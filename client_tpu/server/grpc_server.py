@@ -1,0 +1,183 @@
+"""gRPC front-end for the inference server core."""
+
+from __future__ import annotations
+
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from client_tpu.protocol import inference_pb2 as pb
+from client_tpu.protocol.service import (
+    GRPCInferenceServiceServicer,
+    add_GRPCInferenceServiceServicer_to_server,
+)
+from client_tpu.server.core import InferenceServerCore
+from client_tpu.utils import InferenceServerException
+
+_STATUS_MAP = {
+    "NOT_FOUND": grpc.StatusCode.NOT_FOUND,
+    "INVALID_ARGUMENT": grpc.StatusCode.INVALID_ARGUMENT,
+    "ALREADY_EXISTS": grpc.StatusCode.ALREADY_EXISTS,
+    "UNAVAILABLE": grpc.StatusCode.UNAVAILABLE,
+    "INTERNAL": grpc.StatusCode.INTERNAL,
+    "UNIMPLEMENTED": grpc.StatusCode.UNIMPLEMENTED,
+}
+
+
+def _abort(context, error: InferenceServerException):
+    code = _STATUS_MAP.get(error.status() or "", grpc.StatusCode.INTERNAL)
+    context.abort(code, error.message())
+
+
+class InferenceServicer(GRPCInferenceServiceServicer):
+    def __init__(self, core: InferenceServerCore):
+        self._core = core
+
+    def ServerLive(self, request, context):
+        return pb.ServerLiveResponse(live=self._core.server_live())
+
+    def ServerReady(self, request, context):
+        return pb.ServerReadyResponse(ready=self._core.server_ready())
+
+    def ModelReady(self, request, context):
+        return pb.ModelReadyResponse(
+            ready=self._core.model_ready(request.name, request.version)
+        )
+
+    def ServerMetadata(self, request, context):
+        return self._core.server_metadata()
+
+    def ModelMetadata(self, request, context):
+        try:
+            return self._core.model_metadata(request.name, request.version)
+        except InferenceServerException as e:
+            _abort(context, e)
+
+    def ModelConfig(self, request, context):
+        try:
+            return self._core.model_config(request.name, request.version)
+        except InferenceServerException as e:
+            _abort(context, e)
+
+    def ModelInfer(self, request, context):
+        try:
+            return self._core.infer(request)
+        except InferenceServerException as e:
+            _abort(context, e)
+
+    def ModelStreamInfer(self, request_iterator, context):
+        for request in request_iterator:
+            try:
+                yield from self._core.stream_infer(request)
+            except InferenceServerException as e:
+                # decoupled errors ride the stream rather than aborting it
+                yield pb.ModelStreamInferResponse(error_message=str(e))
+
+    def ModelStatistics(self, request, context):
+        try:
+            return self._core.model_statistics(request.name, request.version)
+        except InferenceServerException as e:
+            _abort(context, e)
+
+    def RepositoryIndex(self, request, context):
+        return self._core.repository_index(request.ready)
+
+    def RepositoryModelLoad(self, request, context):
+        try:
+            self._core.load_model(request.model_name)
+            return pb.RepositoryModelLoadResponse()
+        except InferenceServerException as e:
+            _abort(context, e)
+
+    def RepositoryModelUnload(self, request, context):
+        try:
+            self._core.unload_model(request.model_name)
+            return pb.RepositoryModelUnloadResponse()
+        except InferenceServerException as e:
+            _abort(context, e)
+
+    def SystemSharedMemoryStatus(self, request, context):
+        return self._core.system_shm_status(request.name)
+
+    def SystemSharedMemoryRegister(self, request, context):
+        try:
+            self._core.register_system_shm(
+                request.name, request.key, request.offset, request.byte_size
+            )
+            return pb.SystemSharedMemoryRegisterResponse()
+        except InferenceServerException as e:
+            _abort(context, e)
+
+    def SystemSharedMemoryUnregister(self, request, context):
+        try:
+            self._core.unregister_system_shm(request.name)
+            return pb.SystemSharedMemoryUnregisterResponse()
+        except InferenceServerException as e:
+            _abort(context, e)
+
+    def TpuSharedMemoryStatus(self, request, context):
+        return self._core.tpu_shm_status(request.name)
+
+    def TpuSharedMemoryRegister(self, request, context):
+        try:
+            self._core.register_tpu_shm(
+                request.name, request.raw_handle, request.device_id,
+                request.byte_size,
+            )
+            return pb.TpuSharedMemoryRegisterResponse()
+        except InferenceServerException as e:
+            _abort(context, e)
+
+    def TpuSharedMemoryUnregister(self, request, context):
+        try:
+            self._core.unregister_tpu_shm(request.name)
+            return pb.TpuSharedMemoryUnregisterResponse()
+        except InferenceServerException as e:
+            _abort(context, e)
+
+    def TraceSetting(self, request, context):
+        updates = {k: list(v.value) for k, v in request.settings.items()}
+        settings = self._core.trace_setting(request.model_name, updates)
+        response = pb.TraceSettingResponse()
+        for key, values in settings.items():
+            response.settings[key].value.extend(values)
+        return response
+
+    def LogSettings(self, request, context):
+        updates = {}
+        for key, value in request.settings.items():
+            which = value.WhichOneof("parameter_choice")
+            if which:
+                updates[key] = getattr(value, which)
+        settings = self._core.log_settings(updates)
+        response = pb.LogSettingsResponse()
+        for key, value in settings.items():
+            if isinstance(value, bool):
+                response.settings[key].bool_param = value
+            elif isinstance(value, int):
+                response.settings[key].uint32_param = value
+            else:
+                response.settings[key].string_param = str(value)
+        return response
+
+
+def build_grpc_server(
+    core: InferenceServerCore,
+    address: Optional[str] = "0.0.0.0:8001",
+    max_workers: int = 16,
+    extra_servicers=(),
+) -> grpc.Server:
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        options=[
+            ("grpc.max_send_message_length", -1),
+            ("grpc.max_receive_message_length", -1),
+        ],
+    )
+    add_GRPCInferenceServiceServicer_to_server(InferenceServicer(core), server)
+    for add_fn, servicer in extra_servicers:
+        add_fn(servicer, server)
+    if address:
+        server.add_insecure_port(address)
+    return server

@@ -1,0 +1,225 @@
+"""System (POSIX) shared-memory utilities.
+
+API-parity surface with the reference
+``tritonclient.utils.shared_memory`` (utils/shared_memory/__init__.py:
+93-260), which backs it with a small C extension; here ctypes
+``shm_open``/``shm_unlink`` + stdlib ``mmap`` give the same zero-copy
+behavior with no build step (the C++ ``shm_utils`` in ``native/``
+serves the C++ stack).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import mmap
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from client_tpu.utils import (
+    deserialize_bytes_tensor,
+    serialize_byte_tensor,
+    triton_to_np_dtype,
+)
+
+
+class SharedMemoryException(Exception):
+    """Raised on any shared-memory operation failure."""
+
+
+def _load_shm_lib():
+    # shm_open lives in librt on older glibc, libc on newer.
+    for name in ("rt", "c"):
+        path = ctypes.util.find_library(name)
+        if path is None:
+            continue
+        lib = ctypes.CDLL(path, use_errno=True)
+        if hasattr(lib, "shm_open"):
+            return lib
+    raise SharedMemoryException("unable to locate shm_open in libc/librt")
+
+
+_LIB = _load_shm_lib()
+_LIB.shm_open.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_uint]
+_LIB.shm_open.restype = ctypes.c_int
+_LIB.shm_unlink.argtypes = [ctypes.c_char_p]
+_LIB.shm_unlink.restype = ctypes.c_int
+
+_O_RDWR = os.O_RDWR
+_O_CREAT = os.O_CREAT
+
+
+class SharedMemoryRegion:
+    """Handle to a mapped POSIX shared-memory region."""
+
+    def __init__(self, triton_shm_name: str, shm_key: str):
+        self._triton_shm_name = triton_shm_name
+        self._shm_key = shm_key
+        self._byte_size = 0
+        self._fd = -1
+        self._mpg: Optional[mmap.mmap] = None
+        self._created = False
+
+    @property
+    def name(self) -> str:
+        return self._triton_shm_name
+
+    @property
+    def key(self) -> str:
+        return self._shm_key
+
+    @property
+    def byte_size(self) -> int:
+        return self._byte_size
+
+    def buf(self) -> mmap.mmap:
+        if self._mpg is None:
+            raise SharedMemoryException("region is not mapped")
+        return self._mpg
+
+
+_mapped_regions: dict = {}
+
+
+def create_shared_memory_region(
+    triton_shm_name: str, shm_key: str, byte_size: int, create_only: bool = False
+) -> SharedMemoryRegion:
+    """Create (or attach, unless ``create_only``) and map the POSIX
+    region ``shm_key`` of ``byte_size`` bytes."""
+    region = SharedMemoryRegion(triton_shm_name, shm_key)
+    flags = _O_RDWR | _O_CREAT
+    if create_only:
+        flags |= os.O_EXCL
+    fd = _LIB.shm_open(shm_key.encode(), flags, 0o600)
+    if fd < 0:
+        err = ctypes.get_errno()
+        raise SharedMemoryException(
+            "unable to create shared memory region '%s': %s"
+            % (shm_key, os.strerror(err))
+        )
+    try:
+        stat = os.fstat(fd)
+        region._created = stat.st_size == 0
+        if stat.st_size < byte_size:
+            os.ftruncate(fd, byte_size)
+        region._fd = fd
+        region._byte_size = byte_size
+        region._mpg = mmap.mmap(fd, byte_size)
+    except OSError as e:
+        os.close(fd)
+        raise SharedMemoryException(
+            "unable to map shared memory region '%s': %s" % (shm_key, e)
+        )
+    _mapped_regions[triton_shm_name] = region
+    return region
+
+
+def attach_shared_memory_region(
+    triton_shm_name: str, shm_key: str, byte_size: int
+) -> SharedMemoryRegion:
+    """Attach to an existing region without creating it (used
+    server-side when a client registers a region)."""
+    region = SharedMemoryRegion(triton_shm_name, shm_key)
+    fd = _LIB.shm_open(shm_key.encode(), _O_RDWR, 0o600)
+    if fd < 0:
+        raise SharedMemoryException(
+            "unable to open shared memory region '%s': %s"
+            % (shm_key, os.strerror(ctypes.get_errno()))
+        )
+    try:
+        size = os.fstat(fd).st_size
+        if size < byte_size:
+            raise SharedMemoryException(
+                "region '%s' is %d bytes, %d requested"
+                % (shm_key, size, byte_size)
+            )
+        region._fd = fd
+        region._byte_size = byte_size
+        region._mpg = mmap.mmap(fd, byte_size)
+    except SharedMemoryException:
+        os.close(fd)
+        raise
+    except OSError as e:
+        os.close(fd)
+        raise SharedMemoryException(str(e))
+    return region
+
+
+def set_shared_memory_region(
+    shm_handle: SharedMemoryRegion, input_values, offset: int = 0
+) -> None:
+    """Copy a list of numpy arrays into the region back to back
+    starting at ``offset`` (BYTES arrays are wire-serialized)."""
+    if not isinstance(input_values, (list, tuple)):
+        raise SharedMemoryException("input_values must be a list of numpy arrays")
+    buf = shm_handle.buf()
+    pos = offset
+    for arr in input_values:
+        if arr.dtype.kind in ("O", "S", "U"):
+            data = serialize_byte_tensor(arr).tobytes()
+        else:
+            data = np.ascontiguousarray(arr).tobytes()
+        if pos + len(data) > shm_handle.byte_size:
+            raise SharedMemoryException("input exceeds shared memory region size")
+        buf[pos : pos + len(data)] = data
+        pos += len(data)
+
+
+def get_contents_as_numpy(
+    shm_handle: SharedMemoryRegion, datatype, shape, offset: int = 0
+) -> np.ndarray:
+    """View/copy the region contents as a numpy array of
+    datatype/shape. Fixed-size dtypes return a zero-copy view."""
+    buf = shm_handle.buf()
+    if isinstance(datatype, str):
+        np_dtype = triton_to_np_dtype(datatype)
+        wire = datatype
+    else:
+        np_dtype = np.dtype(datatype)
+        wire = None
+    if np_dtype == np.object_ or wire == "BYTES":
+        end = shm_handle.byte_size
+        return deserialize_bytes_tensor(bytes(buf[offset:end])).reshape(shape)
+    count = int(np.prod(shape)) if len(shape) else 1
+    return np.frombuffer(
+        memoryview(buf), dtype=np_dtype, count=count, offset=offset
+    ).reshape(shape)
+
+
+def get_shared_memory_handle_info(shm_handle: SharedMemoryRegion):
+    """(shm_key, byte_size, fd) of the underlying region."""
+    return (shm_handle.key, shm_handle.byte_size, shm_handle._fd)
+
+
+def mapped_shared_memory_regions() -> List[str]:
+    return list(_mapped_regions.keys())
+
+
+def _release_mapping(shm_handle: SharedMemoryRegion) -> None:
+    # Zero-copy numpy views may still reference the mapping; in that
+    # case dropping our reference lets GC unmap once the views die.
+    if shm_handle._mpg is not None:
+        try:
+            shm_handle._mpg.close()
+        except BufferError:
+            pass
+        shm_handle._mpg = None
+    if shm_handle._fd >= 0:
+        os.close(shm_handle._fd)
+        shm_handle._fd = -1
+
+
+def destroy_shared_memory_region(shm_handle: SharedMemoryRegion) -> None:
+    """Unmap and unlink the region."""
+    try:
+        _release_mapping(shm_handle)
+    finally:
+        _mapped_regions.pop(shm_handle.name, None)
+        _LIB.shm_unlink(shm_handle.key.encode())
+
+
+def detach_shared_memory_region(shm_handle: SharedMemoryRegion) -> None:
+    """Unmap without unlinking (server detaching a client's region)."""
+    _release_mapping(shm_handle)

@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""Minimal sync gRPC inference against the `simple` add/sub model.
+
+Start a server first:  python -m client_tpu.server.app --models simple
+(parity example: reference src/python/examples/simple_grpc_infer_client.py)
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import client_tpu.grpc as grpcclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args()
+
+    with grpcclient.InferenceServerClient(args.url, verbose=args.verbose) as client:
+        if not client.is_server_live():
+            print("server is not live", file=sys.stderr)
+            sys.exit(1)
+
+        in0 = np.arange(16, dtype=np.int32)
+        in1 = np.ones(16, dtype=np.int32)
+        inputs = [
+            grpcclient.InferInput("INPUT0", [16], "INT32"),
+            grpcclient.InferInput("INPUT1", [16], "INT32"),
+        ]
+        inputs[0].set_data_from_numpy(in0)
+        inputs[1].set_data_from_numpy(in1)
+        outputs = [
+            grpcclient.InferRequestedOutput("OUTPUT0"),
+            grpcclient.InferRequestedOutput("OUTPUT1"),
+        ]
+
+        result = client.infer("simple", inputs, outputs=outputs)
+        out0 = result.as_numpy("OUTPUT0")
+        out1 = result.as_numpy("OUTPUT1")
+        for i in range(16):
+            print(f"{in0[i]} + {in1[i]} = {out0[i]}")
+            assert out0[i] == in0[i] + in1[i], "add result mismatch"
+            assert out1[i] == in0[i] - in1[i], "sub result mismatch"
+        print("PASS: infer")
+
+
+if __name__ == "__main__":
+    main()

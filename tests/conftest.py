@@ -1,0 +1,23 @@
+"""Test config: force JAX onto a virtual 8-device CPU platform so
+sharding/mesh tests run anywhere (the driver separately dry-runs the
+multi-chip path). Must run before jax is imported anywhere."""
+
+import os
+import sys
+
+# Force CPU even if the outer environment selects a TPU platform.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# The image's sitecustomize registers a TPU platform plugin and forces
+# it programmatically, so the env var alone is not enough — override
+# the jax config before any backend initializes.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

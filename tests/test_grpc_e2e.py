@@ -1,0 +1,267 @@
+"""End-to-end gRPC integration tests: real client against a real
+in-process server with the `simple` add_sub model (tier-2 of the test
+strategy, SURVEY.md §4 — the analogue of cc_client_test.cc run against
+a live server)."""
+
+import queue
+
+import numpy as np
+import pytest
+
+import client_tpu.grpc as grpcclient
+from client_tpu.server.app import start_grpc_server
+from client_tpu.utils import InferenceServerException
+
+
+@pytest.fixture(scope="module")
+def server():
+    handle = start_grpc_server(load_models=["simple", "add_sub_fp32"])
+    yield handle
+    handle.stop()
+
+
+@pytest.fixture()
+def client(server):
+    with grpcclient.InferenceServerClient(server.address) as c:
+        yield c
+
+
+def _simple_inputs():
+    in0 = np.arange(16, dtype=np.int32)
+    in1 = np.ones(16, dtype=np.int32)
+    inputs = [
+        grpcclient.InferInput("INPUT0", [16], "INT32"),
+        grpcclient.InferInput("INPUT1", [16], "INT32"),
+    ]
+    inputs[0].set_data_from_numpy(in0)
+    inputs[1].set_data_from_numpy(in1)
+    return in0, in1, inputs
+
+
+def test_health(client):
+    assert client.is_server_live()
+    assert client.is_server_ready()
+    assert client.is_model_ready("simple")
+    assert not client.is_model_ready("no_such_model")
+
+
+def test_server_metadata(client):
+    meta = client.get_server_metadata()
+    assert meta.name == "client_tpu_server"
+    assert "system_shared_memory" in meta.extensions
+    as_json = client.get_server_metadata(as_json=True)
+    assert as_json["name"] == "client_tpu_server"
+
+
+def test_model_metadata(client):
+    meta = client.get_model_metadata("simple")
+    assert meta.name == "simple"
+    assert [t.name for t in meta.inputs] == ["INPUT0", "INPUT1"]
+    assert list(meta.inputs[0].shape) == [16]
+    assert meta.inputs[0].datatype == "INT32"
+
+
+def test_model_config(client):
+    config = client.get_model_config("simple")
+    assert config.config.name == "simple"
+    assert len(config.config.input) == 2
+
+
+def test_model_metadata_unknown(client):
+    with pytest.raises(InferenceServerException) as exc:
+        client.get_model_metadata("no_such_model")
+    assert exc.value.status() == "NOT_FOUND"
+
+
+def test_infer(client):
+    in0, in1, inputs = _simple_inputs()
+    result = client.infer("simple", inputs)
+    np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), in0 + in1)
+    np.testing.assert_array_equal(result.as_numpy("OUTPUT1"), in0 - in1)
+
+
+def test_infer_requested_output_subset(client):
+    in0, in1, inputs = _simple_inputs()
+    outputs = [grpcclient.InferRequestedOutput("OUTPUT1")]
+    result = client.infer("simple", inputs, outputs=outputs, request_id="42")
+    assert result.get_response().id == "42"
+    assert result.as_numpy("OUTPUT0") is None
+    np.testing.assert_array_equal(result.as_numpy("OUTPUT1"), in0 - in1)
+
+
+def test_infer_fp32(client):
+    x = np.random.rand(16).astype(np.float32)
+    y = np.random.rand(16).astype(np.float32)
+    inputs = [
+        grpcclient.InferInput("INPUT0", [16], "FP32").set_data_from_numpy(x),
+        grpcclient.InferInput("INPUT1", [16], "FP32").set_data_from_numpy(y),
+    ]
+    result = client.infer("add_sub_fp32", inputs)
+    np.testing.assert_allclose(result.as_numpy("OUTPUT0"), x + y, rtol=1e-6)
+
+
+def test_infer_wrong_input_name(client):
+    bad = grpcclient.InferInput("NOPE", [16], "INT32").set_data_from_numpy(
+        np.zeros(16, dtype=np.int32)
+    )
+    _, _, inputs = _simple_inputs()
+    with pytest.raises(InferenceServerException) as exc:
+        client.infer("simple", [bad, inputs[1]])
+    assert exc.value.status() == "INVALID_ARGUMENT"
+
+
+def test_async_infer(client):
+    in0, in1, inputs = _simple_inputs()
+    results = queue.Queue()
+    ctx = client.async_infer(
+        "simple", inputs, lambda result, error: results.put((result, error))
+    )
+    result, error = results.get(timeout=10)
+    assert error is None
+    np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), in0 + in1)
+    assert ctx is not None
+
+
+def test_async_infer_error(client):
+    _, _, inputs = _simple_inputs()
+    results = queue.Queue()
+    client.async_infer(
+        "no_such_model", inputs, lambda r, e: results.put((r, e))
+    )
+    result, error = results.get(timeout=10)
+    assert result is None
+    assert isinstance(error, InferenceServerException)
+    assert error.status() == "NOT_FOUND"
+
+
+def test_stream_infer_non_decoupled(client):
+    in0, in1, inputs = _simple_inputs()
+    results = queue.Queue()
+    client.start_stream(lambda result, error: results.put((result, error)))
+    try:
+        client.async_stream_infer("simple", inputs, request_id="s1")
+        result, error = results.get(timeout=10)
+        assert error is None
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), in0 + in1)
+        params = result.get_parameters()
+        assert params.get("triton_final_response") is True
+    finally:
+        client.stop_stream()
+
+
+def test_statistics(client):
+    in0, in1, inputs = _simple_inputs()
+    client.infer("simple", inputs)
+    stats = client.get_inference_statistics("simple")
+    stat = stats.model_stats[0]
+    assert stat.name == "simple"
+    assert stat.inference_count >= 1
+    assert stat.inference_stats.success.count >= 1
+    assert stat.inference_stats.compute_infer.ns > 0
+
+
+def test_repository_index_load_unload(client):
+    index = client.get_model_repository_index()
+    names = {m.name: m.state for m in index.models}
+    assert names.get("simple") == "READY"
+    assert "add_sub" in names
+    client.load_model("add_sub")
+    assert client.is_model_ready("add_sub")
+    client.unload_model("add_sub")
+    assert not client.is_model_ready("add_sub")
+
+
+def test_trace_and_log_settings(client):
+    settings = client.update_trace_settings(
+        settings={"trace_level": ["TIMESTAMPS"], "trace_rate": "5"}
+    )
+    got = client.get_trace_settings()
+    assert got.settings["trace_level"].value == ["TIMESTAMPS"]
+    assert got.settings["trace_rate"].value == ["5"]
+    log = client.update_log_settings({"log_verbose_level": 2})
+    assert log.settings["log_verbose_level"].uint32_param == 2
+
+
+def test_plugin_headers(server):
+    seen = {}
+
+    class Recorder(grpcclient.InferenceServerClientPlugin):
+        def __call__(self, request):
+            seen.update(request.headers)
+            request.headers["x-extra"] = "1"
+
+    with grpcclient.InferenceServerClient(server.address) as c:
+        c.register_plugin(grpcclient.BasicAuth("user", "pass"))
+        # chained: replace with recorder after unregistering
+        c.unregister_plugin()
+        c.register_plugin(Recorder())
+        assert c.is_server_live()
+
+
+def test_system_shared_memory_roundtrip(client):
+    import client_tpu.utils.shared_memory as shm
+
+    in0 = np.arange(16, dtype=np.int32)
+    in1 = np.full(16, 2, dtype=np.int32)
+    byte_size = in0.nbytes
+    regions = []
+    try:
+        for name, arr in (("in0_region", in0), ("in1_region", in1)):
+            handle = shm.create_shared_memory_region(name, "/ct_" + name,
+                                                     byte_size)
+            shm.set_shared_memory_region(handle, [arr])
+            client.register_system_shared_memory(name, "/ct_" + name, byte_size)
+            regions.append(handle)
+        out_handle = shm.create_shared_memory_region(
+            "out0_region", "/ct_out0", byte_size
+        )
+        regions.append(out_handle)
+        client.register_system_shared_memory("out0_region", "/ct_out0",
+                                             byte_size)
+
+        status = client.get_system_shared_memory_status()
+        assert set(status.regions.keys()) >= {"in0_region", "in1_region",
+                                              "out0_region"}
+
+        inputs = [
+            grpcclient.InferInput("INPUT0", [16], "INT32"),
+            grpcclient.InferInput("INPUT1", [16], "INT32"),
+        ]
+        inputs[0].set_shared_memory("in0_region", byte_size)
+        inputs[1].set_shared_memory("in1_region", byte_size)
+        outputs = [
+            grpcclient.InferRequestedOutput("OUTPUT0"),
+            grpcclient.InferRequestedOutput("OUTPUT1"),
+        ]
+        outputs[0].set_shared_memory("out0_region", byte_size)
+        result = client.infer("simple", inputs, outputs=outputs)
+
+        # OUTPUT0 landed in shared memory
+        assert result.as_numpy("OUTPUT0") is None
+        out_tensor = result.get_output("OUTPUT0")
+        assert (
+            out_tensor.parameters["shared_memory_region"].string_param
+            == "out0_region"
+        )
+        out0 = shm.get_contents_as_numpy(out_handle, "INT32", [16])
+        np.testing.assert_array_equal(out0, in0 + in1)
+        # OUTPUT1 came back on the wire
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT1"), in0 - in1)
+    finally:
+        client.unregister_system_shared_memory()
+        for handle in regions:
+            shm.destroy_shared_memory_region(handle)
+
+
+def test_register_duplicate_region(client):
+    import client_tpu.utils.shared_memory as shm
+
+    handle = shm.create_shared_memory_region("dup", "/ct_dup", 64)
+    try:
+        client.register_system_shared_memory("dup", "/ct_dup", 64)
+        with pytest.raises(InferenceServerException) as exc:
+            client.register_system_shared_memory("dup", "/ct_dup", 64)
+        assert exc.value.status() == "ALREADY_EXISTS"
+    finally:
+        client.unregister_system_shared_memory("dup")
+        shm.destroy_shared_memory_region(handle)

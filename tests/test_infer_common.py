@@ -1,0 +1,95 @@
+"""Unit tests for the transport-neutral data model."""
+
+import numpy as np
+import pytest
+import ml_dtypes
+
+from client_tpu._infer_common import (
+    InferInput,
+    InferRequestedOutput,
+    build_request_parameters,
+)
+from client_tpu.utils import InferenceServerException
+
+
+def test_infer_input_numpy():
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    inp = InferInput("in0", [2, 3], "FP32")
+    inp.set_data_from_numpy(x)
+    assert inp.raw_data() == x.tobytes()
+    assert inp.shape() == [2, 3]
+    inp.validate()
+
+
+def test_infer_input_dtype_mismatch():
+    inp = InferInput("in0", [2], "FP32")
+    with pytest.raises(InferenceServerException, match="unexpected datatype"):
+        inp.set_data_from_numpy(np.zeros(2, dtype=np.int32))
+
+
+def test_infer_input_shape_mismatch():
+    inp = InferInput("in0", [2, 3], "FP32")
+    with pytest.raises(InferenceServerException, match="unexpected numpy array shape"):
+        inp.set_data_from_numpy(np.zeros((3, 2), dtype=np.float32))
+
+
+def test_infer_input_bytes():
+    arr = np.array([b"ab", b"c"], dtype=np.object_)
+    inp = InferInput("s", [2], "BYTES")
+    inp.set_data_from_numpy(arr)
+    assert inp.raw_data() == b"\x02\x00\x00\x00ab\x01\x00\x00\x00c"
+
+
+def test_infer_input_bf16_from_float():
+    inp = InferInput("b", [3], "BF16")
+    inp.set_data_from_numpy(np.array([1, 2, 3], dtype=np.float32))
+    assert len(inp.raw_data()) == 6
+    out = np.frombuffer(inp.raw_data(), dtype=ml_dtypes.bfloat16)
+    assert np.allclose(out.astype(np.float32), [1, 2, 3])
+
+
+def test_infer_input_shared_memory():
+    inp = InferInput("in0", [2, 2], "FP32")
+    inp.set_shared_memory("region0", 16, offset=4)
+    assert inp.shared_memory() == ("region0", 16, 4)
+    assert inp.raw_data() is None
+    inp.validate()
+    # setting numpy data clears shm and vice versa
+    inp.set_data_from_numpy(np.zeros((2, 2), dtype=np.float32))
+    assert inp.shared_memory() is None
+    inp.set_shared_memory("region0", 16)
+    assert inp.raw_data() is None
+
+
+def test_infer_input_no_data():
+    with pytest.raises(InferenceServerException, match="has no data"):
+        InferInput("in0", [1], "FP32").validate()
+
+
+def test_infer_input_size_validation():
+    inp = InferInput("in0", [4], "FP32")
+    inp.set_data_from_numpy(np.zeros(4, dtype=np.float32))
+    inp.set_shape([5])
+    with pytest.raises(InferenceServerException, match="expected 20"):
+        inp.validate()
+
+
+def test_requested_output():
+    out = InferRequestedOutput("out0", binary_data=False, class_count=3)
+    assert out.name() == "out0"
+    assert not out.binary_data()
+    assert out.class_count() == 3
+    out.set_shared_memory("r", 64)
+    assert out.shared_memory() == ("r", 64, 0)
+    out.unset_shared_memory()
+    assert out.shared_memory() is None
+
+
+def test_request_parameters():
+    p = build_request_parameters(sequence_id=5, sequence_start=True, priority=2,
+                                 timeout=100, parameters={"x": 1})
+    assert p == {"sequence_id": 5, "sequence_start": True, "sequence_end": False,
+                 "priority": 2, "timeout": 100, "x": 1}
+    assert build_request_parameters() == {}
+    with pytest.raises(InferenceServerException, match="reserved"):
+        build_request_parameters(parameters={"priority": 1})

@@ -1,0 +1,94 @@
+"""Unit tests for client_tpu.utils serialization + dtype mapping.
+
+Mirrors the coverage intent of the reference's utils tests (BYTES and
+BF16 round-trips, dtype table completeness)."""
+
+import numpy as np
+import pytest
+
+import ml_dtypes
+
+from client_tpu.utils import (
+    InferenceServerException,
+    deserialize_bf16_tensor,
+    deserialize_bytes_tensor,
+    np_to_wire_dtype,
+    serialize_bf16_tensor,
+    serialize_byte_tensor,
+    serialized_byte_size,
+    tensor_byte_size,
+    wire_to_np_dtype,
+)
+
+ALL_FIXED = [
+    ("BOOL", np.bool_), ("INT8", np.int8), ("INT16", np.int16),
+    ("INT32", np.int32), ("INT64", np.int64), ("UINT8", np.uint8),
+    ("UINT16", np.uint16), ("UINT32", np.uint32), ("UINT64", np.uint64),
+    ("FP16", np.float16), ("FP32", np.float32), ("FP64", np.float64),
+]
+
+
+@pytest.mark.parametrize("wire,np_t", ALL_FIXED)
+def test_dtype_roundtrip(wire, np_t):
+    assert np_to_wire_dtype(np_t) == wire
+    assert wire_to_np_dtype(wire) == np.dtype(np_t)
+
+
+def test_bf16_dtype():
+    assert np_to_wire_dtype(ml_dtypes.bfloat16) == "BF16"
+    assert wire_to_np_dtype("BF16") == np.dtype(ml_dtypes.bfloat16)
+
+
+def test_bytes_dtype():
+    assert np_to_wire_dtype(np.object_) == "BYTES"
+    assert np_to_wire_dtype("S10") == "BYTES"
+    assert wire_to_np_dtype("BYTES") == np.dtype(np.object_)
+
+
+def test_byte_tensor_roundtrip():
+    arr = np.array([b"abc", b"", b"hello world", "unicodeé".encode()],
+                   dtype=np.object_).reshape(2, 2)
+    enc = serialize_byte_tensor(arr)
+    dec = deserialize_bytes_tensor(enc.tobytes()).reshape(2, 2)
+    assert dec.tolist() == arr.tolist()
+
+
+def test_byte_tensor_from_str():
+    arr = np.array(["a", "bb"], dtype=np.object_)
+    enc = serialize_byte_tensor(arr).tobytes()
+    dec = deserialize_bytes_tensor(enc)
+    assert dec.tolist() == [b"a", b"bb"]
+    assert serialized_byte_size(arr) == len(enc) == 4 + 1 + 4 + 2
+
+
+def test_byte_tensor_empty():
+    assert serialize_byte_tensor(np.array([], dtype=np.object_)).size == 0
+    assert deserialize_bytes_tensor(b"").size == 0
+
+
+def test_byte_tensor_malformed():
+    with pytest.raises(InferenceServerException):
+        deserialize_bytes_tensor(b"\x05\x00\x00\x00ab")  # overrun
+    with pytest.raises(InferenceServerException):
+        deserialize_bytes_tensor(b"\x01\x00")  # truncated prefix
+
+
+def test_bf16_roundtrip():
+    x = np.array([[1.5, -2.25], [0.0, 3e8]], dtype=ml_dtypes.bfloat16)
+    enc = serialize_bf16_tensor(x)
+    assert enc.dtype == np.uint8 and enc.size == x.size * 2
+    dec = deserialize_bf16_tensor(enc.tobytes()).reshape(x.shape)
+    assert np.array_equal(dec, x)
+
+
+def test_bf16_from_float32():
+    x = np.array([1.0, 2.0, 3.0], dtype=np.float32)
+    enc = serialize_bf16_tensor(x)
+    dec = deserialize_bf16_tensor(enc.tobytes())
+    assert np.allclose(dec.astype(np.float32), x)
+
+
+def test_tensor_byte_size():
+    assert tensor_byte_size("FP32", [2, 3]) == 24
+    assert tensor_byte_size("BF16", [4]) == 8
+    assert tensor_byte_size("BYTES", [4]) == -1
