@@ -4,4 +4,5 @@ set -e
 cd "$(dirname "$0")/../.."
 protoc -I. --python_out=. \
   client_tpu/protocol/model_config.proto \
-  client_tpu/protocol/inference.proto
+  client_tpu/protocol/inference.proto \
+  client_tpu/protocol/arena.proto

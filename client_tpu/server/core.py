@@ -429,12 +429,13 @@ class InferenceServerCore:
             if cls_count:
                 value = _classification(np.asarray(value), cls_count)
             arr = value
-            np_arr = np.asarray(arr) if not isinstance(arr, np.ndarray) else arr
-            datatype = np_to_wire_dtype(np_arr.dtype)
+            # dtype/shape come from the array metadata — never force a
+            # device->host transfer for shm-placed outputs
+            datatype = np_to_wire_dtype(arr.dtype)
             tensor = response.outputs.add()
             tensor.name = name
             tensor.datatype = datatype
-            tensor.shape.extend(int(d) for d in np_arr.shape)
+            tensor.shape.extend(int(d) for d in arr.shape)
             if req is not None and "shared_memory_region" in req.parameters:
                 region = req.parameters["shared_memory_region"].string_param
                 byte_size = req.parameters["shared_memory_byte_size"].int64_param
@@ -451,6 +452,7 @@ class InferenceServerCore:
                 if offset:
                     tensor.parameters["shared_memory_offset"].int64_param = offset
             else:
+                np_arr = arr if isinstance(arr, np.ndarray) else np.asarray(arr)
                 if datatype == "BYTES":
                     raw = serialize_byte_tensor(np_arr).tobytes()
                 elif datatype == "BF16":

@@ -1,0 +1,142 @@
+"""asyncio client tests (grpc.aio + http.aio) against live servers."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import client_tpu.grpc.aio as grpcclient_aio
+import client_tpu.http.aio as httpclient_aio
+from client_tpu._infer_common import InferInput
+from client_tpu.server.app import build_core, start_grpc_server
+from client_tpu.server.http_server import start_http_server_thread
+from client_tpu.utils import InferenceServerException
+
+
+@pytest.fixture(scope="module")
+def servers():
+    core = build_core(["simple"])
+    grpc_handle = start_grpc_server(core=core)
+    http_runner = start_http_server_thread(core, host="127.0.0.1", port=0)
+    yield grpc_handle, http_runner
+    http_runner.stop()
+    grpc_handle.stop()
+
+
+def _inputs():
+    in0 = np.arange(16, dtype=np.int32)
+    in1 = np.ones(16, dtype=np.int32)
+    inputs = [
+        InferInput("INPUT0", [16], "INT32"),
+        InferInput("INPUT1", [16], "INT32"),
+    ]
+    inputs[0].set_data_from_numpy(in0)
+    inputs[1].set_data_from_numpy(in1)
+    return in0, in1, inputs
+
+
+def test_grpc_aio_basic(servers):
+    grpc_handle, _ = servers
+
+    async def run():
+        async with grpcclient_aio.InferenceServerClient(
+            grpc_handle.address
+        ) as client:
+            assert await client.is_server_live()
+            assert await client.is_server_ready()
+            assert await client.is_model_ready("simple")
+            meta = await client.get_model_metadata("simple")
+            assert meta.name == "simple"
+            in0, in1, inputs = _inputs()
+            result = await client.infer("simple", inputs)
+            np.testing.assert_array_equal(result.as_numpy("OUTPUT0"),
+                                          in0 + in1)
+            with pytest.raises(InferenceServerException):
+                await client.get_model_metadata("ghost")
+
+    asyncio.run(run())
+
+
+def test_grpc_aio_concurrent_infer(servers):
+    grpc_handle, _ = servers
+
+    async def run():
+        async with grpcclient_aio.InferenceServerClient(
+            grpc_handle.address
+        ) as client:
+            in0, in1, inputs = _inputs()
+            results = await asyncio.gather(
+                *[client.infer("simple", inputs) for _ in range(16)]
+            )
+            for result in results:
+                np.testing.assert_array_equal(result.as_numpy("OUTPUT1"),
+                                              in0 - in1)
+
+    asyncio.run(run())
+
+
+def test_grpc_aio_stream(servers):
+    grpc_handle, _ = servers
+
+    async def run():
+        async with grpcclient_aio.InferenceServerClient(
+            grpc_handle.address
+        ) as client:
+            in0, in1, inputs = _inputs()
+
+            async def request_iter():
+                for i in range(3):
+                    yield {"model_name": "simple", "inputs": inputs,
+                           "request_id": str(i)}
+
+            seen = []
+            async for result, error in client.stream_infer(request_iter()):
+                assert error is None
+                seen.append(result.get_response().id)
+                np.testing.assert_array_equal(result.as_numpy("OUTPUT0"),
+                                              in0 + in1)
+            assert seen == ["0", "1", "2"]
+
+    asyncio.run(run())
+
+
+def test_http_aio_basic(servers):
+    _, http_runner = servers
+
+    async def run():
+        async with httpclient_aio.InferenceServerClient(
+            "127.0.0.1:%d" % http_runner.port
+        ) as client:
+            assert await client.is_server_live()
+            assert await client.is_model_ready("simple")
+            meta = await client.get_server_metadata()
+            assert meta["name"] == "client_tpu_server"
+            in0, in1, inputs = _inputs()
+            result = await client.infer("simple", inputs, request_id="aio")
+            assert result.get_response()["id"] == "aio"
+            np.testing.assert_array_equal(result.as_numpy("OUTPUT0"),
+                                          in0 + in1)
+            stats = await client.get_inference_statistics("simple")
+            assert stats["model_stats"][0]["name"] == "simple"
+            with pytest.raises(InferenceServerException):
+                await client.infer("ghost", inputs)
+
+    asyncio.run(run())
+
+
+def test_http_aio_concurrent(servers):
+    _, http_runner = servers
+
+    async def run():
+        async with httpclient_aio.InferenceServerClient(
+            "127.0.0.1:%d" % http_runner.port
+        ) as client:
+            in0, in1, inputs = _inputs()
+            results = await asyncio.gather(
+                *[client.infer("simple", inputs) for _ in range(16)]
+            )
+            for result in results:
+                np.testing.assert_array_equal(result.as_numpy("OUTPUT0"),
+                                              in0 + in1)
+
+    asyncio.run(run())

@@ -1,0 +1,190 @@
+"""TPU shared-memory tests — the north-star path (SURVEY.md §3.5):
+region lifecycle, zero-copy inference I/O, DLPack ingestion, both
+remote (arena service over gRPC) and in-process (co-located) modes."""
+
+import numpy as np
+import pytest
+
+import client_tpu.grpc as grpcclient
+import client_tpu.utils.tpu_shared_memory as tpushm
+from client_tpu.server.app import build_core, start_grpc_server
+from client_tpu.server.tpu_arena import TpuArena
+from client_tpu.utils import InferenceServerException
+
+
+@pytest.fixture(scope="module")
+def server():
+    core = build_core(["add_sub_fp32"])
+    assert core.memory.arena is not None, "arena must be available"
+    handle = start_grpc_server(core=core)
+    yield handle
+    handle.stop()
+
+
+@pytest.fixture()
+def remote_arena(server):
+    tpushm.set_arena_endpoint(server.address)
+    yield
+    tpushm._default_transport = None
+
+
+@pytest.fixture()
+def client(server):
+    with grpcclient.InferenceServerClient(server.address) as c:
+        yield c
+
+
+def test_region_lifecycle(remote_arena):
+    handle = tpushm.create_shared_memory_region("r0", 64, 0)
+    assert "r0" in tpushm.allocated_shared_memory_regions()
+    raw = tpushm.get_raw_handle(handle)
+    assert b"region_id" in raw
+    tpushm.destroy_shared_memory_region(handle)
+    assert "r0" not in tpushm.allocated_shared_memory_regions()
+
+
+def test_set_get_roundtrip(remote_arena):
+    x = np.random.rand(4, 4).astype(np.float32)
+    handle = tpushm.create_shared_memory_region("rt", x.nbytes, 0)
+    try:
+        tpushm.set_shared_memory_region(handle, [x])
+        out = tpushm.get_contents_as_numpy(handle, "FP32", [4, 4])
+        np.testing.assert_array_equal(out, x)
+    finally:
+        tpushm.destroy_shared_memory_region(handle)
+
+
+def test_bytes_roundtrip(remote_arena):
+    arr = np.array([b"alpha", b"bravo!"], dtype=np.object_)
+    handle = tpushm.create_shared_memory_region("bt", 64, 0)
+    try:
+        tpushm.set_shared_memory_region(handle, [arr])
+        out = tpushm.get_contents_as_numpy(handle, "BYTES", [2])
+        assert out.tolist() == arr.tolist()
+    finally:
+        tpushm.destroy_shared_memory_region(handle)
+
+
+def test_zero_copy_infer(remote_arena, client):
+    """The full north-star flow: create regions, register, infer with
+    device-resident I/O, read results (reference §3.5 call stack)."""
+    x = np.random.rand(16).astype(np.float32)
+    y = np.random.rand(16).astype(np.float32)
+    byte_size = x.nbytes
+    h_in0 = tpushm.create_shared_memory_region("t_in0", byte_size, 0)
+    h_in1 = tpushm.create_shared_memory_region("t_in1", byte_size, 0)
+    h_out0 = tpushm.create_shared_memory_region("t_out0", byte_size, 0)
+    try:
+        tpushm.set_shared_memory_region(h_in0, [x])
+        tpushm.set_shared_memory_region(h_in1, [y])
+        client.register_tpu_shared_memory(
+            "t_in0", tpushm.get_raw_handle(h_in0), 0, byte_size
+        )
+        client.register_tpu_shared_memory(
+            "t_in1", tpushm.get_raw_handle(h_in1), 0, byte_size
+        )
+        client.register_tpu_shared_memory(
+            "t_out0", tpushm.get_raw_handle(h_out0), 0, byte_size
+        )
+        status = client.get_tpu_shared_memory_status()
+        assert set(status.regions.keys()) == {"t_in0", "t_in1", "t_out0"}
+
+        inputs = [
+            grpcclient.InferInput("INPUT0", [16], "FP32"),
+            grpcclient.InferInput("INPUT1", [16], "FP32"),
+        ]
+        inputs[0].set_shared_memory("t_in0", byte_size)
+        inputs[1].set_shared_memory("t_in1", byte_size)
+        outputs = [
+            grpcclient.InferRequestedOutput("OUTPUT0"),
+            grpcclient.InferRequestedOutput("OUTPUT1"),
+        ]
+        outputs[0].set_shared_memory("t_out0", byte_size)
+        result = client.infer("add_sub_fp32", inputs, outputs=outputs)
+
+        assert result.as_numpy("OUTPUT0") is None  # lives in HBM
+        out0 = tpushm.get_contents_as_numpy(h_out0, "FP32", [16])
+        np.testing.assert_allclose(out0, x + y, rtol=1e-6)
+        np.testing.assert_allclose(result.as_numpy("OUTPUT1"), x - y,
+                                   rtol=1e-6)
+    finally:
+        client.unregister_tpu_shared_memory()
+        for h in (h_in0, h_in1, h_out0):
+            tpushm.destroy_shared_memory_region(h)
+
+
+def test_register_bogus_handle(remote_arena, client):
+    with pytest.raises(InferenceServerException) as exc:
+        client.register_tpu_shared_memory("bogus", b"not-a-handle", 0, 64)
+    assert exc.value.status() == "INVALID_ARGUMENT"
+
+
+def test_register_wrong_size(remote_arena, client):
+    handle = tpushm.create_shared_memory_region("sz", 64, 0)
+    try:
+        with pytest.raises(InferenceServerException) as exc:
+            client.register_tpu_shared_memory(
+                "sz", tpushm.get_raw_handle(handle), 0, 128
+            )
+        assert exc.value.status() == "INVALID_ARGUMENT"
+    finally:
+        tpushm.destroy_shared_memory_region(handle)
+
+
+def test_in_process_zero_copy():
+    """Co-located mode: jax.Array in, identity-preserved device array
+    out — the true zero-copy contract."""
+    import jax
+    import jax.numpy as jnp
+
+    arena = TpuArena()
+    tpushm.set_arena(arena)
+    try:
+        x = jnp.arange(16, dtype=jnp.float32)
+        handle = tpushm.create_shared_memory_region("ip", x.nbytes, 0)
+        tpushm.set_shared_memory_region_from_dlpack(handle, x)
+        tensor = tpushm.as_shared_memory_tensor(handle, "FP32", [16])
+        # zero copy: the very same jax.Array object is handed back
+        assert tensor.array is x
+        # and it is DLPack-capable
+        reread = np.from_dlpack(tensor)
+        np.testing.assert_array_equal(reread, np.arange(16, dtype=np.float32))
+        tpushm.destroy_shared_memory_region(handle)
+    finally:
+        tpushm._default_transport = None
+
+
+def test_in_process_torch_dlpack():
+    import torch
+
+    arena = TpuArena()
+    tpushm.set_arena(arena)
+    try:
+        t = torch.arange(8, dtype=torch.float32)
+        handle = tpushm.create_shared_memory_region("tt", 32, 0)
+        tpushm.set_shared_memory_region_from_dlpack(handle, t)
+        out = tpushm.get_contents_as_numpy(handle, "FP32", [8])
+        np.testing.assert_array_equal(out, t.numpy())
+        tpushm.destroy_shared_memory_region(handle)
+    finally:
+        tpushm._default_transport = None
+
+
+def test_typed_view_from_raw_write():
+    """Writes without dtype metadata still resolve to typed device
+    arrays via on-device bitcast."""
+    arena = TpuArena()
+    tpushm.set_arena(arena)
+    try:
+        a = np.arange(8, dtype=np.int32)
+        b = np.arange(8, 16, dtype=np.int32)
+        handle = tpushm.create_shared_memory_region("2arr", 64, 0)
+        tpushm.set_shared_memory_region(handle, [a, b])  # raw path
+        out = tpushm.get_contents_as_numpy(handle, "INT32", [16])
+        np.testing.assert_array_equal(out[:8], a)
+        np.testing.assert_array_equal(out[8:], b)
+        tensor = tpushm.as_shared_memory_tensor(handle, "INT32", [16])
+        np.testing.assert_array_equal(np.asarray(tensor.array)[:8], a)
+        tpushm.destroy_shared_memory_region(handle)
+    finally:
+        tpushm._default_transport = None
