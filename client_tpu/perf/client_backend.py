@@ -1,0 +1,525 @@
+"""Pluggable client-backend abstraction for the perf harness.
+
+Mirrors the role of cb::ClientBackend (/root/reference/src/c++/
+perf_analyzer/client_backend/client_backend.h:366): the load
+generators talk to this interface, concrete backends adapt it to the
+gRPC client, the HTTP client, or the in-process server core (the
+analogue of the TRITONSERVER C-API backend, triton_c_api/).
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from client_tpu._infer_common import InferInput, InferRequestedOutput
+from client_tpu.utils import InferenceServerException
+
+
+class BackendKind(enum.Enum):
+    TRITON_GRPC = "grpc"
+    TRITON_HTTP = "http"
+    IN_PROCESS = "inprocess"
+    MOCK = "mock"
+
+
+class ClientBackend:
+    """One backend instance per worker thread (like the reference,
+    where each worker owns a client)."""
+
+    kind: BackendKind
+
+    # control-plane ------------------------------------------------------
+    def server_metadata(self):
+        raise NotImplementedError
+
+    def model_metadata(self, model_name: str, model_version: str = ""):
+        raise NotImplementedError
+
+    def model_config(self, model_name: str, model_version: str = ""):
+        raise NotImplementedError
+
+    def model_statistics(self, model_name: str = "", model_version: str = ""):
+        raise NotImplementedError
+
+    # data-plane ---------------------------------------------------------
+    def infer(self, model_name, inputs, outputs=None, **kwargs):
+        raise NotImplementedError
+
+    def async_infer(self, callback: Callable, model_name, inputs,
+                    outputs=None, **kwargs):
+        """callback(result, error)"""
+        raise NotImplementedError
+
+    def start_stream(self, callback: Callable):
+        raise NotImplementedError
+
+    def stop_stream(self):
+        raise NotImplementedError
+
+    def async_stream_infer(self, model_name, inputs, outputs=None, **kwargs):
+        raise NotImplementedError
+
+    # shared memory ------------------------------------------------------
+    def register_system_shared_memory(self, name, key, byte_size, offset=0):
+        raise NotImplementedError
+
+    def register_tpu_shared_memory(self, name, raw_handle, device_id,
+                                   byte_size):
+        raise NotImplementedError
+
+    def unregister_system_shared_memory(self, name=""):
+        raise NotImplementedError
+
+    def unregister_tpu_shared_memory(self, name=""):
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+
+class GrpcClientBackend(ClientBackend):
+    kind = BackendKind.TRITON_GRPC
+
+    def __init__(self, url: str, verbose: bool = False):
+        import client_tpu.grpc as grpcclient
+
+        self._client = grpcclient.InferenceServerClient(url, verbose=verbose)
+
+    def server_metadata(self):
+        return self._client.get_server_metadata(as_json=True)
+
+    def model_metadata(self, model_name, model_version=""):
+        return self._client.get_model_metadata(
+            model_name, model_version, as_json=True
+        )
+
+    def model_config(self, model_name, model_version=""):
+        response = self._client.get_model_config(
+            model_name, model_version, as_json=True
+        )
+        return response.get("config", response)
+
+    def model_statistics(self, model_name="", model_version=""):
+        return self._client.get_inference_statistics(
+            model_name, model_version, as_json=True
+        )
+
+    def infer(self, model_name, inputs, outputs=None, **kwargs):
+        return self._client.infer(model_name, inputs, outputs=outputs,
+                                  **kwargs)
+
+    def async_infer(self, callback, model_name, inputs, outputs=None,
+                    **kwargs):
+        return self._client.async_infer(model_name, inputs, callback,
+                                        outputs=outputs, **kwargs)
+
+    def start_stream(self, callback):
+        self._client.start_stream(callback)
+
+    def stop_stream(self):
+        self._client.stop_stream()
+
+    def async_stream_infer(self, model_name, inputs, outputs=None, **kwargs):
+        self._client.async_stream_infer(model_name, inputs, outputs=outputs,
+                                        **kwargs)
+
+    def register_system_shared_memory(self, name, key, byte_size, offset=0):
+        self._client.register_system_shared_memory(name, key, byte_size,
+                                                   offset)
+
+    def register_tpu_shared_memory(self, name, raw_handle, device_id,
+                                   byte_size):
+        self._client.register_tpu_shared_memory(name, raw_handle, device_id,
+                                                byte_size)
+
+    def unregister_system_shared_memory(self, name=""):
+        self._client.unregister_system_shared_memory(name)
+
+    def unregister_tpu_shared_memory(self, name=""):
+        self._client.unregister_tpu_shared_memory(name)
+
+    def close(self):
+        self._client.close()
+
+
+class HttpClientBackend(ClientBackend):
+    kind = BackendKind.TRITON_HTTP
+
+    def __init__(self, url: str, verbose: bool = False, concurrency: int = 8):
+        import client_tpu.http as httpclient
+
+        self._client = httpclient.InferenceServerClient(
+            url, verbose=verbose, concurrency=concurrency
+        )
+
+    def server_metadata(self):
+        return self._client.get_server_metadata()
+
+    def model_metadata(self, model_name, model_version=""):
+        return self._client.get_model_metadata(model_name, model_version)
+
+    def model_config(self, model_name, model_version=""):
+        return self._client.get_model_config(model_name, model_version)
+
+    def model_statistics(self, model_name="", model_version=""):
+        return self._client.get_inference_statistics(model_name, model_version)
+
+    def infer(self, model_name, inputs, outputs=None, **kwargs):
+        kwargs.pop("client_timeout", None)
+        return self._client.infer(model_name, inputs, outputs=outputs,
+                                  **kwargs)
+
+    def async_infer(self, callback, model_name, inputs, outputs=None,
+                    **kwargs):
+        kwargs.pop("client_timeout", None)
+        handle = self._client.async_infer(model_name, inputs, outputs=outputs,
+                                          **kwargs)
+
+        # piggyback on the client's worker-pool future — no extra
+        # thread per request; the worker stores exceptions rather than
+        # raising, so future.result() is safe here
+        def _on_done(future):
+            result = future.result()
+            if isinstance(result, Exception):
+                error = (
+                    result if isinstance(result, InferenceServerException)
+                    else InferenceServerException(str(result))
+                )
+                callback(None, error)
+            else:
+                callback(result, None)
+
+        handle._future.add_done_callback(_on_done)
+        return handle
+
+    def register_system_shared_memory(self, name, key, byte_size, offset=0):
+        self._client.register_system_shared_memory(name, key, byte_size,
+                                                   offset)
+
+    def register_tpu_shared_memory(self, name, raw_handle, device_id,
+                                   byte_size):
+        self._client.register_tpu_shared_memory(name, raw_handle, device_id,
+                                                byte_size)
+
+    def unregister_system_shared_memory(self, name=""):
+        self._client.unregister_system_shared_memory(name)
+
+    def unregister_tpu_shared_memory(self, name=""):
+        self._client.unregister_tpu_shared_memory(name)
+
+    def close(self):
+        self._client.close()
+
+
+class InProcessBackend(ClientBackend):
+    """Runs against an InferenceServerCore in this process — no RPC,
+    no serialization of tensor contents beyond proto assembly. The
+    TPU analogue of the reference's triton_c_api backend (in-process
+    server via dlopen, triton_c_api/triton_loader.cc:526)."""
+
+    kind = BackendKind.IN_PROCESS
+
+    def __init__(self, core, max_workers: int = 8):
+        from concurrent.futures import ThreadPoolExecutor
+
+        from google.protobuf import json_format
+
+        self._core = core
+        self._json = json_format
+        self._executor = ThreadPoolExecutor(max_workers=max_workers)
+        self._stream_callback = None
+
+    def server_metadata(self):
+        return self._json.MessageToDict(self._core.server_metadata(),
+                                        preserving_proto_field_name=True)
+
+    def model_metadata(self, model_name, model_version=""):
+        return self._json.MessageToDict(
+            self._core.model_metadata(model_name, model_version),
+            preserving_proto_field_name=True,
+        )
+
+    def model_config(self, model_name, model_version=""):
+        return self._json.MessageToDict(
+            self._core.model_config(model_name, model_version).config,
+            preserving_proto_field_name=True,
+        )
+
+    def model_statistics(self, model_name="", model_version=""):
+        return self._json.MessageToDict(
+            self._core.model_statistics(model_name, model_version),
+            preserving_proto_field_name=True,
+        )
+
+    def _build_request(self, model_name, inputs, outputs, **kwargs):
+        from client_tpu.grpc._utils import get_inference_request
+
+        kwargs.pop("client_timeout", None)
+        return get_inference_request(
+            model_name=model_name, inputs=inputs, outputs=outputs, **kwargs
+        )
+
+    def infer(self, model_name, inputs, outputs=None, **kwargs):
+        from client_tpu.grpc._utils import InferResult
+
+        request = self._build_request(model_name, inputs, outputs, **kwargs)
+        return InferResult(self._core.infer(request))
+
+    def async_infer(self, callback, model_name, inputs, outputs=None,
+                    **kwargs):
+        from client_tpu.grpc._utils import InferResult
+
+        request = self._build_request(model_name, inputs, outputs, **kwargs)
+
+        def _work():
+            try:
+                callback(InferResult(self._core.infer(request)), None)
+            except InferenceServerException as e:
+                callback(None, e)
+            except Exception as e:  # any failure must release the slot
+                callback(None, InferenceServerException(str(e)))
+
+        return self._executor.submit(_work)
+
+    def start_stream(self, callback):
+        self._stream_callback = callback
+
+    def stop_stream(self):
+        self._stream_callback = None
+
+    def async_stream_infer(self, model_name, inputs, outputs=None, **kwargs):
+        from client_tpu.grpc._utils import InferResult
+
+        if self._stream_callback is None:
+            raise InferenceServerException("stream is not running")
+        callback = self._stream_callback
+        request = self._build_request(model_name, inputs, outputs, **kwargs)
+
+        def _work():
+            try:
+                for stream_response in self._core.stream_infer(request):
+                    if stream_response.error_message:
+                        callback(None, InferenceServerException(
+                            stream_response.error_message))
+                    else:
+                        callback(InferResult(stream_response.infer_response),
+                                 None)
+            except InferenceServerException as e:
+                callback(None, e)
+
+        return self._executor.submit(_work)
+
+    def register_system_shared_memory(self, name, key, byte_size, offset=0):
+        self._core.register_system_shm(name, key, offset, byte_size)
+
+    def register_tpu_shared_memory(self, name, raw_handle, device_id,
+                                   byte_size):
+        self._core.register_tpu_shm(name, raw_handle, device_id, byte_size)
+
+    def unregister_system_shared_memory(self, name=""):
+        self._core.unregister_system_shm(name)
+
+    def unregister_tpu_shared_memory(self, name=""):
+        self._core.unregister_tpu_shm(name)
+
+    def close(self):
+        self._executor.shutdown(wait=False)
+
+
+class MockBackend(ClientBackend):
+    """Fakes a server with a programmable per-request delay and
+    optional failures — the fixture that lets every load manager and
+    profiler test run serverless (parity: mock_client_backend.h:471,
+    which spawns detached threads that sleep then fire the async
+    callback)."""
+
+    kind = BackendKind.MOCK
+
+    class Stats:
+        def __init__(self):
+            self.lock = threading.Lock()
+            self.infer_calls = 0
+            self.async_infer_calls = 0
+            self.stream_calls = 0
+            self.sequence_ids: List[int] = []
+            self.request_parameters: List[dict] = []
+
+    def __init__(
+        self,
+        delay_s: float = 0.0,
+        stats: Optional["MockBackend.Stats"] = None,
+        fail_every: int = 0,
+        model_metadata_dict: Optional[dict] = None,
+        model_config_dict: Optional[dict] = None,
+    ):
+        self._delay = delay_s
+        self.stats = stats if stats is not None else MockBackend.Stats()
+        self._fail_every = fail_every
+        self._count = 0
+        self._stream_callback = None
+        self._metadata = model_metadata_dict or {
+            "name": "mock", "versions": ["1"], "platform": "mock",
+            "inputs": [
+                {"name": "INPUT0", "datatype": "FP32", "shape": [16]},
+            ],
+            "outputs": [
+                {"name": "OUTPUT0", "datatype": "FP32", "shape": [16]},
+            ],
+        }
+        self._config = model_config_dict or {
+            "name": "mock", "max_batch_size": 0,
+        }
+
+    def _maybe_fail(self):
+        self._count += 1
+        if self._fail_every and self._count % self._fail_every == 0:
+            raise InferenceServerException("mock failure", status="INTERNAL")
+
+    def _record(self, kind: str, kwargs):
+        with self.stats.lock:
+            if kind == "infer":
+                self.stats.infer_calls += 1
+            elif kind == "async":
+                self.stats.async_infer_calls += 1
+            else:
+                self.stats.stream_calls += 1
+            if kwargs.get("sequence_id"):
+                self.stats.sequence_ids.append(kwargs["sequence_id"])
+            self.stats.request_parameters.append(dict(kwargs))
+
+    def server_metadata(self):
+        return {"name": "mock_server", "version": "0", "extensions": []}
+
+    def model_metadata(self, model_name, model_version=""):
+        return dict(self._metadata, name=model_name)
+
+    def model_config(self, model_name, model_version=""):
+        return dict(self._config, name=model_name)
+
+    def model_statistics(self, model_name="", model_version=""):
+        return {"model_stats": [{
+            "name": model_name or "mock", "version": "1",
+            "inference_count": self.stats.infer_calls
+            + self.stats.async_infer_calls,
+            "execution_count": self.stats.infer_calls
+            + self.stats.async_infer_calls,
+            "inference_stats": {
+                "success": {"count": self._count, "ns": 0},
+                "fail": {"count": 0, "ns": 0},
+                "queue": {"count": self._count, "ns": 1000},
+                "compute_input": {"count": self._count, "ns": 1000},
+                "compute_infer": {"count": self._count, "ns": 1000},
+                "compute_output": {"count": self._count, "ns": 1000},
+            },
+        }]}
+
+    def _result(self):
+        class _R:
+            def as_numpy(self, name):
+                return np.zeros(16, dtype=np.float32)
+
+            def get_response(self):
+                return {}
+
+            def get_parameters(self):
+                return {"triton_final_response": True}
+
+        return _R()
+
+    def infer(self, model_name, inputs, outputs=None, **kwargs):
+        self._record("infer", kwargs)
+        self._maybe_fail()
+        if self._delay:
+            import time
+
+            time.sleep(self._delay)
+        return self._result()
+
+    def async_infer(self, callback, model_name, inputs, outputs=None,
+                    **kwargs):
+        self._record("async", kwargs)
+
+        def _work():
+            import time
+
+            try:
+                self._maybe_fail()
+            except InferenceServerException as e:
+                callback(None, e)
+                return
+            if self._delay:
+                time.sleep(self._delay)
+            callback(self._result(), None)
+
+        thread = threading.Thread(target=_work, daemon=True)
+        thread.start()
+        return thread
+
+    def start_stream(self, callback):
+        self._stream_callback = callback
+
+    def stop_stream(self):
+        self._stream_callback = None
+
+    def async_stream_infer(self, model_name, inputs, outputs=None, **kwargs):
+        self._record("stream", kwargs)
+        callback = self._stream_callback
+
+        def _work():
+            import time
+
+            if self._delay:
+                time.sleep(self._delay)
+            callback(self._result(), None)
+
+        thread = threading.Thread(target=_work, daemon=True)
+        thread.start()
+        return thread
+
+    def register_system_shared_memory(self, name, key, byte_size, offset=0):
+        pass
+
+    def register_tpu_shared_memory(self, name, raw_handle, device_id,
+                                   byte_size):
+        pass
+
+    def unregister_system_shared_memory(self, name=""):
+        pass
+
+    def unregister_tpu_shared_memory(self, name=""):
+        pass
+
+
+class ClientBackendFactory:
+    """Creates per-worker backends (parity: client_backend.h:268)."""
+
+    def __init__(self, kind: BackendKind, url: str = "", core=None,
+                 verbose: bool = False, http_concurrency: int = 8,
+                 mock_delay_s: float = 0.0, mock_stats=None):
+        self.kind = kind
+        self._url = url
+        self._core = core
+        self._verbose = verbose
+        self._http_concurrency = http_concurrency
+        self._mock_delay = mock_delay_s
+        self._mock_stats = mock_stats
+
+    def create(self) -> ClientBackend:
+        if self.kind == BackendKind.TRITON_GRPC:
+            return GrpcClientBackend(self._url, self._verbose)
+        if self.kind == BackendKind.TRITON_HTTP:
+            return HttpClientBackend(self._url, self._verbose,
+                                     self._http_concurrency)
+        if self.kind == BackendKind.IN_PROCESS:
+            if self._core is None:
+                raise InferenceServerException(
+                    "in-process backend requires a server core"
+                )
+            return InProcessBackend(self._core)
+        if self.kind == BackendKind.MOCK:
+            return MockBackend(self._mock_delay, self._mock_stats)
+        raise InferenceServerException("unknown backend kind %s" % self.kind)

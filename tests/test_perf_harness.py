@@ -1,0 +1,412 @@
+"""perf harness unit tests over the mock backend (tier-1 strategy of
+SURVEY.md §4 — no server required) plus a short in-process CLI e2e."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from client_tpu.perf.client_backend import (
+    BackendKind,
+    ClientBackendFactory,
+    MockBackend,
+)
+from client_tpu.perf.data_loader import DataLoader
+from client_tpu.perf.load_manager import (
+    ConcurrencyManager,
+    FifoCtxIdTracker,
+    InferDataManager,
+    RandCtxIdTracker,
+    RequestRateManager,
+    SequenceManager,
+)
+from client_tpu.perf.model_parser import ModelParser, SchedulerType
+from client_tpu.perf.profiler import InferenceProfiler, MeasurementConfig
+from client_tpu.utils import InferenceServerException
+
+
+def make_mock_setup(delay_s=0.001, stats=None):
+    factory = ClientBackendFactory(BackendKind.MOCK, mock_delay_s=delay_s,
+                                   mock_stats=stats)
+    backend = factory.create()
+    model = ModelParser().parse(backend, "mock")
+    loader = DataLoader(model)
+    loader.generate_data()
+    data_manager = InferDataManager(model, loader)
+    return factory, model, loader, data_manager
+
+
+# -- ctx id trackers -------------------------------------------------------
+
+
+def test_fifo_ctx_tracker():
+    tracker = FifoCtxIdTracker()
+    tracker.reset(3)
+    assert [tracker.get() for _ in range(3)] == [0, 1, 2]
+    assert not tracker.available()
+    assert tracker.get(timeout=0.01) is None
+    tracker.release(1)
+    assert tracker.get() == 1
+
+
+def test_rand_ctx_tracker():
+    tracker = RandCtxIdTracker()
+    tracker.reset(5)
+    got = {tracker.get() for _ in range(5)}
+    assert got == {0, 1, 2, 3, 4}
+
+
+# -- model parser ----------------------------------------------------------
+
+
+def test_model_parser_basic():
+    backend = MockBackend()
+    model = ModelParser().parse(backend, "mock")
+    assert model.name == "mock"
+    assert "INPUT0" in model.inputs
+    assert model.scheduler_type == SchedulerType.NONE
+    assert not model.decoupled
+
+
+def test_model_parser_batch_rejection():
+    backend = MockBackend()
+    with pytest.raises(InferenceServerException, match="does not support"):
+        ModelParser().parse(backend, "mock", batch_size=4)
+
+
+def test_model_parser_scheduler_kinds():
+    backend = MockBackend(
+        model_config_dict={"name": "m", "max_batch_size": 8,
+                           "dynamic_batching": {}}
+    )
+    model = ModelParser().parse(backend, "m")
+    assert model.scheduler_type == SchedulerType.DYNAMIC
+    backend = MockBackend(
+        model_config_dict={
+            "name": "m",
+            "ensemble_scheduling": {"step": [{"model_name": "a"},
+                                             {"model_name": "b"}]},
+        }
+    )
+    model = ModelParser().parse(backend, "m")
+    assert model.scheduler_type == SchedulerType.ENSEMBLE
+    assert model.composing_models == ["a", "b"]
+    backend = MockBackend(
+        model_config_dict={
+            "name": "m",
+            "model_transaction_policy": {"decoupled": True},
+        }
+    )
+    assert ModelParser().parse(backend, "m").decoupled
+
+
+# -- data loader -----------------------------------------------------------
+
+
+def test_data_loader_random_and_zero():
+    backend = MockBackend()
+    model = ModelParser().parse(backend, "mock")
+    loader = DataLoader(model)
+    loader.generate_data()
+    data = loader.get_input_data("INPUT0")
+    assert data.shape == [16]
+    assert data.array.dtype == np.float32
+    loader.generate_data(zero_input=True)
+    assert not loader.get_input_data("INPUT0").array.any()
+
+
+def test_data_loader_json():
+    backend = MockBackend()
+    model = ModelParser().parse(backend, "mock")
+    loader = DataLoader(model)
+    loader.read_data_from_json({
+        "data": [
+            {"INPUT0": [float(i) for i in range(16)]},
+            {"INPUT0": {"content": [1.0] * 16, "shape": [16]}},
+        ]
+    })
+    assert loader.step_count(0) == 2
+    np.testing.assert_array_equal(
+        loader.get_input_data("INPUT0", 0, 0).array,
+        np.arange(16, dtype=np.float32),
+    )
+
+
+def test_data_loader_json_b64_and_streams():
+    import base64
+
+    backend = MockBackend()
+    model = ModelParser().parse(backend, "mock")
+    loader = DataLoader(model)
+    raw = np.arange(16, dtype=np.float32)
+    loader.read_data_from_json({
+        "data": [
+            [{"INPUT0": {"b64": base64.b64encode(raw.tobytes()).decode(),
+                          "shape": [16]}}],
+            [{"INPUT0": [0.5] * 16}],
+        ]
+    })
+    assert loader.stream_count == 2
+    np.testing.assert_array_equal(loader.get_input_data("INPUT0", 0, 0).array,
+                                  raw)
+
+
+def test_data_loader_validation_errors():
+    backend = MockBackend()
+    model = ModelParser().parse(backend, "mock")
+    loader = DataLoader(model)
+    with pytest.raises(InferenceServerException, match="missing data"):
+        loader.read_data_from_json({"data": [{}]})
+    with pytest.raises(InferenceServerException, match="incompatible"):
+        loader.read_data_from_json(
+            {"data": [{"INPUT0": {"content": [1.0] * 4, "shape": [4]}}]}
+        )
+    with pytest.raises(InferenceServerException, match="not a model input"):
+        loader.read_data_from_json({"data": [{"NOPE": [1.0]}]})
+
+
+# -- sequence manager ------------------------------------------------------
+
+
+def test_sequence_manager_lifecycle():
+    manager = SequenceManager(start_id=100, sequence_length=3,
+                              sequence_length_variation=0.0)
+    state = manager.new_sequence()
+    k1 = manager.advance(state)
+    assert k1 == {"sequence_id": 100, "sequence_start": True,
+                  "sequence_end": False}
+    k2 = manager.advance(state)
+    assert not k2["sequence_start"] and not k2["sequence_end"]
+    k3 = manager.advance(state)
+    assert k3["sequence_end"]
+
+
+def test_sequence_manager_id_range():
+    manager = SequenceManager(start_id=10, id_range=2, sequence_length=1)
+    ids = {manager.new_sequence()["id"] for _ in range(5)}
+    assert ids == {10, 11}
+
+
+# -- concurrency manager ---------------------------------------------------
+
+
+def _concurrency_manager(factory, model, loader, data_manager, **kw):
+    manager = ConcurrencyManager(
+        factory=factory, model=model, data_loader=loader,
+        data_manager=data_manager, **kw,
+    )
+    manager.init()
+    return manager
+
+
+def test_concurrency_manager_collects_records():
+    stats = MockBackend.Stats()
+    factory, model, loader, dm = make_mock_setup(0.002, stats)
+    manager = _concurrency_manager(factory, model, loader, dm)
+    manager.change_concurrency_level(4)
+    time.sleep(0.3)
+    records = manager.swap_request_records()
+    manager.cleanup()
+    assert len(records) > 20
+    assert all(r.valid for r in records)
+    assert stats.async_infer_calls > 20
+
+
+def test_concurrency_manager_sync_mode():
+    factory, model, loader, dm = make_mock_setup(0.001)
+    manager = _concurrency_manager(factory, model, loader, dm,
+                                   async_mode=False)
+    manager.change_concurrency_level(2)
+    time.sleep(0.2)
+    records = manager.swap_request_records()
+    manager.cleanup()
+    assert len(records) > 10
+
+
+def test_concurrency_manager_streaming():
+    factory, model, loader, dm = make_mock_setup(0.001)
+    manager = _concurrency_manager(factory, model, loader, dm, streaming=True)
+    manager.change_concurrency_level(2)
+    time.sleep(0.3)
+    records = manager.swap_request_records()
+    manager.cleanup()
+    assert len(records) > 10
+    assert all(r.valid for r in records)
+
+
+def test_concurrency_level_change():
+    factory, model, loader, dm = make_mock_setup(0.001)
+    manager = _concurrency_manager(factory, model, loader, dm)
+    manager.change_concurrency_level(1)
+    time.sleep(0.15)
+    low = len(manager.swap_request_records())
+    manager.change_concurrency_level(8)
+    time.sleep(0.15)
+    high = len(manager.swap_request_records())
+    manager.cleanup()
+    assert high > low
+
+
+def test_sequences_through_manager():
+    stats = MockBackend.Stats()
+    factory, model, loader, dm = make_mock_setup(0.001, stats)
+    seq = SequenceManager(sequence_length=3, sequence_length_variation=0.0)
+    manager = _concurrency_manager(factory, model, loader, dm,
+                                   sequence_manager=seq)
+    manager.change_concurrency_level(2)
+    time.sleep(0.2)
+    manager.cleanup()
+    assert stats.sequence_ids, "sequence ids should be recorded"
+    starts = [p for p in stats.request_parameters if p.get("sequence_start")]
+    ends = [p for p in stats.request_parameters if p.get("sequence_end")]
+    assert starts and ends
+
+
+# -- request rate manager --------------------------------------------------
+
+
+def test_request_rate_manager_rate():
+    factory, model, loader, dm = make_mock_setup(0.0)
+    manager = RequestRateManager(
+        factory=factory, model=model, data_loader=loader, data_manager=dm,
+    )
+    manager.init()
+    manager.change_request_rate(100.0)
+    time.sleep(1.0)
+    records = manager.swap_request_records()
+    manager.cleanup()
+    # ~100/s over 1s window, generous tolerance for CI noise
+    assert 50 < len(records) < 160
+
+
+def test_request_rate_poisson():
+    factory, model, loader, dm = make_mock_setup(0.0)
+    manager = RequestRateManager(
+        factory=factory, model=model, data_loader=loader, data_manager=dm,
+        distribution="poisson",
+    )
+    manager.init()
+    manager.change_request_rate(200.0)
+    time.sleep(0.5)
+    records = manager.swap_request_records()
+    manager.cleanup()
+    assert len(records) > 40
+
+
+def test_custom_intervals():
+    factory, model, loader, dm = make_mock_setup(0.0)
+    manager = RequestRateManager(
+        factory=factory, model=model, data_loader=loader, data_manager=dm,
+    )
+    manager.init()
+    manager.set_custom_schedule([0.01, 0.02])  # avg 15ms -> ~66/s
+    time.sleep(0.6)
+    records = manager.swap_request_records()
+    manager.cleanup()
+    assert 20 < len(records) < 80
+
+
+# -- profiler --------------------------------------------------------------
+
+
+def test_profiler_stability_and_merge():
+    factory, model, loader, dm = make_mock_setup(0.002)
+    manager = _concurrency_manager(factory, model, loader, dm)
+    config = MeasurementConfig(
+        measurement_interval_ms=150, max_trials=8, stability_threshold=0.5,
+    )
+    profiler = InferenceProfiler(manager, config)
+    results = profiler.profile_concurrency_range(1, 2)
+    manager.cleanup()
+    assert len(results) == 2
+    assert results[0].concurrency == 1
+    assert results[1].concurrency == 2
+    for status in results:
+        assert status.completed_count > 0
+        assert status.throughput > 0
+        assert status.latency_percentiles[50] > 0
+        assert 50 in status.latency_percentiles
+        assert status.avg_latency_us >= 1000  # 2ms mock delay
+
+
+def test_profiler_latency_threshold_stops_sweep():
+    factory, model, loader, dm = make_mock_setup(0.01)
+    manager = _concurrency_manager(factory, model, loader, dm)
+    config = MeasurementConfig(
+        measurement_interval_ms=100, max_trials=4, stability_threshold=0.9,
+        latency_threshold_ms=0.001,  # everything exceeds
+    )
+    profiler = InferenceProfiler(manager, config)
+    results = profiler.profile_concurrency_range(1, 8)
+    manager.cleanup()
+    assert len(results) == 1  # stopped after first level
+
+
+def test_profiler_count_windows():
+    factory, model, loader, dm = make_mock_setup(0.001)
+    manager = _concurrency_manager(factory, model, loader, dm)
+    config = MeasurementConfig(
+        measurement_mode="count_windows", measurement_request_count=20,
+        measurement_interval_ms=100, max_trials=4, stability_threshold=0.9,
+    )
+    profiler = InferenceProfiler(manager, config)
+    results = profiler.profile_concurrency_range(2, 2)
+    manager.cleanup()
+    assert results[0].completed_count >= 20
+
+
+# -- CLI end-to-end (in-process) ------------------------------------------
+
+
+def test_cli_inprocess_e2e(tmp_path):
+    from client_tpu.perf.cli import run
+    from client_tpu.server.app import build_core
+
+    core = build_core(["simple"])
+    csv_path = tmp_path / "report.csv"
+    export_path = tmp_path / "profile.json"
+    rc = run([
+        "-m", "simple", "--service-kind", "inprocess",
+        "--concurrency-range", "1:2",
+        "--measurement-interval", "150", "--max-trials", "4",
+        "--stability-percentage", "80",
+        "-f", str(csv_path), "--profile-export-file", str(export_path),
+    ], core=core)
+    assert rc == 0
+    assert csv_path.exists()
+    doc = json.loads(export_path.read_text())
+    assert doc["model"] == "simple"
+    assert len(doc["experiments"]) == 2
+    assert doc["experiments"][0]["requests"], "requests should be recorded"
+
+
+def test_cli_inprocess_shm_system(tmp_path):
+    from client_tpu.perf.cli import run
+    from client_tpu.server.app import build_core
+
+    core = build_core(["simple"])
+    rc = run([
+        "-m", "simple", "--service-kind", "inprocess",
+        "--concurrency-range", "1",
+        "--shared-memory", "system",
+        "--measurement-interval", "150", "--max-trials", "3",
+        "--stability-percentage", "90",
+    ], core=core)
+    assert rc == 0
+
+
+def test_cli_inprocess_shm_tpu(tmp_path):
+    from client_tpu.perf.cli import run
+    from client_tpu.server.app import build_core
+
+    core = build_core(["simple"])
+    rc = run([
+        "-m", "simple", "--service-kind", "inprocess",
+        "--concurrency-range", "1",
+        "--shared-memory", "tpu",
+        "--measurement-interval", "150", "--max-trials", "3",
+        "--stability-percentage", "90",
+    ], core=core)
+    assert rc == 0
