@@ -1,59 +1,81 @@
 #!/usr/bin/env python
-"""Round benchmark: end-to-end gRPC infer/sec against the in-repo
-server on the `simple` add/sub model, concurrency 1 — the same
-methodology as the reference's quick-start measurement
-(perf_analyzer docs: 1407.84 infer/sec on an unspecified GPU box,
-BASELINE.md). Prints exactly one JSON line.
+"""Round benchmark — the north-star config (BASELINE.json): ResNet-50
+served over gRPC with TPU shared-memory I/O (batch 8, async,
+concurrency sweep via the perf harness), client+server co-located.
+
+Prints exactly ONE JSON line. ``vs_baseline`` compares against the
+only ResNet-50 throughput the reference publishes (165.8 infer/sec,
+TF-Serving GRPC batch 1, docs/benchmarking.md:121 — illustrative, not
+hardware-matched; the reference publishes no CUDA-shm number).
 """
 
 import json
 import sys
-import time
 
 
 def main():
     sys.path.insert(0, ".")
-    import numpy as np
+    from client_tpu.perf.client_backend import (
+        BackendKind,
+        ClientBackendFactory,
+    )
+    from client_tpu.perf.data_loader import DataLoader
+    from client_tpu.perf.load_manager import (
+        ConcurrencyManager,
+        InferDataManager,
+    )
+    from client_tpu.perf.model_parser import ModelParser
+    from client_tpu.perf.profiler import InferenceProfiler, MeasurementConfig
+    from client_tpu.server.app import build_core, start_grpc_server
 
-    import client_tpu.grpc as grpcclient
-    from client_tpu.server.app import start_grpc_server
+    baseline = 165.8  # reference resnet50 TF-Serving GRPC (batch 1)
+    batch = 8
 
-    baseline = 1407.84  # reference quick_start.md HTTP sync concurrency=1
-
-    handle = start_grpc_server(load_models=["simple"])
+    core = build_core(["resnet50"])
+    handle = start_grpc_server(core=core)
     try:
-        with grpcclient.InferenceServerClient(handle.address) as client:
-            in0 = np.arange(16, dtype=np.int32)
-            in1 = np.ones(16, dtype=np.int32)
-            inputs = [
-                grpcclient.InferInput("INPUT0", [16], "INT32"),
-                grpcclient.InferInput("INPUT1", [16], "INT32"),
-            ]
-            inputs[0].set_data_from_numpy(in0)
-            inputs[1].set_data_from_numpy(in1)
+        factory = ClientBackendFactory(BackendKind.TRITON_GRPC,
+                                       url=handle.address)
+        setup_backend = factory.create()
+        model = ModelParser().parse(setup_backend, "resnet50",
+                                    batch_size=batch)
+        loader = DataLoader(model)
+        loader.generate_data()
+        data_manager = InferDataManager(
+            model, loader, shared_memory="tpu",
+            output_shm_size=batch * 1000 * 4 + 1024,
+            tpu_arena_url=handle.address, batch_size=batch,
+        )
+        manager = ConcurrencyManager(
+            factory=factory, model=model, data_loader=loader,
+            data_manager=data_manager, async_mode=True, max_threads=8,
+        )
+        manager.init()
+        config = MeasurementConfig(
+            measurement_interval_ms=4000, max_trials=6,
+            stability_threshold=0.15,
+        )
+        profiler = InferenceProfiler(manager, config, setup_backend,
+                                     "resnet50")
+        # warm the compiled path before measuring
+        manager.change_concurrency_level(1)
+        import time
 
-            # warmup
-            for _ in range(50):
-                client.infer("simple", inputs)
-
-            # measure: 3 windows of 2s, report the best (stability-lite)
-            best = 0.0
-            for _ in range(3):
-                count = 0
-                start = time.perf_counter()
-                while time.perf_counter() - start < 2.0:
-                    client.infer("simple", inputs)
-                    count += 1
-                elapsed = time.perf_counter() - start
-                best = max(best, count / elapsed)
+        time.sleep(8)
+        results = profiler.profile_concurrency_range(4, 4)
+        manager.cleanup()
+        setup_backend.close()
     finally:
         handle.stop()
 
+    status = results[-1]
     print(json.dumps({
-        "metric": "grpc_sync_infer_per_sec_simple_c1",
-        "value": round(best, 2),
+        "metric": "resnet50_tpu_shm_grpc_batch8_c4_infer_per_sec",
+        "value": round(status.throughput, 2),
         "unit": "infer/sec",
-        "vs_baseline": round(best / baseline, 4),
+        "vs_baseline": round(status.throughput / baseline, 4),
+        "p50_latency_us": round(status.latency_percentiles.get(50, 0), 1),
+        "batch": batch,
     }))
 
 

@@ -9,8 +9,10 @@ from typing import Callable, Dict
 from client_tpu.server.model import ServedModel
 
 
-def builtin_model_factories() -> Dict[str, Callable[[], ServedModel]]:
+def builtin_model_factories(repository=None
+                            ) -> Dict[str, Callable[[], ServedModel]]:
     from client_tpu.models.add_sub import AddSub
+    from client_tpu.models.zoo import extra_model_factories
 
     factories: Dict[str, Callable[[], ServedModel]] = {
         "add_sub": AddSub,
@@ -18,11 +20,9 @@ def builtin_model_factories() -> Dict[str, Callable[[], ServedModel]]:
         "add_sub_fp32": lambda: AddSub(
             name="add_sub_fp32", datatype="FP32", shape=(16,)
         ),
+        "add_sub_tpu": lambda: AddSub(
+            name="add_sub_tpu", datatype="FP32", shape=(16,), device="tpu"
+        ),
     }
-    try:
-        from client_tpu.models.zoo import extra_model_factories
-
-        factories.update(extra_model_factories())
-    except ImportError:
-        pass
+    factories.update(extra_model_factories(repository))
     return factories

@@ -1,0 +1,383 @@
+"""Llama-style decoder LM: the flagship served model and the
+long-context / multi-chip showcase (BASELINE config #5: generate
+endpoint with decoupled token streaming).
+
+TPU-first structure:
+- bf16 params, matmul-heavy blocks sized for the MXU;
+- prefill and decode-step are separate jitted functions; decode keeps
+  the KV cache device-resident and updates it via dynamic_update_slice
+  (donated, so XLA updates in place);
+- sharding comes from client_tpu.parallel rules — heads/ffn/vocab on
+  ``tp``, batch on ``dp``, optional ``sp`` for long-context sequence
+  parallelism; the same code runs single-chip with a 1x1 mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from functools import partial
+from typing import Dict, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from client_tpu.parallel import LLM_RULES, ShardingRules, create_mesh
+from client_tpu.server.model import ServedModel, TensorSpec
+from client_tpu.utils import InferenceServerException
+
+
+@dataclasses.dataclass
+class LlmConfig:
+    vocab: int = 259          # 256 bytes + BOS/EOS/PAD
+    d_model: int = 256
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 4
+    d_ff: int = 704
+    max_seq: int = 1024
+    rope_theta: float = 10000.0
+    dtype: str = "bfloat16"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+LLAMA3_8B = LlmConfig(
+    vocab=128256, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+    d_ff=14336, max_seq=8192, rope_theta=500000.0,
+)
+
+BOS, EOS, PAD = 256, 257, 258
+
+
+class ByteTokenizer:
+    """Zero-dependency byte-level tokenizer (ids 0-255 = raw bytes)."""
+
+    def encode(self, text: str, bos: bool = True) -> np.ndarray:
+        ids = list(text.encode("utf-8"))
+        if bos:
+            ids = [BOS] + ids
+        return np.array(ids, dtype=np.int32)
+
+    def decode(self, ids) -> str:
+        data = bytes(int(i) for i in ids if int(i) < 256)
+        return data.decode("utf-8", errors="replace")
+
+
+# -- parameters ------------------------------------------------------------
+
+
+def init_params(key, cfg: LlmConfig) -> Dict:
+    dtype = jnp.dtype(cfg.dtype)
+    ks = jax.random.split(key, 4 + cfg.n_layers)
+    scale = 0.02
+
+    def norm(k, shape):
+        return (jax.random.normal(k, shape, dtype=jnp.float32)
+                * scale).astype(dtype)
+
+    params = {
+        "embed": norm(ks[0], (cfg.vocab, cfg.d_model)),
+        "unembed": norm(ks[1], (cfg.d_model, cfg.vocab)),
+        "final_norm": jnp.ones((cfg.d_model,), dtype=dtype),
+        "layers": [],
+    }
+    for i in range(cfg.n_layers):
+        lk = jax.random.split(ks[4 + i], 7)
+        params["layers"].append({
+            "attn_norm": jnp.ones((cfg.d_model,), dtype=dtype),
+            "wq": norm(lk[0], (cfg.d_model, cfg.n_heads, cfg.head_dim)),
+            "wk": norm(lk[1], (cfg.d_model, cfg.n_kv_heads, cfg.head_dim)),
+            "wv": norm(lk[2], (cfg.d_model, cfg.n_kv_heads, cfg.head_dim)),
+            "wo": norm(lk[3], (cfg.n_heads, cfg.head_dim, cfg.d_model)),
+            "mlp_norm": jnp.ones((cfg.d_model,), dtype=dtype),
+            "w_gate": norm(lk[4], (cfg.d_model, cfg.d_ff)),
+            "w_up": norm(lk[5], (cfg.d_model, cfg.d_ff)),
+            "w_down": norm(lk[6], (cfg.d_ff, cfg.d_model)),
+        })
+    return params
+
+
+def param_specs(cfg: LlmConfig, rules: ShardingRules = LLM_RULES) -> Dict:
+    """PartitionSpec tree matching init_params (Megatron layout)."""
+    layer = {
+        "attn_norm": rules.spec("model"),
+        "wq": rules.spec("model", "heads", "head_dim"),
+        "wk": rules.spec("model", "kv_heads", "head_dim"),
+        "wv": rules.spec("model", "kv_heads", "head_dim"),
+        "wo": rules.spec("heads", "head_dim", "model"),
+        "mlp_norm": rules.spec("model"),
+        "w_gate": rules.spec("model", "ffn"),
+        "w_up": rules.spec("model", "ffn"),
+        "w_down": rules.spec("ffn", "model"),
+    }
+    return {
+        "embed": rules.spec("vocab", "model"),
+        "unembed": rules.spec("model", "vocab"),
+        "final_norm": rules.spec("model"),
+        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+    }
+
+
+# -- forward ---------------------------------------------------------------
+
+
+def _rms_norm(x, weight, eps: float = 1e-5):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps)).astype(x.dtype) * weight
+
+
+def _rope(x, positions, theta: float):
+    """x: [B, S, H, D]; rotary embedding over the last dim."""
+    d = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B,S,d/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., ::2], x[..., 1::2]
+    rotated = jnp.stack(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    )
+    return rotated.reshape(x.shape).astype(x.dtype)
+
+
+def _attention(q, k, v, mask):
+    """q: [B,S,H,D]; k/v: [B,T,Hkv,D] (GQA: H a multiple of Hkv)."""
+    b, s, h, d = q.shape
+    hkv = k.shape[2]
+    group = h // hkv
+    q = q.reshape(b, s, hkv, group, d)
+    logits = jnp.einsum("bshgd,bthd->bhgst", q, k).astype(jnp.float32)
+    logits = logits / np.sqrt(d)
+    logits = jnp.where(mask[:, None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    ctx = jnp.einsum("bhgst,bthd->bshgd", probs, v)
+    return ctx.reshape(b, s, h, d)
+
+
+def _block(layer, x, positions, mask, cfg: LlmConfig, cache=None,
+           cache_pos=None):
+    h = _rms_norm(x, layer["attn_norm"])
+    q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"])
+    k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"])
+    v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"])
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    new_cache = None
+    if cache is not None:
+        ck, cv = cache  # [B, T, Hkv, D]
+        ck = jax.lax.dynamic_update_slice(ck, k, (0, cache_pos, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v, (0, cache_pos, 0, 0))
+        k, v = ck, cv
+        new_cache = (ck, cv)
+    ctx = _attention(q, k, v, mask)
+    x = x + jnp.einsum("bshk,hkd->bsd", ctx, layer["wo"])
+    h = _rms_norm(x, layer["mlp_norm"])
+    gated = jax.nn.silu(h @ layer["w_gate"]) * (h @ layer["w_up"])
+    return x + gated @ layer["w_down"], new_cache
+
+
+def forward(params, tokens, cfg: LlmConfig):
+    """Full-sequence scoring forward: tokens [B,S] -> logits [B,S,V]."""
+    b, s = tokens.shape
+    x = params["embed"][tokens]
+    positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+    causal = jnp.tril(jnp.ones((s, s), dtype=bool))[None]
+    for layer in params["layers"]:
+        x, _ = _block(layer, x, positions, causal, cfg)
+    x = _rms_norm(x, params["final_norm"])
+    return (x @ params["unembed"]).astype(jnp.float32)
+
+
+def init_cache(cfg: LlmConfig, batch: int, dtype=None):
+    dtype = dtype or jnp.dtype(cfg.dtype)
+    return [
+        (
+            jnp.zeros((batch, cfg.max_seq, cfg.n_kv_heads, cfg.head_dim),
+                      dtype=dtype),
+            jnp.zeros((batch, cfg.max_seq, cfg.n_kv_heads, cfg.head_dim),
+                      dtype=dtype),
+        )
+        for _ in range(cfg.n_layers)
+    ]
+
+
+def prefill(params, tokens, cache, cfg: LlmConfig):
+    """Process the prompt, fill the cache; returns (last logits,
+    cache). tokens [B,S]."""
+    b, s = tokens.shape
+    x = params["embed"][tokens]
+    positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+    # rows attend to cache slots <= their position
+    mask = jnp.tril(
+        jnp.ones((s, cfg.max_seq), dtype=bool), k=0
+    )[None]
+    new_cache = []
+    for layer, layer_cache in zip(params["layers"], cache):
+        x, updated = _block(layer, x, positions, mask, cfg,
+                            cache=layer_cache, cache_pos=0)
+        new_cache.append(updated)
+    x = _rms_norm(x, params["final_norm"])
+    logits = (x[:, -1] @ params["unembed"]).astype(jnp.float32)
+    return logits, new_cache
+
+
+def decode_step(params, token, pos, cache, cfg: LlmConfig):
+    """One token step: token [B,1], pos scalar; returns (logits [B,V],
+    cache)."""
+    b = token.shape[0]
+    x = params["embed"][token]
+    positions = jnp.full((b, 1), pos, dtype=jnp.int32)
+    mask = (jnp.arange(cfg.max_seq) <= pos)[None, None]  # [1,1,T]
+    new_cache = []
+    for layer, layer_cache in zip(params["layers"], cache):
+        x, updated = _block(layer, x, positions, mask[0], cfg,
+                            cache=layer_cache, cache_pos=pos)
+        new_cache.append(updated)
+    x = _rms_norm(x, params["final_norm"])
+    logits = (x[:, -1] @ params["unembed"]).astype(jnp.float32)
+    return logits, new_cache
+
+
+def loss_fn(params, tokens, targets, cfg: LlmConfig):
+    logits = forward(params, tokens, cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    mask = (targets != PAD).astype(jnp.float32)
+    return jnp.sum(nll[..., 0] * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def train_step(params, tokens, targets, cfg: LlmConfig, lr: float = 1e-3):
+    """SGD training step (forward + backward + update) — the function
+    the multi-chip dryrun jits over the mesh."""
+    loss, grads = jax.value_and_grad(partial(loss_fn, cfg=cfg))(
+        params, tokens, targets
+    )
+    new_params = jax.tree.map(
+        lambda w, g: (w - lr * g.astype(w.dtype)).astype(w.dtype),
+        params, grads,
+    )
+    return new_params, loss
+
+
+# -- served model ----------------------------------------------------------
+
+
+class LlmModel(ServedModel):
+    """Decoupled generate endpoint: text in, token stream out.
+
+    Inputs: text_input BYTES [1]; max_tokens INT32 [1] (optional);
+    outputs: text_output BYTES [1] per streamed response. Greedy
+    decoding; prefill + per-token decode are independently jitted and
+    the KV cache never leaves the device.
+    """
+
+    decoupled = True
+    platform = "jax"
+
+    def __init__(self, name: str = "llm", cfg: Optional[LlmConfig] = None,
+                 mesh=None, rules: ShardingRules = LLM_RULES,
+                 seed: int = 0, batch: int = 1):
+        super().__init__()
+        self.name = name
+        self.cfg = cfg or LlmConfig()
+        self._tokenizer = ByteTokenizer()
+        self._batch = batch
+        self._lock = threading.Lock()  # one generation at a time per model
+        self.inputs = [
+            TensorSpec("text_input", "BYTES", [1]),
+            TensorSpec("max_tokens", "INT32", [1], optional=True),
+            TensorSpec("ignore_eos", "BOOL", [1], optional=True),
+        ]
+        self.outputs = [TensorSpec("text_output", "BYTES", [1])]
+
+        key = jax.random.PRNGKey(seed)
+        params = init_params(key, self.cfg)
+        self._mesh = mesh
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+
+            specs = param_specs(self.cfg, rules)
+            params = jax.tree.map(
+                lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+                params, specs,
+                is_leaf=lambda x: isinstance(x, jnp.ndarray),
+            )
+        self._params = params
+        cfg_static = self.cfg
+        self._prefill = jax.jit(
+            lambda p, t, c: prefill(p, t, c, cfg_static)
+        )
+        self._decode = jax.jit(
+            lambda p, tok, pos, c: decode_step(p, tok, pos, c, cfg_static),
+            donate_argnums=(3,),
+        )
+        self._cache = None
+
+    def _get_cache(self):
+        if self._cache is None:
+            self._cache = init_cache(self.cfg, self._batch)
+        cache = self._cache
+        self._cache = None  # donated to the decode loop
+        return cache
+
+    def _return_cache(self, cache):
+        self._cache = cache
+
+    def _generate(self, inputs, parameters):
+        text = inputs["text_input"].reshape(-1)[0]
+        if isinstance(text, bytes):
+            text = text.decode("utf-8", errors="replace")
+        else:
+            text = str(text)
+        max_tokens = int(
+            inputs.get("max_tokens", np.array([32])).reshape(-1)[0]
+        )
+        max_tokens = max(1, min(max_tokens, self.cfg.max_seq - 2))
+        ignore_eos = bool(
+            inputs.get("ignore_eos", np.array([False])).reshape(-1)[0]
+        )
+        prompt = self._tokenizer.encode(text)
+        prompt = prompt[-(self.cfg.max_seq - max_tokens - 1):]
+        with self._lock:
+            cache = self._get_cache()
+            tokens = jnp.asarray(prompt[None])
+            logits, cache = self._prefill(self._params, tokens, cache)
+            pos = len(prompt)
+            token = int(jnp.argmax(logits[0]))
+            for produced in range(max_tokens):
+                if token == EOS and not ignore_eos:
+                    break
+                yield token
+                # decode only when another token will be consumed
+                if produced + 1 >= max_tokens or pos >= self.cfg.max_seq - 1:
+                    break
+                logits, cache = self._decode(
+                    self._params, jnp.full((1, 1), token, dtype=jnp.int32),
+                    pos, cache,
+                )
+                pos += 1
+                token = int(jnp.argmax(logits[0]))
+            self._return_cache(cache)
+
+    def infer_stream(self, inputs, parameters=None
+                     ) -> Iterator[Dict[str, np.ndarray]]:
+        for token in self._generate(inputs, parameters or {}):
+            piece = self._tokenizer.decode([token])
+            yield {
+                "text_output": np.array([piece.encode()], dtype=np.object_)
+            }
+
+    def infer(self, inputs, parameters=None) -> Dict[str, np.ndarray]:
+        tokens = list(self._generate(inputs, parameters or {}))
+        text = self._tokenizer.decode(tokens)
+        return {"text_output": np.array([text.encode()], dtype=np.object_)}
+
+    def warmup(self) -> None:
+        list(self.infer_stream({
+            "text_input": np.array([b"hi"], dtype=np.object_),
+            "max_tokens": np.array([2], dtype=np.int32),
+        }))

@@ -23,7 +23,7 @@ def build_core(
     warmup: bool = True,
 ) -> InferenceServerCore:
     repository = ModelRepository()
-    for name, factory in builtin_model_factories().items():
+    for name, factory in builtin_model_factories(repository).items():
         repository.add_factory(name, factory)
     if tpu_arena is None:
         try:

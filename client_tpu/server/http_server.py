@@ -268,6 +268,151 @@ def build_http_app(core: InferenceServerCore) -> web.Application:
         body = await request.json()
         return web.json_response(core.log_settings(body))
 
+    # -- generate (LLM extension) ---------------------------------------
+
+    def _generate_request(request, body: bytes):
+        """JSON body fields -> ModelInferRequest tensors by input name
+        (the triton generate-extension convention)."""
+        import json as _json
+
+        try:
+            doc = _json.loads(body)
+        except Exception as e:
+            raise InferenceServerException(
+                "malformed generate request: %s" % e,
+                status="INVALID_ARGUMENT",
+            )
+        if not isinstance(doc, dict):
+            raise InferenceServerException(
+                "generate request body must be a JSON object",
+                status="INVALID_ARGUMENT",
+            )
+        infer_request = pb.ModelInferRequest(
+            model_name=request.match_info["model"],
+            model_version=request.match_info.get("version", ""),
+        )
+        from client_tpu.protocol.http_wire import _json_data_to_raw
+
+        model = core.repository.get(infer_request.model_name)
+        for spec in model.inputs:
+            if spec.name not in doc:
+                continue
+            value = doc.pop(spec.name)
+            listed = value if isinstance(value, list) else [value]
+            tensor = infer_request.inputs.add()
+            tensor.name = spec.name
+            tensor.datatype = spec.datatype
+            tensor.shape.extend([len(listed)])
+            try:
+                infer_request.raw_input_contents.append(
+                    _json_data_to_raw(listed, spec.datatype, spec.name)
+                )
+            except (TypeError, ValueError, OverflowError) as e:
+                raise InferenceServerException(
+                    "invalid value for input '%s': %s" % (spec.name, e),
+                    status="INVALID_ARGUMENT",
+                )
+        for key, value in doc.items():  # leftover fields -> parameters
+            if isinstance(value, (bool, int, float, str)):
+                from client_tpu.protocol.http_wire import _set_pb_param
+
+                _set_pb_param(infer_request.parameters[key], value)
+        return infer_request
+
+    def _generate_json(response: pb.ModelInferResponse) -> dict:
+        from client_tpu.protocol.http_wire import _raw_to_json_data
+
+        doc = {
+            "model_name": response.model_name,
+            "model_version": response.model_version,
+        }
+        raw_idx = 0
+        for tensor in response.outputs:
+            if raw_idx >= len(response.raw_output_contents):
+                continue
+            data = _raw_to_json_data(
+                response.raw_output_contents[raw_idx], tensor.datatype
+            )
+            raw_idx += 1
+            doc[tensor.name] = data[0] if len(data) == 1 else data
+        return doc
+
+    @routes.post("/v2/models/{model}/generate")
+    @routes.post("/v2/models/{model}/versions/{version}/generate")
+    async def generate(request):
+        body = await request.read()
+        try:
+            infer_request = _generate_request(request, body)
+            response = await _run(core.infer, infer_request)
+            return web.json_response(_generate_json(response))
+        except InferenceServerException as e:
+            return _error_response(e)
+
+    @routes.post("/v2/models/{model}/generate_stream")
+    @routes.post("/v2/models/{model}/versions/{version}/generate_stream")
+    async def generate_stream(request):
+        import json as _json
+
+        body = await request.read()
+        try:
+            infer_request = _generate_request(request, body)
+        except InferenceServerException as e:
+            return _error_response(e)
+        sse = web.StreamResponse(
+            headers={"Content-Type": "text/event-stream",
+                     "Cache-Control": "no-cache"}
+        )
+        await sse.prepare(request)
+        loop = asyncio.get_running_loop()
+        queue_: asyncio.Queue = asyncio.Queue()
+        DONE = object()
+        import threading
+
+        cancelled = threading.Event()
+
+        def _produce():
+            generator = core.stream_infer(infer_request)
+            try:
+                for stream_response in generator:
+                    if cancelled.is_set():
+                        break  # client gone: stop consuming the model
+                    loop.call_soon_threadsafe(queue_.put_nowait,
+                                              stream_response)
+            except Exception as e:
+                # errors raised before the generator's first yield must
+                # still reach the client as an SSE error event
+                error = pb.ModelStreamInferResponse(error_message=str(e))
+                loop.call_soon_threadsafe(queue_.put_nowait, error)
+            finally:
+                generator.close()  # release the model promptly
+                loop.call_soon_threadsafe(queue_.put_nowait, DONE)
+
+        producer = loop.run_in_executor(None, _produce)
+        try:
+            while True:
+                item = await queue_.get()
+                if item is DONE:
+                    break
+                if item.error_message:
+                    payload = {"error": item.error_message}
+                else:
+                    # suppress only the data-less final marker; data
+                    # responses pass through whatever their outputs are
+                    if not item.infer_response.outputs:
+                        continue
+                    payload = _generate_json(item.infer_response)
+                await sse.write(
+                    ("data: %s\n\n" % _json.dumps(payload)).encode()
+                )
+        except (ConnectionResetError, ConnectionError, asyncio.CancelledError):
+            cancelled.set()
+            raise
+        finally:
+            cancelled.set()
+            await producer
+        await sse.write_eof()
+        return sse
+
     # -- inference -------------------------------------------------------
 
     @routes.post("/v2/models/{model}/infer")

@@ -1,0 +1,69 @@
+"""HTTP generate + generate_stream (SSE) endpoint tests — the LLM
+serving surface genai benchmarks drive."""
+
+import json
+
+import numpy as np
+import pytest
+
+from client_tpu.models.llm import LlmConfig, LlmModel
+from client_tpu.server.app import build_core
+from client_tpu.server.http_server import start_http_server_thread
+
+TINY = LlmConfig(d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+                 d_ff=128, max_seq=128)
+
+
+@pytest.fixture(scope="module")
+def http_server():
+    core = build_core([])
+    core.repository.add_model(LlmModel(name="llm_test", cfg=TINY),
+                              warmup=True)
+    runner = start_http_server_thread(core, host="127.0.0.1", port=0)
+    yield runner
+    runner.stop()
+
+
+def _post(port, path, body):
+    import http.client as hc
+
+    conn = hc.HTTPConnection("127.0.0.1", port, timeout=120)
+    conn.request("POST", path, body=json.dumps(body),
+                 headers={"Content-Type": "application/json"})
+    response = conn.getresponse()
+    payload = response.read()
+    conn.close()
+    return response.status, payload
+
+
+def test_generate(http_server):
+    status, payload = _post(http_server.port,
+                            "/v2/models/llm_test/generate",
+                            {"text_input": "hello", "max_tokens": 4,
+                             "ignore_eos": True})
+    assert status == 200
+    doc = json.loads(payload)
+    assert doc["model_name"] == "llm_test"
+    assert "text_output" in doc
+
+
+def test_generate_unknown_model(http_server):
+    status, payload = _post(http_server.port, "/v2/models/ghost/generate",
+                            {"text_input": "x"})
+    assert status == 404
+
+
+def test_generate_stream_sse(http_server):
+    status, payload = _post(http_server.port,
+                            "/v2/models/llm_test/generate_stream",
+                            {"text_input": "hello", "max_tokens": 4,
+                             "ignore_eos": True})
+    assert status == 200
+    events = [
+        json.loads(line[len("data: "):])
+        for line in payload.decode().split("\n")
+        if line.startswith("data: ")
+    ]
+    assert 1 <= len(events) <= 4
+    for event in events:
+        assert "text_output" in event

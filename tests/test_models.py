@@ -1,0 +1,148 @@
+"""Model zoo tests (small configs, CPU) incl. decoupled LLM streaming
+through the real gRPC stream — the first decoupled end-to-end
+exercise."""
+
+import queue
+
+import numpy as np
+import pytest
+
+import client_tpu.grpc as grpcclient
+from client_tpu.models.bert import BertConfig, BertModel
+from client_tpu.models.ensemble import (
+    PostprocessModel,
+    PreprocessModel,
+    make_image_ensemble,
+)
+from client_tpu.models.llm import ByteTokenizer, LlmConfig, LlmModel
+from client_tpu.models.resnet import ResNetConfig, ResNetModel
+from client_tpu.server.app import build_core, start_grpc_server
+
+
+TINY_LLM = LlmConfig(d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+                     d_ff=128, max_seq=128)
+TINY_BERT = BertConfig(vocab=1000, d_model=64, n_layers=2, n_heads=4,
+                       d_ff=128, max_seq=128)
+
+
+def test_byte_tokenizer_roundtrip():
+    tok = ByteTokenizer()
+    ids = tok.encode("hello é")
+    assert ids[0] == 256  # BOS
+    assert tok.decode(ids) == "hello é"
+
+
+def test_llm_generate_stream_direct():
+    model = LlmModel(name="llm_test", cfg=TINY_LLM)
+    pieces = list(model.infer_stream({
+        "text_input": np.array([b"abc"], dtype=np.object_),
+        "max_tokens": np.array([5], dtype=np.int32),
+        "ignore_eos": np.array([True]),
+    }))
+    assert 1 <= len(pieces) <= 5
+    for piece in pieces:
+        assert piece["text_output"].dtype == np.object_
+
+
+def test_llm_generate_deterministic():
+    model = LlmModel(name="llm_test", cfg=TINY_LLM)
+    run1 = model.infer({
+        "text_input": np.array([b"abc"], dtype=np.object_),
+        "max_tokens": np.array([4], dtype=np.int32),
+        "ignore_eos": np.array([True]),
+    })
+    run2 = model.infer({
+        "text_input": np.array([b"abc"], dtype=np.object_),
+        "max_tokens": np.array([4], dtype=np.int32),
+        "ignore_eos": np.array([True]),
+    })
+    assert run1["text_output"][0] == run2["text_output"][0]
+
+
+def test_resnet_forward_shapes():
+    model = ResNetModel(cfg=ResNetConfig(width=16, num_classes=10))
+    out = model.infer({"INPUT": np.zeros((2, 224, 224, 3), np.float32)})
+    assert np.asarray(out["OUTPUT"]).shape == (2, 10)
+    # unbatched input gets a batch dim
+    out = model.infer({"INPUT": np.zeros((224, 224, 3), np.float32)})
+    assert np.asarray(out["OUTPUT"]).shape == (1, 10)
+
+
+def test_bert_bucketing_and_mask():
+    model = BertModel(cfg=TINY_BERT)
+    ids = np.arange(10, dtype=np.int32) % 1000
+    out1 = model.infer({"input_ids": ids})
+    assert np.asarray(out1["logits"]).shape == (1, 2)
+    # same tokens padded by the bucketing must give the same logits
+    ids_padded = np.concatenate([ids, np.zeros(5, np.int32)])
+    mask = np.concatenate([np.ones(10, np.int32), np.zeros(5, np.int32)])
+    out2 = model.infer({"input_ids": ids_padded, "attention_mask": mask})
+    np.testing.assert_allclose(
+        np.asarray(out1["logits"]), np.asarray(out2["logits"]),
+        rtol=2e-2, atol=2e-2,
+    )
+
+
+def test_ensemble_pipeline():
+    from client_tpu.server.repository import ModelRepository
+
+    repo = ModelRepository()
+    repo.add_model(PreprocessModel())
+    repo.add_model(ResNetModel(cfg=ResNetConfig(width=16, num_classes=10)))
+    repo.add_model(PostprocessModel(num_classes=10))
+    ensemble = make_image_ensemble(repo)
+    out = ensemble.infer({
+        "RAW_IMAGE": np.zeros((224, 224, 3), np.uint8)
+    })
+    label = out["LABEL"]
+    assert b":" in np.asarray(label).reshape(-1)[0]
+    config = ensemble.config_pb()
+    assert [s.model_name for s in config.ensemble_scheduling.step] == [
+        "preprocess", "resnet50", "postprocess",
+    ]
+
+
+@pytest.fixture(scope="module")
+def llm_server():
+    core = build_core([])
+    core.repository.add_model(LlmModel(name="llm_test", cfg=TINY_LLM),
+                              warmup=True)
+    handle = start_grpc_server(core=core)
+    yield handle
+    handle.stop()
+
+
+def test_llm_decoupled_stream_over_grpc(llm_server):
+    """BASELINE config #5 shape: decoupled token streaming over the
+    bidi gRPC stream with final-response semantics."""
+    results = queue.Queue()
+    with grpcclient.InferenceServerClient(llm_server.address) as client:
+        meta = client.get_model_metadata("llm_test")
+        assert meta.name == "llm_test"
+        config = client.get_model_config("llm_test")
+        assert config.config.model_transaction_policy.decoupled
+
+        client.start_stream(lambda r, e: results.put((r, e)))
+        inputs = [
+            grpcclient.InferInput("text_input", [1], "BYTES"),
+            grpcclient.InferInput("max_tokens", [1], "INT32"),
+            grpcclient.InferInput("ignore_eos", [1], "BOOL"),
+        ]
+        inputs[0].set_data_from_numpy(np.array([b"hello"], dtype=np.object_))
+        inputs[1].set_data_from_numpy(np.array([4], dtype=np.int32))
+        inputs[2].set_data_from_numpy(np.array([True]))
+        client.async_stream_infer("llm_test", inputs, request_id="gen1",
+                                  enable_empty_final_response=True)
+
+        tokens = []
+        while True:
+            result, error = results.get(timeout=60)
+            assert error is None, error
+            params = result.get_parameters()
+            if params.get("triton_final_response"):
+                break
+            out = result.as_numpy("text_output")
+            if out is not None:
+                tokens.append(out.reshape(-1)[0])
+        client.stop_stream()
+    assert 1 <= len(tokens) <= 4
