@@ -148,14 +148,15 @@ class BertModel(ServedModel):
         if mask.ndim == 1:
             mask = mask[None]
         s = ids.shape[1]
-        bucket = _bucket_length(s)
-        if s < bucket:  # pad to the bucket so XLA reuses the compilation
+        # pad to a bucket (capped at max_seq) so XLA reuses compilations
+        bucket = min(_bucket_length(s), self.cfg.max_seq)
+        if s > bucket:
+            ids = ids[:, :bucket]
+            mask = mask[:, :bucket]
+        elif s < bucket:
             pad = ((0, 0), (0, bucket - s))
             ids = np.pad(ids, pad)
             mask = np.pad(mask, pad)
-        elif s > self.cfg.max_seq:
-            ids = ids[:, : self.cfg.max_seq]
-            mask = mask[:, : self.cfg.max_seq]
         logits = self._fn(self._params, jnp.asarray(ids), jnp.asarray(mask))
         return {"logits": logits}
 

@@ -205,9 +205,12 @@ def init_cache(cfg: LlmConfig, batch: int, dtype=None):
     ]
 
 
-def prefill(params, tokens, cache, cfg: LlmConfig):
-    """Process the prompt, fill the cache; returns (last logits,
-    cache). tokens [B,S]."""
+def prefill(params, tokens, cache, cfg: LlmConfig, true_len=None):
+    """Process the prompt, fill the cache; returns (logits of the last
+    real row, cache). tokens [B,S]; ``true_len`` (traced scalar) marks
+    the prompt length when S is a padded bucket — padded rows write
+    cache slots >= true_len, which decode overwrites sequentially
+    before ever attending to them, so they never leak into outputs."""
     b, s = tokens.shape
     x = params["embed"][tokens]
     positions = jnp.broadcast_to(jnp.arange(s), (b, s))
@@ -221,7 +224,11 @@ def prefill(params, tokens, cache, cfg: LlmConfig):
                             cache=layer_cache, cache_pos=0)
         new_cache.append(updated)
     x = _rms_norm(x, params["final_norm"])
-    logits = (x[:, -1] @ params["unembed"]).astype(jnp.float32)
+    if true_len is None:
+        last = x[:, -1]
+    else:
+        last = jax.lax.dynamic_slice_in_dim(x, true_len - 1, 1, axis=1)[:, 0]
+    logits = (last @ params["unembed"]).astype(jnp.float32)
     return logits, new_cache
 
 
@@ -309,7 +316,7 @@ class LlmModel(ServedModel):
         self._params = params
         cfg_static = self.cfg
         self._prefill = jax.jit(
-            lambda p, t, c: prefill(p, t, c, cfg_static)
+            lambda p, t, c, n: prefill(p, t, c, cfg_static, true_len=n)
         )
         self._decode = jax.jit(
             lambda p, tok, pos, c: decode_step(p, tok, pos, c, cfg_static),
@@ -344,9 +351,18 @@ class LlmModel(ServedModel):
         prompt = prompt[-(self.cfg.max_seq - max_tokens - 1):]
         with self._lock:
             cache = self._get_cache()
-            tokens = jnp.asarray(prompt[None])
-            logits, cache = self._prefill(self._params, tokens, cache)
-            pos = len(prompt)
+            # pad the prompt to a power-of-two bucket so XLA compiles
+            # prefill once per bucket, not once per prompt length
+            n = len(prompt)
+            bucket = 16
+            while bucket < n:
+                bucket *= 2
+            bucket = min(bucket, self.cfg.max_seq)
+            padded = np.full((1, bucket), PAD, dtype=np.int32)
+            padded[0, :n] = prompt
+            logits, cache = self._prefill(
+                self._params, jnp.asarray(padded), cache, n)
+            pos = n
             token = int(jnp.argmax(logits[0]))
             for produced in range(max_tokens):
                 if token == EOS and not ignore_eos:

@@ -2,10 +2,11 @@
 
 API-parity surface with the reference
 ``tritonclient.utils.shared_memory`` (utils/shared_memory/__init__.py:
-93-260), which backs it with a small C extension; here ctypes
-``shm_open``/``shm_unlink`` + stdlib ``mmap`` give the same zero-copy
-behavior with no build step (the C++ ``shm_utils`` in ``native/``
-serves the C++ stack).
+93-260). Like the reference, the fast path is a small native C
+extension (``shared_memory.c`` → libcshm.so, mirroring the reference's
+shared_memory.cc) loaded with ctypes; if the library cannot be built
+or loaded, a pure-Python ctypes ``shm_open`` + stdlib ``mmap`` path
+provides identical zero-copy behavior.
 """
 
 from __future__ import annotations
@@ -14,6 +15,8 @@ import ctypes
 import ctypes.util
 import mmap
 import os
+import sys
+import weakref
 from typing import List, Optional
 
 import numpy as np
@@ -23,6 +26,25 @@ from client_tpu.utils import (
     serialize_byte_tensor,
     triton_to_np_dtype,
 )
+from client_tpu.utils.shared_memory import _cshm
+
+# libcshm.so is built/loaded lazily on first region operation so that
+# importing the package never blocks on a compiler invocation
+_CSHM_LIB = None
+_CSHM_ATTEMPTED = False
+
+
+def _cshm_lib():
+    global _CSHM_LIB, _CSHM_ATTEMPTED
+    if not _CSHM_ATTEMPTED:
+        _CSHM_ATTEMPTED = True
+        _CSHM_LIB = _cshm.load()
+    return _CSHM_LIB
+
+
+def using_native_backend() -> bool:
+    """True when the libcshm.so C extension backs this module."""
+    return _cshm_lib() is not None
 
 
 class SharedMemoryException(Exception):
@@ -59,7 +81,9 @@ class SharedMemoryRegion:
         self._shm_key = shm_key
         self._byte_size = 0
         self._fd = -1
-        self._mpg: Optional[mmap.mmap] = None
+        self._mpg = None  # mmap.mmap (fallback) or memoryview (C ext)
+        self._chandle: Optional[ctypes.c_void_p] = None
+        self._np_base: Optional[np.ndarray] = None
         self._created = False
 
     @property
@@ -83,12 +107,49 @@ class SharedMemoryRegion:
 _mapped_regions: dict = {}
 
 
+def _adopt_chandle(region: SharedMemoryRegion, chandle: ctypes.c_void_p,
+                   created: bool) -> None:
+    """Fill a region from a native SharedMemoryHandle: zero-copy
+    memoryview over the mapped address + bookkeeping fields."""
+    base = ctypes.c_void_p()
+    key = ctypes.c_char_p()
+    fd = ctypes.c_int()
+    offset = ctypes.c_size_t()
+    size = ctypes.c_size_t()
+    _cshm_lib().GetSharedMemoryHandleInfo(
+        chandle, ctypes.byref(base), ctypes.byref(key), ctypes.byref(fd),
+        ctypes.byref(offset), ctypes.byref(size))
+    region._chandle = chandle
+    region._fd = fd.value
+    region._byte_size = size.value
+    region._created = created
+    # numpy's uint8 buffer exports format 'B' (plain ctypes arrays
+    # export '<B', which memoryview.cast and some consumers reject)
+    arr = np.ctypeslib.as_array(
+        ctypes.cast(base, ctypes.POINTER(ctypes.c_ubyte)),
+        shape=(size.value,))
+    region._np_base = arr
+    region._mpg = memoryview(arr)
+
+
 def create_shared_memory_region(
     triton_shm_name: str, shm_key: str, byte_size: int, create_only: bool = False
 ) -> SharedMemoryRegion:
     """Create (or attach, unless ``create_only``) and map the POSIX
     region ``shm_key`` of ``byte_size`` bytes."""
     region = SharedMemoryRegion(triton_shm_name, shm_key)
+    if using_native_backend():
+        chandle = ctypes.c_void_p()
+        rc = _cshm_lib().SharedMemoryRegionCreate(
+            shm_key.encode(), byte_size, int(create_only),
+            ctypes.byref(chandle))
+        if rc != 0:
+            raise SharedMemoryException(
+                "unable to create shared memory region '%s': %s"
+                % (shm_key, os.strerror(-rc)))
+        _adopt_chandle(region, chandle, created=True)
+        _mapped_regions[triton_shm_name] = region
+        return region
     flags = _O_RDWR | _O_CREAT
     if create_only:
         flags |= os.O_EXCL
@@ -122,6 +183,16 @@ def attach_shared_memory_region(
     """Attach to an existing region without creating it (used
     server-side when a client registers a region)."""
     region = SharedMemoryRegion(triton_shm_name, shm_key)
+    if using_native_backend():
+        chandle = ctypes.c_void_p()
+        rc = _cshm_lib().SharedMemoryRegionOpen(
+            shm_key.encode(), byte_size, ctypes.byref(chandle))
+        if rc != 0:
+            raise SharedMemoryException(
+                "unable to open shared memory region '%s': %s"
+                % (shm_key, os.strerror(-rc)))
+        _adopt_chandle(region, chandle, created=False)
+        return region
     fd = _LIB.shm_open(shm_key.encode(), _O_RDWR, 0o600)
     if fd < 0:
         raise SharedMemoryException(
@@ -163,7 +234,15 @@ def set_shared_memory_region(
             data = np.ascontiguousarray(arr).tobytes()
         if pos + len(data) > shm_handle.byte_size:
             raise SharedMemoryException("input exceeds shared memory region size")
-        buf[pos : pos + len(data)] = data
+        if shm_handle._chandle is not None:
+            rc = _cshm_lib().SharedMemoryRegionSet(
+                shm_handle._chandle, pos, len(data), data)
+            if rc != 0:
+                raise SharedMemoryException(
+                    "unable to set shared memory region: %s"
+                    % os.strerror(-rc))
+        else:
+            buf[pos : pos + len(data)] = data
         pos += len(data)
 
 
@@ -179,10 +258,13 @@ def get_contents_as_numpy(
     else:
         np_dtype = np.dtype(datatype)
         wire = None
+    count = int(np.prod(shape)) if len(shape) else 1
     if np_dtype == np.object_ or wire == "BYTES":
         end = shm_handle.byte_size
-        return deserialize_bytes_tensor(bytes(buf[offset:end])).reshape(shape)
-    count = int(np.prod(shape)) if len(shape) else 1
+        arr = deserialize_bytes_tensor(bytes(buf[offset:end]))
+        # the region may be larger than the tensor; trailing zero bytes
+        # decode as empty elements — keep only the requested count
+        return arr[:count].reshape(shape)
     return np.frombuffer(
         memoryview(buf), dtype=np_dtype, count=count, offset=offset
     ).reshape(shape)
@@ -197,7 +279,27 @@ def mapped_shared_memory_regions() -> List[str]:
     return list(_mapped_regions.keys())
 
 
-def _release_mapping(shm_handle: SharedMemoryRegion) -> None:
+def _release_mapping(shm_handle: SharedMemoryRegion, unlink: bool) -> None:
+    if shm_handle._chandle is not None:
+        lib = _cshm_lib()
+        chandle = shm_handle._chandle
+        base = shm_handle._np_base
+        shm_handle._mpg = None
+        shm_handle._np_base = None
+        shm_handle._chandle = None
+        shm_handle._fd = -1
+        if unlink:
+            # the name can go immediately; the mapping itself survives
+            # until munmap (POSIX keeps unlinked regions mapped)
+            _LIB.shm_unlink(shm_handle.key.encode())
+        # zero-copy numpy views may still reference the mapping
+        # (refcount: `base` local + getrefcount arg = 2 baseline);
+        # defer munmap until they die instead of leaving them dangling
+        if base is not None and sys.getrefcount(base) > 2:
+            weakref.finalize(base, lib.SharedMemoryRegionDetach, chandle)
+        else:
+            lib.SharedMemoryRegionDetach(chandle)
+        return
     # Zero-copy numpy views may still reference the mapping; in that
     # case dropping our reference lets GC unmap once the views die.
     if shm_handle._mpg is not None:
@@ -209,17 +311,18 @@ def _release_mapping(shm_handle: SharedMemoryRegion) -> None:
     if shm_handle._fd >= 0:
         os.close(shm_handle._fd)
         shm_handle._fd = -1
+    if unlink:
+        _LIB.shm_unlink(shm_handle.key.encode())
 
 
 def destroy_shared_memory_region(shm_handle: SharedMemoryRegion) -> None:
     """Unmap and unlink the region."""
     try:
-        _release_mapping(shm_handle)
+        _release_mapping(shm_handle, unlink=True)
     finally:
         _mapped_regions.pop(shm_handle.name, None)
-        _LIB.shm_unlink(shm_handle.key.encode())
 
 
 def detach_shared_memory_region(shm_handle: SharedMemoryRegion) -> None:
     """Unmap without unlinking (server detaching a client's region)."""
-    _release_mapping(shm_handle)
+    _release_mapping(shm_handle, unlink=False)

@@ -146,3 +146,25 @@ def test_llm_decoupled_stream_over_grpc(llm_server):
                 tokens.append(out.reshape(-1)[0])
         client.stop_stream()
     assert 1 <= len(tokens) <= 4
+
+
+def test_bert_truncates_beyond_max_seq():
+    """Inputs longer than max_seq must be truncated, not crash —
+    buckets are clamped to the configured max_seq."""
+    model = BertModel(cfg=TINY_BERT)
+    long_ids = np.ones((1, TINY_BERT.max_seq + 40), dtype=np.int32)
+    out = model.infer({"input_ids": long_ids})
+    assert out["logits"].shape[-1] == TINY_BERT.num_labels
+
+
+def test_llm_prefill_bucketing_consistent():
+    """Different prompt lengths hit the same padded prefill and still
+    produce the same continuation as an unpadded run would."""
+    model = LlmModel(name="llm_b", cfg=TINY_LLM)
+    outs = []
+    for text in ("hi", "hello there, long prompt " * 3):
+        pieces = [r["text_output"] for r in model.infer_stream(
+            {"text_input": np.array([text.encode()], dtype=np.object_),
+             "max_tokens": np.array([4], dtype=np.int32)})]
+        assert pieces
+        outs.append(pieces)
