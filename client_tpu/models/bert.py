@@ -102,11 +102,16 @@ def forward(params, input_ids, attention_mask, cfg: BertConfig):
 _BUCKETS = (32, 64, 128, 256, 512)
 
 
-def _bucket_length(s: int) -> int:
+def _bucket_length(s: int, max_seq: int) -> int:
+    """Smallest padding bucket >= s, doubling past the static list and
+    capped at max_seq (inputs beyond max_seq get truncated)."""
     for bucket in _BUCKETS:
         if s <= bucket:
-            return bucket
-    return _BUCKETS[-1]
+            return min(bucket, max_seq)
+    bucket = _BUCKETS[-1]
+    while bucket < s and bucket < max_seq:
+        bucket *= 2
+    return min(bucket, max_seq)
 
 
 class BertModel(ServedModel):
@@ -149,7 +154,7 @@ class BertModel(ServedModel):
             mask = mask[None]
         s = ids.shape[1]
         # pad to a bucket (capped at max_seq) so XLA reuses compilations
-        bucket = min(_bucket_length(s), self.cfg.max_seq)
+        bucket = _bucket_length(s, self.cfg.max_seq)
         if s > bucket:
             ids = ids[:, :bucket]
             mask = mask[:, :bucket]
@@ -161,6 +166,7 @@ class BertModel(ServedModel):
         return {"logits": logits}
 
     def warmup(self) -> None:
-        ids = jnp.zeros((1, _BUCKETS[0]), dtype=jnp.int32)
+        ids = jnp.zeros((1, min(_BUCKETS[0], self.cfg.max_seq)),
+                        dtype=jnp.int32)
         jax.block_until_ready(self._fn(self._params, ids,
                                        jnp.ones_like(ids)))

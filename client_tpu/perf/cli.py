@@ -18,11 +18,13 @@ from client_tpu.perf.client_backend import BackendKind, ClientBackendFactory
 from client_tpu.perf.data_loader import DataLoader
 from client_tpu.perf.load_manager import (
     ConcurrencyManager,
+    CustomLoadManager,
     InferDataManager,
     PeriodicConcurrencyManager,
     RequestRateManager,
     SequenceManager,
 )
+from client_tpu.perf.metrics_manager import MetricsManager
 from client_tpu.perf.model_parser import ModelParser, SchedulerType
 from client_tpu.perf.profiler import InferenceProfiler, MeasurementConfig
 from client_tpu.perf.report import export_profile, print_report, write_csv
@@ -103,6 +105,13 @@ def build_parser() -> argparse.ArgumentParser:
 
     parser.add_argument("-f", "--latency-report-file", default=None)
     parser.add_argument("--profile-export-file", default=None)
+
+    parser.add_argument("--collect-metrics", action="store_true",
+                        help="scrape server Prometheus metrics per window")
+    parser.add_argument("--metrics-url", default=None,
+                        help="defaults to http://<host>:8000/metrics")
+    parser.add_argument("--metrics-interval", type=float, default=1000.0,
+                        help="scrape interval ms")
     return parser
 
 
@@ -190,6 +199,22 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
         streaming=args.streaming, max_threads=args.max_threads,
         sequence_manager=sequence_manager,
     )
+
+    metrics_manager = None
+    if args.collect_metrics:
+        metrics_url = args.metrics_url
+        if not metrics_url:
+            host = args.url.split("://")[-1].split(":")[0] or "localhost"
+            metrics_url = "http://%s:8000/metrics" % host
+        metrics_manager = MetricsManager(metrics_url, args.metrics_interval)
+        try:
+            metrics_manager.check_reachable()
+        except Exception as e:
+            print("warning: metrics endpoint %s unreachable (%s); "
+                  "continuing without server metrics" % (metrics_url, e),
+                  file=sys.stderr)
+            metrics_manager = None
+
     mode = "concurrency"
     try:
         if args.request_rate_range:
@@ -199,18 +224,20 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
                 distribution=args.request_distribution, **manager_args
             )
             manager.init()
-            profiler = InferenceProfiler(manager, config, setup_backend,
-                                         model.name, args.verbose)
+            profiler = InferenceProfiler(
+                manager, config, setup_backend, model.name, args.verbose,
+                metrics_manager=metrics_manager)
             results = profiler.profile_request_rate_range(start, end, step)
         elif args.request_intervals:
             mode = "request_rate"
-            with open(args.request_intervals) as f:
-                intervals = [int(line) / 1e6 for line in f if line.strip()]
-            manager = RequestRateManager(**manager_args)
+            manager = CustomLoadManager(
+                request_intervals_file=args.request_intervals,
+                **manager_args)
             manager.init()
-            profiler = InferenceProfiler(manager, config, setup_backend,
-                                         model.name, args.verbose)
-            results = profiler.profile_custom_intervals(intervals)
+            profiler = InferenceProfiler(
+                manager, config, setup_backend, model.name, args.verbose,
+                metrics_manager=metrics_manager)
+            results = profiler.profile_custom_intervals()
         elif args.periodic_concurrency_range:
             start, end, step = _parse_range(args.periodic_concurrency_range)
             manager = PeriodicConcurrencyManager(
@@ -219,22 +246,26 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
                 **manager_args,
             )
             manager.init()
-            profiler = InferenceProfiler(manager, config, setup_backend,
-                                         model.name, args.verbose)
+            profiler = InferenceProfiler(
+                manager, config, setup_backend, model.name, args.verbose,
+                metrics_manager=metrics_manager)
             manager.run_ramp()
-            results = [profiler._profile_level()]
+            results = [profiler.profile_single_level()]
             manager.stop()
         else:
             start, end, step = _parse_range(args.concurrency_range or "1")
             manager = ConcurrencyManager(**manager_args)
             manager.init()
-            profiler = InferenceProfiler(manager, config, setup_backend,
-                                         model.name, args.verbose)
+            profiler = InferenceProfiler(
+                manager, config, setup_backend, model.name, args.verbose,
+                metrics_manager=metrics_manager)
             results = profiler.profile_concurrency_range(start, end, step)
-    except InferenceServerException as e:
+    except (InferenceServerException, ValueError, OSError) as e:
         print("perf failed: %s" % e, file=sys.stderr)
         return 1
     finally:
+        if metrics_manager is not None:
+            metrics_manager.stop()
         try:
             manager.cleanup()
         except Exception:

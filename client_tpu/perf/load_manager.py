@@ -715,6 +715,30 @@ class RequestRateManager(LoadManager):
                 pass
 
 
+class CustomLoadManager(RequestRateManager):
+    """Replays user-provided request intervals from a file, one
+    microsecond value per line (parity: custom_load_manager.h:46 /
+    the --request-intervals CLI mode)."""
+
+    def __init__(self, *args, request_intervals_file: Optional[str] = None,
+                 **kwargs):
+        super().__init__(*args, **kwargs)
+        self._intervals_file = request_intervals_file
+
+    @staticmethod
+    def read_intervals_file(path: str) -> List[float]:
+        with open(path) as f:
+            intervals = [int(line.strip()) / 1e6
+                         for line in f if line.strip()]
+        if not intervals:
+            raise ValueError("request-intervals file '%s' is empty" % path)
+        return intervals
+
+    def start_schedule(self) -> None:
+        self.set_custom_schedule(
+            self.read_intervals_file(self._intervals_file))
+
+
 class PeriodicConcurrencyManager(ConcurrencyManager):
     """Ramps concurrency from start to end by `step` every
     `request_period` completed requests (parity:

@@ -42,6 +42,9 @@ class PerfStatus:
         self.records: List[RequestRecord] = []
         self.window_start_ns = 0
         self.window_end_ns = 0
+        # summarized server accelerator gauges for the window:
+        # {family: {"avg": x, "max": y}} (see perf.metrics_manager)
+        self.tpu_metrics: Dict[str, Dict[str, float]] = {}
 
 
 class MeasurementConfig:
@@ -66,12 +69,16 @@ class MeasurementConfig:
 
 class InferenceProfiler:
     def __init__(self, manager: LoadManager, config: MeasurementConfig,
-                 backend=None, model_name: str = "", verbose: bool = False):
+                 backend=None, model_name: str = "", verbose: bool = False,
+                 metrics_manager=None):
         self._manager = manager
         self._config = config
         self._backend = backend  # for server-side stats
         self._model_name = model_name
         self._verbose = verbose
+        self._metrics = metrics_manager  # perf.metrics_manager.MetricsManager
+        if self._metrics is not None:
+            self._metrics.start()
 
     # -- sweeping --------------------------------------------------------
 
@@ -111,12 +118,23 @@ class InferenceProfiler:
         self._manager.stop()
         return results
 
-    def profile_custom_intervals(self, intervals_s) -> List[PerfStatus]:
+    def profile_custom_intervals(self, intervals_s=None) -> List[PerfStatus]:
+        """Profile one level driven by a custom interval schedule —
+        either an explicit list of second offsets, or (when None) the
+        manager's own intervals file (CustomLoadManager)."""
         assert isinstance(self._manager, RequestRateManager)
-        self._manager.set_custom_schedule(intervals_s)
+        if intervals_s is not None:
+            self._manager.set_custom_schedule(intervals_s)
+        else:
+            self._manager.start_schedule()
         status = self._profile_level()
         self._manager.stop()
         return [status]
+
+    def profile_single_level(self) -> PerfStatus:
+        """Measure at whatever load the manager is already generating
+        (periodic-concurrency ramp mode)."""
+        return self._profile_level()
 
     def _exceeds_latency(self, status: PerfStatus) -> bool:
         if self._config.latency_threshold_ms <= 0:
@@ -153,6 +171,8 @@ class InferenceProfiler:
 
     def _measure(self) -> PerfStatus:
         self._manager.swap_request_records()  # discard warm-up residue
+        if self._metrics is not None:
+            self._metrics.get_and_reset()  # drop inter-window scrapes
         start_ns = time.monotonic_ns()
         if self._config.mode == "count_windows":
             deadline = time.monotonic() + self._config.interval_ms / 1000.0 * 10
@@ -167,7 +187,13 @@ class InferenceProfiler:
             time.sleep(self._config.interval_ms / 1000.0)
         end_ns = time.monotonic_ns()
         records = self._manager.swap_request_records()
-        return self._summarize(records, start_ns, end_ns)
+        status = self._summarize(records, start_ns, end_ns)
+        if self._metrics is not None:
+            from client_tpu.perf.metrics_manager import summarize_metrics
+
+            status.tpu_metrics = summarize_metrics(
+                self._metrics.get_and_reset())
+        return status
 
     def _summarize(self, records: List[RequestRecord], start_ns: int,
                    end_ns: int) -> PerfStatus:
@@ -267,4 +293,12 @@ class InferenceProfiler:
             merged.completed_count / window_s if window_s > 0 else 0.0
         )
         merged.server_stats = trials[-1].server_stats
+        families = {f for t in trials for f in t.tpu_metrics}
+        for fam in families:
+            windows = [t.tpu_metrics[fam] for t in trials
+                       if fam in t.tpu_metrics]
+            merged.tpu_metrics[fam] = {
+                "avg": sum(w["avg"] for w in windows) / len(windows),
+                "max": max(w["max"] for w in windows),
+            }
         return merged

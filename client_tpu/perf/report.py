@@ -30,6 +30,17 @@ def print_report(results: List[PerfStatus], percentile: int = 0,
             print("    delayed requests: %d" % status.delayed_count)
         if status.error_count:
             print("    errors: %d" % status.error_count)
+        if status.tpu_metrics:
+            hbm = status.tpu_metrics.get("hbm_used_bytes")
+            util = status.tpu_metrics.get("hbm_utilization")
+            parts = []
+            if hbm:
+                parts.append("HBM used avg %.1f MiB / max %.1f MiB"
+                             % (hbm["avg"] / 2**20, hbm["max"] / 2**20))
+            if util:
+                parts.append("HBM util avg %.1f%%" % (util["avg"] * 100))
+            if parts:
+                print("    server TPU: %s" % ", ".join(parts))
         if not status.on_target:
             print("    WARNING: measurement did not stabilize")
 
@@ -43,8 +54,12 @@ def write_csv(path: str, results: List[PerfStatus],
             "Inferences/Second", "p50 latency", "p90 latency",
             "p95 latency", "p99 latency", "Avg latency", "Std latency",
             "Completed", "Delayed", "Errors",
+            "Avg HBM Used (MiB)", "Max HBM Used (MiB)",
+            "Avg HBM Utilization",
         ])
         for status in results:
+            hbm = status.tpu_metrics.get("hbm_used_bytes", {})
+            util = status.tpu_metrics.get("hbm_utilization", {})
             writer.writerow([
                 status.concurrency if mode == "concurrency"
                 else status.request_rate,
@@ -58,6 +73,9 @@ def write_csv(path: str, results: List[PerfStatus],
                 status.completed_count,
                 status.delayed_count,
                 status.error_count,
+                round(hbm.get("avg", 0) / 2**20, 2) if hbm else "",
+                round(hbm.get("max", 0) / 2**20, 2) if hbm else "",
+                round(util.get("avg", 0), 4) if util else "",
             ])
 
 

@@ -167,6 +167,75 @@ class InferenceServerCore:
                 stat.inference_stats.compute_output.ns = s.compute_output_ns
         return response
 
+    def metrics_text(self) -> str:
+        """Prometheus exposition text (parity: the Triton /metrics
+        endpoint that perf MetricsManager scrapes, metrics_manager.h:56;
+        the DCGM GPU gauges map to TPU HBM gauges here)."""
+        lines = []
+
+        def family(name, kind, help_text, rows):
+            if not rows:
+                return
+            lines.append("# HELP %s %s" % (name, help_text))
+            lines.append("# TYPE %s %s" % (name, kind))
+            lines.extend(rows)
+
+        success, failure, count, exec_count, duration = [], [], [], [], []
+        with self._stats_lock:
+            stats_snapshot = dict(self._stats)
+        for name, s in sorted(stats_snapshot.items()):
+            label = '{model="%s",version="1"}' % name
+            with s.lock:
+                success.append("nv_inference_request_success%s %d"
+                               % (label, s.success_count))
+                failure.append("nv_inference_request_failure%s %d"
+                               % (label, s.fail_count))
+                count.append("nv_inference_count%s %d"
+                             % (label, s.inference_count))
+                exec_count.append("nv_inference_exec_count%s %d"
+                                  % (label, s.execution_count))
+                duration.append("nv_inference_request_duration_us%s %d"
+                                % (label, (s.success_ns + s.fail_ns) // 1000))
+        family("nv_inference_request_success", "counter",
+               "Number of successful inference requests", success)
+        family("nv_inference_request_failure", "counter",
+               "Number of failed inference requests", failure)
+        family("nv_inference_count", "counter",
+               "Number of inferences performed", count)
+        family("nv_inference_exec_count", "counter",
+               "Number of model executions performed", exec_count)
+        family("nv_inference_request_duration_us", "counter",
+               "Cumulative inference request duration", duration)
+
+        used_rows, total_rows, util_rows = [], [], []
+        try:
+            import jax
+
+            for device in jax.local_devices():
+                uuid = "%s-%d" % (device.platform.upper(), device.id)
+                label = '{tpu_uuid="%s"}' % uuid
+                mem = device.memory_stats() or {}
+                used = mem.get("bytes_in_use")
+                limit = mem.get("bytes_limit")
+                if used is not None:
+                    used_rows.append("tpu_hbm_used_bytes%s %d"
+                                     % (label, used))
+                if limit:
+                    total_rows.append("tpu_hbm_total_bytes%s %d"
+                                      % (label, limit))
+                    if used is not None:
+                        util_rows.append("tpu_hbm_utilization%s %.6f"
+                                         % (label, used / limit))
+        except Exception:
+            pass  # metrics must never take the server down
+        family("tpu_hbm_used_bytes", "gauge",
+               "Accelerator HBM bytes in use", used_rows)
+        family("tpu_hbm_total_bytes", "gauge",
+               "Accelerator HBM capacity in bytes", total_rows)
+        family("tpu_hbm_utilization", "gauge",
+               "Fraction of accelerator HBM in use", util_rows)
+        return "\n".join(lines) + "\n"
+
     # -- trace / log settings -------------------------------------------
 
     def trace_setting(self, model_name: str, updates: Dict[str, list]

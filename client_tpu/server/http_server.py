@@ -70,6 +70,12 @@ def build_http_app(core: InferenceServerCore) -> web.Application:
         )
         return web.Response(status=200 if ready else 400)
 
+    @routes.get("/metrics")
+    async def metrics(request):
+        text = await _run(core.metrics_text)
+        return web.Response(text=text,
+                            content_type="text/plain", charset="utf-8")
+
     @routes.get("/v2")
     async def server_metadata(request):
         return _pb_json(core.server_metadata())

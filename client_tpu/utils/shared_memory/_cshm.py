@@ -50,7 +50,14 @@ def load() -> Optional[ctypes.CDLL]:
     """Load (building if necessary) libcshm.so; None on any failure."""
     if os.environ.get("CLIENT_TPU_NO_CSHM"):
         return None
-    path = _LIB_PATH if os.path.exists(_LIB_PATH) else _compile()
+    # rebuild whenever the source is newer than the cached library so
+    # edits to shared_memory.c actually take effect
+    fresh = (
+        os.path.exists(_LIB_PATH)
+        and (not os.path.exists(_SRC)
+             or os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC))
+    )
+    path = _LIB_PATH if fresh else _compile()
     if path is None:
         return None
     try:

@@ -1,0 +1,114 @@
+"""Prometheus metrics: server /metrics endpoint, perf-side scraper
+(parity: MetricsManager metrics_manager.h:56-82 with TPU HBM gauges in
+place of DCGM GPU gauges), and the CustomLoadManager intervals file."""
+
+import urllib.request
+
+import numpy as np
+import pytest
+
+import client_tpu.http as httpclient
+from client_tpu.perf.load_manager import CustomLoadManager
+from client_tpu.perf.metrics_manager import (
+    MetricsManager,
+    parse_prometheus,
+    summarize_metrics,
+)
+from client_tpu.server.app import build_core
+from client_tpu.server.http_server import start_http_server_thread
+
+
+@pytest.fixture(scope="module")
+def simple_core():
+    return build_core(["simple"])
+
+
+@pytest.fixture(scope="module")
+def http_server(simple_core):
+    runner = start_http_server_thread(simple_core, host="127.0.0.1", port=0)
+    runner.address = "127.0.0.1:%d" % runner.port
+    # drive one inference so the counter families are populated
+    with httpclient.InferenceServerClient(runner.address) as c:
+        inputs = [httpclient.InferInput("INPUT0", [16], "INT32"),
+                  httpclient.InferInput("INPUT1", [16], "INT32")]
+        inputs[0].set_data_from_numpy(np.arange(16, dtype=np.int32))
+        inputs[1].set_data_from_numpy(np.ones(16, dtype=np.int32))
+        c.infer("simple", inputs)
+    yield runner
+    runner.stop()
+
+SAMPLE = """\
+# HELP tpu_hbm_used_bytes Accelerator HBM bytes in use
+# TYPE tpu_hbm_used_bytes gauge
+tpu_hbm_used_bytes{tpu_uuid="TPU-0"} 1048576
+tpu_hbm_used_bytes{tpu_uuid="TPU-1"} 2097152
+# HELP tpu_hbm_total_bytes Accelerator HBM capacity in bytes
+# TYPE tpu_hbm_total_bytes gauge
+tpu_hbm_total_bytes{tpu_uuid="TPU-0"} 17179869184
+tpu_hbm_utilization{tpu_uuid="TPU-0"} 0.000061
+nv_inference_request_success{model="simple",version="1"} 42
+"""
+
+
+def test_parse_prometheus():
+    m = parse_prometheus(SAMPLE)
+    assert m.hbm_used_bytes == {"TPU-0": 1048576.0, "TPU-1": 2097152.0}
+    assert m.hbm_total_bytes == {"TPU-0": 17179869184.0}
+    assert m.hbm_utilization["TPU-0"] == pytest.approx(0.000061)
+
+
+def test_summarize_metrics():
+    snaps = [parse_prometheus(SAMPLE), parse_prometheus(SAMPLE)]
+    summary = summarize_metrics(snaps)
+    # per-snapshot device average of used bytes: (1 MiB + 2 MiB) / 2
+    assert summary["hbm_used_bytes"]["avg"] == pytest.approx(1572864.0)
+    assert summary["hbm_used_bytes"]["max"] == pytest.approx(1572864.0)
+
+
+def test_core_metrics_text(simple_core, http_server):
+    text = simple_core.metrics_text()
+    assert "nv_inference_request_success" in text
+    m = parse_prometheus(text)  # parses cleanly even with no gauges
+    assert isinstance(m.hbm_used_bytes, dict)
+
+
+def test_http_metrics_endpoint(http_server):
+    url = "http://%s/metrics" % http_server.address
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        body = resp.read().decode()
+    assert resp.status == 200
+    assert "# TYPE" in body or body.strip() == ""
+
+
+def test_metrics_manager_scrape(http_server):
+    mm = MetricsManager(http_server.address, metrics_interval_ms=20)
+    mm.check_reachable()
+    mm.start()
+    import time
+
+    time.sleep(0.2)
+    mm.stop()
+    snaps = mm.get_and_reset()
+    assert snaps, "expected at least one scrape"
+    assert mm.get_and_reset() == []  # reset drained the buffer
+
+
+def test_metrics_manager_unreachable():
+    mm = MetricsManager("127.0.0.1:59999", metrics_interval_ms=20,
+                        timeout_s=0.2)
+    with pytest.raises(Exception):
+        mm.check_reachable()
+
+
+def test_custom_intervals_file(tmp_path):
+    path = tmp_path / "intervals.txt"
+    path.write_text("1000\n2000\n1500\n")
+    intervals = CustomLoadManager.read_intervals_file(str(path))
+    assert intervals == [0.001, 0.002, 0.0015]
+
+
+def test_custom_intervals_empty_file(tmp_path):
+    path = tmp_path / "empty.txt"
+    path.write_text("\n")
+    with pytest.raises(ValueError):
+        CustomLoadManager.read_intervals_file(str(path))

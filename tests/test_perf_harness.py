@@ -410,3 +410,50 @@ def test_cli_inprocess_shm_tpu(tmp_path):
         "--stability-percentage", "90",
     ], core=core)
     assert rc == 0
+
+
+def test_cli_request_intervals_file(tmp_path):
+    from client_tpu.perf.cli import run
+    from client_tpu.server.app import build_core
+
+    core = build_core(["simple"])
+    intervals = tmp_path / "intervals.txt"
+    intervals.write_text("5000\n10000\n5000\n")  # microseconds
+    rc = run([
+        "-m", "simple", "--service-kind", "inprocess",
+        "--request-intervals", str(intervals),
+        "--measurement-interval", "200", "--max-trials", "3",
+        "--stability-percentage", "90",
+    ], core=core)
+    assert rc == 0
+
+
+def test_cli_collect_metrics_against_http(tmp_path):
+    """--collect-metrics scrapes the server's /metrics per window and
+    the CSV grows the HBM columns."""
+    from client_tpu.perf.cli import run
+    from client_tpu.server.app import build_core
+    from client_tpu.server.app import start_grpc_server
+    from client_tpu.server.http_server import start_http_server_thread
+
+    core = build_core(["simple"])
+    grpc_handle = start_grpc_server(core=core)
+    http_handle = start_http_server_thread(core, host="127.0.0.1", port=0)
+    csv_path = tmp_path / "report.csv"
+    try:
+        rc = run([
+            "-m", "simple", "-u", grpc_handle.address,
+            "--concurrency-range", "1",
+            "--collect-metrics",
+            "--metrics-url", "http://127.0.0.1:%d/metrics" % http_handle.port,
+            "--metrics-interval", "50",
+            "--measurement-interval", "300", "--max-trials", "3",
+            "--stability-percentage", "90",
+            "-f", str(csv_path),
+        ])
+        assert rc == 0
+        header = csv_path.read_text().splitlines()[0]
+        assert "Avg HBM Used (MiB)" in header
+    finally:
+        http_handle.stop()
+        grpc_handle.stop()
