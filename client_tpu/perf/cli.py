@@ -204,7 +204,12 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
     if args.collect_metrics:
         metrics_url = args.metrics_url
         if not metrics_url:
-            host = args.url.split("://")[-1].split(":")[0] or "localhost"
+            from urllib.parse import urlsplit
+
+            netloc = args.url if "://" in args.url else "//" + args.url
+            host = urlsplit(netloc).hostname or "localhost"
+            if ":" in host:  # bracket bare IPv6 for the URL
+                host = "[%s]" % host
             metrics_url = "http://%s:8000/metrics" % host
         metrics_manager = MetricsManager(metrics_url, args.metrics_interval)
         try:
@@ -266,6 +271,9 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
     finally:
         if metrics_manager is not None:
             metrics_manager.stop()
+            if metrics_manager.scrape_failures:
+                print("warning: %d metrics scrapes failed during the run"
+                      % metrics_manager.scrape_failures, file=sys.stderr)
         try:
             manager.cleanup()
         except Exception:

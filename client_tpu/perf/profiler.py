@@ -118,15 +118,13 @@ class InferenceProfiler:
         self._manager.stop()
         return results
 
-    def profile_custom_intervals(self, intervals_s=None) -> List[PerfStatus]:
-        """Profile one level driven by a custom interval schedule —
-        either an explicit list of second offsets, or (when None) the
-        manager's own intervals file (CustomLoadManager)."""
+    def profile_custom_intervals(self) -> List[PerfStatus]:
+        """Profile one level driven by the manager's custom interval
+        schedule (CustomLoadManager intervals file; for an explicit
+        list call manager.set_custom_schedule first and use
+        profile_single_level)."""
         assert isinstance(self._manager, RequestRateManager)
-        if intervals_s is not None:
-            self._manager.set_custom_schedule(intervals_s)
-        else:
-            self._manager.start_schedule()
+        self._manager.start_schedule()
         status = self._profile_level()
         self._manager.stop()
         return [status]

@@ -1,0 +1,147 @@
+"""genai layer: synthetic prompts, input datasets, profile-export
+parsing, statistics, exporters, and the full CLI pipeline against the
+in-process server (parity: genai-perf/tests)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from client_tpu.genai.exporters import console_report, export_csv, export_json
+from client_tpu.genai.inputs import LlmInputs, OutputFormat
+from client_tpu.genai.metrics import LLMProfileDataParser, Statistics
+from client_tpu.genai.synthetic import SyntheticPromptGenerator
+from client_tpu.genai.tokenizer import ByteLevelTokenizer, get_tokenizer
+from client_tpu.genai.wrapper import Profiler
+
+MS = 1_000_000  # ns per ms
+
+
+def test_tokenizer_roundtrip():
+    tok = get_tokenizer("byte")
+    assert isinstance(tok, ByteLevelTokenizer)
+    ids = tok.encode("hello")
+    assert len(ids) == 5
+    assert tok.decode(ids) == "hello"
+
+
+def test_tokenizer_unknown_raises():
+    with pytest.raises(ValueError):
+        get_tokenizer("definitely/not-a-model-on-disk")
+
+
+def test_synthetic_prompt_token_count():
+    tok = get_tokenizer("byte")
+    gen = SyntheticPromptGenerator(tok, seed=3)
+    prompt = gen.generate_prompt(mean_tokens=50)
+    assert abs(len(tok.encode(prompt)) - 50) <= 12  # word granularity
+
+
+def test_llm_inputs_dataset_format(tmp_path):
+    tok = get_tokenizer("byte")
+    inputs = LlmInputs(tok)
+    prompts = inputs.create_prompts(num_prompts=3, input_tokens_mean=20)
+    assert len(prompts) == 3
+    dataset = inputs.convert_to_dataset(prompts, output_tokens_mean=8)
+    assert len(dataset["data"]) == 3
+    step = dataset["data"][0]
+    assert step["max_tokens"] == [8]
+    assert isinstance(step["text_input"][0], str)
+    path = inputs.write_dataset(dataset, str(tmp_path / "in.json"))
+    assert json.load(open(path))["data"]
+
+
+def test_llm_inputs_from_file(tmp_path):
+    f = tmp_path / "prompts.jsonl"
+    f.write_text('{"text_input": "alpha"}\nplain beta\n')
+    inputs = LlmInputs(get_tokenizer("byte"))
+    prompts = inputs.create_prompts(num_prompts=0, input_file=str(f))
+    assert prompts == ["alpha", "plain beta"]
+
+
+def test_openai_chat_format():
+    inputs = LlmInputs(get_tokenizer("byte"))
+    dataset = inputs.convert_to_dataset(
+        ["hi"], OutputFormat.OPENAI_CHAT, output_tokens_mean=4,
+        model_name="m")
+    payload = dataset["data"][0]["payload"][0]
+    assert payload["messages"][0]["content"] == "hi"
+    assert payload["stream"] is True
+
+
+def _export_doc():
+    """Two requests with known timings: TTFT 10ms/20ms, ITLs 5ms."""
+    def req(start_ms, ttft_ms, n_tokens, itl_ms):
+        start = start_ms * MS
+        responses = [start + ttft_ms * MS]
+        for _ in range(n_tokens - 1):
+            responses.append(responses[-1] + itl_ms * MS)
+        return {"timestamp": start, "response_timestamps": responses}
+
+    return {
+        "experiments": [{
+            "experiment": {"mode": "concurrency", "value": 1},
+            "requests": [req(0, 10, 4, 5), req(100, 20, 4, 5)],
+        }],
+    }
+
+
+def test_profile_parser_metrics():
+    parser = LLMProfileDataParser(document=_export_doc(),
+                                  tokenizer=get_tokenizer("byte"))
+    metrics = parser.get_metrics(0)
+    assert [t / MS for t in metrics.time_to_first_token_ns] == [10, 20]
+    assert all(t / MS == 5 for t in metrics.inter_token_latency_ns)
+    assert len(metrics.inter_token_latency_ns) == 6
+    assert metrics.output_token_counts == [4, 4]
+    # duration: first start 0 -> last response (100 + 20 + 15)ms
+    assert metrics.benchmark_duration_s == pytest.approx(0.135)
+    assert metrics.output_token_throughput_per_s == pytest.approx(
+        8 / 0.135)
+
+
+def test_statistics_and_exporters(tmp_path):
+    parser = LLMProfileDataParser(document=_export_doc(),
+                                  tokenizer=get_tokenizer("byte"))
+    stats = parser.get_statistics(0)
+    d = stats.as_dict()
+    assert d["time_to_first_token_ms"]["mean"] == pytest.approx(15.0)
+    assert d["inter_token_latency_ms"]["p50"] == pytest.approx(5.0)
+    assert "request_throughput_per_s" in d
+    report = console_report(stats)
+    assert "time_to_first_token_ms" in report
+    export_json([stats], str(tmp_path / "out.json"), meta={"model": "m"})
+    assert json.load(open(tmp_path / "out.json"))["experiments"]
+    export_csv([stats], str(tmp_path / "out.csv"))
+    assert "time_to_first_token_ms" in (tmp_path / "out.csv").read_text()
+
+
+def test_wrapper_build_args():
+    args = Profiler.build_args(model="llm_tiny", service_kind="inprocess",
+                               concurrency=2, input_path="i.json",
+                               export_path="e.json")
+    assert "--streaming" in args
+    assert "-u" not in args  # inprocess needs no url
+    assert args[args.index("--concurrency-range") + 1] == "2"
+
+
+def test_genai_cli_e2e_inprocess(tmp_path):
+    from client_tpu.genai.main import run
+    from client_tpu.server.app import build_core
+
+    core = build_core(["llm_tiny"])
+    json_out = tmp_path / "stats.json"
+    rc = run([
+        "-m", "llm_tiny", "--service-kind", "inprocess",
+        "--num-prompts", "3", "--output-tokens-mean", "4",
+        "--synthetic-input-tokens-mean", "12",
+        "--measurement-interval", "400", "--max-trials", "2",
+        "--stability-percentage", "90",
+        "--artifact-dir", str(tmp_path),
+        "--export-json", str(json_out),
+    ], core=core)
+    assert rc == 0
+    doc = json.loads(json_out.read_text())
+    exp = doc["experiments"][0]
+    assert "time_to_first_token_ms" in exp
+    assert exp["output_token_throughput_per_s"]["value"] > 0
