@@ -42,10 +42,19 @@ class LlmInputs:
                     # JSONL with {"text_input": ...} or raw text lines
                     try:
                         doc = json.loads(line)
-                        prompts.append(doc.get("text_input") or
-                                       doc.get("prompt") or line)
                     except json.JSONDecodeError:
                         prompts.append(line)
+                        continue
+                    if isinstance(doc, dict):
+                        prompts.append(doc.get("text_input") or
+                                       doc.get("prompt") or line)
+                    elif isinstance(doc, str):
+                        prompts.append(doc)
+                    else:
+                        raise ValueError(
+                            "input file '%s': line is neither an object "
+                            "with text_input/prompt nor a string: %r"
+                            % (input_file, line[:80]))
             if not prompts:
                 raise ValueError("input file '%s' has no prompts"
                                  % input_file)

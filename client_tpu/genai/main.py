@@ -70,6 +70,9 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
 
     artifact_dir = args.artifact_dir or tempfile.mkdtemp(prefix="genai_")
     os.makedirs(artifact_dir, exist_ok=True)
+    # Tell the user where inputs/profile export land (genai-perf
+    # prints its artifact directory too); default runs use a temp dir.
+    print("genai artifacts: %s" % artifact_dir, file=sys.stderr)
     input_path = os.path.join(artifact_dir, "llm_inputs.json")
     export_path = (args.profile_export_file
                    or os.path.join(artifact_dir, "profile_export.json"))

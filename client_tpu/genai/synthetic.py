@@ -28,16 +28,22 @@ class SyntheticPromptGenerator:
                         ) -> str:
         target = max(1, int(self._rng.gauss(mean_tokens, stddev_tokens))
                      if stddev_tokens > 0 else mean_tokens)
+        # Track the token count incrementally (word + separator) so
+        # generation stays linear in the target length; re-encoding
+        # the joined prompt every step is quadratic for long contexts.
         words: List[str] = []
-        # words are ~1+ tokens each; extend until we hit the target
-        while True:
-            words.extend(self._rng.choices(_CORPUS, k=8))
-            prompt = " ".join(words)
-            if self._count(prompt) >= target:
-                break
+        total = 0
+        while total < target:
+            for word in self._rng.choices(_CORPUS, k=8):
+                piece = word if not words else " " + word
+                words.append(word)
+                total += self._count(piece)
+                if total >= target:
+                    break
         # trim down to the target token count
-        while words and self._count(" ".join(words)) > target:
-            words.pop()
+        while len(words) > 1 and total > target:
+            tail = words.pop()
+            total -= self._count(" " + tail)
         return " ".join(words) if words else _CORPUS[0]
 
     def generate_prompts(self, count: int, mean_tokens: int,

@@ -1,0 +1,18 @@
+// Base64 encode/decode (RFC 4648). The reference vendors the
+// public-domain libb64 (cencode.{h,c}) for shipping CUDA IPC handles
+// over HTTP; we need the same for TPU region descriptors in REST
+// bodies.
+#pragma once
+
+#include <cstdint>
+#include <string>
+
+namespace tpuclient {
+
+std::string Base64Encode(const uint8_t* data, size_t len);
+std::string Base64Encode(const std::string& data);
+
+// Returns false on malformed input.
+bool Base64Decode(const std::string& encoded, std::string* out);
+
+}  // namespace tpuclient

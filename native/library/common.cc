@@ -1,0 +1,197 @@
+#include "common.h"
+
+#include <ostream>
+
+namespace tpuclient {
+
+//============================================================ Error
+
+const Error Error::Success("");
+
+Error::Error(const std::string& msg) : msg_(msg) {}
+
+std::ostream& operator<<(std::ostream& out, const Error& err) {
+  if (!err.msg_.empty()) out << err.msg_;
+  return out;
+}
+
+//============================================================ InferInput
+
+Error InferInput::Create(
+    InferInput** infer_input, const std::string& name,
+    const std::vector<int64_t>& dims, const std::string& datatype) {
+  *infer_input = new InferInput(name, dims, datatype);
+  return Error::Success;
+}
+
+InferInput::InferInput(
+    const std::string& name, const std::vector<int64_t>& dims,
+    const std::string& datatype)
+    : name_(name), shape_(dims), datatype_(datatype) {}
+
+Error InferInput::SetShape(const std::vector<int64_t>& dims) {
+  shape_ = dims;
+  return Error::Success;
+}
+
+Error InferInput::AppendRaw(const std::vector<uint8_t>& input) {
+  return AppendRaw(input.data(), input.size());
+}
+
+Error InferInput::AppendRaw(const uint8_t* input, size_t input_byte_size) {
+  bufs_.emplace_back(input, input_byte_size);
+  total_send_byte_size_ += input_byte_size;
+  byte_size_ = total_send_byte_size_;
+  return Error::Success;
+}
+
+Error InferInput::AppendFromString(const std::vector<std::string>& input) {
+  if (datatype_ != "BYTES") {
+    return Error(
+        "unable to append string data to non-BYTES input '" + name_ + "'");
+  }
+  // 4-byte little-endian length prefix per element — the v2 BYTES
+  // wire format (reference serialize_byte_tensor,
+  // tritonclient/utils/__init__.py:193).
+  str_bufs_.emplace_back();
+  std::string& serialized = str_bufs_.back();
+  for (const auto& s : input) {
+    uint32_t len = static_cast<uint32_t>(s.size());
+    char lenbuf[4];
+    lenbuf[0] = static_cast<char>(len & 0xFF);
+    lenbuf[1] = static_cast<char>((len >> 8) & 0xFF);
+    lenbuf[2] = static_cast<char>((len >> 16) & 0xFF);
+    lenbuf[3] = static_cast<char>((len >> 24) & 0xFF);
+    serialized.append(lenbuf, 4);
+    serialized.append(s);
+  }
+  return AppendRaw(
+      reinterpret_cast<const uint8_t*>(serialized.data()), serialized.size());
+}
+
+Error InferInput::SetSharedMemory(
+    const std::string& region_name, size_t byte_size, size_t offset) {
+  shm_name_ = region_name;
+  shm_byte_size_ = byte_size;
+  shm_offset_ = offset;
+  return Error::Success;
+}
+
+Error InferInput::SharedMemoryInfo(
+    std::string* name, size_t* byte_size, size_t* offset) const {
+  if (shm_name_.empty()) {
+    return Error("input '" + name_ + "' has no shared-memory region set");
+  }
+  *name = shm_name_;
+  *byte_size = shm_byte_size_;
+  *offset = shm_offset_;
+  return Error::Success;
+}
+
+Error InferInput::Reset() {
+  bufs_.clear();
+  str_bufs_.clear();
+  total_send_byte_size_ = 0;
+  byte_size_ = 0;
+  bufs_idx_ = 0;
+  buf_pos_ = 0;
+  shm_name_.clear();
+  shm_byte_size_ = 0;
+  shm_offset_ = 0;
+  return Error::Success;
+}
+
+void InferInput::PrepareForRequest() {
+  bufs_idx_ = 0;
+  buf_pos_ = 0;
+}
+
+bool InferInput::GetNext(const uint8_t** buf, size_t* input_bytes) {
+  while (bufs_idx_ < bufs_.size()) {
+    const auto& entry = bufs_[bufs_idx_];
+    if (buf_pos_ < entry.second) {
+      *buf = entry.first + buf_pos_;
+      *input_bytes = entry.second - buf_pos_;
+      ++bufs_idx_;
+      buf_pos_ = 0;
+      return true;
+    }
+    ++bufs_idx_;
+    buf_pos_ = 0;
+  }
+  *buf = nullptr;
+  *input_bytes = 0;
+  return false;
+}
+
+void InferInput::GatherInto(std::string* out) const {
+  for (const auto& entry : bufs_) {
+    out->append(reinterpret_cast<const char*>(entry.first), entry.second);
+  }
+}
+
+//============================================================ InferRequestedOutput
+
+Error InferRequestedOutput::Create(
+    InferRequestedOutput** infer_output, const std::string& name,
+    const size_t class_count, const std::string& datatype) {
+  *infer_output = new InferRequestedOutput(name, datatype, class_count);
+  return Error::Success;
+}
+
+InferRequestedOutput::InferRequestedOutput(
+    const std::string& name, const std::string& datatype,
+    const size_t class_count)
+    : name_(name), datatype_(datatype), class_count_(class_count) {}
+
+Error InferRequestedOutput::SetSharedMemory(
+    const std::string& region_name, size_t byte_size, size_t offset) {
+  shm_name_ = region_name;
+  shm_byte_size_ = byte_size;
+  shm_offset_ = offset;
+  return Error::Success;
+}
+
+Error InferRequestedOutput::UnsetSharedMemory() {
+  shm_name_.clear();
+  shm_byte_size_ = 0;
+  shm_offset_ = 0;
+  return Error::Success;
+}
+
+Error InferRequestedOutput::SharedMemoryInfo(
+    std::string* name, size_t* byte_size, size_t* offset) const {
+  if (shm_name_.empty()) {
+    return Error("output '" + name_ + "' has no shared-memory region set");
+  }
+  *name = shm_name_;
+  *byte_size = shm_byte_size_;
+  *offset = shm_offset_;
+  return Error::Success;
+}
+
+Error InferRequestedOutput::SetBinaryData(bool binary_data) {
+  binary_data_ = binary_data;
+  return Error::Success;
+}
+
+//============================================================ client base
+
+Error InferenceServerClient::ClientInferStat(InferStat* infer_stat) const {
+  std::lock_guard<std::mutex> lk(stat_mutex_);
+  *infer_stat = infer_stat_;
+  return Error::Success;
+}
+
+void InferenceServerClient::UpdateInferStat(const RequestTimers& timer) {
+  std::lock_guard<std::mutex> lk(stat_mutex_);
+  infer_stat_.completed_request_count++;
+  infer_stat_.cumulative_total_request_time_ns += timer.Duration(
+      RequestTimers::Kind::REQUEST_START, RequestTimers::Kind::REQUEST_END);
+  infer_stat_.cumulative_send_time_ns += timer.Duration(
+      RequestTimers::Kind::SEND_START, RequestTimers::Kind::SEND_END);
+  infer_stat_.cumulative_receive_time_ns += timer.Duration(
+      RequestTimers::Kind::RECV_START, RequestTimers::Kind::RECV_END);
+}
+
+}  // namespace tpuclient

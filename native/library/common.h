@@ -1,0 +1,294 @@
+// Core client data model for the TPU-native inference client.
+//
+// Mirrors the public surface of the reference C++ client library's
+// common.h (/root/reference/src/c++/library/common.h:61-677): Error,
+// InferStat, InferenceServerClient base, InferOptions, InferInput,
+// InferRequestedOutput, InferResult, RequestTimers — re-implemented
+// for the KServe-v2 TPU server (system shm + TPU HBM arena regions
+// instead of CUDA IPC).
+#pragma once
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace tpuclient {
+
+class InferResult;
+
+//==============================================================================
+// Error status object returned by every API (parity: common.h:61).
+//
+class Error {
+ public:
+  explicit Error(const std::string& msg = "");
+  bool IsOk() const { return msg_.empty(); }
+  const std::string& Message() const { return msg_; }
+
+  static const Error Success;
+
+  friend std::ostream& operator<<(std::ostream&, const Error&);
+
+ private:
+  std::string msg_;
+};
+
+//==============================================================================
+// Cumulative client-side inference statistics (parity: common.h:93).
+//
+struct InferStat {
+  size_t completed_request_count = 0;
+  uint64_t cumulative_total_request_time_ns = 0;
+  uint64_t cumulative_send_time_ns = 0;
+  uint64_t cumulative_receive_time_ns = 0;
+};
+
+//==============================================================================
+// Nanosecond timestamps captured around each request
+// (parity: common.h:568-648).
+//
+class RequestTimers {
+ public:
+  enum class Kind {
+    REQUEST_START,
+    REQUEST_END,
+    SEND_START,
+    SEND_END,
+    RECV_START,
+    RECV_END,
+    COUNT__
+  };
+
+  RequestTimers() { Reset(); }
+
+  void Reset() {
+    for (auto& t : timestamps_) t = 0;
+  }
+
+  void CaptureTimestamp(Kind kind) {
+    timestamps_[static_cast<size_t>(kind)] =
+        std::chrono::duration_cast<std::chrono::nanoseconds>(
+            std::chrono::steady_clock::now().time_since_epoch())
+            .count();
+  }
+
+  void SetTimestamp(Kind kind, uint64_t ns) {
+    timestamps_[static_cast<size_t>(kind)] = ns;
+  }
+
+  uint64_t Timestamp(Kind kind) const {
+    return timestamps_[static_cast<size_t>(kind)];
+  }
+
+  uint64_t Duration(Kind start, Kind end) const {
+    uint64_t s = Timestamp(start), e = Timestamp(end);
+    return (e >= s) ? (e - s) : 0;
+  }
+
+ private:
+  uint64_t timestamps_[static_cast<size_t>(Kind::COUNT__)];
+};
+
+//==============================================================================
+// Per-request options (parity: common.h:164-231).
+//
+struct InferOptions {
+  explicit InferOptions(const std::string& model_name_in)
+      : model_name(model_name_in) {}
+
+  std::string model_name;
+  std::string model_version;
+  std::string request_id;
+  uint64_t sequence_id = 0;
+  bool sequence_start = false;
+  bool sequence_end = false;
+  uint64_t priority = 0;
+  // Server-side timeout in microseconds (0 = none).
+  uint64_t server_timeout_us = 0;
+  // Client-side transport timeout in microseconds (0 = none).
+  uint64_t client_timeout_us = 0;
+  // Generic request parameters forwarded on the wire.
+  std::map<std::string, std::string> string_params;
+  std::map<std::string, int64_t> int_params;
+  std::map<std::string, bool> bool_params;
+  std::map<std::string, double> double_params;
+  // Whether to request/parse outputs as binary over HTTP.
+  bool binary_data_output = true;
+};
+
+//==============================================================================
+// An input tensor for an inference request (parity: common.h:237-394).
+// Data is either appended host buffers (zero-copy chunk iteration via
+// GetNext) or a named shared-memory region (system or TPU HBM).
+//
+class InferInput {
+ public:
+  static Error Create(
+      InferInput** infer_input, const std::string& name,
+      const std::vector<int64_t>& dims, const std::string& datatype);
+
+  const std::string& Name() const { return name_; }
+  const std::string& Datatype() const { return datatype_; }
+  const std::vector<int64_t>& Shape() const { return shape_; }
+  Error SetShape(const std::vector<int64_t>& dims);
+
+  // Appends a chunk of raw tensor data (not copied; caller keeps the
+  // buffer alive until the request completes; parity common.h:296).
+  Error AppendRaw(const uint8_t* input, size_t input_byte_size);
+  Error AppendRaw(const std::vector<uint8_t>& input);
+  // Appends BYTES-tensor strings (serialized 4-byte-LE length
+  // prefixed into an internal buffer; parity common.h:313).
+  Error AppendFromString(const std::vector<std::string>& input);
+
+  // Routes this input through a registered shared-memory region
+  // (system or TPU; parity common.h:331).
+  Error SetSharedMemory(
+      const std::string& region_name, size_t byte_size, size_t offset = 0);
+  bool IsSharedMemory() const { return !shm_name_.empty(); }
+  Error SharedMemoryInfo(
+      std::string* name, size_t* byte_size, size_t* offset) const;
+
+  Error Reset();
+
+  size_t ByteSize() const { return byte_size_; }
+  // Total bytes appended so far (must equal ByteSize() at send time
+  // for fixed-size dtypes).
+  size_t TotalSendByteSize() const { return total_send_byte_size_; }
+
+  // Chunk iterator used by transports to serialize without copying
+  // (parity: common.h:380 GetNext).
+  void PrepareForRequest();
+  bool GetNext(const uint8_t** buf, size_t* input_bytes);
+  // Convenience: gather all chunks into out (single copy).
+  void GatherInto(std::string* out) const;
+
+ private:
+  InferInput(
+      const std::string& name, const std::vector<int64_t>& dims,
+      const std::string& datatype);
+
+  std::string name_;
+  std::vector<int64_t> shape_;
+  std::string datatype_;
+  size_t byte_size_ = 0;
+
+  std::vector<std::pair<const uint8_t*, size_t>> bufs_;
+  // Backing store for AppendFromString serialization.
+  std::vector<std::string> str_bufs_;
+  size_t total_send_byte_size_ = 0;
+  size_t bufs_idx_ = 0;
+  size_t buf_pos_ = 0;
+
+  std::string shm_name_;
+  size_t shm_byte_size_ = 0;
+  size_t shm_offset_ = 0;
+};
+
+//==============================================================================
+// A requested output tensor (parity: common.h:400-482).
+//
+class InferRequestedOutput {
+ public:
+  static Error Create(
+      InferRequestedOutput** infer_output, const std::string& name,
+      const size_t class_count = 0, const std::string& datatype = "");
+
+  const std::string& Name() const { return name_; }
+  const std::string& Datatype() const { return datatype_; }
+  size_t ClassCount() const { return class_count_; }
+
+  Error SetSharedMemory(
+      const std::string& region_name, size_t byte_size, size_t offset = 0);
+  Error UnsetSharedMemory();
+  bool IsSharedMemory() const { return !shm_name_.empty(); }
+  Error SharedMemoryInfo(
+      std::string* name, size_t* byte_size, size_t* offset) const;
+
+  // HTTP-only: request this output as binary data (default true;
+  // parity common.h:466 BinaryData).
+  bool BinaryData() const { return binary_data_; }
+  Error SetBinaryData(bool binary_data);
+
+ private:
+  InferRequestedOutput(
+      const std::string& name, const std::string& datatype,
+      const size_t class_count);
+
+  std::string name_;
+  std::string datatype_;
+  size_t class_count_;
+  bool binary_data_ = true;
+
+  std::string shm_name_;
+  size_t shm_byte_size_ = 0;
+  size_t shm_offset_ = 0;
+};
+
+//==============================================================================
+// Result interface returned to the user (parity: common.h:488-563).
+//
+class InferResult {
+ public:
+  virtual ~InferResult() = default;
+
+  virtual Error ModelName(std::string* name) const = 0;
+  virtual Error ModelVersion(std::string* version) const = 0;
+  virtual Error Id(std::string* id) const = 0;
+  virtual Error Shape(
+      const std::string& output_name, std::vector<int64_t>* shape) const = 0;
+  virtual Error Datatype(
+      const std::string& output_name, std::string* datatype) const = 0;
+  virtual Error RawData(
+      const std::string& output_name, const uint8_t** buf,
+      size_t* byte_size) const = 0;
+  virtual Error StringData(
+      const std::string& output_name,
+      std::vector<std::string>* string_result) const = 0;
+  virtual std::string DebugString() const = 0;
+  virtual Error RequestStatus() const = 0;
+};
+
+using OnCompleteFn = std::function<void(InferResult*)>;
+using OnMultiCompleteFn = std::function<void(std::vector<InferResult*>)>;
+
+//==============================================================================
+// Client base: shared stats + async-worker scaffolding
+// (parity: common.h:119-153).
+//
+class InferenceServerClient {
+ public:
+  explicit InferenceServerClient(bool verbose)
+      : verbose_(verbose), exiting_(false) {}
+  virtual ~InferenceServerClient() = default;
+
+  Error ClientInferStat(InferStat* infer_stat) const;
+
+ protected:
+  void UpdateInferStat(const RequestTimers& timer);
+
+  bool verbose_;
+
+  std::thread worker_;
+  std::mutex mutex_;
+  std::condition_variable cv_;
+  bool exiting_;
+
+  mutable std::mutex stat_mutex_;
+  InferStat infer_stat_;
+};
+
+//==============================================================================
+// Headers / query-string types used by both protocol clients.
+//
+using Headers = std::map<std::string, std::string>;
+using Parameters = std::map<std::string, std::string>;
+
+}  // namespace tpuclient

@@ -1,0 +1,614 @@
+#include "json.h"
+
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+#include <stdexcept>
+
+namespace tpuclient {
+namespace json {
+
+static const Value kNullValue;
+
+Value::Value(const char* s) : type_(Type::kString), str_(new std::string(s)) {}
+Value::Value(const std::string& s)
+    : type_(Type::kString), str_(new std::string(s)) {}
+Value::Value(std::string&& s)
+    : type_(Type::kString), str_(new std::string(std::move(s))) {}
+Value::Value(const Array& a) : type_(Type::kArray), array_(new Array(a)) {}
+Value::Value(Array&& a) : type_(Type::kArray), array_(new Array(std::move(a))) {}
+Value::Value(const Object& o) : type_(Type::kObject), object_(new Object(o)) {}
+Value::Value(Object&& o)
+    : type_(Type::kObject), object_(new Object(std::move(o))) {}
+
+Value::Value(const Value& other) : type_(Type::kNull) { CopyFrom(other); }
+Value::Value(Value&& other) noexcept : type_(Type::kNull) {
+  MoveFrom(std::move(other));
+}
+Value& Value::operator=(const Value& other) {
+  if (this != &other) {
+    Destroy();
+    CopyFrom(other);
+  }
+  return *this;
+}
+Value& Value::operator=(Value&& other) noexcept {
+  if (this != &other) {
+    Destroy();
+    MoveFrom(std::move(other));
+  }
+  return *this;
+}
+Value::~Value() { Destroy(); }
+
+void Value::Destroy() {
+  str_.reset();
+  array_.reset();
+  object_.reset();
+  type_ = Type::kNull;
+}
+
+void Value::CopyFrom(const Value& other) {
+  type_ = other.type_;
+  switch (type_) {
+    case Type::kBool:
+      bool_ = other.bool_;
+      break;
+    case Type::kInt:
+      int_ = other.int_;
+      break;
+    case Type::kUint:
+      uint_ = other.uint_;
+      break;
+    case Type::kDouble:
+      double_ = other.double_;
+      break;
+    case Type::kString:
+      str_.reset(new std::string(*other.str_));
+      break;
+    case Type::kArray:
+      array_.reset(new Array(*other.array_));
+      break;
+    case Type::kObject:
+      object_.reset(new Object(*other.object_));
+      break;
+    default:
+      break;
+  }
+}
+
+void Value::MoveFrom(Value&& other) {
+  type_ = other.type_;
+  switch (type_) {
+    case Type::kBool:
+      bool_ = other.bool_;
+      break;
+    case Type::kInt:
+      int_ = other.int_;
+      break;
+    case Type::kUint:
+      uint_ = other.uint_;
+      break;
+    case Type::kDouble:
+      double_ = other.double_;
+      break;
+    case Type::kString:
+      str_ = std::move(other.str_);
+      break;
+    case Type::kArray:
+      array_ = std::move(other.array_);
+      break;
+    case Type::kObject:
+      object_ = std::move(other.object_);
+      break;
+    default:
+      break;
+  }
+  other.type_ = Type::kNull;
+}
+
+bool Value::AsBool() const {
+  if (type_ != Type::kBool) throw std::runtime_error("json: not a bool");
+  return bool_;
+}
+
+int64_t Value::AsInt() const {
+  switch (type_) {
+    case Type::kInt:
+      return int_;
+    case Type::kUint:
+      return static_cast<int64_t>(uint_);
+    case Type::kDouble:
+      return static_cast<int64_t>(double_);
+    default:
+      throw std::runtime_error("json: not a number");
+  }
+}
+
+uint64_t Value::AsUint() const {
+  switch (type_) {
+    case Type::kInt:
+      if (int_ < 0) throw std::runtime_error("json: negative to uint");
+      return static_cast<uint64_t>(int_);
+    case Type::kUint:
+      return uint_;
+    case Type::kDouble:
+      return static_cast<uint64_t>(double_);
+    default:
+      throw std::runtime_error("json: not a number");
+  }
+}
+
+double Value::AsDouble() const {
+  switch (type_) {
+    case Type::kInt:
+      return static_cast<double>(int_);
+    case Type::kUint:
+      return static_cast<double>(uint_);
+    case Type::kDouble:
+      return double_;
+    default:
+      throw std::runtime_error("json: not a number");
+  }
+}
+
+const std::string& Value::AsString() const {
+  if (type_ != Type::kString) throw std::runtime_error("json: not a string");
+  return *str_;
+}
+
+const Array& Value::AsArray() const {
+  if (type_ != Type::kArray) throw std::runtime_error("json: not an array");
+  return *array_;
+}
+Array& Value::AsArray() {
+  if (type_ != Type::kArray) throw std::runtime_error("json: not an array");
+  return *array_;
+}
+const Object& Value::AsObject() const {
+  if (type_ != Type::kObject) throw std::runtime_error("json: not an object");
+  return *object_;
+}
+Object& Value::AsObject() {
+  if (type_ != Type::kObject) throw std::runtime_error("json: not an object");
+  return *object_;
+}
+
+const Value& Value::operator[](const std::string& key) const {
+  if (type_ != Type::kObject) return kNullValue;
+  const Value* v = object_->Find(key);
+  return v ? *v : kNullValue;
+}
+
+bool Value::Has(const std::string& key) const {
+  return type_ == Type::kObject && object_->Has(key);
+}
+
+Value& Object::operator[](const std::string& key) {
+  for (auto& e : entries_) {
+    if (e.first == key) return e.second;
+  }
+  entries_.emplace_back(key, Value());
+  return entries_.back().second;
+}
+
+const Value* Object::Find(const std::string& key) const {
+  for (const auto& e : entries_) {
+    if (e.first == key) return &e.second;
+  }
+  return nullptr;
+}
+
+void Object::Set(const std::string& key, Value v) {
+  (*this)[key] = std::move(v);
+}
+
+// ---------------------------------------------------------------- writer
+
+static void WriteEscaped(const std::string& s, std::string* out) {
+  out->push_back('"');
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"':
+        out->append("\\\"");
+        break;
+      case '\\':
+        out->append("\\\\");
+        break;
+      case '\b':
+        out->append("\\b");
+        break;
+      case '\f':
+        out->append("\\f");
+        break;
+      case '\n':
+        out->append("\\n");
+        break;
+      case '\r':
+        out->append("\\r");
+        break;
+      case '\t':
+        out->append("\\t");
+        break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out->append(buf);
+        } else {
+          out->push_back(static_cast<char>(c));
+        }
+    }
+  }
+  out->push_back('"');
+}
+
+void Value::SerializeTo(std::string* out) const {
+  switch (type_) {
+    case Type::kNull:
+      out->append("null");
+      break;
+    case Type::kBool:
+      out->append(bool_ ? "true" : "false");
+      break;
+    case Type::kInt: {
+      char buf[32];
+      snprintf(buf, sizeof(buf), "%lld", static_cast<long long>(int_));
+      out->append(buf);
+      break;
+    }
+    case Type::kUint: {
+      char buf[32];
+      snprintf(buf, sizeof(buf), "%llu", static_cast<unsigned long long>(uint_));
+      out->append(buf);
+      break;
+    }
+    case Type::kDouble: {
+      char buf[64];
+      if (std::isfinite(double_)) {
+        snprintf(buf, sizeof(buf), "%.17g", double_);
+      } else {
+        // JSON has no Inf/NaN; emit null like most writers.
+        snprintf(buf, sizeof(buf), "null");
+      }
+      out->append(buf);
+      break;
+    }
+    case Type::kString:
+      WriteEscaped(*str_, out);
+      break;
+    case Type::kArray: {
+      out->push_back('[');
+      bool first = true;
+      for (const auto& v : *array_) {
+        if (!first) out->push_back(',');
+        first = false;
+        v.SerializeTo(out);
+      }
+      out->push_back(']');
+      break;
+    }
+    case Type::kObject: {
+      out->push_back('{');
+      bool first = true;
+      for (const auto& e : object_->entries()) {
+        if (!first) out->push_back(',');
+        first = false;
+        WriteEscaped(e.first, out);
+        out->push_back(':');
+        e.second.SerializeTo(out);
+      }
+      out->push_back('}');
+      break;
+    }
+  }
+}
+
+std::string Value::Serialize() const {
+  std::string out;
+  SerializeTo(&out);
+  return out;
+}
+
+// ---------------------------------------------------------------- parser
+
+namespace {
+
+class Parser {
+ public:
+  Parser(const char* data, size_t len) : p_(data), end_(data + len) {}
+
+  std::string Run(Value* out) {
+    SkipWs();
+    std::string err = ParseValue(out);
+    if (!err.empty()) return err;
+    SkipWs();
+    if (p_ != end_) return Error("trailing characters");
+    return "";
+  }
+
+ private:
+  std::string Error(const std::string& what) {
+    return "json parse error at offset " +
+           std::to_string(static_cast<size_t>(p_ - start_)) + ": " + what;
+  }
+
+  void SkipWs() {
+    while (p_ != end_ &&
+           (*p_ == ' ' || *p_ == '\t' || *p_ == '\n' || *p_ == '\r')) {
+      ++p_;
+    }
+  }
+
+  bool Consume(char c) {
+    if (p_ != end_ && *p_ == c) {
+      ++p_;
+      return true;
+    }
+    return false;
+  }
+
+  std::string ParseValue(Value* out) {
+    if (p_ == end_) return Error("unexpected end of input");
+    switch (*p_) {
+      case '{':
+        return ParseObject(out);
+      case '[':
+        return ParseArray(out);
+      case '"': {
+        std::string s;
+        std::string err = ParseString(&s);
+        if (!err.empty()) return err;
+        *out = Value(std::move(s));
+        return "";
+      }
+      case 't':
+        if (end_ - p_ >= 4 && memcmp(p_, "true", 4) == 0) {
+          p_ += 4;
+          *out = Value(true);
+          return "";
+        }
+        return Error("invalid literal");
+      case 'f':
+        if (end_ - p_ >= 5 && memcmp(p_, "false", 5) == 0) {
+          p_ += 5;
+          *out = Value(false);
+          return "";
+        }
+        return Error("invalid literal");
+      case 'n':
+        if (end_ - p_ >= 4 && memcmp(p_, "null", 4) == 0) {
+          p_ += 4;
+          *out = Value();
+          return "";
+        }
+        return Error("invalid literal");
+      default:
+        return ParseNumber(out);
+    }
+  }
+
+  std::string ParseObject(Value* out) {
+    ++p_;  // '{'
+    Object obj;
+    SkipWs();
+    if (Consume('}')) {
+      *out = Value(std::move(obj));
+      return "";
+    }
+    while (true) {
+      SkipWs();
+      if (p_ == end_ || *p_ != '"') return Error("expected object key");
+      std::string key;
+      std::string err = ParseString(&key);
+      if (!err.empty()) return err;
+      SkipWs();
+      if (!Consume(':')) return Error("expected ':'");
+      SkipWs();
+      Value v;
+      err = ParseValue(&v);
+      if (!err.empty()) return err;
+      obj.entries().emplace_back(std::move(key), std::move(v));
+      SkipWs();
+      if (Consume(',')) continue;
+      if (Consume('}')) break;
+      return Error("expected ',' or '}'");
+    }
+    *out = Value(std::move(obj));
+    return "";
+  }
+
+  std::string ParseArray(Value* out) {
+    ++p_;  // '['
+    Array arr;
+    SkipWs();
+    if (Consume(']')) {
+      *out = Value(std::move(arr));
+      return "";
+    }
+    while (true) {
+      SkipWs();
+      Value v;
+      std::string err = ParseValue(&v);
+      if (!err.empty()) return err;
+      arr.push_back(std::move(v));
+      SkipWs();
+      if (Consume(',')) continue;
+      if (Consume(']')) break;
+      return Error("expected ',' or ']'");
+    }
+    *out = Value(std::move(arr));
+    return "";
+  }
+
+  static void AppendUtf8(uint32_t cp, std::string* s) {
+    if (cp < 0x80) {
+      s->push_back(static_cast<char>(cp));
+    } else if (cp < 0x800) {
+      s->push_back(static_cast<char>(0xC0 | (cp >> 6)));
+      s->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+    } else if (cp < 0x10000) {
+      s->push_back(static_cast<char>(0xE0 | (cp >> 12)));
+      s->push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+      s->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+    } else {
+      s->push_back(static_cast<char>(0xF0 | (cp >> 18)));
+      s->push_back(static_cast<char>(0x80 | ((cp >> 12) & 0x3F)));
+      s->push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+      s->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+    }
+  }
+
+  std::string ParseHex4(uint32_t* out) {
+    if (end_ - p_ < 4) return Error("truncated \\u escape");
+    uint32_t v = 0;
+    for (int i = 0; i < 4; ++i) {
+      char c = *p_++;
+      v <<= 4;
+      if (c >= '0' && c <= '9')
+        v |= static_cast<uint32_t>(c - '0');
+      else if (c >= 'a' && c <= 'f')
+        v |= static_cast<uint32_t>(c - 'a' + 10);
+      else if (c >= 'A' && c <= 'F')
+        v |= static_cast<uint32_t>(c - 'A' + 10);
+      else
+        return Error("bad \\u escape");
+    }
+    *out = v;
+    return "";
+  }
+
+  std::string ParseString(std::string* out) {
+    ++p_;  // '"'
+    while (true) {
+      if (p_ == end_) return Error("unterminated string");
+      unsigned char c = static_cast<unsigned char>(*p_);
+      if (c == '"') {
+        ++p_;
+        return "";
+      }
+      if (c == '\\') {
+        ++p_;
+        if (p_ == end_) return Error("truncated escape");
+        char e = *p_++;
+        switch (e) {
+          case '"':
+            out->push_back('"');
+            break;
+          case '\\':
+            out->push_back('\\');
+            break;
+          case '/':
+            out->push_back('/');
+            break;
+          case 'b':
+            out->push_back('\b');
+            break;
+          case 'f':
+            out->push_back('\f');
+            break;
+          case 'n':
+            out->push_back('\n');
+            break;
+          case 'r':
+            out->push_back('\r');
+            break;
+          case 't':
+            out->push_back('\t');
+            break;
+          case 'u': {
+            uint32_t cp;
+            std::string err = ParseHex4(&cp);
+            if (!err.empty()) return err;
+            if (cp >= 0xD800 && cp <= 0xDBFF) {
+              // Surrogate pair.
+              if (end_ - p_ < 6 || p_[0] != '\\' || p_[1] != 'u') {
+                return Error("unpaired surrogate");
+              }
+              p_ += 2;
+              uint32_t lo;
+              err = ParseHex4(&lo);
+              if (!err.empty()) return err;
+              if (lo < 0xDC00 || lo > 0xDFFF) {
+                return Error("invalid low surrogate");
+              }
+              cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+            }
+            AppendUtf8(cp, out);
+            break;
+          }
+          default:
+            return Error("bad escape character");
+        }
+      } else if (c < 0x20) {
+        return Error("control character in string");
+      } else {
+        out->push_back(static_cast<char>(c));
+        ++p_;
+      }
+    }
+  }
+
+  std::string ParseNumber(Value* out) {
+    const char* begin = p_;
+    bool negative = Consume('-');
+    bool is_double = false;
+    while (p_ != end_ && *p_ >= '0' && *p_ <= '9') ++p_;
+    if (p_ != end_ && *p_ == '.') {
+      is_double = true;
+      ++p_;
+      while (p_ != end_ && *p_ >= '0' && *p_ <= '9') ++p_;
+    }
+    if (p_ != end_ && (*p_ == 'e' || *p_ == 'E')) {
+      is_double = true;
+      ++p_;
+      if (p_ != end_ && (*p_ == '+' || *p_ == '-')) ++p_;
+      while (p_ != end_ && *p_ >= '0' && *p_ <= '9') ++p_;
+    }
+    if (p_ == begin || (negative && p_ == begin + 1)) {
+      return Error("invalid number");
+    }
+    std::string text(begin, static_cast<size_t>(p_ - begin));
+    if (is_double) {
+      *out = Value(strtod(text.c_str(), nullptr));
+      return "";
+    }
+    errno = 0;
+    if (negative) {
+      long long v = strtoll(text.c_str(), nullptr, 10);
+      if (errno == ERANGE) {
+        *out = Value(strtod(text.c_str(), nullptr));
+      } else {
+        *out = Value(static_cast<int64_t>(v));
+      }
+    } else {
+      unsigned long long v = strtoull(text.c_str(), nullptr, 10);
+      if (errno == ERANGE) {
+        *out = Value(strtod(text.c_str(), nullptr));
+      } else if (v <= static_cast<unsigned long long>(INT64_MAX)) {
+        *out = Value(static_cast<int64_t>(v));
+      } else {
+        *out = Value(static_cast<uint64_t>(v));
+      }
+    }
+    return "";
+  }
+
+  const char* p_;
+  const char* end_;
+  const char* start_ = p_;
+};
+
+}  // namespace
+
+std::string Parse(const char* data, size_t len, Value* out) {
+  Parser parser(data, len);
+  return parser.Run(out);
+}
+
+std::string Parse(const std::string& text, Value* out) {
+  return Parse(text.data(), text.size(), out);
+}
+
+}  // namespace json
+}  // namespace tpuclient

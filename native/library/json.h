@@ -1,0 +1,118 @@
+// Minimal JSON value / parser / writer for the TPU client stack.
+//
+// The reference links rapidjson (via triton-common TritonJson,
+// /root/reference/src/c++/library/http_client.cc); this image has no
+// JSON library, so we carry a small self-contained implementation.
+// Covers the full KServe-v2 REST surface: objects, arrays, strings
+// (with \uXXXX escapes), int64/uint64/double numbers, bool, null.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace tpuclient {
+namespace json {
+
+enum class Type { kNull, kBool, kInt, kUint, kDouble, kString, kArray, kObject };
+
+class Value;
+using Array = std::vector<Value>;
+// Preserves insertion order (KServe binary protocol depends on the
+// order of "inputs"/"outputs" entries matching appended raw buffers).
+class Object;
+
+class Value {
+ public:
+  Value() : type_(Type::kNull) {}
+  Value(std::nullptr_t) : type_(Type::kNull) {}
+  Value(bool b) : type_(Type::kBool), bool_(b) {}
+  Value(int i) : type_(Type::kInt), int_(i) {}
+  Value(int64_t i) : type_(Type::kInt), int_(i) {}
+  Value(uint64_t u) : type_(Type::kUint), uint_(u) {}
+  Value(double d) : type_(Type::kDouble), double_(d) {}
+  Value(const char* s);
+  Value(const std::string& s);
+  Value(std::string&& s);
+  Value(const Array& a);
+  Value(Array&& a);
+  Value(const Object& o);
+  Value(Object&& o);
+  Value(const Value& other);
+  Value(Value&& other) noexcept;
+  Value& operator=(const Value& other);
+  Value& operator=(Value&& other) noexcept;
+  ~Value();
+
+  Type type() const { return type_; }
+  bool IsNull() const { return type_ == Type::kNull; }
+  bool IsBool() const { return type_ == Type::kBool; }
+  bool IsNumber() const {
+    return type_ == Type::kInt || type_ == Type::kUint ||
+           type_ == Type::kDouble;
+  }
+  bool IsString() const { return type_ == Type::kString; }
+  bool IsArray() const { return type_ == Type::kArray; }
+  bool IsObject() const { return type_ == Type::kObject; }
+
+  bool AsBool() const;
+  int64_t AsInt() const;
+  uint64_t AsUint() const;
+  double AsDouble() const;
+  const std::string& AsString() const;
+  const Array& AsArray() const;
+  Array& AsArray();
+  const Object& AsObject() const;
+  Object& AsObject();
+
+  // Object convenience: returns member or null-Value if absent.
+  const Value& operator[](const std::string& key) const;
+  bool Has(const std::string& key) const;
+
+  std::string Serialize() const;
+  void SerializeTo(std::string* out) const;
+
+ private:
+  void Destroy();
+  void CopyFrom(const Value& other);
+  void MoveFrom(Value&& other);
+
+  Type type_;
+  union {
+    bool bool_;
+    int64_t int_;
+    uint64_t uint_;
+    double double_;
+  };
+  std::unique_ptr<std::string> str_;
+  std::unique_ptr<Array> array_;
+  std::unique_ptr<Object> object_;
+};
+
+class Object {
+ public:
+  using Entry = std::pair<std::string, Value>;
+
+  Value& operator[](const std::string& key);
+  const Value* Find(const std::string& key) const;
+  bool Has(const std::string& key) const { return Find(key) != nullptr; }
+  void Set(const std::string& key, Value v);
+
+  std::vector<Entry>& entries() { return entries_; }
+  const std::vector<Entry>& entries() const { return entries_; }
+  size_t size() const { return entries_.size(); }
+  bool empty() const { return entries_.empty(); }
+
+ private:
+  std::vector<Entry> entries_;
+};
+
+// Parses `text` into `out`. Returns empty string on success, else an
+// error description (with byte offset).
+std::string Parse(const std::string& text, Value* out);
+std::string Parse(const char* data, size_t len, Value* out);
+
+}  // namespace json
+}  // namespace tpuclient

@@ -1,0 +1,30 @@
+// POSIX shared-memory helpers (parity: reference
+// /root/reference/src/c++/library/shm_utils.h:38-64).
+#pragma once
+
+#include <cstddef>
+#include <string>
+
+#include "common.h"
+
+namespace tpuclient {
+
+// Creates a POSIX shared-memory region (shm_open + ftruncate) and
+// returns its fd.
+Error CreateSharedMemoryRegion(
+    const std::string& shm_key, size_t byte_size, int* shm_fd);
+
+// Maps `byte_size` bytes at `offset` of the region into this process.
+Error MapSharedMemory(
+    int shm_fd, size_t offset, size_t byte_size, void** shm_addr);
+
+// Closes the region fd.
+Error CloseSharedMemory(int shm_fd);
+
+// Removes the named region from the system.
+Error UnlinkSharedMemoryRegion(const std::string& shm_key);
+
+// Unmaps a mapping obtained from MapSharedMemory.
+Error UnmapSharedMemory(void* shm_addr, size_t byte_size);
+
+}  // namespace tpuclient

@@ -1,0 +1,32 @@
+// TPU HBM region handle — the TPU analogue of the reference's
+// cudaIpcMemHandle_t shim (/root/reference/src/c++/library/ipc.h).
+//
+// PJRT exposes no cross-process device-pointer handle, so a region
+// handle is a logical descriptor minted by the server's HBM arena
+// service (client_tpu/server/tpu_arena.py): the server owns the
+// jax.Array buffers and clients address them by region id. The raw
+// wire form is the JSON descriptor produced by the arena's
+// CreateRegion RPC and passed verbatim to
+// RegisterTpuSharedMemory (the slot the reference fills with a
+// base64 cudaIpcMemHandle_t, http_client.cc:1712).
+#pragma once
+
+#include <cstdint>
+#include <string>
+
+namespace tpuclient {
+
+struct TpuShmHandle {
+  // Opaque region id within the server's arena.
+  std::string region_id;
+  // Arena instance identity (guards against stale handles after a
+  // server restart).
+  std::string arena_id;
+  uint64_t byte_size = 0;
+  int64_t device_ordinal = 0;
+  // The serialized descriptor exactly as minted by the server; this
+  // is what travels in TpuSharedMemoryRegisterRequest.raw_handle.
+  std::string raw;
+};
+
+}  // namespace tpuclient

@@ -1,0 +1,157 @@
+// Minimal single-header test framework for the native unit tests.
+//
+// The reference vendors doctest (a ~6 kLoC public single header,
+// /root/reference/src/c++/perf_analyzer/doctest.h); this image has no
+// test library and we do not copy vendored code, so we carry a small
+// registration-macro framework with the same usage shape:
+//
+//   TEST_CASE("name") { CHECK(x == y); REQUIRE(!err); }
+//
+// A failing CHECK records and continues; a failing REQUIRE aborts the
+// test case. The runner prints per-case results and exits non-zero on
+// any failure. Filter cases with argv[1] substring.
+#pragma once
+
+#include <cstdio>
+#include <cstring>
+#include <functional>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace minitest {
+
+struct TestCase {
+  const char* name;
+  std::function<void()> fn;
+};
+
+inline std::vector<TestCase>& Registry() {
+  static std::vector<TestCase> cases;
+  return cases;
+}
+
+struct Registrar {
+  Registrar(const char* name, std::function<void()> fn) {
+    Registry().push_back({name, std::move(fn)});
+  }
+};
+
+struct Failure {
+  std::string message;
+};
+
+struct State {
+  int checks_failed = 0;
+  int checks_passed = 0;
+  std::vector<std::string> messages;
+};
+
+inline State*& Current() {
+  static State* s = nullptr;
+  return s;
+}
+
+inline void RecordFailure(
+    const char* kind, const char* expr, const char* file, int line,
+    const std::string& extra = "") {
+  std::ostringstream os;
+  os << file << ":" << line << ": " << kind << "(" << expr << ") failed";
+  if (!extra.empty()) os << " — " << extra;
+  Current()->checks_failed++;
+  Current()->messages.push_back(os.str());
+}
+
+inline int RunAll(int argc, char** argv) {
+  const char* filter = (argc > 1) ? argv[1] : nullptr;
+  int failed_cases = 0, ran = 0;
+  for (auto& tc : Registry()) {
+    if (filter && strstr(tc.name, filter) == nullptr) continue;
+    State state;
+    Current() = &state;
+    bool aborted = false;
+    try {
+      tc.fn();
+    } catch (const Failure&) {
+      aborted = true;
+    } catch (const std::exception& e) {
+      state.checks_failed++;
+      state.messages.push_back(
+          std::string("unhandled exception: ") + e.what());
+    }
+    ++ran;
+    if (state.checks_failed > 0) {
+      ++failed_cases;
+      printf("[FAIL] %s%s\n", tc.name, aborted ? " (aborted)" : "");
+      for (const auto& m : state.messages) printf("       %s\n", m.c_str());
+    } else {
+      printf("[ ok ] %s (%d checks)\n", tc.name, state.checks_passed);
+    }
+    Current() = nullptr;
+  }
+  printf(
+      "%d/%d test cases passed\n", ran - failed_cases, ran);
+  return failed_cases == 0 ? 0 : 1;
+}
+
+}  // namespace minitest
+
+#define MT_CONCAT_(a, b) a##b
+#define MT_CONCAT(a, b) MT_CONCAT_(a, b)
+
+#define TEST_CASE(name)                                                \
+  static void MT_CONCAT(mt_case_, __LINE__)();                         \
+  static ::minitest::Registrar MT_CONCAT(mt_reg_, __LINE__)(           \
+      name, MT_CONCAT(mt_case_, __LINE__));                            \
+  static void MT_CONCAT(mt_case_, __LINE__)()
+
+#define CHECK(expr)                                                    \
+  do {                                                                 \
+    if (expr) {                                                        \
+      ::minitest::Current()->checks_passed++;                          \
+    } else {                                                           \
+      ::minitest::RecordFailure("CHECK", #expr, __FILE__, __LINE__);   \
+    }                                                                  \
+  } while (0)
+
+#define CHECK_EQ(a, b)                                                 \
+  do {                                                                 \
+    auto _mta = (a);                                                   \
+    auto _mtb = (b);                                                   \
+    if (_mta == _mtb) {                                                \
+      ::minitest::Current()->checks_passed++;                          \
+    } else {                                                           \
+      std::ostringstream _os;                                          \
+      _os << "lhs=" << _mta << " rhs=" << _mtb;                        \
+      ::minitest::RecordFailure(                                       \
+          "CHECK_EQ", #a " == " #b, __FILE__, __LINE__, _os.str());    \
+    }                                                                  \
+  } while (0)
+
+#define REQUIRE(expr)                                                  \
+  do {                                                                 \
+    if (expr) {                                                        \
+      ::minitest::Current()->checks_passed++;                          \
+    } else {                                                           \
+      ::minitest::RecordFailure("REQUIRE", #expr, __FILE__, __LINE__); \
+      throw ::minitest::Failure{#expr};                                \
+    }                                                                  \
+  } while (0)
+
+// Requires a tpuclient::Error to be OK; prints its message otherwise.
+#define REQUIRE_OK(err_expr)                                           \
+  do {                                                                 \
+    auto _mterr = (err_expr);                                          \
+    if (_mterr.IsOk()) {                                               \
+      ::minitest::Current()->checks_passed++;                          \
+    } else {                                                           \
+      ::minitest::RecordFailure(                                       \
+          "REQUIRE_OK", #err_expr, __FILE__, __LINE__, _mterr.Message()); \
+      throw ::minitest::Failure{#err_expr};                            \
+    }                                                                  \
+  } while (0)
+
+#define MINITEST_MAIN                                                  \
+  int main(int argc, char** argv) {                                    \
+    return ::minitest::RunAll(argc, argv);                             \
+  }

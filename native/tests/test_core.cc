@@ -1,0 +1,191 @@
+// Unit tests for the core native library: JSON, base64, common data
+// model, shm_utils (test-strategy parity: reference tier-1 unit tests,
+// SURVEY.md §4).
+#include <unistd.h>
+
+#include <cstring>
+
+#include "../library/base64.h"
+#include "../library/common.h"
+#include "../library/json.h"
+#include "../library/shm_utils.h"
+#include "minitest.h"
+
+using namespace tpuclient;
+
+TEST_CASE("json: roundtrip scalars") {
+  json::Value v;
+  REQUIRE(json::Parse("{\"a\": 1, \"b\": -2.5, \"c\": true, \"d\": null, "
+                      "\"e\": \"hi\", \"f\": 18446744073709551615}",
+                      &v)
+              .empty());
+  CHECK_EQ(v["a"].AsInt(), 1);
+  CHECK_EQ(v["b"].AsDouble(), -2.5);
+  CHECK(v["c"].AsBool());
+  CHECK(v["d"].IsNull());
+  CHECK_EQ(v["e"].AsString(), "hi");
+  CHECK_EQ(v["f"].AsUint(), 18446744073709551615ull);
+}
+
+TEST_CASE("json: nested structures and order preservation") {
+  json::Object obj;
+  obj["z"] = json::Value(int64_t{1});
+  obj["a"] = json::Value("x");
+  json::Array arr;
+  arr.push_back(json::Value(obj));
+  arr.push_back(json::Value(3.5));
+  json::Value root{json::Value(arr)};
+  std::string s = root.Serialize();
+  CHECK_EQ(s, "[{\"z\":1,\"a\":\"x\"},3.5]");
+
+  json::Value back;
+  REQUIRE(json::Parse(s, &back).empty());
+  CHECK_EQ(back.AsArray()[0]["z"].AsInt(), 1);
+  CHECK_EQ(back.AsArray()[1].AsDouble(), 3.5);
+}
+
+TEST_CASE("json: string escapes") {
+  json::Value v;
+  REQUIRE(json::Parse("\"a\\n\\t\\\"\\u0041\\u00e9\\ud83d\\ude00\"", &v)
+              .empty());
+  CHECK_EQ(v.AsString(), std::string("a\n\t\"A\xc3\xa9\xf0\x9f\x98\x80"));
+  json::Value w{v.AsString()};
+  json::Value back;
+  REQUIRE(json::Parse(w.Serialize(), &back).empty());
+  CHECK_EQ(back.AsString(), v.AsString());
+}
+
+TEST_CASE("json: errors") {
+  json::Value v;
+  CHECK(!json::Parse("{\"a\": }", &v).empty());
+  CHECK(!json::Parse("[1,2", &v).empty());
+  CHECK(!json::Parse("", &v).empty());
+  CHECK(!json::Parse("{} extra", &v).empty());
+}
+
+TEST_CASE("base64: roundtrip") {
+  const char* cases[] = {"", "f", "fo", "foo", "foob", "fooba", "foobar"};
+  const char* expect[] = {"",     "Zg==", "Zm8=",    "Zm9v",
+                          "Zm9vYg==", "Zm9vYmE=", "Zm9vYmFy"};
+  for (int i = 0; i < 7; ++i) {
+    CHECK_EQ(Base64Encode(std::string(cases[i])), std::string(expect[i]));
+    std::string dec;
+    REQUIRE(Base64Decode(expect[i], &dec));
+    CHECK_EQ(dec, std::string(cases[i]));
+  }
+  std::string bin;
+  for (int i = 0; i < 256; ++i) bin.push_back(static_cast<char>(i));
+  std::string dec;
+  REQUIRE(Base64Decode(Base64Encode(bin), &dec));
+  CHECK(dec == bin);
+}
+
+TEST_CASE("common: InferInput raw append and chunk iteration") {
+  InferInput* input = nullptr;
+  REQUIRE_OK(InferInput::Create(&input, "in0", {2, 2}, "FP32"));
+  std::unique_ptr<InferInput> guard(input);
+  float a[2] = {1.0f, 2.0f};
+  float b[2] = {3.0f, 4.0f};
+  REQUIRE_OK(input->AppendRaw(reinterpret_cast<uint8_t*>(a), sizeof(a)));
+  REQUIRE_OK(input->AppendRaw(reinterpret_cast<uint8_t*>(b), sizeof(b)));
+  CHECK_EQ(input->ByteSize(), sizeof(a) + sizeof(b));
+
+  input->PrepareForRequest();
+  const uint8_t* buf;
+  size_t len;
+  size_t total = 0;
+  int chunks = 0;
+  while (input->GetNext(&buf, &len)) {
+    total += len;
+    ++chunks;
+  }
+  CHECK_EQ(total, sizeof(a) + sizeof(b));
+  CHECK_EQ(chunks, 2);
+
+  std::string gathered;
+  input->GatherInto(&gathered);
+  CHECK_EQ(gathered.size(), sizeof(a) + sizeof(b));
+  CHECK(memcmp(gathered.data(), a, sizeof(a)) == 0);
+}
+
+TEST_CASE("common: InferInput BYTES serialization") {
+  InferInput* input = nullptr;
+  REQUIRE_OK(InferInput::Create(&input, "in0", {2}, "BYTES"));
+  std::unique_ptr<InferInput> guard(input);
+  REQUIRE_OK(input->AppendFromString({"ab", "xyz"}));
+  std::string wire;
+  input->GatherInto(&wire);
+  // 4-byte LE length prefix per element.
+  REQUIRE(wire.size() == 4 + 2 + 4 + 3);
+  CHECK_EQ(static_cast<int>(wire[0]), 2);
+  CHECK_EQ(wire.substr(4, 2), "ab");
+  CHECK_EQ(static_cast<int>(wire[6]), 3);
+  CHECK_EQ(wire.substr(10, 3), "xyz");
+
+  InferInput* nonbytes = nullptr;
+  REQUIRE_OK(InferInput::Create(&nonbytes, "in1", {2}, "FP32"));
+  std::unique_ptr<InferInput> guard2(nonbytes);
+  CHECK(!nonbytes->AppendFromString({"x"}).IsOk());
+}
+
+TEST_CASE("common: shared memory routing") {
+  InferInput* input = nullptr;
+  REQUIRE_OK(InferInput::Create(&input, "in0", {4}, "FP32"));
+  std::unique_ptr<InferInput> guard(input);
+  CHECK(!input->IsSharedMemory());
+  REQUIRE_OK(input->SetSharedMemory("region0", 64, 16));
+  CHECK(input->IsSharedMemory());
+  std::string name;
+  size_t sz, off;
+  REQUIRE_OK(input->SharedMemoryInfo(&name, &sz, &off));
+  CHECK_EQ(name, "region0");
+  CHECK_EQ(sz, 64u);
+  CHECK_EQ(off, 16u);
+  REQUIRE_OK(input->Reset());
+  CHECK(!input->IsSharedMemory());
+
+  InferRequestedOutput* output = nullptr;
+  REQUIRE_OK(InferRequestedOutput::Create(&output, "out0"));
+  std::unique_ptr<InferRequestedOutput> oguard(output);
+  REQUIRE_OK(output->SetSharedMemory("region1", 128));
+  CHECK(output->IsSharedMemory());
+  REQUIRE_OK(output->UnsetSharedMemory());
+  CHECK(!output->IsSharedMemory());
+}
+
+TEST_CASE("common: RequestTimers durations") {
+  RequestTimers t;
+  t.SetTimestamp(RequestTimers::Kind::REQUEST_START, 100);
+  t.SetTimestamp(RequestTimers::Kind::REQUEST_END, 350);
+  CHECK_EQ(
+      t.Duration(
+          RequestTimers::Kind::REQUEST_START, RequestTimers::Kind::REQUEST_END),
+      250u);
+  // Reversed order clamps to 0 rather than underflowing.
+  CHECK_EQ(
+      t.Duration(
+          RequestTimers::Kind::REQUEST_END, RequestTimers::Kind::REQUEST_START),
+      0u);
+}
+
+TEST_CASE("shm_utils: create/map/write/read/unlink") {
+  std::string key = "/tpuclient_test_" + std::to_string(getpid());
+  int fd = -1;
+  REQUIRE_OK(CreateSharedMemoryRegion(key, 4096, &fd));
+  void* addr = nullptr;
+  REQUIRE_OK(MapSharedMemory(fd, 0, 4096, &addr));
+  memcpy(addr, "hello", 5);
+
+  // Second mapping sees the data (cross-mapping visibility).
+  void* addr2 = nullptr;
+  REQUIRE_OK(MapSharedMemory(fd, 0, 4096, &addr2));
+  CHECK(memcmp(addr2, "hello", 5) == 0);
+
+  REQUIRE_OK(UnmapSharedMemory(addr, 4096));
+  REQUIRE_OK(UnmapSharedMemory(addr2, 4096));
+  REQUIRE_OK(CloseSharedMemory(fd));
+  REQUIRE_OK(UnlinkSharedMemoryRegion(key));
+  CHECK(!UnlinkSharedMemoryRegion(key).IsOk());
+}
+
+MINITEST_MAIN

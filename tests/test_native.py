@@ -1,0 +1,55 @@
+"""Builds the native (C++) layer and runs its unit-test binaries.
+
+Mirrors the reference's tier-1 strategy (SURVEY.md §4: doctest unit
+binaries run by CTest) — here each native test binary is exposed as
+one pytest case so `python -m pytest tests/` covers the C++ layer too.
+"""
+
+import pathlib
+import shutil
+import subprocess
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+NATIVE = REPO / "native"
+BUILD = NATIVE / "build"
+
+
+def _build_native():
+    if shutil.which("cmake") is None or shutil.which("ninja") is None:
+        pytest.skip("cmake/ninja not available")
+    if not (BUILD / "build.ninja").exists():
+        subprocess.run(
+            ["cmake", "-S", str(NATIVE), "-B", str(BUILD), "-G", "Ninja"],
+            check=True, capture_output=True,
+        )
+    proc = subprocess.run(
+        ["ninja", "-C", str(BUILD)], capture_output=True, text=True
+    )
+    if proc.returncode != 0:
+        raise AssertionError(
+            "native build failed:\n%s\n%s" % (proc.stdout[-4000:],
+                                              proc.stderr[-4000:])
+        )
+
+
+@pytest.fixture(scope="session")
+def native_build():
+    _build_native()
+    return BUILD
+
+
+def _run_binary(build_dir: pathlib.Path, name: str):
+    binary = build_dir / name
+    assert binary.exists(), "%s not built" % name
+    proc = subprocess.run(
+        [str(binary)], capture_output=True, text=True, timeout=300
+    )
+    assert proc.returncode == 0, "%s failed:\n%s\n%s" % (
+        name, proc.stdout[-4000:], proc.stderr[-4000:]
+    )
+
+
+def test_native_core(native_build):
+    _run_binary(native_build, "test_core")
