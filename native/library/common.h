@@ -12,6 +12,7 @@
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
+#include <deque>
 #include <functional>
 #include <map>
 #include <memory>
@@ -181,8 +182,10 @@ class InferInput {
   size_t byte_size_ = 0;
 
   std::vector<std::pair<const uint8_t*, size_t>> bufs_;
-  // Backing store for AppendFromString serialization.
-  std::vector<std::string> str_bufs_;
+  // Backing store for AppendFromString serialization. A deque keeps
+  // element addresses stable across later appends (bufs_ holds raw
+  // pointers into these strings).
+  std::deque<std::string> str_bufs_;
   size_t total_send_byte_size_ = 0;
   size_t bufs_idx_ = 0;
   size_t buf_pos_ = 0;

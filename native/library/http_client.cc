@@ -1,0 +1,897 @@
+#include "http_client.h"
+
+#include <algorithm>
+#include <sstream>
+
+#include "base64.h"
+#include "http_transport.h"
+
+namespace tpuclient {
+
+namespace {
+
+// URI builders matching the server routes
+// (client_tpu/http/_endpoints.py — single source of truth for /v2).
+std::string ModelPath(const std::string& name, const std::string& version) {
+  std::string p = "/v2/models/" + name;
+  if (!version.empty()) p += "/versions/" + version;
+  return p;
+}
+
+std::string AppendQuery(std::string path, const Parameters& query_params) {
+  bool first = true;
+  for (const auto& q : query_params) {
+    path += (first ? "?" : "&");
+    path += q.first + "=" + q.second;
+    first = false;
+  }
+  return path;
+}
+
+Error ErrorFromResponse(const HttpResponse& response) {
+  if (response.status_code >= 200 && response.status_code < 300) {
+    return Error::Success;
+  }
+  json::Value parsed;
+  std::string detail = response.body;
+  if (json::Parse(response.body, &parsed).empty() && parsed.Has("error")) {
+    detail = parsed["error"].AsString();
+  }
+  return Error(
+      "HTTP " + std::to_string(response.status_code) + ": " + detail);
+}
+
+json::Value ParamValue(const std::string& s) { return json::Value(s); }
+
+}  // namespace
+
+//==============================================================================
+// InferResultHttp
+
+Error InferResultHttp::Create(
+    InferResult** result, std::string&& body, size_t header_length,
+    const Error& request_status) {
+  auto* r = new InferResultHttp();
+  r->status_ = request_status;
+  r->body_ = std::move(body);
+  size_t json_end = (header_length != 0) ? header_length : r->body_.size();
+  if (!request_status.IsOk()) {
+    *result = r;
+    return Error::Success;
+  }
+  std::string err =
+      json::Parse(r->body_.data(), json_end, &r->header_);
+  if (!err.empty()) {
+    r->status_ = Error("failed to parse inference response: " + err);
+    *result = r;
+    return Error::Success;
+  }
+  // JSON accessors throw on shape mismatches; convert any
+  // unexpected-shape response into an error status instead of
+  // letting the exception escape (it would terminate async workers).
+  try {
+    const uint8_t* base = reinterpret_cast<const uint8_t*>(r->body_.data());
+    size_t binary_offset = json_end;
+    if (r->header_.Has("outputs")) {
+      for (const auto& entry : r->header_["outputs"].AsArray()) {
+        Output out;
+        const std::string& name = entry["name"].AsString();
+        if (entry.Has("datatype")) out.datatype = entry["datatype"].AsString();
+        if (entry.Has("shape")) {
+          for (const auto& d : entry["shape"].AsArray()) {
+            out.shape.push_back(d.AsInt());
+          }
+        }
+        const json::Value& params = entry["parameters"];
+        if (params.Has("shared_memory_region")) {
+          out.in_shm = true;
+        } else if (params.Has("binary_data_size")) {
+          size_t size = params["binary_data_size"].AsUint();
+          // Overflow-safe bounds check (binary_offset <= body size).
+          if (size > r->body_.size() - binary_offset) {
+            r->status_ = Error("binary output '" + name + "' truncated");
+            break;
+          }
+          out.raw = base + binary_offset;
+          out.raw_size = size;
+          binary_offset += size;
+        } else if (entry.Has("data")) {
+          out.json_data = entry["data"];
+        }
+        r->outputs_.emplace(name, std::move(out));
+      }
+    }
+  } catch (const std::exception& e) {
+    r->status_ = Error(
+        std::string("malformed inference response: ") + e.what());
+  }
+  *result = r;
+  return Error::Success;
+}
+
+Error InferResultHttp::ModelName(std::string* name) const {
+  if (!status_.IsOk()) return status_;
+  *name = header_["model_name"].IsString() ? header_["model_name"].AsString()
+                                           : "";
+  return Error::Success;
+}
+
+Error InferResultHttp::ModelVersion(std::string* version) const {
+  if (!status_.IsOk()) return status_;
+  *version = header_["model_version"].IsString()
+                 ? header_["model_version"].AsString()
+                 : "";
+  return Error::Success;
+}
+
+Error InferResultHttp::Id(std::string* id) const {
+  if (!status_.IsOk()) return status_;
+  *id = header_["id"].IsString() ? header_["id"].AsString() : "";
+  return Error::Success;
+}
+
+Error InferResultHttp::FindOutput(
+    const std::string& name, const Output** out) const {
+  if (!status_.IsOk()) return status_;
+  auto it = outputs_.find(name);
+  if (it == outputs_.end()) {
+    return Error("output '" + name + "' not found in response");
+  }
+  *out = &it->second;
+  return Error::Success;
+}
+
+Error InferResultHttp::Shape(
+    const std::string& output_name, std::vector<int64_t>* shape) const {
+  const Output* out;
+  Error err = FindOutput(output_name, &out);
+  if (!err.IsOk()) return err;
+  *shape = out->shape;
+  return Error::Success;
+}
+
+Error InferResultHttp::Datatype(
+    const std::string& output_name, std::string* datatype) const {
+  const Output* out;
+  Error err = FindOutput(output_name, &out);
+  if (!err.IsOk()) return err;
+  *datatype = out->datatype;
+  return Error::Success;
+}
+
+Error InferResultHttp::RawData(
+    const std::string& output_name, const uint8_t** buf,
+    size_t* byte_size) const {
+  const Output* out;
+  Error err = FindOutput(output_name, &out);
+  if (!err.IsOk()) return err;
+  if (out->in_shm) {
+    return Error(
+        "output '" + output_name +
+        "' is in shared memory; read it from the region");
+  }
+  if (out->raw != nullptr) {
+    *buf = out->raw;
+    *byte_size = out->raw_size;
+    return Error::Success;
+  }
+  return Error(
+      "output '" + output_name +
+      "' was returned as JSON data; use result JSON accessors");
+}
+
+Error InferResultHttp::StringData(
+    const std::string& output_name,
+    std::vector<std::string>* string_result) const {
+  const Output* out;
+  Error err = FindOutput(output_name, &out);
+  if (!err.IsOk()) return err;
+  string_result->clear();
+  if (out->raw != nullptr) {
+    // BYTES wire format: 4-byte LE length prefix per element.
+    size_t pos = 0;
+    while (pos + 4 <= out->raw_size) {
+      uint32_t len = static_cast<uint32_t>(out->raw[pos]) |
+                     (static_cast<uint32_t>(out->raw[pos + 1]) << 8) |
+                     (static_cast<uint32_t>(out->raw[pos + 2]) << 16) |
+                     (static_cast<uint32_t>(out->raw[pos + 3]) << 24);
+      pos += 4;
+      if (pos + len > out->raw_size) {
+        return Error("malformed BYTES output '" + output_name + "'");
+      }
+      string_result->emplace_back(
+          reinterpret_cast<const char*>(out->raw + pos), len);
+      pos += len;
+    }
+    return Error::Success;
+  }
+  if (out->json_data.IsArray()) {
+    for (const auto& v : out->json_data.AsArray()) {
+      string_result->push_back(v.IsString() ? v.AsString() : v.Serialize());
+    }
+    return Error::Success;
+  }
+  return Error("output '" + output_name + "' has no string data");
+}
+
+std::string InferResultHttp::DebugString() const {
+  if (!status_.IsOk()) return "error: " + status_.Message();
+  return header_.Serialize();
+}
+
+Error InferResultHttp::RequestStatus() const { return status_; }
+
+//==============================================================================
+// InferenceServerHttpClient
+
+Error InferenceServerHttpClient::Create(
+    std::unique_ptr<InferenceServerHttpClient>* client, const std::string& url,
+    bool verbose) {
+  client->reset(new InferenceServerHttpClient(url, verbose));
+  if ((*client)->port_ == 0) {
+    client->reset();
+    return Error("invalid url '" + url + "': expected host:port");
+  }
+  return Error::Success;
+}
+
+InferenceServerHttpClient::InferenceServerHttpClient(
+    const std::string& url, bool verbose)
+    : InferenceServerClient(verbose) {
+  // Strip optional scheme.
+  std::string rest = url;
+  size_t scheme = rest.find("://");
+  if (scheme != std::string::npos) rest = rest.substr(scheme + 3);
+  size_t colon = rest.rfind(':');
+  if (colon != std::string::npos) {
+    host_ = rest.substr(0, colon);
+    port_ = atoi(rest.c_str() + colon + 1);
+  } else {
+    host_ = rest;
+    port_ = 8000;
+  }
+  sync_conn_.reset(new HttpConnection(host_, port_));
+}
+
+InferenceServerHttpClient::~InferenceServerHttpClient() {
+  {
+    std::lock_guard<std::mutex> lk(async_mutex_);
+    async_exiting_ = true;
+  }
+  async_cv_.notify_all();
+  for (auto& w : async_workers_) {
+    if (w.joinable()) w.join();
+  }
+}
+
+Error InferenceServerHttpClient::DoRequest(
+    const std::string& method, const std::string& path,
+    const std::string& body, const Headers& headers,
+    const std::string& content_type, size_t json_header_length,
+    std::string* response_body, size_t* response_header_length,
+    HttpConnection* conn, uint64_t timeout_us, uint64_t* sent_ns) {
+  std::map<std::string, std::string> hdrs(headers.begin(), headers.end());
+  if (!content_type.empty()) hdrs["Content-Type"] = content_type;
+  if (json_header_length != 0) {
+    hdrs["Inference-Header-Content-Length"] =
+        std::to_string(json_header_length);
+  }
+  HttpResponse response;
+  std::string terr =
+      conn->Request(method, path, hdrs, body, &response, timeout_us, sent_ns);
+  if (!terr.empty()) return Error(terr);
+  Error err = ErrorFromResponse(response);
+  if (!err.IsOk()) return err;
+  if (response_header_length != nullptr) {
+    auto it = response.headers.find("inference-header-content-length");
+    *response_header_length =
+        (it != response.headers.end())
+            ? strtoull(it->second.c_str(), nullptr, 10)
+            : 0;
+  }
+  *response_body = std::move(response.body);
+  return Error::Success;
+}
+
+Error InferenceServerHttpClient::Get(
+    const std::string& path, const Headers& headers, std::string* response,
+    json::Value* parsed) {
+  std::lock_guard<std::mutex> lk(sync_mutex_);
+  std::string body;
+  Error err = DoRequest(
+      "GET", path, "", headers, "", 0, &body, nullptr, sync_conn_.get(), 0);
+  if (!err.IsOk()) return err;
+  if (parsed != nullptr && !body.empty()) {
+    std::string jerr = json::Parse(body, parsed);
+    if (!jerr.empty()) return Error(jerr);
+  }
+  if (response != nullptr) *response = std::move(body);
+  return Error::Success;
+}
+
+Error InferenceServerHttpClient::Post(
+    const std::string& path, const std::string& body, const Headers& headers,
+    std::string* response, json::Value* parsed) {
+  std::lock_guard<std::mutex> lk(sync_mutex_);
+  std::string response_body;
+  Error err = DoRequest(
+      "POST", path, body, headers, "application/json", 0, &response_body,
+      nullptr, sync_conn_.get(), 0);
+  if (!err.IsOk()) return err;
+  if (parsed != nullptr && !response_body.empty()) {
+    std::string jerr = json::Parse(response_body, parsed);
+    if (!jerr.empty()) return Error(jerr);
+  }
+  if (response != nullptr) *response = std::move(response_body);
+  return Error::Success;
+}
+
+Error InferenceServerHttpClient::IsServerLive(bool* live, const Headers& headers) {
+  Error err = Get("/v2/health/live", headers, nullptr, nullptr);
+  *live = err.IsOk();
+  if (!err.IsOk() && err.Message().rfind("HTTP", 0) != 0) return err;
+  return Error::Success;
+}
+
+Error InferenceServerHttpClient::IsServerReady(bool* ready, const Headers& headers) {
+  Error err = Get("/v2/health/ready", headers, nullptr, nullptr);
+  *ready = err.IsOk();
+  if (!err.IsOk() && err.Message().rfind("HTTP", 0) != 0) return err;
+  return Error::Success;
+}
+
+Error InferenceServerHttpClient::IsModelReady(
+    bool* ready, const std::string& model_name,
+    const std::string& model_version, const Headers& headers) {
+  Error err = Get(
+      ModelPath(model_name, model_version) + "/ready", headers, nullptr,
+      nullptr);
+  *ready = err.IsOk();
+  if (!err.IsOk() && err.Message().rfind("HTTP", 0) != 0) return err;
+  return Error::Success;
+}
+
+Error InferenceServerHttpClient::ServerMetadata(
+    std::string* server_metadata, const Headers& headers) {
+  return Get("/v2", headers, server_metadata, nullptr);
+}
+
+Error InferenceServerHttpClient::ModelMetadata(
+    std::string* model_metadata, const std::string& model_name,
+    const std::string& model_version, const Headers& headers) {
+  return Get(
+      ModelPath(model_name, model_version), headers, model_metadata, nullptr);
+}
+
+Error InferenceServerHttpClient::ModelConfig(
+    std::string* model_config, const std::string& model_name,
+    const std::string& model_version, const Headers& headers) {
+  return Get(
+      ModelPath(model_name, model_version) + "/config", headers, model_config,
+      nullptr);
+}
+
+Error InferenceServerHttpClient::ModelRepositoryIndex(
+    std::string* repository_index, const Headers& headers) {
+  return Post("/v2/repository/index", "{}", headers, repository_index, nullptr);
+}
+
+Error InferenceServerHttpClient::LoadModel(
+    const std::string& model_name, const Headers& headers,
+    const std::string& config) {
+  std::string body = "{}";
+  if (!config.empty()) {
+    json::Object params;
+    params["config"] = json::Value(config);
+    json::Object root;
+    root["parameters"] = json::Value(std::move(params));
+    body = json::Value(std::move(root)).Serialize();
+  }
+  return Post(
+      "/v2/repository/models/" + model_name + "/load", body, headers, nullptr,
+      nullptr);
+}
+
+Error InferenceServerHttpClient::UnloadModel(
+    const std::string& model_name, const Headers& headers) {
+  return Post(
+      "/v2/repository/models/" + model_name + "/unload", "{}", headers,
+      nullptr, nullptr);
+}
+
+Error InferenceServerHttpClient::ModelInferenceStatistics(
+    std::string* infer_stat, const std::string& model_name,
+    const std::string& model_version, const Headers& headers) {
+  std::string path = model_name.empty()
+                         ? "/v2/models/stats"
+                         : ModelPath(model_name, model_version) + "/stats";
+  return Get(path, headers, infer_stat, nullptr);
+}
+
+Error InferenceServerHttpClient::UpdateTraceSettings(
+    std::string* response, const std::string& model_name,
+    const std::map<std::string, std::vector<std::string>>& settings,
+    const Headers& headers) {
+  json::Object obj;
+  for (const auto& s : settings) {
+    json::Array values;
+    for (const auto& v : s.second) values.push_back(ParamValue(v));
+    obj[s.first] = json::Value(std::move(values));
+  }
+  std::string path = model_name.empty()
+                         ? "/v2/trace/setting"
+                         : "/v2/models/" + model_name + "/trace/setting";
+  return Post(
+      path, json::Value(std::move(obj)).Serialize(), headers, response,
+      nullptr);
+}
+
+Error InferenceServerHttpClient::GetTraceSettings(
+    std::string* settings, const std::string& model_name,
+    const Headers& headers) {
+  std::string path = model_name.empty()
+                         ? "/v2/trace/setting"
+                         : "/v2/models/" + model_name + "/trace/setting";
+  return Get(path, headers, settings, nullptr);
+}
+
+Error InferenceServerHttpClient::UpdateLogSettings(
+    std::string* response, const std::map<std::string, std::string>& settings,
+    const Headers& headers) {
+  json::Object obj;
+  for (const auto& s : settings) obj[s.first] = json::Value(s.second);
+  return Post(
+      "/v2/logging", json::Value(std::move(obj)).Serialize(), headers,
+      response, nullptr);
+}
+
+Error InferenceServerHttpClient::GetLogSettings(
+    std::string* settings, const Headers& headers) {
+  return Get("/v2/logging", headers, settings, nullptr);
+}
+
+Error InferenceServerHttpClient::SystemSharedMemoryStatus(
+    std::string* status, const std::string& region_name,
+    const Headers& headers) {
+  std::string path =
+      region_name.empty()
+          ? "/v2/systemsharedmemory/status"
+          : "/v2/systemsharedmemory/region/" + region_name + "/status";
+  return Get(path, headers, status, nullptr);
+}
+
+Error InferenceServerHttpClient::RegisterSystemSharedMemory(
+    const std::string& name, const std::string& key, size_t byte_size,
+    size_t offset, const Headers& headers) {
+  json::Object obj;
+  obj["key"] = json::Value(key);
+  obj["offset"] = json::Value(static_cast<uint64_t>(offset));
+  obj["byte_size"] = json::Value(static_cast<uint64_t>(byte_size));
+  return Post(
+      "/v2/systemsharedmemory/region/" + name + "/register",
+      json::Value(std::move(obj)).Serialize(), headers, nullptr, nullptr);
+}
+
+Error InferenceServerHttpClient::UnregisterSystemSharedMemory(
+    const std::string& name, const Headers& headers) {
+  std::string path =
+      name.empty() ? "/v2/systemsharedmemory/unregister"
+                   : "/v2/systemsharedmemory/region/" + name + "/unregister";
+  return Post(path, "{}", headers, nullptr, nullptr);
+}
+
+Error InferenceServerHttpClient::TpuSharedMemoryStatus(
+    std::string* status, const std::string& region_name,
+    const Headers& headers) {
+  std::string path =
+      region_name.empty()
+          ? "/v2/tpusharedmemory/status"
+          : "/v2/tpusharedmemory/region/" + region_name + "/status";
+  return Get(path, headers, status, nullptr);
+}
+
+Error InferenceServerHttpClient::RegisterTpuSharedMemory(
+    const std::string& name, const std::string& raw_handle, int64_t device_id,
+    size_t byte_size, const Headers& headers) {
+  // Wire shape parity with the reference's CUDA register
+  // (http_client.cc:1712): {"raw_handle": {"b64": ...}, "device_id":
+  // N, "byte_size": N}, with the TPU arena descriptor in the b64 slot.
+  json::Object handle;
+  handle["b64"] = json::Value(Base64Encode(raw_handle));
+  json::Object obj;
+  obj["raw_handle"] = json::Value(std::move(handle));
+  obj["device_id"] = json::Value(static_cast<int64_t>(device_id));
+  obj["byte_size"] = json::Value(static_cast<uint64_t>(byte_size));
+  return Post(
+      "/v2/tpusharedmemory/region/" + name + "/register",
+      json::Value(std::move(obj)).Serialize(), headers, nullptr, nullptr);
+}
+
+Error InferenceServerHttpClient::UnregisterTpuSharedMemory(
+    const std::string& name, const Headers& headers) {
+  std::string path =
+      name.empty() ? "/v2/tpusharedmemory/unregister"
+                   : "/v2/tpusharedmemory/region/" + name + "/unregister";
+  return Post(path, "{}", headers, nullptr, nullptr);
+}
+
+//==============================================================================
+// Inference request body
+
+Error InferenceServerHttpClient::GenerateRequestBody(
+    std::vector<char>* request_body, size_t* header_length,
+    const InferOptions& options, const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs) {
+  json::Object root;
+  if (!options.request_id.empty()) {
+    root["id"] = json::Value(options.request_id);
+  }
+
+  json::Object params;
+  if (options.sequence_id != 0) {
+    params["sequence_id"] = json::Value(options.sequence_id);
+    params["sequence_start"] = json::Value(options.sequence_start);
+    params["sequence_end"] = json::Value(options.sequence_end);
+  }
+  if (options.priority != 0) {
+    params["priority"] = json::Value(options.priority);
+  }
+  if (options.server_timeout_us != 0) {
+    params["timeout"] = json::Value(options.server_timeout_us);
+  }
+  for (const auto& p : options.string_params) {
+    params[p.first] = json::Value(p.second);
+  }
+  for (const auto& p : options.int_params) {
+    params[p.first] = json::Value(p.second);
+  }
+  for (const auto& p : options.bool_params) {
+    params[p.first] = json::Value(p.second);
+  }
+  for (const auto& p : options.double_params) {
+    params[p.first] = json::Value(p.second);
+  }
+  if (outputs.empty() && options.binary_data_output) {
+    // No explicit outputs: ask the server to return all outputs as
+    // binary (parity: reference http _get_inference_request
+    // binary_data_output default, http/_utils.py:115).
+    params["binary_data_output"] = json::Value(true);
+  }
+  if (!params.empty()) {
+    root["parameters"] = json::Value(std::move(params));
+  }
+
+  // Inputs: shm regions ride as parameters; raw tensors append to the
+  // binary section in declaration order.
+  std::vector<const InferInput*> binary_inputs;
+  json::Array input_entries;
+  for (InferInput* input : inputs) {
+    json::Object entry;
+    entry["name"] = json::Value(input->Name());
+    json::Array shape;
+    for (int64_t d : input->Shape()) shape.push_back(json::Value(d));
+    entry["shape"] = json::Value(std::move(shape));
+    entry["datatype"] = json::Value(input->Datatype());
+    json::Object tensor_params;
+    if (input->IsSharedMemory()) {
+      std::string region;
+      size_t byte_size, shm_offset;
+      input->SharedMemoryInfo(&region, &byte_size, &shm_offset);
+      tensor_params["shared_memory_region"] = json::Value(region);
+      tensor_params["shared_memory_byte_size"] =
+          json::Value(static_cast<uint64_t>(byte_size));
+      if (shm_offset != 0) {
+        tensor_params["shared_memory_offset"] =
+            json::Value(static_cast<uint64_t>(shm_offset));
+      }
+    } else {
+      tensor_params["binary_data_size"] =
+          json::Value(static_cast<uint64_t>(input->ByteSize()));
+      binary_inputs.push_back(input);
+    }
+    entry["parameters"] = json::Value(std::move(tensor_params));
+    input_entries.push_back(json::Value(std::move(entry)));
+  }
+  root["inputs"] = json::Value(std::move(input_entries));
+
+  if (!outputs.empty()) {
+    json::Array output_entries;
+    for (const InferRequestedOutput* output : outputs) {
+      json::Object entry;
+      entry["name"] = json::Value(output->Name());
+      json::Object tensor_params;
+      if (output->IsSharedMemory()) {
+        std::string region;
+        size_t byte_size, shm_offset;
+        output->SharedMemoryInfo(&region, &byte_size, &shm_offset);
+        tensor_params["shared_memory_region"] = json::Value(region);
+        tensor_params["shared_memory_byte_size"] =
+            json::Value(static_cast<uint64_t>(byte_size));
+        if (shm_offset != 0) {
+          tensor_params["shared_memory_offset"] =
+              json::Value(static_cast<uint64_t>(shm_offset));
+        }
+      } else {
+        tensor_params["binary_data"] = json::Value(output->BinaryData());
+      }
+      if (output->ClassCount() != 0) {
+        tensor_params["classification"] =
+            json::Value(static_cast<uint64_t>(output->ClassCount()));
+      }
+      entry["parameters"] = json::Value(std::move(tensor_params));
+      output_entries.push_back(json::Value(std::move(entry)));
+    }
+    root["outputs"] = json::Value(std::move(output_entries));
+  }
+
+  std::string json_text = json::Value(std::move(root)).Serialize();
+  *header_length = json_text.size();
+
+  size_t total = json_text.size();
+  for (const InferInput* input : binary_inputs) {
+    total += input->ByteSize();
+  }
+  request_body->clear();
+  request_body->reserve(total);
+  request_body->insert(
+      request_body->end(), json_text.begin(), json_text.end());
+  for (const InferInput* input : binary_inputs) {
+    const_cast<InferInput*>(input)->PrepareForRequest();
+    const uint8_t* buf;
+    size_t len;
+    while (const_cast<InferInput*>(input)->GetNext(&buf, &len)) {
+      request_body->insert(request_body->end(), buf, buf + len);
+    }
+  }
+  return Error::Success;
+}
+
+Error InferenceServerHttpClient::ParseResponseBody(
+    InferResult** result, std::vector<char>&& response_body,
+    size_t header_length) {
+  std::string body(response_body.data(), response_body.size());
+  return InferResultHttp::Create(result, std::move(body), header_length);
+}
+
+Error InferenceServerHttpClient::Infer(
+    InferResult** result, const InferOptions& options,
+    const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs,
+    const Headers& headers, const Parameters& query_params) {
+  RequestTimers timers;
+  timers.CaptureTimestamp(RequestTimers::Kind::REQUEST_START);
+
+  std::vector<char> body;
+  size_t header_length = 0;
+  Error err = GenerateRequestBody(&body, &header_length, options, inputs,
+                                  outputs);
+  if (!err.IsOk()) return err;
+
+  std::string path = AppendQuery(
+      ModelPath(options.model_name, options.model_version) + "/infer",
+      query_params);
+
+  timers.CaptureTimestamp(RequestTimers::Kind::SEND_START);
+  std::string response_body;
+  size_t response_header_length = 0;
+  uint64_t sent_ns = 0;
+  {
+    std::lock_guard<std::mutex> lk(sync_mutex_);
+    err = DoRequest(
+        "POST", path, std::string(body.data(), body.size()), headers,
+        "application/octet-stream", header_length, &response_body,
+        &response_header_length, sync_conn_.get(), options.client_timeout_us,
+        &sent_ns);
+  }
+  // Send ends when the request hit the socket; everything after is
+  // server + receive time.
+  if (sent_ns != 0) {
+    timers.SetTimestamp(RequestTimers::Kind::SEND_END, sent_ns);
+    timers.SetTimestamp(RequestTimers::Kind::RECV_START, sent_ns);
+  } else {
+    timers.CaptureTimestamp(RequestTimers::Kind::SEND_END);
+    timers.CaptureTimestamp(RequestTimers::Kind::RECV_START);
+  }
+
+  Error create_err = InferResultHttp::Create(
+      result, std::move(response_body), response_header_length, err);
+  timers.CaptureTimestamp(RequestTimers::Kind::RECV_END);
+  timers.CaptureTimestamp(RequestTimers::Kind::REQUEST_END);
+  if (create_err.IsOk() && err.IsOk()) UpdateInferStat(timers);
+  return create_err;
+}
+
+void InferenceServerHttpClient::SetAsyncWorkerCount(size_t count) {
+  std::lock_guard<std::mutex> lk(async_mutex_);
+  if (async_workers_.empty() && count > 0) {
+    async_worker_count_ = count;
+  }
+}
+
+void InferenceServerHttpClient::EnsureAsyncWorkers() {
+  std::lock_guard<std::mutex> lk(async_mutex_);
+  if (!async_workers_.empty()) return;
+  for (size_t i = 0; i < async_worker_count_; ++i) {
+    async_workers_.emplace_back(
+        [this]() { AsyncWorkerLoop(); });
+  }
+}
+
+void InferenceServerHttpClient::AsyncWorkerLoop() {
+  // Each worker owns its own connection — concurrent in-flight
+  // requests without sharing (the reference multiplexes via
+  // curl_multi; a per-worker connection achieves the same pipeline
+  // depth with simpler lifetime rules).
+  HttpConnection conn(host_, port_);
+  while (true) {
+    std::unique_ptr<AsyncRequest> req;
+    {
+      std::unique_lock<std::mutex> lk(async_mutex_);
+      async_cv_.wait(lk, [this]() {
+        return async_exiting_ || !async_queue_.empty();
+      });
+      if (async_exiting_ && async_queue_.empty()) return;
+      req = std::move(async_queue_.front());
+      async_queue_.pop_front();
+    }
+
+    req->timers.CaptureTimestamp(RequestTimers::Kind::SEND_START);
+    std::map<std::string, std::string> hdrs(
+        req->headers.begin(), req->headers.end());
+    hdrs["Content-Type"] = "application/octet-stream";
+    if (req->header_length != 0) {
+      hdrs["Inference-Header-Content-Length"] =
+          std::to_string(req->header_length);
+    }
+    HttpResponse response;
+    uint64_t sent_ns = 0;
+    std::string terr = conn.Request(
+        "POST", req->path, hdrs, req->body, &response, req->timeout_us,
+        &sent_ns);
+    if (sent_ns != 0) {
+      req->timers.SetTimestamp(RequestTimers::Kind::SEND_END, sent_ns);
+      req->timers.SetTimestamp(RequestTimers::Kind::RECV_START, sent_ns);
+    } else {
+      req->timers.CaptureTimestamp(RequestTimers::Kind::SEND_END);
+      req->timers.CaptureTimestamp(RequestTimers::Kind::RECV_START);
+    }
+
+    Error err = terr.empty() ? ErrorFromResponse(response) : Error(terr);
+    size_t response_header_length = 0;
+    auto it = response.headers.find("inference-header-content-length");
+    if (it != response.headers.end()) {
+      response_header_length = strtoull(it->second.c_str(), nullptr, 10);
+    }
+    InferResult* result = nullptr;
+    InferResultHttp::Create(
+        &result, std::move(response.body), response_header_length, err);
+    req->timers.CaptureTimestamp(RequestTimers::Kind::RECV_END);
+    req->timers.CaptureTimestamp(RequestTimers::Kind::REQUEST_END);
+    if (err.IsOk()) UpdateInferStat(req->timers);
+    req->callback(result);
+  }
+}
+
+Error InferenceServerHttpClient::AsyncInfer(
+    OnCompleteFn callback, const InferOptions& options,
+    const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs,
+    const Headers& headers, const Parameters& query_params) {
+  if (callback == nullptr) {
+    return Error("callback must not be null for AsyncInfer");
+  }
+  EnsureAsyncWorkers();
+
+  auto req = std::make_unique<AsyncRequest>();
+  req->timers.CaptureTimestamp(RequestTimers::Kind::REQUEST_START);
+  std::vector<char> body;
+  size_t header_length = 0;
+  Error err = GenerateRequestBody(&body, &header_length, options, inputs,
+                                  outputs);
+  if (!err.IsOk()) return err;
+  req->path = AppendQuery(
+      ModelPath(options.model_name, options.model_version) + "/infer",
+      query_params);
+  req->body.assign(body.data(), body.size());
+  req->header_length = header_length;
+  req->headers = headers;
+  req->timeout_us = options.client_timeout_us;
+  req->callback = std::move(callback);
+
+  {
+    std::lock_guard<std::mutex> lk(async_mutex_);
+    async_queue_.push_back(std::move(req));
+  }
+  async_cv_.notify_one();
+  return Error::Success;
+}
+
+Error InferenceServerHttpClient::InferMulti(
+    std::vector<InferResult*>* results, const std::vector<InferOptions>& options,
+    const std::vector<std::vector<InferInput*>>& inputs,
+    const std::vector<std::vector<const InferRequestedOutput*>>& outputs,
+    const Headers& headers) {
+  if (options.size() != 1 && options.size() != inputs.size()) {
+    return Error("options must be 1 or match inputs count");
+  }
+  results->clear();
+  for (size_t i = 0; i < inputs.size(); ++i) {
+    const InferOptions& opt = (options.size() == 1) ? options[0] : options[i];
+    static const std::vector<const InferRequestedOutput*> kNoOutputs;
+    const auto& outs = (i < outputs.size()) ? outputs[i] : kNoOutputs;
+    InferResult* result = nullptr;
+    Error err = Infer(&result, opt, inputs[i], outs, headers);
+    if (!err.IsOk()) {
+      for (auto* r : *results) delete r;
+      results->clear();
+      return err;
+    }
+    results->push_back(result);
+  }
+  return Error::Success;
+}
+
+Error InferenceServerHttpClient::AsyncInferMulti(
+    OnMultiCompleteFn callback, const std::vector<InferOptions>& options,
+    const std::vector<std::vector<InferInput*>>& inputs,
+    const std::vector<std::vector<const InferRequestedOutput*>>& outputs,
+    const Headers& headers) {
+  if (callback == nullptr) {
+    return Error("callback must not be null for AsyncInferMulti");
+  }
+  if (options.size() != 1 && options.size() != inputs.size()) {
+    return Error("options must be 1 or match inputs count");
+  }
+  struct MultiState {
+    std::mutex mutex;
+    std::vector<InferResult*> results;
+    size_t remaining;
+    OnMultiCompleteFn callback;
+  };
+  auto state = std::make_shared<MultiState>();
+  state->results.resize(inputs.size(), nullptr);
+  state->remaining = inputs.size();
+  state->callback = std::move(callback);
+
+  // Build every request body up front so a failure on request i
+  // cannot leave earlier requests in flight with a callback that can
+  // never fire (nothing is enqueued until all succeed).
+  std::vector<std::unique_ptr<AsyncRequest>> requests;
+  requests.reserve(inputs.size());
+  for (size_t i = 0; i < inputs.size(); ++i) {
+    const InferOptions& opt = (options.size() == 1) ? options[0] : options[i];
+    static const std::vector<const InferRequestedOutput*> kNoOutputs;
+    const auto& outs = (i < outputs.size()) ? outputs[i] : kNoOutputs;
+    auto req = std::make_unique<AsyncRequest>();
+    req->timers.CaptureTimestamp(RequestTimers::Kind::REQUEST_START);
+    std::vector<char> body;
+    size_t header_length = 0;
+    Error err =
+        GenerateRequestBody(&body, &header_length, opt, inputs[i], outs);
+    if (!err.IsOk()) return err;
+    req->path = ModelPath(opt.model_name, opt.model_version) + "/infer";
+    req->body.assign(body.data(), body.size());
+    req->header_length = header_length;
+    req->headers = headers;
+    req->timeout_us = opt.client_timeout_us;
+    req->callback = [state, i](InferResult* result) {
+      bool done = false;
+      {
+        std::lock_guard<std::mutex> lk(state->mutex);
+        state->results[i] = result;
+        done = (--state->remaining == 0);
+      }
+      if (done) state->callback(state->results);
+    };
+    requests.push_back(std::move(req));
+  }
+  EnsureAsyncWorkers();
+  {
+    std::lock_guard<std::mutex> lk(async_mutex_);
+    for (auto& req : requests) async_queue_.push_back(std::move(req));
+  }
+  async_cv_.notify_all();
+  return Error::Success;
+}
+
+}  // namespace tpuclient

@@ -1,0 +1,236 @@
+// HTTP/REST (KServe-v2) client for the TPU inference server.
+//
+// Mirrors the reference InferenceServerHttpClient surface
+// (/root/reference/src/c++/library/http_client.h:105): the same ~25
+// endpoint methods, the binary tensor protocol with
+// Inference-Header-Content-Length, sync Infer and callback-async
+// AsyncInfer, and static GenerateRequestBody/ParseResponseBody.
+// Transport is a self-contained POSIX-socket HTTP/1.1 implementation
+// with keep-alive and a worker pool for async (the reference uses
+// libcurl easy/multi, which this image does not provide).
+//
+// The CUDA shared-memory verbs are replaced by TPU HBM arena verbs:
+// RegisterTpuSharedMemory posts the serialized arena-region
+// descriptor where the reference posts a base64 cudaIpcMemHandle_t
+// (http_client.cc:1712).
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include "common.h"
+#include "json.h"
+
+namespace tpuclient {
+
+class HttpConnection;
+
+//==============================================================================
+// Result of an HTTP inference (parity: InferResultHttp,
+// http_client.cc:740).
+//
+class InferResultHttp : public InferResult {
+ public:
+  // Takes ownership of `body`; parses the v2 JSON header + trailing
+  // binary segments.
+  static Error Create(
+      InferResult** result, std::string&& body, size_t header_length,
+      const Error& request_status = Error::Success);
+
+  Error ModelName(std::string* name) const override;
+  Error ModelVersion(std::string* version) const override;
+  Error Id(std::string* id) const override;
+  Error Shape(
+      const std::string& output_name,
+      std::vector<int64_t>* shape) const override;
+  Error Datatype(
+      const std::string& output_name, std::string* datatype) const override;
+  Error RawData(
+      const std::string& output_name, const uint8_t** buf,
+      size_t* byte_size) const override;
+  Error StringData(
+      const std::string& output_name,
+      std::vector<std::string>* string_result) const override;
+  std::string DebugString() const override;
+  Error RequestStatus() const override;
+
+  // Response-header dict access (parameters etc.).
+  const json::Value& Header() const { return header_; }
+
+ private:
+  struct Output {
+    std::string datatype;
+    std::vector<int64_t> shape;
+    const uint8_t* raw = nullptr;  // into body_, or nullptr
+    size_t raw_size = 0;
+    json::Value json_data;         // when not binary
+    bool in_shm = false;
+  };
+
+  Error FindOutput(const std::string& name, const Output** out) const;
+
+  std::string body_;
+  json::Value header_;
+  std::map<std::string, Output> outputs_;
+  Error status_;
+};
+
+//==============================================================================
+// The HTTP client (parity: http_client.h:105).
+//
+class InferenceServerHttpClient : public InferenceServerClient {
+ public:
+  ~InferenceServerHttpClient() override;
+
+  // url is "host:port" (no scheme) like the reference.
+  static Error Create(
+      std::unique_ptr<InferenceServerHttpClient>* client,
+      const std::string& url, bool verbose = false);
+
+  Error IsServerLive(bool* live, const Headers& headers = {});
+  Error IsServerReady(bool* ready, const Headers& headers = {});
+  Error IsModelReady(
+      bool* ready, const std::string& model_name,
+      const std::string& model_version = "", const Headers& headers = {});
+
+  Error ServerMetadata(std::string* server_metadata, const Headers& headers = {});
+  Error ModelMetadata(
+      std::string* model_metadata, const std::string& model_name,
+      const std::string& model_version = "", const Headers& headers = {});
+  Error ModelConfig(
+      std::string* model_config, const std::string& model_name,
+      const std::string& model_version = "", const Headers& headers = {});
+  Error ModelRepositoryIndex(
+      std::string* repository_index, const Headers& headers = {});
+  Error LoadModel(
+      const std::string& model_name, const Headers& headers = {},
+      const std::string& config = "");
+  Error UnloadModel(const std::string& model_name, const Headers& headers = {});
+  Error ModelInferenceStatistics(
+      std::string* infer_stat, const std::string& model_name = "",
+      const std::string& model_version = "", const Headers& headers = {});
+
+  Error UpdateTraceSettings(
+      std::string* response, const std::string& model_name = "",
+      const std::map<std::string, std::vector<std::string>>& settings = {},
+      const Headers& headers = {});
+  Error GetTraceSettings(
+      std::string* settings, const std::string& model_name = "",
+      const Headers& headers = {});
+  Error UpdateLogSettings(
+      std::string* response,
+      const std::map<std::string, std::string>& settings,
+      const Headers& headers = {});
+  Error GetLogSettings(std::string* settings, const Headers& headers = {});
+
+  Error SystemSharedMemoryStatus(
+      std::string* status, const std::string& region_name = "",
+      const Headers& headers = {});
+  Error RegisterSystemSharedMemory(
+      const std::string& name, const std::string& key, size_t byte_size,
+      size_t offset = 0, const Headers& headers = {});
+  Error UnregisterSystemSharedMemory(
+      const std::string& name = "", const Headers& headers = {});
+
+  // TPU HBM arena regions (replaces Register/UnregisterCudaSharedMemory).
+  Error TpuSharedMemoryStatus(
+      std::string* status, const std::string& region_name = "",
+      const Headers& headers = {});
+  Error RegisterTpuSharedMemory(
+      const std::string& name, const std::string& raw_handle,
+      int64_t device_id, size_t byte_size, const Headers& headers = {});
+  Error UnregisterTpuSharedMemory(
+      const std::string& name = "", const Headers& headers = {});
+
+  Error Infer(
+      InferResult** result, const InferOptions& options,
+      const std::vector<InferInput*>& inputs,
+      const std::vector<const InferRequestedOutput*>& outputs = {},
+      const Headers& headers = {}, const Parameters& query_params = {});
+
+  Error AsyncInfer(
+      OnCompleteFn callback, const InferOptions& options,
+      const std::vector<InferInput*>& inputs,
+      const std::vector<const InferRequestedOutput*>& outputs = {},
+      const Headers& headers = {}, const Parameters& query_params = {});
+
+  Error InferMulti(
+      std::vector<InferResult*>* results,
+      const std::vector<InferOptions>& options,
+      const std::vector<std::vector<InferInput*>>& inputs,
+      const std::vector<std::vector<const InferRequestedOutput*>>& outputs = {},
+      const Headers& headers = {});
+  Error AsyncInferMulti(
+      OnMultiCompleteFn callback, const std::vector<InferOptions>& options,
+      const std::vector<std::vector<InferInput*>>& inputs,
+      const std::vector<std::vector<const InferRequestedOutput*>>& outputs = {},
+      const Headers& headers = {});
+
+  // Builds the POST body + json header length without sending
+  // (parity: http_client.h:121 GenerateRequestBody).
+  static Error GenerateRequestBody(
+      std::vector<char>* request_body, size_t* header_length,
+      const InferOptions& options, const std::vector<InferInput*>& inputs,
+      const std::vector<const InferRequestedOutput*>& outputs = {});
+
+  // Parses a response body obtained elsewhere
+  // (parity: http_client.h:135 ParseResponseBody).
+  static Error ParseResponseBody(
+      InferResult** result, std::vector<char>&& response_body,
+      size_t header_length);
+
+  // Number of async worker threads (connections). Must be set before
+  // the first AsyncInfer; default 4.
+  void SetAsyncWorkerCount(size_t count);
+
+ private:
+  InferenceServerHttpClient(const std::string& url, bool verbose);
+
+  Error Get(
+      const std::string& path, const Headers& headers, std::string* response,
+      json::Value* parsed);
+  Error Post(
+      const std::string& path, const std::string& body,
+      const Headers& headers, std::string* response, json::Value* parsed);
+  Error DoRequest(
+      const std::string& method, const std::string& path,
+      const std::string& body, const Headers& headers,
+      const std::string& content_type, size_t json_header_length,
+      std::string* response_body, size_t* response_header_length,
+      HttpConnection* conn, uint64_t timeout_us,
+      uint64_t* sent_ns = nullptr);
+
+  struct AsyncRequest {
+    std::string path;
+    std::string body;
+    size_t header_length = 0;
+    Headers headers;
+    uint64_t timeout_us = 0;
+    OnCompleteFn callback;
+    RequestTimers timers;
+  };
+  void AsyncWorkerLoop();
+  void EnsureAsyncWorkers();
+
+  std::string host_;
+  int port_ = 0;
+
+  // Sync path: one persistent connection guarded by a mutex.
+  std::unique_ptr<HttpConnection> sync_conn_;
+  std::mutex sync_mutex_;
+
+  // Async path: worker pool, each worker owns a connection.
+  size_t async_worker_count_ = 4;
+  std::vector<std::thread> async_workers_;
+  std::deque<std::unique_ptr<AsyncRequest>> async_queue_;
+  std::mutex async_mutex_;
+  std::condition_variable async_cv_;
+  std::atomic<bool> async_exiting_{false};
+};
+
+}  // namespace tpuclient

@@ -1,0 +1,423 @@
+#include "http_transport.h"
+
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <chrono>
+#include <cstring>
+
+namespace tpuclient {
+
+static uint64_t NowNs() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+HttpConnection::~HttpConnection() { Close(); }
+
+void HttpConnection::Close() {
+  if (fd_ >= 0) {
+    ::close(fd_);
+    fd_ = -1;
+  }
+  leftover_.clear();
+}
+
+std::string HttpConnection::Connect(uint64_t timeout_us) {
+  Close();
+  struct addrinfo hints;
+  memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  std::string port_str = std::to_string(port_);
+  int rc = getaddrinfo(host_.c_str(), port_str.c_str(), &hints, &res);
+  if (rc != 0) {
+    return "failed to resolve " + host_ + ": " + gai_strerror(rc);
+  }
+  uint64_t deadline_ns =
+      (timeout_us != 0) ? NowNs() + timeout_us * 1000ull : 0;
+  std::string err;
+  for (struct addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
+    int fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) {
+      err = strerror(errno);
+      continue;
+    }
+    // Non-blocking from the start: connect with EINPROGRESS + poll so
+    // the timeout is honoured, and all later send/recv calls hit the
+    // EAGAIN paths that enforce the request deadline.
+    fcntl(fd, F_SETFL, fcntl(fd, F_GETFL, 0) | O_NONBLOCK);
+    int rc2 = connect(fd, ai->ai_addr, ai->ai_addrlen);
+    if (rc2 != 0 && errno == EINPROGRESS) {
+      struct pollfd pfd = {fd, POLLOUT, 0};
+      while (true) {
+        int pr = poll(&pfd, 1, 50);
+        if (pr > 0) break;
+        if (deadline_ns != 0 && NowNs() > deadline_ns) {
+          err = "connect timeout";
+          break;
+        }
+        if (pr < 0 && errno != EINTR) {
+          err = strerror(errno);
+          break;
+        }
+      }
+      if (err.empty()) {
+        int so_error = 0;
+        socklen_t slen = sizeof(so_error);
+        getsockopt(fd, SOL_SOCKET, SO_ERROR, &so_error, &slen);
+        if (so_error != 0) {
+          err = strerror(so_error);
+          rc2 = -1;
+        } else {
+          rc2 = 0;
+        }
+      } else {
+        rc2 = -1;
+      }
+    } else if (rc2 != 0) {
+      err = strerror(errno);
+    }
+    if (rc2 == 0) {
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      fd_ = fd;
+      err.clear();
+      break;
+    }
+    ::close(fd);
+  }
+  freeaddrinfo(res);
+  if (fd_ < 0) {
+    return "failed to connect to " + host_ + ":" + port_str + ": " + err;
+  }
+  return "";
+}
+
+std::string HttpConnection::SendAll(
+    const char* data, size_t len, uint64_t deadline_ns) {
+  size_t sent = 0;
+  while (sent < len) {
+    ssize_t n = ::send(fd_, data + sent, len - sent, MSG_NOSIGNAL);
+    if (n > 0) {
+      sent += static_cast<size_t>(n);
+      continue;
+    }
+    if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR)) {
+      if (deadline_ns != 0 && NowNs() > deadline_ns) {
+        return "send timeout";
+      }
+      struct pollfd pfd = {fd_, POLLOUT, 0};
+      poll(&pfd, 1, 50);
+      continue;
+    }
+    return std::string("send failed: ") + strerror(errno);
+  }
+  return "";
+}
+
+ssize_t HttpConnection::RecvSome(
+    char* buf, size_t len, uint64_t deadline_ns, std::string* err) {
+  while (true) {
+    ssize_t n = ::recv(fd_, buf, len, 0);
+    if (n >= 0) return n;
+    if (errno == EINTR) continue;
+    if (errno == EAGAIN || errno == EWOULDBLOCK) {
+      if (deadline_ns != 0 && NowNs() > deadline_ns) {
+        *err = "receive timeout";
+        return -1;
+      }
+      struct pollfd pfd = {fd_, POLLIN, 0};
+      poll(&pfd, 1, 50);
+      continue;
+    }
+    *err = std::string("recv failed: ") + strerror(errno);
+    return -1;
+  }
+}
+
+namespace {
+
+// Incremental HTTP/1.1 response parser.
+struct ResponseParser {
+  enum State { kStatusLine, kHeaders, kBody, kChunkSize, kChunkData,
+               kChunkTrailer, kDone } state = kStatusLine;
+  HttpResponse* response;
+  const std::function<void(const char*, size_t)>* on_data;
+  std::string line_buf;
+  size_t content_length = 0;
+  bool have_content_length = false;
+  bool chunked = false;
+  bool close_delimited = false;
+  size_t body_received = 0;
+  size_t chunk_remaining = 0;
+
+  // Feeds bytes; consumes from data, returns error or "".
+  std::string Feed(const char* data, size_t len, size_t* consumed) {
+    size_t i = 0;
+    while (i < len && state != kDone) {
+      switch (state) {
+        case kStatusLine:
+        case kHeaders:
+        case kChunkSize:
+        case kChunkTrailer: {
+          // Accumulate a CRLF-terminated line.
+          char c = data[i++];
+          line_buf.push_back(c);
+          if (c == '\n') {
+            std::string line = line_buf;
+            line_buf.clear();
+            while (!line.empty() &&
+                   (line.back() == '\n' || line.back() == '\r')) {
+              line.pop_back();
+            }
+            std::string err = OnLine(line);
+            if (!err.empty()) return err;
+          }
+          break;
+        }
+        case kBody: {
+          size_t want = len - i;
+          if (have_content_length) {
+            want = std::min(want, content_length - body_received);
+          }
+          Deliver(data + i, want);
+          body_received += want;
+          i += want;
+          if (have_content_length && body_received >= content_length) {
+            state = kDone;
+          }
+          break;
+        }
+        case kChunkData: {
+          size_t want = std::min(len - i, chunk_remaining);
+          Deliver(data + i, want);
+          i += want;
+          chunk_remaining -= want;
+          if (chunk_remaining == 0) {
+            // Consume the CRLF after the chunk via line machinery.
+            state = kChunkTrailer;
+          }
+          break;
+        }
+        case kDone:
+          break;
+      }
+    }
+    *consumed = i;
+    return "";
+  }
+
+  void Deliver(const char* data, size_t len) {
+    if (on_data != nullptr) {
+      (*on_data)(data, len);
+    } else {
+      response->body.append(data, len);
+    }
+  }
+
+  std::string OnLine(const std::string& line) {
+    switch (state) {
+      case kStatusLine: {
+        // "HTTP/1.1 200 OK"
+        size_t sp = line.find(' ');
+        if (sp == std::string::npos || line.compare(0, 5, "HTTP/") != 0) {
+          return "malformed status line: " + line;
+        }
+        response->status_code = atoi(line.c_str() + sp + 1);
+        state = kHeaders;
+        break;
+      }
+      case kHeaders: {
+        if (line.empty()) {
+          // End of headers.
+          auto it = response->headers.find("transfer-encoding");
+          if (it != response->headers.end() &&
+              it->second.find("chunked") != std::string::npos) {
+            chunked = true;
+            state = kChunkSize;
+          } else {
+            it = response->headers.find("content-length");
+            if (it != response->headers.end()) {
+              have_content_length = true;
+              content_length =
+                  static_cast<size_t>(strtoull(it->second.c_str(), nullptr, 10));
+              state = (content_length == 0) ? kDone : kBody;
+            } else {
+              // Read until connection close.
+              close_delimited = true;
+              state = kBody;
+            }
+          }
+          break;
+        }
+        size_t colon = line.find(':');
+        if (colon == std::string::npos) break;  // ignore malformed
+        std::string name = line.substr(0, colon);
+        for (auto& ch : name) ch = static_cast<char>(tolower(ch));
+        size_t vstart = colon + 1;
+        while (vstart < line.size() && line[vstart] == ' ') ++vstart;
+        response->headers[name] = line.substr(vstart);
+        break;
+      }
+      case kChunkSize: {
+        if (line.empty()) break;  // tolerate stray CRLF between chunks
+        chunk_remaining =
+            static_cast<size_t>(strtoull(line.c_str(), nullptr, 16));
+        if (chunk_remaining == 0) {
+          // Final chunk; trailing headers until empty line.
+          state = kChunkTrailer;
+          final_chunk_seen = true;
+        } else {
+          state = kChunkData;
+        }
+        break;
+      }
+      case kChunkTrailer: {
+        if (final_chunk_seen) {
+          if (line.empty()) state = kDone;
+        } else {
+          // This was the CRLF after a chunk's data.
+          state = kChunkSize;
+          if (!line.empty()) {
+            // Line actually held the next chunk size.
+            return OnLine(line);
+          }
+        }
+        break;
+      }
+      default:
+        break;
+    }
+    return "";
+  }
+
+  bool final_chunk_seen = false;
+};
+
+}  // namespace
+
+std::string HttpConnection::ReadResponse(
+    HttpResponse* response,
+    const std::function<void(const char*, size_t)>* on_data,
+    uint64_t deadline_ns) {
+  ResponseParser parser;
+  parser.response = response;
+  parser.on_data = on_data;
+
+  // Feed any bytes buffered beyond the previous response first.
+  if (!leftover_.empty()) {
+    std::string pending;
+    pending.swap(leftover_);
+    size_t consumed = 0;
+    std::string err = parser.Feed(pending.data(), pending.size(), &consumed);
+    if (!err.empty()) return err;
+    if (consumed < pending.size()) {
+      leftover_ = pending.substr(consumed);
+    }
+  }
+
+  char buf[65536];
+  while (parser.state != ResponseParser::kDone) {
+    std::string err;
+    ssize_t n = RecvSome(buf, sizeof(buf), deadline_ns, &err);
+    if (n < 0) return err;
+    if (n == 0) {
+      if (parser.close_delimited &&
+          parser.state == ResponseParser::kBody) {
+        break;  // body delimited by EOF
+      }
+      return "connection closed before full response";
+    }
+    size_t consumed = 0;
+    err = parser.Feed(buf, static_cast<size_t>(n), &consumed);
+    if (!err.empty()) return err;
+    if (consumed < static_cast<size_t>(n)) {
+      leftover_.append(buf + consumed, static_cast<size_t>(n) - consumed);
+    }
+  }
+
+  auto conn_hdr = response->headers.find("connection");
+  if (parser.close_delimited ||
+      (conn_hdr != response->headers.end() &&
+       conn_hdr->second.find("close") != std::string::npos)) {
+    Close();
+  }
+  return "";
+}
+
+std::string HttpConnection::Request(
+    const std::string& method, const std::string& path,
+    const std::map<std::string, std::string>& headers,
+    const std::string& body, HttpResponse* response, uint64_t timeout_us,
+    uint64_t* sent_ns_out) {
+  return RequestStreaming(
+      method, path, headers, body, response, nullptr, timeout_us,
+      sent_ns_out);
+}
+
+std::string HttpConnection::RequestStreaming(
+    const std::string& method, const std::string& path,
+    const std::map<std::string, std::string>& headers,
+    const std::string& body, HttpResponse* response,
+    const std::function<void(const char*, size_t)>& on_data,
+    uint64_t timeout_us, uint64_t* sent_ns_out) {
+  uint64_t deadline_ns =
+      (timeout_us != 0) ? NowNs() + timeout_us * 1000ull : 0;
+
+  std::string head;
+  head.reserve(256);
+  head.append(method).append(" ").append(path).append(" HTTP/1.1\r\n");
+  head.append("Host: ").append(host_).append(":")
+      .append(std::to_string(port_)).append("\r\n");
+  bool have_cl = false;
+  for (const auto& h : headers) {
+    head.append(h.first).append(": ").append(h.second).append("\r\n");
+    std::string lower = h.first;
+    for (auto& c : lower) c = static_cast<char>(tolower(c));
+    if (lower == "content-length") have_cl = true;
+  }
+  if (!have_cl && (!body.empty() || method == "POST" || method == "PUT")) {
+    head.append("Content-Length: ")
+        .append(std::to_string(body.size()))
+        .append("\r\n");
+  }
+  head.append("\r\n");
+
+  // Retry once on stale keep-alive connection.
+  for (int attempt = 0; attempt < 2; ++attempt) {
+    bool fresh = false;
+    if (fd_ < 0) {
+      std::string err = Connect(timeout_us);
+      if (!err.empty()) return err;
+      fresh = true;
+    }
+    *response = HttpResponse();
+    std::string err = SendAll(head.data(), head.size(), deadline_ns);
+    if (err.empty() && !body.empty()) {
+      err = SendAll(body.data(), body.size(), deadline_ns);
+    }
+    if (err.empty() && sent_ns_out != nullptr) *sent_ns_out = NowNs();
+    if (err.empty()) {
+      err = ReadResponse(
+          response, on_data ? &on_data : nullptr, deadline_ns);
+    }
+    if (err.empty()) return "";
+    Close();
+    // Never retry once response bytes were seen (a streaming on_data
+    // callback may already have observed partial data).
+    if (fresh || attempt == 1 || response->status_code != 0) return err;
+    // else: stale keep-alive — reconnect and retry
+  }
+  return "unreachable";
+}
+
+}  // namespace tpuclient

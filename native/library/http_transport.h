@@ -1,0 +1,73 @@
+// Minimal HTTP/1.1 connection over POSIX sockets with keep-alive.
+// Fills the role libcurl plays in the reference http_client
+// (/root/reference/src/c++/library/http_client.cc:1364-1393); also
+// reused by the perf harness's OpenAI-style backend for SSE streams.
+#pragma once
+
+#include <cstdint>
+#include <functional>
+#include <map>
+#include <string>
+
+namespace tpuclient {
+
+struct HttpResponse {
+  int status_code = 0;
+  // Header names lowercased.
+  std::map<std::string, std::string> headers;
+  std::string body;
+};
+
+class HttpConnection {
+ public:
+  HttpConnection(const std::string& host, int port)
+      : host_(host), port_(port) {}
+  ~HttpConnection();
+
+  HttpConnection(const HttpConnection&) = delete;
+  HttpConnection& operator=(const HttpConnection&) = delete;
+
+  // Performs a request, transparently (re)connecting and retrying
+  // once if a kept-alive connection went stale. timeout_us==0 means
+  // no timeout. Returns empty string on success, else error text.
+  std::string Request(
+      const std::string& method, const std::string& path,
+      const std::map<std::string, std::string>& headers,
+      const std::string& body, HttpResponse* response,
+      uint64_t timeout_us = 0, uint64_t* sent_ns_out = nullptr);
+
+  // Like Request but delivers body bytes incrementally to `on_data`
+  // as they arrive (for server-sent-event streams). Headers are
+  // filled in `response`; response->body stays empty. If
+  // `sent_ns_out` is non-null it receives the steady-clock time (ns)
+  // when the request finished hitting the socket, so callers can
+  // attribute send vs. receive latency.
+  std::string RequestStreaming(
+      const std::string& method, const std::string& path,
+      const std::map<std::string, std::string>& headers,
+      const std::string& body, HttpResponse* response,
+      const std::function<void(const char*, size_t)>& on_data,
+      uint64_t timeout_us = 0, uint64_t* sent_ns_out = nullptr);
+
+  void Close();
+  bool IsConnected() const { return fd_ >= 0; }
+
+ private:
+  std::string Connect(uint64_t timeout_us);
+  std::string SendAll(const char* data, size_t len, uint64_t deadline_ns);
+  // Returns >0 bytes read, 0 on EOF, <0 on error (sets err).
+  ssize_t RecvSome(char* buf, size_t len, uint64_t deadline_ns,
+                   std::string* err);
+  std::string ReadResponse(
+      HttpResponse* response,
+      const std::function<void(const char*, size_t)>* on_data,
+      uint64_t deadline_ns);
+
+  std::string host_;
+  int port_;
+  int fd_ = -1;
+  // Buffered bytes read past the previous response (pipelining slop).
+  std::string leftover_;
+};
+
+}  // namespace tpuclient

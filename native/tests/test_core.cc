@@ -126,6 +126,22 @@ TEST_CASE("common: InferInput BYTES serialization") {
   REQUIRE_OK(InferInput::Create(&nonbytes, "in1", {2}, "FP32"));
   std::unique_ptr<InferInput> guard2(nonbytes);
   CHECK(!nonbytes->AppendFromString({"x"}).IsOk());
+
+  // Repeated appends must keep earlier chunks valid (the backing
+  // store must not reallocate out from under recorded pointers).
+  InferInput* multi = nullptr;
+  REQUIRE_OK(InferInput::Create(&multi, "in2", {8}, "BYTES"));
+  std::unique_ptr<InferInput> guard3(multi);
+  for (int i = 0; i < 8; ++i) {
+    REQUIRE_OK(multi->AppendFromString({std::string(1, 'a' + i)}));
+  }
+  std::string all;
+  multi->GatherInto(&all);
+  REQUIRE(all.size() == 8 * 5);
+  for (int i = 0; i < 8; ++i) {
+    CHECK_EQ(static_cast<int>(all[i * 5]), 1);
+    CHECK_EQ(all[i * 5 + 4], static_cast<char>('a' + i));
+  }
 }
 
 TEST_CASE("common: shared memory routing") {

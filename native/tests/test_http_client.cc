@@ -1,0 +1,228 @@
+// HTTP client tests.
+//
+// Offline cases cover request-body generation and response parsing
+// (parity: reference HTTPJSONDataTest, cc_client_test.cc:1660).
+// Integration cases run when TPUCLIENT_SERVER_HTTP is set to a live
+// server's host:port (tests/test_native.py launches the Python server
+// and sets it) — parity with the reference's tier-2 live-server tests.
+#include <cstdlib>
+#include <cstring>
+
+#include "../library/http_client.h"
+#include "minitest.h"
+
+using namespace tpuclient;
+
+namespace {
+
+std::unique_ptr<InferInput> MakeFp32Input(
+    const std::string& name, const std::vector<int64_t>& shape,
+    const float* data, size_t count) {
+  InferInput* raw = nullptr;
+  InferInput::Create(&raw, name, shape, "FP32");
+  raw->AppendRaw(
+      reinterpret_cast<const uint8_t*>(data), count * sizeof(float));
+  return std::unique_ptr<InferInput>(raw);
+}
+
+}  // namespace
+
+TEST_CASE("http: GenerateRequestBody binary layout") {
+  float data0[16], data1[16];
+  for (int i = 0; i < 16; ++i) {
+    data0[i] = static_cast<float>(i);
+    data1[i] = static_cast<float>(i);
+  }
+  auto in0 = MakeFp32Input("INPUT0", {1, 16}, data0, 16);
+  auto in1 = MakeFp32Input("INPUT1", {1, 16}, data1, 16);
+
+  InferRequestedOutput* out0 = nullptr;
+  InferRequestedOutput::Create(&out0, "OUTPUT0");
+  std::unique_ptr<InferRequestedOutput> out_guard(out0);
+
+  InferOptions options("simple");
+  options.request_id = "req-1";
+
+  std::vector<char> body;
+  size_t header_length = 0;
+  REQUIRE_OK(InferenceServerHttpClient::GenerateRequestBody(
+      &body, &header_length, options, {in0.get(), in1.get()}, {out0}));
+
+  // Header is valid JSON followed by 2x64 binary bytes.
+  CHECK_EQ(body.size(), header_length + 128);
+  json::Value header;
+  REQUIRE(json::Parse(body.data(), header_length, &header).empty());
+  CHECK_EQ(header["id"].AsString(), "req-1");
+  CHECK_EQ(header["inputs"].AsArray().size(), 2u);
+  CHECK_EQ(
+      header["inputs"].AsArray()[0]["parameters"]["binary_data_size"].AsUint(),
+      64u);
+  CHECK(memcmp(body.data() + header_length, data0, 64) == 0);
+  CHECK(memcmp(body.data() + header_length + 64, data1, 64) == 0);
+}
+
+TEST_CASE("http: GenerateRequestBody shm params") {
+  InferInput* raw = nullptr;
+  InferInput::Create(&raw, "INPUT0", {4}, "FP32");
+  std::unique_ptr<InferInput> input(raw);
+  input->SetSharedMemory("region0", 16, 4);
+
+  InferOptions options("simple");
+  options.sequence_id = 7;
+  options.sequence_start = true;
+
+  std::vector<char> body;
+  size_t header_length = 0;
+  REQUIRE_OK(InferenceServerHttpClient::GenerateRequestBody(
+      &body, &header_length, options, {input.get()}, {}));
+  CHECK_EQ(body.size(), header_length);  // no binary section
+  json::Value header;
+  REQUIRE(json::Parse(body.data(), header_length, &header).empty());
+  const auto& p = header["inputs"].AsArray()[0]["parameters"];
+  CHECK_EQ(p["shared_memory_region"].AsString(), "region0");
+  CHECK_EQ(p["shared_memory_byte_size"].AsUint(), 16u);
+  CHECK_EQ(p["shared_memory_offset"].AsUint(), 4u);
+  CHECK_EQ(header["parameters"]["sequence_id"].AsUint(), 7u);
+  CHECK(header["parameters"]["sequence_start"].AsBool());
+}
+
+TEST_CASE("http: ParseResponseBody binary and errors") {
+  std::string json_part =
+      "{\"model_name\":\"simple\",\"model_version\":\"1\",\"outputs\":["
+      "{\"name\":\"OUTPUT0\",\"datatype\":\"FP32\",\"shape\":[2],"
+      "\"parameters\":{\"binary_data_size\":8}}]}";
+  float vals[2] = {1.5f, -2.0f};
+  std::vector<char> body(json_part.begin(), json_part.end());
+  body.insert(
+      body.end(), reinterpret_cast<char*>(vals),
+      reinterpret_cast<char*>(vals) + 8);
+
+  InferResult* result = nullptr;
+  REQUIRE_OK(InferenceServerHttpClient::ParseResponseBody(
+      &result, std::move(body), json_part.size()));
+  std::unique_ptr<InferResult> guard(result);
+  REQUIRE_OK(result->RequestStatus());
+
+  std::string name;
+  REQUIRE_OK(result->ModelName(&name));
+  CHECK_EQ(name, "simple");
+  std::vector<int64_t> shape;
+  REQUIRE_OK(result->Shape("OUTPUT0", &shape));
+  REQUIRE(shape.size() == 1u);
+  CHECK_EQ(shape[0], 2);
+  const uint8_t* buf;
+  size_t len;
+  REQUIRE_OK(result->RawData("OUTPUT0", &buf, &len));
+  CHECK_EQ(len, 8u);
+  CHECK(memcmp(buf, vals, 8) == 0);
+  CHECK(!result->RawData("NOPE", &buf, &len).IsOk());
+}
+
+TEST_CASE("http: integration against live server") {
+  const char* url = getenv("TPUCLIENT_SERVER_HTTP");
+  if (url == nullptr) {
+    printf("       (skipped: TPUCLIENT_SERVER_HTTP not set)\n");
+    return;
+  }
+  std::unique_ptr<InferenceServerHttpClient> client;
+  REQUIRE_OK(InferenceServerHttpClient::Create(&client, url));
+
+  bool live = false, ready = false;
+  REQUIRE_OK(client->IsServerLive(&live));
+  CHECK(live);
+  REQUIRE_OK(client->IsServerReady(&ready));
+  CHECK(ready);
+  bool model_ready = false;
+  REQUIRE_OK(client->IsModelReady(&model_ready, "simple"));
+  CHECK(model_ready);
+  bool missing_ready = true;
+  client->IsModelReady(&missing_ready, "no_such_model");
+  CHECK(!missing_ready);
+
+  std::string metadata;
+  REQUIRE_OK(client->ServerMetadata(&metadata));
+  CHECK(metadata.find("extensions") != std::string::npos);
+  REQUIRE_OK(client->ModelMetadata(&metadata, "simple"));
+  CHECK(metadata.find("INPUT0") != std::string::npos);
+  REQUIRE_OK(client->ModelConfig(&metadata, "simple"));
+  std::string index;
+  REQUIRE_OK(client->ModelRepositoryIndex(&index));
+  CHECK(index.find("simple") != std::string::npos);
+
+  // Inference: simple add/sub — INPUT0+INPUT1 -> OUTPUT0=sum,
+  // OUTPUT1=diff (16-wide INT32, same contract as the reference
+  // 'simple' model).
+  int32_t data0[16], data1[16];
+  for (int i = 0; i < 16; ++i) {
+    data0[i] = i;
+    data1[i] = 1;
+  }
+  InferInput* raw0 = nullptr;
+  InferInput::Create(&raw0, "INPUT0", {16}, "INT32");
+  std::unique_ptr<InferInput> in0(raw0);
+  in0->AppendRaw(reinterpret_cast<const uint8_t*>(data0), sizeof(data0));
+  InferInput* raw1 = nullptr;
+  InferInput::Create(&raw1, "INPUT1", {16}, "INT32");
+  std::unique_ptr<InferInput> in1(raw1);
+  in1->AppendRaw(reinterpret_cast<const uint8_t*>(data1), sizeof(data1));
+  InferOptions options("simple");
+  InferResult* result = nullptr;
+  REQUIRE_OK(client->Infer(&result, options, {in0.get(), in1.get()}));
+  std::unique_ptr<InferResult> result_guard(result);
+  REQUIRE_OK(result->RequestStatus());
+  const uint8_t* buf;
+  size_t len;
+  REQUIRE_OK(result->RawData("OUTPUT0", &buf, &len));
+  REQUIRE(len == 64u);
+  const int32_t* sums = reinterpret_cast<const int32_t*>(buf);
+  for (int i = 0; i < 16; ++i) {
+    CHECK_EQ(sums[i], data0[i] + 1);
+  }
+
+  // Async: issue 8 requests and wait for all callbacks.
+  std::mutex mu;
+  std::condition_variable cv;
+  int outstanding = 8;
+  int failures = 0;
+  for (int r = 0; r < 8; ++r) {
+    Error err = client->AsyncInfer(
+        [&](InferResult* res) {
+          std::unique_ptr<InferResult> g(res);
+          std::lock_guard<std::mutex> lk(mu);
+          if (!res->RequestStatus().IsOk()) ++failures;
+          --outstanding;
+          cv.notify_one();
+        },
+        options, {in0.get(), in1.get()});
+    REQUIRE_OK(err);
+  }
+  {
+    std::unique_lock<std::mutex> lk(mu);
+    REQUIRE(cv.wait_for(lk, std::chrono::seconds(60),
+                        [&]() { return outstanding == 0; }));
+  }
+  CHECK_EQ(failures, 0);
+
+  // Client-side stats accumulated.
+  InferStat stat;
+  REQUIRE_OK(client->ClientInferStat(&stat));
+  CHECK(stat.completed_request_count >= 9);
+
+  // Error mapping: unknown model -> HTTP error with server message.
+  InferOptions bad("no_such_model");
+  InferResult* bad_result = nullptr;
+  Error err = client->Infer(&bad_result, bad, {in0.get(), in1.get()});
+  if (bad_result != nullptr) {
+    CHECK(!bad_result->RequestStatus().IsOk());
+    delete bad_result;
+  } else {
+    CHECK(!err.IsOk());
+  }
+
+  // Statistics endpoint.
+  std::string stats;
+  REQUIRE_OK(client->ModelInferenceStatistics(&stats, "simple"));
+  CHECK(stats.find("inference_count") != std::string::npos);
+}
+
+MINITEST_MAIN

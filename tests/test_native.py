@@ -40,11 +40,14 @@ def native_build():
     return BUILD
 
 
-def _run_binary(build_dir: pathlib.Path, name: str):
+def _run_binary(build_dir: pathlib.Path, name: str, env_extra=None):
+    import os
+
     binary = build_dir / name
     assert binary.exists(), "%s not built" % name
+    env = dict(os.environ, **env_extra) if env_extra else None
     proc = subprocess.run(
-        [str(binary)], capture_output=True, text=True, timeout=300
+        [str(binary)], capture_output=True, text=True, timeout=300, env=env
     )
     assert proc.returncode == 0, "%s failed:\n%s\n%s" % (
         name, proc.stdout[-4000:], proc.stderr[-4000:]
@@ -53,3 +56,32 @@ def _run_binary(build_dir: pathlib.Path, name: str):
 
 def test_native_core(native_build):
     _run_binary(native_build, "test_core")
+
+
+def test_native_http_offline(native_build):
+    _run_binary(native_build, "test_http_client")
+
+
+@pytest.fixture(scope="module")
+def live_server():
+    """In-process server with gRPC + HTTP front-ends on ephemeral
+    ports, for native integration binaries."""
+    from client_tpu.server.app import build_core, start_grpc_server
+    from client_tpu.server.http_server import start_http_server_thread
+
+    core = build_core(["simple"])
+    grpc_handle = start_grpc_server(core=core)
+    http_runner = start_http_server_thread(core, host="127.0.0.1", port=0)
+    yield {
+        "grpc": grpc_handle.address,
+        "http": "127.0.0.1:%d" % http_runner.port,
+    }
+    http_runner.stop()
+    grpc_handle.stop()
+
+
+def test_native_http_integration(native_build, live_server):
+    _run_binary(
+        native_build, "test_http_client",
+        {"TPUCLIENT_SERVER_HTTP": live_server["http"]},
+    )
