@@ -1,0 +1,709 @@
+#include "grpc_client.h"
+
+#include <cstring>
+
+namespace tpuclient {
+
+namespace {
+
+const char kService[] = "/inference.GRPCInferenceService/";
+
+std::string Method(const char* name) {
+  return std::string(kService) + name;
+}
+
+}  // namespace
+
+//==============================================================================
+// InferResultGrpc
+
+Error InferResultGrpc::Create(
+    InferResult** result, std::shared_ptr<inference::ModelInferResponse>
+                              response,
+    const Error& request_status) {
+  *result = new InferResultGrpc(std::move(response), request_status);
+  return Error::Success;
+}
+
+Error InferResultGrpc::Create(
+    InferResult** result,
+    std::shared_ptr<inference::ModelStreamInferResponse> stream_response) {
+  Error status = Error::Success;
+  if (!stream_response->error_message().empty()) {
+    status = Error(stream_response->error_message());
+  }
+  auto shared_response = std::shared_ptr<inference::ModelInferResponse>(
+      stream_response, stream_response->mutable_infer_response());
+  auto* grpc_result = new InferResultGrpc(shared_response, status);
+  grpc_result->stream_response_ = stream_response;
+  // Decoupled final-response marker (parity: grpc_client.cc:254-262).
+  const auto& params = shared_response->parameters();
+  auto it = params.find("triton_final_response");
+  if (it != params.end() && it->second.has_bool_param()) {
+    grpc_result->is_final_response_ = it->second.bool_param();
+  }
+  // An empty final response from a decoupled model.
+  grpc_result->null_last_response_ =
+      grpc_result->is_final_response_ &&
+      shared_response->outputs_size() == 0 &&
+      shared_response->model_name().empty();
+  *result = grpc_result;
+  return Error::Success;
+}
+
+InferResultGrpc::InferResultGrpc(
+    std::shared_ptr<inference::ModelInferResponse> response,
+    const Error& request_status)
+    : response_(std::move(response)), status_(request_status) {}
+
+Error InferResultGrpc::FindOutput(
+    const std::string& output_name,
+    const inference::ModelInferResponse::InferOutputTensor** tensor,
+    size_t* index) const {
+  for (int i = 0; i < response_->outputs_size(); ++i) {
+    if (response_->outputs(i).name() == output_name) {
+      *tensor = &response_->outputs(i);
+      *index = static_cast<size_t>(i);
+      return Error::Success;
+    }
+  }
+  return Error(
+      "The response does not contain output '" + output_name + "'");
+}
+
+Error InferResultGrpc::ModelName(std::string* name) const {
+  *name = response_->model_name();
+  return Error::Success;
+}
+
+Error InferResultGrpc::ModelVersion(std::string* version) const {
+  *version = response_->model_version();
+  return Error::Success;
+}
+
+Error InferResultGrpc::Id(std::string* id) const {
+  *id = response_->id();
+  return Error::Success;
+}
+
+Error InferResultGrpc::Shape(
+    const std::string& output_name, std::vector<int64_t>* shape) const {
+  const inference::ModelInferResponse::InferOutputTensor* tensor;
+  size_t index;
+  Error err = FindOutput(output_name, &tensor, &index);
+  if (!err.IsOk()) return err;
+  shape->assign(tensor->shape().begin(), tensor->shape().end());
+  return Error::Success;
+}
+
+Error InferResultGrpc::Datatype(
+    const std::string& output_name, std::string* datatype) const {
+  const inference::ModelInferResponse::InferOutputTensor* tensor;
+  size_t index;
+  Error err = FindOutput(output_name, &tensor, &index);
+  if (!err.IsOk()) return err;
+  *datatype = tensor->datatype();
+  return Error::Success;
+}
+
+Error InferResultGrpc::RawData(
+    const std::string& output_name, const uint8_t** buf,
+    size_t* byte_size) const {
+  const inference::ModelInferResponse::InferOutputTensor* tensor;
+  size_t index;
+  Error err = FindOutput(output_name, &tensor, &index);
+  if (!err.IsOk()) return err;
+  if (static_cast<int>(index) < response_->raw_output_contents_size()) {
+    const std::string& raw = response_->raw_output_contents(index);
+    *buf = reinterpret_cast<const uint8_t*>(raw.data());
+    *byte_size = raw.size();
+    return Error::Success;
+  }
+  return Error(
+      "output '" + output_name + "' has no raw data (in shared memory?)");
+}
+
+Error InferResultGrpc::StringData(
+    const std::string& output_name,
+    std::vector<std::string>* string_result) const {
+  const uint8_t* buf;
+  size_t byte_size;
+  Error err = RawData(output_name, &buf, &byte_size);
+  if (!err.IsOk()) return err;
+  string_result->clear();
+  size_t pos = 0;
+  while (pos + 4 <= byte_size) {
+    uint32_t len;
+    memcpy(&len, buf + pos, 4);  // little-endian wire format
+    pos += 4;
+    if (pos + len > byte_size) {
+      return Error("malformed BYTES tensor in output '" + output_name + "'");
+    }
+    string_result->emplace_back(
+        reinterpret_cast<const char*>(buf + pos), len);
+    pos += len;
+  }
+  return Error::Success;
+}
+
+std::string InferResultGrpc::DebugString() const {
+  return response_->DebugString();
+}
+
+Error InferResultGrpc::RequestStatus() const { return status_; }
+
+//==============================================================================
+// InferenceServerGrpcClient
+
+InferenceServerGrpcClient::InferenceServerGrpcClient(bool verbose)
+    : InferenceServerClient(verbose) {}
+
+InferenceServerGrpcClient::~InferenceServerGrpcClient() {
+  StopStream();
+  // Fail all in-flight async calls now, while completed_ is still
+  // alive to receive their results; the dispatch worker then drains
+  // the queue before exiting (members destruct after the join).
+  if (channel_) channel_->Shutdown();
+  {
+    std::lock_guard<std::mutex> lock(mutex_);
+    exiting_ = true;
+  }
+  cv_.notify_all();
+  if (worker_.joinable()) worker_.join();
+}
+
+Error InferenceServerGrpcClient::Create(
+    std::unique_ptr<InferenceServerGrpcClient>* client,
+    const std::string& url, bool verbose) {
+  client->reset(new InferenceServerGrpcClient(verbose));
+  Error err = GrpcChannel::Create(&(*client)->channel_, url);
+  if (!err.IsOk()) client->reset();
+  return err;
+}
+
+Error InferenceServerGrpcClient::Rpc(
+    const std::string& method, const google::protobuf::Message& req,
+    google::protobuf::Message* resp, const Headers& headers,
+    uint64_t timeout_us, RequestTimers* timers) {
+  std::string request_bytes;
+  if (!req.SerializeToString(&request_bytes)) {
+    return Error("failed to serialize request");
+  }
+  if (request_bytes.size() > static_cast<size_t>(INT32_MAX)) {
+    // Parity: the reference rejects >INT_MAX messages
+    // (grpc_client.cc:1459).
+    return Error("request exceeds 2GB gRPC message limit");
+  }
+  std::string response_bytes;
+  Error err = channel_->UnaryCall(
+      method, request_bytes, &response_bytes, timeout_us, headers, timers);
+  if (!err.IsOk()) return err;
+  if (!resp->ParseFromString(response_bytes)) {
+    return Error("failed to parse response");
+  }
+  if (verbose_) {
+    fprintf(stderr, "%s\n%s\n", method.c_str(),
+            resp->DebugString().c_str());
+  }
+  return Error::Success;
+}
+
+Error InferenceServerGrpcClient::IsServerLive(
+    bool* live, const Headers& headers) {
+  inference::ServerLiveRequest req;
+  inference::ServerLiveResponse resp;
+  Error err = Rpc(Method("ServerLive"), req, &resp, headers);
+  *live = err.IsOk() && resp.live();
+  return err;
+}
+
+Error InferenceServerGrpcClient::IsServerReady(
+    bool* ready, const Headers& headers) {
+  inference::ServerReadyRequest req;
+  inference::ServerReadyResponse resp;
+  Error err = Rpc(Method("ServerReady"), req, &resp, headers);
+  *ready = err.IsOk() && resp.ready();
+  return err;
+}
+
+Error InferenceServerGrpcClient::IsModelReady(
+    bool* ready, const std::string& model_name,
+    const std::string& model_version, const Headers& headers) {
+  inference::ModelReadyRequest req;
+  req.set_name(model_name);
+  req.set_version(model_version);
+  inference::ModelReadyResponse resp;
+  Error err = Rpc(Method("ModelReady"), req, &resp, headers);
+  *ready = err.IsOk() && resp.ready();
+  return err;
+}
+
+Error InferenceServerGrpcClient::ServerMetadata(
+    inference::ServerMetadataResponse* server_metadata,
+    const Headers& headers) {
+  inference::ServerMetadataRequest req;
+  return Rpc(Method("ServerMetadata"), req, server_metadata, headers);
+}
+
+Error InferenceServerGrpcClient::ModelMetadata(
+    inference::ModelMetadataResponse* model_metadata,
+    const std::string& model_name, const std::string& model_version,
+    const Headers& headers) {
+  inference::ModelMetadataRequest req;
+  req.set_name(model_name);
+  req.set_version(model_version);
+  return Rpc(Method("ModelMetadata"), req, model_metadata, headers);
+}
+
+Error InferenceServerGrpcClient::ModelConfig(
+    inference::ModelConfigResponse* model_config,
+    const std::string& model_name, const std::string& model_version,
+    const Headers& headers) {
+  inference::ModelConfigRequest req;
+  req.set_name(model_name);
+  req.set_version(model_version);
+  return Rpc(Method("ModelConfig"), req, model_config, headers);
+}
+
+Error InferenceServerGrpcClient::ModelRepositoryIndex(
+    inference::RepositoryIndexResponse* repository_index,
+    const Headers& headers) {
+  inference::RepositoryIndexRequest req;
+  return Rpc(Method("RepositoryIndex"), req, repository_index, headers);
+}
+
+Error InferenceServerGrpcClient::LoadModel(
+    const std::string& model_name, const Headers& headers,
+    const std::string& config) {
+  inference::RepositoryModelLoadRequest req;
+  req.set_model_name(model_name);
+  if (!config.empty()) {
+    (*req.mutable_parameters())["config"].set_string_param(config);
+  }
+  inference::RepositoryModelLoadResponse resp;
+  return Rpc(Method("RepositoryModelLoad"), req, &resp, headers);
+}
+
+Error InferenceServerGrpcClient::UnloadModel(
+    const std::string& model_name, const Headers& headers) {
+  inference::RepositoryModelUnloadRequest req;
+  req.set_model_name(model_name);
+  inference::RepositoryModelUnloadResponse resp;
+  return Rpc(Method("RepositoryModelUnload"), req, &resp, headers);
+}
+
+Error InferenceServerGrpcClient::ModelInferenceStatistics(
+    inference::ModelStatisticsResponse* infer_stat,
+    const std::string& model_name, const std::string& model_version,
+    const Headers& headers) {
+  inference::ModelStatisticsRequest req;
+  req.set_name(model_name);
+  req.set_version(model_version);
+  return Rpc(Method("ModelStatistics"), req, infer_stat, headers);
+}
+
+Error InferenceServerGrpcClient::UpdateTraceSettings(
+    inference::TraceSettingResponse* response, const std::string& model_name,
+    const std::map<std::string, std::vector<std::string>>& settings,
+    const Headers& headers) {
+  inference::TraceSettingRequest req;
+  req.set_model_name(model_name);
+  for (const auto& kv : settings) {
+    auto& value = (*req.mutable_settings())[kv.first];
+    for (const auto& v : kv.second) value.add_value(v);
+  }
+  return Rpc(Method("TraceSetting"), req, response, headers);
+}
+
+Error InferenceServerGrpcClient::GetTraceSettings(
+    inference::TraceSettingResponse* settings, const std::string& model_name,
+    const Headers& headers) {
+  inference::TraceSettingRequest req;
+  req.set_model_name(model_name);
+  return Rpc(Method("TraceSetting"), req, settings, headers);
+}
+
+Error InferenceServerGrpcClient::SystemSharedMemoryStatus(
+    inference::SystemSharedMemoryStatusResponse* status,
+    const std::string& region_name, const Headers& headers) {
+  inference::SystemSharedMemoryStatusRequest req;
+  req.set_name(region_name);
+  return Rpc(Method("SystemSharedMemoryStatus"), req, status, headers);
+}
+
+Error InferenceServerGrpcClient::RegisterSystemSharedMemory(
+    const std::string& name, const std::string& key, size_t byte_size,
+    size_t offset, const Headers& headers) {
+  inference::SystemSharedMemoryRegisterRequest req;
+  req.set_name(name);
+  req.set_key(key);
+  req.set_offset(offset);
+  req.set_byte_size(byte_size);
+  inference::SystemSharedMemoryRegisterResponse resp;
+  return Rpc(Method("SystemSharedMemoryRegister"), req, &resp, headers);
+}
+
+Error InferenceServerGrpcClient::UnregisterSystemSharedMemory(
+    const std::string& name, const Headers& headers) {
+  inference::SystemSharedMemoryUnregisterRequest req;
+  req.set_name(name);
+  inference::SystemSharedMemoryUnregisterResponse resp;
+  return Rpc(Method("SystemSharedMemoryUnregister"), req, &resp, headers);
+}
+
+Error InferenceServerGrpcClient::TpuSharedMemoryStatus(
+    inference::TpuSharedMemoryStatusResponse* status,
+    const std::string& region_name, const Headers& headers) {
+  inference::TpuSharedMemoryStatusRequest req;
+  req.set_name(region_name);
+  return Rpc(Method("TpuSharedMemoryStatus"), req, status, headers);
+}
+
+Error InferenceServerGrpcClient::RegisterTpuSharedMemory(
+    const std::string& name, const std::string& raw_handle,
+    int64_t device_id, size_t byte_size, const Headers& headers) {
+  inference::TpuSharedMemoryRegisterRequest req;
+  req.set_name(name);
+  req.set_raw_handle(raw_handle);
+  req.set_device_id(device_id);
+  req.set_byte_size(byte_size);
+  inference::TpuSharedMemoryRegisterResponse resp;
+  return Rpc(Method("TpuSharedMemoryRegister"), req, &resp, headers);
+}
+
+Error InferenceServerGrpcClient::UnregisterTpuSharedMemory(
+    const std::string& name, const Headers& headers) {
+  inference::TpuSharedMemoryUnregisterRequest req;
+  req.set_name(name);
+  inference::TpuSharedMemoryUnregisterResponse resp;
+  return Rpc(Method("TpuSharedMemoryUnregister"), req, &resp, headers);
+}
+
+Error InferenceServerGrpcClient::PreRunProcessing(
+    inference::ModelInferRequest* request, const InferOptions& options,
+    const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs) {
+  request->set_model_name(options.model_name);
+  request->set_model_version(options.model_version);
+  request->set_id(options.request_id);
+
+  auto& params = *request->mutable_parameters();
+  if (options.sequence_id != 0) {
+    params["sequence_id"].set_int64_param(options.sequence_id);
+    params["sequence_start"].set_bool_param(options.sequence_start);
+    params["sequence_end"].set_bool_param(options.sequence_end);
+  }
+  if (options.priority != 0) {
+    params["priority"].set_int64_param(options.priority);
+  }
+  if (options.server_timeout_us != 0) {
+    params["timeout"].set_int64_param(options.server_timeout_us);
+  }
+  for (const auto& kv : options.string_params)
+    params[kv.first].set_string_param(kv.second);
+  for (const auto& kv : options.int_params)
+    params[kv.first].set_int64_param(kv.second);
+  for (const auto& kv : options.bool_params)
+    params[kv.first].set_bool_param(kv.second);
+  for (const auto& kv : options.double_params)
+    params[kv.first].set_double_param(kv.second);
+
+  size_t total_bytes = 0;
+  for (InferInput* input : inputs) {
+    auto* tensor = request->add_inputs();
+    tensor->set_name(input->Name());
+    tensor->set_datatype(input->Datatype());
+    for (int64_t dim : input->Shape()) tensor->add_shape(dim);
+    if (input->IsSharedMemory()) {
+      std::string region;
+      size_t byte_size, offset;
+      input->SharedMemoryInfo(&region, &byte_size, &offset);
+      auto& tensor_params = *tensor->mutable_parameters();
+      // Same parameter convention as the reference
+      // (grpc_client.cc:1494-1507).
+      tensor_params["shared_memory_region"].set_string_param(region);
+      tensor_params["shared_memory_byte_size"].set_int64_param(byte_size);
+      if (offset != 0) {
+        tensor_params["shared_memory_offset"].set_int64_param(offset);
+      }
+    } else {
+      std::string* raw = request->add_raw_input_contents();
+      input->PrepareForRequest();
+      raw->clear();
+      raw->reserve(input->TotalSendByteSize());
+      const uint8_t* buf;
+      size_t chunk;
+      while (input->GetNext(&buf, &chunk)) {
+        raw->append(reinterpret_cast<const char*>(buf), chunk);
+      }
+      total_bytes += raw->size();
+    }
+  }
+  if (total_bytes > static_cast<size_t>(INT32_MAX)) {
+    return Error("request exceeds 2GB gRPC message limit");
+  }
+
+  for (const InferRequestedOutput* output : outputs) {
+    auto* tensor = request->add_outputs();
+    tensor->set_name(output->Name());
+    auto& tensor_params = *tensor->mutable_parameters();
+    if (output->ClassCount() > 0) {
+      tensor_params["classification"].set_int64_param(output->ClassCount());
+    }
+    if (output->IsSharedMemory()) {
+      std::string region;
+      size_t byte_size, offset;
+      output->SharedMemoryInfo(&region, &byte_size, &offset);
+      tensor_params["shared_memory_region"].set_string_param(region);
+      tensor_params["shared_memory_byte_size"].set_int64_param(byte_size);
+      if (offset != 0) {
+        tensor_params["shared_memory_offset"].set_int64_param(offset);
+      }
+    }
+  }
+  return Error::Success;
+}
+
+Error InferenceServerGrpcClient::Infer(
+    InferResult** result, const InferOptions& options,
+    const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs,
+    const Headers& headers) {
+  inference::ModelInferRequest request;
+  Error err = PreRunProcessing(&request, options, inputs, outputs);
+  if (!err.IsOk()) return err;
+  auto response = std::make_shared<inference::ModelInferResponse>();
+  RequestTimers timers;
+  err = Rpc(
+      Method("ModelInfer"), request, response.get(), headers,
+      options.client_timeout_us, &timers);
+  UpdateInferStat(timers);
+  if (!err.IsOk()) return err;
+  return InferResultGrpc::Create(result, std::move(response));
+}
+
+Error InferenceServerGrpcClient::AsyncInfer(
+    OnCompleteFn callback, const InferOptions& options,
+    const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs,
+    const Headers& headers) {
+  if (callback == nullptr) {
+    return Error("callback must not be null for AsyncInfer");
+  }
+  if (!dispatch_started_.exchange(true)) {
+    worker_ = std::thread(&InferenceServerGrpcClient::DispatchLoop, this);
+  }
+  inference::ModelInferRequest request;
+  Error err = PreRunProcessing(&request, options, inputs, outputs);
+  if (!err.IsOk()) return err;
+  std::string request_bytes;
+  if (!request.SerializeToString(&request_bytes)) {
+    return Error("failed to serialize request");
+  }
+  return channel_->AsyncUnaryCall(
+      Method("ModelInfer"), request_bytes,
+      [this, callback](
+          const Error& status, std::string&& response_bytes,
+          const RequestTimers& timers) {
+        auto response = std::make_shared<inference::ModelInferResponse>();
+        Error final_status = status;
+        if (final_status.IsOk() &&
+            !response->ParseFromString(response_bytes)) {
+          final_status = Error("failed to parse response");
+        }
+        UpdateInferStat(timers);
+        InferResult* result = nullptr;
+        InferResultGrpc::Create(&result, std::move(response), final_status);
+        {
+          std::lock_guard<std::mutex> lock(mutex_);
+          completed_.push_back({callback, result});
+        }
+        cv_.notify_all();
+      },
+      options.client_timeout_us, headers);
+}
+
+Error InferenceServerGrpcClient::InferMulti(
+    std::vector<InferResult*>* results,
+    const std::vector<InferOptions>& options,
+    const std::vector<std::vector<InferInput*>>& inputs,
+    const std::vector<std::vector<const InferRequestedOutput*>>& outputs,
+    const Headers& headers) {
+  // Parity with reference semantics (grpc_client.cc:1213): one
+  // options entry may fan out over all requests.
+  if (options.size() != 1 && options.size() != inputs.size()) {
+    return Error("options size must be 1 or match inputs size");
+  }
+  results->clear();
+  for (size_t i = 0; i < inputs.size(); ++i) {
+    const InferOptions& opt = options.size() == 1 ? options[0] : options[i];
+    const auto& outs = outputs.empty()
+                           ? std::vector<const InferRequestedOutput*>{}
+                           : outputs[outputs.size() == 1 ? 0 : i];
+    InferResult* result = nullptr;
+    Error err = Infer(&result, opt, inputs[i], outs, headers);
+    if (!err.IsOk()) {
+      for (auto* r : *results) delete r;
+      results->clear();
+      return err;
+    }
+    results->push_back(result);
+  }
+  return Error::Success;
+}
+
+Error InferenceServerGrpcClient::AsyncInferMulti(
+    OnMultiCompleteFn callback, const std::vector<InferOptions>& options,
+    const std::vector<std::vector<InferInput*>>& inputs,
+    const std::vector<std::vector<const InferRequestedOutput*>>& outputs,
+    const Headers& headers) {
+  if (callback == nullptr) {
+    return Error("callback must not be null for AsyncInferMulti");
+  }
+  if (options.size() != 1 && options.size() != inputs.size()) {
+    return Error("options size must be 1 or match inputs size");
+  }
+  struct MultiState {
+    std::mutex mutex;
+    std::vector<InferResult*> results;
+    size_t remaining;
+    OnMultiCompleteFn callback;
+  };
+  auto state = std::make_shared<MultiState>();
+  state->results.resize(inputs.size(), nullptr);
+  state->remaining = inputs.size();
+  state->callback = std::move(callback);
+  for (size_t i = 0; i < inputs.size(); ++i) {
+    const InferOptions& opt = options.size() == 1 ? options[0] : options[i];
+    const auto& outs = outputs.empty()
+                           ? std::vector<const InferRequestedOutput*>{}
+                           : outputs[outputs.size() == 1 ? 0 : i];
+    Error err = AsyncInfer(
+        [state, i](InferResult* result) {
+          bool fire = false;
+          {
+            std::lock_guard<std::mutex> lock(state->mutex);
+            state->results[i] = result;
+            fire = (--state->remaining == 0);
+          }
+          if (fire) state->callback(state->results);
+        },
+        opt, inputs[i], outs, headers);
+    if (!err.IsOk()) {
+      InferResult* error_result = nullptr;
+      auto response = std::make_shared<inference::ModelInferResponse>();
+      InferResultGrpc::Create(&error_result, std::move(response), err);
+      bool fire = false;
+      {
+        std::lock_guard<std::mutex> lock(state->mutex);
+        state->results[i] = error_result;
+        fire = (--state->remaining == 0);
+      }
+      if (fire) state->callback(state->results);
+    }
+  }
+  return Error::Success;
+}
+
+Error InferenceServerGrpcClient::StartStream(
+    OnCompleteFn callback, bool enable_stats, uint32_t stream_timeout,
+    const Headers& headers) {
+  if (callback == nullptr) {
+    return Error("callback must not be null for StartStream");
+  }
+  std::lock_guard<std::mutex> stream_lock(stream_mutex_);
+  if (bidi_stream_ != nullptr) {
+    return Error("cannot start another stream with one already running");
+  }
+  if (!dispatch_started_.exchange(true)) {
+    worker_ = std::thread(&InferenceServerGrpcClient::DispatchLoop, this);
+  }
+  stream_callback_ = std::move(callback);
+  stream_stats_ = enable_stats;
+  Headers stream_headers = headers;
+  if (stream_timeout > 0) {
+    stream_headers["grpc-timeout"] = std::to_string(stream_timeout) + "u";
+  }
+  return channel_->StartBidiStream(
+      &bidi_stream_, Method("ModelStreamInfer"),
+      [this](std::string&& message_bytes) {
+        auto stream_response =
+            std::make_shared<inference::ModelStreamInferResponse>();
+        Error status = Error::Success;
+        if (!stream_response->ParseFromString(message_bytes)) {
+          status = Error("failed to parse stream response");
+        }
+        InferResult* result = nullptr;
+        InferResultGrpc::Create(&result, std::move(stream_response));
+        {
+          std::lock_guard<std::mutex> lock(mutex_);
+          completed_.push_back({stream_callback_, result});
+        }
+        cv_.notify_all();
+      },
+      [this](const Error& status) {
+        if (!status.IsOk()) {
+          // Surface terminal stream errors as a result with error
+          // status (parity: grpc_client.cc:1663-1669).
+          auto response = std::make_shared<inference::ModelInferResponse>();
+          InferResult* result = nullptr;
+          InferResultGrpc::Create(&result, std::move(response), status);
+          std::lock_guard<std::mutex> lock(mutex_);
+          if (stream_callback_) completed_.push_back({stream_callback_, result});
+          cv_.notify_all();
+        }
+      },
+      headers);
+}
+
+Error InferenceServerGrpcClient::StopStream() {
+  std::lock_guard<std::mutex> stream_lock(stream_mutex_);
+  if (bidi_stream_ == nullptr) return Error::Success;
+  bidi_stream_->WritesDone();
+  Error err = bidi_stream_->Finish();
+  bidi_stream_.reset();
+  {
+    std::lock_guard<std::mutex> lock(mutex_);
+    stream_callback_ = nullptr;
+  }
+  return err;
+}
+
+Error InferenceServerGrpcClient::AsyncStreamInfer(
+    const InferOptions& options, const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs) {
+  if (bidi_stream_ == nullptr) {
+    return Error("stream not established, use StartStream() first");
+  }
+  inference::ModelInferRequest request;
+  Error err = PreRunProcessing(&request, options, inputs, outputs);
+  if (!err.IsOk()) return err;
+  std::string request_bytes;
+  if (!request.SerializeToString(&request_bytes)) {
+    return Error("failed to serialize request");
+  }
+  return bidi_stream_->Write(request_bytes);
+}
+
+void InferenceServerGrpcClient::DispatchLoop() {
+  while (true) {
+    Completed item;
+    {
+      std::unique_lock<std::mutex> lock(mutex_);
+      cv_.wait(lock, [this] { return exiting_ || !completed_.empty(); });
+      if (completed_.empty()) {
+        if (exiting_) return;
+        continue;
+      }
+      item = std::move(completed_.front());
+      completed_.pop_front();
+    }
+    if (item.callback) {
+      item.callback(item.result);
+    } else {
+      delete item.result;
+    }
+  }
+}
+
+}  // namespace tpuclient

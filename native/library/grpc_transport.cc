@@ -1,0 +1,406 @@
+#include "grpc_transport.h"
+
+#include <cstring>
+
+namespace tpuclient {
+
+std::string PercentDecode(const std::string& in) {
+  std::string out;
+  out.reserve(in.size());
+  for (size_t i = 0; i < in.size(); ++i) {
+    if (in[i] == '%' && i + 2 < in.size()) {
+      auto hex = [](char c) -> int {
+        if (c >= '0' && c <= '9') return c - '0';
+        if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+        if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+        return -1;
+      };
+      int hi = hex(in[i + 1]), lo = hex(in[i + 2]);
+      if (hi >= 0 && lo >= 0) {
+        out.push_back(static_cast<char>((hi << 4) | lo));
+        i += 2;
+        continue;
+      }
+    }
+    out.push_back(in[i]);
+  }
+  return out;
+}
+
+std::string FrameGrpcMessage(const std::string& payload) {
+  std::string framed;
+  framed.reserve(payload.size() + 5);
+  framed.push_back('\0');  // uncompressed
+  uint32_t len = static_cast<uint32_t>(payload.size());
+  framed.push_back(static_cast<char>(len >> 24));
+  framed.push_back(static_cast<char>(len >> 16));
+  framed.push_back(static_cast<char>(len >> 8));
+  framed.push_back(static_cast<char>(len));
+  framed.append(payload);
+  return framed;
+}
+
+bool GrpcMessageReader::Feed(
+    const uint8_t* data, size_t len, std::vector<std::string>* messages) {
+  buffer_.append(reinterpret_cast<const char*>(data), len);
+  while (buffer_.size() >= 5) {
+    uint8_t flag = static_cast<uint8_t>(buffer_[0]);
+    if (flag > 1) return false;
+    uint32_t msg_len =
+        (static_cast<uint32_t>(static_cast<uint8_t>(buffer_[1])) << 24) |
+        (static_cast<uint32_t>(static_cast<uint8_t>(buffer_[2])) << 16) |
+        (static_cast<uint32_t>(static_cast<uint8_t>(buffer_[3])) << 8) |
+        static_cast<uint8_t>(buffer_[4]);
+    if (flag == 1) return false;  // no compression negotiated
+    if (buffer_.size() < 5u + msg_len) break;
+    messages->emplace_back(buffer_.substr(5, msg_len));
+    buffer_.erase(0, 5 + msg_len);
+  }
+  return true;
+}
+
+Error StatusFromTrailers(
+    const h2::HeaderList& headers, const h2::HeaderList& trailers,
+    const std::string& transport_error) {
+  if (!transport_error.empty()) {
+    return Error("transport error: " + transport_error);
+  }
+  const std::string* status = nullptr;
+  const std::string* message = nullptr;
+  auto scan = [&](const h2::HeaderList& list) {
+    for (const auto& kv : list) {
+      if (kv.first == "grpc-status") status = &kv.second;
+      else if (kv.first == "grpc-message") message = &kv.second;
+    }
+  };
+  scan(trailers);
+  if (status == nullptr) scan(headers);
+  if (status == nullptr) {
+    for (const auto& kv : headers) {
+      if (kv.first == ":status" && kv.second != "200") {
+        return Error("HTTP status " + kv.second);
+      }
+    }
+    return Error("missing grpc-status");
+  }
+  if (*status == "0") return Error::Success;
+  std::string text = "gRPC error " + *status;
+  if (message != nullptr && !message->empty()) {
+    text += ": " + PercentDecode(*message);
+  }
+  return Error(text);
+}
+
+//==============================================================================
+// GrpcChannel
+
+Error GrpcChannel::Create(
+    std::shared_ptr<GrpcChannel>* channel, const std::string& url,
+    uint64_t connect_timeout_us) {
+  std::string host = url;
+  int port = 8001;
+  // Strip optional scheme, split host:port.
+  size_t scheme = host.find("://");
+  if (scheme != std::string::npos) host = host.substr(scheme + 3);
+  size_t colon = host.rfind(':');
+  if (colon != std::string::npos) {
+    port = atoi(host.substr(colon + 1).c_str());
+    host = host.substr(0, colon);
+  }
+  auto ch = std::shared_ptr<GrpcChannel>(new GrpcChannel(host, port));
+  ch->conn_ = std::make_shared<h2::H2Connection>(host, port);
+  std::string err = ch->conn_->Connect(connect_timeout_us);
+  if (!err.empty()) return Error(err);
+  *channel = ch;
+  return Error::Success;
+}
+
+h2::HeaderList GrpcChannel::BuildRequestHeaders(
+    const std::string& method, uint64_t timeout_us,
+    const Headers& metadata) const {
+  h2::HeaderList headers;
+  headers.emplace_back(":method", "POST");
+  headers.emplace_back(":scheme", "http");
+  headers.emplace_back(":path", method);
+  headers.emplace_back(":authority", host_ + ":" + std::to_string(port_));
+  headers.emplace_back("te", "trailers");
+  headers.emplace_back("content-type", "application/grpc");
+  headers.emplace_back("user-agent", "tpuclient-grpc/1.0");
+  if (timeout_us > 0) {
+    // The gRPC spec caps TimeoutValue at 8 digits; step up units as
+    // needed.
+    if (timeout_us < 100000000ull) {
+      headers.emplace_back("grpc-timeout", std::to_string(timeout_us) + "u");
+    } else if (timeout_us / 1000 < 100000000ull) {
+      headers.emplace_back(
+          "grpc-timeout", std::to_string(timeout_us / 1000) + "m");
+    } else {
+      uint64_t secs = std::min<uint64_t>(timeout_us / 1000000, 99999999ull);
+      headers.emplace_back("grpc-timeout", std::to_string(secs) + "S");
+    }
+  }
+  for (const auto& kv : metadata) {
+    headers.emplace_back(kv.first, kv.second);
+  }
+  return headers;
+}
+
+namespace {
+
+// Shared state for one unary call, owned jointly by the caller (sync)
+// or nobody (async, callbacks keep it alive) and the H2 callbacks.
+struct UnaryState {
+  std::mutex mutex;
+  std::condition_variable cv;
+  bool done = false;
+  GrpcMessageReader reader;
+  std::vector<std::string> messages;
+  h2::HeaderList headers;
+  Error status = Error::Success;
+  RequestTimers timers;
+  GrpcChannel::AsyncUnaryCallback async_callback;  // async mode only
+};
+
+h2::StreamCallbacks MakeUnaryCallbacks(std::shared_ptr<UnaryState> state) {
+  h2::StreamCallbacks callbacks;
+  callbacks.on_headers = [state](const h2::HeaderList& headers) {
+    std::lock_guard<std::mutex> lock(state->mutex);
+    state->headers = headers;
+  };
+  callbacks.on_data = [state](const uint8_t* data, size_t len) {
+    std::lock_guard<std::mutex> lock(state->mutex);
+    if (!state->reader.Feed(data, len, &state->messages)) {
+      state->status = Error("malformed gRPC frame");
+    }
+  };
+  callbacks.on_close = [state](
+                           const h2::HeaderList& trailers,
+                           const std::string& transport_error) {
+    GrpcChannel::AsyncUnaryCallback callback;
+    Error status;
+    std::string response;
+    {
+      std::lock_guard<std::mutex> lock(state->mutex);
+      state->timers.CaptureTimestamp(RequestTimers::Kind::RECV_END);
+      if (state->status.IsOk()) {
+        state->status = StatusFromTrailers(
+            state->headers, trailers, transport_error);
+      }
+      if (state->status.IsOk() && state->messages.empty()) {
+        state->status = Error("no response message");
+      }
+      state->done = true;
+      status = state->status;
+      callback = std::move(state->async_callback);
+      // Sync callers read messages[0] themselves after the wait.
+      if (callback && !state->messages.empty()) {
+        response = std::move(state->messages[0]);
+      }
+    }
+    state->cv.notify_all();
+    if (callback) {
+      state->timers.CaptureTimestamp(RequestTimers::Kind::REQUEST_END);
+      callback(status, std::move(response), state->timers);
+    }
+  };
+  return callbacks;
+}
+
+}  // namespace
+
+Error GrpcChannel::UnaryCall(
+    const std::string& method, const std::string& request,
+    std::string* response, uint64_t timeout_us, const Headers& metadata,
+    RequestTimers* timers) {
+  auto state = std::make_shared<UnaryState>();
+  state->timers.CaptureTimestamp(RequestTimers::Kind::REQUEST_START);
+  std::string err;
+  state->timers.CaptureTimestamp(RequestTimers::Kind::SEND_START);
+  int32_t stream_id = conn_->StartStream(
+      BuildRequestHeaders(method, timeout_us, metadata),
+      MakeUnaryCallbacks(state), &err);
+  if (stream_id < 0) return Error(err);
+  std::string framed = FrameGrpcMessage(request);
+  err = conn_->SendData(
+      stream_id, reinterpret_cast<const uint8_t*>(framed.data()),
+      framed.size(), /*end_stream=*/true);
+  {
+    // Under the lock: on_close may already be capturing RECV_END on
+    // the reader thread.
+    std::lock_guard<std::mutex> lock(state->mutex);
+    state->timers.CaptureTimestamp(RequestTimers::Kind::SEND_END);
+    state->timers.CaptureTimestamp(RequestTimers::Kind::RECV_START);
+  }
+  if (!err.empty()) {
+    // The stream may have finished before the send completed (server
+    // rejected the call and reset the stream): prefer the gRPC status
+    // captured by on_close when it arrives promptly.
+    std::unique_lock<std::mutex> lock(state->mutex);
+    if (state->cv.wait_for(
+            lock, std::chrono::seconds(5), [&] { return state->done; }) &&
+        !state->status.IsOk()) {
+      return state->status;
+    }
+    return Error(err);
+  }
+
+  std::unique_lock<std::mutex> lock(state->mutex);
+  if (timeout_us > 0) {
+    if (!state->cv.wait_for(
+            lock, std::chrono::microseconds(timeout_us),
+            [&] { return state->done; })) {
+      lock.unlock();
+      conn_->CancelStream(stream_id);
+      lock.lock();
+      state->cv.wait(lock, [&] { return state->done; });
+      return Error("Deadline Exceeded");
+    }
+  } else {
+    state->cv.wait(lock, [&] { return state->done; });
+  }
+  state->timers.CaptureTimestamp(RequestTimers::Kind::REQUEST_END);
+  if (timers != nullptr) *timers = state->timers;
+  if (!state->status.IsOk()) return state->status;
+  *response = std::move(state->messages[0]);
+  return Error::Success;
+}
+
+Error GrpcChannel::AsyncUnaryCall(
+    const std::string& method, const std::string& request,
+    AsyncUnaryCallback callback, uint64_t timeout_us,
+    const Headers& metadata) {
+  auto state = std::make_shared<UnaryState>();
+  state->async_callback = std::move(callback);
+  state->timers.CaptureTimestamp(RequestTimers::Kind::REQUEST_START);
+  state->timers.CaptureTimestamp(RequestTimers::Kind::SEND_START);
+  std::string err;
+  int32_t stream_id = conn_->StartStream(
+      BuildRequestHeaders(method, timeout_us, metadata),
+      MakeUnaryCallbacks(state), &err);
+  if (stream_id < 0) return Error(err);
+  std::string framed = FrameGrpcMessage(request);
+  // Once the stream is open, completion is owned by on_close — even
+  // on a send error it fires (the stream already finished, or the
+  // broken connection triggers FailAll), so never ALSO return an
+  // error here: the caller would double-complete.
+  conn_->SendData(
+      stream_id, reinterpret_cast<const uint8_t*>(framed.data()),
+      framed.size(), /*end_stream=*/true);
+  {
+    std::lock_guard<std::mutex> lock(state->mutex);
+    state->timers.CaptureTimestamp(RequestTimers::Kind::SEND_END);
+    state->timers.CaptureTimestamp(RequestTimers::Kind::RECV_START);
+  }
+  return Error::Success;
+}
+
+//==============================================================================
+// GrpcBidiStream
+
+struct GrpcBidiStream::State {
+  std::mutex mutex;
+  std::condition_variable cv;
+  bool done = false;
+  Error status = Error::Success;
+  GrpcMessageReader reader;
+  h2::HeaderList headers;
+  std::function<void(std::string&&)> on_message;
+  std::function<void(const Error&)> on_done;
+};
+
+GrpcBidiStream::~GrpcBidiStream() {
+  if (conn_ && stream_id_ >= 0) {
+    bool open;
+    {
+      std::lock_guard<std::mutex> lock(state_->mutex);
+      open = !state_->done;
+    }
+    // Abandoned without Finish(): cancel to release the stream. Must
+    // not hold state_->mutex here — CancelStream fires on_close which
+    // locks it.
+    if (open) conn_->CancelStream(stream_id_);
+  }
+}
+
+Error GrpcBidiStream::Write(const std::string& message) {
+  std::string framed = FrameGrpcMessage(message);
+  std::string err = conn_->SendData(
+      stream_id_, reinterpret_cast<const uint8_t*>(framed.data()),
+      framed.size(), /*end_stream=*/false);
+  if (!err.empty()) return Error(err);
+  return Error::Success;
+}
+
+Error GrpcBidiStream::WritesDone() {
+  std::string err = conn_->CloseSendSide(stream_id_);
+  if (!err.empty()) return Error(err);
+  return Error::Success;
+}
+
+void GrpcBidiStream::Cancel() { conn_->CancelStream(stream_id_); }
+
+Error GrpcBidiStream::Finish() {
+  std::unique_lock<std::mutex> lock(state_->mutex);
+  state_->cv.wait(lock, [&] { return state_->done; });
+  return state_->status;
+}
+
+Error GrpcChannel::StartBidiStream(
+    std::unique_ptr<GrpcBidiStream>* stream, const std::string& method,
+    std::function<void(std::string&&)> on_message,
+    std::function<void(const Error&)> on_done, const Headers& metadata) {
+  auto state = std::make_shared<GrpcBidiStream::State>();
+  state->on_message = std::move(on_message);
+  state->on_done = std::move(on_done);
+
+  h2::StreamCallbacks callbacks;
+  callbacks.on_headers = [state](const h2::HeaderList& headers) {
+    std::lock_guard<std::mutex> lock(state->mutex);
+    state->headers = headers;
+  };
+  callbacks.on_data = [state](const uint8_t* data, size_t len) {
+    std::vector<std::string> messages;
+    {
+      std::lock_guard<std::mutex> lock(state->mutex);
+      if (!state->reader.Feed(data, len, &messages)) {
+        state->status = Error("malformed gRPC frame");
+        return;
+      }
+    }
+    if (state->on_message) {
+      for (auto& m : messages) state->on_message(std::move(m));
+    }
+  };
+  callbacks.on_close = [state](
+                           const h2::HeaderList& trailers,
+                           const std::string& transport_error) {
+    Error status;
+    std::function<void(const Error&)> on_done;
+    {
+      std::lock_guard<std::mutex> lock(state->mutex);
+      if (state->status.IsOk()) {
+        state->status =
+            StatusFromTrailers(state->headers, trailers, transport_error);
+      }
+      state->done = true;
+      status = state->status;
+      on_done = state->on_done;
+    }
+    state->cv.notify_all();
+    if (on_done) on_done(status);
+  };
+
+  std::string err;
+  int32_t stream_id = conn_->StartStream(
+      BuildRequestHeaders(method, 0, metadata), std::move(callbacks), &err);
+  if (stream_id < 0) return Error(err);
+
+  auto bidi = std::unique_ptr<GrpcBidiStream>(new GrpcBidiStream());
+  bidi->state_ = state;
+  bidi->conn_ = conn_;
+  bidi->stream_id_ = stream_id;
+  *stream = std::move(bidi);
+  return Error::Success;
+}
+
+}  // namespace tpuclient

@@ -1,0 +1,138 @@
+// gRPC-over-HTTP/2 transport: length-prefixed message framing, unary
+// calls (sync + callback-async), and bidirectional streams, over the
+// self-contained H2Connection. Fills the role grpc++'s channel,
+// CompletionQueue and ClientReaderWriter play for the reference
+// client (/root/reference/src/c++/library/grpc_client.cc:1583
+// AsyncTransfer, :1629 AsyncStreamTransfer).
+#pragma once
+
+#include <condition_variable>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <string>
+
+#include "common.h"
+#include "h2/h2_connection.h"
+
+namespace tpuclient {
+
+// Decodes %xx escapes (gRPC percent-encodes grpc-message).
+std::string PercentDecode(const std::string& in);
+
+class GrpcChannel;
+
+//==============================================================================
+// A bidirectional gRPC stream (client side). Writes are sequenced by
+// the caller; responses arrive on the connection reader thread via
+// on_message, and on_done fires exactly once when the stream closes.
+//
+class GrpcBidiStream {
+ public:
+  ~GrpcBidiStream();
+
+  // Sends one length-prefixed message.
+  Error Write(const std::string& message);
+  // Half-closes the send side (WritesDone).
+  Error WritesDone();
+  // RST_STREAMs the call.
+  void Cancel();
+
+  // Blocks until the stream has fully closed; returns final status.
+  Error Finish();
+
+ private:
+  friend class GrpcChannel;
+  GrpcBidiStream() = default;
+
+  struct State;
+  std::shared_ptr<State> state_;
+  std::shared_ptr<h2::H2Connection> conn_;
+  int32_t stream_id_ = -1;
+};
+
+//==============================================================================
+// One gRPC channel == one HTTP/2 connection. Thread-safe; calls
+// multiplex as independent HTTP/2 streams.
+//
+class GrpcChannel {
+ public:
+  // url is "host:port".
+  static Error Create(
+      std::shared_ptr<GrpcChannel>* channel, const std::string& url,
+      uint64_t connect_timeout_us = 20 * 1000 * 1000);
+
+  // Synchronous unary call. `method` is "/package.Service/Method".
+  // Fills `response` with the raw message bytes. Timeout 0 = none.
+  Error UnaryCall(
+      const std::string& method, const std::string& request,
+      std::string* response, uint64_t timeout_us = 0,
+      const Headers& metadata = {}, RequestTimers* timers = nullptr);
+
+  // Callback-async unary call; `callback(status, response_bytes,
+  // timers)` fires on the connection reader thread.
+  using AsyncUnaryCallback = std::function<void(
+      const Error&, std::string&&, const RequestTimers&)>;
+  Error AsyncUnaryCall(
+      const std::string& method, const std::string& request,
+      AsyncUnaryCallback callback, uint64_t timeout_us = 0,
+      const Headers& metadata = {});
+
+  // Opens a bidi stream. `on_message(bytes)` per response message,
+  // `on_done(status)` once at stream end; both on the reader thread.
+  Error StartBidiStream(
+      std::unique_ptr<GrpcBidiStream>* stream, const std::string& method,
+      std::function<void(std::string&&)> on_message,
+      std::function<void(const Error&)> on_done,
+      const Headers& metadata = {});
+
+  // Synchronously closes the connection, failing all in-flight calls
+  // (their callbacks fire before this returns). Lets owners tear down
+  // callback targets safely afterwards.
+  void Shutdown() {
+    if (conn_) conn_->Close();
+  }
+
+  bool IsConnected() const {
+    return conn_ != nullptr && conn_->IsConnected();
+  }
+
+  size_t num_active_calls() {
+    return conn_ ? conn_->num_active_streams() : 0;
+  }
+
+ private:
+  GrpcChannel(const std::string& host, int port)
+      : host_(host), port_(port) {}
+
+  h2::HeaderList BuildRequestHeaders(
+      const std::string& method, uint64_t timeout_us,
+      const Headers& metadata) const;
+
+  std::string host_;
+  int port_ = 0;
+  std::shared_ptr<h2::H2Connection> conn_;
+};
+
+// Parses status from trailers (grpc-status / grpc-message), falling
+// back to :status when the gRPC trailer is absent.
+Error StatusFromTrailers(
+    const h2::HeaderList& headers, const h2::HeaderList& trailers,
+    const std::string& transport_error);
+
+// Incremental decoder for the gRPC length-prefix wire format.
+class GrpcMessageReader {
+ public:
+  // Feed DATA bytes; complete messages are appended to *messages.
+  // Returns false on malformed framing (compressed flag set etc.).
+  bool Feed(
+      const uint8_t* data, size_t len, std::vector<std::string>* messages);
+
+ private:
+  std::string buffer_;
+};
+
+// Frames one message: 0x00 flag + 4-byte BE length + payload.
+std::string FrameGrpcMessage(const std::string& payload);
+
+}  // namespace tpuclient

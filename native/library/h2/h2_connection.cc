@@ -1,0 +1,628 @@
+#include "h2_connection.h"
+
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <chrono>
+#include <cstring>
+
+namespace tpuclient {
+namespace h2 {
+
+namespace {
+
+constexpr uint8_t kFrameData = 0x0;
+constexpr uint8_t kFrameHeaders = 0x1;
+constexpr uint8_t kFramePriority = 0x2;
+constexpr uint8_t kFrameRstStream = 0x3;
+constexpr uint8_t kFrameSettings = 0x4;
+constexpr uint8_t kFramePushPromise = 0x5;
+constexpr uint8_t kFramePing = 0x6;
+constexpr uint8_t kFrameGoaway = 0x7;
+constexpr uint8_t kFrameWindowUpdate = 0x8;
+constexpr uint8_t kFrameContinuation = 0x9;
+
+constexpr uint8_t kFlagEndStream = 0x1;
+constexpr uint8_t kFlagAck = 0x1;
+constexpr uint8_t kFlagEndHeaders = 0x4;
+constexpr uint8_t kFlagPadded = 0x8;
+constexpr uint8_t kFlagPriority = 0x20;
+
+constexpr uint16_t kSettingsHeaderTableSize = 0x1;
+constexpr uint16_t kSettingsEnablePush = 0x2;
+constexpr uint16_t kSettingsMaxConcurrentStreams = 0x3;
+constexpr uint16_t kSettingsInitialWindowSize = 0x4;
+constexpr uint16_t kSettingsMaxFrameSize = 0x5;
+
+// Our advertised per-stream receive window. Receive-side flow
+// control is kept trivially open: every DATA frame is immediately
+// re-credited with WINDOW_UPDATEs, so windows never shrink in
+// steady state and large tensors stream without stalls.
+constexpr int64_t kOurInitialWindow = 1 << 24;  // 16 MB
+constexpr size_t kOurMaxFrameSize = 1 << 20;    // 1 MB
+
+const char kPreface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+
+uint64_t NowNs() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+void PutU32(char* p, uint32_t v) {
+  p[0] = static_cast<char>(v >> 24);
+  p[1] = static_cast<char>(v >> 16);
+  p[2] = static_cast<char>(v >> 8);
+  p[3] = static_cast<char>(v);
+}
+
+uint32_t GetU32(const char* p) {
+  const uint8_t* u = reinterpret_cast<const uint8_t*>(p);
+  return (static_cast<uint32_t>(u[0]) << 24) |
+         (static_cast<uint32_t>(u[1]) << 16) |
+         (static_cast<uint32_t>(u[2]) << 8) | u[3];
+}
+
+}  // namespace
+
+H2Connection::~H2Connection() { Close(); }
+
+std::string H2Connection::Connect(uint64_t timeout_us) {
+  struct addrinfo hints;
+  memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  std::string port_str = std::to_string(port_);
+  int rc = getaddrinfo(host_.c_str(), port_str.c_str(), &hints, &res);
+  if (rc != 0) {
+    return "failed to resolve " + host_ + ": " + gai_strerror(rc);
+  }
+  uint64_t deadline_ns = (timeout_us != 0) ? NowNs() + timeout_us * 1000ull : 0;
+  std::string err;
+  for (struct addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
+    int fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) {
+      err = strerror(errno);
+      continue;
+    }
+    fcntl(fd, F_SETFL, fcntl(fd, F_GETFL, 0) | O_NONBLOCK);
+    int rc2 = connect(fd, ai->ai_addr, ai->ai_addrlen);
+    if (rc2 != 0 && errno == EINPROGRESS) {
+      struct pollfd pfd = {fd, POLLOUT, 0};
+      while (true) {
+        int pr = poll(&pfd, 1, 50);
+        if (pr > 0) break;
+        if (deadline_ns != 0 && NowNs() > deadline_ns) {
+          err = "connect timeout";
+          break;
+        }
+        if (pr < 0 && errno != EINTR) {
+          err = strerror(errno);
+          break;
+        }
+      }
+      if (err.empty()) {
+        int so_error = 0;
+        socklen_t slen = sizeof(so_error);
+        getsockopt(fd, SOL_SOCKET, SO_ERROR, &so_error, &slen);
+        rc2 = (so_error == 0) ? 0 : (err = strerror(so_error), -1);
+      } else {
+        rc2 = -1;
+      }
+    } else if (rc2 != 0) {
+      err = strerror(errno);
+    }
+    if (rc2 == 0) {
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      // Blocking mode from here: the reader thread blocks in recv and
+      // writers use a poll loop for partial sends.
+      fcntl(fd, F_SETFL, fcntl(fd, F_GETFL, 0) & ~O_NONBLOCK);
+      fd_ = fd;
+      err.clear();
+      break;
+    }
+    ::close(fd);
+  }
+  freeaddrinfo(res);
+  if (fd_ < 0) {
+    return "failed to connect to " + host_ + ":" + port_str + ": " + err;
+  }
+
+  // Client preface + SETTINGS + a big connection-level window.
+  std::string settings;
+  auto add_setting = [&settings](uint16_t id, uint32_t value) {
+    char buf[6];
+    buf[0] = static_cast<char>(id >> 8);
+    buf[1] = static_cast<char>(id);
+    PutU32(buf + 2, value);
+    settings.append(buf, 6);
+  };
+  add_setting(kSettingsEnablePush, 0);
+  add_setting(kSettingsInitialWindowSize, kOurInitialWindow);
+  add_setting(kSettingsMaxFrameSize, kOurMaxFrameSize);
+  {
+    std::lock_guard<std::mutex> wl(write_mutex_);
+    std::string e = SendAll(kPreface, sizeof(kPreface) - 1);
+    if (e.empty()) {
+      e = WriteFrame(kFrameSettings, 0, 0, settings.data(), settings.size());
+    }
+    if (e.empty()) {
+      // Grow the connection receive window to 1 GB; with the eager
+      // re-credit below it never drains.
+      char wu[4];
+      PutU32(wu, (1u << 30) - 65535);
+      e = WriteFrame(kFrameWindowUpdate, 0, 0, wu, 4);
+    }
+    if (!e.empty()) {
+      ::close(fd_);
+      fd_ = -1;
+      return e;
+    }
+  }
+  reader_ = std::thread(&H2Connection::ReaderLoop, this);
+  return "";
+}
+
+std::string H2Connection::SendAll(const char* data, size_t len) {
+  size_t sent = 0;
+  while (sent < len) {
+    ssize_t n = ::send(fd_, data + sent, len - sent, MSG_NOSIGNAL);
+    if (n > 0) {
+      sent += static_cast<size_t>(n);
+      continue;
+    }
+    if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR)) {
+      struct pollfd pfd = {fd_, POLLOUT, 0};
+      poll(&pfd, 1, 50);
+      continue;
+    }
+    return std::string("send failed: ") + strerror(errno);
+  }
+  return "";
+}
+
+std::string H2Connection::WriteFrame(
+    uint8_t type, uint8_t flags, int32_t stream_id, const char* payload,
+    size_t len) {
+  char header[9];
+  header[0] = static_cast<char>(len >> 16);
+  header[1] = static_cast<char>(len >> 8);
+  header[2] = static_cast<char>(len);
+  header[3] = static_cast<char>(type);
+  header[4] = static_cast<char>(flags);
+  PutU32(header + 5, static_cast<uint32_t>(stream_id));
+  std::string err = SendAll(header, 9);
+  if (!err.empty() || len == 0) return err;
+  return SendAll(payload, len);
+}
+
+int32_t H2Connection::StartStream(
+    const HeaderList& headers, StreamCallbacks callbacks, std::string* err) {
+  int32_t stream_id = -1;
+  size_t max_frame = 16384;
+  auto stream = std::make_shared<Stream>();
+  stream->callbacks = std::move(callbacks);
+  // Stream IDs must hit the wire in increasing order (RFC 9113 §5.1.1),
+  // so the ID is allocated while already holding write_mutex_ and the
+  // HEADERS frame goes out before releasing it. Lock order is always
+  // write_mutex_ → mutex_ (never nested the other way), and the
+  // concurrency-limit wait happens with neither held so the reader
+  // thread can keep re-crediting windows and retiring streams.
+  std::unique_lock<std::mutex> wl(write_mutex_, std::defer_lock);
+  while (stream_id < 0) {
+    {
+      std::unique_lock<std::mutex> lock(mutex_);
+      cv_.wait(lock, [this] {
+        return dead_.load() || streams_.size() < peer_max_concurrent_;
+      });
+    }
+    wl.lock();
+    {
+      std::lock_guard<std::mutex> lock(mutex_);
+      if (dead_.load()) {
+        *err = "connection closed: " + dead_reason_;
+        return -1;
+      }
+      if (streams_.size() < peer_max_concurrent_) {
+        stream_id = next_stream_id_;
+        next_stream_id_ += 2;
+        stream->send_window = peer_initial_window_;
+        max_frame = peer_max_frame_size_;
+        streams_[stream_id] = stream;
+      }
+    }
+    if (stream_id < 0) wl.unlock();  // limit hit again; re-wait
+  }
+
+  std::string block = encoder_.Encode(headers);
+  // Header block fits one frame (our blocks are tiny; peer
+  // MAX_FRAME_SIZE is ≥16384 which far exceeds gRPC request
+  // headers). Chunk defensively anyway.
+  size_t pos = 0;
+  bool first = true;
+  do {
+    size_t chunk = std::min(block.size() - pos, max_frame);
+    bool last = (pos + chunk == block.size());
+    uint8_t type = first ? kFrameHeaders : kFrameContinuation;
+    uint8_t flags = last ? kFlagEndHeaders : 0;
+    std::string e =
+        WriteFrame(type, flags, stream_id, block.data() + pos, chunk);
+    if (!e.empty()) {
+      std::lock_guard<std::mutex> lock(mutex_);
+      streams_.erase(stream_id);
+      *err = e;
+      return -1;
+    }
+    pos += chunk;
+    first = false;
+  } while (pos < block.size());
+  return stream_id;
+}
+
+std::string H2Connection::SendData(
+    int32_t stream_id, const uint8_t* data, size_t len, bool end_stream) {
+  size_t pos = 0;
+  while (pos < len || (end_stream && len == 0)) {
+    size_t chunk;
+    {
+      std::unique_lock<std::mutex> lock(mutex_);
+      auto it = streams_.find(stream_id);
+      if (it == streams_.end()) return "stream closed";
+      if (len == 0) {
+        chunk = 0;
+      } else {
+        auto stream = it->second;
+        cv_.wait(lock, [&] {
+          return dead_.load() || stream->closed ||
+                 (peer_conn_window_ > 0 && stream->send_window > 0);
+        });
+        if (dead_.load()) return "connection closed: " + dead_reason_;
+        if (stream->closed || streams_.find(stream_id) == streams_.end())
+          return "stream closed";
+        chunk = std::min<size_t>(
+            {len - pos, peer_max_frame_size_,
+             static_cast<size_t>(peer_conn_window_),
+             static_cast<size_t>(stream->send_window)});
+        peer_conn_window_ -= chunk;
+        stream->send_window -= chunk;
+      }
+    }
+    bool last = (pos + chunk == len);
+    uint8_t flags = (last && end_stream) ? kFlagEndStream : 0;
+    std::lock_guard<std::mutex> wl(write_mutex_);
+    std::string e = WriteFrame(
+        kFrameData, flags, stream_id,
+        reinterpret_cast<const char*>(data) + pos, chunk);
+    if (!e.empty()) return e;
+    pos += chunk;
+    if (last) break;
+  }
+  return "";
+}
+
+std::string H2Connection::CloseSendSide(int32_t stream_id) {
+  return SendData(stream_id, nullptr, 0, true);
+}
+
+void H2Connection::CancelStream(int32_t stream_id) {
+  {
+    std::lock_guard<std::mutex> lock(mutex_);
+    if (streams_.find(stream_id) == streams_.end()) return;
+  }
+  char payload[4];
+  PutU32(payload, 0x8);  // CANCEL
+  {
+    std::lock_guard<std::mutex> wl(write_mutex_);
+    WriteFrame(kFrameRstStream, 0, stream_id, payload, 4);
+  }
+  FinishStream(stream_id, {}, "cancelled");
+}
+
+size_t H2Connection::num_active_streams() {
+  std::lock_guard<std::mutex> lock(mutex_);
+  return streams_.size();
+}
+
+void H2Connection::Close() {
+  if (fd_ >= 0) {
+    ::shutdown(fd_, SHUT_RDWR);
+  }
+  if (reader_.joinable() &&
+      reader_.get_id() != std::this_thread::get_id()) {
+    reader_.join();
+  }
+  FailAll("connection closed");
+  if (fd_ >= 0) {
+    ::close(fd_);
+    fd_ = -1;
+  }
+}
+
+bool H2Connection::ReadExact(char* buf, size_t len) {
+  size_t got = 0;
+  while (got < len) {
+    ssize_t n = ::recv(fd_, buf + got, len - got, 0);
+    if (n > 0) {
+      got += static_cast<size_t>(n);
+      continue;
+    }
+    if (n < 0 && errno == EINTR) continue;
+    return false;
+  }
+  return true;
+}
+
+void H2Connection::ReaderLoop() {
+  char header[9];
+  std::string payload;
+  while (true) {
+    if (!ReadExact(header, 9)) {
+      FailAll("connection reset");
+      return;
+    }
+    size_t len = (static_cast<size_t>(static_cast<uint8_t>(header[0])) << 16) |
+                 (static_cast<size_t>(static_cast<uint8_t>(header[1])) << 8) |
+                 static_cast<uint8_t>(header[2]);
+    uint8_t type = static_cast<uint8_t>(header[3]);
+    uint8_t flags = static_cast<uint8_t>(header[4]);
+    int32_t stream_id =
+        static_cast<int32_t>(GetU32(header + 5) & 0x7fffffffu);
+    if (len > kOurMaxFrameSize + 1024) {
+      FailAll("oversized frame");
+      return;
+    }
+    payload.resize(len);
+    if (len > 0 && !ReadExact(&payload[0], len)) {
+      FailAll("connection reset mid-frame");
+      return;
+    }
+    HandleFrame(type, flags, stream_id, payload);
+    if (dead_.load()) return;
+  }
+}
+
+void H2Connection::HandleFrame(
+    uint8_t type, uint8_t flags, int32_t stream_id,
+    const std::string& payload) {
+  switch (type) {
+    case kFrameData: {
+      std::shared_ptr<Stream> stream;
+      {
+        std::lock_guard<std::mutex> lock(mutex_);
+        auto it = streams_.find(stream_id);
+        if (it != streams_.end()) stream = it->second;
+      }
+      size_t data_len = payload.size();
+      const char* data = payload.data();
+      if (flags & kFlagPadded) {
+        if (payload.empty()) break;
+        uint8_t pad = static_cast<uint8_t>(payload[0]);
+        if (static_cast<size_t>(pad) + 1 > payload.size()) break;
+        data += 1;
+        data_len = payload.size() - 1 - pad;
+      }
+      if (stream && data_len > 0 && stream->callbacks.on_data) {
+        stream->callbacks.on_data(
+            reinterpret_cast<const uint8_t*>(data), data_len);
+      }
+      // Eagerly re-credit both windows so they never drain.
+      if (!payload.empty()) {
+        char wu[4];
+        PutU32(wu, static_cast<uint32_t>(payload.size()));
+        std::lock_guard<std::mutex> wl(write_mutex_);
+        WriteFrame(kFrameWindowUpdate, 0, 0, wu, 4);
+        if (!(flags & kFlagEndStream)) {
+          WriteFrame(kFrameWindowUpdate, 0, stream_id, wu, 4);
+        }
+      }
+      if (flags & kFlagEndStream) {
+        FinishStream(stream_id, {}, "");
+      }
+      break;
+    }
+    case kFrameHeaders: {
+      std::shared_ptr<Stream> stream;
+      {
+        std::lock_guard<std::mutex> lock(mutex_);
+        auto it = streams_.find(stream_id);
+        if (it != streams_.end()) stream = it->second;
+      }
+      size_t off = 0;
+      size_t len = payload.size();
+      if (flags & kFlagPadded) {
+        if (len < 1) break;
+        uint8_t pad = static_cast<uint8_t>(payload[0]);
+        off += 1;
+        if (len < off + pad) break;
+        len -= pad;
+      }
+      if (flags & kFlagPriority) {
+        if (len < off + 5) break;
+        off += 5;
+      }
+      if (!stream) {
+        // Unknown stream: still must feed HPACK decoder to keep
+        // dynamic-table state in sync.
+        HeaderList ignored;
+        decoder_.Decode(
+            reinterpret_cast<const uint8_t*>(payload.data()) + off,
+            len - off, &ignored);
+        break;
+      }
+      stream->header_block.assign(payload, off, len - off);
+      stream->header_block_end_stream = (flags & kFlagEndStream) != 0;
+      stream->in_header_block = true;
+      if (flags & kFlagEndHeaders) {
+        HandleHeaderBlockDone(stream_id, stream.get());
+      }
+      break;
+    }
+    case kFrameContinuation: {
+      std::shared_ptr<Stream> stream;
+      {
+        std::lock_guard<std::mutex> lock(mutex_);
+        auto it = streams_.find(stream_id);
+        if (it != streams_.end()) stream = it->second;
+      }
+      if (!stream || !stream->in_header_block) break;
+      stream->header_block.append(payload);
+      if (flags & kFlagEndHeaders) {
+        HandleHeaderBlockDone(stream_id, stream.get());
+      }
+      break;
+    }
+    case kFrameSettings: {
+      if (flags & kFlagAck) break;
+      int64_t window_delta = 0;
+      {
+        std::lock_guard<std::mutex> lock(mutex_);
+        for (size_t i = 0; i + 6 <= payload.size(); i += 6) {
+          uint16_t id =
+              (static_cast<uint16_t>(static_cast<uint8_t>(payload[i])) << 8) |
+              static_cast<uint8_t>(payload[i + 1]);
+          uint32_t value = GetU32(payload.data() + i + 2);
+          switch (id) {
+            case kSettingsInitialWindowSize:
+              window_delta =
+                  static_cast<int64_t>(value) - peer_initial_window_;
+              peer_initial_window_ = value;
+              for (auto& kv : streams_) kv.second->send_window += window_delta;
+              break;
+            case kSettingsMaxFrameSize:
+              peer_max_frame_size_ = value;
+              break;
+            case kSettingsMaxConcurrentStreams:
+              peer_max_concurrent_ = value;
+              break;
+            case kSettingsHeaderTableSize:
+              decoder_.SetSettingsCap(value);
+              break;
+            default:
+              break;
+          }
+        }
+      }
+      cv_.notify_all();
+      {
+        std::lock_guard<std::mutex> wl(write_mutex_);
+        WriteFrame(kFrameSettings, kFlagAck, 0, nullptr, 0);
+      }
+      break;
+    }
+    case kFramePing: {
+      if (!(flags & kFlagAck) && payload.size() == 8) {
+        std::lock_guard<std::mutex> wl(write_mutex_);
+        WriteFrame(kFramePing, kFlagAck, 0, payload.data(), 8);
+      }
+      break;
+    }
+    case kFrameWindowUpdate: {
+      if (payload.size() != 4) break;
+      uint32_t increment = GetU32(payload.data()) & 0x7fffffffu;
+      {
+        std::lock_guard<std::mutex> lock(mutex_);
+        if (stream_id == 0) {
+          peer_conn_window_ += increment;
+        } else {
+          auto it = streams_.find(stream_id);
+          if (it != streams_.end()) it->second->send_window += increment;
+        }
+      }
+      cv_.notify_all();
+      break;
+    }
+    case kFrameRstStream: {
+      uint32_t code =
+          payload.size() >= 4 ? GetU32(payload.data()) : 0xffffffffu;
+      FinishStream(
+          stream_id, {}, "stream reset by server (code " +
+                             std::to_string(code) + ")");
+      break;
+    }
+    case kFrameGoaway: {
+      std::string reason = "GOAWAY";
+      if (payload.size() > 8) {
+        reason += ": " + payload.substr(8);
+      }
+      FailAll(reason);
+      break;
+    }
+    case kFramePriority:
+    case kFramePushPromise:
+    default:
+      break;  // ignore (push is disabled via SETTINGS)
+  }
+}
+
+void H2Connection::HandleHeaderBlockDone(int32_t stream_id, Stream* stream) {
+  stream->in_header_block = false;
+  HeaderList headers;
+  std::string err = decoder_.Decode(
+      reinterpret_cast<const uint8_t*>(stream->header_block.data()),
+      stream->header_block.size(), &headers);
+  stream->header_block.clear();
+  if (!err.empty()) {
+    FailAll(err);
+    return;
+  }
+  if (!stream->saw_headers) {
+    stream->saw_headers = true;
+    stream->response_headers = headers;
+    if (stream->callbacks.on_headers) stream->callbacks.on_headers(headers);
+    if (stream->header_block_end_stream) {
+      // Trailers-only response: headers double as trailers.
+      FinishStream(stream_id, headers, "");
+    }
+  } else {
+    // Trailers.
+    FinishStream(stream_id, headers, "");
+  }
+}
+
+void H2Connection::FinishStream(
+    int32_t stream_id, const HeaderList& trailers, const std::string& error) {
+  std::shared_ptr<Stream> stream;
+  {
+    std::lock_guard<std::mutex> lock(mutex_);
+    auto it = streams_.find(stream_id);
+    if (it == streams_.end()) return;
+    stream = it->second;
+    stream->closed = true;
+    streams_.erase(it);
+  }
+  cv_.notify_all();
+  if (stream->callbacks.on_close) {
+    stream->callbacks.on_close(trailers, error);
+  }
+}
+
+void H2Connection::FailAll(const std::string& error) {
+  std::vector<std::shared_ptr<Stream>> doomed;
+  {
+    std::lock_guard<std::mutex> lock(mutex_);
+    if (dead_.exchange(true)) return;
+    dead_reason_ = error;
+    for (auto& kv : streams_) {
+      kv.second->closed = true;
+      doomed.push_back(kv.second);
+    }
+    streams_.clear();
+  }
+  cv_.notify_all();
+  for (auto& stream : doomed) {
+    if (stream->callbacks.on_close) {
+      stream->callbacks.on_close({}, error);
+    }
+  }
+}
+
+}  // namespace h2
+}  // namespace tpuclient

@@ -1,0 +1,136 @@
+// Minimal HTTP/2 (RFC 9113) client connection over POSIX sockets:
+// stream multiplexing, HPACK header compression, flow control, and a
+// reader thread that dispatches frames to per-stream callbacks.
+//
+// This is the transport under the native gRPC client. The reference
+// links grpc++ whose channel owns the equivalent machinery
+// (/root/reference/src/c++/library/grpc_client.cc:50-152 caches
+// channels); this image has no grpc++, so the protocol lives here.
+// Cleartext (h2c with prior knowledge) only — same trust model as the
+// reference's default insecure channels.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "hpack.h"
+
+namespace tpuclient {
+namespace h2 {
+
+// Callbacks fire on the connection's reader thread; keep them quick
+// or hand off to another thread (the gRPC layer does the latter for
+// user callbacks, mirroring the reference's AsyncTransfer thread).
+struct StreamCallbacks {
+  // First response header block (e.g. :status, content-type).
+  std::function<void(const HeaderList&)> on_headers;
+  // A chunk of DATA payload.
+  std::function<void(const uint8_t*, size_t)> on_data;
+  // Stream finished: trailers (may be empty) + transport error text
+  // ("" = clean END_STREAM).
+  std::function<void(const HeaderList&, const std::string&)> on_close;
+};
+
+class H2Connection {
+ public:
+  H2Connection(const std::string& host, int port)
+      : host_(host), port_(port) {}
+  ~H2Connection();
+
+  H2Connection(const H2Connection&) = delete;
+  H2Connection& operator=(const H2Connection&) = delete;
+
+  // Establishes TCP + HTTP/2 preface/SETTINGS and starts the reader
+  // thread. Returns "" on success.
+  std::string Connect(uint64_t timeout_us = 0);
+  bool IsConnected() const { return !dead_.load() && fd_ >= 0; }
+
+  // Opens a stream by sending a HEADERS frame (END_STREAM unset).
+  // Blocks while the peer's MAX_CONCURRENT_STREAMS limit is reached.
+  // Returns the stream id (>0) or -1 with *err filled.
+  int32_t StartStream(
+      const HeaderList& headers, StreamCallbacks callbacks,
+      std::string* err);
+
+  // Sends DATA on the stream, honouring peer flow-control windows and
+  // max frame size (blocks while windows are exhausted). Set
+  // end_stream on the final chunk to half-close.
+  std::string SendData(
+      int32_t stream_id, const uint8_t* data, size_t len, bool end_stream);
+
+  // Half-closes the send side with an empty DATA+END_STREAM frame.
+  std::string CloseSendSide(int32_t stream_id);
+
+  // Sends RST_STREAM (CANCEL) and releases the stream. on_close fires
+  // with error "cancelled" if the stream was still open.
+  void CancelStream(int32_t stream_id);
+
+  // Closes the socket; fails all open streams.
+  void Close();
+
+  size_t num_active_streams();
+
+ private:
+  struct Stream {
+    StreamCallbacks callbacks;
+    int64_t send_window = 0;
+    bool saw_headers = false;
+    bool closed = false;
+    HeaderList response_headers;
+    // Accumulates a header block across HEADERS/CONTINUATION.
+    std::string header_block;
+    bool header_block_end_stream = false;
+    bool in_header_block = false;
+  };
+
+  std::string SendAll(const char* data, size_t len);
+  std::string WriteFrame(
+      uint8_t type, uint8_t flags, int32_t stream_id, const char* payload,
+      size_t len);
+  void ReaderLoop();
+  bool ReadExact(char* buf, size_t len);
+  void HandleFrame(
+      uint8_t type, uint8_t flags, int32_t stream_id,
+      const std::string& payload);
+  void HandleHeaderBlockDone(int32_t stream_id, Stream* stream);
+  // Fails every open stream and marks the connection dead.
+  void FailAll(const std::string& error);
+  // Removes the stream and fires on_close outside the lock.
+  void FinishStream(
+      int32_t stream_id, const HeaderList& trailers,
+      const std::string& error);
+
+  std::string host_;
+  int port_;
+  int fd_ = -1;
+  std::atomic<bool> dead_{false};
+  std::string dead_reason_;
+
+  std::thread reader_;
+
+  std::mutex write_mutex_;
+  HpackEncoder encoder_;
+
+  std::mutex mutex_;  // guards everything below
+  std::condition_variable cv_;
+  std::map<int32_t, std::shared_ptr<Stream>> streams_;
+  int32_t next_stream_id_ = 1;
+  // Peer-advertised limits.
+  int64_t peer_initial_window_ = 65535;
+  int64_t peer_conn_window_ = 65535;
+  size_t peer_max_frame_size_ = 16384;
+  uint64_t peer_max_concurrent_ = 0x7fffffff;
+
+  HpackDecoder decoder_;
+};
+
+}  // namespace h2
+}  // namespace tpuclient

@@ -1,0 +1,286 @@
+#include "hpack.h"
+
+#include <cstring>
+
+#include "hpack_tables.h"
+
+namespace tpuclient {
+namespace h2 {
+
+namespace {
+
+// RFC 7541 §4.1: dynamic-table entry overhead.
+constexpr size_t kEntryOverhead = 32;
+
+//------------------------------------------------------------------
+// Huffman decode tree, built once from the Appendix B tables.
+//
+struct HuffNode {
+  int16_t next[2] = {-1, -1};  // child node index, or -1
+  int16_t symbol = -1;         // 0..255 leaf, 256 EOS, -1 interior
+};
+
+class HuffTree {
+ public:
+  HuffTree() {
+    nodes_.emplace_back();  // root
+    for (int sym = 0; sym <= 256; ++sym) {
+      uint32_t code = kHuffmanCodes[sym];
+      uint8_t len = kHuffmanCodeLengths[sym];
+      int node = 0;
+      for (int bit = len - 1; bit >= 0; --bit) {
+        int b = (code >> bit) & 1;
+        if (nodes_[node].next[b] < 0) {
+          nodes_[node].next[b] = static_cast<int16_t>(nodes_.size());
+          nodes_.emplace_back();
+        }
+        node = nodes_[node].next[b];
+      }
+      nodes_[node].symbol = static_cast<int16_t>(sym);
+    }
+  }
+
+  const HuffNode& at(int i) const { return nodes_[i]; }
+
+ private:
+  std::vector<HuffNode> nodes_;
+};
+
+const HuffTree& huff_tree() {
+  static const HuffTree tree;
+  return tree;
+}
+
+}  // namespace
+
+void EncodeInteger(
+    uint64_t value, uint8_t prefix_bits, uint8_t first_byte_flags,
+    std::string* out) {
+  const uint64_t max_prefix = (1u << prefix_bits) - 1;
+  if (value < max_prefix) {
+    out->push_back(static_cast<char>(first_byte_flags | value));
+    return;
+  }
+  out->push_back(static_cast<char>(first_byte_flags | max_prefix));
+  value -= max_prefix;
+  while (value >= 128) {
+    out->push_back(static_cast<char>(0x80 | (value & 0x7f)));
+    value >>= 7;
+  }
+  out->push_back(static_cast<char>(value));
+}
+
+bool DecodeInteger(
+    const uint8_t* data, size_t len, size_t* pos, uint8_t prefix_bits,
+    uint64_t* value) {
+  if (*pos >= len) return false;
+  const uint64_t max_prefix = (1u << prefix_bits) - 1;
+  uint64_t v = data[*pos] & max_prefix;
+  ++*pos;
+  if (v < max_prefix) {
+    *value = v;
+    return true;
+  }
+  uint32_t shift = 0;
+  while (true) {
+    if (*pos >= len) return false;
+    uint8_t byte = data[*pos];
+    ++*pos;
+    if (shift > 56) return false;  // overflow guard
+    v += static_cast<uint64_t>(byte & 0x7f) << shift;
+    shift += 7;
+    if ((byte & 0x80) == 0) break;
+  }
+  *value = v;
+  return true;
+}
+
+bool HuffmanDecode(const uint8_t* data, size_t len, std::string* out) {
+  const HuffTree& tree = huff_tree();
+  int node = 0;
+  int depth = 0;  // bits consumed since last emitted symbol
+  for (size_t i = 0; i < len; ++i) {
+    for (int bit = 7; bit >= 0; --bit) {
+      int b = (data[i] >> bit) & 1;
+      int next = tree.at(node).next[b];
+      if (next < 0) return false;
+      node = next;
+      ++depth;
+      int16_t sym = tree.at(node).symbol;
+      if (sym >= 0) {
+        if (sym == 256) return false;  // EOS in stream is an error
+        out->push_back(static_cast<char>(sym));
+        node = 0;
+        depth = 0;
+      }
+    }
+  }
+  // Remaining bits must be a prefix of EOS (all ones), < 8 bits.
+  if (depth >= 8) return false;
+  // Walking 1-bits from the current node must not have emitted a
+  // symbol; since EOS is all ones, any strict prefix of it decodes to
+  // nothing. Check that every consumed padding bit was 1 by verifying
+  // the path taken matches ones: re-verify cheaply — the node we're at
+  // must lie on the all-ones path from the root.
+  int check = 0;
+  for (int i = 0; i < depth; ++i) {
+    check = tree.at(check).next[1];
+    if (check < 0) return false;
+  }
+  return check == node;
+}
+
+namespace {
+
+bool DecodeString(
+    const uint8_t* data, size_t len, size_t* pos, std::string* out) {
+  if (*pos >= len) return false;
+  bool huffman = (data[*pos] & 0x80) != 0;
+  uint64_t str_len = 0;
+  if (!DecodeInteger(data, len, pos, 7, &str_len)) return false;
+  if (str_len > len - *pos) return false;
+  if (huffman) {
+    if (!HuffmanDecode(data + *pos, str_len, out)) return false;
+  } else {
+    out->assign(reinterpret_cast<const char*>(data + *pos), str_len);
+  }
+  *pos += str_len;
+  return true;
+}
+
+void EncodeString(const std::string& s, std::string* out) {
+  EncodeInteger(s.size(), 7, 0x00, out);  // no huffman
+  out->append(s);
+}
+
+}  // namespace
+
+std::string HpackEncoder::Encode(const HeaderList& headers) const {
+  std::string out;
+  for (const auto& kv : headers) {
+    // Exact static-table match → indexed field (§6.1).
+    int name_idx = 0;
+    int exact_idx = 0;
+    for (int i = 0; i < 61; ++i) {
+      if (kv.first == kStaticTable[i].name) {
+        if (name_idx == 0) name_idx = i + 1;
+        if (kv.second == kStaticTable[i].value) {
+          exact_idx = i + 1;
+          break;
+        }
+      }
+    }
+    if (exact_idx > 0) {
+      EncodeInteger(exact_idx, 7, 0x80, &out);
+      continue;
+    }
+    // Literal without indexing (§6.2.2), indexed or new name.
+    if (name_idx > 0) {
+      EncodeInteger(name_idx, 4, 0x00, &out);
+    } else {
+      out.push_back(0x00);
+      EncodeString(kv.first, &out);
+    }
+    EncodeString(kv.second, &out);
+  }
+  return out;
+}
+
+bool HpackDecoder::LookupIndex(
+    uint64_t index, std::string* name, std::string* value) {
+  if (index == 0) return false;
+  if (index <= 61) {
+    *name = kStaticTable[index - 1].name;
+    *value = kStaticTable[index - 1].value;
+    return true;
+  }
+  size_t dyn = index - 62;
+  if (dyn >= dynamic_.size()) return false;
+  *name = dynamic_[dyn].name;
+  *value = dynamic_[dyn].value;
+  return true;
+}
+
+void HpackDecoder::EvictTo(size_t target) {
+  while (dynamic_bytes_ > target && !dynamic_.empty()) {
+    const Entry& e = dynamic_.back();
+    dynamic_bytes_ -= e.name.size() + e.value.size() + kEntryOverhead;
+    dynamic_.pop_back();
+  }
+}
+
+void HpackDecoder::InsertDynamic(
+    const std::string& name, const std::string& value) {
+  size_t entry_size = name.size() + value.size() + kEntryOverhead;
+  if (entry_size > max_size_) {
+    // Larger than the whole table: empties it (§4.4).
+    EvictTo(0);
+    return;
+  }
+  EvictTo(max_size_ - entry_size);
+  dynamic_.push_front({name, value});
+  dynamic_bytes_ += entry_size;
+}
+
+std::string HpackDecoder::Decode(
+    const uint8_t* data, size_t len, HeaderList* out) {
+  size_t pos = 0;
+  while (pos < len) {
+    uint8_t b = data[pos];
+    if (b & 0x80) {
+      // Indexed header field (§6.1).
+      uint64_t index = 0;
+      if (!DecodeInteger(data, len, &pos, 7, &index))
+        return "hpack: bad indexed field";
+      std::string name, value;
+      if (!LookupIndex(index, &name, &value))
+        return "hpack: index out of range";
+      out->emplace_back(std::move(name), std::move(value));
+    } else if (b & 0x40) {
+      // Literal with incremental indexing (§6.2.1).
+      uint64_t index = 0;
+      if (!DecodeInteger(data, len, &pos, 6, &index))
+        return "hpack: bad literal";
+      std::string name, value;
+      if (index > 0) {
+        std::string unused;
+        if (!LookupIndex(index, &name, &unused))
+          return "hpack: name index out of range";
+      } else if (!DecodeString(data, len, &pos, &name)) {
+        return "hpack: bad name string";
+      }
+      if (!DecodeString(data, len, &pos, &value))
+        return "hpack: bad value string";
+      InsertDynamic(name, value);
+      out->emplace_back(std::move(name), std::move(value));
+    } else if (b & 0x20) {
+      // Dynamic table size update (§6.3).
+      uint64_t size = 0;
+      if (!DecodeInteger(data, len, &pos, 5, &size))
+        return "hpack: bad table size update";
+      if (size > settings_cap_) return "hpack: table size above cap";
+      max_size_ = size;
+      EvictTo(max_size_);
+    } else {
+      // Literal without indexing (0x00) / never indexed (0x10).
+      uint64_t index = 0;
+      if (!DecodeInteger(data, len, &pos, 4, &index))
+        return "hpack: bad literal";
+      std::string name, value;
+      if (index > 0) {
+        std::string unused;
+        if (!LookupIndex(index, &name, &unused))
+          return "hpack: name index out of range";
+      } else if (!DecodeString(data, len, &pos, &name)) {
+        return "hpack: bad name string";
+      }
+      if (!DecodeString(data, len, &pos, &value))
+        return "hpack: bad value string";
+      out->emplace_back(std::move(name), std::move(value));
+    }
+  }
+  return "";
+}
+
+}  // namespace h2
+}  // namespace tpuclient

@@ -1,0 +1,81 @@
+// HPACK (RFC 7541) header compression for the HTTP/2 transport that
+// carries gRPC in the TPU client. The reference's grpc_client links
+// grpc++ which bundles its own HPACK
+// (/root/reference/src/c++/library/grpc_client.cc uses the grpc++
+// channel); this image has no grpc++, so the codec is implemented
+// here from the RFC.
+//
+// Encoder strategy: indexed fields for exact static-table matches,
+// literal-without-indexing otherwise, never-huffman, no dynamic-table
+// insertions (legal per RFC 7541 §6.2.2 and keeps the encoder
+// stateless). Decoder implements the full spec — dynamic table,
+// size updates, huffman — since the peer (grpcio) uses all of it.
+#pragma once
+
+#include <cstdint>
+#include <deque>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace tpuclient {
+namespace h2 {
+
+using HeaderList = std::vector<std::pair<std::string, std::string>>;
+
+// Appends the RFC 7541 §5.1 variable-length integer encoding of
+// `value` with an `prefix_bits`-bit prefix, OR-ing `first_byte_flags`
+// into the first byte.
+void EncodeInteger(
+    uint64_t value, uint8_t prefix_bits, uint8_t first_byte_flags,
+    std::string* out);
+
+// Decodes an integer at data[*pos]; advances *pos. Returns false on
+// truncation/overflow.
+bool DecodeInteger(
+    const uint8_t* data, size_t len, size_t* pos, uint8_t prefix_bits,
+    uint64_t* value);
+
+// Decodes an HPACK huffman-coded string (RFC 7541 §5.2 / Appendix B).
+// Returns false on invalid padding or EOS in the stream.
+bool HuffmanDecode(const uint8_t* data, size_t len, std::string* out);
+
+class HpackEncoder {
+ public:
+  // Encodes a header block fragment for one HEADERS frame.
+  std::string Encode(const HeaderList& headers) const;
+};
+
+class HpackDecoder {
+ public:
+  explicit HpackDecoder(size_t max_dynamic_size = 4096)
+      : max_size_(max_dynamic_size), settings_cap_(max_dynamic_size) {}
+
+  // Decodes one complete header block. Returns empty string on
+  // success, else an error description (connection error per RFC).
+  std::string Decode(const uint8_t* data, size_t len, HeaderList* out);
+
+  // SETTINGS_HEADER_TABLE_SIZE from our side caps what dynamic-table
+  // size updates the peer may choose.
+  void SetSettingsCap(size_t cap) { settings_cap_ = cap; }
+
+  size_t dynamic_size() const { return dynamic_bytes_; }
+
+ private:
+  struct Entry {
+    std::string name;
+    std::string value;
+  };
+
+  bool LookupIndex(uint64_t index, std::string* name, std::string* value);
+  void InsertDynamic(const std::string& name, const std::string& value);
+  void EvictTo(size_t target);
+
+  std::deque<Entry> dynamic_;  // front = most recent (index 62)
+  size_t dynamic_bytes_ = 0;
+  size_t max_size_;
+  size_t settings_cap_;
+};
+
+}  // namespace h2
+}  // namespace tpuclient

@@ -59,6 +59,12 @@ Error InferResultHttp::Create(
     *result = r;
     return Error::Success;
   }
+  if (json_end > r->body_.size()) {
+    // Never trust the server's Inference-Header-Content-Length.
+    r->status_ = Error("response header length exceeds body size");
+    *result = r;
+    return Error::Success;
+  }
   std::string err =
       json::Parse(r->body_.data(), json_end, &r->header_);
   if (!err.empty()) {
@@ -518,8 +524,8 @@ Error InferenceServerHttpClient::UnregisterTpuSharedMemory(
 //==============================================================================
 // Inference request body
 
-Error InferenceServerHttpClient::GenerateRequestBody(
-    std::vector<char>* request_body, size_t* header_length,
+Error InferenceServerHttpClient::GenerateRequestBodyStr(
+    std::string* request_body, size_t* header_length,
     const InferOptions& options, const std::vector<InferInput*>& inputs,
     const std::vector<const InferRequestedOutput*>& outputs) {
   json::Object root;
@@ -633,16 +639,27 @@ Error InferenceServerHttpClient::GenerateRequestBody(
   }
   request_body->clear();
   request_body->reserve(total);
-  request_body->insert(
-      request_body->end(), json_text.begin(), json_text.end());
+  request_body->append(json_text);
   for (const InferInput* input : binary_inputs) {
     const_cast<InferInput*>(input)->PrepareForRequest();
     const uint8_t* buf;
     size_t len;
     while (const_cast<InferInput*>(input)->GetNext(&buf, &len)) {
-      request_body->insert(request_body->end(), buf, buf + len);
+      request_body->append(reinterpret_cast<const char*>(buf), len);
     }
   }
+  return Error::Success;
+}
+
+Error InferenceServerHttpClient::GenerateRequestBody(
+    std::vector<char>* request_body, size_t* header_length,
+    const InferOptions& options, const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs) {
+  std::string body;
+  Error err =
+      GenerateRequestBodyStr(&body, header_length, options, inputs, outputs);
+  if (!err.IsOk()) return err;
+  request_body->assign(body.begin(), body.end());
   return Error::Success;
 }
 
@@ -661,10 +678,10 @@ Error InferenceServerHttpClient::Infer(
   RequestTimers timers;
   timers.CaptureTimestamp(RequestTimers::Kind::REQUEST_START);
 
-  std::vector<char> body;
+  std::string body;
   size_t header_length = 0;
-  Error err = GenerateRequestBody(&body, &header_length, options, inputs,
-                                  outputs);
+  Error err = GenerateRequestBodyStr(&body, &header_length, options, inputs,
+                                     outputs);
   if (!err.IsOk()) return err;
 
   std::string path = AppendQuery(
@@ -678,7 +695,7 @@ Error InferenceServerHttpClient::Infer(
   {
     std::lock_guard<std::mutex> lk(sync_mutex_);
     err = DoRequest(
-        "POST", path, std::string(body.data(), body.size()), headers,
+        "POST", path, body, headers,
         "application/octet-stream", header_length, &response_body,
         &response_header_length, sync_conn_.get(), options.client_timeout_us,
         &sent_ns);
@@ -784,15 +801,13 @@ Error InferenceServerHttpClient::AsyncInfer(
 
   auto req = std::make_unique<AsyncRequest>();
   req->timers.CaptureTimestamp(RequestTimers::Kind::REQUEST_START);
-  std::vector<char> body;
   size_t header_length = 0;
-  Error err = GenerateRequestBody(&body, &header_length, options, inputs,
-                                  outputs);
+  Error err = GenerateRequestBodyStr(&req->body, &header_length, options,
+                                     inputs, outputs);
   if (!err.IsOk()) return err;
   req->path = AppendQuery(
       ModelPath(options.model_name, options.model_version) + "/infer",
       query_params);
-  req->body.assign(body.data(), body.size());
   req->header_length = header_length;
   req->headers = headers;
   req->timeout_us = options.client_timeout_us;
@@ -864,13 +879,11 @@ Error InferenceServerHttpClient::AsyncInferMulti(
     const auto& outs = (i < outputs.size()) ? outputs[i] : kNoOutputs;
     auto req = std::make_unique<AsyncRequest>();
     req->timers.CaptureTimestamp(RequestTimers::Kind::REQUEST_START);
-    std::vector<char> body;
     size_t header_length = 0;
     Error err =
-        GenerateRequestBody(&body, &header_length, opt, inputs[i], outs);
+        GenerateRequestBodyStr(&req->body, &header_length, opt, inputs[i], outs);
     if (!err.IsOk()) return err;
     req->path = ModelPath(opt.model_name, opt.model_version) + "/infer";
-    req->body.assign(body.data(), body.size());
     req->header_length = header_length;
     req->headers = headers;
     req->timeout_us = opt.client_timeout_us;

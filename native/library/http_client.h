@@ -191,6 +191,13 @@ class InferenceServerHttpClient : public InferenceServerClient {
  private:
   InferenceServerHttpClient(const std::string& url, bool verbose);
 
+  // Copy-free variant used on the request hot path (the public
+  // vector<char> API above wraps it for reference parity).
+  static Error GenerateRequestBodyStr(
+      std::string* request_body, size_t* header_length,
+      const InferOptions& options, const std::vector<InferInput*>& inputs,
+      const std::vector<const InferRequestedOutput*>& outputs);
+
   Error Get(
       const std::string& path, const Headers& headers, std::string* response,
       json::Value* parsed);

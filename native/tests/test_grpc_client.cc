@@ -1,0 +1,288 @@
+// gRPC client tests.
+//
+// Offline cases cover the gRPC wire framing, status mapping, and
+// request marshaling. Integration cases run when
+// TPUCLIENT_SERVER_GRPC is set to a live server's host:port
+// (tests/test_native.py launches the Python server and sets it) —
+// parity with the reference's tier-2 live-server suite
+// (cc_client_test.cc run against localhost:8001).
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <thread>
+
+#include "../library/grpc_client.h"
+#include "minitest.h"
+
+using namespace tpuclient;
+
+namespace {
+
+std::unique_ptr<InferInput> MakeInt32Input(
+    const std::string& name, const std::vector<int64_t>& shape,
+    const int32_t* data, size_t count) {
+  InferInput* raw = nullptr;
+  InferInput::Create(&raw, name, shape, "INT32");
+  raw->AppendRaw(
+      reinterpret_cast<const uint8_t*>(data), count * sizeof(int32_t));
+  return std::unique_ptr<InferInput>(raw);
+}
+
+}  // namespace
+
+TEST_CASE("grpc: message framing round trip") {
+  std::string payload = "hello-protobuf-bytes";
+  std::string framed = FrameGrpcMessage(payload);
+  REQUIRE(framed.size() == payload.size() + 5);
+  CHECK_EQ(framed[0], '\0');
+
+  GrpcMessageReader reader;
+  std::vector<std::string> messages;
+  // Feed in awkward split points.
+  const uint8_t* data = reinterpret_cast<const uint8_t*>(framed.data());
+  REQUIRE(reader.Feed(data, 3, &messages));
+  CHECK_EQ(messages.size(), 0u);
+  REQUIRE(reader.Feed(data + 3, 4, &messages));
+  REQUIRE(reader.Feed(data + 7, framed.size() - 7, &messages));
+  REQUIRE(messages.size() == 1);
+  CHECK_EQ(messages[0], payload);
+
+  // Two messages in one feed.
+  std::string two = FrameGrpcMessage("one") + FrameGrpcMessage("two");
+  messages.clear();
+  GrpcMessageReader reader2;
+  REQUIRE(reader2.Feed(
+      reinterpret_cast<const uint8_t*>(two.data()), two.size(), &messages));
+  REQUIRE(messages.size() == 2);
+  CHECK_EQ(messages[0], "one");
+  CHECK_EQ(messages[1], "two");
+
+  // Compressed flag (unsupported) must be rejected.
+  GrpcMessageReader reader3;
+  std::string compressed = FrameGrpcMessage("x");
+  compressed[0] = 1;
+  messages.clear();
+  CHECK(!reader3.Feed(
+      reinterpret_cast<const uint8_t*>(compressed.data()),
+      compressed.size(), &messages));
+}
+
+TEST_CASE("grpc: status from trailers") {
+  // OK.
+  Error err = StatusFromTrailers(
+      {{":status", "200"}}, {{"grpc-status", "0"}}, "");
+  CHECK(err.IsOk());
+  // Error with percent-encoded message.
+  err = StatusFromTrailers(
+      {{":status", "200"}},
+      {{"grpc-status", "5"}, {"grpc-message", "model%20not%20found"}}, "");
+  CHECK(!err.IsOk());
+  CHECK(err.Message().find("model not found") != std::string::npos);
+  // Trailers-only response: status appears in the header list.
+  err = StatusFromTrailers(
+      {{":status", "200"}, {"grpc-status", "12"}}, {}, "");
+  CHECK(!err.IsOk());
+  // Transport error dominates.
+  err = StatusFromTrailers({}, {{"grpc-status", "0"}}, "connection reset");
+  CHECK(!err.IsOk());
+  CHECK(err.Message().find("connection reset") != std::string::npos);
+}
+
+TEST_CASE("grpc: percent decode") {
+  CHECK_EQ(PercentDecode("a%20b%2Fc"), "a b/c");
+  CHECK_EQ(PercentDecode("no-escapes"), "no-escapes");
+  CHECK_EQ(PercentDecode("trailing%2"), "trailing%2");
+}
+
+//==============================================================================
+// Integration against a live server.
+
+namespace {
+
+const char* ServerUrl() { return getenv("TPUCLIENT_SERVER_GRPC"); }
+
+}  // namespace
+
+TEST_CASE("grpc-live: health and metadata") {
+  if (ServerUrl() == nullptr) return;
+  std::unique_ptr<InferenceServerGrpcClient> client;
+  REQUIRE_OK(InferenceServerGrpcClient::Create(&client, ServerUrl()));
+
+  bool live = false, ready = false, model_ready = false;
+  REQUIRE_OK(client->IsServerLive(&live));
+  CHECK(live);
+  REQUIRE_OK(client->IsServerReady(&ready));
+  CHECK(ready);
+  REQUIRE_OK(client->IsModelReady(&model_ready, "simple"));
+  CHECK(model_ready);
+
+  inference::ServerMetadataResponse server_metadata;
+  REQUIRE_OK(client->ServerMetadata(&server_metadata));
+  CHECK(!server_metadata.name().empty());
+
+  inference::ModelMetadataResponse model_metadata;
+  REQUIRE_OK(client->ModelMetadata(&model_metadata, "simple"));
+  CHECK_EQ(model_metadata.name(), "simple");
+  CHECK_EQ(model_metadata.inputs_size(), 2);
+
+  inference::ModelConfigResponse model_config;
+  REQUIRE_OK(client->ModelConfig(&model_config, "simple"));
+  CHECK_EQ(model_config.config().name(), "simple");
+
+  inference::RepositoryIndexResponse index;
+  REQUIRE_OK(client->ModelRepositoryIndex(&index));
+  CHECK(index.models_size() >= 1);
+}
+
+TEST_CASE("grpc-live: sync infer add_sub") {
+  if (ServerUrl() == nullptr) return;
+  std::unique_ptr<InferenceServerGrpcClient> client;
+  REQUIRE_OK(InferenceServerGrpcClient::Create(&client, ServerUrl()));
+
+  int32_t data0[16], data1[16];
+  for (int i = 0; i < 16; ++i) {
+    data0[i] = i;
+    data1[i] = 1;
+  }
+  auto in0 = MakeInt32Input("INPUT0", {16}, data0, 16);
+  auto in1 = MakeInt32Input("INPUT1", {16}, data1, 16);
+
+  InferOptions options("simple");
+  options.request_id = "native-grpc-1";
+  InferResult* raw_result = nullptr;
+  REQUIRE_OK(client->Infer(&raw_result, options, {in0.get(), in1.get()}));
+  std::unique_ptr<InferResult> result(raw_result);
+  REQUIRE_OK(result->RequestStatus());
+
+  std::string id;
+  REQUIRE_OK(result->Id(&id));
+  CHECK_EQ(id, "native-grpc-1");
+
+  const uint8_t* buf = nullptr;
+  size_t byte_size = 0;
+  REQUIRE_OK(result->RawData("OUTPUT0", &buf, &byte_size));
+  REQUIRE(byte_size == 64);
+  const int32_t* sum = reinterpret_cast<const int32_t*>(buf);
+  for (int i = 0; i < 16; ++i) CHECK_EQ(sum[i], data0[i] + 1);
+
+  REQUIRE_OK(result->RawData("OUTPUT1", &buf, &byte_size));
+  REQUIRE(byte_size == 64);
+  const int32_t* diff = reinterpret_cast<const int32_t*>(buf);
+  for (int i = 0; i < 16; ++i) CHECK_EQ(diff[i], data0[i] - 1);
+
+  // Client-side stats were recorded.
+  InferStat stat;
+  REQUIRE_OK(client->ClientInferStat(&stat));
+  CHECK_EQ(stat.completed_request_count, 1u);
+
+  // Error path: unknown model maps to a gRPC error.
+  InferOptions bad_options("no-such-model");
+  InferResult* bad_result = nullptr;
+  Error err = client->Infer(&bad_result, bad_options, {in0.get()});
+  CHECK(!err.IsOk());
+}
+
+TEST_CASE("grpc-live: async infer") {
+  if (ServerUrl() == nullptr) return;
+  std::unique_ptr<InferenceServerGrpcClient> client;
+  REQUIRE_OK(InferenceServerGrpcClient::Create(&client, ServerUrl()));
+
+  int32_t data0[16], data1[16];
+  for (int i = 0; i < 16; ++i) {
+    data0[i] = i;
+    data1[i] = 2;
+  }
+  auto in0 = MakeInt32Input("INPUT0", {16}, data0, 16);
+  auto in1 = MakeInt32Input("INPUT1", {16}, data1, 16);
+
+  constexpr int kRequests = 8;
+  std::mutex mutex;
+  std::condition_variable cv;
+  int completed = 0;
+  int ok = 0;
+
+  InferOptions options("simple");
+  for (int r = 0; r < kRequests; ++r) {
+    REQUIRE_OK(client->AsyncInfer(
+        [&](InferResult* result) {
+          std::unique_ptr<InferResult> owned(result);
+          bool good = owned->RequestStatus().IsOk();
+          if (good) {
+            const uint8_t* buf = nullptr;
+            size_t n = 0;
+            good = owned->RawData("OUTPUT0", &buf, &n).IsOk() && n == 64;
+          }
+          std::lock_guard<std::mutex> lock(mutex);
+          ++completed;
+          if (good) ++ok;
+          cv.notify_all();
+        },
+        options, {in0.get(), in1.get()}));
+  }
+  std::unique_lock<std::mutex> lock(mutex);
+  REQUIRE(cv.wait_for(lock, std::chrono::seconds(30), [&] {
+    return completed == kRequests;
+  }));
+  CHECK_EQ(ok, kRequests);
+}
+
+TEST_CASE("grpc-live: bidi stream infer") {
+  if (ServerUrl() == nullptr) return;
+  std::unique_ptr<InferenceServerGrpcClient> client;
+  REQUIRE_OK(InferenceServerGrpcClient::Create(&client, ServerUrl()));
+
+  int32_t data0[16], data1[16];
+  for (int i = 0; i < 16; ++i) {
+    data0[i] = i;
+    data1[i] = 3;
+  }
+  auto in0 = MakeInt32Input("INPUT0", {16}, data0, 16);
+  auto in1 = MakeInt32Input("INPUT1", {16}, data1, 16);
+
+  std::mutex mutex;
+  std::condition_variable cv;
+  int received = 0;
+  int ok = 0;
+  REQUIRE_OK(client->StartStream([&](InferResult* result) {
+    std::unique_ptr<InferResult> owned(result);
+    bool good = owned->RequestStatus().IsOk();
+    if (good) {
+      const uint8_t* buf = nullptr;
+      size_t n = 0;
+      good = owned->RawData("OUTPUT0", &buf, &n).IsOk() && n == 64;
+    }
+    std::lock_guard<std::mutex> lock(mutex);
+    ++received;
+    if (good) ++ok;
+    cv.notify_all();
+  }));
+
+  constexpr int kRequests = 5;
+  InferOptions options("simple");
+  for (int r = 0; r < kRequests; ++r) {
+    REQUIRE_OK(client->AsyncStreamInfer(options, {in0.get(), in1.get()}));
+  }
+  {
+    std::unique_lock<std::mutex> lock(mutex);
+    REQUIRE(cv.wait_for(lock, std::chrono::seconds(30), [&] {
+      return received == kRequests;
+    }));
+  }
+  CHECK_EQ(ok, kRequests);
+  REQUIRE_OK(client->StopStream());
+}
+
+TEST_CASE("grpc-live: model statistics and concurrency limit") {
+  if (ServerUrl() == nullptr) return;
+  std::unique_ptr<InferenceServerGrpcClient> client;
+  REQUIRE_OK(InferenceServerGrpcClient::Create(&client, ServerUrl()));
+
+  inference::ModelStatisticsResponse stats;
+  REQUIRE_OK(client->ModelInferenceStatistics(&stats, "simple"));
+  CHECK(stats.model_stats_size() >= 1);
+}
+
+MINITEST_MAIN

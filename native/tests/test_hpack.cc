@@ -1,0 +1,225 @@
+// HPACK codec unit tests, driven by the RFC 7541 Appendix C worked
+// examples (integer coding C.1, huffman requests C.4, plain requests
+// C.3 with dynamic-table evolution).
+#include <string>
+
+#include "../library/h2/hpack.h"
+#include "minitest.h"
+
+using namespace tpuclient::h2;
+
+namespace {
+
+std::string Unhex(const std::string& hex) {
+  std::string out;
+  for (size_t i = 0; i + 1 < hex.size(); i += 2) {
+    auto nib = [](char c) -> int {
+      if (c >= '0' && c <= '9') return c - '0';
+      return c - 'a' + 10;
+    };
+    out.push_back(static_cast<char>((nib(hex[i]) << 4) | nib(hex[i + 1])));
+  }
+  return out;
+}
+
+}  // namespace
+
+TEST_CASE("hpack: integer encoding (RFC 7541 C.1)") {
+  std::string out;
+  EncodeInteger(10, 5, 0, &out);
+  CHECK_EQ(out.size(), 1u);
+  CHECK_EQ(static_cast<uint8_t>(out[0]), 0x0au);
+
+  out.clear();
+  EncodeInteger(1337, 5, 0, &out);
+  REQUIRE(out.size() == 3);
+  CHECK_EQ(static_cast<uint8_t>(out[0]), 0x1fu);
+  CHECK_EQ(static_cast<uint8_t>(out[1]), 0x9au);
+  CHECK_EQ(static_cast<uint8_t>(out[2]), 0x0au);
+
+  out.clear();
+  EncodeInteger(42, 8, 0, &out);
+  CHECK_EQ(out.size(), 1u);
+  CHECK_EQ(static_cast<uint8_t>(out[0]), 0x2au);
+
+  // Round-trip decode.
+  size_t pos = 0;
+  uint64_t value = 0;
+  std::string enc;
+  EncodeInteger(1337, 5, 0, &enc);
+  REQUIRE(DecodeInteger(
+      reinterpret_cast<const uint8_t*>(enc.data()), enc.size(), &pos, 5,
+      &value));
+  CHECK_EQ(value, 1337u);
+  CHECK_EQ(pos, enc.size());
+}
+
+TEST_CASE("hpack: huffman decode (RFC 7541 C.4.1)") {
+  std::string encoded = Unhex("f1e3c2e5f23a6ba0ab90f4ff");
+  std::string out;
+  REQUIRE(HuffmanDecode(
+      reinterpret_cast<const uint8_t*>(encoded.data()), encoded.size(),
+      &out));
+  CHECK_EQ(out, "www.example.com");
+
+  // "no-cache" (C.4.2).
+  encoded = Unhex("a8eb10649cbf");
+  out.clear();
+  REQUIRE(HuffmanDecode(
+      reinterpret_cast<const uint8_t*>(encoded.data()), encoded.size(),
+      &out));
+  CHECK_EQ(out, "no-cache");
+
+  // Bad padding (zero bits) must fail.
+  encoded = Unhex("f1e3c2e5f23a6ba0ab90f400");
+  out.clear();
+  CHECK(!HuffmanDecode(
+      reinterpret_cast<const uint8_t*>(encoded.data()), encoded.size(),
+      &out));
+}
+
+TEST_CASE("hpack: request decode without huffman (RFC 7541 C.3)") {
+  HpackDecoder decoder;
+
+  // First request.
+  std::string block =
+      Unhex("828684410f7777772e6578616d706c652e636f6d");
+  HeaderList headers;
+  REQUIRE(decoder
+              .Decode(
+                  reinterpret_cast<const uint8_t*>(block.data()),
+                  block.size(), &headers)
+              .empty());
+  REQUIRE(headers.size() == 4);
+  CHECK_EQ(headers[0].first, ":method");
+  CHECK_EQ(headers[0].second, "GET");
+  CHECK_EQ(headers[1].first, ":scheme");
+  CHECK_EQ(headers[1].second, "http");
+  CHECK_EQ(headers[2].first, ":path");
+  CHECK_EQ(headers[2].second, "/");
+  CHECK_EQ(headers[3].first, ":authority");
+  CHECK_EQ(headers[3].second, "www.example.com");
+  CHECK_EQ(decoder.dynamic_size(), 57u);
+
+  // Second request reuses the dynamic-table entry (index 62).
+  block = Unhex("828684be58086e6f2d6361636865");
+  headers.clear();
+  REQUIRE(decoder
+              .Decode(
+                  reinterpret_cast<const uint8_t*>(block.data()),
+                  block.size(), &headers)
+              .empty());
+  REQUIRE(headers.size() == 5);
+  CHECK_EQ(headers[3].first, ":authority");
+  CHECK_EQ(headers[3].second, "www.example.com");
+  CHECK_EQ(headers[4].first, "cache-control");
+  CHECK_EQ(headers[4].second, "no-cache");
+  CHECK_EQ(decoder.dynamic_size(), 110u);
+
+  // Third request.
+  block = Unhex(
+      "828785bf400a637573746f6d2d6b65790c637573746f6d2d76616c7565");
+  headers.clear();
+  REQUIRE(decoder
+              .Decode(
+                  reinterpret_cast<const uint8_t*>(block.data()),
+                  block.size(), &headers)
+              .empty());
+  REQUIRE(headers.size() == 5);
+  CHECK_EQ(headers[1].second, "https");
+  CHECK_EQ(headers[2].second, "/index.html");
+  CHECK_EQ(headers[4].first, "custom-key");
+  CHECK_EQ(headers[4].second, "custom-value");
+  CHECK_EQ(decoder.dynamic_size(), 164u);
+}
+
+TEST_CASE("hpack: request decode with huffman (RFC 7541 C.4)") {
+  HpackDecoder decoder;
+  std::string block = Unhex("828684418cf1e3c2e5f23a6ba0ab90f4ff");
+  HeaderList headers;
+  REQUIRE(decoder
+              .Decode(
+                  reinterpret_cast<const uint8_t*>(block.data()),
+                  block.size(), &headers)
+              .empty());
+  REQUIRE(headers.size() == 4);
+  CHECK_EQ(headers[3].first, ":authority");
+  CHECK_EQ(headers[3].second, "www.example.com");
+  CHECK_EQ(decoder.dynamic_size(), 57u);
+}
+
+TEST_CASE("hpack: encoder round-trips through decoder") {
+  HpackEncoder encoder;
+  HpackDecoder decoder;
+  HeaderList headers = {
+      {":method", "POST"},
+      {":scheme", "http"},
+      {":path", "/inference.GRPCInferenceService/ModelInfer"},
+      {":authority", "localhost:8001"},
+      {"te", "trailers"},
+      {"content-type", "application/grpc"},
+      {"grpc-timeout", "5000000u"},
+      {"x-custom-header", "hello world"},
+  };
+  std::string block = encoder.Encode(headers);
+  HeaderList decoded;
+  REQUIRE(decoder
+              .Decode(
+                  reinterpret_cast<const uint8_t*>(block.data()),
+                  block.size(), &decoded)
+              .empty());
+  REQUIRE(decoded.size() == headers.size());
+  for (size_t i = 0; i < headers.size(); ++i) {
+    CHECK_EQ(decoded[i].first, headers[i].first);
+    CHECK_EQ(decoded[i].second, headers[i].second);
+  }
+}
+
+TEST_CASE("hpack: decoder rejects malformed input") {
+  HpackDecoder decoder;
+  HeaderList headers;
+  // Index 0 is invalid.
+  uint8_t bad_index[] = {0x80};
+  CHECK(!decoder.Decode(bad_index, 1, &headers).empty());
+  // Truncated string.
+  HpackDecoder decoder2;
+  uint8_t truncated[] = {0x00, 0x05, 'a', 'b'};
+  CHECK(!decoder2.Decode(truncated, 4, &headers).empty());
+  // Out-of-range dynamic index.
+  HpackDecoder decoder3;
+  uint8_t big_index[] = {0xff, 0x20};
+  CHECK(!decoder3.Decode(big_index, 2, &headers).empty());
+}
+
+TEST_CASE("hpack: dynamic table eviction") {
+  // Cap the table to 100 bytes via a size update, then insert two
+  // entries whose combined size exceeds it — older entry evicts.
+  HpackDecoder decoder;
+  std::string block;
+  // Size update to 100 (prefix 5, pattern 001xxxxx).
+  block.push_back(0x3f);  // 31 + ...
+  block.push_back(0x45);  // 31+69=100
+  // Insert "aa"->"bb" (36 bytes) and "cc"->"dd" (36 bytes), then
+  // "ee"->"ff" (36 bytes) — first insert must be evicted (108>100).
+  auto literal_inc = [](const std::string& n, const std::string& v) {
+    std::string s;
+    s.push_back(0x40);
+    s.push_back(static_cast<char>(n.size()));
+    s += n;
+    s.push_back(static_cast<char>(v.size()));
+    s += v;
+    return s;
+  };
+  block += literal_inc("aa", "bb");
+  block += literal_inc("cc", "dd");
+  block += literal_inc("ee", "ff");
+  HeaderList headers;
+  REQUIRE(decoder
+              .Decode(
+                  reinterpret_cast<const uint8_t*>(block.data()),
+                  block.size(), &headers)
+              .empty());
+  CHECK_EQ(decoder.dynamic_size(), 72u);  // two entries remain
+}
+
+MINITEST_MAIN

@@ -62,6 +62,14 @@ def test_native_http_offline(native_build):
     _run_binary(native_build, "test_http_client")
 
 
+def test_native_hpack(native_build):
+    _run_binary(native_build, "test_hpack")
+
+
+def test_native_grpc_offline(native_build):
+    _run_binary(native_build, "test_grpc_client")
+
+
 @pytest.fixture(scope="module")
 def live_server():
     """In-process server with gRPC + HTTP front-ends on ephemeral
@@ -84,4 +92,11 @@ def test_native_http_integration(native_build, live_server):
     _run_binary(
         native_build, "test_http_client",
         {"TPUCLIENT_SERVER_HTTP": live_server["http"]},
+    )
+
+
+def test_native_grpc_integration(native_build, live_server):
+    _run_binary(
+        native_build, "test_grpc_client",
+        {"TPUCLIENT_SERVER_GRPC": live_server["grpc"]},
     )
