@@ -1,0 +1,623 @@
+#include "client_backend.h"
+
+#include <chrono>
+#include <random>
+#include <thread>
+
+#include "../library/grpc_client.h"
+#include "../library/http_client.h"
+#include "client_tpu/protocol/arena.pb.h"
+
+namespace tpuclient {
+namespace perf {
+
+namespace {
+
+//==============================================================================
+// GRPC backend: wraps the native gRPC client 1:1 (parity:
+// triton_client_backend.h:72).
+//
+class GrpcBackend : public ClientBackend {
+ public:
+  static Error Create(
+      const BackendConfig& config, std::unique_ptr<ClientBackend>* backend) {
+    auto b = std::unique_ptr<GrpcBackend>(new GrpcBackend());
+    Error err = InferenceServerGrpcClient::Create(
+        &b->client_, config.url, config.verbose);
+    if (!err.IsOk()) return err;
+    *backend = std::move(b);
+    return Error::Success;
+  }
+
+  Error ServerMetadataJson(json::Value* metadata) override {
+    inference::ServerMetadataResponse resp;
+    Error err = client_->ServerMetadata(&resp);
+    if (!err.IsOk()) return err;
+    json::Object root;
+    root["name"] = json::Value(resp.name());
+    root["version"] = json::Value(resp.version());
+    json::Array exts;
+    for (const auto& e : resp.extensions()) exts.push_back(json::Value(e));
+    root["extensions"] = json::Value(std::move(exts));
+    *metadata = json::Value(std::move(root));
+    return Error::Success;
+  }
+
+  Error ModelMetadataJson(
+      json::Value* metadata, const std::string& model_name,
+      const std::string& model_version) override {
+    inference::ModelMetadataResponse resp;
+    Error err =
+        client_->ModelMetadata(&resp, model_name, model_version);
+    if (!err.IsOk()) return err;
+    json::Object root;
+    root["name"] = json::Value(resp.name());
+    root["platform"] = json::Value(resp.platform());
+    auto tensors_to_json = [](const auto& tensors) {
+      json::Array arr;
+      for (const auto& t : tensors) {
+        json::Object entry;
+        entry["name"] = json::Value(t.name());
+        entry["datatype"] = json::Value(t.datatype());
+        json::Array shape;
+        for (int64_t d : t.shape()) shape.push_back(json::Value(d));
+        entry["shape"] = json::Value(std::move(shape));
+        arr.push_back(json::Value(std::move(entry)));
+      }
+      return json::Value(std::move(arr));
+    };
+    root["inputs"] = tensors_to_json(resp.inputs());
+    root["outputs"] = tensors_to_json(resp.outputs());
+    *metadata = json::Value(std::move(root));
+    return Error::Success;
+  }
+
+  Error ModelConfigJson(
+      json::Value* config, const std::string& model_name,
+      const std::string& model_version) override {
+    inference::ModelConfigResponse resp;
+    Error err = client_->ModelConfig(&resp, model_name, model_version);
+    if (!err.IsOk()) return err;
+    const auto& c = resp.config();
+    json::Object root;
+    root["name"] = json::Value(c.name());
+    root["max_batch_size"] =
+        json::Value(static_cast<int64_t>(c.max_batch_size()));
+    root["platform"] = json::Value(c.platform());
+    if (c.has_sequence_batching()) {
+      root["sequence_batching"] = json::Value(json::Object{});
+    }
+    if (c.has_dynamic_batching()) {
+      root["dynamic_batching"] = json::Value(json::Object{});
+    }
+    if (c.has_ensemble_scheduling()) {
+      root["ensemble_scheduling"] = json::Value(json::Object{});
+    }
+    if (c.model_transaction_policy().decoupled()) {
+      json::Object policy;
+      policy["decoupled"] = json::Value(true);
+      root["model_transaction_policy"] = json::Value(std::move(policy));
+    }
+    *config = json::Value(std::move(root));
+    return Error::Success;
+  }
+
+  Error ModelStatisticsJson(
+      json::Value* stats, const std::string& model_name) override {
+    inference::ModelStatisticsResponse resp;
+    Error err = client_->ModelInferenceStatistics(&resp, model_name);
+    if (!err.IsOk()) return err;
+    json::Array model_stats;
+    for (const auto& m : resp.model_stats()) {
+      json::Object entry;
+      entry["name"] = json::Value(m.name());
+      entry["version"] = json::Value(m.version());
+      entry["inference_count"] =
+          json::Value(static_cast<uint64_t>(m.inference_count()));
+      entry["execution_count"] =
+          json::Value(static_cast<uint64_t>(m.execution_count()));
+      json::Object infer_stats;
+      auto dur = [](const inference::StatisticDuration& d) {
+        json::Object o;
+        o["count"] = json::Value(static_cast<uint64_t>(d.count()));
+        o["ns"] = json::Value(static_cast<uint64_t>(d.ns()));
+        return json::Value(std::move(o));
+      };
+      infer_stats["success"] = dur(m.inference_stats().success());
+      infer_stats["fail"] = dur(m.inference_stats().fail());
+      infer_stats["queue"] = dur(m.inference_stats().queue());
+      infer_stats["compute_input"] = dur(m.inference_stats().compute_input());
+      infer_stats["compute_infer"] = dur(m.inference_stats().compute_infer());
+      infer_stats["compute_output"] =
+          dur(m.inference_stats().compute_output());
+      entry["inference_stats"] = json::Value(std::move(infer_stats));
+      model_stats.push_back(json::Value(std::move(entry)));
+    }
+    json::Object root;
+    root["model_stats"] = json::Value(std::move(model_stats));
+    *stats = json::Value(std::move(root));
+    return Error::Success;
+  }
+
+  Error Infer(
+      InferResult** result, const InferOptions& options,
+      const std::vector<InferInput*>& inputs,
+      const std::vector<const InferRequestedOutput*>& outputs) override {
+    return client_->Infer(result, options, inputs, outputs);
+  }
+
+  Error AsyncInfer(
+      OnCompleteFn callback, const InferOptions& options,
+      const std::vector<InferInput*>& inputs,
+      const std::vector<const InferRequestedOutput*>& outputs) override {
+    return client_->AsyncInfer(std::move(callback), options, inputs, outputs);
+  }
+
+  Error StartStream(OnCompleteFn callback) override {
+    return client_->StartStream(std::move(callback));
+  }
+  Error StopStream() override { return client_->StopStream(); }
+  Error AsyncStreamInfer(
+      const InferOptions& options, const std::vector<InferInput*>& inputs,
+      const std::vector<const InferRequestedOutput*>& outputs) override {
+    return client_->AsyncStreamInfer(options, inputs, outputs);
+  }
+
+  Error RegisterSystemSharedMemory(
+      const std::string& name, const std::string& key, size_t byte_size,
+      size_t offset) override {
+    return client_->RegisterSystemSharedMemory(name, key, byte_size, offset);
+  }
+  Error RegisterTpuSharedMemory(
+      const std::string& name, const std::string& raw_handle,
+      int64_t device_id, size_t byte_size) override {
+    return client_->RegisterTpuSharedMemory(
+        name, raw_handle, device_id, byte_size);
+  }
+  Error UnregisterSystemSharedMemory(const std::string& name) override {
+    return client_->UnregisterSystemSharedMemory(name);
+  }
+  Error UnregisterTpuSharedMemory(const std::string& name) override {
+    return client_->UnregisterTpuSharedMemory(name);
+  }
+
+ private:
+  std::unique_ptr<InferenceServerGrpcClient> client_;
+};
+
+//==============================================================================
+// HTTP backend.
+//
+class HttpBackend : public ClientBackend {
+ public:
+  static Error Create(
+      const BackendConfig& config, std::unique_ptr<ClientBackend>* backend) {
+    auto b = std::unique_ptr<HttpBackend>(new HttpBackend());
+    Error err = InferenceServerHttpClient::Create(
+        &b->client_, config.url, config.verbose);
+    if (!err.IsOk()) return err;
+    b->client_->SetAsyncWorkerCount(config.http_async_workers);
+    *backend = std::move(b);
+    return Error::Success;
+  }
+
+  Error ServerMetadataJson(json::Value* metadata) override {
+    std::string text;
+    Error err = client_->ServerMetadata(&text);
+    if (!err.IsOk()) return err;
+    return ParseInto(text, metadata);
+  }
+
+  Error ModelMetadataJson(
+      json::Value* metadata, const std::string& model_name,
+      const std::string& model_version) override {
+    std::string text;
+    Error err = client_->ModelMetadata(&text, model_name, model_version);
+    if (!err.IsOk()) return err;
+    return ParseInto(text, metadata);
+  }
+
+  Error ModelConfigJson(
+      json::Value* config, const std::string& model_name,
+      const std::string& model_version) override {
+    std::string text;
+    Error err = client_->ModelConfig(&text, model_name, model_version);
+    if (!err.IsOk()) return err;
+    return ParseInto(text, config);
+  }
+
+  Error ModelStatisticsJson(
+      json::Value* stats, const std::string& model_name) override {
+    std::string text;
+    Error err = client_->ModelInferenceStatistics(&text, model_name);
+    if (!err.IsOk()) return err;
+    return ParseInto(text, stats);
+  }
+
+  Error Infer(
+      InferResult** result, const InferOptions& options,
+      const std::vector<InferInput*>& inputs,
+      const std::vector<const InferRequestedOutput*>& outputs) override {
+    return client_->Infer(result, options, inputs, outputs);
+  }
+  Error AsyncInfer(
+      OnCompleteFn callback, const InferOptions& options,
+      const std::vector<InferInput*>& inputs,
+      const std::vector<const InferRequestedOutput*>& outputs) override {
+    return client_->AsyncInfer(std::move(callback), options, inputs, outputs);
+  }
+  Error StartStream(OnCompleteFn callback) override {
+    return Error("streaming is not supported over HTTP");
+  }
+  Error StopStream() override { return Error::Success; }
+  Error AsyncStreamInfer(
+      const InferOptions& options, const std::vector<InferInput*>& inputs,
+      const std::vector<const InferRequestedOutput*>& outputs) override {
+    return Error("streaming is not supported over HTTP");
+  }
+
+  Error RegisterSystemSharedMemory(
+      const std::string& name, const std::string& key, size_t byte_size,
+      size_t offset) override {
+    return client_->RegisterSystemSharedMemory(name, key, byte_size, offset);
+  }
+  Error RegisterTpuSharedMemory(
+      const std::string& name, const std::string& raw_handle,
+      int64_t device_id, size_t byte_size) override {
+    return client_->RegisterTpuSharedMemory(
+        name, raw_handle, device_id, byte_size);
+  }
+  Error UnregisterSystemSharedMemory(const std::string& name) override {
+    return client_->UnregisterSystemSharedMemory(name);
+  }
+  Error UnregisterTpuSharedMemory(const std::string& name) override {
+    return client_->UnregisterTpuSharedMemory(name);
+  }
+
+ private:
+  static Error ParseInto(const std::string& text, json::Value* out) {
+    std::string err = json::Parse(text.data(), text.size(), out);
+    if (!err.empty()) return Error("bad JSON from server: " + err);
+    return Error::Success;
+  }
+
+  std::unique_ptr<InferenceServerHttpClient> client_;
+};
+
+//==============================================================================
+// Mock backend: a fake server with programmable delay, used by the
+// harness unit tests (parity: NaggyMockClientBackend firing async
+// callbacks from detached threads, mock_client_backend.h:617-625).
+//
+std::shared_ptr<MockBackendStats> g_mock_stats =
+    std::make_shared<MockBackendStats>();
+
+class MockInferResult : public InferResult {
+ public:
+  explicit MockInferResult(const Error& status, std::string id = "")
+      : status_(status), id_(std::move(id)), data_(64, '\0') {}
+
+  Error ModelName(std::string* name) const override {
+    *name = "mock";
+    return Error::Success;
+  }
+  Error ModelVersion(std::string* version) const override {
+    *version = "1";
+    return Error::Success;
+  }
+  Error Id(std::string* id) const override {
+    *id = id_;
+    return Error::Success;
+  }
+  Error Shape(
+      const std::string&, std::vector<int64_t>* shape) const override {
+    *shape = {16};
+    return Error::Success;
+  }
+  Error Datatype(const std::string&, std::string* datatype) const override {
+    *datatype = "INT32";
+    return Error::Success;
+  }
+  Error RawData(
+      const std::string&, const uint8_t** buf,
+      size_t* byte_size) const override {
+    *buf = reinterpret_cast<const uint8_t*>(data_.data());
+    *byte_size = data_.size();
+    return Error::Success;
+  }
+  Error StringData(
+      const std::string&, std::vector<std::string>*) const override {
+    return Error("mock outputs are not BYTES");
+  }
+  std::string DebugString() const override { return "MockInferResult"; }
+  Error RequestStatus() const override { return status_; }
+
+ private:
+  Error status_;
+  std::string id_;
+  std::string data_;
+};
+
+class MockBackend : public ClientBackend {
+ public:
+  explicit MockBackend(const BackendConfig& config)
+      : delay_us_(config.mock_delay_us), error_rate_(config.mock_error_rate) {}
+
+  ~MockBackend() override {
+    StopStream();
+    // Wait for detached completion threads.
+    while (inflight_.load() > 0) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    }
+  }
+
+  Error ServerMetadataJson(json::Value* metadata) override {
+    json::Object root;
+    root["name"] = json::Value(std::string("mock-server"));
+    root["version"] = json::Value(std::string("1.0"));
+    *metadata = json::Value(std::move(root));
+    return Error::Success;
+  }
+
+  Error ModelMetadataJson(
+      json::Value* metadata, const std::string& model_name,
+      const std::string&) override {
+    json::Object root;
+    root["name"] = json::Value(model_name);
+    root["platform"] = json::Value(std::string("mock"));
+    auto tensor = [](const char* name) {
+      json::Object t;
+      t["name"] = json::Value(std::string(name));
+      t["datatype"] = json::Value(std::string("INT32"));
+      json::Array shape;
+      shape.push_back(json::Value(static_cast<int64_t>(16)));
+      t["shape"] = json::Value(std::move(shape));
+      return json::Value(std::move(t));
+    };
+    json::Array inputs;
+    inputs.push_back(tensor("INPUT0"));
+    inputs.push_back(tensor("INPUT1"));
+    root["inputs"] = json::Value(std::move(inputs));
+    json::Array outputs;
+    outputs.push_back(tensor("OUTPUT0"));
+    outputs.push_back(tensor("OUTPUT1"));
+    root["outputs"] = json::Value(std::move(outputs));
+    *metadata = json::Value(std::move(root));
+    return Error::Success;
+  }
+
+  Error ModelConfigJson(
+      json::Value* config, const std::string& model_name,
+      const std::string&) override {
+    json::Object root;
+    root["name"] = json::Value(model_name);
+    root["max_batch_size"] = json::Value(static_cast<int64_t>(8));
+    *config = json::Value(std::move(root));
+    return Error::Success;
+  }
+
+  Error ModelStatisticsJson(
+      json::Value* stats, const std::string&) override {
+    json::Object root;
+    root["model_stats"] = json::Value(json::Array{});
+    *stats = json::Value(std::move(root));
+    return Error::Success;
+  }
+
+  Error Infer(
+      InferResult** result, const InferOptions& options,
+      const std::vector<InferInput*>&,
+      const std::vector<const InferRequestedOutput*>&) override {
+    g_mock_stats->infer_calls++;
+    if (delay_us_ > 0) {
+      std::this_thread::sleep_for(std::chrono::microseconds(delay_us_));
+    }
+    Error status = MaybeError();
+    g_mock_stats->completed++;
+    if (!status.IsOk()) {
+      g_mock_stats->errors++;
+      return status;
+    }
+    *result = new MockInferResult(status, options.request_id);
+    return Error::Success;
+  }
+
+  Error AsyncInfer(
+      OnCompleteFn callback, const InferOptions& options,
+      const std::vector<InferInput*>&,
+      const std::vector<const InferRequestedOutput*>&) override {
+    g_mock_stats->async_infer_calls++;
+    inflight_++;
+    std::string id = options.request_id;
+    std::thread([this, callback = std::move(callback), id] {
+      if (delay_us_ > 0) {
+        std::this_thread::sleep_for(std::chrono::microseconds(delay_us_));
+      }
+      Error status = MaybeError();
+      g_mock_stats->completed++;
+      if (!status.IsOk()) g_mock_stats->errors++;
+      callback(new MockInferResult(status, id));
+      inflight_--;
+    }).detach();
+    return Error::Success;
+  }
+
+  Error StartStream(OnCompleteFn callback) override {
+    std::lock_guard<std::mutex> lock(stream_mutex_);
+    stream_callback_ = std::move(callback);
+    return Error::Success;
+  }
+  Error StopStream() override {
+    std::lock_guard<std::mutex> lock(stream_mutex_);
+    stream_callback_ = nullptr;
+    return Error::Success;
+  }
+  Error AsyncStreamInfer(
+      const InferOptions& options, const std::vector<InferInput*>&,
+      const std::vector<const InferRequestedOutput*>&) override {
+    g_mock_stats->stream_infer_calls++;
+    OnCompleteFn callback;
+    {
+      std::lock_guard<std::mutex> lock(stream_mutex_);
+      callback = stream_callback_;
+    }
+    if (!callback) return Error("stream not started");
+    inflight_++;
+    std::string id = options.request_id;
+    std::thread([this, callback = std::move(callback), id] {
+      if (delay_us_ > 0) {
+        std::this_thread::sleep_for(std::chrono::microseconds(delay_us_));
+      }
+      g_mock_stats->completed++;
+      callback(new MockInferResult(Error::Success, id));
+      inflight_--;
+    }).detach();
+    return Error::Success;
+  }
+
+  Error RegisterSystemSharedMemory(
+      const std::string&, const std::string&, size_t, size_t) override {
+    return Error::Success;
+  }
+  Error RegisterTpuSharedMemory(
+      const std::string&, const std::string&, int64_t, size_t) override {
+    return Error::Success;
+  }
+  Error UnregisterSystemSharedMemory(const std::string&) override {
+    return Error::Success;
+  }
+  Error UnregisterTpuSharedMemory(const std::string&) override {
+    return Error::Success;
+  }
+
+ private:
+  Error MaybeError() {
+    if (error_rate_ > 0.0) {
+      thread_local std::mt19937 rng(std::random_device{}());
+      std::uniform_real_distribution<double> dist(0.0, 1.0);
+      if (dist(rng) < error_rate_) return Error("mock injected failure");
+    }
+    return Error::Success;
+  }
+
+  uint64_t delay_us_;
+  double error_rate_;
+  std::atomic<int64_t> inflight_{0};
+  std::mutex stream_mutex_;
+  OnCompleteFn stream_callback_;
+};
+
+}  // namespace
+
+std::shared_ptr<MockBackendStats> GetMockBackendStats() {
+  return g_mock_stats;
+}
+
+void ResetMockBackendStats() {
+  g_mock_stats->infer_calls = 0;
+  g_mock_stats->async_infer_calls = 0;
+  g_mock_stats->stream_infer_calls = 0;
+  g_mock_stats->completed = 0;
+  g_mock_stats->errors = 0;
+}
+
+Error ClientBackendFactory::Create(
+    std::unique_ptr<ClientBackend>* backend) const {
+  switch (config_.kind) {
+    case BackendKind::TRITON_GRPC:
+      return GrpcBackend::Create(config_, backend);
+    case BackendKind::TRITON_HTTP:
+      return HttpBackend::Create(config_, backend);
+    case BackendKind::MOCK:
+      backend->reset(new MockBackend(config_));
+      return Error::Success;
+  }
+  return Error("unknown backend kind");
+}
+
+//==============================================================================
+// TpuArenaClient
+
+Error TpuArenaClient::Create(
+    std::unique_ptr<TpuArenaClient>* client, const std::string& url) {
+  auto c = std::unique_ptr<TpuArenaClient>(new TpuArenaClient());
+  Error err = GrpcChannel::Create(&c->channel_, url);
+  if (!err.IsOk()) return err;
+  *client = std::move(c);
+  return Error::Success;
+}
+
+TpuArenaClient::~TpuArenaClient() = default;
+
+namespace {
+
+template <typename Req, typename Resp>
+Error ArenaRpc(
+    const std::shared_ptr<GrpcChannel>& channel, const char* method,
+    const Req& req, Resp* resp) {
+  std::string request_bytes, response_bytes;
+  if (!req.SerializeToString(&request_bytes)) {
+    return Error("failed to serialize arena request");
+  }
+  Error err = channel->UnaryCall(
+      std::string("/inference.TpuArenaService/") + method, request_bytes,
+      &response_bytes);
+  if (!err.IsOk()) return err;
+  if (!resp->ParseFromString(response_bytes)) {
+    return Error("failed to parse arena response");
+  }
+  return Error::Success;
+}
+
+}  // namespace
+
+Error TpuArenaClient::CreateRegion(
+    size_t byte_size, int64_t device_id, std::string* raw_handle,
+    std::string* region_id) {
+  inference::CreateRegionRequest req;
+  req.set_byte_size(byte_size);
+  req.set_device_id(device_id);
+  inference::CreateRegionResponse resp;
+  Error err = ArenaRpc(channel_, "CreateRegion", req, &resp);
+  if (!err.IsOk()) return err;
+  *raw_handle = resp.raw_handle();
+  *region_id = resp.region_id();
+  return Error::Success;
+}
+
+Error TpuArenaClient::WriteRegion(
+    const std::string& region_id, size_t offset, const std::string& data,
+    const std::string& datatype, const std::vector<int64_t>& shape) {
+  inference::WriteRegionRequest req;
+  req.set_region_id(region_id);
+  req.set_offset(offset);
+  req.set_data(data);
+  req.set_datatype(datatype);
+  for (int64_t d : shape) req.add_shape(d);
+  inference::WriteRegionResponse resp;
+  return ArenaRpc(channel_, "WriteRegion", req, &resp);
+}
+
+Error TpuArenaClient::ReadRegion(
+    const std::string& region_id, size_t offset, size_t byte_size,
+    std::string* data) {
+  inference::ReadRegionRequest req;
+  req.set_region_id(region_id);
+  req.set_offset(offset);
+  req.set_byte_size(byte_size);
+  inference::ReadRegionResponse resp;
+  Error err = ArenaRpc(channel_, "ReadRegion", req, &resp);
+  if (!err.IsOk()) return err;
+  *data = resp.data();
+  return Error::Success;
+}
+
+Error TpuArenaClient::DestroyRegion(const std::string& region_id) {
+  inference::DestroyRegionRequest req;
+  req.set_region_id(region_id);
+  inference::DestroyRegionResponse resp;
+  return ArenaRpc(channel_, "DestroyRegion", req, &resp);
+}
+
+}  // namespace perf
+}  // namespace tpuclient

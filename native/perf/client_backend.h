@@ -1,0 +1,158 @@
+// Backend abstraction for the native perf harness.
+//
+// Mirrors the reference perf_analyzer's cb::ClientBackend
+// (/root/reference/src/c++/perf_analyzer/client_backend/
+// client_backend.h:366) and its factory (:268): a backend-neutral
+// veneer over the protocol clients so the load-generation layer is
+// transport-agnostic. Concrete backends: TRITON_GRPC / TRITON_HTTP
+// (the native clients in ../library), and MOCK — a fake server with
+// programmable per-request delay used by the unit tests (parity:
+// mock_client_backend.h:471,617-625).
+//
+// The CUDA shared-memory verbs are replaced by TPU HBM arena verbs;
+// TpuArenaClient is the client side of the arena allocation
+// side-channel (client_tpu/protocol/arena.proto), standing in for
+// cudaMalloc/cudaIpcGetMemHandle.
+#pragma once
+
+#include <atomic>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "../library/common.h"
+#include "../library/json.h"
+
+namespace tpuclient {
+
+class GrpcChannel;
+class InferenceServerGrpcClient;
+class InferenceServerHttpClient;
+
+namespace perf {
+
+enum class BackendKind { TRITON_GRPC, TRITON_HTTP, MOCK };
+
+struct BackendConfig {
+  BackendKind kind = BackendKind::TRITON_GRPC;
+  std::string url;  // host:port
+  bool verbose = false;
+  size_t http_async_workers = 8;
+  // MOCK: simulated per-request latency and failure rate.
+  uint64_t mock_delay_us = 500;
+  double mock_error_rate = 0.0;
+};
+
+//==============================================================================
+// Backend-neutral client (parity: cb::ClientBackend).
+//
+class ClientBackend {
+ public:
+  virtual ~ClientBackend() = default;
+
+  virtual Error ServerMetadataJson(json::Value* metadata) = 0;
+  virtual Error ModelMetadataJson(
+      json::Value* metadata, const std::string& model_name,
+      const std::string& model_version = "") = 0;
+  virtual Error ModelConfigJson(
+      json::Value* config, const std::string& model_name,
+      const std::string& model_version = "") = 0;
+  // {model_name -> {inference_count, execution_count, ...ns totals}}.
+  virtual Error ModelStatisticsJson(
+      json::Value* stats, const std::string& model_name = "") = 0;
+
+  virtual Error Infer(
+      InferResult** result, const InferOptions& options,
+      const std::vector<InferInput*>& inputs,
+      const std::vector<const InferRequestedOutput*>& outputs) = 0;
+  virtual Error AsyncInfer(
+      OnCompleteFn callback, const InferOptions& options,
+      const std::vector<InferInput*>& inputs,
+      const std::vector<const InferRequestedOutput*>& outputs) = 0;
+  virtual Error StartStream(OnCompleteFn callback) = 0;
+  virtual Error StopStream() = 0;
+  virtual Error AsyncStreamInfer(
+      const InferOptions& options, const std::vector<InferInput*>& inputs,
+      const std::vector<const InferRequestedOutput*>& outputs) = 0;
+
+  virtual Error RegisterSystemSharedMemory(
+      const std::string& name, const std::string& key, size_t byte_size,
+      size_t offset = 0) = 0;
+  virtual Error RegisterTpuSharedMemory(
+      const std::string& name, const std::string& raw_handle,
+      int64_t device_id, size_t byte_size) = 0;
+  virtual Error UnregisterSystemSharedMemory(const std::string& name = "") = 0;
+  virtual Error UnregisterTpuSharedMemory(const std::string& name = "") = 0;
+};
+
+//==============================================================================
+// Factory (parity: ClientBackendFactory::Create,
+// client_backend.h:268).
+//
+class ClientBackendFactory {
+ public:
+  explicit ClientBackendFactory(BackendConfig config)
+      : config_(std::move(config)) {}
+
+  Error Create(std::unique_ptr<ClientBackend>* backend) const;
+
+  const BackendConfig& config() const { return config_; }
+
+ private:
+  BackendConfig config_;
+};
+
+//==============================================================================
+// Client for the TPU HBM arena allocation service — the stand-in for
+// client-side cudaMalloc + cudaIpcGetMemHandle (reference
+// infer_data_manager_shm.h:56 CreateCUDAIPCHandle).
+//
+class TpuArenaClient {
+ public:
+  // url is the gRPC endpoint hosting TpuArenaService (same server
+  // process that owns the HBM arena).
+  static Error Create(
+      std::unique_ptr<TpuArenaClient>* client, const std::string& url);
+  ~TpuArenaClient();
+
+  // Allocates an HBM region; returns the opaque raw handle (what gets
+  // registered with the inference service) and the region id.
+  Error CreateRegion(
+      size_t byte_size, int64_t device_id, std::string* raw_handle,
+      std::string* region_id);
+  // Writes bytes into the region, optionally typed so the server
+  // stores a ready-to-consume device array.
+  Error WriteRegion(
+      const std::string& region_id, size_t offset, const std::string& data,
+      const std::string& datatype = "",
+      const std::vector<int64_t>& shape = {});
+  Error ReadRegion(
+      const std::string& region_id, size_t offset, size_t byte_size,
+      std::string* data);
+  Error DestroyRegion(const std::string& region_id);
+
+ private:
+  TpuArenaClient() = default;
+  std::shared_ptr<GrpcChannel> channel_;
+};
+
+//==============================================================================
+// Mock backend call statistics (parity: MockClientStats,
+// mock_client_backend.h:145).
+//
+struct MockBackendStats {
+  std::atomic<uint64_t> infer_calls{0};
+  std::atomic<uint64_t> async_infer_calls{0};
+  std::atomic<uint64_t> stream_infer_calls{0};
+  std::atomic<uint64_t> completed{0};
+  std::atomic<uint64_t> errors{0};
+};
+
+std::shared_ptr<MockBackendStats> GetMockBackendStats();
+void ResetMockBackendStats();
+
+}  // namespace perf
+}  // namespace tpuclient

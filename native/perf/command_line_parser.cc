@@ -1,0 +1,242 @@
+#include "command_line_parser.h"
+
+#include <getopt.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+namespace tpuclient {
+namespace perf {
+
+namespace {
+
+// start[:end[:step]]
+template <typename T>
+bool ParseRange(const char* text, T* start, T* end, T* step) {
+  std::string s(text);
+  size_t c1 = s.find(':');
+  auto cast = [](const std::string& v) -> double { return atof(v.c_str()); };
+  *start = static_cast<T>(cast(s.substr(0, c1)));
+  *end = *start;
+  *step = static_cast<T>(1);
+  if (c1 == std::string::npos) return true;
+  size_t c2 = s.find(':', c1 + 1);
+  *end = static_cast<T>(cast(s.substr(c1 + 1, c2 - c1 - 1)));
+  if (c2 != std::string::npos) {
+    *step = static_cast<T>(cast(s.substr(c2 + 1)));
+  }
+  return true;
+}
+
+enum LongOpt {
+  kOptConcurrencyRange = 1000,
+  kOptRequestRateRange,
+  kOptRequestIntervals,
+  kOptPeriodicRange,
+  kOptRequestPeriod,
+  kOptRequestDistribution,
+  kOptMeasurementMode,
+  kOptMeasurementRequestCount,
+  kOptSharedMemory,
+  kOptOutputShmSize,
+  kOptTpuArenaUrl,
+  kOptInputData,
+  kOptStringLength,
+  kOptStringData,
+  kOptShape,
+  kOptSequenceLength,
+  kOptSequenceLengthVariation,
+  kOptSequenceIdRange,
+  kOptProfileExportFile,
+  kOptStreaming,
+  kOptSync,
+  kOptAsync,
+  kOptMaxThreads,
+  kOptPercentile,
+  kOptServiceKind,
+};
+
+const struct option kLongOptions[] = {
+    {"model-name", required_argument, nullptr, 'm'},
+    {"model-version", required_argument, nullptr, 'x'},
+    {"url", required_argument, nullptr, 'u'},
+    {"protocol", required_argument, nullptr, 'i'},
+    {"batch-size", required_argument, nullptr, 'b'},
+    {"verbose", no_argument, nullptr, 'v'},
+    {"measurement-interval", required_argument, nullptr, 'p'},
+    {"max-trials", required_argument, nullptr, 'r'},
+    {"stability-percentage", required_argument, nullptr, 's'},
+    {"latency-threshold", required_argument, nullptr, 'l'},
+    {"latency-report-file", required_argument, nullptr, 'f'},
+    {"concurrency-range", required_argument, nullptr, kOptConcurrencyRange},
+    {"request-rate-range", required_argument, nullptr, kOptRequestRateRange},
+    {"request-intervals", required_argument, nullptr, kOptRequestIntervals},
+    {"periodic-concurrency-range", required_argument, nullptr,
+     kOptPeriodicRange},
+    {"request-period", required_argument, nullptr, kOptRequestPeriod},
+    {"request-distribution", required_argument, nullptr,
+     kOptRequestDistribution},
+    {"measurement-mode", required_argument, nullptr, kOptMeasurementMode},
+    {"measurement-request-count", required_argument, nullptr,
+     kOptMeasurementRequestCount},
+    {"shared-memory", required_argument, nullptr, kOptSharedMemory},
+    {"output-shared-memory-size", required_argument, nullptr,
+     kOptOutputShmSize},
+    {"tpu-arena-url", required_argument, nullptr, kOptTpuArenaUrl},
+    {"input-data", required_argument, nullptr, kOptInputData},
+    {"string-length", required_argument, nullptr, kOptStringLength},
+    {"string-data", required_argument, nullptr, kOptStringData},
+    {"shape", required_argument, nullptr, kOptShape},
+    {"sequence-length", required_argument, nullptr, kOptSequenceLength},
+    {"sequence-length-variation", required_argument, nullptr,
+     kOptSequenceLengthVariation},
+    {"sequence-id-range", required_argument, nullptr, kOptSequenceIdRange},
+    {"profile-export-file", required_argument, nullptr,
+     kOptProfileExportFile},
+    {"streaming", no_argument, nullptr, kOptStreaming},
+    {"sync", no_argument, nullptr, kOptSync},
+    {"async", no_argument, nullptr, kOptAsync},
+    {"max-threads", required_argument, nullptr, kOptMaxThreads},
+    {"percentile", required_argument, nullptr, kOptPercentile},
+    {"service-kind", required_argument, nullptr, kOptServiceKind},
+    {nullptr, 0, nullptr, 0},
+};
+
+}  // namespace
+
+void CLParser::Usage(const char* program) {
+  fprintf(
+      stderr,
+      "Usage: %s -m <model> [-u host:port] [-i grpc|http] [options]\n"
+      "Load modes (default --concurrency-range 1):\n"
+      "  --concurrency-range start:end:step\n"
+      "  --request-rate-range start:end:step [--request-distribution "
+      "constant|poisson]\n"
+      "  --request-intervals <file>   (one microsecond gap per line)\n"
+      "  --periodic-concurrency-range start:end:step [--request-period N]\n"
+      "Measurement: -p <window ms>, -r <max trials>, -s <stability %%>,\n"
+      "  -l <latency threshold ms>, --percentile N, --measurement-mode\n"
+      "  time_windows|count_windows, --measurement-request-count N\n"
+      "Data: --input-data random|zero|<json>, --shape name:d1,d2,\n"
+      "  --string-length N, --string-data S\n"
+      "Shared memory: --shared-memory none|system|tpu,\n"
+      "  --output-shared-memory-size N, --tpu-arena-url host:port\n"
+      "Sequences: --sequence-length N, --sequence-length-variation pct,\n"
+      "  --sequence-id-range start[:end]\n"
+      "Output: -f <csv>, --profile-export-file <json>, -v\n",
+      program);
+}
+
+Error CLParser::Parse(
+    int argc, char** argv, PerfAnalyzerParameters* params) {
+  optind = 1;
+  int opt;
+  while ((opt = getopt_long(
+              argc, argv, "m:x:u:i:b:vp:r:s:l:f:", kLongOptions, nullptr)) !=
+         -1) {
+    switch (opt) {
+      case 'm': params->model_name = optarg; break;
+      case 'x': params->model_version = optarg; break;
+      case 'u': params->url = optarg; break;
+      case 'i':
+        params->protocol = optarg;
+        if (params->protocol != "grpc" && params->protocol != "http") {
+          return Error("unsupported protocol '" + params->protocol + "'");
+        }
+        break;
+      case 'b': params->batch_size = atoll(optarg); break;
+      case 'v': params->verbose = true; break;
+      case 'p': params->measurement_interval_ms = atoll(optarg); break;
+      case 'r': params->max_trials = atoll(optarg); break;
+      case 's': params->stability_percentage = atof(optarg); break;
+      case 'l': params->latency_threshold_ms = atof(optarg); break;
+      case 'f': params->latency_report_file = optarg; break;
+      case kOptConcurrencyRange:
+        params->has_concurrency_range = true;
+        ParseRange(optarg, &params->concurrency_start,
+                   &params->concurrency_end, &params->concurrency_step);
+        break;
+      case kOptRequestRateRange:
+        params->has_request_rate_range = true;
+        ParseRange(optarg, &params->rate_start, &params->rate_end,
+                   &params->rate_step);
+        break;
+      case kOptRequestIntervals:
+        params->request_intervals_file = optarg;
+        break;
+      case kOptPeriodicRange:
+        params->has_periodic_range = true;
+        ParseRange(optarg, &params->periodic_start, &params->periodic_end,
+                   &params->periodic_step);
+        break;
+      case kOptRequestPeriod: params->request_period = atoll(optarg); break;
+      case kOptRequestDistribution:
+        params->request_distribution = optarg;
+        if (params->request_distribution != "constant" &&
+            params->request_distribution != "poisson") {
+          return Error("unsupported request distribution");
+        }
+        break;
+      case kOptMeasurementMode:
+        params->measurement_mode = optarg;
+        if (params->measurement_mode != "time_windows" &&
+            params->measurement_mode != "count_windows") {
+          return Error("unsupported measurement mode");
+        }
+        break;
+      case kOptMeasurementRequestCount:
+        params->measurement_request_count = atoll(optarg);
+        break;
+      case kOptSharedMemory:
+        params->shared_memory = optarg;
+        if (params->shared_memory != "none" &&
+            params->shared_memory != "system" &&
+            params->shared_memory != "tpu") {
+          return Error("unsupported shared memory type (none|system|tpu)");
+        }
+        break;
+      case kOptOutputShmSize: params->output_shm_size = atoll(optarg); break;
+      case kOptTpuArenaUrl: params->tpu_arena_url = optarg; break;
+      case kOptInputData: params->input_data = optarg; break;
+      case kOptStringLength: params->string_length = atoll(optarg); break;
+      case kOptStringData: params->string_data = optarg; break;
+      case kOptShape: params->shape_overrides.push_back(optarg); break;
+      case kOptSequenceLength: params->sequence_length = atoll(optarg); break;
+      case kOptSequenceLengthVariation:
+        params->sequence_length_variation = atof(optarg);
+        break;
+      case kOptSequenceIdRange: params->sequence_id_range = optarg; break;
+      case kOptProfileExportFile:
+        params->profile_export_file = optarg;
+        break;
+      case kOptStreaming: params->streaming = true; break;
+      case kOptSync: params->async_mode = false; break;
+      case kOptAsync: params->async_mode = true; break;
+      case kOptMaxThreads: params->max_threads = atoll(optarg); break;
+      case kOptPercentile: params->percentile = atoi(optarg); break;
+      case kOptServiceKind:
+        if (std::string(optarg) != "triton") {
+          return Error("only --service-kind triton is supported natively; "
+                       "use the Python harness for in-process serving");
+        }
+        break;
+      default:
+        return Error("unknown option (see usage)");
+    }
+  }
+  if (params->model_name.empty()) {
+    return Error("-m <model name> is required");
+  }
+  int mode_count = (params->has_concurrency_range ? 1 : 0) +
+                   (params->has_request_rate_range ? 1 : 0) +
+                   (params->request_intervals_file.empty() ? 0 : 1) +
+                   (params->has_periodic_range ? 1 : 0);
+  if (mode_count > 1) {
+    return Error("load modes are mutually exclusive");
+  }
+  return Error::Success;
+}
+
+}  // namespace perf
+}  // namespace tpuclient

@@ -1,0 +1,77 @@
+// CLI for the native perf_analyzer (parity:
+// /root/reference/src/c++/perf_analyzer/command_line_parser.h:45-176 —
+// getopt_long into a plain parameters struct; same principal flags
+// and defaults, with the CUDA shm choice replaced by "tpu").
+#pragma once
+
+#include <string>
+#include <vector>
+
+#include "../library/common.h"
+#include "load_manager.h"
+
+namespace tpuclient {
+namespace perf {
+
+struct PerfAnalyzerParameters {
+  std::string model_name;
+  std::string model_version;
+  std::string url = "localhost:8001";
+  std::string protocol = "grpc";  // grpc | http
+  int64_t batch_size = 1;
+  bool verbose = false;
+  bool async_mode = true;
+  bool streaming = false;
+  size_t max_threads = 16;
+
+  // Load modes (mutually exclusive; concurrency default).
+  bool has_concurrency_range = false;
+  size_t concurrency_start = 1, concurrency_end = 1, concurrency_step = 1;
+  bool has_request_rate_range = false;
+  double rate_start = 0, rate_end = 0, rate_step = 1.0;
+  std::string request_intervals_file;
+  bool has_periodic_range = false;
+  size_t periodic_start = 1, periodic_end = 8, periodic_step = 1;
+  size_t request_period = 10;
+  std::string request_distribution = "constant";  // constant | poisson
+
+  // Measurement.
+  uint64_t measurement_interval_ms = 5000;
+  std::string measurement_mode = "time_windows";
+  size_t measurement_request_count = 50;
+  size_t max_trials = 10;
+  double stability_percentage = 10.0;
+  double latency_threshold_ms = 0.0;
+  int percentile = 0;
+
+  // Shared memory.
+  std::string shared_memory = "none";  // none | system | tpu
+  size_t output_shm_size = 102400;
+  std::string tpu_arena_url;
+
+  // Input data.
+  std::string input_data = "random";  // random | zero | file path
+  size_t string_length = 16;
+  std::string string_data;
+  // name:d1,d2 shape overrides.
+  std::vector<std::string> shape_overrides;
+
+  // Sequences.
+  size_t sequence_length = 20;
+  double sequence_length_variation = 20.0;
+  std::string sequence_id_range;  // start[:end]
+
+  // Output files.
+  std::string latency_report_file;
+  std::string profile_export_file;
+};
+
+class CLParser {
+ public:
+  // Returns an error (with a usage hint) on bad flags.
+  static Error Parse(int argc, char** argv, PerfAnalyzerParameters* params);
+  static void Usage(const char* program);
+};
+
+}  // namespace perf
+}  // namespace tpuclient

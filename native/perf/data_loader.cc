@@ -1,0 +1,313 @@
+#include "data_loader.h"
+
+#include <cstring>
+#include <fstream>
+#include <random>
+#include <sstream>
+
+#include "../library/base64.h"
+
+namespace tpuclient {
+namespace perf {
+
+namespace {
+
+int64_t ElementCount(const std::vector<int64_t>& shape) {
+  int64_t count = 1;
+  for (int64_t d : shape) count *= (d < 0 ? 1 : d);
+  return count;
+}
+
+std::vector<int64_t> ResolveShape(const std::vector<int64_t>& shape) {
+  std::vector<int64_t> out;
+  for (int64_t d : shape) out.push_back(d < 0 ? 1 : d);
+  return out;
+}
+
+// Serializes one BYTES element with its 4-byte LE length prefix.
+void AppendBytesElement(const std::string& value, std::string* out) {
+  uint32_t len = static_cast<uint32_t>(value.size());
+  out->append(reinterpret_cast<const char*>(&len), 4);
+  out->append(value);
+}
+
+template <typename T>
+void AppendScalar(T value, std::string* out) {
+  out->append(reinterpret_cast<const char*>(&value), sizeof(T));
+}
+
+// Converts an IEEE float to bfloat16 (truncating round) / fp16.
+uint16_t FloatToBf16(float f) {
+  uint32_t bits;
+  memcpy(&bits, &f, 4);
+  return static_cast<uint16_t>(bits >> 16);
+}
+
+uint16_t FloatToFp16(float f) {
+  // Good-enough conversion for generated benchmark data (no denormal
+  // care needed for values in [0,1)).
+  uint32_t bits;
+  memcpy(&bits, &f, 4);
+  uint32_t sign = (bits >> 16) & 0x8000;
+  int32_t exp = static_cast<int32_t>((bits >> 23) & 0xff) - 127 + 15;
+  uint32_t mant = (bits >> 13) & 0x3ff;
+  if (exp <= 0) return static_cast<uint16_t>(sign);
+  if (exp >= 31) return static_cast<uint16_t>(sign | 0x7c00);
+  return static_cast<uint16_t>(sign | (exp << 10) | mant);
+}
+
+// Appends one random element of `datatype` to out.
+void AppendRandomElement(
+    const std::string& datatype, std::mt19937_64* rng, std::string* out) {
+  std::uniform_real_distribution<double> real(0.0, 1.0);
+  std::uniform_int_distribution<int64_t> small_int(-(1 << 20), 1 << 20);
+  std::uniform_int_distribution<int64_t> unsigned_int(0, 1 << 20);
+  if (datatype == "FP32") {
+    AppendScalar(static_cast<float>(real(*rng)), out);
+  } else if (datatype == "FP64") {
+    AppendScalar(real(*rng), out);
+  } else if (datatype == "FP16") {
+    AppendScalar(FloatToFp16(static_cast<float>(real(*rng))), out);
+  } else if (datatype == "BF16") {
+    AppendScalar(FloatToBf16(static_cast<float>(real(*rng))), out);
+  } else if (datatype == "BOOL") {
+    AppendScalar(static_cast<uint8_t>((*rng)() & 1), out);
+  } else if (datatype == "INT8") {
+    AppendScalar(static_cast<int8_t>(small_int(*rng)), out);
+  } else if (datatype == "INT16") {
+    AppendScalar(static_cast<int16_t>(small_int(*rng)), out);
+  } else if (datatype == "INT32") {
+    AppendScalar(static_cast<int32_t>(small_int(*rng)), out);
+  } else if (datatype == "INT64") {
+    AppendScalar(small_int(*rng), out);
+  } else if (datatype == "UINT8") {
+    AppendScalar(static_cast<uint8_t>(unsigned_int(*rng)), out);
+  } else if (datatype == "UINT16") {
+    AppendScalar(static_cast<uint16_t>(unsigned_int(*rng)), out);
+  } else if (datatype == "UINT32") {
+    AppendScalar(static_cast<uint32_t>(unsigned_int(*rng)), out);
+  } else if (datatype == "UINT64") {
+    AppendScalar(static_cast<uint64_t>(unsigned_int(*rng)), out);
+  }
+}
+
+}  // namespace
+
+Error DataLoader::GetInputData(
+    const std::string& input_name, size_t stream, size_t step,
+    const TensorData** data) const {
+  if (stream >= data_.size() || step >= data_[stream].size()) {
+    return Error(
+        "no data for stream " + std::to_string(stream) + " step " +
+        std::to_string(step));
+  }
+  auto it = data_[stream][step].find(input_name);
+  if (it == data_[stream][step].end()) {
+    return Error("no data for input '" + input_name + "'");
+  }
+  *data = &it->second;
+  return Error::Success;
+}
+
+Error DataLoader::GenerateData(
+    bool zero_input, size_t string_length, const std::string& string_data,
+    uint64_t seed, size_t steps) {
+  std::mt19937_64 rng(seed);
+  data_.clear();
+  data_.emplace_back();
+  auto& stream = data_.back();
+  for (size_t s = 0; s < steps; ++s) {
+    stream.emplace_back();
+    auto& step_data = stream.back();
+    for (const auto& tensor : model_->inputs) {
+      TensorData data;
+      data.datatype = tensor.datatype;
+      data.shape = ResolveShape(tensor.shape);
+      int64_t count = ElementCount(data.shape);
+      if (tensor.datatype == "BYTES") {
+        for (int64_t i = 0; i < count; ++i) {
+          std::string value;
+          if (!string_data.empty()) {
+            value = string_data;
+          } else {
+            for (size_t c = 0; c < string_length; ++c) {
+              value.push_back(static_cast<char>('a' + (rng() % 26)));
+            }
+          }
+          AppendBytesElement(value, &data.bytes);
+        }
+      } else {
+        size_t elem = DatatypeByteSize(tensor.datatype);
+        if (elem == 0) {
+          return Error(
+              "cannot generate data for datatype " + tensor.datatype);
+        }
+        if (zero_input) {
+          data.bytes.assign(count * elem, '\0');
+        } else {
+          data.bytes.reserve(count * elem);
+          for (int64_t i = 0; i < count; ++i) {
+            AppendRandomElement(tensor.datatype, &rng, &data.bytes);
+          }
+        }
+      }
+      step_data.emplace(tensor.name, std::move(data));
+    }
+  }
+  return Error::Success;
+}
+
+Error DataLoader::ReadDataFromJson(const std::string& path) {
+  std::ifstream in(path);
+  if (!in) return Error("cannot open input data file '" + path + "'");
+  std::stringstream buffer;
+  buffer << in.rdbuf();
+  return ReadDataFromJsonText(buffer.str());
+}
+
+Error DataLoader::ReadDataFromJsonText(const std::string& text) {
+  json::Value doc;
+  std::string parse_err = json::Parse(text, &doc);
+  if (!parse_err.empty()) {
+    return Error("bad input data JSON: " + parse_err);
+  }
+  try {
+    if (!doc.Has("data")) return Error("input JSON missing 'data' array");
+    const json::Array& data = doc["data"].AsArray();
+    // One stream of steps, or an array of streams.
+    std::vector<const json::Array*> streams;
+    if (!data.empty() && data[0].IsArray()) {
+      for (const auto& s : data) streams.push_back(&s.AsArray());
+    } else {
+      streams.push_back(&data);
+    }
+    data_.clear();
+    for (const json::Array* stream : streams) {
+      data_.emplace_back();
+      auto& steps = data_.back();
+      for (const auto& step : *stream) {
+        steps.emplace_back();
+        auto& step_data = steps.back();
+        for (const auto& kv : step.AsObject().entries()) {
+          const ModelTensor* tensor = model_->FindInput(kv.first);
+          if (tensor == nullptr) {
+            return Error(
+                "input '" + kv.first + "' in data JSON is not a model input");
+          }
+          TensorData parsed;
+          Error err = ParseValue(*tensor, kv.second, &parsed);
+          if (!err.IsOk()) return err;
+          step_data.emplace(kv.first, std::move(parsed));
+        }
+      }
+    }
+  } catch (const std::exception& e) {
+    return Error(std::string("malformed input data JSON: ") + e.what());
+  }
+  return Validate();
+}
+
+Error DataLoader::ParseValue(
+    const ModelTensor& tensor, const json::Value& value, TensorData* out) {
+  out->datatype = tensor.datatype;
+  const json::Value* content = &value;
+  if (value.IsObject()) {
+    if (value.Has("shape")) {
+      out->shape.clear();
+      for (const auto& d : value["shape"].AsArray()) {
+        out->shape.push_back(d.AsInt());
+      }
+    }
+    if (value.Has("b64")) {
+      if (!Base64Decode(value["b64"].AsString(), &out->bytes)) {
+        return Error("bad b64 content for input '" + tensor.name + "'");
+      }
+      if (out->shape.empty()) out->shape = ResolveShape(tensor.shape);
+      return Error::Success;
+    }
+    if (!value.Has("content")) {
+      return Error(
+          "input '" + tensor.name + "' object needs 'content' or 'b64'");
+    }
+    content = &value["content"];
+  }
+  const json::Array& flat = content->AsArray();
+  if (out->shape.empty()) {
+    if (!tensor.shape.empty() &&
+        std::find(tensor.shape.begin(), tensor.shape.end(), -1) ==
+            tensor.shape.end()) {
+      out->shape = tensor.shape;
+    } else {
+      out->shape = {static_cast<int64_t>(flat.size())};
+    }
+  }
+  if (tensor.datatype == "BYTES") {
+    for (const auto& v : flat) AppendBytesElement(v.AsString(), &out->bytes);
+    return Error::Success;
+  }
+  for (const auto& v : flat) {
+    if (tensor.datatype == "FP32") {
+      AppendScalar(static_cast<float>(v.AsDouble()), &out->bytes);
+    } else if (tensor.datatype == "FP64") {
+      AppendScalar(v.AsDouble(), &out->bytes);
+    } else if (tensor.datatype == "FP16") {
+      AppendScalar(FloatToFp16(static_cast<float>(v.AsDouble())), &out->bytes);
+    } else if (tensor.datatype == "BF16") {
+      AppendScalar(FloatToBf16(static_cast<float>(v.AsDouble())), &out->bytes);
+    } else if (tensor.datatype == "BOOL") {
+      AppendScalar(static_cast<uint8_t>(v.AsBool() ? 1 : 0), &out->bytes);
+    } else if (tensor.datatype == "INT8") {
+      AppendScalar(static_cast<int8_t>(v.AsInt()), &out->bytes);
+    } else if (tensor.datatype == "INT16") {
+      AppendScalar(static_cast<int16_t>(v.AsInt()), &out->bytes);
+    } else if (tensor.datatype == "INT32") {
+      AppendScalar(static_cast<int32_t>(v.AsInt()), &out->bytes);
+    } else if (tensor.datatype == "INT64") {
+      AppendScalar(v.AsInt(), &out->bytes);
+    } else if (tensor.datatype == "UINT8") {
+      AppendScalar(static_cast<uint8_t>(v.AsUint()), &out->bytes);
+    } else if (tensor.datatype == "UINT16") {
+      AppendScalar(static_cast<uint16_t>(v.AsUint()), &out->bytes);
+    } else if (tensor.datatype == "UINT32") {
+      AppendScalar(static_cast<uint32_t>(v.AsUint()), &out->bytes);
+    } else if (tensor.datatype == "UINT64") {
+      AppendScalar(v.AsUint(), &out->bytes);
+    } else {
+      return Error("unsupported datatype " + tensor.datatype);
+    }
+  }
+  return Error::Success;
+}
+
+Error DataLoader::Validate() const {
+  for (size_t stream = 0; stream < data_.size(); ++stream) {
+    for (size_t step = 0; step < data_[stream].size(); ++step) {
+      for (const auto& tensor : model_->inputs) {
+        auto it = data_[stream][step].find(tensor.name);
+        if (it == data_[stream][step].end()) {
+          if (tensor.optional) continue;
+          return Error(
+              "missing data for input '" + tensor.name + "' (stream " +
+              std::to_string(stream) + " step " + std::to_string(step) + ")");
+        }
+        const auto& got = it->second.shape;
+        const auto& want = tensor.shape;
+        bool compatible = got.size() == want.size();
+        if (compatible) {
+          for (size_t i = 0; i < got.size(); ++i) {
+            if (want[i] != -1 && got[i] != want[i]) compatible = false;
+          }
+        }
+        if (!compatible) {
+          return Error(
+              "data shape for input '" + tensor.name +
+              "' incompatible with the model spec");
+        }
+      }
+    }
+  }
+  return Error::Success;
+}
+
+}  // namespace perf
+}  // namespace tpuclient

@@ -1,0 +1,65 @@
+// Input data source for the native perf harness (parity:
+// /root/reference/src/c++/perf_analyzer/data_loader.h:63-99 —
+// random/zero generation, JSON data files with b64 content and
+// multi-stream steps).
+#pragma once
+
+#include <map>
+#include <string>
+#include <vector>
+
+#include "../library/common.h"
+#include "model_parser.h"
+
+namespace tpuclient {
+namespace perf {
+
+// One concrete tensor value for a (stream, step). BYTES tensors are
+// stored pre-serialized (4-byte-LE length-prefixed).
+struct TensorData {
+  std::string bytes;
+  std::string datatype;
+  std::vector<int64_t> shape;
+};
+
+// Streams model the reference's sequence data-streams; non-sequence
+// runs use stream 0 and cycle through steps.
+class DataLoader {
+ public:
+  explicit DataLoader(const ParsedModel* model) : model_(model) {}
+
+  size_t stream_count() const { return data_.size(); }
+  size_t step_count(size_t stream = 0) const {
+    return stream < data_.size() ? data_[stream].size() : 0;
+  }
+
+  Error GetInputData(
+      const std::string& input_name, size_t stream, size_t step,
+      const TensorData** data) const;
+
+  // Random (or zero) data for every input (parity: GenerateData
+  // data_loader.h:89). Dynamic dims resolve to 1.
+  Error GenerateData(
+      bool zero_input = false, size_t string_length = 16,
+      const std::string& string_data = "", uint64_t seed = 7,
+      size_t steps = 1);
+
+  // Reads the reference's JSON input format: {"data": [step, ...]} or
+  // {"data": [[stream0 steps], ...]}; each step maps input name ->
+  // list | {"content": ..} | {"b64": ..} with optional "shape"
+  // (parity: ReadDataFromJSON data_loader.h:74).
+  Error ReadDataFromJson(const std::string& path);
+  Error ReadDataFromJsonText(const std::string& text);
+
+ private:
+  Error ParseValue(
+      const ModelTensor& tensor, const json::Value& value, TensorData* out);
+  Error Validate() const;
+
+  const ParsedModel* model_;
+  // stream -> step -> {input name -> data}
+  std::vector<std::vector<std::map<std::string, TensorData>>> data_;
+};
+
+}  // namespace perf
+}  // namespace tpuclient

@@ -1,0 +1,246 @@
+#include "inference_profiler.h"
+
+#include <algorithm>
+#include <chrono>
+#include <cmath>
+#include <cstdio>
+#include <thread>
+
+namespace tpuclient {
+namespace perf {
+
+namespace {
+
+double Percentile(std::vector<double>& sorted, double p) {
+  if (sorted.empty()) return 0.0;
+  double rank = (p / 100.0) * (sorted.size() - 1);
+  size_t lo = static_cast<size_t>(rank);
+  size_t hi = std::min(lo + 1, sorted.size() - 1);
+  double frac = rank - lo;
+  return sorted[lo] * (1.0 - frac) + sorted[hi] * frac;
+}
+
+}  // namespace
+
+Error InferenceProfiler::ProfileConcurrencyRange(
+    ConcurrencyManager* manager, size_t start, size_t end, size_t step,
+    std::vector<PerfStatus>* results) {
+  size_t concurrency = start;
+  while (concurrency <= end || (end == 0 && concurrency == start)) {
+    Error err = manager->ChangeConcurrencyLevel(concurrency);
+    if (!err.IsOk()) return err;
+    PerfStatus status;
+    err = ProfileLevel(&status);
+    if (!err.IsOk()) return err;
+    status.concurrency = concurrency;
+    results->push_back(std::move(status));
+    if (ExceedsLatencyThreshold(results->back())) break;
+    if (end == 0) break;
+    concurrency += step;
+  }
+  manager->Stop();
+  return Error::Success;
+}
+
+Error InferenceProfiler::ProfileRequestRateRange(
+    RequestRateManager* manager, double start, double end, double step,
+    std::vector<PerfStatus>* results) {
+  double rate = start;
+  while (rate <= end + 1e-9 || (end == 0 && rate == start)) {
+    Error err = manager->ChangeRequestRate(rate);
+    if (!err.IsOk()) return err;
+    PerfStatus status;
+    err = ProfileLevel(&status);
+    if (!err.IsOk()) return err;
+    status.request_rate = rate;
+    results->push_back(std::move(status));
+    if (ExceedsLatencyThreshold(results->back())) break;
+    if (end == 0) break;
+    rate += step;
+  }
+  manager->Stop();
+  return Error::Success;
+}
+
+Error InferenceProfiler::ProfileSingleLevel(PerfStatus* status) {
+  return ProfileLevel(status);
+}
+
+bool InferenceProfiler::ExceedsLatencyThreshold(
+    const PerfStatus& status) const {
+  if (config_.latency_threshold_ms <= 0) return false;
+  return StabilityMetric(status) / 1000.0 > config_.latency_threshold_ms;
+}
+
+double InferenceProfiler::StabilityMetric(const PerfStatus& status) const {
+  if (config_.percentile != 0) {
+    auto it = status.latency_percentiles.find(config_.percentile);
+    if (it != status.latency_percentiles.end()) return it->second;
+  }
+  return status.avg_latency_us;
+}
+
+Error InferenceProfiler::ProfileLevel(PerfStatus* merged) {
+  std::vector<PerfStatus> trials;
+  for (size_t trial = 0; trial < config_.max_trials; ++trial) {
+    PerfStatus status;
+    Error err = Measure(&status);
+    if (!err.IsOk()) return err;
+    err = manager_->CheckHealth();
+    if (!err.IsOk()) return err;
+    if (verbose_) {
+      fprintf(stderr, "  trial %zu: %.1f infer/sec, avg %.0f us\n", trial,
+              status.throughput, status.avg_latency_us);
+    }
+    trials.push_back(std::move(status));
+    if (IsStable(trials)) {
+      std::vector<PerfStatus> last3(
+          std::make_move_iterator(trials.end() - 3),
+          std::make_move_iterator(trials.end()));
+      *merged = Merge(std::move(last3));
+      return Error::Success;
+    }
+  }
+  // Unstable: merge what we have, flagged.
+  size_t keep = std::min<size_t>(trials.size(), 3);
+  std::vector<PerfStatus> tail(
+      std::make_move_iterator(trials.end() - keep),
+      std::make_move_iterator(trials.end()));
+  *merged = Merge(std::move(tail));
+  merged->on_target = false;
+  return Error::Success;
+}
+
+Error InferenceProfiler::Measure(PerfStatus* status) {
+  manager_->SwapRequestRecords();  // discard warm-up residue
+  uint64_t start_ns = NowNs();
+  if (config_.count_windows) {
+    uint64_t deadline =
+        start_ns + config_.measurement_interval_ms * 10ull * 1000 * 1000;
+    while (manager_->CountCollectedRequests() <
+               config_.measurement_request_count &&
+           NowNs() < deadline) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(5));
+    }
+  } else {
+    std::this_thread::sleep_for(
+        std::chrono::milliseconds(config_.measurement_interval_ms));
+  }
+  uint64_t end_ns = NowNs();
+  Summarize(manager_->SwapRequestRecords(), start_ns, end_ns, status);
+  if (stats_backend_ != nullptr && !model_name_.empty()) {
+    // Best effort — a failed stats scrape never fails the window.
+    stats_backend_->ModelStatisticsJson(&status->server_stats, model_name_);
+  }
+  return Error::Success;
+}
+
+void InferenceProfiler::Summarize(
+    std::vector<RequestRecord>&& records, uint64_t start_ns, uint64_t end_ns,
+    PerfStatus* status) {
+  status->window_start_ns = start_ns;
+  status->window_end_ns = end_ns;
+  std::vector<double> latencies_us;
+  for (const auto& record : records) {
+    if (record.valid()) {
+      latencies_us.push_back(record.latency_ns() / 1000.0);
+    }
+    if (record.has_error) status->error_count++;
+    if (record.delayed) status->delayed_count++;
+  }
+  status->records = std::move(records);
+  status->completed_count = latencies_us.size();
+  if (latencies_us.empty()) return;
+  double sum = 0.0;
+  for (double v : latencies_us) sum += v;
+  status->avg_latency_us = sum / latencies_us.size();
+  double var = 0.0;
+  for (double v : latencies_us) {
+    var += (v - status->avg_latency_us) * (v - status->avg_latency_us);
+  }
+  status->std_latency_us = std::sqrt(var / latencies_us.size());
+  std::sort(latencies_us.begin(), latencies_us.end());
+  for (int p : {50, 90, 95, 99}) {
+    status->latency_percentiles[p] = Percentile(latencies_us, p);
+  }
+  if (config_.percentile != 0 &&
+      status->latency_percentiles.find(config_.percentile) ==
+          status->latency_percentiles.end()) {
+    status->latency_percentiles[config_.percentile] =
+        Percentile(latencies_us, config_.percentile);
+  }
+  double window_s = (end_ns - start_ns) / 1e9;
+  status->throughput =
+      window_s > 0 ? status->completed_count / window_s : 0.0;
+}
+
+bool InferenceProfiler::IsStable(
+    const std::vector<PerfStatus>& trials) const {
+  if (trials.size() < 3) return false;
+  const PerfStatus* last3[3] = {
+      &trials[trials.size() - 3], &trials[trials.size() - 2],
+      &trials[trials.size() - 1]};
+  for (const PerfStatus* t : last3) {
+    if (t->completed_count == 0) return false;
+  }
+  double latencies[3], throughputs[3];
+  for (int i = 0; i < 3; ++i) {
+    latencies[i] = StabilityMetric(*last3[i]);
+    throughputs[i] = last3[i]->throughput;
+  }
+  for (double* values : {latencies, throughputs}) {
+    double mean = (values[0] + values[1] + values[2]) / 3.0;
+    if (mean <= 0) return false;
+    for (int i = 0; i < 3; ++i) {
+      if (std::abs(values[i] - mean) / mean > config_.stability_threshold) {
+        return false;
+      }
+    }
+  }
+  return true;
+}
+
+PerfStatus InferenceProfiler::Merge(std::vector<PerfStatus>&& trials) const {
+  PerfStatus merged;
+  if (trials.empty()) return merged;
+  merged.window_start_ns = trials.front().window_start_ns;
+  merged.window_end_ns = trials.back().window_end_ns;
+  double window_s = 0.0;
+  std::vector<double> latencies_us;
+  for (auto& trial : trials) {
+    merged.completed_count += trial.completed_count;
+    merged.error_count += trial.error_count;
+    merged.delayed_count += trial.delayed_count;
+    window_s += (trial.window_end_ns - trial.window_start_ns) / 1e9;
+    for (auto& record : trial.records) {
+      if (record.valid()) latencies_us.push_back(record.latency_ns() / 1000.0);
+      merged.records.push_back(std::move(record));
+    }
+  }
+  merged.server_stats = trials.back().server_stats;
+  if (!latencies_us.empty()) {
+    double sum = 0.0;
+    for (double v : latencies_us) sum += v;
+    merged.avg_latency_us = sum / latencies_us.size();
+    double var = 0.0;
+    for (double v : latencies_us) {
+      var += (v - merged.avg_latency_us) * (v - merged.avg_latency_us);
+    }
+    merged.std_latency_us = std::sqrt(var / latencies_us.size());
+    std::sort(latencies_us.begin(), latencies_us.end());
+    for (int p : {50, 90, 95, 99}) {
+      merged.latency_percentiles[p] = Percentile(latencies_us, p);
+    }
+    if (config_.percentile != 0 &&
+        merged.latency_percentiles.find(config_.percentile) ==
+            merged.latency_percentiles.end()) {
+      merged.latency_percentiles[config_.percentile] =
+          Percentile(latencies_us, config_.percentile);
+    }
+  }
+  merged.throughput = window_s > 0 ? merged.completed_count / window_s : 0.0;
+  return merged;
+}
+
+}  // namespace perf
+}  // namespace tpuclient

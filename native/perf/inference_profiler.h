@@ -1,0 +1,95 @@
+// Measurement engine for the native perf harness (parity:
+// /root/reference/src/c++/perf_analyzer/inference_profiler.h:215):
+// sweeps load levels, repeats measurement windows until the last
+// three trials agree within the stability threshold on latency AND
+// throughput, merges the stable trials (MergePerfStatusReports,
+// inference_profiler.cc:648), and pairs server-side statistics.
+#pragma once
+
+#include <map>
+#include <string>
+#include <vector>
+
+#include "../library/common.h"
+#include "load_manager.h"
+
+namespace tpuclient {
+namespace perf {
+
+// One stable measurement at a load level (parity: PerfStatus,
+// inference_profiler.h:178).
+struct PerfStatus {
+  size_t concurrency = 0;
+  double request_rate = 0.0;
+  double throughput = 0.0;        // infer/sec
+  double avg_latency_us = 0.0;
+  double std_latency_us = 0.0;
+  std::map<int, double> latency_percentiles;  // us
+  size_t completed_count = 0;
+  size_t delayed_count = 0;
+  size_t error_count = 0;
+  bool on_target = true;  // false when the level never stabilized
+  uint64_t window_start_ns = 0;
+  uint64_t window_end_ns = 0;
+  // Raw records for the profile export.
+  std::vector<RequestRecord> records;
+  // Server-side statistics snapshot at window end (model_stats JSON).
+  json::Value server_stats;
+  // Client-transport breakdown averaged over the window (from the
+  // setup backend's cumulative stats when available).
+  double avg_send_time_us = 0.0;
+  double avg_receive_time_us = 0.0;
+};
+
+struct MeasurementConfig {
+  uint64_t measurement_interval_ms = 5000;
+  bool count_windows = false;  // measure by request count, not time
+  size_t measurement_request_count = 50;
+  size_t max_trials = 10;
+  double stability_threshold = 0.1;
+  double latency_threshold_ms = 0.0;  // 0 = no limit
+  int percentile = 0;                 // 0 = stabilize on average
+};
+
+class InferenceProfiler {
+ public:
+  InferenceProfiler(
+      LoadManager* manager, MeasurementConfig config,
+      ClientBackend* stats_backend = nullptr, std::string model_name = "",
+      bool verbose = false)
+      : manager_(manager), config_(config), stats_backend_(stats_backend),
+        model_name_(std::move(model_name)), verbose_(verbose) {}
+
+  // Concurrency sweep: [start, end] by step; end==0 profiles only
+  // `start`. Stops early when the latency threshold is exceeded.
+  Error ProfileConcurrencyRange(
+      ConcurrencyManager* manager, size_t start, size_t end, size_t step,
+      std::vector<PerfStatus>* results);
+
+  Error ProfileRequestRateRange(
+      RequestRateManager* manager, double start, double end, double step,
+      std::vector<PerfStatus>* results);
+
+  // Measures at whatever load the manager is already generating.
+  Error ProfileSingleLevel(PerfStatus* status);
+
+ private:
+  Error ProfileLevel(PerfStatus* merged);
+  Error Measure(PerfStatus* status);
+  void Summarize(
+      std::vector<RequestRecord>&& records, uint64_t start_ns,
+      uint64_t end_ns, PerfStatus* status);
+  bool IsStable(const std::vector<PerfStatus>& trials) const;
+  double StabilityMetric(const PerfStatus& status) const;
+  PerfStatus Merge(std::vector<PerfStatus>&& trials) const;
+  bool ExceedsLatencyThreshold(const PerfStatus& status) const;
+
+  LoadManager* manager_;
+  MeasurementConfig config_;
+  ClientBackend* stats_backend_;
+  std::string model_name_;
+  bool verbose_;
+};
+
+}  // namespace perf
+}  // namespace tpuclient

@@ -1,0 +1,832 @@
+#include "load_manager.h"
+
+#include <algorithm>
+#include <chrono>
+#include <cstring>
+#include <fstream>
+
+#include "../library/shm_utils.h"
+
+namespace tpuclient {
+namespace perf {
+
+uint64_t NowNs() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+//==============================================================================
+// FifoCtxIdTracker
+
+void FifoCtxIdTracker::Reset(size_t count) {
+  std::lock_guard<std::mutex> lock(mutex_);
+  free_.clear();
+  for (size_t i = 0; i < count; ++i) free_.push_back(static_cast<int>(i));
+  cv_.notify_all();
+}
+
+int FifoCtxIdTracker::Get(int timeout_ms) {
+  std::unique_lock<std::mutex> lock(mutex_);
+  if (!cv_.wait_for(lock, std::chrono::milliseconds(timeout_ms),
+                    [this] { return !free_.empty(); })) {
+    return -1;
+  }
+  int id = free_.front();
+  free_.pop_front();
+  return id;
+}
+
+void FifoCtxIdTracker::Release(int ctx_id) {
+  {
+    std::lock_guard<std::mutex> lock(mutex_);
+    free_.push_back(ctx_id);
+  }
+  cv_.notify_one();
+}
+
+size_t FifoCtxIdTracker::FreeCount() {
+  std::lock_guard<std::mutex> lock(mutex_);
+  return free_.size();
+}
+
+//==============================================================================
+// SequenceManager
+
+void SequenceManager::NextStep(
+    Slot* slot, size_t stream_count, size_t steps_in_stream,
+    InferOptions* options, size_t* stream, size_t* step) {
+  std::lock_guard<std::mutex> lock(mutex_);
+  if (!slot->active) {
+    slot->sequence_id = start_id_ + (next_offset_++ % id_range_);
+    std::uniform_real_distribution<double> dist(-variation_, variation_);
+    double factor = 1.0 + dist(rng_);
+    slot->remaining = std::max<size_t>(
+        1, static_cast<size_t>(length_ * factor));
+    slot->step = 0;
+    slot->stream =
+        stream_count > 1 ? (rng_() % stream_count) : 0;
+    slot->active = true;
+  }
+  options->sequence_id = slot->sequence_id;
+  options->sequence_start = (slot->step == 0);
+  slot->remaining--;
+  options->sequence_end = (slot->remaining == 0);
+  *stream = slot->stream;
+  *step = steps_in_stream > 0 ? slot->step % steps_in_stream : 0;
+  slot->step++;
+  if (options->sequence_end) slot->active = false;
+}
+
+//==============================================================================
+// InferDataManager
+
+InferDataManager::~InferDataManager() {
+  for (auto& region : system_regions_) {
+    if (region.addr != nullptr) UnmapSharedMemory(region.addr, region.byte_size);
+    if (region.fd >= 0) CloseSharedMemory(region.fd);
+    UnlinkSharedMemoryRegion(region.key);
+  }
+}
+
+const std::string* InferDataManager::BatchedBytes(
+    const std::string& input, size_t stream, size_t step,
+    const TensorData& data) {
+  std::string key =
+      input + "_" + std::to_string(stream) + "_" + std::to_string(step);
+  std::lock_guard<std::mutex> lock(cache_mutex_);
+  auto it = batched_cache_.find(key);
+  if (it != batched_cache_.end()) return &it->second;
+  std::string batched;
+  int64_t copies = (model_->max_batch_size > 0) ? batch_ : 1;
+  batched.reserve(data.bytes.size() * copies);
+  for (int64_t i = 0; i < copies; ++i) batched.append(data.bytes);
+  auto inserted = batched_cache_.emplace(key, std::move(batched));
+  return &inserted.first->second;
+}
+
+Error InferDataManager::CreateInputRegion(
+    ClientBackend* backend, const std::string& region,
+    const TensorData& data) {
+  int64_t copies = (model_->max_batch_size > 0) ? batch_ : 1;
+  size_t byte_size = std::max<size_t>(data.bytes.size() * copies, 1);
+  if (shm_type_ == SharedMemoryType::SYSTEM) {
+    SystemRegion sys;
+    sys.name = region;
+    sys.key = "/perf_" + region;
+    sys.byte_size = byte_size;
+    Error err = CreateSharedMemoryRegion(sys.key, byte_size, &sys.fd);
+    if (!err.IsOk()) return err;
+    err = MapSharedMemory(sys.fd, 0, byte_size, &sys.addr);
+    if (!err.IsOk()) return err;
+    char* dst = static_cast<char*>(sys.addr);
+    for (int64_t i = 0; i < copies; ++i) {
+      memcpy(dst + i * data.bytes.size(), data.bytes.data(),
+             data.bytes.size());
+    }
+    err = backend->RegisterSystemSharedMemory(region, sys.key, byte_size);
+    system_regions_.push_back(std::move(sys));
+    return err;
+  }
+  // TPU: allocate in the server's HBM arena and write the batched
+  // payload with dtype/shape so the arena stores a typed device array.
+  TpuRegion tpu;
+  tpu.name = region;
+  tpu.byte_size = byte_size;
+  Error err =
+      arena_->CreateRegion(byte_size, 0, &tpu.raw_handle, &tpu.region_id);
+  if (!err.IsOk()) return err;
+  std::vector<int64_t> shape = data.shape;
+  std::string payload;
+  if (model_->max_batch_size > 0) {
+    shape.insert(shape.begin(), batch_);
+  }
+  payload.reserve(byte_size);
+  for (int64_t i = 0; i < copies; ++i) payload.append(data.bytes);
+  err = arena_->WriteRegion(tpu.region_id, 0, payload, data.datatype, shape);
+  if (!err.IsOk()) return err;
+  err = backend->RegisterTpuSharedMemory(region, tpu.raw_handle, 0, byte_size);
+  tpu_regions_.push_back(std::move(tpu));
+  return err;
+}
+
+Error InferDataManager::CreateOutputRegion(
+    ClientBackend* backend, const std::string& region) {
+  if (shm_type_ == SharedMemoryType::SYSTEM) {
+    SystemRegion sys;
+    sys.name = region;
+    sys.key = "/perf_" + region;
+    sys.byte_size = output_shm_size_;
+    Error err = CreateSharedMemoryRegion(sys.key, output_shm_size_, &sys.fd);
+    if (!err.IsOk()) return err;
+    err = MapSharedMemory(sys.fd, 0, output_shm_size_, &sys.addr);
+    if (!err.IsOk()) return err;
+    err = backend->RegisterSystemSharedMemory(region, sys.key,
+                                              output_shm_size_);
+    system_regions_.push_back(std::move(sys));
+    return err;
+  }
+  TpuRegion tpu;
+  tpu.name = region;
+  tpu.byte_size = output_shm_size_;
+  Error err = arena_->CreateRegion(
+      output_shm_size_, 0, &tpu.raw_handle, &tpu.region_id);
+  if (!err.IsOk()) return err;
+  err = backend->RegisterTpuSharedMemory(
+      region, tpu.raw_handle, 0, output_shm_size_);
+  tpu_regions_.push_back(std::move(tpu));
+  return err;
+}
+
+Error InferDataManager::Init(ClientBackend* backend) {
+  if (shm_type_ == SharedMemoryType::NONE) return Error::Success;
+  if (shm_type_ == SharedMemoryType::TPU) {
+    if (arena_url_.empty()) {
+      return Error("TPU shared memory requires an arena endpoint URL");
+    }
+    Error err = TpuArenaClient::Create(&arena_, arena_url_);
+    if (!err.IsOk()) return err;
+  }
+  for (size_t stream = 0; stream < loader_->stream_count(); ++stream) {
+    for (size_t step = 0; step < loader_->step_count(stream); ++step) {
+      for (const auto& tensor : model_->inputs) {
+        const TensorData* data = nullptr;
+        Error err = loader_->GetInputData(tensor.name, stream, step, &data);
+        if (!err.IsOk()) return err;
+        std::string region = tensor.name + "_" + std::to_string(stream) +
+                             "_" + std::to_string(step);
+        err = CreateInputRegion(backend, region, *data);
+        if (!err.IsOk()) return err;
+      }
+    }
+  }
+  // One region per output, shared by all in-flight requests
+  // (reference behavior; outputs are not validated by the harness).
+  for (const auto& tensor : model_->outputs) {
+    std::string region = "out_" + tensor.name;
+    Error err = CreateOutputRegion(backend, region);
+    if (!err.IsOk()) return err;
+    output_regions_[tensor.name] = region;
+  }
+  return Error::Success;
+}
+
+Error InferDataManager::Cleanup(ClientBackend* backend) {
+  if (shm_type_ == SharedMemoryType::SYSTEM) {
+    backend->UnregisterSystemSharedMemory("");
+  } else if (shm_type_ == SharedMemoryType::TPU) {
+    backend->UnregisterTpuSharedMemory("");
+    if (arena_ != nullptr) {
+      for (auto& region : tpu_regions_) {
+        arena_->DestroyRegion(region.region_id);
+      }
+    }
+    tpu_regions_.clear();
+  }
+  for (auto& region : system_regions_) {
+    if (region.addr != nullptr) UnmapSharedMemory(region.addr, region.byte_size);
+    if (region.fd >= 0) CloseSharedMemory(region.fd);
+    UnlinkSharedMemoryRegion(region.key);
+  }
+  system_regions_.clear();
+  return Error::Success;
+}
+
+Error InferDataManager::BuildInputs(
+    size_t stream, size_t step,
+    std::vector<std::unique_ptr<InferInput>>* inputs) {
+  inputs->clear();
+  for (const auto& tensor : model_->inputs) {
+    const TensorData* data = nullptr;
+    Error err = loader_->GetInputData(tensor.name, stream, step, &data);
+    if (!err.IsOk()) return err;
+    std::vector<int64_t> shape = data->shape;
+    if (model_->max_batch_size > 0) {
+      shape.insert(shape.begin(), batch_);
+    }
+    InferInput* raw = nullptr;
+    err = InferInput::Create(&raw, tensor.name, shape, tensor.datatype);
+    if (!err.IsOk()) return err;
+    std::unique_ptr<InferInput> input(raw);
+    if (shm_type_ == SharedMemoryType::NONE) {
+      const std::string* payload =
+          BatchedBytes(tensor.name, stream, step, *data);
+      input->AppendRaw(
+          reinterpret_cast<const uint8_t*>(payload->data()), payload->size());
+    } else {
+      std::string region = tensor.name + "_" + std::to_string(stream) + "_" +
+                           std::to_string(step);
+      int64_t copies = (model_->max_batch_size > 0) ? batch_ : 1;
+      input->SetSharedMemory(region, data->bytes.size() * copies);
+    }
+    inputs->push_back(std::move(input));
+  }
+  return Error::Success;
+}
+
+Error InferDataManager::BuildOutputs(
+    std::vector<std::unique_ptr<InferRequestedOutput>>* outputs) {
+  outputs->clear();
+  if (shm_type_ == SharedMemoryType::NONE) return Error::Success;
+  for (const auto& tensor : model_->outputs) {
+    InferRequestedOutput* raw = nullptr;
+    Error err = InferRequestedOutput::Create(&raw, tensor.name);
+    if (!err.IsOk()) return err;
+    std::unique_ptr<InferRequestedOutput> output(raw);
+    output->SetSharedMemory(output_regions_[tensor.name], output_shm_size_);
+    outputs->push_back(std::move(output));
+  }
+  return Error::Success;
+}
+
+//==============================================================================
+// LoadManager
+
+LoadManager::LoadManager(
+    const ClientBackendFactory* factory, const ParsedModel* model,
+    const DataLoader* loader, InferDataManager* data_manager,
+    Options options, SequenceManager* sequence_manager)
+    : factory_(factory), model_(model), loader_(loader),
+      data_manager_(data_manager), options_(options),
+      sequence_manager_(sequence_manager) {}
+
+LoadManager::~LoadManager() { Stop(); }
+
+Error LoadManager::Init() {
+  Error err = factory_->Create(&setup_backend_);
+  if (!err.IsOk()) return err;
+  return data_manager_->Init(setup_backend_.get());
+}
+
+void LoadManager::Cleanup() {
+  Stop();
+  if (setup_backend_ != nullptr) {
+    data_manager_->Cleanup(setup_backend_.get());
+    setup_backend_.reset();
+  }
+}
+
+std::vector<RequestRecord> LoadManager::SwapRequestRecords() {
+  std::vector<RequestRecord> records;
+  for (auto& stat : thread_stats_) {
+    std::lock_guard<std::mutex> lock(stat->mutex);
+    records.insert(
+        records.end(), std::make_move_iterator(stat->records.begin()),
+        std::make_move_iterator(stat->records.end()));
+    stat->records.clear();
+  }
+  return records;
+}
+
+size_t LoadManager::CountCollectedRequests() {
+  size_t count = 0;
+  for (auto& stat : thread_stats_) {
+    std::lock_guard<std::mutex> lock(stat->mutex);
+    count += stat->records.size();
+  }
+  return count;
+}
+
+Error LoadManager::CheckHealth() {
+  for (auto& stat : thread_stats_) {
+    std::lock_guard<std::mutex> lock(stat->mutex);
+    if (!stat->status.empty()) {
+      return Error("worker thread failed: " + stat->status);
+    }
+  }
+  return Error::Success;
+}
+
+void LoadManager::Stop() {
+  stop_ = true;
+  for (auto& thread : threads_) {
+    if (thread.joinable()) thread.join();
+  }
+  threads_.clear();
+  stop_ = false;
+}
+
+size_t LoadManager::NextStep(size_t stream) {
+  std::lock_guard<std::mutex> lock(step_mutex_);
+  size_t steps = std::max<size_t>(loader_->step_count(stream), 1);
+  size_t step = step_cursor_[stream];
+  step_cursor_[stream] = (step + 1) % steps;
+  return step;
+}
+
+Error LoadManager::PrepareRequest(
+    SequenceManager::Slot* slot,
+    std::vector<std::unique_ptr<InferInput>>* inputs,
+    std::vector<std::unique_ptr<InferRequestedOutput>>* outputs,
+    InferOptions* options) {
+  size_t stream = 0, step = 0;
+  if (sequence_manager_ != nullptr && slot != nullptr) {
+    sequence_manager_->NextStep(
+        slot, std::max<size_t>(loader_->stream_count(), 1),
+        loader_->step_count(0), options, &stream, &step);
+    if (stream >= loader_->stream_count()) stream = 0;
+    step = loader_->step_count(stream) > 0
+               ? step % loader_->step_count(stream)
+               : 0;
+  } else {
+    step = NextStep(stream);
+  }
+  Error err = data_manager_->BuildInputs(stream, step, inputs);
+  if (!err.IsOk()) return err;
+  return data_manager_->BuildOutputs(outputs);
+}
+
+namespace {
+
+std::vector<const InferRequestedOutput*> RawOutputs(
+    const std::vector<std::unique_ptr<InferRequestedOutput>>& outputs) {
+  std::vector<const InferRequestedOutput*> raw;
+  raw.reserve(outputs.size());
+  for (const auto& o : outputs) raw.push_back(o.get());
+  return raw;
+}
+
+std::vector<InferInput*> RawInputs(
+    const std::vector<std::unique_ptr<InferInput>>& inputs) {
+  std::vector<InferInput*> raw;
+  raw.reserve(inputs.size());
+  for (const auto& i : inputs) raw.push_back(i.get());
+  return raw;
+}
+
+}  // namespace
+
+//==============================================================================
+// ConcurrencyManager
+
+Error ConcurrencyManager::ChangeConcurrencyLevel(size_t concurrency) {
+  Stop();
+  concurrency_ = concurrency;
+  if (concurrency == 0) return Error::Success;
+  size_t n_threads = std::min(concurrency, options_.max_threads);
+  size_t base = concurrency / n_threads;
+  size_t extra = concurrency % n_threads;
+  thread_stats_.clear();
+  for (size_t i = 0; i < n_threads; ++i) {
+    thread_stats_.push_back(std::make_unique<ThreadStat>());
+  }
+  for (size_t i = 0; i < n_threads; ++i) {
+    size_t ctxs = base + (i < extra ? 1 : 0);
+    threads_.emplace_back(
+        &ConcurrencyManager::Worker, this, thread_stats_[i].get(), ctxs);
+  }
+  return Error::Success;
+}
+
+void ConcurrencyManager::Worker(ThreadStat* stat, size_t n_ctx) {
+  std::unique_ptr<ClientBackend> backend;
+  Error err = factory_->Create(&backend);
+  if (!err.IsOk()) {
+    std::lock_guard<std::mutex> lock(stat->mutex);
+    stat->status = err.Message();
+    return;
+  }
+  if (options_.streaming) {
+    StreamWorker(stat, backend.get(), n_ctx);
+  } else if (options_.async_mode) {
+    AsyncWorker(stat, backend.get(), n_ctx);
+  } else {
+    SyncWorker(stat, backend.get(), n_ctx);
+  }
+}
+
+void ConcurrencyManager::SyncWorker(
+    ThreadStat* stat, ClientBackend* backend, size_t n_ctx) {
+  SequenceManager::Slot slot;
+  while (!stop_.load()) {
+    std::vector<std::unique_ptr<InferInput>> inputs;
+    std::vector<std::unique_ptr<InferRequestedOutput>> outputs;
+    InferOptions options(model_->name);
+    Error err = PrepareRequest(&slot, &inputs, &outputs, &options);
+    if (!err.IsOk()) {
+      std::lock_guard<std::mutex> lock(stat->mutex);
+      stat->status = err.Message();
+      return;
+    }
+    RequestRecord record;
+    record.start_ns = NowNs();
+    InferResult* result = nullptr;
+    err = backend->Infer(
+        &result, options, RawInputs(inputs), RawOutputs(outputs));
+    if (err.IsOk()) {
+      record.end_ns.push_back(NowNs());
+      delete result;
+    } else {
+      record.has_error = true;
+      record.error = err.Message();
+    }
+    stat->AddRecord(std::move(record));
+  }
+}
+
+void ConcurrencyManager::AsyncWorker(
+    ThreadStat* stat, ClientBackend* backend, size_t n_ctx) {
+  auto tracker = std::make_shared<FifoCtxIdTracker>();
+  tracker->Reset(n_ctx);
+  std::vector<SequenceManager::Slot> slots(n_ctx);
+  while (!stop_.load()) {
+    int ctx_id = tracker->Get(100);
+    if (ctx_id < 0) continue;
+    if (stop_.load()) {
+      tracker->Release(ctx_id);
+      break;
+    }
+    auto inputs =
+        std::make_shared<std::vector<std::unique_ptr<InferInput>>>();
+    auto outputs = std::make_shared<
+        std::vector<std::unique_ptr<InferRequestedOutput>>>();
+    InferOptions options(model_->name);
+    Error err =
+        PrepareRequest(&slots[ctx_id], inputs.get(), outputs.get(), &options);
+    if (!err.IsOk()) {
+      std::lock_guard<std::mutex> lock(stat->mutex);
+      stat->status = err.Message();
+      tracker->Release(ctx_id);
+      return;
+    }
+    auto record = std::make_shared<RequestRecord>();
+    record->start_ns = NowNs();
+    // inputs/outputs captured so buffers outlive the async send.
+    err = backend->AsyncInfer(
+        [stat, tracker, ctx_id, record, inputs, outputs](InferResult* result) {
+          record->end_ns.push_back(NowNs());
+          Error status = result != nullptr ? result->RequestStatus()
+                                           : Error("null result");
+          if (!status.IsOk()) {
+            record->has_error = true;
+            record->error = status.Message();
+          }
+          delete result;
+          stat->AddRecord(std::move(*record));
+          tracker->Release(ctx_id);
+        },
+        options, RawInputs(*inputs), RawOutputs(*outputs));
+    if (!err.IsOk()) {
+      record->has_error = true;
+      record->error = err.Message();
+      stat->AddRecord(std::move(*record));
+      tracker->Release(ctx_id);
+    }
+  }
+  // Drain in-flight requests (bounded).
+  uint64_t deadline = NowNs() + 5ull * 1000 * 1000 * 1000;
+  size_t acquired = 0;
+  while (acquired < n_ctx && NowNs() < deadline) {
+    if (tracker->Get(200) >= 0) acquired++;
+  }
+}
+
+void ConcurrencyManager::StreamWorker(
+    ThreadStat* stat, ClientBackend* backend, size_t n_ctx) {
+  auto tracker = std::make_shared<FifoCtxIdTracker>();
+  tracker->Reset(n_ctx);
+  std::vector<SequenceManager::Slot> slots(n_ctx);
+
+  struct Inflight {
+    std::shared_ptr<RequestRecord> record;
+    int ctx_id;
+    std::shared_ptr<std::vector<std::unique_ptr<InferInput>>> inputs;
+    std::shared_ptr<std::vector<std::unique_ptr<InferRequestedOutput>>>
+        outputs;
+  };
+  auto inflight = std::make_shared<std::map<uint64_t, Inflight>>();
+  auto order = std::make_shared<std::deque<uint64_t>>();
+  auto inflight_mutex = std::make_shared<std::mutex>();
+
+  Error err = backend->StartStream(
+      [stat, tracker, inflight, order, inflight_mutex](InferResult* result) {
+        std::unique_ptr<InferResult> owned(result);
+        std::lock_guard<std::mutex> lock(*inflight_mutex);
+        // Pair by echoed request id; FIFO fallback.
+        uint64_t key = 0;
+        bool have_key = false;
+        if (owned != nullptr) {
+          std::string id;
+          if (owned->Id(&id).IsOk() && !id.empty()) {
+            char* end = nullptr;
+            uint64_t parsed = strtoull(id.c_str(), &end, 10);
+            if (end != nullptr && *end == '\0') {
+              key = parsed;
+              have_key = true;
+            }
+          }
+        }
+        if (!have_key) {
+          if (order->empty()) return;
+          key = order->front();
+        }
+        auto it = inflight->find(key);
+        if (it == inflight->end()) return;
+        Inflight& entry = it->second;
+        entry.record->end_ns.push_back(NowNs());
+        Error status = owned != nullptr ? owned->RequestStatus()
+                                        : Error("null stream result");
+        if (!status.IsOk()) {
+          entry.record->has_error = true;
+          entry.record->error = status.Message();
+        }
+        stat->AddRecord(std::move(*entry.record));
+        tracker->Release(entry.ctx_id);
+        order->erase(
+            std::remove(order->begin(), order->end(), key), order->end());
+        inflight->erase(it);
+      });
+  if (!err.IsOk()) {
+    std::lock_guard<std::mutex> lock(stat->mutex);
+    stat->status = err.Message();
+    return;
+  }
+
+  uint64_t counter = 0;
+  while (!stop_.load()) {
+    int ctx_id = tracker->Get(100);
+    if (ctx_id < 0) continue;
+    if (stop_.load()) {
+      tracker->Release(ctx_id);
+      break;
+    }
+    auto inputs =
+        std::make_shared<std::vector<std::unique_ptr<InferInput>>>();
+    auto outputs = std::make_shared<
+        std::vector<std::unique_ptr<InferRequestedOutput>>>();
+    InferOptions options(model_->name);
+    Error prep_err =
+        PrepareRequest(&slots[ctx_id], inputs.get(), outputs.get(), &options);
+    if (!prep_err.IsOk()) {
+      std::lock_guard<std::mutex> lock(stat->mutex);
+      stat->status = prep_err.Message();
+      tracker->Release(ctx_id);
+      break;
+    }
+    uint64_t key;
+    auto record = std::make_shared<RequestRecord>();
+    {
+      std::lock_guard<std::mutex> lock(*inflight_mutex);
+      key = counter++;
+      record->start_ns = NowNs();
+      (*inflight)[key] = Inflight{record, ctx_id, inputs, outputs};
+      order->push_back(key);
+    }
+    options.request_id = std::to_string(key);
+    Error send_err = backend->AsyncStreamInfer(
+        options, RawInputs(*inputs), RawOutputs(*outputs));
+    if (!send_err.IsOk()) {
+      std::lock_guard<std::mutex> lock(*inflight_mutex);
+      auto it = inflight->find(key);
+      if (it != inflight->end()) {
+        it->second.record->has_error = true;
+        it->second.record->error = send_err.Message();
+        stat->AddRecord(std::move(*it->second.record));
+        tracker->Release(it->second.ctx_id);
+        order->erase(
+            std::remove(order->begin(), order->end(), key), order->end());
+        inflight->erase(it);
+      }
+    }
+  }
+  backend->StopStream();
+}
+
+//==============================================================================
+// RequestRateManager
+
+Error RequestRateManager::ChangeRequestRate(double rate, double duration_s) {
+  Stop();
+  if (rate <= 0) return Error::Success;
+  schedule_.clear();
+  std::mt19937_64 rng(11);
+  std::exponential_distribution<double> expo(rate);
+  double t = 0.0;
+  while (t < duration_s) {
+    t += (distribution_ == Distribution::POISSON) ? expo(rng) : 1.0 / rate;
+    schedule_.push_back(t);
+  }
+  LaunchScheduleWorkers();
+  return Error::Success;
+}
+
+Error RequestRateManager::SetCustomSchedule(
+    const std::vector<double>& intervals_s) {
+  Stop();
+  if (intervals_s.empty()) return Error("empty custom schedule");
+  schedule_.clear();
+  double t = 0.0;
+  size_t repeats = 200000 / intervals_s.size() + 1;
+  for (size_t r = 0; r < repeats && t <= 3600.0; ++r) {
+    for (double interval : intervals_s) {
+      t += interval;
+      schedule_.push_back(t);
+    }
+  }
+  LaunchScheduleWorkers();
+  return Error::Success;
+}
+
+void RequestRateManager::LaunchScheduleWorkers() {
+  size_t n_threads = std::min<size_t>(options_.max_threads, 8);
+  thread_stats_.clear();
+  for (size_t i = 0; i < n_threads; ++i) {
+    thread_stats_.push_back(std::make_unique<ThreadStat>());
+  }
+  uint64_t start_ns = NowNs() + 10ull * 1000 * 1000;
+  for (size_t i = 0; i < n_threads; ++i) {
+    threads_.emplace_back(
+        &RequestRateManager::ScheduleWorker, this, thread_stats_[i].get(), i,
+        n_threads, start_ns);
+  }
+}
+
+void RequestRateManager::ScheduleWorker(
+    ThreadStat* stat, size_t worker_idx, size_t n_workers,
+    uint64_t start_ns) {
+  std::unique_ptr<ClientBackend> backend;
+  Error err = factory_->Create(&backend);
+  if (!err.IsOk()) {
+    std::lock_guard<std::mutex> lock(stat->mutex);
+    stat->status = err.Message();
+    return;
+  }
+  SequenceManager::Slot slot;
+  for (size_t idx = worker_idx; idx < schedule_.size() && !stop_.load();
+       idx += n_workers) {
+    uint64_t due_ns =
+        start_ns + static_cast<uint64_t>(schedule_[idx] * 1e9);
+    uint64_t now = NowNs();
+    bool delayed = false;
+    if (now < due_ns) {
+      uint64_t wait_us = (due_ns - now) / 1000;
+      while (wait_us > 0 && !stop_.load()) {
+        uint64_t chunk = std::min<uint64_t>(wait_us, 50000);
+        std::this_thread::sleep_for(std::chrono::microseconds(chunk));
+        now = NowNs();
+        wait_us = now < due_ns ? (due_ns - now) / 1000 : 0;
+      }
+      if (stop_.load()) break;
+    } else {
+      delayed = (now - due_ns) > 10ull * 1000 * 1000;  // >10ms late
+    }
+    auto inputs =
+        std::make_shared<std::vector<std::unique_ptr<InferInput>>>();
+    auto outputs = std::make_shared<
+        std::vector<std::unique_ptr<InferRequestedOutput>>>();
+    InferOptions options(model_->name);
+    err = PrepareRequest(&slot, inputs.get(), outputs.get(), &options);
+    if (!err.IsOk()) {
+      std::lock_guard<std::mutex> lock(stat->mutex);
+      stat->status = err.Message();
+      return;
+    }
+    if (options_.async_mode) {
+      auto record = std::make_shared<RequestRecord>();
+      record->start_ns = NowNs();
+      record->delayed = delayed;
+      Error send_err = backend->AsyncInfer(
+          [stat, record, inputs, outputs](InferResult* result) {
+            record->end_ns.push_back(NowNs());
+            Error status = result != nullptr ? result->RequestStatus()
+                                             : Error("null result");
+            if (!status.IsOk()) {
+              record->has_error = true;
+              record->error = status.Message();
+            }
+            delete result;
+            stat->AddRecord(std::move(*record));
+          },
+          options, RawInputs(*inputs), RawOutputs(*outputs));
+      if (!send_err.IsOk()) {
+        record->has_error = true;
+        record->error = send_err.Message();
+        stat->AddRecord(std::move(*record));
+      }
+    } else {
+      RequestRecord record;
+      record.start_ns = NowNs();
+      record.delayed = delayed;
+      InferResult* result = nullptr;
+      Error send_err = backend->Infer(
+          &result, options, RawInputs(*inputs), RawOutputs(*outputs));
+      if (send_err.IsOk()) {
+        record.end_ns.push_back(NowNs());
+        delete result;
+      } else {
+        record.has_error = true;
+        record.error = send_err.Message();
+      }
+      stat->AddRecord(std::move(record));
+    }
+  }
+}
+
+//==============================================================================
+// CustomLoadManager
+
+Error CustomLoadManager::ReadIntervalsFile(
+    const std::string& path, std::vector<double>* intervals_s) {
+  std::ifstream in(path);
+  if (!in) return Error("cannot open request-intervals file '" + path + "'");
+  intervals_s->clear();
+  std::string line;
+  while (std::getline(in, line)) {
+    if (line.empty()) continue;
+    intervals_s->push_back(strtoull(line.c_str(), nullptr, 10) / 1e6);
+  }
+  if (intervals_s->empty()) {
+    return Error("request-intervals file '" + path + "' is empty");
+  }
+  return Error::Success;
+}
+
+Error CustomLoadManager::StartSchedule(const std::string& intervals_file) {
+  std::vector<double> intervals;
+  Error err = ReadIntervalsFile(intervals_file, &intervals);
+  if (!err.IsOk()) return err;
+  return SetCustomSchedule(intervals);
+}
+
+//==============================================================================
+// PeriodicConcurrencyManager
+
+Error PeriodicConcurrencyManager::RunRamp(const RampConfig& config) {
+  size_t current = config.start;
+  Error err = ChangeConcurrencyLevel(current);
+  if (!err.IsOk()) return err;
+  while (current < config.end && !stop_.load()) {
+    if (CountCollectedRequests() >= config.request_period) {
+      // ChangeConcurrencyLevel resets worker stats; carry the level's
+      // records so the whole ramp is reportable.
+      auto records = SwapRequestRecords();
+      {
+        std::lock_guard<std::mutex> lock(carry_mutex_);
+        carry_records_.insert(
+            carry_records_.end(), std::make_move_iterator(records.begin()),
+            std::make_move_iterator(records.end()));
+      }
+      current = std::min(current + config.step, config.end);
+      err = ChangeConcurrencyLevel(current);
+      if (!err.IsOk()) return err;
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(10));
+  }
+  return Error::Success;
+}
+
+std::vector<RequestRecord> PeriodicConcurrencyManager::SwapRampRecords() {
+  std::vector<RequestRecord> records;
+  {
+    std::lock_guard<std::mutex> lock(carry_mutex_);
+    records.swap(carry_records_);
+  }
+  auto live = SwapRequestRecords();
+  records.insert(
+      records.end(), std::make_move_iterator(live.begin()),
+      std::make_move_iterator(live.end()));
+  return records;
+}
+
+}  // namespace perf
+}  // namespace tpuclient

@@ -1,0 +1,355 @@
+// Load-generation layer of the native perf harness: request records,
+// context-id trackers, sequence bookkeeping, shared-memory data
+// managers, and the load-manager hierarchy (concurrency /
+// request-rate / custom-interval / periodic-concurrency).
+//
+// Parity map into /root/reference/src/c++/perf_analyzer/:
+//   RequestRecord        -> request_record.h:63
+//   ThreadStat           -> load_manager.h:137
+//   FifoCtxIdTracker     -> fifo_ctx_id_tracker.h:35
+//   SequenceManager      -> sequence_manager.h:46
+//   InferDataManager     -> infer_data_manager.h:40 / _shm.h:93
+//   LoadManager          -> load_manager.h:48
+//   ConcurrencyManager   -> concurrency_manager.h:95 (+ worker .cc:42)
+//   RequestRateManager   -> request_rate_manager.h:57
+//   CustomLoadManager    -> custom_load_manager.h:46
+//   PeriodicConcurrencyManager -> periodic_concurrency_manager.h:39
+//
+// The CUDA shared-memory data path is replaced by the TPU HBM arena:
+// region creation/population goes through TpuArenaClient (gRPC
+// side-channel to the server that owns the accelerator) instead of
+// cudaMalloc + cudaIpcGetMemHandle.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <deque>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "../library/common.h"
+#include "client_backend.h"
+#include "data_loader.h"
+#include "model_parser.h"
+
+namespace tpuclient {
+namespace perf {
+
+uint64_t NowNs();
+
+//==============================================================================
+// One request's timestamps + outcome.
+//
+struct RequestRecord {
+  uint64_t start_ns = 0;
+  std::vector<uint64_t> end_ns;  // one per response (streaming)
+  bool delayed = false;
+  bool sequence_end = true;
+  bool has_error = false;
+  std::string error;
+
+  bool valid() const { return !end_ns.empty() && !has_error; }
+  uint64_t latency_ns() const {
+    return end_ns.empty() ? 0 : end_ns.back() - start_ns;
+  }
+};
+
+//==============================================================================
+// Per-worker record sink + health.
+//
+struct ThreadStat {
+  std::mutex mutex;
+  std::vector<RequestRecord> records;
+  std::string status;  // non-empty = worker failed
+
+  void AddRecord(RequestRecord&& record) {
+    std::lock_guard<std::mutex> lock(mutex);
+    records.push_back(std::move(record));
+  }
+};
+
+//==============================================================================
+// Free-slot tracker deciding which context id a worker uses next.
+//
+class FifoCtxIdTracker {
+ public:
+  void Reset(size_t count);
+  // Blocks up to timeout_ms for a free slot; returns -1 on timeout.
+  int Get(int timeout_ms);
+  void Release(int ctx_id);
+  size_t FreeCount();
+
+ private:
+  std::mutex mutex_;
+  std::condition_variable cv_;
+  std::deque<int> free_;
+};
+
+//==============================================================================
+// Sequence-id allocation and per-slot sequence progress.
+//
+class SequenceManager {
+ public:
+  SequenceManager(
+      uint64_t start_id = 1, uint64_t id_range = (1ull << 31),
+      size_t sequence_length = 20, double length_variation = 0.2,
+      uint64_t seed = 3)
+      : next_offset_(0), start_id_(start_id), id_range_(id_range),
+        length_(sequence_length), variation_(length_variation), rng_(seed) {}
+
+  struct Slot {
+    uint64_t sequence_id = 0;
+    size_t remaining = 0;
+    size_t step = 0;
+    size_t stream = 0;
+    bool active = false;
+  };
+
+  // Fills request options for the slot's next sequence step, starting
+  // a fresh sequence when the slot is idle. Also outputs the data
+  // (stream, step) the request should use.
+  void NextStep(
+      Slot* slot, size_t stream_count, size_t steps_in_stream,
+      InferOptions* options, size_t* stream, size_t* step);
+
+ private:
+  std::mutex mutex_;
+  uint64_t next_offset_;
+  uint64_t start_id_;
+  uint64_t id_range_;
+  size_t length_;
+  double variation_;
+  std::mt19937_64 rng_;
+};
+
+//==============================================================================
+// Prepares per-request inputs/outputs. SHM modes create one region
+// per input x stream x step named "<input>_<stream>_<step>", populate
+// it (memcpy for system shm; arena WriteRegion for TPU), register it
+// with the server, and route requests through SetSharedMemory.
+//
+enum class SharedMemoryType { NONE, SYSTEM, TPU };
+
+class InferDataManager {
+ public:
+  InferDataManager(
+      const ParsedModel* model, const DataLoader* loader,
+      SharedMemoryType shm_type = SharedMemoryType::NONE,
+      size_t output_shm_size = 102400, std::string arena_url = "",
+      int64_t batch_size = 1)
+      : model_(model), loader_(loader), shm_type_(shm_type),
+        output_shm_size_(output_shm_size), arena_url_(std::move(arena_url)),
+        batch_(batch_size < 1 ? 1 : batch_size) {}
+  ~InferDataManager();
+
+  Error Init(ClientBackend* backend);
+  Error Cleanup(ClientBackend* backend);
+
+  // Builds fresh InferInput objects (cheap views over shared
+  // buffers; InferInput send-iteration is stateful so they are not
+  // shared across in-flight requests).
+  Error BuildInputs(
+      size_t stream, size_t step,
+      std::vector<std::unique_ptr<InferInput>>* inputs);
+  // SHM modes route outputs into pre-registered regions; otherwise
+  // returns an empty list (server returns all outputs inline).
+  Error BuildOutputs(
+      std::vector<std::unique_ptr<InferRequestedOutput>>* outputs);
+
+ private:
+  struct SystemRegion {
+    std::string name;
+    std::string key;
+    int fd = -1;
+    void* addr = nullptr;
+    size_t byte_size = 0;
+  };
+  struct TpuRegion {
+    std::string name;
+    std::string region_id;
+    std::string raw_handle;
+    size_t byte_size = 0;
+  };
+
+  Error CreateInputRegion(
+      ClientBackend* backend, const std::string& region,
+      const TensorData& data);
+  Error CreateOutputRegion(ClientBackend* backend, const std::string& region);
+
+  // The batched payload for (input, stream, step): data repeated
+  // batch_ times. Stable storage referenced by non-shm InferInputs.
+  const std::string* BatchedBytes(
+      const std::string& input, size_t stream, size_t step,
+      const TensorData& data);
+
+  const ParsedModel* model_;
+  const DataLoader* loader_;
+  SharedMemoryType shm_type_;
+  size_t output_shm_size_;
+  std::string arena_url_;
+  int64_t batch_;
+
+  std::unique_ptr<TpuArenaClient> arena_;
+  std::vector<SystemRegion> system_regions_;
+  std::vector<TpuRegion> tpu_regions_;
+  std::map<std::string, std::string> output_regions_;  // output -> region
+  std::map<std::string, std::string> batched_cache_;
+  std::mutex cache_mutex_;
+};
+
+//==============================================================================
+// Load-manager base: worker threads, records, step cursor.
+//
+class LoadManager {
+ public:
+  struct Options {
+    bool async_mode = true;
+    bool streaming = false;
+    size_t max_threads = 16;
+  };
+
+  LoadManager(
+      const ClientBackendFactory* factory, const ParsedModel* model,
+      const DataLoader* loader, InferDataManager* data_manager,
+      Options options, SequenceManager* sequence_manager = nullptr);
+  virtual ~LoadManager();
+
+  // Creates the setup backend and initializes the data manager
+  // (registering shm regions with the server).
+  Error Init();
+  void Cleanup();
+
+  // Drains all worker records (parity: SwapRequestRecords).
+  std::vector<RequestRecord> SwapRequestRecords();
+  size_t CountCollectedRequests();
+  // Non-empty on worker failure (parity: CheckHealth).
+  Error CheckHealth();
+  virtual void Stop();
+
+  ClientBackend* setup_backend() { return setup_backend_.get(); }
+
+ protected:
+  // One request's inputs/outputs/options. seq slot may be null.
+  Error PrepareRequest(
+      SequenceManager::Slot* slot,
+      std::vector<std::unique_ptr<InferInput>>* inputs,
+      std::vector<std::unique_ptr<InferRequestedOutput>>* outputs,
+      InferOptions* options);
+  size_t NextStep(size_t stream);
+
+  const ClientBackendFactory* factory_;
+  const ParsedModel* model_;
+  const DataLoader* loader_;
+  InferDataManager* data_manager_;
+  Options options_;
+  SequenceManager* sequence_manager_;
+
+  std::unique_ptr<ClientBackend> setup_backend_;
+  std::vector<std::thread> threads_;
+  std::vector<std::unique_ptr<ThreadStat>> thread_stats_;
+  std::atomic<bool> stop_{false};
+
+  std::mutex step_mutex_;
+  std::map<size_t, size_t> step_cursor_;
+};
+
+//==============================================================================
+// Maintains exactly N in-flight requests.
+//
+class ConcurrencyManager : public LoadManager {
+ public:
+  using LoadManager::LoadManager;
+
+  // Stops current workers and relaunches with the new level
+  // (parity: ChangeConcurrencyLevel).
+  Error ChangeConcurrencyLevel(size_t concurrency);
+  size_t concurrency() const { return concurrency_; }
+
+ private:
+  void Worker(ThreadStat* stat, size_t n_ctx);
+  void SyncWorker(ThreadStat* stat, ClientBackend* backend, size_t n_ctx);
+  void AsyncWorker(ThreadStat* stat, ClientBackend* backend, size_t n_ctx);
+  void StreamWorker(ThreadStat* stat, ClientBackend* backend, size_t n_ctx);
+
+  size_t concurrency_ = 0;
+};
+
+//==============================================================================
+// Dispatches from a precomputed schedule at a fixed rate (constant or
+// poisson); late sends are flagged delayed.
+//
+class RequestRateManager : public LoadManager {
+ public:
+  enum class Distribution { CONSTANT, POISSON };
+
+  RequestRateManager(
+      const ClientBackendFactory* factory, const ParsedModel* model,
+      const DataLoader* loader, InferDataManager* data_manager,
+      Options options, Distribution distribution = Distribution::CONSTANT,
+      SequenceManager* sequence_manager = nullptr)
+      : LoadManager(factory, model, loader, data_manager, options,
+                    sequence_manager),
+        distribution_(distribution) {}
+
+  Error ChangeRequestRate(double rate, double duration_s = 3600.0);
+  // Absolute schedule from explicit inter-request gaps (seconds),
+  // cycled to cover a long window (CustomLoadManager path).
+  Error SetCustomSchedule(const std::vector<double>& intervals_s);
+
+ protected:
+  void LaunchScheduleWorkers();
+  void ScheduleWorker(
+      ThreadStat* stat, size_t worker_idx, size_t n_workers,
+      uint64_t start_ns);
+
+  Distribution distribution_;
+  std::vector<double> schedule_;  // offsets in seconds
+};
+
+//==============================================================================
+// Replays user-provided request intervals (one microsecond value per
+// line — the --request-intervals mode).
+//
+class CustomLoadManager : public RequestRateManager {
+ public:
+  using RequestRateManager::RequestRateManager;
+
+  static Error ReadIntervalsFile(
+      const std::string& path, std::vector<double>* intervals_s);
+  Error StartSchedule(const std::string& intervals_file);
+};
+
+//==============================================================================
+// Ramps concurrency start->end by step every request_period completed
+// requests (LLM-oriented).
+//
+class PeriodicConcurrencyManager : public ConcurrencyManager {
+ public:
+  using ConcurrencyManager::ConcurrencyManager;
+
+  struct RampConfig {
+    size_t start = 1;
+    size_t end = 8;
+    size_t step = 1;
+    size_t request_period = 10;
+  };
+
+  // Runs the ramp to completion (blocking); records accumulate across
+  // levels and can be drained afterwards.
+  Error RunRamp(const RampConfig& config);
+
+  std::vector<RequestRecord> SwapRampRecords();
+
+ private:
+  std::vector<RequestRecord> carry_records_;
+  std::mutex carry_mutex_;
+};
+
+}  // namespace perf
+}  // namespace tpuclient

@@ -1,0 +1,109 @@
+#include "model_parser.h"
+
+namespace tpuclient {
+namespace perf {
+
+const ModelTensor* ParsedModel::FindInput(const std::string& name) const {
+  for (const auto& t : inputs) {
+    if (t.name == name) return &t;
+  }
+  return nullptr;
+}
+
+size_t DatatypeByteSize(const std::string& datatype) {
+  if (datatype == "BOOL" || datatype == "INT8" || datatype == "UINT8")
+    return 1;
+  if (datatype == "INT16" || datatype == "UINT16" || datatype == "FP16" ||
+      datatype == "BF16")
+    return 2;
+  if (datatype == "INT32" || datatype == "UINT32" || datatype == "FP32")
+    return 4;
+  if (datatype == "INT64" || datatype == "UINT64" || datatype == "FP64")
+    return 8;
+  return 0;  // BYTES / unknown
+}
+
+namespace {
+
+void ParseTensors(
+    const json::Value& metadata, const char* key, int64_t max_batch_size,
+    std::vector<ModelTensor>* out) {
+  if (!metadata.Has(key)) return;
+  for (const auto& entry : metadata[key].AsArray()) {
+    ModelTensor tensor;
+    tensor.name = entry["name"].AsString();
+    if (entry.Has("datatype")) tensor.datatype = entry["datatype"].AsString();
+    if (entry.Has("shape")) {
+      for (const auto& d : entry["shape"].AsArray()) {
+        tensor.shape.push_back(d.AsInt());
+      }
+    }
+    // Batching models report shapes with a leading -1 batch dim;
+    // strip it (the harness re-adds the concrete batch).
+    if (max_batch_size > 0 && !tensor.shape.empty() &&
+        tensor.shape[0] == -1) {
+      tensor.shape.erase(tensor.shape.begin());
+    }
+    out->push_back(std::move(tensor));
+  }
+}
+
+}  // namespace
+
+Error ModelParser::Parse(
+    ClientBackend* backend, const std::string& model_name,
+    const std::string& model_version, int64_t batch_size,
+    ParsedModel* model) {
+  json::Value metadata, config;
+  Error err = backend->ModelMetadataJson(&metadata, model_name, model_version);
+  if (!err.IsOk()) return err;
+  err = backend->ModelConfigJson(&config, model_name, model_version);
+  if (!err.IsOk()) return err;
+
+  try {
+    model->name =
+        metadata.Has("name") ? metadata["name"].AsString() : model_name;
+    model->version = model_version;
+    if (metadata.Has("platform")) {
+      model->platform = metadata["platform"].AsString();
+    }
+    model->max_batch_size =
+        config.Has("max_batch_size") ? config["max_batch_size"].AsInt() : 0;
+
+    if (batch_size > 1 && model->max_batch_size == 0) {
+      return Error(
+          "batch size " + std::to_string(batch_size) + " requested but "
+          "model '" + model_name + "' does not support batching");
+    }
+    if (model->max_batch_size > 0 && batch_size > model->max_batch_size) {
+      return Error(
+          "batch size " + std::to_string(batch_size) +
+          " exceeds model max_batch_size " +
+          std::to_string(model->max_batch_size));
+    }
+
+    ParseTensors(metadata, "inputs", model->max_batch_size, &model->inputs);
+    ParseTensors(metadata, "outputs", model->max_batch_size, &model->outputs);
+
+    if (config.Has("ensemble_scheduling")) {
+      model->scheduler_type = SchedulerType::ENSEMBLE;
+    } else if (config.Has("sequence_batching")) {
+      model->scheduler_type = SchedulerType::SEQUENCE;
+    } else if (config.Has("dynamic_batching")) {
+      model->scheduler_type = SchedulerType::DYNAMIC;
+    }
+    if (config.Has("model_transaction_policy")) {
+      const auto& policy = config["model_transaction_policy"];
+      if (policy.Has("decoupled")) {
+        model->decoupled = policy["decoupled"].AsBool();
+      }
+    }
+  } catch (const std::exception& e) {
+    return Error(
+        std::string("malformed model metadata/config: ") + e.what());
+  }
+  return Error::Success;
+}
+
+}  // namespace perf
+}  // namespace tpuclient

@@ -1,0 +1,54 @@
+// Model metadata/config normalization for the native perf harness
+// (parity: /root/reference/src/c++/perf_analyzer/model_parser.h:41-76
+// — ModelTensor, scheduler type, decoupled flag).
+#pragma once
+
+#include <map>
+#include <string>
+#include <vector>
+
+#include "../library/common.h"
+#include "client_backend.h"
+
+namespace tpuclient {
+namespace perf {
+
+enum class SchedulerType { NONE, DYNAMIC, SEQUENCE, ENSEMBLE };
+
+struct ModelTensor {
+  std::string name;
+  std::string datatype;
+  std::vector<int64_t> shape;
+  bool optional = false;
+};
+
+struct ParsedModel {
+  std::string name;
+  std::string version;
+  std::string platform;
+  int64_t max_batch_size = 0;
+  // Ordered by declaration, keyed lookups via Find*.
+  std::vector<ModelTensor> inputs;
+  std::vector<ModelTensor> outputs;
+  SchedulerType scheduler_type = SchedulerType::NONE;
+  bool decoupled = false;
+
+  const ModelTensor* FindInput(const std::string& name) const;
+};
+
+class ModelParser {
+ public:
+  // Fetches metadata + config from the backend and normalizes. A
+  // batch_size > the model's max_batch_size (or >1 on a non-batching
+  // model) is an error, mirroring the reference's validation.
+  static Error Parse(
+      ClientBackend* backend, const std::string& model_name,
+      const std::string& model_version, int64_t batch_size,
+      ParsedModel* model);
+};
+
+// Bytes per element for fixed-size datatypes; 0 for BYTES.
+size_t DatatypeByteSize(const std::string& datatype);
+
+}  // namespace perf
+}  // namespace tpuclient

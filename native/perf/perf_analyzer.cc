@@ -1,0 +1,252 @@
+// Driver orchestration (parity:
+// /root/reference/src/c++/perf_analyzer/perf_analyzer.cc:56-69 —
+// create backend factory -> parse model -> build data loader/manager ->
+// choose load manager -> profile -> report/export) plus main() with
+// SIGINT-initiated graceful drain (parity: perf_analyzer.cc:40-53).
+#include <csignal>
+#include <cstdio>
+#include <cstring>
+
+#include "command_line_parser.h"
+#include "inference_profiler.h"
+#include "report_writer.h"
+
+namespace tpuclient {
+namespace perf {
+
+namespace {
+
+volatile std::sig_atomic_t g_early_exit = 0;
+
+void SignalHandler(int) { g_early_exit = 1; }
+
+Error ApplyShapeOverrides(
+    const std::vector<std::string>& overrides, ParsedModel* model) {
+  for (const std::string& override_text : overrides) {
+    size_t colon = override_text.find(':');
+    if (colon == std::string::npos) {
+      return Error("bad --shape (want name:d1,d2): " + override_text);
+    }
+    std::string name = override_text.substr(0, colon);
+    ModelTensor* target = nullptr;
+    for (auto& t : model->inputs) {
+      if (t.name == name) target = &t;
+    }
+    if (target == nullptr) {
+      return Error("--shape names unknown input '" + name + "'");
+    }
+    target->shape.clear();
+    std::string dims = override_text.substr(colon + 1);
+    size_t pos = 0;
+    while (pos < dims.size()) {
+      size_t comma = dims.find(',', pos);
+      target->shape.push_back(
+          atoll(dims.substr(pos, comma - pos).c_str()));
+      if (comma == std::string::npos) break;
+      pos = comma + 1;
+    }
+  }
+  return Error::Success;
+}
+
+}  // namespace
+
+int Run(int argc, char** argv) {
+  PerfAnalyzerParameters params;
+  Error err = CLParser::Parse(argc, argv, &params);
+  if (!err.IsOk()) {
+    fprintf(stderr, "error: %s\n", err.Message().c_str());
+    CLParser::Usage(argv[0]);
+    return 1;
+  }
+
+  std::signal(SIGINT, SignalHandler);
+
+  BackendConfig backend_config;
+  backend_config.kind = params.protocol == "http"
+                            ? BackendKind::TRITON_HTTP
+                            : BackendKind::TRITON_GRPC;
+  backend_config.url = params.url;
+  backend_config.verbose = params.verbose;
+  ClientBackendFactory factory(backend_config);
+
+  std::unique_ptr<ClientBackend> setup_backend;
+  err = factory.Create(&setup_backend);
+  if (!err.IsOk()) {
+    fprintf(stderr, "error: %s\n", err.Message().c_str());
+    return 1;
+  }
+
+  ParsedModel model;
+  err = ModelParser::Parse(
+      setup_backend.get(), params.model_name, params.model_version,
+      params.batch_size, &model);
+  if (!err.IsOk()) {
+    fprintf(stderr, "error: %s\n", err.Message().c_str());
+    return 1;
+  }
+  err = ApplyShapeOverrides(params.shape_overrides, &model);
+  if (!err.IsOk()) {
+    fprintf(stderr, "error: %s\n", err.Message().c_str());
+    return 1;
+  }
+
+  DataLoader loader(&model);
+  if (params.input_data == "random" || params.input_data == "zero") {
+    err = loader.GenerateData(
+        params.input_data == "zero", params.string_length,
+        params.string_data);
+  } else {
+    err = loader.ReadDataFromJson(params.input_data);
+  }
+  if (!err.IsOk()) {
+    fprintf(stderr, "error: %s\n", err.Message().c_str());
+    return 1;
+  }
+
+  SharedMemoryType shm_type = SharedMemoryType::NONE;
+  if (params.shared_memory == "system") shm_type = SharedMemoryType::SYSTEM;
+  if (params.shared_memory == "tpu") shm_type = SharedMemoryType::TPU;
+  std::string arena_url = params.tpu_arena_url;
+  if (shm_type == SharedMemoryType::TPU && arena_url.empty()) {
+    arena_url = params.url;  // arena co-hosted with the gRPC endpoint
+  }
+  InferDataManager data_manager(
+      &model, &loader, shm_type, params.output_shm_size, arena_url,
+      params.batch_size);
+
+  std::unique_ptr<SequenceManager> sequence_manager;
+  if (model.scheduler_type == SchedulerType::SEQUENCE ||
+      !params.sequence_id_range.empty()) {
+    uint64_t start_id = 1, id_range = 1ull << 31;
+    if (!params.sequence_id_range.empty()) {
+      size_t colon = params.sequence_id_range.find(':');
+      start_id = strtoull(
+          params.sequence_id_range.substr(0, colon).c_str(), nullptr, 10);
+      if (colon != std::string::npos) {
+        uint64_t end_id = strtoull(
+            params.sequence_id_range.substr(colon + 1).c_str(), nullptr, 10);
+        id_range = end_id > start_id ? end_id - start_id : 1;
+      }
+    }
+    sequence_manager = std::make_unique<SequenceManager>(
+        start_id, id_range, params.sequence_length,
+        params.sequence_length_variation / 100.0);
+  }
+
+  MeasurementConfig config;
+  config.measurement_interval_ms = params.measurement_interval_ms;
+  config.count_windows = params.measurement_mode == "count_windows";
+  config.measurement_request_count = params.measurement_request_count;
+  config.max_trials = params.max_trials;
+  config.stability_threshold = params.stability_percentage / 100.0;
+  config.latency_threshold_ms = params.latency_threshold_ms;
+  config.percentile = params.percentile;
+
+  LoadManager::Options manager_options;
+  manager_options.async_mode = params.async_mode;
+  manager_options.streaming = params.streaming;
+  manager_options.max_threads = params.max_threads;
+
+  std::vector<PerfStatus> results;
+  LoadMode mode = LoadMode::CONCURRENCY;
+  std::unique_ptr<LoadManager> manager;
+
+  auto profile = [&](LoadManager* m) -> Error {
+    Error init_err = m->Init();
+    if (!init_err.IsOk()) return init_err;
+    InferenceProfiler profiler(
+        m, config, setup_backend.get(), model.name, params.verbose);
+    if (params.has_request_rate_range) {
+      mode = LoadMode::REQUEST_RATE;
+      return profiler.ProfileRequestRateRange(
+          static_cast<RequestRateManager*>(m), params.rate_start,
+          params.rate_end, params.rate_step, &results);
+    }
+    if (!params.request_intervals_file.empty()) {
+      mode = LoadMode::REQUEST_RATE;
+      auto* custom = static_cast<CustomLoadManager*>(m);
+      Error sched_err =
+          custom->StartSchedule(params.request_intervals_file);
+      if (!sched_err.IsOk()) return sched_err;
+      PerfStatus status;
+      Error prof_err = profiler.ProfileSingleLevel(&status);
+      if (!prof_err.IsOk()) return prof_err;
+      results.push_back(std::move(status));
+      custom->Stop();
+      return Error::Success;
+    }
+    if (params.has_periodic_range) {
+      auto* periodic = static_cast<PeriodicConcurrencyManager*>(m);
+      PeriodicConcurrencyManager::RampConfig ramp;
+      ramp.start = params.periodic_start;
+      ramp.end = params.periodic_end;
+      ramp.step = params.periodic_step;
+      ramp.request_period = params.request_period;
+      Error ramp_err = periodic->RunRamp(ramp);
+      if (!ramp_err.IsOk()) return ramp_err;
+      PerfStatus status;
+      Error prof_err = profiler.ProfileSingleLevel(&status);
+      if (!prof_err.IsOk()) return prof_err;
+      status.concurrency = params.periodic_end;
+      results.push_back(std::move(status));
+      periodic->Stop();
+      return Error::Success;
+    }
+    return profiler.ProfileConcurrencyRange(
+        static_cast<ConcurrencyManager*>(m), params.concurrency_start,
+        params.concurrency_end, params.concurrency_step, &results);
+  };
+
+  if (params.has_request_rate_range ||
+      !params.request_intervals_file.empty()) {
+    RequestRateManager::Distribution dist =
+        params.request_distribution == "poisson"
+            ? RequestRateManager::Distribution::POISSON
+            : RequestRateManager::Distribution::CONSTANT;
+    if (!params.request_intervals_file.empty()) {
+      manager = std::make_unique<CustomLoadManager>(
+          &factory, &model, &loader, &data_manager, manager_options, dist,
+          sequence_manager.get());
+    } else {
+      manager = std::make_unique<RequestRateManager>(
+          &factory, &model, &loader, &data_manager, manager_options, dist,
+          sequence_manager.get());
+    }
+  } else if (params.has_periodic_range) {
+    manager = std::make_unique<PeriodicConcurrencyManager>(
+        &factory, &model, &loader, &data_manager, manager_options,
+        sequence_manager.get());
+  } else {
+    manager = std::make_unique<ConcurrencyManager>(
+        &factory, &model, &loader, &data_manager, manager_options,
+        sequence_manager.get());
+  }
+
+  err = profile(manager.get());
+  manager->Cleanup();
+  if (!err.IsOk()) {
+    fprintf(stderr, "perf failed: %s\n", err.Message().c_str());
+    return 1;
+  }
+
+  PrintReport(results, mode, params.percentile);
+  if (!params.latency_report_file.empty()) {
+    err = WriteCsv(params.latency_report_file, results, mode);
+    if (!err.IsOk()) fprintf(stderr, "warning: %s\n", err.Message().c_str());
+  }
+  if (!params.profile_export_file.empty()) {
+    err = ExportProfile(
+        params.profile_export_file, results, model.name, "triton",
+        params.url, mode);
+    if (!err.IsOk()) fprintf(stderr, "warning: %s\n", err.Message().c_str());
+  }
+  return 0;
+}
+
+}  // namespace perf
+}  // namespace tpuclient
+
+int main(int argc, char** argv) {
+  return tpuclient::perf::Run(argc, argv);
+}

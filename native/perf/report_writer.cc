@@ -1,0 +1,121 @@
+#include "report_writer.h"
+
+#include <cstdio>
+#include <fstream>
+
+namespace tpuclient {
+namespace perf {
+
+namespace {
+
+double Pct(const PerfStatus& status, int p) {
+  auto it = status.latency_percentiles.find(p);
+  return it != status.latency_percentiles.end() ? it->second : 0.0;
+}
+
+}  // namespace
+
+void PrintReport(
+    const std::vector<PerfStatus>& results, LoadMode mode, int percentile) {
+  for (const auto& status : results) {
+    if (mode == LoadMode::CONCURRENCY) {
+      printf("Concurrency: %zu, throughput: %.2f infer/sec, avg latency "
+             "%.0f usec\n",
+             status.concurrency, status.throughput, status.avg_latency_us);
+    } else {
+      printf("Request rate: %.1f, throughput: %.2f infer/sec, avg latency "
+             "%.0f usec\n",
+             status.request_rate, status.throughput, status.avg_latency_us);
+    }
+    printf("    latency percentiles (usec):");
+    for (const auto& kv : status.latency_percentiles) {
+      printf(" p%d %.0f", kv.first, kv.second);
+    }
+    printf("\n");
+    if (status.delayed_count > 0) {
+      printf("    delayed requests: %zu\n", status.delayed_count);
+    }
+    if (status.error_count > 0) {
+      printf("    errors: %zu\n", status.error_count);
+    }
+    if (!status.on_target) {
+      printf("    WARNING: measurement did not stabilize\n");
+    }
+  }
+}
+
+Error WriteCsv(
+    const std::string& path, const std::vector<PerfStatus>& results,
+    LoadMode mode) {
+  std::ofstream out(path);
+  if (!out) return Error("cannot write CSV file '" + path + "'");
+  out << (mode == LoadMode::CONCURRENCY ? "Concurrency" : "Request Rate")
+      << ",Inferences/Second,p50 latency,p90 latency,p95 latency,"
+         "p99 latency,Avg latency,Std latency,Completed,Delayed,Errors\n";
+  char line[512];
+  for (const auto& status : results) {
+    if (mode == LoadMode::CONCURRENCY) {
+      snprintf(line, sizeof(line), "%zu,", status.concurrency);
+    } else {
+      snprintf(line, sizeof(line), "%.2f,", status.request_rate);
+    }
+    out << line;
+    snprintf(
+        line, sizeof(line),
+        "%.2f,%.1f,%.1f,%.1f,%.1f,%.1f,%.1f,%zu,%zu,%zu\n",
+        status.throughput, Pct(status, 50), Pct(status, 90), Pct(status, 95),
+        Pct(status, 99), status.avg_latency_us, status.std_latency_us,
+        status.completed_count, status.delayed_count, status.error_count);
+    out << line;
+  }
+  return Error::Success;
+}
+
+Error ExportProfile(
+    const std::string& path, const std::vector<PerfStatus>& results,
+    const std::string& model_name, const std::string& service_kind,
+    const std::string& endpoint, LoadMode mode) {
+  json::Array experiments;
+  for (const auto& status : results) {
+    json::Object experiment;
+    json::Object meta;
+    meta["mode"] = json::Value(std::string(
+        mode == LoadMode::CONCURRENCY ? "concurrency" : "request_rate"));
+    if (mode == LoadMode::CONCURRENCY) {
+      meta["value"] = json::Value(static_cast<uint64_t>(status.concurrency));
+    } else {
+      meta["value"] = json::Value(status.request_rate);
+    }
+    experiment["experiment"] = json::Value(std::move(meta));
+    json::Array requests;
+    for (const auto& record : status.records) {
+      if (!record.valid()) continue;
+      json::Object req;
+      req["timestamp"] = json::Value(record.start_ns);
+      json::Array responses;
+      for (uint64_t ts : record.end_ns) responses.push_back(json::Value(ts));
+      req["response_timestamps"] = json::Value(std::move(responses));
+      requests.push_back(json::Value(std::move(req)));
+    }
+    experiment["requests"] = json::Value(std::move(requests));
+    json::Array window;
+    window.push_back(json::Value(status.window_start_ns));
+    window.push_back(json::Value(status.window_end_ns));
+    experiment["window_boundaries"] = json::Value(std::move(window));
+    experiments.push_back(json::Value(std::move(experiment)));
+  }
+  json::Object doc;
+  doc["version"] = json::Value(std::string("0.1"));
+  doc["service_kind"] = json::Value(service_kind);
+  doc["endpoint"] = json::Value(endpoint);
+  doc["model"] = json::Value(model_name);
+  doc["experiments"] = json::Value(std::move(experiments));
+
+  std::ofstream out(path);
+  if (!out) return Error("cannot write profile export '" + path + "'");
+  out << json::Value(std::move(doc)).Serialize();
+  return Error::Success;
+}
+
+}  // namespace perf
+}  // namespace tpuclient

@@ -1,0 +1,33 @@
+// Result reporting: stdout summary, CSV rows (parity:
+// /root/reference/src/c++/perf_analyzer/report_writer.h:80) and the
+// JSON profile export consumed by the genai layer (parity:
+// profile_data_exporter.h:54-94 — same experiments[].requests[]
+// shape, so client_tpu.genai parses either harness's output).
+#pragma once
+
+#include <string>
+#include <vector>
+
+#include "../library/common.h"
+#include "inference_profiler.h"
+
+namespace tpuclient {
+namespace perf {
+
+enum class LoadMode { CONCURRENCY, REQUEST_RATE };
+
+void PrintReport(
+    const std::vector<PerfStatus>& results, LoadMode mode,
+    int percentile = 0);
+
+Error WriteCsv(
+    const std::string& path, const std::vector<PerfStatus>& results,
+    LoadMode mode);
+
+Error ExportProfile(
+    const std::string& path, const std::vector<PerfStatus>& results,
+    const std::string& model_name, const std::string& service_kind,
+    const std::string& endpoint, LoadMode mode);
+
+}  // namespace perf
+}  // namespace tpuclient

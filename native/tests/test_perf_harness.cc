@@ -1,0 +1,318 @@
+// Perf harness unit tests over the mock backend — no server needed
+// (parity tier 1: the reference's 131 doctest TEST_CASEs run against
+// NaggyMockClientBackend, SURVEY.md §4).
+#include <chrono>
+#include <fstream>
+#include <sstream>
+#include <thread>
+
+#include "../perf/command_line_parser.h"
+#include "../perf/inference_profiler.h"
+#include "../perf/report_writer.h"
+#include "minitest.h"
+
+using namespace tpuclient;
+using namespace tpuclient::perf;
+
+namespace {
+
+BackendConfig MockConfig(uint64_t delay_us = 300) {
+  BackendConfig config;
+  config.kind = BackendKind::MOCK;
+  config.mock_delay_us = delay_us;
+  return config;
+}
+
+struct Harness {
+  ClientBackendFactory factory;
+  std::unique_ptr<ClientBackend> backend;
+  ParsedModel model;
+  DataLoader loader;
+  InferDataManager data_manager;
+
+  explicit Harness(uint64_t delay_us = 300)
+      : factory(MockConfig(delay_us)), loader(&model),
+        data_manager(&model, &loader) {
+    factory.Create(&backend);
+    ModelParser::Parse(backend.get(), "mock", "", 1, &model);
+    loader.GenerateData();
+  }
+};
+
+}  // namespace
+
+TEST_CASE("perf: model parser over mock backend") {
+  Harness h;
+  CHECK_EQ(h.model.name, "mock");
+  CHECK_EQ(h.model.inputs.size(), 2u);
+  CHECK_EQ(h.model.outputs.size(), 2u);
+  CHECK_EQ(h.model.max_batch_size, 8);
+  CHECK(h.model.FindInput("INPUT0") != nullptr);
+  CHECK(h.model.FindInput("NOPE") == nullptr);
+
+  // Batch-size validation.
+  ParsedModel rejected;
+  Error err = ModelParser::Parse(h.backend.get(), "mock", "", 99, &rejected);
+  CHECK(!err.IsOk());
+}
+
+TEST_CASE("perf: data loader random + json") {
+  Harness h;
+  const TensorData* data = nullptr;
+  REQUIRE_OK(h.loader.GetInputData("INPUT0", 0, 0, &data));
+  CHECK_EQ(data->bytes.size(), 64u);  // 16 x INT32
+  CHECK_EQ(data->datatype, "INT32");
+
+  DataLoader json_loader(&h.model);
+  REQUIRE_OK(json_loader.ReadDataFromJsonText(
+      R"({"data": [{"INPUT0": [1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16],
+                    "INPUT1": [1,1,1,1,1,1,1,1,1,1,1,1,1,1,1,1]}]})"));
+  REQUIRE_OK(json_loader.GetInputData("INPUT0", 0, 0, &data));
+  REQUIRE(data->bytes.size() == 64);
+  const int32_t* values =
+      reinterpret_cast<const int32_t*>(data->bytes.data());
+  CHECK_EQ(values[0], 1);
+  CHECK_EQ(values[15], 16);
+
+  // Missing input -> validation error.
+  DataLoader bad_loader(&h.model);
+  Error err = bad_loader.ReadDataFromJsonText(
+      R"({"data": [{"INPUT0": [1]}]})");
+  CHECK(!err.IsOk());
+
+  // Multi-stream form.
+  DataLoader stream_loader(&h.model);
+  REQUIRE_OK(stream_loader.ReadDataFromJsonText(
+      R"({"data": [[{"INPUT0": {"content": [0,0,0,0,0,0,0,0,0,0,0,0,0,0,0,0]},
+                     "INPUT1": [1,1,1,1,1,1,1,1,1,1,1,1,1,1,1,1]}],
+                   [{"INPUT0": [2,2,2,2,2,2,2,2,2,2,2,2,2,2,2,2],
+                     "INPUT1": [3,3,3,3,3,3,3,3,3,3,3,3,3,3,3,3]}]]})"));
+  CHECK_EQ(stream_loader.stream_count(), 2u);
+  CHECK_EQ(stream_loader.step_count(1), 1u);
+}
+
+TEST_CASE("perf: ctx id tracker") {
+  FifoCtxIdTracker tracker;
+  tracker.Reset(2);
+  int a = tracker.Get(100);
+  int b = tracker.Get(100);
+  CHECK_EQ(a, 0);
+  CHECK_EQ(b, 1);
+  CHECK_EQ(tracker.Get(10), -1);  // exhausted
+  tracker.Release(a);
+  CHECK_EQ(tracker.Get(100), 0);
+}
+
+TEST_CASE("perf: sequence manager start/end options") {
+  SequenceManager seq(100, 1000, /*length=*/3, /*variation=*/0.0);
+  SequenceManager::Slot slot;
+  InferOptions options("m");
+  size_t stream, step;
+
+  seq.NextStep(&slot, 1, 4, &options, &stream, &step);
+  CHECK_EQ(options.sequence_id, 100u);
+  CHECK(options.sequence_start);
+  CHECK(!options.sequence_end);
+  CHECK_EQ(step, 0u);
+
+  seq.NextStep(&slot, 1, 4, &options, &stream, &step);
+  CHECK(!options.sequence_start);
+  CHECK(!options.sequence_end);
+  CHECK_EQ(step, 1u);
+
+  seq.NextStep(&slot, 1, 4, &options, &stream, &step);
+  CHECK(options.sequence_end);
+
+  // Next call starts a fresh sequence with a new id.
+  seq.NextStep(&slot, 1, 4, &options, &stream, &step);
+  CHECK_EQ(options.sequence_id, 101u);
+  CHECK(options.sequence_start);
+}
+
+TEST_CASE("perf: concurrency manager drives mock backend") {
+  ResetMockBackendStats();
+  Harness h(200);
+  ConcurrencyManager manager(
+      &h.factory, &h.model, &h.loader, &h.data_manager,
+      LoadManager::Options{/*async=*/true, /*streaming=*/false,
+                           /*max_threads=*/4});
+  REQUIRE_OK(manager.Init());
+  REQUIRE_OK(manager.ChangeConcurrencyLevel(4));
+  std::this_thread::sleep_for(std::chrono::milliseconds(300));
+  REQUIRE_OK(manager.CheckHealth());
+  size_t collected = manager.CountCollectedRequests();
+  CHECK(collected > 20);
+  manager.Stop();  // quiesce before draining so the count stays 0
+  auto records = manager.SwapRequestRecords();
+  CHECK(records.size() >= collected);
+  CHECK_EQ(manager.CountCollectedRequests(), 0u);
+  for (const auto& record : records) {
+    if (!record.valid()) continue;
+    CHECK(record.latency_ns() >= 200 * 1000ull);
+  }
+  CHECK(GetMockBackendStats()->async_infer_calls.load() > 20);
+}
+
+TEST_CASE("perf: sync concurrency mode") {
+  ResetMockBackendStats();
+  Harness h(100);
+  ConcurrencyManager manager(
+      &h.factory, &h.model, &h.loader, &h.data_manager,
+      LoadManager::Options{/*async=*/false, /*streaming=*/false,
+                           /*max_threads=*/2});
+  REQUIRE_OK(manager.Init());
+  REQUIRE_OK(manager.ChangeConcurrencyLevel(2));
+  std::this_thread::sleep_for(std::chrono::milliseconds(200));
+  manager.Stop();
+  CHECK(GetMockBackendStats()->infer_calls.load() > 5);
+  CHECK(manager.CountCollectedRequests() > 5);
+}
+
+TEST_CASE("perf: streaming concurrency mode") {
+  ResetMockBackendStats();
+  Harness h(100);
+  ConcurrencyManager manager(
+      &h.factory, &h.model, &h.loader, &h.data_manager,
+      LoadManager::Options{/*async=*/true, /*streaming=*/true,
+                           /*max_threads=*/2});
+  REQUIRE_OK(manager.Init());
+  REQUIRE_OK(manager.ChangeConcurrencyLevel(2));
+  std::this_thread::sleep_for(std::chrono::milliseconds(250));
+  manager.Stop();
+  CHECK(GetMockBackendStats()->stream_infer_calls.load() > 5);
+  auto records = manager.SwapRequestRecords();
+  size_t valid = 0;
+  for (const auto& r : records) {
+    if (r.valid()) valid++;
+  }
+  CHECK(valid > 5);
+}
+
+TEST_CASE("perf: request rate manager paces dispatch") {
+  ResetMockBackendStats();
+  Harness h(50);
+  RequestRateManager manager(
+      &h.factory, &h.model, &h.loader, &h.data_manager,
+      LoadManager::Options{/*async=*/true, /*streaming=*/false,
+                           /*max_threads=*/4});
+  REQUIRE_OK(manager.Init());
+  REQUIRE_OK(manager.ChangeRequestRate(100.0));  // 100 rps
+  std::this_thread::sleep_for(std::chrono::milliseconds(500));
+  manager.Stop();
+  size_t count = manager.CountCollectedRequests();
+  // ~50 expected in 500ms at 100 rps; generous bounds for CI noise.
+  CHECK(count > 20);
+  CHECK(count < 100);
+}
+
+TEST_CASE("perf: custom schedule from intervals") {
+  Harness h(10);
+  RequestRateManager manager(
+      &h.factory, &h.model, &h.loader, &h.data_manager,
+      LoadManager::Options{/*async=*/true, /*streaming=*/false,
+                           /*max_threads=*/2});
+  REQUIRE_OK(manager.Init());
+  // 5ms gaps -> ~200 rps.
+  REQUIRE_OK(manager.SetCustomSchedule({0.005}));
+  std::this_thread::sleep_for(std::chrono::milliseconds(300));
+  manager.Stop();
+  size_t count = manager.CountCollectedRequests();
+  CHECK(count > 20);
+}
+
+TEST_CASE("perf: profiler stabilizes on mock load") {
+  Harness h(200);
+  ConcurrencyManager manager(
+      &h.factory, &h.model, &h.loader, &h.data_manager,
+      LoadManager::Options{/*async=*/true, /*streaming=*/false,
+                           /*max_threads=*/4});
+  REQUIRE_OK(manager.Init());
+  MeasurementConfig config;
+  config.measurement_interval_ms = 120;
+  config.max_trials = 8;
+  config.stability_threshold = 0.5;  // generous for CI
+  InferenceProfiler profiler(&manager, config);
+  std::vector<PerfStatus> results;
+  REQUIRE_OK(profiler.ProfileConcurrencyRange(&manager, 1, 2, 1, &results));
+  REQUIRE(results.size() == 2);
+  CHECK_EQ(results[0].concurrency, 1u);
+  CHECK_EQ(results[1].concurrency, 2u);
+  for (const auto& status : results) {
+    CHECK(status.completed_count > 0);
+    CHECK(status.throughput > 0.0);
+    CHECK(status.avg_latency_us >= 200.0);
+    CHECK(status.latency_percentiles.count(99) == 1);
+  }
+  // 2 concurrent requests at the same per-request delay ≈ 2x the
+  // throughput of 1 (loose bound).
+  CHECK(results[1].throughput > results[0].throughput * 1.3);
+}
+
+TEST_CASE("perf: report writer and profile export") {
+  PerfStatus status;
+  status.concurrency = 2;
+  status.throughput = 123.4;
+  status.avg_latency_us = 810.0;
+  status.latency_percentiles = {{50, 800.0}, {90, 900.0},
+                                {95, 950.0}, {99, 990.0}};
+  status.completed_count = 100;
+  RequestRecord record;
+  record.start_ns = 1000;
+  record.end_ns = {2000};
+  status.records.push_back(record);
+  std::vector<PerfStatus> results = {status};
+
+  REQUIRE_OK(WriteCsv("/tmp/tpuperf_test.csv", results,
+                      LoadMode::CONCURRENCY));
+  std::ifstream csv("/tmp/tpuperf_test.csv");
+  std::string header, row;
+  std::getline(csv, header);
+  std::getline(csv, row);
+  CHECK(header.find("Inferences/Second") != std::string::npos);
+  CHECK(row.find("123.40") != std::string::npos);
+
+  REQUIRE_OK(ExportProfile(
+      "/tmp/tpuperf_test.json", results, "mock", "triton", "localhost",
+      LoadMode::CONCURRENCY));
+  std::ifstream jf("/tmp/tpuperf_test.json");
+  std::stringstream buf;
+  buf << jf.rdbuf();
+  json::Value doc;
+  REQUIRE(json::Parse(buf.str(), &doc).empty());
+  CHECK_EQ(doc["model"].AsString(), "mock");
+  CHECK_EQ(doc["experiments"].AsArray().size(), 1u);
+  CHECK_EQ(
+      doc["experiments"].AsArray()[0]["requests"].AsArray().size(), 1u);
+}
+
+TEST_CASE("perf: command line parser") {
+  PerfAnalyzerParameters params;
+  const char* argv1[] = {
+      "perf_analyzer", "-m", "resnet50", "-u", "host:9", "-b", "4",
+      "--concurrency-range", "1:8:2", "--shared-memory", "tpu",
+      "--percentile", "99", "-p", "2000"};
+  REQUIRE_OK(CLParser::Parse(
+      15, const_cast<char**>(argv1), &params));
+  CHECK_EQ(params.model_name, "resnet50");
+  CHECK_EQ(params.batch_size, 4);
+  CHECK_EQ(params.concurrency_start, 1u);
+  CHECK_EQ(params.concurrency_end, 8u);
+  CHECK_EQ(params.concurrency_step, 2u);
+  CHECK_EQ(params.shared_memory, "tpu");
+  CHECK_EQ(params.percentile, 99);
+  CHECK_EQ(params.measurement_interval_ms, 2000u);
+
+  // Missing -m fails.
+  PerfAnalyzerParameters missing;
+  const char* argv2[] = {"perf_analyzer", "-u", "host:9"};
+  CHECK(!CLParser::Parse(3, const_cast<char**>(argv2), &missing).IsOk());
+
+  // Mutually exclusive modes fail.
+  PerfAnalyzerParameters exclusive;
+  const char* argv3[] = {
+      "perf_analyzer", "-m", "x", "--concurrency-range", "1:2",
+      "--request-rate-range", "10:20"};
+  CHECK(!CLParser::Parse(7, const_cast<char**>(argv3), &exclusive).IsOk());
+}
+
+MINITEST_MAIN

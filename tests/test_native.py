@@ -70,6 +70,10 @@ def test_native_grpc_offline(native_build):
     _run_binary(native_build, "test_grpc_client")
 
 
+def test_native_perf_harness(native_build):
+    _run_binary(native_build, "test_perf_harness")
+
+
 @pytest.fixture(scope="module")
 def live_server():
     """In-process server with gRPC + HTTP front-ends on ephemeral
@@ -100,3 +104,20 @@ def test_native_grpc_integration(native_build, live_server):
         native_build, "test_grpc_client",
         {"TPUCLIENT_SERVER_GRPC": live_server["grpc"]},
     )
+
+
+@pytest.mark.parametrize("shm", ["none", "system", "tpu"])
+def test_native_perf_analyzer_e2e(native_build, live_server, shm):
+    """The native perf_analyzer binary end-to-end against the live
+    server, in every shared-memory mode (parity: the reference's
+    perf_analyzer L0 runs)."""
+    binary = native_build / "perf_analyzer"
+    assert binary.exists(), "perf_analyzer not built"
+    proc = subprocess.run(
+        [str(binary), "-m", "simple", "-u", live_server["grpc"],
+         "--concurrency-range", "2", "-p", "400", "-r", "4", "-s", "80",
+         "--shared-memory", shm],
+        capture_output=True, text=True, timeout=180,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "throughput" in proc.stdout
