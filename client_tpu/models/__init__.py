@@ -12,6 +12,11 @@ from client_tpu.server.model import ServedModel
 def builtin_model_factories(repository=None
                             ) -> Dict[str, Callable[[], ServedModel]]:
     from client_tpu.models.add_sub import AddSub
+    from client_tpu.models.simple_extra import (
+        RepeatInt32,
+        SequenceAccumulator,
+        StringAddSub,
+    )
     from client_tpu.models.zoo import extra_model_factories
 
     factories: Dict[str, Callable[[], ServedModel]] = {
@@ -23,6 +28,9 @@ def builtin_model_factories(repository=None
         "add_sub_tpu": lambda: AddSub(
             name="add_sub_tpu", datatype="FP32", shape=(16,), device="tpu"
         ),
+        "simple_string": StringAddSub,
+        "simple_sequence": SequenceAccumulator,
+        "repeat_int32": RepeatInt32,
     }
     factories.update(extra_model_factories(repository))
     return factories

@@ -1,0 +1,50 @@
+#!/usr/bin/env python
+"""Decoupled streaming: repeat_int32 emits one response per input element.
+
+Start a server first:  python -m client_tpu.server.app --models repeat_int32
+(parity example: reference src/python/examples/decoupled stream examples (repeat_int32))
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import client_tpu.grpc as grpcclient
+
+import threading
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+
+    with grpcclient.InferenceServerClient(args.url) as client:
+        values = np.array([3, 1, 4, 1, 5], dtype=np.int32)
+        got = []
+        done = threading.Event()
+
+        def callback(result, error):
+            assert error is None, "stream error: %s" % error
+            params = result.get_parameters()
+            if result.as_numpy("OUT") is not None:
+                got.append(int(result.as_numpy("OUT")[0]))
+            if params.get("triton_final_response"):
+                done.set()
+
+        client.start_stream(callback)
+        inputs = [grpcclient.InferInput("IN", [len(values)], "INT32")]
+        inputs[0].set_data_from_numpy(values)
+        client.async_stream_infer("repeat_int32", inputs)
+        assert done.wait(timeout=30), "stream timed out"
+        client.stop_stream()
+        assert got == list(values), "got %s" % got
+        print("PASS: decoupled stream (%d responses)" % len(got))
+
+
+if __name__ == "__main__":
+    main()

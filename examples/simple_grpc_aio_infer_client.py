@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""asyncio gRPC client: concurrent inferences with asyncio.gather.
+
+Start a server first:  python -m client_tpu.server.app --models simple
+(parity example: reference src/python/examples/simple_grpc_aio_infer_client.py)
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import asyncio
+
+import client_tpu.grpc.aio as grpcclient_aio
+from client_tpu.grpc import InferInput
+
+
+async def run(url):
+    async with grpcclient_aio.InferenceServerClient(url) as client:
+        assert await client.is_server_live()
+        in0 = np.arange(16, dtype=np.int32)
+        in1 = np.ones(16, dtype=np.int32)
+        inputs = [
+            InferInput("INPUT0", [16], "INT32"),
+            InferInput("INPUT1", [16], "INT32"),
+        ]
+        inputs[0].set_data_from_numpy(in0)
+        inputs[1].set_data_from_numpy(in1)
+
+        results = await asyncio.gather(
+            *[client.infer("simple", inputs) for _ in range(4)]
+        )
+        for result in results:
+            np.testing.assert_array_equal(
+                result.as_numpy("OUTPUT0"), in0 + in1)
+        print("PASS: aio infer x4")
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+    asyncio.run(run(args.url))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""Callback-async inference: several in-flight requests completed on the client worker thread.
+
+Start a server first:  python -m client_tpu.server.app --models simple
+(parity example: reference src/python/examples/simple_grpc_async_infer_client.py)
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import client_tpu.grpc as grpcclient
+
+import threading
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+
+    with grpcclient.InferenceServerClient(args.url) as client:
+        in0 = np.arange(16, dtype=np.int32)
+        in1 = np.ones(16, dtype=np.int32)
+        inputs = [
+            grpcclient.InferInput("INPUT0", [16], "INT32"),
+            grpcclient.InferInput("INPUT1", [16], "INT32"),
+        ]
+        inputs[0].set_data_from_numpy(in0)
+        inputs[1].set_data_from_numpy(in1)
+
+        n_requests = 8
+        done = threading.Event()
+        results = []
+
+        def callback(result, error):
+            results.append((result, error))
+            if len(results) == n_requests:
+                done.set()
+
+        for _ in range(n_requests):
+            client.async_infer("simple", inputs, callback)
+        assert done.wait(timeout=30), "async requests timed out"
+        for result, error in results:
+            assert error is None, "async infer failed: %s" % error
+            np.testing.assert_array_equal(
+                result.as_numpy("OUTPUT0"), in0 + in1)
+        print("PASS: async infer x%d" % n_requests)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+#!/usr/bin/env python
+"""Health probes, server/model metadata, config, statistics.
+
+Start a server first:  python -m client_tpu.server.app --models simple
+(parity example: reference src/python/examples/simple_grpc_health_metadata.py)
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import client_tpu.grpc as grpcclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+
+    with grpcclient.InferenceServerClient(args.url) as client:
+        assert client.is_server_live()
+        assert client.is_server_ready()
+        assert client.is_model_ready("simple")
+
+        meta = client.get_server_metadata()
+        assert meta.name
+        model_meta = client.get_model_metadata("simple")
+        assert model_meta.name == "simple"
+        assert len(model_meta.inputs) == 2
+
+        config = client.get_model_config("simple")
+        assert config.config.name == "simple"
+
+        stats = client.get_inference_statistics("simple")
+        assert len(stats.model_stats) >= 1
+        print("PASS: health + metadata")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,50 @@
+#!/usr/bin/env python
+"""Explicit model lifecycle: load, infer, unload, repository index.
+
+Start a server first:  python -m client_tpu.server.app --models simple
+(parity example: reference src/python/examples/simple_grpc_model_control.py)
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import client_tpu.grpc as grpcclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+
+    with grpcclient.InferenceServerClient(args.url) as client:
+        client.load_model("add_sub")
+        assert client.is_model_ready("add_sub")
+
+        in0 = np.random.randint(0, 100, 16).astype(np.int32)
+        in1 = np.random.randint(0, 100, 16).astype(np.int32)
+        inputs = [
+            grpcclient.InferInput("INPUT0", [16], "INT32"),
+            grpcclient.InferInput("INPUT1", [16], "INT32"),
+        ]
+        inputs[0].set_data_from_numpy(in0)
+        inputs[1].set_data_from_numpy(in1)
+        result = client.infer("add_sub", inputs)
+        np.testing.assert_allclose(
+            result.as_numpy("OUTPUT0"), in0 + in1, rtol=1e-5)
+
+        client.unload_model("add_sub")
+        assert not client.is_model_ready("add_sub")
+
+        index = client.get_model_repository_index()
+        names = [m.name for m in index.models]
+        assert "add_sub" in names
+        print("PASS: model control")
+
+
+if __name__ == "__main__":
+    main()

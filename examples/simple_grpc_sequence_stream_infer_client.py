@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""Stateful sequences over the bidi stream: per-sequence running sums arrive in order.
+
+Start a server first:  python -m client_tpu.server.app --models simple_sequence
+(parity example: reference src/python/examples/simple_grpc_sequence_stream_infer_client.py)
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import client_tpu.grpc as grpcclient
+
+import threading
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+
+    with grpcclient.InferenceServerClient(args.url) as client:
+        values = [4, 7, 9]
+        expected = [4, 11, 20]
+        got = []
+        done = threading.Event()
+
+        def callback(result, error):
+            assert error is None, "stream error: %s" % error
+            got.append(int(result.as_numpy("OUTPUT")[0]))
+            if len(got) == len(values):
+                done.set()
+
+        client.start_stream(callback)
+        inputs = [grpcclient.InferInput("INPUT", [1], "INT32")]
+        for step, value in enumerate(values):
+            inputs[0].set_data_from_numpy(np.array([value], dtype=np.int32))
+            client.async_stream_infer(
+                "simple_sequence", inputs, sequence_id=42,
+                sequence_start=(step == 0),
+                sequence_end=(step == len(values) - 1),
+            )
+        assert done.wait(timeout=30), "stream timed out"
+        client.stop_stream()
+        assert got == expected, "got %s want %s" % (got, expected)
+        print("PASS: sequence stream infer")
+
+
+if __name__ == "__main__":
+    main()

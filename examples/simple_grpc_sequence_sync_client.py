@@ -1,0 +1,46 @@
+#!/usr/bin/env python
+"""Stateful sequences: two interleaved sequences accumulate server-side.
+
+Start a server first:  python -m client_tpu.server.app --models simple_sequence
+(parity example: reference src/python/examples/simple_grpc_sequence_sync_client.py)
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import client_tpu.grpc as grpcclient
+
+
+def send(client, inputs, seq_id, value, start=False, end=False):
+    inputs[0].set_data_from_numpy(np.array([value], dtype=np.int32))
+    result = client.infer(
+        "simple_sequence", inputs, sequence_id=seq_id,
+        sequence_start=start, sequence_end=end,
+    )
+    return int(result.as_numpy("OUTPUT")[0])
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+
+    with grpcclient.InferenceServerClient(args.url) as client:
+        inputs = [grpcclient.InferInput("INPUT", [1], "INT32")]
+        # Interleave two sequences; each keeps its own running sum.
+        assert send(client, inputs, 1001, 5, start=True) == 5
+        assert send(client, inputs, 1002, 100, start=True) == 100
+        assert send(client, inputs, 1001, 3) == 8
+        assert send(client, inputs, 1002, 11) == 111
+        assert send(client, inputs, 1001, 2, end=True) == 10
+        assert send(client, inputs, 1002, 9, end=True) == 120
+        print("PASS: sequence sync (2 interleaved sequences)")
+
+
+if __name__ == "__main__":
+    main()

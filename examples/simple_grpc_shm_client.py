@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Zero-copy system shared memory: inputs and outputs ride POSIX shm regions.
+
+Start a server first:  python -m client_tpu.server.app --models simple
+(parity example: reference src/python/examples/simple_grpc_shm_client.py)
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import client_tpu.grpc as grpcclient
+
+import client_tpu.utils.shared_memory as shm
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+
+    with grpcclient.InferenceServerClient(args.url) as client:
+        client.unregister_system_shared_memory()
+
+        in0 = np.arange(16, dtype=np.int32)
+        in1 = np.ones(16, dtype=np.int32)
+        byte_size = in0.nbytes
+
+        in_handle = shm.create_shared_memory_region(
+            "input_data", "/example_input", byte_size * 2)
+        shm.set_shared_memory_region(in_handle, [in0])
+        shm.set_shared_memory_region(in_handle, [in1], offset=byte_size)
+        out_handle = shm.create_shared_memory_region(
+            "output_data", "/example_output", byte_size * 2)
+
+        client.register_system_shared_memory(
+            "input_data", "/example_input", byte_size * 2)
+        client.register_system_shared_memory(
+            "output_data", "/example_output", byte_size * 2)
+
+        inputs = [
+            grpcclient.InferInput("INPUT0", [16], "INT32"),
+            grpcclient.InferInput("INPUT1", [16], "INT32"),
+        ]
+        inputs[0].set_shared_memory("input_data", byte_size)
+        inputs[1].set_shared_memory("input_data", byte_size,
+                                    offset=byte_size)
+        outputs = [
+            grpcclient.InferRequestedOutput("OUTPUT0"),
+            grpcclient.InferRequestedOutput("OUTPUT1"),
+        ]
+        outputs[0].set_shared_memory("output_data", byte_size)
+        outputs[1].set_shared_memory("output_data", byte_size,
+                                     offset=byte_size)
+
+        client.infer("simple", inputs, outputs=outputs)
+
+        out0 = shm.get_contents_as_numpy(
+            out_handle, np.int32, [16])
+        out1 = shm.get_contents_as_numpy(
+            out_handle, np.int32, [16], offset=byte_size)
+        np.testing.assert_array_equal(out0, in0 + in1)
+        np.testing.assert_array_equal(out1, in0 - in1)
+
+        status = client.get_system_shared_memory_status()
+        assert len(status.regions) == 2
+
+        client.unregister_system_shared_memory()
+        shm.destroy_shared_memory_region(in_handle)
+        shm.destroy_shared_memory_region(out_handle)
+        print("PASS: system shm infer")
+
+
+if __name__ == "__main__":
+    main()

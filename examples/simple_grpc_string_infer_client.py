@@ -1,0 +1,44 @@
+#!/usr/bin/env python
+"""BYTES-tensor inference: string integers through the simple_string model.
+
+Start a server first:  python -m client_tpu.server.app --models simple_string
+(parity example: reference src/python/examples/simple_grpc_string_infer_client.py)
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import client_tpu.grpc as grpcclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+
+    with grpcclient.InferenceServerClient(args.url) as client:
+        in0 = np.array([str(i).encode() for i in range(16)], dtype=np.object_)
+        in1 = np.array([b"1"] * 16, dtype=np.object_)
+        inputs = [
+            grpcclient.InferInput("INPUT0", [16], "BYTES"),
+            grpcclient.InferInput("INPUT1", [16], "BYTES"),
+        ]
+        inputs[0].set_data_from_numpy(in0)
+        inputs[1].set_data_from_numpy(in1)
+
+        result = client.infer("simple_string", inputs)
+        out0 = result.as_numpy("OUTPUT0")
+        out1 = result.as_numpy("OUTPUT1")
+        for i in range(16):
+            assert int(out0[i]) == i + 1, "string add mismatch"
+            assert int(out1[i]) == i - 1, "string sub mismatch"
+        print("PASS: string infer")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,43 @@
+#!/usr/bin/env python
+"""Greenlet-free async HTTP inference: futures resolved via get_result().
+
+Start a server first:  python -m client_tpu.server.app --models simple
+(parity example: reference src/python/examples/simple_http_async_infer_client.py)
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import client_tpu.http as httpclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    args = parser.parse_args()
+
+    with httpclient.InferenceServerClient(args.url, concurrency=4) as client:
+        in0 = np.arange(16, dtype=np.int32)
+        in1 = np.ones(16, dtype=np.int32)
+        inputs = [
+            httpclient.InferInput("INPUT0", [16], "INT32"),
+            httpclient.InferInput("INPUT1", [16], "INT32"),
+        ]
+        inputs[0].set_data_from_numpy(in0)
+        inputs[1].set_data_from_numpy(in1)
+
+        pending = [client.async_infer("simple", inputs) for _ in range(6)]
+        for request in pending:
+            result = request.get_result(timeout=30)
+            np.testing.assert_array_equal(
+                result.as_numpy("OUTPUT0"), in0 + in1)
+        print("PASS: http async infer x%d" % len(pending))
+
+
+if __name__ == "__main__":
+    main()

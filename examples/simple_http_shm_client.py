@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""System shared memory over the HTTP protocol.
+
+Start a server first:  python -m client_tpu.server.app --models simple
+(parity example: reference src/python/examples/simple_http_shm_client.py)
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import client_tpu.http as httpclient
+
+import client_tpu.utils.shared_memory as shm
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    args = parser.parse_args()
+
+    with httpclient.InferenceServerClient(args.url) as client:
+        client.unregister_system_shared_memory()
+        in0 = np.arange(16, dtype=np.int32)
+        in1 = np.ones(16, dtype=np.int32)
+        byte_size = in0.nbytes
+
+        in_handle = shm.create_shared_memory_region(
+            "http_input", "/http_example_input", byte_size * 2)
+        shm.set_shared_memory_region(in_handle, [in0])
+        shm.set_shared_memory_region(in_handle, [in1], offset=byte_size)
+        client.register_system_shared_memory(
+            "http_input", "/http_example_input", byte_size * 2)
+
+        inputs = [
+            httpclient.InferInput("INPUT0", [16], "INT32"),
+            httpclient.InferInput("INPUT1", [16], "INT32"),
+        ]
+        inputs[0].set_shared_memory("http_input", byte_size)
+        inputs[1].set_shared_memory("http_input", byte_size,
+                                    offset=byte_size)
+
+        result = client.infer("simple", inputs)
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), in0 + in1)
+
+        client.unregister_system_shared_memory()
+        shm.destroy_shared_memory_region(in_handle)
+        print("PASS: http system shm infer")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,43 @@
+#!/usr/bin/env python
+"""BYTES tensors over HTTP (JSON-safe string payloads).
+
+Start a server first:  python -m client_tpu.server.app --models simple_string
+(parity example: reference src/python/examples/simple_http_string_infer_client.py)
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import client_tpu.http as httpclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    args = parser.parse_args()
+
+    with httpclient.InferenceServerClient(args.url) as client:
+        in0 = np.array([str(i).encode() for i in range(16)],
+                       dtype=np.object_)
+        in1 = np.array([b"2"] * 16, dtype=np.object_)
+        inputs = [
+            httpclient.InferInput("INPUT0", [16], "BYTES"),
+            httpclient.InferInput("INPUT1", [16], "BYTES"),
+        ]
+        inputs[0].set_data_from_numpy(in0)
+        inputs[1].set_data_from_numpy(in1)
+
+        result = client.infer("simple_string", inputs)
+        out0 = result.as_numpy("OUTPUT0")
+        for i in range(16):
+            assert int(out0[i]) == i + 2
+        print("PASS: http string infer")
+
+
+if __name__ == "__main__":
+    main()

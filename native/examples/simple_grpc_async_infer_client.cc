@@ -1,0 +1,69 @@
+// Callback-async gRPC inference: several in-flight requests
+// (parity example: reference src/c++/examples/simple_grpc_async_infer_client.cc).
+#include <condition_variable>
+#include <cstring>
+#include <iostream>
+#include <mutex>
+
+#include "grpc_client.h"
+
+
+namespace {
+const char* Url(int argc, char** argv, const char* fallback) {
+  for (int i = 1; i + 1 < argc; ++i) {
+    if (strcmp(argv[i], "-u") == 0) return argv[i + 1];
+  }
+  return fallback;
+}
+#define FAIL_IF_ERR(x, msg)                                         \
+  do {                                                              \
+    tpuclient::Error err__ = (x);                                   \
+    if (!err__.IsOk()) {                                            \
+      std::cerr << "error: " << msg << ": " << err__.Message()      \
+                << std::endl;                                       \
+      exit(1);                                                      \
+    }                                                               \
+  } while (0)
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::unique_ptr<tpuclient::InferenceServerGrpcClient> client;
+  FAIL_IF_ERR(tpuclient::InferenceServerGrpcClient::Create(
+                  &client, Url(argc, argv, "localhost:8001")),
+              "create client");
+
+  int32_t in0[16], in1[16];
+  for (int i = 0; i < 16; ++i) { in0[i] = i; in1[i] = 2; }
+  tpuclient::InferInput* raw0;
+  tpuclient::InferInput* raw1;
+  tpuclient::InferInput::Create(&raw0, "INPUT0", {16}, "INT32");
+  tpuclient::InferInput::Create(&raw1, "INPUT1", {16}, "INT32");
+  std::unique_ptr<tpuclient::InferInput> input0(raw0), input1(raw1);
+  input0->AppendRaw(reinterpret_cast<uint8_t*>(in0), sizeof(in0));
+  input1->AppendRaw(reinterpret_cast<uint8_t*>(in1), sizeof(in1));
+
+  constexpr int kRequests = 8;
+  std::mutex mutex;
+  std::condition_variable cv;
+  int done = 0, ok = 0;
+
+  tpuclient::InferOptions options("simple");
+  for (int r = 0; r < kRequests; ++r) {
+    FAIL_IF_ERR(client->AsyncInfer(
+                    [&](tpuclient::InferResult* result) {
+                      std::unique_ptr<tpuclient::InferResult> owned(result);
+                      bool good = owned->RequestStatus().IsOk();
+                      std::lock_guard<std::mutex> lock(mutex);
+                      ++done;
+                      if (good) ++ok;
+                      cv.notify_all();
+                    },
+                    options, {input0.get(), input1.get()}),
+                "async infer");
+  }
+  std::unique_lock<std::mutex> lock(mutex);
+  cv.wait(lock, [&] { return done == kRequests; });
+  if (ok != kRequests) { std::cerr << "failures\n"; return 1; }
+  std::cout << "PASS: async infer x" << kRequests << std::endl;
+  return 0;
+}

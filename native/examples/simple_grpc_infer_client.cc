@@ -1,0 +1,63 @@
+// Minimal sync gRPC inference against the `simple` add/sub model
+// (parity example: reference src/c++/examples/simple_grpc_infer_client.cc).
+#include <cstring>
+#include <iostream>
+
+#include "grpc_client.h"
+
+
+namespace {
+const char* Url(int argc, char** argv, const char* fallback) {
+  for (int i = 1; i + 1 < argc; ++i) {
+    if (strcmp(argv[i], "-u") == 0) return argv[i + 1];
+  }
+  return fallback;
+}
+#define FAIL_IF_ERR(x, msg)                                         \
+  do {                                                              \
+    tpuclient::Error err__ = (x);                                   \
+    if (!err__.IsOk()) {                                            \
+      std::cerr << "error: " << msg << ": " << err__.Message()      \
+                << std::endl;                                       \
+      exit(1);                                                      \
+    }                                                               \
+  } while (0)
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::unique_ptr<tpuclient::InferenceServerGrpcClient> client;
+  FAIL_IF_ERR(tpuclient::InferenceServerGrpcClient::Create(
+                  &client, Url(argc, argv, "localhost:8001")),
+              "create client");
+
+  int32_t in0[16], in1[16];
+  for (int i = 0; i < 16; ++i) { in0[i] = i; in1[i] = 1; }
+
+  tpuclient::InferInput* raw0;
+  tpuclient::InferInput* raw1;
+  FAIL_IF_ERR(tpuclient::InferInput::Create(&raw0, "INPUT0", {16}, "INT32"),
+              "create INPUT0");
+  FAIL_IF_ERR(tpuclient::InferInput::Create(&raw1, "INPUT1", {16}, "INT32"),
+              "create INPUT1");
+  std::unique_ptr<tpuclient::InferInput> input0(raw0), input1(raw1);
+  input0->AppendRaw(reinterpret_cast<uint8_t*>(in0), sizeof(in0));
+  input1->AppendRaw(reinterpret_cast<uint8_t*>(in1), sizeof(in1));
+
+  tpuclient::InferOptions options("simple");
+  tpuclient::InferResult* raw_result;
+  FAIL_IF_ERR(client->Infer(&raw_result, options,
+                            {input0.get(), input1.get()}),
+              "infer");
+  std::unique_ptr<tpuclient::InferResult> result(raw_result);
+
+  const uint8_t* buf;
+  size_t size;
+  FAIL_IF_ERR(result->RawData("OUTPUT0", &buf, &size), "OUTPUT0");
+  const int32_t* sum = reinterpret_cast<const int32_t*>(buf);
+  for (int i = 0; i < 16; ++i) {
+    std::cout << in0[i] << " + " << in1[i] << " = " << sum[i] << std::endl;
+    if (sum[i] != in0[i] + in1[i]) { std::cerr << "mismatch\n"; return 1; }
+  }
+  std::cout << "PASS: infer" << std::endl;
+  return 0;
+}

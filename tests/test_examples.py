@@ -1,0 +1,106 @@
+"""Examples-as-smoke-tests (parity: SURVEY.md §4 tier 4 — the
+reference's simple_* clients double as protocol conformance checks).
+Every example runs against one live in-process server and must print
+PASS."""
+
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+EXAMPLES = REPO / "examples"
+
+GRPC_EXAMPLES = [
+    "simple_grpc_infer_client.py",
+    "simple_grpc_string_infer_client.py",
+    "simple_grpc_async_infer_client.py",
+    "simple_grpc_sequence_sync_client.py",
+    "simple_grpc_sequence_stream_infer_client.py",
+    "simple_grpc_shm_client.py",
+    "simple_grpc_tpushm_client.py",
+    "simple_grpc_health_metadata_client.py",
+    "simple_grpc_model_control_client.py",
+    "simple_grpc_aio_infer_client.py",
+    "decoupled_grpc_stream_infer_client.py",
+]
+
+HTTP_EXAMPLES = [
+    "simple_http_infer_client.py",
+    "simple_http_async_infer_client.py",
+    "simple_http_aio_infer_client.py",
+    "simple_http_shm_client.py",
+    "simple_http_string_infer_client.py",
+]
+
+
+@pytest.fixture(scope="module")
+def example_server():
+    from client_tpu.server.app import build_core, start_grpc_server
+    from client_tpu.server.http_server import start_http_server_thread
+
+    core = build_core(
+        ["simple", "simple_string", "simple_sequence", "repeat_int32",
+         "add_sub_fp32"]
+    )
+    grpc_handle = start_grpc_server(core=core)
+    http_runner = start_http_server_thread(core, host="127.0.0.1", port=0)
+    yield {
+        "grpc": grpc_handle.address,
+        "http": "127.0.0.1:%d" % http_runner.port,
+    }
+    http_runner.stop()
+    grpc_handle.stop()
+
+
+def _run_example(name: str, url: str):
+    proc = subprocess.run(
+        [sys.executable, str(EXAMPLES / name), "-u", url],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, "%s failed:\n%s\n%s" % (
+        name, proc.stdout[-2000:], proc.stderr[-2000:]
+    )
+    assert "PASS" in proc.stdout, proc.stdout
+
+
+@pytest.mark.parametrize("name", GRPC_EXAMPLES)
+def test_grpc_example(example_server, name):
+    _run_example(name, example_server["grpc"])
+
+
+@pytest.mark.parametrize("name", HTTP_EXAMPLES)
+def test_http_example(example_server, name):
+    _run_example(name, example_server["http"])
+
+
+CPP_GRPC_EXAMPLES = [
+    "simple_grpc_infer_client",
+    "simple_grpc_async_infer_client",
+    "simple_grpc_string_infer_client",
+    "simple_grpc_stream_infer_client",
+    "simple_grpc_shm_client",
+]
+
+
+def _run_native_example(name: str, url: str):
+    binary = REPO / "native" / "build" / name
+    if not binary.exists():
+        pytest.skip("native examples not built (run test_native first)")
+    proc = subprocess.run(
+        [str(binary), "-u", url], capture_output=True, text=True, timeout=60
+    )
+    assert proc.returncode == 0, "%s failed:\n%s\n%s" % (
+        name, proc.stdout[-2000:], proc.stderr[-2000:]
+    )
+    assert "PASS" in proc.stdout
+
+
+@pytest.mark.parametrize("name", CPP_GRPC_EXAMPLES)
+def test_cpp_grpc_example(example_server, name):
+    _run_native_example(name, example_server["grpc"])
+
+
+def test_cpp_http_example(example_server):
+    _run_native_example("simple_http_infer_client", example_server["http"])
