@@ -1,0 +1,64 @@
+// Node gRPC sample for the TPU inference server (parity: reference
+// src/grpc_generated/javascript/client.js — @grpc/proto-loader over
+// the v2 proto, ModelInfer on `simple`).
+//
+//   npm install @grpc/grpc-js @grpc/proto-loader
+//   node client.js localhost:8001
+"use strict";
+
+const path = require("path");
+const grpc = require("@grpc/grpc-js");
+const protoLoader = require("@grpc/proto-loader");
+
+const PROTO = path.join(
+  __dirname, "..", "..", "client_tpu", "protocol", "inference.proto");
+
+function int32Bytes(values) {
+  const buf = Buffer.alloc(values.length * 4);
+  values.forEach((v, i) => buf.writeInt32LE(v, i * 4));
+  return buf;
+}
+
+function main() {
+  const url = process.argv[2] || "localhost:8001";
+  const definition = protoLoader.loadSync(PROTO, {
+    keepCase: true,
+    includeDirs: [path.join(__dirname, "..", "..")],
+  });
+  const proto = grpc.loadPackageDefinition(definition).inference;
+  const client = new proto.GRPCInferenceService(
+    url, grpc.credentials.createInsecure());
+
+  client.ServerLive({}, (err, reply) => {
+    if (err || !reply.live) {
+      console.error("server not live:", err);
+      process.exit(1);
+    }
+    const in0 = Array.from({ length: 16 }, (_, i) => i);
+    const in1 = Array.from({ length: 16 }, () => 1);
+    const request = {
+      model_name: "simple",
+      inputs: [
+        { name: "INPUT0", datatype: "INT32", shape: [16] },
+        { name: "INPUT1", datatype: "INT32", shape: [16] },
+      ],
+      raw_input_contents: [int32Bytes(in0), int32Bytes(in1)],
+    };
+    client.ModelInfer(request, (inferErr, response) => {
+      if (inferErr) {
+        console.error("infer failed:", inferErr);
+        process.exit(1);
+      }
+      const sum = response.raw_output_contents[0];
+      for (let i = 0; i < 16; i++) {
+        if (sum.readInt32LE(i * 4) !== in0[i] + in1[i]) {
+          console.error("mismatch at", i);
+          process.exit(1);
+        }
+      }
+      console.log("PASS: infer");
+    });
+  });
+}
+
+main();

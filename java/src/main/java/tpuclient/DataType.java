@@ -1,0 +1,18 @@
+// KServe-v2 tensor datatypes (parity: the reference Java client's
+// pojo/DataType.java — /root/reference/src/java/src/main/java/triton/
+// client/pojo/DataType.java — re-keyed for the TPU server's type set
+// including BF16).
+package tpuclient;
+
+public enum DataType {
+  BOOL(1), UINT8(1), UINT16(2), UINT32(4), UINT64(8),
+  INT8(1), INT16(2), INT32(4), INT64(8),
+  FP16(2), BF16(2), FP32(4), FP64(8), BYTES(0);
+
+  private final int byteSize;
+
+  DataType(int byteSize) { this.byteSize = byteSize; }
+
+  /** Bytes per element; 0 for variable-size BYTES. */
+  public int byteSize() { return byteSize; }
+}

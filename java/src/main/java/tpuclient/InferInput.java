@@ -1,0 +1,140 @@
+// An input tensor for an inference request (parity: reference
+// triton/client/InferInput.java): typed setters serialize into the
+// binary protocol's little-endian layout, BYTES tensors are 4-byte-LE
+// length-prefixed, and setSharedMemory routes through a registered
+// region (system shm or the TPU HBM arena).
+package tpuclient;
+
+import java.nio.ByteBuffer;
+import java.nio.ByteOrder;
+import java.nio.charset.StandardCharsets;
+import java.util.LinkedHashMap;
+import java.util.Map;
+
+public class InferInput {
+  private final String name;
+  private final long[] shape;
+  private final DataType dataType;
+  private byte[] data;
+  private String sharedMemoryRegion;
+  private long sharedMemoryByteSize;
+  private long sharedMemoryOffset;
+
+  public InferInput(String name, long[] shape, DataType dataType) {
+    this.name = name;
+    this.shape = shape.clone();
+    this.dataType = dataType;
+  }
+
+  public String getName() { return name; }
+
+  public long[] getShape() { return shape.clone(); }
+
+  public DataType getDataType() { return dataType; }
+
+  /** Raw binary payload for the binary protocol, or null if in shm. */
+  public byte[] getData() { return data; }
+
+  public boolean isSharedMemory() { return sharedMemoryRegion != null; }
+
+  public void setSharedMemory(String regionName, long byteSize, long offset) {
+    this.sharedMemoryRegion = regionName;
+    this.sharedMemoryByteSize = byteSize;
+    this.sharedMemoryOffset = offset;
+    this.data = null;
+  }
+
+  private ByteBuffer allocate(int elements, int elementSize) {
+    return ByteBuffer.allocate(elements * elementSize)
+        .order(ByteOrder.LITTLE_ENDIAN);
+  }
+
+  public void setData(int[] values) throws InferenceException {
+    requireType(DataType.INT32, DataType.UINT32);
+    ByteBuffer buffer = allocate(values.length, 4);
+    for (int v : values) buffer.putInt(v);
+    data = buffer.array();
+  }
+
+  public void setData(long[] values) throws InferenceException {
+    requireType(DataType.INT64, DataType.UINT64);
+    ByteBuffer buffer = allocate(values.length, 8);
+    for (long v : values) buffer.putLong(v);
+    data = buffer.array();
+  }
+
+  public void setData(float[] values) throws InferenceException {
+    requireType(DataType.FP32);
+    ByteBuffer buffer = allocate(values.length, 4);
+    for (float v : values) buffer.putFloat(v);
+    data = buffer.array();
+  }
+
+  public void setData(double[] values) throws InferenceException {
+    requireType(DataType.FP64);
+    ByteBuffer buffer = allocate(values.length, 8);
+    for (double v : values) buffer.putDouble(v);
+    data = buffer.array();
+  }
+
+  public void setData(boolean[] values) throws InferenceException {
+    requireType(DataType.BOOL);
+    byte[] out = new byte[values.length];
+    for (int i = 0; i < values.length; i++) {
+      out[i] = (byte) (values[i] ? 1 : 0);
+    }
+    data = out;
+  }
+
+  public void setData(byte[] rawBytes) {
+    data = rawBytes.clone();
+  }
+
+  /** BYTES tensor: each string serialized with a 4-byte LE prefix. */
+  public void setData(String[] values) throws InferenceException {
+    requireType(DataType.BYTES);
+    int total = 0;
+    byte[][] encoded = new byte[values.length][];
+    for (int i = 0; i < values.length; i++) {
+      encoded[i] = values[i].getBytes(StandardCharsets.UTF_8);
+      total += 4 + encoded[i].length;
+    }
+    ByteBuffer buffer =
+        ByteBuffer.allocate(total).order(ByteOrder.LITTLE_ENDIAN);
+    for (byte[] e : encoded) {
+      buffer.putInt(e.length);
+      buffer.put(e);
+    }
+    data = buffer.array();
+  }
+
+  private void requireType(DataType... allowed) throws InferenceException {
+    for (DataType t : allowed) {
+      if (dataType == t) return;
+    }
+    throw new InferenceException(
+        "input '" + name + "' has datatype " + dataType);
+  }
+
+  /** The "inputs" entry for the request's JSON header. */
+  Map<String, Object> toJsonEntry() {
+    Map<String, Object> entry = new LinkedHashMap<>();
+    entry.put("name", name);
+    java.util.List<Object> dims = new java.util.ArrayList<>();
+    for (long d : shape) dims.add(d);
+    entry.put("shape", dims);
+    entry.put("datatype", dataType.name());
+    Map<String, Object> parameters = new LinkedHashMap<>();
+    if (isSharedMemory()) {
+      parameters.put("shared_memory_region", sharedMemoryRegion);
+      parameters.put("shared_memory_byte_size", sharedMemoryByteSize);
+      if (sharedMemoryOffset != 0) {
+        parameters.put("shared_memory_offset", sharedMemoryOffset);
+      }
+    } else {
+      parameters.put("binary_data_size", data == null ? 0 : data.length);
+    }
+    entry.put("parameters", parameters);
+    return entry;
+  }
+}

@@ -1,0 +1,56 @@
+// A requested output tensor (parity: reference
+// triton/client/InferRequestedOutput.java).
+package tpuclient;
+
+import java.util.LinkedHashMap;
+import java.util.Map;
+
+public class InferRequestedOutput {
+  private final String name;
+  private final boolean binaryData;
+  private final int classCount;
+  private String sharedMemoryRegion;
+  private long sharedMemoryByteSize;
+  private long sharedMemoryOffset;
+
+  public InferRequestedOutput(String name) { this(name, true, 0); }
+
+  public InferRequestedOutput(String name, boolean binaryData) {
+    this(name, binaryData, 0);
+  }
+
+  public InferRequestedOutput(String name, boolean binaryData,
+                              int classCount) {
+    this.name = name;
+    this.binaryData = binaryData;
+    this.classCount = classCount;
+  }
+
+  public String getName() { return name; }
+
+  public void setSharedMemory(String regionName, long byteSize, long offset) {
+    this.sharedMemoryRegion = regionName;
+    this.sharedMemoryByteSize = byteSize;
+    this.sharedMemoryOffset = offset;
+  }
+
+  Map<String, Object> toJsonEntry() {
+    Map<String, Object> entry = new LinkedHashMap<>();
+    entry.put("name", name);
+    Map<String, Object> parameters = new LinkedHashMap<>();
+    if (sharedMemoryRegion != null) {
+      parameters.put("shared_memory_region", sharedMemoryRegion);
+      parameters.put("shared_memory_byte_size", sharedMemoryByteSize);
+      if (sharedMemoryOffset != 0) {
+        parameters.put("shared_memory_offset", sharedMemoryOffset);
+      }
+    } else {
+      parameters.put("binary_data", binaryData);
+    }
+    if (classCount > 0) {
+      parameters.put("classification", classCount);
+    }
+    entry.put("parameters", parameters);
+    return entry;
+  }
+}

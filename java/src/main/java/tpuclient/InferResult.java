@@ -1,0 +1,153 @@
+// Parsed inference response: JSON header + trailing binary segments
+// (parity: reference triton/client/InferResult.java +
+// BinaryProtocol.java).
+package tpuclient;
+
+import java.nio.ByteBuffer;
+import java.nio.ByteOrder;
+import java.nio.charset.StandardCharsets;
+import java.util.ArrayList;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+
+public class InferResult {
+  private final Map<String, Object> header;
+  private final Map<String, byte[]> binaryOutputs = new LinkedHashMap<>();
+  private final Map<String, Map<String, Object>> outputEntries =
+      new LinkedHashMap<>();
+
+  @SuppressWarnings("unchecked")
+  InferResult(byte[] body, int headerLength) throws InferenceException {
+    int jsonEnd = headerLength > 0 ? headerLength : body.length;
+    if (jsonEnd > body.length) {
+      throw new InferenceException("response header length exceeds body");
+    }
+    header = Json.parseObject(
+        new String(body, 0, jsonEnd, StandardCharsets.UTF_8));
+    int offset = jsonEnd;
+    Object outputs = header.get("outputs");
+    if (outputs instanceof List) {
+      for (Object entryObj : (List<Object>) outputs) {
+        Map<String, Object> entry = (Map<String, Object>) entryObj;
+        String name = (String) entry.get("name");
+        outputEntries.put(name, entry);
+        Object params = entry.get("parameters");
+        if (params instanceof Map) {
+          Object sizeObj = ((Map<String, Object>) params)
+              .get("binary_data_size");
+          if (sizeObj instanceof Number) {
+            int size = ((Number) sizeObj).intValue();
+            if (offset + size > body.length) {
+              throw new InferenceException(
+                  "binary output '" + name + "' truncated");
+            }
+            byte[] raw = new byte[size];
+            System.arraycopy(body, offset, raw, 0, size);
+            binaryOutputs.put(name, raw);
+            offset += size;
+          }
+        }
+      }
+    }
+  }
+
+  public String getModelName() {
+    Object name = header.get("model_name");
+    return name == null ? "" : name.toString();
+  }
+
+  public String getId() {
+    Object id = header.get("id");
+    return id == null ? "" : id.toString();
+  }
+
+  @SuppressWarnings("unchecked")
+  public long[] getShape(String outputName) throws InferenceException {
+    Map<String, Object> entry = requireOutput(outputName);
+    List<Object> dims = (List<Object>) entry.get("shape");
+    long[] shape = new long[dims.size()];
+    for (int i = 0; i < shape.length; i++) {
+      shape[i] = ((Number) dims.get(i)).longValue();
+    }
+    return shape;
+  }
+
+  public DataType getDataType(String outputName) throws InferenceException {
+    Map<String, Object> entry = requireOutput(outputName);
+    return DataType.valueOf(entry.get("datatype").toString());
+  }
+
+  /** Raw little-endian bytes of a binary output. */
+  public byte[] getOutputData(String outputName) throws InferenceException {
+    byte[] raw = binaryOutputs.get(outputName);
+    if (raw == null) {
+      throw new InferenceException(
+          "output '" + outputName + "' has no binary data");
+    }
+    return raw;
+  }
+
+  public int[] getOutputAsInt(String outputName) throws InferenceException {
+    ByteBuffer buffer = bufferFor(outputName);
+    int[] out = new int[buffer.remaining() / 4];
+    buffer.asIntBuffer().get(out);
+    return out;
+  }
+
+  public long[] getOutputAsLong(String outputName) throws InferenceException {
+    ByteBuffer buffer = bufferFor(outputName);
+    long[] out = new long[buffer.remaining() / 8];
+    buffer.asLongBuffer().get(out);
+    return out;
+  }
+
+  public float[] getOutputAsFloat(String outputName)
+      throws InferenceException {
+    ByteBuffer buffer = bufferFor(outputName);
+    float[] out = new float[buffer.remaining() / 4];
+    buffer.asFloatBuffer().get(out);
+    return out;
+  }
+
+  public double[] getOutputAsDouble(String outputName)
+      throws InferenceException {
+    ByteBuffer buffer = bufferFor(outputName);
+    double[] out = new double[buffer.remaining() / 8];
+    buffer.asDoubleBuffer().get(out);
+    return out;
+  }
+
+  /** BYTES tensor decode: 4-byte-LE length-prefixed strings. */
+  public List<String> getOutputAsStrings(String outputName)
+      throws InferenceException {
+    byte[] raw = getOutputData(outputName);
+    ByteBuffer buffer = ByteBuffer.wrap(raw).order(ByteOrder.LITTLE_ENDIAN);
+    List<String> out = new ArrayList<>();
+    while (buffer.remaining() >= 4) {
+      int len = buffer.getInt();
+      if (len < 0 || len > buffer.remaining()) {
+        throw new InferenceException("malformed BYTES tensor");
+      }
+      byte[] s = new byte[len];
+      buffer.get(s);
+      out.add(new String(s, StandardCharsets.UTF_8));
+    }
+    return out;
+  }
+
+  private ByteBuffer bufferFor(String outputName) throws InferenceException {
+    return ByteBuffer.wrap(getOutputData(outputName))
+        .order(ByteOrder.LITTLE_ENDIAN);
+  }
+
+  private Map<String, Object> requireOutput(String outputName)
+      throws InferenceException {
+    Map<String, Object> entry = outputEntries.get(outputName);
+    if (entry == null) {
+      throw new InferenceException(
+          "response has no output '" + outputName + "'");
+    }
+    return entry;
+  }
+}

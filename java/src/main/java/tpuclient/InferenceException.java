@@ -1,0 +1,11 @@
+// Error raised by every client API (parity: reference
+// triton/client/InferenceException.java).
+package tpuclient;
+
+public class InferenceException extends Exception {
+  public InferenceException(String message) { super(message); }
+
+  public InferenceException(String message, Throwable cause) {
+    super(message, cause);
+  }
+}

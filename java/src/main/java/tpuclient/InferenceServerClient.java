@@ -1,0 +1,279 @@
+// HTTP/REST (KServe-v2) client for the TPU inference server (parity:
+// the reference Java client, triton/client/InferenceServerClient.java
+// — HTTP-only, binary tensor protocol, sync + CompletableFuture
+// async, health/metadata/model-control/shared-memory verbs). Built on
+// java.net.http (JDK 11+), no third-party dependencies; the CUDA shm
+// verbs are replaced by TPU HBM arena verbs carrying the serialized
+// region descriptor.
+package tpuclient;
+
+import java.io.IOException;
+import java.net.URI;
+import java.net.http.HttpClient;
+import java.net.http.HttpRequest;
+import java.net.http.HttpResponse;
+import java.nio.ByteBuffer;
+import java.nio.charset.StandardCharsets;
+import java.time.Duration;
+import java.util.ArrayList;
+import java.util.Base64;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+import java.util.concurrent.CompletableFuture;
+
+public class InferenceServerClient implements AutoCloseable {
+  private final String baseUrl;
+  private final HttpClient http;
+  private final Duration requestTimeout;
+
+  /** url is "host:port" (no scheme), like the reference. */
+  public InferenceServerClient(String url) {
+    this(url, Duration.ofSeconds(30), Duration.ofSeconds(60));
+  }
+
+  public InferenceServerClient(String url, Duration connectTimeout,
+                               Duration requestTimeout) {
+    this.baseUrl = "http://" + url;
+    this.requestTimeout = requestTimeout;
+    this.http = HttpClient.newBuilder()
+        .version(HttpClient.Version.HTTP_1_1)
+        .connectTimeout(connectTimeout)
+        .build();
+  }
+
+  @Override
+  public void close() {}
+
+  // -- health / metadata -------------------------------------------------
+
+  public boolean isServerLive() throws InferenceException {
+    return getStatus("/v2/health/live") == 200;
+  }
+
+  public boolean isServerReady() throws InferenceException {
+    return getStatus("/v2/health/ready") == 200;
+  }
+
+  public boolean isModelReady(String modelName) throws InferenceException {
+    return getStatus("/v2/models/" + modelName + "/ready") == 200;
+  }
+
+  public Map<String, Object> getServerMetadata() throws InferenceException {
+    return Json.parseObject(get("/v2"));
+  }
+
+  public Map<String, Object> getModelMetadata(String modelName)
+      throws InferenceException {
+    return Json.parseObject(get("/v2/models/" + modelName));
+  }
+
+  public Map<String, Object> getModelConfig(String modelName)
+      throws InferenceException {
+    return Json.parseObject(get("/v2/models/" + modelName + "/config"));
+  }
+
+  public Map<String, Object> getInferenceStatistics(String modelName)
+      throws InferenceException {
+    return Json.parseObject(get("/v2/models/" + modelName + "/stats"));
+  }
+
+  // -- model control ----------------------------------------------------
+
+  public void loadModel(String modelName) throws InferenceException {
+    post("/v2/repository/models/" + modelName + "/load", "{}");
+  }
+
+  public void unloadModel(String modelName) throws InferenceException {
+    post("/v2/repository/models/" + modelName + "/unload", "{}");
+  }
+
+  // -- shared memory ----------------------------------------------------
+
+  public void registerSystemSharedMemory(String name, String key,
+                                         long byteSize)
+      throws InferenceException {
+    Map<String, Object> body = new LinkedHashMap<>();
+    body.put("key", key);
+    body.put("offset", 0);
+    body.put("byte_size", byteSize);
+    post("/v2/systemsharedmemory/region/" + name + "/register",
+         Json.write(body));
+  }
+
+  public void unregisterSystemSharedMemory(String name)
+      throws InferenceException {
+    String path = name.isEmpty()
+        ? "/v2/systemsharedmemory/unregister"
+        : "/v2/systemsharedmemory/region/" + name + "/unregister";
+    post(path, "{}");
+  }
+
+  /**
+   * Registers a TPU HBM arena region (the slot the reference fills
+   * with a base64 cudaIpcMemHandle_t; here rawHandle is the arena's
+   * serialized region descriptor).
+   */
+  public void registerTpuSharedMemory(String name, byte[] rawHandle,
+                                      long deviceId, long byteSize)
+      throws InferenceException {
+    Map<String, Object> handle = new LinkedHashMap<>();
+    handle.put("b64", Base64.getEncoder().encodeToString(rawHandle));
+    Map<String, Object> body = new LinkedHashMap<>();
+    body.put("raw_handle", handle);
+    body.put("device_id", deviceId);
+    body.put("byte_size", byteSize);
+    post("/v2/tpusharedmemory/region/" + name + "/register",
+         Json.write(body));
+  }
+
+  public void unregisterTpuSharedMemory(String name)
+      throws InferenceException {
+    String path = name.isEmpty()
+        ? "/v2/tpusharedmemory/unregister"
+        : "/v2/tpusharedmemory/region/" + name + "/unregister";
+    post(path, "{}");
+  }
+
+  // -- inference --------------------------------------------------------
+
+  public InferResult infer(String modelName, List<InferInput> inputs,
+                           List<InferRequestedOutput> outputs)
+      throws InferenceException {
+    HttpRequest request = buildInferRequest(modelName, inputs, outputs);
+    try {
+      HttpResponse<byte[]> response =
+          http.send(request, HttpResponse.BodyHandlers.ofByteArray());
+      return parseInferResponse(response);
+    } catch (IOException | InterruptedException e) {
+      throw new InferenceException("infer request failed", e);
+    }
+  }
+
+  /** Async variant resolved on the HttpClient's executor. */
+  public CompletableFuture<InferResult> asyncInfer(
+      String modelName, List<InferInput> inputs,
+      List<InferRequestedOutput> outputs) throws InferenceException {
+    HttpRequest request = buildInferRequest(modelName, inputs, outputs);
+    return http.sendAsync(request, HttpResponse.BodyHandlers.ofByteArray())
+        .thenApply(response -> {
+          try {
+            return parseInferResponse(response);
+          } catch (InferenceException e) {
+            throw new RuntimeException(e);
+          }
+        });
+  }
+
+  private HttpRequest buildInferRequest(
+      String modelName, List<InferInput> inputs,
+      List<InferRequestedOutput> outputs) throws InferenceException {
+    Map<String, Object> header = new LinkedHashMap<>();
+    List<Object> inputEntries = new ArrayList<>();
+    List<byte[]> binarySegments = new ArrayList<>();
+    for (InferInput input : inputs) {
+      inputEntries.add(input.toJsonEntry());
+      if (!input.isSharedMemory()) {
+        byte[] data = input.getData();
+        if (data == null) {
+          throw new InferenceException(
+              "input '" + input.getName() + "' has no data");
+        }
+        binarySegments.add(data);
+      }
+    }
+    header.put("inputs", inputEntries);
+    if (outputs != null && !outputs.isEmpty()) {
+      List<Object> outputEntries = new ArrayList<>();
+      for (InferRequestedOutput output : outputs) {
+        outputEntries.add(output.toJsonEntry());
+      }
+      header.put("outputs", outputEntries);
+    }
+
+    byte[] headerBytes = Json.write(header).getBytes(StandardCharsets.UTF_8);
+    int total = headerBytes.length;
+    for (byte[] segment : binarySegments) total += segment.length;
+    ByteBuffer body = ByteBuffer.allocate(total);
+    body.put(headerBytes);
+    for (byte[] segment : binarySegments) body.put(segment);
+
+    return HttpRequest.newBuilder()
+        .uri(URI.create(baseUrl + "/v2/models/" + modelName + "/infer"))
+        .timeout(requestTimeout)
+        .header("Content-Type", "application/octet-stream")
+        .header("Inference-Header-Content-Length",
+                Integer.toString(headerBytes.length))
+        .POST(HttpRequest.BodyPublishers.ofByteArray(body.array()))
+        .build();
+  }
+
+  private InferResult parseInferResponse(HttpResponse<byte[]> response)
+      throws InferenceException {
+    if (response.statusCode() != 200) {
+      throw new InferenceException(
+          "HTTP " + response.statusCode() + ": "
+          + new String(response.body(), StandardCharsets.UTF_8));
+    }
+    int headerLength = response.headers()
+        .firstValue("Inference-Header-Content-Length")
+        .map(Integer::parseInt)
+        .orElse(0);
+    return new InferResult(response.body(), headerLength);
+  }
+
+  // -- transport helpers -------------------------------------------------
+
+  private int getStatus(String path) throws InferenceException {
+    try {
+      HttpRequest request = HttpRequest.newBuilder()
+          .uri(URI.create(baseUrl + path))
+          .timeout(requestTimeout)
+          .GET()
+          .build();
+      return http.send(request, HttpResponse.BodyHandlers.discarding())
+          .statusCode();
+    } catch (IOException | InterruptedException e) {
+      throw new InferenceException("request failed: " + path, e);
+    }
+  }
+
+  private String get(String path) throws InferenceException {
+    try {
+      HttpRequest request = HttpRequest.newBuilder()
+          .uri(URI.create(baseUrl + path))
+          .timeout(requestTimeout)
+          .GET()
+          .build();
+      HttpResponse<String> response =
+          http.send(request, HttpResponse.BodyHandlers.ofString());
+      if (response.statusCode() != 200) {
+        throw new InferenceException(
+            "HTTP " + response.statusCode() + ": " + response.body());
+      }
+      return response.body();
+    } catch (IOException | InterruptedException e) {
+      throw new InferenceException("request failed: " + path, e);
+    }
+  }
+
+  private String post(String path, String body) throws InferenceException {
+    try {
+      HttpRequest request = HttpRequest.newBuilder()
+          .uri(URI.create(baseUrl + path))
+          .timeout(requestTimeout)
+          .header("Content-Type", "application/json")
+          .POST(HttpRequest.BodyPublishers.ofString(body))
+          .build();
+      HttpResponse<String> response =
+          http.send(request, HttpResponse.BodyHandlers.ofString());
+      if (response.statusCode() != 200) {
+        throw new InferenceException(
+            "HTTP " + response.statusCode() + ": " + response.body());
+      }
+      return response.body();
+    } catch (IOException | InterruptedException e) {
+      throw new InferenceException("request failed: " + path, e);
+    }
+  }
+}

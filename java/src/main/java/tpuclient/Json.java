@@ -1,0 +1,232 @@
+// Minimal JSON reader/writer so the client has zero third-party
+// dependencies (the reference Java client pulls Jackson; this image's
+// build environment is offline, so the subset of JSON the KServe-v2
+// protocol needs — objects, arrays, strings, numbers, booleans — is
+// implemented here).
+package tpuclient;
+
+import java.util.ArrayList;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+
+public final class Json {
+
+  private Json() {}
+
+  /** Parses a JSON document into Map/List/String/Double/Boolean/null. */
+  public static Object parse(String text) throws InferenceException {
+    Parser parser = new Parser(text);
+    Object value = parser.parseValue();
+    parser.skipWhitespace();
+    if (!parser.atEnd()) {
+      throw new InferenceException("trailing JSON content");
+    }
+    return value;
+  }
+
+  @SuppressWarnings("unchecked")
+  public static Map<String, Object> parseObject(String text)
+      throws InferenceException {
+    Object value = parse(text);
+    if (!(value instanceof Map)) {
+      throw new InferenceException("expected JSON object");
+    }
+    return (Map<String, Object>) value;
+  }
+
+  /** Serializes Map/List/String/Number/Boolean/null back to JSON. */
+  public static String write(Object value) {
+    StringBuilder sb = new StringBuilder();
+    writeValue(value, sb);
+    return sb.toString();
+  }
+
+  private static void writeValue(Object value, StringBuilder sb) {
+    if (value == null) {
+      sb.append("null");
+    } else if (value instanceof String) {
+      writeString((String) value, sb);
+    } else if (value instanceof Boolean) {
+      sb.append(value.toString());
+    } else if (value instanceof Double || value instanceof Float) {
+      double d = ((Number) value).doubleValue();
+      if (d == Math.floor(d) && !Double.isInfinite(d)) {
+        sb.append((long) d);
+      } else {
+        sb.append(d);
+      }
+    } else if (value instanceof Number) {
+      sb.append(value.toString());
+    } else if (value instanceof Map) {
+      sb.append('{');
+      boolean first = true;
+      for (Map.Entry<?, ?> e : ((Map<?, ?>) value).entrySet()) {
+        if (!first) sb.append(',');
+        first = false;
+        writeString(e.getKey().toString(), sb);
+        sb.append(':');
+        writeValue(e.getValue(), sb);
+      }
+      sb.append('}');
+    } else if (value instanceof List) {
+      sb.append('[');
+      boolean first = true;
+      for (Object item : (List<?>) value) {
+        if (!first) sb.append(',');
+        first = false;
+        writeValue(item, sb);
+      }
+      sb.append(']');
+    } else {
+      writeString(value.toString(), sb);
+    }
+  }
+
+  private static void writeString(String s, StringBuilder sb) {
+    sb.append('"');
+    for (int i = 0; i < s.length(); i++) {
+      char c = s.charAt(i);
+      switch (c) {
+        case '"': sb.append("\\\""); break;
+        case '\\': sb.append("\\\\"); break;
+        case '\n': sb.append("\\n"); break;
+        case '\r': sb.append("\\r"); break;
+        case '\t': sb.append("\\t"); break;
+        default:
+          if (c < 0x20) {
+            sb.append(String.format("\\u%04x", (int) c));
+          } else {
+            sb.append(c);
+          }
+      }
+    }
+    sb.append('"');
+  }
+
+  private static final class Parser {
+    private final String text;
+    private int pos = 0;
+
+    Parser(String text) { this.text = text; }
+
+    boolean atEnd() { return pos >= text.length(); }
+
+    void skipWhitespace() {
+      while (pos < text.length() && Character.isWhitespace(text.charAt(pos))) {
+        pos++;
+      }
+    }
+
+    Object parseValue() throws InferenceException {
+      skipWhitespace();
+      if (atEnd()) throw new InferenceException("unexpected end of JSON");
+      char c = text.charAt(pos);
+      switch (c) {
+        case '{': return parseObjectBody();
+        case '[': return parseArrayBody();
+        case '"': return parseString();
+        case 't': expect("true"); return Boolean.TRUE;
+        case 'f': expect("false"); return Boolean.FALSE;
+        case 'n': expect("null"); return null;
+        default: return parseNumber();
+      }
+    }
+
+    private void expect(String literal) throws InferenceException {
+      if (!text.startsWith(literal, pos)) {
+        throw new InferenceException("bad JSON literal at " + pos);
+      }
+      pos += literal.length();
+    }
+
+    private Map<String, Object> parseObjectBody() throws InferenceException {
+      Map<String, Object> map = new LinkedHashMap<>();
+      pos++;  // '{'
+      skipWhitespace();
+      if (!atEnd() && text.charAt(pos) == '}') { pos++; return map; }
+      while (true) {
+        skipWhitespace();
+        String key = parseString();
+        skipWhitespace();
+        if (atEnd() || text.charAt(pos) != ':') {
+          throw new InferenceException("expected ':' at " + pos);
+        }
+        pos++;
+        map.put(key, parseValue());
+        skipWhitespace();
+        if (atEnd()) throw new InferenceException("unterminated object");
+        char c = text.charAt(pos++);
+        if (c == '}') return map;
+        if (c != ',') throw new InferenceException("expected ',' at " + pos);
+      }
+    }
+
+    private List<Object> parseArrayBody() throws InferenceException {
+      List<Object> list = new ArrayList<>();
+      pos++;  // '['
+      skipWhitespace();
+      if (!atEnd() && text.charAt(pos) == ']') { pos++; return list; }
+      while (true) {
+        list.add(parseValue());
+        skipWhitespace();
+        if (atEnd()) throw new InferenceException("unterminated array");
+        char c = text.charAt(pos++);
+        if (c == ']') return list;
+        if (c != ',') throw new InferenceException("expected ',' at " + pos);
+      }
+    }
+
+    private String parseString() throws InferenceException {
+      if (atEnd() || text.charAt(pos) != '"') {
+        throw new InferenceException("expected string at " + pos);
+      }
+      pos++;
+      StringBuilder sb = new StringBuilder();
+      while (true) {
+        if (atEnd()) throw new InferenceException("unterminated string");
+        char c = text.charAt(pos++);
+        if (c == '"') return sb.toString();
+        if (c == '\\') {
+          if (atEnd()) throw new InferenceException("bad escape");
+          char e = text.charAt(pos++);
+          switch (e) {
+            case '"': sb.append('"'); break;
+            case '\\': sb.append('\\'); break;
+            case '/': sb.append('/'); break;
+            case 'b': sb.append('\b'); break;
+            case 'f': sb.append('\f'); break;
+            case 'n': sb.append('\n'); break;
+            case 'r': sb.append('\r'); break;
+            case 't': sb.append('\t'); break;
+            case 'u':
+              if (pos + 4 > text.length()) {
+                throw new InferenceException("bad unicode escape");
+              }
+              sb.append((char) Integer.parseInt(
+                  text.substring(pos, pos + 4), 16));
+              pos += 4;
+              break;
+            default:
+              throw new InferenceException("bad escape '\\" + e + "'");
+          }
+        } else {
+          sb.append(c);
+        }
+      }
+    }
+
+    private Double parseNumber() throws InferenceException {
+      int start = pos;
+      while (pos < text.length()
+          && "+-0123456789.eE".indexOf(text.charAt(pos)) >= 0) {
+        pos++;
+      }
+      try {
+        return Double.parseDouble(text.substring(start, pos));
+      } catch (NumberFormatException e) {
+        throw new InferenceException("bad JSON number at " + start);
+      }
+    }
+  }
+}

@@ -1,0 +1,53 @@
+// Minimal sync inference against the `simple` add/sub model (parity
+// example: reference triton/client/examples/SimpleInferClient.java).
+//
+// Usage: java tpuclient.examples.SimpleInferClient [host:port]
+package tpuclient.examples;
+
+import java.util.List;
+import tpuclient.DataType;
+import tpuclient.InferInput;
+import tpuclient.InferRequestedOutput;
+import tpuclient.InferResult;
+import tpuclient.InferenceServerClient;
+
+public class SimpleInferClient {
+  public static void main(String[] args) throws Exception {
+    String url = args.length > 0 ? args[0] : "localhost:8000";
+    try (InferenceServerClient client = new InferenceServerClient(url)) {
+      if (!client.isServerLive()) {
+        System.err.println("server is not live");
+        System.exit(1);
+      }
+
+      int[] in0 = new int[16];
+      int[] in1 = new int[16];
+      for (int i = 0; i < 16; i++) {
+        in0[i] = i;
+        in1[i] = 1;
+      }
+      InferInput input0 =
+          new InferInput("INPUT0", new long[] {16}, DataType.INT32);
+      InferInput input1 =
+          new InferInput("INPUT1", new long[] {16}, DataType.INT32);
+      input0.setData(in0);
+      input1.setData(in1);
+
+      InferResult result = client.infer(
+          "simple", List.of(input0, input1),
+          List.of(new InferRequestedOutput("OUTPUT0"),
+                  new InferRequestedOutput("OUTPUT1")));
+
+      int[] sum = result.getOutputAsInt("OUTPUT0");
+      int[] diff = result.getOutputAsInt("OUTPUT1");
+      for (int i = 0; i < 16; i++) {
+        System.out.println(in0[i] + " + " + in1[i] + " = " + sum[i]);
+        if (sum[i] != in0[i] + in1[i] || diff[i] != in0[i] - in1[i]) {
+          System.err.println("mismatch at " + i);
+          System.exit(1);
+        }
+      }
+      System.out.println("PASS: infer");
+    }
+  }
+}

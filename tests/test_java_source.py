@@ -1,0 +1,91 @@
+"""Sanity checks for the Java client sources (this image ships no JDK;
+when `javac` is present the whole tree must compile — parity: the
+reference's maven-built src/java)."""
+
+import pathlib
+import shutil
+import subprocess
+
+import pytest
+
+JAVA_ROOT = (
+    pathlib.Path(__file__).resolve().parent.parent / "java" / "src" / "main"
+    / "java"
+)
+
+
+def _sources():
+    return sorted(JAVA_ROOT.rglob("*.java"))
+
+
+def test_sources_exist():
+    names = {p.name for p in _sources()}
+    assert {
+        "InferenceServerClient.java", "InferInput.java",
+        "InferRequestedOutput.java", "InferResult.java", "DataType.java",
+        "InferenceException.java", "Json.java", "SimpleInferClient.java",
+    } <= names
+
+
+@pytest.mark.parametrize("path", _sources(), ids=lambda p: p.name)
+def test_source_well_formed(path):
+    text = path.read_text()
+    # Balanced braces/parens outside of strings & comments.
+    depth_brace = depth_paren = 0
+    in_string = in_char = in_line_comment = in_block_comment = False
+    prev = ""
+    for ch in text:
+        if in_line_comment:
+            if ch == "\n":
+                in_line_comment = False
+        elif in_block_comment:
+            if prev == "*" and ch == "/":
+                in_block_comment = False
+        elif in_string:
+            if ch == '"' and prev != "\\":
+                in_string = False
+        elif in_char:
+            if ch == "'" and prev != "\\":
+                in_char = False
+        elif prev == "/" and ch == "/":
+            in_line_comment = True
+        elif prev == "/" and ch == "*":
+            in_block_comment = True
+        elif ch == '"':
+            in_string = True
+        elif ch == "'":
+            in_char = True
+        elif ch == "{":
+            depth_brace += 1
+        elif ch == "}":
+            depth_brace -= 1
+        elif ch == "(":
+            depth_paren += 1
+        elif ch == ")":
+            depth_paren -= 1
+        prev = "" if (prev == "\\" and ch == "\\") else ch
+    assert depth_brace == 0, "unbalanced braces in %s" % path.name
+    assert depth_paren == 0, "unbalanced parens in %s" % path.name
+    assert "package tpuclient" in text
+
+
+def test_client_api_surface():
+    text = (JAVA_ROOT / "tpuclient" / "InferenceServerClient.java").read_text()
+    for method in (
+        "isServerLive", "isServerReady", "isModelReady", "getServerMetadata",
+        "getModelMetadata", "getModelConfig", "getInferenceStatistics",
+        "loadModel", "unloadModel", "registerSystemSharedMemory",
+        "registerTpuSharedMemory", "infer", "asyncInfer",
+    ):
+        assert method in text, "missing method %s" % method
+
+
+def test_compiles_if_jdk_available(tmp_path):
+    javac = shutil.which("javac")
+    if javac is None:
+        pytest.skip("no JDK in this image")
+    proc = subprocess.run(
+        [javac, "-d", str(tmp_path)] + [str(p) for p in _sources()],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr
