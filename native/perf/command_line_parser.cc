@@ -55,6 +55,9 @@ enum LongOpt {
   kOptMaxThreads,
   kOptPercentile,
   kOptServiceKind,
+  kOptCollectMetrics,
+  kOptMetricsUrl,
+  kOptMetricsInterval,
 };
 
 const struct option kLongOptions[] = {
@@ -100,6 +103,9 @@ const struct option kLongOptions[] = {
     {"max-threads", required_argument, nullptr, kOptMaxThreads},
     {"percentile", required_argument, nullptr, kOptPercentile},
     {"service-kind", required_argument, nullptr, kOptServiceKind},
+    {"collect-metrics", no_argument, nullptr, kOptCollectMetrics},
+    {"metrics-url", required_argument, nullptr, kOptMetricsUrl},
+    {"metrics-interval", required_argument, nullptr, kOptMetricsInterval},
     {nullptr, 0, nullptr, 0},
 };
 
@@ -124,6 +130,8 @@ void CLParser::Usage(const char* program) {
       "  --output-shared-memory-size N, --tpu-arena-url host:port\n"
       "Sequences: --sequence-length N, --sequence-length-variation pct,\n"
       "  --sequence-id-range start[:end]\n"
+      "Metrics: --collect-metrics [--metrics-url host:port/metrics]\n"
+      "  [--metrics-interval ms]\n"
       "Output: -f <csv>, --profile-export-file <json>, -v\n",
       program);
 }
@@ -215,6 +223,11 @@ Error CLParser::Parse(
       case kOptAsync: params->async_mode = true; break;
       case kOptMaxThreads: params->max_threads = atoll(optarg); break;
       case kOptPercentile: params->percentile = atoi(optarg); break;
+      case kOptCollectMetrics: params->collect_metrics = true; break;
+      case kOptMetricsUrl: params->metrics_url = optarg; break;
+      case kOptMetricsInterval:
+        params->metrics_interval_ms = atoll(optarg);
+        break;
       case kOptServiceKind:
         if (std::string(optarg) != "triton") {
           return Error("only --service-kind triton is supported natively; "

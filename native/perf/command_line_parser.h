@@ -64,6 +64,11 @@ struct PerfAnalyzerParameters {
   // Output files.
   std::string latency_report_file;
   std::string profile_export_file;
+
+  // Server metrics scraping.
+  bool collect_metrics = false;
+  std::string metrics_url;  // defaults to http://<url host>:8000/metrics
+  uint64_t metrics_interval_ms = 1000;
 };
 
 class CLParser {

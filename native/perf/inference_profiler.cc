@@ -113,6 +113,7 @@ Error InferenceProfiler::ProfileLevel(PerfStatus* merged) {
 
 Error InferenceProfiler::Measure(PerfStatus* status) {
   manager_->SwapRequestRecords();  // discard warm-up residue
+  if (metrics_ != nullptr) metrics_->GetAndReset();  // drop stale scrapes
   uint64_t start_ns = NowNs();
   if (config_.count_windows) {
     uint64_t deadline =
@@ -128,6 +129,9 @@ Error InferenceProfiler::Measure(PerfStatus* status) {
   }
   uint64_t end_ns = NowNs();
   Summarize(manager_->SwapRequestRecords(), start_ns, end_ns, status);
+  if (metrics_ != nullptr) {
+    status->tpu_metrics = SummarizeMetrics(metrics_->GetAndReset());
+  }
   if (stats_backend_ != nullptr && !model_name_.empty()) {
     // Best effort — a failed stats scrape never fails the window.
     stats_backend_->ModelStatisticsJson(&status->server_stats, model_name_);
@@ -218,6 +222,23 @@ PerfStatus InferenceProfiler::Merge(std::vector<PerfStatus>&& trials) const {
     }
   }
   merged.server_stats = trials.back().server_stats;
+  {
+    // Average the window averages; keep the overall max.
+    std::map<std::string, std::vector<std::pair<double, double>>> collected;
+    for (const auto& trial : trials) {
+      for (const auto& kv : trial.tpu_metrics) {
+        collected[kv.first].push_back(kv.second);
+      }
+    }
+    for (const auto& kv : collected) {
+      double sum = 0, max = 0;
+      for (const auto& window : kv.second) {
+        sum += window.first;
+        max = std::max(max, window.second);
+      }
+      merged.tpu_metrics[kv.first] = {sum / kv.second.size(), max};
+    }
+  }
   if (!latencies_us.empty()) {
     double sum = 0.0;
     for (double v : latencies_us) sum += v;

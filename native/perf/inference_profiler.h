@@ -12,6 +12,7 @@
 
 #include "../library/common.h"
 #include "load_manager.h"
+#include "metrics_manager.h"
 
 namespace tpuclient {
 namespace perf {
@@ -39,6 +40,8 @@ struct PerfStatus {
   // setup backend's cumulative stats when available).
   double avg_send_time_us = 0.0;
   double avg_receive_time_us = 0.0;
+  // Server accelerator gauges for the window: {family -> {avg, max}}.
+  TpuMetricsSummary tpu_metrics;
 };
 
 struct MeasurementConfig {
@@ -56,9 +59,12 @@ class InferenceProfiler {
   InferenceProfiler(
       LoadManager* manager, MeasurementConfig config,
       ClientBackend* stats_backend = nullptr, std::string model_name = "",
-      bool verbose = false)
+      bool verbose = false, MetricsManager* metrics = nullptr)
       : manager_(manager), config_(config), stats_backend_(stats_backend),
-        model_name_(std::move(model_name)), verbose_(verbose) {}
+        model_name_(std::move(model_name)), verbose_(verbose),
+        metrics_(metrics) {
+    if (metrics_ != nullptr) metrics_->Start();
+  }
 
   // Concurrency sweep: [start, end] by step; end==0 profiles only
   // `start`. Stops early when the latency threshold is exceeded.
@@ -89,6 +95,7 @@ class InferenceProfiler {
   ClientBackend* stats_backend_;
   std::string model_name_;
   bool verbose_;
+  MetricsManager* metrics_;
 };
 
 }  // namespace perf

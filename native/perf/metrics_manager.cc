@@ -1,0 +1,172 @@
+#include "metrics_manager.h"
+
+#include <algorithm>
+#include <cstring>
+
+#include "../library/http_transport.h"
+
+namespace tpuclient {
+namespace perf {
+
+namespace {
+
+const char* kFamilies[] = {
+    "tpu_hbm_used_bytes", "tpu_hbm_total_bytes", "tpu_hbm_utilization"};
+
+bool IsTrackedFamily(const std::string& name) {
+  for (const char* f : kFamilies) {
+    if (name == f) return true;
+  }
+  return false;
+}
+
+}  // namespace
+
+TpuMetrics ParsePrometheus(const std::string& text) {
+  TpuMetrics metrics;
+  size_t pos = 0;
+  while (pos < text.size()) {
+    size_t eol = text.find('\n', pos);
+    if (eol == std::string::npos) eol = text.size();
+    std::string line = text.substr(pos, eol - pos);
+    pos = eol + 1;
+    if (line.empty() || line[0] == '#') continue;
+    // name{labels} value   |   name value
+    size_t name_end = line.find_first_of("{ ");
+    if (name_end == std::string::npos) continue;
+    std::string name = line.substr(0, name_end);
+    if (!IsTrackedFamily(name)) continue;
+    std::string uuid = "0";
+    size_t value_start = name_end;
+    if (line[name_end] == '{') {
+      size_t close = line.find('}', name_end);
+      if (close == std::string::npos) continue;
+      std::string labels = line.substr(name_end + 1, close - name_end - 1);
+      for (const char* key : {"tpu_uuid=\"", "gpu_uuid=\""}) {
+        size_t at = labels.find(key);
+        if (at != std::string::npos) {
+          at += strlen(key);
+          size_t end = labels.find('"', at);
+          if (end != std::string::npos) uuid = labels.substr(at, end - at);
+          break;
+        }
+      }
+      value_start = close + 1;
+    }
+    while (value_start < line.size() && line[value_start] == ' ') {
+      value_start++;
+    }
+    char* end = nullptr;
+    double value = strtod(line.c_str() + value_start, &end);
+    if (end == line.c_str() + value_start) continue;
+    metrics.families[name][uuid] = value;
+  }
+  return metrics;
+}
+
+TpuMetricsSummary SummarizeMetrics(const std::vector<TpuMetrics>& snapshots) {
+  TpuMetricsSummary summary;
+  std::map<std::string, std::vector<double>> per_family;
+  for (const auto& snapshot : snapshots) {
+    for (const auto& family : snapshot.families) {
+      if (family.second.empty()) continue;
+      double sum = 0;
+      for (const auto& kv : family.second) sum += kv.second;
+      per_family[family.first].push_back(sum / family.second.size());
+    }
+  }
+  for (const auto& kv : per_family) {
+    double sum = 0, max = 0;
+    for (double v : kv.second) {
+      sum += v;
+      max = std::max(max, v);
+    }
+    summary[kv.first] = {sum / kv.second.size(), max};
+  }
+  return summary;
+}
+
+MetricsManager::MetricsManager(const std::string& url, uint64_t interval_ms)
+    : interval_ms_(interval_ms) {
+  std::string rest = url;
+  size_t scheme = rest.find("://");
+  if (scheme != std::string::npos) rest = rest.substr(scheme + 3);
+  size_t slash = rest.find('/');
+  if (slash != std::string::npos) {
+    path_ = rest.substr(slash);
+    rest = rest.substr(0, slash);
+  }
+  size_t colon = rest.rfind(':');
+  if (colon != std::string::npos) {
+    port_ = atoi(rest.substr(colon + 1).c_str());
+    host_ = rest.substr(0, colon);
+  } else {
+    host_ = rest;
+  }
+  if (path_ == "/") path_ = "/metrics";
+}
+
+MetricsManager::~MetricsManager() { Stop(); }
+
+Error MetricsManager::ScrapeOnce(TpuMetrics* metrics) {
+  HttpConnection conn(host_, port_);
+  HttpResponse response;
+  std::string err = conn.Request(
+      "GET", path_, {}, "", &response, 2 * 1000 * 1000);
+  if (!err.empty()) return Error(err);
+  if (response.status_code != 200) {
+    return Error("metrics endpoint returned HTTP " +
+                 std::to_string(response.status_code));
+  }
+  *metrics = ParsePrometheus(response.body);
+  return Error::Success;
+}
+
+Error MetricsManager::CheckReachable() {
+  TpuMetrics metrics;
+  return ScrapeOnce(&metrics);
+}
+
+void MetricsManager::Start() {
+  Stop();
+  {
+    std::lock_guard<std::mutex> lock(mutex_);
+    stopping_ = false;
+  }
+  poller_ = std::thread(&MetricsManager::PollLoop, this);
+}
+
+void MetricsManager::Stop() {
+  {
+    std::lock_guard<std::mutex> lock(mutex_);
+    stopping_ = true;
+  }
+  cv_.notify_all();
+  if (poller_.joinable()) poller_.join();
+}
+
+void MetricsManager::PollLoop() {
+  std::unique_lock<std::mutex> lock(mutex_);
+  while (!cv_.wait_for(lock, std::chrono::milliseconds(interval_ms_),
+                       [this] { return stopping_; })) {
+    lock.unlock();
+    TpuMetrics metrics;
+    Error err = ScrapeOnce(&metrics);
+    lock.lock();
+    if (err.IsOk()) {
+      snapshots_.push_back(std::move(metrics));
+    } else {
+      scrape_failures_++;
+    }
+  }
+}
+
+std::vector<TpuMetrics> MetricsManager::GetAndReset() {
+  std::lock_guard<std::mutex> lock(mutex_);
+  std::vector<TpuMetrics> out;
+  out.swap(snapshots_);
+  return out;
+}
+
+}  // namespace perf
+}  // namespace tpuclient

@@ -1,0 +1,71 @@
+// Server accelerator-metrics collection (parity:
+// /root/reference/src/c++/perf_analyzer/metrics_manager.h:56-82 —
+// a poller thread scrapes the server's Prometheus /metrics every
+// interval and the profiler pairs per-window summaries with its
+// measurements). The DCGM GPU gauges of the reference map to the TPU
+// server's HBM gauges: tpu_hbm_used_bytes / tpu_hbm_total_bytes /
+// tpu_hbm_utilization, labelled by tpu_uuid.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "../library/common.h"
+
+namespace tpuclient {
+namespace perf {
+
+// One scrape: {family -> {tpu_uuid -> value}}.
+struct TpuMetrics {
+  std::map<std::string, std::map<std::string, double>> families;
+};
+
+// {family -> {avg, max}} across a window's scrapes, averaged over
+// devices first.
+using TpuMetricsSummary = std::map<std::string, std::pair<double, double>>;
+
+TpuMetrics ParsePrometheus(const std::string& text);
+TpuMetricsSummary SummarizeMetrics(const std::vector<TpuMetrics>& snapshots);
+
+class MetricsManager {
+ public:
+  // url is "host:port" or "host:port/metrics".
+  MetricsManager(const std::string& url, uint64_t interval_ms = 1000);
+  ~MetricsManager();
+
+  // Scrapes once synchronously; fails fast when the endpoint is
+  // unreachable (parity: CheckForMissingMetrics).
+  Error CheckReachable();
+
+  void Start();
+  void Stop();
+
+  // Drains the snapshots collected since the last call.
+  std::vector<TpuMetrics> GetAndReset();
+
+  size_t scrape_failures() const { return scrape_failures_.load(); }
+
+ private:
+  Error ScrapeOnce(TpuMetrics* metrics);
+  void PollLoop();
+
+  std::string host_;
+  int port_ = 8000;
+  std::string path_ = "/metrics";
+  uint64_t interval_ms_;
+
+  std::thread poller_;
+  std::mutex mutex_;
+  std::condition_variable cv_;
+  bool stopping_ = false;
+  std::vector<TpuMetrics> snapshots_;
+  std::atomic<size_t> scrape_failures_{0};
+};
+
+}  // namespace perf
+}  // namespace tpuclient

@@ -9,6 +9,7 @@
 
 #include "command_line_parser.h"
 #include "inference_profiler.h"
+#include "metrics_manager.h"
 #include "report_writer.h"
 
 namespace tpuclient {
@@ -148,6 +149,30 @@ int Run(int argc, char** argv) {
   manager_options.streaming = params.streaming;
   manager_options.max_threads = params.max_threads;
 
+  std::unique_ptr<MetricsManager> metrics;
+  if (params.collect_metrics) {
+    std::string metrics_url = params.metrics_url;
+    if (metrics_url.empty()) {
+      // Default: port 8000 on the inference URL's host.
+      std::string host = params.url;
+      size_t scheme = host.find("://");
+      if (scheme != std::string::npos) host = host.substr(scheme + 3);
+      size_t colon = host.rfind(':');
+      if (colon != std::string::npos) host = host.substr(0, colon);
+      metrics_url = host + ":8000/metrics";
+    }
+    metrics = std::make_unique<MetricsManager>(
+        metrics_url, params.metrics_interval_ms);
+    Error reach_err = metrics->CheckReachable();
+    if (!reach_err.IsOk()) {
+      fprintf(stderr,
+              "warning: metrics endpoint %s unreachable (%s); continuing "
+              "without server metrics\n",
+              metrics_url.c_str(), reach_err.Message().c_str());
+      metrics.reset();
+    }
+  }
+
   std::vector<PerfStatus> results;
   LoadMode mode = LoadMode::CONCURRENCY;
   std::unique_ptr<LoadManager> manager;
@@ -156,7 +181,8 @@ int Run(int argc, char** argv) {
     Error init_err = m->Init();
     if (!init_err.IsOk()) return init_err;
     InferenceProfiler profiler(
-        m, config, setup_backend.get(), model.name, params.verbose);
+        m, config, setup_backend.get(), model.name, params.verbose,
+        metrics.get());
     if (params.has_request_rate_range) {
       mode = LoadMode::REQUEST_RATE;
       return profiler.ProfileRequestRateRange(

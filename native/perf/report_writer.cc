@@ -38,6 +38,21 @@ void PrintReport(
     if (status.error_count > 0) {
       printf("    errors: %zu\n", status.error_count);
     }
+    auto hbm = status.tpu_metrics.find("tpu_hbm_used_bytes");
+    auto util = status.tpu_metrics.find("tpu_hbm_utilization");
+    if (hbm != status.tpu_metrics.end() ||
+        util != status.tpu_metrics.end()) {
+      printf("    server TPU:");
+      if (hbm != status.tpu_metrics.end()) {
+        printf(" HBM used avg %.1f MiB / max %.1f MiB",
+               hbm->second.first / 1048576.0,
+               hbm->second.second / 1048576.0);
+      }
+      if (util != status.tpu_metrics.end()) {
+        printf(", HBM util avg %.1f%%", util->second.first * 100.0);
+      }
+      printf("\n");
+    }
     if (!status.on_target) {
       printf("    WARNING: measurement did not stabilize\n");
     }
@@ -51,7 +66,8 @@ Error WriteCsv(
   if (!out) return Error("cannot write CSV file '" + path + "'");
   out << (mode == LoadMode::CONCURRENCY ? "Concurrency" : "Request Rate")
       << ",Inferences/Second,p50 latency,p90 latency,p95 latency,"
-         "p99 latency,Avg latency,Std latency,Completed,Delayed,Errors\n";
+         "p99 latency,Avg latency,Std latency,Completed,Delayed,Errors,"
+         "Avg HBM Used (MiB),Max HBM Used (MiB),Avg HBM Utilization\n";
   char line[512];
   for (const auto& status : results) {
     if (mode == LoadMode::CONCURRENCY) {
@@ -62,11 +78,28 @@ Error WriteCsv(
     out << line;
     snprintf(
         line, sizeof(line),
-        "%.2f,%.1f,%.1f,%.1f,%.1f,%.1f,%.1f,%zu,%zu,%zu\n",
+        "%.2f,%.1f,%.1f,%.1f,%.1f,%.1f,%.1f,%zu,%zu,%zu",
         status.throughput, Pct(status, 50), Pct(status, 90), Pct(status, 95),
         Pct(status, 99), status.avg_latency_us, status.std_latency_us,
         status.completed_count, status.delayed_count, status.error_count);
     out << line;
+    auto hbm = status.tpu_metrics.find("tpu_hbm_used_bytes");
+    auto util = status.tpu_metrics.find("tpu_hbm_utilization");
+    if (hbm != status.tpu_metrics.end()) {
+      snprintf(line, sizeof(line), ",%.2f,%.2f",
+               hbm->second.first / 1048576.0,
+               hbm->second.second / 1048576.0);
+      out << line;
+    } else {
+      out << ",,";
+    }
+    if (util != status.tpu_metrics.end()) {
+      snprintf(line, sizeof(line), ",%.4f", util->second.first);
+      out << line;
+    } else {
+      out << ",";
+    }
+    out << "\n";
   }
   return Error::Success;
 }

@@ -8,6 +8,7 @@
 
 #include "../perf/command_line_parser.h"
 #include "../perf/inference_profiler.h"
+#include "../perf/metrics_manager.h"
 #include "../perf/report_writer.h"
 #include "minitest.h"
 
@@ -283,6 +284,33 @@ TEST_CASE("perf: report writer and profile export") {
   CHECK_EQ(doc["experiments"].AsArray().size(), 1u);
   CHECK_EQ(
       doc["experiments"].AsArray()[0]["requests"].AsArray().size(), 1u);
+}
+
+TEST_CASE("perf: prometheus metrics parse + summarize") {
+  const char* text =
+      "# HELP tpu_hbm_used_bytes Accelerator HBM bytes in use\n"
+      "# TYPE tpu_hbm_used_bytes gauge\n"
+      "tpu_hbm_used_bytes{tpu_uuid=\"TPU-0\"} 1048576\n"
+      "tpu_hbm_used_bytes{tpu_uuid=\"TPU-1\"} 3145728\n"
+      "tpu_hbm_utilization{tpu_uuid=\"TPU-0\"} 0.25\n"
+      "nv_inference_count{model=\"simple\",version=\"1\"} 42\n"
+      "tpu_hbm_total_bytes 8388608\n";
+  TpuMetrics metrics = ParsePrometheus(text);
+  REQUIRE(metrics.families.count("tpu_hbm_used_bytes") == 1);
+  CHECK_EQ(metrics.families["tpu_hbm_used_bytes"].size(), 2u);
+  CHECK_EQ(metrics.families["tpu_hbm_used_bytes"]["TPU-0"], 1048576.0);
+  CHECK_EQ(metrics.families["tpu_hbm_total_bytes"]["0"], 8388608.0);
+  // Untracked families are ignored.
+  CHECK_EQ(metrics.families.count("nv_inference_count"), 0u);
+
+  TpuMetrics second;
+  second.families["tpu_hbm_used_bytes"]["TPU-0"] = 2097152;
+  second.families["tpu_hbm_used_bytes"]["TPU-1"] = 2097152;
+  TpuMetricsSummary summary = SummarizeMetrics({metrics, second});
+  // Window 1 device-avg = 2 MiB, window 2 device-avg = 2 MiB.
+  CHECK_EQ(summary["tpu_hbm_used_bytes"].first, 2097152.0);
+  CHECK_EQ(summary["tpu_hbm_used_bytes"].second, 2097152.0);
+  CHECK_EQ(summary["tpu_hbm_utilization"].first, 0.25);
 }
 
 TEST_CASE("perf: command line parser") {
