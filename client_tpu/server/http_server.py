@@ -419,6 +419,185 @@ def build_http_app(core: InferenceServerCore) -> web.Application:
         await sse.write_eof()
         return sse
 
+    # -- OpenAI-compatible endpoints (chat/completions over the LLM
+    # models; the server-side counterpart of the reference perf
+    # harness's openai client backend, client_backend/openai/) ----------
+
+    def _openai_request(doc, prompt: str):
+        model_name = doc.get("model") or ""
+        if not model_name:
+            raise InferenceServerException(
+                "missing 'model'", status="INVALID_ARGUMENT")
+        infer_request = pb.ModelInferRequest(model_name=model_name)
+        from client_tpu.protocol.http_wire import _json_data_to_raw
+
+        tensor = infer_request.inputs.add()
+        tensor.name = "text_input"
+        tensor.datatype = "BYTES"
+        tensor.shape.extend([1])
+        infer_request.raw_input_contents.append(
+            _json_data_to_raw([prompt], "BYTES", "text_input"))
+        max_tokens = doc.get("max_tokens") or doc.get(
+            "max_completion_tokens")
+        if max_tokens:
+            tensor = infer_request.inputs.add()
+            tensor.name = "max_tokens"
+            tensor.datatype = "INT32"
+            tensor.shape.extend([1])
+            infer_request.raw_input_contents.append(
+                _json_data_to_raw([int(max_tokens)], "INT32", "max_tokens"))
+        return infer_request
+
+    def _openai_text(response: pb.ModelInferResponse) -> str:
+        from client_tpu.protocol.http_wire import _raw_to_json_data
+
+        for i, tensor in enumerate(response.outputs):
+            if tensor.name == "text_output" and i < len(
+                    response.raw_output_contents):
+                data = _raw_to_json_data(
+                    response.raw_output_contents[i], tensor.datatype)
+                return "".join(str(d) for d in data)
+        return ""
+
+    async def _chat_completions(request):
+        import json as _json
+
+        try:
+            doc = _json.loads(await request.read())
+            messages = doc.get("messages") or []
+            prompt = ""
+            for message in messages:
+                if message.get("role") == "user":
+                    prompt = message.get("content") or ""
+            infer_request = _openai_request(doc, prompt)
+        except InferenceServerException as e:
+            return _error_response(e)
+        except Exception as e:
+            return web.json_response(
+                {"error": {"message": str(e)}}, status=400)
+        if doc.get("stream"):
+            return await _openai_stream(
+                request, infer_request, chat=True)
+        try:
+            response = await _run(core.infer, infer_request)
+        except InferenceServerException as e:
+            return _error_response(e)
+        return web.json_response({
+            "id": "chatcmpl-0",
+            "object": "chat.completion",
+            "model": infer_request.model_name,
+            "choices": [{
+                "index": 0,
+                "message": {"role": "assistant",
+                            "content": _openai_text(response)},
+                "finish_reason": "stop",
+            }],
+        })
+
+    async def _completions(request):
+        import json as _json
+
+        try:
+            doc = _json.loads(await request.read())
+            prompt = doc.get("prompt") or ""
+            if isinstance(prompt, list):
+                prompt = prompt[0] if prompt else ""
+            infer_request = _openai_request(doc, prompt)
+        except InferenceServerException as e:
+            return _error_response(e)
+        except Exception as e:
+            return web.json_response(
+                {"error": {"message": str(e)}}, status=400)
+        if doc.get("stream"):
+            return await _openai_stream(
+                request, infer_request, chat=False)
+        try:
+            response = await _run(core.infer, infer_request)
+        except InferenceServerException as e:
+            return _error_response(e)
+        return web.json_response({
+            "id": "cmpl-0",
+            "object": "text_completion",
+            "model": infer_request.model_name,
+            "choices": [{
+                "index": 0,
+                "text": _openai_text(response),
+                "finish_reason": "stop",
+            }],
+        })
+
+    async def _openai_stream(request, infer_request, chat: bool):
+        """SSE chunks in the OpenAI streaming shape, fed by the
+        decoupled model stream (same producer pattern as
+        generate_stream)."""
+        import json as _json
+        import threading
+
+        sse = web.StreamResponse(
+            headers={"Content-Type": "text/event-stream",
+                     "Cache-Control": "no-cache"}
+        )
+        await sse.prepare(request)
+        loop = asyncio.get_running_loop()
+        queue_: asyncio.Queue = asyncio.Queue()
+        DONE = object()
+        cancelled = threading.Event()
+
+        def _produce():
+            generator = core.stream_infer(infer_request)
+            try:
+                for stream_response in generator:
+                    if cancelled.is_set():
+                        break
+                    loop.call_soon_threadsafe(
+                        queue_.put_nowait, stream_response)
+            except Exception as e:
+                error = pb.ModelStreamInferResponse(error_message=str(e))
+                loop.call_soon_threadsafe(queue_.put_nowait, error)
+            finally:
+                generator.close()
+                loop.call_soon_threadsafe(queue_.put_nowait, DONE)
+
+        producer = loop.run_in_executor(None, _produce)
+        obj = "chat.completion.chunk" if chat else "text_completion"
+        try:
+            while True:
+                item = await queue_.get()
+                if item is DONE:
+                    break
+                if item.error_message:
+                    payload = {"error": {"message": item.error_message}}
+                else:
+                    if not item.infer_response.outputs:
+                        continue
+                    token = _openai_text(item.infer_response)
+                    final = item.infer_response.parameters[
+                        "triton_final_response"].bool_param
+                    choice = {"index": 0,
+                              "finish_reason": "stop" if final else None}
+                    if chat:
+                        choice["delta"] = {"content": token}
+                    else:
+                        choice["text"] = token
+                    payload = {"id": "chatcmpl-0", "object": obj,
+                               "model": infer_request.model_name,
+                               "choices": [choice]}
+                await sse.write(
+                    ("data: %s\n\n" % _json.dumps(payload)).encode())
+        except (ConnectionResetError, ConnectionError,
+                asyncio.CancelledError):
+            cancelled.set()
+            raise
+        finally:
+            cancelled.set()
+            await producer
+        await sse.write(b"data: [DONE]\n\n")
+        await sse.write_eof()
+        return sse
+
+    routes.post("/v1/chat/completions")(_chat_completions)
+    routes.post("/v1/completions")(_completions)
+
     # -- inference -------------------------------------------------------
 
     @routes.post("/v2/models/{model}/infer")

@@ -6,6 +6,7 @@
 
 #include "../library/grpc_client.h"
 #include "../library/http_client.h"
+#include "../library/http_transport.h"
 #include "client_tpu/protocol/arena.pb.h"
 
 namespace tpuclient {
@@ -285,6 +286,295 @@ class HttpBackend : public ClientBackend {
 };
 
 //==============================================================================
+// OpenAI backend: chat-completions over HTTP with SSE streaming
+// (parity: the reference's openai client backend,
+// client_backend/openai/openai_client.h:112-176 — payload passthrough
+// from the input JSON, one response callback per SSE chunk). The
+// "payload" input carries the full request-body JSON; streaming mode
+// appends '"stream": true' responsibility to the payload author.
+//
+class OpenAiInferResult : public InferResult {
+ public:
+  OpenAiInferResult(
+      Error status, std::string body, std::string id, bool is_final)
+      : status_(std::move(status)), body_(std::move(body)),
+        id_(std::move(id)), is_final_(is_final) {}
+
+  Error ModelName(std::string* name) const override {
+    *name = "openai";
+    return Error::Success;
+  }
+  Error ModelVersion(std::string* version) const override {
+    *version = "";
+    return Error::Success;
+  }
+  Error Id(std::string* id) const override {
+    *id = id_;
+    return Error::Success;
+  }
+  Error Shape(
+      const std::string&, std::vector<int64_t>* shape) const override {
+    *shape = {1};
+    return Error::Success;
+  }
+  Error Datatype(const std::string&, std::string* datatype) const override {
+    *datatype = "BYTES";
+    return Error::Success;
+  }
+  Error RawData(
+      const std::string&, const uint8_t** buf,
+      size_t* byte_size) const override {
+    *buf = reinterpret_cast<const uint8_t*>(body_.data());
+    *byte_size = body_.size();
+    return Error::Success;
+  }
+  Error StringData(
+      const std::string& output_name,
+      std::vector<std::string>* string_result) const override {
+    string_result->assign(1, body_);
+    return Error::Success;
+  }
+  std::string DebugString() const override { return body_; }
+  Error RequestStatus() const override { return status_; }
+
+  bool IsFinalResponse() const { return is_final_; }
+
+ private:
+  Error status_;
+  std::string body_;
+  std::string id_;
+  bool is_final_;
+};
+
+class OpenAiBackend : public ClientBackend {
+ public:
+  explicit OpenAiBackend(const BackendConfig& config)
+      : endpoint_(config.openai_endpoint) {
+    std::string rest = config.url;
+    size_t scheme = rest.find("://");
+    if (scheme != std::string::npos) rest = rest.substr(scheme + 3);
+    size_t colon = rest.rfind(':');
+    if (colon != std::string::npos) {
+      port_ = atoi(rest.substr(colon + 1).c_str());
+      host_ = rest.substr(0, colon);
+    } else {
+      host_ = rest;
+    }
+    if (!endpoint_.empty() && endpoint_[0] != '/') {
+      endpoint_ = "/" + endpoint_;
+    }
+  }
+
+  ~OpenAiBackend() override {
+    StopStream();
+    while (inflight_.load() > 0) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    }
+  }
+
+  Error ServerMetadataJson(json::Value* metadata) override {
+    json::Object root;
+    root["name"] = json::Value(std::string("openai-endpoint"));
+    *metadata = json::Value(std::move(root));
+    return Error::Success;
+  }
+
+  // Synthesized schema (parity: ModelParser::InitOpenAI,
+  // model_parser.cc:116): a single raw JSON "payload" input.
+  Error ModelMetadataJson(
+      json::Value* metadata, const std::string& model_name,
+      const std::string&) override {
+    json::Object root;
+    root["name"] = json::Value(model_name);
+    root["platform"] = json::Value(std::string("openai"));
+    json::Array inputs;
+    json::Object payload;
+    payload["name"] = json::Value(std::string("payload"));
+    payload["datatype"] = json::Value(std::string("BYTES"));
+    json::Array shape;
+    shape.push_back(json::Value(static_cast<int64_t>(1)));
+    payload["shape"] = json::Value(std::move(shape));
+    inputs.push_back(json::Value(std::move(payload)));
+    root["inputs"] = json::Value(std::move(inputs));
+    root["outputs"] = json::Value(json::Array{});
+    *metadata = json::Value(std::move(root));
+    return Error::Success;
+  }
+
+  Error ModelConfigJson(
+      json::Value* config, const std::string& model_name,
+      const std::string&) override {
+    json::Object root;
+    root["name"] = json::Value(model_name);
+    root["max_batch_size"] = json::Value(static_cast<int64_t>(0));
+    *config = json::Value(std::move(root));
+    return Error::Success;
+  }
+
+  Error ModelStatisticsJson(json::Value* stats, const std::string&) override {
+    json::Object root;
+    root["model_stats"] = json::Value(json::Array{});
+    *stats = json::Value(std::move(root));
+    return Error::Success;
+  }
+
+  Error Infer(
+      InferResult** result, const InferOptions& options,
+      const std::vector<InferInput*>& inputs,
+      const std::vector<const InferRequestedOutput*>&) override {
+    std::string payload;
+    Error err = GatherPayload(inputs, &payload);
+    if (!err.IsOk()) return err;
+    HttpConnection conn(host_, port_);
+    HttpResponse response;
+    std::string transport_err = conn.Request(
+        "POST", endpoint_,
+        {{"Content-Type", "application/json"}}, payload, &response,
+        options.client_timeout_us);
+    if (!transport_err.empty()) return Error(transport_err);
+    Error status = Error::Success;
+    if (response.status_code != 200) {
+      status = Error(
+          "HTTP " + std::to_string(response.status_code) + ": " +
+          response.body);
+    }
+    *result = new OpenAiInferResult(
+        status, std::move(response.body), options.request_id, true);
+    return Error::Success;
+  }
+
+  Error AsyncInfer(
+      OnCompleteFn callback, const InferOptions& options,
+      const std::vector<InferInput*>& inputs,
+      const std::vector<const InferRequestedOutput*>& outputs) override {
+    std::string payload;
+    Error err = GatherPayload(inputs, &payload);
+    if (!err.IsOk()) return err;
+    inflight_++;
+    std::string id = options.request_id;
+    uint64_t timeout_us = options.client_timeout_us;
+    std::thread([this, callback = std::move(callback), id,
+                 payload = std::move(payload), timeout_us] {
+      HttpConnection conn(host_, port_);
+      HttpResponse response;
+      std::string transport_err = conn.Request(
+          "POST", endpoint_, {{"Content-Type", "application/json"}},
+          payload, &response, timeout_us);
+      Error status = Error::Success;
+      if (!transport_err.empty()) {
+        status = Error(transport_err);
+      } else if (response.status_code != 200) {
+        status = Error(
+            "HTTP " + std::to_string(response.status_code) + ": " +
+            response.body);
+      }
+      callback(new OpenAiInferResult(
+          status, std::move(response.body), id, true));
+      inflight_--;
+    }).detach();
+    return Error::Success;
+  }
+
+  Error StartStream(OnCompleteFn callback) override {
+    std::lock_guard<std::mutex> lock(stream_mutex_);
+    stream_callback_ = std::move(callback);
+    return Error::Success;
+  }
+
+  Error StopStream() override {
+    std::lock_guard<std::mutex> lock(stream_mutex_);
+    stream_callback_ = nullptr;
+    return Error::Success;
+  }
+
+  // SSE streaming: one callback per "data:" chunk, a final empty
+  // response at [DONE] / stream end.
+  Error AsyncStreamInfer(
+      const InferOptions& options, const std::vector<InferInput*>& inputs,
+      const std::vector<const InferRequestedOutput*>&) override {
+    OnCompleteFn callback;
+    {
+      std::lock_guard<std::mutex> lock(stream_mutex_);
+      callback = stream_callback_;
+    }
+    if (!callback) return Error("stream not started");
+    std::string payload;
+    Error err = GatherPayload(inputs, &payload);
+    if (!err.IsOk()) return err;
+    inflight_++;
+    std::string id = options.request_id;
+    uint64_t timeout_us = options.client_timeout_us;
+    std::thread([this, callback = std::move(callback), id,
+                 payload = std::move(payload), timeout_us] {
+      HttpConnection conn(host_, port_);
+      HttpResponse response;
+      std::string buffer;
+      auto on_data = [&](const char* data, size_t len) {
+        buffer.append(data, len);
+        size_t pos;
+        while ((pos = buffer.find("\n\n")) != std::string::npos) {
+          std::string event = buffer.substr(0, pos);
+          buffer.erase(0, pos + 2);
+          if (event.rfind("data: ", 0) != 0) continue;
+          std::string chunk = event.substr(6);
+          if (chunk == "[DONE]") continue;  // final fires after EOF
+          callback(new OpenAiInferResult(
+              Error::Success, std::move(chunk), id, false));
+        }
+      };
+      std::string transport_err = conn.RequestStreaming(
+          "POST", endpoint_, {{"Content-Type", "application/json"}},
+          payload, &response, on_data, timeout_us);
+      Error status = Error::Success;
+      if (!transport_err.empty()) {
+        status = Error(transport_err);
+      } else if (response.status_code != 200) {
+        status = Error("HTTP " + std::to_string(response.status_code));
+      }
+      callback(new OpenAiInferResult(status, "", id, true));
+      inflight_--;
+    }).detach();
+    return Error::Success;
+  }
+
+  Error RegisterSystemSharedMemory(
+      const std::string&, const std::string&, size_t, size_t) override {
+    return Error("shared memory is not supported by the OpenAI backend");
+  }
+  Error RegisterTpuSharedMemory(
+      const std::string&, const std::string&, int64_t, size_t) override {
+    return Error("shared memory is not supported by the OpenAI backend");
+  }
+  Error UnregisterSystemSharedMemory(const std::string&) override {
+    return Error::Success;
+  }
+  Error UnregisterTpuSharedMemory(const std::string&) override {
+    return Error::Success;
+  }
+
+ private:
+  static Error GatherPayload(
+      const std::vector<InferInput*>& inputs, std::string* payload) {
+    for (InferInput* input : inputs) {
+      if (input->Name() == "payload") {
+        input->GatherInto(payload);
+        // BYTES wire format: strip the 4-byte length prefix.
+        if (payload->size() >= 4) payload->erase(0, 4);
+        return Error::Success;
+      }
+    }
+    return Error("OpenAI requests need a 'payload' BYTES input");
+  }
+
+  std::string host_;
+  int port_ = 8000;
+  std::string endpoint_;
+  std::atomic<int64_t> inflight_{0};
+  std::mutex stream_mutex_;
+  OnCompleteFn stream_callback_;
+};
+
+//==============================================================================
 // Mock backend: a fake server with programmable delay, used by the
 // harness unit tests (parity: NaggyMockClientBackend firing async
 // callbacks from detached threads, mock_client_backend.h:617-625).
@@ -509,6 +799,14 @@ class MockBackend : public ClientBackend {
 
 }  // namespace
 
+bool IsFinalStreamResponse(const InferResult* result) {
+  const auto* grpc_result = dynamic_cast<const InferResultGrpc*>(result);
+  if (grpc_result != nullptr) return grpc_result->IsFinalResponse();
+  const auto* openai_result = dynamic_cast<const OpenAiInferResult*>(result);
+  if (openai_result != nullptr) return openai_result->IsFinalResponse();
+  return true;
+}
+
 std::shared_ptr<MockBackendStats> GetMockBackendStats() {
   return g_mock_stats;
 }
@@ -528,6 +826,9 @@ Error ClientBackendFactory::Create(
       return GrpcBackend::Create(config_, backend);
     case BackendKind::TRITON_HTTP:
       return HttpBackend::Create(config_, backend);
+    case BackendKind::OPENAI:
+      backend->reset(new OpenAiBackend(config_));
+      return Error::Success;
     case BackendKind::MOCK:
       backend->reset(new MockBackend(config_));
       return Error::Success;

@@ -34,13 +34,15 @@ class InferenceServerHttpClient;
 
 namespace perf {
 
-enum class BackendKind { TRITON_GRPC, TRITON_HTTP, MOCK };
+enum class BackendKind { TRITON_GRPC, TRITON_HTTP, OPENAI, MOCK };
 
 struct BackendConfig {
   BackendKind kind = BackendKind::TRITON_GRPC;
   std::string url;  // host:port
   bool verbose = false;
   size_t http_async_workers = 8;
+  // OPENAI: request path on the server (reference --endpoint).
+  std::string openai_endpoint = "/v1/chat/completions";
   // MOCK: simulated per-request latency and failure rate.
   uint64_t mock_delay_us = 500;
   double mock_error_rate = 0.0;
@@ -153,6 +155,10 @@ struct MockBackendStats {
 
 std::shared_ptr<MockBackendStats> GetMockBackendStats();
 void ResetMockBackendStats();
+
+// Whether a stream response is the last for its request (decoupled
+// models emit several). True for non-stream result types.
+bool IsFinalStreamResponse(const InferResult* result);
 
 }  // namespace perf
 }  // namespace tpuclient

@@ -55,6 +55,7 @@ enum LongOpt {
   kOptMaxThreads,
   kOptPercentile,
   kOptServiceKind,
+  kOptEndpoint,
   kOptCollectMetrics,
   kOptMetricsUrl,
   kOptMetricsInterval,
@@ -103,6 +104,7 @@ const struct option kLongOptions[] = {
     {"max-threads", required_argument, nullptr, kOptMaxThreads},
     {"percentile", required_argument, nullptr, kOptPercentile},
     {"service-kind", required_argument, nullptr, kOptServiceKind},
+    {"endpoint", required_argument, nullptr, kOptEndpoint},
     {"collect-metrics", no_argument, nullptr, kOptCollectMetrics},
     {"metrics-url", required_argument, nullptr, kOptMetricsUrl},
     {"metrics-interval", required_argument, nullptr, kOptMetricsInterval},
@@ -229,11 +231,14 @@ Error CLParser::Parse(
         params->metrics_interval_ms = atoll(optarg);
         break;
       case kOptServiceKind:
-        if (std::string(optarg) != "triton") {
-          return Error("only --service-kind triton is supported natively; "
-                       "use the Python harness for in-process serving");
+        params->service_kind = optarg;
+        if (params->service_kind != "triton" &&
+            params->service_kind != "openai") {
+          return Error("--service-kind must be triton or openai (the "
+                       "Python harness adds in-process serving)");
         }
         break;
+      case kOptEndpoint: params->endpoint = optarg; break;
       default:
         return Error("unknown option (see usage)");
     }

@@ -18,6 +18,8 @@ struct PerfAnalyzerParameters {
   std::string model_version;
   std::string url = "localhost:8001";
   std::string protocol = "grpc";  // grpc | http
+  std::string service_kind = "triton";  // triton | openai
+  std::string endpoint = "v1/chat/completions";  // openai request path
   int64_t batch_size = 1;
   bool verbose = false;
   bool async_mode = true;

@@ -566,10 +566,15 @@ void ConcurrencyManager::StreamWorker(
         entry.record->end_ns.push_back(NowNs());
         Error status = owned != nullptr ? owned->RequestStatus()
                                         : Error("null stream result");
+        bool final = owned == nullptr || IsFinalStreamResponse(owned.get());
         if (!status.IsOk()) {
           entry.record->has_error = true;
           entry.record->error = status.Message();
+          final = true;
         }
+        // Decoupled models emit several responses per request; each
+        // stamps an end_ns, only the final one retires the slot.
+        if (!final) return;
         stat->AddRecord(std::move(*entry.record));
         tracker->Release(entry.ctx_id);
         order->erase(

@@ -64,9 +64,14 @@ int Run(int argc, char** argv) {
   std::signal(SIGINT, SignalHandler);
 
   BackendConfig backend_config;
-  backend_config.kind = params.protocol == "http"
-                            ? BackendKind::TRITON_HTTP
-                            : BackendKind::TRITON_GRPC;
+  if (params.service_kind == "openai") {
+    backend_config.kind = BackendKind::OPENAI;
+    backend_config.openai_endpoint = params.endpoint;
+  } else {
+    backend_config.kind = params.protocol == "http"
+                              ? BackendKind::TRITON_HTTP
+                              : BackendKind::TRITON_GRPC;
+  }
   backend_config.url = params.url;
   backend_config.verbose = params.verbose;
   ClientBackendFactory factory(backend_config);

@@ -106,6 +106,47 @@ def test_native_grpc_integration(native_build, live_server):
     )
 
 
+def test_native_perf_analyzer_openai_e2e(native_build, tmp_path):
+    """The native perf_analyzer's openai service-kind: SSE streaming
+    against the server's /v1/chat/completions (parity: the reference
+    openai client backend)."""
+    import json
+
+    from client_tpu.server.app import build_core
+    from client_tpu.server.http_server import start_http_server_thread
+
+    binary = native_build / "perf_analyzer"
+    assert binary.exists()
+    core = build_core(["llm_tiny"])
+    runner = start_http_server_thread(core, host="127.0.0.1", port=0)
+    try:
+        payload = json.dumps({
+            "model": "llm_tiny", "max_tokens": 4, "stream": True,
+            "messages": [{"role": "user", "content": "bench"}],
+        })
+        input_file = tmp_path / "openai_input.json"
+        input_file.write_text(json.dumps({"data": [{"payload": [payload]}]}))
+        export = tmp_path / "profile.json"
+        proc = subprocess.run(
+            [str(binary), "-m", "llm_tiny",
+             "-u", "127.0.0.1:%d" % runner.port,
+             "--service-kind", "openai",
+             "--endpoint", "v1/chat/completions",
+             "--input-data", str(input_file), "--streaming",
+             "--concurrency-range", "2", "-p", "800", "-r", "3", "-s", "90",
+             "--profile-export-file", str(export)],
+            capture_output=True, text=True, timeout=300,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        doc = json.loads(export.read_text())
+        requests = doc["experiments"][0]["requests"]
+        assert requests, "no requests recorded"
+        # Streaming: every request sees one timestamp per SSE chunk.
+        assert any(len(r["response_timestamps"]) > 1 for r in requests)
+    finally:
+        runner.stop()
+
+
 @pytest.mark.parametrize("shm", ["none", "system", "tpu"])
 def test_native_perf_analyzer_e2e(native_build, live_server, shm):
     """The native perf_analyzer binary end-to-end against the live

@@ -1,0 +1,86 @@
+"""OpenAI-compatible endpoint tests (/v1/chat/completions and
+/v1/completions over the LLM models) — the server-side counterpart of
+the reference perf harness's openai client backend
+(client_backend/openai/)."""
+
+import json
+import urllib.request
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def llm_http_server():
+    from client_tpu.server.app import build_core
+    from client_tpu.server.http_server import start_http_server_thread
+
+    core = build_core(["llm_tiny"])
+    runner = start_http_server_thread(core, host="127.0.0.1", port=0)
+    yield "http://127.0.0.1:%d" % runner.port
+    runner.stop()
+
+
+def _post(url, body):
+    request = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    return urllib.request.urlopen(request, timeout=120)
+
+
+def test_chat_completion(llm_http_server):
+    with _post(llm_http_server + "/v1/chat/completions", {
+        "model": "llm_tiny", "max_tokens": 6,
+        "messages": [{"role": "user", "content": "hello"}],
+    }) as response:
+        doc = json.loads(response.read())
+    assert doc["object"] == "chat.completion"
+    assert doc["model"] == "llm_tiny"
+    choice = doc["choices"][0]
+    assert choice["message"]["role"] == "assistant"
+    assert choice["finish_reason"] == "stop"
+
+
+def test_chat_completion_stream(llm_http_server):
+    with _post(llm_http_server + "/v1/chat/completions", {
+        "model": "llm_tiny", "max_tokens": 5, "stream": True,
+        "messages": [{"role": "user", "content": "hi"}],
+    }) as response:
+        assert response.headers["Content-Type"].startswith(
+            "text/event-stream")
+        text = response.read().decode()
+    events = [e for e in text.split("\n\n") if e.startswith("data: ")]
+    assert events[-1] == "data: [DONE]"
+    chunks = [json.loads(e[6:]) for e in events[:-1]]
+    assert chunks, "no streamed chunks"
+    for chunk in chunks:
+        assert chunk["object"] == "chat.completion.chunk"
+        assert "delta" in chunk["choices"][0]
+    # The last data chunk is marked final.
+    assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
+
+
+def test_text_completion(llm_http_server):
+    with _post(llm_http_server + "/v1/completions", {
+        "model": "llm_tiny", "max_tokens": 4, "prompt": "abc",
+    }) as response:
+        doc = json.loads(response.read())
+    assert doc["object"] == "text_completion"
+    assert "text" in doc["choices"][0]
+
+
+def test_chat_completion_unknown_model(llm_http_server):
+    with pytest.raises(urllib.error.HTTPError) as excinfo:
+        _post(llm_http_server + "/v1/chat/completions", {
+            "model": "no-such-model",
+            "messages": [{"role": "user", "content": "x"}],
+        })
+    assert excinfo.value.code == 404
+
+
+def test_chat_completion_missing_model(llm_http_server):
+    with pytest.raises(urllib.error.HTTPError) as excinfo:
+        _post(llm_http_server + "/v1/chat/completions", {
+            "messages": [{"role": "user", "content": "x"}],
+        })
+    assert excinfo.value.code == 400
