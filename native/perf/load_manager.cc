@@ -32,8 +32,9 @@ int FifoCtxIdTracker::Get(int timeout_ms) {
                     [this] { return !free_.empty(); })) {
     return -1;
   }
-  int id = free_.front();
-  free_.pop_front();
+  size_t index = PickIndex(free_.size());
+  int id = free_[index];
+  free_.erase(free_.begin() + index);
   return id;
 }
 

@@ -79,16 +79,33 @@ struct ThreadStat {
 //
 class FifoCtxIdTracker {
  public:
+  virtual ~FifoCtxIdTracker() = default;
+
   void Reset(size_t count);
   // Blocks up to timeout_ms for a free slot; returns -1 on timeout.
   int Get(int timeout_ms);
   void Release(int ctx_id);
   size_t FreeCount();
 
- private:
+ protected:
+  // Picks which free slot Get() hands out (index into free_).
+  virtual size_t PickIndex(size_t free_count) { return 0; }
+
   std::mutex mutex_;
   std::condition_variable cv_;
   std::deque<int> free_;
+};
+
+// Random slot selection (parity: RandCtxIdTracker,
+// rand_ctx_id_tracker.h:36 — exercises sequence slots non-uniformly).
+class RandCtxIdTracker : public FifoCtxIdTracker {
+ protected:
+  size_t PickIndex(size_t free_count) override {
+    return rng_() % free_count;
+  }
+
+ private:
+  std::mt19937_64 rng_{std::random_device{}()};
 };
 
 //==============================================================================

@@ -1,0 +1,84 @@
+#include "mpi_utils.h"
+
+#include <dlfcn.h>
+
+#include <cstdlib>
+
+namespace tpuclient {
+namespace perf {
+
+MPIDriver::MPIDriver(bool is_enabled) {
+  if (!is_enabled) return;
+  // Only OpenMPI exposes its communicator/type/op constants as
+  // symbols we can resolve dynamically (ompi_*); MPICH encodes them
+  // as integer constants baked in at compile time, which a pure
+  // dlopen binding cannot obtain portably.
+  handle_ = dlopen("libmpi.so", RTLD_NOW | RTLD_GLOBAL);
+  if (handle_ == nullptr) {
+    handle_ = dlopen("libmpi.so.40", RTLD_NOW | RTLD_GLOBAL);
+  }
+  if (handle_ == nullptr) return;
+  init_ = reinterpret_cast<int (*)(int*, char***)>(
+      dlsym(handle_, "MPI_Init"));
+  finalize_ = reinterpret_cast<int (*)()>(dlsym(handle_, "MPI_Finalize"));
+  barrier_ = reinterpret_cast<int (*)(void*)>(dlsym(handle_, "MPI_Barrier"));
+  comm_size_ = reinterpret_cast<int (*)(void*, int*)>(
+      dlsym(handle_, "MPI_Comm_size"));
+  comm_rank_ = reinterpret_cast<int (*)(void*, int*)>(
+      dlsym(handle_, "MPI_Comm_rank"));
+  allreduce_ =
+      reinterpret_cast<int (*)(const void*, void*, int, void*, void*, void*)>(
+          dlsym(handle_, "MPI_Allreduce"));
+  comm_world_ = dlsym(handle_, "ompi_mpi_comm_world");
+  type_int_ = dlsym(handle_, "ompi_mpi_int");
+  op_land_ = dlsym(handle_, "ompi_mpi_op_land");
+  // Active only when everything resolved AND launched under mpirun.
+  active_ = init_ != nullptr && finalize_ != nullptr &&
+            barrier_ != nullptr && comm_size_ != nullptr &&
+            comm_rank_ != nullptr && allreduce_ != nullptr &&
+            comm_world_ != nullptr && type_int_ != nullptr &&
+            op_land_ != nullptr &&
+            (getenv("OMPI_COMM_WORLD_SIZE") != nullptr ||
+             getenv("PMI_SIZE") != nullptr);
+}
+
+MPIDriver::~MPIDriver() {
+  if (handle_ != nullptr) dlclose(handle_);
+}
+
+void MPIDriver::MPIInit() {
+  if (active_) init_(nullptr, nullptr);
+}
+
+void MPIDriver::MPIFinalize() {
+  if (active_) finalize_();
+}
+
+void MPIDriver::MPIBarrierWorld() {
+  if (active_) barrier_(comm_world_);
+}
+
+int MPIDriver::MPICommSizeWorld() const {
+  if (!active_) return 1;
+  int size = 1;
+  comm_size_(comm_world_, &size);
+  return size;
+}
+
+int MPIDriver::MPICommRankWorld() const {
+  if (!active_) return 0;
+  int rank = 0;
+  comm_rank_(comm_world_, &rank);
+  return rank;
+}
+
+bool MPIDriver::MPIAllTrue(bool local) const {
+  if (!active_) return local;
+  int in = local ? 1 : 0;
+  int out = 0;
+  allreduce_(&in, &out, 1, type_int_, op_land_, comm_world_);
+  return out != 0;
+}
+
+}  // namespace perf
+}  // namespace tpuclient

@@ -1,0 +1,50 @@
+// Optional MPI coordination for multi-process perf runs (parity:
+// /root/reference/src/c++/perf_analyzer/mpi_utils.h:32-80 — libmpi is
+// dlopen'd at runtime, never a compile-time dependency; without it
+// every call degrades to single-rank no-ops). Used to launch several
+// analyzer ranks against one server and synchronize their
+// measurement windows.
+#pragma once
+
+#include <string>
+
+namespace tpuclient {
+namespace perf {
+
+class MPIDriver {
+ public:
+  // is_enabled requests MPI; the driver only becomes active when
+  // libmpi.so is loadable AND the process runs under mpirun (world
+  // size resolvable).
+  explicit MPIDriver(bool is_enabled);
+  ~MPIDriver();
+
+  bool IsMPIRun() const { return active_; }
+
+  void MPIInit();
+  void MPIFinalize();
+  void MPIBarrierWorld();
+  int MPICommSizeWorld() const;
+  int MPICommRankWorld() const;
+  // Logical-AND reduce of a local flag across ranks (used to agree
+  // on measurement stability; parity: the reference's AllGather over
+  // stability decisions).
+  bool MPIAllTrue(bool local) const;
+
+ private:
+  bool active_ = false;
+  void* handle_ = nullptr;
+  // Bound symbols (only valid while active_).
+  int (*init_)(int*, char***) = nullptr;
+  int (*finalize_)() = nullptr;
+  int (*barrier_)(void*) = nullptr;
+  int (*comm_size_)(void*, int*) = nullptr;
+  int (*comm_rank_)(void*, int*) = nullptr;
+  int (*allreduce_)(const void*, void*, int, void*, void*, void*) = nullptr;
+  void* comm_world_ = nullptr;
+  void* type_int_ = nullptr;
+  void* op_land_ = nullptr;
+};
+
+}  // namespace perf
+}  // namespace tpuclient
