@@ -33,8 +33,10 @@ def build_parser() -> argparse.ArgumentParser:
                     "latency / token throughput)")
     parser.add_argument("-m", "--model", required=True)
     parser.add_argument("-u", "--url", default="localhost:8001")
+    parser.add_argument("--endpoint", default="v1/chat/completions",
+                        help="openai service-kind request path")
     parser.add_argument("--service-kind", default="triton",
-                        choices=["triton", "inprocess"])
+                        choices=["triton", "inprocess", "openai"])
     parser.add_argument("-i", "--protocol", default="grpc",
                         choices=["grpc", "http"])
     parser.add_argument("--concurrency", type=int, default=1)
@@ -88,8 +90,12 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
     except (OSError, ValueError) as e:
         print("genai failed: %s" % e, file=sys.stderr)
         return 1
+    output_format = (
+        OutputFormat.OPENAI_CHAT if args.service_kind == "openai"
+        else OutputFormat.TRITON_GENERATE
+    )
     dataset = inputs.convert_to_dataset(
-        prompts, OutputFormat.TRITON_GENERATE,
+        prompts, output_format,
         output_tokens_mean=args.output_tokens_mean,
         model_name=args.model,
     )
@@ -103,6 +109,8 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
         stability_pct=args.stability_percentage,
         max_trials=args.max_trials,
         streaming=not args.no_streaming,
+        extra_args=(["--endpoint", args.endpoint]
+                    if args.service_kind == "openai" else None),
     )
     rc = Profiler.run(perf_args, core=core)
     if rc != 0:

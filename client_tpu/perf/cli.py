@@ -50,7 +50,9 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("-i", "--protocol", choices=["grpc", "http"],
                         default="grpc")
     parser.add_argument("--service-kind", default="triton",
-                        choices=["triton", "inprocess"])
+                        choices=["triton", "inprocess", "openai"])
+    parser.add_argument("--endpoint", default="v1/chat/completions",
+                        help="openai service-kind request path")
     parser.add_argument("-b", "--batch-size", type=int, default=1)
     parser.add_argument("-v", "--verbose", action="store_true")
     parser.add_argument("--async", dest="async_mode", action="store_true",
@@ -118,7 +120,12 @@ def build_parser() -> argparse.ArgumentParser:
 def run(argv: Optional[List[str]] = None, core=None) -> int:
     args = build_parser().parse_args(argv)
 
-    if args.service_kind == "inprocess":
+    if args.service_kind == "openai":
+        factory = ClientBackendFactory(
+            BackendKind.OPENAI, url=args.url, verbose=args.verbose,
+            openai_endpoint=args.endpoint,
+        )
+    elif args.service_kind == "inprocess":
         if core is None:
             from client_tpu.server.app import build_core
 

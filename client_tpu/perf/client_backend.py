@@ -23,6 +23,7 @@ class BackendKind(enum.Enum):
     TRITON_GRPC = "grpc"
     TRITON_HTTP = "http"
     IN_PROCESS = "inprocess"
+    OPENAI = "openai"
     MOCK = "mock"
 
 
@@ -213,6 +214,164 @@ class HttpClientBackend(ClientBackend):
 
     def close(self):
         self._client.close()
+
+
+class OpenAiResult:
+    """Result shim for OpenAI responses: the worker pairing/final
+    plumbing sees the same get_response()/get_parameters() surface as
+    Triton results."""
+
+    def __init__(self, body: str, request_id: str, final: bool):
+        self.body = body
+        self._id = request_id
+        self._final = final
+
+    def get_response(self):
+        return {"id": self._id}
+
+    def get_parameters(self):
+        return {"triton_final_response": self._final}
+
+
+class OpenAiClientBackend(ClientBackend):
+    """Chat-completions client over HTTP with SSE streaming (parity:
+    the reference's openai client backend, client_backend/openai/ —
+    payload passthrough from the input JSON, one stream callback per
+    SSE chunk)."""
+
+    kind = BackendKind.OPENAI
+
+    def __init__(self, url: str, endpoint: str = "/v1/chat/completions",
+                 verbose: bool = False):
+        if "://" in url:
+            url = url.split("://", 1)[1]
+        host, _, port = url.partition(":")
+        self._host = host
+        self._port = int(port or 8000)
+        self._endpoint = endpoint if endpoint.startswith("/") \
+            else "/" + endpoint
+        self._verbose = verbose
+        self._stream_callback = None
+        self._inflight = threading.Semaphore(0)
+        self._inflight_count = 0
+        self._lock = threading.Lock()
+
+    # Synthesized schema (parity: ModelParser::InitOpenAI).
+    def model_metadata(self, model_name, model_version=""):
+        return {
+            "name": model_name,
+            "platform": "openai",
+            "inputs": [{"name": "payload", "datatype": "BYTES",
+                        "shape": [1]}],
+            "outputs": [],
+        }
+
+    def model_config(self, model_name, model_version=""):
+        return {"name": model_name, "max_batch_size": 0}
+
+    def model_statistics(self, model_name="", model_version=""):
+        return {"model_stats": []}
+
+    def server_metadata(self):
+        return {"name": "openai-endpoint"}
+
+    @staticmethod
+    def _payload_from_inputs(inputs) -> bytes:
+        for infer_input in inputs:
+            if infer_input.name() == "payload":
+                raw = infer_input.raw_data()
+                if raw is None:
+                    raise InferenceServerException(
+                        "payload input has no data")
+                # BYTES wire format: strip the 4-byte length prefix.
+                return raw[4:] if len(raw) >= 4 else raw
+        raise InferenceServerException(
+            "OpenAI requests need a 'payload' BYTES input")
+
+    def _post(self, payload: bytes, on_chunk=None) -> str:
+        import http.client
+
+        conn = http.client.HTTPConnection(self._host, self._port,
+                                          timeout=120)
+        try:
+            conn.request("POST", self._endpoint, body=payload,
+                         headers={"Content-Type": "application/json"})
+            response = conn.getresponse()
+            if response.status != 200:
+                raise InferenceServerException(
+                    "HTTP %d: %s"
+                    % (response.status, response.read().decode()[:500])
+                )
+            if on_chunk is None:
+                return response.read().decode()
+            buffer = b""
+            while True:
+                data = response.read1(65536)
+                if not data:
+                    break
+                buffer += data
+                while b"\n\n" in buffer:
+                    event, buffer = buffer.split(b"\n\n", 1)
+                    if not event.startswith(b"data: "):
+                        continue
+                    chunk = event[6:].decode()
+                    if chunk == "[DONE]":
+                        continue  # final fires after EOF
+                    on_chunk(chunk)
+            return ""
+        finally:
+            conn.close()
+
+    def infer(self, model_name, inputs, outputs=None, **kwargs):
+        payload = self._payload_from_inputs(inputs)
+        body = self._post(payload)
+        return OpenAiResult(body, kwargs.get("request_id", ""), True)
+
+    def async_infer(self, callback, model_name, inputs, outputs=None,
+                    **kwargs):
+        payload = self._payload_from_inputs(inputs)
+        request_id = kwargs.get("request_id", "")
+
+        def _work():
+            try:
+                body = self._post(payload)
+                callback(OpenAiResult(body, request_id, True), None)
+            except InferenceServerException as e:
+                callback(None, e)
+            except Exception as e:  # transport errors
+                callback(None, InferenceServerException(str(e)))
+
+        threading.Thread(target=_work, daemon=True).start()
+
+    def start_stream(self, callback):
+        self._stream_callback = callback
+
+    def stop_stream(self):
+        self._stream_callback = None
+
+    def async_stream_infer(self, model_name, inputs, outputs=None,
+                           **kwargs):
+        callback = self._stream_callback
+        if callback is None:
+            raise InferenceServerException("stream not started")
+        payload = self._payload_from_inputs(inputs)
+        request_id = kwargs.get("request_id", "")
+
+        def _work():
+            try:
+                self._post(
+                    payload,
+                    on_chunk=lambda chunk: callback(
+                        OpenAiResult(chunk, request_id, False), None),
+                )
+                callback(OpenAiResult("", request_id, True), None)
+            except InferenceServerException as e:
+                callback(OpenAiResult("", request_id, True), e)
+            except Exception as e:
+                callback(OpenAiResult("", request_id, True),
+                         InferenceServerException(str(e)))
+
+        threading.Thread(target=_work, daemon=True).start()
 
 
 class InProcessBackend(ClientBackend):
@@ -499,7 +658,8 @@ class ClientBackendFactory:
 
     def __init__(self, kind: BackendKind, url: str = "", core=None,
                  verbose: bool = False, http_concurrency: int = 8,
-                 mock_delay_s: float = 0.0, mock_stats=None):
+                 mock_delay_s: float = 0.0, mock_stats=None,
+                 openai_endpoint: str = "/v1/chat/completions"):
         self.kind = kind
         self._url = url
         self._core = core
@@ -507,6 +667,7 @@ class ClientBackendFactory:
         self._http_concurrency = http_concurrency
         self._mock_delay = mock_delay_s
         self._mock_stats = mock_stats
+        self._openai_endpoint = openai_endpoint
 
     def create(self) -> ClientBackend:
         if self.kind == BackendKind.TRITON_GRPC:
@@ -514,6 +675,9 @@ class ClientBackendFactory:
         if self.kind == BackendKind.TRITON_HTTP:
             return HttpClientBackend(self._url, self._verbose,
                                      self._http_concurrency)
+        if self.kind == BackendKind.OPENAI:
+            return OpenAiClientBackend(self._url, self._openai_endpoint,
+                                       self._verbose)
         if self.kind == BackendKind.IN_PROCESS:
             if self._core is None:
                 raise InferenceServerException(

@@ -170,18 +170,18 @@ class DataLoader:
                     arr = arr.reshape(shape)
                 return TensorData(arr, tensor.datatype)
             value = value.get("content")
-        arr = np.array(value)
         if tensor.datatype == "BYTES":
-            flat = np.array(
-                [
-                    v.encode() if isinstance(v, str) else bytes(v)
-                    for v in arr.reshape(-1)
-                ],
-                dtype=np.object_,
-            )
-            arr = flat.reshape(arr.shape)
+            # Structured elements (e.g. OpenAI payload objects) ride as
+            # their JSON serialization.
+            def encode(v):
+                if isinstance(v, (dict, list)):
+                    return json.dumps(v).encode()
+                return v.encode() if isinstance(v, str) else bytes(v)
+
+            listed = value if isinstance(value, list) else [value]
+            arr = np.array([encode(v) for v in listed], dtype=np.object_)
         else:
-            arr = arr.astype(triton_to_np_dtype(tensor.datatype))
+            arr = np.array(value).astype(triton_to_np_dtype(tensor.datatype))
         if shape:
             arr = arr.reshape(shape)
         elif len(tensor.shape) and -1 not in tensor.shape:

@@ -242,7 +242,15 @@ Error DataLoader::ParseValue(
     }
   }
   if (tensor.datatype == "BYTES") {
-    for (const auto& v : flat) AppendBytesElement(v.AsString(), &out->bytes);
+    for (const auto& v : flat) {
+      // Structured elements (e.g. OpenAI payload objects) ride as
+      // their JSON serialization.
+      if (v.IsObject() || v.IsArray()) {
+        AppendBytesElement(v.Serialize(), &out->bytes);
+      } else {
+        AppendBytesElement(v.AsString(), &out->bytes);
+      }
+    }
     return Error::Success;
   }
   for (const auto& v : flat) {

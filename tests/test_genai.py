@@ -145,3 +145,35 @@ def test_genai_cli_e2e_inprocess(tmp_path):
     exp = doc["experiments"][0]
     assert "time_to_first_token_ms" in exp
     assert exp["output_token_throughput_per_s"]["value"] > 0
+
+
+def test_genai_cli_e2e_openai(tmp_path):
+    """genai over the OpenAI-compatible endpoint: SSE chunks become
+    TTFT / inter-token metrics (parity: genai-perf's openai
+    endpoint-format path)."""
+    from client_tpu.genai.main import run
+    from client_tpu.server.app import build_core
+    from client_tpu.server.http_server import start_http_server_thread
+
+    core = build_core(["llm_tiny"])
+    runner = start_http_server_thread(core, host="127.0.0.1", port=0)
+    json_out = tmp_path / "stats.json"
+    try:
+        rc = run([
+            "-m", "llm_tiny", "--service-kind", "openai",
+            "-u", "127.0.0.1:%d" % runner.port,
+            "--endpoint", "v1/chat/completions",
+            "--num-prompts", "3", "--output-tokens-mean", "4",
+            "--synthetic-input-tokens-mean", "12",
+            "--measurement-interval", "600", "--max-trials", "2",
+            "--stability-percentage", "90",
+            "--artifact-dir", str(tmp_path),
+            "--export-json", str(json_out),
+        ])
+    finally:
+        runner.stop()
+    assert rc == 0
+    doc = json.loads(json_out.read_text())
+    exp = doc["experiments"][0]
+    assert "time_to_first_token_ms" in exp
+    assert "inter_token_latency_ms" in exp
