@@ -123,8 +123,7 @@ def main():
     try:
         try:
             binary = build_native()
-            # Warm the model's compiled path before measuring.
-            warm, _ = run_native(binary, handle.address)
+            # Stability trials absorb warm-up; one invocation measures.
             throughput, p50_us = run_native(binary, handle.address)
         except Exception as native_err:
             print("native harness unavailable (%s); using Python harness"

@@ -120,6 +120,9 @@ class BertModel(ServedModel):
 
     platform = "jax"
     max_batch_size = 16
+    dynamic_batching = True
+    preferred_batch_sizes = [4, 8, 16]
+    max_queue_delay_us = 100
 
     def __init__(self, name: str = "bert_base", cfg: Optional[BertConfig]
                  = None, seed: int = 0):
@@ -136,10 +139,6 @@ class BertModel(ServedModel):
         self._fn = jax.jit(
             lambda p, ids, mask: forward(p, ids, mask, cfg_static)
         )
-
-    def _extend_config(self, config: mc.ModelConfig) -> None:
-        config.dynamic_batching.preferred_batch_size.extend([4, 8, 16])
-        config.dynamic_batching.max_queue_delay_microseconds = 100
 
     def infer(self, inputs, parameters=None):
         ids = np.asarray(inputs["input_ids"])

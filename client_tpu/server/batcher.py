@@ -1,0 +1,204 @@
+"""Server-side dynamic batching.
+
+The TPU-first equivalent of Triton's dynamic batcher (the scheduler
+the reference's perf docs benchmark against and which BASELINE.md's
+"BERT dynamic batch" config presumes): concurrent single requests are
+fused along the batch dimension into one XLA call — larger MXU
+matmuls, one compile-shape per preferred size, far less per-request
+dispatch overhead — then the stacked outputs are split back per
+request.
+
+Requests are only fused when their per-sample shapes match; shape
+changes flush the current bucket. Sequence requests bypass batching
+entirely (state is per-request)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from client_tpu.utils import InferenceServerException
+
+NANOS_PER_US = 1_000
+
+
+class _Pending:
+    __slots__ = ("inputs", "params", "batch", "shape_key", "event",
+                 "outputs", "error", "enqueue_ns", "queue_ns", "leader")
+
+    def __init__(self, inputs, params, batch, shape_key):
+        self.inputs = inputs
+        self.params = params
+        self.batch = batch
+        self.shape_key = shape_key
+        self.event = threading.Event()
+        self.outputs = None
+        self.error: Optional[Exception] = None
+        self.enqueue_ns = time.monotonic_ns()
+        self.queue_ns = 0
+        # True for the request that represents the fused execution in
+        # the server's execution_count statistic.
+        self.leader = False
+
+
+class DynamicBatcher:
+    """One batcher (and gather thread) per served model."""
+
+    def __init__(self, model, max_queue_delay_us: int = 500,
+                 preferred_batch_sizes: Optional[List[int]] = None):
+        self._model = model
+        self._max_batch = max(int(model.max_batch_size), 1)
+        self._delay_ns = max_queue_delay_us * NANOS_PER_US
+        self._preferred = sorted(
+            s for s in (preferred_batch_sizes or []) if s <= self._max_batch
+        )
+        self._queue: List[_Pending] = []
+        self._cv = threading.Condition()
+        self._stopping = False
+        self._thread = threading.Thread(target=self._gather_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        with self._cv:
+            self._stopping = True
+            self._cv.notify_all()
+        self._thread.join(timeout=5)
+
+    # -- request side ----------------------------------------------------
+
+    def infer(self, inputs: Dict[str, np.ndarray], params: dict,
+              batch: int) -> Dict[str, np.ndarray]:
+        """Blocks until this request's slice of a fused execution is
+        ready. `batch` is the request's own batch-dim size."""
+        shape_key = tuple(
+            (name, array.shape[1:], array.dtype.str)
+            for name, array in sorted(inputs.items())
+        )
+        pending = _Pending(inputs, params, batch, shape_key)
+        with self._cv:
+            self._queue.append(pending)
+            self._cv.notify_all()
+        pending.event.wait()
+        if pending.error is not None:
+            raise pending.error
+        return pending.outputs, pending.queue_ns, pending.leader
+
+    # -- gather thread ---------------------------------------------------
+
+    def _gather_loop(self):
+        while True:
+            bucket: List[_Pending] = []
+            with self._cv:
+                while not self._queue and not self._stopping:
+                    self._cv.wait()
+                if self._stopping and not self._queue:
+                    return
+                first = self._queue.pop(0)
+                bucket = [first]
+                total = first.batch
+                deadline = first.enqueue_ns + self._delay_ns
+                # Gather shape-compatible requests until the batch is
+                # full or the first request's delay budget expires.
+                while total < self._max_batch:
+                    if self._take_compatible(bucket, first.shape_key,
+                                             total):
+                        total = sum(p.batch for p in bucket)
+                        if self._at_preferred(total):
+                            break
+                        continue
+                    now = time.monotonic_ns()
+                    if now >= deadline or self._stopping:
+                        break
+                    self._cv.wait(
+                        timeout=(deadline - now) / 1e9)
+            self._execute(bucket)
+
+    def _take_compatible(self, bucket, shape_key, total) -> bool:
+        """Moves the next compatible queued request into the bucket
+        (caller holds the lock). Returns False when none fits."""
+        for i, pending in enumerate(self._queue):
+            if pending.shape_key != shape_key:
+                continue
+            if total + pending.batch > self._max_batch:
+                continue
+            bucket.append(self._queue.pop(i))
+            return True
+        return False
+
+    def _at_preferred(self, total) -> bool:
+        # Stop gathering only once the LARGEST preferred size is
+        # reached — smaller preferred sizes are padding targets, not
+        # gather limits.
+        return bool(self._preferred) and total >= self._preferred[-1]
+
+    def _padded_size(self, total: int) -> int:
+        """Rounds the fused batch up to a stable compile shape: the
+        smallest preferred size that fits, else the next power of two
+        (capped at max_batch). XLA traces once per shape — unpadded
+        fusing would recompile for every distinct request mix."""
+        for size in self._preferred:
+            if total <= size:
+                return size
+        if total >= self._max_batch:
+            return self._max_batch
+        size = 1
+        while size < total:
+            size <<= 1
+        return min(size, self._max_batch)
+
+    def _execute(self, bucket: List[_Pending]):
+        start_ns = time.monotonic_ns()
+        bucket[0].leader = True
+        for pending in bucket:
+            pending.queue_ns = start_ns - pending.enqueue_ns
+        try:
+            total = sum(p.batch for p in bucket)
+            target = self._padded_size(total)
+            if len(bucket) == 1 and bucket[0].batch == target:
+                bucket[0].outputs = self._model.infer(
+                    bucket[0].inputs, bucket[0].params)
+            else:
+                arrays = {
+                    name: [p.inputs[name] for p in bucket]
+                    for name in bucket[0].inputs
+                }
+                if target > total:
+                    # Pad with repeats of the final row; padded rows
+                    # are computed and discarded.
+                    for name, chunks in arrays.items():
+                        pad = np.repeat(
+                            chunks[-1][-1:], target - total, axis=0)
+                        chunks.append(pad)
+                fused = {
+                    name: np.concatenate(chunks, axis=0)
+                    for name, chunks in arrays.items()
+                }
+                outputs = self._model.infer(fused, bucket[0].params)
+                offset = 0
+                for pending in bucket:
+                    pending.outputs = {
+                        name: array[offset:offset + pending.batch]
+                        for name, array in outputs.items()
+                    }
+                    offset += pending.batch
+        except Exception as e:
+            error = e if isinstance(e, InferenceServerException) else \
+                InferenceServerException(
+                    "batched inference failed: %s" % e, status="INTERNAL")
+            for pending in bucket:
+                pending.error = error
+        finally:
+            for pending in bucket:
+                pending.event.set()
+
+
+def wants_dynamic_batching(model) -> bool:
+    return (
+        getattr(model, "dynamic_batching", False)
+        and int(getattr(model, "max_batch_size", 0)) > 1
+        and not getattr(model, "decoupled", False)
+    )

@@ -65,12 +65,12 @@ class _ModelStats:
         self.last_inference_ms = 0
 
     def record(self, batch: int, queue_ns: int, ci_ns: int, infer_ns: int,
-               co_ns: int, ok: bool):
+               co_ns: int, ok: bool, executions: int = 1):
         total = queue_ns + ci_ns + infer_ns + co_ns
         with self.lock:
             if ok:
                 self.inference_count += batch
-                self.execution_count += 1
+                self.execution_count += executions
                 self.success_count += 1
                 self.success_ns += total
                 self.queue_ns += queue_ns
@@ -94,6 +94,8 @@ class InferenceServerCore:
         self.memory = SharedMemoryManager(tpu_arena)
         self._stats: Dict[str, _ModelStats] = {}
         self._stats_lock = threading.Lock()
+        self._batchers: Dict[str, object] = {}
+        self._batchers_lock = threading.Lock()
         self._trace_settings: Dict[str, Dict[str, list]] = {"": {
             "trace_file": [""], "trace_level": ["OFF"], "trace_rate": ["1000"],
             "trace_count": ["-1"], "log_frequency": ["0"],
@@ -266,18 +268,56 @@ class InferenceServerCore:
         model.warmup()
 
     def unload_model(self, name: str) -> None:
+        with self._batchers_lock:
+            batcher = self._batchers.pop(name, None)
+        if batcher is not None:
+            batcher.stop()
         self.repository.unload(name)
 
     # -- inference -------------------------------------------------------
+
+    def _batcher_for(self, model):
+        """Lazily creates the model's dynamic batcher (None when the
+        model doesn't opt in)."""
+        from client_tpu.server.batcher import (
+            DynamicBatcher,
+            wants_dynamic_batching,
+        )
+
+        if not wants_dynamic_batching(model):
+            return None
+        with self._batchers_lock:
+            batcher = self._batchers.get(model.name)
+            if batcher is None:
+                batcher = DynamicBatcher(
+                    model,
+                    max_queue_delay_us=int(
+                        getattr(model, "max_queue_delay_us", 500)),
+                    preferred_batch_sizes=list(
+                        getattr(model, "preferred_batch_sizes", []) or []),
+                )
+                self._batchers[model.name] = batcher
+            return batcher
 
     def infer(self, request: pb.ModelInferRequest) -> pb.ModelInferResponse:
         model = self.repository.get(request.model_name, request.model_version)
         stats = self._stats_for(model.name)
         t0 = time.monotonic_ns()
+        queue_ns = 0
+        executions = 1
         try:
             inputs, params = self._decode_inputs(model, request)
             t1 = time.monotonic_ns()
-            outputs = model.infer(inputs, params)
+            batcher = self._batcher_for(model)
+            if batcher is not None and "sequence_id" not in params:
+                batch = self._batch_size(model, request)
+                outputs, queue_ns, leader = batcher.infer(
+                    inputs, params, batch)
+                # Fused requests share one model execution; only its
+                # leader bumps execution_count (Triton semantics).
+                executions = 1 if leader else 0
+            else:
+                outputs = model.infer(inputs, params)
             t2 = time.monotonic_ns()
             response = self._encode_response(model, request, outputs)
             t3 = time.monotonic_ns()
@@ -291,7 +331,8 @@ class InferenceServerCore:
                 status="INTERNAL",
             )
         batch = self._batch_size(model, request)
-        stats.record(batch, 0, t1 - t0, t2 - t1, t3 - t2, ok=True)
+        stats.record(batch, queue_ns, t1 - t0, (t2 - t1) - queue_ns,
+                     t3 - t2, ok=True, executions=executions)
         return response
 
     def stream_infer(

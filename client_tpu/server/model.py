@@ -51,6 +51,11 @@ class ServedModel:
     platform: str = "jax"
     max_batch_size: int = 0
     decoupled: bool = False
+    # Server-side dynamic batching (client_tpu.server.batcher): fuse
+    # concurrent requests along the batch dim into one XLA call.
+    dynamic_batching: bool = False
+    preferred_batch_sizes: list = []
+    max_queue_delay_us: int = 500
 
     def __init__(self):
         self.inputs: List[TensorSpec] = []
@@ -119,6 +124,11 @@ class ServedModel:
                 dims=spec.shape,
             )
         config.model_transaction_policy.decoupled = self.decoupled
+        if self.dynamic_batching:
+            config.dynamic_batching.preferred_batch_size.extend(
+                self.preferred_batch_sizes)
+            config.dynamic_batching.max_queue_delay_microseconds = (
+                self.max_queue_delay_us)
         self._extend_config(config)
         return config
 

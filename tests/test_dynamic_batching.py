@@ -1,0 +1,194 @@
+"""Server-side dynamic batching tests: concurrent requests fuse along
+the batch dimension into fewer model executions (the TPU-first
+equivalent of Triton's dynamic batcher)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from client_tpu.server.batcher import DynamicBatcher, wants_dynamic_batching
+from client_tpu.server.model import ServedModel, TensorSpec
+from client_tpu.utils import InferenceServerException
+
+
+class CountingModel(ServedModel):
+    """Echo model that counts executions and records batch sizes."""
+
+    max_batch_size = 8
+    dynamic_batching = True
+
+    def __init__(self, delay_s: float = 0.0):
+        super().__init__()
+        self.name = "counting"
+        self.inputs = [TensorSpec("IN", "FP32", [4])]
+        self.outputs = [TensorSpec("OUT", "FP32", [4])]
+        self.executions = []
+        self.gate = threading.Event()
+        self.gate.set()
+        self._delay = delay_s
+
+    def infer(self, inputs, parameters=None):
+        self.gate.wait()
+        if self._delay:
+            import time
+
+            time.sleep(self._delay)
+        array = np.asarray(inputs["IN"])
+        self.executions.append(array.shape[0])
+        return {"OUT": array * 2.0}
+
+
+def test_wants_dynamic_batching():
+    assert wants_dynamic_batching(CountingModel())
+
+    class NoBatch(ServedModel):
+        max_batch_size = 8
+
+    assert not wants_dynamic_batching(NoBatch())
+
+    class Decoupled(CountingModel):
+        decoupled = True
+
+    assert not wants_dynamic_batching(Decoupled())
+
+
+def test_fuses_concurrent_requests():
+    model = CountingModel()
+    model.gate.clear()  # hold the first execution so requests pile up
+    batcher = DynamicBatcher(model, max_queue_delay_us=200000)
+    results = [None] * 6
+    errors = []
+
+    def one(i):
+        try:
+            data = np.full((1, 4), float(i), dtype=np.float32)
+            outputs, queue_ns, _ = batcher.infer({"IN": data}, {}, 1)
+            results[i] = (outputs["OUT"], queue_ns)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    import time
+
+    time.sleep(0.1)  # let every request enqueue
+    model.gate.set()
+    for t in threads:
+        t.join(timeout=10)
+    batcher.stop()
+
+    assert not errors
+    # Far fewer executions than requests; fused batches may be padded
+    # up to a stable compile shape but never above max batch.
+    assert len(model.executions) < 6
+    assert sum(model.executions) >= 6
+    assert max(model.executions) <= model.max_batch_size
+    for i, (out, queue_ns) in enumerate(results):
+        assert out.shape == (1, 4)
+        np.testing.assert_array_equal(out, np.full((1, 4), i * 2.0))
+        assert queue_ns >= 0
+
+
+def test_shape_mismatch_not_fused():
+    model = CountingModel()
+
+    class VarModel(CountingModel):
+        def __init__(self):
+            super().__init__()
+            self.inputs = [TensorSpec("IN", "FP32", [-1])]
+
+    model = VarModel()
+    model.gate.clear()
+    batcher = DynamicBatcher(model, max_queue_delay_us=100000)
+    done = []
+
+    def one(width):
+        data = np.zeros((1, width), dtype=np.float32)
+        outputs, _, _ = batcher.infer({"IN": data}, {}, 1)
+        done.append(outputs["OUT"].shape)
+
+    threads = [threading.Thread(target=one, args=(w,)) for w in (4, 4, 8)]
+    for t in threads:
+        t.start()
+    import time
+
+    time.sleep(0.1)
+    model.gate.set()
+    for t in threads:
+        t.join(timeout=10)
+    batcher.stop()
+    # Two width-4 requests fused (padded to 2); the width-8 request
+    # ran alone (padded to its own compile shape).
+    assert len(model.executions) == 2
+
+
+def test_error_propagates_to_every_request():
+    class FailingModel(CountingModel):
+        def infer(self, inputs, parameters=None):
+            super().infer(inputs, parameters)
+            raise InferenceServerException("boom", status="INTERNAL")
+
+    model = FailingModel()
+    model.gate.clear()
+    batcher = DynamicBatcher(model, max_queue_delay_us=100000)
+    errors = []
+
+    def one():
+        try:
+            batcher.infer(
+                {"IN": np.zeros((1, 4), dtype=np.float32)}, {}, 1)
+        except InferenceServerException as e:
+            errors.append(str(e))
+
+    threads = [threading.Thread(target=one) for _ in range(3)]
+    for t in threads:
+        t.start()
+    import time
+
+    time.sleep(0.05)
+    model.gate.set()
+    for t in threads:
+        t.join(timeout=10)
+    batcher.stop()
+    assert len(errors) == 3
+
+
+def test_e2e_server_fuses_and_reports_queue_time():
+    """Concurrent gRPC clients against a dynamic-batching model: the
+    server reports execution_count < inference_count and non-zero
+    cumulative queue time."""
+    import client_tpu.grpc as grpcclient
+    from client_tpu.server.app import build_core, start_grpc_server
+
+    core = build_core([])
+    model = CountingModel(delay_s=0.005)
+    core.repository.add_model(model)
+    handle = start_grpc_server(core=core)
+    try:
+        def worker():
+            with grpcclient.InferenceServerClient(handle.address) as client:
+                inputs = [grpcclient.InferInput("IN", [1, 4], "FP32")]
+                inputs[0].set_data_from_numpy(
+                    np.ones((1, 4), dtype=np.float32))
+                for _ in range(10):
+                    result = client.infer("counting", inputs)
+                    np.testing.assert_array_equal(
+                        result.as_numpy("OUT"),
+                        np.full((1, 4), 2.0, dtype=np.float32))
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+
+        stats = core.model_statistics("counting").model_stats[0]
+        assert stats.inference_count == 40
+        assert stats.execution_count < 40, (
+            "no fusing happened (executions=%d)" % stats.execution_count
+        )
+        assert stats.inference_stats.queue.ns > 0
+    finally:
+        handle.stop()
