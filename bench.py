@@ -1,147 +1,153 @@
 #!/usr/bin/env python
-"""Round benchmark — the north-star config (BASELINE.json): ResNet-50
-served over gRPC with TPU shared-memory I/O (batch 8, async,
-concurrency 4), client+server co-located.
+"""Round benchmark orchestrator.
 
-Prefers the native C++ perf_analyzer (the reference's harness is C++;
-ours measures with the same client stack users would deploy), falling
-back to the Python harness when the native build is unavailable.
+Never imports jax itself: all JAX/TPU work happens in a child process
+(`client_tpu.perf.bench_child`) run under hard wall-clock deadlines, so
+a slow TPU-platform initialization can never leave the driver with no
+number at all.  Staged degradation:
 
-Prints exactly ONE JSON line. ``vs_baseline`` compares against the
-only ResNet-50 throughput the reference publishes (165.8 infer/sec,
-TF-Serving GRPC batch 1, docs/benchmarking.md:121 — illustrative, not
-hardware-matched; the reference publishes no CUDA-shm number).
+  attempt 1: child on the image's default platform (TPU on the driver)
+             — killed if jax init misses its deadline;
+  attempt 2: child forced onto CPU — init is seconds, a number on CPU
+             beats a timeout with nothing.
+
+The child measures (budget permitting) `simple` over gRPC, `simple`
+in-process (the RPC-tax comparison, analogue of the reference's C-API
+mode — reference docs/benchmarking.md:75), then the headline resnet50
+batch-8 gRPC + TPU-shared-memory config (BASELINE.json north star),
+writing a cumulative result file after every stage.  This process
+prints exactly ONE JSON line: the best headline available plus every
+stage's numbers.
+
+``vs_baseline`` compares against the only matching throughput the
+reference publishes (resnet50: 165.8 infer/sec TF-Serving GRPC batch 1,
+docs/benchmarking.md:121; simple: 1407.84 infer/sec HTTP sync,
+docs/quick_start.md:94 — illustrative, not hardware-matched).
 """
 
 import json
 import os
 import pathlib
+import signal
 import subprocess
 import sys
 import time
 
 REPO = pathlib.Path(__file__).resolve().parent
-BASELINE = 165.8  # reference resnet50 TF-Serving GRPC (batch 1)
-BATCH = 8
-CONCURRENCY = 4
 
 
-def build_native() -> pathlib.Path:
-    """Returns the perf_analyzer binary path, building it if needed."""
-    build = REPO / "native" / "build"
-    binary = build / "perf_analyzer"
-    if binary.exists():
-        return binary
-    subprocess.run(
-        ["cmake", "-S", str(REPO / "native"), "-B", str(build), "-G",
-         "Ninja"],
-        check=True, capture_output=True, timeout=300,
-    )
-    subprocess.run(
-        ["ninja", "-C", str(build), "perf_analyzer"],
-        check=True, capture_output=True, timeout=600,
-    )
-    return binary
+def log(msg: str) -> None:
+    print("[bench %7.1fs] %s" % (time.time() - T0, msg), file=sys.stderr,
+          flush=True)
 
 
-def run_native(binary: pathlib.Path, address: str):
-    """One stable concurrency-4 measurement via the C++ harness;
-    returns (throughput, p50_us)."""
-    export = "/tmp/bench_profile.json"
-    csv = "/tmp/bench_latency.csv"
-    proc = subprocess.run(
-        [str(binary), "-m", "resnet50", "-u", address,
-         "-b", str(BATCH), "--shared-memory", "tpu",
-         "--output-shared-memory-size", str(BATCH * 1000 * 4 + 1024),
-         "--concurrency-range", str(CONCURRENCY),
-         "-p", "4000", "-r", "6", "-s", "15",
-         "-f", csv, "--profile-export-file", export],
-        capture_output=True, text=True, timeout=600,
-    )
-    if proc.returncode != 0:
-        raise RuntimeError("perf_analyzer failed: %s" % proc.stderr[-500:])
-    with open(csv) as f:
-        f.readline()  # header
-        row = f.readline().strip().split(",")
-    throughput = float(row[1])
-    p50_us = float(row[2])
-    return throughput, p50_us
+T0 = time.time()
 
 
-def run_python_harness(handle):
-    from client_tpu.perf.client_backend import (
-        BackendKind,
-        ClientBackendFactory,
-    )
-    from client_tpu.perf.data_loader import DataLoader
-    from client_tpu.perf.load_manager import (
-        ConcurrencyManager,
-        InferDataManager,
-    )
-    from client_tpu.perf.model_parser import ModelParser
-    from client_tpu.perf.profiler import InferenceProfiler, MeasurementConfig
-
-    factory = ClientBackendFactory(BackendKind.TRITON_GRPC,
-                                   url=handle.address)
-    setup_backend = factory.create()
-    model = ModelParser().parse(setup_backend, "resnet50",
-                                batch_size=BATCH)
-    loader = DataLoader(model)
-    loader.generate_data()
-    data_manager = InferDataManager(
-        model, loader, shared_memory="tpu",
-        output_shm_size=BATCH * 1000 * 4 + 1024,
-        tpu_arena_url=handle.address, batch_size=BATCH,
-    )
-    manager = ConcurrencyManager(
-        factory=factory, model=model, data_loader=loader,
-        data_manager=data_manager, async_mode=True, max_threads=8,
-    )
-    manager.init()
-    config = MeasurementConfig(
-        measurement_interval_ms=4000, max_trials=6,
-        stability_threshold=0.15,
-    )
-    profiler = InferenceProfiler(manager, config, setup_backend, "resnet50")
-    manager.change_concurrency_level(1)
-    time.sleep(8)  # warm the compiled path before measuring
-    results = profiler.profile_concurrency_range(CONCURRENCY, CONCURRENCY)
-    manager.cleanup()
-    setup_backend.close()
-    status = results[-1]
-    return status.throughput, status.latency_percentiles.get(50, 0)
-
-
-def main():
-    sys.path.insert(0, str(REPO))
-    os.chdir(REPO)
-    from client_tpu.server.app import build_core, start_grpc_server
-
-    core = build_core(["resnet50"])
-    handle = start_grpc_server(core=core)
-    harness = "native"
+def run_child(platform: str, init_deadline_s: float, deadline_ts: float):
+    """Run one bench child; returns the parsed result dict or None."""
+    out = pathlib.Path("/tmp/bench_result.json")
+    marker = pathlib.Path("/tmp/bench_init_marker.json")
+    for p in (out, marker):
+        if p.exists():
+            p.unlink()
+    cmd = [sys.executable, "-m", "client_tpu.perf.bench_child",
+           "--out", str(out), "--init-marker", str(marker),
+           "--deadline-ts", str(deadline_ts)]
+    env = dict(os.environ)
+    if platform:
+        cmd += ["--platform", platform]
+        if platform == "cpu":
+            # The image's sitecustomize force-registers the axon TPU
+            # platform; both knobs must be set before the interpreter
+            # starts for the child to come up CPU-only.
+            env["JAX_PLATFORMS"] = "cpu"
+            env["PALLAS_AXON_POOL_IPS"] = ""
+    log("spawning child (platform=%s, init deadline %.0fs, total %.0fs)"
+        % (platform or "default", init_deadline_s, deadline_ts - time.time()))
+    child = subprocess.Popen(cmd, cwd=str(REPO), stdout=sys.stderr,
+                             stderr=sys.stderr, env=env)
+    init_by = min(time.time() + init_deadline_s, deadline_ts)
     try:
-        try:
-            binary = build_native()
-            # Stability trials absorb warm-up; one invocation measures.
-            throughput, p50_us = run_native(binary, handle.address)
-        except Exception as native_err:
-            print("native harness unavailable (%s); using Python harness"
-                  % native_err, file=sys.stderr)
-            harness = "python"
-            throughput, p50_us = run_python_harness(handle)
+        while child.poll() is None and not marker.exists():
+            if time.time() > init_by:
+                log("child missed init deadline — killing")
+                child.kill()
+                child.wait()
+                return None
+            time.sleep(1)
+        # Initialized (or exited); wait for completion until the final
+        # deadline, then SIGINT (child flushes partials) and reap.
+        while child.poll() is None and time.time() < deadline_ts:
+            time.sleep(1)
+        if child.poll() is None:
+            log("deadline reached — SIGINT to child")
+            child.send_signal(signal.SIGINT)
+            try:
+                child.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                child.kill()
+                child.wait()
     finally:
-        handle.stop()
+        if child.poll() is None:
+            child.kill()
+            child.wait()
+    if out.exists():
+        try:
+            return json.loads(out.read_text())
+        except ValueError:
+            log("result file unparseable")
+    return None
 
-    print(json.dumps({
-        "metric": "resnet50_tpu_shm_grpc_batch8_c4_infer_per_sec",
-        "value": round(throughput, 2),
+
+def main() -> None:
+    os.chdir(REPO)
+    # Round-1 evidence: the driver let bench.py run >=25 min before
+    # rc=124, and TPU ('axon') platform init alone can take ~10+ min.
+    # 25 min total leaves the TPU attempt a real init window while
+    # keeping the CPU fallback (needs ~5 min) reachable.
+    budget = float(os.environ.get("BENCH_BUDGET_S", "1500"))
+    deadline_ts = T0 + budget - 30  # leave margin for this process
+
+    # Attempt 1: default platform (TPU on the driver). Give init at
+    # most 60% of budget; TPU platform bring-up on this image can be
+    # minutes.
+    result = run_child("", init_deadline_s=budget * 0.6,
+                       deadline_ts=deadline_ts)
+    if (result is None or not result.get("stages")) \
+            and deadline_ts - time.time() > 120:
+        log("falling back to CPU platform")
+        result = run_child("cpu", init_deadline_s=120.0,
+                           deadline_ts=deadline_ts)
+    if result is None or not result.get("stages"):
+        print(json.dumps({"metric": "bench_failed", "value": 0,
+                          "unit": "infer/sec", "vs_baseline": 0}))
+        sys.exit(1)
+
+    stages = result["stages"]
+    for head_key, head_name in (
+        ("resnet50_tpu_shm_grpc",
+         "resnet50_tpu_shm_grpc_batch8_c4_infer_per_sec"),
+        ("simple_grpc", "simple_grpc_c4_infer_per_sec"),
+    ):
+        if head_key in stages:
+            head = stages[head_key]
+            break
+    else:
+        head_key, head = next(iter(stages.items()))
+        head_name = head_key + "_infer_per_sec"
+    line = {
+        "metric": head_name,
+        "value": head["throughput"],
         "unit": "infer/sec",
-        "vs_baseline": round(throughput / BASELINE, 4),
-        "p50_latency_us": round(p50_us, 1),
-        "batch": BATCH,
-        "harness": harness,
-    }))
+        "vs_baseline": head.get("vs_baseline", 0),
+        "p50_latency_us": head["p50_latency_us"],
+        "platform": result.get("platform"),
+        "harness": result.get("harness"),
+        "stages": stages,
+        "wall_s": round(time.time() - T0, 1),
+    }
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":
