@@ -50,7 +50,8 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("-i", "--protocol", choices=["grpc", "http"],
                         default="grpc")
     parser.add_argument("--service-kind", default="triton",
-                        choices=["triton", "inprocess", "openai"])
+                        choices=["triton", "inprocess", "openai",
+                                 "torchserve", "tfserving"])
     parser.add_argument("--endpoint", default="v1/chat/completions",
                         help="openai service-kind request path")
     parser.add_argument("-b", "--batch-size", type=int, default=1)
@@ -125,6 +126,12 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
             BackendKind.OPENAI, url=args.url, verbose=args.verbose,
             openai_endpoint=args.endpoint,
         )
+    elif args.service_kind in ("torchserve", "tfserving"):
+        factory = ClientBackendFactory(
+            BackendKind.TORCHSERVE if args.service_kind == "torchserve"
+            else BackendKind.TFSERVING,
+            url=args.url, verbose=args.verbose,
+        )
     elif args.service_kind == "inprocess":
         if core is None:
             from client_tpu.server.app import build_core
@@ -198,6 +205,11 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
         stability_threshold=args.stability_percentage / 100.0,
         latency_threshold_ms=args.latency_threshold,
         percentile=args.percentile,
+        # REST/chat service kinds send one logical inference per
+        # request regardless of -b (their payloads are not batched).
+        batch_size=(args.batch_size
+                    if args.service_kind in ("triton", "inprocess")
+                    else 1),
     )
 
     manager_args = dict(

@@ -171,14 +171,23 @@ class DataLoader:
                 return TensorData(arr, tensor.datatype)
             value = value.get("content")
         if tensor.datatype == "BYTES":
-            # Structured elements (e.g. OpenAI payload objects) ride as
-            # their JSON serialization.
+            # Nested lists (multi-dimensional BYTES tensors) flatten
+            # element-wise; only structured dict elements (e.g. OpenAI
+            # payload objects) ride as their JSON serialization.
             def encode(v):
-                if isinstance(v, (dict, list)):
+                if isinstance(v, dict):
                     return json.dumps(v).encode()
                 return v.encode() if isinstance(v, str) else bytes(v)
 
-            listed = value if isinstance(value, list) else [value]
+            def flatten(v):
+                if isinstance(v, list):
+                    for item in v:
+                        yield from flatten(item)
+                else:
+                    yield v
+
+            listed = list(flatten(value)) if isinstance(value, list) \
+                else [value]
             arr = np.array([encode(v) for v in listed], dtype=np.object_)
         else:
             arr = np.array(value).astype(triton_to_np_dtype(tensor.datatype))

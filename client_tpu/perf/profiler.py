@@ -57,6 +57,7 @@ class MeasurementConfig:
         stability_threshold: float = 0.1,
         latency_threshold_ms: float = 0.0,
         percentile: int = 0,  # 0 = use average for stability
+        batch_size: int = 1,
     ):
         self.interval_ms = measurement_interval_ms
         self.mode = measurement_mode
@@ -65,6 +66,10 @@ class MeasurementConfig:
         self.stability = stability_threshold
         self.latency_threshold_ms = latency_threshold_ms
         self.percentile = percentile
+        # Inferences per request: throughput is inferences/sec
+        # (requests x batch / window), reference semantics
+        # (inference_profiler.cc valid_request_count * batch_size).
+        self.batch_size = batch_size
 
 
 class InferenceProfiler:
@@ -219,7 +224,10 @@ class InferenceProfiler:
             status.latency_percentiles[self._config.percentile] = float(
                 np.percentile(latencies_us, self._config.percentile)
             )
-        status.throughput = len(valid) / window_s if window_s > 0 else 0.0
+        status.throughput = (
+            len(valid) * self._config.batch_size / window_s
+            if window_s > 0 else 0.0
+        )
         if self._backend is not None and self._model_name:
             try:
                 status.server_stats = self._backend.model_statistics(
@@ -288,7 +296,8 @@ class InferenceProfiler:
             (t.window_end_ns - t.window_start_ns) / NANOS for t in trials
         )
         merged.throughput = (
-            merged.completed_count / window_s if window_s > 0 else 0.0
+            merged.completed_count * self._config.batch_size / window_s
+            if window_s > 0 else 0.0
         )
         merged.server_stats = trials[-1].server_stats
         families = {f for t in trials for f in t.tpu_metrics}

@@ -74,9 +74,12 @@ class DynamicBatcher:
               batch: int) -> Dict[str, np.ndarray]:
         """Blocks until this request's slice of a fused execution is
         ready. `batch` is the request's own batch-dim size."""
-        shape_key = tuple(
-            (name, array.shape[1:], array.dtype.str)
-            for name, array in sorted(inputs.items())
+        shape_key = (
+            tuple(
+                (name, array.shape[1:], array.dtype.str)
+                for name, array in sorted(inputs.items())
+            ),
+            _params_fingerprint(params),
         )
         pending = _Pending(inputs, params, batch, shape_key)
         with self._cv:
@@ -194,6 +197,18 @@ class DynamicBatcher:
         finally:
             for pending in bucket:
                 pending.event.set()
+
+
+def _params_fingerprint(params: dict):
+    """Normalized, hashable view of request parameters. Requests are
+    only fused when their parameters match — fusing would otherwise
+    execute the whole bucket with the leader's params, silently
+    dropping the rest (priority, timeout, custom params)."""
+    if not params:
+        return ()
+    return tuple(
+        (key, repr(params[key])) for key in sorted(params)
+    )
 
 
 def wants_dynamic_batching(model) -> bool:

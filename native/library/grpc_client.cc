@@ -653,7 +653,7 @@ Error InferenceServerGrpcClient::StartStream(
           cv_.notify_all();
         }
       },
-      headers);
+      stream_headers);
 }
 
 Error InferenceServerGrpcClient::StopStream() {

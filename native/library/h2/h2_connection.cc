@@ -503,7 +503,13 @@ void H2Connection::HandleFrame(
               peer_max_concurrent_ = value;
               break;
             case kSettingsHeaderTableSize:
-              decoder_.SetSettingsCap(value);
+              // The peer's SETTINGS_HEADER_TABLE_SIZE constrains OUR
+              // encoder's dynamic table (which is stateless: every
+              // header is sent as a non-indexed literal, so any value
+              // is honored). The decoder's cap stays at the locally
+              // advertised size (4096 default) — lowering it from the
+              // peer's value would reject the peer's own legitimate
+              // table-size updates.
               break;
             default:
               break;

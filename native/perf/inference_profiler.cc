@@ -175,7 +175,9 @@ void InferenceProfiler::Summarize(
   }
   double window_s = (end_ns - start_ns) / 1e9;
   status->throughput =
-      window_s > 0 ? status->completed_count / window_s : 0.0;
+      window_s > 0
+          ? status->completed_count * config_.batch_size / window_s
+          : 0.0;
 }
 
 bool InferenceProfiler::IsStable(
@@ -259,7 +261,9 @@ PerfStatus InferenceProfiler::Merge(std::vector<PerfStatus>&& trials) const {
           Percentile(latencies_us, config_.percentile);
     }
   }
-  merged.throughput = window_s > 0 ? merged.completed_count / window_s : 0.0;
+  merged.throughput =
+      window_s > 0 ? merged.completed_count * config_.batch_size / window_s
+                   : 0.0;
   return merged;
 }
 

@@ -46,6 +46,10 @@ struct PerfStatus {
 
 struct MeasurementConfig {
   uint64_t measurement_interval_ms = 5000;
+  // Inferences per request: throughput is reported in inferences/sec
+  // (completed requests x batch size / window), matching the
+  // reference's inference_profiler.cc valid_request_count semantics.
+  size_t batch_size = 1;
   bool count_windows = false;  // measure by request count, not time
   size_t measurement_request_count = 50;
   size_t max_trials = 10;

@@ -64,7 +64,11 @@ int Run(int argc, char** argv) {
   std::signal(SIGINT, SignalHandler);
 
   BackendConfig backend_config;
-  if (params.service_kind == "openai") {
+  if (params.service_kind == "torchserve") {
+    backend_config.kind = BackendKind::TORCHSERVE;
+  } else if (params.service_kind == "tfserving") {
+    backend_config.kind = BackendKind::TFSERVING;
+  } else if (params.service_kind == "openai") {
     backend_config.kind = BackendKind::OPENAI;
     backend_config.openai_endpoint = params.endpoint;
   } else {
@@ -144,6 +148,11 @@ int Run(int argc, char** argv) {
   config.measurement_interval_ms = params.measurement_interval_ms;
   config.count_windows = params.measurement_mode == "count_windows";
   config.measurement_request_count = params.measurement_request_count;
+  // REST/chat service kinds send one logical inference per request
+  // regardless of -b (their payloads are not batched).
+  config.batch_size = params.service_kind == "triton"
+                          ? static_cast<size_t>(params.batch_size)
+                          : 1;
   config.max_trials = params.max_trials;
   config.stability_threshold = params.stability_percentage / 100.0;
   config.latency_threshold_ms = params.latency_threshold_ms;
