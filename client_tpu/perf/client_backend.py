@@ -24,6 +24,8 @@ class BackendKind(enum.Enum):
     TRITON_HTTP = "http"
     IN_PROCESS = "inprocess"
     OPENAI = "openai"
+    TORCHSERVE = "torchserve"
+    TFSERVING = "tfserving"
     MOCK = "mock"
 
 
@@ -374,6 +376,220 @@ class OpenAiClientBackend(ClientBackend):
         threading.Thread(target=_work, daemon=True).start()
 
 
+class _RestResult(OpenAiResult):
+    """Result shim for plain-HTTP JSON backends (TorchServe,
+    TF-Serving REST): the OpenAI shim's worker-facing surface, always
+    final, plus JSON decoding."""
+
+    def __init__(self, body: str, request_id: str):
+        super().__init__(body, request_id, final=True)
+
+    def as_json(self):
+        import json
+
+        return json.loads(self.body) if self.body else {}
+
+
+class _PlainHttpBackend(ClientBackend):
+    """Shared plumbing for non-Triton HTTP inference APIs: one
+    http.client connection per request, sync + thread-async."""
+
+    def __init__(self, url: str, verbose: bool = False):
+        self._tls = url.startswith("https://")
+        if "://" in url:
+            url = url.split("://", 1)[1]
+        url = url.split("/", 1)[0]  # drop any path component
+        host, _, port = url.rpartition(":")
+        if host and not port.isdigit():  # IPv6 literal without port
+            host, port = url, ""
+        self._host = host or url
+        self._port = int(port) if port.isdigit() \
+            else (443 if self._tls else 8080)
+        self._verbose = verbose
+
+    def _request(self, method: str, path: str, body=None,
+                 content_type: str = "application/json") -> str:
+        import http.client
+
+        conn_cls = (http.client.HTTPSConnection if self._tls
+                    else http.client.HTTPConnection)
+        conn = conn_cls(self._host, self._port, timeout=120)
+        try:
+            headers = {"Content-Type": content_type} if body else {}
+            conn.request(method, path, body=body, headers=headers)
+            response = conn.getresponse()
+            data = response.read().decode()
+            if response.status != 200:
+                raise InferenceServerException(
+                    "HTTP %d: %s" % (response.status, data[:500]))
+            return data
+        finally:
+            conn.close()
+
+    def _async(self, callback, work):
+        def _run():
+            try:
+                callback(work(), None)
+            except InferenceServerException as e:
+                callback(None, e)
+            except Exception as e:  # transport errors
+                callback(None, InferenceServerException(str(e)))
+
+        threading.Thread(target=_run, daemon=True).start()
+
+    def model_statistics(self, model_name="", model_version=""):
+        return {"model_stats": []}
+
+    def start_stream(self, callback):
+        raise InferenceServerException(
+            "%s does not support streaming" % self.kind.value)
+
+    def async_stream_infer(self, model_name, inputs, outputs=None,
+                           **kwargs):
+        raise InferenceServerException(
+            "%s does not support streaming" % self.kind.value)
+
+
+class TorchServeBackend(_PlainHttpBackend):
+    """TorchServe inference-API client: POST the first input's raw
+    bytes to /predictions/<model> (parity: the reference's torchserve
+    client backend, client_backend/torchserve/ — file-content POST,
+    no output retrieval, no metadata endpoint)."""
+
+    kind = BackendKind.TORCHSERVE
+
+    # TorchServe has no v2 metadata endpoint; synthesize the reference
+    # shape (one BYTES "data" input fed from files or generated data).
+    def model_metadata(self, model_name, model_version=""):
+        return {
+            "name": model_name,
+            "platform": "torchserve",
+            "inputs": [{"name": "data", "datatype": "BYTES",
+                        "shape": [1]}],
+            "outputs": [],
+        }
+
+    def model_config(self, model_name, model_version=""):
+        return {"name": model_name, "max_batch_size": 0}
+
+    def server_metadata(self):
+        return {"name": "torchserve-endpoint"}
+
+    @staticmethod
+    def _body_from_inputs(inputs) -> bytes:
+        for infer_input in inputs:
+            raw = infer_input.raw_data()
+            if raw is None:
+                continue
+            if infer_input.datatype() == "BYTES":
+                # Concatenate every length-prefixed element's payload.
+                parts, offset = [], 0
+                while offset + 4 <= len(raw):
+                    (length,) = np.frombuffer(
+                        raw, np.uint32, count=1, offset=offset)
+                    offset += 4
+                    parts.append(raw[offset:offset + length])
+                    offset += int(length)
+                return b"".join(parts) if parts else raw
+            return raw
+        raise InferenceServerException(
+            "TorchServe requests need one input with data")
+
+    def infer(self, model_name, inputs, outputs=None, **kwargs):
+        body = self._body_from_inputs(inputs)
+        data = self._request(
+            "POST", "/predictions/%s" % model_name, body,
+            content_type="application/octet-stream")
+        return _RestResult(data, kwargs.get("request_id", ""))
+
+    def async_infer(self, callback, model_name, inputs, outputs=None,
+                    **kwargs):
+        self._async(callback,
+                    lambda: self.infer(model_name, inputs, outputs,
+                                       **kwargs))
+
+
+class TfServingBackend(_PlainHttpBackend):
+    """TensorFlow-Serving client over the REST predict API
+    (/v1/models/<m>:predict, columnar "inputs" format). The reference
+    uses the gRPC PredictionService (client_backend/tensorflow_serving/
+    tfserve_grpc_client.cc Predict) — same request semantics; REST is
+    used here so no TensorFlow proto tree is vendored."""
+
+    kind = BackendKind.TFSERVING
+
+    def model_metadata(self, model_name, model_version=""):
+        import json
+
+        path = "/v1/models/%s" % model_name
+        if model_version:
+            path += "/versions/%s" % model_version
+        try:
+            meta = json.loads(self._request("GET", path + "/metadata"))
+        except Exception:
+            meta = {}
+        inputs, outputs = [], []
+        sig = (meta.get("metadata", {}).get("signature_def", {})
+               .get("signature_def", {}).get("serving_default", {}))
+        for name, spec in (sig.get("inputs") or {}).items():
+            dims = [int(d.get("size", -1))
+                    for d in spec.get("tensor_shape", {}).get("dim", [])]
+            inputs.append({"name": name,
+                           "datatype": _TF_TO_TRITON_DTYPE.get(
+                               spec.get("dtype", ""), "FP32"),
+                           "shape": dims or [-1]})
+        for name, spec in (sig.get("outputs") or {}).items():
+            outputs.append({"name": name, "datatype": "FP32",
+                            "shape": [-1]})
+        return {"name": model_name, "platform": "tensorflow_serving",
+                "inputs": inputs, "outputs": outputs}
+
+    def model_config(self, model_name, model_version=""):
+        return {"name": model_name, "max_batch_size": 0}
+
+    def server_metadata(self):
+        return {"name": "tfserving-endpoint"}
+
+    def infer(self, model_name, inputs, outputs=None, **kwargs):
+        import json
+
+        payload = {"inputs": {}}
+        for infer_input in inputs:
+            array = infer_input.numpy_data()
+            if array is None:
+                raise InferenceServerException(
+                    "TF-Serving REST needs numpy-backed inputs")
+            if array.dtype == np.object_:
+                payload["inputs"][infer_input.name()] = [
+                    v.decode() if isinstance(v, bytes) else str(v)
+                    for v in array.ravel()
+                ]
+            else:
+                payload["inputs"][infer_input.name()] = array.tolist()
+        version = kwargs.get("model_version", "")
+        path = "/v1/models/%s" % model_name
+        if version:
+            path += "/versions/%s" % version
+        data = self._request("POST", path + ":predict",
+                             json.dumps(payload).encode())
+        return _RestResult(data, kwargs.get("request_id", ""))
+
+    def async_infer(self, callback, model_name, inputs, outputs=None,
+                    **kwargs):
+        self._async(callback,
+                    lambda: self.infer(model_name, inputs, outputs,
+                                       **kwargs))
+
+
+_TF_TO_TRITON_DTYPE = {
+    "DT_HALF": "FP16", "DT_BFLOAT16": "BF16", "DT_FLOAT": "FP32",
+    "DT_DOUBLE": "FP64", "DT_INT8": "INT8", "DT_INT16": "INT16",
+    "DT_INT32": "INT32", "DT_INT64": "INT64", "DT_UINT8": "UINT8",
+    "DT_UINT16": "UINT16", "DT_UINT32": "UINT32", "DT_UINT64": "UINT64",
+    "DT_STRING": "BYTES", "DT_BOOL": "BOOL",
+}
+
+
 class InProcessBackend(ClientBackend):
     """Runs against an InferenceServerCore in this process — no RPC,
     no serialization of tensor contents beyond proto assembly. The
@@ -678,6 +894,10 @@ class ClientBackendFactory:
         if self.kind == BackendKind.OPENAI:
             return OpenAiClientBackend(self._url, self._openai_endpoint,
                                        self._verbose)
+        if self.kind == BackendKind.TORCHSERVE:
+            return TorchServeBackend(self._url, self._verbose)
+        if self.kind == BackendKind.TFSERVING:
+            return TfServingBackend(self._url, self._verbose)
         if self.kind == BackendKind.IN_PROCESS:
             if self._core is None:
                 raise InferenceServerException(

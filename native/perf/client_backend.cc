@@ -3,6 +3,7 @@
 #include <chrono>
 #include <random>
 #include <thread>
+#include <type_traits>
 
 #include "../library/grpc_client.h"
 #include "../library/http_client.h"
@@ -346,6 +347,31 @@ class OpenAiInferResult : public InferResult {
   bool is_final_;
 };
 
+// One-shot POST shared by the plain-HTTP backends (OpenAI
+// non-streaming and the REST kinds): transport and HTTP-status errors
+// both land in the returned result's RequestStatus, the uniform shape
+// the workers expect from async completions.
+static InferResult* PostAndWrap(
+    const std::string& host, int port, const std::string& path,
+    const std::string& content_type, const std::string& body,
+    const std::string& request_id, uint64_t timeout_us) {
+  HttpConnection conn(host, port);
+  HttpResponse response;
+  std::string transport_err = conn.Request(
+      "POST", path, {{"Content-Type", content_type}}, body, &response,
+      timeout_us);
+  Error status = Error::Success;
+  if (!transport_err.empty()) {
+    status = Error(transport_err);
+  } else if (response.status_code != 200) {
+    status = Error(
+        "HTTP " + std::to_string(response.status_code) + ": " +
+        response.body);
+  }
+  return new OpenAiInferResult(
+      status, std::move(response.body), request_id, true);
+}
+
 class OpenAiBackend : public ClientBackend {
  public:
   explicit OpenAiBackend(const BackendConfig& config)
@@ -425,21 +451,9 @@ class OpenAiBackend : public ClientBackend {
     std::string payload;
     Error err = GatherPayload(inputs, &payload);
     if (!err.IsOk()) return err;
-    HttpConnection conn(host_, port_);
-    HttpResponse response;
-    std::string transport_err = conn.Request(
-        "POST", endpoint_,
-        {{"Content-Type", "application/json"}}, payload, &response,
-        options.client_timeout_us);
-    if (!transport_err.empty()) return Error(transport_err);
-    Error status = Error::Success;
-    if (response.status_code != 200) {
-      status = Error(
-          "HTTP " + std::to_string(response.status_code) + ": " +
-          response.body);
-    }
-    *result = new OpenAiInferResult(
-        status, std::move(response.body), options.request_id, true);
+    *result = PostAndWrap(
+        host_, port_, endpoint_, "application/json", payload,
+        options.request_id, options.client_timeout_us);
     return Error::Success;
   }
 
@@ -455,21 +469,8 @@ class OpenAiBackend : public ClientBackend {
     uint64_t timeout_us = options.client_timeout_us;
     std::thread([this, callback = std::move(callback), id,
                  payload = std::move(payload), timeout_us] {
-      HttpConnection conn(host_, port_);
-      HttpResponse response;
-      std::string transport_err = conn.Request(
-          "POST", endpoint_, {{"Content-Type", "application/json"}},
-          payload, &response, timeout_us);
-      Error status = Error::Success;
-      if (!transport_err.empty()) {
-        status = Error(transport_err);
-      } else if (response.status_code != 200) {
-        status = Error(
-            "HTTP " + std::to_string(response.status_code) + ": " +
-            response.body);
-      }
-      callback(new OpenAiInferResult(
-          status, std::move(response.body), id, true));
+      callback(PostAndWrap(host_, port_, endpoint_, "application/json",
+                           payload, id, timeout_us));
       inflight_--;
     }).detach();
     return Error::Success;
@@ -572,6 +573,359 @@ class OpenAiBackend : public ClientBackend {
   std::atomic<int64_t> inflight_{0};
   std::mutex stream_mutex_;
   OnCompleteFn stream_callback_;
+};
+
+//==============================================================================
+// REST backends for non-Triton inference APIs (parity: the
+// reference's torchserve/ and tensorflow_serving/ client backends).
+// TorchServe posts the first input's raw bytes to /predictions/<m>
+// (torchserve_http_client.cc); TF-Serving uses the REST predict API
+// (/v1/models/<m>:predict, columnar "inputs") — same request
+// semantics as the reference's gRPC PredictionService
+// (tfserve_grpc_client.cc Predict) without vendoring the TF proto
+// tree.
+//
+class RestBackend : public ClientBackend {
+ public:
+  explicit RestBackend(const BackendConfig& config) : kind_(config.kind) {
+    std::string rest = config.url;
+    size_t scheme = rest.find("://");
+    if (scheme != std::string::npos) rest = rest.substr(scheme + 3);
+    size_t colon = rest.rfind(':');
+    if (colon != std::string::npos) {
+      port_ = atoi(rest.substr(colon + 1).c_str());
+      host_ = rest.substr(0, colon);
+    } else {
+      host_ = rest;
+    }
+  }
+
+  ~RestBackend() override {
+    while (inflight_.load() > 0) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    }
+  }
+
+  Error ServerMetadataJson(json::Value* metadata) override {
+    json::Object root;
+    root["name"] = json::Value(std::string(
+        kind_ == BackendKind::TORCHSERVE ? "torchserve-endpoint"
+                                         : "tfserving-endpoint"));
+    *metadata = json::Value(std::move(root));
+    return Error::Success;
+  }
+
+  // TorchServe exposes no v2 metadata; synthesize the reference shape
+  // (one BYTES "data" input; reference ModelParser::InitTorchServe).
+  // TF-Serving serves its signature at /v1/models/<m>/metadata — use
+  // it when reachable, synthesize otherwise.
+  Error ModelMetadataJson(
+      json::Value* metadata, const std::string& model_name,
+      const std::string&) override {
+    if (kind_ == BackendKind::TFSERVING &&
+        FetchTfMetadata(model_name, metadata)) {
+      return Error::Success;
+    }
+    json::Object root;
+    root["name"] = json::Value(model_name);
+    root["platform"] = json::Value(std::string(
+        kind_ == BackendKind::TORCHSERVE ? "torchserve"
+                                         : "tensorflow_serving"));
+    json::Array inputs;
+    json::Object data;
+    data["name"] = json::Value(std::string("data"));
+    data["datatype"] = json::Value(std::string("BYTES"));
+    json::Array shape;
+    shape.push_back(json::Value(static_cast<int64_t>(1)));
+    data["shape"] = json::Value(std::move(shape));
+    inputs.push_back(json::Value(std::move(data)));
+    root["inputs"] = json::Value(std::move(inputs));
+    root["outputs"] = json::Value(json::Array{});
+    *metadata = json::Value(std::move(root));
+    return Error::Success;
+  }
+
+  Error ModelConfigJson(
+      json::Value* config, const std::string& model_name,
+      const std::string&) override {
+    json::Object root;
+    root["name"] = json::Value(model_name);
+    root["max_batch_size"] = json::Value(static_cast<int64_t>(0));
+    *config = json::Value(std::move(root));
+    return Error::Success;
+  }
+
+  Error ModelStatisticsJson(json::Value* stats, const std::string&) override {
+    json::Object root;
+    root["model_stats"] = json::Value(json::Array{});
+    *stats = json::Value(std::move(root));
+    return Error::Success;
+  }
+
+  Error Infer(
+      InferResult** result, const InferOptions& options,
+      const std::vector<InferInput*>& inputs,
+      const std::vector<const InferRequestedOutput*>&) override {
+    std::string path, body, content_type;
+    Error err = BuildRequest(options, inputs, &path, &body, &content_type);
+    if (!err.IsOk()) return err;
+    *result = PostAndWrap(
+        host_, port_, path, content_type, body, options.request_id,
+        options.client_timeout_us);
+    return Error::Success;
+  }
+
+  Error AsyncInfer(
+      OnCompleteFn callback, const InferOptions& options,
+      const std::vector<InferInput*>& inputs,
+      const std::vector<const InferRequestedOutput*>&) override {
+    std::string path, body, content_type;
+    Error err = BuildRequest(options, inputs, &path, &body, &content_type);
+    if (!err.IsOk()) return err;
+    inflight_++;
+    std::string id = options.request_id;
+    uint64_t timeout_us = options.client_timeout_us;
+    std::thread([this, callback = std::move(callback), id,
+                 path = std::move(path), body = std::move(body),
+                 content_type = std::move(content_type), timeout_us] {
+      callback(PostAndWrap(host_, port_, path, content_type, body, id,
+                           timeout_us));
+      inflight_--;
+    }).detach();
+    return Error::Success;
+  }
+
+  Error StartStream(OnCompleteFn) override {
+    return Error("streaming is not supported by this backend");
+  }
+  Error StopStream() override { return Error::Success; }
+  Error AsyncStreamInfer(
+      const InferOptions&, const std::vector<InferInput*>&,
+      const std::vector<const InferRequestedOutput*>&) override {
+    return Error("streaming is not supported by this backend");
+  }
+
+  Error RegisterSystemSharedMemory(
+      const std::string&, const std::string&, size_t, size_t) override {
+    return Error("shared memory is not supported by this backend");
+  }
+  Error RegisterTpuSharedMemory(
+      const std::string&, const std::string&, int64_t, size_t) override {
+    return Error("shared memory is not supported by this backend");
+  }
+  Error UnregisterSystemSharedMemory(const std::string&) override {
+    return Error::Success;
+  }
+  Error UnregisterTpuSharedMemory(const std::string&) override {
+    return Error::Success;
+  }
+
+ private:
+  // GET /v1/models/<m>/metadata and translate the serving_default
+  // signature into v2-style metadata (parity: the Python twin's
+  // TfServingBackend.model_metadata). Returns false when the endpoint
+  // is unreachable or unparseable so the caller synthesizes defaults.
+  bool FetchTfMetadata(const std::string& model_name, json::Value* out) {
+    HttpConnection conn(host_, port_);
+    HttpResponse response;
+    std::string transport_err = conn.Request(
+        "GET", "/v1/models/" + model_name + "/metadata", {}, "", &response,
+        0);
+    if (!transport_err.empty() || response.status_code != 200) return false;
+    json::Value doc;
+    if (!json::Parse(response.body, &doc).empty()) return false;
+    const json::Value& sig =
+        doc["metadata"]["signature_def"]["signature_def"]["serving_default"];
+    if (!sig.IsObject()) return false;
+    json::Object root;
+    root["name"] = json::Value(model_name);
+    root["platform"] = json::Value(std::string("tensorflow_serving"));
+    json::Array inputs, outputs;
+    static const std::map<std::string, std::string> kDtypes = {
+        {"DT_HALF", "FP16"},     {"DT_BFLOAT16", "BF16"},
+        {"DT_FLOAT", "FP32"},    {"DT_DOUBLE", "FP64"},
+        {"DT_INT8", "INT8"},     {"DT_INT16", "INT16"},
+        {"DT_INT32", "INT32"},   {"DT_INT64", "INT64"},
+        {"DT_UINT8", "UINT8"},   {"DT_UINT16", "UINT16"},
+        {"DT_UINT32", "UINT32"}, {"DT_UINT64", "UINT64"},
+        {"DT_STRING", "BYTES"},  {"DT_BOOL", "BOOL"},
+    };
+    auto translate = [&](const json::Value& specs, json::Array* dest) {
+      if (!specs.IsObject()) return;
+      for (const auto& entry : specs.AsObject().entries()) {
+        json::Object tensor;
+        tensor["name"] = json::Value(entry.first);
+        std::string dtype = entry.second["dtype"].IsString()
+                                ? entry.second["dtype"].AsString()
+                                : "";
+        auto it = kDtypes.find(dtype);
+        tensor["datatype"] =
+            json::Value(it != kDtypes.end() ? it->second
+                                            : std::string("FP32"));
+        json::Array shape;
+        const json::Value& dims = entry.second["tensor_shape"]["dim"];
+        if (dims.IsArray()) {
+          for (const json::Value& d : dims.AsArray()) {
+            int64_t size = -1;
+            if (d["size"].IsString()) {
+              size = atoll(d["size"].AsString().c_str());
+            } else if (d["size"].IsNumber()) {
+              size = d["size"].AsInt();
+            }
+            shape.push_back(json::Value(size));
+          }
+        }
+        if (shape.empty()) shape.push_back(json::Value(int64_t{-1}));
+        tensor["shape"] = json::Value(std::move(shape));
+        dest->push_back(json::Value(std::move(tensor)));
+      }
+    };
+    translate(sig["inputs"], &inputs);
+    translate(sig["outputs"], &outputs);
+    if (inputs.empty()) return false;
+    root["inputs"] = json::Value(std::move(inputs));
+    root["outputs"] = json::Value(std::move(outputs));
+    *out = json::Value(std::move(root));
+    return true;
+  }
+
+  Error BuildRequest(
+      const InferOptions& options, const std::vector<InferInput*>& inputs,
+      std::string* path, std::string* body, std::string* content_type) {
+    if (kind_ == BackendKind::TORCHSERVE) {
+      *path = "/predictions/" + options.model_name;
+      *content_type = "application/octet-stream";
+      if (inputs.empty()) return Error("TorchServe requests need an input");
+      std::string raw;
+      inputs[0]->GatherInto(&raw);
+      if (inputs[0]->Datatype() == "BYTES") {
+        // Concatenate every length-prefixed element's payload.
+        size_t offset = 0;
+        while (offset + 4 <= raw.size()) {
+          uint32_t len;
+          memcpy(&len, raw.data() + offset, 4);
+          offset += 4;
+          if (offset + len > raw.size()) break;
+          body->append(raw, offset, len);
+          offset += len;
+        }
+        if (body->empty()) *body = std::move(raw);
+      } else {
+        *body = std::move(raw);
+      }
+      return Error::Success;
+    }
+    *path = "/v1/models/" + options.model_name;
+    if (!options.model_version.empty()) {
+      *path += "/versions/" + options.model_version;
+    }
+    *path += ":predict";
+    *content_type = "application/json";
+    body->assign("{\"inputs\":{");
+    bool first = true;
+    for (InferInput* input : inputs) {
+      if (!first) body->push_back(',');
+      first = false;
+      body->push_back('"');
+      body->append(input->Name());
+      body->append("\":");
+      std::string raw;
+      input->GatherInto(&raw);
+      Error err = AppendJsonTensor(input->Datatype(), raw, body);
+      if (!err.IsOk()) return err;
+    }
+    body->append("}}");
+    return Error::Success;
+  }
+
+  template <typename T>
+  static void AppendNumbers(const std::string& raw, std::string* out) {
+    const T* values = reinterpret_cast<const T*>(raw.data());
+    size_t count = raw.size() / sizeof(T);
+    out->push_back('[');
+    char buf[32];
+    for (size_t i = 0; i < count; ++i) {
+      if (i) out->push_back(',');
+      if (std::is_integral<T>::value) {
+        // Integers must not round-trip through double (2^53 loss).
+        if (std::is_signed<T>::value) {
+          snprintf(buf, sizeof(buf), "%lld",
+                   static_cast<long long>(values[i]));
+        } else {
+          snprintf(buf, sizeof(buf), "%llu",
+                   static_cast<unsigned long long>(values[i]));
+        }
+      } else {
+        // Shortest round-trippable double representation.
+        snprintf(buf, sizeof(buf), "%.17g",
+                 static_cast<double>(values[i]));
+      }
+      out->append(buf);
+    }
+    out->push_back(']');
+  }
+
+  // Flat JSON array from raw tensor bytes (TF-Serving accepts flat
+  // lists for the columnar "inputs" format when ranks match server
+  // side; nested re-shaping happens server-side).
+  static Error AppendJsonTensor(
+      const std::string& datatype, const std::string& raw,
+      std::string* out) {
+    if (datatype == "FP32") {
+      AppendNumbers<float>(raw, out);
+    } else if (datatype == "FP64") {
+      AppendNumbers<double>(raw, out);
+    } else if (datatype == "INT64") {
+      AppendNumbers<int64_t>(raw, out);
+    } else if (datatype == "INT32") {
+      AppendNumbers<int32_t>(raw, out);
+    } else if (datatype == "INT16") {
+      AppendNumbers<int16_t>(raw, out);
+    } else if (datatype == "INT8") {
+      AppendNumbers<int8_t>(raw, out);
+    } else if (datatype == "UINT8") {
+      AppendNumbers<uint8_t>(raw, out);
+    } else if (datatype == "UINT16") {
+      AppendNumbers<uint16_t>(raw, out);
+    } else if (datatype == "UINT32") {
+      AppendNumbers<uint32_t>(raw, out);
+    } else if (datatype == "UINT64") {
+      AppendNumbers<uint64_t>(raw, out);
+    } else if (datatype == "BOOL") {
+      const char* values = raw.data();
+      out->push_back('[');
+      for (size_t i = 0; i < raw.size(); ++i) {
+        if (i) out->push_back(',');
+        out->append(values[i] ? "true" : "false");
+      }
+      out->push_back(']');
+    } else if (datatype == "BYTES") {
+      // Length-prefixed elements -> JSON strings.
+      out->push_back('[');
+      size_t offset = 0;
+      bool first = true;
+      while (offset + 4 <= raw.size()) {
+        uint32_t len;
+        memcpy(&len, raw.data() + offset, 4);
+        offset += 4;
+        if (offset + len > raw.size()) break;
+        if (!first) out->push_back(',');
+        first = false;
+        out->append(json::Value(raw.substr(offset, len)).Serialize());
+        offset += len;
+      }
+      out->push_back(']');
+    } else {
+      return Error("dtype " + datatype +
+                   " is not representable in TF-Serving REST JSON");
+    }
+    return Error::Success;
+  }
+
+  BackendKind kind_;
+  std::string host_;
+  int port_ = 8080;
+  std::atomic<int64_t> inflight_{0};
 };
 
 //==============================================================================
@@ -828,6 +1182,10 @@ Error ClientBackendFactory::Create(
       return HttpBackend::Create(config_, backend);
     case BackendKind::OPENAI:
       backend->reset(new OpenAiBackend(config_));
+      return Error::Success;
+    case BackendKind::TORCHSERVE:
+    case BackendKind::TFSERVING:
+      backend->reset(new RestBackend(config_));
       return Error::Success;
     case BackendKind::MOCK:
       backend->reset(new MockBackend(config_));

@@ -34,7 +34,14 @@ class InferenceServerHttpClient;
 
 namespace perf {
 
-enum class BackendKind { TRITON_GRPC, TRITON_HTTP, OPENAI, MOCK };
+enum class BackendKind {
+  TRITON_GRPC,
+  TRITON_HTTP,
+  OPENAI,
+  TORCHSERVE,
+  TFSERVING,
+  MOCK,
+};
 
 struct BackendConfig {
   BackendKind kind = BackendKind::TRITON_GRPC;

@@ -233,9 +233,12 @@ Error CLParser::Parse(
       case kOptServiceKind:
         params->service_kind = optarg;
         if (params->service_kind != "triton" &&
-            params->service_kind != "openai") {
-          return Error("--service-kind must be triton or openai (the "
-                       "Python harness adds in-process serving)");
+            params->service_kind != "openai" &&
+            params->service_kind != "torchserve" &&
+            params->service_kind != "tfserving") {
+          return Error("--service-kind must be triton, openai, "
+                       "torchserve, or tfserving (the Python harness "
+                       "adds in-process serving)");
         }
         break;
       case kOptEndpoint: params->endpoint = optarg; break;

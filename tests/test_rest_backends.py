@@ -1,0 +1,182 @@
+"""TorchServe + TF-Serving backends, Python and native harness
+(parity: reference client_backend/torchserve/ and
+tensorflow_serving/ — mock-served, like the reference's unit tier)."""
+
+import json
+import pathlib
+import subprocess
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from client_tpu._infer_common import InferInput
+from client_tpu.perf.client_backend import (
+    BackendKind,
+    ClientBackendFactory,
+)
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+class _RestHandler(BaseHTTPRequestHandler):
+    """Mock TorchServe (/predictions/<m>) + TF-Serving REST
+    (/v1/models/<m>:predict, .../metadata) endpoints."""
+
+    def log_message(self, *args):
+        pass
+
+    def _reply(self, payload: dict, status: int = 200):
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path.endswith("/metadata"):
+            self._reply({
+                "model_spec": {"name": "m"},
+                "metadata": {"signature_def": {"signature_def": {
+                    "serving_default": {
+                        "inputs": {"x": {
+                            "dtype": "DT_FLOAT",
+                            "tensor_shape": {"dim": [{"size": "-1"},
+                                                     {"size": "4"}]},
+                        }},
+                        "outputs": {"y": {"dtype": "DT_FLOAT"}},
+                    },
+                }}},
+            })
+        else:
+            self._reply({"error": "not found"}, 404)
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        self.server.requests.append((self.path, body))
+        if self.path.startswith("/predictions/"):
+            self._reply({"prediction": body.decode(errors="replace")})
+        elif self.path.endswith(":predict"):
+            doc = json.loads(body)
+            inputs = doc.get("inputs", {})
+            def summarize(v):
+                try:
+                    return [float(np.asarray(v, dtype=np.float64).sum())]
+                except (ValueError, TypeError):
+                    return v  # string tensors echo back
+
+            outputs = {name: summarize(v) for name, v in inputs.items()}
+            self._reply({"outputs": outputs})
+        else:
+            self._reply({"error": "bad path"}, 404)
+
+
+@pytest.fixture(scope="module")
+def rest_server():
+    server = ThreadingHTTPServer(("127.0.0.1", 0), _RestHandler)
+    server.requests = []
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield server
+    server.shutdown()
+
+
+def _url(server):
+    return "127.0.0.1:%d" % server.server_address[1]
+
+
+def test_torchserve_backend_infer(rest_server):
+    backend = ClientBackendFactory(
+        BackendKind.TORCHSERVE, url=_url(rest_server)).create()
+    meta = backend.model_metadata("squeezenet")
+    assert meta["inputs"][0]["datatype"] == "BYTES"
+    data = InferInput("data", [1], "BYTES")
+    data.set_data_from_numpy(np.array([b"image-bytes"], dtype=np.object_))
+    result = backend.infer("squeezenet", [data])
+    doc = result.as_json()
+    assert doc["prediction"] == "image-bytes"
+    assert result.get_parameters()["triton_final_response"] is True
+
+
+def test_torchserve_backend_async(rest_server):
+    backend = ClientBackendFactory(
+        BackendKind.TORCHSERVE, url=_url(rest_server)).create()
+    data = InferInput("data", [1], "BYTES")
+    data.set_data_from_numpy(np.array([b"x"], dtype=np.object_))
+    done = threading.Event()
+    holder = {}
+
+    def callback(result, error):
+        holder["result"], holder["error"] = result, error
+        done.set()
+
+    backend.async_infer(callback, "m", [data])
+    assert done.wait(10)
+    assert holder["error"] is None
+    assert holder["result"].as_json()["prediction"] == "x"
+
+
+def test_tfserving_backend_metadata_and_infer(rest_server):
+    backend = ClientBackendFactory(
+        BackendKind.TFSERVING, url=_url(rest_server)).create()
+    meta = backend.model_metadata("m")
+    assert meta["platform"] == "tensorflow_serving"
+    assert meta["inputs"][0]["name"] == "x"
+    assert meta["inputs"][0]["datatype"] == "FP32"
+    assert meta["inputs"][0]["shape"] == [-1, 4]
+
+    x = InferInput("x", [2, 2], "FP32")
+    x.set_data_from_numpy(np.array([[1, 2], [3, 4]], dtype=np.float32))
+    result = backend.infer("m", [x])
+    assert result.as_json()["outputs"]["x"] == [10.0]
+
+
+def test_tfserving_backend_bytes_input(rest_server):
+    backend = ClientBackendFactory(
+        BackendKind.TFSERVING, url=_url(rest_server)).create()
+    s = InferInput("s", [2], "BYTES")
+    s.set_data_from_numpy(np.array([b"a", b"b"], dtype=np.object_))
+    result = backend.infer("m", [s])
+    assert result.as_json()["outputs"]["s"] == ["a", "b"]
+
+
+def test_rest_backends_reject_streaming(rest_server):
+    from client_tpu.utils import InferenceServerException
+
+    for kind in (BackendKind.TORCHSERVE, BackendKind.TFSERVING):
+        backend = ClientBackendFactory(kind, url=_url(rest_server)).create()
+        with pytest.raises(InferenceServerException):
+            backend.async_stream_infer("m", [])
+
+
+@pytest.mark.parametrize("service_kind", ["torchserve", "tfserving"])
+def test_native_perf_analyzer_rest_e2e(rest_server, tmp_path, service_kind):
+    """Native harness end-to-end against the mock REST endpoints."""
+    binary = REPO / "native" / "build" / "perf_analyzer"
+    if not binary.exists():
+        pytest.skip("native perf_analyzer not built")
+    input_file = tmp_path / "input.json"
+    if service_kind == "tfserving":
+        # The native backend fetches the signature from the mock's
+        # /metadata endpoint: one FP32 input named "x" of shape [-1,4].
+        step = {"x": {"content": [1.0, 2.0, 3.0, 4.0], "shape": [1, 4]}}
+    else:
+        step = {"data": ["payload"]}
+    input_file.write_text(json.dumps({"data": [step]}))
+    csv = tmp_path / "latency.csv"
+    proc = subprocess.run(
+        [str(binary), "-m", "anymodel", "-u", _url(rest_server),
+         "--service-kind", service_kind,
+         "--input-data", str(input_file),
+         "--concurrency-range", "2", "-p", "400", "-r", "3", "-s", "90",
+         "-f", str(csv)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rows = csv.read_text().strip().splitlines()
+    assert len(rows) >= 2
+    throughput = float(rows[1].split(",")[1])
+    assert throughput > 0
