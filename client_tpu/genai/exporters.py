@@ -39,6 +39,26 @@ def export_json(stats_list: List[Statistics], path: str,
         json.dump(doc, f, indent=2)
 
 
+def export_parquet(stats_list: List[Statistics], path: str) -> None:
+    """Raw per-request samples as a long-format parquet table
+    (experiment, metric, sample_index, value) — parity: genai-perf's
+    parquet export of the raw profile dataframe."""
+    import pandas as pd
+
+    rows = []
+    for idx, stats in enumerate(stats_list):
+        for name, samples in stats.metrics.data().items():
+            for i, value in enumerate(samples):
+                rows.append((idx, name, i, float(value)))
+        rows.append((idx, "request_throughput_per_s", 0,
+                     stats.metrics.request_throughput_per_s))
+        rows.append((idx, "output_token_throughput_per_s", 0,
+                     stats.metrics.output_token_throughput_per_s))
+    frame = pd.DataFrame(
+        rows, columns=["experiment", "metric", "sample_index", "value"])
+    frame.to_parquet(path, index=False)
+
+
 def export_csv(stats_list: List[Statistics], path: str) -> None:
     with open(path, "w", newline="") as f:
         writer = csv.writer(f)

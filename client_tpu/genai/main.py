@@ -49,6 +49,10 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--tokenizer", default="byte")
     parser.add_argument("--input-file", default=None,
                         help="prompts: JSONL with text_input, or raw lines")
+    parser.add_argument("--input-dataset", default=None,
+                        choices=["openorca", "cnn_dailymail"],
+                        help="public dataset prompts (network-gated; "
+                             "falls back to synthetic offline)")
     parser.add_argument("--measurement-interval", type=int, default=4000)
     parser.add_argument("--stability-percentage", type=float, default=50.0)
     parser.add_argument("--max-trials", type=int, default=6)
@@ -57,6 +61,10 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--profile-export-file", default=None)
     parser.add_argument("--export-json", default=None)
     parser.add_argument("--export-csv", default=None)
+    parser.add_argument("--export-parquet", default=None)
+    parser.add_argument("--generate-plots", action="store_true",
+                        help="write TTFT/ITL/latency PNGs to the "
+                             "artifact dir")
     parser.add_argument("--random-seed", type=int, default=0)
     parser.add_argument("--no-streaming", action="store_true")
     return parser
@@ -81,12 +89,24 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
 
     inputs = LlmInputs(tokenizer, seed=args.random_seed)
     try:
-        prompts = inputs.create_prompts(
-            num_prompts=args.num_prompts,
-            input_tokens_mean=args.synthetic_input_tokens_mean,
-            input_tokens_stddev=args.synthetic_input_tokens_stddev,
-            input_file=args.input_file,
-        )
+        if args.input_dataset:
+            from client_tpu.genai.datasets import dataset_prompts
+            from client_tpu.genai.synthetic import SyntheticPromptGenerator
+
+            prompts = dataset_prompts(
+                args.input_dataset, args.num_prompts,
+                fallback_generator=SyntheticPromptGenerator(
+                    tokenizer, args.random_seed),
+                fallback_tokens_mean=args.synthetic_input_tokens_mean,
+                fallback_tokens_stddev=args.synthetic_input_tokens_stddev,
+            )
+        else:
+            prompts = inputs.create_prompts(
+                num_prompts=args.num_prompts,
+                input_tokens_mean=args.synthetic_input_tokens_mean,
+                input_tokens_stddev=args.synthetic_input_tokens_stddev,
+                input_file=args.input_file,
+            )
     except (OSError, ValueError) as e:
         print("genai failed: %s" % e, file=sys.stderr)
         return 1
@@ -128,6 +148,16 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
                           "num_prompts": len(prompts)})
     if args.export_csv:
         export_csv(stats_list, args.export_csv)
+    if args.export_parquet:
+        from client_tpu.genai.exporters import export_parquet
+
+        export_parquet(stats_list, args.export_parquet)
+    if args.generate_plots:
+        from client_tpu.genai.plots import generate_plots
+
+        for path in generate_plots(stats_list, artifact_dir,
+                                   title=args.model):
+            print("genai plot: %s" % path, file=sys.stderr)
     return 0
 
 

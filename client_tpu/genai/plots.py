@@ -1,0 +1,79 @@
+"""Benchmark visualizations (parity: genai-perf plots/ — the
+reference ships plotly scatter/box/heatmap; matplotlib is used here
+since it is what the image provides).
+
+All functions write PNG files into an artifact directory and return
+the written paths.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List
+
+from client_tpu.genai.metrics import Statistics
+
+
+def _matplotlib():
+    import matplotlib
+
+    matplotlib.use("Agg")  # headless
+    import matplotlib.pyplot as plt
+
+    return plt
+
+
+def generate_plots(stats_list: List[Statistics], artifact_dir: str,
+                   title: str = "") -> List[str]:
+    """TTFT scatter, ITL box, request-latency distribution — one file
+    each (parity: genai-perf ttft/itl/latency plot set)."""
+    plt = _matplotlib()
+    os.makedirs(artifact_dir, exist_ok=True)
+    written: List[str] = []
+
+    def save(fig, name: str):
+        path = os.path.join(artifact_dir, name)
+        fig.savefig(path, dpi=110, bbox_inches="tight")
+        plt.close(fig)
+        written.append(path)
+
+    # 1. TTFT scatter per request, one series per experiment.
+    fig, ax = plt.subplots(figsize=(7, 4))
+    for idx, stats in enumerate(stats_list):
+        samples = stats.metrics.data().get("time_to_first_token_ms", [])
+        ax.scatter(range(len(samples)), samples, s=12,
+                   label="experiment %d" % idx)
+    ax.set_xlabel("request index")
+    ax.set_ylabel("time to first token (ms)")
+    ax.set_title(title or "Time to first token")
+    if len(stats_list) > 1:
+        ax.legend()
+    save(fig, "time_to_first_token.png")
+
+    # 2. Inter-token latency box plot per experiment.
+    fig, ax = plt.subplots(figsize=(7, 4))
+    series = [
+        stats.metrics.data().get("inter_token_latency_ms", []) or [0.0]
+        for stats in stats_list
+    ]
+    ax.boxplot(series,
+               labels=["exp %d" % i for i in range(len(series))])
+    ax.set_ylabel("inter-token latency (ms)")
+    ax.set_title(title or "Inter-token latency")
+    save(fig, "inter_token_latency.png")
+
+    # 3. Request latency histogram.
+    fig, ax = plt.subplots(figsize=(7, 4))
+    for idx, stats in enumerate(stats_list):
+        samples = stats.metrics.data().get("request_latency_ms", [])
+        if samples:
+            ax.hist(samples, bins=min(30, max(5, len(samples) // 2)),
+                    alpha=0.6, label="experiment %d" % idx)
+    ax.set_xlabel("request latency (ms)")
+    ax.set_ylabel("requests")
+    ax.set_title(title or "Request latency distribution")
+    if len(stats_list) > 1:
+        ax.legend()
+    save(fig, "request_latency.png")
+
+    return written

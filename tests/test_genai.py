@@ -3,6 +3,7 @@ parsing, statistics, exporters, and the full CLI pipeline against the
 in-process server (parity: genai-perf/tests)."""
 
 import json
+import os
 
 import numpy as np
 import pytest
@@ -177,3 +178,70 @@ def test_genai_cli_e2e_openai(tmp_path):
     exp = doc["experiments"][0]
     assert "time_to_first_token_ms" in exp
     assert "inter_token_latency_ms" in exp
+
+
+def test_export_parquet(tmp_path):
+    import pandas as pd
+
+    parser = LLMProfileDataParser(document=_export_doc(),
+                                  tokenizer=get_tokenizer("byte"))
+    stats = parser.get_statistics(0)
+    from client_tpu.genai.exporters import export_parquet
+
+    path = tmp_path / "out.parquet"
+    export_parquet([stats], str(path))
+    frame = pd.read_parquet(path)
+    assert set(frame.columns) == {"experiment", "metric", "sample_index",
+                                  "value"}
+    ttft = frame[frame.metric == "time_to_first_token_ms"]
+    assert list(ttft.value) == [10.0, 20.0]
+    assert (frame[frame.metric == "request_throughput_per_s"].value > 0).all()
+
+
+def test_generate_plots(tmp_path):
+    parser = LLMProfileDataParser(document=_export_doc(),
+                                  tokenizer=get_tokenizer("byte"))
+    stats = parser.get_statistics(0)
+    from client_tpu.genai.plots import generate_plots
+
+    written = generate_plots([stats], str(tmp_path), title="t")
+    assert len(written) == 3
+    for path in written:
+        assert os.path.getsize(path) > 1000  # a real PNG, not a stub
+
+
+def test_dataset_prompts_fetch_and_fallback():
+    import io
+
+    from client_tpu.genai.datasets import dataset_prompts
+    from client_tpu.genai.synthetic import SyntheticPromptGenerator
+
+    # Mocked datasets-server response (the fetch path).
+    doc = {"rows": [{"row": {"question": "q%d" % i}} for i in range(5)]}
+
+    class _Response(io.StringIO):
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+    def opener(url, timeout):
+        assert "Open-Orca" in url
+        return _Response(json.dumps(doc))
+
+    prompts = dataset_prompts("openorca", 3, _opener=opener)
+    assert prompts == ["q0", "q1", "q2"]
+
+    # Offline: degrade to the synthetic generator.
+    def failing_opener(url, timeout):
+        raise OSError("no network")
+
+    generator = SyntheticPromptGenerator(get_tokenizer("byte"), 0)
+    prompts = dataset_prompts("openorca", 4,
+                              fallback_generator=generator,
+                              _opener=failing_opener)
+    assert len(prompts) == 4
+
+    with pytest.raises(ValueError):
+        dataset_prompts("nope", 1)
