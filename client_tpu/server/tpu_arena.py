@@ -35,6 +35,27 @@ from client_tpu.utils import (
 )
 
 
+class _Segment:
+    """One typed tensor (or raw byte run) living at an offset in a
+    region. Regions hold disjoint segments so multi-tensor layouts
+    (input_0 at 0, input_1 at 4096, ...) keep per-tensor dtype/shape
+    and partial writes never round-trip the whole region."""
+
+    __slots__ = ("offset", "nbytes", "datatype", "shape", "array")
+
+    def __init__(self, offset: int, nbytes: int, datatype: Optional[str],
+                 shape: Optional[list], array):
+        self.offset = offset
+        self.nbytes = nbytes
+        self.datatype = datatype  # None = raw uint8 run
+        self.shape = shape
+        self.array = array  # jax.Array (device) or np.ndarray (BYTES)
+
+    @property
+    def end(self) -> int:
+        return self.offset + self.nbytes
+
+
 class _Region:
     def __init__(self, region_id: str, device, device_id: int, byte_size: int,
                  nonce: str):
@@ -44,11 +65,8 @@ class _Region:
         self.byte_size = byte_size
         self.nonce = nonce
         self.lock = threading.Lock()
-        # Either a typed device array covering the whole region
-        # payload, or a flat uint8 device array of byte_size bytes.
-        self.array = None
-        self.datatype: Optional[str] = None
-        self.shape: Optional[list] = None
+        # Disjoint segments sorted by offset.
+        self.segments: list = []
 
 
 class TpuArena:
@@ -138,7 +156,7 @@ class TpuArena:
         with self._lock:
             region = self._regions.pop(region_id, None)
         if region is not None:
-            region.array = None  # drop the HBM buffer reference
+            region.segments = []  # drop the HBM buffer references
 
     def list_regions(self):
         with self._lock:
@@ -159,8 +177,9 @@ class TpuArena:
 
     def write(self, region_id: str, offset: int, data: bytes,
               datatype: str = "", shape=None) -> None:
-        """Host bytes -> device slot (the one host->device hop). With
-        dtype/shape metadata the slot stores a typed array directly."""
+        """Host bytes -> device segment (the one host->device hop).
+        With dtype/shape metadata the segment stores a typed array at
+        any offset, so multi-tensor layouts keep per-tensor dtype."""
         jax = self._jax
         region = self._get(region_id)
         if offset + len(data) > region.byte_size:
@@ -169,100 +188,154 @@ class TpuArena:
                 % (len(data), offset, region.byte_size),
                 status="INVALID_ARGUMENT",
             )
+        if datatype and shape is not None:
+            if datatype == "BYTES":
+                # variable-length elements stay host-side
+                array = deserialize_bytes_tensor(data).reshape(shape)
+            else:
+                np_dtype = triton_to_np_dtype(datatype)
+                host = np.frombuffer(data, dtype=np_dtype).reshape(shape)
+                array = jax.device_put(host, region.device)
+            segment = _Segment(offset, len(data), datatype, list(shape),
+                               array)
+        else:
+            array = jax.device_put(
+                np.frombuffer(data, np.uint8), region.device)
+            segment = _Segment(offset, len(data), None, None, array)
         with region.lock:
-            if datatype and shape is not None and offset == 0:
-                if datatype == "BYTES":
-                    # variable-length elements stay host-side
-                    arr = deserialize_bytes_tensor(data).reshape(shape)
-                    region.array = arr
-                else:
-                    np_dtype = triton_to_np_dtype(datatype)
-                    host = np.frombuffer(data, dtype=np_dtype).reshape(shape)
-                    region.array = jax.device_put(host, region.device)
-                region.datatype = datatype
-                region.shape = list(shape)
-                return
-            # raw byte write: merge into the flat uint8 image
-            flat = self._as_flat_u8(region)
-            host = np.asarray(flat)  # device->host (rare path)
-            host = host.copy()
-            host[offset : offset + len(data)] = np.frombuffer(data, np.uint8)
-            region.array = jax.device_put(host, region.device)
-            region.datatype = None
-            region.shape = None
+            self._insert_segment(region, segment)
 
-    def _as_flat_u8(self, region: _Region):
+    def _insert_segment(self, region: _Region, segment: _Segment) -> None:
+        """Place a segment, carving out overlaps. Only the overlapped
+        segments are touched (device->host per slice); untouched
+        tensors keep their device arrays — never a whole-region
+        round-trip. Caller holds region.lock."""
         jax = self._jax
-        if region.array is None:
-            return jax.device_put(
-                np.zeros(region.byte_size, dtype=np.uint8), region.device
-            )
-        if region.datatype is None:
-            return region.array
-        if isinstance(region.array, np.ndarray):  # BYTES host-side
-            raise InferenceServerException(
-                "cannot view BYTES region as raw bytes", status="INVALID_ARGUMENT"
-            )
-        # typed -> raw view without leaving the device
-        import jax.numpy as jnp
+        kept = []
+        for existing in region.segments:
+            if existing.end <= segment.offset or \
+                    existing.offset >= segment.end:
+                kept.append(existing)
+                continue
+            if (existing.offset >= segment.offset
+                    and existing.end <= segment.end):
+                continue  # fully covered: dropped
+            if existing.datatype == "BYTES":
+                # A partially-overwritten serialized BYTES tensor has
+                # no meaningful byte remainder (the length-prefixed
+                # framing is invalidated) — drop it so reads never see
+                # stale framing bytes past a smaller replacement.
+                continue
+            # Partial overlap: keep the non-overlapped remainder(s) as
+            # raw byte runs (host hop for this segment only).
+            raw = self._segment_bytes(existing)
+            if existing.offset < segment.offset:
+                head = raw[: segment.offset - existing.offset]
+                kept.append(_Segment(
+                    existing.offset, len(head), None, None,
+                    jax.device_put(np.frombuffer(head, np.uint8),
+                                   region.device)))
+            if existing.end > segment.end:
+                tail = raw[segment.end - existing.offset:]
+                kept.append(_Segment(
+                    segment.end, len(tail), None, None,
+                    jax.device_put(np.frombuffer(tail, np.uint8),
+                                   region.device)))
+        kept.append(segment)
+        kept.sort(key=lambda s: s.offset)
+        region.segments = kept
 
-        flat = region.array.reshape(-1)
-        if flat.dtype == jnp.bool_:  # bitcast rejects bool
-            flat = flat.astype(jnp.uint8)
-        u8 = jax.lax.bitcast_convert_type(flat, jnp.uint8).reshape(-1)
-        pad = region.byte_size - u8.size
-        if pad > 0:
-            u8 = jnp.concatenate([u8, jnp.zeros(pad, dtype=jnp.uint8)])
-        return u8
+    @staticmethod
+    def _segment_bytes(segment: _Segment) -> bytes:
+        """Serialize one segment to host bytes (inspection / carve
+        path — the only place a device segment crosses to host)."""
+        if segment.datatype == "BYTES":
+            from client_tpu.utils import serialize_byte_tensor
+
+            return serialize_byte_tensor(
+                np.asarray(segment.array)).tobytes()
+        return np.asarray(segment.array).tobytes()
 
     def as_typed_array(self, region_id: str, offset: int, byte_size: int,
                        datatype: str, shape):
-        """Resolve the slot as a device array of datatype/shape for
-        model consumption. Fast path: the slot already holds exactly
-        that typed array — hand it over untouched."""
+        """Resolve a slice as a device array of datatype/shape for
+        model consumption. Fast path: a segment already holds exactly
+        that typed array at that offset — hand it over untouched."""
         jax = self._jax
         region = self._get(region_id)
         with region.lock:
-            if (
-                offset == 0
-                and region.datatype == datatype
-                and region.shape == list(shape)
-                and region.array is not None
-            ):
-                return region.array
-            if region.array is None:
+            if not region.segments:
                 raise InferenceServerException(
-                    "TPU region read before any write", status="INVALID_ARGUMENT"
-                )
-            if datatype == "BYTES":
-                if isinstance(region.array, np.ndarray):
-                    return region.array.reshape(shape)
-                raise InferenceServerException(
-                    "region does not hold a BYTES tensor",
+                    "TPU region read before any write",
                     status="INVALID_ARGUMENT",
                 )
-            flat = self._as_flat_u8(region)
-            import jax.numpy as jnp
-
+            for segment in region.segments:
+                if (segment.offset == offset
+                        and segment.datatype == datatype
+                        and segment.shape == list(shape)):
+                    return segment.array
+            if datatype == "BYTES":
+                for segment in region.segments:
+                    if (segment.offset == offset
+                            and segment.datatype == "BYTES"):
+                        return segment.array.reshape(shape)
+                raise InferenceServerException(
+                    "region does not hold a BYTES tensor at offset %d"
+                    % offset,
+                    status="INVALID_ARGUMENT",
+                )
             elem = wire_dtype_element_size(datatype)
             count = elem * int(np.prod(shape)) if len(shape) else elem
             if offset + count > region.byte_size:
                 raise InferenceServerException(
-                    "typed view exceeds region bounds", status="INVALID_ARGUMENT"
+                    "typed view exceeds region bounds",
+                    status="INVALID_ARGUMENT",
                 )
-            np_dtype = triton_to_np_dtype(datatype)
-            window = jax.lax.dynamic_slice(flat, (offset,), (count,))
-            if datatype == "BOOL":  # bitcast rejects bool: u8 0/1 -> bool
-                typed = window.astype(jnp.bool_)
-            else:
-                typed = jax.lax.bitcast_convert_type(
-                    window.reshape(-1, elem), jnp.dtype(np_dtype)
+            cover = [s for s in region.segments
+                     if s.offset < offset + count and s.end > offset]
+            if any(s.datatype == "BYTES" for s in cover):
+                # Serialized BYTES framing is not byte-addressable
+                # numeric data — reinterpreting it would hand the
+                # model garbage.
+                raise InferenceServerException(
+                    "cannot view BYTES region as %s" % datatype,
+                    status="INVALID_ARGUMENT",
                 )
-            return typed.reshape(shape)
+            # Single covering non-BYTES segment: reinterpret on device
+            # (dynamic_slice + bitcast), no host hop.
+            if (len(cover) == 1 and cover[0].datatype != "BYTES"
+                    and cover[0].offset <= offset
+                    and cover[0].end >= offset + count):
+                import jax.numpy as jnp
+
+                segment = cover[0]
+                flat = segment.array.reshape(-1)
+                if flat.dtype == jnp.bool_:  # bitcast rejects bool
+                    flat = flat.astype(jnp.uint8)
+                if flat.dtype != jnp.uint8:
+                    flat = jax.lax.bitcast_convert_type(
+                        flat, jnp.uint8).reshape(-1)
+                np_dtype = triton_to_np_dtype(datatype)
+                window = jax.lax.dynamic_slice(
+                    flat, (offset - segment.offset,), (count,))
+                if datatype == "BOOL":  # u8 0/1 -> bool
+                    typed = window.astype(jnp.bool_)
+                else:
+                    typed = jax.lax.bitcast_convert_type(
+                        window.reshape(-1, elem), jnp.dtype(np_dtype))
+                return typed.reshape(shape)
+            # Slice spans several segments (or gaps): assemble the
+            # covered bytes on host — touching only those segments —
+            # and upload the window once.
+            data = self._read_locked(region, offset, count)
+            host = np.frombuffer(
+                data, dtype=triton_to_np_dtype(datatype)).reshape(shape)
+            return jax.device_put(host, region.device)
 
     def store(self, region_id: str, offset: int, byte_size: int, value) -> int:
-        """Place an inference output into the slot by reference (the
-        zero-copy 'write'). Returns the logical byte size stored."""
+        """Place an inference output into the region by reference (the
+        zero-copy 'write' — a segment swap at any offset). Returns the
+        logical byte size stored."""
         jax = self._jax
         region = self._get(region_id)
         if isinstance(value, np.ndarray) and value.dtype.kind in ("O", "S", "U"):
@@ -287,42 +360,36 @@ class TpuArena:
                 % (nbytes, min(byte_size, region.byte_size - offset)),
                 status="INVALID_ARGUMENT",
             )
-        if offset:
-            # non-zero offset: merge into the raw byte image (host hop;
-            # the zero-copy contract applies to whole-slot placement)
-            if datatype == "BYTES":
-                from client_tpu.utils import serialize_byte_tensor as _sbt
-
-                data = _sbt(np.asarray(stored)).tobytes()
-            else:
-                data = np.asarray(stored).tobytes()
-            self.write(region.region_id, offset, data)
-            return nbytes
         with region.lock:
-            region.array = stored
-            region.datatype = datatype
-            region.shape = list(stored.shape)
+            self._insert_segment(region, _Segment(
+                offset, nbytes, datatype, list(stored.shape), stored))
         return nbytes
 
     def read(self, region_id: str, offset: int, byte_size: int) -> bytes:
-        """Device slot -> host bytes (inspection path)."""
+        """Device region -> host bytes (inspection path). Serializes
+        only the segments overlapping the window."""
         region = self._get(region_id)
         with region.lock:
-            if region.array is None:
+            if not region.segments:
                 return b"\x00" * (byte_size or region.byte_size)
-            if region.datatype == "BYTES":
-                from client_tpu.utils import serialize_byte_tensor
+            if byte_size == 0:  # "to end" = the stored payload
+                end = max(s.end for s in region.segments)
+                byte_size = max(end - offset, 0)
+                if byte_size == 0:
+                    return b""
+            return self._read_locked(region, offset, byte_size)
 
-                data = serialize_byte_tensor(region.array).tobytes()
-            elif region.datatype is not None:
-                data = np.asarray(region.array).tobytes()
-            else:
-                data = np.asarray(region.array).tobytes()
-        if byte_size == 0:  # "to end" = the stored payload (BYTES reads)
-            return data[offset:]
-        if offset >= len(data):
-            return b"\x00" * byte_size
-        chunk = data[offset : offset + byte_size]
-        if len(chunk) < byte_size:  # zero-fill past the stored payload
-            chunk = chunk + b"\x00" * (byte_size - len(chunk))
-        return chunk
+    def _read_locked(self, region: _Region, offset: int,
+                     byte_size: int) -> bytes:
+        """Assemble [offset, offset+byte_size) from overlapping
+        segments, zero-filling gaps. Caller holds region.lock."""
+        window = bytearray(byte_size)
+        for segment in region.segments:
+            if segment.end <= offset or segment.offset >= offset + byte_size:
+                continue
+            raw = self._segment_bytes(segment)
+            src_lo = max(0, offset - segment.offset)
+            src_hi = min(len(raw), offset + byte_size - segment.offset)
+            dst_lo = segment.offset + src_lo - offset
+            window[dst_lo:dst_lo + (src_hi - src_lo)] = raw[src_lo:src_hi]
+        return bytes(window)

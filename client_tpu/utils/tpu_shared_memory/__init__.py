@@ -222,24 +222,19 @@ def set_shared_memory_region(
             "input_values must be a list of numpy arrays"
         )
     transport = tpu_shm_handle._transport
-    if len(input_values) == 1 and offset == 0:
-        arr = input_values[0]
+    pos = offset
+    for arr in input_values:
         datatype = np_to_wire_dtype(arr.dtype)
         if datatype == "BYTES":
             data = serialize_byte_tensor(arr).tobytes()
         else:
             data = np.ascontiguousarray(arr).tobytes()
+        # dtype/shape ride with every tensor, so multi-tensor layouts
+        # become typed device segments (no raw-byte degradation).
         transport.write(
-            tpu_shm_handle._region_id, 0, data, datatype, list(arr.shape)
+            tpu_shm_handle._region_id, pos, data, datatype,
+            list(arr.shape)
         )
-        return
-    pos = offset
-    for arr in input_values:
-        if arr.dtype.kind in ("O", "S", "U"):
-            data = serialize_byte_tensor(arr).tobytes()
-        else:
-            data = np.ascontiguousarray(arr).tobytes()
-        transport.write(tpu_shm_handle._region_id, pos, data)
         pos += len(data)
 
 
@@ -277,8 +272,12 @@ def _is_jax_array(value) -> bool:
 def _dlpack_to_numpy(value) -> np.ndarray:
     if isinstance(value, np.ndarray):
         return value
+    # Host tensors: zero-copy ctypes view via the standalone DLPack
+    # layer (no framework import, parity: reference utils/_dlpack.py).
+    from client_tpu.utils import _dlpack
+
     try:
-        return np.from_dlpack(value)
+        return _dlpack.to_numpy(value)
     except Exception:
         pass
     # device tensors: go through the producer's own host transfer
