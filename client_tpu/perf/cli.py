@@ -11,6 +11,7 @@ rather than silently no-op).
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 from typing import List, Optional
 
@@ -170,6 +171,8 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
         loader.generate_data(zero_input=args.input_data == "zero",
                              string_length=args.string_length,
                              string_data=args.string_data)
+    elif os.path.isdir(args.input_data):
+        loader.read_data_from_dir(args.input_data)
     else:
         loader.read_data_from_json(args.input_data)
 

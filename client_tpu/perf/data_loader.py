@@ -123,6 +123,50 @@ class DataLoader:
 
     # -- JSON file -------------------------------------------------------
 
+    def read_data_from_dir(self, directory: str) -> None:
+        """Directory input: one file per input named after the input
+        (parity: reference DataLoader::ReadDataFromDir,
+        data_loader.cc:42 — single stream/step; non-BYTES files are
+        raw binary matching the tensor byte size, BYTES files are
+        text with one string element per line)."""
+        import os
+
+        step: Dict[str, TensorData] = {}
+        for name, tensor in self._model.inputs.items():
+            path = os.path.join(directory, name)
+            if not os.path.exists(path):
+                if tensor.optional:
+                    continue
+                raise InferenceServerException(
+                    "no file for input '%s' in %s" % (name, directory))
+            shape = _resolve_shape(tensor)
+            if tensor.datatype == "BYTES":
+                # Binary line split (parity with the native reader):
+                # BYTES elements need not be valid UTF-8.
+                with open(path, "rb") as f:
+                    lines = f.read().split(b"\n")
+                if lines and lines[-1] == b"":
+                    lines.pop()  # trailing newline
+                count = int(np.prod(shape)) if shape else 1
+                if len(lines) != count:
+                    raise InferenceServerException(
+                        "input '%s': %d strings in file, shape %s wants "
+                        "%d" % (name, len(lines), shape, count))
+                arr = np.array(lines, dtype=np.object_).reshape(shape)
+            else:
+                with open(path, "rb") as f:
+                    raw = f.read()
+                np_dtype = triton_to_np_dtype(tensor.datatype)
+                expected = int(np.prod(shape)) * np.dtype(np_dtype).itemsize
+                if len(raw) != expected:
+                    raise InferenceServerException(
+                        "input '%s' file has %d bytes, expected %d for "
+                        "shape %s" % (name, len(raw), expected, shape))
+                arr = np.frombuffer(raw, dtype=np_dtype).reshape(shape)
+            step[name] = TensorData(arr, tensor.datatype)
+        self._data = [[step]]
+        self._validate()
+
     def read_data_from_json(self, path_or_dict) -> None:
         """Load the reference's JSON input format: {"data": [step,
         ...]} or {"data": [[stream0 steps], [stream1 steps], ...]};

@@ -157,6 +157,52 @@ Error DataLoader::GenerateData(
   return Error::Success;
 }
 
+Error DataLoader::ReadDataFromDir(const std::string& directory) {
+  std::vector<std::map<std::string, TensorData>> stream(1);
+  std::map<std::string, TensorData>& step = stream[0];
+  for (const ModelTensor& tensor : model_->inputs) {
+    const std::string path = directory + "/" + tensor.name;
+    std::ifstream file(path, std::ios::binary);
+    if (!file) {
+      if (tensor.optional) continue;
+      return Error("no file for input '" + tensor.name + "' in " +
+                   directory);
+    }
+    TensorData data;
+    data.datatype = tensor.datatype;
+    data.shape = ResolveShape(tensor.shape);
+    int64_t count = ElementCount(data.shape);
+    if (tensor.datatype == "BYTES") {
+      std::string line;
+      int64_t lines = 0;
+      while (std::getline(file, line)) {
+        AppendBytesElement(line, &data.bytes);
+        ++lines;
+      }
+      if (lines != count) {
+        return Error(
+            "input '" + tensor.name + "': " + std::to_string(lines) +
+            " strings in file, shape wants " + std::to_string(count));
+      }
+    } else {
+      std::stringstream buffer;
+      buffer << file.rdbuf();
+      data.bytes = buffer.str();
+      size_t expected = count * DatatypeByteSize(tensor.datatype);
+      if (data.bytes.size() != expected) {
+        return Error(
+            "input '" + tensor.name + "' file has " +
+            std::to_string(data.bytes.size()) + " bytes, expected " +
+            std::to_string(expected));
+      }
+    }
+    step[tensor.name] = std::move(data);
+  }
+  data_.clear();
+  data_.push_back(std::move(stream));
+  return Validate();
+}
+
 Error DataLoader::ReadDataFromJson(const std::string& path) {
   std::ifstream in(path);
   if (!in) return Error("cannot open input data file '" + path + "'");

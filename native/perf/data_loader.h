@@ -51,6 +51,12 @@ class DataLoader {
   Error ReadDataFromJson(const std::string& path);
   Error ReadDataFromJsonText(const std::string& text);
 
+  // Directory input: one file per input named after the input
+  // (parity: ReadDataFromDir data_loader.cc:42 — single stream/step;
+  // non-BYTES files are raw binary matching the tensor byte size,
+  // BYTES files are text with one string element per line).
+  Error ReadDataFromDir(const std::string& directory);
+
  private:
   Error ParseValue(
       const ModelTensor& tensor, const json::Value& value, TensorData* out);

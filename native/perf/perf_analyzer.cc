@@ -6,6 +6,7 @@
 #include <csignal>
 #include <cstdio>
 #include <cstring>
+#include <sys/stat.h>
 
 #include "command_line_parser.h"
 #include "inference_profiler.h"
@@ -102,10 +103,15 @@ int Run(int argc, char** argv) {
   }
 
   DataLoader loader(&model);
+  struct stat input_stat;
   if (params.input_data == "random" || params.input_data == "zero") {
     err = loader.GenerateData(
         params.input_data == "zero", params.string_length,
         params.string_data);
+  } else if (
+      stat(params.input_data.c_str(), &input_stat) == 0 &&
+      S_ISDIR(input_stat.st_mode)) {
+    err = loader.ReadDataFromDir(params.input_data);
   } else {
     err = loader.ReadDataFromJson(params.input_data);
   }

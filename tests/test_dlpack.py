@@ -1,0 +1,100 @@
+"""Standalone ctypes DLPack layer (parity: reference utils/_dlpack.py
+— framework-free tensor ingestion)."""
+
+import numpy as np
+import pytest
+
+from client_tpu.utils import _dlpack
+
+
+def test_numpy_roundtrip_zero_copy():
+    source = np.arange(12, dtype=np.float32).reshape(3, 4)
+    view = _dlpack.capsule_to_numpy(source.__dlpack__())
+    np.testing.assert_array_equal(view, source)
+    # Zero copy: mutating the source shows through the view.
+    source[0, 0] = 99.0
+    assert view[0, 0] == 99.0
+
+
+def test_dtypes_roundtrip():
+    for dtype in (np.int8, np.int16, np.int32, np.int64, np.uint8,
+                  np.uint16, np.uint32, np.uint64, np.float16,
+                  np.float32, np.float64, np.bool_):
+        source = np.zeros(5, dtype=dtype)
+        view = _dlpack.to_numpy(_Wrapper(source))
+        assert view.dtype == source.dtype
+        np.testing.assert_array_equal(view, source)
+
+
+class _Wrapper:
+    """A minimal producer exposing only __dlpack__."""
+
+    def __init__(self, array):
+        self._array = array
+
+    def __dlpack__(self, stream=None):
+        return self._array.__dlpack__()
+
+
+def test_device_query():
+    source = np.zeros(3)
+    device = _dlpack.get_dlpack_device(source)
+    assert device[0] == _dlpack.DLDeviceType.kDLCPU
+
+
+def test_torch_tensor_ingestion():
+    torch = pytest.importorskip("torch")
+    tensor = torch.arange(6, dtype=torch.float32).reshape(2, 3)
+    view = _dlpack.to_numpy(tensor)
+    np.testing.assert_array_equal(view, tensor.numpy())
+
+
+def test_jax_cpu_array_ingestion():
+    import jax.numpy as jnp
+
+    array = jnp.arange(8, dtype=jnp.int32)
+    view = _dlpack.to_numpy(array)
+    np.testing.assert_array_equal(view, np.arange(8, dtype=np.int32))
+
+
+def test_non_contiguous_rejected():
+    source = np.arange(16, dtype=np.float32).reshape(4, 4)
+    sliced = source[:, ::2]  # strided view
+    with pytest.raises((ValueError, BufferError)):
+        _dlpack.capsule_to_numpy(sliced.__dlpack__())
+
+
+def test_used_capsule_rejected():
+    source = np.zeros(4)
+    capsule = source.__dlpack__()
+    _dlpack.capsule_to_numpy(capsule)  # does not consume the name
+    # Consuming via numpy marks it used; a second parse must fail.
+    np.from_dlpack(_CapsuleCarrier(capsule))
+    with pytest.raises(ValueError):
+        _dlpack.get_managed_tensor(capsule)
+
+
+class _CapsuleCarrier:
+    def __init__(self, capsule):
+        self._capsule = capsule
+
+    def __dlpack__(self, stream=None, max_version=None):
+        return self._capsule
+
+    def __dlpack_device__(self):
+        return (_dlpack.DLDeviceType.kDLCPU, 0)
+
+
+def test_triton_to_dlpack_dtype():
+    dt = _dlpack.triton_to_dlpack_dtype("FP32")
+    assert (dt.type_code, dt.bits, dt.lanes) == (
+        _dlpack.DLDataTypeCode.kDLFloat, 32, 1)
+    with pytest.raises(ValueError):
+        _dlpack.triton_to_dlpack_dtype("BYTES")
+
+
+def test_bf16_dtype_mapping():
+    import ml_dtypes
+
+    dt = _dlpack.DLDataType(_dlpack.DLDataTypeCode.kDLBfloat, 16, 1)
+    assert _dlpack.dlpack_to_np_dtype(dt) == np.dtype(ml_dtypes.bfloat16)

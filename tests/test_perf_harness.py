@@ -457,3 +457,68 @@ def test_cli_collect_metrics_against_http(tmp_path):
     finally:
         http_handle.stop()
         grpc_handle.stop()
+
+
+def test_data_loader_directory_input(tmp_path):
+    """Directory-of-files input: one file per input (parity:
+    reference DataLoader::ReadDataFromDir)."""
+    from client_tpu.perf.model_parser import ModelTensor, ParsedModel
+
+    model = ParsedModel()
+    model.name = "m"
+    model.inputs["INPUT0"] = ModelTensor("INPUT0", "FP32", [4])
+    model.inputs["WORDS"] = ModelTensor("WORDS", "BYTES", [2])
+    data = np.arange(4, dtype=np.float32)
+    (tmp_path / "INPUT0").write_bytes(data.tobytes())
+    (tmp_path / "WORDS").write_text("hello\nworld\n")
+    loader = DataLoader(model)
+    loader.read_data_from_dir(str(tmp_path))
+    got = loader.get_input_data("INPUT0")
+    np.testing.assert_array_equal(got.array, data)
+    words = loader.get_input_data("WORDS")
+    assert list(words.array) == [b"hello", b"world"]
+
+
+def test_data_loader_directory_input_size_mismatch(tmp_path):
+    from client_tpu.perf.model_parser import ModelTensor, ParsedModel
+    from client_tpu.utils import InferenceServerException
+
+    model = ParsedModel()
+    model.name = "m"
+    model.inputs["INPUT0"] = ModelTensor("INPUT0", "FP32", [4])
+    (tmp_path / "INPUT0").write_bytes(b"\x00" * 7)  # not 16 bytes
+    loader = DataLoader(model)
+    with pytest.raises(InferenceServerException):
+        loader.read_data_from_dir(str(tmp_path))
+
+
+def test_native_perf_analyzer_directory_input(tmp_path):
+    """Native harness accepts a directory for --input-data."""
+    import pathlib
+    import subprocess
+
+    binary = pathlib.Path(__file__).resolve().parents[1] / "native" / \
+        "build" / "perf_analyzer"
+    if not binary.exists():
+        pytest.skip("native perf_analyzer not built")
+    # Serve the simple model and feed it from files.
+    from client_tpu.server.app import build_core, start_grpc_server
+
+    core = build_core(["simple"])
+    handle = start_grpc_server(core=core)
+    try:
+        data = np.arange(16, dtype=np.int32)
+        (tmp_path / "INPUT0").write_bytes(data.tobytes())
+        (tmp_path / "INPUT1").write_bytes(data.tobytes())
+        csv = tmp_path / "latency.csv"
+        proc = subprocess.run(
+            [str(binary), "-m", "simple", "-u", handle.address,
+             "--input-data", str(tmp_path),
+             "--concurrency-range", "1", "-p", "300", "-r", "3",
+             "-s", "90", "-f", str(csv)],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert float(csv.read_text().splitlines()[1].split(",")[1]) > 0
+    finally:
+        handle.stop()
