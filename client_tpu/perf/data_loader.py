@@ -13,7 +13,9 @@ import numpy as np
 from client_tpu.perf.model_parser import ModelTensor, ParsedModel
 from client_tpu.utils import (
     InferenceServerException,
+    num_elements,
     serialize_byte_tensor,
+    tensor_byte_size,
     triton_to_np_dtype,
 )
 
@@ -147,7 +149,7 @@ class DataLoader:
                     lines = f.read().split(b"\n")
                 if lines and lines[-1] == b"":
                     lines.pop()  # trailing newline
-                count = int(np.prod(shape)) if shape else 1
+                count = num_elements(shape)
                 if len(lines) != count:
                     raise InferenceServerException(
                         "input '%s': %d strings in file, shape %s wants "
@@ -157,7 +159,7 @@ class DataLoader:
                 with open(path, "rb") as f:
                     raw = f.read()
                 np_dtype = triton_to_np_dtype(tensor.datatype)
-                expected = int(np.prod(shape)) * np.dtype(np_dtype).itemsize
+                expected = tensor_byte_size(tensor.datatype, shape)
                 if len(raw) != expected:
                     raise InferenceServerException(
                         "input '%s' file has %d bytes, expected %d for "

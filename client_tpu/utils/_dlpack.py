@@ -116,9 +116,14 @@ def get_dlpack_device(tensor) -> Tuple[int, int]:
     when the producer lacks __dlpack_device__."""
     if hasattr(tensor, "__dlpack_device__"):
         return tuple(tensor.__dlpack_device__())
-    managed = get_managed_tensor(get_dlpack_capsule(tensor))
+    # Keep the capsule referenced while reading the struct — dropping
+    # it runs the producer's deleter and frees the DLManagedTensor.
+    capsule = get_dlpack_capsule(tensor)
+    managed = get_managed_tensor(capsule)
     device = managed.dl_tensor.device
-    return (device.device_type, device.device_id)
+    result = (device.device_type, device.device_id)
+    del managed, capsule
+    return result
 
 
 def triton_to_dlpack_dtype(wire_dtype: str) -> DLDataType:
@@ -192,14 +197,14 @@ def capsule_to_numpy(capsule, writable: bool = False) -> np.ndarray:
             "capsule holds device memory (device_type=%d), not host"
             % tensor.device.device_type)
     shape = [tensor.shape[i] for i in range(tensor.ndim)]
-    if not is_contiguous_data(tensor.ndim, tensor.shape, tensor.strides):
-        raise ValueError("only contiguous DLPack tensors are supported")
     np_dtype = dlpack_to_np_dtype(tensor.dtype)
     count = int(np.prod(shape)) if shape else 1
+    if count == 0:  # empty tensors need no layout validation
+        return np.empty(shape, dtype=np_dtype)
+    if not is_contiguous_data(tensor.ndim, tensor.shape, tensor.strides):
+        raise ValueError("only contiguous DLPack tensors are supported")
     nbytes = count * np_dtype.itemsize
     address = (tensor.data or 0) + tensor.byte_offset
-    if count == 0:
-        return np.empty(shape, dtype=np_dtype)
     buffer = (ctypes.c_char * nbytes).from_address(address)
     array = np.frombuffer(buffer, dtype=np_dtype).reshape(shape)
     if not writable:

@@ -165,9 +165,26 @@ public class InferenceServerClient implements AutoCloseable {
         });
   }
 
-  private HttpRequest buildInferRequest(
-      String modelName, List<InferInput> inputs,
-      List<InferRequestedOutput> outputs) throws InferenceException {
+  /** The assembled binary-protocol request body. */
+  public static final class WireBody {
+    public final byte[] body;
+    public final int headerLength;
+
+    WireBody(byte[] body, int headerLength) {
+      this.body = body;
+      this.headerLength = headerLength;
+    }
+  }
+
+  /**
+   * Builds the v2 binary-protocol body (JSON header + concatenated
+   * raw tensor segments). Exposed statically so wire-format
+   * conformance checks can compare these bytes against the Python
+   * client's generate_request_body output.
+   */
+  public static WireBody buildInferBody(
+      List<InferInput> inputs, List<InferRequestedOutput> outputs)
+      throws InferenceException {
     Map<String, Object> header = new LinkedHashMap<>();
     List<Object> inputEntries = new ArrayList<>();
     List<byte[]> binarySegments = new ArrayList<>();
@@ -197,14 +214,20 @@ public class InferenceServerClient implements AutoCloseable {
     ByteBuffer body = ByteBuffer.allocate(total);
     body.put(headerBytes);
     for (byte[] segment : binarySegments) body.put(segment);
+    return new WireBody(body.array(), headerBytes.length);
+  }
 
+  private HttpRequest buildInferRequest(
+      String modelName, List<InferInput> inputs,
+      List<InferRequestedOutput> outputs) throws InferenceException {
+    WireBody wire = buildInferBody(inputs, outputs);
     return HttpRequest.newBuilder()
         .uri(URI.create(baseUrl + "/v2/models/" + modelName + "/infer"))
         .timeout(requestTimeout)
         .header("Content-Type", "application/octet-stream")
         .header("Inference-Header-Content-Length",
-                Integer.toString(headerBytes.length))
-        .POST(HttpRequest.BodyPublishers.ofByteArray(body.array()))
+                Integer.toString(wire.headerLength))
+        .POST(HttpRequest.BodyPublishers.ofByteArray(wire.body))
         .build();
   }
 

@@ -1,0 +1,44 @@
+package tpuclient.examples;
+
+import java.util.Base64;
+import java.util.List;
+
+import tpuclient.DataType;
+import tpuclient.InferInput;
+import tpuclient.InferRequestedOutput;
+import tpuclient.InferenceServerClient;
+
+/**
+ * Wire-format conformance probe: assembles the canonical "simple"
+ * request (the same tensors tests/test_java_source.py builds with the
+ * Python client) and prints the binary-protocol body, so the test can
+ * assert the Java client's bytes match the Python client's.
+ *
+ * Output: two lines — the JSON header length, then the base64 body.
+ */
+public final class WireFormatCheck {
+  private WireFormatCheck() {}
+
+  public static void main(String[] args) throws Exception {
+    int[] values0 = new int[16];
+    int[] values1 = new int[16];
+    for (int i = 0; i < 16; i++) {
+      values0[i] = i;
+      values1[i] = 1;
+    }
+    InferInput input0 = new InferInput(
+        "INPUT0", new long[] {16}, DataType.INT32);
+    input0.setData(values0);
+    InferInput input1 = new InferInput(
+        "INPUT1", new long[] {16}, DataType.INT32);
+    input1.setData(values1);
+    InferRequestedOutput output0 = new InferRequestedOutput("OUTPUT0", true);
+    InferRequestedOutput output1 = new InferRequestedOutput("OUTPUT1", true);
+
+    InferenceServerClient.WireBody wire =
+        InferenceServerClient.buildInferBody(
+            List.of(input0, input1), List.of(output0, output1));
+    System.out.println(wire.headerLength);
+    System.out.println(Base64.getEncoder().encodeToString(wire.body));
+  }
+}

@@ -98,3 +98,10 @@ def test_bf16_dtype_mapping():
 
     dt = _dlpack.DLDataType(_dlpack.DLDataTypeCode.kDLBfloat, 16, 1)
     assert _dlpack.dlpack_to_np_dtype(dt) == np.dtype(ml_dtypes.bfloat16)
+
+
+def test_zero_size_tensor():
+    torch = pytest.importorskip("torch")
+    empty = torch.empty(3, 0)
+    view = _dlpack.to_numpy(empty)
+    assert view.shape == (3, 0)

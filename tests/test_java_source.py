@@ -89,3 +89,79 @@ def test_compiles_if_jdk_available(tmp_path):
         capture_output=True, text=True, timeout=300,
     )
     assert proc.returncode == 0, proc.stderr
+
+
+GOLDEN = pathlib.Path(__file__).resolve().parent / "golden" / \
+    "simple_infer_request.golden"
+
+
+def _canonical_request():
+    """The canonical 'simple' request both clients must serialize
+    identically (java/examples/WireFormatCheck.java builds the same)."""
+    import numpy as np
+
+    from client_tpu.http import (
+        InferenceServerClient,
+        InferInput,
+        InferRequestedOutput,
+    )
+
+    i0 = InferInput("INPUT0", [16], "INT32")
+    i0.set_data_from_numpy(np.arange(16, dtype=np.int32))
+    i1 = InferInput("INPUT1", [16], "INT32")
+    i1.set_data_from_numpy(np.ones(16, dtype=np.int32))
+    o0 = InferRequestedOutput("OUTPUT0", binary_data=True)
+    o1 = InferRequestedOutput("OUTPUT1", binary_data=True)
+    return InferenceServerClient.generate_request_body(
+        [i0, i1], outputs=[o0, o1])
+
+
+def _parse_golden(text):
+    import base64
+    import json
+
+    lines = text.strip().splitlines()
+    header_len = int(lines[0])
+    body = base64.b64decode(lines[1])
+    return json.loads(body[:header_len]), body[header_len:]
+
+
+def test_python_wire_format_matches_golden():
+    """Guards the Python client's binary protocol against drift."""
+    import base64
+    import json
+
+    body, header_len = _canonical_request()
+    golden_header, golden_payload = _parse_golden(GOLDEN.read_text())
+    assert json.loads(body[:header_len]) == golden_header
+    assert body[header_len:] == golden_payload
+
+
+def test_java_wire_format_matches_golden(tmp_path):
+    """Compiles the Java client and asserts its binary request bytes
+    equal the Python client's (semantically-equal JSON header,
+    byte-equal tensor payload). Skipped without a JDK."""
+    import json
+    import subprocess as sp
+
+    javac = shutil.which("javac")
+    java = shutil.which("java")
+    if not (javac and java):
+        pytest.skip("no JDK on this image")
+    classes = tmp_path / "classes"
+    classes.mkdir()
+    sources = [str(p) for p in _sources()]
+    compile_proc = sp.run(
+        [javac, "-d", str(classes)] + sources,
+        capture_output=True, text=True, timeout=300,
+    )
+    assert compile_proc.returncode == 0, compile_proc.stderr
+    run_proc = sp.run(
+        [java, "-cp", str(classes), "tpuclient.examples.WireFormatCheck"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert run_proc.returncode == 0, run_proc.stderr
+    golden_header, golden_payload = _parse_golden(GOLDEN.read_text())
+    java_header, java_payload = _parse_golden(run_proc.stdout)
+    assert java_header == golden_header
+    assert java_payload == golden_payload
