@@ -233,30 +233,43 @@ Error InferResultHttp::RequestStatus() const { return status_; }
 Error InferenceServerHttpClient::Create(
     std::unique_ptr<InferenceServerHttpClient>* client, const std::string& url,
     bool verbose) {
-  client->reset(new InferenceServerHttpClient(url, verbose));
+  return Create(client, url, SslOptions(), verbose);
+}
+
+Error InferenceServerHttpClient::Create(
+    std::unique_ptr<InferenceServerHttpClient>* client, const std::string& url,
+    const SslOptions& ssl_options, bool verbose) {
+  client->reset(new InferenceServerHttpClient(url, ssl_options, verbose));
   if ((*client)->port_ == 0) {
     client->reset();
     return Error("invalid url '" + url + "': expected host:port");
+  }
+  if ((*client)->use_tls_ && !TlsSession::Available()) {
+    client->reset();
+    return Error("https requested but libssl.so.3 is unavailable");
   }
   return Error::Success;
 }
 
 InferenceServerHttpClient::InferenceServerHttpClient(
-    const std::string& url, bool verbose)
-    : InferenceServerClient(verbose) {
-  // Strip optional scheme.
+    const std::string& url, const SslOptions& ssl_options, bool verbose)
+    : InferenceServerClient(verbose), ssl_options_(ssl_options) {
+  // Strip optional scheme ("https://" selects TLS).
   std::string rest = url;
   size_t scheme = rest.find("://");
-  if (scheme != std::string::npos) rest = rest.substr(scheme + 3);
+  if (scheme != std::string::npos) {
+    use_tls_ = rest.compare(0, scheme, "https") == 0;
+    rest = rest.substr(scheme + 3);
+  }
   size_t colon = rest.rfind(':');
   if (colon != std::string::npos) {
     host_ = rest.substr(0, colon);
     port_ = atoi(rest.c_str() + colon + 1);
   } else {
     host_ = rest;
-    port_ = 8000;
+    port_ = use_tls_ ? 443 : 8000;
   }
-  sync_conn_.reset(new HttpConnection(host_, port_));
+  sync_conn_.reset(new HttpConnection(host_, port_, use_tls_, ssl_options_));
 }
 
 InferenceServerHttpClient::~InferenceServerHttpClient() {

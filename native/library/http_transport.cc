@@ -23,6 +23,10 @@ static uint64_t NowNs() {
 HttpConnection::~HttpConnection() { Close(); }
 
 void HttpConnection::Close() {
+  if (tls_ != nullptr) {
+    tls_->Close();
+    tls_.reset();
+  }
   if (fd_ >= 0) {
     ::close(fd_);
     fd_ = -1;
@@ -99,11 +103,23 @@ std::string HttpConnection::Connect(uint64_t timeout_us) {
   if (fd_ < 0) {
     return "failed to connect to " + host_ + ":" + port_str + ": " + err;
   }
+  if (use_tls_) {
+    tls_ = std::make_unique<TlsSession>();
+    uint64_t deadline_ns =
+        (timeout_us != 0) ? NowNs() + timeout_us * 1000ull : 0;
+    std::string tls_err =
+        tls_->Handshake(fd_, host_, ssl_options_, "", deadline_ns);
+    if (!tls_err.empty()) {
+      Close();
+      return "TLS handshake with " + host_ + ": " + tls_err;
+    }
+  }
   return "";
 }
 
 std::string HttpConnection::SendAll(
     const char* data, size_t len, uint64_t deadline_ns) {
+  if (tls_ != nullptr) return tls_->Write(data, len, deadline_ns);
   size_t sent = 0;
   while (sent < len) {
     ssize_t n = ::send(fd_, data + sent, len - sent, MSG_NOSIGNAL);
@@ -126,6 +142,9 @@ std::string HttpConnection::SendAll(
 
 ssize_t HttpConnection::RecvSome(
     char* buf, size_t len, uint64_t deadline_ns, std::string* err) {
+  if (tls_ != nullptr) {
+    return static_cast<ssize_t>(tls_->Read(buf, len, deadline_ns, err));
+  }
   while (true) {
     ssize_t n = ::recv(fd_, buf, len, 0);
     if (n >= 0) return n;

@@ -7,7 +7,10 @@
 #include <cstdint>
 #include <functional>
 #include <map>
+#include <memory>
 #include <string>
+
+#include "tls.h"
 
 namespace tpuclient {
 
@@ -22,6 +25,12 @@ class HttpConnection {
  public:
   HttpConnection(const std::string& host, int port)
       : host_(host), port_(port) {}
+  // HTTPS: TLS over dlopen'd OpenSSL (tls.h); options mirror the
+  // reference SslOptions.
+  HttpConnection(const std::string& host, int port, bool use_tls,
+                 const SslOptions& ssl_options)
+      : host_(host), port_(port), use_tls_(use_tls),
+        ssl_options_(ssl_options) {}
   ~HttpConnection();
 
   HttpConnection(const HttpConnection&) = delete;
@@ -66,6 +75,9 @@ class HttpConnection {
   std::string host_;
   int port_;
   int fd_ = -1;
+  bool use_tls_ = false;
+  SslOptions ssl_options_;
+  std::unique_ptr<TlsSession> tls_;
   // Buffered bytes read past the previous response (pipelining slop).
   std::string leftover_;
 };

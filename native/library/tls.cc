@@ -1,0 +1,260 @@
+#include "tls.h"
+
+#include <dlfcn.h>
+#include <poll.h>
+#include <time.h>
+
+#include <mutex>
+
+namespace tpuclient {
+
+namespace {
+
+// OpenSSL constants (stable public ABI values, openssl/ssl.h).
+constexpr int kSslErrorWantRead = 2;
+constexpr int kSslErrorWantWrite = 3;
+constexpr int kSslErrorZeroReturn = 6;
+constexpr int kSslVerifyNone = 0;
+constexpr int kSslVerifyPeer = 1;
+constexpr int kSslFiletypePem = 1;
+constexpr long kCtrlSetTlsextHostname = 55;
+constexpr long kTlsextNametypeHostName = 0;
+
+struct OpenSsl {
+  void* (*TLS_client_method)();
+  void* (*SSL_CTX_new)(void*);
+  void (*SSL_CTX_free)(void*);
+  void (*SSL_CTX_set_verify)(void*, int, void*);
+  int (*SSL_CTX_load_verify_locations)(void*, const char*, const char*);
+  int (*SSL_CTX_set_default_verify_paths)(void*);
+  int (*SSL_CTX_use_certificate_chain_file)(void*, const char*);
+  int (*SSL_CTX_use_PrivateKey_file)(void*, const char*, int);
+  int (*SSL_CTX_set_alpn_protos)(void*, const unsigned char*, unsigned);
+  void* (*SSL_new)(void*);
+  void (*SSL_free)(void*);
+  int (*SSL_set_fd)(void*, int);
+  long (*SSL_ctrl)(void*, int, long, void*);
+  int (*SSL_set1_host)(void*, const char*);
+  int (*SSL_connect)(void*);
+  int (*SSL_read)(void*, void*, int);
+  int (*SSL_write)(void*, const void*, int);
+  int (*SSL_get_error)(const void*, int);
+  int (*SSL_shutdown)(void*);
+  unsigned long (*ERR_get_error)();
+  void (*ERR_error_string_n)(unsigned long, char*, size_t);
+
+  bool ok = false;
+};
+
+OpenSsl* Lib() {
+  static OpenSsl lib;
+  static std::once_flag once;
+  std::call_once(once, [] {
+    void* ssl = dlopen("libssl.so.3", RTLD_NOW | RTLD_GLOBAL);
+    if (ssl == nullptr) ssl = dlopen("libssl.so", RTLD_NOW | RTLD_GLOBAL);
+    if (ssl == nullptr) return;
+    void* crypto = dlopen("libcrypto.so.3", RTLD_NOW | RTLD_GLOBAL);
+    if (crypto == nullptr) crypto = dlopen("libcrypto.so", RTLD_NOW);
+
+    auto bind = [&](const char* name) -> void* {
+      void* sym = dlsym(ssl, name);
+      if (sym == nullptr && crypto != nullptr) sym = dlsym(crypto, name);
+      return sym;
+    };
+#define TPUCLIENT_BIND(field)                                        \
+  lib.field = reinterpret_cast<decltype(lib.field)>(bind(#field));   \
+  if (lib.field == nullptr) return;
+    TPUCLIENT_BIND(TLS_client_method)
+    TPUCLIENT_BIND(SSL_CTX_new)
+    TPUCLIENT_BIND(SSL_CTX_free)
+    TPUCLIENT_BIND(SSL_CTX_set_verify)
+    TPUCLIENT_BIND(SSL_CTX_load_verify_locations)
+    TPUCLIENT_BIND(SSL_CTX_set_default_verify_paths)
+    TPUCLIENT_BIND(SSL_CTX_use_certificate_chain_file)
+    TPUCLIENT_BIND(SSL_CTX_use_PrivateKey_file)
+    TPUCLIENT_BIND(SSL_CTX_set_alpn_protos)
+    TPUCLIENT_BIND(SSL_new)
+    TPUCLIENT_BIND(SSL_free)
+    TPUCLIENT_BIND(SSL_set_fd)
+    TPUCLIENT_BIND(SSL_ctrl)
+    TPUCLIENT_BIND(SSL_set1_host)
+    TPUCLIENT_BIND(SSL_connect)
+    TPUCLIENT_BIND(SSL_read)
+    TPUCLIENT_BIND(SSL_write)
+    TPUCLIENT_BIND(SSL_get_error)
+    TPUCLIENT_BIND(SSL_shutdown)
+    TPUCLIENT_BIND(ERR_get_error)
+    TPUCLIENT_BIND(ERR_error_string_n)
+#undef TPUCLIENT_BIND
+    lib.ok = true;
+  });
+  return &lib;
+}
+
+uint64_t NowNs() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<uint64_t>(ts.tv_sec) * 1000000000ull + ts.tv_nsec;
+}
+
+std::string LastSslError(const char* fallback) {
+  OpenSsl* lib = Lib();
+  unsigned long code = lib->ERR_get_error();
+  if (code == 0) return fallback;
+  char buf[256];
+  lib->ERR_error_string_n(code, buf, sizeof(buf));
+  return buf;
+}
+
+// Polls until the fd is ready for what OpenSSL wants, or deadline.
+std::string WaitFor(int fd, int ssl_error, uint64_t deadline_ns) {
+  struct pollfd pfd;
+  pfd.fd = fd;
+  pfd.events = ssl_error == kSslErrorWantWrite ? POLLOUT : POLLIN;
+  int timeout_ms = -1;
+  if (deadline_ns != 0) {
+    uint64_t now = NowNs();
+    if (now >= deadline_ns) return "TLS timeout";
+    timeout_ms = static_cast<int>((deadline_ns - now) / 1000000ull);
+    if (timeout_ms == 0) timeout_ms = 1;
+  }
+  int rc = poll(&pfd, 1, timeout_ms);
+  if (rc == 0) return "TLS timeout";
+  if (rc < 0) return "TLS poll failed";
+  return "";
+}
+
+}  // namespace
+
+TlsSession::TlsSession() = default;
+
+TlsSession::~TlsSession() { Close(); }
+
+bool TlsSession::Available() { return Lib()->ok; }
+
+std::string TlsSession::Handshake(
+    int fd, const std::string& host, const SslOptions& options,
+    const std::string& alpn, uint64_t deadline_ns) {
+  OpenSsl* lib = Lib();
+  if (!lib->ok) {
+    return "TLS unavailable: libssl.so.3 not found or incomplete";
+  }
+  Close();
+  ctx_ = lib->SSL_CTX_new(lib->TLS_client_method());
+  if (ctx_ == nullptr) return LastSslError("SSL_CTX_new failed");
+  if (options.insecure_skip_verify) {
+    lib->SSL_CTX_set_verify(ctx_, kSslVerifyNone, nullptr);
+  } else {
+    lib->SSL_CTX_set_verify(ctx_, kSslVerifyPeer, nullptr);
+    if (!options.root_certificates.empty()) {
+      if (lib->SSL_CTX_load_verify_locations(
+              ctx_, options.root_certificates.c_str(), nullptr) != 1) {
+        return LastSslError("failed to load root certificates");
+      }
+    } else {
+      lib->SSL_CTX_set_default_verify_paths(ctx_);
+    }
+  }
+  if (!options.certificate_chain.empty()) {
+    if (lib->SSL_CTX_use_certificate_chain_file(
+            ctx_, options.certificate_chain.c_str()) != 1) {
+      return LastSslError("failed to load certificate chain");
+    }
+  }
+  if (!options.private_key.empty()) {
+    if (lib->SSL_CTX_use_PrivateKey_file(
+            ctx_, options.private_key.c_str(), kSslFiletypePem) != 1) {
+      return LastSslError("failed to load private key");
+    }
+  }
+  if (!alpn.empty()) {
+    // Wire format: one length-prefixed protocol name.
+    std::string wire;
+    wire.push_back(static_cast<char>(alpn.size()));
+    wire += alpn;
+    lib->SSL_CTX_set_alpn_protos(
+        ctx_, reinterpret_cast<const unsigned char*>(wire.data()),
+        static_cast<unsigned>(wire.size()));
+  }
+  ssl_ = lib->SSL_new(ctx_);
+  if (ssl_ == nullptr) return LastSslError("SSL_new failed");
+  lib->SSL_set_fd(ssl_, fd);
+  fd_ = fd;
+  if (!host.empty()) {
+    lib->SSL_ctrl(ssl_, kCtrlSetTlsextHostname, kTlsextNametypeHostName,
+                  const_cast<char*>(host.c_str()));  // SNI
+    if (!options.insecure_skip_verify) {
+      lib->SSL_set1_host(ssl_, host.c_str());  // hostname check
+    }
+  }
+  for (;;) {
+    int rc = lib->SSL_connect(ssl_);
+    if (rc == 1) return "";
+    int ssl_error = lib->SSL_get_error(ssl_, rc);
+    if (ssl_error == kSslErrorWantRead || ssl_error == kSslErrorWantWrite) {
+      std::string err = WaitFor(fd_, ssl_error, deadline_ns);
+      if (!err.empty()) return err;
+      continue;
+    }
+    return LastSslError("TLS handshake failed");
+  }
+}
+
+std::string TlsSession::Write(
+    const char* data, size_t len, uint64_t deadline_ns) {
+  OpenSsl* lib = Lib();
+  size_t sent = 0;
+  while (sent < len) {
+    int rc = lib->SSL_write(ssl_, data + sent,
+                            static_cast<int>(len - sent));
+    if (rc > 0) {
+      sent += rc;
+      continue;
+    }
+    int ssl_error = lib->SSL_get_error(ssl_, rc);
+    if (ssl_error == kSslErrorWantRead || ssl_error == kSslErrorWantWrite) {
+      std::string err = WaitFor(fd_, ssl_error, deadline_ns);
+      if (!err.empty()) return err;
+      continue;
+    }
+    return LastSslError("TLS write failed");
+  }
+  return "";
+}
+
+int64_t TlsSession::Read(
+    char* buf, size_t len, uint64_t deadline_ns, std::string* err) {
+  OpenSsl* lib = Lib();
+  for (;;) {
+    int rc = lib->SSL_read(ssl_, buf, static_cast<int>(len));
+    if (rc > 0) return rc;
+    int ssl_error = lib->SSL_get_error(ssl_, rc);
+    if (ssl_error == kSslErrorZeroReturn) return 0;  // clean close
+    if (ssl_error == kSslErrorWantRead || ssl_error == kSslErrorWantWrite) {
+      std::string wait_err = WaitFor(fd_, ssl_error, deadline_ns);
+      if (!wait_err.empty()) {
+        *err = wait_err;
+        return -1;
+      }
+      continue;
+    }
+    *err = LastSslError("TLS read failed");
+    return -1;
+  }
+}
+
+void TlsSession::Close() {
+  OpenSsl* lib = Lib();
+  if (ssl_ != nullptr) {
+    lib->SSL_shutdown(ssl_);
+    lib->SSL_free(ssl_);
+    ssl_ = nullptr;
+  }
+  if (ctx_ != nullptr) {
+    lib->SSL_CTX_free(ctx_);
+    ctx_ = nullptr;
+  }
+  fd_ = -1;
+}
+
+}  // namespace tpuclient

@@ -1,0 +1,62 @@
+// TLS client sessions over dlopen'd OpenSSL (libssl.so.3).
+//
+// Role parity: the reference links grpc++/libcurl which carry TLS
+// (SslOptions, grpc_client.h:43; HTTPS via curl). This image ships
+// the OpenSSL 3 runtime but no development headers, so — like the
+// MPI driver (perf/mpi_utils.h) — the needed symbols are bound at
+// runtime and the feature degrades gracefully when the library is
+// absent.
+#pragma once
+
+#include <cstdint>
+#include <string>
+
+namespace tpuclient {
+
+// Mirrors the reference's SslOptions (grpc_client.h:43) for both
+// protocol clients.
+struct SslOptions {
+  // PEM root certificates file ("" = system default verify paths).
+  std::string root_certificates;
+  // PEM private key + certificate chain for mutual TLS ("" = none).
+  std::string private_key;
+  std::string certificate_chain;
+  // Skip peer verification (self-signed test endpoints).
+  bool insecure_skip_verify = false;
+};
+
+class TlsSession {
+ public:
+  TlsSession();
+  ~TlsSession();
+
+  TlsSession(const TlsSession&) = delete;
+  TlsSession& operator=(const TlsSession&) = delete;
+
+  // True when libssl.so.3 was found and all symbols bound.
+  static bool Available();
+
+  // Handshakes over an already-connected NON-BLOCKING socket.
+  // `alpn` is an optional protocol name (e.g. "h2" for gRPC).
+  // Returns "" on success, else error text.
+  std::string Handshake(
+      int fd, const std::string& host, const SslOptions& options,
+      const std::string& alpn, uint64_t deadline_ns);
+
+  // Encrypted I/O over the handshaken socket. Semantics match
+  // send/recv on a non-blocking fd: Write sends everything or
+  // errors; Read returns >0 bytes, 0 on clean EOF, <0 with *err set.
+  std::string Write(const char* data, size_t len, uint64_t deadline_ns);
+  int64_t Read(char* buf, size_t len, uint64_t deadline_ns,
+               std::string* err);
+
+  void Close();
+  bool active() const { return ssl_ != nullptr; }
+
+ private:
+  void* ctx_ = nullptr;  // SSL_CTX*
+  void* ssl_ = nullptr;  // SSL*
+  int fd_ = -1;
+};
+
+}  // namespace tpuclient
