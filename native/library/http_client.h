@@ -25,6 +25,7 @@
 
 #include "common.h"
 #include "json.h"
+#include "tls.h"
 
 namespace tpuclient {
 
@@ -87,10 +88,17 @@ class InferenceServerHttpClient : public InferenceServerClient {
  public:
   ~InferenceServerHttpClient() override;
 
-  // url is "host:port" (no scheme) like the reference.
+  // url is "host:port" (no scheme) like the reference; an
+  // "https://" scheme prefix selects TLS.
   static Error Create(
       std::unique_ptr<InferenceServerHttpClient>* client,
       const std::string& url, bool verbose = false);
+
+  // TLS variant (parity: http_client.h:105 Create-with-HttpSslOptions).
+  static Error Create(
+      std::unique_ptr<InferenceServerHttpClient>* client,
+      const std::string& url, const SslOptions& ssl_options,
+      bool verbose = false);
 
   Error IsServerLive(bool* live, const Headers& headers = {});
   Error IsServerReady(bool* ready, const Headers& headers = {});
@@ -189,7 +197,8 @@ class InferenceServerHttpClient : public InferenceServerClient {
   void SetAsyncWorkerCount(size_t count);
 
  private:
-  InferenceServerHttpClient(const std::string& url, bool verbose);
+  InferenceServerHttpClient(
+      const std::string& url, const SslOptions& ssl_options, bool verbose);
 
   // Copy-free variant used on the request hot path (the public
   // vector<char> API above wraps it for reference parity).
@@ -226,6 +235,8 @@ class InferenceServerHttpClient : public InferenceServerClient {
 
   std::string host_;
   int port_ = 0;
+  bool use_tls_ = false;
+  SslOptions ssl_options_;
 
   // Sync path: one persistent connection guarded by a mutex.
   std::unique_ptr<HttpConnection> sync_conn_;
