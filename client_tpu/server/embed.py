@@ -1,0 +1,134 @@
+"""Embedding surface for hosting the server core inside a native
+process (no RPC).
+
+The C++ perf harness's ``--service-kind in_process`` backend embeds
+CPython, imports this module, and drives inference through the
+serialized-protobuf functions below — the TPU-native analogue of the
+reference's ``triton_c_api`` backend, which dlopens tritonserver and
+calls its C API directly
+(/root/reference/src/c++/perf_analyzer/client_backend/triton_c_api/
+triton_loader.cc:526-690). Keeping the exchange at proto-bytes level
+means the embedding layer needs no Python object marshalling beyond
+``bytes`` <-> ``std::string``.
+
+All functions are module-level and hold no GIL assumptions beyond the
+caller owning it for the duration of each call (PyGILState_Ensure in
+the C++ backend).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from client_tpu.protocol import inference_pb2 as pb
+
+_core = None
+
+
+def init(models_csv: str = "") -> None:
+    """Builds the server core and warms the named models (comma
+    separated; empty = registry defaults, loaded lazily)."""
+    global _core
+    if _core is not None:
+        return
+    from client_tpu.server.app import build_core
+
+    names = [m for m in models_csv.split(",") if m]
+    _core = build_core(names)
+
+
+def _require_core():
+    if _core is None:
+        raise RuntimeError("embed.init() has not been called")
+    return _core
+
+
+def infer(request_bytes: bytes) -> bytes:
+    """Serialized ModelInferRequest -> serialized ModelInferResponse.
+    Errors surface as InferenceServerException for the C++ layer to
+    format (message carries the [STATUS] prefix)."""
+    core = _require_core()
+    request = pb.ModelInferRequest()
+    request.ParseFromString(request_bytes)
+    return core.infer(request).SerializeToString()
+
+
+def server_metadata_json() -> str:
+    meta = _require_core().server_metadata()
+    return json.dumps({
+        "name": meta.name,
+        "version": meta.version,
+        "extensions": list(meta.extensions),
+    })
+
+
+def model_metadata_json(name: str, version: str = "") -> str:
+    meta = _require_core().model_metadata(name, version)
+    def tensors(specs):
+        return [{"name": t.name, "datatype": t.datatype,
+                 "shape": list(t.shape)} for t in specs]
+    return json.dumps({
+        "name": meta.name,
+        "versions": list(meta.versions),
+        "platform": meta.platform,
+        "inputs": tensors(meta.inputs),
+        "outputs": tensors(meta.outputs),
+    })
+
+
+def model_config_json(name: str, version: str = "") -> str:
+    config = _require_core().model_config(name, version)
+    from google.protobuf import json_format
+
+    return json_format.MessageToJson(config)
+
+
+def model_statistics_json(name: str = "") -> str:
+    stats = _require_core().model_statistics(name, "")
+    from google.protobuf import json_format
+
+    return json_format.MessageToJson(stats)
+
+
+def register_system_shared_memory(name: str, key: str, byte_size: int,
+                                  offset: int = 0) -> None:
+    _require_core().memory.register_system(name, key, offset, byte_size)
+
+
+def register_tpu_shared_memory(name: str, raw_handle: bytes,
+                               device_id: int, byte_size: int) -> None:
+    _require_core().memory.register_tpu(
+        name, raw_handle, device_id, byte_size)
+
+
+def unregister_system_shared_memory(name: str = "") -> None:
+    _require_core().memory.unregister_system(name or None)
+
+
+def unregister_tpu_shared_memory(name: str = "") -> None:
+    _require_core().memory.unregister_tpu(name or None)
+
+
+def tpu_arena_allocate(byte_size: int, device_id: int = 0) -> bytes:
+    """Allocates an HBM arena region in-process; returns the raw
+    handle bytes (what the gRPC arena service would return)."""
+    return _require_core().memory.arena.create_region(byte_size, device_id)
+
+
+def load_model(name: str) -> None:
+    _require_core().load_model(name)
+
+
+def shutdown() -> None:
+    """Stops per-model batcher threads and drops the core (unload_model
+    is the core's teardown verb; there is no process-level shutdown)."""
+    global _core
+    if _core is None:
+        return
+    core, _core = _core, None
+    for name in [m.name for m in core.repository.ready_models()]:
+        try:
+            core.unload_model(name)
+        except Exception:  # noqa: BLE001 — teardown must not raise
+            pass

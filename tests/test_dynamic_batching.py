@@ -155,6 +155,72 @@ def test_error_propagates_to_every_request():
     assert len(errors) == 3
 
 
+def test_device_chunks_fuse_on_device():
+    """Arena-resolved inputs are jax.Arrays; fusing them must run as
+    device ops — a numpy concat would drag every chunk back to host
+    (the round-2 12-infer/s regression). The model asserts its fused
+    input is still a device array (fusion runs on the gather thread,
+    so a thread-local transfer guard here could not see it)."""
+    import jax.numpy as jnp
+
+    class DeviceModel(CountingModel):
+        def infer(self, inputs, parameters=None):
+            self.gate.wait()  # keep the pile-up choreography working
+            array = inputs["IN"]
+            assert not isinstance(array, np.ndarray), \
+                "fused input fell back to host"
+            self.executions.append(array.shape[0])
+            return {"OUT": array * 2.0}
+
+    model = DeviceModel()
+    model.gate.clear()
+    batcher = DynamicBatcher(model, max_queue_delay_us=200000)
+    results = [None] * 4
+    errors = []
+
+    def one(i):
+        try:
+            data = jnp.full((2, 4), float(i), dtype=jnp.float32)
+            outputs, _, _ = batcher.infer({"IN": data}, {}, 2)
+            results[i] = outputs["OUT"]
+        except Exception as e:
+            errors.append(e)
+
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    import time
+
+    time.sleep(0.1)
+    model.gate.set()
+    for t in threads:
+        t.join(timeout=10)
+    batcher.stop()
+
+    assert not errors, errors[0]
+    assert len(model.executions) < 4  # requests actually fused
+    for i, out in enumerate(results):
+        np.testing.assert_array_equal(
+            np.asarray(out), np.full((2, 4), i * 2.0, dtype=np.float32))
+
+
+def test_device_chunks_fuse_with_padding_on_device():
+    """Padding to the preferred compile shape must also stay on device."""
+    import jax
+    import jax.numpy as jnp
+    from client_tpu.server.batcher import _fuse_chunks
+
+    chunks = [jnp.ones((2, 4)), jnp.zeros((1, 4))]
+    # d2h is the defeat we guard against; tiny h2d offset scalars are
+    # expected (dynamic_update_slice start indices ride as arguments).
+    with jax.transfer_guard_device_to_host("disallow"):
+        fused = _fuse_chunks(chunks, target=8, total=3)
+    assert fused.shape == (8, 4)
+    host = np.asarray(fused)
+    np.testing.assert_array_equal(host[:2], 1.0)
+    np.testing.assert_array_equal(host[2:], 0.0)  # pad rows stay zero
+
+
 def test_e2e_server_fuses_and_reports_queue_time():
     """Concurrent gRPC clients against a dynamic-batching model: the
     server reports execution_count < inference_count and non-zero
