@@ -303,6 +303,36 @@ def main() -> None:
         except Exception as exc:  # noqa: BLE001
             log("simple_inprocess failed: %s" % exc)
 
+    # Stage 3b: simple through the NATIVE in-process backend — the
+    # C++ harness embedding the server core, no server process at all
+    # (triton_c_api analogue). Subprocess so its embedded interpreter
+    # doesn't fight this one; CPU platform because `simple` is
+    # host-placed anyway and the TPU belongs to the live server here.
+    if binary and remaining() > 60:
+        try:
+            env = dict(os.environ, JAX_PLATFORMS="cpu",
+                       PALLAS_AXON_POOL_IPS="")
+            csv = "/tmp/bench_inproc_latency.csv"
+            proc = subprocess.run(
+                [str(binary), "-m", "simple",
+                 "--service-kind", "in_process", "-b", "1",
+                 "--concurrency-range", "4", "--async",
+                 "-p", "2000", "-r", "4", "-s", "20",
+                 "--max-threads", "8", "-f", csv],
+                capture_output=True, text=True, cwd=str(REPO), env=env,
+                timeout=max(30.0, min(180.0, remaining())))
+            if proc.returncode == 0:
+                with open(csv) as f:
+                    f.readline()
+                    row = f.readline().strip().split(",")
+                record_stage("simple_inprocess_native",
+                             float(row[1]), float(row[2]))
+            else:
+                log("native in_process failed rc=%d: %s"
+                    % (proc.returncode, proc.stderr[-300:]))
+        except Exception as exc:  # noqa: BLE001
+            log("simple_inprocess_native failed: %s" % exc)
+
     # Stage 4: resnet50 with TPU shared memory — the headline.
     resnet_budget = 300 if platform != "cpu" else 150
     exec_extra: dict = {}

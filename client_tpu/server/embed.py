@@ -78,17 +78,41 @@ def model_metadata_json(name: str, version: str = "") -> str:
 
 
 def model_config_json(name: str, version: str = "") -> str:
-    config = _require_core().model_config(name, version)
+    response = _require_core().model_config(name, version)
     from google.protobuf import json_format
 
-    return json_format.MessageToJson(config)
+    # The bare config object (not the response wrapper), snake_case:
+    # the native ModelParser reads reference-wire keys like
+    # "max_batch_size" directly (model_parser.cc Parse).
+    return json_format.MessageToJson(
+        response.config, preserving_proto_field_name=True)
 
 
 def model_statistics_json(name: str = "") -> str:
+    # Hand-rolled (not json_format): protobuf JSON encodes (u)int64 as
+    # strings, which the native harness's numeric parsing rejects.
     stats = _require_core().model_statistics(name, "")
-    from google.protobuf import json_format
 
-    return json_format.MessageToJson(stats)
+    def dur(d):
+        return {"count": d.count, "ns": d.ns}
+
+    return json.dumps({"model_stats": [
+        {
+            "name": m.name,
+            "version": m.version,
+            "inference_count": m.inference_count,
+            "execution_count": m.execution_count,
+            "inference_stats": {
+                "success": dur(m.inference_stats.success),
+                "fail": dur(m.inference_stats.fail),
+                "queue": dur(m.inference_stats.queue),
+                "compute_input": dur(m.inference_stats.compute_input),
+                "compute_infer": dur(m.inference_stats.compute_infer),
+                "compute_output": dur(m.inference_stats.compute_output),
+            },
+        }
+        for m in stats.model_stats
+    ]})
 
 
 def register_system_shared_memory(name: str, key: str, byte_size: int,
@@ -113,7 +137,14 @@ def unregister_tpu_shared_memory(name: str = "") -> None:
 def tpu_arena_allocate(byte_size: int, device_id: int = 0) -> bytes:
     """Allocates an HBM arena region in-process; returns the raw
     handle bytes (what the gRPC arena service would return)."""
-    return _require_core().memory.arena.create_region(byte_size, device_id)
+    arena = _require_core().memory.arena
+    if arena is None:
+        from client_tpu.utils import InferenceServerException
+
+        raise InferenceServerException(
+            "server has no TPU arena; TPU shared memory unavailable",
+            status="UNAVAILABLE")
+    return arena.create_region(byte_size, device_id)
 
 
 def load_model(name: str) -> None:

@@ -183,15 +183,17 @@ class InferenceServerGrpcClient : public InferenceServerClient {
       const InferOptions& options, const std::vector<InferInput*>& inputs,
       const std::vector<const InferRequestedOutput*>& outputs = {});
 
- private:
-  InferenceServerGrpcClient(bool verbose);
-
   // Marshals options/inputs/outputs into the request proto (parity:
-  // PreRunProcessing, grpc_client.cc:1419).
-  Error PreRunProcessing(
+  // PreRunProcessing, grpc_client.cc:1419). Static and public so
+  // non-RPC consumers (the in-process perf backend) can build the
+  // same request protos without a connection.
+  static Error PreRunProcessing(
       inference::ModelInferRequest* request, const InferOptions& options,
       const std::vector<InferInput*>& inputs,
       const std::vector<const InferRequestedOutput*>& outputs);
+
+ private:
+  InferenceServerGrpcClient(bool verbose);
 
   // Serializes req, runs the unary RPC, parses into resp.
   Error Rpc(const std::string& method, const google::protobuf::Message& req,

@@ -8,6 +8,9 @@
 #include "../library/grpc_client.h"
 #include "../library/http_client.h"
 #include "../library/http_transport.h"
+#ifdef TPUCLIENT_HAVE_PYTHON
+#include "inprocess_backend.h"
+#endif
 #include "client_tpu/protocol/arena.pb.h"
 
 namespace tpuclient {
@@ -1190,6 +1193,14 @@ Error ClientBackendFactory::Create(
     case BackendKind::MOCK:
       backend->reset(new MockBackend(config_));
       return Error::Success;
+    case BackendKind::IN_PROCESS:
+#ifdef TPUCLIENT_HAVE_PYTHON
+      return InProcessBackend::Create(config_, backend);
+#else
+      return Error(
+          "this build has no embedded-CPython support "
+          "(in_process backend unavailable)");
+#endif
   }
   return Error("unknown backend kind");
 }

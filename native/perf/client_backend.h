@@ -41,6 +41,8 @@ enum class BackendKind {
   TORCHSERVE,
   TFSERVING,
   MOCK,
+  // Embedded server core, no RPC (parity: triton_c_api).
+  IN_PROCESS,
 };
 
 struct BackendConfig {
@@ -53,6 +55,8 @@ struct BackendConfig {
   // MOCK: simulated per-request latency and failure rate.
   uint64_t mock_delay_us = 500;
   double mock_error_rate = 0.0;
+  // IN_PROCESS: comma-separated models for embed.init to warm.
+  std::string inprocess_models;
 };
 
 //==============================================================================

@@ -235,10 +235,10 @@ Error CLParser::Parse(
         if (params->service_kind != "triton" &&
             params->service_kind != "openai" &&
             params->service_kind != "torchserve" &&
-            params->service_kind != "tfserving") {
+            params->service_kind != "tfserving" &&
+            params->service_kind != "in_process") {
           return Error("--service-kind must be triton, openai, "
-                       "torchserve, or tfserving (the Python harness "
-                       "adds in-process serving)");
+                       "torchserve, tfserving, or in_process");
         }
         break;
       case kOptEndpoint: params->endpoint = optarg; break;

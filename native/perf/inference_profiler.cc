@@ -149,7 +149,10 @@ void InferenceProfiler::Summarize(
     if (record.valid()) {
       latencies_us.push_back(record.latency_ns() / 1000.0);
     }
-    if (record.has_error) status->error_count++;
+    if (record.has_error) {
+      status->error_count++;
+      if (status->sample_error.empty()) status->sample_error = record.error;
+    }
     if (record.delayed) status->delayed_count++;
   }
   status->records = std::move(records);
@@ -216,6 +219,7 @@ PerfStatus InferenceProfiler::Merge(std::vector<PerfStatus>&& trials) const {
   for (auto& trial : trials) {
     merged.completed_count += trial.completed_count;
     merged.error_count += trial.error_count;
+    if (merged.sample_error.empty()) merged.sample_error = trial.sample_error;
     merged.delayed_count += trial.delayed_count;
     window_s += (trial.window_end_ns - trial.window_start_ns) / 1e9;
     for (auto& record : trial.records) {

@@ -29,6 +29,9 @@ struct PerfStatus {
   size_t completed_count = 0;
   size_t delayed_count = 0;
   size_t error_count = 0;
+  // First failing request's message — without it a fully-erroring run
+  // prints only a count, hiding the actual cause.
+  std::string sample_error;
   bool on_target = true;  // false when the level never stabilized
   uint64_t window_start_ns = 0;
   uint64_t window_end_ns = 0;

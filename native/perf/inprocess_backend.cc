@@ -1,0 +1,446 @@
+#include "inprocess_backend.h"
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdlib>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+#include "../library/grpc_client.h"
+#include "client_tpu/protocol/inference.pb.h"
+
+namespace tpuclient {
+namespace perf {
+
+namespace {
+
+//==============================================================================
+// Embedded CPython runtime (process singleton).
+
+std::string RepoRootGuess() {
+  const char* env = std::getenv("TPUCLIENT_REPO_ROOT");
+  if (env != nullptr && env[0] != '\0') return env;
+  // Binary lives at <root>/native/build/perf_analyzer.
+  char buf[4096];
+  ssize_t n = readlink("/proc/self/exe", buf, sizeof(buf) - 1);
+  if (n > 0) {
+    std::string path(buf, n);
+    size_t cut = path.rfind("/native/build/");
+    if (cut != std::string::npos) return path.substr(0, cut);
+  }
+  return ".";
+}
+
+class PythonEmbed {
+ public:
+  static PythonEmbed& Get() {
+    static PythonEmbed instance;
+    return instance;
+  }
+
+  Error EnsureInit(const std::string& models_csv) {
+    std::lock_guard<std::mutex> lk(init_mutex_);
+    if (initialized_) return init_error_;
+    initialized_ = true;
+
+    std::string repo = RepoRootGuess();
+    std::string pythonpath = repo;
+    // The embedded interpreter boots from the base install; graft the
+    // active venv's site-packages (jax & friends live there).
+    const char* venv = std::getenv("VIRTUAL_ENV");
+    std::string site =
+        std::string(venv != nullptr ? venv : "/opt/venv") +
+        "/lib/python" + std::to_string(PY_MAJOR_VERSION) + "." +
+        std::to_string(PY_MINOR_VERSION) + "/site-packages";
+    if (access(site.c_str(), F_OK) == 0) pythonpath += ":" + site;
+    const char* existing = std::getenv("PYTHONPATH");
+    if (existing != nullptr && existing[0] != '\0') {
+      pythonpath += ":" + std::string(existing);
+    }
+    setenv("PYTHONPATH", pythonpath.c_str(), 1);
+
+    Py_InitializeEx(0);
+    module_ = PyImport_ImportModule("client_tpu.server.embed");
+    if (module_ == nullptr) {
+      init_error_ = FetchPyError("import client_tpu.server.embed");
+      PyEval_SaveThread();
+      return init_error_;
+    }
+    PyObject* r = PyObject_CallMethod(
+        module_, "init", "s", models_csv.c_str());
+    if (r == nullptr) {
+      init_error_ = FetchPyError("embed.init");
+    }
+    Py_XDECREF(r);
+    // Release the GIL so harness worker threads can take it per call.
+    PyEval_SaveThread();
+    return init_error_;
+  }
+
+  // fn(bytes) -> bytes
+  Error CallBytes(
+      const char* fn, const std::string& arg, std::string* result) {
+    PyGILState_STATE gil = PyGILState_Ensure();
+    PyObject* r = PyObject_CallMethod(
+        module_, fn, "y#", arg.data(), (Py_ssize_t)arg.size());
+    Error err = Error::Success;
+    if (r == nullptr) {
+      err = FetchPyError(fn);
+    } else {
+      char* data = nullptr;
+      Py_ssize_t size = 0;
+      if (PyBytes_AsStringAndSize(r, &data, &size) != 0) {
+        err = FetchPyError(fn);
+      } else {
+        result->assign(data, (size_t)size);
+      }
+      Py_DECREF(r);
+    }
+    PyGILState_Release(gil);
+    return err;
+  }
+
+  // fn(*args) -> str  (args passed by Py_BuildValue format)
+  Error CallStr(
+      const char* fn, const char* format, std::string* result,
+      const char* a0 = nullptr, const char* a1 = nullptr) {
+    PyGILState_STATE gil = PyGILState_Ensure();
+    PyObject* r = (a1 != nullptr)
+                      ? PyObject_CallMethod(module_, fn, format, a0, a1)
+                      : (a0 != nullptr)
+                            ? PyObject_CallMethod(module_, fn, format, a0)
+                            : PyObject_CallMethod(module_, fn, nullptr);
+    Error err = Error::Success;
+    if (r == nullptr) {
+      err = FetchPyError(fn);
+    } else {
+      Py_ssize_t size = 0;
+      const char* text = PyUnicode_AsUTF8AndSize(r, &size);
+      if (text == nullptr) {
+        err = FetchPyError(fn);
+      } else {
+        result->assign(text, (size_t)size);
+      }
+      Py_DECREF(r);
+    }
+    PyGILState_Release(gil);
+    return err;
+  }
+
+  // Builds an argument tuple under the GIL via a callback.
+  template <typename BuildFn>
+  Error CallVoidBuilt(const char* fn, BuildFn build) {
+    PyGILState_STATE gil = PyGILState_Ensure();
+    Error err = Error::Success;
+    PyObject* args = build();
+    if (args == nullptr) {
+      err = FetchPyError(fn);
+    } else {
+      PyObject* callable = PyObject_GetAttrString(module_, fn);
+      if (callable == nullptr) {
+        err = FetchPyError(fn);
+      } else {
+        PyObject* r = PyObject_CallObject(callable, args);
+        if (r == nullptr) err = FetchPyError(fn);
+        Py_XDECREF(r);
+        Py_DECREF(callable);
+      }
+      Py_DECREF(args);
+    }
+    PyGILState_Release(gil);
+    return err;
+  }
+
+  // fn(byte_size, device_id) -> bytes
+  Error CallAllocate(
+      size_t byte_size, int64_t device_id, std::string* handle) {
+    PyGILState_STATE gil = PyGILState_Ensure();
+    PyObject* r = PyObject_CallMethod(
+        module_, "tpu_arena_allocate", "nL", (Py_ssize_t)byte_size,
+        (long long)device_id);
+    Error err = Error::Success;
+    if (r == nullptr) {
+      err = FetchPyError("tpu_arena_allocate");
+    } else {
+      char* data = nullptr;
+      Py_ssize_t size = 0;
+      if (PyBytes_AsStringAndSize(r, &data, &size) != 0) {
+        err = FetchPyError("tpu_arena_allocate");
+      } else {
+        handle->assign(data, (size_t)size);
+      }
+      Py_DECREF(r);
+    }
+    PyGILState_Release(gil);
+    return err;
+  }
+
+ private:
+  PythonEmbed() = default;
+
+  // Caller holds the GIL. Converts the pending Python exception into
+  // an Error (InferenceServerException str() carries "[STATUS] msg").
+  static Error FetchPyError(const char* what) {
+    PyObject *type = nullptr, *value = nullptr, *trace = nullptr;
+    PyErr_Fetch(&type, &value, &trace);
+    std::string message = std::string(what) + " failed";
+    if (value != nullptr) {
+      PyObject* s = PyObject_Str(value);
+      if (s != nullptr) {
+        const char* text = PyUnicode_AsUTF8(s);
+        if (text != nullptr) message = text;
+        Py_DECREF(s);
+      }
+    }
+    Py_XDECREF(type);
+    Py_XDECREF(value);
+    Py_XDECREF(trace);
+    return Error(message);
+  }
+
+  std::mutex init_mutex_;
+  bool initialized_ = false;
+  Error init_error_ = Error::Success;
+  PyObject* module_ = nullptr;
+};
+
+//==============================================================================
+// Async worker pool: the dynamic batcher fuses requests only when
+// several are in flight, so async mode needs real concurrent callers
+// (each blocks in Python with the GIL released while waiting).
+
+class AsyncPool {
+ public:
+  struct Job {
+    std::function<void()> run;
+  };
+
+  static AsyncPool& Get() {
+    // Deliberately leaked: a static-duration destructor would tear
+    // down the mutex/cv while detached workers may still touch them.
+    static AsyncPool* pool = new AsyncPool();
+    return *pool;
+  }
+
+  void Submit(std::function<void()> run) {
+    {
+      std::lock_guard<std::mutex> lk(mutex_);
+      queue_.push_back({std::move(run)});
+      // Grow to match offered concurrency (capped): a fixed pool
+      // would silently clamp --concurrency-range above its size and
+      // misreport latency for the queued remainder.
+      size_t wanted = queue_.size() + busy_;
+      while (workers_.size() < wanted && workers_.size() < kMaxWorkers) {
+        workers_.emplace_back([this] { Loop(); });
+      }
+    }
+    cv_.notify_one();
+  }
+
+ private:
+  static constexpr size_t kMaxWorkers = 128;
+
+  AsyncPool() = default;
+
+  void Loop() {
+    for (;;) {
+      Job job;
+      {
+        std::unique_lock<std::mutex> lk(mutex_);
+        cv_.wait(lk, [this] { return !queue_.empty(); });
+        job = std::move(queue_.front());
+        queue_.pop_front();
+        ++busy_;
+      }
+      job.run();
+      {
+        std::lock_guard<std::mutex> lk(mutex_);
+        --busy_;
+      }
+    }
+  }
+
+  std::mutex mutex_;
+  std::condition_variable cv_;
+  std::deque<Job> queue_;
+  std::vector<std::thread> workers_;
+  size_t busy_ = 0;
+};
+
+Error ParseJsonText(const std::string& text, json::Value* out) {
+  std::string err = json::Parse(text.data(), text.size(), out);
+  if (!err.empty()) return Error("malformed embed JSON: " + err);
+  return Error::Success;
+}
+
+}  // namespace
+
+//==============================================================================
+// InProcessBackend
+
+Error InProcessBackend::Create(
+    const BackendConfig& config, std::unique_ptr<ClientBackend>* backend) {
+  Error err = PythonEmbed::Get().EnsureInit(config.inprocess_models);
+  if (!err.IsOk()) return err;
+  backend->reset(new InProcessBackend());
+  return Error::Success;
+}
+
+Error InProcessBackend::ServerMetadataJson(json::Value* metadata) {
+  std::string text;
+  Error err =
+      PythonEmbed::Get().CallStr("server_metadata_json", nullptr, &text);
+  if (!err.IsOk()) return err;
+  return ParseJsonText(text, metadata);
+}
+
+Error InProcessBackend::ModelMetadataJson(
+    json::Value* metadata, const std::string& model_name,
+    const std::string& model_version) {
+  std::string text;
+  Error err = PythonEmbed::Get().CallStr(
+      "model_metadata_json", "ss", &text, model_name.c_str(),
+      model_version.c_str());
+  if (!err.IsOk()) return err;
+  return ParseJsonText(text, metadata);
+}
+
+Error InProcessBackend::ModelConfigJson(
+    json::Value* config, const std::string& model_name,
+    const std::string& model_version) {
+  std::string text;
+  Error err = PythonEmbed::Get().CallStr(
+      "model_config_json", "ss", &text, model_name.c_str(),
+      model_version.c_str());
+  if (!err.IsOk()) return err;
+  return ParseJsonText(text, config);
+}
+
+Error InProcessBackend::ModelStatisticsJson(
+    json::Value* stats, const std::string& model_name) {
+  std::string text;
+  Error err = PythonEmbed::Get().CallStr(
+      "model_statistics_json", "s", &text, model_name.c_str());
+  if (!err.IsOk()) return err;
+  return ParseJsonText(text, stats);
+}
+
+Error InProcessBackend::Infer(
+    InferResult** result, const InferOptions& options,
+    const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs) {
+  inference::ModelInferRequest request;
+  Error err = InferenceServerGrpcClient::PreRunProcessing(
+      &request, options, inputs, outputs);
+  if (!err.IsOk()) return err;
+  std::string request_bytes;
+  if (!request.SerializeToString(&request_bytes)) {
+    return Error("failed to serialize request");
+  }
+  std::string response_bytes;
+  err = PythonEmbed::Get().CallBytes("infer", request_bytes, &response_bytes);
+  if (!err.IsOk()) return err;
+  auto response = std::make_shared<inference::ModelInferResponse>();
+  if (!response->ParseFromString(response_bytes)) {
+    return Error("failed to parse embed response");
+  }
+  return InferResultGrpc::Create(result, std::move(response));
+}
+
+Error InProcessBackend::AsyncInfer(
+    OnCompleteFn callback, const InferOptions& options,
+    const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs) {
+  if (callback == nullptr) {
+    return Error("callback must not be null for AsyncInfer");
+  }
+  // Inputs are marshalled into the proto NOW (the caller may reuse
+  // its buffers after we return), then the blocking call runs on the
+  // pool so several requests sit inside the server core concurrently
+  // — that is what lets the dynamic batcher fuse them.
+  inference::ModelInferRequest request;
+  Error err = InferenceServerGrpcClient::PreRunProcessing(
+      &request, options, inputs, outputs);
+  if (!err.IsOk()) return err;
+  auto request_bytes = std::make_shared<std::string>();
+  if (!request.SerializeToString(request_bytes.get())) {
+    return Error("failed to serialize request");
+  }
+  AsyncPool::Get().Submit([request_bytes, callback] {
+    std::string response_bytes;
+    Error call_err = PythonEmbed::Get().CallBytes(
+        "infer", *request_bytes, &response_bytes);
+    auto response = std::make_shared<inference::ModelInferResponse>();
+    if (call_err.IsOk() && !response->ParseFromString(response_bytes)) {
+      call_err = Error("failed to parse embed response");
+    }
+    InferResult* result = nullptr;
+    InferResultGrpc::Create(&result, std::move(response), call_err);
+    callback(result);
+  });
+  return Error::Success;
+}
+
+Error InProcessBackend::StartStream(OnCompleteFn /*callback*/) {
+  return Error("streaming is not supported by the in_process backend");
+}
+
+Error InProcessBackend::StopStream() {
+  return Error("streaming is not supported by the in_process backend");
+}
+
+Error InProcessBackend::AsyncStreamInfer(
+    const InferOptions&, const std::vector<InferInput*>&,
+    const std::vector<const InferRequestedOutput*>&) {
+  return Error("streaming is not supported by the in_process backend");
+}
+
+Error InProcessBackend::RegisterSystemSharedMemory(
+    const std::string& name, const std::string& key, size_t byte_size,
+    size_t offset) {
+  return PythonEmbed::Get().CallVoidBuilt(
+      "register_system_shared_memory", [&]() {
+        return Py_BuildValue(
+            "ssnn", name.c_str(), key.c_str(), (Py_ssize_t)byte_size,
+            (Py_ssize_t)offset);
+      });
+}
+
+Error InProcessBackend::RegisterTpuSharedMemory(
+    const std::string& name, const std::string& raw_handle,
+    int64_t device_id, size_t byte_size) {
+  return PythonEmbed::Get().CallVoidBuilt(
+      "register_tpu_shared_memory", [&]() {
+        return Py_BuildValue(
+            "sy#Ln", name.c_str(), raw_handle.data(),
+            (Py_ssize_t)raw_handle.size(), (long long)device_id,
+            (Py_ssize_t)byte_size);
+      });
+}
+
+Error InProcessBackend::UnregisterSystemSharedMemory(
+    const std::string& name) {
+  return PythonEmbed::Get().CallVoidBuilt(
+      "unregister_system_shared_memory",
+      [&]() { return Py_BuildValue("(s)", name.c_str()); });
+}
+
+Error InProcessBackend::UnregisterTpuSharedMemory(const std::string& name) {
+  return PythonEmbed::Get().CallVoidBuilt(
+      "unregister_tpu_shared_memory",
+      [&]() { return Py_BuildValue("(s)", name.c_str()); });
+}
+
+Error InProcessBackend::ArenaAllocate(
+    size_t byte_size, int64_t device_id, std::string* raw_handle) {
+  return PythonEmbed::Get().CallAllocate(byte_size, device_id, raw_handle);
+}
+
+}  // namespace perf
+}  // namespace tpuclient

@@ -1,0 +1,76 @@
+// In-process backend: the perf harness drives the Python server core
+// directly inside this process — no RPC, no server process.
+//
+// Role parity: the reference's triton_c_api backend, which dlopens
+// libtritonserver.so and calls its C API so perf_analyzer measures the
+// model stack without network overhead
+// (/root/reference/src/c++/perf_analyzer/client_backend/triton_c_api/
+// triton_c_api_backend.h:64, triton_loader.cc:526-690). Here the
+// "server library" is the CPython runtime: the backend embeds the
+// interpreter, imports client_tpu.server.embed, and exchanges
+// serialized KServe protos (bytes in, bytes out), so request
+// construction and result parsing reuse the exact gRPC-client code
+// paths.
+#pragma once
+
+#include <memory>
+
+#include "client_backend.h"
+
+namespace tpuclient {
+namespace perf {
+
+// One embedded interpreter per process (CPython is a singleton);
+// repeated Create() calls share it. Not finalized at exit — the JAX
+// runtime owns background threads that do not survive Py_Finalize.
+class InProcessBackend : public ClientBackend {
+ public:
+  // models_csv seeds embed.init (e.g. "simple"); the target model is
+  // loaded on demand by the server core's repository.
+  static Error Create(
+      const BackendConfig& config, std::unique_ptr<ClientBackend>* backend);
+
+  Error ServerMetadataJson(json::Value* metadata) override;
+  Error ModelMetadataJson(
+      json::Value* metadata, const std::string& model_name,
+      const std::string& model_version) override;
+  Error ModelConfigJson(
+      json::Value* config, const std::string& model_name,
+      const std::string& model_version) override;
+  Error ModelStatisticsJson(
+      json::Value* stats, const std::string& model_name) override;
+
+  Error Infer(
+      InferResult** result, const InferOptions& options,
+      const std::vector<InferInput*>& inputs,
+      const std::vector<const InferRequestedOutput*>& outputs) override;
+  Error AsyncInfer(
+      OnCompleteFn callback, const InferOptions& options,
+      const std::vector<InferInput*>& inputs,
+      const std::vector<const InferRequestedOutput*>& outputs) override;
+  Error StartStream(OnCompleteFn callback) override;
+  Error StopStream() override;
+  Error AsyncStreamInfer(
+      const InferOptions& options, const std::vector<InferInput*>& inputs,
+      const std::vector<const InferRequestedOutput*>& outputs) override;
+
+  Error RegisterSystemSharedMemory(
+      const std::string& name, const std::string& key, size_t byte_size,
+      size_t offset) override;
+  Error RegisterTpuSharedMemory(
+      const std::string& name, const std::string& raw_handle,
+      int64_t device_id, size_t byte_size) override;
+  Error UnregisterSystemSharedMemory(const std::string& name) override;
+  Error UnregisterTpuSharedMemory(const std::string& name) override;
+
+  // Allocates an HBM arena region in-process (the no-RPC analogue of
+  // TpuArenaClient::Allocate).
+  static Error ArenaAllocate(
+      size_t byte_size, int64_t device_id, std::string* raw_handle);
+
+ private:
+  InProcessBackend() = default;
+};
+
+}  // namespace perf
+}  // namespace tpuclient

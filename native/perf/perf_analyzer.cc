@@ -72,6 +72,11 @@ int Run(int argc, char** argv) {
   } else if (params.service_kind == "openai") {
     backend_config.kind = BackendKind::OPENAI;
     backend_config.openai_endpoint = params.endpoint;
+  } else if (params.service_kind == "in_process") {
+    // Embedded server core (triton_c_api analogue): no server
+    // process, no RPC — embed.init warms the target model.
+    backend_config.kind = BackendKind::IN_PROCESS;
+    backend_config.inprocess_models = params.model_name;
   } else {
     backend_config.kind = params.protocol == "http"
                               ? BackendKind::TRITON_HTTP
@@ -156,7 +161,8 @@ int Run(int argc, char** argv) {
   config.measurement_request_count = params.measurement_request_count;
   // REST/chat service kinds send one logical inference per request
   // regardless of -b (their payloads are not batched).
-  config.batch_size = params.service_kind == "triton"
+  config.batch_size = (params.service_kind == "triton" ||
+                       params.service_kind == "in_process")
                           ? static_cast<size_t>(params.batch_size)
                           : 1;
   config.max_trials = params.max_trials;

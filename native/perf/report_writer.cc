@@ -37,6 +37,9 @@ void PrintReport(
     }
     if (status.error_count > 0) {
       printf("    errors: %zu\n", status.error_count);
+      if (!status.sample_error.empty()) {
+        printf("    first error: %s\n", status.sample_error.c_str());
+      }
     }
     auto hbm = status.tpu_metrics.find("tpu_hbm_used_bytes");
     auto util = status.tpu_metrics.find("tpu_hbm_utilization");

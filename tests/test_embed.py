@@ -1,0 +1,71 @@
+"""The embedding surface (client_tpu.server.embed) that backs the
+native perf harness's in_process service kind: serialized-proto
+inference plus JSON metadata/statistics, no RPC."""
+
+import json
+
+import numpy as np
+import pytest
+
+from client_tpu.protocol import inference_pb2 as pb
+from client_tpu.server import embed
+
+
+@pytest.fixture(scope="module")
+def embedded():
+    embed.init("simple")
+    yield embed
+    embed.shutdown()
+
+
+def _simple_request():
+    request = pb.ModelInferRequest(model_name="simple")
+    for name in ("INPUT0", "INPUT1"):
+        tensor = request.inputs.add()
+        tensor.name = name
+        tensor.datatype = "INT32"
+        tensor.shape.extend([16])
+        request.raw_input_contents.append(
+            np.arange(16, dtype=np.int32).tobytes())
+    return request
+
+
+def test_infer_bytes_round_trip(embedded):
+    response = pb.ModelInferResponse()
+    response.ParseFromString(
+        embedded.infer(_simple_request().SerializeToString()))
+    out0 = np.frombuffer(response.raw_output_contents[0], np.int32)
+    np.testing.assert_array_equal(out0, np.arange(16) * 2)
+
+
+def test_infer_unknown_model_raises_with_status(embedded):
+    request = pb.ModelInferRequest(model_name="no_such_model")
+    with pytest.raises(Exception, match=r"\[NOT_FOUND\]"):
+        embedded.infer(request.SerializeToString())
+
+
+def test_metadata_and_config_json(embedded):
+    meta = json.loads(embedded.model_metadata_json("simple"))
+    assert meta["name"] == "simple"
+    assert {t["name"] for t in meta["inputs"]} == {"INPUT0", "INPUT1"}
+    # snake_case keys — the native ModelParser reads these directly
+    # (proto3 JSON omits zero-default fields, so use a batching model).
+    embedded.load_model("preprocess")
+    config = json.loads(embedded.model_config_json("preprocess"))
+    assert config.get("max_batch_size") == 32
+
+
+def test_statistics_json_counts_are_numbers(embedded):
+    embedded.infer(_simple_request().SerializeToString())
+    stats = json.loads(embedded.model_statistics_json("simple"))
+    entry = stats["model_stats"][0]
+    assert isinstance(entry["inference_count"], int)  # not proto strings
+    assert entry["inference_count"] >= 1
+    assert entry["inference_stats"]["success"]["count"] >= 1
+
+
+def test_arena_allocate_and_register(embedded):
+    handle = embedded.tpu_arena_allocate(1024)
+    assert isinstance(handle, bytes) and handle
+    embedded.register_tpu_shared_memory("embed_r0", handle, 0, 1024)
+    embedded.unregister_tpu_shared_memory("embed_r0")

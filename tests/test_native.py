@@ -147,6 +147,29 @@ def test_native_perf_analyzer_openai_e2e(native_build, tmp_path):
         runner.stop()
 
 
+def test_native_perf_analyzer_in_process(native_build):
+    """--service-kind in_process: the harness embeds CPython and
+    drives the server core with NO server process and no RPC (parity:
+    the reference's triton_c_api backend, triton_loader.cc:526-690).
+    Runs as a subprocess so the embedded interpreter initializes from
+    the repo's own tree."""
+    import os
+
+    binary = native_build / "perf_analyzer"
+    assert binary.exists(), "perf_analyzer not built"
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    proc = subprocess.run(
+        [str(binary), "-m", "simple", "--service-kind", "in_process",
+         "-b", "1", "--concurrency-range", "2", "--async",
+         "-p", "400", "-r", "4", "-s", "80"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=str(REPO),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "throughput" in proc.stdout
+    assert "errors" not in proc.stdout, proc.stdout
+
+
 @pytest.mark.parametrize("shm", ["none", "system", "tpu"])
 def test_native_perf_analyzer_e2e(native_build, live_server, shm):
     """The native perf_analyzer binary end-to-end against the live
