@@ -84,6 +84,11 @@ class EnsembleModel(ServedModel):
         self.inputs = inputs
         self.outputs = outputs
         self.max_batch_size = max_batch_size
+        # Set by the server core so composing-step executions show up
+        # in per-model statistics (Triton records composing models'
+        # queue/compute like top-level requests): callable
+        # (model_name, count, compute_ns).
+        self.stats_recorder = None
 
     def _extend_config(self, config: mc.ModelConfig) -> None:
         for model_name, input_map, output_map in self._steps:
@@ -109,7 +114,21 @@ class EnsembleModel(ServedModel):
                         status="INVALID_ARGUMENT",
                     )
                 step_inputs[step_name] = tensors[ens_name]
-            step_outputs = model.infer(step_inputs, parameters)
+            if self.stats_recorder is not None:
+                import time
+
+                start_ns = time.monotonic_ns()
+                step_outputs = model.infer(step_inputs, parameters)
+                first = next(iter(step_inputs.values()), None)
+                count = (
+                    int(first.shape[0])
+                    if getattr(first, "ndim", 0) and model.max_batch_size > 0
+                    else 1
+                )
+                self.stats_recorder(
+                    model_name, count, time.monotonic_ns() - start_ns)
+            else:
+                step_outputs = model.infer(step_inputs, parameters)
             for ens_name, step_name in output_map.items():
                 tensors[ens_name] = step_outputs[step_name]
         return {spec.name: tensors[spec.name] for spec in self.outputs}

@@ -214,7 +214,9 @@ def run_python_harness(model: str, batch: int, concurrency: int,
     manager.init()
     config = MeasurementConfig(measurement_interval_ms=2000, max_trials=4,
                                stability_threshold=0.2, batch_size=batch)
-    profiler = InferenceProfiler(manager, config, setup_backend, model)
+    profiler = InferenceProfiler(
+        manager, config, setup_backend, model,
+        composing_models=parsed.composing_models)
     manager.change_concurrency_level(1)
     time.sleep(warm_s)  # warm the compiled path before measuring
     results = profiler.profile_concurrency_range(concurrency, concurrency)

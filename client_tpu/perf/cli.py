@@ -253,7 +253,8 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
             manager.init()
             profiler = InferenceProfiler(
                 manager, config, setup_backend, model.name, args.verbose,
-                metrics_manager=metrics_manager)
+                metrics_manager=metrics_manager,
+                composing_models=model.composing_models)
             results = profiler.profile_request_rate_range(start, end, step)
         elif args.request_intervals:
             mode = "request_rate"
@@ -263,7 +264,8 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
             manager.init()
             profiler = InferenceProfiler(
                 manager, config, setup_backend, model.name, args.verbose,
-                metrics_manager=metrics_manager)
+                metrics_manager=metrics_manager,
+                composing_models=model.composing_models)
             results = profiler.profile_custom_intervals()
         elif args.periodic_concurrency_range:
             start, end, step = _parse_range(args.periodic_concurrency_range)
@@ -275,7 +277,8 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
             manager.init()
             profiler = InferenceProfiler(
                 manager, config, setup_backend, model.name, args.verbose,
-                metrics_manager=metrics_manager)
+                metrics_manager=metrics_manager,
+                composing_models=model.composing_models)
             manager.run_ramp()
             results = [profiler.profile_single_level()]
             manager.stop()
@@ -285,7 +288,8 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
             manager.init()
             profiler = InferenceProfiler(
                 manager, config, setup_backend, model.name, args.verbose,
-                metrics_manager=metrics_manager)
+                metrics_manager=metrics_manager,
+                composing_models=model.composing_models)
             results = profiler.profile_concurrency_range(start, end, step)
     except (InferenceServerException, ValueError, OSError) as e:
         print("perf failed: %s" % e, file=sys.stderr)

@@ -72,14 +72,100 @@ class MeasurementConfig:
         self.batch_size = batch_size
 
 
+def _normalize_stats_entry(entry: Dict) -> Dict:
+    """Undoes protobuf-JSON int64 stringification on the known numeric
+    fields only (a generic string->int pass would corrupt `version`)."""
+    out = dict(entry)
+    for key in ("inference_count", "execution_count"):
+        if key in out:
+            out[key] = int(out[key])
+    sections = {}
+    for name, section in dict(out.get("inference_stats", {})).items():
+        sections[name] = (
+            {k: int(v) for k, v in section.items()}
+            if isinstance(section, dict) else section
+        )
+    if sections:
+        out["inference_stats"] = sections
+    return out
+
+
+def _numeric_delta(before, after):
+    """after - before over matching numeric leaves; non-numeric leaves
+    (names, versions) pass through from `after`."""
+    if isinstance(after, dict):
+        before = before if isinstance(before, dict) else {}
+        return {
+            key: _numeric_delta(before.get(key), value)
+            for key, value in after.items()
+        }
+    if isinstance(after, (int, float)) and not isinstance(after, bool):
+        base = before if isinstance(before, (int, float)) \
+            and not isinstance(before, bool) else 0
+        # Clamp: a server-side counter reset mid-window must not
+        # produce negative counts (matches the native CombineDuration).
+        return max(after - base, 0)
+    return after
+
+
+def _accumulate_numeric(total, part):
+    """total + part over numeric leaves (dict-shaped mirror of
+    _numeric_delta, used when merging stable windows)."""
+    if isinstance(part, dict):
+        total = total if isinstance(total, dict) else {}
+        return {
+            key: _accumulate_numeric(total.get(key), value)
+            for key, value in part.items()
+        }
+    if isinstance(part, (int, float)) and not isinstance(part, bool):
+        base = total if isinstance(total, (int, float)) \
+            and not isinstance(total, bool) else 0
+        return base + part
+    return part
+
+
+def _accumulate_server_stats(total: Dict, part: Dict) -> Dict:
+    """Sums two window-delta server_stats payloads, matching
+    model_stats entries by (name, version) — _accumulate_numeric alone
+    cannot merge the entry LIST (it would replace it wholesale)."""
+    if not part:
+        return total
+    if not total:
+        return part
+    merged = {
+        (e.get("name"), e.get("version", "")): e
+        for e in total.get("model_stats", [])
+    }
+    for entry in part.get("model_stats", []):
+        key = (entry.get("name"), entry.get("version", ""))
+        merged[key] = _accumulate_numeric(merged.get(key, {}), entry)
+    return {"model_stats": list(merged.values())}
+
+
+def _delta_server_stats(before: Dict, after: Dict) -> Dict:
+    """Window-start/window-end statistics pairing: returns the same
+    model_stats shape holding only THIS window's deltas, one entry per
+    (model, version) — the top model plus ensemble composing models."""
+    return {
+        "model_stats": [
+            _numeric_delta(before.get(key, {}), entry)
+            for key, entry in after.items()
+        ]
+    }
+
+
 class InferenceProfiler:
     def __init__(self, manager: LoadManager, config: MeasurementConfig,
                  backend=None, model_name: str = "", verbose: bool = False,
-                 metrics_manager=None):
+                 metrics_manager=None, composing_models=None):
         self._manager = manager
         self._config = config
         self._backend = backend  # for server-side stats
         self._model_name = model_name
+        # Ensemble composing models: their stats are snapshotted and
+        # paired alongside the top model (reference
+        # inference_profiler.cc:648 MergeServerSideStats).
+        self._composing = list(composing_models or [])
         self._verbose = verbose
         self._metrics = metrics_manager  # perf.metrics_manager.MetricsManager
         if self._metrics is not None:
@@ -176,6 +262,7 @@ class InferenceProfiler:
         self._manager.swap_request_records()  # discard warm-up residue
         if self._metrics is not None:
             self._metrics.get_and_reset()  # drop inter-window scrapes
+        stats_before = self._server_stats_snapshot()
         start_ns = time.monotonic_ns()
         if self._config.mode == "count_windows":
             deadline = time.monotonic() + self._config.interval_ms / 1000.0 * 10
@@ -190,7 +277,11 @@ class InferenceProfiler:
             time.sleep(self._config.interval_ms / 1000.0)
         end_ns = time.monotonic_ns()
         records = self._manager.swap_request_records()
+        stats_after = self._server_stats_snapshot()
         status = self._summarize(records, start_ns, end_ns)
+        if stats_after is not None:
+            status.server_stats = _delta_server_stats(
+                stats_before or {}, stats_after)
         if self._metrics is not None:
             from client_tpu.perf.metrics_manager import summarize_metrics
 
@@ -228,14 +319,29 @@ class InferenceProfiler:
             len(valid) * self._config.batch_size / window_s
             if window_s > 0 else 0.0
         )
-        if self._backend is not None and self._model_name:
-            try:
-                status.server_stats = self._backend.model_statistics(
-                    self._model_name
-                )
-            except Exception:
-                status.server_stats = {}
         return status
+
+    def _server_stats_snapshot(self) -> Optional[Dict]:
+        """Cumulative server statistics for the model and its
+        composing models, keyed by (name, version). Deltas between the
+        window-start and window-end snapshots isolate THIS window's
+        queue/compute behavior from warmup and earlier windows
+        (reference pairs start/end ModelInferenceStatistics per
+        Measure, inference_profiler.cc:648)."""
+        if self._backend is None or not self._model_name:
+            return None
+        wanted = set([self._model_name] + self._composing)
+        try:  # one all-models query per snapshot (native parity)
+            stats = self._backend.model_statistics("")
+        except Exception:
+            return None
+        snapshot: Dict = {}
+        for entry in stats.get("model_stats", []):
+            if entry.get("name") not in wanted:
+                continue
+            key = (entry.get("name"), entry.get("version", ""))
+            snapshot[key] = _normalize_stats_entry(entry)
+        return snapshot or None
 
     def _is_stable(self, trials: List[PerfStatus]) -> bool:
         if len(trials) < 3:
@@ -299,7 +405,12 @@ class InferenceProfiler:
             merged.completed_count * self._config.batch_size / window_s
             if window_s > 0 else 0.0
         )
-        merged.server_stats = trials[-1].server_stats
+        # Per-window deltas sum across the merged windows (counts and
+        # ns are additive); non-numeric fields ride through.
+        merged.server_stats = {}
+        for trial in trials:
+            merged.server_stats = _accumulate_server_stats(
+                merged.server_stats, trial.server_stats)
         families = {f for t in trials for f in t.tpu_metrics}
         for fam in families:
             windows = [t.tpu_metrics[fam] for t in trials

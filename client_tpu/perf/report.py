@@ -30,6 +30,23 @@ def print_report(results: List[PerfStatus], percentile: int = 0,
             print("    delayed requests: %d" % status.delayed_count)
         if status.error_count:
             print("    errors: %d" % status.error_count)
+        for entry in status.server_stats.get("model_stats", []):
+            stats = entry.get("inference_stats", {})
+            count = entry.get("inference_count", 0)
+            if not count:
+                continue
+
+            def us(section):
+                return stats.get(section, {}).get("ns", 0) / count / 1000.0
+
+            print(
+                "    server %s (this window): %d inferences, "
+                "%d executions, queue %.0f us, compute in/infer/out "
+                "%.0f/%.0f/%.0f us"
+                % (entry.get("name", "?"), count,
+                   entry.get("execution_count", 0), us("queue"),
+                   us("compute_input"), us("compute_infer"),
+                   us("compute_output")))
         if status.tpu_metrics:
             hbm = status.tpu_metrics.get("hbm_used_bytes")
             util = status.tpu_metrics.get("hbm_utilization")

@@ -299,8 +299,17 @@ class InferenceServerCore:
                 self._batchers[model.name] = batcher
             return batcher
 
+    def _record_composing(self, name: str, count: int,
+                          compute_ns: int) -> None:
+        """Stats hook ensembles call per composing-step execution, so
+        composing models' per-window deltas are real (Triton records
+        composing executions through their own schedulers)."""
+        self._stats_for(name).record(count, 0, 0, compute_ns, 0, ok=True)
+
     def infer(self, request: pb.ModelInferRequest) -> pb.ModelInferResponse:
         model = self.repository.get(request.model_name, request.model_version)
+        if getattr(model, "stats_recorder", False) is None:
+            model.stats_recorder = self._record_composing
         stats = self._stats_for(model.name)
         t0 = time.monotonic_ns()
         queue_ns = 0

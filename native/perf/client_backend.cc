@@ -96,7 +96,18 @@ class GrpcBackend : public ClientBackend {
       root["dynamic_batching"] = json::Value(json::Object{});
     }
     if (c.has_ensemble_scheduling()) {
-      root["ensemble_scheduling"] = json::Value(json::Object{});
+      // The step list carries the composing-model names the profiler
+      // pairs per-window stats for — an empty object would silently
+      // disable that on the gRPC path.
+      json::Array steps;
+      for (const auto& step : c.ensemble_scheduling().step()) {
+        json::Object entry;
+        entry["model_name"] = json::Value(step.model_name());
+        steps.push_back(json::Value(std::move(entry)));
+      }
+      json::Object scheduling;
+      scheduling["step"] = json::Value(std::move(steps));
+      root["ensemble_scheduling"] = json::Value(std::move(scheduling));
     }
     if (c.model_transaction_policy().decoupled()) {
       json::Object policy;

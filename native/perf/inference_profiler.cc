@@ -4,6 +4,7 @@
 #include <chrono>
 #include <cmath>
 #include <cstdio>
+#include <cstdlib>
 #include <thread>
 
 namespace tpuclient {
@@ -18,6 +19,142 @@ double Percentile(std::vector<double>& sorted, double p) {
   size_t hi = std::min(lo + 1, sorted.size() - 1);
   double frac = rank - lo;
   return sorted[lo] * (1.0 - frac) + sorted[hi] * frac;
+}
+
+//==============================================================================
+// Per-window server-stat pairing (parity: inference_profiler.cc:648
+// start/end snapshot deltas with composing-model merging).
+
+uint64_t StatUint(const json::Value& entry, const char* key) {
+  if (!entry.IsObject() || !entry.Has(key)) return 0;
+  const json::Value& v = entry[key];
+  if (v.IsNumber()) return v.AsUint();
+  if (v.IsString()) {
+    // protobuf-JSON stringifies (u)int64 counters ("123"), which is
+    // what the HTTP stats endpoint serves.
+    return strtoull(v.AsString().c_str(), nullptr, 10);
+  }
+  return 0;
+}
+
+const json::Value* FindModelEntry(
+    const json::Value& stats, const std::string& name,
+    const std::string& version) {
+  if (!stats.IsObject() || !stats.Has("model_stats")) return nullptr;
+  const json::Value& arr = stats["model_stats"];
+  if (!arr.IsArray()) return nullptr;
+  for (const auto& entry : arr.AsArray()) {
+    if (!entry.IsObject()) continue;
+    std::string entry_name =
+        entry.Has("name") ? entry["name"].AsString() : "";
+    std::string entry_version =
+        entry.Has("version") ? entry["version"].AsString() : "";
+    if (entry_name == name &&
+        (version.empty() || entry_version == version)) {
+      return &entry;
+    }
+  }
+  return nullptr;
+}
+
+// sign=-1: after + (-1)*before = the window's delta;
+// sign=+1: accumulate two window deltas when merging stable trials.
+json::Value CombineDuration(
+    const json::Value* a, const json::Value* b, int sign) {
+  json::Object out;
+  uint64_t a_count = a != nullptr ? StatUint(*a, "count") : 0;
+  uint64_t a_ns = a != nullptr ? StatUint(*a, "ns") : 0;
+  uint64_t b_count = b != nullptr ? StatUint(*b, "count") : 0;
+  uint64_t b_ns = b != nullptr ? StatUint(*b, "ns") : 0;
+  auto combine = [sign](uint64_t base, uint64_t other) -> uint64_t {
+    if (sign < 0) return base >= other ? base - other : 0;
+    return base + other;
+  };
+  out["count"] = json::Value(combine(b_count, a_count));
+  out["ns"] = json::Value(combine(b_ns, a_ns));
+  return json::Value(std::move(out));
+}
+
+json::Value CombineModelEntry(
+    const json::Value* before, const json::Value& after, int sign) {
+  static const char* kSections[] = {"success", "fail", "queue",
+                                    "compute_input", "compute_infer",
+                                    "compute_output"};
+  json::Object out;
+  if (after.IsObject() && after.Has("name")) {
+    out["name"] = json::Value(after["name"].AsString());
+  }
+  if (after.IsObject() && after.Has("version")) {
+    out["version"] = json::Value(after["version"].AsString());
+  }
+  auto combine = [sign](uint64_t base, uint64_t other) -> uint64_t {
+    if (sign < 0) return base >= other ? base - other : 0;
+    return base + other;
+  };
+  out["inference_count"] = json::Value(combine(
+      StatUint(after, "inference_count"),
+      before != nullptr ? StatUint(*before, "inference_count") : 0));
+  out["execution_count"] = json::Value(combine(
+      StatUint(after, "execution_count"),
+      before != nullptr ? StatUint(*before, "execution_count") : 0));
+  const json::Value* after_stats =
+      after.IsObject() && after.Has("inference_stats")
+          ? &after["inference_stats"]
+          : nullptr;
+  const json::Value* before_stats =
+      before != nullptr && before->IsObject() &&
+              before->Has("inference_stats")
+          ? &(*before)["inference_stats"]
+          : nullptr;
+  json::Object sections;
+  for (const char* section : kSections) {
+    const json::Value* a =
+        before_stats != nullptr && before_stats->IsObject() &&
+                before_stats->Has(section)
+            ? &(*before_stats)[section]
+            : nullptr;
+    const json::Value* b =
+        after_stats != nullptr && after_stats->IsObject() &&
+                after_stats->Has(section)
+            ? &(*after_stats)[section]
+            : nullptr;
+    sections[section] = CombineDuration(a, b, sign);
+  }
+  out["inference_stats"] = json::Value(std::move(sections));
+  return json::Value(std::move(out));
+}
+
+json::Value DeltaServerStats(
+    const json::Value& before, const json::Value& after,
+    const std::vector<std::string>& models) {
+  json::Array entries;
+  for (const std::string& name : models) {
+    const json::Value* b = FindModelEntry(before, name, "");
+    const json::Value* a = FindModelEntry(after, name, "");
+    if (a == nullptr) continue;
+    entries.push_back(CombineModelEntry(b, *a, -1));
+  }
+  json::Object root;
+  root["model_stats"] = json::Value(std::move(entries));
+  return json::Value(std::move(root));
+}
+
+json::Value AccumulateServerStats(
+    const json::Value& total, const json::Value& part) {
+  if (!part.IsObject() || !part.Has("model_stats")) return total;
+  if (!total.IsObject() || !total.Has("model_stats")) {
+    return part;  // first window with stats
+  }
+  json::Array entries;
+  for (const auto& entry : part["model_stats"].AsArray()) {
+    std::string name =
+        entry.IsObject() && entry.Has("name") ? entry["name"].AsString() : "";
+    const json::Value* prior = FindModelEntry(total, name, "");
+    entries.push_back(CombineModelEntry(prior, entry, +1));
+  }
+  json::Object root;
+  root["model_stats"] = json::Value(std::move(entries));
+  return json::Value(std::move(root));
 }
 
 }  // namespace
@@ -114,6 +251,12 @@ Error InferenceProfiler::ProfileLevel(PerfStatus* merged) {
 Error InferenceProfiler::Measure(PerfStatus* status) {
   manager_->SwapRequestRecords();  // discard warm-up residue
   if (metrics_ != nullptr) metrics_->GetAndReset();  // drop stale scrapes
+  const bool want_stats = stats_backend_ != nullptr && !model_name_.empty();
+  json::Value stats_before;
+  if (want_stats) {
+    // Best effort — a failed stats scrape never fails the window.
+    stats_backend_->ModelStatisticsJson(&stats_before, "");
+  }
   uint64_t start_ns = NowNs();
   if (config_.count_windows) {
     uint64_t deadline =
@@ -132,9 +275,16 @@ Error InferenceProfiler::Measure(PerfStatus* status) {
   if (metrics_ != nullptr) {
     status->tpu_metrics = SummarizeMetrics(metrics_->GetAndReset());
   }
-  if (stats_backend_ != nullptr && !model_name_.empty()) {
-    // Best effort — a failed stats scrape never fails the window.
-    stats_backend_->ModelStatisticsJson(&status->server_stats, model_name_);
+  if (want_stats) {
+    json::Value stats_after;
+    Error stats_err = stats_backend_->ModelStatisticsJson(&stats_after, "");
+    if (stats_err.IsOk()) {
+      std::vector<std::string> models = {model_name_};
+      models.insert(models.end(), composing_models_.begin(),
+                    composing_models_.end());
+      status->server_stats =
+          DeltaServerStats(stats_before, stats_after, models);
+    }
   }
   return Error::Success;
 }
@@ -227,7 +377,11 @@ PerfStatus InferenceProfiler::Merge(std::vector<PerfStatus>&& trials) const {
       merged.records.push_back(std::move(record));
     }
   }
-  merged.server_stats = trials.back().server_stats;
+  // Window deltas are additive across the merged stable windows.
+  for (const auto& trial : trials) {
+    merged.server_stats =
+        AccumulateServerStats(merged.server_stats, trial.server_stats);
+  }
   {
     // Average the window averages; keep the overall max.
     std::map<std::string, std::vector<std::pair<double, double>>> collected;

@@ -37,7 +37,9 @@ struct PerfStatus {
   uint64_t window_end_ns = 0;
   // Raw records for the profile export.
   std::vector<RequestRecord> records;
-  // Server-side statistics snapshot at window end (model_stats JSON).
+  // Server-side statistics for THIS window: deltas between the
+  // window-start and window-end snapshots (model_stats JSON shape),
+  // one entry per model (top model + ensemble composing models).
   json::Value server_stats;
   // Client-transport breakdown averaged over the window (from the
   // setup backend's cumulative stats when available).
@@ -66,9 +68,11 @@ class InferenceProfiler {
   InferenceProfiler(
       LoadManager* manager, MeasurementConfig config,
       ClientBackend* stats_backend = nullptr, std::string model_name = "",
-      bool verbose = false, MetricsManager* metrics = nullptr)
+      bool verbose = false, MetricsManager* metrics = nullptr,
+      std::vector<std::string> composing_models = {})
       : manager_(manager), config_(config), stats_backend_(stats_backend),
-        model_name_(std::move(model_name)), verbose_(verbose),
+        model_name_(std::move(model_name)),
+        composing_models_(std::move(composing_models)), verbose_(verbose),
         metrics_(metrics) {
     if (metrics_ != nullptr) metrics_->Start();
   }
@@ -101,6 +105,10 @@ class InferenceProfiler {
   MeasurementConfig config_;
   ClientBackend* stats_backend_;
   std::string model_name_;
+  // Ensemble composing models: their per-window stat deltas are
+  // paired alongside the top model's (reference
+  // inference_profiler.cc:648).
+  std::vector<std::string> composing_models_;
   bool verbose_;
   MetricsManager* metrics_;
 };

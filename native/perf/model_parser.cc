@@ -87,6 +87,16 @@ Error ModelParser::Parse(
 
     if (config.Has("ensemble_scheduling")) {
       model->scheduler_type = SchedulerType::ENSEMBLE;
+      const json::Value& scheduling = config["ensemble_scheduling"];
+      if (scheduling.IsObject() && scheduling.Has("step") &&
+          scheduling["step"].IsArray()) {
+        for (const auto& step : scheduling["step"].AsArray()) {
+          if (step.IsObject() && step.Has("model_name")) {
+            model->composing_models.push_back(
+                step["model_name"].AsString());
+          }
+        }
+      }
     } else if (config.Has("sequence_batching")) {
       model->scheduler_type = SchedulerType::SEQUENCE;
     } else if (config.Has("dynamic_batching")) {

@@ -32,6 +32,10 @@ struct ParsedModel {
   std::vector<ModelTensor> outputs;
   SchedulerType scheduler_type = SchedulerType::NONE;
   bool decoupled = false;
+  // Ensemble steps' model names (reference: composing-model metadata,
+  // model_parser.cc GetEnsembleSchedulerType) — the profiler pairs
+  // their per-window server stats with the top model's.
+  std::vector<std::string> composing_models;
 
   const ModelTensor* FindInput(const std::string& name) const;
 };

@@ -208,7 +208,7 @@ int Run(int argc, char** argv) {
     if (!init_err.IsOk()) return init_err;
     InferenceProfiler profiler(
         m, config, setup_backend.get(), model.name, params.verbose,
-        metrics.get());
+        metrics.get(), model.composing_models);
     if (params.has_request_rate_range) {
       mode = LoadMode::REQUEST_RATE;
       return profiler.ProfileRequestRateRange(

@@ -41,6 +41,32 @@ void PrintReport(
         printf("    first error: %s\n", status.sample_error.c_str());
       }
     }
+    // Per-window server-side deltas (top model + ensemble composing
+    // models), µs per inference — reference column set.
+    if (status.server_stats.IsObject() &&
+        status.server_stats.Has("model_stats")) {
+      for (const auto& entry : status.server_stats["model_stats"].AsArray()) {
+        if (!entry.IsObject() || !entry.Has("inference_count")) continue;
+        uint64_t count = entry["inference_count"].AsUint();
+        if (count == 0) continue;
+        const json::Value& stats = entry["inference_stats"];
+        auto us = [&](const char* section) -> double {
+          if (!stats.IsObject() || !stats.Has(section)) return 0.0;
+          return stats[section]["ns"].AsDouble() / count / 1000.0;
+        };
+        printf(
+            "    server %s (this window): %llu inferences, %llu "
+            "executions, queue %.0f us, compute in/infer/out "
+            "%.0f/%.0f/%.0f us\n",
+            entry.Has("name") ? entry["name"].AsString().c_str() : "?",
+            (unsigned long long)count,
+            (unsigned long long)(entry.Has("execution_count")
+                                     ? entry["execution_count"].AsUint()
+                                     : 0),
+            us("queue"), us("compute_input"), us("compute_infer"),
+            us("compute_output"));
+      }
+    }
     auto hbm = status.tpu_metrics.find("tpu_hbm_used_bytes");
     auto util = status.tpu_metrics.find("tpu_hbm_utilization");
     if (hbm != status.tpu_metrics.end() ||

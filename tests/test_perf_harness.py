@@ -357,6 +357,101 @@ def test_profiler_count_windows():
     assert results[0].completed_count >= 20
 
 
+def test_profiler_server_stats_are_window_deltas():
+    """server_stats must reflect only the measured windows, not the
+    cumulative totals (the reference pairs start/end snapshots per
+    Measure window): warmup traffic before profiling must not leak
+    into the reported inference_count."""
+    from client_tpu.perf.client_backend import (
+        BackendKind,
+        ClientBackendFactory,
+    )
+    from client_tpu.perf.data_loader import DataLoader
+    from client_tpu.perf.load_manager import InferDataManager
+    from client_tpu.perf.model_parser import ModelParser
+    from client_tpu.server.app import build_core
+
+    core = build_core(["simple"])
+    factory = ClientBackendFactory(BackendKind.IN_PROCESS, core=core)
+    backend = factory.create()
+    # 50 warmup inferences that must NOT appear in the window delta.
+    parsed = ModelParser().parse(backend, "simple", batch_size=1)
+    loader = DataLoader(parsed)
+    loader.generate_data()
+    dm = InferDataManager(parsed, loader, batch_size=1)
+    warm_manager = _concurrency_manager(factory, parsed, loader, dm)
+    import numpy as np
+
+    for _ in range(50):
+        from client_tpu.protocol import inference_pb2 as pb
+
+        req = pb.ModelInferRequest(model_name="simple")
+        for name in ("INPUT0", "INPUT1"):
+            t = req.inputs.add()
+            t.name = name
+            t.datatype = "INT32"
+            t.shape.extend([16])
+            req.raw_input_contents.append(
+                np.zeros(16, dtype=np.int32).tobytes())
+        core.infer(req)
+    config = MeasurementConfig(
+        measurement_interval_ms=200, max_trials=6, stability_threshold=0.9,
+    )
+    profiler = InferenceProfiler(
+        warm_manager, config, backend, "simple")
+    results = profiler.profile_concurrency_range(2, 2)
+    warm_manager.cleanup()
+    entry = results[0].server_stats["model_stats"][0]
+    assert entry["name"] == "simple"
+    # Delta, not cumulative: the window count tracks the requests the
+    # profiler itself completed, excluding the 50 warmup inferences
+    # and everything before the stable windows.
+    window = entry["inference_count"]
+    assert 0 < window, "no inferences recorded in window delta"
+    total_stats = backend.model_statistics("simple")
+    total = int(total_stats["model_stats"][0]["inference_count"])
+    assert window <= total - 50, (
+        "window delta %d should exclude the 50 warmup inferences "
+        "(cumulative %d)" % (window, total))
+    assert entry["inference_stats"]["success"]["count"] == window
+
+
+def test_profiler_pairs_composing_model_stats():
+    """Ensemble profiling reports per-window deltas for the composing
+    models alongside the top model."""
+    from client_tpu.perf.client_backend import (
+        BackendKind,
+        ClientBackendFactory,
+    )
+    from client_tpu.perf.data_loader import DataLoader
+    from client_tpu.perf.load_manager import InferDataManager
+    from client_tpu.perf.model_parser import ModelParser
+    from client_tpu.server.app import build_core
+
+    core = build_core(["ensemble_image"])
+    factory = ClientBackendFactory(BackendKind.IN_PROCESS, core=core)
+    backend = factory.create()
+    parsed = ModelParser().parse(backend, "ensemble_image", batch_size=1)
+    assert parsed.composing_models, "parser found no composing models"
+    loader = DataLoader(parsed)
+    loader.generate_data()
+    dm = InferDataManager(parsed, loader, batch_size=1)
+    manager = _concurrency_manager(factory, parsed, loader, dm)
+    config = MeasurementConfig(
+        measurement_interval_ms=250, max_trials=6, stability_threshold=0.9,
+    )
+    profiler = InferenceProfiler(
+        manager, config, backend, "ensemble_image",
+        composing_models=parsed.composing_models)
+    results = profiler.profile_concurrency_range(2, 2)
+    manager.cleanup()
+    names = {e["name"] for e in results[0].server_stats["model_stats"]}
+    assert "ensemble_image" in names
+    for composing in parsed.composing_models:
+        assert composing in names, (
+            "composing model %s missing from %s" % (composing, names))
+
+
 # -- CLI end-to-end (in-process) ------------------------------------------
 
 
