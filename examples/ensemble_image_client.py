@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Ensemble pipeline client: sends a raw uint8 image to the
+`ensemble_image` model (preprocess -> resnet50 -> postprocess executed
+server-side) and prints the top-1 label each composing step produced.
+
+Start a server first:
+  python -m client_tpu.server.app --models ensemble_image
+(parity example: reference src/python/examples/ensemble_image_client.py)
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import client_tpu.grpc as grpcclient
+
+
+def load_image(path, h=224, w=224):
+    if path:
+        from PIL import Image
+
+        image = Image.open(path).convert("RGB").resize((w, h))
+        return np.array(image).astype(np.uint8)
+    rng = np.random.default_rng(0)
+    return rng.integers(0, 256, size=(h, w, 3), dtype=np.uint8)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("image", nargs="?", default="",
+                        help="image file (empty = synthetic)")
+    parser.add_argument("-m", "--model-name", default="ensemble_image")
+    parser.add_argument("-b", "--batch-size", type=int, default=1)
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    parser.add_argument("--streaming", action="store_true",
+                        help="send over a bidirectional stream")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args()
+
+    image = load_image(args.image)
+    batched = np.stack([image] * args.batch_size, axis=0)
+
+    with grpcclient.InferenceServerClient(args.url,
+                                          verbose=args.verbose) as client:
+        inputs = [grpcclient.InferInput(
+            "RAW_IMAGE", list(batched.shape), "UINT8")]
+        inputs[0].set_data_from_numpy(batched)
+        outputs = [grpcclient.InferRequestedOutput("LABEL")]
+
+        if args.streaming:
+            import queue
+
+            responses = queue.Queue()
+            client.start_stream(
+                callback=lambda result, error: responses.put((result, error)))
+            client.async_stream_infer(args.model_name, inputs,
+                                      outputs=outputs)
+            result, error = responses.get(timeout=60)
+            client.stop_stream()
+            if error is not None:
+                raise error
+        else:
+            result = client.infer(args.model_name, inputs, outputs=outputs)
+
+        labels = result.as_numpy("LABEL")
+        for row in np.asarray(labels).reshape(-1):
+            text = row.decode() if isinstance(row, bytes) else row
+            print("top-1 (score:index): %s" % text)
+        print("PASS: ensemble_image")
+
+
+if __name__ == "__main__":
+    main()

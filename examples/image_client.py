@@ -1,0 +1,361 @@
+#!/usr/bin/env python
+"""Image classification client: preprocessing, batching, optional
+async/streaming modes, top-K classification parsing, and optional
+shared-memory I/O (the BASELINE config #2 shape: ResNet-50, batch 8,
+TPU shm).
+
+Start a server first:  python -m client_tpu.server.app --models resnet50
+Then:  python examples/image_client.py -m resnet50 -b 8 -c 3 image_or_dir
+With no image argument a synthetic batch is generated — handy because
+the served ResNet's weights are random anyway.
+
+(parity example: reference src/python/examples/image_client.py —
+preprocessing with --scaling INCEPTION|VGG|NONE, metadata-driven
+shape/dtype handling, classification via class_count.)
+"""
+
+import argparse
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from client_tpu.utils import InferenceServerException, triton_to_np_dtype
+
+
+def parse_model(metadata, config):
+    """Validates that the model looks like an image classifier (one
+    image input, one vector output) and extracts what preprocessing
+    needs: (input_name, output_name, h, w, c, dtype, max_batch)."""
+    if len(metadata["inputs"]) != 1:
+        raise RuntimeError(
+            "expecting 1 input, got %d" % len(metadata["inputs"]))
+    if len(metadata["outputs"]) != 1:
+        raise RuntimeError(
+            "expecting 1 output, got %d" % len(metadata["outputs"]))
+    input_meta = metadata["inputs"][0]
+    output_meta = metadata["outputs"][0]
+    max_batch = int(config.get("max_batch_size", 0))
+
+    # Output must be a vector (all-but-one dims of size 1).
+    out_shape = [int(d) for d in output_meta["shape"]]
+    if max_batch > 0 and out_shape and out_shape[0] == -1:
+        out_shape = out_shape[1:]
+    non_one = [d for d in out_shape if d != 1]
+    if len(non_one) != 1:
+        raise RuntimeError(
+            "expecting output to be a vector, got shape %s" % out_shape)
+
+    shape = [int(d) for d in input_meta["shape"]]
+    if max_batch > 0 and shape and shape[0] == -1:
+        shape = shape[1:]
+    if len(shape) != 3:
+        raise RuntimeError(
+            "expecting input with 3 dims (HWC), got %s" % shape)
+    h, w, c = shape
+    return (input_meta["name"], output_meta["name"], h, w, c,
+            input_meta["datatype"], max_batch)
+
+
+def preprocess(image, h, w, c, datatype, scaling):
+    """PIL image -> HWC array matching the model input, with the
+    reference's scaling conventions."""
+    if c == 1:
+        image = image.convert("L")
+    else:
+        image = image.convert("RGB")
+    image = image.resize((w, h))
+    np_dtype = triton_to_np_dtype(datatype)
+    array = np.array(image).astype(np.float32)
+    if array.ndim == 2:
+        array = array[:, :, None]
+    if scaling == "INCEPTION":
+        array = array / 127.5 - 1.0
+    elif scaling == "VGG":
+        mean = (np.array([123.0, 117.0, 104.0], dtype=np.float32)
+                if c == 3 else np.float32(128.0))
+        array = array - mean
+    return array.astype(np_dtype)
+
+
+def load_images(paths, h, w, c, datatype, scaling, batch):
+    """Image files/dirs -> list of preprocessed arrays (repeated to
+    fill the batch); no paths -> synthetic data."""
+    files = []
+    for path in paths:
+        p = Path(path)
+        if p.is_dir():
+            files.extend(sorted(x for x in p.iterdir() if x.is_file()))
+        else:
+            files.append(p)
+    if not files:
+        rng = np.random.default_rng(0)
+        np_dtype = triton_to_np_dtype(datatype)
+        synth = (rng.random((h, w, c), dtype=np.float32) * 255).astype(
+            np_dtype)
+        return [synth] * max(batch, 1), ["<synthetic>"] * max(batch, 1)
+    from PIL import Image
+
+    arrays, names = [], []
+    for f in files:
+        arrays.append(preprocess(Image.open(str(f)), h, w, c, datatype,
+                                 scaling))
+        names.append(str(f))
+    while len(arrays) < batch:  # repeat to fill the requested batch
+        arrays.append(arrays[len(arrays) % len(files)])
+        names.append(names[len(names) % len(files)])
+    return arrays, names
+
+
+def postprocess(result, output_name, names, classes, batched):
+    output = np.asarray(result.as_numpy(output_name))
+    if not batched:  # non-batching model: one row, make it iterable
+        output = output[None]
+    if classes:
+        # server-side classification: BYTES rows "score:index[:label]"
+        for row, name in zip(output, names):
+            print("Image '%s':" % name)
+            for entry in np.asarray(row).reshape(-1):
+                value = entry.decode() if isinstance(entry, bytes) else entry
+                print("    %s" % value)
+    else:
+        for row, name in zip(output, names):
+            print("Image '%s': argmax %d (%.4f)"
+                  % (name, int(row.argmax()), float(row.max())))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("image", nargs="*",
+                        help="image file(s) or folder(s); empty = synthetic")
+    parser.add_argument("-m", "--model-name", default="resnet50")
+    parser.add_argument("-x", "--model-version", default="")
+    parser.add_argument("-b", "--batch-size", type=int, default=1)
+    parser.add_argument("-c", "--classes", type=int, default=0,
+                        help="request top-K server-side classification")
+    parser.add_argument("-s", "--scaling", default="NONE",
+                        choices=["NONE", "INCEPTION", "VGG"])
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    parser.add_argument("-i", "--protocol", default="grpc",
+                        choices=["grpc", "http"])
+    parser.add_argument("-a", "--async", dest="async_set",
+                        action="store_true", help="async inference")
+    parser.add_argument("--streaming", action="store_true",
+                        help="bidirectional stream (gRPC only)")
+    parser.add_argument("--shared-memory", default="none",
+                        choices=["none", "system", "tpu"],
+                        help="I/O placement (tpu = HBM arena regions)")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args()
+
+    if args.streaming and args.protocol != "grpc":
+        sys.exit("--streaming requires -i grpc")
+    if args.shared_memory != "none" and args.classes:
+        sys.exit("server-side classification (-c) puts BYTES results in "
+                 "the response body; combine it without --shared-memory")
+    if args.shared_memory == "tpu" and args.protocol != "grpc":
+        sys.exit("--shared-memory tpu requires -i grpc (the HBM arena "
+                 "service is co-hosted with the gRPC endpoint)")
+
+    if args.protocol == "grpc":
+        import client_tpu.grpc as tritonclient
+    else:
+        import client_tpu.http as tritonclient
+
+    with tritonclient.InferenceServerClient(
+            args.url, verbose=args.verbose) as client:
+        if args.protocol == "grpc":
+            metadata = client.get_model_metadata(
+                args.model_name, args.model_version, as_json=True)
+            config = client.get_model_config(
+                args.model_name, args.model_version, as_json=True)
+        else:  # HTTP speaks JSON natively
+            metadata = client.get_model_metadata(
+                args.model_name, args.model_version)
+            config = client.get_model_config(
+                args.model_name, args.model_version)
+        config = config.get("config", config)
+        (input_name, output_name, h, w, c, datatype,
+         max_batch) = parse_model(metadata, config)
+
+        batch = args.batch_size
+        if max_batch == 0 and batch != 1:
+            sys.exit("model does not support batching; use -b 1")
+        if max_batch > 0 and batch > max_batch:
+            sys.exit("max supported batch is %d" % max_batch)
+
+        arrays, names = load_images(
+            args.image, h, w, c, datatype, args.scaling, batch)
+        # Every image gets classified: surplus images become extra
+        # batched requests (the shm layout holds one batch, so shm
+        # mode processes exactly one).
+        step = batch if max_batch > 0 else 1
+        chunks = [(arrays[i:i + step], names[i:i + step])
+                  for i in range(0, len(arrays), step)]
+        if args.shared_memory != "none" and len(chunks) > 1:
+            print("warning: --shared-memory holds one batch; classifying "
+                  "the first %d image(s) only" % step, file=sys.stderr)
+            chunks = chunks[:1]
+
+        streaming_started = False
+        shm_handles = []
+        import queue
+
+        stream_results = queue.Queue()  # shared by every streamed request
+        try:
+            for chunk_arrays, chunk_names in chunks:
+                while len(chunk_arrays) < step:  # pad the tail batch
+                    chunk_arrays = chunk_arrays + [chunk_arrays[-1]]
+                    chunk_names = chunk_names + [chunk_names[-1]]
+                batched = (np.stack(chunk_arrays, axis=0)
+                           if max_batch > 0 else chunk_arrays[0])
+                shape = list(batched.shape)
+                inputs = [tritonclient.InferInput(
+                    input_name, shape, datatype)]
+                outputs = [tritonclient.InferRequestedOutput(
+                    output_name, class_count=args.classes)]
+                if args.shared_memory != "none":
+                    inputs[0], outputs[0], shm_handles = \
+                        _setup_shared_memory(
+                            args, client, tritonclient, input_name,
+                            output_name, batched, datatype, shape)
+                else:
+                    inputs[0].set_data_from_numpy(batched)
+
+                if args.streaming:
+                    if not streaming_started:
+                        client.start_stream(
+                            callback=lambda result, error:
+                            stream_results.put((result, error)))
+                        streaming_started = True
+                    client.async_stream_infer(
+                        args.model_name, inputs, outputs=outputs)
+                    result, error = stream_results.get(timeout=60)
+                    if error is not None:
+                        raise error
+                elif args.async_set and args.protocol == "http":
+                    # HTTP async returns a handle (reference semantics).
+                    result = client.async_infer(
+                        args.model_name, inputs,
+                        outputs=outputs).get_result()
+                elif args.async_set:
+                    future = {}
+                    import threading
+
+                    done = threading.Event()
+
+                    def callback(result, error=None):
+                        future["result"], future["error"] = result, error
+                        done.set()
+
+                    client.async_infer(args.model_name, inputs, callback,
+                                       outputs=outputs)
+                    if not done.wait(timeout=60):
+                        sys.exit("async request timed out")
+                    if future.get("error") is not None:
+                        raise future["error"]
+                    result = future["result"]
+                else:
+                    result = client.infer(args.model_name, inputs,
+                                          outputs=outputs)
+                if args.shared_memory != "none":
+                    _print_shm_output(result, output_name, shm_handles,
+                                      chunk_names)
+                else:
+                    postprocess(result, output_name, chunk_names,
+                                args.classes, batched=max_batch > 0)
+            print("PASS: image_client")
+        finally:
+            if streaming_started:
+                client.stop_stream()
+            _cleanup_shared_memory(args, client, shm_handles)
+
+
+def _setup_shared_memory(args, client, tritonclient, input_name,
+                         output_name, batched, datatype, shape):
+    """Places the input (and output destination) in shared memory:
+    'system' = POSIX shm, 'tpu' = HBM arena regions via the arena
+    service (input uploaded once, outputs stay on device)."""
+    out_size = 4 * 1024 * 1024
+    if args.shared_memory == "system":
+        import client_tpu.utils.shared_memory as shm
+
+        in_handle = shm.create_shared_memory_region(
+            "img_in", "/img_in", batched.nbytes)
+        shm.set_shared_memory_region(in_handle, [batched])
+        client.register_system_shared_memory(
+            "img_in", "/img_in", batched.nbytes)
+        out_handle = shm.create_shared_memory_region(
+            "img_out", "/img_out", out_size)
+        client.register_system_shared_memory("img_out", "/img_out", out_size)
+    else:
+        import client_tpu.utils.tpu_shared_memory as tpushm
+
+        tpushm.set_arena_endpoint(args.url)
+        in_handle = tpushm.create_shared_memory_region(
+            "img_in", batched.nbytes, 0)
+        tpushm.set_shared_memory_region(in_handle, [batched])
+        client.register_tpu_shared_memory(
+            "img_in", tpushm.get_raw_handle(in_handle), 0, batched.nbytes)
+        out_handle = tpushm.create_shared_memory_region(
+            "img_out", out_size, 0)
+        client.register_tpu_shared_memory(
+            "img_out", tpushm.get_raw_handle(out_handle), 0, out_size)
+    infer_input = tritonclient.InferInput(input_name, shape, datatype)
+    infer_input.set_shared_memory("img_in", batched.nbytes)
+    requested = tritonclient.InferRequestedOutput(
+        output_name, class_count=args.classes)
+    requested.set_shared_memory("img_out", out_size)
+    return infer_input, requested, [in_handle, out_handle]
+
+
+def _print_shm_output(result, output_name, shm_handles, names):
+    output = result.get_output(output_name)
+    if output is None:
+        raise InferenceServerException("no output in response")
+    if hasattr(output, "parameters"):  # grpc proto
+        region = output.parameters["shared_memory_region"].string_param
+        byte_size = output.parameters["shared_memory_byte_size"].int64_param
+        shape = list(output.shape)
+        datatype = output.datatype
+    else:  # http json
+        params = output.get("parameters", {})
+        region = params.get("shared_memory_region")
+        byte_size = params.get("shared_memory_byte_size")
+        shape = output.get("shape")
+        datatype = output.get("datatype")
+    handle = shm_handles[1]
+    import client_tpu.utils.shared_memory as sysshm
+    import client_tpu.utils.tpu_shared_memory as tpushm
+
+    module = tpushm if type(handle).__module__.endswith(
+        "tpu_shared_memory") else sysshm
+    array = module.get_contents_as_numpy(
+        handle, triton_to_np_dtype(datatype), shape)
+    print("(output read from region '%s', %d bytes)" % (region, byte_size))
+    for row, name in zip(np.asarray(array), names):
+        print("Image '%s': argmax %d" % (name, int(row.argmax())))
+
+
+def _cleanup_shared_memory(args, client, shm_handles):
+    if not shm_handles:
+        return
+    if args.shared_memory == "system":
+        import client_tpu.utils.shared_memory as shm
+
+        client.unregister_system_shared_memory()
+        for handle in shm_handles:
+            shm.destroy_shared_memory_region(handle)
+    elif args.shared_memory == "tpu":
+        import client_tpu.utils.tpu_shared_memory as tpushm
+
+        client.unregister_tpu_shared_memory()
+        for handle in shm_handles:
+            tpushm.destroy_shared_memory_region(handle)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,137 @@
+// TPU HBM shared-memory I/O over gRPC: inputs are uploaded ONCE into
+// arena regions on the accelerator, every inference references them
+// by region name, and outputs land in an arena region without ever
+// leaving HBM — the zero-copy co-location flow the framework is built
+// around (parity example: reference
+// src/c++/examples/simple_grpc_cudashm_client.cc, with the HBM arena
+// standing in for cudaIpcMemHandle_t regions).
+#include <cstdint>
+#include <cstring>
+#include <iostream>
+#include <memory>
+#include <vector>
+
+#include "../perf/client_backend.h"
+#include "grpc_client.h"
+
+namespace {
+const char* Url(int argc, char** argv, const char* fallback) {
+  for (int i = 1; i + 1 < argc; ++i) {
+    if (strcmp(argv[i], "-u") == 0) return argv[i + 1];
+  }
+  return fallback;
+}
+#define FAIL_IF_ERR(x, msg)                                         \
+  do {                                                              \
+    tpuclient::Error err__ = (x);                                   \
+    if (!err__.IsOk()) {                                            \
+      std::cerr << "error: " << msg << ": " << err__.Message()      \
+                << std::endl;                                       \
+      exit(1);                                                      \
+    }                                                               \
+  } while (0)
+}  // namespace
+
+int main(int argc, char** argv) {
+  const std::string url = Url(argc, argv, "localhost:8001");
+  std::unique_ptr<tpuclient::InferenceServerGrpcClient> client;
+  FAIL_IF_ERR(tpuclient::InferenceServerGrpcClient::Create(&client, url),
+              "create client");
+  client->UnregisterTpuSharedMemory();
+
+  // The arena service is co-hosted with the inference endpoint; it is
+  // the stand-in for client-side cudaMalloc + cudaIpcGetMemHandle.
+  std::unique_ptr<tpuclient::perf::TpuArenaClient> arena;
+  FAIL_IF_ERR(tpuclient::perf::TpuArenaClient::Create(&arena, url),
+              "create arena client");
+
+  constexpr size_t kTensorBytes = 16 * sizeof(int32_t);
+
+  // One region per input, typed at upload time so the server stores a
+  // ready-to-consume device array.
+  std::vector<int32_t> in0(16), in1(16);
+  for (int i = 0; i < 16; ++i) { in0[i] = i; in1[i] = 1; }
+  const char* names[2] = {"tpushm_in0", "tpushm_in1"};
+  std::vector<int32_t>* host[2] = {&in0, &in1};
+  for (int idx = 0; idx < 2; ++idx) {
+    std::string raw_handle, region_id;
+    FAIL_IF_ERR(arena->CreateRegion(kTensorBytes, 0, &raw_handle,
+                                    &region_id),
+                "allocate input region");
+    FAIL_IF_ERR(arena->WriteRegion(
+                    region_id, 0,
+                    std::string(
+                        reinterpret_cast<const char*>(host[idx]->data()),
+                        kTensorBytes),
+                    "INT32", {16}),
+                "upload input");
+    FAIL_IF_ERR(client->RegisterTpuSharedMemory(names[idx], raw_handle, 0,
+                                                kTensorBytes),
+                "register input region");
+  }
+
+  std::string out_handle, out_region_id;
+  FAIL_IF_ERR(arena->CreateRegion(kTensorBytes * 2, 0, &out_handle,
+                                  &out_region_id),
+              "allocate output region");
+  FAIL_IF_ERR(client->RegisterTpuSharedMemory("tpushm_out", out_handle, 0,
+                                              kTensorBytes * 2),
+              "register output region");
+
+  // Inference: every tensor rides by region reference; no payload
+  // bytes cross the wire and outputs stay on the accelerator.
+  tpuclient::InferInput* raw0;
+  tpuclient::InferInput* raw1;
+  FAIL_IF_ERR(tpuclient::InferInput::Create(&raw0, "INPUT0", {16}, "INT32"),
+              "create INPUT0");
+  FAIL_IF_ERR(tpuclient::InferInput::Create(&raw1, "INPUT1", {16}, "INT32"),
+              "create INPUT1");
+  std::unique_ptr<tpuclient::InferInput> input0(raw0), input1(raw1);
+  FAIL_IF_ERR(input0->SetSharedMemory("tpushm_in0", kTensorBytes),
+              "INPUT0 shm");
+  FAIL_IF_ERR(input1->SetSharedMemory("tpushm_in1", kTensorBytes),
+              "INPUT1 shm");
+
+  tpuclient::InferRequestedOutput* raw_out0;
+  tpuclient::InferRequestedOutput* raw_out1;
+  FAIL_IF_ERR(tpuclient::InferRequestedOutput::Create(&raw_out0, "OUTPUT0"),
+              "create OUTPUT0");
+  FAIL_IF_ERR(tpuclient::InferRequestedOutput::Create(&raw_out1, "OUTPUT1"),
+              "create OUTPUT1");
+  std::unique_ptr<tpuclient::InferRequestedOutput> out0(raw_out0);
+  std::unique_ptr<tpuclient::InferRequestedOutput> out1(raw_out1);
+  FAIL_IF_ERR(out0->SetSharedMemory("tpushm_out", kTensorBytes, 0),
+              "OUTPUT0 shm");
+  FAIL_IF_ERR(out1->SetSharedMemory("tpushm_out", kTensorBytes,
+                                    kTensorBytes),
+              "OUTPUT1 shm");
+
+  tpuclient::InferOptions options("simple");
+  tpuclient::InferResult* result = nullptr;
+  FAIL_IF_ERR(client->Infer(&result, options,
+                            {input0.get(), input1.get()},
+                            {out0.get(), out1.get()}),
+              "infer");
+  std::unique_ptr<tpuclient::InferResult> owned(result);
+
+  // Outputs live in the arena; read them back through the allocation
+  // side-channel only for verification (a co-located consumer would
+  // keep them on device).
+  std::string payload;
+  FAIL_IF_ERR(arena->ReadRegion(out_region_id, 0, kTensorBytes * 2,
+                                &payload),
+              "read outputs");
+  const int32_t* sum = reinterpret_cast<const int32_t*>(payload.data());
+  const int32_t* diff = sum + 16;
+  for (int i = 0; i < 16; ++i) {
+    if (sum[i] != in0[i] + in1[i] || diff[i] != in0[i] - in1[i]) {
+      std::cerr << "mismatch at " << i << ": " << sum[i] << ", " << diff[i]
+                << std::endl;
+      return 1;
+    }
+  }
+
+  FAIL_IF_ERR(client->UnregisterTpuSharedMemory(), "unregister");
+  std::cout << "PASS: tpu shm infer" << std::endl;
+  return 0;
+}

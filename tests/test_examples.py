@@ -42,7 +42,7 @@ def example_server():
 
     core = build_core(
         ["simple", "simple_string", "simple_sequence", "repeat_int32",
-         "add_sub_fp32"]
+         "add_sub_fp32", "resnet50", "ensemble_image"]
     )
     grpc_handle = start_grpc_server(core=core)
     http_runner = start_http_server_thread(core, host="127.0.0.1", port=0)
@@ -54,15 +54,19 @@ def example_server():
     grpc_handle.stop()
 
 
-def _run_example(name: str, url: str):
+def _run_example_args(name, args, timeout=300):
     proc = subprocess.run(
-        [sys.executable, str(EXAMPLES / name), "-u", url],
-        capture_output=True, text=True, timeout=120,
+        [sys.executable, str(EXAMPLES / name)] + args,
+        capture_output=True, text=True, timeout=timeout,
     )
     assert proc.returncode == 0, "%s failed:\n%s\n%s" % (
         name, proc.stdout[-2000:], proc.stderr[-2000:]
     )
     assert "PASS" in proc.stdout, proc.stdout
+
+
+def _run_example(name: str, url: str):
+    _run_example_args(name, ["-u", url], timeout=120)
 
 
 @pytest.mark.parametrize("name", GRPC_EXAMPLES)
@@ -81,6 +85,8 @@ CPP_GRPC_EXAMPLES = [
     "simple_grpc_string_infer_client",
     "simple_grpc_stream_infer_client",
     "simple_grpc_shm_client",
+    "simple_grpc_tpushm_client",
+    "simple_grpc_sequence_sync_client",
 ]
 
 
@@ -104,3 +110,83 @@ def test_cpp_grpc_example(example_server, name):
 
 def test_cpp_http_example(example_server):
     _run_native_example("simple_http_infer_client", example_server["http"])
+
+
+# -- image / ensemble / reuse clients (richer argument surfaces) ----------
+
+
+@pytest.mark.parametrize("extra", [
+    [],                                # sync, argmax output
+    ["-c", "3", "-s", "INCEPTION"],    # server-side classification
+    ["-a"],                            # async
+    ["--shared-memory", "system"],
+    ["--shared-memory", "tpu"],        # the BASELINE config #2 shape
+    ["--streaming", "-b", "1"],
+])
+def test_image_client(example_server, extra):
+    _run_example_args(
+        "image_client.py",
+        ["-m", "resnet50", "-b", "2", "-u", example_server["grpc"]] + extra)
+
+
+def test_image_client_http(example_server):
+    _run_example_args(
+        "image_client.py",
+        ["-m", "resnet50", "-b", "2", "-i", "http",
+         "-u", example_server["http"]])
+
+
+def test_image_client_real_file(example_server, tmp_path):
+    import numpy as np
+
+    Image = pytest.importorskip("PIL.Image")
+
+    path = tmp_path / "img.png"
+    Image.fromarray(
+        (np.random.default_rng(0).random((64, 48, 3)) * 255).astype("uint8")
+    ).save(path)
+    _run_example_args(
+        "image_client.py",
+        ["-m", "resnet50", "-b", "2", "-s", "VGG",
+         "-u", example_server["grpc"], str(path)])
+
+
+def test_image_client_more_images_than_batch(example_server, tmp_path):
+    """Surplus images become extra batched requests — every file gets
+    classified, none silently dropped."""
+    import numpy as np
+
+    Image = pytest.importorskip("PIL.Image")
+    rng = np.random.default_rng(0)
+    for i in range(5):
+        Image.fromarray(
+            (rng.random((32, 32, 3)) * 255).astype("uint8")
+        ).save(tmp_path / ("img%d.png" % i))
+    proc = subprocess.run(
+        [sys.executable, str(EXAMPLES / "image_client.py"),
+         "-m", "resnet50", "-b", "2", "-u", example_server["grpc"],
+         str(tmp_path)],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    for i in range(5):
+        assert ("img%d.png" % i) in proc.stdout, proc.stdout
+
+
+@pytest.mark.parametrize("extra", [[], ["--streaming"]])
+def test_ensemble_image_client(example_server, extra):
+    _run_example_args(
+        "ensemble_image_client.py",
+        ["-u", example_server["grpc"], "-b", "2"] + extra)
+
+
+def test_reuse_infer_objects(example_server):
+    _run_example_args(
+        "reuse_infer_objects_client.py",
+        ["-u", example_server["grpc"], "--http-url",
+         example_server["http"]])
+
+
+def test_custom_args_client(example_server):
+    _run_example_args(
+        "simple_grpc_custom_args_client.py", ["-u", example_server["grpc"]])
