@@ -25,7 +25,9 @@ from client_tpu.http import _endpoints as ep
 from client_tpu.protocol.http_wire import (
     HEADER_LEN,
     DecodedOutput,
+    compress_body,
     decode_infer_response,
+    decompress_body,
     encode_infer_request,
 )
 from client_tpu.utils import InferenceServerException
@@ -369,7 +371,15 @@ class InferenceServerClient(InferenceServerClientBase):
         headers: Optional[dict] = None,
         query_params: Optional[dict] = None,
         parameters: Optional[dict] = None,
+        request_compression_algorithm: Optional[str] = None,
+        response_compression_algorithm: Optional[str] = None,
     ) -> InferResult:
+        """``request_compression_algorithm`` /
+        ``response_compression_algorithm`` select per-call body
+        compression ("gzip" or "deflate"; None = off), mirroring the
+        reference HTTP client (http_client.cc:2130-2247). Response
+        compression is a preference the server honors via
+        Accept-Encoding."""
         body, json_len = encode_infer_request(
             inputs=inputs, outputs=outputs, request_id=request_id,
             sequence_id=sequence_id, sequence_start=sequence_start,
@@ -382,6 +392,13 @@ class InferenceServerClient(InferenceServerClientBase):
             request_headers["Content-Type"] = "application/octet-stream"
         else:
             request_headers["Content-Type"] = "application/json"
+        if request_compression_algorithm:
+            body = compress_body(body, request_compression_algorithm)
+            request_headers["Content-Encoding"] = \
+                request_compression_algorithm
+        if response_compression_algorithm:
+            request_headers["Accept-Encoding"] = \
+                response_compression_algorithm
         path = ep.infer_path(model_name, model_version)
         if query_params:
             path += "?" + "&".join(
@@ -391,6 +408,8 @@ class InferenceServerClient(InferenceServerClientBase):
         status, resp_headers, payload = self._request(
             "POST", path, body=body, headers=request_headers
         )
+        payload = decompress_body(
+            payload, resp_headers.get("content-encoding"))
         ep.raise_if_error(status, payload)
         response_header_len = resp_headers.get(HEADER_LEN.lower())
         return InferResult.from_response_body(

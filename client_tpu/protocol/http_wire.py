@@ -33,6 +33,42 @@ from client_tpu.utils import (
 HEADER_LEN = "Inference-Header-Content-Length"
 
 
+# -- body compression (client and server sides) ----------------------------
+
+def compress_body(body: bytes, algorithm: str) -> bytes:
+    """gzip / deflate body compression ("deflate" is the zlib format,
+    per RFC 9110 §8.4.1)."""
+    if algorithm == "gzip":
+        import gzip
+
+        return gzip.compress(body)
+    if algorithm == "deflate":
+        import zlib
+
+        return zlib.compress(body)
+    raise InferenceServerException(
+        "unsupported compression algorithm '%s' (gzip or deflate)"
+        % algorithm
+    )
+
+
+def decompress_body(body: bytes, content_encoding: Optional[str]) -> bytes:
+    """Undoes Content-Encoding; identity/absent passes through."""
+    if not content_encoding or content_encoding == "identity":
+        return body
+    if content_encoding == "gzip":
+        import gzip
+
+        return gzip.decompress(body)
+    if content_encoding == "deflate":
+        import zlib
+
+        return zlib.decompress(body)
+    raise InferenceServerException(
+        "unsupported Content-Encoding '%s'" % content_encoding
+    )
+
+
 def _json_safe_param(value):
     if isinstance(value, (bool, int, float, str)):
         return value

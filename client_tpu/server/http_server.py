@@ -18,6 +18,7 @@ from google.protobuf import json_format
 from client_tpu.protocol import inference_pb2 as pb
 from client_tpu.protocol.http_wire import (
     HEADER_LEN,
+    compress_body,
     decode_infer_request,
     encode_infer_response,
 )
@@ -45,6 +46,25 @@ def _pb_json(message) -> web.Response:
     return web.json_response(
         json_format.MessageToDict(message, preserving_proto_field_name=True)
     )
+
+
+def _pick_encoding(accept_encoding: str) -> Optional[str]:
+    """First supported coding the client actually accepts: RFC 9110
+    token parsing, so 'gzip;q=0' (explicitly refused) or 'br' never
+    match (a bare substring test would)."""
+    for token in accept_encoding.split(","):
+        parts = token.strip().lower().split(";")
+        coding = parts[0].strip()
+        if coding not in ("gzip", "deflate"):
+            continue
+        refused = any(
+            p.strip().replace(" ", "") in ("q=0", "q=0.0", "q=0.00",
+                                           "q=0.000")
+            for p in parts[1:]
+        )
+        if not refused:
+            return coding
+    return None
 
 
 def build_http_app(core: InferenceServerCore) -> web.Application:
@@ -605,6 +625,8 @@ def build_http_app(core: InferenceServerCore) -> web.Application:
     async def infer(request):
         body = await request.read()
         header_length = request.headers.get(HEADER_LEN)
+        # Compressed request bodies (Content-Encoding gzip/deflate)
+        # are already decompressed by aiohttp's request parser.
         try:
             infer_request = decode_infer_request(
                 body,
@@ -630,6 +652,14 @@ def build_http_app(core: InferenceServerCore) -> web.Application:
             headers = {}
             if json_len is not None:
                 headers[HEADER_LEN] = str(json_len)
+            # Per-call response compression: honor the client's
+            # explicit Accept-Encoding preference (reference allows
+            # gzip/deflate per request).
+            algorithm = _pick_encoding(
+                request.headers.get("Accept-Encoding", ""))
+            if algorithm:
+                payload = compress_body(payload, algorithm)
+                headers["Content-Encoding"] = algorithm
             return web.Response(
                 body=payload,
                 headers=headers,

@@ -1,0 +1,101 @@
+#include "compression.h"
+
+#include <zlib.h>
+
+#include <vector>
+
+namespace tpuclient {
+
+namespace {
+
+// windowBits selects the format: 15 = zlib ("deflate" per RFC 9110),
+// 15+16 = gzip, 15+32 on inflate = auto-detect either.
+constexpr int kZlibWindow = 15;
+constexpr int kGzipWindow = 15 + 16;
+constexpr int kAutoWindow = 15 + 32;
+
+Error Deflate(const std::string& in, int window_bits, std::string* out) {
+  if (in.size() >= UINT32_MAX) {  // zlib avail_in is 32-bit
+    return Error("body too large to compress in one pass (>4GiB)");
+  }
+  z_stream stream{};
+  if (deflateInit2(&stream, Z_DEFAULT_COMPRESSION, Z_DEFLATED, window_bits,
+                   8, Z_DEFAULT_STRATEGY) != Z_OK) {
+    return Error("zlib deflateInit failed");
+  }
+  out->clear();
+  out->resize(deflateBound(&stream, in.size()));
+  stream.next_in =
+      reinterpret_cast<Bytef*>(const_cast<char*>(in.data()));
+  stream.avail_in = static_cast<uInt>(in.size());
+  stream.next_out = reinterpret_cast<Bytef*>(&(*out)[0]);
+  stream.avail_out = static_cast<uInt>(out->size());
+  int rc = deflate(&stream, Z_FINISH);
+  deflateEnd(&stream);
+  if (rc != Z_STREAM_END) return Error("zlib deflate failed");
+  out->resize(out->size() - stream.avail_out);
+  return Error::Success;
+}
+
+}  // namespace
+
+const char* CompressionName(CompressionType type) {
+  switch (type) {
+    case CompressionType::NONE: return "";
+    case CompressionType::DEFLATE: return "deflate";
+    case CompressionType::GZIP: return "gzip";
+  }
+  return "";
+}
+
+Error CompressBody(CompressionType type, const std::string& in,
+                   std::string* out) {
+  switch (type) {
+    case CompressionType::NONE:
+      *out = in;
+      return Error::Success;
+    case CompressionType::DEFLATE:
+      return Deflate(in, kZlibWindow, out);
+    case CompressionType::GZIP:
+      return Deflate(in, kGzipWindow, out);
+  }
+  return Error("unknown compression type");
+}
+
+Error DecompressBody(const std::string& encoding, const std::string& in,
+                     std::string* out) {
+  if (encoding.empty() || encoding == "identity") {
+    *out = in;
+    return Error::Success;
+  }
+  if (encoding != "gzip" && encoding != "deflate") {
+    return Error("unsupported Content-Encoding '" + encoding + "'");
+  }
+  if (in.size() >= UINT32_MAX) {  // zlib avail_in is 32-bit
+    return Error("body too large to decompress in one pass (>4GiB)");
+  }
+  z_stream stream{};
+  if (inflateInit2(&stream, kAutoWindow) != Z_OK) {
+    return Error("zlib inflateInit failed");
+  }
+  out->clear();
+  stream.next_in =
+      reinterpret_cast<Bytef*>(const_cast<char*>(in.data()));
+  stream.avail_in = static_cast<uInt>(in.size());
+  std::vector<char> buffer(64 * 1024);
+  int rc = Z_OK;
+  while (rc != Z_STREAM_END) {
+    stream.next_out = reinterpret_cast<Bytef*>(buffer.data());
+    stream.avail_out = static_cast<uInt>(buffer.size());
+    rc = inflate(&stream, Z_NO_FLUSH);
+    if (rc != Z_OK && rc != Z_STREAM_END) {
+      inflateEnd(&stream);
+      return Error("zlib inflate failed (corrupt body?)");
+    }
+    out->append(buffer.data(), buffer.size() - stream.avail_out);
+  }
+  inflateEnd(&stream);
+  return Error::Success;
+}
+
+}  // namespace tpuclient

@@ -1,0 +1,26 @@
+// Per-call HTTP body compression (parity: the reference client's
+// request/response_compression_algorithm args,
+// /root/reference/src/c++/library/http_client.cc:2130-2247 — there
+// implemented with libcurl+zlib; here plain zlib).
+#pragma once
+
+#include <string>
+
+#include "common.h"
+
+namespace tpuclient {
+
+enum class CompressionType { NONE, DEFLATE, GZIP };
+
+// Header token for Content-Encoding / Accept-Encoding ("" for NONE).
+const char* CompressionName(CompressionType type);
+
+// in -> compressed out ("deflate" = zlib format per RFC 9110).
+Error CompressBody(CompressionType type, const std::string& in,
+                   std::string* out);
+
+// Undoes a Content-Encoding ("gzip"/"deflate"; ""/"identity" copies).
+Error DecompressBody(const std::string& encoding, const std::string& in,
+                     std::string* out);
+
+}  // namespace tpuclient

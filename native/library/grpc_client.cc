@@ -1,6 +1,8 @@
 #include "grpc_client.h"
 
+#include <chrono>
 #include <cstring>
+#include <thread>
 
 namespace tpuclient {
 
@@ -158,12 +160,69 @@ Error InferResultGrpc::RequestStatus() const { return status_; }
 InferenceServerGrpcClient::InferenceServerGrpcClient(bool verbose)
     : InferenceServerClient(verbose) {}
 
+namespace {
+
+// URL-keyed channel cache (parity: GetStub's grpc_channel_stub_map_,
+// grpc_client.cc:50-152): up to max_share_count clients share one
+// HTTP/2 connection per URL before a fresh one is opened —
+// distributing clients over channels relieves per-connection stream
+// concurrency limits.
+std::map<std::string, std::pair<size_t, std::shared_ptr<GrpcChannel>>>
+    g_channel_cache;
+std::mutex g_channel_cache_mutex;
+
+Error GetChannel(
+    const std::string& url, bool use_cached_channel, bool* shared,
+    std::shared_ptr<GrpcChannel>* out) {
+  *shared = false;
+  if (!use_cached_channel) {
+    return GrpcChannel::Create(out, url);
+  }
+  std::lock_guard<std::mutex> lock(g_channel_cache_mutex);
+  static const size_t max_share_count = []() {
+    const char* env = getenv("TPUCLIENT_GRPC_CHANNEL_MAX_SHARE_COUNT");
+    size_t count = env != nullptr ? strtoull(env, nullptr, 10) : 0;
+    return count != 0 ? count : 6;
+  }();
+  auto it = g_channel_cache.find(url);
+  if (it != g_channel_cache.end() &&
+      it->second.first % max_share_count != 0 &&
+      it->second.second->IsConnected()) {
+    it->second.first++;
+    *out = it->second.second;
+    *shared = true;
+    return Error::Success;
+  }
+  std::shared_ptr<GrpcChannel> channel;
+  Error err = GrpcChannel::Create(&channel, url);
+  if (!err.IsOk()) return err;
+  g_channel_cache[url] = {1, channel};
+  *out = channel;
+  *shared = true;
+  return Error::Success;
+}
+
+}  // namespace
+
 InferenceServerGrpcClient::~InferenceServerGrpcClient() {
   StopStream();
-  // Fail all in-flight async calls now, while completed_ is still
-  // alive to receive their results; the dispatch worker then drains
-  // the queue before exiting (members destruct after the join).
-  if (channel_) channel_->Shutdown();
+  if (channel_shared_) {
+    // The connection belongs to the cache and other clients: wait for
+    // our own in-flight calls to complete instead of shutting it
+    // down (their callbacks reference this object). A wedged call
+    // past the grace period forces Shutdown anyway — a connection
+    // stuck for 30s is broken for every sharer, and Shutdown
+    // synchronously fails the calls so the wait below terminates.
+    if (!inflight_->WaitZero(std::chrono::seconds(30)) && channel_) {
+      channel_->Shutdown();
+      inflight_->WaitZero(std::chrono::seconds(30));
+    }
+  } else if (channel_) {
+    // Sole owner: fail all in-flight async calls now, while
+    // completed_ is still alive to receive their results; the
+    // dispatch worker then drains the queue before exiting.
+    channel_->Shutdown();
+  }
   {
     std::lock_guard<std::mutex> lock(mutex_);
     exiting_ = true;
@@ -174,9 +233,10 @@ InferenceServerGrpcClient::~InferenceServerGrpcClient() {
 
 Error InferenceServerGrpcClient::Create(
     std::unique_ptr<InferenceServerGrpcClient>* client,
-    const std::string& url, bool verbose) {
+    const std::string& url, bool verbose, bool use_cached_channel) {
   client->reset(new InferenceServerGrpcClient(verbose));
-  Error err = GrpcChannel::Create(&(*client)->channel_, url);
+  Error err = GetChannel(url, use_cached_channel,
+                         &(*client)->channel_shared_, &(*client)->channel_);
   if (!err.IsOk()) client->reset();
   return err;
 }
@@ -500,9 +560,14 @@ Error InferenceServerGrpcClient::AsyncInfer(
   if (!request.SerializeToString(&request_bytes)) {
     return Error("failed to serialize request");
   }
-  return channel_->AsyncUnaryCall(
+  inflight_->Add();
+  // The tracker shared_ptr keeps the "done" signal alive even if the
+  // callback fires after this client object is destroyed; every
+  // access to client members happens BEFORE tracker->Sub().
+  auto tracker = inflight_;
+  Error call_err = channel_->AsyncUnaryCall(
       Method("ModelInfer"), request_bytes,
-      [this, callback](
+      [this, callback, tracker](
           const Error& status, std::string&& response_bytes,
           const RequestTimers& timers) {
         auto response = std::make_shared<inference::ModelInferResponse>();
@@ -519,8 +584,11 @@ Error InferenceServerGrpcClient::AsyncInfer(
           completed_.push_back({callback, result});
         }
         cv_.notify_all();
+        tracker->Sub();  // last: no member access beyond this point
       },
       options.client_timeout_us, headers);
+  if (!call_err.IsOk()) inflight_->Sub();
+  return call_err;
 }
 
 Error InferenceServerGrpcClient::InferMulti(

@@ -17,8 +17,11 @@
 #pragma once
 
 #include <atomic>
+#include <chrono>
+#include <condition_variable>
 #include <deque>
 #include <memory>
+#include <mutex>
 
 #include "client_tpu/protocol/inference.pb.h"
 #include "common.h"
@@ -88,9 +91,14 @@ class InferenceServerGrpcClient : public InferenceServerClient {
   ~InferenceServerGrpcClient() override;
 
   // url is "host:port" (no scheme), like the reference.
+  // use_cached_channel shares one HTTP/2 connection among up to
+  // TPUCLIENT_GRPC_CHANNEL_MAX_SHARE_COUNT (default 6) clients per
+  // URL before opening the next one (parity: GetStub channel cache,
+  // grpc_client.cc:50-152).
   static Error Create(
       std::unique_ptr<InferenceServerGrpcClient>* client,
-      const std::string& url, bool verbose = false);
+      const std::string& url, bool verbose = false,
+      bool use_cached_channel = true);
 
   Error IsServerLive(bool* live, const Headers& headers = {});
   Error IsServerReady(bool* ready, const Headers& headers = {});
@@ -202,6 +210,11 @@ class InferenceServerGrpcClient : public InferenceServerClient {
 
   void DispatchLoop();
 
+ public:
+  // Connection identity, for tests/diagnostics of channel sharing.
+  const GrpcChannel* RawChannel() const { return channel_.get(); }
+
+ private:
   std::shared_ptr<GrpcChannel> channel_;
 
   // Completed async results waiting for user-callback dispatch (the
@@ -213,6 +226,38 @@ class InferenceServerGrpcClient : public InferenceServerClient {
   };
   std::deque<Completed> completed_;
   std::atomic<bool> dispatch_started_{false};
+  // True when channel_ came from the URL-keyed cache: the destructor
+  // must then WAIT for this client's in-flight calls instead of
+  // shutting the (shared) connection down under other clients.
+  // The tracker is shared into every async callback so its final
+  // "done" signal never touches freed client members (the callback
+  // may fire on the shared connection's reader thread after this
+  // client is gone).
+  struct InflightTracker {
+    std::mutex mu;
+    std::condition_variable cv;
+    size_t count = 0;
+
+    void Add() {
+      std::lock_guard<std::mutex> lock(mu);
+      ++count;
+    }
+    void Sub() {
+      {
+        std::lock_guard<std::mutex> lock(mu);
+        --count;
+      }
+      cv.notify_all();
+    }
+    template <typename Rep, typename Period>
+    bool WaitZero(const std::chrono::duration<Rep, Period>& timeout) {
+      std::unique_lock<std::mutex> lock(mu);
+      return cv.wait_for(lock, timeout, [this] { return count == 0; });
+    }
+  };
+  bool channel_shared_ = false;
+  std::shared_ptr<InflightTracker> inflight_ =
+      std::make_shared<InflightTracker>();
 
   // Streaming state.
   std::unique_ptr<GrpcBidiStream> bidi_stream_;

@@ -308,6 +308,10 @@ Error InferenceServerHttpClient::DoRequest(
             ? strtoull(it->second.c_str(), nullptr, 10)
             : 0;
   }
+  auto enc = response.headers.find("content-encoding");
+  if (enc != response.headers.end()) {
+    return DecompressBody(enc->second, response.body, response_body);
+  }
   *response_body = std::move(response.body);
   return Error::Success;
 }
@@ -687,7 +691,9 @@ Error InferenceServerHttpClient::Infer(
     InferResult** result, const InferOptions& options,
     const std::vector<InferInput*>& inputs,
     const std::vector<const InferRequestedOutput*>& outputs,
-    const Headers& headers, const Parameters& query_params) {
+    const Headers& headers, const Parameters& query_params,
+    CompressionType request_compression,
+    CompressionType response_compression) {
   RequestTimers timers;
   timers.CaptureTimestamp(RequestTimers::Kind::REQUEST_START);
 
@@ -696,6 +702,18 @@ Error InferenceServerHttpClient::Infer(
   Error err = GenerateRequestBodyStr(&body, &header_length, options, inputs,
                                      outputs);
   if (!err.IsOk()) return err;
+
+  Headers call_headers = headers;
+  if (request_compression != CompressionType::NONE) {
+    std::string compressed;
+    err = CompressBody(request_compression, body, &compressed);
+    if (!err.IsOk()) return err;
+    body = std::move(compressed);
+    call_headers["Content-Encoding"] = CompressionName(request_compression);
+  }
+  if (response_compression != CompressionType::NONE) {
+    call_headers["Accept-Encoding"] = CompressionName(response_compression);
+  }
 
   std::string path = AppendQuery(
       ModelPath(options.model_name, options.model_version) + "/infer",
@@ -708,7 +726,7 @@ Error InferenceServerHttpClient::Infer(
   {
     std::lock_guard<std::mutex> lk(sync_mutex_);
     err = DoRequest(
-        "POST", path, body, headers,
+        "POST", path, body, call_headers,
         "application/octet-stream", header_length, &response_body,
         &response_header_length, sync_conn_.get(), options.client_timeout_us,
         &sent_ns);
@@ -792,6 +810,12 @@ void InferenceServerHttpClient::AsyncWorkerLoop() {
     if (it != response.headers.end()) {
       response_header_length = strtoull(it->second.c_str(), nullptr, 10);
     }
+    auto enc = response.headers.find("content-encoding");
+    if (err.IsOk() && enc != response.headers.end()) {
+      std::string plain;
+      err = DecompressBody(enc->second, response.body, &plain);
+      if (err.IsOk()) response.body = std::move(plain);
+    }
     InferResult* result = nullptr;
     InferResultHttp::Create(
         &result, std::move(response.body), response_header_length, err);
@@ -806,7 +830,9 @@ Error InferenceServerHttpClient::AsyncInfer(
     OnCompleteFn callback, const InferOptions& options,
     const std::vector<InferInput*>& inputs,
     const std::vector<const InferRequestedOutput*>& outputs,
-    const Headers& headers, const Parameters& query_params) {
+    const Headers& headers, const Parameters& query_params,
+    CompressionType request_compression,
+    CompressionType response_compression) {
   if (callback == nullptr) {
     return Error("callback must not be null for AsyncInfer");
   }
@@ -823,6 +849,16 @@ Error InferenceServerHttpClient::AsyncInfer(
       query_params);
   req->header_length = header_length;
   req->headers = headers;
+  if (request_compression != CompressionType::NONE) {
+    std::string compressed;
+    err = CompressBody(request_compression, req->body, &compressed);
+    if (!err.IsOk()) return err;
+    req->body = std::move(compressed);
+    req->headers["Content-Encoding"] = CompressionName(request_compression);
+  }
+  if (response_compression != CompressionType::NONE) {
+    req->headers["Accept-Encoding"] = CompressionName(response_compression);
+  }
   req->timeout_us = options.client_timeout_us;
   req->callback = std::move(callback);
 

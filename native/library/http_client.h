@@ -24,6 +24,7 @@
 #include <vector>
 
 #include "common.h"
+#include "compression.h"
 #include "json.h"
 #include "tls.h"
 
@@ -155,17 +156,24 @@ class InferenceServerHttpClient : public InferenceServerClient {
   Error UnregisterTpuSharedMemory(
       const std::string& name = "", const Headers& headers = {});
 
+  // Compression args select per-call gzip/deflate on the request
+  // body and (via Accept-Encoding) the response body (parity:
+  // http_client.cc:2130-2247).
   Error Infer(
       InferResult** result, const InferOptions& options,
       const std::vector<InferInput*>& inputs,
       const std::vector<const InferRequestedOutput*>& outputs = {},
-      const Headers& headers = {}, const Parameters& query_params = {});
+      const Headers& headers = {}, const Parameters& query_params = {},
+      CompressionType request_compression = CompressionType::NONE,
+      CompressionType response_compression = CompressionType::NONE);
 
   Error AsyncInfer(
       OnCompleteFn callback, const InferOptions& options,
       const std::vector<InferInput*>& inputs,
       const std::vector<const InferRequestedOutput*>& outputs = {},
-      const Headers& headers = {}, const Parameters& query_params = {});
+      const Headers& headers = {}, const Parameters& query_params = {},
+      CompressionType request_compression = CompressionType::NONE,
+      CompressionType response_compression = CompressionType::NONE);
 
   Error InferMulti(
       std::vector<InferResult*>* results,

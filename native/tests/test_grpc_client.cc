@@ -285,4 +285,38 @@ TEST_CASE("grpc-live: model statistics and concurrency limit") {
   CHECK(stats.model_stats_size() >= 1);
 }
 
+TEST_CASE("grpc-live: channel cache shares connections per URL") {
+  if (ServerUrl() == nullptr) return;
+  // Default max share count is 6: the first six clients ride one
+  // connection, the seventh opens a new one (parity: GetStub,
+  // grpc_client.cc:50-152). The cache is URL-string-keyed and other
+  // cases already used the bare URL, so take a fresh alias (the
+  // transport strips the scheme).
+  const std::string url = std::string("sharetest://") + ServerUrl();
+  std::vector<std::unique_ptr<InferenceServerGrpcClient>> clients;
+  for (int i = 0; i < 7; ++i) {
+    std::unique_ptr<InferenceServerGrpcClient> c;
+    REQUIRE_OK(InferenceServerGrpcClient::Create(
+        &c, url, /*verbose=*/false, /*use_cached_channel=*/true));
+    clients.push_back(std::move(c));
+  }
+  for (int i = 1; i < 6; ++i) {
+    CHECK_EQ(clients[0]->RawChannel(), clients[i]->RawChannel());
+  }
+  CHECK(clients[6]->RawChannel() != clients[0]->RawChannel());
+
+  // Opting out always gets a private connection.
+  std::unique_ptr<InferenceServerGrpcClient> solo;
+  REQUIRE_OK(InferenceServerGrpcClient::Create(
+      &solo, ServerUrl(), false, /*use_cached_channel=*/false));
+  CHECK(solo->RawChannel() != clients[6]->RawChannel());
+
+  // Shared-channel clients still serve traffic correctly.
+  for (auto& c : clients) {
+    bool live = false;
+    REQUIRE_OK(c->IsServerLive(&live));
+    CHECK(live);
+  }
+}
+
 MINITEST_MAIN

@@ -208,6 +208,28 @@ TEST_CASE("http: integration against live server") {
   REQUIRE_OK(client->ClientInferStat(&stat));
   CHECK(stat.completed_request_count >= 9);
 
+  // Per-call compression: every request/response algorithm pairing
+  // round-trips (parity: http_client.cc:2130-2247).
+  for (CompressionType req_alg :
+       {CompressionType::NONE, CompressionType::DEFLATE,
+        CompressionType::GZIP}) {
+    for (CompressionType resp_alg :
+         {CompressionType::NONE, CompressionType::DEFLATE,
+          CompressionType::GZIP}) {
+      InferResult* zres = nullptr;
+      REQUIRE_OK(client->Infer(&zres, options, {in0.get(), in1.get()}, {},
+                               {}, {}, req_alg, resp_alg));
+      std::unique_ptr<InferResult> zguard(zres);
+      REQUIRE_OK(zres->RequestStatus());
+      const uint8_t* zbuf;
+      size_t zlen;
+      REQUIRE_OK(zres->RawData("OUTPUT0", &zbuf, &zlen));
+      REQUIRE(zlen == 64u);
+      const int32_t* zsums = reinterpret_cast<const int32_t*>(zbuf);
+      for (int i = 0; i < 16; ++i) CHECK_EQ(zsums[i], data0[i] + 1);
+    }
+  }
+
   // Error mapping: unknown model -> HTTP error with server message.
   InferOptions bad("no_such_model");
   InferResult* bad_result = nullptr;

@@ -1,0 +1,91 @@
+"""Per-call HTTP body compression (gzip/deflate on request and
+response), mirroring the reference HTTP client's
+request/response_compression_algorithm args (http_client.cc:2130-2247).
+"""
+
+import gzip
+import zlib
+
+import numpy as np
+import pytest
+
+import client_tpu.http as httpclient
+from client_tpu.protocol.http_wire import compress_body, decompress_body
+
+
+@pytest.fixture(scope="module")
+def http_server():
+    from client_tpu.server.app import build_core
+    from client_tpu.server.http_server import start_http_server_thread
+
+    core = build_core(["simple"])
+    runner = start_http_server_thread(core, host="127.0.0.1", port=0)
+    yield "127.0.0.1:%d" % runner.port
+    runner.stop()
+
+
+def _make_inputs():
+    in0 = np.arange(16, dtype=np.int32)
+    in1 = np.ones(16, dtype=np.int32)
+    inputs = [
+        httpclient.InferInput("INPUT0", [16], "INT32"),
+        httpclient.InferInput("INPUT1", [16], "INT32"),
+    ]
+    inputs[0].set_data_from_numpy(in0)
+    inputs[1].set_data_from_numpy(in1)
+    return inputs, in0, in1
+
+
+def test_body_helpers_round_trip():
+    payload = b"x" * 4096
+    assert decompress_body(compress_body(payload, "gzip"), "gzip") == payload
+    assert decompress_body(
+        compress_body(payload, "deflate"), "deflate") == payload
+    assert gzip.decompress(compress_body(payload, "gzip")) == payload
+    assert zlib.decompress(compress_body(payload, "deflate")) == payload
+    assert decompress_body(payload, None) == payload
+    assert decompress_body(payload, "identity") == payload
+
+
+@pytest.mark.parametrize("algorithm", ["gzip", "deflate"])
+def test_request_compression_round_trip(http_server, algorithm):
+    with httpclient.InferenceServerClient(http_server) as client:
+        inputs, in0, in1 = _make_inputs()
+        result = client.infer(
+            "simple", inputs,
+            request_compression_algorithm=algorithm)
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), in0 + in1)
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT1"), in0 - in1)
+
+
+@pytest.mark.parametrize("algorithm", ["gzip", "deflate"])
+def test_response_compression_round_trip(http_server, algorithm):
+    with httpclient.InferenceServerClient(http_server) as client:
+        inputs, in0, in1 = _make_inputs()
+        result = client.infer(
+            "simple", inputs,
+            response_compression_algorithm=algorithm)
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), in0 + in1)
+
+
+def test_accept_encoding_token_parsing():
+    from client_tpu.server.http_server import _pick_encoding
+
+    assert _pick_encoding("gzip") == "gzip"
+    assert _pick_encoding("deflate, gzip") == "deflate"
+    assert _pick_encoding("identity, gzip;q=0") is None  # refused
+    assert _pick_encoding("gzip;q=0.5, deflate;q=0") == "gzip"
+    assert _pick_encoding("br") is None  # unsupported coding
+    assert _pick_encoding("") is None
+    assert _pick_encoding("GZIP") == "gzip"  # codings are case-insensitive
+
+
+def test_both_directions_compressed(http_server):
+    with httpclient.InferenceServerClient(http_server) as client:
+        inputs, in0, in1 = _make_inputs()
+        result = client.infer(
+            "simple", inputs,
+            request_compression_algorithm="gzip",
+            response_compression_algorithm="deflate")
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), in0 + in1)
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT1"), in0 - in1)
