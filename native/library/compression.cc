@@ -23,8 +23,13 @@ Error Deflate(const std::string& in, int window_bits, std::string* out) {
                    8, Z_DEFAULT_STRATEGY) != Z_OK) {
     return Error("zlib deflateInit failed");
   }
+  uLong bound = deflateBound(&stream, in.size());
+  if (bound >= UINT32_MAX) {  // avail_out is 32-bit too
+    deflateEnd(&stream);
+    return Error("body too large to compress in one pass (>4GiB)");
+  }
   out->clear();
-  out->resize(deflateBound(&stream, in.size()));
+  out->resize(bound);
   stream.next_in =
       reinterpret_cast<Bytef*>(const_cast<char*>(in.data()));
   stream.avail_in = static_cast<uInt>(in.size());

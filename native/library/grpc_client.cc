@@ -209,11 +209,12 @@ InferenceServerGrpcClient::~InferenceServerGrpcClient() {
   if (channel_shared_) {
     // The connection belongs to the cache and other clients: wait for
     // our own in-flight calls to complete instead of shutting it
-    // down (their callbacks reference this object). A wedged call
-    // past the grace period forces Shutdown anyway — a connection
-    // stuck for 30s is broken for every sharer, and Shutdown
-    // synchronously fails the calls so the wait below terminates.
-    if (!inflight_->WaitZero(std::chrono::seconds(30)) && channel_) {
+    // down (their callbacks reference this object). The wait is
+    // instant when nothing is in flight — the common case. A wedged
+    // call past the short grace forces Shutdown anyway: a connection
+    // that cannot answer for 5s is broken for every sharer, and
+    // Shutdown synchronously fails the calls so the wait terminates.
+    if (!inflight_->WaitZero(std::chrono::seconds(5)) && channel_) {
       channel_->Shutdown();
       inflight_->WaitZero(std::chrono::seconds(30));
     }

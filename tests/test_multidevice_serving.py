@@ -1,0 +1,130 @@
+"""Serving a sharded model through the server + TPU-shm (arena) path
+on a multi-device mesh (the conftest provides a virtual 8-device CPU
+mesh). Round-2 gap: the LLM accepted a mesh but nothing ever served a
+tp-sharded model in serving position."""
+
+import numpy as np
+import pytest
+
+import client_tpu.grpc as grpcclient
+from client_tpu.utils import serialize_byte_tensor
+
+
+@pytest.fixture(scope="module")
+def sharded_server():
+    import jax
+
+    from client_tpu.models.llm import LlmConfig, LlmModel
+    from client_tpu.parallel import create_mesh
+    from client_tpu.server.app import build_core, start_grpc_server
+
+    devices = jax.devices()
+    assert len(devices) >= 8, "conftest should provide 8 CPU devices"
+    # tp=2 divides n_kv_heads=2 (the tightest sharded dim)
+    mesh = create_mesh((("dp", 2), ("sp", 1), ("tp", 2)),
+                       devices=devices[:4])
+    cfg = LlmConfig(vocab=264, d_model=64, n_layers=2, n_heads=4,
+                    n_kv_heads=2, d_ff=128, max_seq=64)
+    core = build_core([])
+    model = LlmModel(name="llm_sharded", cfg=cfg, mesh=mesh)
+    core.repository.add_model(model)
+    handle = start_grpc_server(core=core)
+    yield {"core": core, "address": handle.address, "mesh": mesh,
+           "model": model}
+    handle.stop()
+
+
+def test_params_actually_sharded(sharded_server):
+    """The served model's parameters live on all mesh devices."""
+    import jax
+
+    params = sharded_server["model"]._params
+    leaves = jax.tree.leaves(params)
+    sharded = [
+        leaf for leaf in leaves
+        if hasattr(leaf, "sharding") and len(leaf.sharding.device_set) > 1
+    ]
+    assert sharded, "no parameter is sharded across the mesh"
+
+
+def test_sharded_model_serves_over_grpc(sharded_server):
+    with grpcclient.InferenceServerClient(
+            sharded_server["address"]) as client:
+        inputs = [
+            grpcclient.InferInput("text_input", [1], "BYTES"),
+            grpcclient.InferInput("max_tokens", [1], "INT32"),
+        ]
+        inputs[0].set_data_from_numpy(
+            np.array([b"hello"], dtype=np.object_))
+        inputs[1].set_data_from_numpy(np.array([4], dtype=np.int32))
+        responses = []
+        client.start_stream(
+            callback=lambda result, error: responses.append((result, error)))
+        client.async_stream_infer("llm_sharded", inputs)
+        import time
+
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            final = [
+                r for r, e in responses
+                if r is not None and r.get_response().parameters.get(
+                    "triton_final_response") is not None
+            ]
+            if final or any(e is not None for _, e in responses):
+                break
+            time.sleep(0.2)
+        client.stop_stream()
+        errors = [e for _, e in responses if e is not None]
+        assert not errors, errors[0]
+        texts = [r.as_numpy("text_output") for r, _ in responses
+                 if r is not None and r.as_numpy("text_output") is not None]
+        assert texts, "no streamed tokens from the sharded model"
+
+
+def test_sharded_model_serves_through_arena(sharded_server):
+    """TPU-shm path with a sharded model: input rides an arena region,
+    output lands back in one by reference."""
+    core = sharded_server["core"]
+    arena = core.memory.arena
+    if arena is None:
+        pytest.skip("no arena on this platform")
+    payload = serialize_byte_tensor(
+        np.array([b"hi"], dtype=np.object_)).tobytes()
+    in_handle = arena.create_region(max(len(payload), 64), 0)
+    from client_tpu.protocol import inference_pb2 as pb
+
+    core.memory.register_tpu("llm_in", in_handle, 0, max(len(payload), 64))
+    out_handle = arena.create_region(4096, 0)
+    core.memory.register_tpu("llm_out", out_handle, 0, 4096)
+    try:
+        # place the serialized BYTES tensor into the input region
+        region = core.memory._get("llm_in")
+        arena.write(region.region_id, 0, payload, "BYTES", [1])
+
+        request = pb.ModelInferRequest(model_name="llm_sharded")
+        tensor = request.inputs.add()
+        tensor.name = "text_input"
+        tensor.datatype = "BYTES"
+        tensor.shape.extend([1])
+        tensor.parameters["shared_memory_region"].string_param = "llm_in"
+        tensor.parameters["shared_memory_byte_size"].int64_param = len(
+            payload)
+        mt = request.inputs.add()
+        mt.name = "max_tokens"
+        mt.datatype = "INT32"
+        mt.shape.extend([1])
+        request.raw_input_contents.append(
+            np.array([2], dtype=np.int32).tobytes())
+        out = request.outputs.add()
+        out.name = "text_output"
+        out.parameters["shared_memory_region"].string_param = "llm_out"
+        out.parameters["shared_memory_byte_size"].int64_param = 4096
+
+        responses = list(core.stream_infer(request))
+        assert responses, "no responses from sharded stream via arena"
+        # outputs were placed into the region by reference: read back
+        out_region = core.memory._get("llm_out")
+        data = arena.read(out_region.region_id, 0, 0)
+        assert data, "output region is empty"
+    finally:
+        core.memory.unregister_tpu(None)
