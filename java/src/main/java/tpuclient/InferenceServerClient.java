@@ -22,10 +22,20 @@ import java.util.List;
 import java.util.Map;
 import java.util.concurrent.CompletableFuture;
 
+import tpuclient.endpoint.AbstractEndpoint;
+import tpuclient.endpoint.FixedEndpoint;
+
 public class InferenceServerClient implements AutoCloseable {
-  private final String baseUrl;
+  private final AbstractEndpoint endpoint;
   private final HttpClient http;
   private final Duration requestTimeout;
+  // Connection-level failures on the synchronous infer() path retry
+  // up to retryCnt additional attempts; each attempt re-resolves the
+  // endpoint, so multi-address endpoints fail over naturally (parity:
+  // InferenceServerClient.java:245,293). Timeouts do NOT retry — the
+  // server may already be executing the request — and asyncInfer()
+  // is single-attempt like the reference's async path.
+  private volatile int retryCnt = 3;
 
   /** url is "host:port" (no scheme), like the reference. */
   public InferenceServerClient(String url) {
@@ -34,12 +44,34 @@ public class InferenceServerClient implements AutoCloseable {
 
   public InferenceServerClient(String url, Duration connectTimeout,
                                Duration requestTimeout) {
-    this.baseUrl = "http://" + url;
+    this(new FixedEndpoint(url), connectTimeout, requestTimeout);
+  }
+
+  public InferenceServerClient(AbstractEndpoint endpoint) {
+    this(endpoint, Duration.ofSeconds(30), Duration.ofSeconds(60));
+  }
+
+  public InferenceServerClient(AbstractEndpoint endpoint,
+                               Duration connectTimeout,
+                               Duration requestTimeout) {
+    this.endpoint = endpoint;
     this.requestTimeout = requestTimeout;
     this.http = HttpClient.newBuilder()
         .version(HttpClient.Version.HTTP_1_1)
         .connectTimeout(connectTimeout)
         .build();
+  }
+
+  /** Extra attempts after a transport failure (0 = fail fast). */
+  public void setRetryCnt(int retryCnt) {
+    if (retryCnt < 0) {
+      throw new IllegalArgumentException("retryCnt must be >= 0");
+    }
+    this.retryCnt = retryCnt;
+  }
+
+  private String baseUrl() throws InferenceException {
+    return "http://" + endpoint.next();
   }
 
   @Override
@@ -140,13 +172,30 @@ public class InferenceServerClient implements AutoCloseable {
   public InferResult infer(String modelName, List<InferInput> inputs,
                            List<InferRequestedOutput> outputs)
       throws InferenceException {
-    HttpRequest request = buildInferRequest(modelName, inputs, outputs);
-    try {
-      HttpResponse<byte[]> response =
-          http.send(request, HttpResponse.BodyHandlers.ofByteArray());
-      return parseInferResponse(response);
-    } catch (IOException | InterruptedException e) {
-      throw new InferenceException("infer request failed", e);
+    WireBody wire = buildInferBody(inputs, outputs);
+    // Bounded retry on transport failures; the request is rebuilt per
+    // attempt so a rotating endpoint fails over to the next host.
+    for (int attempt = 0; ; attempt++) {
+      HttpRequest request = buildInferRequest(modelName, wire);
+      try {
+        HttpResponse<byte[]> response =
+            http.send(request, HttpResponse.BodyHandlers.ofByteArray());
+        return parseInferResponse(response);
+      } catch (InterruptedException e) {
+        Thread.currentThread().interrupt();
+        throw new InferenceException("infer request interrupted", e);
+      } catch (java.net.http.HttpTimeoutException e) {
+        // The server may already be executing this non-idempotent
+        // request: a retry would duplicate the inference.
+        throw new InferenceException(
+            "infer timed out, url: " + request.uri(), e);
+      } catch (IOException e) {
+        if (attempt >= retryCnt) {
+          throw new InferenceException(
+              "infer failed after " + (attempt + 1) + " attempt(s), url: "
+              + request.uri(), e);
+        }
+      }
     }
   }
 
@@ -154,7 +203,8 @@ public class InferenceServerClient implements AutoCloseable {
   public CompletableFuture<InferResult> asyncInfer(
       String modelName, List<InferInput> inputs,
       List<InferRequestedOutput> outputs) throws InferenceException {
-    HttpRequest request = buildInferRequest(modelName, inputs, outputs);
+    HttpRequest request =
+        buildInferRequest(modelName, buildInferBody(inputs, outputs));
     return http.sendAsync(request, HttpResponse.BodyHandlers.ofByteArray())
         .thenApply(response -> {
           try {
@@ -217,12 +267,10 @@ public class InferenceServerClient implements AutoCloseable {
     return new WireBody(body.array(), headerBytes.length);
   }
 
-  private HttpRequest buildInferRequest(
-      String modelName, List<InferInput> inputs,
-      List<InferRequestedOutput> outputs) throws InferenceException {
-    WireBody wire = buildInferBody(inputs, outputs);
+  private HttpRequest buildInferRequest(String modelName, WireBody wire)
+      throws InferenceException {
     return HttpRequest.newBuilder()
-        .uri(URI.create(baseUrl + "/v2/models/" + modelName + "/infer"))
+        .uri(URI.create(baseUrl() + "/v2/models/" + modelName + "/infer"))
         .timeout(requestTimeout)
         .header("Content-Type", "application/octet-stream")
         .header("Inference-Header-Content-Length",
@@ -250,7 +298,7 @@ public class InferenceServerClient implements AutoCloseable {
   private int getStatus(String path) throws InferenceException {
     try {
       HttpRequest request = HttpRequest.newBuilder()
-          .uri(URI.create(baseUrl + path))
+          .uri(URI.create(baseUrl() + path))
           .timeout(requestTimeout)
           .GET()
           .build();
@@ -264,7 +312,7 @@ public class InferenceServerClient implements AutoCloseable {
   private String get(String path) throws InferenceException {
     try {
       HttpRequest request = HttpRequest.newBuilder()
-          .uri(URI.create(baseUrl + path))
+          .uri(URI.create(baseUrl() + path))
           .timeout(requestTimeout)
           .GET()
           .build();
@@ -283,7 +331,7 @@ public class InferenceServerClient implements AutoCloseable {
   private String post(String path, String body) throws InferenceException {
     try {
       HttpRequest request = HttpRequest.newBuilder()
-          .uri(URI.create(baseUrl + path))
+          .uri(URI.create(baseUrl() + path))
           .timeout(requestTimeout)
           .header("Content-Type", "application/json")
           .POST(HttpRequest.BodyPublishers.ofString(body))

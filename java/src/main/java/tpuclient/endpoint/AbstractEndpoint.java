@@ -1,0 +1,22 @@
+// Endpoint abstraction: where the client sends each request (parity:
+// the reference's triton/client/endpoint/AbstractEndpoint.java, which
+// lets discovery-backed strategies hand out addresses per request).
+package tpuclient.endpoint;
+
+import tpuclient.InferenceException;
+
+/**
+ * Supplies a "host:port[/path]" address for each outgoing request.
+ * Implementations may rotate over multiple serving hosts (the
+ * multi-host TPU serving case) or resolve dynamically from a
+ * discovery service; {@code next()} is called once per request, so a
+ * retry after a transport failure naturally lands on the next
+ * address.
+ */
+public abstract class AbstractEndpoint {
+  /** Next address to use, in host:port[/path] form (no scheme). */
+  public abstract String next() throws InferenceException;
+
+  /** Number of distinct addresses behind this endpoint (>= 1). */
+  public abstract int size() throws InferenceException;
+}

@@ -1,0 +1,32 @@
+// Single-address endpoint (parity: the reference's
+// triton/client/endpoint/FixedEndpoint.java).
+package tpuclient.endpoint;
+
+import tpuclient.InferenceException;
+
+/** Endpoint pinned to one address. */
+public class FixedEndpoint extends AbstractEndpoint {
+  private final String address;
+
+  /** address is "host:port[/path]" without a scheme. */
+  public FixedEndpoint(String address) {
+    if (address == null || address.isEmpty()) {
+      throw new IllegalArgumentException("address must not be empty");
+    }
+    if (address.contains("://")) {
+      throw new IllegalArgumentException(
+          "address must be host:port[/path] without a scheme");
+    }
+    this.address = address;
+  }
+
+  @Override
+  public String next() {
+    return address;
+  }
+
+  @Override
+  public int size() {
+    return 1;
+  }
+}

@@ -1,0 +1,40 @@
+// Rotating multi-address endpoint: each request (and each retry) goes
+// to the next serving host — the client-side face of multi-host TPU
+// serving (the reference's AbstractEndpoint exists for exactly this
+// kind of strategy; it ships only the fixed one).
+package tpuclient.endpoint;
+
+import java.util.ArrayList;
+import java.util.List;
+import java.util.concurrent.atomic.AtomicInteger;
+
+/** Endpoint cycling over a fixed list of addresses. */
+public class RoundRobinEndpoint extends AbstractEndpoint {
+  private final List<String> addresses;
+  private final AtomicInteger cursor = new AtomicInteger();
+
+  /** addresses are "host:port[/path]" without schemes. */
+  public RoundRobinEndpoint(List<String> addresses) {
+    if (addresses == null || addresses.isEmpty()) {
+      throw new IllegalArgumentException("need at least one address");
+    }
+    for (String address : addresses) {
+      if (address.contains("://")) {
+        throw new IllegalArgumentException(
+            "addresses must be host:port[/path] without a scheme");
+      }
+    }
+    this.addresses = new ArrayList<>(addresses);
+  }
+
+  @Override
+  public String next() {
+    int index = Math.floorMod(cursor.getAndIncrement(), addresses.size());
+    return addresses.get(index);
+  }
+
+  @Override
+  public int size() {
+    return addresses.size();
+  }
+}

@@ -210,11 +210,13 @@ InferenceServerGrpcClient::~InferenceServerGrpcClient() {
     // The connection belongs to the cache and other clients: wait for
     // our own in-flight calls to complete instead of shutting it
     // down (their callbacks reference this object). The wait is
-    // instant when nothing is in flight — the common case. A wedged
-    // call past the short grace forces Shutdown anyway: a connection
-    // that cannot answer for 5s is broken for every sharer, and
-    // Shutdown synchronously fails the calls so the wait terminates.
-    if (!inflight_->WaitZero(std::chrono::seconds(5)) && channel_) {
+    // instant when nothing is in flight — the common case. A call
+    // still pending after the grace (generous: past normal inference
+    // latency, including long LLM generations) forces Shutdown — a
+    // connection that cannot answer for that long is broken for every
+    // sharer, and Shutdown synchronously fails the calls so the wait
+    // terminates.
+    if (!inflight_->WaitZero(std::chrono::seconds(30)) && channel_) {
       channel_->Shutdown();
       inflight_->WaitZero(std::chrono::seconds(30));
     }

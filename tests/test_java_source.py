@@ -76,8 +76,33 @@ def test_client_api_surface():
         "getModelMetadata", "getModelConfig", "getInferenceStatistics",
         "loadModel", "unloadModel", "registerSystemSharedMemory",
         "registerTpuSharedMemory", "infer", "asyncInfer",
+        # robustness surface (parity: reference :245,368)
+        "setRetryCnt", "AbstractEndpoint",
     ):
         assert method in text, "missing method %s" % method
+
+
+def test_retry_and_endpoint_abstraction():
+    """Bounded transport retry + endpoint strategy classes (parity:
+    reference InferenceServerClient.java:245,293 and endpoint/)."""
+    client = (JAVA_ROOT / "tpuclient"
+              / "InferenceServerClient.java").read_text()
+    # retry loop: bounded by retryCnt, rebuilds the request per attempt
+    assert "retryCnt" in client
+    assert "attempt >= retryCnt" in client
+    assert "catch (IOException" in client
+    # constructor overloads accept an endpoint strategy
+    assert "InferenceServerClient(AbstractEndpoint endpoint" in client
+    names = {p.name for p in _sources()}
+    assert {"AbstractEndpoint.java", "FixedEndpoint.java",
+            "RoundRobinEndpoint.java"} <= names
+    fixed = (JAVA_ROOT / "tpuclient" / "endpoint"
+             / "FixedEndpoint.java").read_text()
+    assert "extends AbstractEndpoint" in fixed
+    rr = (JAVA_ROOT / "tpuclient" / "endpoint"
+          / "RoundRobinEndpoint.java").read_text()
+    assert "extends AbstractEndpoint" in rr
+    assert "getAndIncrement" in rr  # actually rotates
 
 
 def test_compiles_if_jdk_available(tmp_path):
