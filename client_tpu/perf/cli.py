@@ -132,6 +132,9 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
             BackendKind.TORCHSERVE if args.service_kind == "torchserve"
             else BackendKind.TFSERVING,
             url=args.url, verbose=args.verbose,
+            # gRPC PredictionService is TF-Serving's native protocol;
+            # -i http selects the REST predict API instead.
+            tfserving_grpc=args.protocol != "http",
         )
     elif args.service_kind == "inprocess":
         if core is None:
@@ -160,10 +163,18 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
         print("perf failed: %s" % e, file=sys.stderr)
         setup_backend.close()
         return 1
-    # variable-dim overrides
+    # variable-dim overrides; name:DTYPE:d1,d2 CREATES the tensor for
+    # metadata-less service kinds (tfserving's gRPC surface exposes no
+    # KServe metadata)
     for override in args.shape:
-        name, _, dims = override.partition(":")
-        if name in model.inputs:
+        name, _, rest = override.partition(":")
+        dtype, _, dims = rest.rpartition(":")
+        if dtype:
+            from client_tpu.perf.model_parser import ModelTensor
+
+            model.inputs[name] = ModelTensor(
+                name, dtype, [int(d) for d in dims.split(",")])
+        elif name in model.inputs:
             model.inputs[name].shape = [int(d) for d in dims.split(",")]
 
     loader = DataLoader(model)

@@ -589,6 +589,183 @@ _TF_TO_TRITON_DTYPE = {
     "DT_STRING": "BYTES", "DT_BOOL": "BOOL",
 }
 
+# triton wire dtype -> tensorflow.DataType enum value (types.proto).
+TRITON_TO_TF_DTYPE = {
+    "FP16": 19, "BF16": 14, "FP32": 1, "FP64": 2, "INT8": 6, "INT16": 5,
+    "INT32": 3, "INT64": 9, "UINT8": 4, "UINT16": 17, "UINT32": 22,
+    "UINT64": 23, "BYTES": 7, "BOOL": 10,
+}
+_TF_ENUM_TO_NP = {
+    1: np.float32, 2: np.float64, 3: np.int32, 4: np.uint8, 5: np.int16,
+    6: np.int8, 9: np.int64, 10: np.bool_, 17: np.uint16, 19: np.float16,
+    22: np.uint32, 23: np.uint64,
+}
+
+
+class _TfsResult:
+    """PredictResponse wrapper with the InferResult reading surface."""
+
+    def __init__(self, response, request_id=""):
+        self._response = response
+        self._id = request_id
+
+    def as_numpy(self, name):
+        tensor = self._response.outputs.get(name)
+        if tensor is None:
+            return None
+        shape = [d.size for d in tensor.tensor_shape.dim]
+        if tensor.dtype == 7:  # DT_STRING
+            return np.array(list(tensor.string_val),
+                            dtype=np.object_).reshape(shape)
+        np_dtype = _TF_ENUM_TO_NP.get(tensor.dtype)
+        if np_dtype is None:
+            raise InferenceServerException(
+                "unsupported TF dtype %d" % tensor.dtype)
+        if tensor.tensor_content:
+            return np.frombuffer(
+                tensor.tensor_content, dtype=np_dtype).reshape(shape)
+        if len(tensor.half_val):  # raw 16-bit patterns widened to int32
+            return np.array(list(tensor.half_val),
+                            dtype=np.uint16).view(np_dtype).reshape(shape)
+        for field in ("float_val", "double_val", "int_val", "int64_val",
+                      "bool_val", "uint32_val", "uint64_val"):
+            values = getattr(tensor, field)
+            if len(values):
+                return np.array(list(values), dtype=np_dtype).reshape(shape)
+        return np.zeros(shape, dtype=np_dtype)
+
+    def get_response(self):
+        return self._response
+
+    def request_id(self):
+        return self._id
+
+    def is_final_response(self):
+        return True
+
+
+class TfServingGrpcBackend(ClientBackend):
+    """TensorFlow-Serving over the gRPC PredictionService — the
+    reference's native protocol (client_backend/tensorflow_serving/
+    tfserve_grpc_client.cc Predict), speaking the compiled
+    wire-compatible proto subset in client_tpu.protocol."""
+
+    kind = BackendKind.TFSERVING
+
+    def __init__(self, url: str, verbose: bool = False):
+        import grpc
+        from concurrent.futures import ThreadPoolExecutor
+
+        from client_tpu.protocol import tensorflow_serving_apis_pb2 as tfs
+
+        self._tfs = tfs
+        self._url = url
+        self._verbose = verbose
+        self._channel = grpc.insecure_channel(url)
+        self._predict = self._channel.unary_unary(
+            "/tensorflow.serving.PredictionService/Predict",
+            request_serializer=tfs.PredictRequest.SerializeToString,
+            response_deserializer=tfs.PredictResponse.FromString,
+        )
+        self._executor = ThreadPoolExecutor(max_workers=8)
+
+    def close(self):
+        self._executor.shutdown(wait=False)
+        self._channel.close()
+
+    # TF-Serving exposes no KServe metadata; shapes come from the
+    # harness's --shape overrides (reference behavior for this kind).
+    def server_metadata(self):
+        return {"name": "tfserving-endpoint", "protocol": "grpc"}
+
+    def model_metadata(self, model_name, model_version=""):
+        return {"name": model_name, "platform": "tensorflow_serving",
+                "inputs": [], "outputs": []}
+
+    def model_config(self, model_name, model_version=""):
+        return {"name": model_name, "max_batch_size": 0}
+
+    def model_statistics(self, model_name="", model_version=""):
+        return {"model_stats": []}
+
+    def _build_request(self, model_name, inputs, model_version=""):
+        request = self._tfs.PredictRequest()
+        request.model_spec.name = model_name
+        if model_version:
+            request.model_spec.version.value = int(model_version)
+        for infer_input in inputs:
+            array = infer_input.numpy_data()
+            if array is None:
+                raise InferenceServerException(
+                    "TF-Serving needs numpy-backed inputs")
+            tensor = request.inputs[infer_input.name()]
+            tensor.dtype = TRITON_TO_TF_DTYPE.get(
+                infer_input.datatype(), 1)
+            for dim in array.shape:
+                tensor.tensor_shape.dim.add().size = int(dim)
+            if array.dtype == np.object_:
+                tensor.string_val.extend(
+                    v if isinstance(v, bytes) else str(v).encode()
+                    for v in array.ravel()
+                )
+            else:
+                tensor.tensor_content = np.ascontiguousarray(
+                    array).tobytes()
+        return request
+
+    def infer(self, model_name, inputs, outputs=None, **kwargs):
+        import grpc
+
+        request = self._build_request(
+            model_name, inputs, kwargs.get("model_version", ""))
+        timeout = kwargs.get("client_timeout")
+        try:
+            response = self._predict(request, timeout=timeout)
+        except grpc.RpcError as e:
+            raise InferenceServerException(
+                "tfserving predict failed: %s" % e, status="UNAVAILABLE")
+        return _TfsResult(response, kwargs.get("request_id", ""))
+
+    def async_infer(self, callback, model_name, inputs, outputs=None,
+                    **kwargs):
+        def run():
+            try:
+                callback(self.infer(model_name, inputs, outputs, **kwargs),
+                         None)
+            except Exception as e:  # noqa: BLE001 — delivered to callback
+                callback(None, e)
+
+        self._executor.submit(run)
+
+    def start_stream(self, callback):
+        raise InferenceServerException(
+            "tfserving does not support streaming", status="UNIMPLEMENTED")
+
+    def stop_stream(self):
+        raise InferenceServerException(
+            "tfserving does not support streaming", status="UNIMPLEMENTED")
+
+    def async_stream_infer(self, model_name, inputs, outputs=None,
+                           **kwargs):
+        raise InferenceServerException(
+            "tfserving does not support streaming", status="UNIMPLEMENTED")
+
+    def register_system_shared_memory(self, *args, **kwargs):
+        raise InferenceServerException(
+            "tfserving does not support shared memory",
+            status="UNIMPLEMENTED")
+
+    def register_tpu_shared_memory(self, *args, **kwargs):
+        raise InferenceServerException(
+            "tfserving does not support shared memory",
+            status="UNIMPLEMENTED")
+
+    def unregister_system_shared_memory(self, name=""):
+        pass
+
+    def unregister_tpu_shared_memory(self, name=""):
+        pass
+
 
 class InProcessBackend(ClientBackend):
     """Runs against an InferenceServerCore in this process — no RPC,
@@ -875,7 +1052,8 @@ class ClientBackendFactory:
     def __init__(self, kind: BackendKind, url: str = "", core=None,
                  verbose: bool = False, http_concurrency: int = 8,
                  mock_delay_s: float = 0.0, mock_stats=None,
-                 openai_endpoint: str = "/v1/chat/completions"):
+                 openai_endpoint: str = "/v1/chat/completions",
+                 tfserving_grpc: bool = True):
         self.kind = kind
         self._url = url
         self._core = core
@@ -884,6 +1062,9 @@ class ClientBackendFactory:
         self._mock_delay = mock_delay_s
         self._mock_stats = mock_stats
         self._openai_endpoint = openai_endpoint
+        # gRPC PredictionService is TF-Serving's native protocol
+        # (reference parity); False selects the REST predict API.
+        self._tfserving_grpc = tfserving_grpc
 
     def create(self) -> ClientBackend:
         if self.kind == BackendKind.TRITON_GRPC:
@@ -897,6 +1078,8 @@ class ClientBackendFactory:
         if self.kind == BackendKind.TORCHSERVE:
             return TorchServeBackend(self._url, self._verbose)
         if self.kind == BackendKind.TFSERVING:
+            if self._tfserving_grpc:
+                return TfServingGrpcBackend(self._url, self._verbose)
             return TfServingBackend(self._url, self._verbose)
         if self.kind == BackendKind.IN_PROCESS:
             if self._core is None:

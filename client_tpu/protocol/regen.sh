@@ -5,4 +5,6 @@ cd "$(dirname "$0")/../.."
 protoc -I. --python_out=. \
   client_tpu/protocol/model_config.proto \
   client_tpu/protocol/inference.proto \
-  client_tpu/protocol/arena.proto
+  client_tpu/protocol/arena.proto \
+  client_tpu/protocol/tensorflow_serving.proto \
+  client_tpu/protocol/tensorflow_serving_apis.proto

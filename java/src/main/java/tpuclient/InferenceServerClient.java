@@ -184,6 +184,14 @@ public class InferenceServerClient implements AutoCloseable {
       } catch (InterruptedException e) {
         Thread.currentThread().interrupt();
         throw new InferenceException("infer request interrupted", e);
+      } catch (java.net.http.HttpConnectTimeoutException e) {
+        // No request was sent: connect timeouts are safe to retry
+        // (and the failover case RoundRobinEndpoint exists for).
+        if (attempt >= retryCnt) {
+          throw new InferenceException(
+              "infer failed after " + (attempt + 1) + " attempt(s), url: "
+              + request.uri(), e);
+        }
       } catch (java.net.http.HttpTimeoutException e) {
         // The server may already be executing this non-idempotent
         // request: a retry would duplicate the inference.

@@ -57,6 +57,8 @@ struct BackendConfig {
   double mock_error_rate = 0.0;
   // IN_PROCESS: comma-separated models for embed.init to warm.
   std::string inprocess_models;
+  // TFSERVING: gRPC PredictionService (native protocol) vs REST.
+  bool tfserving_grpc = true;
 };
 
 //==============================================================================

@@ -30,15 +30,30 @@ Error ApplyShapeOverrides(
       return Error("bad --shape (want name:d1,d2): " + override_text);
     }
     std::string name = override_text.substr(0, colon);
+    std::string dims = override_text.substr(colon + 1);
+    // name:DTYPE:d1,d2 CREATES the tensor — service kinds with no
+    // metadata surface (tfserving gRPC) declare inputs this way.
+    std::string datatype;
+    size_t second = dims.find(':');
+    if (second != std::string::npos) {
+      datatype = dims.substr(0, second);
+      dims = dims.substr(second + 1);
+    }
     ModelTensor* target = nullptr;
     for (auto& t : model->inputs) {
       if (t.name == name) target = &t;
     }
     if (target == nullptr) {
-      return Error("--shape names unknown input '" + name + "'");
+      if (datatype.empty()) {
+        return Error("--shape names unknown input '" + name +
+                     "' (declare new tensors as name:DTYPE:d1,d2)");
+      }
+      model->inputs.emplace_back();
+      target = &model->inputs.back();
+      target->name = name;
     }
+    if (!datatype.empty()) target->datatype = datatype;
     target->shape.clear();
-    std::string dims = override_text.substr(colon + 1);
     size_t pos = 0;
     while (pos < dims.size()) {
       size_t comma = dims.find(',', pos);
@@ -69,6 +84,9 @@ int Run(int argc, char** argv) {
     backend_config.kind = BackendKind::TORCHSERVE;
   } else if (params.service_kind == "tfserving") {
     backend_config.kind = BackendKind::TFSERVING;
+    // gRPC PredictionService is the native protocol; -i http selects
+    // the REST predict API.
+    backend_config.tfserving_grpc = params.protocol != "http";
   } else if (params.service_kind == "openai") {
     backend_config.kind = BackendKind::OPENAI;
     backend_config.openai_endpoint = params.endpoint;

@@ -120,8 +120,10 @@ def test_torchserve_backend_async(rest_server):
 
 
 def test_tfserving_backend_metadata_and_infer(rest_server):
+    # tfserving_grpc=False exercises the REST predict API variant
     backend = ClientBackendFactory(
-        BackendKind.TFSERVING, url=_url(rest_server)).create()
+        BackendKind.TFSERVING, url=_url(rest_server),
+        tfserving_grpc=False).create()
     meta = backend.model_metadata("m")
     assert meta["platform"] == "tensorflow_serving"
     assert meta["inputs"][0]["name"] == "x"
@@ -136,7 +138,8 @@ def test_tfserving_backend_metadata_and_infer(rest_server):
 
 def test_tfserving_backend_bytes_input(rest_server):
     backend = ClientBackendFactory(
-        BackendKind.TFSERVING, url=_url(rest_server)).create()
+        BackendKind.TFSERVING, url=_url(rest_server),
+        tfserving_grpc=False).create()
     s = InferInput("s", [2], "BYTES")
     s.set_data_from_numpy(np.array([b"a", b"b"], dtype=np.object_))
     result = backend.infer("m", [s])
@@ -147,7 +150,8 @@ def test_rest_backends_reject_streaming(rest_server):
     from client_tpu.utils import InferenceServerException
 
     for kind in (BackendKind.TORCHSERVE, BackendKind.TFSERVING):
-        backend = ClientBackendFactory(kind, url=_url(rest_server)).create()
+        backend = ClientBackendFactory(kind, url=_url(rest_server),
+                                       tfserving_grpc=False).create()
         with pytest.raises(InferenceServerException):
             backend.async_stream_infer("m", [])
 
@@ -169,7 +173,7 @@ def test_native_perf_analyzer_rest_e2e(rest_server, tmp_path, service_kind):
     csv = tmp_path / "latency.csv"
     proc = subprocess.run(
         [str(binary), "-m", "anymodel", "-u", _url(rest_server),
-         "--service-kind", service_kind,
+         "--service-kind", service_kind, "-i", "http",
          "--input-data", str(input_file),
          "--concurrency-range", "2", "-p", "400", "-r", "3", "-s", "90",
          "-f", str(csv)],
