@@ -210,8 +210,16 @@ class HttpBackend : public ClientBackend {
   static Error Create(
       const BackendConfig& config, std::unique_ptr<ClientBackend>* backend) {
     auto b = std::unique_ptr<HttpBackend>(new HttpBackend());
-    Error err = InferenceServerHttpClient::Create(
-        &b->client_, config.url, config.verbose);
+    std::string url = config.url;
+    if (config.https && url.find("://") == std::string::npos) {
+      url = "https://" + url;  // scheme selects TLS in the client
+    }
+    Error err = config.https
+                    ? InferenceServerHttpClient::Create(
+                          &b->client_, url, config.https_ssl,
+                          config.verbose)
+                    : InferenceServerHttpClient::Create(
+                          &b->client_, url, config.verbose);
     if (!err.IsOk()) return err;
     b->client_->SetAsyncWorkerCount(config.http_async_workers);
     *backend = std::move(b);
@@ -369,8 +377,9 @@ class OpenAiInferResult : public InferResult {
 static InferResult* PostAndWrap(
     const std::string& host, int port, const std::string& path,
     const std::string& content_type, const std::string& body,
-    const std::string& request_id, uint64_t timeout_us) {
-  HttpConnection conn(host, port);
+    const std::string& request_id, uint64_t timeout_us,
+    bool use_tls = false, const SslOptions& ssl = SslOptions()) {
+  HttpConnection conn(host, port, use_tls, ssl);
   HttpResponse response;
   std::string transport_err = conn.Request(
       "POST", path, {{"Content-Type", content_type}}, body, &response,
@@ -390,7 +399,8 @@ static InferResult* PostAndWrap(
 class OpenAiBackend : public ClientBackend {
  public:
   explicit OpenAiBackend(const BackendConfig& config)
-      : endpoint_(config.openai_endpoint) {
+      : endpoint_(config.openai_endpoint), use_tls_(config.https),
+        ssl_(config.https_ssl) {
     std::string rest = config.url;
     size_t scheme = rest.find("://");
     if (scheme != std::string::npos) rest = rest.substr(scheme + 3);
@@ -468,7 +478,7 @@ class OpenAiBackend : public ClientBackend {
     if (!err.IsOk()) return err;
     *result = PostAndWrap(
         host_, port_, endpoint_, "application/json", payload,
-        options.request_id, options.client_timeout_us);
+        options.request_id, options.client_timeout_us, use_tls_, ssl_);
     return Error::Success;
   }
 
@@ -485,7 +495,7 @@ class OpenAiBackend : public ClientBackend {
     std::thread([this, callback = std::move(callback), id,
                  payload = std::move(payload), timeout_us] {
       callback(PostAndWrap(host_, port_, endpoint_, "application/json",
-                           payload, id, timeout_us));
+                           payload, id, timeout_us, use_tls_, ssl_));
       inflight_--;
     }).detach();
     return Error::Success;
@@ -522,7 +532,7 @@ class OpenAiBackend : public ClientBackend {
     uint64_t timeout_us = options.client_timeout_us;
     std::thread([this, callback = std::move(callback), id,
                  payload = std::move(payload), timeout_us] {
-      HttpConnection conn(host_, port_);
+      HttpConnection conn(host_, port_, use_tls_, ssl_);
       HttpResponse response;
       std::string buffer;
       auto on_data = [&](const char* data, size_t len) {
@@ -585,6 +595,8 @@ class OpenAiBackend : public ClientBackend {
   std::string host_;
   int port_ = 8000;
   std::string endpoint_;
+  bool use_tls_ = false;
+  SslOptions ssl_;
   std::atomic<int64_t> inflight_{0};
   std::mutex stream_mutex_;
   OnCompleteFn stream_callback_;
@@ -602,7 +614,8 @@ class OpenAiBackend : public ClientBackend {
 //
 class RestBackend : public ClientBackend {
  public:
-  explicit RestBackend(const BackendConfig& config) : kind_(config.kind) {
+  explicit RestBackend(const BackendConfig& config)
+      : kind_(config.kind), use_tls_(config.https), ssl_(config.https_ssl) {
     std::string rest = config.url;
     size_t scheme = rest.find("://");
     if (scheme != std::string::npos) rest = rest.substr(scheme + 3);
@@ -686,7 +699,7 @@ class RestBackend : public ClientBackend {
     if (!err.IsOk()) return err;
     *result = PostAndWrap(
         host_, port_, path, content_type, body, options.request_id,
-        options.client_timeout_us);
+        options.client_timeout_us, use_tls_, ssl_);
     return Error::Success;
   }
 
@@ -704,7 +717,7 @@ class RestBackend : public ClientBackend {
                  path = std::move(path), body = std::move(body),
                  content_type = std::move(content_type), timeout_us] {
       callback(PostAndWrap(host_, port_, path, content_type, body, id,
-                           timeout_us));
+                           timeout_us, use_tls_, ssl_));
       inflight_--;
     }).detach();
     return Error::Success;
@@ -741,7 +754,7 @@ class RestBackend : public ClientBackend {
   // TfServingBackend.model_metadata). Returns false when the endpoint
   // is unreachable or unparseable so the caller synthesizes defaults.
   bool FetchTfMetadata(const std::string& model_name, json::Value* out) {
-    HttpConnection conn(host_, port_);
+    HttpConnection conn(host_, port_, use_tls_, ssl_);
     HttpResponse response;
     std::string transport_err = conn.Request(
         "GET", "/v1/models/" + model_name + "/metadata", {}, "", &response,
@@ -940,6 +953,8 @@ class RestBackend : public ClientBackend {
   BackendKind kind_;
   std::string host_;
   int port_ = 8080;
+  bool use_tls_ = false;
+  SslOptions ssl_;
   std::atomic<int64_t> inflight_{0};
 };
 
@@ -1137,6 +1152,7 @@ class TfServingGrpcBackend : public ClientBackend {
       const BackendConfig& config, std::unique_ptr<ClientBackend>* backend) {
     auto b = std::unique_ptr<TfServingGrpcBackend>(
         new TfServingGrpcBackend());
+    b->signature_name_ = config.model_signature_name;
     Error err = GrpcChannel::Create(&b->channel_, config.url);
     if (!err.IsOk()) return err;
     *backend = std::move(b);
@@ -1260,6 +1276,10 @@ class TfServingGrpcBackend : public ClientBackend {
       std::string* request_bytes) {
     tensorflow::serving::PredictRequest request;
     request.mutable_model_spec()->set_name(options.model_name);
+    if (!signature_name_.empty() &&
+        signature_name_ != "serving_default") {
+      request.mutable_model_spec()->set_signature_name(signature_name_);
+    }
     if (!options.model_version.empty()) {
       request.mutable_model_spec()->mutable_version()->set_value(
           strtoll(options.model_version.c_str(), nullptr, 10));
@@ -1309,6 +1329,7 @@ class TfServingGrpcBackend : public ClientBackend {
   }
 
   std::shared_ptr<GrpcChannel> channel_;
+  std::string signature_name_;
 };
 
 //==============================================================================

@@ -25,6 +25,7 @@
 
 #include "../library/common.h"
 #include "../library/json.h"
+#include "../library/tls.h"
 
 namespace tpuclient {
 
@@ -59,6 +60,11 @@ struct BackendConfig {
   std::string inprocess_models;
   // TFSERVING: gRPC PredictionService (native protocol) vs REST.
   bool tfserving_grpc = true;
+  // TFSERVING: signature to invoke (reference --model-signature-name).
+  std::string model_signature_name = "serving_default";
+  // HTTPS for the HTTP client (TLS via dlopen'd OpenSSL).
+  bool https = false;
+  SslOptions https_ssl;
 };
 
 //==============================================================================

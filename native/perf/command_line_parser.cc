@@ -59,6 +59,30 @@ enum LongOpt {
   kOptCollectMetrics,
   kOptMetricsUrl,
   kOptMetricsInterval,
+  kOptBinarySearch,
+  kOptRequestCount,
+  kOptDataDirectory,
+  kOptBlsComposingModels,
+  kOptModelSignatureName,
+  kOptNumOfSequences,
+  kOptSerialSequences,
+  kOptVerboseCsv,
+  kOptSslGrpcUseSsl,
+  kOptSslGrpcRootCerts,
+  kOptSslGrpcPrivateKey,
+  kOptSslGrpcCertChain,
+  kOptSslHttpsCaCerts,
+  kOptSslHttpsClientCert,
+  kOptSslHttpsPrivateKey,
+  kOptSslHttpsVerifyPeer,
+  kOptSslHttpsVerifyHost,
+  kOptRequestParameter,
+  kOptTraceLevel,
+  kOptTraceRate,
+  kOptTraceCount,
+  kOptEnableMpi,
+  kOptLogFrequency,
+  kOptVersion,
 };
 
 const struct option kLongOptions[] = {
@@ -108,6 +132,40 @@ const struct option kLongOptions[] = {
     {"collect-metrics", no_argument, nullptr, kOptCollectMetrics},
     {"metrics-url", required_argument, nullptr, kOptMetricsUrl},
     {"metrics-interval", required_argument, nullptr, kOptMetricsInterval},
+    {"binary-search", no_argument, nullptr, kOptBinarySearch},
+    {"request-count", required_argument, nullptr, kOptRequestCount},
+    {"data-directory", required_argument, nullptr, kOptDataDirectory},
+    {"bls-composing-models", required_argument, nullptr,
+     kOptBlsComposingModels},
+    {"model-signature-name", required_argument, nullptr,
+     kOptModelSignatureName},
+    {"num-of-sequences", required_argument, nullptr, kOptNumOfSequences},
+    {"serial-sequences", no_argument, nullptr, kOptSerialSequences},
+    {"verbose-csv", no_argument, nullptr, kOptVerboseCsv},
+    {"ssl-grpc-use-ssl", no_argument, nullptr, kOptSslGrpcUseSsl},
+    {"ssl-grpc-root-certifications-file", required_argument, nullptr,
+     kOptSslGrpcRootCerts},
+    {"ssl-grpc-private-key-file", required_argument, nullptr,
+     kOptSslGrpcPrivateKey},
+    {"ssl-grpc-certificate-chain-file", required_argument, nullptr,
+     kOptSslGrpcCertChain},
+    {"ssl-https-ca-certificates-file", required_argument, nullptr,
+     kOptSslHttpsCaCerts},
+    {"ssl-https-client-certificate-file", required_argument, nullptr,
+     kOptSslHttpsClientCert},
+    {"ssl-https-private-key-file", required_argument, nullptr,
+     kOptSslHttpsPrivateKey},
+    {"ssl-https-verify-peer", required_argument, nullptr,
+     kOptSslHttpsVerifyPeer},
+    {"ssl-https-verify-host", required_argument, nullptr,
+     kOptSslHttpsVerifyHost},
+    {"request-parameter", required_argument, nullptr, kOptRequestParameter},
+    {"trace-level", required_argument, nullptr, kOptTraceLevel},
+    {"trace-rate", required_argument, nullptr, kOptTraceRate},
+    {"trace-count", required_argument, nullptr, kOptTraceCount},
+    {"enable-mpi", no_argument, nullptr, kOptEnableMpi},
+    {"log-frequency", required_argument, nullptr, kOptLogFrequency},
+    {"version", no_argument, nullptr, kOptVersion},
     {nullptr, 0, nullptr, 0},
 };
 
@@ -117,24 +175,38 @@ void CLParser::Usage(const char* program) {
   fprintf(
       stderr,
       "Usage: %s -m <model> [-u host:port] [-i grpc|http] [options]\n"
+      "Service kinds: --service-kind "
+      "triton|openai|torchserve|tfserving|in_process\n"
+      "  [--endpoint path] [--model-signature-name sig]\n"
       "Load modes (default --concurrency-range 1):\n"
-      "  --concurrency-range start:end:step\n"
+      "  --concurrency-range start:end:step [--binary-search]\n"
       "  --request-rate-range start:end:step [--request-distribution "
       "constant|poisson]\n"
       "  --request-intervals <file>   (one microsecond gap per line)\n"
       "  --periodic-concurrency-range start:end:step [--request-period N]\n"
       "Measurement: -p <window ms>, -r <max trials>, -s <stability %%>,\n"
       "  -l <latency threshold ms>, --percentile N, --measurement-mode\n"
-      "  time_windows|count_windows, --measurement-request-count N\n"
-      "Data: --input-data random|zero|<json>, --shape name:d1,d2,\n"
-      "  --string-length N, --string-data S\n"
+      "  time_windows|count_windows, --measurement-request-count N,\n"
+      "  --request-count N\n"
+      "Data: --input-data random|zero|<json>, --data-directory <dir>,\n"
+      "  --shape name[:DTYPE]:d1,d2, --string-length N, --string-data S,\n"
+      "  --request-parameter name:value:type\n"
       "Shared memory: --shared-memory none|system|tpu,\n"
       "  --output-shared-memory-size N, --tpu-arena-url host:port\n"
       "Sequences: --sequence-length N, --sequence-length-variation pct,\n"
-      "  --sequence-id-range start[:end]\n"
+      "  --sequence-id-range start[:end], --num-of-sequences N,\n"
+      "  --serial-sequences\n"
+      "Pipelines: --bls-composing-models m1,m2\n"
+      "TLS: --ssl-https-ca-certificates-file F,\n"
+      "  --ssl-https-client-certificate-file F,\n"
+      "  --ssl-https-private-key-file F, --ssl-https-verify-peer 0|1,\n"
+      "  --ssl-https-verify-host 0|1\n"
+      "Tracing: --trace-level L [--trace-rate N] [--trace-count N]\n"
       "Metrics: --collect-metrics [--metrics-url host:port/metrics]\n"
       "  [--metrics-interval ms]\n"
-      "Output: -f <csv>, --profile-export-file <json>, -v\n",
+      "Scale-out: --enable-mpi\n"
+      "Output: -f <csv> [--verbose-csv], --profile-export-file <json>,\n"
+      "  --log-frequency N, -v, --version\n",
       program);
 }
 
@@ -230,6 +302,96 @@ Error CLParser::Parse(
       case kOptMetricsInterval:
         params->metrics_interval_ms = atoll(optarg);
         break;
+      case kOptBinarySearch:
+        params->binary_search = true;
+        break;
+      case kOptRequestCount:
+        params->request_count = atoll(optarg);
+        break;
+      case kOptDataDirectory:
+        // Alias: the reference splits file/dir input across two
+        // flags; our --input-data already accepts a directory.
+        params->input_data = optarg;
+        break;
+      case kOptBlsComposingModels: {
+        std::string csv = optarg;
+        size_t pos = 0;
+        while (pos <= csv.size()) {
+          size_t comma = csv.find(',', pos);
+          std::string name = csv.substr(
+              pos, comma == std::string::npos ? std::string::npos
+                                              : comma - pos);
+          if (!name.empty()) params->bls_composing_models.push_back(name);
+          if (comma == std::string::npos) break;
+          pos = comma + 1;
+        }
+        break;
+      }
+      case kOptModelSignatureName:
+        params->model_signature_name = optarg;
+        break;
+      case kOptNumOfSequences:
+        params->num_of_sequences = atoll(optarg);
+        break;
+      case kOptSerialSequences:
+        params->serial_sequences = true;
+        break;
+      case kOptVerboseCsv:
+        params->verbose_csv = true;
+        break;
+      case kOptSslGrpcUseSsl:
+        params->ssl_grpc_use_ssl = true;
+        break;
+      case kOptSslGrpcRootCerts:
+        params->ssl_grpc_root_certifications_file = optarg;
+        break;
+      case kOptSslGrpcPrivateKey:
+        params->ssl_grpc_private_key_file = optarg;
+        break;
+      case kOptSslGrpcCertChain:
+        params->ssl_grpc_certificate_chain_file = optarg;
+        break;
+      case kOptSslHttpsCaCerts:
+        params->ssl_https_any = true;
+        params->ssl_https_ca_certificates_file = optarg;
+        break;
+      case kOptSslHttpsClientCert:
+        params->ssl_https_any = true;
+        params->ssl_https_client_certificate_file = optarg;
+        break;
+      case kOptSslHttpsPrivateKey:
+        params->ssl_https_any = true;
+        params->ssl_https_private_key_file = optarg;
+        break;
+      case kOptSslHttpsVerifyPeer:
+        params->ssl_https_any = true;
+        params->ssl_https_verify_peer = atoi(optarg) != 0;
+        break;
+      case kOptSslHttpsVerifyHost:
+        params->ssl_https_any = true;
+        params->ssl_https_verify_host = atoi(optarg) != 0;
+        break;
+      case kOptRequestParameter:
+        params->request_parameters.push_back(optarg);
+        break;
+      case kOptTraceLevel:
+        params->trace_level = optarg;
+        break;
+      case kOptTraceRate:
+        params->trace_rate = atoll(optarg);
+        break;
+      case kOptTraceCount:
+        params->trace_count = atoll(optarg);
+        break;
+      case kOptEnableMpi:
+        params->enable_mpi = true;
+        break;
+      case kOptLogFrequency:
+        params->log_frequency = atoll(optarg);
+        break;
+      case kOptVersion:
+        printf("perf_analyzer (client_tpu native harness)\n");
+        exit(0);
       case kOptServiceKind:
         params->service_kind = optarg;
         if (params->service_kind != "triton" &&

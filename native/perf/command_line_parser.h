@@ -29,6 +29,10 @@ struct PerfAnalyzerParameters {
   // Load modes (mutually exclusive; concurrency default).
   bool has_concurrency_range = false;
   size_t concurrency_start = 1, concurrency_end = 1, concurrency_step = 1;
+  // Binary-search mode: bisect [start, end] for the highest
+  // concurrency meeting the latency threshold (reference
+  // inference_profiler.h:280-325).
+  bool binary_search = false;
   bool has_request_rate_range = false;
   double rate_start = 0, rate_end = 0, rate_step = 1.0;
   std::string request_intervals_file;
@@ -45,6 +49,9 @@ struct PerfAnalyzerParameters {
   double stability_percentage = 10.0;
   double latency_threshold_ms = 0.0;
   int percentile = 0;
+  // Exactly N requests then stop (0 = window-based), reference
+  // --request-count.
+  size_t request_count = 0;
 
   // Shared memory.
   std::string shared_memory = "none";  // none | system | tpu
@@ -62,15 +69,58 @@ struct PerfAnalyzerParameters {
   size_t sequence_length = 20;
   double sequence_length_variation = 20.0;
   std::string sequence_id_range;  // start[:end]
+  // Concurrent sequence count for sequence models (reference
+  // --num-of-sequences) and strict serialization per sequence id.
+  size_t num_of_sequences = 4;
+  bool serial_sequences = false;
 
   // Output files.
   std::string latency_report_file;
   std::string profile_export_file;
+  bool verbose_csv = false;
 
   // Server metrics scraping.
   bool collect_metrics = false;
   std::string metrics_url;  // defaults to http://<url host>:8000/metrics
   uint64_t metrics_interval_ms = 1000;
+
+  // Composing models of a BLS/pipeline top model whose per-window
+  // stats should be paired (reference --bls-composing-models).
+  std::vector<std::string> bls_composing_models;
+
+  // TF-Serving signature (reference --model-signature-name).
+  std::string model_signature_name = "serving_default";
+
+  // TLS (dlopen'd OpenSSL; both protocols).
+  bool ssl_grpc_use_ssl = false;
+  std::string ssl_grpc_root_certifications_file;
+  std::string ssl_grpc_private_key_file;
+  std::string ssl_grpc_certificate_chain_file;
+  std::string ssl_https_ca_certificates_file;
+  std::string ssl_https_client_certificate_file;
+  std::string ssl_https_private_key_file;
+  bool ssl_https_verify_peer = true;
+  bool ssl_https_verify_host = true;
+  // True when ANY ssl-https flag appeared (enables HTTPS even with
+  // only verify flags given).
+  bool ssl_https_any = false;
+
+  // Per-request custom parameter overrides, "name:value:type"
+  // (reference --request-parameter).
+  std::vector<std::string> request_parameters;
+
+  // Client-side trace knobs forwarded to the server's trace settings
+  // (reference --trace-level/--trace-rate/--trace-count).
+  std::string trace_level;
+  uint64_t trace_rate = 0;
+  int64_t trace_count = -1;
+
+  // MPI multi-client rendezvous (reference --enable-mpi).
+  bool enable_mpi = false;
+
+  // Progress log every N completed requests in verbose mode
+  // (reference --log-frequency).
+  size_t log_frequency = 0;
 };
 
 class CLParser {

@@ -179,6 +179,52 @@ Error InferenceProfiler::ProfileConcurrencyRange(
   return Error::Success;
 }
 
+Error InferenceProfiler::ProfileConcurrencyBinarySearch(
+    ConcurrencyManager* manager, size_t start, size_t end,
+    std::vector<PerfStatus>* results) {
+  if (config_.latency_threshold_ms <= 0) {
+    return Error("--binary-search requires --latency-threshold");
+  }
+  if (end < start) return Error("--binary-search needs start <= end");
+  size_t lo = start, hi = end;
+  size_t best = 0;
+  while (lo <= hi) {
+    size_t mid = lo + (hi - lo) / 2;
+    Error err = manager->ChangeConcurrencyLevel(mid);
+    if (!err.IsOk()) return err;
+    PerfStatus status;
+    err = ProfileLevel(&status);
+    if (!err.IsOk()) return err;
+    status.concurrency = mid;
+    bool over = ExceedsLatencyThreshold(status);
+    results->push_back(std::move(status));
+    if (verbose_) {
+      fprintf(stderr, "binary search: concurrency %zu %s threshold\n",
+              mid, over ? "exceeds" : "meets");
+    }
+    if (over) {
+      if (mid == 0) break;
+      hi = mid - 1;
+      if (hi < start) break;  // nothing meets the threshold
+    } else {
+      best = mid;
+      lo = mid + 1;
+    }
+  }
+  if (best == 0) {
+    return Error("no concurrency in range meets the latency threshold");
+  }
+  // Re-order so the winning level's measurement is last (report
+  // convention: final row = recommendation).
+  for (size_t i = 0; i + 1 < results->size(); ++i) {
+    if ((*results)[i].concurrency == best) {
+      std::rotate(results->begin() + i, results->begin() + i + 1,
+                  results->end());
+    }
+  }
+  return Error::Success;
+}
+
 Error InferenceProfiler::ProfileRequestRateRange(
     RequestRateManager* manager, double start, double end, double step,
     std::vector<PerfStatus>* results) {
@@ -229,7 +275,22 @@ Error InferenceProfiler::ProfileLevel(PerfStatus* merged) {
       fprintf(stderr, "  trial %zu: %.1f infer/sec, avg %.0f us\n", trial,
               status.throughput, status.avg_latency_us);
     }
+    if (config_.log_frequency > 0) {
+      completed_total_ += status.completed_count;
+      if (completed_total_ >= next_log_at_) {
+        fprintf(stderr, "completed %zu requests\n", completed_total_);
+        next_log_at_ =
+            (completed_total_ / config_.log_frequency + 1) *
+            config_.log_frequency;
+      }
+    }
     trials.push_back(std::move(status));
+    if (config_.max_trials == 1) {
+      // Single-window modes (--request-count) measure once by
+      // design; the stability rule (3 agreeing trials) cannot apply.
+      *merged = Merge(std::move(trials));
+      return Error::Success;
+    }
     if (IsStable(trials)) {
       std::vector<PerfStatus> last3(
           std::make_move_iterator(trials.end() - 3),

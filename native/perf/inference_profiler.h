@@ -61,6 +61,9 @@ struct MeasurementConfig {
   double stability_threshold = 0.1;
   double latency_threshold_ms = 0.0;  // 0 = no limit
   int percentile = 0;                 // 0 = stabilize on average
+  // Progress line every N completed requests (0 = off), reference
+  // --log-frequency.
+  size_t log_frequency = 0;
 };
 
 class InferenceProfiler {
@@ -73,7 +76,7 @@ class InferenceProfiler {
       : manager_(manager), config_(config), stats_backend_(stats_backend),
         model_name_(std::move(model_name)),
         composing_models_(std::move(composing_models)), verbose_(verbose),
-        metrics_(metrics) {
+        metrics_(metrics), next_log_at_(config.log_frequency) {
     if (metrics_ != nullptr) metrics_->Start();
   }
 
@@ -81,6 +84,14 @@ class InferenceProfiler {
   // `start`. Stops early when the latency threshold is exceeded.
   Error ProfileConcurrencyRange(
       ConcurrencyManager* manager, size_t start, size_t end, size_t step,
+      std::vector<PerfStatus>* results);
+
+  // Binary-search mode (reference inference_profiler.h:280-325):
+  // bisects [start, end] for the highest concurrency whose latency
+  // stays under the threshold; every probed level's measurement is
+  // appended, best level last.
+  Error ProfileConcurrencyBinarySearch(
+      ConcurrencyManager* manager, size_t start, size_t end,
       std::vector<PerfStatus>* results);
 
   Error ProfileRequestRateRange(
@@ -111,6 +122,9 @@ class InferenceProfiler {
   std::vector<std::string> composing_models_;
   bool verbose_;
   MetricsManager* metrics_;
+  // --log-frequency progress accounting.
+  size_t completed_total_ = 0;
+  size_t next_log_at_ = 0;
 };
 
 }  // namespace perf

@@ -374,7 +374,37 @@ Error LoadManager::PrepareRequest(
   }
   Error err = data_manager_->BuildInputs(stream, step, inputs);
   if (!err.IsOk()) return err;
-  return data_manager_->BuildOutputs(outputs);
+  err = data_manager_->BuildOutputs(outputs);
+  if (!err.IsOk()) return err;
+  return ApplyRequestParameters(options);
+}
+
+Error LoadManager::ApplyRequestParameters(InferOptions* options) {
+  // "name:value:type" custom parameters (reference
+  // --request-parameter); type in {string, int, uint, bool, double}.
+  for (const std::string& parameter : options_.request_parameters) {
+    size_t first = parameter.find(':');
+    size_t last = parameter.rfind(':');
+    if (first == std::string::npos || first == last) {
+      return Error("bad --request-parameter (want name:value:type): " +
+                   parameter);
+    }
+    std::string name = parameter.substr(0, first);
+    std::string value = parameter.substr(first + 1, last - first - 1);
+    std::string type = parameter.substr(last + 1);
+    if (type == "string") {
+      options->string_params[name] = value;
+    } else if (type == "int" || type == "uint") {
+      options->int_params[name] = strtoll(value.c_str(), nullptr, 10);
+    } else if (type == "bool") {
+      options->bool_params[name] = value == "true" || value == "1";
+    } else if (type == "double") {
+      options->double_params[name] = strtod(value.c_str(), nullptr);
+    } else {
+      return Error("bad --request-parameter type '" + type + "'");
+    }
+  }
+  return Error::Success;
 }
 
 namespace {
@@ -675,6 +705,13 @@ Error RequestRateManager::SetCustomSchedule(
 
 void RequestRateManager::LaunchScheduleWorkers() {
   size_t n_threads = std::min<size_t>(options_.max_threads, 8);
+  if (sequence_manager_ != nullptr) {
+    // Concurrent sequences = workers x slots-per-worker; fewer
+    // sequences than workers means fewer workers, or the flag would
+    // silently over-deliver (each worker needs >= 1 private slot).
+    n_threads = std::max<size_t>(
+        1, std::min(n_threads, options_.num_of_sequences));
+  }
   thread_stats_.clear();
   for (size_t i = 0; i < n_threads; ++i) {
     thread_stats_.push_back(std::make_unique<ThreadStat>());
@@ -697,9 +734,37 @@ void RequestRateManager::ScheduleWorker(
     stat->status = err.Message();
     return;
   }
-  SequenceManager::Slot slot;
+  // Sequence slots for this worker: --num-of-sequences total across
+  // the worker pool, cycled per request; serial mode additionally
+  // guarantees one in-flight request per sequence.
+  size_t slot_count = 1;
+  if (sequence_manager_ != nullptr) {
+    // This worker owns the slots {i : i % n_workers == worker_idx},
+    // so the pool-wide total is exactly --num-of-sequences (the
+    // launcher guarantees n_workers <= num_of_sequences).
+    slot_count = std::max<size_t>(
+        1, options_.num_of_sequences / n_workers +
+               (worker_idx < options_.num_of_sequences % n_workers ? 1
+                                                                   : 0));
+  }
+  std::vector<SequenceManager::Slot> worker_slots(slot_count);
+  std::vector<std::shared_ptr<std::atomic<bool>>> slot_busy;
+  for (size_t i = 0; i < slot_count; ++i) {
+    slot_busy.push_back(std::make_shared<std::atomic<bool>>(false));
+  }
+  size_t slot_cursor = 0;
   for (size_t idx = worker_idx; idx < schedule_.size() && !stop_.load();
        idx += n_workers) {
+    SequenceManager::Slot& slot = worker_slots[slot_cursor];
+    auto busy = slot_busy[slot_cursor];
+    slot_cursor = (slot_cursor + 1) % slot_count;
+    if (options_.serial_sequences) {
+      // A sequence must never have two requests in flight.
+      while (busy->load() && !stop_.load()) {
+        std::this_thread::sleep_for(std::chrono::microseconds(100));
+      }
+      if (stop_.load()) break;
+    }
     uint64_t due_ns =
         start_ns + static_cast<uint64_t>(schedule_[idx] * 1e9);
     uint64_t now = NowNs();
@@ -731,8 +796,9 @@ void RequestRateManager::ScheduleWorker(
       auto record = std::make_shared<RequestRecord>();
       record->start_ns = NowNs();
       record->delayed = delayed;
+      busy->store(true);
       Error send_err = backend->AsyncInfer(
-          [stat, record, inputs, outputs](InferResult* result) {
+          [stat, record, inputs, outputs, busy](InferResult* result) {
             record->end_ns.push_back(NowNs());
             Error status = result != nullptr ? result->RequestStatus()
                                              : Error("null result");
@@ -742,12 +808,14 @@ void RequestRateManager::ScheduleWorker(
             }
             delete result;
             stat->AddRecord(std::move(*record));
+            busy->store(false);
           },
           options, RawInputs(*inputs), RawOutputs(*outputs));
       if (!send_err.IsOk()) {
         record->has_error = true;
         record->error = send_err.Message();
         stat->AddRecord(std::move(*record));
+        busy->store(false);
       }
     } else {
       RequestRecord record;

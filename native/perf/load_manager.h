@@ -229,6 +229,15 @@ class LoadManager {
     bool async_mode = true;
     bool streaming = false;
     size_t max_threads = 16;
+    // Sequence load shaping (reference --num-of-sequences /
+    // --serial-sequences): how many sequences run concurrently in
+    // request-rate mode, and whether a sequence may ever have more
+    // than one request in flight.
+    size_t num_of_sequences = 4;
+    bool serial_sequences = false;
+    // "name:value:type" custom request parameters attached to every
+    // request (reference --request-parameter).
+    std::vector<std::string> request_parameters;
   };
 
   LoadManager(
@@ -258,6 +267,7 @@ class LoadManager {
       std::vector<std::unique_ptr<InferInput>>* inputs,
       std::vector<std::unique_ptr<InferRequestedOutput>>* outputs,
       InferOptions* options);
+  Error ApplyRequestParameters(InferOptions* options);
   size_t NextStep(size_t stream);
 
   const ClientBackendFactory* factory_;

@@ -8,9 +8,11 @@
 #include <cstring>
 #include <sys/stat.h>
 
+#include "../library/grpc_client.h"
 #include "command_line_parser.h"
 #include "inference_profiler.h"
 #include "metrics_manager.h"
+#include "mpi_utils.h"
 #include "report_writer.h"
 
 namespace tpuclient {
@@ -102,6 +104,25 @@ int Run(int argc, char** argv) {
   }
   backend_config.url = params.url;
   backend_config.verbose = params.verbose;
+  backend_config.model_signature_name = params.model_signature_name;
+  if (params.ssl_grpc_use_ssl) {
+    // The from-scratch gRPC transport is cleartext HTTP/2; TLS rides
+    // the HTTP client only (tls.h). Fail loudly, never silently.
+    fprintf(stderr,
+            "error: --ssl-grpc-use-ssl is not supported by this build's "
+            "gRPC transport (HTTPS is available with -i http)\n");
+    return 1;
+  }
+  if (params.ssl_https_any) {
+    backend_config.https = true;
+    backend_config.https_ssl.root_certificates =
+        params.ssl_https_ca_certificates_file;
+    backend_config.https_ssl.certificate_chain =
+        params.ssl_https_client_certificate_file;
+    backend_config.https_ssl.private_key = params.ssl_https_private_key_file;
+    backend_config.https_ssl.insecure_skip_verify =
+        !params.ssl_https_verify_peer || !params.ssl_https_verify_host;
+  }
   ClientBackendFactory factory(backend_config);
 
   std::unique_ptr<ClientBackend> setup_backend;
@@ -177,6 +198,13 @@ int Run(int argc, char** argv) {
   config.measurement_interval_ms = params.measurement_interval_ms;
   config.count_windows = params.measurement_mode == "count_windows";
   config.measurement_request_count = params.measurement_request_count;
+  if (params.request_count > 0) {
+    // --request-count: measure exactly N requests, one window (a
+    // single-trial run is by design, not an unstable measurement).
+    config.count_windows = true;
+    config.measurement_request_count = params.request_count;
+    config.max_trials = 1;
+  }
   // REST/chat service kinds send one logical inference per request
   // regardless of -b (their payloads are not batched).
   config.batch_size = (params.service_kind == "triton" ||
@@ -187,11 +215,47 @@ int Run(int argc, char** argv) {
   config.stability_threshold = params.stability_percentage / 100.0;
   config.latency_threshold_ms = params.latency_threshold_ms;
   config.percentile = params.percentile;
+  config.log_frequency = params.log_frequency;
 
   LoadManager::Options manager_options;
   manager_options.async_mode = params.async_mode;
   manager_options.streaming = params.streaming;
   manager_options.max_threads = params.max_threads;
+  manager_options.num_of_sequences = params.num_of_sequences;
+  manager_options.serial_sequences = params.serial_sequences;
+  manager_options.request_parameters = params.request_parameters;
+
+  // BLS/pipeline composing models named on the CLI pair their
+  // per-window stats like ensemble steps do.
+  for (const auto& name : params.bls_composing_models) {
+    model.composing_models.push_back(name);
+  }
+
+  // Client-driven trace configuration: forward to the server's trace
+  // settings before load starts (reference --trace-level/rate/count).
+  if (!params.trace_level.empty() && params.service_kind == "triton" &&
+      params.protocol != "http") {
+    std::unique_ptr<InferenceServerGrpcClient> trace_client;
+    Error trace_err =
+        InferenceServerGrpcClient::Create(&trace_client, params.url);
+    if (trace_err.IsOk()) {
+      std::map<std::string, std::vector<std::string>> settings;
+      settings["trace_level"] = {params.trace_level};
+      if (params.trace_rate > 0) {
+        settings["trace_rate"] = {std::to_string(params.trace_rate)};
+      }
+      if (params.trace_count >= 0) {
+        settings["trace_count"] = {std::to_string(params.trace_count)};
+      }
+      inference::TraceSettingResponse trace_response;
+      trace_err = trace_client->UpdateTraceSettings(
+          &trace_response, params.model_name, settings);
+    }
+    if (!trace_err.IsOk()) {
+      fprintf(stderr, "warning: trace settings not applied: %s\n",
+              trace_err.Message().c_str());
+    }
+  }
 
   std::unique_ptr<MetricsManager> metrics;
   if (params.collect_metrics) {
@@ -263,6 +327,11 @@ int Run(int argc, char** argv) {
       periodic->Stop();
       return Error::Success;
     }
+    if (params.binary_search) {
+      return profiler.ProfileConcurrencyBinarySearch(
+          static_cast<ConcurrencyManager*>(m), params.concurrency_start,
+          params.concurrency_end, &results);
+    }
     return profiler.ProfileConcurrencyRange(
         static_cast<ConcurrencyManager*>(m), params.concurrency_start,
         params.concurrency_end, params.concurrency_step, &results);
@@ -293,8 +362,20 @@ int Run(int argc, char** argv) {
         sequence_manager.get());
   }
 
+  // Multi-client scale-out: rank-synchronized start/stop so every
+  // MPI process measures the same window (reference --enable-mpi).
+  MPIDriver mpi(params.enable_mpi);
+  if (params.enable_mpi) {
+    mpi.MPIInit();
+    mpi.MPIBarrierWorld();
+  }
+
   err = profile(manager.get());
   manager->Cleanup();
+  if (params.enable_mpi) {
+    mpi.MPIBarrierWorld();
+    mpi.MPIFinalize();
+  }
   if (!err.IsOk()) {
     fprintf(stderr, "perf failed: %s\n", err.Message().c_str());
     return 1;
@@ -302,7 +383,8 @@ int Run(int argc, char** argv) {
 
   PrintReport(results, mode, params.percentile);
   if (!params.latency_report_file.empty()) {
-    err = WriteCsv(params.latency_report_file, results, mode);
+    err = WriteCsv(params.latency_report_file, results, mode,
+                   params.verbose_csv);
     if (!err.IsOk()) fprintf(stderr, "warning: %s\n", err.Message().c_str());
   }
   if (!params.profile_export_file.empty()) {

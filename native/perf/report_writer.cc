@@ -90,13 +90,21 @@ void PrintReport(
 
 Error WriteCsv(
     const std::string& path, const std::vector<PerfStatus>& results,
-    LoadMode mode) {
+    LoadMode mode, bool verbose_csv) {
   std::ofstream out(path);
   if (!out) return Error("cannot write CSV file '" + path + "'");
   out << (mode == LoadMode::CONCURRENCY ? "Concurrency" : "Request Rate")
       << ",Inferences/Second,p50 latency,p90 latency,p95 latency,"
          "p99 latency,Avg latency,Std latency,Completed,Delayed,Errors,"
-         "Avg HBM Used (MiB),Max HBM Used (MiB),Avg HBM Utilization\n";
+         "Avg HBM Used (MiB),Max HBM Used (MiB),Avg HBM Utilization";
+  if (verbose_csv) {
+    // Server-side per-window breakdown columns (reference
+    // --verbose-csv adds the queue/compute column set).
+    out << ",Server Queue us,Server Compute Input us,"
+           "Server Compute Infer us,Server Compute Output us,"
+           "Server Inferences";
+  }
+  out << "\n";
   char line[512];
   for (const auto& status : results) {
     if (mode == LoadMode::CONCURRENCY) {
@@ -127,6 +135,34 @@ Error WriteCsv(
       out << line;
     } else {
       out << ",";
+    }
+    if (verbose_csv) {
+      uint64_t count = 0;
+      double queue_us = 0, in_us = 0, infer_us = 0, out_us = 0;
+      if (status.server_stats.IsObject() &&
+          status.server_stats.Has("model_stats")) {
+        const auto& entries = status.server_stats["model_stats"];
+        if (entries.IsArray() && !entries.AsArray().empty()) {
+          const auto& top = entries.AsArray().front();
+          if (top.IsObject() && top.Has("inference_count")) {
+            count = top["inference_count"].AsUint();
+            const auto& stats = top["inference_stats"];
+            auto us = [&](const char* key) -> double {
+              if (!stats.IsObject() || !stats.Has(key) || count == 0) {
+                return 0.0;
+              }
+              return stats[key]["ns"].AsDouble() / count / 1000.0;
+            };
+            queue_us = us("queue");
+            in_us = us("compute_input");
+            infer_us = us("compute_infer");
+            out_us = us("compute_output");
+          }
+        }
+      }
+      snprintf(line, sizeof(line), ",%.1f,%.1f,%.1f,%.1f,%llu", queue_us,
+               in_us, infer_us, out_us, (unsigned long long)count);
+      out << line;
     }
     out << "\n";
   }

@@ -22,7 +22,7 @@ void PrintReport(
 
 Error WriteCsv(
     const std::string& path, const std::vector<PerfStatus>& results,
-    LoadMode mode);
+    LoadMode mode, bool verbose_csv = false);
 
 Error ExportProfile(
     const std::string& path, const std::vector<PerfStatus>& results,

@@ -170,6 +170,61 @@ def test_native_perf_analyzer_in_process(native_build):
     assert "errors" not in proc.stdout, proc.stdout
 
 
+def test_native_perf_analyzer_binary_search(native_build, live_server):
+    """--binary-search bisects the concurrency range for the highest
+    level under the latency threshold (reference
+    inference_profiler.h:280-325)."""
+    binary = native_build / "perf_analyzer"
+    proc = subprocess.run(
+        [str(binary), "-m", "simple", "-u", live_server["grpc"],
+         "--concurrency-range", "1:8", "--binary-search",
+         "-l", "2000",  # generous: everything passes, best = 8
+         "-p", "300", "-r", "2", "-s", "90"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    # The final (recommendation) row is the highest passing level.
+    lines = [line for line in proc.stdout.splitlines()
+             if line.startswith("Concurrency:")]
+    assert lines, proc.stdout
+    assert lines[-1].startswith("Concurrency: 8"), proc.stdout
+
+    # Impossible threshold: fails loudly instead of reporting garbage.
+    proc = subprocess.run(
+        [str(binary), "-m", "simple", "-u", live_server["grpc"],
+         "--concurrency-range", "1:4", "--binary-search",
+         "-l", "0.000001", "-p", "200", "-r", "1", "-s", "99"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode != 0
+    assert "meets the latency threshold" in proc.stdout + proc.stderr
+
+
+def test_native_perf_analyzer_request_parameter_and_count(
+        native_build, live_server, tmp_path):
+    """--request-parameter rides every request; --request-count
+    measures exactly one window of N requests; --verbose-csv adds the
+    server breakdown columns."""
+    binary = native_build / "perf_analyzer"
+    csv = tmp_path / "report.csv"
+    proc = subprocess.run(
+        [str(binary), "-m", "simple", "-u", live_server["grpc"],
+         "--concurrency-range", "2",
+         "--request-count", "40",
+         "--request-parameter", "custom_flag:true:bool",
+         "--request-parameter", "custom_level:7:int",
+         "-f", str(csv), "--verbose-csv"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    # Single-window fixed-count runs are by design, not "unstable".
+    assert "did not stabilize" not in proc.stdout, proc.stdout
+    header, row = csv.read_text().strip().splitlines()[:2]
+    assert "Server Queue us" in header
+    assert "Server Inferences" in header
+    assert len(row.split(",")) == len(header.split(","))
+
+
 @pytest.mark.parametrize("shm", ["none", "system", "tpu"])
 def test_native_perf_analyzer_e2e(native_build, live_server, shm):
     """The native perf_analyzer binary end-to-end against the live
