@@ -247,7 +247,8 @@ Error InferenceServerGrpcClient::Create(
 Error InferenceServerGrpcClient::Rpc(
     const std::string& method, const google::protobuf::Message& req,
     google::protobuf::Message* resp, const Headers& headers,
-    uint64_t timeout_us, RequestTimers* timers) {
+    uint64_t timeout_us, RequestTimers* timers,
+    const std::string& compression) {
   std::string request_bytes;
   if (!req.SerializeToString(&request_bytes)) {
     return Error("failed to serialize request");
@@ -259,7 +260,8 @@ Error InferenceServerGrpcClient::Rpc(
   }
   std::string response_bytes;
   Error err = channel_->UnaryCall(
-      method, request_bytes, &response_bytes, timeout_us, headers, timers);
+      method, request_bytes, &response_bytes, timeout_us, headers, timers,
+      compression);
   if (!err.IsOk()) return err;
   if (!resp->ParseFromString(response_bytes)) {
     return Error("failed to parse response");
@@ -531,7 +533,7 @@ Error InferenceServerGrpcClient::Infer(
     InferResult** result, const InferOptions& options,
     const std::vector<InferInput*>& inputs,
     const std::vector<const InferRequestedOutput*>& outputs,
-    const Headers& headers) {
+    const Headers& headers, const std::string& grpc_compression) {
   inference::ModelInferRequest request;
   Error err = PreRunProcessing(&request, options, inputs, outputs);
   if (!err.IsOk()) return err;
@@ -539,7 +541,7 @@ Error InferenceServerGrpcClient::Infer(
   RequestTimers timers;
   err = Rpc(
       Method("ModelInfer"), request, response.get(), headers,
-      options.client_timeout_us, &timers);
+      options.client_timeout_us, &timers, grpc_compression);
   UpdateInferStat(timers);
   if (!err.IsOk()) return err;
   return InferResultGrpc::Create(result, std::move(response));
@@ -549,7 +551,7 @@ Error InferenceServerGrpcClient::AsyncInfer(
     OnCompleteFn callback, const InferOptions& options,
     const std::vector<InferInput*>& inputs,
     const std::vector<const InferRequestedOutput*>& outputs,
-    const Headers& headers) {
+    const Headers& headers, const std::string& grpc_compression) {
   if (callback == nullptr) {
     return Error("callback must not be null for AsyncInfer");
   }
@@ -589,7 +591,7 @@ Error InferenceServerGrpcClient::AsyncInfer(
         cv_.notify_all();
         tracker->Sub();  // last: no member access beyond this point
       },
-      options.client_timeout_us, headers);
+      options.client_timeout_us, headers, grpc_compression);
   if (!call_err.IsOk()) inflight_->Sub();
   return call_err;
 }

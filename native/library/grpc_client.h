@@ -158,16 +158,21 @@ class InferenceServerGrpcClient : public InferenceServerClient {
   Error UnregisterTpuSharedMemory(
       const std::string& name = "", const Headers& headers = {});
 
+  // grpc_compression ("gzip"/"deflate"/"" ) compresses request
+  // messages per the gRPC wire spec (parity: the reference's
+  // grpc_compression_algorithm argument).
   Error Infer(
       InferResult** result, const InferOptions& options,
       const std::vector<InferInput*>& inputs,
       const std::vector<const InferRequestedOutput*>& outputs = {},
-      const Headers& headers = {});
+      const Headers& headers = {},
+      const std::string& grpc_compression = "");
   Error AsyncInfer(
       OnCompleteFn callback, const InferOptions& options,
       const std::vector<InferInput*>& inputs,
       const std::vector<const InferRequestedOutput*>& outputs = {},
-      const Headers& headers = {});
+      const Headers& headers = {},
+      const std::string& grpc_compression = "");
   Error InferMulti(
       std::vector<InferResult*>* results,
       const std::vector<InferOptions>& options,
@@ -206,7 +211,8 @@ class InferenceServerGrpcClient : public InferenceServerClient {
   // Serializes req, runs the unary RPC, parses into resp.
   Error Rpc(const std::string& method, const google::protobuf::Message& req,
             google::protobuf::Message* resp, const Headers& headers,
-            uint64_t timeout_us = 0, RequestTimers* timers = nullptr);
+            uint64_t timeout_us = 0, RequestTimers* timers = nullptr,
+            const std::string& compression = "");
 
   void DispatchLoop();
 

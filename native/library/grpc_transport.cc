@@ -2,6 +2,8 @@
 
 #include <cstring>
 
+#include "compression.h"
+
 namespace tpuclient {
 
 std::string PercentDecode(const std::string& in) {
@@ -27,16 +29,30 @@ std::string PercentDecode(const std::string& in) {
   return out;
 }
 
-std::string FrameGrpcMessage(const std::string& payload) {
+std::string FrameGrpcMessage(
+    const std::string& payload, const std::string& compression) {
+  const std::string* body = &payload;
+  std::string compressed;
+  bool flag = false;
+  if (compression == "gzip" || compression == "deflate") {
+    Error err = CompressBody(
+        compression == "gzip" ? CompressionType::GZIP
+                              : CompressionType::DEFLATE,
+        payload, &compressed);
+    if (err.IsOk()) {
+      body = &compressed;
+      flag = true;
+    }  // compression failure degrades to an uncompressed frame
+  }
   std::string framed;
-  framed.reserve(payload.size() + 5);
-  framed.push_back('\0');  // uncompressed
-  uint32_t len = static_cast<uint32_t>(payload.size());
+  framed.reserve(body->size() + 5);
+  framed.push_back(flag ? '\x01' : '\0');
+  uint32_t len = static_cast<uint32_t>(body->size());
   framed.push_back(static_cast<char>(len >> 24));
   framed.push_back(static_cast<char>(len >> 16));
   framed.push_back(static_cast<char>(len >> 8));
   framed.push_back(static_cast<char>(len));
-  framed.append(payload);
+  framed.append(*body);
   return framed;
 }
 
@@ -51,9 +67,19 @@ bool GrpcMessageReader::Feed(
         (static_cast<uint32_t>(static_cast<uint8_t>(buffer_[2])) << 16) |
         (static_cast<uint32_t>(static_cast<uint8_t>(buffer_[3])) << 8) |
         static_cast<uint8_t>(buffer_[4]);
-    if (flag == 1) return false;  // no compression negotiated
     if (buffer_.size() < 5u + msg_len) break;
-    messages->emplace_back(buffer_.substr(5, msg_len));
+    if (flag == 1) {
+      if (encoding_.empty() || encoding_ == "identity") {
+        return false;  // compressed frame, no encoding negotiated
+      }
+      std::string plain;
+      Error err =
+          DecompressBody(encoding_, buffer_.substr(5, msg_len), &plain);
+      if (!err.IsOk()) return false;
+      messages->push_back(std::move(plain));
+    } else {
+      messages->emplace_back(buffer_.substr(5, msg_len));
+    }
     buffer_.erase(0, 5 + msg_len);
   }
   return true;
@@ -147,6 +173,23 @@ h2::HeaderList GrpcChannel::BuildRequestHeaders(
 
 namespace {
 
+// Adds grpc-encoding / grpc-accept-encoding metadata for a
+// per-call message compression algorithm ("" = none).
+// The only supported message codings; anything else degrades to
+// uncompressed rather than sending a header/flag mismatch.
+bool SupportedGrpcCompression(const std::string& compression) {
+  return compression == "gzip" || compression == "deflate";
+}
+
+Headers WithCompressionHeaders(
+    const Headers& metadata, const std::string& compression) {
+  if (compression.empty()) return metadata;
+  Headers out = metadata;
+  out["grpc-encoding"] = compression;
+  out["grpc-accept-encoding"] = "gzip,deflate,identity";
+  return out;
+}
+
 // Shared state for one unary call, owned jointly by the caller (sync)
 // or nobody (async, callbacks keep it alive) and the H2 callbacks.
 struct UnaryState {
@@ -166,6 +209,9 @@ h2::StreamCallbacks MakeUnaryCallbacks(std::shared_ptr<UnaryState> state) {
   callbacks.on_headers = [state](const h2::HeaderList& headers) {
     std::lock_guard<std::mutex> lock(state->mutex);
     state->headers = headers;
+    for (const auto& kv : headers) {
+      if (kv.first == "grpc-encoding") state->reader.SetEncoding(kv.second);
+    }
   };
   callbacks.on_data = [state](const uint8_t* data, size_t len) {
     std::lock_guard<std::mutex> lock(state->mutex);
@@ -211,16 +257,19 @@ h2::StreamCallbacks MakeUnaryCallbacks(std::shared_ptr<UnaryState> state) {
 Error GrpcChannel::UnaryCall(
     const std::string& method, const std::string& request,
     std::string* response, uint64_t timeout_us, const Headers& metadata,
-    RequestTimers* timers) {
+    RequestTimers* timers, const std::string& compression_arg) {
+  const std::string compression =
+      SupportedGrpcCompression(compression_arg) ? compression_arg : "";
   auto state = std::make_shared<UnaryState>();
   state->timers.CaptureTimestamp(RequestTimers::Kind::REQUEST_START);
   std::string err;
   state->timers.CaptureTimestamp(RequestTimers::Kind::SEND_START);
   int32_t stream_id = conn_->StartStream(
-      BuildRequestHeaders(method, timeout_us, metadata),
+      BuildRequestHeaders(method, timeout_us,
+                          WithCompressionHeaders(metadata, compression)),
       MakeUnaryCallbacks(state), &err);
   if (stream_id < 0) return Error(err);
-  std::string framed = FrameGrpcMessage(request);
+  std::string framed = FrameGrpcMessage(request, compression);
   err = conn_->SendData(
       stream_id, reinterpret_cast<const uint8_t*>(framed.data()),
       framed.size(), /*end_stream=*/true);
@@ -268,17 +317,20 @@ Error GrpcChannel::UnaryCall(
 Error GrpcChannel::AsyncUnaryCall(
     const std::string& method, const std::string& request,
     AsyncUnaryCallback callback, uint64_t timeout_us,
-    const Headers& metadata) {
+    const Headers& metadata, const std::string& compression_arg) {
+  const std::string compression =
+      SupportedGrpcCompression(compression_arg) ? compression_arg : "";
   auto state = std::make_shared<UnaryState>();
   state->async_callback = std::move(callback);
   state->timers.CaptureTimestamp(RequestTimers::Kind::REQUEST_START);
   state->timers.CaptureTimestamp(RequestTimers::Kind::SEND_START);
   std::string err;
   int32_t stream_id = conn_->StartStream(
-      BuildRequestHeaders(method, timeout_us, metadata),
+      BuildRequestHeaders(method, timeout_us,
+                          WithCompressionHeaders(metadata, compression)),
       MakeUnaryCallbacks(state), &err);
   if (stream_id < 0) return Error(err);
-  std::string framed = FrameGrpcMessage(request);
+  std::string framed = FrameGrpcMessage(request, compression);
   // Once the stream is open, completion is owned by on_close — even
   // on a send error it fires (the stream already finished, or the
   // broken connection triggers FailAll), so never ALSO return an
@@ -357,6 +409,9 @@ Error GrpcChannel::StartBidiStream(
   callbacks.on_headers = [state](const h2::HeaderList& headers) {
     std::lock_guard<std::mutex> lock(state->mutex);
     state->headers = headers;
+    for (const auto& kv : headers) {
+      if (kv.first == "grpc-encoding") state->reader.SetEncoding(kv.second);
+    }
   };
   callbacks.on_data = [state](const uint8_t* data, size_t len) {
     std::vector<std::string> messages;

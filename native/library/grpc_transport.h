@@ -64,10 +64,13 @@ class GrpcChannel {
 
   // Synchronous unary call. `method` is "/package.Service/Method".
   // Fills `response` with the raw message bytes. Timeout 0 = none.
+  // `compression` ("gzip"/"deflate") compresses the request message
+  // per the gRPC wire spec and advertises grpc-accept-encoding.
   Error UnaryCall(
       const std::string& method, const std::string& request,
       std::string* response, uint64_t timeout_us = 0,
-      const Headers& metadata = {}, RequestTimers* timers = nullptr);
+      const Headers& metadata = {}, RequestTimers* timers = nullptr,
+      const std::string& compression = "");
 
   // Callback-async unary call; `callback(status, response_bytes,
   // timers)` fires on the connection reader thread.
@@ -76,7 +79,7 @@ class GrpcChannel {
   Error AsyncUnaryCall(
       const std::string& method, const std::string& request,
       AsyncUnaryCallback callback, uint64_t timeout_us = 0,
-      const Headers& metadata = {});
+      const Headers& metadata = {}, const std::string& compression = "");
 
   // Opens a bidi stream. `on_message(bytes)` per response message,
   // `on_done(status)` once at stream end; both on the reader thread.
@@ -124,15 +127,24 @@ Error StatusFromTrailers(
 class GrpcMessageReader {
  public:
   // Feed DATA bytes; complete messages are appended to *messages.
-  // Returns false on malformed framing (compressed flag set etc.).
+  // Returns false on malformed framing (or a compressed message when
+  // no encoding was negotiated).
   bool Feed(
       const uint8_t* data, size_t len, std::vector<std::string>* messages);
 
+  // Message-encoding from the response's grpc-encoding header;
+  // compressed-flag frames are inflated with it.
+  void SetEncoding(const std::string& encoding) { encoding_ = encoding; }
+
  private:
   std::string buffer_;
+  std::string encoding_;
 };
 
-// Frames one message: 0x00 flag + 4-byte BE length + payload.
-std::string FrameGrpcMessage(const std::string& payload);
+// Frames one message: flag byte + 4-byte BE length + payload.
+// `compression` ("gzip"/"deflate") compresses the payload and sets
+// the compressed flag (reference grpc_compression_algorithm parity).
+std::string FrameGrpcMessage(
+    const std::string& payload, const std::string& compression = "");
 
 }  // namespace tpuclient

@@ -28,6 +28,7 @@ class GrpcBackend : public ClientBackend {
   static Error Create(
       const BackendConfig& config, std::unique_ptr<ClientBackend>* backend) {
     auto b = std::unique_ptr<GrpcBackend>(new GrpcBackend());
+    b->grpc_compression_ = config.grpc_compression;
     Error err = InferenceServerGrpcClient::Create(
         &b->client_, config.url, config.verbose);
     if (!err.IsOk()) return err;
@@ -160,14 +161,16 @@ class GrpcBackend : public ClientBackend {
       InferResult** result, const InferOptions& options,
       const std::vector<InferInput*>& inputs,
       const std::vector<const InferRequestedOutput*>& outputs) override {
-    return client_->Infer(result, options, inputs, outputs);
+    return client_->Infer(result, options, inputs, outputs, {},
+                          grpc_compression_);
   }
 
   Error AsyncInfer(
       OnCompleteFn callback, const InferOptions& options,
       const std::vector<InferInput*>& inputs,
       const std::vector<const InferRequestedOutput*>& outputs) override {
-    return client_->AsyncInfer(std::move(callback), options, inputs, outputs);
+    return client_->AsyncInfer(std::move(callback), options, inputs, outputs,
+                               {}, grpc_compression_);
   }
 
   Error StartStream(OnCompleteFn callback) override {
@@ -200,6 +203,7 @@ class GrpcBackend : public ClientBackend {
 
  private:
   std::unique_ptr<InferenceServerGrpcClient> client_;
+  std::string grpc_compression_;
 };
 
 //==============================================================================

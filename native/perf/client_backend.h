@@ -60,6 +60,8 @@ struct BackendConfig {
   std::string inprocess_models;
   // TFSERVING: gRPC PredictionService (native protocol) vs REST.
   bool tfserving_grpc = true;
+  // gRPC message compression for Infer calls ("gzip"/"deflate"/"").
+  std::string grpc_compression;
   // TFSERVING: signature to invoke (reference --model-signature-name).
   std::string model_signature_name = "serving_default";
   // HTTPS for the HTTP client (TLS via dlopen'd OpenSSL).

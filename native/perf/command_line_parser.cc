@@ -83,6 +83,7 @@ enum LongOpt {
   kOptEnableMpi,
   kOptLogFrequency,
   kOptVersion,
+  kOptGrpcCompression,
 };
 
 const struct option kLongOptions[] = {
@@ -166,6 +167,8 @@ const struct option kLongOptions[] = {
     {"enable-mpi", no_argument, nullptr, kOptEnableMpi},
     {"log-frequency", required_argument, nullptr, kOptLogFrequency},
     {"version", no_argument, nullptr, kOptVersion},
+    {"grpc-compression-algorithm", required_argument, nullptr,
+     kOptGrpcCompression},
     {nullptr, 0, nullptr, 0},
 };
 
@@ -392,6 +395,16 @@ Error CLParser::Parse(
       case kOptVersion:
         printf("perf_analyzer (client_tpu native harness)\n");
         exit(0);
+      case kOptGrpcCompression:
+        params->grpc_compression_algorithm = optarg;
+        if (params->grpc_compression_algorithm != "none" &&
+            params->grpc_compression_algorithm != "gzip" &&
+            params->grpc_compression_algorithm != "deflate") {
+          return Error(
+              "--grpc-compression-algorithm must be none, gzip, or "
+              "deflate");
+        }
+        break;
       case kOptServiceKind:
         params->service_kind = optarg;
         if (params->service_kind != "triton" &&

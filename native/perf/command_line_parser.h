@@ -118,6 +118,9 @@ struct PerfAnalyzerParameters {
   // MPI multi-client rendezvous (reference --enable-mpi).
   bool enable_mpi = false;
 
+  // gRPC message compression (reference --grpc-compression-algorithm).
+  std::string grpc_compression_algorithm = "none";
+
   // Progress log every N completed requests in verbose mode
   // (reference --log-frequency).
   size_t log_frequency = 0;

@@ -105,6 +105,9 @@ int Run(int argc, char** argv) {
   backend_config.url = params.url;
   backend_config.verbose = params.verbose;
   backend_config.model_signature_name = params.model_signature_name;
+  if (params.grpc_compression_algorithm != "none") {
+    backend_config.grpc_compression = params.grpc_compression_algorithm;
+  }
   if (params.ssl_grpc_use_ssl) {
     // The from-scratch gRPC transport is cleartext HTTP/2; TLS rides
     // the HTTP client only (tls.h). Fail loudly, never silently.

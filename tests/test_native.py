@@ -225,6 +225,27 @@ def test_native_perf_analyzer_request_parameter_and_count(
     assert len(row.split(",")) == len(header.split(","))
 
 
+@pytest.mark.parametrize("mode", ["--async", "--sync"])
+@pytest.mark.parametrize("algorithm", ["gzip", "deflate"])
+def test_native_perf_analyzer_grpc_compression(
+        native_build, live_server, algorithm, mode):
+    """--grpc-compression-algorithm: request messages ride the gRPC
+    wire compressed (flag-1 frames + grpc-encoding); the grpcio server
+    decompresses natively, so an erroring run would prove a framing
+    bug."""
+    binary = native_build / "perf_analyzer"
+    proc = subprocess.run(
+        [str(binary), "-m", "simple", "-u", live_server["grpc"],
+         "--concurrency-range", "2", mode,
+         "--grpc-compression-algorithm", algorithm,
+         "-p", "300", "-r", "2", "-s", "90"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "throughput" in proc.stdout
+    assert "errors" not in proc.stdout, proc.stdout
+
+
 @pytest.mark.parametrize("shm", ["none", "system", "tpu"])
 def test_native_perf_analyzer_e2e(native_build, live_server, shm):
     """The native perf_analyzer binary end-to-end against the live
