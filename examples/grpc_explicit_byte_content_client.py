@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""Explicit BYTES contents: `bytes_contents` entries instead of the
+length-prefixed raw form (KServe-v2 allows both; the server must
+accept either).
+
+Start a server first:
+  python -m client_tpu.server.app --models simple_string
+(parity example: reference
+src/python/examples/grpc_explicit_byte_content_client.py)
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import grpc
+import numpy as np
+
+from client_tpu.protocol import inference_pb2 as pb
+from client_tpu.protocol.service import GRPCInferenceServiceStub
+from client_tpu.utils import deserialize_bytes_tensor
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+
+    channel = grpc.insecure_channel(args.url)
+    stub = GRPCInferenceServiceStub(channel)
+
+    request = pb.ModelInferRequest(model_name="simple_string")
+    values0 = [str(i).encode() for i in range(16)]
+    values1 = [b"1"] * 16
+    for name, values in (("INPUT0", values0), ("INPUT1", values1)):
+        tensor = request.inputs.add()
+        tensor.name = name
+        tensor.datatype = "BYTES"
+        tensor.shape.extend([16])
+        tensor.contents.bytes_contents.extend(values)  # typed, not raw
+    response = stub.ModelInfer(request)
+
+    sums = deserialize_bytes_tensor(response.raw_output_contents[0])
+    np.testing.assert_array_equal(
+        sums.astype(np.int32), np.arange(16) + 1)
+    channel.close()
+    print("PASS: explicit byte contents")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Explicit typed contents: builds the ModelInferRequest proto by hand
+with `int_contents` fields instead of raw_input_contents — the wire
+form clients in other ecosystems emit, which the server must also
+accept (KServe-v2 allows both).
+
+Start a server first:  python -m client_tpu.server.app --models simple
+(parity example: reference
+src/python/examples/grpc_explicit_int_content_client.py)
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import grpc
+import numpy as np
+
+from client_tpu.protocol import inference_pb2 as pb
+from client_tpu.protocol.service import GRPCInferenceServiceStub
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+
+    channel = grpc.insecure_channel(args.url)
+    stub = GRPCInferenceServiceStub(channel)
+
+    request = pb.ModelInferRequest(model_name="simple")
+    for name, values in (("INPUT0", range(16)), ("INPUT1", [1] * 16)):
+        tensor = request.inputs.add()
+        tensor.name = name
+        tensor.datatype = "INT32"
+        tensor.shape.extend([16])
+        tensor.contents.int_contents.extend(values)  # typed, not raw
+    response = stub.ModelInfer(request)
+
+    out0 = np.frombuffer(response.raw_output_contents[0], np.int32)
+    out1 = np.frombuffer(response.raw_output_contents[1], np.int32)
+    np.testing.assert_array_equal(out0, np.arange(16) + 1)
+    np.testing.assert_array_equal(out1, np.arange(16) - 1)
+    channel.close()
+    print("PASS: explicit int contents")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Memory-growth soak: hammers infer in a loop and asserts the client
+process RSS stabilizes — a leak in the request path (buffers, protos,
+response objects) shows up as monotonic growth.
+
+Start a server first:  python -m client_tpu.server.app --models simple
+(parity example: reference src/python/examples/memory_growth_test.py)
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import client_tpu.grpc as grpcclient
+
+
+def rss_bytes() -> int:
+    if not os.path.exists("/proc/self/statm"):  # non-Linux: no procfs
+        print("SKIP: /proc/self/statm unavailable on this platform")
+        print("PASS: memory stable (skipped)")
+        sys.exit(0)
+    with open("/proc/self/statm") as f:
+        return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    parser.add_argument("-n", "--iterations", type=int, default=2000)
+    parser.add_argument("--max-growth-mb", type=float, default=32.0)
+    args = parser.parse_args()
+
+    with grpcclient.InferenceServerClient(args.url) as client:
+        inputs = [
+            grpcclient.InferInput("INPUT0", [16], "INT32"),
+            grpcclient.InferInput("INPUT1", [16], "INT32"),
+        ]
+        inputs[0].set_data_from_numpy(np.arange(16, dtype=np.int32))
+        inputs[1].set_data_from_numpy(np.ones(16, dtype=np.int32))
+
+        warmup = max(args.iterations // 10, 50)
+        for _ in range(warmup):
+            client.infer("simple", inputs)
+        baseline = rss_bytes()
+        for i in range(args.iterations):
+            result = client.infer("simple", inputs)
+            assert result.as_numpy("OUTPUT0") is not None
+        growth = rss_bytes() - baseline
+        print("RSS growth over %d inferences: %.2f MiB"
+              % (args.iterations, growth / 2**20))
+        assert growth < args.max_growth_mb * 2**20, (
+            "memory grew %.1f MiB (> %.1f MiB budget)"
+            % (growth / 2**20, args.max_growth_mb))
+        print("PASS: memory stable")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""HTTP health and metadata surface: liveness, readiness, server and
+model metadata, model config, statistics, repository index.
+
+Start a server first:  python -m client_tpu.server.app --models simple
+(parity example: reference src/python/examples/simple_http_health_metadata.py)
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import client_tpu.http as httpclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args()
+
+    with httpclient.InferenceServerClient(args.url,
+                                          verbose=args.verbose) as client:
+        assert client.is_server_live(), "server not live"
+        assert client.is_server_ready(), "server not ready"
+        assert client.is_model_ready("simple"), "model not ready"
+
+        server_metadata = client.get_server_metadata()
+        print("server:", server_metadata["name"],
+              server_metadata.get("version", ""))
+        assert "extensions" in server_metadata
+
+        model_metadata = client.get_model_metadata("simple")
+        print("model:", model_metadata["name"],
+              "inputs:", [t["name"] for t in model_metadata["inputs"]])
+        assert {t["name"] for t in model_metadata["inputs"]} == {
+            "INPUT0", "INPUT1"}
+
+        config = client.get_model_config("simple")
+        config = config.get("config", config)
+        assert config["name"] == "simple"
+
+        index = client.get_model_repository_index()
+        names = [m["name"] for m in index]
+        assert "simple" in names, names
+
+        stats = client.get_inference_statistics("simple")
+        assert stats["model_stats"][0]["name"] == "simple"
+        print("PASS: http health + metadata")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Explicit model lifecycle over HTTP: load, infer, unload, index.
+
+Start a server first:  python -m client_tpu.server.app --models simple
+(parity example: reference src/python/examples/simple_http_model_control.py)
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import client_tpu.http as httpclient
+from client_tpu.utils import InferenceServerException
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    args = parser.parse_args()
+
+    with httpclient.InferenceServerClient(args.url) as client:
+        client.load_model("add_sub")
+        assert client.is_model_ready("add_sub")
+
+        in0 = np.random.randint(0, 100, 16).astype(np.int32)
+        in1 = np.random.randint(0, 100, 16).astype(np.int32)
+        inputs = [
+            httpclient.InferInput("INPUT0", [16], "INT32"),
+            httpclient.InferInput("INPUT1", [16], "INT32"),
+        ]
+        inputs[0].set_data_from_numpy(in0)
+        inputs[1].set_data_from_numpy(in1)
+        result = client.infer("add_sub", inputs)
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), in0 + in1)
+
+        client.unload_model("add_sub")
+        assert not client.is_model_ready("add_sub")
+        try:
+            client.infer("add_sub", inputs)
+            raise AssertionError("infer after unload should fail")
+        except InferenceServerException:
+            pass
+        print("PASS: http model control")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""Stateful sequences over HTTP: two interleaved sequences accumulate
+server-side, addressed by correlation id + start/end flags.
+
+Start a server first:
+  python -m client_tpu.server.app --models simple_sequence
+(parity example: reference
+src/python/examples/simple_http_sequence_sync_infer_client.py)
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import client_tpu.http as httpclient
+
+
+def send(client, inputs, seq_id, value, start=False, end=False):
+    inputs[0].set_data_from_numpy(np.array([value], dtype=np.int32))
+    result = client.infer(
+        "simple_sequence", inputs, sequence_id=seq_id,
+        sequence_start=start, sequence_end=end,
+    )
+    return int(result.as_numpy("OUTPUT")[0])
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    args = parser.parse_args()
+
+    values = [11, 7, 5, 3, 2, 0, 1]
+    with httpclient.InferenceServerClient(args.url) as client:
+        inputs = [httpclient.InferInput("INPUT", [1], "INT32")]
+        total_a = total_b = 0
+        for i, v in enumerate(values):
+            start, end = i == 0, i + 1 == len(values)
+            got_a = send(client, inputs, 1007, v, start, end)
+            got_b = send(client, inputs, 1008, -v, start, end)
+            total_a += v
+            total_b -= v
+            print("seq 1007 += %d -> %d | seq 1008 += %d -> %d"
+                  % (v, got_a, -v, got_b))
+            assert got_a == total_a and got_b == total_b
+        print("PASS: http sequence infer")
+
+
+if __name__ == "__main__":
+    main()

@@ -13,6 +13,9 @@ REPO = pathlib.Path(__file__).resolve().parent.parent
 EXAMPLES = REPO / "examples"
 
 GRPC_EXAMPLES = [
+    "grpc_explicit_int_content_client.py",
+    "grpc_explicit_byte_content_client.py",
+    "simple_grpc_keepalive_client.py",
     "simple_grpc_infer_client.py",
     "simple_grpc_string_infer_client.py",
     "simple_grpc_async_infer_client.py",
@@ -27,6 +30,9 @@ GRPC_EXAMPLES = [
 ]
 
 HTTP_EXAMPLES = [
+    "simple_http_health_metadata_client.py",
+    "simple_http_model_control_client.py",
+    "simple_http_sequence_sync_client.py",
     "simple_http_infer_client.py",
     "simple_http_async_infer_client.py",
     "simple_http_aio_infer_client.py",
@@ -190,3 +196,9 @@ def test_reuse_infer_objects(example_server):
 def test_custom_args_client(example_server):
     _run_example_args(
         "simple_grpc_custom_args_client.py", ["-u", example_server["grpc"]])
+
+
+def test_memory_growth(example_server):
+    _run_example_args(
+        "memory_growth_test.py",
+        ["-u", example_server["grpc"], "-n", "600"])
