@@ -19,10 +19,6 @@ import client_tpu.grpc as grpcclient
 
 
 def rss_bytes() -> int:
-    if not os.path.exists("/proc/self/statm"):  # non-Linux: no procfs
-        print("SKIP: /proc/self/statm unavailable on this platform")
-        print("PASS: memory stable (skipped)")
-        sys.exit(0)
     with open("/proc/self/statm") as f:
         return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
 
@@ -33,6 +29,11 @@ def main():
     parser.add_argument("-n", "--iterations", type=int, default=2000)
     parser.add_argument("--max-growth-mb", type=float, default=32.0)
     args = parser.parse_args()
+
+    if not os.path.exists("/proc/self/statm"):  # non-Linux: no procfs
+        print("SKIP: /proc/self/statm unavailable on this platform")
+        print("PASS: memory stable (skipped)")
+        return
 
     with grpcclient.InferenceServerClient(args.url) as client:
         inputs = [

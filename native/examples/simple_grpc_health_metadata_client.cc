@@ -1,0 +1,75 @@
+// Health + metadata surface over gRPC: liveness, readiness, server
+// and model metadata, config, statistics, repository index (parity
+// example: reference src/c++/examples/simple_grpc_health_metadata.cc).
+#include <cstring>
+#include <iostream>
+
+#include "grpc_client.h"
+
+namespace {
+const char* Url(int argc, char** argv, const char* fallback) {
+  for (int i = 1; i + 1 < argc; ++i) {
+    if (strcmp(argv[i], "-u") == 0) return argv[i + 1];
+  }
+  return fallback;
+}
+#define FAIL_IF_ERR(x, msg)                                         \
+  do {                                                              \
+    tpuclient::Error err__ = (x);                                   \
+    if (!err__.IsOk()) {                                            \
+      std::cerr << "error: " << msg << ": " << err__.Message()      \
+                << std::endl;                                       \
+      exit(1);                                                      \
+    }                                                               \
+  } while (0)
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::unique_ptr<tpuclient::InferenceServerGrpcClient> client;
+  FAIL_IF_ERR(tpuclient::InferenceServerGrpcClient::Create(
+                  &client, Url(argc, argv, "localhost:8001")),
+              "create client");
+
+  bool live = false, ready = false, model_ready = false;
+  FAIL_IF_ERR(client->IsServerLive(&live), "server live");
+  FAIL_IF_ERR(client->IsServerReady(&ready), "server ready");
+  FAIL_IF_ERR(client->IsModelReady(&model_ready, "simple"), "model ready");
+  if (!live || !ready || !model_ready) {
+    std::cerr << "server/model not ready\n";
+    return 1;
+  }
+
+  inference::ServerMetadataResponse server_metadata;
+  FAIL_IF_ERR(client->ServerMetadata(&server_metadata), "server metadata");
+  std::cout << "server: " << server_metadata.name() << " "
+            << server_metadata.version() << std::endl;
+
+  inference::ModelMetadataResponse model_metadata;
+  FAIL_IF_ERR(client->ModelMetadata(&model_metadata, "simple"),
+              "model metadata");
+  if (model_metadata.inputs_size() != 2) {
+    std::cerr << "expected 2 inputs\n";
+    return 1;
+  }
+
+  inference::ModelConfigResponse config;
+  FAIL_IF_ERR(client->ModelConfig(&config, "simple"), "model config");
+
+  inference::RepositoryIndexResponse index;
+  FAIL_IF_ERR(client->ModelRepositoryIndex(&index), "repository index");
+  bool found = false;
+  for (const auto& model : index.models()) {
+    if (model.name() == "simple") found = true;
+  }
+  if (!found) {
+    std::cerr << "'simple' missing from repository index\n";
+    return 1;
+  }
+
+  inference::ModelStatisticsResponse stats;
+  FAIL_IF_ERR(client->ModelInferenceStatistics(&stats, "simple"),
+              "statistics");
+
+  std::cout << "PASS: health + metadata" << std::endl;
+  return 0;
+}

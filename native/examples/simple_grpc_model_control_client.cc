@@ -1,0 +1,92 @@
+// Explicit model lifecycle over gRPC: load, infer, unload, verify
+// infer-after-unload fails (parity example: reference
+// src/c++/examples/simple_grpc_model_control.cc).
+#include <cstdint>
+#include <cstring>
+#include <iostream>
+#include <memory>
+
+#include "grpc_client.h"
+
+namespace {
+const char* Url(int argc, char** argv, const char* fallback) {
+  for (int i = 1; i + 1 < argc; ++i) {
+    if (strcmp(argv[i], "-u") == 0) return argv[i + 1];
+  }
+  return fallback;
+}
+#define FAIL_IF_ERR(x, msg)                                         \
+  do {                                                              \
+    tpuclient::Error err__ = (x);                                   \
+    if (!err__.IsOk()) {                                            \
+      std::cerr << "error: " << msg << ": " << err__.Message()      \
+                << std::endl;                                       \
+      exit(1);                                                      \
+    }                                                               \
+  } while (0)
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::unique_ptr<tpuclient::InferenceServerGrpcClient> client;
+  FAIL_IF_ERR(tpuclient::InferenceServerGrpcClient::Create(
+                  &client, Url(argc, argv, "localhost:8001")),
+              "create client");
+
+  FAIL_IF_ERR(client->LoadModel("add_sub"), "load model");
+  bool ready = false;
+  FAIL_IF_ERR(client->IsModelReady(&ready, "add_sub"), "model ready");
+  if (!ready) {
+    std::cerr << "add_sub not ready after load\n";
+    return 1;
+  }
+
+  int32_t in0[16], in1[16];
+  for (int i = 0; i < 16; ++i) { in0[i] = i; in1[i] = 2; }
+  tpuclient::InferInput* raw0;
+  tpuclient::InferInput* raw1;
+  tpuclient::InferInput::Create(&raw0, "INPUT0", {16}, "INT32");
+  tpuclient::InferInput::Create(&raw1, "INPUT1", {16}, "INT32");
+  std::unique_ptr<tpuclient::InferInput> input0(raw0), input1(raw1);
+  input0->AppendRaw(reinterpret_cast<uint8_t*>(in0), sizeof(in0));
+  input1->AppendRaw(reinterpret_cast<uint8_t*>(in1), sizeof(in1));
+
+  tpuclient::InferOptions options("add_sub");
+  tpuclient::InferResult* raw_result = nullptr;
+  FAIL_IF_ERR(client->Infer(&raw_result, options,
+                            {input0.get(), input1.get()}),
+              "infer");
+  std::unique_ptr<tpuclient::InferResult> result(raw_result);
+  const uint8_t* buf;
+  size_t len;
+  FAIL_IF_ERR(result->RawData("OUTPUT0", &buf, &len), "read output");
+  if (len != 16 * sizeof(int32_t)) {
+    std::cerr << "unexpected output size " << len << std::endl;
+    return 1;
+  }
+  const int32_t* sums = reinterpret_cast<const int32_t*>(buf);
+  for (int i = 0; i < 16; ++i) {
+    if (sums[i] != in0[i] + in1[i]) {
+      std::cerr << "bad sum at " << i << std::endl;
+      return 1;
+    }
+  }
+
+  FAIL_IF_ERR(client->UnloadModel("add_sub"), "unload model");
+  ready = true;
+  client->IsModelReady(&ready, "add_sub");
+  if (ready) {
+    std::cerr << "add_sub still ready after unload\n";
+    return 1;
+  }
+  tpuclient::InferResult* dead_result = nullptr;
+  tpuclient::Error err = client->Infer(&dead_result, options,
+                                       {input0.get(), input1.get()});
+  if (err.IsOk()) {
+    delete dead_result;
+    std::cerr << "infer after unload should fail\n";
+    return 1;
+  }
+
+  std::cout << "PASS: model control" << std::endl;
+  return 0;
+}

@@ -1,0 +1,95 @@
+// Callback-async HTTP inference: several requests in flight on the
+// worker pool, completions on callback threads (parity example:
+// reference src/c++/examples/simple_http_async_infer_client.cc).
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <iostream>
+#include <memory>
+#include <mutex>
+
+#include "http_client.h"
+
+namespace {
+const char* Url(int argc, char** argv, const char* fallback) {
+  for (int i = 1; i + 1 < argc; ++i) {
+    if (strcmp(argv[i], "-u") == 0) return argv[i + 1];
+  }
+  return fallback;
+}
+#define FAIL_IF_ERR(x, msg)                                         \
+  do {                                                              \
+    tpuclient::Error err__ = (x);                                   \
+    if (!err__.IsOk()) {                                            \
+      std::cerr << "error: " << msg << ": " << err__.Message()      \
+                << std::endl;                                       \
+      exit(1);                                                      \
+    }                                                               \
+  } while (0)
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::unique_ptr<tpuclient::InferenceServerHttpClient> client;
+  FAIL_IF_ERR(tpuclient::InferenceServerHttpClient::Create(
+                  &client, Url(argc, argv, "localhost:8000")),
+              "create client");
+
+  int32_t in0[16], in1[16];
+  for (int i = 0; i < 16; ++i) { in0[i] = i; in1[i] = 1; }
+  tpuclient::InferInput* raw0;
+  tpuclient::InferInput* raw1;
+  tpuclient::InferInput::Create(&raw0, "INPUT0", {16}, "INT32");
+  tpuclient::InferInput::Create(&raw1, "INPUT1", {16}, "INT32");
+  std::unique_ptr<tpuclient::InferInput> input0(raw0), input1(raw1);
+  input0->AppendRaw(reinterpret_cast<uint8_t*>(in0), sizeof(in0));
+  input1->AppendRaw(reinterpret_cast<uint8_t*>(in1), sizeof(in1));
+
+  constexpr int kRequests = 8;
+  std::mutex mutex;
+  std::condition_variable cv;
+  int outstanding = kRequests;
+  int failures = 0;
+
+  tpuclient::InferOptions options("simple");
+  for (int r = 0; r < kRequests; ++r) {
+    FAIL_IF_ERR(client->AsyncInfer(
+                    [&](tpuclient::InferResult* raw) {
+                      std::unique_ptr<tpuclient::InferResult> result(raw);
+                      bool ok = result->RequestStatus().IsOk();
+                      const uint8_t* buf = nullptr;
+                      size_t len = 0;
+                      if (ok) {
+                        ok = result->RawData("OUTPUT0", &buf, &len).IsOk() &&
+                             len == 16 * sizeof(int32_t);
+                      }
+                      if (ok) {
+                        const int32_t* sums =
+                            reinterpret_cast<const int32_t*>(buf);
+                        for (int i = 0; i < 16; ++i) {
+                          if (sums[i] != i + 1) ok = false;
+                        }
+                      }
+                      std::lock_guard<std::mutex> lock(mutex);
+                      if (!ok) ++failures;
+                      --outstanding;
+                      cv.notify_one();
+                    },
+                    options, {input0.get(), input1.get()}),
+                "async infer");
+  }
+  {
+    std::unique_lock<std::mutex> lock(mutex);
+    if (!cv.wait_for(lock, std::chrono::seconds(60),
+                     [&] { return outstanding == 0; })) {
+      std::cerr << "timed out waiting for callbacks\n";
+      return 1;
+    }
+  }
+  if (failures != 0) {
+    std::cerr << failures << " request(s) failed\n";
+    return 1;
+  }
+  std::cout << "PASS: http async infer (" << kRequests << " requests)"
+            << std::endl;
+  return 0;
+}

@@ -93,6 +93,14 @@ CPP_GRPC_EXAMPLES = [
     "simple_grpc_shm_client",
     "simple_grpc_tpushm_client",
     "simple_grpc_sequence_sync_client",
+    "simple_grpc_health_metadata_client",
+    "simple_grpc_model_control_client",
+]
+
+CPP_HTTP_EXAMPLES = [
+    "simple_http_infer_client",
+    "simple_http_string_infer_client",
+    "simple_http_async_infer_client",
 ]
 
 
@@ -114,8 +122,9 @@ def test_cpp_grpc_example(example_server, name):
     _run_native_example(name, example_server["grpc"])
 
 
-def test_cpp_http_example(example_server):
-    _run_native_example("simple_http_infer_client", example_server["http"])
+@pytest.mark.parametrize("name", CPP_HTTP_EXAMPLES)
+def test_cpp_http_example(example_server, name):
+    _run_native_example(name, example_server["http"])
 
 
 # -- image / ensemble / reuse clients (richer argument surfaces) ----------

@@ -1,0 +1,59 @@
+"""Race detection: builds the native tree with ThreadSanitizer and
+runs the most threading-heavy test binaries under it (SURVEY.md §5 —
+the reference configures no sanitizer jobs; the load managers,
+async clients, and channel cache here are all lock-based concurrent
+code, exactly what TSAN exists for)."""
+
+import os
+import pathlib
+import shutil
+import subprocess
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+NATIVE = REPO / "native"
+TSAN_BUILD = NATIVE / "build-tsan"
+
+
+@pytest.fixture(scope="module")
+def tsan_build():
+    if shutil.which("cmake") is None or shutil.which("ninja") is None:
+        pytest.skip("cmake/ninja not available")
+    if not (TSAN_BUILD / "build.ninja").exists():
+        proc = subprocess.run(
+            ["cmake", "-S", str(NATIVE), "-B", str(TSAN_BUILD),
+             "-G", "Ninja", "-DTPUCLIENT_SANITIZE=thread",
+             # The CPython-embedding backend is out of scope for TSAN
+             # (the interpreter itself is not TSAN-instrumented).
+             "-DCMAKE_DISABLE_FIND_PACKAGE_Python3=ON"],
+            capture_output=True, text=True, timeout=300,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+    proc = subprocess.run(
+        ["ninja", "-C", str(TSAN_BUILD), "test_core", "test_perf_harness",
+         "test_grpc_client"],
+        capture_output=True, text=True, timeout=900,
+    )
+    assert proc.returncode == 0, (proc.stdout[-2000:] + proc.stderr[-2000:])
+    return TSAN_BUILD
+
+
+@pytest.mark.parametrize(
+    "binary", ["test_core", "test_perf_harness", "test_grpc_client"])
+def test_tsan_clean(tsan_build, binary):
+    """halt_on_error turns any detected data race into a non-zero
+    exit; these binaries exercise the load managers' worker pools,
+    the mock backend's detached callback threads, and the async
+    client paths."""
+    proc = subprocess.run(
+        [str(tsan_build / binary)],
+        capture_output=True, text=True, timeout=600,
+        env=dict(os.environ, TSAN_OPTIONS="halt_on_error=1"),
+    )
+    assert "WARNING: ThreadSanitizer" not in proc.stdout + proc.stderr, (
+        proc.stdout[-3000:] + proc.stderr[-3000:]
+    )
+    assert proc.returncode == 0, (
+        proc.stdout[-3000:] + proc.stderr[-3000:]
+    )
