@@ -265,3 +265,19 @@ def test_register_duplicate_region(client):
     finally:
         client.unregister_system_shared_memory("dup")
         shm.destroy_shared_memory_region(handle)
+
+
+def test_sync_server_fallback():
+    """Both gRPC front-ends serve the same servicer: the asyncio
+    transport is the default; aio=False keeps the classic thread-pool
+    server working (also selectable via CLIENT_TPU_GRPC_AIO=0)."""
+    handle = start_grpc_server(load_models=["simple"], aio=False)
+    try:
+        with grpcclient.InferenceServerClient(handle.address) as c:
+            assert c.is_server_live()
+            in0, in1, inputs = _simple_inputs()
+            result = c.infer("simple", inputs)
+            np.testing.assert_array_equal(result.as_numpy("OUTPUT0"),
+                                          in0 + in1)
+    finally:
+        handle.stop()
