@@ -125,6 +125,20 @@ def build_native_harness(deadline_s: float) -> bool:
             built = binary.exists()
     except (subprocess.SubprocessError, OSError) as exc:
         log("NATIVE BUILD ERROR: %s" % exc)
+    if built:
+        # Best-effort extras: tpu_serverd (native serving front-end)
+        # gates only its own bench stage, never the harness.
+        try:
+            proc = subprocess.run(
+                ["cmake", "--build", str(REPO / "native" / "build"),
+                 "--target", "tpu_serverd"],
+                capture_output=True, text=True,
+                timeout=max(10.0, build_by - time.time()))
+            if proc.returncode != 0:
+                log("tpu_serverd build failed (stage will be skipped):\n%s"
+                    % proc.stderr[-1000:])
+        except (subprocess.SubprocessError, OSError) as exc:
+            log("tpu_serverd build error (stage will be skipped): %s" % exc)
     if not built and binary.exists():
         # A stale binary from an earlier build would silently bench
         # outdated code — quarantine it so the child falls back to the
@@ -167,6 +181,8 @@ def main() -> None:
     for head_key, head_name in (
         ("resnet50_tpu_shm_grpc",
          "resnet50_tpu_shm_grpc_batch8_c4_infer_per_sec"),
+        ("simple_grpc_native_server",
+         "simple_grpc_native_server_c4_infer_per_sec"),
         ("simple_grpc", "simple_grpc_c4_infer_per_sec"),
     ):
         if head_key in stages:

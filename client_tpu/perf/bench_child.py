@@ -305,6 +305,61 @@ def main() -> None:
         except Exception as exc:  # noqa: BLE001
             log("simple_inprocess failed: %s" % exc)
 
+    # Stage 2b: simple against tpu_serverd — the C++ gRPC front-end
+    # (native/server/) embedding the same core. `simple` is
+    # host-placed, so the daemon runs on the CPU platform and never
+    # contends for the TPU the live in-child server holds.
+    serverd = REPO / "native" / "build" / "tpu_serverd"
+    if binary and serverd.exists() and remaining() > 60:
+        daemon = None
+        try:
+            env = dict(os.environ, JAX_PLATFORMS="cpu",
+                       PALLAS_AXON_POOL_IPS="")
+            # New session so an orchestrator kill of this child can't
+            # orphan the daemon mid-init (we kill its whole group).
+            daemon = subprocess.Popen(
+                [str(serverd), "--port", "0", "--models", "simple"],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                text=True, cwd=str(REPO), env=env,
+                start_new_session=True)
+            import select
+
+            init_by = time.time() + min(120.0, max(30.0, remaining() - 30))
+            line = ""
+            while time.time() < init_by:
+                ready, _, _ = select.select([daemon.stdout], [], [], 1.0)
+                if ready:
+                    line = daemon.stdout.readline().strip()
+                    break
+                if daemon.poll() is not None:
+                    break
+            if not line.startswith("LISTENING "):
+                raise RuntimeError("tpu_serverd init: %r" % line)
+            address = "127.0.0.1:%s" % line.split()[1]
+            tput, p50 = run_native(binary, address, "simple",
+                                   batch=1, concurrency=4,
+                                   shared_memory="none", output_shm=0,
+                                   timeout=max(30.0, min(180.0, remaining())))
+            record_stage("simple_grpc_native_server", tput, p50,
+                         {"vs_baseline": round(tput / BASELINE_SIMPLE, 4)})
+        except Exception as exc:  # noqa: BLE001
+            log("simple_grpc_native_server failed: %s" % exc)
+        finally:
+            if daemon is not None:
+                import signal as _signal
+
+                try:
+                    os.killpg(daemon.pid, _signal.SIGTERM)
+                except OSError:
+                    daemon.terminate()
+                try:
+                    daemon.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    try:
+                        os.killpg(daemon.pid, _signal.SIGKILL)
+                    except OSError:
+                        daemon.kill()
+
     # Stage 3b: simple through the NATIVE in-process backend — the
     # C++ harness embedding the server core, no server process at all
     # (triton_c_api analogue). Subprocess so its embedded interpreter

@@ -151,6 +151,99 @@ def load_model(name: str) -> None:
     _require_core().load_model(name)
 
 
+#==============================================================================
+# Generic gRPC dispatch: the native server front-end (native/server/)
+# terminates HTTP/2 + gRPC framing in C++ and forwards each call here
+# by its wire path, so transport and servicer logic stay in one place.
+
+class GrpcAbort(Exception):
+    """An RPC failure carrying the numeric gRPC status code. __str__
+    formats as "[GRPC:<code>] <details>" which the native bridge
+    parses back into (code, message) for the grpc-status trailer."""
+
+    def __init__(self, code: int, details: str):
+        super().__init__("[GRPC:%d] %s" % (code, details))
+        self.code = code
+        self.details = details
+
+
+class _AbortContext:
+    """Stand-in for grpc.ServicerContext: servicers only ever call
+    abort() (which must raise) on it."""
+
+    def abort(self, code, details):
+        raise GrpcAbort(code.value[0], details)
+
+    def set_code(self, code):  # pragma: no cover - servicers use abort
+        pass
+
+    def set_details(self, details):  # pragma: no cover
+        pass
+
+
+_registry = None  # path -> (request_cls, handler, server_streaming)
+
+
+def _grpc_registry():
+    global _registry
+    if _registry is not None:
+        return _registry
+    core = _require_core()
+    from client_tpu.protocol import service as svc
+    from client_tpu.server.grpc_server import InferenceServicer
+
+    servicer = InferenceServicer(core)
+    registry = {}
+    for name, req_t, _resp_t, _cstream, sstream in svc._METHODS:
+        path = "/%s/%s" % (svc.SERVICE_NAME, name)
+        registry[path] = (req_t, getattr(servicer, name), sstream)
+    if core.memory.arena is not None:
+        from client_tpu.server import arena_service
+
+        arena_servicer = arena_service.TpuArenaServicer(core.memory.arena)
+        for name, req_t, _resp_t in arena_service._METHODS:
+            path = "/%s/%s" % (arena_service.SERVICE_NAME, name)
+            registry[path] = (req_t, getattr(arena_servicer, name), False)
+    _registry = registry
+    return registry
+
+
+def grpc_method_kind(path: str) -> str:
+    """"unary", "stream", or "" for an unknown path."""
+    entry = _grpc_registry().get(path)
+    if entry is None:
+        return ""
+    return "stream" if entry[2] else "unary"
+
+
+def grpc_call(path: str, request_bytes: bytes) -> bytes:
+    """Dispatches one unary RPC by wire path; returns the serialized
+    response. Unknown paths / servicer aborts raise GrpcAbort."""
+    entry = _grpc_registry().get(path)
+    if entry is None or entry[2]:
+        raise GrpcAbort(12, "unknown or non-unary method %s" % path)
+    req_t, handler, _ = entry
+    request = req_t()
+    request.ParseFromString(request_bytes)
+    response = handler(request, _AbortContext())
+    return response.SerializeToString()
+
+
+def grpc_stream_call(path: str, request_bytes: bytes) -> list:
+    """Dispatches one message of a bidi-streaming RPC; returns the
+    list of serialized responses it produced. Stream RPCs here map
+    each request independently (ModelStreamInfer semantics), so no
+    cross-call session state is needed."""
+    entry = _grpc_registry().get(path)
+    if entry is None or not entry[2]:
+        raise GrpcAbort(12, "unknown or non-stream method %s" % path)
+    req_t, handler, _ = entry
+    request = req_t()
+    request.ParseFromString(request_bytes)
+    responses = handler(iter([request]), _AbortContext())
+    return [r.SerializeToString() for r in responses]
+
+
 def shutdown() -> None:
     """Stops per-model batcher threads and drops the core (unload_model
     is the core's teardown verb; there is no process-level shutdown)."""

@@ -220,6 +220,8 @@ class AioGrpcServerThread:
                 for add_fn, servicer in extra_servicers:
                     add_fn(servicer, server)
                 self.port = server.add_insecure_port(address)
+                if self.port == 0:
+                    raise RuntimeError("unable to bind %s" % address)
                 await server.start()
             except Exception as exc:  # surface bind/setup errors to caller
                 error.append(exc)

@@ -1,0 +1,813 @@
+#include "h2_server.h"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <map>
+#include <mutex>
+
+#include "../library/grpc_transport.h"
+#include "../library/h2/hpack.h"
+
+namespace tpuclient {
+namespace server {
+
+namespace {
+
+constexpr uint8_t kFrameData = 0x0;
+constexpr uint8_t kFrameHeaders = 0x1;
+constexpr uint8_t kFrameRstStream = 0x3;
+constexpr uint8_t kFrameSettings = 0x4;
+constexpr uint8_t kFramePing = 0x6;
+constexpr uint8_t kFrameGoaway = 0x7;
+constexpr uint8_t kFrameWindowUpdate = 0x8;
+constexpr uint8_t kFrameContinuation = 0x9;
+
+constexpr uint8_t kFlagEndStream = 0x1;
+constexpr uint8_t kFlagAck = 0x1;
+constexpr uint8_t kFlagEndHeaders = 0x4;
+constexpr uint8_t kFlagPadded = 0x8;
+constexpr uint8_t kFlagPriority = 0x20;
+
+constexpr uint16_t kSettingsInitialWindowSize = 0x4;
+constexpr uint16_t kSettingsMaxFrameSize = 0x5;
+
+// Same receive-side policy as the client transport
+// (native/library/h2/h2_connection.cc): advertise big windows and
+// re-credit every DATA frame immediately, so tensor uploads from
+// clients never stall on flow control.
+constexpr int64_t kOurInitialWindow = 1 << 24;  // 16 MB
+constexpr size_t kOurMaxFrameSize = 1 << 20;    // 1 MB
+
+const char kPreface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+constexpr size_t kPrefaceLen = sizeof(kPreface) - 1;
+
+void PutU32(char* p, uint32_t v) {
+  p[0] = static_cast<char>(v >> 24);
+  p[1] = static_cast<char>(v >> 16);
+  p[2] = static_cast<char>(v >> 8);
+  p[3] = static_cast<char>(v);
+}
+
+uint32_t GetU32(const char* p) {
+  const uint8_t* u = reinterpret_cast<const uint8_t*>(p);
+  return (static_cast<uint32_t>(u[0]) << 24) |
+         (static_cast<uint32_t>(u[1]) << 16) |
+         (static_cast<uint32_t>(u[2]) << 8) | u[3];
+}
+
+// grpc-message trailer values are percent-encoded (gRPC HTTP/2 spec);
+// encode anything outside the printable-ASCII safe set.
+std::string PercentEncode(const std::string& in) {
+  static const char* hex = "0123456789ABCDEF";
+  std::string out;
+  out.reserve(in.size());
+  for (unsigned char c : in) {
+    if (c >= 0x20 && c <= 0x7e && c != '%') {
+      out.push_back(static_cast<char>(c));
+    } else {
+      out.push_back('%');
+      out.push_back(hex[c >> 4]);
+      out.push_back(hex[c & 0xf]);
+    }
+  }
+  return out;
+}
+
+class WorkPool {
+ public:
+  explicit WorkPool(int workers) {
+    for (int i = 0; i < workers; ++i) {
+      threads_.emplace_back([this] { Loop(); });
+    }
+  }
+
+  ~WorkPool() { Stop(); }
+
+  void Submit(std::function<void()> job) {
+    {
+      std::lock_guard<std::mutex> lk(mutex_);
+      if (stopped_) return;
+      queue_.push_back(std::move(job));
+    }
+    cv_.notify_one();
+  }
+
+  void Stop() {
+    {
+      std::lock_guard<std::mutex> lk(mutex_);
+      if (stopped_) return;
+      stopped_ = true;
+    }
+    cv_.notify_all();
+    for (auto& t : threads_) t.join();
+    threads_.clear();
+  }
+
+ private:
+  void Loop() {
+    for (;;) {
+      std::function<void()> job;
+      {
+        std::unique_lock<std::mutex> lk(mutex_);
+        cv_.wait(lk, [this] { return stopped_ || !queue_.empty(); });
+        if (queue_.empty()) return;  // stopped
+        job = std::move(queue_.front());
+        queue_.pop_front();
+      }
+      job();
+    }
+  }
+
+  std::mutex mutex_;
+  std::condition_variable cv_;
+  std::deque<std::function<void()>> queue_;
+  std::vector<std::thread> threads_;
+  bool stopped_ = false;
+};
+
+}  // namespace
+
+//==============================================================================
+// Connection
+
+class Conn : public std::enable_shared_from_this<Conn> {
+ public:
+  Conn(int fd, GrpcHandler* handler, WorkPool* pool)
+      : fd_(fd), handler_(handler), pool_(pool) {}
+
+  ~Conn() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+  void Start() { reader_ = std::thread(&Conn::ReaderLoop, this); }
+
+  void ForceClose() {
+    dead_.store(true);
+    ::shutdown(fd_, SHUT_RDWR);
+    cv_.notify_all();
+  }
+
+  void Join() {
+    if (reader_.joinable()) reader_.join();
+  }
+
+  bool finished() const { return finished_.load(); }
+
+ private:
+  struct Stream {
+    std::string path;
+    int kind = 0;  // 1 unary, 2 bidi stream
+    GrpcMessageReader reader;
+    std::deque<std::string> pending;  // complete request messages
+    bool processing = false;
+    bool end_stream_received = false;
+    bool response_headers_sent = false;
+    bool closed = false;
+    bool got_any_message = false;
+    int64_t send_window = 65535;
+    // HEADERS/CONTINUATION accumulation.
+    std::string header_block;
+    bool in_header_block = false;
+    bool header_block_end_stream = false;
+  };
+
+  //----------------------------------------------------------------
+  // Write side (any thread; write_mutex_ serializes the socket).
+
+  std::string SendAll(const char* data, size_t len) {
+    size_t sent = 0;
+    while (sent < len) {
+      ssize_t n = ::send(fd_, data + sent, len - sent, MSG_NOSIGNAL);
+      if (n > 0) {
+        sent += static_cast<size_t>(n);
+        continue;
+      }
+      if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK ||
+                    errno == EINTR)) {
+        struct pollfd pfd = {fd_, POLLOUT, 0};
+        poll(&pfd, 1, 50);
+        continue;
+      }
+      return std::string("send failed: ") + strerror(errno);
+    }
+    return "";
+  }
+
+  std::string WriteFrame(uint8_t type, uint8_t flags, int32_t stream_id,
+                         const char* payload, size_t len) {
+    char header[9];
+    header[0] = static_cast<char>(len >> 16);
+    header[1] = static_cast<char>(len >> 8);
+    header[2] = static_cast<char>(len);
+    header[3] = static_cast<char>(type);
+    header[4] = static_cast<char>(flags);
+    PutU32(header + 5, static_cast<uint32_t>(stream_id));
+    std::string err = SendAll(header, 9);
+    if (!err.empty() || len == 0) return err;
+    return SendAll(payload, len);
+  }
+
+  void SendResponseHeaders(int32_t stream_id) {
+    h2::HeaderList headers = {{":status", "200"},
+                              {"content-type", "application/grpc"}};
+    std::string block = encoder_.Encode(headers);
+    std::lock_guard<std::mutex> wl(write_mutex_);
+    WriteFrame(kFrameHeaders, kFlagEndHeaders, stream_id, block.data(),
+               block.size());
+  }
+
+  void SendTrailers(int32_t stream_id, int status, const std::string& message,
+                    bool headers_sent) {
+    h2::HeaderList trailers;
+    if (!headers_sent) {
+      // Trailers-only response (gRPC over HTTP/2 spec).
+      trailers.push_back({":status", "200"});
+      trailers.push_back({"content-type", "application/grpc"});
+    }
+    trailers.push_back({"grpc-status", std::to_string(status)});
+    if (!message.empty()) {
+      trailers.push_back({"grpc-message", PercentEncode(message)});
+    }
+    std::string block = encoder_.Encode(trailers);
+    std::lock_guard<std::mutex> wl(write_mutex_);
+    WriteFrame(kFrameHeaders, kFlagEndHeaders | kFlagEndStream, stream_id,
+               block.data(), block.size());
+  }
+
+  // Frames `payload` as one gRPC message and sends it as DATA,
+  // honouring the peer's flow-control windows.
+  std::string SendMessage(int32_t stream_id, const std::string& payload) {
+    std::string framed = FrameGrpcMessage(payload);
+    size_t pos = 0;
+    while (pos < framed.size()) {
+      size_t chunk;
+      {
+        std::unique_lock<std::mutex> lock(mutex_);
+        auto it = streams_.find(stream_id);
+        if (it == streams_.end() || it->second->closed) {
+          return "stream closed";
+        }
+        auto stream = it->second;
+        cv_.wait(lock, [&] {
+          return dead_.load() || stream->closed ||
+                 (peer_conn_window_ > 0 && stream->send_window > 0);
+        });
+        if (dead_.load()) return "connection closed";
+        if (stream->closed) return "stream closed";
+        chunk = std::min<size_t>(
+            {framed.size() - pos, peer_max_frame_size_,
+             static_cast<size_t>(peer_conn_window_),
+             static_cast<size_t>(stream->send_window)});
+        peer_conn_window_ -= chunk;
+        stream->send_window -= chunk;
+      }
+      std::lock_guard<std::mutex> wl(write_mutex_);
+      std::string e = WriteFrame(kFrameData, 0, stream_id,
+                                 framed.data() + pos, chunk);
+      if (!e.empty()) return e;
+      pos += chunk;
+    }
+    return "";
+  }
+
+  //----------------------------------------------------------------
+  // Reader side (connection's own thread).
+
+  bool ReadExact(char* buf, size_t len) {
+    size_t got = 0;
+    while (got < len) {
+      ssize_t n = ::recv(fd_, buf + got, len - got, 0);
+      if (n > 0) {
+        got += static_cast<size_t>(n);
+        continue;
+      }
+      if (n < 0 && errno == EINTR) continue;
+      return false;
+    }
+    return true;
+  }
+
+  void ReaderLoop() {
+    // Server SETTINGS + a big connection window, then the client
+    // preface. RFC 9113 §3.4: the server sends its SETTINGS first.
+    {
+      std::string settings;
+      auto add_setting = [&settings](uint16_t id, uint32_t value) {
+        char buf[6];
+        buf[0] = static_cast<char>(id >> 8);
+        buf[1] = static_cast<char>(id);
+        PutU32(buf + 2, value);
+        settings.append(buf, 6);
+      };
+      add_setting(kSettingsInitialWindowSize, kOurInitialWindow);
+      add_setting(kSettingsMaxFrameSize, kOurMaxFrameSize);
+      std::lock_guard<std::mutex> wl(write_mutex_);
+      std::string e =
+          WriteFrame(kFrameSettings, 0, 0, settings.data(), settings.size());
+      if (e.empty()) {
+        char wu[4];
+        PutU32(wu, (1u << 30) - 65535);
+        e = WriteFrame(kFrameWindowUpdate, 0, 0, wu, 4);
+      }
+      if (!e.empty()) {
+        Fail("handshake write failed");
+        return;
+      }
+    }
+    char preface[kPrefaceLen];
+    if (!ReadExact(preface, kPrefaceLen) ||
+        memcmp(preface, kPreface, kPrefaceLen) != 0) {
+      Fail("bad client preface");
+      return;
+    }
+    char header[9];
+    std::string payload;
+    while (!dead_.load()) {
+      if (!ReadExact(header, 9)) {
+        Fail("connection reset");
+        return;
+      }
+      size_t len =
+          (static_cast<size_t>(static_cast<uint8_t>(header[0])) << 16) |
+          (static_cast<size_t>(static_cast<uint8_t>(header[1])) << 8) |
+          static_cast<uint8_t>(header[2]);
+      uint8_t type = static_cast<uint8_t>(header[3]);
+      uint8_t flags = static_cast<uint8_t>(header[4]);
+      int32_t stream_id =
+          static_cast<int32_t>(GetU32(header + 5) & 0x7fffffffu);
+      if (len > kOurMaxFrameSize + 1024) {
+        Fail("oversized frame");
+        return;
+      }
+      payload.resize(len);
+      if (len > 0 && !ReadExact(&payload[0], len)) {
+        Fail("connection reset mid-frame");
+        return;
+      }
+      HandleFrame(type, flags, stream_id, payload);
+    }
+    finished_.store(true);
+  }
+
+  void HandleFrame(uint8_t type, uint8_t flags, int32_t stream_id,
+                   const std::string& payload) {
+    switch (type) {
+      case kFrameData:
+        HandleData(flags, stream_id, payload);
+        break;
+      case kFrameHeaders: {
+        size_t off = 0;
+        size_t len = payload.size();
+        if (flags & kFlagPadded) {
+          if (len < 1) break;
+          uint8_t pad = static_cast<uint8_t>(payload[0]);
+          off += 1;
+          if (len < off + pad) break;
+          len -= pad;
+        }
+        if (flags & kFlagPriority) {
+          if (len < off + 5) break;
+          off += 5;
+        }
+        auto stream = std::make_shared<Stream>();
+        {
+          std::lock_guard<std::mutex> lock(mutex_);
+          stream->send_window = peer_initial_window_;
+          streams_[stream_id] = stream;
+        }
+        stream->header_block.assign(payload, off, len - off);
+        stream->header_block_end_stream = (flags & kFlagEndStream) != 0;
+        stream->in_header_block = true;
+        if (flags & kFlagEndHeaders) {
+          HandleHeaderBlockDone(stream_id, stream);
+        }
+        break;
+      }
+      case kFrameContinuation: {
+        std::shared_ptr<Stream> stream = FindStream(stream_id);
+        if (!stream || !stream->in_header_block) break;
+        stream->header_block.append(payload);
+        if (flags & kFlagEndHeaders) {
+          HandleHeaderBlockDone(stream_id, stream);
+        }
+        break;
+      }
+      case kFrameSettings: {
+        if (flags & kFlagAck) break;
+        {
+          std::lock_guard<std::mutex> lock(mutex_);
+          for (size_t i = 0; i + 6 <= payload.size(); i += 6) {
+            uint16_t id =
+                (static_cast<uint16_t>(static_cast<uint8_t>(payload[i]))
+                 << 8) |
+                static_cast<uint8_t>(payload[i + 1]);
+            uint32_t value = GetU32(payload.data() + i + 2);
+            switch (id) {
+              case kSettingsInitialWindowSize: {
+                int64_t delta =
+                    static_cast<int64_t>(value) - peer_initial_window_;
+                peer_initial_window_ = value;
+                for (auto& kv : streams_) kv.second->send_window += delta;
+                break;
+              }
+              case kSettingsMaxFrameSize:
+                peer_max_frame_size_ = value;
+                break;
+              default:
+                break;
+            }
+          }
+        }
+        cv_.notify_all();
+        std::lock_guard<std::mutex> wl(write_mutex_);
+        WriteFrame(kFrameSettings, kFlagAck, 0, nullptr, 0);
+        break;
+      }
+      case kFramePing: {
+        if (!(flags & kFlagAck) && payload.size() == 8) {
+          std::lock_guard<std::mutex> wl(write_mutex_);
+          WriteFrame(kFramePing, kFlagAck, 0, payload.data(), 8);
+        }
+        break;
+      }
+      case kFrameWindowUpdate: {
+        if (payload.size() != 4) break;
+        uint32_t increment = GetU32(payload.data()) & 0x7fffffffu;
+        {
+          std::lock_guard<std::mutex> lock(mutex_);
+          if (stream_id == 0) {
+            peer_conn_window_ += increment;
+          } else {
+            auto it = streams_.find(stream_id);
+            if (it != streams_.end()) {
+              it->second->send_window += increment;
+            }
+          }
+        }
+        cv_.notify_all();
+        break;
+      }
+      case kFrameRstStream: {
+        std::lock_guard<std::mutex> lock(mutex_);
+        auto it = streams_.find(stream_id);
+        if (it != streams_.end()) {
+          it->second->closed = true;
+          if (!it->second->processing) streams_.erase(it);
+        }
+        cv_.notify_all();
+        break;
+      }
+      case kFrameGoaway:
+        Fail("client GOAWAY");
+        break;
+      default:
+        break;  // PRIORITY, PUSH_PROMISE (never valid from client), ...
+    }
+  }
+
+  void HandleHeaderBlockDone(int32_t stream_id,
+                             const std::shared_ptr<Stream>& stream) {
+    stream->in_header_block = false;
+    h2::HeaderList headers;
+    std::string err = decoder_.Decode(
+        reinterpret_cast<const uint8_t*>(stream->header_block.data()),
+        stream->header_block.size(), &headers);
+    stream->header_block.clear();
+    if (!err.empty()) {
+      Fail("HPACK error: " + err);
+      return;
+    }
+    if (!stream->path.empty()) {
+      // A second header block on an open request stream would be
+      // client trailers; gRPC clients don't send them — ignore.
+      return;
+    }
+    std::string encoding;
+    for (const auto& kv : headers) {
+      if (kv.first == ":path") stream->path = kv.second;
+      if (kv.first == "grpc-encoding") encoding = kv.second;
+    }
+    if (!encoding.empty()) stream->reader.SetEncoding(encoding);
+    stream->kind = handler_->MethodKind(stream->path);
+    if (stream->kind == 0) {
+      SendTrailers(stream_id, 12, "unknown method " + stream->path,
+                   /*headers_sent=*/false);
+      std::lock_guard<std::mutex> lock(mutex_);
+      streams_.erase(stream_id);
+      return;
+    }
+    if (stream->header_block_end_stream) {
+      {
+        std::lock_guard<std::mutex> lock(mutex_);
+        stream->end_stream_received = true;
+      }
+      Schedule(stream_id);
+    }
+  }
+
+  void HandleData(uint8_t flags, int32_t stream_id,
+                  const std::string& payload) {
+    std::shared_ptr<Stream> stream = FindStream(stream_id);
+    size_t data_len = payload.size();
+    const char* data = payload.data();
+    if (flags & kFlagPadded) {
+      if (payload.empty()) return;
+      uint8_t pad = static_cast<uint8_t>(payload[0]);
+      if (static_cast<size_t>(pad) + 1 > payload.size()) return;
+      data += 1;
+      data_len = payload.size() - 1 - pad;
+    }
+    if (stream && !stream->closed && data_len > 0) {
+      std::vector<std::string> messages;
+      if (!stream->reader.Feed(reinterpret_cast<const uint8_t*>(data),
+                               data_len, &messages)) {
+        SendTrailers(stream_id, 13, "malformed gRPC framing",
+                     stream->response_headers_sent);
+        std::lock_guard<std::mutex> lock(mutex_);
+        stream->closed = true;
+        if (!stream->processing) streams_.erase(stream_id);
+        return;
+      }
+      if (!messages.empty()) {
+        std::lock_guard<std::mutex> lock(mutex_);
+        for (auto& m : messages) {
+          stream->pending.push_back(std::move(m));
+        }
+        stream->got_any_message = true;
+      }
+    }
+    // Eagerly re-credit both windows (mirror of the client policy).
+    if (!payload.empty()) {
+      char wu[4];
+      PutU32(wu, static_cast<uint32_t>(payload.size()));
+      std::lock_guard<std::mutex> wl(write_mutex_);
+      WriteFrame(kFrameWindowUpdate, 0, 0, wu, 4);
+      if (!(flags & kFlagEndStream)) {
+        WriteFrame(kFrameWindowUpdate, 0, stream_id, wu, 4);
+      }
+    }
+    if (stream && (flags & kFlagEndStream)) {
+      std::lock_guard<std::mutex> lock(mutex_);
+      stream->end_stream_received = true;
+    }
+    if (stream) Schedule(stream_id);
+  }
+
+  std::shared_ptr<Stream> FindStream(int32_t stream_id) {
+    std::lock_guard<std::mutex> lock(mutex_);
+    auto it = streams_.find(stream_id);
+    return it == streams_.end() ? nullptr : it->second;
+  }
+
+  //----------------------------------------------------------------
+  // Dispatch (worker threads).
+
+  // Enqueues a worker for the stream unless one is already running.
+  void Schedule(int32_t stream_id) {
+    {
+      std::lock_guard<std::mutex> lock(mutex_);
+      auto it = streams_.find(stream_id);
+      if (it == streams_.end()) return;
+      auto& s = it->second;
+      if (s->processing || s->closed) return;
+      if (s->pending.empty() && !s->end_stream_received) return;
+      s->processing = true;
+    }
+    auto self = shared_from_this();
+    pool_->Submit([self, stream_id] { self->Work(stream_id); });
+  }
+
+  // Drains one stream's pending messages in order; a stream is only
+  // ever worked by one thread at a time, so per-stream dispatch order
+  // matches arrival order while different streams run in parallel.
+  void Work(int32_t stream_id) {
+    for (;;) {
+      std::shared_ptr<Stream> stream;
+      std::string message;
+      bool have = false;
+      bool finish = false;
+      {
+        std::lock_guard<std::mutex> lock(mutex_);
+        auto it = streams_.find(stream_id);
+        if (it == streams_.end()) return;
+        stream = it->second;
+        if (stream->closed) {
+          stream->processing = false;
+          streams_.erase(it);
+          return;
+        }
+        if (!stream->pending.empty()) {
+          message = std::move(stream->pending.front());
+          stream->pending.pop_front();
+          have = true;
+        } else if (stream->end_stream_received) {
+          finish = true;
+        } else {
+          stream->processing = false;
+          return;
+        }
+      }
+      if (have && stream->kind == 1) {
+        GrpcReply reply = handler_->Call(stream->path, message);
+        if (reply.status == 0 && !reply.responses.empty()) {
+          SendResponseHeaders(stream_id);
+          {
+            std::lock_guard<std::mutex> lock(mutex_);
+            stream->response_headers_sent = true;
+          }
+          SendMessage(stream_id, reply.responses.front());
+          SendTrailers(stream_id, 0, "", /*headers_sent=*/true);
+        } else if (reply.status == 0) {
+          SendTrailers(stream_id, 13, "handler produced no response",
+                       /*headers_sent=*/false);
+        } else {
+          SendTrailers(stream_id, reply.status, reply.message,
+                       /*headers_sent=*/false);
+        }
+        CloseStream(stream_id);
+        return;
+      }
+      if (have) {  // streaming message
+        GrpcReply reply = handler_->StreamCall(stream->path, message);
+        if (reply.status != 0) {
+          SendTrailers(stream_id, reply.status, reply.message,
+                       stream->response_headers_sent);
+          CloseStream(stream_id);
+          return;
+        }
+        bool need_headers;
+        {
+          std::lock_guard<std::mutex> lock(mutex_);
+          need_headers = !stream->response_headers_sent;
+          stream->response_headers_sent = true;
+        }
+        if (need_headers) SendResponseHeaders(stream_id);
+        for (const auto& response : reply.responses) {
+          if (!SendMessage(stream_id, response).empty()) {
+            CloseStream(stream_id);
+            return;
+          }
+        }
+        continue;  // more pending messages / wait for half-close
+      }
+      // finish: client half-closed and everything is dispatched.
+      if (finish) {
+        bool headers_sent;
+        {
+          std::lock_guard<std::mutex> lock(mutex_);
+          headers_sent = stream->response_headers_sent;
+        }
+        if (stream->kind == 1 && !stream->got_any_message) {
+          SendTrailers(stream_id, 13, "request message missing",
+                       headers_sent);
+        } else {
+          SendTrailers(stream_id, 0, "", headers_sent);
+        }
+        CloseStream(stream_id);
+        return;
+      }
+    }
+  }
+
+  void CloseStream(int32_t stream_id) {
+    std::lock_guard<std::mutex> lock(mutex_);
+    auto it = streams_.find(stream_id);
+    if (it != streams_.end()) {
+      it->second->closed = true;
+      it->second->processing = false;
+      streams_.erase(it);
+    }
+    cv_.notify_all();
+  }
+
+  void Fail(const std::string&) {
+    if (dead_.exchange(true)) return;
+    {
+      std::lock_guard<std::mutex> lock(mutex_);
+      for (auto& kv : streams_) kv.second->closed = true;
+    }
+    cv_.notify_all();
+    ::shutdown(fd_, SHUT_RDWR);
+    finished_.store(true);
+  }
+
+  int fd_;
+  GrpcHandler* handler_;
+  WorkPool* pool_;
+  std::thread reader_;
+  std::atomic<bool> dead_{false};
+  std::atomic<bool> finished_{false};
+
+  std::mutex write_mutex_;
+  h2::HpackEncoder encoder_;
+  h2::HpackDecoder decoder_;
+
+  std::mutex mutex_;  // guards everything below
+  std::condition_variable cv_;
+  std::map<int32_t, std::shared_ptr<Stream>> streams_;
+  int64_t peer_initial_window_ = 65535;
+  int64_t peer_conn_window_ = 65535;
+  size_t peer_max_frame_size_ = 16384;
+};
+
+//==============================================================================
+// H2Server
+
+struct H2Server::Impl {
+  explicit Impl(int workers) : pool(workers) {}
+  WorkPool pool;
+  std::mutex mutex;
+  std::vector<std::shared_ptr<Conn>> conns;
+};
+
+H2Server::H2Server(GrpcHandler* handler, int workers)
+    : handler_(handler), workers_(workers),
+      impl_(new Impl(workers)) {}
+
+H2Server::~H2Server() { Shutdown(); }
+
+std::string H2Server::Listen(const std::string& host, int port) {
+  listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (listen_fd_ < 0) return strerror(errno);
+  int one = 1;
+  setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+    return "bad listen host " + host;
+  }
+  if (bind(listen_fd_, reinterpret_cast<struct sockaddr*>(&addr),
+           sizeof(addr)) != 0) {
+    return std::string("bind failed: ") + strerror(errno);
+  }
+  if (listen(listen_fd_, 128) != 0) {
+    return std::string("listen failed: ") + strerror(errno);
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(listen_fd_, reinterpret_cast<struct sockaddr*>(&addr), &alen);
+  bound_port_ = ntohs(addr.sin_port);
+  accept_thread_ = std::thread(&H2Server::AcceptLoop, this);
+  return "";
+}
+
+void H2Server::AcceptLoop() {
+  while (!shutting_down_.load()) {
+    int fd = ::accept(listen_fd_, nullptr, nullptr);
+    if (fd < 0) {
+      if (errno == EINTR) continue;
+      return;  // listen socket closed
+    }
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    auto conn = std::make_shared<Conn>(fd, handler_, &impl_->pool);
+    {
+      std::lock_guard<std::mutex> lk(impl_->mutex);
+      // Opportunistically reap connections whose reader has exited.
+      auto& conns = impl_->conns;
+      for (size_t i = 0; i < conns.size();) {
+        if (conns[i]->finished()) {
+          conns[i]->Join();
+          conns.erase(conns.begin() + i);
+        } else {
+          ++i;
+        }
+      }
+      conns.push_back(conn);
+    }
+    conn->Start();
+  }
+}
+
+void H2Server::Shutdown() {
+  if (shutting_down_.exchange(true)) return;
+  if (listen_fd_ >= 0) {
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    ::close(listen_fd_);
+    listen_fd_ = -1;
+  }
+  if (accept_thread_.joinable()) accept_thread_.join();
+  std::vector<std::shared_ptr<Conn>> conns;
+  {
+    std::lock_guard<std::mutex> lk(impl_->mutex);
+    conns.swap(impl_->conns);
+  }
+  for (auto& conn : conns) conn->ForceClose();
+  // Workers may still hold references to conns; stop them before the
+  // connections are destroyed.
+  impl_->pool.Stop();
+  for (auto& conn : conns) conn->Join();
+}
+
+}  // namespace server
+}  // namespace tpuclient

@@ -1,0 +1,83 @@
+// Server-side HTTP/2 (RFC 9113) + gRPC framing: the transport under
+// tpu_serverd, the native front-end for the inference server core.
+//
+// The reference repo is client-only — its servers are the Triton
+// binaries it talks to. This framework serves its own models, and the
+// Python grpc front-ends (sync ~1.1k simple infer/s, asyncio ~1.9k)
+// leave most of the embedded core's ~40k infer/s on the table. This
+// C++ front-end terminates TCP/h2/HPACK/gRPC framing natively and
+// forwards each call to the embedded core (native/server/py_core),
+// so the only Python on the hot path is the servicer itself.
+//
+// Counterpart of the client-side transport in native/library/h2/
+// (same HPACK codec, same frame grammar, mirrored roles).
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <memory>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace tpuclient {
+namespace server {
+
+// Outcome of one dispatched call (unary: responses.size() <= 1).
+struct GrpcReply {
+  int status = 0;         // grpc-status trailer value (0 = OK)
+  std::string message;    // grpc-message when status != 0
+  std::vector<std::string> responses;  // serialized response protos
+};
+
+// Dispatch interface the transport calls into; the implementation
+// (PyCoreHandler) bridges to the embedded Python core. Called from
+// worker threads; implementations must be thread-safe.
+class GrpcHandler {
+ public:
+  virtual ~GrpcHandler() = default;
+  // 0 = unknown path, 1 = unary, 2 = bidi streaming.
+  virtual int MethodKind(const std::string& path) = 0;
+  // One unary request message -> reply.
+  virtual GrpcReply Call(const std::string& path,
+                         const std::string& message) = 0;
+  // One message of a bidi-streaming RPC -> zero or more responses.
+  virtual GrpcReply StreamCall(const std::string& path,
+                               const std::string& message) = 0;
+};
+
+class H2Server {
+ public:
+  // `workers`: dispatch threads shared across connections. The GIL
+  // serializes the Python servicer anyway; workers exist so slow
+  // calls on one stream don't head-of-line-block other streams at
+  // the transport level.
+  explicit H2Server(GrpcHandler* handler, int workers = 8);
+  ~H2Server();
+
+  H2Server(const H2Server&) = delete;
+  H2Server& operator=(const H2Server&) = delete;
+
+  // Binds and starts the accept loop. port 0 = ephemeral; see
+  // bound_port(). Returns "" on success.
+  std::string Listen(const std::string& host, int port);
+  int bound_port() const { return bound_port_; }
+
+  // Stops accepting, closes all connections, joins all threads.
+  void Shutdown();
+
+ private:
+  void AcceptLoop();
+
+  GrpcHandler* handler_;
+  int workers_;
+  int listen_fd_ = -1;
+  int bound_port_ = 0;
+  std::atomic<bool> shutting_down_{false};
+  std::thread accept_thread_;
+  struct Impl;
+  std::unique_ptr<Impl> impl_;
+};
+
+}  // namespace server
+}  // namespace tpuclient

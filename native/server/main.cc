@@ -1,0 +1,83 @@
+// tpu_serverd — native gRPC front-end for the inference server core.
+//
+//   tpu_serverd --port 8001 --models simple,resnet50 [--workers 8]
+//
+// Terminates HTTP/2 + gRPC framing in C++ (native/server/h2_server)
+// and dispatches to the embedded Python core (client_tpu.server.embed)
+// — the full GRPCInferenceService + TpuArenaService surface at native
+// transport speed. Prints "LISTENING <port>" on stdout once ready so
+// harnesses can scrape the bound (possibly ephemeral) port.
+#include <signal.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include "h2_server.h"
+#include "py_core.h"
+
+namespace {
+
+std::atomic<bool> g_stop{false};
+
+void OnSignal(int) { g_stop.store(true); }
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string host = "127.0.0.1";
+  int port = 8001;
+  int workers = 8;
+  std::string models = "simple";
+  for (int i = 1; i < argc; ++i) {
+    std::string arg = argv[i];
+    auto next = [&]() -> const char* {
+      return (i + 1 < argc) ? argv[++i] : "";
+    };
+    if (arg == "--port" || arg == "-p") {
+      port = atoi(next());
+    } else if (arg == "--host") {
+      host = next();
+    } else if (arg == "--models" || arg == "-m") {
+      models = next();
+    } else if (arg == "--workers") {
+      workers = atoi(next());
+    } else if (arg == "--help" || arg == "-h") {
+      printf(
+          "usage: tpu_serverd [--host H] [--port P] [--models a,b] "
+          "[--workers N]\n");
+      return 0;
+    } else {
+      fprintf(stderr, "unknown argument: %s\n", arg.c_str());
+      return 2;
+    }
+  }
+
+  tpuclient::server::PyCoreHandler handler;
+  fprintf(stderr, "initializing core (models=%s)...\n", models.c_str());
+  std::string err = handler.Init(models);
+  if (!err.empty()) {
+    fprintf(stderr, "core init failed: %s\n", err.c_str());
+    return 1;
+  }
+
+  tpuclient::server::H2Server server(&handler, workers);
+  err = server.Listen(host, port);
+  if (!err.empty()) {
+    fprintf(stderr, "listen failed: %s\n", err.c_str());
+    return 1;
+  }
+  printf("LISTENING %d\n", server.bound_port());
+  fflush(stdout);
+
+  signal(SIGINT, OnSignal);
+  signal(SIGTERM, OnSignal);
+  while (!g_stop.load()) {
+    usleep(100 * 1000);
+  }
+  fprintf(stderr, "shutting down\n");
+  server.Shutdown();
+  return 0;
+}

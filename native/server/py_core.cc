@@ -1,0 +1,194 @@
+#include "py_core.h"
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <unistd.h>
+
+#include <cstdlib>
+#include <mutex>
+#include <unordered_map>
+
+namespace tpuclient {
+namespace server {
+
+namespace {
+
+std::string RepoRootGuess() {
+  const char* env = std::getenv("TPUCLIENT_REPO_ROOT");
+  if (env != nullptr && env[0] != '\0') return env;
+  // Binary lives at <root>/native/build/tpu_serverd.
+  char buf[4096];
+  ssize_t n = readlink("/proc/self/exe", buf, sizeof(buf) - 1);
+  if (n > 0) {
+    std::string path(buf, n);
+    size_t cut = path.rfind("/native/build/");
+    if (cut != std::string::npos) return path.substr(0, cut);
+  }
+  return ".";
+}
+
+// Caller holds the GIL. Formats the pending exception; embed.GrpcAbort
+// stringifies as "[GRPC:<code>] <details>".
+std::string FetchPyError(const char* what) {
+  PyObject *type = nullptr, *value = nullptr, *trace = nullptr;
+  PyErr_Fetch(&type, &value, &trace);
+  std::string message = std::string(what) + " failed";
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char* text = PyUnicode_AsUTF8(s);
+      if (text != nullptr) message = text;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(trace);
+  return message;
+}
+
+// Maps an exception message to (grpc-status, details): "[GRPC:n] ..."
+// comes from embed.GrpcAbort; anything else is INTERNAL (13).
+void ParseAbort(const std::string& text, GrpcReply* reply) {
+  if (text.rfind("[GRPC:", 0) == 0) {
+    size_t close = text.find(']');
+    if (close != std::string::npos) {
+      reply->status = atoi(text.c_str() + 6);
+      size_t start = close + 1;
+      while (start < text.size() && text[start] == ' ') ++start;
+      reply->message = text.substr(start);
+      if (reply->status == 0) reply->status = 13;
+      return;
+    }
+  }
+  reply->status = 13;
+  reply->message = text;
+}
+
+}  // namespace
+
+struct PyCoreHandler::Impl {
+  PyObject* module = nullptr;
+  std::mutex kind_mutex;
+  std::unordered_map<std::string, int> kind_cache;
+};
+
+std::string PyCoreHandler::Init(const std::string& models_csv) {
+  impl_ = new Impl();
+  std::string repo = RepoRootGuess();
+  std::string pythonpath = repo;
+  // The embedded interpreter boots from the base install; graft the
+  // active venv's site-packages (jax & friends live there).
+  const char* venv = std::getenv("VIRTUAL_ENV");
+  std::string site = std::string(venv != nullptr ? venv : "/opt/venv") +
+                     "/lib/python" + std::to_string(PY_MAJOR_VERSION) + "." +
+                     std::to_string(PY_MINOR_VERSION) + "/site-packages";
+  if (access(site.c_str(), F_OK) == 0) pythonpath += ":" + site;
+  const char* existing = std::getenv("PYTHONPATH");
+  if (existing != nullptr && existing[0] != '\0') {
+    pythonpath += ":" + std::string(existing);
+  }
+  setenv("PYTHONPATH", pythonpath.c_str(), 1);
+
+  Py_InitializeEx(0);
+  impl_->module = PyImport_ImportModule("client_tpu.server.embed");
+  if (impl_->module == nullptr) {
+    std::string err = FetchPyError("import client_tpu.server.embed");
+    PyEval_SaveThread();
+    return err;
+  }
+  PyObject* r = PyObject_CallMethod(
+      impl_->module, "init", "s", models_csv.c_str());
+  std::string err;
+  if (r == nullptr) err = FetchPyError("embed.init");
+  Py_XDECREF(r);
+  // Release the GIL; transport worker threads take it per call.
+  PyEval_SaveThread();
+  return err;
+}
+
+int PyCoreHandler::MethodKind(const std::string& path) {
+  {
+    std::lock_guard<std::mutex> lk(impl_->kind_mutex);
+    auto it = impl_->kind_cache.find(path);
+    if (it != impl_->kind_cache.end()) return it->second;
+  }
+  int kind = 0;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* r = PyObject_CallMethod(
+      impl_->module, "grpc_method_kind", "s", path.c_str());
+  if (r != nullptr) {
+    const char* text = PyUnicode_AsUTF8(r);
+    if (text != nullptr) {
+      if (strcmp(text, "unary") == 0) kind = 1;
+      if (strcmp(text, "stream") == 0) kind = 2;
+    }
+    Py_DECREF(r);
+  } else {
+    PyErr_Clear();
+  }
+  PyGILState_Release(gil);
+  std::lock_guard<std::mutex> lk(impl_->kind_mutex);
+  impl_->kind_cache[path] = kind;
+  return kind;
+}
+
+GrpcReply PyCoreHandler::Call(const std::string& path,
+                              const std::string& message) {
+  GrpcReply reply;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* r = PyObject_CallMethod(
+      impl_->module, "grpc_call", "sy#", path.c_str(), message.data(),
+      (Py_ssize_t)message.size());
+  if (r == nullptr) {
+    ParseAbort(FetchPyError("grpc_call"), &reply);
+  } else {
+    char* data = nullptr;
+    Py_ssize_t size = 0;
+    if (PyBytes_AsStringAndSize(r, &data, &size) != 0) {
+      ParseAbort(FetchPyError("grpc_call result"), &reply);
+    } else {
+      reply.responses.emplace_back(data, (size_t)size);
+    }
+    Py_DECREF(r);
+  }
+  PyGILState_Release(gil);
+  return reply;
+}
+
+GrpcReply PyCoreHandler::StreamCall(const std::string& path,
+                                    const std::string& message) {
+  GrpcReply reply;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* r = PyObject_CallMethod(
+      impl_->module, "grpc_stream_call", "sy#", path.c_str(), message.data(),
+      (Py_ssize_t)message.size());
+  if (r == nullptr) {
+    ParseAbort(FetchPyError("grpc_stream_call"), &reply);
+  } else {
+    PyObject* seq = PySequence_Fast(r, "grpc_stream_call must return a list");
+    if (seq == nullptr) {
+      ParseAbort(FetchPyError("grpc_stream_call result"), &reply);
+    } else {
+      Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+      for (Py_ssize_t i = 0; i < n; ++i) {
+        PyObject* item = PySequence_Fast_GET_ITEM(seq, i);
+        char* data = nullptr;
+        Py_ssize_t size = 0;
+        if (PyBytes_AsStringAndSize(item, &data, &size) == 0) {
+          reply.responses.emplace_back(data, (size_t)size);
+        } else {
+          PyErr_Clear();
+        }
+      }
+      Py_DECREF(seq);
+    }
+    Py_DECREF(r);
+  }
+  PyGILState_Release(gil);
+  return reply;
+}
+
+}  // namespace server
+}  // namespace tpuclient

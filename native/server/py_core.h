@@ -1,0 +1,34 @@
+// GrpcHandler implementation that embeds CPython and dispatches every
+// RPC to client_tpu.server.embed.grpc_call / grpc_stream_call — the
+// server-side twin of the perf harness's in-process backend
+// (native/perf/inprocess_backend.cc), which embeds the same module
+// from the client direction.
+#pragma once
+
+#include <string>
+
+#include "h2_server.h"
+
+namespace tpuclient {
+namespace server {
+
+class PyCoreHandler : public GrpcHandler {
+ public:
+  // Initializes the interpreter and builds the server core, warming
+  // `models_csv` (comma-separated). Returns "" on success. Must be
+  // called once before the H2Server starts dispatching.
+  std::string Init(const std::string& models_csv);
+
+  int MethodKind(const std::string& path) override;
+  GrpcReply Call(const std::string& path,
+                 const std::string& message) override;
+  GrpcReply StreamCall(const std::string& path,
+                       const std::string& message) override;
+
+ private:
+  struct Impl;
+  Impl* impl_ = nullptr;  // leaked on purpose: lives for the process
+};
+
+}  // namespace server
+}  // namespace tpuclient
