@@ -100,6 +100,10 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--string-data", default=None)
     parser.add_argument("--shape", action="append", default=[],
                         help="name:d1,d2 overrides for variable dims")
+    parser.add_argument("--bls-composing-models", default="",
+                        help="comma-separated models a BLS/pipeline model "
+                             "calls; their server stats are paired with "
+                             "the top model's per window")
 
     parser.add_argument("--sequence-length", type=int, default=20)
     parser.add_argument("--sequence-length-variation", type=float,
@@ -157,8 +161,11 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
     setup_backend = factory.create()
     parser_obj = ModelParser()
     try:
-        model = parser_obj.parse(setup_backend, args.model_name,
-                                 args.model_version, args.batch_size)
+        model = parser_obj.parse(
+            setup_backend, args.model_name, args.model_version,
+            args.batch_size,
+            bls_composing_models=[
+                m for m in args.bls_composing_models.split(",") if m])
     except InferenceServerException as e:
         print("perf failed: %s" % e, file=sys.stderr)
         setup_backend.close()

@@ -907,6 +907,7 @@ class MockBackend(ClientBackend):
         fail_every: int = 0,
         model_metadata_dict: Optional[dict] = None,
         model_config_dict: Optional[dict] = None,
+        model_configs: Optional[dict] = None,
     ):
         self._delay = delay_s
         self.stats = stats if stats is not None else MockBackend.Stats()
@@ -925,6 +926,8 @@ class MockBackend(ClientBackend):
         self._config = model_config_dict or {
             "name": "mock", "max_batch_size": 0,
         }
+        # Per-model-name config overrides (composing-model tests).
+        self._configs = model_configs or {}
 
     def _maybe_fail(self):
         self._count += 1
@@ -950,6 +953,8 @@ class MockBackend(ClientBackend):
         return dict(self._metadata, name=model_name)
 
     def model_config(self, model_name, model_version=""):
+        if model_name in self._configs:
+            return dict(self._configs[model_name], name=model_name)
         return dict(self._config, name=model_name)
 
     def model_statistics(self, model_name="", model_version=""):

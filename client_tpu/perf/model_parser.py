@@ -37,13 +37,20 @@ class ParsedModel:
         self.scheduler_type = SchedulerType.NONE
         self.decoupled = False
         self.composing_models: List[str] = []
+        # True when any composing model is sequence-batched: the load
+        # manager must then drive sequences even though the top model
+        # is an ensemble (reference GetComposingSchedulerType).
+        self.composing_sequential = False
+        self.response_cache_enabled = False
 
 
 class ModelParser:
     """Builds a ParsedModel from backend metadata+config dicts."""
 
     def parse(self, backend, model_name: str, model_version: str = "",
-              batch_size: int = 1) -> ParsedModel:
+              batch_size: int = 1,
+              bls_composing_models: Optional[List[str]] = None
+              ) -> ParsedModel:
         metadata = backend.model_metadata(model_name, model_version)
         config = backend.model_config(model_name, model_version)
         model = ParsedModel()
@@ -84,14 +91,44 @@ class ModelParser:
 
         if "ensemble_scheduling" in config:
             model.scheduler_type = SchedulerType.ENSEMBLE
-            model.composing_models = [
-                step.get("model_name", "")
-                for step in config["ensemble_scheduling"].get("step", [])
-            ]
         elif "sequence_batching" in config:
             model.scheduler_type = SchedulerType.SEQUENCE
         elif "dynamic_batching" in config:
             model.scheduler_type = SchedulerType.DYNAMIC
         policy = config.get("model_transaction_policy", {})
         model.decoupled = bool(policy.get("decoupled", False))
+        cache = config.get("response_cache", {})
+        model.response_cache_enabled = bool(cache.get("enable", False))
+
+        # Composing models: ensemble steps (recursively — an ensemble
+        # step may itself be an ensemble) plus any BLS children named
+        # explicitly (a BLS pipeline's callees are invisible in the
+        # config, reference --bls-composing-models). Pairing their
+        # per-window stats with the top model's is what makes
+        # ensemble profiles add up.
+        seen = set()
+        self._add_composing(backend, config, model, seen)
+        for name in bls_composing_models or []:
+            self._add_child(backend, name, model, seen)
         return model
+
+    def _add_composing(self, backend, config: dict, model: ParsedModel,
+                       seen: set) -> None:
+        for step in config.get("ensemble_scheduling", {}).get("step", []):
+            name = step.get("model_name", "")
+            if name:
+                self._add_child(backend, name, model, seen)
+
+    def _add_child(self, backend, name: str, model: ParsedModel,
+                   seen: set) -> None:
+        if name in seen:
+            return
+        seen.add(name)
+        model.composing_models.append(name)
+        try:
+            child_config = backend.model_config(name)
+        except InferenceServerException:
+            return  # unavailable child: keep the name for stat pairing
+        if "sequence_batching" in child_config:
+            model.composing_sequential = True
+        self._add_composing(backend, child_config, model, seen)

@@ -247,7 +247,8 @@ def grpc_stream_call(path: str, request_bytes: bytes) -> list:
 def shutdown() -> None:
     """Stops per-model batcher threads and drops the core (unload_model
     is the core's teardown verb; there is no process-level shutdown)."""
-    global _core
+    global _core, _registry
+    _registry = None  # dispatch registry holds servicers bound to _core
     if _core is None:
         return
     core, _core = _core, None

@@ -1443,7 +1443,23 @@ class MockBackend : public ClientBackend {
       const std::string&) override {
     json::Object root;
     root["name"] = json::Value(model_name);
-    root["max_batch_size"] = json::Value(static_cast<int64_t>(8));
+    // Composing-model fixtures: "ensemble_top" -> "ensemble_mid" ->
+    // "seq_leaf" exercises the parser's recursive resolution.
+    if (model_name == "ensemble_top" || model_name == "ensemble_mid") {
+      std::string child =
+          model_name == "ensemble_top" ? "ensemble_mid" : "seq_leaf";
+      json::Object step;
+      step["model_name"] = json::Value(child);
+      json::Array steps;
+      steps.push_back(json::Value(std::move(step)));
+      json::Object scheduling;
+      scheduling["step"] = json::Value(std::move(steps));
+      root["ensemble_scheduling"] = json::Value(std::move(scheduling));
+    } else if (model_name == "seq_leaf") {
+      root["sequence_batching"] = json::Value(json::Object{});
+    } else {
+      root["max_batch_size"] = json::Value(static_cast<int64_t>(8));
+    }
     *config = json::Value(std::move(root));
     return Error::Success;
   }

@@ -48,12 +48,46 @@ void ParseTensors(
   }
 }
 
+// Appends `name` (and, recursively, its own ensemble steps) to the
+// composing-model list; sequence-batched children flip
+// composing_sequential. Unfetchable children keep their name so the
+// profiler can still pair whatever stats the server reports.
+void AddComposingModel(
+    ClientBackend* backend, const std::string& name, ParsedModel* model,
+    std::vector<std::string>* seen) {
+  for (const auto& s : *seen) {
+    if (s == name) return;
+  }
+  seen->push_back(name);
+  model->composing_models.push_back(name);
+  json::Value child;
+  if (!backend->ModelConfigJson(&child, name, "").IsOk()) return;
+  try {
+    if (child.Has("sequence_batching")) model->composing_sequential = true;
+    if (child.Has("ensemble_scheduling")) {
+      const json::Value& scheduling = child["ensemble_scheduling"];
+      if (scheduling.IsObject() && scheduling.Has("step") &&
+          scheduling["step"].IsArray()) {
+        for (const auto& step : scheduling["step"].AsArray()) {
+          if (step.IsObject() && step.Has("model_name")) {
+            AddComposingModel(
+                backend, step["model_name"].AsString(), model, seen);
+          }
+        }
+      }
+    }
+  } catch (const std::exception&) {
+    // Malformed child config: the name is already recorded.
+  }
+}
+
 }  // namespace
 
 Error ModelParser::Parse(
     ClientBackend* backend, const std::string& model_name,
     const std::string& model_version, int64_t batch_size,
-    ParsedModel* model) {
+    ParsedModel* model,
+    const std::vector<std::string>& bls_composing_models) {
   json::Value metadata, config;
   Error err = backend->ModelMetadataJson(&metadata, model_name, model_version);
   if (!err.IsOk()) return err;
@@ -85,6 +119,7 @@ Error ModelParser::Parse(
     ParseTensors(metadata, "inputs", model->max_batch_size, &model->inputs);
     ParseTensors(metadata, "outputs", model->max_batch_size, &model->outputs);
 
+    std::vector<std::string> seen;
     if (config.Has("ensemble_scheduling")) {
       model->scheduler_type = SchedulerType::ENSEMBLE;
       const json::Value& scheduling = config["ensemble_scheduling"];
@@ -92,8 +127,8 @@ Error ModelParser::Parse(
           scheduling["step"].IsArray()) {
         for (const auto& step : scheduling["step"].AsArray()) {
           if (step.IsObject() && step.Has("model_name")) {
-            model->composing_models.push_back(
-                step["model_name"].AsString());
+            AddComposingModel(
+                backend, step["model_name"].AsString(), model, &seen);
           }
         }
       }
@@ -107,6 +142,15 @@ Error ModelParser::Parse(
       if (policy.Has("decoupled")) {
         model->decoupled = policy["decoupled"].AsBool();
       }
+    }
+    if (config.Has("response_cache")) {
+      const auto& cache = config["response_cache"];
+      if (cache.Has("enable")) {
+        model->response_cache_enabled = cache["enable"].AsBool();
+      }
+    }
+    for (const auto& name : bls_composing_models) {
+      AddComposingModel(backend, name, model, &seen);
     }
   } catch (const std::exception& e) {
     return Error(

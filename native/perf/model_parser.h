@@ -32,10 +32,15 @@ struct ParsedModel {
   std::vector<ModelTensor> outputs;
   SchedulerType scheduler_type = SchedulerType::NONE;
   bool decoupled = false;
-  // Ensemble steps' model names (reference: composing-model metadata,
-  // model_parser.cc GetEnsembleSchedulerType) — the profiler pairs
+  // Ensemble steps' model names, resolved recursively (a step may be
+  // an ensemble itself) plus explicit BLS children (reference:
+  // model_parser.cc DetermineComposingModelMap) — the profiler pairs
   // their per-window server stats with the top model's.
   std::vector<std::string> composing_models;
+  // Any composing model is sequence-batched: drive sequences even
+  // though the top model is an ensemble (GetComposingSchedulerType).
+  bool composing_sequential = false;
+  bool response_cache_enabled = false;
 
   const ModelTensor* FindInput(const std::string& name) const;
 };
@@ -48,7 +53,8 @@ class ModelParser {
   static Error Parse(
       ClientBackend* backend, const std::string& model_name,
       const std::string& model_version, int64_t batch_size,
-      ParsedModel* model);
+      ParsedModel* model,
+      const std::vector<std::string>& bls_composing_models = {});
 };
 
 // Bytes per element for fixed-size datatypes; 0 for BYTES.

@@ -138,7 +138,7 @@ int Run(int argc, char** argv) {
   ParsedModel model;
   err = ModelParser::Parse(
       setup_backend.get(), params.model_name, params.model_version,
-      params.batch_size, &model);
+      params.batch_size, &model, params.bls_composing_models);
   if (!err.IsOk()) {
     fprintf(stderr, "error: %s\n", err.Message().c_str());
     return 1;
@@ -227,12 +227,6 @@ int Run(int argc, char** argv) {
   manager_options.num_of_sequences = params.num_of_sequences;
   manager_options.serial_sequences = params.serial_sequences;
   manager_options.request_parameters = params.request_parameters;
-
-  // BLS/pipeline composing models named on the CLI pair their
-  // per-window stats like ensemble steps do.
-  for (const auto& name : params.bls_composing_models) {
-    model.composing_models.push_back(name);
-  }
 
   // Client-driven trace configuration: forward to the server's trace
   // settings before load starts (reference --trace-level/rate/count).

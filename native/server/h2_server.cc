@@ -378,11 +378,20 @@ class Conn : public std::enable_shared_from_this<Conn> {
           if (len < off + 5) break;
           off += 5;
         }
-        auto stream = std::make_shared<Stream>();
+        std::shared_ptr<Stream> stream;
         {
           std::lock_guard<std::mutex> lock(mutex_);
-          stream->send_window = peer_initial_window_;
-          streams_[stream_id] = stream;
+          auto it = streams_.find(stream_id);
+          if (it != streams_.end()) {
+            // Second header block on an open stream = client
+            // trailers; feed HPACK to keep decoder state in sync but
+            // leave the in-flight stream untouched.
+            stream = it->second;
+          } else {
+            stream = std::make_shared<Stream>();
+            stream->send_window = peer_initial_window_;
+            streams_[stream_id] = stream;
+          }
         }
         stream->header_block.assign(payload, off, len - off);
         stream->header_block_end_stream = (flags & kFlagEndStream) != 0;
@@ -420,7 +429,12 @@ class Conn : public std::enable_shared_from_this<Conn> {
                 break;
               }
               case kSettingsMaxFrameSize:
-                peer_max_frame_size_ = value;
+                // RFC 9113 §6.5.2: valid range [2^14, 2^24-1]; a
+                // value below the floor would otherwise zero out
+                // SendMessage's chunk computation and spin.
+                if (value >= 16384 && value <= (1u << 24) - 1) {
+                  peer_max_frame_size_ = value;
+                }
                 break;
               default:
                 break;
@@ -526,12 +540,18 @@ class Conn : public std::enable_shared_from_this<Conn> {
       data += 1;
       data_len = payload.size() - 1 - pad;
     }
-    if (stream && !stream->closed && data_len > 0) {
+    bool stream_open = false;
+    bool headers_sent = false;
+    if (stream) {
+      std::lock_guard<std::mutex> lock(mutex_);
+      stream_open = !stream->closed;
+      headers_sent = stream->response_headers_sent;
+    }
+    if (stream_open && data_len > 0) {
       std::vector<std::string> messages;
       if (!stream->reader.Feed(reinterpret_cast<const uint8_t*>(data),
                                data_len, &messages)) {
-        SendTrailers(stream_id, 13, "malformed gRPC framing",
-                     stream->response_headers_sent);
+        SendTrailers(stream_id, 13, "malformed gRPC framing", headers_sent);
         std::lock_guard<std::mutex> lock(mutex_);
         stream->closed = true;
         if (!stream->processing) streams_.erase(stream_id);
@@ -595,6 +615,7 @@ class Conn : public std::enable_shared_from_this<Conn> {
       std::string message;
       bool have = false;
       bool finish = false;
+      bool got_any = false;
       {
         std::lock_guard<std::mutex> lock(mutex_);
         auto it = streams_.find(stream_id);
@@ -605,6 +626,7 @@ class Conn : public std::enable_shared_from_this<Conn> {
           streams_.erase(it);
           return;
         }
+        got_any = stream->got_any_message;
         if (!stream->pending.empty()) {
           message = std::move(stream->pending.front());
           stream->pending.pop_front();
@@ -666,7 +688,7 @@ class Conn : public std::enable_shared_from_this<Conn> {
           std::lock_guard<std::mutex> lock(mutex_);
           headers_sent = stream->response_headers_sent;
         }
-        if (stream->kind == 1 && !stream->got_any_message) {
+        if (stream->kind == 1 && !got_any) {
           SendTrailers(stream_id, 13, "request message missing",
                        headers_sent);
         } else {
@@ -736,34 +758,41 @@ H2Server::H2Server(GrpcHandler* handler, int workers)
 H2Server::~H2Server() { Shutdown(); }
 
 std::string H2Server::Listen(const std::string& host, int port) {
-  listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
-  if (listen_fd_ < 0) return strerror(errno);
+  int lfd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (lfd < 0) return strerror(errno);
   int one = 1;
-  setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
   struct sockaddr_in addr;
   memset(&addr, 0, sizeof(addr));
   addr.sin_family = AF_INET;
   addr.sin_port = htons(static_cast<uint16_t>(port));
   if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+    ::close(lfd);
     return "bad listen host " + host;
   }
-  if (bind(listen_fd_, reinterpret_cast<struct sockaddr*>(&addr),
+  if (bind(lfd, reinterpret_cast<struct sockaddr*>(&addr),
            sizeof(addr)) != 0) {
-    return std::string("bind failed: ") + strerror(errno);
+    std::string err = std::string("bind failed: ") + strerror(errno);
+    ::close(lfd);
+    return err;
   }
-  if (listen(listen_fd_, 128) != 0) {
-    return std::string("listen failed: ") + strerror(errno);
+  if (listen(lfd, 128) != 0) {
+    std::string err = std::string("listen failed: ") + strerror(errno);
+    ::close(lfd);
+    return err;
   }
   socklen_t alen = sizeof(addr);
-  getsockname(listen_fd_, reinterpret_cast<struct sockaddr*>(&addr), &alen);
+  getsockname(lfd, reinterpret_cast<struct sockaddr*>(&addr), &alen);
   bound_port_ = ntohs(addr.sin_port);
+  listen_fd_.store(lfd);
   accept_thread_ = std::thread(&H2Server::AcceptLoop, this);
   return "";
 }
 
 void H2Server::AcceptLoop() {
+  const int lfd = listen_fd_.load();
   while (!shutting_down_.load()) {
-    int fd = ::accept(listen_fd_, nullptr, nullptr);
+    int fd = ::accept(lfd, nullptr, nullptr);
     if (fd < 0) {
       if (errno == EINTR) continue;
       return;  // listen socket closed
@@ -791,12 +820,12 @@ void H2Server::AcceptLoop() {
 
 void H2Server::Shutdown() {
   if (shutting_down_.exchange(true)) return;
-  if (listen_fd_ >= 0) {
-    ::shutdown(listen_fd_, SHUT_RDWR);
-    ::close(listen_fd_);
-    listen_fd_ = -1;
-  }
+  // shutdown() wakes the blocked accept; the fd is closed only after
+  // the accept thread has exited so it can't be reused under it.
+  int lfd = listen_fd_.exchange(-1);
+  if (lfd >= 0) ::shutdown(lfd, SHUT_RDWR);
   if (accept_thread_.joinable()) accept_thread_.join();
+  if (lfd >= 0) ::close(lfd);
   std::vector<std::shared_ptr<Conn>> conns;
   {
     std::lock_guard<std::mutex> lk(impl_->mutex);

@@ -71,7 +71,7 @@ class H2Server {
 
   GrpcHandler* handler_;
   int workers_;
-  int listen_fd_ = -1;
+  std::atomic<int> listen_fd_{-1};
   int bound_port_ = 0;
   std::atomic<bool> shutting_down_{false};
   std::thread accept_thread_;

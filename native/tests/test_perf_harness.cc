@@ -57,6 +57,27 @@ TEST_CASE("perf: model parser over mock backend") {
   CHECK(!err.IsOk());
 }
 
+TEST_CASE("perf: model parser recursive composing + bls") {
+  Harness h;
+  ParsedModel model;
+  Error err =
+      ModelParser::Parse(h.backend.get(), "ensemble_top", "", 1, &model);
+  CHECK(err.IsOk());
+  CHECK(model.scheduler_type == SchedulerType::ENSEMBLE);
+  REQUIRE(model.composing_models.size() == 2u);
+  CHECK_EQ(model.composing_models[0], "ensemble_mid");
+  CHECK_EQ(model.composing_models[1], "seq_leaf");
+  CHECK(model.composing_sequential);
+
+  // BLS children named explicitly merge (and dedupe) into the map.
+  ParsedModel bls;
+  err = ModelParser::Parse(
+      h.backend.get(), "mock", "", 1, &bls, {"callee", "callee"});
+  CHECK(err.IsOk());
+  REQUIRE(bls.composing_models.size() == 1u);
+  CHECK_EQ(bls.composing_models[0], "callee");
+}
+
 TEST_CASE("perf: data loader random + json") {
   Harness h;
   const TensorData* data = nullptr;

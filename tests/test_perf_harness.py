@@ -102,6 +102,38 @@ def test_model_parser_scheduler_kinds():
     assert ModelParser().parse(backend, "m").decoupled
 
 
+def test_model_parser_recursive_composing():
+    """Ensemble steps that are themselves ensembles resolve
+    recursively; sequence-batched children flip composing_sequential
+    (reference DetermineComposingModelMap/GetComposingSchedulerType)."""
+    backend = MockBackend(
+        model_config_dict={
+            "name": "top",
+            "ensemble_scheduling": {"step": [{"model_name": "mid"}]},
+        },
+        model_configs={
+            "mid": {"ensemble_scheduling":
+                    {"step": [{"model_name": "leaf"}]}},
+            "leaf": {"sequence_batching": {}},
+        },
+    )
+    model = ModelParser().parse(backend, "top")
+    assert model.composing_models == ["mid", "leaf"]
+    assert model.composing_sequential
+
+
+def test_model_parser_bls_composing_and_cache():
+    backend = MockBackend(
+        model_config_dict={"name": "bls",
+                           "response_cache": {"enable": True}},
+        model_configs={"callee": {"max_batch_size": 4}},
+    )
+    model = ModelParser().parse(
+        backend, "bls", bls_composing_models=["callee", "callee"])
+    assert model.composing_models == ["callee"]  # deduped
+    assert model.response_cache_enabled
+
+
 # -- data loader -----------------------------------------------------------
 
 

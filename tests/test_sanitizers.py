@@ -32,7 +32,7 @@ def tsan_build():
         assert proc.returncode == 0, proc.stderr[-2000:]
     proc = subprocess.run(
         ["ninja", "-C", str(TSAN_BUILD), "test_core", "test_perf_harness",
-         "test_grpc_client"],
+         "test_grpc_client", "test_h2_server"],
         capture_output=True, text=True, timeout=900,
     )
     assert proc.returncode == 0, (proc.stdout[-2000:] + proc.stderr[-2000:])
@@ -40,7 +40,8 @@ def tsan_build():
 
 
 @pytest.mark.parametrize(
-    "binary", ["test_core", "test_perf_harness", "test_grpc_client"])
+    "binary", ["test_core", "test_perf_harness", "test_grpc_client",
+               "test_h2_server"])
 def test_tsan_clean(tsan_build, binary):
     """halt_on_error turns any detected data race into a non-zero
     exit; these binaries exercise the load managers' worker pools,
