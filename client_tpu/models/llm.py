@@ -158,8 +158,28 @@ def _attention(q, k, v, mask):
     return ctx.reshape(b, s, h, d)
 
 
+def ring_attention_fn(mesh, axis_name: str = "sp"):
+    """Drop-in attention for sequence-sharded full-sequence forwards:
+    rotates K/V shards around the ``axis_name`` ring instead of
+    letting GSPMD all-gather the full sequence (O(S_local) memory —
+    the long-context path). GQA heads are expanded to full heads
+    before the ring; the mask argument is ignored because the ring op
+    applies global causal masking itself."""
+    from client_tpu.parallel.ring_attention import ring_attention
+
+    def attn(q, k, v, mask):  # noqa: ARG001 - causal handled in-op
+        h, hkv = q.shape[2], k.shape[2]
+        if h != hkv:
+            k = jnp.repeat(k, h // hkv, axis=2)
+            v = jnp.repeat(v, h // hkv, axis=2)
+        return ring_attention(q, k, v, mesh, axis_name=axis_name,
+                              causal=True)
+
+    return attn
+
+
 def _block(layer, x, positions, mask, cfg: LlmConfig, cache=None,
-           cache_pos=None):
+           cache_pos=None, attention_fn=None):
     h = _rms_norm(x, layer["attn_norm"])
     q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"])
     k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"])
@@ -173,21 +193,24 @@ def _block(layer, x, positions, mask, cfg: LlmConfig, cache=None,
         cv = jax.lax.dynamic_update_slice(cv, v, (0, cache_pos, 0, 0))
         k, v = ck, cv
         new_cache = (ck, cv)
-    ctx = _attention(q, k, v, mask)
+    ctx = (attention_fn or _attention)(q, k, v, mask)
     x = x + jnp.einsum("bshk,hkd->bsd", ctx, layer["wo"])
     h = _rms_norm(x, layer["mlp_norm"])
     gated = jax.nn.silu(h @ layer["w_gate"]) * (h @ layer["w_up"])
     return x + gated @ layer["w_down"], new_cache
 
 
-def forward(params, tokens, cfg: LlmConfig):
-    """Full-sequence scoring forward: tokens [B,S] -> logits [B,S,V]."""
+def forward(params, tokens, cfg: LlmConfig, attention_fn=None):
+    """Full-sequence scoring forward: tokens [B,S] -> logits [B,S,V].
+    ``attention_fn`` swaps the attention op (ring_attention_fn for
+    sequence-parallel long-context runs)."""
     b, s = tokens.shape
     x = params["embed"][tokens]
     positions = jnp.broadcast_to(jnp.arange(s), (b, s))
     causal = jnp.tril(jnp.ones((s, s), dtype=bool))[None]
     for layer in params["layers"]:
-        x, _ = _block(layer, x, positions, causal, cfg)
+        x, _ = _block(layer, x, positions, causal, cfg,
+                      attention_fn=attention_fn)
     x = _rms_norm(x, params["final_norm"])
     return (x @ params["unembed"]).astype(jnp.float32)
 
@@ -232,6 +255,26 @@ def prefill(params, tokens, cache, cfg: LlmConfig, true_len=None):
     return logits, new_cache
 
 
+def decode_chunk(params, token, pos, cache, cfg: LlmConfig, length: int):
+    """Greedy-decodes ``length`` tokens entirely on device with
+    lax.scan: token/pos are traced scalars, the KV cache is the scan
+    carry. One host fetch retrieves the whole chunk, so the
+    host<->device round-trip cost (exaggerated ~100ms by the axon
+    relay on this image, but real on any PCIe/ICI hop) is paid once
+    per ``length`` tokens instead of per token. Returns
+    (token ids [length], cache)."""
+
+    def step(carry, _):
+        tok, p, c = carry
+        logits, c = decode_step(params, tok.reshape(1, 1), p, c, cfg)
+        nxt = jnp.argmax(logits[0]).astype(jnp.int32)
+        return (nxt, p + 1, c), nxt
+
+    (_, _, cache), tokens = jax.lax.scan(
+        step, (token.astype(jnp.int32), pos, cache), None, length=length)
+    return tokens, cache
+
+
 def decode_step(params, token, pos, cache, cfg: LlmConfig):
     """One token step: token [B,1], pos scalar; returns (logits [B,V],
     cache)."""
@@ -249,18 +292,21 @@ def decode_step(params, token, pos, cache, cfg: LlmConfig):
     return logits, new_cache
 
 
-def loss_fn(params, tokens, targets, cfg: LlmConfig):
-    logits = forward(params, tokens, cfg)
+def loss_fn(params, tokens, targets, cfg: LlmConfig, attention_fn=None):
+    logits = forward(params, tokens, cfg, attention_fn=attention_fn)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
     mask = (targets != PAD).astype(jnp.float32)
     return jnp.sum(nll[..., 0] * mask) / jnp.maximum(jnp.sum(mask), 1.0)
 
 
-def train_step(params, tokens, targets, cfg: LlmConfig, lr: float = 1e-3):
+def train_step(params, tokens, targets, cfg: LlmConfig, lr: float = 1e-3,
+               attention_fn=None):
     """SGD training step (forward + backward + update) — the function
-    the multi-chip dryrun jits over the mesh."""
-    loss, grads = jax.value_and_grad(partial(loss_fn, cfg=cfg))(
+    the multi-chip dryrun jits over the mesh. ``attention_fn`` selects
+    the attention op (ring attention for context-parallel runs)."""
+    loss, grads = jax.value_and_grad(
+        partial(loss_fn, cfg=cfg, attention_fn=attention_fn))(
         params, tokens, targets
     )
     new_params = jax.tree.map(
@@ -284,6 +330,8 @@ class LlmModel(ServedModel):
 
     decoupled = True
     platform = "jax"
+    # Tokens per device-side decode dispatch (and per host fetch).
+    STREAM_CHUNK = 8
 
     def __init__(self, name: str = "llm", cfg: Optional[LlmConfig] = None,
                  mesh=None, rules: ShardingRules = LLM_RULES,
@@ -318,8 +366,11 @@ class LlmModel(ServedModel):
         self._prefill = jax.jit(
             lambda p, t, c, n: prefill(p, t, c, cfg_static, true_len=n)
         )
-        self._decode = jax.jit(
-            lambda p, tok, pos, c: decode_step(p, tok, pos, c, cfg_static),
+        # Device-side multi-token loop: one dispatch + one host fetch
+        # per STREAM_CHUNK tokens (see decode_chunk).
+        self._decode_chunk = jax.jit(
+            lambda p, tok, pos, c: decode_chunk(
+                p, tok, pos, c, cfg_static, self.STREAM_CHUNK),
             donate_argnums=(3,),
         )
         self._cache = None
@@ -364,19 +415,28 @@ class LlmModel(ServedModel):
                 self._params, jnp.asarray(padded), cache, n)
             pos = n
             token = int(jnp.argmax(logits[0]))
-            for produced in range(max_tokens):
+            produced = 0
+            pending: list = []  # chunk tokens fetched but not yielded
+            while produced < max_tokens:
                 if token == EOS and not ignore_eos:
                     break
                 yield token
-                # decode only when another token will be consumed
-                if produced + 1 >= max_tokens or pos >= self.cfg.max_seq - 1:
+                produced += 1
+                if produced >= max_tokens:
                     break
-                logits, cache = self._decode(
-                    self._params, jnp.full((1, 1), token, dtype=jnp.int32),
-                    pos, cache,
-                )
-                pos += 1
-                token = int(jnp.argmax(logits[0]))
+                if not pending:
+                    if pos >= self.cfg.max_seq - 1:
+                        break
+                    # The final chunk may overrun the token budget; the
+                    # surplus is discarded and its clamped cache writes
+                    # land in slots no valid query ever attends to.
+                    toks, cache = self._decode_chunk(
+                        self._params, jnp.asarray(token, dtype=jnp.int32),
+                        pos, cache,
+                    )
+                    pending = [int(t) for t in jax.device_get(toks)]
+                    pos += len(pending)
+                token = pending.pop(0)
             self._return_cache(cache)
 
     def infer_stream(self, inputs, parameters=None

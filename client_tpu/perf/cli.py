@@ -204,8 +204,17 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
         tpu_arena_url=tpu_arena_url, batch_size=args.batch_size,
     )
 
+    if model.response_cache_enabled:
+        # Cache hits bypass queue/compute, so per-window server-stat
+        # breakdowns under-report work (reference perf_analyzer prints
+        # the same caveat when response_cache.enable is set).
+        print("note: model has response caching enabled; server-side "
+              "queue/compute breakdowns exclude cache hits",
+              file=sys.stderr)
+
     sequence_manager = None
-    if model.scheduler_type == SchedulerType.SEQUENCE or args.sequence_id_range:
+    if (model.scheduler_type == SchedulerType.SEQUENCE
+            or model.composing_sequential or args.sequence_id_range):
         start_id, id_range = 1, 2**31
         if args.sequence_id_range:
             parts = args.sequence_id_range.split(":")

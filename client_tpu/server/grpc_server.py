@@ -205,6 +205,8 @@ class AioGrpcServerThread:
 
         self._loop = asyncio.new_event_loop()
         self._server = None
+        self._stop_event = None
+        self._grace = 1.0
         self.port = 0
         started = threading.Event()
         error: list = []
@@ -228,8 +230,14 @@ class AioGrpcServerThread:
                 started.set()
                 return
             self._server = server
+            self._stop_event = asyncio.Event()
             started.set()
-            await server.wait_for_termination()
+            # Shutdown runs in THIS task once stop() sets the event —
+            # grpc.aio's stop() never completes when it races a
+            # pending wait_for_termination() on the same server (it
+            # hung for the full timeout even on an idle server).
+            await self._stop_event.wait()
+            await server.stop(self._grace)
 
         def _run():
             asyncio.set_event_loop(self._loop)
@@ -249,20 +257,18 @@ class AioGrpcServerThread:
                                % address)
 
     def stop(self, grace: float = 1.0):
-        import asyncio
         import logging
 
         if self._server is None:
             return
-        fut = asyncio.run_coroutine_threadsafe(
-            self._server.stop(grace), self._loop)
+        self._grace = grace
         try:
-            fut.result(timeout=grace + 10)
-        except Exception as exc:  # noqa: BLE001 — shutdown best-effort
+            self._loop.call_soon_threadsafe(self._stop_event.set)
+        except RuntimeError as exc:  # loop already closed by a racer
             logging.getLogger(__name__).warning(
-                "aio gRPC server shutdown did not complete cleanly: %r", exc)
+                "aio gRPC server stop signal not delivered: %s", exc)
         self._server = None
-        self._thread.join(timeout=5)
+        self._thread.join(timeout=grace + 15)
         if self._thread.is_alive():
             logging.getLogger(__name__).warning(
                 "aio gRPC server thread still alive after stop(); the "

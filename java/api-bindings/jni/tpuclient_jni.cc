@@ -1,0 +1,92 @@
+// JNI shim for tpuclient.bindings.NativeClient: a handle-per-channel
+// wrapper over the framework's own gRPC transport
+// (native/library/grpc_transport.h). Calls exchange serialized
+// ModelInferRequest/ModelInferResponse bytes, so no JNI-side proto
+// marshalling is needed. Built as libtpuclientjni.so by the
+// TPUCLIENT_JNI=ON CMake option (skipped when no JDK provides jni.h).
+#include <jni.h>
+
+#include <memory>
+#include <string>
+
+#include "grpc_transport.h"
+
+namespace {
+
+struct ClientHandle {
+  std::shared_ptr<tpuclient::GrpcChannel> channel;
+};
+
+void ThrowRuntime(JNIEnv* env, const std::string& message) {
+  jclass cls = env->FindClass("java/lang/RuntimeException");
+  if (cls != nullptr) env->ThrowNew(cls, message.c_str());
+}
+
+std::string JavaBytes(JNIEnv* env, jbyteArray array) {
+  jsize len = env->GetArrayLength(array);
+  std::string out(static_cast<size_t>(len), '\0');
+  env->GetByteArrayRegion(array, 0, len,
+                          reinterpret_cast<jbyte*>(&out[0]));
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+JNIEXPORT jlong JNICALL Java_tpuclient_bindings_NativeClient_create(
+    JNIEnv* env, jclass, jstring url) {
+  const char* chars = env->GetStringUTFChars(url, nullptr);
+  std::string target(chars != nullptr ? chars : "");
+  env->ReleaseStringUTFChars(url, chars);
+  auto handle = std::make_unique<ClientHandle>();
+  tpuclient::Error err =
+      tpuclient::GrpcChannel::Create(&handle->channel, target);
+  if (!err.IsOk()) return 0;
+  return reinterpret_cast<jlong>(handle.release());
+}
+
+JNIEXPORT jbyteArray JNICALL Java_tpuclient_bindings_NativeClient_infer(
+    JNIEnv* env, jclass, jlong handle, jbyteArray request) {
+  if (request == nullptr) {
+    jclass cls = env->FindClass("java/lang/NullPointerException");
+    if (cls != nullptr) env->ThrowNew(cls, "request must not be null");
+    return nullptr;
+  }
+  auto* client = reinterpret_cast<ClientHandle*>(handle);
+  std::string response;
+  tpuclient::Error err = client->channel->UnaryCall(
+      "/inference.GRPCInferenceService/ModelInfer",
+      JavaBytes(env, request), &response);
+  if (!err.IsOk()) {
+    ThrowRuntime(env, err.Message());
+    return nullptr;
+  }
+  jbyteArray out = env->NewByteArray(static_cast<jsize>(response.size()));
+  if (out != nullptr) {
+    env->SetByteArrayRegion(
+        out, 0, static_cast<jsize>(response.size()),
+        reinterpret_cast<const jbyte*>(response.data()));
+  }
+  return out;
+}
+
+JNIEXPORT jboolean JNICALL Java_tpuclient_bindings_NativeClient_isServerLive(
+    JNIEnv* env, jclass, jlong handle) {
+  auto* client = reinterpret_cast<ClientHandle*>(handle);
+  std::string response;
+  tpuclient::Error err = client->channel->UnaryCall(
+      "/inference.GRPCInferenceService/ServerLive", "", &response);
+  // ServerLiveResponse{live=true} encodes as {0x08, 0x01}.
+  return (err.IsOk() && response.size() >= 2 &&
+          static_cast<uint8_t>(response[0]) == 0x08 && response[1] == 1)
+             ? JNI_TRUE
+             : JNI_FALSE;
+}
+
+JNIEXPORT void JNICALL Java_tpuclient_bindings_NativeClient_destroy(
+    JNIEnv*, jclass, jlong handle) {
+  delete reinterpret_cast<ClientHandle*>(handle);
+}
+
+}  // extern "C"

@@ -1,0 +1,64 @@
+package tpuclient.bindings;
+
+/**
+ * JNI surface over the framework's C++ gRPC client
+ * (native/library/grpc_client.h, built as libtpugrpcclient.so with
+ * the JNI shim from java/api-bindings/jni/tpuclient_jni.cc).
+ *
+ * The exchange format is serialized ModelInferRequest /
+ * ModelInferResponse protobufs — the same bytes-in/bytes-out contract
+ * as the embedded-core surface — so this class carries no
+ * tensor-marshalling logic of its own; pair it with the wire codecs
+ * in the pure-Java client (java/src/main/java/tpuclient).
+ *
+ * Analogue of the reference's java-api-bindings (JavaCPP presets over
+ * the tritonserver C API).
+ */
+public final class NativeClient implements AutoCloseable {
+  static {
+    System.loadLibrary("tpuclientjni");
+  }
+
+  private long handle;
+
+  public NativeClient(String url) {
+    handle = create(url);
+    if (handle == 0) {
+      throw new IllegalStateException("failed to connect to " + url);
+    }
+  }
+
+  /** Serialized ModelInferRequest in, serialized ModelInferResponse
+   *  out; throws RuntimeException with the server's error text. */
+  public byte[] infer(byte[] requestProto) {
+    ensureOpen();
+    return infer(handle, requestProto);
+  }
+
+  public boolean isServerLive() {
+    ensureOpen();
+    return isServerLive(handle);
+  }
+
+  @Override
+  public void close() {
+    if (handle != 0) {
+      destroy(handle);
+      handle = 0;
+    }
+  }
+
+  private void ensureOpen() {
+    if (handle == 0) {
+      throw new IllegalStateException("client is closed");
+    }
+  }
+
+  private static native long create(String url);
+
+  private static native byte[] infer(long handle, byte[] requestProto);
+
+  private static native boolean isServerLive(long handle);
+
+  private static native void destroy(long handle);
+}

@@ -178,8 +178,15 @@ int Run(int argc, char** argv) {
       &model, &loader, shm_type, params.output_shm_size, arena_url,
       params.batch_size);
 
+  if (model.response_cache_enabled) {
+    fprintf(stderr,
+            "note: model has response caching enabled; server-side "
+            "queue/compute breakdowns exclude cache hits\n");
+  }
+
   std::unique_ptr<SequenceManager> sequence_manager;
   if (model.scheduler_type == SchedulerType::SEQUENCE ||
+      model.composing_sequential ||  // a composing model needs sequences
       !params.sequence_id_range.empty()) {
     uint64_t start_id = 1, id_range = 1ull << 31;
     if (!params.sequence_id_range.empty()) {

@@ -1,0 +1,53 @@
+"""Source-level checks for the JNI api-bindings (java/api-bindings):
+every Java native method must have a matching JNI export with the
+mangled name, and the shim must stay on the bytes-in/bytes-out
+contract. Compile/run coverage is JDK-gated (this image has none), the
+same tiering as tests/test_java_source.py."""
+
+import pathlib
+import re
+import shutil
+import subprocess
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+BINDINGS = REPO / "java" / "api-bindings"
+JAVA_SRC = (BINDINGS / "src" / "main" / "java" / "tpuclient" / "bindings"
+            / "NativeClient.java")
+JNI_SRC = BINDINGS / "jni" / "tpuclient_jni.cc"
+
+
+def test_native_methods_have_jni_exports():
+    java = JAVA_SRC.read_text()
+    jni = JNI_SRC.read_text()
+    natives = re.findall(
+        r"private static native \S+ (\w+)\(", java)
+    assert sorted(natives) == ["create", "destroy", "infer", "isServerLive"]
+    for name in natives:
+        symbol = "Java_tpuclient_bindings_NativeClient_" + name
+        assert symbol in jni, "missing JNI export %s" % symbol
+
+
+def test_jni_shim_is_bytes_level():
+    """The shim must not re-implement tensor marshalling: it forwards
+    serialized protos over the native channel's UnaryCall."""
+    jni = JNI_SRC.read_text()
+    assert "/inference.GRPCInferenceService/ModelInfer" in jni
+    assert "UnaryCall" in jni
+    assert "InferInput" not in jni  # no typed marshalling in the shim
+
+
+def test_cmake_option_wires_the_target():
+    cmake = (REPO / "native" / "CMakeLists.txt").read_text()
+    assert "TPUCLIENT_JNI" in cmake
+    assert "tpuclient_jni.cc" in cmake
+
+
+def test_compile_when_jdk_present():
+    if shutil.which("javac") is None:
+        pytest.skip("no JDK in this image (source-level checks only)")
+    proc = subprocess.run(
+        ["javac", "-d", "/tmp/jni_bindings_classes", str(JAVA_SRC)],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr

@@ -59,6 +59,37 @@ def test_llm_generate_deterministic():
     assert run1["text_output"][0] == run2["text_output"][0]
 
 
+def test_llm_chunked_decode_matches_single_step():
+    """decode_chunk (device-side lax.scan loop, one fetch per chunk)
+    must reproduce the per-token decode_step sequence exactly —
+    chunking changes the host round-trip count, never the tokens."""
+    import jax
+    import jax.numpy as jnp
+
+    from client_tpu.models.llm import decode_chunk, decode_step, init_cache
+
+    model = LlmModel(name="llm_test", cfg=TINY_LLM)
+    params, cfg = model._params, model.cfg
+    prompt = jnp.full((1, 4), 7, dtype=jnp.int32)
+    from client_tpu.models.llm import prefill
+
+    logits, cache_a = prefill(params, prompt, init_cache(cfg, 1), cfg,
+                              true_len=4)
+    cache_b = jax.tree.map(jnp.copy, cache_a)
+    first = jnp.argmax(logits[0]).astype(jnp.int32)
+
+    chunk, _ = decode_chunk(params, first, 4, cache_a, cfg, length=6)
+    singles = []
+    token, pos = first, 4
+    for _ in range(6):
+        step_logits, cache_b = decode_step(
+            params, token.reshape(1, 1), pos, cache_b, cfg)
+        token = jnp.argmax(step_logits[0]).astype(jnp.int32)
+        singles.append(int(token))
+        pos += 1
+    assert [int(t) for t in np.asarray(chunk)] == singles
+
+
 def test_resnet_forward_shapes():
     model = ResNetModel(cfg=ResNetConfig(width=16, num_classes=10))
     out = model.infer({"INPUT": np.zeros((2, 224, 224, 3), np.float32)})
