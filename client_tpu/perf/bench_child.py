@@ -148,7 +148,8 @@ def run_native(binary: pathlib.Path, address: str, model: str, batch: int,
                concurrency: int, shared_memory: str, output_shm: int,
                timeout: float, warm: bool = False, streaming: bool = False,
                input_data: str | None = None, window_ms: int = 2000,
-               trials: int = 4, stability: int = 20) -> tuple[float, float]:
+               trials: int = 4, stability: int = 20,
+               protocol: str = "") -> tuple[float, float]:
     """One stable measurement via the C++ harness; (throughput, p50_us).
     ``warm=True`` runs a single short unmeasured pass first so one-time
     XLA utility-kernel compiles (batch fusion, output slicing) land
@@ -163,6 +164,8 @@ def run_native(binary: pathlib.Path, address: str, model: str, batch: int,
            "-s", "99" if warm else str(stability),
            "--max-threads", "8",
            "-f", csv]
+    if protocol:
+        cmd += ["-i", protocol]
     if streaming:
         cmd.append("--streaming")
     if input_data is not None:
@@ -318,13 +321,15 @@ def main() -> None:
     serverd = REPO / "native" / "build" / "tpu_serverd"
     if binary and serverd.exists() and remaining() > 60:
         daemon = None
+        http_line = None
         try:
             env = dict(os.environ, JAX_PLATFORMS="cpu",
                        PALLAS_AXON_POOL_IPS="")
             # New session so an orchestrator kill of this child can't
             # orphan the daemon mid-init (we kill its whole group).
             daemon = subprocess.Popen(
-                [str(serverd), "--port", "0", "--models", "simple"],
+                [str(serverd), "--port", "0", "--http-port", "0",
+                 "--models", "simple"],
                 stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
                 text=True, cwd=str(REPO), env=env,
                 start_new_session=True)
@@ -342,6 +347,7 @@ def main() -> None:
             if not line.startswith("LISTENING "):
                 raise RuntimeError("tpu_serverd init: %r" % line)
             address = "127.0.0.1:%s" % line.split()[1]
+            http_line = daemon.stdout.readline().strip()
             tput, p50 = run_native(binary, address, "simple",
                                    batch=1, concurrency=4,
                                    shared_memory="none", output_shm=0,
@@ -350,6 +356,23 @@ def main() -> None:
                          {"vs_baseline": round(tput / BASELINE_SIMPLE, 4)})
         except Exception as exc:  # noqa: BLE001
             log("simple_grpc_native_server failed: %s" % exc)
+        # HTTP front-end at concurrency 1: the same shape as the
+        # reference's published 1407.84 infer/s quick-start row
+        # (HTTP, concurrency 1) — a direct apples-to-apples datum.
+        try:
+            if daemon is not None and http_line is not None and \
+                    http_line.startswith("LISTENING-HTTP ") and \
+                    remaining() > 30:
+                http_address = "127.0.0.1:%s" % http_line.split()[1]
+                tput, p50 = run_native(
+                    binary, http_address, "simple", batch=1, concurrency=1,
+                    shared_memory="none", output_shm=0, protocol="http",
+                    timeout=max(30.0, min(180.0, remaining())))
+                record_stage(
+                    "simple_http_native_server_c1", tput, p50,
+                    {"vs_baseline": round(tput / BASELINE_SIMPLE, 4)})
+        except Exception as exc:  # noqa: BLE001
+            log("simple_http_native_server_c1 failed: %s" % exc)
         finally:
             if daemon is not None:
                 import signal as _signal

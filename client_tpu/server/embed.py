@@ -229,6 +229,21 @@ def grpc_call(path: str, request_bytes: bytes) -> bytes:
     return response.SerializeToString()
 
 
+def http_call(method: str, path: str, headers_json: str,
+              body: bytes) -> tuple:
+    """REST twin of grpc_call for the native HTTP/1.1 front-end:
+    returns (status:int, headers_json:str, body:bytes). Header names
+    in ``headers_json`` must be lower-cased by the transport."""
+    import json as _json
+
+    from client_tpu.server import http_embed
+
+    status, headers, payload = http_embed.http_call(
+        _require_core(), method, path,
+        _json.loads(headers_json) if headers_json else {}, body)
+    return status, _json.dumps(headers), payload
+
+
 def grpc_stream_call(path: str, request_bytes: bytes) -> list:
     """Dispatches one message of a bidi-streaming RPC; returns the
     list of serialized responses it produced. Stream RPCs here map

@@ -43,28 +43,16 @@ def _error_response(error: InferenceServerException) -> web.Response:
 
 
 def _pb_json(message) -> web.Response:
-    return web.json_response(
+    from client_tpu.server.http_embed import _int64_lists_to_ints
+
+    return web.json_response(_int64_lists_to_ints(
         json_format.MessageToDict(message, preserving_proto_field_name=True)
-    )
+    ))
 
 
-def _pick_encoding(accept_encoding: str) -> Optional[str]:
-    """First supported coding the client actually accepts: RFC 9110
-    token parsing, so 'gzip;q=0' (explicitly refused) or 'br' never
-    match (a bare substring test would)."""
-    for token in accept_encoding.split(","):
-        parts = token.strip().lower().split(";")
-        coding = parts[0].strip()
-        if coding not in ("gzip", "deflate"):
-            continue
-        refused = any(
-            p.strip().replace(" ", "") in ("q=0", "q=0.0", "q=0.00",
-                                           "q=0.000")
-            for p in parts[1:]
-        )
-        if not refused:
-            return coding
-    return None
+# RFC 9110 Accept-Encoding negotiation shared with the native REST
+# front-end's dispatcher.
+from client_tpu.server.http_embed import _pick_encoding  # noqa: E402
 
 
 def build_http_app(core: InferenceServerCore) -> web.Application:

@@ -1,0 +1,335 @@
+#include "http1_server.h"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cctype>
+#include <cstring>
+#include <map>
+#include <mutex>
+
+namespace tpuclient {
+namespace server {
+
+namespace {
+
+constexpr size_t kMaxHeaderBytes = 64 * 1024;
+constexpr size_t kMaxBodyBytes = 1ull << 31;  // 2 GB, same as gRPC side
+
+const char* ReasonPhrase(int status) {
+  switch (status) {
+    case 200: return "OK";
+    case 400: return "Bad Request";
+    case 404: return "Not Found";
+    case 409: return "Conflict";
+    case 500: return "Internal Server Error";
+    case 501: return "Not Implemented";
+    case 503: return "Service Unavailable";
+    default: return "Status";
+  }
+}
+
+// Escapes a string for embedding in a JSON object (header values are
+// ASCII in practice; control chars are \u-escaped defensively).
+void AppendJsonString(const std::string& in, std::string* out) {
+  out->push_back('"');
+  for (unsigned char c : in) {
+    switch (c) {
+      case '"': *out += "\\\""; break;
+      case '\\': *out += "\\\\"; break;
+      default:
+        // Control chars AND bytes >= 0x80: HTTP/1.1 header values may
+        // be latin-1; raw high bytes would make the JSON invalid
+        // UTF-8 (the \u00XX escape is exactly the latin-1 codepoint).
+        if (c < 0x20 || c >= 0x80) {
+          char buf[8];
+          snprintf(buf, sizeof(buf), "\\u%04x", c);
+          *out += buf;
+        } else {
+          out->push_back(static_cast<char>(c));
+        }
+    }
+  }
+  out->push_back('"');
+}
+
+// Minimal parse of the handler's {"Name": "value", ...} headers_json
+// (produced by json.dumps of a flat str->str dict — no nesting).
+std::map<std::string, std::string> ParseFlatJson(const std::string& text) {
+  std::map<std::string, std::string> out;
+  size_t pos = 0;
+  auto read_string = [&](std::string* value) -> bool {
+    while (pos < text.size() && text[pos] != '"') {
+      if (text[pos] == '}') return false;
+      ++pos;
+    }
+    if (pos >= text.size()) return false;
+    ++pos;
+    value->clear();
+    while (pos < text.size() && text[pos] != '"') {
+      char c = text[pos];
+      if (c == '\\' && pos + 1 < text.size()) {
+        ++pos;
+        char e = text[pos];
+        if (e == 'u' && pos + 4 < text.size()) {
+          int code = std::stoi(text.substr(pos + 1, 4), nullptr, 16);
+          value->push_back(static_cast<char>(code));
+          pos += 4;
+        } else {
+          value->push_back(e == 'n' ? '\n' : e == 't' ? '\t' : e);
+        }
+      } else {
+        value->push_back(c);
+      }
+      ++pos;
+    }
+    if (pos < text.size()) ++pos;  // closing quote
+    return true;
+  };
+  std::string key, value;
+  while (read_string(&key)) {
+    if (!read_string(&value)) break;
+    out[key] = value;
+  }
+  return out;
+}
+
+}  // namespace
+
+struct Http1Server::Impl {
+  struct Worker {
+    std::thread thread;
+    std::atomic<bool> done{false};
+  };
+
+  std::mutex mutex;
+  std::vector<std::unique_ptr<Worker>> workers;
+  std::vector<int> active_fds;  // connections currently being served
+
+  void Register(int fd) {
+    std::lock_guard<std::mutex> lk(mutex);
+    active_fds.push_back(fd);
+  }
+
+  void Unregister(int fd) {
+    std::lock_guard<std::mutex> lk(mutex);
+    active_fds.erase(
+        std::remove(active_fds.begin(), active_fds.end(), fd),
+        active_fds.end());
+  }
+
+  // Joins workers whose connection has ended (called from the accept
+  // loop so a long-lived server doesn't accumulate zombie threads).
+  void Reap() {
+    std::lock_guard<std::mutex> lk(mutex);
+    for (size_t i = 0; i < workers.size();) {
+      if (workers[i]->done.load()) {
+        workers[i]->thread.join();
+        workers.erase(workers.begin() + i);
+      } else {
+        ++i;
+      }
+    }
+  }
+};
+
+Http1Server::Http1Server(HttpHandler* handler) : handler_(handler) {}
+
+Http1Server::~Http1Server() { Shutdown(); }
+
+std::string Http1Server::Listen(const std::string& host, int port) {
+  impl_.reset(new Impl());
+  int lfd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (lfd < 0) return strerror(errno);
+  int one = 1;
+  setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+    ::close(lfd);
+    return "bad listen host " + host;
+  }
+  if (bind(lfd, reinterpret_cast<struct sockaddr*>(&addr),
+           sizeof(addr)) != 0) {
+    std::string err = std::string("bind failed: ") + strerror(errno);
+    ::close(lfd);
+    return err;
+  }
+  if (listen(lfd, 128) != 0) {
+    std::string err = std::string("listen failed: ") + strerror(errno);
+    ::close(lfd);
+    return err;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(lfd, reinterpret_cast<struct sockaddr*>(&addr), &alen);
+  bound_port_ = ntohs(addr.sin_port);
+  listen_fd_.store(lfd);
+  accept_thread_ = std::thread(&Http1Server::AcceptLoop, this);
+  return "";
+}
+
+void Http1Server::AcceptLoop() {
+  const int lfd = listen_fd_.load();
+  while (!shutting_down_.load()) {
+    int fd = ::accept(lfd, nullptr, nullptr);
+    if (fd < 0) {
+      if (errno == EINTR) continue;
+      return;
+    }
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    impl_->Reap();
+    auto worker = std::make_unique<Impl::Worker>();
+    Impl::Worker* raw = worker.get();
+    {
+      std::lock_guard<std::mutex> lk(impl_->mutex);
+      impl_->workers.push_back(std::move(worker));
+    }
+    raw->thread = std::thread([this, fd, raw] {
+      ServeConnection(fd);
+      raw->done.store(true);
+    });
+  }
+}
+
+void Http1Server::ServeConnection(int fd) {
+  impl_->Register(fd);
+  ServeRequests(fd);
+  // Unregister BEFORE closing: Shutdown() only shuts down fds still
+  // in the registry, so a closed-and-reused descriptor can never be
+  // disturbed.
+  impl_->Unregister(fd);
+  ::close(fd);
+}
+
+void Http1Server::ServeRequests(int fd) {
+  std::string buffer;
+  char chunk[16384];
+  bool keep_alive = true;
+  while (keep_alive && !shutting_down_.load()) {
+    // Read until the header terminator.
+    size_t header_end;
+    while ((header_end = buffer.find("\r\n\r\n")) == std::string::npos) {
+      if (buffer.size() > kMaxHeaderBytes) {
+        return;
+      }
+      ssize_t n = ::recv(fd, chunk, sizeof(chunk), 0);
+      if (n <= 0) {
+        return;
+      }
+      buffer.append(chunk, static_cast<size_t>(n));
+    }
+    // Request line.
+    size_t line_end = buffer.find("\r\n");
+    std::string line = buffer.substr(0, line_end);
+    size_t sp1 = line.find(' ');
+    size_t sp2 = line.rfind(' ');
+    if (sp1 == std::string::npos || sp2 <= sp1) {
+      return;
+    }
+    std::string method = line.substr(0, sp1);
+    std::string target = line.substr(sp1 + 1, sp2 - sp1 - 1);
+    size_t query = target.find('?');
+    std::string path =
+        query == std::string::npos ? target : target.substr(0, query);
+    // Headers -> lower-cased JSON for the handler.
+    std::string headers_json = "{";
+    size_t content_length = 0;
+    bool close_requested = false;
+    size_t pos = line_end + 2;
+    bool first = true;
+    while (pos < header_end) {
+      size_t eol = buffer.find("\r\n", pos);
+      std::string header = buffer.substr(pos, eol - pos);
+      pos = eol + 2;
+      size_t colon = header.find(':');
+      if (colon == std::string::npos) continue;
+      std::string name = header.substr(0, colon);
+      std::transform(name.begin(), name.end(), name.begin(),
+                     [](unsigned char c) { return std::tolower(c); });
+      size_t vstart = colon + 1;
+      while (vstart < header.size() && header[vstart] == ' ') ++vstart;
+      std::string value = header.substr(vstart);
+      if (name == "content-length") {
+        content_length = strtoull(value.c_str(), nullptr, 10);
+      }
+      if (name == "connection") {
+        std::transform(value.begin(), value.end(), value.begin(),
+                       [](unsigned char c) { return std::tolower(c); });
+        close_requested = value.find("close") != std::string::npos;
+      }
+      if (!first) headers_json += ",";
+      AppendJsonString(name, &headers_json);
+      headers_json += ":";
+      AppendJsonString(value, &headers_json);
+      first = false;
+    }
+    headers_json += "}";
+    if (content_length > kMaxBodyBytes) {
+      return;
+    }
+    // Body.
+    size_t body_start = header_end + 4;
+    while (buffer.size() < body_start + content_length) {
+      ssize_t n = ::recv(fd, chunk, sizeof(chunk), 0);
+      if (n <= 0) {
+        return;
+      }
+      buffer.append(chunk, static_cast<size_t>(n));
+    }
+    std::string body = buffer.substr(body_start, content_length);
+    buffer.erase(0, body_start + content_length);
+
+    HttpReply reply = handler_->HttpCall(method, path, headers_json, body);
+
+    std::string response = "HTTP/1.1 " + std::to_string(reply.status) +
+                           " " + ReasonPhrase(reply.status) + "\r\n";
+    for (const auto& kv : ParseFlatJson(reply.headers_json)) {
+      response += kv.first + ": " + kv.second + "\r\n";
+    }
+    response += "Content-Length: " + std::to_string(reply.body.size()) +
+                "\r\n";
+    keep_alive = !close_requested;
+    response += keep_alive ? "Connection: keep-alive\r\n"
+                           : "Connection: close\r\n";
+    response += "\r\n";
+    response += reply.body;
+    size_t sent = 0;
+    while (sent < response.size()) {
+      ssize_t n = ::send(fd, response.data() + sent,
+                         response.size() - sent, MSG_NOSIGNAL);
+      if (n <= 0) {
+        return;
+      }
+      sent += static_cast<size_t>(n);
+    }
+  }
+}
+
+void Http1Server::Shutdown() {
+  if (shutting_down_.exchange(true)) return;
+  int lfd = listen_fd_.exchange(-1);
+  if (lfd >= 0) ::shutdown(lfd, SHUT_RDWR);
+  if (accept_thread_.joinable()) accept_thread_.join();
+  if (lfd >= 0) ::close(lfd);
+  if (impl_) {
+    // Wake connection threads blocked in recv() (shutdown makes it
+    // return 0), then join them all before the server is destroyed.
+    std::vector<std::unique_ptr<Impl::Worker>> workers;
+    {
+      std::lock_guard<std::mutex> lk(impl_->mutex);
+      for (int fd : impl_->active_fds) ::shutdown(fd, SHUT_RDWR);
+      workers.swap(impl_->workers);
+    }
+    for (auto& worker : workers) worker->thread.join();
+  }
+}
+
+}  // namespace server
+}  // namespace tpuclient

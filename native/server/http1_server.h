@@ -1,0 +1,62 @@
+// HTTP/1.1 server transport for tpu_serverd's REST front-end: accepts
+// KServe-v2 REST calls (JSON + binary-tensor extension) and forwards
+// them to the embedded core via the HttpHandler interface
+// (PyCoreHandler::HttpCall -> client_tpu.server.embed.http_call).
+// HTTP/1.1 is one request at a time per connection, so dispatch runs
+// on the connection's own thread — parallelism comes from concurrent
+// connections, mirroring the reference server's REST front-end model.
+#pragma once
+
+#include <atomic>
+#include <memory>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace tpuclient {
+namespace server {
+
+struct HttpReply {
+  int status = 200;
+  std::string headers_json;  // {"Header-Name": "value", ...}
+  std::string body;
+};
+
+class HttpHandler {
+ public:
+  virtual ~HttpHandler() = default;
+  // `headers_json` carries the request headers with lower-cased names.
+  virtual HttpReply HttpCall(const std::string& method,
+                             const std::string& path,
+                             const std::string& headers_json,
+                             const std::string& body) = 0;
+};
+
+class Http1Server {
+ public:
+  explicit Http1Server(HttpHandler* handler);
+  ~Http1Server();
+
+  Http1Server(const Http1Server&) = delete;
+  Http1Server& operator=(const Http1Server&) = delete;
+
+  std::string Listen(const std::string& host, int port);
+  int bound_port() const { return bound_port_; }
+  void Shutdown();
+
+ private:
+  void AcceptLoop();
+  void ServeConnection(int fd);
+  void ServeRequests(int fd);
+
+  HttpHandler* handler_;
+  std::atomic<int> listen_fd_{-1};
+  int bound_port_ = 0;
+  std::atomic<bool> shutting_down_{false};
+  std::thread accept_thread_;
+  struct Impl;
+  std::unique_ptr<Impl> impl_;
+};
+
+}  // namespace server
+}  // namespace tpuclient

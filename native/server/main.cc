@@ -16,6 +16,7 @@
 #include <string>
 
 #include "h2_server.h"
+#include "http1_server.h"
 #include "py_core.h"
 
 namespace {
@@ -29,6 +30,7 @@ void OnSignal(int) { g_stop.store(true); }
 int main(int argc, char** argv) {
   std::string host = "127.0.0.1";
   int port = 8001;
+  int http_port = -1;  // -1 = disabled; 0 = ephemeral
   int workers = 8;
   std::string models = "simple";
   for (int i = 1; i < argc; ++i) {
@@ -38,6 +40,8 @@ int main(int argc, char** argv) {
     };
     if (arg == "--port" || arg == "-p") {
       port = atoi(next());
+    } else if (arg == "--http-port") {
+      http_port = atoi(next());
     } else if (arg == "--host") {
       host = next();
     } else if (arg == "--models" || arg == "-m") {
@@ -46,8 +50,8 @@ int main(int argc, char** argv) {
       workers = atoi(next());
     } else if (arg == "--help" || arg == "-h") {
       printf(
-          "usage: tpu_serverd [--host H] [--port P] [--models a,b] "
-          "[--workers N]\n");
+          "usage: tpu_serverd [--host H] [--port P] [--http-port P] "
+          "[--models a,b] [--workers N]\n");
       return 0;
     } else {
       fprintf(stderr, "unknown argument: %s\n", arg.c_str());
@@ -69,7 +73,19 @@ int main(int argc, char** argv) {
     fprintf(stderr, "listen failed: %s\n", err.c_str());
     return 1;
   }
+  std::unique_ptr<tpuclient::server::Http1Server> http_server;
+  if (http_port >= 0) {
+    http_server.reset(new tpuclient::server::Http1Server(&handler));
+    err = http_server->Listen(host, http_port);
+    if (!err.empty()) {
+      fprintf(stderr, "http listen failed: %s\n", err.c_str());
+      return 1;
+    }
+  }
   printf("LISTENING %d\n", server.bound_port());
+  if (http_server != nullptr) {
+    printf("LISTENING-HTTP %d\n", http_server->bound_port());
+  }
   fflush(stdout);
 
   signal(SIGINT, OnSignal);

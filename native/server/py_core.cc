@@ -190,5 +190,76 @@ GrpcReply PyCoreHandler::StreamCall(const std::string& path,
   return reply;
 }
 
+namespace {
+
+std::string JsonEscape(const std::string& in) {
+  std::string out;
+  out.reserve(in.size());
+  for (unsigned char c : in) {
+    if (c == '"' || c == '\\') {
+      out.push_back('\\');
+      out.push_back(static_cast<char>(c));
+    } else if (c < 0x20 || c >= 0x80) {
+      char buf[8];
+      snprintf(buf, sizeof(buf), "\\u%04x", c);
+      out += buf;
+    } else {
+      out.push_back(static_cast<char>(c));
+    }
+  }
+  return out;
+}
+
+}  // namespace
+
+HttpReply PyCoreHandler::HttpCall(const std::string& method,
+                                  const std::string& path,
+                                  const std::string& headers_json,
+                                  const std::string& body) {
+  HttpReply reply;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* r = PyObject_CallMethod(
+      impl_->module, "http_call", "sssy#", method.c_str(), path.c_str(),
+      headers_json.c_str(), body.data(), (Py_ssize_t)body.size());
+  if (r == nullptr) {
+    reply.status = 500;
+    reply.body =
+        "{\"error\": \"" + JsonEscape(FetchPyError("http_call")) + "\"}";
+    reply.headers_json = "{\"Content-Type\": \"application/json\"}";
+  } else {
+    // (status:int, headers_json:str, body:bytes)
+    bool ok = false;
+    PyObject* status = PyTuple_GetItem(r, 0);
+    PyObject* headers = PyTuple_GetItem(r, 1);
+    PyObject* payload = PyTuple_GetItem(r, 2);
+    if (status != nullptr && headers != nullptr && payload != nullptr) {
+      long code = PyLong_AsLong(status);
+      const char* text = PyUnicode_AsUTF8(headers);
+      char* data = nullptr;
+      Py_ssize_t size = 0;
+      if (code != -1 || PyErr_Occurred() == nullptr) {
+        if (text != nullptr &&
+            PyBytes_AsStringAndSize(payload, &data, &size) == 0) {
+          reply.status = (int)code;
+          reply.headers_json = text;
+          reply.body.assign(data, (size_t)size);
+          ok = true;
+        }
+      }
+    }
+    if (!ok) {
+      // A pending conversion error must never leak past this call
+      // (running the next C-API call with an exception set is UB).
+      PyErr_Clear();
+      reply.status = 500;
+      reply.body = "{\"error\": \"malformed http_call result\"}";
+      reply.headers_json = "{\"Content-Type\": \"application/json\"}";
+    }
+    Py_DECREF(r);
+  }
+  PyGILState_Release(gil);
+  return reply;
+}
+
 }  // namespace server
 }  // namespace tpuclient

@@ -8,11 +8,12 @@
 #include <string>
 
 #include "h2_server.h"
+#include "http1_server.h"
 
 namespace tpuclient {
 namespace server {
 
-class PyCoreHandler : public GrpcHandler {
+class PyCoreHandler : public GrpcHandler, public HttpHandler {
  public:
   // Initializes the interpreter and builds the server core, warming
   // `models_csv` (comma-separated). Returns "" on success. Must be
@@ -24,6 +25,9 @@ class PyCoreHandler : public GrpcHandler {
                  const std::string& message) override;
   GrpcReply StreamCall(const std::string& path,
                        const std::string& message) override;
+  HttpReply HttpCall(const std::string& method, const std::string& path,
+                     const std::string& headers_json,
+                     const std::string& body) override;
 
  private:
   struct Impl;

@@ -1,0 +1,123 @@
+"""Route-level tests for the embedded REST dispatcher
+(client_tpu/server/http_embed.py) — the surface the native HTTP/1.1
+front-end forwards into. Pure Python: no native binary needed."""
+
+import json
+
+import numpy as np
+import pytest
+
+from client_tpu.protocol.http_wire import (
+    decode_infer_response,
+    encode_infer_request,
+)
+from client_tpu.server import http_embed
+from client_tpu.server.app import build_core
+
+
+@pytest.fixture(scope="module")
+def core():
+    return build_core(["simple"])
+
+
+def call(core, method, path, headers=None, body=b""):
+    return http_embed.http_call(core, method, path, headers or {}, body)
+
+
+def test_health_and_metadata(core):
+    assert call(core, "GET", "/v2/health/live")[0] == 200
+    assert call(core, "GET", "/v2/health/ready")[0] == 200
+    assert call(core, "GET", "/v2/models/simple/ready")[0] == 200
+    assert call(core, "GET", "/v2/models/nope/ready")[0] == 400
+    status, headers, body = call(core, "GET", "/v2")
+    assert status == 200
+    assert json.loads(body)["name"] == "client_tpu_server"
+    status, _, body = call(core, "GET", "/v2/models/simple")
+    assert [t["name"] for t in json.loads(body)["inputs"]] == \
+        ["INPUT0", "INPUT1"]
+    assert call(core, "GET", "/v2/models/simple/config")[0] == 200
+
+
+def test_error_mapping_and_unknown_route(core):
+    status, _, body = call(core, "GET", "/v2/models/ghost")
+    assert status == 404
+    assert "error" in json.loads(body)
+    assert call(core, "GET", "/v2/not/a/route")[0] == 404
+    assert call(core, "POST", "/v2/health/live")[0] == 404  # wrong verb
+
+
+def _infer_body():
+    from client_tpu.http import InferInput
+
+    a = np.arange(16, dtype=np.int32)
+    b = np.ones(16, dtype=np.int32)
+    inputs = [InferInput("INPUT0", [16], "INT32"),
+              InferInput("INPUT1", [16], "INT32")]
+    inputs[0].set_data_from_numpy(a)
+    inputs[1].set_data_from_numpy(b)
+    body, json_len = encode_infer_request(inputs)
+    return a, b, body, json_len
+
+
+def test_infer_binary_protocol(core):
+    a, b, body, json_len = _infer_body()
+    headers = {}
+    if json_len is not None:
+        headers["inference-header-content-length"] = str(json_len)
+    status, reply_headers, payload = call(
+        core, "POST", "/v2/models/simple/infer", headers, body)
+    assert status == 200
+    length = reply_headers.get("Inference-Header-Content-Length")
+    _, outputs = decode_infer_response(payload,
+                                       int(length) if length else None)
+    decoded = outputs["OUTPUT0"]
+    out = (np.frombuffer(decoded.raw, dtype=np.int32)
+           if decoded.raw is not None
+           else np.asarray(decoded.json_data, dtype=np.int32))
+    np.testing.assert_array_equal(out, a + b)
+
+
+def test_infer_response_compression(core):
+    from client_tpu.protocol.http_wire import decompress_body
+
+    a, b, body, json_len = _infer_body()
+    headers = {"accept-encoding": "gzip"}
+    if json_len is not None:
+        headers["inference-header-content-length"] = str(json_len)
+    status, reply_headers, payload = call(
+        core, "POST", "/v2/models/simple/infer", headers, body)
+    assert status == 200
+    assert reply_headers.get("Content-Encoding") == "gzip"
+    raw = decompress_body(payload, "gzip")
+    length = reply_headers.get("Inference-Header-Content-Length")
+    _, outputs = decode_infer_response(raw, int(length) if length else None)
+    decoded = outputs["OUTPUT0"]
+    out = (np.frombuffer(decoded.raw, dtype=np.int32)
+           if decoded.raw is not None
+           else np.asarray(decoded.json_data, dtype=np.int32))
+    np.testing.assert_array_equal(out, a + b)
+
+
+def test_system_shm_roundtrip(core):
+    import client_tpu.utils.shared_memory as shm
+
+    handle = shm.create_shared_memory_region("he_r", "/he_embed", 64)
+    try:
+        status, _, _ = call(
+            core, "POST", "/v2/systemsharedmemory/region/he_r/register",
+            body=json.dumps({"key": "/he_embed", "byte_size": 64}).encode())
+        assert status == 200
+        _, _, body = call(core, "GET", "/v2/systemsharedmemory/status")
+        assert any(r["name"] == "he_r" for r in json.loads(body))
+        assert call(core, "POST",
+                    "/v2/systemsharedmemory/region/he_r/unregister")[0] \
+            == 200
+    finally:
+        shm.destroy_shared_memory_region(handle)
+
+
+def test_repository_index(core):
+    status, _, body = call(core, "POST", "/v2/repository/index",
+                           body=b'{"ready": true}')
+    assert status == 200
+    assert any(m["name"] == "simple" for m in json.loads(body))

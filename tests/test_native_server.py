@@ -16,21 +16,25 @@ SERVERD = REPO / "native" / "build" / "tpu_serverd"
 
 
 @pytest.fixture(scope="module")
-def serverd():
+def serverd_ports():
     if not SERVERD.exists():
         pytest.skip("tpu_serverd not built (run tests/test_native.py first)")
     import os
 
     env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
     proc = subprocess.Popen(
-        [str(SERVERD), "--port", "0", "--models", "simple"],
+        [str(SERVERD), "--port", "0", "--http-port", "0",
+         "--models", "simple"],
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
         cwd=str(REPO), env=env,
     )
     try:
         line = proc.stdout.readline().strip()  # "LISTENING <port>"
         assert line.startswith("LISTENING "), line
-        yield "127.0.0.1:%s" % line.split()[1]
+        http_line = proc.stdout.readline().strip()  # "LISTENING-HTTP <p>"
+        assert http_line.startswith("LISTENING-HTTP "), http_line
+        yield {"grpc": "127.0.0.1:%s" % line.split()[1],
+               "http": "127.0.0.1:%s" % http_line.split()[1]}
     finally:
         proc.terminate()
         try:
@@ -38,6 +42,11 @@ def serverd():
         except subprocess.TimeoutExpired:
             proc.kill()
             proc.wait(timeout=10)
+
+
+@pytest.fixture(scope="module")
+def serverd(serverd_ports):
+    return serverd_ports["grpc"]
 
 
 @pytest.fixture()
@@ -156,3 +165,34 @@ def test_statistics_accumulate(serverd):
         after = c.get_inference_statistics("simple") \
             .model_stats[0].inference_stats.success.count
     assert after == before + 1
+
+
+def test_http_front_end_infer(serverd_ports):
+    """The Python HTTP client (binary protocol, own pooled transport)
+    drives tpu_serverd's native HTTP/1.1 front-end."""
+    import client_tpu.http as httpclient
+
+    with httpclient.InferenceServerClient(serverd_ports["http"]) as c:
+        assert c.is_server_live()
+        meta = c.get_model_metadata("simple")
+        assert meta["name"] == "simple"
+        in0 = np.arange(16, dtype=np.int32)
+        in1 = np.ones(16, dtype=np.int32)
+        inputs = [httpclient.InferInput("INPUT0", [16], "INT32"),
+                  httpclient.InferInput("INPUT1", [16], "INT32")]
+        inputs[0].set_data_from_numpy(in0)
+        inputs[1].set_data_from_numpy(in1)
+        result = c.infer("simple", inputs)
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), in0 + in1)
+
+
+def test_http_front_end_errors_and_keepalive(serverd_ports):
+    import client_tpu.http as httpclient
+    from client_tpu.utils import InferenceServerException
+
+    with httpclient.InferenceServerClient(serverd_ports["http"]) as c:
+        with pytest.raises(InferenceServerException):
+            c.get_model_metadata("no_such_model")
+        # Several requests over one keep-alive connection.
+        for _ in range(5):
+            assert c.is_server_ready()
