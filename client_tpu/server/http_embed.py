@@ -4,9 +4,10 @@ The native HTTP front-end (native/server/http1_server.cc inside
 tpu_serverd) terminates HTTP/1.1 in C++ and forwards each request here
 as (method, path, headers, body) -> (status, headers, body) — the REST
 twin of embed.grpc_call. The route surface mirrors the aiohttp server
-(client_tpu/server/http_server.py) minus the streaming endpoints
-(generate_stream / OpenAI SSE need chunked responses; the aiohttp
-front-end remains the home for those).
+(client_tpu/server/http_server.py) except the streaming endpoints —
+generate_stream and the OpenAI SSE APIs need chunked responses, so the
+aiohttp front-end remains the home for those (non-streaming generate
+IS served here).
 """
 
 from __future__ import annotations
@@ -229,6 +230,91 @@ def _tpu_shm_register(core, m, headers, body):
 def _tpu_shm_unregister(core, m, headers, body):
     core.unregister_tpu_shm(m.group("name") or "")
     return 200, {}, b""
+
+
+@_route("GET", r"/v2(?:/models/(?P<model>[^/]+))?/trace/setting")
+def _get_trace(core, m, headers, body):
+    settings = core.trace_setting(m.group("model") or "", {})
+    return _json_reply(
+        {k: v if len(v) != 1 else v[0] for k, v in settings.items()})
+
+
+@_route("POST", r"/v2(?:/models/(?P<model>[^/]+))?/trace/setting")
+def _post_trace(core, m, headers, body):
+    updates = {
+        k: (v if isinstance(v, list) else [v]) if v is not None else []
+        for k, v in json.loads(body).items()
+    }
+    settings = core.trace_setting(m.group("model") or "", updates)
+    return _json_reply(
+        {k: v if len(v) != 1 else v[0] for k, v in settings.items()})
+
+
+@_route("GET", r"/v2/logging")
+def _get_logging(core, m, headers, body):
+    return _json_reply(core.log_settings({}))
+
+
+@_route("POST", r"/v2/logging")
+def _post_logging(core, m, headers, body):
+    return _json_reply(core.log_settings(json.loads(body)))
+
+
+@_route("POST", _MODEL + r"/generate")
+def _generate(core, m, headers, body):
+    """Non-streaming generate extension (JSON in, JSON out); the SSE
+    generate_stream variant stays on the aiohttp front-end."""
+    from client_tpu.protocol import inference_pb2 as pb
+    from client_tpu.protocol.http_wire import (
+        _json_data_to_raw,
+        _raw_to_json_data,
+        _set_pb_param,
+    )
+
+    try:
+        doc = json.loads(body)
+    except ValueError as e:
+        raise InferenceServerException(
+            "malformed generate request: %s" % e, status="INVALID_ARGUMENT")
+    if not isinstance(doc, dict):
+        raise InferenceServerException(
+            "generate request body must be a JSON object",
+            status="INVALID_ARGUMENT")
+    infer_request = pb.ModelInferRequest(
+        model_name=m.group("model"),
+        model_version=m.group("version") or "")
+    model = core.repository.get(infer_request.model_name)
+    for spec in model.inputs:
+        if spec.name not in doc:
+            continue
+        value = doc.pop(spec.name)
+        listed = value if isinstance(value, list) else [value]
+        tensor = infer_request.inputs.add()
+        tensor.name = spec.name
+        tensor.datatype = spec.datatype
+        tensor.shape.extend([len(listed)])
+        try:
+            infer_request.raw_input_contents.append(
+                _json_data_to_raw(listed, spec.datatype, spec.name))
+        except (TypeError, ValueError, OverflowError) as e:
+            raise InferenceServerException(
+                "invalid value for input '%s': %s" % (spec.name, e),
+                status="INVALID_ARGUMENT")
+    for key, value in doc.items():  # leftover fields -> parameters
+        if isinstance(value, (bool, int, float, str)):
+            _set_pb_param(infer_request.parameters[key], value)
+    response = core.infer(infer_request)
+    out = {"model_name": response.model_name,
+           "model_version": response.model_version}
+    raw_idx = 0
+    for tensor in response.outputs:
+        if raw_idx >= len(response.raw_output_contents):
+            continue
+        data = _raw_to_json_data(
+            response.raw_output_contents[raw_idx], tensor.datatype)
+        raw_idx += 1
+        out[tensor.name] = data[0] if len(data) == 1 else data
+    return _json_reply(out)
 
 
 @_route("POST", _MODEL + r"/infer")

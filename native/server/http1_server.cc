@@ -33,27 +33,9 @@ const char* ReasonPhrase(int status) {
   }
 }
 
-// Escapes a string for embedding in a JSON object (header values are
-// ASCII in practice; control chars are \u-escaped defensively).
 void AppendJsonString(const std::string& in, std::string* out) {
   out->push_back('"');
-  for (unsigned char c : in) {
-    switch (c) {
-      case '"': *out += "\\\""; break;
-      case '\\': *out += "\\\\"; break;
-      default:
-        // Control chars AND bytes >= 0x80: HTTP/1.1 header values may
-        // be latin-1; raw high bytes would make the JSON invalid
-        // UTF-8 (the \u00XX escape is exactly the latin-1 codepoint).
-        if (c < 0x20 || c >= 0x80) {
-          char buf[8];
-          snprintf(buf, sizeof(buf), "\\u%04x", c);
-          *out += buf;
-        } else {
-          out->push_back(static_cast<char>(c));
-        }
-    }
-  }
+  *out += JsonEscapeLatin1(in);
   out->push_back('"');
 }
 
@@ -99,6 +81,26 @@ std::map<std::string, std::string> ParseFlatJson(const std::string& text) {
 }
 
 }  // namespace
+
+std::string JsonEscapeLatin1(const std::string& in) {
+  std::string out;
+  out.reserve(in.size());
+  for (unsigned char c : in) {
+    if (c == '"' || c == '\\') {
+      out.push_back('\\');
+      out.push_back(static_cast<char>(c));
+    } else if (c < 0x20 || c >= 0x80) {
+      // HTTP/1.1 header values may be latin-1; raw high bytes would
+      // make the JSON invalid UTF-8 (\u00XX IS the latin-1 codepoint).
+      char buf[8];
+      snprintf(buf, sizeof(buf), "\\u%04x", c);
+      out += buf;
+    } else {
+      out.push_back(static_cast<char>(c));
+    }
+  }
+  return out;
+}
 
 struct Http1Server::Impl {
   struct Worker {
@@ -200,6 +202,12 @@ void Http1Server::AcceptLoop() {
 
 void Http1Server::ServeConnection(int fd) {
   impl_->Register(fd);
+  // Shutdown() may have snapshotted active_fds before this Register:
+  // re-check so a connection accepted during shutdown can't sit in
+  // recv() forever (Shutdown would then hang in join()).
+  if (shutting_down_.load()) {
+    ::shutdown(fd, SHUT_RDWR);
+  }
   ServeRequests(fd);
   // Unregister BEFORE closing: Shutdown() only shuts down fds still
   // in the registry, so a closed-and-reused descriptor can never be
@@ -256,8 +264,26 @@ void Http1Server::ServeRequests(int fd) {
       size_t vstart = colon + 1;
       while (vstart < header.size() && header[vstart] == ' ') ++vstart;
       std::string value = header.substr(vstart);
+      if (name == "transfer-encoding") {
+        // Chunked bodies are not implemented; answering without
+        // draining the body would desync the connection — reject and
+        // close.
+        const char* resp =
+            "HTTP/1.1 501 Not Implemented\r\nContent-Length: 0\r\n"
+            "Connection: close\r\n\r\n";
+        ::send(fd, resp, strlen(resp), MSG_NOSIGNAL);
+        return;
+      }
       if (name == "content-length") {
-        content_length = strtoull(value.c_str(), nullptr, 10);
+        char* end = nullptr;
+        content_length = strtoull(value.c_str(), &end, 10);
+        if (end == value.c_str() || (end != nullptr && *end != '\0')) {
+          const char* resp =
+              "HTTP/1.1 400 Bad Request\r\nContent-Length: 0\r\n"
+              "Connection: close\r\n\r\n";
+          ::send(fd, resp, strlen(resp), MSG_NOSIGNAL);
+          return;
+        }
       }
       if (name == "connection") {
         std::transform(value.begin(), value.end(), value.begin(),
@@ -299,15 +325,20 @@ void Http1Server::ServeRequests(int fd) {
     response += keep_alive ? "Connection: keep-alive\r\n"
                            : "Connection: close\r\n";
     response += "\r\n";
-    response += reply.body;
-    size_t sent = 0;
-    while (sent < response.size()) {
-      ssize_t n = ::send(fd, response.data() + sent,
-                         response.size() - sent, MSG_NOSIGNAL);
-      if (n <= 0) {
-        return;
+    // Header and body go out as two sends: appending a large tensor
+    // reply to the header string would double peak memory.
+    auto send_all = [fd](const char* data, size_t len) {
+      size_t sent = 0;
+      while (sent < len) {
+        ssize_t n = ::send(fd, data + sent, len - sent, MSG_NOSIGNAL);
+        if (n <= 0) return false;
+        sent += static_cast<size_t>(n);
       }
-      sent += static_cast<size_t>(n);
+      return true;
+    };
+    if (!send_all(response.data(), response.size()) ||
+        !send_all(reply.body.data(), reply.body.size())) {
+      return;
     }
   }
 }

@@ -16,6 +16,13 @@
 namespace tpuclient {
 namespace server {
 
+// Escapes a byte string for embedding in a JSON string literal:
+// quote/backslash escaped, control chars and bytes >= 0x80 \u-escaped
+// (high bytes as their latin-1 codepoints, keeping the JSON valid
+// UTF-8). Shared by the transport's header marshalling and the
+// Python bridge's error bodies.
+std::string JsonEscapeLatin1(const std::string& in);
+
 struct HttpReply {
   int status = 200;
   std::string headers_json;  // {"Header-Name": "value", ...}

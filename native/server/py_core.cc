@@ -190,28 +190,6 @@ GrpcReply PyCoreHandler::StreamCall(const std::string& path,
   return reply;
 }
 
-namespace {
-
-std::string JsonEscape(const std::string& in) {
-  std::string out;
-  out.reserve(in.size());
-  for (unsigned char c : in) {
-    if (c == '"' || c == '\\') {
-      out.push_back('\\');
-      out.push_back(static_cast<char>(c));
-    } else if (c < 0x20 || c >= 0x80) {
-      char buf[8];
-      snprintf(buf, sizeof(buf), "\\u%04x", c);
-      out += buf;
-    } else {
-      out.push_back(static_cast<char>(c));
-    }
-  }
-  return out;
-}
-
-}  // namespace
-
 HttpReply PyCoreHandler::HttpCall(const std::string& method,
                                   const std::string& path,
                                   const std::string& headers_json,
@@ -223,8 +201,8 @@ HttpReply PyCoreHandler::HttpCall(const std::string& method,
       headers_json.c_str(), body.data(), (Py_ssize_t)body.size());
   if (r == nullptr) {
     reply.status = 500;
-    reply.body =
-        "{\"error\": \"" + JsonEscape(FetchPyError("http_call")) + "\"}";
+    reply.body = "{\"error\": \"" +
+                 JsonEscapeLatin1(FetchPyError("http_call")) + "\"}";
     reply.headers_json = "{\"Content-Type\": \"application/json\"}";
   } else {
     // (status:int, headers_json:str, body:bytes)

@@ -121,3 +121,29 @@ def test_repository_index(core):
                            body=b'{"ready": true}')
     assert status == 200
     assert any(m["name"] == "simple" for m in json.loads(body))
+
+
+def test_trace_and_logging_routes(core):
+    status, _, body = call(core, "GET", "/v2/trace/setting")
+    assert status == 200
+    status, _, body = call(
+        core, "POST", "/v2/trace/setting",
+        body=json.dumps({"trace_level": ["TIMESTAMPS"]}).encode())
+    assert status == 200
+    assert "trace_level" in json.loads(body)
+    status, _, body = call(core, "GET", "/v2/logging")
+    assert status == 200
+    status, _, body = call(core, "POST", "/v2/logging",
+                           body=b'{"log_verbose_level": 1}')
+    assert status == 200
+
+
+def test_generate_route(core):
+    assert call(core, "POST",
+                "/v2/repository/models/simple_string/load")[0] == 200
+    status, _, body = call(
+        core, "POST", "/v2/models/simple_string/generate",
+        body=json.dumps({"INPUT0": ["1"] * 16,
+                         "INPUT1": ["2"] * 16}).encode())
+    assert status == 200
+    assert json.loads(body)["model_name"] == "simple_string"
