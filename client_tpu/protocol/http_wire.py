@@ -418,3 +418,71 @@ def decode_infer_response(
             json_data=entry.get("data"),
         )
     return header, outputs
+
+
+# -- generate extension (LLM convenience API) ------------------------------
+# JSON-by-input-name request bodies and flattened JSON responses,
+# shared by the aiohttp front-end and the embedded REST dispatcher.
+
+
+def build_generate_request(
+    model_inputs, model_name: str, model_version: str, body: bytes
+) -> pb.ModelInferRequest:
+    """Generate-extension JSON body -> ModelInferRequest: fields that
+    name a model input become tensors (scalars are wrapped), leftover
+    scalar fields become request parameters."""
+    try:
+        doc = json.loads(body)
+    except Exception as e:  # noqa: BLE001 — any parse failure is a 400
+        raise InferenceServerException(
+            "malformed generate request: %s" % e, status="INVALID_ARGUMENT"
+        )
+    if not isinstance(doc, dict):
+        raise InferenceServerException(
+            "generate request body must be a JSON object",
+            status="INVALID_ARGUMENT",
+        )
+    request = pb.ModelInferRequest(
+        model_name=model_name, model_version=model_version
+    )
+    for spec in model_inputs:
+        if spec.name not in doc:
+            continue
+        value = doc.pop(spec.name)
+        listed = value if isinstance(value, list) else [value]
+        tensor = request.inputs.add()
+        tensor.name = spec.name
+        tensor.datatype = spec.datatype
+        tensor.shape.extend([len(listed)])
+        try:
+            request.raw_input_contents.append(
+                _json_data_to_raw(listed, spec.datatype, spec.name)
+            )
+        except (TypeError, ValueError, OverflowError) as e:
+            raise InferenceServerException(
+                "invalid value for input '%s': %s" % (spec.name, e),
+                status="INVALID_ARGUMENT",
+            )
+    for key, value in doc.items():  # leftover fields -> parameters
+        if isinstance(value, (bool, int, float, str)):
+            _set_pb_param(request.parameters[key], value)
+    return request
+
+
+def generate_response_json(response: pb.ModelInferResponse) -> dict:
+    """ModelInferResponse -> the generate extension's flat JSON doc
+    (single-element tensors unwrap to scalars)."""
+    doc = {
+        "model_name": response.model_name,
+        "model_version": response.model_version,
+    }
+    raw_idx = 0
+    for tensor in response.outputs:
+        if raw_idx >= len(response.raw_output_contents):
+            continue
+        data = _raw_to_json_data(
+            response.raw_output_contents[raw_idx], tensor.datatype
+        )
+        raw_idx += 1
+        doc[tensor.name] = data[0] if len(data) == 1 else data
+    return doc

@@ -264,57 +264,16 @@ def _post_logging(core, m, headers, body):
 def _generate(core, m, headers, body):
     """Non-streaming generate extension (JSON in, JSON out); the SSE
     generate_stream variant stays on the aiohttp front-end."""
-    from client_tpu.protocol import inference_pb2 as pb
     from client_tpu.protocol.http_wire import (
-        _json_data_to_raw,
-        _raw_to_json_data,
-        _set_pb_param,
+        build_generate_request,
+        generate_response_json,
     )
 
-    try:
-        doc = json.loads(body)
-    except ValueError as e:
-        raise InferenceServerException(
-            "malformed generate request: %s" % e, status="INVALID_ARGUMENT")
-    if not isinstance(doc, dict):
-        raise InferenceServerException(
-            "generate request body must be a JSON object",
-            status="INVALID_ARGUMENT")
-    infer_request = pb.ModelInferRequest(
-        model_name=m.group("model"),
-        model_version=m.group("version") or "")
-    model = core.repository.get(infer_request.model_name)
-    for spec in model.inputs:
-        if spec.name not in doc:
-            continue
-        value = doc.pop(spec.name)
-        listed = value if isinstance(value, list) else [value]
-        tensor = infer_request.inputs.add()
-        tensor.name = spec.name
-        tensor.datatype = spec.datatype
-        tensor.shape.extend([len(listed)])
-        try:
-            infer_request.raw_input_contents.append(
-                _json_data_to_raw(listed, spec.datatype, spec.name))
-        except (TypeError, ValueError, OverflowError) as e:
-            raise InferenceServerException(
-                "invalid value for input '%s': %s" % (spec.name, e),
-                status="INVALID_ARGUMENT")
-    for key, value in doc.items():  # leftover fields -> parameters
-        if isinstance(value, (bool, int, float, str)):
-            _set_pb_param(infer_request.parameters[key], value)
-    response = core.infer(infer_request)
-    out = {"model_name": response.model_name,
-           "model_version": response.model_version}
-    raw_idx = 0
-    for tensor in response.outputs:
-        if raw_idx >= len(response.raw_output_contents):
-            continue
-        data = _raw_to_json_data(
-            response.raw_output_contents[raw_idx], tensor.datatype)
-        raw_idx += 1
-        out[tensor.name] = data[0] if len(data) == 1 else data
-    return _json_reply(out)
+    body = decompress_body(body, headers.get("content-encoding"))
+    model = core.repository.get(m.group("model"))
+    infer_request = build_generate_request(
+        model.inputs, m.group("model"), m.group("version") or "", body)
+    return _json_reply(generate_response_json(core.infer(infer_request)))
 
 
 @_route("POST", _MODEL + r"/infer")

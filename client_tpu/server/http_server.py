@@ -286,70 +286,19 @@ def build_http_app(core: InferenceServerCore) -> web.Application:
 
     def _generate_request(request, body: bytes):
         """JSON body fields -> ModelInferRequest tensors by input name
-        (the triton generate-extension convention)."""
-        import json as _json
+        (shared codec: http_wire.build_generate_request)."""
+        from client_tpu.protocol.http_wire import build_generate_request
 
-        try:
-            doc = _json.loads(body)
-        except Exception as e:
-            raise InferenceServerException(
-                "malformed generate request: %s" % e,
-                status="INVALID_ARGUMENT",
-            )
-        if not isinstance(doc, dict):
-            raise InferenceServerException(
-                "generate request body must be a JSON object",
-                status="INVALID_ARGUMENT",
-            )
-        infer_request = pb.ModelInferRequest(
-            model_name=request.match_info["model"],
-            model_version=request.match_info.get("version", ""),
-        )
-        from client_tpu.protocol.http_wire import _json_data_to_raw
-
-        model = core.repository.get(infer_request.model_name)
-        for spec in model.inputs:
-            if spec.name not in doc:
-                continue
-            value = doc.pop(spec.name)
-            listed = value if isinstance(value, list) else [value]
-            tensor = infer_request.inputs.add()
-            tensor.name = spec.name
-            tensor.datatype = spec.datatype
-            tensor.shape.extend([len(listed)])
-            try:
-                infer_request.raw_input_contents.append(
-                    _json_data_to_raw(listed, spec.datatype, spec.name)
-                )
-            except (TypeError, ValueError, OverflowError) as e:
-                raise InferenceServerException(
-                    "invalid value for input '%s': %s" % (spec.name, e),
-                    status="INVALID_ARGUMENT",
-                )
-        for key, value in doc.items():  # leftover fields -> parameters
-            if isinstance(value, (bool, int, float, str)):
-                from client_tpu.protocol.http_wire import _set_pb_param
-
-                _set_pb_param(infer_request.parameters[key], value)
-        return infer_request
+        model_name = request.match_info["model"]
+        model = core.repository.get(model_name)
+        return build_generate_request(
+            model.inputs, model_name,
+            request.match_info.get("version", ""), body)
 
     def _generate_json(response: pb.ModelInferResponse) -> dict:
-        from client_tpu.protocol.http_wire import _raw_to_json_data
+        from client_tpu.protocol.http_wire import generate_response_json
 
-        doc = {
-            "model_name": response.model_name,
-            "model_version": response.model_version,
-        }
-        raw_idx = 0
-        for tensor in response.outputs:
-            if raw_idx >= len(response.raw_output_contents):
-                continue
-            data = _raw_to_json_data(
-                response.raw_output_contents[raw_idx], tensor.datatype
-            )
-            raw_idx += 1
-            doc[tensor.name] = data[0] if len(data) == 1 else data
-        return doc
+        return generate_response_json(response)
 
     @routes.post("/v2/models/{model}/generate")
     @routes.post("/v2/models/{model}/versions/{version}/generate")

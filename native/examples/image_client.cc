@@ -1,0 +1,132 @@
+// C++ image classification client for resnet50: batched NHWC float
+// input over gRPC async, top-K parse of the logits (parity example:
+// reference src/c++/examples/image_client.cc — there OpenCV decodes
+// JPEGs; here the image is synthesized or read as raw float32 NHWC
+// so the example carries no image-library dependency).
+#include <algorithm>
+#include <condition_variable>
+#include <cstring>
+#include <fstream>
+#include <iostream>
+#include <mutex>
+#include <vector>
+
+#include "grpc_client.h"
+
+namespace {
+constexpr int kH = 224, kW = 224, kC = 3, kClasses = 1000;
+
+const char* Arg(int argc, char** argv, const char* flag,
+                const char* fallback) {
+  for (int i = 1; i + 1 < argc; ++i) {
+    if (strcmp(argv[i], flag) == 0) return argv[i + 1];
+  }
+  return fallback;
+}
+#define FAIL_IF_ERR(x, msg)                                         \
+  do {                                                              \
+    tpuclient::Error err__ = (x);                                   \
+    if (!err__.IsOk()) {                                            \
+      std::cerr << "error: " << msg << ": " << err__.Message()      \
+                << std::endl;                                       \
+      exit(1);                                                      \
+    }                                                               \
+  } while (0)
+}  // namespace
+
+int main(int argc, char** argv) {
+  const char* url = Arg(argc, argv, "-u", "localhost:8001");
+  int batch = atoi(Arg(argc, argv, "-b", "2"));
+  int topk = atoi(Arg(argc, argv, "-c", "3"));
+  const char* raw_path = Arg(argc, argv, "-f", "");  // raw f32 NHWC file
+
+  std::unique_ptr<tpuclient::InferenceServerGrpcClient> client;
+  FAIL_IF_ERR(tpuclient::InferenceServerGrpcClient::Create(&client, url),
+              "create client");
+
+  // One image: from a raw float32 file, or a synthesized gradient
+  // (channel-normalized like the Python image_client's INCEPTION
+  // scaling).
+  std::vector<float> image(kH * kW * kC);
+  if (raw_path[0] != '\0') {
+    std::ifstream file(raw_path, std::ios::binary);
+    if (!file.read(reinterpret_cast<char*>(image.data()),
+                   image.size() * sizeof(float))) {
+      std::cerr << "failed to read " << raw_path << "\n";
+      return 1;
+    }
+  } else {
+    for (int y = 0; y < kH; ++y) {
+      for (int x = 0; x < kW; ++x) {
+        for (int c = 0; c < kC; ++c) {
+          image[(y * kW + x) * kC + c] =
+              (static_cast<float>(x + y + c * 37) / (kH + kW)) - 0.5f;
+        }
+      }
+    }
+  }
+  // The batch repeats the image (reference: one file per batch slot).
+  std::vector<float> batched;
+  batched.reserve(image.size() * batch);
+  for (int i = 0; i < batch; ++i) {
+    batched.insert(batched.end(), image.begin(), image.end());
+  }
+
+  tpuclient::InferInput* raw_input;
+  FAIL_IF_ERR(tpuclient::InferInput::Create(
+                  &raw_input, "INPUT", {batch, kH, kW, kC}, "FP32"),
+              "create input");
+  std::unique_ptr<tpuclient::InferInput> input(raw_input);
+  FAIL_IF_ERR(
+      input->AppendRaw(reinterpret_cast<uint8_t*>(batched.data()),
+                       batched.size() * sizeof(float)),
+      "set image data");
+
+  std::mutex mutex;
+  std::condition_variable cv;
+  tpuclient::InferResult* async_result = nullptr;
+
+  tpuclient::InferOptions options("resnet50");
+  FAIL_IF_ERR(client->AsyncInfer(
+                  [&](tpuclient::InferResult* r) {
+                    std::lock_guard<std::mutex> lock(mutex);
+                    async_result = r;
+                    cv.notify_all();
+                  },
+                  options, {input.get()}),
+              "async infer");
+  {
+    std::unique_lock<std::mutex> lock(mutex);
+    if (!cv.wait_for(lock, std::chrono::seconds(120),
+                     [&] { return async_result != nullptr; })) {
+      std::cerr << "timeout\n";
+      return 1;
+    }
+  }
+  std::unique_ptr<tpuclient::InferResult> result(async_result);
+  FAIL_IF_ERR(result->RequestStatus(), "inference failed");
+
+  const uint8_t* buf;
+  size_t size;
+  FAIL_IF_ERR(result->RawData("OUTPUT", &buf, &size), "OUTPUT");
+  if (size < static_cast<size_t>(batch) * kClasses * sizeof(float)) {
+    std::cerr << "short output: " << size << " bytes\n";
+    return 1;
+  }
+  const float* logits = reinterpret_cast<const float*>(buf);
+  for (int b = 0; b < batch; ++b) {
+    std::vector<int> order(kClasses);
+    for (int i = 0; i < kClasses; ++i) order[i] = i;
+    const float* row = logits + b * kClasses;
+    std::partial_sort(order.begin(), order.begin() + topk, order.end(),
+                      [row](int a, int c) { return row[a] > row[c]; });
+    std::cout << "image " << b << " top-" << topk << ":";
+    for (int i = 0; i < topk; ++i) {
+      std::cout << " class " << order[i] << " (" << row[order[i]] << ")";
+    }
+    std::cout << std::endl;
+  }
+  std::cout << "PASS: image classification (batch " << batch << ")"
+            << std::endl;
+  return 0;
+}

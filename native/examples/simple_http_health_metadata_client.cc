@@ -1,0 +1,74 @@
+// Health + metadata surface over HTTP/REST: liveness, readiness,
+// server/model metadata (JSON), config, statistics, repository index
+// (parity example: reference
+// src/c++/examples/simple_http_health_metadata.cc).
+#include <cstring>
+#include <iostream>
+
+#include "http_client.h"
+
+namespace {
+const char* Url(int argc, char** argv, const char* fallback) {
+  for (int i = 1; i + 1 < argc; ++i) {
+    if (strcmp(argv[i], "-u") == 0) return argv[i + 1];
+  }
+  return fallback;
+}
+#define FAIL_IF_ERR(x, msg)                                         \
+  do {                                                              \
+    tpuclient::Error err__ = (x);                                   \
+    if (!err__.IsOk()) {                                            \
+      std::cerr << "error: " << msg << ": " << err__.Message()      \
+                << std::endl;                                       \
+      exit(1);                                                      \
+    }                                                               \
+  } while (0)
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::unique_ptr<tpuclient::InferenceServerHttpClient> client;
+  FAIL_IF_ERR(tpuclient::InferenceServerHttpClient::Create(
+                  &client, Url(argc, argv, "localhost:8000")),
+              "create client");
+
+  bool live = false, ready = false, model_ready = false;
+  FAIL_IF_ERR(client->IsServerLive(&live), "server live");
+  FAIL_IF_ERR(client->IsServerReady(&ready), "server ready");
+  FAIL_IF_ERR(client->IsModelReady(&model_ready, "simple"), "model ready");
+  if (!live || !ready || !model_ready) {
+    std::cerr << "server/model not ready\n";
+    return 1;
+  }
+
+  std::string server_metadata;
+  FAIL_IF_ERR(client->ServerMetadata(&server_metadata), "server metadata");
+  if (server_metadata.find("client_tpu_server") == std::string::npos) {
+    std::cerr << "unexpected server metadata: " << server_metadata << "\n";
+    return 1;
+  }
+
+  std::string model_metadata;
+  FAIL_IF_ERR(client->ModelMetadata(&model_metadata, "simple"),
+              "model metadata");
+  if (model_metadata.find("INPUT0") == std::string::npos) {
+    std::cerr << "INPUT0 missing from metadata\n";
+    return 1;
+  }
+
+  std::string config;
+  FAIL_IF_ERR(client->ModelConfig(&config, "simple"), "model config");
+
+  std::string index;
+  FAIL_IF_ERR(client->ModelRepositoryIndex(&index), "repository index");
+  if (index.find("simple") == std::string::npos) {
+    std::cerr << "'simple' missing from repository index\n";
+    return 1;
+  }
+
+  std::string stats;
+  FAIL_IF_ERR(client->ModelInferenceStatistics(&stats, "simple"),
+              "statistics");
+
+  std::cout << "PASS: http health + metadata" << std::endl;
+  return 0;
+}

@@ -1,0 +1,118 @@
+// System shared memory over the HTTP/REST front-end: inputs AND
+// outputs ride POSIX shm regions, only tensor references cross the
+// wire (parity example: reference
+// src/c++/examples/simple_http_shm_client.cc).
+#include <cstring>
+#include <iostream>
+
+#include "http_client.h"
+#include "shm_utils.h"
+
+namespace {
+const char* Url(int argc, char** argv, const char* fallback) {
+  for (int i = 1; i + 1 < argc; ++i) {
+    if (strcmp(argv[i], "-u") == 0) return argv[i + 1];
+  }
+  return fallback;
+}
+#define FAIL_IF_ERR(x, msg)                                         \
+  do {                                                              \
+    tpuclient::Error err__ = (x);                                   \
+    if (!err__.IsOk()) {                                            \
+      std::cerr << "error: " << msg << ": " << err__.Message()      \
+                << std::endl;                                       \
+      exit(1);                                                      \
+    }                                                               \
+  } while (0)
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::unique_ptr<tpuclient::InferenceServerHttpClient> client;
+  FAIL_IF_ERR(tpuclient::InferenceServerHttpClient::Create(
+                  &client, Url(argc, argv, "localhost:8000")),
+              "create client");
+  client->UnregisterSystemSharedMemory();
+
+  constexpr size_t kTensorBytes = 16 * sizeof(int32_t);
+
+  int in_fd;
+  void* in_addr;
+  FAIL_IF_ERR(tpuclient::CreateSharedMemoryRegion(
+                  "/http_example_input", kTensorBytes * 2, &in_fd),
+              "create input region");
+  FAIL_IF_ERR(tpuclient::MapSharedMemory(
+                  in_fd, 0, kTensorBytes * 2, &in_addr),
+              "map input region");
+  int32_t* in0 = static_cast<int32_t*>(in_addr);
+  int32_t* in1 = in0 + 16;
+  for (int i = 0; i < 16; ++i) { in0[i] = i; in1[i] = 3; }
+
+  int out_fd;
+  void* out_addr;
+  FAIL_IF_ERR(tpuclient::CreateSharedMemoryRegion(
+                  "/http_example_output", kTensorBytes * 2, &out_fd),
+              "create output region");
+  FAIL_IF_ERR(tpuclient::MapSharedMemory(
+                  out_fd, 0, kTensorBytes * 2, &out_addr),
+              "map output region");
+
+  FAIL_IF_ERR(client->RegisterSystemSharedMemory(
+                  "http_input_data", "/http_example_input",
+                  kTensorBytes * 2),
+              "register input region");
+  FAIL_IF_ERR(client->RegisterSystemSharedMemory(
+                  "http_output_data", "/http_example_output",
+                  kTensorBytes * 2),
+              "register output region");
+
+  std::string status;
+  FAIL_IF_ERR(client->SystemSharedMemoryStatus(&status), "shm status");
+  if (status.find("http_input_data") == std::string::npos) {
+    std::cerr << "registered region missing from status\n";
+    return 1;
+  }
+
+  tpuclient::InferInput* raw0;
+  tpuclient::InferInput* raw1;
+  tpuclient::InferInput::Create(&raw0, "INPUT0", {16}, "INT32");
+  tpuclient::InferInput::Create(&raw1, "INPUT1", {16}, "INT32");
+  std::unique_ptr<tpuclient::InferInput> input0(raw0), input1(raw1);
+  input0->SetSharedMemory("http_input_data", kTensorBytes, 0);
+  input1->SetSharedMemory("http_input_data", kTensorBytes, kTensorBytes);
+
+  tpuclient::InferRequestedOutput* rout0;
+  tpuclient::InferRequestedOutput* rout1;
+  tpuclient::InferRequestedOutput::Create(&rout0, "OUTPUT0");
+  tpuclient::InferRequestedOutput::Create(&rout1, "OUTPUT1");
+  std::unique_ptr<tpuclient::InferRequestedOutput> output0(rout0),
+      output1(rout1);
+  output0->SetSharedMemory("http_output_data", kTensorBytes, 0);
+  output1->SetSharedMemory("http_output_data", kTensorBytes, kTensorBytes);
+
+  tpuclient::InferOptions options("simple");
+  tpuclient::InferResult* raw_result;
+  FAIL_IF_ERR(client->Infer(&raw_result, options,
+                            {input0.get(), input1.get()},
+                            {output0.get(), output1.get()}),
+              "infer");
+  std::unique_ptr<tpuclient::InferResult> result(raw_result);
+
+  const int32_t* sum = static_cast<const int32_t*>(out_addr);
+  const int32_t* diff = sum + 16;
+  for (int i = 0; i < 16; ++i) {
+    if (sum[i] != in0[i] + in1[i] || diff[i] != in0[i] - in1[i]) {
+      std::cerr << "mismatch at " << i << "\n";
+      return 1;
+    }
+  }
+
+  client->UnregisterSystemSharedMemory();
+  tpuclient::UnmapSharedMemory(in_addr, kTensorBytes * 2);
+  tpuclient::UnmapSharedMemory(out_addr, kTensorBytes * 2);
+  tpuclient::CloseSharedMemory(in_fd);
+  tpuclient::CloseSharedMemory(out_fd);
+  tpuclient::UnlinkSharedMemoryRegion("/http_example_input");
+  tpuclient::UnlinkSharedMemoryRegion("/http_example_output");
+  std::cout << "PASS: http system shm infer" << std::endl;
+  return 0;
+}

@@ -244,6 +244,21 @@ Error InferenceServerGrpcClient::Create(
   return err;
 }
 
+Error InferenceServerGrpcClient::Create(
+    std::unique_ptr<InferenceServerGrpcClient>* client,
+    const std::string& url, const KeepAliveOptions& keepalive,
+    bool verbose) {
+  // Keepalive probing is per-connection state: never share a cached
+  // channel (another client's probing policy must not leak in).
+  Error err = Create(client, url, verbose, /*use_cached_channel=*/false);
+  if (!err.IsOk()) return err;
+  if (keepalive.keepalive_time_ms != UINT64_MAX) {
+    (*client)->channel_->EnableKeepAlive(
+        keepalive.keepalive_time_ms, keepalive.keepalive_timeout_ms);
+  }
+  return Error::Success;
+}
+
 Error InferenceServerGrpcClient::Rpc(
     const std::string& method, const google::protobuf::Message& req,
     google::protobuf::Message* resp, const Headers& headers,

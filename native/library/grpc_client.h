@@ -100,6 +100,20 @@ class InferenceServerGrpcClient : public InferenceServerClient {
       const std::string& url, bool verbose = false,
       bool use_cached_channel = true);
 
+  // Client-side keepalive (parity: the reference's KeepAliveOptions,
+  // grpc_client.h:94 — GRPC_ARG_KEEPALIVE_* channel args; here h2
+  // PING probing on the owned connection). keepalive_time_ms is the
+  // probe interval, keepalive_timeout_ms the unacked-PING deadline.
+  struct KeepAliveOptions {
+    uint64_t keepalive_time_ms = UINT64_MAX;  // default: disabled
+    uint64_t keepalive_timeout_ms = 20000;
+  };
+
+  static Error Create(
+      std::unique_ptr<InferenceServerGrpcClient>* client,
+      const std::string& url, const KeepAliveOptions& keepalive,
+      bool verbose = false);
+
   Error IsServerLive(bool* live, const Headers& headers = {});
   Error IsServerReady(bool* ready, const Headers& headers = {});
   Error IsModelReady(

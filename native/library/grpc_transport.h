@@ -89,6 +89,14 @@ class GrpcChannel {
       std::function<void(const Error&)> on_done,
       const Headers& metadata = {});
 
+  // Transport-level liveness probing with h2 PINGs (gRPC keepalive
+  // semantics): unacked PINGs fail the connection and every pending
+  // call errors out, so dead servers are detected without waiting on
+  // per-call timeouts.
+  void EnableKeepAlive(uint64_t interval_ms, uint64_t timeout_ms) {
+    if (conn_) conn_->EnableKeepAlive(interval_ms, timeout_ms);
+  }
+
   // Synchronously closes the connection, failing all in-flight calls
   // (their callbacks fire before this returns). Lets owners tear down
   // callback targets safely afterwards.

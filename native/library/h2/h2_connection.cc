@@ -331,9 +331,58 @@ size_t H2Connection::num_active_streams() {
   return streams_.size();
 }
 
+void H2Connection::EnableKeepAlive(uint64_t interval_ms,
+                                   uint64_t timeout_ms) {
+  if (keepalive_.joinable()) return;
+  keepalive_ = std::thread([this, interval_ms, timeout_ms] {
+    const char payload[8] = {'k', 'e', 'e', 'p', 'a', 'l', 'v', 0};
+    while (!keepalive_stop_.load() && !dead_.load()) {
+      {
+        std::lock_guard<std::mutex> wl(write_mutex_);
+        if (WriteFrame(kFramePing, 0, 0, payload, 8).empty()) {
+          pings_sent_.fetch_add(1);
+        }
+      }
+      // Wait for the ack within timeout_ms, polling in small steps so
+      // stop/death are noticed promptly.
+      uint64_t waited = 0;
+      while (waited < timeout_ms && !keepalive_stop_.load() &&
+             !dead_.load() && pings_acked_.load() < pings_sent_.load()) {
+        std::this_thread::sleep_for(std::chrono::milliseconds(20));
+        waited += 20;
+      }
+      if (pings_acked_.load() < pings_sent_.load() &&
+          !keepalive_stop_.load() && !dead_.load()) {
+        // Only flag + kill the socket here: the reader thread then
+        // fails the streams and fires user callbacks on ITS thread.
+        // Running FailAll from this thread could destroy the
+        // connection inside a user callback while this thread still
+        // touches members (use-after-free, then std::terminate on
+        // the joinable thread member).
+        keepalive_expired_.store(true);
+        ::shutdown(fd_, SHUT_RDWR);
+        return;
+      }
+      uint64_t slept = 0;
+      while (slept < interval_ms && !keepalive_stop_.load() &&
+             !dead_.load()) {
+        std::this_thread::sleep_for(std::chrono::milliseconds(50));
+        slept += 50;
+      }
+    }
+  });
+}
+
 void H2Connection::Close() {
+  keepalive_stop_.store(true);
   if (fd_ >= 0) {
+    // Socket shutdown FIRST: it unsticks a keepalive PING send wedged
+    // in SendAll's retry loop, so the join below can't hang.
     ::shutdown(fd_, SHUT_RDWR);
+  }
+  if (keepalive_.joinable() &&
+      keepalive_.get_id() != std::this_thread::get_id()) {
+    keepalive_.join();
   }
   if (reader_.joinable() &&
       reader_.get_id() != std::this_thread::get_id()) {
@@ -365,7 +414,9 @@ void H2Connection::ReaderLoop() {
   std::string payload;
   while (true) {
     if (!ReadExact(header, 9)) {
-      FailAll("connection reset");
+      FailAll(keepalive_expired_.load()
+                  ? "keepalive timeout: PING unacked"
+                  : "connection reset");
       return;
     }
     size_t len = (static_cast<size_t>(static_cast<uint8_t>(header[0])) << 16) |
@@ -524,7 +575,9 @@ void H2Connection::HandleFrame(
       break;
     }
     case kFramePing: {
-      if (!(flags & kFlagAck) && payload.size() == 8) {
+      if (flags & kFlagAck) {
+        pings_acked_.fetch_add(1);
+      } else if (payload.size() == 8) {
         std::lock_guard<std::mutex> wl(write_mutex_);
         WriteFrame(kFramePing, kFlagAck, 0, payload.data(), 8);
       }

@@ -53,6 +53,12 @@ class H2Connection {
   std::string Connect(uint64_t timeout_us = 0);
   bool IsConnected() const { return !dead_.load() && fd_ >= 0; }
 
+  // Liveness probing with h2 PING frames (the transport-level
+  // equivalent of gRPC keepalive): every `interval_ms` an outstanding
+  // PING is sent; a PING unacked for `timeout_ms` fails the
+  // connection ("keepalive watchdog"). Call after Connect().
+  void EnableKeepAlive(uint64_t interval_ms, uint64_t timeout_ms);
+
   // Opens a stream by sending a HEADERS frame (END_STREAM unset).
   // Blocks while the peer's MAX_CONCURRENT_STREAMS limit is reached.
   // Returns the stream id (>0) or -1 with *err filled.
@@ -115,6 +121,11 @@ class H2Connection {
   std::string dead_reason_;
 
   std::thread reader_;
+  std::thread keepalive_;
+  std::atomic<bool> keepalive_stop_{false};
+  std::atomic<bool> keepalive_expired_{false};
+  std::atomic<uint64_t> pings_sent_{0};
+  std::atomic<uint64_t> pings_acked_{0};
 
   std::mutex write_mutex_;
   HpackEncoder encoder_;

@@ -275,9 +275,19 @@ void Http1Server::ServeRequests(int fd) {
         return;
       }
       if (name == "content-length") {
+        // Trim RFC 7230 optional trailing whitespace; reject signs
+        // (strtoull would silently wrap "-1" to 2^64-1).
+        while (!value.empty() &&
+               (value.back() == ' ' || value.back() == '\t')) {
+          value.pop_back();
+        }
         char* end = nullptr;
-        content_length = strtoull(value.c_str(), &end, 10);
-        if (end == value.c_str() || (end != nullptr && *end != '\0')) {
+        bool bad = value.empty() || value[0] == '-' || value[0] == '+';
+        if (!bad) {
+          content_length = strtoull(value.c_str(), &end, 10);
+          bad = (end == value.c_str()) || (end != nullptr && *end != '\0');
+        }
+        if (bad) {
           const char* resp =
               "HTTP/1.1 400 Bad Request\r\nContent-Length: 0\r\n"
               "Connection: close\r\n\r\n";

@@ -4,6 +4,11 @@
 // The client side is the framework's own GrpcChannel: every test is a
 // real cross-stack pair (native client transport <-> native server
 // transport) over localhost.
+#include <netinet/in.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
@@ -13,6 +18,7 @@
 
 #include "../server/h2_server.h"
 #include "grpc_transport.h"
+#include "h2/h2_connection.h"
 #include "minitest.h"
 
 using namespace tpuclient;
@@ -200,6 +206,70 @@ TEST_CASE("h2 server: shutdown with in-flight calls") {
   fx->server.Shutdown();
   caller.join();
   channel->Shutdown();
+}
+
+TEST_CASE("h2 client: keepalive detects a silent peer") {
+  // A peer that completes the h2 handshake then never responds: the
+  // client's PING watchdog must fail the connection in bounded time
+  // (the failure-detection story — no per-call timeout needed).
+  int listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  REQUIRE(listen_fd >= 0);
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = 0;
+  REQUIRE(bind(listen_fd, reinterpret_cast<struct sockaddr*>(&addr),
+               sizeof(addr)) == 0);
+  REQUIRE(listen(listen_fd, 1) == 0);
+  socklen_t alen = sizeof(addr);
+  getsockname(listen_fd, reinterpret_cast<struct sockaddr*>(&addr), &alen);
+  int port = ntohs(addr.sin_port);
+
+  std::thread silent_peer([listen_fd] {
+    int fd = ::accept(listen_fd, nullptr, nullptr);
+    if (fd < 0) return;
+    // Server SETTINGS so the client handshake completes...
+    const char settings[9] = {0, 0, 0, 0x4, 0, 0, 0, 0, 0};
+    ::send(fd, settings, sizeof(settings), MSG_NOSIGNAL);
+    // ...then read and discard everything, never answering PINGs.
+    char buf[4096];
+    while (::recv(fd, buf, sizeof(buf), 0) > 0) {
+    }
+    ::close(fd);
+  });
+
+  tpuclient::h2::H2Connection conn("127.0.0.1", port);
+  REQUIRE(conn.Connect(2 * 1000 * 1000).empty());
+  conn.EnableKeepAlive(/*interval_ms=*/100, /*timeout_ms=*/500);
+
+  std::mutex mutex;
+  std::condition_variable cv;
+  std::string close_error;
+  bool closed = false;
+  tpuclient::h2::StreamCallbacks callbacks;
+  callbacks.on_close = [&](const tpuclient::h2::HeaderList&,
+                           const std::string& error) {
+    std::lock_guard<std::mutex> lk(mutex);
+    closed = true;
+    close_error = error;
+    cv.notify_all();
+  };
+  std::string err;
+  int32_t sid = conn.StartStream(
+      {{":method", "POST"}, {":scheme", "http"}, {":path", "/x"},
+       {":authority", "test"}},
+      callbacks, &err);
+  CHECK(sid > 0);
+  {
+    std::unique_lock<std::mutex> lk(mutex);
+    CHECK(cv.wait_for(lk, std::chrono::seconds(5), [&] { return closed; }));
+  }
+  CHECK(close_error.find("keepalive") != std::string::npos);
+  conn.Close();
+  ::shutdown(listen_fd, SHUT_RDWR);
+  ::close(listen_fd);
+  silent_peer.join();
 }
 
 MINITEST_MAIN

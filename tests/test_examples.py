@@ -95,12 +95,20 @@ CPP_GRPC_EXAMPLES = [
     "simple_grpc_sequence_sync_client",
     "simple_grpc_health_metadata_client",
     "simple_grpc_model_control_client",
+    "simple_grpc_keepalive_client",
+    "simple_grpc_custom_repeat_client",
+    "simple_grpc_sequence_stream_client",
+    "image_client",
 ]
 
 CPP_HTTP_EXAMPLES = [
     "simple_http_infer_client",
     "simple_http_string_infer_client",
     "simple_http_async_infer_client",
+    "simple_http_health_metadata_client",
+    "simple_http_model_control_client",
+    "simple_http_shm_client",
+    "simple_http_sequence_sync_client",
 ]
 
 
@@ -211,3 +219,17 @@ def test_memory_growth(example_server):
     _run_example_args(
         "memory_growth_test.py",
         ["-u", example_server["grpc"], "-n", "600"])
+
+
+def test_cpp_reuse_infer_objects(example_server):
+    """Needs both protocol endpoints (-u grpc, -w http)."""
+    binary = REPO / "native" / "build" / "reuse_infer_objects_client"
+    if not binary.exists():
+        pytest.skip("native examples not built (run test_native first)")
+    proc = subprocess.run(
+        [str(binary), "-u", example_server["grpc"],
+         "-w", example_server["http"]],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "PASS" in proc.stdout
