@@ -25,6 +25,9 @@ def builtin_model_factories(repository=None
         "add_sub_fp32": lambda: AddSub(
             name="add_sub_fp32", datatype="FP32", shape=(16,)
         ),
+        "add_sub_int8": lambda: AddSub(
+            name="add_sub_int8", datatype="INT8", shape=(16,)
+        ),
         "add_sub_tpu": lambda: AddSub(
             name="add_sub_tpu", datatype="FP32", shape=(16,), device="tpu"
         ),

@@ -1,0 +1,58 @@
+#!/usr/bin/env python
+"""Explicit INT8 typed contents: int8 values ride the proto's
+int_contents field (KServe-v2 packs every integer width narrower than
+64 bits there), exercising the server's typed-content decode for a
+narrow dtype.
+
+Start a server first:
+  python -m client_tpu.server.app --models add_sub_int8
+(parity example: reference
+src/python/examples/grpc_explicit_int8_content_client.py)
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import grpc
+import numpy as np
+
+from client_tpu.protocol import inference_pb2 as pb
+from client_tpu.protocol.service import GRPCInferenceServiceStub
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+
+    channel = grpc.insecure_channel(args.url)
+    stub = GRPCInferenceServiceStub(channel)
+
+    in0 = np.arange(16, dtype=np.int8)
+    in1 = np.ones(16, dtype=np.int8)
+    request = pb.ModelInferRequest(model_name="add_sub_int8")
+    for name, values in (("INPUT0", in0), ("INPUT1", in1)):
+        tensor = request.inputs.add()
+        tensor.name = name
+        tensor.datatype = "INT8"
+        tensor.shape.extend([16])
+        tensor.contents.int_contents.extend(int(v) for v in values)
+    response = stub.ModelInfer(request)
+
+    out0 = np.frombuffer(response.raw_output_contents[0], dtype=np.int8)
+    out1 = np.frombuffer(response.raw_output_contents[1], dtype=np.int8)
+    expected_sum = in0 + in1
+    expected_diff = in0 - in1
+    for i in range(16):
+        print("%d + %d = %d" % (in0[i], in1[i], out0[i]))
+        assert out0[i] == expected_sum[i]
+        assert out1[i] == expected_diff[i]
+    channel.close()
+    print("PASS: explicit int8 contents")
+
+
+if __name__ == "__main__":
+    main()

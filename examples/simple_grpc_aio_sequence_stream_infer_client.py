@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""asyncio sequences over the bidi stream: two correlated sequences
+interleave on one ModelStreamInfer stream driven by an async
+generator, with per-sequence running totals checked from the streamed
+responses.
+
+Start a server first:
+  python -m client_tpu.server.app --models simple_sequence
+(parity example: reference
+src/python/examples/simple_grpc_aio_sequence_stream_infer_client.py)
+"""
+
+import argparse
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import client_tpu.grpc.aio as grpcclient_aio
+from client_tpu.grpc import InferInput
+
+
+def _sequence_step(sequence_id, value, start, end):
+    inputs = [InferInput("INPUT", [1], "INT32")]
+    inputs[0].set_data_from_numpy(np.array([value], dtype=np.int32))
+    return dict(
+        model_name="simple_sequence",
+        inputs=inputs,
+        request_id="%d-%d" % (sequence_id, value),
+        sequence_id=sequence_id,
+        sequence_start=start,
+        sequence_end=end,
+    )
+
+
+async def run(url):
+    seq_a, seq_b = 31001, 31002
+    steps = [
+        _sequence_step(seq_a, 1, True, False),
+        _sequence_step(seq_b, 10, True, False),
+        _sequence_step(seq_a, 2, False, False),
+        _sequence_step(seq_b, 20, False, False),
+        _sequence_step(seq_a, 3, False, True),
+        _sequence_step(seq_b, 30, False, True),
+    ]
+
+    async def request_iterator():
+        for step in steps:
+            yield step
+
+    totals = {}
+    async with grpcclient_aio.InferenceServerClient(url) as client:
+        async for result, error in client.stream_infer(request_iterator()):
+            assert error is None, error
+            request_id = result.get_response().id
+            sequence = int(request_id.split("-")[0])
+            totals[sequence] = int(result.as_numpy("OUTPUT")[0])
+            if len(totals) == 2 and totals.get(seq_a) == 6 \
+                    and totals.get(seq_b) == 60:
+                break
+
+    assert totals[seq_a] == 6, totals
+    assert totals[seq_b] == 60, totals
+    print("PASS: aio sequence stream (totals %d, %d)"
+          % (totals[seq_a], totals[seq_b]))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+    asyncio.run(run(args.url))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""BYTES tensors through system shared memory over gRPC: the
+wire-serialized string tensor (4-byte length prefixes) lives in a
+POSIX shm region; only region references cross the RPC.
+
+Start a server first:
+  python -m client_tpu.server.app --models simple_string
+(parity example: reference
+src/python/examples/simple_grpc_shm_string_client.py — there CUDA shm
+carries the serialized strings; semantics identical.)
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import client_tpu.grpc as grpcclient
+import client_tpu.utils.shared_memory as shm
+from client_tpu.utils import deserialize_bytes_tensor, serialize_byte_tensor
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+
+    with grpcclient.InferenceServerClient(args.url) as client:
+        client.unregister_system_shared_memory()
+
+        in0 = np.array([str(i).encode() for i in range(16)],
+                       dtype=np.object_)
+        in1 = np.array([b"1"] * 16, dtype=np.object_)
+        in0_bytes = serialize_byte_tensor(in0).tobytes()
+        in1_bytes = serialize_byte_tensor(in1).tobytes()
+
+        in_handle = shm.create_shared_memory_region(
+            "str_input_data", "/example_str_input",
+            len(in0_bytes) + len(in1_bytes))
+        shm.set_shared_memory_region(in_handle, [in0])
+        shm.set_shared_memory_region(in_handle, [in1],
+                                     offset=len(in0_bytes))
+        # Serialized string outputs vary in length; give them slack.
+        out_capacity = 2 * (len(in0_bytes) + len(in1_bytes)) + 256
+        out_handle = shm.create_shared_memory_region(
+            "str_output_data", "/example_str_output", out_capacity)
+
+        client.register_system_shared_memory(
+            "str_input_data", "/example_str_input",
+            len(in0_bytes) + len(in1_bytes))
+        client.register_system_shared_memory(
+            "str_output_data", "/example_str_output", out_capacity)
+
+        try:
+            inputs = [
+                grpcclient.InferInput("INPUT0", [16], "BYTES"),
+                grpcclient.InferInput("INPUT1", [16], "BYTES"),
+            ]
+            inputs[0].set_shared_memory("str_input_data", len(in0_bytes))
+            inputs[1].set_shared_memory("str_input_data", len(in1_bytes),
+                                        offset=len(in0_bytes))
+            half = out_capacity // 2
+            outputs = [
+                grpcclient.InferRequestedOutput("OUTPUT0"),
+                grpcclient.InferRequestedOutput("OUTPUT1"),
+            ]
+            outputs[0].set_shared_memory("str_output_data", half)
+            outputs[1].set_shared_memory("str_output_data", half,
+                                         offset=half)
+
+            result = client.infer("simple_string", inputs, outputs=outputs)
+
+            sum_size = result.get_output("OUTPUT0").parameters[
+                "shared_memory_byte_size"].int64_param
+            raw = bytes(out_handle.buf()[:sum_size])
+            decoded = deserialize_bytes_tensor(raw)
+            for i, value in enumerate(decoded):
+                total = int(value)
+                print("%d + 1 = %d" % (i, total))
+                assert total == i + 1
+        finally:
+            client.unregister_system_shared_memory()
+            shm.destroy_shared_memory_region(in_handle)
+            shm.destroy_shared_memory_region(out_handle)
+    print("PASS: string tensors through system shm")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""BYTES tensors through system shared memory over HTTP/REST — same
+serialized-string-in-region convention as the gRPC variant, through
+the REST front-end's shm extension.
+
+Start a server first:
+  python -m client_tpu.server.app --models simple_string
+(parity example: reference
+src/python/examples/simple_http_shm_string_client.py)
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import client_tpu.http as httpclient
+import client_tpu.utils.shared_memory as shm
+from client_tpu.utils import deserialize_bytes_tensor, serialize_byte_tensor
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    args = parser.parse_args()
+
+    with httpclient.InferenceServerClient(args.url) as client:
+        client.unregister_system_shared_memory()
+
+        in0 = np.array([str(i).encode() for i in range(16)],
+                       dtype=np.object_)
+        in1 = np.array([b"2"] * 16, dtype=np.object_)
+        in0_bytes = serialize_byte_tensor(in0).tobytes()
+        in1_bytes = serialize_byte_tensor(in1).tobytes()
+
+        in_handle = shm.create_shared_memory_region(
+            "str_http_input", "/http_str_input",
+            len(in0_bytes) + len(in1_bytes))
+        shm.set_shared_memory_region(in_handle, [in0])
+        shm.set_shared_memory_region(in_handle, [in1],
+                                     offset=len(in0_bytes))
+        out_capacity = 2 * (len(in0_bytes) + len(in1_bytes)) + 256
+        out_handle = shm.create_shared_memory_region(
+            "str_http_output", "/http_str_output", out_capacity)
+
+        client.register_system_shared_memory(
+            "str_http_input", "/http_str_input",
+            len(in0_bytes) + len(in1_bytes))
+        client.register_system_shared_memory(
+            "str_http_output", "/http_str_output", out_capacity)
+
+        try:
+            inputs = [
+                httpclient.InferInput("INPUT0", [16], "BYTES"),
+                httpclient.InferInput("INPUT1", [16], "BYTES"),
+            ]
+            inputs[0].set_shared_memory("str_http_input", len(in0_bytes))
+            inputs[1].set_shared_memory("str_http_input", len(in1_bytes),
+                                        offset=len(in0_bytes))
+            half = out_capacity // 2
+            outputs = [
+                httpclient.InferRequestedOutput("OUTPUT0"),
+                httpclient.InferRequestedOutput("OUTPUT1"),
+            ]
+            outputs[0].set_shared_memory("str_http_output", half)
+            outputs[1].set_shared_memory("str_http_output", half,
+                                         offset=half)
+
+            result = client.infer("simple_string", inputs, outputs=outputs)
+
+            params = result.get_output("OUTPUT0")["parameters"]
+            sum_size = int(params["shared_memory_byte_size"])
+            raw = bytes(out_handle.buf()[:sum_size])
+            decoded = deserialize_bytes_tensor(raw)
+            for i, value in enumerate(decoded):
+                total = int(value)
+                print("%d + 2 = %d" % (i, total))
+                assert total == i + 2
+        finally:
+            client.unregister_system_shared_memory()
+            shm.destroy_shared_memory_region(in_handle)
+            shm.destroy_shared_memory_region(out_handle)
+    print("PASS: string tensors through system shm (http)")
+
+
+if __name__ == "__main__":
+    main()

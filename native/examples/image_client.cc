@@ -36,8 +36,9 @@ const char* Arg(int argc, char** argv, const char* flag,
 
 int main(int argc, char** argv) {
   const char* url = Arg(argc, argv, "-u", "localhost:8001");
-  int batch = atoi(Arg(argc, argv, "-b", "2"));
-  int topk = atoi(Arg(argc, argv, "-c", "3"));
+  int batch = std::max(1, atoi(Arg(argc, argv, "-b", "2")));
+  int topk = std::min(std::max(1, atoi(Arg(argc, argv, "-c", "3"))),
+                      kClasses);
   const char* raw_path = Arg(argc, argv, "-f", "");  // raw f32 NHWC file
 
   std::unique_ptr<tpuclient::InferenceServerGrpcClient> client;

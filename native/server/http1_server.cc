@@ -275,17 +275,23 @@ void Http1Server::ServeRequests(int fd) {
         return;
       }
       if (name == "content-length") {
-        // Trim RFC 7230 optional trailing whitespace; reject signs
-        // (strtoull would silently wrap "-1" to 2^64-1).
+        // Trim RFC 7230 optional whitespace both sides, then require
+        // every char to be a digit: strtoull would skip tabs, accept
+        // signs (wrapping "-1" to 2^64-1), and clamp overflow.
         while (!value.empty() &&
                (value.back() == ' ' || value.back() == '\t')) {
           value.pop_back();
         }
-        char* end = nullptr;
-        bool bad = value.empty() || value[0] == '-' || value[0] == '+';
+        while (!value.empty() &&
+               (value.front() == ' ' || value.front() == '\t')) {
+          value.erase(value.begin());
+        }
+        bool bad = value.empty() || value.size() > 18;  // > 1e18: absurd
+        for (char c : value) {
+          if (c < '0' || c > '9') bad = true;
+        }
         if (!bad) {
-          content_length = strtoull(value.c_str(), &end, 10);
-          bad = (end == value.c_str()) || (end != nullptr && *end != '\0');
+          content_length = strtoull(value.c_str(), nullptr, 10);
         }
         if (bad) {
           const char* resp =

@@ -15,6 +15,9 @@ EXAMPLES = REPO / "examples"
 GRPC_EXAMPLES = [
     "grpc_explicit_int_content_client.py",
     "grpc_explicit_byte_content_client.py",
+    "grpc_explicit_int8_content_client.py",
+    "simple_grpc_shm_string_client.py",
+    "simple_grpc_aio_sequence_stream_infer_client.py",
     "simple_grpc_keepalive_client.py",
     "simple_grpc_infer_client.py",
     "simple_grpc_string_infer_client.py",
@@ -38,6 +41,7 @@ HTTP_EXAMPLES = [
     "simple_http_aio_infer_client.py",
     "simple_http_shm_client.py",
     "simple_http_string_infer_client.py",
+    "simple_http_shm_string_client.py",
 ]
 
 
@@ -48,7 +52,7 @@ def example_server():
 
     core = build_core(
         ["simple", "simple_string", "simple_sequence", "repeat_int32",
-         "add_sub_fp32", "resnet50", "ensemble_image"]
+         "add_sub_fp32", "add_sub_int8", "resnet50", "ensemble_image"]
     )
     grpc_handle = start_grpc_server(core=core)
     http_runner = start_http_server_thread(core, host="127.0.0.1", port=0)
