@@ -560,6 +560,42 @@ def main() -> None:
             llm_stage["throughput"] * llm_stage["tokens_per_request"], 1)
         flush_result()
 
+    # Config 5 LLM metrics proper: the genai harness measures TTFT and
+    # inter-token latency over the decoupled stream (the numbers LLM
+    # serving is actually judged by). Attached to the llm stage.
+    if llm_stage and remaining() > 90:
+        try:
+            export = "/tmp/bench_genai.json"
+            proc = subprocess.run(
+                [sys.executable, "-m", "client_tpu.genai.main",
+                 "-m", "llm_tiny", "-u", handle.address,
+                 "--concurrency", "2", "--num-prompts", "6",
+                 "--output-tokens-mean", str(llm_max_tokens),
+                 "--measurement-interval", "3000", "--max-trials", "3",
+                 "--export-json", export],
+                capture_output=True, text=True, cwd=str(REPO),
+                timeout=max(60.0, min(240.0, remaining() - 20)))
+            if proc.returncode != 0:
+                raise RuntimeError("genai rc=%d: %s"
+                                   % (proc.returncode, proc.stderr[-400:]))
+            with open(export) as f:
+                doc = json.load(f)
+            stats = doc["experiments"][0]
+            for key, out_name in (
+                ("time_to_first_token_ms", "ttft_ms"),
+                ("inter_token_latency_ms", "itl_ms"),
+            ):
+                if key in stats:
+                    llm_stage[out_name] = {
+                        k: round(v, 2)
+                        for k, v in stats[key].items()
+                        if k in ("mean", "p50", "p99")}
+            flush_result()
+            log("genai TTFT/ITL attached: %s / %s"
+                % (llm_stage.get("ttft_ms"), llm_stage.get("itl_ms")))
+        except Exception as exc:  # noqa: BLE001
+            log("genai stage failed: %s" % exc)
+
     flush_result()
     handle.stop()
     log("done")

@@ -318,6 +318,7 @@ Error InferenceProfiler::Measure(PerfStatus* status) {
     // Best effort — a failed stats scrape never fails the window.
     stats_backend_->ModelStatisticsJson(&stats_before, "");
   }
+  manager_->GetAndResetIdleNs();  // window starts with clean idle books
   uint64_t start_ns = NowNs();
   if (config_.count_windows) {
     uint64_t deadline =
@@ -332,6 +333,16 @@ Error InferenceProfiler::Measure(PerfStatus* status) {
         std::chrono::milliseconds(config_.measurement_interval_ms));
   }
   uint64_t end_ns = NowNs();
+  {
+    // Reference SummarizeOverhead: idle above the window length (the
+    // start/stop isn't instantaneous) clamps to 0% overhead.
+    uint64_t window_ns = end_ns - start_ns;
+    uint64_t idle_ns = manager_->GetAndResetIdleNs();
+    status->overhead_pct =
+        idle_ns >= window_ns
+            ? 0.0
+            : 100.0 * static_cast<double>(window_ns - idle_ns) / window_ns;
+  }
   Summarize(manager_->SwapRequestRecords(), start_ns, end_ns, status);
   if (metrics_ != nullptr) {
     status->tpu_metrics = SummarizeMetrics(metrics_->GetAndReset());

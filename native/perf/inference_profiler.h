@@ -29,6 +29,10 @@ struct PerfStatus {
   size_t completed_count = 0;
   size_t delayed_count = 0;
   size_t error_count = 0;
+  // Share of the window the harness workers were busy (100 - idle%):
+  // high values mean the measurement is client-bound (reference
+  // SummarizeOverhead semantics).
+  double overhead_pct = 0.0;
   // First failing request's message — without it a fully-erroring run
   // prints only a count, hiding the actual cause.
   std::string sample_error;

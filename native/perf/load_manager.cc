@@ -319,6 +319,17 @@ std::vector<RequestRecord> LoadManager::SwapRequestRecords() {
   return records;
 }
 
+uint64_t LoadManager::GetAndResetIdleNs() {
+  uint64_t total = 0;
+  for (auto& stat : thread_stats_) {
+    total += stat->idle_ns.exchange(0);
+  }
+  // Average over ALL launched workers: a worker with zero idle is a
+  // saturated worker, exactly what the overhead warning exists to
+  // surface — excluding it would suppress the signal.
+  return thread_stats_.empty() ? 0 : total / thread_stats_.size();
+}
+
 size_t LoadManager::CountCollectedRequests() {
   size_t count = 0;
   for (auto& stat : thread_stats_) {
@@ -484,6 +495,10 @@ void ConcurrencyManager::SyncWorker(
     InferResult* result = nullptr;
     err = backend->Infer(
         &result, options, RawInputs(inputs), RawOutputs(outputs));
+    // Blocked-in-Infer is waiting on the server, not harness work —
+    // count it as idle (reference InferContext wraps the synchronous
+    // request with its idle timer the same way).
+    stat->AddIdle(NowNs() - record.start_ns);
     if (err.IsOk()) {
       record.end_ns.push_back(NowNs());
       delete result;
@@ -501,7 +516,9 @@ void ConcurrencyManager::AsyncWorker(
   tracker->Reset(n_ctx);
   std::vector<SequenceManager::Slot> slots(n_ctx);
   while (!stop_.load()) {
+    uint64_t wait_start = NowNs();
     int ctx_id = tracker->Get(100);
+    stat->AddIdle(NowNs() - wait_start);  // no free slot = worker idle
     if (ctx_id < 0) continue;
     if (stop_.load()) {
       tracker->Release(ctx_id);
@@ -620,7 +637,9 @@ void ConcurrencyManager::StreamWorker(
 
   uint64_t counter = 0;
   while (!stop_.load()) {
+    uint64_t wait_start = NowNs();
     int ctx_id = tracker->Get(100);
+    stat->AddIdle(NowNs() - wait_start);  // no free slot = worker idle
     if (ctx_id < 0) continue;
     if (stop_.load()) {
       tracker->Release(ctx_id);
@@ -774,6 +793,9 @@ void RequestRateManager::ScheduleWorker(
       while (wait_us > 0 && !stop_.load()) {
         uint64_t chunk = std::min<uint64_t>(wait_us, 50000);
         std::this_thread::sleep_for(std::chrono::microseconds(chunk));
+        // Accrue idle incrementally: a per-window reset mid-sleep
+        // then only loses one 50ms chunk, not the whole wait.
+        stat->AddIdle(chunk * 1000);
         now = NowNs();
         wait_us = now < due_ns ? (due_ns - now) / 1000 : 0;
       }

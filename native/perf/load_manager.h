@@ -67,11 +67,18 @@ struct ThreadStat {
   std::mutex mutex;
   std::vector<RequestRecord> records;
   std::string status;  // non-empty = worker failed
+  // Time this worker spent with nothing to do (waiting for a free
+  // context slot / the pacing schedule) — the reference's IdleTimer
+  // (idle_timer.h): the profiler turns it into an overhead_pct that
+  // flags harness-bound measurements.
+  std::atomic<uint64_t> idle_ns{0};
 
   void AddRecord(RequestRecord&& record) {
     std::lock_guard<std::mutex> lock(mutex);
     records.push_back(std::move(record));
   }
+
+  void AddIdle(uint64_t ns) { idle_ns.fetch_add(ns); }
 };
 
 //==============================================================================
@@ -254,6 +261,9 @@ class LoadManager {
   // Drains all worker records (parity: SwapRequestRecords).
   std::vector<RequestRecord> SwapRequestRecords();
   size_t CountCollectedRequests();
+  // Average idle ns per active worker since the last call (parity:
+  // LoadManager::GetIdleTime averaging thread_stat idle timers).
+  uint64_t GetAndResetIdleNs();
   // Non-empty on worker failure (parity: CheckHealth).
   Error CheckHealth();
   virtual void Stop();

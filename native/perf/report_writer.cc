@@ -32,6 +32,13 @@ void PrintReport(
       printf(" p%d %.0f", kv.first, kv.second);
     }
     printf("\n");
+    if (status.overhead_pct > 50.0) {
+      // Reference behavior: warn when the harness itself is the
+      // bottleneck (workers busy most of the window).
+      printf("    WARNING: perf client overhead %.0f%% of the window — "
+             "results may be client-bound (raise --max-threads)\n",
+             status.overhead_pct);
+    }
     if (status.delayed_count > 0) {
       printf("    delayed requests: %zu\n", status.delayed_count);
     }
