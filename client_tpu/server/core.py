@@ -100,6 +100,8 @@ class InferenceServerCore:
             "trace_file": [""], "trace_level": ["OFF"], "trace_rate": ["1000"],
             "trace_count": ["-1"], "log_frequency": ["0"],
         }}
+        self._trace_state: Dict[str, dict] = {}
+        self._trace_lock = threading.Lock()
         self._log_settings: Dict[str, object] = {
             "log_file": "", "log_info": True, "log_warning": True,
             "log_error": True, "log_verbose_level": 0, "log_format": "default",
@@ -240,17 +242,105 @@ class InferenceServerCore:
 
     # -- trace / log settings -------------------------------------------
 
+    def _effective_trace_settings(self, model_name: str) -> Dict[str, list]:
+        return self._trace_settings.get(model_name) \
+            or self._trace_settings[""]
+
     def trace_setting(self, model_name: str, updates: Dict[str, list]
                       ) -> Dict[str, list]:
-        settings = self._trace_settings.setdefault(
-            model_name, dict(self._trace_settings[""])
-        )
-        for key, value in updates.items():
-            if not value:  # clear -> revert to global
-                settings[key] = list(self._trace_settings[""].get(key, []))
-            else:
-                settings[key] = [str(v) for v in value]
+        with self._trace_lock:
+            if updates:
+                # Flush every buffered state under its PRE-update
+                # settings (so records land in the file they were
+                # recorded for), then re-arm the sampling counters of
+                # the states the updated key governs (Triton re-arms
+                # trace_count on settings updates).
+                for name, state in self._trace_state.items():
+                    if state["buffer"]:
+                        self._flush_trace(
+                            name, self._effective_trace_settings(name),
+                            state)
+            settings = self._trace_settings.setdefault(
+                model_name, dict(self._trace_settings[""])
+            )
+            for key, value in updates.items():
+                if not value:  # clear -> revert to global
+                    settings[key] = list(
+                        self._trace_settings[""].get(key, []))
+                else:
+                    settings[key] = [str(v) for v in value]
+            if updates:
+                for name, state in self._trace_state.items():
+                    governed = name == model_name or (
+                        model_name == "" and name not in self._trace_settings)
+                    if governed:
+                        state["seen"] = 0
+                        state["emitted"] = 0
         return settings
+
+    def _maybe_trace(self, model_name: str, request_id: str, t0: int,
+                     t1: int, t2: int, t3: int, queue_ns: int) -> None:
+        """Emits one timeline record per sampled request (Triton trace
+        semantics: trace_level != OFF enables, trace_rate samples 1-in-N,
+        trace_count caps, log_frequency batches file writes)."""
+        settings = self._effective_trace_settings(model_name)
+        level = (settings.get("trace_level") or ["OFF"])[0]
+        if level in ("", "OFF"):
+            return
+        if not (settings.get("trace_file") or [""])[0]:
+            # No sink configured: tracing stays off (Triton needs an
+            # explicit trace file too; an implicit cwd-relative
+            # default would litter the server's working directory).
+            return
+        try:
+            rate = max(1, int((settings.get("trace_rate") or ["1000"])[0]))
+            cap = int((settings.get("trace_count") or ["-1"])[0])
+            freq = int((settings.get("log_frequency") or ["0"])[0])
+        except ValueError:
+            return
+        with self._trace_lock:
+            state = self._trace_state.setdefault(
+                model_name, {"seen": 0, "emitted": 0, "next_id": 1,
+                             "buffer": []})
+            state["seen"] += 1
+            if (state["seen"] - 1) % rate != 0:
+                return
+            if 0 <= cap <= state["emitted"]:
+                return
+            state["emitted"] += 1
+            record = {
+                "id": state["next_id"],
+                "model_name": model_name,
+                "request_id": request_id,
+                "timestamps": [
+                    {"name": "REQUEST_START", "ns": t0},
+                    {"name": "QUEUE_START", "ns": t1},
+                    {"name": "COMPUTE_START", "ns": t1 + queue_ns},
+                    {"name": "COMPUTE_END", "ns": t2},
+                    {"name": "REQUEST_END", "ns": t3},
+                ],
+            }
+            state["next_id"] += 1
+            state["buffer"].append(record)
+            if len(state["buffer"]) >= max(1, freq):
+                self._flush_trace(model_name, settings, state)
+
+    def _flush_trace(self, model_name: str, settings: Dict[str, list],
+                     state: dict) -> None:
+        """Appends buffered records as JSON lines (caller holds
+        _trace_lock)."""
+        import json as _json
+
+        path = (settings.get("trace_file") or [""])[0]
+        records, state["buffer"] = state["buffer"], []
+        if not path:
+            return  # sink was never configured; drop silently
+        try:
+            with open(path, "a") as f:
+                for record in records:
+                    f.write(_json.dumps(record) + "\n")
+        except OSError:
+            pass  # tracing must never fail the request path
 
     def log_settings(self, updates: Dict[str, object]) -> Dict[str, object]:
         for key, value in updates.items():
@@ -342,6 +432,7 @@ class InferenceServerCore:
         batch = self._batch_size(model, request)
         stats.record(batch, queue_ns, t1 - t0, (t2 - t1) - queue_ns,
                      t3 - t2, ok=True, executions=executions)
+        self._maybe_trace(model.name, request.id, t0, t1, t2, t3, queue_ns)
         return response
 
     def stream_infer(

@@ -778,9 +778,11 @@ void RequestRateManager::ScheduleWorker(
     auto busy = slot_busy[slot_cursor];
     slot_cursor = (slot_cursor + 1) % slot_count;
     if (options_.serial_sequences) {
-      // A sequence must never have two requests in flight.
+      // A sequence must never have two requests in flight; waiting
+      // for the previous one is idle time.
       while (busy->load() && !stop_.load()) {
         std::this_thread::sleep_for(std::chrono::microseconds(100));
+        stat->AddIdle(100 * 1000);
       }
       if (stop_.load()) break;
     }
@@ -846,6 +848,8 @@ void RequestRateManager::ScheduleWorker(
       InferResult* result = nullptr;
       Error send_err = backend->Infer(
           &result, options, RawInputs(*inputs), RawOutputs(*outputs));
+      // Blocked-in-Infer is server wait, not harness overhead.
+      stat->AddIdle(NowNs() - record.start_ns);
       if (send_err.IsOk()) {
         record.end_ns.push_back(NowNs());
         delete result;

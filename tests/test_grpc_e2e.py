@@ -180,6 +180,49 @@ def test_trace_and_log_settings(client):
     assert got.settings["trace_rate"].value == ["5"]
     log = client.update_log_settings({"log_verbose_level": 2})
     assert log.settings["log_verbose_level"].uint32_param == 2
+    # reset: a global TIMESTAMPS level would trace later tests' infers
+    client.update_trace_settings(settings={"trace_level": ["OFF"]})
+
+
+def test_trace_records_written(client, tmp_path):
+    """trace_level != OFF emits Triton-style timeline records to
+    trace_file, honoring trace_count caps and monotonic timestamps."""
+    import json as jsonlib
+
+    trace_file = tmp_path / "trace.jsonl"
+    client.update_trace_settings(
+        model_name="simple",
+        settings={"trace_level": ["TIMESTAMPS"], "trace_rate": "1",
+                  "trace_count": "3", "log_frequency": "1",
+                  "trace_file": str(trace_file)})
+    try:
+        in0, in1, inputs = _simple_inputs()
+        for _ in range(5):
+            client.infer("simple", inputs)
+        lines = trace_file.read_text().strip().splitlines()
+        assert len(lines) == 3  # trace_count caps emission
+        record = jsonlib.loads(lines[0])
+        assert record["model_name"] == "simple"
+        names = [t["name"] for t in record["timestamps"]]
+        assert names == ["REQUEST_START", "QUEUE_START", "COMPUTE_START",
+                         "COMPUTE_END", "REQUEST_END"]
+        stamps = [t["ns"] for t in record["timestamps"]]
+        assert stamps == sorted(stamps)
+
+        # Settings updates re-arm the counters (Triton semantics):
+        # the same cap yields fresh records after an update.
+        client.update_trace_settings(
+            model_name="simple",
+            settings={"trace_level": ["TIMESTAMPS"], "trace_rate": "1",
+                      "trace_count": "2", "log_frequency": "1",
+                      "trace_file": str(trace_file)})
+        for _ in range(4):
+            client.infer("simple", inputs)
+        lines = trace_file.read_text().strip().splitlines()
+        assert len(lines) == 5  # 3 from before + 2 re-armed
+    finally:
+        client.update_trace_settings(
+            model_name="simple", settings={"trace_level": ["OFF"]})
 
 
 def test_plugin_headers(server):
