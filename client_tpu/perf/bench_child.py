@@ -449,10 +449,13 @@ def main() -> None:
                     log("warm pass failed (continuing): %s" % exc)
             with _CompileCounter() as compiles:
                 if binary:
+                    # Longer windows + more trials than the default:
+                    # relay jitter makes 2s windows swing the headline
+                    # by +-20% run to run.
                     tput, p50 = run_native(
                         binary, handle.address, "resnet50", batch=8,
                         concurrency=4, shared_memory="tpu",
-                        output_shm=out_shm,
+                        output_shm=out_shm, window_ms=3000, trials=5,
                         timeout=max(30.0, remaining() - 20))
                 else:
                     tput, p50 = run_python_harness(

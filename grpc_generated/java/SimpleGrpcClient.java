@@ -1,0 +1,90 @@
+// Java gRPC sample for the TPU inference server (parity: reference
+// src/grpc_generated/java — ModelInfer on the `simple` model through
+// protoc-generated grpc-java stubs, as opposed to java/src which is a
+// full hand-written client speaking the wire protocol itself).
+//
+// Generate stubs (needs protoc + the protoc-gen-grpc-java plugin):
+//
+//   protoc -I ../.. \
+//     --java_out=src/main/java --grpc-java_out=src/main/java \
+//     client_tpu/protocol/inference.proto \
+//     client_tpu/protocol/model_config.proto
+//
+// Build with the grpc-java BOM on the classpath (io.grpc:grpc-netty,
+// grpc-protobuf, grpc-stub), then:
+//
+//   java SimpleGrpcClient localhost:8001
+//
+// The generated service class is inference.GRPCInferenceServiceGrpc;
+// message types live in the inference.* package.
+
+import java.nio.ByteBuffer;
+import java.nio.ByteOrder;
+
+import com.google.protobuf.ByteString;
+
+import io.grpc.ManagedChannel;
+import io.grpc.ManagedChannelBuilder;
+
+import inference.GRPCInferenceServiceGrpc;
+import inference.Inference.ModelInferRequest;
+import inference.Inference.ModelInferResponse;
+import inference.Inference.ServerLiveRequest;
+
+public final class SimpleGrpcClient {
+  public static void main(String[] args) throws Exception {
+    String target = args.length > 0 ? args[0] : "localhost:8001";
+    ManagedChannel channel =
+        ManagedChannelBuilder.forTarget(target).usePlaintext().build();
+    try {
+      GRPCInferenceServiceGrpc.GRPCInferenceServiceBlockingStub stub =
+          GRPCInferenceServiceGrpc.newBlockingStub(channel);
+
+      boolean live = stub.serverLive(
+          ServerLiveRequest.newBuilder().build()).getLive();
+      if (!live) {
+        throw new IllegalStateException("server not live");
+      }
+
+      // INPUT0 = 0..15, INPUT1 = 1s, as raw little-endian int32.
+      ByteBuffer in0 = ByteBuffer.allocate(16 * 4)
+          .order(ByteOrder.LITTLE_ENDIAN);
+      ByteBuffer in1 = ByteBuffer.allocate(16 * 4)
+          .order(ByteOrder.LITTLE_ENDIAN);
+      for (int i = 0; i < 16; ++i) {
+        in0.putInt(i);
+        in1.putInt(1);
+      }
+      in0.flip();
+      in1.flip();
+
+      ModelInferRequest request = ModelInferRequest.newBuilder()
+          .setModelName("simple")
+          .addInputs(ModelInferRequest.InferInputTensor.newBuilder()
+              .setName("INPUT0").setDatatype("INT32").addShape(16))
+          .addInputs(ModelInferRequest.InferInputTensor.newBuilder()
+              .setName("INPUT1").setDatatype("INT32").addShape(16))
+          .addRawInputContents(ByteString.copyFrom(in0))
+          .addRawInputContents(ByteString.copyFrom(in1))
+          .build();
+
+      ModelInferResponse response = stub.modelInfer(request);
+
+      ByteBuffer sum = response.getRawOutputContents(0).asReadOnlyByteBuffer()
+          .order(ByteOrder.LITTLE_ENDIAN);
+      ByteBuffer diff = response.getRawOutputContents(1).asReadOnlyByteBuffer()
+          .order(ByteOrder.LITTLE_ENDIAN);
+      for (int i = 0; i < 16; ++i) {
+        int s = sum.getInt();
+        int d = diff.getInt();
+        System.out.printf("%d + 1 = %d, %d - 1 = %d%n", i, s, i, d);
+        if (s != i + 1 || d != i - 1) {
+          throw new IllegalStateException("mismatch at " + i);
+        }
+      }
+      System.out.println("PASS: java grpc sample");
+    } finally {
+      channel.shutdownNow();
+    }
+  }
+}
