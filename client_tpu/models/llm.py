@@ -179,7 +179,7 @@ def ring_attention_fn(mesh, axis_name: str = "sp"):
 
 
 def _block(layer, x, positions, mask, cfg: LlmConfig, cache=None,
-           cache_pos=None, attention_fn=None):
+           cache_pos=None, attention_fn=None, cache_pos_vec=None):
     h = _rms_norm(x, layer["attn_norm"])
     q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"])
     k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"])
@@ -189,8 +189,17 @@ def _block(layer, x, positions, mask, cfg: LlmConfig, cache=None,
     new_cache = None
     if cache is not None:
         ck, cv = cache  # [B, T, Hkv, D]
-        ck = jax.lax.dynamic_update_slice(ck, k, (0, cache_pos, 0, 0))
-        cv = jax.lax.dynamic_update_slice(cv, v, (0, cache_pos, 0, 0))
+        if cache_pos_vec is not None:
+            # Per-lane write positions (multi-lane decode: each lane
+            # is a different sequence at a different length).
+            write = jax.vmap(
+                lambda c, kv, p: jax.lax.dynamic_update_slice(
+                    c, kv, (p, 0, 0)))
+            ck = write(ck, k, cache_pos_vec)
+            cv = write(cv, v, cache_pos_vec)
+        else:
+            ck = jax.lax.dynamic_update_slice(ck, k, (0, cache_pos, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cv, v, (0, cache_pos, 0, 0))
         k, v = ck, cv
         new_cache = (ck, cv)
     ctx = (attention_fn or _attention)(q, k, v, mask)
@@ -275,6 +284,45 @@ def decode_chunk(params, token, pos, cache, cfg: LlmConfig, length: int):
     return tokens, cache
 
 
+def decode_step_multi(params, tokens, pos, cache, cfg: LlmConfig):
+    """One step for B independent lanes: tokens [B,1], pos [B] (each
+    lane its own position); returns (logits [B,V], cache). Per-lane
+    causal masks and cache writes — the kernel under multi-lane
+    (continuous-batching-style) serving."""
+    positions = pos[:, None]  # [B,1]
+    x = params["embed"][tokens]
+    mask = (jnp.arange(cfg.max_seq)[None, None, :]
+            <= pos[:, None, None])  # [B,1,T]
+    new_cache = []
+    for layer, layer_cache in zip(params["layers"], cache):
+        x, updated = _block(layer, x, positions, mask, cfg,
+                            cache=layer_cache, cache_pos_vec=pos)
+        new_cache.append(updated)
+    x = _rms_norm(x, params["final_norm"])
+    logits = (x[:, -1] @ params["unembed"]).astype(jnp.float32)
+    return logits, new_cache
+
+
+def decode_chunk_multi(params, tokens, pos, cache, cfg: LlmConfig,
+                       length: int):
+    """Greedy-decodes ``length`` tokens for B lanes on device:
+    tokens/pos [B]; returns (token ids [length, B], cache). One
+    dispatch + one host fetch serves every active lane — requests
+    join/leave at chunk boundaries (continuous batching at chunk
+    granularity)."""
+
+    def step(carry, _):
+        tok, p, c = carry
+        logits, c = decode_step_multi(params, tok[:, None], p, c, cfg)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B]
+        return (nxt, p + 1, c), nxt
+
+    (_, _, cache), toks = jax.lax.scan(
+        step, (tokens.astype(jnp.int32), pos.astype(jnp.int32), cache),
+        None, length=length)
+    return toks, cache
+
+
 def decode_step(params, token, pos, cache, cfg: LlmConfig):
     """One token step: token [B,1], pos scalar; returns (logits [B,V],
     cache)."""
@@ -319,13 +367,39 @@ def train_step(params, tokens, targets, cfg: LlmConfig, lr: float = 1e-3,
 # -- served model ----------------------------------------------------------
 
 
+class _GenRequest:
+    """One in-flight generation riding a decode lane."""
+
+    def __init__(self, prompt, max_tokens: int, ignore_eos: bool):
+        import queue as _queue
+
+        self.prompt = prompt
+        self.max_tokens = max_tokens
+        self.ignore_eos = ignore_eos
+        self.delivered = 0
+        self.queue: "_queue.Queue" = _queue.Queue()
+        self.error: Optional[str] = None
+
+    def finish(self):
+        self.queue.put(None)
+
+    def fail(self, message: str):
+        self.error = message
+        self.queue.put(None)
+
+
 class LlmModel(ServedModel):
     """Decoupled generate endpoint: text in, token stream out.
 
     Inputs: text_input BYTES [1]; max_tokens INT32 [1] (optional);
     outputs: text_output BYTES [1] per streamed response. Greedy
-    decoding; prefill + per-token decode are independently jitted and
-    the KV cache never leaves the device.
+    decoding with multi-lane batched decode: a scheduler thread steps
+    ``decode_lanes`` independent sequences through one jitted
+    decode_chunk_multi dispatch, so concurrent requests share device
+    work instead of serializing (continuous batching at chunk
+    granularity — requests join/leave at chunk boundaries). Prefill is
+    per-request and its cache is inserted into the lane's slice of the
+    batched KV cache, which never leaves the device.
     """
 
     decoupled = True
@@ -335,13 +409,11 @@ class LlmModel(ServedModel):
 
     def __init__(self, name: str = "llm", cfg: Optional[LlmConfig] = None,
                  mesh=None, rules: ShardingRules = LLM_RULES,
-                 seed: int = 0, batch: int = 1):
+                 seed: int = 0, decode_lanes: int = 4):
         super().__init__()
         self.name = name
         self.cfg = cfg or LlmConfig()
         self._tokenizer = ByteTokenizer()
-        self._batch = batch
-        self._lock = threading.Lock()  # one generation at a time per model
         self.inputs = [
             TensorSpec("text_input", "BYTES", [1]),
             TensorSpec("max_tokens", "INT32", [1], optional=True),
@@ -366,24 +438,169 @@ class LlmModel(ServedModel):
         self._prefill = jax.jit(
             lambda p, t, c, n: prefill(p, t, c, cfg_static, true_len=n)
         )
-        # Device-side multi-token loop: one dispatch + one host fetch
-        # per STREAM_CHUNK tokens (see decode_chunk).
-        self._decode_chunk = jax.jit(
-            lambda p, tok, pos, c: decode_chunk(
+        self._decode_chunk_multi = jax.jit(
+            lambda p, tok, pos, c: decode_chunk_multi(
                 p, tok, pos, c, cfg_static, self.STREAM_CHUNK),
             donate_argnums=(3,),
         )
-        self._cache = None
+        # Inserts a batch-1 prefill cache into lane `i` of the batched
+        # cache (i is traced: one compile serves every lane).
+        self._lane_insert = jax.jit(
+            lambda batched, single, i: jax.tree.map(
+                lambda b, s: jax.lax.dynamic_update_slice(
+                    b, s, (i, 0, 0, 0)), batched, single),
+            donate_argnums=(0,),
+        )
 
-    def _get_cache(self):
-        if self._cache is None:
-            self._cache = init_cache(self.cfg, self._batch)
-        cache = self._cache
-        self._cache = None  # donated to the decode loop
-        return cache
+        self._lanes = max(1, int(decode_lanes))
+        self._sched_lock = threading.Lock()
+        self._sched_cv = threading.Condition(self._sched_lock)
+        self._sched_thread: Optional[threading.Thread] = None
+        self._sched_stop = False
+        self._join_queue: list = []
+        self._active: Dict[int, _GenRequest] = {}
+        self._free_lanes = list(range(self._lanes))
+        self._lane_tokens = [PAD] * self._lanes  # host-side carries
+        self._lane_pos = [0] * self._lanes
+        self._batched_cache = None
 
-    def _return_cache(self, cache):
-        self._cache = cache
+    # -- scheduler -------------------------------------------------------
+
+    def _ensure_scheduler(self):
+        with self._sched_cv:
+            if self._sched_thread is not None or self._sched_stop:
+                return
+            self._sched_thread = threading.Thread(
+                target=self._scheduler_loop, daemon=True,
+                name="llm-decode-%s" % self.name)
+            self._sched_thread.start()
+
+    def _deliver(self, lane: int, req: _GenRequest, token: int) -> bool:
+        """Pushes one token; returns False when the request finished
+        (EOS or budget). Caller holds _sched_cv."""
+        if token == EOS and not req.ignore_eos:
+            req.finish()
+            return False
+        req.queue.put(int(token))
+        req.delivered += 1
+        if req.delivered >= req.max_tokens:
+            req.finish()
+            return False
+        return True
+
+    def _release_lane(self, lane: int):
+        """Caller holds _sched_cv."""
+        self._active.pop(lane, None)
+        self._lane_tokens[lane] = PAD
+        self._lane_pos[lane] = 0
+        self._free_lanes.append(lane)
+
+    def _join_lane(self, lane: int, req: _GenRequest):
+        """Prefill (batch 1) into the lane's cache slice; deliver the
+        first token. Runs on the scheduler thread, no lock held during
+        device work."""
+        prompt = req.prompt
+        n = len(prompt)
+        # pad the prompt to a power-of-two bucket so XLA compiles
+        # prefill once per bucket, not once per prompt length
+        bucket = 16
+        while bucket < n:
+            bucket *= 2
+        bucket = min(bucket, self.cfg.max_seq)
+        padded = np.full((1, bucket), PAD, dtype=np.int32)
+        padded[0, :n] = prompt
+        logits, single_cache = self._prefill(
+            self._params, jnp.asarray(padded), init_cache(self.cfg, 1), n)
+        first = int(jnp.argmax(logits[0]))
+        self._batched_cache = self._lane_insert(
+            self._batched_cache, single_cache, lane)
+        with self._sched_cv:
+            if self._sched_stop:
+                # unload() raced this join after popping the request
+                # off the queue — fail it, never strand the client.
+                req.fail("model unloaded")
+                self._free_lanes.append(lane)
+                return
+            self._lane_tokens[lane] = first
+            self._lane_pos[lane] = n
+            self._active[lane] = req
+            if not self._deliver(lane, req, first):
+                self._release_lane(lane)
+
+    def _scheduler_loop(self):
+        try:
+            while True:
+                joins = []
+                with self._sched_cv:
+                    while (not self._sched_stop and not self._active
+                           and not self._join_queue):
+                        self._sched_cv.wait()
+                    if self._sched_stop:
+                        return
+                    while self._join_queue and self._free_lanes:
+                        joins.append((self._free_lanes.pop(0),
+                                      self._join_queue.pop(0)))
+                for lane, req in joins:
+                    self._join_lane(lane, req)
+                with self._sched_cv:
+                    if not self._active:
+                        continue
+                if self._batched_cache is None:  # pragma: no cover
+                    continue
+                tokens = jnp.asarray(self._lane_tokens, dtype=jnp.int32)
+                pos = jnp.asarray(self._lane_pos, dtype=jnp.int32)
+                toks, self._batched_cache = self._decode_chunk_multi(
+                    self._params, tokens, pos, self._batched_cache)
+                ids = np.asarray(jax.device_get(toks))  # [chunk, lanes]
+                with self._sched_cv:
+                    for lane in range(self._lanes):
+                        req = self._active.get(lane)
+                        if req is None:
+                            # Idle lanes decode garbage that later
+                            # prefills overwrite before it is ever
+                            # attended; just pin their bookkeeping.
+                            self._lane_tokens[lane] = PAD
+                            self._lane_pos[lane] = 0
+                            continue
+                        alive = True
+                        for token in ids[:, lane]:
+                            alive = self._deliver(lane, req, int(token))
+                            if not alive:
+                                break
+                        self._lane_pos[lane] += ids.shape[0]
+                        self._lane_tokens[lane] = int(ids[-1, lane])
+                        if alive and \
+                                self._lane_pos[lane] >= self.cfg.max_seq - 1:
+                            req.finish()
+                            alive = False
+                        if not alive:
+                            self._release_lane(lane)
+        except Exception as e:  # noqa: BLE001 — fail all riders loudly
+            with self._sched_cv:
+                for req in list(self._active.values()) + self._join_queue:
+                    req.fail("llm scheduler failed: %s" % e)
+                self._active.clear()
+                self._join_queue.clear()
+                # Reset lane state so a restarted scheduler starts
+                # clean: the donated cache may already be consumed,
+                # and leaked lanes would leave the restart spinning
+                # with nothing schedulable.
+                self._free_lanes = list(range(self._lanes))
+                self._lane_tokens = [PAD] * self._lanes
+                self._lane_pos = [0] * self._lanes
+                self._batched_cache = None
+                self._sched_thread = None
+
+    def unload(self) -> None:
+        with self._sched_cv:
+            self._sched_stop = True
+            for req in list(self._active.values()) + self._join_queue:
+                req.fail("model unloaded")
+            self._active.clear()
+            self._join_queue.clear()
+            self._sched_cv.notify_all()
+        if self._sched_thread is not None:
+            self._sched_thread.join(timeout=10)
 
     def _generate(self, inputs, parameters):
         text = inputs["text_input"].reshape(-1)[0]
@@ -400,44 +617,25 @@ class LlmModel(ServedModel):
         )
         prompt = self._tokenizer.encode(text)
         prompt = prompt[-(self.cfg.max_seq - max_tokens - 1):]
-        with self._lock:
-            cache = self._get_cache()
-            # pad the prompt to a power-of-two bucket so XLA compiles
-            # prefill once per bucket, not once per prompt length
-            n = len(prompt)
-            bucket = 16
-            while bucket < n:
-                bucket *= 2
-            bucket = min(bucket, self.cfg.max_seq)
-            padded = np.full((1, bucket), PAD, dtype=np.int32)
-            padded[0, :n] = prompt
-            logits, cache = self._prefill(
-                self._params, jnp.asarray(padded), cache, n)
-            pos = n
-            token = int(jnp.argmax(logits[0]))
-            produced = 0
-            pending: list = []  # chunk tokens fetched but not yielded
-            while produced < max_tokens:
-                if token == EOS and not ignore_eos:
-                    break
-                yield token
-                produced += 1
-                if produced >= max_tokens:
-                    break
-                if not pending:
-                    if pos >= self.cfg.max_seq - 1:
-                        break
-                    # The final chunk may overrun the token budget; the
-                    # surplus is discarded and its clamped cache writes
-                    # land in slots no valid query ever attends to.
-                    toks, cache = self._decode_chunk(
-                        self._params, jnp.asarray(token, dtype=jnp.int32),
-                        pos, cache,
-                    )
-                    pending = [int(t) for t in jax.device_get(toks)]
-                    pos += len(pending)
-                token = pending.pop(0)
-            self._return_cache(cache)
+        request = _GenRequest(prompt, max_tokens, ignore_eos)
+        self._ensure_scheduler()
+        with self._sched_cv:
+            if self._sched_stop:
+                raise InferenceServerException(
+                    "model '%s' is unloaded" % self.name,
+                    status="UNAVAILABLE")
+            if self._batched_cache is None:
+                self._batched_cache = init_cache(self.cfg, self._lanes)
+            self._join_queue.append(request)
+            self._sched_cv.notify_all()
+        while True:
+            token = request.queue.get()
+            if token is None:
+                break
+            yield token
+        if request.error is not None:
+            raise InferenceServerException(request.error,
+                                           status="INTERNAL")
 
     def infer_stream(self, inputs, parameters=None
                      ) -> Iterator[Dict[str, np.ndarray]]:

@@ -59,6 +59,42 @@ def test_llm_generate_deterministic():
     assert run1["text_output"][0] == run2["text_output"][0]
 
 
+def test_llm_concurrent_generations_batched_lanes():
+    """Multiple concurrent generations ride separate decode lanes and
+    must each produce exactly what a solo run produces (greedy decode
+    is lane-independent: per-lane masks and cache slices)."""
+    import threading
+
+    model = LlmModel(name="llm_test", cfg=TINY_LLM, decode_lanes=3)
+
+    def run(prompt):
+        return [t for t in model._generate(
+            {"text_input": np.array([prompt], dtype=np.object_),
+             "max_tokens": np.array([6], dtype=np.int32),
+             "ignore_eos": np.array([True])}, {})]
+
+    prompts = [b"alpha", b"beta", b"gamma", b"delta", b"epsilon"]
+    solo = {p: run(p) for p in prompts}
+
+    results = {}
+    errors = []
+
+    def worker(p):
+        try:
+            results[p] = run(p)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(p,)) for p in prompts]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    for p in prompts:
+        assert results[p] == solo[p], p
+
+
 def test_llm_chunked_decode_matches_single_step():
     """decode_chunk (device-side lax.scan loop, one fetch per chunk)
     must reproduce the per-token decode_step sequence exactly —
