@@ -15,6 +15,7 @@ TPU-first structure:
 from __future__ import annotations
 
 import dataclasses
+import queue
 import threading
 from functools import partial
 from typing import Dict, Iterator, Optional
@@ -371,14 +372,16 @@ class _GenRequest:
     """One in-flight generation riding a decode lane."""
 
     def __init__(self, prompt, max_tokens: int, ignore_eos: bool):
-        import queue as _queue
-
         self.prompt = prompt
         self.max_tokens = max_tokens
         self.ignore_eos = ignore_eos
         self.delivered = 0
-        self.queue: "_queue.Queue" = _queue.Queue()
+        self.queue: queue.Queue = queue.Queue()
         self.error: Optional[str] = None
+        # Set when the consumer abandons the stream (client
+        # disconnect): the scheduler frees the lane at the next chunk
+        # boundary instead of decoding the full budget into nowhere.
+        self.cancelled = False
 
     def finish(self):
         self.queue.put(None)
@@ -477,7 +480,11 @@ class LlmModel(ServedModel):
 
     def _deliver(self, lane: int, req: _GenRequest, token: int) -> bool:
         """Pushes one token; returns False when the request finished
-        (EOS or budget). Caller holds _sched_cv."""
+        (EOS, budget, or consumer abandonment). Caller holds
+        _sched_cv."""
+        if req.cancelled:
+            req.finish()
+            return False
         if token == EOS and not req.ignore_eos:
             req.finish()
             return False
@@ -538,8 +545,11 @@ class LlmModel(ServedModel):
                     if self._sched_stop:
                         return
                     while self._join_queue and self._free_lanes:
-                        joins.append((self._free_lanes.pop(0),
-                                      self._join_queue.pop(0)))
+                        req = self._join_queue.pop(0)
+                        if req.cancelled:  # abandoned while queued
+                            req.finish()
+                            continue
+                        joins.append((self._free_lanes.pop(0), req))
                 for lane, req in joins:
                     self._join_lane(lane, req)
                 with self._sched_cv:
@@ -618,7 +628,6 @@ class LlmModel(ServedModel):
         prompt = self._tokenizer.encode(text)
         prompt = prompt[-(self.cfg.max_seq - max_tokens - 1):]
         request = _GenRequest(prompt, max_tokens, ignore_eos)
-        self._ensure_scheduler()
         with self._sched_cv:
             if self._sched_stop:
                 raise InferenceServerException(
@@ -628,11 +637,20 @@ class LlmModel(ServedModel):
                 self._batched_cache = init_cache(self.cfg, self._lanes)
             self._join_queue.append(request)
             self._sched_cv.notify_all()
-        while True:
-            token = request.queue.get()
-            if token is None:
-                break
-            yield token
+        # AFTER enqueuing: a scheduler that crashed between the
+        # liveness check and the append would otherwise leave the
+        # request stranded — this restart sees it in the queue.
+        self._ensure_scheduler()
+        try:
+            while True:
+                token = request.queue.get()
+                if token is None:
+                    break
+                yield token
+        finally:
+            # Consumer gone (client disconnect closes the generator):
+            # let the scheduler reclaim the lane at the next chunk.
+            request.cancelled = True
         if request.error is not None:
             raise InferenceServerException(request.error,
                                            status="INTERNAL")

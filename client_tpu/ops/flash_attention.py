@@ -29,16 +29,20 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int,
-                  block_k: int, seq_k: int, valid_k: int, causal: bool,
+def _flash_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, *, block_q: int,
+                  block_k: int, seq_k: int, n_heads: int, causal: bool,
                   scale: float):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale  # [block_q, d]
     d = q.shape[-1]
+    # This sequence's real key length (lengths live in SMEM, whole
+    # array per grid cell; batch index = bh // heads).
+    valid_k = len_ref[pl.program_id(0) // n_heads]
 
     acc = jnp.zeros((block_q, d), jnp.float32)
     row_max = jnp.full((block_q,), _NEG_INF, jnp.float32)
@@ -75,10 +79,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int,
             preferred_element_type=jnp.float32)
         return new_acc, new_max, new_sum
 
-    num_k_blocks = seq_k // block_k
+    # Skip blocks that are entirely masked: past this sequence's real
+    # length, and (causal) strictly above the diagonal.
+    num_k_blocks = jnp.minimum(seq_k // block_k,
+                               pl.cdiv(valid_k, block_k))
     if causal:
-        # Blocks strictly above the diagonal are fully masked: stop
-        # the walk at the q-block's own diagonal block.
         num_k_blocks = jnp.minimum(
             num_k_blocks,
             pl.cdiv((qi + 1) * block_q, block_k))
@@ -90,10 +95,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int,
 
 def flash_attention(q, k, v, *, causal: bool = True,
                     scale: Optional[float] = None, block_q: int = 128,
-                    block_k: int = 128, interpret: bool = False):
+                    block_k: int = 128, valid_lengths=None,
+                    interpret: bool = False):
     """q: [B, S_q, H, D]; k/v: [B, S_k, H, D]. Returns [B, S_q, H, D].
     Sequence lengths are padded to the block size internally (padded
-    key rows are masked out; padded query rows are dropped)."""
+    key rows are masked out; padded query rows are dropped).
+    ``valid_lengths`` ([B] int32, optional) masks keys per sequence —
+    the variable-length-batch shape encoder models (BERT) run, where
+    each batch row has its own real length inside the padded bucket."""
     b, s_q, h, d = q.shape
     s_k = k.shape[1]
     if scale is None:
@@ -115,10 +124,14 @@ def flash_attention(q, k, v, *, causal: bool = True,
     kt = prep(k, pad_k)
     vt = prep(v, pad_k)
     seq_q, seq_k = s_q + pad_q, s_k + pad_k
+    if valid_lengths is None:
+        lengths = jnp.full((b,), s_k, dtype=jnp.int32)
+    else:
+        lengths = jnp.asarray(valid_lengths, jnp.int32).reshape(b)
 
     kernel = functools.partial(
         _flash_kernel, block_q=block_q, block_k=block_k, seq_k=seq_k,
-        valid_k=s_k, causal=causal, scale=scale)
+        n_heads=h, causal=causal, scale=scale)
 
     out = pl.pallas_call(
         kernel,
@@ -130,13 +143,15 @@ def flash_attention(q, k, v, *, causal: bool = True,
                          lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((1, seq_k, d + pad_d),
                          lambda bh, qi: (bh, 0, 0)),
+            # Whole [B] lengths vector in SMEM per grid cell.
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=pl.BlockSpec((1, block_q, d + pad_d),
                                lambda bh, qi: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct(
             (b * h, seq_q, d + pad_d), q.dtype),
         interpret=interpret,
-    )(qt, kt, vt)
+    )(qt, kt, vt, lengths)
 
     out = out.reshape(b, h, seq_q, d + pad_d).transpose(0, 2, 1, 3)
     return out[:, :s_q, :, :d]

@@ -99,3 +99,28 @@ def test_flash_llm_forward_hook():
                     attention_fn=flash_attention_fn(interpret=True))
     np.testing.assert_allclose(np.asarray(flash), np.asarray(dense),
                                rtol=2e-3, atol=2e-3)
+
+
+def test_flash_variable_valid_lengths():
+    """Per-sequence key masking (the BERT variable-length-batch shape):
+    each batch row attends only its own valid prefix."""
+    b, s, h, d = 3, 128, 2, 32
+    q = jnp.asarray(_rand((b, s, h, d), 20))
+    k = jnp.asarray(_rand((b, s, h, d), 21))
+    v = jnp.asarray(_rand((b, s, h, d), 22))
+    lengths = np.array([128, 70, 9], dtype=np.int32)
+    out = flash_attention(q, k, v, causal=False,
+                          valid_lengths=lengths, interpret=True)
+    # dense reference with per-row key masks
+    logits = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) / (d ** 0.5)
+    key_ok = np.arange(s)[None, :] < lengths[:, None]        # [B,T]
+    logits = jnp.where(key_ok[:, None, None, :], logits, -jnp.inf)
+    expected = jnp.einsum("bhst,bthd->bshd",
+                          jax.nn.softmax(logits, axis=-1),
+                          v.astype(jnp.float32))
+    # rows whose queries sit beyond their own valid length still get
+    # finite output (they attend the valid prefix)
+    assert np.isfinite(np.asarray(out)).all()
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               rtol=2e-4, atol=2e-4)

@@ -95,6 +95,31 @@ def test_llm_concurrent_generations_batched_lanes():
         assert results[p] == solo[p], p
 
 
+def test_llm_abandoned_stream_releases_lane():
+    """Closing the generator mid-stream (client disconnect) must free
+    the decode lane at the next chunk instead of decoding the full
+    budget into an unread queue."""
+    import time
+
+    model = LlmModel(name="llm_test", cfg=TINY_LLM, decode_lanes=1)
+    gen = model._generate(
+        {"text_input": np.array([b"abandon me"], dtype=np.object_),
+         "max_tokens": np.array([500], dtype=np.int32),
+         "ignore_eos": np.array([True])}, {})
+    next(gen)   # request is live on the only lane
+    gen.close()  # consumer walks away
+    deadline = time.time() + 30
+    while time.time() < deadline and model._active:
+        time.sleep(0.05)
+    assert not model._active
+    # the lane is reusable: a fresh request completes
+    out = list(model._generate(
+        {"text_input": np.array([b"next"], dtype=np.object_),
+         "max_tokens": np.array([4], dtype=np.int32),
+         "ignore_eos": np.array([True])}, {}))
+    assert len(out) == 4
+
+
 def test_llm_chunked_decode_matches_single_step():
     """decode_chunk (device-side lax.scan loop, one fetch per chunk)
     must reproduce the per-token decode_step sequence exactly —
