@@ -550,8 +550,20 @@ class LlmModel(ServedModel):
                             req.finish()
                             continue
                         joins.append((self._free_lanes.pop(0), req))
-                for lane, req in joins:
-                    self._join_lane(lane, req)
+                for idx, (lane, req) in enumerate(joins):
+                    try:
+                        self._join_lane(lane, req)
+                    except Exception as e:  # noqa: BLE001
+                        # The popped requests are in neither _active nor
+                        # _join_queue, so the crash handler below cannot
+                        # see them — fail them here or their clients
+                        # block forever on queue.get().
+                        with self._sched_cv:
+                            for lane2, req2 in joins[idx:]:
+                                req2.fail("llm prefill failed: %s" % e)
+                                if lane2 not in self._active:
+                                    self._free_lanes.append(lane2)
+                        raise
                 with self._sched_cv:
                     if not self._active:
                         continue

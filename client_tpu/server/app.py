@@ -51,6 +51,7 @@ class ServerHandle:
 
     def stop(self, grace: float = 1.0):
         self.grpc_server.stop(grace)
+        self.core.shutdown()
 
 
 def start_grpc_server(

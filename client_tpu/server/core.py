@@ -362,7 +362,26 @@ class InferenceServerCore:
             batcher = self._batchers.pop(name, None)
         if batcher is not None:
             batcher.stop()
+        with self._trace_lock:
+            state = self._trace_state.get(name)
+            if state is not None and state["buffer"]:
+                self._flush_trace(
+                    name, self._effective_trace_settings(name), state)
         self.repository.unload(name)
+
+    def shutdown(self) -> None:
+        """Teardown: stop batchers and flush buffered trace records —
+        log_frequency>0 buffers would otherwise silently drop the tail
+        of every trace file (Triton flushes on trace-file close)."""
+        with self._batchers_lock:
+            batchers, self._batchers = dict(self._batchers), {}
+        for batcher in batchers.values():
+            batcher.stop()
+        with self._trace_lock:
+            for name, state in self._trace_state.items():
+                if state["buffer"]:
+                    self._flush_trace(
+                        name, self._effective_trace_settings(name), state)
 
     # -- inference -------------------------------------------------------
 

@@ -248,7 +248,13 @@ def grpc_stream_call(path: str, request_bytes: bytes) -> list:
     """Dispatches one message of a bidi-streaming RPC; returns the
     list of serialized responses it produced. Stream RPCs here map
     each request independently (ModelStreamInfer semantics), so no
-    cross-call session state is needed."""
+    cross-call session state is needed.
+
+    NOTE: this variant buffers — a decoupled model's full response
+    stream materializes before anything returns. The native transport
+    uses grpc_stream_call_emit for incremental delivery; this remains
+    for in-process callers that want the collected list.
+    """
     entry = _grpc_registry().get(path)
     if entry is None or not entry[2]:
         raise GrpcAbort(12, "unknown or non-stream method %s" % path)
@@ -259,9 +265,35 @@ def grpc_stream_call(path: str, request_bytes: bytes) -> list:
     return [r.SerializeToString() for r in responses]
 
 
+def grpc_stream_call_emit(path: str, request_bytes: bytes, emit) -> None:
+    """Incremental twin of grpc_stream_call: calls ``emit(serialized)``
+    for each response as the handler produces it, so the native
+    front-end writes decoupled-model responses (LLM tokens) to the
+    wire one by one instead of in one end-of-generation burst. A
+    falsy return from ``emit`` means the peer is gone — stop
+    producing (the servicer's generator close() cancels the
+    underlying request)."""
+    entry = _grpc_registry().get(path)
+    if entry is None or not entry[2]:
+        raise GrpcAbort(12, "unknown or non-stream method %s" % path)
+    req_t, handler, _ = entry
+    request = req_t()
+    request.ParseFromString(request_bytes)
+    responses = handler(iter([request]), _AbortContext())
+    try:
+        for r in responses:
+            if not emit(r.SerializeToString()):
+                break
+    finally:
+        close = getattr(responses, "close", None)
+        if close is not None:
+            close()
+
+
 def shutdown() -> None:
-    """Stops per-model batcher threads and drops the core (unload_model
-    is the core's teardown verb; there is no process-level shutdown)."""
+    """Unloads every ready model, then runs the core's process-level
+    teardown (batcher stop + buffered-trace flush) and drops the
+    core."""
     global _core, _registry
     _registry = None  # dispatch registry holds servicers bound to _core
     if _core is None:
@@ -272,3 +304,7 @@ def shutdown() -> None:
             core.unload_model(name)
         except Exception:  # noqa: BLE001 — teardown must not raise
             pass
+    try:
+        core.shutdown()
+    except Exception:  # noqa: BLE001
+        pass

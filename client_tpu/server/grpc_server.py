@@ -249,10 +249,28 @@ class AioGrpcServerThread:
         self._thread = threading.Thread(target=_run, daemon=True,
                                         name="grpc-aio-server")
         self._thread.start()
-        started.wait(60)
+        started_in_time = started.wait(60)
         if error:
             raise error[0]
-        if self._server is None:
+        if not started_in_time or self._server is None:
+            # A slow startup could still complete start() after we
+            # raise, leaving an orphaned running server with no handle
+            # to stop it — signal the serve task to shut down and join
+            # the thread before surfacing the failure.
+            def _abort():
+                if self._stop_event is not None:
+                    self._stop_event.set()
+                else:
+                    # start() hasn't finished: cancel everything on the
+                    # loop so run_until_complete unwinds.
+                    for task in asyncio.all_tasks(self._loop):
+                        task.cancel()
+
+            try:
+                self._loop.call_soon_threadsafe(_abort)
+            except RuntimeError:
+                pass  # loop already closed — thread is done
+            self._thread.join(timeout=15)
             raise RuntimeError("aio gRPC server failed to start on %s"
                                % address)
 

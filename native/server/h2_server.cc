@@ -717,22 +717,34 @@ class Conn : public std::enable_shared_from_this<Conn> {
         return;
       }
       if (have) {  // streaming message
-        GrpcReply reply = handler_->StreamCall(stream->path, message);
+        // Each response hits the wire as the handler produces it, so
+        // decoupled models stream incrementally through this
+        // front-end (TTFT = first token, not full generation).
+        auto emit = [this, stream_id, &stream](
+                        const std::string& response) -> bool {
+          bool need_headers;
+          {
+            std::lock_guard<std::mutex> lock(mutex_);
+            if (stream->closed) return false;
+            need_headers = !stream->response_headers_sent;
+            stream->response_headers_sent = true;
+          }
+          if (need_headers) SendResponseHeaders(stream_id);
+          return SendMessage(stream_id, response).empty();
+        };
+        GrpcReply reply = handler_->StreamCall(stream->path, message, emit);
         if (reply.status != 0) {
-          SendTrailers(stream_id, reply.status, reply.message,
-                       stream->response_headers_sent);
+          bool headers_sent;
+          {
+            std::lock_guard<std::mutex> lock(mutex_);
+            headers_sent = stream->response_headers_sent;
+          }
+          SendTrailers(stream_id, reply.status, reply.message, headers_sent);
           CloseStream(stream_id);
           return;
         }
-        bool need_headers;
-        {
-          std::lock_guard<std::mutex> lock(mutex_);
-          need_headers = !stream->response_headers_sent;
-          stream->response_headers_sent = true;
-        }
-        if (need_headers) SendResponseHeaders(stream_id);
         for (const auto& response : reply.responses) {
-          if (!SendMessage(stream_id, response).empty()) {
+          if (!emit(response)) {
             CloseStream(stream_id);
             return;
           }

@@ -15,6 +15,7 @@
 
 #include <atomic>
 #include <cstdint>
+#include <functional>
 #include <memory>
 #include <string>
 #include <thread>
@@ -41,9 +42,17 @@ class GrpcHandler {
   // One unary request message -> reply.
   virtual GrpcReply Call(const std::string& path,
                          const std::string& message) = 0;
-  // One message of a bidi-streaming RPC -> zero or more responses.
+  // Writes one serialized response to the peer immediately; returns
+  // false when the stream is gone (the handler should stop producing).
+  using StreamEmit = std::function<bool(const std::string&)>;
+  // One message of a bidi-streaming RPC -> zero or more responses,
+  // delivered incrementally through `emit` as they are produced (a
+  // decoupled model's token stream reaches the wire token by token,
+  // not as one end-of-generation burst). Responses left in the
+  // returned reply are flushed after the call as a convenience.
   virtual GrpcReply StreamCall(const std::string& path,
-                               const std::string& message) = 0;
+                               const std::string& message,
+                               const StreamEmit& emit) = 0;
 };
 
 class H2Server {

@@ -249,6 +249,7 @@ void Http1Server::ServeRequests(int fd) {
     // Headers -> lower-cased JSON for the handler.
     std::string headers_json = "{";
     size_t content_length = 0;
+    bool content_length_seen = false;
     bool close_requested = false;
     size_t pos = line_end + 2;
     bool first = true;
@@ -291,7 +292,15 @@ void Http1Server::ServeRequests(int fd) {
           if (c < '0' || c > '9') bad = true;
         }
         if (!bad) {
-          content_length = strtoull(value.c_str(), nullptr, 10);
+          size_t parsed = strtoull(value.c_str(), nullptr, 10);
+          // RFC 7230 §3.3.3: conflicting repeated Content-Length
+          // headers are a request-smuggling vector behind proxies —
+          // reject rather than last-one-wins.
+          if (content_length_seen && parsed != content_length) {
+            bad = true;
+          }
+          content_length = parsed;
+          content_length_seen = true;
         }
         if (bad) {
           const char* resp =

@@ -157,35 +157,57 @@ GrpcReply PyCoreHandler::Call(const std::string& path,
   return reply;
 }
 
+namespace {
+
+// Python-callable bridge handed to embed.grpc_stream_call_emit: each
+// call forwards one serialized response to the transport's emit
+// closure with the GIL released (the socket write may block on h2
+// flow control; holding the GIL there would stall every other call).
+extern "C" PyObject* EmitTrampoline(PyObject* self, PyObject* args) {
+  auto* emit = static_cast<const GrpcHandler::StreamEmit*>(
+      PyCapsule_GetPointer(self, "tpuclient.stream_emit"));
+  const char* data = nullptr;
+  Py_ssize_t size = 0;
+  if (emit == nullptr || !PyArg_ParseTuple(args, "y#", &data, &size)) {
+    return nullptr;
+  }
+  std::string payload(data, (size_t)size);
+  bool ok = false;
+  Py_BEGIN_ALLOW_THREADS
+  ok = (*emit)(payload);
+  Py_END_ALLOW_THREADS
+  return PyBool_FromLong(ok ? 1 : 0);
+}
+
+PyMethodDef kEmitDef = {"emit", EmitTrampoline, METH_VARARGS, nullptr};
+
+}  // namespace
+
 GrpcReply PyCoreHandler::StreamCall(const std::string& path,
-                                    const std::string& message) {
+                                    const std::string& message,
+                                    const StreamEmit& emit) {
   GrpcReply reply;
   PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* capsule = PyCapsule_New(
+      const_cast<StreamEmit*>(&emit), "tpuclient.stream_emit", nullptr);
+  PyObject* emit_fn =
+      capsule != nullptr ? PyCFunction_New(&kEmitDef, capsule) : nullptr;
+  if (emit_fn == nullptr) {
+    ParseAbort(FetchPyError("stream emit bridge"), &reply);
+    Py_XDECREF(capsule);
+    PyGILState_Release(gil);
+    return reply;
+  }
   PyObject* r = PyObject_CallMethod(
-      impl_->module, "grpc_stream_call", "sy#", path.c_str(), message.data(),
-      (Py_ssize_t)message.size());
+      impl_->module, "grpc_stream_call_emit", "sy#O", path.c_str(),
+      message.data(), (Py_ssize_t)message.size(), emit_fn);
   if (r == nullptr) {
-    ParseAbort(FetchPyError("grpc_stream_call"), &reply);
+    ParseAbort(FetchPyError("grpc_stream_call_emit"), &reply);
   } else {
-    PyObject* seq = PySequence_Fast(r, "grpc_stream_call must return a list");
-    if (seq == nullptr) {
-      ParseAbort(FetchPyError("grpc_stream_call result"), &reply);
-    } else {
-      Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
-      for (Py_ssize_t i = 0; i < n; ++i) {
-        PyObject* item = PySequence_Fast_GET_ITEM(seq, i);
-        char* data = nullptr;
-        Py_ssize_t size = 0;
-        if (PyBytes_AsStringAndSize(item, &data, &size) == 0) {
-          reply.responses.emplace_back(data, (size_t)size);
-        } else {
-          PyErr_Clear();
-        }
-      }
-      Py_DECREF(seq);
-    }
     Py_DECREF(r);
   }
+  Py_DECREF(emit_fn);
+  Py_DECREF(capsule);
   PyGILState_Release(gil);
   return reply;
 }

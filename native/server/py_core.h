@@ -24,7 +24,8 @@ class PyCoreHandler : public GrpcHandler, public HttpHandler {
   GrpcReply Call(const std::string& path,
                  const std::string& message) override;
   GrpcReply StreamCall(const std::string& path,
-                       const std::string& message) override;
+                       const std::string& message,
+                       const StreamEmit& emit) override;
   HttpReply HttpCall(const std::string& method, const std::string& path,
                      const std::string& headers_json,
                      const std::string& body) override;

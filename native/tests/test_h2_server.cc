@@ -37,7 +37,7 @@ class StubHandler : public GrpcHandler {
         path == "/test.Svc/Fail") {
       return 1;
     }
-    if (path == "/test.Svc/Duplicate") return 2;
+    if (path == "/test.Svc/Duplicate" || path == "/test.Svc/Drip") return 2;
     return 0;
   }
 
@@ -58,10 +58,22 @@ class StubHandler : public GrpcHandler {
     return reply;
   }
 
-  GrpcReply StreamCall(const std::string&,
-                       const std::string& message) override {
+  GrpcReply StreamCall(const std::string& path, const std::string& message,
+                       const StreamEmit& emit) override {
     GrpcReply reply;
-    reply.responses.push_back(message);
+    if (path == "/test.Svc/Drip") {
+      // Slow producer: three messages 60 ms apart, all incremental.
+      for (int i = 0; i < 3; ++i) {
+        if (i > 0) {
+          std::this_thread::sleep_for(std::chrono::milliseconds(60));
+        }
+        if (!emit(message + "-" + std::to_string(i))) return reply;
+      }
+      return reply;
+    }
+    // First copy through the incremental path, second via the
+    // returned list — covers both delivery routes.
+    if (!emit(message)) return reply;
     reply.responses.push_back(message);
     return reply;
   }
@@ -162,6 +174,56 @@ TEST_CASE("h2 server: bidi stream fan-out") {
   CHECK_EQ(messages[1], "one");
   CHECK_EQ(messages[2], "two");
   CHECK_EQ(messages[3], "two");
+  channel->Shutdown();
+}
+
+TEST_CASE("h2 server: stream responses are delivered incrementally") {
+  ServerFixture fx;
+  std::shared_ptr<GrpcChannel> channel;
+  REQUIRE_OK(GrpcChannel::Create(&channel, fx.url()));
+
+  std::mutex mutex;
+  std::condition_variable cv;
+  std::vector<std::chrono::steady_clock::time_point> arrivals;
+  bool done = false;
+
+  std::unique_ptr<GrpcBidiStream> stream;
+  REQUIRE_OK(channel->StartBidiStream(
+      &stream, "/test.Svc/Drip",
+      [&](std::string&&) {
+        std::lock_guard<std::mutex> lk(mutex);
+        arrivals.push_back(std::chrono::steady_clock::now());
+        cv.notify_all();
+      },
+      [&](const Error&) {
+        std::lock_guard<std::mutex> lk(mutex);
+        done = true;
+        cv.notify_all();
+      }));
+  REQUIRE_OK(stream->Write("tick"));
+  {
+    std::unique_lock<std::mutex> lk(mutex);
+    CHECK(cv.wait_for(lk, std::chrono::seconds(5),
+                      [&] { return arrivals.size() >= 3; }));
+  }
+  REQUIRE_OK(stream->WritesDone());
+  {
+    std::unique_lock<std::mutex> lk(mutex);
+    CHECK(cv.wait_for(lk, std::chrono::seconds(5), [&] { return done; }));
+  }
+  // The producer sleeps 60 ms between messages; a buffering transport
+  // would deliver all three in one end-of-call burst (total spread
+  // ~0). Only the first-to-last spread is asserted — adjacent gaps
+  // can coalesce when the read thread is descheduled under TSAN/load.
+  if (arrivals.size() < 3) {
+    CHECK(false);  // stream never produced three messages
+    channel->Shutdown();
+    return;
+  }
+  auto spread_ms = std::chrono::duration_cast<std::chrono::milliseconds>(
+                       arrivals[2] - arrivals[0])
+                       .count();
+  CHECK(spread_ms >= 60);
   channel->Shutdown();
 }
 
@@ -308,6 +370,49 @@ TEST_CASE("http1 server: request round-trips + keep-alive + errors") {
 
   CHECK(HttpRequest(port, "GET", "/missing", "")
             .find("HTTP/1.1 404") == 0);
+
+  // Conflicting duplicate Content-Length headers: 400, not
+  // last-one-wins (RFC 7230 §3.3.3 — request-smuggling vector).
+  {
+    int raw = ::socket(AF_INET, SOCK_STREAM, 0);
+    REQUIRE(raw >= 0);
+    struct sockaddr_in addr;
+    memset(&addr, 0, sizeof(addr));
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    addr.sin_port = htons(port);
+    REQUIRE(::connect(raw, reinterpret_cast<struct sockaddr*>(&addr),
+                      sizeof(addr)) == 0);
+    const char* smuggle =
+        "POST /echo HTTP/1.1\r\nHost: x\r\nContent-Length: 5\r\n"
+        "Content-Length: 3\r\n\r\nhello";
+    ::send(raw, smuggle, strlen(smuggle), MSG_NOSIGNAL);
+    std::string reply;
+    char buf[1024];
+    ssize_t n;
+    while ((n = ::recv(raw, buf, sizeof(buf), 0)) > 0) {
+      reply.append(buf, (size_t)n);
+      if (reply.find("\r\n\r\n") != std::string::npos) break;
+    }
+    ::close(raw);
+    CHECK(reply.find("HTTP/1.1 400") == 0);
+    // Matching duplicates are tolerated (same value, no conflict).
+    int raw2 = ::socket(AF_INET, SOCK_STREAM, 0);
+    REQUIRE(raw2 >= 0);
+    REQUIRE(::connect(raw2, reinterpret_cast<struct sockaddr*>(&addr),
+                      sizeof(addr)) == 0);
+    const char* benign_req =
+        "POST /echo HTTP/1.1\r\nHost: x\r\nContent-Length: 5\r\n"
+        "Content-Length: 5\r\nConnection: close\r\n\r\nhello";
+    ::send(raw2, benign_req, strlen(benign_req), MSG_NOSIGNAL);
+    std::string benign;
+    while ((n = ::recv(raw2, buf, sizeof(buf), 0)) > 0) {
+      benign.append(buf, (size_t)n);
+    }
+    ::close(raw2);
+    CHECK(benign.find("HTTP/1.1 200 OK") == 0);
+    CHECK(benign.find("olleh") != std::string::npos);
+  }
 
   // Concurrent clients across connections (worker-thread reaping +
   // shutdown with connections open run under TSAN here).

@@ -64,6 +64,43 @@ def test_statistics_json_counts_are_numbers(embedded):
     assert entry["inference_stats"]["success"]["count"] >= 1
 
 
+_STREAM_PATH = "/inference.GRPCInferenceService/ModelStreamInfer"
+
+
+def test_stream_call_emit_delivers_incrementally(embedded):
+    got = []
+    embedded.grpc_stream_call_emit(
+        _STREAM_PATH, _simple_request().SerializeToString(), got.append)
+    assert len(got) == 1
+    response = pb.ModelStreamInferResponse()
+    response.ParseFromString(got[0])
+    out0 = np.frombuffer(
+        response.infer_response.raw_output_contents[0], np.int32)
+    np.testing.assert_array_equal(out0, np.arange(16) * 2)
+
+
+def test_stream_call_emit_stops_when_emit_reports_peer_gone(embedded):
+    calls = []
+
+    def emit(payload):
+        calls.append(payload)
+        return False  # peer disconnected after the first message
+
+    embedded.grpc_stream_call_emit(
+        _STREAM_PATH, _simple_request().SerializeToString(), emit)
+    assert len(calls) == 1  # producer stopped, no error raised
+
+
+def test_stream_call_list_variant_matches_emit(embedded):
+    listed = embedded.grpc_stream_call(
+        _STREAM_PATH, _simple_request().SerializeToString())
+    emitted = []
+    embedded.grpc_stream_call_emit(
+        _STREAM_PATH, _simple_request().SerializeToString(),
+        lambda payload: emitted.append(payload) or True)
+    assert listed == emitted
+
+
 def test_arena_allocate_and_register(embedded):
     handle = embedded.tpu_arena_allocate(1024)
     assert isinstance(handle, bytes) and handle
