@@ -16,7 +16,13 @@ from client_tpu.utils import InferenceServerException
 
 
 class PreprocessModel(ServedModel):
-    """uint8 image [224,224,3] -> normalized FP32 NHWC."""
+    """uint8 image [224,224,3] -> normalized FP32 NHWC.
+
+    Runs ON DEVICE: the wire payload stays the compact uint8 image
+    (4x smaller than fp32) and the normalized tensor is born in HBM,
+    so the downstream backbone fuses DEVICE chunks across concurrent
+    ensemble requests and nothing round-trips to the host between
+    steps."""
 
     platform = "jax"
     max_batch_size = 32
@@ -26,12 +32,25 @@ class PreprocessModel(ServedModel):
         self.name = name
         self.inputs = [TensorSpec("RAW_IMAGE", "UINT8", [224, 224, 3])]
         self.outputs = [TensorSpec("IMAGE", "FP32", [224, 224, 3])]
-        self._mean = np.array([0.485, 0.456, 0.406], dtype=np.float32) * 255
-        self._std = np.array([0.229, 0.224, 0.225], dtype=np.float32) * 255
+        mean = np.array([0.485, 0.456, 0.406], dtype=np.float32) * 255
+        std = np.array([0.229, 0.224, 0.225], dtype=np.float32) * 255
+        import jax
+        import jax.numpy as jnp
+
+        mean_d, std_d = jnp.asarray(mean), jnp.asarray(std)
+        self._fn = jax.jit(
+            lambda raw: (raw.astype(jnp.float32) - mean_d) / std_d)
 
     def infer(self, inputs, parameters=None):
-        raw = np.asarray(inputs["RAW_IMAGE"]).astype(np.float32)
-        return {"IMAGE": (raw - self._mean) / self._std}
+        return {"IMAGE": self._fn(inputs["RAW_IMAGE"])}
+
+    def warmup(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        for batch in (1, 8, 16, 32):
+            jax.block_until_ready(
+                self._fn(jnp.zeros((batch, 224, 224, 3), dtype=jnp.uint8)))
 
 
 class PostprocessModel(ServedModel):
@@ -89,6 +108,12 @@ class EnsembleModel(ServedModel):
         # queue/compute like top-level requests): callable
         # (model_name, count, compute_ns).
         self.stats_recorder = None
+        # Set by the server core: resolves a composing model to its
+        # dynamic batcher (or None). Steps entering a batching model's
+        # scheduler fuse ACROSS concurrent ensemble requests — without
+        # this, every concurrent stream request runs its own batch-1
+        # backbone execution and pays its own device round trip.
+        self.batcher_resolver = None
 
     def _extend_config(self, config: mc.ModelConfig) -> None:
         for model_name, input_map, output_map in self._steps:
@@ -114,19 +139,31 @@ class EnsembleModel(ServedModel):
                         status="INVALID_ARGUMENT",
                     )
                 step_inputs[step_name] = tensors[ens_name]
+            first = next(iter(step_inputs.values()), None)
+            count = (
+                int(first.shape[0])
+                if getattr(first, "ndim", 0) and model.max_batch_size > 0
+                else 1
+            )
+            batcher = self.batcher_resolver(model) \
+                if self.batcher_resolver is not None else None
             if self.stats_recorder is not None:
                 import time
 
                 start_ns = time.monotonic_ns()
-                step_outputs = model.infer(step_inputs, parameters)
-                first = next(iter(step_inputs.values()), None)
-                count = (
-                    int(first.shape[0])
-                    if getattr(first, "ndim", 0) and model.max_batch_size > 0
-                    else 1
-                )
+                if batcher is not None:
+                    step_outputs, _, leader = batcher.infer(
+                        step_inputs, parameters or {}, count)
+                    executions = 1 if leader else 0
+                else:
+                    step_outputs = model.infer(step_inputs, parameters)
+                    executions = 1
                 self.stats_recorder(
-                    model_name, count, time.monotonic_ns() - start_ns)
+                    model_name, count, time.monotonic_ns() - start_ns,
+                    executions)
+            elif batcher is not None:
+                step_outputs, _, _ = batcher.infer(
+                    step_inputs, parameters or {}, count)
             else:
                 step_outputs = model.infer(step_inputs, parameters)
             for ens_name, step_name in output_map.items():
@@ -141,7 +178,7 @@ class EnsembleModel(ServedModel):
 def make_image_ensemble(repository, name: str = "ensemble_image",
                         backbone: str = "resnet50") -> EnsembleModel:
     """preprocess -> resnet -> postprocess with triton-style maps."""
-    return EnsembleModel(
+    ensemble = EnsembleModel(
         name=name,
         repository=repository,
         steps=[
@@ -153,3 +190,16 @@ def make_image_ensemble(repository, name: str = "ensemble_image",
         outputs=[TensorSpec("LABEL", "BYTES", [1])],
         max_batch_size=32,
     )
+    # Fuse concurrent ensemble requests BEFORE the first device hop:
+    # per-request image upload + logits fetch through the relay cap a
+    # request-at-a-time pipeline at ~80/s regardless of server design
+    # (each small transfer serializes ~12 ms in the relay), while a
+    # fused bucket pays ONE upload and ONE fetch for the whole batch.
+    # The 20 ms gather window (measured: 5 ms only reached ~4-wide
+    # buckets under continuous streaming load; 20 ms reaches ~15 and
+    # is small next to the bucket's ~150 ms pipeline) lets a response
+    # burst's re-sends re-converge into the next bucket.
+    ensemble.dynamic_batching = True
+    ensemble.preferred_batch_sizes = [8, 16, 32]
+    ensemble.max_queue_delay_us = 20000
+    return ensemble

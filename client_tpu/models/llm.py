@@ -17,6 +17,7 @@ from __future__ import annotations
 import dataclasses
 import queue
 import threading
+from collections import deque
 from functools import partial
 from typing import Dict, Iterator, Optional
 
@@ -240,10 +241,12 @@ def init_cache(cfg: LlmConfig, batch: int, dtype=None):
 
 def prefill(params, tokens, cache, cfg: LlmConfig, true_len=None):
     """Process the prompt, fill the cache; returns (logits of the last
-    real row, cache). tokens [B,S]; ``true_len`` (traced scalar) marks
-    the prompt length when S is a padded bucket — padded rows write
-    cache slots >= true_len, which decode overwrites sequentially
-    before ever attending to them, so they never leak into outputs."""
+    real row, cache). tokens [B,S]; ``true_len`` (traced scalar or
+    per-row [B] vector — the batched-join path prefills several
+    prompts of different lengths in ONE dispatch) marks the prompt
+    length when S is a padded bucket — padded rows write cache slots
+    >= true_len, which decode overwrites sequentially before ever
+    attending to them, so they never leak into outputs."""
     b, s = tokens.shape
     x = params["embed"][tokens]
     positions = jnp.broadcast_to(jnp.arange(s), (b, s))
@@ -259,6 +262,9 @@ def prefill(params, tokens, cache, cfg: LlmConfig, true_len=None):
     x = _rms_norm(x, params["final_norm"])
     if true_len is None:
         last = x[:, -1]
+    elif jnp.ndim(true_len) >= 1:
+        last = jnp.take_along_axis(
+            x, (true_len - 1)[:, None, None], axis=1)[:, 0]
     else:
         last = jax.lax.dynamic_slice_in_dim(x, true_len - 1, 1, axis=1)[:, 0]
     logits = (last @ params["unembed"]).astype(jnp.float32)
@@ -400,15 +406,33 @@ class LlmModel(ServedModel):
     ``decode_lanes`` independent sequences through one jitted
     decode_chunk_multi dispatch, so concurrent requests share device
     work instead of serializing (continuous batching at chunk
-    granularity — requests join/leave at chunk boundaries). Prefill is
-    per-request and its cache is inserted into the lane's slice of the
-    batched KV cache, which never leaves the device.
+    granularity — requests join/leave at chunk boundaries). Joins
+    prefill in one batched dispatch per padded bucket and their caches
+    are row-inserted into the batched KV cache, which never leaves the
+    device.
+
+    The decode pipeline is split into a dispatch side (scheduler
+    thread: prefills + decode chunks launched back-to-back, last
+    tokens carried ON DEVICE between chunks) and a delivery side
+    (delivery thread: waits on each chunk's pooled device->host fetch
+    in dispatch order and routes tokens to requests). Up to
+    MAX_INFLIGHT chunks are in flight, so the host-fetch round trip
+    (~65 ms through this image's relay, real on any PCIe/ICI hop)
+    overlaps decode compute instead of stalling the token stream every
+    STREAM_CHUNK tokens — inter-token latency at a chunk boundary is
+    the chunk's compute time, not the fetch latency.
     """
 
     decoupled = True
     platform = "jax"
     # Tokens per device-side decode dispatch (and per host fetch).
     STREAM_CHUNK = 8
+    # Decode chunks allowed in flight (dispatched, fetch pending).
+    # Pipelining bound: the relay's ~65 ms fetch overlaps roughly
+    # fetch_latency / chunk_compute (~4) chunks; beyond that it is
+    # run-ahead waste on finished requests and queue-drain latency
+    # ahead of every join's first token.
+    MAX_INFLIGHT = 5
 
     def __init__(self, name: str = "llm", cfg: Optional[LlmConfig] = None,
                  mesh=None, rules: ShardingRules = LLM_RULES,
@@ -438,45 +462,90 @@ class LlmModel(ServedModel):
             )
         self._params = params
         cfg_static = self.cfg
-        self._prefill = jax.jit(
-            lambda p, t, c, n: prefill(p, t, c, cfg_static, true_len=n)
-        )
+
+        def _prefill_first(p, t, c, n):
+            # argmax folded in: the scheduler only needs the first
+            # TOKEN, and a separate jitted argmax would compile per
+            # batch shape mid-serving.
+            logits, new_cache = prefill(p, t, c, cfg_static, true_len=n)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), new_cache
+
+        self._prefill = jax.jit(_prefill_first)
         self._decode_chunk_multi = jax.jit(
             lambda p, tok, pos, c: decode_chunk_multi(
                 p, tok, pos, c, cfg_static, self.STREAM_CHUNK),
             donate_argnums=(3,),
         )
-        # Inserts a batch-1 prefill cache into lane `i` of the batched
-        # cache (i is traced: one compile serves every lane).
-        self._lane_insert = jax.jit(
-            lambda batched, single, i: jax.tree.map(
-                lambda b, s: jax.lax.dynamic_update_slice(
-                    b, s, (i, 0, 0, 0)), batched, single),
+        # Inserts row `b` of a batched prefill cache into lane `i` of
+        # the decode cache (b and i are traced: one compile serves
+        # every (row, lane) pair).
+        self._lane_insert_row = jax.jit(
+            lambda batched, multi, b, i: jax.tree.map(
+                lambda dst, src: jax.lax.dynamic_update_slice(
+                    dst, jax.lax.dynamic_slice_in_dim(src, b, 1, axis=0),
+                    (i, 0, 0, 0)),
+                batched, multi),
             donate_argnums=(0,),
         )
+        # Scatter first tokens of joining lanes into the device-side
+        # last-token vector the next decode chunk consumes.
+        self._set_lane_tokens = jax.jit(
+            lambda toks, idx, vals: toks.at[idx].set(vals),
+            donate_argnums=(0,),
+        )
+
+        # Prefill executables keyed by (batch, bucket). Batched-join
+        # prefill shapes are compiled AHEAD in a background thread the
+        # first time a new shape shows up — an inline compile (seconds)
+        # would stall every active token stream; until the compile
+        # lands, joins fall back to the already-compiled batch-1 path.
+        self._prefill_exec: Dict[tuple, object] = {}
+        self._prefill_compiling: set = set()
+        self._prefill_exec_lock = threading.Lock()
 
         self._lanes = max(1, int(decode_lanes))
         self._sched_lock = threading.Lock()
         self._sched_cv = threading.Condition(self._sched_lock)
         self._sched_thread: Optional[threading.Thread] = None
+        self._delivery_thread: Optional[threading.Thread] = None
+        self._fetch_pool = None
         self._sched_stop = False
+        self._gen = 0  # bumped on crash: stale threads exit
         self._join_queue: list = []
         self._active: Dict[int, _GenRequest] = {}
         self._free_lanes = list(range(self._lanes))
-        self._lane_tokens = [PAD] * self._lanes  # host-side carries
-        self._lane_pos = [0] * self._lanes
+        self._lane_pos = [0] * self._lanes  # host bookkeeping
+        self._tokens_dev = None  # [lanes] int32 device carry
         self._batched_cache = None
+        self._delivery_queue: deque = deque()
+        self._inflight = 0  # dispatched-not-yet-delivered decode chunks
 
     # -- scheduler -------------------------------------------------------
 
     def _ensure_scheduler(self):
         with self._sched_cv:
-            if self._sched_thread is not None or self._sched_stop:
+            if self._sched_stop:
                 return
-            self._sched_thread = threading.Thread(
-                target=self._scheduler_loop, daemon=True,
-                name="llm-decode-%s" % self.name)
-            self._sched_thread.start()
+            if self._fetch_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                # Sized so every in-flight chunk's device->host fetch
+                # overlaps (the relay pipelines concurrent fetches:
+                # 8 concurrent transfers complete in one ~65 ms round
+                # trip, measured on this image).
+                self._fetch_pool = ThreadPoolExecutor(
+                    max_workers=self.MAX_INFLIGHT + 2,
+                    thread_name_prefix="llm-fetch-%s" % self.name)
+            if self._sched_thread is None:
+                self._sched_thread = threading.Thread(
+                    target=self._scheduler_loop, args=(self._gen,),
+                    daemon=True, name="llm-decode-%s" % self.name)
+                self._sched_thread.start()
+            if self._delivery_thread is None:
+                self._delivery_thread = threading.Thread(
+                    target=self._delivery_loop, args=(self._gen,),
+                    daemon=True, name="llm-deliver-%s" % self.name)
+                self._delivery_thread.start()
 
     def _deliver(self, lane: int, req: _GenRequest, token: int) -> bool:
         """Pushes one token; returns False when the request finished
@@ -498,51 +567,138 @@ class LlmModel(ServedModel):
     def _release_lane(self, lane: int):
         """Caller holds _sched_cv."""
         self._active.pop(lane, None)
-        self._lane_tokens[lane] = PAD
         self._lane_pos[lane] = 0
         self._free_lanes.append(lane)
 
-    def _join_lane(self, lane: int, req: _GenRequest):
-        """Prefill (batch 1) into the lane's cache slice; deliver the
-        first token. Runs on the scheduler thread, no lock held during
-        device work."""
-        prompt = req.prompt
-        n = len(prompt)
-        # pad the prompt to a power-of-two bucket so XLA compiles
-        # prefill once per bucket, not once per prompt length
-        bucket = 16
-        while bucket < n:
-            bucket *= 2
-        bucket = min(bucket, self.cfg.max_seq)
-        padded = np.full((1, bucket), PAD, dtype=np.int32)
-        padded[0, :n] = prompt
-        logits, single_cache = self._prefill(
-            self._params, jnp.asarray(padded), init_cache(self.cfg, 1), n)
-        first = int(jnp.argmax(logits[0]))
-        self._batched_cache = self._lane_insert(
-            self._batched_cache, single_cache, lane)
-        with self._sched_cv:
-            if self._sched_stop:
-                # unload() raced this join after popping the request
-                # off the queue — fail it, never strand the client.
-                req.fail("model unloaded")
-                self._free_lanes.append(lane)
-                return
-            self._lane_tokens[lane] = first
-            self._lane_pos[lane] = n
-            self._active[lane] = req
-            if not self._deliver(lane, req, first):
-                self._release_lane(lane)
+    def _compile_prefill(self, b: int, bucket: int):
+        """AOT-compiles the (b, bucket) prefill and publishes it in
+        _prefill_exec. Runs inline for batch 1 (first use of a new
+        bucket has nothing to fall back to) and on a background thread
+        for batched shapes."""
+        toks = jax.ShapeDtypeStruct((b, bucket), jnp.int32)
+        lens = jax.ShapeDtypeStruct((b,), jnp.int32)
+        cache = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+            init_cache(self.cfg, b))
+        compiled = self._prefill.lower(
+            self._params, toks, cache, lens).compile()
+        with self._prefill_exec_lock:
+            self._prefill_exec[(b, bucket)] = compiled
+            self._prefill_compiling.discard((b, bucket))
 
-    def _scheduler_loop(self):
+    def _get_prefill_exec(self, b: int, bucket: int):
+        """Returns the compiled (b, bucket) prefill, or None while a
+        background compile is still in flight (caller falls back to
+        batch 1). Batch 1 always blocks until compiled."""
+        key = (b, bucket)
+        with self._prefill_exec_lock:
+            compiled = self._prefill_exec.get(key)
+            if compiled is not None:
+                return compiled
+            if b > 1 and key in self._prefill_compiling:
+                return None
+            if b > 1:
+                self._prefill_compiling.add(key)
+        if b == 1:
+            self._compile_prefill(1, bucket)
+            return self._prefill_exec[key]
+        threading.Thread(
+            target=self._compile_prefill_safely, args=(b, bucket),
+            daemon=True, name="llm-prefill-compile").start()
+        return None
+
+    def _compile_prefill_safely(self, b: int, bucket: int):
+        try:
+            self._compile_prefill(b, bucket)
+        except Exception:  # noqa: BLE001 — joins keep falling back
+            with self._prefill_exec_lock:
+                self._prefill_compiling.discard((b, bucket))
+
+    def _dispatch_joins(self, joins):
+        """Batched prefill for a set of (lane, request) joins: prompts
+        sharing a padded bucket go through ONE prefill dispatch (batch
+        padded to a power of two so XLA compiles per (B, bucket), not
+        per request mix), their caches are row-inserted into the
+        decode cache, and the first tokens are scattered into the
+        device token vector. Nothing here blocks on the device — the
+        first tokens travel to clients through the delivery queue like
+        any decode chunk. Runs on the scheduler thread, no lock held
+        during device work."""
+        groups: Dict[int, list] = {}
+        for lane, req in joins:
+            n = len(req.prompt)
+            bucket = 16
+            while bucket < n:
+                bucket *= 2
+            bucket = min(bucket, self.cfg.max_seq)
+            groups.setdefault(bucket, []).append((lane, req))
+        batches = []
+        for bucket, group in groups.items():
+            b = 1
+            while b < len(group):
+                b *= 2
+            compiled = self._get_prefill_exec(b, bucket)
+            if compiled is None:
+                # Batched shape still compiling in the background:
+                # fall back to batch-1 prefills rather than stalling
+                # every active stream for seconds.
+                one = self._get_prefill_exec(1, bucket)
+                batches.extend((bucket, 1, one, [entry]) for entry in group)
+            else:
+                batches.append((bucket, b, compiled, group))
+        for batch_idx, (bucket, b, compiled, group) in enumerate(batches):
+            padded = np.full((b, bucket), PAD, dtype=np.int32)
+            lens = np.ones((b,), dtype=np.int32)
+            for row, (lane, req) in enumerate(group):
+                padded[row, :len(req.prompt)] = req.prompt
+                lens[row] = len(req.prompt)
+            firsts, multi_cache = compiled(
+                self._params, jnp.asarray(padded),
+                init_cache(self.cfg, b), jnp.asarray(lens))  # [b] device
+            lanes_idx = np.array([lane for lane, _ in group],
+                                 dtype=np.int32)
+            for row, (lane, req) in enumerate(group):
+                self._batched_cache = self._lane_insert_row(
+                    self._batched_cache, multi_cache,
+                    np.int32(row), np.int32(lane))
+            self._tokens_dev = self._set_lane_tokens(
+                self._tokens_dev, jnp.asarray(lanes_idx),
+                firsts[:len(group)])
+            fut = self._fetch_pool.submit(np.asarray, firsts)
+            with self._sched_cv:
+                if self._sched_stop:
+                    # Fail the current group AND every not-yet-run
+                    # group — they are all popped off _join_queue and
+                    # invisible to any other cleanup path.
+                    for _, _, _, late_group in batches[batch_idx:]:
+                        for lane, req in late_group:
+                            req.fail("model unloaded")
+                            self._free_lanes.append(lane)
+                    return
+                for row, (lane, req) in enumerate(group):
+                    self._lane_pos[lane] = len(req.prompt)
+                    self._active[lane] = req
+                self._delivery_queue.append(("join", fut, list(group)))
+                self._sched_cv.notify_all()
+
+    def _scheduler_loop(self, gen: int):
+        """Dispatch side of the decode pipeline: prefills joins and
+        launches decode chunks back-to-back WITHOUT waiting for their
+        device->host fetches — each chunk's token fetch rides the
+        fetch pool and reaches clients through _delivery_loop. The
+        relay's ~65 ms fetch latency then overlaps the next chunks'
+        compute instead of gating the token cadence (inter-chunk gap =
+        chunk compute time, not fetch latency)."""
         try:
             while True:
                 joins = []
                 with self._sched_cv:
-                    while (not self._sched_stop and not self._active
-                           and not self._join_queue):
+                    while (not self._sched_stop and self._gen == gen
+                           and not (self._join_queue and self._free_lanes)
+                           and not (self._active
+                                    and self._inflight < self.MAX_INFLIGHT)):
                         self._sched_cv.wait()
-                    if self._sched_stop:
+                    if self._sched_stop or self._gen != gen:
                         return
                     while self._join_queue and self._free_lanes:
                         req = self._join_queue.pop(0)
@@ -550,79 +706,140 @@ class LlmModel(ServedModel):
                             req.finish()
                             continue
                         joins.append((self._free_lanes.pop(0), req))
-                for idx, (lane, req) in enumerate(joins):
+                if joins:
                     try:
-                        self._join_lane(lane, req)
+                        self._dispatch_joins(joins)
                     except Exception as e:  # noqa: BLE001
-                        # The popped requests are in neither _active nor
-                        # _join_queue, so the crash handler below cannot
-                        # see them — fail them here or their clients
+                        # Popped requests are in neither _active nor
+                        # _join_queue, so the crash handler cannot see
+                        # all of them — fail them here or their clients
                         # block forever on queue.get().
                         with self._sched_cv:
-                            for lane2, req2 in joins[idx:]:
-                                req2.fail("llm prefill failed: %s" % e)
-                                if lane2 not in self._active:
-                                    self._free_lanes.append(lane2)
+                            for lane2, req2 in joins:
+                                if self._active.get(lane2) is not req2:
+                                    req2.fail("llm prefill failed: %s" % e)
+                                    if lane2 not in self._active:
+                                        self._free_lanes.append(lane2)
                         raise
+                    continue  # more joins may fit before the next chunk
                 with self._sched_cv:
-                    if not self._active:
+                    if (not self._active or self._batched_cache is None
+                            or self._inflight >= self.MAX_INFLIGHT):
                         continue
-                if self._batched_cache is None:  # pragma: no cover
-                    continue
-                tokens = jnp.asarray(self._lane_tokens, dtype=jnp.int32)
-                pos = jnp.asarray(self._lane_pos, dtype=jnp.int32)
+                    pos_host = np.asarray(self._lane_pos, dtype=np.int32)
                 toks, self._batched_cache = self._decode_chunk_multi(
-                    self._params, tokens, pos, self._batched_cache)
-                ids = np.asarray(jax.device_get(toks))  # [chunk, lanes]
+                    self._params, self._tokens_dev, jnp.asarray(pos_host),
+                    self._batched_cache)
+                self._tokens_dev = toks[-1]  # [lanes] device carry
+                fut = self._fetch_pool.submit(np.asarray, toks)
                 with self._sched_cv:
-                    for lane in range(self._lanes):
-                        req = self._active.get(lane)
-                        if req is None:
-                            # Idle lanes decode garbage that later
-                            # prefills overwrite before it is ever
-                            # attended; just pin their bookkeeping.
-                            self._lane_tokens[lane] = PAD
-                            self._lane_pos[lane] = 0
-                            continue
+                    snapshot = dict(self._active)
+                    for lane in snapshot:
+                        self._lane_pos[lane] += self.STREAM_CHUNK
+                    self._inflight += 1
+                    self._delivery_queue.append(("chunk", fut, snapshot))
+                    self._sched_cv.notify_all()
+        except Exception as e:  # noqa: BLE001 — fail all riders loudly
+            self._crash("llm scheduler failed: %s" % e, gen)
+
+    def _delivery_loop(self, gen: int):
+        """Consumer side of the decode pipeline: waits on each fetched
+        token block IN DISPATCH ORDER and routes tokens to their
+        requests. Runs concurrently with the scheduler's next
+        dispatches, so the fetch latency is pipelined away."""
+        try:
+            while True:
+                with self._sched_cv:
+                    while (not self._sched_stop and self._gen == gen
+                           and not self._delivery_queue):
+                        self._sched_cv.wait()
+                    if self._sched_stop or self._gen != gen:
+                        return
+                    kind, fut, payload = self._delivery_queue.popleft()
+                ids = fut.result()  # blocks ~one relay round trip
+                if kind == "join":
+                    with self._sched_cv:
+                        if self._gen != gen:
+                            return
+                        for row, (lane, req) in enumerate(payload):
+                            if self._active.get(lane) is not req:
+                                continue  # finished/cancelled already
+                            if not self._deliver(lane, req, int(ids[row])):
+                                self._release_lane(lane)
+                        self._sched_cv.notify_all()
+                    continue
+                with self._sched_cv:
+                    if self._gen != gen:
+                        return
+                    for lane, req in payload.items():
+                        if self._active.get(lane) is not req:
+                            continue  # lane re-assigned since dispatch
                         alive = True
                         for token in ids[:, lane]:
                             alive = self._deliver(lane, req, int(token))
                             if not alive:
                                 break
-                        self._lane_pos[lane] += ids.shape[0]
-                        self._lane_tokens[lane] = int(ids[-1, lane])
-                        if alive and \
-                                self._lane_pos[lane] >= self.cfg.max_seq - 1:
+                        if alive and (len(req.prompt) + req.delivered
+                                      >= self.cfg.max_seq - 1):
                             req.finish()
                             alive = False
                         if not alive:
                             self._release_lane(lane)
-        except Exception as e:  # noqa: BLE001 — fail all riders loudly
-            with self._sched_cv:
-                for req in list(self._active.values()) + self._join_queue:
-                    req.fail("llm scheduler failed: %s" % e)
-                self._active.clear()
-                self._join_queue.clear()
-                # Reset lane state so a restarted scheduler starts
-                # clean: the donated cache may already be consumed,
-                # and leaked lanes would leave the restart spinning
-                # with nothing schedulable.
-                self._free_lanes = list(range(self._lanes))
-                self._lane_tokens = [PAD] * self._lanes
-                self._lane_pos = [0] * self._lanes
-                self._batched_cache = None
-                self._sched_thread = None
+                    self._inflight -= 1
+                    self._sched_cv.notify_all()
+        except Exception as e:  # noqa: BLE001
+            self._crash("llm delivery failed: %s" % e, gen)
+
+    def _collect_riders(self):
+        """Every request the pipeline still owes tokens to: active
+        lanes, queued joins, and requests riding undelivered records.
+        Caller holds _sched_cv."""
+        riders = list(self._active.values()) + self._join_queue
+        for _, _, payload in self._delivery_queue:
+            if isinstance(payload, dict):
+                riders.extend(payload.values())
+            else:
+                riders.extend(req for _, req in payload)
+        return riders
+
+    def _crash(self, message: str, gen: int):
+        """Fails every rider and resets the pipeline so a later
+        request restarts it cleanly (the donated cache may already be
+        consumed; leaked lanes would leave a restart spinning)."""
+        with self._sched_cv:
+            if self._gen != gen:  # another thread already reset
+                return
+            self._gen += 1
+            for req in self._collect_riders():
+                req.fail(message)
+            self._active.clear()
+            self._join_queue.clear()
+            self._delivery_queue.clear()
+            self._inflight = 0
+            self._free_lanes = list(range(self._lanes))
+            self._lane_pos = [0] * self._lanes
+            self._tokens_dev = None
+            self._batched_cache = None
+            self._sched_thread = None
+            self._delivery_thread = None
+            self._sched_cv.notify_all()
 
     def unload(self) -> None:
         with self._sched_cv:
             self._sched_stop = True
-            for req in list(self._active.values()) + self._join_queue:
+            for req in self._collect_riders():
                 req.fail("model unloaded")
             self._active.clear()
             self._join_queue.clear()
+            self._delivery_queue.clear()
+            self._inflight = 0
             self._sched_cv.notify_all()
         if self._sched_thread is not None:
             self._sched_thread.join(timeout=10)
+        if self._delivery_thread is not None:
+            self._delivery_thread.join(timeout=10)
+        if self._fetch_pool is not None:
+            self._fetch_pool.shutdown(wait=False)
 
     def _generate(self, inputs, parameters):
         text = inputs["text_input"].reshape(-1)[0]
@@ -647,6 +864,9 @@ class LlmModel(ServedModel):
                     status="UNAVAILABLE")
             if self._batched_cache is None:
                 self._batched_cache = init_cache(self.cfg, self._lanes)
+            if self._tokens_dev is None:
+                self._tokens_dev = jnp.full(
+                    (self._lanes,), PAD, dtype=jnp.int32)
             self._join_queue.append(request)
             self._sched_cv.notify_all()
         # AFTER enqueuing: a scheduler that crashed between the
@@ -681,6 +901,39 @@ class LlmModel(ServedModel):
         return {"text_output": np.array([text.encode()], dtype=np.object_)}
 
     def warmup(self) -> None:
+        # Prime the prefill shapes concurrent serving hits (power-of
+        # -two join batches x the two common prompt buckets) so no
+        # multi-second XLA compile lands mid-stream; the persistent
+        # compilation cache makes repeat warmups near-free.
+        pow2s = [1]
+        while pow2s[-1] < self._lanes:  # ceiling pow2 covers any group
+            pow2s.append(pow2s[-1] * 2)
+        for b in pow2s:
+            for bucket in sorted({min(16, self.cfg.max_seq),
+                                  min(64, self.cfg.max_seq)}):
+                if (b, bucket) not in self._prefill_exec:
+                    try:
+                        self._compile_prefill(b, bucket)
+                    except Exception:  # noqa: BLE001 — warmup best-effort
+                        pass
+        # The join path's small shape-dependent kernels (cache row
+        # insert per prefill batch, token scatter per join-group size)
+        # also compile per shape — prime them too, or the first
+        # concurrent join round stalls every stream for the compile.
+        try:
+            for b in pow2s:
+                scratch = self._lane_insert_row(
+                    init_cache(self.cfg, self._lanes),
+                    init_cache(self.cfg, b), np.int32(0), np.int32(0))
+                del scratch
+            toks = jnp.full((self._lanes,), PAD, dtype=jnp.int32)
+            for g in range(1, self._lanes + 1):
+                toks = self._set_lane_tokens(
+                    toks, jnp.arange(g, dtype=jnp.int32),
+                    jnp.full((g,), PAD, dtype=jnp.int32))
+            del toks
+        except Exception:  # noqa: BLE001 — warmup best-effort
+            pass
         list(self.infer_stream({
             "text_input": np.array([b"hi"], dtype=np.object_),
             "max_tokens": np.array([2], dtype=np.int32),

@@ -35,9 +35,21 @@ import time
 REPO = pathlib.Path(__file__).resolve().parents[2]
 
 # Reference baselines (illustrative — docs/quick_start.md:94 and
-# docs/benchmarking.md:121 of the reference perf_analyzer).
+# docs/benchmarking.md:121,75 of the reference perf_analyzer).
 BASELINE_SIMPLE = 1407.84
 BASELINE_RESNET = 165.8
+BASELINE_INPROCESS = 19.6095  # ref --service-kind=triton_c_api row
+
+# Regenerated TPU baselines for the BASELINE.md configs the reference
+# publishes no numbers for: the round-3 measured values on this
+# hardware, frozen in BASELINE.md's "Regenerated baselines" table.
+# vs_baseline for these stages = improvement over that anchor.
+BASELINE_R3 = {
+    "bert_grpc_sysshm": 102.64,
+    "ensemble_stream_grpc": 62.32,
+    "llm_tokens_per_sec": 192.0,
+    "llm_itl_p99_ms": 129.82,
+}
 
 RESULT: dict = {"stages": {}}
 _OUT_PATH: pathlib.Path | None = None
@@ -142,6 +154,86 @@ def measure_model_exec_ms(core, model_name: str, batch: int,
         times.append(time.perf_counter() - t0)
     times = times[1:]
     return sorted(times)[len(times) // 2] * 1000.0
+
+
+def measure_model_exec_corrected(core, model_name: str, batch: int,
+                                 chain: int = 32, trials: int = 5):
+    """Relay-honest device step time (BASELINE.md methodology):
+    dispatches ``chain`` executions back-to-back and fetches only the
+    LAST output, then solves  T1 = e + f,  Tn = n*e + f  for the
+    device exec time e — the fixed ~65 ms device->host round trip the
+    relay adds to any naive timing drops out. Returns
+    (exec_ms, fetch_ms) medians over ``trials``."""
+    import numpy as np
+
+    from client_tpu.utils import triton_to_np_dtype
+
+    model = core.repository.get(model_name, "")
+    rng = np.random.default_rng(0)
+    inputs = {}
+    for spec in model.inputs:
+        shape = [d if d > 0 else 128 for d in spec.shape]
+        if model.max_batch_size > 0:
+            shape = [batch] + shape
+        np_dtype = np.dtype(triton_to_np_dtype(spec.datatype))
+        if np_dtype.kind in "iu":
+            data = rng.integers(0, 8, size=shape).astype(np_dtype)
+        else:
+            data = rng.random(size=shape, dtype=np.float32).astype(np_dtype)
+        inputs[spec.name] = data
+
+    # Device-resident inputs, or every chained exec re-pays the
+    # host->device upload round trip and the probe measures the relay
+    # again instead of the device (the serving path reads the arena —
+    # its inputs never cross the wire either).
+    import jax
+    import jax.numpy as jnp
+
+    inputs = {name: jax.device_put(value) for name, value in inputs.items()}
+    for value in inputs.values():  # force the uploads to complete
+        np.asarray(jnp.reshape(value, (-1,))[:1])
+
+    def timed(n: int) -> float:
+        t0 = time.perf_counter()
+        outputs = None
+        for _ in range(n):
+            outputs = model.infer(inputs, {})
+        for value in outputs.values():
+            np.asarray(value)
+        return time.perf_counter() - t0
+
+    timed(1)  # warm the fetch path + any first-call compile
+    execs, fetches = [], []
+    for _ in range(trials):
+        t1 = timed(1)
+        tn = timed(chain)
+        execs.append((tn - t1) / (chain - 1))
+        fetches.append(t1)
+    execs.sort()
+    fetches.sort()
+    exec_s = execs[len(execs) // 2]
+    fetch_s = max(fetches[len(fetches) // 2] - exec_s, 0.0)
+    if exec_s < 5e-5:
+        # Relay jitter swamped the chain: the difference method can't
+        # resolve device time this small — report unmeasurable rather
+        # than a garbage MFU.
+        raise RuntimeError(
+            "device exec below measurement floor (%.3f ms; relay "
+            "jitter dominates)" % (exec_s * 1000))
+    return exec_s * 1000.0, fetch_s * 1000.0
+
+
+def fusion_stats(core, model_name: str):
+    """(inference_count, execution_count) snapshot for fusion-ratio
+    evidence (Triton semantics: inference_count counts batch rows,
+    execution_count counts model executions; ratio < 0.5 proves the
+    dynamic batcher fused)."""
+    try:
+        stats = core.model_statistics(model_name)
+        entry = stats.model_stats[0]
+        return int(entry.inference_count), int(entry.execution_count)
+    except Exception:  # noqa: BLE001 — evidence, never a failure
+        return None
 
 
 def run_native(binary: pathlib.Path, address: str, model: str, batch: int,
@@ -310,7 +402,10 @@ def main() -> None:
         try:
             tput, p50 = run_python_harness("simple", 1, 4, "none", 0,
                                            core=core, warm_s=1.0)
-            record_stage("simple_inprocess", tput, p50)
+            record_stage(
+                "simple_inprocess", tput, p50,
+                {"vs_baseline": round(tput / BASELINE_INPROCESS, 4),
+                 "baseline_src": "ref triton_c_api in-process row"})
         except Exception as exc:  # noqa: BLE001
             log("simple_inprocess failed: %s" % exc)
 
@@ -411,8 +506,11 @@ def main() -> None:
                 with open(csv) as f:
                     f.readline()
                     row = f.readline().strip().split(",")
-                record_stage("simple_inprocess_native",
-                             float(row[1]), float(row[2]))
+                record_stage(
+                    "simple_inprocess_native", float(row[1]), float(row[2]),
+                    {"vs_baseline": round(
+                        float(row[1]) / BASELINE_INPROCESS, 4),
+                     "baseline_src": "ref triton_c_api in-process row"})
             else:
                 log("native in_process failed rc=%d: %s"
                     % (proc.returncode, proc.stderr[-300:]))
@@ -438,6 +536,24 @@ def main() -> None:
                 log("resnet50 bare exec+fetch (batch 8): %.1f ms" % exec_ms)
             except Exception as exc:  # noqa: BLE001
                 log("exec probe failed (continuing): %s" % exc)
+            try:
+                # Relay-corrected device step time (chained dispatches,
+                # one fetch): the honest device-side number the raw
+                # probe hides behind the ~65 ms fetch tax.
+                dev_ms, fetch_ms = measure_model_exec_corrected(
+                    core, "resnet50", batch=8)
+                exec_extra["model_exec_ms_device"] = round(dev_ms, 2)
+                exec_extra["relay_fetch_ms_est"] = round(fetch_ms, 2)
+                # 8 imgs x ~7.7 GFLOP forward / device time vs v5e
+                # peak 394 bf16 TFLOP/s.
+                if platform == "tpu":
+                    exec_extra["mfu_device"] = round(
+                        8 * 7.7e9 / (dev_ms / 1e3) / 394e12, 5)
+                log("resnet50 device exec (batch 8): %.2f ms "
+                    "(fetch %.1f ms, mfu %.3f)"
+                    % (dev_ms, fetch_ms, exec_extra.get("mfu_device", -1)))
+            except Exception as exc:  # noqa: BLE001
+                log("corrected exec probe failed (continuing): %s" % exc)
             log("resnet50 warm; measuring over gRPC + tpu shm")
             out_shm = 8 * 1000 * 4 + 1024
             if binary:  # unmeasured pass: fusion/slice kernels compile
@@ -491,7 +607,10 @@ def main() -> None:
             tput, p50 = run_python_harness("resnet50", 8, 4, "none", 0,
                                            core=core, warm_s=1.0)
             record_stage("resnet50_inprocess", tput, p50,
-                         {"batch": 8, **exec_extra})
+                         {"batch": 8,
+                          "vs_baseline": round(tput / BASELINE_INPROCESS, 4),
+                          "baseline_src": "ref triton_c_api in-process row",
+                          **exec_extra})
         except Exception as exc:  # noqa: BLE001
             log("resnet50_inprocess failed: %s" % exc)
 
@@ -503,7 +622,9 @@ def main() -> None:
     # measured figure on TPU.
     def native_stage(stage_name, model_name, *, batch=1, concurrency=4,
                      shared_memory="none", output_shm=0, streaming=False,
-                     window_ms=2000, input_data=None, extra=None):
+                     window_ms=2000, input_data=None, extra=None,
+                     baseline=None, baseline_src="", track_fusion=False,
+                     fusion_composing=()):
         if not binary or remaining() < 90:
             return
         try:
@@ -527,23 +648,76 @@ def main() -> None:
             except Exception as exc:  # noqa: BLE001
                 log("%s warm pass failed (continuing): %s"
                     % (stage_name, exc))
-            tput, p50 = run_native(
-                binary, handle.address, model_name, batch, concurrency,
-                timeout=max(30.0, min(240.0, remaining() - 20)), **common)
-            record_stage(stage_name, tput, p50,
-                         dict(extra or {}, batch=batch,
-                              concurrency=concurrency))
+            fusion_names = ([model_name] if track_fusion else []) \
+                + list(fusion_composing)
+            counts_before = {name: fusion_stats(core, name)
+                             for name in fusion_names}
+            attempts = 0
+            while True:
+                attempts += 1
+                try:
+                    tput, p50 = run_native(
+                        binary, handle.address, model_name, batch,
+                        concurrency,
+                        timeout=max(30.0, min(240.0, remaining() - 20)),
+                        **common)
+                    break
+                except Exception as exc:  # noqa: BLE001
+                    # A freshly-warmed server right after a heavy stage
+                    # occasionally resets the first connection burst;
+                    # one settle-and-retry rescues the stage instead of
+                    # dropping a BASELINE config from the record.
+                    if attempts >= 2 or remaining() < 60:
+                        raise
+                    log("%s attempt %d failed (%s) — retrying"
+                        % (stage_name, attempts, exc))
+                    time.sleep(3.0)
+            result = dict(extra or {}, batch=batch, concurrency=concurrency)
+            if baseline:
+                result["vs_baseline"] = round(tput / baseline, 4)
+                result["baseline_src"] = baseline_src
+            for name in fusion_names:
+                before = counts_before.get(name)
+                after = fusion_stats(core, name)
+                if before is None or after is None:
+                    continue
+                d_infer = after[0] - before[0]
+                d_exec = after[1] - before[1]
+                if d_infer <= 0:
+                    continue
+                # < 0.5 proves the dynamic batcher fused
+                # (avg fused batch = 1 / ratio). Composing models get
+                # a prefixed key so the backbone-step fusion is its
+                # own recorded evidence.
+                prefix = "" if name == model_name else name + "_"
+                result[prefix + "fusion_ratio"] = round(d_exec / d_infer, 4)
+                result[prefix + "fused_requests"] = d_infer
+                result[prefix + "fused_executions"] = d_exec
+            record_stage(stage_name, tput, p50, result)
         except Exception as exc:  # noqa: BLE001
             log("%s failed: %s" % (stage_name, exc))
 
     # Config 3: BERT-base, dynamic batching fuses concurrent variable
     # length requests server-side; I/O over system shared memory.
-    native_stage("bert_grpc_sysshm", "bert_base", concurrency=8,
-                 shared_memory="system", output_shm=4096)
+    # Concurrency 64: the served round trip has a hard ~65 ms relay
+    # fetch floor, so throughput = in-flight requests / latency — and
+    # the batcher turns those 64 into a few MXU calls (fusion_ratio is
+    # the recorded proof).
+    native_stage("bert_grpc_sysshm", "bert_base", concurrency=64,
+                 shared_memory="system", output_shm=4096,
+                 baseline=BASELINE_R3["bert_grpc_sysshm"],
+                 baseline_src="r03 regenerated (BASELINE.md)",
+                 track_fusion=True)
     # Config 4: ensemble (preprocess -> resnet50 -> postprocess) over
-    # bidi streaming gRPC with decoupled outputs.
-    native_stage("ensemble_stream_grpc", "ensemble_image", concurrency=4,
-                 streaming=True)
+    # bidi streaming gRPC with decoupled outputs. Concurrency 32 for
+    # the same latency-floor reason; the backbone step fuses across
+    # concurrent stream requests through resnet50's own dynamic
+    # batcher (fusion_ratio on the composing model is the proof).
+    native_stage("ensemble_stream_grpc", "ensemble_image", concurrency=32,
+                 streaming=True,
+                 baseline=BASELINE_R3["ensemble_stream_grpc"],
+                 baseline_src="r03 regenerated (BASELINE.md)",
+                 track_fusion=True, fusion_composing=("resnet50",))
     # Config 5: LLM generate endpoint, decoupled token streaming
     # (device-side chunked decode: one host fetch per 8 tokens).
     # Inputs are pinned — random data would draw a huge max_tokens and
@@ -561,6 +735,10 @@ def main() -> None:
     if llm_stage:
         llm_stage["tokens_per_sec"] = round(
             llm_stage["throughput"] * llm_stage["tokens_per_request"], 1)
+        llm_stage["vs_baseline"] = round(
+            llm_stage["tokens_per_sec"] / BASELINE_R3["llm_tokens_per_sec"],
+            4)
+        llm_stage["baseline_src"] = "r03 regenerated (BASELINE.md), tokens/s"
         flush_result()
 
     # Config 5 LLM metrics proper: the genai harness measures TTFT and
@@ -593,6 +771,11 @@ def main() -> None:
                         k: round(v, 2)
                         for k, v in stats[key].items()
                         if k in ("mean", "p50", "p99")}
+            itl = llm_stage.get("itl_ms")
+            if itl and itl.get("p99"):
+                # > 1 = better than the r03 anchor (lower tail latency).
+                llm_stage["itl_p99_improvement"] = round(
+                    BASELINE_R3["llm_itl_p99_ms"] / itl["p99"], 2)
             flush_result()
             log("genai TTFT/ITL attached: %s / %s"
                 % (llm_stage.get("ttft_ms"), llm_stage.get("itl_ms")))

@@ -58,7 +58,7 @@ def start_grpc_server(
     load_models: Optional[Sequence[str]] = None,
     address: str = "127.0.0.1:0",
     core: Optional[InferenceServerCore] = None,
-    max_workers: int = 16,
+    max_workers: int = 96,
     aio: Optional[bool] = None,
 ) -> ServerHandle:
     """Start a server on ``address`` (port 0 = ephemeral); returns a

@@ -64,6 +64,15 @@ class DynamicBatcher:
 
         self._fetch_pool = ThreadPoolExecutor(
             max_workers=4, thread_name_prefix="batch-fetch")
+        # Bucket executions run here, NOT on the gather thread: a
+        # model whose infer() blocks (an ensemble fetching its final
+        # outputs, any host-side model) would otherwise serialize the
+        # whole batcher at one bucket per blocking round trip; in the
+        # pool, consecutive buckets' device work and transfers
+        # pipeline. Buckets are mutually independent, so cross-bucket
+        # completion order is free.
+        self._exec_pool = ThreadPoolExecutor(
+            max_workers=6, thread_name_prefix="batch-exec")
         self._thread = threading.Thread(target=self._gather_loop,
                                         daemon=True)
         self._thread.start()
@@ -73,6 +82,7 @@ class DynamicBatcher:
             self._stopping = True
             self._cv.notify_all()
         self._thread.join(timeout=5)
+        self._exec_pool.shutdown(wait=True)
         self._fetch_pool.shutdown(wait=True)
 
     # -- request side ----------------------------------------------------
@@ -125,7 +135,10 @@ class DynamicBatcher:
                         break
                     self._cv.wait(
                         timeout=(deadline - now) / 1e9)
-            self._execute(bucket)
+            try:
+                self._exec_pool.submit(self._execute, bucket)
+            except RuntimeError:  # pool shut down mid-stop
+                self._execute(bucket)
 
     def _take_compatible(self, bucket, shape_key, total) -> bool:
         """Moves the next compatible queued request into the bucket

@@ -409,16 +409,24 @@ class InferenceServerCore:
             return batcher
 
     def _record_composing(self, name: str, count: int,
-                          compute_ns: int) -> None:
+                          compute_ns: int, executions: int = 1) -> None:
         """Stats hook ensembles call per composing-step execution, so
         composing models' per-window deltas are real (Triton records
-        composing executions through their own schedulers)."""
-        self._stats_for(name).record(count, 0, 0, compute_ns, 0, ok=True)
+        composing executions through their own schedulers). Batched
+        steps pass executions=0 for non-leader riders."""
+        self._stats_for(name).record(count, 0, 0, compute_ns, 0, ok=True,
+                                     executions=executions)
 
     def infer(self, request: pb.ModelInferRequest) -> pb.ModelInferResponse:
         model = self.repository.get(request.model_name, request.model_version)
         if getattr(model, "stats_recorder", False) is None:
             model.stats_recorder = self._record_composing
+        if getattr(model, "batcher_resolver", False) is None:
+            # Composing steps route through each model's OWN dynamic
+            # batcher (Triton semantics: an ensemble step enters the
+            # composing model's scheduler), so concurrent ensemble
+            # requests fuse their backbone executions.
+            model.batcher_resolver = self._batcher_for
         stats = self._stats_for(model.name)
         t0 = time.monotonic_ns()
         queue_ns = 0

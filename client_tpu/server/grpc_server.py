@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+import threading
 from concurrent import futures
 from typing import Optional
 
@@ -66,13 +67,85 @@ class InferenceServicer(GRPCInferenceServiceServicer):
         except InferenceServerException as e:
             _abort(context, e)
 
+    # In-flight requests per stream. Triton decoupled-stream
+    # semantics: a client may pipeline many requests on one stream and
+    # responses interleave (matched by request id) — handling them one
+    # at a time would multiply every client's latency by its in-flight
+    # depth.
+    STREAM_WORKERS = 8
+
     def ModelStreamInfer(self, request_iterator, context):
-        for request in request_iterator:
+        import queue as _queue
+        from concurrent.futures import ThreadPoolExecutor
+
+        out: _queue.Queue = _queue.Queue()
+        sentinel = object()
+        # Set when the client goes away (gRPC closes this generator):
+        # workers close their per-request generators so model-side
+        # abandonment handling (GeneratorExit -> request.cancelled,
+        # e.g. the LLM's lane reclaim) still fires with threaded
+        # dispatch.
+        cancelled = threading.Event()
+
+        def run_one(request):
+            generator = self._core.stream_infer(request)
             try:
-                yield from self._core.stream_infer(request)
+                for response in generator:
+                    if cancelled.is_set():
+                        break
+                    out.put(response)
             except InferenceServerException as e:
-                # decoupled errors ride the stream rather than aborting it
-                yield pb.ModelStreamInferResponse(error_message=str(e))
+                # decoupled errors ride the stream, not abort it
+                out.put(pb.ModelStreamInferResponse(error_message=str(e)))
+            except Exception as e:  # noqa: BLE001 — never kill the stream
+                out.put(pb.ModelStreamInferResponse(
+                    error_message="internal error: %s" % e))
+            finally:
+                generator.close()
+
+        def run_after(prev, request):
+            # Same-sequence requests must execute in arrival order —
+            # sequence state is ordered — so each chains on its
+            # predecessor; distinct sequences still run concurrently.
+            if prev is not None:
+                try:
+                    prev.result()
+                except Exception:  # noqa: BLE001 — order, not success
+                    pass
+            run_one(request)
+
+        def reader():
+            sequence_tail = {}
+            try:
+                with ThreadPoolExecutor(
+                        max_workers=self.STREAM_WORKERS,
+                        thread_name_prefix="stream-infer") as pool:
+                    for request in request_iterator:
+                        key = None
+                        param = request.parameters.get("sequence_id")
+                        if param is not None:
+                            key = (param.int64_param or
+                                   param.string_param or None)
+                        if key:
+                            sequence_tail[key] = pool.submit(
+                                run_after, sequence_tail.get(key), request)
+                        else:
+                            pool.submit(run_one, request)
+                    # with-block: waits for every in-flight request
+            finally:
+                out.put(sentinel)
+
+        reader_thread = threading.Thread(target=reader, daemon=True,
+                                         name="stream-infer-reader")
+        reader_thread.start()
+        try:
+            while True:
+                item = out.get()
+                if item is sentinel:
+                    return
+                yield item
+        finally:
+            cancelled.set()
 
     def ModelStatistics(self, request, context):
         try:
@@ -199,7 +272,14 @@ class AioGrpcServerThread:
     """
 
     def __init__(self, core: InferenceServerCore, address: str,
-                 extra_servicers=(), max_workers: int = 16):
+                 extra_servicers=(), max_workers: int = 96):
+        # The servicer's handlers are sync and BLOCK in the migration
+        # pool (dynamic-batcher waits ride a threading.Event; a
+        # batched round trip is ~80 ms behind the relay) — at 64+
+        # concurrent requests a 16-thread pool serves them in waves
+        # and the wave count multiplies client latency. Blocked
+        # threads are cheap; size the pool past the serving
+        # concurrency the bench drives.
         import asyncio
         import threading
 

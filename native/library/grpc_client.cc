@@ -274,10 +274,22 @@ Error InferenceServerGrpcClient::Rpc(
     return Error("request exceeds 2GB gRPC message limit");
   }
   std::string response_bytes;
+  auto call_start = std::chrono::steady_clock::now();
   Error err = channel_->UnaryCall(
       method, request_bytes, &response_bytes, timeout_us, headers, timers,
       compression);
   if (!err.IsOk()) return err;
+  if (timeout_us > 0) {
+    // gRPC deadline semantics: completing AFTER the deadline is still
+    // DEADLINE_EXCEEDED, even when the transport's bounded wait won
+    // the race (the server may well have executed the request).
+    auto elapsed_us = std::chrono::duration_cast<std::chrono::microseconds>(
+                          std::chrono::steady_clock::now() - call_start)
+                          .count();
+    if (static_cast<uint64_t>(elapsed_us) > timeout_us) {
+      return Error("Deadline Exceeded");
+    }
+  }
   if (!resp->ParseFromString(response_bytes)) {
     return Error("failed to parse response");
   }

@@ -1,6 +1,7 @@
 #include "http_client.h"
 
 #include <algorithm>
+#include <chrono>
 #include <sstream>
 
 #include "base64.h"
@@ -296,9 +297,20 @@ Error InferenceServerHttpClient::DoRequest(
         std::to_string(json_header_length);
   }
   HttpResponse response;
+  auto call_start = std::chrono::steady_clock::now();
   std::string terr =
       conn->Request(method, path, hdrs, body, &response, timeout_us, sent_ns);
   if (!terr.empty()) return Error(terr);
+  if (timeout_us > 0) {
+    // Deadline semantics match the gRPC client: finishing after the
+    // deadline is a timeout even if the bounded wait won the race.
+    auto elapsed_us = std::chrono::duration_cast<std::chrono::microseconds>(
+                          std::chrono::steady_clock::now() - call_start)
+                          .count();
+    if (static_cast<uint64_t>(elapsed_us) > timeout_us) {
+      return Error("timeout: request exceeded client deadline");
+    }
+  }
   Error err = ErrorFromResponse(response);
   if (!err.IsOk()) return err;
   if (response_header_length != nullptr) {

@@ -51,6 +51,20 @@ size_t FifoCtxIdTracker::FreeCount() {
   return free_.size();
 }
 
+void ConcurrencyCtxIdTracker::Reset(size_t count) {
+  std::lock_guard<std::mutex> lock(mutex_);
+  free_.clear();
+  for (size_t i = 0; i < count; ++i) free_.push_back(0);
+  cv_.notify_all();
+}
+
+std::shared_ptr<FifoCtxIdTracker> MakeCtxIdTracker(
+    bool sequences_active, bool prefer_random) {
+  if (!sequences_active) return std::make_shared<ConcurrencyCtxIdTracker>();
+  if (prefer_random) return std::make_shared<RandCtxIdTracker>();
+  return std::make_shared<FifoCtxIdTracker>();
+}
+
 //==============================================================================
 // SequenceManager
 
@@ -512,7 +526,8 @@ void ConcurrencyManager::SyncWorker(
 
 void ConcurrencyManager::AsyncWorker(
     ThreadStat* stat, ClientBackend* backend, size_t n_ctx) {
-  auto tracker = std::make_shared<FifoCtxIdTracker>();
+  auto tracker = MakeCtxIdTracker(sequence_manager_ != nullptr,
+                                  /*prefer_random=*/false);
   tracker->Reset(n_ctx);
   std::vector<SequenceManager::Slot> slots(n_ctx);
   while (!stop_.load()) {
@@ -571,7 +586,8 @@ void ConcurrencyManager::AsyncWorker(
 
 void ConcurrencyManager::StreamWorker(
     ThreadStat* stat, ClientBackend* backend, size_t n_ctx) {
-  auto tracker = std::make_shared<FifoCtxIdTracker>();
+  auto tracker = MakeCtxIdTracker(sequence_manager_ != nullptr,
+                                  /*prefer_random=*/true);
   tracker->Reset(n_ctx);
   std::vector<SequenceManager::Slot> slots(n_ctx);
 

@@ -88,7 +88,7 @@ class FifoCtxIdTracker {
  public:
   virtual ~FifoCtxIdTracker() = default;
 
-  void Reset(size_t count);
+  virtual void Reset(size_t count);
   // Blocks up to timeout_ms for a free slot; returns -1 on timeout.
   int Get(int timeout_ms);
   void Release(int ctx_id);
@@ -114,6 +114,22 @@ class RandCtxIdTracker : public FifoCtxIdTracker {
  private:
   std::mt19937_64 rng_{std::random_device{}()};
 };
+
+// Non-sequence concurrency: context identity is irrelevant, so every
+// slot is id 0 and the tracker is purely an in-flight counter
+// (parity: ConcurrencyCtxIdTracker,
+// concurrency_ctx_id_tracker.h:35 — Reset enqueues `count` zeros).
+class ConcurrencyCtxIdTracker : public FifoCtxIdTracker {
+ public:
+  void Reset(size_t count) override;
+};
+
+// Strategy selection (parity: ctx_id_tracker_factory.h): sequence
+// slots carry per-slot state so their id matters — FIFO for ordered
+// reuse on the async path, RAND on the stream path to exercise slots
+// non-uniformly; non-sequence concurrency counts in-flight only.
+std::shared_ptr<FifoCtxIdTracker> MakeCtxIdTracker(
+    bool sequences_active, bool prefer_random);
 
 //==============================================================================
 // Sequence-id allocation and per-slot sequence progress.

@@ -152,6 +152,10 @@ Error ModelParser::Parse(
     for (const auto& name : bls_composing_models) {
       AddComposingModel(backend, name, model, &seen);
     }
+    if (model->scheduler_type == SchedulerType::ENSEMBLE &&
+        model->composing_sequential) {
+      model->scheduler_type = SchedulerType::ENSEMBLE_SEQUENCE;
+    }
   } catch (const std::exception& e) {
     return Error(
         std::string("malformed model metadata/config: ") + e.what());

@@ -13,7 +13,17 @@
 namespace tpuclient {
 namespace perf {
 
-enum class SchedulerType { NONE, DYNAMIC, SEQUENCE, ENSEMBLE };
+// Parity: model_parser.h:63 {NONE,DYNAMIC,SEQUENCE,ENSEMBLE,
+// ENSEMBLE_SEQUENCE} — the kind picks measurement semantics (sequence
+// kinds auto-enable the SequenceManager; ensemble kinds pull
+// composing-model server stats into the report).
+enum class SchedulerType {
+  NONE,
+  DYNAMIC,
+  SEQUENCE,
+  ENSEMBLE,
+  ENSEMBLE_SEQUENCE,
+};
 
 struct ModelTensor {
   std::string name;

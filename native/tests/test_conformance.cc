@@ -1,0 +1,527 @@
+// Protocol-conformance suite: ONE typed matrix of client-visible
+// behavior run over BOTH InferenceServerGrpcClient and
+// InferenceServerHttpClient against a live tpu_serverd (parity: the
+// reference's typed dual-protocol suite
+// /root/reference/src/c++/tests/cc_client_test.cc:42,300-1350, plus
+// client_timeout_test.cc and memory_leak_test.cc's iteration loop).
+//
+// Every case is written once as a template over the client type; the
+// CONFORMANCE_CASE macro instantiates it for each protocol, gated on
+// TPUCLIENT_SERVER_GRPC / TPUCLIENT_SERVER_HTTP (tests/test_native.py
+// launches tpu_serverd with both front-ends and sets both).
+#include <sys/mman.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "../library/grpc_client.h"
+#include "../library/http_client.h"
+#include "../library/shm_utils.h"
+#include "minitest.h"
+
+using namespace tpuclient;
+
+namespace {
+
+// Adapter: uniform Create + protocol tag for the typed cases.
+template <typename ClientT>
+struct Protocol;
+
+template <>
+struct Protocol<InferenceServerGrpcClient> {
+  static const char* EnvUrl() { return getenv("TPUCLIENT_SERVER_GRPC"); }
+  static Error Create(std::unique_ptr<InferenceServerGrpcClient>* client) {
+    return InferenceServerGrpcClient::Create(client, EnvUrl());
+  }
+  static constexpr bool kStreaming = true;
+};
+
+template <>
+struct Protocol<InferenceServerHttpClient> {
+  static const char* EnvUrl() { return getenv("TPUCLIENT_SERVER_HTTP"); }
+  static Error Create(std::unique_ptr<InferenceServerHttpClient>* client) {
+    return InferenceServerHttpClient::Create(client, EnvUrl());
+  }
+  static constexpr bool kStreaming = false;
+};
+
+std::unique_ptr<InferInput> MakeInt32Input(
+    const std::string& name, const std::vector<int64_t>& shape,
+    const std::vector<int32_t>& data) {
+  InferInput* raw = nullptr;
+  InferInput::Create(&raw, name, shape, "INT32");
+  raw->AppendRaw(reinterpret_cast<const uint8_t*>(data.data()),
+                 data.size() * sizeof(int32_t));
+  return std::unique_ptr<InferInput>(raw);
+}
+
+std::vector<int32_t> Iota(int n, int32_t start = 0) {
+  std::vector<int32_t> v(n);
+  for (int i = 0; i < n; ++i) v[i] = start + i;
+  return v;
+}
+
+void CheckInt32Output(InferResult* result, const std::string& name,
+                      const std::vector<int32_t>& expect) {
+  const uint8_t* buf = nullptr;
+  size_t byte_size = 0;
+  REQUIRE_OK(result->RawData(name, &buf, &byte_size));
+  REQUIRE(byte_size == expect.size() * sizeof(int32_t));
+  const int32_t* got = reinterpret_cast<const int32_t*>(buf);
+  for (size_t i = 0; i < expect.size(); ++i) CHECK_EQ(got[i], expect[i]);
+}
+
+// The conformance matrix ------------------------------------------------
+
+// cc_client_test.cc InferMulti variants: several requests with
+// DIFFERENT options/request ids in one call; results in order.
+template <typename ClientT>
+void CaseInferMulti() {
+  std::unique_ptr<ClientT> client;
+  REQUIRE_OK(Protocol<ClientT>::Create(&client));
+
+  constexpr int kRequests = 3;
+  std::vector<std::vector<int32_t>> data0, data1;
+  std::vector<std::unique_ptr<InferInput>> keep;
+  std::vector<std::vector<InferInput*>> inputs;
+  std::vector<InferOptions> options;
+  for (int r = 0; r < kRequests; ++r) {
+    data0.push_back(Iota(16, r));
+    data1.push_back(std::vector<int32_t>(16, r + 1));
+    auto in0 = MakeInt32Input("INPUT0", {16}, data0.back());
+    auto in1 = MakeInt32Input("INPUT1", {16}, data1.back());
+    inputs.push_back({in0.get(), in1.get()});
+    keep.push_back(std::move(in0));
+    keep.push_back(std::move(in1));
+    InferOptions option("simple");
+    option.request_id = "multi-" + std::to_string(r);
+    options.push_back(option);
+  }
+
+  std::vector<InferResult*> raw_results;
+  REQUIRE_OK(client->InferMulti(&raw_results, options, inputs));
+  REQUIRE(raw_results.size() == kRequests);
+  for (int r = 0; r < kRequests; ++r) {
+    std::unique_ptr<InferResult> result(raw_results[r]);
+    REQUIRE_OK(result->RequestStatus());
+    std::string id;
+    REQUIRE_OK(result->Id(&id));
+    CHECK_EQ(id, "multi-" + std::to_string(r));
+    std::vector<int32_t> sum(16), diff(16);
+    for (int i = 0; i < 16; ++i) {
+      sum[i] = data0[r][i] + data1[r][i];
+      diff[i] = data0[r][i] - data1[r][i];
+    }
+    CheckInt32Output(result.get(), "OUTPUT0", sum);
+    CheckInt32Output(result.get(), "OUTPUT1", diff);
+  }
+}
+
+// AsyncInferMulti: one callback with every result.
+template <typename ClientT>
+void CaseAsyncInferMulti() {
+  std::unique_ptr<ClientT> client;
+  REQUIRE_OK(Protocol<ClientT>::Create(&client));
+
+  constexpr int kRequests = 4;
+  std::vector<std::unique_ptr<InferInput>> keep;
+  std::vector<std::vector<InferInput*>> inputs;
+  std::vector<InferOptions> options;
+  auto base0 = Iota(16);
+  auto base1 = std::vector<int32_t>(16, 7);
+  for (int r = 0; r < kRequests; ++r) {
+    auto in0 = MakeInt32Input("INPUT0", {16}, base0);
+    auto in1 = MakeInt32Input("INPUT1", {16}, base1);
+    inputs.push_back({in0.get(), in1.get()});
+    keep.push_back(std::move(in0));
+    keep.push_back(std::move(in1));
+    options.push_back(InferOptions("simple"));
+  }
+
+  std::mutex mutex;
+  std::condition_variable cv;
+  bool done = false;
+  int ok = 0;
+  REQUIRE_OK(client->AsyncInferMulti(
+      [&](std::vector<InferResult*> results) {
+        int good = 0;
+        for (InferResult* raw : results) {
+          std::unique_ptr<InferResult> result(raw);
+          if (result->RequestStatus().IsOk()) {
+            const uint8_t* buf = nullptr;
+            size_t n = 0;
+            if (result->RawData("OUTPUT0", &buf, &n).IsOk() && n == 64) {
+              ++good;
+            }
+          }
+        }
+        std::lock_guard<std::mutex> lock(mutex);
+        ok = good;
+        done = true;
+        cv.notify_all();
+      },
+      options, inputs));
+  std::unique_lock<std::mutex> lock(mutex);
+  REQUIRE(cv.wait_for(lock, std::chrono::seconds(30), [&] { return done; }));
+  CHECK_EQ(ok, kRequests);
+}
+
+// BYTES tensors in and out (cc_client_test string-tensor variants).
+template <typename ClientT>
+void CaseStringTensors() {
+  std::unique_ptr<ClientT> client;
+  REQUIRE_OK(Protocol<ClientT>::Create(&client));
+
+  std::vector<std::string> values0, values1;
+  for (int i = 0; i < 16; ++i) {
+    values0.push_back(std::to_string(i));
+    values1.push_back(std::to_string(1));
+  }
+  InferInput* raw0 = nullptr;
+  InferInput::Create(&raw0, "INPUT0", {16}, "BYTES");
+  std::unique_ptr<InferInput> in0(raw0);
+  REQUIRE_OK(in0->AppendFromString(values0));
+  InferInput* raw1 = nullptr;
+  InferInput::Create(&raw1, "INPUT1", {16}, "BYTES");
+  std::unique_ptr<InferInput> in1(raw1);
+  REQUIRE_OK(in1->AppendFromString(values1));
+
+  InferResult* raw_result = nullptr;
+  REQUIRE_OK(client->Infer(&raw_result, InferOptions("simple_string"),
+                           {in0.get(), in1.get()}));
+  std::unique_ptr<InferResult> result(raw_result);
+  REQUIRE_OK(result->RequestStatus());
+  std::vector<std::string> sums;
+  REQUIRE_OK(result->StringData("OUTPUT0", &sums));
+  REQUIRE(sums.size() == 16);
+  for (int i = 0; i < 16; ++i) CHECK_EQ(sums[i], std::to_string(i + 1));
+  std::vector<std::string> diffs;
+  REQUIRE_OK(result->StringData("OUTPUT1", &diffs));
+  REQUIRE(diffs.size() == 16);
+  for (int i = 0; i < 16; ++i) CHECK_EQ(diffs[i], std::to_string(i - 1));
+}
+
+// System shared memory for inputs AND outputs: register, infer with
+// shm-backed tensors, read outputs from the region, status +
+// unregister (cc_client_test shm variants over both protocols).
+template <typename ClientT>
+void CaseSystemSharedMemory() {
+  std::unique_ptr<ClientT> client;
+  REQUIRE_OK(Protocol<ClientT>::Create(&client));
+
+  const std::string tag =
+      Protocol<ClientT>::kStreaming ? "grpc" : "http";
+  const std::string in_key = "/conf_in_" + tag;
+  const std::string out_key = "/conf_out_" + tag;
+  const size_t in_size = 2 * 16 * sizeof(int32_t);
+  const size_t out_size = 2 * 16 * sizeof(int32_t);
+
+  // Fresh regions (unlink leftovers from a crashed prior run).
+  UnlinkSharedMemoryRegion(in_key);
+  UnlinkSharedMemoryRegion(out_key);
+  int in_fd = -1, out_fd = -1;
+  REQUIRE_OK(CreateSharedMemoryRegion(in_key, in_size, &in_fd));
+  REQUIRE_OK(CreateSharedMemoryRegion(out_key, out_size, &out_fd));
+  void* in_ptr = nullptr;
+  void* out_ptr = nullptr;
+  REQUIRE_OK(MapSharedMemory(in_fd, 0, in_size, &in_ptr));
+  REQUIRE_OK(MapSharedMemory(out_fd, 0, out_size, &out_ptr));
+
+  auto data0 = Iota(16);
+  std::vector<int32_t> data1(16, 5);
+  memcpy(in_ptr, data0.data(), 16 * sizeof(int32_t));
+  memcpy(static_cast<uint8_t*>(in_ptr) + 16 * sizeof(int32_t),
+         data1.data(), 16 * sizeof(int32_t));
+
+  const std::string in_region = "conf_in_region_" + tag;
+  const std::string out_region = "conf_out_region_" + tag;
+  client->UnregisterSystemSharedMemory(in_region);
+  client->UnregisterSystemSharedMemory(out_region);
+  REQUIRE_OK(client->RegisterSystemSharedMemory(in_region, in_key, in_size));
+  REQUIRE_OK(
+      client->RegisterSystemSharedMemory(out_region, out_key, out_size));
+
+  InferInput* raw0 = nullptr;
+  InferInput::Create(&raw0, "INPUT0", {16}, "INT32");
+  std::unique_ptr<InferInput> in0(raw0);
+  REQUIRE_OK(in0->SetSharedMemory(in_region, 16 * sizeof(int32_t), 0));
+  InferInput* raw1 = nullptr;
+  InferInput::Create(&raw1, "INPUT1", {16}, "INT32");
+  std::unique_ptr<InferInput> in1(raw1);
+  REQUIRE_OK(
+      in1->SetSharedMemory(in_region, 16 * sizeof(int32_t),
+                           16 * sizeof(int32_t)));
+
+  InferRequestedOutput* rout0 = nullptr;
+  InferRequestedOutput::Create(&rout0, "OUTPUT0");
+  std::unique_ptr<InferRequestedOutput> out0(rout0);
+  REQUIRE_OK(out0->SetSharedMemory(out_region, 16 * sizeof(int32_t), 0));
+  InferRequestedOutput* rout1 = nullptr;
+  InferRequestedOutput::Create(&rout1, "OUTPUT1");
+  std::unique_ptr<InferRequestedOutput> out1(rout1);
+  REQUIRE_OK(out1->SetSharedMemory(out_region, 16 * sizeof(int32_t),
+                                   16 * sizeof(int32_t)));
+
+  InferResult* raw_result = nullptr;
+  REQUIRE_OK(client->Infer(&raw_result, InferOptions("simple"),
+                           {in0.get(), in1.get()},
+                           {out0.get(), out1.get()}));
+  std::unique_ptr<InferResult> result(raw_result);
+  REQUIRE_OK(result->RequestStatus());
+
+  const int32_t* sums = static_cast<const int32_t*>(out_ptr);
+  const int32_t* diffs = sums + 16;
+  for (int i = 0; i < 16; ++i) {
+    CHECK_EQ(sums[i], data0[i] + data1[i]);
+    CHECK_EQ(diffs[i], data0[i] - data1[i]);
+  }
+
+  REQUIRE_OK(client->UnregisterSystemSharedMemory(in_region));
+  REQUIRE_OK(client->UnregisterSystemSharedMemory(out_region));
+  UnmapSharedMemory(in_ptr, in_size);
+  UnmapSharedMemory(out_ptr, out_size);
+  CloseSharedMemory(in_fd);
+  CloseSharedMemory(out_fd);
+  UnlinkSharedMemoryRegion(in_key);
+  UnlinkSharedMemoryRegion(out_key);
+}
+
+// LoadModel with a config override, infer against the overridden
+// config, then unload (cc_client_test.cc:1202-1350 LoadWithConfig).
+template <typename ClientT>
+void CaseLoadWithOverride() {
+  std::unique_ptr<ClientT> client;
+  REQUIRE_OK(Protocol<ClientT>::Create(&client));
+
+  client->UnloadModel("add_sub_fp32");
+  // Override sticks a recognizable max_batch_size on the loaded copy.
+  REQUIRE_OK(client->LoadModel(
+      "add_sub_fp32", {}, "{\"max_batch_size\": 5}"));
+
+  bool ready = false;
+  REQUIRE_OK(client->IsModelReady(&ready, "add_sub_fp32"));
+  CHECK(ready);
+
+  std::vector<float> f0(16), f1(16);
+  for (int i = 0; i < 16; ++i) {
+    f0[i] = static_cast<float>(i);
+    f1[i] = 2.0f;
+  }
+  InferInput* raw0 = nullptr;
+  InferInput::Create(&raw0, "INPUT0", {16}, "FP32");
+  std::unique_ptr<InferInput> in0(raw0);
+  in0->AppendRaw(reinterpret_cast<const uint8_t*>(f0.data()),
+                 f0.size() * sizeof(float));
+  InferInput* raw1 = nullptr;
+  InferInput::Create(&raw1, "INPUT1", {16}, "FP32");
+  std::unique_ptr<InferInput> in1(raw1);
+  in1->AppendRaw(reinterpret_cast<const uint8_t*>(f1.data()),
+                 f1.size() * sizeof(float));
+
+  InferResult* raw_result = nullptr;
+  REQUIRE_OK(client->Infer(&raw_result, InferOptions("add_sub_fp32"),
+                           {in0.get(), in1.get()}));
+  std::unique_ptr<InferResult> result(raw_result);
+  REQUIRE_OK(result->RequestStatus());
+  const uint8_t* buf = nullptr;
+  size_t byte_size = 0;
+  REQUIRE_OK(result->RawData("OUTPUT0", &buf, &byte_size));
+  REQUIRE(byte_size == 16 * sizeof(float));
+  const float* sums = reinterpret_cast<const float*>(buf);
+  for (int i = 0; i < 16; ++i) CHECK_EQ(sums[i], f0[i] + f1[i]);
+
+  REQUIRE_OK(client->UnloadModel("add_sub_fp32"));
+  ready = true;
+  REQUIRE_OK(client->IsModelReady(&ready, "add_sub_fp32"));
+  CHECK(!ready);
+  // Restore for other cases/suites.
+  REQUIRE_OK(client->LoadModel("add_sub_fp32"));
+}
+
+// Client-side timeout: a 1 us deadline must surface as an error, and
+// the client must remain usable afterwards (client_timeout_test.cc).
+template <typename ClientT>
+void CaseClientTimeout() {
+  std::unique_ptr<ClientT> client;
+  REQUIRE_OK(Protocol<ClientT>::Create(&client));
+
+  auto data0 = Iota(16);
+  std::vector<int32_t> data1(16, 1);
+  auto in0 = MakeInt32Input("INPUT0", {16}, data0);
+  auto in1 = MakeInt32Input("INPUT1", {16}, data1);
+
+  InferOptions options("simple");
+  options.client_timeout_us = 1;  // unmeetable
+  InferResult* raw_result = nullptr;
+  Error err =
+      client->Infer(&raw_result, options, {in0.get(), in1.get()});
+  if (err.IsOk()) {
+    // Some transports report the deadline on the result instead.
+    std::unique_ptr<InferResult> result(raw_result);
+    CHECK(!result->RequestStatus().IsOk());
+  } else {
+    CHECK(!err.IsOk());
+  }
+
+  // The same client must still complete a normal request.
+  InferOptions ok_options("simple");
+  InferResult* ok_raw = nullptr;
+  REQUIRE_OK(client->Infer(&ok_raw, ok_options, {in0.get(), in1.get()}));
+  std::unique_ptr<InferResult> ok_result(ok_raw);
+  REQUIRE_OK(ok_result->RequestStatus());
+}
+
+// Unknown-model error mapping is identical across protocols.
+template <typename ClientT>
+void CaseUnknownModel() {
+  std::unique_ptr<ClientT> client;
+  REQUIRE_OK(Protocol<ClientT>::Create(&client));
+  auto data = Iota(16);
+  auto in0 = MakeInt32Input("INPUT0", {16}, data);
+  InferResult* raw_result = nullptr;
+  Error err = client->Infer(&raw_result, InferOptions("no_such_model"),
+                            {in0.get()});
+  if (err.IsOk()) {
+    // The HTTP client surfaces server-side errors on the result
+    // (parity: InferResultHttp::RequestStatus); gRPC fails the call.
+    REQUIRE(raw_result != nullptr);
+    std::unique_ptr<InferResult> result(raw_result);
+    CHECK(!result->RequestStatus().IsOk());
+  } else {
+    CHECK(!err.IsOk());
+  }
+}
+
+// Leak-iteration loop (memory_leak_test.cc): many create/infer/destroy
+// cycles; watches process RSS stays bounded rather than instrumenting
+// the allocator.
+template <typename ClientT>
+void CaseIterationLoop() {
+  auto rss_kb = [] {
+    FILE* f = fopen("/proc/self/status", "r");
+    long kb = 0;
+    if (f != nullptr) {
+      char line[256];
+      while (fgets(line, sizeof(line), f) != nullptr) {
+        if (strncmp(line, "VmRSS:", 6) == 0) {
+          kb = atol(line + 6);
+          break;
+        }
+      }
+      fclose(f);
+    }
+    return kb;
+  };
+
+  auto data0 = Iota(16);
+  std::vector<int32_t> data1(16, 3);
+  auto one_cycle = [&]() {
+    std::unique_ptr<ClientT> client;
+    REQUIRE_OK(Protocol<ClientT>::Create(&client));
+    for (int i = 0; i < 10; ++i) {
+      auto in0 = MakeInt32Input("INPUT0", {16}, data0);
+      auto in1 = MakeInt32Input("INPUT1", {16}, data1);
+      InferResult* raw = nullptr;
+      REQUIRE_OK(client->Infer(&raw, InferOptions("simple"),
+                               {in0.get(), in1.get()}));
+      std::unique_ptr<InferResult> result(raw);
+      REQUIRE_OK(result->RequestStatus());
+    }
+  };
+
+  for (int warm = 0; warm < 3; ++warm) one_cycle();  // settle allocator
+  long before = rss_kb();
+  for (int cycle = 0; cycle < 15; ++cycle) one_cycle();
+  long after = rss_kb();
+  // 150 inferences + 15 client setups should not grow RSS by more
+  // than a few MB; a per-request leak shows up far larger.
+  CHECK(after - before < 16 * 1024);
+}
+
+}  // namespace
+
+// minitest's TEST_CASE keys its registration symbols on __LINE__, so
+// one macro expanding to TWO cases would collide — register directly.
+#define CONFORMANCE_CASE(case_fn, label)                            \
+  static void run_grpc_##case_fn() {                                \
+    if (Protocol<InferenceServerGrpcClient>::EnvUrl() == nullptr)   \
+      return;                                                       \
+    case_fn<InferenceServerGrpcClient>();                           \
+  }                                                                 \
+  static minitest::Registrar reg_grpc_##case_fn(                    \
+      "conformance-grpc: " label, run_grpc_##case_fn);              \
+  static void run_http_##case_fn() {                                \
+    if (Protocol<InferenceServerHttpClient>::EnvUrl() == nullptr)   \
+      return;                                                       \
+    case_fn<InferenceServerHttpClient>();                           \
+  }                                                                 \
+  static minitest::Registrar reg_http_##case_fn(                    \
+      "conformance-http: " label, run_http_##case_fn);
+
+CONFORMANCE_CASE(CaseInferMulti, "InferMulti ordered results")
+CONFORMANCE_CASE(CaseAsyncInferMulti, "AsyncInferMulti one callback")
+CONFORMANCE_CASE(CaseStringTensors, "BYTES tensors round trip")
+CONFORMANCE_CASE(CaseSystemSharedMemory, "system shm inputs + outputs")
+CONFORMANCE_CASE(CaseLoadWithOverride, "load with config override")
+CONFORMANCE_CASE(CaseClientTimeout, "client timeout surfaces + recovers")
+CONFORMANCE_CASE(CaseUnknownModel, "unknown model error mapping")
+CONFORMANCE_CASE(CaseIterationLoop, "leak iteration loop bounded RSS")
+
+// Streaming is protocol-specific (the reference's streaming matrix is
+// gRPC-only too): decoupled bidi stream with per-request options.
+TEST_CASE("conformance-grpc: bidi streaming with request ids") {
+  if (Protocol<InferenceServerGrpcClient>::EnvUrl() == nullptr) return;
+  std::unique_ptr<InferenceServerGrpcClient> client;
+  REQUIRE_OK(Protocol<InferenceServerGrpcClient>::Create(&client));
+
+  std::mutex mutex;
+  std::condition_variable cv;
+  std::vector<std::string> ids;
+  int ok = 0;
+  REQUIRE_OK(client->StartStream([&](InferResult* raw) {
+    std::unique_ptr<InferResult> result(raw);
+    std::string id;
+    bool good = result->RequestStatus().IsOk() &&
+                result->Id(&id).IsOk();
+    std::lock_guard<std::mutex> lock(mutex);
+    ids.push_back(id);
+    if (good) ++ok;
+    cv.notify_all();
+  }));
+
+  auto data0 = Iota(16);
+  std::vector<int32_t> data1(16, 9);
+  constexpr int kRequests = 6;
+  for (int r = 0; r < kRequests; ++r) {
+    auto in0 = MakeInt32Input("INPUT0", {16}, data0);
+    auto in1 = MakeInt32Input("INPUT1", {16}, data1);
+    InferOptions options("simple");
+    options.request_id = "stream-" + std::to_string(r);
+    REQUIRE_OK(client->AsyncStreamInfer(options, {in0.get(), in1.get()}));
+  }
+  {
+    std::unique_lock<std::mutex> lock(mutex);
+    REQUIRE(cv.wait_for(lock, std::chrono::seconds(30), [&] {
+      return ids.size() == kRequests;
+    }));
+  }
+  CHECK_EQ(ok, kRequests);
+  // Per-request ids all came back (order may interleave).
+  for (int r = 0; r < kRequests; ++r) {
+    bool found = false;
+    for (const auto& id : ids) {
+      if (id == "stream-" + std::to_string(r)) found = true;
+    }
+    CHECK(found);
+  }
+  REQUIRE_OK(client->StopStream());
+}
+
+MINITEST_MAIN

@@ -63,7 +63,9 @@ TEST_CASE("perf: model parser recursive composing + bls") {
   Error err =
       ModelParser::Parse(h.backend.get(), "ensemble_top", "", 1, &model);
   CHECK(err.IsOk());
-  CHECK(model.scheduler_type == SchedulerType::ENSEMBLE);
+  // A sequence-batched composing model refines the kind to
+  // ENSEMBLE_SEQUENCE (reference model_parser.h:63).
+  CHECK(model.scheduler_type == SchedulerType::ENSEMBLE_SEQUENCE);
   REQUIRE(model.composing_models.size() == 2u);
   CHECK_EQ(model.composing_models[0], "ensemble_mid");
   CHECK_EQ(model.composing_models[1], "seq_leaf");

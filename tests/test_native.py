@@ -106,6 +106,58 @@ def test_native_grpc_integration(native_build, live_server):
     )
 
 
+@pytest.fixture(scope="module")
+def serverd_both(native_build):
+    """tpu_serverd with both native front-ends, for the C++
+    protocol-conformance suite (the typed dual-protocol matrix runs
+    against the native server, not the Python one)."""
+    import os
+
+    serverd = native_build / "tpu_serverd"
+    if not serverd.exists():
+        pytest.skip("tpu_serverd not built")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    proc = subprocess.Popen(
+        [str(serverd), "--port", "0", "--http-port", "0",
+         "--models", "simple,simple_string,add_sub_fp32"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        cwd=str(REPO), env=env,
+    )
+    try:
+        line = proc.stdout.readline().strip()
+        assert line.startswith("LISTENING "), line
+        http_line = proc.stdout.readline().strip()
+        assert http_line.startswith("LISTENING-HTTP "), http_line
+        yield {"grpc": "127.0.0.1:%s" % line.split()[1],
+               "http": "127.0.0.1:%s" % http_line.split()[1]}
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)
+
+
+def test_native_conformance_suite(native_build, serverd_both):
+    """The cc_client_test analogue: one typed matrix
+    (InferMulti/AsyncInferMulti, BYTES tensors, shm in/out, load with
+    config override, client timeout, leak loop, streaming) over BOTH
+    native protocol clients against tpu_serverd (parity: reference
+    src/c++/tests/cc_client_test.cc:42,300-1350)."""
+    _run_binary(
+        native_build, "test_conformance",
+        {"TPUCLIENT_SERVER_GRPC": serverd_both["grpc"],
+         "TPUCLIENT_SERVER_HTTP": serverd_both["http"]},
+    )
+
+
+def test_native_conformance_offline(native_build):
+    """Without server envs every case is a gated no-op — the binary
+    must still run clean (CI safety)."""
+    _run_binary(native_build, "test_conformance")
+
+
 def test_native_perf_analyzer_openai_e2e(native_build, tmp_path):
     """The native perf_analyzer's openai service-kind: SSE streaming
     against the server's /v1/chat/completions (parity: the reference
