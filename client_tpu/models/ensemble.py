@@ -152,15 +152,21 @@ class EnsembleModel(ServedModel):
 
                 start_ns = time.monotonic_ns()
                 if batcher is not None:
-                    step_outputs, _, leader = batcher.infer(
+                    step_outputs, queue_ns, leader = batcher.infer(
                         step_inputs, parameters or {}, count)
+                    # Triton books fused compute once, per execution:
+                    # only the leader records the (queue-corrected)
+                    # wall time; riders contribute their row count.
                     executions = 1 if leader else 0
+                    compute_ns = max(
+                        time.monotonic_ns() - start_ns - queue_ns, 0
+                    ) if leader else 0
                 else:
                     step_outputs = model.infer(step_inputs, parameters)
                     executions = 1
+                    compute_ns = time.monotonic_ns() - start_ns
                 self.stats_recorder(
-                    model_name, count, time.monotonic_ns() - start_ns,
-                    executions)
+                    model_name, count, compute_ns, executions)
             elif batcher is not None:
                 step_outputs, _, _ = batcher.infer(
                     step_inputs, parameters or {}, count)

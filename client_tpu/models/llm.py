@@ -614,7 +614,7 @@ class LlmModel(ServedModel):
             with self._prefill_exec_lock:
                 self._prefill_compiling.discard((b, bucket))
 
-    def _dispatch_joins(self, joins):
+    def _dispatch_joins(self, joins, gen: int):
         """Batched prefill for a set of (lane, request) joins: prompts
         sharing a padded bucket go through ONE prefill dispatch (batch
         padded to a power of two so XLA compiles per (B, bucket), not
@@ -666,14 +666,18 @@ class LlmModel(ServedModel):
                 firsts[:len(group)])
             fut = self._fetch_pool.submit(np.asarray, firsts)
             with self._sched_cv:
-                if self._sched_stop:
+                if self._sched_stop or self._gen != gen:
+                    # Unload or a concurrent _crash reset the pipeline.
                     # Fail the current group AND every not-yet-run
                     # group — they are all popped off _join_queue and
-                    # invisible to any other cleanup path.
+                    # invisible to any other cleanup path. After a
+                    # crash the lane list was already rebuilt, so only
+                    # re-add lanes while this generation is live.
                     for _, _, _, late_group in batches[batch_idx:]:
                         for lane, req in late_group:
                             req.fail("model unloaded")
-                            self._free_lanes.append(lane)
+                            if self._gen == gen:
+                                self._free_lanes.append(lane)
                     return
                 for row, (lane, req) in enumerate(group):
                     self._lane_pos[lane] = len(req.prompt)
@@ -708,7 +712,7 @@ class LlmModel(ServedModel):
                         joins.append((self._free_lanes.pop(0), req))
                 if joins:
                     try:
-                        self._dispatch_joins(joins)
+                        self._dispatch_joins(joins, gen)
                     except Exception as e:  # noqa: BLE001
                         # Popped requests are in neither _active nor
                         # _join_queue, so the crash handler cannot see
@@ -718,7 +722,8 @@ class LlmModel(ServedModel):
                             for lane2, req2 in joins:
                                 if self._active.get(lane2) is not req2:
                                     req2.fail("llm prefill failed: %s" % e)
-                                    if lane2 not in self._active:
+                                    if (self._gen == gen
+                                            and lane2 not in self._active):
                                         self._free_lanes.append(lane2)
                         raise
                     continue  # more joins may fit before the next chunk
@@ -733,6 +738,13 @@ class LlmModel(ServedModel):
                 self._tokens_dev = toks[-1]  # [lanes] device carry
                 fut = self._fetch_pool.submit(np.asarray, toks)
                 with self._sched_cv:
+                    if self._sched_stop or self._gen != gen:
+                        # A concurrent _crash/unload reset the pipeline
+                        # while this dispatch ran unlocked — registering
+                        # the record would hand the NEW generation a
+                        # stale (possibly failing) future and re-mark
+                        # rebuilt free lanes active.
+                        return
                     snapshot = dict(self._active)
                     for lane in snapshot:
                         self._lane_pos[lane] += self.STREAM_CHUNK

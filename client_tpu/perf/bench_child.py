@@ -650,11 +650,14 @@ def main() -> None:
                     % (stage_name, exc))
             fusion_names = ([model_name] if track_fusion else []) \
                 + list(fusion_composing)
-            counts_before = {name: fusion_stats(core, name)
-                             for name in fusion_names}
             attempts = 0
             while True:
                 attempts += 1
+                # Snapshot inside the loop: a failed attempt's partial
+                # traffic must not pollute the successful attempt's
+                # fusion evidence.
+                counts_before = {name: fusion_stats(core, name)
+                                 for name in fusion_names}
                 try:
                     tput, p50 = run_native(
                         binary, handle.address, model_name, batch,

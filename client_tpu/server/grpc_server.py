@@ -78,7 +78,11 @@ class InferenceServicer(GRPCInferenceServiceServicer):
         import queue as _queue
         from concurrent.futures import ThreadPoolExecutor
 
-        out: _queue.Queue = _queue.Queue()
+        # Bounded: the old sequential `yield from` backpressured
+        # through HTTP/2 flow control; with threaded dispatch a
+        # non-reading client must hit this cap (workers block in put)
+        # instead of growing server memory without bound.
+        out: _queue.Queue = _queue.Queue(maxsize=64)
         sentinel = object()
         # Set when the client goes away (gRPC closes this generator):
         # workers close their per-request generators so model-side
@@ -87,18 +91,26 @@ class InferenceServicer(GRPCInferenceServiceServicer):
         # dispatch.
         cancelled = threading.Event()
 
+        def put_out(item) -> bool:
+            while not cancelled.is_set():
+                try:
+                    out.put(item, timeout=0.5)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
+
         def run_one(request):
             generator = self._core.stream_infer(request)
             try:
                 for response in generator:
-                    if cancelled.is_set():
+                    if cancelled.is_set() or not put_out(response):
                         break
-                    out.put(response)
             except InferenceServerException as e:
                 # decoupled errors ride the stream, not abort it
-                out.put(pb.ModelStreamInferResponse(error_message=str(e)))
+                put_out(pb.ModelStreamInferResponse(error_message=str(e)))
             except Exception as e:  # noqa: BLE001 — never kill the stream
-                out.put(pb.ModelStreamInferResponse(
+                put_out(pb.ModelStreamInferResponse(
                     error_message="internal error: %s" % e))
             finally:
                 generator.close()
@@ -133,7 +145,7 @@ class InferenceServicer(GRPCInferenceServiceServicer):
                             pool.submit(run_one, request)
                     # with-block: waits for every in-flight request
             finally:
-                out.put(sentinel)
+                put_out(sentinel)  # no-op when the client is gone
 
         reader_thread = threading.Thread(target=reader, daemon=True,
                                          name="stream-infer-reader")

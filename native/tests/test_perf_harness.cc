@@ -177,6 +177,153 @@ TEST_CASE("perf: concurrency manager drives mock backend") {
   CHECK(GetMockBackendStats()->async_infer_calls.load() > 20);
 }
 
+TEST_CASE("perf: request-rate schedule adherence constant + poisson") {
+  // Parity: test_request_rate_manager.cc — a CONSTANT schedule's
+  // inter-send gaps are uniform, a POISSON schedule's are not, and
+  // both deliver approximately rate * duration requests.
+  auto run_mode = [](RequestRateManager::Distribution distribution) {
+    ResetMockBackendStats();
+    Harness h(200);
+    RequestRateManager manager(
+        &h.factory, &h.model, &h.loader, &h.data_manager,
+        LoadManager::Options{/*async=*/true, /*streaming=*/false,
+                             /*max_threads=*/4},
+        distribution);
+    REQUIRE_OK(manager.Init());
+    constexpr double kRate = 200.0;  // req/s
+    REQUIRE_OK(manager.ChangeRequestRate(kRate));
+    std::this_thread::sleep_for(std::chrono::milliseconds(600));
+    REQUIRE_OK(manager.CheckHealth());
+    manager.Stop();
+    auto records = manager.SwapRequestRecords();
+    // ~120 expected in 600ms; generous window for CI jitter.
+    CHECK(records.size() > 60);
+    CHECK(records.size() < 240);
+    // Inter-send gap dispersion separates the distributions.
+    std::vector<uint64_t> starts;
+    for (const auto& record : records) starts.push_back(record.start_ns);
+    std::sort(starts.begin(), starts.end());
+    std::vector<double> gaps_ms;
+    for (size_t i = 1; i < starts.size(); ++i) {
+      gaps_ms.push_back((starts[i] - starts[i - 1]) / 1e6);
+    }
+    double mean = 0;
+    for (double g : gaps_ms) mean += g;
+    mean /= gaps_ms.size();
+    double var = 0;
+    for (double g : gaps_ms) var += (g - mean) * (g - mean);
+    var /= gaps_ms.size();
+    // Coefficient of variation: ~0 for CONSTANT, ~1 for POISSON.
+    return std::sqrt(var) / mean;
+  };
+
+  double cv_constant =
+      run_mode(RequestRateManager::Distribution::CONSTANT);
+  double cv_poisson = run_mode(RequestRateManager::Distribution::POISSON);
+  CHECK(cv_constant < 0.5);
+  CHECK(cv_poisson > 0.5);
+  CHECK(cv_poisson > cv_constant);
+}
+
+TEST_CASE("perf: request-rate delayed accounting under overload") {
+  // A rate the mock's latency cannot sustain with the worker pool
+  // forces sends behind schedule; those records carry delayed=true
+  // (reference request_rate_worker delayed-request accounting).
+  ResetMockBackendStats();
+  Harness h(40 * 1000);  // 40 ms per request
+  RequestRateManager manager(
+      &h.factory, &h.model, &h.loader, &h.data_manager,
+      LoadManager::Options{/*async=*/false, /*streaming=*/false,
+                           /*max_threads=*/2});
+  REQUIRE_OK(manager.Init());
+  // 2 sync workers x 40 ms = ~50 req/s sustainable; ask for 500.
+  REQUIRE_OK(manager.ChangeRequestRate(500.0));
+  std::this_thread::sleep_for(std::chrono::milliseconds(600));
+  manager.Stop();
+  auto records = manager.SwapRequestRecords();
+  size_t delayed = 0;
+  for (const auto& record : records) {
+    if (record.delayed) ++delayed;
+  }
+  CHECK(records.size() > 5);
+  CHECK(delayed > 0);
+  CHECK(delayed >= records.size() / 2);  // overload: most sends late
+}
+
+TEST_CASE("perf: custom load manager replays interval file") {
+  // Parity: test_custom_load_manager.cc — explicit inter-request
+  // intervals from a file drive the schedule verbatim (cycled).
+  ResetMockBackendStats();
+  const char* path = "/tmp/tpuclient_test_intervals.txt";
+  {
+    std::ofstream f(path);
+    // microseconds per line: 4ms, 4ms, 12ms -> mean gap ~6.7ms
+    f << "4000\n4000\n12000\n";
+  }
+  Harness h(200);
+  CustomLoadManager manager(
+      &h.factory, &h.model, &h.loader, &h.data_manager,
+      LoadManager::Options{/*async=*/true, /*streaming=*/false,
+                           /*max_threads=*/2});
+  REQUIRE_OK(manager.Init());
+  std::vector<double> intervals;
+  REQUIRE_OK(CustomLoadManager::ReadIntervalsFile(path, &intervals));
+  REQUIRE(intervals.size() == 3u);
+  CHECK(intervals[2] > intervals[0]);
+  REQUIRE_OK(manager.StartSchedule(path));
+  std::this_thread::sleep_for(std::chrono::milliseconds(500));
+  manager.Stop();
+  auto records = manager.SwapRequestRecords();
+  // 20ms per 3-interval cycle -> ~150/s -> ~75 requests in 500ms.
+  CHECK(records.size() > 35);
+  CHECK(records.size() < 150);
+  std::vector<uint64_t> starts;
+  for (const auto& record : records) starts.push_back(record.start_ns);
+  std::sort(starts.begin(), starts.end());
+  // The long 12ms interval must be visible in the send pattern: at
+  // least a quarter of gaps >= 9ms while the median stays small.
+  size_t long_gaps = 0, all_gaps = 0;
+  for (size_t i = 1; i < starts.size(); ++i) {
+    double gap_ms = (starts[i] - starts[i - 1]) / 1e6;
+    ++all_gaps;
+    if (gap_ms >= 9.0) ++long_gaps;
+  }
+  CHECK(all_gaps > 0);
+  CHECK(long_gaps * 5 >= all_gaps);  // >= 20% of gaps are the long one
+}
+
+TEST_CASE("perf: periodic concurrency manager ramps by request period") {
+  // Parity: periodic_concurrency_manager.cc — concurrency climbs
+  // start -> end, advancing one step per request_period completed
+  // responses, and every level's records survive into the ramp drain.
+  ResetMockBackendStats();
+  Harness h(1000);  // 1 ms per request: levels turn over fast
+  PeriodicConcurrencyManager manager(
+      &h.factory, &h.model, &h.loader, &h.data_manager,
+      LoadManager::Options{/*async=*/true, /*streaming=*/false,
+                           /*max_threads=*/4});
+  REQUIRE_OK(manager.Init());
+  PeriodicConcurrencyManager::RampConfig config;
+  config.start = 1;
+  config.end = 4;
+  config.step = 1;
+  config.request_period = 8;
+  REQUIRE_OK(manager.RunRamp(config));
+  CHECK_EQ(manager.concurrency(), 4u);  // reached the top level
+  manager.Stop();
+  auto records = manager.SwapRampRecords();
+  // Each of the 3 intermediate levels collected >= request_period
+  // records before advancing, plus whatever the final level ran.
+  CHECK(records.size() >= 3 * config.request_period);
+  size_t valid = 0;
+  for (const auto& record : records) {
+    if (record.valid()) ++valid;
+  }
+  CHECK(valid >= 3 * config.request_period);
+  CHECK(GetMockBackendStats()->async_infer_calls.load() >=
+        3 * config.request_period);
+}
+
 TEST_CASE("perf: sync concurrency mode") {
   ResetMockBackendStats();
   Harness h(100);

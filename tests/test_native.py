@@ -277,6 +277,64 @@ def test_native_perf_analyzer_request_parameter_and_count(
     assert len(row.split(",")) == len(header.split(","))
 
 
+@pytest.mark.parametrize("distribution", ["constant", "poisson"])
+def test_native_perf_analyzer_request_rate_e2e(
+        native_build, live_server, distribution):
+    """--request-rate-range end to end in both distributions (parity:
+    the reference's request-rate mode runs)."""
+    binary = native_build / "perf_analyzer"
+    proc = subprocess.run(
+        [str(binary), "-m", "simple", "-u", live_server["grpc"],
+         "--request-rate-range", "100", "--async",
+         "--request-distribution", distribution,
+         "-p", "600", "-r", "2", "-s", "90"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "Request rate: 100" in proc.stdout, proc.stdout
+    assert "throughput" in proc.stdout
+
+
+def test_native_perf_analyzer_custom_intervals_e2e(
+        native_build, live_server, tmp_path):
+    """--request-intervals end to end: the measured request count
+    follows the replayed schedule (parity: CustomLoadManager)."""
+    binary = native_build / "perf_analyzer"
+    intervals = tmp_path / "intervals.txt"
+    intervals.write_text("5000\n5000\n10000\n")  # ~150 req/s cycle
+    proc = subprocess.run(
+        [str(binary), "-m", "simple", "-u", live_server["grpc"],
+         "--request-intervals", str(intervals), "--async",
+         "-p", "600", "-r", "2", "-s", "90"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "throughput" in proc.stdout
+
+
+def test_native_perf_analyzer_periodic_concurrency_e2e(
+        native_build, live_server, tmp_path):
+    """--periodic-concurrency-range ramp end to end with a profile
+    export covering the whole ramp (parity:
+    periodic_concurrency_manager.cc + its profile-export contract)."""
+    binary = native_build / "perf_analyzer"
+    export = tmp_path / "ramp_export.json"
+    proc = subprocess.run(
+        [str(binary), "-m", "simple", "-u", live_server["grpc"],
+         "--periodic-concurrency-range", "1:4:1",
+         "--request-period", "8", "--async",
+         "--profile-export-file", str(export)],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    import json
+
+    doc = json.loads(export.read_text())
+    requests = doc["experiments"][0]["requests"]
+    # Three intermediate levels x request_period, plus the top level.
+    assert len(requests) >= 24, len(requests)
+
+
 @pytest.mark.parametrize("mode", ["--async", "--sync"])
 @pytest.mark.parametrize("algorithm", ["gzip", "deflate"])
 def test_native_perf_analyzer_grpc_compression(
