@@ -28,12 +28,17 @@ class LLMMetrics:
         request_latency_ns: List[int],
         output_token_counts: List[int],
         benchmark_duration_s: float,
+        itl_sequences_ns: List[List[int]] = None,
     ):
         self.time_to_first_token_ns = time_to_first_token_ns
         self.inter_token_latency_ns = inter_token_latency_ns
         self.request_latency_ns = request_latency_ns
         self.output_token_counts = output_token_counts
         self.benchmark_duration_s = benchmark_duration_s
+        # Per-request gap sequences (token position preserved) — the
+        # token-position heatmap's input; the flat series above cannot
+        # reconstruct position.
+        self.itl_sequences_ns = itl_sequences_ns or []
 
     @property
     def request_throughput_per_s(self) -> float:
@@ -116,26 +121,33 @@ class LLMProfileDataParser:
     def get_metrics(self, experiment_index: int = 0) -> LLMMetrics:
         exp = self.experiments[experiment_index]
         requests = exp.get("requests", [])
-        ttft, itl, latency, token_counts = [], [], [], []
+        ttft, latency, token_counts = [], [], []
         min_start, max_end = None, None
+        itl_sequences = []
         for req in requests:
             start = req["timestamp"]
             responses = sorted(req.get("response_timestamps", []))
             if not responses:
                 continue
             ttft.append(responses[0] - start)
-            for a, b in zip(responses, responses[1:]):
-                itl.append(b - a)
+            gaps = [b - a for a, b in zip(responses, responses[1:])]
+            if gaps:
+                itl_sequences.append(gaps)
             latency.append(responses[-1] - start)
             token_counts.append(self._token_count(req, responses))
             min_start = start if min_start is None else min(min_start, start)
             max_end = (responses[-1] if max_end is None
                        else max(max_end, responses[-1]))
+        # The flat series is DERIVED from the sequences — one source
+        # of truth, so stats and the token-position heatmap can never
+        # disagree.
+        itl = [gap for seq in itl_sequences for gap in seq]
         duration_s = (
             (max_end - min_start) / NANOS
             if min_start is not None and max_end > min_start else 0.0
         )
-        return LLMMetrics(ttft, itl, latency, token_counts, duration_s)
+        return LLMMetrics(ttft, itl, latency, token_counts, duration_s,
+                          itl_sequences_ns=itl_sequences)
 
     def _token_count(self, req: dict, responses: List[int]) -> int:
         texts = req.get("response_texts")

@@ -76,4 +76,58 @@ def generate_plots(stats_list: List[Statistics], artifact_dir: str,
         ax.legend()
     save(fig, "request_latency.png")
 
+    # 4. Token-position heatmap: requests (rows) x token position
+    # (cols), colored by inter-token gap — makes chunked-delivery
+    # stalls visible as vertical bands (parity: genai-perf's token
+    # position vs latency heatmap).
+    import numpy as np
+
+    sequences = []
+    for stats in stats_list:
+        sequences.extend(
+            [g / 1e6 for g in seq]
+            for seq in getattr(stats.metrics, "itl_sequences_ns", [])
+        )
+    if sequences:
+        width = max(len(seq) for seq in sequences)
+        grid = np.full((len(sequences), width), np.nan)
+        for row, seq in enumerate(sequences):
+            grid[row, :len(seq)] = seq
+        fig, ax = plt.subplots(figsize=(8, 4.5))
+        image = ax.imshow(grid, aspect="auto", interpolation="nearest",
+                          cmap="viridis")
+        fig.colorbar(image, ax=ax, label="inter-token latency (ms)")
+        ax.set_xlabel("token position")
+        ax.set_ylabel("request")
+        ax.set_title(title or "Inter-token latency by token position")
+        save(fig, "token_position_heatmap.png")
+
+    # 5. Per-experiment comparison: throughputs and latency summary
+    # side by side (parity: genai-perf's cross-experiment comparison
+    # plots for concurrency sweeps).
+    fig, axes = plt.subplots(1, 3, figsize=(12, 4))
+    labels = ["exp %d" % i for i in range(len(stats_list))]
+    x = np.arange(len(stats_list))
+    axes[0].bar(x, [s.metrics.request_throughput_per_s
+                    for s in stats_list])
+    axes[0].set_title("request throughput (/s)")
+    axes[1].bar(x, [s.metrics.output_token_throughput_per_s
+                    for s in stats_list])
+    axes[1].set_title("token throughput (/s)")
+    ttft_p50, ttft_p99 = [], []
+    for stats in stats_list:
+        entry = stats.stats.get("time_to_first_token_ms", {})
+        ttft_p50.append(entry.get("p50", 0.0))
+        ttft_p99.append(entry.get("p99", 0.0))
+    bar_width = 0.4
+    axes[2].bar(x - bar_width / 2, ttft_p50, bar_width, label="p50")
+    axes[2].bar(x + bar_width / 2, ttft_p99, bar_width, label="p99")
+    axes[2].set_title("TTFT (ms)")
+    axes[2].legend()
+    for ax in axes:
+        ax.set_xticks(x)
+        ax.set_xticklabels(labels)
+    fig.suptitle(title or "Experiment comparison")
+    save(fig, "experiment_comparison.png")
+
     return written

@@ -170,6 +170,46 @@ class InferenceServerClient(InferenceServerClientBase):
             headers, client_timeout,
         )
 
+    # -- trace / log settings --------------------------------------------
+
+    async def update_trace_settings(self, model_name="", settings=None,
+                                    headers=None, client_timeout=None):
+        """Asyncio mirror of the sync client's trace-settings update
+        (parity: reference grpc/aio/__init__.py update_trace_settings)."""
+        request = pb.TraceSettingRequest(model_name=model_name or "")
+        for key, value in (settings or {}).items():
+            if value is None:
+                request.settings[key]  # noqa: B018 — clears the setting
+            elif isinstance(value, (list, tuple)):
+                request.settings[key].value.extend(str(v) for v in value)
+            else:
+                request.settings[key].value.append(str(value))
+        return await self._call(self._client_stub.TraceSetting, request,
+                                headers, client_timeout)
+
+    async def get_trace_settings(self, model_name="", headers=None,
+                                 client_timeout=None):
+        return await self.update_trace_settings(
+            model_name=model_name, settings={}, headers=headers,
+            client_timeout=client_timeout)
+
+    async def update_log_settings(self, settings, headers=None,
+                                  client_timeout=None):
+        request = pb.LogSettingsRequest()
+        for key, value in (settings or {}).items():
+            if isinstance(value, bool):
+                request.settings[key].bool_param = value
+            elif isinstance(value, int):
+                request.settings[key].uint32_param = value
+            else:
+                request.settings[key].string_param = str(value)
+        return await self._call(self._client_stub.LogSettings, request,
+                                headers, client_timeout)
+
+    async def get_log_settings(self, headers=None, client_timeout=None):
+        return await self.update_log_settings(
+            {}, headers=headers, client_timeout=client_timeout)
+
     # -- shared memory ---------------------------------------------------
 
     async def get_system_shared_memory_status(self, region_name="",

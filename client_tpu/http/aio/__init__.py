@@ -123,6 +123,26 @@ class InferenceServerClient(InferenceServerClientBase):
             ep.stats_path(model_name, model_version), headers
         )
 
+    # -- trace / log settings --------------------------------------------
+
+    async def update_trace_settings(self, model_name="", settings=None,
+                                    headers=None) -> dict:
+        """Asyncio mirror of the sync client's trace-settings verbs."""
+        return await self._get_json(
+            ep.trace_path(model_name), headers, method="POST",
+            body=json.dumps(settings or {}).encode())
+
+    async def get_trace_settings(self, model_name="", headers=None) -> dict:
+        return await self._get_json(ep.trace_path(model_name), headers)
+
+    async def update_log_settings(self, settings, headers=None) -> dict:
+        return await self._get_json(
+            ep.logging_path(), headers, method="POST",
+            body=json.dumps(settings or {}).encode())
+
+    async def get_log_settings(self, headers=None) -> dict:
+        return await self._get_json(ep.logging_path(), headers)
+
     # -- shared memory ---------------------------------------------------
 
     async def get_system_shared_memory_status(self, region_name="",

@@ -72,11 +72,18 @@ class _Region:
 class TpuArena:
     """Named HBM slots on the arena's devices."""
 
-    def __init__(self, platform: Optional[str] = None):
+    def __init__(self, platform: Optional[str] = None, devices=None):
         import jax
 
         self._jax = jax
-        if platform:
+        if devices is not None:
+            # Host-local subset: in a multi-host deployment each
+            # host's serving process pins its arena to ITS devices, so
+            # arena traffic rides ICI only — cross-host tensor
+            # movement goes through the documented DCN pull path
+            # (docs/cross_host_arena.md), never through the arena.
+            self._devices = list(devices)
+        elif platform:
             self._devices = jax.devices(platform)
         else:
             self._devices = jax.devices()

@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""gRPC-only image classification client: metadata-driven
+preprocessing, batching, sync or callback-async submission.
+
+Start a server first:  python -m client_tpu.server.app --models resnet50
+Then:  python examples/grpc_image_client.py -m resnet50 -b 4 [image...]
+With no image argument a synthetic batch is generated (the served
+ResNet's weights are random anyway).
+
+(parity example: reference src/python/examples/grpc_image_client.py —
+the gRPC-specific image pipeline; the protocol-generic variant lives
+in image_client.py.)
+"""
+
+import argparse
+import os
+import sys
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import client_tpu.grpc as grpcclient
+from client_tpu.utils import triton_to_np_dtype
+
+from image_client import load_images, parse_model  # shared helpers
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("image", nargs="*",
+                        help="image file(s) or folder(s); empty = synthetic")
+    parser.add_argument("-m", "--model-name", default="resnet50")
+    parser.add_argument("-x", "--model-version", default="")
+    parser.add_argument("-b", "--batch-size", type=int, default=1)
+    parser.add_argument("-c", "--classes", type=int, default=0)
+    parser.add_argument("-s", "--scaling", default="NONE",
+                        choices=["NONE", "INCEPTION", "VGG"])
+    parser.add_argument("-a", "--async-mode", action="store_true",
+                        help="submit via callback async_infer")
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+
+    with grpcclient.InferenceServerClient(args.url) as client:
+        metadata = client.get_model_metadata(
+            args.model_name, args.model_version)
+        config = client.get_model_config(args.model_name, args.model_version)
+        (input_name, output_name, h, w, c, datatype, max_batch) = parse_model(
+            {
+                "inputs": [{"name": t.name, "datatype": t.datatype,
+                            "shape": list(t.shape)} for t in metadata.inputs],
+                "outputs": [{"name": t.name, "datatype": t.datatype,
+                             "shape": list(t.shape)} for t in metadata.outputs],
+            },
+            {"max_batch_size": config.config.max_batch_size},
+        )
+        batch = max(args.batch_size, 1)
+        if max_batch == 0 and batch > 1:
+            raise SystemExit("model does not support batching")
+        arrays, names = load_images(
+            args.image, h, w, c, datatype, args.scaling, batch)
+        arrays = arrays[:batch]
+        names = names[:batch]
+
+        data = np.stack(arrays).astype(triton_to_np_dtype(datatype))
+        shape = list(data.shape) if max_batch > 0 else list(data.shape[1:])
+        if max_batch == 0:
+            data = data[0]
+        inputs = [grpcclient.InferInput(input_name, shape, datatype)]
+        inputs[0].set_data_from_numpy(data)
+        outputs = [grpcclient.InferRequestedOutput(
+            output_name, class_count=args.classes)]
+
+        def report(result):
+            output = np.asarray(result.as_numpy(output_name))
+            if max_batch == 0:
+                output = output[None]
+            for row, name in zip(output, names):
+                if args.classes:
+                    entries = [
+                        e.decode() if isinstance(e, bytes) else str(e)
+                        for e in np.asarray(row).reshape(-1)
+                    ]
+                    print("Image '%s': %s" % (name, ", ".join(entries)))
+                else:
+                    print("Image '%s': argmax %d" % (name, int(row.argmax())))
+
+        if args.async_mode:
+            import queue
+
+            done: queue.Queue = queue.Queue()
+
+            def callback(done_queue, result, error):
+                done_queue.put((result, error))
+
+            client.async_infer(args.model_name, inputs,
+                               partial(callback, done),
+                               model_version=args.model_version,
+                               outputs=outputs)
+            result, error = done.get(timeout=60)
+            if error is not None:
+                raise error
+            report(result)
+        else:
+            report(client.infer(args.model_name, inputs,
+                                model_version=args.model_version,
+                                outputs=outputs))
+    print("PASS: grpc image client (%s mode)"
+          % ("async" if args.async_mode else "sync"))
+
+
+if __name__ == "__main__":
+    main()

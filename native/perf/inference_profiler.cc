@@ -7,6 +7,8 @@
 #include <cstdlib>
 #include <thread>
 
+#include "mpi_utils.h"
+
 namespace tpuclient {
 namespace perf {
 
@@ -171,7 +173,9 @@ Error InferenceProfiler::ProfileConcurrencyRange(
     if (!err.IsOk()) return err;
     status.concurrency = concurrency;
     results->push_back(std::move(status));
-    if (ExceedsLatencyThreshold(results->back())) break;
+    // Any rank over the threshold stops EVERY rank: a local break
+    // would desequence the per-trial collectives of the next level.
+    if (AnyRank(ExceedsLatencyThreshold(results->back()))) break;
     if (end == 0) break;
     concurrency += step;
   }
@@ -196,7 +200,9 @@ Error InferenceProfiler::ProfileConcurrencyBinarySearch(
     err = ProfileLevel(&status);
     if (!err.IsOk()) return err;
     status.concurrency = mid;
-    bool over = ExceedsLatencyThreshold(status);
+    // Rank-merged: every rank must take the SAME branch or their
+    // subsequent collective sequences diverge.
+    bool over = AnyRank(ExceedsLatencyThreshold(status));
     results->push_back(std::move(status));
     if (verbose_) {
       fprintf(stderr, "binary search: concurrency %zu %s threshold\n",
@@ -237,7 +243,7 @@ Error InferenceProfiler::ProfileRequestRateRange(
     if (!err.IsOk()) return err;
     status.request_rate = rate;
     results->push_back(std::move(status));
-    if (ExceedsLatencyThreshold(results->back())) break;
+    if (AnyRank(ExceedsLatencyThreshold(results->back()))) break;
     if (end == 0) break;
     rate += step;
   }
@@ -247,6 +253,20 @@ Error InferenceProfiler::ProfileRequestRateRange(
 
 Error InferenceProfiler::ProfileSingleLevel(PerfStatus* status) {
   return ProfileLevel(status);
+}
+
+bool InferenceProfiler::AllRanks(bool local) const {
+  // AND across ranks; identity when not under MPI. EVERY rank-local
+  // control-flow decision that gates a collective (another trial's
+  // allreduce, the next level's measurement) must flow through this
+  // or AnyRank — a rank-local break would leave peers blocked in a
+  // collective this rank never enters.
+  if (mpi_ == nullptr) return local;
+  return mpi_->MPIAllTrue(local);
+}
+
+bool InferenceProfiler::AnyRank(bool local) const {
+  return !AllRanks(!local);
 }
 
 bool InferenceProfiler::ExceedsLatencyThreshold(
@@ -268,9 +288,14 @@ Error InferenceProfiler::ProfileLevel(PerfStatus* merged) {
   for (size_t trial = 0; trial < config_.max_trials; ++trial) {
     PerfStatus status;
     Error err = Measure(&status);
-    if (!err.IsOk()) return err;
-    err = manager_->CheckHealth();
-    if (!err.IsOk()) return err;
+    if (err.IsOk()) err = manager_->CheckHealth();
+    // Merge the per-trial outcome BEFORE any early return: a rank
+    // returning on a local error while peers enter the stability
+    // allreduce would deadlock the world.
+    if (!AllRanks(err.IsOk())) {
+      return err.IsOk() ? Error("a peer rank failed its measurement")
+                        : err;
+    }
     if (verbose_) {
       fprintf(stderr, "  trial %zu: %.1f infer/sec, avg %.0f us\n", trial,
               status.throughput, status.avg_latency_us);
@@ -291,7 +316,11 @@ Error InferenceProfiler::ProfileLevel(PerfStatus* merged) {
       *merged = Merge(std::move(trials));
       return Error::Success;
     }
-    if (IsStable(trials)) {
+    // Rank-merged decision: no rank stops measuring until EVERY
+    // rank's last trials agree, so all processes report windows
+    // covering the same load interval.
+    bool stable = AllRanks(IsStable(trials));
+    if (stable) {
       std::vector<PerfStatus> last3(
           std::make_move_iterator(trials.end() - 3),
           std::make_move_iterator(trials.end()));

@@ -70,6 +70,8 @@ struct MeasurementConfig {
   size_t log_frequency = 0;
 };
 
+class MPIDriver;
+
 class InferenceProfiler {
  public:
   InferenceProfiler(
@@ -83,6 +85,18 @@ class InferenceProfiler {
         metrics_(metrics), next_log_at_(config.log_frequency) {
     if (metrics_ != nullptr) metrics_->Start();
   }
+
+  // Multi-rank runs: the stability decision is merged across ranks
+  // (logical AND), so every analyzer process keeps measuring until
+  // ALL of them are stable (parity: mpi_utils.h:32-80 — the
+  // reference AllGathers per-rank stability and loops until
+  // unanimous).
+  void set_mpi(MPIDriver* mpi) { mpi_ = mpi; }
+
+  // Rank-merged decisions (identity without MPI): every control-flow
+  // branch that gates a collective must agree across ranks.
+  bool AllRanks(bool local) const;
+  bool AnyRank(bool local) const;
 
   // Concurrency sweep: [start, end] by step; end==0 profiles only
   // `start`. Stops early when the latency threshold is exceeded.
@@ -126,6 +140,7 @@ class InferenceProfiler {
   std::vector<std::string> composing_models_;
   bool verbose_;
   MetricsManager* metrics_;
+  MPIDriver* mpi_ = nullptr;
   // --log-frequency progress accounting.
   size_t completed_total_ = 0;
   size_t next_log_at_ = 0;

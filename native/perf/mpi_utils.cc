@@ -2,20 +2,36 @@
 
 #include <dlfcn.h>
 
+#include <cstdint>
 #include <cstdlib>
 
 namespace tpuclient {
 namespace perf {
 
+namespace {
+
+// MPICH-ABI handle constants. MPICH (and its ABI family: Intel MPI,
+// MVAPICH2, Cray MPT) encodes MPI handles as fixed 32-bit integers
+// baked into mpi.h — stable across releases as part of the common
+// MPICH ABI — and passes them BY VALUE. Passing the constant through
+// a pointer-typed parameter is well-defined on the SysV ABI (both
+// travel in the same register); the library reads it back as an int.
+constexpr uintptr_t kMpichCommWorld = 0x44000000u;
+constexpr uintptr_t kMpichTypeInt = 0x4c000405u;
+constexpr uintptr_t kMpichOpLand = 0x58000005u;
+
+}  // namespace
+
 MPIDriver::MPIDriver(bool is_enabled) {
   if (!is_enabled) return;
-  // Only OpenMPI exposes its communicator/type/op constants as
-  // symbols we can resolve dynamically (ompi_*); MPICH encodes them
-  // as integer constants baked in at compile time, which a pure
-  // dlopen binding cannot obtain portably.
-  handle_ = dlopen("libmpi.so", RTLD_NOW | RTLD_GLOBAL);
-  if (handle_ == nullptr) {
-    handle_ = dlopen("libmpi.so.40", RTLD_NOW | RTLD_GLOBAL);
+  // OpenMPI exposes its communicator/type/op constants as dynamic
+  // symbols (ompi_*); the MPICH family bakes them in as integer
+  // constants (fallback below).
+  for (const char* name :
+       {"libmpi.so", "libmpi.so.40", "libmpi.so.12", "libmpich.so",
+        "libmpich.so.12"}) {
+    handle_ = dlopen(name, RTLD_NOW | RTLD_GLOBAL);
+    if (handle_ != nullptr) break;
   }
   if (handle_ == nullptr) return;
   init_ = reinterpret_cast<int (*)(int*, char***)>(
@@ -32,14 +48,29 @@ MPIDriver::MPIDriver(bool is_enabled) {
   comm_world_ = dlsym(handle_, "ompi_mpi_comm_world");
   type_int_ = dlsym(handle_, "ompi_mpi_int");
   op_land_ = dlsym(handle_, "ompi_mpi_op_land");
-  // Active only when everything resolved AND launched under mpirun.
+  if (comm_world_ == nullptr && init_ != nullptr) {
+    // No OpenMPI handle symbols but MPI entry points resolved: assume
+    // the MPICH ABI family (MPICH, Intel MPI, MVAPICH2, Cray MPT all
+    // share these integer-constant handles; none exports a reliable
+    // family-identifying symbol to key on, and a non-MPICH-ABI
+    // library would also be gated off by the launcher-env check
+    // below).
+    comm_world_ = reinterpret_cast<void*>(kMpichCommWorld);
+    type_int_ = reinterpret_cast<void*>(kMpichTypeInt);
+    op_land_ = reinterpret_cast<void*>(kMpichOpLand);
+  }
+  // Active only when everything resolved AND launched under a real
+  // launcher (mpirun/mpiexec set these; a singleton would need the
+  // runtime daemons this image does not ship).
   active_ = init_ != nullptr && finalize_ != nullptr &&
             barrier_ != nullptr && comm_size_ != nullptr &&
             comm_rank_ != nullptr && allreduce_ != nullptr &&
             comm_world_ != nullptr && type_int_ != nullptr &&
             op_land_ != nullptr &&
             (getenv("OMPI_COMM_WORLD_SIZE") != nullptr ||
-             getenv("PMI_SIZE") != nullptr);
+             getenv("PMI_SIZE") != nullptr ||
+             getenv("PMI_RANK") != nullptr ||
+             getenv("HYDRA_CONTROL_FD") != nullptr);
 }
 
 MPIDriver::~MPIDriver() {

@@ -289,12 +289,18 @@ int Run(int argc, char** argv) {
   LoadMode mode = LoadMode::CONCURRENCY;
   std::unique_ptr<LoadManager> manager;
 
+  // Multi-client scale-out (reference --enable-mpi): ranks start and
+  // stop together, and the profiler merges the stability decision so
+  // every rank measures the same window.
+  MPIDriver mpi(params.enable_mpi);
+
   auto profile = [&](LoadManager* m) -> Error {
     Error init_err = m->Init();
     if (!init_err.IsOk()) return init_err;
     InferenceProfiler profiler(
         m, config, setup_backend.get(), model.name, params.verbose,
         metrics.get(), model.composing_models);
+    if (params.enable_mpi && mpi.IsMPIRun()) profiler.set_mpi(&mpi);
     if (params.has_request_rate_range) {
       mode = LoadMode::REQUEST_RATE;
       return profiler.ProfileRequestRateRange(
@@ -366,9 +372,6 @@ int Run(int argc, char** argv) {
         sequence_manager.get());
   }
 
-  // Multi-client scale-out: rank-synchronized start/stop so every
-  // MPI process measures the same window (reference --enable-mpi).
-  MPIDriver mpi(params.enable_mpi);
   if (params.enable_mpi) {
     mpi.MPIInit();
     mpi.MPIBarrierWorld();

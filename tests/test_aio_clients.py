@@ -57,6 +57,81 @@ def test_grpc_aio_basic(servers):
     asyncio.run(run())
 
 
+def test_grpc_aio_full_endpoint_surface(servers, tmp_path):
+    """The aio client's tail endpoints match the sync client: trace +
+    log settings, statistics, repository index, model control, shm
+    status verbs (parity: reference grpc/aio/__init__.py:50 mirrors
+    the full method set)."""
+    grpc_handle, _ = servers
+
+    async def run():
+        async with grpcclient_aio.InferenceServerClient(
+            grpc_handle.address
+        ) as client:
+            # statistics + repository control
+            stats = await client.get_inference_statistics("simple")
+            assert stats.model_stats[0].name == "simple"
+            index = await client.get_model_repository_index()
+            assert any(m.name == "simple" for m in index.models)
+            await client.load_model("add_sub_fp32")
+            assert await client.is_model_ready("add_sub_fp32")
+            await client.unload_model("add_sub_fp32")
+            assert not await client.is_model_ready("add_sub_fp32")
+            # trace settings round trip
+            trace_file = str(tmp_path / "aio_trace.jsonl")
+            updated = await client.update_trace_settings(
+                "simple", {"trace_level": ["TIMESTAMPS"],
+                           "trace_file": trace_file, "trace_rate": 1})
+            assert updated.settings["trace_file"].value[0] == trace_file
+            fetched = await client.get_trace_settings("simple")
+            assert fetched.settings["trace_level"].value[0] == "TIMESTAMPS"
+            await client.update_trace_settings(
+                "simple", {"trace_level": ["OFF"]})
+            # log settings round trip
+            logs = await client.update_log_settings({"log_verbose_level": 1})
+            assert logs.settings["log_verbose_level"].uint32_param == 1
+            logs = await client.get_log_settings()
+            assert "log_verbose_level" in logs.settings
+            # shm status verbs (empty is fine — the verb must answer)
+            status = await client.get_system_shared_memory_status()
+            assert status is not None
+            tpu_status = await client.get_tpu_shared_memory_status()
+            assert tpu_status is not None
+
+    asyncio.run(run())
+
+
+def test_http_aio_full_endpoint_surface(servers, tmp_path):
+    """http.aio's tail endpoints: trace/log settings + statistics +
+    model control reach the sync client's surface."""
+    _, http_runner = servers
+
+    async def run():
+        url = "127.0.0.1:%d" % http_runner.port
+        async with httpclient_aio.InferenceServerClient(url) as client:
+            stats = await client.get_inference_statistics("simple")
+            assert stats["model_stats"][0]["name"] == "simple"
+            await client.load_model("add_sub_fp32")
+            assert await client.is_model_ready("add_sub_fp32")
+            await client.unload_model("add_sub_fp32")
+            trace_file = str(tmp_path / "aio_http_trace.jsonl")
+            updated = await client.update_trace_settings(
+                "simple", {"trace_level": ["TIMESTAMPS"],
+                           "trace_file": trace_file})
+            assert updated["trace_file"] in (trace_file, [trace_file])
+            fetched = await client.get_trace_settings("simple")
+            assert fetched["trace_level"] in ("TIMESTAMPS", ["TIMESTAMPS"])
+            await client.update_trace_settings(
+                "simple", {"trace_level": ["OFF"]})
+            logs = await client.update_log_settings(
+                {"log_verbose_level": 2})
+            assert logs["log_verbose_level"] == 2
+            logs = await client.get_log_settings()
+            assert "log_verbose_level" in logs
+
+    asyncio.run(run())
+
+
 def test_grpc_aio_concurrent_infer(servers):
     grpc_handle, _ = servers
 

@@ -30,6 +30,8 @@ GRPC_EXAMPLES = [
     "simple_grpc_model_control_client.py",
     "simple_grpc_aio_infer_client.py",
     "decoupled_grpc_stream_infer_client.py",
+    "grpc_client.py",
+    "grpc_image_client.py",
 ]
 
 HTTP_EXAMPLES = [
@@ -102,6 +104,8 @@ CPP_GRPC_EXAMPLES = [
     "simple_grpc_keepalive_client",
     "simple_grpc_custom_repeat_client",
     "simple_grpc_sequence_stream_client",
+    "simple_grpc_custom_args_client",
+    "ensemble_image_client",
     "image_client",
 ]
 

@@ -205,9 +205,30 @@ def test_generate_plots(tmp_path):
     from client_tpu.genai.plots import generate_plots
 
     written = generate_plots([stats], str(tmp_path), title="t")
-    assert len(written) == 3
+    names = {os.path.basename(p) for p in written}
+    assert names == {
+        "time_to_first_token.png", "inter_token_latency.png",
+        "request_latency.png", "token_position_heatmap.png",
+        "experiment_comparison.png",
+    }
     for path in written:
         assert os.path.getsize(path) > 1000  # a real PNG, not a stub
+
+
+def test_generate_plots_multi_experiment_comparison(tmp_path):
+    """Two experiments render the comparison + heatmap set (parity:
+    genai-perf's cross-experiment plot suite)."""
+    doc = _export_doc()
+    doc["experiments"].append(doc["experiments"][0])
+    parser = LLMProfileDataParser(document=doc,
+                                  tokenizer=get_tokenizer("byte"))
+    from client_tpu.genai.plots import generate_plots
+
+    stats = [parser.get_statistics(0), parser.get_statistics(1)]
+    written = generate_plots(stats, str(tmp_path), title="sweep")
+    names = {os.path.basename(p) for p in written}
+    assert "experiment_comparison.png" in names
+    assert "token_position_heatmap.png" in names
 
 
 def test_dataset_prompts_fetch_and_fallback():

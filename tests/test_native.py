@@ -277,6 +277,45 @@ def test_native_perf_analyzer_request_parameter_and_count(
     assert len(row.split(",")) == len(header.split(","))
 
 
+def test_native_perf_analyzer_mpi_degrades_without_launcher(
+        native_build, live_server):
+    """--enable-mpi outside mpirun must degrade to a clean single-rank
+    run (the dlopen'd driver stays inactive without launcher env)."""
+    binary = native_build / "perf_analyzer"
+    proc = subprocess.run(
+        [str(binary), "-m", "simple", "-u", live_server["grpc"],
+         "--enable-mpi", "--concurrency-range", "2", "--async",
+         "-p", "300", "-r", "2", "-s", "90"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "throughput" in proc.stdout
+
+
+def test_native_perf_analyzer_mpi_two_ranks(native_build, live_server):
+    """Two analyzer ranks under mpirun barrier together and agree on
+    stability (rank-merged decision). Skips when the image has no MPI
+    launcher (this one ships only the OpenMPI runtime library)."""
+    mpirun = shutil.which("mpirun") or shutil.which("mpiexec")
+    if mpirun is None:
+        pytest.skip("no MPI launcher (mpirun/mpiexec) on this image")
+    version = subprocess.run([mpirun, "--version"], capture_output=True,
+                             text=True).stdout
+    # --allow-run-as-root is OpenMPI-only; MPICH's Hydra rejects it.
+    root_flags = ["--allow-run-as-root"] if "Open MPI" in version else []
+    binary = native_build / "perf_analyzer"
+    proc = subprocess.run(
+        [mpirun, "-n", "2", *root_flags,
+         str(binary), "-m", "simple", "-u", live_server["grpc"],
+         "--enable-mpi", "--concurrency-range", "2", "--async",
+         "-p", "400", "-r", "3", "-s", "50"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    # Both ranks print a report once every rank's windows stabilize.
+    assert proc.stdout.count("throughput") >= 2, proc.stdout
+
+
 @pytest.mark.parametrize("distribution", ["constant", "poisson"])
 def test_native_perf_analyzer_request_rate_e2e(
         native_build, live_server, distribution):
