@@ -620,6 +620,8 @@ def main() -> None:
     # reference publishes no numbers for these shapes, so the stages
     # carry no vs_baseline — they exist so every BASELINE config has a
     # measured figure on TPU.
+    relay_wedged = [False]  # sticky: set when a warmup watchdog fires
+
     def native_stage(stage_name, model_name, *, batch=1, concurrency=4,
                      shared_memory="none", output_shm=0, streaming=False,
                      window_ms=2000, input_data=None, extra=None,
@@ -627,9 +629,42 @@ def main() -> None:
                      fusion_composing=()):
         if not binary or remaining() < 90:
             return
+        if relay_wedged[0]:
+            # A prior warmup never returned: the one-client relay is
+            # wedged and every later device op queues behind it —
+            # skipping immediately is honest (running "measurements"
+            # against a wedged device is not) and preserves budget
+            # for the result flush.
+            log("%s skipped: relay wedged earlier in this run"
+                % stage_name)
+            return
         try:
             log("warming %s..." % model_name)
-            core.repository.load(model_name).warmup()
+            # Watchdog: a relay stall inside a warmup (observed: a
+            # device op blocking indefinitely in the relay client)
+            # must not eat the whole remaining budget. The stalled
+            # daemon thread cannot be killed; the sticky flag above
+            # keeps later stages from piling up behind it.
+            import threading
+
+            warm_done = threading.Event()
+            warm_err: list = []
+
+            def _warm():
+                try:
+                    core.repository.load(model_name).warmup()
+                except Exception as exc:  # noqa: BLE001
+                    warm_err.append(exc)
+                finally:
+                    warm_done.set()
+
+            threading.Thread(target=_warm, daemon=True).start()
+            if not warm_done.wait(min(180.0, max(60.0, remaining() - 60))):
+                relay_wedged[0] = True
+                raise RuntimeError("warmup stalled (relay hang?) — "
+                                   "skipping this and later stages")
+            if warm_err:
+                raise warm_err[0]
             data_path = None
             if input_data is not None:
                 data_path = "/tmp/bench_%s_input.json" % model_name

@@ -166,7 +166,7 @@ Error InferenceProfiler::ProfileConcurrencyRange(
     std::vector<PerfStatus>* results) {
   size_t concurrency = start;
   while (concurrency <= end || (end == 0 && concurrency == start)) {
-    Error err = manager->ChangeConcurrencyLevel(concurrency);
+    Error err = RankCheck(manager->ChangeConcurrencyLevel(concurrency));
     if (!err.IsOk()) return err;
     PerfStatus status;
     err = ProfileLevel(&status);
@@ -194,7 +194,7 @@ Error InferenceProfiler::ProfileConcurrencyBinarySearch(
   size_t best = 0;
   while (lo <= hi) {
     size_t mid = lo + (hi - lo) / 2;
-    Error err = manager->ChangeConcurrencyLevel(mid);
+    Error err = RankCheck(manager->ChangeConcurrencyLevel(mid));
     if (!err.IsOk()) return err;
     PerfStatus status;
     err = ProfileLevel(&status);
@@ -236,7 +236,7 @@ Error InferenceProfiler::ProfileRequestRateRange(
     std::vector<PerfStatus>* results) {
   double rate = start;
   while (rate <= end + 1e-9 || (end == 0 && rate == start)) {
-    Error err = manager->ChangeRequestRate(rate);
+    Error err = RankCheck(manager->ChangeRequestRate(rate));
     if (!err.IsOk()) return err;
     PerfStatus status;
     err = ProfileLevel(&status);
@@ -267,6 +267,14 @@ bool InferenceProfiler::AllRanks(bool local) const {
 
 bool InferenceProfiler::AnyRank(bool local) const {
   return !AllRanks(!local);
+}
+
+Error InferenceProfiler::RankCheck(const Error& err) const {
+  // Merge a rank-local outcome BEFORE any early return that skips a
+  // collective: without this, one failing rank leaves its peers
+  // blocked in an allreduce/barrier it never reaches.
+  if (AllRanks(err.IsOk())) return Error::Success;
+  return err.IsOk() ? Error("a peer rank failed") : err;
 }
 
 bool InferenceProfiler::ExceedsLatencyThreshold(

@@ -97,6 +97,10 @@ class InferenceProfiler {
   // branch that gates a collective must agree across ranks.
   bool AllRanks(bool local) const;
   bool AnyRank(bool local) const;
+  // Success only when EVERY rank's err is ok; otherwise the local
+  // error (or a peer-failure marker) — so error returns can never
+  // desequence the ranks' collectives.
+  Error RankCheck(const Error& err) const;
 
   // Concurrency sweep: [start, end] by step; end==0 profiles only
   // `start`. Stops early when the latency threshold is exceeded.

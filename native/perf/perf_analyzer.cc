@@ -295,12 +295,14 @@ int Run(int argc, char** argv) {
   MPIDriver mpi(params.enable_mpi);
 
   auto profile = [&](LoadManager* m) -> Error {
-    Error init_err = m->Init();
-    if (!init_err.IsOk()) return init_err;
     InferenceProfiler profiler(
         m, config, setup_backend.get(), model.name, params.verbose,
         metrics.get(), model.composing_models);
     if (params.enable_mpi && mpi.IsMPIRun()) profiler.set_mpi(&mpi);
+    // Rank-merged: a rank whose Init fails must not leave peers
+    // blocked in the profiler's collectives.
+    Error init_err = profiler.RankCheck(m->Init());
+    if (!init_err.IsOk()) return init_err;
     if (params.has_request_rate_range) {
       mode = LoadMode::REQUEST_RATE;
       return profiler.ProfileRequestRateRange(
@@ -310,8 +312,8 @@ int Run(int argc, char** argv) {
     if (!params.request_intervals_file.empty()) {
       mode = LoadMode::REQUEST_RATE;
       auto* custom = static_cast<CustomLoadManager*>(m);
-      Error sched_err =
-          custom->StartSchedule(params.request_intervals_file);
+      Error sched_err = profiler.RankCheck(
+          custom->StartSchedule(params.request_intervals_file));
       if (!sched_err.IsOk()) return sched_err;
       PerfStatus status;
       Error prof_err = profiler.ProfileSingleLevel(&status);
@@ -327,7 +329,7 @@ int Run(int argc, char** argv) {
       ramp.end = params.periodic_end;
       ramp.step = params.periodic_step;
       ramp.request_period = params.request_period;
-      Error ramp_err = periodic->RunRamp(ramp);
+      Error ramp_err = profiler.RankCheck(periodic->RunRamp(ramp));
       if (!ramp_err.IsOk()) return ramp_err;
       PerfStatus status;
       Error prof_err = profiler.ProfileSingleLevel(&status);
