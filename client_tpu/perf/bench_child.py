@@ -620,7 +620,12 @@ def main() -> None:
     # reference publishes no numbers for these shapes, so the stages
     # carry no vs_baseline — they exist so every BASELINE config has a
     # measured figure on TPU.
-    relay_wedged = [False]  # sticky: set when a warmup watchdog fires
+    # When a warmup watchdog fires, its Event is parked here; later
+    # stages skip while it is still unset (relay wedged) but resume
+    # once it fires (the warmup was merely slow, e.g. a long
+    # first-call XLA compile — a false alarm must not drop the
+    # remaining BASELINE configs from the record).
+    relay_stall = {"event": None}
 
     def native_stage(stage_name, model_name, *, batch=1, concurrency=4,
                      shared_memory="none", output_shm=0, streaming=False,
@@ -629,15 +634,20 @@ def main() -> None:
                      fusion_composing=()):
         if not binary or remaining() < 90:
             return
-        if relay_wedged[0]:
-            # A prior warmup never returned: the one-client relay is
-            # wedged and every later device op queues behind it —
-            # skipping immediately is honest (running "measurements"
-            # against a wedged device is not) and preserves budget
-            # for the result flush.
-            log("%s skipped: relay wedged earlier in this run"
-                % stage_name)
-            return
+        stalled = relay_stall["event"]
+        if stalled is not None:
+            if stalled.is_set():
+                relay_stall["event"] = None  # recovered: just slow
+                log("earlier warmup stall recovered — resuming stages")
+            else:
+                # A prior warmup still hasn't returned: the one-client
+                # relay is wedged and every later device op queues
+                # behind it — skipping is honest (running
+                # "measurements" against a wedged device is not) and
+                # preserves budget for the result flush.
+                log("%s skipped: relay wedged earlier in this run"
+                    % stage_name)
+                return
         try:
             log("warming %s..." % model_name)
             # Watchdog: a relay stall inside a warmup (observed: a
@@ -659,10 +669,10 @@ def main() -> None:
                     warm_done.set()
 
             threading.Thread(target=_warm, daemon=True).start()
-            if not warm_done.wait(min(180.0, max(60.0, remaining() - 60))):
-                relay_wedged[0] = True
+            if not warm_done.wait(min(240.0, max(120.0, remaining() - 60))):
+                relay_stall["event"] = warm_done
                 raise RuntimeError("warmup stalled (relay hang?) — "
-                                   "skipping this and later stages")
+                                   "skipping stages until it returns")
             if warm_err:
                 raise warm_err[0]
             data_path = None

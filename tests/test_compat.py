@@ -1,0 +1,67 @@
+"""Migration shims: reference-style `tritonclient.*` imports resolve
+to client_tpu modules (parity-plus for the reference's deprecation
+shims, SURVEY.md §2.2)."""
+
+import sys
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture()
+def compat():
+    import client_tpu.compat as compat
+
+    with pytest.warns(DeprecationWarning):
+        compat.install()
+    yield compat
+    compat.uninstall()
+
+
+def test_grpc_alias_is_the_real_client(compat):
+    import tritonclient.grpc as grpcclient
+
+    import client_tpu.grpc as real
+
+    assert grpcclient is real
+    assert hasattr(grpcclient, "InferenceServerClient")
+    assert hasattr(grpcclient, "InferInput")
+
+
+def test_utils_alias_round_trips_serialization(compat):
+    import tritonclient.utils as utils
+
+    tensor = np.array([b"a", b"bc"], dtype=np.object_)
+    wire = utils.serialize_byte_tensor(tensor)
+    back = utils.deserialize_bytes_tensor(np.asarray(wire).tobytes())
+    assert list(back) == [b"a", b"bc"]
+
+
+def test_cuda_shm_alias_targets_tpu_arena(compat):
+    import tritonclient.utils.cuda_shared_memory as cudashm
+
+    import client_tpu.utils.tpu_shared_memory as tpushm
+
+    assert cudashm is tpushm
+    # The seven-function CUDA-parity surface resolves through the alias.
+    for name in ("create_shared_memory_region", "get_raw_handle",
+                 "set_shared_memory_region", "get_contents_as_numpy",
+                 "set_shared_memory_region_from_dlpack",
+                 "as_shared_memory_tensor", "destroy_shared_memory_region"):
+        assert hasattr(cudashm, name), name
+
+
+def test_attribute_access_through_parent(compat):
+    import tritonclient
+
+    assert hasattr(tritonclient, "grpc")
+    assert hasattr(tritonclient, "utils")
+    assert tritonclient.utils.np_to_triton_dtype(np.int32) == "INT32"
+
+
+def test_install_is_idempotent_and_uninstall_cleans(compat):
+    compat.install()  # second call: no-op, no error
+    assert "tritonclient" in sys.modules
+    compat.uninstall()
+    assert "tritonclient" not in sys.modules
+    compat.install(quiet=True)  # reinstall for fixture teardown
