@@ -101,11 +101,12 @@ class InferDataManager:
                 tpushm.set_arena_endpoint(self._tpu_arena_url)
         for stream in range(self._loader.stream_count):
             for step in range(self._loader.step_count(stream)):
-                for name in self._model.inputs:
+                for name, tensor in self._model.inputs.items():
                     data = self._loader.get_input_data(name, stream, step)
                     region = "%s_%d_%d" % (name, stream, step)
-                    self._create_region(backend, region, data.raw_bytes(),
-                                        data.array, data.datatype)
+                    self._create_region(
+                        backend, region, data.raw_bytes(), data.array,
+                        data.datatype, copies=self._copies_for(tensor))
         # One region per output name, shared by all in-flight requests
         # (reference behavior). Outputs are never validated by the
         # harness; concurrent placements interleave harmlessly — the
@@ -116,16 +117,26 @@ class InferDataManager:
             self._create_output_region(backend, region)
             self._output_regions[name] = region
 
-    def _create_region(self, backend, region, raw, array, datatype):
-        byte_size = max(len(raw) * max(self._batch, 1), 1)
+    def _batchable(self, tensor) -> bool:
+        """One rule for both shape batching and data replication:
+        ordinary inputs of batching models batch; shape tensors never
+        do (their values describe shapes — one value set per batch,
+        reference ModelTensor.is_shape_tensor)."""
+        return self._model.max_batch_size > 0 and not tensor.is_shape_tensor
+
+    def _copies_for(self, tensor) -> int:
+        return max(self._batch, 1) if self._batchable(tensor) else 1
+
+    def _create_region(self, backend, region, raw, array, datatype,
+                       copies=1):
+        byte_size = max(len(raw) * copies, 1)
         if self._shm == SHM_SYSTEM:
             import client_tpu.utils.shared_memory as shm
 
             handle = shm.create_shared_memory_region(
                 region, "/perf_" + region, byte_size
             )
-            batched = [array] * max(self._batch, 1)
-            shm.set_shared_memory_region(handle, batched)
+            shm.set_shared_memory_region(handle, [array] * copies)
             backend.register_system_shared_memory(region, "/perf_" + region,
                                                   byte_size)
             self._system_handles.append(handle)
@@ -133,8 +144,8 @@ class InferDataManager:
             import client_tpu.utils.tpu_shared_memory as tpushm
 
             handle = tpushm.create_shared_memory_region(region, byte_size, 0)
-            if self._batch > 1:
-                batched = np.stack([array] * self._batch)
+            if copies > 1:
+                batched = np.stack([array] * copies)
                 tpushm.set_shared_memory_region(handle, [batched])
             else:
                 tpushm.set_shared_memory_region(handle, [array])
@@ -169,21 +180,23 @@ class InferDataManager:
         inputs = []
         for name, tensor in self._model.inputs.items():
             data = self._loader.get_input_data(name, stream, step)
+            copies = self._copies_for(tensor)
+            batchable = self._batchable(tensor)
             shape = data.shape
-            if self._model.max_batch_size > 0 and self._batch >= 1:
+            if batchable and self._batch >= 1:
                 shape = [self._batch] + shape
             infer_input = InferInput(name, shape, tensor.datatype)
             if self._shm == SHM_NONE:
-                if self._batch > 1:
-                    batched = np.stack([data.array] * self._batch)
-                    infer_input.set_data_from_numpy(batched)
-                elif self._model.max_batch_size > 0:
+                if copies > 1:
+                    infer_input.set_data_from_numpy(
+                        np.stack([data.array] * copies))
+                elif batchable:
                     infer_input.set_data_from_numpy(data.array[None])
                 else:
                     infer_input.set_data_from_numpy(data.array)
             else:
                 region = "%s_%d_%d" % (name, stream, step)
-                raw_len = len(data.raw_bytes()) * max(self._batch, 1)
+                raw_len = len(data.raw_bytes()) * copies
                 infer_input.set_shared_memory(region, raw_len)
             inputs.append(infer_input)
         return inputs

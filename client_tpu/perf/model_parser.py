@@ -14,6 +14,9 @@ class SchedulerType(enum.Enum):
     DYNAMIC = "dynamic"
     SEQUENCE = "sequence"
     ENSEMBLE = "ensemble"
+    # Ensemble whose composing chain contains a sequence-batched model
+    # (reference model_parser.h:63) — sequence semantics apply.
+    ENSEMBLE_SEQUENCE = "ensemble_sequence"
 
 
 class ModelTensor:
@@ -110,6 +113,9 @@ class ModelParser:
         self._add_composing(backend, config, model, seen)
         for name in bls_composing_models or []:
             self._add_child(backend, name, model, seen)
+        if (model.scheduler_type is SchedulerType.ENSEMBLE
+                and model.composing_sequential):
+            model.scheduler_type = SchedulerType.ENSEMBLE_SEQUENCE
         return model
 
     def _add_composing(self, backend, config: dict, model: ParsedModel,

@@ -1457,6 +1457,18 @@ class MockBackend : public ClientBackend {
       root["ensemble_scheduling"] = json::Value(std::move(scheduling));
     } else if (model_name == "seq_leaf") {
       root["sequence_batching"] = json::Value(json::Object{});
+    } else if (model_name == "shape_mock") {
+      // Shape-tensor fixture: INPUT1's values describe shapes
+      // (config input.is_shape_tensor), INPUT0 is ordinary batched
+      // data — exercises the parser flag + the data manager's
+      // no-replication semantics.
+      root["max_batch_size"] = json::Value(static_cast<int64_t>(8));
+      json::Array inputs;
+      json::Object in1;
+      in1["name"] = json::Value(std::string("INPUT1"));
+      in1["is_shape_tensor"] = json::Value(true);
+      inputs.push_back(json::Value(std::move(in1)));
+      root["input"] = json::Value(std::move(inputs));
     } else {
       root["max_batch_size"] = json::Value(static_cast<int64_t>(8));
     }

@@ -105,15 +105,15 @@ InferDataManager::~InferDataManager() {
 }
 
 const std::string* InferDataManager::BatchedBytes(
-    const std::string& input, size_t stream, size_t step,
+    const ModelTensor& tensor, size_t stream, size_t step,
     const TensorData& data) {
-  std::string key =
-      input + "_" + std::to_string(stream) + "_" + std::to_string(step);
+  std::string key = tensor.name + "_" + std::to_string(stream) + "_" +
+                    std::to_string(step);
   std::lock_guard<std::mutex> lock(cache_mutex_);
   auto it = batched_cache_.find(key);
   if (it != batched_cache_.end()) return &it->second;
   std::string batched;
-  int64_t copies = (model_->max_batch_size > 0) ? batch_ : 1;
+  int64_t copies = CopiesFor(tensor);
   batched.reserve(data.bytes.size() * copies);
   for (int64_t i = 0; i < copies; ++i) batched.append(data.bytes);
   auto inserted = batched_cache_.emplace(key, std::move(batched));
@@ -122,8 +122,8 @@ const std::string* InferDataManager::BatchedBytes(
 
 Error InferDataManager::CreateInputRegion(
     ClientBackend* backend, const std::string& region,
-    const TensorData& data) {
-  int64_t copies = (model_->max_batch_size > 0) ? batch_ : 1;
+    const ModelTensor& tensor, const TensorData& data) {
+  int64_t copies = CopiesFor(tensor);
   size_t byte_size = std::max<size_t>(data.bytes.size() * copies, 1);
   if (shm_type_ == SharedMemoryType::SYSTEM) {
     SystemRegion sys;
@@ -153,8 +153,11 @@ Error InferDataManager::CreateInputRegion(
   if (!err.IsOk()) return err;
   std::vector<int64_t> shape = data.shape;
   std::string payload;
-  if (model_->max_batch_size > 0) {
-    shape.insert(shape.begin(), batch_);
+  // Mirror BuildInputs' declared shape exactly (including batch 1):
+  // the arena's zero-copy fast path requires the stored segment shape
+  // to EQUAL the request's declared shape.
+  if (model_->max_batch_size > 0 && !tensor.is_shape_tensor) {
+    shape.insert(shape.begin(), copies);
   }
   payload.reserve(byte_size);
   for (int64_t i = 0; i < copies; ++i) payload.append(data.bytes);
@@ -210,7 +213,7 @@ Error InferDataManager::Init(ClientBackend* backend) {
         if (!err.IsOk()) return err;
         std::string region = tensor.name + "_" + std::to_string(stream) +
                              "_" + std::to_string(step);
-        err = CreateInputRegion(backend, region, *data);
+        err = CreateInputRegion(backend, region, tensor, *data);
         if (!err.IsOk()) return err;
       }
     }
@@ -256,7 +259,7 @@ Error InferDataManager::BuildInputs(
     Error err = loader_->GetInputData(tensor.name, stream, step, &data);
     if (!err.IsOk()) return err;
     std::vector<int64_t> shape = data->shape;
-    if (model_->max_batch_size > 0) {
+    if (model_->max_batch_size > 0 && !tensor.is_shape_tensor) {
       shape.insert(shape.begin(), batch_);
     }
     InferInput* raw = nullptr;
@@ -265,14 +268,14 @@ Error InferDataManager::BuildInputs(
     std::unique_ptr<InferInput> input(raw);
     if (shm_type_ == SharedMemoryType::NONE) {
       const std::string* payload =
-          BatchedBytes(tensor.name, stream, step, *data);
+          BatchedBytes(tensor, stream, step, *data);
       input->AppendRaw(
           reinterpret_cast<const uint8_t*>(payload->data()), payload->size());
     } else {
       std::string region = tensor.name + "_" + std::to_string(stream) + "_" +
                            std::to_string(step);
-      int64_t copies = (model_->max_batch_size > 0) ? batch_ : 1;
-      input->SetSharedMemory(region, data->bytes.size() * copies);
+      input->SetSharedMemory(region,
+                             data->bytes.size() * CopiesFor(tensor));
     }
     inputs->push_back(std::move(input));
   }

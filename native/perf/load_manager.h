@@ -219,13 +219,24 @@ class InferDataManager {
 
   Error CreateInputRegion(
       ClientBackend* backend, const std::string& region,
-      const TensorData& data);
+      const ModelTensor& tensor, const TensorData& data);
   Error CreateOutputRegion(ClientBackend* backend, const std::string& region);
 
+  // Per-row replication count for a tensor: batch_ for ordinary
+  // batched inputs, 1 for non-batching models AND for shape tensors
+  // (their values describe shapes — one value set per batch, never
+  // replicated per row).
+  int64_t CopiesFor(const ModelTensor& tensor) const {
+    return (model_->max_batch_size > 0 && !tensor.is_shape_tensor)
+               ? batch_
+               : 1;
+  }
+
   // The batched payload for (input, stream, step): data repeated
-  // batch_ times. Stable storage referenced by non-shm InferInputs.
+  // CopiesFor(tensor) times. Stable storage referenced by non-shm
+  // InferInputs.
   const std::string* BatchedBytes(
-      const std::string& input, size_t stream, size_t step,
+      const ModelTensor& tensor, size_t stream, size_t step,
       const TensorData& data);
 
   const ParsedModel* model_;

@@ -119,6 +119,26 @@ Error ModelParser::Parse(
     ParseTensors(metadata, "inputs", model->max_batch_size, &model->inputs);
     ParseTensors(metadata, "outputs", model->max_batch_size, &model->outputs);
 
+    // Shape-tensor and optional-input flags live in the CONFIG's
+    // tensor entries, not the metadata.
+    for (const char* key : {"input", "output"}) {
+      if (!config.Has(key) || !config[key].IsArray()) continue;
+      for (const auto& entry : config[key].AsArray()) {
+        if (!entry.IsObject() || !entry.Has("name")) continue;
+        const std::string name = entry["name"].AsString();
+        auto& tensors = (key[0] == 'i') ? model->inputs : model->outputs;
+        for (auto& tensor : tensors) {
+          if (tensor.name != name) continue;
+          if (entry.Has("is_shape_tensor")) {
+            tensor.is_shape_tensor = entry["is_shape_tensor"].AsBool();
+          }
+          if (entry.Has("optional")) {
+            tensor.optional = entry["optional"].AsBool();
+          }
+        }
+      }
+    }
+
     std::vector<std::string> seen;
     if (config.Has("ensemble_scheduling")) {
       model->scheduler_type = SchedulerType::ENSEMBLE;

@@ -30,6 +30,11 @@ struct ModelTensor {
   std::string datatype;
   std::vector<int64_t> shape;
   bool optional = false;
+  // Triton shape tensors (config input.is_shape_tensor): their VALUES
+  // describe shapes, one value set per batch — the data manager sends
+  // them unbatched and never replicates them per row (parity:
+  // model_parser.h:41 is_shape_tensor).
+  bool is_shape_tensor = false;
 };
 
 struct ParsedModel {

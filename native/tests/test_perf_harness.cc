@@ -80,6 +80,37 @@ TEST_CASE("perf: model parser recursive composing + bls") {
   CHECK_EQ(bls.composing_models[0], "callee");
 }
 
+TEST_CASE("perf: shape tensors stay unbatched") {
+  // Parity: reference ModelTensor.is_shape_tensor (model_parser.h:41)
+  // — a shape tensor's values describe SHAPES, one value set per
+  // batch, so the data manager must neither add the batch dim nor
+  // replicate its bytes per row.
+  Harness h;
+  ParsedModel model;
+  REQUIRE_OK(ModelParser::Parse(h.backend.get(), "shape_mock", "", 4,
+                                &model));
+  const ModelTensor* plain = model.FindInput("INPUT0");
+  const ModelTensor* shape_tensor = model.FindInput("INPUT1");
+  REQUIRE(plain != nullptr);
+  REQUIRE(shape_tensor != nullptr);
+  CHECK(!plain->is_shape_tensor);
+  CHECK(shape_tensor->is_shape_tensor);
+
+  DataLoader loader(&model);
+  REQUIRE_OK(loader.GenerateData());
+  InferDataManager manager(&model, &loader, SharedMemoryType::NONE,
+                           102400, "", /*batch=*/4);
+  std::vector<std::unique_ptr<InferInput>> inputs;
+  REQUIRE_OK(manager.BuildInputs(0, 0, &inputs));
+  REQUIRE(inputs.size() == 2u);
+  // INPUT0: leading batch dim 4, bytes replicated 4x.
+  CHECK_EQ(inputs[0]->Shape().size(), 2u);
+  CHECK_EQ(inputs[0]->Shape()[0], 4);
+  // INPUT1 (shape tensor): unbatched shape, single copy of the data.
+  CHECK_EQ(inputs[1]->Shape().size(), 1u);
+  CHECK_EQ(inputs[1]->Shape()[0], 16);
+}
+
 TEST_CASE("perf: data loader random + json") {
   Harness h;
   const TensorData* data = nullptr;

@@ -76,6 +76,56 @@ def test_model_parser_batch_rejection():
         ModelParser().parse(backend, "mock", batch_size=4)
 
 
+def test_shape_tensor_stays_unbatched():
+    """A config input marked is_shape_tensor keeps its unbatched shape
+    and single data copy at batch>1 (reference
+    ModelTensor.is_shape_tensor, model_parser.h:41)."""
+    backend = MockBackend(
+        model_metadata_dict={
+            "name": "m", "versions": ["1"], "platform": "mock",
+            "inputs": [
+                {"name": "INPUT0", "datatype": "FP32", "shape": [16]},
+                {"name": "INPUT1", "datatype": "INT32", "shape": [2]},
+            ],
+            "outputs": [
+                {"name": "OUTPUT0", "datatype": "FP32", "shape": [16]},
+            ],
+        },
+        model_config_dict={
+            "name": "m", "max_batch_size": 8,
+            "input": [{"name": "INPUT1", "is_shape_tensor": True}],
+        }
+    )
+    model = ModelParser().parse(backend, "m", batch_size=4)
+    assert not model.inputs["INPUT0"].is_shape_tensor
+    assert model.inputs["INPUT1"].is_shape_tensor
+
+    loader = DataLoader(model)
+    loader.generate_data()
+    manager = InferDataManager(model, loader, batch_size=4)
+    inputs = manager.build_inputs()
+    by_name = {i.name(): i for i in inputs}
+    assert by_name["INPUT0"].shape()[0] == 4  # leading batch dim
+    assert by_name["INPUT1"].shape() == model.inputs["INPUT1"].shape
+    assert len(by_name["INPUT0"].raw_data()) == 4 * 16 * 4  # replicated
+    assert len(by_name["INPUT1"].raw_data()) == 2 * 4  # single copy
+
+
+def test_model_parser_ensemble_sequence_kind():
+    """An ensemble with a sequence-batched composing model refines to
+    ENSEMBLE_SEQUENCE (reference model_parser.h:63)."""
+    backend = MockBackend(
+        model_configs={
+            "top": {"name": "top",
+                    "ensemble_scheduling": {"step": [{"model_name": "leaf"}]}},
+            "leaf": {"name": "leaf", "sequence_batching": {}},
+        }
+    )
+    model = ModelParser().parse(backend, "top")
+    assert model.scheduler_type == SchedulerType.ENSEMBLE_SEQUENCE
+    assert model.composing_sequential
+
+
 def test_model_parser_scheduler_kinds():
     backend = MockBackend(
         model_config_dict={"name": "m", "max_batch_size": 8,
