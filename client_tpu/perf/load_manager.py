@@ -106,7 +106,8 @@ class InferDataManager:
                     region = "%s_%d_%d" % (name, stream, step)
                     self._create_region(
                         backend, region, data.raw_bytes(), data.array,
-                        data.datatype, copies=self._copies_for(tensor))
+                        data.datatype, copies=self._copies_for(tensor),
+                        batchable=self._batchable(tensor))
         # One region per output name, shared by all in-flight requests
         # (reference behavior). Outputs are never validated by the
         # harness; concurrent placements interleave harmlessly — the
@@ -128,7 +129,7 @@ class InferDataManager:
         return max(self._batch, 1) if self._batchable(tensor) else 1
 
     def _create_region(self, backend, region, raw, array, datatype,
-                       copies=1):
+                       copies=1, batchable=False):
         byte_size = max(len(raw) * copies, 1)
         if self._shm == SHM_SYSTEM:
             import client_tpu.utils.shared_memory as shm
@@ -144,9 +145,13 @@ class InferDataManager:
             import client_tpu.utils.tpu_shared_memory as tpushm
 
             handle = tpushm.create_shared_memory_region(region, byte_size, 0)
-            if copies > 1:
-                batched = np.stack([array] * copies)
-                tpushm.set_shared_memory_region(handle, [batched])
+            if batchable:
+                # Store with the leading batch dim EVEN at batch 1: the
+                # arena's zero-copy fast path requires the stored shape
+                # to equal the request's declared shape (build_inputs
+                # declares [batch, ...] for batchable tensors).
+                tpushm.set_shared_memory_region(
+                    handle, [np.stack([array] * copies)])
             else:
                 tpushm.set_shared_memory_region(handle, [array])
             backend.register_tpu_shared_memory(

@@ -120,6 +120,115 @@ def test_llm_abandoned_stream_releases_lane():
     assert len(out) == 4
 
 
+def test_llm_pipeline_churn_with_random_cancels():
+    """Stress the dispatch/delivery pipeline: more concurrent
+    generations than lanes, a fraction abandoned mid-stream — every
+    surviving request must produce its solo-run tokens and every
+    request must terminate (no lane leak, no hang)."""
+    import random
+    import threading
+    import time
+
+    model = LlmModel(name="llm_churn", cfg=TINY_LLM, decode_lanes=2)
+    rng = random.Random(7)
+
+    def run_full(prompt):
+        return [t for t in model._generate(
+            {"text_input": np.array([prompt], dtype=np.object_),
+             "max_tokens": np.array([5], dtype=np.int32),
+             "ignore_eos": np.array([True])}, {})]
+
+    prompts = [("p%d" % i).encode() for i in range(8)]
+    solo = {p: run_full(p) for p in prompts[:3]}  # reference outputs
+
+    results, errors = {}, []
+
+    def worker(index, prompt):
+        try:
+            gen = model._generate(
+                {"text_input": np.array([prompt], dtype=np.object_),
+                 "max_tokens": np.array([5], dtype=np.int32),
+                 "ignore_eos": np.array([True])}, {})
+            if index % 3 == 2:  # abandon after the first token
+                next(gen)
+                gen.close()
+                results[prompt] = "cancelled"
+            else:
+                results[prompt] = list(gen)
+        except Exception as e:  # noqa: BLE001
+            errors.append((prompt, e))
+
+    for round_idx in range(3):
+        threads = [
+            threading.Thread(target=worker, args=(i, p))
+            for i, p in enumerate(prompts)
+        ]
+        rng.shuffle(threads)
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+            assert not t.is_alive(), "a generation hung"
+        assert not errors, errors
+        for p in prompts[:3]:
+            if results.get(p) != "cancelled":
+                assert results[p] == solo[p], (round_idx, p)
+        # pipeline fully drained between rounds
+        deadline = time.time() + 30
+        while time.time() < deadline and model._active:
+            time.sleep(0.05)
+        assert not model._active
+        assert sorted(model._free_lanes) == [0, 1]
+
+
+def test_llm_pipeline_crash_recovery():
+    """A device failure mid-decode must fail every rider loudly (no
+    client blocks forever) and the next request must restart the
+    pipeline cleanly (generation bump, fresh lanes)."""
+    model = LlmModel(name="llm_crash", cfg=TINY_LLM, decode_lanes=2)
+
+    # Prime (compiles + proves the happy path), then arm a one-shot
+    # failure inside the decode dispatch.
+    ok = list(model._generate(
+        {"text_input": np.array([b"prime"], dtype=np.object_),
+         "max_tokens": np.array([4], dtype=np.int32),
+         "ignore_eos": np.array([True])}, {}))
+    assert len(ok) == 4
+
+    real_decode = model._decode_chunk_multi
+    state = {"armed": True}
+
+    def exploding(*args, **kwargs):
+        if state["armed"]:
+            state["armed"] = False
+            raise RuntimeError("injected device failure")
+        return real_decode(*args, **kwargs)
+
+    model._decode_chunk_multi = exploding
+    from client_tpu.utils import InferenceServerException
+
+    with pytest.raises(InferenceServerException, match="failed"):
+        list(model._generate(
+            {"text_input": np.array([b"boom"], dtype=np.object_),
+             "max_tokens": np.array([8], dtype=np.int32),
+             "ignore_eos": np.array([True])}, {}))
+
+    # Recovery: pipeline restarted (new generation), request completes.
+    out = list(model._generate(
+        {"text_input": np.array([b"after"], dtype=np.object_),
+         "max_tokens": np.array([4], dtype=np.int32),
+         "ignore_eos": np.array([True])}, {}))
+    assert len(out) == 4
+    # Lane release runs on the delivery thread AFTER the terminating
+    # None is consumed — drain before asserting, like the churn test.
+    import time
+
+    deadline = time.time() + 30
+    while time.time() < deadline and sorted(model._free_lanes) != [0, 1]:
+        time.sleep(0.05)
+    assert sorted(model._free_lanes) == [0, 1]
+
+
 def test_llm_chunked_decode_matches_single_step():
     """decode_chunk (device-side lax.scan loop, one fetch per chunk)
     must reproduce the per-token decode_step sequence exactly —
