@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Decoupled repeat with custom pacing: repeat_int32 emits one
+response per input element, delayed per-element by the DELAY input —
+demonstrates multi-input decoupled streaming and per-response timing
+(parity example: reference simple_grpc_custom_repeat.py).
+
+Start a server first:  python -m client_tpu.server.app --models repeat_int32
+"""
+
+import argparse
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import client_tpu.grpc as grpcclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    parser.add_argument("-r", "--repeat-count", type=int, default=6)
+    parser.add_argument("-d", "--delay-us", type=int, default=2000)
+    args = parser.parse_args()
+
+    values = np.arange(args.repeat_count, dtype=np.int32) * 7
+    delays = np.full(args.repeat_count, args.delay_us, dtype=np.uint32)
+
+    received = []
+    arrivals = []
+    done = threading.Event()
+    start = time.perf_counter()
+
+    def callback(result, error):
+        assert error is None, "stream error: %s" % error
+        out = result.as_numpy("OUT")
+        if out is not None:
+            received.append(int(out.reshape(-1)[0]))
+            arrivals.append(time.perf_counter() - start)
+        if result.get_parameters().get("triton_final_response"):
+            done.set()
+
+    with grpcclient.InferenceServerClient(args.url) as client:
+        client.start_stream(callback)
+        inputs = [
+            grpcclient.InferInput("IN", [args.repeat_count], "INT32"),
+            grpcclient.InferInput("DELAY", [args.repeat_count], "UINT32"),
+        ]
+        inputs[0].set_data_from_numpy(values)
+        inputs[1].set_data_from_numpy(delays)
+        client.async_stream_infer("repeat_int32", inputs)
+        assert done.wait(timeout=60), "stream timed out"
+        client.stop_stream()
+
+    assert received == list(values), received
+    # The per-element delay paces the stream: first-to-last response
+    # spread (connection setup excluded) must reflect the per-element
+    # delays.
+    spread = (arrivals[-1] - arrivals[0]) if len(arrivals) > 1 else 0.0
+    needed = (args.repeat_count - 1) * args.delay_us / 1e6 * 0.5
+    assert spread >= needed, (spread, needed)
+    print("PASS: custom repeat (%d responses paced over %.1f ms)"
+          % (len(received), spread * 1e3))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Zero-copy TPU shared-memory inference over the HTTP client —
+the north-star flow on the REST protocol (parity example: reference
+simple_http_cudashm_client.py, re-targeted at the HBM arena).
+
+Start a server first:
+  python -m client_tpu.server.app --models add_sub_fp32
+(the arena gRPC service rides the --grpc-port; pass it as --arena-url
+when the HTTP port differs).
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import client_tpu.http as httpclient
+import client_tpu.utils.tpu_shared_memory as tpushm
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8000",
+                        help="HTTP endpoint")
+    parser.add_argument("--arena-url", default="localhost:8001",
+                        help="gRPC endpoint hosting the arena service")
+    parser.add_argument("-m", "--model", default="add_sub_fp32")
+    args = parser.parse_args()
+
+    tpushm.set_arena_endpoint(args.arena_url)
+    client = httpclient.InferenceServerClient(args.url)
+
+    x = np.random.rand(16).astype(np.float32)
+    y = np.random.rand(16).astype(np.float32)
+    byte_size = x.nbytes
+
+    handles = {
+        name: tpushm.create_shared_memory_region(name, byte_size, 0)
+        for name in ("input0_data", "input1_data", "output0_data",
+                     "output1_data")
+    }
+    tpushm.set_shared_memory_region(handles["input0_data"], [x])
+    tpushm.set_shared_memory_region(handles["input1_data"], [y])
+
+    # Registration over REST: the raw handle is a logical descriptor,
+    # never a pointer (reference posts the base64 cudaIpcMemHandle_t;
+    # here it is the arena's serialized region descriptor).
+    for name, handle in handles.items():
+        client.register_tpu_shared_memory(
+            name, tpushm.get_raw_handle(handle), 0, byte_size
+        )
+    status = client.get_tpu_shared_memory_status()
+    registered = {entry["name"] for entry in status}
+    assert registered.issuperset(handles), status
+
+    inputs = [
+        httpclient.InferInput("INPUT0", [16], "FP32"),
+        httpclient.InferInput("INPUT1", [16], "FP32"),
+    ]
+    inputs[0].set_shared_memory("input0_data", byte_size)
+    inputs[1].set_shared_memory("input1_data", byte_size)
+    outputs = [
+        httpclient.InferRequestedOutput("OUTPUT0"),
+        httpclient.InferRequestedOutput("OUTPUT1"),
+    ]
+    outputs[0].set_shared_memory("output0_data", byte_size)
+    outputs[1].set_shared_memory("output1_data", byte_size)
+
+    client.infer(args.model, inputs, outputs=outputs)
+
+    out0 = tpushm.get_contents_as_numpy(handles["output0_data"], "FP32", [16])
+    out1 = tpushm.get_contents_as_numpy(handles["output1_data"], "FP32", [16])
+    assert np.allclose(out0, x + y, rtol=1e-6), "add mismatch"
+    assert np.allclose(out1, x - y, rtol=1e-6), "sub mismatch"
+    print("PASS: tpu shared memory over http")
+
+    client.unregister_tpu_shared_memory()
+    for handle in handles.values():
+        tpushm.destroy_shared_memory_region(handle)
+    client.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -32,6 +32,7 @@ GRPC_EXAMPLES = [
     "decoupled_grpc_stream_infer_client.py",
     "grpc_client.py",
     "grpc_image_client.py",
+    "simple_grpc_custom_repeat_client.py",
 ]
 
 HTTP_EXAMPLES = [
@@ -144,6 +145,19 @@ def test_cpp_http_example(example_server, name):
 
 
 # -- image / ensemble / reuse clients (richer argument surfaces) ----------
+
+
+def test_http_tpushm_client(example_server):
+    """HTTP protocol + TPU-arena zero-copy I/O (the reference's
+    simple_http_cudashm_client analogue): registration verbs ride
+    REST while the arena service rides the gRPC port."""
+    _run_example_args(
+        "simple_http_tpushm_client.py",
+        ["-u", example_server["http"],
+         "--arena-url", example_server["grpc"],
+         "-m", "add_sub_fp32"],
+        timeout=120,
+    )
 
 
 @pytest.mark.parametrize("extra", [
