@@ -88,6 +88,53 @@ def native_binary() -> pathlib.Path | None:
     return binary if binary.exists() else None
 
 
+# When a watchdog fires, the stalled operation's done-Event is parked
+# here; stages skip while it is unset (relay wedged — every device op
+# queues behind the stuck one) and resume once it fires (merely slow).
+RELAY_STALL: dict = {"event": None}
+
+
+def relay_blocked() -> bool:
+    stalled = RELAY_STALL["event"]
+    if stalled is None:
+        return False
+    if stalled.is_set():
+        RELAY_STALL["event"] = None
+        log("earlier relay stall recovered — resuming stages")
+        return False
+    return True
+
+
+def run_with_watchdog(label: str, fn, timeout_s: float):
+    """Runs fn() on a daemon thread, bounded by a stall watchdog: an
+    observed relay failure mode blocks device ops indefinitely, and a
+    stuck call must cost one stage, not the whole bench budget. The
+    stalled thread cannot be killed — its Event is parked in
+    RELAY_STALL so later stages skip until it returns."""
+    import threading
+
+    done = threading.Event()
+    box: dict = {}
+
+    def _run():
+        try:
+            box["result"] = fn()
+        except Exception as exc:  # noqa: BLE001 — re-raised below
+            box["error"] = exc
+        finally:
+            done.set()
+
+    threading.Thread(target=_run, daemon=True,
+                     name="watchdog-%s" % label).start()
+    if not done.wait(timeout_s):
+        RELAY_STALL["event"] = done
+        raise RuntimeError("%s stalled (relay hang?) — skipping stages "
+                           "until it returns" % label)
+    if "error" in box:
+        raise box["error"]
+    return box.get("result")
+
+
 class _CompileCounter:
     """Counts XLA compiles during a window via jax_log_compiles, to
     prove the measured steady state triggers no recompiles."""
@@ -520,28 +567,40 @@ def main() -> None:
     # Stage 4: resnet50 with TPU shared memory — the headline.
     resnet_budget = 300 if platform != "cpu" else 150
     exec_extra: dict = {}
-    if remaining() > resnet_budget:
+    if remaining() > resnet_budget and not relay_blocked():
         try:
             log("warming resnet50 (batch 8)...")
-            model = core.repository.load("resnet50")
-            model.warmup()
+            run_with_watchdog(
+                "resnet50 warmup",
+                lambda: core.repository.load("resnet50").warmup(),
+                min(240.0, max(120.0, remaining() - 60)))
             # Pure-model cost (dispatch + fresh host fetch), so served
             # p50 splits into model time vs serving overhead. On this
             # image the axon relay's device->host hop is the floor.
-            # Diagnostic only — never let it kill the headline stage.
+            # Probe errors never kill the stage; a PERSISTENT relay
+            # stall does (measuring against a wedged device would be
+            # fiction) via the relay_blocked() gate below.
             exec_ms = None
             try:
-                exec_ms = measure_model_exec_ms(core, "resnet50", batch=8)
+                exec_ms = run_with_watchdog(
+                    "exec probe",
+                    lambda: measure_model_exec_ms(core, "resnet50", batch=8),
+                    150.0)
                 exec_extra = {"model_exec_ms": round(exec_ms, 2)}
                 log("resnet50 bare exec+fetch (batch 8): %.1f ms" % exec_ms)
             except Exception as exc:  # noqa: BLE001
                 log("exec probe failed (continuing): %s" % exc)
             try:
+                if relay_blocked():
+                    raise RuntimeError("relay wedged — probe skipped")
                 # Relay-corrected device step time (chained dispatches,
                 # one fetch): the honest device-side number the raw
                 # probe hides behind the ~65 ms fetch tax.
-                dev_ms, fetch_ms = measure_model_exec_corrected(
-                    core, "resnet50", batch=8)
+                dev_ms, fetch_ms = run_with_watchdog(
+                    "corrected exec probe",
+                    lambda: measure_model_exec_corrected(
+                        core, "resnet50", batch=8),
+                    180.0)
                 exec_extra["model_exec_ms_device"] = round(dev_ms, 2)
                 exec_extra["relay_fetch_ms_est"] = round(fetch_ms, 2)
                 # 8 imgs x ~7.7 GFLOP forward / device time vs v5e
@@ -554,6 +613,8 @@ def main() -> None:
                     % (dev_ms, fetch_ms, exec_extra.get("mfu_device", -1)))
             except Exception as exc:  # noqa: BLE001
                 log("corrected exec probe failed (continuing): %s" % exc)
+            if relay_blocked():
+                raise RuntimeError("relay wedged during probes")
             log("resnet50 warm; measuring over gRPC + tpu shm")
             out_shm = 8 * 1000 * 4 + 1024
             if binary:  # unmeasured pass: fusion/slice kernels compile
@@ -593,7 +654,8 @@ def main() -> None:
             log("resnet50 stage failed: %s" % exc)
 
     # Stage 5: resnet50 in-process.
-    if "resnet50_tpu_shm_grpc" in RESULT["stages"] and remaining() > 90:
+    if "resnet50_tpu_shm_grpc" in RESULT["stages"] and remaining() > 90 \
+            and not relay_blocked():
         try:
             # Drain the async exec queue the shm stage left behind: a
             # host round-trip through a fresh computation completes
@@ -620,13 +682,6 @@ def main() -> None:
     # reference publishes no numbers for these shapes, so the stages
     # carry no vs_baseline — they exist so every BASELINE config has a
     # measured figure on TPU.
-    # When a warmup watchdog fires, its Event is parked here; later
-    # stages skip while it is still unset (relay wedged) but resume
-    # once it fires (the warmup was merely slow, e.g. a long
-    # first-call XLA compile — a false alarm must not drop the
-    # remaining BASELINE configs from the record).
-    relay_stall = {"event": None}
-
     def native_stage(stage_name, model_name, *, batch=1, concurrency=4,
                      shared_memory="none", output_shm=0, streaming=False,
                      window_ms=2000, input_data=None, extra=None,
@@ -634,47 +689,20 @@ def main() -> None:
                      fusion_composing=()):
         if not binary or remaining() < 90:
             return
-        stalled = relay_stall["event"]
-        if stalled is not None:
-            if stalled.is_set():
-                relay_stall["event"] = None  # recovered: just slow
-                log("earlier warmup stall recovered — resuming stages")
-            else:
-                # A prior warmup still hasn't returned: the one-client
-                # relay is wedged and every later device op queues
-                # behind it — skipping is honest (running
-                # "measurements" against a wedged device is not) and
-                # preserves budget for the result flush.
-                log("%s skipped: relay wedged earlier in this run"
-                    % stage_name)
-                return
+        if relay_blocked():
+            # A prior device op never returned: the one-client relay
+            # is wedged and every later op queues behind it — skipping
+            # is honest (running "measurements" against a wedged
+            # device is not) and preserves budget for the flush.
+            log("%s skipped: relay wedged earlier in this run"
+                % stage_name)
+            return
         try:
             log("warming %s..." % model_name)
-            # Watchdog: a relay stall inside a warmup (observed: a
-            # device op blocking indefinitely in the relay client)
-            # must not eat the whole remaining budget. The stalled
-            # daemon thread cannot be killed; the sticky flag above
-            # keeps later stages from piling up behind it.
-            import threading
-
-            warm_done = threading.Event()
-            warm_err: list = []
-
-            def _warm():
-                try:
-                    core.repository.load(model_name).warmup()
-                except Exception as exc:  # noqa: BLE001
-                    warm_err.append(exc)
-                finally:
-                    warm_done.set()
-
-            threading.Thread(target=_warm, daemon=True).start()
-            if not warm_done.wait(min(240.0, max(120.0, remaining() - 60))):
-                relay_stall["event"] = warm_done
-                raise RuntimeError("warmup stalled (relay hang?) — "
-                                   "skipping stages until it returns")
-            if warm_err:
-                raise warm_err[0]
+            run_with_watchdog(
+                "%s warmup" % model_name,
+                lambda: core.repository.load(model_name).warmup(),
+                min(240.0, max(120.0, remaining() - 60)))
             data_path = None
             if input_data is not None:
                 data_path = "/tmp/bench_%s_input.json" % model_name
