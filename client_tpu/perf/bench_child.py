@@ -426,6 +426,33 @@ def main() -> None:
     RESULT["address"] = handle.address
     flush_result()
 
+    # Pre-flight device round trip, watchdogged. Runs AFTER the init
+    # marker is written (the orchestrator's init deadline must never
+    # ride on a wedged relay) and clamped to the budget. When the
+    # relay is wedged (observed failure mode: every device op blocks
+    # forever), the host-placed `simple` stages still measure fine —
+    # this records WHY the model-bound stages are absent.
+    def _device_probe():
+        import numpy as _np
+
+        x = jax.device_put(_np.ones((8, 8), _np.float32))
+        return float(_np.asarray((x * 2).sum()))
+
+    try:
+        run_with_watchdog("device probe", _device_probe,
+                          min(90.0, max(20.0, remaining() - 60)))
+        RESULT["device_probe"] = "ok"
+    except RuntimeError as exc:
+        if "stalled" in str(exc):
+            RESULT["device_probe"] = "stalled: %s" % exc
+            log("device probe stalled — model-bound stages will be "
+                "skipped while the relay is wedged")
+        else:
+            RESULT["device_probe"] = "error: %s" % exc
+    except Exception as exc:  # noqa: BLE001 — a real device error
+        RESULT["device_probe"] = "error: %s" % exc
+    flush_result()
+
     binary = native_binary()
     RESULT["harness"] = "native" if binary else "python"
 
@@ -858,6 +885,12 @@ def main() -> None:
         except Exception as exc:  # noqa: BLE001
             log("genai stage failed: %s" % exc)
 
+    # Reconcile the probe label: a stall that later recovered (stages
+    # ran) must not read as "model stages absent because wedged".
+    stalled_event = RELAY_STALL["event"]
+    if str(RESULT.get("device_probe", "")).startswith("stalled") and (
+            stalled_event is None or stalled_event.is_set()):
+        RESULT["device_probe"] = "stalled-then-recovered"
     flush_result()
     handle.stop()
     log("done")
