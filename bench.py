@@ -44,7 +44,8 @@ def log(msg: str) -> None:
 T0 = time.time()
 
 
-def run_child(platform: str, init_deadline_s: float, deadline_ts: float):
+def run_child(platform: str, init_deadline_s: float, deadline_ts: float,
+              skip_stages=None):
     """Run one bench child; returns the parsed result dict or None."""
     out = pathlib.Path("/tmp/bench_result.json")
     marker = pathlib.Path("/tmp/bench_init_marker.json")
@@ -54,6 +55,8 @@ def run_child(platform: str, init_deadline_s: float, deadline_ts: float):
     cmd = [sys.executable, "-m", "client_tpu.perf.bench_child",
            "--out", str(out), "--init-marker", str(marker),
            "--deadline-ts", str(deadline_ts)]
+    if skip_stages:
+        cmd += ["--skip-stages", ",".join(skip_stages)]
     env = dict(os.environ)
     if platform:
         cmd += ["--platform", platform]
@@ -172,12 +175,49 @@ def main() -> None:
         log("falling back to CPU platform")
         result = run_child("cpu", init_deadline_s=120.0,
                            deadline_ts=deadline_ts)
+    elif (result is not None
+          and str(result.get("device_probe", "")).startswith("stalled:")
+          and "resnet50_tpu_shm_grpc" not in result.get("stages", {})
+          and deadline_ts - time.time() > 180):
+        # Relay wedged: the TPU attempt measured only the host-placed
+        # stages. Supplement the model-bound stages on CPU under
+        # *_cpu_fallback names — visible data, never the headline
+        # (their throughputs don't compare to TPU numbers).
+        log("TPU relay wedged — supplementing model stages on CPU")
+        cpu_result = run_child("cpu", init_deadline_s=120.0,
+                               deadline_ts=deadline_ts,
+                               skip_stages=sorted(result["stages"]))
+        for name, stage in ((cpu_result or {}).get("stages") or {}).items():
+            if name not in result["stages"]:
+                # Strip TPU-anchored comparison fields: a CPU number
+                # against a TPU baseline is apples-to-oranges.
+                stage = {k: v for k, v in stage.items()
+                         if not k.startswith(("vs_", "baseline_", "mfu"))
+                         and k != "itl_p99_improvement"}
+                result["stages"][name + "_cpu_fallback"] = stage
     if result is None or not result.get("stages"):
         print(json.dumps({"metric": "bench_failed", "value": 0,
                           "unit": "infer/sec", "vs_baseline": 0}))
         sys.exit(1)
 
     stages = result["stages"]
+    # Headline eligibility: CPU-fallback numbers must never headline
+    # under a TPU stage name (apples-to-oranges vs_baseline) — applies
+    # to the priority list AND the last-resort pick below.
+    eligible = {
+        name: stage for name, stage in stages.items()
+        if not name.endswith("_cpu_fallback")
+        and not (name == "resnet50_tpu_shm_grpc"
+                 and result.get("platform") != "tpu")
+    }
+    if not eligible:
+        # Nothing headline-worthy measured: report the first stage
+        # under an explicit cpu-fallback name with no TPU-anchored
+        # comparison, never a TPU metric name.
+        head_key, head = next(iter(stages.items()))
+        head = {k: v for k, v in head.items()
+                if not k.startswith(("vs_", "baseline_"))}
+        eligible = {head_key + "_cpu_fallback": head}
     for head_key, head_name in (
         ("resnet50_tpu_shm_grpc",
          "resnet50_tpu_shm_grpc_batch8_c4_infer_per_sec"),
@@ -185,11 +225,11 @@ def main() -> None:
          "simple_grpc_native_server_c4_infer_per_sec"),
         ("simple_grpc", "simple_grpc_c4_infer_per_sec"),
     ):
-        if head_key in stages:
-            head = stages[head_key]
+        if head_key in eligible:
+            head = eligible[head_key]
             break
     else:
-        head_key, head = next(iter(stages.items()))
+        head_key, head = next(iter(eligible.items()))
         head_name = head_key + "_infer_per_sec"
     line = {
         "metric": head_name,

@@ -1,0 +1,25 @@
+"""client_tpu — a TPU-native inference client framework.
+
+A ground-up re-design of the Triton Inference Server client ecosystem
+(reference: ``gyulaz-htec/client``) for TPU hosts:
+
+- ``client_tpu.grpc`` / ``client_tpu.http`` — KServe-v2 protocol clients
+  (sync, callback-async, asyncio, decoupled bidi streaming).
+- ``client_tpu.utils`` — dtype maps (BF16 first-class), BYTES wire
+  serialization, exceptions.
+- ``client_tpu.utils.shared_memory`` — POSIX system shared memory.
+- ``client_tpu.utils.tpu_shared_memory`` — zero-copy TPU HBM tensor I/O
+  (the re-target of the reference's ``cuda_shared_memory`` module).
+- ``client_tpu.server`` — a JAX/XLA-backed KServe-v2 server used for
+  integration tests, co-located zero-copy serving, and benchmarking.
+- ``client_tpu.perf`` — load-generation + profiling harness
+  (perf_analyzer equivalent); ``client_tpu.genai`` — LLM benchmark
+  metrics (genai-perf equivalent).
+- ``client_tpu.models`` / ``client_tpu.parallel`` / ``client_tpu.ops`` —
+  the server-side JAX model zoo, mesh/sharding helpers, and Pallas
+  kernels backing the benchmark model repository.
+"""
+
+__version__ = "0.1.0"
+
+from client_tpu.utils import InferenceServerException  # noqa: F401

@@ -1,0 +1,202 @@
+"""Transport-neutral core data model.
+
+The reference duplicates InferInput/InferRequestedOutput/InferResult per
+transport (grpc/_infer_input.py, http/_infer_input.py, ...); here a
+single implementation carries tensor data and shared-memory references,
+and each transport layer serializes it to its own wire form. Parity
+surface: /root/reference/src/c++/library/common.h:237-563 and the Python
+mirrors.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from client_tpu.utils import (
+    InferenceServerException,
+    np_to_wire_dtype,
+    num_elements,
+    serialize_bf16_tensor,
+    serialize_byte_tensor,
+    tensor_byte_size,
+)
+
+
+class InferInput:
+    """One named input tensor of an inference request.
+
+    Data can be attached either from a numpy array
+    (:meth:`set_data_from_numpy`) or as a reference into a registered
+    shared-memory region (:meth:`set_shared_memory`) — system (POSIX) or
+    TPU (HBM arena slice).
+    """
+
+    def __init__(self, name: str, shape: Sequence[int], datatype: str):
+        self._name = name
+        self._shape = [int(s) for s in shape]
+        self._datatype = datatype
+        self._parameters: dict = {}
+        self._raw_data: Optional[bytes] = None
+        self._np_data: Optional[np.ndarray] = None
+        self._shm: Optional[Tuple[str, int, int]] = None  # (region, byte_size, offset)
+
+    def name(self) -> str:
+        return self._name
+
+    def datatype(self) -> str:
+        return self._datatype
+
+    def shape(self) -> list:
+        return self._shape
+
+    def set_shape(self, shape: Sequence[int]) -> "InferInput":
+        self._shape = [int(s) for s in shape]
+        return self
+
+    def parameters(self) -> dict:
+        return self._parameters
+
+    def set_parameter(self, key: str, value) -> "InferInput":
+        self._parameters[key] = value
+        return self
+
+    def set_data_from_numpy(self, input_tensor: np.ndarray) -> "InferInput":
+        """Attach tensor data, validating dtype and shape against the
+        declaration. BYTES tensors are length-prefix serialized; BF16
+        accepts ml_dtypes.bfloat16 (or float) arrays."""
+        if not isinstance(input_tensor, np.ndarray):
+            raise InferenceServerException("input tensor must be a numpy array")
+        dtype = np_to_wire_dtype(input_tensor.dtype)
+        if self._datatype != dtype and not (
+            self._datatype == "BF16" and input_tensor.dtype.kind == "f"
+        ):
+            raise InferenceServerException(
+                "got unexpected datatype %s from numpy array, expected %s"
+                % (dtype, self._datatype)
+            )
+        valid_shape = input_tensor.ndim == len(self._shape) and all(
+            int(a) == int(b) for a, b in zip(input_tensor.shape, self._shape)
+        )
+        if not valid_shape:
+            raise InferenceServerException(
+                "got unexpected numpy array shape %s, expected %s"
+                % (list(input_tensor.shape), self._shape)
+            )
+        self._shm = None
+        self._np_data = input_tensor
+        if self._datatype == "BYTES":
+            self._raw_data = serialize_byte_tensor(input_tensor).tobytes()
+        elif self._datatype == "BF16":
+            self._raw_data = serialize_bf16_tensor(input_tensor).tobytes()
+        else:
+            self._raw_data = np.ascontiguousarray(input_tensor).tobytes()
+        return self
+
+    def set_shared_memory(
+        self, region_name: str, byte_size: int, offset: int = 0
+    ) -> "InferInput":
+        """Reference a slice of a registered shared-memory region
+        instead of inlining data on the wire (zero-copy path)."""
+        self._raw_data = None
+        self._np_data = None
+        self._shm = (region_name, int(byte_size), int(offset))
+        return self
+
+    # -- accessors used by the transport layers --------------------------
+
+    def raw_data(self) -> Optional[bytes]:
+        return self._raw_data
+
+    def numpy_data(self) -> Optional[np.ndarray]:
+        return self._np_data
+
+    def shared_memory(self) -> Optional[Tuple[str, int, int]]:
+        return self._shm
+
+    def validate(self) -> None:
+        if self._raw_data is None and self._shm is None:
+            raise InferenceServerException(
+                "input '%s' has no data; call set_data_from_numpy or "
+                "set_shared_memory" % self._name
+            )
+        if self._raw_data is not None and self._datatype not in ("BYTES",):
+            expected = tensor_byte_size(self._datatype, self._shape)
+            if expected >= 0 and len(self._raw_data) != expected:
+                raise InferenceServerException(
+                    "input '%s' got %d data bytes, expected %d for %s%s"
+                    % (
+                        self._name,
+                        len(self._raw_data),
+                        expected,
+                        self._datatype,
+                        self._shape,
+                    )
+                )
+
+
+class InferRequestedOutput:
+    """One requested output: optionally top-K classification results,
+    binary-data preference (HTTP), or a shared-memory placement."""
+
+    def __init__(self, name: str, binary_data: bool = True, class_count: int = 0):
+        self._name = name
+        self._binary_data = binary_data
+        self._class_count = int(class_count)
+        self._parameters: dict = {}
+        self._shm: Optional[Tuple[str, int, int]] = None
+
+    def name(self) -> str:
+        return self._name
+
+    def binary_data(self) -> bool:
+        return self._binary_data
+
+    def class_count(self) -> int:
+        return self._class_count
+
+    def parameters(self) -> dict:
+        return self._parameters
+
+    def set_shared_memory(
+        self, region_name: str, byte_size: int, offset: int = 0
+    ) -> "InferRequestedOutput":
+        self._shm = (region_name, int(byte_size), int(offset))
+        return self
+
+    def unset_shared_memory(self) -> "InferRequestedOutput":
+        self._shm = None
+        return self
+
+    def shared_memory(self) -> Optional[Tuple[str, int, int]]:
+        return self._shm
+
+
+def build_request_parameters(
+    sequence_id: int = 0,
+    sequence_start: bool = False,
+    sequence_end: bool = False,
+    priority: int = 0,
+    timeout: Optional[int] = None,
+    parameters: Optional[dict] = None,
+) -> dict:
+    """Normalize per-request options into the v2 ``parameters`` map the
+    transports serialize (sequence_* only included when a sequence is in
+    play, matching reference wire behavior)."""
+    params = dict(parameters) if parameters else {}
+    reserved = ("sequence_id", "sequence_start", "sequence_end", "priority", "timeout")
+    for k in reserved:
+        if k in params:
+            raise InferenceServerException(
+                "parameter '%s' is reserved; use the dedicated argument" % k
+            )
+    if sequence_id:
+        params["sequence_id"] = int(sequence_id)
+        params["sequence_start"] = bool(sequence_start)
+        params["sequence_end"] = bool(sequence_end)
+    if priority:
+        params["priority"] = int(priority)
+    if timeout is not None:
+        params["timeout"] = int(timeout)
+    return params

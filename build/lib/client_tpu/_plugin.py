@@ -1,0 +1,67 @@
+"""Client plugin hook: a callable invoked with every outgoing request
+so users can inject headers (auth, tracing) uniformly across
+transports. Parity: reference tritonclient/_plugin.py:31-48."""
+
+from __future__ import annotations
+
+import abc
+
+
+class InferenceServerClientPlugin(abc.ABC):
+    """A plugin is called with the :class:`Request` right before every
+    network operation and may mutate its headers in place."""
+
+    @abc.abstractmethod
+    def __call__(self, request: "Request") -> None:
+        ...
+
+
+class Request:
+    """An outgoing request as seen by plugins: just mutable headers."""
+
+    def __init__(self, headers: dict):
+        self.headers = headers
+
+
+class BasicAuth(InferenceServerClientPlugin):
+    """Adds an HTTP Basic ``Authorization`` header."""
+
+    def __init__(self, username: str, password: str):
+        import base64
+
+        cred = ("%s:%s" % (username, password)).encode()
+        self._auth_header = "Basic " + base64.b64encode(cred).decode()
+
+    def __call__(self, request: Request) -> None:
+        request.headers["Authorization"] = self._auth_header
+
+
+class InferenceServerClientBase:
+    """Shared plugin registration/dispatch for every client flavor."""
+
+    def __init__(self):
+        self._plugin = None
+
+    def register_plugin(self, plugin: InferenceServerClientPlugin) -> None:
+        if plugin is None:
+            raise ValueError("plugin must not be None")
+        if self._plugin is not None:
+            raise RuntimeError("a plugin is already registered")
+        self._plugin = plugin
+
+    def plugin(self):
+        return self._plugin
+
+    def unregister_plugin(self) -> None:
+        if self._plugin is None:
+            raise RuntimeError("no plugin is registered")
+        self._plugin = None
+
+    def _call_plugin(self, headers: dict) -> dict:
+        """Run the plugin (if any) over a headers dict; returns the
+        (possibly new) headers mapping."""
+        if self._plugin is not None:
+            if headers is None:
+                headers = {}
+            self._plugin(Request(headers))
+        return headers

@@ -1,0 +1,126 @@
+"""Migration shims for code written against the reference client
+(parity-plus: the reference ships deprecation shims for ITS old
+package names — `tritonclientutils`, `tritongrpcclient`,
+`tritonhttpclient`, `tritonshmutils`, each re-exporting the new layout
+with a DeprecationWarning; this build's equivalent concern is code
+written against `tritonclient.*` itself).
+
+``install()`` registers module aliases so existing scripts run
+unchanged against this framework::
+
+    import client_tpu.compat
+    client_tpu.compat.install()
+
+    import tritonclient.grpc as grpcclient          # -> client_tpu.grpc
+    import tritonclient.utils.shared_memory as shm  # -> client_tpu...
+
+Aliased surface: ``tritonclient`` (package), ``.grpc``, ``.grpc.aio``,
+``.http``, ``.http.aio``, ``.utils``, ``.utils.shared_memory``, and
+``.utils.cuda_shared_memory`` — the last mapping onto
+``client_tpu.utils.tpu_shared_memory``, whose seven-function surface
+mirrors the CUDA module one-for-one (create/get_raw_handle/set/
+get_contents_as_numpy/set_from_dlpack/as_shared_memory_tensor/
+destroy), so CUDA-shm call sites retarget the HBM arena without
+edits. A MigrationWarning-style DeprecationWarning fires once per
+aliased import path.
+"""
+
+from __future__ import annotations
+
+import importlib
+import sys
+import warnings
+
+# alias -> real module path
+_ALIASES = {
+    "tritonclient": "client_tpu",
+    "tritonclient.grpc": "client_tpu.grpc",
+    "tritonclient.grpc.aio": "client_tpu.grpc.aio",
+    "tritonclient.http": "client_tpu.http",
+    "tritonclient.http.aio": "client_tpu.http.aio",
+    "tritonclient.utils": "client_tpu.utils",
+    "tritonclient.utils.shared_memory": "client_tpu.utils.shared_memory",
+    # CUDA shm call sites retarget the TPU HBM arena: identical
+    # seven-function surface (SURVEY.md §2.2 north-star module).
+    "tritonclient.utils.cuda_shared_memory":
+        "client_tpu.utils.tpu_shared_memory",
+}
+
+_installed = False
+_attr_backups: list = []  # (parent module, attr, had_prev, prev)
+
+
+def install(quiet: bool = False) -> None:
+    """Registers the ``tritonclient.*`` aliases in ``sys.modules``.
+
+    Idempotent; refuses to shadow a REAL tritonclient installation
+    (if one is importable, the aliases are not installed and a
+    RuntimeError is raised — silently hijacking an installed package
+    would be hostile)."""
+    global _installed
+    if _installed:
+        return
+    existing = sys.modules.get("tritonclient")
+    if existing is not None and \
+            not existing.__name__.startswith("client_tpu"):
+        raise RuntimeError(
+            "a real tritonclient package is already imported; refusing "
+            "to alias it to client_tpu (mixed-client state would be "
+            "worse than either)")
+    if existing is None:
+        try:
+            import importlib.util
+
+            if importlib.util.find_spec("tritonclient") is not None:
+                raise RuntimeError(
+                    "a real tritonclient package is installed; refusing "
+                    "to alias it to client_tpu (uninstall it or import "
+                    "client_tpu directly)")
+        except (ImportError, ValueError):
+            pass  # no spec machinery surprises block the shim
+    for alias, target in _ALIASES.items():
+        module = importlib.import_module(target)
+        sys.modules[alias] = module
+        # Attribute access (tritonclient.grpc) must also resolve:
+        # wire each aliased child onto its aliased parent — recording
+        # what we touch so uninstall() can restore it (the "parent"
+        # IS the real client_tpu module; leaking attributes onto it
+        # would outlive the shim).
+        if "." in alias:
+            parent_alias, child = alias.rsplit(".", 1)
+            parent = sys.modules.get(parent_alias)
+            if parent is not None:
+                _attr_backups.append(
+                    (parent, child, hasattr(parent, child),
+                     getattr(parent, child, None)))
+                setattr(parent, child, module)
+    if not quiet:
+        warnings.warn(
+            "tritonclient.* imports are aliased to client_tpu.* "
+            "(client_tpu.compat); port imports to client_tpu when "
+            "convenient",
+            DeprecationWarning,
+            stacklevel=2,
+        )
+    _installed = True
+
+
+def uninstall() -> None:
+    """Removes the aliases and restores any attributes install() set
+    on the real client_tpu modules (test hygiene)."""
+    global _installed
+    for alias in _ALIASES:
+        existing = sys.modules.get(alias)
+        if existing is not None and existing.__name__.startswith(
+                "client_tpu"):
+            del sys.modules[alias]
+    while _attr_backups:
+        parent, child, had_prev, prev = _attr_backups.pop()
+        if had_prev:
+            setattr(parent, child, prev)
+        else:
+            try:
+                delattr(parent, child)
+            except AttributeError:
+                pass
+    _installed = False

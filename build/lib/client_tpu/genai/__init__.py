@@ -1,0 +1,27 @@
+"""LLM benchmarking front-end over the perf harness.
+
+Parity target: the reference's genai-perf package
+(perf_analyzer/genai-perf: CLI -> input generation -> perf_analyzer
+run -> profile-export parsing -> TTFT / inter-token-latency /
+token-throughput statistics -> console/JSON/CSV export). Here the
+"perf_analyzer subprocess" is the in-repo client_tpu.perf harness,
+invoked in-process."""
+
+from client_tpu.genai.metrics import (
+    LLMMetrics,
+    LLMProfileDataParser,
+    Statistics,
+)
+from client_tpu.genai.inputs import LlmInputs, OutputFormat
+from client_tpu.genai.synthetic import SyntheticPromptGenerator
+from client_tpu.genai.tokenizer import get_tokenizer
+
+__all__ = [
+    "LLMMetrics",
+    "LLMProfileDataParser",
+    "Statistics",
+    "LlmInputs",
+    "OutputFormat",
+    "SyntheticPromptGenerator",
+    "get_tokenizer",
+]

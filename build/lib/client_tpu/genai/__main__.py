@@ -1,0 +1,3 @@
+from client_tpu.genai.main import main
+
+main()

@@ -1,0 +1,88 @@
+"""Public-dataset prompt sources (parity: genai-perf
+llm_inputs/llm_inputs.py OpenOrca / CNN-dailymail input types).
+
+The reference pulls rows from the HF datasets-server REST API at input
+generation time. This module does the same when the network allows,
+and otherwise degrades to the synthetic prompt generator with a clear
+warning — offline images (like the TPU build/CI hosts) still get a
+working benchmark run.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import urllib.error
+import urllib.request
+from typing import List, Optional
+
+DATASETS = {
+    # name -> (datasets-server query, field holding the prompt text)
+    "openorca": (
+        "dataset=Open-Orca%2FOpenOrca&config=default&split=train",
+        "question",
+    ),
+    "cnn_dailymail": (
+        "dataset=cnn_dailymail&config=3.0.0&split=train",
+        "article",
+    ),
+}
+
+_ROWS_URL = ("https://datasets-server.huggingface.co/rows"
+             "?%s&offset=%d&length=%d")
+_PAGE = 100  # datasets-server caps length at 100 per request
+
+
+def dataset_prompts(
+    name: str,
+    num_prompts: int,
+    fallback_generator=None,
+    fallback_tokens_mean: int = 64,
+    fallback_tokens_stddev: float = 0.0,
+    timeout_s: float = 10.0,
+    _opener=None,
+) -> List[str]:
+    """Fetch ``num_prompts`` prompts from a named public dataset
+    (paginating past the server's 100-row page cap); falls back to
+    ``fallback_generator.generate_prompts`` offline."""
+    if name not in DATASETS:
+        raise ValueError(
+            "unknown dataset %r (have: %s)" % (name, ", ".join(DATASETS)))
+    query, field = DATASETS[name]
+    opener = _opener or urllib.request.urlopen
+    try:
+        prompts: List[str] = []
+        offset = 0
+        while len(prompts) < num_prompts:
+            url = _ROWS_URL % (
+                query, offset, min(num_prompts - len(prompts), _PAGE))
+            with opener(url, timeout=timeout_s) as response:
+                doc = json.load(response)
+            page = [
+                str(row["row"][field])
+                for row in doc.get("rows", [])
+                if field in row.get("row", {})
+            ]
+            if not page:
+                break  # dataset exhausted
+            prompts.extend(page)
+            offset += len(doc.get("rows", []))
+        if not prompts:
+            raise ValueError("dataset response had no '%s' rows" % field)
+        if len(prompts) < num_prompts:
+            print(
+                "genai: dataset '%s' yielded only %d of %d requested "
+                "prompts" % (name, len(prompts), num_prompts),
+                file=sys.stderr,
+            )
+        return prompts[:num_prompts]
+    except Exception as exc:  # noqa: BLE001 — any failure degrades
+        if fallback_generator is None:
+            raise
+        print(
+            "genai: dataset '%s' unavailable (%s); using synthetic "
+            "prompts" % (name, exc),
+            file=sys.stderr,
+        )
+        return fallback_generator.generate_prompts(
+            num_prompts, fallback_tokens_mean, fallback_tokens_stddev)

@@ -1,0 +1,73 @@
+"""Result export: console table, JSON, CSV (parity: genai-perf
+export/console exporters)."""
+
+from __future__ import annotations
+
+import csv
+import json
+from typing import List, Optional
+
+from client_tpu.genai.metrics import Statistics
+
+_COLUMNS = ["mean", "min", "max", "p99", "p95", "p90", "p75", "p50", "p25"]
+
+
+def console_report(stats: Statistics, title: str = "LLM Metrics") -> str:
+    lines = ["", title, "=" * len(title)]
+    header = "%-28s" % "Statistic" + "".join(
+        "%12s" % c for c in _COLUMNS)
+    lines.append(header)
+    lines.append("-" * len(header))
+    for name, entry in stats.as_dict().items():
+        if "value" in entry:
+            continue
+        lines.append("%-28s" % name + "".join(
+            "%12.2f" % entry.get(c, float("nan")) for c in _COLUMNS))
+    for name, entry in stats.as_dict().items():
+        if "value" in entry:
+            lines.append("%-28s%12.2f" % (name, entry["value"]))
+    return "\n".join(lines)
+
+
+def export_json(stats_list: List[Statistics], path: str,
+                meta: Optional[dict] = None) -> None:
+    doc = {
+        "meta": meta or {},
+        "experiments": [s.as_dict() for s in stats_list],
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2)
+
+
+def export_parquet(stats_list: List[Statistics], path: str) -> None:
+    """Raw per-request samples as a long-format parquet table
+    (experiment, metric, sample_index, value) — parity: genai-perf's
+    parquet export of the raw profile dataframe."""
+    import pandas as pd
+
+    rows = []
+    for idx, stats in enumerate(stats_list):
+        for name, samples in stats.metrics.data().items():
+            for i, value in enumerate(samples):
+                rows.append((idx, name, i, float(value)))
+        rows.append((idx, "request_throughput_per_s", 0,
+                     stats.metrics.request_throughput_per_s))
+        rows.append((idx, "output_token_throughput_per_s", 0,
+                     stats.metrics.output_token_throughput_per_s))
+    frame = pd.DataFrame(
+        rows, columns=["experiment", "metric", "sample_index", "value"])
+    frame.to_parquet(path, index=False)
+
+
+def export_csv(stats_list: List[Statistics], path: str) -> None:
+    with open(path, "w", newline="") as f:
+        writer = csv.writer(f)
+        writer.writerow(["experiment", "metric"] + _COLUMNS + ["value"])
+        for idx, stats in enumerate(stats_list):
+            for name, entry in stats.as_dict().items():
+                writer.writerow(
+                    [idx, name]
+                    + [round(entry[c], 4) if c in entry else ""
+                       for c in _COLUMNS]
+                    + [round(entry["value"], 4) if "value" in entry else ""]
+                )

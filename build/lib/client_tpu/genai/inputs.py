@@ -1,0 +1,100 @@
+"""LLM input-dataset construction (parity: genai-perf
+llm_inputs/llm_inputs.py — synthetic or file prompts rendered into the
+payload format of the target endpoint)."""
+
+from __future__ import annotations
+
+import enum
+import json
+from typing import List, Optional
+
+from client_tpu.genai.synthetic import SyntheticPromptGenerator
+
+
+class OutputFormat(enum.Enum):
+    # perf-harness data JSON driving the decoupled generate model
+    TRITON_GENERATE = "triton_generate"
+    # OpenAI-style chat-completions payloads (one JSON body per step)
+    OPENAI_CHAT = "openai_chat"
+
+
+class LlmInputs:
+    """Builds the input file consumed by the perf harness (the
+    reference writes llm_inputs.json for perf_analyzer)."""
+
+    def __init__(self, tokenizer, seed: int = 0):
+        self._generator = SyntheticPromptGenerator(tokenizer, seed)
+
+    def create_prompts(
+        self,
+        num_prompts: int = 10,
+        input_tokens_mean: int = 64,
+        input_tokens_stddev: float = 0.0,
+        input_file: Optional[str] = None,
+    ) -> List[str]:
+        if input_file:
+            prompts = []
+            with open(input_file) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    # JSONL with {"text_input": ...} or raw text lines
+                    try:
+                        doc = json.loads(line)
+                    except json.JSONDecodeError:
+                        prompts.append(line)
+                        continue
+                    if isinstance(doc, dict):
+                        prompts.append(doc.get("text_input") or
+                                       doc.get("prompt") or line)
+                    elif isinstance(doc, str):
+                        prompts.append(doc)
+                    else:
+                        raise ValueError(
+                            "input file '%s': line is neither an object "
+                            "with text_input/prompt nor a string: %r"
+                            % (input_file, line[:80]))
+            if not prompts:
+                raise ValueError("input file '%s' has no prompts"
+                                 % input_file)
+            return prompts[:num_prompts] if num_prompts else prompts
+        return self._generator.generate_prompts(
+            num_prompts, input_tokens_mean, input_tokens_stddev)
+
+    def convert_to_dataset(
+        self,
+        prompts: List[str],
+        output_format: OutputFormat = OutputFormat.TRITON_GENERATE,
+        output_tokens_mean: int = 32,
+        ignore_eos: bool = True,
+        model_name: str = "llm",
+    ) -> dict:
+        if output_format == OutputFormat.OPENAI_CHAT:
+            return {
+                "data": [
+                    {"payload": [{
+                        "model": model_name,
+                        "messages": [
+                            {"role": "user", "content": prompt}],
+                        "max_tokens": output_tokens_mean,
+                        "stream": True,
+                    }]}
+                    for prompt in prompts
+                ]
+            }
+        steps = []
+        for prompt in prompts:
+            step = {
+                "text_input": [prompt],
+                "max_tokens": [int(output_tokens_mean)],
+            }
+            if ignore_eos:
+                step["ignore_eos"] = [True]
+            steps.append(step)
+        return {"data": steps}
+
+    def write_dataset(self, dataset: dict, path: str) -> str:
+        with open(path, "w") as f:
+            json.dump(dataset, f)
+        return path

@@ -1,0 +1,169 @@
+"""genai CLI: benchmark an LLM generate endpoint end to end.
+
+Run:  python -m client_tpu.genai -m llm --service-kind inprocess \
+          --num-prompts 8 --output-tokens-mean 16
+
+Pipeline parity with genai-perf main.py:46-120 — generate inputs,
+run the perf harness, parse the profile export, report LLM metrics.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+from typing import List, Optional
+
+from client_tpu.genai.exporters import (
+    console_report,
+    export_csv,
+    export_json,
+)
+from client_tpu.genai.inputs import LlmInputs, OutputFormat
+from client_tpu.genai.metrics import LLMProfileDataParser
+from client_tpu.genai.tokenizer import get_tokenizer
+from client_tpu.genai.wrapper import Profiler
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="client_tpu.genai",
+        description="LLM benchmark front-end (TTFT / inter-token "
+                    "latency / token throughput)")
+    parser.add_argument("-m", "--model", required=True)
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    parser.add_argument("--endpoint", default="v1/chat/completions",
+                        help="openai service-kind request path")
+    parser.add_argument("--service-kind", default="triton",
+                        choices=["triton", "inprocess", "openai"])
+    parser.add_argument("-i", "--protocol", default="grpc",
+                        choices=["grpc", "http"])
+    parser.add_argument("--concurrency", type=int, default=1)
+    parser.add_argument("--num-prompts", type=int, default=8)
+    parser.add_argument("--synthetic-input-tokens-mean", type=int,
+                        default=64)
+    parser.add_argument("--synthetic-input-tokens-stddev", type=float,
+                        default=0.0)
+    parser.add_argument("--output-tokens-mean", type=int, default=16)
+    parser.add_argument("--tokenizer", default="byte")
+    parser.add_argument("--input-file", default=None,
+                        help="prompts: JSONL with text_input, or raw lines")
+    parser.add_argument("--input-dataset", default=None,
+                        choices=["openorca", "cnn_dailymail"],
+                        help="public dataset prompts (network-gated; "
+                             "falls back to synthetic offline)")
+    parser.add_argument("--measurement-interval", type=int, default=4000)
+    parser.add_argument("--stability-percentage", type=float, default=50.0)
+    parser.add_argument("--max-trials", type=int, default=6)
+    parser.add_argument("--artifact-dir", default=None,
+                        help="keep inputs/exports here (default: temp)")
+    parser.add_argument("--profile-export-file", default=None)
+    parser.add_argument("--export-json", default=None)
+    parser.add_argument("--export-csv", default=None)
+    parser.add_argument("--export-parquet", default=None)
+    parser.add_argument("--generate-plots", action="store_true",
+                        help="write TTFT/ITL/latency PNGs to the "
+                             "artifact dir")
+    parser.add_argument("--random-seed", type=int, default=0)
+    parser.add_argument("--no-streaming", action="store_true")
+    return parser
+
+
+def run(argv: Optional[List[str]] = None, core=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        tokenizer = get_tokenizer(args.tokenizer)
+    except ValueError as e:
+        print("genai failed: %s" % e, file=sys.stderr)
+        return 1
+
+    artifact_dir = args.artifact_dir or tempfile.mkdtemp(prefix="genai_")
+    os.makedirs(artifact_dir, exist_ok=True)
+    # Tell the user where inputs/profile export land (genai-perf
+    # prints its artifact directory too); default runs use a temp dir.
+    print("genai artifacts: %s" % artifact_dir, file=sys.stderr)
+    input_path = os.path.join(artifact_dir, "llm_inputs.json")
+    export_path = (args.profile_export_file
+                   or os.path.join(artifact_dir, "profile_export.json"))
+
+    inputs = LlmInputs(tokenizer, seed=args.random_seed)
+    try:
+        if args.input_dataset:
+            from client_tpu.genai.datasets import dataset_prompts
+            from client_tpu.genai.synthetic import SyntheticPromptGenerator
+
+            prompts = dataset_prompts(
+                args.input_dataset, args.num_prompts,
+                fallback_generator=SyntheticPromptGenerator(
+                    tokenizer, args.random_seed),
+                fallback_tokens_mean=args.synthetic_input_tokens_mean,
+                fallback_tokens_stddev=args.synthetic_input_tokens_stddev,
+            )
+        else:
+            prompts = inputs.create_prompts(
+                num_prompts=args.num_prompts,
+                input_tokens_mean=args.synthetic_input_tokens_mean,
+                input_tokens_stddev=args.synthetic_input_tokens_stddev,
+                input_file=args.input_file,
+            )
+    except (OSError, ValueError) as e:
+        print("genai failed: %s" % e, file=sys.stderr)
+        return 1
+    output_format = (
+        OutputFormat.OPENAI_CHAT if args.service_kind == "openai"
+        else OutputFormat.TRITON_GENERATE
+    )
+    dataset = inputs.convert_to_dataset(
+        prompts, output_format,
+        output_tokens_mean=args.output_tokens_mean,
+        model_name=args.model,
+    )
+    inputs.write_dataset(dataset, input_path)
+
+    perf_args = Profiler.build_args(
+        model=args.model, url=args.url, service_kind=args.service_kind,
+        protocol=args.protocol, concurrency=args.concurrency,
+        input_path=input_path, export_path=export_path,
+        measurement_interval_ms=args.measurement_interval,
+        stability_pct=args.stability_percentage,
+        max_trials=args.max_trials,
+        streaming=not args.no_streaming,
+        extra_args=(["--endpoint", args.endpoint]
+                    if args.service_kind == "openai" else None),
+    )
+    rc = Profiler.run(perf_args, core=core)
+    if rc != 0:
+        return rc
+
+    parser_obj = LLMProfileDataParser(export_path, tokenizer)
+    stats_list = [parser_obj.get_statistics(i)
+                  for i in range(len(parser_obj.experiments))]
+    for stats in stats_list:
+        print(console_report(stats))
+    if args.export_json:
+        export_json(stats_list, args.export_json,
+                    meta={"model": args.model,
+                          "concurrency": args.concurrency,
+                          "num_prompts": len(prompts)})
+    if args.export_csv:
+        export_csv(stats_list, args.export_csv)
+    if args.export_parquet:
+        from client_tpu.genai.exporters import export_parquet
+
+        export_parquet(stats_list, args.export_parquet)
+    if args.generate_plots:
+        from client_tpu.genai.plots import generate_plots
+
+        for path in generate_plots(stats_list, artifact_dir,
+                                   title=args.model):
+            print("genai plot: %s" % path, file=sys.stderr)
+    return 0
+
+
+def main():
+    sys.exit(run())
+
+
+if __name__ == "__main__":
+    main()

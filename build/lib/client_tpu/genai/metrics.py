@@ -1,0 +1,157 @@
+"""LLM metrics from the perf profile export (parity: genai-perf
+llm_metrics.py:45-254 — LLMProfileDataParser / LLMMetrics /
+Statistics).
+
+The profile export (client_tpu.perf.report.export_profile) records one
+``timestamp`` and a list of ``response_timestamps`` per request; with
+the decoupled generate model every streamed response carries one
+token, so response counts double as output token counts unless
+response texts are present for a tokenizer to count."""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+import numpy as np
+
+NANOS = 1_000_000_000
+
+
+class LLMMetrics:
+    """Raw per-request series for one experiment (load level)."""
+
+    def __init__(
+        self,
+        time_to_first_token_ns: List[int],
+        inter_token_latency_ns: List[int],
+        request_latency_ns: List[int],
+        output_token_counts: List[int],
+        benchmark_duration_s: float,
+        itl_sequences_ns: List[List[int]] = None,
+    ):
+        self.time_to_first_token_ns = time_to_first_token_ns
+        self.inter_token_latency_ns = inter_token_latency_ns
+        self.request_latency_ns = request_latency_ns
+        self.output_token_counts = output_token_counts
+        self.benchmark_duration_s = benchmark_duration_s
+        # Per-request gap sequences (token position preserved) — the
+        # token-position heatmap's input; the flat series above cannot
+        # reconstruct position.
+        self.itl_sequences_ns = itl_sequences_ns or []
+
+    @property
+    def request_throughput_per_s(self) -> float:
+        if self.benchmark_duration_s <= 0:
+            return 0.0
+        return len(self.request_latency_ns) / self.benchmark_duration_s
+
+    @property
+    def output_token_throughput_per_s(self) -> float:
+        if self.benchmark_duration_s <= 0:
+            return 0.0
+        return sum(self.output_token_counts) / self.benchmark_duration_s
+
+    def data(self) -> Dict[str, List[float]]:
+        """Metric name -> samples (ns series reported in ms)."""
+        return {
+            "time_to_first_token_ms": [
+                t / 1e6 for t in self.time_to_first_token_ns],
+            "inter_token_latency_ms": [
+                t / 1e6 for t in self.inter_token_latency_ns],
+            "request_latency_ms": [
+                t / 1e6 for t in self.request_latency_ns],
+            "output_token_count": list(map(float,
+                                           self.output_token_counts)),
+        }
+
+
+_PERCENTILES = (25, 50, 75, 90, 95, 99)
+
+
+class Statistics:
+    """mean/std/min/max/p25..p99 for every metric plus the throughput
+    scalars (parity: genai-perf Statistics)."""
+
+    def __init__(self, metrics: LLMMetrics):
+        self._metrics = metrics
+        self.stats: Dict[str, Dict[str, float]] = {}
+        for name, samples in metrics.data().items():
+            if not samples:
+                continue
+            arr = np.array(samples, dtype=np.float64)
+            entry = {
+                "mean": float(arr.mean()),
+                "std": float(arr.std()),
+                "min": float(arr.min()),
+                "max": float(arr.max()),
+            }
+            for p in _PERCENTILES:
+                entry["p%d" % p] = float(np.percentile(arr, p))
+            self.stats[name] = entry
+        self.stats["request_throughput_per_s"] = {
+            "value": metrics.request_throughput_per_s}
+        self.stats["output_token_throughput_per_s"] = {
+            "value": metrics.output_token_throughput_per_s}
+
+    @property
+    def metrics(self) -> LLMMetrics:
+        return self._metrics
+
+    def as_dict(self) -> Dict[str, Dict[str, float]]:
+        return self.stats
+
+
+class LLMProfileDataParser:
+    """Reads the profile-export JSON and derives LLM metrics per
+    experiment (parity: LLMProfileDataParser llm_metrics.py)."""
+
+    def __init__(self, filename: str = None, tokenizer=None,
+                 document: Optional[dict] = None):
+        if document is None:
+            with open(filename) as f:
+                document = json.load(f)
+        self._doc = document
+        self._tokenizer = tokenizer
+        self.experiments: List[dict] = self._doc.get("experiments", [])
+
+    def get_statistics(self, experiment_index: int = 0) -> Statistics:
+        return Statistics(self.get_metrics(experiment_index))
+
+    def get_metrics(self, experiment_index: int = 0) -> LLMMetrics:
+        exp = self.experiments[experiment_index]
+        requests = exp.get("requests", [])
+        ttft, latency, token_counts = [], [], []
+        min_start, max_end = None, None
+        itl_sequences = []
+        for req in requests:
+            start = req["timestamp"]
+            responses = sorted(req.get("response_timestamps", []))
+            if not responses:
+                continue
+            ttft.append(responses[0] - start)
+            gaps = [b - a for a, b in zip(responses, responses[1:])]
+            if gaps:
+                itl_sequences.append(gaps)
+            latency.append(responses[-1] - start)
+            token_counts.append(self._token_count(req, responses))
+            min_start = start if min_start is None else min(min_start, start)
+            max_end = (responses[-1] if max_end is None
+                       else max(max_end, responses[-1]))
+        # The flat series is DERIVED from the sequences — one source
+        # of truth, so stats and the token-position heatmap can never
+        # disagree.
+        itl = [gap for seq in itl_sequences for gap in seq]
+        duration_s = (
+            (max_end - min_start) / NANOS
+            if min_start is not None and max_end > min_start else 0.0
+        )
+        return LLMMetrics(ttft, itl, latency, token_counts, duration_s,
+                          itl_sequences_ns=itl_sequences)
+
+    def _token_count(self, req: dict, responses: List[int]) -> int:
+        texts = req.get("response_texts")
+        if texts and self._tokenizer is not None:
+            return len(self._tokenizer.encode("".join(texts)))
+        # decoupled generate: one token per streamed response
+        return len(responses)

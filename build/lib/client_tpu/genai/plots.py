@@ -1,0 +1,133 @@
+"""Benchmark visualizations (parity: genai-perf plots/ — the
+reference ships plotly scatter/box/heatmap; matplotlib is used here
+since it is what the image provides).
+
+All functions write PNG files into an artifact directory and return
+the written paths.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List
+
+from client_tpu.genai.metrics import Statistics
+
+
+def _matplotlib():
+    import matplotlib
+
+    matplotlib.use("Agg")  # headless
+    import matplotlib.pyplot as plt
+
+    return plt
+
+
+def generate_plots(stats_list: List[Statistics], artifact_dir: str,
+                   title: str = "") -> List[str]:
+    """TTFT scatter, ITL box, request-latency distribution — one file
+    each (parity: genai-perf ttft/itl/latency plot set)."""
+    plt = _matplotlib()
+    os.makedirs(artifact_dir, exist_ok=True)
+    written: List[str] = []
+
+    def save(fig, name: str):
+        path = os.path.join(artifact_dir, name)
+        fig.savefig(path, dpi=110, bbox_inches="tight")
+        plt.close(fig)
+        written.append(path)
+
+    # 1. TTFT scatter per request, one series per experiment.
+    fig, ax = plt.subplots(figsize=(7, 4))
+    for idx, stats in enumerate(stats_list):
+        samples = stats.metrics.data().get("time_to_first_token_ms", [])
+        ax.scatter(range(len(samples)), samples, s=12,
+                   label="experiment %d" % idx)
+    ax.set_xlabel("request index")
+    ax.set_ylabel("time to first token (ms)")
+    ax.set_title(title or "Time to first token")
+    if len(stats_list) > 1:
+        ax.legend()
+    save(fig, "time_to_first_token.png")
+
+    # 2. Inter-token latency box plot per experiment.
+    fig, ax = plt.subplots(figsize=(7, 4))
+    series = [
+        stats.metrics.data().get("inter_token_latency_ms", []) or [0.0]
+        for stats in stats_list
+    ]
+    ax.boxplot(series,
+               labels=["exp %d" % i for i in range(len(series))])
+    ax.set_ylabel("inter-token latency (ms)")
+    ax.set_title(title or "Inter-token latency")
+    save(fig, "inter_token_latency.png")
+
+    # 3. Request latency histogram.
+    fig, ax = plt.subplots(figsize=(7, 4))
+    for idx, stats in enumerate(stats_list):
+        samples = stats.metrics.data().get("request_latency_ms", [])
+        if samples:
+            ax.hist(samples, bins=min(30, max(5, len(samples) // 2)),
+                    alpha=0.6, label="experiment %d" % idx)
+    ax.set_xlabel("request latency (ms)")
+    ax.set_ylabel("requests")
+    ax.set_title(title or "Request latency distribution")
+    if len(stats_list) > 1:
+        ax.legend()
+    save(fig, "request_latency.png")
+
+    # 4. Token-position heatmap: requests (rows) x token position
+    # (cols), colored by inter-token gap — makes chunked-delivery
+    # stalls visible as vertical bands (parity: genai-perf's token
+    # position vs latency heatmap).
+    import numpy as np
+
+    sequences = []
+    for stats in stats_list:
+        sequences.extend(
+            [g / 1e6 for g in seq]
+            for seq in getattr(stats.metrics, "itl_sequences_ns", [])
+        )
+    if sequences:
+        width = max(len(seq) for seq in sequences)
+        grid = np.full((len(sequences), width), np.nan)
+        for row, seq in enumerate(sequences):
+            grid[row, :len(seq)] = seq
+        fig, ax = plt.subplots(figsize=(8, 4.5))
+        image = ax.imshow(grid, aspect="auto", interpolation="nearest",
+                          cmap="viridis")
+        fig.colorbar(image, ax=ax, label="inter-token latency (ms)")
+        ax.set_xlabel("token position")
+        ax.set_ylabel("request")
+        ax.set_title(title or "Inter-token latency by token position")
+        save(fig, "token_position_heatmap.png")
+
+    # 5. Per-experiment comparison: throughputs and latency summary
+    # side by side (parity: genai-perf's cross-experiment comparison
+    # plots for concurrency sweeps).
+    fig, axes = plt.subplots(1, 3, figsize=(12, 4))
+    labels = ["exp %d" % i for i in range(len(stats_list))]
+    x = np.arange(len(stats_list))
+    axes[0].bar(x, [s.metrics.request_throughput_per_s
+                    for s in stats_list])
+    axes[0].set_title("request throughput (/s)")
+    axes[1].bar(x, [s.metrics.output_token_throughput_per_s
+                    for s in stats_list])
+    axes[1].set_title("token throughput (/s)")
+    ttft_p50, ttft_p99 = [], []
+    for stats in stats_list:
+        entry = stats.stats.get("time_to_first_token_ms", {})
+        ttft_p50.append(entry.get("p50", 0.0))
+        ttft_p99.append(entry.get("p99", 0.0))
+    bar_width = 0.4
+    axes[2].bar(x - bar_width / 2, ttft_p50, bar_width, label="p50")
+    axes[2].bar(x + bar_width / 2, ttft_p99, bar_width, label="p99")
+    axes[2].set_title("TTFT (ms)")
+    axes[2].legend()
+    for ax in axes:
+        ax.set_xticks(x)
+        ax.set_xticklabels(labels)
+    fig.suptitle(title or "Experiment comparison")
+    save(fig, "experiment_comparison.png")
+
+    return written

@@ -1,0 +1,55 @@
+"""Synthetic prompt generation (parity: genai-perf
+synthetic_prompt_generator.py — prompts of approximately N tokens
+drawn from a corpus, with a configurable standard deviation)."""
+
+from __future__ import annotations
+
+import random
+from typing import List
+
+# A small built-in corpus; prompts are built by sampling words until
+# the tokenizer says the target token count is reached.
+_CORPUS = (
+    "the quick brown fox jumps over a lazy dog while seventy silent "
+    "engineers measure throughput latency and bandwidth across oceans "
+    "of accelerated matrix multiplication hardware scheduling tokens "
+    "streams batches caches prompts answers questions models layers "
+    "attention heads embedding tables gradients optimizers learning "
+    "rates compilers graphs kernels memory tiles vectors scalars"
+).split()
+
+
+class SyntheticPromptGenerator:
+    def __init__(self, tokenizer, seed: int = 0):
+        self._tokenizer = tokenizer
+        self._rng = random.Random(seed)
+
+    def generate_prompt(self, mean_tokens: int, stddev_tokens: float = 0.0
+                        ) -> str:
+        target = max(1, int(self._rng.gauss(mean_tokens, stddev_tokens))
+                     if stddev_tokens > 0 else mean_tokens)
+        # Track the token count incrementally (word + separator) so
+        # generation stays linear in the target length; re-encoding
+        # the joined prompt every step is quadratic for long contexts.
+        words: List[str] = []
+        total = 0
+        while total < target:
+            for word in self._rng.choices(_CORPUS, k=8):
+                piece = word if not words else " " + word
+                words.append(word)
+                total += self._count(piece)
+                if total >= target:
+                    break
+        # trim down to the target token count
+        while len(words) > 1 and total > target:
+            tail = words.pop()
+            total -= self._count(" " + tail)
+        return " ".join(words) if words else _CORPUS[0]
+
+    def generate_prompts(self, count: int, mean_tokens: int,
+                         stddev_tokens: float = 0.0) -> List[str]:
+        return [self.generate_prompt(mean_tokens, stddev_tokens)
+                for _ in range(count)]
+
+    def _count(self, text: str) -> int:
+        return len(self._tokenizer.encode(text))

@@ -1,0 +1,46 @@
+"""Tokenizer wrapper (parity: genai-perf tokenizer.py — a thin HF
+AutoTokenizer facade). ``byte`` gives a dependency-free tokenizer that
+matches the in-repo LLM's byte-level vocabulary; any other name is
+resolved through transformers when available."""
+
+from __future__ import annotations
+
+from typing import List
+
+DEFAULT_TOKENIZER = "byte"
+
+
+class ByteLevelTokenizer:
+    """One token per UTF-8 byte — matches models.llm.ByteTokenizer."""
+
+    def encode(self, text: str) -> List[int]:
+        return list(text.encode("utf-8"))
+
+    def decode(self, ids) -> str:
+        return bytes(int(i) & 0xFF for i in ids).decode("utf-8", "replace")
+
+
+class HfTokenizer:
+    def __init__(self, name: str, trust_remote_code: bool = False):
+        from transformers import AutoTokenizer  # gated import
+
+        self._tok = AutoTokenizer.from_pretrained(
+            name, trust_remote_code=trust_remote_code)
+
+    def encode(self, text: str) -> List[int]:
+        return self._tok.encode(text, add_special_tokens=False)
+
+    def decode(self, ids) -> str:
+        return self._tok.decode(ids)
+
+
+def get_tokenizer(name: str = DEFAULT_TOKENIZER,
+                  trust_remote_code: bool = False):
+    if name in (None, "", "byte", DEFAULT_TOKENIZER):
+        return ByteLevelTokenizer()
+    try:
+        return HfTokenizer(name, trust_remote_code)
+    except Exception as e:
+        raise ValueError(
+            "unable to load tokenizer '%s' (%s); use 'byte' for the "
+            "dependency-free byte-level tokenizer" % (name, e))

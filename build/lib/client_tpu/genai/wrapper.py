@@ -1,0 +1,49 @@
+"""Perf-harness invocation wrapper (parity: genai-perf wrapper.py,
+which renders a perf_analyzer command line; here the harness is the
+in-repo client_tpu.perf CLI, invoked in-process with the same argv it
+would receive as a subprocess)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+class Profiler:
+    @staticmethod
+    def build_args(
+        model: str,
+        url: str = "localhost:8001",
+        service_kind: str = "triton",
+        protocol: str = "grpc",
+        concurrency: int = 1,
+        input_path: str = "llm_inputs.json",
+        export_path: str = "profile_export.json",
+        measurement_interval_ms: int = 4000,
+        stability_pct: float = 50.0,
+        max_trials: int = 6,
+        streaming: bool = True,
+        extra_args: Optional[List[str]] = None,
+    ) -> List[str]:
+        args = [
+            "-m", model,
+            "--service-kind", service_kind,
+            "--input-data", input_path,
+            "--profile-export-file", export_path,
+            "--concurrency-range", str(concurrency),
+            "--measurement-interval", str(measurement_interval_ms),
+            "--stability-percentage", str(stability_pct),
+            "--max-trials", str(max_trials),
+        ]
+        if service_kind != "inprocess":
+            args += ["-u", url, "-i", protocol]
+        if streaming:
+            args.append("--streaming")
+        if extra_args:
+            args += list(extra_args)
+        return args
+
+    @staticmethod
+    def run(args: List[str], core=None) -> int:
+        from client_tpu.perf.cli import run
+
+        return run(args, core=core)

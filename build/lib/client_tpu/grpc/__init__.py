@@ -1,0 +1,16 @@
+"""KServe-v2 gRPC client (sync + callback-async + decoupled bidi
+streaming). ``client_tpu.grpc.aio`` holds the asyncio mirror."""
+
+from client_tpu._infer_common import InferInput, InferRequestedOutput  # noqa: F401
+from client_tpu._plugin import (  # noqa: F401
+    BasicAuth,
+    InferenceServerClientPlugin,
+    Request,
+)
+from client_tpu.grpc._client import (  # noqa: F401
+    CallContext,
+    InferenceServerClient,
+    KeepAliveOptions,
+)
+from client_tpu.grpc._utils import InferResult  # noqa: F401
+from client_tpu.utils import InferenceServerException  # noqa: F401

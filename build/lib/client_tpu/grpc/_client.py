@@ -1,0 +1,680 @@
+"""Synchronous + callback-async gRPC client for the KServe-v2 protocol.
+
+API-parity surface with the reference
+tritonclient.grpc.InferenceServerClient (grpc/_client.py:119+), with
+the CUDA shared-memory verbs re-targeted at TPU HBM regions.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Optional, Sequence
+
+import grpc
+from google.protobuf import json_format
+
+from client_tpu._infer_common import InferInput, InferRequestedOutput
+from client_tpu._plugin import InferenceServerClientBase
+from client_tpu.grpc._utils import (
+    InferResult,
+    get_error_grpc,
+    get_inference_request,
+    raise_error,
+    raise_error_grpc,
+    set_parameter,
+)
+from client_tpu.protocol import inference_pb2 as pb
+from client_tpu.protocol.service import GRPCInferenceServiceStub
+from client_tpu.utils import InferenceServerException
+
+# Default channel options: unlimited message sizes (tensors), matching
+# the reference's MAX_GRPC_MESSAGE_SIZE unlimiting (grpc_client.cc).
+_DEFAULT_CHANNEL_OPTIONS = [
+    ("grpc.max_send_message_length", -1),
+    ("grpc.max_receive_message_length", -1),
+]
+
+
+class KeepAliveOptions:
+    """GRPC keepalive knobs (reference grpc_client.h:62-82)."""
+
+    def __init__(
+        self,
+        keepalive_time_ms: int = 2**31 - 1,
+        keepalive_timeout_ms: int = 20000,
+        keepalive_permit_without_calls: bool = False,
+        http2_max_pings_without_data: int = 2,
+    ):
+        self.keepalive_time_ms = keepalive_time_ms
+        self.keepalive_timeout_ms = keepalive_timeout_ms
+        self.keepalive_permit_without_calls = keepalive_permit_without_calls
+        self.http2_max_pings_without_data = http2_max_pings_without_data
+
+    def channel_args(self):
+        return [
+            ("grpc.keepalive_time_ms", self.keepalive_time_ms),
+            ("grpc.keepalive_timeout_ms", self.keepalive_timeout_ms),
+            (
+                "grpc.keepalive_permit_without_calls",
+                int(self.keepalive_permit_without_calls),
+            ),
+            (
+                "grpc.http2.max_pings_without_data",
+                self.http2_max_pings_without_data,
+            ),
+        ]
+
+
+class CallContext:
+    """Cancellation handle returned by :meth:`async_infer`."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._call = None
+        self._cancelled = False
+
+    def _set_call(self, call):
+        with self._lock:
+            self._call = call
+            if self._cancelled:
+                call.cancel()
+
+    def cancel(self):
+        with self._lock:
+            self._cancelled = True
+            if self._call is not None:
+                self._call.cancel()
+
+
+def _metadata_from_headers(headers: Optional[dict]):
+    if not headers:
+        return None
+    return tuple((str(k).lower(), str(v)) for k, v in headers.items())
+
+
+class _InferStream:
+    """Decoupled bidi stream: a queue-fed request iterator writes into
+    ModelStreamInfer; a reader thread dispatches each response (or
+    error) to the user callback. Mirrors the reference's
+    _InferStream/_RequestIterator design (grpc/_infer_stream.py:38,170)."""
+
+    _CLOSE = object()
+
+    def __init__(self, callback: Callable, verbose: bool = False):
+        self._callback = callback
+        self._verbose = verbose
+        self._request_queue: "queue.Queue" = queue.Queue()
+        self._response_iterator = None
+        self._worker: Optional[threading.Thread] = None
+        self._active = True
+
+    def _request_iterator(self):
+        while True:
+            item = self._request_queue.get()
+            if item is self._CLOSE:
+                return
+            yield item
+
+    def start(self, stub, metadata, timeout):
+        self._response_iterator = stub.ModelStreamInfer(
+            self._request_iterator(), metadata=metadata, timeout=timeout
+        )
+        self._worker = threading.Thread(target=self._process_responses, daemon=True)
+        self._worker.start()
+
+    def enqueue_request(self, request: pb.ModelInferRequest):
+        if not self._active:
+            raise_error("stream is closed")
+        self._request_queue.put(request)
+
+    def _process_responses(self):
+        try:
+            for response in self._response_iterator:
+                if response.error_message:
+                    self._callback(
+                        None, InferenceServerException(response.error_message)
+                    )
+                else:
+                    self._callback(InferResult(response.infer_response), None)
+        except grpc.RpcError as rpc_error:
+            if rpc_error.code() != grpc.StatusCode.CANCELLED:
+                self._callback(None, get_error_grpc(rpc_error))
+        except Exception as e:  # defensive: surface reader crashes
+            self._callback(None, InferenceServerException(str(e)))
+
+    def close(self, cancel_requests: bool = False):
+        if not self._active:
+            return
+        self._active = False
+        if cancel_requests and self._response_iterator is not None:
+            self._response_iterator.cancel()
+        self._request_queue.put(self._CLOSE)
+        if self._worker is not None:
+            self._worker.join()
+
+
+class InferenceServerClient(InferenceServerClientBase):
+    """A client talking to a KServe-v2 gRPC endpoint.
+
+    One client owns one channel; ``infer`` is thread-safe, the
+    stream-control methods are not (same contract as the reference,
+    grpc_client.h:86-89).
+    """
+
+    def __init__(
+        self,
+        url: str,
+        verbose: bool = False,
+        ssl: bool = False,
+        root_certificates: Optional[str] = None,
+        private_key: Optional[str] = None,
+        certificate_chain: Optional[str] = None,
+        creds: Optional[grpc.ChannelCredentials] = None,
+        keepalive_options: Optional[KeepAliveOptions] = None,
+        channel_args: Optional[list] = None,
+    ):
+        super().__init__()
+        self._url = url
+        self._verbose = verbose
+        options = list(_DEFAULT_CHANNEL_OPTIONS)
+        if keepalive_options is not None:
+            options += keepalive_options.channel_args()
+        if channel_args is not None:
+            options += list(channel_args)
+        if creds is not None:
+            self._channel = grpc.secure_channel(url, creds, options=options)
+        elif ssl:
+            rc = open(root_certificates, "rb").read() if root_certificates else None
+            pk = open(private_key, "rb").read() if private_key else None
+            cc = open(certificate_chain, "rb").read() if certificate_chain else None
+            credentials = grpc.ssl_channel_credentials(rc, pk, cc)
+            self._channel = grpc.secure_channel(url, credentials, options=options)
+        else:
+            self._channel = grpc.insecure_channel(url, options=options)
+        self._client_stub = GRPCInferenceServiceStub(self._channel)
+        self._stream: Optional[_InferStream] = None
+
+    # -- lifecycle -------------------------------------------------------
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_value, traceback):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def close(self):
+        self.stop_stream()
+        self._channel.close()
+
+    def _log(self, *args):
+        if self._verbose:
+            print(*args)
+
+    def _metadata(self, headers):
+        headers = self._call_plugin(dict(headers) if headers else {})
+        return _metadata_from_headers(headers)
+
+    # -- health / metadata ----------------------------------------------
+
+    def is_server_live(self, headers=None, client_timeout=None) -> bool:
+        try:
+            response = self._client_stub.ServerLive(
+                pb.ServerLiveRequest(),
+                metadata=self._metadata(headers),
+                timeout=client_timeout,
+            )
+            return response.live
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    def is_server_ready(self, headers=None, client_timeout=None) -> bool:
+        try:
+            response = self._client_stub.ServerReady(
+                pb.ServerReadyRequest(),
+                metadata=self._metadata(headers),
+                timeout=client_timeout,
+            )
+            return response.ready
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    def is_model_ready(
+        self, model_name, model_version="", headers=None, client_timeout=None
+    ) -> bool:
+        try:
+            response = self._client_stub.ModelReady(
+                pb.ModelReadyRequest(name=model_name, version=model_version),
+                metadata=self._metadata(headers),
+                timeout=client_timeout,
+            )
+            return response.ready
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    def get_server_metadata(self, headers=None, as_json=False, client_timeout=None):
+        try:
+            response = self._client_stub.ServerMetadata(
+                pb.ServerMetadataRequest(),
+                metadata=self._metadata(headers),
+                timeout=client_timeout,
+            )
+            return _maybe_json(response, as_json)
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    def get_model_metadata(
+        self,
+        model_name,
+        model_version="",
+        headers=None,
+        as_json=False,
+        client_timeout=None,
+    ):
+        try:
+            response = self._client_stub.ModelMetadata(
+                pb.ModelMetadataRequest(name=model_name, version=model_version),
+                metadata=self._metadata(headers),
+                timeout=client_timeout,
+            )
+            return _maybe_json(response, as_json)
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    def get_model_config(
+        self,
+        model_name,
+        model_version="",
+        headers=None,
+        as_json=False,
+        client_timeout=None,
+    ):
+        try:
+            response = self._client_stub.ModelConfig(
+                pb.ModelConfigRequest(name=model_name, version=model_version),
+                metadata=self._metadata(headers),
+                timeout=client_timeout,
+            )
+            return _maybe_json(response, as_json)
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    def get_model_repository_index(self, headers=None, as_json=False,
+                                   client_timeout=None):
+        try:
+            response = self._client_stub.RepositoryIndex(
+                pb.RepositoryIndexRequest(),
+                metadata=self._metadata(headers),
+                timeout=client_timeout,
+            )
+            return _maybe_json(response, as_json)
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    # -- model control ---------------------------------------------------
+
+    def load_model(
+        self, model_name, headers=None, config=None, files=None, client_timeout=None
+    ):
+        request = pb.RepositoryModelLoadRequest(model_name=model_name)
+        if config is not None:
+            request.parameters["config"].string_param = config
+        if files is not None:
+            for path, content in files.items():
+                request.parameters[path].bytes_param = content
+        try:
+            self._client_stub.RepositoryModelLoad(
+                request, metadata=self._metadata(headers), timeout=client_timeout
+            )
+            self._log("Loaded model '%s'" % model_name)
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    def unload_model(
+        self, model_name, headers=None, unload_dependents=False, client_timeout=None
+    ):
+        request = pb.RepositoryModelUnloadRequest(model_name=model_name)
+        request.parameters["unload_dependents"].bool_param = unload_dependents
+        try:
+            self._client_stub.RepositoryModelUnload(
+                request, metadata=self._metadata(headers), timeout=client_timeout
+            )
+            self._log("Unloaded model '%s'" % model_name)
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    # -- statistics / settings ------------------------------------------
+
+    def get_inference_statistics(
+        self, model_name="", model_version="", headers=None, as_json=False,
+        client_timeout=None
+    ):
+        try:
+            response = self._client_stub.ModelStatistics(
+                pb.ModelStatisticsRequest(name=model_name, version=model_version),
+                metadata=self._metadata(headers),
+                timeout=client_timeout,
+            )
+            return _maybe_json(response, as_json)
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    def update_trace_settings(
+        self, model_name="", settings=None, headers=None, as_json=False,
+        client_timeout=None
+    ):
+        request = pb.TraceSettingRequest(model_name=model_name or "")
+        for key, value in (settings or {}).items():
+            if value is None:
+                request.settings[key]  # clears the setting
+            elif isinstance(value, (list, tuple)):
+                request.settings[key].value.extend(str(v) for v in value)
+            else:
+                request.settings[key].value.append(str(value))
+        try:
+            response = self._client_stub.TraceSetting(
+                request, metadata=self._metadata(headers), timeout=client_timeout
+            )
+            return _maybe_json(response, as_json)
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    def get_trace_settings(self, model_name="", headers=None, as_json=False,
+                           client_timeout=None):
+        return self.update_trace_settings(
+            model_name=model_name, settings={}, headers=headers, as_json=as_json,
+            client_timeout=client_timeout
+        )
+
+    def update_log_settings(self, settings, headers=None, as_json=False,
+                            client_timeout=None):
+        request = pb.LogSettingsRequest()
+        for key, value in (settings or {}).items():
+            if isinstance(value, bool):
+                request.settings[key].bool_param = value
+            elif isinstance(value, int):
+                request.settings[key].uint32_param = value
+            else:
+                request.settings[key].string_param = str(value)
+        try:
+            response = self._client_stub.LogSettings(
+                request, metadata=self._metadata(headers), timeout=client_timeout
+            )
+            return _maybe_json(response, as_json)
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    def get_log_settings(self, headers=None, as_json=False, client_timeout=None):
+        return self.update_log_settings({}, headers=headers, as_json=as_json,
+                                        client_timeout=client_timeout)
+
+    # -- shared memory ---------------------------------------------------
+
+    def get_system_shared_memory_status(
+        self, region_name="", headers=None, as_json=False, client_timeout=None
+    ):
+        try:
+            response = self._client_stub.SystemSharedMemoryStatus(
+                pb.SystemSharedMemoryStatusRequest(name=region_name),
+                metadata=self._metadata(headers),
+                timeout=client_timeout,
+            )
+            return _maybe_json(response, as_json)
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    def register_system_shared_memory(
+        self, name, key, byte_size, offset=0, headers=None, client_timeout=None
+    ):
+        try:
+            self._client_stub.SystemSharedMemoryRegister(
+                pb.SystemSharedMemoryRegisterRequest(
+                    name=name, key=key, offset=offset, byte_size=byte_size
+                ),
+                metadata=self._metadata(headers),
+                timeout=client_timeout,
+            )
+            self._log("Registered system shared memory with name '%s'" % name)
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    def unregister_system_shared_memory(self, name="", headers=None,
+                                        client_timeout=None):
+        try:
+            self._client_stub.SystemSharedMemoryUnregister(
+                pb.SystemSharedMemoryUnregisterRequest(name=name),
+                metadata=self._metadata(headers),
+                timeout=client_timeout,
+            )
+            self._log("Unregistered system shared memory with name '%s'" % name)
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    def get_tpu_shared_memory_status(
+        self, region_name="", headers=None, as_json=False, client_timeout=None
+    ):
+        try:
+            response = self._client_stub.TpuSharedMemoryStatus(
+                pb.TpuSharedMemoryStatusRequest(name=region_name),
+                metadata=self._metadata(headers),
+                timeout=client_timeout,
+            )
+            return _maybe_json(response, as_json)
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    def register_tpu_shared_memory(
+        self, name, raw_handle, device_id, byte_size, headers=None,
+        client_timeout=None
+    ):
+        """Register a TPU HBM region by its serialized handle (the TPU
+        analogue of register_cuda_shared_memory, reference
+        grpc/_client.py:1339)."""
+        try:
+            self._client_stub.TpuSharedMemoryRegister(
+                pb.TpuSharedMemoryRegisterRequest(
+                    name=name,
+                    raw_handle=raw_handle,
+                    device_id=device_id,
+                    byte_size=byte_size,
+                ),
+                metadata=self._metadata(headers),
+                timeout=client_timeout,
+            )
+            self._log("Registered TPU shared memory with name '%s'" % name)
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    def unregister_tpu_shared_memory(self, name="", headers=None,
+                                     client_timeout=None):
+        try:
+            self._client_stub.TpuSharedMemoryUnregister(
+                pb.TpuSharedMemoryUnregisterRequest(name=name),
+                metadata=self._metadata(headers),
+                timeout=client_timeout,
+            )
+            self._log("Unregistered TPU shared memory with name '%s'" % name)
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    # Drop-in aliases for code migrating from the CUDA client.
+    get_cuda_shared_memory_status = get_tpu_shared_memory_status
+    register_cuda_shared_memory = register_tpu_shared_memory
+    unregister_cuda_shared_memory = unregister_tpu_shared_memory
+
+    # -- inference -------------------------------------------------------
+
+    def infer(
+        self,
+        model_name: str,
+        inputs: Sequence[InferInput],
+        model_version: str = "",
+        outputs: Optional[Sequence[InferRequestedOutput]] = None,
+        request_id: str = "",
+        sequence_id: int = 0,
+        sequence_start: bool = False,
+        sequence_end: bool = False,
+        priority: int = 0,
+        timeout: Optional[int] = None,
+        client_timeout: Optional[float] = None,
+        headers: Optional[dict] = None,
+        compression_algorithm: Optional[str] = None,
+        parameters: Optional[dict] = None,
+    ) -> InferResult:
+        request = get_inference_request(
+            model_name=model_name,
+            inputs=inputs,
+            model_version=model_version,
+            outputs=outputs,
+            request_id=request_id,
+            sequence_id=sequence_id,
+            sequence_start=sequence_start,
+            sequence_end=sequence_end,
+            priority=priority,
+            timeout=timeout,
+            parameters=parameters,
+        )
+        try:
+            response = self._client_stub.ModelInfer(
+                request,
+                metadata=self._metadata(headers),
+                timeout=client_timeout,
+                compression=_grpc_compression(compression_algorithm),
+            )
+            return InferResult(response)
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    def async_infer(
+        self,
+        model_name: str,
+        inputs: Sequence[InferInput],
+        callback: Callable,
+        model_version: str = "",
+        outputs: Optional[Sequence[InferRequestedOutput]] = None,
+        request_id: str = "",
+        sequence_id: int = 0,
+        sequence_start: bool = False,
+        sequence_end: bool = False,
+        priority: int = 0,
+        timeout: Optional[int] = None,
+        client_timeout: Optional[float] = None,
+        headers: Optional[dict] = None,
+        compression_algorithm: Optional[str] = None,
+        parameters: Optional[dict] = None,
+    ) -> CallContext:
+        """Issue the request without blocking; ``callback(result,
+        error)`` fires on the grpc completion thread. Returns a
+        :class:`CallContext` whose ``cancel()`` aborts the call."""
+        request = get_inference_request(
+            model_name=model_name,
+            inputs=inputs,
+            model_version=model_version,
+            outputs=outputs,
+            request_id=request_id,
+            sequence_id=sequence_id,
+            sequence_start=sequence_start,
+            sequence_end=sequence_end,
+            priority=priority,
+            timeout=timeout,
+            parameters=parameters,
+        )
+
+        def _done(call_future):
+            try:
+                result = InferResult(call_future.result())
+                callback(result, None)
+            except grpc.RpcError as rpc_error:
+                callback(None, get_error_grpc(rpc_error))
+            except grpc.FutureCancelledError:
+                callback(None, InferenceServerException("request cancelled",
+                                                        status="CANCELLED"))
+            except Exception as e:
+                callback(None, InferenceServerException(str(e)))
+
+        context = CallContext()
+        call_future = self._client_stub.ModelInfer.future(
+            request,
+            metadata=self._metadata(headers),
+            timeout=client_timeout,
+            compression=_grpc_compression(compression_algorithm),
+        )
+        context._set_call(call_future)
+        call_future.add_done_callback(_done)
+        return context
+
+    # -- streaming -------------------------------------------------------
+
+    def start_stream(
+        self,
+        callback: Callable,
+        stream_timeout: Optional[float] = None,
+        headers: Optional[dict] = None,
+    ):
+        """Open the bidi ModelStreamInfer stream; every response (or
+        error) is delivered to ``callback(result, error)``."""
+        if self._stream is not None:
+            raise_error("stream is already running; call stop_stream first")
+        self._stream = _InferStream(callback, self._verbose)
+        self._stream.start(self._client_stub, self._metadata(headers), stream_timeout)
+
+    def stop_stream(self, cancel_requests: bool = False):
+        if self._stream is not None:
+            self._stream.close(cancel_requests)
+            self._stream = None
+
+    def async_stream_infer(
+        self,
+        model_name: str,
+        inputs: Sequence[InferInput],
+        model_version: str = "",
+        outputs: Optional[Sequence[InferRequestedOutput]] = None,
+        request_id: str = "",
+        sequence_id: int = 0,
+        sequence_start: bool = False,
+        sequence_end: bool = False,
+        priority: int = 0,
+        timeout: Optional[int] = None,
+        enable_empty_final_response: bool = False,
+        parameters: Optional[dict] = None,
+    ):
+        if self._stream is None:
+            raise_error("stream is not running; call start_stream first")
+        request = get_inference_request(
+            model_name=model_name,
+            inputs=inputs,
+            model_version=model_version,
+            outputs=outputs,
+            request_id=request_id,
+            sequence_id=sequence_id,
+            sequence_start=sequence_start,
+            sequence_end=sequence_end,
+            priority=priority,
+            timeout=timeout,
+            parameters=parameters,
+        )
+        if enable_empty_final_response:
+            request.parameters["triton_enable_empty_final_response"].bool_param = True
+        self._stream.enqueue_request(request)
+
+
+def _maybe_json(message, as_json: bool):
+    if as_json:
+        return json_format.MessageToDict(message, preserving_proto_field_name=True)
+    return message
+
+
+def _grpc_compression(algorithm: Optional[str]):
+    if algorithm is None or algorithm == "none":
+        return None
+    if algorithm == "deflate":
+        return grpc.Compression.Deflate
+    if algorithm == "gzip":
+        return grpc.Compression.Gzip
+    raise_error("unsupported compression algorithm %s" % algorithm)

@@ -1,0 +1,184 @@
+"""gRPC transport helpers: error mapping, proto assembly, result
+wrapper. Parity surface: reference tritonclient/grpc/_utils.py and
+_infer_result semantics."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import grpc
+import numpy as np
+
+from client_tpu._infer_common import (
+    InferInput,
+    InferRequestedOutput,
+    build_request_parameters,
+)
+from client_tpu.protocol import inference_pb2 as pb
+from client_tpu.utils import (
+    InferenceServerException,
+    deserialize_bf16_tensor,
+    deserialize_bytes_tensor,
+    triton_to_np_dtype,
+)
+
+
+def get_error_grpc(rpc_error: grpc.RpcError) -> InferenceServerException:
+    try:
+        code = rpc_error.code().name
+        details = rpc_error.details()
+    except Exception:  # not a Call object
+        code = None
+        details = str(rpc_error)
+    return InferenceServerException(msg=details, status=code, debug_details=rpc_error)
+
+
+def raise_error_grpc(rpc_error: grpc.RpcError):
+    raise get_error_grpc(rpc_error) from None
+
+
+def raise_error(msg: str):
+    raise InferenceServerException(msg=msg) from None
+
+
+def set_parameter(param: pb.InferParameter, value) -> None:
+    if isinstance(value, bool):
+        param.bool_param = value
+    elif isinstance(value, int):
+        param.int64_param = value
+    elif isinstance(value, float):
+        param.double_param = value
+    elif isinstance(value, str):
+        param.string_param = value
+    else:
+        raise_error("unsupported parameter type %s" % type(value).__name__)
+
+
+def parameter_value(param: pb.InferParameter):
+    which = param.WhichOneof("parameter_choice")
+    return getattr(param, which) if which else None
+
+
+def get_inference_request(
+    model_name: str,
+    inputs: Sequence[InferInput],
+    model_version: str = "",
+    outputs: Optional[Sequence[InferRequestedOutput]] = None,
+    request_id: str = "",
+    sequence_id: int = 0,
+    sequence_start: bool = False,
+    sequence_end: bool = False,
+    priority: int = 0,
+    timeout: Optional[int] = None,
+    parameters: Optional[dict] = None,
+) -> pb.ModelInferRequest:
+    """Assemble a ModelInferRequest proto. Tensor data travels in
+    ``raw_input_contents`` (one bytes blob per non-shm input, in input
+    order), shared-memory inputs as region parameters — the same wire
+    convention as the reference (grpc_client.cc:1419-1580)."""
+    request = pb.ModelInferRequest(
+        model_name=model_name, model_version=model_version
+    )
+    if request_id:
+        request.id = request_id
+    params = build_request_parameters(
+        sequence_id=sequence_id,
+        sequence_start=sequence_start,
+        sequence_end=sequence_end,
+        priority=priority,
+        timeout=timeout,
+        parameters=parameters,
+    )
+    for key, value in params.items():
+        set_parameter(request.parameters[key], value)
+
+    for infer_input in inputs:
+        infer_input.validate()
+        tensor = request.inputs.add()
+        tensor.name = infer_input.name()
+        tensor.datatype = infer_input.datatype()
+        tensor.shape.extend(infer_input.shape())
+        for key, value in infer_input.parameters().items():
+            set_parameter(tensor.parameters[key], value)
+        shm = infer_input.shared_memory()
+        if shm is not None:
+            region, byte_size, offset = shm
+            tensor.parameters["shared_memory_region"].string_param = region
+            tensor.parameters["shared_memory_byte_size"].int64_param = byte_size
+            if offset:
+                tensor.parameters["shared_memory_offset"].int64_param = offset
+        else:
+            request.raw_input_contents.append(infer_input.raw_data())
+
+    if outputs:
+        for infer_output in outputs:
+            tensor = request.outputs.add()
+            tensor.name = infer_output.name()
+            for key, value in infer_output.parameters().items():
+                set_parameter(tensor.parameters[key], value)
+            if infer_output.class_count():
+                tensor.parameters["classification"].int64_param = (
+                    infer_output.class_count()
+                )
+            shm = infer_output.shared_memory()
+            if shm is not None:
+                region, byte_size, offset = shm
+                tensor.parameters["shared_memory_region"].string_param = region
+                tensor.parameters["shared_memory_byte_size"].int64_param = byte_size
+                if offset:
+                    tensor.parameters["shared_memory_offset"].int64_param = offset
+    return request
+
+
+class InferResult:
+    """Result wrapper over a ModelInferResponse."""
+
+    def __init__(self, response: pb.ModelInferResponse):
+        self._response = response
+        # map output name -> (tensor, raw index or None)
+        self._index = {}
+        raw_idx = 0
+        for tensor in response.outputs:
+            if "shared_memory_region" in tensor.parameters:
+                self._index[tensor.name] = (tensor, None)
+            else:
+                idx = raw_idx if raw_idx < len(response.raw_output_contents) else None
+                self._index[tensor.name] = (tensor, idx)
+                raw_idx += 1
+
+    @classmethod
+    def from_response(cls, response) -> "InferResult":
+        return cls(response)
+
+    def get_response(self) -> pb.ModelInferResponse:
+        return self._response
+
+    def get_output(self, name: str):
+        """The InferOutputTensor proto for ``name`` or None."""
+        entry = self._index.get(name)
+        return entry[0] if entry else None
+
+    def as_numpy(self, name: str) -> Optional[np.ndarray]:
+        """Decode output ``name`` to numpy. Returns None for outputs
+        living in shared memory (read them via the region API)."""
+        entry = self._index.get(name)
+        if entry is None:
+            return None
+        tensor, raw_idx = entry
+        if raw_idx is None:
+            return None
+        shape = [int(d) for d in tensor.shape]
+        raw = self._response.raw_output_contents[raw_idx]
+        if tensor.datatype == "BYTES":
+            return deserialize_bytes_tensor(raw).reshape(shape)
+        if tensor.datatype == "BF16":
+            return deserialize_bf16_tensor(raw).reshape(shape)
+        np_dtype = triton_to_np_dtype(tensor.datatype)
+        if np_dtype is None:
+            raise InferenceServerException(
+                "unknown output datatype %s" % tensor.datatype
+            )
+        return np.frombuffer(raw, dtype=np_dtype).reshape(shape)
+
+    def get_parameters(self) -> dict:
+        return {k: parameter_value(v) for k, v in self._response.parameters.items()}

@@ -1,0 +1,337 @@
+"""asyncio gRPC client — mirror of client_tpu.grpc for event-loop
+applications (parity: reference tritonclient.grpc.aio,
+grpc/aio/__init__.py:50+)."""
+
+from __future__ import annotations
+
+from typing import AsyncIterator, Optional, Sequence
+
+import grpc
+
+from client_tpu._infer_common import InferInput, InferRequestedOutput
+from client_tpu._plugin import InferenceServerClientBase
+from client_tpu.grpc._client import (
+    KeepAliveOptions,
+    _DEFAULT_CHANNEL_OPTIONS,
+    _metadata_from_headers,
+)
+from client_tpu.grpc._utils import (
+    InferResult,
+    get_error_grpc,
+    get_inference_request,
+    raise_error,
+)
+from client_tpu.protocol import inference_pb2 as pb
+from client_tpu.protocol.service import GRPCInferenceServiceStub
+from client_tpu.utils import InferenceServerException
+
+__all__ = [
+    "InferenceServerClient",
+    "InferInput",
+    "InferRequestedOutput",
+    "InferResult",
+    "KeepAliveOptions",
+]
+
+
+class InferenceServerClient(InferenceServerClientBase):
+    """asyncio flavor: every RPC is a coroutine; ``stream_infer``
+    consumes an async iterator of requests and yields results."""
+
+    def __init__(
+        self,
+        url: str,
+        verbose: bool = False,
+        ssl: bool = False,
+        root_certificates: Optional[str] = None,
+        private_key: Optional[str] = None,
+        certificate_chain: Optional[str] = None,
+        creds: Optional[grpc.ChannelCredentials] = None,
+        keepalive_options: Optional[KeepAliveOptions] = None,
+        channel_args: Optional[list] = None,
+    ):
+        super().__init__()
+        options = list(_DEFAULT_CHANNEL_OPTIONS)
+        if keepalive_options is not None:
+            options += keepalive_options.channel_args()
+        if channel_args is not None:
+            options += list(channel_args)
+        if creds is not None:
+            self._channel = grpc.aio.secure_channel(url, creds, options=options)
+        elif ssl:
+            rc = open(root_certificates, "rb").read() if root_certificates else None
+            pk = open(private_key, "rb").read() if private_key else None
+            cc = open(certificate_chain, "rb").read() if certificate_chain else None
+            credentials = grpc.ssl_channel_credentials(rc, pk, cc)
+            self._channel = grpc.aio.secure_channel(
+                url, credentials, options=options
+            )
+        else:
+            self._channel = grpc.aio.insecure_channel(url, options=options)
+        self._client_stub = GRPCInferenceServiceStub(self._channel)
+        self._verbose = verbose
+
+    async def __aenter__(self):
+        return self
+
+    async def __aexit__(self, exc_type, exc_value, traceback):
+        await self.close()
+
+    async def close(self):
+        await self._channel.close()
+
+    def _metadata(self, headers):
+        headers = self._call_plugin(dict(headers) if headers else {})
+        return _metadata_from_headers(headers)
+
+    async def _call(self, method, request, headers, client_timeout=None):
+        try:
+            return await method(
+                request, metadata=self._metadata(headers), timeout=client_timeout
+            )
+        except grpc.RpcError as rpc_error:
+            raise get_error_grpc(rpc_error) from None
+
+    # -- health / metadata ----------------------------------------------
+
+    async def is_server_live(self, headers=None, client_timeout=None) -> bool:
+        response = await self._call(
+            self._client_stub.ServerLive, pb.ServerLiveRequest(), headers,
+            client_timeout,
+        )
+        return response.live
+
+    async def is_server_ready(self, headers=None, client_timeout=None) -> bool:
+        response = await self._call(
+            self._client_stub.ServerReady, pb.ServerReadyRequest(), headers,
+            client_timeout,
+        )
+        return response.ready
+
+    async def is_model_ready(self, model_name, model_version="", headers=None,
+                             client_timeout=None) -> bool:
+        response = await self._call(
+            self._client_stub.ModelReady,
+            pb.ModelReadyRequest(name=model_name, version=model_version),
+            headers, client_timeout,
+        )
+        return response.ready
+
+    async def get_server_metadata(self, headers=None, client_timeout=None):
+        return await self._call(
+            self._client_stub.ServerMetadata, pb.ServerMetadataRequest(),
+            headers, client_timeout,
+        )
+
+    async def get_model_metadata(self, model_name, model_version="",
+                                 headers=None, client_timeout=None):
+        return await self._call(
+            self._client_stub.ModelMetadata,
+            pb.ModelMetadataRequest(name=model_name, version=model_version),
+            headers, client_timeout,
+        )
+
+    async def get_model_config(self, model_name, model_version="",
+                               headers=None, client_timeout=None):
+        return await self._call(
+            self._client_stub.ModelConfig,
+            pb.ModelConfigRequest(name=model_name, version=model_version),
+            headers, client_timeout,
+        )
+
+    async def get_model_repository_index(self, headers=None,
+                                         client_timeout=None):
+        return await self._call(
+            self._client_stub.RepositoryIndex, pb.RepositoryIndexRequest(),
+            headers, client_timeout,
+        )
+
+    async def load_model(self, model_name, headers=None, config=None,
+                         client_timeout=None):
+        request = pb.RepositoryModelLoadRequest(model_name=model_name)
+        if config is not None:
+            request.parameters["config"].string_param = config
+        await self._call(self._client_stub.RepositoryModelLoad, request,
+                         headers, client_timeout)
+
+    async def unload_model(self, model_name, headers=None,
+                           client_timeout=None):
+        await self._call(
+            self._client_stub.RepositoryModelUnload,
+            pb.RepositoryModelUnloadRequest(model_name=model_name),
+            headers, client_timeout,
+        )
+
+    async def get_inference_statistics(self, model_name="", model_version="",
+                                       headers=None, client_timeout=None):
+        return await self._call(
+            self._client_stub.ModelStatistics,
+            pb.ModelStatisticsRequest(name=model_name, version=model_version),
+            headers, client_timeout,
+        )
+
+    # -- trace / log settings --------------------------------------------
+
+    async def update_trace_settings(self, model_name="", settings=None,
+                                    headers=None, client_timeout=None):
+        """Asyncio mirror of the sync client's trace-settings update
+        (parity: reference grpc/aio/__init__.py update_trace_settings)."""
+        request = pb.TraceSettingRequest(model_name=model_name or "")
+        for key, value in (settings or {}).items():
+            if value is None:
+                request.settings[key]  # noqa: B018 — clears the setting
+            elif isinstance(value, (list, tuple)):
+                request.settings[key].value.extend(str(v) for v in value)
+            else:
+                request.settings[key].value.append(str(value))
+        return await self._call(self._client_stub.TraceSetting, request,
+                                headers, client_timeout)
+
+    async def get_trace_settings(self, model_name="", headers=None,
+                                 client_timeout=None):
+        return await self.update_trace_settings(
+            model_name=model_name, settings={}, headers=headers,
+            client_timeout=client_timeout)
+
+    async def update_log_settings(self, settings, headers=None,
+                                  client_timeout=None):
+        request = pb.LogSettingsRequest()
+        for key, value in (settings or {}).items():
+            if isinstance(value, bool):
+                request.settings[key].bool_param = value
+            elif isinstance(value, int):
+                request.settings[key].uint32_param = value
+            else:
+                request.settings[key].string_param = str(value)
+        return await self._call(self._client_stub.LogSettings, request,
+                                headers, client_timeout)
+
+    async def get_log_settings(self, headers=None, client_timeout=None):
+        return await self.update_log_settings(
+            {}, headers=headers, client_timeout=client_timeout)
+
+    # -- shared memory ---------------------------------------------------
+
+    async def get_system_shared_memory_status(self, region_name="",
+                                              headers=None,
+                                              client_timeout=None):
+        return await self._call(
+            self._client_stub.SystemSharedMemoryStatus,
+            pb.SystemSharedMemoryStatusRequest(name=region_name), headers,
+            client_timeout,
+        )
+
+    async def register_system_shared_memory(self, name, key, byte_size,
+                                            offset=0, headers=None,
+                                            client_timeout=None):
+        await self._call(
+            self._client_stub.SystemSharedMemoryRegister,
+            pb.SystemSharedMemoryRegisterRequest(
+                name=name, key=key, offset=offset, byte_size=byte_size
+            ),
+            headers, client_timeout,
+        )
+
+    async def unregister_system_shared_memory(self, name="", headers=None,
+                                              client_timeout=None):
+        await self._call(
+            self._client_stub.SystemSharedMemoryUnregister,
+            pb.SystemSharedMemoryUnregisterRequest(name=name), headers,
+            client_timeout,
+        )
+
+    async def get_tpu_shared_memory_status(self, region_name="", headers=None,
+                                           client_timeout=None):
+        return await self._call(
+            self._client_stub.TpuSharedMemoryStatus,
+            pb.TpuSharedMemoryStatusRequest(name=region_name), headers,
+            client_timeout,
+        )
+
+    async def register_tpu_shared_memory(self, name, raw_handle, device_id,
+                                         byte_size, headers=None,
+                                         client_timeout=None):
+        await self._call(
+            self._client_stub.TpuSharedMemoryRegister,
+            pb.TpuSharedMemoryRegisterRequest(
+                name=name, raw_handle=raw_handle, device_id=device_id,
+                byte_size=byte_size,
+            ),
+            headers, client_timeout,
+        )
+
+    async def unregister_tpu_shared_memory(self, name="", headers=None,
+                                           client_timeout=None):
+        await self._call(
+            self._client_stub.TpuSharedMemoryUnregister,
+            pb.TpuSharedMemoryUnregisterRequest(name=name), headers,
+            client_timeout,
+        )
+
+    get_cuda_shared_memory_status = get_tpu_shared_memory_status
+    register_cuda_shared_memory = register_tpu_shared_memory
+    unregister_cuda_shared_memory = unregister_tpu_shared_memory
+
+    # -- inference -------------------------------------------------------
+
+    async def infer(
+        self,
+        model_name: str,
+        inputs: Sequence[InferInput],
+        model_version: str = "",
+        outputs: Optional[Sequence[InferRequestedOutput]] = None,
+        request_id: str = "",
+        sequence_id: int = 0,
+        sequence_start: bool = False,
+        sequence_end: bool = False,
+        priority: int = 0,
+        timeout: Optional[int] = None,
+        client_timeout: Optional[float] = None,
+        headers: Optional[dict] = None,
+        parameters: Optional[dict] = None,
+    ) -> InferResult:
+        request = get_inference_request(
+            model_name=model_name, inputs=inputs, model_version=model_version,
+            outputs=outputs, request_id=request_id, sequence_id=sequence_id,
+            sequence_start=sequence_start, sequence_end=sequence_end,
+            priority=priority, timeout=timeout, parameters=parameters,
+        )
+        response = await self._call(
+            self._client_stub.ModelInfer, request, headers, client_timeout
+        )
+        return InferResult(response)
+
+    async def stream_infer(
+        self,
+        inputs_iterator: AsyncIterator[dict],
+        stream_timeout: Optional[float] = None,
+        headers: Optional[dict] = None,
+    ):
+        """Bidi streaming: consumes an async iterator of infer-call
+        kwargs dicts (same keys as :meth:`infer`), yields
+        (InferResult, error) tuples as responses arrive."""
+
+        async def _requests():
+            async for kwargs in inputs_iterator:
+                enable_empty_final = kwargs.pop(
+                    "enable_empty_final_response", False
+                )
+                request = get_inference_request(**kwargs)
+                if enable_empty_final:
+                    request.parameters[
+                        "triton_enable_empty_final_response"
+                    ].bool_param = True
+                yield request
+
+        try:
+            stream = self._client_stub.ModelStreamInfer(
+                _requests(), metadata=self._metadata(headers),
+                timeout=stream_timeout,
+            )
+            async for response in stream:
+                if response.error_message:
+                    yield None, InferenceServerException(response.error_message)
+                else:
+                    yield InferResult(response.infer_response), None
+        except grpc.RpcError as rpc_error:
+            raise get_error_grpc(rpc_error) from None

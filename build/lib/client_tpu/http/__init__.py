@@ -1,0 +1,15 @@
+"""KServe-v2 HTTP/REST client (sync + callback-async, binary tensor
+protocol). ``client_tpu.http.aio`` holds the asyncio mirror."""
+
+from client_tpu._infer_common import InferInput, InferRequestedOutput  # noqa: F401
+from client_tpu._plugin import (  # noqa: F401
+    BasicAuth,
+    InferenceServerClientPlugin,
+    Request,
+)
+from client_tpu.http._client import (  # noqa: F401
+    InferAsyncRequest,
+    InferenceServerClient,
+    InferResult,
+)
+from client_tpu.utils import InferenceServerException  # noqa: F401

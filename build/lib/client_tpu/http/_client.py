@@ -1,0 +1,429 @@
+"""Synchronous + callback-async HTTP/REST client for the KServe-v2
+protocol (binary tensor extension included).
+
+API-parity surface with the reference tritonclient.http
+InferenceServerClient (http/_client.py:102+). The reference pools
+geventhttpclient connections; here a thread-safe pool of stdlib
+``http.client`` keep-alive connections plus a worker pool provides
+the same concurrency model without extra dependencies.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, Optional, Sequence, Tuple
+from urllib.parse import quote, urlparse
+
+import http.client
+
+from client_tpu._infer_common import InferInput, InferRequestedOutput
+from client_tpu._plugin import InferenceServerClientBase
+from client_tpu.http import _endpoints as ep
+from client_tpu.protocol.http_wire import (
+    HEADER_LEN,
+    DecodedOutput,
+    compress_body,
+    decode_infer_response,
+    decompress_body,
+    encode_infer_request,
+)
+from client_tpu.utils import InferenceServerException
+
+
+class InferResult:
+    """Result wrapper over a decoded HTTP inference response."""
+
+    def __init__(self, header: dict, outputs: Dict[str, DecodedOutput]):
+        self._header = header
+        self._outputs = outputs
+
+    @classmethod
+    def from_response_body(
+        cls, body: bytes, header_length: Optional[int] = None
+    ) -> "InferResult":
+        header, outputs = decode_infer_response(body, header_length)
+        return cls(header, outputs)
+
+    def get_response(self) -> dict:
+        return self._header
+
+    def get_output(self, name: str) -> Optional[dict]:
+        for entry in self._header.get("outputs", []):
+            if entry.get("name") == name:
+                return entry
+        return None
+
+    def as_numpy(self, name: str):
+        decoded = self._outputs.get(name)
+        return decoded.as_numpy() if decoded is not None else None
+
+    def get_parameters(self) -> dict:
+        return self._header.get("parameters", {})
+
+
+class InferAsyncRequest:
+    """Handle returned by async_infer; get_result() joins the worker."""
+
+    def __init__(self, future, verbose: bool = False):
+        self._future = future
+        self._verbose = verbose
+
+    def get_result(self, block: bool = True, timeout: Optional[float] = None
+                   ) -> InferResult:
+        if not block and not self._future.done():
+            raise InferenceServerException("result is not ready")
+        result = self._future.result(timeout=timeout)
+        if isinstance(result, Exception):
+            raise result
+        return result
+
+
+class _ConnectionPool:
+    """Thread-safe pool of keep-alive HTTP connections."""
+
+    def __init__(self, host: str, port: int, size: int, timeout: float,
+                 ssl: bool = False, ssl_context=None):
+        self._host = host
+        self._port = port
+        self._timeout = timeout
+        self._ssl = ssl
+        self._ssl_context = ssl_context
+        self._idle: "queue.Queue" = queue.Queue()
+        self._size = size
+        self._created = 0
+        self._lock = threading.Lock()
+
+    def _new_connection(self):
+        if self._ssl:
+            return http.client.HTTPSConnection(
+                self._host, self._port, timeout=self._timeout,
+                context=self._ssl_context,
+            )
+        return http.client.HTTPConnection(
+            self._host, self._port, timeout=self._timeout
+        )
+
+    def acquire(self):
+        try:
+            return self._idle.get_nowait()
+        except queue.Empty:
+            pass
+        with self._lock:
+            if self._created < self._size:
+                self._created += 1
+                return self._new_connection()
+        return self._idle.get()
+
+    def release(self, conn, broken: bool = False):
+        if broken:
+            try:
+                conn.close()
+            except Exception:
+                pass
+            conn = self._new_connection()
+        self._idle.put(conn)
+
+    def close(self):
+        while True:
+            try:
+                conn = self._idle.get_nowait()
+                conn.close()
+            except queue.Empty:
+                break
+
+
+class InferenceServerClient(InferenceServerClientBase):
+    """A client talking to a KServe-v2 HTTP/REST endpoint.
+
+    ``concurrency`` sizes both the connection pool and the async
+    worker pool (reference http/_client.py:178-188 semantics).
+    """
+
+    def __init__(
+        self,
+        url: str,
+        verbose: bool = False,
+        concurrency: int = 1,
+        connection_timeout: float = 60.0,
+        network_timeout: float = 60.0,
+        ssl: bool = False,
+        ssl_context=None,
+    ):
+        super().__init__()
+        if "://" in url:
+            parsed = urlparse(url)
+        else:
+            parsed = urlparse(("https://" if ssl else "http://") + url)
+        if parsed.hostname is None:
+            raise InferenceServerException("invalid url '%s'" % url)
+        self._host = parsed.hostname
+        self._port = parsed.port or (443 if ssl else 80)
+        self._verbose = verbose
+        self._pool = _ConnectionPool(
+            self._host, self._port, max(concurrency, 1),
+            max(connection_timeout, network_timeout), ssl, ssl_context,
+        )
+        self._executor = ThreadPoolExecutor(max_workers=max(concurrency, 1))
+        self._closed = False
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_value, traceback):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def close(self):
+        if not self._closed:
+            self._closed = True
+            self._executor.shutdown(wait=True)
+            self._pool.close()
+
+    # -- low-level request -----------------------------------------------
+
+    def _request(
+        self,
+        method: str,
+        path: str,
+        body: Optional[bytes] = None,
+        headers: Optional[dict] = None,
+    ) -> Tuple[int, dict, bytes]:
+        headers = self._call_plugin(dict(headers) if headers else {})
+        conn = self._pool.acquire()
+        broken = False
+        try:
+            conn.request(method, path, body=body, headers=headers or {})
+            response = conn.getresponse()
+            payload = response.read()
+            resp_headers = {k.lower(): v for k, v in response.getheaders()}
+            if self._verbose:
+                print("%s %s -> %d (%d bytes)"
+                      % (method, path, response.status, len(payload)))
+            return response.status, resp_headers, payload
+        except (http.client.HTTPException, OSError) as e:
+            broken = True
+            raise InferenceServerException(
+                "connection to %s:%d failed: %s" % (self._host, self._port, e)
+            )
+        finally:
+            self._pool.release(conn, broken)
+
+    def _get_json(self, path: str, headers=None, method: str = "GET",
+                  body: Optional[bytes] = None):
+        status, _, payload = self._request(method, path, body=body,
+                                           headers=headers)
+        ep.raise_if_error(status, payload)
+        return json.loads(payload) if payload else {}
+
+    # -- health / metadata ----------------------------------------------
+
+    def is_server_live(self, headers=None) -> bool:
+        status, _, _ = self._request("GET", "/v2/health/live", headers=headers)
+        return status == 200
+
+    def is_server_ready(self, headers=None) -> bool:
+        status, _, _ = self._request("GET", "/v2/health/ready", headers=headers)
+        return status == 200
+
+    def is_model_ready(self, model_name, model_version="", headers=None) -> bool:
+        status, _, _ = self._request(
+            "GET", ep.ready_path(model_name, model_version), headers=headers
+        )
+        return status == 200
+
+    def get_server_metadata(self, headers=None) -> dict:
+        return self._get_json("/v2", headers)
+
+    def get_model_metadata(self, model_name, model_version="", headers=None
+                           ) -> dict:
+        return self._get_json(ep.model_path(model_name, model_version), headers)
+
+    def get_model_config(self, model_name, model_version="", headers=None
+                         ) -> dict:
+        return self._get_json(ep.config_path(model_name, model_version), headers)
+
+    def get_model_repository_index(self, headers=None) -> list:
+        return self._get_json(ep.repo_index_path(), headers, method="POST",
+                              body=b"{}")
+
+    # -- model control ---------------------------------------------------
+
+    def load_model(self, model_name, headers=None, config=None, files=None):
+        self._get_json(ep.repo_load_path(model_name), headers, method="POST",
+                       body=ep.load_model_body(config))
+
+    def unload_model(self, model_name, headers=None, unload_dependents=False):
+        self._get_json(ep.repo_unload_path(model_name), headers, method="POST",
+                       body=ep.unload_model_body(unload_dependents))
+
+    # -- statistics / settings ------------------------------------------
+
+    def get_inference_statistics(self, model_name="", model_version="",
+                                 headers=None) -> dict:
+        return self._get_json(ep.stats_path(model_name, model_version), headers)
+
+    def update_trace_settings(self, model_name="", settings=None, headers=None
+                              ) -> dict:
+        return self._get_json(ep.trace_path(model_name), headers, method="POST",
+                              body=json.dumps(settings or {}).encode())
+
+    def get_trace_settings(self, model_name="", headers=None) -> dict:
+        return self._get_json(ep.trace_path(model_name), headers)
+
+    def update_log_settings(self, settings, headers=None) -> dict:
+        return self._get_json(ep.logging_path(), headers, method="POST",
+                              body=json.dumps(settings or {}).encode())
+
+    def get_log_settings(self, headers=None) -> dict:
+        return self._get_json(ep.logging_path(), headers)
+
+    # -- shared memory ---------------------------------------------------
+
+    def get_system_shared_memory_status(self, region_name="", headers=None
+                                        ) -> list:
+        return self._get_json(ep.shm_status_path("system", region_name),
+                              headers)
+
+    def register_system_shared_memory(self, name, key, byte_size, offset=0,
+                                      headers=None):
+        self._get_json(
+            ep.shm_register_path("system", name), headers, method="POST",
+            body=ep.system_shm_register_body(key, byte_size, offset),
+        )
+
+    def unregister_system_shared_memory(self, name="", headers=None):
+        self._get_json(ep.shm_unregister_path("system", name), headers,
+                       method="POST", body=b"{}")
+
+    def get_tpu_shared_memory_status(self, region_name="", headers=None) -> list:
+        return self._get_json(ep.shm_status_path("tpu", region_name), headers)
+
+    def register_tpu_shared_memory(self, name, raw_handle, device_id,
+                                   byte_size, headers=None):
+        """raw_handle: serialized TPU region descriptor (posted base64,
+        the same shape the reference uses for cudaIpcMemHandle_t —
+        http_client.cc:1712)."""
+        self._get_json(
+            ep.shm_register_path("tpu", name), headers, method="POST",
+            body=ep.tpu_shm_register_body(raw_handle, device_id, byte_size),
+        )
+
+    def unregister_tpu_shared_memory(self, name="", headers=None):
+        self._get_json(ep.shm_unregister_path("tpu", name), headers,
+                       method="POST", body=b"{}")
+
+    get_cuda_shared_memory_status = get_tpu_shared_memory_status
+    register_cuda_shared_memory = register_tpu_shared_memory
+    unregister_cuda_shared_memory = unregister_tpu_shared_memory
+
+    # -- inference -------------------------------------------------------
+
+    @staticmethod
+    def generate_request_body(
+        inputs: Sequence[InferInput],
+        outputs: Optional[Sequence[InferRequestedOutput]] = None,
+        request_id: str = "",
+        sequence_id: int = 0,
+        sequence_start: bool = False,
+        sequence_end: bool = False,
+        priority: int = 0,
+        timeout: Optional[int] = None,
+        parameters: Optional[dict] = None,
+    ) -> Tuple[bytes, Optional[int]]:
+        """Build an inference request body without sending it
+        (reference http/_client.py:1219). Returns (body,
+        json_header_length or None)."""
+        return encode_infer_request(
+            inputs=inputs, outputs=outputs, request_id=request_id,
+            sequence_id=sequence_id, sequence_start=sequence_start,
+            sequence_end=sequence_end, priority=priority, timeout=timeout,
+            parameters=parameters,
+        )
+
+    @staticmethod
+    def parse_response_body(
+        response_body: bytes, header_length: Optional[int] = None
+    ) -> InferResult:
+        """Decode an inference response body obtained elsewhere
+        (reference http/_client.py:1304)."""
+        return InferResult.from_response_body(response_body, header_length)
+
+    def infer(
+        self,
+        model_name: str,
+        inputs: Sequence[InferInput],
+        model_version: str = "",
+        outputs: Optional[Sequence[InferRequestedOutput]] = None,
+        request_id: str = "",
+        sequence_id: int = 0,
+        sequence_start: bool = False,
+        sequence_end: bool = False,
+        priority: int = 0,
+        timeout: Optional[int] = None,
+        headers: Optional[dict] = None,
+        query_params: Optional[dict] = None,
+        parameters: Optional[dict] = None,
+        request_compression_algorithm: Optional[str] = None,
+        response_compression_algorithm: Optional[str] = None,
+    ) -> InferResult:
+        """``request_compression_algorithm`` /
+        ``response_compression_algorithm`` select per-call body
+        compression ("gzip" or "deflate"; None = off), mirroring the
+        reference HTTP client (http_client.cc:2130-2247). Response
+        compression is a preference the server honors via
+        Accept-Encoding."""
+        body, json_len = encode_infer_request(
+            inputs=inputs, outputs=outputs, request_id=request_id,
+            sequence_id=sequence_id, sequence_start=sequence_start,
+            sequence_end=sequence_end, priority=priority, timeout=timeout,
+            parameters=parameters,
+        )
+        request_headers = dict(headers) if headers else {}
+        if json_len is not None:
+            request_headers[HEADER_LEN] = str(json_len)
+            request_headers["Content-Type"] = "application/octet-stream"
+        else:
+            request_headers["Content-Type"] = "application/json"
+        if request_compression_algorithm:
+            body = compress_body(body, request_compression_algorithm)
+            request_headers["Content-Encoding"] = \
+                request_compression_algorithm
+        if response_compression_algorithm:
+            request_headers["Accept-Encoding"] = \
+                response_compression_algorithm
+        path = ep.infer_path(model_name, model_version)
+        if query_params:
+            path += "?" + "&".join(
+                "%s=%s" % (quote(str(k)), quote(str(v)))
+                for k, v in query_params.items()
+            )
+        status, resp_headers, payload = self._request(
+            "POST", path, body=body, headers=request_headers
+        )
+        payload = decompress_body(
+            payload, resp_headers.get("content-encoding"))
+        ep.raise_if_error(status, payload)
+        response_header_len = resp_headers.get(HEADER_LEN.lower())
+        return InferResult.from_response_body(
+            payload, int(response_header_len) if response_header_len else None
+        )
+
+    def async_infer(self, model_name, inputs, **kwargs) -> InferAsyncRequest:
+        """Run infer on the worker pool; returns a handle whose
+        get_result() blocks for the InferResult."""
+
+        def _work():
+            try:
+                return self.infer(model_name, inputs, **kwargs)
+            except Exception as e:  # delivered via get_result
+                return e
+
+        return InferAsyncRequest(self._executor.submit(_work), self._verbose)

@@ -1,0 +1,115 @@
+"""Shared URI construction + error mapping for the sync and asyncio
+HTTP clients (single source of truth for the /v2 URI scheme)."""
+
+from __future__ import annotations
+
+import base64
+import json
+from urllib.parse import quote
+
+from client_tpu.utils import InferenceServerException
+
+
+def model_path(model_name: str, model_version: str = "") -> str:
+    path = "/v2/models/%s" % quote(model_name)
+    if model_version:
+        path += "/versions/%s" % model_version
+    return path
+
+
+def ready_path(model_name: str, model_version: str = "") -> str:
+    return model_path(model_name, model_version) + "/ready"
+
+
+def config_path(model_name: str, model_version: str = "") -> str:
+    return model_path(model_name, model_version) + "/config"
+
+
+def infer_path(model_name: str, model_version: str = "") -> str:
+    return model_path(model_name, model_version) + "/infer"
+
+
+def stats_path(model_name: str = "", model_version: str = "") -> str:
+    if model_name:
+        return model_path(model_name, model_version) + "/stats"
+    return "/v2/models/stats"
+
+
+def repo_index_path() -> str:
+    return "/v2/repository/index"
+
+
+def repo_load_path(model_name: str) -> str:
+    return "/v2/repository/models/%s/load" % quote(model_name)
+
+
+def repo_unload_path(model_name: str) -> str:
+    return "/v2/repository/models/%s/unload" % quote(model_name)
+
+
+def shm_status_path(kind: str, region_name: str = "") -> str:
+    if region_name:
+        return "/v2/%ssharedmemory/region/%s/status" % (kind, quote(region_name))
+    return "/v2/%ssharedmemory/status" % kind
+
+
+def shm_register_path(kind: str, region_name: str) -> str:
+    return "/v2/%ssharedmemory/region/%s/register" % (kind, quote(region_name))
+
+
+def shm_unregister_path(kind: str, region_name: str = "") -> str:
+    if region_name:
+        return "/v2/%ssharedmemory/region/%s/unregister" % (
+            kind, quote(region_name),
+        )
+    return "/v2/%ssharedmemory/unregister" % kind
+
+
+def trace_path(model_name: str = "") -> str:
+    if model_name:
+        return "/v2/models/%s/trace/setting" % quote(model_name)
+    return "/v2/trace/setting"
+
+
+def logging_path() -> str:
+    return "/v2/logging"
+
+
+def system_shm_register_body(key: str, byte_size: int, offset: int) -> bytes:
+    return json.dumps(
+        {"key": key, "offset": offset, "byte_size": byte_size}
+    ).encode()
+
+
+def tpu_shm_register_body(raw_handle: bytes, device_id: int,
+                          byte_size: int) -> bytes:
+    return json.dumps({
+        "raw_handle": {"b64": base64.b64encode(raw_handle).decode()},
+        "device_id": device_id,
+        "byte_size": byte_size,
+    }).encode()
+
+
+def load_model_body(config=None) -> bytes:
+    body: dict = {}
+    if config is not None:
+        body.setdefault("parameters", {})["config"] = config
+    return json.dumps(body).encode()
+
+
+def unload_model_body(unload_dependents: bool = False) -> bytes:
+    return json.dumps(
+        {"parameters": {"unload_dependents": unload_dependents}}
+    ).encode()
+
+
+def raise_if_error(status: int, body: bytes) -> None:
+    if status < 400:
+        return
+    try:
+        message = json.loads(body).get("error", "")
+    except Exception:
+        message = body.decode(errors="replace")
+    raise InferenceServerException(
+        message or ("HTTP status %d" % status), status=str(status)
+    )

@@ -1,0 +1,224 @@
+"""asyncio HTTP/REST client over aiohttp — mirror of client_tpu.http
+(parity: reference tritonclient.http.aio, http/aio/__init__.py:92+)."""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Optional, Sequence
+
+import aiohttp
+
+from client_tpu._infer_common import InferInput, InferRequestedOutput
+from client_tpu._plugin import InferenceServerClientBase
+from client_tpu.http import _endpoints as ep
+from client_tpu.http._client import InferResult
+from client_tpu.protocol.http_wire import HEADER_LEN, encode_infer_request
+from client_tpu.utils import InferenceServerException
+
+__all__ = [
+    "InferenceServerClient",
+    "InferInput",
+    "InferRequestedOutput",
+    "InferResult",
+]
+
+
+class InferenceServerClient(InferenceServerClientBase):
+    def __init__(
+        self,
+        url: str,
+        verbose: bool = False,
+        conn_limit: int = 100,
+        conn_timeout: float = 60.0,
+        ssl: bool = False,
+        ssl_context=None,
+    ):
+        super().__init__()
+        base = url if "://" in url else (
+            ("https://" if ssl else "http://") + url
+        )
+        self._base = base.rstrip("/")
+        self._verbose = verbose
+        connector = aiohttp.TCPConnector(limit=conn_limit, ssl=ssl_context
+                                         if ssl else False)
+        self._session = aiohttp.ClientSession(
+            connector=connector,
+            timeout=aiohttp.ClientTimeout(total=conn_timeout),
+        )
+
+    async def __aenter__(self):
+        return self
+
+    async def __aexit__(self, exc_type, exc_value, traceback):
+        await self.close()
+
+    async def close(self):
+        await self._session.close()
+
+    async def _request(self, method: str, path: str, body=None, headers=None):
+        headers = self._call_plugin(dict(headers) if headers else {})
+        try:
+            async with self._session.request(
+                method, self._base + path, data=body, headers=headers or {}
+            ) as response:
+                payload = await response.read()
+                return response.status, dict(response.headers), payload
+        except aiohttp.ClientError as e:
+            raise InferenceServerException("connection failed: %s" % e)
+
+    async def _get_json(self, path, headers=None, method="GET", body=None):
+        status, _, payload = await self._request(method, path, body, headers)
+        ep.raise_if_error(status, payload)
+        return json.loads(payload) if payload else {}
+
+    # -- health / metadata ----------------------------------------------
+
+    async def is_server_live(self, headers=None) -> bool:
+        status, _, _ = await self._request("GET", "/v2/health/live",
+                                           headers=headers)
+        return status == 200
+
+    async def is_server_ready(self, headers=None) -> bool:
+        status, _, _ = await self._request("GET", "/v2/health/ready",
+                                           headers=headers)
+        return status == 200
+
+    async def is_model_ready(self, model_name, model_version="",
+                             headers=None) -> bool:
+        status, _, _ = await self._request(
+            "GET", ep.ready_path(model_name, model_version), headers=headers
+        )
+        return status == 200
+
+    async def get_server_metadata(self, headers=None) -> dict:
+        return await self._get_json("/v2", headers)
+
+    async def get_model_metadata(self, model_name, model_version="",
+                                 headers=None) -> dict:
+        return await self._get_json(
+            ep.model_path(model_name, model_version), headers
+        )
+
+    async def get_model_config(self, model_name, model_version="",
+                               headers=None) -> dict:
+        return await self._get_json(
+            ep.config_path(model_name, model_version), headers
+        )
+
+    async def get_model_repository_index(self, headers=None) -> list:
+        return await self._get_json(ep.repo_index_path(), headers,
+                                    method="POST", body=b"{}")
+
+    async def load_model(self, model_name, headers=None, config=None):
+        await self._get_json(ep.repo_load_path(model_name), headers,
+                             method="POST", body=ep.load_model_body(config))
+
+    async def unload_model(self, model_name, headers=None):
+        await self._get_json(ep.repo_unload_path(model_name), headers,
+                             method="POST", body=ep.unload_model_body())
+
+    async def get_inference_statistics(self, model_name="", model_version="",
+                                       headers=None) -> dict:
+        return await self._get_json(
+            ep.stats_path(model_name, model_version), headers
+        )
+
+    # -- trace / log settings --------------------------------------------
+
+    async def update_trace_settings(self, model_name="", settings=None,
+                                    headers=None) -> dict:
+        """Asyncio mirror of the sync client's trace-settings verbs."""
+        return await self._get_json(
+            ep.trace_path(model_name), headers, method="POST",
+            body=json.dumps(settings or {}).encode())
+
+    async def get_trace_settings(self, model_name="", headers=None) -> dict:
+        return await self._get_json(ep.trace_path(model_name), headers)
+
+    async def update_log_settings(self, settings, headers=None) -> dict:
+        return await self._get_json(
+            ep.logging_path(), headers, method="POST",
+            body=json.dumps(settings or {}).encode())
+
+    async def get_log_settings(self, headers=None) -> dict:
+        return await self._get_json(ep.logging_path(), headers)
+
+    # -- shared memory ---------------------------------------------------
+
+    async def get_system_shared_memory_status(self, region_name="",
+                                              headers=None) -> list:
+        return await self._get_json(
+            ep.shm_status_path("system", region_name), headers
+        )
+
+    async def register_system_shared_memory(self, name, key, byte_size,
+                                            offset=0, headers=None):
+        await self._get_json(
+            ep.shm_register_path("system", name), headers, method="POST",
+            body=ep.system_shm_register_body(key, byte_size, offset),
+        )
+
+    async def unregister_system_shared_memory(self, name="", headers=None):
+        await self._get_json(ep.shm_unregister_path("system", name), headers,
+                             method="POST", body=b"{}")
+
+    async def get_tpu_shared_memory_status(self, region_name="",
+                                           headers=None) -> list:
+        return await self._get_json(
+            ep.shm_status_path("tpu", region_name), headers
+        )
+
+    async def register_tpu_shared_memory(self, name, raw_handle, device_id,
+                                         byte_size, headers=None):
+        await self._get_json(
+            ep.shm_register_path("tpu", name), headers, method="POST",
+            body=ep.tpu_shm_register_body(raw_handle, device_id, byte_size),
+        )
+
+    async def unregister_tpu_shared_memory(self, name="", headers=None):
+        await self._get_json(ep.shm_unregister_path("tpu", name), headers,
+                             method="POST", body=b"{}")
+
+    get_cuda_shared_memory_status = get_tpu_shared_memory_status
+    register_cuda_shared_memory = register_tpu_shared_memory
+    unregister_cuda_shared_memory = unregister_tpu_shared_memory
+
+    # -- inference -------------------------------------------------------
+
+    async def infer(
+        self,
+        model_name: str,
+        inputs: Sequence[InferInput],
+        model_version: str = "",
+        outputs: Optional[Sequence[InferRequestedOutput]] = None,
+        request_id: str = "",
+        sequence_id: int = 0,
+        sequence_start: bool = False,
+        sequence_end: bool = False,
+        priority: int = 0,
+        timeout: Optional[int] = None,
+        headers: Optional[dict] = None,
+        parameters: Optional[dict] = None,
+    ) -> InferResult:
+        body, json_len = encode_infer_request(
+            inputs=inputs, outputs=outputs, request_id=request_id,
+            sequence_id=sequence_id, sequence_start=sequence_start,
+            sequence_end=sequence_end, priority=priority, timeout=timeout,
+            parameters=parameters,
+        )
+        request_headers = dict(headers) if headers else {}
+        if json_len is not None:
+            request_headers[HEADER_LEN] = str(json_len)
+            request_headers["Content-Type"] = "application/octet-stream"
+        else:
+            request_headers["Content-Type"] = "application/json"
+        status, resp_headers, payload = await self._request(
+            "POST", ep.infer_path(model_name, model_version), body=body,
+            headers=request_headers,
+        )
+        ep.raise_if_error(status, payload)
+        lowered = {k.lower(): v for k, v in resp_headers.items()}
+        header_len = lowered.get(HEADER_LEN.lower())
+        return InferResult.from_response_body(
+            payload, int(header_len) if header_len else None
+        )

@@ -1,0 +1,39 @@
+"""JAX model zoo served by the reference server and used by the
+benchmark configs (BASELINE.md). Each entry maps a model name to a
+zero-argument factory, consumed by the ModelRepository."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+from client_tpu.server.model import ServedModel
+
+
+def builtin_model_factories(repository=None
+                            ) -> Dict[str, Callable[[], ServedModel]]:
+    from client_tpu.models.add_sub import AddSub
+    from client_tpu.models.simple_extra import (
+        RepeatInt32,
+        SequenceAccumulator,
+        StringAddSub,
+    )
+    from client_tpu.models.zoo import extra_model_factories
+
+    factories: Dict[str, Callable[[], ServedModel]] = {
+        "add_sub": AddSub,
+        "simple": lambda: AddSub(name="simple", datatype="INT32", shape=(16,)),
+        "add_sub_fp32": lambda: AddSub(
+            name="add_sub_fp32", datatype="FP32", shape=(16,)
+        ),
+        "add_sub_int8": lambda: AddSub(
+            name="add_sub_int8", datatype="INT8", shape=(16,)
+        ),
+        "add_sub_tpu": lambda: AddSub(
+            name="add_sub_tpu", datatype="FP32", shape=(16,), device="tpu"
+        ),
+        "simple_string": StringAddSub,
+        "simple_sequence": SequenceAccumulator,
+        "repeat_int32": RepeatInt32,
+    }
+    factories.update(extra_model_factories(repository))
+    return factories

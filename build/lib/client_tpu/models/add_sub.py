@@ -1,0 +1,73 @@
+"""The `simple` add/sub model: OUTPUT0 = INPUT0 + INPUT1,
+OUTPUT1 = INPUT0 - INPUT1 — the protocol-conformance and latency-floor
+workhorse (reference examples' `simple` model; BASELINE config #1).
+
+Placement: defaults to the host CPU backend — for a 64-byte tensor the
+accelerator round trip is pure loss (on this image the TPU relay's
+device-to-host hop alone is ~20 ms). Pass ``device="tpu"`` to pin it
+on the accelerator, which is the right choice when I/O rides TPU
+shared-memory regions and never leaves HBM.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from client_tpu.server.model import ServedModel, TensorSpec
+from client_tpu.utils import triton_to_np_dtype
+
+
+class AddSub(ServedModel):
+    """Element-wise add/sub over two same-shape inputs, one fused XLA
+    kernel. Device-resident inputs (TPU shm regions) are consumed in
+    place with no host round-trip."""
+
+    platform = "jax"
+
+    def __init__(self, name: str = "add_sub", datatype: str = "INT32",
+                 shape=(16,), device: str = "cpu"):
+        super().__init__()
+        self.name = name
+        self._datatype = datatype
+        self._shape = list(shape)
+        self._device_kind = device
+        self.inputs = [
+            TensorSpec("INPUT0", datatype, self._shape),
+            TensorSpec("INPUT1", datatype, self._shape),
+        ]
+        self.outputs = [
+            TensorSpec("OUTPUT0", datatype, self._shape),
+            TensorSpec("OUTPUT1", datatype, self._shape),
+        ]
+        self._fn = jax.jit(lambda a, b: (a + b, a - b))
+        self._device = None
+        if device == "cpu":
+            self._device = jax.devices("cpu")[0]
+
+    def infer(self, inputs: Dict[str, np.ndarray],
+              parameters: Optional[dict] = None) -> Dict[str, np.ndarray]:
+        a, b = inputs["INPUT0"], inputs["INPUT1"]
+        if (
+            self._device is not None
+            and isinstance(a, np.ndarray)
+            and isinstance(b, np.ndarray)
+        ):
+            # Host tensors on a host-placed model: plain numpy is the
+            # fastest "kernel" there is for 16 elements.
+            return {"OUTPUT0": a + b, "OUTPUT1": a - b}
+        out0, out1 = self._fn(a, b)
+        return {"OUTPUT0": out0, "OUTPUT1": out1}
+
+    def warmup(self) -> None:
+        np_dtype = triton_to_np_dtype(self._datatype)
+        if self._device is not None:
+            with jax.default_device(self._device):
+                zero = jnp.zeros(self._shape, dtype=np_dtype)
+                jax.block_until_ready(self._fn(zero, zero))
+        else:
+            zero = jnp.zeros(self._shape, dtype=np_dtype)
+            jax.block_until_ready(self._fn(zero, zero))

@@ -1,0 +1,186 @@
+"""BERT-base sequence classification (BASELINE config #3: dynamic
+batching + variable sequence length).
+
+Variable-length inputs are bucketed to a small set of padded lengths
+so XLA compiles a handful of static shapes instead of one per length
+(the TPU answer to dynamic shapes)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from client_tpu.protocol import model_config_pb2 as mc
+from client_tpu.server.model import ServedModel, TensorSpec
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab: int = 30522
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    max_seq: int = 512
+    num_labels: int = 2
+    dtype: str = "bfloat16"
+
+
+def init_params(key, cfg: BertConfig) -> Dict:
+    dtype = jnp.dtype(cfg.dtype)
+    ks = jax.random.split(key, 4 + cfg.n_layers)
+
+    def norm(k, shape, scale=0.02):
+        return (jax.random.normal(k, shape, dtype=jnp.float32)
+                * scale).astype(dtype)
+
+    params = {
+        "word_embed": norm(ks[0], (cfg.vocab, cfg.d_model)),
+        "pos_embed": norm(ks[1], (cfg.max_seq, cfg.d_model)),
+        "embed_norm": {"scale": jnp.ones((cfg.d_model,), dtype=dtype),
+                       "bias": jnp.zeros((cfg.d_model,), dtype=dtype)},
+        "layers": [],
+        "pooler": norm(ks[2], (cfg.d_model, cfg.d_model)),
+        "classifier": norm(ks[3], (cfg.d_model, cfg.num_labels)),
+    }
+    head_dim = cfg.d_model // cfg.n_heads
+    for i in range(cfg.n_layers):
+        lk = jax.random.split(ks[4 + i], 6)
+        params["layers"].append({
+            "wq": norm(lk[0], (cfg.d_model, cfg.n_heads, head_dim)),
+            "wk": norm(lk[1], (cfg.d_model, cfg.n_heads, head_dim)),
+            "wv": norm(lk[2], (cfg.d_model, cfg.n_heads, head_dim)),
+            "wo": norm(lk[3], (cfg.n_heads, head_dim, cfg.d_model)),
+            "norm1": {"scale": jnp.ones((cfg.d_model,), dtype=dtype),
+                      "bias": jnp.zeros((cfg.d_model,), dtype=dtype)},
+            "w_up": norm(lk[4], (cfg.d_model, cfg.d_ff)),
+            "w_down": norm(lk[5], (cfg.d_ff, cfg.d_model)),
+            "norm2": {"scale": jnp.ones((cfg.d_model,), dtype=dtype),
+                      "bias": jnp.zeros((cfg.d_model,), dtype=dtype)},
+        })
+    return params
+
+
+def _layer_norm(x, p, eps=1e-6):
+    x32 = x.astype(jnp.float32)
+    mean = x32.mean(axis=-1, keepdims=True)
+    var = x32.var(axis=-1, keepdims=True)
+    return (((x32 - mean) * jax.lax.rsqrt(var + eps)).astype(x.dtype)
+            * p["scale"] + p["bias"])
+
+
+def forward(params, input_ids, attention_mask, cfg: BertConfig):
+    """input_ids/attention_mask [B,S] -> logits [B,num_labels]."""
+    b, s = input_ids.shape
+    x = params["word_embed"][input_ids] + params["pos_embed"][None, :s]
+    x = _layer_norm(x, params["embed_norm"])
+    mask = attention_mask.astype(bool)[:, None, None, :]  # [B,1,1,S]
+    head_dim = cfg.d_model // cfg.n_heads
+    for layer in params["layers"]:
+        q = jnp.einsum("bsd,dhk->bshk", x, layer["wq"])
+        k = jnp.einsum("bsd,dhk->bshk", x, layer["wk"])
+        v = jnp.einsum("bsd,dhk->bshk", x, layer["wv"])
+        logits = jnp.einsum("bshk,bthk->bhst", q, k).astype(jnp.float32)
+        logits = logits / np.sqrt(head_dim)
+        logits = jnp.where(mask, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+        ctx = jnp.einsum("bhst,bthk->bshk", probs, v)
+        x = _layer_norm(
+            x + jnp.einsum("bshk,hkd->bsd", ctx, layer["wo"]),
+            layer["norm1"],
+        )
+        h = jax.nn.gelu(x @ layer["w_up"])
+        x = _layer_norm(x + h @ layer["w_down"], layer["norm2"])
+    pooled = jnp.tanh(x[:, 0] @ params["pooler"])
+    return (pooled @ params["classifier"]).astype(jnp.float32)
+
+
+_BUCKETS = (32, 64, 128, 256, 512)
+
+
+def _bucket_length(s: int, max_seq: int) -> int:
+    """Smallest padding bucket >= s, doubling past the static list and
+    capped at max_seq (inputs beyond max_seq get truncated)."""
+    for bucket in _BUCKETS:
+        if s <= bucket:
+            return min(bucket, max_seq)
+    bucket = _BUCKETS[-1]
+    while bucket < s and bucket < max_seq:
+        bucket *= 2
+    return min(bucket, max_seq)
+
+
+class BertModel(ServedModel):
+    """Inputs input_ids/attention_mask INT32 [-1]; output logits
+    [num_labels]. Declares dynamic batching in its config."""
+
+    platform = "jax"
+    # Fuse ceiling 64: with the batcher's async output fetch the
+    # served-request cadence is relay-latency bound (~65 ms/round
+    # trip), so throughput scales with how many concurrent requests
+    # fuse into one MXU call — bert-base batch 64 is still ~4 ms of
+    # device compute, far below the fetch it hides behind. The 4 ms
+    # queue window spans a whole response burst (requests re-arrive in
+    # waves at this latency), growing the average fused batch from ~7
+    # to ~32 at 64 clients; it adds 4 ms to a ~130 ms round trip.
+    max_batch_size = 64
+    dynamic_batching = True
+    preferred_batch_sizes = [8, 16, 32, 64]
+    max_queue_delay_us = 4000
+
+    def __init__(self, name: str = "bert_base", cfg: Optional[BertConfig]
+                 = None, seed: int = 0):
+        super().__init__()
+        self.name = name
+        self.cfg = cfg or BertConfig()
+        self.inputs = [
+            TensorSpec("input_ids", "INT32", [-1]),
+            TensorSpec("attention_mask", "INT32", [-1], optional=True),
+        ]
+        self.outputs = [TensorSpec("logits", "FP32", [self.cfg.num_labels])]
+        self._params = init_params(jax.random.PRNGKey(seed), self.cfg)
+        cfg_static = self.cfg
+        self._fn = jax.jit(
+            lambda p, ids, mask: forward(p, ids, mask, cfg_static)
+        )
+
+    def infer(self, inputs, parameters=None):
+        ids = np.asarray(inputs["input_ids"])
+        if ids.ndim == 1:
+            ids = ids[None]
+        mask = inputs.get("attention_mask")
+        mask = (
+            np.asarray(mask) if mask is not None
+            else np.ones_like(ids)
+        )
+        if mask.ndim == 1:
+            mask = mask[None]
+        s = ids.shape[1]
+        # pad to a bucket (capped at max_seq) so XLA reuses compilations
+        bucket = _bucket_length(s, self.cfg.max_seq)
+        if s > bucket:
+            ids = ids[:, :bucket]
+            mask = mask[:, :bucket]
+        elif s < bucket:
+            pad = ((0, 0), (0, bucket - s))
+            ids = np.pad(ids, pad)
+            mask = np.pad(mask, pad)
+        logits = self._fn(self._params, jnp.asarray(ids), jnp.asarray(mask))
+        return {"logits": logits}
+
+    def warmup(self) -> None:
+        # Compile the fused-batch grid at the first seq bucket: the
+        # dynamic batcher pads to preferred_batch_sizes, and a
+        # multi-second XLA compile landing inside a measurement window
+        # (instead of here) shows up as an 8-second p99. Other seq
+        # buckets still compile on first use — the persistent
+        # compilation cache absorbs repeats.
+        seq = min(_BUCKETS[0], self.cfg.max_seq)
+        for batch in (1,) + tuple(self.preferred_batch_sizes):
+            ids = jnp.zeros((batch, seq), dtype=jnp.int32)
+            jax.block_until_ready(self._fn(self._params, ids,
+                                           jnp.ones_like(ids)))

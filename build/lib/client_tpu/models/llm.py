@@ -1,0 +1,952 @@
+"""Llama-style decoder LM: the flagship served model and the
+long-context / multi-chip showcase (BASELINE config #5: generate
+endpoint with decoupled token streaming).
+
+TPU-first structure:
+- bf16 params, matmul-heavy blocks sized for the MXU;
+- prefill and decode-step are separate jitted functions; decode keeps
+  the KV cache device-resident and updates it via dynamic_update_slice
+  (donated, so XLA updates in place);
+- sharding comes from client_tpu.parallel rules — heads/ffn/vocab on
+  ``tp``, batch on ``dp``, optional ``sp`` for long-context sequence
+  parallelism; the same code runs single-chip with a 1x1 mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+from collections import deque
+from functools import partial
+from typing import Dict, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from client_tpu.parallel import LLM_RULES, ShardingRules, create_mesh
+from client_tpu.server.model import ServedModel, TensorSpec
+from client_tpu.utils import InferenceServerException
+
+
+@dataclasses.dataclass
+class LlmConfig:
+    vocab: int = 259          # 256 bytes + BOS/EOS/PAD
+    d_model: int = 256
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 4
+    d_ff: int = 704
+    max_seq: int = 1024
+    rope_theta: float = 10000.0
+    dtype: str = "bfloat16"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+LLAMA3_8B = LlmConfig(
+    vocab=128256, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+    d_ff=14336, max_seq=8192, rope_theta=500000.0,
+)
+
+BOS, EOS, PAD = 256, 257, 258
+
+
+class ByteTokenizer:
+    """Zero-dependency byte-level tokenizer (ids 0-255 = raw bytes)."""
+
+    def encode(self, text: str, bos: bool = True) -> np.ndarray:
+        ids = list(text.encode("utf-8"))
+        if bos:
+            ids = [BOS] + ids
+        return np.array(ids, dtype=np.int32)
+
+    def decode(self, ids) -> str:
+        data = bytes(int(i) for i in ids if int(i) < 256)
+        return data.decode("utf-8", errors="replace")
+
+
+# -- parameters ------------------------------------------------------------
+
+
+def init_params(key, cfg: LlmConfig) -> Dict:
+    dtype = jnp.dtype(cfg.dtype)
+    ks = jax.random.split(key, 4 + cfg.n_layers)
+    scale = 0.02
+
+    def norm(k, shape):
+        return (jax.random.normal(k, shape, dtype=jnp.float32)
+                * scale).astype(dtype)
+
+    params = {
+        "embed": norm(ks[0], (cfg.vocab, cfg.d_model)),
+        "unembed": norm(ks[1], (cfg.d_model, cfg.vocab)),
+        "final_norm": jnp.ones((cfg.d_model,), dtype=dtype),
+        "layers": [],
+    }
+    for i in range(cfg.n_layers):
+        lk = jax.random.split(ks[4 + i], 7)
+        params["layers"].append({
+            "attn_norm": jnp.ones((cfg.d_model,), dtype=dtype),
+            "wq": norm(lk[0], (cfg.d_model, cfg.n_heads, cfg.head_dim)),
+            "wk": norm(lk[1], (cfg.d_model, cfg.n_kv_heads, cfg.head_dim)),
+            "wv": norm(lk[2], (cfg.d_model, cfg.n_kv_heads, cfg.head_dim)),
+            "wo": norm(lk[3], (cfg.n_heads, cfg.head_dim, cfg.d_model)),
+            "mlp_norm": jnp.ones((cfg.d_model,), dtype=dtype),
+            "w_gate": norm(lk[4], (cfg.d_model, cfg.d_ff)),
+            "w_up": norm(lk[5], (cfg.d_model, cfg.d_ff)),
+            "w_down": norm(lk[6], (cfg.d_ff, cfg.d_model)),
+        })
+    return params
+
+
+def param_specs(cfg: LlmConfig, rules: ShardingRules = LLM_RULES) -> Dict:
+    """PartitionSpec tree matching init_params (Megatron layout)."""
+    layer = {
+        "attn_norm": rules.spec("model"),
+        "wq": rules.spec("model", "heads", "head_dim"),
+        "wk": rules.spec("model", "kv_heads", "head_dim"),
+        "wv": rules.spec("model", "kv_heads", "head_dim"),
+        "wo": rules.spec("heads", "head_dim", "model"),
+        "mlp_norm": rules.spec("model"),
+        "w_gate": rules.spec("model", "ffn"),
+        "w_up": rules.spec("model", "ffn"),
+        "w_down": rules.spec("ffn", "model"),
+    }
+    return {
+        "embed": rules.spec("vocab", "model"),
+        "unembed": rules.spec("model", "vocab"),
+        "final_norm": rules.spec("model"),
+        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+    }
+
+
+# -- forward ---------------------------------------------------------------
+
+
+def _rms_norm(x, weight, eps: float = 1e-5):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps)).astype(x.dtype) * weight
+
+
+def _rope(x, positions, theta: float):
+    """x: [B, S, H, D]; rotary embedding over the last dim."""
+    d = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B,S,d/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., ::2], x[..., 1::2]
+    rotated = jnp.stack(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    )
+    return rotated.reshape(x.shape).astype(x.dtype)
+
+
+def _attention(q, k, v, mask):
+    """q: [B,S,H,D]; k/v: [B,T,Hkv,D] (GQA: H a multiple of Hkv)."""
+    b, s, h, d = q.shape
+    hkv = k.shape[2]
+    group = h // hkv
+    q = q.reshape(b, s, hkv, group, d)
+    logits = jnp.einsum("bshgd,bthd->bhgst", q, k).astype(jnp.float32)
+    logits = logits / np.sqrt(d)
+    logits = jnp.where(mask[:, None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    ctx = jnp.einsum("bhgst,bthd->bshgd", probs, v)
+    return ctx.reshape(b, s, h, d)
+
+
+def ring_attention_fn(mesh, axis_name: str = "sp"):
+    """Drop-in attention for sequence-sharded full-sequence forwards:
+    rotates K/V shards around the ``axis_name`` ring instead of
+    letting GSPMD all-gather the full sequence (O(S_local) memory —
+    the long-context path). GQA heads are expanded to full heads
+    before the ring; the mask argument is ignored because the ring op
+    applies global causal masking itself."""
+    from client_tpu.parallel.ring_attention import ring_attention
+
+    def attn(q, k, v, mask):  # noqa: ARG001 - causal handled in-op
+        h, hkv = q.shape[2], k.shape[2]
+        if h != hkv:
+            k = jnp.repeat(k, h // hkv, axis=2)
+            v = jnp.repeat(v, h // hkv, axis=2)
+        return ring_attention(q, k, v, mesh, axis_name=axis_name,
+                              causal=True)
+
+    return attn
+
+
+def _block(layer, x, positions, mask, cfg: LlmConfig, cache=None,
+           cache_pos=None, attention_fn=None, cache_pos_vec=None):
+    h = _rms_norm(x, layer["attn_norm"])
+    q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"])
+    k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"])
+    v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"])
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    new_cache = None
+    if cache is not None:
+        ck, cv = cache  # [B, T, Hkv, D]
+        if cache_pos_vec is not None:
+            # Per-lane write positions (multi-lane decode: each lane
+            # is a different sequence at a different length).
+            write = jax.vmap(
+                lambda c, kv, p: jax.lax.dynamic_update_slice(
+                    c, kv, (p, 0, 0)))
+            ck = write(ck, k, cache_pos_vec)
+            cv = write(cv, v, cache_pos_vec)
+        else:
+            ck = jax.lax.dynamic_update_slice(ck, k, (0, cache_pos, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cv, v, (0, cache_pos, 0, 0))
+        k, v = ck, cv
+        new_cache = (ck, cv)
+    ctx = (attention_fn or _attention)(q, k, v, mask)
+    x = x + jnp.einsum("bshk,hkd->bsd", ctx, layer["wo"])
+    h = _rms_norm(x, layer["mlp_norm"])
+    gated = jax.nn.silu(h @ layer["w_gate"]) * (h @ layer["w_up"])
+    return x + gated @ layer["w_down"], new_cache
+
+
+def forward(params, tokens, cfg: LlmConfig, attention_fn=None):
+    """Full-sequence scoring forward: tokens [B,S] -> logits [B,S,V].
+    ``attention_fn`` swaps the attention op (ring_attention_fn for
+    sequence-parallel long-context runs)."""
+    b, s = tokens.shape
+    x = params["embed"][tokens]
+    positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+    causal = jnp.tril(jnp.ones((s, s), dtype=bool))[None]
+    for layer in params["layers"]:
+        x, _ = _block(layer, x, positions, causal, cfg,
+                      attention_fn=attention_fn)
+    x = _rms_norm(x, params["final_norm"])
+    return (x @ params["unembed"]).astype(jnp.float32)
+
+
+def init_cache(cfg: LlmConfig, batch: int, dtype=None):
+    dtype = dtype or jnp.dtype(cfg.dtype)
+    return [
+        (
+            jnp.zeros((batch, cfg.max_seq, cfg.n_kv_heads, cfg.head_dim),
+                      dtype=dtype),
+            jnp.zeros((batch, cfg.max_seq, cfg.n_kv_heads, cfg.head_dim),
+                      dtype=dtype),
+        )
+        for _ in range(cfg.n_layers)
+    ]
+
+
+def prefill(params, tokens, cache, cfg: LlmConfig, true_len=None):
+    """Process the prompt, fill the cache; returns (logits of the last
+    real row, cache). tokens [B,S]; ``true_len`` (traced scalar or
+    per-row [B] vector — the batched-join path prefills several
+    prompts of different lengths in ONE dispatch) marks the prompt
+    length when S is a padded bucket — padded rows write cache slots
+    >= true_len, which decode overwrites sequentially before ever
+    attending to them, so they never leak into outputs."""
+    b, s = tokens.shape
+    x = params["embed"][tokens]
+    positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+    # rows attend to cache slots <= their position
+    mask = jnp.tril(
+        jnp.ones((s, cfg.max_seq), dtype=bool), k=0
+    )[None]
+    new_cache = []
+    for layer, layer_cache in zip(params["layers"], cache):
+        x, updated = _block(layer, x, positions, mask, cfg,
+                            cache=layer_cache, cache_pos=0)
+        new_cache.append(updated)
+    x = _rms_norm(x, params["final_norm"])
+    if true_len is None:
+        last = x[:, -1]
+    elif jnp.ndim(true_len) >= 1:
+        last = jnp.take_along_axis(
+            x, (true_len - 1)[:, None, None], axis=1)[:, 0]
+    else:
+        last = jax.lax.dynamic_slice_in_dim(x, true_len - 1, 1, axis=1)[:, 0]
+    logits = (last @ params["unembed"]).astype(jnp.float32)
+    return logits, new_cache
+
+
+def decode_chunk(params, token, pos, cache, cfg: LlmConfig, length: int):
+    """Greedy-decodes ``length`` tokens entirely on device with
+    lax.scan: token/pos are traced scalars, the KV cache is the scan
+    carry. One host fetch retrieves the whole chunk, so the
+    host<->device round-trip cost (exaggerated ~100ms by the axon
+    relay on this image, but real on any PCIe/ICI hop) is paid once
+    per ``length`` tokens instead of per token. Returns
+    (token ids [length], cache)."""
+
+    def step(carry, _):
+        tok, p, c = carry
+        logits, c = decode_step(params, tok.reshape(1, 1), p, c, cfg)
+        nxt = jnp.argmax(logits[0]).astype(jnp.int32)
+        return (nxt, p + 1, c), nxt
+
+    (_, _, cache), tokens = jax.lax.scan(
+        step, (token.astype(jnp.int32), pos, cache), None, length=length)
+    return tokens, cache
+
+
+def decode_step_multi(params, tokens, pos, cache, cfg: LlmConfig):
+    """One step for B independent lanes: tokens [B,1], pos [B] (each
+    lane its own position); returns (logits [B,V], cache). Per-lane
+    causal masks and cache writes — the kernel under multi-lane
+    (continuous-batching-style) serving."""
+    positions = pos[:, None]  # [B,1]
+    x = params["embed"][tokens]
+    mask = (jnp.arange(cfg.max_seq)[None, None, :]
+            <= pos[:, None, None])  # [B,1,T]
+    new_cache = []
+    for layer, layer_cache in zip(params["layers"], cache):
+        x, updated = _block(layer, x, positions, mask, cfg,
+                            cache=layer_cache, cache_pos_vec=pos)
+        new_cache.append(updated)
+    x = _rms_norm(x, params["final_norm"])
+    logits = (x[:, -1] @ params["unembed"]).astype(jnp.float32)
+    return logits, new_cache
+
+
+def decode_chunk_multi(params, tokens, pos, cache, cfg: LlmConfig,
+                       length: int):
+    """Greedy-decodes ``length`` tokens for B lanes on device:
+    tokens/pos [B]; returns (token ids [length, B], cache). One
+    dispatch + one host fetch serves every active lane — requests
+    join/leave at chunk boundaries (continuous batching at chunk
+    granularity)."""
+
+    def step(carry, _):
+        tok, p, c = carry
+        logits, c = decode_step_multi(params, tok[:, None], p, c, cfg)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B]
+        return (nxt, p + 1, c), nxt
+
+    (_, _, cache), toks = jax.lax.scan(
+        step, (tokens.astype(jnp.int32), pos.astype(jnp.int32), cache),
+        None, length=length)
+    return toks, cache
+
+
+def decode_step(params, token, pos, cache, cfg: LlmConfig):
+    """One token step: token [B,1], pos scalar; returns (logits [B,V],
+    cache)."""
+    b = token.shape[0]
+    x = params["embed"][token]
+    positions = jnp.full((b, 1), pos, dtype=jnp.int32)
+    mask = (jnp.arange(cfg.max_seq) <= pos)[None, None]  # [1,1,T]
+    new_cache = []
+    for layer, layer_cache in zip(params["layers"], cache):
+        x, updated = _block(layer, x, positions, mask[0], cfg,
+                            cache=layer_cache, cache_pos=pos)
+        new_cache.append(updated)
+    x = _rms_norm(x, params["final_norm"])
+    logits = (x[:, -1] @ params["unembed"]).astype(jnp.float32)
+    return logits, new_cache
+
+
+def loss_fn(params, tokens, targets, cfg: LlmConfig, attention_fn=None):
+    logits = forward(params, tokens, cfg, attention_fn=attention_fn)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    mask = (targets != PAD).astype(jnp.float32)
+    return jnp.sum(nll[..., 0] * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def train_step(params, tokens, targets, cfg: LlmConfig, lr: float = 1e-3,
+               attention_fn=None):
+    """SGD training step (forward + backward + update) — the function
+    the multi-chip dryrun jits over the mesh. ``attention_fn`` selects
+    the attention op (ring attention for context-parallel runs)."""
+    loss, grads = jax.value_and_grad(
+        partial(loss_fn, cfg=cfg, attention_fn=attention_fn))(
+        params, tokens, targets
+    )
+    new_params = jax.tree.map(
+        lambda w, g: (w - lr * g.astype(w.dtype)).astype(w.dtype),
+        params, grads,
+    )
+    return new_params, loss
+
+
+# -- served model ----------------------------------------------------------
+
+
+class _GenRequest:
+    """One in-flight generation riding a decode lane."""
+
+    def __init__(self, prompt, max_tokens: int, ignore_eos: bool):
+        self.prompt = prompt
+        self.max_tokens = max_tokens
+        self.ignore_eos = ignore_eos
+        self.delivered = 0
+        self.queue: queue.Queue = queue.Queue()
+        self.error: Optional[str] = None
+        # Set when the consumer abandons the stream (client
+        # disconnect): the scheduler frees the lane at the next chunk
+        # boundary instead of decoding the full budget into nowhere.
+        self.cancelled = False
+
+    def finish(self):
+        self.queue.put(None)
+
+    def fail(self, message: str):
+        self.error = message
+        self.queue.put(None)
+
+
+class LlmModel(ServedModel):
+    """Decoupled generate endpoint: text in, token stream out.
+
+    Inputs: text_input BYTES [1]; max_tokens INT32 [1] (optional);
+    outputs: text_output BYTES [1] per streamed response. Greedy
+    decoding with multi-lane batched decode: a scheduler thread steps
+    ``decode_lanes`` independent sequences through one jitted
+    decode_chunk_multi dispatch, so concurrent requests share device
+    work instead of serializing (continuous batching at chunk
+    granularity — requests join/leave at chunk boundaries). Joins
+    prefill in one batched dispatch per padded bucket and their caches
+    are row-inserted into the batched KV cache, which never leaves the
+    device.
+
+    The decode pipeline is split into a dispatch side (scheduler
+    thread: prefills + decode chunks launched back-to-back, last
+    tokens carried ON DEVICE between chunks) and a delivery side
+    (delivery thread: waits on each chunk's pooled device->host fetch
+    in dispatch order and routes tokens to requests). Up to
+    MAX_INFLIGHT chunks are in flight, so the host-fetch round trip
+    (~65 ms through this image's relay, real on any PCIe/ICI hop)
+    overlaps decode compute instead of stalling the token stream every
+    STREAM_CHUNK tokens — inter-token latency at a chunk boundary is
+    the chunk's compute time, not the fetch latency.
+    """
+
+    decoupled = True
+    platform = "jax"
+    # Tokens per device-side decode dispatch (and per host fetch).
+    STREAM_CHUNK = 8
+    # Decode chunks allowed in flight (dispatched, fetch pending).
+    # Pipelining bound: the relay's ~65 ms fetch overlaps roughly
+    # fetch_latency / chunk_compute (~4) chunks; beyond that it is
+    # run-ahead waste on finished requests and queue-drain latency
+    # ahead of every join's first token.
+    MAX_INFLIGHT = 5
+
+    def __init__(self, name: str = "llm", cfg: Optional[LlmConfig] = None,
+                 mesh=None, rules: ShardingRules = LLM_RULES,
+                 seed: int = 0, decode_lanes: int = 4):
+        super().__init__()
+        self.name = name
+        self.cfg = cfg or LlmConfig()
+        self._tokenizer = ByteTokenizer()
+        self.inputs = [
+            TensorSpec("text_input", "BYTES", [1]),
+            TensorSpec("max_tokens", "INT32", [1], optional=True),
+            TensorSpec("ignore_eos", "BOOL", [1], optional=True),
+        ]
+        self.outputs = [TensorSpec("text_output", "BYTES", [1])]
+
+        key = jax.random.PRNGKey(seed)
+        params = init_params(key, self.cfg)
+        self._mesh = mesh
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+
+            specs = param_specs(self.cfg, rules)
+            params = jax.tree.map(
+                lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+                params, specs,
+                is_leaf=lambda x: isinstance(x, jnp.ndarray),
+            )
+        self._params = params
+        cfg_static = self.cfg
+
+        def _prefill_first(p, t, c, n):
+            # argmax folded in: the scheduler only needs the first
+            # TOKEN, and a separate jitted argmax would compile per
+            # batch shape mid-serving.
+            logits, new_cache = prefill(p, t, c, cfg_static, true_len=n)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), new_cache
+
+        self._prefill = jax.jit(_prefill_first)
+        self._decode_chunk_multi = jax.jit(
+            lambda p, tok, pos, c: decode_chunk_multi(
+                p, tok, pos, c, cfg_static, self.STREAM_CHUNK),
+            donate_argnums=(3,),
+        )
+        # Inserts row `b` of a batched prefill cache into lane `i` of
+        # the decode cache (b and i are traced: one compile serves
+        # every (row, lane) pair).
+        self._lane_insert_row = jax.jit(
+            lambda batched, multi, b, i: jax.tree.map(
+                lambda dst, src: jax.lax.dynamic_update_slice(
+                    dst, jax.lax.dynamic_slice_in_dim(src, b, 1, axis=0),
+                    (i, 0, 0, 0)),
+                batched, multi),
+            donate_argnums=(0,),
+        )
+        # Scatter first tokens of joining lanes into the device-side
+        # last-token vector the next decode chunk consumes.
+        self._set_lane_tokens = jax.jit(
+            lambda toks, idx, vals: toks.at[idx].set(vals),
+            donate_argnums=(0,),
+        )
+
+        # Prefill executables keyed by (batch, bucket). Batched-join
+        # prefill shapes are compiled AHEAD in a background thread the
+        # first time a new shape shows up — an inline compile (seconds)
+        # would stall every active token stream; until the compile
+        # lands, joins fall back to the already-compiled batch-1 path.
+        self._prefill_exec: Dict[tuple, object] = {}
+        self._prefill_compiling: set = set()
+        self._prefill_exec_lock = threading.Lock()
+
+        self._lanes = max(1, int(decode_lanes))
+        self._sched_lock = threading.Lock()
+        self._sched_cv = threading.Condition(self._sched_lock)
+        self._sched_thread: Optional[threading.Thread] = None
+        self._delivery_thread: Optional[threading.Thread] = None
+        self._fetch_pool = None
+        self._sched_stop = False
+        self._gen = 0  # bumped on crash: stale threads exit
+        self._join_queue: list = []
+        self._active: Dict[int, _GenRequest] = {}
+        self._free_lanes = list(range(self._lanes))
+        self._lane_pos = [0] * self._lanes  # host bookkeeping
+        self._tokens_dev = None  # [lanes] int32 device carry
+        self._batched_cache = None
+        self._delivery_queue: deque = deque()
+        self._inflight = 0  # dispatched-not-yet-delivered decode chunks
+
+    # -- scheduler -------------------------------------------------------
+
+    def _ensure_scheduler(self):
+        with self._sched_cv:
+            if self._sched_stop:
+                return
+            if self._fetch_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                # Sized so every in-flight chunk's device->host fetch
+                # overlaps (the relay pipelines concurrent fetches:
+                # 8 concurrent transfers complete in one ~65 ms round
+                # trip, measured on this image).
+                self._fetch_pool = ThreadPoolExecutor(
+                    max_workers=self.MAX_INFLIGHT + 2,
+                    thread_name_prefix="llm-fetch-%s" % self.name)
+            if self._sched_thread is None:
+                self._sched_thread = threading.Thread(
+                    target=self._scheduler_loop, args=(self._gen,),
+                    daemon=True, name="llm-decode-%s" % self.name)
+                self._sched_thread.start()
+            if self._delivery_thread is None:
+                self._delivery_thread = threading.Thread(
+                    target=self._delivery_loop, args=(self._gen,),
+                    daemon=True, name="llm-deliver-%s" % self.name)
+                self._delivery_thread.start()
+
+    def _deliver(self, lane: int, req: _GenRequest, token: int) -> bool:
+        """Pushes one token; returns False when the request finished
+        (EOS, budget, or consumer abandonment). Caller holds
+        _sched_cv."""
+        if req.cancelled:
+            req.finish()
+            return False
+        if token == EOS and not req.ignore_eos:
+            req.finish()
+            return False
+        req.queue.put(int(token))
+        req.delivered += 1
+        if req.delivered >= req.max_tokens:
+            req.finish()
+            return False
+        return True
+
+    def _release_lane(self, lane: int):
+        """Caller holds _sched_cv."""
+        self._active.pop(lane, None)
+        self._lane_pos[lane] = 0
+        self._free_lanes.append(lane)
+
+    def _compile_prefill(self, b: int, bucket: int):
+        """AOT-compiles the (b, bucket) prefill and publishes it in
+        _prefill_exec. Runs inline for batch 1 (first use of a new
+        bucket has nothing to fall back to) and on a background thread
+        for batched shapes."""
+        toks = jax.ShapeDtypeStruct((b, bucket), jnp.int32)
+        lens = jax.ShapeDtypeStruct((b,), jnp.int32)
+        cache = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+            init_cache(self.cfg, b))
+        compiled = self._prefill.lower(
+            self._params, toks, cache, lens).compile()
+        with self._prefill_exec_lock:
+            self._prefill_exec[(b, bucket)] = compiled
+            self._prefill_compiling.discard((b, bucket))
+
+    def _get_prefill_exec(self, b: int, bucket: int):
+        """Returns the compiled (b, bucket) prefill, or None while a
+        background compile is still in flight (caller falls back to
+        batch 1). Batch 1 always blocks until compiled."""
+        key = (b, bucket)
+        with self._prefill_exec_lock:
+            compiled = self._prefill_exec.get(key)
+            if compiled is not None:
+                return compiled
+            if b > 1 and key in self._prefill_compiling:
+                return None
+            if b > 1:
+                self._prefill_compiling.add(key)
+        if b == 1:
+            self._compile_prefill(1, bucket)
+            return self._prefill_exec[key]
+        threading.Thread(
+            target=self._compile_prefill_safely, args=(b, bucket),
+            daemon=True, name="llm-prefill-compile").start()
+        return None
+
+    def _compile_prefill_safely(self, b: int, bucket: int):
+        try:
+            self._compile_prefill(b, bucket)
+        except Exception:  # noqa: BLE001 — joins keep falling back
+            with self._prefill_exec_lock:
+                self._prefill_compiling.discard((b, bucket))
+
+    def _dispatch_joins(self, joins, gen: int):
+        """Batched prefill for a set of (lane, request) joins: prompts
+        sharing a padded bucket go through ONE prefill dispatch (batch
+        padded to a power of two so XLA compiles per (B, bucket), not
+        per request mix), their caches are row-inserted into the
+        decode cache, and the first tokens are scattered into the
+        device token vector. Nothing here blocks on the device — the
+        first tokens travel to clients through the delivery queue like
+        any decode chunk. Runs on the scheduler thread, no lock held
+        during device work."""
+        groups: Dict[int, list] = {}
+        for lane, req in joins:
+            n = len(req.prompt)
+            bucket = 16
+            while bucket < n:
+                bucket *= 2
+            bucket = min(bucket, self.cfg.max_seq)
+            groups.setdefault(bucket, []).append((lane, req))
+        batches = []
+        for bucket, group in groups.items():
+            b = 1
+            while b < len(group):
+                b *= 2
+            compiled = self._get_prefill_exec(b, bucket)
+            if compiled is None:
+                # Batched shape still compiling in the background:
+                # fall back to batch-1 prefills rather than stalling
+                # every active stream for seconds.
+                one = self._get_prefill_exec(1, bucket)
+                batches.extend((bucket, 1, one, [entry]) for entry in group)
+            else:
+                batches.append((bucket, b, compiled, group))
+        for batch_idx, (bucket, b, compiled, group) in enumerate(batches):
+            padded = np.full((b, bucket), PAD, dtype=np.int32)
+            lens = np.ones((b,), dtype=np.int32)
+            for row, (lane, req) in enumerate(group):
+                padded[row, :len(req.prompt)] = req.prompt
+                lens[row] = len(req.prompt)
+            firsts, multi_cache = compiled(
+                self._params, jnp.asarray(padded),
+                init_cache(self.cfg, b), jnp.asarray(lens))  # [b] device
+            lanes_idx = np.array([lane for lane, _ in group],
+                                 dtype=np.int32)
+            for row, (lane, req) in enumerate(group):
+                self._batched_cache = self._lane_insert_row(
+                    self._batched_cache, multi_cache,
+                    np.int32(row), np.int32(lane))
+            self._tokens_dev = self._set_lane_tokens(
+                self._tokens_dev, jnp.asarray(lanes_idx),
+                firsts[:len(group)])
+            fut = self._fetch_pool.submit(np.asarray, firsts)
+            with self._sched_cv:
+                if self._sched_stop or self._gen != gen:
+                    # Unload or a concurrent _crash reset the pipeline.
+                    # Fail the current group AND every not-yet-run
+                    # group — they are all popped off _join_queue and
+                    # invisible to any other cleanup path. After a
+                    # crash the lane list was already rebuilt, so only
+                    # re-add lanes while this generation is live.
+                    for _, _, _, late_group in batches[batch_idx:]:
+                        for lane, req in late_group:
+                            req.fail("model unloaded")
+                            if self._gen == gen:
+                                self._free_lanes.append(lane)
+                    return
+                for row, (lane, req) in enumerate(group):
+                    self._lane_pos[lane] = len(req.prompt)
+                    self._active[lane] = req
+                self._delivery_queue.append(("join", fut, list(group)))
+                self._sched_cv.notify_all()
+
+    def _scheduler_loop(self, gen: int):
+        """Dispatch side of the decode pipeline: prefills joins and
+        launches decode chunks back-to-back WITHOUT waiting for their
+        device->host fetches — each chunk's token fetch rides the
+        fetch pool and reaches clients through _delivery_loop. The
+        relay's ~65 ms fetch latency then overlaps the next chunks'
+        compute instead of gating the token cadence (inter-chunk gap =
+        chunk compute time, not fetch latency)."""
+        try:
+            while True:
+                joins = []
+                with self._sched_cv:
+                    while (not self._sched_stop and self._gen == gen
+                           and not (self._join_queue and self._free_lanes)
+                           and not (self._active
+                                    and self._inflight < self.MAX_INFLIGHT)):
+                        self._sched_cv.wait()
+                    if self._sched_stop or self._gen != gen:
+                        return
+                    while self._join_queue and self._free_lanes:
+                        req = self._join_queue.pop(0)
+                        if req.cancelled:  # abandoned while queued
+                            req.finish()
+                            continue
+                        joins.append((self._free_lanes.pop(0), req))
+                if joins:
+                    try:
+                        self._dispatch_joins(joins, gen)
+                    except Exception as e:  # noqa: BLE001
+                        # Popped requests are in neither _active nor
+                        # _join_queue, so the crash handler cannot see
+                        # all of them — fail them here or their clients
+                        # block forever on queue.get().
+                        with self._sched_cv:
+                            for lane2, req2 in joins:
+                                if self._active.get(lane2) is not req2:
+                                    req2.fail("llm prefill failed: %s" % e)
+                                    if (self._gen == gen
+                                            and lane2 not in self._active):
+                                        self._free_lanes.append(lane2)
+                        raise
+                    continue  # more joins may fit before the next chunk
+                with self._sched_cv:
+                    if (not self._active or self._batched_cache is None
+                            or self._inflight >= self.MAX_INFLIGHT):
+                        continue
+                    pos_host = np.asarray(self._lane_pos, dtype=np.int32)
+                toks, self._batched_cache = self._decode_chunk_multi(
+                    self._params, self._tokens_dev, jnp.asarray(pos_host),
+                    self._batched_cache)
+                self._tokens_dev = toks[-1]  # [lanes] device carry
+                fut = self._fetch_pool.submit(np.asarray, toks)
+                with self._sched_cv:
+                    if self._sched_stop or self._gen != gen:
+                        # A concurrent _crash/unload reset the pipeline
+                        # while this dispatch ran unlocked — registering
+                        # the record would hand the NEW generation a
+                        # stale (possibly failing) future and re-mark
+                        # rebuilt free lanes active.
+                        return
+                    snapshot = dict(self._active)
+                    for lane in snapshot:
+                        self._lane_pos[lane] += self.STREAM_CHUNK
+                    self._inflight += 1
+                    self._delivery_queue.append(("chunk", fut, snapshot))
+                    self._sched_cv.notify_all()
+        except Exception as e:  # noqa: BLE001 — fail all riders loudly
+            self._crash("llm scheduler failed: %s" % e, gen)
+
+    def _delivery_loop(self, gen: int):
+        """Consumer side of the decode pipeline: waits on each fetched
+        token block IN DISPATCH ORDER and routes tokens to their
+        requests. Runs concurrently with the scheduler's next
+        dispatches, so the fetch latency is pipelined away."""
+        try:
+            while True:
+                with self._sched_cv:
+                    while (not self._sched_stop and self._gen == gen
+                           and not self._delivery_queue):
+                        self._sched_cv.wait()
+                    if self._sched_stop or self._gen != gen:
+                        return
+                    kind, fut, payload = self._delivery_queue.popleft()
+                ids = fut.result()  # blocks ~one relay round trip
+                if kind == "join":
+                    with self._sched_cv:
+                        if self._gen != gen:
+                            return
+                        for row, (lane, req) in enumerate(payload):
+                            if self._active.get(lane) is not req:
+                                continue  # finished/cancelled already
+                            if not self._deliver(lane, req, int(ids[row])):
+                                self._release_lane(lane)
+                        self._sched_cv.notify_all()
+                    continue
+                with self._sched_cv:
+                    if self._gen != gen:
+                        return
+                    for lane, req in payload.items():
+                        if self._active.get(lane) is not req:
+                            continue  # lane re-assigned since dispatch
+                        alive = True
+                        for token in ids[:, lane]:
+                            alive = self._deliver(lane, req, int(token))
+                            if not alive:
+                                break
+                        if alive and (len(req.prompt) + req.delivered
+                                      >= self.cfg.max_seq - 1):
+                            req.finish()
+                            alive = False
+                        if not alive:
+                            self._release_lane(lane)
+                    self._inflight -= 1
+                    self._sched_cv.notify_all()
+        except Exception as e:  # noqa: BLE001
+            self._crash("llm delivery failed: %s" % e, gen)
+
+    def _collect_riders(self):
+        """Every request the pipeline still owes tokens to: active
+        lanes, queued joins, and requests riding undelivered records.
+        Caller holds _sched_cv."""
+        riders = list(self._active.values()) + self._join_queue
+        for _, _, payload in self._delivery_queue:
+            if isinstance(payload, dict):
+                riders.extend(payload.values())
+            else:
+                riders.extend(req for _, req in payload)
+        return riders
+
+    def _crash(self, message: str, gen: int):
+        """Fails every rider and resets the pipeline so a later
+        request restarts it cleanly (the donated cache may already be
+        consumed; leaked lanes would leave a restart spinning)."""
+        with self._sched_cv:
+            if self._gen != gen:  # another thread already reset
+                return
+            self._gen += 1
+            for req in self._collect_riders():
+                req.fail(message)
+            self._active.clear()
+            self._join_queue.clear()
+            self._delivery_queue.clear()
+            self._inflight = 0
+            self._free_lanes = list(range(self._lanes))
+            self._lane_pos = [0] * self._lanes
+            self._tokens_dev = None
+            self._batched_cache = None
+            self._sched_thread = None
+            self._delivery_thread = None
+            self._sched_cv.notify_all()
+
+    def unload(self) -> None:
+        with self._sched_cv:
+            self._sched_stop = True
+            for req in self._collect_riders():
+                req.fail("model unloaded")
+            self._active.clear()
+            self._join_queue.clear()
+            self._delivery_queue.clear()
+            self._inflight = 0
+            self._sched_cv.notify_all()
+        if self._sched_thread is not None:
+            self._sched_thread.join(timeout=10)
+        if self._delivery_thread is not None:
+            self._delivery_thread.join(timeout=10)
+        if self._fetch_pool is not None:
+            self._fetch_pool.shutdown(wait=False)
+
+    def _generate(self, inputs, parameters):
+        text = inputs["text_input"].reshape(-1)[0]
+        if isinstance(text, bytes):
+            text = text.decode("utf-8", errors="replace")
+        else:
+            text = str(text)
+        max_tokens = int(
+            inputs.get("max_tokens", np.array([32])).reshape(-1)[0]
+        )
+        max_tokens = max(1, min(max_tokens, self.cfg.max_seq - 2))
+        ignore_eos = bool(
+            inputs.get("ignore_eos", np.array([False])).reshape(-1)[0]
+        )
+        prompt = self._tokenizer.encode(text)
+        prompt = prompt[-(self.cfg.max_seq - max_tokens - 1):]
+        request = _GenRequest(prompt, max_tokens, ignore_eos)
+        with self._sched_cv:
+            if self._sched_stop:
+                raise InferenceServerException(
+                    "model '%s' is unloaded" % self.name,
+                    status="UNAVAILABLE")
+            if self._batched_cache is None:
+                self._batched_cache = init_cache(self.cfg, self._lanes)
+            if self._tokens_dev is None:
+                self._tokens_dev = jnp.full(
+                    (self._lanes,), PAD, dtype=jnp.int32)
+            self._join_queue.append(request)
+            self._sched_cv.notify_all()
+        # AFTER enqueuing: a scheduler that crashed between the
+        # liveness check and the append would otherwise leave the
+        # request stranded — this restart sees it in the queue.
+        self._ensure_scheduler()
+        try:
+            while True:
+                token = request.queue.get()
+                if token is None:
+                    break
+                yield token
+        finally:
+            # Consumer gone (client disconnect closes the generator):
+            # let the scheduler reclaim the lane at the next chunk.
+            request.cancelled = True
+        if request.error is not None:
+            raise InferenceServerException(request.error,
+                                           status="INTERNAL")
+
+    def infer_stream(self, inputs, parameters=None
+                     ) -> Iterator[Dict[str, np.ndarray]]:
+        for token in self._generate(inputs, parameters or {}):
+            piece = self._tokenizer.decode([token])
+            yield {
+                "text_output": np.array([piece.encode()], dtype=np.object_)
+            }
+
+    def infer(self, inputs, parameters=None) -> Dict[str, np.ndarray]:
+        tokens = list(self._generate(inputs, parameters or {}))
+        text = self._tokenizer.decode(tokens)
+        return {"text_output": np.array([text.encode()], dtype=np.object_)}
+
+    def warmup(self) -> None:
+        # Prime the prefill shapes concurrent serving hits (power-of
+        # -two join batches x the two common prompt buckets) so no
+        # multi-second XLA compile lands mid-stream; the persistent
+        # compilation cache makes repeat warmups near-free.
+        pow2s = [1]
+        while pow2s[-1] < self._lanes:  # ceiling pow2 covers any group
+            pow2s.append(pow2s[-1] * 2)
+        for b in pow2s:
+            for bucket in sorted({min(16, self.cfg.max_seq),
+                                  min(64, self.cfg.max_seq)}):
+                if (b, bucket) not in self._prefill_exec:
+                    try:
+                        self._compile_prefill(b, bucket)
+                    except Exception:  # noqa: BLE001 — warmup best-effort
+                        pass
+        # The join path's small shape-dependent kernels (cache row
+        # insert per prefill batch, token scatter per join-group size)
+        # also compile per shape — prime them too, or the first
+        # concurrent join round stalls every stream for the compile.
+        try:
+            for b in pow2s:
+                scratch = self._lane_insert_row(
+                    init_cache(self.cfg, self._lanes),
+                    init_cache(self.cfg, b), np.int32(0), np.int32(0))
+                del scratch
+            toks = jnp.full((self._lanes,), PAD, dtype=jnp.int32)
+            for g in range(1, self._lanes + 1):
+                toks = self._set_lane_tokens(
+                    toks, jnp.arange(g, dtype=jnp.int32),
+                    jnp.full((g,), PAD, dtype=jnp.int32))
+            del toks
+        except Exception:  # noqa: BLE001 — warmup best-effort
+            pass
+        list(self.infer_stream({
+            "text_input": np.array([b"hi"], dtype=np.object_),
+            "max_tokens": np.array([2], dtype=np.int32),
+        }))

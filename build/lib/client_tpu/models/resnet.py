@@ -1,0 +1,168 @@
+"""ResNet-50 (NHWC, bf16) — the north-star benchmark model
+(BASELINE config #2: image classification with TPU shared-memory I/O).
+
+Inference-mode batch norm folded into scale/bias; convs via
+lax.conv_general_dilated in NHWC which XLA maps straight onto the MXU.
+Weights are randomly initialized — the benchmark measures the serving
+path, not accuracy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from client_tpu.server.model import ServedModel, TensorSpec
+
+STAGES = {50: (3, 4, 6, 3), 101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
+
+
+@dataclasses.dataclass
+class ResNetConfig:
+    depth: int = 50
+    num_classes: int = 1000
+    width: int = 64
+    dtype: str = "bfloat16"
+
+
+def _conv_kernel(key, kh, kw, cin, cout, dtype):
+    fan_in = kh * kw * cin
+    return (jax.random.normal(key, (kh, kw, cin, cout), dtype=jnp.float32)
+            * np.sqrt(2.0 / fan_in)).astype(dtype)
+
+
+def _bn(c, dtype):
+    return {
+        "scale": jnp.ones((c,), dtype=dtype),
+        "bias": jnp.zeros((c,), dtype=dtype),
+    }
+
+
+def init_params(key, cfg: ResNetConfig) -> Dict:
+    dtype = jnp.dtype(cfg.dtype)
+    keys = jax.random.split(key, 64)
+    ki = iter(range(64))
+    params = {
+        "stem": {
+            "conv": _conv_kernel(keys[next(ki)], 7, 7, 3, cfg.width, dtype),
+            "bn": _bn(cfg.width, dtype),
+        },
+        "stages": [],
+    }
+    cin = cfg.width
+    for stage_idx, blocks in enumerate(STAGES[cfg.depth]):
+        cmid = cfg.width * (2 ** stage_idx)
+        cout = cmid * 4
+        stage = []
+        for block_idx in range(blocks):
+            key = jax.random.fold_in(keys[next(ki) % 64], block_idx)
+            bk = jax.random.split(key, 4)
+            block = {
+                "conv1": _conv_kernel(bk[0], 1, 1, cin, cmid, dtype),
+                "bn1": _bn(cmid, dtype),
+                "conv2": _conv_kernel(bk[1], 3, 3, cmid, cmid, dtype),
+                "bn2": _bn(cmid, dtype),
+                "conv3": _conv_kernel(bk[2], 1, 1, cmid, cout, dtype),
+                "bn3": _bn(cout, dtype),
+            }
+            if block_idx == 0:
+                block["proj"] = _conv_kernel(bk[3], 1, 1, cin, cout, dtype)
+                block["proj_bn"] = _bn(cout, dtype)
+            stage.append(block)
+            cin = cout
+        params["stages"].append(stage)
+    head_key = keys[next(ki) % 64]
+    params["head"] = {
+        "kernel": (jax.random.normal(head_key, (cin, cfg.num_classes),
+                                     dtype=jnp.float32) * 0.01).astype(dtype),
+        "bias": jnp.zeros((cfg.num_classes,), dtype=dtype),
+    }
+    return params
+
+
+def _conv(x, kernel, stride=1):
+    return jax.lax.conv_general_dilated(
+        x, kernel, window_strides=(stride, stride), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _apply_bn(x, bn):
+    return x * bn["scale"] + bn["bias"]
+
+
+def _bottleneck(x, block, stride):
+    shortcut = x
+    y = jax.nn.relu(_apply_bn(_conv(x, block["conv1"]), block["bn1"]))
+    y = jax.nn.relu(_apply_bn(_conv(y, block["conv2"], stride), block["bn2"]))
+    y = _apply_bn(_conv(y, block["conv3"]), block["bn3"])
+    if "proj" in block:
+        shortcut = _apply_bn(_conv(x, block["proj"], stride),
+                             block["proj_bn"])
+    return jax.nn.relu(y + shortcut)
+
+
+def forward(params, images, cfg: ResNetConfig):
+    """images [B, 224, 224, 3] -> logits [B, num_classes]."""
+    x = images.astype(jnp.dtype(cfg.dtype))
+    x = jax.nn.relu(_apply_bn(_conv(x, params["stem"]["conv"], 2),
+                              params["stem"]["bn"]))
+    x = jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME"
+    )
+    for stage_idx, stage in enumerate(params["stages"]):
+        for block_idx, block in enumerate(stage):
+            stride = 2 if (stage_idx > 0 and block_idx == 0) else 1
+            x = _bottleneck(x, block, stride)
+    x = jnp.mean(x, axis=(1, 2))
+    logits = x @ params["head"]["kernel"] + params["head"]["bias"]
+    return logits.astype(jnp.float32)
+
+
+class ResNetModel(ServedModel):
+    """Input "INPUT" FP32 [224,224,3] (NHWC, batchable), output
+    "OUTPUT" FP32 [num_classes] — the image_client parity surface."""
+
+    platform = "jax"
+    max_batch_size = 32
+    # Fuse concurrent requests into MXU-friendly batches server-side.
+    dynamic_batching = True
+    # Two compile shapes only: 8 leaves a lone batch-8 request
+    # unpadded; fused buckets pad to 32 (the MXU sweet spot).
+    preferred_batch_sizes = [8, 32]
+    # 2 ms gather window: long enough for a burst of concurrent
+    # ensemble backbone steps (batch-1 each, arriving within ~1 ms of
+    # each other) to fuse, negligible against the ~65 ms relay floor.
+    max_queue_delay_us = 2000
+
+    def __init__(self, name: str = "resnet50", cfg: Optional[ResNetConfig]
+                 = None, seed: int = 0):
+        super().__init__()
+        self.name = name
+        self.cfg = cfg or ResNetConfig()
+        self.inputs = [TensorSpec("INPUT", "FP32", [224, 224, 3])]
+        self.outputs = [TensorSpec("OUTPUT", "FP32",
+                                   [self.cfg.num_classes])]
+        self._params = init_params(jax.random.PRNGKey(seed), self.cfg)
+        cfg_static = self.cfg
+        self._fn = jax.jit(lambda p, x: forward(p, x, cfg_static))
+
+    def infer(self, inputs, parameters=None):
+        images = inputs["INPUT"]
+        # Unbatched single image (host OR device array — a device-side
+        # preprocess step hands over jax.Arrays): add the batch dim.
+        if getattr(images, "ndim", 0) == 3:
+            images = images[None]
+        return {"OUTPUT": self._fn(self._params, images)}
+
+    def warmup(self) -> None:
+        # Compile the single-sample path plus the dynamic batcher's
+        # preferred fused shapes ahead of traffic.
+        for batch in [1] + list(self.preferred_batch_sizes):
+            x = jnp.zeros((batch, 224, 224, 3), dtype=jnp.float32)
+            jax.block_until_ready(self._fn(self._params, x))

@@ -1,0 +1,138 @@
+"""Protocol-conformance models backing the examples suite: BYTES
+string math, stateful sequence accumulation, and a decoupled repeat
+streamer — the TPU-framework counterparts of the reference's
+`simple_string`, `simple_sequence`-style, and `repeat_int32` test
+models (driven by e.g. reference
+src/python/examples/simple_grpc_string_infer_client.py,
+simple_grpc_sequence_stream_infer_client.py, and the decoupled
+examples)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+from client_tpu.server.model import ServedModel, TensorSpec
+from client_tpu.utils import InferenceServerException
+
+
+class StringAddSub(ServedModel):
+    """BYTES add/sub: inputs hold decimal integer strings; outputs are
+    their sums/differences as strings (parity: the reference server's
+    simple_string model)."""
+
+    def __init__(self, name: str = "simple_string", count: int = 16):
+        super().__init__()
+        self.name = name
+        self.platform = "python"
+        self._count = count
+        self.inputs = [
+            TensorSpec("INPUT0", "BYTES", [count]),
+            TensorSpec("INPUT1", "BYTES", [count]),
+        ]
+        self.outputs = [
+            TensorSpec("OUTPUT0", "BYTES", [count]),
+            TensorSpec("OUTPUT1", "BYTES", [count]),
+        ]
+
+    def infer(self, inputs: Dict[str, np.ndarray],
+              parameters: Optional[dict] = None) -> Dict[str, np.ndarray]:
+        def to_ints(array: np.ndarray) -> np.ndarray:
+            flat = array.reshape(-1)
+            try:
+                return np.array(
+                    [int(v.decode() if isinstance(v, bytes) else v)
+                     for v in flat],
+                    dtype=np.int64,
+                )
+            except ValueError as e:
+                raise InferenceServerException(
+                    "non-integer string tensor: %s" % e,
+                    status="INVALID_ARGUMENT",
+                )
+
+        in0 = to_ints(inputs["INPUT0"])
+        in1 = to_ints(inputs["INPUT1"])
+        out0 = np.array([str(v).encode() for v in in0 + in1],
+                        dtype=np.object_)
+        out1 = np.array([str(v).encode() for v in in0 - in1],
+                        dtype=np.object_)
+        return {"OUTPUT0": out0, "OUTPUT1": out1}
+
+
+class SequenceAccumulator(ServedModel):
+    """Stateful sequence model: per sequence-id running sum of the
+    INT32 input; sequence_start resets, sequence_end drops the state.
+    Config advertises sequence_batching so clients schedule it through
+    their sequence path (parity: the dyna_sequence/simple_sequence
+    models the reference sequence examples call)."""
+
+    def __init__(self, name: str = "simple_sequence"):
+        super().__init__()
+        self.name = name
+        self.platform = "python"
+        self.inputs = [TensorSpec("INPUT", "INT32", [1])]
+        self.outputs = [TensorSpec("OUTPUT", "INT32", [1])]
+        self._lock = threading.Lock()
+        self._state: Dict[int, int] = {}
+
+    def _extend_config(self, config) -> None:
+        config.sequence_batching.SetInParent()
+
+    def infer(self, inputs: Dict[str, np.ndarray],
+              parameters: Optional[dict] = None) -> Dict[str, np.ndarray]:
+        params = parameters or {}
+        sequence_id = int(params.get("sequence_id", 0))
+        if sequence_id == 0:
+            raise InferenceServerException(
+                "model '%s' requires a sequence_id" % self.name,
+                status="INVALID_ARGUMENT",
+            )
+        value = int(np.asarray(inputs["INPUT"]).reshape(-1)[0])
+        with self._lock:
+            if params.get("sequence_start"):
+                self._state[sequence_id] = 0
+            if sequence_id not in self._state:
+                raise InferenceServerException(
+                    "sequence %d not started" % sequence_id,
+                    status="INVALID_ARGUMENT",
+                )
+            self._state[sequence_id] += value
+            total = self._state[sequence_id]
+            if params.get("sequence_end"):
+                del self._state[sequence_id]
+        return {"OUTPUT": np.array([total], dtype=np.int32)}
+
+
+class RepeatInt32(ServedModel):
+    """Decoupled streamer: emits one response per element of IN, with
+    an optional per-response DELAY (us) — the shape the reference's
+    decoupled examples drive (repeat_int32)."""
+
+    decoupled = True
+
+    def __init__(self, name: str = "repeat_int32"):
+        super().__init__()
+        self.name = name
+        self.platform = "python"
+        self.inputs = [
+            TensorSpec("IN", "INT32", [-1]),
+            TensorSpec("DELAY", "UINT32", [-1], optional=True),
+        ]
+        self.outputs = [TensorSpec("OUT", "INT32", [1])]
+
+    def infer_stream(self, inputs: Dict[str, np.ndarray],
+                     parameters: Optional[dict] = None
+                     ) -> Iterator[Dict[str, np.ndarray]]:
+        import time
+
+        values = np.asarray(inputs["IN"]).reshape(-1)
+        delays = None
+        if "DELAY" in inputs:
+            delays = np.asarray(inputs["DELAY"]).reshape(-1)
+        for i, value in enumerate(values):
+            if delays is not None and i < len(delays):
+                time.sleep(int(delays[i]) / 1e6)
+            yield {"OUT": np.array([value], dtype=np.int32)}

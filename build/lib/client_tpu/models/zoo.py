@@ -1,0 +1,36 @@
+"""Extended model zoo: the BASELINE.md benchmark models."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+from client_tpu.server.model import ServedModel
+
+
+def extra_model_factories(repository=None) -> Dict[str, Callable[[], ServedModel]]:
+    from client_tpu.models.bert import BertModel
+    from client_tpu.models.ensemble import (
+        PostprocessModel,
+        PreprocessModel,
+        make_image_ensemble,
+    )
+    from client_tpu.models.llm import LlmConfig, LlmModel
+    from client_tpu.models.resnet import ResNetModel
+
+    factories: Dict[str, Callable[[], ServedModel]] = {
+        "resnet50": ResNetModel,
+        "bert_base": BertModel,
+        "llm_tiny": lambda: LlmModel(name="llm_tiny"),
+        "llm_small": lambda: LlmModel(
+            name="llm_small",
+            cfg=LlmConfig(d_model=512, n_layers=8, n_heads=8, n_kv_heads=4,
+                          d_ff=1408, max_seq=2048),
+        ),
+        "preprocess": PreprocessModel,
+        "postprocess": PostprocessModel,
+    }
+    if repository is not None:
+        factories["ensemble_image"] = (
+            lambda: make_image_ensemble(repository)
+        )
+    return factories

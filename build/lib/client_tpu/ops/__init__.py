@@ -1,0 +1,12 @@
+"""Hand-written TPU kernels (Pallas) for the hot ops.
+
+XLA fuses the bulk of the models well; kernels live here only where
+manual control of VMEM residency and the MXU schedule beats the
+compiler — currently flash attention (streaming-softmax attention that
+never materializes the [S, S] score matrix).
+"""
+
+from client_tpu.ops.flash_attention import (  # noqa: F401
+    flash_attention,
+    flash_attention_fn,
+)

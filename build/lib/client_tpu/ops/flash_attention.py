@@ -1,0 +1,172 @@
+"""Flash attention as a Pallas TPU kernel.
+
+Dense attention materializes the [S, S] score matrix in HBM — O(S^2)
+memory traffic, the classic long-context killer. This kernel streams
+K/V blocks through VMEM and keeps the softmax running statistics
+(row max + row sum) in registers, so scores never leave the core and
+HBM traffic stays O(S * D). One grid cell per (batch*head, q-block);
+the inner lax.fori_loop walks K/V blocks, skipping fully-masked
+blocks under causal masking.
+
+Head_dim is zero-padded to the 128-lane tile (guide: last dim must be
+128); zero columns contribute nothing to either the scores or the
+output, so padding is exact. K/V for one (batch, head) must fit VMEM
+(~16 MB/core): fine through S ~ 8k at f32, far beyond the serving
+shapes here — shard longer sequences over the mesh with
+client_tpu.parallel.ring_attention instead (the two compose: ring
+rotates shards, flash computes each block pair).
+
+Algorithm: Dao et al., "FlashAttention: Fast and Memory-Efficient
+Exact Attention with IO-Awareness" (arXiv:2205.14135), re-derived for
+Pallas; no reference implementation was consulted.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, *, block_q: int,
+                  block_k: int, seq_k: int, n_heads: int, causal: bool,
+                  scale: float):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale  # [block_q, d]
+    d = q.shape[-1]
+    # This sequence's real key length (lengths live in SMEM, whole
+    # array per grid cell; batch index = bh // heads).
+    valid_k = len_ref[pl.program_id(0) // n_heads]
+
+    acc = jnp.zeros((block_q, d), jnp.float32)
+    row_max = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    row_sum = jnp.zeros((block_q,), jnp.float32)
+
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    def body(ki, carry):
+        acc, row_max, row_sum = carry
+        k_block = k_ref[0, pl.dslice(ki * block_k, block_k)].astype(
+            jnp.float32)
+        v_block = v_ref[0, pl.dslice(ki * block_k, block_k)].astype(
+            jnp.float32)
+        scores = jax.lax.dot_general(
+            q, k_block, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [block_q, block_k]
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        visible = k_pos < valid_k  # padded key rows never win
+        if causal:
+            visible = jnp.logical_and(visible, q_pos >= k_pos)
+        scores = jnp.where(visible, scores, _NEG_INF)
+        block_max = jnp.max(scores, axis=-1)
+        new_max = jnp.maximum(row_max, block_max)
+        alpha = jnp.exp(row_max - new_max)
+        # Gate the exp with the mask: fully-masked rows would
+        # otherwise contribute exp(_NEG_INF - _NEG_INF) = 1 each.
+        weights = jnp.where(
+            visible, jnp.exp(scores - new_max[:, None]), 0.0)
+        new_sum = row_sum * alpha + jnp.sum(weights, axis=-1)
+        new_acc = acc * alpha[:, None] + jax.lax.dot_general(
+            weights, v_block, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return new_acc, new_max, new_sum
+
+    # Skip blocks that are entirely masked: past this sequence's real
+    # length, and (causal) strictly above the diagonal.
+    num_k_blocks = jnp.minimum(seq_k // block_k,
+                               pl.cdiv(valid_k, block_k))
+    if causal:
+        num_k_blocks = jnp.minimum(
+            num_k_blocks,
+            pl.cdiv((qi + 1) * block_q, block_k))
+    acc, row_max, row_sum = jax.lax.fori_loop(
+        0, num_k_blocks, body, (acc, row_max, row_sum))
+    o_ref[0] = (acc / jnp.maximum(row_sum, 1e-30)[:, None]).astype(
+        o_ref.dtype)
+
+
+def flash_attention(q, k, v, *, causal: bool = True,
+                    scale: Optional[float] = None, block_q: int = 128,
+                    block_k: int = 128, valid_lengths=None,
+                    interpret: bool = False):
+    """q: [B, S_q, H, D]; k/v: [B, S_k, H, D]. Returns [B, S_q, H, D].
+    Sequence lengths are padded to the block size internally (padded
+    key rows are masked out; padded query rows are dropped).
+    ``valid_lengths`` ([B] int32, optional) masks keys per sequence —
+    the variable-length-batch shape encoder models (BERT) run, where
+    each batch row has its own real length inside the padded bucket."""
+    b, s_q, h, d = q.shape
+    s_k = k.shape[1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+
+    pad_q = (-s_q) % block_q
+    pad_k = (-s_k) % block_k
+    pad_d = (-d) % 128
+    if causal and s_q != s_k:
+        raise ValueError("causal flash attention needs S_q == S_k")
+
+    def prep(x, pad_s):
+        x = jnp.pad(x, ((0, 0), (0, pad_s), (0, 0), (0, pad_d)))
+        # [B, S, H, D] -> [B*H, S, D]
+        return x.transpose(0, 2, 1, 3).reshape(
+            b * h, x.shape[1], d + pad_d)
+
+    qt = prep(q, pad_q)
+    kt = prep(k, pad_k)
+    vt = prep(v, pad_k)
+    seq_q, seq_k = s_q + pad_q, s_k + pad_k
+    if valid_lengths is None:
+        lengths = jnp.full((b,), s_k, dtype=jnp.int32)
+    else:
+        lengths = jnp.asarray(valid_lengths, jnp.int32).reshape(b)
+
+    kernel = functools.partial(
+        _flash_kernel, block_q=block_q, block_k=block_k, seq_k=seq_k,
+        n_heads=h, causal=causal, scale=scale)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, seq_q // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d + pad_d),
+                         lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, seq_k, d + pad_d),
+                         lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, seq_k, d + pad_d),
+                         lambda bh, qi: (bh, 0, 0)),
+            # Whole [B] lengths vector in SMEM per grid cell.
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d + pad_d),
+                               lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(
+            (b * h, seq_q, d + pad_d), q.dtype),
+        interpret=interpret,
+    )(qt, kt, vt, lengths)
+
+    out = out.reshape(b, h, seq_q, d + pad_d).transpose(0, 2, 1, 3)
+    return out[:, :s_q, :, :d]
+
+
+def flash_attention_fn(interpret: bool = False):
+    """Drop-in for the LLM forward's attention_fn hook (same contract
+    as parallel.ring_attention_fn): expands GQA heads, ignores the
+    mask argument because causal masking happens in-kernel."""
+
+    def attn(q, k, v, mask):  # noqa: ARG001 — causal in-kernel
+        h, hkv = q.shape[2], k.shape[2]
+        if h != hkv:
+            k = jnp.repeat(k, h // hkv, axis=2)
+            v = jnp.repeat(v, h // hkv, axis=2)
+        return flash_attention(q, k, v, causal=True, interpret=interpret)
+
+    return attn

@@ -1,0 +1,102 @@
+"""Mesh + sharding utilities for multi-chip serving and training.
+
+The framework's distributed design follows the JAX SPMD recipe: pick a
+``Mesh`` over the device grid, annotate arrays with ``PartitionSpec``s
+via logical axis rules, jit, and let XLA insert the collectives (ICI
+for intra-slice axes, DCN for the data axis across hosts). Axis
+conventions:
+
+- ``dp``   data parallel (batch dim; DCN-friendly)
+- ``fsdp`` fully-sharded data parallel (params sharded over dp axis)
+- ``tp``   tensor parallel (heads / hidden dims; ICI)
+- ``sp``   sequence/context parallel (long-context; ICI)
+- ``ep``   expert parallel (MoE)
+- ``pp``   pipeline parallel (layer stages)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+AxisSpec = Sequence[Tuple[str, int]]
+
+
+def create_mesh(axes: AxisSpec, devices: Optional[list] = None) -> Mesh:
+    """Build a Mesh from ((name, size), ...); size -1 absorbs the
+    remaining devices."""
+    if devices is None:
+        devices = jax.devices()
+    names = [name for name, _ in axes]
+    sizes = [size for _, size in axes]
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = len(devices) // known
+    total = int(np.prod(sizes))
+    if total > len(devices):
+        raise ValueError(
+            "mesh %s needs %d devices, have %d" % (axes, total, len(devices))
+        )
+    grid = np.array(devices[:total]).reshape(sizes)
+    return Mesh(grid, tuple(names))
+
+
+def single_device_mesh(device=None) -> Mesh:
+    """1x1 mesh — lets the same pjit-ed code run on one chip."""
+    if device is None:
+        device = jax.devices()[0]
+    return Mesh(np.array([device]).reshape(1, 1), ("dp", "tp"))
+
+
+class ShardingRules:
+    """Logical-axis -> mesh-axis mapping (the scaling-book recipe:
+    name your array dims logically, map them to mesh axes once)."""
+
+    def __init__(self, rules: Dict[str, Optional[str]]):
+        self.rules = dict(rules)
+
+    def spec(self, *logical_axes: Optional[str]) -> PartitionSpec:
+        return PartitionSpec(
+            *[self.rules.get(axis) if axis else None
+              for axis in logical_axes]
+        )
+
+    def sharding(self, mesh: Mesh, *logical_axes: Optional[str]
+                 ) -> NamedSharding:
+        return NamedSharding(mesh, self.spec(*logical_axes))
+
+
+# Megatron-style rules for transformer serving/training.
+LLM_RULES = ShardingRules({
+    "batch": "dp",
+    "sequence": None,      # "sp" for context parallelism (long seqs)
+    "model": None,         # residual stream stays replicated
+    "heads": "tp",
+    "kv_heads": "tp",
+    "head_dim": None,
+    "ffn": "tp",
+    "vocab": "tp",
+    "experts": "ep",
+})
+
+LONG_CONTEXT_RULES = ShardingRules({
+    **LLM_RULES.rules,
+    "sequence": "sp",
+})
+
+
+def shard_params(params, mesh: Mesh, spec_tree):
+    """device_put a parameter pytree according to a matching tree of
+    PartitionSpecs."""
+    return jax.tree.map(
+        lambda p, spec: jax.device_put(p, NamedSharding(mesh, spec)),
+        params, spec_tree,
+    )
+
+
+def replicate(value, mesh: Mesh):
+    return jax.device_put(value, NamedSharding(mesh, PartitionSpec()))

@@ -1,0 +1,121 @@
+"""Ring attention: exact attention over a sequence-sharded axis.
+
+Long-context forward passes shard the sequence over the mesh's ``sp``
+axis. Plain attention would force XLA to all-gather the full K/V
+(memory O(S_global)); ring attention instead rotates K/V shards around
+the ring with ``lax.ppermute`` — P steps, each attending the local Q
+block to one remote K/V block — while accumulating a numerically
+stable streaming softmax (the log-sum-exp trick flash attention uses).
+Peak memory stays O(S_local) per device and every hop rides the ring's
+ICI neighbour links, never DCN.
+
+The reference client has no model parallelism anywhere in its tree
+(SURVEY.md §2.7) — this op exists for the framework's own long-context
+models (models/llm.py forward/training path), not as a port.
+
+Algorithm: Liu et al., "Ring Attention with Blockwise Transformers for
+Near-Infinite Context" (arXiv:2310.01889) — re-derived here for
+jax shard_map; no reference implementation was consulted.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
+                          scale: Optional[float], vary_axes: tuple):
+    """Per-device body (runs under shard_map). q/k/v: [B, S_loc, H, D]
+    local shards of a [B, S_loc*P, H, D] global array; returns the
+    local [B, S_loc, H, D] output shard."""
+    p = lax.psum(1, axis_name)
+    my_block = lax.axis_index(axis_name)
+    b, s, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+
+    # Work in [B, H, S, D]; accumulate in f32 regardless of input dtype.
+    qh = q.transpose(0, 2, 1, 3).astype(jnp.float32) * scale
+    kh = k.transpose(0, 2, 1, 3)
+    vh = v.transpose(0, 2, 1, 3)
+
+    # pvary: the accumulators become device-varying from step 0 (the
+    # K/V they absorb differ per device), so the scan carry type is
+    # consistent under shard_map's varying-axes check.
+    out = lax.pvary(jnp.zeros((b, h, s, d), jnp.float32), vary_axes)
+    row_max = lax.pvary(
+        jnp.full((b, h, s), -jnp.inf, jnp.float32), vary_axes)
+    row_sum = lax.pvary(jnp.zeros((b, h, s), jnp.float32), vary_axes)
+    perm = [(j, (j + 1) % p) for j in range(p)]
+
+    def step(carry, i):
+        out, row_max, row_sum, kh, vh = carry
+        # After i rotations this device holds the K/V block that
+        # started on device (my_block - i) mod p.
+        src_block = (my_block - i) % p
+        logits = jnp.einsum(
+            "bhsd,bhtd->bhst", qh, kh.astype(jnp.float32))
+        if causal:
+            q_pos = my_block * s + jnp.arange(s)
+            k_pos = src_block * s + jnp.arange(s)
+            visible = (q_pos[:, None] >= k_pos[None, :]).astype(
+                jnp.float32)
+        else:
+            visible = jnp.ones((s, s), jnp.float32)
+        # Streaming softmax: rescale the running numerator/denominator
+        # by exp(old_max - new_max), add this block's contribution.
+        # Masked entries are zeroed explicitly (not -inf) so a block
+        # with no visible keys contributes exactly nothing.
+        block_max = jnp.max(
+            jnp.where(visible > 0, logits, -jnp.inf), axis=-1)
+        new_max = jnp.maximum(row_max, block_max)
+        # Fully-masked-so-far rows keep -inf; use a finite stand-in for
+        # the subtraction (their weights are zeroed by `visible`).
+        safe_max = jnp.where(jnp.isfinite(new_max), new_max, 0.0)
+        alpha = jnp.where(
+            jnp.isfinite(row_max),
+            jnp.exp(row_max - safe_max), 0.0)
+        # Gate the exp itself, not just the product: a masked (future)
+        # logit can exceed the visible-only max by enough to overflow
+        # exp() to inf, and inf * 0 = NaN.
+        weights = jnp.where(
+            visible > 0, jnp.exp(logits - safe_max[..., None]), 0.0)
+        row_sum = row_sum * alpha + jnp.sum(weights, axis=-1)
+        out = out * alpha[..., None] + jnp.einsum(
+            "bhst,bhtd->bhsd", weights, vh.astype(jnp.float32))
+        kh = lax.ppermute(kh, axis_name, perm)
+        vh = lax.ppermute(vh, axis_name, perm)
+        return (out, new_max, row_sum, kh, vh), None
+
+    (out, _, row_sum, _, _), _ = lax.scan(
+        step, (out, row_max, row_sum, kh, vh), jnp.arange(p))
+    out = out / jnp.maximum(row_sum, 1e-30)[..., None]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh: Mesh, *, axis_name: str = "sp",
+                   causal: bool = True, scale: Optional[float] = None,
+                   batch_axis: Optional[str] = "dp"):
+    """Exact attention with q/k/v sequence-sharded over
+    ``axis_name``. q/k/v: [B, S, H, D] global arrays (S divisible by
+    the axis size); returns [B, S, H, D] with the same sharding.
+    ``batch_axis`` additionally shards batch when present in the mesh.
+    """
+    db = batch_axis if (batch_axis and batch_axis in mesh.axis_names) \
+        else None
+    spec = P(db, axis_name, None, None)
+    vary_axes = (axis_name,) + ((db,) if db else ())
+    local = partial(_ring_attention_local, axis_name=axis_name,
+                    causal=causal, scale=scale, vary_axes=vary_axes)
+    fn = jax.shard_map(
+        local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    constraint = NamedSharding(mesh, spec)
+    q, k, v = (lax.with_sharding_constraint(x, constraint)
+               for x in (q, k, v))
+    return fn(q, k, v)

@@ -1,0 +1,27 @@
+"""Load-generation + profiling harness (perf_analyzer equivalent).
+
+CLI: python -m client_tpu.perf -m <model> [--concurrency-range a:b] ...
+"""
+
+from client_tpu.perf.client_backend import (  # noqa: F401
+    BackendKind,
+    ClientBackend,
+    ClientBackendFactory,
+    MockBackend,
+)
+from client_tpu.perf.data_loader import DataLoader  # noqa: F401
+from client_tpu.perf.load_manager import (  # noqa: F401
+    ConcurrencyManager,
+    InferDataManager,
+    LoadManager,
+    PeriodicConcurrencyManager,
+    RequestRateManager,
+    RequestRecord,
+    SequenceManager,
+)
+from client_tpu.perf.model_parser import ModelParser, ParsedModel  # noqa: F401
+from client_tpu.perf.profiler import (  # noqa: F401
+    InferenceProfiler,
+    MeasurementConfig,
+    PerfStatus,
+)

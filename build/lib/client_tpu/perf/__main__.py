@@ -1,0 +1,3 @@
+from client_tpu.perf.cli import main
+
+main()

@@ -383,20 +383,8 @@ def main() -> None:
                     help="absolute unix time to be fully done by")
     ap.add_argument("--platform", default="",
                     help="force a jax platform (e.g. cpu); default = image")
-    ap.add_argument("--skip-stages", default="",
-                    help="comma-separated stage names already measured "
-                         "elsewhere (the orchestrator's CPU supplement "
-                         "only re-measures what is missing)")
     args = ap.parse_args()
     _OUT_PATH = pathlib.Path(args.out)
-
-    skip_stages = set(filter(None, args.skip_stages.split(",")))
-
-    def stage_wanted(name: str) -> bool:
-        if name in skip_stages:
-            log("%s skipped (already measured by the orchestrator)" % name)
-            return False
-        return True
 
     def remaining() -> float:
         return args.deadline_ts - time.time()
@@ -469,23 +457,22 @@ def main() -> None:
     RESULT["harness"] = "native" if binary else "python"
 
     # Stage 2: simple over gRPC — the guaranteed number.
-    if stage_wanted("simple_grpc"):
-      try:
-          if binary:
-              tput, p50 = run_native(binary, handle.address, "simple",
-                                     batch=1, concurrency=4,
-                                     shared_memory="none", output_shm=0,
-                                     timeout=max(30.0, min(180.0, remaining())))
-          else:
-              tput, p50 = run_python_harness("simple", 1, 4, "none", 0,
-                                             address=handle.address)
-          record_stage("simple_grpc", tput, p50,
-                       {"vs_baseline": round(tput / BASELINE_SIMPLE, 4)})
-      except Exception as exc:  # noqa: BLE001 — always degrade, never die
+    try:
+        if binary:
+            tput, p50 = run_native(binary, handle.address, "simple",
+                                   batch=1, concurrency=4,
+                                   shared_memory="none", output_shm=0,
+                                   timeout=max(30.0, min(180.0, remaining())))
+        else:
+            tput, p50 = run_python_harness("simple", 1, 4, "none", 0,
+                                           address=handle.address)
+        record_stage("simple_grpc", tput, p50,
+                     {"vs_baseline": round(tput / BASELINE_SIMPLE, 4)})
+    except Exception as exc:  # noqa: BLE001 — always degrade, never die
         log("simple_grpc failed: %s" % exc)
 
     # Stage 3: simple in-process (RPC tax datum).
-    if remaining() > 60 and stage_wanted("simple_inprocess"):
+    if remaining() > 60:
         try:
             tput, p50 = run_python_harness("simple", 1, 4, "none", 0,
                                            core=core, warm_s=1.0)
@@ -501,10 +488,7 @@ def main() -> None:
     # host-placed, so the daemon runs on the CPU platform and never
     # contends for the TPU the live in-child server holds.
     serverd = REPO / "native" / "build" / "tpu_serverd"
-    want_native_grpc = "simple_grpc_native_server" not in skip_stages
-    want_native_http = "simple_http_native_server_c1" not in skip_stages
-    if binary and serverd.exists() and remaining() > 60 \
-            and (want_native_grpc or want_native_http):
+    if binary and serverd.exists() and remaining() > 60:
         daemon = None
         http_line = None
         try:
@@ -533,14 +517,12 @@ def main() -> None:
                 raise RuntimeError("tpu_serverd init: %r" % line)
             address = "127.0.0.1:%s" % line.split()[1]
             http_line = daemon.stdout.readline().strip()
-            if want_native_grpc:
-                tput, p50 = run_native(
-                    binary, address, "simple", batch=1, concurrency=4,
-                    shared_memory="none", output_shm=0,
-                    timeout=max(30.0, min(180.0, remaining())))
-                record_stage("simple_grpc_native_server", tput, p50,
-                             {"vs_baseline": round(tput / BASELINE_SIMPLE,
-                                                   4)})
+            tput, p50 = run_native(binary, address, "simple",
+                                   batch=1, concurrency=4,
+                                   shared_memory="none", output_shm=0,
+                                   timeout=max(30.0, min(180.0, remaining())))
+            record_stage("simple_grpc_native_server", tput, p50,
+                         {"vs_baseline": round(tput / BASELINE_SIMPLE, 4)})
         except Exception as exc:  # noqa: BLE001
             log("simple_grpc_native_server failed: %s" % exc)
         # HTTP front-end at concurrency 1: the same shape as the
@@ -549,7 +531,7 @@ def main() -> None:
         try:
             if daemon is not None and http_line is not None and \
                     http_line.startswith("LISTENING-HTTP ") and \
-                    want_native_http and remaining() > 30:
+                    remaining() > 30:
                 http_address = "127.0.0.1:%s" % http_line.split()[1]
                 tput, p50 = run_native(
                     binary, http_address, "simple", batch=1, concurrency=1,
@@ -581,8 +563,7 @@ def main() -> None:
     # (triton_c_api analogue). Subprocess so its embedded interpreter
     # doesn't fight this one; CPU platform because `simple` is
     # host-placed anyway and the TPU belongs to the live server here.
-    if binary and remaining() > 60 \
-            and stage_wanted("simple_inprocess_native"):
+    if binary and remaining() > 60:
         try:
             env = dict(os.environ, JAX_PLATFORMS="cpu",
                        PALLAS_AXON_POOL_IPS="")
@@ -613,8 +594,7 @@ def main() -> None:
     # Stage 4: resnet50 with TPU shared memory — the headline.
     resnet_budget = 300 if platform != "cpu" else 150
     exec_extra: dict = {}
-    if remaining() > resnet_budget and not relay_blocked() \
-            and stage_wanted("resnet50_tpu_shm_grpc"):
+    if remaining() > resnet_budget and not relay_blocked():
         try:
             log("warming resnet50 (batch 8)...")
             run_with_watchdog(
@@ -702,7 +682,7 @@ def main() -> None:
 
     # Stage 5: resnet50 in-process.
     if "resnet50_tpu_shm_grpc" in RESULT["stages"] and remaining() > 90 \
-            and not relay_blocked() and stage_wanted("resnet50_inprocess"):
+            and not relay_blocked():
         try:
             # Drain the async exec queue the shm stage left behind: a
             # host round-trip through a fresh computation completes
@@ -735,8 +715,6 @@ def main() -> None:
                      baseline=None, baseline_src="", track_fusion=False,
                      fusion_composing=()):
         if not binary or remaining() < 90:
-            return
-        if not stage_wanted(stage_name):
             return
         if relay_blocked():
             # A prior device op never returned: the one-client relay
@@ -907,14 +885,11 @@ def main() -> None:
         except Exception as exc:  # noqa: BLE001
             log("genai stage failed: %s" % exc)
 
-    # Reconcile the probe label with the final relay state: a stall
-    # that later recovered (stages ran) must not read as "model stages
-    # absent because wedged", and a relay that wedged AFTER a clean
-    # probe must not read as "ok".
+    # Reconcile the probe label: a stall that later recovered (stages
+    # ran) must not read as "model stages absent because wedged".
     stalled_event = RELAY_STALL["event"]
-    if stalled_event is not None and not stalled_event.is_set():
-        RESULT["device_probe"] = "stalled: relay wedged mid-run"
-    elif str(RESULT.get("device_probe", "")).startswith("stalled"):
+    if str(RESULT.get("device_probe", "")).startswith("stalled") and (
+            stalled_event is None or stalled_event.is_set()):
         RESULT["device_probe"] = "stalled-then-recovered"
     flush_result()
     handle.stop()

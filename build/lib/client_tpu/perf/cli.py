@@ -1,0 +1,350 @@
+"""perf CLI — the perf_analyzer front door.
+
+Run:  python -m client_tpu.perf -m simple -u localhost:8001 \
+          --concurrency-range 1:4 --shared-memory tpu
+
+Flag set mirrors the reference command_line_parser.h:45-176 surface
+(the subset implemented so far; unknown reference flags fail loudly
+rather than silently no-op).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from client_tpu.perf.client_backend import BackendKind, ClientBackendFactory
+from client_tpu.perf.data_loader import DataLoader
+from client_tpu.perf.load_manager import (
+    ConcurrencyManager,
+    CustomLoadManager,
+    InferDataManager,
+    PeriodicConcurrencyManager,
+    RequestRateManager,
+    SequenceManager,
+)
+from client_tpu.perf.metrics_manager import MetricsManager
+from client_tpu.perf.model_parser import ModelParser, SchedulerType
+from client_tpu.perf.profiler import InferenceProfiler, MeasurementConfig
+from client_tpu.perf.report import export_profile, print_report, write_csv
+from client_tpu.utils import InferenceServerException
+
+
+def _parse_range(value: str, cast=int):
+    """start[:end[:step]]"""
+    parts = value.split(":")
+    start = cast(parts[0])
+    end = cast(parts[1]) if len(parts) > 1 else start
+    step = cast(parts[2]) if len(parts) > 2 else cast(1)
+    return start, end, step
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="client_tpu.perf", description="TPU-native perf analyzer"
+    )
+    parser.add_argument("-m", "--model-name", required=True)
+    parser.add_argument("-x", "--model-version", default="")
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    parser.add_argument("-i", "--protocol", choices=["grpc", "http"],
+                        default="grpc")
+    parser.add_argument("--service-kind", default="triton",
+                        choices=["triton", "inprocess", "openai",
+                                 "torchserve", "tfserving"])
+    parser.add_argument("--endpoint", default="v1/chat/completions",
+                        help="openai service-kind request path")
+    parser.add_argument("-b", "--batch-size", type=int, default=1)
+    parser.add_argument("-v", "--verbose", action="store_true")
+    parser.add_argument("--async", dest="async_mode", action="store_true",
+                        default=True)
+    parser.add_argument("--sync", dest="async_mode", action="store_false")
+    parser.add_argument("--streaming", action="store_true")
+    parser.add_argument("--max-threads", type=int, default=16)
+
+    parser.add_argument("--concurrency-range", default=None,
+                        help="start:end:step")
+    parser.add_argument("--request-rate-range", default=None,
+                        help="start:end:step")
+    parser.add_argument("--request-intervals", default=None,
+                        help="file with one interval (us) per line")
+    parser.add_argument("--periodic-concurrency-range", default=None,
+                        help="start:end:step (LLM ramp mode)")
+    parser.add_argument("--request-period", type=int, default=10)
+    parser.add_argument("--request-distribution", default="constant",
+                        choices=["constant", "poisson"])
+
+    parser.add_argument("-p", "--measurement-interval", type=int,
+                        default=5000, help="window ms")
+    parser.add_argument("--measurement-mode", default="time_windows",
+                        choices=["time_windows", "count_windows"])
+    parser.add_argument("--measurement-request-count", type=int, default=50)
+    parser.add_argument("-r", "--max-trials", type=int, default=10)
+    parser.add_argument("-s", "--stability-percentage", type=float,
+                        default=10.0)
+    parser.add_argument("-l", "--latency-threshold", type=float, default=0.0,
+                        help="ms; 0 = no limit")
+    parser.add_argument("--percentile", type=int, default=0)
+
+    parser.add_argument("--shared-memory", default="none",
+                        choices=["none", "system", "tpu"])
+    parser.add_argument("--output-shared-memory-size", type=int,
+                        default=102400)
+    parser.add_argument("--tpu-arena-url", default="",
+                        help="arena service url (defaults to --url for grpc)")
+
+    parser.add_argument("--input-data", default="random",
+                        help="random | zero | path/to/data.json")
+    parser.add_argument("--string-length", type=int, default=16)
+    parser.add_argument("--string-data", default=None)
+    parser.add_argument("--shape", action="append", default=[],
+                        help="name:d1,d2 overrides for variable dims")
+    parser.add_argument("--bls-composing-models", default="",
+                        help="comma-separated models a BLS/pipeline model "
+                             "calls; their server stats are paired with "
+                             "the top model's per window")
+
+    parser.add_argument("--sequence-length", type=int, default=20)
+    parser.add_argument("--sequence-length-variation", type=float,
+                        default=20.0)
+    parser.add_argument("--sequence-id-range", default=None,
+                        help="start[:end]")
+
+    parser.add_argument("-f", "--latency-report-file", default=None)
+    parser.add_argument("--profile-export-file", default=None)
+
+    parser.add_argument("--collect-metrics", action="store_true",
+                        help="scrape server Prometheus metrics per window")
+    parser.add_argument("--metrics-url", default=None,
+                        help="defaults to http://<host>:8000/metrics")
+    parser.add_argument("--metrics-interval", type=float, default=1000.0,
+                        help="scrape interval ms")
+    return parser
+
+
+def run(argv: Optional[List[str]] = None, core=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.service_kind == "openai":
+        factory = ClientBackendFactory(
+            BackendKind.OPENAI, url=args.url, verbose=args.verbose,
+            openai_endpoint=args.endpoint,
+        )
+    elif args.service_kind in ("torchserve", "tfserving"):
+        factory = ClientBackendFactory(
+            BackendKind.TORCHSERVE if args.service_kind == "torchserve"
+            else BackendKind.TFSERVING,
+            url=args.url, verbose=args.verbose,
+            # gRPC PredictionService is TF-Serving's native protocol;
+            # -i http selects the REST predict API instead.
+            tfserving_grpc=args.protocol != "http",
+        )
+    elif args.service_kind == "inprocess":
+        if core is None:
+            from client_tpu.server.app import build_core
+
+            core = build_core([args.model_name])
+        factory = ClientBackendFactory(BackendKind.IN_PROCESS, core=core)
+        if args.shared_memory == "tpu" and core.memory.arena is not None:
+            import client_tpu.utils.tpu_shared_memory as tpushm
+
+            tpushm.set_arena(core.memory.arena)
+    else:
+        kind = (
+            BackendKind.TRITON_GRPC if args.protocol == "grpc"
+            else BackendKind.TRITON_HTTP
+        )
+        factory = ClientBackendFactory(kind, url=args.url,
+                                       verbose=args.verbose)
+
+    setup_backend = factory.create()
+    parser_obj = ModelParser()
+    try:
+        model = parser_obj.parse(
+            setup_backend, args.model_name, args.model_version,
+            args.batch_size,
+            bls_composing_models=[
+                m for m in args.bls_composing_models.split(",") if m])
+    except InferenceServerException as e:
+        print("perf failed: %s" % e, file=sys.stderr)
+        setup_backend.close()
+        return 1
+    # variable-dim overrides; name:DTYPE:d1,d2 CREATES the tensor for
+    # metadata-less service kinds (tfserving's gRPC surface exposes no
+    # KServe metadata)
+    for override in args.shape:
+        name, _, rest = override.partition(":")
+        dtype, _, dims = rest.rpartition(":")
+        if dtype:
+            from client_tpu.perf.model_parser import ModelTensor
+
+            model.inputs[name] = ModelTensor(
+                name, dtype, [int(d) for d in dims.split(",")])
+        elif name in model.inputs:
+            model.inputs[name].shape = [int(d) for d in dims.split(",")]
+
+    loader = DataLoader(model)
+    if args.input_data in ("random", "zero"):
+        loader.generate_data(zero_input=args.input_data == "zero",
+                             string_length=args.string_length,
+                             string_data=args.string_data)
+    elif os.path.isdir(args.input_data):
+        loader.read_data_from_dir(args.input_data)
+    else:
+        loader.read_data_from_json(args.input_data)
+
+    tpu_arena_url = args.tpu_arena_url
+    if (args.shared_memory == "tpu" and not tpu_arena_url
+            and args.service_kind == "triton"):
+        tpu_arena_url = args.url
+    data_manager = InferDataManager(
+        model, loader, shared_memory=args.shared_memory,
+        output_shm_size=args.output_shared_memory_size,
+        tpu_arena_url=tpu_arena_url, batch_size=args.batch_size,
+    )
+
+    if model.response_cache_enabled:
+        # Cache hits bypass queue/compute, so per-window server-stat
+        # breakdowns under-report work (reference perf_analyzer prints
+        # the same caveat when response_cache.enable is set).
+        print("note: model has response caching enabled; server-side "
+              "queue/compute breakdowns exclude cache hits",
+              file=sys.stderr)
+
+    sequence_manager = None
+    if (model.scheduler_type == SchedulerType.SEQUENCE
+            or model.composing_sequential or args.sequence_id_range):
+        start_id, id_range = 1, 2**31
+        if args.sequence_id_range:
+            parts = args.sequence_id_range.split(":")
+            start_id = int(parts[0])
+            if len(parts) > 1:
+                id_range = int(parts[1]) - start_id
+        sequence_manager = SequenceManager(
+            start_id=start_id, id_range=id_range,
+            sequence_length=args.sequence_length,
+            sequence_length_variation=args.sequence_length_variation / 100.0,
+        )
+
+    config = MeasurementConfig(
+        measurement_interval_ms=args.measurement_interval,
+        measurement_mode=args.measurement_mode,
+        measurement_request_count=args.measurement_request_count,
+        max_trials=args.max_trials,
+        stability_threshold=args.stability_percentage / 100.0,
+        latency_threshold_ms=args.latency_threshold,
+        percentile=args.percentile,
+        # REST/chat service kinds send one logical inference per
+        # request regardless of -b (their payloads are not batched).
+        batch_size=(args.batch_size
+                    if args.service_kind in ("triton", "inprocess")
+                    else 1),
+    )
+
+    manager_args = dict(
+        factory=factory, model=model, data_loader=loader,
+        data_manager=data_manager, async_mode=args.async_mode,
+        streaming=args.streaming, max_threads=args.max_threads,
+        sequence_manager=sequence_manager,
+    )
+
+    metrics_manager = None
+    if args.collect_metrics:
+        metrics_url = args.metrics_url
+        if not metrics_url:
+            from urllib.parse import urlsplit
+
+            netloc = args.url if "://" in args.url else "//" + args.url
+            host = urlsplit(netloc).hostname or "localhost"
+            if ":" in host:  # bracket bare IPv6 for the URL
+                host = "[%s]" % host
+            metrics_url = "http://%s:8000/metrics" % host
+        metrics_manager = MetricsManager(metrics_url, args.metrics_interval)
+        try:
+            metrics_manager.check_reachable()
+        except Exception as e:
+            print("warning: metrics endpoint %s unreachable (%s); "
+                  "continuing without server metrics" % (metrics_url, e),
+                  file=sys.stderr)
+            metrics_manager = None
+
+    mode = "concurrency"
+    try:
+        if args.request_rate_range:
+            mode = "request_rate"
+            start, end, step = _parse_range(args.request_rate_range, float)
+            manager = RequestRateManager(
+                distribution=args.request_distribution, **manager_args
+            )
+            manager.init()
+            profiler = InferenceProfiler(
+                manager, config, setup_backend, model.name, args.verbose,
+                metrics_manager=metrics_manager,
+                composing_models=model.composing_models)
+            results = profiler.profile_request_rate_range(start, end, step)
+        elif args.request_intervals:
+            mode = "request_rate"
+            manager = CustomLoadManager(
+                request_intervals_file=args.request_intervals,
+                **manager_args)
+            manager.init()
+            profiler = InferenceProfiler(
+                manager, config, setup_backend, model.name, args.verbose,
+                metrics_manager=metrics_manager,
+                composing_models=model.composing_models)
+            results = profiler.profile_custom_intervals()
+        elif args.periodic_concurrency_range:
+            start, end, step = _parse_range(args.periodic_concurrency_range)
+            manager = PeriodicConcurrencyManager(
+                concurrency_start=start, concurrency_end=end,
+                concurrency_step=step, request_period=args.request_period,
+                **manager_args,
+            )
+            manager.init()
+            profiler = InferenceProfiler(
+                manager, config, setup_backend, model.name, args.verbose,
+                metrics_manager=metrics_manager,
+                composing_models=model.composing_models)
+            manager.run_ramp()
+            results = [profiler.profile_single_level()]
+            manager.stop()
+        else:
+            start, end, step = _parse_range(args.concurrency_range or "1")
+            manager = ConcurrencyManager(**manager_args)
+            manager.init()
+            profiler = InferenceProfiler(
+                manager, config, setup_backend, model.name, args.verbose,
+                metrics_manager=metrics_manager,
+                composing_models=model.composing_models)
+            results = profiler.profile_concurrency_range(start, end, step)
+    except (InferenceServerException, ValueError, OSError) as e:
+        print("perf failed: %s" % e, file=sys.stderr)
+        return 1
+    finally:
+        if metrics_manager is not None:
+            metrics_manager.stop()
+            if metrics_manager.scrape_failures:
+                print("warning: %d metrics scrapes failed during the run"
+                      % metrics_manager.scrape_failures, file=sys.stderr)
+        try:
+            manager.cleanup()
+        except Exception:
+            pass
+        setup_backend.close()
+
+    print_report(results, args.percentile, mode)
+    if args.latency_report_file:
+        write_csv(args.latency_report_file, results, mode)
+    if args.profile_export_file:
+        export_profile(args.profile_export_file, results, model.name,
+                       args.service_kind, args.url, mode)
+    return 0
+
+
+def main():
+    sys.exit(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,1097 @@
+"""Pluggable client-backend abstraction for the perf harness.
+
+Mirrors the role of cb::ClientBackend (/root/reference/src/c++/
+perf_analyzer/client_backend/client_backend.h:366): the load
+generators talk to this interface, concrete backends adapt it to the
+gRPC client, the HTTP client, or the in-process server core (the
+analogue of the TRITONSERVER C-API backend, triton_c_api/).
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from client_tpu._infer_common import InferInput, InferRequestedOutput
+from client_tpu.utils import InferenceServerException
+
+
+class BackendKind(enum.Enum):
+    TRITON_GRPC = "grpc"
+    TRITON_HTTP = "http"
+    IN_PROCESS = "inprocess"
+    OPENAI = "openai"
+    TORCHSERVE = "torchserve"
+    TFSERVING = "tfserving"
+    MOCK = "mock"
+
+
+class ClientBackend:
+    """One backend instance per worker thread (like the reference,
+    where each worker owns a client)."""
+
+    kind: BackendKind
+
+    # control-plane ------------------------------------------------------
+    def server_metadata(self):
+        raise NotImplementedError
+
+    def model_metadata(self, model_name: str, model_version: str = ""):
+        raise NotImplementedError
+
+    def model_config(self, model_name: str, model_version: str = ""):
+        raise NotImplementedError
+
+    def model_statistics(self, model_name: str = "", model_version: str = ""):
+        raise NotImplementedError
+
+    # data-plane ---------------------------------------------------------
+    def infer(self, model_name, inputs, outputs=None, **kwargs):
+        raise NotImplementedError
+
+    def async_infer(self, callback: Callable, model_name, inputs,
+                    outputs=None, **kwargs):
+        """callback(result, error)"""
+        raise NotImplementedError
+
+    def start_stream(self, callback: Callable):
+        raise NotImplementedError
+
+    def stop_stream(self):
+        raise NotImplementedError
+
+    def async_stream_infer(self, model_name, inputs, outputs=None, **kwargs):
+        raise NotImplementedError
+
+    # shared memory ------------------------------------------------------
+    def register_system_shared_memory(self, name, key, byte_size, offset=0):
+        raise NotImplementedError
+
+    def register_tpu_shared_memory(self, name, raw_handle, device_id,
+                                   byte_size):
+        raise NotImplementedError
+
+    def unregister_system_shared_memory(self, name=""):
+        raise NotImplementedError
+
+    def unregister_tpu_shared_memory(self, name=""):
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+
+class GrpcClientBackend(ClientBackend):
+    kind = BackendKind.TRITON_GRPC
+
+    def __init__(self, url: str, verbose: bool = False):
+        import client_tpu.grpc as grpcclient
+
+        self._client = grpcclient.InferenceServerClient(url, verbose=verbose)
+
+    def server_metadata(self):
+        return self._client.get_server_metadata(as_json=True)
+
+    def model_metadata(self, model_name, model_version=""):
+        return self._client.get_model_metadata(
+            model_name, model_version, as_json=True
+        )
+
+    def model_config(self, model_name, model_version=""):
+        response = self._client.get_model_config(
+            model_name, model_version, as_json=True
+        )
+        return response.get("config", response)
+
+    def model_statistics(self, model_name="", model_version=""):
+        return self._client.get_inference_statistics(
+            model_name, model_version, as_json=True
+        )
+
+    def infer(self, model_name, inputs, outputs=None, **kwargs):
+        return self._client.infer(model_name, inputs, outputs=outputs,
+                                  **kwargs)
+
+    def async_infer(self, callback, model_name, inputs, outputs=None,
+                    **kwargs):
+        return self._client.async_infer(model_name, inputs, callback,
+                                        outputs=outputs, **kwargs)
+
+    def start_stream(self, callback):
+        self._client.start_stream(callback)
+
+    def stop_stream(self):
+        self._client.stop_stream()
+
+    def async_stream_infer(self, model_name, inputs, outputs=None, **kwargs):
+        self._client.async_stream_infer(model_name, inputs, outputs=outputs,
+                                        **kwargs)
+
+    def register_system_shared_memory(self, name, key, byte_size, offset=0):
+        self._client.register_system_shared_memory(name, key, byte_size,
+                                                   offset)
+
+    def register_tpu_shared_memory(self, name, raw_handle, device_id,
+                                   byte_size):
+        self._client.register_tpu_shared_memory(name, raw_handle, device_id,
+                                                byte_size)
+
+    def unregister_system_shared_memory(self, name=""):
+        self._client.unregister_system_shared_memory(name)
+
+    def unregister_tpu_shared_memory(self, name=""):
+        self._client.unregister_tpu_shared_memory(name)
+
+    def close(self):
+        self._client.close()
+
+
+class HttpClientBackend(ClientBackend):
+    kind = BackendKind.TRITON_HTTP
+
+    def __init__(self, url: str, verbose: bool = False, concurrency: int = 8):
+        import client_tpu.http as httpclient
+
+        self._client = httpclient.InferenceServerClient(
+            url, verbose=verbose, concurrency=concurrency
+        )
+
+    def server_metadata(self):
+        return self._client.get_server_metadata()
+
+    def model_metadata(self, model_name, model_version=""):
+        return self._client.get_model_metadata(model_name, model_version)
+
+    def model_config(self, model_name, model_version=""):
+        return self._client.get_model_config(model_name, model_version)
+
+    def model_statistics(self, model_name="", model_version=""):
+        return self._client.get_inference_statistics(model_name, model_version)
+
+    def infer(self, model_name, inputs, outputs=None, **kwargs):
+        kwargs.pop("client_timeout", None)
+        return self._client.infer(model_name, inputs, outputs=outputs,
+                                  **kwargs)
+
+    def async_infer(self, callback, model_name, inputs, outputs=None,
+                    **kwargs):
+        kwargs.pop("client_timeout", None)
+        handle = self._client.async_infer(model_name, inputs, outputs=outputs,
+                                          **kwargs)
+
+        # piggyback on the client's worker-pool future — no extra
+        # thread per request; the worker stores exceptions rather than
+        # raising, so future.result() is safe here
+        def _on_done(future):
+            result = future.result()
+            if isinstance(result, Exception):
+                error = (
+                    result if isinstance(result, InferenceServerException)
+                    else InferenceServerException(str(result))
+                )
+                callback(None, error)
+            else:
+                callback(result, None)
+
+        handle._future.add_done_callback(_on_done)
+        return handle
+
+    def register_system_shared_memory(self, name, key, byte_size, offset=0):
+        self._client.register_system_shared_memory(name, key, byte_size,
+                                                   offset)
+
+    def register_tpu_shared_memory(self, name, raw_handle, device_id,
+                                   byte_size):
+        self._client.register_tpu_shared_memory(name, raw_handle, device_id,
+                                                byte_size)
+
+    def unregister_system_shared_memory(self, name=""):
+        self._client.unregister_system_shared_memory(name)
+
+    def unregister_tpu_shared_memory(self, name=""):
+        self._client.unregister_tpu_shared_memory(name)
+
+    def close(self):
+        self._client.close()
+
+
+class OpenAiResult:
+    """Result shim for OpenAI responses: the worker pairing/final
+    plumbing sees the same get_response()/get_parameters() surface as
+    Triton results."""
+
+    def __init__(self, body: str, request_id: str, final: bool):
+        self.body = body
+        self._id = request_id
+        self._final = final
+
+    def get_response(self):
+        return {"id": self._id}
+
+    def get_parameters(self):
+        return {"triton_final_response": self._final}
+
+
+class OpenAiClientBackend(ClientBackend):
+    """Chat-completions client over HTTP with SSE streaming (parity:
+    the reference's openai client backend, client_backend/openai/ —
+    payload passthrough from the input JSON, one stream callback per
+    SSE chunk)."""
+
+    kind = BackendKind.OPENAI
+
+    def __init__(self, url: str, endpoint: str = "/v1/chat/completions",
+                 verbose: bool = False):
+        if "://" in url:
+            url = url.split("://", 1)[1]
+        host, _, port = url.partition(":")
+        self._host = host
+        self._port = int(port or 8000)
+        self._endpoint = endpoint if endpoint.startswith("/") \
+            else "/" + endpoint
+        self._verbose = verbose
+        self._stream_callback = None
+        self._inflight = threading.Semaphore(0)
+        self._inflight_count = 0
+        self._lock = threading.Lock()
+
+    # Synthesized schema (parity: ModelParser::InitOpenAI).
+    def model_metadata(self, model_name, model_version=""):
+        return {
+            "name": model_name,
+            "platform": "openai",
+            "inputs": [{"name": "payload", "datatype": "BYTES",
+                        "shape": [1]}],
+            "outputs": [],
+        }
+
+    def model_config(self, model_name, model_version=""):
+        return {"name": model_name, "max_batch_size": 0}
+
+    def model_statistics(self, model_name="", model_version=""):
+        return {"model_stats": []}
+
+    def server_metadata(self):
+        return {"name": "openai-endpoint"}
+
+    @staticmethod
+    def _payload_from_inputs(inputs) -> bytes:
+        for infer_input in inputs:
+            if infer_input.name() == "payload":
+                raw = infer_input.raw_data()
+                if raw is None:
+                    raise InferenceServerException(
+                        "payload input has no data")
+                # BYTES wire format: strip the 4-byte length prefix.
+                return raw[4:] if len(raw) >= 4 else raw
+        raise InferenceServerException(
+            "OpenAI requests need a 'payload' BYTES input")
+
+    def _post(self, payload: bytes, on_chunk=None) -> str:
+        import http.client
+
+        conn = http.client.HTTPConnection(self._host, self._port,
+                                          timeout=120)
+        try:
+            conn.request("POST", self._endpoint, body=payload,
+                         headers={"Content-Type": "application/json"})
+            response = conn.getresponse()
+            if response.status != 200:
+                raise InferenceServerException(
+                    "HTTP %d: %s"
+                    % (response.status, response.read().decode()[:500])
+                )
+            if on_chunk is None:
+                return response.read().decode()
+            buffer = b""
+            while True:
+                data = response.read1(65536)
+                if not data:
+                    break
+                buffer += data
+                while b"\n\n" in buffer:
+                    event, buffer = buffer.split(b"\n\n", 1)
+                    if not event.startswith(b"data: "):
+                        continue
+                    chunk = event[6:].decode()
+                    if chunk == "[DONE]":
+                        continue  # final fires after EOF
+                    on_chunk(chunk)
+            return ""
+        finally:
+            conn.close()
+
+    def infer(self, model_name, inputs, outputs=None, **kwargs):
+        payload = self._payload_from_inputs(inputs)
+        body = self._post(payload)
+        return OpenAiResult(body, kwargs.get("request_id", ""), True)
+
+    def async_infer(self, callback, model_name, inputs, outputs=None,
+                    **kwargs):
+        payload = self._payload_from_inputs(inputs)
+        request_id = kwargs.get("request_id", "")
+
+        def _work():
+            try:
+                body = self._post(payload)
+                callback(OpenAiResult(body, request_id, True), None)
+            except InferenceServerException as e:
+                callback(None, e)
+            except Exception as e:  # transport errors
+                callback(None, InferenceServerException(str(e)))
+
+        threading.Thread(target=_work, daemon=True).start()
+
+    def start_stream(self, callback):
+        self._stream_callback = callback
+
+    def stop_stream(self):
+        self._stream_callback = None
+
+    def async_stream_infer(self, model_name, inputs, outputs=None,
+                           **kwargs):
+        callback = self._stream_callback
+        if callback is None:
+            raise InferenceServerException("stream not started")
+        payload = self._payload_from_inputs(inputs)
+        request_id = kwargs.get("request_id", "")
+
+        def _work():
+            try:
+                self._post(
+                    payload,
+                    on_chunk=lambda chunk: callback(
+                        OpenAiResult(chunk, request_id, False), None),
+                )
+                callback(OpenAiResult("", request_id, True), None)
+            except InferenceServerException as e:
+                callback(OpenAiResult("", request_id, True), e)
+            except Exception as e:
+                callback(OpenAiResult("", request_id, True),
+                         InferenceServerException(str(e)))
+
+        threading.Thread(target=_work, daemon=True).start()
+
+
+class _RestResult(OpenAiResult):
+    """Result shim for plain-HTTP JSON backends (TorchServe,
+    TF-Serving REST): the OpenAI shim's worker-facing surface, always
+    final, plus JSON decoding."""
+
+    def __init__(self, body: str, request_id: str):
+        super().__init__(body, request_id, final=True)
+
+    def as_json(self):
+        import json
+
+        return json.loads(self.body) if self.body else {}
+
+
+class _PlainHttpBackend(ClientBackend):
+    """Shared plumbing for non-Triton HTTP inference APIs: one
+    http.client connection per request, sync + thread-async."""
+
+    def __init__(self, url: str, verbose: bool = False):
+        self._tls = url.startswith("https://")
+        if "://" in url:
+            url = url.split("://", 1)[1]
+        url = url.split("/", 1)[0]  # drop any path component
+        host, _, port = url.rpartition(":")
+        if host and not port.isdigit():  # IPv6 literal without port
+            host, port = url, ""
+        self._host = host or url
+        self._port = int(port) if port.isdigit() \
+            else (443 if self._tls else 8080)
+        self._verbose = verbose
+
+    def _request(self, method: str, path: str, body=None,
+                 content_type: str = "application/json") -> str:
+        import http.client
+
+        conn_cls = (http.client.HTTPSConnection if self._tls
+                    else http.client.HTTPConnection)
+        conn = conn_cls(self._host, self._port, timeout=120)
+        try:
+            headers = {"Content-Type": content_type} if body else {}
+            conn.request(method, path, body=body, headers=headers)
+            response = conn.getresponse()
+            data = response.read().decode()
+            if response.status != 200:
+                raise InferenceServerException(
+                    "HTTP %d: %s" % (response.status, data[:500]))
+            return data
+        finally:
+            conn.close()
+
+    def _async(self, callback, work):
+        def _run():
+            try:
+                callback(work(), None)
+            except InferenceServerException as e:
+                callback(None, e)
+            except Exception as e:  # transport errors
+                callback(None, InferenceServerException(str(e)))
+
+        threading.Thread(target=_run, daemon=True).start()
+
+    def model_statistics(self, model_name="", model_version=""):
+        return {"model_stats": []}
+
+    def start_stream(self, callback):
+        raise InferenceServerException(
+            "%s does not support streaming" % self.kind.value)
+
+    def async_stream_infer(self, model_name, inputs, outputs=None,
+                           **kwargs):
+        raise InferenceServerException(
+            "%s does not support streaming" % self.kind.value)
+
+
+class TorchServeBackend(_PlainHttpBackend):
+    """TorchServe inference-API client: POST the first input's raw
+    bytes to /predictions/<model> (parity: the reference's torchserve
+    client backend, client_backend/torchserve/ — file-content POST,
+    no output retrieval, no metadata endpoint)."""
+
+    kind = BackendKind.TORCHSERVE
+
+    # TorchServe has no v2 metadata endpoint; synthesize the reference
+    # shape (one BYTES "data" input fed from files or generated data).
+    def model_metadata(self, model_name, model_version=""):
+        return {
+            "name": model_name,
+            "platform": "torchserve",
+            "inputs": [{"name": "data", "datatype": "BYTES",
+                        "shape": [1]}],
+            "outputs": [],
+        }
+
+    def model_config(self, model_name, model_version=""):
+        return {"name": model_name, "max_batch_size": 0}
+
+    def server_metadata(self):
+        return {"name": "torchserve-endpoint"}
+
+    @staticmethod
+    def _body_from_inputs(inputs) -> bytes:
+        for infer_input in inputs:
+            raw = infer_input.raw_data()
+            if raw is None:
+                continue
+            if infer_input.datatype() == "BYTES":
+                # Concatenate every length-prefixed element's payload.
+                parts, offset = [], 0
+                while offset + 4 <= len(raw):
+                    (length,) = np.frombuffer(
+                        raw, np.uint32, count=1, offset=offset)
+                    offset += 4
+                    parts.append(raw[offset:offset + length])
+                    offset += int(length)
+                return b"".join(parts) if parts else raw
+            return raw
+        raise InferenceServerException(
+            "TorchServe requests need one input with data")
+
+    def infer(self, model_name, inputs, outputs=None, **kwargs):
+        body = self._body_from_inputs(inputs)
+        data = self._request(
+            "POST", "/predictions/%s" % model_name, body,
+            content_type="application/octet-stream")
+        return _RestResult(data, kwargs.get("request_id", ""))
+
+    def async_infer(self, callback, model_name, inputs, outputs=None,
+                    **kwargs):
+        self._async(callback,
+                    lambda: self.infer(model_name, inputs, outputs,
+                                       **kwargs))
+
+
+class TfServingBackend(_PlainHttpBackend):
+    """TensorFlow-Serving client over the REST predict API
+    (/v1/models/<m>:predict, columnar "inputs" format). The reference
+    uses the gRPC PredictionService (client_backend/tensorflow_serving/
+    tfserve_grpc_client.cc Predict) — same request semantics; REST is
+    used here so no TensorFlow proto tree is vendored."""
+
+    kind = BackendKind.TFSERVING
+
+    def model_metadata(self, model_name, model_version=""):
+        import json
+
+        path = "/v1/models/%s" % model_name
+        if model_version:
+            path += "/versions/%s" % model_version
+        try:
+            meta = json.loads(self._request("GET", path + "/metadata"))
+        except Exception:
+            meta = {}
+        inputs, outputs = [], []
+        sig = (meta.get("metadata", {}).get("signature_def", {})
+               .get("signature_def", {}).get("serving_default", {}))
+        for name, spec in (sig.get("inputs") or {}).items():
+            dims = [int(d.get("size", -1))
+                    for d in spec.get("tensor_shape", {}).get("dim", [])]
+            inputs.append({"name": name,
+                           "datatype": _TF_TO_TRITON_DTYPE.get(
+                               spec.get("dtype", ""), "FP32"),
+                           "shape": dims or [-1]})
+        for name, spec in (sig.get("outputs") or {}).items():
+            outputs.append({"name": name, "datatype": "FP32",
+                            "shape": [-1]})
+        return {"name": model_name, "platform": "tensorflow_serving",
+                "inputs": inputs, "outputs": outputs}
+
+    def model_config(self, model_name, model_version=""):
+        return {"name": model_name, "max_batch_size": 0}
+
+    def server_metadata(self):
+        return {"name": "tfserving-endpoint"}
+
+    def infer(self, model_name, inputs, outputs=None, **kwargs):
+        import json
+
+        payload = {"inputs": {}}
+        for infer_input in inputs:
+            array = infer_input.numpy_data()
+            if array is None:
+                raise InferenceServerException(
+                    "TF-Serving REST needs numpy-backed inputs")
+            if array.dtype == np.object_:
+                payload["inputs"][infer_input.name()] = [
+                    v.decode() if isinstance(v, bytes) else str(v)
+                    for v in array.ravel()
+                ]
+            else:
+                payload["inputs"][infer_input.name()] = array.tolist()
+        version = kwargs.get("model_version", "")
+        path = "/v1/models/%s" % model_name
+        if version:
+            path += "/versions/%s" % version
+        data = self._request("POST", path + ":predict",
+                             json.dumps(payload).encode())
+        return _RestResult(data, kwargs.get("request_id", ""))
+
+    def async_infer(self, callback, model_name, inputs, outputs=None,
+                    **kwargs):
+        self._async(callback,
+                    lambda: self.infer(model_name, inputs, outputs,
+                                       **kwargs))
+
+
+_TF_TO_TRITON_DTYPE = {
+    "DT_HALF": "FP16", "DT_BFLOAT16": "BF16", "DT_FLOAT": "FP32",
+    "DT_DOUBLE": "FP64", "DT_INT8": "INT8", "DT_INT16": "INT16",
+    "DT_INT32": "INT32", "DT_INT64": "INT64", "DT_UINT8": "UINT8",
+    "DT_UINT16": "UINT16", "DT_UINT32": "UINT32", "DT_UINT64": "UINT64",
+    "DT_STRING": "BYTES", "DT_BOOL": "BOOL",
+}
+
+# triton wire dtype -> tensorflow.DataType enum value (types.proto).
+TRITON_TO_TF_DTYPE = {
+    "FP16": 19, "BF16": 14, "FP32": 1, "FP64": 2, "INT8": 6, "INT16": 5,
+    "INT32": 3, "INT64": 9, "UINT8": 4, "UINT16": 17, "UINT32": 22,
+    "UINT64": 23, "BYTES": 7, "BOOL": 10,
+}
+_TF_ENUM_TO_NP = {
+    1: np.float32, 2: np.float64, 3: np.int32, 4: np.uint8, 5: np.int16,
+    6: np.int8, 9: np.int64, 10: np.bool_, 17: np.uint16, 19: np.float16,
+    22: np.uint32, 23: np.uint64,
+}
+
+
+class _TfsResult:
+    """PredictResponse wrapper with the InferResult reading surface."""
+
+    def __init__(self, response, request_id=""):
+        self._response = response
+        self._id = request_id
+
+    def as_numpy(self, name):
+        tensor = self._response.outputs.get(name)
+        if tensor is None:
+            return None
+        shape = [d.size for d in tensor.tensor_shape.dim]
+        if tensor.dtype == 7:  # DT_STRING
+            return np.array(list(tensor.string_val),
+                            dtype=np.object_).reshape(shape)
+        np_dtype = _TF_ENUM_TO_NP.get(tensor.dtype)
+        if np_dtype is None:
+            raise InferenceServerException(
+                "unsupported TF dtype %d" % tensor.dtype)
+        if tensor.tensor_content:
+            return np.frombuffer(
+                tensor.tensor_content, dtype=np_dtype).reshape(shape)
+        if len(tensor.half_val):  # raw 16-bit patterns widened to int32
+            return np.array(list(tensor.half_val),
+                            dtype=np.uint16).view(np_dtype).reshape(shape)
+        for field in ("float_val", "double_val", "int_val", "int64_val",
+                      "bool_val", "uint32_val", "uint64_val"):
+            values = getattr(tensor, field)
+            if len(values):
+                return np.array(list(values), dtype=np_dtype).reshape(shape)
+        return np.zeros(shape, dtype=np_dtype)
+
+    def get_response(self):
+        return self._response
+
+    def request_id(self):
+        return self._id
+
+    def is_final_response(self):
+        return True
+
+
+class TfServingGrpcBackend(ClientBackend):
+    """TensorFlow-Serving over the gRPC PredictionService — the
+    reference's native protocol (client_backend/tensorflow_serving/
+    tfserve_grpc_client.cc Predict), speaking the compiled
+    wire-compatible proto subset in client_tpu.protocol."""
+
+    kind = BackendKind.TFSERVING
+
+    def __init__(self, url: str, verbose: bool = False):
+        import grpc
+        from concurrent.futures import ThreadPoolExecutor
+
+        from client_tpu.protocol import tensorflow_serving_apis_pb2 as tfs
+
+        self._tfs = tfs
+        self._url = url
+        self._verbose = verbose
+        self._channel = grpc.insecure_channel(url)
+        self._predict = self._channel.unary_unary(
+            "/tensorflow.serving.PredictionService/Predict",
+            request_serializer=tfs.PredictRequest.SerializeToString,
+            response_deserializer=tfs.PredictResponse.FromString,
+        )
+        self._executor = ThreadPoolExecutor(max_workers=8)
+
+    def close(self):
+        self._executor.shutdown(wait=False)
+        self._channel.close()
+
+    # TF-Serving exposes no KServe metadata; shapes come from the
+    # harness's --shape overrides (reference behavior for this kind).
+    def server_metadata(self):
+        return {"name": "tfserving-endpoint", "protocol": "grpc"}
+
+    def model_metadata(self, model_name, model_version=""):
+        return {"name": model_name, "platform": "tensorflow_serving",
+                "inputs": [], "outputs": []}
+
+    def model_config(self, model_name, model_version=""):
+        return {"name": model_name, "max_batch_size": 0}
+
+    def model_statistics(self, model_name="", model_version=""):
+        return {"model_stats": []}
+
+    def _build_request(self, model_name, inputs, model_version=""):
+        request = self._tfs.PredictRequest()
+        request.model_spec.name = model_name
+        if model_version:
+            request.model_spec.version.value = int(model_version)
+        for infer_input in inputs:
+            array = infer_input.numpy_data()
+            if array is None:
+                raise InferenceServerException(
+                    "TF-Serving needs numpy-backed inputs")
+            tensor = request.inputs[infer_input.name()]
+            tensor.dtype = TRITON_TO_TF_DTYPE.get(
+                infer_input.datatype(), 1)
+            for dim in array.shape:
+                tensor.tensor_shape.dim.add().size = int(dim)
+            if array.dtype == np.object_:
+                tensor.string_val.extend(
+                    v if isinstance(v, bytes) else str(v).encode()
+                    for v in array.ravel()
+                )
+            else:
+                tensor.tensor_content = np.ascontiguousarray(
+                    array).tobytes()
+        return request
+
+    def infer(self, model_name, inputs, outputs=None, **kwargs):
+        import grpc
+
+        request = self._build_request(
+            model_name, inputs, kwargs.get("model_version", ""))
+        timeout = kwargs.get("client_timeout")
+        try:
+            response = self._predict(request, timeout=timeout)
+        except grpc.RpcError as e:
+            raise InferenceServerException(
+                "tfserving predict failed: %s" % e, status="UNAVAILABLE")
+        return _TfsResult(response, kwargs.get("request_id", ""))
+
+    def async_infer(self, callback, model_name, inputs, outputs=None,
+                    **kwargs):
+        def run():
+            try:
+                callback(self.infer(model_name, inputs, outputs, **kwargs),
+                         None)
+            except Exception as e:  # noqa: BLE001 — delivered to callback
+                callback(None, e)
+
+        self._executor.submit(run)
+
+    def start_stream(self, callback):
+        raise InferenceServerException(
+            "tfserving does not support streaming", status="UNIMPLEMENTED")
+
+    def stop_stream(self):
+        raise InferenceServerException(
+            "tfserving does not support streaming", status="UNIMPLEMENTED")
+
+    def async_stream_infer(self, model_name, inputs, outputs=None,
+                           **kwargs):
+        raise InferenceServerException(
+            "tfserving does not support streaming", status="UNIMPLEMENTED")
+
+    def register_system_shared_memory(self, *args, **kwargs):
+        raise InferenceServerException(
+            "tfserving does not support shared memory",
+            status="UNIMPLEMENTED")
+
+    def register_tpu_shared_memory(self, *args, **kwargs):
+        raise InferenceServerException(
+            "tfserving does not support shared memory",
+            status="UNIMPLEMENTED")
+
+    def unregister_system_shared_memory(self, name=""):
+        pass
+
+    def unregister_tpu_shared_memory(self, name=""):
+        pass
+
+
+class InProcessBackend(ClientBackend):
+    """Runs against an InferenceServerCore in this process — no RPC,
+    no serialization of tensor contents beyond proto assembly. The
+    TPU analogue of the reference's triton_c_api backend (in-process
+    server via dlopen, triton_c_api/triton_loader.cc:526)."""
+
+    kind = BackendKind.IN_PROCESS
+
+    def __init__(self, core, max_workers: int = 8):
+        from concurrent.futures import ThreadPoolExecutor
+
+        from google.protobuf import json_format
+
+        self._core = core
+        self._json = json_format
+        self._executor = ThreadPoolExecutor(max_workers=max_workers)
+        self._stream_callback = None
+
+    def server_metadata(self):
+        return self._json.MessageToDict(self._core.server_metadata(),
+                                        preserving_proto_field_name=True)
+
+    def model_metadata(self, model_name, model_version=""):
+        return self._json.MessageToDict(
+            self._core.model_metadata(model_name, model_version),
+            preserving_proto_field_name=True,
+        )
+
+    def model_config(self, model_name, model_version=""):
+        return self._json.MessageToDict(
+            self._core.model_config(model_name, model_version).config,
+            preserving_proto_field_name=True,
+        )
+
+    def model_statistics(self, model_name="", model_version=""):
+        return self._json.MessageToDict(
+            self._core.model_statistics(model_name, model_version),
+            preserving_proto_field_name=True,
+        )
+
+    def _build_request(self, model_name, inputs, outputs, **kwargs):
+        from client_tpu.grpc._utils import get_inference_request
+
+        kwargs.pop("client_timeout", None)
+        return get_inference_request(
+            model_name=model_name, inputs=inputs, outputs=outputs, **kwargs
+        )
+
+    def infer(self, model_name, inputs, outputs=None, **kwargs):
+        from client_tpu.grpc._utils import InferResult
+
+        request = self._build_request(model_name, inputs, outputs, **kwargs)
+        return InferResult(self._core.infer(request))
+
+    def async_infer(self, callback, model_name, inputs, outputs=None,
+                    **kwargs):
+        from client_tpu.grpc._utils import InferResult
+
+        request = self._build_request(model_name, inputs, outputs, **kwargs)
+
+        def _work():
+            try:
+                callback(InferResult(self._core.infer(request)), None)
+            except InferenceServerException as e:
+                callback(None, e)
+            except Exception as e:  # any failure must release the slot
+                callback(None, InferenceServerException(str(e)))
+
+        return self._executor.submit(_work)
+
+    def start_stream(self, callback):
+        self._stream_callback = callback
+
+    def stop_stream(self):
+        self._stream_callback = None
+
+    def async_stream_infer(self, model_name, inputs, outputs=None, **kwargs):
+        from client_tpu.grpc._utils import InferResult
+
+        if self._stream_callback is None:
+            raise InferenceServerException("stream is not running")
+        callback = self._stream_callback
+        request = self._build_request(model_name, inputs, outputs, **kwargs)
+
+        def _work():
+            try:
+                for stream_response in self._core.stream_infer(request):
+                    if stream_response.error_message:
+                        callback(None, InferenceServerException(
+                            stream_response.error_message))
+                    else:
+                        callback(InferResult(stream_response.infer_response),
+                                 None)
+            except InferenceServerException as e:
+                callback(None, e)
+
+        return self._executor.submit(_work)
+
+    def register_system_shared_memory(self, name, key, byte_size, offset=0):
+        self._core.register_system_shm(name, key, offset, byte_size)
+
+    def register_tpu_shared_memory(self, name, raw_handle, device_id,
+                                   byte_size):
+        self._core.register_tpu_shm(name, raw_handle, device_id, byte_size)
+
+    def unregister_system_shared_memory(self, name=""):
+        self._core.unregister_system_shm(name)
+
+    def unregister_tpu_shared_memory(self, name=""):
+        self._core.unregister_tpu_shm(name)
+
+    def close(self):
+        self._executor.shutdown(wait=False)
+
+
+class MockBackend(ClientBackend):
+    """Fakes a server with a programmable per-request delay and
+    optional failures — the fixture that lets every load manager and
+    profiler test run serverless (parity: mock_client_backend.h:471,
+    which spawns detached threads that sleep then fire the async
+    callback)."""
+
+    kind = BackendKind.MOCK
+
+    class Stats:
+        def __init__(self):
+            self.lock = threading.Lock()
+            self.infer_calls = 0
+            self.async_infer_calls = 0
+            self.stream_calls = 0
+            self.sequence_ids: List[int] = []
+            self.request_parameters: List[dict] = []
+
+    def __init__(
+        self,
+        delay_s: float = 0.0,
+        stats: Optional["MockBackend.Stats"] = None,
+        fail_every: int = 0,
+        model_metadata_dict: Optional[dict] = None,
+        model_config_dict: Optional[dict] = None,
+        model_configs: Optional[dict] = None,
+    ):
+        self._delay = delay_s
+        self.stats = stats if stats is not None else MockBackend.Stats()
+        self._fail_every = fail_every
+        self._count = 0
+        self._stream_callback = None
+        self._metadata = model_metadata_dict or {
+            "name": "mock", "versions": ["1"], "platform": "mock",
+            "inputs": [
+                {"name": "INPUT0", "datatype": "FP32", "shape": [16]},
+            ],
+            "outputs": [
+                {"name": "OUTPUT0", "datatype": "FP32", "shape": [16]},
+            ],
+        }
+        self._config = model_config_dict or {
+            "name": "mock", "max_batch_size": 0,
+        }
+        # Per-model-name config overrides (composing-model tests).
+        self._configs = model_configs or {}
+
+    def _maybe_fail(self):
+        self._count += 1
+        if self._fail_every and self._count % self._fail_every == 0:
+            raise InferenceServerException("mock failure", status="INTERNAL")
+
+    def _record(self, kind: str, kwargs):
+        with self.stats.lock:
+            if kind == "infer":
+                self.stats.infer_calls += 1
+            elif kind == "async":
+                self.stats.async_infer_calls += 1
+            else:
+                self.stats.stream_calls += 1
+            if kwargs.get("sequence_id"):
+                self.stats.sequence_ids.append(kwargs["sequence_id"])
+            self.stats.request_parameters.append(dict(kwargs))
+
+    def server_metadata(self):
+        return {"name": "mock_server", "version": "0", "extensions": []}
+
+    def model_metadata(self, model_name, model_version=""):
+        return dict(self._metadata, name=model_name)
+
+    def model_config(self, model_name, model_version=""):
+        if model_name in self._configs:
+            return dict(self._configs[model_name], name=model_name)
+        return dict(self._config, name=model_name)
+
+    def model_statistics(self, model_name="", model_version=""):
+        return {"model_stats": [{
+            "name": model_name or "mock", "version": "1",
+            "inference_count": self.stats.infer_calls
+            + self.stats.async_infer_calls,
+            "execution_count": self.stats.infer_calls
+            + self.stats.async_infer_calls,
+            "inference_stats": {
+                "success": {"count": self._count, "ns": 0},
+                "fail": {"count": 0, "ns": 0},
+                "queue": {"count": self._count, "ns": 1000},
+                "compute_input": {"count": self._count, "ns": 1000},
+                "compute_infer": {"count": self._count, "ns": 1000},
+                "compute_output": {"count": self._count, "ns": 1000},
+            },
+        }]}
+
+    def _result(self):
+        class _R:
+            def as_numpy(self, name):
+                return np.zeros(16, dtype=np.float32)
+
+            def get_response(self):
+                return {}
+
+            def get_parameters(self):
+                return {"triton_final_response": True}
+
+        return _R()
+
+    def infer(self, model_name, inputs, outputs=None, **kwargs):
+        self._record("infer", kwargs)
+        self._maybe_fail()
+        if self._delay:
+            import time
+
+            time.sleep(self._delay)
+        return self._result()
+
+    def async_infer(self, callback, model_name, inputs, outputs=None,
+                    **kwargs):
+        self._record("async", kwargs)
+
+        def _work():
+            import time
+
+            try:
+                self._maybe_fail()
+            except InferenceServerException as e:
+                callback(None, e)
+                return
+            if self._delay:
+                time.sleep(self._delay)
+            callback(self._result(), None)
+
+        thread = threading.Thread(target=_work, daemon=True)
+        thread.start()
+        return thread
+
+    def start_stream(self, callback):
+        self._stream_callback = callback
+
+    def stop_stream(self):
+        self._stream_callback = None
+
+    def async_stream_infer(self, model_name, inputs, outputs=None, **kwargs):
+        self._record("stream", kwargs)
+        callback = self._stream_callback
+
+        def _work():
+            import time
+
+            if self._delay:
+                time.sleep(self._delay)
+            callback(self._result(), None)
+
+        thread = threading.Thread(target=_work, daemon=True)
+        thread.start()
+        return thread
+
+    def register_system_shared_memory(self, name, key, byte_size, offset=0):
+        pass
+
+    def register_tpu_shared_memory(self, name, raw_handle, device_id,
+                                   byte_size):
+        pass
+
+    def unregister_system_shared_memory(self, name=""):
+        pass
+
+    def unregister_tpu_shared_memory(self, name=""):
+        pass
+
+
+class ClientBackendFactory:
+    """Creates per-worker backends (parity: client_backend.h:268)."""
+
+    def __init__(self, kind: BackendKind, url: str = "", core=None,
+                 verbose: bool = False, http_concurrency: int = 8,
+                 mock_delay_s: float = 0.0, mock_stats=None,
+                 openai_endpoint: str = "/v1/chat/completions",
+                 tfserving_grpc: bool = True):
+        self.kind = kind
+        self._url = url
+        self._core = core
+        self._verbose = verbose
+        self._http_concurrency = http_concurrency
+        self._mock_delay = mock_delay_s
+        self._mock_stats = mock_stats
+        self._openai_endpoint = openai_endpoint
+        # gRPC PredictionService is TF-Serving's native protocol
+        # (reference parity); False selects the REST predict API.
+        self._tfserving_grpc = tfserving_grpc
+
+    def create(self) -> ClientBackend:
+        if self.kind == BackendKind.TRITON_GRPC:
+            return GrpcClientBackend(self._url, self._verbose)
+        if self.kind == BackendKind.TRITON_HTTP:
+            return HttpClientBackend(self._url, self._verbose,
+                                     self._http_concurrency)
+        if self.kind == BackendKind.OPENAI:
+            return OpenAiClientBackend(self._url, self._openai_endpoint,
+                                       self._verbose)
+        if self.kind == BackendKind.TORCHSERVE:
+            return TorchServeBackend(self._url, self._verbose)
+        if self.kind == BackendKind.TFSERVING:
+            if self._tfserving_grpc:
+                return TfServingGrpcBackend(self._url, self._verbose)
+            return TfServingBackend(self._url, self._verbose)
+        if self.kind == BackendKind.IN_PROCESS:
+            if self._core is None:
+                raise InferenceServerException(
+                    "in-process backend requires a server core"
+                )
+            return InProcessBackend(self._core)
+        if self.kind == BackendKind.MOCK:
+            return MockBackend(self._mock_delay, self._mock_stats)
+        raise InferenceServerException("unknown backend kind %s" % self.kind)

@@ -1,0 +1,268 @@
+"""Input data source for the perf harness (parity: data_loader.h:63-99
+— random/zero generation, JSON data files with b64 content and
+multi-stream steps)."""
+
+from __future__ import annotations
+
+import base64
+import json
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from client_tpu.perf.model_parser import ModelTensor, ParsedModel
+from client_tpu.utils import (
+    InferenceServerException,
+    num_elements,
+    serialize_byte_tensor,
+    tensor_byte_size,
+    triton_to_np_dtype,
+)
+
+
+def _resolve_shape(tensor: ModelTensor, default_dim: int = 1) -> List[int]:
+    return [default_dim if d < 0 else int(d) for d in tensor.shape]
+
+
+class TensorData:
+    """One concrete tensor value for a (stream, step)."""
+
+    def __init__(self, array: np.ndarray, datatype: str):
+        self.array = array
+        self.datatype = datatype
+
+    @property
+    def shape(self) -> List[int]:
+        return list(self.array.shape)
+
+    def raw_bytes(self) -> bytes:
+        if self.datatype == "BYTES":
+            return serialize_byte_tensor(self.array).tobytes()
+        return np.ascontiguousarray(self.array).tobytes()
+
+
+class DataLoader:
+    """Holds per-(stream, step) input tensors. Streams model the
+    sequence data-streams of the reference; non-sequence runs use
+    stream 0 and cycle through steps."""
+
+    def __init__(self, model: ParsedModel):
+        self._model = model
+        # stream -> step -> {input name -> TensorData}
+        self._data: List[List[Dict[str, TensorData]]] = []
+
+    @property
+    def stream_count(self) -> int:
+        return len(self._data)
+
+    def step_count(self, stream: int = 0) -> int:
+        return len(self._data[stream]) if stream < len(self._data) else 0
+
+    def get_input_data(self, input_name: str, stream: int = 0,
+                       step: int = 0) -> TensorData:
+        try:
+            return self._data[stream][step][input_name]
+        except (IndexError, KeyError):
+            raise InferenceServerException(
+                "no data for input '%s' stream %d step %d"
+                % (input_name, stream, step)
+            )
+
+    # -- generation ------------------------------------------------------
+
+    def generate_data(self, zero_input: bool = False,
+                      string_length: int = 16, string_data: Optional[str] = None,
+                      seed: int = 7, steps: int = 1) -> None:
+        """Random (or zero) data for every input (parity:
+        GenerateData data_loader.h:89)."""
+        rng = np.random.default_rng(seed)
+        stream = []
+        for _ in range(steps):
+            step_data = {}
+            for name, tensor in self._model.inputs.items():
+                shape = _resolve_shape(tensor)
+                step_data[name] = TensorData(
+                    self._generate_tensor(tensor, shape, zero_input,
+                                          string_length, string_data, rng),
+                    tensor.datatype,
+                )
+            stream.append(step_data)
+        self._data = [stream]
+
+    def _generate_tensor(self, tensor: ModelTensor, shape, zero_input,
+                         string_length, string_data, rng) -> np.ndarray:
+        np_dtype = triton_to_np_dtype(tensor.datatype)
+        if tensor.datatype == "BYTES":
+            if string_data is not None:
+                value = string_data.encode()
+                flat = np.array([value] * int(np.prod(shape)),
+                                dtype=np.object_)
+            else:
+                flat = np.array(
+                    [
+                        bytes(rng.integers(97, 123, string_length,
+                                           dtype=np.uint8))
+                        for _ in range(int(np.prod(shape)))
+                    ],
+                    dtype=np.object_,
+                )
+            return flat.reshape(shape)
+        if zero_input:
+            return np.zeros(shape, dtype=np_dtype)
+        if np_dtype is None:
+            raise InferenceServerException(
+                "cannot generate data for datatype %s" % tensor.datatype
+            )
+        kind = np.dtype(np_dtype).kind
+        if kind == "f" or tensor.datatype == "BF16":
+            return rng.random(shape).astype(np_dtype)
+        if kind == "b":
+            return rng.integers(0, 2, shape).astype(np_dtype)
+        info = np.iinfo(np_dtype)
+        high = min(int(info.max), 2**20)
+        low = max(int(info.min), -(2**20))
+        return rng.integers(low, high, shape).astype(np_dtype)
+
+    # -- JSON file -------------------------------------------------------
+
+    def read_data_from_dir(self, directory: str) -> None:
+        """Directory input: one file per input named after the input
+        (parity: reference DataLoader::ReadDataFromDir,
+        data_loader.cc:42 — single stream/step; non-BYTES files are
+        raw binary matching the tensor byte size, BYTES files are
+        text with one string element per line)."""
+        import os
+
+        step: Dict[str, TensorData] = {}
+        for name, tensor in self._model.inputs.items():
+            path = os.path.join(directory, name)
+            if not os.path.exists(path):
+                if tensor.optional:
+                    continue
+                raise InferenceServerException(
+                    "no file for input '%s' in %s" % (name, directory))
+            shape = _resolve_shape(tensor)
+            if tensor.datatype == "BYTES":
+                # Binary line split (parity with the native reader):
+                # BYTES elements need not be valid UTF-8.
+                with open(path, "rb") as f:
+                    lines = f.read().split(b"\n")
+                if lines and lines[-1] == b"":
+                    lines.pop()  # trailing newline
+                count = num_elements(shape)
+                if len(lines) != count:
+                    raise InferenceServerException(
+                        "input '%s': %d strings in file, shape %s wants "
+                        "%d" % (name, len(lines), shape, count))
+                arr = np.array(lines, dtype=np.object_).reshape(shape)
+            else:
+                with open(path, "rb") as f:
+                    raw = f.read()
+                np_dtype = triton_to_np_dtype(tensor.datatype)
+                expected = tensor_byte_size(tensor.datatype, shape)
+                if len(raw) != expected:
+                    raise InferenceServerException(
+                        "input '%s' file has %d bytes, expected %d for "
+                        "shape %s" % (name, len(raw), expected, shape))
+                arr = np.frombuffer(raw, dtype=np_dtype).reshape(shape)
+            step[name] = TensorData(arr, tensor.datatype)
+        self._data = [[step]]
+        self._validate()
+
+    def read_data_from_json(self, path_or_dict) -> None:
+        """Load the reference's JSON input format: {"data": [step,
+        ...]} or {"data": [[stream0 steps], [stream1 steps], ...]};
+        each step maps input name -> list | {"content": .., "shape":
+        ..} | {"b64": ..} (parity: ReadDataFromJSON data_loader.h:74)."""
+        if isinstance(path_or_dict, dict):
+            doc = path_or_dict
+        else:
+            with open(path_or_dict) as f:
+                doc = json.load(f)
+        data = doc.get("data")
+        if data is None:
+            raise InferenceServerException("input JSON missing 'data' array")
+        if data and isinstance(data[0], list):
+            streams = data
+        else:
+            streams = [data]
+        self._data = []
+        for stream in streams:
+            steps = []
+            for step in stream:
+                step_data = {}
+                for name, value in step.items():
+                    tensor = self._model.inputs.get(name)
+                    if tensor is None:
+                        raise InferenceServerException(
+                            "input '%s' in data JSON is not a model input"
+                            % name
+                        )
+                    step_data[name] = self._parse_value(tensor, value)
+                steps.append(step_data)
+            self._data.append(steps)
+        self._validate()
+
+    def _parse_value(self, tensor: ModelTensor, value) -> TensorData:
+        shape = None
+        if isinstance(value, dict):
+            if "shape" in value:
+                shape = [int(d) for d in value["shape"]]
+            if "b64" in value:
+                raw = base64.b64decode(value["b64"])
+                np_dtype = triton_to_np_dtype(tensor.datatype)
+                arr = np.frombuffer(raw, dtype=np_dtype)
+                if shape:
+                    arr = arr.reshape(shape)
+                return TensorData(arr, tensor.datatype)
+            value = value.get("content")
+        if tensor.datatype == "BYTES":
+            # Nested lists (multi-dimensional BYTES tensors) flatten
+            # element-wise; only structured dict elements (e.g. OpenAI
+            # payload objects) ride as their JSON serialization.
+            def encode(v):
+                if isinstance(v, dict):
+                    return json.dumps(v).encode()
+                return v.encode() if isinstance(v, str) else bytes(v)
+
+            def flatten(v):
+                if isinstance(v, list):
+                    for item in v:
+                        yield from flatten(item)
+                else:
+                    yield v
+
+            listed = list(flatten(value)) if isinstance(value, list) \
+                else [value]
+            arr = np.array([encode(v) for v in listed], dtype=np.object_)
+        else:
+            arr = np.array(value).astype(triton_to_np_dtype(tensor.datatype))
+        if shape:
+            arr = arr.reshape(shape)
+        elif len(tensor.shape) and -1 not in tensor.shape:
+            arr = arr.reshape(tensor.shape)
+        return TensorData(arr, tensor.datatype)
+
+    def _validate(self):
+        """Every step must cover all non-optional inputs with
+        spec-compatible shapes (parity: data_loader validation
+        :173-198)."""
+        for stream_idx, stream in enumerate(self._data):
+            for step_idx, step in enumerate(stream):
+                for name, tensor in self._model.inputs.items():
+                    if name not in step:
+                        if tensor.optional:
+                            continue
+                        raise InferenceServerException(
+                            "missing data for input '%s' (stream %d step %d)"
+                            % (name, stream_idx, step_idx)
+                        )
+                    got = step[name].shape
+                    want = tensor.shape
+                    if len(got) != len(want) or any(
+                        w != -1 and g != w for g, w in zip(got, want)
+                    ):
+                        raise InferenceServerException(
+                            "shape %s for input '%s' incompatible with %s"
+                            % (got, name, want)
+                        )

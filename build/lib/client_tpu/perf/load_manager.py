@@ -1,0 +1,784 @@
+"""Load generation: InferContext slots, shared-memory data managers,
+sequence bookkeeping, and the load-manager hierarchy
+(concurrency / request-rate / custom-interval / periodic-concurrency),
+mirroring the reference's perf_analyzer core (load_manager.h:48,
+concurrency_manager.h:95, request_rate_manager.h:57,
+infer_data_manager_shm.h:93, sequence_manager.h:46).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from client_tpu._infer_common import InferInput, InferRequestedOutput
+from client_tpu.perf.client_backend import BackendKind, ClientBackendFactory
+from client_tpu.perf.data_loader import DataLoader
+from client_tpu.perf.model_parser import ParsedModel, SchedulerType
+from client_tpu.utils import InferenceServerException
+
+NANOS = 1_000_000_000
+
+
+class RequestRecord:
+    """Timestamps for one request and its response(s) (parity:
+    request_record.h:63)."""
+
+    __slots__ = ("start_ns", "end_ns", "delayed", "sequence_end", "error")
+
+    def __init__(self, start_ns: int, delayed: bool = False,
+                 sequence_end: bool = True):
+        self.start_ns = start_ns
+        self.end_ns: List[int] = []
+        self.delayed = delayed
+        self.sequence_end = sequence_end
+        self.error: Optional[Exception] = None
+
+    @property
+    def valid(self) -> bool:
+        return bool(self.end_ns) and self.error is None
+
+    @property
+    def latency_ns(self) -> int:
+        return self.end_ns[-1] - self.start_ns
+
+
+class ThreadStat:
+    """Per-worker request records + health (parity: ThreadStat in
+    load_manager.h:137)."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.records: List[RequestRecord] = []
+        self.status: Optional[Exception] = None
+        self.idle_ns = 0
+
+    def add_record(self, record: RequestRecord):
+        with self.lock:
+            self.records.append(record)
+
+
+# -- shared-memory kinds ---------------------------------------------------
+
+SHM_NONE = "none"
+SHM_SYSTEM = "system"
+SHM_TPU = "tpu"
+
+
+class InferDataManager:
+    """Prepares the InferInput/InferRequestedOutput objects each
+    context sends. In shm modes it creates+populates+registers one
+    region per input x stream x step named `<input>_<stream>_<step>`
+    and routes inputs through set_shared_memory (parity:
+    infer_data_manager_shm.h:93-136)."""
+
+    def __init__(self, model: ParsedModel, data_loader: DataLoader,
+                 shared_memory: str = SHM_NONE,
+                 output_shm_size: int = 102400,
+                 tpu_arena_url: str = "", batch_size: int = 1):
+        self._model = model
+        self._loader = data_loader
+        self._shm = shared_memory
+        self._output_shm_size = output_shm_size
+        self._tpu_arena_url = tpu_arena_url
+        self._batch = batch_size
+        self._system_handles: list = []
+        self._tpu_handles: list = []
+        self._registered = []
+        self._output_regions: Dict[str, str] = {}
+
+    def init(self, backend) -> None:
+        if self._shm == SHM_NONE:
+            return
+        if self._shm == SHM_TPU:
+            import client_tpu.utils.tpu_shared_memory as tpushm
+
+            if self._tpu_arena_url:
+                tpushm.set_arena_endpoint(self._tpu_arena_url)
+        for stream in range(self._loader.stream_count):
+            for step in range(self._loader.step_count(stream)):
+                for name, tensor in self._model.inputs.items():
+                    data = self._loader.get_input_data(name, stream, step)
+                    region = "%s_%d_%d" % (name, stream, step)
+                    self._create_region(
+                        backend, region, data.raw_bytes(), data.array,
+                        data.datatype, copies=self._copies_for(tensor),
+                        batchable=self._batchable(tensor))
+        # One region per output name, shared by all in-flight requests
+        # (reference behavior). Outputs are never validated by the
+        # harness; concurrent placements interleave harmlessly — the
+        # arena stores whole-array references under a lock and system
+        # regions take overlapping memcpys without faulting.
+        for name in self._model.outputs:
+            region = "out_%s" % name
+            self._create_output_region(backend, region)
+            self._output_regions[name] = region
+
+    def _batchable(self, tensor) -> bool:
+        """One rule for both shape batching and data replication:
+        ordinary inputs of batching models batch; shape tensors never
+        do (their values describe shapes — one value set per batch,
+        reference ModelTensor.is_shape_tensor)."""
+        return self._model.max_batch_size > 0 and not tensor.is_shape_tensor
+
+    def _copies_for(self, tensor) -> int:
+        return max(self._batch, 1) if self._batchable(tensor) else 1
+
+    def _create_region(self, backend, region, raw, array, datatype,
+                       copies=1, batchable=False):
+        byte_size = max(len(raw) * copies, 1)
+        if self._shm == SHM_SYSTEM:
+            import client_tpu.utils.shared_memory as shm
+
+            handle = shm.create_shared_memory_region(
+                region, "/perf_" + region, byte_size
+            )
+            shm.set_shared_memory_region(handle, [array] * copies)
+            backend.register_system_shared_memory(region, "/perf_" + region,
+                                                  byte_size)
+            self._system_handles.append(handle)
+        else:
+            import client_tpu.utils.tpu_shared_memory as tpushm
+
+            handle = tpushm.create_shared_memory_region(region, byte_size, 0)
+            if batchable:
+                # Store with the leading batch dim EVEN at batch 1: the
+                # arena's zero-copy fast path requires the stored shape
+                # to equal the request's declared shape (build_inputs
+                # declares [batch, ...] for batchable tensors).
+                tpushm.set_shared_memory_region(
+                    handle, [np.stack([array] * copies)])
+            else:
+                tpushm.set_shared_memory_region(handle, [array])
+            backend.register_tpu_shared_memory(
+                region, tpushm.get_raw_handle(handle), 0, byte_size
+            )
+            self._tpu_handles.append(handle)
+        self._registered.append(region)
+
+    def _create_output_region(self, backend, region):
+        byte_size = self._output_shm_size
+        if self._shm == SHM_SYSTEM:
+            import client_tpu.utils.shared_memory as shm
+
+            handle = shm.create_shared_memory_region(
+                region, "/perf_" + region, byte_size
+            )
+            backend.register_system_shared_memory(region, "/perf_" + region,
+                                                  byte_size)
+            self._system_handles.append(handle)
+        else:
+            import client_tpu.utils.tpu_shared_memory as tpushm
+
+            handle = tpushm.create_shared_memory_region(region, byte_size, 0)
+            backend.register_tpu_shared_memory(
+                region, tpushm.get_raw_handle(handle), 0, byte_size
+            )
+            self._tpu_handles.append(handle)
+        self._registered.append(region)
+
+    def build_inputs(self, stream: int = 0, step: int = 0) -> List[InferInput]:
+        inputs = []
+        for name, tensor in self._model.inputs.items():
+            data = self._loader.get_input_data(name, stream, step)
+            copies = self._copies_for(tensor)
+            batchable = self._batchable(tensor)
+            shape = data.shape
+            if batchable and self._batch >= 1:
+                shape = [self._batch] + shape
+            infer_input = InferInput(name, shape, tensor.datatype)
+            if self._shm == SHM_NONE:
+                if copies > 1:
+                    infer_input.set_data_from_numpy(
+                        np.stack([data.array] * copies))
+                elif batchable:
+                    infer_input.set_data_from_numpy(data.array[None])
+                else:
+                    infer_input.set_data_from_numpy(data.array)
+            else:
+                region = "%s_%d_%d" % (name, stream, step)
+                raw_len = len(data.raw_bytes()) * copies
+                infer_input.set_shared_memory(region, raw_len)
+            inputs.append(infer_input)
+        return inputs
+
+    def build_outputs(self) -> Optional[List[InferRequestedOutput]]:
+        if self._shm == SHM_NONE:
+            return None
+        outputs = []
+        for name in self._model.outputs:
+            requested = InferRequestedOutput(name)
+            requested.set_shared_memory(self._output_regions[name],
+                                        self._output_shm_size)
+            outputs.append(requested)
+        return outputs
+
+    def cleanup(self, backend) -> None:
+        try:
+            if self._shm == SHM_SYSTEM:
+                backend.unregister_system_shared_memory("")
+            elif self._shm == SHM_TPU:
+                backend.unregister_tpu_shared_memory("")
+        except Exception:
+            pass
+        import client_tpu.utils.shared_memory as shm
+
+        for handle in self._system_handles:
+            try:
+                shm.destroy_shared_memory_region(handle)
+            except Exception:
+                pass
+        if self._tpu_handles:
+            import client_tpu.utils.tpu_shared_memory as tpushm
+
+            for handle in self._tpu_handles:
+                try:
+                    tpushm.destroy_shared_memory_region(handle)
+                except Exception:
+                    pass
+        self._system_handles = []
+        self._tpu_handles = []
+
+
+class SequenceManager:
+    """Sequence-id allocation and per-sequence progress (parity:
+    sequence_manager.h:46-150)."""
+
+    def __init__(self, start_id: int = 1, id_range: int = 2**31,
+                 sequence_length: int = 20,
+                 sequence_length_variation: float = 0.2, seed: int = 3):
+        self._next_id = start_id
+        self._start = start_id
+        self._range = id_range
+        self._length = sequence_length
+        self._variation = sequence_length_variation
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._active: Dict[int, dict] = {}
+
+    def new_sequence(self, data_stream_count: int = 1) -> dict:
+        with self._lock:
+            seq_id = self._start + (self._next_id - self._start) % self._range
+            self._next_id += 1
+            remaining = max(
+                1,
+                int(self._length
+                    * (1 + self._rng.uniform(-self._variation,
+                                             self._variation))),
+            )
+            state = {
+                "id": seq_id,
+                "remaining": remaining,
+                "step": 0,
+                "stream": self._rng.randrange(data_stream_count),
+            }
+            self._active[seq_id] = state
+            return state
+
+    def advance(self, state: dict) -> dict:
+        """Returns kwargs for the next request in this sequence and
+        updates progress."""
+        with self._lock:
+            start = state["step"] == 0
+            state["remaining"] -= 1
+            end = state["remaining"] <= 0
+            kwargs = {
+                "sequence_id": state["id"],
+                "sequence_start": start,
+                "sequence_end": end,
+            }
+            state["step"] += 1
+            if end:
+                self._active.pop(state["id"], None)
+            return kwargs
+
+
+# -- ctx id trackers (parity: ctx_id_tracker_factory.h) -------------------
+
+
+class FifoCtxIdTracker:
+    def __init__(self):
+        self._free: List[int] = []
+        self._cv = threading.Condition()
+
+    def reset(self, count: int):
+        with self._cv:
+            self._free = list(range(count))
+            self._cv.notify_all()
+
+    def available(self) -> bool:
+        with self._cv:
+            return bool(self._free)
+
+    def get(self, timeout: Optional[float] = None) -> Optional[int]:
+        with self._cv:
+            if not self._free and not self._cv.wait_for(
+                lambda: bool(self._free), timeout=timeout
+            ):
+                return None
+            return self._free.pop(0)
+
+    def release(self, ctx_id: int):
+        with self._cv:
+            self._free.append(ctx_id)
+            self._cv.notify()
+
+
+class RandCtxIdTracker(FifoCtxIdTracker):
+    def get(self, timeout: Optional[float] = None) -> Optional[int]:
+        with self._cv:
+            if not self._free and not self._cv.wait_for(
+                lambda: bool(self._free), timeout=timeout
+            ):
+                return None
+            idx = random.randrange(len(self._free))
+            return self._free.pop(idx)
+
+
+# -- load managers ---------------------------------------------------------
+
+
+class LoadManager:
+    """Base: owns backends, data manager, worker threads, records."""
+
+    def __init__(
+        self,
+        factory: ClientBackendFactory,
+        model: ParsedModel,
+        data_loader: DataLoader,
+        data_manager: InferDataManager,
+        async_mode: bool = True,
+        streaming: bool = False,
+        max_threads: int = 16,
+        sequence_manager: Optional[SequenceManager] = None,
+    ):
+        self._factory = factory
+        self._model = model
+        self._loader = data_loader
+        self._data_manager = data_manager
+        self._async = async_mode
+        self._streaming = streaming
+        self._max_threads = max_threads
+        self._sequence_manager = sequence_manager
+        self._threads: List[threading.Thread] = []
+        self._thread_stats: List[ThreadStat] = []
+        self._stop = threading.Event()
+        self._setup_backend = None
+        self._step_cursor: Dict[int, int] = {}
+        self._step_lock = threading.Lock()
+
+    # setup ---------------------------------------------------------------
+    def init(self) -> None:
+        self._setup_backend = self._factory.create()
+        self._data_manager.init(self._setup_backend)
+
+    def cleanup(self) -> None:
+        self.stop()
+        if self._setup_backend is not None:
+            self._data_manager.cleanup(self._setup_backend)
+            self._setup_backend.close()
+            self._setup_backend = None
+
+    def _next_step(self, stream: int = 0) -> int:
+        with self._step_lock:
+            steps = max(self._loader.step_count(stream), 1)
+            step = self._step_cursor.get(stream, 0)
+            self._step_cursor[stream] = (step + 1) % steps
+            return step
+
+    def _sequence_step(self, holder: dict):
+        """Advance the sequence owned by a context slot; a slot runs
+        one sequence to completion before starting the next (the
+        reference's per-context sequence semantics,
+        infer_context.h:111). Returns (request kwargs, data stream,
+        data step) — sequences replay their own stream's steps in
+        order."""
+        if self._sequence_manager is None:
+            return {}, 0, None
+        state = holder.get("state")
+        if state is None:
+            state = self._sequence_manager.new_sequence(
+                self._loader.stream_count
+            )
+            holder["state"] = state
+        stream = state["stream"]
+        step = state["step"] % max(self._loader.step_count(stream), 1)
+        kwargs = self._sequence_manager.advance(state)
+        if kwargs["sequence_end"]:
+            holder["state"] = None
+        return kwargs, stream, step
+
+    # record access -------------------------------------------------------
+    def swap_request_records(self) -> List[RequestRecord]:
+        """Drain all worker records (parity: SwapRequestRecords)."""
+        records: List[RequestRecord] = []
+        for stat in self._thread_stats:
+            with stat.lock:
+                records.extend(stat.records)
+                stat.records = []
+        return records
+
+    def count_collected_requests(self) -> int:
+        return sum(len(s.records) for s in self._thread_stats)
+
+    def check_health(self) -> None:
+        for stat in self._thread_stats:
+            if stat.status is not None:
+                raise InferenceServerException(
+                    "worker thread failed: %s" % stat.status
+                )
+
+    def stop(self) -> None:
+        self._stop.set()
+        for thread in self._threads:
+            thread.join(timeout=30)
+        self._threads = []
+        self._stop.clear()
+
+
+class ConcurrencyManager(LoadManager):
+    """Maintains exactly N in-flight requests (parity:
+    concurrency_manager.h:95 + concurrency_worker.cc:42-175)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._concurrency = 0
+
+    def change_concurrency_level(self, concurrency: int) -> None:
+        self.stop()
+        self._concurrency = concurrency
+        if concurrency == 0:
+            return
+        n_threads = min(concurrency, self._max_threads)
+        base, extra = divmod(concurrency, n_threads)
+        self._thread_stats = [ThreadStat() for _ in range(n_threads)]
+        self._threads = []
+        for i in range(n_threads):
+            ctxs = base + (1 if i < extra else 0)
+            thread = threading.Thread(
+                target=self._worker, args=(self._thread_stats[i], ctxs),
+                daemon=True,
+            )
+            self._threads.append(thread)
+            thread.start()
+
+    def _worker(self, stat: ThreadStat, n_ctx: int) -> None:
+        try:
+            backend = self._factory.create()
+        except Exception as e:
+            stat.status = e
+            return
+        try:
+            if self._streaming:
+                self._stream_worker(stat, backend, n_ctx)
+            elif self._async:
+                self._async_worker(stat, backend, n_ctx)
+            else:
+                self._sync_worker(stat, backend, n_ctx)
+        except Exception as e:
+            stat.status = e
+        finally:
+            try:
+                backend.close()
+            except Exception:
+                pass
+
+    def _make_request(self, holder: dict):
+        kwargs, stream, seq_step = self._sequence_step(holder)
+        step = seq_step if seq_step is not None else self._next_step(stream)
+        inputs = self._data_manager.build_inputs(stream, step)
+        outputs = self._data_manager.build_outputs()
+        return inputs, outputs, kwargs
+
+    def _sync_worker(self, stat, backend, n_ctx):
+        holder: dict = {}
+        while not self._stop.is_set():
+            inputs, outputs, kwargs = self._make_request(holder)
+            record = RequestRecord(time.monotonic_ns())
+            try:
+                backend.infer(self._model.name, inputs, outputs=outputs,
+                              **kwargs)
+                record.end_ns.append(time.monotonic_ns())
+            except InferenceServerException as e:
+                record.error = e
+            stat.add_record(record)
+
+    def _async_worker(self, stat, backend, n_ctx):
+        tracker = FifoCtxIdTracker()
+        tracker.reset(n_ctx)
+        holders = [dict() for _ in range(n_ctx)]
+
+        def _done(record, ctx_id):
+            def callback(result, error):
+                record.end_ns.append(time.monotonic_ns())
+                if error is not None:
+                    record.error = error
+                stat.add_record(record)
+                tracker.release(ctx_id)
+
+            return callback
+
+        while not self._stop.is_set():
+            ctx_id = tracker.get(timeout=0.1)
+            if ctx_id is None:
+                continue
+            if self._stop.is_set():
+                tracker.release(ctx_id)
+                break
+            inputs, outputs, kwargs = self._make_request(holders[ctx_id])
+            record = RequestRecord(time.monotonic_ns())
+            backend.async_infer(_done(record, ctx_id), self._model.name,
+                                inputs, outputs=outputs, **kwargs)
+        # drain: wait briefly for in-flight requests
+        deadline = time.monotonic() + 5
+        acquired = 0
+        while acquired < n_ctx and time.monotonic() < deadline:
+            if tracker.get(timeout=0.2) is not None:
+                acquired += 1
+
+    def _stream_worker(self, stat, backend, n_ctx):
+        tracker = FifoCtxIdTracker()
+        tracker.reset(n_ctx)
+        holders = [dict() for _ in range(n_ctx)]
+        inflight: Dict[int, tuple] = {}  # key -> (record, ctx_id)
+        inflight_lock = threading.Lock()
+        order: List[int] = []
+
+        def _response_key(result):
+            """Pair by the echoed request id; FIFO fallback for
+            backends that don't echo ids (mock)."""
+            if result is not None:
+                try:
+                    response = result.get_response()
+                    rid = (
+                        response.get("id") if isinstance(response, dict)
+                        else response.id
+                    )
+                    if rid:
+                        return int(rid)
+                except (AttributeError, ValueError):
+                    pass
+            return order[0] if order else None
+
+        def callback(result, error):
+            with inflight_lock:
+                final = True
+                if result is not None:
+                    params = result.get_parameters()
+                    final = params.get("triton_final_response", True)
+                key = _response_key(result)
+                if key is None or key not in inflight:
+                    return  # unsolicited/late response
+                record, ctx_id = inflight[key]
+                record.end_ns.append(time.monotonic_ns())
+                if error is not None:
+                    record.error = error
+                    final = True
+                if final:
+                    if key in order:
+                        order.remove(key)
+                    inflight.pop(key, None)
+                    stat.add_record(record)
+                    tracker.release(ctx_id)
+
+        backend.start_stream(callback)
+        counter = 0
+        try:
+            while not self._stop.is_set():
+                ctx_id = tracker.get(timeout=0.1)
+                if ctx_id is None:
+                    continue
+                if self._stop.is_set():
+                    tracker.release(ctx_id)
+                    break
+                inputs, outputs, kwargs = self._make_request(holders[ctx_id])
+                record = RequestRecord(time.monotonic_ns())
+                with inflight_lock:
+                    key = counter
+                    counter += 1
+                    inflight[key] = (record, ctx_id)
+                    order.append(key)
+                backend.async_stream_infer(self._model.name, inputs,
+                                           outputs=outputs,
+                                           request_id=str(key), **kwargs)
+        finally:
+            backend.stop_stream()
+
+
+class RequestRateManager(LoadManager):
+    """Dispatches at a fixed rate from a generated schedule, constant
+    or Poisson (parity: request_rate_manager.h:57,
+    request_rate_worker.h:52). Late sends are flagged `delayed`."""
+
+    def __init__(self, *args, distribution: str = "constant", **kwargs):
+        super().__init__(*args, **kwargs)
+        self._distribution = distribution
+        self._rate = 0.0
+        self._schedule: List[float] = []
+
+    def _generate_schedule(self, rate: float, duration_s: float) -> List[float]:
+        if rate <= 0:
+            return []
+        offsets = []
+        t = 0.0
+        rng = random.Random(11)
+        while t < duration_s:
+            if self._distribution == "poisson":
+                t += rng.expovariate(rate)
+            else:
+                t += 1.0 / rate
+            offsets.append(t)
+        return offsets
+
+    def change_request_rate(self, rate: float,
+                            duration_s: float = 3600) -> None:
+        self.stop()
+        self._rate = rate
+        if rate <= 0:
+            return
+        self._schedule = self._generate_schedule(rate, duration_s)
+        self._launch_schedule_workers()
+
+    def set_custom_schedule(self, intervals_s: List[float]) -> None:
+        """Absolute offsets computed from user intervals
+        (CustomLoadManager parity, custom_load_manager.h:46); cycled
+        when exhausted."""
+        self.stop()
+        offsets = []
+        t = 0.0
+        # repeat the interval list to cover a long window
+        for _ in range(200000 // max(len(intervals_s), 1) + 1):
+            for interval in intervals_s:
+                t += interval
+                offsets.append(t)
+            if t > 3600:
+                break
+        self._schedule = offsets
+        self._launch_schedule_workers()
+
+    def _launch_schedule_workers(self):
+        n_threads = min(self._max_threads, 8)
+        self._thread_stats = [ThreadStat() for _ in range(n_threads)]
+        self._threads = []
+        start_ns = time.monotonic_ns() + int(0.01 * NANOS)
+        for i in range(n_threads):
+            thread = threading.Thread(
+                target=self._worker,
+                args=(self._thread_stats[i], i, n_threads, start_ns),
+                daemon=True,
+            )
+            self._threads.append(thread)
+            thread.start()
+
+    def _worker(self, stat: ThreadStat, worker_idx: int, n_workers: int,
+                start_ns: int) -> None:
+        try:
+            backend = self._factory.create()
+        except Exception as e:
+            stat.status = e
+            return
+
+        def _done(record):
+            def callback(result, error):
+                record.end_ns.append(time.monotonic_ns())
+                if error is not None:
+                    record.error = error
+                stat.add_record(record)
+
+            return callback
+
+        try:
+            idx = worker_idx
+            holder: dict = {}
+            while not self._stop.is_set() and idx < len(self._schedule):
+                due_ns = start_ns + int(self._schedule[idx] * NANOS)
+                now = time.monotonic_ns()
+                delayed = False
+                if now < due_ns:
+                    wait = (due_ns - now) / NANOS
+                    if self._stop.wait(timeout=wait):
+                        break
+                else:
+                    delayed = (now - due_ns) > 0.01 * NANOS
+                kwargs, stream, seq_step = self._sequence_step(holder)
+                step = (
+                    seq_step if seq_step is not None
+                    else self._next_step(stream)
+                )
+                inputs = self._data_manager.build_inputs(stream, step)
+                outputs = self._data_manager.build_outputs()
+                record = RequestRecord(time.monotonic_ns(), delayed=delayed)
+                if self._async:
+                    backend.async_infer(_done(record), self._model.name,
+                                        inputs, outputs=outputs, **kwargs)
+                else:
+                    try:
+                        backend.infer(self._model.name, inputs,
+                                      outputs=outputs, **kwargs)
+                        record.end_ns.append(time.monotonic_ns())
+                    except InferenceServerException as e:
+                        record.error = e
+                    stat.add_record(record)
+                idx += n_workers
+        except Exception as e:
+            stat.status = e
+        finally:
+            try:
+                backend.close()
+            except Exception:
+                pass
+
+
+class CustomLoadManager(RequestRateManager):
+    """Replays user-provided request intervals from a file, one
+    microsecond value per line (parity: custom_load_manager.h:46 /
+    the --request-intervals CLI mode)."""
+
+    def __init__(self, *args, request_intervals_file: Optional[str] = None,
+                 **kwargs):
+        super().__init__(*args, **kwargs)
+        self._intervals_file = request_intervals_file
+
+    @staticmethod
+    def read_intervals_file(path: str) -> List[float]:
+        with open(path) as f:
+            intervals = [int(line.strip()) / 1e6
+                         for line in f if line.strip()]
+        if not intervals:
+            raise ValueError("request-intervals file '%s' is empty" % path)
+        return intervals
+
+    def start_schedule(self) -> None:
+        self.set_custom_schedule(
+            self.read_intervals_file(self._intervals_file))
+
+
+class PeriodicConcurrencyManager(ConcurrencyManager):
+    """Ramps concurrency from start to end by `step` every
+    `request_period` completed requests (parity:
+    periodic_concurrency_manager.h:39 — LLM-oriented)."""
+
+    def __init__(self, *args, concurrency_start: int = 1,
+                 concurrency_end: int = 8, concurrency_step: int = 1,
+                 request_period: int = 10, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._start_c = concurrency_start
+        self._end_c = concurrency_end
+        self._step_c = concurrency_step
+        self._period = request_period
+        self._ramp_thread: Optional[threading.Thread] = None
+
+    def run_ramp(self) -> None:
+        current = self._start_c
+        self.change_concurrency_level(current)
+        while current < self._end_c and not self._stop.is_set():
+            # change_concurrency_level resets thread stats, so the
+            # collected count starts from zero at every level
+            if self.count_collected_requests() >= self._period:
+                current = min(current + self._step_c, self._end_c)
+                self.change_concurrency_level(current)
+            time.sleep(0.01)

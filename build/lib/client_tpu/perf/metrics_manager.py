@@ -1,0 +1,136 @@
+"""Server metrics collection for the perf harness.
+
+Parity with the reference MetricsManager (metrics_manager.h:56-82,
+metrics.h:37-43): poll the server's Prometheus ``/metrics`` endpoint on
+a background thread every ``metrics_interval_ms`` and parse accelerator
+gauges into per-window :class:`TpuMetrics` snapshots. The DCGM GPU
+util/power/memory maps become TPU HBM gauges (tpu_hbm_used_bytes /
+tpu_hbm_total_bytes / tpu_hbm_utilization exported by the in-repo
+server; any Prometheus source with those families works).
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+_SAMPLE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>[^\s]+)')
+_LABEL = re.compile(r'(\w+)="([^"]*)"')
+
+
+@dataclass
+class TpuMetrics:
+    """One scrape: per-device gauge maps keyed by device uuid
+    (parity: Metrics::gpu_utilization_per_gpu etc, metrics.h:37-43)."""
+
+    hbm_used_bytes: Dict[str, float] = field(default_factory=dict)
+    hbm_total_bytes: Dict[str, float] = field(default_factory=dict)
+    hbm_utilization: Dict[str, float] = field(default_factory=dict)
+
+
+_FAMILIES = {
+    "tpu_hbm_used_bytes": "hbm_used_bytes",
+    "tpu_hbm_total_bytes": "hbm_total_bytes",
+    "tpu_hbm_utilization": "hbm_utilization",
+}
+
+
+def parse_prometheus(text: str) -> TpuMetrics:
+    metrics = TpuMetrics()
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE.match(line)
+        if not m or m.group("name") not in _FAMILIES:
+            continue
+        labels = dict(_LABEL.findall(m.group("labels") or ""))
+        uuid = labels.get("tpu_uuid") or labels.get("gpu_uuid") or "0"
+        try:
+            value = float(m.group("value"))
+        except ValueError:
+            continue
+        getattr(metrics, _FAMILIES[m.group("name")])[uuid] = value
+    return metrics
+
+
+class MetricsManager:
+    """Polls ``url`` every ``metrics_interval_ms`` while started;
+    snapshots accumulate until :meth:`get_and_reset`."""
+
+    def __init__(self, url: str, metrics_interval_ms: float = 1000.0,
+                 timeout_s: float = 2.0):
+        if "://" not in url:
+            url = "http://" + url
+        if not url.endswith("/metrics"):
+            url = url.rstrip("/") + "/metrics"
+        self._url = url
+        self._interval_s = metrics_interval_ms / 1000.0
+        self._timeout_s = timeout_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._snapshots: List[TpuMetrics] = []
+        self.scrape_failures = 0
+
+    def scrape_once(self) -> TpuMetrics:
+        with urllib.request.urlopen(self._url,
+                                    timeout=self._timeout_s) as resp:
+            return parse_prometheus(resp.read().decode("utf-8", "replace"))
+
+    def check_reachable(self) -> None:
+        """Raise if the endpoint cannot be scraped (parity:
+        CheckForMissingMetrics fail-fast before profiling)."""
+        self.scrape_once()
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._poll, daemon=True)
+        self._thread.start()
+
+    def _poll(self) -> None:
+        while not self._stop.wait(self._interval_s):
+            try:
+                snapshot = self.scrape_once()
+            except Exception:
+                self.scrape_failures += 1
+                continue
+            with self._lock:
+                self._snapshots.append(snapshot)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def get_and_reset(self) -> List[TpuMetrics]:
+        """Snapshots collected since the last call (one measurement
+        window's worth)."""
+        with self._lock:
+            out = self._snapshots
+            self._snapshots = []
+        return out
+
+
+def summarize_metrics(snapshots: List[TpuMetrics]) -> Dict[str, Dict[str, float]]:
+    """avg/max per gauge family across a window's snapshots, averaged
+    over devices (what the CSV 'GPU metrics' columns become)."""
+    out: Dict[str, Dict[str, float]] = {}
+    for attr in ("hbm_used_bytes", "hbm_total_bytes", "hbm_utilization"):
+        values = []
+        for snap in snapshots:
+            per_device = getattr(snap, attr)
+            if per_device:
+                values.append(sum(per_device.values()) / len(per_device))
+        if values:
+            out[attr] = {
+                "avg": sum(values) / len(values),
+                "max": max(values),
+            }
+    return out

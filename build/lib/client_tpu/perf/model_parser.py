@@ -1,0 +1,140 @@
+"""Model metadata/config normalization for the perf harness (parity:
+model_parser.h:41-76 — ModelTensor, scheduler type, decoupled flag)."""
+
+from __future__ import annotations
+
+import enum
+from typing import Dict, List, Optional
+
+from client_tpu.utils import InferenceServerException
+
+
+class SchedulerType(enum.Enum):
+    NONE = "none"
+    DYNAMIC = "dynamic"
+    SEQUENCE = "sequence"
+    ENSEMBLE = "ensemble"
+    # Ensemble whose composing chain contains a sequence-batched model
+    # (reference model_parser.h:63) — sequence semantics apply.
+    ENSEMBLE_SEQUENCE = "ensemble_sequence"
+
+
+class ModelTensor:
+    def __init__(self, name: str, datatype: str, shape: List[int],
+                 optional: bool = False, is_shape_tensor: bool = False):
+        self.name = name
+        self.datatype = datatype
+        self.shape = shape
+        self.optional = optional
+        self.is_shape_tensor = is_shape_tensor
+
+
+class ParsedModel:
+    def __init__(self):
+        self.name = ""
+        self.version = ""
+        self.platform = ""
+        self.max_batch_size = 0
+        self.inputs: Dict[str, ModelTensor] = {}
+        self.outputs: Dict[str, ModelTensor] = {}
+        self.scheduler_type = SchedulerType.NONE
+        self.decoupled = False
+        self.composing_models: List[str] = []
+        # True when any composing model is sequence-batched: the load
+        # manager must then drive sequences even though the top model
+        # is an ensemble (reference GetComposingSchedulerType).
+        self.composing_sequential = False
+        self.response_cache_enabled = False
+
+
+class ModelParser:
+    """Builds a ParsedModel from backend metadata+config dicts."""
+
+    def parse(self, backend, model_name: str, model_version: str = "",
+              batch_size: int = 1,
+              bls_composing_models: Optional[List[str]] = None
+              ) -> ParsedModel:
+        metadata = backend.model_metadata(model_name, model_version)
+        config = backend.model_config(model_name, model_version)
+        model = ParsedModel()
+        model.name = metadata.get("name", model_name)
+        versions = metadata.get("versions", [])
+        model.version = model_version or (versions[-1] if versions else "")
+        model.platform = metadata.get("platform", "")
+        model.max_batch_size = int(config.get("max_batch_size", 0))
+        if batch_size > 1 and model.max_batch_size == 0:
+            raise InferenceServerException(
+                "batch size %d requested but model '%s' does not support "
+                "batching" % (batch_size, model_name)
+            )
+        if batch_size > model.max_batch_size > 0:
+            raise InferenceServerException(
+                "batch size %d exceeds model max_batch_size %d"
+                % (batch_size, model.max_batch_size)
+            )
+
+        config_inputs = {t.get("name"): t for t in config.get("input", [])}
+        for tensor in metadata.get("inputs", []):
+            shape = [int(d) for d in tensor.get("shape", [])]
+            if model.max_batch_size > 0 and shape and shape[0] == -1:
+                shape = shape[1:]  # strip batch dim
+            extra = config_inputs.get(tensor["name"], {})
+            model.inputs[tensor["name"]] = ModelTensor(
+                tensor["name"], tensor.get("datatype", ""), shape,
+                optional=bool(extra.get("optional", False)),
+                is_shape_tensor=bool(extra.get("is_shape_tensor", False)),
+            )
+        for tensor in metadata.get("outputs", []):
+            shape = [int(d) for d in tensor.get("shape", [])]
+            if model.max_batch_size > 0 and shape and shape[0] == -1:
+                shape = shape[1:]
+            model.outputs[tensor["name"]] = ModelTensor(
+                tensor["name"], tensor.get("datatype", ""), shape
+            )
+
+        if "ensemble_scheduling" in config:
+            model.scheduler_type = SchedulerType.ENSEMBLE
+        elif "sequence_batching" in config:
+            model.scheduler_type = SchedulerType.SEQUENCE
+        elif "dynamic_batching" in config:
+            model.scheduler_type = SchedulerType.DYNAMIC
+        policy = config.get("model_transaction_policy", {})
+        model.decoupled = bool(policy.get("decoupled", False))
+        cache = config.get("response_cache", {})
+        model.response_cache_enabled = bool(cache.get("enable", False))
+
+        # Composing models: ensemble steps (recursively — an ensemble
+        # step may itself be an ensemble) plus any BLS children named
+        # explicitly (a BLS pipeline's callees are invisible in the
+        # config, reference --bls-composing-models). Pairing their
+        # per-window stats with the top model's is what makes
+        # ensemble profiles add up.
+        seen = set()
+        self._add_composing(backend, config, model, seen)
+        for name in bls_composing_models or []:
+            self._add_child(backend, name, model, seen)
+        if (model.scheduler_type is SchedulerType.ENSEMBLE
+                and model.composing_sequential):
+            model.scheduler_type = SchedulerType.ENSEMBLE_SEQUENCE
+        return model
+
+    def _add_composing(self, backend, config: dict, model: ParsedModel,
+                       seen: set) -> None:
+        for step in config.get("ensemble_scheduling", {}).get("step", []):
+            name = step.get("model_name", "")
+            if name:
+                self._add_child(backend, name, model, seen)
+
+    def _add_child(self, backend, name: str, model: ParsedModel,
+                   seen: set) -> None:
+        if name in seen:
+            return
+        seen.add(name)
+        model.composing_models.append(name)
+        try:
+            child_config = backend.model_config(name)
+        except InferenceServerException:
+            return  # unavailable child: keep the name for stat pairing
+        if "sequence_batching" in child_config:
+            model.composing_sequential = True
+        self._add_composing(backend, child_config, model, seen)

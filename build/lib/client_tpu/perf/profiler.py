@@ -1,0 +1,422 @@
+"""Measurement engine: sweeps load levels, repeats measurement windows
+until the last three trials are stable, computes client percentiles
+and pairs server-side statistics (parity: inference_profiler.h:215,
+Measure/ProfileHelper semantics incl. the last-3-trials stability rule
+and window sleep)."""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from client_tpu.perf.load_manager import (
+    ConcurrencyManager,
+    LoadManager,
+    RequestRateManager,
+    RequestRecord,
+)
+from client_tpu.utils import InferenceServerException
+
+NANOS = 1_000_000_000
+
+
+class PerfStatus:
+    """One stable measurement at a load level (parity: PerfStatus
+    inference_profiler.h:178)."""
+
+    def __init__(self):
+        self.concurrency = 0
+        self.request_rate = 0.0
+        self.client_stats: Dict[str, float] = {}
+        self.server_stats: Dict[str, dict] = {}
+        self.latency_percentiles: Dict[int, float] = {}
+        self.throughput = 0.0
+        self.avg_latency_us = 0.0
+        self.std_latency_us = 0.0
+        self.completed_count = 0
+        self.delayed_count = 0
+        self.error_count = 0
+        self.on_target = True
+        self.records: List[RequestRecord] = []
+        self.window_start_ns = 0
+        self.window_end_ns = 0
+        # summarized server accelerator gauges for the window:
+        # {family: {"avg": x, "max": y}} (see perf.metrics_manager)
+        self.tpu_metrics: Dict[str, Dict[str, float]] = {}
+
+
+class MeasurementConfig:
+    def __init__(
+        self,
+        measurement_interval_ms: int = 5000,
+        measurement_mode: str = "time_windows",  # or count_windows
+        measurement_request_count: int = 50,
+        max_trials: int = 10,
+        stability_threshold: float = 0.1,
+        latency_threshold_ms: float = 0.0,
+        percentile: int = 0,  # 0 = use average for stability
+        batch_size: int = 1,
+    ):
+        self.interval_ms = measurement_interval_ms
+        self.mode = measurement_mode
+        self.request_count = measurement_request_count
+        self.max_trials = max_trials
+        self.stability = stability_threshold
+        self.latency_threshold_ms = latency_threshold_ms
+        self.percentile = percentile
+        # Inferences per request: throughput is inferences/sec
+        # (requests x batch / window), reference semantics
+        # (inference_profiler.cc valid_request_count * batch_size).
+        self.batch_size = batch_size
+
+
+def _normalize_stats_entry(entry: Dict) -> Dict:
+    """Undoes protobuf-JSON int64 stringification on the known numeric
+    fields only (a generic string->int pass would corrupt `version`)."""
+    out = dict(entry)
+    for key in ("inference_count", "execution_count"):
+        if key in out:
+            out[key] = int(out[key])
+    sections = {}
+    for name, section in dict(out.get("inference_stats", {})).items():
+        sections[name] = (
+            {k: int(v) for k, v in section.items()}
+            if isinstance(section, dict) else section
+        )
+    if sections:
+        out["inference_stats"] = sections
+    return out
+
+
+def _numeric_delta(before, after):
+    """after - before over matching numeric leaves; non-numeric leaves
+    (names, versions) pass through from `after`."""
+    if isinstance(after, dict):
+        before = before if isinstance(before, dict) else {}
+        return {
+            key: _numeric_delta(before.get(key), value)
+            for key, value in after.items()
+        }
+    if isinstance(after, (int, float)) and not isinstance(after, bool):
+        base = before if isinstance(before, (int, float)) \
+            and not isinstance(before, bool) else 0
+        # Clamp: a server-side counter reset mid-window must not
+        # produce negative counts (matches the native CombineDuration).
+        return max(after - base, 0)
+    return after
+
+
+def _accumulate_numeric(total, part):
+    """total + part over numeric leaves (dict-shaped mirror of
+    _numeric_delta, used when merging stable windows)."""
+    if isinstance(part, dict):
+        total = total if isinstance(total, dict) else {}
+        return {
+            key: _accumulate_numeric(total.get(key), value)
+            for key, value in part.items()
+        }
+    if isinstance(part, (int, float)) and not isinstance(part, bool):
+        base = total if isinstance(total, (int, float)) \
+            and not isinstance(total, bool) else 0
+        return base + part
+    return part
+
+
+def _accumulate_server_stats(total: Dict, part: Dict) -> Dict:
+    """Sums two window-delta server_stats payloads, matching
+    model_stats entries by (name, version) — _accumulate_numeric alone
+    cannot merge the entry LIST (it would replace it wholesale)."""
+    if not part:
+        return total
+    if not total:
+        return part
+    merged = {
+        (e.get("name"), e.get("version", "")): e
+        for e in total.get("model_stats", [])
+    }
+    for entry in part.get("model_stats", []):
+        key = (entry.get("name"), entry.get("version", ""))
+        merged[key] = _accumulate_numeric(merged.get(key, {}), entry)
+    return {"model_stats": list(merged.values())}
+
+
+def _delta_server_stats(before: Dict, after: Dict) -> Dict:
+    """Window-start/window-end statistics pairing: returns the same
+    model_stats shape holding only THIS window's deltas, one entry per
+    (model, version) — the top model plus ensemble composing models."""
+    return {
+        "model_stats": [
+            _numeric_delta(before.get(key, {}), entry)
+            for key, entry in after.items()
+        ]
+    }
+
+
+class InferenceProfiler:
+    def __init__(self, manager: LoadManager, config: MeasurementConfig,
+                 backend=None, model_name: str = "", verbose: bool = False,
+                 metrics_manager=None, composing_models=None):
+        self._manager = manager
+        self._config = config
+        self._backend = backend  # for server-side stats
+        self._model_name = model_name
+        # Ensemble composing models: their stats are snapshotted and
+        # paired alongside the top model (reference
+        # inference_profiler.cc:648 MergeServerSideStats).
+        self._composing = list(composing_models or [])
+        self._verbose = verbose
+        self._metrics = metrics_manager  # perf.metrics_manager.MetricsManager
+        if self._metrics is not None:
+            self._metrics.start()
+
+    # -- sweeping --------------------------------------------------------
+
+    def profile_concurrency_range(self, start: int, end: int,
+                                  step: int = 1) -> List[PerfStatus]:
+        assert isinstance(self._manager, ConcurrencyManager)
+        results = []
+        concurrency = start
+        while concurrency <= end or (end == 0 and concurrency == start):
+            self._manager.change_concurrency_level(concurrency)
+            status = self._profile_level()
+            status.concurrency = concurrency
+            results.append(status)
+            if self._exceeds_latency(status):
+                break
+            if end == 0:
+                break
+            concurrency += step
+        self._manager.stop()
+        return results
+
+    def profile_request_rate_range(self, start: float, end: float,
+                                   step: float = 1.0) -> List[PerfStatus]:
+        assert isinstance(self._manager, RequestRateManager)
+        results = []
+        rate = start
+        while rate <= end or (end == 0 and rate == start):
+            self._manager.change_request_rate(rate)
+            status = self._profile_level()
+            status.request_rate = rate
+            results.append(status)
+            if self._exceeds_latency(status):
+                break
+            if end == 0:
+                break
+            rate += step
+        self._manager.stop()
+        return results
+
+    def profile_custom_intervals(self) -> List[PerfStatus]:
+        """Profile one level driven by the manager's custom interval
+        schedule (CustomLoadManager intervals file; for an explicit
+        list call manager.set_custom_schedule first and use
+        profile_single_level)."""
+        assert isinstance(self._manager, RequestRateManager)
+        self._manager.start_schedule()
+        status = self._profile_level()
+        self._manager.stop()
+        return [status]
+
+    def profile_single_level(self) -> PerfStatus:
+        """Measure at whatever load the manager is already generating
+        (periodic-concurrency ramp mode)."""
+        return self._profile_level()
+
+    def _exceeds_latency(self, status: PerfStatus) -> bool:
+        if self._config.latency_threshold_ms <= 0:
+            return False
+        measured = (
+            status.latency_percentiles.get(self._config.percentile,
+                                           status.avg_latency_us)
+            if self._config.percentile else status.avg_latency_us
+        )
+        return measured / 1000.0 > self._config.latency_threshold_ms
+
+    # -- one load level --------------------------------------------------
+
+    def _profile_level(self) -> PerfStatus:
+        """Repeat measurement windows until the last three agree
+        within the stability threshold on latency AND throughput
+        (reference stability rule), or max_trials is hit."""
+        trials: List[PerfStatus] = []
+        for trial in range(self._config.max_trials):
+            status = self._measure()
+            self._manager.check_health()
+            trials.append(status)
+            if self._verbose:
+                print(
+                    "  trial %d: %.1f infer/sec, avg %.0f us"
+                    % (trial, status.throughput, status.avg_latency_us)
+                )
+            if self._is_stable(trials):
+                return self._merge(trials[-3:])
+        # unstable: report the merge anyway, flagged
+        merged = self._merge(trials[-3:] if len(trials) >= 3 else trials)
+        merged.on_target = False
+        return merged
+
+    def _measure(self) -> PerfStatus:
+        self._manager.swap_request_records()  # discard warm-up residue
+        if self._metrics is not None:
+            self._metrics.get_and_reset()  # drop inter-window scrapes
+        stats_before = self._server_stats_snapshot()
+        start_ns = time.monotonic_ns()
+        if self._config.mode == "count_windows":
+            deadline = time.monotonic() + self._config.interval_ms / 1000.0 * 10
+            while (
+                self._manager.count_collected_requests()
+                < self._config.request_count
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.005)
+        else:
+            # reference sleeps window * 1.2 then snapshots
+            time.sleep(self._config.interval_ms / 1000.0)
+        end_ns = time.monotonic_ns()
+        records = self._manager.swap_request_records()
+        stats_after = self._server_stats_snapshot()
+        status = self._summarize(records, start_ns, end_ns)
+        if stats_after is not None:
+            status.server_stats = _delta_server_stats(
+                stats_before or {}, stats_after)
+        if self._metrics is not None:
+            from client_tpu.perf.metrics_manager import summarize_metrics
+
+            status.tpu_metrics = summarize_metrics(
+                self._metrics.get_and_reset())
+        return status
+
+    def _summarize(self, records: List[RequestRecord], start_ns: int,
+                   end_ns: int) -> PerfStatus:
+        status = PerfStatus()
+        status.window_start_ns = start_ns
+        status.window_end_ns = end_ns
+        status.records = records
+        window_s = (end_ns - start_ns) / NANOS
+        valid = [r for r in records if r.valid]
+        status.completed_count = len(valid)
+        status.error_count = sum(1 for r in records if r.error is not None)
+        status.delayed_count = sum(1 for r in records if r.delayed)
+        if not valid:
+            return status
+        latencies_us = np.array([r.latency_ns / 1000.0 for r in valid])
+        status.avg_latency_us = float(latencies_us.mean())
+        status.std_latency_us = float(latencies_us.std())
+        for p in (50, 90, 95, 99):
+            status.latency_percentiles[p] = float(
+                np.percentile(latencies_us, p)
+            )
+        if self._config.percentile and self._config.percentile not in (
+            50, 90, 95, 99,
+        ):
+            status.latency_percentiles[self._config.percentile] = float(
+                np.percentile(latencies_us, self._config.percentile)
+            )
+        status.throughput = (
+            len(valid) * self._config.batch_size / window_s
+            if window_s > 0 else 0.0
+        )
+        return status
+
+    def _server_stats_snapshot(self) -> Optional[Dict]:
+        """Cumulative server statistics for the model and its
+        composing models, keyed by (name, version). Deltas between the
+        window-start and window-end snapshots isolate THIS window's
+        queue/compute behavior from warmup and earlier windows
+        (reference pairs start/end ModelInferenceStatistics per
+        Measure, inference_profiler.cc:648)."""
+        if self._backend is None or not self._model_name:
+            return None
+        wanted = set([self._model_name] + self._composing)
+        try:  # one all-models query per snapshot (native parity)
+            stats = self._backend.model_statistics("")
+        except Exception:
+            return None
+        snapshot: Dict = {}
+        for entry in stats.get("model_stats", []):
+            if entry.get("name") not in wanted:
+                continue
+            key = (entry.get("name"), entry.get("version", ""))
+            snapshot[key] = _normalize_stats_entry(entry)
+        return snapshot or None
+
+    def _is_stable(self, trials: List[PerfStatus]) -> bool:
+        if len(trials) < 3:
+            return False
+        last = trials[-3:]
+        if any(t.completed_count == 0 for t in last):
+            return False
+        metric = (
+            (lambda t: t.latency_percentiles.get(self._config.percentile,
+                                                 t.avg_latency_us))
+            if self._config.percentile else (lambda t: t.avg_latency_us)
+        )
+        latencies = [metric(t) for t in last]
+        throughputs = [t.throughput for t in last]
+        for values in (latencies, throughputs):
+            mean = sum(values) / 3
+            if mean <= 0:
+                return False
+            if any(abs(v - mean) / mean > self._config.stability
+                   for v in values):
+                return False
+        if self._config.latency_threshold_ms > 0:
+            if any(
+                metric(t) / 1000.0 > self._config.latency_threshold_ms
+                for t in last
+            ):
+                return True  # over threshold: stop early, caller reports
+        return True
+
+    def _merge(self, trials: List[PerfStatus]) -> PerfStatus:
+        """Merge the stable trials into one report (parity:
+        MergePerfStatusReports inference_profiler.cc:648)."""
+        if not trials:
+            return PerfStatus()
+        merged = PerfStatus()
+        merged.records = [r for t in trials for r in t.records]
+        merged.window_start_ns = trials[0].window_start_ns
+        merged.window_end_ns = trials[-1].window_end_ns
+        merged.completed_count = sum(t.completed_count for t in trials)
+        merged.error_count = sum(t.error_count for t in trials)
+        merged.delayed_count = sum(t.delayed_count for t in trials)
+        valid = [r for r in merged.records if r.valid]
+        if valid:
+            latencies_us = np.array([r.latency_ns / 1000.0 for r in valid])
+            merged.avg_latency_us = float(latencies_us.mean())
+            merged.std_latency_us = float(latencies_us.std())
+            for p in (50, 90, 95, 99):
+                merged.latency_percentiles[p] = float(
+                    np.percentile(latencies_us, p)
+                )
+            if self._config.percentile and self._config.percentile not in (
+                50, 90, 95, 99,
+            ):
+                merged.latency_percentiles[self._config.percentile] = float(
+                    np.percentile(latencies_us, self._config.percentile)
+                )
+        window_s = sum(
+            (t.window_end_ns - t.window_start_ns) / NANOS for t in trials
+        )
+        merged.throughput = (
+            merged.completed_count * self._config.batch_size / window_s
+            if window_s > 0 else 0.0
+        )
+        # Per-window deltas sum across the merged windows (counts and
+        # ns are additive); non-numeric fields ride through.
+        merged.server_stats = {}
+        for trial in trials:
+            merged.server_stats = _accumulate_server_stats(
+                merged.server_stats, trial.server_stats)
+        families = {f for t in trials for f in t.tpu_metrics}
+        for fam in families:
+            windows = [t.tpu_metrics[fam] for t in trials
+                       if fam in t.tpu_metrics]
+            merged.tpu_metrics[fam] = {
+                "avg": sum(w["avg"] for w in windows) / len(windows),
+                "max": max(w["max"] for w in windows),
+            }
+        return merged

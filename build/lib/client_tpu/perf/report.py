@@ -1,0 +1,134 @@
+"""Result reporting: stdout summary, CSV rows (parity: report_writer.h)
+and the JSON profile export consumed by the genai layer (parity:
+profile_data_exporter.h:54-94)."""
+
+from __future__ import annotations
+
+import csv
+import json
+from typing import List, Optional
+
+from client_tpu.perf.profiler import PerfStatus
+
+
+def print_report(results: List[PerfStatus], percentile: int = 0,
+                 mode: str = "concurrency") -> None:
+    for status in results:
+        level = (
+            "Concurrency: %d" % status.concurrency
+            if mode == "concurrency"
+            else "Request rate: %.1f" % status.request_rate
+        )
+        print("%s, throughput: %.2f infer/sec, avg latency %.0f usec"
+              % (level, status.throughput, status.avg_latency_us))
+        pcts = ", ".join(
+            "p%d %.0f" % (p, v)
+            for p, v in sorted(status.latency_percentiles.items())
+        )
+        print("    latency percentiles (usec): %s" % pcts)
+        if status.delayed_count:
+            print("    delayed requests: %d" % status.delayed_count)
+        if status.error_count:
+            print("    errors: %d" % status.error_count)
+        for entry in status.server_stats.get("model_stats", []):
+            stats = entry.get("inference_stats", {})
+            count = entry.get("inference_count", 0)
+            if not count:
+                continue
+
+            def us(section):
+                return stats.get(section, {}).get("ns", 0) / count / 1000.0
+
+            print(
+                "    server %s (this window): %d inferences, "
+                "%d executions, queue %.0f us, compute in/infer/out "
+                "%.0f/%.0f/%.0f us"
+                % (entry.get("name", "?"), count,
+                   entry.get("execution_count", 0), us("queue"),
+                   us("compute_input"), us("compute_infer"),
+                   us("compute_output")))
+        if status.tpu_metrics:
+            hbm = status.tpu_metrics.get("hbm_used_bytes")
+            util = status.tpu_metrics.get("hbm_utilization")
+            parts = []
+            if hbm:
+                parts.append("HBM used avg %.1f MiB / max %.1f MiB"
+                             % (hbm["avg"] / 2**20, hbm["max"] / 2**20))
+            if util:
+                parts.append("HBM util avg %.1f%%" % (util["avg"] * 100))
+            if parts:
+                print("    server TPU: %s" % ", ".join(parts))
+        if not status.on_target:
+            print("    WARNING: measurement did not stabilize")
+
+
+def write_csv(path: str, results: List[PerfStatus],
+              mode: str = "concurrency") -> None:
+    with open(path, "w", newline="") as f:
+        writer = csv.writer(f)
+        writer.writerow([
+            "Concurrency" if mode == "concurrency" else "Request Rate",
+            "Inferences/Second", "p50 latency", "p90 latency",
+            "p95 latency", "p99 latency", "Avg latency", "Std latency",
+            "Completed", "Delayed", "Errors",
+            "Avg HBM Used (MiB)", "Max HBM Used (MiB)",
+            "Avg HBM Utilization",
+        ])
+        for status in results:
+            hbm = status.tpu_metrics.get("hbm_used_bytes", {})
+            util = status.tpu_metrics.get("hbm_utilization", {})
+            writer.writerow([
+                status.concurrency if mode == "concurrency"
+                else status.request_rate,
+                round(status.throughput, 2),
+                round(status.latency_percentiles.get(50, 0), 1),
+                round(status.latency_percentiles.get(90, 0), 1),
+                round(status.latency_percentiles.get(95, 0), 1),
+                round(status.latency_percentiles.get(99, 0), 1),
+                round(status.avg_latency_us, 1),
+                round(status.std_latency_us, 1),
+                status.completed_count,
+                status.delayed_count,
+                status.error_count,
+                round(hbm.get("avg", 0) / 2**20, 2) if hbm else "",
+                round(hbm.get("max", 0) / 2**20, 2) if hbm else "",
+                round(util.get("avg", 0), 4) if util else "",
+            ])
+
+
+def export_profile(path: str, results: List[PerfStatus], model_name: str,
+                   service_kind: str = "triton", endpoint: str = "",
+                   mode: str = "concurrency") -> None:
+    """The profile-export JSON the LLM metrics layer parses (same
+    experiment/requests shape as the reference exporter)."""
+    experiments = []
+    for status in results:
+        requests = []
+        for record in status.records:
+            if not record.valid:
+                continue
+            requests.append({
+                "timestamp": record.start_ns,
+                "response_timestamps": list(record.end_ns),
+            })
+        experiments.append({
+            "experiment": {
+                "mode": mode,
+                "value": (
+                    status.concurrency if mode == "concurrency"
+                    else status.request_rate
+                ),
+            },
+            "requests": requests,
+            "window_boundaries": [status.window_start_ns,
+                                  status.window_end_ns],
+        })
+    doc = {
+        "version": "0.1",
+        "service_kind": service_kind,
+        "endpoint": endpoint,
+        "model": model_name,
+        "experiments": experiments,
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f)

@@ -1,0 +1,4 @@
+"""KServe-v2 protocol definitions: protobuf messages + gRPC service
+glue. Regenerate the ``*_pb2`` modules with ``regen.sh``."""
+
+from client_tpu.protocol import inference_pb2, model_config_pb2  # noqa: F401

@@ -1,0 +1,488 @@
+"""KServe-v2 HTTP/REST wire format: JSON + binary tensor extension.
+
+Shared by the HTTP client and the HTTP server front-end. The binary
+tensor protocol appends raw little-endian tensor buffers after the
+JSON header; ``Inference-Header-Content-Length`` tells the peer where
+JSON ends (reference http_client.cc:2130-2247 and the v2 binary-data
+extension).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from client_tpu._infer_common import (
+    InferInput,
+    InferRequestedOutput,
+    build_request_parameters,
+)
+from client_tpu.protocol import inference_pb2 as pb
+from client_tpu.utils import (
+    InferenceServerException,
+    deserialize_bf16_tensor,
+    deserialize_bytes_tensor,
+    np_to_wire_dtype,
+    serialize_bf16_tensor,
+    serialize_byte_tensor,
+    triton_to_np_dtype,
+)
+
+HEADER_LEN = "Inference-Header-Content-Length"
+
+
+# -- body compression (client and server sides) ----------------------------
+
+def compress_body(body: bytes, algorithm: str) -> bytes:
+    """gzip / deflate body compression ("deflate" is the zlib format,
+    per RFC 9110 §8.4.1)."""
+    if algorithm == "gzip":
+        import gzip
+
+        return gzip.compress(body)
+    if algorithm == "deflate":
+        import zlib
+
+        return zlib.compress(body)
+    raise InferenceServerException(
+        "unsupported compression algorithm '%s' (gzip or deflate)"
+        % algorithm
+    )
+
+
+def decompress_body(body: bytes, content_encoding: Optional[str]) -> bytes:
+    """Undoes Content-Encoding; identity/absent passes through."""
+    if not content_encoding or content_encoding == "identity":
+        return body
+    if content_encoding == "gzip":
+        import gzip
+
+        return gzip.decompress(body)
+    if content_encoding == "deflate":
+        import zlib
+
+        return zlib.decompress(body)
+    raise InferenceServerException(
+        "unsupported Content-Encoding '%s'" % content_encoding
+    )
+
+
+def _json_safe_param(value):
+    if isinstance(value, (bool, int, float, str)):
+        return value
+    raise InferenceServerException(
+        "unsupported parameter type %s" % type(value).__name__
+    )
+
+
+# -- request: client encode ------------------------------------------------
+
+
+def encode_infer_request(
+    inputs: Sequence[InferInput],
+    outputs: Optional[Sequence[InferRequestedOutput]] = None,
+    request_id: str = "",
+    sequence_id: int = 0,
+    sequence_start: bool = False,
+    sequence_end: bool = False,
+    priority: int = 0,
+    timeout: Optional[int] = None,
+    parameters: Optional[dict] = None,
+) -> Tuple[bytes, Optional[int]]:
+    """Build the POST body. Returns (body, json_header_length);
+    header length is None when no input travels as binary (pure JSON
+    body)."""
+    header: Dict = {}
+    if request_id:
+        header["id"] = request_id
+    params = build_request_parameters(
+        sequence_id=sequence_id,
+        sequence_start=sequence_start,
+        sequence_end=sequence_end,
+        priority=priority,
+        timeout=timeout,
+        parameters=parameters,
+    )
+    if params:
+        header["parameters"] = {k: _json_safe_param(v) for k, v in params.items()}
+
+    binary_blobs: List[bytes] = []
+    header_inputs = []
+    for infer_input in inputs:
+        infer_input.validate()
+        entry: Dict = {
+            "name": infer_input.name(),
+            "shape": infer_input.shape(),
+            "datatype": infer_input.datatype(),
+        }
+        tensor_params = {
+            k: _json_safe_param(v) for k, v in infer_input.parameters().items()
+        }
+        shm = infer_input.shared_memory()
+        if shm is not None:
+            region, byte_size, offset = shm
+            tensor_params["shared_memory_region"] = region
+            tensor_params["shared_memory_byte_size"] = byte_size
+            if offset:
+                tensor_params["shared_memory_offset"] = offset
+        else:
+            raw = infer_input.raw_data()
+            tensor_params["binary_data_size"] = len(raw)
+            binary_blobs.append(raw)
+        if tensor_params:
+            entry["parameters"] = tensor_params
+        header_inputs.append(entry)
+    header["inputs"] = header_inputs
+
+    if outputs:
+        header_outputs = []
+        for infer_output in outputs:
+            entry = {"name": infer_output.name()}
+            tensor_params = {
+                k: _json_safe_param(v)
+                for k, v in infer_output.parameters().items()
+            }
+            shm = infer_output.shared_memory()
+            if shm is not None:
+                region, byte_size, offset = shm
+                tensor_params["shared_memory_region"] = region
+                tensor_params["shared_memory_byte_size"] = byte_size
+                if offset:
+                    tensor_params["shared_memory_offset"] = offset
+            else:
+                tensor_params["binary_data"] = infer_output.binary_data()
+            if infer_output.class_count():
+                tensor_params["classification"] = infer_output.class_count()
+            if tensor_params:
+                entry["parameters"] = tensor_params
+            header_outputs.append(entry)
+        header["outputs"] = header_outputs
+
+    json_bytes = json.dumps(header).encode()
+    if binary_blobs:
+        return json_bytes + b"".join(binary_blobs), len(json_bytes)
+    return json_bytes, None
+
+
+# -- request: server decode ------------------------------------------------
+
+
+def decode_infer_request(
+    body: bytes,
+    model_name: str,
+    model_version: str = "",
+    header_length: Optional[int] = None,
+) -> pb.ModelInferRequest:
+    """Parse a POST /v2/models/<m>/infer body into the canonical
+    ModelInferRequest proto (raw_input_contents carries tensor data)."""
+    json_end = header_length if header_length is not None else len(body)
+    try:
+        header = json.loads(body[:json_end])
+    except json.JSONDecodeError as e:
+        raise InferenceServerException(
+            "malformed inference request JSON: %s" % e, status="INVALID_ARGUMENT"
+        )
+    request = pb.ModelInferRequest(
+        model_name=model_name, model_version=model_version
+    )
+    request.id = header.get("id", "")
+    for key, value in (header.get("parameters") or {}).items():
+        _set_pb_param(request.parameters[key], value)
+
+    binary_offset = json_end
+    for entry in header.get("inputs", []):
+        tensor = request.inputs.add()
+        tensor.name = entry.get("name", "")
+        tensor.datatype = entry.get("datatype", "")
+        tensor.shape.extend(int(d) for d in entry.get("shape", []))
+        params = entry.get("parameters") or {}
+        binary_size = params.pop("binary_data_size", None)
+        for key, value in params.items():
+            _set_pb_param(tensor.parameters[key], value)
+        if "shared_memory_region" in params:
+            continue
+        if binary_size is not None:
+            end = binary_offset + int(binary_size)
+            if end > len(body):
+                raise InferenceServerException(
+                    "binary input '%s' overruns request body" % tensor.name,
+                    status="INVALID_ARGUMENT",
+                )
+            request.raw_input_contents.append(bytes(body[binary_offset:end]))
+            binary_offset = end
+        elif "data" in entry:
+            request.raw_input_contents.append(
+                _json_data_to_raw(entry["data"], tensor.datatype, tensor.name)
+            )
+        else:
+            raise InferenceServerException(
+                "input '%s' has no data" % tensor.name,
+                status="INVALID_ARGUMENT",
+            )
+
+    for entry in header.get("outputs", []):
+        tensor = request.outputs.add()
+        tensor.name = entry.get("name", "")
+        params = entry.get("parameters") or {}
+        for key, value in params.items():
+            _set_pb_param(tensor.parameters[key], value)
+    return request
+
+
+def _set_pb_param(param: pb.InferParameter, value):
+    if isinstance(value, bool):
+        param.bool_param = value
+    elif isinstance(value, int):
+        param.int64_param = value
+    elif isinstance(value, float):
+        param.double_param = value
+    elif isinstance(value, str):
+        param.string_param = value
+    else:
+        raise InferenceServerException(
+            "unsupported parameter type %s" % type(value).__name__,
+            status="INVALID_ARGUMENT",
+        )
+
+
+def _json_data_to_raw(data, datatype: str, name: str) -> bytes:
+    """JSON "data" (nested or flat list) -> raw wire bytes."""
+    if datatype == "BYTES":
+        flat = np.array(data, dtype=np.object_).reshape(-1)
+        coerced = np.array(
+            [v.encode() if isinstance(v, str) else bytes(v) for v in flat],
+            dtype=np.object_,
+        )
+        return serialize_byte_tensor(coerced).tobytes()
+    np_dtype = triton_to_np_dtype(datatype)
+    if np_dtype is None:
+        raise InferenceServerException(
+            "input '%s' has unknown datatype '%s'" % (name, datatype),
+            status="INVALID_ARGUMENT",
+        )
+    if datatype == "BF16":
+        arr = np.array(data, dtype=np.float32)
+        return serialize_bf16_tensor(arr).tobytes()
+    return np.ascontiguousarray(np.array(data, dtype=np_dtype)).tobytes()
+
+
+# -- response: server encode ----------------------------------------------
+
+
+def encode_infer_response(
+    response: pb.ModelInferResponse,
+    binary_prefs: Dict[str, bool],
+    default_binary: bool = True,
+) -> Tuple[bytes, Optional[int]]:
+    """ModelInferResponse proto -> HTTP body. ``binary_prefs`` maps
+    output name -> requested binary_data flag."""
+    header: Dict = {
+        "model_name": response.model_name,
+        "model_version": response.model_version,
+    }
+    if response.id:
+        header["id"] = response.id
+    if response.parameters:
+        header["parameters"] = {
+            k: _pb_param_to_json(v) for k, v in response.parameters.items()
+        }
+    binary_blobs: List[bytes] = []
+    header_outputs = []
+    raw_idx = 0
+    for tensor in response.outputs:
+        entry: Dict = {
+            "name": tensor.name,
+            "datatype": tensor.datatype,
+            "shape": [int(d) for d in tensor.shape],
+        }
+        params = {k: _pb_param_to_json(v) for k, v in tensor.parameters.items()}
+        if "shared_memory_region" in tensor.parameters:
+            entry["parameters"] = params
+            header_outputs.append(entry)
+            continue
+        raw = response.raw_output_contents[raw_idx]
+        raw_idx += 1
+        use_binary = binary_prefs.get(tensor.name, default_binary)
+        if use_binary:
+            params["binary_data_size"] = len(raw)
+            binary_blobs.append(raw)
+            entry["parameters"] = params
+        else:
+            entry["data"] = _raw_to_json_data(raw, tensor.datatype)
+            if params:
+                entry["parameters"] = params
+        header_outputs.append(entry)
+    header["outputs"] = header_outputs
+    json_bytes = json.dumps(header).encode()
+    if binary_blobs:
+        return json_bytes + b"".join(binary_blobs), len(json_bytes)
+    return json_bytes, None
+
+
+def _pb_param_to_json(param: pb.InferParameter):
+    which = param.WhichOneof("parameter_choice")
+    return getattr(param, which) if which else None
+
+
+def _raw_to_json_data(raw: bytes, datatype: str):
+    if datatype == "BYTES":
+        arr = deserialize_bytes_tensor(raw)
+        out = []
+        for b in arr:
+            try:
+                out.append(b.decode("utf-8"))
+            except UnicodeDecodeError:
+                out.append(b.decode("latin-1"))
+        return out
+    if datatype == "BF16":
+        return [float(x) for x in deserialize_bf16_tensor(raw)]
+    arr = np.frombuffer(raw, dtype=triton_to_np_dtype(datatype))
+    if datatype in ("FP16", "FP32", "FP64"):
+        return [float(x) for x in arr]
+    if datatype == "BOOL":
+        return [bool(x) for x in arr]
+    return [int(x) for x in arr]
+
+
+# -- response: client decode ----------------------------------------------
+
+
+class DecodedOutput:
+    def __init__(self, name: str, datatype: str, shape, parameters: dict,
+                 raw: Optional[bytes], json_data):
+        self.name = name
+        self.datatype = datatype
+        self.shape = list(shape)
+        self.parameters = parameters
+        self.raw = raw
+        self.json_data = json_data
+
+    def as_numpy(self) -> Optional[np.ndarray]:
+        if self.raw is not None:
+            if self.datatype == "BYTES":
+                return deserialize_bytes_tensor(self.raw).reshape(self.shape)
+            if self.datatype == "BF16":
+                return deserialize_bf16_tensor(self.raw).reshape(self.shape)
+            return np.frombuffer(
+                self.raw, dtype=triton_to_np_dtype(self.datatype)
+            ).reshape(self.shape)
+        if self.json_data is not None:
+            if self.datatype == "BYTES":
+                flat = np.array(
+                    [
+                        v.encode() if isinstance(v, str) else bytes(v)
+                        for v in np.array(self.json_data, dtype=np.object_
+                                          ).reshape(-1)
+                    ],
+                    dtype=np.object_,
+                )
+                return flat.reshape(self.shape)
+            return np.array(
+                self.json_data, dtype=triton_to_np_dtype(self.datatype)
+            ).reshape(self.shape)
+        return None  # output lives in shared memory
+
+
+def decode_infer_response(
+    body: bytes, header_length: Optional[int] = None
+) -> Tuple[dict, Dict[str, DecodedOutput]]:
+    """HTTP body -> (response header dict, outputs by name)."""
+    json_end = header_length if header_length is not None else len(body)
+    try:
+        header = json.loads(body[:json_end])
+    except json.JSONDecodeError as e:
+        raise InferenceServerException(
+            "malformed inference response JSON: %s" % e
+        )
+    outputs: Dict[str, DecodedOutput] = {}
+    binary_offset = json_end
+    for entry in header.get("outputs", []):
+        params = entry.get("parameters") or {}
+        raw = None
+        if "binary_data_size" in params:
+            size = int(params["binary_data_size"])
+            raw = bytes(body[binary_offset : binary_offset + size])
+            if len(raw) != size:
+                raise InferenceServerException(
+                    "binary output '%s' truncated" % entry.get("name")
+                )
+            binary_offset += size
+        outputs[entry["name"]] = DecodedOutput(
+            name=entry["name"],
+            datatype=entry.get("datatype", ""),
+            shape=entry.get("shape", []),
+            parameters=params,
+            raw=raw,
+            json_data=entry.get("data"),
+        )
+    return header, outputs
+
+
+# -- generate extension (LLM convenience API) ------------------------------
+# JSON-by-input-name request bodies and flattened JSON responses,
+# shared by the aiohttp front-end and the embedded REST dispatcher.
+
+
+def build_generate_request(
+    model_inputs, model_name: str, model_version: str, body: bytes
+) -> pb.ModelInferRequest:
+    """Generate-extension JSON body -> ModelInferRequest: fields that
+    name a model input become tensors (scalars are wrapped), leftover
+    scalar fields become request parameters."""
+    try:
+        doc = json.loads(body)
+    except Exception as e:  # noqa: BLE001 — any parse failure is a 400
+        raise InferenceServerException(
+            "malformed generate request: %s" % e, status="INVALID_ARGUMENT"
+        )
+    if not isinstance(doc, dict):
+        raise InferenceServerException(
+            "generate request body must be a JSON object",
+            status="INVALID_ARGUMENT",
+        )
+    request = pb.ModelInferRequest(
+        model_name=model_name, model_version=model_version
+    )
+    for spec in model_inputs:
+        if spec.name not in doc:
+            continue
+        value = doc.pop(spec.name)
+        listed = value if isinstance(value, list) else [value]
+        tensor = request.inputs.add()
+        tensor.name = spec.name
+        tensor.datatype = spec.datatype
+        tensor.shape.extend([len(listed)])
+        try:
+            request.raw_input_contents.append(
+                _json_data_to_raw(listed, spec.datatype, spec.name)
+            )
+        except (TypeError, ValueError, OverflowError) as e:
+            raise InferenceServerException(
+                "invalid value for input '%s': %s" % (spec.name, e),
+                status="INVALID_ARGUMENT",
+            )
+    for key, value in doc.items():  # leftover fields -> parameters
+        if isinstance(value, (bool, int, float, str)):
+            _set_pb_param(request.parameters[key], value)
+    return request
+
+
+def generate_response_json(response: pb.ModelInferResponse) -> dict:
+    """ModelInferResponse -> the generate extension's flat JSON doc
+    (single-element tensors unwrap to scalars)."""
+    doc = {
+        "model_name": response.model_name,
+        "model_version": response.model_version,
+    }
+    raw_idx = 0
+    for tensor in response.outputs:
+        if raw_idx >= len(response.raw_output_contents):
+            continue
+        data = _raw_to_json_data(
+            response.raw_output_contents[raw_idx], tensor.datatype
+        )
+        raw_idx += 1
+        doc[tensor.name] = data[0] if len(data) == 1 else data
+    return doc

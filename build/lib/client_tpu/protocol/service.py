@@ -1,0 +1,106 @@
+"""Hand-written gRPC stub/servicer glue for GRPCInferenceService.
+
+The build image has grpcio but not grpc_tools, so instead of generated
+``*_pb2_grpc.py`` we declare the service surface once in _METHODS and
+derive both the client stub and the server registration from it.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from client_tpu.protocol import inference_pb2 as pb
+
+SERVICE_NAME = "inference.GRPCInferenceService"
+
+# (method, request type, response type, client-streaming, server-streaming)
+_METHODS = [
+    ("ServerLive", pb.ServerLiveRequest, pb.ServerLiveResponse, False, False),
+    ("ServerReady", pb.ServerReadyRequest, pb.ServerReadyResponse, False, False),
+    ("ModelReady", pb.ModelReadyRequest, pb.ModelReadyResponse, False, False),
+    ("ServerMetadata", pb.ServerMetadataRequest, pb.ServerMetadataResponse, False, False),
+    ("ModelMetadata", pb.ModelMetadataRequest, pb.ModelMetadataResponse, False, False),
+    ("ModelInfer", pb.ModelInferRequest, pb.ModelInferResponse, False, False),
+    ("ModelStreamInfer", pb.ModelInferRequest, pb.ModelStreamInferResponse, True, True),
+    ("ModelConfig", pb.ModelConfigRequest, pb.ModelConfigResponse, False, False),
+    ("ModelStatistics", pb.ModelStatisticsRequest, pb.ModelStatisticsResponse, False, False),
+    ("RepositoryIndex", pb.RepositoryIndexRequest, pb.RepositoryIndexResponse, False, False),
+    ("RepositoryModelLoad", pb.RepositoryModelLoadRequest, pb.RepositoryModelLoadResponse, False, False),
+    ("RepositoryModelUnload", pb.RepositoryModelUnloadRequest, pb.RepositoryModelUnloadResponse, False, False),
+    ("SystemSharedMemoryStatus", pb.SystemSharedMemoryStatusRequest, pb.SystemSharedMemoryStatusResponse, False, False),
+    ("SystemSharedMemoryRegister", pb.SystemSharedMemoryRegisterRequest, pb.SystemSharedMemoryRegisterResponse, False, False),
+    ("SystemSharedMemoryUnregister", pb.SystemSharedMemoryUnregisterRequest, pb.SystemSharedMemoryUnregisterResponse, False, False),
+    ("TpuSharedMemoryStatus", pb.TpuSharedMemoryStatusRequest, pb.TpuSharedMemoryStatusResponse, False, False),
+    ("TpuSharedMemoryRegister", pb.TpuSharedMemoryRegisterRequest, pb.TpuSharedMemoryRegisterResponse, False, False),
+    ("TpuSharedMemoryUnregister", pb.TpuSharedMemoryUnregisterRequest, pb.TpuSharedMemoryUnregisterResponse, False, False),
+    ("TraceSetting", pb.TraceSettingRequest, pb.TraceSettingResponse, False, False),
+    ("LogSettings", pb.LogSettingsRequest, pb.LogSettingsResponse, False, False),
+]
+
+
+class GRPCInferenceServiceStub:
+    """Client stub: one multicallable attribute per RPC, built against a
+    ``grpc.Channel`` or ``grpc.aio.Channel``."""
+
+    def __init__(self, channel):
+        for name, req_t, resp_t, cstream, sstream in _METHODS:
+            path = "/%s/%s" % (SERVICE_NAME, name)
+            if cstream and sstream:
+                factory = channel.stream_stream
+            elif sstream:
+                factory = channel.unary_stream
+            elif cstream:
+                factory = channel.stream_unary
+            else:
+                factory = channel.unary_unary
+            setattr(
+                self,
+                name,
+                factory(
+                    path,
+                    request_serializer=req_t.SerializeToString,
+                    response_deserializer=resp_t.FromString,
+                ),
+            )
+
+
+class GRPCInferenceServiceServicer:
+    """Base servicer; subclasses override the RPCs they implement."""
+
+    def _unimplemented(self, context):
+        context.set_code(grpc.StatusCode.UNIMPLEMENTED)
+        context.set_details("method not implemented")
+        raise NotImplementedError("method not implemented")
+
+
+def _make_default(name):
+    def handler(self, request, context):
+        self._unimplemented(context)
+
+    handler.__name__ = name
+    return handler
+
+
+for _name, _req, _resp, _cs, _ss in _METHODS:
+    setattr(GRPCInferenceServiceServicer, _name, _make_default(_name))
+
+
+def add_GRPCInferenceServiceServicer_to_server(servicer, server):
+    handlers = {}
+    for name, req_t, resp_t, cstream, sstream in _METHODS:
+        if cstream and sstream:
+            factory = grpc.stream_stream_rpc_method_handler
+        elif sstream:
+            factory = grpc.unary_stream_rpc_method_handler
+        elif cstream:
+            factory = grpc.stream_unary_rpc_method_handler
+        else:
+            factory = grpc.unary_unary_rpc_method_handler
+        handlers[name] = factory(
+            getattr(servicer, name),
+            request_deserializer=req_t.FromString,
+            response_serializer=resp_t.SerializeToString,
+        )
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),)
+    )

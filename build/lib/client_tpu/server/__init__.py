@@ -1,0 +1,6 @@
+"""JAX/XLA-backed KServe-v2 inference server: the integration-test
+fixture, co-located zero-copy serving peer, and benchmark target."""
+
+from client_tpu.server.core import InferenceServerCore  # noqa: F401
+from client_tpu.server.model import ServedModel, TensorSpec  # noqa: F401
+from client_tpu.server.repository import ModelRepository  # noqa: F401

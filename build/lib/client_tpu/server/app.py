@@ -1,0 +1,140 @@
+"""Server assembly + CLI: build a core with the builtin model zoo and
+serve it over gRPC (and HTTP once enabled).
+
+Run:  python -m client_tpu.server.app --grpc-port 8001 --models simple
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import threading
+import time
+from typing import Optional, Sequence
+
+from client_tpu.models import builtin_model_factories
+from client_tpu.server.core import InferenceServerCore
+from client_tpu.server.grpc_server import build_grpc_server
+from client_tpu.server.repository import ModelRepository
+
+
+def build_core(
+    load_models: Optional[Sequence[str]] = None,
+    tpu_arena=None,
+    warmup: bool = True,
+) -> InferenceServerCore:
+    repository = ModelRepository()
+    for name, factory in builtin_model_factories(repository).items():
+        repository.add_factory(name, factory)
+    if tpu_arena is None:
+        try:
+            from client_tpu.server.tpu_arena import TpuArena
+
+            tpu_arena = TpuArena()
+        except Exception:
+            tpu_arena = None  # no accelerator runtime available
+    core = InferenceServerCore(repository, tpu_arena=tpu_arena)
+    for name in load_models or ():
+        model = repository.load(name)
+        if warmup:
+            model.warmup()
+    return core
+
+
+class ServerHandle:
+    """A running gRPC (+ arena service) server endpoint."""
+
+    def __init__(self, core: InferenceServerCore, grpc_server, address: str):
+        self.core = core
+        self.grpc_server = grpc_server
+        self.address = address
+
+    def stop(self, grace: float = 1.0):
+        self.grpc_server.stop(grace)
+        self.core.shutdown()
+
+
+def start_grpc_server(
+    load_models: Optional[Sequence[str]] = None,
+    address: str = "127.0.0.1:0",
+    core: Optional[InferenceServerCore] = None,
+    max_workers: int = 96,
+    aio: Optional[bool] = None,
+) -> ServerHandle:
+    """Start a server on ``address`` (port 0 = ephemeral); returns a
+    handle with the bound address.
+
+    ``aio`` selects the asyncio-transport front-end (the default: it
+    clears ~1.8x the sync thread-pool server's request rate with the
+    same servicer); pass ``False`` — or set CLIENT_TPU_GRPC_AIO=0 — for
+    the classic sync server.
+    """
+    if aio is None:
+        aio = os.environ.get("CLIENT_TPU_GRPC_AIO", "1") != "0"
+    if core is None:
+        core = build_core(load_models)
+    extra = []
+    if core.memory.arena is not None:
+        from client_tpu.server.arena_service import arena_servicer_entry
+
+        extra.append(arena_servicer_entry(core.memory.arena))
+    host = address.rsplit(":", 1)[0]
+    if aio:
+        from client_tpu.server.grpc_server import AioGrpcServerThread
+
+        server = AioGrpcServerThread(core, address, extra_servicers=extra,
+                                     max_workers=max_workers)
+        port = server.port
+    else:
+        server = build_grpc_server(core, address=None,
+                                   max_workers=max_workers,
+                                   extra_servicers=extra)
+        port = server.add_insecure_port(address)
+        if port == 0:
+            raise RuntimeError("unable to bind %s" % address)
+        server.start()
+    return ServerHandle(core, server, "%s:%d" % (host, port))
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="client_tpu inference server")
+    parser.add_argument("--grpc-port", type=int, default=8001)
+    parser.add_argument("--http-port", type=int, default=8000)
+    parser.add_argument("--no-http", action="store_true")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument(
+        "--models", nargs="*", default=["simple"],
+        help="models to load at startup (others load on demand)",
+    )
+    args = parser.parse_args(argv)
+
+    core = build_core(args.models)
+    handle = start_grpc_server(
+        core=core, address="%s:%d" % (args.host, args.grpc_port)
+    )
+    print("gRPC server listening on %s" % handle.address, flush=True)
+    http_runner = None
+    if not args.no_http:
+        try:
+            from client_tpu.server.http_server import start_http_server_thread
+
+            http_runner = start_http_server_thread(
+                core, host=args.host, port=args.http_port
+            )
+            print(
+                "HTTP server listening on %s:%d" % (args.host, args.http_port),
+                flush=True,
+            )
+        except ImportError as e:
+            print("HTTP server unavailable: %s" % e, flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        handle.stop()
+        if http_runner is not None:
+            http_runner.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,119 @@
+"""gRPC glue for the TPU arena service (hosted on the same server
+port as the inference service)."""
+
+from __future__ import annotations
+
+import grpc
+
+from client_tpu.protocol import arena_pb2
+from client_tpu.server.tpu_arena import TpuArena
+from client_tpu.utils import InferenceServerException
+
+SERVICE_NAME = "inference.TpuArenaService"
+
+_METHODS = [
+    ("CreateRegion", arena_pb2.CreateRegionRequest,
+     arena_pb2.CreateRegionResponse),
+    ("WriteRegion", arena_pb2.WriteRegionRequest,
+     arena_pb2.WriteRegionResponse),
+    ("ReadRegion", arena_pb2.ReadRegionRequest,
+     arena_pb2.ReadRegionResponse),
+    ("DestroyRegion", arena_pb2.DestroyRegionRequest,
+     arena_pb2.DestroyRegionResponse),
+    ("ListRegions", arena_pb2.ListRegionsRequest,
+     arena_pb2.ListRegionsResponse),
+]
+
+_STATUS_MAP = {
+    "NOT_FOUND": grpc.StatusCode.NOT_FOUND,
+    "INVALID_ARGUMENT": grpc.StatusCode.INVALID_ARGUMENT,
+    "UNAVAILABLE": grpc.StatusCode.UNAVAILABLE,
+}
+
+
+class TpuArenaStub:
+    def __init__(self, channel):
+        for name, req_t, resp_t in _METHODS:
+            setattr(
+                self, name,
+                channel.unary_unary(
+                    "/%s/%s" % (SERVICE_NAME, name),
+                    request_serializer=req_t.SerializeToString,
+                    response_deserializer=resp_t.FromString,
+                ),
+            )
+
+
+class TpuArenaServicer:
+    def __init__(self, arena: TpuArena):
+        self._arena = arena
+
+    def _abort(self, context, error: InferenceServerException):
+        context.abort(
+            _STATUS_MAP.get(error.status() or "", grpc.StatusCode.INTERNAL),
+            error.message(),
+        )
+
+    def CreateRegion(self, request, context):
+        try:
+            raw_handle = self._arena.create_region(
+                request.byte_size, request.device_id
+            )
+            import json
+
+            region_id = json.loads(raw_handle)["region_id"]
+            return arena_pb2.CreateRegionResponse(
+                raw_handle=raw_handle, region_id=region_id
+            )
+        except InferenceServerException as e:
+            self._abort(context, e)
+
+    def WriteRegion(self, request, context):
+        try:
+            self._arena.write(
+                request.region_id, request.offset, request.data,
+                request.datatype, list(request.shape) or None,
+            )
+            return arena_pb2.WriteRegionResponse()
+        except InferenceServerException as e:
+            self._abort(context, e)
+
+    def ReadRegion(self, request, context):
+        try:
+            data = self._arena.read(
+                request.region_id, request.offset, request.byte_size
+            )
+            return arena_pb2.ReadRegionResponse(data=data)
+        except InferenceServerException as e:
+            self._abort(context, e)
+
+    def DestroyRegion(self, request, context):
+        self._arena.destroy_region(request.region_id)
+        return arena_pb2.DestroyRegionResponse()
+
+    def ListRegions(self, request, context):
+        response = arena_pb2.ListRegionsResponse()
+        for region_id, device_id, byte_size in self._arena.list_regions():
+            response.regions.add(
+                region_id=region_id, device_id=device_id, byte_size=byte_size
+            )
+        return response
+
+
+def add_TpuArenaServicer_to_server(servicer: TpuArenaServicer, server):
+    handlers = {}
+    for name, req_t, resp_t in _METHODS:
+        handlers[name] = grpc.unary_unary_rpc_method_handler(
+            getattr(servicer, name),
+            request_deserializer=req_t.FromString,
+            response_serializer=resp_t.SerializeToString,
+        )
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),)
+    )
+
+
+def arena_servicer_entry(arena: TpuArena):
+    """(add_fn, servicer) pair for build_grpc_server's
+    extra_servicers."""
+    return (add_TpuArenaServicer_to_server, TpuArenaServicer(arena))

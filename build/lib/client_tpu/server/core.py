@@ -1,0 +1,760 @@
+"""Transport-neutral inference server core.
+
+Executes KServe-v2 requests against a ModelRepository. Both the gRPC
+servicer and the HTTP app convert their wire forms to the protos in
+client_tpu.protocol and call into this core; the perf harness's
+in-process backend (the analogue of the reference's triton_c_api
+backend, /root/reference/src/c++/perf_analyzer/client_backend/
+triton_c_api/) calls it directly with no serialization at all.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+from client_tpu.protocol import inference_pb2 as pb
+from client_tpu.server.memory import SharedMemoryManager
+from client_tpu.server.model import ServedModel
+from client_tpu.server.repository import ModelRepository
+from client_tpu.utils import (
+    InferenceServerException,
+    deserialize_bf16_tensor,
+    deserialize_bytes_tensor,
+    np_to_wire_dtype,
+    serialize_bf16_tensor,
+    serialize_byte_tensor,
+    triton_to_np_dtype,
+)
+
+SERVER_NAME = "client_tpu_server"
+SERVER_VERSION = "0.1.0"
+SERVER_EXTENSIONS = [
+    "classification",
+    "sequence",
+    "model_repository",
+    "schedule_policy",
+    "model_configuration",
+    "system_shared_memory",
+    "tpu_shared_memory",
+    "binary_tensor_data",
+    "statistics",
+    "trace",
+    "logging",
+]
+
+
+class _ModelStats:
+    """Cumulative per-model counters backing ModelStatistics."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.inference_count = 0
+        self.execution_count = 0
+        self.success_count = 0
+        self.success_ns = 0
+        self.fail_count = 0
+        self.fail_ns = 0
+        self.queue_ns = 0
+        self.compute_input_ns = 0
+        self.compute_infer_ns = 0
+        self.compute_output_ns = 0
+        self.last_inference_ms = 0
+
+    def record(self, batch: int, queue_ns: int, ci_ns: int, infer_ns: int,
+               co_ns: int, ok: bool, executions: int = 1):
+        total = queue_ns + ci_ns + infer_ns + co_ns
+        with self.lock:
+            if ok:
+                self.inference_count += batch
+                self.execution_count += executions
+                self.success_count += 1
+                self.success_ns += total
+                self.queue_ns += queue_ns
+                self.compute_input_ns += ci_ns
+                self.compute_infer_ns += infer_ns
+                self.compute_output_ns += co_ns
+            else:
+                self.fail_count += 1
+                self.fail_ns += total
+            self.last_inference_ms = int(time.time() * 1000)
+
+
+def _param_value(param: pb.InferParameter):
+    which = param.WhichOneof("parameter_choice")
+    return getattr(param, which) if which else None
+
+
+class InferenceServerCore:
+    def __init__(self, repository: ModelRepository, tpu_arena=None):
+        self.repository = repository
+        self.memory = SharedMemoryManager(tpu_arena)
+        self._stats: Dict[str, _ModelStats] = {}
+        self._stats_lock = threading.Lock()
+        self._batchers: Dict[str, object] = {}
+        self._batchers_lock = threading.Lock()
+        self._trace_settings: Dict[str, Dict[str, list]] = {"": {
+            "trace_file": [""], "trace_level": ["OFF"], "trace_rate": ["1000"],
+            "trace_count": ["-1"], "log_frequency": ["0"],
+        }}
+        self._trace_state: Dict[str, dict] = {}
+        self._trace_lock = threading.Lock()
+        self._log_settings: Dict[str, object] = {
+            "log_file": "", "log_info": True, "log_warning": True,
+            "log_error": True, "log_verbose_level": 0, "log_format": "default",
+        }
+        self.ready = True
+
+    # -- health / metadata ----------------------------------------------
+
+    def server_live(self) -> bool:
+        return True
+
+    def server_ready(self) -> bool:
+        return self.ready
+
+    def model_ready(self, name: str, version: str = "") -> bool:
+        return self.repository.is_ready(name, version)
+
+    def server_metadata(self) -> pb.ServerMetadataResponse:
+        return pb.ServerMetadataResponse(
+            name=SERVER_NAME, version=SERVER_VERSION, extensions=SERVER_EXTENSIONS
+        )
+
+    def model_metadata(self, name: str, version: str = "") -> pb.ModelMetadataResponse:
+        return self.repository.get(name, version).metadata_pb()
+
+    def model_config(self, name: str, version: str = "") -> pb.ModelConfigResponse:
+        return pb.ModelConfigResponse(
+            config=self.repository.get(name, version).config_pb()
+        )
+
+    # -- statistics ------------------------------------------------------
+
+    def _stats_for(self, name: str) -> _ModelStats:
+        with self._stats_lock:
+            if name not in self._stats:
+                self._stats[name] = _ModelStats()
+            return self._stats[name]
+
+    def model_statistics(self, name: str = "", version: str = ""
+                         ) -> pb.ModelStatisticsResponse:
+        response = pb.ModelStatisticsResponse()
+        models = (
+            [self.repository.get(name, version)] if name
+            else self.repository.ready_models()
+        )
+        for model in models:
+            s = self._stats_for(model.name)
+            with s.lock:
+                stat = response.model_stats.add(
+                    name=model.name,
+                    version=model.version,
+                    last_inference=s.last_inference_ms,
+                    inference_count=s.inference_count,
+                    execution_count=s.execution_count,
+                )
+                stat.inference_stats.success.count = s.success_count
+                stat.inference_stats.success.ns = s.success_ns
+                stat.inference_stats.fail.count = s.fail_count
+                stat.inference_stats.fail.ns = s.fail_ns
+                stat.inference_stats.queue.count = s.success_count
+                stat.inference_stats.queue.ns = s.queue_ns
+                stat.inference_stats.compute_input.count = s.success_count
+                stat.inference_stats.compute_input.ns = s.compute_input_ns
+                stat.inference_stats.compute_infer.count = s.success_count
+                stat.inference_stats.compute_infer.ns = s.compute_infer_ns
+                stat.inference_stats.compute_output.count = s.success_count
+                stat.inference_stats.compute_output.ns = s.compute_output_ns
+        return response
+
+    def metrics_text(self) -> str:
+        """Prometheus exposition text (parity: the Triton /metrics
+        endpoint that perf MetricsManager scrapes, metrics_manager.h:56;
+        the DCGM GPU gauges map to TPU HBM gauges here)."""
+        lines = []
+
+        def family(name, kind, help_text, rows):
+            if not rows:
+                return
+            lines.append("# HELP %s %s" % (name, help_text))
+            lines.append("# TYPE %s %s" % (name, kind))
+            lines.extend(rows)
+
+        success, failure, count, exec_count, duration = [], [], [], [], []
+        with self._stats_lock:
+            stats_snapshot = dict(self._stats)
+        for name, s in sorted(stats_snapshot.items()):
+            label = '{model="%s",version="1"}' % name
+            with s.lock:
+                success.append("nv_inference_request_success%s %d"
+                               % (label, s.success_count))
+                failure.append("nv_inference_request_failure%s %d"
+                               % (label, s.fail_count))
+                count.append("nv_inference_count%s %d"
+                             % (label, s.inference_count))
+                exec_count.append("nv_inference_exec_count%s %d"
+                                  % (label, s.execution_count))
+                duration.append("nv_inference_request_duration_us%s %d"
+                                % (label, (s.success_ns + s.fail_ns) // 1000))
+        family("nv_inference_request_success", "counter",
+               "Number of successful inference requests", success)
+        family("nv_inference_request_failure", "counter",
+               "Number of failed inference requests", failure)
+        family("nv_inference_count", "counter",
+               "Number of inferences performed", count)
+        family("nv_inference_exec_count", "counter",
+               "Number of model executions performed", exec_count)
+        family("nv_inference_request_duration_us", "counter",
+               "Cumulative inference request duration", duration)
+
+        used_rows, total_rows, util_rows = [], [], []
+        try:
+            import jax
+
+            for device in jax.local_devices():
+                uuid = "%s-%d" % (device.platform.upper(), device.id)
+                label = '{tpu_uuid="%s"}' % uuid
+                mem = device.memory_stats() or {}
+                used = mem.get("bytes_in_use")
+                limit = mem.get("bytes_limit")
+                if used is not None:
+                    used_rows.append("tpu_hbm_used_bytes%s %d"
+                                     % (label, used))
+                if limit:
+                    total_rows.append("tpu_hbm_total_bytes%s %d"
+                                      % (label, limit))
+                    if used is not None:
+                        util_rows.append("tpu_hbm_utilization%s %.6f"
+                                         % (label, used / limit))
+        except Exception:
+            pass  # metrics must never take the server down
+        family("tpu_hbm_used_bytes", "gauge",
+               "Accelerator HBM bytes in use", used_rows)
+        family("tpu_hbm_total_bytes", "gauge",
+               "Accelerator HBM capacity in bytes", total_rows)
+        family("tpu_hbm_utilization", "gauge",
+               "Fraction of accelerator HBM in use", util_rows)
+        return "\n".join(lines) + "\n"
+
+    # -- trace / log settings -------------------------------------------
+
+    def _effective_trace_settings(self, model_name: str) -> Dict[str, list]:
+        return self._trace_settings.get(model_name) \
+            or self._trace_settings[""]
+
+    def trace_setting(self, model_name: str, updates: Dict[str, list]
+                      ) -> Dict[str, list]:
+        with self._trace_lock:
+            if updates:
+                # Flush every buffered state under its PRE-update
+                # settings (so records land in the file they were
+                # recorded for), then re-arm the sampling counters of
+                # the states the updated key governs (Triton re-arms
+                # trace_count on settings updates).
+                for name, state in self._trace_state.items():
+                    if state["buffer"]:
+                        self._flush_trace(
+                            name, self._effective_trace_settings(name),
+                            state)
+            settings = self._trace_settings.setdefault(
+                model_name, dict(self._trace_settings[""])
+            )
+            for key, value in updates.items():
+                if not value:  # clear -> revert to global
+                    settings[key] = list(
+                        self._trace_settings[""].get(key, []))
+                else:
+                    settings[key] = [str(v) for v in value]
+            if updates:
+                for name, state in self._trace_state.items():
+                    governed = name == model_name or (
+                        model_name == "" and name not in self._trace_settings)
+                    if governed:
+                        state["seen"] = 0
+                        state["emitted"] = 0
+        return settings
+
+    def _maybe_trace(self, model_name: str, request_id: str, t0: int,
+                     t1: int, t2: int, t3: int, queue_ns: int) -> None:
+        """Emits one timeline record per sampled request (Triton trace
+        semantics: trace_level != OFF enables, trace_rate samples 1-in-N,
+        trace_count caps, log_frequency batches file writes)."""
+        settings = self._effective_trace_settings(model_name)
+        level = (settings.get("trace_level") or ["OFF"])[0]
+        if level in ("", "OFF"):
+            return
+        if not (settings.get("trace_file") or [""])[0]:
+            # No sink configured: tracing stays off (Triton needs an
+            # explicit trace file too; an implicit cwd-relative
+            # default would litter the server's working directory).
+            return
+        try:
+            rate = max(1, int((settings.get("trace_rate") or ["1000"])[0]))
+            cap = int((settings.get("trace_count") or ["-1"])[0])
+            freq = int((settings.get("log_frequency") or ["0"])[0])
+        except ValueError:
+            return
+        with self._trace_lock:
+            state = self._trace_state.setdefault(
+                model_name, {"seen": 0, "emitted": 0, "next_id": 1,
+                             "buffer": []})
+            state["seen"] += 1
+            if (state["seen"] - 1) % rate != 0:
+                return
+            if 0 <= cap <= state["emitted"]:
+                return
+            state["emitted"] += 1
+            record = {
+                "id": state["next_id"],
+                "model_name": model_name,
+                "request_id": request_id,
+                "timestamps": [
+                    {"name": "REQUEST_START", "ns": t0},
+                    {"name": "QUEUE_START", "ns": t1},
+                    {"name": "COMPUTE_START", "ns": t1 + queue_ns},
+                    {"name": "COMPUTE_END", "ns": t2},
+                    {"name": "REQUEST_END", "ns": t3},
+                ],
+            }
+            state["next_id"] += 1
+            state["buffer"].append(record)
+            if len(state["buffer"]) >= max(1, freq):
+                self._flush_trace(model_name, settings, state)
+
+    def _flush_trace(self, model_name: str, settings: Dict[str, list],
+                     state: dict) -> None:
+        """Appends buffered records as JSON lines (caller holds
+        _trace_lock)."""
+        import json as _json
+
+        path = (settings.get("trace_file") or [""])[0]
+        records, state["buffer"] = state["buffer"], []
+        if not path:
+            return  # sink was never configured; drop silently
+        try:
+            with open(path, "a") as f:
+                for record in records:
+                    f.write(_json.dumps(record) + "\n")
+        except OSError:
+            pass  # tracing must never fail the request path
+
+    def log_settings(self, updates: Dict[str, object]) -> Dict[str, object]:
+        for key, value in updates.items():
+            self._log_settings[key] = value
+        return dict(self._log_settings)
+
+    # -- repository control ---------------------------------------------
+
+    def repository_index(self, ready_only: bool = False
+                         ) -> pb.RepositoryIndexResponse:
+        return self.repository.index(ready_only)
+
+    def load_model(self, name: str) -> None:
+        model = self.repository.load(name)
+        model.warmup()
+
+    def unload_model(self, name: str) -> None:
+        with self._batchers_lock:
+            batcher = self._batchers.pop(name, None)
+        if batcher is not None:
+            batcher.stop()
+        with self._trace_lock:
+            state = self._trace_state.get(name)
+            if state is not None and state["buffer"]:
+                self._flush_trace(
+                    name, self._effective_trace_settings(name), state)
+        self.repository.unload(name)
+
+    def shutdown(self) -> None:
+        """Teardown: stop batchers and flush buffered trace records —
+        log_frequency>0 buffers would otherwise silently drop the tail
+        of every trace file (Triton flushes on trace-file close)."""
+        with self._batchers_lock:
+            batchers, self._batchers = dict(self._batchers), {}
+        for batcher in batchers.values():
+            batcher.stop()
+        with self._trace_lock:
+            for name, state in self._trace_state.items():
+                if state["buffer"]:
+                    self._flush_trace(
+                        name, self._effective_trace_settings(name), state)
+
+    # -- inference -------------------------------------------------------
+
+    def _batcher_for(self, model):
+        """Lazily creates the model's dynamic batcher (None when the
+        model doesn't opt in)."""
+        from client_tpu.server.batcher import (
+            DynamicBatcher,
+            wants_dynamic_batching,
+        )
+
+        if not wants_dynamic_batching(model):
+            return None
+        with self._batchers_lock:
+            batcher = self._batchers.get(model.name)
+            if batcher is None:
+                batcher = DynamicBatcher(
+                    model,
+                    max_queue_delay_us=int(
+                        getattr(model, "max_queue_delay_us", 500)),
+                    preferred_batch_sizes=list(
+                        getattr(model, "preferred_batch_sizes", []) or []),
+                )
+                self._batchers[model.name] = batcher
+            return batcher
+
+    def _record_composing(self, name: str, count: int,
+                          compute_ns: int, executions: int = 1) -> None:
+        """Stats hook ensembles call per composing-step execution, so
+        composing models' per-window deltas are real (Triton records
+        composing executions through their own schedulers). Batched
+        steps pass executions=0 for non-leader riders."""
+        self._stats_for(name).record(count, 0, 0, compute_ns, 0, ok=True,
+                                     executions=executions)
+
+    def infer(self, request: pb.ModelInferRequest) -> pb.ModelInferResponse:
+        model = self.repository.get(request.model_name, request.model_version)
+        if getattr(model, "stats_recorder", False) is None:
+            model.stats_recorder = self._record_composing
+        if getattr(model, "batcher_resolver", False) is None:
+            # Composing steps route through each model's OWN dynamic
+            # batcher (Triton semantics: an ensemble step enters the
+            # composing model's scheduler), so concurrent ensemble
+            # requests fuse their backbone executions.
+            model.batcher_resolver = self._batcher_for
+        stats = self._stats_for(model.name)
+        t0 = time.monotonic_ns()
+        queue_ns = 0
+        executions = 1
+        try:
+            inputs, params = self._decode_inputs(model, request)
+            t1 = time.monotonic_ns()
+            batcher = self._batcher_for(model)
+            if batcher is not None and "sequence_id" not in params:
+                batch = self._batch_size(model, request)
+                outputs, queue_ns, leader = batcher.infer(
+                    inputs, params, batch)
+                # Fused requests share one model execution; only its
+                # leader bumps execution_count (Triton semantics).
+                executions = 1 if leader else 0
+            else:
+                outputs = model.infer(inputs, params)
+            t2 = time.monotonic_ns()
+            response = self._encode_response(model, request, outputs)
+            t3 = time.monotonic_ns()
+        except InferenceServerException:
+            stats.record(1, 0, 0, 0, time.monotonic_ns() - t0, ok=False)
+            raise
+        except Exception as e:
+            stats.record(1, 0, 0, 0, time.monotonic_ns() - t0, ok=False)
+            raise InferenceServerException(
+                "inference failed for model '%s': %s" % (model.name, e),
+                status="INTERNAL",
+            )
+        batch = self._batch_size(model, request)
+        stats.record(batch, queue_ns, t1 - t0, (t2 - t1) - queue_ns,
+                     t3 - t2, ok=True, executions=executions)
+        self._maybe_trace(model.name, request.id, t0, t1, t2, t3, queue_ns)
+        return response
+
+    def stream_infer(
+        self, request: pb.ModelInferRequest
+    ) -> Iterator[pb.ModelStreamInferResponse]:
+        """Decoupled execution: yields one ModelStreamInferResponse per
+        model response; the final response carries the
+        triton_final_response=true parameter (empty if the model
+        yielded nothing after its last data response and the client
+        asked for empty finals)."""
+        model = self.repository.get(request.model_name, request.model_version)
+        stats = self._stats_for(model.name)
+        want_empty_final = (
+            "triton_enable_empty_final_response" in request.parameters
+            and request.parameters[
+                "triton_enable_empty_final_response"
+            ].bool_param
+        )
+        t0 = time.monotonic_ns()
+        if not model.decoupled:
+            response = self.infer(request)
+            stream_response = pb.ModelStreamInferResponse()
+            stream_response.infer_response.CopyFrom(response)
+            stream_response.infer_response.parameters[
+                "triton_final_response"
+            ].bool_param = True
+            yield stream_response
+            return
+        try:
+            inputs, params = self._decode_inputs(model, request)
+            count = 0
+            pending = None  # buffer one ahead so the last data response
+            # can carry the final flag when empty finals are off
+            for out in model.infer_stream(inputs, params):
+                response = self._encode_response(model, request, out)
+                stream_response = pb.ModelStreamInferResponse()
+                stream_response.infer_response.CopyFrom(response)
+                stream_response.infer_response.parameters[
+                    "triton_final_response"
+                ].bool_param = False
+                count += 1
+                if pending is not None:
+                    yield pending
+                pending = stream_response
+            if want_empty_final or count == 0:
+                if pending is not None:
+                    yield pending
+                final = pb.ModelStreamInferResponse()
+                final.infer_response.model_name = model.name
+                final.infer_response.model_version = model.version
+                final.infer_response.id = request.id
+                final.infer_response.parameters[
+                    "triton_final_response"
+                ].bool_param = True
+                yield final
+            else:
+                pending.infer_response.parameters[
+                    "triton_final_response"
+                ].bool_param = True
+                yield pending
+            stats.record(max(count, 1), 0, 0, time.monotonic_ns() - t0, 0, ok=True)
+        except InferenceServerException as e:
+            stats.record(1, 0, 0, time.monotonic_ns() - t0, 0, ok=False)
+            yield pb.ModelStreamInferResponse(error_message=str(e))
+        except Exception as e:
+            stats.record(1, 0, 0, time.monotonic_ns() - t0, 0, ok=False)
+            yield pb.ModelStreamInferResponse(
+                error_message="inference failed: %s" % e
+            )
+
+    # -- shared memory verbs --------------------------------------------
+
+    def register_system_shm(self, name, key, offset, byte_size):
+        self.memory.register_system(name, key, offset, byte_size)
+
+    def unregister_system_shm(self, name):
+        self.memory.unregister_system(name)
+
+    def system_shm_status(self, name=""):
+        return self.memory.system_status(name)
+
+    def register_tpu_shm(self, name, raw_handle, device_id, byte_size):
+        self.memory.register_tpu(name, raw_handle, device_id, byte_size)
+
+    def unregister_tpu_shm(self, name):
+        self.memory.unregister_tpu(name)
+
+    def tpu_shm_status(self, name=""):
+        return self.memory.tpu_status(name)
+
+    # -- internals -------------------------------------------------------
+
+    def _batch_size(self, model: ServedModel, request: pb.ModelInferRequest) -> int:
+        if model.max_batch_size > 0 and request.inputs:
+            shape = request.inputs[0].shape
+            if shape:
+                return max(int(shape[0]), 1)
+        return 1
+
+    def _decode_inputs(self, model: ServedModel, request: pb.ModelInferRequest):
+        params = {k: _param_value(v) for k, v in request.parameters.items()}
+        inputs: Dict[str, np.ndarray] = {}
+        raw_idx = 0
+        for tensor in request.inputs:
+            spec = model.find_input(tensor.name)
+            if spec is None:
+                raise InferenceServerException(
+                    "unexpected inference input '%s' for model '%s'"
+                    % (tensor.name, model.name),
+                    status="INVALID_ARGUMENT",
+                )
+            if tensor.datatype != spec.datatype:
+                raise InferenceServerException(
+                    "input '%s' has datatype %s, model '%s' expects %s"
+                    % (tensor.name, tensor.datatype, model.name, spec.datatype),
+                    status="INVALID_ARGUMENT",
+                )
+            shape = [int(d) for d in tensor.shape]
+            unbatched = shape[1:] if model.max_batch_size > 0 else shape
+            if not spec.compatible_with(unbatched):
+                raise InferenceServerException(
+                    "input '%s' has shape %s, model '%s' expects %s%s"
+                    % (
+                        tensor.name,
+                        shape,
+                        model.name,
+                        "[batch] + " if model.max_batch_size > 0 else "",
+                        spec.shape,
+                    ),
+                    status="INVALID_ARGUMENT",
+                )
+            if "shared_memory_region" in tensor.parameters:
+                region = tensor.parameters["shared_memory_region"].string_param
+                byte_size = tensor.parameters[
+                    "shared_memory_byte_size"
+                ].int64_param
+                offset = (
+                    tensor.parameters["shared_memory_offset"].int64_param
+                    if "shared_memory_offset" in tensor.parameters
+                    else 0
+                )
+                inputs[tensor.name] = self.memory.read_input(
+                    region, byte_size, offset, tensor.datatype, shape
+                )
+            elif tensor.HasField("contents") and (
+                len(tensor.contents.bool_contents)
+                or len(tensor.contents.int_contents)
+                or len(tensor.contents.int64_contents)
+                or len(tensor.contents.uint_contents)
+                or len(tensor.contents.uint64_contents)
+                or len(tensor.contents.fp32_contents)
+                or len(tensor.contents.fp64_contents)
+                or len(tensor.contents.bytes_contents)
+            ):
+                inputs[tensor.name] = _from_contents(tensor, shape)
+            else:
+                if raw_idx >= len(request.raw_input_contents):
+                    raise InferenceServerException(
+                        "input '%s' has no data" % tensor.name,
+                        status="INVALID_ARGUMENT",
+                    )
+                raw = request.raw_input_contents[raw_idx]
+                raw_idx += 1
+                inputs[tensor.name] = _decode_raw(
+                    raw, tensor.datatype, shape, tensor.name
+                )
+        # missing non-optional inputs?
+        for spec in model.inputs:
+            if spec.name not in inputs and not spec.optional:
+                raise InferenceServerException(
+                    "input '%s' is required by model '%s'"
+                    % (spec.name, model.name),
+                    status="INVALID_ARGUMENT",
+                )
+        return inputs, params
+
+    def _encode_response(
+        self,
+        model: ServedModel,
+        request: pb.ModelInferRequest,
+        outputs: Dict[str, np.ndarray],
+    ) -> pb.ModelInferResponse:
+        response = pb.ModelInferResponse(
+            model_name=model.name, model_version=model.version, id=request.id
+        )
+        requested = list(request.outputs)
+        if not requested:
+            names = list(outputs.keys())
+        else:
+            names = [t.name for t in requested]
+        req_by_name = {t.name: t for t in requested}
+        for name in names:
+            if name not in outputs:
+                raise InferenceServerException(
+                    "unexpected inference output '%s' for model '%s'"
+                    % (name, model.name),
+                    status="INVALID_ARGUMENT",
+                )
+            value = outputs[name]
+            req = req_by_name.get(name)
+            cls_count = 0
+            if req is not None and "classification" in req.parameters:
+                cls_count = int(req.parameters["classification"].int64_param)
+            if cls_count:
+                value = _classification(np.asarray(value), cls_count)
+            arr = value
+            # dtype/shape come from the array metadata — never force a
+            # device->host transfer for shm-placed outputs
+            datatype = np_to_wire_dtype(arr.dtype)
+            tensor = response.outputs.add()
+            tensor.name = name
+            tensor.datatype = datatype
+            tensor.shape.extend(int(d) for d in arr.shape)
+            if req is not None and "shared_memory_region" in req.parameters:
+                region = req.parameters["shared_memory_region"].string_param
+                byte_size = req.parameters["shared_memory_byte_size"].int64_param
+                offset = (
+                    req.parameters["shared_memory_offset"].int64_param
+                    if "shared_memory_offset" in req.parameters
+                    else 0
+                )
+                written = self.memory.write_output(
+                    region, byte_size, offset, arr
+                )
+                tensor.parameters["shared_memory_region"].string_param = region
+                tensor.parameters["shared_memory_byte_size"].int64_param = written
+                if offset:
+                    tensor.parameters["shared_memory_offset"].int64_param = offset
+            else:
+                np_arr = arr if isinstance(arr, np.ndarray) else np.asarray(arr)
+                if datatype == "BYTES":
+                    raw = serialize_byte_tensor(np_arr).tobytes()
+                elif datatype == "BF16":
+                    raw = serialize_bf16_tensor(np_arr).tobytes()
+                else:
+                    raw = np.ascontiguousarray(np_arr).tobytes()
+                response.raw_output_contents.append(raw)
+        return response
+
+
+def _decode_raw(raw: bytes, datatype: str, shape, name: str) -> np.ndarray:
+    try:
+        if datatype == "BYTES":
+            return deserialize_bytes_tensor(raw).reshape(shape)
+        if datatype == "BF16":
+            return deserialize_bf16_tensor(raw).reshape(shape)
+        np_dtype = triton_to_np_dtype(datatype)
+        if np_dtype is None:
+            raise InferenceServerException(
+                "unknown datatype '%s'" % datatype, status="INVALID_ARGUMENT"
+            )
+        return np.frombuffer(raw, dtype=np_dtype).reshape(shape)
+    except ValueError as e:
+        raise InferenceServerException(
+            "unable to decode input '%s': %s" % (name, e),
+            status="INVALID_ARGUMENT",
+        )
+
+
+def _from_contents(tensor: pb.ModelInferRequest.InferInputTensor, shape):
+    c = tensor.contents
+    dt = tensor.datatype
+    if dt == "BOOL":
+        arr = np.array(c.bool_contents, dtype=np.bool_)
+    elif dt in ("INT8", "INT16", "INT32"):
+        arr = np.array(c.int_contents, dtype=triton_to_np_dtype(dt))
+    elif dt == "INT64":
+        arr = np.array(c.int64_contents, dtype=np.int64)
+    elif dt in ("UINT8", "UINT16", "UINT32"):
+        arr = np.array(c.uint_contents, dtype=triton_to_np_dtype(dt))
+    elif dt == "UINT64":
+        arr = np.array(c.uint64_contents, dtype=np.uint64)
+    elif dt in ("FP16", "FP32", "BF16"):
+        arr = np.array(c.fp32_contents, dtype=triton_to_np_dtype(dt))
+    elif dt == "FP64":
+        arr = np.array(c.fp64_contents, dtype=np.float64)
+    elif dt == "BYTES":
+        arr = np.array(list(c.bytes_contents), dtype=np.object_)
+    else:
+        raise InferenceServerException(
+            "unknown datatype '%s'" % dt, status="INVALID_ARGUMENT"
+        )
+    return arr.reshape(shape)
+
+
+def _classification(value: np.ndarray, k: int) -> np.ndarray:
+    """Top-k classification strings "score:index" over the last axis
+    (v2 classification extension)."""
+    flat = value.reshape(-1, value.shape[-1]) if value.ndim > 1 else value[None, :]
+    k = min(k, flat.shape[-1])
+    rows = []
+    for row in flat:
+        idx = np.argsort(row)[::-1][:k]
+        rows.append([("%f:%d" % (row[i], i)).encode() for i in idx])
+    out = np.array(rows, dtype=np.object_)
+    if value.ndim > 1:
+        return out.reshape(value.shape[:-1] + (k,))
+    return out.reshape(k)

@@ -1,0 +1,310 @@
+"""Embedding surface for hosting the server core inside a native
+process (no RPC).
+
+The C++ perf harness's ``--service-kind in_process`` backend embeds
+CPython, imports this module, and drives inference through the
+serialized-protobuf functions below — the TPU-native analogue of the
+reference's ``triton_c_api`` backend, which dlopens tritonserver and
+calls its C API directly
+(/root/reference/src/c++/perf_analyzer/client_backend/triton_c_api/
+triton_loader.cc:526-690). Keeping the exchange at proto-bytes level
+means the embedding layer needs no Python object marshalling beyond
+``bytes`` <-> ``std::string``.
+
+All functions are module-level and hold no GIL assumptions beyond the
+caller owning it for the duration of each call (PyGILState_Ensure in
+the C++ backend).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from client_tpu.protocol import inference_pb2 as pb
+
+_core = None
+
+
+def init(models_csv: str = "") -> None:
+    """Builds the server core and warms the named models (comma
+    separated; empty = registry defaults, loaded lazily)."""
+    global _core
+    if _core is not None:
+        return
+    from client_tpu.server.app import build_core
+
+    names = [m for m in models_csv.split(",") if m]
+    _core = build_core(names)
+
+
+def _require_core():
+    if _core is None:
+        raise RuntimeError("embed.init() has not been called")
+    return _core
+
+
+def infer(request_bytes: bytes) -> bytes:
+    """Serialized ModelInferRequest -> serialized ModelInferResponse.
+    Errors surface as InferenceServerException for the C++ layer to
+    format (message carries the [STATUS] prefix)."""
+    core = _require_core()
+    request = pb.ModelInferRequest()
+    request.ParseFromString(request_bytes)
+    return core.infer(request).SerializeToString()
+
+
+def server_metadata_json() -> str:
+    meta = _require_core().server_metadata()
+    return json.dumps({
+        "name": meta.name,
+        "version": meta.version,
+        "extensions": list(meta.extensions),
+    })
+
+
+def model_metadata_json(name: str, version: str = "") -> str:
+    meta = _require_core().model_metadata(name, version)
+    def tensors(specs):
+        return [{"name": t.name, "datatype": t.datatype,
+                 "shape": list(t.shape)} for t in specs]
+    return json.dumps({
+        "name": meta.name,
+        "versions": list(meta.versions),
+        "platform": meta.platform,
+        "inputs": tensors(meta.inputs),
+        "outputs": tensors(meta.outputs),
+    })
+
+
+def model_config_json(name: str, version: str = "") -> str:
+    response = _require_core().model_config(name, version)
+    from google.protobuf import json_format
+
+    # The bare config object (not the response wrapper), snake_case:
+    # the native ModelParser reads reference-wire keys like
+    # "max_batch_size" directly (model_parser.cc Parse).
+    return json_format.MessageToJson(
+        response.config, preserving_proto_field_name=True)
+
+
+def model_statistics_json(name: str = "") -> str:
+    # Hand-rolled (not json_format): protobuf JSON encodes (u)int64 as
+    # strings, which the native harness's numeric parsing rejects.
+    stats = _require_core().model_statistics(name, "")
+
+    def dur(d):
+        return {"count": d.count, "ns": d.ns}
+
+    return json.dumps({"model_stats": [
+        {
+            "name": m.name,
+            "version": m.version,
+            "inference_count": m.inference_count,
+            "execution_count": m.execution_count,
+            "inference_stats": {
+                "success": dur(m.inference_stats.success),
+                "fail": dur(m.inference_stats.fail),
+                "queue": dur(m.inference_stats.queue),
+                "compute_input": dur(m.inference_stats.compute_input),
+                "compute_infer": dur(m.inference_stats.compute_infer),
+                "compute_output": dur(m.inference_stats.compute_output),
+            },
+        }
+        for m in stats.model_stats
+    ]})
+
+
+def register_system_shared_memory(name: str, key: str, byte_size: int,
+                                  offset: int = 0) -> None:
+    _require_core().memory.register_system(name, key, offset, byte_size)
+
+
+def register_tpu_shared_memory(name: str, raw_handle: bytes,
+                               device_id: int, byte_size: int) -> None:
+    _require_core().memory.register_tpu(
+        name, raw_handle, device_id, byte_size)
+
+
+def unregister_system_shared_memory(name: str = "") -> None:
+    _require_core().memory.unregister_system(name or None)
+
+
+def unregister_tpu_shared_memory(name: str = "") -> None:
+    _require_core().memory.unregister_tpu(name or None)
+
+
+def tpu_arena_allocate(byte_size: int, device_id: int = 0) -> bytes:
+    """Allocates an HBM arena region in-process; returns the raw
+    handle bytes (what the gRPC arena service would return)."""
+    arena = _require_core().memory.arena
+    if arena is None:
+        from client_tpu.utils import InferenceServerException
+
+        raise InferenceServerException(
+            "server has no TPU arena; TPU shared memory unavailable",
+            status="UNAVAILABLE")
+    return arena.create_region(byte_size, device_id)
+
+
+def load_model(name: str) -> None:
+    _require_core().load_model(name)
+
+
+#==============================================================================
+# Generic gRPC dispatch: the native server front-end (native/server/)
+# terminates HTTP/2 + gRPC framing in C++ and forwards each call here
+# by its wire path, so transport and servicer logic stay in one place.
+
+class GrpcAbort(Exception):
+    """An RPC failure carrying the numeric gRPC status code. __str__
+    formats as "[GRPC:<code>] <details>" which the native bridge
+    parses back into (code, message) for the grpc-status trailer."""
+
+    def __init__(self, code: int, details: str):
+        super().__init__("[GRPC:%d] %s" % (code, details))
+        self.code = code
+        self.details = details
+
+
+class _AbortContext:
+    """Stand-in for grpc.ServicerContext: servicers only ever call
+    abort() (which must raise) on it."""
+
+    def abort(self, code, details):
+        raise GrpcAbort(code.value[0], details)
+
+    def set_code(self, code):  # pragma: no cover - servicers use abort
+        pass
+
+    def set_details(self, details):  # pragma: no cover
+        pass
+
+
+_registry = None  # path -> (request_cls, handler, server_streaming)
+
+
+def _grpc_registry():
+    global _registry
+    if _registry is not None:
+        return _registry
+    core = _require_core()
+    from client_tpu.protocol import service as svc
+    from client_tpu.server.grpc_server import InferenceServicer
+
+    servicer = InferenceServicer(core)
+    registry = {}
+    for name, req_t, _resp_t, _cstream, sstream in svc._METHODS:
+        path = "/%s/%s" % (svc.SERVICE_NAME, name)
+        registry[path] = (req_t, getattr(servicer, name), sstream)
+    if core.memory.arena is not None:
+        from client_tpu.server import arena_service
+
+        arena_servicer = arena_service.TpuArenaServicer(core.memory.arena)
+        for name, req_t, _resp_t in arena_service._METHODS:
+            path = "/%s/%s" % (arena_service.SERVICE_NAME, name)
+            registry[path] = (req_t, getattr(arena_servicer, name), False)
+    _registry = registry
+    return registry
+
+
+def grpc_method_kind(path: str) -> str:
+    """"unary", "stream", or "" for an unknown path."""
+    entry = _grpc_registry().get(path)
+    if entry is None:
+        return ""
+    return "stream" if entry[2] else "unary"
+
+
+def grpc_call(path: str, request_bytes: bytes) -> bytes:
+    """Dispatches one unary RPC by wire path; returns the serialized
+    response. Unknown paths / servicer aborts raise GrpcAbort."""
+    entry = _grpc_registry().get(path)
+    if entry is None or entry[2]:
+        raise GrpcAbort(12, "unknown or non-unary method %s" % path)
+    req_t, handler, _ = entry
+    request = req_t()
+    request.ParseFromString(request_bytes)
+    response = handler(request, _AbortContext())
+    return response.SerializeToString()
+
+
+def http_call(method: str, path: str, headers_json: str,
+              body: bytes) -> tuple:
+    """REST twin of grpc_call for the native HTTP/1.1 front-end:
+    returns (status:int, headers_json:str, body:bytes). Header names
+    in ``headers_json`` must be lower-cased by the transport."""
+    import json as _json
+
+    from client_tpu.server import http_embed
+
+    status, headers, payload = http_embed.http_call(
+        _require_core(), method, path,
+        _json.loads(headers_json) if headers_json else {}, body)
+    return status, _json.dumps(headers), payload
+
+
+def grpc_stream_call(path: str, request_bytes: bytes) -> list:
+    """Dispatches one message of a bidi-streaming RPC; returns the
+    list of serialized responses it produced. Stream RPCs here map
+    each request independently (ModelStreamInfer semantics), so no
+    cross-call session state is needed.
+
+    NOTE: this variant buffers — a decoupled model's full response
+    stream materializes before anything returns. The native transport
+    uses grpc_stream_call_emit for incremental delivery; this remains
+    for in-process callers that want the collected list.
+    """
+    entry = _grpc_registry().get(path)
+    if entry is None or not entry[2]:
+        raise GrpcAbort(12, "unknown or non-stream method %s" % path)
+    req_t, handler, _ = entry
+    request = req_t()
+    request.ParseFromString(request_bytes)
+    responses = handler(iter([request]), _AbortContext())
+    return [r.SerializeToString() for r in responses]
+
+
+def grpc_stream_call_emit(path: str, request_bytes: bytes, emit) -> None:
+    """Incremental twin of grpc_stream_call: calls ``emit(serialized)``
+    for each response as the handler produces it, so the native
+    front-end writes decoupled-model responses (LLM tokens) to the
+    wire one by one instead of in one end-of-generation burst. A
+    falsy return from ``emit`` means the peer is gone — stop
+    producing (the servicer's generator close() cancels the
+    underlying request)."""
+    entry = _grpc_registry().get(path)
+    if entry is None or not entry[2]:
+        raise GrpcAbort(12, "unknown or non-stream method %s" % path)
+    req_t, handler, _ = entry
+    request = req_t()
+    request.ParseFromString(request_bytes)
+    responses = handler(iter([request]), _AbortContext())
+    try:
+        for r in responses:
+            if not emit(r.SerializeToString()):
+                break
+    finally:
+        close = getattr(responses, "close", None)
+        if close is not None:
+            close()
+
+
+def shutdown() -> None:
+    """Unloads every ready model, then runs the core's process-level
+    teardown (batcher stop + buffered-trace flush) and drops the
+    core."""
+    global _core, _registry
+    _registry = None  # dispatch registry holds servicers bound to _core
+    if _core is None:
+        return
+    core, _core = _core, None
+    for name in [m.name for m in core.repository.ready_models()]:
+        try:
+            core.unload_model(name)
+        except Exception:  # noqa: BLE001 — teardown must not raise
+            pass
+    try:
+        core.shutdown()
+    except Exception:  # noqa: BLE001
+        pass

@@ -1,0 +1,327 @@
+"""Framework-agnostic KServe-v2 REST dispatch for embedded hosts.
+
+The native HTTP front-end (native/server/http1_server.cc inside
+tpu_serverd) terminates HTTP/1.1 in C++ and forwards each request here
+as (method, path, headers, body) -> (status, headers, body) — the REST
+twin of embed.grpc_call. The route surface mirrors the aiohttp server
+(client_tpu/server/http_server.py) except the streaming endpoints —
+generate_stream and the OpenAI SSE APIs need chunked responses, so the
+aiohttp front-end remains the home for those (non-streaming generate
+IS served here).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Dict, Optional, Tuple
+
+from google.protobuf import json_format
+
+from client_tpu.protocol.http_wire import (
+    compress_body,
+    decode_infer_request,
+    decompress_body,
+    encode_infer_response,
+)
+from client_tpu.utils import InferenceServerException
+
+HEADER_LEN = "Inference-Header-Content-Length"
+
+_STATUS_HTTP = {
+    "NOT_FOUND": 404,
+    "INVALID_ARGUMENT": 400,
+    "ALREADY_EXISTS": 409,
+    "UNAVAILABLE": 503,
+    "UNIMPLEMENTED": 501,
+    "INTERNAL": 500,
+}
+
+Reply = Tuple[int, Dict[str, str], bytes]
+
+
+def _json_reply(obj, status: int = 200) -> Reply:
+    return (status, {"Content-Type": "application/json"},
+            json.dumps(obj).encode())
+
+
+def _int64_lists_to_ints(obj):
+    """proto3 JSON stringifies int64 ("shape": ["16"]); the KServe
+    REST spec wants integers. Fix shape/dims lists recursively."""
+    if isinstance(obj, dict):
+        return {
+            key: ([int(d) for d in value]
+                  if key in ("shape", "dims") and isinstance(value, list)
+                  and all(isinstance(d, str) and d.lstrip("-").isdigit()
+                          for d in value)
+                  else _int64_lists_to_ints(value))
+            for key, value in obj.items()
+        }
+    if isinstance(obj, list):
+        return [_int64_lists_to_ints(v) for v in obj]
+    return obj
+
+
+def _pb_reply(message) -> Reply:
+    return _json_reply(_int64_lists_to_ints(
+        json_format.MessageToDict(message, preserving_proto_field_name=True)))
+
+
+def _error_reply(error: InferenceServerException) -> Reply:
+    return _json_reply({"error": error.message()},
+                       _STATUS_HTTP.get(error.status() or "", 500))
+
+
+def _pick_encoding(accept_encoding: str) -> Optional[str]:
+    for token in accept_encoding.split(","):
+        parts = token.strip().lower().split(";")
+        coding = parts[0].strip()
+        if coding not in ("gzip", "deflate"):
+            continue
+        refused = any(
+            p.strip().replace(" ", "") in ("q=0", "q=0.0", "q=0.00",
+                                           "q=0.000")
+            for p in parts[1:]
+        )
+        if not refused:
+            return coding
+    return None
+
+
+_ROUTES = []  # (method, compiled pattern, handler(core, m, headers, body))
+
+
+def _route(method: str, pattern: str):
+    compiled = re.compile("^" + pattern + "$")
+
+    def register(fn):
+        _ROUTES.append((method, compiled, fn))
+        return fn
+
+    return register
+
+
+_MODEL = r"/v2/models/(?P<model>[^/]+)(?:/versions/(?P<version>[^/]+))?"
+
+
+@_route("GET", r"/v2/health/live")
+def _live(core, m, headers, body):
+    return (200 if core.server_live() else 400), {}, b""
+
+
+@_route("GET", r"/v2/health/ready")
+def _ready(core, m, headers, body):
+    return (200 if core.server_ready() else 400), {}, b""
+
+
+@_route("GET", _MODEL + r"/ready")
+def _model_ready(core, m, headers, body):
+    ready = core.model_ready(m.group("model"), m.group("version") or "")
+    return (200 if ready else 400), {}, b""
+
+
+@_route("GET", r"/metrics")
+def _metrics(core, m, headers, body):
+    text = core.metrics_text()
+    return 200, {"Content-Type": "text/plain; version=0.0.4"}, text.encode()
+
+
+@_route("GET", r"/v2")
+def _server_metadata(core, m, headers, body):
+    return _pb_reply(core.server_metadata())
+
+
+@_route("GET", _MODEL + r"/config")
+def _model_config(core, m, headers, body):
+    response = core.model_config(m.group("model"), m.group("version") or "")
+    return _pb_reply(response.config)
+
+
+@_route("GET", _MODEL + r"/stats")
+def _model_stats(core, m, headers, body):
+    return _pb_reply(core.model_statistics(
+        m.group("model"), m.group("version") or ""))
+
+
+@_route("GET", r"/v2/models/stats")
+def _all_stats(core, m, headers, body):
+    return _pb_reply(core.model_statistics("", ""))
+
+
+@_route("GET", _MODEL)
+def _model_metadata(core, m, headers, body):
+    return _pb_reply(core.model_metadata(
+        m.group("model"), m.group("version") or ""))
+
+
+@_route("POST", r"/v2/repository/index")
+def _repo_index(core, m, headers, body):
+    payload = json.loads(body) if body else {}
+    index = core.repository_index(bool(payload.get("ready", False)))
+    return _json_reply([
+        {"name": entry.name, "version": entry.version,
+         "state": entry.state, "reason": entry.reason}
+        for entry in index.models
+    ])
+
+
+@_route("POST", r"/v2/repository/models/(?P<model>[^/]+)/load")
+def _repo_load(core, m, headers, body):
+    core.load_model(m.group("model"))
+    return 200, {}, b""
+
+
+@_route("POST", r"/v2/repository/models/(?P<model>[^/]+)/unload")
+def _repo_unload(core, m, headers, body):
+    core.unload_model(m.group("model"))
+    return 200, {}, b""
+
+
+@_route("GET", r"/v2/systemsharedmemory(?:/region/(?P<name>[^/]+))?/status")
+def _sys_shm_status(core, m, headers, body):
+    status = core.system_shm_status(m.group("name") or "")
+    return _json_reply([
+        {"name": region.name, "key": region.key,
+         "offset": region.offset, "byte_size": region.byte_size}
+        for region in status.regions.values()
+    ])
+
+
+@_route("POST", r"/v2/systemsharedmemory/region/(?P<name>[^/]+)/register")
+def _sys_shm_register(core, m, headers, body):
+    payload = json.loads(body)
+    core.register_system_shm(
+        m.group("name"), payload["key"], int(payload.get("offset", 0)),
+        int(payload["byte_size"]))
+    return 200, {}, b""
+
+
+@_route("POST",
+        r"/v2/systemsharedmemory(?:/region/(?P<name>[^/]+))?/unregister")
+def _sys_shm_unregister(core, m, headers, body):
+    core.unregister_system_shm(m.group("name") or "")
+    return 200, {}, b""
+
+
+@_route("GET", r"/v2/tpusharedmemory(?:/region/(?P<name>[^/]+))?/status")
+def _tpu_shm_status(core, m, headers, body):
+    status = core.tpu_shm_status(m.group("name") or "")
+    return _json_reply([
+        {"name": region.name, "device_id": region.device_id,
+         "byte_size": region.byte_size}
+        for region in status.regions.values()
+    ])
+
+
+@_route("POST", r"/v2/tpusharedmemory/region/(?P<name>[^/]+)/register")
+def _tpu_shm_register(core, m, headers, body):
+    import base64
+
+    payload = json.loads(body)
+    raw = payload.get("raw_handle", {}).get("b64", "")
+    core.register_tpu_shm(
+        m.group("name"), base64.b64decode(raw),
+        int(payload.get("device_id", 0)), int(payload["byte_size"]))
+    return 200, {}, b""
+
+
+@_route("POST",
+        r"/v2/tpusharedmemory(?:/region/(?P<name>[^/]+))?/unregister")
+def _tpu_shm_unregister(core, m, headers, body):
+    core.unregister_tpu_shm(m.group("name") or "")
+    return 200, {}, b""
+
+
+@_route("GET", r"/v2(?:/models/(?P<model>[^/]+))?/trace/setting")
+def _get_trace(core, m, headers, body):
+    settings = core.trace_setting(m.group("model") or "", {})
+    return _json_reply(
+        {k: v if len(v) != 1 else v[0] for k, v in settings.items()})
+
+
+@_route("POST", r"/v2(?:/models/(?P<model>[^/]+))?/trace/setting")
+def _post_trace(core, m, headers, body):
+    updates = {
+        k: (v if isinstance(v, list) else [v]) if v is not None else []
+        for k, v in json.loads(body).items()
+    }
+    settings = core.trace_setting(m.group("model") or "", updates)
+    return _json_reply(
+        {k: v if len(v) != 1 else v[0] for k, v in settings.items()})
+
+
+@_route("GET", r"/v2/logging")
+def _get_logging(core, m, headers, body):
+    return _json_reply(core.log_settings({}))
+
+
+@_route("POST", r"/v2/logging")
+def _post_logging(core, m, headers, body):
+    return _json_reply(core.log_settings(json.loads(body)))
+
+
+@_route("POST", _MODEL + r"/generate")
+def _generate(core, m, headers, body):
+    """Non-streaming generate extension (JSON in, JSON out); the SSE
+    generate_stream variant stays on the aiohttp front-end."""
+    from client_tpu.protocol.http_wire import (
+        build_generate_request,
+        generate_response_json,
+    )
+
+    body = decompress_body(body, headers.get("content-encoding"))
+    model = core.repository.get(m.group("model"))
+    infer_request = build_generate_request(
+        model.inputs, m.group("model"), m.group("version") or "", body)
+    return _json_reply(generate_response_json(core.infer(infer_request)))
+
+
+@_route("POST", _MODEL + r"/infer")
+def _infer(core, m, headers, body):
+    body = decompress_body(body, headers.get("content-encoding"))
+    header_length = headers.get(HEADER_LEN.lower())
+    infer_request = decode_infer_request(
+        body, m.group("model"), m.group("version") or "",
+        int(header_length) if header_length else None)
+    response = core.infer(infer_request)
+    binary_prefs = {}
+    default_binary = False
+    for tensor in infer_request.outputs:
+        if "binary_data" in tensor.parameters:
+            binary_prefs[tensor.name] = \
+                tensor.parameters["binary_data"].bool_param
+    if "binary_data_output" in infer_request.parameters:
+        default_binary = \
+            infer_request.parameters["binary_data_output"].bool_param
+    payload, json_len = encode_infer_response(
+        response, binary_prefs, default_binary)
+    reply_headers = {"Content-Type": "application/octet-stream"
+                     if json_len is not None else "application/json"}
+    if json_len is not None:
+        reply_headers[HEADER_LEN] = str(json_len)
+    algorithm = _pick_encoding(headers.get("accept-encoding", ""))
+    if algorithm:
+        payload = compress_body(payload, algorithm)
+        reply_headers["Content-Encoding"] = algorithm
+    return 200, reply_headers, payload
+
+
+def http_call(core, method: str, path: str, headers: Dict[str, str],
+              body: bytes) -> Reply:
+    """Dispatches one REST call; header names must be lower-cased by
+    the caller. Unknown paths return 404, servicer errors map to the
+    KServe error-JSON convention."""
+    for route_method, pattern, handler in _ROUTES:
+        if route_method != method:
+            continue
+        m = pattern.match(path)
+        if m is None:
+            continue
+        try:
+            return handler(core, m, headers, body)
+        except InferenceServerException as e:
+            return _error_reply(e)
+        except Exception as e:  # noqa: BLE001 — malformed body etc.
+            return _json_reply({"error": str(e)}, 400)
+    return _json_reply({"error": "unknown route %s %s" % (method, path)},
+                       404)

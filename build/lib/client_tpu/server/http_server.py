@@ -1,0 +1,680 @@
+"""HTTP/REST front-end (aiohttp) for the inference server core.
+
+Implements the KServe-v2 REST surface incl. the binary tensor
+extension and the shared-memory extension endpoints, mirroring the
+URI scheme the reference client talks to (http_client.cc /v2/...).
+Runs either on an existing asyncio loop or in a dedicated thread.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Optional
+
+from aiohttp import web
+from google.protobuf import json_format
+
+from client_tpu.protocol import inference_pb2 as pb
+from client_tpu.protocol.http_wire import (
+    HEADER_LEN,
+    compress_body,
+    decode_infer_request,
+    encode_infer_response,
+)
+from client_tpu.server.core import InferenceServerCore
+from client_tpu.utils import InferenceServerException
+
+_STATUS_HTTP = {
+    "NOT_FOUND": 404,
+    "INVALID_ARGUMENT": 400,
+    "ALREADY_EXISTS": 409,
+    "UNAVAILABLE": 503,
+    "INTERNAL": 500,
+    "UNIMPLEMENTED": 501,
+}
+
+
+def _error_response(error: InferenceServerException) -> web.Response:
+    return web.json_response(
+        {"error": error.message()},
+        status=_STATUS_HTTP.get(error.status() or "", 500),
+    )
+
+
+def _pb_json(message) -> web.Response:
+    from client_tpu.server.http_embed import _int64_lists_to_ints
+
+    return web.json_response(_int64_lists_to_ints(
+        json_format.MessageToDict(message, preserving_proto_field_name=True)
+    ))
+
+
+# RFC 9110 Accept-Encoding negotiation shared with the native REST
+# front-end's dispatcher.
+from client_tpu.server.http_embed import _pick_encoding  # noqa: E402
+
+
+def build_http_app(core: InferenceServerCore) -> web.Application:
+    routes = web.RouteTableDef()
+
+    def _run(fn, *args):
+        """Execute a synchronous core call off the event loop."""
+        return asyncio.get_running_loop().run_in_executor(None, fn, *args)
+
+    @routes.get("/v2/health/live")
+    async def health_live(request):
+        return web.Response(status=200 if core.server_live() else 400)
+
+    @routes.get("/v2/health/ready")
+    async def health_ready(request):
+        return web.Response(status=200 if core.server_ready() else 400)
+
+    @routes.get("/v2/models/{model}/ready")
+    @routes.get("/v2/models/{model}/versions/{version}/ready")
+    async def model_ready(request):
+        ready = core.model_ready(
+            request.match_info["model"], request.match_info.get("version", "")
+        )
+        return web.Response(status=200 if ready else 400)
+
+    @routes.get("/metrics")
+    async def metrics(request):
+        text = await _run(core.metrics_text)
+        return web.Response(text=text,
+                            content_type="text/plain", charset="utf-8")
+
+    @routes.get("/v2")
+    async def server_metadata(request):
+        return _pb_json(core.server_metadata())
+
+    @routes.get("/v2/models/{model}")
+    @routes.get("/v2/models/{model}/versions/{version}")
+    async def model_metadata(request):
+        try:
+            return _pb_json(
+                core.model_metadata(
+                    request.match_info["model"],
+                    request.match_info.get("version", ""),
+                )
+            )
+        except InferenceServerException as e:
+            return _error_response(e)
+
+    @routes.get("/v2/models/{model}/config")
+    @routes.get("/v2/models/{model}/versions/{version}/config")
+    async def model_config(request):
+        try:
+            response = core.model_config(
+                request.match_info["model"],
+                request.match_info.get("version", ""),
+            )
+            return _pb_json(response.config)
+        except InferenceServerException as e:
+            return _error_response(e)
+
+    @routes.get("/v2/models/stats")
+    @routes.get("/v2/models/{model}/stats")
+    @routes.get("/v2/models/{model}/versions/{version}/stats")
+    async def model_stats(request):
+        try:
+            return _pb_json(
+                core.model_statistics(
+                    request.match_info.get("model", ""),
+                    request.match_info.get("version", ""),
+                )
+            )
+        except InferenceServerException as e:
+            return _error_response(e)
+
+    @routes.post("/v2/repository/index")
+    async def repository_index(request):
+        body = await request.json() if request.can_read_body else {}
+        index = core.repository_index(bool(body.get("ready", False)))
+        return web.json_response(
+            [
+                {
+                    "name": m.name,
+                    "version": m.version,
+                    "state": m.state,
+                    "reason": m.reason,
+                }
+                for m in index.models
+            ]
+        )
+
+    @routes.post("/v2/repository/models/{model}/load")
+    async def repository_load(request):
+        try:
+            await _run(core.load_model, request.match_info["model"])
+            return web.Response(status=200)
+        except InferenceServerException as e:
+            return _error_response(e)
+
+    @routes.post("/v2/repository/models/{model}/unload")
+    async def repository_unload(request):
+        try:
+            await _run(core.unload_model, request.match_info["model"])
+            return web.Response(status=200)
+        except InferenceServerException as e:
+            return _error_response(e)
+
+    # -- shared memory ---------------------------------------------------
+
+    @routes.get("/v2/systemsharedmemory/status")
+    @routes.get("/v2/systemsharedmemory/region/{name}/status")
+    async def system_shm_status(request):
+        status = core.system_shm_status(request.match_info.get("name", ""))
+        return web.json_response(
+            [
+                {
+                    "name": r.name,
+                    "key": r.key,
+                    "offset": r.offset,
+                    "byte_size": r.byte_size,
+                }
+                for r in status.regions.values()
+            ]
+        )
+
+    @routes.post("/v2/systemsharedmemory/region/{name}/register")
+    async def system_shm_register(request):
+        try:
+            body = await request.json()
+            core.register_system_shm(
+                request.match_info["name"],
+                body["key"],
+                int(body.get("offset", 0)),
+                int(body["byte_size"]),
+            )
+            return web.Response(status=200)
+        except KeyError as e:
+            return web.json_response(
+                {"error": "missing field %s" % e}, status=400
+            )
+        except InferenceServerException as e:
+            return _error_response(e)
+
+    @routes.post("/v2/systemsharedmemory/unregister")
+    @routes.post("/v2/systemsharedmemory/region/{name}/unregister")
+    async def system_shm_unregister(request):
+        try:
+            core.unregister_system_shm(request.match_info.get("name", ""))
+            return web.Response(status=200)
+        except InferenceServerException as e:
+            return _error_response(e)
+
+    @routes.get("/v2/tpusharedmemory/status")
+    @routes.get("/v2/tpusharedmemory/region/{name}/status")
+    async def tpu_shm_status(request):
+        status = core.tpu_shm_status(request.match_info.get("name", ""))
+        return web.json_response(
+            [
+                {
+                    "name": r.name,
+                    "device_id": r.device_id,
+                    "byte_size": r.byte_size,
+                }
+                for r in status.regions.values()
+            ]
+        )
+
+    @routes.post("/v2/tpusharedmemory/region/{name}/register")
+    async def tpu_shm_register(request):
+        import base64
+
+        try:
+            body = await request.json()
+            raw_handle = base64.b64decode(body["raw_handle"]["b64"])
+            core.register_tpu_shm(
+                request.match_info["name"],
+                raw_handle,
+                int(body.get("device_id", 0)),
+                int(body["byte_size"]),
+            )
+            return web.Response(status=200)
+        except (KeyError, TypeError, ValueError) as e:
+            return web.json_response(
+                {"error": "malformed register request: %s" % e}, status=400
+            )
+        except InferenceServerException as e:
+            return _error_response(e)
+
+    @routes.post("/v2/tpusharedmemory/unregister")
+    @routes.post("/v2/tpusharedmemory/region/{name}/unregister")
+    async def tpu_shm_unregister(request):
+        try:
+            core.unregister_tpu_shm(request.match_info.get("name", ""))
+            return web.Response(status=200)
+        except InferenceServerException as e:
+            return _error_response(e)
+
+    # -- trace / logging -------------------------------------------------
+
+    @routes.get("/v2/trace/setting")
+    @routes.get("/v2/models/{model}/trace/setting")
+    async def get_trace(request):
+        settings = core.trace_setting(request.match_info.get("model", ""), {})
+        return web.json_response(
+            {k: v if len(v) != 1 else v[0] for k, v in settings.items()}
+        )
+
+    @routes.post("/v2/trace/setting")
+    @routes.post("/v2/models/{model}/trace/setting")
+    async def post_trace(request):
+        body = await request.json()
+        updates = {
+            k: (v if isinstance(v, list) else [v]) if v is not None else []
+            for k, v in body.items()
+        }
+        settings = core.trace_setting(request.match_info.get("model", ""),
+                                      updates)
+        return web.json_response(
+            {k: v if len(v) != 1 else v[0] for k, v in settings.items()}
+        )
+
+    @routes.get("/v2/logging")
+    async def get_logging(request):
+        return web.json_response(core.log_settings({}))
+
+    @routes.post("/v2/logging")
+    async def post_logging(request):
+        body = await request.json()
+        return web.json_response(core.log_settings(body))
+
+    # -- generate (LLM extension) ---------------------------------------
+
+    def _generate_request(request, body: bytes):
+        """JSON body fields -> ModelInferRequest tensors by input name
+        (shared codec: http_wire.build_generate_request)."""
+        from client_tpu.protocol.http_wire import build_generate_request
+
+        model_name = request.match_info["model"]
+        model = core.repository.get(model_name)
+        return build_generate_request(
+            model.inputs, model_name,
+            request.match_info.get("version", ""), body)
+
+    def _generate_json(response: pb.ModelInferResponse) -> dict:
+        from client_tpu.protocol.http_wire import generate_response_json
+
+        return generate_response_json(response)
+
+    @routes.post("/v2/models/{model}/generate")
+    @routes.post("/v2/models/{model}/versions/{version}/generate")
+    async def generate(request):
+        body = await request.read()
+        try:
+            infer_request = _generate_request(request, body)
+            response = await _run(core.infer, infer_request)
+            return web.json_response(_generate_json(response))
+        except InferenceServerException as e:
+            return _error_response(e)
+
+    @routes.post("/v2/models/{model}/generate_stream")
+    @routes.post("/v2/models/{model}/versions/{version}/generate_stream")
+    async def generate_stream(request):
+        import json as _json
+
+        body = await request.read()
+        try:
+            infer_request = _generate_request(request, body)
+        except InferenceServerException as e:
+            return _error_response(e)
+        sse = web.StreamResponse(
+            headers={"Content-Type": "text/event-stream",
+                     "Cache-Control": "no-cache"}
+        )
+        await sse.prepare(request)
+        loop = asyncio.get_running_loop()
+        queue_: asyncio.Queue = asyncio.Queue()
+        DONE = object()
+        import threading
+
+        cancelled = threading.Event()
+
+        def _produce():
+            generator = core.stream_infer(infer_request)
+            try:
+                for stream_response in generator:
+                    if cancelled.is_set():
+                        break  # client gone: stop consuming the model
+                    loop.call_soon_threadsafe(queue_.put_nowait,
+                                              stream_response)
+            except Exception as e:
+                # errors raised before the generator's first yield must
+                # still reach the client as an SSE error event
+                error = pb.ModelStreamInferResponse(error_message=str(e))
+                loop.call_soon_threadsafe(queue_.put_nowait, error)
+            finally:
+                generator.close()  # release the model promptly
+                loop.call_soon_threadsafe(queue_.put_nowait, DONE)
+
+        producer = loop.run_in_executor(None, _produce)
+        try:
+            while True:
+                item = await queue_.get()
+                if item is DONE:
+                    break
+                if item.error_message:
+                    payload = {"error": item.error_message}
+                else:
+                    # suppress only the data-less final marker; data
+                    # responses pass through whatever their outputs are
+                    if not item.infer_response.outputs:
+                        continue
+                    payload = _generate_json(item.infer_response)
+                await sse.write(
+                    ("data: %s\n\n" % _json.dumps(payload)).encode()
+                )
+        except (ConnectionResetError, ConnectionError, asyncio.CancelledError):
+            cancelled.set()
+            raise
+        finally:
+            cancelled.set()
+            await producer
+        await sse.write_eof()
+        return sse
+
+    # -- OpenAI-compatible endpoints (chat/completions over the LLM
+    # models; the server-side counterpart of the reference perf
+    # harness's openai client backend, client_backend/openai/) ----------
+
+    def _openai_request(doc, prompt: str):
+        model_name = doc.get("model") or ""
+        if not model_name:
+            raise InferenceServerException(
+                "missing 'model'", status="INVALID_ARGUMENT")
+        infer_request = pb.ModelInferRequest(model_name=model_name)
+        from client_tpu.protocol.http_wire import _json_data_to_raw
+
+        tensor = infer_request.inputs.add()
+        tensor.name = "text_input"
+        tensor.datatype = "BYTES"
+        tensor.shape.extend([1])
+        infer_request.raw_input_contents.append(
+            _json_data_to_raw([prompt], "BYTES", "text_input"))
+        max_tokens = doc.get("max_tokens") or doc.get(
+            "max_completion_tokens")
+        if max_tokens:
+            tensor = infer_request.inputs.add()
+            tensor.name = "max_tokens"
+            tensor.datatype = "INT32"
+            tensor.shape.extend([1])
+            infer_request.raw_input_contents.append(
+                _json_data_to_raw([int(max_tokens)], "INT32", "max_tokens"))
+        return infer_request
+
+    def _openai_text(response: pb.ModelInferResponse) -> str:
+        from client_tpu.protocol.http_wire import _raw_to_json_data
+
+        for i, tensor in enumerate(response.outputs):
+            if tensor.name == "text_output" and i < len(
+                    response.raw_output_contents):
+                data = _raw_to_json_data(
+                    response.raw_output_contents[i], tensor.datatype)
+                return "".join(str(d) for d in data)
+        return ""
+
+    async def _chat_completions(request):
+        import json as _json
+
+        try:
+            doc = _json.loads(await request.read())
+            messages = doc.get("messages") or []
+            prompt = ""
+            for message in messages:
+                if message.get("role") == "user":
+                    prompt = message.get("content") or ""
+            infer_request = _openai_request(doc, prompt)
+        except InferenceServerException as e:
+            return _error_response(e)
+        except Exception as e:
+            return web.json_response(
+                {"error": {"message": str(e)}}, status=400)
+        if doc.get("stream"):
+            return await _openai_stream(
+                request, infer_request, chat=True)
+        try:
+            response = await _run(core.infer, infer_request)
+        except InferenceServerException as e:
+            return _error_response(e)
+        return web.json_response({
+            "id": "chatcmpl-0",
+            "object": "chat.completion",
+            "model": infer_request.model_name,
+            "choices": [{
+                "index": 0,
+                "message": {"role": "assistant",
+                            "content": _openai_text(response)},
+                "finish_reason": "stop",
+            }],
+        })
+
+    async def _completions(request):
+        import json as _json
+
+        try:
+            doc = _json.loads(await request.read())
+            prompt = doc.get("prompt") or ""
+            if isinstance(prompt, list):
+                prompt = prompt[0] if prompt else ""
+            infer_request = _openai_request(doc, prompt)
+        except InferenceServerException as e:
+            return _error_response(e)
+        except Exception as e:
+            return web.json_response(
+                {"error": {"message": str(e)}}, status=400)
+        if doc.get("stream"):
+            return await _openai_stream(
+                request, infer_request, chat=False)
+        try:
+            response = await _run(core.infer, infer_request)
+        except InferenceServerException as e:
+            return _error_response(e)
+        return web.json_response({
+            "id": "cmpl-0",
+            "object": "text_completion",
+            "model": infer_request.model_name,
+            "choices": [{
+                "index": 0,
+                "text": _openai_text(response),
+                "finish_reason": "stop",
+            }],
+        })
+
+    async def _openai_stream(request, infer_request, chat: bool):
+        """SSE chunks in the OpenAI streaming shape, fed by the
+        decoupled model stream (same producer pattern as
+        generate_stream)."""
+        import json as _json
+        import threading
+
+        sse = web.StreamResponse(
+            headers={"Content-Type": "text/event-stream",
+                     "Cache-Control": "no-cache"}
+        )
+        await sse.prepare(request)
+        loop = asyncio.get_running_loop()
+        queue_: asyncio.Queue = asyncio.Queue()
+        DONE = object()
+        cancelled = threading.Event()
+
+        def _produce():
+            generator = core.stream_infer(infer_request)
+            try:
+                for stream_response in generator:
+                    if cancelled.is_set():
+                        break
+                    loop.call_soon_threadsafe(
+                        queue_.put_nowait, stream_response)
+            except Exception as e:
+                error = pb.ModelStreamInferResponse(error_message=str(e))
+                loop.call_soon_threadsafe(queue_.put_nowait, error)
+            finally:
+                generator.close()
+                loop.call_soon_threadsafe(queue_.put_nowait, DONE)
+
+        producer = loop.run_in_executor(None, _produce)
+        obj = "chat.completion.chunk" if chat else "text_completion"
+        try:
+            while True:
+                item = await queue_.get()
+                if item is DONE:
+                    break
+                if item.error_message:
+                    payload = {"error": {"message": item.error_message}}
+                else:
+                    if not item.infer_response.outputs:
+                        continue
+                    token = _openai_text(item.infer_response)
+                    final = item.infer_response.parameters[
+                        "triton_final_response"].bool_param
+                    choice = {"index": 0,
+                              "finish_reason": "stop" if final else None}
+                    if chat:
+                        choice["delta"] = {"content": token}
+                    else:
+                        choice["text"] = token
+                    payload = {"id": "chatcmpl-0", "object": obj,
+                               "model": infer_request.model_name,
+                               "choices": [choice]}
+                await sse.write(
+                    ("data: %s\n\n" % _json.dumps(payload)).encode())
+        except (ConnectionResetError, ConnectionError,
+                asyncio.CancelledError):
+            cancelled.set()
+            raise
+        finally:
+            cancelled.set()
+            await producer
+        await sse.write(b"data: [DONE]\n\n")
+        await sse.write_eof()
+        return sse
+
+    routes.post("/v1/chat/completions")(_chat_completions)
+    routes.post("/v1/completions")(_completions)
+
+    # -- inference -------------------------------------------------------
+
+    @routes.post("/v2/models/{model}/infer")
+    @routes.post("/v2/models/{model}/versions/{version}/infer")
+    async def infer(request):
+        body = await request.read()
+        header_length = request.headers.get(HEADER_LEN)
+        # Compressed request bodies (Content-Encoding gzip/deflate)
+        # are already decompressed by aiohttp's request parser.
+        try:
+            infer_request = decode_infer_request(
+                body,
+                request.match_info["model"],
+                request.match_info.get("version", ""),
+                int(header_length) if header_length else None,
+            )
+            response = await _run(core.infer, infer_request)
+            binary_prefs = {}
+            default_binary = False  # pure-JSON clients get JSON back
+            for tensor in infer_request.outputs:
+                if "binary_data" in tensor.parameters:
+                    binary_prefs[tensor.name] = tensor.parameters[
+                        "binary_data"
+                    ].bool_param
+            if "binary_data_output" in infer_request.parameters:
+                default_binary = infer_request.parameters[
+                    "binary_data_output"
+                ].bool_param
+            payload, json_len = encode_infer_response(
+                response, binary_prefs, default_binary
+            )
+            headers = {}
+            if json_len is not None:
+                headers[HEADER_LEN] = str(json_len)
+            # Per-call response compression: honor the client's
+            # explicit Accept-Encoding preference (reference allows
+            # gzip/deflate per request).
+            algorithm = _pick_encoding(
+                request.headers.get("Accept-Encoding", ""))
+            if algorithm:
+                payload = compress_body(payload, algorithm)
+                headers["Content-Encoding"] = algorithm
+            return web.Response(
+                body=payload,
+                headers=headers,
+                content_type=(
+                    "application/octet-stream" if json_len is not None
+                    else "application/json"
+                ),
+            )
+        except InferenceServerException as e:
+            return _error_response(e)
+
+    app = web.Application(client_max_size=1024**3)
+    app.add_routes(routes)
+    return app
+
+
+class HttpServerThread:
+    """Runs the aiohttp app on a dedicated thread + event loop."""
+
+    def __init__(self, core: InferenceServerCore, host: str, port: int):
+        self._core = core
+        self._host = host
+        self._port = port
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._runner: Optional[web.AppRunner] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+        self._startup_error: Optional[BaseException] = None
+        self.port: Optional[int] = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+        if not self._started.wait(timeout=30):
+            raise RuntimeError("HTTP server failed to start (timeout)")
+        if self._startup_error is not None:
+            raise RuntimeError(
+                "HTTP server failed to start"
+            ) from self._startup_error
+        return self
+
+    def _serve(self):
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+
+        async def _up():
+            app = build_http_app(self._core)
+            self._runner = web.AppRunner(app)
+            await self._runner.setup()
+            site = web.TCPSite(self._runner, self._host, self._port)
+            await site.start()
+            server = site._server
+            self.port = server.sockets[0].getsockname()[1]
+
+        try:
+            self._loop.run_until_complete(_up())
+        except BaseException as e:
+            self._startup_error = e
+            self._started.set()
+            return
+        self._started.set()
+        self._loop.run_forever()
+
+    def stop(self):
+        if self._loop is None:
+            return
+
+        async def _down():
+            if self._runner is not None:
+                await self._runner.cleanup()
+
+        asyncio.run_coroutine_threadsafe(_down(), self._loop).result(timeout=10)
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+
+def start_http_server_thread(
+    core: InferenceServerCore, host: str = "0.0.0.0", port: int = 8000
+) -> HttpServerThread:
+    return HttpServerThread(core, host, port).start()

@@ -1,0 +1,219 @@
+"""Server-side shared-memory registry.
+
+Tracks client-registered regions by name, mirroring the role of
+triton's shared-memory manager that the reference client talks to via
+the Register/Unregister/Status verbs (grpc_client.cc:923-1092):
+
+- **system** regions: POSIX shm segments the server maps read/write.
+- **tpu** regions: logical slots in the server-owned HBM arena
+  (client_tpu.server.tpu_arena). A slot holds a ``jax.Array``; input
+  resolution hands the device array straight to the model and output
+  placement swaps the slot's reference — the TPU-native analogue of
+  cudaIpcOpenMemHandle'd pointers, with no per-request host copy.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from client_tpu.protocol import inference_pb2 as pb
+from client_tpu.utils import InferenceServerException
+from client_tpu.utils import shared_memory as system_shm
+
+
+class _SystemRegion:
+    kind = "system"
+
+    def __init__(self, name: str, key: str, offset: int, byte_size: int,
+                 handle: system_shm.SharedMemoryRegion):
+        self.name = name
+        self.key = key
+        self.offset = offset
+        self.byte_size = byte_size
+        self.handle = handle
+
+
+class _TpuRegion:
+    kind = "tpu"
+
+    def __init__(self, name: str, region_id: str, device_id: int, byte_size: int):
+        self.name = name
+        self.region_id = region_id
+        self.device_id = device_id
+        self.byte_size = byte_size
+
+
+class SharedMemoryManager:
+    """Name -> region registry + data plane resolution."""
+
+    def __init__(self, tpu_arena=None):
+        self._lock = threading.Lock()
+        self._system: Dict[str, _SystemRegion] = {}
+        self._tpu: Dict[str, _TpuRegion] = {}
+        self._arena = tpu_arena
+
+    @property
+    def arena(self):
+        return self._arena
+
+    # -- registration verbs ---------------------------------------------
+
+    def register_system(self, name: str, key: str, offset: int,
+                        byte_size: int) -> None:
+        with self._lock:
+            if name in self._system or name in self._tpu:
+                raise InferenceServerException(
+                    "shared memory region '%s' already registered" % name,
+                    status="ALREADY_EXISTS",
+                )
+            try:
+                handle = system_shm.attach_shared_memory_region(
+                    name, key, offset + byte_size
+                )
+            except system_shm.SharedMemoryException as e:
+                raise InferenceServerException(str(e), status="INVALID_ARGUMENT")
+            self._system[name] = _SystemRegion(name, key, offset, byte_size, handle)
+
+    def unregister_system(self, name: str) -> None:
+        with self._lock:
+            if not name:  # empty name = unregister all (v2 convention)
+                for region in self._system.values():
+                    system_shm.detach_shared_memory_region(region.handle)
+                self._system.clear()
+                return
+            region = self._system.pop(name, None)
+            if region is not None:
+                system_shm.detach_shared_memory_region(region.handle)
+
+    def system_status(self, name: str = "") -> pb.SystemSharedMemoryStatusResponse:
+        response = pb.SystemSharedMemoryStatusResponse()
+        with self._lock:
+            regions = (
+                [self._system[name]] if name and name in self._system
+                else ([] if name else list(self._system.values()))
+            )
+            for r in regions:
+                response.regions[r.name].name = r.name
+                response.regions[r.name].key = r.key
+                response.regions[r.name].offset = r.offset
+                response.regions[r.name].byte_size = r.byte_size
+        return response
+
+    def register_tpu(self, name: str, raw_handle: bytes, device_id: int,
+                     byte_size: int) -> None:
+        if self._arena is None:
+            raise InferenceServerException(
+                "server has no TPU arena; TPU shared memory unavailable",
+                status="UNAVAILABLE",
+            )
+        with self._lock:
+            if name in self._system or name in self._tpu:
+                raise InferenceServerException(
+                    "shared memory region '%s' already registered" % name,
+                    status="ALREADY_EXISTS",
+                )
+            region_id = self._arena.validate_handle(raw_handle, device_id, byte_size)
+            self._tpu[name] = _TpuRegion(name, region_id, device_id, byte_size)
+
+    def unregister_tpu(self, name: str) -> None:
+        with self._lock:
+            if not name:
+                self._tpu.clear()
+                return
+            self._tpu.pop(name, None)
+
+    def tpu_status(self, name: str = "") -> pb.TpuSharedMemoryStatusResponse:
+        response = pb.TpuSharedMemoryStatusResponse()
+        with self._lock:
+            regions = (
+                [self._tpu[name]] if name and name in self._tpu
+                else ([] if name else list(self._tpu.values()))
+            )
+            for r in regions:
+                response.regions[r.name].name = r.name
+                response.regions[r.name].device_id = r.device_id
+                response.regions[r.name].byte_size = r.byte_size
+        return response
+
+    # -- data plane ------------------------------------------------------
+
+    def _get(self, name: str):
+        with self._lock:
+            region = self._system.get(name) or self._tpu.get(name)
+        if region is None:
+            raise InferenceServerException(
+                "shared memory region '%s' is not registered" % name,
+                status="NOT_FOUND",
+            )
+        return region
+
+    def read_input(self, name: str, byte_size: int, offset: int,
+                   datatype: str, shape):
+        """Resolve a shm-referenced input to an array the model can
+        consume: numpy view for system regions, device ``jax.Array``
+        for TPU regions (no host round-trip)."""
+        region = self._get(name)
+        if region.kind == "system":
+            if offset + byte_size > region.byte_size:
+                raise InferenceServerException(
+                    "input exceeds region '%s' bounds" % name,
+                    status="INVALID_ARGUMENT",
+                )
+            buf = region.handle.buf()
+            base = region.offset + offset
+            return _bytes_to_array(
+                memoryview(buf)[base : base + byte_size], datatype, shape
+            )
+        return self._arena.as_typed_array(
+            region.region_id, offset, byte_size, datatype, shape
+        )
+
+    def write_output(self, name: str, byte_size: int, offset: int, value) -> int:
+        """Place an output tensor into a region. Returns bytes written.
+        TPU regions store the device array by reference (zero copy)."""
+        region = self._get(name)
+        if region.kind == "system":
+            data = _array_to_bytes(value)
+            if len(data) > byte_size:
+                raise InferenceServerException(
+                    "output of %d bytes exceeds the requested %d-byte slice "
+                    "of region '%s'" % (len(data), byte_size, name),
+                    status="INVALID_ARGUMENT",
+                )
+            if offset + len(data) > region.byte_size:
+                raise InferenceServerException(
+                    "output exceeds region '%s' bounds (%d > %d)"
+                    % (name, offset + len(data), region.byte_size),
+                    status="INVALID_ARGUMENT",
+                )
+            buf = region.handle.buf()
+            base = region.offset + offset
+            buf[base : base + len(data)] = data
+            return len(data)
+        return self._arena.store(region.region_id, offset, byte_size, value)
+
+
+def _bytes_to_array(view, datatype: str, shape):
+    from client_tpu.utils import (
+        deserialize_bf16_tensor,
+        deserialize_bytes_tensor,
+        triton_to_np_dtype,
+    )
+
+    if datatype == "BYTES":
+        return deserialize_bytes_tensor(bytes(view)).reshape(shape)
+    if datatype == "BF16":
+        return deserialize_bf16_tensor(bytes(view)).reshape(shape)
+    return np.frombuffer(view, dtype=triton_to_np_dtype(datatype)).reshape(shape)
+
+
+def _array_to_bytes(value) -> bytes:
+    from client_tpu.utils import serialize_byte_tensor
+
+    arr = np.asarray(value)
+    if arr.dtype.kind in ("O", "S", "U"):
+        return serialize_byte_tensor(arr).tobytes()
+    return np.ascontiguousarray(arr).tobytes()

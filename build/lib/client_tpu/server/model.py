@@ -1,0 +1,148 @@
+"""Served-model abstraction for the JAX/TPU inference server.
+
+A ServedModel declares its I/O signature (KServe-v2 tensor metadata +
+our ModelConfig) and implements ``infer`` — typically a ``jax.jit``-ed
+function over device arrays. Decoupled models (token streaming)
+implement ``infer_stream`` yielding zero-or-many responses.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from client_tpu.protocol import inference_pb2 as pb
+from client_tpu.protocol import model_config_pb2 as mc
+from client_tpu.utils import InferenceServerException
+
+_WIRE_TO_CONFIG_DTYPE = {
+    "BOOL": mc.TYPE_BOOL, "UINT8": mc.TYPE_UINT8, "UINT16": mc.TYPE_UINT16,
+    "UINT32": mc.TYPE_UINT32, "UINT64": mc.TYPE_UINT64, "INT8": mc.TYPE_INT8,
+    "INT16": mc.TYPE_INT16, "INT32": mc.TYPE_INT32, "INT64": mc.TYPE_INT64,
+    "FP16": mc.TYPE_FP16, "FP32": mc.TYPE_FP32, "FP64": mc.TYPE_FP64,
+    "BYTES": mc.TYPE_BYTES, "BF16": mc.TYPE_BF16,
+}
+CONFIG_TO_WIRE_DTYPE = {v: k for k, v in _WIRE_TO_CONFIG_DTYPE.items()}
+
+
+class TensorSpec:
+    """Declared name/datatype/shape of one model input or output; -1
+    dims are variable."""
+
+    def __init__(self, name: str, datatype: str, shape: Sequence[int],
+                 optional: bool = False):
+        self.name = name
+        self.datatype = datatype
+        self.shape = [int(d) for d in shape]
+        self.optional = optional
+
+    def compatible_with(self, shape: Sequence[int]) -> bool:
+        if len(shape) != len(self.shape):
+            return False
+        return all(d == -1 or int(d) == int(s) for d, s in zip(self.shape, shape))
+
+
+class ServedModel:
+    """Base class for everything the server can serve."""
+
+    name: str = "model"
+    version: str = "1"
+    platform: str = "jax"
+    max_batch_size: int = 0
+    decoupled: bool = False
+    # Server-side dynamic batching (client_tpu.server.batcher): fuse
+    # concurrent requests along the batch dim into one XLA call.
+    dynamic_batching: bool = False
+    preferred_batch_sizes: list = []
+    max_queue_delay_us: int = 500
+
+    def __init__(self):
+        self.inputs: List[TensorSpec] = []
+        self.outputs: List[TensorSpec] = []
+
+    # -- to be implemented by concrete models ---------------------------
+
+    def infer(
+        self, inputs: Dict[str, np.ndarray], parameters: Optional[dict] = None
+    ) -> Dict[str, np.ndarray]:
+        raise InferenceServerException(
+            "model '%s' does not implement one-shot inference" % self.name
+        )
+
+    def infer_stream(
+        self, inputs: Dict[str, np.ndarray], parameters: Optional[dict] = None
+    ) -> Iterator[Dict[str, np.ndarray]]:
+        raise InferenceServerException(
+            "model '%s' is not decoupled" % self.name
+        )
+
+    def warmup(self) -> None:
+        """Trigger jit compilation ahead of traffic (optional)."""
+
+    def unload(self) -> None:
+        """Release device resources (optional)."""
+
+    # -- protocol views --------------------------------------------------
+
+    def metadata_pb(self) -> pb.ModelMetadataResponse:
+        meta = pb.ModelMetadataResponse(
+            name=self.name, versions=[self.version], platform=self.platform
+        )
+        batch_dim = [-1] if self.max_batch_size > 0 else []
+        for spec in self.inputs:
+            meta.inputs.add(
+                name=spec.name, datatype=spec.datatype,
+                shape=batch_dim + spec.shape,
+            )
+        for spec in self.outputs:
+            meta.outputs.add(
+                name=spec.name, datatype=spec.datatype,
+                shape=batch_dim + spec.shape,
+            )
+        return meta
+
+    def config_pb(self) -> mc.ModelConfig:
+        config = mc.ModelConfig(
+            name=self.name,
+            platform=self.platform,
+            backend="jax",
+            max_batch_size=self.max_batch_size,
+            versions=[self.version],
+        )
+        for spec in self.inputs:
+            config.input.add(
+                name=spec.name,
+                data_type=_WIRE_TO_CONFIG_DTYPE[spec.datatype],
+                dims=spec.shape,
+                optional=spec.optional,
+            )
+        for spec in self.outputs:
+            config.output.add(
+                name=spec.name,
+                data_type=_WIRE_TO_CONFIG_DTYPE[spec.datatype],
+                dims=spec.shape,
+            )
+        config.model_transaction_policy.decoupled = self.decoupled
+        if self.dynamic_batching:
+            config.dynamic_batching.preferred_batch_size.extend(
+                self.preferred_batch_sizes)
+            config.dynamic_batching.max_queue_delay_microseconds = (
+                self.max_queue_delay_us)
+        self._extend_config(config)
+        return config
+
+    def _extend_config(self, config: mc.ModelConfig) -> None:
+        """Hook for subclasses (dynamic batching, ensemble, mesh...)."""
+
+    def find_input(self, name: str) -> Optional[TensorSpec]:
+        for spec in self.inputs:
+            if spec.name == name:
+                return spec
+        return None
+
+    def find_output(self, name: str) -> Optional[TensorSpec]:
+        for spec in self.outputs:
+            if spec.name == name:
+                return spec
+        return None

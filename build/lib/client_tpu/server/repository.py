@@ -1,0 +1,121 @@
+"""Model repository: named models with explicit load/unload and an
+index — the server-side counterpart of the client's model-control APIs
+(RepositoryIndex / RepositoryModelLoad / RepositoryModelUnload)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+from client_tpu.protocol import inference_pb2 as pb
+from client_tpu.server.model import ServedModel
+from client_tpu.utils import InferenceServerException
+
+
+class ModelRepository:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._models: Dict[str, ServedModel] = {}
+        self._factories: Dict[str, Callable[[], ServedModel]] = {}
+        self._state: Dict[str, str] = {}
+        self._reason: Dict[str, str] = {}
+
+    def add_factory(self, name: str, factory: Callable[[], ServedModel]) -> None:
+        """Make ``name`` loadable on demand without instantiating it."""
+        with self._lock:
+            self._factories[name] = factory
+            self._state.setdefault(name, "UNAVAILABLE")
+
+    def add_model(self, model: ServedModel, warmup: bool = False) -> None:
+        with self._lock:
+            self._models[model.name] = model
+            # reload-after-unload resurrects this exact instance (a
+            # bare type() factory would lose constructor arguments)
+            self._factories.setdefault(model.name, lambda model=model: model)
+            self._state[model.name] = "READY"
+            self._reason.pop(model.name, None)
+        if warmup:
+            model.warmup()
+
+    def load(self, name: str) -> ServedModel:
+        with self._lock:
+            if name in self._models:
+                self._state[name] = "READY"
+                return self._models[name]
+            factory = self._factories.get(name)
+            if factory is None:
+                raise InferenceServerException(
+                    "unknown model '%s'" % name, status="NOT_FOUND"
+                )
+        try:
+            model = factory()
+        except Exception as e:
+            with self._lock:
+                self._state[name] = "UNAVAILABLE"
+                self._reason[name] = str(e)
+            raise InferenceServerException(
+                "failed to load model '%s': %s" % (name, e), status="INTERNAL"
+            )
+        with self._lock:
+            self._models[name] = model
+            self._state[name] = "READY"
+            self._reason.pop(name, None)
+        return model
+
+    def unload(self, name: str) -> None:
+        with self._lock:
+            model = self._models.pop(name, None)
+            if model is None and name not in self._factories:
+                raise InferenceServerException(
+                    "unknown model '%s'" % name, status="NOT_FOUND"
+                )
+            self._state[name] = "UNAVAILABLE"
+            self._reason[name] = "unloaded"
+        if model is not None:
+            model.unload()
+
+    def get(self, name: str, version: str = "") -> ServedModel:
+        with self._lock:
+            model = self._models.get(name)
+        if model is None:
+            raise InferenceServerException(
+                "request for unknown model: '%s' is not found" % name,
+                status="NOT_FOUND",
+            )
+        if version and model.version != version:
+            raise InferenceServerException(
+                "request for unknown model version: '%s' version %s"
+                % (name, version),
+                status="NOT_FOUND",
+            )
+        return model
+
+    def is_ready(self, name: str, version: str = "") -> bool:
+        with self._lock:
+            model = self._models.get(name)
+            if model is None or self._state.get(name) != "READY":
+                return False
+            return not version or model.version == version
+
+    def ready_models(self) -> List[ServedModel]:
+        with self._lock:
+            return [
+                m for n, m in self._models.items()
+                if self._state.get(n) == "READY"
+            ]
+
+    def index(self, ready_only: bool = False) -> pb.RepositoryIndexResponse:
+        response = pb.RepositoryIndexResponse()
+        with self._lock:
+            for name in sorted(set(self._factories) | set(self._models)):
+                state = self._state.get(name, "UNAVAILABLE")
+                if ready_only and state != "READY":
+                    continue
+                model = self._models.get(name)
+                response.models.add(
+                    name=name,
+                    version=model.version if model else "",
+                    state=state,
+                    reason=self._reason.get(name, ""),
+                )
+        return response

@@ -1,0 +1,402 @@
+"""Server-owned TPU HBM arena: the TPU-native shared-memory data plane.
+
+Re-designs the reference's CUDA shared-memory model (cudaMalloc +
+cudaIpcGetMemHandle + cudaIpcOpenMemHandle, utils/cuda_shared_memory/
+__init__.py:107-149) for TPU reality: one process owns the device, so
+"shared" regions are *named slots* in the owning process. A slot holds
+a ``jax.Array``; the handle handed to clients is a signed logical
+descriptor, not a pointer.
+
+Zero-copy properties:
+- input resolution hands the slot's device array to the jitted model
+  unchanged (no host round-trip, no copy);
+- output placement stores the result array by reference — on TPU an
+  "in-place write to shared memory" is a reference swap;
+- host data written by a remote client crosses host->device once at
+  population time, never on the request path (matching how
+  perf-harness shm mode populates regions once and reuses them).
+"""
+
+from __future__ import annotations
+
+import json
+import secrets
+import threading
+import uuid
+from typing import Dict, Optional
+
+import numpy as np
+
+from client_tpu.utils import (
+    InferenceServerException,
+    deserialize_bytes_tensor,
+    triton_to_np_dtype,
+    wire_dtype_element_size,
+)
+
+
+class _Segment:
+    """One typed tensor (or raw byte run) living at an offset in a
+    region. Regions hold disjoint segments so multi-tensor layouts
+    (input_0 at 0, input_1 at 4096, ...) keep per-tensor dtype/shape
+    and partial writes never round-trip the whole region."""
+
+    __slots__ = ("offset", "nbytes", "datatype", "shape", "array")
+
+    def __init__(self, offset: int, nbytes: int, datatype: Optional[str],
+                 shape: Optional[list], array):
+        self.offset = offset
+        self.nbytes = nbytes
+        self.datatype = datatype  # None = raw uint8 run
+        self.shape = shape
+        self.array = array  # jax.Array (device) or np.ndarray (BYTES)
+
+    @property
+    def end(self) -> int:
+        return self.offset + self.nbytes
+
+
+class _Region:
+    def __init__(self, region_id: str, device, device_id: int, byte_size: int,
+                 nonce: str):
+        self.region_id = region_id
+        self.device = device
+        self.device_id = device_id
+        self.byte_size = byte_size
+        self.nonce = nonce
+        self.lock = threading.Lock()
+        # Disjoint segments sorted by offset.
+        self.segments: list = []
+
+
+class TpuArena:
+    """Named HBM slots on the arena's devices."""
+
+    def __init__(self, platform: Optional[str] = None, devices=None):
+        import jax
+
+        self._jax = jax
+        if devices is not None:
+            # Host-local subset: in a multi-host deployment each
+            # host's serving process pins its arena to ITS devices, so
+            # arena traffic rides ICI only — cross-host tensor
+            # movement goes through the documented DCN pull path
+            # (docs/cross_host_arena.md), never through the arena.
+            self._devices = list(devices)
+        elif platform:
+            self._devices = jax.devices(platform)
+        else:
+            self._devices = jax.devices()
+        self.arena_id = uuid.uuid4().hex[:12]
+        self._regions: Dict[str, _Region] = {}
+        self._lock = threading.Lock()
+
+    # -- lifecycle -------------------------------------------------------
+
+    def device_for(self, device_id: int):
+        if device_id < 0 or device_id >= len(self._devices):
+            raise InferenceServerException(
+                "device_id %d out of range (%d devices)"
+                % (device_id, len(self._devices)),
+                status="INVALID_ARGUMENT",
+            )
+        return self._devices[device_id]
+
+    def create_region(self, byte_size: int, device_id: int = 0) -> bytes:
+        """Allocate a slot; returns the serialized raw handle."""
+        if byte_size <= 0:
+            raise InferenceServerException(
+                "byte_size must be positive", status="INVALID_ARGUMENT"
+            )
+        device = self.device_for(device_id)
+        region_id = uuid.uuid4().hex
+        nonce = secrets.token_hex(8)
+        region = _Region(region_id, device, device_id, byte_size, nonce)
+        with self._lock:
+            self._regions[region_id] = region
+        return self._serialize_handle(region)
+
+    def _serialize_handle(self, region: _Region) -> bytes:
+        return json.dumps({
+            "arena_id": self.arena_id,
+            "region_id": region.region_id,
+            "device_id": region.device_id,
+            "byte_size": region.byte_size,
+            "nonce": region.nonce,
+        }).encode()
+
+    def validate_handle(self, raw_handle: bytes, device_id: int,
+                        byte_size: int) -> str:
+        """Check a client-provided handle against this arena; returns
+        the region_id (used by TpuSharedMemoryRegister)."""
+        try:
+            descriptor = json.loads(raw_handle)
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            raise InferenceServerException(
+                "malformed TPU shared memory handle", status="INVALID_ARGUMENT"
+            )
+        region = self._regions.get(descriptor.get("region_id", ""))
+        if (
+            region is None
+            or descriptor.get("arena_id") != self.arena_id
+            or descriptor.get("nonce") != region.nonce
+        ):
+            raise InferenceServerException(
+                "TPU shared memory handle does not match any arena region",
+                status="INVALID_ARGUMENT",
+            )
+        if byte_size > region.byte_size:
+            raise InferenceServerException(
+                "registered byte_size %d exceeds region size %d"
+                % (byte_size, region.byte_size),
+                status="INVALID_ARGUMENT",
+            )
+        if device_id != region.device_id:
+            raise InferenceServerException(
+                "registered device_id %d does not match region device %d"
+                % (device_id, region.device_id),
+                status="INVALID_ARGUMENT",
+            )
+        return region.region_id
+
+    def destroy_region(self, region_id: str) -> None:
+        with self._lock:
+            region = self._regions.pop(region_id, None)
+        if region is not None:
+            region.segments = []  # drop the HBM buffer references
+
+    def list_regions(self):
+        with self._lock:
+            return [
+                (r.region_id, r.device_id, r.byte_size)
+                for r in self._regions.values()
+            ]
+
+    def _get(self, region_id: str) -> _Region:
+        region = self._regions.get(region_id)
+        if region is None:
+            raise InferenceServerException(
+                "unknown TPU arena region", status="NOT_FOUND"
+            )
+        return region
+
+    # -- data plane ------------------------------------------------------
+
+    def write(self, region_id: str, offset: int, data: bytes,
+              datatype: str = "", shape=None) -> None:
+        """Host bytes -> device segment (the one host->device hop).
+        With dtype/shape metadata the segment stores a typed array at
+        any offset, so multi-tensor layouts keep per-tensor dtype."""
+        jax = self._jax
+        region = self._get(region_id)
+        if offset + len(data) > region.byte_size:
+            raise InferenceServerException(
+                "write of %d bytes at offset %d exceeds region size %d"
+                % (len(data), offset, region.byte_size),
+                status="INVALID_ARGUMENT",
+            )
+        if datatype and shape is not None:
+            if datatype == "BYTES":
+                # variable-length elements stay host-side
+                array = deserialize_bytes_tensor(data).reshape(shape)
+            else:
+                np_dtype = triton_to_np_dtype(datatype)
+                host = np.frombuffer(data, dtype=np_dtype).reshape(shape)
+                array = jax.device_put(host, region.device)
+            segment = _Segment(offset, len(data), datatype, list(shape),
+                               array)
+        else:
+            array = jax.device_put(
+                np.frombuffer(data, np.uint8), region.device)
+            segment = _Segment(offset, len(data), None, None, array)
+        with region.lock:
+            self._insert_segment(region, segment)
+
+    def _insert_segment(self, region: _Region, segment: _Segment) -> None:
+        """Place a segment, carving out overlaps. Only the overlapped
+        segments are touched (device->host per slice); untouched
+        tensors keep their device arrays — never a whole-region
+        round-trip. Caller holds region.lock."""
+        jax = self._jax
+        kept = []
+        for existing in region.segments:
+            if existing.end <= segment.offset or \
+                    existing.offset >= segment.end:
+                kept.append(existing)
+                continue
+            if (existing.offset >= segment.offset
+                    and existing.end <= segment.end):
+                continue  # fully covered: dropped
+            if existing.datatype == "BYTES":
+                # A partially-overwritten serialized BYTES tensor has
+                # no meaningful byte remainder (the length-prefixed
+                # framing is invalidated) — drop it so reads never see
+                # stale framing bytes past a smaller replacement.
+                continue
+            # Partial overlap: keep the non-overlapped remainder(s) as
+            # raw byte runs (host hop for this segment only).
+            raw = self._segment_bytes(existing)
+            if existing.offset < segment.offset:
+                head = raw[: segment.offset - existing.offset]
+                kept.append(_Segment(
+                    existing.offset, len(head), None, None,
+                    jax.device_put(np.frombuffer(head, np.uint8),
+                                   region.device)))
+            if existing.end > segment.end:
+                tail = raw[segment.end - existing.offset:]
+                kept.append(_Segment(
+                    segment.end, len(tail), None, None,
+                    jax.device_put(np.frombuffer(tail, np.uint8),
+                                   region.device)))
+        kept.append(segment)
+        kept.sort(key=lambda s: s.offset)
+        region.segments = kept
+
+    @staticmethod
+    def _segment_bytes(segment: _Segment) -> bytes:
+        """Serialize one segment to host bytes (inspection / carve
+        path — the only place a device segment crosses to host)."""
+        if segment.datatype == "BYTES":
+            from client_tpu.utils import serialize_byte_tensor
+
+            return serialize_byte_tensor(
+                np.asarray(segment.array)).tobytes()
+        return np.asarray(segment.array).tobytes()
+
+    def as_typed_array(self, region_id: str, offset: int, byte_size: int,
+                       datatype: str, shape):
+        """Resolve a slice as a device array of datatype/shape for
+        model consumption. Fast path: a segment already holds exactly
+        that typed array at that offset — hand it over untouched."""
+        jax = self._jax
+        region = self._get(region_id)
+        with region.lock:
+            if not region.segments:
+                raise InferenceServerException(
+                    "TPU region read before any write",
+                    status="INVALID_ARGUMENT",
+                )
+            for segment in region.segments:
+                if (segment.offset == offset
+                        and segment.datatype == datatype
+                        and segment.shape == list(shape)):
+                    return segment.array
+            if datatype == "BYTES":
+                for segment in region.segments:
+                    if (segment.offset == offset
+                            and segment.datatype == "BYTES"):
+                        return segment.array.reshape(shape)
+                raise InferenceServerException(
+                    "region does not hold a BYTES tensor at offset %d"
+                    % offset,
+                    status="INVALID_ARGUMENT",
+                )
+            elem = wire_dtype_element_size(datatype)
+            count = elem * int(np.prod(shape)) if len(shape) else elem
+            if offset + count > region.byte_size:
+                raise InferenceServerException(
+                    "typed view exceeds region bounds",
+                    status="INVALID_ARGUMENT",
+                )
+            cover = [s for s in region.segments
+                     if s.offset < offset + count and s.end > offset]
+            if any(s.datatype == "BYTES" for s in cover):
+                # Serialized BYTES framing is not byte-addressable
+                # numeric data — reinterpreting it would hand the
+                # model garbage.
+                raise InferenceServerException(
+                    "cannot view BYTES region as %s" % datatype,
+                    status="INVALID_ARGUMENT",
+                )
+            # Single covering non-BYTES segment: reinterpret on device
+            # (dynamic_slice + bitcast), no host hop.
+            if (len(cover) == 1 and cover[0].datatype != "BYTES"
+                    and cover[0].offset <= offset
+                    and cover[0].end >= offset + count):
+                import jax.numpy as jnp
+
+                segment = cover[0]
+                flat = segment.array.reshape(-1)
+                if flat.dtype == jnp.bool_:  # bitcast rejects bool
+                    flat = flat.astype(jnp.uint8)
+                if flat.dtype != jnp.uint8:
+                    flat = jax.lax.bitcast_convert_type(
+                        flat, jnp.uint8).reshape(-1)
+                np_dtype = triton_to_np_dtype(datatype)
+                window = jax.lax.dynamic_slice(
+                    flat, (offset - segment.offset,), (count,))
+                if datatype == "BOOL":  # u8 0/1 -> bool
+                    typed = window.astype(jnp.bool_)
+                else:
+                    typed = jax.lax.bitcast_convert_type(
+                        window.reshape(-1, elem), jnp.dtype(np_dtype))
+                return typed.reshape(shape)
+            # Slice spans several segments (or gaps): assemble the
+            # covered bytes on host — touching only those segments —
+            # and upload the window once.
+            data = self._read_locked(region, offset, count)
+            host = np.frombuffer(
+                data, dtype=triton_to_np_dtype(datatype)).reshape(shape)
+            return jax.device_put(host, region.device)
+
+    def store(self, region_id: str, offset: int, byte_size: int, value) -> int:
+        """Place an inference output into the region by reference (the
+        zero-copy 'write' — a segment swap at any offset). Returns the
+        logical byte size stored."""
+        jax = self._jax
+        region = self._get(region_id)
+        if isinstance(value, np.ndarray) and value.dtype.kind in ("O", "S", "U"):
+            from client_tpu.utils import serialize_byte_tensor
+
+            nbytes = int(serialize_byte_tensor(value).size)
+            datatype = "BYTES"
+            stored = value
+        else:
+            if not hasattr(value, "dtype"):
+                value = np.asarray(value)
+            nbytes = int(np.prod(value.shape)) * value.dtype.itemsize
+            from client_tpu.utils import np_to_wire_dtype
+
+            datatype = np_to_wire_dtype(value.dtype)
+            stored = value
+            if isinstance(value, np.ndarray):
+                stored = jax.device_put(value, region.device)
+        if nbytes > byte_size or offset + nbytes > region.byte_size:
+            raise InferenceServerException(
+                "output of %d bytes exceeds TPU region slice (%d)"
+                % (nbytes, min(byte_size, region.byte_size - offset)),
+                status="INVALID_ARGUMENT",
+            )
+        with region.lock:
+            self._insert_segment(region, _Segment(
+                offset, nbytes, datatype, list(stored.shape), stored))
+        return nbytes
+
+    def read(self, region_id: str, offset: int, byte_size: int) -> bytes:
+        """Device region -> host bytes (inspection path). Serializes
+        only the segments overlapping the window."""
+        region = self._get(region_id)
+        with region.lock:
+            if not region.segments:
+                return b"\x00" * (byte_size or region.byte_size)
+            if byte_size == 0:  # "to end" = the stored payload
+                end = max(s.end for s in region.segments)
+                byte_size = max(end - offset, 0)
+                if byte_size == 0:
+                    return b""
+            return self._read_locked(region, offset, byte_size)
+
+    def _read_locked(self, region: _Region, offset: int,
+                     byte_size: int) -> bytes:
+        """Assemble [offset, offset+byte_size) from overlapping
+        segments, zero-filling gaps. Caller holds region.lock."""
+        window = bytearray(byte_size)
+        for segment in region.segments:
+            if segment.end <= offset or segment.offset >= offset + byte_size:
+                continue
+            raw = self._segment_bytes(segment)
+            src_lo = max(0, offset - segment.offset)
+            src_hi = min(len(raw), offset + byte_size - segment.offset)
+            dst_lo = segment.offset + src_lo - offset
+            window[dst_lo:dst_lo + (src_hi - src_lo)] = raw[src_lo:src_hi]
+        return bytes(window)

@@ -1,0 +1,201 @@
+"""Core utilities: exceptions, dtype mapping, wire serialization.
+
+API-parity surface with the reference ``tritonclient.utils``
+(/root/reference/src/python/library/tritonclient/utils/__init__.py:71-348),
+re-designed TPU-first: BF16 is a first-class numpy dtype here (via
+``ml_dtypes.bfloat16``, the dtype JAX itself uses) instead of the
+reference's uint16-view workaround.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Optional
+
+import numpy as np
+
+try:  # ml_dtypes ships with jax; gives us a real bfloat16 numpy dtype
+    import ml_dtypes
+
+    _BF16 = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover - ml_dtypes is a jax dependency
+    ml_dtypes = None
+    _BF16 = None
+
+
+class InferenceServerException(Exception):
+    """Exception carrying a message, an optional protocol status and
+    optional debug details, raised by every client-facing API."""
+
+    def __init__(self, msg: str, status: Optional[str] = None, debug_details=None):
+        self._msg = msg
+        self._status = status
+        self._debug_details = debug_details
+        super().__init__(msg)
+
+    def __str__(self) -> str:
+        msg = super().__str__() if self._msg is None else self._msg
+        if self._status is not None:
+            msg = "[" + self._status + "] " + msg
+        return msg
+
+    def message(self) -> str:
+        return self._msg
+
+    def status(self) -> Optional[str]:
+        return self._status
+
+    def debug_details(self):
+        return self._debug_details
+
+
+# KServe-v2 wire dtype <-> numpy dtype tables. BF16 maps to the real
+# ml_dtypes.bfloat16 dtype (TPU native); np.object_ carries BYTES.
+_NP_TO_WIRE = {
+    np.dtype(np.bool_): "BOOL",
+    np.dtype(np.int8): "INT8",
+    np.dtype(np.int16): "INT16",
+    np.dtype(np.int32): "INT32",
+    np.dtype(np.int64): "INT64",
+    np.dtype(np.uint8): "UINT8",
+    np.dtype(np.uint16): "UINT16",
+    np.dtype(np.uint32): "UINT32",
+    np.dtype(np.uint64): "UINT64",
+    np.dtype(np.float16): "FP16",
+    np.dtype(np.float32): "FP32",
+    np.dtype(np.float64): "FP64",
+}
+if _BF16 is not None:
+    _NP_TO_WIRE[_BF16] = "BF16"
+
+_WIRE_TO_NP = {v: k for k, v in _NP_TO_WIRE.items()}
+_WIRE_TO_NP["BYTES"] = np.dtype(np.object_)
+
+# Fixed per-element byte sizes for non-BYTES dtypes.
+_WIRE_ELEM_SIZE = {
+    "BOOL": 1, "INT8": 1, "UINT8": 1,
+    "INT16": 2, "UINT16": 2, "FP16": 2, "BF16": 2,
+    "INT32": 4, "UINT32": 4, "FP32": 4,
+    "INT64": 8, "UINT64": 8, "FP64": 8,
+}
+
+
+def np_to_triton_dtype(np_dtype) -> Optional[str]:
+    """numpy dtype (or type) -> wire dtype string, None if unmapped."""
+    dt = np.dtype(np_dtype)
+    if dt.kind in ("O", "S", "U"):
+        return "BYTES"
+    return _NP_TO_WIRE.get(dt)
+
+
+def triton_to_np_dtype(dtype: str):
+    """Wire dtype string -> numpy dtype (BF16 -> ml_dtypes.bfloat16)."""
+    return _WIRE_TO_NP.get(dtype)
+
+
+# The framework's preferred names; the triton_* spellings above are kept
+# for drop-in compatibility with tritonclient user code.
+np_to_wire_dtype = np_to_triton_dtype
+wire_to_np_dtype = triton_to_np_dtype
+
+
+def wire_dtype_element_size(dtype: str) -> int:
+    """Bytes per element for a fixed-size wire dtype; -1 for BYTES."""
+    return _WIRE_ELEM_SIZE.get(dtype, -1)
+
+
+def num_elements(shape) -> int:
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+def tensor_byte_size(dtype: str, shape) -> int:
+    """Wire byte size of a fixed-size-dtype tensor; -1 for BYTES (data
+    dependent)."""
+    es = wire_dtype_element_size(dtype)
+    if es < 0:
+        return -1
+    return es * num_elements(shape)
+
+
+def serialize_byte_tensor(input_tensor: np.ndarray) -> np.ndarray:
+    """Serialize a BYTES tensor for the wire.
+
+    Each element is encoded as a 4-byte little-endian length followed by
+    the element's bytes (str elements are UTF-8 encoded), in C order.
+    Returns a flat uint8 array wrapping the serialized buffer.
+    """
+    if input_tensor.size == 0:
+        return np.empty([0], dtype=np.uint8)
+    if input_tensor.dtype.kind not in ("O", "S", "U"):
+        raise InferenceServerException(
+            "cannot serialize tensor of dtype %s as BYTES" % input_tensor.dtype
+        )
+    parts = []
+    for obj in np.nditer(input_tensor, flags=["refs_ok"], order="C"):
+        item = obj.item()
+        if isinstance(item, (bytes, bytearray, memoryview)):
+            b = bytes(item)
+        else:
+            b = str(item).encode("utf-8")
+        parts.append(struct.pack("<I", len(b)))
+        parts.append(b)
+    flat = b"".join(parts)
+    return np.frombuffer(flat, dtype=np.uint8)
+
+
+def deserialize_bytes_tensor(encoded_tensor: bytes) -> np.ndarray:
+    """Inverse of :func:`serialize_byte_tensor`: flat buffer -> 1-D
+    np.object_ array of bytes elements (caller reshapes)."""
+    strs = []
+    offset = 0
+    view = memoryview(encoded_tensor)
+    n = len(view)
+    while offset < n:
+        if offset + 4 > n:
+            raise InferenceServerException(
+                "malformed BYTES tensor: truncated length prefix"
+            )
+        (length,) = struct.unpack_from("<I", view, offset)
+        offset += 4
+        if offset + length > n:
+            raise InferenceServerException(
+                "malformed BYTES tensor: element overruns buffer"
+            )
+        strs.append(bytes(view[offset : offset + length]))
+        offset += length
+    return np.array(strs, dtype=np.object_)
+
+
+def serialize_bf16_tensor(input_tensor: np.ndarray) -> np.ndarray:
+    """Serialize a bfloat16 tensor to its raw 2-byte-per-element wire
+    form. Accepts ml_dtypes.bfloat16 arrays directly (zero-copy) or
+    float16/float32/float64 arrays (cast)."""
+    if _BF16 is not None and input_tensor.dtype == _BF16:
+        arr = np.ascontiguousarray(input_tensor)
+    elif input_tensor.dtype in (np.float16, np.float32, np.float64):
+        if _BF16 is None:  # pragma: no cover
+            raise InferenceServerException("ml_dtypes required for BF16")
+        arr = np.ascontiguousarray(input_tensor.astype(_BF16))
+    else:
+        raise InferenceServerException(
+            "cannot serialize tensor of dtype %s as BF16" % input_tensor.dtype
+        )
+    return arr.view(np.uint8).reshape(-1)
+
+
+def deserialize_bf16_tensor(encoded_tensor: bytes) -> np.ndarray:
+    """Flat wire buffer -> 1-D ml_dtypes.bfloat16 array (caller
+    reshapes)."""
+    if _BF16 is None:  # pragma: no cover
+        raise InferenceServerException("ml_dtypes required for BF16")
+    return np.frombuffer(encoded_tensor, dtype=_BF16)
+
+
+def serialized_byte_size(tensor_value: np.ndarray) -> int:
+    """Wire byte size of a tensor once serialized (BYTES aware)."""
+    if tensor_value.dtype.kind in ("O", "S", "U"):
+        return int(serialize_byte_tensor(tensor_value).size)
+    return int(tensor_value.nbytes)

@@ -1,0 +1,230 @@
+"""Standalone pure-ctypes DLPack implementation.
+
+Role parity with the reference's framework-independent
+``tritonclient/utils/_dlpack.py`` (:57-120 struct layer, :219
+contiguity check, :245 capsule access): ingest ANY tensor exposing
+``__dlpack__`` without importing its framework, and without
+``np.from_dlpack``'s CPU-only/device restrictions. CPU tensors become
+zero-copy numpy views; the caller decides what to do with non-CPU
+devices (in-process jax arrays are stored by reference upstream).
+
+The struct layout follows the public DLPack ABI (dmlc/dlpack
+``dlpack.h``, stable since v0.6).
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Tuple
+
+import numpy as np
+
+
+class DLDeviceType:
+    kDLCPU = 1
+    kDLCUDA = 2
+    kDLCUDAHost = 3
+    kDLOpenCL = 4
+    kDLVulkan = 7
+    kDLMetal = 8
+    kDLVPI = 9
+    kDLROCM = 10
+    kDLROCMHost = 11
+    kDLExtDev = 12
+    kDLCUDAManaged = 13
+    kDLOneAPI = 14
+
+
+class DLDataTypeCode:
+    kDLInt = 0
+    kDLUInt = 1
+    kDLFloat = 2
+    kDLOpaqueHandle = 3
+    kDLBfloat = 4
+    kDLComplex = 5
+    kDLBool = 6
+
+
+class DLDevice(ctypes.Structure):
+    _fields_ = [
+        ("device_type", ctypes.c_int),
+        ("device_id", ctypes.c_int),
+    ]
+
+
+class DLDataType(ctypes.Structure):
+    _fields_ = [
+        ("type_code", ctypes.c_uint8),
+        ("bits", ctypes.c_uint8),
+        ("lanes", ctypes.c_uint16),
+    ]
+
+
+class DLTensor(ctypes.Structure):
+    _fields_ = [
+        ("data", ctypes.c_void_p),
+        ("device", DLDevice),
+        ("ndim", ctypes.c_int),
+        ("dtype", DLDataType),
+        ("shape", ctypes.POINTER(ctypes.c_int64)),
+        ("strides", ctypes.POINTER(ctypes.c_int64)),
+        ("byte_offset", ctypes.c_uint64),
+    ]
+
+
+class DLManagedTensor(ctypes.Structure):
+    _fields_ = [
+        ("dl_tensor", DLTensor),
+        ("manager_ctx", ctypes.c_void_p),
+        ("deleter", ctypes.CFUNCTYPE(None, ctypes.c_void_p)),
+    ]
+
+
+_CAPSULE_NAME = b"dltensor"
+_USED_CAPSULE_NAME = b"used_dltensor"
+
+ctypes.pythonapi.PyCapsule_GetPointer.restype = ctypes.c_void_p
+ctypes.pythonapi.PyCapsule_GetPointer.argtypes = [
+    ctypes.py_object, ctypes.c_char_p]
+ctypes.pythonapi.PyCapsule_IsValid.restype = ctypes.c_int
+ctypes.pythonapi.PyCapsule_IsValid.argtypes = [
+    ctypes.py_object, ctypes.c_char_p]
+ctypes.pythonapi.PyCapsule_SetName.restype = ctypes.c_int
+ctypes.pythonapi.PyCapsule_SetName.argtypes = [
+    ctypes.py_object, ctypes.c_char_p]
+
+
+def get_managed_tensor(capsule) -> DLManagedTensor:
+    """The DLManagedTensor struct behind a 'dltensor' capsule."""
+    if not ctypes.pythonapi.PyCapsule_IsValid(capsule, _CAPSULE_NAME):
+        raise ValueError(
+            "capsule is not a valid (unconsumed) dltensor capsule")
+    ptr = ctypes.pythonapi.PyCapsule_GetPointer(capsule, _CAPSULE_NAME)
+    return ctypes.cast(ptr, ctypes.POINTER(DLManagedTensor)).contents
+
+
+def get_dlpack_capsule(tensor, stream=None):
+    """Produce the capsule from any __dlpack__-capable object."""
+    try:
+        return tensor.__dlpack__(stream=stream)
+    except TypeError:
+        return tensor.__dlpack__()
+
+
+def get_dlpack_device(tensor) -> Tuple[int, int]:
+    """(device_type, device_id); falls back to parsing the capsule
+    when the producer lacks __dlpack_device__."""
+    if hasattr(tensor, "__dlpack_device__"):
+        return tuple(tensor.__dlpack_device__())
+    # Keep the capsule referenced while reading the struct — dropping
+    # it runs the producer's deleter and frees the DLManagedTensor.
+    capsule = get_dlpack_capsule(tensor)
+    managed = get_managed_tensor(capsule)
+    device = managed.dl_tensor.device
+    result = (device.device_type, device.device_id)
+    del managed, capsule
+    return result
+
+
+def triton_to_dlpack_dtype(wire_dtype: str) -> DLDataType:
+    """Wire dtype string -> DLDataType (parity: reference
+    triton_to_dlpack_dtype :170)."""
+    table = {
+        "BOOL": (DLDataTypeCode.kDLBool, 8),
+        "INT8": (DLDataTypeCode.kDLInt, 8),
+        "INT16": (DLDataTypeCode.kDLInt, 16),
+        "INT32": (DLDataTypeCode.kDLInt, 32),
+        "INT64": (DLDataTypeCode.kDLInt, 64),
+        "UINT8": (DLDataTypeCode.kDLUInt, 8),
+        "UINT16": (DLDataTypeCode.kDLUInt, 16),
+        "UINT32": (DLDataTypeCode.kDLUInt, 32),
+        "UINT64": (DLDataTypeCode.kDLUInt, 64),
+        "FP16": (DLDataTypeCode.kDLFloat, 16),
+        "BF16": (DLDataTypeCode.kDLBfloat, 16),
+        "FP32": (DLDataTypeCode.kDLFloat, 32),
+        "FP64": (DLDataTypeCode.kDLFloat, 64),
+    }
+    if wire_dtype not in table:
+        raise ValueError("dtype %s has no DLPack equivalent" % wire_dtype)
+    code, bits = table[wire_dtype]
+    return DLDataType(code, bits, 1)
+
+
+def dlpack_to_np_dtype(dtype: DLDataType) -> np.dtype:
+    if dtype.lanes != 1:
+        raise ValueError("vector dtypes are not supported")
+    code, bits = dtype.type_code, dtype.bits
+    if code == DLDataTypeCode.kDLInt:
+        return np.dtype("int%d" % bits)
+    if code == DLDataTypeCode.kDLUInt:
+        return np.dtype("uint%d" % bits)
+    if code == DLDataTypeCode.kDLFloat:
+        return np.dtype("float%d" % bits)
+    if code == DLDataTypeCode.kDLBool:
+        return np.dtype(np.bool_)
+    if code == DLDataTypeCode.kDLBfloat:
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    raise ValueError(
+        "DLPack type code %d is not representable in numpy" % code)
+
+
+def is_contiguous_data(ndim: int, shape, strides) -> bool:
+    """Row-major contiguity from DLPack shape/strides (strides may be
+    NULL = contiguous by convention)."""
+    if not strides:
+        return True
+    expected = 1
+    for i in reversed(range(ndim)):
+        if shape[i] != 1 and strides[i] != expected:
+            return False
+        expected *= shape[i]
+    return True
+
+
+def capsule_to_numpy(capsule, writable: bool = False) -> np.ndarray:
+    """Zero-copy numpy view over a CPU dltensor capsule. The returned
+    array keeps the capsule alive (the producer's deleter fires when
+    the view is garbage-collected)."""
+    managed = get_managed_tensor(capsule)
+    tensor = managed.dl_tensor
+    if tensor.device.device_type not in (
+        DLDeviceType.kDLCPU, DLDeviceType.kDLCUDAHost,
+        DLDeviceType.kDLROCMHost,
+    ):
+        raise ValueError(
+            "capsule holds device memory (device_type=%d), not host"
+            % tensor.device.device_type)
+    shape = [tensor.shape[i] for i in range(tensor.ndim)]
+    np_dtype = dlpack_to_np_dtype(tensor.dtype)
+    count = int(np.prod(shape)) if shape else 1
+    if count == 0:  # empty tensors need no layout validation
+        return np.empty(shape, dtype=np_dtype)
+    if not is_contiguous_data(tensor.ndim, tensor.shape, tensor.strides):
+        raise ValueError("only contiguous DLPack tensors are supported")
+    nbytes = count * np_dtype.itemsize
+    address = (tensor.data or 0) + tensor.byte_offset
+    buffer = (ctypes.c_char * nbytes).from_address(address)
+    array = np.frombuffer(buffer, dtype=np_dtype).reshape(shape)
+    if not writable:
+        array.flags.writeable = False
+    # Tie the capsule's lifetime to the view: numpy only keeps
+    # `buffer` alive, which does not own the producer's memory.
+    array = array.view(_CapsuleBackedArray)
+    array._dlpack_capsule = capsule
+    return array
+
+
+class _CapsuleBackedArray(np.ndarray):
+    """ndarray subclass carrying the owning dltensor capsule."""
+
+    _dlpack_capsule = None
+
+
+def to_numpy(tensor) -> np.ndarray:
+    """Any host-resident __dlpack__-capable tensor -> zero-copy numpy
+    view (the ingestion entry point)."""
+    if isinstance(tensor, np.ndarray):
+        return tensor
+    return capsule_to_numpy(get_dlpack_capsule(tensor))

@@ -1,0 +1,328 @@
+"""System (POSIX) shared-memory utilities.
+
+API-parity surface with the reference
+``tritonclient.utils.shared_memory`` (utils/shared_memory/__init__.py:
+93-260). Like the reference, the fast path is a small native C
+extension (``shared_memory.c`` → libcshm.so, mirroring the reference's
+shared_memory.cc) loaded with ctypes; if the library cannot be built
+or loaded, a pure-Python ctypes ``shm_open`` + stdlib ``mmap`` path
+provides identical zero-copy behavior.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import mmap
+import os
+import sys
+import weakref
+from typing import List, Optional
+
+import numpy as np
+
+from client_tpu.utils import (
+    deserialize_bytes_tensor,
+    serialize_byte_tensor,
+    triton_to_np_dtype,
+)
+from client_tpu.utils.shared_memory import _cshm
+
+# libcshm.so is built/loaded lazily on first region operation so that
+# importing the package never blocks on a compiler invocation
+_CSHM_LIB = None
+_CSHM_ATTEMPTED = False
+
+
+def _cshm_lib():
+    global _CSHM_LIB, _CSHM_ATTEMPTED
+    if not _CSHM_ATTEMPTED:
+        _CSHM_ATTEMPTED = True
+        _CSHM_LIB = _cshm.load()
+    return _CSHM_LIB
+
+
+def using_native_backend() -> bool:
+    """True when the libcshm.so C extension backs this module."""
+    return _cshm_lib() is not None
+
+
+class SharedMemoryException(Exception):
+    """Raised on any shared-memory operation failure."""
+
+
+def _load_shm_lib():
+    # shm_open lives in librt on older glibc, libc on newer.
+    for name in ("rt", "c"):
+        path = ctypes.util.find_library(name)
+        if path is None:
+            continue
+        lib = ctypes.CDLL(path, use_errno=True)
+        if hasattr(lib, "shm_open"):
+            return lib
+    raise SharedMemoryException("unable to locate shm_open in libc/librt")
+
+
+_LIB = _load_shm_lib()
+_LIB.shm_open.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_uint]
+_LIB.shm_open.restype = ctypes.c_int
+_LIB.shm_unlink.argtypes = [ctypes.c_char_p]
+_LIB.shm_unlink.restype = ctypes.c_int
+
+_O_RDWR = os.O_RDWR
+_O_CREAT = os.O_CREAT
+
+
+class SharedMemoryRegion:
+    """Handle to a mapped POSIX shared-memory region."""
+
+    def __init__(self, triton_shm_name: str, shm_key: str):
+        self._triton_shm_name = triton_shm_name
+        self._shm_key = shm_key
+        self._byte_size = 0
+        self._fd = -1
+        self._mpg = None  # mmap.mmap (fallback) or memoryview (C ext)
+        self._chandle: Optional[ctypes.c_void_p] = None
+        self._np_base: Optional[np.ndarray] = None
+        self._created = False
+
+    @property
+    def name(self) -> str:
+        return self._triton_shm_name
+
+    @property
+    def key(self) -> str:
+        return self._shm_key
+
+    @property
+    def byte_size(self) -> int:
+        return self._byte_size
+
+    def buf(self) -> mmap.mmap:
+        if self._mpg is None:
+            raise SharedMemoryException("region is not mapped")
+        return self._mpg
+
+
+_mapped_regions: dict = {}
+
+
+def _adopt_chandle(region: SharedMemoryRegion, chandle: ctypes.c_void_p,
+                   created: bool) -> None:
+    """Fill a region from a native SharedMemoryHandle: zero-copy
+    memoryview over the mapped address + bookkeeping fields."""
+    base = ctypes.c_void_p()
+    key = ctypes.c_char_p()
+    fd = ctypes.c_int()
+    offset = ctypes.c_size_t()
+    size = ctypes.c_size_t()
+    _cshm_lib().GetSharedMemoryHandleInfo(
+        chandle, ctypes.byref(base), ctypes.byref(key), ctypes.byref(fd),
+        ctypes.byref(offset), ctypes.byref(size))
+    region._chandle = chandle
+    region._fd = fd.value
+    region._byte_size = size.value
+    region._created = created
+    # numpy's uint8 buffer exports format 'B' (plain ctypes arrays
+    # export '<B', which memoryview.cast and some consumers reject)
+    arr = np.ctypeslib.as_array(
+        ctypes.cast(base, ctypes.POINTER(ctypes.c_ubyte)),
+        shape=(size.value,))
+    region._np_base = arr
+    region._mpg = memoryview(arr)
+
+
+def create_shared_memory_region(
+    triton_shm_name: str, shm_key: str, byte_size: int, create_only: bool = False
+) -> SharedMemoryRegion:
+    """Create (or attach, unless ``create_only``) and map the POSIX
+    region ``shm_key`` of ``byte_size`` bytes."""
+    region = SharedMemoryRegion(triton_shm_name, shm_key)
+    if using_native_backend():
+        chandle = ctypes.c_void_p()
+        rc = _cshm_lib().SharedMemoryRegionCreate(
+            shm_key.encode(), byte_size, int(create_only),
+            ctypes.byref(chandle))
+        if rc != 0:
+            raise SharedMemoryException(
+                "unable to create shared memory region '%s': %s"
+                % (shm_key, os.strerror(-rc)))
+        _adopt_chandle(region, chandle, created=True)
+        _mapped_regions[triton_shm_name] = region
+        return region
+    flags = _O_RDWR | _O_CREAT
+    if create_only:
+        flags |= os.O_EXCL
+    fd = _LIB.shm_open(shm_key.encode(), flags, 0o600)
+    if fd < 0:
+        err = ctypes.get_errno()
+        raise SharedMemoryException(
+            "unable to create shared memory region '%s': %s"
+            % (shm_key, os.strerror(err))
+        )
+    try:
+        stat = os.fstat(fd)
+        region._created = stat.st_size == 0
+        if stat.st_size < byte_size:
+            os.ftruncate(fd, byte_size)
+        region._fd = fd
+        region._byte_size = byte_size
+        region._mpg = mmap.mmap(fd, byte_size)
+    except OSError as e:
+        os.close(fd)
+        raise SharedMemoryException(
+            "unable to map shared memory region '%s': %s" % (shm_key, e)
+        )
+    _mapped_regions[triton_shm_name] = region
+    return region
+
+
+def attach_shared_memory_region(
+    triton_shm_name: str, shm_key: str, byte_size: int
+) -> SharedMemoryRegion:
+    """Attach to an existing region without creating it (used
+    server-side when a client registers a region)."""
+    region = SharedMemoryRegion(triton_shm_name, shm_key)
+    if using_native_backend():
+        chandle = ctypes.c_void_p()
+        rc = _cshm_lib().SharedMemoryRegionOpen(
+            shm_key.encode(), byte_size, ctypes.byref(chandle))
+        if rc != 0:
+            raise SharedMemoryException(
+                "unable to open shared memory region '%s': %s"
+                % (shm_key, os.strerror(-rc)))
+        _adopt_chandle(region, chandle, created=False)
+        return region
+    fd = _LIB.shm_open(shm_key.encode(), _O_RDWR, 0o600)
+    if fd < 0:
+        raise SharedMemoryException(
+            "unable to open shared memory region '%s': %s"
+            % (shm_key, os.strerror(ctypes.get_errno()))
+        )
+    try:
+        size = os.fstat(fd).st_size
+        if size < byte_size:
+            raise SharedMemoryException(
+                "region '%s' is %d bytes, %d requested"
+                % (shm_key, size, byte_size)
+            )
+        region._fd = fd
+        region._byte_size = byte_size
+        region._mpg = mmap.mmap(fd, byte_size)
+    except SharedMemoryException:
+        os.close(fd)
+        raise
+    except OSError as e:
+        os.close(fd)
+        raise SharedMemoryException(str(e))
+    return region
+
+
+def set_shared_memory_region(
+    shm_handle: SharedMemoryRegion, input_values, offset: int = 0
+) -> None:
+    """Copy a list of numpy arrays into the region back to back
+    starting at ``offset`` (BYTES arrays are wire-serialized)."""
+    if not isinstance(input_values, (list, tuple)):
+        raise SharedMemoryException("input_values must be a list of numpy arrays")
+    buf = shm_handle.buf()
+    pos = offset
+    for arr in input_values:
+        if arr.dtype.kind in ("O", "S", "U"):
+            data = serialize_byte_tensor(arr).tobytes()
+        else:
+            data = np.ascontiguousarray(arr).tobytes()
+        if pos + len(data) > shm_handle.byte_size:
+            raise SharedMemoryException("input exceeds shared memory region size")
+        if shm_handle._chandle is not None:
+            rc = _cshm_lib().SharedMemoryRegionSet(
+                shm_handle._chandle, pos, len(data), data)
+            if rc != 0:
+                raise SharedMemoryException(
+                    "unable to set shared memory region: %s"
+                    % os.strerror(-rc))
+        else:
+            buf[pos : pos + len(data)] = data
+        pos += len(data)
+
+
+def get_contents_as_numpy(
+    shm_handle: SharedMemoryRegion, datatype, shape, offset: int = 0
+) -> np.ndarray:
+    """View/copy the region contents as a numpy array of
+    datatype/shape. Fixed-size dtypes return a zero-copy view."""
+    buf = shm_handle.buf()
+    if isinstance(datatype, str):
+        np_dtype = triton_to_np_dtype(datatype)
+        wire = datatype
+    else:
+        np_dtype = np.dtype(datatype)
+        wire = None
+    count = int(np.prod(shape)) if len(shape) else 1
+    if np_dtype == np.object_ or wire == "BYTES":
+        end = shm_handle.byte_size
+        arr = deserialize_bytes_tensor(bytes(buf[offset:end]))
+        # the region may be larger than the tensor; trailing zero bytes
+        # decode as empty elements — keep only the requested count
+        return arr[:count].reshape(shape)
+    return np.frombuffer(
+        memoryview(buf), dtype=np_dtype, count=count, offset=offset
+    ).reshape(shape)
+
+
+def get_shared_memory_handle_info(shm_handle: SharedMemoryRegion):
+    """(shm_key, byte_size, fd) of the underlying region."""
+    return (shm_handle.key, shm_handle.byte_size, shm_handle._fd)
+
+
+def mapped_shared_memory_regions() -> List[str]:
+    return list(_mapped_regions.keys())
+
+
+def _release_mapping(shm_handle: SharedMemoryRegion, unlink: bool) -> None:
+    if shm_handle._chandle is not None:
+        lib = _cshm_lib()
+        chandle = shm_handle._chandle
+        base = shm_handle._np_base
+        shm_handle._mpg = None
+        shm_handle._np_base = None
+        shm_handle._chandle = None
+        shm_handle._fd = -1
+        if unlink:
+            # the name can go immediately; the mapping itself survives
+            # until munmap (POSIX keeps unlinked regions mapped)
+            _LIB.shm_unlink(shm_handle.key.encode())
+        # zero-copy numpy views may still reference the mapping
+        # (refcount: `base` local + getrefcount arg = 2 baseline);
+        # defer munmap until they die instead of leaving them dangling
+        if base is not None and sys.getrefcount(base) > 2:
+            weakref.finalize(base, lib.SharedMemoryRegionDetach, chandle)
+        else:
+            lib.SharedMemoryRegionDetach(chandle)
+        return
+    # Zero-copy numpy views may still reference the mapping; in that
+    # case dropping our reference lets GC unmap once the views die.
+    if shm_handle._mpg is not None:
+        try:
+            shm_handle._mpg.close()
+        except BufferError:
+            pass
+        shm_handle._mpg = None
+    if shm_handle._fd >= 0:
+        os.close(shm_handle._fd)
+        shm_handle._fd = -1
+    if unlink:
+        _LIB.shm_unlink(shm_handle.key.encode())
+
+
+def destroy_shared_memory_region(shm_handle: SharedMemoryRegion) -> None:
+    """Unmap and unlink the region."""
+    try:
+        _release_mapping(shm_handle, unlink=True)
+    finally:
+        _mapped_regions.pop(shm_handle.name, None)
+
+
+def detach_shared_memory_region(shm_handle: SharedMemoryRegion) -> None:
+    """Unmap without unlinking (server detaching a client's region)."""
+    _release_mapping(shm_handle, unlink=False)

@@ -1,0 +1,101 @@
+"""Loader for the native shared-memory backend (libcshm.so).
+
+The reference ships a prebuilt C extension loaded with ctypes
+(utils/shared_memory/__init__.py:48-72); here the library is compiled
+on first use from ``shared_memory.c`` with the system compiler and
+cached next to this file. Set ``CLIENT_TPU_NO_CSHM=1`` to force the
+pure-Python fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import shutil
+import subprocess
+import tempfile
+from typing import Optional
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_PKG_DIR, "shared_memory.c")
+_LIB_PATH = os.path.join(_PKG_DIR, "libcshm.so")
+
+
+def _compile() -> Optional[str]:
+    cc = shutil.which("cc") or shutil.which("gcc") or shutil.which("g++")
+    if cc is None or not os.path.exists(_SRC):
+        return None
+    # build into a temp file then atomically rename so concurrent
+    # importers never load a half-written .so
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_PKG_DIR)
+    os.close(fd)
+    try:
+        subprocess.run(
+            [cc, "-O2", "-shared", "-fPIC", "-o", tmp, _SRC],
+            check=True,
+            capture_output=True,
+            timeout=60,
+        )
+        os.replace(tmp, _LIB_PATH)
+        return _LIB_PATH
+    except (subprocess.SubprocessError, OSError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (building if necessary) libcshm.so; None on any failure."""
+    if os.environ.get("CLIENT_TPU_NO_CSHM"):
+        return None
+    # rebuild whenever the source is newer than the cached library so
+    # edits to shared_memory.c actually take effect
+    fresh = (
+        os.path.exists(_LIB_PATH)
+        and (not os.path.exists(_SRC)
+             or os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC))
+    )
+    path = _LIB_PATH if fresh else _compile()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+
+    lib.SharedMemoryRegionCreate.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_size_t,
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_void_p),
+    ]
+    lib.SharedMemoryRegionCreate.restype = ctypes.c_int
+    lib.SharedMemoryRegionOpen.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_void_p),
+    ]
+    lib.SharedMemoryRegionOpen.restype = ctypes.c_int
+    lib.SharedMemoryRegionSet.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_size_t,
+        ctypes.c_size_t,
+        ctypes.c_void_p,
+    ]
+    lib.SharedMemoryRegionSet.restype = ctypes.c_int
+    lib.GetSharedMemoryHandleInfo.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_char_p),
+        ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_size_t),
+        ctypes.POINTER(ctypes.c_size_t),
+    ]
+    lib.GetSharedMemoryHandleInfo.restype = ctypes.c_int
+    lib.SharedMemoryRegionDestroy.argtypes = [ctypes.c_void_p]
+    lib.SharedMemoryRegionDestroy.restype = ctypes.c_int
+    lib.SharedMemoryRegionDetach.argtypes = [ctypes.c_void_p]
+    lib.SharedMemoryRegionDetach.restype = ctypes.c_int
+    return lib

@@ -1,0 +1,170 @@
+/* Native POSIX shared-memory backend for client_tpu.utils.shared_memory.
+ *
+ * API-parity surface with the reference's small C extension
+ * (tritonclient/utils/shared_memory/shared_memory.cc: 151 LoC of
+ * shm_open/mmap/memcpy behind SharedMemoryRegionCreate / Set /
+ * GetSharedMemoryHandleInfo / Destroy), re-implemented for the TPU
+ * client stack. Built as libcshm.so and loaded with ctypes; all
+ * returns are 0 on success or -errno on failure.
+ */
+
+#define _GNU_SOURCE
+#include <errno.h>
+#include <fcntl.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+typedef struct SharedMemoryHandle {
+  void* base_addr_;
+  char* shm_key_;
+  int shm_fd_;
+  size_t offset_;
+  size_t byte_size_;
+  int owns_region_; /* created (unlink on destroy) vs attached */
+} SharedMemoryHandle;
+
+static int
+MapRegion(int shm_fd, size_t offset, size_t byte_size, void** base_addr)
+{
+  *base_addr =
+      mmap(NULL, byte_size, PROT_READ | PROT_WRITE, MAP_SHARED, shm_fd, offset);
+  if (*base_addr == MAP_FAILED) {
+    return -errno;
+  }
+  return 0;
+}
+
+static int
+OpenCommon(
+    const char* shm_key, size_t byte_size, int oflags, int owns,
+    void** shm_handle)
+{
+  int fd = shm_open(shm_key, oflags, S_IRUSR | S_IWUSR);
+  if (fd == -1) {
+    return -errno;
+  }
+  if (owns) {
+    struct stat st;
+    if (fstat(fd, &st) == -1 || (size_t)st.st_size < byte_size) {
+      if (ftruncate(fd, (off_t)byte_size) == -1) {
+        int err = -errno;
+        close(fd);
+        return err;
+      }
+    }
+  } else {
+    struct stat st;
+    if (fstat(fd, &st) == -1) {
+      int err = -errno;
+      close(fd);
+      return err;
+    }
+    if ((size_t)st.st_size < byte_size) {
+      close(fd);
+      return -EINVAL;
+    }
+  }
+
+  void* base = NULL;
+  int rc = MapRegion(fd, 0, byte_size, &base);
+  if (rc != 0) {
+    close(fd);
+    return rc;
+  }
+
+  SharedMemoryHandle* handle =
+      (SharedMemoryHandle*)malloc(sizeof(SharedMemoryHandle));
+  if (handle == NULL) {
+    munmap(base, byte_size);
+    close(fd);
+    return -ENOMEM;
+  }
+  handle->base_addr_ = base;
+  handle->shm_key_ = strdup(shm_key);
+  handle->shm_fd_ = fd;
+  handle->offset_ = 0;
+  handle->byte_size_ = byte_size;
+  handle->owns_region_ = owns;
+  *shm_handle = handle;
+  return 0;
+}
+
+int
+SharedMemoryRegionCreate(
+    const char* shm_key, size_t byte_size, int create_only, void** shm_handle)
+{
+  int oflags = O_RDWR | O_CREAT | (create_only ? O_EXCL : 0);
+  return OpenCommon(shm_key, byte_size, oflags, 1, shm_handle);
+}
+
+int
+SharedMemoryRegionOpen(const char* shm_key, size_t byte_size, void** shm_handle)
+{
+  return OpenCommon(shm_key, byte_size, O_RDWR, 0, shm_handle);
+}
+
+int
+SharedMemoryRegionSet(
+    void* shm_handle, size_t offset, size_t byte_size, const void* data)
+{
+  SharedMemoryHandle* handle = (SharedMemoryHandle*)shm_handle;
+  if (offset + byte_size > handle->byte_size_) {
+    return -EINVAL;
+  }
+  memcpy((char*)handle->base_addr_ + offset, data, byte_size);
+  return 0;
+}
+
+int
+GetSharedMemoryHandleInfo(
+    void* shm_handle, char** base_addr, const char** shm_key, int* shm_fd,
+    size_t* offset, size_t* byte_size)
+{
+  SharedMemoryHandle* handle = (SharedMemoryHandle*)shm_handle;
+  *base_addr = (char*)handle->base_addr_;
+  *shm_key = handle->shm_key_;
+  *shm_fd = handle->shm_fd_;
+  *offset = handle->offset_;
+  *byte_size = handle->byte_size_;
+  return 0;
+}
+
+static int
+ReleaseCommon(SharedMemoryHandle* handle, int unlink_region)
+{
+  int rc = 0;
+  if (handle->base_addr_ != NULL) {
+    if (munmap(handle->base_addr_, handle->byte_size_) == -1) {
+      rc = -errno;
+    }
+    handle->base_addr_ = NULL;
+  }
+  if (handle->shm_fd_ >= 0) {
+    close(handle->shm_fd_);
+    handle->shm_fd_ = -1;
+  }
+  if (unlink_region && handle->shm_key_ != NULL) {
+    if (shm_unlink(handle->shm_key_) == -1 && rc == 0) {
+      rc = -errno;
+    }
+  }
+  free(handle->shm_key_);
+  handle->shm_key_ = NULL;
+  free(handle);
+  return rc;
+}
+
+int
+SharedMemoryRegionDestroy(void* shm_handle)
+{
+  return ReleaseCommon((SharedMemoryHandle*)shm_handle, 1);
+}
+
+int
+SharedMemoryRegionDetach(void* shm_handle)
+{
+  return ReleaseCommon((SharedMemoryHandle*)shm_handle, 0);
+}

@@ -1,0 +1,363 @@
+"""TPU shared memory — zero-copy device tensor I/O.
+
+The re-target of the reference's ``tritonclient.utils.cuda_shared_memory``
+(utils/cuda_shared_memory/__init__.py:107-414) at TPU HBM. Same
+seven-function surface:
+
+    create_shared_memory_region(name, byte_size, device_id)
+    get_raw_handle(handle)
+    set_shared_memory_region(handle, values)
+    set_shared_memory_region_from_dlpack(handle, tensor)
+    get_contents_as_numpy(handle, datatype, shape)
+    as_shared_memory_tensor(handle, datatype, shape)
+    destroy_shared_memory_region(handle)
+
+TPU difference: CUDA lets any process cudaMalloc and export an IPC
+handle; on TPU a single process owns the device, so regions are slots
+in the *server's* HBM arena and this module talks to the arena
+service (same port as inference) — or directly to an in-process
+``TpuArena``. The handle is a logical descriptor, not a pointer; pass
+it to ``register_tpu_shared_memory`` exactly like the CUDA raw
+handle. Region population is one host->device hop; the inference
+request path is zero-copy (the server hands slot arrays straight to
+the jitted model and stores outputs by reference swap).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from client_tpu.utils import (
+    InferenceServerException,
+    deserialize_bf16_tensor,
+    deserialize_bytes_tensor,
+    np_to_wire_dtype,
+    serialize_byte_tensor,
+    triton_to_np_dtype,
+)
+
+
+class TpuSharedMemoryException(InferenceServerException):
+    pass
+
+
+class _ArenaTransport:
+    """Uniform view over an in-process TpuArena or a remote arena
+    service stub."""
+
+    def __init__(self, arena=None, stub=None, channel=None):
+        self.arena = arena
+        self.stub = stub
+        self.channel = channel
+
+    @staticmethod
+    def _rpc(call, request):
+        import grpc
+
+        try:
+            return call(request)
+        except grpc.RpcError as rpc_error:
+            try:
+                code, details = rpc_error.code().name, rpc_error.details()
+            except Exception:
+                code, details = None, str(rpc_error)
+            raise TpuSharedMemoryException(details, status=code) from None
+
+    def create(self, byte_size: int, device_id: int):
+        if self.arena is not None:
+            raw = self.arena.create_region(byte_size, device_id)
+            import json
+
+            return raw, json.loads(raw)["region_id"]
+        from client_tpu.protocol import arena_pb2
+
+        response = self._rpc(
+            self.stub.CreateRegion,
+            arena_pb2.CreateRegionRequest(
+                byte_size=byte_size, device_id=device_id
+            ),
+        )
+        return response.raw_handle, response.region_id
+
+    def write(self, region_id, offset, data, datatype="", shape=None):
+        if self.arena is not None:
+            self.arena.write(region_id, offset, data, datatype, shape)
+            return
+        from client_tpu.protocol import arena_pb2
+
+        self._rpc(
+            self.stub.WriteRegion,
+            arena_pb2.WriteRegionRequest(
+                region_id=region_id, offset=offset, data=data,
+                datatype=datatype or "", shape=shape or [],
+            ),
+        )
+
+    def read(self, region_id, offset, byte_size) -> bytes:
+        if self.arena is not None:
+            return self.arena.read(region_id, offset, byte_size)
+        from client_tpu.protocol import arena_pb2
+
+        return self._rpc(
+            self.stub.ReadRegion,
+            arena_pb2.ReadRegionRequest(
+                region_id=region_id, offset=offset, byte_size=byte_size
+            ),
+        ).data
+
+    def destroy(self, region_id):
+        if self.arena is not None:
+            self.arena.destroy_region(region_id)
+            return
+        from client_tpu.protocol import arena_pb2
+
+        self._rpc(
+            self.stub.DestroyRegion,
+            arena_pb2.DestroyRegionRequest(region_id=region_id),
+        )
+
+
+_default_transport: Optional[_ArenaTransport] = None
+_transport_lock = threading.Lock()
+allocated_shm_regions: Dict[str, "TpuSharedMemoryHandle"] = {}
+
+
+def set_arena(arena) -> None:
+    """Use an in-process TpuArena (co-located / C-API-analogue mode —
+    the cleanest zero-copy story, SURVEY.md §5 'distributed
+    communication backend')."""
+    global _default_transport
+    with _transport_lock:
+        _default_transport = _ArenaTransport(arena=arena)
+
+
+def set_arena_endpoint(url: str) -> None:
+    """Point this module at a server's arena service (gRPC url, same
+    port as the inference service)."""
+    import grpc
+
+    from client_tpu.server.arena_service import TpuArenaStub
+
+    global _default_transport
+    channel = grpc.insecure_channel(
+        url,
+        options=[
+            ("grpc.max_send_message_length", -1),
+            ("grpc.max_receive_message_length", -1),
+        ],
+    )
+    with _transport_lock:
+        _default_transport = _ArenaTransport(
+            stub=TpuArenaStub(channel), channel=channel
+        )
+
+
+def _transport() -> _ArenaTransport:
+    if _default_transport is None:
+        raise TpuSharedMemoryException(
+            "no TPU arena configured; call set_arena_endpoint(url) or "
+            "set_arena(arena) first"
+        )
+    return _default_transport
+
+
+class TpuSharedMemoryHandle:
+    def __init__(self, name: str, byte_size: int, device_id: int,
+                 raw_handle: bytes, region_id: str,
+                 transport: _ArenaTransport):
+        self._name = name
+        self._byte_size = byte_size
+        self._device_id = device_id
+        self._raw_handle = raw_handle
+        self._region_id = region_id
+        self._transport = transport
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def byte_size(self) -> int:
+        return self._byte_size
+
+    @property
+    def device_id(self) -> int:
+        return self._device_id
+
+
+def create_shared_memory_region(
+    triton_shm_name: str, byte_size: int, device_id: int = 0
+) -> TpuSharedMemoryHandle:
+    """Allocate an HBM region slot of byte_size bytes on device_id
+    (parity: cuda create_shared_memory_region :107)."""
+    transport = _transport()
+    raw_handle, region_id = transport.create(byte_size, device_id)
+    handle = TpuSharedMemoryHandle(
+        triton_shm_name, byte_size, device_id, raw_handle, region_id,
+        transport,
+    )
+    allocated_shm_regions[triton_shm_name] = handle
+    return handle
+
+
+def get_raw_handle(tpu_shm_handle: TpuSharedMemoryHandle) -> bytes:
+    """The serialized region descriptor to pass to
+    register_tpu_shared_memory (parity: cuda get_raw_handle :152,
+    which base64s the cudaIpcMemHandle_t)."""
+    return tpu_shm_handle._raw_handle
+
+
+def set_shared_memory_region(
+    tpu_shm_handle: TpuSharedMemoryHandle, input_values, offset: int = 0
+) -> None:
+    """Copy numpy arrays into the region (one host->device hop).
+    A single array at offset 0 is stored typed, so inference consumes
+    it with zero reinterpretation (parity: cuda
+    set_shared_memory_region :173)."""
+    if not isinstance(input_values, (list, tuple)):
+        raise TpuSharedMemoryException(
+            "input_values must be a list of numpy arrays"
+        )
+    transport = tpu_shm_handle._transport
+    pos = offset
+    for arr in input_values:
+        datatype = np_to_wire_dtype(arr.dtype)
+        if datatype == "BYTES":
+            data = serialize_byte_tensor(arr).tobytes()
+        else:
+            data = np.ascontiguousarray(arr).tobytes()
+        # dtype/shape ride with every tensor, so multi-tensor layouts
+        # become typed device segments (no raw-byte degradation).
+        transport.write(
+            tpu_shm_handle._region_id, pos, data, datatype,
+            list(arr.shape)
+        )
+        pos += len(data)
+
+
+def set_shared_memory_region_from_dlpack(
+    tpu_shm_handle: TpuSharedMemoryHandle, input_value
+) -> None:
+    """Ingest any DLPack-capable tensor (torch, jax, numpy...). An
+    in-process jax.Array on the right device is stored by reference
+    (true zero copy); anything else crosses host->device once
+    (parity: cuda set_shared_memory_region_from_dlpack :328)."""
+    transport = tpu_shm_handle._transport
+    if transport.arena is not None and _is_jax_array(input_value):
+        transport.arena.store(
+            tpu_shm_handle._region_id, 0, tpu_shm_handle._byte_size,
+            input_value,
+        )
+        return
+    host = _dlpack_to_numpy(input_value)
+    datatype = np_to_wire_dtype(host.dtype)
+    transport.write(
+        tpu_shm_handle._region_id, 0,
+        np.ascontiguousarray(host).tobytes(), datatype, list(host.shape),
+    )
+
+
+def _is_jax_array(value) -> bool:
+    try:
+        import jax
+
+        return isinstance(value, jax.Array)
+    except ImportError:  # pragma: no cover
+        return False
+
+
+def _dlpack_to_numpy(value) -> np.ndarray:
+    if isinstance(value, np.ndarray):
+        return value
+    # Host tensors: zero-copy ctypes view via the standalone DLPack
+    # layer (no framework import, parity: reference utils/_dlpack.py).
+    from client_tpu.utils import _dlpack
+
+    try:
+        return _dlpack.to_numpy(value)
+    except Exception:
+        pass
+    # device tensors: go through the producer's own host transfer
+    if hasattr(value, "cpu"):  # torch
+        return value.cpu().numpy()
+    return np.asarray(value)
+
+
+def get_contents_as_numpy(
+    tpu_shm_handle: TpuSharedMemoryHandle, datatype, shape, offset: int = 0
+) -> np.ndarray:
+    """Region contents -> host numpy array (the inspection hop,
+    parity: cuda get_contents_as_numpy :242)."""
+    if isinstance(datatype, str):
+        wire = datatype
+    else:
+        wire = np_to_wire_dtype(np.dtype(datatype))
+    if wire == "BYTES":
+        data = tpu_shm_handle._transport.read(
+            tpu_shm_handle._region_id, offset, 0
+        )
+        return deserialize_bytes_tensor(data).reshape(shape)
+    np_dtype = triton_to_np_dtype(wire) if wire else np.dtype(datatype)
+    count = int(np.prod(shape)) if len(shape) else 1
+    byte_size = count * np.dtype(np_dtype).itemsize
+    data = tpu_shm_handle._transport.read(
+        tpu_shm_handle._region_id, offset, byte_size
+    )
+    if wire == "BF16":
+        return deserialize_bf16_tensor(data).reshape(shape)
+    return np.frombuffer(data, dtype=np_dtype).reshape(shape)
+
+
+class SharedMemoryTensor:
+    """DLPack-capable view of a region (parity:
+    utils/_shared_memory_tensor.py:34). In-process this wraps the live
+    jax.Array (zero copy); remote it wraps a host snapshot."""
+
+    def __init__(self, array):
+        self._array = array
+
+    def __dlpack__(self, stream=None):
+        return self._array.__dlpack__()
+
+    def __dlpack_device__(self):
+        return self._array.__dlpack_device__()
+
+    @property
+    def array(self):
+        return self._array
+
+
+def as_shared_memory_tensor(
+    tpu_shm_handle: TpuSharedMemoryHandle, datatype: str, shape
+) -> SharedMemoryTensor:
+    """Zero-copy device view of the region as datatype/shape (parity:
+    cuda as_shared_memory_tensor :391)."""
+    transport = tpu_shm_handle._transport
+    if transport.arena is not None:
+        return SharedMemoryTensor(
+            transport.arena.as_typed_array(
+                tpu_shm_handle._region_id, 0, tpu_shm_handle._byte_size,
+                datatype, shape,
+            )
+        )
+    return SharedMemoryTensor(
+        get_contents_as_numpy(tpu_shm_handle, datatype, shape)
+    )
+
+
+def destroy_shared_memory_region(
+    tpu_shm_handle: TpuSharedMemoryHandle,
+) -> None:
+    """Free the region slot (parity: cuda destroy_shared_memory_region
+    :414)."""
+    try:
+        tpu_shm_handle._transport.destroy(tpu_shm_handle._region_id)
+    finally:
+        allocated_shm_regions.pop(tpu_shm_handle._name, None)
+
+
+def allocated_shared_memory_regions() -> List[str]:
+    return list(allocated_shm_regions.keys())
