@@ -387,10 +387,16 @@ class InferenceServerClient(InferenceServerClientBase):
 
     def get_trace_settings(self, model_name="", headers=None, as_json=False,
                            client_timeout=None):
-        return self.update_trace_settings(
-            model_name=model_name, settings={}, headers=headers, as_json=as_json,
-            client_timeout=client_timeout
-        )
+        """Pure read: the settings map is never touched, so no server
+        implementation can mistake the request for a write."""
+        try:
+            response = self._client_stub.TraceSetting(
+                pb.TraceSettingRequest(model_name=model_name or ""),
+                metadata=self._metadata(headers), timeout=client_timeout
+            )
+            return _maybe_json(response, as_json)
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
 
     def update_log_settings(self, settings, headers=None, as_json=False,
                             client_timeout=None):
@@ -411,8 +417,15 @@ class InferenceServerClient(InferenceServerClientBase):
             raise_error_grpc(e)
 
     def get_log_settings(self, headers=None, as_json=False, client_timeout=None):
-        return self.update_log_settings({}, headers=headers, as_json=as_json,
-                                        client_timeout=client_timeout)
+        """Pure read (see get_trace_settings)."""
+        try:
+            response = self._client_stub.LogSettings(
+                pb.LogSettingsRequest(), metadata=self._metadata(headers),
+                timeout=client_timeout
+            )
+            return _maybe_json(response, as_json)
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
 
     # -- shared memory ---------------------------------------------------
 

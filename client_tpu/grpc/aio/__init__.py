@@ -189,9 +189,14 @@ class InferenceServerClient(InferenceServerClientBase):
 
     async def get_trace_settings(self, model_name="", headers=None,
                                  client_timeout=None):
-        return await self.update_trace_settings(
-            model_name=model_name, settings={}, headers=headers,
-            client_timeout=client_timeout)
+        """Pure read: sends a TraceSettingRequest with the settings map
+        untouched (never routed through the update path, so no server
+        implementation can mistake it for a write — parity: reference
+        grpc/aio/__init__.py get_trace_settings)."""
+        return await self._call(
+            self._client_stub.TraceSetting,
+            pb.TraceSettingRequest(model_name=model_name or ""),
+            headers, client_timeout)
 
     async def update_log_settings(self, settings, headers=None,
                                   client_timeout=None):
@@ -207,8 +212,10 @@ class InferenceServerClient(InferenceServerClientBase):
                                 headers, client_timeout)
 
     async def get_log_settings(self, headers=None, client_timeout=None):
-        return await self.update_log_settings(
-            {}, headers=headers, client_timeout=client_timeout)
+        """Pure read (see get_trace_settings)."""
+        return await self._call(self._client_stub.LogSettings,
+                                pb.LogSettingsRequest(),
+                                headers, client_timeout)
 
     # -- shared memory ---------------------------------------------------
 

@@ -657,13 +657,18 @@ class LlmModel(ServedModel):
                 init_cache(self.cfg, b), jnp.asarray(lens))  # [b] device
             lanes_idx = np.array([lane for lane, _ in group],
                                  dtype=np.int32)
+            # Row-insert into locals; publish under the lock only after
+            # the gen check below — a concurrent _crash rebuilds the
+            # cache/token carry and an unlocked old-generation rebind
+            # here would clobber the new generation's fresh state.
+            with self._sched_cv:
+                cache = self._batched_cache
+                tokens_dev = self._tokens_dev
             for row, (lane, req) in enumerate(group):
-                self._batched_cache = self._lane_insert_row(
-                    self._batched_cache, multi_cache,
-                    np.int32(row), np.int32(lane))
-            self._tokens_dev = self._set_lane_tokens(
-                self._tokens_dev, jnp.asarray(lanes_idx),
-                firsts[:len(group)])
+                cache = self._lane_insert_row(
+                    cache, multi_cache, np.int32(row), np.int32(lane))
+            tokens_dev = self._set_lane_tokens(
+                tokens_dev, jnp.asarray(lanes_idx), firsts[:len(group)])
             fut = self._fetch_pool.submit(np.asarray, firsts)
             with self._sched_cv:
                 if self._sched_stop or self._gen != gen:
@@ -679,6 +684,8 @@ class LlmModel(ServedModel):
                             if self._gen == gen:
                                 self._free_lanes.append(lane)
                     return
+                self._batched_cache = cache
+                self._tokens_dev = tokens_dev
                 for row, (lane, req) in enumerate(group):
                     self._lane_pos[lane] = len(req.prompt)
                     self._active[lane] = req
@@ -732,19 +739,24 @@ class LlmModel(ServedModel):
                             or self._inflight >= self.MAX_INFLIGHT):
                         continue
                     pos_host = np.asarray(self._lane_pos, dtype=np.int32)
-                toks, self._batched_cache = self._decode_chunk_multi(
-                    self._params, self._tokens_dev, jnp.asarray(pos_host),
-                    self._batched_cache)
-                self._tokens_dev = toks[-1]  # [lanes] device carry
+                    params = self._params
+                    tokens_dev = self._tokens_dev
+                    cache = self._batched_cache
+                toks, new_cache = self._decode_chunk_multi(
+                    params, tokens_dev, jnp.asarray(pos_host), cache)
                 fut = self._fetch_pool.submit(np.asarray, toks)
                 with self._sched_cv:
                     if self._sched_stop or self._gen != gen:
                         # A concurrent _crash/unload reset the pipeline
                         # while this dispatch ran unlocked — registering
                         # the record would hand the NEW generation a
-                        # stale (possibly failing) future and re-mark
-                        # rebuilt free lanes active.
+                        # stale (possibly failing) future, re-mark
+                        # rebuilt free lanes active, or clobber the new
+                        # generation's freshly rebuilt cache/token carry
+                        # with this old generation's outputs.
                         return
+                    self._batched_cache = new_cache
+                    self._tokens_dev = toks[-1]  # [lanes] device carry
                     snapshot = dict(self._active)
                     for lane in snapshot:
                         self._lane_pos[lane] += self.STREAM_CHUNK

@@ -83,6 +83,16 @@ class _ModelStats:
             self.last_inference_ms = int(time.time() * 1000)
 
 
+def stream_error_response(request, message):
+    """Decoupled errors ride the stream (never abort it) and carry the
+    request id so a client pipelining many requests on one stream can
+    attribute the failure (concurrent dispatch means arrival order
+    proves nothing)."""
+    response = pb.ModelStreamInferResponse(error_message=message)
+    response.infer_response.id = request.id
+    return response
+
+
 def _param_value(param: pb.InferParameter):
     which = param.WhichOneof("parameter_choice")
     return getattr(param, which) if which else None
@@ -523,12 +533,10 @@ class InferenceServerCore:
             stats.record(max(count, 1), 0, 0, time.monotonic_ns() - t0, 0, ok=True)
         except InferenceServerException as e:
             stats.record(1, 0, 0, time.monotonic_ns() - t0, 0, ok=False)
-            yield pb.ModelStreamInferResponse(error_message=str(e))
+            yield stream_error_response(request, str(e))
         except Exception as e:
             stats.record(1, 0, 0, time.monotonic_ns() - t0, 0, ok=False)
-            yield pb.ModelStreamInferResponse(
-                error_message="inference failed: %s" % e
-            )
+            yield stream_error_response(request, "inference failed: %s" % e)
 
     # -- shared memory verbs --------------------------------------------
 

@@ -13,7 +13,7 @@ from client_tpu.protocol.service import (
     GRPCInferenceServiceServicer,
     add_GRPCInferenceServiceServicer_to_server,
 )
-from client_tpu.server.core import InferenceServerCore
+from client_tpu.server.core import InferenceServerCore, stream_error_response
 from client_tpu.utils import InferenceServerException
 
 _STATUS_MAP = {
@@ -108,10 +108,10 @@ class InferenceServicer(GRPCInferenceServiceServicer):
                         break
             except InferenceServerException as e:
                 # decoupled errors ride the stream, not abort it
-                put_out(pb.ModelStreamInferResponse(error_message=str(e)))
+                put_out(stream_error_response(request, str(e)))
             except Exception as e:  # noqa: BLE001 — never kill the stream
-                put_out(pb.ModelStreamInferResponse(
-                    error_message="internal error: %s" % e))
+                put_out(stream_error_response(
+                    request, "internal error: %s" % e))
             finally:
                 generator.close()
 

@@ -1346,8 +1346,12 @@ std::shared_ptr<MockBackendStats> g_mock_stats =
 
 class MockInferResult : public InferResult {
  public:
-  explicit MockInferResult(const Error& status, std::string id = "")
-      : status_(status), id_(std::move(id)), data_(64, '\0') {}
+  explicit MockInferResult(const Error& status, std::string id = "",
+                           bool final_response = true)
+      : status_(status), id_(std::move(id)), data_(64, '\0'),
+        final_(final_response) {}
+
+  bool IsFinalResponse() const { return final_; }
 
   Error ModelName(std::string* name) const override {
     *name = "mock";
@@ -1388,12 +1392,17 @@ class MockInferResult : public InferResult {
   Error status_;
   std::string id_;
   std::string data_;
+  bool final_;
 };
 
 class MockBackend : public ClientBackend {
  public:
   explicit MockBackend(const BackendConfig& config)
-      : delay_us_(config.mock_delay_us), error_rate_(config.mock_error_rate) {}
+      : delay_us_(config.mock_delay_us), error_rate_(config.mock_error_rate),
+        responses_per_request_(
+            config.mock_responses_per_request > 0
+                ? config.mock_responses_per_request
+                : 1) {}
 
   ~MockBackend() override {
     StopStream();
@@ -1545,6 +1554,16 @@ class MockBackend : public ClientBackend {
     inflight_++;
     std::string id = options.request_id;
     std::thread([this, callback = std::move(callback), id] {
+      // Decoupled simulation: n-1 non-final responses then the final
+      // one; the per-response delay spreads the timestamps so tests
+      // can assert ordering.
+      for (uint64_t i = 0; i + 1 < responses_per_request_; ++i) {
+        if (delay_us_ > 0) {
+          std::this_thread::sleep_for(std::chrono::microseconds(delay_us_));
+        }
+        callback(new MockInferResult(Error::Success, id,
+                                     /*final_response=*/false));
+      }
       if (delay_us_ > 0) {
         std::this_thread::sleep_for(std::chrono::microseconds(delay_us_));
       }
@@ -1582,6 +1601,7 @@ class MockBackend : public ClientBackend {
 
   uint64_t delay_us_;
   double error_rate_;
+  uint64_t responses_per_request_;
   std::atomic<int64_t> inflight_{0};
   std::mutex stream_mutex_;
   OnCompleteFn stream_callback_;
@@ -1594,6 +1614,8 @@ bool IsFinalStreamResponse(const InferResult* result) {
   if (grpc_result != nullptr) return grpc_result->IsFinalResponse();
   const auto* openai_result = dynamic_cast<const OpenAiInferResult*>(result);
   if (openai_result != nullptr) return openai_result->IsFinalResponse();
+  const auto* mock_result = dynamic_cast<const MockInferResult*>(result);
+  if (mock_result != nullptr) return mock_result->IsFinalResponse();
   return true;
 }
 

@@ -56,6 +56,9 @@ struct BackendConfig {
   // MOCK: simulated per-request latency and failure rate.
   uint64_t mock_delay_us = 500;
   double mock_error_rate = 0.0;
+  // MOCK: stream responses per request (>1 simulates a decoupled
+  // model — only the last response carries the final flag).
+  uint64_t mock_responses_per_request = 1;
   // IN_PROCESS: comma-separated models for embed.init to warm.
   std::string inprocess_models;
   // TFSERVING: gRPC PredictionService (native protocol) vs REST.

@@ -4,6 +4,7 @@
 
 #include <cstdint>
 #include <cstdlib>
+#include <string>
 
 namespace tpuclient {
 namespace perf {
@@ -49,15 +50,40 @@ MPIDriver::MPIDriver(bool is_enabled) {
   type_int_ = dlsym(handle_, "ompi_mpi_int");
   op_land_ = dlsym(handle_, "ompi_mpi_op_land");
   if (comm_world_ == nullptr && init_ != nullptr) {
-    // No OpenMPI handle symbols but MPI entry points resolved: assume
-    // the MPICH ABI family (MPICH, Intel MPI, MVAPICH2, Cray MPT all
-    // share these integer-constant handles; none exports a reliable
-    // family-identifying symbol to key on, and a non-MPICH-ABI
-    // library would also be gated off by the launcher-env check
-    // below).
-    comm_world_ = reinterpret_cast<void*>(kMpichCommWorld);
-    type_int_ = reinterpret_cast<void*>(kMpichTypeInt);
-    op_land_ = reinterpret_cast<void*>(kMpichOpLand);
+    // No OpenMPI handle symbols: the integer-constant fallback is
+    // only valid for the MPICH ABI family (MPICH, Intel MPI,
+    // MVAPICH2, Cray MPT). Identify the family before trusting it —
+    // a non-MPICH-ABI libmpi under a PMI-setting launcher would
+    // otherwise be handed garbage handles in MPI_Allreduce.
+    // MPI_Get_library_version is MPI-3 and callable before MPI_Init;
+    // every MPICH descendant names its lineage in the string. The
+    // MPIR_* internal exports fingerprint MPICH lineage for builds
+    // too old to have it.
+    bool mpich_family = false;
+    auto version_fn = reinterpret_cast<int (*)(char*, int*)>(
+        dlsym(handle_, "MPI_Get_library_version"));
+    if (version_fn != nullptr) {
+      static char version[8704] = {0};  // >= MPICH's 8192 string max
+      int len = 0;
+      if (version_fn(version, &len) == 0) {
+        const std::string v(version);
+        mpich_family = v.find("MPICH") != std::string::npos ||
+                       v.find("Intel(R) MPI") != std::string::npos ||
+                       v.find("MVAPICH") != std::string::npos ||
+                       v.find("CRAY") != std::string::npos;
+      }
+    }
+    // Rebranded derivatives (e.g. ParaStation) may name neither
+    // lineage in the string; the MPIR_* internal exports still
+    // fingerprint the MPICH code base.
+    if (!mpich_family) {
+      mpich_family = dlsym(handle_, "MPIR_Err_create_code") != nullptr;
+    }
+    if (mpich_family) {
+      comm_world_ = reinterpret_cast<void*>(kMpichCommWorld);
+      type_int_ = reinterpret_cast<void*>(kMpichTypeInt);
+      op_land_ = reinterpret_cast<void*>(kMpichOpLand);
+    }
   }
   // Active only when everything resolved AND launched under a real
   // launcher (mpirun/mpiexec set these; a singleton would need the

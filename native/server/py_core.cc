@@ -163,18 +163,40 @@ namespace {
 // call forwards one serialized response to the transport's emit
 // closure with the GIL released (the socket write may block on h2
 // flow control; holding the GIL there would stall every other call).
+// The StreamEmit the capsule refers to lives on StreamCall's stack, so
+// a handler that retains the emit callable past the call (e.g. a
+// future async path) must get a safe no-op (False = stream gone),
+// never a dangling dereference. The capsule therefore owns a heap
+// holder whose mutex spans pointer-fetch AND invoke: expiry (below)
+// blocks until any in-flight emit drains, closing the window where a
+// fetched pointer outlives the frame across a GIL release.
+struct EmitHolder {
+  std::mutex mu;
+  const GrpcHandler::StreamEmit* emit = nullptr;  // null once expired
+};
+
+extern "C" void DestroyEmitHolder(PyObject* capsule) {
+  delete static_cast<EmitHolder*>(
+      PyCapsule_GetPointer(capsule, "tpuclient.stream_emit"));
+}
+
 extern "C" PyObject* EmitTrampoline(PyObject* self, PyObject* args) {
-  auto* emit = static_cast<const GrpcHandler::StreamEmit*>(
+  auto* holder = static_cast<EmitHolder*>(
       PyCapsule_GetPointer(self, "tpuclient.stream_emit"));
   const char* data = nullptr;
   Py_ssize_t size = 0;
-  if (emit == nullptr || !PyArg_ParseTuple(args, "y#", &data, &size)) {
+  if (holder == nullptr || !PyArg_ParseTuple(args, "y#", &data, &size)) {
     return nullptr;
   }
   std::string payload(data, (size_t)size);
   bool ok = false;
   Py_BEGIN_ALLOW_THREADS
-  ok = (*emit)(payload);
+  {
+    // mu is released before the GIL is re-acquired, so expiry blocking
+    // on mu while holding the GIL cannot deadlock against this thread.
+    std::lock_guard<std::mutex> lock(holder->mu);
+    ok = holder->emit != nullptr && (*holder->emit)(payload);
+  }
   Py_END_ALLOW_THREADS
   return PyBool_FromLong(ok ? 1 : 0);
 }
@@ -188,8 +210,11 @@ GrpcReply PyCoreHandler::StreamCall(const std::string& path,
                                     const StreamEmit& emit) {
   GrpcReply reply;
   PyGILState_STATE gil = PyGILState_Ensure();
-  PyObject* capsule = PyCapsule_New(
-      const_cast<StreamEmit*>(&emit), "tpuclient.stream_emit", nullptr);
+  auto* holder = new EmitHolder;
+  holder->emit = &emit;
+  PyObject* capsule =
+      PyCapsule_New(holder, "tpuclient.stream_emit", DestroyEmitHolder);
+  if (capsule == nullptr) delete holder;
   PyObject* emit_fn =
       capsule != nullptr ? PyCFunction_New(&kEmitDef, capsule) : nullptr;
   if (emit_fn == nullptr) {
@@ -205,6 +230,13 @@ GrpcReply PyCoreHandler::StreamCall(const std::string& path,
     ParseAbort(FetchPyError("grpc_stream_call_emit"), &reply);
   } else {
     Py_DECREF(r);
+  }
+  // Expire before the frame's StreamEmit dies: blocks on mu until any
+  // in-flight emit drains (its lock is released GIL-free, so waiting
+  // here with the GIL held cannot deadlock), then later calls no-op.
+  {
+    std::lock_guard<std::mutex> lock(holder->mu);
+    holder->emit = nullptr;
   }
   Py_DECREF(emit_fn);
   Py_DECREF(capsule);

@@ -32,8 +32,10 @@ struct Harness {
   InferDataManager data_manager;
 
   explicit Harness(uint64_t delay_us = 300)
-      : factory(MockConfig(delay_us)), loader(&model),
-        data_manager(&model, &loader) {
+      : Harness(MockConfig(delay_us)) {}
+
+  explicit Harness(const BackendConfig& config)
+      : factory(config), loader(&model), data_manager(&model, &loader) {
     factory.Create(&backend);
     ModelParser::Parse(backend.get(), "mock", "", 1, &model);
     loader.GenerateData();
@@ -388,6 +390,45 @@ TEST_CASE("perf: streaming concurrency mode") {
     if (r.valid()) valid++;
   }
   CHECK(valid > 5);
+}
+
+TEST_CASE("perf: decoupled stream responses attribute to their request") {
+  // Pins the decoupled-statistics contract stated in
+  // docs/perf_analyzer.md: every response pairs to the RECORD OF THE
+  // REQUEST THAT ISSUED IT (echoed request id; FIFO fallback), a
+  // request retires only on its final-flagged response, latency =
+  // final response - send, and request throughput counts requests —
+  // never responses. (The reference documents its own punt here:
+  // grpc_client.cc FIXME DLIS-1263.)
+  ResetMockBackendStats();
+  BackendConfig config = MockConfig(100);
+  config.mock_responses_per_request = 3;
+  Harness h(config);
+  ConcurrencyManager manager(
+      &h.factory, &h.model, &h.loader, &h.data_manager,
+      LoadManager::Options{/*async=*/true, /*streaming=*/true,
+                           /*max_threads=*/2});
+  REQUIRE_OK(manager.Init());
+  REQUIRE_OK(manager.ChangeConcurrencyLevel(4));
+  std::this_thread::sleep_for(std::chrono::milliseconds(400));
+  manager.Stop();
+  auto records = manager.SwapRequestRecords();
+  size_t valid = 0;
+  for (const auto& r : records) {
+    if (!r.valid()) continue;
+    valid++;
+    // All of a request's responses land on ITS record: a split or
+    // cross-request misattribution shows up as a wrong count.
+    CHECK_EQ(r.end_ns.size(), 3u);
+    CHECK(r.end_ns.front() >= r.start_ns);
+    for (size_t i = 1; i < r.end_ns.size(); ++i) {
+      CHECK(r.end_ns[i] >= r.end_ns[i - 1]);
+    }
+    CHECK_EQ(r.latency_ns(), r.end_ns.back() - r.start_ns);
+  }
+  CHECK(valid > 5);
+  // Request throughput counts requests, not responses.
+  CHECK(valid <= GetMockBackendStats()->stream_infer_calls.load());
 }
 
 TEST_CASE("perf: request rate manager paces dispatch") {

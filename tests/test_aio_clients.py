@@ -101,6 +101,37 @@ def test_grpc_aio_full_endpoint_surface(servers, tmp_path):
     asyncio.run(run())
 
 
+def test_grpc_aio_get_trace_settings_is_pure_read(servers, tmp_path):
+    """get_trace_settings must not write: after an update, repeated
+    gets return identical settings — a get implemented as an
+    empty-settings update could clear or overwrite state on server
+    implementations that treat a present map as a write (parity:
+    reference grpc/aio get methods issue get RPCs)."""
+    grpc_handle, _ = servers
+
+    async def run():
+        async with grpcclient_aio.InferenceServerClient(
+            grpc_handle.address
+        ) as client:
+            trace_file = str(tmp_path / "pure_read_trace.jsonl")
+            await client.update_trace_settings(
+                "simple", {"trace_level": ["TIMESTAMPS"],
+                           "trace_file": trace_file, "trace_rate": 7})
+            first = await client.get_trace_settings("simple")
+            second = await client.get_trace_settings("simple")
+            assert first.settings["trace_rate"].value[0] == "7"
+            assert first.settings["trace_file"].value[0] == trace_file
+            # get-without-write: the read changed nothing
+            assert first.settings == second.settings
+            logs_first = await client.get_log_settings()
+            logs_second = await client.get_log_settings()
+            assert logs_first.settings == logs_second.settings
+            await client.update_trace_settings(
+                "simple", {"trace_level": ["OFF"]})
+
+    asyncio.run(run())
+
+
 def test_http_aio_full_endpoint_surface(servers, tmp_path):
     """http.aio's tail endpoints: trace/log settings + statistics +
     model control reach the sync client's surface."""
