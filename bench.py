@@ -154,6 +154,50 @@ def build_native_harness(deadline_s: float) -> bool:
     return built
 
 
+def as_cpu_fallback(stage: dict) -> dict:
+    """Strip TPU-anchored comparison fields from a CPU-measured stage:
+    a CPU number against a TPU/reference baseline is apples-to-oranges."""
+    return {k: v for k, v in stage.items()
+            if not k.startswith(("vs_", "baseline_"))
+            and "mfu" not in k
+            and not k.endswith("_device")
+            and "relay_fetch" not in k
+            and k != "itl_p99_improvement"}
+
+
+# Stages whose model is host-placed (numpy `simple`): their measurement
+# is identical on every jax platform, and their vs_baseline anchors the
+# reference's own published host-side rows — a CPU-platform run of
+# these is NOT degraded data, so they keep their names and anchors.
+HOST_PLACED_STAGES = frozenset({
+    "simple_grpc", "simple_inprocess", "simple_grpc_native_server",
+    "simple_http_native_server_c1", "simple_inprocess_native",
+})
+
+
+def merge_cpu_stages(result: dict, cpu_stages: dict) -> None:
+    """Fold CPU-measured stages into `result`: device-bound stages under
+    `_cpu_fallback` names with TPU anchors stripped, host-placed stages
+    untouched. Never overwrites a stage measured on the real platform."""
+    for name, stage in (cpu_stages or {}).items():
+        if name in result["stages"]:
+            continue
+        if name in HOST_PLACED_STAGES:
+            result["stages"][name] = stage
+        else:
+            result["stages"][name + "_cpu_fallback"] = as_cpu_fallback(stage)
+
+
+def tpu_stages_missing(result: dict) -> list:
+    """Model-bound stage names absent from a TPU-labeled run (wedge or
+    budget casualties) — the set a relay-recovery retry should target."""
+    want = ("resnet50_tpu_shm_grpc", "resnet50_inprocess",
+            "bert_grpc_sysshm", "ensemble_stream_grpc",
+            "llm_generate_stream")
+    have = set(result.get("stages", {}))
+    return [name for name in want if name not in have]
+
+
 def main() -> None:
     os.chdir(REPO)
     # Round-1 evidence: the driver let bench.py run >=25 min before
@@ -170,31 +214,72 @@ def main() -> None:
     # minutes.
     result = run_child("", init_deadline_s=budget * 0.6,
                        deadline_ts=deadline_ts)
+    if result is not None and result.get("stages") \
+            and result.get("platform") != "tpu":
+        # The "default platform" attempt itself came up on CPU (axon
+        # never registered — a driver box, or a relay env failure with
+        # no wedge). Same honesty contract as the explicit fallback:
+        # suffix everything, strip TPU anchors.
+        log("attempt 1 ran on %s — labeling all stages cpu_fallback"
+            % result.get("platform"))
+        relabeled = dict(result, stages={})
+        merge_cpu_stages(relabeled, result["stages"])
+        result = relabeled
     if (result is None or not result.get("stages")) \
             and deadline_ts - time.time() > 120:
+        # Whole-run fallback: every stage below was measured on CPU, so
+        # every stage gets the `_cpu_fallback` suffix and loses its
+        # TPU-anchored comparison fields — same contract as the
+        # partial-supplement path (the r04 record violated this).
         log("falling back to CPU platform")
-        result = run_child("cpu", init_deadline_s=120.0,
-                           deadline_ts=deadline_ts)
-    elif (result is not None
-          and str(result.get("device_probe", "")).startswith("stalled:")
-          and "resnet50_tpu_shm_grpc" not in result.get("stages", {})
-          and deadline_ts - time.time() > 180):
-        # Relay wedged: the TPU attempt measured only the host-placed
-        # stages. Supplement the model-bound stages on CPU under
-        # *_cpu_fallback names — visible data, never the headline
-        # (their throughputs don't compare to TPU numbers).
-        log("TPU relay wedged — supplementing model stages on CPU")
         cpu_result = run_child("cpu", init_deadline_s=120.0,
-                               deadline_ts=deadline_ts,
-                               skip_stages=sorted(result["stages"]))
-        for name, stage in ((cpu_result or {}).get("stages") or {}).items():
-            if name not in result["stages"]:
-                # Strip TPU-anchored comparison fields: a CPU number
-                # against a TPU baseline is apples-to-oranges.
-                stage = {k: v for k, v in stage.items()
-                         if not k.startswith(("vs_", "baseline_", "mfu"))
-                         and k != "itl_p99_improvement"}
-                result["stages"][name + "_cpu_fallback"] = stage
+                               deadline_ts=deadline_ts)
+        if cpu_result is not None and cpu_result.get("stages"):
+            result = dict(cpu_result, stages={})
+            merge_cpu_stages(result, cpu_result["stages"])
+        # The relay wedge is transient (r04 wedged mid-round, r03
+        # succeeded end-of-round): with budget left, give TPU one more
+        # shot under a short init deadline. Real-platform stages merge
+        # in under their true names and outrank the CPU fallbacks.
+        if deadline_ts - time.time() > 300:
+            log("retrying TPU after CPU fallback (short init deadline)")
+            retry = run_child("", init_deadline_s=180.0,
+                              deadline_ts=deadline_ts)
+            if retry is not None and retry.get("platform") == "tpu" \
+                    and retry.get("stages"):
+                if result is not None and result.get("stages"):
+                    merged = dict(retry)
+                    merged["stages"] = dict(result["stages"])
+                    merged["stages"].update(retry["stages"])
+                    result = merged
+                else:
+                    result = retry
+    elif (result is not None
+          and str(result.get("device_probe", "")).startswith("stalled")
+          and tpu_stages_missing(result)
+          and deadline_ts - time.time() > 180):
+        # Relay wedged mid-run: the TPU attempt measured only the
+        # host-placed stages. First retry the missing model-bound
+        # stages on TPU (the wedge is transient), then supplement
+        # whatever still lacks a number on CPU under *_cpu_fallback
+        # names — visible data, never the headline.
+        if deadline_ts - time.time() > 420:
+            log("TPU relay wedged — retrying model stages on TPU")
+            retry = run_child("", init_deadline_s=180.0,
+                              deadline_ts=deadline_ts - 240,
+                              skip_stages=sorted(result["stages"]))
+            if retry is not None and retry.get("platform") == "tpu":
+                for name, stage in (retry.get("stages") or {}).items():
+                    result["stages"].setdefault(name, stage)
+                if not str(retry.get("device_probe", "")
+                           ).startswith("stalled"):
+                    result["device_probe"] = "stalled-then-recovered"
+        if tpu_stages_missing(result) and deadline_ts - time.time() > 180:
+            log("supplementing still-missing model stages on CPU")
+            cpu_result = run_child("cpu", init_deadline_s=120.0,
+                                   deadline_ts=deadline_ts,
+                                   skip_stages=sorted(result["stages"]))
+            merge_cpu_stages(result, (cpu_result or {}).get("stages") or {})
     if result is None or not result.get("stages"):
         print(json.dumps({"metric": "bench_failed", "value": 0,
                           "unit": "infer/sec", "vs_baseline": 0}))
@@ -215,9 +300,10 @@ def main() -> None:
         # under an explicit cpu-fallback name with no TPU-anchored
         # comparison, never a TPU metric name.
         head_key, head = next(iter(stages.items()))
-        head = {k: v for k, v in head.items()
-                if not k.startswith(("vs_", "baseline_"))}
-        eligible = {head_key + "_cpu_fallback": head}
+        head = as_cpu_fallback(head)
+        if not head_key.endswith("_cpu_fallback"):
+            head_key += "_cpu_fallback"
+        eligible = {head_key: head}
     for head_key, head_name in (
         ("resnet50_tpu_shm_grpc",
          "resnet50_tpu_shm_grpc_batch8_c4_infer_per_sec"),

@@ -184,3 +184,14 @@ class BertModel(ServedModel):
             ids = jnp.zeros((batch, seq), dtype=jnp.int32)
             jax.block_until_ready(self._fn(self._params, ids,
                                            jnp.ones_like(ids)))
+
+    def flops_estimate(self, batch: int, seq: int = 0):
+        # Encoder forward at padded length S, per layer:
+        #   QKV+output projections 8*S*d^2, FFN 2*2*S*d*d_ff,
+        #   attention scores+context 4*S^2*d.
+        cfg = self.cfg
+        s = seq or _bucket_length(1, cfg.max_seq)
+        per_layer = (8 * s * cfg.d_model ** 2
+                     + 4 * s * cfg.d_model * cfg.d_ff
+                     + 4 * s * s * cfg.d_model)
+        return float(batch * cfg.n_layers * per_layer)

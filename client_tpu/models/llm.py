@@ -924,6 +924,16 @@ class LlmModel(ServedModel):
         text = self._tokenizer.decode(tokens)
         return {"text_output": np.array([text.encode()], dtype=np.object_)}
 
+    def flops_per_token(self) -> float:
+        """Decode FLOPs per generated token ≈ 2 * parameter count
+        (matmul-dominated; KV-cache attention reads are minor at tiny
+        sequence lengths) — the serving-MFU numerator."""
+        import jax as _jax
+
+        n_params = sum(int(x.size) for x in _jax.tree_util.tree_leaves(
+            self._params))
+        return 2.0 * n_params
+
     def warmup(self) -> None:
         # Prime the prefill shapes concurrent serving hits (power-of
         # -two join batches x the two common prompt buckets) so no

@@ -259,17 +259,22 @@ class InferenceServerCore:
     def trace_setting(self, model_name: str, updates: Dict[str, list]
                       ) -> Dict[str, list]:
         with self._trace_lock:
-            if updates:
-                # Flush every buffered state under its PRE-update
-                # settings (so records land in the file they were
-                # recorded for), then re-arm the sampling counters of
-                # the states the updated key governs (Triton re-arms
-                # trace_count on settings updates).
-                for name, state in self._trace_state.items():
-                    if state["buffer"]:
-                        self._flush_trace(
-                            name, self._effective_trace_settings(name),
-                            state)
+            if not updates:
+                # Pure read: snapshotting per-model settings here
+                # (setdefault) would freeze this model against later
+                # global updates — a get must not change what a future
+                # update_trace_settings("") applies to.
+                return dict(self._effective_trace_settings(model_name))
+            # Flush every buffered state under its PRE-update settings
+            # (so records land in the file they were recorded for),
+            # then re-arm the sampling counters of the states the
+            # updated key governs (Triton re-arms trace_count on
+            # settings updates).
+            for name, state in self._trace_state.items():
+                if state["buffer"]:
+                    self._flush_trace(
+                        name, self._effective_trace_settings(name),
+                        state)
             settings = self._trace_settings.setdefault(
                 model_name, dict(self._trace_settings[""])
             )
@@ -279,13 +284,12 @@ class InferenceServerCore:
                         self._trace_settings[""].get(key, []))
                 else:
                     settings[key] = [str(v) for v in value]
-            if updates:
-                for name, state in self._trace_state.items():
-                    governed = name == model_name or (
-                        model_name == "" and name not in self._trace_settings)
-                    if governed:
-                        state["seen"] = 0
-                        state["emitted"] = 0
+            for name, state in self._trace_state.items():
+                governed = name == model_name or (
+                    model_name == "" and name not in self._trace_settings)
+                if governed:
+                    state["seen"] = 0
+                    state["emitted"] = 0
         return settings
 
     def _maybe_trace(self, model_name: str, request_id: str, t0: int,

@@ -83,6 +83,12 @@ class ServedModel:
     def unload(self) -> None:
         """Release device resources (optional)."""
 
+    def flops_estimate(self, batch: int, seq: int = 0):
+        """Analytic FLOPs for ONE forward execution at this batch size
+        (``seq`` for sequence models) — the MFU numerator the bench
+        divides by measured device time.  None = not modeled."""
+        return None
+
     # -- protocol views --------------------------------------------------
 
     def metadata_pb(self) -> pb.ModelMetadataResponse:

@@ -126,6 +126,16 @@ def test_grpc_aio_get_trace_settings_is_pure_read(servers, tmp_path):
             logs_first = await client.get_log_settings()
             logs_second = await client.get_log_settings()
             assert logs_first.settings == logs_second.settings
+            # A get on a model with no model-specific settings must not
+            # snapshot one: a later GLOBAL update still applies to it.
+            globals_before = await client.get_trace_settings("")
+            await client.get_trace_settings("add_sub_fp32")
+            await client.update_trace_settings("", {"trace_rate": 13})
+            after = await client.get_trace_settings("add_sub_fp32")
+            assert after.settings["trace_rate"].value[0] == "13"
+            old_rate = list(
+                globals_before.settings["trace_rate"].value) or ["1"]
+            await client.update_trace_settings("", {"trace_rate": old_rate})
             await client.update_trace_settings(
                 "simple", {"trace_level": ["OFF"]})
 

@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Mid-round TPU self-measurement: the same stages the end-of-round
+bench runs, invocable cheaply at any time.
+
+Thin wrapper over ``client_tpu.perf.bench_child`` (the single source of
+truth for stage definitions, watchdogs, and honest-degradation rules) —
+this script only builds the native harness, computes a deadline, runs
+the child on the image's default platform, and pretty-prints the
+per-stage record.  Results land in ``--out`` (default
+``/tmp/measure_tpu.json``) in exactly the schema ``bench.py`` emits
+under ``"stages"``, so a mid-round record can be compared field-by-field
+with the driver's ``BENCH_r*.json``.
+
+Usage:
+    python tools/measure_tpu.py                    # all stages, 20 min
+    python tools/measure_tpu.py --budget 600       # quick pass
+    python tools/measure_tpu.py --skip-stages simple_grpc,simple_inprocess
+"""
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--budget", type=float, default=1200.0,
+                    help="wall-clock budget in seconds (default 1200)")
+    ap.add_argument("--out", default="/tmp/measure_tpu.json")
+    ap.add_argument("--skip-stages", default="",
+                    help="comma-separated stage names to skip")
+    ap.add_argument("--platform", default="",
+                    help="force a jax platform (default: image default, "
+                         "i.e. TPU when the relay is up)")
+    ap.add_argument("--skip-build", action="store_true",
+                    help="reuse the existing native harness binary")
+    args = ap.parse_args()
+
+    t0 = time.time()
+    sys.path.insert(0, str(REPO))
+    import bench
+
+    if not args.skip_build:
+        bench.build_native_harness(deadline_s=min(300.0, args.budget * 0.3))
+
+    # bench.run_child owns the init-marker watchdog (a wedged relay can
+    # hang jax init forever — the child's own deadline checks only run
+    # after init), the SIGINT partial-flush, and the CPU env knobs that
+    # must be set before the interpreter starts.
+    result = bench.run_child(
+        args.platform, init_deadline_s=max(60.0, args.budget * 0.6),
+        deadline_ts=t0 + args.budget,
+        skip_stages=sorted(filter(None, args.skip_stages.split(","))))
+    if result is None:
+        print("no result — child missed init deadline or died",
+              file=sys.stderr)
+        sys.exit(1)
+    pathlib.Path(args.out).write_text(json.dumps(result, indent=2))
+    print(json.dumps(result, indent=2))
+    print("\nplatform=%s harness=%s probe=%s wall=%.0fs -> %s"
+          % (result.get("platform"), result.get("harness"),
+             result.get("device_probe"), time.time() - t0, args.out),
+          file=sys.stderr)
+    sys.exit(0 if result.get("stages") else 1)
+
+
+if __name__ == "__main__":
+    main()
