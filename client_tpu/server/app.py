@@ -79,11 +79,29 @@ def start_grpc_server(
 
         extra.append(arena_servicer_entry(core.memory.arena))
     host = address.rsplit(":", 1)[0]
+
+    def publish_arena_route(port: int) -> None:
+        # Handles minted once serving starts carry this address, making
+        # them redeemable from other hosts via the DCN pull path —
+        # which is why this runs post-bind but PRE-serve (a handle
+        # minted by the first request must already be routed). 0.0.0.0
+        # is a bind address, not a route — leave routing to the
+        # deployment in that case (CLIENT_TPU_ARENA_URL overrides).
+        arena = core.memory.arena
+        if arena is None or arena.public_url:
+            return
+        route = os.environ.get("CLIENT_TPU_ARENA_URL") or (
+            "%s:%d" % (host, port)
+            if host not in ("0.0.0.0", "[::]", "") else "")
+        if route:
+            arena.set_public_url(route)
+
     if aio:
         from client_tpu.server.grpc_server import AioGrpcServerThread
 
         server = AioGrpcServerThread(core, address, extra_servicers=extra,
-                                     max_workers=max_workers)
+                                     max_workers=max_workers,
+                                     on_bound=publish_arena_route)
         port = server.port
     else:
         server = build_grpc_server(core, address=None,
@@ -92,6 +110,7 @@ def start_grpc_server(
         port = server.add_insecure_port(address)
         if port == 0:
             raise RuntimeError("unable to bind %s" % address)
+        publish_arena_route(port)
         server.start()
     return ServerHandle(core, server, "%s:%d" % (host, port))
 

@@ -1,0 +1,263 @@
+"""Cross-host (DCN) arena pull path.
+
+Consumer side of `docs/cross_host_arena.md` rule 2: when a request
+lands on host A but its shm-referenced tensor lives in host B's arena,
+A *pulls* — streams B's typed segments over the arena service and
+`device_put`s them into its own arena, then serves locally.
+
+Design points (vs the old ReadRegion byte copy):
+
+- **Typed, not a blob**: segment metadata (offset/dtype/shape) rides
+  with the bytes, so the pulled region reproduces the owner's typed
+  layout and the zero-copy `as_typed_array` fast path works on the
+  consumer exactly as on the owner.
+- **No whole-region host bounce on the consumer**: each network chunk
+  is `device_put` as it arrives; assembly (concatenate + bitcast to
+  the segment dtype) happens on the consumer's device. Host memory
+  holds at most one chunk at a time per segment.
+- **The handle is the capability**: the owner authenticates the full
+  descriptor (arena_id + region + nonce) before any byte leaves it.
+
+The reference's zero-copy contract this replaces:
+`src/c++/perf_analyzer/infer_data_manager_shm.h:56` (CUDA-IPC regions
+shared by address); CUDA IPC cannot cross hosts at all — the pull path
+is the TPU-native extension of the same handle-redemption model to a
+DCN-connected fleet.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterator, Optional
+
+import numpy as np
+
+from client_tpu.protocol import arena_pb2
+from client_tpu.server.tpu_arena import TpuArena
+from client_tpu.utils import (
+    InferenceServerException,
+    deserialize_bytes_tensor,
+    triton_to_np_dtype,
+    wire_dtype_element_size,
+)
+
+DEFAULT_CHUNK_BYTES = 2 * 1024 * 1024
+
+
+# -- owner side -----------------------------------------------------------
+
+def iter_region_chunks(arena: TpuArena, raw_handle: bytes,
+                       chunk_bytes: int = 0
+                       ) -> Iterator[arena_pb2.PullRegionChunk]:
+    """Stream a region's segments as PullRegionChunk messages.
+
+    Serialization happens per segment AFTER the snapshot (segment
+    arrays are immutable), so the owner never holds its region lock
+    across a device->host transfer or a network send."""
+    region = arena.resolve_pull_handle(raw_handle)
+    chunk_bytes = chunk_bytes or DEFAULT_CHUNK_BYTES
+    segments = arena.snapshot_segments(region.region_id)
+    first = True
+
+    def stamp(msg):
+        nonlocal first
+        if first:
+            msg.region_byte_size = region.byte_size
+            msg.device_id = region.device_id
+            first = False
+        return msg
+
+    if not segments:
+        # Empty region: one metadata-only chunk so the consumer can
+        # still size and create its local region.
+        yield stamp(arena_pb2.PullRegionChunk(segment_nbytes=0))
+        return
+    for index, segment in enumerate(segments):
+        raw = TpuArena._segment_bytes(segment)
+        position = 0
+        while True:
+            data = raw[position:position + chunk_bytes]
+            yield stamp(arena_pb2.PullRegionChunk(
+                segment_index=index,
+                segment_offset=segment.offset,
+                segment_nbytes=len(raw),
+                datatype=segment.datatype or "",
+                shape=segment.shape or [],
+                chunk_offset=position,
+                data=data,
+            ))
+            position += len(data)
+            if position >= len(raw):
+                break
+
+
+# -- consumer side --------------------------------------------------------
+
+def _typed_from_u8(jax, flat_u8, datatype: str, shape):
+    """Reinterpret a flat uint8 device array as datatype/shape on
+    device (mirrors TpuArena.as_typed_array's bitcast path)."""
+    import jax.numpy as jnp
+
+    if datatype == "BOOL":
+        return flat_u8.astype(jnp.bool_).reshape(shape)
+    elem = wire_dtype_element_size(datatype)
+    np_dtype = triton_to_np_dtype(datatype)
+    typed = jax.lax.bitcast_convert_type(
+        flat_u8.reshape(-1, elem), jnp.dtype(np_dtype))
+    return typed.reshape(shape)
+
+
+class _PendingSegment:
+    """One in-flight segment: network chunks are device_put as they
+    arrive; the typed assembly happens on device at flush."""
+
+    def __init__(self, msg):
+        self.index = msg.segment_index
+        self.offset = int(msg.segment_offset)
+        self.nbytes = int(msg.segment_nbytes)
+        self.datatype = msg.datatype
+        self.shape = list(msg.shape)
+        self.parts: list = []      # device u8 chunks (non-BYTES)
+        self.host_parts: list = [] # host bytes (BYTES stays host-side)
+        self.received = 0
+
+    def add(self, jax, device, msg) -> None:
+        if int(msg.chunk_offset) != self.received:
+            raise InferenceServerException(
+                "pull stream out of order (segment %d: chunk at %d, "
+                "expected %d)" % (self.index, msg.chunk_offset,
+                                  self.received),
+                status="INTERNAL")
+        if self.datatype == "BYTES":
+            self.host_parts.append(msg.data)
+        else:
+            self.parts.append(jax.device_put(
+                np.frombuffer(msg.data, np.uint8), device))
+        self.received += len(msg.data)
+
+    def flush(self, jax, arena: TpuArena, region_id: str) -> None:
+        import jax.numpy as jnp
+
+        if self.received != self.nbytes:
+            raise InferenceServerException(
+                "pull stream truncated (segment %d: %d of %d bytes)"
+                % (self.index, self.received, self.nbytes),
+                status="INTERNAL")
+        if self.datatype == "BYTES":
+            raw = b"".join(self.host_parts)
+            array = deserialize_bytes_tensor(raw)
+            if self.shape:
+                array = array.reshape(self.shape)
+            arena.adopt_segment(region_id, self.offset, self.nbytes,
+                                "BYTES", self.shape, array)
+            return
+        flat = (self.parts[0] if len(self.parts) == 1
+                else jnp.concatenate(self.parts))
+        if self.datatype:
+            array = _typed_from_u8(jax, flat, self.datatype, self.shape)
+            arena.adopt_segment(region_id, self.offset, self.nbytes,
+                                self.datatype, self.shape, array)
+        else:
+            arena.adopt_segment(region_id, self.offset, self.nbytes,
+                                None, None, flat)
+
+
+DEFAULT_PULL_TIMEOUT_S = 120.0
+
+
+def pull_region(owner, raw_handle: bytes, local_arena: TpuArena,
+                device_id: Optional[int] = None,
+                chunk_bytes: int = 0,
+                timeout_s: float = DEFAULT_PULL_TIMEOUT_S) -> bytes:
+    """Redeem a foreign region handle: stream the owner's segments into
+    a fresh region of ``local_arena`` and return the LOCAL handle.
+
+    ``owner`` is the owner's address ("host:port"), an open grpc
+    channel, or a TpuArenaStub. ``device_id`` pins the local placement
+    (default: the owner's device_id when locally valid, else 0).
+    ``timeout_s`` bounds the whole stream — a partitioned owner must
+    fail the redemption, not pin the consumer's registration thread."""
+    import grpc
+
+    from client_tpu.server.arena_service import TpuArenaStub
+
+    jax = local_arena._jax
+    own_channel = None
+    if isinstance(owner, str):
+        own_channel = grpc.insecure_channel(owner)
+        stub = TpuArenaStub(own_channel)
+    elif hasattr(owner, "PullRegion"):
+        stub = owner
+    else:
+        stub = TpuArenaStub(owner)
+    local_handle = None
+    region_id = None
+    try:
+        stream = stub.PullRegion(
+            arena_pb2.PullRegionRequest(
+                raw_handle=raw_handle, chunk_bytes=chunk_bytes),
+            timeout=timeout_s or None)
+        device = None
+        pending: Optional[_PendingSegment] = None
+        for msg in stream:
+            if local_handle is None:
+                size = int(msg.region_byte_size)
+                if size <= 0:
+                    raise InferenceServerException(
+                        "pull stream missing region size",
+                        status="INTERNAL")
+                if device_id is None:
+                    owner_dev = int(msg.device_id)
+                    device_id = (owner_dev if 0 <= owner_dev
+                                 < len(local_arena._devices) else 0)
+                local_handle = local_arena.create_region(size, device_id)
+                region_id = json.loads(local_handle)["region_id"]
+                device = local_arena.device_for(device_id)
+            if msg.segment_nbytes == 0:
+                continue  # empty-region marker
+            if pending is not None and msg.segment_index != pending.index:
+                pending.flush(jax, local_arena, region_id)
+                pending = None
+            if pending is None:
+                pending = _PendingSegment(msg)
+            pending.add(jax, device, msg)
+        if local_handle is None:
+            raise InferenceServerException(
+                "owner sent an empty pull stream", status="INTERNAL")
+        if pending is not None:
+            pending.flush(jax, local_arena, region_id)
+        handle = local_handle
+        local_handle = None  # success: skip the cleanup below
+        return handle
+    except grpc.RpcError as err:
+        # Preserve the owner's verdict: NOT_FOUND/INVALID_ARGUMENT are
+        # permanent (a retry loop keyed on UNAVAILABLE must not spin on
+        # a dead handle); everything else is a transport failure.
+        code = err.code() if hasattr(err, "code") else None
+        status = {
+            grpc.StatusCode.NOT_FOUND: "NOT_FOUND",
+            grpc.StatusCode.INVALID_ARGUMENT: "INVALID_ARGUMENT",
+        }.get(code, "UNAVAILABLE")
+        raise InferenceServerException(
+            "DCN pull from region owner failed: %s"
+            % getattr(err, "details", lambda: err)(),
+            status=status)
+    finally:
+        if local_handle is not None and region_id is not None:
+            local_arena.destroy_region(region_id)  # failed pull: no leak
+        if own_channel is not None:
+            own_channel.close()
+
+
+def foreign_owner_url(raw_handle: bytes, local_arena_id: str
+                      ) -> Optional[str]:
+    """The owner's address when ``raw_handle`` belongs to ANOTHER
+    host's arena and carries routing info; None for local or
+    unroutable handles."""
+    try:
+        descriptor = json.loads(raw_handle)
+    except (json.JSONDecodeError, UnicodeDecodeError, TypeError):
+        return None
+    if descriptor.get("arena_id") == local_arena_id:
+        return None
+    return descriptor.get("owner_url") or None

@@ -24,6 +24,12 @@ _METHODS = [
      arena_pb2.ListRegionsResponse),
 ]
 
+# Server-streaming methods (the DCN pull path).
+_STREAM_METHODS = [
+    ("PullRegion", arena_pb2.PullRegionRequest,
+     arena_pb2.PullRegionChunk),
+]
+
 _STATUS_MAP = {
     "NOT_FOUND": grpc.StatusCode.NOT_FOUND,
     "INVALID_ARGUMENT": grpc.StatusCode.INVALID_ARGUMENT,
@@ -37,6 +43,15 @@ class TpuArenaStub:
             setattr(
                 self, name,
                 channel.unary_unary(
+                    "/%s/%s" % (SERVICE_NAME, name),
+                    request_serializer=req_t.SerializeToString,
+                    response_deserializer=resp_t.FromString,
+                ),
+            )
+        for name, req_t, resp_t in _STREAM_METHODS:
+            setattr(
+                self, name,
+                channel.unary_stream(
                     "/%s/%s" % (SERVICE_NAME, name),
                     request_serializer=req_t.SerializeToString,
                     response_deserializer=resp_t.FromString,
@@ -99,11 +114,28 @@ class TpuArenaServicer:
             )
         return response
 
+    def PullRegion(self, request, context):
+        """Owner side of the DCN pull: authenticate the handle, then
+        stream typed segments (client_tpu.server.arena_pull)."""
+        from client_tpu.server.arena_pull import iter_region_chunks
+
+        try:
+            yield from iter_region_chunks(
+                self._arena, request.raw_handle, request.chunk_bytes)
+        except InferenceServerException as e:
+            self._abort(context, e)
+
 
 def add_TpuArenaServicer_to_server(servicer: TpuArenaServicer, server):
     handlers = {}
     for name, req_t, resp_t in _METHODS:
         handlers[name] = grpc.unary_unary_rpc_method_handler(
+            getattr(servicer, name),
+            request_deserializer=req_t.FromString,
+            response_serializer=resp_t.SerializeToString,
+        )
+    for name, req_t, resp_t in _STREAM_METHODS:
+        handlers[name] = grpc.unary_stream_rpc_method_handler(
             getattr(servicer, name),
             request_deserializer=req_t.FromString,
             response_serializer=resp_t.SerializeToString,

@@ -284,7 +284,8 @@ class AioGrpcServerThread:
     """
 
     def __init__(self, core: InferenceServerCore, address: str,
-                 extra_servicers=(), max_workers: int = 96):
+                 extra_servicers=(), max_workers: int = 96,
+                 on_bound=None):
         # The servicer's handlers are sync and BLOCK in the migration
         # pool (dynamic-batcher waits ride a threading.Event; a
         # batched round trip is ~80 ms behind the relay) — at 64+
@@ -316,6 +317,11 @@ class AioGrpcServerThread:
                 self.port = server.add_insecure_port(address)
                 if self.port == 0:
                     raise RuntimeError("unable to bind %s" % address)
+                if on_bound is not None:
+                    # Post-bind, pre-serve: state that must be visible
+                    # to the very first request (e.g. the arena's
+                    # public_url, which stamps every minted handle).
+                    on_bound(self.port)
                 await server.start()
             except Exception as exc:  # surface bind/setup errors to caller
                 error.append(exc)

@@ -39,11 +39,16 @@ class _SystemRegion:
 class _TpuRegion:
     kind = "tpu"
 
-    def __init__(self, name: str, region_id: str, device_id: int, byte_size: int):
+    def __init__(self, name: str, region_id: str, device_id: int,
+                 byte_size: int, pulled: bool = False):
         self.name = name
         self.region_id = region_id
         self.device_id = device_id
         self.byte_size = byte_size
+        # True when the region is a local replica the server pulled
+        # over DCN from another host's arena: the server owns it, so
+        # unregistration destroys it (nobody else holds its handle).
+        self.pulled = pulled
 
 
 class SharedMemoryManager:
@@ -115,15 +120,71 @@ class SharedMemoryManager:
                     "shared memory region '%s' already registered" % name,
                     status="ALREADY_EXISTS",
                 )
-            region_id = self._arena.validate_handle(raw_handle, device_id, byte_size)
-            self._tpu[name] = _TpuRegion(name, region_id, device_id, byte_size)
+            try:
+                region_id = self._arena.validate_handle(
+                    raw_handle, device_id, byte_size)
+                self._tpu[name] = _TpuRegion(name, region_id, device_id,
+                                             byte_size)
+                return
+            except InferenceServerException:
+                from client_tpu.server.arena_pull import foreign_owner_url
+
+                owner = foreign_owner_url(raw_handle, self._arena.arena_id)
+                if owner is None:
+                    raise
+        # Foreign handle with routing info: redeem it over the DCN pull
+        # path (docs/cross_host_arena.md rule 2) — stream the owner's
+        # typed segments into a local replica, then serve locally. The
+        # pull runs OUTSIDE the registry lock (a cross-host transfer
+        # must not block unrelated registrations).
+        import json
+
+        from client_tpu.server.arena_pull import pull_region
+
+        # Reject an oversized registration BEFORE paying the DCN
+        # transfer: the owner's descriptor carries the region size.
+        try:
+            claimed = int(json.loads(raw_handle).get("byte_size", 0))
+        except (ValueError, TypeError):
+            claimed = 0
+        if claimed and byte_size > claimed:
+            raise InferenceServerException(
+                "registered byte_size %d exceeds region size %d"
+                % (byte_size, claimed), status="INVALID_ARGUMENT")
+        local_handle = pull_region(owner, raw_handle, self._arena)
+        descriptor = json.loads(local_handle)
+        local_device = descriptor["device_id"]
+        try:
+            with self._lock:
+                if name in self._system or name in self._tpu:
+                    raise InferenceServerException(
+                        "shared memory region '%s' already registered" % name,
+                        status="ALREADY_EXISTS",
+                    )
+                region_id = self._arena.validate_handle(
+                    local_handle, local_device, byte_size)
+                self._tpu[name] = _TpuRegion(name, region_id, local_device,
+                                             byte_size, pulled=True)
+        except Exception:
+            # Any post-pull failure: the replica has no name and no
+            # handle holder — free its HBM instead of leaking it.
+            self._arena.destroy_region(descriptor["region_id"])
+            raise
 
     def unregister_tpu(self, name: str) -> None:
         with self._lock:
             if not name:
+                pulled = [r for r in self._tpu.values() if r.pulled]
                 self._tpu.clear()
-                return
-            self._tpu.pop(name, None)
+            else:
+                region = self._tpu.pop(name, None)
+                pulled = [region] if region is not None and region.pulled \
+                    else []
+        # Pulled replicas are server-owned: free their HBM now (outside
+        # the lock; destroy only drops references).
+        for region in pulled:
+            if self._arena is not None:
+                self._arena.destroy_region(region.region_id)
 
     def tpu_status(self, name: str = "") -> pb.TpuSharedMemoryStatusResponse:
         response = pb.TpuSharedMemoryStatusResponse()

@@ -72,7 +72,8 @@ class _Region:
 class TpuArena:
     """Named HBM slots on the arena's devices."""
 
-    def __init__(self, platform: Optional[str] = None, devices=None):
+    def __init__(self, platform: Optional[str] = None, devices=None,
+                 public_url: Optional[str] = None):
         import jax
 
         self._jax = jax
@@ -80,7 +81,7 @@ class TpuArena:
             # Host-local subset: in a multi-host deployment each
             # host's serving process pins its arena to ITS devices, so
             # arena traffic rides ICI only — cross-host tensor
-            # movement goes through the documented DCN pull path
+            # movement goes through the DCN pull path
             # (docs/cross_host_arena.md), never through the arena.
             self._devices = list(devices)
         elif platform:
@@ -88,8 +89,15 @@ class TpuArena:
         else:
             self._devices = jax.devices()
         self.arena_id = uuid.uuid4().hex[:12]
+        # When set, handles carry the owner's address so any other
+        # host's server can redeem them via PullRegion (the handle is
+        # the capability; the URL is just routing).
+        self.public_url = public_url
         self._regions: Dict[str, _Region] = {}
         self._lock = threading.Lock()
+
+    def set_public_url(self, url: str) -> None:
+        self.public_url = url
 
     # -- lifecycle -------------------------------------------------------
 
@@ -117,24 +125,28 @@ class TpuArena:
         return self._serialize_handle(region)
 
     def _serialize_handle(self, region: _Region) -> bytes:
-        return json.dumps({
+        descriptor = {
             "arena_id": self.arena_id,
             "region_id": region.region_id,
             "device_id": region.device_id,
             "byte_size": region.byte_size,
             "nonce": region.nonce,
-        }).encode()
+        }
+        if self.public_url:
+            descriptor["owner_url"] = self.public_url
+        return json.dumps(descriptor).encode()
 
-    def validate_handle(self, raw_handle: bytes, device_id: int,
-                        byte_size: int) -> str:
-        """Check a client-provided handle against this arena; returns
-        the region_id (used by TpuSharedMemoryRegister)."""
+    def _authenticate(self, raw_handle: bytes, not_found_status: str
+                      ) -> _Region:
+        """Parse + authenticate a handle descriptor (arena_id, region,
+        nonce) — the single capability check every redemption path
+        (local registration AND cross-host pull) goes through."""
         try:
             descriptor = json.loads(raw_handle)
         except (json.JSONDecodeError, UnicodeDecodeError):
             raise InferenceServerException(
-                "malformed TPU shared memory handle", status="INVALID_ARGUMENT"
-            )
+                "malformed TPU shared memory handle",
+                status="INVALID_ARGUMENT")
         region = self._regions.get(descriptor.get("region_id", ""))
         if (
             region is None
@@ -143,8 +155,15 @@ class TpuArena:
         ):
             raise InferenceServerException(
                 "TPU shared memory handle does not match any arena region",
-                status="INVALID_ARGUMENT",
+                status=not_found_status,
             )
+        return region
+
+    def validate_handle(self, raw_handle: bytes, device_id: int,
+                        byte_size: int) -> str:
+        """Check a client-provided handle against this arena; returns
+        the region_id (used by TpuSharedMemoryRegister)."""
+        region = self._authenticate(raw_handle, "INVALID_ARGUMENT")
         if byte_size > region.byte_size:
             raise InferenceServerException(
                 "registered byte_size %d exceeds region size %d"
@@ -179,6 +198,43 @@ class TpuArena:
                 "unknown TPU arena region", status="NOT_FOUND"
             )
         return region
+
+    # -- cross-host pull path (docs/cross_host_arena.md) -----------------
+
+    def resolve_pull_handle(self, raw_handle: bytes) -> _Region:
+        """Authenticate a handle for PullRegion: the full descriptor
+        (arena_id + region + nonce) must match — a consumer can only
+        pull what the owner's handle authorizes. NOT_FOUND (vs the
+        registration path's INVALID_ARGUMENT) so the consumer can tell
+        a dead handle from a malformed one."""
+        return self._authenticate(raw_handle, "NOT_FOUND")
+
+    def snapshot_segments(self, region_id: str):
+        """Consistent segment-list snapshot for the pull stream.
+        Segment arrays are immutable (writes replace the list, never
+        mutate an array), so serializing each segment AFTER releasing
+        the lock streams a coherent point-in-time view without holding
+        the region lock across device->host transfers."""
+        region = self._get(region_id)
+        with region.lock:
+            return list(region.segments)
+
+    def adopt_segment(self, region_id: str, offset: int, nbytes: int,
+                      datatype: Optional[str], shape, array) -> None:
+        """Insert an externally-assembled segment (the consumer end of
+        a pull): ``array`` is already typed and placed on this host —
+        metadata comes from the owner's stream, bounds are re-checked
+        here."""
+        region = self._get(region_id)
+        if offset < 0 or offset + nbytes > region.byte_size:
+            raise InferenceServerException(
+                "pulled segment [%d, %d) exceeds region size %d"
+                % (offset, offset + nbytes, region.byte_size),
+                status="INVALID_ARGUMENT")
+        segment = _Segment(offset, nbytes, datatype or None,
+                           list(shape) if shape is not None else None, array)
+        with region.lock:
+            self._insert_segment(region, segment)
 
     # -- data plane ------------------------------------------------------
 

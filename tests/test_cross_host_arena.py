@@ -1,0 +1,183 @@
+"""Cross-host DCN pull path (docs/cross_host_arena.md rule 2).
+
+Two real processes play two hosts: the OWNER process ("host B") runs a
+server whose arena holds typed tensors; this test process ("host A")
+redeems B's region handle — first by a direct consumer-side pull into a
+local arena, then through the full serving path (a host-A client
+registers the B handle with the A server, which pulls transparently and
+serves the inference locally).
+
+Replaces the reference's single-host CUDA-IPC sharing contract
+(reference src/c++/perf_analyzer/infer_data_manager_shm.h:56) with a
+handle-redemption model that crosses hosts."""
+
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import client_tpu.grpc as grpcclient
+from client_tpu.server.app import build_core, start_grpc_server
+from client_tpu.server.arena_pull import foreign_owner_url, pull_region
+from client_tpu.server.tpu_arena import TpuArena
+from client_tpu.utils import InferenceServerException
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+# The owner host: serves an arena whose region holds a typed layout —
+# two INT32 [16] tensors (the `simple` model's inputs), a BYTES tensor,
+# and a raw byte run.
+OWNER_SCRIPT = r"""
+import json, signal
+import numpy as np
+from client_tpu.server.app import build_core, start_grpc_server
+from client_tpu.utils import serialize_byte_tensor
+
+core = build_core([], warmup=False)
+handle = start_grpc_server(core=core)
+arena = core.memory.arena
+raw = arena.create_region(8192, 0)
+region_id = json.loads(raw)["region_id"]
+rng = np.random.default_rng(7)
+x = rng.integers(0, 100, size=16).astype(np.int32)
+y = rng.integers(0, 100, size=16).astype(np.int32)
+arena.write(region_id, 0, x.tobytes(), "INT32", [16])
+arena.write(region_id, 64, y.tobytes(), "INT32", [16])
+arr = np.array([b"alpha", b"bravo!"], dtype=np.object_)
+arena.write(region_id, 4096, serialize_byte_tensor(arr).tobytes(),
+            "BYTES", [2])
+arena.write(region_id, 6000, b"\x01\x02\x03\x04")
+print(json.dumps({"address": handle.address, "handle": raw.decode(),
+                  "x": x.tolist(), "y": y.tolist()}), flush=True)
+signal.sigwait([signal.SIGTERM])
+handle.stop()
+"""
+
+
+@pytest.fixture(scope="module")
+def owner():
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", OWNER_SCRIPT], stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True, cwd=str(REPO), env=env)
+    try:
+        line = proc.stdout.readline().strip()
+        assert line, "owner process died before publishing its handle"
+        info = json.loads(line)
+        yield info
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+
+
+def test_handle_carries_owner_route(owner):
+    descriptor = json.loads(owner["handle"])
+    assert descriptor["owner_url"] == owner["address"]
+    assert foreign_owner_url(owner["handle"].encode(), "someother") \
+        == owner["address"]
+    # local handles are never routed back out
+    assert foreign_owner_url(owner["handle"].encode(),
+                             descriptor["arena_id"]) is None
+
+
+def test_direct_pull_reproduces_typed_layout(owner):
+    """Consumer-side pull: the local replica reproduces the owner's
+    segments typed — INT32 tensors resolve through the zero-copy
+    fast path, BYTES and raw runs survive byte-exact."""
+    arena = TpuArena()
+    local_handle = pull_region(owner["address"], owner["handle"].encode(),
+                               arena)
+    descriptor = json.loads(local_handle)
+    assert descriptor["arena_id"] == arena.arena_id
+    region_id = descriptor["region_id"]
+    x = np.asarray(arena.as_typed_array(region_id, 0, 64, "INT32", [16]))
+    y = np.asarray(arena.as_typed_array(region_id, 64, 64, "INT32", [16]))
+    np.testing.assert_array_equal(x, np.array(owner["x"], np.int32))
+    np.testing.assert_array_equal(y, np.array(owner["y"], np.int32))
+    bts = arena.as_typed_array(region_id, 4096, 0, "BYTES", [2])
+    assert list(bts) == [b"alpha", b"bravo!"]
+    assert arena.read(region_id, 6000, 4) == b"\x01\x02\x03\x04"
+
+
+def test_small_chunks_stream_in_order(owner):
+    """Chunked streaming: a 16-byte chunk size forces multi-chunk
+    segments; device-side assembly must still be byte-exact."""
+    arena = TpuArena()
+    local_handle = pull_region(owner["address"], owner["handle"].encode(),
+                               arena, chunk_bytes=16)
+    region_id = json.loads(local_handle)["region_id"]
+    x = np.asarray(arena.as_typed_array(region_id, 0, 64, "INT32", [16]))
+    np.testing.assert_array_equal(x, np.array(owner["x"], np.int32))
+    bts = arena.as_typed_array(region_id, 4096, 0, "BYTES", [2])
+    assert list(bts) == [b"alpha", b"bravo!"]
+
+
+def test_tampered_handle_is_rejected(owner):
+    descriptor = json.loads(owner["handle"])
+    descriptor["nonce"] = "0" * 16
+    arena = TpuArena()
+    with pytest.raises(InferenceServerException):
+        pull_region(owner["address"], json.dumps(descriptor).encode(),
+                    arena)
+    assert arena.list_regions() == []  # failed pull leaks nothing
+
+
+def test_server_redeems_foreign_handle_end_to_end(owner):
+    """The full flow: host-A client registers a host-B handle with the
+    host-A server; the server pulls the region over DCN and serves an
+    inference from the local replica; unregistration frees it."""
+    core = build_core(["simple"], warmup=False)
+    handle = start_grpc_server(core=core)
+    try:
+        with grpcclient.InferenceServerClient(handle.address) as client:
+            client.register_tpu_shared_memory(
+                "xhost", owner["handle"].encode(), 0, 8192)
+            status = client.get_tpu_shared_memory_status()
+            assert "xhost" in status.regions
+
+            inputs = [
+                grpcclient.InferInput("INPUT0", [16], "INT32"),
+                grpcclient.InferInput("INPUT1", [16], "INT32"),
+            ]
+            inputs[0].set_shared_memory("xhost", 64, offset=0)
+            inputs[1].set_shared_memory("xhost", 64, offset=64)
+            result = client.infer("simple", inputs)
+            x = np.array(owner["x"], np.int32)
+            y = np.array(owner["y"], np.int32)
+            np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), x + y)
+            np.testing.assert_array_equal(result.as_numpy("OUTPUT1"), x - y)
+
+            # The pulled replica is server-owned: unregistering it
+            # frees the local HBM region.
+            replicas = len(core.memory.arena.list_regions())
+            assert replicas >= 1
+            client.unregister_tpu_shared_memory("xhost")
+            assert len(core.memory.arena.list_regions()) == replicas - 1
+    finally:
+        handle.stop()
+
+
+def test_unroutable_foreign_handle_still_rejected(owner):
+    """A foreign handle WITHOUT routing info keeps the old error: the
+    pull path only engages when the handle says where to pull from."""
+    descriptor = json.loads(owner["handle"])
+    del descriptor["owner_url"]
+    core = build_core([], warmup=False)
+    handle = start_grpc_server(core=core)
+    try:
+        with grpcclient.InferenceServerClient(handle.address) as client:
+            with pytest.raises(InferenceServerException) as exc:
+                client.register_tpu_shared_memory(
+                    "nr", json.dumps(descriptor).encode(), 0, 8192)
+            assert exc.value.status() == "INVALID_ARGUMENT"
+    finally:
+        handle.stop()
