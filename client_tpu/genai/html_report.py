@@ -1,0 +1,383 @@
+"""Interactive benchmark report: one self-contained HTML file.
+
+Parity: the reference's genai-perf emits interactive plotly HTML
+(reference src/c++/perf_analyzer/genai-perf/genai_perf/plots/ —
+BasePlot subclasses call plotly `fig.write_html`). Plotly is not on
+this image, so the report is hand-rendered SVG + a small vanilla-JS
+hover layer — no network, no dependencies, one file that opens
+anywhere.
+
+Chart set mirrors plots.py's static PNGs: stat tiles (the headline
+numbers), TTFT-per-request scatter, request-latency histogram,
+inter-token-latency box summary, and the token-position heatmap.
+Every mark carries a hover tooltip; a table view of the summary
+statistics ships in the same file.
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import os
+from typing import List
+
+from client_tpu.genai.metrics import Statistics
+
+# Categorical slots 1-3 (light, dark): the all-pairs-validated prefix
+# of the reference palette; experiments beyond three fold into the
+# table view rather than minting new hues.
+SERIES_LIGHT = ["#2a78d6", "#eb6834", "#1baf7a"]
+SERIES_DARK = ["#3987e5", "#d95926", "#199e70"]
+MAX_SERIES = 3
+
+# Sequential single-hue ramp (blue, light->dark) for the heatmap.
+SEQ_RAMP = ["#eaf2fc", "#c4dbf5", "#9cc2ec", "#6fa4e2",
+            "#4485d9", "#2a6ab8", "#1b4a85"]
+
+_CSS = """
+.viz-root {
+  color-scheme: light;
+  --surface-1: #fcfcfb; --surface-2: #f2f1ee;
+  --text-primary: #0b0b0b; --text-secondary: #52514e;
+  --grid: #e4e3df; --axis: #b9b8b2;
+  font: 14px/1.45 system-ui, sans-serif;
+  background: var(--surface-1); color: var(--text-primary);
+  max-width: 980px; margin: 0 auto; padding: 24px;
+}
+@media (prefers-color-scheme: dark) {
+  :root:where(:not([data-theme="light"])) .viz-root {
+    color-scheme: dark;
+    --surface-1: #1a1a19; --surface-2: #242423;
+    --text-primary: #ffffff; --text-secondary: #c3c2b7;
+    --grid: #333330; --axis: #55544f;
+  }
+}
+.viz-root h1 { font-size: 20px; margin: 0 0 4px; }
+.viz-root .sub { color: var(--text-secondary); margin: 0 0 20px; }
+.tiles { display: flex; gap: 12px; flex-wrap: wrap; margin: 0 0 24px; }
+.tile { background: var(--surface-2); border-radius: 8px;
+        padding: 12px 16px; min-width: 130px; }
+.tile .v { font-size: 22px; font-weight: 600; }
+.tile .l { color: var(--text-secondary); font-size: 12px; }
+.chart { margin: 0 0 28px; }
+.chart h2 { font-size: 15px; margin: 0 0 2px; }
+.chart .d { color: var(--text-secondary); font-size: 12px; margin: 0 0 8px; }
+.legend { display: flex; gap: 14px; font-size: 12px;
+          color: var(--text-secondary); margin: 4px 0 6px; }
+.legend .sw { display: inline-block; width: 10px; height: 10px;
+              border-radius: 3px; margin-right: 5px; }
+svg text { fill: var(--text-secondary); font-size: 11px; }
+svg .axisline { stroke: var(--axis); stroke-width: 1; }
+svg .gridline { stroke: var(--grid); stroke-width: 1; }
+#tip { position: fixed; pointer-events: none; display: none;
+       background: var(--text-primary); color: var(--surface-1);
+       padding: 4px 8px; border-radius: 5px; font-size: 12px; z-index: 9; }
+table.stats { border-collapse: collapse; font-size: 13px; }
+table.stats th, table.stats td { padding: 4px 10px; text-align: right;
+  border-bottom: 1px solid var(--grid); }
+table.stats th:first-child, table.stats td:first-child { text-align: left; }
+details { margin: 0 0 24px; }
+details summary { cursor: pointer; color: var(--text-secondary); }
+"""
+
+_JS = """
+(function () {
+  var tip = document.getElementById('tip');
+  document.querySelectorAll('[data-tip]').forEach(function (el) {
+    el.addEventListener('mousemove', function (ev) {
+      tip.textContent = el.getAttribute('data-tip');
+      tip.style.display = 'block';
+      tip.style.left = (ev.clientX + 12) + 'px';
+      tip.style.top = (ev.clientY - 10) + 'px';
+    });
+    el.addEventListener('mouseleave', function () {
+      tip.style.display = 'none';
+    });
+  });
+})();
+"""
+
+
+def _fmt(value: float) -> str:
+    if value >= 100:
+        return "%.0f" % value
+    if value >= 1:
+        return "%.1f" % value
+    return "%.3g" % value
+
+
+def _scale(lo: float, hi: float, out_lo: float, out_hi: float):
+    span = (hi - lo) or 1.0
+
+    def to(v: float) -> float:
+        return out_lo + (v - lo) / span * (out_hi - out_lo)
+
+    return to
+
+
+def _axes(width, height, pad, y_lo, y_hi, x_label, y_label):
+    """Recessive grid + axis lines + 4 y-ticks."""
+    parts = []
+    ty = _scale(y_lo, y_hi, height - pad, pad)
+    for i in range(5):
+        v = y_lo + (y_hi - y_lo) * i / 4
+        y = ty(v)
+        parts.append('<line class="gridline" x1="%d" y1="%.1f" x2="%d" '
+                     'y2="%.1f"/>' % (pad, y, width - 8, y))
+        parts.append('<text x="%d" y="%.1f" text-anchor="end">%s</text>'
+                     % (pad - 6, y + 4, _fmt(v)))
+    parts.append('<line class="axisline" x1="%d" y1="%d" x2="%d" y2="%d"/>'
+                 % (pad, height - pad, width - 8, height - pad))
+    parts.append('<text x="%d" y="%d" text-anchor="middle">%s</text>'
+                 % ((width + pad) // 2, height - 4, html.escape(x_label)))
+    parts.append('<text x="12" y="%d" transform="rotate(-90 12 %d)" '
+                 'text-anchor="middle">%s</text>'
+                 % (height // 2, height // 2, html.escape(y_label)))
+    return "".join(parts), ty
+
+
+def _legend(n: int) -> str:
+    if n < 2:
+        return ""
+    items = "".join(
+        '<span><span class="sw" style="background:var(--s%d)"></span>'
+        'experiment %d</span>' % (i, i) for i in range(min(n, MAX_SERIES)))
+    more = ('<span>(+%d more in the table)</span>' % (n - MAX_SERIES)
+            if n > MAX_SERIES else "")
+    return '<div class="legend">%s%s</div>' % (items, more)
+
+
+def _series_vars() -> str:
+    light = "".join("--s%d: %s; " % (i, c)
+                    for i, c in enumerate(SERIES_LIGHT))
+    dark = "".join("--s%d: %s; " % (i, c) for i, c in enumerate(SERIES_DARK))
+    return (".viz-root { %s}\n"
+            "@media (prefers-color-scheme: dark) {\n"
+            "  :root:where(:not([data-theme=\"light\"])) .viz-root { %s}\n"
+            "}\n" % (light, dark))
+
+
+def _scatter(data_list, n_experiments: int) -> str:
+    """TTFT per request: per-mark hover, >=8px targets."""
+    series = [d.get("time_to_first_token_ms", [])
+              for d in data_list[:MAX_SERIES]]
+    points = [(i, j, v) for i, samples in enumerate(series)
+              for j, v in enumerate(samples)]
+    if not points:
+        return ""
+    width, height, pad = 920, 260, 58
+    y_hi = max(v for _, _, v in points) * 1.08
+    x_hi = max(max((len(s) for s in series)) - 1, 1)
+    grid, ty = _axes(width, height, pad, 0.0, y_hi,
+                     "request index", "TTFT (ms)")
+    tx = _scale(0, x_hi, pad + 8, width - 20)
+    marks = "".join(
+        '<circle cx="%.1f" cy="%.1f" r="4.5" fill="var(--s%d)" '
+        'data-tip="exp %d · request %d · %s ms"/>'
+        % (tx(j), ty(v), i, i, j, _fmt(v)) for i, j, v in points)
+    return ('<div class="chart"><h2>Time to first token</h2>'
+            '<p class="d">one mark per request, in arrival order</p>%s'
+            '<svg viewBox="0 0 %d %d" width="100%%">%s%s</svg></div>'
+            % (_legend(n_experiments), width, height, grid, marks))
+
+
+def _histogram(data_list, n_experiments: int) -> str:
+    series = [d.get("request_latency_ms", [])
+              for d in data_list[:MAX_SERIES]]
+    merged = [v for s in series for v in s]
+    if not merged:
+        return ""
+    lo, hi = min(merged), max(merged) * 1.0001
+    bins = min(24, max(5, len(merged) // 2))
+    step = (hi - lo) / bins or 1.0
+    counts = [[0] * bins for _ in series]
+    for i, samples in enumerate(series):
+        for v in samples:
+            counts[i][min(int((v - lo) / step), bins - 1)] += 1
+    width, height, pad = 920, 240, 58
+    y_hi = max(max(c) for c in counts) * 1.1 or 1
+    grid, ty = _axes(width, height, pad, 0, y_hi,
+                     "request latency (ms)", "requests")
+    plot_w = width - 28 - pad
+    group_w = plot_w / bins
+    bar_w = max((group_w - 2 * len(series)) / max(len(series), 1), 2)
+    bars = []
+    for i, row in enumerate(counts):
+        for b, count in enumerate(row):
+            if not count:
+                continue
+            x = pad + 8 + b * group_w + i * (bar_w + 2)
+            y = ty(count)
+            bars.append(
+                '<rect x="%.1f" y="%.1f" width="%.1f" height="%.1f" '
+                'rx="2" fill="var(--s%d)" data-tip='
+                '"exp %d · %s-%s ms · %d requests"/>'
+                % (x, y, bar_w, (height - pad) - y, i, i,
+                   _fmt(lo + b * step), _fmt(lo + (b + 1) * step), count))
+    return ('<div class="chart"><h2>Request latency</h2>'
+            '<p class="d">distribution across all requests</p>%s'
+            '<svg viewBox="0 0 %d %d" width="100%%">%s%s</svg></div>'
+            % (_legend(n_experiments), width, height, grid, "".join(bars)))
+
+
+def _boxes(stats_list) -> str:
+    """ITL five-number summaries as thin boxes with whiskers — from
+    Statistics' own percentile table (one interpolation convention:
+    metrics.py computes it, every view reuses it). Series slots keep
+    their original experiment index even when a non-streaming
+    experiment has no ITL samples (color follows the entity)."""
+    boxes = []  # (experiment index, stats entry)
+    for i, stats in enumerate(stats_list[:MAX_SERIES]):
+        entry = stats.stats.get("inter_token_latency_ms")
+        if entry:
+            boxes.append((i, entry))
+    if not boxes:
+        return ""
+    width, height, pad = 920, 220, 58
+    y_hi = max(entry["max"] for _, entry in boxes) * 1.1
+    grid, ty = _axes(width, height, pad, 0.0, y_hi,
+                     "experiment", "inter-token latency (ms)")
+    plot_w = width - 28 - pad
+    marks = []
+    for slot, (i, entry) in enumerate(boxes):
+        q1, med, q3 = entry["p25"], entry["p50"], entry["p75"]
+        center = pad + 8 + plot_w * (slot + 0.5) / len(boxes)
+        half = 28
+        tip = ("exp %d · min %s · p25 %s · median %s · p75 %s · max %s ms"
+               % (i, _fmt(entry["min"]), _fmt(q1), _fmt(med), _fmt(q3),
+                  _fmt(entry["max"])))
+        marks.append(
+            '<g data-tip="%s">'
+            '<line x1="%.1f" y1="%.1f" x2="%.1f" y2="%.1f" '
+            'stroke="var(--s%d)" stroke-width="2"/>'
+            '<rect x="%.1f" y="%.1f" width="%d" height="%.1f" rx="4" '
+            'fill="var(--s%d)" fill-opacity="0.35" stroke="var(--s%d)" '
+            'stroke-width="2"/>'
+            '<line x1="%.1f" y1="%.1f" x2="%.1f" y2="%.1f" '
+            'stroke="var(--s%d)" stroke-width="2"/></g>'
+            % (html.escape(tip),
+               center, ty(entry["min"]), center, ty(entry["max"]), i,
+               center - half, ty(q3), half * 2,
+               max(ty(q1) - ty(q3), 2), i, i,
+               center - half, ty(med), center + half, ty(med), i))
+        marks.append('<text x="%.1f" y="%d" text-anchor="middle">'
+                     'exp %d</text>' % (center, height - pad + 14, i))
+    return ('<div class="chart"><h2>Inter-token latency</h2>'
+            '<p class="d">five-number summary per experiment '
+            '(hover a box)</p>%s'
+            '<svg viewBox="0 0 %d %d" width="100%%">%s%s</svg></div>'
+            % (_legend(len(stats_list)), width, height, grid,
+               "".join(marks)))
+
+
+def _heatmap(stats_list) -> str:
+    sequences = []
+    for stats in stats_list:
+        sequences.extend(
+            [g / 1e6 for g in seq]
+            for seq in getattr(stats.metrics, "itl_sequences_ns", []))
+    sequences = [s for s in sequences if s]
+    if not sequences:
+        return ""
+    sequences = sequences[:48]  # keep the SVG bounded
+    width, pad = 920, 58
+    cols = max(len(s) for s in sequences)
+    cell_w = min((width - pad - 28) / cols, 34)
+    cell_h = min(max(180 // len(sequences), 6), 22)
+    height = len(sequences) * cell_h + 70
+    v_hi = max(max(s) for s in sequences) or 1.0
+    cells = []
+    for row, seq in enumerate(sequences):
+        for col, v in enumerate(seq):
+            color = SEQ_RAMP[min(int(v / v_hi * (len(SEQ_RAMP) - 1) + 0.5),
+                                 len(SEQ_RAMP) - 1)]
+            cells.append(
+                '<rect x="%.1f" y="%d" width="%.1f" height="%d" '
+                'fill="%s" data-tip="request %d · token %d · %s ms"/>'
+                % (pad + 8 + col * cell_w, 8 + row * cell_h,
+                   max(cell_w - 1, 1), cell_h - 1, color, row, col + 1,
+                   _fmt(v)))
+    legend = "".join(
+        '<rect x="%d" y="%d" width="16" height="10" fill="%s"/>'
+        % (pad + 8 + i * 16, len(sequences) * cell_h + 24, c)
+        for i, c in enumerate(SEQ_RAMP))
+    scale_text = ('<text x="%d" y="%d">0 ms</text>'
+                  '<text x="%d" y="%d">%s ms</text>'
+                  % (pad + 8, len(sequences) * cell_h + 48,
+                     pad + 8 + len(SEQ_RAMP) * 16 + 6,
+                     len(sequences) * cell_h + 34, _fmt(v_hi)))
+    return ('<div class="chart"><h2>Inter-token latency by token '
+            'position</h2><p class="d">rows are requests; vertical bands '
+            'are delivery stalls</p>'
+            '<svg viewBox="0 0 %d %d" width="100%%">%s%s%s'
+            '<text x="%d" y="%d" text-anchor="middle">token position'
+            '</text></svg></div>'
+            % (width, height, "".join(cells), legend, scale_text,
+               (width + pad) // 2, len(sequences) * cell_h + 64))
+
+
+def _tiles(stats_list) -> str:
+    s0 = stats_list[0]
+    ttft = s0.stats.get("time_to_first_token_ms", {})
+    itl = s0.stats.get("inter_token_latency_ms", {})
+    tiles = [
+        (_fmt(s0.metrics.request_throughput_per_s), "requests / s"),
+        (_fmt(s0.metrics.output_token_throughput_per_s), "tokens / s"),
+        (_fmt(ttft.get("p50", 0.0)), "TTFT p50 (ms)"),
+        (_fmt(ttft.get("p99", 0.0)), "TTFT p99 (ms)"),
+        (_fmt(itl.get("p50", 0.0)), "ITL p50 (ms)"),
+        (_fmt(itl.get("p99", 0.0)), "ITL p99 (ms)"),
+    ]
+    return '<div class="tiles">%s</div>' % "".join(
+        '<div class="tile"><div class="v">%s</div><div class="l">%s</div>'
+        '</div>' % (v, l) for v, l in tiles)
+
+
+def _table(stats_list) -> str:
+    metrics = ["time_to_first_token_ms", "inter_token_latency_ms",
+               "request_latency_ms", "output_token_count"]
+    cols = ["mean", "p50", "p90", "p99"]
+    rows = []
+    for i, stats in enumerate(stats_list):
+        for metric in metrics:
+            entry = stats.stats.get(metric)
+            if not entry:
+                continue
+            rows.append("<tr><td>exp %d · %s</td>%s</tr>" % (
+                i, metric,
+                "".join("<td>%s</td>" % _fmt(entry.get(c, 0.0))
+                        for c in cols)))
+    return ('<details open><summary>Summary table (all experiments)'
+            '</summary><table class="stats"><tr><th>metric</th>%s</tr>%s'
+            '</table></details>'
+            % ("".join("<th>%s</th>" % c for c in cols), "".join(rows)))
+
+
+def generate_html_report(stats_list: List[Statistics], artifact_dir: str,
+                         title: str = "") -> str:
+    """Write `report.html`; returns the path."""
+    os.makedirs(artifact_dir, exist_ok=True)
+    # data() rebuilds every ns->ms converted list per call — convert
+    # once per experiment, share across charts.
+    data_list = [s.metrics.data() for s in stats_list]
+    body = "".join([
+        _tiles(stats_list),
+        _scatter(data_list, len(stats_list)),
+        _histogram(data_list, len(stats_list)),
+        _boxes(stats_list),
+        _heatmap(stats_list),
+        _table(stats_list),
+    ])
+    doc = ("<!doctype html><html><head><meta charset=\"utf-8\">"
+           "<title>%s</title><style>%s%s</style></head><body>"
+           "<div class=\"viz-root\"><h1>%s</h1>"
+           "<p class=\"sub\">%d experiment(s) · generated by "
+           "tpu-genai-perf</p>%s</div><div id=\"tip\"></div>"
+           "<script>%s</script></body></html>"
+           % (html.escape(title or "LLM benchmark report"), _CSS,
+              _series_vars(), html.escape(title or "LLM benchmark report"),
+              len(stats_list), body, _JS))
+    path = os.path.join(artifact_dir, "report.html")
+    with open(path, "w") as f:
+        f.write(doc)
+    return path

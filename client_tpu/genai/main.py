@@ -153,11 +153,15 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
 
         export_parquet(stats_list, args.export_parquet)
     if args.generate_plots:
+        from client_tpu.genai.html_report import generate_html_report
         from client_tpu.genai.plots import generate_plots
 
         for path in generate_plots(stats_list, artifact_dir,
                                    title=args.model):
             print("genai plot: %s" % path, file=sys.stderr)
+        print("genai plot: %s"
+              % generate_html_report(stats_list, artifact_dir,
+                                     title=args.model), file=sys.stderr)
     return 0
 
 

@@ -79,6 +79,7 @@ def test_flash_outlier_masked_logit_no_nan():
     assert np.isfinite(np.asarray(out)).all()
 
 
+@pytest.mark.slow  # compiles the LLM forward with the pallas kernel
 def test_flash_llm_forward_hook():
     """The LLM scoring forward with the flash hook matches dense."""
     from client_tpu.models.llm import (

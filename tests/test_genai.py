@@ -26,6 +26,7 @@ def test_tokenizer_roundtrip():
     assert tok.decode(ids) == "hello"
 
 
+@pytest.mark.slow  # probes the optional HF tokenizer import path
 def test_tokenizer_unknown_raises():
     with pytest.raises(ValueError):
         get_tokenizer("definitely/not-a-model-on-disk")
@@ -126,6 +127,7 @@ def test_wrapper_build_args():
     assert args[args.index("--concurrency-range") + 1] == "2"
 
 
+@pytest.mark.slow  # full profiling run over the in-process backend
 def test_genai_cli_e2e_inprocess(tmp_path):
     from client_tpu.genai.main import run
     from client_tpu.server.app import build_core
@@ -148,6 +150,7 @@ def test_genai_cli_e2e_inprocess(tmp_path):
     assert exp["output_token_throughput_per_s"]["value"] > 0
 
 
+@pytest.mark.slow  # full profiling run over the OpenAI SSE backend
 def test_genai_cli_e2e_openai(tmp_path):
     """genai over the OpenAI-compatible endpoint: SSE chunks become
     TTFT / inter-token metrics (parity: genai-perf's openai
@@ -229,6 +232,46 @@ def test_generate_plots_multi_experiment_comparison(tmp_path):
     names = {os.path.basename(p) for p in written}
     assert "experiment_comparison.png" in names
     assert "token_position_heatmap.png" in names
+
+
+def test_generate_html_report(tmp_path):
+    """The interactive report (parity: genai-perf's plotly HTML) is one
+    self-contained file: every chart, the hover layer, and a table view
+    with no external resources."""
+    doc = _export_doc()
+    doc["experiments"].append(doc["experiments"][0])
+    parser = LLMProfileDataParser(document=doc,
+                                  tokenizer=get_tokenizer("byte"))
+    from client_tpu.genai.html_report import generate_html_report
+
+    stats = [parser.get_statistics(0), parser.get_statistics(1)]
+    path = generate_html_report(stats, str(tmp_path), title="sweep")
+    text = open(path).read()
+    assert os.path.basename(path) == "report.html"
+    # all chart sections present
+    for heading in ("Time to first token", "Request latency",
+                    "Inter-token latency", "token position",
+                    "Summary table"):
+        assert heading in text
+    # interactivity: per-mark tooltips + the hover script
+    assert text.count("data-tip=") > 4
+    assert "mousemove" in text
+    # >=2 series: legend present; identity never color-alone
+    assert "experiment 0" in text and "experiment 1" in text
+    # self-contained: no external fetches of any kind
+    assert "http://" not in text and "https://" not in text
+    # dark mode is selected, not an automatic flip
+    assert "prefers-color-scheme: dark" in text
+
+
+def test_html_report_single_series_has_no_legend(tmp_path):
+    parser = LLMProfileDataParser(document=_export_doc(),
+                                  tokenizer=get_tokenizer("byte"))
+    from client_tpu.genai.html_report import generate_html_report
+
+    path = generate_html_report([parser.get_statistics(0)], str(tmp_path))
+    text = open(path).read()
+    assert '<div class="legend">' not in text  # title names the series
 
 
 def test_dataset_prompts_fetch_and_fallback():

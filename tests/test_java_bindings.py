@@ -46,7 +46,9 @@ def test_cmake_option_wires_the_target():
 
 def test_compile_when_jdk_present():
     if shutil.which("javac") is None:
-        pytest.skip("no JDK in this image (source-level checks only)")
+        pytest.skip("no JDK in this image — install openjdk (e.g. apt "
+                    "install openjdk-17-jdk-headless) to enable "
+                    "JNI-shim compilation")
     proc = subprocess.run(
         ["javac", "-d", "/tmp/jni_bindings_classes", str(JAVA_SRC)],
         capture_output=True, text=True, timeout=120)

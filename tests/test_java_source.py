@@ -108,7 +108,9 @@ def test_retry_and_endpoint_abstraction():
 def test_compiles_if_jdk_available(tmp_path):
     javac = shutil.which("javac")
     if javac is None:
-        pytest.skip("no JDK in this image")
+        pytest.skip("no JDK in this image — install openjdk (e.g. apt "
+                    "install openjdk-17-jdk-headless) to compile the "
+                    "Java client")
     proc = subprocess.run(
         [javac, "-d", str(tmp_path)] + [str(p) for p in _sources()],
         capture_output=True, text=True, timeout=300,
@@ -172,7 +174,9 @@ def test_java_wire_format_matches_golden(tmp_path):
     javac = shutil.which("javac")
     java = shutil.which("java")
     if not (javac and java):
-        pytest.skip("no JDK on this image")
+        pytest.skip("no JDK on this image — install openjdk (e.g. apt "
+                    "install openjdk-17-jdk-headless) to run the Java "
+                    "wire-format conformance check")
     classes = tmp_path / "classes"
     classes.mkdir()
     sources = [str(p) for p in _sources()]

@@ -273,6 +273,7 @@ def test_llm_chunked_decode_matches_single_step():
     assert [int(t) for t in np.asarray(chunk)] == singles
 
 
+@pytest.mark.slow  # compiles the full resnet50 forward on CPU
 def test_resnet_forward_shapes():
     model = ResNetModel(cfg=ResNetConfig(width=16, num_classes=10))
     out = model.infer({"INPUT": np.zeros((2, 224, 224, 3), np.float32)})

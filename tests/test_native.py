@@ -11,6 +11,8 @@ import subprocess
 
 import pytest
 
+pytestmark = pytest.mark.slow  # native cmake build + live-server e2e
+
 REPO = pathlib.Path(__file__).resolve().parent.parent
 NATIVE = REPO / "native"
 BUILD = NATIVE / "build"
@@ -298,7 +300,9 @@ def test_native_perf_analyzer_mpi_two_ranks(native_build, live_server):
     launcher (this one ships only the OpenMPI runtime library)."""
     mpirun = shutil.which("mpirun") or shutil.which("mpiexec")
     if mpirun is None:
-        pytest.skip("no MPI launcher (mpirun/mpiexec) on this image")
+        pytest.skip("no MPI launcher on this image — install one (e.g. "
+                    "apt install openmpi-bin) to run the 2-rank "
+                    "rank-merge test")
     version = subprocess.run([mpirun, "--version"], capture_output=True,
                              text=True).stdout
     # --allow-run-as-root is OpenMPI-only; MPICH's Hydra rejects it.

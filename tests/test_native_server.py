@@ -11,6 +11,8 @@ import threading
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # tpu_serverd e2e (needs native build)
+
 REPO = pathlib.Path(__file__).resolve().parent.parent
 SERVERD = REPO / "native" / "build" / "tpu_serverd"
 

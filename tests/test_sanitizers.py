@@ -11,6 +11,8 @@ import subprocess
 
 import pytest
 
+pytestmark = pytest.mark.slow  # TSAN cmake build tree (~3 min)
+
 REPO = pathlib.Path(__file__).resolve().parent.parent
 NATIVE = REPO / "native"
 TSAN_BUILD = NATIVE / "build-tsan"
