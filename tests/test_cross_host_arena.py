@@ -52,7 +52,9 @@ arr = np.array([b"alpha", b"bravo!"], dtype=np.object_)
 arena.write(region_id, 4096, serialize_byte_tensor(arr).tobytes(),
             "BYTES", [2])
 arena.write(region_id, 6000, b"\x01\x02\x03\x04")
+empty = arena.create_region(512, 0)
 print(json.dumps({"address": handle.address, "handle": raw.decode(),
+                  "empty_handle": empty.decode(),
                   "x": x.tolist(), "y": y.tolist()}), flush=True)
 signal.sigwait([signal.SIGTERM])
 handle.stop()
@@ -164,6 +166,69 @@ def test_server_redeems_foreign_handle_end_to_end(owner):
             assert len(core.memory.arena.list_regions()) == replicas - 1
     finally:
         handle.stop()
+
+
+def test_pull_empty_region(owner):
+    """A region with no writes yet pulls as an empty, correctly-sized
+    replica (the stream's metadata-only chunk)."""
+    arena = TpuArena()
+    local_handle = pull_region(owner["address"],
+                               owner["empty_handle"].encode(), arena)
+    descriptor = json.loads(local_handle)
+    assert descriptor["byte_size"] == 512
+    region_id = descriptor["region_id"]
+    assert arena.read(region_id, 0, 16) == b"\x00" * 16  # zero-filled
+
+
+def test_concurrent_pulls_are_independent(owner):
+    """Two consumers redeeming the same handle concurrently each get
+    their own coherent replica."""
+    import concurrent.futures
+
+    def one_pull(_):
+        arena = TpuArena()
+        local = pull_region(owner["address"], owner["handle"].encode(),
+                            arena)
+        region_id = json.loads(local)["region_id"]
+        return np.asarray(
+            arena.as_typed_array(region_id, 0, 64, "INT32", [16]))
+
+    with concurrent.futures.ThreadPoolExecutor(4) as pool:
+        results = list(pool.map(one_pull, range(4)))
+    for got in results:
+        np.testing.assert_array_equal(got, np.array(owner["x"], np.int32))
+
+
+def test_http_client_redeems_foreign_handle(owner):
+    """Same transparent redemption through the HTTP front-end: the
+    registration verb is protocol-symmetric (reference exposes
+    register_cuda_shared_memory on both protocols)."""
+    import client_tpu.http as httpclient
+    from client_tpu.server.http_server import start_http_server_thread
+
+    core = build_core(["simple"], warmup=False)
+    runner = start_http_server_thread(core, host="127.0.0.1", port=0)
+    try:
+        client = httpclient.InferenceServerClient(
+            "127.0.0.1:%d" % runner.port)
+        client.register_tpu_shared_memory(
+            "xh_http", owner["handle"].encode(), 0, 8192)
+        status = client.get_tpu_shared_memory_status()
+        assert "xh_http" in {r["name"] for r in status}
+        inputs = [
+            httpclient.InferInput("INPUT0", [16], "INT32"),
+            httpclient.InferInput("INPUT1", [16], "INT32"),
+        ]
+        inputs[0].set_shared_memory("xh_http", 64, offset=0)
+        inputs[1].set_shared_memory("xh_http", 64, offset=64)
+        result = client.infer("simple", inputs)
+        x = np.array(owner["x"], np.int32)
+        y = np.array(owner["y"], np.int32)
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), x + y)
+        client.unregister_tpu_shared_memory("xh_http")
+        client.close()
+    finally:
+        runner.stop()
 
 
 def test_unroutable_foreign_handle_still_rejected(owner):
