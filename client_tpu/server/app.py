@@ -84,15 +84,13 @@ def start_grpc_server(
         # Handles minted once serving starts carry this address, making
         # them redeemable from other hosts via the DCN pull path —
         # which is why this runs post-bind but PRE-serve (a handle
-        # minted by the first request must already be routed). 0.0.0.0
-        # is a bind address, not a route — leave routing to the
-        # deployment in that case (CLIENT_TPU_ARENA_URL overrides).
+        # minted by the first request must already be routed).
         arena = core.memory.arena
         if arena is None or arena.public_url:
             return
-        route = os.environ.get("CLIENT_TPU_ARENA_URL") or (
-            "%s:%d" % (host, port)
-            if host not in ("0.0.0.0", "[::]", "") else "")
+        from client_tpu.server.arena_pull import resolve_arena_route
+
+        route = resolve_arena_route("%s:%d" % (host, port))
         if route:
             arena.set_public_url(route)
 

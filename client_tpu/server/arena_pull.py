@@ -249,6 +249,21 @@ def pull_region(owner, raw_handle: bytes, local_arena: TpuArena,
             own_channel.close()
 
 
+def resolve_arena_route(bound: str) -> str:
+    """The single routing policy every front-end applies post-bind:
+    CLIENT_TPU_ARENA_URL wins unconditionally (the operator's explicit
+    route for NAT'd deployments); otherwise the bound address routes
+    unless its host is a bind-any address (0.0.0.0 is where to listen,
+    not where to be reached). Returns "" for 'publish nothing'."""
+    import os
+
+    env = os.environ.get("CLIENT_TPU_ARENA_URL")
+    if env:
+        return env
+    host = bound.rsplit(":", 1)[0] if bound else ""
+    return "" if host in ("0.0.0.0", "[::]", "") else bound
+
+
 def foreign_owner_url(raw_handle: bytes, local_arena_id: str
                       ) -> Optional[str]:
     """The owner's address when ``raw_handle`` belongs to ANOTHER

@@ -134,6 +134,22 @@ def unregister_tpu_shared_memory(name: str = "") -> None:
     _require_core().memory.unregister_tpu(name or None)
 
 
+def set_arena_public_url(url: str) -> None:
+    """Publishes the front-end's bound address into every handle the
+    arena mints from now on (call post-bind, pre-serve), making them
+    redeemable from other hosts via the DCN pull path. Same routing
+    policy as the Python front-end (arena_pull.resolve_arena_route);
+    a first-set wins."""
+    from client_tpu.server.arena_pull import resolve_arena_route
+
+    arena = _require_core().memory.arena
+    if arena is None or arena.public_url:
+        return
+    route = resolve_arena_route(url)
+    if route:
+        arena.set_public_url(route)
+
+
 def tpu_arena_allocate(byte_size: int, device_id: int = 0) -> bytes:
     """Allocates an HBM arena region in-process; returns the raw
     handle bytes (what the gRPC arena service would return)."""
@@ -204,6 +220,18 @@ def _grpc_registry():
         for name, req_t, _resp_t in arena_service._METHODS:
             path = "/%s/%s" % (arena_service.SERVICE_NAME, name)
             registry[path] = (req_t, getattr(arena_servicer, name), False)
+        for name, req_t, _resp_t in arena_service._STREAM_METHODS:
+            # Server-streaming with a UNARY request (PullRegion). The
+            # embed stream dispatch hands every handler a request
+            # iterator (bidi shape); adapt it to the unary-request
+            # signature the arena servicer uses.
+            path = "/%s/%s" % (arena_service.SERVICE_NAME, name)
+
+            def _adapt(request_iter, context,
+                       _method=getattr(arena_servicer, name)):
+                return _method(next(iter(request_iter)), context)
+
+            registry[path] = (req_t, _adapt, True)
     _registry = registry
     return registry
 

@@ -125,13 +125,25 @@ _transport_lock = threading.Lock()
 allocated_shm_regions: Dict[str, "TpuSharedMemoryHandle"] = {}
 
 
+def reset_arena_endpoint() -> None:
+    """Clears the module transport, closing any gRPC channel it owns
+    (the teardown twin of set_arena_endpoint / set_arena)."""
+    _swap_transport(None)
+
+
+def _swap_transport(new) -> None:
+    global _default_transport
+    with _transport_lock:
+        old, _default_transport = _default_transport, new
+    if old is not None and getattr(old, "channel", None) is not None:
+        old.channel.close()
+
+
 def set_arena(arena) -> None:
     """Use an in-process TpuArena (co-located / C-API-analogue mode —
     the cleanest zero-copy story, SURVEY.md §5 'distributed
     communication backend')."""
-    global _default_transport
-    with _transport_lock:
-        _default_transport = _ArenaTransport(arena=arena)
+    _swap_transport(_ArenaTransport(arena=arena))
 
 
 def set_arena_endpoint(url: str) -> None:
@@ -141,7 +153,6 @@ def set_arena_endpoint(url: str) -> None:
 
     from client_tpu.server.arena_service import TpuArenaStub
 
-    global _default_transport
     channel = grpc.insecure_channel(
         url,
         options=[
@@ -149,10 +160,8 @@ def set_arena_endpoint(url: str) -> None:
             ("grpc.max_receive_message_length", -1),
         ],
     )
-    with _transport_lock:
-        _default_transport = _ArenaTransport(
-            stub=TpuArenaStub(channel), channel=channel
-        )
+    _swap_transport(_ArenaTransport(stub=TpuArenaStub(channel),
+                                    channel=channel))
 
 
 def _transport() -> _ArenaTransport:

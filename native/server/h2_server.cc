@@ -828,6 +828,13 @@ H2Server::H2Server(GrpcHandler* handler, int workers)
 H2Server::~H2Server() { Shutdown(); }
 
 std::string H2Server::Listen(const std::string& host, int port) {
+  std::string err = Bind(host, port);
+  if (!err.empty()) return err;
+  Serve();
+  return "";
+}
+
+std::string H2Server::Bind(const std::string& host, int port) {
   int lfd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (lfd < 0) return strerror(errno);
   int one = 1;
@@ -855,8 +862,11 @@ std::string H2Server::Listen(const std::string& host, int port) {
   getsockname(lfd, reinterpret_cast<struct sockaddr*>(&addr), &alen);
   bound_port_ = ntohs(addr.sin_port);
   listen_fd_.store(lfd);
-  accept_thread_ = std::thread(&H2Server::AcceptLoop, this);
   return "";
+}
+
+void H2Server::Serve() {
+  accept_thread_ = std::thread(&H2Server::AcceptLoop, this);
 }
 
 void H2Server::AcceptLoop() {

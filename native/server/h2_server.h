@@ -68,8 +68,14 @@ class H2Server {
   H2Server& operator=(const H2Server&) = delete;
 
   // Binds and starts the accept loop. port 0 = ephemeral; see
-  // bound_port(). Returns "" on success.
+  // bound_port(). Returns "" on success. Equivalent to Bind()+Serve().
   std::string Listen(const std::string& host, int port);
+  // Two-phase variant: Bind() resolves the port (early connections
+  // queue in the kernel backlog), letting the caller finish
+  // port-dependent setup (e.g. publishing the arena route into
+  // handles) before Serve() starts accepting.
+  std::string Bind(const std::string& host, int port);
+  void Serve();
   int bound_port() const { return bound_port_; }
 
   // Stops accepting, closes all connections, joins all threads.

@@ -68,11 +68,23 @@ int main(int argc, char** argv) {
   }
 
   tpuclient::server::H2Server server(&handler, workers);
-  err = server.Listen(host, port);
+  err = server.Bind(host, port);
   if (!err.empty()) {
     fprintf(stderr, "listen failed: %s\n", err.c_str());
     return 1;
   }
+  // Post-bind, pre-serve: the first accepted connection must already
+  // see the published arena route in any handle it mints (early
+  // connections queue in the kernel backlog until Serve()). The embed
+  // side applies the same routing rules as the Python front-end: a
+  // bind-any host is not a route, CLIENT_TPU_ARENA_URL overrides.
+  err = handler.SetArenaPublicUrl(
+      host + ":" + std::to_string(server.bound_port()));
+  if (!err.empty()) {
+    fprintf(stderr, "arena route publish failed (cross-host "
+            "redemption of local handles disabled): %s\n", err.c_str());
+  }
+  server.Serve();
   std::unique_ptr<tpuclient::server::Http1Server> http_server;
   if (http_port >= 0) {
     http_server.reset(new tpuclient::server::Http1Server(&handler));

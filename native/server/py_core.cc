@@ -108,6 +108,17 @@ std::string PyCoreHandler::Init(const std::string& models_csv) {
   return err;
 }
 
+std::string PyCoreHandler::SetArenaPublicUrl(const std::string& url) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* r = PyObject_CallMethod(
+      impl_->module, "set_arena_public_url", "s", url.c_str());
+  std::string err;
+  if (r == nullptr) err = FetchPyError("embed.set_arena_public_url");
+  Py_XDECREF(r);
+  PyGILState_Release(gil);
+  return err;
+}
+
 int PyCoreHandler::MethodKind(const std::string& path) {
   {
     std::lock_guard<std::mutex> lk(impl_->kind_mutex);

@@ -20,6 +20,12 @@ class PyCoreHandler : public GrpcHandler, public HttpHandler {
   // called once before the H2Server starts dispatching.
   std::string Init(const std::string& models_csv);
 
+  // Publishes the bound address into arena handles (embed.
+  // set_arena_public_url) so they are redeemable cross-host via the
+  // DCN pull path. Call after Listen(), before serving. Returns "" on
+  // success.
+  std::string SetArenaPublicUrl(const std::string& url);
+
   int MethodKind(const std::string& path) override;
   GrpcReply Call(const std::string& path,
                  const std::string& message) override;

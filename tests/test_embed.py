@@ -106,3 +106,31 @@ def test_arena_allocate_and_register(embedded):
     assert isinstance(handle, bytes) and handle
     embedded.register_tpu_shared_memory("embed_r0", handle, 0, 1024)
     embedded.unregister_tpu_shared_memory("embed_r0")
+
+
+def test_arena_pull_region_streams_through_embed(embedded):
+    """The DCN pull RPC is reachable through the native front-end's
+    dispatch registry: PullRegion is a server-streaming method with a
+    unary request, adapted onto the embed stream path."""
+    from client_tpu.protocol import arena_pb2
+
+    handle = embedded.tpu_arena_allocate(256)
+    path = "/inference.TpuArenaService/PullRegion"
+    assert embedded.grpc_method_kind(path) == "stream"
+    write = arena_pb2.WriteRegionRequest(
+        region_id=json.loads(handle)["region_id"],
+        offset=0, data=np.arange(16, dtype=np.int32).tobytes(),
+        datatype="INT32", shape=[16])
+    embedded.grpc_call("/inference.TpuArenaService/WriteRegion",
+                       write.SerializeToString())
+    request = arena_pb2.PullRegionRequest(raw_handle=handle,
+                                          chunk_bytes=16)
+    chunks = [arena_pb2.PullRegionChunk.FromString(raw)
+              for raw in embedded.grpc_stream_call(
+                  path, request.SerializeToString())]
+    assert chunks[0].region_byte_size == 256
+    assert chunks[0].datatype == "INT32"
+    assert len(chunks) == 4  # 64 bytes in 16-byte chunks
+    payload = b"".join(c.data for c in chunks)
+    np.testing.assert_array_equal(
+        np.frombuffer(payload, np.int32), np.arange(16, dtype=np.int32))

@@ -169,6 +169,40 @@ def test_statistics_accumulate(serverd):
     assert after == before + 1
 
 
+def test_arena_pull_through_native_front_end(serverd):
+    """The DCN pull rides the native C++ h2 transport end to end: a
+    consumer arena pulls a region the native server's arena owns, via
+    the server-streaming PullRegion RPC over a real channel."""
+    import client_tpu.utils.tpu_shared_memory as tpushm
+    from client_tpu.server.arena_pull import pull_region
+    from client_tpu.server.tpu_arena import TpuArena
+
+    tpushm.set_arena_endpoint(serverd)
+    try:
+        payload = np.random.default_rng(3).random((8, 32)).astype(
+            np.float32)
+        handle = tpushm.create_shared_memory_region(
+            "pull_src", payload.nbytes, 0)
+        try:
+            tpushm.set_shared_memory_region(handle, [payload])
+            raw = tpushm.get_raw_handle(handle)
+            # Handles minted by the native front-end carry the route
+            # (SetArenaPublicUrl runs post-bind, pre-serve).
+            import json
+
+            assert json.loads(raw).get("owner_url") == serverd
+            consumer = TpuArena()
+            local = pull_region(serverd, raw, consumer, chunk_bytes=256)
+            region_id = json.loads(local)["region_id"]
+            got = np.asarray(consumer.as_typed_array(
+                region_id, 0, payload.nbytes, "FP32", [8, 32]))
+            np.testing.assert_array_equal(got, payload)
+        finally:
+            tpushm.destroy_shared_memory_region(handle)
+    finally:
+        tpushm.reset_arena_endpoint()
+
+
 def test_http_front_end_infer(serverd_ports):
     """The Python HTTP client (binary protocol, own pooled transport)
     drives tpu_serverd's native HTTP/1.1 front-end."""

@@ -25,7 +25,7 @@ def server():
 def remote_arena(server):
     tpushm.set_arena_endpoint(server.address)
     yield
-    tpushm._default_transport = None
+    tpushm.reset_arena_endpoint()
 
 
 @pytest.fixture()
@@ -151,7 +151,7 @@ def test_in_process_zero_copy():
         np.testing.assert_array_equal(reread, np.arange(16, dtype=np.float32))
         tpushm.destroy_shared_memory_region(handle)
     finally:
-        tpushm._default_transport = None
+        tpushm.reset_arena_endpoint()
 
 
 def test_in_process_torch_dlpack():
@@ -167,7 +167,7 @@ def test_in_process_torch_dlpack():
         np.testing.assert_array_equal(out, t.numpy())
         tpushm.destroy_shared_memory_region(handle)
     finally:
-        tpushm._default_transport = None
+        tpushm.reset_arena_endpoint()
 
 
 def test_typed_view_from_raw_write():
@@ -187,7 +187,7 @@ def test_typed_view_from_raw_write():
         np.testing.assert_array_equal(np.asarray(tensor.array)[:8], a)
         tpushm.destroy_shared_memory_region(handle)
     finally:
-        tpushm._default_transport = None
+        tpushm.reset_arena_endpoint()
 
 
 class TestSegmentedArena:
