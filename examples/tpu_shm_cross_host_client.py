@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Cross-host TPU shared-memory redemption (docs/cross_host_arena.md).
+
+Demonstrates the DCN pull path with two servers playing two hosts:
+data is populated ONCE into host B's HBM arena; a client then runs
+inference against host A using B's region handle. Host A transparently
+pulls a typed replica of the region over the arena service's streaming
+PullRegion RPC and serves from local HBM — the client never re-uploads
+the tensors, and the handle is the only thing that crosses between the
+client's view of the two hosts.
+
+The reference's CUDA-IPC sharing (simple_grpc_cudashm_client.py)
+cannot cross hosts at all; this is the TPU-native extension of the
+same register/redeem model to a DCN-connected fleet.
+
+Run with no arguments to self-host both servers in-process, or point
+--owner-url / --serve-url at two already-running servers:
+
+    python -m client_tpu.server.app --grpc-port 8001  # host B (owner)
+    python -m client_tpu.server.app --grpc-port 8002  # host A (server)
+    python examples/tpu_shm_cross_host_client.py \
+        --owner-url localhost:8001 --serve-url localhost:8002
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import client_tpu.grpc as grpcclient
+import client_tpu.utils.tpu_shared_memory as tpushm
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--owner-url", default="",
+                        help="host B: where the data lives")
+    parser.add_argument("--serve-url", default="",
+                        help="host A: where inference runs")
+    args = parser.parse_args()
+
+    started = []
+    if not (args.owner_url and args.serve_url):
+        # Self-hosted demo: two independent server cores in one
+        # process stand in for the two hosts.
+        from client_tpu.server.app import build_core, start_grpc_server
+
+        owner = start_grpc_server(core=build_core([], warmup=False))
+        server = start_grpc_server(core=build_core(["simple"]))
+        started = [owner, server]
+        args.owner_url, args.serve_url = owner.address, server.address
+        print("self-hosted: owner(B)=%s serve(A)=%s"
+              % (args.owner_url, args.serve_url))
+
+    try:
+        # 1. Populate host B's arena: one region, both input tensors
+        #    as typed segments at fixed offsets.
+        tpushm.set_arena_endpoint(args.owner_url)
+        x = np.arange(16, dtype=np.int32)
+        y = np.full(16, 3, dtype=np.int32)
+        region = tpushm.create_shared_memory_region(
+            "xhost_data", 2 * x.nbytes, 0)
+        tpushm.set_shared_memory_region(region, [x, y])
+        raw_handle = tpushm.get_raw_handle(region)
+        import json
+
+        route = json.loads(raw_handle).get("owner_url")
+        if not route:
+            sys.exit("owner published no route (a 0.0.0.0 bind is not "
+                     "reachable) — start host B with --host <address> "
+                     "or set CLIENT_TPU_ARENA_URL")
+        print("host B holds the data; handle routes to %s" % route)
+
+        # 2. Register B's handle with host A — A pulls the typed
+        #    replica over DCN behind this one verb.
+        client = grpcclient.InferenceServerClient(args.serve_url)
+        client.register_tpu_shared_memory("xhost_data", raw_handle, 0,
+                                          2 * x.nbytes)
+
+        # 3. Infer on A from the replicated region (no tensor bytes on
+        #    this wire — just region references).
+        inputs = [
+            grpcclient.InferInput("INPUT0", [16], "INT32"),
+            grpcclient.InferInput("INPUT1", [16], "INT32"),
+        ]
+        inputs[0].set_shared_memory("xhost_data", x.nbytes, offset=0)
+        inputs[1].set_shared_memory("xhost_data", y.nbytes,
+                                    offset=x.nbytes)
+        result = client.infer("simple", inputs)
+        out0 = result.as_numpy("OUTPUT0")
+        out1 = result.as_numpy("OUTPUT1")
+        np.testing.assert_array_equal(out0, x + y)
+        np.testing.assert_array_equal(out1, x - y)
+        print("host A served from host B's tensors: OUTPUT0[:4]=%s "
+              "OUTPUT1[:4]=%s" % (out0[:4], out1[:4]))
+
+        # 4. Cleanup: A frees its replica on unregister; B's region is
+        #    destroyed through the owner transport.
+        client.unregister_tpu_shared_memory("xhost_data")
+        client.close()
+        tpushm.destroy_shared_memory_region(region)
+        tpushm.reset_arena_endpoint()
+        print("PASS: cross-host redemption")
+    finally:
+        for handle in started:
+            handle.stop()
+
+
+if __name__ == "__main__":
+    main()

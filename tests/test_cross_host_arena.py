@@ -64,6 +64,7 @@ handle.stop()
 @pytest.fixture(scope="module")
 def owner():
     env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    env.pop("CLIENT_TPU_ARENA_URL", None)  # hermetic owner route
     proc = subprocess.Popen(
         [sys.executable, "-c", OWNER_SCRIPT], stdout=subprocess.PIPE,
         stderr=subprocess.DEVNULL, text=True, cwd=str(REPO), env=env)

@@ -70,9 +70,15 @@ def example_server():
 
 
 def _run_example_args(name, args, timeout=300):
+    import os
+
+    env = dict(os.environ)
+    # An ambient deployment route would redirect the self-hosted
+    # cross-host example's pulls to the wrong endpoint.
+    env.pop("CLIENT_TPU_ARENA_URL", None)
     proc = subprocess.run(
         [sys.executable, str(EXAMPLES / name)] + args,
-        capture_output=True, text=True, timeout=timeout,
+        capture_output=True, text=True, timeout=timeout, env=env,
     )
     assert proc.returncode == 0, "%s failed:\n%s\n%s" % (
         name, proc.stdout[-2000:], proc.stderr[-2000:]
@@ -92,6 +98,12 @@ def test_grpc_example(example_server, name):
 @pytest.mark.parametrize("name", HTTP_EXAMPLES)
 def test_http_example(example_server, name):
     _run_example(name, example_server["http"])
+
+
+def test_cross_host_example():
+    # Self-hosts its two "hosts" (owner + serving server), so it takes
+    # no -u; the serving host redeems the owner's handle via DCN pull.
+    _run_example_args("tpu_shm_cross_host_client.py", [])
 
 
 CPP_GRPC_EXAMPLES = [

@@ -24,6 +24,9 @@ def serverd_ports():
     import os
 
     env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    # An ambient deployment route would override the bound address the
+    # owner_url assertions expect.
+    env.pop("CLIENT_TPU_ARENA_URL", None)
     proc = subprocess.Popen(
         [str(SERVERD), "--port", "0", "--http-port", "0",
          "--models", "simple"],
