@@ -42,10 +42,17 @@ def main():
                         help="host A: where inference runs")
     args = parser.parse_args()
 
+    if bool(args.owner_url) != bool(args.serve_url):
+        parser.error("--owner-url and --serve-url go together (one "
+                     "alone would silently self-host both hosts)")
     started = []
-    if not (args.owner_url and args.serve_url):
+    if not args.owner_url:
         # Self-hosted demo: two independent server cores in one
-        # process stand in for the two hosts.
+        # process stand in for the two hosts. An ambient deployment
+        # route (CLIENT_TPU_ARENA_URL) would stamp BOTH self-hosted
+        # arenas with the same external URL and misdirect the pull —
+        # the self-hosted topology routes by bound address.
+        os.environ.pop("CLIENT_TPU_ARENA_URL", None)
         from client_tpu.server.app import build_core, start_grpc_server
 
         owner = start_grpc_server(core=build_core([], warmup=False))

@@ -76,6 +76,13 @@ def _run_example_args(name, args, timeout=300):
     # An ambient deployment route would redirect the self-hosted
     # cross-host example's pulls to the wrong endpoint.
     env.pop("CLIENT_TPU_ARENA_URL", None)
+    # The cross-host example builds server cores (imports jax) in this
+    # subprocess: both knobs must be set before the interpreter starts
+    # or the image's sitecustomize brings up the axon TPU platform
+    # (minutes of init, possible relay wedge). Harmless for the
+    # pure-client examples.
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
     proc = subprocess.run(
         [sys.executable, str(EXAMPLES / name)] + args,
         capture_output=True, text=True, timeout=timeout, env=env,
