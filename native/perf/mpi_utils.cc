@@ -1,10 +1,21 @@
 #include "mpi_utils.h"
 
 #include <dlfcn.h>
+#include <errno.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
 
+#include <chrono>
 #include <cstdint>
+#include <cstdio>
 #include <cstdlib>
 #include <string>
+#include <thread>
 
 namespace tpuclient {
 namespace perf {
@@ -21,10 +32,124 @@ constexpr uintptr_t kMpichCommWorld = 0x44000000u;
 constexpr uintptr_t kMpichTypeInt = 0x4c000405u;
 constexpr uintptr_t kMpichOpLand = 0x58000005u;
 
+// ---- built-in coordinator wire format ------------------------------
+// One fixed 8-byte frame per collective message. TCP ordering plus
+// the lockstep collective call sequence (every rank issues the same
+// collectives in the same order — the same contract MPI itself
+// assumes) means no framing beyond a sanity-checked sequence number
+// is needed.
+struct CoordFrame {
+  uint16_t magic;  // kCoordMagic
+  uint8_t op;      // CoordOp
+  uint8_t flag;    // hello: low byte of rank; all_and: local flag
+  uint32_t seq;    // collective counter (hello: full rank)
+};
+static_assert(sizeof(CoordFrame) == 8, "frame must be 8 bytes");
+
+constexpr uint16_t kCoordMagic = 0x5043;  // "CP"
+enum CoordOp : uint8_t { kHello = 1, kAllAnd = 2, kResult = 3 };
+
+bool SendAll(int fd, const CoordFrame& f) {
+  const char* p = reinterpret_cast<const char*>(&f);
+  size_t left = sizeof(f);
+  while (left > 0) {
+    ssize_t n = send(fd, p, left, MSG_NOSIGNAL);
+    if (n <= 0) {
+      if (n < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += n;
+    left -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool RecvAll(int fd, CoordFrame* f) {
+  char* p = reinterpret_cast<char*>(f);
+  size_t left = sizeof(*f);
+  while (left > 0) {
+    ssize_t n = recv(fd, p, left, 0);
+    if (n <= 0) {
+      if (n < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += n;
+    left -= static_cast<size_t>(n);
+  }
+  return f->magic == kCoordMagic;
+}
+
+void SetSocketOptions(int fd, double timeout_s) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  struct timeval tv;
+  tv.tv_sec = static_cast<long>(timeout_s);
+  tv.tv_usec = static_cast<long>((timeout_s - tv.tv_sec) * 1e6);
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+}
+
+// Resolve host:port to a connect/bind-ready IPv4/IPv6 address.
+bool ResolveAddr(const std::string& host, int port, bool for_bind,
+                 struct addrinfo** out) {
+  struct addrinfo hints;
+  memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  if (for_bind) hints.ai_flags = AI_PASSIVE;
+  const std::string port_str = std::to_string(port);
+  return getaddrinfo(host.empty() ? nullptr : host.c_str(),
+                     port_str.c_str(), &hints, out) == 0;
+}
+
 }  // namespace
 
 MPIDriver::MPIDriver(bool is_enabled) {
   if (!is_enabled) return;
+  // Built-in coordinator contract (jax.distributed.initialize-style:
+  // coordinator_address / num_processes / process_id). Preferred over
+  // the MPI probe when set — it works with no launcher at all.
+  const char* coord = getenv("TPUCLIENT_COORDINATOR");
+  const char* world = getenv("TPUCLIENT_WORLD_SIZE");
+  const char* rank = getenv("TPUCLIENT_RANK");
+  if (coord != nullptr && world != nullptr && rank != nullptr) {
+    const std::string addr(coord);
+    const size_t colon = addr.rfind(':');
+    const int size = atoi(world);
+    const int r = atoi(rank);
+    if (colon != std::string::npos && size >= 2 && r >= 0 && r < size) {
+      coord_host_ = addr.substr(0, colon);
+      // Bracketed IPv6 literal ([fd00::1]:7000) — strip the brackets
+      // for getaddrinfo (same accepted shape as
+      // jax.distributed.initialize's coordinator_address).
+      if (coord_host_.size() >= 2 && coord_host_.front() == '[' &&
+          coord_host_.back() == ']') {
+        coord_host_ = coord_host_.substr(1, coord_host_.size() - 2);
+      }
+      coord_port_ = atoi(addr.c_str() + colon + 1);
+      world_size_ = size;
+      rank_ = r;
+      if (const char* t = getenv("TPUCLIENT_COORD_TIMEOUT_S")) {
+        timeout_s_ = atof(t);
+        if (timeout_s_ <= 0) timeout_s_ = 60.0;
+      }
+      // Per-collective skew budget — deliberately separate from the
+      // join timeout: a fail-fast join window must not turn a long
+      // measurement trial's stability collective into a degrade.
+      if (const char* t = getenv("TPUCLIENT_COLLECTIVE_TIMEOUT_S")) {
+        collective_timeout_s_ = atof(t);
+      }
+      if (collective_timeout_s_ <= 0) collective_timeout_s_ = 600.0;
+      builtin_ = true;
+      active_ = true;
+      return;
+    }
+    fprintf(stderr,
+            "warning: TPUCLIENT_COORDINATOR set but the rank contract "
+            "is invalid (addr=%s world=%s rank=%s); running "
+            "single-rank\n",
+            coord, world, rank);
+  }
   // OpenMPI exposes its communicator/type/op constants as dynamic
   // symbols (ompi_*); the MPICH family bakes them in as integer
   // constants (fallback below).
@@ -100,23 +225,48 @@ MPIDriver::MPIDriver(bool is_enabled) {
 }
 
 MPIDriver::~MPIDriver() {
+  BuiltinTeardown();
   if (handle_ != nullptr) dlclose(handle_);
 }
 
 void MPIDriver::MPIInit() {
-  if (active_) init_(nullptr, nullptr);
+  if (!active_) return;
+  if (builtin_) {
+    if (!BuiltinInit()) {
+      fprintf(stderr,
+              "warning: rank %d could not join the coordinator at "
+              "%s:%d within %.0fs; degrading to a single-rank run\n",
+              rank_, coord_host_.c_str(), coord_port_, timeout_s_);
+      BuiltinTeardown();
+      active_ = false;
+    }
+    return;
+  }
+  init_(nullptr, nullptr);
 }
 
 void MPIDriver::MPIFinalize() {
-  if (active_) finalize_();
+  if (!active_) return;
+  if (builtin_) {
+    BuiltinTeardown();
+    return;
+  }
+  finalize_();
 }
 
 void MPIDriver::MPIBarrierWorld() {
-  if (active_) barrier_(comm_world_);
+  if (!active_) return;
+  if (builtin_) {
+    bool unused;
+    BuiltinCollective(true, &unused);
+    return;
+  }
+  barrier_(comm_world_);
 }
 
 int MPIDriver::MPICommSizeWorld() const {
   if (!active_) return 1;
+  if (builtin_) return world_size_;
   int size = 1;
   comm_size_(comm_world_, &size);
   return size;
@@ -124,6 +274,7 @@ int MPIDriver::MPICommSizeWorld() const {
 
 int MPIDriver::MPICommRankWorld() const {
   if (!active_) return 0;
+  if (builtin_) return rank_;
   int rank = 0;
   comm_rank_(comm_world_, &rank);
   return rank;
@@ -131,10 +282,166 @@ int MPIDriver::MPICommRankWorld() const {
 
 bool MPIDriver::MPIAllTrue(bool local) const {
   if (!active_) return local;
+  if (builtin_) {
+    bool result = local;
+    if (!BuiltinCollective(local, &result)) return local;
+    return result;
+  }
   int in = local ? 1 : 0;
   int out = 0;
   allreduce_(&in, &out, 1, type_int_, op_land_, comm_world_);
   return out != 0;
+}
+
+bool MPIDriver::BuiltinInit() {
+  const auto deadline = std::chrono::steady_clock::now() +
+                        std::chrono::duration<double>(timeout_s_);
+  if (rank_ == 0) {
+    struct addrinfo* ai = nullptr;
+    if (!ResolveAddr(coord_host_, coord_port_, /*for_bind=*/true, &ai)) {
+      return false;
+    }
+    // Walk every resolved address (a dual-stack hostname's first
+    // entry may be an unbindable family on this host).
+    for (struct addrinfo* a = ai; a != nullptr; a = a->ai_next) {
+      listen_fd_ = socket(a->ai_family, SOCK_STREAM, 0);
+      if (listen_fd_ < 0) continue;
+      int one = 1;
+      setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+      if (bind(listen_fd_, a->ai_addr, a->ai_addrlen) == 0 &&
+          listen(listen_fd_, world_size_) == 0) {
+        break;
+      }
+      close(listen_fd_);
+      listen_fd_ = -1;
+    }
+    freeaddrinfo(ai);
+    if (listen_fd_ < 0) return false;
+    fds_.assign(world_size_ - 1, -1);
+    int joined = 0;
+    while (joined < world_size_ - 1) {
+      const auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+          deadline - std::chrono::steady_clock::now());
+      if (left.count() <= 0) return false;
+      struct pollfd pfd = {listen_fd_, POLLIN, 0};
+      const int ready = poll(&pfd, 1, static_cast<int>(left.count()));
+      if (ready <= 0) {
+        if (ready < 0 && errno == EINTR) continue;
+        return false;
+      }
+      const int fd = accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) continue;
+      SetSocketOptions(fd, timeout_s_);
+      CoordFrame hello;
+      const int peer =
+          RecvAll(fd, &hello) && hello.op == kHello
+              ? static_cast<int>(hello.seq)
+              : -1;
+      if (peer < 1 || peer >= world_size_ || fds_[peer - 1] != -1) {
+        close(fd);
+        continue;  // malformed or duplicate join; keep listening
+      }
+      fds_[peer - 1] = fd;
+      ++joined;
+    }
+    // Joined: widen the socket deadlines from the join window to the
+    // per-collective skew budget.
+    for (int fd : fds_) SetSocketOptions(fd, collective_timeout_s_);
+    return true;
+  }
+  // Non-coordinator rank: connect with retry until rank 0 is up. A
+  // failed resolve also retries — under a scheduler the
+  // coordinator's DNS name may not be propagated yet when this rank
+  // starts.
+  struct addrinfo* ai = nullptr;
+  while (std::chrono::steady_clock::now() < deadline) {
+    if (ai == nullptr &&
+        !ResolveAddr(coord_host_, coord_port_, /*for_bind=*/false, &ai)) {
+      ai = nullptr;
+      std::this_thread::sleep_for(std::chrono::milliseconds(250));
+      continue;
+    }
+    int fd = -1;
+    for (struct addrinfo* a = ai; a != nullptr; a = a->ai_next) {
+      fd = socket(a->ai_family, SOCK_STREAM, 0);
+      if (fd < 0) continue;
+      if (connect(fd, a->ai_addr, a->ai_addrlen) == 0) break;
+      close(fd);
+      fd = -1;
+    }
+    if (fd >= 0) {
+      freeaddrinfo(ai);
+      SetSocketOptions(fd, collective_timeout_s_);
+      CoordFrame hello = {kCoordMagic, kHello,
+                          static_cast<uint8_t>(rank_ & 0xff),
+                          static_cast<uint32_t>(rank_)};
+      if (!SendAll(fd, hello)) {
+        close(fd);
+        return false;
+      }
+      fds_.assign(1, fd);
+      return true;
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+  if (ai != nullptr) freeaddrinfo(ai);
+  return false;
+}
+
+bool MPIDriver::BuiltinCollective(bool local, bool* result) const {
+  const uint32_t seq = seq_++;
+  bool ok = true;
+  if (rank_ == 0) {
+    bool agg = local;
+    for (int fd : fds_) {
+      CoordFrame f;
+      if (!RecvAll(fd, &f) || f.op != kAllAnd || f.seq != seq) {
+        ok = false;
+        break;
+      }
+      agg = agg && f.flag != 0;
+    }
+    if (ok) {
+      const CoordFrame out = {kCoordMagic, kResult,
+                              static_cast<uint8_t>(agg ? 1 : 0), seq};
+      for (int fd : fds_) {
+        if (!SendAll(fd, out)) {
+          ok = false;
+          break;
+        }
+      }
+    }
+    if (ok) *result = agg;
+  } else {
+    const CoordFrame out = {kCoordMagic, kAllAnd,
+                            static_cast<uint8_t>(local ? 1 : 0), seq};
+    CoordFrame in;
+    ok = SendAll(fds_[0], out) && RecvAll(fds_[0], &in) &&
+         in.op == kResult && in.seq == seq;
+    if (ok) *result = in.flag != 0;
+  }
+  if (!ok) {
+    // A dead peer must not hang the world: drop to rank-local
+    // decisions (the same degrade contract as a missing launcher).
+    fprintf(stderr,
+            "warning: rank %d lost the coordinator collective (seq %u); "
+            "degrading to rank-local decisions\n",
+            rank_, seq);
+    BuiltinTeardown();
+    active_ = false;
+  }
+  return ok;
+}
+
+void MPIDriver::BuiltinTeardown() const {
+  for (int fd : fds_) {
+    if (fd >= 0) close(fd);
+  }
+  fds_.clear();
+  if (listen_fd_ >= 0) {
+    close(listen_fd_);
+    listen_fd_ = -1;
+  }
 }
 
 }  // namespace perf

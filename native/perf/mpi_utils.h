@@ -1,21 +1,37 @@
-// Optional MPI coordination for multi-process perf runs (parity:
+// Rank coordination for multi-process perf runs (parity:
 // /root/reference/src/c++/perf_analyzer/mpi_utils.h:32-80 — libmpi is
 // dlopen'd at runtime, never a compile-time dependency; without it
 // every call degrades to single-rank no-ops). Used to launch several
 // analyzer ranks against one server and synchronize their
 // measurement windows.
+//
+// Two transports, one facade:
+//  - MPI: when launched under mpirun/mpiexec with a loadable libmpi,
+//    collectives ride MPI_Allreduce/MPI_Barrier (the reference's
+//    only mode).
+//  - Built-in coordinator: when the TPUCLIENT_COORDINATOR /
+//    TPUCLIENT_WORLD_SIZE / TPUCLIENT_RANK environment variables are
+//    set (the same coordinator_address / num_processes / process_id
+//    contract as jax.distributed.initialize), rank 0 listens on the
+//    coordinator address and the collectives run over a TCP star.
+//    This makes multi-rank scale-out work on hosts with no MPI
+//    launcher at all — each rank is started by hand, a script, or a
+//    scheduler, exactly like a JAX multi-host job.
 #pragma once
 
+#include <cstdint>
 #include <string>
+#include <vector>
 
 namespace tpuclient {
 namespace perf {
 
 class MPIDriver {
  public:
-  // is_enabled requests MPI; the driver only becomes active when
-  // libmpi.so is loadable AND the process runs under mpirun (world
-  // size resolvable).
+  // is_enabled requests coordination; the driver only becomes active
+  // when (a) libmpi.so is loadable AND the process runs under mpirun
+  // (world size resolvable), or (b) the TPUCLIENT_COORDINATOR env
+  // contract names this process's rank in a multi-rank world.
   explicit MPIDriver(bool is_enabled);
   ~MPIDriver();
 
@@ -32,9 +48,17 @@ class MPIDriver {
   bool MPIAllTrue(bool local) const;
 
  private:
-  bool active_ = false;
+  // Built-in coordinator transport.
+  bool BuiltinInit();
+  bool BuiltinCollective(bool local, bool* result) const;
+  void BuiltinTeardown() const;
+
+  // active_ / seq_ / fds are mutable so a socket failure inside the
+  // const collective entry points can deactivate the driver and
+  // degrade to rank-local decisions instead of hanging peers.
+  mutable bool active_ = false;
   void* handle_ = nullptr;
-  // Bound symbols (only valid while active_).
+  // Bound symbols (only valid while active_ on the MPI transport).
   int (*init_)(int*, char***) = nullptr;
   int (*finalize_)() = nullptr;
   int (*barrier_)(void*) = nullptr;
@@ -44,6 +68,20 @@ class MPIDriver {
   void* comm_world_ = nullptr;
   void* type_int_ = nullptr;
   void* op_land_ = nullptr;
+
+  // Built-in coordinator state.
+  bool builtin_ = false;
+  int rank_ = 0;
+  int world_size_ = 1;
+  std::string coord_host_;
+  int coord_port_ = 0;
+  double timeout_s_ = 60.0;             // join/connect window
+  double collective_timeout_s_ = 600.0;  // per-collective skew budget
+  mutable int listen_fd_ = -1;
+  // Coordinator: one socket per peer rank (index rank-1).
+  // Non-coordinator: a single socket to rank 0 at index 0.
+  mutable std::vector<int> fds_;
+  mutable uint32_t seq_ = 0;
 };
 
 }  // namespace perf

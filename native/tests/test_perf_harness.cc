@@ -1,6 +1,12 @@
 // Perf harness unit tests over the mock backend — no server needed
 // (parity tier 1: the reference's 131 doctest TEST_CASEs run against
 // NaggyMockClientBackend, SURVEY.md §4).
+#include <netinet/in.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
 #include <chrono>
 #include <fstream>
 #include <sstream>
@@ -9,6 +15,7 @@
 #include "../perf/command_line_parser.h"
 #include "../perf/inference_profiler.h"
 #include "../perf/metrics_manager.h"
+#include "../perf/mpi_utils.h"
 #include "../perf/report_writer.h"
 #include "minitest.h"
 
@@ -583,6 +590,128 @@ TEST_CASE("perf: command line parser") {
       "perf_analyzer", "-m", "x", "--concurrency-range", "1:2",
       "--request-rate-range", "10:20"};
   CHECK(!CLParser::Parse(7, const_cast<char**>(argv3), &exclusive).IsOk());
+}
+
+TEST_CASE("perf: builtin rank coordinator 2-rank collectives") {
+  // Two real processes (fork) join over the TPUCLIENT_COORDINATOR
+  // TCP contract — the launcher-free replacement for the reference's
+  // mpirun path (mpi_utils.h:32-80) — and must agree on every
+  // AllTrue decision.
+  int probe = socket(AF_INET, SOCK_STREAM, 0);
+  REQUIRE(probe >= 0);
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = 0;
+  REQUIRE(bind(probe, reinterpret_cast<struct sockaddr*>(&addr),
+               sizeof(addr)) == 0);
+  socklen_t len = sizeof(addr);
+  REQUIRE(getsockname(probe, reinterpret_cast<struct sockaddr*>(&addr),
+                      &len) == 0);
+  const int port = ntohs(addr.sin_port);
+  close(probe);
+
+  char coord[64];
+  snprintf(coord, sizeof(coord), "127.0.0.1:%d", port);
+  setenv("TPUCLIENT_COORDINATOR", coord, 1);
+  setenv("TPUCLIENT_WORLD_SIZE", "2", 1);
+  setenv("TPUCLIENT_COORD_TIMEOUT_S", "20", 1);
+
+  const pid_t pid = fork();
+  REQUIRE(pid >= 0);
+  if (pid == 0) {
+    // Rank 1: exit code reports each collective's outcome.
+    setenv("TPUCLIENT_RANK", "1", 1);
+    MPIDriver peer(true);
+    if (!peer.IsMPIRun()) _exit(10);
+    peer.MPIInit();
+    if (!peer.IsMPIRun()) _exit(11);
+    if (peer.MPICommSizeWorld() != 2 || peer.MPICommRankWorld() != 1) {
+      _exit(12);
+    }
+    if (!peer.MPIAllTrue(true)) _exit(13);   // both true -> true
+    if (peer.MPIAllTrue(false)) _exit(14);   // local false -> false
+    if (peer.MPIAllTrue(true)) _exit(15);    // peer false -> false
+    peer.MPIBarrierWorld();
+    peer.MPIFinalize();
+    _exit(0);
+  }
+  setenv("TPUCLIENT_RANK", "0", 1);
+  MPIDriver mpi(true);
+  CHECK(mpi.IsMPIRun());
+  mpi.MPIInit();
+  REQUIRE(mpi.IsMPIRun());
+  CHECK_EQ(mpi.MPICommSizeWorld(), 2);
+  CHECK_EQ(mpi.MPICommRankWorld(), 0);
+  CHECK(mpi.MPIAllTrue(true));
+  CHECK(!mpi.MPIAllTrue(true));   // peer votes false
+  CHECK(!mpi.MPIAllTrue(false));  // local false
+  mpi.MPIBarrierWorld();
+  mpi.MPIFinalize();
+  int status = 0;
+  REQUIRE(waitpid(pid, &status, 0) == pid);
+  CHECK(WIFEXITED(status));
+  CHECK_EQ(WEXITSTATUS(status), 0);
+
+  unsetenv("TPUCLIENT_COORDINATOR");
+  unsetenv("TPUCLIENT_WORLD_SIZE");
+  unsetenv("TPUCLIENT_RANK");
+  unsetenv("TPUCLIENT_COORD_TIMEOUT_S");
+}
+
+TEST_CASE("perf: builtin rank coordinator degrades when a peer dies") {
+  int probe = socket(AF_INET, SOCK_STREAM, 0);
+  REQUIRE(probe >= 0);
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = 0;
+  REQUIRE(bind(probe, reinterpret_cast<struct sockaddr*>(&addr),
+               sizeof(addr)) == 0);
+  socklen_t len = sizeof(addr);
+  REQUIRE(getsockname(probe, reinterpret_cast<struct sockaddr*>(&addr),
+                      &len) == 0);
+  const int port = ntohs(addr.sin_port);
+  close(probe);
+
+  char coord[64];
+  snprintf(coord, sizeof(coord), "127.0.0.1:%d", port);
+  setenv("TPUCLIENT_COORDINATOR", coord, 1);
+  setenv("TPUCLIENT_WORLD_SIZE", "2", 1);
+  setenv("TPUCLIENT_COORD_TIMEOUT_S", "20", 1);
+
+  const pid_t pid = fork();
+  REQUIRE(pid >= 0);
+  if (pid == 0) {
+    // Rank 1 joins, answers one collective, then dies without
+    // finalizing — the coordinator must degrade, not hang.
+    setenv("TPUCLIENT_RANK", "1", 1);
+    MPIDriver peer(true);
+    peer.MPIInit();
+    if (!peer.IsMPIRun()) _exit(11);
+    peer.MPIAllTrue(true);
+    _exit(0);
+  }
+  setenv("TPUCLIENT_RANK", "0", 1);
+  MPIDriver mpi(true);
+  mpi.MPIInit();
+  REQUIRE(mpi.IsMPIRun());
+  CHECK(mpi.MPIAllTrue(true));
+  int status = 0;
+  REQUIRE(waitpid(pid, &status, 0) == pid);
+  // The peer is gone: the next collective degrades to the local
+  // value (both polarities) instead of blocking forever.
+  CHECK(mpi.MPIAllTrue(true));
+  CHECK(!mpi.IsMPIRun());
+  CHECK(!mpi.MPIAllTrue(false));
+  mpi.MPIFinalize();
+
+  unsetenv("TPUCLIENT_COORDINATOR");
+  unsetenv("TPUCLIENT_WORLD_SIZE");
+  unsetenv("TPUCLIENT_RANK");
+  unsetenv("TPUCLIENT_COORD_TIMEOUT_S");
 }
 
 MINITEST_MAIN

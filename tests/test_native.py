@@ -297,7 +297,8 @@ def test_native_perf_analyzer_mpi_degrades_without_launcher(
 def test_native_perf_analyzer_mpi_two_ranks(native_build, live_server):
     """Two analyzer ranks under mpirun barrier together and agree on
     stability (rank-merged decision). Skips when the image has no MPI
-    launcher (this one ships only the OpenMPI runtime library)."""
+    launcher (this one ships only the OpenMPI runtime library) — the
+    builtin-coordinator test below covers launcher-free 2-rank runs."""
     mpirun = shutil.which("mpirun") or shutil.which("mpiexec")
     if mpirun is None:
         pytest.skip("no MPI launcher on this image — install one (e.g. "
@@ -318,6 +319,47 @@ def test_native_perf_analyzer_mpi_two_ranks(native_build, live_server):
     assert proc.returncode == 0, proc.stdout + proc.stderr
     # Both ranks print a report once every rank's windows stabilize.
     assert proc.stdout.count("throughput") >= 2, proc.stdout
+
+
+def test_native_perf_analyzer_coordinator_two_ranks(
+        native_build, live_server):
+    """Two analyzer ranks with NO MPI launcher: the builtin TCP
+    coordinator (TPUCLIENT_COORDINATOR env contract, the same
+    coordinator_address/num_processes/process_id shape as
+    jax.distributed.initialize) barriers the ranks together and
+    rank-merges the stability decision."""
+    import os
+    import socket
+
+    with socket.socket() as probe:
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+    binary = native_build / "perf_analyzer"
+    args = [str(binary), "-m", "simple", "-u", live_server["grpc"],
+            "--enable-mpi", "--concurrency-range", "2", "--async",
+            "-p", "400", "-r", "3", "-s", "50"]
+    base_env = dict(
+        os.environ,
+        TPUCLIENT_COORDINATOR="127.0.0.1:%d" % port,
+        TPUCLIENT_WORLD_SIZE="2",
+        TPUCLIENT_COORD_TIMEOUT_S="60",
+    )
+    procs = [
+        subprocess.Popen(args, env=dict(base_env, TPUCLIENT_RANK=str(r)),
+                         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                         text=True)
+        for r in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=300)
+        assert p.returncode == 0, out + err
+        # No degrade warning: the collectives stayed up for the whole
+        # profile, so the decision really was rank-merged.
+        assert "degrading to rank-local" not in err, err
+        outs.append(out)
+    for out in outs:
+        assert "throughput" in out, out
 
 
 @pytest.mark.parametrize("distribution", ["constant", "poisson"])
