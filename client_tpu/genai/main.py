@@ -67,6 +67,14 @@ def build_parser() -> argparse.ArgumentParser:
                              "artifact dir")
     parser.add_argument("--random-seed", type=int, default=0)
     parser.add_argument("--no-streaming", action="store_true")
+    parser.add_argument("--measurement-mode", default="time_windows",
+                        choices=["time_windows", "count_windows"],
+                        help="count_windows holds each window open "
+                             "until --measurement-request-count "
+                             "requests complete (robust on slow or "
+                             "contended backends)")
+    parser.add_argument("--measurement-request-count", type=int,
+                        default=50)
     return parser
 
 
@@ -129,6 +137,8 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
         stability_pct=args.stability_percentage,
         max_trials=args.max_trials,
         streaming=not args.no_streaming,
+        measurement_mode=args.measurement_mode,
+        measurement_request_count=args.measurement_request_count,
         extra_args=(["--endpoint", args.endpoint]
                     if args.service_kind == "openai" else None),
     )

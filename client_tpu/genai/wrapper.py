@@ -22,6 +22,8 @@ class Profiler:
         stability_pct: float = 50.0,
         max_trials: int = 6,
         streaming: bool = True,
+        measurement_mode: str = "time_windows",
+        measurement_request_count: int = 50,
         extra_args: Optional[List[str]] = None,
     ) -> List[str]:
         args = [
@@ -33,6 +35,8 @@ class Profiler:
             "--measurement-interval", str(measurement_interval_ms),
             "--stability-percentage", str(stability_pct),
             "--max-trials", str(max_trials),
+            "--measurement-mode", measurement_mode,
+            "--measurement-request-count", str(measurement_request_count),
         ]
         if service_kind != "inprocess":
             args += ["-u", url, "-i", protocol]

@@ -253,6 +253,14 @@ class InferenceProfiler:
                 )
             if self._is_stable(trials):
                 return self._merge(trials[-3:])
+        if all(t.completed_count == 0 for t in trials):
+            # Reference contract: a level whose every window saw no
+            # completed request is an error, not a zero-stat report
+            # (inference_profiler.cc "No valid requests recorded").
+            raise InferenceServerException(
+                "no valid requests recorded in any measurement window; "
+                "use a larger --measurement-interval or "
+                "--measurement-mode count_windows")
         # unstable: report the merge anyway, flagged
         merged = self._merge(trials[-3:] if len(trials) >= 3 else trials)
         merged.on_target = False

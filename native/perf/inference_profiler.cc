@@ -321,6 +321,11 @@ Error InferenceProfiler::ProfileLevel(PerfStatus* merged) {
     if (config_.max_trials == 1) {
       // Single-window modes (--request-count) measure once by
       // design; the stability rule (3 agreeing trials) cannot apply.
+      if (!AllRanks(trials.back().completed_count > 0)) {
+        return Error(
+            "no valid requests recorded in the measurement window; "
+            "use a larger --measurement-interval (-p)");
+      }
       *merged = Merge(std::move(trials));
       return Error::Success;
     }
@@ -335,6 +340,18 @@ Error InferenceProfiler::ProfileLevel(PerfStatus* merged) {
       *merged = Merge(std::move(last3));
       return Error::Success;
     }
+  }
+  // Reference contract: a level whose every window saw no completed
+  // request is an error, not a zero-stat report. Rank-merged (any
+  // empty rank fails the world) so no rank walks on to the next
+  // level's collectives alone.
+  bool any_completed = false;
+  for (const auto& t : trials) any_completed |= t.completed_count > 0;
+  if (!AllRanks(any_completed)) {
+    return Error(
+        "no valid requests recorded in any measurement window; use a "
+        "larger --measurement-interval (-p) or --measurement-mode "
+        "count_windows");
   }
   // Unstable: merge what we have, flagged.
   size_t keep = std::min<size_t>(trials.size(), 3);

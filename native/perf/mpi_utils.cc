@@ -49,7 +49,11 @@ static_assert(sizeof(CoordFrame) == 8, "frame must be 8 bytes");
 constexpr uint16_t kCoordMagic = 0x5043;  // "CP"
 enum CoordOp : uint8_t { kHello = 1, kAllAnd = 2, kResult = 3 };
 
-bool SendAll(int fd, const CoordFrame& f) {
+bool SendAll(int fd, const CoordFrame& frame) {
+  // Network byte order on the wire: ranks may sit on different hosts.
+  CoordFrame f = frame;
+  f.magic = htons(f.magic);
+  f.seq = htonl(f.seq);
   const char* p = reinterpret_cast<const char*>(&f);
   size_t left = sizeof(f);
   while (left > 0) {
@@ -76,6 +80,8 @@ bool RecvAll(int fd, CoordFrame* f) {
     p += n;
     left -= static_cast<size_t>(n);
   }
+  f->magic = ntohs(f->magic);
+  f->seq = ntohl(f->seq);
   return f->magic == kCoordMagic;
 }
 
@@ -117,7 +123,10 @@ MPIDriver::MPIDriver(bool is_enabled) {
     const size_t colon = addr.rfind(':');
     const int size = atoi(world);
     const int r = atoi(rank);
-    if (colon != std::string::npos && size >= 2 && r >= 0 && r < size) {
+    const int port =
+        colon != std::string::npos ? atoi(addr.c_str() + colon + 1) : 0;
+    if (colon != std::string::npos && port >= 1 && port <= 65535 &&
+        size >= 2 && r >= 0 && r < size) {
       coord_host_ = addr.substr(0, colon);
       // Bracketed IPv6 literal ([fd00::1]:7000) — strip the brackets
       // for getaddrinfo (same accepted shape as
@@ -126,7 +135,7 @@ MPIDriver::MPIDriver(bool is_enabled) {
           coord_host_.back() == ']') {
         coord_host_ = coord_host_.substr(1, coord_host_.size() - 2);
       }
-      coord_port_ = atoi(addr.c_str() + colon + 1);
+      coord_port_ = port;
       world_size_ = size;
       rank_ = r;
       if (const char* t = getenv("TPUCLIENT_COORD_TIMEOUT_S")) {

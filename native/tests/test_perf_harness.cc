@@ -470,6 +470,27 @@ TEST_CASE("perf: custom schedule from intervals") {
   CHECK(count > 20);
 }
 
+TEST_CASE("perf: profiler errors when every window is empty") {
+  // Mock delay far beyond the window: no request completes in any
+  // trial — the level must fail (reference: "No valid requests
+  // recorded"), not report zero stats.
+  Harness h(10 * 1000 * 1000);  // 10s per request
+  ConcurrencyManager manager(
+      &h.factory, &h.model, &h.loader, &h.data_manager,
+      LoadManager::Options{/*async=*/true, /*streaming=*/false,
+                           /*max_threads=*/2});
+  REQUIRE_OK(manager.Init());
+  MeasurementConfig config;
+  config.measurement_interval_ms = 40;
+  config.max_trials = 2;
+  InferenceProfiler profiler(&manager, config);
+  std::vector<PerfStatus> results;
+  Error err = profiler.ProfileConcurrencyRange(&manager, 1, 1, 1, &results);
+  CHECK(!err.IsOk());
+  CHECK(err.Message().find("no valid requests") != std::string::npos);
+  manager.Stop();
+}
+
 TEST_CASE("perf: profiler stabilizes on mock load") {
   Harness h(200);
   ConcurrencyManager manager(
@@ -592,31 +613,56 @@ TEST_CASE("perf: command line parser") {
   CHECK(!CLParser::Parse(7, const_cast<char**>(argv3), &exclusive).IsOk());
 }
 
-TEST_CASE("perf: builtin rank coordinator 2-rank collectives") {
-  // Two real processes (fork) join over the TPUCLIENT_COORDINATOR
-  // TCP contract — the launcher-free replacement for the reference's
-  // mpirun path (mpi_utils.h:32-80) — and must agree on every
-  // AllTrue decision.
+namespace {
+
+// Reserve a loopback port for a coordinator test (bind :0, read the
+// kernel's pick, release it).
+int PickLoopbackPort() {
   int probe = socket(AF_INET, SOCK_STREAM, 0);
-  REQUIRE(probe >= 0);
+  if (probe < 0) return -1;
   struct sockaddr_in addr;
   memset(&addr, 0, sizeof(addr));
   addr.sin_family = AF_INET;
   addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
   addr.sin_port = 0;
-  REQUIRE(bind(probe, reinterpret_cast<struct sockaddr*>(&addr),
-               sizeof(addr)) == 0);
   socklen_t len = sizeof(addr);
-  REQUIRE(getsockname(probe, reinterpret_cast<struct sockaddr*>(&addr),
-                      &len) == 0);
-  const int port = ntohs(addr.sin_port);
+  const bool ok =
+      bind(probe, reinterpret_cast<struct sockaddr*>(&addr),
+           sizeof(addr)) == 0 &&
+      getsockname(probe, reinterpret_cast<struct sockaddr*>(&addr),
+                  &len) == 0;
+  const int port = ok ? ntohs(addr.sin_port) : -1;
   close(probe);
+  return port;
+}
 
-  char coord[64];
-  snprintf(coord, sizeof(coord), "127.0.0.1:%d", port);
-  setenv("TPUCLIENT_COORDINATOR", coord, 1);
-  setenv("TPUCLIENT_WORLD_SIZE", "2", 1);
-  setenv("TPUCLIENT_COORD_TIMEOUT_S", "20", 1);
+// Scoped TPUCLIENT_* env contract for a 2-rank coordinator world.
+struct CoordEnv {
+  explicit CoordEnv(int port) {
+    char coord[64];
+    snprintf(coord, sizeof(coord), "127.0.0.1:%d", port);
+    setenv("TPUCLIENT_COORDINATOR", coord, 1);
+    setenv("TPUCLIENT_WORLD_SIZE", "2", 1);
+    setenv("TPUCLIENT_COORD_TIMEOUT_S", "20", 1);
+  }
+  ~CoordEnv() {
+    unsetenv("TPUCLIENT_COORDINATOR");
+    unsetenv("TPUCLIENT_WORLD_SIZE");
+    unsetenv("TPUCLIENT_RANK");
+    unsetenv("TPUCLIENT_COORD_TIMEOUT_S");
+  }
+};
+
+}  // namespace
+
+TEST_CASE("perf: builtin rank coordinator 2-rank collectives") {
+  // Two real processes (fork) join over the TPUCLIENT_COORDINATOR
+  // TCP contract — the launcher-free replacement for the reference's
+  // mpirun path (mpi_utils.h:32-80) — and must agree on every
+  // AllTrue decision.
+  const int port = PickLoopbackPort();
+  REQUIRE(port > 0);
+  CoordEnv env(port);
 
   const pid_t pid = fork();
   REQUIRE(pid >= 0);
@@ -653,34 +699,12 @@ TEST_CASE("perf: builtin rank coordinator 2-rank collectives") {
   REQUIRE(waitpid(pid, &status, 0) == pid);
   CHECK(WIFEXITED(status));
   CHECK_EQ(WEXITSTATUS(status), 0);
-
-  unsetenv("TPUCLIENT_COORDINATOR");
-  unsetenv("TPUCLIENT_WORLD_SIZE");
-  unsetenv("TPUCLIENT_RANK");
-  unsetenv("TPUCLIENT_COORD_TIMEOUT_S");
 }
 
 TEST_CASE("perf: builtin rank coordinator degrades when a peer dies") {
-  int probe = socket(AF_INET, SOCK_STREAM, 0);
-  REQUIRE(probe >= 0);
-  struct sockaddr_in addr;
-  memset(&addr, 0, sizeof(addr));
-  addr.sin_family = AF_INET;
-  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
-  addr.sin_port = 0;
-  REQUIRE(bind(probe, reinterpret_cast<struct sockaddr*>(&addr),
-               sizeof(addr)) == 0);
-  socklen_t len = sizeof(addr);
-  REQUIRE(getsockname(probe, reinterpret_cast<struct sockaddr*>(&addr),
-                      &len) == 0);
-  const int port = ntohs(addr.sin_port);
-  close(probe);
-
-  char coord[64];
-  snprintf(coord, sizeof(coord), "127.0.0.1:%d", port);
-  setenv("TPUCLIENT_COORDINATOR", coord, 1);
-  setenv("TPUCLIENT_WORLD_SIZE", "2", 1);
-  setenv("TPUCLIENT_COORD_TIMEOUT_S", "20", 1);
+  const int port = PickLoopbackPort();
+  REQUIRE(port > 0);
+  CoordEnv env(port);
 
   const pid_t pid = fork();
   REQUIRE(pid >= 0);
@@ -707,11 +731,6 @@ TEST_CASE("perf: builtin rank coordinator degrades when a peer dies") {
   CHECK(!mpi.IsMPIRun());
   CHECK(!mpi.MPIAllTrue(false));
   mpi.MPIFinalize();
-
-  unsetenv("TPUCLIENT_COORDINATOR");
-  unsetenv("TPUCLIENT_WORLD_SIZE");
-  unsetenv("TPUCLIENT_RANK");
-  unsetenv("TPUCLIENT_COORD_TIMEOUT_S");
 }
 
 MINITEST_MAIN

@@ -138,7 +138,12 @@ def test_genai_cli_e2e_inprocess(tmp_path):
         "-m", "llm_tiny", "--service-kind", "inprocess",
         "--num-prompts", "3", "--output-tokens-mean", "4",
         "--synthetic-input-tokens-mean", "12",
-        "--measurement-interval", "400", "--max-trials", "2",
+        # count_windows holds each window open until 3 requests
+        # complete (up to 10x the interval), so a contended CI box
+        # cannot close a window empty-handed.
+        "--measurement-mode", "count_windows",
+        "--measurement-request-count", "3",
+        "--measurement-interval", "2000", "--max-trials", "2",
         "--stability-percentage", "90",
         "--artifact-dir", str(tmp_path),
         "--export-json", str(json_out),
@@ -169,7 +174,9 @@ def test_genai_cli_e2e_openai(tmp_path):
             "--endpoint", "v1/chat/completions",
             "--num-prompts", "3", "--output-tokens-mean", "4",
             "--synthetic-input-tokens-mean", "12",
-            "--measurement-interval", "600", "--max-trials", "2",
+            "--measurement-mode", "count_windows",
+            "--measurement-request-count", "3",
+            "--measurement-interval", "2000", "--max-trials", "2",
             "--stability-percentage", "90",
             "--artifact-dir", str(tmp_path),
             "--export-json", str(json_out),

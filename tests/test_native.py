@@ -350,16 +350,22 @@ def test_native_perf_analyzer_coordinator_two_ranks(
                          text=True)
         for r in range(2)
     ]
-    outs = []
-    for p in procs:
-        out, err = p.communicate(timeout=300)
-        assert p.returncode == 0, out + err
-        # No degrade warning: the collectives stayed up for the whole
-        # profile, so the decision really was rank-merged.
-        assert "degrading to rank-local" not in err, err
-        outs.append(out)
-    for out in outs:
-        assert "throughput" in out, out
+    try:
+        outs = []
+        for p in procs:
+            out, err = p.communicate(timeout=300)
+            assert p.returncode == 0, out + err
+            # No degrade warning: the collectives stayed up for the
+            # whole profile, so the decision really was rank-merged.
+            assert "degrading to rank-local" not in err, err
+            outs.append(out)
+        for out in outs:
+            assert "throughput" in out, out
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
 
 
 @pytest.mark.parametrize("distribution", ["constant", "poisson"])

@@ -439,6 +439,22 @@ def test_profiler_count_windows():
     assert results[0].completed_count >= 20
 
 
+def test_profiler_all_empty_windows_is_an_error():
+    """A level whose every window completes zero requests must raise,
+    not report zero stats (reference: inference_profiler.cc 'No valid
+    requests recorded' error)."""
+    factory, model, loader, dm = make_mock_setup(10.0)  # 10s delay
+    manager = _concurrency_manager(factory, model, loader, dm)
+    config = MeasurementConfig(
+        measurement_interval_ms=40, max_trials=2, stability_threshold=0.5,
+    )
+    profiler = InferenceProfiler(manager, config)
+    with pytest.raises(InferenceServerException,
+                       match="no valid requests"):
+        profiler.profile_concurrency_range(1, 1)
+    manager.cleanup()
+
+
 def test_profiler_server_stats_are_window_deltas():
     """server_stats must reflect only the measured windows, not the
     cumulative totals (the reference pairs start/end snapshots per
