@@ -81,6 +81,7 @@ enum LongOpt {
   kOptTraceRate,
   kOptTraceCount,
   kOptEnableMpi,
+  kOptRanks,
   kOptLogFrequency,
   kOptVersion,
   kOptGrpcCompression,
@@ -165,6 +166,7 @@ const struct option kLongOptions[] = {
     {"trace-rate", required_argument, nullptr, kOptTraceRate},
     {"trace-count", required_argument, nullptr, kOptTraceCount},
     {"enable-mpi", no_argument, nullptr, kOptEnableMpi},
+    {"ranks", required_argument, nullptr, kOptRanks},
     {"log-frequency", required_argument, nullptr, kOptLogFrequency},
     {"version", no_argument, nullptr, kOptVersion},
     {"grpc-compression-algorithm", required_argument, nullptr,
@@ -207,7 +209,8 @@ void CLParser::Usage(const char* program) {
       "Tracing: --trace-level L [--trace-rate N] [--trace-count N]\n"
       "Metrics: --collect-metrics [--metrics-url host:port/metrics]\n"
       "  [--metrics-interval ms]\n"
-      "Scale-out: --enable-mpi\n"
+      "Scale-out: --enable-mpi, --ranks N (forks N local ranks over\n"
+      "  the builtin coordinator; no launcher needed)\n"
       "Output: -f <csv> [--verbose-csv], --profile-export-file <json>,\n"
       "  --log-frequency N, -v, --version\n",
       program);
@@ -385,6 +388,14 @@ Error CLParser::Parse(
         break;
       case kOptTraceCount:
         params->trace_count = atoll(optarg);
+        break;
+      case kOptRanks:
+        params->ranks = atoi(optarg);
+        if (params->ranks < 1) {
+          return Error("--ranks must be >= 1");
+        }
+        // --ranks 1 is a plain single-process run, not an MPI run.
+        if (params->ranks > 1) params->enable_mpi = true;
         break;
       case kOptEnableMpi:
         params->enable_mpi = true;

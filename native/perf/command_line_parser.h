@@ -117,6 +117,9 @@ struct PerfAnalyzerParameters {
 
   // MPI multi-client rendezvous (reference --enable-mpi).
   bool enable_mpi = false;
+  // --ranks N: fork N local analyzer ranks over the builtin TCP
+  // coordinator (the launcher-free equivalent of `mpirun -n N`).
+  int ranks = 1;
 
   // gRPC message compression (reference --grpc-compression-algorithm).
   std::string grpc_compression_algorithm = "none";

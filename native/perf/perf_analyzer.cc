@@ -3,10 +3,16 @@
 // create backend factory -> parse model -> build data loader/manager ->
 // choose load manager -> profile -> report/export) plus main() with
 // SIGINT-initiated graceful drain (parity: perf_analyzer.cc:40-53).
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
 #include <csignal>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
-#include <sys/stat.h>
 
 #include "../library/grpc_client.h"
 #include "command_line_parser.h"
@@ -70,17 +76,7 @@ Error ApplyShapeOverrides(
 
 }  // namespace
 
-int Run(int argc, char** argv) {
-  PerfAnalyzerParameters params;
-  Error err = CLParser::Parse(argc, argv, &params);
-  if (!err.IsOk()) {
-    fprintf(stderr, "error: %s\n", err.Message().c_str());
-    CLParser::Usage(argv[0]);
-    return 1;
-  }
-
-  std::signal(SIGINT, SignalHandler);
-
+int RunRank(PerfAnalyzerParameters& params) {
   BackendConfig backend_config;
   if (params.service_kind == "torchserve") {
     backend_config.kind = BackendKind::TORCHSERVE;
@@ -129,7 +125,7 @@ int Run(int argc, char** argv) {
   ClientBackendFactory factory(backend_config);
 
   std::unique_ptr<ClientBackend> setup_backend;
-  err = factory.Create(&setup_backend);
+  Error err = factory.Create(&setup_backend);
   if (!err.IsOk()) {
     fprintf(stderr, "error: %s\n", err.Message().c_str());
     return 1;
@@ -376,6 +372,26 @@ int Run(int argc, char** argv) {
 
   if (params.enable_mpi) {
     mpi.MPIInit();
+    if (getenv("TPUCLIENT_RANKS_FORKED") != nullptr && !mpi.IsMPIRun()) {
+      // This world was forked by our own --ranks: running on solo
+      // would silently produce N uncoordinated profiles.
+      fprintf(stderr,
+              "error: this rank failed to join the --ranks world\n");
+      return 1;
+    }
+    // Per-rank output files: ranks run the same command line, so a
+    // shared -f / --profile-export-file path would be clobbered
+    // concurrently. Rank 0 keeps the given name.
+    const int rank = mpi.MPICommRankWorld();
+    if (mpi.MPICommSizeWorld() > 1 && rank > 0) {
+      const std::string suffix = ".rank" + std::to_string(rank);
+      if (!params.latency_report_file.empty()) {
+        params.latency_report_file += suffix;
+      }
+      if (!params.profile_export_file.empty()) {
+        params.profile_export_file += suffix;
+      }
+    }
     mpi.MPIBarrierWorld();
   }
 
@@ -403,6 +419,104 @@ int Run(int argc, char** argv) {
     if (!err.IsOk()) fprintf(stderr, "warning: %s\n", err.Message().c_str());
   }
   return 0;
+}
+
+int Run(int argc, char** argv) {
+  PerfAnalyzerParameters params;
+  Error err = CLParser::Parse(argc, argv, &params);
+  if (!err.IsOk()) {
+    fprintf(stderr, "error: %s\n", err.Message().c_str());
+    CLParser::Usage(argv[0]);
+    return 1;
+  }
+
+  std::signal(SIGINT, SignalHandler);
+
+  // --ranks N: fork N-1 more local ranks over the builtin TCP
+  // coordinator (the launcher-free `mpirun -n N`). Forked BEFORE any
+  // backend/socket state exists; each child runs RunRank as its own
+  // rank. A complete TPUCLIENT_* contract in the environment means an
+  // external launcher already placed this process — don't re-fork.
+  std::vector<pid_t> rank_children;
+  // Defer to an external launcher only when the FULL coordinator
+  // contract is present; a stale partial contract (e.g. a leftover
+  // TPUCLIENT_RANK export) is cleared so --ranks works as asked.
+  const bool external_contract = getenv("TPUCLIENT_COORDINATOR") != nullptr &&
+                                 getenv("TPUCLIENT_WORLD_SIZE") != nullptr &&
+                                 getenv("TPUCLIENT_RANK") != nullptr;
+  if (params.ranks > 1 && !external_contract) {
+    if (getenv("TPUCLIENT_COORDINATOR") != nullptr ||
+        getenv("TPUCLIENT_WORLD_SIZE") != nullptr ||
+        getenv("TPUCLIENT_RANK") != nullptr) {
+      fprintf(stderr,
+              "warning: ignoring a partial TPUCLIENT_* coordinator "
+              "contract; --ranks %d forks its own world\n",
+              params.ranks);
+      unsetenv("TPUCLIENT_COORDINATOR");
+      unsetenv("TPUCLIENT_WORLD_SIZE");
+      unsetenv("TPUCLIENT_RANK");
+    }
+    int probe = socket(AF_INET, SOCK_STREAM, 0);
+    struct sockaddr_in addr;
+    memset(&addr, 0, sizeof(addr));
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    addr.sin_port = 0;
+    socklen_t addr_len = sizeof(addr);
+    if (probe < 0 ||
+        bind(probe, reinterpret_cast<struct sockaddr*>(&addr),
+             sizeof(addr)) != 0 ||
+        getsockname(probe, reinterpret_cast<struct sockaddr*>(&addr),
+                    &addr_len) != 0) {
+      fprintf(stderr, "error: --ranks could not reserve a port\n");
+      if (probe >= 0) close(probe);
+      return 1;
+    }
+    close(probe);
+    char coord[64];
+    snprintf(coord, sizeof(coord), "127.0.0.1:%d", ntohs(addr.sin_port));
+    setenv("TPUCLIENT_COORDINATOR", coord, 1);
+    char world[16];
+    snprintf(world, sizeof(world), "%d", params.ranks);
+    setenv("TPUCLIENT_WORLD_SIZE", world, 1);
+    // Marks a world WE forked: failing to join it is then an error,
+    // not a silent degrade — N uncoordinated solo profiles exiting 0
+    // would look like a successful --ranks run.
+    setenv("TPUCLIENT_RANKS_FORKED", "1", 1);
+    bool is_child = false;
+    for (int r = 1; r < params.ranks; ++r) {
+      const pid_t pid = fork();
+      if (pid < 0) {
+        fprintf(stderr, "error: --ranks fork failed\n");
+        for (pid_t child : rank_children) {
+          kill(child, SIGTERM);
+          waitpid(child, nullptr, 0);
+        }
+        return 1;
+      }
+      if (pid == 0) {
+        char rank_str[16];
+        snprintf(rank_str, sizeof(rank_str), "%d", r);
+        setenv("TPUCLIENT_RANK", rank_str, 1);
+        rank_children.clear();
+        is_child = true;
+        break;
+      }
+      rank_children.push_back(pid);
+    }
+    if (!is_child) setenv("TPUCLIENT_RANK", "0", 1);
+  }
+
+  int rc = RunRank(params);
+  for (pid_t child : rank_children) {
+    int status = 0;
+    if (waitpid(child, &status, 0) != child || !WIFEXITED(status) ||
+        WEXITSTATUS(status) != 0) {
+      fprintf(stderr, "warning: a forked rank exited abnormally\n");
+      rc = rc == 0 ? 1 : rc;
+    }
+  }
+  return rc;
 }
 
 }  // namespace perf

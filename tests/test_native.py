@@ -368,6 +368,28 @@ def test_native_perf_analyzer_coordinator_two_ranks(
                 p.wait()
 
 
+def test_native_perf_analyzer_ranks_flag(native_build, live_server,
+                                         tmp_path):
+    """--ranks 2 forks a second local rank over the builtin
+    coordinator (launcher-free `mpirun -n 2`): one invocation, two
+    rank-merged reports, per-rank export files (rank 0 keeps the
+    given name; peers get a .rankN suffix instead of clobbering)."""
+    binary = native_build / "perf_analyzer"
+    export = tmp_path / "profile.json"
+    proc = subprocess.run(
+        [str(binary), "-m", "simple", "-u", live_server["grpc"],
+         "--ranks", "2", "--concurrency-range", "2", "--async",
+         "-p", "400", "-r", "3", "-s", "50",
+         "--profile-export-file", str(export)],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert proc.stdout.count("throughput") >= 2, proc.stdout
+    assert "degrading to rank-local" not in proc.stderr, proc.stderr
+    assert export.exists()
+    assert (tmp_path / "profile.json.rank1").exists()
+
+
 @pytest.mark.parametrize("distribution", ["constant", "poisson"])
 def test_native_perf_analyzer_request_rate_e2e(
         native_build, live_server, distribution):
