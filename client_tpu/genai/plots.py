@@ -23,6 +23,14 @@ def _matplotlib():
     return plt
 
 
+def _boxplot(ax, series, names):
+    # matplotlib 3.9 renamed boxplot's `labels` to `tick_labels`.
+    try:
+        ax.boxplot(series, tick_labels=names)
+    except TypeError:
+        ax.boxplot(series, labels=names)
+
+
 def generate_plots(stats_list: List[Statistics], artifact_dir: str,
                    title: str = "") -> List[str]:
     """TTFT scatter, ITL box, request-latency distribution — one file
@@ -56,8 +64,7 @@ def generate_plots(stats_list: List[Statistics], artifact_dir: str,
         stats.metrics.data().get("inter_token_latency_ms", []) or [0.0]
         for stats in stats_list
     ]
-    ax.boxplot(series,
-               labels=["exp %d" % i for i in range(len(series))])
+    _boxplot(ax, series, ["exp %d" % i for i in range(len(series))])
     ax.set_ylabel("inter-token latency (ms)")
     ax.set_title(title or "Inter-token latency")
     save(fig, "inter_token_latency.png")

@@ -45,13 +45,19 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
     kh = k.transpose(0, 2, 1, 3)
     vh = v.transpose(0, 2, 1, 3)
 
-    # pvary: the accumulators become device-varying from step 0 (the
-    # K/V they absorb differ per device), so the scan carry type is
-    # consistent under shard_map's varying-axes check.
-    out = lax.pvary(jnp.zeros((b, h, s, d), jnp.float32), vary_axes)
-    row_max = lax.pvary(
-        jnp.full((b, h, s), -jnp.inf, jnp.float32), vary_axes)
-    row_sum = lax.pvary(jnp.zeros((b, h, s), jnp.float32), vary_axes)
+    # The accumulators become device-varying from step 0 (the K/V
+    # they absorb differ per device), so the scan carry type is
+    # consistent under shard_map's varying-axes check. pcast replaced
+    # pvary in newer jax; keep the fallback for older releases.
+    if hasattr(lax, "pcast"):
+        def _vary(x):
+            return lax.pcast(x, vary_axes, to="varying")
+    else:
+        def _vary(x):
+            return lax.pvary(x, vary_axes)
+    out = _vary(jnp.zeros((b, h, s, d), jnp.float32))
+    row_max = _vary(jnp.full((b, h, s), -jnp.inf, jnp.float32))
+    row_sum = _vary(jnp.zeros((b, h, s), jnp.float32))
     perm = [(j, (j + 1) % p) for j in range(p)]
 
     def step(carry, i):
