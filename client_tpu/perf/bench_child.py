@@ -311,6 +311,12 @@ def run_native(binary: pathlib.Path, address: str, model: str, batch: int,
            "-s", "99" if warm else str(stability),
            "--max-threads", "8",
            "-f", csv]
+    if warm:
+        # Hold the warm window open until the first requests actually
+        # complete (first-call XLA compiles can outlast any fixed
+        # window, and an all-empty window is a harness error).
+        cmd += ["--measurement-mode", "count_windows",
+                "--measurement-request-count", str(max(2, concurrency))]
     if protocol:
         cmd += ["-i", protocol]
     if streaming:
