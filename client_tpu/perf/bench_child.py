@@ -716,6 +716,24 @@ def main() -> None:
                      / PEAK_BF16_FLOPS, 5)
                  if platform == "tpu" else None,
                  **exec_extra})
+            # Supplementary: the same path at concurrency 8. The c4
+            # headline is round-trip-bound (throughput ~ in-flight
+            # batches / RTT), so doubling in-flight shows how much of
+            # the ceiling is pipelining vs device.
+            if binary and remaining() > 60 and not relay_blocked():
+                try:
+                    tput8, p508 = run_native(
+                        binary, handle.address, "resnet50", batch=8,
+                        concurrency=8, shared_memory="tpu",
+                        output_shm=out_shm, window_ms=3000, trials=4,
+                        timeout=max(30.0, remaining() - 20))
+                    record_stage(
+                        "resnet50_tpu_shm_grpc_c8", tput8, p508,
+                        {"batch": 8, "concurrency": 8,
+                         "vs_baseline": round(tput8 / BASELINE_RESNET, 4)})
+                except Exception as exc:  # noqa: BLE001
+                    log("resnet50 c8 supplement failed (continuing): %s"
+                        % exc)
         except Exception as exc:  # noqa: BLE001
             log("resnet50 stage failed: %s" % exc)
 
