@@ -32,6 +32,8 @@ import subprocess
 import sys
 import time
 
+from client_tpu.perf.harness_proc import run_native
+
 REPO = pathlib.Path(__file__).resolve().parents[2]
 
 # Reference baselines (illustrative — docs/quick_start.md:94 and
@@ -289,52 +291,6 @@ def fusion_stats(core, model_name: str):
         return int(entry.inference_count), int(entry.execution_count)
     except Exception:  # noqa: BLE001 — evidence, never a failure
         return None
-
-
-def run_native(binary: pathlib.Path, address: str, model: str, batch: int,
-               concurrency: int, shared_memory: str, output_shm: int,
-               timeout: float, warm: bool = False, streaming: bool = False,
-               input_data: str | None = None, window_ms: int = 2000,
-               trials: int = 4, stability: int = 20,
-               protocol: str = "") -> tuple[float, float]:
-    """One stable measurement via the C++ harness; (throughput, p50_us).
-    ``warm=True`` runs a single short unmeasured pass first so one-time
-    XLA utility-kernel compiles (batch fusion, output slicing) land
-    outside the counted window."""
-    csv = "/tmp/bench_%s_latency.csv" % model
-    cmd = [str(binary), "-m", model, "-u", address,
-           "-b", str(batch),
-           "--concurrency-range", str(concurrency),
-           "--async",
-           "-p", "1500" if warm else str(window_ms),
-           "-r", "1" if warm else str(trials),
-           "-s", "99" if warm else str(stability),
-           "--max-threads", "8",
-           "-f", csv]
-    if warm:
-        # Hold the warm window open until the first requests actually
-        # complete (first-call XLA compiles can outlast any fixed
-        # window, and an all-empty window is a harness error).
-        cmd += ["--measurement-mode", "count_windows",
-                "--measurement-request-count", str(max(2, concurrency))]
-    if protocol:
-        cmd += ["-i", protocol]
-    if streaming:
-        cmd.append("--streaming")
-    if input_data is not None:
-        cmd += ["--input-data", input_data]
-    if shared_memory != "none":
-        cmd += ["--shared-memory", shared_memory,
-                "--output-shared-memory-size", str(output_shm)]
-    proc = subprocess.run(cmd, capture_output=True, text=True,
-                          timeout=timeout)
-    if proc.returncode != 0:
-        raise RuntimeError("perf_analyzer rc=%d: %s"
-                           % (proc.returncode, proc.stderr[-500:]))
-    with open(csv) as f:
-        f.readline()  # header
-        row = f.readline().strip().split(",")
-    return float(row[1]), float(row[2])
 
 
 def run_python_harness(model: str, batch: int, concurrency: int,

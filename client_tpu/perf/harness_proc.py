@@ -1,0 +1,63 @@
+"""Run the native perf_analyzer as a subprocess and parse its CSV.
+
+jax-free on purpose: both the bench child (which owns the device) and
+the bench orchestrator (which must never import jax — bench.py's
+whole design is that device work lives in killable children) drive
+the C++ harness through this one helper, so the command assembly,
+warm-pass semantics, and CSV parse cannot drift apart.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import subprocess
+
+
+def run_native(binary: pathlib.Path, address: str, model: str, batch: int,
+               concurrency: int, shared_memory: str, output_shm: int,
+               timeout: float, warm: bool = False, streaming: bool = False,
+               input_data: str | None = None, window_ms: int = 2000,
+               trials: int = 4, stability: int = 20,
+               protocol: str = "") -> tuple[float, float]:
+    """One stable measurement via the C++ harness; (throughput, p50_us).
+    ``warm=True`` runs a single short unmeasured pass first so one-time
+    XLA utility-kernel compiles (batch fusion, output slicing) land
+    outside the counted window."""
+    csv = "/tmp/bench_%s_latency.csv" % model
+    cmd = [str(binary), "-m", model, "-u", address,
+           "-b", str(batch),
+           "--concurrency-range", str(concurrency),
+           "--async",
+           "-p", "1500" if warm else str(window_ms),
+           "-r", "1" if warm else str(trials),
+           "-s", "99" if warm else str(stability),
+           "--max-threads", "8",
+           "-f", csv]
+    if warm:
+        # Hold the warm window open until the first requests actually
+        # complete (first-call XLA compiles can outlast any fixed
+        # window, and an all-empty window is a harness error).
+        cmd += ["--measurement-mode", "count_windows",
+                "--measurement-request-count", str(max(2, concurrency))]
+    if protocol:
+        cmd += ["-i", protocol]
+    if streaming:
+        cmd.append("--streaming")
+    if input_data is not None:
+        cmd += ["--input-data", input_data]
+    if shared_memory != "none":
+        cmd += ["--shared-memory", shared_memory,
+                "--output-shared-memory-size", str(output_shm)]
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=timeout)
+    if proc.returncode != 0:
+        raise RuntimeError("perf_analyzer rc=%d: %s"
+                           % (proc.returncode, proc.stderr[-500:]))
+    with open(csv) as f:
+        f.readline()  # header
+        row = f.readline().strip().split(",")
+    if len(row) < 3:
+        # A header-only CSV (analyzer exited 0 with nothing measured)
+        # must not take the whole bench down with an IndexError.
+        raise RuntimeError("perf_analyzer wrote no result row")
+    return float(row[1]), float(row[2])
