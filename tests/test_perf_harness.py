@@ -535,8 +535,11 @@ def test_profiler_pairs_composing_model_stats():
     loader.generate_data()
     dm = InferDataManager(parsed, loader, batch_size=1)
     manager = _concurrency_manager(factory, parsed, loader, dm)
+    # count_windows: a contended box cannot close a window with zero
+    # completions (which is an error since the reference-parity change).
     config = MeasurementConfig(
-        measurement_interval_ms=250, max_trials=6, stability_threshold=0.9,
+        measurement_mode="count_windows", measurement_request_count=4,
+        measurement_interval_ms=500, max_trials=6, stability_threshold=0.9,
     )
     profiler = InferenceProfiler(
         manager, config, backend, "ensemble_image",
