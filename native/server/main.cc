@@ -31,7 +31,13 @@ int main(int argc, char** argv) {
   std::string host = "127.0.0.1";
   int port = 8001;
   int http_port = -1;  // -1 = disabled; 0 = ephemeral
-  int workers = 8;
+  // Dispatch threads bound server-side in-flight concurrency, which
+  // feeds the dynamic batcher: fewer workers than the offered client
+  // concurrency starves batch fusion (bert c64 measured 117 vs 700
+  // infer/s at 8 vs 96 workers). Threads mostly block on the GIL or
+  // batcher events, so a large pool is cheap — default generously
+  // and size --workers >= expected client concurrency.
+  int workers = 64;
   std::string models = "simple";
   for (int i = 1; i < argc; ++i) {
     std::string arg = argv[i];
