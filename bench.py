@@ -264,12 +264,24 @@ def run_native_serving_supplement(result: dict, deadline_ts: float) -> None:
                 ValueError) as exc:
             log("%s failed (continuing): %s" % (stage_name, exc))
             return
-        result["stages"][stage_name] = {
+        stage = {
             "batch": batch, "concurrency": concurrency,
             "throughput": tput, "p50_latency_us": p50,
             "vs_baseline": round(tput / anchor, 4),
             "baseline_src": anchor_src,
         }
+        # Same chip + model as the child's stage: its device probe
+        # carries over, and served-throughput MFU scales linearly with
+        # throughput (mfu_est = tput * flops_per_infer / peak).
+        child = result["stages"].get("resnet50_tpu_shm_grpc", {})
+        for key in ("model_exec_ms_device", "mfu_device",
+                    "relay_fetch_ms_est"):
+            if key in child:
+                stage[key] = child[key]
+        if child.get("mfu_est") and child.get("throughput"):
+            stage["mfu_est"] = round(
+                child["mfu_est"] * tput / child["throughput"], 5)
+        result["stages"][stage_name] = stage
         log("stage %s: %.2f infer/sec, p50 %.0f us"
             % (stage_name, tput, p50))
 
