@@ -80,6 +80,10 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--measurement-mode", default="time_windows",
                         choices=["time_windows", "count_windows"])
     parser.add_argument("--measurement-request-count", type=int, default=50)
+    parser.add_argument("--request-count", type=int, default=0,
+                        help="measure exactly N requests in one window "
+                             "(single-trial by design; parity: the "
+                             "reference's --request-count)")
     parser.add_argument("-r", "--max-trials", type=int, default=10)
     parser.add_argument("-s", "--stability-percentage", type=float,
                         default=10.0)
@@ -229,9 +233,14 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
 
     config = MeasurementConfig(
         measurement_interval_ms=args.measurement_interval,
-        measurement_mode=args.measurement_mode,
-        measurement_request_count=args.measurement_request_count,
-        max_trials=args.max_trials,
+        measurement_mode=("count_windows" if args.request_count > 0
+                          else args.measurement_mode),
+        measurement_request_count=(args.request_count
+                                   if args.request_count > 0
+                                   else args.measurement_request_count),
+        # --request-count measures exactly one fixed-count window; the
+        # stability rule cannot apply to a single-trial run.
+        max_trials=1 if args.request_count > 0 else args.max_trials,
         stability_threshold=args.stability_percentage / 100.0,
         latency_threshold_ms=args.latency_threshold,
         percentile=args.percentile,

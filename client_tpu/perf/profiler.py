@@ -251,6 +251,14 @@ class InferenceProfiler:
                     "  trial %d: %.1f infer/sec, avg %.0f us"
                     % (trial, status.throughput, status.avg_latency_us)
                 )
+            if self._config.max_trials == 1:
+                # Single-window modes (--request-count) measure once
+                # by design; the 3-trial stability rule cannot apply.
+                if status.completed_count == 0:
+                    raise InferenceServerException(
+                        "no valid requests recorded in the measurement "
+                        "window; use a larger --measurement-interval")
+                return self._merge(trials)
             if self._is_stable(trials):
                 return self._merge(trials[-3:])
         if all(t.completed_count == 0 for t in trials):

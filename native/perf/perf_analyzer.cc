@@ -204,13 +204,6 @@ int RunRank(PerfAnalyzerParameters& params) {
   config.measurement_interval_ms = params.measurement_interval_ms;
   config.count_windows = params.measurement_mode == "count_windows";
   config.measurement_request_count = params.measurement_request_count;
-  if (params.request_count > 0) {
-    // --request-count: measure exactly N requests, one window (a
-    // single-trial run is by design, not an unstable measurement).
-    config.count_windows = true;
-    config.measurement_request_count = params.request_count;
-    config.max_trials = 1;
-  }
   // REST/chat service kinds send one logical inference per request
   // regardless of -b (their payloads are not batched).
   config.batch_size = (params.service_kind == "triton" ||
@@ -218,6 +211,17 @@ int RunRank(PerfAnalyzerParameters& params) {
                           ? static_cast<size_t>(params.batch_size)
                           : 1;
   config.max_trials = params.max_trials;
+  if (params.request_count > 0) {
+    // --request-count: measure exactly N requests, one window (a
+    // single-trial run is by design, not an unstable measurement).
+    // Must come AFTER the generic max_trials assignment — a default
+    // max_trials overwriting this 1 turns the fixed-count run into a
+    // stability-ruled multi-window run that can report "did not
+    // stabilize" under load.
+    config.count_windows = true;
+    config.measurement_request_count = params.request_count;
+    config.max_trials = 1;
+  }
   config.stability_threshold = params.stability_percentage / 100.0;
   config.latency_threshold_ms = params.latency_threshold_ms;
   config.percentile = params.percentile;

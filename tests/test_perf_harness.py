@@ -578,6 +578,30 @@ def test_cli_inprocess_e2e(tmp_path):
     assert doc["experiments"][0]["requests"], "requests should be recorded"
 
 
+def test_cli_request_count_single_window(tmp_path, capsys):
+    """--request-count N measures exactly one fixed-count window
+    (parity: the reference flag): N requests collected, no stability
+    warning, single experiment."""
+    from client_tpu.perf.cli import run
+    from client_tpu.server.app import build_core
+
+    core = build_core(["simple"])
+    export_path = tmp_path / "profile.json"
+    rc = run([
+        "-m", "simple", "--service-kind", "inprocess",
+        "--concurrency-range", "2",
+        "--request-count", "20",
+        "--measurement-interval", "2000",
+        "--profile-export-file", str(export_path),
+    ], core=core)
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "did not stabilize" not in out, out
+    doc = json.loads(export_path.read_text())
+    assert len(doc["experiments"]) == 1
+    assert len(doc["experiments"][0]["requests"]) >= 20
+
+
 def test_cli_inprocess_shm_system(tmp_path):
     from client_tpu.perf.cli import run
     from client_tpu.server.app import build_core
