@@ -707,6 +707,55 @@ TEST_CASE("perf: builtin rank coordinator 2-rank collectives") {
   CHECK_EQ(WEXITSTATUS(status), 0);
 }
 
+TEST_CASE("perf: builtin rank coordinator 3-rank world, reverse joins") {
+  // Three ranks; rank 2 connects before rank 1 (the coordinator must
+  // key peers by their HELLO rank, not arrival order), and the AND
+  // reduce must mix all three votes.
+  const int port = PickLoopbackPort();
+  REQUIRE(port > 0);
+  CoordEnv env(port);
+  setenv("TPUCLIENT_WORLD_SIZE", "3", 1);
+
+  auto child = [&](int rank, int delay_ms) {
+    const pid_t pid = fork();
+    if (pid != 0) return pid;
+    std::this_thread::sleep_for(std::chrono::milliseconds(delay_ms));
+    char rank_str[8];
+    snprintf(rank_str, sizeof(rank_str), "%d", rank);
+    setenv("TPUCLIENT_RANK", rank_str, 1);
+    MPIDriver peer(true);
+    peer.MPIInit();
+    if (!peer.IsMPIRun()) _exit(10 + rank);
+    if (peer.MPICommSizeWorld() != 3) _exit(20 + rank);
+    if (!peer.MPIAllTrue(true)) _exit(30 + rank);          // all true
+    if (peer.MPIAllTrue(rank != 1)) _exit(40 + rank);      // rank1 false
+    peer.MPIBarrierWorld();
+    peer.MPIFinalize();
+    _exit(0);
+  };
+  // Rank 2 starts immediately; rank 1 joins 300ms later.
+  const pid_t pid2 = child(2, 0);
+  REQUIRE(pid2 > 0);
+  const pid_t pid1 = child(1, 300);
+  REQUIRE(pid1 > 0);
+
+  setenv("TPUCLIENT_RANK", "0", 1);
+  MPIDriver mpi(true);
+  mpi.MPIInit();
+  REQUIRE(mpi.IsMPIRun());
+  CHECK_EQ(mpi.MPICommSizeWorld(), 3);
+  CHECK(mpi.MPIAllTrue(true));
+  CHECK(!mpi.MPIAllTrue(true));  // rank 1 votes false
+  mpi.MPIBarrierWorld();
+  mpi.MPIFinalize();
+  for (pid_t pid : {pid1, pid2}) {
+    int status = 0;
+    REQUIRE(waitpid(pid, &status, 0) == pid);
+    CHECK(WIFEXITED(status));
+    CHECK_EQ(WEXITSTATUS(status), 0);
+  }
+}
+
 TEST_CASE("perf: builtin rank coordinator degrades when a peer dies") {
   const int port = PickLoopbackPort();
   REQUIRE(port > 0);
