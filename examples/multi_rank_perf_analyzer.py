@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Launcher-free multi-rank perf run over the builtin coordinator.
+
+Spawns N native perf_analyzer ranks against one server with the
+TPUCLIENT_COORDINATOR env contract (the jax.distributed-style
+coordinator_address / num_processes / process_id shape), the
+launcher-free equivalent of `mpirun -n N perf_analyzer --enable-mpi`
+(reference: src/c++/perf_analyzer/mpi_utils.h:32-80). The ranks
+barrier together and rank-merge every stability decision, so all N
+reports cover the same load interval. For the single-command local
+form, `perf_analyzer --ranks N` does all of this itself.
+
+    python examples/multi_rank_perf_analyzer.py -u 127.0.0.1:8001 -n 2
+"""
+
+import argparse
+import os
+import pathlib
+import socket
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="127.0.0.1:8001")
+    parser.add_argument("-m", "--model", default="simple")
+    parser.add_argument("-n", "--ranks", type=int, default=2)
+    parser.add_argument("--binary",
+                        default=str(REPO / "native" / "build" /
+                                    "perf_analyzer"))
+    args = parser.parse_args()
+
+    if not pathlib.Path(args.binary).exists():
+        print("perf_analyzer not built (cmake -S native -B native/build "
+              "-G Ninja && ninja -C native/build)", file=sys.stderr)
+        return 1
+    with socket.socket() as probe:
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+
+    cmd = [args.binary, "-m", args.model, "-u", args.url,
+           "--enable-mpi", "--concurrency-range", "2", "--async",
+           "-p", "500", "-r", "3", "-s", "50"]
+    base_env = dict(
+        os.environ,
+        TPUCLIENT_COORDINATOR="127.0.0.1:%d" % port,
+        TPUCLIENT_WORLD_SIZE=str(args.ranks),
+    )
+    procs = [
+        subprocess.Popen(cmd, env=dict(base_env, TPUCLIENT_RANK=str(r)),
+                         stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                         text=True)
+        for r in range(args.ranks)
+    ]
+    try:
+        ok = True
+        for rank, proc in enumerate(procs):
+            out, _ = proc.communicate(timeout=300)
+            merged = "throughput" in out and proc.returncode == 0
+            ok = ok and merged
+            print("--- rank %d (rc=%d) ---" % (rank, proc.returncode))
+            print("\n".join(out.splitlines()[-3:]))
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+    print("PASS" if ok else "FAIL")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -113,6 +113,17 @@ def test_cross_host_example():
     _run_example_args("tpu_shm_cross_host_client.py", [])
 
 
+def test_multi_rank_example(example_server):
+    # Two native analyzer ranks over the builtin TCP coordinator
+    # (launcher-free mpirun); skips itself cleanly if the native
+    # harness is not built.
+    binary = REPO / "native" / "build" / "perf_analyzer"
+    if not binary.exists():
+        pytest.skip("native harness not built")
+    _run_example_args("multi_rank_perf_analyzer.py",
+                      ["-u", example_server["grpc"], "-n", "2"])
+
+
 CPP_GRPC_EXAMPLES = [
     "simple_grpc_infer_client",
     "simple_grpc_async_infer_client",
