@@ -28,6 +28,12 @@ def builtin_model_factories(repository=None
         "add_sub_int8": lambda: AddSub(
             name="add_sub_int8", datatype="INT8", shape=(16,)
         ),
+        # 4 MiB per tensor: conformance ammunition for HTTP/2 flow
+        # control — requests and responses must chunk through DATA
+        # frames + WINDOW_UPDATEs in both directions.
+        "add_sub_large": lambda: AddSub(
+            name="add_sub_large", datatype="FP32", shape=(1048576,)
+        ),
         "add_sub_tpu": lambda: AddSub(
             name="add_sub_tpu", datatype="FP32", shape=(16,), device="tpu"
         ),

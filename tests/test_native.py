@@ -121,7 +121,7 @@ def serverd_both(native_build):
     env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
     proc = subprocess.Popen(
         [str(serverd), "--port", "0", "--http-port", "0",
-         "--models", "simple,simple_string,add_sub_fp32"],
+         "--models", "simple,simple_string,add_sub_fp32,add_sub_large"],
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
         cwd=str(REPO), env=env,
     )
