@@ -15,7 +15,8 @@ from client_tpu.utils import InferenceServerException
 
 @pytest.fixture(scope="module")
 def server():
-    handle = start_grpc_server(load_models=["simple", "add_sub_fp32"])
+    handle = start_grpc_server(
+        load_models=["simple", "add_sub_fp32", "add_sub_large"])
     yield handle
     handle.stop()
 
@@ -98,6 +99,22 @@ def test_infer_fp32(client):
     ]
     result = client.infer("add_sub_fp32", inputs)
     np.testing.assert_allclose(result.as_numpy("OUTPUT0"), x + y, rtol=1e-6)
+
+
+def test_infer_multi_megabyte_tensors(client):
+    """4 MiB per tensor through the Python client+server pair: both
+    ends configure unlimited gRPC message sizes (grpcio's 4 MB default
+    would reject the 8 MiB request), and values survive intact."""
+    n = 1 << 20
+    x = (np.arange(n, dtype=np.float32) % 9973)
+    y = (np.arange(n, dtype=np.float32) % 7919)
+    inputs = [
+        grpcclient.InferInput("INPUT0", [n], "FP32").set_data_from_numpy(x),
+        grpcclient.InferInput("INPUT1", [n], "FP32").set_data_from_numpy(y),
+    ]
+    result = client.infer("add_sub_large", inputs)
+    np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), x + y)
+    np.testing.assert_array_equal(result.as_numpy("OUTPUT1"), x - y)
 
 
 def test_infer_wrong_input_name(client):

@@ -14,7 +14,7 @@ from client_tpu.utils import InferenceServerException
 
 @pytest.fixture(scope="module")
 def server():
-    core = build_core(["simple", "add_sub_fp32"])
+    core = build_core(["simple", "add_sub_fp32", "add_sub_large"])
     runner = start_http_server_thread(core, host="127.0.0.1", port=0)
     yield runner
     runner.stop()
@@ -38,6 +38,22 @@ def _simple_inputs():
     inputs[0].set_data_from_numpy(in0)
     inputs[1].set_data_from_numpy(in1)
     return in0, in1, inputs
+
+
+def test_infer_multi_megabyte_tensors(client):
+    """4 MiB per tensor through the HTTP binary protocol: the 8 MiB
+    request/response bodies exercise chunked socket I/O and the
+    Inference-Header-Content-Length split on large payloads."""
+    n = 1 << 20
+    x = (np.arange(n, dtype=np.float32) % 9973)
+    y = (np.arange(n, dtype=np.float32) % 7919)
+    inputs = [
+        httpclient.InferInput("INPUT0", [n], "FP32").set_data_from_numpy(x),
+        httpclient.InferInput("INPUT1", [n], "FP32").set_data_from_numpy(y),
+    ]
+    result = client.infer("add_sub_large", inputs)
+    np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), x + y)
+    np.testing.assert_array_equal(result.as_numpy("OUTPUT1"), x - y)
 
 
 def test_health(client):
