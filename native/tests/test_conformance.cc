@@ -493,6 +493,72 @@ void CaseLargeTensorFlowControl() {
   }
 }
 
+// Four concurrent 4 MiB-per-tensor infers on ONE client: on gRPC the
+// async worker multiplexes them over a shared HTTP/2 connection, so
+// large DATA frames from different streams interleave and compete
+// for the shared connection window while each stream's own window
+// gates it — the distinct failure mode vs one big sequential call is
+// cross-stream window accounting. HTTP exercises the connection
+// pool's concurrent large bodies.
+template <typename ClientT>
+void CaseConcurrentLargeTensors() {
+  std::unique_ptr<ClientT> client;
+  REQUIRE_OK(Protocol<ClientT>::Create(&client));
+  constexpr int64_t kN = 1048576;
+  constexpr int kRequests = 4;
+  std::vector<std::vector<float>> a(kRequests), b(kRequests);
+  std::vector<std::unique_ptr<InferInput>> keep;
+  std::mutex mutex;
+  std::condition_variable cv;
+  int done = 0, good = 0;
+  for (int r = 0; r < kRequests; ++r) {
+    a[r].resize(kN);
+    b[r].resize(kN);
+    for (int64_t i = 0; i < kN; ++i) {
+      a[r][i] = static_cast<float>((i + r) % 9973);
+      b[r][i] = static_cast<float>((i + 2 * r) % 7919);
+    }
+    auto make = [](const char* name, const std::vector<float>& data) {
+      InferInput* raw = nullptr;
+      InferInput::Create(&raw, name, {kN}, "FP32");
+      raw->AppendRaw(reinterpret_cast<const uint8_t*>(data.data()),
+                     data.size() * sizeof(float));
+      return std::unique_ptr<InferInput>(raw);
+    };
+    auto in0 = make("INPUT0", a[r]);
+    auto in1 = make("INPUT1", b[r]);
+    REQUIRE_OK(client->AsyncInfer(
+        [&, r](InferResult* raw) {
+          std::unique_ptr<InferResult> result(raw);
+          bool ok = result->RequestStatus().IsOk();
+          if (ok) {
+            const uint8_t* buf = nullptr;
+            size_t byte_size = 0;
+            ok = result->RawData("OUTPUT0", &buf, &byte_size).IsOk() &&
+                 byte_size == static_cast<size_t>(kN) * sizeof(float);
+            if (ok) {
+              const float* sum = reinterpret_cast<const float*>(buf);
+              for (int64_t i = 0; i < kN && ok; i += 65521) {
+                ok = sum[i] == a[r][i] + b[r][i];
+              }
+              ok = ok && sum[kN - 1] == a[r][kN - 1] + b[r][kN - 1];
+            }
+          }
+          std::lock_guard<std::mutex> lock(mutex);
+          ++done;
+          if (ok) ++good;
+          cv.notify_all();
+        },
+        InferOptions("add_sub_large"), {in0.get(), in1.get()}));
+    keep.push_back(std::move(in0));
+    keep.push_back(std::move(in1));
+  }
+  std::unique_lock<std::mutex> lock(mutex);
+  REQUIRE(cv.wait_for(lock, std::chrono::seconds(120),
+                      [&] { return done == kRequests; }));
+  CHECK_EQ(good, kRequests);
+}
+
 }  // namespace
 
 // minitest's TEST_CASE keys its registration symbols on __LINE__, so
@@ -523,6 +589,8 @@ CONFORMANCE_CASE(CaseUnknownModel, "unknown model error mapping")
 CONFORMANCE_CASE(CaseIterationLoop, "leak iteration loop bounded RSS")
 CONFORMANCE_CASE(CaseLargeTensorFlowControl,
                  "multi-MB tensors chunk through flow control")
+CONFORMANCE_CASE(CaseConcurrentLargeTensors,
+                 "concurrent multi-MB streams share one connection")
 
 // Streaming is protocol-specific (the reference's streaming matrix is
 // gRPC-only too): decoupled bidi stream with per-request options.
