@@ -41,6 +41,7 @@ class InferInput:
         self._raw_data: Optional[bytes] = None
         self._np_data: Optional[np.ndarray] = None
         self._shm: Optional[Tuple[str, int, int]] = None  # (region, byte_size, offset)
+        self._binary_data = True
 
     def name(self) -> str:
         return self._name
@@ -62,10 +63,18 @@ class InferInput:
         self._parameters[key] = value
         return self
 
-    def set_data_from_numpy(self, input_tensor: np.ndarray) -> "InferInput":
+    def set_data_from_numpy(self, input_tensor: np.ndarray,
+                            binary_data: bool = True) -> "InferInput":
         """Attach tensor data, validating dtype and shape against the
         declaration. BYTES tensors are length-prefix serialized; BF16
-        accepts ml_dtypes.bfloat16 (or float) arrays."""
+        accepts ml_dtypes.bfloat16 (or float) arrays.
+
+        ``binary_data=False`` asks the HTTP transport to send this
+        tensor as a JSON ``data`` array instead of the binary
+        extension (parity: the reference HTTP client's kwarg) —
+        interoperable with KServe servers that lack the binary
+        protocol. Ignored by gRPC (protobuf raw contents are already
+        binary)."""
         if not isinstance(input_tensor, np.ndarray):
             raise InferenceServerException("input tensor must be a numpy array")
         dtype = np_to_wire_dtype(input_tensor.dtype)
@@ -92,6 +101,7 @@ class InferInput:
             self._raw_data = serialize_bf16_tensor(input_tensor).tobytes()
         else:
             self._raw_data = np.ascontiguousarray(input_tensor).tobytes()
+        self._binary_data = bool(binary_data)
         return self
 
     def set_shared_memory(
@@ -108,6 +118,9 @@ class InferInput:
 
     def raw_data(self) -> Optional[bytes]:
         return self._raw_data
+
+    def binary_data(self) -> bool:
+        return self._binary_data
 
     def numpy_data(self) -> Optional[np.ndarray]:
         return self._np_data

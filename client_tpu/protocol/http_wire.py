@@ -129,8 +129,32 @@ def encode_infer_request(
                 tensor_params["shared_memory_offset"] = offset
         else:
             raw = infer_input.raw_data()
-            tensor_params["binary_data_size"] = len(raw)
-            binary_blobs.append(raw)
+            if infer_input.binary_data():
+                tensor_params["binary_data_size"] = len(raw)
+                binary_blobs.append(raw)
+            else:
+                # JSON tensor data (binary_data=False): interoperable
+                # with servers lacking the binary extension. BYTES
+                # elements must be valid UTF-8 — a JSON string cannot
+                # carry arbitrary binary, and a lossy re-encode would
+                # silently corrupt the payload.
+                if infer_input.datatype() == "BYTES":
+                    try:
+                        entry["data"] = [
+                            b.decode("utf-8")
+                            for b in deserialize_bytes_tensor(raw)
+                        ]
+                    except UnicodeDecodeError:
+                        raise InferenceServerException(
+                            "BYTES input '%s' holds non-UTF-8 bytes; "
+                            "JSON tensor data cannot carry arbitrary "
+                            "binary — use binary_data=True"
+                            % infer_input.name(),
+                            status="INVALID_ARGUMENT",
+                        )
+                else:
+                    entry["data"] = _raw_to_json_data(
+                        raw, infer_input.datatype())
         if tensor_params:
             entry["parameters"] = tensor_params
         header_inputs.append(entry)

@@ -123,6 +123,10 @@ struct InferOptions {
   std::map<std::string, double> double_params;
   // Whether to request/parse outputs as binary over HTTP.
   bool binary_data_output = true;
+  // HTTP only: send input tensors as JSON "data" arrays instead of
+  // the binary extension (interop with KServe servers lacking the
+  // binary protocol; reference --input-tensor-format json).
+  bool json_input_data = false;
 };
 
 //==============================================================================

@@ -1,7 +1,11 @@
 #include "http_client.h"
 
+#include <string.h>
+
 #include <algorithm>
 #include <chrono>
+#include <cmath>
+#include <limits>
 #include <sstream>
 
 #include "base64.h"
@@ -43,6 +47,102 @@ Error ErrorFromResponse(const HttpResponse& response) {
 }
 
 json::Value ParamValue(const std::string& s) { return json::Value(s); }
+
+// float -> IEEE half with round-to-nearest (for FP16 JSON outputs).
+uint16_t HalfFromFloat(float f) {
+  uint32_t bits;
+  memcpy(&bits, &f, 4);
+  const uint16_t sign = static_cast<uint16_t>((bits >> 16) & 0x8000);
+  const uint32_t exp8 = (bits >> 23) & 0xff;
+  uint32_t frac = bits & 0x7fffff;
+  if (exp8 == 0xff) {  // inf / nan
+    return sign | 0x7c00 | (frac ? 0x200 : 0);
+  }
+  const int32_t exp = static_cast<int32_t>(exp8) - 127 + 15;
+  if (exp >= 31) return sign | 0x7c00;  // overflow -> inf
+  if (exp <= 0) {
+    if (exp < -10) return sign;  // underflow -> signed zero
+    frac |= 0x800000;            // make the implicit bit explicit
+    const uint32_t shift = static_cast<uint32_t>(14 - exp);
+    uint16_t sub = static_cast<uint16_t>(frac >> shift);
+    if ((frac >> (shift - 1)) & 1) ++sub;  // round to nearest
+    return sign | sub;
+  }
+  uint16_t h =
+      sign | static_cast<uint16_t>(exp << 10) | static_cast<uint16_t>(
+                                                    frac >> 13);
+  if (frac & 0x1000) ++h;  // round to nearest
+  return h;
+}
+
+// JSON "data" array -> packed wire bytes per datatype (so RawData()
+// behaves identically whether the server answered binary or JSON).
+// May throw (json accessors throw on type mismatches); the caller
+// converts to an Error.
+Error RawFromJsonData(const json::Value& data, const std::string& datatype,
+                      std::string* out) {
+  auto append = [out](const void* p, size_t n) {
+    out->append(reinterpret_cast<const char*>(p), n);
+  };
+  for (const auto& v : data.AsArray()) {
+    if (datatype == "BOOL") {
+      uint8_t b = v.AsBool() ? 1 : 0;
+      append(&b, 1);
+    } else if (datatype == "INT8") {
+      int8_t x = static_cast<int8_t>(v.AsInt());
+      append(&x, 1);
+    } else if (datatype == "INT16") {
+      int16_t x = static_cast<int16_t>(v.AsInt());
+      append(&x, 2);
+    } else if (datatype == "INT32") {
+      int32_t x = static_cast<int32_t>(v.AsInt());
+      append(&x, 4);
+    } else if (datatype == "INT64") {
+      int64_t x = v.AsInt();
+      append(&x, 8);
+    } else if (datatype == "UINT8") {
+      uint8_t x = static_cast<uint8_t>(v.AsUint());
+      append(&x, 1);
+    } else if (datatype == "UINT16") {
+      uint16_t x = static_cast<uint16_t>(v.AsUint());
+      append(&x, 2);
+    } else if (datatype == "UINT32") {
+      uint32_t x = static_cast<uint32_t>(v.AsUint());
+      append(&x, 4);
+    } else if (datatype == "UINT64") {
+      uint64_t x = v.AsUint();
+      append(&x, 8);
+    } else if (datatype == "FP32") {
+      float x = static_cast<float>(v.AsDouble());
+      append(&x, 4);
+    } else if (datatype == "FP64") {
+      double x = v.AsDouble();
+      append(&x, 8);
+    } else if (datatype == "BF16") {
+      float f = static_cast<float>(v.AsDouble());
+      uint32_t bits;
+      memcpy(&bits, &f, 4);
+      uint16_t h = static_cast<uint16_t>(bits >> 16);
+      append(&h, 2);
+    } else if (datatype == "FP16") {
+      uint16_t h = HalfFromFloat(static_cast<float>(v.AsDouble()));
+      append(&h, 2);
+    } else if (datatype == "BYTES") {
+      const std::string& s = v.AsString();
+      uint32_t len = static_cast<uint32_t>(s.size());
+      uint8_t prefix[4] = {static_cast<uint8_t>(len),
+                           static_cast<uint8_t>(len >> 8),
+                           static_cast<uint8_t>(len >> 16),
+                           static_cast<uint8_t>(len >> 24)};
+      append(prefix, 4);
+      out->append(s);
+    } else {
+      return Error("JSON output datatype '" + datatype +
+                   "' has no wire packing");
+    }
+  }
+  return Error::Success;
+}
 
 }  // namespace
 
@@ -182,9 +282,34 @@ Error InferResultHttp::RawData(
     *byte_size = out->raw_size;
     return Error::Success;
   }
+  if (out->json_data.IsArray()) {
+    // JSON-format output: pack once, then serve the cached bytes.
+    // json accessors throw on malformed server data (nested arrays,
+    // wrong element types) — convert to an Error so nothing escapes
+    // an async worker (same invariant as the response parser above).
+    if (!out->decode_attempted) {
+      out->decode_attempted = true;
+      Error perr = Error::Success;
+      try {
+        perr = RawFromJsonData(out->json_data, out->datatype,
+                               &out->decoded);
+      } catch (const std::exception& e) {
+        perr = Error(std::string("malformed JSON output data: ") + e.what());
+      }
+      if (!perr.IsOk()) {
+        out->decoded.clear();
+        return perr;
+      }
+    }
+    if (!out->decoded.empty() || out->json_data.AsArray().empty()) {
+      *buf = reinterpret_cast<const uint8_t*>(out->decoded.data());
+      *byte_size = out->decoded.size();
+      return Error::Success;
+    }
+  }
   return Error(
       "output '" + output_name +
-      "' was returned as JSON data; use result JSON accessors");
+      "' was returned as JSON data that could not be packed");
 }
 
 Error InferResultHttp::StringData(
@@ -553,6 +678,116 @@ Error InferenceServerHttpClient::UnregisterTpuSharedMemory(
 //==============================================================================
 // Inference request body
 
+namespace {
+
+double HalfToDouble(uint16_t h) {
+  const uint32_t sign = (h >> 15) & 0x1;
+  const uint32_t exp = (h >> 10) & 0x1f;
+  const uint32_t frac = h & 0x3ff;
+  double value;
+  if (exp == 0) {
+    value = std::ldexp(static_cast<double>(frac), -24);  // subnormal
+  } else if (exp == 31) {
+    value = frac == 0 ? std::numeric_limits<double>::infinity()
+                      : std::numeric_limits<double>::quiet_NaN();
+  } else {
+    value = std::ldexp(1.0 + frac / 1024.0, static_cast<int>(exp) - 15);
+  }
+  return sign ? -value : value;
+}
+
+// Contiguous raw tensor bytes -> JSON "data" array per datatype
+// (inverse of the server's JSON-tensor decode; used for
+// --input-tensor-format json / binary_data=false interop).
+Error JsonDataFromRaw(const std::string& datatype, const uint8_t* data,
+                      size_t byte_size, json::Array* out) {
+  auto pack_ints = [&](auto typed, size_t width) {
+    using T = decltype(typed);
+    for (size_t pos = 0; pos + width <= byte_size; pos += width) {
+      T v;
+      memcpy(&v, data + pos, width);
+      out->push_back(json::Value(static_cast<int64_t>(v)));
+    }
+  };
+  auto pack_uints = [&](auto typed, size_t width) {
+    using T = decltype(typed);
+    for (size_t pos = 0; pos + width <= byte_size; pos += width) {
+      T v;
+      memcpy(&v, data + pos, width);
+      out->push_back(json::Value(static_cast<uint64_t>(v)));
+    }
+  };
+  if (datatype == "BOOL") {
+    for (size_t i = 0; i < byte_size; ++i) {
+      out->push_back(json::Value(data[i] != 0));
+    }
+  } else if (datatype == "INT8") {
+    pack_ints(int8_t{}, 1);
+  } else if (datatype == "INT16") {
+    pack_ints(int16_t{}, 2);
+  } else if (datatype == "INT32") {
+    pack_ints(int32_t{}, 4);
+  } else if (datatype == "INT64") {
+    pack_ints(int64_t{}, 8);
+  } else if (datatype == "UINT8") {
+    pack_uints(uint8_t{}, 1);
+  } else if (datatype == "UINT16") {
+    pack_uints(uint16_t{}, 2);
+  } else if (datatype == "UINT32") {
+    pack_uints(uint32_t{}, 4);
+  } else if (datatype == "UINT64") {
+    pack_uints(uint64_t{}, 8);
+  } else if (datatype == "FP32") {
+    for (size_t pos = 0; pos + 4 <= byte_size; pos += 4) {
+      float v;
+      memcpy(&v, data + pos, 4);
+      out->push_back(json::Value(static_cast<double>(v)));
+    }
+  } else if (datatype == "FP64") {
+    for (size_t pos = 0; pos + 8 <= byte_size; pos += 8) {
+      double v;
+      memcpy(&v, data + pos, 8);
+      out->push_back(json::Value(v));
+    }
+  } else if (datatype == "FP16") {
+    for (size_t pos = 0; pos + 2 <= byte_size; pos += 2) {
+      uint16_t v;
+      memcpy(&v, data + pos, 2);
+      out->push_back(json::Value(HalfToDouble(v)));
+    }
+  } else if (datatype == "BF16") {
+    for (size_t pos = 0; pos + 2 <= byte_size; pos += 2) {
+      uint16_t v;
+      memcpy(&v, data + pos, 2);
+      uint32_t bits = static_cast<uint32_t>(v) << 16;
+      float f;
+      memcpy(&f, &bits, 4);
+      out->push_back(json::Value(static_cast<double>(f)));
+    }
+  } else if (datatype == "BYTES") {
+    size_t pos = 0;
+    while (pos + 4 <= byte_size) {
+      uint32_t len = static_cast<uint32_t>(data[pos]) |
+                     (static_cast<uint32_t>(data[pos + 1]) << 8) |
+                     (static_cast<uint32_t>(data[pos + 2]) << 16) |
+                     (static_cast<uint32_t>(data[pos + 3]) << 24);
+      pos += 4;
+      if (pos + len > byte_size) {
+        return Error("malformed BYTES input for JSON tensor data");
+      }
+      out->push_back(json::Value(
+          std::string(reinterpret_cast<const char*>(data + pos), len)));
+      pos += len;
+    }
+  } else {
+    return Error("datatype '" + datatype +
+                 "' has no JSON tensor representation");
+  }
+  return Error::Success;
+}
+
+}  // namespace
+
 Error InferenceServerHttpClient::GenerateRequestBodyStr(
     std::string* request_body, size_t* header_length,
     const InferOptions& options, const std::vector<InferInput*>& inputs,
@@ -586,11 +821,11 @@ Error InferenceServerHttpClient::GenerateRequestBodyStr(
   for (const auto& p : options.double_params) {
     params[p.first] = json::Value(p.second);
   }
-  if (outputs.empty() && options.binary_data_output) {
-    // No explicit outputs: ask the server to return all outputs as
-    // binary (parity: reference http _get_inference_request
-    // binary_data_output default, http/_utils.py:115).
-    params["binary_data_output"] = json::Value(true);
+  if (outputs.empty()) {
+    // No explicit outputs: state the desired format for all outputs
+    // (parity: reference http _get_inference_request
+    // binary_data_output, http/_utils.py:115; false = JSON data).
+    params["binary_data_output"] = json::Value(options.binary_data_output);
   }
   if (!params.empty()) {
     root["parameters"] = json::Value(std::move(params));
@@ -619,6 +854,23 @@ Error InferenceServerHttpClient::GenerateRequestBodyStr(
         tensor_params["shared_memory_offset"] =
             json::Value(static_cast<uint64_t>(shm_offset));
       }
+    } else if (options.json_input_data) {
+      // JSON tensor data: collect the (possibly chunked) raw bytes
+      // and encode them as a flat "data" array.
+      std::string raw;
+      raw.reserve(input->ByteSize());
+      input->PrepareForRequest();
+      const uint8_t* buf;
+      size_t len;
+      while (input->GetNext(&buf, &len)) {
+        raw.append(reinterpret_cast<const char*>(buf), len);
+      }
+      json::Array data;
+      Error jerr = JsonDataFromRaw(
+          input->Datatype(), reinterpret_cast<const uint8_t*>(raw.data()),
+          raw.size(), &data);
+      if (!jerr.IsOk()) return jerr;
+      entry["data"] = json::Value(std::move(data));
     } else {
       tensor_params["binary_data_size"] =
           json::Value(static_cast<uint64_t>(input->ByteSize()));

@@ -71,6 +71,10 @@ class InferResultHttp : public InferResult {
     const uint8_t* raw = nullptr;  // into body_, or nullptr
     size_t raw_size = 0;
     json::Value json_data;         // when not binary
+    // Lazily packed wire bytes for JSON-data outputs, so RawData()
+    // works identically in both tensor formats.
+    mutable std::string decoded;
+    mutable bool decode_attempted = false;
     bool in_shm = false;
   };
 

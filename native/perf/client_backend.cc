@@ -226,6 +226,8 @@ class HttpBackend : public ClientBackend {
                           &b->client_, url, config.verbose);
     if (!err.IsOk()) return err;
     b->client_->SetAsyncWorkerCount(config.http_async_workers);
+    b->json_input_ = config.http_json_input;
+    b->json_output_ = config.http_json_output;
     *backend = std::move(b);
     return Error::Success;
   }
@@ -267,13 +269,14 @@ class HttpBackend : public ClientBackend {
       InferResult** result, const InferOptions& options,
       const std::vector<InferInput*>& inputs,
       const std::vector<const InferRequestedOutput*>& outputs) override {
-    return client_->Infer(result, options, inputs, outputs);
+    return client_->Infer(result, Formatted(options), inputs, outputs);
   }
   Error AsyncInfer(
       OnCompleteFn callback, const InferOptions& options,
       const std::vector<InferInput*>& inputs,
       const std::vector<const InferRequestedOutput*>& outputs) override {
-    return client_->AsyncInfer(std::move(callback), options, inputs, outputs);
+    return client_->AsyncInfer(std::move(callback), Formatted(options),
+                               inputs, outputs);
   }
   Error StartStream(OnCompleteFn callback) override {
     return Error("streaming is not supported over HTTP");
@@ -304,6 +307,15 @@ class HttpBackend : public ClientBackend {
   }
 
  private:
+  // Apply the configured tensor wire formats to a request's options.
+  InferOptions Formatted(const InferOptions& options) const {
+    if (!json_input_ && !json_output_) return options;
+    InferOptions adjusted = options;
+    adjusted.json_input_data = json_input_;
+    if (json_output_) adjusted.binary_data_output = false;
+    return adjusted;
+  }
+
   static Error ParseInto(const std::string& text, json::Value* out) {
     std::string err = json::Parse(text.data(), text.size(), out);
     if (!err.empty()) return Error("bad JSON from server: " + err);
@@ -311,6 +323,8 @@ class HttpBackend : public ClientBackend {
   }
 
   std::unique_ptr<InferenceServerHttpClient> client_;
+  bool json_input_ = false;
+  bool json_output_ = false;
 };
 
 //==============================================================================

@@ -70,6 +70,11 @@ struct BackendConfig {
   // HTTPS for the HTTP client (TLS via dlopen'd OpenSSL).
   bool https = false;
   SslOptions https_ssl;
+  // HTTP tensor wire format (reference --input-tensor-format /
+  // --output-tensor-format): JSON mode interoperates with KServe
+  // servers lacking the binary extension.
+  bool http_json_input = false;
+  bool http_json_output = false;
 };
 
 //==============================================================================

@@ -82,6 +82,8 @@ enum LongOpt {
   kOptTraceCount,
   kOptEnableMpi,
   kOptRanks,
+  kOptInputTensorFormat,
+  kOptOutputTensorFormat,
   kOptLogFrequency,
   kOptVersion,
   kOptGrpcCompression,
@@ -167,6 +169,10 @@ const struct option kLongOptions[] = {
     {"trace-count", required_argument, nullptr, kOptTraceCount},
     {"enable-mpi", no_argument, nullptr, kOptEnableMpi},
     {"ranks", required_argument, nullptr, kOptRanks},
+    {"input-tensor-format", required_argument, nullptr,
+     kOptInputTensorFormat},
+    {"output-tensor-format", required_argument, nullptr,
+     kOptOutputTensorFormat},
     {"log-frequency", required_argument, nullptr, kOptLogFrequency},
     {"version", no_argument, nullptr, kOptVersion},
     {"grpc-compression-algorithm", required_argument, nullptr,
@@ -209,6 +215,8 @@ void CLParser::Usage(const char* program) {
       "Tracing: --trace-level L [--trace-rate N] [--trace-count N]\n"
       "Metrics: --collect-metrics [--metrics-url host:port/metrics]\n"
       "  [--metrics-interval ms]\n"
+      "HTTP tensor format: --input-tensor-format binary|json,\n"
+      "  --output-tensor-format binary|json\n"
       "Scale-out: --enable-mpi, --ranks N (forks N local ranks over\n"
       "  the builtin coordinator; no launcher needed)\n"
       "Output: -f <csv> [--verbose-csv], --profile-export-file <json>,\n"
@@ -388,6 +396,20 @@ Error CLParser::Parse(
         break;
       case kOptTraceCount:
         params->trace_count = atoll(optarg);
+        break;
+      case kOptInputTensorFormat:
+        params->input_tensor_format = optarg;
+        if (params->input_tensor_format != "binary" &&
+            params->input_tensor_format != "json") {
+          return Error("--input-tensor-format must be binary|json");
+        }
+        break;
+      case kOptOutputTensorFormat:
+        params->output_tensor_format = optarg;
+        if (params->output_tensor_format != "binary" &&
+            params->output_tensor_format != "json") {
+          return Error("--output-tensor-format must be binary|json");
+        }
         break;
       case kOptRanks:
         params->ranks = atoi(optarg);

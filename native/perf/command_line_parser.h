@@ -120,6 +120,10 @@ struct PerfAnalyzerParameters {
   // --ranks N: fork N local analyzer ranks over the builtin TCP
   // coordinator (the launcher-free equivalent of `mpirun -n N`).
   int ranks = 1;
+  // HTTP tensor wire format, binary|json (reference
+  // --input-tensor-format / --output-tensor-format).
+  std::string input_tensor_format = "binary";
+  std::string output_tensor_format = "binary";
 
   // gRPC message compression (reference --grpc-compression-algorithm).
   std::string grpc_compression_algorithm = "none";

@@ -100,6 +100,14 @@ int RunRank(PerfAnalyzerParameters& params) {
   }
   backend_config.url = params.url;
   backend_config.verbose = params.verbose;
+  backend_config.http_json_input = params.input_tensor_format == "json";
+  backend_config.http_json_output = params.output_tensor_format == "json";
+  if ((backend_config.http_json_input || backend_config.http_json_output) &&
+      backend_config.kind != BackendKind::TRITON_HTTP) {
+    fprintf(stderr,
+            "warning: --input/--output-tensor-format json applies only "
+            "to the HTTP protocol; ignored here\n");
+  }
   backend_config.model_signature_name = params.model_signature_name;
   if (params.grpc_compression_algorithm != "none") {
     backend_config.grpc_compression = params.grpc_compression_algorithm;

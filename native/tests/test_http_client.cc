@@ -61,6 +61,43 @@ TEST_CASE("http: GenerateRequestBody binary layout") {
   CHECK(memcmp(body.data() + header_length + 64, data1, 64) == 0);
 }
 
+TEST_CASE("http: GenerateRequestBody JSON tensor data") {
+  // json_input_data: tensors ride as JSON "data" arrays, the body IS
+  // the header (no binary section), and binary_data_output=false is
+  // stated so the server answers in JSON too.
+  float data0[4] = {0.5f, -1.25f, 2.0f, 3.75f};
+  int32_t data1[4] = {1, -2, 3, -4};
+  auto in0 = MakeFp32Input("INPUT0", {4}, data0, 4);
+  InferInput* raw1 = nullptr;
+  InferInput::Create(&raw1, "INPUT1", {4}, "INT32");
+  std::unique_ptr<InferInput> in1(raw1);
+  in1->AppendRaw(reinterpret_cast<const uint8_t*>(data1), sizeof(data1));
+
+  InferOptions options("simple");
+  options.json_input_data = true;
+  options.binary_data_output = false;
+
+  std::vector<char> body;
+  size_t header_length = 0;
+  REQUIRE_OK(InferenceServerHttpClient::GenerateRequestBody(
+      &body, &header_length, options, {in0.get(), in1.get()}, {}));
+  CHECK_EQ(body.size(), header_length);  // no binary section at all
+  json::Value header;
+  REQUIRE(json::Parse(body.data(), header_length, &header).empty());
+  CHECK_EQ(header["parameters"]["binary_data_output"].AsBool(), false);
+  const auto& inputs = header["inputs"].AsArray();
+  REQUIRE(inputs.size() == 2u);
+  CHECK(!inputs[0]["parameters"].Has("binary_data_size"));
+  const auto& d0 = inputs[0]["data"].AsArray();
+  REQUIRE(d0.size() == 4u);
+  CHECK_EQ(d0[1].AsDouble(), -1.25);
+  CHECK_EQ(d0[3].AsDouble(), 3.75);
+  const auto& d1 = inputs[1]["data"].AsArray();
+  REQUIRE(d1.size() == 4u);
+  CHECK_EQ(d1[1].AsInt(), -2);
+  CHECK_EQ(d1[3].AsInt(), -4);
+}
+
 TEST_CASE("http: GenerateRequestBody shm params") {
   InferInput* raw = nullptr;
   InferInput::Create(&raw, "INPUT0", {4}, "FP32");
@@ -177,6 +214,27 @@ TEST_CASE("http: integration against live server") {
   const int32_t* sums = reinterpret_cast<const int32_t*>(buf);
   for (int i = 0; i < 16; ++i) {
     CHECK_EQ(sums[i], data0[i] + 1);
+  }
+
+  // JSON tensor mode round trip: inputs as "data" arrays, outputs
+  // requested as JSON, RawData materializes the packed bytes.
+  {
+    InferOptions json_options("simple");
+    json_options.json_input_data = true;
+    json_options.binary_data_output = false;
+    InferResult* json_result = nullptr;
+    REQUIRE_OK(client->Infer(&json_result, json_options,
+                             {in0.get(), in1.get()}));
+    std::unique_ptr<InferResult> json_guard(json_result);
+    REQUIRE_OK(json_result->RequestStatus());
+    const uint8_t* jbuf;
+    size_t jlen;
+    REQUIRE_OK(json_result->RawData("OUTPUT0", &jbuf, &jlen));
+    REQUIRE(jlen == 64u);
+    const int32_t* jsums = reinterpret_cast<const int32_t*>(jbuf);
+    for (int i = 0; i < 16; ++i) {
+      CHECK_EQ(jsums[i], data0[i] + 1);
+    }
   }
 
   // Async: issue 8 requests and wait for all callbacks.

@@ -56,6 +56,39 @@ def test_infer_multi_megabyte_tensors(client):
     np.testing.assert_array_equal(result.as_numpy("OUTPUT1"), x - y)
 
 
+def test_infer_json_tensor_data(client):
+    """binary_data=False on inputs and outputs: tensors ride as JSON
+    data arrays both ways (no binary extension anywhere on the wire) —
+    the interop mode for KServe servers without the binary protocol
+    (parity: reference HTTP client's binary_data kwargs)."""
+    x = np.arange(16, dtype=np.float32) / 3.0
+    y = np.ones(16, dtype=np.float32) * 2.5
+    inputs = [
+        httpclient.InferInput("INPUT0", [16], "FP32").set_data_from_numpy(
+            x, binary_data=False),
+        httpclient.InferInput("INPUT1", [16], "FP32").set_data_from_numpy(
+            y, binary_data=False),
+    ]
+    outputs = [
+        httpclient.InferRequestedOutput("OUTPUT0", binary_data=False),
+        httpclient.InferRequestedOutput("OUTPUT1", binary_data=False),
+    ]
+    result = client.infer("add_sub_fp32", inputs, outputs=outputs)
+    np.testing.assert_allclose(result.as_numpy("OUTPUT0"), x + y, rtol=1e-6)
+    np.testing.assert_allclose(result.as_numpy("OUTPUT1"), x - y, rtol=1e-6)
+
+
+def test_json_tensor_bytes_must_be_utf8(client):
+    """binary_data=False on a BYTES input holding non-UTF-8 bytes must
+    error loudly — a JSON string cannot carry arbitrary binary, and a
+    lossy re-encode would silently corrupt the payload."""
+    arr = np.array([b"\xff\xfe raw"], dtype=np.object_)
+    infer_input = httpclient.InferInput("INPUT0", [1], "BYTES")
+    infer_input.set_data_from_numpy(arr, binary_data=False)
+    with pytest.raises(InferenceServerException, match="non-UTF-8"):
+        client.infer("simple_string", [infer_input])
+
+
 def test_health(client):
     assert client.is_server_live()
     assert client.is_server_ready()

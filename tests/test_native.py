@@ -279,6 +279,24 @@ def test_native_perf_analyzer_request_parameter_and_count(
     assert len(row.split(",")) == len(header.split(","))
 
 
+def test_native_perf_analyzer_json_tensor_format(native_build, live_server):
+    """--input-tensor-format json --output-tensor-format json: tensors
+    ride as JSON data arrays both ways over HTTP (no binary extension
+    anywhere — the interop mode for KServe servers without it; parity:
+    the reference's tensor-format flags)."""
+    binary = native_build / "perf_analyzer"
+    proc = subprocess.run(
+        [str(binary), "-m", "simple", "-u", live_server["http"],
+         "-i", "http", "--input-tensor-format", "json",
+         "--output-tensor-format", "json",
+         "--concurrency-range", "2", "--async",
+         "-p", "400", "-r", "3", "-s", "50"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "throughput" in proc.stdout
+
+
 def test_native_perf_analyzer_mpi_degrades_without_launcher(
         native_build, live_server):
     """--enable-mpi outside mpirun must degrade to a clean single-rank
