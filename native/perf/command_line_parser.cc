@@ -84,6 +84,10 @@ enum LongOpt {
   kOptRanks,
   kOptInputTensorFormat,
   kOptOutputTensorFormat,
+  kOptSslHttpsClientCertType,
+  kOptSslHttpsPrivateKeyType,
+  kOptModelRepository,
+  kOptTritonServerDir,
   kOptLogFrequency,
   kOptVersion,
   kOptGrpcCompression,
@@ -173,6 +177,13 @@ const struct option kLongOptions[] = {
      kOptInputTensorFormat},
     {"output-tensor-format", required_argument, nullptr,
      kOptOutputTensorFormat},
+    {"ssl-https-client-certificate-type", required_argument, nullptr,
+     kOptSslHttpsClientCertType},
+    {"ssl-https-private-key-type", required_argument, nullptr,
+     kOptSslHttpsPrivateKeyType},
+    {"model-repository", required_argument, nullptr, kOptModelRepository},
+    {"triton-server-directory", required_argument, nullptr,
+     kOptTritonServerDir},
     {"log-frequency", required_argument, nullptr, kOptLogFrequency},
     {"version", no_argument, nullptr, kOptVersion},
     {"grpc-compression-algorithm", required_argument, nullptr,
@@ -397,6 +408,20 @@ Error CLParser::Parse(
       case kOptTraceCount:
         params->trace_count = atoll(optarg);
         break;
+      case kOptSslHttpsClientCertType:
+      case kOptSslHttpsPrivateKeyType:
+        // The TLS loader reads PEM; DER is the only other reference
+        // value and is unsupported here.
+        if (std::string(optarg) != "PEM") {
+          return Error("only PEM certificates/keys are supported");
+        }
+        break;
+      case kOptModelRepository:
+      case kOptTritonServerDir:
+        return Error(
+            "this build's --service-kind in_process embeds the model "
+            "registry directly (no libtritonserver / repository "
+            "directory); select models with -m");
       case kOptInputTensorFormat:
         params->input_tensor_format = optarg;
         if (params->input_tensor_format != "binary" &&
