@@ -131,6 +131,14 @@ class BertModel(ServedModel):
     dynamic_batching = True
     preferred_batch_sizes = [8, 16, 32, 64]
     max_queue_delay_us = 4000
+    # Opt into the adaptive gather window: under the bench's c64
+    # burst the inter-arrival EMA stretches the window toward
+    # delay_max so whole preferred batches form (r05 fused only ~11
+    # of 64); the idle-gap cutoff keeps sparse/stalled traffic at the
+    # 4 ms floor, so the ceiling is only ever paid when arrivals can
+    # actually fill a batch.
+    delay_min_us = 4000
+    delay_max_us = 64000
 
     def __init__(self, name: str = "bert_base", cfg: Optional[BertConfig]
                  = None, seed: int = 0):

@@ -28,6 +28,11 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:  # jax >= 0.4.35 exports shard_map at the top level
+    _shard_map = jax.shard_map
+except AttributeError:  # older releases keep it under experimental
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 
 def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
                           scale: Optional[float], vary_axes: tuple):
@@ -52,9 +57,15 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
     if hasattr(lax, "pcast"):
         def _vary(x):
             return lax.pcast(x, vary_axes, to="varying")
-    else:
+    elif hasattr(lax, "pvary"):
         def _vary(x):
             return lax.pvary(x, vary_axes)
+    else:
+        # jax 0.4.x: the shard_map rep-checker inserts replicated->
+        # varying conversions itself; no explicit marker op exists
+        # (lax.pbroadcast there is a real collective, not the marker).
+        def _vary(x):
+            return x
     out = _vary(jnp.zeros((b, h, s, d), jnp.float32))
     row_max = _vary(jnp.full((b, h, s), -jnp.inf, jnp.float32))
     row_sum = _vary(jnp.zeros((b, h, s), jnp.float32))
@@ -119,7 +130,7 @@ def ring_attention(q, k, v, mesh: Mesh, *, axis_name: str = "sp",
     vary_axes = (axis_name,) + ((db,) if db else ())
     local = partial(_ring_attention_local, axis_name=axis_name,
                     causal=causal, scale=scale, vary_axes=vary_axes)
-    fn = jax.shard_map(
+    fn = _shard_map(
         local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     constraint = NamedSharding(mesh, spec)
     q, k, v = (lax.with_sharding_constraint(x, constraint)

@@ -281,16 +281,73 @@ def measure_model_exec_corrected(core, model_name: str, batch: int,
 
 
 def fusion_stats(core, model_name: str):
-    """(inference_count, execution_count) snapshot for fusion-ratio
-    evidence (Triton semantics: inference_count counts batch rows,
-    execution_count counts model executions; ratio < 0.5 proves the
-    dynamic batcher fused)."""
+    """Statistics snapshot for fusion + pipeline evidence (Triton
+    semantics: inference_count counts batch rows, execution_count
+    counts model executions; ratio < 0.5 proves the dynamic batcher
+    fused). Carries the fused-batch-size histogram and the batcher's
+    compute/fetch overlap counters so window deltas land in the bench
+    JSON."""
     try:
         stats = core.model_statistics(model_name)
         entry = stats.model_stats[0]
-        return int(entry.inference_count), int(entry.execution_count)
+        pipe = entry.pipeline_stats
+        return {
+            "inference_count": int(entry.inference_count),
+            "execution_count": int(entry.execution_count),
+            "batch_hist": {
+                int(row.batch_size): int(row.compute_infer.count)
+                for row in entry.batch_stats
+            },
+            "fetch_ns": int(pipe.fetch_ns),
+            "overlap_ns": int(pipe.overlap_ns),
+            "pending_count": int(pipe.pending_count),
+            "inflight_count": int(pipe.inflight_count),
+            "queue_delay_us": int(pipe.queue_delay_us),
+        }
     except Exception:  # noqa: BLE001 — evidence, never a failure
         return None
+
+
+class PipelineSampler:
+    """Polls the batcher gauges WHILE a measured run is live: pending
+    depth and in-flight count are point-in-time values, so reading
+    them after the harness's closed-loop clients drain would always
+    record the idle 0 — the max under load is the evidence."""
+
+    def __init__(self, core, names, interval_s: float = 0.5):
+        import threading
+
+        self._core = core
+        self._names = list(names)
+        self._interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self.max_pending: dict = {}
+        self.max_inflight: dict = {}
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._thread.join(timeout=5)
+        return False
+
+    def reset(self) -> None:
+        self.max_pending.clear()
+        self.max_inflight.clear()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval_s):
+            for name in self._names:
+                snap = fusion_stats(self._core, name)
+                if snap is None:
+                    continue
+                self.max_pending[name] = max(
+                    self.max_pending.get(name, 0), snap["pending_count"])
+                self.max_inflight[name] = max(
+                    self.max_inflight.get(name, 0), snap["inflight_count"])
 
 
 def run_python_harness(model: str, batch: int, concurrency: int,
@@ -766,30 +823,33 @@ def main() -> None:
             fusion_names = ([model_name] if track_fusion else []) \
                 + list(fusion_composing)
             attempts = 0
-            while True:
-                attempts += 1
-                # Snapshot inside the loop: a failed attempt's partial
-                # traffic must not pollute the successful attempt's
-                # fusion evidence.
-                counts_before = {name: fusion_stats(core, name)
-                                 for name in fusion_names}
-                try:
-                    tput, p50 = run_native(
-                        binary, handle.address, model_name, batch,
-                        concurrency,
-                        timeout=max(30.0, min(240.0, remaining() - 20)),
-                        **common)
-                    break
-                except Exception as exc:  # noqa: BLE001
-                    # A freshly-warmed server right after a heavy stage
-                    # occasionally resets the first connection burst;
-                    # one settle-and-retry rescues the stage instead of
-                    # dropping a BASELINE config from the record.
-                    if attempts >= 2 or remaining() < 60:
-                        raise
-                    log("%s attempt %d failed (%s) — retrying"
-                        % (stage_name, attempts, exc))
-                    time.sleep(3.0)
+            with PipelineSampler(core, fusion_names) as sampler:
+                while True:
+                    attempts += 1
+                    # Snapshot inside the loop: a failed attempt's
+                    # partial traffic must not pollute the successful
+                    # attempt's fusion evidence.
+                    counts_before = {name: fusion_stats(core, name)
+                                     for name in fusion_names}
+                    sampler.reset()
+                    try:
+                        tput, p50 = run_native(
+                            binary, handle.address, model_name, batch,
+                            concurrency,
+                            timeout=max(30.0, min(240.0, remaining() - 20)),
+                            **common)
+                        break
+                    except Exception as exc:  # noqa: BLE001
+                        # A freshly-warmed server right after a heavy
+                        # stage occasionally resets the first connection
+                        # burst; one settle-and-retry rescues the stage
+                        # instead of dropping a BASELINE config from the
+                        # record.
+                        if attempts >= 2 or remaining() < 60:
+                            raise
+                        log("%s attempt %d failed (%s) — retrying"
+                            % (stage_name, attempts, exc))
+                        time.sleep(3.0)
             result = dict(extra or {}, batch=batch, concurrency=concurrency)
             if baseline:
                 result["vs_baseline"] = round(tput / baseline, 4)
@@ -799,8 +859,8 @@ def main() -> None:
                 after = fusion_stats(core, name)
                 if before is None or after is None:
                     continue
-                d_infer = after[0] - before[0]
-                d_exec = after[1] - before[1]
+                d_infer = after["inference_count"] - before["inference_count"]
+                d_exec = after["execution_count"] - before["execution_count"]
                 if d_infer <= 0:
                     continue
                 # < 0.5 proves the dynamic batcher fused
@@ -811,6 +871,33 @@ def main() -> None:
                 result[prefix + "fusion_ratio"] = round(d_exec / d_infer, 4)
                 result[prefix + "fused_requests"] = d_infer
                 result[prefix + "fused_executions"] = d_exec
+                # Executed-batch-size histogram over THIS stage's
+                # windows ({size: executions}) plus the pipeline
+                # evidence: overlap_ratio is the fraction of
+                # device->host fetch wall-clock during which other
+                # batches' work (compute dispatch or fetch) was also
+                # in flight — fetch time the pipeline kept company
+                # instead of serializing behind.
+                hist = {
+                    size: count - before["batch_hist"].get(size, 0)
+                    for size, count in sorted(after["batch_hist"].items())
+                }
+                hist = {s: c for s, c in hist.items() if c > 0}
+                if hist:
+                    result[prefix + "fused_batch_hist"] = hist
+                d_fetch = after["fetch_ns"] - before["fetch_ns"]
+                d_overlap = after["overlap_ns"] - before["overlap_ns"]
+                if d_fetch > 0:
+                    result[prefix + "overlap_ratio"] = round(
+                        d_overlap / d_fetch, 4)
+                # Gauges sampled DURING the measured windows (the
+                # after-run values would always read the drained 0).
+                result[prefix + "batch_pending_depth_max"] = \
+                    sampler.max_pending.get(name, after["pending_count"])
+                result[prefix + "batch_inflight_max"] = \
+                    sampler.max_inflight.get(name, after["inflight_count"])
+                result[prefix + "adaptive_queue_delay_us"] = \
+                    after["queue_delay_us"]
             # Device-side residual for the VERDICT contract: every TPU
             # stage records model_exec_ms_device + mfu_device. The
             # probe runs AFTER the measured windows (same warm model,

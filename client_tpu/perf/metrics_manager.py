@@ -26,17 +26,26 @@ _LABEL = re.compile(r'(\w+)="([^"]*)"')
 @dataclass
 class TpuMetrics:
     """One scrape: per-device gauge maps keyed by device uuid
-    (parity: Metrics::gpu_utilization_per_gpu etc, metrics.h:37-43)."""
+    (parity: Metrics::gpu_utilization_per_gpu etc, metrics.h:37-43),
+    plus the dynamic-batcher pipeline gauges keyed by model name."""
 
     hbm_used_bytes: Dict[str, float] = field(default_factory=dict)
     hbm_total_bytes: Dict[str, float] = field(default_factory=dict)
     hbm_utilization: Dict[str, float] = field(default_factory=dict)
+    batch_pending_depth: Dict[str, float] = field(default_factory=dict)
+    batch_inflight: Dict[str, float] = field(default_factory=dict)
+    batch_queue_delay_us: Dict[str, float] = field(default_factory=dict)
+    batch_overlap_ratio: Dict[str, float] = field(default_factory=dict)
 
 
 _FAMILIES = {
     "tpu_hbm_used_bytes": "hbm_used_bytes",
     "tpu_hbm_total_bytes": "hbm_total_bytes",
     "tpu_hbm_utilization": "hbm_utilization",
+    "tpu_batch_pending_depth": "batch_pending_depth",
+    "tpu_batch_inflight": "batch_inflight",
+    "tpu_batch_queue_delay_us": "batch_queue_delay_us",
+    "tpu_batch_overlap_ratio": "batch_overlap_ratio",
 }
 
 
@@ -50,12 +59,14 @@ def parse_prometheus(text: str) -> TpuMetrics:
         if not m or m.group("name") not in _FAMILIES:
             continue
         labels = dict(_LABEL.findall(m.group("labels") or ""))
-        uuid = labels.get("tpu_uuid") or labels.get("gpu_uuid") or "0"
+        # Batcher gauges are per-model; HBM gauges are per-device.
+        key = (labels.get("model") or labels.get("tpu_uuid")
+               or labels.get("gpu_uuid") or "0")
         try:
             value = float(m.group("value"))
         except ValueError:
             continue
-        getattr(metrics, _FAMILIES[m.group("name")])[uuid] = value
+        getattr(metrics, _FAMILIES[m.group("name")])[key] = value
     return metrics
 
 
@@ -120,9 +131,12 @@ class MetricsManager:
 
 def summarize_metrics(snapshots: List[TpuMetrics]) -> Dict[str, Dict[str, float]]:
     """avg/max per gauge family across a window's snapshots, averaged
-    over devices (what the CSV 'GPU metrics' columns become)."""
+    over devices (what the CSV 'GPU metrics' columns become; the
+    batch_* families average over models instead)."""
     out: Dict[str, Dict[str, float]] = {}
-    for attr in ("hbm_used_bytes", "hbm_total_bytes", "hbm_utilization"):
+    for attr in ("hbm_used_bytes", "hbm_total_bytes", "hbm_utilization",
+                 "batch_pending_depth", "batch_inflight",
+                 "batch_queue_delay_us", "batch_overlap_ratio"):
         values = []
         for snap in snapshots:
             per_device = getattr(snap, attr)

@@ -87,6 +87,22 @@ def _normalize_stats_entry(entry: Dict) -> Dict:
         )
     if sections:
         out["inference_stats"] = sections
+    if "batch_stats" in out:
+        out["batch_stats"] = [
+            {
+                name: (
+                    {k: int(v) for k, v in value.items()}
+                    if isinstance(value, dict) else int(value)
+                )
+                for name, value in row.items()
+            }
+            for row in out["batch_stats"]
+        ]
+    if "pipeline_stats" in out:
+        out["pipeline_stats"] = {
+            name: float(value) if name == "overlap_ratio" else int(value)
+            for name, value in out["pipeline_stats"].items()
+        }
     return out
 
 
@@ -138,20 +154,84 @@ def _accumulate_server_stats(total: Dict, part: Dict) -> Dict:
     }
     for entry in part.get("model_stats", []):
         key = (entry.get("name"), entry.get("version", ""))
-        merged[key] = _accumulate_numeric(merged.get(key, {}), entry)
+        prior = merged.get(key, {})
+        acc = _accumulate_numeric(prior, entry)
+        if "batch_stats" in entry or "batch_stats" in prior:
+            by_size: Dict = {}
+            for row in list(prior.get("batch_stats", [])) + list(
+                    entry.get("batch_stats", [])):
+                size = row.get("batch_size")
+                base = by_size.get(size, {})
+                summed = _accumulate_numeric(base, row)
+                summed["batch_size"] = size
+                by_size[size] = summed
+            acc["batch_stats"] = list(by_size.values())
+        pipe_prior = prior.get("pipeline_stats", {})
+        pipe_part = entry.get("pipeline_stats", {})
+        if pipe_prior or pipe_part:
+            # _accumulate_numeric iterates the PART's keys, so a window
+            # without pipeline_stats (batcher unloaded mid-run) must not
+            # wipe earlier windows' counters.
+            pipe = (_accumulate_numeric(pipe_prior, pipe_part)
+                    if pipe_part else dict(pipe_prior))
+            # Gauges and the derived ratio are not additive: keep the
+            # latest window's view / recompute from summed counters.
+            for gauge in ("pending_count", "inflight_count",
+                          "queue_delay_us"):
+                if gauge in pipe_part:
+                    pipe[gauge] = pipe_part[gauge]
+            fetch_ns = pipe.get("fetch_ns", 0)
+            pipe["overlap_ratio"] = (
+                pipe.get("overlap_ns", 0) / fetch_ns if fetch_ns else 0.0)
+            acc["pipeline_stats"] = pipe
+        merged[key] = acc
     return {"model_stats": list(merged.values())}
 
 
 def _delta_server_stats(before: Dict, after: Dict) -> Dict:
     """Window-start/window-end statistics pairing: returns the same
     model_stats shape holding only THIS window's deltas, one entry per
-    (model, version) — the top model plus ensemble composing models."""
-    return {
-        "model_stats": [
-            _numeric_delta(before.get(key, {}), entry)
-            for key, entry in after.items()
-        ]
-    }
+    (model, version) — the top model plus ensemble composing models.
+
+    Counters are differenced; the batcher pipeline GAUGES
+    (pending_count / inflight_count / queue_delay_us) pass through as
+    window-end values, and the fused-batch histogram is matched row by
+    row on batch_size (a plain leaf delta cannot difference a list)."""
+    out = []
+    for key, entry in after.items():
+        prior = before.get(key, {})
+        delta = _numeric_delta(prior, entry)
+        if "batch_stats" in entry:
+            delta["batch_stats"] = _delta_batch_stats(
+                prior.get("batch_stats", []), entry["batch_stats"])
+        if "pipeline_stats" in entry:
+            pipe = _numeric_delta(prior.get("pipeline_stats", {}),
+                                  entry["pipeline_stats"])
+            for gauge in ("pending_count", "inflight_count",
+                          "queue_delay_us"):
+                if gauge in entry["pipeline_stats"]:
+                    pipe[gauge] = entry["pipeline_stats"][gauge]
+            fetch_ns = pipe.get("fetch_ns", 0)
+            pipe["overlap_ratio"] = (
+                pipe.get("overlap_ns", 0) / fetch_ns if fetch_ns else 0.0)
+            delta["pipeline_stats"] = pipe
+        out.append(delta)
+    return {"model_stats": out}
+
+
+def _delta_batch_stats(before: List[Dict], after: List[Dict]) -> List[Dict]:
+    """Per-batch-size histogram deltas, dropping sizes this window
+    never executed."""
+    prior = {row.get("batch_size"): row for row in before}
+    out = []
+    for row in after:
+        delta = _numeric_delta(prior.get(row.get("batch_size"), {}), row)
+        delta["batch_size"] = row.get("batch_size")
+        counts = delta.get("compute_infer", {})
+        if isinstance(counts, dict) and not counts.get("count"):
+            continue
+        out.append(delta)
+    return out
 
 
 class InferenceProfiler:

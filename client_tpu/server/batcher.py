@@ -1,4 +1,4 @@
-"""Server-side dynamic batching.
+"""Server-side dynamic batching with pipelined execution.
 
 The TPU-first equivalent of Triton's dynamic batcher (the scheduler
 the reference's perf docs benchmark against and which BASELINE.md's
@@ -8,15 +8,44 @@ matmuls, one compile-shape per preferred size, far less per-request
 dispatch overhead — then the stacked outputs are split back per
 request.
 
-Requests are only fused when their per-sample shapes match; shape
-changes flush the current bucket. Sequence requests bypass batching
-entirely (state is per-request)."""
+Three mechanisms turn the naive gather->execute->fetch->split loop
+into a pipeline:
+
+* **Per-shape bucket queues.** Requests land in the queue keyed by
+  their (per-sample shape, params) signature. A shape change no longer
+  flushes the in-progress bucket — each shape accumulates toward its
+  own preferred size on its own deadline, so interleaved traffic of
+  two shapes fuses both instead of fragmenting each.
+
+* **Adaptive queue delay** (opt-in via ``delay_min_us`` /
+  ``delay_max_us``). For models that set the bounds, the batcher
+  tracks the observed inter-arrival gap (EMA) and sizes the gather
+  window to the time it actually takes to fill the largest preferred
+  batch, clamped to ``[delay_min_us, delay_max_us]``. Sparse traffic
+  collapses to the lower bound (no latency tax waiting for requests
+  that are not coming); bursty traffic extends toward the upper bound
+  so BERT-style concurrent singles fill a preferred 32/64 instead of
+  dispatching at whatever arrived in the fixed window. Models that
+  set neither bound keep Triton semantics: ``max_queue_delay_us`` is
+  a hard ceiling.
+
+* **Two-stage compute/fetch pipeline.** The gather thread dispatches
+  fused batch N+1 to the device while batch N's stacked outputs are
+  still fetching device->host on the fetch pool. In-flight depth is
+  bounded (``pipeline_depth``), a failed batch poisons only its own
+  requests, and stop() drains every queued request before the pools
+  shut down. The :class:`_OverlapTracker` measures how much fetch
+  wall-clock actually overlapped compute — the served-path number the
+  statistics endpoints report as ``overlap_ratio``.
+
+Sequence requests bypass batching entirely (state is per-request)."""
 
 from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, List, Optional
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -44,26 +73,127 @@ class _Pending:
         self.leader = False
 
 
+class _OverlapTracker:
+    """Wall-clock accounting for the compute/fetch pipeline: cumulative
+    ns with >=1 fused execution in flight (compute), >=1 device->host
+    output fetch in flight (fetch), and overlap — fetch time during
+    which ANY other pipeline stage (another batch's compute dispatch or
+    another fetch) was simultaneously in flight. Counting concurrent
+    fetches matters because async-dispatch models return lazy device
+    arrays: their device compute completes inside the fetch stage's
+    host materialization, so on such models pipelining manifests as
+    overlapping fetches rather than a long blocking compute span. The
+    overlap/fetch ratio is the measure of how much of the fetch tax
+    the pipeline hid behind other in-flight work (host-observed; for
+    async models compute_ns is the dispatch span, a lower bound)."""
+
+    __slots__ = ("_lock", "_compute", "_fetch", "_last_ns",
+                 "compute_ns", "fetch_ns", "overlap_ns")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._compute = 0
+        self._fetch = 0
+        self._last_ns = time.monotonic_ns()
+        self.compute_ns = 0
+        self.fetch_ns = 0
+        self.overlap_ns = 0
+
+    def _shift(self, d_compute: int, d_fetch: int) -> None:
+        with self._lock:
+            # Clock read INSIDE the lock: a stale `now` captured before
+            # a contending thread advanced _last_ns would yield a
+            # negative dt and corrupt the counters.
+            now = time.monotonic_ns()
+            dt = now - self._last_ns
+            self._last_ns = now
+            if self._compute > 0:
+                self.compute_ns += dt
+            if self._fetch > 0:
+                self.fetch_ns += dt
+            if self._fetch > 0 and self._compute + self._fetch >= 2:
+                self.overlap_ns += dt
+            self._compute += d_compute
+            self._fetch += d_fetch
+
+    def enter_compute(self):
+        self._shift(1, 0)
+
+    def exit_compute(self):
+        self._shift(-1, 0)
+
+    def enter_fetch(self):
+        self._shift(0, 1)
+
+    def exit_fetch(self):
+        self._shift(0, -1)
+
+    def snapshot(self) -> Tuple[int, int, int]:
+        """(compute_ns, fetch_ns, overlap_ns), advanced to now."""
+        self._shift(0, 0)
+        with self._lock:
+            return self.compute_ns, self.fetch_ns, self.overlap_ns
+
+
 class DynamicBatcher:
-    """One batcher (and gather thread) per served model."""
+    """One batcher (and gather thread) per served model.
+
+    ``stats_hook(executed_batch_size, compute_ns, fetch_ns)`` is called
+    once per successful fused execution — the server core feeds its
+    per-model batch-size histogram from it."""
 
     def __init__(self, model, max_queue_delay_us: int = 500,
-                 preferred_batch_sizes: Optional[List[int]] = None):
+                 preferred_batch_sizes: Optional[List[int]] = None,
+                 delay_min_us: int = 0, delay_max_us: int = 0,
+                 pipeline_depth: int = 0, fetch_workers: int = 0,
+                 stats_hook: Optional[Callable[[int, int, int],
+                                               None]] = None):
         self._model = model
         self._max_batch = max(int(model.max_batch_size), 1)
         self._delay_ns = max_queue_delay_us * NANOS_PER_US
         self._preferred = sorted(
             s for s in (preferred_batch_sizes or []) if s <= self._max_batch
         )
-        self._queue: List[_Pending] = []
+        # Adaptive-delay bounds. Adaptation is OPT-IN: a model that
+        # sets delay_min_us/delay_max_us accepts a gather window that
+        # tracks the arrival rate inside those bounds; without them
+        # max_queue_delay_us stays the hard ceiling it is in Triton —
+        # silently stretching an existing config's "max" 16x would be
+        # a latency regression nobody asked for.
+        self._adaptive = delay_min_us > 0 or delay_max_us > 0
+        self._delay_min_ns = (delay_min_us * NANOS_PER_US
+                              if delay_min_us > 0 else self._delay_ns)
+        self._delay_max_ns = (delay_max_us * NANOS_PER_US
+                              if delay_max_us > 0
+                              else max(self._delay_ns * 16, self._delay_ns))
+        if not self._adaptive:
+            self._delay_max_ns = self._delay_ns
+        self._cur_delay_ns = min(max(self._delay_ns, self._delay_min_ns),
+                                 self._delay_max_ns)
+        # Inter-arrival EMA (ns); 0 until two requests have arrived.
+        self._ia_ema_ns = 0.0
+        self._last_arrival_ns = 0
+        # Per-shape bucket queues, insertion-ordered so draining and
+        # deadline scans visit older shapes first.
+        self._buckets: "OrderedDict[tuple, List[_Pending]]" = OrderedDict()
         self._cv = threading.Condition()
         self._stopping = False
-        # Host fetches of fused outputs run here so the gather thread
-        # keeps dispatching; concurrent device->host transfers pipeline.
+        # Bounded pipeline: at most this many fused batches dispatched
+        # but not yet finished (compute or fetch still pending).
+        self._depth = pipeline_depth if pipeline_depth > 0 else 4
+        self._inflight = 0
+        self._tracker = _OverlapTracker()
+        self._stats_hook = stats_hook
         from concurrent.futures import ThreadPoolExecutor
 
+        # Host fetches of fused outputs run here so the exec workers
+        # keep dispatching; concurrent device->host transfers pipeline.
+        # Sized from the pipeline depth unless the model pins a count.
+        self._fetch_workers = (fetch_workers if fetch_workers > 0
+                               else max(2, self._depth))
         self._fetch_pool = ThreadPoolExecutor(
-            max_workers=4, thread_name_prefix="batch-fetch")
+            max_workers=self._fetch_workers,
+            thread_name_prefix="batch-fetch")
         # Bucket executions run here, NOT on the gather thread: a
         # model whose infer() blocks (an ensemble fetching its final
         # outputs, any host-side model) would otherwise serialize the
@@ -72,16 +202,20 @@ class DynamicBatcher:
         # pipeline. Buckets are mutually independent, so cross-bucket
         # completion order is free.
         self._exec_pool = ThreadPoolExecutor(
-            max_workers=6, thread_name_prefix="batch-exec")
+            max_workers=max(2, self._depth),
+            thread_name_prefix="batch-exec")
         self._thread = threading.Thread(target=self._gather_loop,
                                         daemon=True)
         self._thread.start()
 
     def stop(self):
+        """Stops accepting work and drains: every queued request is
+        still executed (deadlines are void once stopping), then the
+        pools shut down after their in-flight batches finish."""
         with self._cv:
             self._stopping = True
             self._cv.notify_all()
-        self._thread.join(timeout=5)
+        self._thread.join(timeout=10)
         self._exec_pool.shutdown(wait=True)
         self._fetch_pool.shutdown(wait=True)
 
@@ -100,63 +234,163 @@ class DynamicBatcher:
         )
         pending = _Pending(inputs, params, batch, shape_key)
         with self._cv:
-            self._queue.append(pending)
+            if self._stopping:
+                raise InferenceServerException(
+                    "server is shutting down", status="UNAVAILABLE")
+            now = pending.enqueue_ns
+            if self._last_arrival_ns:
+                gap = now - self._last_arrival_ns
+                # Only intra-burst spacing feeds the EMA. A closed
+                # loop's clients all block on the in-flight batch, so
+                # each cycle shows one long idle gap; folding it in
+                # would inflate the EMA (and with it the idle cutoff)
+                # until the stall detector could never fire. The
+                # threshold is FIXED (2x the configured delay) — tying
+                # it to the adaptive window would feed back: a larger
+                # window folds larger gaps, inflating the EMA, pinning
+                # the window at delay_max.
+                if gap <= 2 * max(self._delay_ns, self._delay_min_ns):
+                    self._ia_ema_ns = (
+                        gap if self._ia_ema_ns <= 0
+                        else 0.875 * self._ia_ema_ns + 0.125 * gap)
+            self._last_arrival_ns = now
+            queue = self._buckets.get(shape_key)
+            if queue is None:
+                queue = self._buckets[shape_key] = []
+            queue.append(pending)
             self._cv.notify_all()
         pending.event.wait()
         if pending.error is not None:
             raise pending.error
         return pending.outputs, pending.queue_ns, pending.leader
 
+    # -- adaptive delay ---------------------------------------------------
+
+    def _adaptive_delay_ns(self) -> int:
+        """Gather-window size for the current arrival rate (caller
+        holds the lock). Sized so a full preferred batch has time to
+        accumulate — but only for models that opted into adaptation
+        (set delay bounds) AND declared preferred sizes, and only when
+        arrivals are frequent enough that waiting can plausibly fill
+        one. The idle-gap cutoff in _take_ready_bucket keeps the
+        stretched window from taxing bounded closed-loop traffic."""
+        ema = self._ia_ema_ns
+        if not self._adaptive or not self._preferred \
+                or self._preferred[-1] <= 1 or ema <= 0:
+            delay = self._delay_ns
+            return int(min(max(delay, self._delay_min_ns),
+                           self._delay_max_ns))
+        target = ema * (self._preferred[-1] - 1)
+        target = min(max(target, self._delay_min_ns), self._delay_max_ns)
+        # Taper toward the floor as traffic thins instead of cliffing:
+        # `g` is how many arrivals the longest allowed window can
+        # plausibly catch. At g<=2 waiting cannot form a batch (floor);
+        # at g>=4 the full target applies; linear in between, so the
+        # window doesn't oscillate when the rate hovers at a boundary.
+        g = self._delay_max_ns / ema
+        if g <= 2:
+            delay = self._delay_min_ns
+        elif g < 4:
+            delay = self._delay_min_ns + \
+                (target - self._delay_min_ns) * (g - 2) / 2
+        else:
+            delay = target
+        return int(min(max(delay, self._delay_min_ns), self._delay_max_ns))
+
+    def _idle_cutoff_ns(self, delay_ns: int) -> int:
+        """How long the arrival stream may stall before a partial
+        bucket dispatches early (caller holds the lock). Bounded-
+        concurrency closed loops stop producing once every client is
+        queued — detecting the stalled stream and dispatching beats
+        burning the rest of a window sized for traffic that cannot
+        arrive. Never below delay_min (the configured latency floor)."""
+        ema = int(self._ia_ema_ns)
+        if ema <= 0:
+            return delay_ns
+        return min(max(4 * ema, self._delay_min_ns), delay_ns)
+
     # -- gather thread ---------------------------------------------------
 
     def _gather_loop(self):
         while True:
-            bucket: List[_Pending] = []
+            bucket: Optional[List[_Pending]] = None
             with self._cv:
-                while not self._queue and not self._stopping:
-                    self._cv.wait()
-                if self._stopping and not self._queue:
-                    return
-                first = self._queue.pop(0)
-                bucket = [first]
-                total = first.batch
-                deadline = first.enqueue_ns + self._delay_ns
-                # Gather shape-compatible requests until the batch is
-                # full or the first request's delay budget expires.
-                while total < self._max_batch:
-                    if self._take_compatible(bucket, first.shape_key,
-                                             total):
-                        total = sum(p.batch for p in bucket)
-                        if self._at_preferred(total):
-                            break
+                while bucket is None:
+                    if self._stopping and not self._buckets:
+                        return
+                    if self._inflight >= self._depth:
+                        # Pipeline full: woken by a batch completion.
+                        self._cv.wait()
                         continue
                     now = time.monotonic_ns()
-                    if now >= deadline or self._stopping:
+                    bucket, wake_ns = self._take_ready_bucket(now)
+                    if bucket is not None:
                         break
-                    self._cv.wait(
-                        timeout=(deadline - now) / 1e9)
+                    if not self._buckets:
+                        self._cv.wait()
+                    else:
+                        self._cv.wait(
+                            timeout=max(wake_ns - now, 0) / 1e9)
+                self._inflight += 1
             try:
                 self._exec_pool.submit(self._execute, bucket)
             except RuntimeError:  # pool shut down mid-stop
                 self._execute(bucket)
 
-    def _take_compatible(self, bucket, shape_key, total) -> bool:
-        """Moves the next compatible queued request into the bucket
-        (caller holds the lock). Returns False when none fits."""
-        for i, pending in enumerate(self._queue):
-            if pending.shape_key != shape_key:
+    def _take_ready_bucket(self, now: int):
+        """Pops and returns the ready bucket with the OLDEST head
+        request (full to the largest preferred size / max batch, past
+        its adaptive deadline, past the idle-gap cutoff, or draining
+        on stop); otherwise (None, earliest_wake_ns). Oldest-head
+        order keeps a flooded shape from starving a rare shape whose
+        deadline expired while the flood's queue stayed permanently
+        full. Caller holds the lock."""
+        self._cur_delay_ns = delay = self._adaptive_delay_ns()
+        full_at = self._preferred[-1] if self._preferred else self._max_batch
+        # Arrival stream stalled (bounded closed loop fully queued):
+        # partial buckets dispatch now instead of waiting out a window
+        # sized for arrivals that cannot come.
+        stalled = (self._last_arrival_ns > 0 and
+                   now - self._last_arrival_ns >= self._idle_cutoff_ns(delay))
+        ready_key = None
+        ready_take = 0
+        ready_head = None
+        earliest: Optional[int] = None
+        for shape_key, queue in self._buckets.items():
+            take = 0
+            total = 0
+            for pending in queue:
+                if total + pending.batch > self._max_batch:
+                    break
+                total += pending.batch
+                take += 1
+                if total >= full_at:
+                    break
+            if take == 0:
+                # Head request alone exceeds max_batch capacity only
+                # when batch > max_batch (validated upstream) — run it
+                # alone rather than wedge the queue.
+                take = 1
+            head_ns = queue[0].enqueue_ns
+            deadline = head_ns + delay
+            if (total >= full_at or now >= deadline or stalled
+                    or self._stopping):
+                if ready_head is None or head_ns < ready_head:
+                    ready_key, ready_take, ready_head = \
+                        shape_key, take, head_ns
                 continue
-            if total + pending.batch > self._max_batch:
-                continue
-            bucket.append(self._queue.pop(i))
-            return True
-        return False
-
-    def _at_preferred(self, total) -> bool:
-        # Stop gathering only once the LARGEST preferred size is
-        # reached — smaller preferred sizes are padding targets, not
-        # gather limits.
-        return bool(self._preferred) and total >= self._preferred[-1]
+            wake = min(deadline,
+                       self._last_arrival_ns + self._idle_cutoff_ns(delay))
+            if earliest is None or wake < earliest:
+                earliest = wake
+        if ready_key is not None:
+            queue = self._buckets[ready_key]
+            bucket = queue[:ready_take]
+            del queue[:ready_take]
+            if not queue:
+                del self._buckets[ready_key]
+            return bucket, None
+        return None, earliest
 
     def _padded_size(self, total: int) -> int:
         """Rounds the fused batch up to a stable compile shape: the
@@ -173,55 +407,98 @@ class DynamicBatcher:
             size <<= 1
         return min(size, self._max_batch)
 
+    # -- execution stage (exec pool) --------------------------------------
+
     def _execute(self, bucket: List[_Pending]):
         start_ns = time.monotonic_ns()
         bucket[0].leader = True
         for pending in bucket:
             pending.queue_ns = start_ns - pending.enqueue_ns
-        done_inline = True
         try:
             total = sum(p.batch for p in bucket)
             target = self._padded_size(total)
-            if len(bucket) == 1 and bucket[0].batch == target:
-                bucket[0].outputs = self._model.infer(
-                    bucket[0].inputs, bucket[0].params)
-            else:
-                fused = {
-                    name: _fuse_chunks(
-                        [p.inputs[name] for p in bucket], target, total)
-                    for name in bucket[0].inputs
-                }
-                outputs = self._model.infer(fused, bucket[0].params)
-                if all(
-                    isinstance(p.inputs[name], np.ndarray)
-                    for p in bucket for name in p.inputs
-                ):
-                    # Every request arrived over the wire and will be
-                    # serialized to host bytes anyway: fetch the fused
-                    # output ONCE (one relay round-trip for the whole
-                    # bucket, not n slice transfers) — and do it on the
-                    # fetch pool so the gather thread can dispatch the
-                    # NEXT bucket while this transfer is in flight.
-                    for array in outputs.values():
-                        if hasattr(array, "copy_to_host_async"):
-                            array.copy_to_host_async()
-                    try:
-                        self._fetch_pool.submit(
-                            self._finish_host_bucket, bucket, outputs)
-                        done_inline = False
-                    except RuntimeError:  # pool shut down mid-stop:
-                        self._finish_host_bucket(bucket, outputs)
-                        return
+            passthrough = len(bucket) == 1 and bucket[0].batch == target
+            self._tracker.enter_compute()
+            try:
+                if passthrough:
+                    outputs = self._model.infer(
+                        bucket[0].inputs, bucket[0].params)
                 else:
-                    # Device-resident bucket (TPU-shm path): slices are
-                    # lazy device views; outputs stay in HBM end-to-end.
-                    self._scatter(bucket, outputs)
+                    fused = {
+                        name: _fuse_chunks(
+                            [p.inputs[name] for p in bucket], target, total)
+                        for name in bucket[0].inputs
+                    }
+                    outputs = self._model.infer(fused, bucket[0].params)
+            finally:
+                self._tracker.exit_compute()
+            compute_ns = time.monotonic_ns() - start_ns
+            if passthrough:
+                bucket[0].outputs = outputs
+                self._finish(bucket, target, compute_ns, 0)
+                return
+            if all(
+                isinstance(p.inputs[name], np.ndarray)
+                for p in bucket for name in p.inputs
+            ):
+                # Every request arrived over the wire and will be
+                # serialized to host bytes anyway: fetch the fused
+                # output ONCE (one relay round-trip for the whole
+                # bucket, not n slice transfers) — and do it on the
+                # fetch pool so this exec worker (and the gather
+                # thread) can dispatch the NEXT bucket while this
+                # transfer is in flight.
+                for array in outputs.values():
+                    if hasattr(array, "copy_to_host_async"):
+                        array.copy_to_host_async()
+                try:
+                    self._fetch_pool.submit(
+                        self._finish_host_bucket, bucket, outputs,
+                        target, compute_ns)
+                except RuntimeError:  # pool shut down mid-stop
+                    self._finish_host_bucket(bucket, outputs, target,
+                                             compute_ns)
+            else:
+                # Device-resident bucket (TPU-shm path): slices are
+                # lazy device views; outputs stay in HBM end-to-end.
+                self._scatter(bucket, outputs)
+                self._finish(bucket, target, compute_ns, 0)
         except Exception as e:
             self._assign_error(bucket, e)
-        finally:
-            if done_inline:
-                for pending in bucket:
-                    pending.event.set()
+            self._finish(bucket, 0, 0, 0, ok=False)
+
+    # -- fetch stage (fetch pool) -----------------------------------------
+
+    def _finish_host_bucket(self, bucket: List[_Pending], outputs,
+                            target: int, compute_ns: int) -> None:
+        fetch_start = time.monotonic_ns()
+        self._tracker.enter_fetch()
+        try:
+            host = {name: np.asarray(a) for name, a in outputs.items()}
+            self._scatter(bucket, host)
+        except Exception as e:  # noqa: BLE001 — waiters must wake
+            self._assign_error(bucket, e)
+            self._tracker.exit_fetch()
+            self._finish(bucket, 0, 0, 0, ok=False)
+            return
+        self._tracker.exit_fetch()
+        self._finish(bucket, target, compute_ns,
+                     time.monotonic_ns() - fetch_start)
+
+    def _finish(self, bucket: List[_Pending], executed: int,
+                compute_ns: int, fetch_ns: int, ok: bool = True) -> None:
+        """Completion for one fused batch: wake the waiters, record the
+        execution, release the pipeline slot."""
+        for pending in bucket:
+            pending.event.set()
+        if ok and self._stats_hook is not None:
+            try:
+                self._stats_hook(executed, compute_ns, fetch_ns)
+            except Exception:  # noqa: BLE001 — stats never fail serving
+                pass
+        with self._cv:
+            self._inflight -= 1
+            self._cv.notify_all()
 
     @staticmethod
     def _scatter(bucket: List[_Pending], outputs) -> None:
@@ -233,16 +510,6 @@ class DynamicBatcher:
             }
             offset += pending.batch
 
-    def _finish_host_bucket(self, bucket: List[_Pending], outputs) -> None:
-        try:
-            host = {name: np.asarray(a) for name, a in outputs.items()}
-            self._scatter(bucket, host)
-        except Exception as e:  # noqa: BLE001 — waiters must wake
-            self._assign_error(bucket, e)
-        finally:
-            for pending in bucket:
-                pending.event.set()
-
     @staticmethod
     def _assign_error(bucket: List[_Pending], e: Exception) -> None:
         error = e if isinstance(e, InferenceServerException) else \
@@ -250,6 +517,26 @@ class DynamicBatcher:
                 "batched inference failed: %s" % e, status="INTERNAL")
         for pending in bucket:
             pending.error = error
+
+    # -- observability ----------------------------------------------------
+
+    def stats_snapshot(self) -> dict:
+        """Point-in-time pipeline gauges plus cumulative compute/fetch
+        overlap counters (the statistics endpoints' pipeline_stats)."""
+        with self._cv:
+            pending = sum(len(q) for q in self._buckets.values())
+            inflight = self._inflight
+            delay_us = self._cur_delay_ns // NANOS_PER_US
+        compute_ns, fetch_ns, overlap_ns = self._tracker.snapshot()
+        return {
+            "pending_count": pending,
+            "inflight_count": inflight,
+            "queue_delay_us": delay_us,
+            "compute_ns": compute_ns,
+            "fetch_ns": fetch_ns,
+            "overlap_ns": overlap_ns,
+            "overlap_ratio": (overlap_ns / fetch_ns) if fetch_ns else 0.0,
+        }
 
 
 def _fuse_chunks(chunks, target: int, total: int):

@@ -63,6 +63,10 @@ class _ModelStats:
         self.compute_infer_ns = 0
         self.compute_output_ns = 0
         self.last_inference_ms = 0
+        # Fused-batch-size histogram fed by the dynamic batcher's
+        # stats hook: executed batch size -> [executions, compute_ns,
+        # fetch_ns] (renders as ModelStatistics.batch_stats).
+        self.batch_hist: Dict[int, list] = {}
 
     def record(self, batch: int, queue_ns: int, ci_ns: int, infer_ns: int,
                co_ns: int, ok: bool, executions: int = 1):
@@ -81,6 +85,16 @@ class _ModelStats:
                 self.fail_count += 1
                 self.fail_ns += total
             self.last_inference_ms = int(time.time() * 1000)
+
+    def record_batch(self, size: int, compute_ns: int, fetch_ns: int):
+        """Dynamic-batcher stats hook: one fused execution at `size`."""
+        if size <= 0:
+            return
+        with self.lock:
+            entry = self.batch_hist.setdefault(size, [0, 0, 0])
+            entry[0] += 1
+            entry[1] += compute_ns
+            entry[2] += fetch_ns
 
 
 def stream_error_response(request, message):
@@ -179,6 +193,25 @@ class InferenceServerCore:
                 stat.inference_stats.compute_infer.ns = s.compute_infer_ns
                 stat.inference_stats.compute_output.count = s.success_count
                 stat.inference_stats.compute_output.ns = s.compute_output_ns
+                for size in sorted(s.batch_hist):
+                    count, compute_ns, fetch_ns = s.batch_hist[size]
+                    row = stat.batch_stats.add(batch_size=size)
+                    row.compute_infer.count = count
+                    row.compute_infer.ns = compute_ns
+                    row.compute_output.count = count
+                    row.compute_output.ns = fetch_ns
+            with self._batchers_lock:
+                batcher = self._batchers.get(model.name)
+            if batcher is not None:
+                snap = batcher.stats_snapshot()
+                pipe = stat.pipeline_stats
+                pipe.pending_count = snap["pending_count"]
+                pipe.inflight_count = snap["inflight_count"]
+                pipe.queue_delay_us = snap["queue_delay_us"]
+                pipe.compute_ns = snap["compute_ns"]
+                pipe.fetch_ns = snap["fetch_ns"]
+                pipe.overlap_ns = snap["overlap_ns"]
+                pipe.overlap_ratio = snap["overlap_ratio"]
         return response
 
     def metrics_text(self) -> str:
@@ -195,6 +228,7 @@ class InferenceServerCore:
             lines.extend(rows)
 
         success, failure, count, exec_count, duration = [], [], [], [], []
+        fused_hist = []
         with self._stats_lock:
             stats_snapshot = dict(self._stats)
         for name, s in sorted(stats_snapshot.items()):
@@ -210,6 +244,10 @@ class InferenceServerCore:
                                   % (label, s.execution_count))
                 duration.append("nv_inference_request_duration_us%s %d"
                                 % (label, (s.success_ns + s.fail_ns) // 1000))
+                for size in sorted(s.batch_hist):
+                    fused_hist.append(
+                        'tpu_batch_fused_total{model="%s",size="%d"} %d'
+                        % (name, size, s.batch_hist[size][0]))
         family("nv_inference_request_success", "counter",
                "Number of successful inference requests", success)
         family("nv_inference_request_failure", "counter",
@@ -220,6 +258,38 @@ class InferenceServerCore:
                "Number of model executions performed", exec_count)
         family("nv_inference_request_duration_us", "counter",
                "Cumulative inference request duration", duration)
+        family("tpu_batch_fused_total", "counter",
+               "Fused executions per executed batch size", fused_hist)
+
+        pending_rows, inflight_rows, delay_rows, overlap_rows = \
+            [], [], [], []
+        with self._batchers_lock:
+            batchers_snapshot = dict(self._batchers)
+        for name, batcher in sorted(batchers_snapshot.items()):
+            try:
+                snap = batcher.stats_snapshot()
+            except Exception:  # noqa: BLE001 — metrics never take
+                continue  # the server down
+            label = '{model="%s"}' % name
+            pending_rows.append("tpu_batch_pending_depth%s %d"
+                                % (label, snap["pending_count"]))
+            inflight_rows.append("tpu_batch_inflight%s %d"
+                                 % (label, snap["inflight_count"]))
+            delay_rows.append("tpu_batch_queue_delay_us%s %d"
+                              % (label, snap["queue_delay_us"]))
+            overlap_rows.append("tpu_batch_overlap_ratio%s %.6f"
+                                % (label, snap["overlap_ratio"]))
+        family("tpu_batch_pending_depth", "gauge",
+               "Requests waiting in the dynamic batcher's bucket queues",
+               pending_rows)
+        family("tpu_batch_inflight", "gauge",
+               "Fused batches currently in the compute/fetch pipeline",
+               inflight_rows)
+        family("tpu_batch_queue_delay_us", "gauge",
+               "Current adaptive max queue delay", delay_rows)
+        family("tpu_batch_overlap_ratio", "gauge",
+               "Fraction of output-fetch time with other batches' "
+               "compute or fetch in flight", overlap_rows)
 
         used_rows, total_rows, util_rows = [], [], []
         try:
@@ -418,6 +488,13 @@ class InferenceServerCore:
                         getattr(model, "max_queue_delay_us", 500)),
                     preferred_batch_sizes=list(
                         getattr(model, "preferred_batch_sizes", []) or []),
+                    delay_min_us=int(getattr(model, "delay_min_us", 0)),
+                    delay_max_us=int(getattr(model, "delay_max_us", 0)),
+                    pipeline_depth=int(
+                        getattr(model, "pipeline_depth", 0)),
+                    fetch_workers=int(
+                        getattr(model, "fetch_pool_workers", 0)),
+                    stats_hook=self._stats_for(model.name).record_batch,
                 )
                 self._batchers[model.name] = batcher
             return batcher

@@ -56,6 +56,17 @@ class ServedModel:
     dynamic_batching: bool = False
     preferred_batch_sizes: list = []
     max_queue_delay_us: int = 500
+    # Adaptive gather-window bounds: the batcher sizes the queue delay
+    # from the observed inter-arrival rate, clamped to
+    # [delay_min_us, delay_max_us]. 0 = derive from max_queue_delay_us
+    # (min = the configured delay, max = 16x it).
+    delay_min_us: int = 0
+    delay_max_us: int = 0
+    # Compute/fetch pipeline: max fused batches in flight at once
+    # (0 = batcher default) and the device->host fetch pool size
+    # (0 = sized from pipeline depth).
+    pipeline_depth: int = 0
+    fetch_pool_workers: int = 0
 
     def __init__(self):
         self.inputs: List[TensorSpec] = []

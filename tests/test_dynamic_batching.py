@@ -258,3 +258,380 @@ def test_e2e_server_fuses_and_reports_queue_time():
         assert stats.inference_stats.queue.ns > 0
     finally:
         handle.stop()
+
+
+# -- pipelined batcher -----------------------------------------------------
+
+
+def test_per_shape_bucket_queues_fuse_interleaved_shapes():
+    """Interleaved arrivals of two shapes must not fragment either
+    shape's bucket: each shape accumulates in its own queue and fuses
+    into one execution."""
+
+    class VarModel(CountingModel):
+        def __init__(self):
+            super().__init__()
+            self.inputs = [TensorSpec("IN", "FP32", [-1])]
+
+    model = VarModel()
+    model.gate.clear()
+    batcher = DynamicBatcher(model, max_queue_delay_us=150000)
+    errors = []
+
+    def one(width, value):
+        try:
+            data = np.full((1, width), value, dtype=np.float32)
+            outputs, _, _ = batcher.infer({"IN": data}, {}, 1)
+            np.testing.assert_array_equal(outputs["OUT"], data * 2.0)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    # a,b,a,b,a,b interleaving
+    widths = [4, 8, 4, 8, 4, 8]
+    threads = []
+    for i, width in enumerate(widths):
+        t = threading.Thread(target=one, args=(width, float(i)))
+        t.start()
+        threads.append(t)
+        import time
+
+        time.sleep(0.01)
+    time.sleep(0.1)
+    model.gate.set()
+    for t in threads:
+        t.join(timeout=10)
+    batcher.stop()
+    assert not errors, errors[0]
+    # one fused execution per shape, not one per shape *change*
+    assert len(model.executions) == 2, model.executions
+
+
+def test_adaptive_delay_bounds():
+    """Deterministic bound checks (integer-us EMAs only)."""
+    model = CountingModel()
+    batcher = DynamicBatcher(
+        model, max_queue_delay_us=1000, preferred_batch_sizes=[8],
+        delay_min_us=500, delay_max_us=20000)
+    try:
+        def delay_us_for(ema_us):
+            with batcher._cv:
+                batcher._ia_ema_ns = ema_us * 1000
+                return batcher._adaptive_delay_ns() / 1000
+
+        assert delay_us_for(100) == 700      # 100us * (8-1)
+        assert delay_us_for(1000) == 7000    # proportional
+        assert delay_us_for(1) == 500        # floored at delay_min
+        assert delay_us_for(5000) == 20000   # capped at delay_max
+        assert delay_us_for(15000) == 500    # sparse -> floor
+    finally:
+        batcher.stop()
+    # no preferred sizes -> no adaptation, configured delay as-is
+    plain = DynamicBatcher(CountingModel(), max_queue_delay_us=1000)
+    try:
+        with plain._cv:
+            plain._ia_ema_ns = 100 * 1000
+            assert plain._adaptive_delay_ns() == 1000 * 1000
+    finally:
+        plain.stop()
+
+
+def test_stalled_stream_dispatches_partial_bucket():
+    """A bounded closed loop stops producing once every client is
+    queued; the idle-gap cutoff must dispatch the partial bucket
+    instead of waiting out the adaptive window sized for preferred-64
+    traffic."""
+    import time
+
+    class WideModel(CountingModel):
+        max_batch_size = 64
+        preferred_batch_sizes = [64]
+
+    model = WideModel()
+    batcher = DynamicBatcher(model, max_queue_delay_us=5000,
+                             delay_max_us=500000)
+    results, errors = [], []
+
+    def one(i):
+        try:
+            data = np.full((1, 4), float(i), dtype=np.float32)
+            outputs, _, _ = batcher.infer({"IN": data}, {}, 1)
+            results.append(np.asarray(outputs["OUT"]))
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+        time.sleep(0.001)  # a live EMA (~1ms), then the stream stalls
+    for t in threads:
+        t.join(timeout=30)
+    elapsed = time.monotonic() - t0
+    batcher.stop()
+    assert not errors, errors[0]
+    assert len(results) == 4
+    # adaptive target would be ~1ms * 63 = 63ms; the idle-gap cutoff
+    # (~4-5ms after the last arrival) must beat it by a wide margin
+    assert elapsed < 0.05, "stalled stream waited out the full window"
+
+
+class _SlowFetchArray:
+    """Array-like whose host materialization (np.asarray) takes
+    `delay_s` — a stand-in for the device->host relay fetch."""
+
+    def __init__(self, data, delay_s):
+        self._data = data
+        self._delay_s = delay_s
+        self.shape = data.shape
+        self.dtype = data.dtype
+
+    def __array__(self, dtype=None, copy=None):
+        import time
+
+        time.sleep(self._delay_s)
+        return self._data
+
+
+def test_pipeline_overlaps_compute_with_fetch():
+    """>=2 fused batches genuinely in flight: batch N+1's device
+    compute runs while batch N's output fetch is still in progress,
+    and the tracker records the overlap."""
+    import time
+
+    class SlowFetchModel(CountingModel):
+        def infer(self, inputs, parameters=None):
+            array = np.asarray(inputs["IN"])
+            self.executions.append(array.shape[0])
+            time.sleep(0.05)  # device compute
+            return {"OUT": _SlowFetchArray(array * 2.0, 0.25)}
+
+    model = SlowFetchModel()
+    batcher = DynamicBatcher(model, max_queue_delay_us=20000,
+                             pipeline_depth=4)
+    errors, results = [], {}
+
+    def one(i):
+        try:
+            data = np.full((1, 4), float(i), dtype=np.float32)
+            outputs, _, _ = batcher.infer({"IN": data}, {}, 1)
+            results[i] = np.asarray(outputs["OUT"])
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    # Two waves far enough apart to land in different buckets, close
+    # enough that wave 1's fetch (250 ms) is still in flight when wave
+    # 2's compute dispatches.
+    threads = []
+    for i in (0, 1):
+        t = threading.Thread(target=one, args=(i,))
+        t.start()
+        threads.append(t)
+    time.sleep(0.12)  # wave 1 dispatched (compute 50ms done, fetching)
+    for i in (2, 3):
+        t = threading.Thread(target=one, args=(i,))
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(timeout=20)
+    snap = batcher.stats_snapshot()
+    batcher.stop()
+    assert not errors, errors[0]
+    assert len(model.executions) == 2, model.executions
+    for i in range(4):
+        np.testing.assert_array_equal(
+            results[i], np.full((1, 4), i * 2.0, dtype=np.float32))
+    assert snap["fetch_ns"] > 0
+    # wave 2's 50ms compute must have landed inside wave 1's 250ms fetch
+    assert snap["overlap_ns"] > 0, snap
+    assert snap["overlap_ratio"] > 0.0
+
+
+def test_error_in_batch_does_not_poison_next_batch():
+    """A failing fused batch propagates its error to exactly its own
+    requests; the next batch through the pipeline is unaffected."""
+
+    class SelectivelyFailingModel(CountingModel):
+        def infer(self, inputs, parameters=None):
+            self.gate.wait()
+            array = np.asarray(inputs["IN"])
+            self.executions.append(array.shape[0])
+            if float(array[0, 0]) < 0:
+                raise InferenceServerException("boom", status="INTERNAL")
+            return {"OUT": array * 2.0}
+
+    model = SelectivelyFailingModel()
+    model.inputs = [TensorSpec("IN", "FP32", [-1])]
+    model.gate.clear()
+    batcher = DynamicBatcher(model, max_queue_delay_us=100000)
+    outcomes = {}
+
+    def one(key, width, value):
+        data = np.full((1, width), value, dtype=np.float32)
+        try:
+            outputs, _, _ = batcher.infer({"IN": data}, {}, 1)
+            outcomes[key] = np.asarray(outputs["OUT"])
+        except InferenceServerException as e:
+            outcomes[key] = e
+
+    # widths differ -> two buckets; the width-4 bucket fails
+    threads = [
+        threading.Thread(target=one, args=("bad0", 4, -1.0)),
+        threading.Thread(target=one, args=("bad1", 4, -1.0)),
+        threading.Thread(target=one, args=("good0", 8, 3.0)),
+        threading.Thread(target=one, args=("good1", 8, 3.0)),
+    ]
+    for t in threads:
+        t.start()
+    import time
+
+    time.sleep(0.1)
+    model.gate.set()
+    for t in threads:
+        t.join(timeout=10)
+    batcher.stop()
+    assert isinstance(outcomes["bad0"], InferenceServerException)
+    assert isinstance(outcomes["bad1"], InferenceServerException)
+    for key in ("good0", "good1"):
+        np.testing.assert_array_equal(
+            outcomes[key], np.full((1, 8), 6.0, dtype=np.float32))
+
+
+def test_drain_on_shutdown_executes_queued_requests():
+    """stop() must drain: requests still waiting out their gather
+    window execute immediately (deadlines void) instead of being
+    dropped or stranded."""
+    model = CountingModel()
+    # 10s window: without the drain these would still be queued when
+    # the test times out below.
+    batcher = DynamicBatcher(model, max_queue_delay_us=10_000_000)
+    results, errors = [], []
+
+    def one(i):
+        try:
+            data = np.full((1, 4), float(i), dtype=np.float32)
+            outputs, _, _ = batcher.infer({"IN": data}, {}, 1)
+            results.append(np.asarray(outputs["OUT"]))
+        except Exception as e:
+            errors.append(e)
+
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    import time
+
+    time.sleep(0.1)  # all three queued, none near its 10s deadline
+    t0 = time.monotonic()
+    batcher.stop()
+    for t in threads:
+        t.join(timeout=10)
+    elapsed = time.monotonic() - t0
+    assert not errors, errors[0]
+    assert len(results) == 3
+    assert elapsed < 5.0, "drain waited out the gather window"
+    assert sum(model.executions) >= 3
+
+
+def test_fetch_pool_sizing_configurable():
+    """The fetch pool honours an explicit worker count and otherwise
+    sizes itself from the pipeline depth."""
+    model = CountingModel()
+    b1 = DynamicBatcher(model, fetch_workers=7)
+    b2 = DynamicBatcher(model, pipeline_depth=6)
+    b3 = DynamicBatcher(model)
+    try:
+        assert b1._fetch_workers == 7
+        assert b2._fetch_workers == 6
+        assert b3._fetch_workers == max(2, b3._depth)
+    finally:
+        b1.stop()
+        b2.stop()
+        b3.stop()
+
+
+def test_statistics_expose_histogram_and_pipeline():
+    """The server statistics carry the fused-batch-size histogram
+    (batch_stats) and the pipeline gauges/overlap (pipeline_stats),
+    over both front-end surfaces and /metrics."""
+    from client_tpu.server.app import build_core, start_grpc_server
+    import client_tpu.grpc as grpcclient
+
+    core = build_core([])
+    model = CountingModel(delay_s=0.005)
+    core.repository.add_model(model)
+    handle = start_grpc_server(core=core)
+    try:
+        def worker():
+            with grpcclient.InferenceServerClient(handle.address) as client:
+                inputs = [grpcclient.InferInput("IN", [1, 4], "FP32")]
+                inputs[0].set_data_from_numpy(
+                    np.ones((1, 4), dtype=np.float32))
+                for _ in range(8):
+                    client.infer("counting", inputs)
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+
+        stats = core.model_statistics("counting").model_stats[0]
+        hist = {int(r.batch_size): int(r.compute_infer.count)
+                for r in stats.batch_stats}
+        assert hist, "no fused-batch histogram recorded"
+        assert sum(hist.values()) == stats.execution_count
+        assert stats.pipeline_stats.queue_delay_us > 0
+        assert stats.pipeline_stats.compute_ns > 0
+
+        # gRPC front-end: same proto rides through ModelStatistics
+        with grpcclient.InferenceServerClient(handle.address) as client:
+            wire = client.get_inference_statistics("counting")
+            entry = wire.model_stats[0]
+            assert [int(r.batch_size) for r in entry.batch_stats]
+            assert entry.pipeline_stats.queue_delay_us > 0
+
+        # Prometheus: histogram + gauges scrape-able
+        text = core.metrics_text()
+        assert "tpu_batch_fused_total" in text
+        assert 'tpu_batch_pending_depth{model="counting"}' in text
+        assert 'tpu_batch_overlap_ratio{model="counting"}' in text
+    finally:
+        handle.stop()
+
+
+def test_statistics_over_http_endpoint():
+    """The HTTP /v2/models/{m}/stats surface carries the new fields."""
+    from client_tpu.server.app import build_core
+    from client_tpu.server.http_server import start_http_server_thread
+    import client_tpu.http as httpclient
+
+    core = build_core([])
+    model = CountingModel(delay_s=0.002)
+    core.repository.add_model(model)
+    server = start_http_server_thread(core, host="127.0.0.1", port=0)
+    try:
+        address = "127.0.0.1:%d" % server.port
+
+        def worker():
+            with httpclient.InferenceServerClient(address) as client:
+                inputs = [httpclient.InferInput("IN", [1, 4], "FP32")]
+                inputs[0].set_data_from_numpy(
+                    np.ones((1, 4), dtype=np.float32))
+                for _ in range(6):
+                    client.infer("counting", inputs)
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+
+        with httpclient.InferenceServerClient(address) as client:
+            stats = client.get_inference_statistics("counting")
+        entry = stats["model_stats"][0]
+        assert entry.get("batch_stats"), entry
+        pipe = entry.get("pipeline_stats", {})
+        assert int(pipe.get("queue_delay_us", 0)) > 0
+        assert int(pipe.get("compute_ns", 0)) > 0
+    finally:
+        server.stop()
+        core.shutdown()

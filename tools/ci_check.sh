@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Tier-1 gate: runs the ROADMAP.md tier-1 pytest command and fails if
+# the passed-test count (DOTS_PASSED) drops below the recorded seed
+# floor. Usage: tools/ci_check.sh [min_passed]
+set -u -o pipefail
+
+MIN_PASSED="${1:-290}"
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+LOG=/tmp/_t1.log
+
+cd "$REPO"
+rm -f "$LOG"
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee "$LOG"
+rc=${PIPESTATUS[0]}
+
+passed=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)
+echo "DOTS_PASSED=$passed (floor: $MIN_PASSED, pytest rc: $rc)"
+
+if [ "$passed" -lt "$MIN_PASSED" ]; then
+    echo "FAIL: passed-test count $passed dropped below the seed floor $MIN_PASSED" >&2
+    exit 1
+fi
+echo "OK: tier-1 no worse than seed"
+exit 0

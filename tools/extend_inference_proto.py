@@ -1,0 +1,102 @@
+"""Regenerate the BatchPipelineStatistics additions in inference_pb2.py.
+
+The container image carries no protoc / grpcio-tools, so proto schema
+changes are applied by editing the serialized FileDescriptorProto that
+``inference_pb2.py`` embeds: parse it with ``descriptor_pb2``, add the
+new message + field, re-serialize, and rewrite the ``AddSerializedFile``
+bytes literal in place.  Idempotent — running it again on an already
+patched file is a no-op.
+
+The ``_serialized_start/_serialized_end`` attribute lines at the bottom
+of the pb2 module go stale after the patch; they only execute when
+``_USE_C_DESCRIPTORS`` is False, which is never the case on the upb
+runtime this image ships (protobuf >= 4), so they are left untouched.
+
+Usage: python tools/extend_inference_proto.py
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+import sys
+
+from google.protobuf import descriptor_pb2
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+PB2_PATH = REPO / "client_tpu" / "protocol" / "inference_pb2.py"
+
+U64 = descriptor_pb2.FieldDescriptorProto.TYPE_UINT64
+DOUBLE = descriptor_pb2.FieldDescriptorProto.TYPE_DOUBLE
+MESSAGE = descriptor_pb2.FieldDescriptorProto.TYPE_MESSAGE
+OPTIONAL = descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL
+
+# (name, number, type) — keep in sync with inference.proto.
+PIPELINE_FIELDS = [
+    ("pending_count", 1, U64),
+    ("inflight_count", 2, U64),
+    ("queue_delay_us", 3, U64),
+    ("compute_ns", 4, U64),
+    ("fetch_ns", 5, U64),
+    ("overlap_ns", 6, U64),
+    ("overlap_ratio", 7, DOUBLE),
+]
+
+
+def extract_serialized(source: str) -> bytes:
+    match = re.search(r"AddSerializedFile\((b'.*')\)", source)
+    if not match:
+        raise SystemExit("no AddSerializedFile literal found in %s" % PB2_PATH)
+    return eval(match.group(1))  # noqa: S307 — a bytes literal we just matched
+
+
+def patch(file_proto: descriptor_pb2.FileDescriptorProto) -> bool:
+    names = [m.name for m in file_proto.message_type]
+    changed = False
+    if "BatchPipelineStatistics" not in names:
+        # Insert right after InferBatchStatistics (placement is
+        # cosmetic; descriptor resolution is order-independent).
+        anchor = names.index("InferBatchStatistics") + 1
+        message = descriptor_pb2.DescriptorProto(name="BatchPipelineStatistics")
+        for name, number, ftype in PIPELINE_FIELDS:
+            message.field.add(name=name, number=number, type=ftype,
+                              label=OPTIONAL, json_name=_json_name(name))
+        file_proto.message_type.insert(anchor, message)
+        changed = True
+    model_stats = next(
+        m for m in file_proto.message_type if m.name == "ModelStatistics")
+    if not any(f.name == "pipeline_stats" for f in model_stats.field):
+        model_stats.field.add(
+            name="pipeline_stats", number=8, type=MESSAGE, label=OPTIONAL,
+            type_name=".inference.BatchPipelineStatistics",
+            json_name="pipelineStats")
+        changed = True
+    return changed
+
+
+def _json_name(snake: str) -> str:
+    head, *rest = snake.split("_")
+    return head + "".join(part.capitalize() for part in rest)
+
+
+def main() -> None:
+    source = PB2_PATH.read_text()
+    file_proto = descriptor_pb2.FileDescriptorProto()
+    file_proto.ParseFromString(extract_serialized(source))
+    if not patch(file_proto):
+        print("inference_pb2.py already patched; nothing to do")
+        return
+    new_literal = repr(file_proto.SerializeToString())
+    assert new_literal.startswith("b'") and new_literal.endswith("'")
+    new_source = re.sub(
+        r"AddSerializedFile\(b'.*'\)",
+        lambda _: "AddSerializedFile(%s)" % new_literal,
+        source,
+    )
+    PB2_PATH.write_text(new_source)
+    print("patched %s (+BatchPipelineStatistics, "
+          "+ModelStatistics.pipeline_stats)" % PB2_PATH)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
