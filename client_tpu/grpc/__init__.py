@@ -13,4 +13,5 @@ from client_tpu.grpc._client import (  # noqa: F401
     KeepAliveOptions,
 )
 from client_tpu.grpc._utils import InferResult  # noqa: F401
+from client_tpu.robust import CircuitBreaker, RetryPolicy  # noqa: F401
 from client_tpu.utils import InferenceServerException  # noqa: F401

@@ -173,10 +173,17 @@ class InferenceServerClient(InferenceServerClientBase):
         creds: Optional[grpc.ChannelCredentials] = None,
         keepalive_options: Optional[KeepAliveOptions] = None,
         channel_args: Optional[list] = None,
+        retry_policy=None,
+        circuit_breaker=None,
     ):
         super().__init__()
         self._url = url
         self._verbose = verbose
+        # client_tpu.robust wiring: infer() retries retryable statuses
+        # (UNAVAILABLE, ...) under the policy; the breaker fails fast
+        # while open. Both default to off.
+        self._retry_policy = retry_policy
+        self._breaker = circuit_breaker
         options = list(_DEFAULT_CHANNEL_OPTIONS)
         if keepalive_options is not None:
             options += keepalive_options.channel_args()
@@ -553,16 +560,29 @@ class InferenceServerClient(InferenceServerClientBase):
             timeout=timeout,
             parameters=parameters,
         )
-        try:
-            response = self._client_stub.ModelInfer(
-                request,
-                metadata=self._metadata(headers),
-                timeout=client_timeout,
-                compression=_grpc_compression(compression_algorithm),
-            )
-            return InferResult(response)
-        except grpc.RpcError as e:
-            raise_error_grpc(e)
+        metadata = self._metadata(headers)
+        compression = _grpc_compression(compression_algorithm)
+
+        def _attempt(remaining: Optional[float]) -> InferResult:
+            # `remaining` is the shrinking share of client_timeout left
+            # for this attempt (None = no deadline).
+            try:
+                response = self._client_stub.ModelInfer(
+                    request,
+                    metadata=metadata,
+                    timeout=remaining,
+                    compression=compression,
+                )
+                return InferResult(response)
+            except grpc.RpcError as e:
+                raise_error_grpc(e)
+
+        from client_tpu.robust import call_with_retry
+
+        return call_with_retry(
+            _attempt, self._retry_policy, self._breaker,
+            deadline_s=client_timeout,
+        )
 
     def async_infer(
         self,

@@ -34,7 +34,10 @@ def get_error_grpc(rpc_error: grpc.RpcError) -> InferenceServerException:
 
 
 def raise_error_grpc(rpc_error: grpc.RpcError):
-    raise get_error_grpc(rpc_error) from None
+    # `from rpc_error`: keep the RpcError as __cause__ so transport
+    # failures stay debuggable end to end (the traceback shows the
+    # channel state, not just our wrapper).
+    raise get_error_grpc(rpc_error) from rpc_error
 
 
 def raise_error(msg: str):

@@ -49,8 +49,14 @@ class InferenceServerClient(InferenceServerClientBase):
         creds: Optional[grpc.ChannelCredentials] = None,
         keepalive_options: Optional[KeepAliveOptions] = None,
         channel_args: Optional[list] = None,
+        retry_policy=None,
+        circuit_breaker=None,
     ):
         super().__init__()
+        # client_tpu.robust wiring (same contract as the sync client):
+        # infer() retries retryable statuses with backoff + jitter.
+        self._retry_policy = retry_policy
+        self._breaker = circuit_breaker
         options = list(_DEFAULT_CHANNEL_OPTIONS)
         if keepalive_options is not None:
             options += keepalive_options.channel_args()
@@ -90,7 +96,9 @@ class InferenceServerClient(InferenceServerClientBase):
                 request, metadata=self._metadata(headers), timeout=client_timeout
             )
         except grpc.RpcError as rpc_error:
-            raise get_error_grpc(rpc_error) from None
+            # `from rpc_error`: preserve the transport failure as
+            # __cause__ so network errors stay debuggable.
+            raise get_error_grpc(rpc_error) from rpc_error
 
     # -- health / metadata ----------------------------------------------
 
@@ -303,10 +311,19 @@ class InferenceServerClient(InferenceServerClientBase):
             sequence_start=sequence_start, sequence_end=sequence_end,
             priority=priority, timeout=timeout, parameters=parameters,
         )
-        response = await self._call(
-            self._client_stub.ModelInfer, request, headers, client_timeout
+
+        async def _attempt(remaining):
+            response = await self._call(
+                self._client_stub.ModelInfer, request, headers, remaining
+            )
+            return InferResult(response)
+
+        from client_tpu.robust import call_with_retry_async
+
+        return await call_with_retry_async(
+            _attempt, self._retry_policy, self._breaker,
+            deadline_s=client_timeout,
         )
-        return InferResult(response)
 
     async def stream_infer(
         self,

@@ -12,4 +12,5 @@ from client_tpu.http._client import (  # noqa: F401
     InferenceServerClient,
     InferResult,
 )
+from client_tpu.robust import CircuitBreaker, RetryPolicy  # noqa: F401
 from client_tpu.utils import InferenceServerException  # noqa: F401

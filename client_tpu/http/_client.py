@@ -12,7 +12,9 @@ from __future__ import annotations
 
 import json
 import queue
+import socket
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, Optional, Sequence, Tuple
 from urllib.parse import quote, urlparse
@@ -81,11 +83,12 @@ class InferAsyncRequest:
         return result
 
 
-class _ConnectionPool:
+class _KeepAliveConnectionPool:
     """Thread-safe pool of keep-alive HTTP connections."""
 
     def __init__(self, host: str, port: int, size: int, timeout: float,
-                 ssl: bool = False, ssl_context=None):
+                 ssl: bool = False, ssl_context=None,
+                 acquire_timeout: Optional[float] = None):
         self._host = host
         self._port = port
         self._timeout = timeout
@@ -95,6 +98,12 @@ class _ConnectionPool:
         self._size = size
         self._created = 0
         self._lock = threading.Lock()
+        # Bounded wait for an idle connection once the pool is at
+        # capacity. An unbounded get() deadlocks the caller forever if
+        # a connection ever leaks (e.g. a crashed worker that never
+        # released) — fail loudly instead.
+        self._acquire_timeout = acquire_timeout if acquire_timeout \
+            else max(timeout, 1.0)
 
     def _new_connection(self):
         if self._ssl:
@@ -115,7 +124,16 @@ class _ConnectionPool:
             if self._created < self._size:
                 self._created += 1
                 return self._new_connection()
-        return self._idle.get()
+        try:
+            return self._idle.get(timeout=self._acquire_timeout)
+        except queue.Empty:
+            raise InferenceServerException(
+                "no idle connection became available within %.1fs "
+                "(pool size %d, all in use — a connection may have "
+                "leaked or every request is stuck); raise `concurrency`"
+                " or investigate hung requests"
+                % (self._acquire_timeout, self._size),
+                status="UNAVAILABLE") from None
 
     def release(self, conn, broken: bool = False):
         if broken:
@@ -135,11 +153,20 @@ class _ConnectionPool:
                 break
 
 
+# Back-compat alias (pre-robustness name).
+_ConnectionPool = _KeepAliveConnectionPool
+
+
 class InferenceServerClient(InferenceServerClientBase):
     """A client talking to a KServe-v2 HTTP/REST endpoint.
 
     ``concurrency`` sizes both the connection pool and the async
     worker pool (reference http/_client.py:178-188 semantics).
+
+    ``retry_policy`` / ``circuit_breaker``
+    (:mod:`client_tpu.robust`) make :meth:`infer` retry retryable
+    failures (503/UNAVAILABLE, connection errors) with exponential
+    backoff + full jitter, and fail fast while the breaker is open.
     """
 
     def __init__(
@@ -151,6 +178,8 @@ class InferenceServerClient(InferenceServerClientBase):
         network_timeout: float = 60.0,
         ssl: bool = False,
         ssl_context=None,
+        retry_policy=None,
+        circuit_breaker=None,
     ):
         super().__init__()
         if "://" in url:
@@ -162,11 +191,15 @@ class InferenceServerClient(InferenceServerClientBase):
         self._host = parsed.hostname
         self._port = parsed.port or (443 if ssl else 80)
         self._verbose = verbose
-        self._pool = _ConnectionPool(
+        self._default_timeout = max(connection_timeout, network_timeout)
+        self._pool = _KeepAliveConnectionPool(
             self._host, self._port, max(concurrency, 1),
-            max(connection_timeout, network_timeout), ssl, ssl_context,
+            self._default_timeout, ssl, ssl_context,
+            acquire_timeout=connection_timeout,
         )
         self._executor = ThreadPoolExecutor(max_workers=max(concurrency, 1))
+        self._retry_policy = retry_policy
+        self._breaker = circuit_breaker
         self._closed = False
 
     def __enter__(self):
@@ -195,25 +228,69 @@ class InferenceServerClient(InferenceServerClientBase):
         path: str,
         body: Optional[bytes] = None,
         headers: Optional[dict] = None,
+        timeout: Optional[float] = None,
     ) -> Tuple[int, dict, bytes]:
+        """``timeout`` caps THIS request's socket wait (per-call
+        deadline); the pool's default timeout is restored on release."""
         headers = self._call_plugin(dict(headers) if headers else {})
         conn = self._pool.acquire()
         broken = False
         try:
+            deadline = None
+            if timeout is not None:
+                deadline = time.monotonic() + timeout
+                conn.timeout = timeout
+                if conn.sock is not None:
+                    conn.sock.settimeout(timeout)
             conn.request(method, path, body=body, headers=headers or {})
             response = conn.getresponse()
-            payload = response.read()
+            if deadline is None:
+                payload = response.read()
+            else:
+                # Absolute deadline, not per-socket-op: a server that
+                # trickles one byte per (timeout) seconds would reset
+                # a plain socket timeout forever. Re-arm the socket
+                # with the REMAINING budget before every read.
+                chunks = []
+                while True:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise socket.timeout(
+                            "deadline exhausted mid-response")
+                    conn.sock.settimeout(remaining)
+                    chunk = response.read1(65536)
+                    if not chunk:
+                        break
+                    chunks.append(chunk)
+                payload = b"".join(chunks)
             resp_headers = {k.lower(): v for k, v in response.getheaders()}
             if self._verbose:
                 print("%s %s -> %d (%d bytes)"
                       % (method, path, response.status, len(payload)))
             return response.status, resp_headers, payload
+        except (TimeoutError, socket.timeout) as e:
+            # socket.timeout merged into TimeoutError only in py3.10;
+            # naming both keeps py3.9 timeouts DEADLINE_EXCEEDED
+            # instead of falling into the retryable-UNAVAILABLE branch.
+            broken = True
+            raise InferenceServerException(
+                "request to %s:%d timed out after %.3fs"
+                % (self._host, self._port,
+                   timeout if timeout is not None else
+                   self._default_timeout),
+                status="DEADLINE_EXCEEDED",
+            ) from e
         except (http.client.HTTPException, OSError) as e:
             broken = True
             raise InferenceServerException(
-                "connection to %s:%d failed: %s" % (self._host, self._port, e)
-            )
+                "connection to %s:%d failed: %s" % (self._host, self._port, e),
+                status="UNAVAILABLE",
+            ) from e
         finally:
+            if timeout is not None and not broken:
+                conn.timeout = self._default_timeout
+                if conn.sock is not None:
+                    conn.sock.settimeout(self._default_timeout)
             self._pool.release(conn, broken)
 
     def _get_json(self, path: str, headers=None, method: str = "GET",
@@ -368,6 +445,7 @@ class InferenceServerClient(InferenceServerClientBase):
         sequence_end: bool = False,
         priority: int = 0,
         timeout: Optional[int] = None,
+        client_timeout: Optional[float] = None,
         headers: Optional[dict] = None,
         query_params: Optional[dict] = None,
         parameters: Optional[dict] = None,
@@ -379,7 +457,13 @@ class InferenceServerClient(InferenceServerClientBase):
         compression ("gzip" or "deflate"; None = off), mirroring the
         reference HTTP client (http_client.cc:2130-2247). Response
         compression is a preference the server honors via
-        Accept-Encoding."""
+        Accept-Encoding.
+
+        ``client_timeout`` (seconds) bounds this call end to end —
+        gRPC-client parity. With a retry policy configured it is the
+        TOTAL budget across attempts and backoffs, each attempt
+        receiving the remainder; ``timeout`` (microseconds) remains the
+        server-side queue deadline riding in the request parameters."""
         body, json_len = encode_infer_request(
             inputs=inputs, outputs=outputs, request_id=request_id,
             sequence_id=sequence_id, sequence_start=sequence_start,
@@ -405,15 +489,26 @@ class InferenceServerClient(InferenceServerClientBase):
                 "%s=%s" % (quote(str(k)), quote(str(v)))
                 for k, v in query_params.items()
             )
-        status, resp_headers, payload = self._request(
-            "POST", path, body=body, headers=request_headers
-        )
-        payload = decompress_body(
-            payload, resp_headers.get("content-encoding"))
-        ep.raise_if_error(status, payload)
-        response_header_len = resp_headers.get(HEADER_LEN.lower())
-        return InferResult.from_response_body(
-            payload, int(response_header_len) if response_header_len else None
+
+        def _attempt(remaining: Optional[float]) -> InferResult:
+            status, resp_headers, payload = self._request(
+                "POST", path, body=body, headers=request_headers,
+                timeout=remaining,
+            )
+            payload_out = decompress_body(
+                payload, resp_headers.get("content-encoding"))
+            ep.raise_if_error(status, payload_out)
+            response_header_len = resp_headers.get(HEADER_LEN.lower())
+            return InferResult.from_response_body(
+                payload_out,
+                int(response_header_len) if response_header_len else None,
+            )
+
+        from client_tpu.robust import call_with_retry
+
+        return call_with_retry(
+            _attempt, self._retry_policy, self._breaker,
+            deadline_s=client_timeout,
         )
 
     def async_infer(self, model_name, inputs, **kwargs) -> InferAsyncRequest:

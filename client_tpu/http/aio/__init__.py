@@ -3,6 +3,7 @@
 
 from __future__ import annotations
 
+import asyncio
 import json
 from typing import Dict, Optional, Sequence
 
@@ -32,8 +33,13 @@ class InferenceServerClient(InferenceServerClientBase):
         conn_timeout: float = 60.0,
         ssl: bool = False,
         ssl_context=None,
+        retry_policy=None,
+        circuit_breaker=None,
     ):
         super().__init__()
+        # client_tpu.robust wiring (same contract as the sync client).
+        self._retry_policy = retry_policy
+        self._breaker = circuit_breaker
         base = url if "://" in url else (
             ("https://" if ssl else "http://") + url
         )
@@ -55,16 +61,26 @@ class InferenceServerClient(InferenceServerClientBase):
     async def close(self):
         await self._session.close()
 
-    async def _request(self, method: str, path: str, body=None, headers=None):
+    async def _request(self, method: str, path: str, body=None, headers=None,
+                       timeout: Optional[float] = None):
         headers = self._call_plugin(dict(headers) if headers else {})
+        kwargs = {}
+        if timeout is not None:
+            kwargs["timeout"] = aiohttp.ClientTimeout(total=timeout)
         try:
             async with self._session.request(
-                method, self._base + path, data=body, headers=headers or {}
+                method, self._base + path, data=body, headers=headers or {},
+                **kwargs
             ) as response:
                 payload = await response.read()
                 return response.status, dict(response.headers), payload
+        except asyncio.TimeoutError as e:
+            raise InferenceServerException(
+                "request timed out after %.3fs" % (timeout or 0),
+                status="DEADLINE_EXCEEDED") from e
         except aiohttp.ClientError as e:
-            raise InferenceServerException("connection failed: %s" % e)
+            raise InferenceServerException(
+                "connection failed: %s" % e, status="UNAVAILABLE") from e
 
     async def _get_json(self, path, headers=None, method="GET", body=None):
         status, _, payload = await self._request(method, path, body, headers)
@@ -197,6 +213,7 @@ class InferenceServerClient(InferenceServerClientBase):
         sequence_end: bool = False,
         priority: int = 0,
         timeout: Optional[int] = None,
+        client_timeout: Optional[float] = None,
         headers: Optional[dict] = None,
         parameters: Optional[dict] = None,
     ) -> InferResult:
@@ -212,13 +229,22 @@ class InferenceServerClient(InferenceServerClientBase):
             request_headers["Content-Type"] = "application/octet-stream"
         else:
             request_headers["Content-Type"] = "application/json"
-        status, resp_headers, payload = await self._request(
-            "POST", ep.infer_path(model_name, model_version), body=body,
-            headers=request_headers,
-        )
-        ep.raise_if_error(status, payload)
-        lowered = {k.lower(): v for k, v in resp_headers.items()}
-        header_len = lowered.get(HEADER_LEN.lower())
-        return InferResult.from_response_body(
-            payload, int(header_len) if header_len else None
+
+        async def _attempt(remaining):
+            status, resp_headers, payload = await self._request(
+                "POST", ep.infer_path(model_name, model_version), body=body,
+                headers=request_headers, timeout=remaining,
+            )
+            ep.raise_if_error(status, payload)
+            lowered = {k.lower(): v for k, v in resp_headers.items()}
+            header_len = lowered.get(HEADER_LEN.lower())
+            return InferResult.from_response_body(
+                payload, int(header_len) if header_len else None
+            )
+
+        from client_tpu.robust import call_with_retry_async
+
+        return await call_with_retry_async(
+            _attempt, self._retry_policy, self._breaker,
+            deadline_s=client_timeout,
         )

@@ -139,6 +139,14 @@ class BertModel(ServedModel):
     # actually fill a batch.
     delay_min_us = 4000
     delay_max_us = 64000
+    # Queue policy: bound pending work at 16x the fuse ceiling — far
+    # above the closed-loop bench's c64 (which must never see a
+    # reject) but finite, so open-loop overload sheds with 503/
+    # UNAVAILABLE instead of growing the queue without bound; queued
+    # requests nobody will wait >2s for expire before touching the
+    # device.
+    max_queue_size = 1024
+    default_queue_policy_timeout_us = 2_000_000
 
     def __init__(self, name: str = "bert_base", cfg: Optional[BertConfig]
                  = None, seed: int = 0):

@@ -124,11 +124,66 @@ def build_parser() -> argparse.ArgumentParser:
                         help="defaults to http://<host>:8000/metrics")
     parser.add_argument("--metrics-interval", type=float, default=1000.0,
                         help="scrape interval ms")
+
+    parser.add_argument("--chaos", default=None,
+                        help="fault-injection spec, e.g. "
+                             "'latency_ms=50,error_rate=0.1,drop_rate=0.01,"
+                             "seed=7'. Configures server-side chaos for "
+                             "--service-kind inprocess; remote servers "
+                             "must set CLIENT_TPU_CHAOS themselves. "
+                             "Enables the chaos summary report.")
+    parser.add_argument("--retries", type=int, default=None,
+                        help="max client-side retries per request "
+                             "(default 0; 3 under --chaos)")
+    parser.add_argument("--retry-backoff-ms", type=float, default=25.0,
+                        help="initial retry backoff (exponential, full "
+                             "jitter)")
+    parser.add_argument("--circuit-breaker-threshold", type=int, default=0,
+                        help="consecutive failures before a worker's "
+                             "circuit opens (0 = no breaker)")
     return parser
 
 
 def run(argv: Optional[List[str]] = None, core=None) -> int:
     args = build_parser().parse_args(argv)
+
+    # Robustness wiring: retries default on under chaos (measuring
+    # recovery is the point), off otherwise.
+    from client_tpu import robust
+
+    retries = args.retries if args.retries is not None \
+        else (3 if args.chaos else 0)
+    retry_policy = None
+    if retries > 0:
+        retry_policy = robust.RetryPolicy(
+            max_attempts=retries + 1,
+            initial_backoff_s=args.retry_backoff_ms / 1000.0)
+    breaker_factory = None
+    if args.circuit_breaker_threshold > 0:
+        threshold = args.circuit_breaker_threshold
+        breaker_factory = (
+            lambda: robust.CircuitBreaker(failure_threshold=threshold))
+    robustness = dict(retry_policy=retry_policy,
+                      breaker_factory=breaker_factory)
+    chaos_config = None
+    if args.chaos:
+        from client_tpu.server import chaos as chaos_mod
+
+        if args.service_kind == "inprocess":
+            chaos_config = chaos_mod.configure_from_spec(args.chaos)
+        else:
+            # Remote server: injection happens there, not here.
+            chaos_config = chaos_mod.ChaosConfig.from_spec(args.chaos)
+            print("note: --chaos against a remote server only shapes "
+                  "the report; start the server with CLIENT_TPU_CHAOS="
+                  "'%s' to inject faults" % args.chaos, file=sys.stderr)
+    robust.reset_retry_total()
+
+    if args.service_kind in ("openai", "torchserve", "tfserving") \
+            and (retry_policy is not None or breaker_factory is not None):
+        print("warning: --retries/--circuit-breaker-threshold are not "
+              "supported by the %s backend and will be ignored"
+              % args.service_kind, file=sys.stderr)
 
     if args.service_kind == "openai":
         factory = ClientBackendFactory(
@@ -149,7 +204,8 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
             from client_tpu.server.app import build_core
 
             core = build_core([args.model_name])
-        factory = ClientBackendFactory(BackendKind.IN_PROCESS, core=core)
+        factory = ClientBackendFactory(BackendKind.IN_PROCESS, core=core,
+                                       **robustness)
         if args.shared_memory == "tpu" and core.memory.arena is not None:
             import client_tpu.utils.tpu_shared_memory as tpushm
 
@@ -160,7 +216,8 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
             else BackendKind.TRITON_HTTP
         )
         factory = ClientBackendFactory(kind, url=args.url,
-                                       verbose=args.verbose)
+                                       verbose=args.verbose,
+                                       **robustness)
 
     setup_backend = factory.create()
     parser_obj = ModelParser()
@@ -343,6 +400,19 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
         setup_backend.close()
 
     print_report(results, args.percentile, mode)
+    if args.chaos or retries > 0:
+        from client_tpu.perf.report import print_chaos_report
+
+        injected = None
+        if args.chaos and args.service_kind == "inprocess":
+            from client_tpu.server import chaos as chaos_mod
+
+            injected = chaos_mod.stats()
+            chaos_mod.configure(None)  # leave the process clean
+        print_chaos_report(results, robust.retry_total(), injected,
+                           chaos_config.describe() if chaos_config
+                           else "no injection",
+                           unrecovered=robust.exhausted_total())
     if args.latency_report_file:
         write_csv(args.latency_report_file, results, mode)
     if args.profile_export_file:

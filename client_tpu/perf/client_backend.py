@@ -87,10 +87,13 @@ class ClientBackend:
 class GrpcClientBackend(ClientBackend):
     kind = BackendKind.TRITON_GRPC
 
-    def __init__(self, url: str, verbose: bool = False):
+    def __init__(self, url: str, verbose: bool = False, retry_policy=None,
+                 circuit_breaker=None):
         import client_tpu.grpc as grpcclient
 
-        self._client = grpcclient.InferenceServerClient(url, verbose=verbose)
+        self._client = grpcclient.InferenceServerClient(
+            url, verbose=verbose, retry_policy=retry_policy,
+            circuit_breaker=circuit_breaker)
 
     def server_metadata(self):
         return self._client.get_server_metadata(as_json=True)
@@ -152,11 +155,13 @@ class GrpcClientBackend(ClientBackend):
 class HttpClientBackend(ClientBackend):
     kind = BackendKind.TRITON_HTTP
 
-    def __init__(self, url: str, verbose: bool = False, concurrency: int = 8):
+    def __init__(self, url: str, verbose: bool = False, concurrency: int = 8,
+                 retry_policy=None, circuit_breaker=None):
         import client_tpu.http as httpclient
 
         self._client = httpclient.InferenceServerClient(
-            url, verbose=verbose, concurrency=concurrency
+            url, verbose=verbose, concurrency=concurrency,
+            retry_policy=retry_policy, circuit_breaker=circuit_breaker,
         )
 
     def server_metadata(self):
@@ -172,13 +177,13 @@ class HttpClientBackend(ClientBackend):
         return self._client.get_inference_statistics(model_name, model_version)
 
     def infer(self, model_name, inputs, outputs=None, **kwargs):
-        kwargs.pop("client_timeout", None)
+        # client_timeout passes through: the HTTP client now has
+        # per-call deadline parity with the gRPC client.
         return self._client.infer(model_name, inputs, outputs=outputs,
                                   **kwargs)
 
     def async_infer(self, callback, model_name, inputs, outputs=None,
                     **kwargs):
-        kwargs.pop("client_timeout", None)
         handle = self._client.async_infer(model_name, inputs, outputs=outputs,
                                           **kwargs)
 
@@ -775,7 +780,8 @@ class InProcessBackend(ClientBackend):
 
     kind = BackendKind.IN_PROCESS
 
-    def __init__(self, core, max_workers: int = 8):
+    def __init__(self, core, max_workers: int = 8, retry_policy=None,
+                 circuit_breaker=None):
         from concurrent.futures import ThreadPoolExecutor
 
         from google.protobuf import json_format
@@ -784,6 +790,10 @@ class InProcessBackend(ClientBackend):
         self._json = json_format
         self._executor = ThreadPoolExecutor(max_workers=max_workers)
         self._stream_callback = None
+        # Retry/breaker parity with the RPC backends so chaos runs can
+        # measure recovery with zero serialization in the loop.
+        self._retry_policy = retry_policy
+        self._breaker = circuit_breaker
 
     def server_metadata(self):
         return self._json.MessageToDict(self._core.server_metadata(),
@@ -815,21 +825,26 @@ class InProcessBackend(ClientBackend):
             model_name=model_name, inputs=inputs, outputs=outputs, **kwargs
         )
 
-    def infer(self, model_name, inputs, outputs=None, **kwargs):
+    def _infer_with_retry(self, request):
         from client_tpu.grpc._utils import InferResult
+        from client_tpu.robust import call_with_retry
 
+        return call_with_retry(
+            lambda _remaining: InferResult(self._core.infer(request)),
+            self._retry_policy, self._breaker,
+        )
+
+    def infer(self, model_name, inputs, outputs=None, **kwargs):
         request = self._build_request(model_name, inputs, outputs, **kwargs)
-        return InferResult(self._core.infer(request))
+        return self._infer_with_retry(request)
 
     def async_infer(self, callback, model_name, inputs, outputs=None,
                     **kwargs):
-        from client_tpu.grpc._utils import InferResult
-
         request = self._build_request(model_name, inputs, outputs, **kwargs)
 
         def _work():
             try:
-                callback(InferResult(self._core.infer(request)), None)
+                callback(self._infer_with_retry(request), None)
             except InferenceServerException as e:
                 callback(None, e)
             except Exception as e:  # any failure must release the slot
@@ -1058,7 +1073,8 @@ class ClientBackendFactory:
                  verbose: bool = False, http_concurrency: int = 8,
                  mock_delay_s: float = 0.0, mock_stats=None,
                  openai_endpoint: str = "/v1/chat/completions",
-                 tfserving_grpc: bool = True):
+                 tfserving_grpc: bool = True, retry_policy=None,
+                 breaker_factory=None):
         self.kind = kind
         self._url = url
         self._core = core
@@ -1070,13 +1086,25 @@ class ClientBackendFactory:
         # gRPC PredictionService is TF-Serving's native protocol
         # (reference parity); False selects the REST predict API.
         self._tfserving_grpc = tfserving_grpc
+        # Robustness wiring: the policy is immutable and shared; each
+        # backend (= each worker's client) gets its OWN breaker so one
+        # worker tripping open doesn't blind the others' measurements.
+        self._retry_policy = retry_policy
+        self._breaker_factory = breaker_factory
+
+    def _breaker(self):
+        return self._breaker_factory() if self._breaker_factory else None
 
     def create(self) -> ClientBackend:
         if self.kind == BackendKind.TRITON_GRPC:
-            return GrpcClientBackend(self._url, self._verbose)
+            return GrpcClientBackend(self._url, self._verbose,
+                                     retry_policy=self._retry_policy,
+                                     circuit_breaker=self._breaker())
         if self.kind == BackendKind.TRITON_HTTP:
             return HttpClientBackend(self._url, self._verbose,
-                                     self._http_concurrency)
+                                     self._http_concurrency,
+                                     retry_policy=self._retry_policy,
+                                     circuit_breaker=self._breaker())
         if self.kind == BackendKind.OPENAI:
             return OpenAiClientBackend(self._url, self._openai_endpoint,
                                        self._verbose)
@@ -1091,7 +1119,9 @@ class ClientBackendFactory:
                 raise InferenceServerException(
                     "in-process backend requires a server core"
                 )
-            return InProcessBackend(self._core)
+            return InProcessBackend(self._core,
+                                    retry_policy=self._retry_policy,
+                                    circuit_breaker=self._breaker())
         if self.kind == BackendKind.MOCK:
             return MockBackend(self._mock_delay, self._mock_stats)
         raise InferenceServerException("unknown backend kind %s" % self.kind)

@@ -76,7 +76,8 @@ def _normalize_stats_entry(entry: Dict) -> Dict:
     """Undoes protobuf-JSON int64 stringification on the known numeric
     fields only (a generic string->int pass would corrupt `version`)."""
     out = dict(entry)
-    for key in ("inference_count", "execution_count"):
+    for key in ("inference_count", "execution_count", "reject_count",
+                "timeout_count"):
         if key in out:
             out[key] = int(out[key])
     sections = {}

@@ -62,6 +62,45 @@ def print_report(results: List[PerfStatus], percentile: int = 0,
             print("    WARNING: measurement did not stabilize")
 
 
+def print_chaos_report(results: List[PerfStatus], retry_count: int,
+                       injected: Optional[dict] = None,
+                       description: str = "",
+                       unrecovered: int = 0) -> None:
+    """The --chaos summary: goodput (successful inferences/sec), the
+    client-visible error rate, retry volume, tail latency under fault,
+    and — for in-process runs — how many faults were injected vs how
+    many escaped retries (the recovery rate the acceptance gate
+    regresses on). ``unrecovered`` is robust.exhausted_total(): a
+    process-lifetime counter, like the injection counters, so recovery
+    accounts for warm-up-window failures that per-window error counts
+    would miss."""
+    print("Chaos summary (%s):" % (description or "no injection"))
+    total_completed = sum(s.completed_count for s in results)
+    total_errors = sum(s.error_count for s in results)
+    seen = total_completed + total_errors
+    for status in results:
+        attempted = status.completed_count + status.error_count
+        error_rate = (status.error_count / attempted * 100.0
+                      if attempted else 0.0)
+        print("    goodput %.2f infer/sec, error rate %.2f%% "
+              "(%d/%d), p99 %.0f usec"
+              % (status.throughput, error_rate, status.error_count,
+                 attempted, status.latency_percentiles.get(99, 0.0)))
+    print("    client retries: %d" % retry_count)
+    if injected:
+        faults = injected.get("injected_errors", 0) \
+            + injected.get("injected_drops", 0)
+        print("    injected: %d errors, %d drops, %d delayed requests"
+              % (injected.get("injected_errors", 0),
+                 injected.get("injected_drops", 0),
+                 injected.get("delayed_requests", 0)))
+        if faults:
+            recovered = max(faults - unrecovered, 0)
+            print("    recovered %d/%d injected faults (%.1f%%) across "
+                  "%d client-visible results"
+                  % (recovered, faults, recovered / faults * 100.0, seen))
+
+
 def write_csv(path: str, results: List[PerfStatus],
               mode: str = "concurrency") -> None:
     with open(path, "w", newline="") as f:

@@ -1,0 +1,407 @@
+"""Client-side robustness primitives shared by all four front-ends
+(HTTP/gRPC x sync/asyncio): retry with exponential backoff + full
+jitter, a circuit breaker, and the retry executors that wire both into
+a client call.
+
+Design notes
+------------
+
+* :class:`RetryPolicy` is immutable configuration — one instance can be
+  shared across every client and worker thread in a process. Mutable
+  retry state (attempt counters, backoff draws) lives in the executor's
+  stack frame, never on the policy.
+* Backoff uses **full jitter** (``uniform(0, min(cap, base * mult^n))``)
+  rather than equal jitter: under a thundering herd the uniform spread
+  over the whole interval decorrelates clients fastest.
+* The per-call deadline is a **shrinking budget**: every attempt is
+  handed the wall-clock remaining out of the caller's ``client_timeout``
+  so the total time (attempts + backoffs) never exceeds what the caller
+  asked for. A retry whose backoff would not leave room for another
+  attempt re-raises immediately instead of sleeping into a guaranteed
+  deadline miss.
+* :class:`CircuitBreaker` is per-client (per connection target), not
+  global: closed -> open after ``failure_threshold`` consecutive
+  failures, open -> half-open after ``reset_timeout_s``, half-open
+  admits exactly one probe whose outcome decides closed vs open again.
+  While open, calls fail fast with ``UNAVAILABLE`` — no network I/O —
+  which is what sheds load from a struggling server.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Callable, Optional
+
+from client_tpu.utils import InferenceServerException
+
+# Statuses worth retrying by default: server-side admission rejections
+# and transport failures surface as UNAVAILABLE (gRPC) / 503 (HTTP).
+# Deadline expiries are NOT default-retryable — a request that timed
+# out once will usually time out again and retrying it doubles load at
+# exactly the moment the server is slowest.
+DEFAULT_RETRYABLE_STATUSES = ("UNAVAILABLE", "503")
+
+# Definitive client errors: the server answered, decisively — proof
+# the endpoint is healthy. These feed the circuit breaker as
+# successes; everything else (availability errors, timeouts, server
+# errors, status-less transport failures) counts toward opening it.
+CLIENT_ERROR_STATUSES = frozenset({
+    "INVALID_ARGUMENT", "400", "NOT_FOUND", "404", "ALREADY_EXISTS",
+    "409", "UNIMPLEMENTED", "501", "PERMISSION_DENIED", "403",
+    "UNAUTHENTICATED", "401",
+})
+
+
+def _breaker_resolve(breaker: "CircuitBreaker", error: BaseException) -> None:
+    """Settle the breaker after a failed attempt. A definitive client
+    error (bad shape, unknown model) proves the server is up and must
+    not open the circuit against a healthy endpoint; caller-side
+    aborts (cancellation, interrupts — BaseExceptions that are not
+    Exceptions) say nothing about the server, so they only free the
+    probe slot; anything else is availability evidence. Every path
+    resolves a half-open probe — a probe left unresolved would lock
+    the client out forever."""
+    if isinstance(error, InferenceServerException) \
+            and (error.status() or "") in CLIENT_ERROR_STATUSES:
+        breaker.record_success()
+    elif not isinstance(error, Exception):
+        # asyncio.CancelledError / KeyboardInterrupt / SystemExit: the
+        # CALLER gave up, the server never answered either way.
+        breaker.abort_probe()
+    else:
+        breaker.record_failure()
+
+
+class RetryPolicy:
+    """Immutable retry configuration (share one instance freely).
+
+    ``max_attempts`` counts the first try: ``max_attempts=4`` means one
+    call plus up to three retries.
+    """
+
+    def __init__(
+        self,
+        max_attempts: int = 4,
+        initial_backoff_s: float = 0.025,
+        backoff_multiplier: float = 2.0,
+        max_backoff_s: float = 1.0,
+        retryable_statuses=DEFAULT_RETRYABLE_STATUSES,
+        jitter: bool = True,
+        rng: Optional[random.Random] = None,
+    ):
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self.max_attempts = int(max_attempts)
+        self.initial_backoff_s = float(initial_backoff_s)
+        self.backoff_multiplier = float(backoff_multiplier)
+        self.max_backoff_s = float(max_backoff_s)
+        self.retryable_statuses = frozenset(
+            str(s) for s in retryable_statuses)
+        self.jitter = bool(jitter)
+        self._rng = rng if rng is not None else random.Random()
+
+    def is_retryable(self, error: Exception) -> bool:
+        if not isinstance(error, InferenceServerException):
+            return False
+        return (error.status() or "") in self.retryable_statuses
+
+    def backoff_cap_s(self, attempt: int) -> float:
+        """Deterministic upper bound of the attempt's backoff draw."""
+        cap = self.initial_backoff_s * (self.backoff_multiplier ** attempt)
+        return min(cap, self.max_backoff_s)
+
+    def backoff_s(self, attempt: int) -> float:
+        """Backoff before retry number ``attempt`` (0-based: the wait
+        after the first failure is ``backoff_s(0)``)."""
+        cap = self.backoff_cap_s(attempt)
+        if not self.jitter:
+            return cap
+        return self._rng.uniform(0.0, cap)
+
+
+class CircuitBreaker:
+    """Consecutive-failure circuit breaker with a half-open probe.
+
+    Thread-safe; intended to be owned by one client talking to one
+    endpoint. ``before_call`` raises ``UNAVAILABLE`` while the circuit
+    is open (fail fast, zero network I/O), admits a single probe once
+    ``reset_timeout_s`` has elapsed, and the executor reports the
+    outcome through ``record_success`` / ``record_failure``.
+    """
+
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+    def __init__(self, failure_threshold: int = 5,
+                 reset_timeout_s: float = 5.0,
+                 clock: Callable[[], float] = time.monotonic):
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self.failure_threshold = int(failure_threshold)
+        self.reset_timeout_s = float(reset_timeout_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._probe_in_flight = False
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def before_call(self) -> None:
+        with self._lock:
+            if self._state == self.OPEN:
+                waited = self._clock() - self._opened_at
+                if waited < self.reset_timeout_s:
+                    raise InferenceServerException(
+                        "circuit breaker open after %d consecutive "
+                        "failures; next probe in %.2fs"
+                        % (self._consecutive_failures,
+                           self.reset_timeout_s - waited),
+                        status="UNAVAILABLE",
+                    )
+                self._state = self.HALF_OPEN
+                self._probe_in_flight = True
+                return
+            if self._state == self.HALF_OPEN:
+                if self._probe_in_flight:
+                    raise InferenceServerException(
+                        "circuit breaker half-open: probe already in "
+                        "flight", status="UNAVAILABLE")
+                self._probe_in_flight = True
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._state = self.CLOSED
+            self._consecutive_failures = 0
+            self._probe_in_flight = False
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._consecutive_failures += 1
+            if (self._state == self.HALF_OPEN
+                    or self._consecutive_failures >= self.failure_threshold):
+                self._state = self.OPEN
+                self._opened_at = self._clock()
+            self._probe_in_flight = False
+
+    def admits(self) -> bool:
+        """Non-mutating preview of :meth:`before_call`: would a call
+        be allowed right now? Used by the retry executors to skip the
+        backoff sleep when the circuit has just opened — sleeping
+        toward an attempt the breaker will refuse only delays the
+        caller's failure."""
+        with self._lock:
+            if self._state == self.OPEN:
+                return self._clock() - self._opened_at \
+                    >= self.reset_timeout_s
+            if self._state == self.HALF_OPEN:
+                return not self._probe_in_flight
+            return True
+
+    def abort_probe(self) -> None:
+        """Settle an aborted call with NO availability evidence: the
+        failure counter is untouched and a half-open probe slot is
+        freed (back to open with the original timer, so the next call
+        may probe immediately)."""
+        with self._lock:
+            self._probe_in_flight = False
+            if self._state == self.HALF_OPEN:
+                self._state = self.OPEN
+
+
+# -- process-wide retry accounting (the perf harness's chaos report
+# sums retries across every per-worker client). `exhausted` counts
+# retryable failures that escaped to the caller anyway (attempts or
+# deadline budget spent) — the honest "not recovered" number: it spans
+# the whole process lifetime exactly like the chaos injection
+# counters, so the recovery rate compares like with like (per-window
+# error counts would miss warm-up-window failures). ------------------
+
+_retry_lock = threading.Lock()
+_retry_total = 0
+_exhausted_total = 0
+
+
+def note_retries(count: int = 1) -> None:
+    global _retry_total
+    with _retry_lock:
+        _retry_total += count
+
+
+def note_exhausted() -> None:
+    global _exhausted_total
+    with _retry_lock:
+        _exhausted_total += 1
+
+
+def retry_total() -> int:
+    with _retry_lock:
+        return _retry_total
+
+
+def exhausted_total() -> int:
+    with _retry_lock:
+        return _exhausted_total
+
+
+def reset_retry_total() -> None:
+    global _retry_total, _exhausted_total
+    with _retry_lock:
+        _retry_total = 0
+        _exhausted_total = 0
+
+
+def _note_if_exhausted(policy: Optional[RetryPolicy],
+                       error: InferenceServerException) -> None:
+    """A retryable-class error is escaping to the caller: count it as
+    unrecovered (attempts/budget spent, or no policy to retry with)."""
+    statuses = (policy.retryable_statuses if policy is not None
+                else frozenset(DEFAULT_RETRYABLE_STATUSES))
+    if (error.status() or "") in statuses:
+        note_exhausted()
+
+
+def _next_delay(policy: RetryPolicy, error: InferenceServerException,
+                attempt: int, deadline_s: Optional[float],
+                elapsed_s: float) -> Optional[float]:
+    """Backoff before the next attempt, or None when the call must
+    re-raise (non-retryable, attempts exhausted, or no budget left to
+    retry inside the deadline)."""
+    if not policy.is_retryable(error):
+        return None
+    if attempt >= policy.max_attempts - 1:
+        return None
+    delay = policy.backoff_s(attempt)
+    if deadline_s is not None and elapsed_s + delay >= deadline_s:
+        return None
+    return delay
+
+
+def call_with_retry(
+    fn: Callable[[Optional[float]], object],
+    policy: Optional[RetryPolicy] = None,
+    breaker: Optional[CircuitBreaker] = None,
+    deadline_s: Optional[float] = None,
+    sleep: Callable[[float], None] = time.sleep,
+    clock: Callable[[], float] = time.monotonic,
+):
+    """Run ``fn(remaining_timeout_s)`` under the retry policy.
+
+    ``fn`` receives the wall-clock budget remaining out of
+    ``deadline_s`` (None when no deadline) and should pass it through
+    as its transport timeout, so later attempts get strictly less time.
+    Only :class:`InferenceServerException` is ever retried; breaker
+    open-state failures raise without consuming retry attempts.
+    """
+    start = clock()
+    attempt = 0
+    while True:
+        if breaker is not None:
+            try:
+                # Outside the retry net: open circuits fail fast
+                # instead of burning attempts — but the shed call IS a
+                # client-visible unrecovered failure, so count it.
+                breaker.before_call()
+            except InferenceServerException as e:
+                _note_if_exhausted(policy, e)
+                raise
+        remaining = None
+        if deadline_s is not None:
+            remaining = deadline_s - (clock() - start)
+            if remaining <= 0:
+                raise InferenceServerException(
+                    "deadline of %.3fs exhausted after %d attempt(s)"
+                    % (deadline_s, attempt), status="DEADLINE_EXCEEDED")
+        try:
+            result = fn(remaining)
+        except InferenceServerException as e:
+            if breaker is not None:
+                _breaker_resolve(breaker, e)
+            delay = None if policy is None else _next_delay(
+                policy, e, attempt, deadline_s, clock() - start)
+            if delay is None or (breaker is not None
+                                 and not breaker.admits()):
+                # No retry coming (attempts/budget spent, or the
+                # breaker just opened): raise the REAL error now —
+                # sleeping first and counting a phantom retry would
+                # only delay the failure and skew the chaos report.
+                _note_if_exhausted(policy, e)
+                raise
+            note_retries()
+            sleep(delay)
+            attempt += 1
+            continue
+        except BaseException as e:
+            # Unexpected failures (decode bugs, KeyboardInterrupt,
+            # cancellation) are never retried, but they MUST still
+            # settle the breaker — an unresolved half-open probe locks
+            # the client out.
+            if breaker is not None:
+                _breaker_resolve(breaker, e)
+            raise
+        if breaker is not None:
+            breaker.record_success()
+        return result
+
+
+async def call_with_retry_async(
+    fn,
+    policy: Optional[RetryPolicy] = None,
+    breaker: Optional[CircuitBreaker] = None,
+    deadline_s: Optional[float] = None,
+    clock: Callable[[], float] = time.monotonic,
+):
+    """asyncio mirror of :func:`call_with_retry`; ``fn`` is an async
+    callable taking the remaining-timeout budget."""
+    import asyncio
+
+    start = clock()
+    attempt = 0
+    while True:
+        if breaker is not None:
+            try:
+                breaker.before_call()
+            except InferenceServerException as e:
+                # A shed call is a client-visible unrecovered failure.
+                _note_if_exhausted(policy, e)
+                raise
+        remaining = None
+        if deadline_s is not None:
+            remaining = deadline_s - (clock() - start)
+            if remaining <= 0:
+                raise InferenceServerException(
+                    "deadline of %.3fs exhausted after %d attempt(s)"
+                    % (deadline_s, attempt), status="DEADLINE_EXCEEDED")
+        try:
+            result = await fn(remaining)
+        except InferenceServerException as e:
+            if breaker is not None:
+                _breaker_resolve(breaker, e)
+            delay = None if policy is None else _next_delay(
+                policy, e, attempt, deadline_s, clock() - start)
+            if delay is None or (breaker is not None
+                                 and not breaker.admits()):
+                # See the sync executor: never sleep toward an attempt
+                # the breaker will refuse.
+                _note_if_exhausted(policy, e)
+                raise
+            note_retries()
+            await asyncio.sleep(delay)
+            attempt += 1
+            continue
+        except BaseException as e:
+            # See the sync executor: every failure (incl. task
+            # cancellation) settles the breaker.
+            if breaker is not None:
+                _breaker_resolve(breaker, e)
+            raise
+        if breaker is not None:
+            breaker.record_success()
+        return result

@@ -50,6 +50,10 @@ class ServerHandle:
         self.address = address
 
     def stop(self, grace: float = 1.0):
+        # Health flips to not-ready BEFORE the listener stops: load
+        # balancers polling /v2/health/ready see the drain and stop
+        # routing while in-flight requests finish under `grace`.
+        self.core.ready = False
         self.grpc_server.stop(grace)
         self.core.shutdown()
 

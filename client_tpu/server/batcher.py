@@ -56,9 +56,11 @@ NANOS_PER_US = 1_000
 
 class _Pending:
     __slots__ = ("inputs", "params", "batch", "shape_key", "event",
-                 "outputs", "error", "enqueue_ns", "queue_ns", "leader")
+                 "outputs", "error", "enqueue_ns", "queue_ns", "leader",
+                 "deadline_ns")
 
-    def __init__(self, inputs, params, batch, shape_key):
+    def __init__(self, inputs, params, batch, shape_key,
+                 timeout_ns: int = 0):
         self.inputs = inputs
         self.params = params
         self.batch = batch
@@ -71,6 +73,10 @@ class _Pending:
         # True for the request that represents the fused execution in
         # the server's execution_count statistic.
         self.leader = False
+        # Absolute queue deadline (0 = none). Expired requests are
+        # dropped BEFORE dispatch — a request nobody is waiting for
+        # must not occupy a TPU slot.
+        self.deadline_ns = self.enqueue_ns + timeout_ns if timeout_ns else 0
 
 
 class _OverlapTracker:
@@ -147,8 +153,34 @@ class DynamicBatcher:
                  delay_min_us: int = 0, delay_max_us: int = 0,
                  pipeline_depth: int = 0, fetch_workers: int = 0,
                  stats_hook: Optional[Callable[[int, int, int],
-                                               None]] = None):
+                                               None]] = None,
+                 max_queue_size: int = 0,
+                 default_timeout_us: int = 0,
+                 allow_timeout_override: bool = True,
+                 timeout_action: str = "REJECT",
+                 reject_hook: Optional[Callable[[], None]] = None,
+                 timeout_hook: Optional[Callable[[], None]] = None):
         self._model = model
+        # Queue policy (Triton ModelQueuePolicy semantics):
+        # max_queue_size bounds total pending requests (0 = unbounded;
+        # overflow is rejected UNAVAILABLE at admission, never
+        # enqueued); default_timeout_us starts each request's queue
+        # deadline, overridable per request by its `timeout` parameter
+        # when allow_timeout_override is set. timeout_action REJECT
+        # expires deadline-passed requests before dispatch; DELAY keeps
+        # them queued (the deadline becomes advisory) — they execute
+        # whenever their bucket dispatches.
+        self._max_queue_size = max(int(max_queue_size), 0)
+        self._default_timeout_ns = max(int(default_timeout_us), 0) \
+            * NANOS_PER_US
+        self._allow_timeout_override = bool(allow_timeout_override)
+        self._timeout_reject = str(timeout_action).upper() != "DELAY"
+        self._reject_hook = reject_hook
+        self._timeout_hook = timeout_hook
+        # Latches true at the first deadlined enqueue; until then the
+        # expiry scan short-circuits, so models that never use
+        # timeouts pay nothing on the hot gather path.
+        self._any_deadlines = self._default_timeout_ns > 0
         self._max_batch = max(int(model.max_batch_size), 1)
         self._delay_ns = max_queue_delay_us * NANOS_PER_US
         self._preferred = sorted(
@@ -174,8 +206,11 @@ class DynamicBatcher:
         self._ia_ema_ns = 0.0
         self._last_arrival_ns = 0
         # Per-shape bucket queues, insertion-ordered so draining and
-        # deadline scans visit older shapes first.
+        # deadline scans visit older shapes first. _pending_total
+        # mirrors the summed queue lengths so admission control and
+        # the stats gauge read it in O(1) on the hot paths.
         self._buckets: "OrderedDict[tuple, List[_Pending]]" = OrderedDict()
+        self._pending_total = 0
         self._cv = threading.Condition()
         self._stopping = False
         # Bounded pipeline: at most this many fused batches dispatched
@@ -232,11 +267,30 @@ class DynamicBatcher:
             ),
             _params_fingerprint(params),
         )
-        pending = _Pending(inputs, params, batch, shape_key)
+        pending = _Pending(inputs, params, batch, shape_key,
+                           timeout_ns=self._timeout_ns_for(params))
         with self._cv:
             if self._stopping:
                 raise InferenceServerException(
                     "server is shutting down", status="UNAVAILABLE")
+            if self._max_queue_size > 0 \
+                    and self._pending_total >= self._max_queue_size:
+                # Admission control: overflow is rejected here, at the
+                # door, so a saturated queue sheds load in O(1) instead
+                # of growing without bound and timing everyone out.
+                if self._reject_hook is not None:
+                    try:
+                        self._reject_hook()
+                    except Exception:  # noqa: BLE001 — stats only
+                        pass
+                raise InferenceServerException(
+                    "request for model '%s' rejected: exceeds "
+                    "max_queue_size %d"
+                    % (getattr(self._model, "name", "?"),
+                       self._max_queue_size),
+                    status="UNAVAILABLE")
+            if pending.deadline_ns:
+                self._any_deadlines = True
             now = pending.enqueue_ns
             if self._last_arrival_ns:
                 gap = now - self._last_arrival_ns
@@ -258,11 +312,73 @@ class DynamicBatcher:
             if queue is None:
                 queue = self._buckets[shape_key] = []
             queue.append(pending)
+            self._pending_total += 1
             self._cv.notify_all()
         pending.event.wait()
         if pending.error is not None:
             raise pending.error
         return pending.outputs, pending.queue_ns, pending.leader
+
+    # -- queue policy -----------------------------------------------------
+
+    def _timeout_ns_for(self, params: dict) -> int:
+        """Effective queue timeout for one request: the per-request
+        `timeout` parameter (microseconds, KServe-v2) when overrides
+        are allowed, else the model's default_queue_policy_timeout_us;
+        0 = no deadline."""
+        timeout_ns = self._default_timeout_ns
+        if self._allow_timeout_override:
+            override = params.get("timeout")
+            if override is not None:
+                try:
+                    timeout_ns = max(int(override), 0) * NANOS_PER_US
+                except (TypeError, ValueError):
+                    pass  # malformed timeouts fall back to the default
+        return timeout_ns
+
+    def _expire_locked(self, now: int) -> Optional[int]:
+        """Drops deadline-passed requests (timeout_action REJECT) and
+        returns the earliest live deadline for the gather wake-up, or
+        None when nothing is deadlined. Caller holds the lock. Expiry
+        runs BEFORE bucket selection so an expired request never
+        reaches the device; deadlines are void while draining on stop
+        (stop() promises execution)."""
+        if self._stopping or not self._timeout_reject \
+                or not self._any_deadlines:
+            return None
+        earliest: Optional[int] = None
+        expired: List[_Pending] = []
+        for shape_key in list(self._buckets):
+            queue = self._buckets[shape_key]
+            live = []
+            for pending in queue:
+                if pending.deadline_ns and now >= pending.deadline_ns:
+                    pending.queue_ns = now - pending.enqueue_ns
+                    expired.append(pending)
+                    continue
+                if pending.deadline_ns:
+                    if earliest is None or pending.deadline_ns < earliest:
+                        earliest = pending.deadline_ns
+                live.append(pending)
+            if len(live) != len(queue):
+                if live:
+                    queue[:] = live
+                else:
+                    del self._buckets[shape_key]
+        self._pending_total -= len(expired)
+        for pending in expired:
+            pending.error = InferenceServerException(
+                "request for model '%s' timed out in queue after "
+                "%d us" % (getattr(self._model, "name", "?"),
+                           pending.queue_ns // NANOS_PER_US),
+                status="DEADLINE_EXCEEDED")
+            pending.event.set()
+            if self._timeout_hook is not None:
+                try:
+                    self._timeout_hook()
+                except Exception:  # noqa: BLE001 — stats only
+                    pass
+        return earliest
 
     # -- adaptive delay ---------------------------------------------------
 
@@ -319,8 +435,15 @@ class DynamicBatcher:
                     if self._stopping and not self._buckets:
                         return
                     if self._inflight >= self._depth:
-                        # Pipeline full: woken by a batch completion.
-                        self._cv.wait()
+                        # Pipeline full: woken by a batch completion —
+                        # but queued deadlines must still expire, so
+                        # sleep only until the earliest one.
+                        wake = self._expire_locked(time.monotonic_ns())
+                        if wake is None:
+                            self._cv.wait()
+                        else:
+                            self._cv.wait(timeout=max(
+                                wake - time.monotonic_ns(), 0) / 1e9)
                         continue
                     now = time.monotonic_ns()
                     bucket, wake_ns = self._take_ready_bucket(now)
@@ -345,6 +468,9 @@ class DynamicBatcher:
         order keeps a flooded shape from starving a rare shape whose
         deadline expired while the flood's queue stayed permanently
         full. Caller holds the lock."""
+        expire_wake = self._expire_locked(now)
+        if not self._buckets:
+            return None, expire_wake
         self._cur_delay_ns = delay = self._adaptive_delay_ns()
         full_at = self._preferred[-1] if self._preferred else self._max_batch
         # Arrival stream stalled (bounded closed loop fully queued):
@@ -383,10 +509,16 @@ class DynamicBatcher:
                        self._last_arrival_ns + self._idle_cutoff_ns(delay))
             if earliest is None or wake < earliest:
                 earliest = wake
+        if expire_wake is not None and (earliest is None
+                                        or expire_wake < earliest):
+            # Queue-policy deadlines must wake the gather thread even
+            # when every bucket's dispatch deadline lies further out.
+            earliest = expire_wake
         if ready_key is not None:
             queue = self._buckets[ready_key]
             bucket = queue[:ready_take]
             del queue[:ready_take]
+            self._pending_total -= ready_take
             if not queue:
                 del self._buckets[ready_key]
             return bucket, None
@@ -524,7 +656,7 @@ class DynamicBatcher:
         """Point-in-time pipeline gauges plus cumulative compute/fetch
         overlap counters (the statistics endpoints' pipeline_stats)."""
         with self._cv:
-            pending = sum(len(q) for q in self._buckets.values())
+            pending = self._pending_total
             inflight = self._inflight
             delay_us = self._cur_delay_ns // NANOS_PER_US
         compute_ns, fetch_ns, overlap_ns = self._tracker.snapshot()
@@ -584,11 +716,14 @@ def _params_fingerprint(params: dict):
     """Normalized, hashable view of request parameters. Requests are
     only fused when their parameters match — fusing would otherwise
     execute the whole bucket with the leader's params, silently
-    dropping the rest (priority, timeout, custom params)."""
+    dropping the rest (priority, custom params). `timeout` is excluded:
+    the batcher enforces each request's deadline individually, so
+    differing timeouts must not fragment fusion."""
     if not params:
         return ()
     return tuple(
         (key, repr(params[key])) for key in sorted(params)
+        if key != "timeout"
     )
 
 

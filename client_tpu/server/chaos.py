@@ -1,0 +1,184 @@
+"""Fault injection for the server request path.
+
+Chaos is configured per process (``configure`` / the CLIENT_TPU_CHAOS
+environment variable) and evaluated by :func:`inject`, which the server
+core calls once per inference request. Three fault kinds:
+
+* ``latency_ms`` — added service latency (sleep before execution).
+* ``error_rate`` — fraction of requests failed with UNAVAILABLE, the
+  shape a crashing backend or evicted pod produces.
+* ``drop_rate`` — fraction of requests failed as *connection drops*:
+  the HTTP front-end closes the TCP transport mid-request (the client
+  sees a reset, not an error body); gRPC surfaces UNAVAILABLE with a
+  drop marker. Raised as :class:`ChaosDropError` so front-ends can
+  distinguish a drop from an ordinary injected error.
+
+Spec strings (``--chaos`` / CLIENT_TPU_CHAOS) are comma-separated
+``key=value`` pairs, e.g. ``"latency_ms=50,error_rate=0.1,seed=7"``.
+An optional ``models=a+b`` entry restricts injection to those models.
+
+Everything is deterministic under ``seed`` so a chaos run is
+reproducible — the property that turns "it degrades gracefully" into a
+regression-gated measurement.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from typing import Optional
+
+from client_tpu.utils import InferenceServerException
+
+ENV_VAR = "CLIENT_TPU_CHAOS"
+
+
+class ChaosDropError(InferenceServerException):
+    """An injected connection drop. Subclasses the server exception so
+    untouched paths degrade to a plain UNAVAILABLE error; front-ends
+    that can sever the transport (HTTP) special-case it."""
+
+    def __init__(self, msg: str = "connection dropped (chaos)"):
+        super().__init__(msg, status="UNAVAILABLE")
+
+
+class ChaosConfig:
+    def __init__(self, latency_ms: float = 0.0, error_rate: float = 0.0,
+                 drop_rate: float = 0.0, seed: Optional[int] = None,
+                 models: Optional[set] = None):
+        self.latency_ms = max(float(latency_ms), 0.0)
+        self.error_rate = min(max(float(error_rate), 0.0), 1.0)
+        self.drop_rate = min(max(float(drop_rate), 0.0), 1.0)
+        self.seed = seed
+        self.models = set(models) if models else None
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.latency_ms or self.error_rate or self.drop_rate)
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "ChaosConfig":
+        """Parse ``"latency_ms=50,error_rate=0.1,drop_rate=0.01,
+        seed=7,models=a+b"``; unknown keys fail loudly."""
+        kwargs: dict = {}
+        for part in (spec or "").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            key, sep, value = part.partition("=")
+            if not sep:
+                raise ValueError("chaos spec entry '%s' is not key=value"
+                                 % part)
+            key = key.strip()
+            value = value.strip()
+            if key in ("latency_ms", "error_rate", "drop_rate"):
+                kwargs[key] = float(value)
+            elif key == "seed":
+                kwargs["seed"] = int(value)
+            elif key == "models":
+                kwargs["models"] = {m for m in value.split("+") if m}
+            else:
+                raise ValueError("unknown chaos spec key '%s'" % key)
+        return cls(**kwargs)
+
+    def describe(self) -> str:
+        parts = []
+        if self.latency_ms:
+            parts.append("+%gms latency" % self.latency_ms)
+        if self.error_rate:
+            parts.append("%.0f%% errors" % (self.error_rate * 100))
+        if self.drop_rate:
+            parts.append("%.0f%% drops" % (self.drop_rate * 100))
+        return ", ".join(parts) if parts else "disabled"
+
+
+class _ChaosState:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.config: Optional[ChaosConfig] = None
+        self.rng = random.Random()
+        self.injected_errors = 0
+        self.injected_drops = 0
+        self.delayed_requests = 0
+        self._env_checked = False
+
+
+_state = _ChaosState()
+
+
+def configure(config: Optional[ChaosConfig]) -> None:
+    """Install (or, with None, clear) the process-wide chaos config and
+    reset the injection counters."""
+    with _state.lock:
+        _state.config = config if config is not None and config.enabled \
+            else None
+        _state.rng = random.Random(
+            config.seed if config is not None else None)
+        _state.injected_errors = 0
+        _state.injected_drops = 0
+        _state.delayed_requests = 0
+        _state._env_checked = True  # explicit config beats the env
+
+
+def configure_from_spec(spec: str) -> ChaosConfig:
+    config = ChaosConfig.from_spec(spec)
+    configure(config)
+    return config
+
+
+def _load_env_config() -> None:
+    """One-shot CLIENT_TPU_CHAOS pickup, done lazily at the first
+    inject() so standalone servers get chaos without code changes."""
+    with _state.lock:
+        if _state._env_checked:
+            return
+        _state._env_checked = True
+        spec = os.environ.get(ENV_VAR, "")
+    if spec:
+        configure_from_spec(spec)
+        with _state.lock:  # keep env-sourced config re-checkable
+            _state._env_checked = True
+
+
+def stats() -> dict:
+    with _state.lock:
+        return {
+            "injected_errors": _state.injected_errors,
+            "injected_drops": _state.injected_drops,
+            "delayed_requests": _state.delayed_requests,
+        }
+
+
+def inject(model_name: str = "") -> None:
+    """Request-path hook: sleep/raise per the active config. No-op
+    (one lock-free attribute read) when chaos is off."""
+    if not _state._env_checked:
+        _load_env_config()
+    config = _state.config
+    if config is None:
+        return
+    if config.models is not None and model_name not in config.models:
+        return
+    with _state.lock:
+        if _state.config is not config:  # reconfigured mid-flight
+            return
+        roll = _state.rng.random()
+        delay_ms = config.latency_ms
+        drop = roll < config.drop_rate
+        error = not drop and roll < config.drop_rate + config.error_rate
+        if delay_ms:
+            _state.delayed_requests += 1
+        if drop:
+            _state.injected_drops += 1
+        elif error:
+            _state.injected_errors += 1
+    if delay_ms:
+        time.sleep(delay_ms / 1000.0)
+    if drop:
+        raise ChaosDropError()
+    if error:
+        raise InferenceServerException(
+            "injected fault (chaos error_rate=%g)" % config.error_rate,
+            status="UNAVAILABLE")

@@ -17,6 +17,7 @@ from typing import Dict, Iterator, Optional
 import numpy as np
 
 from client_tpu.protocol import inference_pb2 as pb
+from client_tpu.server import chaos
 from client_tpu.server.memory import SharedMemoryManager
 from client_tpu.server.model import ServedModel
 from client_tpu.server.repository import ModelRepository
@@ -63,6 +64,12 @@ class _ModelStats:
         self.compute_infer_ns = 0
         self.compute_output_ns = 0
         self.last_inference_ms = 0
+        # Queue-policy drops: admission rejections (queue full) and
+        # queue-deadline expiries — every dropped request is counted
+        # somewhere (ModelStatistics.reject_count/timeout_count and
+        # the tpu_request_*_total Prometheus families).
+        self.rejected_count = 0
+        self.timeout_count = 0
         # Fused-batch-size histogram fed by the dynamic batcher's
         # stats hook: executed batch size -> [executions, compute_ns,
         # fetch_ns] (renders as ModelStatistics.batch_stats).
@@ -85,6 +92,16 @@ class _ModelStats:
                 self.fail_count += 1
                 self.fail_ns += total
             self.last_inference_ms = int(time.time() * 1000)
+
+    def record_rejected(self):
+        """Queue-policy admission rejection (max_queue_size hit)."""
+        with self.lock:
+            self.rejected_count += 1
+
+    def record_timeout(self):
+        """Queue-deadline expiry (request dropped before dispatch)."""
+        with self.lock:
+            self.timeout_count += 1
 
     def record_batch(self, size: int, compute_ns: int, fetch_ns: int):
         """Dynamic-batcher stats hook: one fused execution at `size`."""
@@ -180,6 +197,8 @@ class InferenceServerCore:
                     last_inference=s.last_inference_ms,
                     inference_count=s.inference_count,
                     execution_count=s.execution_count,
+                    reject_count=s.rejected_count,
+                    timeout_count=s.timeout_count,
                 )
                 stat.inference_stats.success.count = s.success_count
                 stat.inference_stats.success.ns = s.success_ns
@@ -228,7 +247,7 @@ class InferenceServerCore:
             lines.extend(rows)
 
         success, failure, count, exec_count, duration = [], [], [], [], []
-        fused_hist = []
+        fused_hist, rejected, timed_out = [], [], []
         with self._stats_lock:
             stats_snapshot = dict(self._stats)
         for name, s in sorted(stats_snapshot.items()):
@@ -244,6 +263,10 @@ class InferenceServerCore:
                                   % (label, s.execution_count))
                 duration.append("nv_inference_request_duration_us%s %d"
                                 % (label, (s.success_ns + s.fail_ns) // 1000))
+                rejected.append("tpu_request_rejected_total%s %d"
+                                % (label, s.rejected_count))
+                timed_out.append("tpu_request_timeout_total%s %d"
+                                 % (label, s.timeout_count))
                 for size in sorted(s.batch_hist):
                     fused_hist.append(
                         'tpu_batch_fused_total{model="%s",size="%d"} %d'
@@ -260,9 +283,16 @@ class InferenceServerCore:
                "Cumulative inference request duration", duration)
         family("tpu_batch_fused_total", "counter",
                "Fused executions per executed batch size", fused_hist)
+        family("tpu_request_rejected_total", "counter",
+               "Requests rejected by queue-policy admission control "
+               "(max_queue_size)", rejected)
+        family("tpu_request_timeout_total", "counter",
+               "Requests expired by their queue deadline before "
+               "dispatch", timed_out)
 
         pending_rows, inflight_rows, delay_rows, overlap_rows = \
             [], [], [], []
+        queue_rows = []
         with self._batchers_lock:
             batchers_snapshot = dict(self._batchers)
         for name, batcher in sorted(batchers_snapshot.items()):
@@ -271,6 +301,12 @@ class InferenceServerCore:
             except Exception:  # noqa: BLE001 — metrics never take
                 continue  # the server down
             label = '{model="%s"}' % name
+            # Deliberately the same sample as tpu_batch_pending_depth:
+            # tpu_queue_size is the stable queue-policy-facing name
+            # (paired with tpu_request_rejected_total); the batch_*
+            # family stays for PR 1 dashboards.
+            queue_rows.append("tpu_queue_size%s %d"
+                              % (label, snap["pending_count"]))
             pending_rows.append("tpu_batch_pending_depth%s %d"
                                 % (label, snap["pending_count"]))
             inflight_rows.append("tpu_batch_inflight%s %d"
@@ -279,6 +315,9 @@ class InferenceServerCore:
                               % (label, snap["queue_delay_us"]))
             overlap_rows.append("tpu_batch_overlap_ratio%s %.6f"
                                 % (label, snap["overlap_ratio"]))
+        family("tpu_queue_size", "gauge",
+               "Requests pending in the per-model scheduler queue "
+               "(admission-controlled by max_queue_size)", queue_rows)
         family("tpu_batch_pending_depth", "gauge",
                "Requests waiting in the dynamic batcher's bucket queues",
                pending_rows)
@@ -454,9 +493,13 @@ class InferenceServerCore:
         self.repository.unload(name)
 
     def shutdown(self) -> None:
-        """Teardown: stop batchers and flush buffered trace records —
-        log_frequency>0 buffers would otherwise silently drop the tail
-        of every trace file (Triton flushes on trace-file close)."""
+        """Teardown: flip /v2/health/ready to not-ready FIRST (load
+        balancers stop routing while the drain completes), then stop
+        batchers (which drain their queues) and flush buffered trace
+        records — log_frequency>0 buffers would otherwise silently drop
+        the tail of every trace file (Triton flushes on trace-file
+        close)."""
+        self.ready = False
         with self._batchers_lock:
             batchers, self._batchers = dict(self._batchers), {}
         for batcher in batchers.values():
@@ -482,6 +525,7 @@ class InferenceServerCore:
         with self._batchers_lock:
             batcher = self._batchers.get(model.name)
             if batcher is None:
+                stats = self._stats_for(model.name)
                 batcher = DynamicBatcher(
                     model,
                     max_queue_delay_us=int(
@@ -494,7 +538,17 @@ class InferenceServerCore:
                         getattr(model, "pipeline_depth", 0)),
                     fetch_workers=int(
                         getattr(model, "fetch_pool_workers", 0)),
-                    stats_hook=self._stats_for(model.name).record_batch,
+                    stats_hook=stats.record_batch,
+                    max_queue_size=int(
+                        getattr(model, "max_queue_size", 0)),
+                    default_timeout_us=int(getattr(
+                        model, "default_queue_policy_timeout_us", 0)),
+                    allow_timeout_override=bool(
+                        getattr(model, "allow_timeout_override", True)),
+                    timeout_action=str(
+                        getattr(model, "timeout_action", "REJECT")),
+                    reject_hook=stats.record_rejected,
+                    timeout_hook=stats.record_timeout,
                 )
                 self._batchers[model.name] = batcher
             return batcher
@@ -523,6 +577,8 @@ class InferenceServerCore:
         queue_ns = 0
         executions = 1
         try:
+            chaos.inject(model.name)  # fault injection (no-op unless
+            # configured); drops/errors ride the normal failure path
             inputs, params = self._decode_inputs(model, request)
             t1 = time.monotonic_ns()
             batcher = self._batcher_for(model)

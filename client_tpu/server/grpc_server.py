@@ -21,6 +21,9 @@ _STATUS_MAP = {
     "INVALID_ARGUMENT": grpc.StatusCode.INVALID_ARGUMENT,
     "ALREADY_EXISTS": grpc.StatusCode.ALREADY_EXISTS,
     "UNAVAILABLE": grpc.StatusCode.UNAVAILABLE,
+    "DEADLINE_EXCEEDED": grpc.StatusCode.DEADLINE_EXCEEDED,
+    "RESOURCE_EXHAUSTED": grpc.StatusCode.RESOURCE_EXHAUSTED,
+    "CANCELLED": grpc.StatusCode.CANCELLED,
     "INTERNAL": grpc.StatusCode.INTERNAL,
     "UNIMPLEMENTED": grpc.StatusCode.UNIMPLEMENTED,
 }

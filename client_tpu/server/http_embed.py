@@ -33,6 +33,8 @@ _STATUS_HTTP = {
     "INVALID_ARGUMENT": 400,
     "ALREADY_EXISTS": 409,
     "UNAVAILABLE": 503,
+    "DEADLINE_EXCEEDED": 504,
+    "RESOURCE_EXHAUSTED": 429,
     "UNIMPLEMENTED": 501,
     "INTERNAL": 500,
 }
@@ -40,9 +42,12 @@ _STATUS_HTTP = {
 Reply = Tuple[int, Dict[str, str], bytes]
 
 
-def _json_reply(obj, status: int = 200) -> Reply:
-    return (status, {"Content-Type": "application/json"},
-            json.dumps(obj).encode())
+def _json_reply(obj, status: int = 200,
+                extra_headers: Optional[Dict[str, str]] = None) -> Reply:
+    headers = {"Content-Type": "application/json"}
+    if extra_headers:
+        headers.update(extra_headers)
+    return (status, headers, json.dumps(obj).encode())
 
 
 def _int64_lists_to_ints(obj):
@@ -68,8 +73,11 @@ def _pb_reply(message) -> Reply:
 
 
 def _error_reply(error: InferenceServerException) -> Reply:
-    return _json_reply({"error": error.message()},
-                       _STATUS_HTTP.get(error.status() or "", 500))
+    status = _STATUS_HTTP.get(error.status() or "", 500)
+    # Retry-After on 503: parity with the aiohttp front-end so
+    # well-behaved clients back off from a saturated queue.
+    return _json_reply({"error": error.message()}, status,
+                       {"Retry-After": "1"} if status == 503 else None)
 
 
 def _pick_encoding(accept_encoding: str) -> Optional[str]:

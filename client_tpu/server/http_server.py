@@ -30,15 +30,20 @@ _STATUS_HTTP = {
     "INVALID_ARGUMENT": 400,
     "ALREADY_EXISTS": 409,
     "UNAVAILABLE": 503,
+    "DEADLINE_EXCEEDED": 504,
+    "RESOURCE_EXHAUSTED": 429,
     "INTERNAL": 500,
     "UNIMPLEMENTED": 501,
 }
 
 
 def _error_response(error: InferenceServerException) -> web.Response:
+    status = _STATUS_HTTP.get(error.status() or "", 500)
+    # 503s carry Retry-After so well-behaved clients (and LBs) back
+    # off instead of hammering a saturated queue.
+    headers = {"Retry-After": "1"} if status == 503 else None
     return web.json_response(
-        {"error": error.message()},
-        status=_STATUS_HTTP.get(error.status() or "", 500),
+        {"error": error.message()}, status=status, headers=headers,
     )
 
 
@@ -606,6 +611,15 @@ def build_http_app(core: InferenceServerCore) -> web.Application:
                 ),
             )
         except InferenceServerException as e:
+            from client_tpu.server.chaos import ChaosDropError
+
+            if isinstance(e, ChaosDropError):
+                # Injected connection drop: sever the TCP transport so
+                # the client sees a reset mid-request, not an error
+                # body — the failure mode a crashed pod produces.
+                if request.transport is not None:
+                    request.transport.close()
+                raise ConnectionResetError("chaos drop") from e
             return _error_response(e)
 
     app = web.Application(client_max_size=1024**3)

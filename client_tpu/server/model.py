@@ -67,6 +67,18 @@ class ServedModel:
     # (0 = sized from pipeline depth).
     pipeline_depth: int = 0
     fetch_pool_workers: int = 0
+    # Queue policy (Triton ModelQueuePolicy semantics). max_queue_size
+    # bounds pending requests in the dynamic batcher (0 = unbounded;
+    # overflow rejected UNAVAILABLE at admission).
+    # default_queue_policy_timeout_us starts each request's queue
+    # deadline (0 = none); the per-request `timeout` parameter
+    # overrides it when allow_timeout_override is set. timeout_action:
+    # "REJECT" expires deadline-passed requests before dispatch
+    # (DEADLINE_EXCEEDED); "DELAY" keeps them queued (advisory).
+    max_queue_size: int = 0
+    default_queue_policy_timeout_us: int = 0
+    allow_timeout_override: bool = True
+    timeout_action: str = "REJECT"
 
     def __init__(self):
         self.inputs: List[TensorSpec] = []
@@ -146,6 +158,12 @@ class ServedModel:
                 self.preferred_batch_sizes)
             config.dynamic_batching.max_queue_delay_microseconds = (
                 self.max_queue_delay_us)
+            config.dynamic_batching.default_queue_policy_timeout_us = (
+                self.default_queue_policy_timeout_us)
+            config.dynamic_batching.max_queue_size = self.max_queue_size
+            config.dynamic_batching.allow_timeout_override = (
+                self.allow_timeout_override)
+            config.dynamic_batching.timeout_action = self.timeout_action
         self._extend_config(config)
         return config
 

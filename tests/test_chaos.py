@@ -1,0 +1,183 @@
+"""Fault-injection harness tests: chaos spec parsing, deterministic
+injection, and the --chaos perf-harness smoke run (the regression gate
+for "degrades gracefully")."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from client_tpu import robust
+from client_tpu.server import chaos
+from client_tpu.utils import InferenceServerException
+
+
+@pytest.fixture(autouse=True)
+def clean_chaos():
+    yield
+    chaos.configure(None)
+    robust.reset_retry_total()
+
+
+def test_spec_parsing():
+    config = chaos.ChaosConfig.from_spec(
+        "latency_ms=50,error_rate=0.1,drop_rate=0.01,seed=7,models=a+b")
+    assert config.latency_ms == 50.0
+    assert config.error_rate == 0.1
+    assert config.drop_rate == 0.01
+    assert config.seed == 7
+    assert config.models == {"a", "b"}
+    assert config.enabled
+    assert not chaos.ChaosConfig.from_spec("").enabled
+
+
+def test_spec_unknown_key_fails_loudly():
+    with pytest.raises(ValueError):
+        chaos.ChaosConfig.from_spec("latency=50")
+    with pytest.raises(ValueError):
+        chaos.ChaosConfig.from_spec("garbage")
+
+
+def test_inject_error_rate_deterministic():
+    chaos.configure(chaos.ChaosConfig(error_rate=1.0, seed=1))
+    with pytest.raises(InferenceServerException) as excinfo:
+        chaos.inject("m")
+    assert excinfo.value.status() == "UNAVAILABLE"
+    assert chaos.stats()["injected_errors"] == 1
+    # same seed, same outcome sequence
+    chaos.configure(chaos.ChaosConfig(error_rate=0.5, seed=42))
+    outcomes_a = []
+    for _ in range(20):
+        try:
+            chaos.inject("m")
+            outcomes_a.append(True)
+        except InferenceServerException:
+            outcomes_a.append(False)
+    chaos.configure(chaos.ChaosConfig(error_rate=0.5, seed=42))
+    outcomes_b = []
+    for _ in range(20):
+        try:
+            chaos.inject("m")
+            outcomes_b.append(True)
+        except InferenceServerException:
+            outcomes_b.append(False)
+    assert outcomes_a == outcomes_b
+    assert False in outcomes_a and True in outcomes_a
+
+
+def test_inject_drop_is_distinguishable():
+    chaos.configure(chaos.ChaosConfig(drop_rate=1.0, seed=3))
+    with pytest.raises(chaos.ChaosDropError):
+        chaos.inject("m")
+    assert chaos.stats()["injected_drops"] == 1
+    # still an InferenceServerException for paths that can't sever TCP
+    assert issubclass(chaos.ChaosDropError, InferenceServerException)
+
+
+def test_inject_latency_and_model_filter():
+    chaos.configure(chaos.ChaosConfig(latency_ms=30, seed=2,
+                                      models={"slow"}))
+    start = time.monotonic()
+    chaos.inject("other")  # filtered: no delay
+    assert time.monotonic() - start < 0.02
+    start = time.monotonic()
+    chaos.inject("slow")
+    assert time.monotonic() - start >= 0.025
+    assert chaos.stats()["delayed_requests"] == 1
+
+
+def test_disabled_is_noop():
+    chaos.configure(None)
+    chaos.inject("anything")  # must not raise or sleep
+    assert chaos.stats() == {"injected_errors": 0, "injected_drops": 0,
+                             "delayed_requests": 0}
+
+
+def test_core_counts_injected_errors_as_failures():
+    from client_tpu.server.app import build_core
+    from client_tpu.grpc._utils import get_inference_request
+
+    import client_tpu.grpc as grpcclient
+
+    core = build_core(["simple"])
+    try:
+        inputs = [grpcclient.InferInput("INPUT0", [16], "INT32"),
+                  grpcclient.InferInput("INPUT1", [16], "INT32")]
+        inputs[0].set_data_from_numpy(np.arange(16, dtype=np.int32))
+        inputs[1].set_data_from_numpy(np.ones(16, dtype=np.int32))
+        request = get_inference_request(model_name="simple", inputs=inputs)
+        chaos.configure(chaos.ChaosConfig(error_rate=1.0, seed=9))
+        with pytest.raises(InferenceServerException):
+            core.infer(request)
+        chaos.configure(None)
+        core.infer(request)  # healthy again once chaos is off
+        stats = core.model_statistics("simple")
+        assert stats.model_stats[0].inference_stats.fail.count == 1
+        assert stats.model_stats[0].inference_stats.success.count == 1
+    finally:
+        core.shutdown()
+
+
+def test_chaos_smoke_perf_harness(capsys):
+    """The regression-gated chaos claim: under injected faults at
+    concurrency 4, retries recover >= 90% of retryable failures, no
+    request hangs (the run completes), and the report shows the
+    recovery."""
+    from client_tpu.perf.cli import run
+
+    rc = run([
+        "-m", "simple", "--service-kind", "inprocess",
+        "--request-count", "40", "-p", "4000",
+        "--concurrency-range", "4",
+        "--chaos", "error_rate=0.25,seed=11",
+        "--retries", "4",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "Chaos summary" in out
+    assert "client retries:" in out
+    # parse the recovery line: "recovered R/F injected faults"
+    recovered_line = [line for line in out.splitlines()
+                      if "recovered" in line]
+    assert recovered_line, out
+    fraction = recovered_line[0].split("recovered ")[1].split(" ")[0]
+    recovered, faults = (int(x) for x in fraction.split("/"))
+    assert faults > 0, "chaos must actually inject faults"
+    assert recovered >= 0.9 * faults, out
+
+
+def test_chaos_smoke_with_bounded_queue():
+    """Chaos + saturation end to end in-process: bounded queue sheds
+    load (nonzero rejects), nothing hangs, and retries recover the
+    rejections."""
+    from client_tpu.server.app import build_core
+    from tests.test_robustness import SlowBatchModel, _flood, _slow_inputs
+    from client_tpu.perf.client_backend import InProcessBackend
+
+    import client_tpu.grpc as grpcclient
+
+    core = build_core([])
+    core.repository.add_model(SlowBatchModel(delay_s=0.15,
+                                             name="slow_chaos"))
+    chaos.configure(chaos.ChaosConfig(error_rate=0.1, latency_ms=20,
+                                      seed=13))
+    robust.reset_retry_total()
+    policy = robust.RetryPolicy(max_attempts=10, initial_backoff_s=0.05,
+                                max_backoff_s=0.5)
+    backend = InProcessBackend(core, retry_policy=policy)
+    try:
+        ok, outcomes, hung = _flood(
+            lambda: backend.infer("slow_chaos", _slow_inputs(grpcclient)),
+            10)
+        assert hung == 0, "zero hung requests under fault"
+        stats = core.model_statistics("slow_chaos")
+        assert stats.model_stats[0].reject_count > 0, \
+            "2x-saturation load must hit the bounded queue"
+        assert robust.retry_total() > 0
+        # >= 90% of requests recovered via retries
+        assert ok >= 9, outcomes
+    finally:
+        backend.close()
+        chaos.configure(None)
+        core.shutdown()

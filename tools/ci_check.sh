@@ -1,10 +1,12 @@
 #!/usr/bin/env bash
 # Tier-1 gate: runs the ROADMAP.md tier-1 pytest command and fails if
 # the passed-test count (DOTS_PASSED) drops below the recorded seed
-# floor. Usage: tools/ci_check.sh [min_passed]
+# floor, then runs the chaos smoke (perf harness under fault
+# injection with client retries — the "degrades gracefully"
+# regression gate). Usage: tools/ci_check.sh [min_passed]
 set -u -o pipefail
 
-MIN_PASSED="${1:-290}"
+MIN_PASSED="${1:-305}"
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
 LOG=/tmp/_t1.log
 
@@ -23,4 +25,24 @@ if [ "$passed" -lt "$MIN_PASSED" ]; then
     exit 1
 fi
 echo "OK: tier-1 no worse than seed"
+
+# Chaos smoke: 25% injected errors at concurrency 4; the run must
+# complete (zero hung requests) and the recovery line must appear.
+echo "chaos smoke: perf harness under error_rate=0.25 with retries"
+CHAOS_LOG=/tmp/_chaos_smoke.log
+if ! timeout -k 10 180 env JAX_PLATFORMS=cpu python -m client_tpu.perf \
+    -m simple --service-kind inprocess --request-count 40 -p 4000 \
+    --concurrency-range 4 --chaos "error_rate=0.25,seed=11" --retries 4 \
+    > "$CHAOS_LOG" 2>&1; then
+    echo "FAIL: chaos smoke run did not complete" >&2
+    tail -20 "$CHAOS_LOG" >&2
+    exit 1
+fi
+if ! grep -q "Chaos summary" "$CHAOS_LOG"; then
+    echo "FAIL: chaos smoke produced no chaos summary" >&2
+    tail -20 "$CHAOS_LOG" >&2
+    exit 1
+fi
+grep -E "Chaos summary|goodput|retries|recovered" "$CHAOS_LOG"
+echo "OK: chaos smoke passed"
 exit 0

@@ -1,14 +1,25 @@
-"""Regenerate the BatchPipelineStatistics additions in inference_pb2.py.
+"""Regenerate the proto additions in inference_pb2.py / model_config_pb2.py.
 
 The container image carries no protoc / grpcio-tools, so proto schema
 changes are applied by editing the serialized FileDescriptorProto that
-``inference_pb2.py`` embeds: parse it with ``descriptor_pb2``, add the
-new message + field, re-serialize, and rewrite the ``AddSerializedFile``
-bytes literal in place.  Idempotent — running it again on an already
-patched file is a no-op.
+each ``*_pb2.py`` embeds: parse it with ``descriptor_pb2``, add the
+new messages + fields, re-serialize, and rewrite the
+``AddSerializedFile`` bytes literal in place.  Idempotent — running it
+again on an already patched file is a no-op.
+
+Patches applied:
+
+* inference_pb2.py — ``BatchPipelineStatistics`` +
+  ``ModelStatistics.pipeline_stats`` (PR 1), and the queue-policy drop
+  counters ``ModelStatistics.reject_count`` /
+  ``ModelStatistics.timeout_count`` (PR 2).
+* model_config_pb2.py — ``DynamicBatchingConfig.max_queue_size`` /
+  ``allow_timeout_override`` / ``timeout_action`` (PR 2 queue policy;
+  ``default_queue_policy_timeout_us`` has been in the schema since the
+  seed).
 
 The ``_serialized_start/_serialized_end`` attribute lines at the bottom
-of the pb2 module go stale after the patch; they only execute when
+of the pb2 modules go stale after the patch; they only execute when
 ``_USE_C_DESCRIPTORS`` is False, which is never the case on the upb
 runtime this image ships (protobuf >= 4), so they are left untouched.
 
@@ -25,10 +36,13 @@ from google.protobuf import descriptor_pb2
 
 REPO = pathlib.Path(__file__).resolve().parents[1]
 PB2_PATH = REPO / "client_tpu" / "protocol" / "inference_pb2.py"
+MODEL_CONFIG_PB2_PATH = REPO / "client_tpu" / "protocol" / "model_config_pb2.py"
 
 U64 = descriptor_pb2.FieldDescriptorProto.TYPE_UINT64
 DOUBLE = descriptor_pb2.FieldDescriptorProto.TYPE_DOUBLE
 MESSAGE = descriptor_pb2.FieldDescriptorProto.TYPE_MESSAGE
+BOOL = descriptor_pb2.FieldDescriptorProto.TYPE_BOOL
+STRING = descriptor_pb2.FieldDescriptorProto.TYPE_STRING
 OPTIONAL = descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL
 
 # (name, number, type) — keep in sync with inference.proto.
@@ -42,15 +56,29 @@ PIPELINE_FIELDS = [
     ("overlap_ratio", 7, DOUBLE),
 ]
 
+# Queue-policy drop counters on ModelStatistics (pipeline_stats is 8).
+STATISTICS_FIELDS = [
+    ("reject_count", 9, U64),
+    ("timeout_count", 10, U64),
+]
 
-def extract_serialized(source: str) -> bytes:
+# Queue-policy knobs on DynamicBatchingConfig (field 3 is
+# default_queue_policy_timeout_us, present since the seed).
+QUEUE_POLICY_FIELDS = [
+    ("max_queue_size", 4, U64),
+    ("allow_timeout_override", 5, BOOL),
+    ("timeout_action", 6, STRING),
+]
+
+
+def extract_serialized(source: str, path: pathlib.Path) -> bytes:
     match = re.search(r"AddSerializedFile\((b'.*')\)", source)
     if not match:
-        raise SystemExit("no AddSerializedFile literal found in %s" % PB2_PATH)
+        raise SystemExit("no AddSerializedFile literal found in %s" % path)
     return eval(match.group(1))  # noqa: S307 — a bytes literal we just matched
 
 
-def patch(file_proto: descriptor_pb2.FileDescriptorProto) -> bool:
+def patch_inference(file_proto: descriptor_pb2.FileDescriptorProto) -> bool:
     names = [m.name for m in file_proto.message_type]
     changed = False
     if "BatchPipelineStatistics" not in names:
@@ -71,6 +99,24 @@ def patch(file_proto: descriptor_pb2.FileDescriptorProto) -> bool:
             type_name=".inference.BatchPipelineStatistics",
             json_name="pipelineStats")
         changed = True
+    for name, number, ftype in STATISTICS_FIELDS:
+        if not any(f.name == name for f in model_stats.field):
+            model_stats.field.add(name=name, number=number, type=ftype,
+                                  label=OPTIONAL, json_name=_json_name(name))
+            changed = True
+    return changed
+
+
+def patch_model_config(file_proto: descriptor_pb2.FileDescriptorProto) -> bool:
+    batching = next(
+        m for m in file_proto.message_type
+        if m.name == "DynamicBatchingConfig")
+    changed = False
+    for name, number, ftype in QUEUE_POLICY_FIELDS:
+        if not any(f.name == name for f in batching.field):
+            batching.field.add(name=name, number=number, type=ftype,
+                               label=OPTIONAL, json_name=_json_name(name))
+            changed = True
     return changed
 
 
@@ -79,12 +125,12 @@ def _json_name(snake: str) -> str:
     return head + "".join(part.capitalize() for part in rest)
 
 
-def main() -> None:
-    source = PB2_PATH.read_text()
+def _apply(path: pathlib.Path, patcher) -> None:
+    source = path.read_text()
     file_proto = descriptor_pb2.FileDescriptorProto()
-    file_proto.ParseFromString(extract_serialized(source))
-    if not patch(file_proto):
-        print("inference_pb2.py already patched; nothing to do")
+    file_proto.ParseFromString(extract_serialized(source, path))
+    if not patcher(file_proto):
+        print("%s already patched; nothing to do" % path)
         return
     new_literal = repr(file_proto.SerializeToString())
     assert new_literal.startswith("b'") and new_literal.endswith("'")
@@ -93,9 +139,13 @@ def main() -> None:
         lambda _: "AddSerializedFile(%s)" % new_literal,
         source,
     )
-    PB2_PATH.write_text(new_source)
-    print("patched %s (+BatchPipelineStatistics, "
-          "+ModelStatistics.pipeline_stats)" % PB2_PATH)
+    path.write_text(new_source)
+    print("patched %s" % path)
+
+
+def main() -> None:
+    _apply(PB2_PATH, patch_inference)
+    _apply(MODEL_CONFIG_PB2_PATH, patch_model_config)
 
 
 if __name__ == "__main__":
