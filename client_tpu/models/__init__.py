@@ -13,6 +13,7 @@ def builtin_model_factories(repository=None
                             ) -> Dict[str, Callable[[], ServedModel]]:
     from client_tpu.models.add_sub import AddSub
     from client_tpu.models.simple_extra import (
+        DynaSequence,
         RepeatInt32,
         SequenceAccumulator,
         StringAddSub,
@@ -39,6 +40,7 @@ def builtin_model_factories(repository=None
         ),
         "simple_string": StringAddSub,
         "simple_sequence": SequenceAccumulator,
+        "dyna_sequence": DynaSequence,
         "repeat_int32": RepeatInt32,
     }
     factories.update(extra_model_factories(repository))

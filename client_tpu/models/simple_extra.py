@@ -65,21 +65,27 @@ class StringAddSub(ServedModel):
 class SequenceAccumulator(ServedModel):
     """Stateful sequence model: per sequence-id running sum of the
     INT32 input; sequence_start resets, sequence_end drops the state.
-    Config advertises sequence_batching so clients schedule it through
-    their sequence path (parity: the dyna_sequence/simple_sequence
-    models the reference sequence examples call)."""
+    Schedules through the sequence scheduler's Direct strategy — the
+    model manages its own state keyed by the sequence_* parameters, so
+    the scheduler's job is slot bookkeeping, per-sequence ordering,
+    and idle reclamation (parity: the simple_sequence model the
+    reference sequence examples call)."""
 
-    def __init__(self, name: str = "simple_sequence"):
+    sequence_batching = True
+    sequence_strategy = "direct"
+
+    def __init__(self, name: str = "simple_sequence",
+                 max_sequence_idle_us: int = 0,
+                 max_candidate_sequences: int = 0):
         super().__init__()
         self.name = name
         self.platform = "python"
+        self.max_sequence_idle_us = max_sequence_idle_us
+        self.max_candidate_sequences = max_candidate_sequences
         self.inputs = [TensorSpec("INPUT", "INT32", [1])]
         self.outputs = [TensorSpec("OUTPUT", "INT32", [1])]
         self._lock = threading.Lock()
         self._state: Dict[int, int] = {}
-
-    def _extend_config(self, config) -> None:
-        config.sequence_batching.SetInParent()
 
     def infer(self, inputs: Dict[str, np.ndarray],
               parameters: Optional[dict] = None) -> Dict[str, np.ndarray]:
@@ -104,6 +110,85 @@ class SequenceAccumulator(ServedModel):
             if params.get("sequence_end"):
                 del self._state[sequence_id]
         return {"OUTPUT": np.array([total], dtype=np.int32)}
+
+
+class DynaSequence(ServedModel):
+    """Oldest-strategy sequence model with IMPLICIT state: the
+    scheduler injects CORRID/START/END/READY controls and carries the
+    running-sum STATE tensor between steps as a device-resident
+    ``jax.Array``, and dispatches every step through the dynamic
+    batcher — concurrent sequences' steps fuse into one batched
+    execution (parity: the reference's dyna_sequence model, whose
+    per-sequence results match simple_sequence's accumulation).
+
+    Per batch row: ``new_state = state * (1 - START) + INPUT``;
+    ``OUTPUT = new_state`` and ``STATE_OUT = new_state``. The START
+    control makes restart-in-place correct even inside a fused batch.
+    """
+
+    max_batch_size = 16
+    dynamic_batching = True
+    preferred_batch_sizes = [4, 8]
+    max_queue_delay_us = 2000
+    sequence_batching = True
+    sequence_strategy = "oldest"
+    max_candidate_sequences = 16
+    max_sequence_idle_us = 5_000_000
+    sequence_controls = [
+        {"name": "CORRID", "kind": "CONTROL_SEQUENCE_CORRID",
+         "datatype": "UINT64"},
+        {"name": "START", "kind": "CONTROL_SEQUENCE_START",
+         "datatype": "INT32"},
+        {"name": "END", "kind": "CONTROL_SEQUENCE_END",
+         "datatype": "INT32"},
+        {"name": "READY", "kind": "CONTROL_SEQUENCE_READY",
+         "datatype": "INT32"},
+    ]
+    sequence_states = [
+        {"input_name": "STATE_IN", "output_name": "STATE_OUT",
+         "datatype": "INT32", "dims": (1,)},
+    ]
+
+    def __init__(self, name: str = "dyna_sequence", **overrides):
+        super().__init__()
+        self.name = name
+        self.platform = "jax"
+        for key, value in overrides.items():
+            setattr(self, key, value)
+        self.inputs = [TensorSpec("INPUT", "INT32", [1])]
+        self.outputs = [TensorSpec("OUTPUT", "INT32", [1])]
+        self._step_fn = None
+
+    def _step(self):
+        """Jitted step, compiled once per fused batch shape. The state
+        buffer is donated: the previous step's HBM state is consumed
+        in place by the next step's execution (donation is a no-op on
+        backends that cannot alias, e.g. CPU)."""
+        if self._step_fn is None:
+            import jax
+
+            def step(value, state, start):
+                new_state = state * (1 - start) + value
+                return new_state, new_state
+
+            donate = (1,) if jax.default_backend() != "cpu" else ()
+            self._step_fn = jax.jit(step, donate_argnums=donate)
+        return self._step_fn
+
+    def infer(self, inputs: Dict[str, np.ndarray],
+              parameters: Optional[dict] = None) -> Dict[str, np.ndarray]:
+        value = inputs["INPUT"]
+        state = inputs.get("STATE_IN")
+        start = inputs.get("START")
+        if state is None or start is None:
+            raise InferenceServerException(
+                "model '%s' is sequence-batched: STATE_IN/START are "
+                "scheduler-injected — send requests with a sequence_id"
+                % self.name,
+                status="INVALID_ARGUMENT",
+            )
+        output, new_state = self._step()(value, state, start)
+        return {"OUTPUT": output, "STATE_OUT": new_state}
 
 
 class RepeatInt32(ServedModel):

@@ -308,6 +308,26 @@ def fusion_stats(core, model_name: str):
         return None
 
 
+def sequence_stats(core, model_name: str):
+    """Sequence-scheduler snapshot for bench evidence (slot occupancy
+    + lifetime counters from ModelStatistics.sequence_stats)."""
+    try:
+        stats = core.model_statistics(model_name)
+        seq = stats.model_stats[0].sequence_stats
+        return {
+            "active_sequences": int(seq.active_sequences),
+            "slot_total": int(seq.slot_total),
+            "backlog_depth": int(seq.backlog_depth),
+            "sequences_started": int(seq.sequences_started),
+            "sequences_completed": int(seq.sequences_completed),
+            "step_count": int(seq.step_count),
+            "fused_steps": int(seq.fused_steps),
+            "idle_reclaimed_total": int(seq.idle_reclaimed_total),
+        }
+    except Exception:  # noqa: BLE001 — evidence, never a failure
+        return None
+
+
 class PipelineSampler:
     """Polls the batcher gauges WHILE a measured run is live: pending
     depth and in-flight count are point-in-time values, so reading
@@ -353,9 +373,12 @@ class PipelineSampler:
 def run_python_harness(model: str, batch: int, concurrency: int,
                        shared_memory: str, output_shm: int,
                        core=None, address: str = "",
-                       warm_s: float = 3.0) -> tuple[float, float]:
+                       warm_s: float = 3.0,
+                       sequence_length: int = 0) -> tuple[float, float]:
     """Python harness measurement; in-process when ``core`` is given,
-    gRPC otherwise; (throughput, p50_us)."""
+    gRPC otherwise; (throughput, p50_us). ``sequence_length`` > 0
+    drives sequence load (each context runs whole sequences through
+    the server's sequence scheduler)."""
     from client_tpu.perf.client_backend import (
         BackendKind,
         ClientBackendFactory,
@@ -364,6 +387,7 @@ def run_python_harness(model: str, batch: int, concurrency: int,
     from client_tpu.perf.load_manager import (
         ConcurrencyManager,
         InferDataManager,
+        SequenceManager,
     )
     from client_tpu.perf.model_parser import ModelParser
     from client_tpu.perf.profiler import InferenceProfiler, MeasurementConfig
@@ -382,9 +406,15 @@ def run_python_harness(model: str, batch: int, concurrency: int,
                       tpu_arena_url=address)
     data_manager = InferDataManager(parsed, loader, batch_size=batch,
                                     **kwargs)
+    sequence_manager = None
+    if sequence_length > 0:
+        sequence_manager = SequenceManager(
+            sequence_length=sequence_length,
+            sequence_length_variation=0.0)
     manager = ConcurrencyManager(
         factory=factory, model=parsed, data_loader=loader,
         data_manager=data_manager, async_mode=True, max_threads=8,
+        sequence_manager=sequence_manager,
     )
     manager.init()
     config = MeasurementConfig(measurement_interval_ms=2000, max_trials=4,
@@ -952,6 +982,44 @@ def main() -> None:
                  # exec probe pads seq to the 128 bucket (the corrected
                  # probe's dynamic-dim default) at a preferred batch.
                  mfu_probe=("bert_base", 32, 128))
+    # Config 3b: dyna_sequence — stateful sequence serving through the
+    # sequence scheduler (BASELINE config 3's dyna_sequence path). 12
+    # concurrent sequences under the Oldest strategy: each step
+    # carries device-resident implicit state and dispatches through
+    # the dynamic batcher, so steps from distinct sequences fuse
+    # (fusion_ratio < 1 and mean_fused_step_batch > 1 are the proof).
+    if remaining() > 90 and stage_wanted("dyna_sequence_inprocess"):
+        try:
+            run_with_watchdog(
+                "dyna_sequence load",
+                lambda: core.repository.load("dyna_sequence"),
+                min(120.0, max(30.0, remaining() - 60)))
+            before = fusion_stats(core, "dyna_sequence")
+            tput, p50 = run_python_harness(
+                "dyna_sequence", 1, 12, "none", 0, core=core,
+                warm_s=1.0, sequence_length=10)
+            after = fusion_stats(core, "dyna_sequence")
+            extra = {"concurrency": 12, "sequence_length": 10}
+            if before and after:
+                d_infer = after["inference_count"] - before["inference_count"]
+                d_exec = after["execution_count"] - before["execution_count"]
+                if d_infer > 0 and d_exec > 0:
+                    extra["fusion_ratio"] = round(d_exec / d_infer, 4)
+                    extra["mean_fused_step_batch"] = round(
+                        d_infer / d_exec, 2)
+                    extra["fused_requests"] = d_infer
+                    extra["fused_executions"] = d_exec
+            seq = sequence_stats(core, "dyna_sequence")
+            if seq:
+                extra["sequences_started"] = seq["sequences_started"]
+                extra["sequence_steps"] = seq["step_count"]
+                extra["sequence_slot_total"] = seq["slot_total"]
+                extra["sequence_idle_reclaimed"] = \
+                    seq["idle_reclaimed_total"]
+            record_stage("dyna_sequence_inprocess", tput, p50, extra)
+        except Exception as exc:  # noqa: BLE001
+            log("dyna_sequence_inprocess failed: %s" % exc)
+
     # Config 4: ensemble (preprocess -> resnet50 -> postprocess) over
     # bidi streaming gRPC with decoupled outputs. Concurrency 32 for
     # the same latency-floor reason; the backbone step fuses across

@@ -36,6 +36,8 @@ class TpuMetrics:
     batch_inflight: Dict[str, float] = field(default_factory=dict)
     batch_queue_delay_us: Dict[str, float] = field(default_factory=dict)
     batch_overlap_ratio: Dict[str, float] = field(default_factory=dict)
+    sequence_active: Dict[str, float] = field(default_factory=dict)
+    sequence_backlog: Dict[str, float] = field(default_factory=dict)
 
 
 _FAMILIES = {
@@ -46,6 +48,8 @@ _FAMILIES = {
     "tpu_batch_inflight": "batch_inflight",
     "tpu_batch_queue_delay_us": "batch_queue_delay_us",
     "tpu_batch_overlap_ratio": "batch_overlap_ratio",
+    "tpu_sequence_active": "sequence_active",
+    "tpu_sequence_backlog": "sequence_backlog",
 }
 
 
@@ -136,7 +140,8 @@ def summarize_metrics(snapshots: List[TpuMetrics]) -> Dict[str, Dict[str, float]
     out: Dict[str, Dict[str, float]] = {}
     for attr in ("hbm_used_bytes", "hbm_total_bytes", "hbm_utilization",
                  "batch_pending_depth", "batch_inflight",
-                 "batch_queue_delay_us", "batch_overlap_ratio"):
+                 "batch_queue_delay_us", "batch_overlap_ratio",
+                 "sequence_active", "sequence_backlog"):
         values = []
         for snap in snapshots:
             per_device = getattr(snap, attr)

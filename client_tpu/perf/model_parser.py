@@ -39,6 +39,15 @@ class ParsedModel:
         self.outputs: Dict[str, ModelTensor] = {}
         self.scheduler_type = SchedulerType.NONE
         self.decoupled = False
+        # sequence_batching details (populated for SEQUENCE models):
+        # the same knobs the server's scheduler enforces, so the load
+        # manager and report can size/describe sequence runs.
+        self.sequence_strategy = "direct"
+        self.max_candidate_sequences = 0
+        self.max_sequence_idle_us = 0
+        self.sequence_controls: List[Dict] = []
+        self.sequence_states: List[Dict] = []
+        self.sequence_preferred_batch_sizes: List[int] = []
         self.composing_models: List[str] = []
         # True when any composing model is sequence-batched: the load
         # manager must then drive sequences even though the top model
@@ -96,6 +105,8 @@ class ModelParser:
             model.scheduler_type = SchedulerType.ENSEMBLE
         elif "sequence_batching" in config:
             model.scheduler_type = SchedulerType.SEQUENCE
+            self._parse_sequence_batching(
+                config["sequence_batching"] or {}, model)
         elif "dynamic_batching" in config:
             model.scheduler_type = SchedulerType.DYNAMIC
         policy = config.get("model_transaction_policy", {})
@@ -117,6 +128,34 @@ class ModelParser:
                 and model.composing_sequential):
             model.scheduler_type = SchedulerType.ENSEMBLE_SEQUENCE
         return model
+
+    @staticmethod
+    def _parse_sequence_batching(section: dict, model: ParsedModel) -> None:
+        """Full sequence_batching parse (strategy, controls, state,
+        idle timeout) so the harness sees the same config the server's
+        scheduler enforces. proto-JSON stringifies (u)int64 — numeric
+        fields go through int()."""
+        model.sequence_strategy = str(
+            section.get("strategy") or "direct").lower()
+        model.max_candidate_sequences = int(
+            section.get("max_candidate_sequences", 0) or 0)
+        model.max_sequence_idle_us = int(
+            section.get("max_sequence_idle_microseconds", 0) or 0)
+        model.sequence_controls = [
+            {"name": c.get("name", ""), "kind": c.get("kind", ""),
+             "datatype": str(c.get("data_type", "")).replace("TYPE_", "")}
+            for c in section.get("control_input", [])
+        ]
+        model.sequence_states = [
+            {"input_name": s.get("input_name", ""),
+             "output_name": s.get("output_name", ""),
+             "datatype": str(s.get("data_type", "")).replace("TYPE_", ""),
+             "dims": [int(d) for d in s.get("dims", [])]}
+            for s in section.get("state", [])
+        ]
+        model.sequence_preferred_batch_sizes = [
+            int(size) for size in section.get("preferred_batch_size", [])
+        ]
 
     def _add_composing(self, backend, config: dict, model: ParsedModel,
                        seen: set) -> None:

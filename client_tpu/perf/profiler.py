@@ -104,7 +104,17 @@ def _normalize_stats_entry(entry: Dict) -> Dict:
             name: float(value) if name == "overlap_ratio" else int(value)
             for name, value in out["pipeline_stats"].items()
         }
+    if "sequence_stats" in out:
+        out["sequence_stats"] = {
+            name: int(value)
+            for name, value in out["sequence_stats"].items()
+        }
     return out
+
+
+# sequence_stats gauges pass through as window-end values in deltas
+# and merges (active/backlog/slot_total are occupancy, not counters).
+_SEQUENCE_GAUGES = ("active_sequences", "slot_total", "backlog_depth")
 
 
 def _numeric_delta(before, after):
@@ -167,6 +177,15 @@ def _accumulate_server_stats(total: Dict, part: Dict) -> Dict:
                 summed["batch_size"] = size
                 by_size[size] = summed
             acc["batch_stats"] = list(by_size.values())
+        seq_prior = prior.get("sequence_stats", {})
+        seq_part = entry.get("sequence_stats", {})
+        if seq_prior or seq_part:
+            seq = (_accumulate_numeric(seq_prior, seq_part)
+                   if seq_part else dict(seq_prior))
+            for gauge in _SEQUENCE_GAUGES:
+                if gauge in seq_part:
+                    seq[gauge] = seq_part[gauge]
+            acc["sequence_stats"] = seq
         pipe_prior = prior.get("pipeline_stats", {})
         pipe_part = entry.get("pipeline_stats", {})
         if pipe_prior or pipe_part:
@@ -216,6 +235,13 @@ def _delta_server_stats(before: Dict, after: Dict) -> Dict:
             pipe["overlap_ratio"] = (
                 pipe.get("overlap_ns", 0) / fetch_ns if fetch_ns else 0.0)
             delta["pipeline_stats"] = pipe
+        if "sequence_stats" in entry:
+            seq = _numeric_delta(prior.get("sequence_stats", {}),
+                                 entry["sequence_stats"])
+            for gauge in _SEQUENCE_GAUGES:
+                if gauge in entry["sequence_stats"]:
+                    seq[gauge] = entry["sequence_stats"][gauge]
+            delta["sequence_stats"] = seq
         out.append(delta)
     return {"model_stats": out}
 

@@ -47,6 +47,25 @@ def print_report(results: List[PerfStatus], percentile: int = 0,
                    entry.get("execution_count", 0), us("queue"),
                    us("compute_input"), us("compute_infer"),
                    us("compute_output")))
+            seq = entry.get("sequence_stats") or {}
+            if seq.get("step_count") or seq.get("active_sequences"):
+                slot_total = seq.get("slot_total", 0)
+                active = seq.get("active_sequences", 0)
+                util = active / slot_total if slot_total else 0.0
+                executions = entry.get("execution_count", 0)
+                fused_batch = count / executions if executions else 0.0
+                print(
+                    "    sequences %s: %d active / %d slots "
+                    "(%.0f%% utilized), %d started, %d completed, "
+                    "%d steps (%d via dynamic batcher, mean fused "
+                    "batch %.2f), backlog %d, idle-reclaimed %d"
+                    % (entry.get("name", "?"), active, slot_total,
+                       util * 100.0, seq.get("sequences_started", 0),
+                       seq.get("sequences_completed", 0),
+                       seq.get("step_count", 0),
+                       seq.get("fused_steps", 0), fused_batch,
+                       seq.get("backlog_depth", 0),
+                       seq.get("idle_reclaimed_total", 0)))
         if status.tpu_metrics:
             hbm = status.tpu_metrics.get("hbm_used_bytes")
             util = status.tpu_metrics.get("hbm_utilization")

@@ -38,7 +38,12 @@ into a pipeline:
   wall-clock actually overlapped compute — the served-path number the
   statistics endpoints report as ``overlap_ratio``.
 
-Sequence requests bypass batching entirely (state is per-request)."""
+Sequence requests route through the sequence scheduler
+(client_tpu.server.sequence) instead of entering here directly; under
+the oldest strategy that scheduler dispatches per-sequence STEPS into
+this batcher (controls and device-resident state already attached,
+sequence_* params stripped), so steps from distinct sequences fuse
+like any other concurrent requests."""
 
 from __future__ import annotations
 

@@ -137,6 +137,10 @@ class InferenceServerCore:
         self._stats_lock = threading.Lock()
         self._batchers: Dict[str, object] = {}
         self._batchers_lock = threading.Lock()
+        # Sequence-batching schedulers, one per sequence model
+        # (client_tpu.server.sequence), created lazily like batchers.
+        self._sequencers: Dict[str, object] = {}
+        self._sequencers_lock = threading.Lock()
         self._trace_settings: Dict[str, Dict[str, list]] = {"": {
             "trace_file": [""], "trace_level": ["OFF"], "trace_rate": ["1000"],
             "trace_count": ["-1"], "log_frequency": ["0"],
@@ -231,6 +235,19 @@ class InferenceServerCore:
                 pipe.fetch_ns = snap["fetch_ns"]
                 pipe.overlap_ns = snap["overlap_ns"]
                 pipe.overlap_ratio = snap["overlap_ratio"]
+            with self._sequencers_lock:
+                sequencer = self._sequencers.get(model.name)
+            if sequencer is not None:
+                snap = sequencer.stats_snapshot()
+                seq = stat.sequence_stats
+                seq.active_sequences = snap["active_sequences"]
+                seq.slot_total = snap["slot_total"]
+                seq.backlog_depth = snap["backlog_depth"]
+                seq.idle_reclaimed_total = snap["idle_reclaimed_total"]
+                seq.sequences_started = snap["sequences_started"]
+                seq.sequences_completed = snap["sequences_completed"]
+                seq.step_count = snap["step_count"]
+                seq.fused_steps = snap["fused_steps"]
         return response
 
     def metrics_text(self) -> str:
@@ -329,6 +346,36 @@ class InferenceServerCore:
         family("tpu_batch_overlap_ratio", "gauge",
                "Fraction of output-fetch time with other batches' "
                "compute or fetch in flight", overlap_rows)
+
+        active_rows, slots_rows, backlog_rows, reclaimed_rows = \
+            [], [], [], []
+        with self._sequencers_lock:
+            sequencers_snapshot = dict(self._sequencers)
+        for name, sequencer in sorted(sequencers_snapshot.items()):
+            try:
+                snap = sequencer.stats_snapshot()
+            except Exception:  # noqa: BLE001 — metrics never take
+                continue  # the server down
+            label = '{model="%s"}' % name
+            active_rows.append("tpu_sequence_active%s %d"
+                               % (label, snap["active_sequences"]))
+            slots_rows.append("tpu_sequence_slots_total%s %d"
+                              % (label, snap["slot_total"]))
+            backlog_rows.append("tpu_sequence_backlog%s %d"
+                                % (label, snap["backlog_depth"]))
+            reclaimed_rows.append(
+                "tpu_sequence_idle_reclaimed_total%s %d"
+                % (label, snap["idle_reclaimed_total"]))
+        family("tpu_sequence_active", "gauge",
+               "Sequences currently holding a scheduler slot",
+               active_rows)
+        family("tpu_sequence_slots_total", "gauge",
+               "Configured candidate-sequence slots", slots_rows)
+        family("tpu_sequence_backlog", "gauge",
+               "Sequence starts waiting for a free slot", backlog_rows)
+        family("tpu_sequence_idle_reclaimed_total", "counter",
+               "Sequence slots reclaimed by the idle timeout "
+               "(max_sequence_idle_microseconds)", reclaimed_rows)
 
         used_rows, total_rows, util_rows = [], [], []
         try:
@@ -481,6 +528,10 @@ class InferenceServerCore:
         model.warmup()
 
     def unload_model(self, name: str) -> None:
+        with self._sequencers_lock:
+            sequencer = self._sequencers.pop(name, None)
+        if sequencer is not None:
+            sequencer.stop()
         with self._batchers_lock:
             batcher = self._batchers.pop(name, None)
         if batcher is not None:
@@ -500,6 +551,10 @@ class InferenceServerCore:
         the tail of every trace file (Triton flushes on trace-file
         close)."""
         self.ready = False
+        with self._sequencers_lock:
+            sequencers, self._sequencers = dict(self._sequencers), {}
+        for sequencer in sequencers.values():
+            sequencer.stop()  # backlogged starts fail UNAVAILABLE
         with self._batchers_lock:
             batchers, self._batchers = dict(self._batchers), {}
         for batcher in batchers.values():
@@ -553,6 +608,32 @@ class InferenceServerCore:
                 self._batchers[model.name] = batcher
             return batcher
 
+    def _sequencer_for(self, model):
+        """Lazily creates the model's sequence scheduler (None when the
+        model doesn't declare sequence_batching)."""
+        from client_tpu.server.sequence import (
+            SequenceScheduler,
+            wants_sequence_batching,
+        )
+
+        if not wants_sequence_batching(model):
+            return None
+        with self._sequencers_lock:
+            sequencer = self._sequencers.get(model.name)
+            if sequencer is None:
+                stats = self._stats_for(model.name)
+                sequencer = SequenceScheduler(
+                    model,
+                    # Oldest-strategy steps dispatch through the
+                    # model's own dynamic batcher so concurrent
+                    # sequences fuse (None for direct-only models).
+                    batcher=self._batcher_for(model),
+                    reject_hook=stats.record_rejected,
+                    timeout_hook=stats.record_timeout,
+                )
+                self._sequencers[model.name] = sequencer
+            return sequencer
+
     def _record_composing(self, name: str, count: int,
                           compute_ns: int, executions: int = 1) -> None:
         """Stats hook ensembles call per composing-step execution, so
@@ -582,7 +663,17 @@ class InferenceServerCore:
             inputs, params = self._decode_inputs(model, request)
             t1 = time.monotonic_ns()
             batcher = self._batcher_for(model)
-            if batcher is not None and "sequence_id" not in params:
+            sequencer = (self._sequencer_for(model)
+                         if params.get("sequence_id") else None)
+            if sequencer is not None:
+                # Correlated request: the sequence scheduler owns slot
+                # assignment, per-sequence ordering, control/state
+                # injection, and (oldest strategy) dispatch into the
+                # dynamic batcher for cross-sequence step fusion.
+                batch = self._batch_size(model, request)
+                outputs, queue_ns, executions = sequencer.infer(
+                    inputs, params, batch)
+            elif batcher is not None and "sequence_id" not in params:
                 batch = self._batch_size(model, request)
                 outputs, queue_ns, leader = batcher.infer(
                     inputs, params, batch)

@@ -119,9 +119,11 @@ class InferenceServicer(GRPCInferenceServiceServicer):
                 generator.close()
 
         def run_after(prev, request):
-            # Same-sequence requests must execute in arrival order —
-            # sequence state is ordered — so each chains on its
-            # predecessor; distinct sequences still run concurrently.
+            # Same-sequence requests must reach the sequence scheduler
+            # in arrival order (it serializes execution, but ordering
+            # of ticket issue is the transport's to preserve) — so
+            # each chains on its predecessor; distinct sequences still
+            # run concurrently.
             if prev is not None:
                 try:
                     prev.result()
@@ -130,7 +132,22 @@ class InferenceServicer(GRPCInferenceServiceServicer):
             run_one(request)
 
         def reader():
+            # key -> tail future of that correlation id's chain. An
+            # entry is dropped as soon as its tail future completes
+            # while still being the tail (sequence ended, errored, or
+            # simply idle) — before this a long-lived stream kept one
+            # future alive per correlation id it ever saw.
             sequence_tail = {}
+            tail_lock = threading.Lock()
+
+            def drop_when_tail(key, future):
+                def _done(f):
+                    with tail_lock:
+                        if sequence_tail.get(key) is f:
+                            del sequence_tail[key]
+
+                future.add_done_callback(_done)
+
             try:
                 with ThreadPoolExecutor(
                         max_workers=self.STREAM_WORKERS,
@@ -142,8 +159,12 @@ class InferenceServicer(GRPCInferenceServiceServicer):
                             key = (param.int64_param or
                                    param.string_param or None)
                         if key:
-                            sequence_tail[key] = pool.submit(
-                                run_after, sequence_tail.get(key), request)
+                            with tail_lock:
+                                prev = sequence_tail.get(key)
+                                future = pool.submit(
+                                    run_after, prev, request)
+                                sequence_tail[key] = future
+                            drop_when_tail(key, future)
                         else:
                             pool.submit(run_one, request)
                     # with-block: waits for every in-flight request

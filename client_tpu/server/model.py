@@ -79,6 +79,27 @@ class ServedModel:
     default_queue_policy_timeout_us: int = 0
     allow_timeout_override: bool = True
     timeout_action: str = "REJECT"
+    # Sequence batching (client_tpu.server.sequence): correlated
+    # request streams are scheduled onto per-sequence slots. strategy
+    # "direct" pins a slot per sequence and executes steps singly;
+    # "oldest" dispatches steps through the dynamic batcher so
+    # concurrent sequences' steps fuse into one execution.
+    # max_candidate_sequences bounds live sequences (0 = scheduler
+    # default); max_sequence_idle_us reclaims idle slots (0 = never).
+    # sequence_controls: [{"name", "kind", "datatype"}] tensors the
+    # scheduler injects per step (kinds CONTROL_SEQUENCE_START / _END /
+    # _READY / _CORRID). sequence_states: [{"input_name",
+    # "output_name", "datatype", "dims"}] implicit state carried
+    # between steps, device-resident on TPU.
+    # sequence_preferred_batch_sizes hints the oldest strategy's fused
+    # step sizes (falls back to preferred_batch_sizes).
+    sequence_batching: bool = False
+    sequence_strategy: str = "direct"
+    max_candidate_sequences: int = 0
+    max_sequence_idle_us: int = 0
+    sequence_controls: list = []
+    sequence_states: list = []
+    sequence_preferred_batch_sizes: list = []
 
     def __init__(self):
         self.inputs: List[TensorSpec] = []
@@ -164,6 +185,33 @@ class ServedModel:
             config.dynamic_batching.allow_timeout_override = (
                 self.allow_timeout_override)
             config.dynamic_batching.timeout_action = self.timeout_action
+        if self.sequence_batching:
+            from client_tpu.server.sequence import (
+                DEFAULT_CANDIDATE_SEQUENCES,
+            )
+
+            sb = config.sequence_batching
+            sb.SetInParent()
+            sb.strategy = self.sequence_strategy or "direct"
+            sb.max_candidate_sequences = (
+                self.max_candidate_sequences or DEFAULT_CANDIDATE_SEQUENCES)
+            sb.max_sequence_idle_microseconds = self.max_sequence_idle_us
+            for entry in self.sequence_controls:
+                sb.control_input.add(
+                    name=entry["name"], kind=entry["kind"],
+                    data_type=_WIRE_TO_CONFIG_DTYPE[
+                        entry.get("datatype", "INT32")])
+            for entry in self.sequence_states:
+                state = sb.state.add(
+                    input_name=entry["input_name"],
+                    output_name=entry["output_name"],
+                    data_type=_WIRE_TO_CONFIG_DTYPE[
+                        entry.get("datatype", "FP32")])
+                state.dims.extend(
+                    int(d) for d in entry.get("dims", (1,)))
+            sb.preferred_batch_size.extend(
+                self.sequence_preferred_batch_sizes
+                or self.preferred_batch_sizes)
         self._extend_config(config)
         return config
 

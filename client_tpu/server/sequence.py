@@ -1,0 +1,536 @@
+"""Sequence-batching scheduler: stateful sequence serving with
+device-resident implicit state.
+
+The TPU-first counterpart of Triton's sequence batcher (the scheduler
+behind `simple_sequence` / `dyna_sequence` and perf_analyzer's
+sequence load modes). It sits between the front-ends and the PR-1
+pipelined dynamic batcher and owns everything a correlated stream of
+requests needs that a stateless scheduler cannot provide:
+
+* **Slot assignment.** Each live sequence holds one of
+  ``max_candidate_sequences`` slots from its first step
+  (``sequence_start``) to its last (``sequence_end``). Two strategies,
+  parsed from the model's ``sequence_batching`` config:
+
+  - **Direct** — the slot is pinned for the sequence lifetime and
+    every step executes as its own model call (the contract for models
+    that manage their own per-correlation-id state, like
+    `simple_sequence`).
+  - **Oldest** — each step dispatches into the model's dynamic
+    batcher, oldest sequence first, so concurrent steps from DISTINCT
+    sequences fuse into one device execution instead of N singles
+    (the Orca-style cross-sequence step fusion that dominates
+    stateful-serving throughput). ``preferred_batch_size`` and
+    ``max_candidate_sequences`` bound the fused step batch.
+
+* **Per-sequence ordering.** Steps of one sequence execute in arrival
+  order (a ticket turnstile per slot); steps of distinct sequences run
+  concurrently. This replaces transport-level chaining as the ordering
+  authority — the gRPC stream path still submits in arrival order, but
+  correctness no longer depends on it.
+
+* **Control-input injection.** Models that declare ``control_input``
+  get CORRID / START / END / READY tensors injected into every step
+  (shaped ``[batch, 1]`` for batching models), matching the reference
+  `dyna_sequence` contract; the client never sends them.
+
+* **Implicit state** (``sequence_batching.state``). Per-slot state
+  tensors live in HBM as ``jax.Array``s between steps: step N's state
+  output is handed to step N+1's execution as a device array — state
+  never round-trips through the ~65 ms relay fetch path (the
+  TPU-native analogue of the reference's CUDA-shm state story), and
+  models can donate the buffer into the next XLA call.
+
+* **Backlog admission.** When every slot is busy a new sequence start
+  waits in the backlog, governed by the model's PR-2 queue policy:
+  ``max_queue_size`` bounds the backlog (overflow rejected
+  UNAVAILABLE) and ``default_queue_policy_timeout_us`` (or the
+  per-request ``timeout`` parameter) expires waiting starts
+  DEADLINE_EXCEEDED.
+
+* **Idle reclamation.** A sequence idle longer than
+  ``max_sequence_idle_microseconds`` loses its slot (freeing it for
+  the backlog); subsequent steps fail "sequence ... not started".
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from client_tpu.utils import InferenceServerException, triton_to_np_dtype
+
+NANOS_PER_US = 1_000
+
+CONTROL_START = "CONTROL_SEQUENCE_START"
+CONTROL_END = "CONTROL_SEQUENCE_END"
+CONTROL_READY = "CONTROL_SEQUENCE_READY"
+CONTROL_CORRID = "CONTROL_SEQUENCE_CORRID"
+
+# Slots when the model declares sequence_batching without sizing it.
+DEFAULT_CANDIDATE_SEQUENCES = 32
+
+
+class ControlSpec:
+    """One injected control tensor (name + kind + dtype)."""
+
+    __slots__ = ("name", "kind", "datatype")
+
+    def __init__(self, name: str, kind: str, datatype: str = "INT32"):
+        self.name = name
+        self.kind = kind
+        self.datatype = datatype
+
+
+class StateSpec:
+    """One implicit-state tensor pair (model reads input_name, writes
+    output_name; the scheduler carries the value between steps)."""
+
+    __slots__ = ("input_name", "output_name", "datatype", "dims")
+
+    def __init__(self, input_name: str, output_name: str,
+                 datatype: str = "FP32", dims=(1,)):
+        self.input_name = input_name
+        self.output_name = output_name
+        self.datatype = datatype
+        self.dims = tuple(int(d) for d in dims)
+
+
+class _Slot:
+    """One live sequence: its slot id, device-resident state, and the
+    ticket turnstile that serializes its steps."""
+
+    __slots__ = ("index", "corrid", "state", "last_step_ns", "next_ticket",
+                 "serving", "ended", "reclaimed")
+
+    def __init__(self, index: int, corrid):
+        self.index = index
+        self.corrid = corrid
+        self.state: Dict[str, object] = {}
+        self.last_step_ns = time.monotonic_ns()
+        self.next_ticket = 0   # next ticket to hand out
+        self.serving = 0       # ticket currently allowed to execute
+        self.ended = False     # sequence_end step has been admitted
+        self.reclaimed = False
+
+
+def _not_started(model_name: str, corrid) -> InferenceServerException:
+    return InferenceServerException(
+        "sequence %s not started for model '%s' (no sequence_start, or "
+        "the slot was reclaimed after max_sequence_idle_microseconds)"
+        % (corrid, model_name),
+        status="INVALID_ARGUMENT",
+    )
+
+
+class SequenceScheduler:
+    """One scheduler per sequence-batched model.
+
+    ``batcher`` is the model's DynamicBatcher (or None); the oldest
+    strategy dispatches steps through it so concurrent sequences fuse.
+    ``reject_hook`` / ``timeout_hook`` feed the PR-2 queue-policy drop
+    counters.
+    """
+
+    def __init__(self, model, batcher=None,
+                 reject_hook: Optional[Callable[[], None]] = None,
+                 timeout_hook: Optional[Callable[[], None]] = None):
+        self._model = model
+        self._batcher = batcher
+        self._reject_hook = reject_hook
+        self._timeout_hook = timeout_hook
+        self._strategy = str(
+            getattr(model, "sequence_strategy", "direct") or "direct"
+        ).lower()
+        candidates = int(getattr(model, "max_candidate_sequences", 0) or 0)
+        self._slot_total = candidates if candidates > 0 \
+            else DEFAULT_CANDIDATE_SEQUENCES
+        self._idle_ns = max(
+            int(getattr(model, "max_sequence_idle_us", 0) or 0), 0
+        ) * NANOS_PER_US
+        self._controls = _control_specs(model)
+        self._states = _state_specs(model)
+        # Backlog admission reuses the model's queue policy: bound +
+        # wait deadline (0 = unbounded / wait forever).
+        self._backlog_max = max(int(getattr(model, "max_queue_size", 0)), 0)
+        self._default_timeout_ns = max(
+            int(getattr(model, "default_queue_policy_timeout_us", 0)), 0
+        ) * NANOS_PER_US
+        self._allow_timeout_override = bool(
+            getattr(model, "allow_timeout_override", True))
+        # Models without declared controls/state manage their own state
+        # keyed by the sequence_* request parameters — those must reach
+        # model.infer, and fusing such steps would execute the bucket
+        # with the leader's params, corrupting every other sequence.
+        self._pass_params = not (self._controls or self._states)
+        self._fuse = (self._strategy == "oldest" and batcher is not None
+                      and not self._pass_params)
+        self._cv = threading.Condition()
+        self._sequences: "OrderedDict[object, _Slot]" = OrderedDict()
+        self._free_slots: List[int] = list(range(self._slot_total))
+        self._backlog = 0
+        self._stopping = False
+        # lifetime counters (ModelStatistics.sequence_stats)
+        self._started_total = 0
+        self._completed_total = 0
+        self._reclaimed_total = 0
+        self._step_total = 0
+        self._fused_step_total = 0
+        self._reaper: Optional[threading.Thread] = None
+        if self._idle_ns > 0:
+            self._reaper = threading.Thread(
+                target=self._reap_loop, daemon=True,
+                name="sequence-reaper-%s" % getattr(model, "name", "?"))
+            self._reaper.start()
+
+    # -- lifecycle --------------------------------------------------------
+
+    def stop(self) -> None:
+        """Rejects new work and wakes every backlogged start (they fail
+        UNAVAILABLE); in-flight steps finish through the batcher/model
+        they were already dispatched to."""
+        with self._cv:
+            self._stopping = True
+            self._cv.notify_all()
+        if self._reaper is not None:
+            self._reaper.join(timeout=5)
+
+    # -- request path -----------------------------------------------------
+
+    def infer(self, inputs: Dict[str, np.ndarray], params: dict,
+              batch: int):
+        """Executes one sequence step; returns
+        ``(outputs, queue_ns, executions)`` where executions follows
+        the dynamic batcher's leader accounting (0 for fused riders).
+        """
+        corrid = params.get("sequence_id")
+        start = bool(params.get("sequence_start"))
+        end = bool(params.get("sequence_end"))
+        entry_ns = time.monotonic_ns()
+        slot, ticket = self._admit(corrid, start, entry_ns, params)
+        try:
+            self._await_turn(slot, ticket, start)
+        except Exception:
+            self._release_turn(slot, end=False)
+            raise
+        queue_ns = time.monotonic_ns() - entry_ns
+        try:
+            exec_inputs = dict(inputs)
+            if self._controls:
+                self._inject_controls(exec_inputs, batch, corrid, start, end)
+            if self._states:
+                self._attach_state(exec_inputs, slot, batch, start)
+            if self._fuse:
+                exec_params = {
+                    k: v for k, v in params.items()
+                    if not k.startswith("sequence_")
+                }
+                outputs, fuse_queue_ns, leader = self._batcher.infer(
+                    exec_inputs, exec_params, batch)
+                queue_ns += fuse_queue_ns
+                executions = 1 if leader else 0
+                with self._cv:
+                    self._fused_step_total += 1
+            else:
+                exec_params = params if self._pass_params else {
+                    k: v for k, v in params.items()
+                    if not k.startswith("sequence_")
+                }
+                outputs = self._model.infer(exec_inputs, exec_params)
+                executions = 1
+            if self._states:
+                outputs = self._extract_state(outputs, slot)
+            with self._cv:
+                self._step_total += 1
+            return outputs, queue_ns, executions
+        finally:
+            self._release_turn(slot, end)
+
+    # -- admission --------------------------------------------------------
+
+    def _timeout_ns_for(self, params: dict) -> int:
+        timeout_ns = self._default_timeout_ns
+        if self._allow_timeout_override:
+            override = params.get("timeout")
+            if override is not None:
+                try:
+                    timeout_ns = max(int(override), 0) * NANOS_PER_US
+                except (TypeError, ValueError):
+                    pass
+        return timeout_ns
+
+    def _admit(self, corrid, start: bool, entry_ns: int, params: dict):
+        """Returns (slot, ticket) for this step, allocating a slot on
+        sequence_start (waiting in the backlog when none is free)."""
+        model_name = getattr(self._model, "name", "?")
+        with self._cv:
+            while True:
+                if self._stopping:
+                    raise InferenceServerException(
+                        "server is shutting down", status="UNAVAILABLE")
+                self._reclaim_locked(time.monotonic_ns())
+                slot = self._sequences.get(corrid)
+                if slot is not None:
+                    if not start and slot.ended:
+                        raise _not_started(model_name, corrid)
+                    # live corrid: non-start steps join it; a start
+                    # restarts in place (Triton semantics —
+                    # _attach_state ignores stale state on start).
+                    # Duplicate concurrent starts for one corrid land
+                    # here too: the loser of the allocation race joins
+                    # the winner's slot instead of minting a second.
+                    ticket = slot.next_ticket
+                    slot.next_ticket += 1
+                    return slot, ticket
+                if not start:
+                    raise _not_started(model_name, corrid)
+                if self._free_slots:
+                    index = self._free_slots.pop(0)
+                    slot = _Slot(index, corrid)
+                    self._sequences[corrid] = slot
+                    self._started_total += 1
+                    ticket = slot.next_ticket
+                    slot.next_ticket += 1
+                    return slot, ticket
+                # Backlog wait releases the lock; loop to re-check the
+                # world (slot freed, duplicate start won, stopping).
+                self._wait_for_slot_locked(model_name, entry_ns, params)
+
+    def _wait_for_slot_locked(self, model_name: str, entry_ns: int,
+                              params: dict) -> None:
+        """Backlog admission under the PR-2 queue policy (caller holds
+        the lock; returns with a slot free or raises)."""
+        if self._backlog_max > 0 and self._backlog >= self._backlog_max:
+            if self._reject_hook is not None:
+                try:
+                    self._reject_hook()
+                except Exception:  # noqa: BLE001 — stats only
+                    pass
+            raise InferenceServerException(
+                "sequence start for model '%s' rejected: all %d sequence "
+                "slots busy and the backlog exceeds max_queue_size %d"
+                % (model_name, self._slot_total, self._backlog_max),
+                status="UNAVAILABLE")
+        timeout_ns = self._timeout_ns_for(params)
+        deadline_ns = entry_ns + timeout_ns if timeout_ns else 0
+        self._backlog += 1
+        try:
+            while not self._free_slots:
+                if self._stopping:
+                    raise InferenceServerException(
+                        "server is shutting down", status="UNAVAILABLE")
+                now = time.monotonic_ns()
+                self._reclaim_locked(now)
+                if self._free_slots:
+                    return
+                if deadline_ns and now >= deadline_ns:
+                    if self._timeout_hook is not None:
+                        try:
+                            self._timeout_hook()
+                        except Exception:  # noqa: BLE001 — stats only
+                            pass
+                    raise InferenceServerException(
+                        "sequence start for model '%s' timed out after "
+                        "%d us waiting for a free sequence slot"
+                        % (model_name,
+                           (now - entry_ns) // NANOS_PER_US),
+                        status="DEADLINE_EXCEEDED")
+                if deadline_ns:
+                    wait_s = (deadline_ns - now) / 1e9
+                elif self._idle_ns:
+                    # no deadline: wake for the reaper's next sweep
+                    wait_s = self._idle_ns / 1e9
+                else:
+                    wait_s = None
+                self._cv.wait(timeout=wait_s)
+        finally:
+            self._backlog -= 1
+
+    # -- per-sequence ordering --------------------------------------------
+
+    def _await_turn(self, slot: _Slot, ticket: int, start: bool) -> None:
+        with self._cv:
+            while slot.serving != ticket:
+                if self._stopping:
+                    raise InferenceServerException(
+                        "server is shutting down", status="UNAVAILABLE")
+                self._cv.wait(timeout=1.0)
+            if slot.reclaimed:
+                raise _not_started(
+                    getattr(self._model, "name", "?"), slot.corrid)
+            if slot.ended:
+                # The sequence ended while this step waited its turn: a
+                # restart step revives the slot, anything else fails.
+                if start:
+                    slot.ended = False
+                else:
+                    raise _not_started(
+                        getattr(self._model, "name", "?"), slot.corrid)
+
+    def _release_turn(self, slot: _Slot, end: bool) -> None:
+        with self._cv:
+            slot.serving += 1
+            slot.last_step_ns = time.monotonic_ns()
+            if end:
+                slot.ended = True
+            if slot.ended and not slot.reclaimed \
+                    and slot.serving >= slot.next_ticket:
+                # ended with nothing left queued: free the slot (steps
+                # still queued behind the end fail/restart in
+                # _await_turn, and the last one out frees it here).
+                self._free_locked(slot, completed=True)
+            self._cv.notify_all()
+
+    def _free_locked(self, slot: _Slot, completed: bool) -> None:
+        """Returns the slot to the free pool (caller holds the lock)."""
+        live = self._sequences.get(slot.corrid)
+        if live is not slot:
+            return  # already freed (reclaim/end race)
+        del self._sequences[slot.corrid]
+        slot.state = {}
+        self._free_slots.append(slot.index)
+        if completed:
+            self._completed_total += 1
+        else:
+            slot.reclaimed = True
+            self._reclaimed_total += 1
+
+    # -- idle reclamation -------------------------------------------------
+
+    def _reclaim_locked(self, now_ns: int) -> None:
+        if not self._idle_ns:
+            return
+        for corrid in list(self._sequences):
+            slot = self._sequences[corrid]
+            if slot.serving != slot.next_ticket:
+                continue  # steps pending or executing: not idle
+            if now_ns - slot.last_step_ns >= self._idle_ns:
+                self._free_locked(slot, completed=False)
+
+    def _reap_loop(self) -> None:
+        interval_s = max(self._idle_ns / 1e9 / 2.0, 0.01)
+        with self._cv:
+            while not self._stopping:
+                before = len(self._free_slots)
+                self._reclaim_locked(time.monotonic_ns())
+                if len(self._free_slots) != before:
+                    self._cv.notify_all()
+                # cv.wait (not time.sleep) so stop()'s notify_all wakes
+                # the reaper immediately — unload/shutdown must not
+                # stall half an idle interval on the join.
+                self._cv.wait(timeout=interval_s)
+
+    # -- control + state tensors ------------------------------------------
+
+    def _batched(self, value: np.ndarray, batch: int):
+        """Shapes a per-step scalar/row for the model: ``[batch, 1]``
+        for batching models (so fused steps stack along the batch dim),
+        ``[1]`` otherwise."""
+        if int(getattr(self._model, "max_batch_size", 0)) > 0:
+            return np.broadcast_to(
+                value.reshape(1, -1), (max(batch, 1), value.size)).copy()
+        return value
+
+    def _inject_controls(self, exec_inputs: Dict[str, object], batch: int,
+                         corrid, start: bool, end: bool) -> None:
+        for spec in self._controls:
+            np_dtype = triton_to_np_dtype(spec.datatype) or np.int32
+            if spec.kind == CONTROL_CORRID:
+                try:
+                    raw = np.array([int(corrid)], dtype=np_dtype)
+                except (TypeError, ValueError, OverflowError):
+                    # string correlation ids (and ids outside the
+                    # control dtype's range, e.g. a negative id with a
+                    # UINT64 control) hash into the corrid slot
+                    raw = np.array([hash(str(corrid)) & 0x7FFFFFFF],
+                                   dtype=np_dtype)
+            elif spec.kind == CONTROL_START:
+                raw = np.array([1 if start else 0], dtype=np_dtype)
+            elif spec.kind == CONTROL_END:
+                raw = np.array([1 if end else 0], dtype=np_dtype)
+            else:  # READY: this step is live in its slot
+                raw = np.array([1], dtype=np_dtype)
+            exec_inputs[spec.name] = self._batched(raw, batch)
+
+    def _initial_state(self, spec: StateSpec, batch: int):
+        """Zero state, created ON DEVICE so the whole state lifecycle
+        (init -> step N output -> step N+1 input) stays in HBM; numpy
+        fallback when no accelerator runtime is importable."""
+        dims = tuple(d if d > 0 else 1 for d in spec.dims)
+        if int(getattr(self._model, "max_batch_size", 0)) > 0:
+            dims = (max(batch, 1),) + dims
+        np_dtype = triton_to_np_dtype(spec.datatype) or np.float32
+        try:
+            import jax.numpy as jnp
+
+            return jnp.zeros(dims, dtype=np_dtype)
+        except Exception:  # pragma: no cover — no jax runtime
+            return np.zeros(dims, dtype=np_dtype)
+
+    def _attach_state(self, exec_inputs: Dict[str, object], slot: _Slot,
+                      batch: int, start: bool) -> None:
+        for spec in self._states:
+            value = None if start else slot.state.get(spec.input_name)
+            if value is None:
+                value = self._initial_state(spec, batch)
+            exec_inputs[spec.input_name] = value
+
+    def _extract_state(self, outputs: Dict[str, object], slot: _Slot
+                       ) -> Dict[str, object]:
+        """Pops state outputs from the response and parks them in the
+        slot for the next step — WITHOUT materializing to host: a lazy
+        device slice of the fused output stays a device array here."""
+        remaining = dict(outputs)
+        for spec in self._states:
+            value = remaining.pop(spec.output_name, None)
+            if value is not None:
+                slot.state[spec.input_name] = value
+        return remaining
+
+    # -- observability ----------------------------------------------------
+
+    def stats_snapshot(self) -> dict:
+        with self._cv:
+            return {
+                "active_sequences": len(self._sequences),
+                "slot_total": self._slot_total,
+                "backlog_depth": self._backlog,
+                "idle_reclaimed_total": self._reclaimed_total,
+                "sequences_started": self._started_total,
+                "sequences_completed": self._completed_total,
+                "step_count": self._step_total,
+                "fused_steps": self._fused_step_total,
+            }
+
+
+def _control_specs(model) -> List[ControlSpec]:
+    specs = []
+    for entry in getattr(model, "sequence_controls", None) or []:
+        if isinstance(entry, ControlSpec):
+            specs.append(entry)
+        else:
+            specs.append(ControlSpec(
+                entry["name"], entry["kind"],
+                entry.get("datatype", "INT32")))
+    return specs
+
+
+def _state_specs(model) -> List[StateSpec]:
+    specs = []
+    for entry in getattr(model, "sequence_states", None) or []:
+        if isinstance(entry, StateSpec):
+            specs.append(entry)
+        else:
+            specs.append(StateSpec(
+                entry["input_name"], entry["output_name"],
+                entry.get("datatype", "FP32"), entry.get("dims", (1,))))
+    return specs
+
+
+def wants_sequence_batching(model) -> bool:
+    return bool(getattr(model, "sequence_batching", False)) \
+        and not getattr(model, "decoupled", False)

@@ -1,0 +1,606 @@
+"""Sequence-batching scheduler tests: slot lifecycle, per-sequence
+ordering, Direct vs Oldest cross-sequence step fusion, implicit
+device-resident state, idle reclamation, queue-policy backlog, and
+e2e parity across all four client front-ends (HTTP/gRPC x sync/aio)
+plus the decoupled stream path."""
+
+import asyncio
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import client_tpu.grpc as grpcclient
+import client_tpu.grpc.aio as grpcclient_aio
+import client_tpu.http as httpclient
+import client_tpu.http.aio as httpclient_aio
+from client_tpu._infer_common import InferInput
+from client_tpu.grpc._utils import InferResult, get_inference_request
+from client_tpu.models.simple_extra import DynaSequence, SequenceAccumulator
+from client_tpu.server.app import build_core, start_grpc_server
+from client_tpu.server.http_server import start_http_server_thread
+from client_tpu.server.sequence import (
+    DEFAULT_CANDIDATE_SEQUENCES,
+    SequenceScheduler,
+    wants_sequence_batching,
+)
+from client_tpu.utils import InferenceServerException
+
+GOLDEN_INPUTS = [1, 2, 3, 4, 5]
+GOLDEN_OUTPUTS = [1, 3, 6, 10, 15]  # running sum — the single-sequence
+# golden both simple_sequence (model-managed state) and dyna_sequence
+# (scheduler-managed implicit state) must reproduce byte-identically.
+
+
+# -- helpers ---------------------------------------------------------------
+
+
+def _request(model, value, sid, start=False, end=False, batched=False):
+    shape = [1, 1] if batched else [1]
+    tensor = InferInput("INPUT", shape, "INT32")
+    tensor.set_data_from_numpy(
+        np.array([value], dtype=np.int32).reshape(shape))
+    return get_inference_request(
+        model_name=model, inputs=[tensor], outputs=None,
+        sequence_id=sid, sequence_start=start, sequence_end=end)
+
+
+def _core_step(core, model, value, sid, start=False, end=False,
+               batched=False):
+    response = core.infer(
+        _request(model, value, sid, start, end, batched))
+    return int(InferResult(response).as_numpy("OUTPUT").reshape(-1)[0])
+
+
+def _run_sequence(core, model, sid, values=GOLDEN_INPUTS, batched=False):
+    return [
+        _core_step(core, model, value, sid, start=(i == 0),
+                   end=(i == len(values) - 1), batched=batched)
+        for i, value in enumerate(values)
+    ]
+
+
+# -- scheduler unit behavior (in-process core) -----------------------------
+
+
+@pytest.fixture(scope="module")
+def core():
+    core = build_core(["simple_sequence", "dyna_sequence"], warmup=False)
+    yield core
+    core.shutdown()
+
+
+def test_wants_sequence_batching():
+    assert wants_sequence_batching(SequenceAccumulator())
+    assert wants_sequence_batching(DynaSequence())
+
+    class Plain:
+        sequence_batching = False
+
+    assert not wants_sequence_batching(Plain())
+
+
+def test_direct_golden(core):
+    assert _run_sequence(core, "simple_sequence", 1001) == GOLDEN_OUTPUTS
+
+
+def test_oldest_implicit_state_golden(core):
+    """dyna_sequence's state lives in the scheduler (device arrays),
+    not the model — results must match the simple_sequence golden."""
+    assert _run_sequence(core, "dyna_sequence", 1002,
+                         batched=True) == GOLDEN_OUTPUTS
+
+
+def test_state_output_not_in_response(core):
+    response = core.infer(
+        _request("dyna_sequence", 5, 1003, start=True, end=True,
+                 batched=True))
+    names = [t.name for t in response.outputs]
+    assert "OUTPUT" in names
+    assert "STATE_OUT" not in names  # implicit state stays server-side
+
+
+def test_sequence_not_started(core):
+    with pytest.raises(InferenceServerException) as exc:
+        _core_step(core, "simple_sequence", 1, 55555)
+    assert "not started" in str(exc.value)
+    assert exc.value.status() == "INVALID_ARGUMENT"
+
+
+def test_step_after_end_fails(core):
+    _run_sequence(core, "simple_sequence", 1004)
+    with pytest.raises(InferenceServerException) as exc:
+        _core_step(core, "simple_sequence", 1, 1004)
+    assert "not started" in str(exc.value)
+
+
+def test_restart_resets_state(core):
+    _run_sequence(core, "dyna_sequence", 1005, batched=True)
+    # same corrid, fresh start: accumulation restarts from zero
+    assert _run_sequence(core, "dyna_sequence", 1005,
+                         batched=True) == GOLDEN_OUTPUTS
+
+
+def test_oldest_fusion_across_sequences(core):
+    """>= 8 live sequences, Oldest strategy: steps from distinct
+    sequences fuse into shared executions — execution_count strictly
+    below request_count (the acceptance-criteria shape)."""
+    stats0 = core.model_statistics("dyna_sequence").model_stats[0]
+    results = {}
+    values = list(range(1, 11))
+
+    def run_one(sid):
+        results[sid] = _run_sequence(core, "dyna_sequence", sid,
+                                     values=values, batched=True)
+
+    threads = [threading.Thread(target=run_one, args=(2000 + i,))
+               for i in range(10)]
+    for thread in threads:
+        thread.start()
+    for thread in threads:
+        thread.join()
+    golden = list(np.cumsum(values))
+    for sid, outputs in results.items():
+        assert outputs == golden, "sequence %d broke: %s" % (sid, outputs)
+    stats1 = core.model_statistics("dyna_sequence").model_stats[0]
+    d_requests = stats1.inference_count - stats0.inference_count
+    d_executions = stats1.execution_count - stats0.execution_count
+    assert d_requests == 100
+    assert d_executions < d_requests, (
+        "no cross-sequence fusion: %d executions for %d requests"
+        % (d_executions, d_requests))
+    seq = stats1.sequence_stats
+    assert seq.slot_total == 16
+    assert seq.fused_steps >= 100
+    assert seq.sequences_completed >= 10
+
+
+def test_direct_sequences_never_fuse(core):
+    """Direct strategy executes steps singly even under concurrency
+    (the model's own params-keyed state requires it)."""
+    stats0 = core.model_statistics("simple_sequence").model_stats[0]
+    results = {}
+
+    def run_one(sid):
+        results[sid] = _run_sequence(core, "simple_sequence", sid)
+
+    threads = [threading.Thread(target=run_one, args=(3000 + i,))
+               for i in range(8)]
+    for thread in threads:
+        thread.start()
+    for thread in threads:
+        thread.join()
+    for outputs in results.values():
+        assert outputs == GOLDEN_OUTPUTS
+    stats1 = core.model_statistics("simple_sequence").model_stats[0]
+    assert (stats1.execution_count - stats0.execution_count
+            == stats1.inference_count - stats0.inference_count)
+
+
+def test_per_sequence_ordering_under_concurrency():
+    """Steps admitted in order execute in order even when later steps
+    are dispatched from concurrent threads while earlier ones run."""
+
+    class SlowModel(SequenceAccumulator):
+        def infer(self, inputs, parameters=None):
+            time.sleep(0.02)
+            return super().infer(inputs, parameters)
+
+    model = SlowModel(name="slow_sequence")
+    scheduler = SequenceScheduler(model)
+    outputs = []
+    lock = threading.Lock()
+    threads = []
+
+    def run_step(value, start, end):
+        out, _, _ = scheduler.infer(
+            {"INPUT": np.array([value], dtype=np.int32)},
+            {"sequence_id": 42, "sequence_start": start,
+             "sequence_end": end}, 1)
+        with lock:
+            outputs.append(int(np.asarray(out["OUTPUT"]).reshape(-1)[0]))
+
+    # Admit each step under the scheduler's turnstile IN ORDER (tickets
+    # issue at admission), then let the executions race.
+    for i, value in enumerate(GOLDEN_INPUTS):
+        thread = threading.Thread(
+            target=run_step,
+            args=(value, i == 0, i == len(GOLDEN_INPUTS) - 1))
+        thread.start()
+        threads.append(thread)
+        time.sleep(0.005)  # admission order = arrival order
+    for thread in threads:
+        thread.join()
+    assert outputs == GOLDEN_OUTPUTS
+    scheduler.stop()
+
+
+def test_idle_timeout_reclaims_slot():
+    model = SequenceAccumulator(name="idle_sequence",
+                                max_sequence_idle_us=50_000,
+                                max_candidate_sequences=2)
+    scheduler = SequenceScheduler(model)
+
+    def step(value, sid, start=False, end=False):
+        out, _, _ = scheduler.infer(
+            {"INPUT": np.array([value], dtype=np.int32)},
+            {"sequence_id": sid, "sequence_start": start,
+             "sequence_end": end}, 1)
+        return int(np.asarray(out["OUTPUT"]).reshape(-1)[0])
+
+    assert step(1, 7, start=True) == 1
+    time.sleep(0.3)  # > max_sequence_idle_us: the reaper frees slot 7
+    with pytest.raises(InferenceServerException) as exc:
+        step(2, 7)
+    assert "not started" in str(exc.value)
+    snap = scheduler.stats_snapshot()
+    assert snap["idle_reclaimed_total"] == 1
+    assert snap["active_sequences"] == 0
+    # the reclaimed slot is reusable by new sequences
+    assert step(5, 8, start=True, end=True) == 5
+    scheduler.stop()
+
+
+def test_backlog_rejects_when_bounded():
+    """All slots busy + bounded backlog: a new start is rejected
+    UNAVAILABLE at admission (PR-2 queue-policy semantics)."""
+    model = SequenceAccumulator(name="tiny_sequence",
+                                max_candidate_sequences=1)
+    model.max_queue_size = 1  # backlog admits at most one waiter
+    rejects = []
+    scheduler = SequenceScheduler(model, reject_hook=lambda:
+                                  rejects.append(1))
+
+    def start_seq(sid):
+        scheduler.infer(
+            {"INPUT": np.array([1], dtype=np.int32)},
+            {"sequence_id": sid, "sequence_start": True}, 1)
+
+    start_seq(1)  # occupies the only slot (never ended)
+    blocked_outcome = []
+
+    def blocked_start():
+        try:
+            start_seq(2)
+        except InferenceServerException as e:
+            blocked_outcome.append(e.status())
+
+    blocked = threading.Thread(target=blocked_start, daemon=True)
+    blocked.start()  # fills the backlog (waits forever; no deadline)
+    time.sleep(0.1)
+    with pytest.raises(InferenceServerException) as exc:
+        start_seq(3)
+    assert exc.value.status() == "UNAVAILABLE"
+    assert rejects == [1]
+    scheduler.stop()  # wakes the backlogged start with UNAVAILABLE
+    blocked.join(timeout=5)
+    assert not blocked.is_alive()
+    assert blocked_outcome == ["UNAVAILABLE"]
+
+
+def test_backlog_start_times_out():
+    model = SequenceAccumulator(name="deadline_sequence",
+                                max_candidate_sequences=1)
+    model.default_queue_policy_timeout_us = 50_000
+    timeouts = []
+    scheduler = SequenceScheduler(model, timeout_hook=lambda:
+                                  timeouts.append(1))
+    scheduler.infer(
+        {"INPUT": np.array([1], dtype=np.int32)},
+        {"sequence_id": 1, "sequence_start": True}, 1)
+    t0 = time.monotonic()
+    with pytest.raises(InferenceServerException) as exc:
+        scheduler.infer(
+            {"INPUT": np.array([1], dtype=np.int32)},
+            {"sequence_id": 2, "sequence_start": True}, 1)
+    assert exc.value.status() == "DEADLINE_EXCEEDED"
+    assert time.monotonic() - t0 < 5.0
+    assert timeouts == [1]
+    scheduler.stop()
+
+
+def test_duplicate_concurrent_starts_share_one_slot():
+    """Two racing starts for the same corrid that both backlog must
+    resolve to ONE slot (the loser joins the winner's) — a duplicate
+    allocation would leak a slot index forever."""
+    model = SequenceAccumulator(name="dup_sequence",
+                                max_candidate_sequences=2)
+    scheduler = SequenceScheduler(model)
+
+    def step(sid, value, start=False, end=False):
+        out, _, _ = scheduler.infer(
+            {"INPUT": np.array([value], dtype=np.int32)},
+            {"sequence_id": sid, "sequence_start": start,
+             "sequence_end": end}, 1)
+        return int(np.asarray(out["OUTPUT"]).reshape(-1)[0])
+
+    step(1, 1, start=True)
+    step(2, 1, start=True)  # both slots busy
+    results = []
+    threads = [
+        threading.Thread(target=lambda: results.append(
+            step(7, 5, start=True)))
+        for _ in range(2)
+    ]
+    for thread in threads:
+        thread.start()
+    time.sleep(0.2)  # both duplicate starts now wait in the backlog
+    step(1, 1, end=True)
+    step(2, 1, end=True)  # frees both slots; both waiters wake
+    for thread in threads:
+        thread.join(timeout=10)
+    assert len(results) == 2
+    snap = scheduler.stats_snapshot()
+    assert snap["active_sequences"] == 1  # corrid 7 holds ONE slot
+    assert len(scheduler._free_slots) == 1
+    step(7, 1, end=True)
+    assert len(scheduler._free_slots) == 2  # no leaked slot index
+    scheduler.stop()
+
+
+def test_negative_corrid_with_unsigned_control():
+    """A correlation id outside the CORRID control dtype's range (here
+    -1 vs UINT64) takes the hash fallback instead of failing the
+    step."""
+    model = DynaSequence(name="neg_corrid_sequence")
+    scheduler = SequenceScheduler(model)
+    out, _, _ = scheduler.infer(
+        {"INPUT": np.array([[4]], dtype=np.int32)},
+        {"sequence_id": -1, "sequence_start": True,
+         "sequence_end": True}, 1)
+    assert int(np.asarray(out["OUTPUT"]).reshape(-1)[0]) == 4
+    scheduler.stop()
+
+
+def test_implicit_state_stays_device_resident():
+    """The state handed between steps must be a device array (jax) —
+    never silently materialized to host by the scheduler."""
+    import jax
+
+    model = DynaSequence(name="resident_sequence")
+    scheduler = SequenceScheduler(model)
+    scheduler.infer(
+        {"INPUT": np.array([[3]], dtype=np.int32)},
+        {"sequence_id": 5, "sequence_start": True}, 1)
+    slot = scheduler._sequences[5]
+    state = slot.state["STATE_IN"]
+    assert isinstance(state, jax.Array)
+    assert int(np.asarray(state).reshape(-1)[0]) == 3
+    scheduler.stop()
+
+
+# -- config rendering over both transports ---------------------------------
+
+
+@pytest.fixture(scope="module")
+def servers(core):
+    grpc_handle = start_grpc_server(core=core)
+    http_runner = start_http_server_thread(core, host="127.0.0.1", port=0)
+    yield grpc_handle, http_runner
+    http_runner.stop()
+    # grpc_handle.stop() also calls core.shutdown(); the core fixture's
+    # own shutdown after this is a no-op second call.
+    grpc_handle.stop()
+
+
+def _check_config_dict(config):
+    sb = config["sequence_batching"]
+    assert sb["strategy"] == "oldest"
+    assert int(sb["max_candidate_sequences"]) == 16
+    assert int(sb["max_sequence_idle_microseconds"]) == 5_000_000
+    kinds = {c["kind"]: c["name"] for c in sb["control_input"]}
+    assert kinds == {
+        "CONTROL_SEQUENCE_CORRID": "CORRID",
+        "CONTROL_SEQUENCE_START": "START",
+        "CONTROL_SEQUENCE_END": "END",
+        "CONTROL_SEQUENCE_READY": "READY",
+    }
+    (state,) = sb["state"]
+    assert state["input_name"] == "STATE_IN"
+    assert state["output_name"] == "STATE_OUT"
+    assert [int(d) for d in state["dims"]] == [1]
+    assert [int(s) for s in sb["preferred_batch_size"]] == [4, 8]
+
+
+def test_grpc_config_renders_sequence_batching(servers):
+    grpc_handle, _ = servers
+    with grpcclient.InferenceServerClient(grpc_handle.address) as client:
+        config = client.get_model_config("dyna_sequence", as_json=True)
+        _check_config_dict(config.get("config", config))
+        simple = client.get_model_config("simple_sequence", as_json=True)
+        simple = simple.get("config", simple)
+        assert simple["sequence_batching"]["strategy"] == "direct"
+
+
+def test_http_config_renders_sequence_batching(servers):
+    _, http_runner = servers
+    with httpclient.InferenceServerClient(
+            "127.0.0.1:%d" % http_runner.port) as client:
+        _check_config_dict(client.get_model_config("dyna_sequence"))
+
+
+def test_model_parser_full_sequence_config(servers):
+    from client_tpu.perf.client_backend import (
+        BackendKind,
+        ClientBackendFactory,
+    )
+    from client_tpu.perf.model_parser import ModelParser, SchedulerType
+
+    _, http_runner = servers
+    factory = ClientBackendFactory(BackendKind.TRITON_HTTP,
+                                   url="127.0.0.1:%d" % http_runner.port)
+    backend = factory.create()
+    try:
+        parsed = ModelParser().parse(backend, "dyna_sequence")
+    finally:
+        backend.close()
+    assert parsed.scheduler_type is SchedulerType.SEQUENCE
+    assert parsed.sequence_strategy == "oldest"
+    assert parsed.max_candidate_sequences == 16
+    assert parsed.max_sequence_idle_us == 5_000_000
+    assert {c["kind"] for c in parsed.sequence_controls} == {
+        "CONTROL_SEQUENCE_CORRID", "CONTROL_SEQUENCE_START",
+        "CONTROL_SEQUENCE_END", "CONTROL_SEQUENCE_READY"}
+    assert parsed.sequence_states[0]["input_name"] == "STATE_IN"
+    assert parsed.sequence_preferred_batch_sizes == [4, 8]
+
+
+# -- e2e over the four front-ends ------------------------------------------
+
+
+def _client_sequence(client, model, sid, batched, infer):
+    outputs = []
+    for i, value in enumerate(GOLDEN_INPUTS):
+        shape = [1, 1] if batched else [1]
+        tensor = InferInput("INPUT", shape, "INT32")
+        tensor.set_data_from_numpy(
+            np.array([value], dtype=np.int32).reshape(shape))
+        result = infer(client, model, [tensor], sid,
+                       i == 0, i == len(GOLDEN_INPUTS) - 1)
+        outputs.append(int(result.as_numpy("OUTPUT").reshape(-1)[0]))
+    return outputs
+
+
+@pytest.mark.parametrize("model,batched", [
+    ("simple_sequence", False),
+    ("dyna_sequence", True),
+])
+def test_grpc_sync_sequence_e2e(servers, model, batched):
+    grpc_handle, _ = servers
+
+    def infer(client, model_name, inputs, sid, start, end):
+        return client.infer(model_name, inputs, sequence_id=sid,
+                            sequence_start=start, sequence_end=end)
+
+    with grpcclient.InferenceServerClient(grpc_handle.address) as client:
+        assert _client_sequence(client, model, 4100 + batched, batched,
+                                infer) == GOLDEN_OUTPUTS
+
+
+@pytest.mark.parametrize("model,batched", [
+    ("simple_sequence", False),
+    ("dyna_sequence", True),
+])
+def test_http_sync_sequence_e2e(servers, model, batched):
+    _, http_runner = servers
+
+    def infer(client, model_name, inputs, sid, start, end):
+        return client.infer(model_name, inputs, sequence_id=sid,
+                            sequence_start=start, sequence_end=end)
+
+    with httpclient.InferenceServerClient(
+            "127.0.0.1:%d" % http_runner.port) as client:
+        assert _client_sequence(client, model, 4200 + batched, batched,
+                                infer) == GOLDEN_OUTPUTS
+
+
+@pytest.mark.parametrize("model,batched", [
+    ("simple_sequence", False),
+    ("dyna_sequence", True),
+])
+def test_grpc_aio_sequence_e2e(servers, model, batched):
+    grpc_handle, _ = servers
+
+    async def run():
+        async with grpcclient_aio.InferenceServerClient(
+                grpc_handle.address) as client:
+            outputs = []
+            for i, value in enumerate(GOLDEN_INPUTS):
+                shape = [1, 1] if batched else [1]
+                tensor = InferInput("INPUT", shape, "INT32")
+                tensor.set_data_from_numpy(
+                    np.array([value], dtype=np.int32).reshape(shape))
+                result = await client.infer(
+                    model, [tensor], sequence_id=4300 + batched,
+                    sequence_start=(i == 0),
+                    sequence_end=(i == len(GOLDEN_INPUTS) - 1))
+                outputs.append(
+                    int(result.as_numpy("OUTPUT").reshape(-1)[0]))
+            assert outputs == GOLDEN_OUTPUTS
+
+    asyncio.run(run())
+
+
+@pytest.mark.parametrize("model,batched", [
+    ("simple_sequence", False),
+    ("dyna_sequence", True),
+])
+def test_http_aio_sequence_e2e(servers, model, batched):
+    _, http_runner = servers
+
+    async def run():
+        async with httpclient_aio.InferenceServerClient(
+                "127.0.0.1:%d" % http_runner.port) as client:
+            outputs = []
+            for i, value in enumerate(GOLDEN_INPUTS):
+                shape = [1, 1] if batched else [1]
+                tensor = InferInput("INPUT", shape, "INT32")
+                tensor.set_data_from_numpy(
+                    np.array([value], dtype=np.int32).reshape(shape))
+                result = await client.infer(
+                    model, [tensor], sequence_id=4400 + batched,
+                    sequence_start=(i == 0),
+                    sequence_end=(i == len(GOLDEN_INPUTS) - 1))
+                outputs.append(
+                    int(result.as_numpy("OUTPUT").reshape(-1)[0]))
+            assert outputs == GOLDEN_OUTPUTS
+
+    asyncio.run(run())
+
+
+# -- streaming-path parity -------------------------------------------------
+
+
+@pytest.mark.parametrize("model,batched", [
+    ("simple_sequence", False),
+    ("dyna_sequence", True),
+])
+def test_stream_sequence_parity(servers, model, batched):
+    """The bidi-stream path routes through the same scheduler: ordered
+    per-sequence results, interleaved across two live sequences."""
+    grpc_handle, _ = servers
+    got = {}
+    lock = threading.Lock()
+    expected_total = 2 * len(GOLDEN_INPUTS)
+    done = threading.Event()
+    errors = []
+
+    def callback(result, error):
+        if error is not None:
+            errors.append(error)
+            done.set()
+            return
+        rid = result.get_response().id
+        sid = int(rid.split("-")[0])
+        with lock:
+            got.setdefault(sid, []).append(
+                int(result.as_numpy("OUTPUT").reshape(-1)[0]))
+            if sum(len(v) for v in got.values()) == expected_total:
+                done.set()
+
+    with grpcclient.InferenceServerClient(grpc_handle.address) as client:
+        client.start_stream(callback)
+        sids = (4500 + batched * 10, 4501 + batched * 10)
+        for i, value in enumerate(GOLDEN_INPUTS):
+            for sid in sids:  # interleave the two sequences' steps
+                shape = [1, 1] if batched else [1]
+                tensor = InferInput("INPUT", shape, "INT32")
+                tensor.set_data_from_numpy(
+                    np.array([value], dtype=np.int32).reshape(shape))
+                client.async_stream_infer(
+                    model, [tensor], request_id="%d-%d" % (sid, i),
+                    sequence_id=sid, sequence_start=(i == 0),
+                    sequence_end=(i == len(GOLDEN_INPUTS) - 1))
+        assert done.wait(timeout=60), "stream timed out: got %s" % got
+        client.stop_stream()
+    assert not errors, errors
+    for sid in sids:
+        assert got[sid] == GOLDEN_OUTPUTS
+
+
+def test_default_candidate_slots_rendered(core):
+    config = core.model_config("simple_sequence").config
+    assert config.sequence_batching.max_candidate_sequences == \
+        DEFAULT_CANDIDATE_SEQUENCES
+    assert config.sequence_batching.strategy == "direct"

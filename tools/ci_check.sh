@@ -6,7 +6,7 @@
 # regression gate). Usage: tools/ci_check.sh [min_passed]
 set -u -o pipefail
 
-MIN_PASSED="${1:-305}"
+MIN_PASSED="${1:-374}"
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
 LOG=/tmp/_t1.log
 
@@ -45,4 +45,32 @@ if ! grep -q "Chaos summary" "$CHAOS_LOG"; then
 fi
 grep -E "Chaos summary|goodput|retries|recovered" "$CHAOS_LOG"
 echo "OK: chaos smoke passed"
+
+# Sequence-fusion smoke: 8 concurrent sequences against dyna_sequence
+# (oldest strategy) must fuse steps across sequences — the perf
+# report's sequence summary must show mean fused batch > 1 (i.e.
+# execution_count < request_count on a concurrent-sequence run).
+echo "sequence smoke: dyna_sequence fusion at 8 concurrent sequences"
+SEQ_LOG=/tmp/_sequence_smoke.log
+if ! timeout -k 10 240 env JAX_PLATFORMS=cpu python -m client_tpu.perf \
+    -m dyna_sequence --service-kind inprocess --request-count 80 -p 6000 \
+    --concurrency-range 8 --sequence-length 10 > "$SEQ_LOG" 2>&1; then
+    echo "FAIL: sequence smoke run did not complete" >&2
+    tail -20 "$SEQ_LOG" >&2
+    exit 1
+fi
+fused=$(grep -oE "mean fused batch [0-9.]+" "$SEQ_LOG" | tail -1 \
+    | awk '{print $4}')
+if [ -z "$fused" ]; then
+    echo "FAIL: sequence smoke produced no sequence summary" >&2
+    tail -20 "$SEQ_LOG" >&2
+    exit 1
+fi
+if ! awk -v f="$fused" 'BEGIN { exit !(f > 1.0) }'; then
+    echo "FAIL: sequence steps did not fuse (mean fused batch $fused)" >&2
+    grep -E "sequences dyna_sequence|server dyna_sequence" "$SEQ_LOG" >&2
+    exit 1
+fi
+grep -E "sequences dyna_sequence" "$SEQ_LOG"
+echo "OK: sequence smoke passed (mean fused batch $fused)"
 exit 0

@@ -10,13 +10,18 @@ again on an already patched file is a no-op.
 Patches applied:
 
 * inference_pb2.py — ``BatchPipelineStatistics`` +
-  ``ModelStatistics.pipeline_stats`` (PR 1), and the queue-policy drop
+  ``ModelStatistics.pipeline_stats`` (PR 1), the queue-policy drop
   counters ``ModelStatistics.reject_count`` /
-  ``ModelStatistics.timeout_count`` (PR 2).
+  ``ModelStatistics.timeout_count`` (PR 2), and
+  ``SequenceBatchingStatistics`` + ``ModelStatistics.sequence_stats``
+  (PR 3 sequence scheduler).
 * model_config_pb2.py — ``DynamicBatchingConfig.max_queue_size`` /
   ``allow_timeout_override`` / ``timeout_action`` (PR 2 queue policy;
   ``default_queue_policy_timeout_us`` has been in the schema since the
-  seed).
+  seed), and the full sequence-batching schema (PR 3):
+  ``SequenceControlInput`` / ``SequenceStateConfig`` messages plus
+  ``SequenceBatchingConfig.strategy`` / ``control_input`` / ``state`` /
+  ``preferred_batch_size``.
 
 The ``_serialized_start/_serialized_end`` attribute lines at the bottom
 of the pb2 modules go stale after the patch; they only execute when
@@ -39,11 +44,14 @@ PB2_PATH = REPO / "client_tpu" / "protocol" / "inference_pb2.py"
 MODEL_CONFIG_PB2_PATH = REPO / "client_tpu" / "protocol" / "model_config_pb2.py"
 
 U64 = descriptor_pb2.FieldDescriptorProto.TYPE_UINT64
+I64 = descriptor_pb2.FieldDescriptorProto.TYPE_INT64
 DOUBLE = descriptor_pb2.FieldDescriptorProto.TYPE_DOUBLE
 MESSAGE = descriptor_pb2.FieldDescriptorProto.TYPE_MESSAGE
+ENUM = descriptor_pb2.FieldDescriptorProto.TYPE_ENUM
 BOOL = descriptor_pb2.FieldDescriptorProto.TYPE_BOOL
 STRING = descriptor_pb2.FieldDescriptorProto.TYPE_STRING
 OPTIONAL = descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL
+REPEATED = descriptor_pb2.FieldDescriptorProto.LABEL_REPEATED
 
 # (name, number, type) — keep in sync with inference.proto.
 PIPELINE_FIELDS = [
@@ -68,6 +76,40 @@ QUEUE_POLICY_FIELDS = [
     ("max_queue_size", 4, U64),
     ("allow_timeout_override", 5, BOOL),
     ("timeout_action", 6, STRING),
+]
+
+# Sequence-scheduler observability on ModelStatistics (field 11;
+# 8/9/10 are pipeline_stats / reject_count / timeout_count).
+SEQUENCE_STATS_FIELDS = [
+    ("active_sequences", 1, U64),
+    ("slot_total", 2, U64),
+    ("backlog_depth", 3, U64),
+    ("idle_reclaimed_total", 4, U64),
+    ("sequences_started", 5, U64),
+    ("sequences_completed", 6, U64),
+    ("step_count", 7, U64),
+    ("fused_steps", 8, U64),
+]
+
+# (name, number, type, label, type_name) rows for the sequence-batching
+# schema messages — keep in sync with model_config.proto.
+CONTROL_INPUT_FIELDS = [
+    ("name", 1, STRING, OPTIONAL, None),
+    ("kind", 2, STRING, OPTIONAL, None),
+    ("data_type", 3, ENUM, OPTIONAL, ".inference.TensorDataType"),
+]
+STATE_CONFIG_FIELDS = [
+    ("input_name", 1, STRING, OPTIONAL, None),
+    ("output_name", 2, STRING, OPTIONAL, None),
+    ("data_type", 3, ENUM, OPTIONAL, ".inference.TensorDataType"),
+    ("dims", 4, I64, REPEATED, None),
+]
+SEQUENCE_BATCHING_FIELDS = [
+    ("strategy", 3, STRING, OPTIONAL, None),
+    ("control_input", 4, MESSAGE, REPEATED,
+     ".inference.SequenceControlInput"),
+    ("state", 5, MESSAGE, REPEATED, ".inference.SequenceStateConfig"),
+    ("preferred_batch_size", 6, I64, REPEATED, None),
 ]
 
 
@@ -104,6 +146,22 @@ def patch_inference(file_proto: descriptor_pb2.FileDescriptorProto) -> bool:
             model_stats.field.add(name=name, number=number, type=ftype,
                                   label=OPTIONAL, json_name=_json_name(name))
             changed = True
+    names = [m.name for m in file_proto.message_type]
+    if "SequenceBatchingStatistics" not in names:
+        anchor = names.index("BatchPipelineStatistics") + 1
+        message = descriptor_pb2.DescriptorProto(
+            name="SequenceBatchingStatistics")
+        for name, number, ftype in SEQUENCE_STATS_FIELDS:
+            message.field.add(name=name, number=number, type=ftype,
+                              label=OPTIONAL, json_name=_json_name(name))
+        file_proto.message_type.insert(anchor, message)
+        changed = True
+    if not any(f.name == "sequence_stats" for f in model_stats.field):
+        model_stats.field.add(
+            name="sequence_stats", number=11, type=MESSAGE, label=OPTIONAL,
+            type_name=".inference.SequenceBatchingStatistics",
+            json_name="sequenceStats")
+        changed = True
     return changed
 
 
@@ -117,6 +175,35 @@ def patch_model_config(file_proto: descriptor_pb2.FileDescriptorProto) -> bool:
             batching.field.add(name=name, number=number, type=ftype,
                                label=OPTIONAL, json_name=_json_name(name))
             changed = True
+    names = [m.name for m in file_proto.message_type]
+    anchor = names.index("SequenceBatchingConfig")
+    for msg_name, rows in (
+        ("SequenceControlInput", CONTROL_INPUT_FIELDS),
+        ("SequenceStateConfig", STATE_CONFIG_FIELDS),
+    ):
+        if msg_name in names:
+            continue
+        message = descriptor_pb2.DescriptorProto(name=msg_name)
+        for name, number, ftype, label, type_name in rows:
+            field = message.field.add(name=name, number=number, type=ftype,
+                                      label=label,
+                                      json_name=_json_name(name))
+            if type_name:
+                field.type_name = type_name
+        file_proto.message_type.insert(anchor, message)
+        anchor += 1
+        changed = True
+    sequence = next(
+        m for m in file_proto.message_type
+        if m.name == "SequenceBatchingConfig")
+    for name, number, ftype, label, type_name in SEQUENCE_BATCHING_FIELDS:
+        if any(f.name == name for f in sequence.field):
+            continue
+        field = sequence.field.add(name=name, number=number, type=ftype,
+                                   label=label, json_name=_json_name(name))
+        if type_name:
+            field.type_name = type_name
+        changed = True
     return changed
 
 
