@@ -154,12 +154,62 @@ class _InferStream:
             self._worker.join()
 
 
-class InferenceServerClient(InferenceServerClientBase):
-    """A client talking to a KServe-v2 gRPC endpoint.
+def _channel_credentials(ssl, root_certificates, private_key,
+                         certificate_chain, creds):
+    """Resolve the credentials one channel needs (None = insecure)."""
+    if creds is not None:
+        return creds
+    if not ssl:
+        return None
+    rc = open(root_certificates, "rb").read() if root_certificates else None
+    pk = open(private_key, "rb").read() if private_key else None
+    cc = open(certificate_chain, "rb").read() if certificate_chain else None
+    return grpc.ssl_channel_credentials(rc, pk, cc)
 
-    One client owns one channel; ``infer`` is thread-safe, the
-    stream-control methods are not (same contract as the reference,
-    grpc_client.h:86-89).
+
+def _make_channel(url, options, credentials, aio: bool = False):
+    api = grpc.aio if aio else grpc
+    if credentials is not None:
+        return api.secure_channel(url, credentials, options=options)
+    return api.insecure_channel(url, options=options)
+
+
+def probe_grpc_ready(url, credentials, timeout: float) -> bool:
+    """Bounded self-contained ServerReady probe: its own short-lived
+    channel, independent of any client's transports — a shared
+    EndpointPool's prober must keep working after the client that
+    registered it closes (probes only run for ejected endpoints at the
+    probe interval, so the per-probe channel cost is irrelevant)."""
+    channel = None
+    try:
+        channel = _make_channel(url, list(_DEFAULT_CHANNEL_OPTIONS),
+                                credentials)
+        response = GRPCInferenceServiceStub(channel).ServerReady(
+            pb.ServerReadyRequest(), timeout=timeout)
+        return bool(response.ready)
+    except Exception:  # noqa: BLE001 — any failure = not ready
+        return False
+    finally:
+        if channel is not None:
+            channel.close()
+
+
+class InferenceServerClient(InferenceServerClientBase):
+    """A client talking to one or more KServe-v2 gRPC endpoints.
+
+    One client owns one channel per endpoint; ``infer`` is
+    thread-safe, the stream-control methods are not (same contract as
+    the reference, grpc_client.h:86-89).
+
+    ``url`` may be a comma-separated endpoint list (or a list), or an
+    :class:`client_tpu.robust.EndpointPool` may be passed as
+    ``endpoint_pool``: ``infer`` then routes least-outstanding across
+    healthy endpoints, fails over on retryable errors, hedges
+    tail-slow requests within the pool's budget, and a background
+    prober (ServerReady with a bounded timeout) readmits ejected
+    endpoints. Streams stay pinned to the primary endpoint. With a
+    pool, ``circuit_breaker`` is ignored — health is per endpoint,
+    owned by the pool.
     """
 
     def __init__(
@@ -175,32 +225,49 @@ class InferenceServerClient(InferenceServerClientBase):
         channel_args: Optional[list] = None,
         retry_policy=None,
         circuit_breaker=None,
+        endpoint_pool=None,
     ):
         super().__init__()
-        self._url = url
+        from client_tpu.robust import EndpointPool
+
+        urls = (endpoint_pool.urls if endpoint_pool is not None
+                else EndpointPool.split_url(url))
+        if not urls:
+            raise InferenceServerException("invalid url '%s'" % url)
+        self._url = urls[0]
         self._verbose = verbose
+        self._owns_pool = endpoint_pool is None and len(urls) > 1
+        self._endpoint_pool = (endpoint_pool if endpoint_pool is not None
+                               else (EndpointPool(urls) if len(urls) > 1
+                                     else None))
         # client_tpu.robust wiring: infer() retries retryable statuses
         # (UNAVAILABLE, ...) under the policy; the breaker fails fast
         # while open. Both default to off.
         self._retry_policy = retry_policy
-        self._breaker = circuit_breaker
+        self._breaker = circuit_breaker if self._endpoint_pool is None \
+            else None
         options = list(_DEFAULT_CHANNEL_OPTIONS)
         if keepalive_options is not None:
             options += keepalive_options.channel_args()
         if channel_args is not None:
             options += list(channel_args)
-        if creds is not None:
-            self._channel = grpc.secure_channel(url, creds, options=options)
-        elif ssl:
-            rc = open(root_certificates, "rb").read() if root_certificates else None
-            pk = open(private_key, "rb").read() if private_key else None
-            cc = open(certificate_chain, "rb").read() if certificate_chain else None
-            credentials = grpc.ssl_channel_credentials(rc, pk, cc)
-            self._channel = grpc.secure_channel(url, credentials, options=options)
-        else:
-            self._channel = grpc.insecure_channel(url, options=options)
-        self._client_stub = GRPCInferenceServiceStub(self._channel)
+        credentials = _channel_credentials(
+            ssl, root_certificates, private_key, certificate_chain, creds)
+        self._channels = {
+            u: _make_channel(u, options, credentials) for u in urls
+        }
+        self._stubs = {
+            u: GRPCInferenceServiceStub(ch)
+            for u, ch in self._channels.items()
+        }
+        self._channel = self._channels[urls[0]]
+        self._client_stub = self._stubs[urls[0]]
         self._stream: Optional[_InferStream] = None
+        if self._endpoint_pool is not None:
+            timeout = self._endpoint_pool.probe_timeout_s
+            self._endpoint_pool.ensure_prober(
+                lambda u, _creds=credentials: probe_grpc_ready(
+                    u, _creds, timeout))
 
     # -- lifecycle -------------------------------------------------------
 
@@ -217,8 +284,17 @@ class InferenceServerClient(InferenceServerClientBase):
             pass
 
     def close(self):
+        if self._endpoint_pool is not None and self._owns_pool:
+            self._endpoint_pool.close()
         self.stop_stream()
-        self._channel.close()
+        for channel in self._channels.values():
+            channel.close()
+
+    def pool_stats(self) -> Optional[dict]:
+        """EndpointPool snapshot (hedges/failovers/ejections + per-
+        endpoint health); None for a single-endpoint client."""
+        return (self._endpoint_pool.stats()
+                if self._endpoint_pool is not None else None)
 
     def _log(self, *args):
         if self._verbose:
@@ -227,6 +303,12 @@ class InferenceServerClient(InferenceServerClientBase):
     def _metadata(self, headers):
         headers = self._call_plugin(dict(headers) if headers else {})
         return _metadata_from_headers(headers)
+
+    def _fleet_stubs(self):
+        """Every endpoint's stub — control-plane verbs that mutate
+        per-replica state (shm registration, model load/unload) must
+        hit the whole fleet, not just the primary."""
+        return list(self._stubs.values())
 
     # -- health / metadata ----------------------------------------------
 
@@ -336,9 +418,11 @@ class InferenceServerClient(InferenceServerClientBase):
             for path, content in files.items():
                 request.parameters[path].bytes_param = content
         try:
-            self._client_stub.RepositoryModelLoad(
-                request, metadata=self._metadata(headers), timeout=client_timeout
-            )
+            for stub in self._fleet_stubs():
+                stub.RepositoryModelLoad(
+                    request, metadata=self._metadata(headers),
+                    timeout=client_timeout
+                )
             self._log("Loaded model '%s'" % model_name)
         except grpc.RpcError as e:
             raise_error_grpc(e)
@@ -349,9 +433,11 @@ class InferenceServerClient(InferenceServerClientBase):
         request = pb.RepositoryModelUnloadRequest(model_name=model_name)
         request.parameters["unload_dependents"].bool_param = unload_dependents
         try:
-            self._client_stub.RepositoryModelUnload(
-                request, metadata=self._metadata(headers), timeout=client_timeout
-            )
+            for stub in self._fleet_stubs():
+                stub.RepositoryModelUnload(
+                    request, metadata=self._metadata(headers),
+                    timeout=client_timeout
+                )
             self._log("Unloaded model '%s'" % model_name)
         except grpc.RpcError as e:
             raise_error_grpc(e)
@@ -453,13 +539,14 @@ class InferenceServerClient(InferenceServerClientBase):
         self, name, key, byte_size, offset=0, headers=None, client_timeout=None
     ):
         try:
-            self._client_stub.SystemSharedMemoryRegister(
-                pb.SystemSharedMemoryRegisterRequest(
-                    name=name, key=key, offset=offset, byte_size=byte_size
-                ),
-                metadata=self._metadata(headers),
-                timeout=client_timeout,
-            )
+            for stub in self._fleet_stubs():
+                stub.SystemSharedMemoryRegister(
+                    pb.SystemSharedMemoryRegisterRequest(
+                        name=name, key=key, offset=offset, byte_size=byte_size
+                    ),
+                    metadata=self._metadata(headers),
+                    timeout=client_timeout,
+                )
             self._log("Registered system shared memory with name '%s'" % name)
         except grpc.RpcError as e:
             raise_error_grpc(e)
@@ -467,11 +554,12 @@ class InferenceServerClient(InferenceServerClientBase):
     def unregister_system_shared_memory(self, name="", headers=None,
                                         client_timeout=None):
         try:
-            self._client_stub.SystemSharedMemoryUnregister(
-                pb.SystemSharedMemoryUnregisterRequest(name=name),
-                metadata=self._metadata(headers),
-                timeout=client_timeout,
-            )
+            for stub in self._fleet_stubs():
+                stub.SystemSharedMemoryUnregister(
+                    pb.SystemSharedMemoryUnregisterRequest(name=name),
+                    metadata=self._metadata(headers),
+                    timeout=client_timeout,
+                )
             self._log("Unregistered system shared memory with name '%s'" % name)
         except grpc.RpcError as e:
             raise_error_grpc(e)
@@ -497,16 +585,17 @@ class InferenceServerClient(InferenceServerClientBase):
         analogue of register_cuda_shared_memory, reference
         grpc/_client.py:1339)."""
         try:
-            self._client_stub.TpuSharedMemoryRegister(
-                pb.TpuSharedMemoryRegisterRequest(
-                    name=name,
-                    raw_handle=raw_handle,
-                    device_id=device_id,
-                    byte_size=byte_size,
-                ),
-                metadata=self._metadata(headers),
-                timeout=client_timeout,
-            )
+            for stub in self._fleet_stubs():
+                stub.TpuSharedMemoryRegister(
+                    pb.TpuSharedMemoryRegisterRequest(
+                        name=name,
+                        raw_handle=raw_handle,
+                        device_id=device_id,
+                        byte_size=byte_size,
+                    ),
+                    metadata=self._metadata(headers),
+                    timeout=client_timeout,
+                )
             self._log("Registered TPU shared memory with name '%s'" % name)
         except grpc.RpcError as e:
             raise_error_grpc(e)
@@ -514,11 +603,12 @@ class InferenceServerClient(InferenceServerClientBase):
     def unregister_tpu_shared_memory(self, name="", headers=None,
                                      client_timeout=None):
         try:
-            self._client_stub.TpuSharedMemoryUnregister(
-                pb.TpuSharedMemoryUnregisterRequest(name=name),
-                metadata=self._metadata(headers),
-                timeout=client_timeout,
-            )
+            for stub in self._fleet_stubs():
+                stub.TpuSharedMemoryUnregister(
+                    pb.TpuSharedMemoryUnregisterRequest(name=name),
+                    metadata=self._metadata(headers),
+                    timeout=client_timeout,
+                )
             self._log("Unregistered TPU shared memory with name '%s'" % name)
         except grpc.RpcError as e:
             raise_error_grpc(e)
@@ -563,11 +653,11 @@ class InferenceServerClient(InferenceServerClientBase):
         metadata = self._metadata(headers)
         compression = _grpc_compression(compression_algorithm)
 
-        def _attempt(remaining: Optional[float]) -> InferResult:
+        def _call(stub, remaining: Optional[float]) -> InferResult:
             # `remaining` is the shrinking share of client_timeout left
             # for this attempt (None = no deadline).
             try:
-                response = self._client_stub.ModelInfer(
+                response = stub.ModelInfer(
                     request,
                     metadata=metadata,
                     timeout=remaining,
@@ -577,10 +667,22 @@ class InferenceServerClient(InferenceServerClientBase):
             except grpc.RpcError as e:
                 raise_error_grpc(e)
 
+        if self._endpoint_pool is not None:
+            from client_tpu.robust import call_with_retry_pool
+
+            return call_with_retry_pool(
+                lambda state, remaining: _call(self._stubs[state.url],
+                                               remaining),
+                self._endpoint_pool, self._retry_policy,
+                deadline_s=client_timeout, sequence_id=sequence_id,
+                sequence_end=sequence_end,
+            )
+
         from client_tpu.robust import call_with_retry
 
         return call_with_retry(
-            _attempt, self._retry_policy, self._breaker,
+            lambda remaining: _call(self._client_stub, remaining),
+            self._retry_policy, self._breaker,
             deadline_s=client_timeout,
         )
 
@@ -619,25 +721,55 @@ class InferenceServerClient(InferenceServerClientBase):
             parameters=parameters,
         )
 
+        # Pool routing for the callback API: one endpoint is chosen
+        # least-outstanding up front and the outcome settles its
+        # breaker/EWMA. Retries/hedges need a blocking wait — use
+        # infer() (possibly on a worker thread) for those semantics.
+        pool = self._endpoint_pool
+        state = None
+        stub = self._client_stub
+        if pool is not None:
+            state = pool.pick(sequence_id=sequence_id)
+            state.breaker.before_call()
+            stub = self._stubs[state.url]
+            pool.note_start(state)
+        import time as _time
+
+        started = _time.monotonic()
+
         def _done(call_future):
+            error = None
             try:
                 result = InferResult(call_future.result())
-                callback(result, None)
             except grpc.RpcError as rpc_error:
-                callback(None, get_error_grpc(rpc_error))
+                result, error = None, get_error_grpc(rpc_error)
             except grpc.FutureCancelledError:
-                callback(None, InferenceServerException("request cancelled",
-                                                        status="CANCELLED"))
+                result, error = None, InferenceServerException(
+                    "request cancelled", status="CANCELLED")
             except Exception as e:
-                callback(None, InferenceServerException(str(e)))
+                result, error = None, InferenceServerException(str(e))
+            if pool is not None:
+                pool.note_end(state, _time.monotonic() - started,
+                              error=error)
+            callback(result, error)
 
         context = CallContext()
-        call_future = self._client_stub.ModelInfer.future(
-            request,
-            metadata=self._metadata(headers),
-            timeout=client_timeout,
-            compression=_grpc_compression(compression_algorithm),
-        )
+        try:
+            call_future = stub.ModelInfer.future(
+                request,
+                metadata=self._metadata(headers),
+                timeout=client_timeout,
+                compression=_grpc_compression(compression_algorithm),
+            )
+        except BaseException as e:
+            # Submission itself failed (closed channel, plugin hook
+            # raised): _done never runs, so settle the pool here — an
+            # unreleased outstanding count would skew routing forever,
+            # and an unresolved half-open probe would lock the
+            # endpoint out.
+            if pool is not None:
+                pool.note_end(state, _time.monotonic() - started, error=e)
+            raise
         context._set_call(call_future)
         call_future.add_done_callback(_done)
         return context

@@ -30,7 +30,19 @@ def get_error_grpc(rpc_error: grpc.RpcError) -> InferenceServerException:
     except Exception:  # not a Call object
         code = None
         details = str(rpc_error)
-    return InferenceServerException(msg=details, status=code, debug_details=rpc_error)
+    error = InferenceServerException(msg=details, status=code,
+                                     debug_details=rpc_error)
+    # Server-advised backoff rides the trailing metadata (the gRPC twin
+    # of the HTTP Retry-After header); RetryPolicy sleeps at least this
+    # long before the next attempt.
+    try:
+        for key, value in (rpc_error.trailing_metadata() or ()):
+            if str(key).lower() == "retry-after":
+                error.retry_after_s = float(value)
+                break
+    except Exception:  # noqa: BLE001 — metadata is advisory only
+        pass
+    return error
 
 
 def raise_error_grpc(rpc_error: grpc.RpcError):

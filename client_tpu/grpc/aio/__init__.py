@@ -13,7 +13,10 @@ from client_tpu._plugin import InferenceServerClientBase
 from client_tpu.grpc._client import (
     KeepAliveOptions,
     _DEFAULT_CHANNEL_OPTIONS,
+    _channel_credentials,
+    _make_channel,
     _metadata_from_headers,
+    probe_grpc_ready,
 )
 from client_tpu.grpc._utils import (
     InferResult,
@@ -36,7 +39,16 @@ __all__ = [
 
 class InferenceServerClient(InferenceServerClientBase):
     """asyncio flavor: every RPC is a coroutine; ``stream_infer``
-    consumes an async iterator of requests and yields results."""
+    consumes an async iterator of requests and yields results.
+
+    ``url`` may be a comma-separated endpoint list (or a list), or a
+    shared :class:`client_tpu.robust.EndpointPool` may be passed as
+    ``endpoint_pool``: ``infer`` then routes least-outstanding across
+    healthy endpoints, fails over on retryable errors, and hedges
+    tail-slow requests within the pool's budget; a thread-based prober
+    (sync channels, off the event loop) readmits ejected endpoints.
+    Streams stay pinned to the primary endpoint. With a pool,
+    ``circuit_breaker`` is ignored."""
 
     def __init__(
         self,
@@ -51,31 +63,52 @@ class InferenceServerClient(InferenceServerClientBase):
         channel_args: Optional[list] = None,
         retry_policy=None,
         circuit_breaker=None,
+        endpoint_pool=None,
     ):
         super().__init__()
+        from client_tpu.robust import EndpointPool
+
+        urls = (endpoint_pool.urls if endpoint_pool is not None
+                else EndpointPool.split_url(url))
+        if not urls:
+            raise InferenceServerException("invalid url '%s'" % url)
+        self._owns_pool = endpoint_pool is None and len(urls) > 1
+        self._endpoint_pool = (endpoint_pool if endpoint_pool is not None
+                               else (EndpointPool(urls) if len(urls) > 1
+                                     else None))
         # client_tpu.robust wiring (same contract as the sync client):
         # infer() retries retryable statuses with backoff + jitter.
         self._retry_policy = retry_policy
-        self._breaker = circuit_breaker
+        self._breaker = circuit_breaker if self._endpoint_pool is None \
+            else None
         options = list(_DEFAULT_CHANNEL_OPTIONS)
         if keepalive_options is not None:
             options += keepalive_options.channel_args()
         if channel_args is not None:
             options += list(channel_args)
-        if creds is not None:
-            self._channel = grpc.aio.secure_channel(url, creds, options=options)
-        elif ssl:
-            rc = open(root_certificates, "rb").read() if root_certificates else None
-            pk = open(private_key, "rb").read() if private_key else None
-            cc = open(certificate_chain, "rb").read() if certificate_chain else None
-            credentials = grpc.ssl_channel_credentials(rc, pk, cc)
-            self._channel = grpc.aio.secure_channel(
-                url, credentials, options=options
-            )
-        else:
-            self._channel = grpc.aio.insecure_channel(url, options=options)
-        self._client_stub = GRPCInferenceServiceStub(self._channel)
+        credentials = _channel_credentials(
+            ssl, root_certificates, private_key, certificate_chain, creds)
+        self._channels = {
+            u: _make_channel(u, options, credentials, aio=True)
+            for u in urls
+        }
+        self._stubs = {
+            u: GRPCInferenceServiceStub(ch)
+            for u, ch in self._channels.items()
+        }
+        self._channel = self._channels[urls[0]]
+        self._client_stub = self._stubs[urls[0]]
         self._verbose = verbose
+        if self._endpoint_pool is not None:
+            # The probe is SYNC and self-contained (its own short-lived
+            # channel, run on the pool's prober thread): it must never
+            # touch this client's event loop (the loop being wedged is
+            # exactly when probing matters) and must survive this
+            # client closing when the pool is shared.
+            timeout = self._endpoint_pool.probe_timeout_s
+            self._endpoint_pool.ensure_prober(
+                lambda u, _creds=credentials: probe_grpc_ready(
+                    u, _creds, timeout))
 
     async def __aenter__(self):
         return self
@@ -84,11 +117,26 @@ class InferenceServerClient(InferenceServerClientBase):
         await self.close()
 
     async def close(self):
-        await self._channel.close()
+        if self._endpoint_pool is not None and self._owns_pool:
+            self._endpoint_pool.close()
+        for channel in self._channels.values():
+            await channel.close()
+
+    def pool_stats(self) -> Optional[dict]:
+        """EndpointPool snapshot (hedges/failovers/ejections + per-
+        endpoint health); None for a single-endpoint client."""
+        return (self._endpoint_pool.stats()
+                if self._endpoint_pool is not None else None)
 
     def _metadata(self, headers):
         headers = self._call_plugin(dict(headers) if headers else {})
         return _metadata_from_headers(headers)
+
+    def _fleet_stubs(self):
+        """Every endpoint's stub — control-plane verbs that mutate
+        per-replica state (shm registration, model load/unload) must
+        hit the whole fleet, not just the primary."""
+        return list(self._stubs.values())
 
     async def _call(self, method, request, headers, client_timeout=None):
         try:
@@ -159,16 +207,18 @@ class InferenceServerClient(InferenceServerClientBase):
         request = pb.RepositoryModelLoadRequest(model_name=model_name)
         if config is not None:
             request.parameters["config"].string_param = config
-        await self._call(self._client_stub.RepositoryModelLoad, request,
-                         headers, client_timeout)
+        for stub in self._fleet_stubs():
+            await self._call(stub.RepositoryModelLoad, request,
+                             headers, client_timeout)
 
     async def unload_model(self, model_name, headers=None,
                            client_timeout=None):
-        await self._call(
-            self._client_stub.RepositoryModelUnload,
-            pb.RepositoryModelUnloadRequest(model_name=model_name),
-            headers, client_timeout,
-        )
+        for stub in self._fleet_stubs():
+            await self._call(
+                stub.RepositoryModelUnload,
+                pb.RepositoryModelUnloadRequest(model_name=model_name),
+                headers, client_timeout,
+            )
 
     async def get_inference_statistics(self, model_name="", model_version="",
                                        headers=None, client_timeout=None):
@@ -239,21 +289,23 @@ class InferenceServerClient(InferenceServerClientBase):
     async def register_system_shared_memory(self, name, key, byte_size,
                                             offset=0, headers=None,
                                             client_timeout=None):
-        await self._call(
-            self._client_stub.SystemSharedMemoryRegister,
-            pb.SystemSharedMemoryRegisterRequest(
-                name=name, key=key, offset=offset, byte_size=byte_size
-            ),
-            headers, client_timeout,
-        )
+        for stub in self._fleet_stubs():
+            await self._call(
+                stub.SystemSharedMemoryRegister,
+                pb.SystemSharedMemoryRegisterRequest(
+                    name=name, key=key, offset=offset, byte_size=byte_size
+                ),
+                headers, client_timeout,
+            )
 
     async def unregister_system_shared_memory(self, name="", headers=None,
                                               client_timeout=None):
-        await self._call(
-            self._client_stub.SystemSharedMemoryUnregister,
-            pb.SystemSharedMemoryUnregisterRequest(name=name), headers,
-            client_timeout,
-        )
+        for stub in self._fleet_stubs():
+            await self._call(
+                stub.SystemSharedMemoryUnregister,
+                pb.SystemSharedMemoryUnregisterRequest(name=name), headers,
+                client_timeout,
+            )
 
     async def get_tpu_shared_memory_status(self, region_name="", headers=None,
                                            client_timeout=None):
@@ -266,22 +318,24 @@ class InferenceServerClient(InferenceServerClientBase):
     async def register_tpu_shared_memory(self, name, raw_handle, device_id,
                                          byte_size, headers=None,
                                          client_timeout=None):
-        await self._call(
-            self._client_stub.TpuSharedMemoryRegister,
-            pb.TpuSharedMemoryRegisterRequest(
-                name=name, raw_handle=raw_handle, device_id=device_id,
-                byte_size=byte_size,
-            ),
-            headers, client_timeout,
-        )
+        for stub in self._fleet_stubs():
+            await self._call(
+                stub.TpuSharedMemoryRegister,
+                pb.TpuSharedMemoryRegisterRequest(
+                    name=name, raw_handle=raw_handle, device_id=device_id,
+                    byte_size=byte_size,
+                ),
+                headers, client_timeout,
+            )
 
     async def unregister_tpu_shared_memory(self, name="", headers=None,
                                            client_timeout=None):
-        await self._call(
-            self._client_stub.TpuSharedMemoryUnregister,
-            pb.TpuSharedMemoryUnregisterRequest(name=name), headers,
-            client_timeout,
-        )
+        for stub in self._fleet_stubs():
+            await self._call(
+                stub.TpuSharedMemoryUnregister,
+                pb.TpuSharedMemoryUnregisterRequest(name=name), headers,
+                client_timeout,
+            )
 
     get_cuda_shared_memory_status = get_tpu_shared_memory_status
     register_cuda_shared_memory = register_tpu_shared_memory
@@ -311,6 +365,22 @@ class InferenceServerClient(InferenceServerClientBase):
             sequence_start=sequence_start, sequence_end=sequence_end,
             priority=priority, timeout=timeout, parameters=parameters,
         )
+
+        if self._endpoint_pool is not None:
+            from client_tpu.robust import call_with_retry_pool_async
+
+            async def _pool_attempt(state, remaining):
+                response = await self._call(
+                    self._stubs[state.url].ModelInfer, request, headers,
+                    remaining
+                )
+                return InferResult(response)
+
+            return await call_with_retry_pool_async(
+                _pool_attempt, self._endpoint_pool, self._retry_policy,
+                deadline_s=client_timeout, sequence_id=sequence_id,
+                sequence_end=sequence_end,
+            )
 
         async def _attempt(remaining):
             response = await self._call(

@@ -157,16 +157,43 @@ class _KeepAliveConnectionPool:
 _ConnectionPool = _KeepAliveConnectionPool
 
 
-class InferenceServerClient(InferenceServerClientBase):
-    """A client talking to a KServe-v2 HTTP/REST endpoint.
+class _HttpEndpoint:
+    """One endpoint's transport: parsed address + keep-alive pool."""
 
-    ``concurrency`` sizes both the connection pool and the async
-    worker pool (reference http/_client.py:178-188 semantics).
+    def __init__(self, url: str, ssl: bool, ssl_context, concurrency: int,
+                 default_timeout: float, connection_timeout: float):
+        self.url = url
+        parsed = urlparse(url if "://" in url
+                          else ("https://" if ssl else "http://") + url)
+        if parsed.hostname is None:
+            raise InferenceServerException("invalid url '%s'" % url)
+        self.host = parsed.hostname
+        self.port = parsed.port or (443 if ssl else 80)
+        self.pool = _KeepAliveConnectionPool(
+            self.host, self.port, max(concurrency, 1), default_timeout,
+            ssl, ssl_context, acquire_timeout=connection_timeout,
+        )
+
+
+class InferenceServerClient(InferenceServerClientBase):
+    """A client talking to one or more KServe-v2 HTTP/REST endpoints.
+
+    ``concurrency`` sizes both the per-endpoint connection pool and the
+    async worker pool (reference http/_client.py:178-188 semantics).
 
     ``retry_policy`` / ``circuit_breaker``
     (:mod:`client_tpu.robust`) make :meth:`infer` retry retryable
     failures (503/UNAVAILABLE, connection errors) with exponential
     backoff + full jitter, and fail fast while the breaker is open.
+
+    ``url`` may be a comma-separated endpoint list (or a list), or an
+    :class:`client_tpu.robust.EndpointPool` may be passed as
+    ``endpoint_pool`` (possibly shared with other clients): ``infer``
+    then routes least-outstanding across healthy endpoints, fails over
+    on retryable errors, hedges tail-slow requests within the pool's
+    budget, and a background prober readmits ejected endpoints. With a
+    pool, ``circuit_breaker`` is ignored — health is per endpoint,
+    owned by the pool.
     """
 
     def __init__(
@@ -180,27 +207,43 @@ class InferenceServerClient(InferenceServerClientBase):
         ssl_context=None,
         retry_policy=None,
         circuit_breaker=None,
+        endpoint_pool=None,
     ):
         super().__init__()
-        if "://" in url:
-            parsed = urlparse(url)
-        else:
-            parsed = urlparse(("https://" if ssl else "http://") + url)
-        if parsed.hostname is None:
+        from client_tpu.robust import EndpointPool
+
+        urls = (endpoint_pool.urls if endpoint_pool is not None
+                else EndpointPool.split_url(url))
+        if not urls:
             raise InferenceServerException("invalid url '%s'" % url)
-        self._host = parsed.hostname
-        self._port = parsed.port or (443 if ssl else 80)
+        self._owns_pool = endpoint_pool is None and len(urls) > 1
+        self._endpoint_pool = (endpoint_pool if endpoint_pool is not None
+                               else (EndpointPool(urls) if len(urls) > 1
+                                     else None))
         self._verbose = verbose
         self._default_timeout = max(connection_timeout, network_timeout)
-        self._pool = _KeepAliveConnectionPool(
-            self._host, self._port, max(concurrency, 1),
-            self._default_timeout, ssl, ssl_context,
-            acquire_timeout=connection_timeout,
-        )
+        self._endpoints: Dict[str, _HttpEndpoint] = {
+            u: _HttpEndpoint(u, ssl, ssl_context, concurrency,
+                             self._default_timeout, connection_timeout)
+            for u in urls
+        }
+        self._primary = self._endpoints[urls[0]]
+        # Single-endpoint compat surface (tests and subclasses poke at
+        # these; multi-endpoint callers should not).
+        self._host = self._primary.host
+        self._port = self._primary.port
+        self._pool = self._primary.pool
         self._executor = ThreadPoolExecutor(max_workers=max(concurrency, 1))
         self._retry_policy = retry_policy
-        self._breaker = circuit_breaker
+        self._breaker = circuit_breaker if self._endpoint_pool is None \
+            else None
         self._closed = False
+        if self._endpoint_pool is not None:
+            from client_tpu.http._endpoints import probe_http_ready
+
+            timeout = self._endpoint_pool.probe_timeout_s
+            self._endpoint_pool.ensure_prober(
+                lambda u, _ssl=ssl: probe_http_ready(u, timeout, _ssl))
 
     def __enter__(self):
         return self
@@ -214,11 +257,20 @@ class InferenceServerClient(InferenceServerClientBase):
         except Exception:
             pass
 
+    def pool_stats(self) -> Optional[dict]:
+        """EndpointPool snapshot (hedges/failovers/ejections + per-
+        endpoint health); None for a single-endpoint client."""
+        return (self._endpoint_pool.stats()
+                if self._endpoint_pool is not None else None)
+
     def close(self):
         if not self._closed:
             self._closed = True
             self._executor.shutdown(wait=True)
-            self._pool.close()
+            for endpoint in self._endpoints.values():
+                endpoint.pool.close()
+            if self._endpoint_pool is not None and self._owns_pool:
+                self._endpoint_pool.close()
 
     # -- low-level request -----------------------------------------------
 
@@ -229,11 +281,14 @@ class InferenceServerClient(InferenceServerClientBase):
         body: Optional[bytes] = None,
         headers: Optional[dict] = None,
         timeout: Optional[float] = None,
+        endpoint: Optional[_HttpEndpoint] = None,
     ) -> Tuple[int, dict, bytes]:
         """``timeout`` caps THIS request's socket wait (per-call
-        deadline); the pool's default timeout is restored on release."""
+        deadline); the pool's default timeout is restored on release.
+        ``endpoint`` targets one fleet member (default: the primary)."""
+        endpoint = endpoint or self._primary
         headers = self._call_plugin(dict(headers) if headers else {})
-        conn = self._pool.acquire()
+        conn = endpoint.pool.acquire()
         broken = False
         try:
             deadline = None
@@ -275,7 +330,7 @@ class InferenceServerClient(InferenceServerClientBase):
             broken = True
             raise InferenceServerException(
                 "request to %s:%d timed out after %.3fs"
-                % (self._host, self._port,
+                % (endpoint.host, endpoint.port,
                    timeout if timeout is not None else
                    self._default_timeout),
                 status="DEADLINE_EXCEEDED",
@@ -283,7 +338,8 @@ class InferenceServerClient(InferenceServerClientBase):
         except (http.client.HTTPException, OSError) as e:
             broken = True
             raise InferenceServerException(
-                "connection to %s:%d failed: %s" % (self._host, self._port, e),
+                "connection to %s:%d failed: %s"
+                % (endpoint.host, endpoint.port, e),
                 status="UNAVAILABLE",
             ) from e
         finally:
@@ -291,28 +347,56 @@ class InferenceServerClient(InferenceServerClientBase):
                 conn.timeout = self._default_timeout
                 if conn.sock is not None:
                     conn.sock.settimeout(self._default_timeout)
-            self._pool.release(conn, broken)
+            endpoint.pool.release(conn, broken)
 
     def _get_json(self, path: str, headers=None, method: str = "GET",
                   body: Optional[bytes] = None):
-        status, _, payload = self._request(method, path, body=body,
-                                           headers=headers)
-        ep.raise_if_error(status, payload)
+        status, resp_headers, payload = self._request(method, path, body=body,
+                                                      headers=headers)
+        ep.raise_if_error(
+            status, payload,
+            retry_after_s=ep.parse_retry_after(
+                resp_headers.get("retry-after")))
         return json.loads(payload) if payload else {}
+
+    def _get_json_fleet(self, path: str, headers=None, method: str = "GET",
+                        body: Optional[bytes] = None):
+        """Run a control-plane verb against EVERY endpoint (shm
+        registration, model load/unload): fleet members are replicas,
+        so per-replica state must be applied to all of them. Single
+        endpoint = plain call."""
+        result = None
+        for endpoint in self._endpoints.values():
+            status, resp_headers, payload = self._request(
+                method, path, body=body, headers=headers,
+                endpoint=endpoint)
+            ep.raise_if_error(
+                status, payload,
+                retry_after_s=ep.parse_retry_after(
+                    resp_headers.get("retry-after")))
+            result = json.loads(payload) if payload else {}
+        return result
 
     # -- health / metadata ----------------------------------------------
 
-    def is_server_live(self, headers=None) -> bool:
-        status, _, _ = self._request("GET", "/v2/health/live", headers=headers)
+    def is_server_live(self, headers=None, client_timeout=None) -> bool:
+        """``client_timeout`` bounds the probe (gRPC-client parity) —
+        a health check against a wedged server must not hang for the
+        transport default."""
+        status, _, _ = self._request("GET", "/v2/health/live",
+                                     headers=headers, timeout=client_timeout)
         return status == 200
 
-    def is_server_ready(self, headers=None) -> bool:
-        status, _, _ = self._request("GET", "/v2/health/ready", headers=headers)
+    def is_server_ready(self, headers=None, client_timeout=None) -> bool:
+        status, _, _ = self._request("GET", "/v2/health/ready",
+                                     headers=headers, timeout=client_timeout)
         return status == 200
 
-    def is_model_ready(self, model_name, model_version="", headers=None) -> bool:
+    def is_model_ready(self, model_name, model_version="", headers=None,
+                       client_timeout=None) -> bool:
         status, _, _ = self._request(
-            "GET", ep.ready_path(model_name, model_version), headers=headers
+            "GET", ep.ready_path(model_name, model_version), headers=headers,
+            timeout=client_timeout,
         )
         return status == 200
 
@@ -334,12 +418,13 @@ class InferenceServerClient(InferenceServerClientBase):
     # -- model control ---------------------------------------------------
 
     def load_model(self, model_name, headers=None, config=None, files=None):
-        self._get_json(ep.repo_load_path(model_name), headers, method="POST",
-                       body=ep.load_model_body(config))
+        self._get_json_fleet(ep.repo_load_path(model_name), headers,
+                             method="POST", body=ep.load_model_body(config))
 
     def unload_model(self, model_name, headers=None, unload_dependents=False):
-        self._get_json(ep.repo_unload_path(model_name), headers, method="POST",
-                       body=ep.unload_model_body(unload_dependents))
+        self._get_json_fleet(ep.repo_unload_path(model_name), headers,
+                             method="POST",
+                             body=ep.unload_model_body(unload_dependents))
 
     # -- statistics / settings ------------------------------------------
 
@@ -371,14 +456,14 @@ class InferenceServerClient(InferenceServerClientBase):
 
     def register_system_shared_memory(self, name, key, byte_size, offset=0,
                                       headers=None):
-        self._get_json(
+        self._get_json_fleet(
             ep.shm_register_path("system", name), headers, method="POST",
             body=ep.system_shm_register_body(key, byte_size, offset),
         )
 
     def unregister_system_shared_memory(self, name="", headers=None):
-        self._get_json(ep.shm_unregister_path("system", name), headers,
-                       method="POST", body=b"{}")
+        self._get_json_fleet(ep.shm_unregister_path("system", name), headers,
+                             method="POST", body=b"{}")
 
     def get_tpu_shared_memory_status(self, region_name="", headers=None) -> list:
         return self._get_json(ep.shm_status_path("tpu", region_name), headers)
@@ -388,14 +473,14 @@ class InferenceServerClient(InferenceServerClientBase):
         """raw_handle: serialized TPU region descriptor (posted base64,
         the same shape the reference uses for cudaIpcMemHandle_t —
         http_client.cc:1712)."""
-        self._get_json(
+        self._get_json_fleet(
             ep.shm_register_path("tpu", name), headers, method="POST",
             body=ep.tpu_shm_register_body(raw_handle, device_id, byte_size),
         )
 
     def unregister_tpu_shared_memory(self, name="", headers=None):
-        self._get_json(ep.shm_unregister_path("tpu", name), headers,
-                       method="POST", body=b"{}")
+        self._get_json_fleet(ep.shm_unregister_path("tpu", name), headers,
+                             method="POST", body=b"{}")
 
     get_cuda_shared_memory_status = get_tpu_shared_memory_status
     register_cuda_shared_memory = register_tpu_shared_memory
@@ -490,19 +575,39 @@ class InferenceServerClient(InferenceServerClientBase):
                 for k, v in query_params.items()
             )
 
-        def _attempt(remaining: Optional[float]) -> InferResult:
-            status, resp_headers, payload = self._request(
-                "POST", path, body=body, headers=request_headers,
-                timeout=remaining,
-            )
+        def _decode(status, resp_headers, payload) -> InferResult:
             payload_out = decompress_body(
                 payload, resp_headers.get("content-encoding"))
-            ep.raise_if_error(status, payload_out)
+            ep.raise_if_error(
+                status, payload_out,
+                retry_after_s=ep.parse_retry_after(
+                    resp_headers.get("retry-after")))
             response_header_len = resp_headers.get(HEADER_LEN.lower())
             return InferResult.from_response_body(
                 payload_out,
                 int(response_header_len) if response_header_len else None,
             )
+
+        if self._endpoint_pool is not None:
+            from client_tpu.robust import call_with_retry_pool
+
+            def _pool_attempt(state, remaining) -> InferResult:
+                return _decode(*self._request(
+                    "POST", path, body=body, headers=request_headers,
+                    timeout=remaining, endpoint=self._endpoints[state.url],
+                ))
+
+            return call_with_retry_pool(
+                _pool_attempt, self._endpoint_pool, self._retry_policy,
+                deadline_s=client_timeout, sequence_id=sequence_id,
+                sequence_end=sequence_end,
+            )
+
+        def _attempt(remaining: Optional[float]) -> InferResult:
+            return _decode(*self._request(
+                "POST", path, body=body, headers=request_headers,
+                timeout=remaining,
+            ))
 
         from client_tpu.robust import call_with_retry
 

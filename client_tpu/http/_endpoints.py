@@ -103,13 +103,59 @@ def unload_model_body(unload_dependents: bool = False) -> bytes:
     ).encode()
 
 
-def raise_if_error(status: int, body: bytes) -> None:
+def raise_if_error(status: int, body: bytes,
+                   retry_after_s=None) -> None:
     if status < 400:
         return
     try:
         message = json.loads(body).get("error", "")
     except Exception:
         message = body.decode(errors="replace")
-    raise InferenceServerException(
+    error = InferenceServerException(
         message or ("HTTP status %d" % status), status=str(status)
     )
+    if retry_after_s is not None:
+        # Server-advised backoff (Retry-After header, delta-seconds
+        # form); RetryPolicy sleeps at least this long before retrying.
+        error.retry_after_s = retry_after_s
+    raise error
+
+
+def parse_retry_after(value) -> "float | None":
+    """Delta-seconds Retry-After header value -> seconds (HTTP-date
+    forms are ignored: the servers here only send delta-seconds)."""
+    if not value:
+        return None
+    try:
+        seconds = float(value)
+    except (TypeError, ValueError):
+        return None
+    return seconds if seconds > 0 else None
+
+
+def probe_http_ready(url: str, timeout: float = 1.0,
+                     ssl: bool = False) -> bool:
+    """Bounded stdlib /v2/health/ready probe for one endpoint — the
+    EndpointPool prober's health check. Self-contained (no client
+    connection pool) so a wedged pool can never block probing, and
+    usable from asyncio clients without touching their event loop."""
+    import http.client
+    from urllib.parse import urlparse
+
+    if "://" not in url:
+        url = ("https://" if ssl else "http://") + url
+    parsed = urlparse(url)
+    if parsed.hostname is None:
+        return False
+    conn_cls = (http.client.HTTPSConnection if parsed.scheme == "https"
+                else http.client.HTTPConnection)
+    conn = conn_cls(parsed.hostname,
+                    parsed.port or (443 if parsed.scheme == "https" else 80),
+                    timeout=timeout)
+    try:
+        conn.request("GET", "/v2/health/ready")
+        return conn.getresponse().status == 200
+    except Exception:  # noqa: BLE001 — any failure = not ready
+        return False
+    finally:
+        conn.close()

@@ -25,6 +25,15 @@ __all__ = [
 
 
 class InferenceServerClient(InferenceServerClientBase):
+    """asyncio HTTP client. ``url`` may be a comma-separated endpoint
+    list (or a list), or a shared
+    :class:`client_tpu.robust.EndpointPool` may be passed as
+    ``endpoint_pool``: ``infer`` then routes least-outstanding across
+    healthy endpoints, fails over on retryable errors, and hedges
+    tail-slow requests within the pool's budget; the pool's
+    thread-based prober (stdlib HTTP, off the event loop) readmits
+    ejected endpoints. With a pool, ``circuit_breaker`` is ignored."""
+
     def __init__(
         self,
         url: str,
@@ -35,15 +44,29 @@ class InferenceServerClient(InferenceServerClientBase):
         ssl_context=None,
         retry_policy=None,
         circuit_breaker=None,
+        endpoint_pool=None,
     ):
         super().__init__()
+        from client_tpu.robust import EndpointPool
+
+        urls = (endpoint_pool.urls if endpoint_pool is not None
+                else EndpointPool.split_url(url))
+        if not urls:
+            raise InferenceServerException("invalid url '%s'" % url)
+        self._owns_pool = endpoint_pool is None and len(urls) > 1
+        self._endpoint_pool = (endpoint_pool if endpoint_pool is not None
+                               else (EndpointPool(urls) if len(urls) > 1
+                                     else None))
         # client_tpu.robust wiring (same contract as the sync client).
         self._retry_policy = retry_policy
-        self._breaker = circuit_breaker
-        base = url if "://" in url else (
-            ("https://" if ssl else "http://") + url
-        )
-        self._base = base.rstrip("/")
+        self._breaker = circuit_breaker if self._endpoint_pool is None \
+            else None
+        self._bases = {
+            u: (u if "://" in u else (("https://" if ssl else "http://") + u)
+                ).rstrip("/")
+            for u in urls
+        }
+        self._base = self._bases[urls[0]]
         self._verbose = verbose
         connector = aiohttp.TCPConnector(limit=conn_limit, ssl=ssl_context
                                          if ssl else False)
@@ -51,6 +74,12 @@ class InferenceServerClient(InferenceServerClientBase):
             connector=connector,
             timeout=aiohttp.ClientTimeout(total=conn_timeout),
         )
+        if self._endpoint_pool is not None:
+            from client_tpu.http._endpoints import probe_http_ready
+
+            timeout = self._endpoint_pool.probe_timeout_s
+            self._endpoint_pool.ensure_prober(
+                lambda u, _ssl=ssl: probe_http_ready(u, timeout, _ssl))
 
     async def __aenter__(self):
         return self
@@ -59,18 +88,27 @@ class InferenceServerClient(InferenceServerClientBase):
         await self.close()
 
     async def close(self):
+        if self._endpoint_pool is not None and self._owns_pool:
+            self._endpoint_pool.close()
         await self._session.close()
 
+    def pool_stats(self) -> Optional[dict]:
+        """EndpointPool snapshot (hedges/failovers/ejections + per-
+        endpoint health); None for a single-endpoint client."""
+        return (self._endpoint_pool.stats()
+                if self._endpoint_pool is not None else None)
+
     async def _request(self, method: str, path: str, body=None, headers=None,
-                       timeout: Optional[float] = None):
+                       timeout: Optional[float] = None,
+                       base: Optional[str] = None):
         headers = self._call_plugin(dict(headers) if headers else {})
         kwargs = {}
         if timeout is not None:
             kwargs["timeout"] = aiohttp.ClientTimeout(total=timeout)
         try:
             async with self._session.request(
-                method, self._base + path, data=body, headers=headers or {},
-                **kwargs
+                method, (base or self._base) + path, data=body,
+                headers=headers or {}, **kwargs
             ) as response:
                 payload = await response.read()
                 return response.status, dict(response.headers), payload
@@ -82,27 +120,53 @@ class InferenceServerClient(InferenceServerClientBase):
             raise InferenceServerException(
                 "connection failed: %s" % e, status="UNAVAILABLE") from e
 
+    @staticmethod
+    def _raise_if_error(status, resp_headers, payload):
+        lowered = {k.lower(): v for k, v in resp_headers.items()}
+        ep.raise_if_error(
+            status, payload,
+            retry_after_s=ep.parse_retry_after(lowered.get("retry-after")))
+
     async def _get_json(self, path, headers=None, method="GET", body=None):
-        status, _, payload = await self._request(method, path, body, headers)
-        ep.raise_if_error(status, payload)
+        status, resp_headers, payload = await self._request(
+            method, path, body, headers)
+        self._raise_if_error(status, resp_headers, payload)
         return json.loads(payload) if payload else {}
+
+    async def _get_json_fleet(self, path, headers=None, method="GET",
+                              body=None):
+        """Control-plane verb against EVERY endpoint (shm registration,
+        model load/unload) — fleet members are replicas, so
+        per-replica state must be applied to all of them."""
+        result = None
+        for base in self._bases.values():
+            status, resp_headers, payload = await self._request(
+                method, path, body, headers, base=base)
+            self._raise_if_error(status, resp_headers, payload)
+            result = json.loads(payload) if payload else {}
+        return result
 
     # -- health / metadata ----------------------------------------------
 
-    async def is_server_live(self, headers=None) -> bool:
+    async def is_server_live(self, headers=None, client_timeout=None) -> bool:
+        """``client_timeout`` bounds the probe (sync/gRPC parity)."""
         status, _, _ = await self._request("GET", "/v2/health/live",
-                                           headers=headers)
+                                           headers=headers,
+                                           timeout=client_timeout)
         return status == 200
 
-    async def is_server_ready(self, headers=None) -> bool:
+    async def is_server_ready(self, headers=None,
+                              client_timeout=None) -> bool:
         status, _, _ = await self._request("GET", "/v2/health/ready",
-                                           headers=headers)
+                                           headers=headers,
+                                           timeout=client_timeout)
         return status == 200
 
     async def is_model_ready(self, model_name, model_version="",
-                             headers=None) -> bool:
+                             headers=None, client_timeout=None) -> bool:
         status, _, _ = await self._request(
-            "GET", ep.ready_path(model_name, model_version), headers=headers
+            "GET", ep.ready_path(model_name, model_version), headers=headers,
+            timeout=client_timeout,
         )
         return status == 200
 
@@ -126,12 +190,14 @@ class InferenceServerClient(InferenceServerClientBase):
                                     method="POST", body=b"{}")
 
     async def load_model(self, model_name, headers=None, config=None):
-        await self._get_json(ep.repo_load_path(model_name), headers,
-                             method="POST", body=ep.load_model_body(config))
+        await self._get_json_fleet(ep.repo_load_path(model_name), headers,
+                                   method="POST",
+                                   body=ep.load_model_body(config))
 
     async def unload_model(self, model_name, headers=None):
-        await self._get_json(ep.repo_unload_path(model_name), headers,
-                             method="POST", body=ep.unload_model_body())
+        await self._get_json_fleet(ep.repo_unload_path(model_name), headers,
+                                   method="POST",
+                                   body=ep.unload_model_body())
 
     async def get_inference_statistics(self, model_name="", model_version="",
                                        headers=None) -> dict:
@@ -169,14 +235,14 @@ class InferenceServerClient(InferenceServerClientBase):
 
     async def register_system_shared_memory(self, name, key, byte_size,
                                             offset=0, headers=None):
-        await self._get_json(
+        await self._get_json_fleet(
             ep.shm_register_path("system", name), headers, method="POST",
             body=ep.system_shm_register_body(key, byte_size, offset),
         )
 
     async def unregister_system_shared_memory(self, name="", headers=None):
-        await self._get_json(ep.shm_unregister_path("system", name), headers,
-                             method="POST", body=b"{}")
+        await self._get_json_fleet(ep.shm_unregister_path("system", name),
+                                   headers, method="POST", body=b"{}")
 
     async def get_tpu_shared_memory_status(self, region_name="",
                                            headers=None) -> list:
@@ -186,14 +252,14 @@ class InferenceServerClient(InferenceServerClientBase):
 
     async def register_tpu_shared_memory(self, name, raw_handle, device_id,
                                          byte_size, headers=None):
-        await self._get_json(
+        await self._get_json_fleet(
             ep.shm_register_path("tpu", name), headers, method="POST",
             body=ep.tpu_shm_register_body(raw_handle, device_id, byte_size),
         )
 
     async def unregister_tpu_shared_memory(self, name="", headers=None):
-        await self._get_json(ep.shm_unregister_path("tpu", name), headers,
-                             method="POST", body=b"{}")
+        await self._get_json_fleet(ep.shm_unregister_path("tpu", name),
+                                   headers, method="POST", body=b"{}")
 
     get_cuda_shared_memory_status = get_tpu_shared_memory_status
     register_cuda_shared_memory = register_tpu_shared_memory
@@ -230,17 +296,36 @@ class InferenceServerClient(InferenceServerClientBase):
         else:
             request_headers["Content-Type"] = "application/json"
 
-        async def _attempt(remaining):
-            status, resp_headers, payload = await self._request(
-                "POST", ep.infer_path(model_name, model_version), body=body,
-                headers=request_headers, timeout=remaining,
-            )
-            ep.raise_if_error(status, payload)
+        path = ep.infer_path(model_name, model_version)
+
+        def _decode(status, resp_headers, payload):
+            self._raise_if_error(status, resp_headers, payload)
             lowered = {k.lower(): v for k, v in resp_headers.items()}
             header_len = lowered.get(HEADER_LEN.lower())
             return InferResult.from_response_body(
                 payload, int(header_len) if header_len else None
             )
+
+        if self._endpoint_pool is not None:
+            from client_tpu.robust import call_with_retry_pool_async
+
+            async def _pool_attempt(state, remaining):
+                return _decode(*await self._request(
+                    "POST", path, body=body, headers=request_headers,
+                    timeout=remaining, base=self._bases[state.url],
+                ))
+
+            return await call_with_retry_pool_async(
+                _pool_attempt, self._endpoint_pool, self._retry_policy,
+                deadline_s=client_timeout, sequence_id=sequence_id,
+                sequence_end=sequence_end,
+            )
+
+        async def _attempt(remaining):
+            return _decode(*await self._request(
+                "POST", path, body=body,
+                headers=request_headers, timeout=remaining,
+            ))
 
         from client_tpu.robust import call_with_retry_async
 

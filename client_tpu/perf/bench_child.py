@@ -30,6 +30,7 @@ import pathlib
 import signal
 import subprocess
 import sys
+import threading
 import time
 
 from client_tpu.perf.harness_proc import run_native
@@ -429,6 +430,86 @@ def run_python_harness(model: str, batch: int, concurrency: int,
     setup_backend.close()
     status = results[-1]
     return status.throughput, status.latency_percentiles.get(50, 0.0)
+
+
+def run_fleet_measure(concurrency: int = 8, hedge_max_ratio: float = 0.05,
+                      spike_ms: float = 0.0, kill_after_s: float = 0.0,
+                      window_ms: int = 2500, trials: int = 2):
+    """Spin a 2-server in-process fleet (gRPC, `simple`), measure one
+    concurrency level through the EndpointPool client, optionally
+    latency-spiking or killing one endpoint mid-run. Returns
+    (PerfStatus, pool_stats). Self-contained: servers and pool are
+    torn down before returning."""
+    from client_tpu import robust
+    from client_tpu.perf.client_backend import (
+        BackendKind,
+        ClientBackendFactory,
+    )
+    from client_tpu.perf.data_loader import DataLoader
+    from client_tpu.perf.load_manager import (
+        ConcurrencyManager,
+        InferDataManager,
+    )
+    from client_tpu.perf.model_parser import ModelParser
+    from client_tpu.perf.profiler import InferenceProfiler, MeasurementConfig
+    from client_tpu.server import chaos
+    from client_tpu.server.app import build_core, start_grpc_server
+
+    fleet = []
+    for i in range(2):
+        fleet_core = build_core(["simple"])
+        fleet_core.chaos_scope = "bench_ep%d" % i
+        fleet.append((fleet_core, start_grpc_server(core=fleet_core)))
+    pool = robust.EndpointPool(
+        [h.address for _c, h in fleet],
+        hedge_delay_min_ms=2.0, hedge_max_ratio=hedge_max_ratio)
+    factory = ClientBackendFactory(
+        BackendKind.TRITON_GRPC, url=",".join(pool.urls),
+        retry_policy=robust.RetryPolicy(max_attempts=4,
+                                        initial_backoff_s=0.01),
+        endpoint_pool=pool)
+    scenario_timer = None
+    try:
+        setup_backend = factory.create()
+        parsed = ModelParser().parse(setup_backend, "simple", batch_size=1)
+        loader = DataLoader(parsed)
+        loader.generate_data()
+        manager = ConcurrencyManager(
+            factory=factory, model=parsed, data_loader=loader,
+            data_manager=InferDataManager(parsed, loader, batch_size=1),
+            async_mode=True, max_threads=8)
+        manager.init()
+        if spike_ms > 0:
+            chaos.configure_scope("bench_ep0",
+                                  chaos.ChaosConfig(latency_ms=spike_ms))
+        if kill_after_s > 0:
+            scenario_timer = threading.Timer(
+                kill_after_s, fleet[0][1].stop)
+            scenario_timer.daemon = True
+            scenario_timer.start()
+        profiler = InferenceProfiler(
+            manager,
+            MeasurementConfig(measurement_interval_ms=window_ms,
+                              max_trials=trials, stability_threshold=0.5,
+                              batch_size=1),
+            setup_backend, "simple")
+        manager.change_concurrency_level(2)
+        time.sleep(0.8)  # warm the fleet + latency window
+        results = profiler.profile_concurrency_range(concurrency,
+                                                     concurrency)
+        manager.cleanup()
+        setup_backend.close()
+        return results[-1], pool.stats()
+    finally:
+        if scenario_timer is not None:
+            scenario_timer.cancel()
+        chaos.configure_scope("bench_ep0", None)
+        pool.close()
+        for fleet_core, handle in fleet:
+            try:
+                handle.stop()
+            except Exception:  # already killed mid-run
+                pass
 
 
 def main() -> None:
@@ -1019,6 +1100,52 @@ def main() -> None:
             record_stage("dyna_sequence_inprocess", tput, p50, extra)
         except Exception as exc:  # noqa: BLE001
             log("dyna_sequence_inprocess failed: %s" % exc)
+
+    # Config 3c: failover + hedging across a 2-server fleet (the
+    # EndpointPool client). Three measurements: one endpoint latency-
+    # spiked WITHOUT hedging (the tail to beat), the same spike WITH
+    # hedging (p99 must drop while the hedge ratio stays inside the
+    # budget), and one endpoint hard-killed mid-run (goodput must hold
+    # 100% — every failure failed over).
+    if remaining() > 150 and stage_wanted("failover_hedging"):
+        try:
+            from client_tpu import robust as _robust
+
+            _robust.reset_retry_total()
+            spiked, _ = run_fleet_measure(hedge_max_ratio=0.0,
+                                          spike_ms=200.0)
+            hedged, hedged_pool = run_fleet_measure(hedge_max_ratio=0.05,
+                                                    spike_ms=200.0)
+            killed, killed_pool = run_fleet_measure(kill_after_s=2.0,
+                                                    window_ms=3000,
+                                                    trials=2)
+            attempted = killed.completed_count + killed.error_count
+            extra = {
+                "p99_spiked_unhedged_us": round(
+                    spiked.latency_percentiles.get(99, 0.0)),
+                "p99_spiked_hedged_us": round(
+                    hedged.latency_percentiles.get(99, 0.0)),
+                "hedges_fired": hedged_pool["hedges_fired"],
+                "hedges_won": hedged_pool["hedges_won"],
+                "hedge_ratio": round(
+                    hedged_pool["hedges_fired"]
+                    / max(hedged_pool["requests"], 1), 4),
+                "hedge_delay_ms": hedged_pool["hedge_delay_ms"],
+                "kill_errors": killed.error_count,
+                "kill_goodput_pct": round(
+                    killed.completed_count / attempted * 100.0, 2)
+                if attempted else 0.0,
+                "kill_failovers": killed_pool["failovers"],
+                "kill_ejections": killed_pool["ejections"],
+            }
+            if extra["p99_spiked_hedged_us"]:
+                extra["p99_hedging_speedup"] = round(
+                    extra["p99_spiked_unhedged_us"]
+                    / extra["p99_spiked_hedged_us"], 2)
+            record_stage("failover_hedging", hedged.throughput,
+                         hedged.latency_percentiles.get(50, 0.0), extra)
+        except Exception as exc:  # noqa: BLE001
+            log("failover_hedging failed: %s" % exc)
 
     # Config 4: ensemble (preprocess -> resnet50 -> postprocess) over
     # bidi streaming gRPC with decoupled outputs. Concurrency 32 for

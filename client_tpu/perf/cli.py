@@ -47,7 +47,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     parser.add_argument("-m", "--model-name", required=True)
     parser.add_argument("-x", "--model-version", default="")
-    parser.add_argument("-u", "--url", default="localhost:8001")
+    parser.add_argument("-u", "--url", default="localhost:8001",
+                        help="server endpoint, or a comma-separated "
+                             "endpoint list: the client then routes "
+                             "by expected completion time across "
+                             "healthy endpoints with failover + "
+                             "hedging (service-kind triton only)")
     parser.add_argument("-i", "--protocol", choices=["grpc", "http"],
                         default="grpc")
     parser.add_argument("--service-kind", default="triton",
@@ -141,6 +146,27 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--circuit-breaker-threshold", type=int, default=0,
                         help="consecutive failures before a worker's "
                              "circuit opens (0 = no breaker)")
+    parser.add_argument("--hedge-delay-ms", type=float, default=1.0,
+                        help="floor for the hedge delay; the actual "
+                             "delay is max(this, the pool's observed "
+                             "p95 latency). Applies to multi-endpoint "
+                             "runs only")
+    parser.add_argument("--hedge-max-ratio", type=float, default=0.05,
+                        help="hedge budget: max fraction of requests "
+                             "that may fire a hedge (0 disables "
+                             "hedging)")
+    parser.add_argument("--fleet", type=int, default=0,
+                        help="start N embedded servers (each its own "
+                             "core, --protocol transport) and spread "
+                             "-u across them — the self-contained "
+                             "failover/hedging testbed (service-kind "
+                             "triton only)")
+    parser.add_argument("--degrade-one",
+                        default=None,
+                        help="staged degradation of one fleet member: "
+                             "'latency_ms=200,latency_after_s=1,"
+                             "kill_after_s=3,victim=0' (requires "
+                             "--fleet)")
     return parser
 
 
@@ -185,6 +211,69 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
               "supported by the %s backend and will be ignored"
               % args.service_kind, file=sys.stderr)
 
+    # -- embedded fleet: N in-process servers behind real transports --
+    fleet_members = []  # (scope, server, core, stop_fn)
+    scenario = None
+    if args.fleet and args.fleet > 1:
+        if args.service_kind != "triton":
+            print("perf failed: --fleet requires --service-kind triton",
+                  file=sys.stderr)
+            return 1
+        from client_tpu.server.app import build_core as _build_core
+        from client_tpu.server.app import start_grpc_server
+
+        fleet_urls = []
+        for i in range(args.fleet):
+            scope = "ep%d" % i
+            member_core = _build_core([args.model_name])
+            member_core.chaos_scope = scope
+            if args.protocol == "grpc":
+                handle = start_grpc_server(core=member_core,
+                                           address="127.0.0.1:0")
+                fleet_urls.append(handle.address)
+                fleet_members.append((scope, handle, member_core,
+                                      handle.stop))
+            else:
+                from client_tpu.server.http_server import (
+                    start_http_server_thread,
+                )
+
+                runner = start_http_server_thread(
+                    member_core, host="127.0.0.1", port=0)
+                fleet_urls.append("127.0.0.1:%d" % runner.port)
+
+                def _stop_http(runner=runner, core=member_core):
+                    core.ready = False
+                    runner.stop()
+                    core.shutdown()
+
+                fleet_members.append((scope, runner, member_core,
+                                      _stop_http))
+        args.url = ",".join(fleet_urls)
+        print("fleet: %d embedded %s servers at %s"
+              % (args.fleet, args.protocol, args.url), file=sys.stderr)
+
+    if args.degrade_one is not None and not fleet_members:
+        print("perf failed: --degrade-one requires --fleet",
+              file=sys.stderr)
+        return 1
+
+    # -- endpoint pool: one shared pool spans every worker client -----
+    endpoint_urls = robust.EndpointPool.split_url(args.url)
+    endpoint_pool = None
+    if args.service_kind == "triton" and len(endpoint_urls) > 1:
+        endpoint_pool = robust.EndpointPool(
+            endpoint_urls,
+            breaker_factory=breaker_factory,
+            hedge_delay_min_ms=args.hedge_delay_ms,
+            hedge_max_ratio=args.hedge_max_ratio,
+        )
+    elif len(endpoint_urls) > 1:
+        print("warning: multi-endpoint -u is only supported for "
+              "--service-kind triton; using %s" % endpoint_urls[0],
+              file=sys.stderr)
+        args.url = endpoint_urls[0]
+
     if args.service_kind == "openai":
         factory = ClientBackendFactory(
             BackendKind.OPENAI, url=args.url, verbose=args.verbose,
@@ -217,6 +306,7 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
         )
         factory = ClientBackendFactory(kind, url=args.url,
                                        verbose=args.verbose,
+                                       endpoint_pool=endpoint_pool,
                                        **robustness)
 
     setup_backend = factory.create()
@@ -230,6 +320,13 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
     except InferenceServerException as e:
         print("perf failed: %s" % e, file=sys.stderr)
         setup_backend.close()
+        if endpoint_pool is not None:
+            endpoint_pool.close()
+        for _scope, _server, _core, stop_fn in fleet_members:
+            try:
+                stop_fn()
+            except Exception:
+                pass
         return 1
     # variable-dim overrides; name:DTYPE:d1,d2 CREATES the tensor for
     # metadata-less service kinds (tfserving's gRPC surface exposes no
@@ -258,7 +355,8 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
     tpu_arena_url = args.tpu_arena_url
     if (args.shared_memory == "tpu" and not tpu_arena_url
             and args.service_kind == "triton"):
-        tpu_arena_url = args.url
+        # Arena pulls are endpoint-agnostic; the primary serves them.
+        tpu_arena_url = endpoint_urls[0]
     data_manager = InferDataManager(
         model, loader, shared_memory=args.shared_memory,
         output_shm_size=args.output_shared_memory_size,
@@ -321,7 +419,8 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
         if not metrics_url:
             from urllib.parse import urlsplit
 
-            netloc = args.url if "://" in args.url else "//" + args.url
+            first_url = endpoint_urls[0]
+            netloc = first_url if "://" in first_url else "//" + first_url
             host = urlsplit(netloc).hostname or "localhost"
             if ":" in host:  # bracket bare IPv6 for the URL
                 host = "[%s]" % host
@@ -334,6 +433,15 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
                   "continuing without server metrics" % (metrics_url, e),
                   file=sys.stderr)
             metrics_manager = None
+
+    if args.degrade_one is not None:
+        from client_tpu.server.chaos import DegradeOneScenario
+
+        scenario = DegradeOneScenario(
+            scopes=[m[0] for m in fleet_members],
+            kill_fns=[m[3] for m in fleet_members],
+            **DegradeOneScenario.parse_spec(args.degrade_one),
+        ).start()
 
     mode = "concurrency"
     try:
@@ -398,8 +506,31 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
         except Exception:
             pass
         setup_backend.close()
+        if scenario is not None:
+            scenario.stop()
+        if endpoint_pool is not None:
+            endpoint_pool.close()
+        for _scope, _server, _core, stop_fn in fleet_members:
+            try:
+                stop_fn()
+            except Exception:  # already killed by the scenario
+                pass
 
     print_report(results, args.percentile, mode)
+    if endpoint_pool is not None:
+        from client_tpu.perf.report import print_failover_report
+
+        description = "%d endpoints" % len(endpoint_urls)
+        if scenario is not None:
+            events = []
+            if scenario.spiked.is_set():
+                events.append("latency spike")
+            if scenario.killed.is_set():
+                events.append("killed")
+            if events:
+                description += ", one %s" % " then ".join(events)
+        print_failover_report(results, robust.fleet_totals(),
+                              endpoint_pool.stats(), description)
     if args.chaos or retries > 0:
         from client_tpu.perf.report import print_chaos_report
 

@@ -88,12 +88,22 @@ class GrpcClientBackend(ClientBackend):
     kind = BackendKind.TRITON_GRPC
 
     def __init__(self, url: str, verbose: bool = False, retry_policy=None,
-                 circuit_breaker=None):
+                 circuit_breaker=None, endpoint_pool=None):
         import client_tpu.grpc as grpcclient
 
         self._client = grpcclient.InferenceServerClient(
             url, verbose=verbose, retry_policy=retry_policy,
-            circuit_breaker=circuit_breaker)
+            circuit_breaker=circuit_breaker, endpoint_pool=endpoint_pool)
+        # Pool mode: async_infer rides infer() on a worker pool so the
+        # full failover/hedging/retry loop applies (the raw gRPC
+        # future API routes to ONE endpoint and cannot fail over —
+        # in-flight requests at an endpoint kill would surface as
+        # client-visible errors instead of being masked).
+        self._executor = None
+        if endpoint_pool is not None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._executor = ThreadPoolExecutor(max_workers=32)
 
     def server_metadata(self):
         return self._client.get_server_metadata(as_json=True)
@@ -120,6 +130,18 @@ class GrpcClientBackend(ClientBackend):
 
     def async_infer(self, callback, model_name, inputs, outputs=None,
                     **kwargs):
+        if self._executor is not None:
+            def _work():
+                try:
+                    callback(self._client.infer(model_name, inputs,
+                                                outputs=outputs, **kwargs),
+                             None)
+                except InferenceServerException as e:
+                    callback(None, e)
+                except Exception as e:  # noqa: BLE001 — to the callback
+                    callback(None, InferenceServerException(str(e)))
+
+            return self._executor.submit(_work)
         return self._client.async_infer(model_name, inputs, callback,
                                         outputs=outputs, **kwargs)
 
@@ -149,6 +171,8 @@ class GrpcClientBackend(ClientBackend):
         self._client.unregister_tpu_shared_memory(name)
 
     def close(self):
+        if self._executor is not None:
+            self._executor.shutdown(wait=False)
         self._client.close()
 
 
@@ -156,12 +180,14 @@ class HttpClientBackend(ClientBackend):
     kind = BackendKind.TRITON_HTTP
 
     def __init__(self, url: str, verbose: bool = False, concurrency: int = 8,
-                 retry_policy=None, circuit_breaker=None):
+                 retry_policy=None, circuit_breaker=None,
+                 endpoint_pool=None):
         import client_tpu.http as httpclient
 
         self._client = httpclient.InferenceServerClient(
             url, verbose=verbose, concurrency=concurrency,
             retry_policy=retry_policy, circuit_breaker=circuit_breaker,
+            endpoint_pool=endpoint_pool,
         )
 
     def server_metadata(self):
@@ -1074,7 +1100,7 @@ class ClientBackendFactory:
                  mock_delay_s: float = 0.0, mock_stats=None,
                  openai_endpoint: str = "/v1/chat/completions",
                  tfserving_grpc: bool = True, retry_policy=None,
-                 breaker_factory=None):
+                 breaker_factory=None, endpoint_pool=None):
         self.kind = kind
         self._url = url
         self._core = core
@@ -1091,6 +1117,11 @@ class ClientBackendFactory:
         # worker tripping open doesn't blind the others' measurements.
         self._retry_policy = retry_policy
         self._breaker_factory = breaker_factory
+        # Multi-endpoint runs share ONE EndpointPool across every
+        # worker's client: fleet health (breakers, EWMA, ejections) is
+        # a property of the fleet, not of one worker, and the pooled
+        # counters then cover the whole run for the failover report.
+        self.endpoint_pool = endpoint_pool
 
     def _breaker(self):
         return self._breaker_factory() if self._breaker_factory else None
@@ -1099,12 +1130,14 @@ class ClientBackendFactory:
         if self.kind == BackendKind.TRITON_GRPC:
             return GrpcClientBackend(self._url, self._verbose,
                                      retry_policy=self._retry_policy,
-                                     circuit_breaker=self._breaker())
+                                     circuit_breaker=self._breaker(),
+                                     endpoint_pool=self.endpoint_pool)
         if self.kind == BackendKind.TRITON_HTTP:
             return HttpClientBackend(self._url, self._verbose,
                                      self._http_concurrency,
                                      retry_policy=self._retry_policy,
-                                     circuit_breaker=self._breaker())
+                                     circuit_breaker=self._breaker(),
+                                     endpoint_pool=self.endpoint_pool)
         if self.kind == BackendKind.OPENAI:
             return OpenAiClientBackend(self._url, self._openai_endpoint,
                                        self._verbose)

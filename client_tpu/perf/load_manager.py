@@ -531,8 +531,18 @@ class ConcurrencyManager(LoadManager):
                 break
             inputs, outputs, kwargs = self._make_request(holders[ctx_id])
             record = RequestRecord(time.monotonic_ns())
-            backend.async_infer(_done(record, ctx_id), self._model.name,
-                                inputs, outputs=outputs, **kwargs)
+            try:
+                backend.async_infer(_done(record, ctx_id), self._model.name,
+                                    inputs, outputs=outputs, **kwargs)
+            except InferenceServerException as e:
+                # Submission itself was shed (e.g. every endpoint in
+                # the pool ejected): that is ONE failed request, not a
+                # dead worker — record it and keep measuring, exactly
+                # what a resilience run wants to observe.
+                record.end_ns.append(time.monotonic_ns())
+                record.error = e
+                stat.add_record(record)
+                tracker.release(ctx_id)
         # drain: wait briefly for in-flight requests
         deadline = time.monotonic() + 5
         acquired = 0
@@ -713,8 +723,16 @@ class RequestRateManager(LoadManager):
                 outputs = self._data_manager.build_outputs()
                 record = RequestRecord(time.monotonic_ns(), delayed=delayed)
                 if self._async:
-                    backend.async_infer(_done(record), self._model.name,
-                                        inputs, outputs=outputs, **kwargs)
+                    try:
+                        backend.async_infer(_done(record), self._model.name,
+                                            inputs, outputs=outputs,
+                                            **kwargs)
+                    except InferenceServerException as e:
+                        # Shed at submission (pool fully ejected): one
+                        # failed request, not a dead worker.
+                        record.end_ns.append(time.monotonic_ns())
+                        record.error = e
+                        stat.add_record(record)
                 else:
                     try:
                         backend.infer(self._model.name, inputs,

@@ -120,6 +120,47 @@ def print_chaos_report(results: List[PerfStatus], retry_count: int,
                   % (recovered, faults, recovered / faults * 100.0, seen))
 
 
+def print_failover_report(results: List[PerfStatus],
+                          fleet_totals: dict,
+                          pool_stats: Optional[dict] = None,
+                          description: str = "") -> None:
+    """The multi-endpoint summary: goodput across the fleet,
+    client-visible errors (the zero that proves failover masked an
+    outage), hedge volume vs budget, and per-endpoint health at the
+    end of the run. ``fleet_totals`` is robust.fleet_totals()
+    (process-lifetime, like the retry counters); ``pool_stats`` is the
+    shared pool's stats() snapshot when one pool spanned the run."""
+    print("Failover summary (%s):" % (description or "endpoint pool"))
+    total_completed = sum(s.completed_count for s in results)
+    total_errors = sum(s.error_count for s in results)
+    attempted = total_completed + total_errors
+    goodput_pct = (total_completed / attempted * 100.0) if attempted else 0.0
+    print("    client-visible errors: %d of %d requests "
+          "(goodput %.1f%%)" % (total_errors, attempted, goodput_pct))
+    requests = pool_stats.get("requests", attempted) if pool_stats \
+        else attempted
+    hedge_ratio = (fleet_totals.get("hedges_fired", 0) / requests * 100.0
+                   if requests else 0.0)
+    print("    failovers: %d, hedges fired: %d (%.2f%% of requests), "
+          "hedges won: %d"
+          % (fleet_totals.get("failovers", 0),
+             fleet_totals.get("hedges_fired", 0), hedge_ratio,
+             fleet_totals.get("hedges_won", 0)))
+    print("    ejections: %d, readmissions: %d"
+          % (fleet_totals.get("ejections", 0),
+             fleet_totals.get("readmissions", 0)))
+    if pool_stats:
+        if pool_stats.get("hedge_delay_ms") is not None:
+            print("    hedge delay: %.1f ms (observed latency "
+                  "quantile)" % pool_stats["hedge_delay_ms"])
+        for endpoint in pool_stats.get("endpoints", ()):
+            print("    endpoint %s: %s, %d requests, %d failures, "
+                  "ewma latency %.1f ms"
+                  % (endpoint["url"], endpoint["state"],
+                     endpoint["requests"], endpoint["failures"],
+                     endpoint["ewma_latency_ms"]))
+
+
 def write_csv(path: str, results: List[PerfStatus],
               mode: str = "concurrency") -> None:
     with open(path, "w", newline="") as f:

@@ -25,14 +25,25 @@ Design notes
   admits exactly one probe whose outcome decides closed vs open again.
   While open, calls fail fast with ``UNAVAILABLE`` — no network I/O —
   which is what sheds load from a struggling server.
+* :class:`EndpointPool` lifts all of the above from one connection to a
+  replica fleet: one breaker + EWMA latency per endpoint (passive
+  health), least-outstanding routing with a latency tiebreak, sticky
+  routing by ``sequence_id``, an optional background prober that
+  readmits ejected endpoints (active health), and budgeted request
+  hedging per "The Tail at Scale" (Dean & Barroso, 2013).
+  :func:`call_with_retry_pool` / :func:`call_with_retry_pool_async` are
+  the pool-aware twins of the single-endpoint executors: a retryable
+  failure fails over to the next healthy endpoint inside the same
+  shrinking ``client_timeout`` budget.
 """
 
 from __future__ import annotations
 
+import queue as _queue
 import random
 import threading
 import time
-from typing import Callable, Optional
+from typing import Callable, Dict, List, Optional, Sequence
 
 from client_tpu.utils import InferenceServerException
 
@@ -42,6 +53,14 @@ from client_tpu.utils import InferenceServerException
 # out once will usually time out again and retrying it doubles load at
 # exactly the moment the server is slowest.
 DEFAULT_RETRYABLE_STATUSES = ("UNAVAILABLE", "503")
+
+# Statuses that justify FAILOVER to a different endpoint even though
+# they are not retryable against the same one: a server cancelling
+# in-flight work (shutdown grace expiring) says this replica is going
+# away, not that the request was bad. Caller-side cancellation never
+# takes this shape — it surfaces as CancelledError/FutureCancelledError
+# (BaseExceptions), not a status-CANCELLED server exception.
+POOL_FAILOVER_STATUSES = frozenset({"CANCELLED"})
 
 # Definitive client errors: the server answered, decisively — proof
 # the endpoint is healthy. These feed the circuit breaker as
@@ -227,6 +246,14 @@ class CircuitBreaker:
 _retry_lock = threading.Lock()
 _retry_total = 0
 _exhausted_total = 0
+# Fleet accounting (EndpointPool): summed across every pool in the
+# process so the perf harness's failover report spans all workers,
+# exactly like the retry counters above.
+_failover_total = 0
+_hedge_fired_total = 0
+_hedge_won_total = 0
+_ejection_total = 0
+_readmission_total = 0
 
 
 def note_retries(count: int = 1) -> None:
@@ -251,11 +278,46 @@ def exhausted_total() -> int:
         return _exhausted_total
 
 
+def _note_fleet(counter: str) -> None:
+    global _failover_total, _hedge_fired_total, _hedge_won_total, \
+        _ejection_total, _readmission_total
+    with _retry_lock:
+        if counter == "failover":
+            _failover_total += 1
+        elif counter == "hedge_fired":
+            _hedge_fired_total += 1
+        elif counter == "hedge_won":
+            _hedge_won_total += 1
+        elif counter == "ejection":
+            _ejection_total += 1
+        elif counter == "readmission":
+            _readmission_total += 1
+
+
+def fleet_totals() -> dict:
+    """Process-lifetime EndpointPool counters (all pools summed)."""
+    with _retry_lock:
+        return {
+            "failovers": _failover_total,
+            "hedges_fired": _hedge_fired_total,
+            "hedges_won": _hedge_won_total,
+            "ejections": _ejection_total,
+            "readmissions": _readmission_total,
+        }
+
+
 def reset_retry_total() -> None:
-    global _retry_total, _exhausted_total
+    global _retry_total, _exhausted_total, _failover_total, \
+        _hedge_fired_total, _hedge_won_total, _ejection_total, \
+        _readmission_total
     with _retry_lock:
         _retry_total = 0
         _exhausted_total = 0
+        _failover_total = 0
+        _hedge_fired_total = 0
+        _hedge_won_total = 0
+        _ejection_total = 0
+        _readmission_total = 0
 
 
 def _note_if_exhausted(policy: Optional[RetryPolicy],
@@ -266,6 +328,20 @@ def _note_if_exhausted(policy: Optional[RetryPolicy],
                 else frozenset(DEFAULT_RETRYABLE_STATUSES))
     if (error.status() or "") in statuses:
         note_exhausted()
+
+
+def retry_after_of(error: BaseException) -> Optional[float]:
+    """Server-advised retry delay riding on the error (the HTTP
+    ``Retry-After`` header / the gRPC ``retry-after`` trailing-metadata
+    hint), seconds; None when the server sent none."""
+    value = getattr(error, "retry_after_s", None)
+    if value is None:
+        return None
+    try:
+        value = float(value)
+    except (TypeError, ValueError):
+        return None
+    return value if value > 0 else None
 
 
 def _next_delay(policy: RetryPolicy, error: InferenceServerException,
@@ -279,6 +355,12 @@ def _next_delay(policy: RetryPolicy, error: InferenceServerException,
     if attempt >= policy.max_attempts - 1:
         return None
     delay = policy.backoff_s(attempt)
+    retry_after = retry_after_of(error)
+    if retry_after is not None:
+        # The server knows its queue better than our jitter does:
+        # sleep at least as long as it asked, still capped by the
+        # policy ceiling so a hostile header can't park the client.
+        delay = min(max(delay, retry_after), policy.max_backoff_s)
     if deadline_s is not None and elapsed_s + delay >= deadline_s:
         return None
     return delay
@@ -404,4 +486,778 @@ async def call_with_retry_async(
             raise
         if breaker is not None:
             breaker.record_success()
+        return result
+
+
+# -- endpoint pool: health-aware multi-endpoint routing + hedging ----------
+
+
+class EndpointState:
+    """Per-endpoint health + load record owned by an EndpointPool.
+
+    Mutable fields are guarded by the POOL's lock (routing reads the
+    whole fleet atomically); the breaker has its own lock and is safe
+    to touch directly.
+    """
+
+    def __init__(self, url: str, breaker: CircuitBreaker):
+        self.url = url
+        self.breaker = breaker
+        self.outstanding = 0       # requests currently in flight
+        self.ewma_latency_s = 0.0  # 0 until the first sample
+        self.requests = 0
+        self.failures = 0
+        # Last breaker state the pool observed — the edge detector for
+        # the ejection/readmission counters (the breaker itself has no
+        # transition hooks).
+        self.last_state = CircuitBreaker.CLOSED
+
+
+class EndpointPool:
+    """A fleet of interchangeable server endpoints with passive and
+    active health tracking, least-outstanding routing, sticky sequence
+    routing, and budgeted request hedging.
+
+    * **Passive health**: every call settles the endpoint's
+      :class:`CircuitBreaker` (ejection = breaker open) and, on
+      success, its EWMA latency. Definitive client errors count as
+      health, exactly like the single-endpoint executors.
+    * **Active health**: :meth:`ensure_prober` runs a background
+      thread that half-open-probes ejected endpoints with a bounded
+      health check and readmits them on recovery — so a replica that
+      comes back is found by the prober, not by sacrificial traffic.
+    * **Routing**: least expected completion time —
+      ``(outstanding + 1) * EWMA latency`` — so a latency-degraded
+      replica sheds traffic long before it fails anything, with a
+      small uniform exploration ratio (``explore_ratio``) so a
+      recovered replica's latency estimate refreshes instead of
+      freezing at its worst. ``sequence_id`` pins correlated streams
+      to one endpoint until it is ejected (implicit server-side state
+      is endpoint-local).
+    * **Hedging**: after ``hedge_delay_s()`` (the pool's observed
+      latency quantile, floored at ``hedge_delay_min_ms``) the
+      executors may fire the same idempotent request at a second
+      endpoint; first success wins. ``hedge_max_ratio`` budgets hedges
+      against total requests so a brown-out cannot double fleet load.
+
+    One pool may be shared by many clients (the perf harness shares a
+    pool across worker clients so the fleet-health view and the
+    counters span the whole run); transports stay per-client.
+    """
+
+    def __init__(self, urls, breaker_factory: Optional[Callable[[], CircuitBreaker]] = None,
+                 hedge_delay_min_ms: float = 1.0,
+                 hedge_quantile: float = 0.95,
+                 hedge_max_ratio: float = 0.05,
+                 probe_interval_s: float = 1.0,
+                 probe_timeout_s: float = 1.0,
+                 latency_window: int = 512,
+                 explore_ratio: float = 0.02,
+                 hedge_workers: int = 32,
+                 rng: Optional[random.Random] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        urls = self.split_url(urls)
+        if not urls:
+            raise ValueError("EndpointPool needs at least one url")
+        if len(set(urls)) != len(urls):
+            raise ValueError("EndpointPool urls must be distinct: %r" % urls)
+        factory = breaker_factory or CircuitBreaker
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.endpoints: Dict[str, EndpointState] = {
+            url: EndpointState(url, factory()) for url in urls
+        }
+        self.hedge_delay_min_ms = float(hedge_delay_min_ms)
+        self.hedge_quantile = min(max(float(hedge_quantile), 0.0), 1.0)
+        self.hedge_max_ratio = max(float(hedge_max_ratio), 0.0)
+        self.probe_interval_s = float(probe_interval_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.explore_ratio = min(max(float(explore_ratio), 0.0), 1.0)
+        self._rng = rng if rng is not None else random.Random()
+        self._latencies: List[float] = []  # ring buffer of success samples
+        self._latency_window = max(int(latency_window), 16)
+        self._latency_idx = 0
+        self._sticky: Dict[int, str] = {}
+        # counters (also mirrored into the process-wide fleet totals)
+        self.requests_total = 0
+        self.hedges_fired = 0
+        self.hedges_won = 0
+        self.hedges_discarded = 0
+        self.failovers = 0
+        self.ejections = 0
+        self.readmissions = 0
+        self.probes = 0
+        self._prober_thread: Optional[threading.Thread] = None
+        self._prober_stop = threading.Event()
+        # Worker pool for the SYNC hedged path: reused threads (no
+        # per-call thread churn), bounded by a semaphore so saturation
+        # degrades to inline unhedged attempts instead of queueing
+        # primaries behind each other.
+        self._worker_count = max(int(hedge_workers), 2)
+        self._worker_slots = threading.BoundedSemaphore(self._worker_count)
+        self._workers = None
+
+    def _acquire_worker(self):
+        """Non-blocking worker-slot acquire; returns the executor or
+        None when every slot is busy (caller degrades to inline)."""
+        if not self._worker_slots.acquire(blocking=False):
+            return None
+        with self._lock:
+            if self._workers is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._workers = ThreadPoolExecutor(
+                    max_workers=self._worker_count,
+                    thread_name_prefix="endpoint-pool-hedge")
+            return self._workers
+
+    def _release_worker(self) -> None:
+        self._worker_slots.release()
+
+    # -- construction helpers -------------------------------------------
+
+    @staticmethod
+    def split_url(url) -> List[str]:
+        """Accepts ``"a:1,b:1"``, ``["a:1", "b:1"]``, or a single url;
+        returns the cleaned endpoint list."""
+        if isinstance(url, str):
+            parts = [u.strip() for u in url.split(",")]
+        elif isinstance(url, Sequence):
+            parts = [str(u).strip() for u in url]
+        else:
+            parts = [str(url).strip()]
+        return [u for u in parts if u]
+
+    def __len__(self) -> int:
+        return len(self.endpoints)
+
+    @property
+    def urls(self) -> List[str]:
+        return list(self.endpoints)
+
+    # -- routing ---------------------------------------------------------
+
+    def _admitting(self, exclude) -> List[EndpointState]:
+        return [s for s in self.endpoints.values()
+                if s.url not in exclude and s.breaker.admits()]
+
+    @staticmethod
+    def _score(state: EndpointState) -> float:
+        """Expected completion time: queue depth x per-request latency.
+        A replica 30x slower sheds traffic even while idle, instead of
+        looking attractive every time it drains its one request."""
+        return (state.outstanding + 1) * max(state.ewma_latency_s, 1e-6)
+
+    def pick(self, exclude=(), sequence_id: int = 0) -> EndpointState:
+        """Choose the endpoint for one attempt: sticky by sequence_id
+        while the pinned endpoint stays healthy, else minimum expected
+        completion time (with a small uniform exploration draw that
+        keeps every endpoint's latency estimate fresh). Raises
+        UNAVAILABLE when no endpoint admits a call (every breaker
+        open)."""
+        exclude = set(exclude)
+        with self._lock:
+            if sequence_id:
+                pinned = self._sticky.get(sequence_id)
+                if pinned is not None and pinned not in exclude:
+                    state = self.endpoints.get(pinned)
+                    if state is not None and state.breaker.admits():
+                        return state
+            candidates = self._admitting(exclude)
+            if not candidates:
+                raise InferenceServerException(
+                    "no healthy endpoint in pool (%d of %d ejected%s)"
+                    % (sum(1 for s in self.endpoints.values()
+                           if s.breaker.state != CircuitBreaker.CLOSED),
+                       len(self.endpoints),
+                       ", %d excluded" % len(exclude) if exclude else ""),
+                    status="UNAVAILABLE")
+            if len(candidates) > 1 and not sequence_id \
+                    and self._rng.random() < self.explore_ratio:
+                state = self._rng.choice(candidates)
+            else:
+                state = min(candidates, key=self._score)
+            if sequence_id:
+                previous = self._sticky.get(sequence_id)
+                self._sticky[sequence_id] = state.url
+                if previous is not None and previous != state.url \
+                        and previous not in exclude:
+                    # the pinned endpoint was ejected mid-sequence: the
+                    # re-pin IS a failover even before any attempt
+                    # runs. (When the caller EXCLUDED the pin — the
+                    # retry loop failing over after an attempt — that
+                    # loop already counted it; counting here too would
+                    # double-book one event.)
+                    self.failovers += 1
+                    _note_fleet("failover")
+            return state
+
+    def has_alternative(self, exclude=()) -> bool:
+        with self._lock:
+            return bool(self._admitting(set(exclude)))
+
+    def release_sequence(self, sequence_id: int) -> None:
+        with self._lock:
+            self._sticky.pop(sequence_id, None)
+
+    # -- passive health bookkeeping -------------------------------------
+
+    def _check_transition(self, state: EndpointState) -> None:
+        """Edge-detect breaker transitions (caller holds the lock)."""
+        now = state.breaker.state
+        if now == state.last_state:
+            return
+        if now == CircuitBreaker.OPEN \
+                and state.last_state != CircuitBreaker.OPEN:
+            self.ejections += 1
+            _note_fleet("ejection")
+        elif now == CircuitBreaker.CLOSED \
+                and state.last_state == CircuitBreaker.OPEN:
+            self.readmissions += 1
+            _note_fleet("readmission")
+        elif now == CircuitBreaker.CLOSED \
+                and state.last_state == CircuitBreaker.HALF_OPEN:
+            self.readmissions += 1
+            _note_fleet("readmission")
+        state.last_state = now
+
+    def note_start(self, state: EndpointState) -> None:
+        with self._lock:
+            state.outstanding += 1
+            state.requests += 1
+
+    def note_end(self, state: EndpointState, latency_s: float,
+                 error: Optional[BaseException] = None,
+                 sample: bool = True) -> None:
+        """``sample=False`` keeps the latency out of the hedge-delay
+        quantile window while still updating the endpoint's EWMA: a
+        hedge LOSER's latency is real evidence about its endpoint, but
+        the caller never waited for it — letting losers into the window
+        would drag the hedge delay toward exactly the slow latencies
+        hedging is meant to cut."""
+        if error is None:
+            state.breaker.record_success()
+        else:
+            _breaker_resolve(state.breaker, error)
+        with self._lock:
+            state.outstanding = max(state.outstanding - 1, 0)
+            if error is None:
+                state.ewma_latency_s = (
+                    latency_s if state.ewma_latency_s == 0.0
+                    else 0.2 * latency_s + 0.8 * state.ewma_latency_s)
+                if sample:
+                    if len(self._latencies) < self._latency_window:
+                        self._latencies.append(latency_s)
+                    else:
+                        self._latencies[self._latency_idx] = latency_s
+                        self._latency_idx = \
+                            (self._latency_idx + 1) % self._latency_window
+            else:
+                state.failures += 1
+            self._check_transition(state)
+
+    def note_request(self) -> None:
+        with self._lock:
+            self.requests_total += 1
+
+    def note_failover(self) -> None:
+        with self._lock:
+            self.failovers += 1
+        _note_fleet("failover")
+
+    def note_hedge_won(self) -> None:
+        with self._lock:
+            self.hedges_won += 1
+        _note_fleet("hedge_won")
+
+    def note_hedge_discarded(self) -> None:
+        with self._lock:
+            self.hedges_discarded += 1
+
+    # -- hedging ---------------------------------------------------------
+
+    def hedge_delay_s(self) -> float:
+        """Delay before firing a hedge: the configured quantile of
+        observed latencies, floored at ``hedge_delay_min_ms`` (and a
+        10ms default while the sample window is still cold)."""
+        floor = self.hedge_delay_min_ms / 1000.0
+        with self._lock:
+            samples = sorted(self._latencies)
+        if len(samples) < 8:
+            return max(floor, 0.01)
+        idx = min(int(self.hedge_quantile * len(samples)),
+                  len(samples) - 1)
+        return max(floor, samples[idx])
+
+    def try_acquire_hedge(self, exclude=()) -> Optional[EndpointState]:
+        """Budget gate + routing for one hedge: returns the endpoint to
+        hedge on (debiting the budget), or None when the budget is
+        spent or no distinct healthy endpoint exists."""
+        exclude = set(exclude)
+        with self._lock:
+            if self.hedge_max_ratio <= 0:
+                return None
+            if (self.hedges_fired + 1) > \
+                    self.hedge_max_ratio * max(self.requests_total, 1):
+                return None
+            candidates = self._admitting(exclude)
+            if not candidates:
+                return None
+            # No exploration for hedges: the hedge exists to BEAT the
+            # slow attempt, so it always takes the best endpoint.
+            state = min(candidates, key=self._score)
+            self.hedges_fired += 1
+        _note_fleet("hedge_fired")
+        return state
+
+    # -- active probing ---------------------------------------------------
+
+    def ensure_prober(self, probe_fn: Callable[[str], bool]) -> None:
+        """Start the background prober (idempotent). ``probe_fn(url)``
+        must be a BOUNDED health check returning truthy on a live+ready
+        endpoint; exceptions count as failure. The prober only touches
+        endpoints whose breaker is not closed, using the breaker's own
+        half-open probe slot, so it never races traffic into a double
+        probe and never adds load to healthy replicas."""
+        with self._lock:
+            if self._prober_thread is not None \
+                    and self._prober_thread.is_alive():
+                return
+            self._prober_stop.clear()
+            self._prober_thread = threading.Thread(
+                target=self._probe_loop, args=(probe_fn,), daemon=True,
+                name="endpoint-pool-prober")
+            self._prober_thread.start()
+
+    def _probe_loop(self, probe_fn: Callable[[str], bool]) -> None:
+        while not self._prober_stop.wait(self.probe_interval_s):
+            for state in list(self.endpoints.values()):
+                if self._prober_stop.is_set():
+                    return
+                breaker = state.breaker
+                if breaker.state == CircuitBreaker.CLOSED \
+                        or not breaker.admits():
+                    continue
+                try:
+                    breaker.before_call()
+                except InferenceServerException:
+                    continue  # raced a traffic probe into the slot
+                with self._lock:
+                    self.probes += 1
+                try:
+                    ok = bool(probe_fn(state.url))
+                except Exception:
+                    ok = False
+                if ok:
+                    breaker.record_success()
+                else:
+                    breaker.record_failure()
+                with self._lock:
+                    self._check_transition(state)
+
+    def stop_prober(self) -> None:
+        with self._lock:
+            thread, self._prober_thread = self._prober_thread, None
+        self._prober_stop.set()
+        if thread is not None:
+            thread.join(timeout=5)
+
+    def close(self) -> None:
+        self.stop_prober()
+        with self._lock:
+            workers, self._workers = self._workers, None
+        if workers is not None:
+            workers.shutdown(wait=False)
+
+    # -- observability ----------------------------------------------------
+
+    def stats(self) -> dict:
+        """Snapshot of fleet health + the hedging/failover counters."""
+        hedge_delay_ms = round(self.hedge_delay_s() * 1000.0, 3)
+        with self._lock:
+            endpoints = [
+                {
+                    "url": s.url,
+                    "state": s.breaker.state,
+                    "outstanding": s.outstanding,
+                    "ewma_latency_ms": round(s.ewma_latency_s * 1000.0, 3),
+                    "requests": s.requests,
+                    "failures": s.failures,
+                }
+                for s in self.endpoints.values()
+            ]
+            return {
+                "endpoints": endpoints,
+                "requests": self.requests_total,
+                "hedges_fired": self.hedges_fired,
+                "hedges_won": self.hedges_won,
+                "hedges_discarded": self.hedges_discarded,
+                "failovers": self.failovers,
+                "ejections": self.ejections,
+                "readmissions": self.readmissions,
+                "probes": self.probes,
+                "hedge_delay_ms": hedge_delay_ms,
+            }
+
+
+# -- pool-aware executors --------------------------------------------------
+
+
+def _pool_attempt(pool: EndpointPool, state: EndpointState, fn,
+                  remaining: Optional[float], clock, sample_fn=None):
+    """One attempt against one endpoint with full breaker + latency
+    bookkeeping. ``fn(endpoint_state, remaining_timeout_s)``;
+    ``sample_fn`` decides at completion time whether the latency enters
+    the hedge-delay window (hedge losers don't)."""
+    state.breaker.before_call()
+    pool.note_start(state)
+    t0 = clock()
+    try:
+        result = fn(state, remaining)
+    except BaseException as e:
+        pool.note_end(state, clock() - t0, error=e)
+        raise
+    pool.note_end(state, clock() - t0,
+                  sample=sample_fn() if sample_fn is not None else True)
+    return result
+
+
+def _remaining_of(deadline_s, start, clock):
+    if deadline_s is None:
+        return None
+    remaining = deadline_s - (clock() - start)
+    if remaining <= 0:
+        raise InferenceServerException(
+            "deadline of %.3fs exhausted" % deadline_s,
+            status="DEADLINE_EXCEEDED")
+    return remaining
+
+
+def _hedged_call(pool: EndpointPool, fn, primary: EndpointState,
+                 deadline_s: Optional[float], start: float, clock,
+                 hedge: bool):
+    """Run one logical attempt, optionally hedged: the primary runs on
+    a worker thread; if it hasn't answered within the pool's hedge
+    delay and the budget admits, the same request fires at a second
+    endpoint and the first SUCCESS wins (the loser's response is
+    discarded and counted). Falls back to a plain inline attempt when
+    hedging can't apply."""
+    workers = None
+    if hedge and pool.hedge_max_ratio > 0 and len(pool) >= 2:
+        # Reused worker threads, bounded: when every slot is busy the
+        # call degrades to a plain inline attempt (hedging is
+        # opportunistic — queueing primaries behind each other to
+        # preserve it would invert the latency win).
+        workers = pool._acquire_worker()
+    if workers is None:
+        return _pool_attempt(pool, primary, fn,
+                             _remaining_of(deadline_s, start, clock), clock)
+
+    outcomes: "_queue.Queue" = _queue.Queue()
+    settled = threading.Event()  # a winner already returned
+
+    def run(state: EndpointState) -> None:
+        try:
+            try:
+                remaining = _remaining_of(deadline_s, start, clock)
+                result = _pool_attempt(
+                    pool, state, fn, remaining, clock,
+                    sample_fn=lambda: not settled.is_set())
+            except BaseException as e:  # noqa: BLE001 — via the queue
+                outcomes.put((state, None, e))
+                return
+            if settled.is_set():
+                pool.note_hedge_discarded()
+            outcomes.put((state, result, None))
+        finally:
+            pool._release_worker()
+
+    workers.submit(run, primary)
+    launched = [primary]
+    first = None
+    try:
+        first = outcomes.get(timeout=pool.hedge_delay_s())
+    except _queue.Empty:
+        hedge_state = None
+        hedge_workers = pool._acquire_worker()
+        if hedge_workers is not None:
+            hedge_state = pool.try_acquire_hedge(exclude={primary.url})
+            if hedge_state is None:
+                pool._release_worker()
+        if hedge_state is not None:
+            hedge_workers.submit(run, hedge_state)
+            launched.append(hedge_state)
+
+    errors = []
+    pending = len(launched) - (1 if first is not None else 0)
+    item = first
+    while True:
+        if item is None:
+            # Bounded wait: each attempt already carries the shrinking
+            # transport budget, the slack only covers scheduling.
+            timeout = None
+            if deadline_s is not None:
+                timeout = max(deadline_s - (clock() - start), 0.0) + 0.25
+            try:
+                item = outcomes.get(timeout=timeout)
+            except _queue.Empty:
+                raise InferenceServerException(
+                    "deadline of %.3fs exhausted waiting for hedged "
+                    "attempts" % deadline_s, status="DEADLINE_EXCEEDED")
+            pending -= 1
+        state, result, error = item
+        item = None
+        if error is None:
+            settled.set()
+            if len(launched) > 1 and state is launched[1]:
+                pool.note_hedge_won()
+            return result
+        errors.append((state, error))
+        if pending <= 0:
+            break
+    # every launched attempt failed: surface the primary's error (the
+    # hedge was opportunistic; its failure is secondary evidence)
+    for state, error in errors:
+        if state is primary:
+            raise error
+    raise errors[0][1]
+
+
+def call_with_retry_pool(
+    fn,
+    pool: EndpointPool,
+    policy: Optional[RetryPolicy] = None,
+    deadline_s: Optional[float] = None,
+    sequence_id: int = 0,
+    sequence_end: bool = False,
+    hedge: bool = True,
+    sleep: Callable[[float], None] = time.sleep,
+    clock: Callable[[], float] = time.monotonic,
+):
+    """Pool-aware twin of :func:`call_with_retry`.
+
+    ``fn(endpoint_state, remaining_timeout_s)`` runs one attempt
+    against one endpoint. A retryable failure fails over to the next
+    healthy endpoint immediately (no backoff — a different replica is
+    not the one that just failed); when every endpoint has been tried
+    the backoff applies before the fleet is retried from scratch.
+    Without a policy the budget is one attempt per endpoint (pure
+    failover). Sequence-correlated requests (``sequence_id``) are
+    sticky-routed and never hedged; ``sequence_end`` releases the pin.
+    """
+    start = clock()
+    attempt = 0
+    tried: set = set()
+    pool.note_request()
+    max_attempts = policy.max_attempts if policy is not None \
+        else max(len(pool), 1)
+    retryable_statuses = (policy.retryable_statuses if policy is not None
+                          else frozenset(DEFAULT_RETRYABLE_STATUSES))
+    while True:
+        remaining = deadline_s
+        if deadline_s is not None:
+            remaining = deadline_s - (clock() - start)
+            if remaining <= 0:
+                raise InferenceServerException(
+                    "deadline of %.3fs exhausted after %d attempt(s)"
+                    % (deadline_s, attempt), status="DEADLINE_EXCEEDED")
+        try:
+            state = pool.pick(exclude=tried, sequence_id=sequence_id)
+        except InferenceServerException as e:
+            if tried:
+                tried = set()  # whole fleet tried: widen back out
+                try:
+                    state = pool.pick(sequence_id=sequence_id)
+                except InferenceServerException as e2:
+                    _note_if_exhausted(policy, e2)
+                    raise
+            else:
+                _note_if_exhausted(policy, e)
+                raise
+        try:
+            result = _hedged_call(pool, fn, state, deadline_s, start,
+                                  clock, hedge and not sequence_id)
+        except InferenceServerException as e:
+            status = e.status() or ""
+            retryable = (policy.is_retryable(e) if policy is not None
+                         else status in retryable_statuses)
+            # Endpoint-level failures (see POOL_FAILOVER_STATUSES) are
+            # failover-eligible even when not same-endpoint-retryable.
+            retryable = retryable or status in POOL_FAILOVER_STATUSES
+            if not retryable or attempt >= max_attempts - 1:
+                if sequence_id and sequence_end:
+                    # the sequence is over even on failure: a leaked
+                    # pin would grow _sticky forever and stale-route a
+                    # reused sequence_id
+                    pool.release_sequence(sequence_id)
+                _note_if_exhausted(policy, e)
+                raise
+            tried.add(state.url)
+            if pool.has_alternative(exclude=tried):
+                # Immediate failover: a healthy replica exists, so
+                # sleeping first would only stretch the tail.
+                pool.note_failover()
+                note_retries()
+                attempt += 1
+                continue
+            delay = None if policy is None else _next_delay(
+                policy, e, attempt, deadline_s, clock() - start)
+            if delay is None:
+                _note_if_exhausted(policy, e)
+                raise
+            note_retries()
+            sleep(delay)
+            tried = set()
+            attempt += 1
+            continue
+        if sequence_id and sequence_end:
+            pool.release_sequence(sequence_id)
+        return result
+
+
+async def _pool_attempt_async(pool: EndpointPool, state: EndpointState,
+                              fn, remaining: Optional[float], clock):
+    state.breaker.before_call()
+    pool.note_start(state)
+    t0 = clock()
+    try:
+        result = await fn(state, remaining)
+    except BaseException as e:
+        pool.note_end(state, clock() - t0, error=e)
+        raise
+    pool.note_end(state, clock() - t0)
+    return result
+
+
+async def _hedged_call_async(pool: EndpointPool, fn,
+                             primary: EndpointState,
+                             deadline_s: Optional[float], start: float,
+                             clock, hedge: bool):
+    import asyncio
+
+    if not hedge or pool.hedge_max_ratio <= 0 or len(pool) < 2:
+        return await _pool_attempt_async(
+            pool, primary, fn, _remaining_of(deadline_s, start, clock),
+            clock)
+
+    def spawn(state):
+        async def attempt():
+            remaining = _remaining_of(deadline_s, start, clock)
+            return await _pool_attempt_async(pool, state, fn, remaining,
+                                             clock)
+        return asyncio.ensure_future(attempt())
+
+    primary_task = spawn(primary)
+    done, _ = await asyncio.wait({primary_task},
+                                 timeout=pool.hedge_delay_s())
+    tasks = {primary_task: primary}
+    if not done:
+        hedge_state = pool.try_acquire_hedge(exclude={primary.url})
+        if hedge_state is not None:
+            tasks[spawn(hedge_state)] = hedge_state
+    errors = []
+    pending = set(tasks)
+    while pending:
+        done, pending = await asyncio.wait(
+            pending, return_when=asyncio.FIRST_COMPLETED)
+        for task in done:
+            error = task.exception()
+            if error is None:
+                # winner: cancel the loser (its cancellation settles
+                # the breaker neutrally via abort_probe)
+                for loser in pending:
+                    loser.cancel()
+                for loser in pending:
+                    try:
+                        await loser
+                    except BaseException:  # noqa: BLE001 — discarded
+                        pass
+                if task is not primary_task:
+                    pool.note_hedge_won()
+                return task.result()
+            errors.append((tasks[task], error))
+    for state, error in errors:
+        if state is primary:
+            raise error
+    raise errors[0][1]
+
+
+async def call_with_retry_pool_async(
+    fn,
+    pool: EndpointPool,
+    policy: Optional[RetryPolicy] = None,
+    deadline_s: Optional[float] = None,
+    sequence_id: int = 0,
+    sequence_end: bool = False,
+    hedge: bool = True,
+    clock: Callable[[], float] = time.monotonic,
+):
+    """asyncio mirror of :func:`call_with_retry_pool`; ``fn`` is an
+    async callable taking (endpoint_state, remaining_timeout_s)."""
+    import asyncio
+
+    start = clock()
+    attempt = 0
+    tried: set = set()
+    pool.note_request()
+    max_attempts = policy.max_attempts if policy is not None \
+        else max(len(pool), 1)
+    retryable_statuses = (policy.retryable_statuses if policy is not None
+                          else frozenset(DEFAULT_RETRYABLE_STATUSES))
+    while True:
+        if deadline_s is not None:
+            if deadline_s - (clock() - start) <= 0:
+                raise InferenceServerException(
+                    "deadline of %.3fs exhausted after %d attempt(s)"
+                    % (deadline_s, attempt), status="DEADLINE_EXCEEDED")
+        try:
+            state = pool.pick(exclude=tried, sequence_id=sequence_id)
+        except InferenceServerException as e:
+            if tried:
+                tried = set()
+                try:
+                    state = pool.pick(sequence_id=sequence_id)
+                except InferenceServerException as e2:
+                    _note_if_exhausted(policy, e2)
+                    raise
+            else:
+                _note_if_exhausted(policy, e)
+                raise
+        try:
+            result = await _hedged_call_async(
+                pool, fn, state, deadline_s, start, clock,
+                hedge and not sequence_id)
+        except InferenceServerException as e:
+            status = e.status() or ""
+            retryable = (policy.is_retryable(e) if policy is not None
+                         else status in retryable_statuses)
+            # Endpoint-level failures (see POOL_FAILOVER_STATUSES) are
+            # failover-eligible even when not same-endpoint-retryable.
+            retryable = retryable or status in POOL_FAILOVER_STATUSES
+            if not retryable or attempt >= max_attempts - 1:
+                if sequence_id and sequence_end:
+                    # the sequence is over even on failure: a leaked
+                    # pin would grow _sticky forever and stale-route a
+                    # reused sequence_id
+                    pool.release_sequence(sequence_id)
+                _note_if_exhausted(policy, e)
+                raise
+            tried.add(state.url)
+            if pool.has_alternative(exclude=tried):
+                pool.note_failover()
+                note_retries()
+                attempt += 1
+                continue
+            delay = None if policy is None else _next_delay(
+                policy, e, attempt, deadline_s, clock() - start)
+            if delay is None:
+                _note_if_exhausted(policy, e)
+                raise
+            note_retries()
+            await asyncio.sleep(delay)
+            tried = set()
+            attempt += 1
+            continue
+        if sequence_id and sequence_end:
+            pool.release_sequence(sequence_id)
         return result

@@ -98,6 +98,11 @@ class _ChaosState:
     def __init__(self):
         self.lock = threading.Lock()
         self.config: Optional[ChaosConfig] = None
+        # Scoped configs: named injection targets for multi-core
+        # processes (an in-process fleet). A core whose `chaos_scope`
+        # matches gets the scope's faults ON TOP of the global config —
+        # this is how one replica of N can be degraded alone.
+        self.scoped: dict = {}
         self.rng = random.Random()
         self.injected_errors = 0
         self.injected_drops = 0
@@ -110,16 +115,31 @@ _state = _ChaosState()
 
 def configure(config: Optional[ChaosConfig]) -> None:
     """Install (or, with None, clear) the process-wide chaos config and
-    reset the injection counters."""
+    reset the injection counters (scoped configs are cleared too)."""
     with _state.lock:
         _state.config = config if config is not None and config.enabled \
             else None
+        _state.scoped = {}
         _state.rng = random.Random(
             config.seed if config is not None else None)
         _state.injected_errors = 0
         _state.injected_drops = 0
         _state.delayed_requests = 0
         _state._env_checked = True  # explicit config beats the env
+
+
+def configure_scope(scope: str, config: Optional[ChaosConfig]) -> None:
+    """Install (or, with None, clear) a NAMED chaos config. Only cores
+    whose ``chaos_scope`` equals ``scope`` evaluate it — the tool for
+    degrading one replica of an in-process fleet. Counters are shared
+    with the global config and are NOT reset here (a scenario flips
+    scopes mid-run; resetting would lose the run's totals)."""
+    with _state.lock:
+        if config is not None and config.enabled:
+            _state.scoped[scope] = config
+        else:
+            _state.scoped.pop(scope, None)
+        _state._env_checked = True
 
 
 def configure_from_spec(spec: str) -> ChaosConfig:
@@ -151,34 +171,146 @@ def stats() -> dict:
         }
 
 
-def inject(model_name: str = "") -> None:
-    """Request-path hook: sleep/raise per the active config. No-op
-    (one lock-free attribute read) when chaos is off."""
+def inject(model_name: str = "", scope: Optional[str] = None) -> None:
+    """Request-path hook: sleep/raise per the active config(s). No-op
+    (one lock-free attribute read) when chaos is off. ``scope`` names
+    the calling core; a matching scoped config applies on top of the
+    global one (fault kinds compound: delays add, the first raising
+    kind wins)."""
     if not _state._env_checked:
         _load_env_config()
-    config = _state.config
-    if config is None:
+    configs = []
+    if _state.config is not None:
+        configs.append(_state.config)
+    if scope is not None and _state.scoped:
+        scoped = _state.scoped.get(scope)
+        if scoped is not None:
+            configs.append(scoped)
+    if not configs:
         return
-    if config.models is not None and model_name not in config.models:
-        return
+    delay_ms = 0.0
+    drop = False
+    error = None
     with _state.lock:
-        if _state.config is not config:  # reconfigured mid-flight
-            return
-        roll = _state.rng.random()
-        delay_ms = config.latency_ms
-        drop = roll < config.drop_rate
-        error = not drop and roll < config.drop_rate + config.error_rate
+        for config in configs:
+            if config.models is not None \
+                    and model_name not in config.models:
+                continue
+            if config is not _state.config \
+                    and config is not _state.scoped.get(scope):
+                continue  # reconfigured mid-flight
+            roll = _state.rng.random()
+            delay_ms += config.latency_ms
+            if roll < config.drop_rate:
+                drop = True
+            elif roll < config.drop_rate + config.error_rate:
+                error = config.error_rate
         if delay_ms:
             _state.delayed_requests += 1
         if drop:
             _state.injected_drops += 1
-        elif error:
+        elif error is not None:
             _state.injected_errors += 1
     if delay_ms:
         time.sleep(delay_ms / 1000.0)
     if drop:
         raise ChaosDropError()
-    if error:
+    if error is not None:
         raise InferenceServerException(
-            "injected fault (chaos error_rate=%g)" % config.error_rate,
+            "injected fault (chaos error_rate=%g)" % error,
             status="UNAVAILABLE")
+
+
+class DegradeOneScenario:
+    """Staged degradation of ONE replica in an in-process fleet: after
+    ``latency_after_s`` the victim's scope gets a latency spike (the
+    brown-out hedging is built for), after ``kill_after_s`` the victim
+    is hard-killed via the supplied callback (the outage failover is
+    built for). Either stage may be disabled (None).
+
+    Spec string (perf ``--degrade-one``), comma-separated key=value:
+    ``latency_ms=200,latency_after_s=1,kill_after_s=3,victim=1``.
+    Timings are relative to :meth:`start`.
+    """
+
+    def __init__(self, scopes, kill_fns, latency_ms: float = 0.0,
+                 latency_after_s: Optional[float] = None,
+                 kill_after_s: Optional[float] = None,
+                 victim: int = -1):
+        if len(scopes) != len(kill_fns):
+            raise ValueError("one kill_fn per scope required")
+        if not scopes:
+            raise ValueError("DegradeOneScenario needs at least one scope")
+        self.scopes = list(scopes)
+        self.kill_fns = list(kill_fns)
+        self.latency_ms = float(latency_ms)
+        self.latency_after_s = latency_after_s
+        self.kill_after_s = kill_after_s
+        self.victim = victim % len(scopes)
+        self.killed = threading.Event()
+        self.spiked = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def parse_spec(cls, spec: str) -> dict:
+        """``"latency_ms=200,latency_after_s=1,kill_after_s=3,
+        victim=1"`` -> constructor kwargs; unknown keys fail loudly."""
+        kwargs: dict = {}
+        for part in (spec or "").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            key, sep, value = part.partition("=")
+            if not sep:
+                raise ValueError(
+                    "degrade-one spec entry '%s' is not key=value" % part)
+            key = key.strip()
+            if key in ("latency_ms", "latency_after_s", "kill_after_s"):
+                kwargs[key] = float(value)
+            elif key == "victim":
+                kwargs["victim"] = int(value)
+            else:
+                raise ValueError(
+                    "unknown degrade-one spec key '%s'" % key)
+        return kwargs
+
+    def start(self) -> "DegradeOneScenario":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="chaos-degrade-one")
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        t0 = time.monotonic()
+
+        def wait_until(offset_s: float) -> bool:
+            remaining = t0 + offset_s - time.monotonic()
+            if remaining > 0 and self._stop.wait(remaining):
+                return False
+            return not self._stop.is_set()
+
+        scope = self.scopes[self.victim]
+        if self.latency_after_s is not None and self.latency_ms > 0:
+            if not wait_until(self.latency_after_s):
+                return
+            configure_scope(scope, ChaosConfig(latency_ms=self.latency_ms))
+            self.spiked.set()
+        if self.kill_after_s is not None:
+            if not wait_until(self.kill_after_s):
+                return
+            # the spike ends when the process does — clear it so the
+            # shared rng isn't consulted for a dead replica
+            configure_scope(scope, None)
+            try:
+                self.kill_fns[self.victim]()
+            finally:
+                self.killed.set()
+
+    def stop(self) -> None:
+        """Cancel pending stages and clear the victim's scope (an
+        already-fired kill is not undone)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        configure_scope(self.scopes[self.victim], None)

@@ -152,6 +152,10 @@ class InferenceServerCore:
             "log_error": True, "log_verbose_level": 0, "log_format": "default",
         }
         self.ready = True
+        # Names this core for scoped chaos injection: with several
+        # in-process cores in one process (a fleet), chaos can degrade
+        # ONE replica while the others stay healthy.
+        self.chaos_scope: Optional[str] = None
 
     # -- health / metadata ----------------------------------------------
 
@@ -528,6 +532,12 @@ class InferenceServerCore:
         model.warmup()
 
     def unload_model(self, name: str) -> None:
+        # Graceful drain ordering: (1) shed NEW requests (503/
+        # UNAVAILABLE + Retry-After) before anything stops, (2) stop
+        # the schedulers — their stop() drains queued work, which still
+        # holds in-flight counts, (3) wait for in-flight to hit zero
+        # (bounded) and only then tear the model down.
+        self.repository.begin_unload(name)
         with self._sequencers_lock:
             sequencer = self._sequencers.pop(name, None)
         if sequencer is not None:
@@ -541,7 +551,7 @@ class InferenceServerCore:
             if state is not None and state["buffer"]:
                 self._flush_trace(
                     name, self._effective_trace_settings(name), state)
-        self.repository.unload(name)
+        self.repository.finish_unload(name)
 
     def shutdown(self) -> None:
         """Teardown: flip /v2/health/ready to not-ready FIRST (load
@@ -644,7 +654,19 @@ class InferenceServerCore:
                                      executions=executions)
 
     def infer(self, request: pb.ModelInferRequest) -> pb.ModelInferResponse:
-        model = self.repository.get(request.model_name, request.model_version)
+        # acquire = READY check + in-flight increment in one atomic
+        # step: a graceful unload drains exactly the requests admitted
+        # before it flipped the state (repository.begin_unload).
+        model = self.repository.acquire(request.model_name,
+                                        request.model_version)
+        try:
+            return self._infer_admitted(model, request)
+        finally:
+            self.repository.release(model.name)
+
+    def _infer_admitted(self, model: ServedModel,
+                        request: pb.ModelInferRequest
+                        ) -> pb.ModelInferResponse:
         if getattr(model, "stats_recorder", False) is None:
             model.stats_recorder = self._record_composing
         if getattr(model, "batcher_resolver", False) is None:
@@ -658,8 +680,9 @@ class InferenceServerCore:
         queue_ns = 0
         executions = 1
         try:
-            chaos.inject(model.name)  # fault injection (no-op unless
-            # configured); drops/errors ride the normal failure path
+            chaos.inject(model.name, scope=self.chaos_scope)
+            # fault injection (no-op unless configured); drops/errors
+            # ride the normal failure path
             inputs, params = self._decode_inputs(model, request)
             t1 = time.monotonic_ns()
             batcher = self._batcher_for(model)
@@ -718,7 +741,7 @@ class InferenceServerCore:
         )
         t0 = time.monotonic_ns()
         if not model.decoupled:
-            response = self.infer(request)
+            response = self.infer(request)  # admission handled there
             stream_response = pb.ModelStreamInferResponse()
             stream_response.infer_response.CopyFrom(response)
             stream_response.infer_response.parameters[
@@ -726,6 +749,18 @@ class InferenceServerCore:
             ].bool_param = True
             yield stream_response
             return
+        # Decoupled: the whole stream holds one in-flight admission so
+        # a graceful unload drains it before teardown.
+        model = self.repository.acquire(request.model_name,
+                                        request.model_version)
+        try:
+            yield from self._stream_admitted(model, request, stats, t0,
+                                             want_empty_final)
+        finally:
+            self.repository.release(model.name)
+
+    def _stream_admitted(self, model, request, stats, t0,
+                         want_empty_final):
         try:
             inputs, params = self._decode_inputs(model, request)
             count = 0

@@ -31,6 +31,14 @@ _STATUS_MAP = {
 
 def _abort(context, error: InferenceServerException):
     code = _STATUS_MAP.get(error.status() or "", grpc.StatusCode.INTERNAL)
+    if code == grpc.StatusCode.UNAVAILABLE:
+        # The gRPC twin of the HTTP Retry-After header: a trailing
+        # metadata hint that well-behaved clients (RetryPolicy) use as
+        # their minimum backoff before retrying a shed request.
+        try:
+            context.set_trailing_metadata((("retry-after", "1"),))
+        except Exception:  # noqa: BLE001 — the abort must still fire
+            pass
     context.abort(code, error.message())
 
 

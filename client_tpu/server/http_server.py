@@ -660,7 +660,12 @@ class HttpServerThread:
             app = build_http_app(self._core)
             self._runner = web.AppRunner(app)
             await self._runner.setup()
-            site = web.TCPSite(self._runner, self._host, self._port)
+            # shutdown_timeout mirrors the gRPC server's stop grace:
+            # aiohttp's 60s default would park stop() on every live
+            # keep-alive connection — a "killed" replica must actually
+            # go away promptly.
+            site = web.TCPSite(self._runner, self._host, self._port,
+                               shutdown_timeout=1.0)
             await site.start()
             server = site._server
             self.port = server.sockets[0].getsockname()[1]
@@ -682,7 +687,16 @@ class HttpServerThread:
             if self._runner is not None:
                 await self._runner.cleanup()
 
-        asyncio.run_coroutine_threadsafe(_down(), self._loop).result(timeout=10)
+        import concurrent.futures
+
+        try:
+            asyncio.run_coroutine_threadsafe(
+                _down(), self._loop).result(timeout=10)
+        except (TimeoutError, concurrent.futures.TimeoutError):
+            # Cleanup wedged on a stubborn connection: stop the loop
+            # anyway — the listener sockets are already closed and a
+            # dead thread is better than a hung caller.
+            pass
         self._loop.call_soon_threadsafe(self._loop.stop)
         if self._thread is not None:
             self._thread.join(timeout=10)

@@ -5,6 +5,7 @@ index — the server-side counterpart of the client's model-control APIs
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Dict, List, Optional
 
 from client_tpu.protocol import inference_pb2 as pb
@@ -13,12 +14,19 @@ from client_tpu.utils import InferenceServerException
 
 
 class ModelRepository:
+    # Bounded wait for in-flight requests at unload: long enough for
+    # any sane inference, short enough that a wedged request cannot
+    # hold a model's device memory hostage forever.
+    DRAIN_TIMEOUT_S = 10.0
+
     def __init__(self):
         self._lock = threading.RLock()
+        self._cv = threading.Condition(self._lock)
         self._models: Dict[str, ServedModel] = {}
         self._factories: Dict[str, Callable[[], ServedModel]] = {}
         self._state: Dict[str, str] = {}
         self._reason: Dict[str, str] = {}
+        self._inflight: Dict[str, int] = {}
 
     def add_factory(self, name: str, factory: Callable[[], ServedModel]) -> None:
         """Make ``name`` loadable on demand without instantiating it."""
@@ -62,17 +70,93 @@ class ModelRepository:
             self._reason.pop(name, None)
         return model
 
-    def unload(self, name: str) -> None:
+    # -- graceful unload --------------------------------------------------
+    #
+    # unload is a three-phase drain, NOT a pop-and-teardown: (1) flip
+    # the state so new requests are shed with UNAVAILABLE (HTTP 503 +
+    # Retry-After) while /..../ready goes false for load balancers,
+    # (2) wait — bounded — for the per-model in-flight counter to hit
+    # zero, (3) only then drop the instance and release its device
+    # resources. Tearing down while a request holds the model's jitted
+    # functions/device buffers is a use-after-free in spirit even when
+    # Python keeps the objects alive.
+
+    def begin_unload(self, name: str) -> None:
+        """Phase 1: stop admitting requests for ``name``."""
         with self._lock:
-            model = self._models.pop(name, None)
-            if model is None and name not in self._factories:
+            if name not in self._models and name not in self._factories:
                 raise InferenceServerException(
                     "unknown model '%s'" % name, status="NOT_FOUND"
                 )
             self._state[name] = "UNAVAILABLE"
-            self._reason[name] = "unloaded"
+            self._reason[name] = "unloading: draining in-flight requests"
+
+    def finish_unload(self, name: str,
+                      drain_timeout_s: Optional[float] = None) -> None:
+        """Phases 2+3: bounded in-flight drain, then teardown."""
+        timeout = self.DRAIN_TIMEOUT_S if drain_timeout_s is None \
+            else drain_timeout_s
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while self._inflight.get(name, 0) > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break  # wedged request: tear down anyway, loudly
+                self._cv.wait(timeout=remaining)
+            leaked = self._inflight.pop(name, 0)
+            model = self._models.pop(name, None)
+            self._reason[name] = "unloaded" if not leaked else (
+                "unloaded with %d request(s) still in flight after "
+                "%.1fs drain" % (leaked, timeout))
         if model is not None:
             model.unload()
+
+    def unload(self, name: str,
+               drain_timeout_s: Optional[float] = None) -> None:
+        self.begin_unload(name)
+        self.finish_unload(name, drain_timeout_s)
+
+    # -- in-flight accounting ---------------------------------------------
+
+    def acquire(self, name: str, version: str = "") -> ServedModel:
+        """Admission for one inference: the READY check and the
+        in-flight increment are one atomic step, so an unload that
+        begins after admission waits for this request and an unload
+        that began before it sheds this request — never both."""
+        with self._lock:
+            model = self._models.get(name)
+            if model is None:
+                raise InferenceServerException(
+                    "request for unknown model: '%s' is not found" % name,
+                    status="NOT_FOUND",
+                )
+            if self._state.get(name) != "READY":
+                raise InferenceServerException(
+                    "model '%s' is unavailable: %s"
+                    % (name, self._reason.get(name, "not ready")),
+                    status="UNAVAILABLE",
+                )
+            if version and model.version != version:
+                raise InferenceServerException(
+                    "request for unknown model version: '%s' version %s"
+                    % (name, version),
+                    status="NOT_FOUND",
+                )
+            self._inflight[name] = self._inflight.get(name, 0) + 1
+            return model
+
+    def release(self, name: str) -> None:
+        with self._cv:
+            count = self._inflight.get(name, 0) - 1
+            if count <= 0:
+                self._inflight.pop(name, None)
+                self._cv.notify_all()
+            else:
+                self._inflight[name] = count
+
+    def inflight(self, name: str) -> int:
+        with self._lock:
+            return self._inflight.get(name, 0)
 
     def get(self, name: str, version: str = "") -> ServedModel:
         with self._lock:

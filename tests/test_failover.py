@@ -1,0 +1,804 @@
+"""Multi-endpoint robustness: EndpointPool routing/hedging/failover
+(unit level), two-server kill-mid-load and latency-spike scenarios over
+real HTTP transports, breaker ejection + prober readmission, sequence
+stickiness across failover, Retry-After honoring on both transports,
+and the graceful-unload drain."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from client_tpu import robust
+from client_tpu.robust import (
+    CircuitBreaker,
+    EndpointPool,
+    RetryPolicy,
+    call_with_retry,
+    call_with_retry_pool,
+)
+from client_tpu.utils import InferenceServerException
+
+
+@pytest.fixture(autouse=True)
+def _reset_counters():
+    robust.reset_retry_total()
+    yield
+    robust.reset_retry_total()
+
+
+# -- EndpointPool unit level ----------------------------------------------
+
+
+def test_split_url_forms():
+    assert EndpointPool.split_url("a:1") == ["a:1"]
+    assert EndpointPool.split_url("a:1, b:2,") == ["a:1", "b:2"]
+    assert EndpointPool.split_url(["a:1", "b:2"]) == ["a:1", "b:2"]
+    with pytest.raises(ValueError):
+        EndpointPool(["a:1", "a:1"])  # duplicates would alias state
+    with pytest.raises(ValueError):
+        EndpointPool([])
+
+
+def test_routing_prefers_low_expected_completion():
+    pool = EndpointPool(["fast", "slow"], explore_ratio=0.0)
+    pool.endpoints["fast"].ewma_latency_s = 0.005
+    pool.endpoints["slow"].ewma_latency_s = 0.200
+    # idle: the 40x faster endpoint wins even at equal outstanding
+    assert pool.pick().url == "fast"
+    # the score is (outstanding+1) * ewma: fast stays preferred until
+    # its queue is ~40 deep
+    with pool._lock:
+        pool.endpoints["fast"].outstanding = 10
+    assert pool.pick().url == "fast"
+    with pool._lock:
+        pool.endpoints["fast"].outstanding = 100
+    assert pool.pick().url == "slow"
+
+
+def test_failover_on_retryable_error():
+    pool = EndpointPool(["a", "b"], hedge_max_ratio=0.0, explore_ratio=0.0)
+
+    def fn(state, remaining):
+        if state.url == "a":
+            raise InferenceServerException("down", status="UNAVAILABLE")
+        return "ok"
+
+    policy = RetryPolicy(max_attempts=3, initial_backoff_s=0.001)
+    for _ in range(6):
+        assert call_with_retry_pool(fn, pool, policy) == "ok"
+    stats = pool.stats()
+    assert stats["failovers"] >= 1
+    # after enough consecutive failures endpoint a is ejected
+    assert stats["ejections"] == 1
+    assert pool.endpoints["a"].breaker.state == CircuitBreaker.OPEN
+    # with a ejected, requests route straight to b — no more failovers
+    before = pool.stats()["failovers"]
+    assert call_with_retry_pool(fn, pool, policy) == "ok"
+    assert pool.stats()["failovers"] == before
+
+
+def test_non_retryable_error_does_not_fail_over():
+    pool = EndpointPool(["a", "b"], hedge_max_ratio=0.0, explore_ratio=0.0)
+    calls = []
+
+    def bad(state, remaining):
+        calls.append(state.url)
+        raise InferenceServerException("bad", status="INVALID_ARGUMENT")
+
+    with pytest.raises(InferenceServerException):
+        call_with_retry_pool(bad, pool, RetryPolicy(max_attempts=4))
+    assert len(calls) == 1
+
+
+def test_all_endpoints_ejected_fails_fast():
+    pool = EndpointPool(
+        ["a", "b"],
+        breaker_factory=lambda: CircuitBreaker(failure_threshold=1,
+                                               reset_timeout_s=60.0),
+        hedge_max_ratio=0.0, explore_ratio=0.0)
+
+    def down(state, remaining):
+        raise InferenceServerException("down", status="UNAVAILABLE")
+
+    with pytest.raises(InferenceServerException):
+        call_with_retry_pool(down, pool,
+                             RetryPolicy(max_attempts=4,
+                                         initial_backoff_s=0.001))
+    assert pool.stats()["ejections"] == 2
+    calls = []
+    with pytest.raises(InferenceServerException) as excinfo:
+        call_with_retry_pool(lambda s, r: calls.append(1), pool)
+    assert excinfo.value.status() == "UNAVAILABLE"
+    assert calls == []  # shed with zero I/O
+    assert robust.exhausted_total() >= 1
+
+
+def test_hedge_budget_is_enforced():
+    pool = EndpointPool(["a", "b"], hedge_max_ratio=0.10,
+                        hedge_delay_min_ms=1.0, explore_ratio=0.0)
+    # 100 requests: the budget admits at most 10 hedges
+    for _ in range(100):
+        pool.note_request()
+    granted = 0
+    while pool.try_acquire_hedge(exclude={"a"}) is not None:
+        granted += 1
+    assert granted == 10
+    assert pool.stats()["hedges_fired"] == 10
+    # zero-budget pool never hedges
+    pool0 = EndpointPool(["a", "b"], hedge_max_ratio=0.0)
+    pool0.note_request()
+    assert pool0.try_acquire_hedge() is None
+
+
+def test_hedged_call_first_success_wins():
+    pool = EndpointPool(["slow", "fast"], hedge_delay_min_ms=5.0,
+                        hedge_max_ratio=1.0, explore_ratio=0.0)
+    # pin routing to the slow endpoint so the hedge must rescue it
+    pool.endpoints["slow"].ewma_latency_s = 0.0001
+    pool.endpoints["fast"].ewma_latency_s = 0.001
+
+    def fn(state, remaining):
+        if state.url == "slow":
+            time.sleep(0.25)
+            return "slow"
+        return "fast"
+
+    start = time.monotonic()
+    result = call_with_retry_pool(fn, pool)
+    elapsed = time.monotonic() - start
+    assert result == "fast"
+    assert elapsed < 0.2  # did not wait out the slow primary
+    stats = pool.stats()
+    assert stats["hedges_fired"] == 1
+    assert stats["hedges_won"] == 1
+    # the slow loser is discarded and counted once it completes
+    deadline = time.monotonic() + 2
+    while pool.stats()["hedges_discarded"] == 0 \
+            and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert pool.stats()["hedges_discarded"] == 1
+
+
+def test_sequences_never_hedge():
+    pool = EndpointPool(["a", "b"], hedge_delay_min_ms=1.0,
+                        hedge_max_ratio=1.0, explore_ratio=0.0)
+
+    def fn(state, remaining):
+        time.sleep(0.03)  # well past the hedge delay
+        return state.url
+
+    for _ in range(5):
+        call_with_retry_pool(fn, pool, sequence_id=9)
+    assert pool.stats()["hedges_fired"] == 0
+
+
+def test_sticky_sequence_pins_until_ejection():
+    pool = EndpointPool(["a", "b"], hedge_max_ratio=0.0, explore_ratio=0.0)
+    seen = []
+
+    def fn(state, remaining):
+        seen.append(state.url)
+        return state.url
+
+    for _ in range(8):
+        call_with_retry_pool(fn, pool, sequence_id=42)
+    assert len(set(seen)) == 1
+    pinned = seen[0]
+    other = "b" if pinned == "a" else "a"
+    # eject the pinned endpoint: the sequence re-pins (counted as a
+    # failover) and stays on the survivor
+    for _ in range(pool.endpoints[pinned].breaker.failure_threshold):
+        pool.endpoints[pinned].breaker.record_failure()
+    seen.clear()
+    for _ in range(4):
+        call_with_retry_pool(fn, pool, sequence_id=42)
+    assert set(seen) == {other}
+    assert pool.stats()["failovers"] >= 1
+    # sequence_end releases the pin
+    call_with_retry_pool(fn, pool, sequence_id=42, sequence_end=True)
+    with pool._lock:
+        assert 42 not in pool._sticky
+
+
+def test_sequence_pin_released_on_terminal_failure():
+    """A sequence whose FINAL request (sequence_end) fails terminally
+    must still release the sticky pin — a leaked pin would grow the
+    map forever and stale-route a reused sequence_id."""
+    pool = EndpointPool(["a", "b"], hedge_max_ratio=0.0, explore_ratio=0.0)
+
+    def bad(state, remaining):
+        raise InferenceServerException("bad", status="INVALID_ARGUMENT")
+
+    call_with_retry_pool(lambda s, r: s.url, pool, sequence_id=13)
+    with pool._lock:
+        assert 13 in pool._sticky
+    with pytest.raises(InferenceServerException):
+        call_with_retry_pool(bad, pool, sequence_id=13, sequence_end=True)
+    with pool._lock:
+        assert 13 not in pool._sticky
+
+
+def test_sticky_failover_counted_once():
+    """One sequence failover event = one failover count: the retry
+    loop's count and pick()'s re-pin detector must not double-book."""
+    pool = EndpointPool(["a", "b"], hedge_max_ratio=0.0, explore_ratio=0.0)
+    calls = []
+
+    def fn(state, remaining):
+        calls.append(state.url)
+        if len(calls) <= 3 or state.url == calls[0]:
+            if len(calls) == 3:  # third step: pinned endpoint dies
+                raise InferenceServerException("down",
+                                               status="UNAVAILABLE")
+        return state.url
+
+    policy = RetryPolicy(max_attempts=3, initial_backoff_s=0.001)
+    call_with_retry_pool(fn, pool, policy, sequence_id=21)
+    call_with_retry_pool(fn, pool, policy, sequence_id=21)
+    call_with_retry_pool(fn, pool, policy, sequence_id=21)  # fails over
+    assert pool.stats()["failovers"] == 1
+
+
+def test_prober_readmits_recovered_endpoint():
+    healthy = {"v": False}
+    pool = EndpointPool(
+        ["z"],
+        breaker_factory=lambda: CircuitBreaker(failure_threshold=1,
+                                               reset_timeout_s=0.05),
+        probe_interval_s=0.05)
+
+    def down(state, remaining):
+        raise InferenceServerException("down", status="UNAVAILABLE")
+
+    with pytest.raises(InferenceServerException):
+        call_with_retry_pool(down, pool)
+    assert pool.stats()["ejections"] == 1
+    pool.ensure_prober(lambda url: healthy["v"])
+    time.sleep(0.25)  # failing probes keep it open
+    assert pool.endpoints["z"].breaker.state == CircuitBreaker.OPEN
+    healthy["v"] = True
+    deadline = time.monotonic() + 5
+    while pool.endpoints["z"].breaker.state != CircuitBreaker.CLOSED \
+            and time.monotonic() < deadline:
+        time.sleep(0.02)
+    stats = pool.stats()
+    pool.close()
+    assert stats["readmissions"] == 1
+    assert stats["probes"] >= 1
+
+
+# -- Retry-After honored --------------------------------------------------
+
+
+def test_retry_after_floors_the_backoff():
+    sleeps = []
+
+    def flaky(remaining):
+        if not sleeps:
+            error = InferenceServerException("busy", status="503")
+            error.retry_after_s = 0.5
+            raise error
+        return "ok"
+
+    policy = RetryPolicy(max_attempts=3, initial_backoff_s=0.001,
+                         max_backoff_s=1.0)
+    assert call_with_retry(flaky, policy, sleep=sleeps.append) == "ok"
+    assert len(sleeps) == 1
+    assert sleeps[0] >= 0.5  # server-advised minimum, not the 1ms draw
+
+
+def test_retry_after_capped_by_backoff_max():
+    sleeps = []
+
+    def flaky(remaining):
+        if not sleeps:
+            error = InferenceServerException("busy", status="503")
+            error.retry_after_s = 60.0  # hostile/huge header
+            raise error
+        return "ok"
+
+    policy = RetryPolicy(max_attempts=3, initial_backoff_s=0.001,
+                         max_backoff_s=0.2)
+    assert call_with_retry(flaky, policy, sleep=sleeps.append) == "ok"
+    assert sleeps[0] == pytest.approx(0.2)
+
+
+def test_http_raise_if_error_carries_retry_after():
+    from client_tpu.http import _endpoints as ep
+
+    with pytest.raises(InferenceServerException) as excinfo:
+        ep.raise_if_error(503, b'{"error": "saturated"}',
+                          retry_after_s=ep.parse_retry_after("1"))
+    assert excinfo.value.status() == "503"
+    assert robust.retry_after_of(excinfo.value) == 1.0
+    assert ep.parse_retry_after("bogus") is None
+    assert ep.parse_retry_after(None) is None
+
+
+def test_grpc_error_carries_retry_after_from_trailing_metadata():
+    import grpc
+
+    from client_tpu.grpc._utils import get_error_grpc
+
+    class FakeRpcError(grpc.RpcError):
+        def code(self):
+            return grpc.StatusCode.UNAVAILABLE
+
+        def details(self):
+            return "saturated"
+
+        def trailing_metadata(self):
+            return (("retry-after", "1"),)
+
+    error = get_error_grpc(FakeRpcError())
+    assert error.status() == "UNAVAILABLE"
+    assert robust.retry_after_of(error) == 1.0
+
+
+def test_grpc_server_sends_retry_after_on_unavailable():
+    """End to end: a saturated gRPC server's UNAVAILABLE carries the
+    retry-after trailing-metadata hint, and the client surfaces it."""
+    from client_tpu.server.app import build_core, start_grpc_server
+    from client_tpu.server.model import ServedModel, TensorSpec
+
+    import client_tpu.grpc as grpcclient
+
+    class Gated(ServedModel):
+        max_batch_size = 4
+        dynamic_batching = True
+        pipeline_depth = 1
+        max_queue_size = 1
+        max_queue_delay_us = 1000
+
+        def __init__(self):
+            super().__init__()
+            self.name = "gated_ra"
+            self.inputs = [TensorSpec("IN", "FP32", [4])]
+            self.outputs = [TensorSpec("OUT", "FP32", [4])]
+            self.gate = threading.Event()
+
+        def infer(self, inputs, parameters=None):
+            self.gate.wait(30)
+            return {"OUT": np.asarray(inputs["IN"])}
+
+    core = build_core([])
+    model = Gated()
+    core.repository.add_model(model)
+    handle = start_grpc_server(core=core, address="127.0.0.1:0")
+    try:
+        with grpcclient.InferenceServerClient(handle.address) as client:
+            inputs = [grpcclient.InferInput("IN", [1, 4], "FP32")]
+            inputs[0].set_data_from_numpy(np.ones((1, 4), np.float32))
+            threads = [
+                threading.Thread(
+                    target=lambda: _swallow(
+                        lambda: client.infer("gated_ra", inputs)),
+                    daemon=True)
+                for _ in range(6)
+            ]
+            for thread in threads:
+                thread.start()
+            time.sleep(0.3)  # saturate the 1-deep queue
+            saw = None
+            deadline = time.monotonic() + 10
+            while saw is None and time.monotonic() < deadline:
+                try:
+                    # 200ms server-side queue deadline: an ADMITTED
+                    # probe expires quickly instead of blocking on the
+                    # gated model.
+                    client.infer("gated_ra", inputs, timeout=200_000)
+                except InferenceServerException as e:
+                    if e.status() == "UNAVAILABLE":
+                        saw = robust.retry_after_of(e)
+                        break
+                time.sleep(0.02)
+            model.gate.set()
+            for thread in threads:
+                thread.join(timeout=10)
+        assert saw == 1.0, "UNAVAILABLE must carry the retry-after hint"
+    finally:
+        handle.stop()
+
+
+# -- two real servers: kill, spike, stickiness ----------------------------
+
+
+def _make_inputs(mod):
+    i0 = mod.InferInput("INPUT0", [16], "INT32")
+    i1 = mod.InferInput("INPUT1", [16], "INT32")
+    i0.set_data_from_numpy(np.arange(16, dtype=np.int32))
+    i1.set_data_from_numpy(np.ones(16, np.int32))
+    return [i0, i1]
+
+
+def _http_fleet(n=2):
+    from client_tpu.server.app import build_core
+    from client_tpu.server.http_server import start_http_server_thread
+
+    members = []
+    for i in range(n):
+        core = build_core(["simple"])
+        core.chaos_scope = "test_ep%d" % i
+        runner = start_http_server_thread(core, host="127.0.0.1", port=0)
+        members.append((core, runner))
+    urls = ",".join("127.0.0.1:%d" % r.port for _c, r in members)
+    return members, urls
+
+
+def test_endpoint_kill_mid_load_zero_errors():
+    import client_tpu.http as httpclient
+
+    members, urls = _http_fleet()
+    client = httpclient.InferenceServerClient(
+        urls, concurrency=8,
+        retry_policy=RetryPolicy(max_attempts=4, initial_backoff_s=0.01))
+    errors, done, stop = [], [0], threading.Event()
+
+    def worker():
+        inputs = _make_inputs(httpclient)
+        while not stop.is_set():
+            try:
+                result = client.infer("simple", inputs)
+                assert result.as_numpy("OUTPUT0") is not None
+                done[0] += 1
+            except Exception as e:  # noqa: BLE001 — counted below
+                errors.append(e)
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(4)]
+    try:
+        for thread in threads:
+            thread.start()
+        time.sleep(0.6)
+        members[0][1].stop()  # hard kill one of two endpoints
+        members[0][0].shutdown()
+        time.sleep(1.2)
+    finally:
+        stop.set()
+        for thread in threads:
+            thread.join(timeout=15)
+    stats = client.pool_stats()
+    client.close()
+    members[1][1].stop()
+    members[1][0].shutdown()
+    assert done[0] > 50
+    assert not errors, "failover must mask the outage: %r" % errors[:3]
+    assert stats["failovers"] >= 1
+    assert stats["ejections"] >= 1
+    # all post-kill traffic landed on the survivor
+    states = {e["url"]: e["state"] for e in stats["endpoints"]}
+    assert "open" in states.values()
+
+
+def test_latency_spike_hedge_wins_and_p99_bounded():
+    """One fleet member latency-spiked by 800ms over real HTTP
+    servers: requests FORCED onto the spiked endpoint must be rescued
+    by hedges well under the spike. Exposure is pinned (the spiked
+    endpoint's EWMA is reset before each request so routing picks it)
+    to keep the test deterministic — the statistical p99 comparison
+    under organic routing lives in the bench stage's failover_hedging
+    extras and the perf-harness --degrade-one flow, where window
+    lengths make it stable."""
+    from client_tpu.server import chaos
+
+    import client_tpu.http as httpclient
+
+    members, urls = _http_fleet()
+    spike_s = 0.8
+    pool = EndpointPool(urls, hedge_delay_min_ms=30.0, hedge_max_ratio=1.0,
+                        explore_ratio=0.0)
+    client = httpclient.InferenceServerClient(
+        urls, concurrency=8, endpoint_pool=pool,
+        retry_policy=RetryPolicy(max_attempts=3, initial_backoff_s=0.01))
+    spiked_url, fast_url = pool.urls
+    try:
+        inputs = _make_inputs(httpclient)
+        for _ in range(40):  # warm the latency window with honest samples
+            client.infer("simple", inputs)
+        chaos.configure_scope(
+            "test_ep0", chaos.ChaosConfig(latency_ms=spike_s * 1000.0))
+        latencies = []
+        for _ in range(8):
+            # pin routing onto the spiked endpoint for this request
+            with pool._lock:
+                pool.endpoints[spiked_url].ewma_latency_s = 1e-5
+                pool.endpoints[fast_url].ewma_latency_s = 0.01
+            start = time.monotonic()
+            client.infer("simple", inputs)
+            latencies.append(time.monotonic() - start)
+        stats = client.pool_stats()
+        # every spiked request was rescued by its hedge: nothing waited
+        # out the full spike, and the hedge actually won
+        assert max(latencies) < spike_s * 0.8, \
+            "hedge did not rescue: %s" % [round(lat, 3)
+                                          for lat in latencies]
+        assert stats["hedges_fired"] >= 8
+        assert stats["hedges_won"] >= 6
+        assert stats["hedge_delay_ms"] < spike_s * 1000.0 / 2
+    finally:
+        chaos.configure_scope("test_ep0", None)
+        client.close()
+        for core, runner in members:
+            runner.stop()
+            core.shutdown()
+
+
+def test_sequence_sticky_across_fleet_and_failover():
+    import client_tpu.http as httpclient
+
+    members, urls = _http_fleet()
+    client = httpclient.InferenceServerClient(
+        urls, concurrency=4,
+        retry_policy=RetryPolicy(max_attempts=4, initial_backoff_s=0.01))
+    try:
+        inputs = _make_inputs(httpclient)
+        for step in range(10):
+            client.infer("simple", inputs, sequence_id=7,
+                         sequence_start=step == 0)
+        # all 10 steps landed on ONE server
+        counts = [
+            core.model_statistics("simple").model_stats[0].inference_count
+            for core, _r in members
+        ]
+        assert sorted(counts) == [0, 10], counts
+        pinned_idx = counts.index(10)
+        # kill the pinned endpoint: the sequence fails over and stays
+        # pinned to the survivor, with zero client-visible errors
+        members[pinned_idx][1].stop()
+        members[pinned_idx][0].shutdown()
+        for _ in range(10):
+            client.infer("simple", inputs, sequence_id=7)
+        survivor = members[1 - pinned_idx][0]
+        count = survivor.model_statistics(
+            "simple").model_stats[0].inference_count
+        assert count == 10
+        assert client.pool_stats()["failovers"] >= 1
+    finally:
+        client.close()
+        for core, runner in members:
+            try:
+                runner.stop()
+                core.shutdown()
+            except Exception:
+                pass
+
+
+def test_ejection_then_prober_readmission_over_http():
+    import client_tpu.http as httpclient
+    from client_tpu.server.app import build_core
+    from client_tpu.server.http_server import start_http_server_thread
+
+    core1 = build_core(["simple"])
+    runner1 = start_http_server_thread(core1, host="127.0.0.1", port=0)
+    port1 = runner1.port
+    core2 = build_core(["simple"])
+    runner2 = start_http_server_thread(core2, host="127.0.0.1", port=0)
+    urls = "127.0.0.1:%d,127.0.0.1:%d" % (port1, runner2.port)
+    pool = EndpointPool(
+        urls,
+        breaker_factory=lambda: CircuitBreaker(failure_threshold=2,
+                                               reset_timeout_s=0.1),
+        probe_interval_s=0.1, hedge_max_ratio=0.0, explore_ratio=0.0)
+    client = httpclient.InferenceServerClient(
+        urls, concurrency=4, endpoint_pool=pool,
+        retry_policy=RetryPolicy(max_attempts=4, initial_backoff_s=0.01))
+    revived = None
+    try:
+        inputs = _make_inputs(httpclient)
+        runner1.stop()  # endpoint 1 dies
+        for _ in range(8):
+            client.infer("simple", inputs)  # failures eject it
+        assert pool.stats()["ejections"] >= 1
+        url1 = "127.0.0.1:%d" % port1
+        assert pool.endpoints[url1].breaker.state == CircuitBreaker.OPEN
+        # replica comes back on the SAME address: the prober readmits
+        # it without any client traffic sacrificed
+        revived = start_http_server_thread(core1, host="127.0.0.1",
+                                           port=port1)
+        deadline = time.monotonic() + 10
+        while pool.endpoints[url1].breaker.state != CircuitBreaker.CLOSED \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert pool.endpoints[url1].breaker.state == CircuitBreaker.CLOSED
+        assert pool.stats()["readmissions"] >= 1
+        client.infer("simple", inputs)  # traffic flows again
+    finally:
+        client.close()
+        for runner in (runner2, revived):
+            if runner is not None:
+                try:
+                    runner.stop()
+                except Exception:
+                    pass
+        core1.shutdown()
+        core2.shutdown()
+
+
+# -- graceful unload drain ------------------------------------------------
+
+
+class SlowUnloadModel:
+    """Slow model that records when unload() fires relative to the
+    in-flight request."""
+
+
+def test_graceful_unload_drains_inflight_first():
+    from client_tpu.server.app import build_core
+    from client_tpu.server.model import ServedModel, TensorSpec
+    from client_tpu.grpc._utils import get_inference_request
+
+    import client_tpu.grpc as grpcclient  # for InferInput
+
+    events = []
+
+    class Slow(ServedModel):
+        def __init__(self):
+            super().__init__()
+            self.name = "slow_unload"
+            self.inputs = [TensorSpec("IN", "FP32", [2])]
+            self.outputs = [TensorSpec("OUT", "FP32", [2])]
+
+        def infer(self, inputs, parameters=None):
+            events.append("infer_start")
+            time.sleep(0.5)
+            events.append("infer_done")
+            return {"OUT": np.asarray(inputs["IN"])}
+
+        def unload(self):
+            events.append("unload")
+
+    core = build_core([])
+    core.repository.add_model(Slow())
+    inputs = [grpcclient.InferInput("IN", [2], "FP32")]
+    inputs[0].set_data_from_numpy(np.ones(2, np.float32))
+    request = get_inference_request(model_name="slow_unload",
+                                    inputs=inputs)
+    results = {}
+
+    def run_infer():
+        try:
+            core.infer(request)
+            results["infer"] = "ok"
+        except InferenceServerException as e:
+            results["infer"] = e.status()
+
+    infer_thread = threading.Thread(target=run_infer, daemon=True)
+    infer_thread.start()
+    deadline = time.monotonic() + 5
+    while "infer_start" not in events and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert core.repository.inflight("slow_unload") == 1
+
+    unload_thread = threading.Thread(
+        target=lambda: core.unload_model("slow_unload"), daemon=True)
+    unload_thread.start()
+    time.sleep(0.1)  # drain has begun, request still in flight
+    # new requests are shed with UNAVAILABLE (-> HTTP 503 + Retry-After)
+    with pytest.raises(InferenceServerException) as excinfo:
+        core.infer(request)
+    assert excinfo.value.status() == "UNAVAILABLE"
+    infer_thread.join(timeout=10)
+    unload_thread.join(timeout=10)
+    # the in-flight request completed, and teardown came strictly after
+    assert results["infer"] == "ok"
+    assert events == ["infer_start", "infer_done", "unload"]
+    assert core.repository.inflight("slow_unload") == 0
+    # fully gone now
+    with pytest.raises(InferenceServerException) as excinfo:
+        core.infer(request)
+    assert excinfo.value.status() == "NOT_FOUND"
+
+
+def test_unload_drain_is_bounded():
+    from client_tpu.server.app import build_core
+    from client_tpu.server.model import ServedModel, TensorSpec
+    from client_tpu.grpc._utils import get_inference_request
+
+    import client_tpu.grpc as grpcclient
+
+    gate = threading.Event()
+
+    class Wedged(ServedModel):
+        def __init__(self):
+            super().__init__()
+            self.name = "wedged"
+            self.inputs = [TensorSpec("IN", "FP32", [2])]
+            self.outputs = [TensorSpec("OUT", "FP32", [2])]
+
+        def infer(self, inputs, parameters=None):
+            gate.wait(30)
+            return {"OUT": np.asarray(inputs["IN"])}
+
+    core = build_core([])
+    core.repository.add_model(Wedged())
+    inputs = [grpcclient.InferInput("IN", [2], "FP32")]
+    inputs[0].set_data_from_numpy(np.ones(2, np.float32))
+    request = get_inference_request(model_name="wedged", inputs=inputs)
+    thread = threading.Thread(
+        target=lambda: _swallow(lambda: core.infer(request)), daemon=True)
+    thread.start()
+    time.sleep(0.2)
+    start = time.monotonic()
+    core.repository.begin_unload("wedged")
+    core.repository.finish_unload("wedged", drain_timeout_s=0.3)
+    elapsed = time.monotonic() - start
+    assert elapsed < 2.0, "drain must be bounded, took %.1fs" % elapsed
+    gate.set()
+    thread.join(timeout=10)
+
+
+def _swallow(fn):
+    try:
+        fn()
+    except Exception:
+        pass
+
+
+# -- asyncio clients over a fleet -----------------------------------------
+
+
+def test_http_aio_pool_failover():
+    import asyncio
+
+    import client_tpu.http.aio as aioclient
+
+    members, urls = _http_fleet()
+
+    async def main():
+        client = aioclient.InferenceServerClient(
+            urls,
+            retry_policy=RetryPolicy(max_attempts=4,
+                                     initial_backoff_s=0.01))
+        try:
+            inputs = _make_inputs(aioclient)
+            for _ in range(10):
+                await client.infer("simple", inputs)
+            members[0][1].stop()  # kill one endpoint
+            members[0][0].shutdown()
+            for _ in range(10):
+                result = await client.infer("simple", inputs)
+                assert result.as_numpy("OUTPUT0") is not None
+            return client.pool_stats()
+        finally:
+            await client.close()
+
+    stats = asyncio.run(main())
+    members[1][1].stop()
+    members[1][0].shutdown()
+    assert stats["requests"] >= 20
+
+
+def test_grpc_aio_pool_failover():
+    import asyncio
+
+    from client_tpu.server.app import build_core, start_grpc_server
+
+    import client_tpu.grpc.aio as aioclient
+
+    core1 = build_core(["simple"])
+    core2 = build_core(["simple"])
+    handle1 = start_grpc_server(core=core1, address="127.0.0.1:0")
+    handle2 = start_grpc_server(core=core2, address="127.0.0.1:0")
+
+    async def main():
+        client = aioclient.InferenceServerClient(
+            "%s,%s" % (handle1.address, handle2.address),
+            retry_policy=RetryPolicy(max_attempts=4,
+                                     initial_backoff_s=0.01))
+        try:
+            inputs = _make_inputs(aioclient)
+            for _ in range(10):
+                await client.infer("simple", inputs)
+            handle1.stop()
+            for _ in range(10):
+                result = await client.infer("simple", inputs)
+                assert result.as_numpy("OUTPUT0") is not None
+            return client.pool_stats()
+        finally:
+            await client.close()
+
+    stats = asyncio.run(main())
+    handle2.stop()
+    assert stats["requests"] >= 20

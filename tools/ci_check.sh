@@ -6,7 +6,7 @@
 # regression gate). Usage: tools/ci_check.sh [min_passed]
 set -u -o pipefail
 
-MIN_PASSED="${1:-374}"
+MIN_PASSED="${1:-399}"
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
 LOG=/tmp/_t1.log
 
@@ -73,4 +73,31 @@ if ! awk -v f="$fused" 'BEGIN { exit !(f > 1.0) }'; then
 fi
 grep -E "sequences dyna_sequence" "$SEQ_LOG"
 echo "OK: sequence smoke passed (mean fused batch $fused)"
+
+# Failover smoke: 2 embedded gRPC servers, one chaos-killed 2s into
+# the run — the endpoint pool must mask the outage completely (100%
+# goodput: zero client-visible errors, all traffic failed over).
+echo "failover smoke: 2-server fleet with one endpoint chaos-killed"
+FO_LOG=/tmp/_failover_smoke.log
+if ! timeout -k 10 240 env JAX_PLATFORMS=cpu python -m client_tpu.perf \
+    -m simple --service-kind triton --fleet 2 -i grpc -p 3000 -r 2 \
+    --concurrency-range 4 --retries 3 \
+    --degrade-one "kill_after_s=2,victim=1" > "$FO_LOG" 2>&1; then
+    echo "FAIL: failover smoke run did not complete" >&2
+    tail -20 "$FO_LOG" >&2
+    exit 1
+fi
+if ! grep -q "Failover summary" "$FO_LOG"; then
+    echo "FAIL: failover smoke produced no failover summary" >&2
+    tail -20 "$FO_LOG" >&2
+    exit 1
+fi
+if ! grep -q "client-visible errors: 0 of" "$FO_LOG"; then
+    echo "FAIL: endpoint kill was not fully masked by failover" >&2
+    grep -E "Failover summary|client-visible|failovers|ejections" \
+        "$FO_LOG" >&2
+    exit 1
+fi
+grep -E "Failover summary|client-visible|failovers|ejections" "$FO_LOG"
+echo "OK: failover smoke passed (100% goodput through an endpoint kill)"
 exit 0
