@@ -20,9 +20,24 @@ def builtin_model_factories(repository=None
     )
     from client_tpu.models.zoo import extra_model_factories
 
+    def _simple_cache() -> ServedModel:
+        # The `simple` model with the response cache enabled, fronted
+        # by a dynamic batcher whose preferred size (8) exceeds the
+        # bench harness's closed-loop concurrency — misses pay the
+        # full gather window, which is exactly the latency a cache hit
+        # masks (hits bypass the queue/batcher entirely).
+        model = AddSub(name="simple_cache", datatype="INT32", shape=(16,))
+        model.response_cache = True
+        model.max_batch_size = 8
+        model.dynamic_batching = True
+        model.preferred_batch_sizes = [8]
+        model.max_queue_delay_us = 1000
+        return model
+
     factories: Dict[str, Callable[[], ServedModel]] = {
         "add_sub": AddSub,
         "simple": lambda: AddSub(name="simple", datatype="INT32", shape=(16,)),
+        "simple_cache": _simple_cache,
         "add_sub_fp32": lambda: AddSub(
             name="add_sub_fp32", datatype="FP32", shape=(16,)
         ),

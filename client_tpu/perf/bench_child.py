@@ -309,6 +309,156 @@ def fusion_stats(core, model_name: str):
         return None
 
 
+def cache_stats(core, model_name: str):
+    """Response-cache counters for bench evidence (hits never execute;
+    the hit/miss split plus execution_count proves both the replay hit
+    ratio and single-flight dedup)."""
+    try:
+        stats = core.model_statistics(model_name)
+        entry = stats.model_stats[0]
+        return {
+            "inference_count": int(entry.inference_count),
+            "execution_count": int(entry.execution_count),
+            "cache_hit_count": int(entry.cache_hit_count),
+            "cache_miss_count": int(entry.cache_miss_count),
+        }
+    except Exception:  # noqa: BLE001 — evidence, never a failure
+        return None
+
+
+def run_cache_measure(core, model_name: str = "simple_cache",
+                      hot_set: int = 64, threads: int = 2,
+                      warm_s: float = 2.0, unique: int = 2048,
+                      burst: int = 16) -> dict:
+    """Hot-set replay measurement for the response cache. Three
+    phases against the in-process core (no RPC, so the server-side
+    cost difference is what gets measured):
+
+    * cold — every request content-unique, so every one misses and
+      rides the dynamic batcher (gather window + execute + insert);
+    * warm — the same ``hot_set`` requests replayed for ``warm_s``
+      after one priming pass: every request hits and bypasses the
+      batcher entirely (hash + lookup + proto copy);
+    * burst — ``burst`` threads fire ONE identical fresh request
+      simultaneously: single-flight must coalesce them onto exactly
+      one model execution.
+    """
+    import threading as _threading
+
+    import numpy as np
+
+    from client_tpu._infer_common import InferInput
+    from client_tpu.grpc._utils import get_inference_request
+
+    def request(seed: int):
+        a = np.full((1, 16), seed, dtype=np.int32)
+        b = np.arange(16, dtype=np.int32).reshape(1, 16) + seed
+        t0 = InferInput("INPUT0", [1, 16], "INT32")
+        t0.set_data_from_numpy(a)
+        t1 = InferInput("INPUT1", [1, 16], "INT32")
+        t1.set_data_from_numpy(b)
+        return get_inference_request(model_name=model_name,
+                                     inputs=[t0, t1], outputs=None)
+
+    def closed_loop(request_slices, duration_s=None):
+        """One closed-loop worker per slice; each worker walks ITS OWN
+        request list (no shared lock in the issue path — a shared
+        iterator lock convoys with the GIL and measures the harness,
+        not the server). Returns (throughput, p50_us)."""
+        latencies: list = []
+        merge = _threading.Lock()
+
+        def worker(slice_requests):
+            local = []
+            for req in slice_requests:
+                t_start = time.monotonic_ns()
+                core.infer(req)
+                local.append(time.monotonic_ns() - t_start)
+                if duration_s is not None \
+                        and time.monotonic() - t_phase0 >= duration_s:
+                    break
+            with merge:
+                latencies.extend(local)
+
+        t_phase0 = time.monotonic()
+        pool = [_threading.Thread(target=worker, args=(s,))
+                for s in request_slices]
+        for thread in pool:
+            thread.start()
+        for thread in pool:
+            thread.join()
+        elapsed = time.monotonic() - t_phase0
+        if not latencies or elapsed <= 0:
+            return 0.0, 0.0
+        latencies.sort()
+        p50_us = latencies[len(latencies) // 2] / 1000.0
+        return len(latencies) / elapsed, p50_us
+
+    # -- cold: `unique` never-repeating requests (all misses),
+    #    pre-partitioned across the workers
+    cold_requests = [request(1_000_000 + i) for i in range(unique)]
+    cold_slices = [cold_requests[i::threads] for i in range(threads)]
+    before_cold = cache_stats(core, model_name)
+    cold_tput, cold_p50 = closed_loop(cold_slices)
+
+    # -- warm: prime the hot set once, then replay it for warm_s
+    #    (each worker cycles the hot set from its own offset)
+    hot_requests = [request(2_000_000 + i) for i in range(hot_set)]
+    for req in hot_requests:
+        core.infer(req)
+    rounds = max(1, int(50_000 * warm_s) // max(hot_set, 1))
+    warm_slices = [
+        (hot_requests[i % hot_set:] + hot_requests[:i % hot_set]) * rounds
+        for i in range(threads)
+    ]
+    before_warm = cache_stats(core, model_name)
+    warm_tput, warm_p50 = closed_loop(warm_slices, duration_s=warm_s)
+    after_warm = cache_stats(core, model_name)
+
+    # -- burst: single-flight dedup on one fresh request
+    before_burst = cache_stats(core, model_name)
+    burst_request = request(3_000_000)
+    barrier = _threading.Barrier(burst)
+
+    def burst_worker():
+        barrier.wait()
+        core.infer(burst_request)
+
+    pool = [_threading.Thread(target=burst_worker) for _ in range(burst)]
+    for thread in pool:
+        thread.start()
+    for thread in pool:
+        thread.join()
+    after_burst = cache_stats(core, model_name)
+
+    result = {
+        "hot_set": hot_set,
+        "concurrency": threads,
+        "cold_miss_tput": round(cold_tput, 2),
+        "cold_miss_p50_us": round(cold_p50, 1),
+        "warm_hit_tput": round(warm_tput, 2),
+        "warm_hit_p50_us": round(warm_p50, 1),
+    }
+    if cold_tput > 0:
+        result["warm_vs_cold_speedup"] = round(warm_tput / cold_tput, 2)
+    if before_warm and after_warm:
+        d_hit = (after_warm["cache_hit_count"]
+                 - before_warm["cache_hit_count"])
+        d_miss = (after_warm["cache_miss_count"]
+                  - before_warm["cache_miss_count"])
+        if d_hit + d_miss:
+            result["warm_hit_ratio"] = round(d_hit / (d_hit + d_miss), 4)
+    if before_cold and before_warm:
+        result["cold_misses"] = (before_warm["cache_miss_count"]
+                                 - before_cold["cache_miss_count"])
+    if before_burst and after_burst:
+        result["singleflight_burst"] = burst
+        result["singleflight_executions"] = (
+            after_burst["execution_count"]
+            - before_burst["execution_count"])
+    return result
+
+
 def sequence_stats(core, model_name: str):
     """Sequence-scheduler snapshot for bench evidence (slot occupancy
     + lifetime counters from ModelStatistics.sequence_stats)."""
@@ -1100,6 +1250,26 @@ def main() -> None:
             record_stage("dyna_sequence_inprocess", tput, p50, extra)
         except Exception as exc:  # noqa: BLE001
             log("dyna_sequence_inprocess failed: %s" % exc)
+
+    # Config 3d: response cache — hot-set replay against simple_cache
+    # (the `simple` add/sub model with response_cache.enable + a
+    # dynamic batcher). Cold phase: content-unique requests, all
+    # misses through the batcher. Warm phase: a 64-request hot set
+    # replayed, all hits bypassing queue/batcher/execution. The
+    # single-flight burst proves N identical concurrent requests
+    # execute the model exactly once. Acceptance: warm-hit tput >= 5x
+    # cold-miss tput and singleflight_executions == 1.
+    if remaining() > 60 and stage_wanted("response_cache"):
+        try:
+            run_with_watchdog(
+                "simple_cache load",
+                lambda: core.repository.load("simple_cache"),
+                min(120.0, max(30.0, remaining() - 60)))
+            extra = run_cache_measure(core)
+            record_stage("response_cache", extra.get("warm_hit_tput", 0.0),
+                         extra.get("warm_hit_p50_us", 0.0), extra)
+        except Exception as exc:  # noqa: BLE001
+            log("response_cache failed: %s" % exc)
 
     # Config 3c: failover + hedging across a 2-server fleet (the
     # EndpointPool client). Three measurements: one endpoint latency-

@@ -38,6 +38,11 @@ class TpuMetrics:
     batch_overlap_ratio: Dict[str, float] = field(default_factory=dict)
     sequence_active: Dict[str, float] = field(default_factory=dict)
     sequence_backlog: Dict[str, float] = field(default_factory=dict)
+    cache_hit_total: Dict[str, float] = field(default_factory=dict)
+    cache_miss_total: Dict[str, float] = field(default_factory=dict)
+    cache_size_bytes: Dict[str, float] = field(default_factory=dict)
+    cache_entries: Dict[str, float] = field(default_factory=dict)
+    cache_evictions_total: Dict[str, float] = field(default_factory=dict)
 
 
 _FAMILIES = {
@@ -50,7 +55,20 @@ _FAMILIES = {
     "tpu_batch_overlap_ratio": "batch_overlap_ratio",
     "tpu_sequence_active": "sequence_active",
     "tpu_sequence_backlog": "sequence_backlog",
+    "tpu_cache_hit_total": "cache_hit_total",
+    "tpu_cache_miss_total": "cache_miss_total",
+    "tpu_cache_size_bytes": "cache_size_bytes",
+    "tpu_cache_entries": "cache_entries",
+    "tpu_cache_evictions_total": "cache_evictions_total",
 }
+
+# Monotonic counters among the scraped families: summarize_metrics
+# reports their within-window DELTA (last - first, clamped at 0 for
+# counter resets) instead of a meaningless avg/max of the cumulative
+# value. Everything else is a gauge (avg/max of point-in-time values).
+_COUNTER_FAMILIES = frozenset((
+    "cache_hit_total", "cache_miss_total", "cache_evictions_total",
+))
 
 
 def parse_prometheus(text: str) -> TpuMetrics:
@@ -134,14 +152,19 @@ class MetricsManager:
 
 
 def summarize_metrics(snapshots: List[TpuMetrics]) -> Dict[str, Dict[str, float]]:
-    """avg/max per gauge family across a window's snapshots, averaged
-    over devices (what the CSV 'GPU metrics' columns become; the
-    batch_* families average over models instead)."""
+    """Per-family window summary. Gauges get avg/max across the
+    window's snapshots, averaged over devices (what the CSV 'GPU
+    metrics' columns become; the batch_*/cache gauge families average
+    over models instead). Counter families (_COUNTER_FAMILIES) get the
+    window DELTA instead — first-to-last difference summed over
+    models, clamped at 0 per model so a server restart mid-window
+    cannot go negative."""
     out: Dict[str, Dict[str, float]] = {}
     for attr in ("hbm_used_bytes", "hbm_total_bytes", "hbm_utilization",
                  "batch_pending_depth", "batch_inflight",
                  "batch_queue_delay_us", "batch_overlap_ratio",
-                 "sequence_active", "sequence_backlog"):
+                 "sequence_active", "sequence_backlog",
+                 "cache_size_bytes", "cache_entries"):
         values = []
         for snap in snapshots:
             per_device = getattr(snap, attr)
@@ -151,5 +174,18 @@ def summarize_metrics(snapshots: List[TpuMetrics]) -> Dict[str, Dict[str, float]
             out[attr] = {
                 "avg": sum(values) / len(values),
                 "max": max(values),
+            }
+    for attr in sorted(_COUNTER_FAMILIES):
+        first: Dict[str, float] = {}
+        last: Dict[str, float] = {}
+        for snap in snapshots:
+            for key, value in getattr(snap, attr).items():
+                first.setdefault(key, value)
+                last[key] = value
+        if last:
+            out[attr] = {
+                "delta": sum(max(last[k] - first.get(k, 0.0), 0.0)
+                             for k in last),
+                "last": sum(last.values()),
             }
     return out

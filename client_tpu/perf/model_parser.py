@@ -54,6 +54,11 @@ class ParsedModel:
         # is an ensemble (reference GetComposingSchedulerType).
         self.composing_sequential = False
         self.response_cache_enabled = False
+        # True when any composing model of an ensemble enables the
+        # response cache: the cache-latency caveat applies even though
+        # the TOP model's config carries no response_cache section
+        # (its composing steps' breakdowns exclude their cache hits).
+        self.composing_cache_enabled = False
 
 
 class ModelParser:
@@ -176,4 +181,6 @@ class ModelParser:
             return  # unavailable child: keep the name for stat pairing
         if "sequence_batching" in child_config:
             model.composing_sequential = True
+        if (child_config.get("response_cache") or {}).get("enable"):
+            model.composing_cache_enabled = True
         self._add_composing(backend, child_config, model, seen)

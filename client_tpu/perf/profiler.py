@@ -77,7 +77,7 @@ def _normalize_stats_entry(entry: Dict) -> Dict:
     fields only (a generic string->int pass would corrupt `version`)."""
     out = dict(entry)
     for key in ("inference_count", "execution_count", "reject_count",
-                "timeout_count"):
+                "timeout_count", "cache_hit_count", "cache_miss_count"):
         if key in out:
             out[key] = int(out[key])
     sections = {}
@@ -538,8 +538,17 @@ class InferenceProfiler:
         for fam in families:
             windows = [t.tpu_metrics[fam] for t in trials
                        if fam in t.tpu_metrics]
-            merged.tpu_metrics[fam] = {
-                "avg": sum(w["avg"] for w in windows) / len(windows),
-                "max": max(w["max"] for w in windows),
-            }
+            if any("delta" in w for w in windows):
+                # Counter families (cache hit/miss/evictions): window
+                # deltas sum across merged windows; "last" keeps the
+                # final cumulative value.
+                merged.tpu_metrics[fam] = {
+                    "delta": sum(w.get("delta", 0.0) for w in windows),
+                    "last": windows[-1].get("last", 0.0),
+                }
+            else:
+                merged.tpu_metrics[fam] = {
+                    "avg": sum(w["avg"] for w in windows) / len(windows),
+                    "max": max(w["max"] for w in windows),
+                }
         return merged

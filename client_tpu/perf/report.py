@@ -47,6 +47,29 @@ def print_report(results: List[PerfStatus], percentile: int = 0,
                    entry.get("execution_count", 0), us("queue"),
                    us("compute_input"), us("compute_infer"),
                    us("compute_output")))
+            hits = int(entry.get("cache_hit_count", 0))
+            misses = int(entry.get("cache_miss_count", 0))
+            if hits or misses:
+                # Window-delta cache summary. The mean path latencies
+                # come from the cache_hit/cache_miss duration sections
+                # (end-to-end per path); queue/compute sections above
+                # EXCLUDE hits — the caveat printed at startup.
+                ratio = hits / (hits + misses) * 100.0
+
+                def path_us(section, n):
+                    return (stats.get(section, {}).get("ns", 0) / n
+                            / 1000.0 if n else 0.0)
+
+                parts = ["%.1f%% hit ratio (%d hits / %d misses)"
+                         % (ratio, hits, misses)]
+                if hits:
+                    parts.append("hit mean %.0f us"
+                                 % path_us("cache_hit", hits))
+                if misses:
+                    parts.append("miss mean %.0f us"
+                                 % path_us("cache_miss", misses))
+                print("    cache %s (this window): %s"
+                      % (entry.get("name", "?"), ", ".join(parts)))
             seq = entry.get("sequence_stats") or {}
             if seq.get("step_count") or seq.get("active_sequences"):
                 slot_total = seq.get("slot_total", 0)

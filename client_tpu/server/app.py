@@ -22,6 +22,7 @@ def build_core(
     load_models: Optional[Sequence[str]] = None,
     tpu_arena=None,
     warmup: bool = True,
+    cache_size: Optional[int] = None,
 ) -> InferenceServerCore:
     repository = ModelRepository()
     for name, factory in builtin_model_factories(repository).items():
@@ -33,7 +34,13 @@ def build_core(
             tpu_arena = TpuArena()
         except Exception:
             tpu_arena = None  # no accelerator runtime available
-    core = InferenceServerCore(repository, tpu_arena=tpu_arena)
+    if cache_size is None:
+        # Server-level response-cache byte budget (0 disables); the
+        # env var covers embedded launches with no CLI surface.
+        env = os.environ.get("CLIENT_TPU_CACHE_SIZE", "")
+        cache_size = int(env) if env else None
+    core = InferenceServerCore(repository, tpu_arena=tpu_arena,
+                               cache_size=cache_size)
     for name in load_models or ():
         model = repository.load(name)
         if warmup:
@@ -127,9 +134,15 @@ def main(argv=None):
         "--models", nargs="*", default=["simple"],
         help="models to load at startup (others load on demand)",
     )
+    parser.add_argument(
+        "--cache-size", type=int, default=None,
+        help="response-cache byte budget shared across models "
+             "(0 disables; default 64 MiB; models opt in via "
+             "response_cache.enable)",
+    )
     args = parser.parse_args(argv)
 
-    core = build_core(args.models)
+    core = build_core(args.models, cache_size=args.cache_size)
     handle = start_grpc_server(
         core=core, address="%s:%d" % (args.host, args.grpc_port)
     )

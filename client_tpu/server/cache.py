@@ -1,0 +1,338 @@
+"""Content-addressed response cache with single-flight deduplication.
+
+The server-side counterpart of Triton's local response cache (the
+feature both perf parsers already read as ``response_cache.enable`` and
+whose latency caveat the harness prints): a byte-budgeted LRU over
+*encoded* ``ModelInferResponse`` protos, keyed by a content hash of the
+wire request — model, version, every input tensor's name/dtype/shape/
+bytes, the requested outputs (with their response-shaping parameters),
+and the cache-relevant request parameters. Hits are served before the
+request is even decoded: no input deserialization, no queue, no
+batcher, no model execution, no output encoding.
+
+Two deliberate departures from the Triton design:
+
+* **Single-flight deduplication.** Concurrent identical misses
+  coalesce: the first becomes the *leader* and executes normally;
+  followers park on the leader's flight and are served its response
+  (bounded by their own queue deadline, PR-2 semantics). A burst of N
+  identical requests executes the model once, not N times — Clipper's
+  prediction-cache observation applied at admission time.
+* **Host-only entries.** Cached responses are already-serialized host
+  bytes; the cache never pins device buffers, so HBM pressure is
+  unaffected by cache sizing.
+
+Bypass rules (the request never touches the cache):
+
+* stateful sequence requests (``sequence_id`` — step results depend on
+  scheduler state, not request content),
+* decoupled/streaming models (zero-or-many responses have no single
+  cacheable value),
+* any input or requested output routed through a shared-memory region
+  (region contents are not content-addressable from the wire request,
+  and shm outputs need per-request side effects),
+* failed executions (errors resolve the flight but are never
+  inserted).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+from typing import Dict, Optional, Tuple
+
+from client_tpu.protocol import inference_pb2 as pb
+
+# 64 MiB default budget — ~200k cached `simple` responses (payload +
+# per-entry overhead), or a few thousand BERT-sized ones; override per
+# server via the cache_size knob (InferenceServerCore /
+# app --cache-size / CLIENT_TPU_CACHE_SIZE).
+DEFAULT_CACHE_BYTES = 64 << 20
+
+# Request parameters that must NOT contribute to the content hash:
+# QoS/transport knobs that do not change the response payload.
+_UNCACHED_PARAMS = frozenset((
+    "timeout",
+    "priority",
+    "triton_enable_empty_final_response",
+    "binary_data_output",
+))
+
+# Any of these marks a correlated (stateful) request: bypass entirely.
+_SEQUENCE_PARAMS = frozenset((
+    "sequence_id", "sequence_start", "sequence_end",
+))
+
+
+def request_cache_key(model_name: str, model_version: str,
+                      request: pb.ModelInferRequest) -> Optional[bytes]:
+    """Content hash for one wire request, or ``None`` when the request
+    is uncacheable (sequence params, shared-memory I/O).
+
+    Hashed over the *wire form* (tensor bytes, not decoded arrays), so
+    a hit never pays input deserialization. The same logical tensor
+    sent via ``raw_input_contents`` vs typed ``contents`` hashes
+    differently — that is only a missed dedup opportunity, never a
+    correctness issue.
+    """
+    for key in request.parameters:
+        if key in _SEQUENCE_PARAMS:
+            return None
+    h = hashlib.blake2b(digest_size=16)
+    h.update(model_name.encode())
+    h.update(b"\x00")
+    h.update(model_version.encode())
+    # Each tensor hashes as its serialized wire form (name, datatype,
+    # shape, typed contents, parameters in one C-level call — the hit
+    # path must stay a few microseconds). Within-process proto
+    # serialization is stable; a nondeterministic map ordering would
+    # only cost a spurious miss, never a wrong hit.
+    for tensor in request.inputs:
+        if "shared_memory_region" in tensor.parameters:
+            return None
+        h.update(b"\x01")
+        h.update(tensor.SerializeToString())
+    for raw in request.raw_input_contents:
+        h.update(b"\x02")
+        h.update(len(raw).to_bytes(8, "little"))
+        h.update(raw)
+    # Requested outputs shape the response (selection, classification
+    # top-k), so they are part of the content address.
+    for out in request.outputs:
+        if "shared_memory_region" in out.parameters:
+            return None
+        h.update(b"\x03")
+        h.update(out.SerializeToString())
+    for key in sorted(request.parameters):
+        if key in _UNCACHED_PARAMS:
+            continue
+        h.update(b"\x04")
+        h.update(key.encode())
+        h.update(request.parameters[key].SerializeToString())
+    return h.digest()
+
+
+class Flight:
+    """One in-progress execution for a cache key. The leader resolves
+    it with the encoded response (or marks it failed); followers wait
+    on ``event`` bounded by their own queue deadline."""
+
+    __slots__ = ("event", "response", "failed")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.response: Optional[pb.ModelInferResponse] = None
+        self.failed = False
+
+
+# Charged per entry on top of the serialized payload: key digest,
+# OrderedDict slot, entry object, and bytes-object headers. Keeps the
+# byte budget an honest bound on real host memory, not just payload.
+ENTRY_OVERHEAD_BYTES = 128
+
+
+class _Entry:
+    __slots__ = ("model", "data", "nbytes")
+
+    def __init__(self, model: str, data: bytes, nbytes: int):
+        self.model = model
+        self.data = data
+        self.nbytes = nbytes
+
+
+class _ModelCacheStats:
+    """Per-model cache accounting the Prometheus families render."""
+
+    __slots__ = ("entries", "bytes", "evictions", "coalesced",
+                 "insert_skipped")
+
+    def __init__(self):
+        self.entries = 0
+        self.bytes = 0
+        self.evictions = 0
+        # Followers served from a leader's flight (dedup wins).
+        self.coalesced = 0
+        # Responses larger than the whole budget (never cached).
+        self.insert_skipped = 0
+
+
+class ResponseCache:
+    """Byte-budgeted LRU over encoded responses + the single-flight
+    table. All operations are O(1) except ``invalidate_model`` (one
+    scan, only on reload/unload). Thread-safe."""
+
+    def __init__(self, max_bytes: int = DEFAULT_CACHE_BYTES):
+        self.max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[bytes, _Entry]" = OrderedDict()
+        self._bytes = 0
+        self._flights: Dict[bytes, Flight] = {}
+        self._per_model: Dict[str, _ModelCacheStats] = {}
+
+    @property
+    def enabled(self) -> bool:
+        return self.max_bytes > 0
+
+    # -- lookup / insert -------------------------------------------------
+
+    def _model_stats(self, model: str) -> _ModelCacheStats:
+        stats = self._per_model.get(model)
+        if stats is None:
+            stats = self._per_model[model] = _ModelCacheStats()
+        return stats
+
+    def lookup(self, key: bytes) -> Optional[bytes]:
+        """LRU-touching lookup. Returns the stored *serialized*
+        response (id cleared at insert) — callers parse a fresh proto
+        and stamp the requester's own id."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                return None
+            self._entries.move_to_end(key)
+            return entry.data
+
+    def lookup_or_begin(self, key: bytes
+                        ) -> Tuple[Optional[bytes], Optional[Flight], bool]:
+        """(cached_bytes, flight, is_leader) in ONE atomic step. A
+        separate lookup-miss followed by begin_flight would race: a
+        leader that resolves and inserts between the two calls leaves
+        the late thread leading a second redundant execution. Inserts
+        happen BEFORE flight resolution on the leader path, so this
+        atomic probe can never miss both."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+                return entry.data, None, False
+            flight = self._flights.get(key)
+            if flight is not None:
+                return None, flight, False
+            flight = Flight()
+            self._flights[key] = flight
+            return None, flight, True
+
+    def insert(self, model: str, key: bytes,
+               response: pb.ModelInferResponse) -> bool:
+        """Stores the serialized response (id cleared — the hit path
+        stamps the requester's own id), evicting LRU entries until the
+        byte budget holds. A response larger than the whole budget is
+        never cached. Entries are host bytes only: the cache never
+        pins device buffers or live proto graphs."""
+        stored = pb.ModelInferResponse()
+        stored.CopyFrom(response)
+        stored.id = ""
+        data = stored.SerializeToString()
+        nbytes = len(data) + ENTRY_OVERHEAD_BYTES
+        with self._lock:
+            stats = self._model_stats(model)
+            if nbytes > self.max_bytes:
+                stats.insert_skipped += 1
+                return False
+            prior = self._entries.pop(key, None)
+            if prior is not None:
+                self._bytes -= prior.nbytes
+                prior_stats = self._model_stats(prior.model)
+                prior_stats.entries -= 1
+                prior_stats.bytes -= prior.nbytes
+            while self._bytes + nbytes > self.max_bytes and self._entries:
+                _, victim = self._entries.popitem(last=False)
+                self._bytes -= victim.nbytes
+                victim_stats = self._model_stats(victim.model)
+                victim_stats.entries -= 1
+                victim_stats.bytes -= victim.nbytes
+                victim_stats.evictions += 1
+            self._entries[key] = _Entry(model, data, nbytes)
+            self._bytes += nbytes
+            stats.entries += 1
+            stats.bytes += nbytes
+            return True
+
+    # -- single flight ---------------------------------------------------
+
+    def begin_flight(self, key: bytes) -> Tuple[Flight, bool]:
+        """(flight, is_leader). The first caller for a key leads and
+        MUST later call resolve_flight or fail_flight (core does so in
+        its success/except paths); everyone else follows."""
+        with self._lock:
+            flight = self._flights.get(key)
+            if flight is not None:
+                return flight, False
+            flight = Flight()
+            self._flights[key] = flight
+            return flight, True
+
+    def _close_flight(self, key: bytes, flight: Flight) -> None:
+        with self._lock:
+            if self._flights.get(key) is flight:
+                del self._flights[key]
+
+    def resolve_flight(self, key: bytes, flight: Flight,
+                       response: pb.ModelInferResponse) -> None:
+        flight.response = response
+        self._close_flight(key, flight)
+        flight.event.set()
+
+    def fail_flight(self, key: bytes, flight: Flight) -> None:
+        """Leader failed: wake followers with nothing — each falls back
+        to its own execution (one failure must not fan out to the whole
+        coalesced burst)."""
+        flight.failed = True
+        self._close_flight(key, flight)
+        flight.event.set()
+
+    def record_coalesced(self, model: str) -> None:
+        with self._lock:
+            self._model_stats(model).coalesced += 1
+
+    # -- invalidation ----------------------------------------------------
+
+    def invalidate_model(self, model: str) -> int:
+        """Drops every entry for ``model`` (reload/unload: a new
+        instance may produce different bytes for the same inputs)."""
+        with self._lock:
+            doomed = [k for k, e in self._entries.items()
+                      if e.model == model]
+            for key in doomed:
+                entry = self._entries.pop(key)
+                self._bytes -= entry.nbytes
+            stats = self._per_model.get(model)
+            if stats is not None:
+                stats.entries = 0
+                stats.bytes = 0
+            return len(doomed)
+
+    # -- observability ---------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Dict[str, int]]:
+        """Per-model gauge/counter snapshot for /metrics: {model:
+        {entries, bytes, evictions, coalesced, insert_skipped}}."""
+        with self._lock:
+            return {
+                model: {
+                    "entries": s.entries,
+                    "bytes": s.bytes,
+                    "evictions": s.evictions,
+                    "coalesced": s.coalesced,
+                    "insert_skipped": s.insert_skipped,
+                }
+                for model, s in self._per_model.items()
+            }
+
+    def total_bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def total_entries(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+def wants_response_cache(model) -> bool:
+    """Per-model opt-in (``response_cache.enable`` in ModelConfig);
+    decoupled models never cache (zero-or-many responses)."""
+    return (
+        bool(getattr(model, "response_cache", False))
+        and not getattr(model, "decoupled", False)
+    )

@@ -18,6 +18,12 @@ import numpy as np
 
 from client_tpu.protocol import inference_pb2 as pb
 from client_tpu.server import chaos
+from client_tpu.server.cache import (
+    DEFAULT_CACHE_BYTES,
+    ResponseCache,
+    request_cache_key,
+    wants_response_cache,
+)
 from client_tpu.server.memory import SharedMemoryManager
 from client_tpu.server.model import ServedModel
 from client_tpu.server.repository import ModelRepository
@@ -74,10 +80,23 @@ class _ModelStats:
         # stats hook: executed batch size -> [executions, compute_ns,
         # fetch_ns] (renders as ModelStatistics.batch_stats).
         self.batch_hist: Dict[int, list] = {}
+        # Response-cache path counters (ModelStatistics.cache_*): hits
+        # — direct lookups AND single-flight followers — never execute
+        # the model, so they count toward inference_count but not
+        # execution_count, and contribute NOTHING to the queue/compute
+        # sections (the perf-harness caveat).
+        self.cache_hit_count = 0
+        self.cache_hit_ns = 0
+        self.cache_miss_count = 0
+        self.cache_miss_ns = 0
 
     def record(self, batch: int, queue_ns: int, ci_ns: int, infer_ns: int,
-               co_ns: int, ok: bool, executions: int = 1):
-        total = queue_ns + ci_ns + infer_ns + co_ns
+               co_ns: int, ok: bool, executions: int = 1,
+               total_ns: Optional[int] = None):
+        # total_ns overrides the component sum for paths whose time
+        # must not land in any queue/compute bucket (cache hits).
+        total = queue_ns + ci_ns + infer_ns + co_ns \
+            if total_ns is None else total_ns
         with self.lock:
             if ok:
                 self.inference_count += batch
@@ -102,6 +121,22 @@ class _ModelStats:
         """Queue-deadline expiry (request dropped before dispatch)."""
         with self.lock:
             self.timeout_count += 1
+
+    def record_cache_hit(self, ns: int):
+        """One request served from the response cache (or coalesced
+        onto an identical in-flight execution). ``ns`` is the
+        end-to-end hit-path duration."""
+        with self.lock:
+            self.cache_hit_count += 1
+            self.cache_hit_ns += ns
+
+    def record_cache_miss(self, ns: int):
+        """One cache-eligible request that had to execute. ``ns`` is
+        the end-to-end miss-path duration (lookup + execute +
+        insert)."""
+        with self.lock:
+            self.cache_miss_count += 1
+            self.cache_miss_ns += ns
 
     def record_batch(self, size: int, compute_ns: int, fetch_ns: int):
         """Dynamic-batcher stats hook: one fused execution at `size`."""
@@ -130,9 +165,18 @@ def _param_value(param: pb.InferParameter):
 
 
 class InferenceServerCore:
-    def __init__(self, repository: ModelRepository, tpu_arena=None):
+    def __init__(self, repository: ModelRepository, tpu_arena=None,
+                 cache_size: Optional[int] = None):
         self.repository = repository
         self.memory = SharedMemoryManager(tpu_arena)
+        # Content-addressed response cache (server-level byte budget;
+        # models opt in via response_cache.enable). 0 disables. The
+        # repository's unload drain path invalidates a model's entries
+        # on reload/unload — a new instance may produce different
+        # bytes for the same inputs.
+        self.response_cache = ResponseCache(
+            DEFAULT_CACHE_BYTES if cache_size is None else cache_size)
+        repository.add_unload_listener(self.response_cache.invalidate_model)
         self._stats: Dict[str, _ModelStats] = {}
         self._stats_lock = threading.Lock()
         self._batchers: Dict[str, object] = {}
@@ -207,7 +251,13 @@ class InferenceServerCore:
                     execution_count=s.execution_count,
                     reject_count=s.rejected_count,
                     timeout_count=s.timeout_count,
+                    cache_hit_count=s.cache_hit_count,
+                    cache_miss_count=s.cache_miss_count,
                 )
+                stat.inference_stats.cache_hit.count = s.cache_hit_count
+                stat.inference_stats.cache_hit.ns = s.cache_hit_ns
+                stat.inference_stats.cache_miss.count = s.cache_miss_count
+                stat.inference_stats.cache_miss.ns = s.cache_miss_ns
                 stat.inference_stats.success.count = s.success_count
                 stat.inference_stats.success.ns = s.success_ns
                 stat.inference_stats.fail.count = s.fail_count
@@ -269,6 +319,7 @@ class InferenceServerCore:
 
         success, failure, count, exec_count, duration = [], [], [], [], []
         fused_hist, rejected, timed_out = [], [], []
+        cache_hits, cache_misses = [], []
         with self._stats_lock:
             stats_snapshot = dict(self._stats)
         for name, s in sorted(stats_snapshot.items()):
@@ -288,6 +339,10 @@ class InferenceServerCore:
                                 % (label, s.rejected_count))
                 timed_out.append("tpu_request_timeout_total%s %d"
                                  % (label, s.timeout_count))
+                cache_hits.append("tpu_cache_hit_total%s %d"
+                                  % (label, s.cache_hit_count))
+                cache_misses.append("tpu_cache_miss_total%s %d"
+                                    % (label, s.cache_miss_count))
                 for size in sorted(s.batch_hist):
                     fused_hist.append(
                         'tpu_batch_fused_total{model="%s",size="%d"} %d'
@@ -310,6 +365,29 @@ class InferenceServerCore:
         family("tpu_request_timeout_total", "counter",
                "Requests expired by their queue deadline before "
                "dispatch", timed_out)
+        family("tpu_cache_hit_total", "counter",
+               "Requests served from the response cache (incl. "
+               "single-flight followers)", cache_hits)
+        family("tpu_cache_miss_total", "counter",
+               "Cache-eligible requests that executed the model",
+               cache_misses)
+
+        size_rows, entry_rows, evict_rows = [], [], []
+        for name, snap in sorted(self.response_cache.snapshot().items()):
+            label = '{model="%s"}' % name
+            size_rows.append("tpu_cache_size_bytes%s %d"
+                             % (label, snap["bytes"]))
+            entry_rows.append("tpu_cache_entries%s %d"
+                              % (label, snap["entries"]))
+            evict_rows.append("tpu_cache_evictions_total%s %d"
+                              % (label, snap["evictions"]))
+        family("tpu_cache_size_bytes", "gauge",
+               "Bytes of cached responses held per model (the server-"
+               "level byte budget is shared across models)", size_rows)
+        family("tpu_cache_entries", "gauge",
+               "Cached responses held per model", entry_rows)
+        family("tpu_cache_evictions_total", "counter",
+               "Responses evicted by the LRU byte budget", evict_rows)
 
         pending_rows, inflight_rows, delay_rows, overlap_rows = \
             [], [], [], []
@@ -676,6 +754,124 @@ class InferenceServerCore:
             # requests fuse their backbone executions.
             model.batcher_resolver = self._batcher_for
         stats = self._stats_for(model.name)
+        cache = self.response_cache
+        if not (cache.enabled and wants_response_cache(model)):
+            return self._infer_executed(model, request, stats)
+        # Cache lookup runs on the WIRE request, before any input
+        # decoding: a hit skips deserialization, queue/batcher, model
+        # execution, and output encoding — it pays only the content
+        # hash, one dict probe, and a proto copy. Sequence requests
+        # and shared-memory I/O yield key=None (bypass).
+        key = request_cache_key(model.name, model.version, request)
+        if key is None:
+            return self._infer_executed(model, request, stats)
+        t_cache = time.monotonic_ns()
+        # Single-flight: the first miss for a key leads and executes;
+        # concurrent identical misses follow — they are served the
+        # leader's response instead of executing N copies of the same
+        # work. A burst of N identical requests runs the model once.
+        # The probe is one atomic step (entry, live flight, or new
+        # leadership) so a leader resolving between a lookup and a
+        # begin cannot hand a late thread a redundant execution.
+        cached, flight, leader = cache.lookup_or_begin(key)
+        if cached is not None:
+            return self._finish_cache_hit(model, request, stats, cached,
+                                          t_cache)
+        if not leader:
+            response = self._await_flight(model, request, stats, cache,
+                                          flight, t_cache)
+            if response is not None:
+                return response
+            # Leader failed: fall back to an independent execution so
+            # one fault never fans out across the coalesced burst.
+            flight = None
+        try:
+            response = self._infer_executed(model, request, stats)
+        except Exception:
+            if flight is not None:
+                cache.fail_flight(key, flight)
+            raise
+        try:
+            # Success only: failed executions are never inserted.
+            cache.insert(model.name, key, response)
+            stats.record_cache_miss(time.monotonic_ns() - t_cache)
+        finally:
+            # Followers are woken no matter what — a failed insert
+            # must never strand the coalesced burst.
+            if flight is not None:
+                cache.resolve_flight(key, flight, response)
+        return response
+
+    def _finish_cache_hit(self, model: ServedModel,
+                          request: pb.ModelInferRequest, stats: _ModelStats,
+                          cached: bytes, t_cache: int
+                          ) -> pb.ModelInferResponse:
+        """Serves a stored response: parse the cached bytes, stamp the
+        requester's id, count an inference (never an execution), keep
+        queue/compute sections untouched (hits bypass them — the perf
+        caveat)."""
+        response = pb.ModelInferResponse()
+        response.ParseFromString(cached)
+        response.id = request.id
+        ns = time.monotonic_ns() - t_cache
+        stats.record_cache_hit(ns)
+        stats.record(self._batch_size(model, request), 0, 0, 0, 0,
+                     ok=True, executions=0, total_ns=ns)
+        return response
+
+    def _await_flight(self, model: ServedModel,
+                      request: pb.ModelInferRequest, stats: _ModelStats,
+                      cache: ResponseCache, flight, t_cache: int
+                      ) -> Optional[pb.ModelInferResponse]:
+        """Follower side of single-flight: wait for the leader's
+        response, bounded by this request's own queue deadline (PR-2
+        semantics: per-request `timeout` when the model allows the
+        override, else default_queue_policy_timeout_us; 0 = wait for
+        the leader — whose own execution is bounded). A model whose
+        timeout_action is DELAY keeps its deadline advisory here too:
+        the follower waits the leader out instead of hard-failing.
+        Returns None when the leader failed (caller executes
+        independently)."""
+        timeout_us = 0
+        if getattr(model, "allow_timeout_override", True) \
+                and "timeout" in request.parameters:
+            try:
+                # Same coercion as the batcher's _timeout_ns_for: HTTP
+                # clients send `timeout` as a string/double parameter.
+                timeout_us = int(
+                    _param_value(request.parameters["timeout"]) or 0)
+            except (TypeError, ValueError):
+                timeout_us = 0
+        if timeout_us <= 0:
+            timeout_us = int(getattr(
+                model, "default_queue_policy_timeout_us", 0))
+        if str(getattr(model, "timeout_action", "REJECT")).upper() \
+                != "REJECT":
+            timeout_us = 0  # DELAY: deadline is advisory, never fatal
+        if not flight.event.wait(
+                timeout_us / 1e6 if timeout_us > 0 else None):
+            stats.record_timeout()
+            stats.record(1, 0, 0, 0,
+                         time.monotonic_ns() - t_cache, ok=False)
+            raise InferenceServerException(
+                "request for model '%s' expired after %d us waiting on "
+                "an identical in-flight request (single-flight)"
+                % (model.name, timeout_us), status="DEADLINE_EXCEEDED")
+        if flight.failed or flight.response is None:
+            return None
+        cache.record_coalesced(model.name)
+        response = pb.ModelInferResponse()
+        response.CopyFrom(flight.response)
+        response.id = request.id
+        ns = time.monotonic_ns() - t_cache
+        stats.record_cache_hit(ns)
+        stats.record(self._batch_size(model, request), 0, 0, 0, 0,
+                     ok=True, executions=0, total_ns=ns)
+        return response
+
+    def _infer_executed(self, model: ServedModel,
+                        request: pb.ModelInferRequest,
+                        stats: _ModelStats) -> pb.ModelInferResponse:
         t0 = time.monotonic_ns()
         queue_ns = 0
         executions = 1

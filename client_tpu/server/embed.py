@@ -102,6 +102,8 @@ def model_statistics_json(name: str = "") -> str:
             "version": m.version,
             "inference_count": m.inference_count,
             "execution_count": m.execution_count,
+            "cache_hit_count": m.cache_hit_count,
+            "cache_miss_count": m.cache_miss_count,
             "inference_stats": {
                 "success": dur(m.inference_stats.success),
                 "fail": dur(m.inference_stats.fail),
@@ -109,6 +111,8 @@ def model_statistics_json(name: str = "") -> str:
                 "compute_input": dur(m.inference_stats.compute_input),
                 "compute_infer": dur(m.inference_stats.compute_infer),
                 "compute_output": dur(m.inference_stats.compute_output),
+                "cache_hit": dur(m.inference_stats.cache_hit),
+                "cache_miss": dur(m.inference_stats.cache_miss),
             },
         }
         for m in stats.model_stats

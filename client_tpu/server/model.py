@@ -93,6 +93,14 @@ class ServedModel:
     # between steps, device-resident on TPU.
     # sequence_preferred_batch_sizes hints the oldest strategy's fused
     # step sizes (falls back to preferred_batch_sizes).
+    # Response cache (client_tpu.server.cache): opt this model into
+    # the server's content-addressed response cache — identical
+    # requests are served the cached encoded response (bypassing
+    # queue/batcher/execution) and concurrent identical misses
+    # coalesce onto one execution (single-flight). The byte budget is
+    # a SERVER-level knob (cache_size); decoupled models and sequence
+    # requests always bypass.
+    response_cache: bool = False
     sequence_batching: bool = False
     sequence_strategy: str = "direct"
     max_candidate_sequences: int = 0
@@ -174,6 +182,8 @@ class ServedModel:
                 dims=spec.shape,
             )
         config.model_transaction_policy.decoupled = self.decoupled
+        if self.response_cache:
+            config.response_cache.enable = True
         if self.dynamic_batching:
             config.dynamic_batching.preferred_batch_size.extend(
                 self.preferred_batch_sizes)

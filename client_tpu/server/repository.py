@@ -27,6 +27,15 @@ class ModelRepository:
         self._state: Dict[str, str] = {}
         self._reason: Dict[str, str] = {}
         self._inflight: Dict[str, int] = {}
+        # Called with the model name after an unload's drain completes
+        # (and before a reload can serve): the response cache hooks in
+        # here so a reloaded instance never serves another instance's
+        # cached bytes.
+        self._unload_listeners: List[Callable[[str], None]] = []
+
+    def add_unload_listener(self, listener: Callable[[str], None]) -> None:
+        with self._lock:
+            self._unload_listeners.append(listener)
 
     def add_factory(self, name: str, factory: Callable[[], ServedModel]) -> None:
         """Make ``name`` loadable on demand without instantiating it."""
@@ -110,6 +119,11 @@ class ModelRepository:
                 "%.1fs drain" % (leaked, timeout))
         if model is not None:
             model.unload()
+        for listener in list(self._unload_listeners):
+            try:
+                listener(name)
+            except Exception:  # noqa: BLE001 — teardown must not raise
+                pass
 
     def unload(self, name: str,
                drain_timeout_s: Optional[float] = None) -> None:

@@ -64,6 +64,13 @@ void AddComposingModel(
   if (!backend->ModelConfigJson(&child, name, "").IsOk()) return;
   try {
     if (child.Has("sequence_batching")) model->composing_sequential = true;
+    if (child.Has("response_cache")) {
+      const json::Value& cache = child["response_cache"];
+      if (cache.IsObject() && cache.Has("enable") &&
+          cache["enable"].AsBool()) {
+        model->composing_cache_enabled = true;
+      }
+    }
     if (child.Has("ensemble_scheduling")) {
       const json::Value& scheduling = child["ensemble_scheduling"];
       if (scheduling.IsObject() && scheduling.Has("step") &&

@@ -56,6 +56,10 @@ struct ParsedModel {
   // though the top model is an ensemble (GetComposingSchedulerType).
   bool composing_sequential = false;
   bool response_cache_enabled = false;
+  // Any composing model of an ensemble enables response caching: the
+  // cache-latency caveat applies to the paired composing stats even
+  // when the top model's config has no response_cache section.
+  bool composing_cache_enabled = false;
 
   const ModelTensor* FindInput(const std::string& name) const;
 };

@@ -182,10 +182,11 @@ int RunRank(PerfAnalyzerParameters& params) {
       &model, &loader, shm_type, params.output_shm_size, arena_url,
       params.batch_size);
 
-  if (model.response_cache_enabled) {
+  if (model.response_cache_enabled || model.composing_cache_enabled) {
     fprintf(stderr,
-            "note: model has response caching enabled; server-side "
-            "queue/compute breakdowns exclude cache hits\n");
+            "note: %s has response caching enabled; server-side "
+            "queue/compute breakdowns exclude cache hits\n",
+            model.response_cache_enabled ? "model" : "a composing model");
   }
 
   std::unique_ptr<SequenceManager> sequence_manager;

@@ -1,0 +1,644 @@
+"""Response-cache tests: content-addressed keying, byte-budgeted LRU
+eviction, hit/miss golden parity e2e over all four client front-ends
+(HTTP/gRPC x sync/aio), single-flight coalescing under concurrency,
+sequence/decoupled bypass, invalidation on model reload, and the
+statistics / Prometheus observability surface."""
+
+import asyncio
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import client_tpu.grpc as grpcclient
+import client_tpu.grpc.aio as grpcclient_aio
+import client_tpu.http as httpclient
+import client_tpu.http.aio as httpclient_aio
+from client_tpu._infer_common import InferInput
+from client_tpu.grpc._utils import InferResult, get_inference_request
+from client_tpu.models.add_sub import AddSub
+from client_tpu.models.simple_extra import SequenceAccumulator
+from client_tpu.protocol import inference_pb2 as pb
+from client_tpu.server.app import build_core, start_grpc_server
+from client_tpu.server.cache import (
+    ResponseCache,
+    request_cache_key,
+    wants_response_cache,
+)
+from client_tpu.server.http_server import start_http_server_thread
+from client_tpu.utils import InferenceServerException
+
+
+# -- helpers ---------------------------------------------------------------
+
+
+def _request(value, model="simple_cache", shape=(1, 16), timeout=None,
+             **kwargs):
+    """Two-input add/sub request whose content is fully determined by
+    ``value`` (INPUT0 = value, INPUT1 = 2*value)."""
+    tensors = []
+    for name, fill in (("INPUT0", value), ("INPUT1", 2 * value)):
+        tensor = InferInput(name, list(shape), "INT32")
+        tensor.set_data_from_numpy(
+            np.full(shape, fill, dtype=np.int32))
+        tensors.append(tensor)
+    return get_inference_request(
+        model_name=model, inputs=tensors, outputs=None, timeout=timeout,
+        **kwargs)
+
+
+def _infer_value(core, value, model="simple_cache", **kwargs):
+    response = core.infer(_request(value, model=model, **kwargs))
+    return int(InferResult(response).as_numpy("OUTPUT0").reshape(-1)[0])
+
+
+def _cache_counters(core, model="simple_cache"):
+    entry = core.model_statistics(model).model_stats[0]
+    return {
+        "inference": int(entry.inference_count),
+        "execution": int(entry.execution_count),
+        "hit": int(entry.cache_hit_count),
+        "miss": int(entry.cache_miss_count),
+        "timeout": int(entry.timeout_count),
+    }
+
+
+class CountingModel(AddSub):
+    """Cache-enabled add/sub (no batcher) that counts real executions
+    and can be slowed down or made to fail."""
+
+    response_cache = True
+
+    def __init__(self, name, delay_s=0.0, fail_first=False):
+        super().__init__(name=name, datatype="INT32", shape=(16,))
+        self.calls = 0
+        self._calls_lock = threading.Lock()
+        self._delay_s = delay_s
+        self._fail_first = fail_first
+
+    def infer(self, inputs, parameters=None):
+        with self._calls_lock:
+            self.calls += 1
+            fail = self._fail_first and self.calls == 1
+        if self._delay_s:
+            time.sleep(self._delay_s)
+        if fail:
+            raise InferenceServerException(
+                "injected leader failure", status="INTERNAL")
+        return super().infer(inputs, parameters)
+
+
+# -- keying rules (unit) ---------------------------------------------------
+
+
+def test_cache_key_content_addressing():
+    a = request_cache_key("m", "1", _request(3))
+    b = request_cache_key("m", "1", _request(3))
+    assert a == b
+    assert request_cache_key("m", "1", _request(4)) != a
+    assert request_cache_key("other", "1", _request(3)) != a
+    assert request_cache_key("m", "2", _request(3)) != a
+    # request id and QoS params are NOT part of the content address
+    tagged = _request(3)
+    tagged.id = "req-77"
+    assert request_cache_key("m", "1", tagged) == a
+    assert request_cache_key("m", "1", _request(3, timeout=5000)) == a
+    # a response-shaping param IS part of it
+    named = _request(3, parameters={"custom": 1})
+    assert request_cache_key("m", "1", named) != a
+
+
+def test_cache_key_bypasses():
+    # correlated (stateful) requests never cache
+    assert request_cache_key(
+        "m", "1", _request(3, sequence_id=7, sequence_start=True)) is None
+    # shared-memory input regions are not content-addressable
+    shm = _request(3)
+    shm.inputs[0].parameters["shared_memory_region"].string_param = "r0"
+    assert request_cache_key("m", "1", shm) is None
+    # shm outputs need per-request side effects
+    out = _request(3)
+    tensor = out.outputs.add()
+    tensor.name = "OUTPUT0"
+    tensor.parameters["shared_memory_region"].string_param = "r1"
+    assert request_cache_key("m", "1", out) is None
+
+
+def test_wants_response_cache_rules():
+    model = AddSub(name="x")
+    assert not wants_response_cache(model)
+    model.response_cache = True
+    assert wants_response_cache(model)
+    model.decoupled = True  # decoupled models never cache
+    assert not wants_response_cache(model)
+
+
+# -- LRU / byte budget (unit) ---------------------------------------------
+
+
+def _response(size, marker=0):
+    response = pb.ModelInferResponse(model_name="m")
+    response.raw_output_contents.append(bytes([marker % 256]) * size)
+    return response
+
+
+def test_lru_eviction_under_byte_budget():
+    cache = ResponseCache(max_bytes=1500)
+    keys = [("k%d" % i).encode() for i in range(5)]
+    for i, key in enumerate(keys):
+        assert cache.insert("m", key, _response(300, i))
+    # ~310 payload + 128 overhead bytes/entry: only the 3 most recent
+    # survive the 1500-byte budget
+    assert cache.lookup(keys[0]) is None
+    assert cache.lookup(keys[1]) is None
+    assert cache.total_bytes() <= 1500
+    snap = cache.snapshot()["m"]
+    assert snap["entries"] == cache.total_entries() == 3
+    assert snap["evictions"] == 2
+    # a lookup refreshes recency: keys[2] survives the next insert
+    assert cache.lookup(keys[2]) is not None
+    cache.insert("m", b"fresh", _response(300))
+    assert cache.lookup(keys[2]) is not None
+    assert cache.lookup(keys[3]) is None  # the new LRU victim
+
+
+def test_oversized_response_never_cached():
+    cache = ResponseCache(max_bytes=100)
+    assert not cache.insert("m", b"big", _response(500))
+    assert cache.total_entries() == 0
+    assert cache.snapshot()["m"]["insert_skipped"] == 1
+
+
+def test_insert_serializes_and_clears_id():
+    cache = ResponseCache(max_bytes=1000)
+    response = _response(10)
+    response.id = "caller-id"
+    cache.insert("m", b"k", response)
+    response.raw_output_contents[0] = b"mutated!"
+    stored = pb.ModelInferResponse()
+    stored.ParseFromString(cache.lookup(b"k"))
+    assert stored.id == ""  # hits are re-stamped per requester
+    assert stored.raw_output_contents[0] != b"mutated!"
+
+
+def test_lookup_or_begin_is_atomic_after_resolution():
+    """A thread whose plain lookup missed must NOT become a second
+    leader once the first leader has inserted+resolved — the atomic
+    probe returns the entry instead."""
+    cache = ResponseCache(max_bytes=10_000)
+    _, flight, leader = cache.lookup_or_begin(b"k")
+    assert leader
+    cache.insert("m", b"k", _response(10))
+    cache.resolve_flight(b"k", flight, _response(10))
+    cached, late_flight, late_leader = cache.lookup_or_begin(b"k")
+    assert cached is not None
+    assert late_flight is None and not late_leader
+
+
+def test_invalidate_model_drops_only_its_entries():
+    cache = ResponseCache(max_bytes=10_000)
+    cache.insert("a", b"ka", _response(50))
+    cache.insert("b", b"kb", _response(50))
+    assert cache.invalidate_model("a") == 1
+    assert cache.lookup(b"ka") is None
+    assert cache.lookup(b"kb") is not None
+    assert cache.snapshot()["a"]["entries"] == 0
+
+
+# -- core hit/miss behavior ------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def core():
+    core = build_core(["simple_cache"], warmup=False)
+    yield core
+    core.shutdown()
+
+
+def test_hit_miss_golden_parity(core):
+    before = _cache_counters(core)
+    first = core.infer(_request(21))
+    second = core.infer(_request(21))
+    for name in ("OUTPUT0", "OUTPUT1"):
+        np.testing.assert_array_equal(
+            InferResult(first).as_numpy(name),
+            InferResult(second).as_numpy(name))
+    assert int(InferResult(second).as_numpy("OUTPUT0")[0, 0]) == 63
+    after = _cache_counters(core)
+    # Triton semantics: the hit counts toward inference_count but the
+    # model executed once.
+    assert after["inference"] - before["inference"] == 2
+    assert after["execution"] - before["execution"] == 1
+    assert after["hit"] - before["hit"] == 1
+    assert after["miss"] - before["miss"] == 1
+
+
+def test_hit_carries_requester_id(core):
+    core.infer(_request(22))
+    request = _request(22)
+    request.id = "my-request"
+    response = core.infer(request)
+    assert response.id == "my-request"
+
+
+def test_distinct_content_always_misses(core):
+    before = _cache_counters(core)
+    for value in range(300, 305):
+        _infer_value(core, value)
+    after = _cache_counters(core)
+    assert after["miss"] - before["miss"] == 5
+    assert after["hit"] == before["hit"]
+
+
+def test_hit_duration_stats_rendered(core):
+    core.infer(_request(23))
+    core.infer(_request(23))
+    entry = core.model_statistics("simple_cache").model_stats[0]
+    stats = entry.inference_stats
+    assert stats.cache_hit.count == entry.cache_hit_count > 0
+    assert stats.cache_hit.ns > 0
+    assert stats.cache_miss.count == entry.cache_miss_count > 0
+    assert stats.cache_miss.ns > stats.cache_hit.ns / max(
+        stats.cache_hit.count, 1)  # misses executed, hits did not
+
+
+# -- single-flight deduplication -------------------------------------------
+
+
+def test_single_flight_coalesces_concurrent_misses():
+    core = build_core([], warmup=False)
+    model = CountingModel("sf_model", delay_s=0.15)
+    core.repository.add_model(model)
+    barrier = threading.Barrier(6)
+    results = []
+    lock = threading.Lock()
+
+    def worker():
+        barrier.wait()
+        value = _infer_value(core, 9, model="sf_model", shape=(16,))
+        with lock:
+            results.append(value)
+
+    threads = [threading.Thread(target=worker) for _ in range(6)]
+    for thread in threads:
+        thread.start()
+    for thread in threads:
+        thread.join()
+    assert results == [27] * 6
+    assert model.calls == 1  # one leader executed; followers coalesced
+    counters = _cache_counters(core, "sf_model")
+    assert counters["miss"] == 1
+    assert counters["hit"] == 5
+    assert counters["execution"] == 1
+    assert core.response_cache.snapshot()["sf_model"]["coalesced"] == 5
+    core.shutdown()
+
+
+def test_follower_deadline_bounds_the_wait():
+    core = build_core([], warmup=False)
+    model = CountingModel("slow_model", delay_s=0.6)
+    core.repository.add_model(model)
+    leader_done = []
+
+    def leader():
+        leader_done.append(
+            _infer_value(core, 4, model="slow_model", shape=(16,)))
+
+    leader_thread = threading.Thread(target=leader)
+    leader_thread.start()
+    time.sleep(0.1)  # the leader is now executing
+    t0 = time.monotonic()
+    with pytest.raises(InferenceServerException) as exc:
+        _infer_value(core, 4, model="slow_model", shape=(16,),
+                     timeout=100_000)  # 100 ms deadline, 600 ms leader
+    assert exc.value.status() == "DEADLINE_EXCEEDED"
+    assert time.monotonic() - t0 < 0.5  # expired before the leader
+    leader_thread.join()
+    assert leader_done == [12]
+    assert _cache_counters(core, "slow_model")["timeout"] == 1
+    core.shutdown()
+
+
+def test_follower_delay_action_keeps_deadline_advisory():
+    """timeout_action=DELAY (PR-2): the queue deadline never hard-fails
+    a request — a coalesced follower must wait the leader out instead
+    of raising DEADLINE_EXCEEDED."""
+    core = build_core([], warmup=False)
+    model = CountingModel("delay_model", delay_s=0.3)
+    model.default_queue_policy_timeout_us = 50_000  # << leader's 300ms
+    model.timeout_action = "DELAY"
+    core.repository.add_model(model)
+    barrier = threading.Barrier(2)
+    results = []
+    lock = threading.Lock()
+
+    def worker():
+        barrier.wait()
+        value = _infer_value(core, 3, model="delay_model", shape=(16,))
+        with lock:
+            results.append(value)
+
+    threads = [threading.Thread(target=worker) for _ in range(2)]
+    for thread in threads:
+        thread.start()
+    for thread in threads:
+        thread.join()
+    assert results == [9, 9]
+    assert model.calls == 1  # the follower coalesced, never expired
+    assert _cache_counters(core, "delay_model")["timeout"] == 0
+    core.shutdown()
+
+
+def test_follower_deadline_accepts_string_timeout():
+    """HTTP clients send `timeout` as a string parameter; the follower
+    wait must honor it like the batcher does (same coercion)."""
+    core = build_core([], warmup=False)
+    model = CountingModel("strto_model", delay_s=0.6)
+    core.repository.add_model(model)
+    leader = threading.Thread(
+        target=lambda: _infer_value(core, 4, model="strto_model",
+                                    shape=(16,)))
+    leader.start()
+    time.sleep(0.1)
+    follower_request = _request(4, model="strto_model", shape=(16,))
+    follower_request.parameters["timeout"].string_param = "100000"
+    t0 = time.monotonic()
+    with pytest.raises(InferenceServerException) as exc:
+        core.infer(follower_request)
+    assert exc.value.status() == "DEADLINE_EXCEEDED"
+    assert time.monotonic() - t0 < 0.5
+    leader.join()
+    core.shutdown()
+
+
+def test_leader_failure_falls_back_not_fans_out():
+    core = build_core([], warmup=False)
+    model = CountingModel("flaky_model", delay_s=0.15, fail_first=True)
+    core.repository.add_model(model)
+    barrier = threading.Barrier(4)
+    outcomes = []
+    lock = threading.Lock()
+
+    def worker():
+        barrier.wait()
+        try:
+            value = _infer_value(core, 6, model="flaky_model", shape=(16,))
+            with lock:
+                outcomes.append(value)
+        except InferenceServerException as e:
+            with lock:
+                outcomes.append(e.status())
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for thread in threads:
+        thread.start()
+    for thread in threads:
+        thread.join()
+    # Exactly the leader fails; followers fall back to their own
+    # executions instead of inheriting the failure.
+    assert outcomes.count("INTERNAL") == 1
+    assert outcomes.count(18) == 3
+    assert model.calls == 4  # 1 failed leader + 3 independent fallbacks
+    # the failure was never inserted: the cached entry (from a
+    # fallback success) serves the next request
+    assert _infer_value(core, 6, model="flaky_model", shape=(16,)) == 18
+    assert model.calls == 4
+    core.shutdown()
+
+
+def test_failed_execution_not_inserted():
+    core = build_core([], warmup=False)
+    model = CountingModel("fail_model", fail_first=True)
+    core.repository.add_model(model)
+    with pytest.raises(InferenceServerException):
+        _infer_value(core, 5, model="fail_model", shape=(16,))
+    assert core.response_cache.snapshot().get(
+        "fail_model", {}).get("entries", 0) == 0
+    # the same request executes again (no poisoned entry) and succeeds
+    assert _infer_value(core, 5, model="fail_model", shape=(16,)) == 15
+    assert model.calls == 2
+    core.shutdown()
+
+
+# -- bypass rules ----------------------------------------------------------
+
+
+def test_sequence_requests_bypass_cache():
+    core = build_core([], warmup=False)
+    model = SequenceAccumulator(name="seq_cache")
+    model.response_cache = True  # even opted in, sequences bypass
+    core.repository.add_model(model)
+
+    def step(value, start=False, end=False):
+        tensor = InferInput("INPUT", [1], "INT32")
+        tensor.set_data_from_numpy(np.array([value], dtype=np.int32))
+        request = get_inference_request(
+            model_name="seq_cache", inputs=[tensor], outputs=None,
+            sequence_id=31, sequence_start=start, sequence_end=end)
+        return int(InferResult(core.infer(request))
+                   .as_numpy("OUTPUT").reshape(-1)[0])
+
+    # identical step payloads MUST produce different (accumulated)
+    # results — a cached response would repeat the first
+    assert step(2, start=True) == 2
+    assert step(2) == 4
+    assert step(2, end=True) == 6
+    counters = _cache_counters(core, "seq_cache")
+    assert counters["hit"] == 0 and counters["miss"] == 0
+    core.shutdown()
+
+
+def test_invalidation_on_unload_reload(core):
+    assert _infer_value(core, 41) == 123
+    assert _infer_value(core, 41) == 123
+    before = _cache_counters(core)
+    assert core.response_cache.snapshot()["simple_cache"]["entries"] > 0
+    core.unload_model("simple_cache")
+    assert core.response_cache.snapshot()["simple_cache"]["entries"] == 0
+    core.load_model("simple_cache")
+    assert _infer_value(core, 41) == 123
+    after = _cache_counters(core)
+    assert after["miss"] - before["miss"] == 1  # cold again post-reload
+
+
+# -- observability ---------------------------------------------------------
+
+
+def test_prometheus_cache_families(core):
+    core.infer(_request(51))
+    core.infer(_request(51))
+    text = core.metrics_text()
+    for family in ("tpu_cache_hit_total", "tpu_cache_miss_total",
+                   "tpu_cache_size_bytes", "tpu_cache_entries",
+                   "tpu_cache_evictions_total"):
+        assert family in text, family
+    from client_tpu.perf.metrics_manager import (
+        parse_prometheus,
+        summarize_metrics,
+    )
+
+    snap = parse_prometheus(text)
+    assert snap.cache_hit_total["simple_cache"] >= 1
+    assert snap.cache_entries["simple_cache"] >= 1
+    assert snap.cache_size_bytes["simple_cache"] > 0
+    # gauge-aware window deltas: counters difference first->last
+    later = parse_prometheus(core.metrics_text())
+    later.cache_hit_total["simple_cache"] += 3
+    summary = summarize_metrics([snap, later])
+    assert summary["cache_hit_total"]["delta"] == 3
+    assert summary["cache_entries"]["avg"] >= 1
+
+
+def test_eviction_end_to_end_under_tight_budget():
+    core = build_core([], warmup=False, cache_size=600)
+    model = CountingModel("tiny_cache")
+    core.repository.add_model(model)
+    for value in range(60, 70):
+        _infer_value(core, value, model="tiny_cache", shape=(16,))
+    snap = core.response_cache.snapshot()["tiny_cache"]
+    assert snap["evictions"] > 0
+    assert core.response_cache.total_bytes() <= 600
+    assert "tpu_cache_evictions_total{model=\"tiny_cache\"} %d" \
+        % snap["evictions"] in core.metrics_text()
+    core.shutdown()
+
+
+def test_cache_size_zero_disables():
+    core = build_core([], warmup=False, cache_size=0)
+    model = CountingModel("nocache_model")
+    core.repository.add_model(model)
+    assert _infer_value(core, 8, model="nocache_model", shape=(16,)) == 24
+    assert _infer_value(core, 8, model="nocache_model", shape=(16,)) == 24
+    assert model.calls == 2  # every request executed
+    counters = _cache_counters(core, "nocache_model")
+    assert counters["hit"] == 0 and counters["miss"] == 0
+    core.shutdown()
+
+
+# -- e2e over all four client front-ends -----------------------------------
+
+
+@pytest.fixture(scope="module")
+def servers(core):
+    grpc_handle = start_grpc_server(core=core)
+    http_runner = start_http_server_thread(core, host="127.0.0.1", port=0)
+    yield grpc_handle, http_runner
+    http_runner.stop()
+    grpc_handle.stop()
+
+
+def _client_inputs(value, cls):
+    tensors = []
+    for name, fill in (("INPUT0", value), ("INPUT1", 2 * value)):
+        tensor = cls(name, [1, 16], "INT32")
+        tensor.set_data_from_numpy(np.full((1, 16), fill, dtype=np.int32))
+        tensors.append(tensor)
+    return tensors
+
+
+def _assert_parity(first, second, value):
+    np.testing.assert_array_equal(first.as_numpy("OUTPUT0"),
+                                  second.as_numpy("OUTPUT0"))
+    np.testing.assert_array_equal(first.as_numpy("OUTPUT1"),
+                                  second.as_numpy("OUTPUT1"))
+    assert int(first.as_numpy("OUTPUT0")[0, 0]) == 3 * value
+    assert int(first.as_numpy("OUTPUT1")[0, 0]) == -value
+
+
+def test_grpc_hit_miss_parity(servers):
+    grpc_handle, _ = servers
+    with grpcclient.InferenceServerClient(grpc_handle.address) as client:
+        inputs = _client_inputs(71, grpcclient.InferInput)
+        first = client.infer("simple_cache", inputs)
+        second = client.infer("simple_cache", inputs)
+        _assert_parity(first, second, 71)
+        stats = client.get_inference_statistics("simple_cache")
+        entry = stats.model_stats[0]
+        assert entry.cache_hit_count >= 1
+        assert entry.cache_miss_count >= 1
+
+
+def test_http_hit_miss_parity(servers):
+    _, http_runner = servers
+    with httpclient.InferenceServerClient(
+            "127.0.0.1:%d" % http_runner.port) as client:
+        inputs = _client_inputs(72, httpclient.InferInput)
+        first = client.infer("simple_cache", inputs)
+        second = client.infer("simple_cache", inputs)
+        _assert_parity(first, second, 72)
+        stats = client.get_inference_statistics("simple_cache")
+        entry = stats["model_stats"][0]
+        assert int(entry["cache_hit_count"]) >= 1
+        assert int(entry["cache_miss_count"]) >= 1
+        assert int(entry["inference_stats"]["cache_hit"]["count"]) >= 1
+
+
+def test_grpc_aio_hit_miss_parity(servers):
+    grpc_handle, _ = servers
+
+    async def run():
+        client = grpcclient_aio.InferenceServerClient(grpc_handle.address)
+        try:
+            inputs = _client_inputs(73, grpcclient_aio.InferInput)
+            first = await client.infer("simple_cache", inputs)
+            second = await client.infer("simple_cache", inputs)
+            _assert_parity(first, second, 73)
+            stats = await client.get_inference_statistics("simple_cache")
+            assert stats.model_stats[0].cache_hit_count >= 1
+        finally:
+            await client.close()
+
+    asyncio.run(run())
+
+
+def test_http_aio_hit_miss_parity(servers):
+    _, http_runner = servers
+
+    async def run():
+        client = httpclient_aio.InferenceServerClient(
+            "127.0.0.1:%d" % http_runner.port)
+        try:
+            inputs = _client_inputs(74, httpclient_aio.InferInput)
+            first = await client.infer("simple_cache", inputs)
+            second = await client.infer("simple_cache", inputs)
+            _assert_parity(first, second, 74)
+            stats = await client.get_inference_statistics("simple_cache")
+            assert int(stats["model_stats"][0]["cache_hit_count"]) >= 1
+        finally:
+            await client.close()
+
+    asyncio.run(run())
+
+
+def test_config_renders_response_cache_both_transports(servers):
+    grpc_handle, http_runner = servers
+    with grpcclient.InferenceServerClient(grpc_handle.address) as client:
+        config = client.get_model_config("simple_cache", as_json=True)
+        config = config.get("config", config)
+        assert config["response_cache"]["enable"] is True
+    with httpclient.InferenceServerClient(
+            "127.0.0.1:%d" % http_runner.port) as client:
+        config = client.get_model_config("simple_cache")
+        assert config["response_cache"]["enable"] is True
+
+
+def test_perf_parser_composing_cache_caveat():
+    """Satellite: the ensemble caveat — a top model with NO cache whose
+    composing model enables it must still flip the caveat flag."""
+    from client_tpu.perf.client_backend import MockBackend
+    from client_tpu.perf.model_parser import ModelParser
+
+    backend = MockBackend(
+        model_config_dict={
+            "name": "ens",
+            "ensemble_scheduling": {"step": [{"model_name": "backbone"}]},
+        },
+        model_configs={
+            "backbone": {"max_batch_size": 4,
+                         "response_cache": {"enable": True}},
+        },
+    )
+    model = ModelParser().parse(backend, "ens")
+    assert not model.response_cache_enabled
+    assert model.composing_cache_enabled

@@ -1,0 +1,63 @@
+"""Response-cache smoke gate for tools/ci_check.sh.
+
+Runs the bench harness's hot-set replay measurement
+(client_tpu.perf.bench_child.run_cache_measure) against an in-process
+core serving ``simple_cache`` (the `simple` add/sub model with
+response_cache.enable + a dynamic batcher) and gates on:
+
+* the replayed hot set reaches a 100% hit ratio,
+* hit-path p50 is well under miss-path p50 (< 1/2), and
+* a concurrent identical-request burst executes the model exactly
+  once (single-flight deduplication).
+
+Usage: JAX_PLATFORMS=cpu python tools/cache_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+
+def main() -> int:
+    from client_tpu.server.app import build_core
+    from client_tpu.perf.bench_child import run_cache_measure
+
+    core = build_core(["simple_cache"], warmup=False)
+    try:
+        result = run_cache_measure(core, warm_s=1.5, unique=512)
+    finally:
+        core.shutdown()
+    print(json.dumps(result, indent=1))
+
+    failures = []
+    if result.get("warm_hit_ratio") != 1.0:
+        failures.append("replayed hot set did not reach 100%% hit ratio "
+                        "(got %s)" % result.get("warm_hit_ratio"))
+    hit_p50 = result.get("warm_hit_p50_us", 0.0)
+    miss_p50 = result.get("cold_miss_p50_us", 0.0)
+    if not (0 < hit_p50 * 2 < miss_p50):
+        failures.append("hit-path p50 (%.0f us) is not well under "
+                        "miss-path p50 (%.0f us)" % (hit_p50, miss_p50))
+    if result.get("singleflight_executions") != 1:
+        failures.append("identical-request burst executed the model %s "
+                        "times (single-flight wants exactly 1)"
+                        % result.get("singleflight_executions"))
+    for failure in failures:
+        print("FAIL: %s" % failure, file=sys.stderr)
+    if failures:
+        return 1
+    print("cache smoke passed: %.1f%% hit ratio, hit p50 %.0f us vs "
+          "miss p50 %.0f us (%.1fx tput), single-flight 1 execution"
+          % (result.get("warm_hit_ratio", 0.0) * 100.0, hit_p50,
+             miss_p50, result.get("warm_vs_cold_speedup", 0.0)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,7 +6,7 @@
 # regression gate). Usage: tools/ci_check.sh [min_passed]
 set -u -o pipefail
 
-MIN_PASSED="${1:-399}"
+MIN_PASSED="${1:-428}"
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
 LOG=/tmp/_t1.log
 
@@ -100,4 +100,19 @@ if ! grep -q "client-visible errors: 0 of" "$FO_LOG"; then
 fi
 grep -E "Failover summary|client-visible|failovers|ejections" "$FO_LOG"
 echo "OK: failover smoke passed (100% goodput through an endpoint kill)"
+
+# Cache smoke: hot-set replay against simple_cache — the replayed set
+# must reach a 100% hit ratio with hit-path p50 well under miss-path
+# p50, and an identical-request burst must execute the model exactly
+# once (single-flight dedup). Gates live in tools/cache_smoke.py.
+echo "cache smoke: simple_cache hot-set replay + single-flight burst"
+CACHE_LOG=/tmp/_cache_smoke.log
+if ! timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/cache_smoke.py \
+    > "$CACHE_LOG" 2>&1; then
+    echo "FAIL: cache smoke did not pass" >&2
+    tail -20 "$CACHE_LOG" >&2
+    exit 1
+fi
+grep -E "cache smoke passed" "$CACHE_LOG"
+echo "OK: cache smoke passed"
 exit 0

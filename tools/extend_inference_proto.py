@@ -12,16 +12,19 @@ Patches applied:
 * inference_pb2.py — ``BatchPipelineStatistics`` +
   ``ModelStatistics.pipeline_stats`` (PR 1), the queue-policy drop
   counters ``ModelStatistics.reject_count`` /
-  ``ModelStatistics.timeout_count`` (PR 2), and
+  ``ModelStatistics.timeout_count`` (PR 2),
   ``SequenceBatchingStatistics`` + ``ModelStatistics.sequence_stats``
-  (PR 3 sequence scheduler).
+  (PR 3 sequence scheduler), and the response-cache statistics (PR 5):
+  ``ModelStatistics.cache_hit_count`` / ``cache_miss_count`` plus the
+  ``InferStatistics.cache_hit`` / ``cache_miss`` durations.
 * model_config_pb2.py — ``DynamicBatchingConfig.max_queue_size`` /
   ``allow_timeout_override`` / ``timeout_action`` (PR 2 queue policy;
   ``default_queue_policy_timeout_us`` has been in the schema since the
-  seed), and the full sequence-batching schema (PR 3):
+  seed), the full sequence-batching schema (PR 3):
   ``SequenceControlInput`` / ``SequenceStateConfig`` messages plus
   ``SequenceBatchingConfig.strategy`` / ``control_input`` / ``state`` /
-  ``preferred_batch_size``.
+  ``preferred_batch_size``, and the ``ResponseCacheConfig`` message +
+  ``ModelConfig.response_cache`` (PR 5 response cache).
 
 The ``_serialized_start/_serialized_end`` attribute lines at the bottom
 of the pb2 modules go stale after the patch; they only execute when
@@ -68,6 +71,19 @@ PIPELINE_FIELDS = [
 STATISTICS_FIELDS = [
     ("reject_count", 9, U64),
     ("timeout_count", 10, U64),
+]
+
+# Response-cache counters on ModelStatistics (11 is sequence_stats).
+CACHE_COUNT_FIELDS = [
+    ("cache_hit_count", 12, U64),
+    ("cache_miss_count", 13, U64),
+]
+
+# Response-cache path durations on InferStatistics (1..6 are the
+# Triton-parity sections present since the seed).
+CACHE_DURATION_FIELDS = [
+    ("cache_hit", 7),
+    ("cache_miss", 8),
 ]
 
 # Queue-policy knobs on DynamicBatchingConfig (field 3 is
@@ -162,6 +178,20 @@ def patch_inference(file_proto: descriptor_pb2.FileDescriptorProto) -> bool:
             type_name=".inference.SequenceBatchingStatistics",
             json_name="sequenceStats")
         changed = True
+    for name, number, ftype in CACHE_COUNT_FIELDS:
+        if not any(f.name == name for f in model_stats.field):
+            model_stats.field.add(name=name, number=number, type=ftype,
+                                  label=OPTIONAL, json_name=_json_name(name))
+            changed = True
+    infer_stats = next(
+        m for m in file_proto.message_type if m.name == "InferStatistics")
+    for name, number in CACHE_DURATION_FIELDS:
+        if not any(f.name == name for f in infer_stats.field):
+            infer_stats.field.add(
+                name=name, number=number, type=MESSAGE, label=OPTIONAL,
+                type_name=".inference.StatisticDuration",
+                json_name=_json_name(name))
+            changed = True
     return changed
 
 
@@ -203,6 +233,22 @@ def patch_model_config(file_proto: descriptor_pb2.FileDescriptorProto) -> bool:
                                    label=label, json_name=_json_name(name))
         if type_name:
             field.type_name = type_name
+        changed = True
+    names = [m.name for m in file_proto.message_type]
+    if "ResponseCacheConfig" not in names:
+        anchor = names.index("EnsembleStepConfig")
+        message = descriptor_pb2.DescriptorProto(name="ResponseCacheConfig")
+        message.field.add(name="enable", number=1, type=BOOL,
+                          label=OPTIONAL, json_name="enable")
+        file_proto.message_type.insert(anchor, message)
+        changed = True
+    model_config = next(
+        m for m in file_proto.message_type if m.name == "ModelConfig")
+    if not any(f.name == "response_cache" for f in model_config.field):
+        model_config.field.add(
+            name="response_cache", number=15, type=MESSAGE, label=OPTIONAL,
+            type_name=".inference.ResponseCacheConfig",
+            json_name="responseCache")
         changed = True
     return changed
 
