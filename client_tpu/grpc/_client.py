@@ -210,6 +210,12 @@ class InferenceServerClient(InferenceServerClientBase):
     endpoints. Streams stay pinned to the primary endpoint. With a
     pool, ``circuit_breaker`` is ignored — health is per endpoint,
     owned by the pool.
+
+    ``tracer`` (:class:`client_tpu.tracing.ClientTracer`) records a
+    client-side span per ``infer`` and propagates its W3C
+    ``traceparent`` as gRPC metadata so the server's sampled span tree
+    joins the client's trace; a caller-supplied ``traceparent`` in
+    ``headers`` wins over the generated one.
     """
 
     def __init__(
@@ -226,6 +232,7 @@ class InferenceServerClient(InferenceServerClientBase):
         retry_policy=None,
         circuit_breaker=None,
         endpoint_pool=None,
+        tracer=None,
     ):
         super().__init__()
         from client_tpu.robust import EndpointPool
@@ -263,6 +270,7 @@ class InferenceServerClient(InferenceServerClientBase):
         self._channel = self._channels[urls[0]]
         self._client_stub = self._stubs[urls[0]]
         self._stream: Optional[_InferStream] = None
+        self._tracer = tracer
         if self._endpoint_pool is not None:
             timeout = self._endpoint_pool.probe_timeout_s
             self._endpoint_pool.ensure_prober(
@@ -650,6 +658,12 @@ class InferenceServerClient(InferenceServerClientBase):
             timeout=timeout,
             parameters=parameters,
         )
+        client_span = None
+        if self._tracer is not None:
+            client_span = self._tracer.start_span(
+                "client_infer", model_name, request_id, headers)
+            client_span.attrs["transport"] = "grpc"
+            headers = client_span.inject(headers)
         metadata = self._metadata(headers)
         compression = _grpc_compression(compression_algorithm)
 
@@ -667,24 +681,35 @@ class InferenceServerClient(InferenceServerClientBase):
             except grpc.RpcError as e:
                 raise_error_grpc(e)
 
-        if self._endpoint_pool is not None:
-            from client_tpu.robust import call_with_retry_pool
+        def _issue() -> InferResult:
+            if self._endpoint_pool is not None:
+                from client_tpu.robust import call_with_retry_pool
 
-            return call_with_retry_pool(
-                lambda state, remaining: _call(self._stubs[state.url],
-                                               remaining),
-                self._endpoint_pool, self._retry_policy,
-                deadline_s=client_timeout, sequence_id=sequence_id,
-                sequence_end=sequence_end,
+                return call_with_retry_pool(
+                    lambda state, remaining: _call(self._stubs[state.url],
+                                                   remaining),
+                    self._endpoint_pool, self._retry_policy,
+                    deadline_s=client_timeout, sequence_id=sequence_id,
+                    sequence_end=sequence_end,
+                )
+
+            from client_tpu.robust import call_with_retry
+
+            return call_with_retry(
+                lambda remaining: _call(self._client_stub, remaining),
+                self._retry_policy, self._breaker,
+                deadline_s=client_timeout,
             )
 
-        from client_tpu.robust import call_with_retry
-
-        return call_with_retry(
-            lambda remaining: _call(self._client_stub, remaining),
-            self._retry_policy, self._breaker,
-            deadline_s=client_timeout,
-        )
+        if client_span is None:
+            return _issue()
+        try:
+            result = _issue()
+        except BaseException as e:
+            client_span.finish(e)
+            raise
+        client_span.finish()
+        return result
 
     def async_infer(
         self,

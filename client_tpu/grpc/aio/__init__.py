@@ -48,7 +48,12 @@ class InferenceServerClient(InferenceServerClientBase):
     tail-slow requests within the pool's budget; a thread-based prober
     (sync channels, off the event loop) readmits ejected endpoints.
     Streams stay pinned to the primary endpoint. With a pool,
-    ``circuit_breaker`` is ignored."""
+    ``circuit_breaker`` is ignored.
+
+    ``tracer`` (:class:`client_tpu.tracing.ClientTracer`) records a
+    client-side span per ``infer`` and propagates its W3C
+    ``traceparent`` as gRPC metadata (caller-supplied traceparent
+    wins)."""
 
     def __init__(
         self,
@@ -64,6 +69,7 @@ class InferenceServerClient(InferenceServerClientBase):
         retry_policy=None,
         circuit_breaker=None,
         endpoint_pool=None,
+        tracer=None,
     ):
         super().__init__()
         from client_tpu.robust import EndpointPool
@@ -99,6 +105,7 @@ class InferenceServerClient(InferenceServerClientBase):
         self._channel = self._channels[urls[0]]
         self._client_stub = self._stubs[urls[0]]
         self._verbose = verbose
+        self._tracer = tracer
         if self._endpoint_pool is not None:
             # The probe is SYNC and self-contained (its own short-lived
             # channel, run on the pool's prober thread): it must never
@@ -365,35 +372,52 @@ class InferenceServerClient(InferenceServerClientBase):
             sequence_start=sequence_start, sequence_end=sequence_end,
             priority=priority, timeout=timeout, parameters=parameters,
         )
+        client_span = None
+        if self._tracer is not None:
+            client_span = self._tracer.start_span(
+                "client_infer", model_name, request_id, headers)
+            client_span.attrs["transport"] = "grpc-aio"
+            headers = client_span.inject(headers)
 
-        if self._endpoint_pool is not None:
-            from client_tpu.robust import call_with_retry_pool_async
+        async def _issue():
+            if self._endpoint_pool is not None:
+                from client_tpu.robust import call_with_retry_pool_async
 
-            async def _pool_attempt(state, remaining):
+                async def _pool_attempt(state, remaining):
+                    response = await self._call(
+                        self._stubs[state.url].ModelInfer, request, headers,
+                        remaining
+                    )
+                    return InferResult(response)
+
+                return await call_with_retry_pool_async(
+                    _pool_attempt, self._endpoint_pool, self._retry_policy,
+                    deadline_s=client_timeout, sequence_id=sequence_id,
+                    sequence_end=sequence_end,
+                )
+
+            async def _attempt(remaining):
                 response = await self._call(
-                    self._stubs[state.url].ModelInfer, request, headers,
-                    remaining
+                    self._client_stub.ModelInfer, request, headers, remaining
                 )
                 return InferResult(response)
 
-            return await call_with_retry_pool_async(
-                _pool_attempt, self._endpoint_pool, self._retry_policy,
-                deadline_s=client_timeout, sequence_id=sequence_id,
-                sequence_end=sequence_end,
+            from client_tpu.robust import call_with_retry_async
+
+            return await call_with_retry_async(
+                _attempt, self._retry_policy, self._breaker,
+                deadline_s=client_timeout,
             )
 
-        async def _attempt(remaining):
-            response = await self._call(
-                self._client_stub.ModelInfer, request, headers, remaining
-            )
-            return InferResult(response)
-
-        from client_tpu.robust import call_with_retry_async
-
-        return await call_with_retry_async(
-            _attempt, self._retry_policy, self._breaker,
-            deadline_s=client_timeout,
-        )
+        if client_span is None:
+            return await _issue()
+        try:
+            result = await _issue()
+        except BaseException as e:
+            client_span.finish(e)
+            raise
+        client_span.finish()
+        return result
 
     async def stream_infer(
         self,

@@ -194,6 +194,12 @@ class InferenceServerClient(InferenceServerClientBase):
     budget, and a background prober readmits ejected endpoints. With a
     pool, ``circuit_breaker`` is ignored — health is per endpoint,
     owned by the pool.
+
+    ``tracer`` (:class:`client_tpu.tracing.ClientTracer`) records a
+    client-side span per ``infer`` and propagates its W3C
+    ``traceparent`` header so the server's sampled span tree joins the
+    client's trace; a caller-supplied ``traceparent`` in ``headers``
+    wins over the generated one.
     """
 
     def __init__(
@@ -208,6 +214,7 @@ class InferenceServerClient(InferenceServerClientBase):
         retry_policy=None,
         circuit_breaker=None,
         endpoint_pool=None,
+        tracer=None,
     ):
         super().__init__()
         from client_tpu.robust import EndpointPool
@@ -234,6 +241,7 @@ class InferenceServerClient(InferenceServerClientBase):
         self._port = self._primary.port
         self._pool = self._primary.pool
         self._executor = ThreadPoolExecutor(max_workers=max(concurrency, 1))
+        self._tracer = tracer
         self._retry_policy = retry_policy
         self._breaker = circuit_breaker if self._endpoint_pool is None \
             else None
@@ -556,6 +564,12 @@ class InferenceServerClient(InferenceServerClientBase):
             parameters=parameters,
         )
         request_headers = dict(headers) if headers else {}
+        client_span = None
+        if self._tracer is not None:
+            client_span = self._tracer.start_span(
+                "client_infer", model_name, request_id, request_headers)
+            client_span.attrs["transport"] = "http"
+            request_headers = client_span.inject(request_headers)
         if json_len is not None:
             request_headers[HEADER_LEN] = str(json_len)
             request_headers["Content-Type"] = "application/octet-stream"
@@ -588,33 +602,45 @@ class InferenceServerClient(InferenceServerClientBase):
                 int(response_header_len) if response_header_len else None,
             )
 
-        if self._endpoint_pool is not None:
-            from client_tpu.robust import call_with_retry_pool
+        def _issue() -> InferResult:
+            if self._endpoint_pool is not None:
+                from client_tpu.robust import call_with_retry_pool
 
-            def _pool_attempt(state, remaining) -> InferResult:
+                def _pool_attempt(state, remaining) -> InferResult:
+                    return _decode(*self._request(
+                        "POST", path, body=body, headers=request_headers,
+                        timeout=remaining,
+                        endpoint=self._endpoints[state.url],
+                    ))
+
+                return call_with_retry_pool(
+                    _pool_attempt, self._endpoint_pool, self._retry_policy,
+                    deadline_s=client_timeout, sequence_id=sequence_id,
+                    sequence_end=sequence_end,
+                )
+
+            def _attempt(remaining: Optional[float]) -> InferResult:
                 return _decode(*self._request(
                     "POST", path, body=body, headers=request_headers,
-                    timeout=remaining, endpoint=self._endpoints[state.url],
+                    timeout=remaining,
                 ))
 
-            return call_with_retry_pool(
-                _pool_attempt, self._endpoint_pool, self._retry_policy,
-                deadline_s=client_timeout, sequence_id=sequence_id,
-                sequence_end=sequence_end,
+            from client_tpu.robust import call_with_retry
+
+            return call_with_retry(
+                _attempt, self._retry_policy, self._breaker,
+                deadline_s=client_timeout,
             )
 
-        def _attempt(remaining: Optional[float]) -> InferResult:
-            return _decode(*self._request(
-                "POST", path, body=body, headers=request_headers,
-                timeout=remaining,
-            ))
-
-        from client_tpu.robust import call_with_retry
-
-        return call_with_retry(
-            _attempt, self._retry_policy, self._breaker,
-            deadline_s=client_timeout,
-        )
+        if client_span is None:
+            return _issue()
+        try:
+            result = _issue()
+        except BaseException as e:
+            client_span.finish(e)
+            raise
+        client_span.finish()
+        return result
 
     def async_infer(self, model_name, inputs, **kwargs) -> InferAsyncRequest:
         """Run infer on the worker pool; returns a handle whose

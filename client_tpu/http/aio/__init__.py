@@ -32,7 +32,11 @@ class InferenceServerClient(InferenceServerClientBase):
     healthy endpoints, fails over on retryable errors, and hedges
     tail-slow requests within the pool's budget; the pool's
     thread-based prober (stdlib HTTP, off the event loop) readmits
-    ejected endpoints. With a pool, ``circuit_breaker`` is ignored."""
+    ejected endpoints. With a pool, ``circuit_breaker`` is ignored.
+
+    ``tracer`` (:class:`client_tpu.tracing.ClientTracer`) records a
+    client-side span per ``infer`` and propagates its W3C
+    ``traceparent`` header (caller-supplied traceparent wins)."""
 
     def __init__(
         self,
@@ -45,6 +49,7 @@ class InferenceServerClient(InferenceServerClientBase):
         retry_policy=None,
         circuit_breaker=None,
         endpoint_pool=None,
+        tracer=None,
     ):
         super().__init__()
         from client_tpu.robust import EndpointPool
@@ -58,6 +63,7 @@ class InferenceServerClient(InferenceServerClientBase):
                                else (EndpointPool(urls) if len(urls) > 1
                                      else None))
         # client_tpu.robust wiring (same contract as the sync client).
+        self._tracer = tracer
         self._retry_policy = retry_policy
         self._breaker = circuit_breaker if self._endpoint_pool is None \
             else None
@@ -290,6 +296,12 @@ class InferenceServerClient(InferenceServerClientBase):
             parameters=parameters,
         )
         request_headers = dict(headers) if headers else {}
+        client_span = None
+        if self._tracer is not None:
+            client_span = self._tracer.start_span(
+                "client_infer", model_name, request_id, request_headers)
+            client_span.attrs["transport"] = "http-aio"
+            request_headers = client_span.inject(request_headers)
         if json_len is not None:
             request_headers[HEADER_LEN] = str(json_len)
             request_headers["Content-Type"] = "application/octet-stream"
@@ -306,30 +318,41 @@ class InferenceServerClient(InferenceServerClientBase):
                 payload, int(header_len) if header_len else None
             )
 
-        if self._endpoint_pool is not None:
-            from client_tpu.robust import call_with_retry_pool_async
+        async def _issue():
+            if self._endpoint_pool is not None:
+                from client_tpu.robust import call_with_retry_pool_async
 
-            async def _pool_attempt(state, remaining):
+                async def _pool_attempt(state, remaining):
+                    return _decode(*await self._request(
+                        "POST", path, body=body, headers=request_headers,
+                        timeout=remaining, base=self._bases[state.url],
+                    ))
+
+                return await call_with_retry_pool_async(
+                    _pool_attempt, self._endpoint_pool, self._retry_policy,
+                    deadline_s=client_timeout, sequence_id=sequence_id,
+                    sequence_end=sequence_end,
+                )
+
+            async def _attempt(remaining):
                 return _decode(*await self._request(
-                    "POST", path, body=body, headers=request_headers,
-                    timeout=remaining, base=self._bases[state.url],
+                    "POST", path, body=body,
+                    headers=request_headers, timeout=remaining,
                 ))
 
-            return await call_with_retry_pool_async(
-                _pool_attempt, self._endpoint_pool, self._retry_policy,
-                deadline_s=client_timeout, sequence_id=sequence_id,
-                sequence_end=sequence_end,
+            from client_tpu.robust import call_with_retry_async
+
+            return await call_with_retry_async(
+                _attempt, self._retry_policy, self._breaker,
+                deadline_s=client_timeout,
             )
 
-        async def _attempt(remaining):
-            return _decode(*await self._request(
-                "POST", path, body=body,
-                headers=request_headers, timeout=remaining,
-            ))
-
-        from client_tpu.robust import call_with_retry_async
-
-        return await call_with_retry_async(
-            _attempt, self._retry_policy, self._breaker,
-            deadline_s=client_timeout,
-        )
+        if client_span is None:
+            return await _issue()
+        try:
+            result = await _issue()
+        except BaseException as e:
+            client_span.finish(e)
+            raise
+        client_span.finish()
+        return result

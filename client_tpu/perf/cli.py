@@ -123,6 +123,19 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("-f", "--latency-report-file", default=None)
     parser.add_argument("--profile-export-file", default=None)
 
+    parser.add_argument("--trace", type=int, default=0, metavar="RATE",
+                        help="enable server-side span tracing at "
+                             "1-in-RATE sampling (1 = every request), "
+                             "harvest the trace file after the run, and "
+                             "print the stage-attribution table "
+                             "(decode/cache/queue/execute/fetch/encode "
+                             "p50/p99 + share of server time). "
+                             "service-kind triton and inprocess only; "
+                             "for remote servers the trace file path "
+                             "must be reachable from this process")
+    parser.add_argument("--trace-file", default=None,
+                        help="span trace output path (default: a "
+                             "temp file, deleted after the report)")
     parser.add_argument("--collect-metrics", action="store_true",
                         help="scrape server Prometheus metrics per window")
     parser.add_argument("--metrics-url", default=None,
@@ -375,6 +388,42 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
               "queue/compute breakdowns exclude cache hits" % scope,
               file=sys.stderr)
 
+    # -- server-side span tracing (--trace RATE) ----------------------
+    trace_path = None
+    trace_is_temp = False
+    if args.trace and args.trace > 0:
+        if args.service_kind not in ("triton", "inprocess"):
+            print("warning: --trace requires --service-kind triton or "
+                  "inprocess; ignoring", file=sys.stderr)
+        else:
+            if args.trace_file:
+                trace_path = args.trace_file
+            else:
+                import tempfile
+
+                fd, trace_path = tempfile.mkstemp(
+                    prefix="client_tpu_trace_", suffix=".jsonl")
+                os.close(fd)
+                trace_is_temp = True
+            try:
+                # Global settings so composing/ensemble models trace
+                # too; log_frequency=50 batches file writes off the
+                # hot path (the OFF update after the run flushes the
+                # tail), compact mode is what the harvest parses (set
+                # trace_mode=chrome by hand for Perfetto).
+                setup_backend.update_trace_settings("", {
+                    "trace_level": "TIMESTAMPS",
+                    "trace_rate": str(args.trace),
+                    "trace_count": "-1",
+                    "log_frequency": "50",
+                    "trace_file": trace_path,
+                    "trace_mode": "compact",
+                })
+            except InferenceServerException as e:
+                print("warning: could not enable tracing (%s); "
+                      "continuing without --trace" % e, file=sys.stderr)
+                trace_path = None
+
     sequence_manager = None
     if (model.scheduler_type == SchedulerType.SEQUENCE
             or model.composing_sequential or args.sequence_id_range):
@@ -509,6 +558,14 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
             manager.cleanup()
         except Exception:
             pass
+        if trace_path is not None:
+            # Turning tracing off also flushes any buffered records
+            # under the run's settings, so the harvest sees the tail.
+            try:
+                setup_backend.update_trace_settings(
+                    "", {"trace_level": "OFF"})
+            except Exception:
+                pass
         setup_backend.close()
         if scenario is not None:
             scenario.stop()
@@ -521,6 +578,15 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
                 pass
 
     print_report(results, args.percentile, mode)
+    if trace_path is not None:
+        from client_tpu.perf.report import print_trace_report
+
+        print_trace_report(trace_path)
+        if trace_is_temp:
+            try:
+                os.unlink(trace_path)
+            except OSError:
+                pass
     if endpoint_pool is not None:
         from client_tpu.perf.report import print_failover_report
 

@@ -48,6 +48,13 @@ class ClientBackend:
     def model_statistics(self, model_name: str = "", model_version: str = ""):
         raise NotImplementedError
 
+    def update_trace_settings(self, model_name: str = "", settings=None):
+        """Server-side trace settings (the harness's --trace wiring);
+        backends without a trace surface raise."""
+        raise InferenceServerException(
+            "%s does not support trace settings" % self.kind.value,
+            status="UNIMPLEMENTED")
+
     # data-plane ---------------------------------------------------------
     def infer(self, model_name, inputs, outputs=None, **kwargs):
         raise NotImplementedError
@@ -123,6 +130,10 @@ class GrpcClientBackend(ClientBackend):
         return self._client.get_inference_statistics(
             model_name, model_version, as_json=True
         )
+
+    def update_trace_settings(self, model_name="", settings=None):
+        return self._client.update_trace_settings(model_name, settings,
+                                                  as_json=True)
 
     def infer(self, model_name, inputs, outputs=None, **kwargs):
         return self._client.infer(model_name, inputs, outputs=outputs,
@@ -201,6 +212,9 @@ class HttpClientBackend(ClientBackend):
 
     def model_statistics(self, model_name="", model_version=""):
         return self._client.get_inference_statistics(model_name, model_version)
+
+    def update_trace_settings(self, model_name="", settings=None):
+        return self._client.update_trace_settings(model_name, settings)
 
     def infer(self, model_name, inputs, outputs=None, **kwargs):
         # client_timeout passes through: the HTTP client now has
@@ -842,6 +856,17 @@ class InProcessBackend(ClientBackend):
             self._core.model_statistics(model_name, model_version),
             preserving_proto_field_name=True,
         )
+
+    def update_trace_settings(self, model_name="", settings=None):
+        updates = {}
+        for key, value in (settings or {}).items():
+            if value is None:
+                updates[key] = []
+            elif isinstance(value, (list, tuple)):
+                updates[key] = [str(v) for v in value]
+            else:
+                updates[key] = [str(value)]
+        return self._core.trace_setting(model_name, updates)
 
     def _build_request(self, model_name, inputs, outputs, **kwargs):
         from client_tpu.grpc._utils import get_inference_request

@@ -104,6 +104,109 @@ def print_report(results: List[PerfStatus], percentile: int = 0,
             print("    WARNING: measurement did not stabilize")
 
 
+# Span name -> report stage for the --trace stage-attribution table.
+# Spans outside this map land in "other"; the "request" root span is
+# the denominator (end-to-end server time), never a stage.
+STAGE_SPANS = {
+    "decode": "decode",
+    "cache_lookup": "cache",
+    "cache_wait": "cache",
+    "cache_insert": "cache",
+    "queue": "queue",
+    "sequence_slot_wait": "queue",
+    "batch_execute": "execute",
+    "device_execute": "execute",
+    "stream_response": "execute",
+    "relay_fetch": "fetch",
+    "encode": "encode",
+}
+STAGE_ORDER = ("decode", "cache", "queue", "execute", "fetch", "encode",
+               "other")
+
+
+def harvest_trace(path: str) -> List[dict]:
+    """Parses a compact-mode trace file into per-request stage
+    attribution: one {root_ns, stages: {stage: ns}, model} entry per
+    sampled request. Unparseable lines are skipped — a trace file is
+    diagnostic evidence, never a reason to fail the report."""
+    import json
+
+    from client_tpu.server.tracing import stage_durations
+
+    requests = []
+    try:
+        with open(path) as f:
+            lines = f.readlines()
+    except OSError:
+        return requests
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            record = json.loads(line)
+        except ValueError:
+            continue
+        spans = record.get("spans") or []
+        root = next(
+            (s for s in spans if s.get("name") == "request"), None)
+        if root is None:
+            continue
+        root_ns = max(
+            int(root.get("end_ns", 0)) - int(root.get("start_ns", 0)), 0)
+        requests.append({
+            "root_ns": root_ns,
+            "stages": stage_durations(spans, STAGE_SPANS),
+            "model": record.get("model_name", ""),
+        })
+    return requests
+
+
+def print_trace_report(path: str) -> None:
+    """The --trace stage-attribution table: per-stage p50/p99 across
+    sampled requests plus each stage's share of p50 end-to-end server
+    time — the measured replacement for relay_fetch_ms_est. The
+    coverage line is the CI trace smoke's gate."""
+    import numpy as np
+
+    requests = harvest_trace(path)
+    if not requests:
+        print("Trace summary: no sampled requests in %s" % path)
+        return
+    roots = np.array([r["root_ns"] for r in requests], dtype=float)
+    root_p50 = float(np.percentile(roots, 50))
+    root_sum = float(roots.sum())
+    print("Trace summary (%d sampled requests, %s):"
+          % (len(requests), path))
+    print("    %-8s %12s %12s %8s" % ("stage", "p50 us", "p99 us",
+                                      "share"))
+    tracked_sum = 0.0
+    qef_sum = 0.0
+    for stage in STAGE_ORDER:
+        values = np.array([r["stages"].get(stage, 0) for r in requests],
+                          dtype=float)
+        if not values.any():
+            continue
+        p50 = float(np.percentile(values, 50))
+        p99 = float(np.percentile(values, 99))
+        # Shares are sum-based (this stage's total time across sampled
+        # requests over total server time): per-stage p50s are not
+        # additive when variance is high (a compile spike lands in one
+        # request's execute AND its root; percentile sums would
+        # under-attribute it).
+        share = values.sum() / root_sum * 100.0 if root_sum else 0.0
+        tracked_sum += values.sum()
+        if stage in ("queue", "execute", "fetch"):
+            qef_sum += values.sum()
+        print("    %-8s %12.1f %12.1f %7.1f%%"
+              % (stage, p50 / 1000.0, p99 / 1000.0, share))
+    coverage = tracked_sum / root_sum * 100.0 if root_sum else 0.0
+    qef = qef_sum / root_sum * 100.0 if root_sum else 0.0
+    print("    server p50 %.1f us; stage coverage %.1f%% of server "
+          "span time (queue+execute+fetch %.1f%%)"
+          % (root_p50 / 1000.0, coverage, qef))
+
+
 def print_chaos_report(results: List[PerfStatus], retry_count: int,
                        injected: Optional[dict] = None,
                        description: str = "",

@@ -54,6 +54,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from client_tpu.server import tracing as spantrace
 from client_tpu.utils import InferenceServerException
 
 NANOS_PER_US = 1_000
@@ -62,10 +63,10 @@ NANOS_PER_US = 1_000
 class _Pending:
     __slots__ = ("inputs", "params", "batch", "shape_key", "event",
                  "outputs", "error", "enqueue_ns", "queue_ns", "leader",
-                 "deadline_ns")
+                 "deadline_ns", "trace", "done_ns", "queue_from_ns")
 
     def __init__(self, inputs, params, batch, shape_key,
-                 timeout_ns: int = 0):
+                 timeout_ns: int = 0, trace=None):
         self.inputs = inputs
         self.params = params
         self.batch = batch
@@ -82,6 +83,16 @@ class _Pending:
         # dropped BEFORE dispatch — a request nobody is waiting for
         # must not occupy a TPU slot.
         self.deadline_ns = self.enqueue_ns + timeout_ns if timeout_ns else 0
+        # Sampled requests carry their RequestTrace; the execution
+        # stage records queue/batch/fetch spans into it (shared spans
+        # for the fused work). None = unsampled, zero cost.
+        self.trace = trace
+        # Completion stamp (_finish) so the request thread can span
+        # its own wake latency; queue_from_ns backdates the queue span
+        # to the caller's last boundary (covers scheduler creation and
+        # enqueue locking, not just time spent in the bucket).
+        self.done_ns = 0
+        self.queue_from_ns = 0
 
 
 class _OverlapTracker:
@@ -262,9 +273,14 @@ class DynamicBatcher:
     # -- request side ----------------------------------------------------
 
     def infer(self, inputs: Dict[str, np.ndarray], params: dict,
-              batch: int) -> Dict[str, np.ndarray]:
+              batch: int, trace=None,
+              queue_from_ns: int = 0) -> Dict[str, np.ndarray]:
         """Blocks until this request's slice of a fused execution is
-        ready. `batch` is the request's own batch-dim size."""
+        ready. `batch` is the request's own batch-dim size; `trace` is
+        the request's RequestTrace when sampled (never part of the
+        fusion fingerprint — tracing must not fragment batches), and
+        `queue_from_ns` backdates its queue span to the caller's last
+        span boundary."""
         shape_key = (
             tuple(
                 (name, array.shape[1:], array.dtype.str)
@@ -273,7 +289,9 @@ class DynamicBatcher:
             _params_fingerprint(params),
         )
         pending = _Pending(inputs, params, batch, shape_key,
-                           timeout_ns=self._timeout_ns_for(params))
+                           timeout_ns=self._timeout_ns_for(params),
+                           trace=trace)
+        pending.queue_from_ns = queue_from_ns
         with self._cv:
             if self._stopping:
                 raise InferenceServerException(
@@ -320,6 +338,12 @@ class DynamicBatcher:
             self._pending_total += 1
             self._cv.notify_all()
         pending.event.wait()
+        if trace is not None and pending.done_ns:
+            # Wake latency: the batch finished (done_ns stamped by
+            # _finish) but this thread had to be rescheduled — real
+            # queueing under load, spanned so the timeline tiles.
+            trace.add_timed(spantrace.SPAN_QUEUE, pending.done_ns,
+                            time.monotonic_ns(), {"phase": "wake"})
         if pending.error is not None:
             raise pending.error
         return pending.outputs, pending.queue_ns, pending.leader
@@ -549,8 +573,13 @@ class DynamicBatcher:
     def _execute(self, bucket: List[_Pending]):
         start_ns = time.monotonic_ns()
         bucket[0].leader = True
+        traced = [p.trace for p in bucket if p.trace is not None]
         for pending in bucket:
             pending.queue_ns = start_ns - pending.enqueue_ns
+            if pending.trace is not None:
+                pending.trace.add_timed(
+                    spantrace.SPAN_QUEUE,
+                    pending.queue_from_ns or pending.enqueue_ns, start_ns)
         try:
             total = sum(p.batch for p in bucket)
             target = self._padded_size(total)
@@ -569,10 +598,27 @@ class DynamicBatcher:
                     outputs = self._model.infer(fused, bucket[0].params)
             finally:
                 self._tracker.exit_compute()
-            compute_ns = time.monotonic_ns() - start_ns
+            compute_end_ns = time.monotonic_ns()
+            compute_ns = compute_end_ns - start_ns
+            if traced:
+                # ONE batch-execution span shared by every sampled
+                # member: same span id in each trace, carrying the
+                # fused batch size and compile bucket — the reader
+                # both attributes the time per request and sees the
+                # work was done once. Its end bound is reused as the
+                # fetch chain's start so no slice between the stages
+                # goes untracked.
+                batch_span = spantrace.shared_span(
+                    spantrace.SPAN_BATCH_EXECUTE, start_ns,
+                    compute_end_ns,
+                    {"batch": total, "padded_batch": target,
+                     "requests": len(bucket)})
+                for trace in traced:
+                    trace.add(batch_span)
             if passthrough:
                 bucket[0].outputs = outputs
-                self._finish(bucket, target, compute_ns, 0)
+                self._finish(bucket, target, compute_ns, 0,
+                             done_from=compute_end_ns)
                 return
             if all(
                 isinstance(p.inputs[name], np.ndarray)
@@ -599,7 +645,8 @@ class DynamicBatcher:
                 # Device-resident bucket (TPU-shm path): slices are
                 # lazy device views; outputs stay in HBM end-to-end.
                 self._scatter(bucket, outputs)
-                self._finish(bucket, target, compute_ns, 0)
+                self._finish(bucket, target, compute_ns, 0,
+                             done_from=compute_end_ns)
         except Exception as e:
             self._assign_error(bucket, e)
             self._finish(bucket, 0, 0, 0, ok=False)
@@ -610,8 +657,30 @@ class DynamicBatcher:
                             target: int, compute_ns: int) -> None:
         fetch_start = time.monotonic_ns()
         self._tracker.enter_fetch()
+        traced = [p.trace for p in bucket if p.trace is not None]
+        mark_ns = 0
         try:
-            host = {name: np.asarray(a) for name, a in outputs.items()}
+            if traced:
+                # Per-output relay fetch, individually timed: one
+                # shared span per output tensor (the whole bucket
+                # rides one transfer) — the measured form of ROADMAP
+                # item 1's relay_fetch_ms_est. Boundaries chain (each
+                # span starts where the previous ended, the first at
+                # the pool handoff) so the fetch stage tiles.
+                host = {}
+                mark_ns = fetch_start
+                for name, array in outputs.items():
+                    host[name] = np.asarray(array)
+                    end_ns = time.monotonic_ns()
+                    fetch_span = spantrace.shared_span(
+                        spantrace.SPAN_RELAY_FETCH, mark_ns, end_ns,
+                        {"output": name,
+                         "nbytes": int(host[name].nbytes)})
+                    mark_ns = end_ns
+                    for trace in traced:
+                        trace.add(fetch_span)
+            else:
+                host = {name: np.asarray(a) for name, a in outputs.items()}
             self._scatter(bucket, host)
         except Exception as e:  # noqa: BLE001 — waiters must wake
             self._assign_error(bucket, e)
@@ -620,13 +689,19 @@ class DynamicBatcher:
             return
         self._tracker.exit_fetch()
         self._finish(bucket, target, compute_ns,
-                     time.monotonic_ns() - fetch_start)
+                     time.monotonic_ns() - fetch_start,
+                     done_from=mark_ns)
 
     def _finish(self, bucket: List[_Pending], executed: int,
-                compute_ns: int, fetch_ns: int, ok: bool = True) -> None:
+                compute_ns: int, fetch_ns: int, ok: bool = True,
+                done_from: int = 0) -> None:
         """Completion for one fused batch: wake the waiters, record the
-        execution, release the pipeline slot."""
+        execution, release the pipeline slot. ``done_from`` chains the
+        wake-span base off the last compute/fetch boundary so the
+        scatter/notify slice is attributed too."""
+        done_ns = done_from or time.monotonic_ns()
         for pending in bucket:
+            pending.done_ns = done_ns
             pending.event.set()
         if ok and self._stats_hook is not None:
             try:

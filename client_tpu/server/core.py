@@ -10,14 +10,17 @@ triton_c_api/) calls it directly with no serialization at all.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
+import uuid
 from typing import Dict, Iterator, Optional
 
 import numpy as np
 
 from client_tpu.protocol import inference_pb2 as pb
 from client_tpu.server import chaos
+from client_tpu.server import tracing as spantrace
 from client_tpu.server.cache import (
     DEFAULT_CACHE_BYTES,
     ResponseCache,
@@ -36,6 +39,8 @@ from client_tpu.utils import (
     serialize_byte_tensor,
     triton_to_np_dtype,
 )
+
+_LOG = logging.getLogger("client_tpu.server")
 
 SERVER_NAME = "client_tpu_server"
 SERVER_VERSION = "0.1.0"
@@ -149,6 +154,16 @@ class _ModelStats:
             entry[2] += fetch_ns
 
 
+def mint_request_id(request: pb.ModelInferRequest) -> None:
+    """Request-id correlation: a transport front-end stamps an id on
+    requests that carry none, so responses, trace records, and error
+    logs can always be joined to a client-side result. Only call this
+    on a per-call proto the transport owns — direct core callers may
+    share one request object across threads."""
+    if not request.id:
+        request.id = uuid.uuid4().hex[:16]
+
+
 def stream_error_response(request, message):
     """Decoupled errors ride the stream (never abort it) and carry the
     request id so a client pipelining many requests on one stream can
@@ -188,6 +203,7 @@ class InferenceServerCore:
         self._trace_settings: Dict[str, Dict[str, list]] = {"": {
             "trace_file": [""], "trace_level": ["OFF"], "trace_rate": ["1000"],
             "trace_count": ["-1"], "log_frequency": ["0"],
+            "trace_mode": ["compact"],
         }}
         self._trace_state: Dict[str, dict] = {}
         self._trace_lock = threading.Lock()
@@ -441,7 +457,7 @@ class InferenceServerCore:
             label = '{model="%s"}' % name
             active_rows.append("tpu_sequence_active%s %d"
                                % (label, snap["active_sequences"]))
-            slots_rows.append("tpu_sequence_slots_total%s %d"
+            slots_rows.append("tpu_sequence_slots%s %d"
                               % (label, snap["slot_total"]))
             backlog_rows.append("tpu_sequence_backlog%s %d"
                                 % (label, snap["backlog_depth"]))
@@ -451,7 +467,11 @@ class InferenceServerCore:
         family("tpu_sequence_active", "gauge",
                "Sequences currently holding a scheduler slot",
                active_rows)
-        family("tpu_sequence_slots_total", "gauge",
+        # Renamed from tpu_sequence_slots_total (PR 3): the _total
+        # suffix implies a counter to Prometheus tooling, but this is
+        # a configured-capacity gauge — metrics_lint enforces the
+        # convention now.
+        family("tpu_sequence_slots", "gauge",
                "Configured candidate-sequence slots", slots_rows)
         family("tpu_sequence_backlog", "gauge",
                "Sequence starts waiting for a free slot", backlog_rows)
@@ -530,67 +550,109 @@ class InferenceServerCore:
                     state["emitted"] = 0
         return settings
 
-    def _maybe_trace(self, model_name: str, request_id: str, t0: int,
-                     t1: int, t2: int, t3: int, queue_ns: int) -> None:
-        """Emits one timeline record per sampled request (Triton trace
-        semantics: trace_level != OFF enables, trace_rate samples 1-in-N,
-        trace_count caps, log_frequency batches file writes)."""
+    def _trace_state_for(self, model_name: str) -> dict:
+        """Per-model sampling state (caller holds _trace_lock)."""
+        return self._trace_state.setdefault(
+            model_name, {"seen": 0, "emitted": 0, "next_id": 1,
+                         "buffer": []})
+
+    def _trace_begin(self, model_name: str, trace_context: Optional[str],
+                     request_id: str
+                     ) -> Optional[spantrace.RequestTrace]:
+        """Sampling decision for one request (Triton trace semantics:
+        trace_level != OFF enables, trace_rate samples 1-in-N,
+        trace_count caps). Runs at request START so every stage —
+        cache hits and single-flight waits included — lands in the
+        span tree; the trace_count slot is reserved here so a settings
+        update's re-arm keeps exact counts. Returns None (the
+        near-zero-cost path) for unsampled requests."""
         settings = self._effective_trace_settings(model_name)
         level = (settings.get("trace_level") or ["OFF"])[0]
         if level in ("", "OFF"):
-            return
+            return None
         if not (settings.get("trace_file") or [""])[0]:
             # No sink configured: tracing stays off (Triton needs an
             # explicit trace file too; an implicit cwd-relative
             # default would litter the server's working directory).
-            return
+            return None
         try:
             rate = max(1, int((settings.get("trace_rate") or ["1000"])[0]))
             cap = int((settings.get("trace_count") or ["-1"])[0])
-            freq = int((settings.get("log_frequency") or ["0"])[0])
         except ValueError:
-            return
+            return None
         with self._trace_lock:
-            state = self._trace_state.setdefault(
-                model_name, {"seen": 0, "emitted": 0, "next_id": 1,
-                             "buffer": []})
+            state = self._trace_state_for(model_name)
             state["seen"] += 1
             if (state["seen"] - 1) % rate != 0:
-                return
+                return None
             if 0 <= cap <= state["emitted"]:
-                return
+                return None
             state["emitted"] += 1
-            record = {
-                "id": state["next_id"],
-                "model_name": model_name,
-                "request_id": request_id,
-                "timestamps": [
-                    {"name": "REQUEST_START", "ns": t0},
-                    {"name": "QUEUE_START", "ns": t1},
-                    {"name": "COMPUTE_START", "ns": t1 + queue_ns},
-                    {"name": "COMPUTE_END", "ns": t2},
-                    {"name": "REQUEST_END", "ns": t3},
-                ],
-            }
+        return spantrace.RequestTrace(
+            trace_context,
+            attrs={"model": model_name, "request_id": request_id})
+
+    def _trace_emit(self, model_name: str, request_id: str,
+                    trace: spantrace.RequestTrace) -> None:
+        """Buffers one finished trace under the model's CURRENT
+        settings (trace_mode selects the rendering, log_frequency
+        batches file writes); a later settings update flushes earlier
+        buffers under their pre-update settings (trace_setting)."""
+        settings = self._effective_trace_settings(model_name)
+        try:
+            freq = int((settings.get("log_frequency") or ["0"])[0])
+        except ValueError:
+            freq = 0
+        mode = (settings.get("trace_mode") or ["compact"])[0]
+        if mode not in spantrace.TRACE_MODES:
+            mode = "compact"
+        with self._trace_lock:
+            state = self._trace_state_for(model_name)
+            record_id = state["next_id"]
             state["next_id"] += 1
-            state["buffer"].append(record)
+        # Rendering runs OUTSIDE the lock: at trace_rate=1 every
+        # request emits, and serializing dict/JSON assembly on the
+        # shared lock would put tracing itself on the critical path
+        # (file order may interleave across threads; readers sort by
+        # timestamp, ids stay unique).
+        if mode == "chrome":
+            payload = spantrace.chrome_events(
+                trace, record_id, model_name, request_id)
+        else:
+            payload = spantrace.compact_record(
+                trace, record_id, model_name, request_id)
+        with self._trace_lock:
+            state = self._trace_state_for(model_name)
+            state["buffer"].append((mode, payload))
             if len(state["buffer"]) >= max(1, freq):
                 self._flush_trace(model_name, settings, state)
 
     def _flush_trace(self, model_name: str, settings: Dict[str, list],
                      state: dict) -> None:
-        """Appends buffered records as JSON lines (caller holds
-        _trace_lock)."""
+        """Appends buffered records to the settings' trace_file
+        (caller holds _trace_lock): compact records as JSON lines,
+        chrome events as an open JSON array — the Chrome trace format
+        explicitly allows the missing close bracket, so the file loads
+        in chrome://tracing and ui.perfetto.dev as written."""
         import json as _json
+        import os as _os
 
         path = (settings.get("trace_file") or [""])[0]
         records, state["buffer"] = state["buffer"], []
         if not path:
             return  # sink was never configured; drop silently
         try:
+            fresh = not _os.path.exists(path) or _os.path.getsize(path) == 0
             with open(path, "a") as f:
-                for record in records:
-                    f.write(_json.dumps(record) + "\n")
+                for mode, payload in records:
+                    if mode == "chrome":
+                        if fresh:
+                            f.write("[\n")
+                            fresh = False
+                        for event in payload:
+                            f.write(_json.dumps(event) + ",\n")
+                    else:
+                        f.write(_json.dumps(payload) + "\n")
         except OSError:
             pass  # tracing must never fail the request path
 
@@ -731,19 +793,33 @@ class InferenceServerCore:
         self._stats_for(name).record(count, 0, 0, compute_ns, 0, ok=True,
                                      executions=executions)
 
-    def infer(self, request: pb.ModelInferRequest) -> pb.ModelInferResponse:
+    def infer(self, request: pb.ModelInferRequest,
+              trace_context: Optional[str] = None
+              ) -> pb.ModelInferResponse:
+        # Request-id correlation happens at the transport front-ends
+        # (mint_request_id): they own their per-call protos, whereas a
+        # direct core caller may legitimately share one request object
+        # across threads (the bench's closed loops do) and an in-place
+        # mint would race.
         # acquire = READY check + in-flight increment in one atomic
         # step: a graceful unload drains exactly the requests admitted
         # before it flipped the state (repository.begin_unload).
         model = self.repository.acquire(request.model_name,
                                         request.model_version)
         try:
-            return self._infer_admitted(model, request)
+            return self._infer_admitted(model, request, trace_context)
+        except InferenceServerException as e:
+            # Stamped error log: the line joins a client-side failure
+            # to its trace/statistics by request id.
+            _LOG.debug("request %s for model '%s' failed: %s",
+                       request.id, model.name, e)
+            raise
         finally:
             self.repository.release(model.name)
 
     def _infer_admitted(self, model: ServedModel,
-                        request: pb.ModelInferRequest
+                        request: pb.ModelInferRequest,
+                        trace_context: Optional[str] = None
                         ) -> pb.ModelInferResponse:
         if getattr(model, "stats_recorder", False) is None:
             model.stats_recorder = self._record_composing
@@ -754,9 +830,31 @@ class InferenceServerCore:
             # requests fuse their backbone executions.
             model.batcher_resolver = self._batcher_for
         stats = self._stats_for(model.name)
+        trace = self._trace_begin(model.name, trace_context, request.id)
+        if trace is None:
+            return self._infer_routed(model, request, stats, None)
+        error: Optional[str] = None
+        try:
+            return self._infer_routed(model, request, stats, trace)
+        except Exception as e:
+            error = str(e)
+            raise
+        finally:
+            trace.finish(error=error)
+            self._trace_emit(model.name, request.id, trace)
+
+    def _infer_routed(self, model: ServedModel,
+                      request: pb.ModelInferRequest, stats: _ModelStats,
+                      trace: Optional[spantrace.RequestTrace]
+                      ) -> pb.ModelInferResponse:
+        """Cache-aware routing for one admitted request: lookup /
+        single-flight when the model opted into the response cache,
+        else straight to execution."""
         cache = self.response_cache
         if not (cache.enabled and wants_response_cache(model)):
-            return self._infer_executed(model, request, stats)
+            return self._infer_executed(
+                model, request, stats, trace,
+                t0_ns=trace.root.start_ns if trace is not None else None)
         # Cache lookup runs on the WIRE request, before any input
         # decoding: a hit skips deserialization, queue/batcher, model
         # execution, and output encoding — it pays only the content
@@ -764,7 +862,14 @@ class InferenceServerCore:
         # and shared-memory I/O yield key=None (bypass).
         key = request_cache_key(model.name, model.version, request)
         if key is None:
-            return self._infer_executed(model, request, stats)
+            if trace is not None:
+                mark = time.monotonic_ns()
+                trace.add_timed(spantrace.SPAN_CACHE_LOOKUP,
+                                trace.root.start_ns, mark,
+                                {"outcome": "bypass"})
+                return self._infer_executed(model, request, stats, trace,
+                                            t0_ns=mark)
+            return self._infer_executed(model, request, stats, trace)
         t_cache = time.monotonic_ns()
         # Single-flight: the first miss for a key leads and executes;
         # concurrent identical misses follow — they are served the
@@ -775,22 +880,52 @@ class InferenceServerCore:
         # begin cannot hand a late thread a redundant execution.
         cached, flight, leader = cache.lookup_or_begin(key)
         if cached is not None:
-            return self._finish_cache_hit(model, request, stats, cached,
-                                          t_cache)
+            response = self._finish_cache_hit(model, request, stats,
+                                              cached, t_cache)
+            if trace is not None:
+                # The lookup span covers probe AND serve (parse +
+                # id stamp) so a hit's trace tiles from root start.
+                trace.add_timed(spantrace.SPAN_CACHE_LOOKUP,
+                                trace.root.start_ns,
+                                time.monotonic_ns(), {"outcome": "hit"})
+            return response
+        mark = 0
+        if trace is not None:
+            mark = time.monotonic_ns()
+            trace.add_timed(spantrace.SPAN_CACHE_LOOKUP,
+                            trace.root.start_ns, mark,
+                            {"outcome": "miss" if leader else "follower"})
         if not leader:
-            response = self._await_flight(model, request, stats, cache,
-                                          flight, t_cache)
+            try:
+                response = self._await_flight(model, request, stats, cache,
+                                              flight, t_cache)
+            except Exception:
+                if trace is not None:
+                    trace.add_timed(spantrace.SPAN_CACHE_WAIT, mark,
+                                    time.monotonic_ns(),
+                                    {"outcome": "timeout"})
+                raise
+            if trace is not None:
+                end_ns = time.monotonic_ns()
+                trace.add_timed(spantrace.SPAN_CACHE_WAIT, mark, end_ns,
+                                {"outcome": ("served" if response is not None
+                                             else "leader_failed")})
+                mark = end_ns
             if response is not None:
                 return response
             # Leader failed: fall back to an independent execution so
             # one fault never fans out across the coalesced burst.
             flight = None
         try:
-            response = self._infer_executed(model, request, stats)
+            response = self._infer_executed(
+                model, request, stats, trace,
+                t0_ns=mark if trace is not None else None)
         except Exception:
             if flight is not None:
                 cache.fail_flight(key, flight)
             raise
+        insert_start = (trace.timeline[-1] if trace is not None
+                        and trace.timeline else 0)
         try:
             # Success only: failed executions are never inserted.
             cache.insert(model.name, key, response)
@@ -800,6 +935,9 @@ class InferenceServerCore:
             # must never strand the coalesced burst.
             if flight is not None:
                 cache.resolve_flight(key, flight, response)
+        if trace is not None and insert_start:
+            trace.add_timed(spantrace.SPAN_CACHE_INSERT, insert_start,
+                            time.monotonic_ns())
         return response
 
     def _finish_cache_hit(self, model: ServedModel,
@@ -854,9 +992,10 @@ class InferenceServerCore:
             stats.record(1, 0, 0, 0,
                          time.monotonic_ns() - t_cache, ok=False)
             raise InferenceServerException(
-                "request for model '%s' expired after %d us waiting on "
-                "an identical in-flight request (single-flight)"
-                % (model.name, timeout_us), status="DEADLINE_EXCEEDED")
+                "request %s for model '%s' expired after %d us waiting "
+                "on an identical in-flight request (single-flight)"
+                % (request.id, model.name, timeout_us),
+                status="DEADLINE_EXCEEDED")
         if flight.failed or flight.response is None:
             return None
         cache.record_coalesced(model.name)
@@ -871,8 +1010,15 @@ class InferenceServerCore:
 
     def _infer_executed(self, model: ServedModel,
                         request: pb.ModelInferRequest,
-                        stats: _ModelStats) -> pb.ModelInferResponse:
-        t0 = time.monotonic_ns()
+                        stats: _ModelStats,
+                        trace: Optional[spantrace.RequestTrace] = None,
+                        t0_ns: Optional[int] = None
+                        ) -> pb.ModelInferResponse:
+        # Traced requests chain t0 off the caller's last span boundary
+        # (root start / cache-lookup end) so the admission slice lands
+        # in the decode span instead of an untracked gap; untraced
+        # requests keep a fresh read.
+        t0 = t0_ns if t0_ns is not None else time.monotonic_ns()
         queue_ns = 0
         executions = 1
         try:
@@ -881,6 +1027,15 @@ class InferenceServerCore:
             # ride the normal failure path
             inputs, params = self._decode_inputs(model, request)
             t1 = time.monotonic_ns()
+            if trace is not None:
+                # Spans tile the t0..t3 timeline exactly (decode =
+                # t0->t1, execute = t1->t2 around the scheduler spans,
+                # encode = t2->t3) so the stage-attribution table can
+                # account for ~all of the server time even on
+                # microsecond-scale models where inter-stage framework
+                # gaps would otherwise dominate.
+                trace.add_timed(spantrace.SPAN_DECODE, t0, t1,
+                                {"inputs": len(inputs)})
             batcher = self._batcher_for(model)
             sequencer = (self._sequencer_for(model)
                          if params.get("sequence_id") else None)
@@ -891,36 +1046,85 @@ class InferenceServerCore:
                 # dynamic batcher for cross-sequence step fusion.
                 batch = self._batch_size(model, request)
                 outputs, queue_ns, executions = sequencer.infer(
-                    inputs, params, batch)
+                    inputs, params, batch, trace=trace)
             elif batcher is not None and "sequence_id" not in params:
                 batch = self._batch_size(model, request)
                 outputs, queue_ns, leader = batcher.infer(
-                    inputs, params, batch)
+                    inputs, params, batch, trace=trace,
+                    queue_from_ns=t1 if trace is not None else 0)
                 # Fused requests share one model execution; only its
                 # leader bumps execution_count (Triton semantics).
                 executions = 1 if leader else 0
             else:
                 outputs = model.infer(inputs, params)
             t2 = time.monotonic_ns()
+            # Span boundaries are CHAINED off single clock reads
+            # (decode ends exactly where execute starts, etc.): two
+            # separate reads around a boundary would let a GIL
+            # deschedule land between them as untracked time, and at
+            # concurrency those slices dominate microsecond models.
+            span_mark = t2
+            if trace is not None and sequencer is None and batcher is None:
+                # device_execute = end of decode to model return
+                # (async-dispatch models return lazy arrays; the
+                # forced materialization lands in relay_fetch below).
+                trace.add_timed(spantrace.SPAN_DEVICE_EXECUTE, t1, t2)
+                # Sampled direct-path requests materialize each
+                # wire-bound output under its own relay_fetch span —
+                # the device->host tax ROADMAP item 1 names, measured
+                # per output instead of estimated.
+                outputs, span_mark = self._traced_fetch(
+                    model, request, outputs, trace, t2)
             response = self._encode_response(model, request, outputs)
             t3 = time.monotonic_ns()
+            if trace is not None:
+                trace.add_timed(spantrace.SPAN_ENCODE, span_mark, t3)
         except InferenceServerException:
             stats.record(1, 0, 0, 0, time.monotonic_ns() - t0, ok=False)
             raise
         except Exception as e:
             stats.record(1, 0, 0, 0, time.monotonic_ns() - t0, ok=False)
             raise InferenceServerException(
-                "inference failed for model '%s': %s" % (model.name, e),
+                "inference failed for model '%s' (request %s): %s"
+                % (model.name, request.id, e),
                 status="INTERNAL",
             )
         batch = self._batch_size(model, request)
         stats.record(batch, queue_ns, t1 - t0, (t2 - t1) - queue_ns,
                      t3 - t2, ok=True, executions=executions)
-        self._maybe_trace(model.name, request.id, t0, t1, t2, t3, queue_ns)
+        if trace is not None:
+            trace.timeline = (t0, t1, t1 + queue_ns, t2, t3)
         return response
 
+    def _traced_fetch(self, model: ServedModel,
+                      request: pb.ModelInferRequest, outputs,
+                      trace: spantrace.RequestTrace, mark_ns: int):
+        """Per-output device->host relay fetch for sampled direct-path
+        requests: each wire-bound output is materialized under its own
+        relay_fetch span (encode then reads the host copy). Outputs
+        destined for a shared-memory region keep the zero-copy
+        device-resident path — never forced to host. ``mark_ns`` is
+        the chained span boundary; returns (outputs, new boundary)."""
+        shm_outputs = {
+            t.name for t in request.outputs
+            if "shared_memory_region" in t.parameters
+        }
+        fetched = {}
+        for name, value in outputs.items():
+            if name in shm_outputs or isinstance(value, np.ndarray):
+                fetched[name] = value
+                continue
+            host = np.asarray(value)
+            end_ns = time.monotonic_ns()
+            trace.add_timed(spantrace.SPAN_RELAY_FETCH, mark_ns, end_ns,
+                            {"output": name, "nbytes": int(host.nbytes)})
+            mark_ns = end_ns
+            fetched[name] = host
+        return fetched, mark_ns
+
     def stream_infer(
-        self, request: pb.ModelInferRequest
+        self, request: pb.ModelInferRequest,
+        trace_context: Optional[str] = None
     ) -> Iterator[pb.ModelStreamInferResponse]:
         """Decoupled execution: yields one ModelStreamInferResponse per
         model response; the final response carries the
@@ -937,7 +1141,8 @@ class InferenceServerCore:
         )
         t0 = time.monotonic_ns()
         if not model.decoupled:
-            response = self.infer(request)  # admission handled there
+            response = self.infer(request, trace_context)
+            # admission handled there
             stream_response = pb.ModelStreamInferResponse()
             stream_response.infer_response.CopyFrom(response)
             stream_response.infer_response.parameters[
@@ -949,20 +1154,36 @@ class InferenceServerCore:
         # a graceful unload drains it before teardown.
         model = self.repository.acquire(request.model_name,
                                         request.model_version)
+        trace = self._trace_begin(model.name, trace_context, request.id)
         try:
             yield from self._stream_admitted(model, request, stats, t0,
-                                             want_empty_final)
+                                             want_empty_final, trace)
         finally:
+            if trace is not None:
+                trace.finish()
+                self._trace_emit(model.name, request.id, trace)
             self.repository.release(model.name)
 
     def _stream_admitted(self, model, request, stats, t0,
-                         want_empty_final):
+                         want_empty_final, trace=None):
         try:
+            decode_span = (trace.begin(spantrace.SPAN_DECODE)
+                           if trace is not None else None)
             inputs, params = self._decode_inputs(model, request)
+            if decode_span is not None:
+                trace.end(decode_span)
             count = 0
             pending = None  # buffer one ahead so the last data response
             # can carry the final flag when empty finals are off
+            mark_ns = time.monotonic_ns()
             for out in model.infer_stream(inputs, params):
+                if trace is not None:
+                    # One span per decoupled response: model produce
+                    # time since the previous response left this loop
+                    # (the server-side view of inter-token latency).
+                    trace.add_timed(
+                        spantrace.SPAN_STREAM_RESPONSE, mark_ns,
+                        time.monotonic_ns(), {"index": count})
                 response = self._encode_response(model, request, out)
                 stream_response = pb.ModelStreamInferResponse()
                 stream_response.infer_response.CopyFrom(response)
@@ -973,6 +1194,7 @@ class InferenceServerCore:
                 if pending is not None:
                     yield pending
                 pending = stream_response
+                mark_ns = time.monotonic_ns()
             if want_empty_final or count == 0:
                 if pending is not None:
                     yield pending

@@ -13,7 +13,11 @@ from client_tpu.protocol.service import (
     GRPCInferenceServiceServicer,
     add_GRPCInferenceServiceServicer_to_server,
 )
-from client_tpu.server.core import InferenceServerCore, stream_error_response
+from client_tpu.server.core import (
+    InferenceServerCore,
+    mint_request_id,
+    stream_error_response,
+)
 from client_tpu.utils import InferenceServerException
 
 _STATUS_MAP = {
@@ -27,6 +31,19 @@ _STATUS_MAP = {
     "INTERNAL": grpc.StatusCode.INTERNAL,
     "UNIMPLEMENTED": grpc.StatusCode.UNIMPLEMENTED,
 }
+
+
+def _trace_context(context) -> Optional[str]:
+    """W3C traceparent from the call's invocation metadata (the gRPC
+    twin of the HTTP header), or None — malformed/absent context must
+    never fail a request."""
+    try:
+        for key, value in context.invocation_metadata() or ():
+            if key == "traceparent":
+                return value
+    except Exception:  # noqa: BLE001 — propagation is best-effort
+        pass
+    return None
 
 
 def _abort(context, error: InferenceServerException):
@@ -73,8 +90,10 @@ class InferenceServicer(GRPCInferenceServiceServicer):
             _abort(context, e)
 
     def ModelInfer(self, request, context):
+        mint_request_id(request)
         try:
-            return self._core.infer(request)
+            return self._core.infer(
+                request, trace_context=_trace_context(context))
         except InferenceServerException as e:
             _abort(context, e)
 
@@ -88,6 +107,10 @@ class InferenceServicer(GRPCInferenceServiceServicer):
     def ModelStreamInfer(self, request_iterator, context):
         import queue as _queue
         from concurrent.futures import ThreadPoolExecutor
+
+        # One traceparent per stream (gRPC metadata is per-call):
+        # every request pipelined on this stream joins that trace.
+        stream_trace_context = _trace_context(context)
 
         # Bounded: the old sequential `yield from` backpressured
         # through HTTP/2 flow control; with threaded dispatch a
@@ -112,7 +135,9 @@ class InferenceServicer(GRPCInferenceServiceServicer):
             return False
 
         def run_one(request):
-            generator = self._core.stream_infer(request)
+            mint_request_id(request)
+            generator = self._core.stream_infer(
+                request, trace_context=stream_trace_context)
             try:
                 for response in generator:
                     if cancelled.is_set() or not put_out(response):

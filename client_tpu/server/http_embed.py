@@ -291,7 +291,12 @@ def _infer(core, m, headers, body):
     infer_request = decode_infer_request(
         body, m.group("model"), m.group("version") or "",
         int(header_length) if header_length else None)
-    response = core.infer(infer_request)
+    from client_tpu.server.core import mint_request_id
+
+    mint_request_id(infer_request)
+    # header names are lower-cased by the caller (http_call contract)
+    response = core.infer(infer_request,
+                          trace_context=headers.get("traceparent"))
     binary_prefs = {}
     default_binary = False
     for tensor in infer_request.outputs:

@@ -576,7 +576,13 @@ def build_http_app(core: InferenceServerCore) -> web.Application:
                 request.match_info.get("version", ""),
                 int(header_length) if header_length else None,
             )
-            response = await _run(core.infer, infer_request)
+            from client_tpu.server.core import mint_request_id
+
+            mint_request_id(infer_request)
+            # W3C trace-context propagation: a caller-supplied
+            # traceparent joins the server span tree to the client's.
+            response = await _run(core.infer, infer_request,
+                                  request.headers.get("traceparent"))
             binary_prefs = {}
             default_binary = False  # pure-JSON clients get JSON back
             for tensor in infer_request.outputs:

@@ -62,6 +62,7 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from client_tpu.server import tracing as spantrace
 from client_tpu.utils import InferenceServerException, triton_to_np_dtype
 
 NANOS_PER_US = 1_000
@@ -202,10 +203,13 @@ class SequenceScheduler:
     # -- request path -----------------------------------------------------
 
     def infer(self, inputs: Dict[str, np.ndarray], params: dict,
-              batch: int):
+              batch: int, trace=None):
         """Executes one sequence step; returns
         ``(outputs, queue_ns, executions)`` where executions follows
         the dynamic batcher's leader accounting (0 for fused riders).
+        ``trace`` is the request's RequestTrace when sampled: the slot
+        wait and (direct-strategy) device execution record spans, and
+        fused steps carry the trace into the dynamic batcher.
         """
         corrid = params.get("sequence_id")
         start = bool(params.get("sequence_start"))
@@ -217,7 +221,13 @@ class SequenceScheduler:
         except Exception:
             self._release_turn(slot, end=False)
             raise
-        queue_ns = time.monotonic_ns() - entry_ns
+        turn_ns = time.monotonic_ns()
+        queue_ns = turn_ns - entry_ns
+        if trace is not None:
+            trace.add_timed(
+                spantrace.SPAN_SEQUENCE_WAIT, entry_ns, turn_ns,
+                {"slot": slot.index, "corrid": str(corrid),
+                 "start": start, "end": end})
         try:
             exec_inputs = dict(inputs)
             if self._controls:
@@ -230,17 +240,24 @@ class SequenceScheduler:
                     if not k.startswith("sequence_")
                 }
                 outputs, fuse_queue_ns, leader = self._batcher.infer(
-                    exec_inputs, exec_params, batch)
+                    exec_inputs, exec_params, batch, trace=trace,
+                    queue_from_ns=turn_ns if trace is not None else 0)
                 queue_ns += fuse_queue_ns
                 executions = 1 if leader else 0
                 with self._cv:
                     self._fused_step_total += 1
             else:
+                exec_span = (trace.begin(
+                    spantrace.SPAN_DEVICE_EXECUTE,
+                    attrs={"sequence_step": True})
+                    if trace is not None else None)
                 exec_params = params if self._pass_params else {
                     k: v for k, v in params.items()
                     if not k.startswith("sequence_")
                 }
                 outputs = self._model.infer(exec_inputs, exec_params)
+                if exec_span is not None:
+                    trace.end(exec_span)
                 executions = 1
             if self._states:
                 outputs = self._extract_state(outputs, slot)

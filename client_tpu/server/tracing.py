@@ -1,0 +1,281 @@
+"""Server-side span recorder: per-request timelines across every
+serving stage.
+
+The Dapper-style (Sigelman et al., 2010) replacement for the old flat
+t0-t3 trace record: a sampled request carries a :class:`RequestTrace`
+through the core, cache, sequence scheduler, and dynamic batcher, and
+each stage records a :class:`Span` — monotonic-ns bounds, a parent
+link, and a small attribute dict. Stages that serve several requests
+with ONE piece of work (a fused batch execution, the batched relay
+fetch) record a *shared* span: the same span id appears in every
+member request's trace, so a reader can both attribute the time to
+each request and recognize the work was done once.
+
+Design constraints:
+
+* **Near-zero cost when sampled out.** An unsampled request carries
+  ``trace=None`` and every instrumentation point is a single ``is
+  None`` check — no allocation, no clock read, no lock.
+* **Thread-safe per trace.** The request thread records decode/encode
+  while scheduler pool threads record queue/execute/fetch; appends
+  take the trace's own lock (uncontended in practice — the request
+  thread is parked on an event while pool threads run).
+* **Transport-joinable.** A trace created with a W3C ``traceparent``
+  (client_tpu.tracing) adopts the caller's trace id and parents its
+  root span under the client span, so client and server spans form
+  one tree.
+
+Export formats (the ``trace_mode`` setting, rendered by
+:func:`compact_record` / :func:`chrome_events`):
+
+* ``compact`` — one JSON line per request: spans + the legacy
+  five-point ``timestamps`` list (REQUEST_START..REQUEST_END), so
+  pre-span consumers keep working.
+* ``chrome`` — Chrome trace / Perfetto "X" (complete) events, one
+  request per tid; open the file in https://ui.perfetto.dev.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from client_tpu.tracing import (  # noqa: F401 — re-exported for servers
+    TRACEPARENT_HEADER,
+    new_span_id,
+    new_trace_id,
+    parse_traceparent,
+)
+
+TRACE_MODES = ("compact", "chrome")
+
+# Span names are the stable contract the perf harness's stage
+# attribution maps on (client_tpu.perf.report.STAGE_SPANS); add new
+# stages there too or they land in the "other" bucket.
+SPAN_REQUEST = "request"
+SPAN_DECODE = "decode"
+SPAN_CACHE_LOOKUP = "cache_lookup"
+SPAN_CACHE_WAIT = "cache_wait"
+SPAN_CACHE_INSERT = "cache_insert"
+SPAN_QUEUE = "queue"
+SPAN_SEQUENCE_WAIT = "sequence_slot_wait"
+SPAN_BATCH_EXECUTE = "batch_execute"
+SPAN_DEVICE_EXECUTE = "device_execute"
+SPAN_RELAY_FETCH = "relay_fetch"
+SPAN_ENCODE = "encode"
+SPAN_STREAM_RESPONSE = "stream_response"
+
+
+class Span:
+    __slots__ = ("name", "span_id", "parent_id", "start_ns", "end_ns",
+                 "attrs")
+
+    def __init__(self, name: str, span_id: str, parent_id: Optional[str],
+                 start_ns: int, end_ns: int = 0,
+                 attrs: Optional[dict] = None):
+        self.name = name
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.start_ns = start_ns
+        self.end_ns = end_ns
+        self.attrs = attrs
+
+    def as_dict(self) -> dict:
+        out = {
+            "name": self.name,
+            "span_id": self.span_id,
+            "parent_span_id": self.parent_id,
+            "start_ns": self.start_ns,
+            "end_ns": self.end_ns,
+        }
+        if self.attrs:
+            out["attrs"] = self.attrs
+        return out
+
+
+def shared_span(name: str, start_ns: int, end_ns: int,
+                attrs: Optional[dict] = None) -> Span:
+    """A span representing work shared by several requests (fused
+    batch execute, batched relay fetch). It has no parent — each
+    member trace records it at top level with ``shared: true`` so
+    tree readers treat it as a link, not a child."""
+    attrs = dict(attrs) if attrs else {}
+    attrs["shared"] = True
+    return Span(name, new_span_id(), None, start_ns, end_ns, attrs)
+
+
+class RequestTrace:
+    """One sampled request's span tree (plus bookkeeping the core
+    needs at emit time)."""
+
+    __slots__ = ("trace_id", "parent_span_id", "root", "spans", "_lock",
+                 "timeline")
+
+    def __init__(self, trace_context: Optional[str] = None,
+                 attrs: Optional[dict] = None):
+        parsed = parse_traceparent(trace_context)
+        if parsed is not None:
+            self.trace_id, self.parent_span_id = parsed
+        else:
+            self.trace_id, self.parent_span_id = new_trace_id(), None
+        self.root = Span(SPAN_REQUEST, new_span_id(), self.parent_span_id,
+                         time.monotonic_ns(), attrs=attrs or {})
+        self.spans: List[Span] = []
+        self._lock = threading.Lock()
+        # Optional legacy five-point timeline (t0, queue_start,
+        # compute_start, compute_end, t3) set by the executed path;
+        # emit falls back to the root bounds when absent.
+        self.timeline = None
+
+    # -- recording --------------------------------------------------------
+
+    def begin(self, name: str, parent: Optional[Span] = None,
+              attrs: Optional[dict] = None) -> Span:
+        """Starts a span (child of the root unless ``parent`` given).
+        The span is recorded at END time so readers never see
+        half-open spans."""
+        parent_id = (parent or self.root).span_id
+        return Span(name, new_span_id(), parent_id, time.monotonic_ns(),
+                    attrs=attrs)
+
+    def end(self, span: Span, attrs: Optional[dict] = None) -> Span:
+        span.end_ns = time.monotonic_ns()
+        if attrs:
+            span.attrs = dict(span.attrs or {})
+            span.attrs.update(attrs)
+        self.add(span)
+        return span
+
+    def add(self, span: Span) -> None:
+        """Records a finished span (also the entry point for shared
+        spans built by the batcher)."""
+        with self._lock:
+            self.spans.append(span)
+
+    def add_timed(self, name: str, start_ns: int, end_ns: int,
+                  attrs: Optional[dict] = None) -> Span:
+        """Records a span from explicit bounds (for stages timed with
+        existing counters, e.g. the batcher's queue wait)."""
+        span = Span(name, new_span_id(), self.root.span_id, start_ns,
+                    end_ns, attrs)
+        self.add(span)
+        return span
+
+    def finish(self, error: Optional[str] = None) -> None:
+        """Closes the root span. On success the root ends where the
+        LAST recorded span ends — the post-span slice is only stack
+        unwind, stats bookkeeping, and scheduler wake noise, and
+        counting it would make every stage table read "x% unattributed
+        overhead" on contended hosts (the client-visible tail is the
+        harness's latency percentiles' job). Failed requests keep a
+        fresh clock read: the path to the failure point is exactly
+        what their root must cover."""
+        with self._lock:
+            last_ns = max((s.end_ns for s in self.spans), default=0)
+        if error or not last_ns:
+            self.root.end_ns = time.monotonic_ns()
+        else:
+            self.root.end_ns = max(last_ns, self.root.start_ns)
+        if error:
+            self.root.attrs = dict(self.root.attrs or {})
+            self.root.attrs["error"] = error
+
+    def snapshot(self) -> List[Span]:
+        with self._lock:
+            return [self.root] + list(self.spans)
+
+
+# -- rendering ------------------------------------------------------------
+
+
+def _legacy_timestamps(trace: RequestTrace) -> List[dict]:
+    """The pre-span five-point timeline, derived from the explicit
+    timeline when the executed path recorded one, else degenerate at
+    the root bounds (cache hits never queue or compute)."""
+    if trace.timeline is not None:
+        t0, t_queue, t_compute, t_end_compute, t3 = trace.timeline
+    else:
+        t0 = t_queue = t_compute = t_end_compute = trace.root.start_ns
+        t3 = trace.root.end_ns or t0
+    return [
+        {"name": "REQUEST_START", "ns": t0},
+        {"name": "QUEUE_START", "ns": t_queue},
+        {"name": "COMPUTE_START", "ns": t_compute},
+        {"name": "COMPUTE_END", "ns": t_end_compute},
+        {"name": "REQUEST_END", "ns": t3},
+    ]
+
+
+def compact_record(trace: RequestTrace, record_id: int, model_name: str,
+                   request_id: str) -> dict:
+    """One JSON-able record per request for ``trace_mode=compact``."""
+    return {
+        "id": record_id,
+        "model_name": model_name,
+        "request_id": request_id,
+        "trace_id": trace.trace_id,
+        "parent_span_id": trace.parent_span_id,
+        "timestamps": _legacy_timestamps(trace),
+        "spans": [span.as_dict() for span in trace.snapshot()],
+    }
+
+
+def chrome_events(trace: RequestTrace, record_id: int, model_name: str,
+                  request_id: str) -> List[dict]:
+    """Chrome-trace complete ("X") events for ``trace_mode=chrome``.
+    One pid per model, one tid per request; ts/dur are microseconds
+    (floats keep sub-us spans visible in Perfetto). The pid is a
+    stable digest — builtin hash() is salted per process, which would
+    scatter one model across pids between runs."""
+    import zlib
+
+    pid = zlib.crc32(model_name.encode()) % 100000
+    tid = record_id
+    events: List[dict] = [{
+        "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+        "args": {"name": "req %s %s" % (request_id, trace.trace_id[:8])},
+    }, {
+        "name": "process_name", "ph": "M", "pid": pid,
+        "args": {"name": "model %s" % model_name},
+    }]
+    for span in trace.snapshot():
+        end_ns = span.end_ns or span.start_ns
+        event = {
+            "name": span.name,
+            "ph": "X",
+            "pid": pid,
+            "tid": tid,
+            "ts": span.start_ns / 1000.0,
+            "dur": max(end_ns - span.start_ns, 0) / 1000.0,
+            "args": {
+                "span_id": span.span_id,
+                "parent_span_id": span.parent_id,
+                "trace_id": trace.trace_id,
+                "request_id": request_id,
+            },
+        }
+        if span.attrs:
+            event["args"].update(span.attrs)
+        events.append(event)
+    return events
+
+
+# -- stage attribution ----------------------------------------------------
+
+def stage_durations(spans: List[dict],
+                    stage_map: Dict[str, str]) -> Dict[str, int]:
+    """Sums span durations (ns) into stages per ``stage_map``
+    ({span_name: stage}); unmapped non-root spans land in "other".
+    Shared spans count fully toward each member request (attribution
+    view, not a work count)."""
+    out: Dict[str, int] = {}
+    for span in spans:
+        name = span.get("name", "")
+        if name == SPAN_REQUEST:
+            continue
+        stage = stage_map.get(name, "other")
+        duration = max(
+            int(span.get("end_ns", 0)) - int(span.get("start_ns", 0)), 0)
+        out[stage] = out.get(stage, 0) + duration
+    return out

@@ -1,0 +1,208 @@
+"""Client-side trace context: W3C traceparent propagation + a
+lightweight client span recorder.
+
+The client half of the end-to-end span story (docs/tracing.md): every
+client (HTTP/gRPC x sync/aio) can carry a :class:`ClientTracer`; each
+``infer`` then either adopts a caller-supplied ``traceparent`` header
+or mints one, records a client-side send/receive span, and ships the
+context to the server as the standard W3C ``traceparent`` HTTP header
+/ gRPC metadata entry. A server whose sampler picks the request up
+joins the SAME trace id, with the client span as the server root
+span's parent — one tree across the wire.
+
+Kept dependency-free and transport-neutral so both the clients and
+the server's span recorder (client_tpu.server.tracing) share one
+definition of the wire format.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+TRACEPARENT_HEADER = "traceparent"
+
+# W3C trace-context version we emit; '01' flags = sampled.
+_VERSION = "00"
+_SAMPLED = "01"
+
+
+# Ids come from a PRNG seeded once from the OS: os.urandom costs ~10us
+# per call on older kernels, and a sampled request mints 8+ ids — the
+# syscall alone would dominate the span recorder's budget. Trace/span
+# ids need uniqueness, not cryptographic strength. random.getrandbits
+# is a single C call (atomic under the GIL), so this is thread-safe.
+_rng = __import__("random").Random(int.from_bytes(os.urandom(8), "big"))
+
+
+def new_trace_id() -> str:
+    """128-bit random trace id, 32 lowercase hex chars."""
+    return "%032x" % _rng.getrandbits(128)
+
+
+def new_span_id() -> str:
+    """64-bit random span id, 16 lowercase hex chars (never zero —
+    the W3C all-zero parent id means 'absent')."""
+    return "%016x" % (_rng.getrandbits(64) | 1)
+
+
+def format_traceparent(trace_id: str, span_id: str) -> str:
+    """``00-<trace-id>-<parent-id>-01`` (always flagged sampled; the
+    server applies its own trace_rate on top)."""
+    return "-".join((_VERSION, trace_id, span_id, _SAMPLED))
+
+
+def parse_traceparent(value: Optional[str]
+                      ) -> Optional[Tuple[str, str]]:
+    """(trace_id, parent_span_id) from a traceparent header, or None
+    when absent/malformed (a bad header must never fail a request)."""
+    if not value:
+        return None
+    parts = value.strip().split("-")
+    if len(parts) < 4:
+        return None
+    _version, trace_id, span_id = parts[0], parts[1], parts[2]
+    if len(trace_id) != 32 or len(span_id) != 16:
+        return None
+    try:
+        int(trace_id, 16)
+        int(span_id, 16)
+    except ValueError:
+        return None
+    if int(trace_id, 16) == 0 or int(span_id, 16) == 0:
+        return None
+    return trace_id, span_id
+
+
+class ClientSpan:
+    """One client-side send/receive span. Use as a context manager or
+    call :meth:`finish` explicitly; the span is recorded either way."""
+
+    __slots__ = ("tracer", "name", "trace_id", "span_id", "start_ns",
+                 "end_ns", "attrs", "_done")
+
+    def __init__(self, tracer: "ClientTracer", name: str, trace_id: str,
+                 span_id: str, attrs: Optional[dict] = None):
+        self.tracer = tracer
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.start_ns = time.monotonic_ns()
+        self.end_ns = 0
+        self.attrs = dict(attrs) if attrs else {}
+        self._done = False
+
+    @property
+    def traceparent(self) -> str:
+        return format_traceparent(self.trace_id, self.span_id)
+
+    def inject(self, headers: Optional[dict]) -> dict:
+        """Returns ``headers`` (a new dict when None) with this span's
+        traceparent set — UNLESS the caller already supplied one (the
+        caller's context wins; this span then joins that trace)."""
+        headers = dict(headers) if headers else {}
+        existing = parse_traceparent(headers.get(TRACEPARENT_HEADER))
+        if existing is not None:
+            self.trace_id, _parent = existing
+        else:
+            headers[TRACEPARENT_HEADER] = self.traceparent
+        return headers
+
+    def finish(self, error: Optional[BaseException] = None) -> None:
+        if self._done:
+            return
+        self._done = True
+        self.end_ns = time.monotonic_ns()
+        if error is not None:
+            self.attrs["error"] = str(error)
+        self.tracer._record(self)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.finish(exc)
+        return False
+
+
+class ClientTracer:
+    """Thread-safe recorder of client-side spans.
+
+    ``path``, when set, appends one JSON line per span on
+    :meth:`flush` (same compact shape as the server's span records, so
+    client and server lines can be joined on ``trace_id``) — and spans
+    auto-flush there every ``flush_every`` records, so a long-lived
+    traced client never grows without bound. Without a path the
+    buffer is a ring capped at ``max_records`` (oldest spans drop):
+    an unconsumed tracer must not become a memory leak.
+    """
+
+    def __init__(self, path: Optional[str] = None,
+                 max_records: int = 10_000, flush_every: int = 100):
+        self.path = path
+        self._max_records = max(int(max_records), 1)
+        self._flush_every = max(int(flush_every), 1)
+        self._lock = threading.Lock()
+        self._spans: List[ClientSpan] = []
+
+    def start_span(self, name: str, model_name: str = "",
+                   request_id: str = "",
+                   headers: Optional[dict] = None) -> ClientSpan:
+        """Starts a client span, adopting a caller-supplied
+        traceparent from ``headers`` when present."""
+        existing = parse_traceparent(
+            (headers or {}).get(TRACEPARENT_HEADER))
+        trace_id = existing[0] if existing else new_trace_id()
+        attrs = {}
+        if model_name:
+            attrs["model"] = model_name
+        if request_id:
+            attrs["request_id"] = request_id
+        return ClientSpan(self, name, trace_id, new_span_id(), attrs)
+
+    def _record(self, span: ClientSpan) -> None:
+        flush_now = False
+        with self._lock:
+            self._spans.append(span)
+            if self.path:
+                flush_now = len(self._spans) >= self._flush_every
+            elif len(self._spans) > self._max_records:
+                del self._spans[:len(self._spans) - self._max_records]
+        if flush_now:
+            try:
+                self.flush()
+            except OSError:
+                pass  # tracing must never fail the request path
+
+    def records(self) -> List[dict]:
+        """Snapshot of recorded spans as JSON-able dicts."""
+        with self._lock:
+            spans = list(self._spans)
+        return [
+            {
+                "name": s.name,
+                "trace_id": s.trace_id,
+                "span_id": s.span_id,
+                "start_ns": s.start_ns,
+                "end_ns": s.end_ns,
+                "attrs": dict(s.attrs),
+            }
+            for s in spans
+        ]
+
+    def flush(self) -> int:
+        """Appends recorded spans to ``path`` as JSON lines and clears
+        the buffer; returns the number written (0 with no path)."""
+        import json
+
+        records = self.records()
+        with self._lock:
+            self._spans = []
+        if not self.path or not records:
+            return 0
+        with open(self.path, "a") as f:
+            for record in records:
+                f.write(json.dumps(record) + "\n")
+        return len(records)

@@ -19,7 +19,10 @@ def embedded():
 
 
 def _simple_request():
-    request = pb.ModelInferRequest(model_name="simple")
+    # Explicit id: the server mints a fresh one per request when the
+    # client sends none (request-id correlation), so byte-for-byte
+    # comparisons across calls need a pinned id.
+    request = pb.ModelInferRequest(model_name="simple", id="embed-req")
     for name in ("INPUT0", "INPUT1"):
         tensor = request.inputs.add()
         tensor.name = name

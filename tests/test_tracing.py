@@ -1,0 +1,569 @@
+"""End-to-end span tracing: settings semantics, golden span trees for
+every scheduler path, W3C trace-context propagation from all four
+clients, and request-id correlation (PR 6).
+
+One core serves BOTH transports so trace settings/records can be
+asserted against the same sampling state regardless of which front-end
+carried the request.
+"""
+
+import asyncio
+import json
+import logging
+import threading
+
+import numpy as np
+import pytest
+
+import client_tpu.grpc as grpcclient
+import client_tpu.http as httpclient
+from client_tpu._infer_common import InferInput
+from client_tpu.grpc._utils import get_inference_request
+from client_tpu.server.app import build_core, start_grpc_server
+from client_tpu.server.http_server import start_http_server_thread
+from client_tpu.tracing import ClientTracer, format_traceparent, parse_traceparent
+from client_tpu.utils import InferenceServerException
+
+
+@pytest.fixture(scope="module")
+def stack():
+    core = build_core(["simple", "simple_cache", "add_sub_fp32",
+                       "dyna_sequence", "repeat_int32"])
+    grpc_handle = start_grpc_server(core=core, address="127.0.0.1:0")
+    http_runner = start_http_server_thread(core, host="127.0.0.1", port=0)
+    yield {"core": core, "grpc": grpc_handle.address,
+           "http": "127.0.0.1:%d" % http_runner.port}
+    # stop() flips ready + shuts the core down; the runner rides along.
+    http_runner.stop()
+    grpc_handle.stop()
+
+
+@pytest.fixture()
+def core(stack):
+    yield stack["core"]
+    # Leave tracing off between tests, whatever a test configured.
+    stack["core"].trace_setting("", {"trace_level": ["OFF"]})
+    stack["core"].trace_setting("simple", {"trace_level": []})
+
+
+def _enable(core, path, model="", rate=1, count=-1, freq=1,
+            mode="compact"):
+    core.trace_setting(model or "", {
+        "trace_level": ["TIMESTAMPS"], "trace_rate": [str(rate)],
+        "trace_count": [str(count)], "log_frequency": [str(freq)],
+        "trace_file": [str(path)], "trace_mode": [mode]})
+
+
+def _records(path):
+    out = []
+    for line in open(path):
+        line = line.strip()
+        if line:
+            out.append(json.loads(line))
+    return out
+
+
+def _request(model="simple", seed=0, batched=False, request_id="",
+             sequence_id=0, sequence_start=False, sequence_end=False):
+    shape = [1, 16] if batched else [16]
+    in0 = InferInput("INPUT0", shape, "INT32")
+    in0.set_data_from_numpy(
+        (np.arange(16, dtype=np.int32) + seed).reshape(shape))
+    in1 = InferInput("INPUT1", shape, "INT32")
+    in1.set_data_from_numpy(np.ones(shape, dtype=np.int32))
+    return get_inference_request(
+        model_name=model, inputs=[in0, in1], model_version="",
+        outputs=None, request_id=request_id, sequence_id=sequence_id,
+        sequence_start=sequence_start, sequence_end=sequence_end,
+        priority=0, timeout=None)
+
+
+def _span_names(record):
+    return [s["name"] for s in record["spans"]]
+
+
+def _span(record, name):
+    for s in record["spans"]:
+        if s["name"] == name:
+            return s
+    return None
+
+
+# -- settings semantics ---------------------------------------------------
+
+
+def test_per_model_override_and_revert_on_clear(core):
+    baseline = core.trace_setting("", {})
+    core.trace_setting("", {"trace_rate": ["7"]})
+    try:
+        core.trace_setting("simple", {"trace_rate": ["3"]})
+        assert core.trace_setting("simple", {})["trace_rate"] == ["3"]
+        # Other models keep following the global value.
+        assert core.trace_setting("add_sub_fp32", {})["trace_rate"] \
+            == ["7"]
+        # Clearing the per-model key reverts it to the global value
+        # (a copy taken at clear time — the documented semantics).
+        core.trace_setting("simple", {"trace_rate": []})
+        assert core.trace_setting("simple", {})["trace_rate"] == ["7"]
+        # A model never updated is NOT frozen by reads: later global
+        # updates flow through to it.
+        core.trace_setting("", {"trace_rate": ["9"]})
+        assert core.trace_setting("add_sub_fp32", {})["trace_rate"] \
+            == ["9"]
+    finally:
+        core.trace_setting(
+            "", {"trace_rate": baseline.get("trace_rate") or ["1000"]})
+
+
+def test_trace_mode_setting_default_and_roundtrip(core):
+    settings = core.trace_setting("", {})
+    assert settings.get("trace_mode") == ["compact"]
+    core.trace_setting("simple", {"trace_mode": ["chrome"]})
+    assert core.trace_setting("simple", {})["trace_mode"] == ["chrome"]
+    core.trace_setting("simple", {"trace_mode": []})
+    assert core.trace_setting("simple", {})["trace_mode"] == ["compact"]
+
+
+def test_trace_count_rearm_on_update_http(stack, core, tmp_path):
+    """trace_count caps emission; a settings update re-arms the
+    counters (Triton semantics) — exercised over the HTTP settings
+    endpoint this time (the gRPC path has its own e2e test)."""
+    path = tmp_path / "rearm.jsonl"
+    with httpclient.InferenceServerClient(stack["http"]) as client:
+        client.update_trace_settings("simple", {
+            "trace_level": ["TIMESTAMPS"], "trace_rate": "1",
+            "trace_count": "2", "log_frequency": "1",
+            "trace_file": str(path)})
+        _, _, inputs = _http_inputs()
+        for _ in range(4):
+            client.infer("simple", inputs)
+        assert len(_records(path)) == 2
+        client.update_trace_settings("simple", {
+            "trace_level": ["TIMESTAMPS"], "trace_rate": "1",
+            "trace_count": "3", "log_frequency": "1",
+            "trace_file": str(path)})
+        for _ in range(5):
+            client.infer("simple", inputs)
+        assert len(_records(path)) == 5  # 2 + re-armed 3
+        client.update_trace_settings("simple", {"trace_level": ["OFF"]})
+
+
+def test_buffered_flush_under_pre_update_settings(core, tmp_path):
+    """Records buffered under log_frequency land in the file they were
+    recorded FOR when a settings update redirects the sink: the buffer
+    is flushed under its pre-update settings."""
+    old = tmp_path / "pre.jsonl"
+    new = tmp_path / "post.jsonl"
+    _enable(core, old, model="simple", freq=100)
+    for i in range(3):
+        core.infer(_request(seed=i))
+    assert not old.exists() or not _records(old)  # still buffered
+    _enable(core, new, model="simple", freq=1)
+    assert len(_records(old)) == 3  # flushed into the OLD file
+    core.infer(_request(seed=99))
+    assert len(_records(new)) == 1  # new records go to the new sink
+    core.trace_setting("simple", {"trace_level": ["OFF"]})
+
+
+def test_shutdown_flushes_buffered_records(tmp_path):
+    own_core = build_core(["simple"])
+    path = tmp_path / "shutdown.jsonl"
+    _enable(own_core, path, freq=1000)
+    own_core.infer(_request())
+    own_core.shutdown()
+    records = _records(path)
+    assert len(records) == 1
+    assert records[0]["model_name"] == "simple"
+
+
+# -- golden span trees ----------------------------------------------------
+
+
+def test_direct_path_span_tree_and_legacy_timestamps(core, tmp_path):
+    path = tmp_path / "direct.jsonl"
+    _enable(core, path, model="simple")
+    response = core.infer(_request(seed=5, request_id="direct-1"))
+    core.trace_setting("simple", {"trace_level": ["OFF"]})
+    (record,) = _records(path)
+    names = _span_names(record)
+    assert names[0] == "request"
+    assert "decode" in names and "device_execute" in names \
+        and "encode" in names
+    # Legacy five-point timeline rides along, monotonic.
+    stamps = [t["ns"] for t in record["timestamps"]]
+    assert [t["name"] for t in record["timestamps"]] == [
+        "REQUEST_START", "QUEUE_START", "COMPUTE_START", "COMPUTE_END",
+        "REQUEST_END"]
+    assert stamps == sorted(stamps)
+    # The id echoes on the response and stamps the trace record.
+    assert response.id == "direct-1"
+    assert record["request_id"] == "direct-1"
+    # Non-root spans parent to the root.
+    root = _span(record, "request")
+    for span in record["spans"][1:]:
+        if not (span.get("attrs") or {}).get("shared"):
+            assert span["parent_span_id"] == root["span_id"]
+
+
+def test_cache_hit_miss_and_singleflight_follower_span_trees(
+        core, tmp_path):
+    path = tmp_path / "cache.jsonl"
+    _enable(core, path, model="simple_cache")
+    core.infer(_request("simple_cache", seed=301, batched=True))
+    core.infer(_request("simple_cache", seed=301, batched=True))
+    # Single-flight: a barrier burst of identical NEW requests — one
+    # leads (miss), the rest coalesce as followers inside the leader's
+    # ~1 ms gather window.
+    burst = 4
+    barrier = threading.Barrier(burst)
+    request_proto = _request("simple_cache", seed=302, batched=True)
+
+    def fire():
+        barrier.wait()
+        core.infer(request_proto)
+
+    pool = [threading.Thread(target=fire) for _ in range(burst)]
+    for t in pool:
+        t.start()
+    for t in pool:
+        t.join()
+    core.trace_setting("simple_cache", {"trace_level": ["OFF"]})
+    records = _records(path)
+    outcomes = [
+        (_span(r, "cache_lookup") or {}).get("attrs", {}).get("outcome")
+        for r in records
+    ]
+    assert outcomes[0] == "miss"
+    assert outcomes[1] == "hit"
+    # Miss rides the scheduler: queue + shared batch execution +
+    # relay fetch + insert all visible.
+    miss = records[0]
+    for name in ("decode", "queue", "batch_execute", "relay_fetch",
+                 "encode", "cache_insert"):
+        assert name in _span_names(miss), name
+    assert (_span(miss, "batch_execute")["attrs"] or {}).get("shared")
+    # Hit bypasses everything: lookup only, no execution spans.
+    hit = records[1]
+    assert "batch_execute" not in _span_names(hit)
+    assert "queue" not in _span_names(hit)
+    burst_outcomes = outcomes[2:]
+    assert burst_outcomes.count("miss") == 1
+    assert any(o in ("follower", "hit") for o in burst_outcomes)
+    for record, outcome in zip(records[2:], burst_outcomes):
+        if outcome == "follower":
+            wait = _span(record, "cache_wait")
+            assert wait is not None
+            assert wait["attrs"]["outcome"] == "served"
+
+
+def test_fused_requests_share_one_batch_execute_span(core, tmp_path):
+    """Two distinct concurrent requests fused by the dynamic batcher
+    record THE SAME batch-execution span (same span id, requests=2) —
+    the trace-level proof of fusion."""
+    for attempt in range(4):
+        path = tmp_path / ("fused%d.jsonl" % attempt)
+        _enable(core, path, model="simple_cache")
+        barrier = threading.Barrier(2)
+        seeds = (1000 + attempt * 10, 1001 + attempt * 10)
+
+        def fire(seed):
+            barrier.wait()
+            core.infer(_request("simple_cache", seed=seed, batched=True))
+
+        pool = [threading.Thread(target=fire, args=(s,)) for s in seeds]
+        for t in pool:
+            t.start()
+        for t in pool:
+            t.join()
+        core.trace_setting("simple_cache", {"trace_level": ["OFF"]})
+        records = _records(path)
+        spans = [_span(r, "batch_execute") for r in records]
+        if all(s is not None for s in spans) \
+                and spans[0]["span_id"] == spans[1]["span_id"]:
+            assert spans[0]["attrs"]["requests"] == 2
+            assert spans[0]["attrs"]["shared"] is True
+            return
+    pytest.fail("requests never fused into one batch-execution span "
+                "in 4 attempts")
+
+
+def test_sequence_step_span_tree(core, tmp_path):
+    path = tmp_path / "sequence.jsonl"
+    _enable(core, path, model="dyna_sequence")
+    in0 = InferInput("INPUT", [1, 1], "INT32")
+    in0.set_data_from_numpy(np.array([[7]], dtype=np.int32))
+    start = get_inference_request(
+        model_name="dyna_sequence", inputs=[in0], model_version="",
+        outputs=None, request_id="seq-step", sequence_id=4242,
+        sequence_start=True, sequence_end=False, priority=0,
+        timeout=None)
+    end = get_inference_request(
+        model_name="dyna_sequence", inputs=[in0], model_version="",
+        outputs=None, request_id="", sequence_id=4242,
+        sequence_start=False, sequence_end=True, priority=0,
+        timeout=None)
+    core.infer(start)
+    core.infer(end)
+    core.trace_setting("dyna_sequence", {"trace_level": ["OFF"]})
+    records = _records(path)
+    assert len(records) == 2
+    first = records[0]
+    wait = _span(first, "sequence_slot_wait")
+    assert wait is not None
+    assert wait["attrs"]["corrid"] == "4242"
+    assert wait["attrs"]["start"] is True
+    # Oldest strategy: the step dispatched through the dynamic batcher.
+    assert "queue" in _span_names(first)
+    assert "batch_execute" in _span_names(first)
+    assert first["request_id"] == "seq-step"
+
+
+def test_decoupled_stream_per_response_spans(core, tmp_path):
+    path = tmp_path / "stream.jsonl"
+    _enable(core, path, model="repeat_int32")
+    tensor = InferInput("IN", [3], "INT32")
+    tensor.set_data_from_numpy(np.array([4, 5, 6], dtype=np.int32))
+    request = get_inference_request(
+        model_name="repeat_int32", inputs=[tensor], model_version="",
+        outputs=None, request_id="", sequence_id=0,
+        sequence_start=False, sequence_end=False, priority=0,
+        timeout=None)
+    responses = list(core.stream_infer(request))
+    core.trace_setting("repeat_int32", {"trace_level": ["OFF"]})
+    data = [r for r in responses if r.infer_response.outputs]
+    assert len(data) == 3
+    (record,) = _records(path)
+    stream_spans = [s for s in record["spans"]
+                    if s["name"] == "stream_response"]
+    assert [s["attrs"]["index"] for s in stream_spans] == [0, 1, 2]
+    assert "decode" in _span_names(record)
+
+
+def test_chrome_trace_mode_emits_perfetto_events(core, tmp_path):
+    path = tmp_path / "chrome.json"
+    _enable(core, path, model="simple", mode="chrome")
+    core.infer(_request(seed=77))
+    core.trace_setting("simple", {"trace_level": ["OFF"]})
+    text = path.read_text()
+    assert text.startswith("[")
+    # The chrome format allows the missing close bracket; complete it
+    # to parse here.
+    events = json.loads(text.rstrip().rstrip(",") + "]")
+    phases = {e.get("ph") for e in events}
+    assert "X" in phases and "M" in phases
+    names = [e["name"] for e in events if e.get("ph") == "X"]
+    assert "request" in names and "device_execute" in names
+    request_event = next(e for e in events if e["name"] == "request")
+    assert request_event["args"]["trace_id"]
+    assert request_event["dur"] > 0
+
+
+# -- trace-context propagation (all four clients) -------------------------
+
+
+def _http_inputs():
+    in0 = np.arange(16, dtype=np.int32)
+    in1 = np.ones(16, dtype=np.int32)
+    inputs = [httpclient.InferInput("INPUT0", [16], "INT32"),
+              httpclient.InferInput("INPUT1", [16], "INT32")]
+    inputs[0].set_data_from_numpy(in0)
+    inputs[1].set_data_from_numpy(in1)
+    return in0, in1, inputs
+
+
+def _grpc_inputs():
+    in0 = np.arange(16, dtype=np.int32)
+    in1 = np.ones(16, dtype=np.int32)
+    inputs = [grpcclient.InferInput("INPUT0", [16], "INT32"),
+              grpcclient.InferInput("INPUT1", [16], "INT32")]
+    inputs[0].set_data_from_numpy(in0)
+    inputs[1].set_data_from_numpy(in1)
+    return in0, in1, inputs
+
+
+def test_propagation_http_sync(stack, core, tmp_path):
+    path = tmp_path / "prop_http.jsonl"
+    _enable(core, path, model="simple")
+    tracer = ClientTracer()
+    with httpclient.InferenceServerClient(stack["http"],
+                                          tracer=tracer) as client:
+        _, _, inputs = _http_inputs()
+        client.infer("simple", inputs, request_id="prop-http")
+    core.trace_setting("simple", {"trace_level": ["OFF"]})
+    (client_record,) = tracer.records()
+    (server_record,) = _records(path)
+    # Same trace id across the wire; the client span parents the
+    # server root.
+    assert server_record["trace_id"] == client_record["trace_id"]
+    assert server_record["parent_span_id"] == client_record["span_id"]
+    assert client_record["attrs"]["transport"] == "http"
+    assert server_record["request_id"] == "prop-http"
+
+
+def test_propagation_grpc_sync_and_caller_supplied(stack, core,
+                                                   tmp_path):
+    path = tmp_path / "prop_grpc.jsonl"
+    _enable(core, path, model="simple")
+    tracer = ClientTracer()
+    with grpcclient.InferenceServerClient(stack["grpc"],
+                                          tracer=tracer) as client:
+        _, _, inputs = _grpc_inputs()
+        client.infer("simple", inputs)
+        # Caller-supplied traceparent wins over the tracer-minted one.
+        supplied = format_traceparent("ab" * 16, "cd" * 8)
+        client.infer("simple", inputs,
+                     headers={"traceparent": supplied})
+    core.trace_setting("simple", {"trace_level": ["OFF"]})
+    records = _records(path)
+    client_records = tracer.records()
+    assert records[0]["trace_id"] == client_records[0]["trace_id"]
+    assert records[0]["parent_span_id"] == client_records[0]["span_id"]
+    assert records[1]["trace_id"] == "ab" * 16
+    assert records[1]["parent_span_id"] == "cd" * 8
+    # The tracer adopted the supplied trace id for its own span too.
+    assert client_records[1]["trace_id"] == "ab" * 16
+
+
+def test_propagation_aio_clients(stack, core, tmp_path):
+    import client_tpu.grpc.aio as grpcaio
+    import client_tpu.http.aio as httpaio
+
+    path = tmp_path / "prop_aio.jsonl"
+    _enable(core, path, model="simple")
+    grpc_tracer = ClientTracer()
+    http_tracer = ClientTracer()
+
+    async def run():
+        async with grpcaio.InferenceServerClient(
+                stack["grpc"], tracer=grpc_tracer) as client:
+            _, _, inputs = _grpc_inputs()
+            await client.infer("simple", inputs)
+        async with httpaio.InferenceServerClient(
+                stack["http"], tracer=http_tracer) as client:
+            _, _, inputs = _http_inputs()
+            await client.infer("simple", inputs)
+
+    asyncio.run(run())
+    core.trace_setting("simple", {"trace_level": ["OFF"]})
+    records = _records(path)
+    assert len(records) == 2
+    (grpc_span,) = grpc_tracer.records()
+    (http_span,) = http_tracer.records()
+    assert records[0]["trace_id"] == grpc_span["trace_id"]
+    assert records[0]["parent_span_id"] == grpc_span["span_id"]
+    assert records[1]["trace_id"] == http_span["trace_id"]
+    assert records[1]["parent_span_id"] == http_span["span_id"]
+
+
+def test_malformed_traceparent_is_ignored(core, tmp_path):
+    path = tmp_path / "malformed.jsonl"
+    _enable(core, path, model="simple")
+    core.infer(_request(), trace_context="zz-not-a-traceparent")
+    core.trace_setting("simple", {"trace_level": ["OFF"]})
+    (record,) = _records(path)
+    assert record["parent_span_id"] is None
+    assert len(record["trace_id"]) == 32
+    assert parse_traceparent("zz-not-a-traceparent") is None
+    assert parse_traceparent(
+        format_traceparent("ab" * 16, "cd" * 8)) == ("ab" * 16, "cd" * 8)
+
+
+# -- request-id correlation -----------------------------------------------
+
+
+def test_request_id_minted_and_echoed_both_transports(stack, core):
+    with httpclient.InferenceServerClient(stack["http"]) as client:
+        _, _, inputs = _http_inputs()
+        result = client.infer("simple", inputs)
+        assert result.get_response().get("id")
+    with grpcclient.InferenceServerClient(stack["grpc"]) as client:
+        _, _, inputs = _grpc_inputs()
+        response = client.infer("simple", inputs)
+        assert response.get_response().id
+        # Caller-supplied ids are preserved verbatim.
+        response = client.infer("simple", inputs, request_id="mine-1")
+        assert response.get_response().id == "mine-1"
+
+
+def test_error_log_carries_request_id(core, caplog):
+    bad = _request(seed=0)
+    bad.id = "failing-req"
+    bad.inputs[0].name = "NO_SUCH_INPUT"
+    with caplog.at_level(logging.DEBUG, logger="client_tpu.server"):
+        with pytest.raises(InferenceServerException):
+            core.infer(bad)
+    assert any("failing-req" in message
+               for message in caplog.messages)
+
+
+def test_tracing_off_has_no_file_side_effects(core, tmp_path):
+    path = tmp_path / "off.jsonl"
+    # Level OFF: nothing written even with a file configured.
+    core.trace_setting("simple", {
+        "trace_level": ["OFF"], "trace_file": [str(path)],
+        "trace_rate": ["1"]})
+    core.infer(_request())
+    assert not path.exists()
+    # Level set but NO file: tracing stays off (no implicit sink).
+    core.trace_setting("simple", {
+        "trace_level": ["TIMESTAMPS"], "trace_file": [""]})
+    core.infer(_request())
+    core.trace_setting("simple", {"trace_level": ["OFF"]})
+    assert not path.exists()
+
+
+# -- metrics lint (satellite) ---------------------------------------------
+
+
+def test_metrics_lint_accepts_live_exposition(core):
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools"))
+    from metrics_lint import check_monotonic, lint_exposition
+
+    core.infer(_request(seed=11))
+    errors, types, before = lint_exposition(core.metrics_text())
+    assert errors == []
+    core.infer(_request(seed=12))
+    errors, types, after = lint_exposition(core.metrics_text())
+    assert errors == []
+    assert check_monotonic(types, before, after) == []
+    assert types.get("nv_inference_count") == "counter"
+
+
+def test_metrics_lint_flags_violations():
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools"))
+    from metrics_lint import check_monotonic, lint_exposition
+
+    bad = "\n".join([
+        '# HELP a_total ok',
+        '# TYPE a_total counter',
+        'a_total{m="x"} 5',
+        'a_total{m="x"} 6',          # duplicate series
+        'orphan_metric 1',           # no HELP/TYPE
+        '# HELP late ok',
+        'late 2',
+        '# TYPE late gauge',         # TYPE after sample
+        '# HELP b_total ok',
+        '# TYPE b_total gauge',      # _total typed gauge
+        'b_total 1',
+    ])
+    errors, types, series = lint_exposition(bad)
+    text = "\n".join(errors)
+    assert "duplicate series" in text
+    assert "orphan_metric" in text
+    assert "TYPE appears after" in text
+    assert "_total but is typed" in text
+    # Monotonicity: a decreasing counter is flagged.
+    decreased = check_monotonic(
+        {"a_total": "counter"}, {("a_total", 'm="x"'): 5.0},
+        {("a_total", 'm="x"'): 4.0})
+    assert decreased and "decreased" in decreased[0]

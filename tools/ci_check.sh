@@ -6,7 +6,7 @@
 # regression gate). Usage: tools/ci_check.sh [min_passed]
 set -u -o pipefail
 
-MIN_PASSED="${1:-428}"
+MIN_PASSED="${1:-448}"
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
 LOG=/tmp/_t1.log
 
@@ -100,6 +100,55 @@ if ! grep -q "client-visible errors: 0 of" "$FO_LOG"; then
 fi
 grep -E "Failover summary|client-visible|failovers|ejections" "$FO_LOG"
 echo "OK: failover smoke passed (100% goodput through an endpoint kill)"
+
+# Metrics lint: the Prometheus exposition must stay well-formed
+# (HELP/TYPE before samples, escaped labels, no duplicate series) and
+# counters must stay monotonic across two scrapes under load.
+echo "metrics lint: exposition format + counter monotonicity"
+LINT_LOG=/tmp/_metrics_lint.log
+if ! timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/metrics_lint.py \
+    > "$LINT_LOG" 2>&1; then
+    echo "FAIL: metrics lint failed" >&2
+    tail -20 "$LINT_LOG" >&2
+    exit 1
+fi
+grep "metrics lint passed" "$LINT_LOG"
+echo "OK: metrics lint passed"
+
+# Trace smoke: perf run with span tracing at trace_rate=1 — the
+# stage-attribution table must be emitted and the instrumented stages
+# must account for >=90% of end-to-end server span time (the span
+# tree tiles the request; a drop below means an uninstrumented stage
+# crept into the serving path).
+echo "trace smoke: perf --trace 1 stage attribution on simple"
+TRACE_LOG=/tmp/_trace_smoke.log
+if ! timeout -k 10 240 env JAX_PLATFORMS=cpu python -m client_tpu.perf \
+    -m simple --service-kind inprocess --request-count 40 -p 4000 \
+    --concurrency-range 4 --trace 1 > "$TRACE_LOG" 2>&1; then
+    echo "FAIL: trace smoke run did not complete" >&2
+    tail -20 "$TRACE_LOG" >&2
+    exit 1
+fi
+if ! grep -q "Trace summary" "$TRACE_LOG"; then
+    echo "FAIL: trace smoke produced no stage-attribution table" >&2
+    tail -20 "$TRACE_LOG" >&2
+    exit 1
+fi
+coverage=$(grep -oE "stage coverage [0-9.]+%" "$TRACE_LOG" | tail -1 \
+    | grep -oE "[0-9.]+")
+if [ -z "$coverage" ]; then
+    echo "FAIL: trace smoke printed no stage-coverage line" >&2
+    tail -20 "$TRACE_LOG" >&2
+    exit 1
+fi
+if ! awk -v c="$coverage" 'BEGIN { exit !(c >= 90.0) }'; then
+    echo "FAIL: stage attribution covers only ${coverage}% of server" \
+         "span time (floor: 90%)" >&2
+    grep -A 10 "Trace summary" "$TRACE_LOG" >&2
+    exit 1
+fi
+grep -A 10 "Trace summary" "$TRACE_LOG"
+echo "OK: trace smoke passed (stage coverage ${coverage}%)"
 
 # Cache smoke: hot-set replay against simple_cache — the replayed set
 # must reach a 100% hit ratio with hit-path p50 well under miss-path

@@ -1,0 +1,241 @@
+#!/usr/bin/env python
+"""Prometheus exposition lint for ``core.metrics_text()``.
+
+Validates the /metrics surface the perf MetricsManager and external
+scrapers consume, then proves counter monotonicity across two scrapes
+taken under concurrent load:
+
+* every sample's family has a ``# HELP`` and ``# TYPE`` line, and both
+  appear BEFORE the family's first sample (Prometheus exposition
+  format requirement);
+* family/label names are legal, label values are properly escaped
+  (no raw ``"``, ``\\`` or newline inside a quoted value);
+* no duplicate series (family + label set appears once per scrape);
+* ``_total``-suffixed families are typed ``counter``;
+* every family typed ``counter`` is monotonically non-decreasing
+  between two scrapes with inference traffic in between.
+
+Run directly (``python tools/metrics_lint.py``) or from
+tools/ci_check.sh; exits non-zero with one line per violation.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+import threading
+from typing import Dict, List, Tuple
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_NAME = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_NAME = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+# One label pair: name="value" with only escaped specials inside.
+_LABEL_PAIR = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\\n]|\\.)*)"')
+
+
+def _parse_sample(line: str):
+    """(family, labels_str, value_str) or None when not a sample."""
+    m = re.match(
+        r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+        r"(?:\{(?P<labels>.*)\})?\s+(?P<value>\S+)\s*$", line)
+    if m is None:
+        return None
+    return m.group("name"), m.group("labels") or "", m.group("value")
+
+
+def lint_exposition(text: str) -> Tuple[List[str], Dict[str, str],
+                                        Dict[Tuple[str, str], float]]:
+    """Lints one exposition payload. Returns (errors, {family: type},
+    {(family, labels): value})."""
+    errors: List[str] = []
+    help_seen: Dict[str, int] = {}
+    type_seen: Dict[str, str] = {}
+    first_sample: Dict[str, int] = {}
+    series: Dict[Tuple[str, str], float] = {}
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = raw.rstrip()
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            parts = line.split(None, 3)
+            if len(parts) < 4:
+                errors.append("line %d: HELP without text: %r"
+                              % (lineno, line))
+                continue
+            family = parts[2]
+            if family in help_seen:
+                errors.append("line %d: duplicate HELP for %s"
+                              % (lineno, family))
+            help_seen.setdefault(family, lineno)
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) != 4:
+                errors.append("line %d: malformed TYPE: %r"
+                              % (lineno, line))
+                continue
+            family, kind = parts[2], parts[3]
+            if kind not in ("counter", "gauge", "histogram", "summary",
+                            "untyped"):
+                errors.append("line %d: unknown TYPE %r for %s"
+                              % (lineno, kind, family))
+            if family in type_seen:
+                errors.append("line %d: duplicate TYPE for %s"
+                              % (lineno, family))
+            type_seen.setdefault(family, kind)
+            continue
+        if line.startswith("#"):
+            continue
+        sample = _parse_sample(line)
+        if sample is None:
+            errors.append("line %d: unparseable sample: %r"
+                          % (lineno, line))
+            continue
+        family, labels_str, value_str = sample
+        first_sample.setdefault(family, lineno)
+        if not _NAME.match(family):
+            errors.append("line %d: illegal family name %r"
+                          % (lineno, family))
+        if labels_str:
+            consumed = _LABEL_PAIR.sub("", labels_str)
+            if consumed.replace(",", "").strip():
+                errors.append(
+                    "line %d: malformed/unescaped labels in %s{%s}"
+                    % (lineno, family, labels_str))
+            for label_name, _value in _LABEL_PAIR.findall(labels_str):
+                if not _LABEL_NAME.match(label_name):
+                    errors.append("line %d: illegal label name %r"
+                                  % (lineno, label_name))
+        try:
+            value = float(value_str)
+        except ValueError:
+            errors.append("line %d: non-numeric value %r for %s"
+                          % (lineno, value_str, family))
+            continue
+        key = (family, labels_str)
+        if key in series:
+            errors.append("line %d: duplicate series %s{%s}"
+                          % (lineno, family, labels_str))
+        series[key] = value
+    for family, lineno in first_sample.items():
+        if family not in help_seen:
+            errors.append("family %s has samples but no HELP" % family)
+        elif help_seen[family] > lineno:
+            errors.append("family %s: HELP appears after its first "
+                          "sample" % family)
+        if family not in type_seen:
+            errors.append("family %s has samples but no TYPE" % family)
+        if family.endswith("_total") and \
+                type_seen.get(family, "counter") != "counter":
+            errors.append("family %s ends in _total but is typed %s"
+                          % (family, type_seen.get(family)))
+    # TYPE-before-sample ordering (re-scan cheaply).
+    type_line: Dict[str, int] = {}
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        if raw.startswith("# TYPE "):
+            parts = raw.split()
+            if len(parts) >= 3:
+                type_line.setdefault(parts[2], lineno)
+    for family, lineno in first_sample.items():
+        if family in type_line and type_line[family] > lineno:
+            errors.append("family %s: TYPE appears after its first "
+                          "sample" % family)
+    return errors, type_seen, series
+
+
+def check_monotonic(types: Dict[str, str],
+                    before: Dict[Tuple[str, str], float],
+                    after: Dict[Tuple[str, str], float]) -> List[str]:
+    """Counter series must never decrease between two scrapes of the
+    same live server."""
+    errors = []
+    for key, value in after.items():
+        family, labels = key
+        if types.get(family) != "counter":
+            continue
+        prior = before.get(key)
+        if prior is not None and value < prior:
+            errors.append(
+                "counter %s{%s} decreased between scrapes: %s -> %s"
+                % (family, labels, prior, value))
+    return errors
+
+
+def _drive_load(core, model_name: str, n: int, threads: int) -> None:
+    """Concurrent inference bursts so the second scrape sees moving
+    counters (incl. cache hits/misses and fused-batch families)."""
+    import numpy as np
+
+    from client_tpu._infer_common import InferInput
+    from client_tpu.grpc._utils import get_inference_request
+
+    def request(seed: int, batched: bool):
+        shape = [1, 16] if batched else [16]
+        a = np.full(shape, seed % 97, dtype=np.int32)
+        b = np.arange(16, dtype=np.int32).reshape(shape)
+        t0 = InferInput("INPUT0", shape, "INT32")
+        t0.set_data_from_numpy(a)
+        t1 = InferInput("INPUT1", shape, "INT32")
+        t1.set_data_from_numpy(b)
+        return get_inference_request(model_name=model_name,
+                                     inputs=[t0, t1], outputs=None)
+
+    batched = int(getattr(core.repository.get(model_name),
+                          "max_batch_size", 0)) > 0
+
+    def worker(offset: int):
+        for i in range(n):
+            core.infer(request(offset * 1000 + i, batched))
+
+    pool = [threading.Thread(target=worker, args=(i,))
+            for i in range(threads)]
+    for thread in pool:
+        thread.start()
+    for thread in pool:
+        thread.join()
+
+
+def main() -> int:
+    from client_tpu.server.app import build_core
+
+    core = build_core(["simple", "simple_cache"])
+    try:
+        _drive_load(core, "simple", n=20, threads=2)
+        _drive_load(core, "simple_cache", n=20, threads=2)
+        first = core.metrics_text()
+        errors, types, series_before = lint_exposition(first)
+        # More traffic between the scrapes, half of it replayed so the
+        # cache-hit counters move too.
+        _drive_load(core, "simple", n=20, threads=4)
+        _drive_load(core, "simple_cache", n=20, threads=4)
+        second = core.metrics_text()
+        errors2, types2, series_after = lint_exposition(second)
+        errors.extend(e for e in errors2 if e not in errors)
+        errors.extend(check_monotonic(types2, series_before, series_after))
+        moved = sum(
+            1 for key, value in series_after.items()
+            if types2.get(key[0]) == "counter"
+            and value > series_before.get(key, 0.0))
+        if moved == 0:
+            errors.append("no counter series advanced between scrapes "
+                          "under load — the exposition looks frozen")
+    finally:
+        core.shutdown()
+    if errors:
+        for error in errors:
+            print("metrics lint: %s" % error, file=sys.stderr)
+        print("metrics lint FAILED (%d violation%s)"
+              % (len(errors), "s" if len(errors) != 1 else ""),
+              file=sys.stderr)
+        return 1
+    print("metrics lint passed: %d families, %d series, %d counters "
+          "advanced under load"
+          % (len(types2), len(series_after), moved))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
