@@ -34,10 +34,30 @@ def builtin_model_factories(repository=None
         model.max_queue_delay_us = 1000
         return model
 
+    def _simple_qos() -> ServedModel:
+        # The `simple` model with two priority classes and a bounded,
+        # sheddable queue — the multi-tenant QoS testbed. Bulk
+        # (priority 2, the default) can saturate max_queue_size while
+        # interactive priority-1 traffic overtakes at dispatch time
+        # (and displaces bulk at a full queue), which is exactly what
+        # the overload smoke gates on. The slow-ish gather window
+        # (preferred 8 / 2 ms) makes queueing observable on CPU.
+        model = AddSub(name="simple_qos", datatype="INT32", shape=(16,))
+        model.max_batch_size = 8
+        model.dynamic_batching = True
+        model.preferred_batch_sizes = [8]
+        model.max_queue_delay_us = 2000
+        model.max_queue_size = 32
+        model.priority_levels = 2
+        model.default_priority_level = 2
+        model.shed_watermark = 0.9
+        return model
+
     factories: Dict[str, Callable[[], ServedModel]] = {
         "add_sub": AddSub,
         "simple": lambda: AddSub(name="simple", datatype="INT32", shape=(16,)),
         "simple_cache": _simple_cache,
+        "simple_qos": _simple_qos,
         "add_sub_fp32": lambda: AddSub(
             name="add_sub_fp32", datatype="FP32", shape=(16,)
         ),

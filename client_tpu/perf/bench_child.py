@@ -459,6 +459,280 @@ def run_cache_measure(core, model_name: str = "simple_cache",
     return result
 
 
+def qos_stats(core, model_name: str):
+    """Per-priority QoS counters for bench evidence (success / reject
+    / timeout / shed per class plus cumulative queue time)."""
+    try:
+        stats = core.model_statistics(model_name)
+        entry = stats.model_stats[0]
+        return {
+            int(row.priority_level): {
+                "success": int(row.success_count),
+                "rejected": int(row.reject_count),
+                "timed_out": int(row.timeout_count),
+                "shed": int(row.shed_count),
+                "queue_ns": int(row.queue_ns),
+            }
+            for row in entry.priority_stats
+        }
+    except Exception:  # noqa: BLE001 — evidence, never a failure
+        return None
+
+
+def run_qos_measure(core, model_name: str = "qos_bench",
+                    exec_delay_s: float = 0.01,
+                    bulk_workers: int = 8,
+                    foreground_threads: int = 1,
+                    measure_s: float = 4.0) -> dict:
+    """Multi-tenant overload measurement: priority-2 bulk saturates a
+    bounded queue while a small priority-1 foreground keeps sending.
+
+    The p99 gate divides two tail statistics measured in-process on a
+    small CI box (~2 cores), so the setup minimizes self-inflicted
+    scheduler noise: total thread count stays low (8 bulk workers
+    against a 4-deep queue saturate it just as hard as 16 against 8 —
+    admitted submitters block inside ``core.infer``, so workers beyond
+    resident capacity only add GIL churn), bulk protos are prebuilt,
+    and the 4 s loaded window puts ~250 samples behind the p99 so it
+    is not an interpolation between the two worst stragglers.
+
+    Four phases against a purpose-built slow QoS model (AddSub + a
+    fixed per-execution delay so the queue actually fills on CPU,
+    max_queue_size 8, two priority classes, shed watermark 0.9):
+
+    * baseline — priority-1 closed loop alone: unloaded p50/p99;
+    * overload — an OverloadScenario bulk burst (priority 2, tenant
+      "bulk") saturates the queue while the same priority-1 loop runs:
+      priority-1 p99 and goodput under saturation, bulk reject/shed
+      accounting from the per-priority statistics;
+    * fusion parity — a c16 single-class run vs a c16 mixed-priority
+      run: execution counts must match within 10%, proving QoS
+      ordering costs dispatch order, not batch efficiency.
+    """
+    import threading as _threading
+
+    import numpy as np
+
+    from client_tpu._infer_common import InferInput
+    from client_tpu.grpc._utils import get_inference_request
+    from client_tpu.models.add_sub import AddSub
+    from client_tpu.server.chaos import OverloadScenario
+    from client_tpu.utils import InferenceServerException
+
+    class _SlowQoS(AddSub):
+        # Sized so a modest closed-loop bulk pool actually saturates
+        # the queue on CPU: in-flight capacity is pipeline_depth x
+        # preferred = 4 rows, so 8 bulk workers keep the 4-deep queue
+        # hard-full (resident capacity is queue 4 + in-flight 4) —
+        # while pipeline_depth 2 leaves enough dispatch slack that a
+        # priority-1 arrival rides the next execution instead of
+        # waiting out a serialized pipe (the 2x p99 gate).
+        def __init__(self):
+            super().__init__(name=model_name, datatype="INT32",
+                             shape=(16,))
+            self.max_batch_size = 4
+            self.dynamic_batching = True
+            self.preferred_batch_sizes = [2]
+            self.max_queue_delay_us = 1000
+            self.pipeline_depth = 2
+            self.max_queue_size = 4
+            self.priority_levels = 2
+            self.default_priority_level = 2
+            self.shed_watermark = 0.9
+
+        def infer(self, inputs, parameters=None):
+            time.sleep(exec_delay_s)
+            return super().infer(inputs, parameters)
+
+    core.repository.add_factory(model_name, _SlowQoS)
+    core.repository.load(model_name)
+
+    def request(priority: int, tenant: str, seed: int):
+        a = np.full((1, 16), seed % 997, dtype=np.int32)
+        b = np.arange(16, dtype=np.int32).reshape(1, 16)
+        t0 = InferInput("INPUT0", [1, 16], "INT32")
+        t0.set_data_from_numpy(a)
+        t1 = InferInput("INPUT1", [1, 16], "INT32")
+        t1.set_data_from_numpy(b)
+        return get_inference_request(
+            model_name=model_name, inputs=[t0, t1], outputs=None,
+            priority=priority, parameters={"tenant": tenant})
+
+    def p1_loop(duration_s: float) -> dict:
+        """Closed-loop priority-1 foreground: latencies + goodput."""
+        latencies: list = []
+        errors = [0]
+        merge = _threading.Lock()
+
+        def worker(index: int):
+            local, failed = [], 0
+            deadline = time.monotonic() + duration_s
+            seed = index * 100_000
+            while time.monotonic() < deadline:
+                req = request(1, "interactive", seed)
+                seed += 1
+                t_start = time.monotonic_ns()
+                try:
+                    core.infer(req)
+                    local.append(time.monotonic_ns() - t_start)
+                except InferenceServerException:
+                    failed += 1
+            with merge:
+                latencies.extend(local)
+                errors[0] += failed
+
+        pool = [_threading.Thread(target=worker, args=(i,))
+                for i in range(foreground_threads)]
+        for thread in pool:
+            thread.start()
+        for thread in pool:
+            thread.join()
+        if not latencies:
+            return {"p50_us": 0.0, "p99_us": 0.0, "completed": 0,
+                    "errors": errors[0], "goodput_pct": 0.0}
+        arr = np.array(latencies, dtype=float) / 1000.0
+        total = len(latencies) + errors[0]
+        return {
+            "p50_us": round(float(np.percentile(arr, 50)), 1),
+            "p99_us": round(float(np.percentile(arr, 99)), 1),
+            "completed": len(latencies),
+            "errors": errors[0],
+            "goodput_pct": round(len(latencies) / total * 100.0, 2),
+        }
+
+    # Bulk protos are PREBUILT and cycled: the burst's job is queue
+    # pressure, not allocation churn — building numpy tensors + a
+    # proto per submit at hundreds/s steals GIL slices from the very
+    # p1 tail the gate measures. Sharing protos across submitter
+    # threads is safe on the direct-core path (core never mutates a
+    # caller-owned request; the model has no response cache, so
+    # identical payloads cannot coalesce).
+    bulk_pool = [request(2, "bulk", 500_000 + i) for i in range(32)]
+    bulk_seed = [0]
+    bulk_lock = _threading.Lock()
+
+    def bulk_submit():
+        with bulk_lock:
+            bulk_seed[0] += 1
+            seed = bulk_seed[0]
+        core.infer(bulk_pool[seed % len(bulk_pool)])
+
+    # -- interleaved baseline/overload rounds. The gate divides two
+    # p99s measured on a shared, throttled CI box where a single
+    # scheduler stall can double one window's tail, so each statistic
+    # is the MEDIAN of three short windows, and unloaded/loaded
+    # windows alternate (B0 L0 B1 L1 B2 L2) so slow box drift lands on
+    # both sides of the ratio — the same interleaved-medians
+    # discipline run_tracing_measure uses for its overhead gate. A
+    # short discarded warmup absorbs numpy/JAX lazy-init first.
+    # Pacing: 0.75x the NOMINAL service rate (pipeline_depth x
+    # preferred / exec_delay = 400 rows/s) — dispatch/GIL overhead
+    # puts the real rate nearer half that, so this is still ~1.5x
+    # effective overpressure: the queue sits hard-full for the whole
+    # loaded window with sheds to spare, but the excess — every
+    # over-rate submission is an insta-shed exception burning the GIL
+    # — stays bounded so the run measures QoS, not scheduler thrash.
+    rounds = 3
+    base_window_s = measure_s * 0.35
+    loaded_window_s = measure_s * 0.45
+    service_rate = 2 * 2 / exec_delay_s
+    p1_loop(0.5)  # warmup, discarded
+    before = qos_stats(core, model_name) or {}
+    base_rounds, loaded_rounds = [], []
+    burst = {"submitted": 0, "rejected": 0}
+    for round_index in range(rounds):
+        base_rounds.append(p1_loop(base_window_s))
+        scenario = OverloadScenario(
+            bulk_submit, rate=0.75 * service_rate, burst_after_s=0.0,
+            burst_duration_s=loaded_window_s + 0.5,
+            workers=bulk_workers, seed=11 + round_index).start()
+        time.sleep(0.3)  # let the burst fill the queue first
+        loaded_rounds.append(p1_loop(loaded_window_s))
+        scenario.stop()
+        for key, value in scenario.stats().items():
+            burst[key] += value
+        time.sleep(0.2)  # drain the residual backlog between rounds
+    after = qos_stats(core, model_name) or {}
+
+    def med(windows, key: str) -> float:
+        return round(float(np.median([w[key] for w in windows])), 1)
+
+    baseline = {"p50_us": med(base_rounds, "p50_us"),
+                "p99_us": med(base_rounds, "p99_us")}
+    completed = sum(w["completed"] for w in loaded_rounds)
+    failed = sum(w["errors"] for w in loaded_rounds)
+    loaded = {
+        "p50_us": med(loaded_rounds, "p50_us"),
+        "p99_us": med(loaded_rounds, "p99_us"),
+        "completed": completed,
+        "errors": failed,
+        "goodput_pct": round(
+            completed / (completed + failed) * 100.0, 2)
+        if completed + failed else 0.0,
+    }
+
+    def delta(level: int, key: str) -> int:
+        return (after.get(level, {}).get(key, 0)
+                - before.get(level, {}).get(key, 0))
+
+    # -- fusion parity: single-class vs mixed-priority c16
+    def fusion_run(mixed: bool) -> float:
+        stats_before = fusion_stats(core, model_name)
+        pool = []
+        for i in range(16):
+            priority = 1 if (mixed and i % 2 == 0) else 2
+            def worker(p=priority, offset=i):
+                for j in range(8):
+                    try:
+                        core.infer(request(p, "fusion", 800_000
+                                           + offset * 100 + j))
+                    except InferenceServerException:
+                        pass
+            pool.append(_threading.Thread(target=worker))
+        for thread in pool:
+            thread.start()
+        for thread in pool:
+            thread.join()
+        stats_after = fusion_stats(core, model_name)
+        if not stats_before or not stats_after:
+            return 0.0
+        d_exec = (stats_after["execution_count"]
+                  - stats_before["execution_count"])
+        d_infer = (stats_after["inference_count"]
+                   - stats_before["inference_count"])
+        return d_exec / d_infer if d_infer else 0.0
+
+    fusion_single = fusion_run(mixed=False)
+    fusion_mixed = fusion_run(mixed=True)
+
+    result = {
+        "bulk_workers": bulk_workers,
+        "p1_unloaded_p50_us": baseline["p50_us"],
+        "p1_unloaded_p99_us": baseline["p99_us"],
+        "p1_loaded_p50_us": loaded["p50_us"],
+        "p1_loaded_p99_us": loaded["p99_us"],
+        "p1_completed": loaded["completed"],
+        "p1_tput": round(
+            loaded["completed"] / (rounds * loaded_window_s), 2),
+        "p1_errors": loaded["errors"],
+        "p1_goodput_pct": loaded["goodput_pct"],
+        "bulk_submitted": burst["submitted"],
+        "bulk_rejected": burst["rejected"],
+        "bulk_server_rejects": delta(2, "rejected"),
+        "bulk_server_sheds": delta(2, "shed"),
+        "p1_server_sheds": delta(1, "shed"),
+        "fusion_ratio_single_class": round(fusion_single, 4),
+        "fusion_ratio_mixed": round(fusion_mixed, 4),
+    }
+    if baseline["p99_us"]:
+        result["p1_p99_vs_unloaded"] = round(
+            loaded["p99_us"] / baseline["p99_us"], 2)
+    if fusion_single:
+        result["fusion_mixed_vs_single"] = round(
+            fusion_mixed / fusion_single, 3)
+    return result
+
+
 def run_tracing_measure(core, model_name: str = "add_sub_large",
                         threads: int = 4, requests: int = 120) -> dict:
     """Span-tracing overhead: the same closed loop run with tracing
@@ -1383,6 +1657,27 @@ def main() -> None:
                          extra.get("warm_hit_p50_us", 0.0), extra)
         except Exception as exc:  # noqa: BLE001
             log("response_cache failed: %s" % exc)
+
+    # Config 3e: multi-tenant QoS under overload — priority-2 bulk
+    # saturates a bounded queue (8 deep, shed watermark 0.9) while a
+    # priority-1 foreground keeps sending. Acceptance: priority-1 p99
+    # <= 2x its unloaded baseline with 100% goodput (bulk absorbs
+    # every reject/shed), and mixed-priority c16 fusion within 10% of
+    # single-class (QoS costs dispatch order, not batch efficiency).
+    if remaining() > 60 and stage_wanted("qos_overload"):
+        try:
+            extra = run_qos_measure(core)
+            record_stage("qos_overload", extra.get("p1_tput", 0.0),
+                         extra.get("p1_loaded_p50_us", 0.0), extra)
+            if extra.get("p1_goodput_pct", 0.0) < 100.0:
+                log("qos_overload: priority-1 goodput %.2f%% below "
+                    "100%%" % extra.get("p1_goodput_pct", 0.0))
+            if extra.get("p1_p99_vs_unloaded", 0.0) > 2.0:
+                log("qos_overload: priority-1 p99 %.2fx unloaded "
+                    "exceeds the 2x gate"
+                    % extra.get("p1_p99_vs_unloaded", 0.0))
+        except Exception as exc:  # noqa: BLE001
+            log("qos_overload failed: %s" % exc)
 
     # Config 3d: span-tracing overhead — the identical closed loop on
     # add_sub_large (4 MiB tensors, the ms-scale request shape tracing

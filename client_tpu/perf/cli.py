@@ -180,6 +180,24 @@ def build_parser() -> argparse.ArgumentParser:
                              "'latency_ms=200,latency_after_s=1,"
                              "kill_after_s=3,victim=0' (requires "
                              "--fleet)")
+    parser.add_argument("--priority-mix", default=None,
+                        help="weighted priority classes for issued "
+                             "requests, e.g. '1:0.2,2:0.8' (level:"
+                             "weight; 1 = highest). Enables the "
+                             "per-class QoS summary report")
+    parser.add_argument("--tenant", default=None,
+                        help="tenant identity stamped on every "
+                             "request (the `tenant` parameter; "
+                             "per-tenant quotas and accounting key "
+                             "on it)")
+    parser.add_argument("--overload", default=None,
+                        help="staged burst-arrival injection against "
+                             "the model under test: 'rate=500,"
+                             "after_s=1,duration_s=3,workers=8,"
+                             "seed=11,priority=2,tenant=bulk' — the "
+                             "burst saturates the queue while the "
+                             "foreground load's QoS is measured "
+                             "(service-kind inprocess and triton)")
     return parser
 
 
@@ -424,6 +442,31 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
                       "continuing without --trace" % e, file=sys.stderr)
                 trace_path = None
 
+    priority_mix = None
+    if args.priority_mix:
+        from client_tpu.perf.load_manager import parse_priority_mix
+
+        try:
+            priority_mix = parse_priority_mix(args.priority_mix)
+        except ValueError as e:
+            print("perf failed: bad --priority-mix: %s" % e,
+                  file=sys.stderr)
+            setup_backend.close()
+            return 1
+        if model.priority_levels:
+            over = [level for level, _ in priority_mix
+                    if level > model.priority_levels]
+            if over:
+                print("perf failed: --priority-mix levels %s exceed "
+                      "the model's priority_levels %d"
+                      % (over, model.priority_levels), file=sys.stderr)
+                setup_backend.close()
+                return 1
+        else:
+            print("note: model '%s' declares no priority_levels; the "
+                  "server treats every class alike" % model.name,
+                  file=sys.stderr)
+
     sequence_manager = None
     if (model.scheduler_type == SchedulerType.SEQUENCE
             or model.composing_sequential or args.sequence_id_range):
@@ -464,7 +507,49 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
         data_manager=data_manager, async_mode=args.async_mode,
         streaming=args.streaming, max_threads=args.max_threads,
         sequence_manager=sequence_manager,
+        priority_mix=priority_mix, tenant=args.tenant,
     )
+
+    # -- staged overload burst (--overload) ---------------------------
+    overload_scenario = None
+    overload_backend = None
+    if args.overload is not None:
+        from client_tpu.server.chaos import OverloadScenario
+
+        if args.service_kind not in ("triton", "inprocess"):
+            print("perf failed: --overload requires --service-kind "
+                  "triton or inprocess", file=sys.stderr)
+            setup_backend.close()
+            return 1
+        # Request-shaping keys (priority/tenant) ride the same spec
+        # but belong to the submitted requests, not the scenario.
+        scenario_parts, burst_kwargs = [], {}
+        for part in args.overload.split(","):
+            key = part.partition("=")[0].strip()
+            value = part.partition("=")[2].strip()
+            if key == "priority":
+                burst_kwargs["priority"] = int(value)
+            elif key == "tenant":
+                burst_kwargs["parameters"] = {"tenant": value}
+            elif part.strip():
+                scenario_parts.append(part)
+        # raw: the burst must reach the server on every submit — a
+        # retrying/breaker-guarded backend paces itself on Retry-After
+        # (429 is retryable since this PR) or opens under sustained
+        # rejects, and the saturation the flag exists to create never
+        # holds; the scenario's submitted/rejected counts would also
+        # hide rejects that a retry later converted to success.
+        overload_backend = factory.create(raw=True)
+        burst_inputs = data_manager.build_inputs(0, 0)
+        burst_outputs = data_manager.build_outputs()
+
+        def _burst_submit():
+            overload_backend.infer(model.name, burst_inputs,
+                                   outputs=burst_outputs, **burst_kwargs)
+
+        overload_scenario = OverloadScenario(
+            _burst_submit,
+            **OverloadScenario.parse_spec(",".join(scenario_parts)))
 
     metrics_manager = None
     if args.collect_metrics:
@@ -495,6 +580,8 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
             kill_fns=[m[3] for m in fleet_members],
             **DegradeOneScenario.parse_spec(args.degrade_one),
         ).start()
+    if overload_scenario is not None:
+        overload_scenario.start()
 
     mode = "concurrency"
     try:
@@ -569,6 +656,13 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
         setup_backend.close()
         if scenario is not None:
             scenario.stop()
+        if overload_scenario is not None:
+            overload_scenario.stop()
+        if overload_backend is not None:
+            try:
+                overload_backend.close()
+            except Exception:
+                pass
         if endpoint_pool is not None:
             endpoint_pool.close()
         for _scope, _server, _core, stop_fn in fleet_members:
@@ -578,6 +672,20 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
                 pass
 
     print_report(results, args.percentile, mode)
+    if priority_mix is not None or args.tenant or overload_scenario:
+        from client_tpu.perf.report import print_qos_report
+
+        description_parts = []
+        if priority_mix is not None:
+            description_parts.append("mix %s" % args.priority_mix)
+        if args.tenant:
+            description_parts.append("tenant %s" % args.tenant)
+        if overload_scenario is not None:
+            burst = overload_scenario.stats()
+            description_parts.append(
+                "overload burst: %d submitted, %d rejected"
+                % (burst["submitted"], burst["rejected"]))
+        print_qos_report(results, ", ".join(description_parts))
     if trace_path is not None:
         from client_tpu.perf.report import print_trace_report
 

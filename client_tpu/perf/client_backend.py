@@ -1151,17 +1151,23 @@ class ClientBackendFactory:
     def _breaker(self):
         return self._breaker_factory() if self._breaker_factory else None
 
-    def create(self) -> ClientBackend:
+    def create(self, raw: bool = False) -> ClientBackend:
+        # raw=True drops the retry policy / circuit breaker: fault- and
+        # load-injection callers (e.g. the --overload burst) must hit
+        # the server with every submission — a retrying burst paces
+        # itself on Retry-After and never sustains saturation.
+        retry_policy = None if raw else self._retry_policy
+        breaker = None if raw else self._breaker()
         if self.kind == BackendKind.TRITON_GRPC:
             return GrpcClientBackend(self._url, self._verbose,
-                                     retry_policy=self._retry_policy,
-                                     circuit_breaker=self._breaker(),
+                                     retry_policy=retry_policy,
+                                     circuit_breaker=breaker,
                                      endpoint_pool=self.endpoint_pool)
         if self.kind == BackendKind.TRITON_HTTP:
             return HttpClientBackend(self._url, self._verbose,
                                      self._http_concurrency,
-                                     retry_policy=self._retry_policy,
-                                     circuit_breaker=self._breaker(),
+                                     retry_policy=retry_policy,
+                                     circuit_breaker=breaker,
                                      endpoint_pool=self.endpoint_pool)
         if self.kind == BackendKind.OPENAI:
             return OpenAiClientBackend(self._url, self._openai_endpoint,
@@ -1178,8 +1184,8 @@ class ClientBackendFactory:
                     "in-process backend requires a server core"
                 )
             return InProcessBackend(self._core,
-                                    retry_policy=self._retry_policy,
-                                    circuit_breaker=self._breaker())
+                                    retry_policy=retry_policy,
+                                    circuit_breaker=breaker)
         if self.kind == BackendKind.MOCK:
             return MockBackend(self._mock_delay, self._mock_stats)
         raise InferenceServerException("unknown backend kind %s" % self.kind)

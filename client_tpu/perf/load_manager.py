@@ -26,17 +26,23 @@ NANOS = 1_000_000_000
 
 class RequestRecord:
     """Timestamps for one request and its response(s) (parity:
-    request_record.h:63)."""
+    request_record.h:63). ``priority``/``tenant`` label the record's
+    QoS class so the report can break latency and goodput down per
+    class (0/None = unclassed)."""
 
-    __slots__ = ("start_ns", "end_ns", "delayed", "sequence_end", "error")
+    __slots__ = ("start_ns", "end_ns", "delayed", "sequence_end", "error",
+                 "priority", "tenant")
 
     def __init__(self, start_ns: int, delayed: bool = False,
-                 sequence_end: bool = True):
+                 sequence_end: bool = True, priority: int = 0,
+                 tenant: Optional[str] = None):
         self.start_ns = start_ns
         self.end_ns: List[int] = []
         self.delayed = delayed
         self.sequence_end = sequence_end
         self.error: Optional[Exception] = None
+        self.priority = priority
+        self.tenant = tenant
 
     @property
     def valid(self) -> bool:
@@ -342,6 +348,72 @@ class RandCtxIdTracker(FifoCtxIdTracker):
 # -- load managers ---------------------------------------------------------
 
 
+def build_priority_schedule(mix: List,
+                            slots: Optional[int] = None) -> List[int]:
+    """Deterministic interleaved class schedule from (level, weight)
+    pairs — smooth weighted round-robin, so a 1:4 mix issues
+    2,2,1,2,2 rather than 1,2,2,2,2 blocks (blocked assignment would
+    make the high class's latency depend on its slot phase). The
+    schedule is sized so even the smallest-weight class gets at least
+    one slot (a '1:0.01,2:0.99' mix must still issue priority-1
+    requests), capped at 1000 slots — a rarer class than 1/1000 gets
+    rounded up to that share."""
+    mix = [(int(level), float(weight)) for level, weight in mix
+           if weight > 0]
+    if not mix:
+        return [0]
+    total = sum(weight for _, weight in mix)
+    if slots is None:
+        import math
+
+        smallest = min(weight for _, weight in mix)
+        slots = min(max(20, math.ceil(total / smallest)), 1000)
+    current = {level: 0.0 for level, _ in mix}
+    schedule: List[int] = []
+    for _ in range(slots):
+        for level, weight in mix:
+            current[level] += weight
+        best = max(mix, key=lambda lw: current[lw[0]])[0]
+        current[best] -= total
+        schedule.append(best)
+    # Rounding starved ultra-rare classes entirely (slots is capped at
+    # 1000): append one slot per starved class rather than silently
+    # dropping it — writing them all into one shared tail slot would
+    # leave every starved class but the last unissued, and overwriting
+    # existing slots could erase another class's only slot.
+    schedule.extend(level for level, _ in mix if level not in schedule)
+    return schedule
+
+
+def parse_priority_mix(spec: str) -> List:
+    """``"1:0.2,2:0.8"`` (level:weight pairs) -> [(1, 0.2), (2, 0.8)];
+    a bare ``"1,2"`` means equal weights."""
+    mix = []
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        level, sep, weight = part.partition(":")
+        level = int(level)
+        if level < 1:
+            # 0 would issue unclassed requests (the server substitutes
+            # its default level) while the report claims a mix was
+            # applied; negatives are rejected INVALID_ARGUMENT at the
+            # server mid-run. Fail fast at parse time instead.
+            raise ValueError(
+                "priority level %d out of range (levels start at 1)"
+                % level)
+        weight = float(weight) if sep else 1.0
+        if weight <= 0:
+            raise ValueError(
+                "priority level %d has non-positive weight %g"
+                % (level, weight))
+        mix.append((level, weight))
+    if not mix:
+        raise ValueError("empty --priority-mix spec")
+    return mix
+
+
 class LoadManager:
     """Base: owns backends, data manager, worker threads, records."""
 
@@ -355,6 +427,8 @@ class LoadManager:
         streaming: bool = False,
         max_threads: int = 16,
         sequence_manager: Optional[SequenceManager] = None,
+        priority_mix: Optional[List] = None,
+        tenant: Optional[str] = None,
     ):
         self._factory = factory
         self._model = model
@@ -370,6 +444,35 @@ class LoadManager:
         self._setup_backend = None
         self._step_cursor: Dict[int, int] = {}
         self._step_lock = threading.Lock()
+        # QoS labeling: every issued request draws its priority class
+        # from a deterministic interleaved schedule (--priority-mix)
+        # and carries the run's tenant identity (--tenant) as the
+        # `tenant` parameter.
+        self._tenant = tenant
+        self._priority_schedule = (
+            build_priority_schedule(priority_mix) if priority_mix
+            else None)
+        self._qos_cursor = 0
+        self._qos_lock = threading.Lock()
+
+    def _qos_assign(self) -> tuple:
+        """(priority, tenant) for the next issued request."""
+        priority = 0
+        if self._priority_schedule is not None:
+            with self._qos_lock:
+                priority = self._priority_schedule[
+                    self._qos_cursor % len(self._priority_schedule)]
+                self._qos_cursor += 1
+        return priority, self._tenant
+
+    @staticmethod
+    def _qos_kwargs(priority: int, tenant: Optional[str]) -> dict:
+        kwargs: dict = {}
+        if priority:
+            kwargs["priority"] = priority
+        if tenant:
+            kwargs["parameters"] = {"tenant": tenant}
+        return kwargs
 
     # setup ---------------------------------------------------------------
     def init(self) -> None:
@@ -492,13 +595,17 @@ class ConcurrencyManager(LoadManager):
         step = seq_step if seq_step is not None else self._next_step(stream)
         inputs = self._data_manager.build_inputs(stream, step)
         outputs = self._data_manager.build_outputs()
-        return inputs, outputs, kwargs
+        priority, tenant = self._qos_assign()
+        kwargs.update(self._qos_kwargs(priority, tenant))
+        return inputs, outputs, kwargs, priority, tenant
 
     def _sync_worker(self, stat, backend, n_ctx):
         holder: dict = {}
         while not self._stop.is_set():
-            inputs, outputs, kwargs = self._make_request(holder)
-            record = RequestRecord(time.monotonic_ns())
+            inputs, outputs, kwargs, priority, tenant = \
+                self._make_request(holder)
+            record = RequestRecord(time.monotonic_ns(),
+                                   priority=priority, tenant=tenant)
             try:
                 backend.infer(self._model.name, inputs, outputs=outputs,
                               **kwargs)
@@ -529,8 +636,10 @@ class ConcurrencyManager(LoadManager):
             if self._stop.is_set():
                 tracker.release(ctx_id)
                 break
-            inputs, outputs, kwargs = self._make_request(holders[ctx_id])
-            record = RequestRecord(time.monotonic_ns())
+            inputs, outputs, kwargs, priority, tenant = \
+                self._make_request(holders[ctx_id])
+            record = RequestRecord(time.monotonic_ns(),
+                                   priority=priority, tenant=tenant)
             try:
                 backend.async_infer(_done(record, ctx_id), self._model.name,
                                     inputs, outputs=outputs, **kwargs)
@@ -605,8 +714,10 @@ class ConcurrencyManager(LoadManager):
                 if self._stop.is_set():
                     tracker.release(ctx_id)
                     break
-                inputs, outputs, kwargs = self._make_request(holders[ctx_id])
-                record = RequestRecord(time.monotonic_ns())
+                inputs, outputs, kwargs, priority, tenant = \
+                    self._make_request(holders[ctx_id])
+                record = RequestRecord(time.monotonic_ns(),
+                                       priority=priority, tenant=tenant)
                 with inflight_lock:
                     key = counter
                     counter += 1
@@ -721,7 +832,10 @@ class RequestRateManager(LoadManager):
                 )
                 inputs = self._data_manager.build_inputs(stream, step)
                 outputs = self._data_manager.build_outputs()
-                record = RequestRecord(time.monotonic_ns(), delayed=delayed)
+                priority, tenant = self._qos_assign()
+                kwargs.update(self._qos_kwargs(priority, tenant))
+                record = RequestRecord(time.monotonic_ns(), delayed=delayed,
+                                       priority=priority, tenant=tenant)
                 if self._async:
                     try:
                         backend.async_infer(_done(record), self._model.name,

@@ -43,6 +43,12 @@ class TpuMetrics:
     cache_size_bytes: Dict[str, float] = field(default_factory=dict)
     cache_entries: Dict[str, float] = field(default_factory=dict)
     cache_evictions_total: Dict[str, float] = field(default_factory=dict)
+    # QoS families: priority queue depths keyed "model|p<level>", shed
+    # counters likewise; tenant counters keyed by tenant label.
+    priority_queue_size: Dict[str, float] = field(default_factory=dict)
+    shed_total: Dict[str, float] = field(default_factory=dict)
+    tenant_success_total: Dict[str, float] = field(default_factory=dict)
+    tenant_rejected_total: Dict[str, float] = field(default_factory=dict)
 
 
 _FAMILIES = {
@@ -60,6 +66,10 @@ _FAMILIES = {
     "tpu_cache_size_bytes": "cache_size_bytes",
     "tpu_cache_entries": "cache_entries",
     "tpu_cache_evictions_total": "cache_evictions_total",
+    "tpu_priority_queue_size": "priority_queue_size",
+    "tpu_shed_total": "shed_total",
+    "tpu_tenant_success_total": "tenant_success_total",
+    "tpu_tenant_rejected_total": "tenant_rejected_total",
 }
 
 # Monotonic counters among the scraped families: summarize_metrics
@@ -68,6 +78,7 @@ _FAMILIES = {
 # value. Everything else is a gauge (avg/max of point-in-time values).
 _COUNTER_FAMILIES = frozenset((
     "cache_hit_total", "cache_miss_total", "cache_evictions_total",
+    "shed_total", "tenant_success_total", "tenant_rejected_total",
 ))
 
 
@@ -81,9 +92,14 @@ def parse_prometheus(text: str) -> TpuMetrics:
         if not m or m.group("name") not in _FAMILIES:
             continue
         labels = dict(_LABEL.findall(m.group("labels") or ""))
-        # Batcher gauges are per-model; HBM gauges are per-device.
-        key = (labels.get("model") or labels.get("tpu_uuid")
-               or labels.get("gpu_uuid") or "0")
+        # Batcher gauges are per-model; HBM gauges are per-device;
+        # tenant counters per tenant; priority families carry a
+        # compound model|p<level> key so deltas stay per class.
+        key = (labels.get("model") or labels.get("tenant")
+               or labels.get("tpu_uuid") or labels.get("gpu_uuid")
+               or "0")
+        if "priority" in labels:
+            key = "%s|p%s" % (key, labels["priority"])
         try:
             value = float(m.group("value"))
         except ValueError:
@@ -164,7 +180,8 @@ def summarize_metrics(snapshots: List[TpuMetrics]) -> Dict[str, Dict[str, float]
                  "batch_pending_depth", "batch_inflight",
                  "batch_queue_delay_us", "batch_overlap_ratio",
                  "sequence_active", "sequence_backlog",
-                 "cache_size_bytes", "cache_entries"):
+                 "cache_size_bytes", "cache_entries",
+                 "priority_queue_size"):
         values = []
         for snap in snapshots:
             per_device = getattr(snap, attr)

@@ -54,6 +54,12 @@ class ParsedModel:
         # is an ensemble (reference GetComposingSchedulerType).
         self.composing_sequential = False
         self.response_cache_enabled = False
+        # Multi-tenant QoS knobs (dynamic_batching.priority_levels
+        # schema): the harness uses them to validate a --priority-mix
+        # against the served config and to describe the run.
+        self.priority_levels = 0
+        self.default_priority_level = 0
+        self.shed_watermark = 0.0
         # True when any composing model of an ensemble enables the
         # response cache: the cache-latency caveat applies even though
         # the TOP model's config carries no response_cache section
@@ -114,6 +120,14 @@ class ModelParser:
                 config["sequence_batching"] or {}, model)
         elif "dynamic_batching" in config:
             model.scheduler_type = SchedulerType.DYNAMIC
+        batching = config.get("dynamic_batching") or {}
+        # proto-JSON stringifies u64 — numeric fields go through int().
+        model.priority_levels = int(
+            batching.get("priority_levels", 0) or 0)
+        model.default_priority_level = int(
+            batching.get("default_priority_level", 0) or 0)
+        model.shed_watermark = float(
+            batching.get("shed_watermark", 0.0) or 0.0)
         policy = config.get("model_transaction_policy", {})
         model.decoupled = bool(policy.get("decoupled", False))
         cache = config.get("response_cache", {})

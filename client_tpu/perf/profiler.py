@@ -77,9 +77,18 @@ def _normalize_stats_entry(entry: Dict) -> Dict:
     fields only (a generic string->int pass would corrupt `version`)."""
     out = dict(entry)
     for key in ("inference_count", "execution_count", "reject_count",
-                "timeout_count", "cache_hit_count", "cache_miss_count"):
+                "timeout_count", "cache_hit_count", "cache_miss_count",
+                "shed_count"):
         if key in out:
             out[key] = int(out[key])
+    for key in ("priority_stats", "tenant_stats"):
+        if key in out:
+            out[key] = [
+                {name: (int(value) if name not in ("tenant",)
+                        else value)
+                 for name, value in row.items()}
+                for row in out[key]
+            ]
     sections = {}
     for name, section in dict(out.get("inference_stats", {})).items():
         sections[name] = (
@@ -177,6 +186,12 @@ def _accumulate_server_stats(total: Dict, part: Dict) -> Dict:
                 summed["batch_size"] = size
                 by_size[size] = summed
             acc["batch_stats"] = list(by_size.values())
+        for list_key, row_key in (("priority_stats", "priority_level"),
+                                  ("tenant_stats", "tenant")):
+            if list_key in entry or list_key in prior:
+                acc[list_key] = _accumulate_keyed_list(
+                    prior.get(list_key, []), entry.get(list_key, []),
+                    row_key)
         seq_prior = prior.get("sequence_stats", {})
         seq_part = entry.get("sequence_stats", {})
         if seq_prior or seq_part:
@@ -224,6 +239,14 @@ def _delta_server_stats(before: Dict, after: Dict) -> Dict:
         if "batch_stats" in entry:
             delta["batch_stats"] = _delta_batch_stats(
                 prior.get("batch_stats", []), entry["batch_stats"])
+        if "priority_stats" in entry:
+            delta["priority_stats"] = _delta_keyed_list(
+                prior.get("priority_stats", []), entry["priority_stats"],
+                "priority_level")
+        if "tenant_stats" in entry:
+            delta["tenant_stats"] = _delta_keyed_list(
+                prior.get("tenant_stats", []), entry["tenant_stats"],
+                "tenant")
         if "pipeline_stats" in entry:
             pipe = _numeric_delta(prior.get("pipeline_stats", {}),
                                   entry["pipeline_stats"])
@@ -244,6 +267,35 @@ def _delta_server_stats(before: Dict, after: Dict) -> Dict:
             delta["sequence_stats"] = seq
         out.append(delta)
     return {"model_stats": out}
+
+
+def _delta_keyed_list(before: List[Dict], after: List[Dict],
+                      key: str) -> List[Dict]:
+    """Row-matched deltas for repeated per-class stats (priority_stats
+    keyed by priority_level, tenant_stats by tenant), dropping rows
+    with no activity this window."""
+    prior = {row.get(key): row for row in before}
+    out = []
+    for row in after:
+        delta = _numeric_delta(prior.get(row.get(key), {}), row)
+        delta[key] = row.get(key)
+        if any(v for name, v in delta.items()
+               if name != key and isinstance(v, (int, float))):
+            out.append(delta)
+    return out
+
+
+def _accumulate_keyed_list(total: List[Dict], part: List[Dict],
+                           key: str) -> List[Dict]:
+    """Row-matched accumulation (merge of stable windows) for the same
+    repeated per-class stats."""
+    by_key: Dict = {}
+    for row in list(total) + list(part):
+        base = by_key.get(row.get(key), {})
+        summed = _accumulate_numeric(base, row)
+        summed[key] = row.get(key)
+        by_key[row.get(key)] = summed
+    return list(by_key.values())
 
 
 def _delta_batch_stats(before: List[Dict], after: List[Dict]) -> List[Dict]:

@@ -207,6 +207,65 @@ def print_trace_report(path: str) -> None:
           % (root_p50 / 1000.0, coverage, qef))
 
 
+def print_qos_report(results: List[PerfStatus],
+                     description: str = "") -> None:
+    """The --priority-mix/--tenant summary: per-priority-class
+    client-side throughput, p50/p99 and errors (from the labeled
+    request records), paired with the server's window-delta QoS
+    counters (rejects, queue-deadline timeouts, sheds, mean queue time
+    per class) and the per-tenant admission accounting — same
+    window-delta discipline as the cache and failover summaries."""
+    import numpy as np
+
+    print("QoS summary (%s):" % (description or "priority classes"))
+    by_class: dict = {}
+    window_s = 0.0
+    for status in results:
+        window_s += (status.window_end_ns - status.window_start_ns) / 1e9
+        for record in status.records:
+            by_class.setdefault(record.priority, []).append(record)
+    for level in sorted(by_class):
+        records = by_class[level]
+        valid = [r for r in records if r.valid]
+        errors = len(records) - len(valid)
+        label = ("priority %d" % level) if level else "unclassed"
+        if not valid:
+            print("    %s: 0 completed, %d errors" % (label, errors))
+            continue
+        latencies = np.array([r.latency_ns / 1000.0 for r in valid])
+        goodput = len(valid) / (len(records) or 1) * 100.0
+        print("    %s: %.2f infer/sec, p50 %.0f us, p99 %.0f us, "
+              "%d errors (goodput %.1f%%)"
+              % (label, len(valid) / window_s if window_s else 0.0,
+                 float(np.percentile(latencies, 50)),
+                 float(np.percentile(latencies, 99)), errors, goodput))
+    for status in results:
+        for entry in status.server_stats.get("model_stats", []):
+            for row in entry.get("priority_stats", []):
+                success = int(row.get("success_count", 0))
+                queue_ns = int(row.get("queue_ns", 0))
+                print("    server %s priority %s (this window): "
+                      "%d ok, %d rejected, %d timed out, %d shed, "
+                      "mean queue %.0f us"
+                      % (entry.get("name", "?"),
+                         row.get("priority_level", "?"), success,
+                         int(row.get("reject_count", 0)),
+                         int(row.get("timeout_count", 0)),
+                         int(row.get("shed_count", 0)),
+                         queue_ns / success / 1000.0 if success else 0.0))
+            for row in entry.get("tenant_stats", []):
+                success = int(row.get("success_count", 0))
+                duration_ns = int(row.get("duration_ns", 0))
+                print("    tenant %s @ %s (this window): %d ok, "
+                      "%d quota-rejected, %d failed, mean %.0f us"
+                      % (row.get("tenant", "?"),
+                         entry.get("name", "?"), success,
+                         int(row.get("reject_count", 0)),
+                         int(row.get("fail_count", 0)),
+                         duration_ns / success / 1000.0 if success
+                         else 0.0))
+
+
 def print_chaos_report(results: List[PerfStatus], retry_count: int,
                        injected: Optional[dict] = None,
                        description: str = "",

@@ -48,11 +48,16 @@ from typing import Callable, Dict, List, Optional, Sequence
 from client_tpu.utils import InferenceServerException
 
 # Statuses worth retrying by default: server-side admission rejections
-# and transport failures surface as UNAVAILABLE (gRPC) / 503 (HTTP).
+# and transport failures surface as UNAVAILABLE (gRPC) / 503 (HTTP);
+# per-tenant quota rejects surface as RESOURCE_EXHAUSTED (gRPC) / 429
+# (HTTP) and carry a Retry-After derived from the token-bucket refill
+# time, which retry_after_of turns into the minimum backoff — the
+# retry is paced to when the server SAID capacity returns.
 # Deadline expiries are NOT default-retryable — a request that timed
 # out once will usually time out again and retrying it doubles load at
 # exactly the moment the server is slowest.
-DEFAULT_RETRYABLE_STATUSES = ("UNAVAILABLE", "503")
+DEFAULT_RETRYABLE_STATUSES = ("UNAVAILABLE", "503",
+                              "RESOURCE_EXHAUSTED", "429")
 
 # Statuses that justify FAILOVER to a different endpoint even though
 # they are not retryable against the same one: a server cancelling
@@ -72,6 +77,14 @@ CLIENT_ERROR_STATUSES = frozenset({
     "UNAUTHENTICATED", "401",
 })
 
+# Per-tenant quota rejects: retryable (paced by Retry-After) but
+# POLICY signals, not availability evidence — the server answered
+# decisively and is healthy, it just chose not to admit THIS tenant
+# yet. Counting them as breaker failures would let one over-quota
+# tenant open the circuit / eject a healthy endpoint for all traffic
+# sharing the client.
+QUOTA_REJECT_STATUSES = frozenset({"RESOURCE_EXHAUSTED", "429"})
+
 
 def _breaker_resolve(breaker: "CircuitBreaker", error: BaseException) -> None:
     """Settle the breaker after a failed attempt. A definitive client
@@ -83,7 +96,8 @@ def _breaker_resolve(breaker: "CircuitBreaker", error: BaseException) -> None:
     resolves a half-open probe — a probe left unresolved would lock
     the client out forever."""
     if isinstance(error, InferenceServerException) \
-            and (error.status() or "") in CLIENT_ERROR_STATUSES:
+            and ((error.status() or "") in CLIENT_ERROR_STATUSES
+                 or (error.status() or "") in QUOTA_REJECT_STATUSES):
         breaker.record_success()
     elif not isinstance(error, Exception):
         # asyncio.CancelledError / KeyboardInterrupt / SystemExit: the
@@ -1093,7 +1107,13 @@ def call_with_retry_pool(
                 _note_if_exhausted(policy, e)
                 raise
             tried.add(state.url)
-            if pool.has_alternative(exclude=tried):
+            # Quota rejects never fail over: quotas are enforced on
+            # every replica, so "try the next endpoint now" turns one
+            # throttled tenant's request into fleet-size physical hits
+            # and skips the Retry-After pacing the server asked for.
+            # They take the backoff path (floored at Retry-After).
+            if status not in QUOTA_REJECT_STATUSES \
+                    and pool.has_alternative(exclude=tried):
                 # Immediate failover: a healthy replica exists, so
                 # sleeping first would only stretch the tail.
                 pool.note_failover()
@@ -1243,7 +1263,11 @@ async def call_with_retry_pool_async(
                 _note_if_exhausted(policy, e)
                 raise
             tried.add(state.url)
-            if pool.has_alternative(exclude=tried):
+            # Same no-failover rule for quota rejects as the sync
+            # twin: pace on Retry-After instead of multiplying an
+            # over-quota tenant's load by fleet size.
+            if status not in QUOTA_REJECT_STATUSES \
+                    and pool.has_alternative(exclude=tried):
                 pool.note_failover()
                 note_retries()
                 attempt += 1

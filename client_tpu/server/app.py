@@ -23,6 +23,7 @@ def build_core(
     tpu_arena=None,
     warmup: bool = True,
     cache_size: Optional[int] = None,
+    tenant_quotas: Optional[str] = None,
 ) -> InferenceServerCore:
     repository = ModelRepository()
     for name, factory in builtin_model_factories(repository).items():
@@ -39,8 +40,18 @@ def build_core(
         # env var covers embedded launches with no CLI surface.
         env = os.environ.get("CLIENT_TPU_CACHE_SIZE", "")
         cache_size = int(env) if env else None
+    quota_manager = None
+    if tenant_quotas is None:
+        # Per-tenant admission quotas (same env-var pattern as the
+        # cache budget for embedded launches).
+        tenant_quotas = os.environ.get("CLIENT_TPU_TENANT_QUOTAS", "")
+    if tenant_quotas:
+        from client_tpu.server.qos import TenantQuotaManager
+
+        quota_manager = TenantQuotaManager.from_spec(tenant_quotas)
     core = InferenceServerCore(repository, tpu_arena=tpu_arena,
-                               cache_size=cache_size)
+                               cache_size=cache_size,
+                               tenant_quotas=quota_manager)
     for name in load_models or ():
         model = repository.load(name)
         if warmup:
@@ -140,9 +151,19 @@ def main(argv=None):
              "(0 disables; default 64 MiB; models opt in via "
              "response_cache.enable)",
     )
+    parser.add_argument(
+        "--tenant-quotas", default=None,
+        help="per-tenant admission quotas, e.g. "
+             "'default=rate:100,burst:20,concurrency:8;bulk=rate:10' "
+             "(rejects are 429/RESOURCE_EXHAUSTED with Retry-After "
+             "from the bucket refill time; tenant identity comes from "
+             "the `tenant` request parameter, the x-tenant-id HTTP "
+             "header, or `tenant` gRPC metadata)",
+    )
     args = parser.parse_args(argv)
 
-    core = build_core(args.models, cache_size=args.cache_size)
+    core = build_core(args.models, cache_size=args.cache_size,
+                      tenant_quotas=args.tenant_quotas)
     handle = start_grpc_server(
         core=core, address="%s:%d" % (args.host, args.grpc_port)
     )

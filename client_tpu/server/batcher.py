@@ -38,6 +38,18 @@ into a pipeline:
   wall-clock actually overlapped compute — the served-path number the
   statistics endpoints report as ``overlap_ratio``.
 
+With ``priority_levels`` configured (Triton semantics: classes
+``1..priority_levels``, 1 highest), each shape bucket segments its
+queue per class and dispatch drains classes strictly in priority
+order — a priority-1 request overtakes a bulk backlog at dispatch
+time — with an aged-oldest slot every ``AGE_EVERY`` dispatches so
+strict ordering cannot starve bulk. Priority is dispatch ORDER, not
+fusion identity: mixed classes still fuse into one padded execution.
+Overload degrades lowest-priority-first (the graceful-shedding
+tentpole): past ``shed_watermark`` lowest-class arrivals are shed
+with Retry-After, and at a hard-full queue a higher-priority arrival
+displaces the newest lowest-class waiter instead of being rejected.
+
 Sequence requests route through the sequence scheduler
 (client_tpu.server.sequence) instead of entering here directly; under
 the oldest strategy that scheduler dispatches per-sequence STEPS into
@@ -47,6 +59,7 @@ like any other concurrent requests."""
 
 from __future__ import annotations
 
+import inspect
 import threading
 import time
 from collections import OrderedDict
@@ -55,6 +68,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from client_tpu.server import tracing as spantrace
+from client_tpu.server.qos import coerce_int, coerce_priority
 from client_tpu.utils import InferenceServerException
 
 NANOS_PER_US = 1_000
@@ -63,10 +77,11 @@ NANOS_PER_US = 1_000
 class _Pending:
     __slots__ = ("inputs", "params", "batch", "shape_key", "event",
                  "outputs", "error", "enqueue_ns", "queue_ns", "leader",
-                 "deadline_ns", "trace", "done_ns", "queue_from_ns")
+                 "deadline_ns", "trace", "done_ns", "queue_from_ns",
+                 "priority")
 
     def __init__(self, inputs, params, batch, shape_key,
-                 timeout_ns: int = 0, trace=None):
+                 timeout_ns: int = 0, trace=None, priority: int = 0):
         self.inputs = inputs
         self.params = params
         self.batch = batch
@@ -93,6 +108,116 @@ class _Pending:
         # enqueue locking, not just time spent in the bucket).
         self.done_ns = 0
         self.queue_from_ns = 0
+        # QoS class (1..priority_levels, 1 highest; 0 = model has no
+        # priority levels). Dispatch order, never fusion identity —
+        # mixed-priority requests still fuse into one execution.
+        self.priority = priority
+
+
+class _Bucket:
+    """One shape bucket's pending requests, segmented per priority
+    class. Level 0 (priority disabled) degenerates to a single FIFO —
+    the pre-QoS behavior, at the cost of one extra dict hop. Dispatch
+    drains classes in ascending level order (1 = highest first), FIFO
+    within a class; the caller holds the batcher lock throughout."""
+
+    __slots__ = ("queues", "dispatches")
+
+    def __init__(self):
+        # level -> FIFO of _Pending, keys kept in ascending (highest
+        # priority first) order so dispatch iteration is just
+        # insertion order. Pending totals are the batcher's job
+        # (_pending_total / _pending_by_priority) — no per-bucket
+        # count is kept here.
+        self.queues: "OrderedDict[int, List[_Pending]]" = OrderedDict()
+        # This bucket's own dispatch count, driving the aged-oldest
+        # slot: a batcher-global counter could beat periodically
+        # against the bucket-selection pattern (e.g. two buckets
+        # alternating with AGE_EVERY=4 always lands the aged slot on
+        # the same bucket), letting bulk starve in the other.
+        self.dispatches = 0
+
+    def append(self, pending: _Pending) -> None:
+        queue = self.queues.get(pending.priority)
+        if queue is None:
+            self.queues[pending.priority] = [pending]
+            if len(self.queues) > 1:
+                self.queues = OrderedDict(sorted(self.queues.items()))
+        else:
+            queue.append(pending)
+
+    def head_ns(self) -> int:
+        """Enqueue stamp of the OLDEST pending request across classes
+        (each class queue is FIFO, so its head is its oldest)."""
+        return min(queue[0].enqueue_ns for queue in self.queues.values())
+
+    def plan(self, max_batch: int, full_at: int) -> int:
+        """Dry-run of take(): the fused batch total a dispatch now
+        would reach, visiting classes in priority order."""
+        total = 0
+        for queue in self.queues.values():
+            for pending in queue:
+                if total and (total + pending.batch > max_batch
+                              or total >= full_at):
+                    return total
+                total += pending.batch
+                if total >= full_at:
+                    return total
+        return total
+
+    def take(self, max_batch: int, full_at: int,
+             age_oldest: bool = False) -> List[_Pending]:
+        """Pops the requests of one fused dispatch: strict priority
+        order (class 1 drains first), except that with ``age_oldest``
+        the globally-oldest request is seated FIRST regardless of its
+        class — the weighted share of strict-then-weighted dispatch
+        that keeps a saturating high-priority stream from starving
+        bulk forever. The first request is always taken even when its
+        batch alone exceeds max_batch (validated upstream; running it
+        alone beats wedging the queue)."""
+        taken: List[_Pending] = []
+        total = 0
+        if age_oldest and len(self.queues) > 1:
+            oldest_level = min(
+                self.queues,
+                key=lambda level: self.queues[level][0].enqueue_ns)
+            head = self.queues[oldest_level].pop(0)
+            if not self.queues[oldest_level]:
+                del self.queues[oldest_level]
+            taken.append(head)
+            total = head.batch
+        done = False
+        for level in list(self.queues):
+            queue = self.queues[level]
+            while queue:
+                pending = queue[0]
+                if taken and (total + pending.batch > max_batch
+                              or total >= full_at):
+                    # Stop the WHOLE take at the first non-fitting
+                    # head: skipping it to seat a smaller lower-class
+                    # request would invert priority order.
+                    done = True
+                    break
+                taken.append(queue.pop(0))
+                total += pending.batch
+            if not queue:
+                del self.queues[level]
+            if done:
+                break
+        return taken
+
+    def remove(self, pending: _Pending) -> bool:
+        """Drops one specific pending (shed path). False if absent."""
+        queue = self.queues.get(pending.priority)
+        if not queue:
+            return False
+        try:
+            queue.remove(pending)
+        except ValueError:
+            return False
+        if not queue:
+            del self.queues[pending.priority]
+        return True
 
 
 class _OverlapTracker:
@@ -164,6 +289,12 @@ class DynamicBatcher:
     once per successful fused execution — the server core feeds its
     per-model batch-size histogram from it."""
 
+    # Every Nth dispatch from a mixed-priority bucket seats the
+    # globally-oldest request first (the "weighted" arm of
+    # strict-then-weighted dispatch): lower classes keep a bounded
+    # share of dispatch slots even under sustained priority-1 load.
+    AGE_EVERY = 4
+
     def __init__(self, model, max_queue_delay_us: int = 500,
                  preferred_batch_sizes: Optional[List[int]] = None,
                  delay_min_us: int = 0, delay_max_us: int = 0,
@@ -174,9 +305,31 @@ class DynamicBatcher:
                  default_timeout_us: int = 0,
                  allow_timeout_override: bool = True,
                  timeout_action: str = "REJECT",
-                 reject_hook: Optional[Callable[[], None]] = None,
-                 timeout_hook: Optional[Callable[[], None]] = None):
+                 reject_hook: Optional[Callable[..., None]] = None,
+                 timeout_hook: Optional[Callable[..., None]] = None,
+                 priority_levels: int = 0,
+                 default_priority_level: int = 0,
+                 priority_policies: Optional[Dict[int, dict]] = None,
+                 shed_watermark: float = 0.0,
+                 shed_hook: Optional[Callable[..., None]] = None):
         self._model = model
+        # Priority scheduling (Triton priority_levels semantics):
+        # classes 1..priority_levels, 1 highest; requests pick their
+        # class via the `priority` parameter (coerced + validated by
+        # qos.coerce_priority — out-of-range is INVALID_ARGUMENT, not
+        # a silent drop). priority_policies maps a level to optional
+        # {"max_queue_size", "default_timeout_us"} overrides.
+        # shed_watermark (fraction of max_queue_size) arms graceful
+        # load shedding: past it, lowest-class arrivals are shed with
+        # Retry-After, and at a hard-full queue a higher-priority
+        # arrival displaces the newest lowest-class waiter instead of
+        # being turned away.
+        self._priority_levels = max(int(priority_levels), 0)
+        self._default_priority = int(default_priority_level)
+        self._priority_policies = dict(priority_policies or {})
+        self._shed_watermark = min(max(float(shed_watermark), 0.0), 1.0)
+        self._shed_hook = shed_hook
+        self._pending_by_priority: Dict[int, int] = {}
         # Queue policy (Triton ModelQueuePolicy semantics):
         # max_queue_size bounds total pending requests (0 = unbounded;
         # overflow is rejected UNAVAILABLE at admission, never
@@ -221,11 +374,12 @@ class DynamicBatcher:
         # Inter-arrival EMA (ns); 0 until two requests have arrived.
         self._ia_ema_ns = 0.0
         self._last_arrival_ns = 0
-        # Per-shape bucket queues, insertion-ordered so draining and
-        # deadline scans visit older shapes first. _pending_total
-        # mirrors the summed queue lengths so admission control and
-        # the stats gauge read it in O(1) on the hot paths.
-        self._buckets: "OrderedDict[tuple, List[_Pending]]" = OrderedDict()
+        # Per-shape bucket queues (each segmented per priority class),
+        # insertion-ordered so draining and deadline scans visit older
+        # shapes first. _pending_total mirrors the summed queue
+        # lengths so admission control and the stats gauge read it in
+        # O(1) on the hot paths.
+        self._buckets: "OrderedDict[tuple, _Bucket]" = OrderedDict()
         self._pending_total = 0
         self._cv = threading.Condition()
         self._stopping = False
@@ -274,13 +428,17 @@ class DynamicBatcher:
 
     def infer(self, inputs: Dict[str, np.ndarray], params: dict,
               batch: int, trace=None,
-              queue_from_ns: int = 0) -> Dict[str, np.ndarray]:
+              queue_from_ns: int = 0,
+              priority: Optional[int] = None) -> Dict[str, np.ndarray]:
         """Blocks until this request's slice of a fused execution is
         ready. `batch` is the request's own batch-dim size; `trace` is
         the request's RequestTrace when sampled (never part of the
         fusion fingerprint — tracing must not fragment batches), and
         `queue_from_ns` backdates its queue span to the caller's last
-        span boundary."""
+        span boundary. `priority` is the caller's already-coerced
+        class when it validated the parameter itself (the core does,
+        for stats labeling — one coercion, one source of truth);
+        None = coerce from params here."""
         shape_key = (
             tuple(
                 (name, array.shape[1:], array.dtype.str)
@@ -288,30 +446,18 @@ class DynamicBatcher:
             ),
             _params_fingerprint(params),
         )
+        if priority is None:
+            priority = self._priority_for(params)  # INVALID_ARGUMENT
         pending = _Pending(inputs, params, batch, shape_key,
-                           timeout_ns=self._timeout_ns_for(params),
-                           trace=trace)
+                           timeout_ns=self._timeout_ns_for(params,
+                                                           priority),
+                           trace=trace, priority=priority)
         pending.queue_from_ns = queue_from_ns
         with self._cv:
             if self._stopping:
                 raise InferenceServerException(
                     "server is shutting down", status="UNAVAILABLE")
-            if self._max_queue_size > 0 \
-                    and self._pending_total >= self._max_queue_size:
-                # Admission control: overflow is rejected here, at the
-                # door, so a saturated queue sheds load in O(1) instead
-                # of growing without bound and timing everyone out.
-                if self._reject_hook is not None:
-                    try:
-                        self._reject_hook()
-                    except Exception:  # noqa: BLE001 — stats only
-                        pass
-                raise InferenceServerException(
-                    "request for model '%s' rejected: exceeds "
-                    "max_queue_size %d"
-                    % (getattr(self._model, "name", "?"),
-                       self._max_queue_size),
-                    status="UNAVAILABLE")
+            self._admit_locked(pending)
             if pending.deadline_ns:
                 self._any_deadlines = True
             now = pending.enqueue_ns
@@ -331,11 +477,14 @@ class DynamicBatcher:
                         gap if self._ia_ema_ns <= 0
                         else 0.875 * self._ia_ema_ns + 0.125 * gap)
             self._last_arrival_ns = now
-            queue = self._buckets.get(shape_key)
-            if queue is None:
-                queue = self._buckets[shape_key] = []
-            queue.append(pending)
+            bucket = self._buckets.get(shape_key)
+            if bucket is None:
+                bucket = self._buckets[shape_key] = _Bucket()
+            bucket.append(pending)
             self._pending_total += 1
+            if self._priority_levels:
+                self._pending_by_priority[priority] = \
+                    self._pending_by_priority.get(priority, 0) + 1
             self._cv.notify_all()
         pending.event.wait()
         if trace is not None and pending.done_ns:
@@ -350,20 +499,157 @@ class DynamicBatcher:
 
     # -- queue policy -----------------------------------------------------
 
-    def _timeout_ns_for(self, params: dict) -> int:
+    def _priority_for(self, params: dict) -> int:
+        """Coerced, validated priority class of one request (0 when the
+        model has no priority levels). Raises INVALID_ARGUMENT for
+        out-of-range or non-numeric values — the silent-drop fix."""
+        if not self._priority_levels:
+            return 0
+        return coerce_priority(params.get("priority"),
+                               self._priority_levels,
+                               self._default_priority)
+
+    def _timeout_ns_for(self, params: dict, priority: int = 0) -> int:
         """Effective queue timeout for one request: the per-request
         `timeout` parameter (microseconds, KServe-v2) when overrides
-        are allowed, else the model's default_queue_policy_timeout_us;
-        0 = no deadline."""
+        are allowed, else the priority class's default_timeout_us
+        (ModelQueuePolicy override), else the model's
+        default_queue_policy_timeout_us; 0 = no deadline. String and
+        double wire forms are coerced like `priority`."""
         timeout_ns = self._default_timeout_ns
+        policy = self._priority_policies.get(priority)
+        if policy and policy.get("default_timeout_us"):
+            timeout_ns = int(policy["default_timeout_us"]) * NANOS_PER_US
         if self._allow_timeout_override:
             override = params.get("timeout")
             if override is not None:
                 try:
-                    timeout_ns = max(int(override), 0) * NANOS_PER_US
+                    timeout_ns = max(coerce_int(override), 0) \
+                        * NANOS_PER_US
                 except (TypeError, ValueError):
                     pass  # malformed timeouts fall back to the default
         return timeout_ns
+
+    def _admit_locked(self, pending: _Pending) -> None:
+        """Queue-policy admission for one request (caller holds the
+        lock). Three gates, cheapest first:
+
+        1. Per-priority max_queue_size (ModelQueuePolicy override) —
+           a class over its own bound is rejected even when the global
+           queue has room, so one class cannot monopolize the queue.
+        2. Shed watermark — past ``shed_watermark * max_queue_size``,
+           arrivals of the LOWEST class are shed with Retry-After
+           (they would otherwise ride the queue to the hard cap and
+           blow every deadline together).
+        3. Global max_queue_size — at a hard-full queue, an arrival
+           with strictly higher priority than the lowest-priority
+           waiter displaces the newest such waiter (the displaced
+           request is shed UNAVAILABLE); otherwise the arrival itself
+           is rejected. This is what keeps priority-1 goodput at 100%
+           while bulk saturates the queue."""
+        priority = pending.priority
+        policy = self._priority_policies.get(priority)
+        if policy and policy.get("max_queue_size"):
+            if self._pending_by_priority.get(priority, 0) \
+                    >= int(policy["max_queue_size"]):
+                self._hook(self._reject_hook, priority)
+                raise self._over_capacity_error(
+                    "priority-%d queue is full (per-priority "
+                    "max_queue_size %d)"
+                    % (priority, int(policy["max_queue_size"])))
+        if self._max_queue_size > 0:
+            if (self._shed_watermark > 0 and self._priority_levels
+                    and priority == self._priority_levels
+                    and self._pending_total
+                    >= self._shed_watermark * self._max_queue_size):
+                self._hook(self._shed_hook, priority)
+                raise self._over_capacity_error(
+                    "shed at watermark (queue depth %d >= %.0f%% of "
+                    "max_queue_size %d)"
+                    % (self._pending_total, self._shed_watermark * 100,
+                       self._max_queue_size))
+            if self._pending_total >= self._max_queue_size:
+                if self._priority_levels \
+                        and self._displace_locked(priority):
+                    return  # a lower-priority waiter made room
+                self._hook(self._reject_hook, priority)
+                raise self._over_capacity_error(
+                    "exceeds max_queue_size %d" % self._max_queue_size)
+
+    def _displace_locked(self, below: int) -> bool:
+        """Sheds the NEWEST waiter of the lowest-priority class whose
+        level is strictly greater (= lower priority) than ``below``;
+        the PR-2 expiry machinery's removal path reused for overload.
+        The newest waiter is chosen because it has the least queue
+        time invested — shedding the oldest would maximize wasted
+        wait. Returns False when every waiter is at least ``below``."""
+        victim: Optional[_Pending] = None
+        victim_key = None
+        for shape_key, bucket in self._buckets.items():
+            for level in reversed(bucket.queues):
+                if level <= below:
+                    break  # ascending keys: nothing lower-priority left
+                candidate = bucket.queues[level][-1]
+                if victim is None or level > victim.priority or (
+                        level == victim.priority
+                        and candidate.enqueue_ns > victim.enqueue_ns):
+                    victim = candidate
+                    victim_key = shape_key
+                break  # only the lowest class of this bucket matters
+        if victim is None:
+            return False
+        bucket = self._buckets[victim_key]
+        bucket.remove(victim)
+        if not bucket.queues:
+            del self._buckets[victim_key]
+        self._drop_accounting_locked(victim)
+        victim.queue_ns = time.monotonic_ns() - victim.enqueue_ns
+        victim.error = self._over_capacity_error(
+            "shed for a priority-%d arrival at a full queue "
+            "(max_queue_size %d)" % (below, self._max_queue_size))
+        victim.event.set()
+        self._hook(self._shed_hook, victim.priority)
+        return True
+
+    def _drop_accounting_locked(self, pending: _Pending) -> None:
+        self._pending_total -= 1
+        if self._priority_levels:
+            count = self._pending_by_priority.get(pending.priority, 0)
+            if count > 1:
+                self._pending_by_priority[pending.priority] = count - 1
+            else:
+                self._pending_by_priority.pop(pending.priority, None)
+
+    def _over_capacity_error(self, detail: str) -> InferenceServerException:
+        error = InferenceServerException(
+            "request for model '%s' rejected: %s"
+            % (getattr(self._model, "name", "?"), detail),
+            status="UNAVAILABLE")
+        # Server-advised backoff: half the current gather window is a
+        # decent guess at when a dispatch will have freed queue room.
+        error.retry_after_s = max(
+            self._cur_delay_ns / 2 / 1e9, 0.05)
+        return error
+
+    @staticmethod
+    def _hook(hook: Optional[Callable[..., None]],
+              priority: int) -> None:
+        # Arity is decided by signature, not by catching TypeError
+        # from the call — a hook whose BODY raises TypeError must not
+        # be silently re-invoked (side effects would double).
+        if hook is None:
+            return
+        try:
+            takes_priority = bool(inspect.signature(hook).parameters)
+        except (TypeError, ValueError):  # C callables: no signature
+            takes_priority = True
+        try:
+            if takes_priority:
+                hook(priority)
+            else:
+                hook()  # pre-QoS hooks take no priority argument
+        except Exception:  # noqa: BLE001 — stats only
+            pass
 
     def _expire_locked(self, now: int) -> Optional[int]:
         """Drops deadline-passed requests (timeout_action REJECT) and
@@ -378,35 +664,36 @@ class DynamicBatcher:
         earliest: Optional[int] = None
         expired: List[_Pending] = []
         for shape_key in list(self._buckets):
-            queue = self._buckets[shape_key]
-            live = []
-            for pending in queue:
-                if pending.deadline_ns and now >= pending.deadline_ns:
-                    pending.queue_ns = now - pending.enqueue_ns
-                    expired.append(pending)
-                    continue
-                if pending.deadline_ns:
-                    if earliest is None or pending.deadline_ns < earliest:
-                        earliest = pending.deadline_ns
-                live.append(pending)
-            if len(live) != len(queue):
-                if live:
-                    queue[:] = live
-                else:
-                    del self._buckets[shape_key]
-        self._pending_total -= len(expired)
+            bucket = self._buckets[shape_key]
+            for level in list(bucket.queues):
+                queue = bucket.queues[level]
+                live = []
+                for pending in queue:
+                    if pending.deadline_ns and now >= pending.deadline_ns:
+                        pending.queue_ns = now - pending.enqueue_ns
+                        expired.append(pending)
+                        continue
+                    if pending.deadline_ns:
+                        if earliest is None \
+                                or pending.deadline_ns < earliest:
+                            earliest = pending.deadline_ns
+                    live.append(pending)
+                if len(live) != len(queue):
+                    if live:
+                        queue[:] = live
+                    else:
+                        del bucket.queues[level]
+            if not bucket.queues:
+                del self._buckets[shape_key]
         for pending in expired:
+            self._drop_accounting_locked(pending)
             pending.error = InferenceServerException(
                 "request for model '%s' timed out in queue after "
                 "%d us" % (getattr(self._model, "name", "?"),
                            pending.queue_ns // NANOS_PER_US),
                 status="DEADLINE_EXCEEDED")
             pending.event.set()
-            if self._timeout_hook is not None:
-                try:
-                    self._timeout_hook()
-                except Exception:  # noqa: BLE001 — stats only
-                    pass
+            self._hook(self._timeout_hook, pending.priority)
         return earliest
 
     # -- adaptive delay ---------------------------------------------------
@@ -496,7 +783,11 @@ class DynamicBatcher:
         on stop); otherwise (None, earliest_wake_ns). Oldest-head
         order keeps a flooded shape from starving a rare shape whose
         deadline expired while the flood's queue stayed permanently
-        full. Caller holds the lock."""
+        full. Within the chosen bucket the take respects priority
+        order (class 1 fills first, bulk rides the remaining
+        capacity), with an aged-oldest slot every AGE_EVERY dispatches
+        so strict ordering cannot starve bulk. Caller holds the
+        lock."""
         expire_wake = self._expire_locked(now)
         if not self._buckets:
             return None, expire_wake
@@ -508,31 +799,16 @@ class DynamicBatcher:
         stalled = (self._last_arrival_ns > 0 and
                    now - self._last_arrival_ns >= self._idle_cutoff_ns(delay))
         ready_key = None
-        ready_take = 0
         ready_head = None
         earliest: Optional[int] = None
-        for shape_key, queue in self._buckets.items():
-            take = 0
-            total = 0
-            for pending in queue:
-                if total + pending.batch > self._max_batch:
-                    break
-                total += pending.batch
-                take += 1
-                if total >= full_at:
-                    break
-            if take == 0:
-                # Head request alone exceeds max_batch capacity only
-                # when batch > max_batch (validated upstream) — run it
-                # alone rather than wedge the queue.
-                take = 1
-            head_ns = queue[0].enqueue_ns
+        for shape_key, bucket_q in self._buckets.items():
+            total = bucket_q.plan(self._max_batch, full_at)
+            head_ns = bucket_q.head_ns()
             deadline = head_ns + delay
             if (total >= full_at or now >= deadline or stalled
                     or self._stopping):
                 if ready_head is None or head_ns < ready_head:
-                    ready_key, ready_take, ready_head = \
-                        shape_key, take, head_ns
+                    ready_key, ready_head = shape_key, head_ns
                 continue
             wake = min(deadline,
                        self._last_arrival_ns + self._idle_cutoff_ns(delay))
@@ -544,13 +820,17 @@ class DynamicBatcher:
             # when every bucket's dispatch deadline lies further out.
             earliest = expire_wake
         if ready_key is not None:
-            queue = self._buckets[ready_key]
-            bucket = queue[:ready_take]
-            del queue[:ready_take]
-            self._pending_total -= ready_take
-            if not queue:
+            bucket_q = self._buckets[ready_key]
+            bucket_q.dispatches += 1
+            age_oldest = (self._priority_levels > 0
+                          and bucket_q.dispatches % self.AGE_EVERY == 0)
+            taken = bucket_q.take(self._max_batch, full_at,
+                                  age_oldest=age_oldest)
+            for pending in taken:
+                self._drop_accounting_locked(pending)
+            if not bucket_q.queues:
                 del self._buckets[ready_key]
-            return bucket, None
+            return taken, None
         return None, earliest
 
     def _padded_size(self, total: int) -> int:
@@ -577,9 +857,14 @@ class DynamicBatcher:
         for pending in bucket:
             pending.queue_ns = start_ns - pending.enqueue_ns
             if pending.trace is not None:
+                # The priority attribute makes QoS ordering visible in
+                # the span tree: a reader can see a priority-1 queue
+                # span end (dispatch) while older bulk spans run on.
                 pending.trace.add_timed(
                     spantrace.SPAN_QUEUE,
-                    pending.queue_from_ns or pending.enqueue_ns, start_ns)
+                    pending.queue_from_ns or pending.enqueue_ns, start_ns,
+                    {"priority": pending.priority} if pending.priority
+                    else None)
         try:
             total = sum(p.batch for p in bucket)
             target = self._padded_size(total)
@@ -734,11 +1019,19 @@ class DynamicBatcher:
 
     def stats_snapshot(self) -> dict:
         """Point-in-time pipeline gauges plus cumulative compute/fetch
-        overlap counters (the statistics endpoints' pipeline_stats)."""
+        overlap counters (the statistics endpoints' pipeline_stats).
+        ``pending_by_priority`` feeds the tpu_priority_queue_size
+        Prometheus family (empty when priority levels are off)."""
         with self._cv:
             pending = self._pending_total
             inflight = self._inflight
             delay_us = self._cur_delay_ns // NANOS_PER_US
+            # Every configured class reports a row (0 included):
+            # a class's series must not appear/disappear with traffic.
+            by_priority = {
+                level: self._pending_by_priority.get(level, 0)
+                for level in range(1, self._priority_levels + 1)
+            }
         compute_ns, fetch_ns, overlap_ns = self._tracker.snapshot()
         return {
             "pending_count": pending,
@@ -748,6 +1041,7 @@ class DynamicBatcher:
             "fetch_ns": fetch_ns,
             "overlap_ns": overlap_ns,
             "overlap_ratio": (overlap_ns / fetch_ns) if fetch_ns else 0.0,
+            "pending_by_priority": by_priority,
         }
 
 
@@ -792,18 +1086,27 @@ def _fuse_chunks(chunks, target: int, total: int):
     return buf
 
 
+# Parameters enforced per request by the scheduler itself, never by
+# the model: they must not fragment fusion. `timeout` (PR 2) is a
+# per-request deadline; `priority` orders dispatch but the fused batch
+# executes identically; `tenant` is admission-control identity.
+_QOS_PARAMS = frozenset(("timeout", "priority", "tenant"))
+
+
 def _params_fingerprint(params: dict):
     """Normalized, hashable view of request parameters. Requests are
     only fused when their parameters match — fusing would otherwise
     execute the whole bucket with the leader's params, silently
-    dropping the rest (priority, custom params). `timeout` is excluded:
-    the batcher enforces each request's deadline individually, so
-    differing timeouts must not fragment fusion."""
+    dropping the rest (custom params). QoS knobs (`timeout`,
+    `priority`, `tenant`) are excluded: the scheduler enforces them
+    per request, so mixed deadlines/classes/tenants still fuse into
+    one padded execution — QoS ordering costs dispatch order, not
+    batch efficiency."""
     if not params:
         return ()
     return tuple(
         (key, repr(params[key])) for key in sorted(params)
-        if key != "timeout"
+        if key not in _QOS_PARAMS
     )
 
 

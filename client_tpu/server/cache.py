@@ -51,10 +51,13 @@ from client_tpu.protocol import inference_pb2 as pb
 DEFAULT_CACHE_BYTES = 64 << 20
 
 # Request parameters that must NOT contribute to the content hash:
-# QoS/transport knobs that do not change the response payload.
+# QoS/transport knobs that do not change the response payload
+# (`tenant` is admission identity — two tenants sending the same
+# request share one cached response).
 _UNCACHED_PARAMS = frozenset((
     "timeout",
     "priority",
+    "tenant",
     "triton_enable_empty_final_response",
     "binary_data_output",
 ))
@@ -116,14 +119,18 @@ def request_cache_key(model_name: str, model_version: str,
 class Flight:
     """One in-progress execution for a cache key. The leader resolves
     it with the encoded response (or marks it failed); followers wait
-    on ``event`` bounded by their own queue deadline."""
+    on ``event`` bounded by their own queue deadline. ``priority`` is
+    the leader's coerced class (0 = unclassed): a would-be follower of
+    a strictly higher class must not coalesce behind a lower-class
+    leader stuck at the back of the priority queue."""
 
-    __slots__ = ("event", "response", "failed")
+    __slots__ = ("event", "response", "failed", "priority")
 
-    def __init__(self):
+    def __init__(self, priority: int = 0):
         self.event = threading.Event()
         self.response: Optional[pb.ModelInferResponse] = None
         self.failed = False
+        self.priority = priority
 
 
 # Charged per entry on top of the serialized payload: key digest,
@@ -193,14 +200,16 @@ class ResponseCache:
             self._entries.move_to_end(key)
             return entry.data
 
-    def lookup_or_begin(self, key: bytes
+    def lookup_or_begin(self, key: bytes, priority: int = 0
                         ) -> Tuple[Optional[bytes], Optional[Flight], bool]:
         """(cached_bytes, flight, is_leader) in ONE atomic step. A
         separate lookup-miss followed by begin_flight would race: a
         leader that resolves and inserts between the two calls leaves
         the late thread leading a second redundant execution. Inserts
         happen BEFORE flight resolution on the leader path, so this
-        atomic probe can never miss both."""
+        atomic probe can never miss both. ``priority`` is stamped on a
+        newly-led flight so higher-class arrivals can decline to
+        coalesce behind it."""
         with self._lock:
             entry = self._entries.get(key)
             if entry is not None:
@@ -209,7 +218,7 @@ class ResponseCache:
             flight = self._flights.get(key)
             if flight is not None:
                 return None, flight, False
-            flight = Flight()
+            flight = Flight(priority)
             self._flights[key] = flight
             return None, flight, True
 

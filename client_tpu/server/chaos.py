@@ -221,6 +221,117 @@ def inject(model_name: str = "", scope: Optional[str] = None) -> None:
             status="UNAVAILABLE")
 
 
+class OverloadScenario:
+    """Staged burst-arrival injection against ONE model: after
+    ``burst_after_s`` a pool of ``workers`` closed-loop threads floods
+    ``submit_fn`` (one call = one request; it may raise — rejects ARE
+    the point) for ``burst_duration_s``, with seeded-jitter pacing so
+    a run is reproducible. The saturation half of the CI overload
+    gate: the burst drives a bounded queue to its max_queue_size while
+    foreground traffic's QoS is measured.
+
+    Spec string (perf ``--overload``), comma-separated key=value:
+    ``rate=500,after_s=1,duration_s=3,workers=8,seed=11`` — rate is
+    target submissions/sec across all workers (0 = as fast as the
+    closed loops can go). Timings are relative to :meth:`start`.
+    """
+
+    def __init__(self, submit_fn, rate: float = 0.0,
+                 burst_after_s: float = 0.0,
+                 burst_duration_s: float = 3.0,
+                 workers: int = 8, seed: int = 11):
+        self.submit_fn = submit_fn
+        self.rate = max(float(rate), 0.0)
+        self.burst_after_s = max(float(burst_after_s), 0.0)
+        self.burst_duration_s = max(float(burst_duration_s), 0.0)
+        self.workers = max(int(workers), 1)
+        self.seed = seed
+        self.submitted = 0
+        self.rejected = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: list = []
+        self.started = threading.Event()
+        self.finished = threading.Event()
+
+    @classmethod
+    def parse_spec(cls, spec: str) -> dict:
+        """``"rate=500,after_s=1,duration_s=3,workers=8,seed=11"`` ->
+        constructor kwargs; unknown keys fail loudly."""
+        kwargs: dict = {}
+        for part in (spec or "").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            key, sep, value = part.partition("=")
+            if not sep:
+                raise ValueError(
+                    "overload spec entry '%s' is not key=value" % part)
+            key = key.strip()
+            if key == "rate":
+                kwargs["rate"] = float(value)
+            elif key == "after_s":
+                kwargs["burst_after_s"] = float(value)
+            elif key == "duration_s":
+                kwargs["burst_duration_s"] = float(value)
+            elif key == "workers":
+                kwargs["workers"] = int(value)
+            elif key == "seed":
+                kwargs["seed"] = int(value)
+            else:
+                raise ValueError("unknown overload spec key '%s'" % key)
+        return kwargs
+
+    def start(self) -> "OverloadScenario":
+        self._threads = [
+            threading.Thread(target=self._run, args=(i,), daemon=True,
+                             name="chaos-overload-%d" % i)
+            for i in range(self.workers)
+        ]
+        for thread in self._threads:
+            thread.start()
+        return self
+
+    def _run(self, index: int) -> None:
+        # Per-worker seeded rng: pacing jitter is reproducible AND
+        # uncorrelated across workers (one shared rng under a lock
+        # would serialize the burst it exists to create).
+        rng = random.Random(self.seed * 1_000_003 + index)
+        if self._stop.wait(self.burst_after_s):
+            return
+        self.started.set()
+        deadline = time.monotonic() + self.burst_duration_s
+        per_worker_rate = self.rate / self.workers if self.rate else 0.0
+        while not self._stop.is_set() and time.monotonic() < deadline:
+            try:
+                self.submit_fn()
+                with self._lock:
+                    self.submitted += 1
+            except Exception:  # noqa: BLE001 — rejects are the point
+                with self._lock:
+                    self.submitted += 1
+                    self.rejected += 1
+            if per_worker_rate > 0:
+                # Exponential inter-arrival: a Poisson burst, the
+                # arrival process queueing theory (and the adaptive
+                # batcher window) assumes, not a metronome.
+                pause = rng.expovariate(per_worker_rate)
+                if self._stop.wait(min(pause, 1.0)):
+                    return
+        self.finished.set()
+
+    def stop(self) -> None:
+        """Cancel the burst (or wait out stragglers) and join."""
+        self._stop.set()
+        for thread in self._threads:
+            thread.join(timeout=5)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"submitted": self.submitted,
+                    "rejected": self.rejected}
+
+
 class DegradeOneScenario:
     """Staged degradation of ONE replica in an in-process fleet: after
     ``latency_after_s`` the victim's scope gets a latency spike (the

@@ -81,6 +81,18 @@ class _ModelStats:
         # the tpu_request_*_total Prometheus families).
         self.rejected_count = 0
         self.timeout_count = 0
+        # Overload sheds (lowest-priority-first drops: displacement at
+        # a full queue, watermark sheds) — distinct from plain rejects
+        # so dashboards can tell "queue full" from "QoS made room".
+        self.shed_count = 0
+        # Per-priority-class rows (ModelStatistics.priority_stats):
+        # level -> [success, reject, timeout, shed, queue_ns].
+        self.priority_hist: Dict[int, list] = {}
+        # Per-tenant rows (ModelStatistics.tenant_stats):
+        # tenant -> [success, reject, fail, duration_ns]. Quota
+        # rejects land in `reject`; queue-policy drops are priority
+        # rows' business.
+        self.tenant_hist: Dict[str, list] = {}
         # Fused-batch-size histogram fed by the dynamic batcher's
         # stats hook: executed batch size -> [executions, compute_ns,
         # fetch_ns] (renders as ModelStatistics.batch_stats).
@@ -95,9 +107,14 @@ class _ModelStats:
         self.cache_miss_count = 0
         self.cache_miss_ns = 0
 
+    def _priority_row(self, level: int) -> list:
+        """[success, reject, timeout, shed, queue_ns] for one class
+        (caller holds the lock)."""
+        return self.priority_hist.setdefault(level, [0, 0, 0, 0, 0])
+
     def record(self, batch: int, queue_ns: int, ci_ns: int, infer_ns: int,
                co_ns: int, ok: bool, executions: int = 1,
-               total_ns: Optional[int] = None):
+               total_ns: Optional[int] = None, priority: int = 0):
         # total_ns overrides the component sum for paths whose time
         # must not land in any queue/compute bucket (cache hits).
         total = queue_ns + ci_ns + infer_ns + co_ns \
@@ -112,20 +129,68 @@ class _ModelStats:
                 self.compute_input_ns += ci_ns
                 self.compute_infer_ns += infer_ns
                 self.compute_output_ns += co_ns
+                if priority:
+                    row = self._priority_row(priority)
+                    row[0] += 1
+                    row[4] += queue_ns
             else:
                 self.fail_count += 1
                 self.fail_ns += total
             self.last_inference_ms = int(time.time() * 1000)
 
-    def record_rejected(self):
+    def record_rejected(self, priority: int = 0):
         """Queue-policy admission rejection (max_queue_size hit)."""
         with self.lock:
             self.rejected_count += 1
+            if priority:
+                self._priority_row(priority)[1] += 1
 
-    def record_timeout(self):
+    def record_timeout(self, priority: int = 0):
         """Queue-deadline expiry (request dropped before dispatch)."""
         with self.lock:
             self.timeout_count += 1
+            if priority:
+                self._priority_row(priority)[2] += 1
+
+    def record_shed(self, priority: int = 0):
+        """Overload shed: the request was dropped to protect a higher
+        class (displacement / watermark), lowest-priority-first."""
+        with self.lock:
+            self.shed_count += 1
+            if priority:
+                self._priority_row(priority)[3] += 1
+
+    def _tenant_row(self, tenant: str) -> list:
+        """[success, reject, fail, duration_ns] for one tenant (caller
+        holds the lock). Cardinality-bounded like the quota manager:
+        identity is client-supplied, so past the cap new names fold
+        into one overflow row instead of growing without bound."""
+        row = self.tenant_hist.get(tenant)
+        if row is None:
+            from client_tpu.server.qos import (
+                MAX_TRACKED_TENANTS,
+                OVERFLOW_TENANT,
+            )
+
+            if len(self.tenant_hist) >= MAX_TRACKED_TENANTS:
+                tenant = OVERFLOW_TENANT
+            row = self.tenant_hist.setdefault(tenant, [0, 0, 0, 0])
+        return row
+
+    def record_tenant(self, tenant: str, ok: bool, ns: int):
+        """End-to-end per-tenant accounting for one served request."""
+        with self.lock:
+            row = self._tenant_row(tenant)
+            if ok:
+                row[0] += 1
+                row[3] += max(int(ns), 0)
+            else:
+                row[2] += 1
+
+    def record_tenant_rejected(self, tenant: str):
+        """Quota reject (token bucket / concurrency cap) at the door."""
+        with self.lock:
+            self._tenant_row(tenant)[1] += 1
 
     def record_cache_hit(self, ns: int):
         """One request served from the response cache (or coalesced
@@ -174,6 +239,77 @@ def stream_error_response(request, message):
     return response
 
 
+class _TenantAdmission:
+    """Pairs tenant-quota admission with release + accounting so the
+    unary and streaming paths cannot drift. ``__enter__`` resolves the
+    request's tenant and spends a quota token/in-flight slot (a reject
+    records per-tenant accounting and raises RESOURCE_EXHAUSTED);
+    ``__exit__`` returns the slot and records latency on EVERY exit —
+    including failures between admission and model acquire, which
+    would otherwise leak the slot and starve a concurrency-capped
+    tenant. Callers set ``ok = True`` on success and ``model_name``
+    once a validated model is known (per-model tenant rows must not be
+    minted for bogus model names)."""
+
+    __slots__ = ("_core", "_request", "tenant", "ok", "model_name",
+                 "_held", "_t0")
+
+    def __init__(self, core: "InferenceServerCore",
+                 request: pb.ModelInferRequest):
+        self._core = core
+        self._request = request
+        self.tenant = None
+        self.ok = False
+        self.model_name: Optional[str] = None
+        self._held = False
+        self._t0 = 0
+
+    def __enter__(self) -> "_TenantAdmission":
+        core, request = self._core, self._request
+        tenant = core._tenant_of(request)
+        quotas = core.tenant_quotas
+        if tenant is not None and quotas is not None and quotas.enabled:
+            try:
+                # acquire may resolve the identity to the shared
+                # overflow bucket (cardinality bound) — release and
+                # accounting must use the resolved name.
+                tenant = quotas.acquire(tenant)
+                self._held = True
+            except InferenceServerException as e:
+                # Per-model reject accounting only for KNOWN stats
+                # entries: a quota-rejected request naming a bogus
+                # model must not mint permanent per-model series.
+                with core._stats_lock:
+                    stats = core._stats.get(request.model_name)
+                if stats is not None:
+                    stats.record_tenant_rejected(tenant)
+                _LOG.debug("request %s for tenant '%s' rejected: %s",
+                           request.id, tenant, e)
+                raise
+        self.tenant = tenant
+        self._t0 = time.monotonic_ns() if tenant is not None else 0
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if self.tenant is not None:
+            duration_ns = time.monotonic_ns() - self._t0
+            if self._held:
+                self._core.tenant_quotas.release(
+                    self.tenant, self.ok, duration_ns)
+            if self.model_name is not None:
+                self._core._stats_for(self.model_name).record_tenant(
+                    self.tenant, self.ok, duration_ns)
+        return False
+
+
+def _escape_label_value(value) -> str:
+    """Prometheus exposition-format label-value escaping. Tenant is the
+    one CLIENT-supplied label value on /metrics; a quote, backslash, or
+    newline inside it must not corrupt the whole exposition page."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _param_value(param: pb.InferParameter):
     which = param.WhichOneof("parameter_choice")
     return getattr(param, which) if which else None
@@ -181,9 +317,17 @@ def _param_value(param: pb.InferParameter):
 
 class InferenceServerCore:
     def __init__(self, repository: ModelRepository, tpu_arena=None,
-                 cache_size: Optional[int] = None):
+                 cache_size: Optional[int] = None,
+                 tenant_quotas=None):
         self.repository = repository
         self.memory = SharedMemoryManager(tpu_arena)
+        # Per-tenant admission control (client_tpu.server.qos
+        # TenantQuotaManager; None/disabled = zero per-request cost).
+        # Enforced at the very front of infer(), before the model is
+        # even acquired: a tenant over its token bucket or concurrency
+        # cap is rejected RESOURCE_EXHAUSTED (HTTP 429) with a
+        # Retry-After derived from the bucket refill time.
+        self.tenant_quotas = tenant_quotas
         # Content-addressed response cache (server-level byte budget;
         # models opt in via response_cache.enable). 0 disables. The
         # repository's unload drain path invalidates a model's entries
@@ -269,7 +413,20 @@ class InferenceServerCore:
                     timeout_count=s.timeout_count,
                     cache_hit_count=s.cache_hit_count,
                     cache_miss_count=s.cache_miss_count,
+                    shed_count=s.shed_count,
                 )
+                for level in sorted(s.priority_hist):
+                    row = s.priority_hist[level]
+                    stat.priority_stats.add(
+                        priority_level=level, success_count=row[0],
+                        reject_count=row[1], timeout_count=row[2],
+                        shed_count=row[3], queue_ns=row[4])
+                for tenant in sorted(s.tenant_hist):
+                    row = s.tenant_hist[tenant]
+                    stat.tenant_stats.add(
+                        tenant=tenant, success_count=row[0],
+                        reject_count=row[1], fail_count=row[2],
+                        duration_ns=row[3])
                 stat.inference_stats.cache_hit.count = s.cache_hit_count
                 stat.inference_stats.cache_hit.ns = s.cache_hit_ns
                 stat.inference_stats.cache_miss.count = s.cache_miss_count
@@ -336,6 +493,8 @@ class InferenceServerCore:
         success, failure, count, exec_count, duration = [], [], [], [], []
         fused_hist, rejected, timed_out = [], [], []
         cache_hits, cache_misses = [], []
+        shed_rows = []
+        tenant_totals: Dict[str, list] = {}
         with self._stats_lock:
             stats_snapshot = dict(self._stats)
         for name, s in sorted(stats_snapshot.items()):
@@ -363,6 +522,14 @@ class InferenceServerCore:
                     fused_hist.append(
                         'tpu_batch_fused_total{model="%s",size="%d"} %d'
                         % (name, size, s.batch_hist[size][0]))
+                for level in sorted(s.priority_hist):
+                    shed_rows.append(
+                        'tpu_shed_total{model="%s",priority="%d"} %d'
+                        % (name, level, s.priority_hist[level][3]))
+                for tenant, row in s.tenant_hist.items():
+                    total = tenant_totals.setdefault(tenant, [0, 0, 0, 0])
+                    for i in range(4):
+                        total[i] += row[i]
         family("nv_inference_request_success", "counter",
                "Number of successful inference requests", success)
         family("nv_inference_request_failure", "counter",
@@ -387,6 +554,66 @@ class InferenceServerCore:
         family("tpu_cache_miss_total", "counter",
                "Cache-eligible requests that executed the model",
                cache_misses)
+        family("tpu_shed_total", "counter",
+               "Requests dropped by graceful load shedding, "
+               "lowest-priority-first (displacement at a full queue + "
+               "watermark sheds)", shed_rows)
+
+        tenant_success, tenant_rejected, tenant_failure = [], [], []
+        tenant_duration = []
+        # Quota rejects come from the quota manager when configured —
+        # it counts every reject, including ones for model names that
+        # never minted a stats entry; per-model rows are the fallback.
+        quota_snapshot = (self.tenant_quotas.snapshot()
+                          if self.tenant_quotas is not None else None)
+        if quota_snapshot is not None:
+            rejected_by_tenant = {
+                tenant: snap["rejected"]
+                for tenant, snap in quota_snapshot.items()}
+        else:
+            rejected_by_tenant = {
+                tenant: row[1] for tenant, row in tenant_totals.items()}
+        for tenant in sorted(tenant_totals):
+            row = tenant_totals[tenant]
+            label = '{tenant="%s"}' % _escape_label_value(tenant)
+            tenant_success.append("tpu_tenant_success_total%s %d"
+                                  % (label, row[0]))
+            tenant_failure.append("tpu_tenant_failure_total%s %d"
+                                  % (label, row[2]))
+            tenant_duration.append("tpu_tenant_request_duration_us%s %d"
+                                   % (label, row[3] // 1000))
+        for tenant in sorted(rejected_by_tenant):
+            tenant_rejected.append(
+                'tpu_tenant_rejected_total{tenant="%s"} %d'
+                % (_escape_label_value(tenant),
+                   rejected_by_tenant[tenant]))
+        family("tpu_tenant_success_total", "counter",
+               "Successful requests per tenant (summed over models)",
+               tenant_success)
+        family("tpu_tenant_rejected_total", "counter",
+               "Requests rejected by per-tenant quotas (token bucket "
+               "or concurrency cap)", tenant_rejected)
+        family("tpu_tenant_failure_total", "counter",
+               "Failed requests per tenant (post-admission errors)",
+               tenant_failure)
+        family("tpu_tenant_request_duration_us", "counter",
+               "Cumulative successful-request duration per tenant",
+               tenant_duration)
+
+        tenant_inflight, tenant_tokens = [], []
+        if quota_snapshot is not None:
+            for tenant, snap in sorted(quota_snapshot.items()):
+                label = '{tenant="%s"}' % _escape_label_value(tenant)
+                tenant_inflight.append("tpu_tenant_inflight%s %d"
+                                       % (label, snap["inflight"]))
+                tenant_tokens.append("tpu_tenant_tokens%s %.3f"
+                                     % (label, snap["tokens"]))
+        family("tpu_tenant_inflight", "gauge",
+               "Requests currently in flight per tenant",
+               tenant_inflight)
+        family("tpu_tenant_tokens", "gauge",
+               "Tokens remaining in each tenant's admission bucket",
+               tenant_tokens)
 
         size_rows, entry_rows, evict_rows = [], [], []
         for name, snap in sorted(self.response_cache.snapshot().items()):
@@ -407,7 +634,7 @@ class InferenceServerCore:
 
         pending_rows, inflight_rows, delay_rows, overlap_rows = \
             [], [], [], []
-        queue_rows = []
+        queue_rows, priority_queue_rows = [], []
         with self._batchers_lock:
             batchers_snapshot = dict(self._batchers)
         for name, batcher in sorted(batchers_snapshot.items()):
@@ -416,6 +643,11 @@ class InferenceServerCore:
             except Exception:  # noqa: BLE001 — metrics never take
                 continue  # the server down
             label = '{model="%s"}' % name
+            for level in sorted(snap.get("pending_by_priority", {})):
+                priority_queue_rows.append(
+                    'tpu_priority_queue_size{model="%s",priority="%d"} '
+                    '%d' % (name, level,
+                            snap["pending_by_priority"][level]))
             # Deliberately the same sample as tpu_batch_pending_depth:
             # tpu_queue_size is the stable queue-policy-facing name
             # (paired with tpu_request_rejected_total); the batch_*
@@ -433,6 +665,9 @@ class InferenceServerCore:
         family("tpu_queue_size", "gauge",
                "Requests pending in the per-model scheduler queue "
                "(admission-controlled by max_queue_size)", queue_rows)
+        family("tpu_priority_queue_size", "gauge",
+               "Requests pending per priority class (1 = highest) in "
+               "the per-model scheduler queue", priority_queue_rows)
         family("tpu_batch_pending_depth", "gauge",
                "Requests waiting in the dynamic batcher's bucket queues",
                pending_rows)
@@ -754,6 +989,16 @@ class InferenceServerCore:
                         getattr(model, "timeout_action", "REJECT")),
                     reject_hook=stats.record_rejected,
                     timeout_hook=stats.record_timeout,
+                    priority_levels=int(
+                        getattr(model, "priority_levels", 0)),
+                    default_priority_level=int(
+                        getattr(model, "default_priority_level", 0)),
+                    priority_policies=dict(
+                        getattr(model, "priority_queue_policies", {})
+                        or {}),
+                    shed_watermark=float(
+                        getattr(model, "shed_watermark", 0.0)),
+                    shed_hook=stats.record_shed,
                 )
                 self._batchers[model.name] = batcher
             return batcher
@@ -793,6 +1038,19 @@ class InferenceServerCore:
         self._stats_for(name).record(count, 0, 0, compute_ns, 0, ok=True,
                                      executions=executions)
 
+    def _tenant_of(self, request: pb.ModelInferRequest) -> Optional[str]:
+        """Tenant identity for quota/accounting purposes, or None when
+        nothing needs it (no quotas configured AND the request is
+        untagged — the zero-cost common case)."""
+        param = request.parameters.get("tenant")
+        tagged = param is not None and param.string_param
+        if not tagged and (self.tenant_quotas is None
+                           or not self.tenant_quotas.enabled):
+            return None
+        from client_tpu.server.qos import ANONYMOUS_TENANT
+
+        return str(param.string_param) if tagged else ANONYMOUS_TENANT
+
     def infer(self, request: pb.ModelInferRequest,
               trace_context: Optional[str] = None
               ) -> pb.ModelInferResponse:
@@ -801,21 +1059,30 @@ class InferenceServerCore:
         # direct core caller may legitimately share one request object
         # across threads (the bench's closed loops do) and an in-place
         # mint would race.
-        # acquire = READY check + in-flight increment in one atomic
-        # step: a graceful unload drains exactly the requests admitted
-        # before it flipped the state (repository.begin_unload).
-        model = self.repository.acquire(request.model_name,
-                                        request.model_version)
-        try:
-            return self._infer_admitted(model, request, trace_context)
-        except InferenceServerException as e:
-            # Stamped error log: the line joins a client-side failure
-            # to its trace/statistics by request id.
-            _LOG.debug("request %s for model '%s' failed: %s",
-                       request.id, model.name, e)
-            raise
-        finally:
-            self.repository.release(model.name)
+        # Tenant quota admission runs FIRST — before the model is
+        # acquired — so an over-quota tenant cannot even hold an
+        # in-flight slot during a drain.
+        with _TenantAdmission(self, request) as admission:
+            # acquire = READY check + in-flight increment in one atomic
+            # step: a graceful unload drains exactly the requests
+            # admitted before it flipped the state
+            # (repository.begin_unload).
+            model = self.repository.acquire(request.model_name,
+                                            request.model_version)
+            admission.model_name = model.name
+            try:
+                response = self._infer_admitted(model, request,
+                                                trace_context)
+                admission.ok = True
+                return response
+            except InferenceServerException as e:
+                # Stamped error log: the line joins a client-side
+                # failure to its trace/statistics by request id.
+                _LOG.debug("request %s for model '%s' failed: %s",
+                           request.id, model.name, e)
+                raise
+            finally:
+                self.repository.release(model.name)
 
     def _infer_admitted(self, model: ServedModel,
                         request: pb.ModelInferRequest,
@@ -870,6 +1137,20 @@ class InferenceServerCore:
                 return self._infer_executed(model, request, stats, trace,
                                             t0_ns=mark)
             return self._infer_executed(model, request, stats, trace)
+        # Priority is coerced BEFORE the cache probe on QoS models so
+        # (a) an out-of-range value fails INVALID_ARGUMENT even when
+        # the answer is cached — caching must not change validation
+        # semantics — and (b) a new flight carries its leader's class.
+        req_priority = 0
+        levels = int(getattr(model, "priority_levels", 0))
+        if levels > 0:
+            from client_tpu.server.qos import coerce_priority
+
+            value = (_param_value(request.parameters["priority"])
+                     if "priority" in request.parameters else None)
+            req_priority = coerce_priority(
+                value, levels,
+                int(getattr(model, "default_priority_level", 0)))
         t_cache = time.monotonic_ns()
         # Single-flight: the first miss for a key leads and executes;
         # concurrent identical misses follow — they are served the
@@ -878,10 +1159,11 @@ class InferenceServerCore:
         # The probe is one atomic step (entry, live flight, or new
         # leadership) so a leader resolving between a lookup and a
         # begin cannot hand a late thread a redundant execution.
-        cached, flight, leader = cache.lookup_or_begin(key)
+        cached, flight, leader = cache.lookup_or_begin(key, req_priority)
         if cached is not None:
             response = self._finish_cache_hit(model, request, stats,
-                                              cached, t_cache)
+                                              cached, t_cache,
+                                              priority=req_priority)
             if trace is not None:
                 # The lookup span covers probe AND serve (parse +
                 # id stamp) so a hit's trace tiles from root start.
@@ -889,16 +1171,32 @@ class InferenceServerCore:
                                 trace.root.start_ns,
                                 time.monotonic_ns(), {"outcome": "hit"})
             return response
+        # A strictly higher class must not coalesce behind a
+        # lower-class leader: the follower would inherit the leader's
+        # position at the back of the lowest-priority queue — exactly
+        # the saturation condition where priority dispatch is supposed
+        # to let it overtake. It executes independently instead (the
+        # priority queues fuse it into the next execution); the leader
+        # keeps flight ownership, insert, and follower wake-up.
+        overtake = (not leader and flight is not None and req_priority
+                    and flight.priority and req_priority < flight.priority)
         mark = 0
         if trace is not None:
             mark = time.monotonic_ns()
+            outcome = ("miss" if leader
+                       else "priority_bypass" if overtake else "follower")
             trace.add_timed(spantrace.SPAN_CACHE_LOOKUP,
                             trace.root.start_ns, mark,
-                            {"outcome": "miss" if leader else "follower"})
+                            {"outcome": outcome})
+        if overtake:
+            return self._infer_executed(
+                model, request, stats, trace,
+                t0_ns=mark if trace is not None else None)
         if not leader:
             try:
                 response = self._await_flight(model, request, stats, cache,
-                                              flight, t_cache)
+                                              flight, t_cache,
+                                              priority=req_priority)
             except Exception:
                 if trace is not None:
                     trace.add_timed(spantrace.SPAN_CACHE_WAIT, mark,
@@ -942,24 +1240,28 @@ class InferenceServerCore:
 
     def _finish_cache_hit(self, model: ServedModel,
                           request: pb.ModelInferRequest, stats: _ModelStats,
-                          cached: bytes, t_cache: int
+                          cached: bytes, t_cache: int, priority: int = 0
                           ) -> pb.ModelInferResponse:
         """Serves a stored response: parse the cached bytes, stamp the
         requester's id, count an inference (never an execution), keep
         queue/compute sections untouched (hits bypass them — the perf
-        caveat)."""
+        caveat). ``priority`` labels the success in priority_stats —
+        a hit served to a QoS class still counts toward that class's
+        goodput."""
         response = pb.ModelInferResponse()
         response.ParseFromString(cached)
         response.id = request.id
         ns = time.monotonic_ns() - t_cache
         stats.record_cache_hit(ns)
         stats.record(self._batch_size(model, request), 0, 0, 0, 0,
-                     ok=True, executions=0, total_ns=ns)
+                     ok=True, executions=0, total_ns=ns,
+                     priority=priority)
         return response
 
     def _await_flight(self, model: ServedModel,
                       request: pb.ModelInferRequest, stats: _ModelStats,
-                      cache: ResponseCache, flight, t_cache: int
+                      cache: ResponseCache, flight, t_cache: int,
+                      priority: int = 0
                       ) -> Optional[pb.ModelInferResponse]:
         """Follower side of single-flight: wait for the leader's
         response, bounded by this request's own queue deadline (PR-2
@@ -988,7 +1290,7 @@ class InferenceServerCore:
             timeout_us = 0  # DELAY: deadline is advisory, never fatal
         if not flight.event.wait(
                 timeout_us / 1e6 if timeout_us > 0 else None):
-            stats.record_timeout()
+            stats.record_timeout(priority)
             stats.record(1, 0, 0, 0,
                          time.monotonic_ns() - t_cache, ok=False)
             raise InferenceServerException(
@@ -1005,7 +1307,8 @@ class InferenceServerCore:
         ns = time.monotonic_ns() - t_cache
         stats.record_cache_hit(ns)
         stats.record(self._batch_size(model, request), 0, 0, 0, 0,
-                     ok=True, executions=0, total_ns=ns)
+                     ok=True, executions=0, total_ns=ns,
+                     priority=priority)
         return response
 
     def _infer_executed(self, model: ServedModel,
@@ -1021,11 +1324,22 @@ class InferenceServerCore:
         t0 = t0_ns if t0_ns is not None else time.monotonic_ns()
         queue_ns = 0
         executions = 1
+        priority = 0
         try:
             chaos.inject(model.name, scope=self.chaos_scope)
             # fault injection (no-op unless configured); drops/errors
             # ride the normal failure path
             inputs, params = self._decode_inputs(model, request)
+            if getattr(model, "priority_levels", 0) > 0:
+                # Same coercion/validation the batcher applies — done
+                # here too so the success stats can be labeled per
+                # class and an out-of-range priority fails before any
+                # queueing (INVALID_ARGUMENT, never a silent drop).
+                from client_tpu.server.qos import coerce_priority
+
+                priority = coerce_priority(
+                    params.get("priority"), model.priority_levels,
+                    int(getattr(model, "default_priority_level", 0)))
             t1 = time.monotonic_ns()
             if trace is not None:
                 # Spans tile the t0..t3 timeline exactly (decode =
@@ -1051,7 +1365,8 @@ class InferenceServerCore:
                 batch = self._batch_size(model, request)
                 outputs, queue_ns, leader = batcher.infer(
                     inputs, params, batch, trace=trace,
-                    queue_from_ns=t1 if trace is not None else 0)
+                    queue_from_ns=t1 if trace is not None else 0,
+                    priority=priority if priority else None)
                 # Fused requests share one model execution; only its
                 # leader bumps execution_count (Triton semantics).
                 executions = 1 if leader else 0
@@ -1091,7 +1406,8 @@ class InferenceServerCore:
             )
         batch = self._batch_size(model, request)
         stats.record(batch, queue_ns, t1 - t0, (t2 - t1) - queue_ns,
-                     t3 - t2, ok=True, executions=executions)
+                     t3 - t2, ok=True, executions=executions,
+                     priority=priority)
         if trace is not None:
             trace.timeline = (t0, t1, t1 + queue_ns, t2, t3)
         return response
@@ -1142,7 +1458,7 @@ class InferenceServerCore:
         t0 = time.monotonic_ns()
         if not model.decoupled:
             response = self.infer(request, trace_context)
-            # admission handled there
+            # admission handled there (tenant quotas included)
             stream_response = pb.ModelStreamInferResponse()
             stream_response.infer_response.CopyFrom(response)
             stream_response.infer_response.parameters[
@@ -1150,19 +1466,39 @@ class InferenceServerCore:
             ].bool_param = True
             yield stream_response
             return
-        # Decoupled: the whole stream holds one in-flight admission so
-        # a graceful unload drains it before teardown.
-        model = self.repository.acquire(request.model_name,
-                                        request.model_version)
-        trace = self._trace_begin(model.name, trace_context, request.id)
-        try:
-            yield from self._stream_admitted(model, request, stats, t0,
-                                             want_empty_final, trace)
-        finally:
-            if trace is not None:
-                trace.finish()
-                self._trace_emit(model.name, request.id, trace)
-            self.repository.release(model.name)
+        # Decoupled: tenant quotas apply here too — the whole stream
+        # spends one token and holds one in-flight slot for its
+        # duration, so the streaming RPC cannot bypass admission. A
+        # quota reject raises; the transports surface it as an
+        # in-stream error.
+        with _TenantAdmission(self, request) as admission:
+            # model came from repository.get above, so the name is
+            # validated — per-model tenant rows are recorded even when
+            # the in-flight acquire below fails (drain in progress).
+            admission.model_name = model.name
+            trace = None
+            acquired = False
+            # The whole stream holds one in-flight admission so a
+            # graceful unload drains it before teardown. Everything
+            # past the quota acquire runs inside the admission scope so
+            # an acquire/trace failure (model draining, bad version)
+            # still returns the tenant's token and in-flight slot.
+            try:
+                model = self.repository.acquire(request.model_name,
+                                                request.model_version)
+                acquired = True
+                trace = self._trace_begin(model.name, trace_context,
+                                          request.id)
+                yield from self._stream_admitted(model, request, stats,
+                                                 t0, want_empty_final,
+                                                 trace)
+                admission.ok = True
+            finally:
+                if trace is not None:
+                    trace.finish()
+                    self._trace_emit(model.name, request.id, trace)
+                if acquired:
+                    self.repository.release(model.name)
 
     def _stream_admitted(self, model, request, stats, t0,
                          want_empty_final, trace=None):

@@ -48,15 +48,36 @@ def _trace_context(context) -> Optional[str]:
 
 def _abort(context, error: InferenceServerException):
     code = _STATUS_MAP.get(error.status() or "", grpc.StatusCode.INTERNAL)
-    if code == grpc.StatusCode.UNAVAILABLE:
+    if code in (grpc.StatusCode.UNAVAILABLE,
+                grpc.StatusCode.RESOURCE_EXHAUSTED):
         # The gRPC twin of the HTTP Retry-After header: a trailing
         # metadata hint that well-behaved clients (RetryPolicy) use as
         # their minimum backoff before retrying a shed request.
+        # Quota rejects (RESOURCE_EXHAUSTED) carry the token-bucket
+        # refill time; queue rejects carry the server's estimate.
+        retry_after = getattr(error, "retry_after_s", None)
         try:
-            context.set_trailing_metadata((("retry-after", "1"),))
+            context.set_trailing_metadata((
+                ("retry-after",
+                 "%.3f" % retry_after if retry_after else "1"),))
         except Exception:  # noqa: BLE001 — the abort must still fire
             pass
     context.abort(code, error.message())
+
+
+def _apply_tenant_metadata(request, context) -> None:
+    """Maps a `tenant` invocation-metadata key onto the request's
+    `tenant` parameter (the transport-neutral identity quotas key on);
+    an in-request parameter wins over metadata."""
+    if "tenant" in request.parameters:
+        return
+    try:
+        for key, value in context.invocation_metadata() or ():
+            if key == "tenant" and value:
+                request.parameters["tenant"].string_param = value
+                return
+    except Exception:  # noqa: BLE001 — identity is best-effort
+        pass
 
 
 class InferenceServicer(GRPCInferenceServiceServicer):
@@ -91,6 +112,7 @@ class InferenceServicer(GRPCInferenceServiceServicer):
 
     def ModelInfer(self, request, context):
         mint_request_id(request)
+        _apply_tenant_metadata(request, context)
         try:
             return self._core.infer(
                 request, trace_context=_trace_context(context))
@@ -111,6 +133,16 @@ class InferenceServicer(GRPCInferenceServiceServicer):
         # One traceparent per stream (gRPC metadata is per-call):
         # every request pipelined on this stream joins that trace.
         stream_trace_context = _trace_context(context)
+        # Likewise one tenant identity per stream: without this the
+        # streaming RPC would bypass tenant quotas entirely.
+        stream_tenant = None
+        try:
+            for key, value in context.invocation_metadata() or ():
+                if key == "tenant" and value:
+                    stream_tenant = value
+                    break
+        except Exception:  # noqa: BLE001 — identity is best-effort
+            pass
 
         # Bounded: the old sequential `yield from` backpressured
         # through HTTP/2 flow control; with threaded dispatch a
@@ -136,6 +168,8 @@ class InferenceServicer(GRPCInferenceServiceServicer):
 
         def run_one(request):
             mint_request_id(request)
+            if stream_tenant and "tenant" not in request.parameters:
+                request.parameters["tenant"].string_param = stream_tenant
             generator = self._core.stream_infer(
                 request, trace_context=stream_trace_context)
             try:

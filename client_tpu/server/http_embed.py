@@ -74,10 +74,18 @@ def _pb_reply(message) -> Reply:
 
 def _error_reply(error: InferenceServerException) -> Reply:
     status = _STATUS_HTTP.get(error.status() or "", 500)
-    # Retry-After on 503: parity with the aiohttp front-end so
-    # well-behaved clients back off from a saturated queue.
-    return _json_reply({"error": error.message()}, status,
-                       {"Retry-After": "1"} if status == 503 else None)
+    # Retry-After on 503 (queue saturation) and 429 (tenant quota):
+    # parity with the aiohttp front-end — the value is the server's
+    # refill/window estimate, rounded UP to whole seconds (RFC 9110
+    # delta-seconds is integer; third-party consumers fail a float).
+    headers = None
+    if status in (503, 429):
+        import math
+
+        retry_after = getattr(error, "retry_after_s", None)
+        headers = {"Retry-After": ("%d" % max(math.ceil(retry_after), 1))
+                   if retry_after else "1"}
+    return _json_reply({"error": error.message()}, status, headers)
 
 
 def _pick_encoding(accept_encoding: str) -> Optional[str]:
@@ -294,6 +302,11 @@ def _infer(core, m, headers, body):
     from client_tpu.server.core import mint_request_id
 
     mint_request_id(infer_request)
+    # Tenant identity: x-tenant-id maps onto the `tenant` parameter
+    # (aiohttp front-end parity); an in-body parameter wins.
+    tenant_header = headers.get("x-tenant-id")
+    if tenant_header and "tenant" not in infer_request.parameters:
+        infer_request.parameters["tenant"].string_param = tenant_header
     # header names are lower-cased by the caller (http_call contract)
     response = core.infer(infer_request,
                           trace_context=headers.get("traceparent"))

@@ -39,9 +39,21 @@ _STATUS_HTTP = {
 
 def _error_response(error: InferenceServerException) -> web.Response:
     status = _STATUS_HTTP.get(error.status() or "", 500)
-    # 503s carry Retry-After so well-behaved clients (and LBs) back
-    # off instead of hammering a saturated queue.
-    headers = {"Retry-After": "1"} if status == 503 else None
+    # 503s (queue saturation) and 429s (tenant quota) carry
+    # Retry-After so well-behaved clients (and LBs) back off instead
+    # of hammering a saturated queue. The value comes from the
+    # error's server-computed backoff when present (token-bucket
+    # refill time, gather-window estimate), else the legacy 1s —
+    # rounded UP to whole seconds: RFC 9110 delta-seconds is integer,
+    # and third-party consumers (urllib3, proxies) fail a float parse.
+    # The gRPC trailing metadata keeps sub-second precision.
+    headers = None
+    if status in (503, 429):
+        import math
+
+        retry_after = getattr(error, "retry_after_s", None)
+        headers = {"Retry-After": ("%d" % max(math.ceil(retry_after), 1))
+                   if retry_after else "1"}
     return web.json_response(
         {"error": error.message()}, status=status, headers=headers,
     )
@@ -289,6 +301,14 @@ def build_http_app(core: InferenceServerCore) -> web.Application:
 
     # -- generate (LLM extension) ---------------------------------------
 
+    def _apply_tenant_header(request, infer_request) -> None:
+        """x-tenant-id -> `tenant` parameter (an in-body parameter
+        wins), so the generate/OpenAI routes carry quota identity like
+        the /infer route."""
+        tenant_header = request.headers.get("x-tenant-id")
+        if tenant_header and "tenant" not in infer_request.parameters:
+            infer_request.parameters["tenant"].string_param = tenant_header
+
     def _generate_request(request, body: bytes):
         """JSON body fields -> ModelInferRequest tensors by input name
         (shared codec: http_wire.build_generate_request)."""
@@ -296,9 +316,11 @@ def build_http_app(core: InferenceServerCore) -> web.Application:
 
         model_name = request.match_info["model"]
         model = core.repository.get(model_name)
-        return build_generate_request(
+        infer_request = build_generate_request(
             model.inputs, model_name,
             request.match_info.get("version", ""), body)
+        _apply_tenant_header(request, infer_request)
+        return infer_request
 
     def _generate_json(response: pb.ModelInferResponse) -> dict:
         from client_tpu.protocol.http_wire import generate_response_json
@@ -432,6 +454,7 @@ def build_http_app(core: InferenceServerCore) -> web.Application:
                 if message.get("role") == "user":
                     prompt = message.get("content") or ""
             infer_request = _openai_request(doc, prompt)
+            _apply_tenant_header(request, infer_request)
         except InferenceServerException as e:
             return _error_response(e)
         except Exception as e:
@@ -465,6 +488,7 @@ def build_http_app(core: InferenceServerCore) -> web.Application:
             if isinstance(prompt, list):
                 prompt = prompt[0] if prompt else ""
             infer_request = _openai_request(doc, prompt)
+            _apply_tenant_header(request, infer_request)
         except InferenceServerException as e:
             return _error_response(e)
         except Exception as e:
@@ -579,6 +603,7 @@ def build_http_app(core: InferenceServerCore) -> web.Application:
             from client_tpu.server.core import mint_request_id
 
             mint_request_id(infer_request)
+            _apply_tenant_header(request, infer_request)
             # W3C trace-context propagation: a caller-supplied
             # traceparent joins the server span tree to the client's.
             response = await _run(core.infer, infer_request,

@@ -79,6 +79,20 @@ class ServedModel:
     default_queue_policy_timeout_us: int = 0
     allow_timeout_override: bool = True
     timeout_action: str = "REJECT"
+    # Multi-tenant QoS (client_tpu.server.qos + batcher priority
+    # queues). priority_levels declares classes 1..N (1 highest;
+    # requests pick theirs via the `priority` parameter — accepted
+    # range 0..N, 0 = default_priority_level, out-of-range rejected
+    # INVALID_ARGUMENT). default_priority_level 0 means the middle
+    # level. priority_queue_policies maps a level to optional
+    # {"max_queue_size", "default_timeout_us"} overrides (Triton's
+    # per-priority ModelQueuePolicy). shed_watermark is the queue-
+    # depth fraction of max_queue_size past which lowest-class
+    # arrivals are shed (0 = displacement-only shedding).
+    priority_levels: int = 0
+    default_priority_level: int = 0
+    priority_queue_policies: dict = {}
+    shed_watermark: float = 0.0
     # Sequence batching (client_tpu.server.sequence): correlated
     # request streams are scheduled onto per-sequence slots. strategy
     # "direct" pins a slot per sequence and executes steps singly;
@@ -195,6 +209,20 @@ class ServedModel:
             config.dynamic_batching.allow_timeout_override = (
                 self.allow_timeout_override)
             config.dynamic_batching.timeout_action = self.timeout_action
+            # Accepted `priority` parameter range once rendered:
+            # 0..priority_levels (0 = default_priority_level; 1 is the
+            # highest class). Out-of-range is INVALID_ARGUMENT.
+            config.dynamic_batching.priority_levels = self.priority_levels
+            config.dynamic_batching.default_priority_level = (
+                self.default_priority_level)
+            config.dynamic_batching.shed_watermark = self.shed_watermark
+            for level in sorted(self.priority_queue_policies):
+                policy = self.priority_queue_policies[level]
+                config.dynamic_batching.priority_queue_policy.add(
+                    priority_level=int(level),
+                    max_queue_size=int(policy.get("max_queue_size", 0)),
+                    default_timeout_us=int(
+                        policy.get("default_timeout_us", 0)))
         if self.sequence_batching:
             from client_tpu.server.sequence import (
                 DEFAULT_CANDIDATE_SEQUENCES,

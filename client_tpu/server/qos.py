@@ -1,0 +1,320 @@
+"""Multi-tenant quality-of-service primitives.
+
+Two independent mechanisms share this module because they are enforced
+at the same boundary (request admission) and report into the same
+observability surfaces:
+
+* **Priority classes** — the `priority` request parameter all four
+  clients already send (and the server silently dropped before this
+  module). :func:`coerce_priority` is the single source of truth for
+  its wire semantics: levels are ``1..priority_levels`` with 1 the
+  highest class, 0/absent falls back to the model's
+  ``default_priority_level``, string/double forms are coerced like the
+  batcher's ``timeout`` parameter, and out-of-range values are
+  rejected INVALID_ARGUMENT instead of being ignored (an ignored
+  priority is a silent QoS downgrade the sender cannot observe).
+
+* **Tenant quotas** — a token-bucket rate limiter plus a concurrency
+  cap per tenant identity, enforced by :class:`TenantQuotaManager` at
+  the front door of ``core.infer`` (before the model is even
+  acquired). Tenant identity comes from the ``tenant`` request
+  parameter; the HTTP front-end maps an ``x-tenant-id`` header and the
+  gRPC front-end a ``tenant`` metadata key onto that parameter, so all
+  transports converge on one wire form. Rejects surface as
+  RESOURCE_EXHAUSTED (HTTP 429) carrying a ``Retry-After`` derived
+  from the bucket's refill time — the PR-2 RetryPolicy sleeps at least
+  that long before retrying, which turns quota pressure into client
+  backpressure instead of a retry storm.
+
+Quotas are configured per server via a spec string
+(``--tenant-quotas`` / the CLIENT_TPU_TENANT_QUOTAS env var):
+
+    default=rate:100,burst:20,concurrency:8;bulk=rate:10,burst:5
+
+Entries are ``tenant=knob:value,...`` separated by ``;``. The
+``default`` entry is the template every unlisted tenant gets its own
+bucket from (requests without an identity share the ``anonymous``
+tenant's bucket). ``rate`` is tokens (requests) per second, ``burst``
+the bucket size (defaults to max(rate, 1)), ``concurrency`` the
+in-flight cap; 0 disables that knob for the tenant.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from client_tpu.utils import InferenceServerException
+
+ENV_VAR = "CLIENT_TPU_TENANT_QUOTAS"
+
+# Identity assigned to requests that carry no tenant parameter/header:
+# they are still governed (by the default policy) — an unlabeled flood
+# must not bypass admission just by omitting the header.
+ANONYMOUS_TENANT = "anonymous"
+
+# Tenant identity is client-supplied: a client rotating the value per
+# request must not grow server state/metric cardinality without bound.
+# Once this many DYNAMIC (not explicitly configured) tenants exist,
+# further new identities share one overflow bucket.
+MAX_TRACKED_TENANTS = 1024
+OVERFLOW_TENANT = "overflow"
+
+
+def coerce_int(value) -> int:
+    """int() that also accepts double/decimal-string wire forms (HTTP
+    clients serialize numeric params as strings or doubles). The ONE
+    numeric-param coercion — `timeout` (batcher) and `priority` (here)
+    must accept identical wire forms."""
+    try:
+        return int(value)
+    except (TypeError, ValueError):
+        return int(float(value))
+
+
+def coerce_priority(value, priority_levels: int,
+                    default_level: int = 0) -> int:
+    """Normalizes one request's ``priority`` parameter to a level in
+    ``1..priority_levels`` (1 = highest). Accepts int/str/double wire
+    forms (HTTP clients send numeric params as strings or doubles,
+    exactly the `timeout` hardening gap this PR closes for priority).
+    0/absent selects ``default_level`` (or the middle level when that
+    is 0 too). Raises INVALID_ARGUMENT for negative, over-max, or
+    non-numeric values — dropping them silently would downgrade the
+    request's service class without the sender ever knowing."""
+    if priority_levels <= 0:
+        return 0
+    if value is None:
+        level = 0
+    else:
+        try:
+            level = coerce_int(value)
+        except (TypeError, ValueError):
+            raise InferenceServerException(
+                "priority '%s' is not numeric (accepted range: "
+                "0..%d, 1 = highest, 0 = model default)"
+                % (value, priority_levels),
+                status="INVALID_ARGUMENT") from None
+    if level == 0:
+        level = default_level or (priority_levels + 1) // 2
+        return min(max(level, 1), priority_levels)
+    if level < 0 or level > priority_levels:
+        raise InferenceServerException(
+            "priority %d out of range (accepted range: 0..%d, "
+            "1 = highest, 0 = model default)" % (level, priority_levels),
+            status="INVALID_ARGUMENT")
+    return level
+
+
+class TenantPolicy:
+    """Per-tenant quota knobs. rate_per_s=0 means no rate limit,
+    concurrency=0 no in-flight cap; burst defaults to max(rate, 1)."""
+
+    __slots__ = ("rate_per_s", "burst", "concurrency")
+
+    def __init__(self, rate_per_s: float = 0.0, burst: float = 0.0,
+                 concurrency: int = 0):
+        self.rate_per_s = max(float(rate_per_s), 0.0)
+        self.burst = float(burst) if burst > 0 else max(self.rate_per_s, 1.0)
+        self.concurrency = max(int(concurrency), 0)
+
+    @property
+    def enforced(self) -> bool:
+        return self.rate_per_s > 0 or self.concurrency > 0
+
+
+class _TenantState:
+    """One tenant's bucket + counters (lock held by the manager)."""
+
+    __slots__ = ("policy", "tokens", "last_refill_s", "inflight",
+                 "admitted", "rejected", "completed", "failed",
+                 "total_ns")
+
+    def __init__(self, policy: TenantPolicy, now_s: float):
+        self.policy = policy
+        self.tokens = policy.burst
+        self.last_refill_s = now_s
+        self.inflight = 0
+        self.admitted = 0
+        self.rejected = 0
+        self.completed = 0
+        self.failed = 0
+        self.total_ns = 0
+
+
+class TenantQuotaManager:
+    """Token-bucket rate + concurrency admission control per tenant.
+
+    ``acquire`` spends one token (refilled continuously at the
+    tenant's rate, capped at burst) and one in-flight slot; a reject
+    raises RESOURCE_EXHAUSTED with ``retry_after_s`` set to the time
+    until the bucket holds a full token again — the value the
+    front-ends serialize as Retry-After / retry-after metadata.
+    ``release`` returns the slot and records latency. All state lives
+    behind one lock; the per-request work is O(1)."""
+
+    def __init__(self, policies: Optional[Dict[str, TenantPolicy]] = None,
+                 default: Optional[TenantPolicy] = None,
+                 clock=time.monotonic):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._default = default or TenantPolicy()
+        self._policies = dict(policies or {})
+        self._tenants: Dict[str, _TenantState] = {}
+
+    @property
+    def enabled(self) -> bool:
+        return self._default.enforced or any(
+            p.enforced for p in self._policies.values())
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "TenantQuotaManager":
+        """Parse ``"default=rate:100,burst:20,concurrency:8;bulk=
+        rate:10"``; unknown knobs fail loudly."""
+        policies: Dict[str, TenantPolicy] = {}
+        default = None
+        for entry in (spec or "").split(";"):
+            entry = entry.strip()
+            if not entry:
+                continue
+            tenant, sep, knobs = entry.partition("=")
+            if not sep:
+                raise ValueError(
+                    "tenant-quota entry '%s' is not tenant=knobs" % entry)
+            kwargs: Dict[str, float] = {}
+            for knob in knobs.split(","):
+                knob = knob.strip()
+                if not knob:
+                    continue
+                key, sep, value = knob.partition(":")
+                if not sep:
+                    raise ValueError(
+                        "tenant-quota knob '%s' is not key:value" % knob)
+                key = key.strip()
+                if key == "rate":
+                    kwargs["rate_per_s"] = float(value)
+                elif key == "burst":
+                    kwargs["burst"] = float(value)
+                elif key == "concurrency":
+                    kwargs["concurrency"] = int(value)
+                else:
+                    raise ValueError(
+                        "unknown tenant-quota knob '%s'" % key)
+            policy = TenantPolicy(**kwargs)
+            tenant = tenant.strip()
+            if tenant == "default":
+                default = policy
+            else:
+                policies[tenant] = policy
+        return cls(policies, default)
+
+    def _state_for(self, tenant: str, now_s: float) -> _TenantState:
+        state = self._tenants.get(tenant)
+        if state is None:
+            if tenant not in self._policies \
+                    and len(self._tenants) >= MAX_TRACKED_TENANTS:
+                # Cardinality bound: rotating client-supplied identities
+                # collapse into one shared overflow bucket (explicitly
+                # configured tenants always keep their own).
+                tenant = OVERFLOW_TENANT
+                state = self._tenants.get(tenant)
+                if state is not None:
+                    return state
+            policy = self._policies.get(tenant, self._default)
+            state = self._tenants[tenant] = _TenantState(policy, now_s)
+        return state
+
+    def _refill(self, state: _TenantState, now_s: float) -> None:
+        if state.policy.rate_per_s <= 0:
+            return
+        elapsed = now_s - state.last_refill_s
+        if elapsed > 0:
+            state.tokens = min(
+                state.tokens + elapsed * state.policy.rate_per_s,
+                state.policy.burst)
+            state.last_refill_s = now_s
+
+    def acquire(self, tenant: str) -> str:
+        """Admit one request for ``tenant`` or raise RESOURCE_EXHAUSTED
+        (HTTP 429) with ``retry_after_s`` set from the bucket refill
+        time. Returns the RESOLVED identity (== tenant, or
+        OVERFLOW_TENANT once the cardinality bound folds new dynamic
+        identities together) — callers MUST pair a successful acquire
+        with release() on that resolved name."""
+        now_s = self._clock()
+        with self._lock:
+            state = self._state_for(tenant, now_s)
+            if self._tenants.get(tenant) is not state:
+                tenant = OVERFLOW_TENANT
+            policy = state.policy
+            self._refill(state, now_s)
+            if policy.concurrency > 0 \
+                    and state.inflight >= policy.concurrency:
+                state.rejected += 1
+                retry_after = self._retry_after_locked(state)
+                raise self._reject(tenant, "concurrency limit %d"
+                                   % policy.concurrency, retry_after)
+            if policy.rate_per_s > 0:
+                if state.tokens < 1.0:
+                    state.rejected += 1
+                    retry_after = (1.0 - state.tokens) / policy.rate_per_s
+                    raise self._reject(
+                        tenant, "rate limit %g req/s" % policy.rate_per_s,
+                        retry_after)
+                state.tokens -= 1.0
+            state.inflight += 1
+            state.admitted += 1
+            return tenant
+
+    @staticmethod
+    def _retry_after_locked(state: _TenantState) -> float:
+        # Concurrency rejects have no refill clock; advise one mean
+        # service time's worth of backoff from the observed latency,
+        # floored at 50 ms so an all-zero history still backs off.
+        if state.completed > 0:
+            return max(state.total_ns / state.completed / 1e9, 0.05)
+        return 0.05
+
+    @staticmethod
+    def _reject(tenant: str, reason: str,
+                retry_after_s: float) -> InferenceServerException:
+        error = InferenceServerException(
+            "tenant '%s' over quota (%s); retry after %.3fs"
+            % (tenant, reason, retry_after_s),
+            status="RESOURCE_EXHAUSTED")
+        # Serialized as the HTTP Retry-After header / gRPC retry-after
+        # trailing metadata; RetryPolicy sleeps at least this long.
+        error.retry_after_s = max(retry_after_s, 0.001)
+        return error
+
+    def release(self, tenant: str, ok: bool, duration_ns: int) -> None:
+        with self._lock:
+            state = self._tenants.get(tenant)
+            if state is None:  # release without acquire: stats only
+                return
+            if state.inflight > 0:
+                state.inflight -= 1
+            if ok:
+                state.completed += 1
+                state.total_ns += max(int(duration_ns), 0)
+            else:
+                state.failed += 1
+
+    def snapshot(self) -> Dict[str, dict]:
+        """Per-tenant counters + gauges for /metrics and statistics."""
+        now_s = self._clock()
+        out: Dict[str, dict] = {}
+        with self._lock:
+            for tenant, state in self._tenants.items():
+                self._refill(state, now_s)
+                out[tenant] = {
+                    "admitted": state.admitted,
+                    "rejected": state.rejected,
+                    "completed": state.completed,
+                    "failed": state.failed,
+                    "total_ns": state.total_ns,
+                    "inflight": state.inflight,
+                    "tokens": state.tokens,
+                }
+        return out

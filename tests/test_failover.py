@@ -397,7 +397,10 @@ def test_grpc_server_sends_retry_after_on_unavailable():
             model.gate.set()
             for thread in threads:
                 thread.join(timeout=10)
-        assert saw == 1.0, "UNAVAILABLE must carry the retry-after hint"
+        # delta-seconds; since the QoS PR the value is the server's
+        # gather-window estimate rather than a flat 1s
+        assert saw is not None and saw > 0, \
+            "UNAVAILABLE must carry the retry-after hint"
     finally:
         handle.stop()
 

@@ -1,0 +1,1145 @@
+"""Multi-tenant QoS tests: priority coercion/validation, per-priority
+queues and strict-then-weighted dispatch in the dynamic batcher,
+graceful load shedding (displacement at a full queue + watermark),
+tenant token-bucket quotas with Retry-After, QoS observability
+(ModelStatistics rows, Prometheus families, span attributes), the
+priority-param round trip over HTTP + gRPC sync + aio, and the
+overload chaos scenario."""
+
+import asyncio
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from client_tpu.server.batcher import DynamicBatcher, _params_fingerprint
+from client_tpu.server.model import ServedModel, TensorSpec
+from client_tpu.server.qos import (
+    ANONYMOUS_TENANT,
+    TenantPolicy,
+    TenantQuotaManager,
+    coerce_priority,
+)
+from client_tpu.utils import InferenceServerException
+
+
+# -- priority coercion (the silent-drop fix) ------------------------------
+
+
+def test_coerce_priority_accepts_wire_forms():
+    assert coerce_priority(1, 3) == 1
+    assert coerce_priority("2", 3) == 2
+    assert coerce_priority(3.0, 3) == 3
+    assert coerce_priority("2.0", 3) == 2
+
+
+def test_coerce_priority_default_level():
+    # absent/0 -> default_priority_level, or the middle level when
+    # that is 0 too
+    assert coerce_priority(None, 4, default_level=2) == 2
+    assert coerce_priority(0, 4, default_level=1) == 1
+    assert coerce_priority(None, 4) == 2  # (4 + 1) // 2
+    assert coerce_priority(None, 5) == 3
+    # disabled levels: always class 0
+    assert coerce_priority(7, 0) == 0
+
+
+@pytest.mark.parametrize("bad", [-1, 5, "9", "nope", object()])
+def test_coerce_priority_rejects_invalid(bad):
+    with pytest.raises(InferenceServerException) as excinfo:
+        coerce_priority(bad, 4)
+    assert excinfo.value.status() == "INVALID_ARGUMENT"
+    assert "0..4" in str(excinfo.value)  # documented accepted range
+
+
+def test_qos_params_excluded_from_fusion_fingerprint():
+    base = _params_fingerprint({"custom": 1})
+    assert _params_fingerprint(
+        {"custom": 1, "priority": 1, "tenant": "a", "timeout": 5}) == base
+    # non-QoS params still fragment
+    assert _params_fingerprint({"custom": 2}) != base
+
+
+# -- tenant quotas --------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+def test_tenant_quota_spec_parsing():
+    manager = TenantQuotaManager.from_spec(
+        "default=rate:100,burst:20,concurrency:8;bulk=rate:10")
+    assert manager.enabled
+    assert manager._default.rate_per_s == 100
+    assert manager._default.burst == 20
+    assert manager._default.concurrency == 8
+    assert manager._policies["bulk"].rate_per_s == 10
+    assert manager._policies["bulk"].burst == 10  # defaults to rate
+    with pytest.raises(ValueError):
+        TenantQuotaManager.from_spec("oops")
+    with pytest.raises(ValueError):
+        TenantQuotaManager.from_spec("a=frobnicate:1")
+
+
+def test_token_bucket_rate_and_refill():
+    clock = FakeClock()
+    manager = TenantQuotaManager(
+        default=TenantPolicy(rate_per_s=10, burst=2), clock=clock)
+    manager.acquire("t")
+    manager.acquire("t")  # burst exhausted
+    with pytest.raises(InferenceServerException) as excinfo:
+        manager.acquire("t")
+    error = excinfo.value
+    assert error.status() == "RESOURCE_EXHAUSTED"
+    # refill time for one token at 10/s = 100ms
+    assert error.retry_after_s == pytest.approx(0.1, abs=0.02)
+    clock.now += 0.11  # wait out the advised backoff
+    manager.acquire("t")  # token refilled
+    snap = manager.snapshot()["t"]
+    assert snap["admitted"] == 3
+    assert snap["rejected"] == 1
+    assert snap["inflight"] == 3
+
+
+def test_concurrency_cap_and_release():
+    manager = TenantQuotaManager(
+        default=TenantPolicy(concurrency=2))
+    manager.acquire("t")
+    manager.acquire("t")
+    with pytest.raises(InferenceServerException) as excinfo:
+        manager.acquire("t")
+    assert excinfo.value.status() == "RESOURCE_EXHAUSTED"
+    assert excinfo.value.retry_after_s > 0
+    manager.release("t", ok=True, duration_ns=5_000_000)
+    manager.acquire("t")  # slot freed
+    snap = manager.snapshot()["t"]
+    assert snap["completed"] == 1
+    assert snap["total_ns"] == 5_000_000
+
+
+def test_quota_rejects_are_retryable_with_server_pacing():
+    from client_tpu.robust import RetryPolicy, retry_after_of
+
+    policy = RetryPolicy()
+    error = InferenceServerException("over quota",
+                                     status="RESOURCE_EXHAUSTED")
+    error.retry_after_s = 0.25
+    assert policy.is_retryable(error)
+    assert policy.is_retryable(InferenceServerException("x", status="429"))
+    assert retry_after_of(error) == 0.25
+
+
+# -- batcher priority scheduling ------------------------------------------
+
+
+class GatedModel(ServedModel):
+    """Execution blocks on a gate; records executed values in order so
+    dispatch order is observable."""
+
+    max_batch_size = 8
+    dynamic_batching = True
+
+    def __init__(self, name="qos_gated"):
+        super().__init__()
+        self.name = name
+        self.inputs = [TensorSpec("IN", "FP32", [4])]
+        self.outputs = [TensorSpec("OUT", "FP32", [4])]
+        self.executions = []
+        self.gate = threading.Event()
+
+    def infer(self, inputs, parameters=None):
+        self.gate.wait()
+        array = np.asarray(inputs["IN"])
+        self.executions.append([float(v) for v in array[:, 0]])
+        return {"OUT": array * 2.0}
+
+
+def _submit(batcher, i, params=None, results=None):
+    def run():
+        try:
+            out, _, _ = batcher.infer(
+                {"IN": np.full((1, 4), float(i), np.float32)},
+                dict(params or {}), 1)
+            results[i] = ("ok", float(out["OUT"][0, 0]))
+        except InferenceServerException as e:
+            results[i] = (e.status(), str(e))
+
+    thread = threading.Thread(target=run, daemon=True)
+    thread.start()
+    return thread
+
+
+def _wait_for(predicate, timeout_s=5.0):
+    deadline = time.monotonic() + timeout_s
+    while not predicate() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert predicate()
+
+
+def test_priority_one_overtakes_bulk_backlog():
+    """A priority-1 request enqueued BEHIND a bulk backlog dispatches
+    in the very next execution (dispatch singles: preferred size 1)."""
+    model = GatedModel()
+    batcher = DynamicBatcher(model, max_queue_delay_us=1000,
+                             preferred_batch_sizes=[1], pipeline_depth=1,
+                             priority_levels=2, default_priority_level=2)
+    results = {}
+    threads = [_submit(batcher, 0, results=results)]
+    time.sleep(0.15)  # request 0 dispatched, holds the gate
+    threads += [_submit(batcher, i, params={"priority": 2},
+                        results=results) for i in (1, 2, 3)]
+    time.sleep(0.1)  # bulk backlog queued
+    threads += [_submit(batcher, 9, params={"priority": 1},
+                        results=results)]
+    time.sleep(0.1)
+    model.gate.set()
+    for thread in threads:
+        thread.join(timeout=10)
+    batcher.stop()
+    assert all(r[0] == "ok" for r in results.values())
+    order = [v for execution in model.executions for v in execution]
+    # 0 was in flight; 9 (priority 1) must beat every queued bulk
+    assert order.index(9.0) < min(order.index(v) for v in (1.0, 2.0, 3.0))
+
+
+def test_mixed_priority_requests_fuse_into_one_execution():
+    model = GatedModel()
+    batcher = DynamicBatcher(model, max_queue_delay_us=300_000,
+                             preferred_batch_sizes=[8],
+                             priority_levels=2, default_priority_level=2)
+    results = {}
+    threads = [
+        _submit(batcher, i, params={"priority": 1 + i % 2},
+                results=results)
+        for i in range(4)
+    ]
+    _wait_for(lambda: batcher.stats_snapshot()["pending_count"] == 4)
+    model.gate.set()
+    for thread in threads:
+        thread.join(timeout=10)
+    batcher.stop()
+    assert all(results[i][0] == "ok" for i in range(4))
+    assert len(model.executions) == 1  # one fused execution
+    # within the fused batch, priority-1 members seated first
+    first = model.executions[0]
+    assert set(first) == {0.0, 1.0, 2.0, 3.0}
+    p1 = {i for i in range(4) if 1 + i % 2 == 1}
+    assert {first.index(float(i)) for i in p1} == {0, 1}
+
+
+def test_full_queue_displaces_newest_bulk_for_priority_one():
+    model = GatedModel()
+    sheds = []
+    batcher = DynamicBatcher(model, max_queue_delay_us=200_000,
+                             preferred_batch_sizes=[1], pipeline_depth=1,
+                             max_queue_size=2,
+                             priority_levels=2, default_priority_level=2,
+                             shed_hook=lambda p: sheds.append(p))
+    results = {}
+    threads = [_submit(batcher, 0, params={"priority": 2},
+                       results=results)]
+    time.sleep(0.15)  # 0 in flight
+    threads += [_submit(batcher, i, params={"priority": 2},
+                        results=results) for i in (1, 2)]
+    _wait_for(lambda: batcher.stats_snapshot()["pending_count"] == 2)
+    # queue full of bulk: the priority-1 arrival displaces the NEWEST
+    # bulk waiter (2) instead of being rejected
+    threads += [_submit(batcher, 9, params={"priority": 1},
+                        results=results)]
+    _wait_for(lambda: 2 in results)
+    assert results[2][0] == "UNAVAILABLE"
+    assert "shed" in results[2][1]
+    model.gate.set()
+    for thread in threads:
+        thread.join(timeout=10)
+    batcher.stop()
+    assert results[9][0] == "ok"
+    assert results[1][0] == "ok"
+    assert sheds == [2]  # the displaced request's class
+
+
+def test_full_queue_rejects_same_class_without_displacement():
+    model = GatedModel()
+    rejects = []
+    batcher = DynamicBatcher(model, max_queue_delay_us=200_000,
+                             preferred_batch_sizes=[1], pipeline_depth=1,
+                             max_queue_size=2,
+                             priority_levels=2, default_priority_level=2,
+                             reject_hook=lambda p: rejects.append(p))
+    results = {}
+    threads = [_submit(batcher, 0, results=results)]
+    time.sleep(0.15)
+    threads += [_submit(batcher, i, results=results) for i in (1, 2)]
+    _wait_for(lambda: batcher.stats_snapshot()["pending_count"] == 2)
+    threads += [_submit(batcher, 3, results=results)]  # same class
+    _wait_for(lambda: 3 in results)
+    assert results[3][0] == "UNAVAILABLE"
+    model.gate.set()
+    for thread in threads:
+        thread.join(timeout=10)
+    batcher.stop()
+    assert rejects == [2]  # default class
+
+
+def test_watermark_sheds_lowest_class_arrivals():
+    model = GatedModel()
+    sheds = []
+    batcher = DynamicBatcher(model, max_queue_delay_us=200_000,
+                             preferred_batch_sizes=[1], pipeline_depth=1,
+                             max_queue_size=4, shed_watermark=0.5,
+                             priority_levels=2, default_priority_level=2,
+                             shed_hook=lambda p: sheds.append(p))
+    results = {}
+    threads = [_submit(batcher, 0, params={"priority": 2},
+                       results=results)]
+    time.sleep(0.15)
+    threads += [_submit(batcher, i, params={"priority": 2},
+                        results=results) for i in (1, 2)]
+    _wait_for(lambda: batcher.stats_snapshot()["pending_count"] == 2)
+    # depth 2 >= 0.5 * 4: lowest-class arrivals shed with Retry-After,
+    # priority-1 arrivals still admitted
+    threads += [_submit(batcher, 3, params={"priority": 2},
+                        results=results)]
+    _wait_for(lambda: 3 in results)
+    assert results[3][0] == "UNAVAILABLE"
+    assert "watermark" in results[3][1]
+    threads += [_submit(batcher, 9, params={"priority": 1},
+                        results=results)]
+    time.sleep(0.1)
+    model.gate.set()
+    for thread in threads:
+        thread.join(timeout=10)
+    batcher.stop()
+    assert results[9][0] == "ok"
+    assert sheds == [2]
+
+
+def test_per_priority_queue_policy_caps_and_timeouts():
+    model = GatedModel()
+    batcher = DynamicBatcher(
+        model, max_queue_delay_us=500_000, preferred_batch_sizes=[1],
+        pipeline_depth=1, priority_levels=2, default_priority_level=2,
+        priority_policies={2: {"max_queue_size": 1,
+                               "default_timeout_us": 80_000}})
+    results = {}
+    threads = [_submit(batcher, 0, params={"priority": 1},
+                       results=results)]
+    time.sleep(0.15)  # 0 in flight
+    threads += [_submit(batcher, 1, params={"priority": 2},
+                        results=results)]
+    _wait_for(lambda: batcher.stats_snapshot()["pending_count"] == 1)
+    # class-2 queue is capped at 1: a second bulk waiter is rejected
+    # even though the global queue is unbounded
+    threads += [_submit(batcher, 2, params={"priority": 2},
+                        results=results)]
+    _wait_for(lambda: 2 in results)
+    assert results[2][0] == "UNAVAILABLE"
+    assert "per-priority" in results[2][1]
+    # and the queued class-2 request expires on ITS class default
+    _wait_for(lambda: 1 in results)
+    assert results[1][0] == "DEADLINE_EXCEEDED"
+    model.gate.set()
+    threads[0].join(timeout=10)
+    batcher.stop()
+
+
+def test_aged_oldest_slot_prevents_bulk_starvation():
+    """Every AGE_EVERY dispatches the globally-oldest request is
+    seated first, so sustained priority-1 load cannot starve bulk
+    forever (the weighted arm of strict-then-weighted dispatch)."""
+    from client_tpu.server.batcher import _Bucket, _Pending
+
+    bucket = _Bucket()
+    bulk = _Pending({}, {}, 1, "k", priority=2)
+    bucket.append(bulk)
+    time.sleep(0.002)
+    for _ in range(3):
+        bucket.append(_Pending({}, {}, 1, "k", priority=1))
+    taken = bucket.take(max_batch=1, full_at=1, age_oldest=True)
+    assert taken == [bulk]  # oldest wins the aged slot despite class
+    taken = bucket.take(max_batch=1, full_at=1, age_oldest=False)
+    assert taken[0].priority == 1
+
+
+# -- config render + parser round trip ------------------------------------
+
+
+class QosConfigModel(GatedModel):
+    priority_levels = 3
+    default_priority_level = 2
+    shed_watermark = 0.75
+    priority_queue_policies = {
+        1: {"max_queue_size": 8},
+        3: {"default_timeout_us": 50_000},
+    }
+
+
+def test_config_pb_renders_priority_schema():
+    config = QosConfigModel().config_pb()
+    batching = config.dynamic_batching
+    assert batching.priority_levels == 3
+    assert batching.default_priority_level == 2
+    assert batching.shed_watermark == pytest.approx(0.75)
+    rows = {r.priority_level: r for r in batching.priority_queue_policy}
+    assert rows[1].max_queue_size == 8
+    assert rows[3].default_timeout_us == 50_000
+
+
+def test_model_parser_reads_priority_schema():
+    from client_tpu.perf.model_parser import ModelParser
+
+    class Backend:
+        def model_metadata(self, name, version=""):
+            return {"name": "qos_gated", "versions": ["1"],
+                    "platform": "jax",
+                    "inputs": [{"name": "IN", "datatype": "FP32",
+                                "shape": [-1, 4]}],
+                    "outputs": [{"name": "OUT", "datatype": "FP32",
+                                 "shape": [-1, 4]}]}
+
+        def model_config(self, name, version=""):
+            from google.protobuf import json_format
+
+            return json_format.MessageToDict(
+                QosConfigModel().config_pb(),
+                preserving_proto_field_name=True)
+
+    model = ModelParser().parse(Backend(), "qos_gated")
+    assert model.priority_levels == 3
+    assert model.default_priority_level == 2
+    assert model.shed_watermark == pytest.approx(0.75)
+
+
+# -- end to end over real transports --------------------------------------
+
+
+@pytest.fixture(scope="module")
+def qos_servers():
+    from client_tpu.server.app import build_core, start_grpc_server
+    from client_tpu.server.http_server import start_http_server_thread
+    from client_tpu.server.qos import TenantQuotaManager
+
+    core = build_core(["simple_qos"], warmup=False)
+    core.tenant_quotas = TenantQuotaManager.from_spec(
+        "default=rate:10000;limited=rate:2,burst:1;"
+        "streamlim=rate:0.2,burst:1")
+    grpc_handle = start_grpc_server(core=core)
+    http_runner = start_http_server_thread(core, host="127.0.0.1", port=0)
+    yield core, grpc_handle, http_runner
+    http_runner.stop()
+    grpc_handle.stop()
+
+
+def _qos_inputs(client_mod):
+    inputs = [client_mod.InferInput("INPUT0", [1, 16], "INT32"),
+              client_mod.InferInput("INPUT1", [1, 16], "INT32")]
+    inputs[0].set_data_from_numpy(np.arange(16, dtype=np.int32)[None])
+    inputs[1].set_data_from_numpy(np.ones((1, 16), np.int32))
+    return inputs
+
+
+def _priority_counts(core, model="simple_qos"):
+    stats = core.model_statistics(model)
+    return {int(r.priority_level): int(r.success_count)
+            for r in stats.model_stats[0].priority_stats}
+
+
+def test_priority_round_trip_http_and_grpc_sync(qos_servers):
+    import client_tpu.grpc as grpcclient
+    import client_tpu.http as httpclient
+
+    core, grpc_handle, http_runner = qos_servers
+    before = _priority_counts(core)
+    with httpclient.InferenceServerClient(
+            "127.0.0.1:%d" % http_runner.port) as client:
+        client.infer("simple_qos", _qos_inputs(httpclient), priority=1)
+    with grpcclient.InferenceServerClient(grpc_handle.address) as client:
+        client.infer("simple_qos", _qos_inputs(grpcclient), priority=1)
+        # invalid priority is INVALID_ARGUMENT end to end, not ignored
+        with pytest.raises(InferenceServerException) as excinfo:
+            client.infer("simple_qos", _qos_inputs(grpcclient),
+                         priority=9)
+        assert excinfo.value.status() == "INVALID_ARGUMENT"
+    after = _priority_counts(core)
+    assert after.get(1, 0) - before.get(1, 0) == 2
+
+
+def test_priority_round_trip_aio(qos_servers):
+    import client_tpu.grpc.aio as grpcclient_aio
+    import client_tpu.http.aio as httpclient_aio
+
+    core, grpc_handle, http_runner = qos_servers
+    before = _priority_counts(core)
+
+    async def run():
+        async with grpcclient_aio.InferenceServerClient(
+                grpc_handle.address) as client:
+            await client.infer("simple_qos", _qos_inputs(grpcclient_aio),
+                               priority=1)
+        async with httpclient_aio.InferenceServerClient(
+                "127.0.0.1:%d" % http_runner.port) as client:
+            await client.infer("simple_qos", _qos_inputs(httpclient_aio),
+                               priority=1)
+
+    asyncio.run(run())
+    after = _priority_counts(core)
+    assert after.get(1, 0) - before.get(1, 0) == 2
+
+
+def test_priority_one_overtakes_full_bulk_backlog_e2e(qos_servers):
+    """The satellite's e2e shape over BOTH transports: a gated model
+    builds a bulk backlog, a priority-1 request sent last executes
+    first once the gate opens."""
+    import client_tpu.grpc as grpcclient
+    import client_tpu.http as httpclient
+
+    core, grpc_handle, http_runner = qos_servers
+
+    for transport in ("http", "grpc"):
+        model = GatedModel(name="qos_gated_%s" % transport)
+        model.preferred_batch_sizes = [1]
+        model.pipeline_depth = 1
+        model.priority_levels = 2
+        model.default_priority_level = 2
+        core.repository.add_model(model)
+
+        if transport == "http":
+            client = httpclient.InferenceServerClient(
+                "127.0.0.1:%d" % http_runner.port, concurrency=8)
+            mod = httpclient
+        else:
+            client = grpcclient.InferenceServerClient(grpc_handle.address)
+            mod = grpcclient
+
+        def send(value, priority):
+            inputs = [mod.InferInput("IN", [1, 4], "FP32")]
+            inputs[0].set_data_from_numpy(
+                np.full((1, 4), float(value), np.float32))
+            client.infer(model.name, inputs, priority=priority)
+
+        threads = [threading.Thread(target=send, args=(0, 2),
+                                    daemon=True)]
+        threads[0].start()
+        time.sleep(0.3)  # 0 dispatched, holds the gate
+        for value in (1, 2, 3):
+            thread = threading.Thread(target=send, args=(value, 2),
+                                      daemon=True)
+            thread.start()
+            threads.append(thread)
+        deadline = time.monotonic() + 5
+        while core.model_statistics(model.name).model_stats[0] \
+                .pipeline_stats.pending_count < 3 \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        hi = threading.Thread(target=send, args=(9, 1), daemon=True)
+        hi.start()
+        threads.append(hi)
+        time.sleep(0.2)
+        model.gate.set()
+        for thread in threads:
+            thread.join(timeout=15)
+        client.close()
+        order = [v for execution in model.executions for v in execution]
+        assert order.index(9.0) < min(
+            order.index(v) for v in (1.0, 2.0, 3.0)), \
+            "%s: priority-1 did not overtake (%s)" % (transport, order)
+
+
+def test_mixed_priority_fuses_with_shared_batch_execute_span(
+        qos_servers, tmp_path):
+    """Mixed-priority concurrent requests still fuse: their traces
+    share ONE batch_execute span id, and their queue spans carry the
+    priority attribute."""
+    core, grpc_handle, _ = qos_servers
+    import client_tpu.grpc as grpcclient
+
+    model = GatedModel(name="qos_fuse_trace")
+    model.preferred_batch_sizes = [4]
+    # Long gather window: the bucket must not dispatch until all four
+    # mixed-priority requests are queued (it fills to preferred=4 and
+    # dispatches immediately at that point).
+    model.max_queue_delay_us = 2_000_000
+    model.priority_levels = 2
+    model.default_priority_level = 2
+    core.repository.add_model(model)
+    trace_file = str(tmp_path / "qos_trace.jsonl")
+    core.trace_setting(model.name, {
+        "trace_level": ["TIMESTAMPS"], "trace_rate": ["1"],
+        "trace_count": ["-1"], "log_frequency": ["1"],
+        "trace_file": [trace_file]})
+    client = grpcclient.InferenceServerClient(grpc_handle.address)
+
+    def send(value, priority):
+        inputs = [grpcclient.InferInput("IN", [1, 4], "FP32")]
+        inputs[0].set_data_from_numpy(
+            np.full((1, 4), float(value), np.float32))
+        client.infer(model.name, inputs, priority=priority)
+
+    threads = [threading.Thread(target=send, args=(i, 1 + i % 2),
+                                daemon=True) for i in range(4)]
+    for thread in threads:
+        thread.start()
+    deadline = time.monotonic() + 5
+    while core.model_statistics(model.name).model_stats[0] \
+            .pipeline_stats.pending_count < 4 \
+            and time.monotonic() < deadline:
+        time.sleep(0.02)
+    model.gate.set()
+    for thread in threads:
+        thread.join(timeout=15)
+    client.close()
+    core.trace_setting(model.name, {"trace_level": ["OFF"]})
+    assert len(model.executions) == 1  # fused despite mixed classes
+    records = [json.loads(line)
+               for line in open(trace_file) if line.strip()]
+    assert len(records) == 4
+    batch_ids = set()
+    priorities = []
+    for record in records:
+        for span in record["spans"]:
+            if span["name"] == "batch_execute":
+                batch_ids.add(span["span_id"])
+            if span["name"] == "queue" \
+                    and "priority" in (span.get("attrs") or {}):
+                priorities.append(span["attrs"]["priority"])
+    assert len(batch_ids) == 1  # ONE shared fused-execution span
+    assert sorted(priorities) == [1, 1, 2, 2]
+
+
+def test_tenant_quota_http_429_retry_after_and_recovery(qos_servers):
+    import client_tpu.http as httpclient
+    from client_tpu.robust import RetryPolicy
+
+    core, _, http_runner = qos_servers
+    with httpclient.InferenceServerClient(
+            "127.0.0.1:%d" % http_runner.port) as client:
+        params = {"tenant": "limited"}
+        client.infer("simple_qos", _qos_inputs(httpclient),
+                     parameters=params)  # burst of 1 spent
+        with pytest.raises(InferenceServerException) as excinfo:
+            client.infer("simple_qos", _qos_inputs(httpclient),
+                         parameters=params)
+        error = excinfo.value
+        assert error.status() == "429"
+        # rate 2/s -> ~0.5s to the next token, rounded up to integer
+        # delta-seconds for the HTTP header (RFC 9110)
+        assert getattr(error, "retry_after_s", None) == 1.0
+        # the PR-2 retry policy recovers by honoring the advised pacing
+        policy = RetryPolicy(max_attempts=4, initial_backoff_s=0.01)
+        attempts = [0]
+
+        def call():
+            attempts[0] += 1
+            return client.infer("simple_qos", _qos_inputs(httpclient),
+                                parameters=params)
+
+        from client_tpu.robust import call_with_retry
+
+        call_with_retry(lambda _r: call(), policy)
+        assert attempts[0] >= 1
+    stats = core.model_statistics("simple_qos").model_stats[0]
+    rows = {r.tenant: r for r in stats.tenant_stats}
+    assert rows["limited"].reject_count >= 1
+    assert rows["limited"].success_count >= 2
+
+
+def test_tenant_quota_grpc_resource_exhausted_with_retry_after(
+        qos_servers):
+    import client_tpu.grpc as grpcclient
+
+    core, grpc_handle, _ = qos_servers
+    with grpcclient.InferenceServerClient(grpc_handle.address) as client:
+        params = {"tenant": "limited"}
+        statuses = []
+        error = None
+        for _ in range(4):
+            try:
+                client.infer("simple_qos", _qos_inputs(grpcclient),
+                             parameters=params)
+                statuses.append("ok")
+            except InferenceServerException as e:
+                statuses.append(e.status())
+                error = e
+        assert "RESOURCE_EXHAUSTED" in statuses
+        # retry-after trailing metadata parsed into the exception
+        assert getattr(error, "retry_after_s", 0) > 0
+
+
+def test_tenant_identity_from_header_and_metadata(qos_servers):
+    import urllib.request
+
+    import grpc as grpc_mod
+
+    import client_tpu.http as httpclient
+    from client_tpu.protocol import inference_pb2 as pb
+    from client_tpu.protocol.service import GRPCInferenceServiceStub
+
+    core, grpc_handle, http_runner = qos_servers
+
+    def tenant_rows():
+        stats = core.model_statistics("simple_qos").model_stats[0]
+        return {r.tenant: int(r.success_count)
+                for r in stats.tenant_stats}
+
+    before = tenant_rows()
+    # HTTP: x-tenant-id header maps onto the tenant parameter
+    body, json_len = httpclient.InferenceServerClient. \
+        generate_request_body(_qos_inputs(httpclient))
+    request = urllib.request.Request(
+        "http://127.0.0.1:%d/v2/models/simple_qos/infer"
+        % http_runner.port, data=body,
+        headers={"x-tenant-id": "header-co",
+                 "Inference-Header-Content-Length": str(json_len)})
+    with urllib.request.urlopen(request) as response:
+        assert response.status == 200
+    # gRPC: `tenant` invocation metadata key
+    channel = grpc_mod.insecure_channel(grpc_handle.address)
+    stub = GRPCInferenceServiceStub(channel)
+    infer_request = pb.ModelInferRequest(model_name="simple_qos")
+    for name in ("INPUT0", "INPUT1"):
+        tensor = infer_request.inputs.add()
+        tensor.name = name
+        tensor.datatype = "INT32"
+        tensor.shape.extend([1, 16])
+        infer_request.raw_input_contents.append(
+            np.arange(16, dtype=np.int32)[None].tobytes())
+    stub.ModelInfer(infer_request, metadata=(("tenant", "meta-co"),))
+    channel.close()
+    after = tenant_rows()
+    assert after.get("header-co", 0) - before.get("header-co", 0) == 1
+    assert after.get("meta-co", 0) - before.get("meta-co", 0) == 1
+
+
+def test_decoupled_stream_respects_tenant_quota(qos_servers):
+    """The streaming path must not bypass admission: a decoupled
+    stream spends one quota token and holds one in-flight slot for
+    its duration."""
+    from client_tpu.protocol import inference_pb2 as pb
+
+    core, _, _ = qos_servers
+    core.repository.load("repeat_int32")
+
+    def stream_request():
+        request = pb.ModelInferRequest(model_name="repeat_int32")
+        tensor = request.inputs.add()
+        tensor.name = "IN"
+        tensor.datatype = "INT32"
+        tensor.shape.extend([2])
+        request.raw_input_contents.append(
+            np.array([1, 2], np.int32).tobytes())
+        request.parameters["tenant"].string_param = "streamlim"
+        return request
+
+    responses = list(core.stream_infer(stream_request()))
+    assert any(not r.error_message for r in responses)
+    # burst of 1 spent, refill 0.2/s: the next stream is rejected
+    with pytest.raises(InferenceServerException) as excinfo:
+        list(core.stream_infer(stream_request()))
+    assert excinfo.value.status() == "RESOURCE_EXHAUSTED"
+    assert excinfo.value.retry_after_s > 0
+    snap = core.tenant_quotas.snapshot()["streamlim"]
+    assert snap["admitted"] == 1
+    assert snap["rejected"] == 1
+    assert snap["inflight"] == 0  # released when the stream completed
+
+
+def test_decoupled_stream_releases_quota_when_acquire_fails(qos_servers):
+    """Regression: a failure BETWEEN quota admission and stream start
+    (model draining -> repository.acquire raises) must still return
+    the tenant's token and in-flight slot, or a concurrency-capped
+    tenant is starved forever after `cap` such failures."""
+    from client_tpu.protocol import inference_pb2 as pb
+    from client_tpu.server.qos import TenantQuotaManager
+
+    core, _, _ = qos_servers
+    core.repository.load("repeat_int32")
+
+    def stream_request():
+        request = pb.ModelInferRequest(model_name="repeat_int32")
+        tensor = request.inputs.add()
+        tensor.name = "IN"
+        tensor.datatype = "INT32"
+        tensor.shape.extend([2])
+        request.raw_input_contents.append(
+            np.array([1, 2], np.int32).tobytes())
+        request.parameters["tenant"].string_param = "capped"
+        return request
+
+    saved_quotas = core.tenant_quotas
+    saved_acquire = core.repository.acquire
+    try:
+        core.tenant_quotas = TenantQuotaManager.from_spec(
+            "default=rate:10000;capped=concurrency:2")
+
+        def draining_acquire(name, version=""):
+            raise InferenceServerException(
+                "model '%s' is draining" % name, status="UNAVAILABLE")
+
+        core.repository.acquire = draining_acquire
+        for _ in range(3):  # > concurrency cap
+            with pytest.raises(InferenceServerException) as excinfo:
+                list(core.stream_infer(stream_request()))
+            # the drain error, never a quota reject from leaked slots
+            assert excinfo.value.status() == "UNAVAILABLE"
+        snap = core.tenant_quotas.snapshot()["capped"]
+        assert snap["inflight"] == 0
+        # recovery: acquire works again -> the tenant streams normally
+        core.repository.acquire = saved_acquire
+        responses = list(core.stream_infer(stream_request()))
+        assert any(not r.error_message for r in responses)
+        assert core.tenant_quotas.snapshot()["capped"]["inflight"] == 0
+    finally:
+        core.repository.acquire = saved_acquire
+        core.tenant_quotas = saved_quotas
+
+
+def test_untagged_requests_account_as_anonymous(qos_servers):
+    import client_tpu.http as httpclient
+
+    core, _, http_runner = qos_servers
+    with httpclient.InferenceServerClient(
+            "127.0.0.1:%d" % http_runner.port) as client:
+        client.infer("simple_qos", _qos_inputs(httpclient))
+    stats = core.model_statistics("simple_qos").model_stats[0]
+    rows = {r.tenant for r in stats.tenant_stats}
+    assert ANONYMOUS_TENANT in rows
+
+
+def test_qos_prometheus_families(qos_servers):
+    core, _, _ = qos_servers
+    text = core.metrics_text()
+    assert "tpu_tenant_success_total{" in text
+    assert "tpu_tenant_rejected_total{" in text
+    assert 'tpu_shed_total{model="simple_qos",priority="' in text
+    assert "tpu_tenant_tokens{" in text
+    # priority queue gauge appears once the batcher exists
+    assert "tpu_priority_queue_size" in text
+
+
+def test_tenant_label_values_escaped_in_metrics(qos_servers):
+    """Tenant is the one client-supplied Prometheus label value: a
+    quote/backslash/newline in it must not corrupt the exposition."""
+    import client_tpu.http as httpclient
+
+    core, _, http_runner = qos_servers
+    hostile = 'evil"} 1\ninjected{x="'
+    with httpclient.InferenceServerClient(
+            "127.0.0.1:%d" % http_runner.port) as client:
+        client.infer("simple_qos", _qos_inputs(httpclient),
+                     parameters={"tenant": hostile})
+    text = core.metrics_text()
+    assert 'tenant="evil\\"} 1\\ninjected{x=\\""' in text
+    import re
+    for line in text.splitlines():  # every sample line stays one line
+        if "evil" in line:
+            assert re.fullmatch(
+                r'[a-zA-Z_][a-zA-Z0-9_]*\{tenant=".*"\} [0-9.+-eE]+',
+                line), line
+
+
+def test_higher_priority_miss_does_not_coalesce_behind_bulk_leader():
+    """Cache x QoS interplay: priority is excluded from the cache key,
+    so an identical higher-class arrival WOULD coalesce onto a bulk
+    leader and inherit its back-of-queue wait — exactly the saturation
+    condition priority dispatch exists for. It must execute
+    independently instead; same-class arrivals still coalesce."""
+    from client_tpu._infer_common import InferInput
+    from client_tpu.grpc._utils import InferResult, get_inference_request
+    from client_tpu.models.add_sub import AddSub
+    from client_tpu.server.app import build_core
+
+    release = threading.Event()
+    entered = threading.Event()
+
+    class GatedQoSCache(AddSub):
+        response_cache = True
+
+        def __init__(self):
+            super().__init__(name="qos_cache", datatype="INT32",
+                             shape=(16,))
+            self.priority_levels = 2
+            self.default_priority_level = 2
+            self.calls = 0
+
+        def infer(self, inputs, parameters=None):
+            self.calls += 1
+            if self.calls == 1:  # hold the bulk leader mid-execution
+                entered.set()
+                assert release.wait(5)
+            return super().infer(inputs, parameters)
+
+    core = build_core([], warmup=False)
+    model = GatedQoSCache()
+    core.repository.add_model(model)
+
+    def request(priority=0):
+        tensors = []
+        for name, fill in (("INPUT0", 3), ("INPUT1", 6)):
+            tensor = InferInput(name, [16], "INT32")
+            tensor.set_data_from_numpy(np.full((16,), fill, np.int32))
+            tensors.append(tensor)
+        return get_inference_request(
+            model_name="qos_cache", inputs=tensors, outputs=None,
+            priority=priority)
+
+    try:
+        leader_results = []
+        leader = threading.Thread(
+            target=lambda: leader_results.append(core.infer(request())))
+        leader.start()
+        try:
+            assert entered.wait(5)
+            # identical content, higher class: completes while the
+            # bulk leader is still held, via its own execution
+            response = core.infer(request(priority=1))
+            value = int(InferResult(response)
+                        .as_numpy("OUTPUT0").reshape(-1)[0])
+            assert value == 9
+            assert model.calls == 2
+            assert leader.is_alive()  # overtake never woke the leader
+        finally:
+            release.set()
+            leader.join(timeout=5)
+        assert len(leader_results) == 1
+        # the leader resolved + inserted: a same-class repeat is a hit
+        core.infer(request())
+        assert model.calls == 2
+    finally:
+        core.shutdown()
+
+
+# -- overload chaos scenario ----------------------------------------------
+
+
+def test_overload_scenario_spec_parsing():
+    from client_tpu.server.chaos import OverloadScenario
+
+    kwargs = OverloadScenario.parse_spec(
+        "rate=500,after_s=1,duration_s=3,workers=4,seed=7")
+    assert kwargs == {"rate": 500.0, "burst_after_s": 1.0,
+                      "burst_duration_s": 3.0, "workers": 4, "seed": 7}
+    with pytest.raises(ValueError):
+        OverloadScenario.parse_spec("nope")
+    with pytest.raises(ValueError):
+        OverloadScenario.parse_spec("frobnicate=1")
+
+
+def test_overload_scenario_counts_submissions_and_rejects():
+    from client_tpu.server.chaos import OverloadScenario
+
+    calls = []
+
+    def submit():
+        calls.append(1)
+        if len(calls) % 2 == 0:
+            raise InferenceServerException("shed", status="UNAVAILABLE")
+
+    # one worker: the even/odd reject pattern in submit() is only
+    # deterministic when calls are sequential
+    scenario = OverloadScenario(submit, rate=0.0, burst_after_s=0.0,
+                                burst_duration_s=0.3, workers=1).start()
+    deadline = time.monotonic() + 5
+    while not scenario.finished.is_set() \
+            and time.monotonic() < deadline:
+        time.sleep(0.01)
+    scenario.stop()
+    stats = scenario.stats()
+    assert stats["submitted"] == len(calls)
+    assert stats["rejected"] == len(calls) // 2
+    assert scenario.started.is_set()
+
+
+def test_overload_scenario_stage_cancel():
+    from client_tpu.server.chaos import OverloadScenario
+
+    scenario = OverloadScenario(lambda: None, burst_after_s=30.0,
+                                burst_duration_s=1.0).start()
+    scenario.stop()  # cancels before the burst ever fires
+    assert not scenario.started.is_set()
+    assert scenario.stats()["submitted"] == 0
+
+
+# -- perf harness QoS pieces ----------------------------------------------
+
+
+def test_priority_mix_parse_and_schedule():
+    from client_tpu.perf.load_manager import (
+        build_priority_schedule,
+        parse_priority_mix,
+    )
+
+    mix = parse_priority_mix("1:0.25,2:0.75")
+    assert mix == [(1, 0.25), (2, 0.75)]
+    assert parse_priority_mix("1,2") == [(1, 1.0), (2, 1.0)]
+    with pytest.raises(ValueError):
+        parse_priority_mix("")
+    # levels start at 1: 0 would issue unclassed requests, negatives
+    # would be rejected INVALID_ARGUMENT at the server mid-run
+    with pytest.raises(ValueError):
+        parse_priority_mix("0:1")
+    with pytest.raises(ValueError):
+        parse_priority_mix("-1:0.5,2:0.5")
+    with pytest.raises(ValueError):
+        parse_priority_mix("1:0")
+    schedule = build_priority_schedule([(1, 1), (2, 3)], slots=8)
+    assert schedule.count(1) == 2
+    assert schedule.count(2) == 6
+    # interleaved, not blocked: no run of four 2s containing all the 1s
+    assert schedule[:4].count(2) < 4 or schedule[4:].count(1) == 0
+
+
+def test_profiler_deltas_for_qos_stats():
+    from client_tpu.perf.profiler import (
+        _accumulate_server_stats,
+        _delta_server_stats,
+        _normalize_stats_entry,
+    )
+
+    before_entry = _normalize_stats_entry({
+        "name": "m", "version": "1", "shed_count": "2",
+        "priority_stats": [
+            {"priority_level": "1", "success_count": "10",
+             "queue_ns": "1000"}],
+        "tenant_stats": [
+            {"tenant": "a", "success_count": "5",
+             "reject_count": "1"}],
+    })
+    after_entry = _normalize_stats_entry({
+        "name": "m", "version": "1", "shed_count": "5",
+        "priority_stats": [
+            {"priority_level": "1", "success_count": "16",
+             "queue_ns": "4000"},
+            {"priority_level": "2", "success_count": "3",
+             "shed_count": "3"}],
+        "tenant_stats": [
+            {"tenant": "a", "success_count": "9",
+             "reject_count": "4"}],
+    })
+    delta = _delta_server_stats(
+        {("m", "1"): before_entry}, {("m", "1"): after_entry})
+    entry = delta["model_stats"][0]
+    assert entry["shed_count"] == 3
+    rows = {r["priority_level"]: r for r in entry["priority_stats"]}
+    assert rows[1]["success_count"] == 6
+    assert rows[1]["queue_ns"] == 3000
+    assert rows[2]["shed_count"] == 3
+    tenant_rows = {r["tenant"]: r for r in entry["tenant_stats"]}
+    assert tenant_rows["a"]["success_count"] == 4
+    assert tenant_rows["a"]["reject_count"] == 3
+    # merging two stable windows sums the rows
+    merged = _accumulate_server_stats(delta, delta)
+    entry = merged["model_stats"][0]
+    rows = {r["priority_level"]: r for r in entry["priority_stats"]}
+    assert rows[1]["success_count"] == 12
+
+
+# -- post-review hardening regressions ------------------------------------
+
+
+def test_quota_reject_never_fails_over_in_pool():
+    """A RESOURCE_EXHAUSTED quota reject is a policy signal enforced
+    identically on every replica: failing over immediately would turn
+    one throttled tenant's request into fleet-size physical hits and
+    skip the Retry-After pacing. The pool path must back off (floored
+    at Retry-After) instead of trying the next endpoint, and with no
+    policy (pure failover) must surface the reject after ONE attempt."""
+    from client_tpu.robust import (
+        EndpointPool,
+        RetryPolicy,
+        call_with_retry_pool,
+    )
+
+    def reject(state, remaining):
+        calls.append(state.url)
+        error = InferenceServerException(
+            "tenant over quota", status="RESOURCE_EXHAUSTED")
+        error.retry_after_s = 0.2
+        raise error
+
+    # Pure failover (policy=None): one attempt, no fan-out.
+    calls, pool = [], EndpointPool(
+        ["a", "b"], hedge_max_ratio=0.0, explore_ratio=0.0)
+    with pytest.raises(InferenceServerException) as err:
+        call_with_retry_pool(reject, pool, None, sleep=lambda s: None)
+    assert err.value.status() == "RESOURCE_EXHAUSTED"
+    assert len(calls) == 1
+    assert pool.stats()["failovers"] == 0
+
+    # With a policy: the retry waits at least Retry-After; the second
+    # attempt is a paced re-try, never counted as a failover.
+    calls, slept = [], []
+    pool = EndpointPool(["a", "b"], hedge_max_ratio=0.0,
+                        explore_ratio=0.0)
+    with pytest.raises(InferenceServerException):
+        call_with_retry_pool(
+            reject, pool, RetryPolicy(max_attempts=2),
+            sleep=slept.append)
+    assert len(calls) == 2
+    assert slept and slept[0] >= 0.2
+    assert pool.stats()["failovers"] == 0
+
+
+def test_cache_hit_and_follower_success_labeled_per_priority():
+    """priority_stats must count cache-hit successes: with
+    response_cache + priority_levels both on, a class fully served
+    from cache would otherwise report ~0 per-class goodput while
+    inference_count says every request succeeded."""
+    from client_tpu._infer_common import InferInput
+    from client_tpu.models.add_sub import AddSub
+    from client_tpu.grpc._utils import get_inference_request
+    from client_tpu.server.app import build_core
+
+    class QoSCache(AddSub):
+        response_cache = True
+
+        def __init__(self):
+            super().__init__(name="qos_cache_stats", datatype="INT32",
+                             shape=(16,))
+            self.priority_levels = 2
+            self.default_priority_level = 2
+
+    core = build_core([], warmup=False)
+    core.repository.add_model(QoSCache())
+
+    def request():
+        tensors = []
+        for name, fill in (("INPUT0", 3), ("INPUT1", 6)):
+            tensor = InferInput(name, [16], "INT32")
+            tensor.set_data_from_numpy(np.full((16,), fill, np.int32))
+            tensors.append(tensor)
+        return get_inference_request(
+            model_name="qos_cache_stats", inputs=tensors, outputs=None,
+            priority=1)
+
+    try:
+        core.infer(request())  # miss: executes, labeled by the batcher
+        core.infer(request())  # identical repeat: served from cache
+        hist = core._stats_for("qos_cache_stats").priority_hist
+        assert hist[1][0] == 2  # both successes land in class 1
+    finally:
+        core.shutdown()
+
+
+def test_hook_body_typeerror_is_not_reinvoked():
+    """_hook decides arity by signature, not by catching TypeError
+    from the call: a hook whose BODY raises TypeError must not be
+    silently re-run (its side effects would double-count)."""
+    calls = []
+
+    def broken(priority):
+        calls.append(priority)
+        raise TypeError("internal bug, not an arity mismatch")
+
+    DynamicBatcher._hook(broken, 1)
+    assert calls == [1]  # swallowed once, never re-invoked zero-arg
+
+    legacy_calls = []
+    DynamicBatcher._hook(lambda: legacy_calls.append(1), 2)
+    assert legacy_calls == [1]  # pre-QoS zero-arg hooks still work

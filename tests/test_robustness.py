@@ -638,7 +638,11 @@ def test_http_saturation_returns_503_with_retry_after(saturable_core):
                 status, resp_headers, _ = probe_client._request(
                     "POST", path, body=body, headers=dict(probe_headers))
                 if status == 503:
-                    saw_retry_after = resp_headers.get("retry-after") == "1"
+                    # delta-seconds form; since the QoS PR the value is
+                    # the server's refill/window estimate, not a flat 1s
+                    value = resp_headers.get("retry-after")
+                    saw_retry_after = (
+                        value is not None and float(value) > 0)
                     break
                 time.sleep(0.01)
         finally:

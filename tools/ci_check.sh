@@ -6,7 +6,7 @@
 # regression gate). Usage: tools/ci_check.sh [min_passed]
 set -u -o pipefail
 
-MIN_PASSED="${1:-448}"
+MIN_PASSED="${1:-478}"
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
 LOG=/tmp/_t1.log
 
@@ -149,6 +149,22 @@ if ! awk -v c="$coverage" 'BEGIN { exit !(c >= 90.0) }'; then
 fi
 grep -A 10 "Trace summary" "$TRACE_LOG"
 echo "OK: trace smoke passed (stage coverage ${coverage}%)"
+
+# QoS overload smoke: priority-2 bulk saturates a bounded queue while
+# a priority-1 foreground keeps sending — priority-1 p99 must stay
+# within 2x its unloaded baseline at 100% goodput, the bulk burst
+# must actually shed at saturation, and mixed-priority fusion must
+# match single-class within 10%. Gates live in tools/qos_smoke.py.
+echo "qos smoke: priority-1 under priority-2 saturation + fusion parity"
+QOS_LOG=/tmp/_qos_smoke.log
+if ! timeout -k 10 240 env JAX_PLATFORMS=cpu python tools/qos_smoke.py \
+    > "$QOS_LOG" 2>&1; then
+    echo "FAIL: qos smoke did not pass" >&2
+    tail -30 "$QOS_LOG" >&2
+    exit 1
+fi
+grep -E "qos smoke passed" "$QOS_LOG"
+echo "OK: qos smoke passed"
 
 # Cache smoke: hot-set replay against simple_cache — the replayed set
 # must reach a 100% hit ratio with hit-path p50 well under miss-path

@@ -14,17 +14,23 @@ Patches applied:
   counters ``ModelStatistics.reject_count`` /
   ``ModelStatistics.timeout_count`` (PR 2),
   ``SequenceBatchingStatistics`` + ``ModelStatistics.sequence_stats``
-  (PR 3 sequence scheduler), and the response-cache statistics (PR 5):
+  (PR 3 sequence scheduler), the response-cache statistics (PR 5):
   ``ModelStatistics.cache_hit_count`` / ``cache_miss_count`` plus the
-  ``InferStatistics.cache_hit`` / ``cache_miss`` durations.
+  ``InferStatistics.cache_hit`` / ``cache_miss`` durations, and the
+  QoS statistics (PR 7): ``ModelStatistics.shed_count`` plus the
+  repeated per-class ``PriorityStatistics`` / ``TenantStatistics``
+  rows.
 * model_config_pb2.py — ``DynamicBatchingConfig.max_queue_size`` /
   ``allow_timeout_override`` / ``timeout_action`` (PR 2 queue policy;
   ``default_queue_policy_timeout_us`` has been in the schema since the
   seed), the full sequence-batching schema (PR 3):
   ``SequenceControlInput`` / ``SequenceStateConfig`` messages plus
   ``SequenceBatchingConfig.strategy`` / ``control_input`` / ``state`` /
-  ``preferred_batch_size``, and the ``ResponseCacheConfig`` message +
-  ``ModelConfig.response_cache`` (PR 5 response cache).
+  ``preferred_batch_size``, the ``ResponseCacheConfig`` message +
+  ``ModelConfig.response_cache`` (PR 5 response cache), and the
+  multi-tenant QoS schema (PR 7): ``DynamicBatchingConfig.
+  priority_levels`` / ``default_priority_level`` / ``shed_watermark``
+  plus the per-priority ``PriorityQueuePolicy`` rows.
 
 The ``_serialized_start/_serialized_end`` attribute lines at the bottom
 of the pb2 modules go stale after the patch; they only execute when
@@ -79,6 +85,31 @@ CACHE_COUNT_FIELDS = [
     ("cache_miss_count", 13, U64),
 ]
 
+# QoS drop counter on ModelStatistics (14; 15/16 are the repeated
+# per-class statistics rows below).
+QOS_COUNT_FIELDS = [
+    ("shed_count", 14, U64),
+]
+
+# Per-priority-class counters (one row per level that saw traffic).
+PRIORITY_STATS_FIELDS = [
+    ("priority_level", 1, U64),
+    ("success_count", 2, U64),
+    ("reject_count", 3, U64),
+    ("timeout_count", 4, U64),
+    ("shed_count", 5, U64),
+    ("queue_ns", 6, U64),
+]
+
+# Per-tenant counters (one row per tenant this model served).
+TENANT_STATS_FIELDS = [
+    ("tenant", 1, STRING),
+    ("success_count", 2, U64),
+    ("reject_count", 3, U64),
+    ("fail_count", 4, U64),
+    ("duration_ns", 5, U64),
+]
+
 # Response-cache path durations on InferStatistics (1..6 are the
 # Triton-parity sections present since the seed).
 CACHE_DURATION_FIELDS = [
@@ -92,6 +123,26 @@ QUEUE_POLICY_FIELDS = [
     ("max_queue_size", 4, U64),
     ("allow_timeout_override", 5, BOOL),
     ("timeout_action", 6, STRING),
+]
+
+# Multi-tenant QoS knobs on DynamicBatchingConfig (Triton
+# priority_levels semantics: classes 1..priority_levels, 1 highest;
+# shed_watermark is the queue-depth fraction at which lowest-class
+# shedding starts). priority_queue_policy (field 9) is added
+# separately — it is a repeated message.
+PRIORITY_FIELDS = [
+    ("priority_levels", 7, U64),
+    ("default_priority_level", 8, U64),
+    ("shed_watermark", 10, DOUBLE),
+]
+
+# Per-priority ModelQueuePolicy overrides (the map<uint64,
+# ModelQueuePolicy> of Triton's schema, flattened to repeated rows so
+# the descriptor patch stays map-entry-free).
+PRIORITY_POLICY_FIELDS = [
+    ("priority_level", 1, U64),
+    ("max_queue_size", 2, U64),
+    ("default_timeout_us", 3, U64),
 ]
 
 # Sequence-scheduler observability on ModelStatistics (field 11;
@@ -178,10 +229,35 @@ def patch_inference(file_proto: descriptor_pb2.FileDescriptorProto) -> bool:
             type_name=".inference.SequenceBatchingStatistics",
             json_name="sequenceStats")
         changed = True
-    for name, number, ftype in CACHE_COUNT_FIELDS:
+    for name, number, ftype in CACHE_COUNT_FIELDS + QOS_COUNT_FIELDS:
         if not any(f.name == name for f in model_stats.field):
             model_stats.field.add(name=name, number=number, type=ftype,
                                   label=OPTIONAL, json_name=_json_name(name))
+            changed = True
+    names = [m.name for m in file_proto.message_type]
+    for msg_name, rows in (
+        ("PriorityStatistics", PRIORITY_STATS_FIELDS),
+        ("TenantStatistics", TENANT_STATS_FIELDS),
+    ):
+        if msg_name in names:
+            continue
+        anchor = names.index("SequenceBatchingStatistics") + 1
+        message = descriptor_pb2.DescriptorProto(name=msg_name)
+        for name, number, ftype in rows:
+            message.field.add(name=name, number=number, type=ftype,
+                              label=OPTIONAL, json_name=_json_name(name))
+        file_proto.message_type.insert(anchor, message)
+        names.insert(anchor, msg_name)
+        changed = True
+    for field_name, number, type_name in (
+        ("priority_stats", 15, ".inference.PriorityStatistics"),
+        ("tenant_stats", 16, ".inference.TenantStatistics"),
+    ):
+        if not any(f.name == field_name for f in model_stats.field):
+            model_stats.field.add(
+                name=field_name, number=number, type=MESSAGE,
+                label=REPEATED, type_name=type_name,
+                json_name=_json_name(field_name))
             changed = True
     infer_stats = next(
         m for m in file_proto.message_type if m.name == "InferStatistics")
@@ -200,12 +276,27 @@ def patch_model_config(file_proto: descriptor_pb2.FileDescriptorProto) -> bool:
         m for m in file_proto.message_type
         if m.name == "DynamicBatchingConfig")
     changed = False
-    for name, number, ftype in QUEUE_POLICY_FIELDS:
+    for name, number, ftype in QUEUE_POLICY_FIELDS + PRIORITY_FIELDS:
         if not any(f.name == name for f in batching.field):
             batching.field.add(name=name, number=number, type=ftype,
                                label=OPTIONAL, json_name=_json_name(name))
             changed = True
     names = [m.name for m in file_proto.message_type]
+    if "PriorityQueuePolicy" not in names:
+        anchor = names.index("DynamicBatchingConfig")
+        message = descriptor_pb2.DescriptorProto(name="PriorityQueuePolicy")
+        for name, number, ftype in PRIORITY_POLICY_FIELDS:
+            message.field.add(name=name, number=number, type=ftype,
+                              label=OPTIONAL, json_name=_json_name(name))
+        file_proto.message_type.insert(anchor, message)
+        names.insert(anchor, "PriorityQueuePolicy")
+        changed = True
+    if not any(f.name == "priority_queue_policy" for f in batching.field):
+        batching.field.add(
+            name="priority_queue_policy", number=9, type=MESSAGE,
+            label=REPEATED, type_name=".inference.PriorityQueuePolicy",
+            json_name="priorityQueuePolicy")
+        changed = True
     anchor = names.index("SequenceBatchingConfig")
     for msg_name, rows in (
         ("SequenceControlInput", CONTROL_INPUT_FIELDS),

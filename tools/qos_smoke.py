@@ -1,0 +1,90 @@
+"""Multi-tenant QoS overload smoke gate for tools/ci_check.sh.
+
+Runs the bench harness's overload measurement
+(client_tpu.perf.bench_child.run_qos_measure) against an in-process
+core: a paced priority-2 bulk burst (tenant "bulk") saturates a
+bounded queue while a priority-1 foreground keeps a closed loop
+running. Gates on the ISSUE-7 acceptance criteria:
+
+* priority-1 goodput is 100% through saturation (every drop landed on
+  bulk via displacement/watermark shedding, never on priority 1),
+* priority-1 p99 stays within 2x its unloaded baseline,
+* bulk actually saturated (server sheds/rejects observed — otherwise
+  the run proved nothing), and
+* mixed-priority fusion parity: the c16 mixed run's fusion ratio is
+  within 10% of the single-class run's (QoS ordering costs dispatch
+  order, not batch efficiency).
+
+The latency gate involves OS scheduling at ms scale, so one retry is
+allowed; the correctness gates (goodput, sheds, fusion) must hold on
+every attempt.
+
+Usage: JAX_PLATFORMS=cpu python tools/qos_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+
+def run_once(attempt: int) -> tuple:
+    from client_tpu.server.app import build_core
+    from client_tpu.perf.bench_child import run_qos_measure
+
+    core = build_core([], warmup=False)
+    try:
+        result = run_qos_measure(core, model_name="qos_smoke_%d" % attempt)
+    finally:
+        core.shutdown()
+    print(json.dumps(result, indent=1))
+
+    hard, soft = [], []
+    if result.get("p1_goodput_pct") != 100.0:
+        hard.append("priority-1 goodput %.2f%% under saturation "
+                    "(want 100%%)" % result.get("p1_goodput_pct", 0.0))
+    dropped = (result.get("bulk_server_sheds", 0)
+               + result.get("bulk_server_rejects", 0))
+    if dropped <= 0:
+        hard.append("bulk burst never saturated the queue (0 server "
+                    "sheds/rejects) — the run proved nothing")
+    parity = result.get("fusion_mixed_vs_single", 0.0)
+    if not 0.9 <= parity <= 1.1:
+        hard.append("mixed-priority fusion ratio is %.3fx the "
+                    "single-class run (want within 10%%)" % parity)
+    ratio = result.get("p1_p99_vs_unloaded", 0.0)
+    if not 0 < ratio <= 2.0:
+        soft.append("priority-1 p99 %.2fx its unloaded baseline "
+                    "(gate: 2x)" % ratio)
+    return result, hard, soft
+
+
+def main() -> int:
+    for attempt in range(2):
+        result, hard, soft = run_once(attempt)
+        for failure in hard:
+            print("FAIL: %s" % failure, file=sys.stderr)
+        if hard:
+            return 1
+        if not soft:
+            print("qos smoke passed: priority-1 p99 %.2fx unloaded at "
+                  "100%% goodput, %d bulk sheds at saturation, mixed "
+                  "fusion parity %.3f"
+                  % (result.get("p1_p99_vs_unloaded", 0.0),
+                     result.get("bulk_server_sheds", 0)
+                     + result.get("bulk_server_rejects", 0),
+                     result.get("fusion_mixed_vs_single", 0.0)))
+            return 0
+        for failure in soft:
+            print("attempt %d: %s" % (attempt, failure), file=sys.stderr)
+    print("FAIL: %s" % soft[0], file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
