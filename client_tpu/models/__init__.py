@@ -53,11 +53,33 @@ def builtin_model_factories(repository=None
         model.shed_watermark = 0.9
         return model
 
+    def _simple_replicas() -> ServedModel:
+        # The `simple` model served as an instance group of 4
+        # per-device fault domains (client_tpu.server.replicas): a
+        # dynamic batcher gathers fused batches, the replica router
+        # spreads them by least expected completion time, and a
+        # degraded replica is ejected/self-healed without dropping the
+        # model from readiness. Recovery knobs are tuned tight so the
+        # chaos smoke and tests observe eject -> readmit in seconds.
+        model = AddSub(name="simple_replicas", datatype="INT32",
+                       shape=(16,))
+        model.max_batch_size = 8
+        model.dynamic_batching = True
+        model.preferred_batch_sizes = [4]
+        model.max_queue_delay_us = 500
+        model.instance_group_count = 4
+        model.instance_group_kind = "cpu"
+        model.replica_watchdog_us = 2_000_000
+        model.replica_failure_threshold = 3
+        model.replica_recovery_s = 0.5
+        return model
+
     factories: Dict[str, Callable[[], ServedModel]] = {
         "add_sub": AddSub,
         "simple": lambda: AddSub(name="simple", datatype="INT32", shape=(16,)),
         "simple_cache": _simple_cache,
         "simple_qos": _simple_qos,
+        "simple_replicas": _simple_replicas,
         "add_sub_fp32": lambda: AddSub(
             name="add_sub_fp32", datatype="FP32", shape=(16,)
         ),

@@ -733,6 +733,188 @@ def run_qos_measure(core, model_name: str = "qos_bench",
     return result
 
 
+def replica_stats(core, model_name: str):
+    """Replica-set health + lifecycle counters for bench evidence."""
+    try:
+        stats = core.model_statistics(model_name)
+        entry = stats.model_stats[0]
+        return {
+            "healthy": int(entry.healthy_replicas),
+            "total": int(entry.total_replicas),
+            "ejected": sum(int(r.ejected_count)
+                           for r in entry.replica_stats),
+            "readmitted": sum(int(r.readmitted_count)
+                              for r in entry.replica_stats),
+            "per_replica_execs": {
+                int(r.replica_index): int(r.execution_count)
+                for r in entry.replica_stats},
+        }
+    except Exception:  # noqa: BLE001 — evidence, never a failure
+        return None
+
+
+def run_replica_measure(core, model_name: str = "replica_bench",
+                        exec_delay_s: float = 0.004,
+                        threads: int = 8,
+                        measure_s: float = 2.0) -> dict:
+    """Replica serving measurement: data-parallel scaling plus the
+    degrade-one blast-radius timeline.
+
+    Phase 1 — scaling: the same slow model (AddSub + a fixed
+    per-execution delay so replica parallelism, not numpy speed, is
+    what's measured) served with 1 replica vs 4 replicas under an
+    identical closed loop. A single replica's device queue serializes
+    executions, so throughput is delay-bound (~1/exec_delay); 4
+    replicas run 4 queues concurrently. Acceptance: >= 2.5x.
+
+    Phase 2 — degrade-one: replica 2 of 4 is hard-degraded mid-run via
+    a replica-targeted DegradeOneScenario (every execution on it
+    fails). The router re-dispatches in-flight failures to healthy
+    siblings (goodput stays 100%), the breaker ejects the replica
+    (throughput degrades toward 3/4), the scenario heals the fault,
+    and the supervisor readmits after a canary — throughput must
+    recover to within 20% of the pre-fault rate.
+    """
+    import threading as _threading
+
+    import numpy as np
+
+    from client_tpu._infer_common import InferInput
+    from client_tpu.grpc._utils import get_inference_request
+    from client_tpu.models.add_sub import AddSub
+    from client_tpu.server.chaos import DegradeOneScenario
+    from client_tpu.utils import InferenceServerException
+
+    def slow_replica_factory(name: str, count: int):
+        class _SlowReplica(AddSub):
+            # Direct path (no dynamic batcher): every request is one
+            # routed execution, so the scaling ratio reads the router,
+            # not the gather window. Recovery knobs are tight so the
+            # degrade phase observes eject -> readmit inside its
+            # windows.
+            def __init__(self):
+                super().__init__(name=name, datatype="INT32",
+                                 shape=(16,))
+                self.instance_group_count = count
+                self.replica_watchdog_us = 2_000_000
+                self.replica_failure_threshold = 3
+                self.replica_recovery_s = 0.3
+
+            def infer(self, inputs, parameters=None):
+                time.sleep(exec_delay_s)
+                return super().infer(inputs, parameters)
+
+        return _SlowReplica
+
+    def request(name: str, seed: int):
+        a = np.full((16,), seed % 997, dtype=np.int32)
+        b = np.arange(16, dtype=np.int32)
+        t0 = InferInput("INPUT0", [16], "INT32")
+        t0.set_data_from_numpy(a)
+        t1 = InferInput("INPUT1", [16], "INT32")
+        t1.set_data_from_numpy(b)
+        return get_inference_request(model_name=name, inputs=[t0, t1],
+                                     outputs=None)
+
+    def closed_loop(name: str, duration_s: float) -> dict:
+        latencies: list = []
+        errors = [0]
+        merge = _threading.Lock()
+
+        def worker(index: int):
+            local, failed = [], 0
+            deadline = time.monotonic() + duration_s
+            seed = index * 100_000
+            while time.monotonic() < deadline:
+                req = request(name, seed)
+                seed += 1
+                t_start = time.monotonic_ns()
+                try:
+                    core.infer(req)
+                    local.append(time.monotonic_ns() - t_start)
+                except InferenceServerException:
+                    failed += 1
+            with merge:
+                latencies.extend(local)
+                errors[0] += failed
+
+        pool = [_threading.Thread(target=worker, args=(i,))
+                for i in range(threads)]
+        for thread in pool:
+            thread.start()
+        for thread in pool:
+            thread.join()
+        completed = len(latencies)
+        total = completed + errors[0]
+        return {
+            "tput": completed / duration_s if duration_s else 0.0,
+            "p50_us": round(float(np.percentile(
+                np.array(latencies, dtype=float) / 1000.0, 50)), 1)
+            if latencies else 0.0,
+            "completed": completed,
+            "errors": errors[0],
+            "goodput_pct": round(completed / total * 100.0, 2)
+            if total else 0.0,
+        }
+
+    # -- phase 1: scaling, 1 vs 4 replicas --------------------------------
+    name1, name4 = model_name + "1", model_name + "4"
+    core.repository.add_factory(name1, slow_replica_factory(name1, 1))
+    core.repository.add_factory(name4, slow_replica_factory(name4, 4))
+    core.repository.load(name1)
+    core.repository.load(name4)
+    closed_loop(name1, 0.3)  # warmup, discarded
+    single = closed_loop(name1, measure_s)
+    closed_loop(name4, 0.3)  # warmup: instantiates the replica set
+    quad = closed_loop(name4, measure_s)
+
+    # -- phase 2: degrade replica 2 of 4 mid-run, then heal ---------------
+    before = replica_stats(core, name4) or {}
+    prefault = closed_loop(name4, measure_s)
+    scenario = DegradeOneScenario(
+        replica="%s:2" % name4, kill_after_s=0.0,
+        heal_after_s=measure_s + 0.5).start()
+    scenario.killed.wait(timeout=2.0)
+    degraded = closed_loop(name4, measure_s)
+    scenario.healed.wait(timeout=measure_s + 5.0)
+    scenario.stop()
+    # Give the supervisor one recovery period to canary + readmit.
+    mid = replica_stats(core, name4) or {}
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        snap = replica_stats(core, name4)
+        if snap and snap["readmitted"] > before.get("readmitted", 0):
+            break
+        time.sleep(0.1)
+    recovered = closed_loop(name4, measure_s)
+    after = replica_stats(core, name4) or {}
+
+    result = {
+        "exec_delay_ms": exec_delay_s * 1000.0,
+        "concurrency": threads,
+        "tput_1": round(single["tput"], 2),
+        "p50_1_us": single["p50_us"],
+        "tput_4": round(quad["tput"], 2),
+        "p50_4_us": quad["p50_us"],
+        "prefault_tput": round(prefault["tput"], 2),
+        "degraded_tput": round(degraded["tput"], 2),
+        "recovered_tput": round(recovered["tput"], 2),
+        "degrade_goodput_pct": degraded["goodput_pct"],
+        "degrade_errors": degraded["errors"],
+        "healthy_during_degrade": mid.get("healthy"),
+        "ejections": (after.get("ejected", 0)
+                      - before.get("ejected", 0)),
+        "readmissions": (after.get("readmitted", 0)
+                         - before.get("readmitted", 0)),
+    }
+    if single["tput"]:
+        result["scaling_4v1"] = round(quad["tput"] / single["tput"], 2)
+    if prefault["tput"]:
+        result["recovery_vs_prefault"] = round(
+            recovered["tput"] / prefault["tput"], 3)
+    return result
+
+
 def run_tracing_measure(core, model_name: str = "add_sub_large",
                         threads: int = 4, requests: int = 120) -> dict:
     """Span-tracing overhead: the same closed loop run with tracing
@@ -1678,6 +1860,33 @@ def main() -> None:
                     % extra.get("p1_p99_vs_unloaded", 0.0))
         except Exception as exc:  # noqa: BLE001
             log("qos_overload failed: %s" % exc)
+
+    # Config 3f: replica serving — data-parallel scaling (1 vs 4
+    # per-device replicas of a delay-bound model under one closed
+    # loop) plus the degrade-one blast-radius timeline (replica 2 of 4
+    # hard-degraded mid-run: goodput holds 100% via bounded
+    # re-dispatch, throughput degrades toward 3/4 after ejection, and
+    # recovers within 20% of the pre-fault rate once the supervisor
+    # readmits). Acceptance: scaling_4v1 >= 2.5x, degrade goodput
+    # 100%, recovery_vs_prefault >= 0.8.
+    if remaining() > 90 and stage_wanted("replica_scaling"):
+        try:
+            extra = run_replica_measure(core)
+            record_stage("replica_scaling", extra.get("tput_4", 0.0),
+                         extra.get("p50_4_us", 0.0), extra)
+            if extra.get("scaling_4v1", 0.0) < 2.5:
+                log("replica_scaling: %.2fx at 4 replicas is under "
+                    "the 2.5x gate" % extra.get("scaling_4v1", 0.0))
+            if extra.get("degrade_goodput_pct", 0.0) < 100.0:
+                log("replica_scaling: degrade-one goodput %.2f%% "
+                    "below 100%%"
+                    % extra.get("degrade_goodput_pct", 0.0))
+            if extra.get("recovery_vs_prefault", 0.0) < 0.8:
+                log("replica_scaling: post-readmission throughput "
+                    "%.3fx pre-fault is under the 0.8x gate"
+                    % extra.get("recovery_vs_prefault", 0.0))
+        except Exception as exc:  # noqa: BLE001
+            log("replica_scaling failed: %s" % exc)
 
     # Config 3d: span-tracing overhead — the identical closed loop on
     # add_sub_large (4 MiB tensors, the ms-scale request shape tracing

@@ -49,6 +49,16 @@ class TpuMetrics:
     shed_total: Dict[str, float] = field(default_factory=dict)
     tenant_success_total: Dict[str, float] = field(default_factory=dict)
     tenant_rejected_total: Dict[str, float] = field(default_factory=dict)
+    # Replica-serving families: health gauges per model, lifecycle
+    # counters per model, cumulative exec time keyed "model|r<index>".
+    replica_healthy: Dict[str, float] = field(default_factory=dict)
+    replica_count: Dict[str, float] = field(default_factory=dict)
+    replica_ejected_total: Dict[str, float] = field(default_factory=dict)
+    replica_readmitted_total: Dict[str, float] = field(
+        default_factory=dict)
+    replica_redispatch_total: Dict[str, float] = field(
+        default_factory=dict)
+    replica_exec_us: Dict[str, float] = field(default_factory=dict)
 
 
 _FAMILIES = {
@@ -70,6 +80,12 @@ _FAMILIES = {
     "tpu_shed_total": "shed_total",
     "tpu_tenant_success_total": "tenant_success_total",
     "tpu_tenant_rejected_total": "tenant_rejected_total",
+    "tpu_replica_healthy": "replica_healthy",
+    "tpu_replica_count": "replica_count",
+    "tpu_replica_ejected_total": "replica_ejected_total",
+    "tpu_replica_readmitted_total": "replica_readmitted_total",
+    "tpu_replica_redispatch_total": "replica_redispatch_total",
+    "tpu_replica_exec_us": "replica_exec_us",
 }
 
 # Monotonic counters among the scraped families: summarize_metrics
@@ -79,6 +95,8 @@ _FAMILIES = {
 _COUNTER_FAMILIES = frozenset((
     "cache_hit_total", "cache_miss_total", "cache_evictions_total",
     "shed_total", "tenant_success_total", "tenant_rejected_total",
+    "replica_ejected_total", "replica_readmitted_total",
+    "replica_redispatch_total", "replica_exec_us",
 ))
 
 
@@ -94,12 +112,16 @@ def parse_prometheus(text: str) -> TpuMetrics:
         labels = dict(_LABEL.findall(m.group("labels") or ""))
         # Batcher gauges are per-model; HBM gauges are per-device;
         # tenant counters per tenant; priority families carry a
-        # compound model|p<level> key so deltas stay per class.
+        # compound model|p<level> key so deltas stay per class, and
+        # replica exec time a model|r<index> key so deltas stay per
+        # fault domain.
         key = (labels.get("model") or labels.get("tenant")
                or labels.get("tpu_uuid") or labels.get("gpu_uuid")
                or "0")
         if "priority" in labels:
             key = "%s|p%s" % (key, labels["priority"])
+        if "replica" in labels:
+            key = "%s|r%s" % (key, labels["replica"])
         try:
             value = float(m.group("value"))
         except ValueError:
@@ -181,7 +203,8 @@ def summarize_metrics(snapshots: List[TpuMetrics]) -> Dict[str, Dict[str, float]
                  "batch_queue_delay_us", "batch_overlap_ratio",
                  "sequence_active", "sequence_backlog",
                  "cache_size_bytes", "cache_entries",
-                 "priority_queue_size"):
+                 "priority_queue_size", "replica_healthy",
+                 "replica_count"):
         values = []
         for snap in snapshots:
             per_device = getattr(snap, attr)

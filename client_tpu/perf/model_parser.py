@@ -65,6 +65,10 @@ class ParsedModel:
         # the TOP model's config carries no response_cache section
         # (its composing steps' breakdowns exclude their cache hits).
         self.composing_cache_enabled = False
+        # Replica serving (instance_group): total declared replicas
+        # across the model's instance groups (0 = single fault
+        # domain), so reports can annotate per-replica expectations.
+        self.instance_group_count = 0
 
 
 class ModelParser:
@@ -132,6 +136,9 @@ class ModelParser:
         model.decoupled = bool(policy.get("decoupled", False))
         cache = config.get("response_cache", {})
         model.response_cache_enabled = bool(cache.get("enable", False))
+        model.instance_group_count = sum(
+            int(group.get("count", 0) or 0)
+            for group in config.get("instance_group", []) or [])
 
         # Composing models: ensemble steps (recursively — an ensemble
         # step may itself be an ensemble) plus any BLS children named

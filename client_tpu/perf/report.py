@@ -100,6 +100,21 @@ def print_report(results: List[PerfStatus], percentile: int = 0,
                 parts.append("HBM util avg %.1f%%" % (util["avg"] * 100))
             if parts:
                 print("    server TPU: %s" % ", ".join(parts))
+            healthy = status.tpu_metrics.get("replica_healthy")
+            total = status.tpu_metrics.get("replica_count")
+            if healthy and total and total.get("max"):
+                parts = ["healthy avg %.1f / %.0f"
+                         % (healthy["avg"], total["max"])]
+                for fam, label in (("replica_ejected_total", "ejections"),
+                                   ("replica_readmitted_total",
+                                    "readmissions"),
+                                   ("replica_redispatch_total",
+                                    "re-dispatches")):
+                    window = status.tpu_metrics.get(fam)
+                    if window and window.get("delta"):
+                        parts.append("%s %d" % (label,
+                                                int(window["delta"])))
+                print("    server replicas: %s" % ", ".join(parts))
         if not status.on_target:
             print("    WARNING: measurement did not stabilize")
 

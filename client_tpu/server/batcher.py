@@ -311,8 +311,18 @@ class DynamicBatcher:
                  default_priority_level: int = 0,
                  priority_policies: Optional[Dict[int, dict]] = None,
                  shed_watermark: float = 0.0,
-                 shed_hook: Optional[Callable[..., None]] = None):
+                 shed_hook: Optional[Callable[..., None]] = None,
+                 execution_target=None):
         self._model = model
+        # The hand-off point to execution. By default fused batches run
+        # on the model itself; an instance-group model passes its
+        # ReplicaSet proxy here so every fused batch is health-routed
+        # to one of N per-device replicas (client_tpu.server.replicas)
+        # instead of a single fault domain. Config knobs above always
+        # read from `model` — routing changes where a batch executes,
+        # never how it was gathered.
+        self._target = execution_target if execution_target is not None \
+            else model
         # Priority scheduling (Triton priority_levels semantics):
         # classes 1..priority_levels, 1 highest; requests pick their
         # class via the `priority` parameter (coerced + validated by
@@ -872,7 +882,7 @@ class DynamicBatcher:
             self._tracker.enter_compute()
             try:
                 if passthrough:
-                    outputs = self._model.infer(
+                    outputs = self._target.infer(
                         bucket[0].inputs, bucket[0].params)
                 else:
                     fused = {
@@ -880,7 +890,7 @@ class DynamicBatcher:
                             [p.inputs[name] for p in bucket], target, total)
                         for name in bucket[0].inputs
                     }
-                    outputs = self._model.infer(fused, bucket[0].params)
+                    outputs = self._target.infer(fused, bucket[0].params)
             finally:
                 self._tracker.exit_compute()
             compute_end_ns = time.monotonic_ns()

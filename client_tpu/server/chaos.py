@@ -12,10 +12,19 @@ core calls once per inference request. Three fault kinds:
   sees a reset, not an error body); gRPC surfaces UNAVAILABLE with a
   drop marker. Raised as :class:`ChaosDropError` so front-ends can
   distinguish a drop from an ordinary injected error.
+* ``hang_ms`` — a stall: every matching execution sleeps this long
+  (deterministic, no roll), the shape a wedged device queue produces.
+  Sized above a replica's watchdog deadline it is what the watchdog
+  ejection path exists to catch.
 
 Spec strings (``--chaos`` / CLIENT_TPU_CHAOS) are comma-separated
 ``key=value`` pairs, e.g. ``"latency_ms=50,error_rate=0.1,seed=7"``.
 An optional ``models=a+b`` entry restricts injection to those models.
+An optional ``replica=model:index`` entry retargets the config at
+exactly ONE replica of an instance-group model: the faults then fire
+only at the replica layer's inject (which passes ``replica_id``) and
+never at the request-level inject — degrading one fault domain while
+its siblings and the front-of-house path stay clean.
 
 Everything is deterministic under ``seed`` so a chaos run is
 reproducible — the property that turns "it degrades gracefully" into a
@@ -46,22 +55,30 @@ class ChaosDropError(InferenceServerException):
 
 class ChaosConfig:
     def __init__(self, latency_ms: float = 0.0, error_rate: float = 0.0,
-                 drop_rate: float = 0.0, seed: Optional[int] = None,
-                 models: Optional[set] = None):
+                 drop_rate: float = 0.0, hang_ms: float = 0.0,
+                 seed: Optional[int] = None,
+                 models: Optional[set] = None,
+                 replica: Optional[str] = None):
         self.latency_ms = max(float(latency_ms), 0.0)
         self.error_rate = min(max(float(error_rate), 0.0), 1.0)
         self.drop_rate = min(max(float(drop_rate), 0.0), 1.0)
+        self.hang_ms = max(float(hang_ms), 0.0)
         self.seed = seed
         self.models = set(models) if models else None
+        # "model:index" retargets this config at one replica's
+        # execution path (see module docstring); None = request level.
+        self.replica = str(replica) if replica else None
 
     @property
     def enabled(self) -> bool:
-        return bool(self.latency_ms or self.error_rate or self.drop_rate)
+        return bool(self.latency_ms or self.error_rate or self.drop_rate
+                    or self.hang_ms)
 
     @classmethod
     def from_spec(cls, spec: str) -> "ChaosConfig":
         """Parse ``"latency_ms=50,error_rate=0.1,drop_rate=0.01,
-        seed=7,models=a+b"``; unknown keys fail loudly."""
+        hang_ms=0,seed=7,models=a+b,replica=simple:1"``; unknown keys
+        fail loudly."""
         kwargs: dict = {}
         for part in (spec or "").split(","):
             part = part.strip()
@@ -73,12 +90,19 @@ class ChaosConfig:
                                  % part)
             key = key.strip()
             value = value.strip()
-            if key in ("latency_ms", "error_rate", "drop_rate"):
+            if key in ("latency_ms", "error_rate", "drop_rate",
+                       "hang_ms"):
                 kwargs[key] = float(value)
             elif key == "seed":
                 kwargs["seed"] = int(value)
             elif key == "models":
                 kwargs["models"] = {m for m in value.split("+") if m}
+            elif key == "replica":
+                if ":" not in value:
+                    raise ValueError(
+                        "chaos replica target '%s' is not model:index"
+                        % value)
+                kwargs["replica"] = value
             else:
                 raise ValueError("unknown chaos spec key '%s'" % key)
         return cls(**kwargs)
@@ -91,7 +115,12 @@ class ChaosConfig:
             parts.append("%.0f%% errors" % (self.error_rate * 100))
         if self.drop_rate:
             parts.append("%.0f%% drops" % (self.drop_rate * 100))
-        return ", ".join(parts) if parts else "disabled"
+        if self.hang_ms:
+            parts.append("%gms hangs" % self.hang_ms)
+        described = ", ".join(parts) if parts else "disabled"
+        if self.replica and parts:
+            described += " @ replica %s" % self.replica
+        return described
 
 
 class _ChaosState:
@@ -103,10 +132,16 @@ class _ChaosState:
         # matches gets the scope's faults ON TOP of the global config —
         # this is how one replica of N can be degraded alone.
         self.scoped: dict = {}
+        # Replica-targeted slot (configure_replica): an independent
+        # layer for scenario-driven single-replica faults, so a
+        # DegradeOneScenario in replica mode compounds with — instead
+        # of clobbering — an operator's global --chaos config.
+        self.replica_config: Optional[ChaosConfig] = None
         self.rng = random.Random()
         self.injected_errors = 0
         self.injected_drops = 0
         self.delayed_requests = 0
+        self.injected_hangs = 0
         self._env_checked = False
 
 
@@ -120,11 +155,13 @@ def configure(config: Optional[ChaosConfig]) -> None:
         _state.config = config if config is not None and config.enabled \
             else None
         _state.scoped = {}
+        _state.replica_config = None
         _state.rng = random.Random(
             config.seed if config is not None else None)
         _state.injected_errors = 0
         _state.injected_drops = 0
         _state.delayed_requests = 0
+        _state.injected_hangs = 0
         _state._env_checked = True  # explicit config beats the env
 
 
@@ -139,6 +176,20 @@ def configure_scope(scope: str, config: Optional[ChaosConfig]) -> None:
             _state.scoped[scope] = config
         else:
             _state.scoped.pop(scope, None)
+        _state._env_checked = True
+
+
+def configure_replica(config: Optional[ChaosConfig]) -> None:
+    """Install (or, with None, clear) the replica-targeted chaos slot
+    (``config.replica`` must name a ``model:index``). Independent of
+    the global config and the scoped configs — a replica-mode
+    DegradeOneScenario stages faults here so it compounds with an
+    operator's baseline ``--chaos`` instead of replacing it. Counters
+    are shared and NOT reset (scenarios flip stages mid-run)."""
+    with _state.lock:
+        _state.replica_config = (
+            config if config is not None and config.enabled
+            and config.replica else None)
         _state._env_checked = True
 
 
@@ -168,20 +219,28 @@ def stats() -> dict:
             "injected_errors": _state.injected_errors,
             "injected_drops": _state.injected_drops,
             "delayed_requests": _state.delayed_requests,
+            "injected_hangs": _state.injected_hangs,
         }
 
 
-def inject(model_name: str = "", scope: Optional[str] = None) -> None:
+def inject(model_name: str = "", scope: Optional[str] = None,
+           replica_id: Optional[str] = None) -> None:
     """Request-path hook: sleep/raise per the active config(s). No-op
     (one lock-free attribute read) when chaos is off. ``scope`` names
     the calling core; a matching scoped config applies on top of the
     global one (fault kinds compound: delays add, the first raising
-    kind wins)."""
+    kind wins). ``replica_id`` ("model:index") names the replica whose
+    device queue is executing: replica-targeted configs fire only
+    here, and only for their replica; untargeted configs fire only at
+    the request-level inject (``replica_id=None``) — one fault, one
+    layer, never both."""
     if not _state._env_checked:
         _load_env_config()
     configs = []
     if _state.config is not None:
         configs.append(_state.config)
+    if _state.replica_config is not None:
+        configs.append(_state.replica_config)
     if scope is not None and _state.scoped:
         scoped = _state.scoped.get(scope)
         if scoped is not None:
@@ -189,6 +248,7 @@ def inject(model_name: str = "", scope: Optional[str] = None) -> None:
     if not configs:
         return
     delay_ms = 0.0
+    hang_ms = 0.0
     drop = False
     error = None
     with _state.lock:
@@ -196,23 +256,35 @@ def inject(model_name: str = "", scope: Optional[str] = None) -> None:
             if config.models is not None \
                     and model_name not in config.models:
                 continue
+            if (config.replica is None) != (replica_id is None):
+                continue  # wrong layer for this config
+            if config.replica is not None \
+                    and config.replica != replica_id:
+                continue  # targeted at a sibling replica
             if config is not _state.config \
+                    and config is not _state.replica_config \
                     and config is not _state.scoped.get(scope):
                 continue  # reconfigured mid-flight
             roll = _state.rng.random()
             delay_ms += config.latency_ms
+            hang_ms = max(hang_ms, config.hang_ms)
             if roll < config.drop_rate:
                 drop = True
             elif roll < config.drop_rate + config.error_rate:
                 error = config.error_rate
         if delay_ms:
             _state.delayed_requests += 1
+        if hang_ms:
+            _state.injected_hangs += 1
         if drop:
             _state.injected_drops += 1
         elif error is not None:
             _state.injected_errors += 1
     if delay_ms:
         time.sleep(delay_ms / 1000.0)
+    if hang_ms:
+        # Deterministic stall (no roll): the watchdog-catchable hang.
+        time.sleep(hang_ms / 1000.0)
     if drop:
         raise ChaosDropError()
     if error is not None:
@@ -333,40 +405,72 @@ class OverloadScenario:
 
 
 class DegradeOneScenario:
-    """Staged degradation of ONE replica in an in-process fleet: after
-    ``latency_after_s`` the victim's scope gets a latency spike (the
-    brown-out hedging is built for), after ``kill_after_s`` the victim
-    is hard-killed via the supplied callback (the outage failover is
-    built for). Either stage may be disabled (None).
+    """Staged degradation of ONE fault domain: after
+    ``latency_after_s`` the victim gets a latency spike (the brown-out
+    hedging is built for), after ``kill_after_s`` the victim is
+    hard-killed (the outage failover/ejection is built for), and —
+    replica mode only — after ``heal_after_s`` the fault clears so the
+    supervisor can canary-probe and readmit. Any stage may be disabled
+    (None).
+
+    Two victim addressing modes:
+
+    * **Fleet mode** (``scopes`` + ``kill_fns``): the victim is one
+      in-process server core named by its chaos scope; kill invokes
+      the matching callback (PR-4 endpoint failover).
+    * **Replica mode** (``replica="model:index"``): the victim is one
+      replica of an instance-group model; the spike/kill stages
+      install replica-targeted ChaosConfigs (kill = ``error_rate=1``,
+      or a deterministic ``hang_ms`` stall with ``kill_kind=hang`` so
+      the execution watchdog — not the breaker — must catch it). This
+      is the intra-host blast-radius scenario the replica chaos smoke
+      gates on: siblings and the front-of-house path stay clean.
 
     Spec string (perf ``--degrade-one``), comma-separated key=value:
-    ``latency_ms=200,latency_after_s=1,kill_after_s=3,victim=1``.
+    ``latency_ms=200,latency_after_s=1,kill_after_s=3,victim=1`` or
+    ``replica=simple:2,kill_after_s=2,heal_after_s=5``.
     Timings are relative to :meth:`start`.
     """
 
-    def __init__(self, scopes, kill_fns, latency_ms: float = 0.0,
+    def __init__(self, scopes=(), kill_fns=(), latency_ms: float = 0.0,
                  latency_after_s: Optional[float] = None,
                  kill_after_s: Optional[float] = None,
-                 victim: int = -1):
-        if len(scopes) != len(kill_fns):
-            raise ValueError("one kill_fn per scope required")
-        if not scopes:
-            raise ValueError("DegradeOneScenario needs at least one scope")
+                 victim: int = -1,
+                 replica: Optional[str] = None,
+                 kill_kind: str = "error",
+                 hang_ms: float = 10_000.0,
+                 heal_after_s: Optional[float] = None):
+        self.replica = str(replica) if replica else None
+        if self.replica is None:
+            if len(scopes) != len(kill_fns):
+                raise ValueError("one kill_fn per scope required")
+            if not scopes:
+                raise ValueError(
+                    "DegradeOneScenario needs at least one scope "
+                    "(or a replica= target)")
         self.scopes = list(scopes)
         self.kill_fns = list(kill_fns)
         self.latency_ms = float(latency_ms)
         self.latency_after_s = latency_after_s
         self.kill_after_s = kill_after_s
-        self.victim = victim % len(scopes)
+        self.heal_after_s = heal_after_s
+        self.victim = victim % len(scopes) if scopes else 0
+        if kill_kind not in ("error", "hang"):
+            raise ValueError("kill_kind must be 'error' or 'hang'")
+        self.kill_kind = kill_kind
+        self.hang_ms = float(hang_ms)
         self.killed = threading.Event()
         self.spiked = threading.Event()
+        self.healed = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     @classmethod
     def parse_spec(cls, spec: str) -> dict:
         """``"latency_ms=200,latency_after_s=1,kill_after_s=3,
-        victim=1"`` -> constructor kwargs; unknown keys fail loudly."""
+        victim=1"`` (fleet) or ``"replica=simple:2,kill_after_s=2,
+        kill_kind=hang,heal_after_s=5"`` (replica) -> constructor
+        kwargs; unknown keys fail loudly."""
         kwargs: dict = {}
         for part in (spec or "").split(","):
             part = part.strip()
@@ -377,10 +481,19 @@ class DegradeOneScenario:
                 raise ValueError(
                     "degrade-one spec entry '%s' is not key=value" % part)
             key = key.strip()
-            if key in ("latency_ms", "latency_after_s", "kill_after_s"):
+            if key in ("latency_ms", "latency_after_s", "kill_after_s",
+                       "heal_after_s", "hang_ms"):
                 kwargs[key] = float(value)
             elif key == "victim":
                 kwargs["victim"] = int(value)
+            elif key == "replica":
+                if ":" not in value:
+                    raise ValueError(
+                        "degrade-one replica target '%s' is not "
+                        "model:index" % value)
+                kwargs["replica"] = value
+            elif key == "kill_kind":
+                kwargs["kill_kind"] = value.strip().lower()
             else:
                 raise ValueError(
                     "unknown degrade-one spec key '%s'" % key)
@@ -401,6 +514,9 @@ class DegradeOneScenario:
                 return False
             return not self._stop.is_set()
 
+        if self.replica is not None:
+            self._run_replica(wait_until)
+            return
         scope = self.scopes[self.victim]
         if self.latency_after_s is not None and self.latency_ms > 0:
             if not wait_until(self.latency_after_s):
@@ -418,10 +534,44 @@ class DegradeOneScenario:
             finally:
                 self.killed.set()
 
+    def _run_replica(self, wait_until) -> None:
+        """Replica-mode stages: spike -> kill -> heal, each installed
+        in the dedicated replica-targeted chaos slot
+        (:func:`configure_replica`) so the scenario compounds with an
+        operator's global --chaos config instead of replacing it. Each
+        stage supersedes the previous one; faults fire only at the
+        victim replica's execution path (chaos.inject with replica_id;
+        siblings never roll)."""
+        target = self.replica
+        if self.latency_after_s is not None and self.latency_ms > 0:
+            if not wait_until(self.latency_after_s):
+                return
+            configure_replica(ChaosConfig(latency_ms=self.latency_ms,
+                                          replica=target))
+            self.spiked.set()
+        if self.kill_after_s is not None:
+            if not wait_until(self.kill_after_s):
+                return
+            if self.kill_kind == "hang":
+                configure_replica(ChaosConfig(hang_ms=self.hang_ms,
+                                              replica=target))
+            else:
+                configure_replica(ChaosConfig(error_rate=1.0,
+                                              replica=target))
+            self.killed.set()
+        if self.heal_after_s is not None:
+            if not wait_until(self.heal_after_s):
+                return
+            configure_replica(None)
+            self.healed.set()
+
     def stop(self) -> None:
-        """Cancel pending stages and clear the victim's scope (an
-        already-fired kill is not undone)."""
+        """Cancel pending stages and clear the victim's faults (a
+        fleet-mode kill already fired is not undone)."""
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
-        configure_scope(self.scopes[self.victim], None)
+        if self.replica is not None:
+            configure_replica(None)
+        else:
+            configure_scope(self.scopes[self.victim], None)

@@ -344,6 +344,13 @@ class InferenceServerCore:
         # (client_tpu.server.sequence), created lazily like batchers.
         self._sequencers: Dict[str, object] = {}
         self._sequencers_lock = threading.Lock()
+        # Replica sets (client_tpu.server.replicas), one per
+        # instance-group model, created lazily like batchers. The set's
+        # proxy becomes the execution target of the model's scheduler
+        # (batcher / sequencer / direct path), so every execution is
+        # health-routed across N per-device fault domains.
+        self._replica_sets: Dict[str, object] = {}
+        self._replica_lock = threading.Lock()
         self._trace_settings: Dict[str, Dict[str, list]] = {"": {
             "trace_file": [""], "trace_level": ["OFF"], "trace_rate": ["1000"],
             "trace_count": ["-1"], "log_frequency": ["0"],
@@ -370,7 +377,25 @@ class InferenceServerCore:
         return self.ready
 
     def model_ready(self, name: str, version: str = "") -> bool:
-        return self.repository.is_ready(name, version)
+        # Partial degradation keeps the model (and the server) ready:
+        # readiness only flips when EVERY replica of an instance-group
+        # model is ejected — one healthy fault domain still serves.
+        if not self.repository.is_ready(name, version):
+            return False
+        with self._replica_lock:
+            replica_set = self._replica_sets.get(name)
+        return replica_set is None or replica_set.healthy_count() > 0
+
+    def replica_health(self, name: str):
+        """(healthy, total) for an instance-group model whose replica
+        set is live, else None — the model-ready metadata both
+        front-ends expose (x-replica-healthy/-total headers on HTTP,
+        trailing metadata on gRPC)."""
+        with self._replica_lock:
+            replica_set = self._replica_sets.get(name)
+        if replica_set is None:
+            return None
+        return replica_set.healthy_count(), replica_set.count
 
     def server_metadata(self) -> pb.ServerMetadataResponse:
         return pb.ServerMetadataResponse(
@@ -462,6 +487,22 @@ class InferenceServerCore:
                 pipe.fetch_ns = snap["fetch_ns"]
                 pipe.overlap_ns = snap["overlap_ns"]
                 pipe.overlap_ratio = snap["overlap_ratio"]
+            with self._replica_lock:
+                replica_set = self._replica_sets.get(model.name)
+            if replica_set is not None:
+                snap = replica_set.snapshot()
+                stat.healthy_replicas = snap["healthy"]
+                stat.total_replicas = snap["count"]
+                for row in snap["replicas"]:
+                    stat.replica_stats.add(
+                        replica_index=row["index"],
+                        healthy=row["healthy"],
+                        request_count=row["requests"],
+                        failure_count=row["failures"],
+                        execution_count=row["execution_count"],
+                        exec_ns=row["exec_ns"],
+                        ejected_count=row["ejected_count"],
+                        readmitted_count=row["readmitted_count"])
             with self._sequencers_lock:
                 sequencer = self._sequencers.get(model.name)
             if sequencer is not None:
@@ -714,6 +755,51 @@ class InferenceServerCore:
                "Sequence slots reclaimed by the idle timeout "
                "(max_sequence_idle_microseconds)", reclaimed_rows)
 
+        healthy_rows, replica_total_rows = [], []
+        ejected_rows, readmitted_rows, redispatch_rows = [], [], []
+        exec_rows = []
+        with self._replica_lock:
+            replica_snapshot = dict(self._replica_sets)
+        for name, replica_set in sorted(replica_snapshot.items()):
+            try:
+                snap = replica_set.snapshot()
+            except Exception:  # noqa: BLE001 — metrics never take
+                continue  # the server down
+            label = '{model="%s"}' % name
+            healthy_rows.append("tpu_replica_healthy%s %d"
+                                % (label, snap["healthy"]))
+            replica_total_rows.append("tpu_replica_count%s %d"
+                                      % (label, snap["count"]))
+            ejected_rows.append("tpu_replica_ejected_total%s %d"
+                                % (label, snap["ejections"]))
+            readmitted_rows.append("tpu_replica_readmitted_total%s %d"
+                                   % (label, snap["readmissions"]))
+            redispatch_rows.append("tpu_replica_redispatch_total%s %d"
+                                   % (label, snap["redispatches"]))
+            for row in snap["replicas"]:
+                exec_rows.append(
+                    'tpu_replica_exec_us{model="%s",replica="%d"} %d'
+                    % (name, row["index"], row["exec_ns"] // 1000))
+        family("tpu_replica_healthy", "gauge",
+               "Healthy replicas (fault domains) currently in routing "
+               "per instance-group model", healthy_rows)
+        family("tpu_replica_count", "gauge",
+               "Configured replicas per instance-group model",
+               replica_total_rows)
+        family("tpu_replica_ejected_total", "counter",
+               "Replica ejections (watchdog trips + circuit-breaker "
+               "opens) per model", ejected_rows)
+        family("tpu_replica_readmitted_total", "counter",
+               "Replicas readmitted by the self-healing supervisor "
+               "after a re-initialize + canary probe", readmitted_rows)
+        family("tpu_replica_redispatch_total", "counter",
+               "Batches re-dispatched to a healthy sibling after a "
+               "replica failure (bounded: once per batch)",
+               redispatch_rows)
+        family("tpu_replica_exec_us", "counter",
+               "Cumulative successful execution time per replica",
+               exec_rows)
+
         used_rows, total_rows, util_rows = [], [], []
         try:
             import jax
@@ -921,6 +1007,13 @@ class InferenceServerCore:
             batcher = self._batchers.pop(name, None)
         if batcher is not None:
             batcher.stop()
+        # Replica sets drain AFTER the schedulers: the batcher's stop()
+        # executes its queued tail through the replica router, so the
+        # per-device queues must still be routing while it drains.
+        with self._replica_lock:
+            replica_set = self._replica_sets.pop(name, None)
+        if replica_set is not None:
+            replica_set.stop()
         with self._trace_lock:
             state = self._trace_state.get(name)
             if state is not None and state["buffer"]:
@@ -944,6 +1037,10 @@ class InferenceServerCore:
             batchers, self._batchers = dict(self._batchers), {}
         for batcher in batchers.values():
             batcher.stop()
+        with self._replica_lock:
+            replica_sets, self._replica_sets = dict(self._replica_sets), {}
+        for replica_set in replica_sets.values():
+            replica_set.stop()  # after batchers: they drain through it
         with self._trace_lock:
             for name, state in self._trace_state.items():
                 if state["buffer"]:
@@ -951,6 +1048,35 @@ class InferenceServerCore:
                         name, self._effective_trace_settings(name), state)
 
     # -- inference -------------------------------------------------------
+
+    def _replicas_for(self, model):
+        """Lazily creates the model's ReplicaSet (None when the model
+        declares no instance group). The repository's registered
+        factory instantiates the per-replica executables and re-
+        initializes an ejected replica's weights during self-healing;
+        the scope_fn threads this core's chaos scope into replica
+        executions so scoped AND replica-targeted faults land inside
+        the right fault domain."""
+        from client_tpu.server.replicas import ReplicaSet, wants_replicas
+
+        if not wants_replicas(model):
+            return None
+        with self._replica_lock:
+            replica_set = self._replica_sets.get(model.name)
+            if replica_set is None:
+                replica_set = ReplicaSet(
+                    model,
+                    factory=self.repository.factory(model.name),
+                    scope_fn=lambda: self.chaos_scope,
+                )
+                self._replica_sets[model.name] = replica_set
+            return replica_set
+
+    def _execution_target(self, model):
+        """Where this model's executions run: the ReplicaSet's routing
+        proxy for instance-group models, the model itself otherwise."""
+        replica_set = self._replicas_for(model)
+        return model if replica_set is None else replica_set.proxy
 
     def _batcher_for(self, model):
         """Lazily creates the model's dynamic batcher (None when the
@@ -968,6 +1094,7 @@ class InferenceServerCore:
                 stats = self._stats_for(model.name)
                 batcher = DynamicBatcher(
                     model,
+                    execution_target=self._execution_target(model),
                     max_queue_delay_us=int(
                         getattr(model, "max_queue_delay_us", 500)),
                     preferred_batch_sizes=list(
@@ -1023,6 +1150,7 @@ class InferenceServerCore:
                     # model's own dynamic batcher so concurrent
                     # sequences fuse (None for direct-only models).
                     batcher=self._batcher_for(model),
+                    execution_target=self._execution_target(model),
                     reject_hook=stats.record_rejected,
                     timeout_hook=stats.record_timeout,
                 )
@@ -1371,7 +1499,11 @@ class InferenceServerCore:
                 # leader bumps execution_count (Triton semantics).
                 executions = 1 if leader else 0
             else:
-                outputs = model.infer(inputs, params)
+                # Direct path: instance-group models route through the
+                # ReplicaSet proxy (health-routed dispatch + bounded
+                # re-dispatch); everything else executes in place.
+                outputs = self._execution_target(model).infer(
+                    inputs, params)
             t2 = time.monotonic_ns()
             # Span boundaries are CHAINED off single clock reads
             # (decode ends exactly where execute starts, etc.): two

@@ -91,9 +91,20 @@ class InferenceServicer(GRPCInferenceServiceServicer):
         return pb.ServerReadyResponse(ready=self._core.server_ready())
 
     def ModelReady(self, request, context):
-        return pb.ModelReadyResponse(
-            ready=self._core.model_ready(request.name, request.version)
-        )
+        ready = self._core.model_ready(request.name, request.version)
+        # Same partial-degradation metadata the HTTP ready route sends
+        # as x-replica-* headers: trailing metadata so clients can
+        # weight a degraded-but-ready instance-group model.
+        health = self._core.replica_health(request.name)
+        if health is not None:
+            try:
+                context.set_trailing_metadata((
+                    ("replica-healthy", str(health[0])),
+                    ("replica-total", str(health[1])),
+                ))
+            except Exception:  # noqa: BLE001 — metadata is advisory
+                pass
+        return pb.ModelReadyResponse(ready=ready)
 
     def ServerMetadata(self, request, context):
         return self._core.server_metadata()

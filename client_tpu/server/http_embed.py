@@ -132,8 +132,16 @@ def _ready(core, m, headers, body):
 
 @_route("GET", _MODEL + r"/ready")
 def _model_ready(core, m, headers, body):
-    ready = core.model_ready(m.group("model"), m.group("version") or "")
-    return (200 if ready else 400), {}, b""
+    name = m.group("model")
+    ready = core.model_ready(name, m.group("version") or "")
+    # Parity with the aiohttp front-end: instance-group models expose
+    # partial-degradation metadata on the ready probe.
+    extra = {}
+    health = core.replica_health(name)
+    if health is not None:
+        extra["x-replica-healthy"] = str(health[0])
+        extra["x-replica-total"] = str(health[1])
+    return (200 if ready else 400), extra, b""
 
 
 @_route("GET", r"/metrics")

@@ -90,10 +90,20 @@ def build_http_app(core: InferenceServerCore) -> web.Application:
     @routes.get("/v2/models/{model}/ready")
     @routes.get("/v2/models/{model}/versions/{version}/ready")
     async def model_ready(request):
+        name = request.match_info["model"]
         ready = core.model_ready(
-            request.match_info["model"], request.match_info.get("version", "")
+            name, request.match_info.get("version", "")
         )
-        return web.Response(status=200 if ready else 400)
+        # Replica-serving models expose partial-degradation metadata:
+        # the model stays ready while >=1 replica is healthy, and a
+        # load balancer can weight by x-replica-healthy/-total without
+        # a statistics round trip.
+        headers = {}
+        health = core.replica_health(name)
+        if health is not None:
+            headers["x-replica-healthy"] = str(health[0])
+            headers["x-replica-total"] = str(health[1])
+        return web.Response(status=200 if ready else 400, headers=headers)
 
     @routes.get("/metrics")
     async def metrics(request):

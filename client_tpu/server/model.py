@@ -115,6 +115,24 @@ class ServedModel:
     # a SERVER-level knob (cache_size); decoupled models and sequence
     # requests always bypass.
     response_cache: bool = False
+    # Replica serving (client_tpu.server.replicas): instance_group
+    # declares N per-device replicas of this model behind an
+    # in-process health-routed router — each replica its own
+    # executable on its own serialized device queue and its own fault
+    # domain (watchdog ejection, per-replica circuit breaker, bounded
+    # once re-dispatch, supervisor self-healing). 0 (default) keeps
+    # the legacy direct path; 1 engages the layer with a single fault
+    # domain. instance_group_kind is KIND_AUTO/KIND_CPU/KIND_TPU
+    # rendered in ModelConfig.instance_group.
+    # replica_watchdog_us bounds one execution (0 = 5s default);
+    # replica_failure_threshold consecutive failures eject a replica;
+    # replica_recovery_s paces the breaker reset and the supervisor's
+    # re-initialize + canary probe.
+    instance_group_count: int = 0
+    instance_group_kind: str = "auto"
+    replica_watchdog_us: int = 0
+    replica_failure_threshold: int = 0
+    replica_recovery_s: float = 0.0
     sequence_batching: bool = False
     sequence_strategy: str = "direct"
     max_candidate_sequences: int = 0
@@ -198,6 +216,15 @@ class ServedModel:
         config.model_transaction_policy.decoupled = self.decoupled
         if self.response_cache:
             config.response_cache.enable = True
+        if self.instance_group_count > 0:
+            kind = {
+                "cpu": mc.ModelInstanceConfig.KIND_CPU,
+                "tpu": mc.ModelInstanceConfig.KIND_TPU,
+            }.get(str(self.instance_group_kind).lower(),
+                  mc.ModelInstanceConfig.KIND_AUTO)
+            config.instance_group.add(
+                name="%s_0" % self.name, kind=kind,
+                count=self.instance_group_count)
         if self.dynamic_batching:
             config.dynamic_batching.preferred_batch_size.extend(
                 self.preferred_batch_sizes)

@@ -1,0 +1,599 @@
+"""In-process replica serving: per-device fault domains with
+health-routed dispatch and automatic recovery.
+
+A model that declares an ``instance_group`` (count N) is served by N
+:class:`_Replica` instances — each one its own model executable on its
+own single-threaded device queue — behind a :class:`ReplicaSet` router
+that sits between the PR-1 dynamic batcher and execution. The router
+is the in-process twin of the PR-4 :class:`~client_tpu.robust.
+EndpointPool`: the same least-expected-completion-time score
+(``(outstanding + 1) * EWMA latency``), the same per-target
+:class:`~client_tpu.robust.CircuitBreaker`, the same sticky sequence
+routing — applied to devices inside one server instead of endpoints
+across servers.
+
+Each replica is a **fault domain**:
+
+* **Watchdog.** Every execution is bounded by the model's
+  ``replica_watchdog_us`` deadline. A replica that blows it is marked
+  UNHEALTHY immediately (a hung device queue would otherwise wedge
+  every batch routed to it) and the waiting batch is re-dispatched to
+  a healthy sibling. The stuck worker thread is abandoned — its
+  executor is replaced wholesale at recovery, never joined.
+* **Circuit breaker.** Execution failures settle the replica's
+  breaker exactly like endpoint failures settle the pool's (definitive
+  client errors count as health, see :func:`~client_tpu.robust.
+  _breaker_resolve`); repeated failures open it and eject the replica
+  from routing.
+* **Bounded re-dispatch.** A batch that fails on one replica is
+  re-dispatched to a healthy sibling exactly ONCE — masking a
+  single-replica fault costs one extra execution, never a retry storm.
+  Deterministic client errors (bad shapes and friends) are never
+  re-dispatched: the sibling would fail them identically.
+* **Supervisor self-healing.** A background thread watches unhealthy
+  replicas, re-initializes an ejected replica's executable and weights
+  (a fresh instance from the model factory, on a fresh device-queue
+  thread), half-open-probes it with a canary execution through the
+  full fault-injection path, and readmits it on success — so a
+  recovered replica is found by the supervisor, not by sacrificial
+  traffic.
+
+Sequence slots pin sticky to a replica until that replica is ejected
+(implicit per-sequence state is replica-local), mirroring EndpointPool
+sequence stickiness.
+
+Replica-targeted chaos (``replica=model:index`` + the ``hang_ms``
+fault kind in :mod:`client_tpu.server.chaos`) injects faults into
+exactly one replica's execution path — the blast-radius scenario the
+CI replica smoke gates on.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FuturesTimeout
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from client_tpu.robust import CLIENT_ERROR_STATUSES, CircuitBreaker
+from client_tpu.server import chaos
+from client_tpu.utils import InferenceServerException, triton_to_np_dtype
+
+_LOG = logging.getLogger("client_tpu.server.replicas")
+
+# Per-execution watchdog when the model doesn't set
+# replica_watchdog_us: generous enough for any sane CPU-sim execution,
+# tight enough that a hung replica costs seconds, not a drain timeout.
+DEFAULT_WATCHDOG_US = 5_000_000
+# Consecutive execution failures before the breaker ejects a replica.
+DEFAULT_FAILURE_THRESHOLD = 3
+# Breaker reset timeout AND the supervisor's probe pace: how long an
+# ejected replica rests before the supervisor re-initializes and
+# canary-probes it.
+DEFAULT_RECOVERY_S = 1.0
+# Every Nth routed execution round-robins the healthy candidates
+# instead of taking the least-expected-completion-time minimum (the
+# in-process, deterministic form of EndpointPool's 2% exploration):
+# keeps every replica's EWMA fresh so one slow cold execution cannot
+# starve a fault domain out of the rotation.
+EXPLORE_EVERY = 16
+
+
+def wants_replicas(model) -> bool:
+    """A model opts into replica serving by declaring an instance
+    group (``instance_group_count >= 1``). Count 1 still engages the
+    layer — one fault domain with a watchdog and self-healing — while
+    0 (the default) keeps the legacy direct path."""
+    return int(getattr(model, "instance_group_count", 0) or 0) >= 1
+
+
+class _Replica:
+    """One fault domain: its own model executable on its own
+    single-threaded device queue (executions on one replica are
+    serialized, mirroring a device that runs one program at a time;
+    executions on distinct replicas are concurrent).
+
+    Mutable routing fields (outstanding / EWMA / counters) are guarded
+    by the SET's lock — routing reads the whole fleet atomically, like
+    EndpointPool. The breaker has its own lock."""
+
+    __slots__ = ("index", "model", "executor", "breaker", "hung",
+                 "outstanding", "ewma_latency_s", "requests", "failures",
+                 "execution_count", "exec_ns", "ejected_count",
+                 "readmitted_count", "generation")
+
+    def __init__(self, index: int, model, breaker: CircuitBreaker):
+        self.index = index
+        self.model = model
+        self.breaker = breaker
+        self.executor: Optional[ThreadPoolExecutor] = None
+        # Watchdog verdict: the replica's device queue stopped
+        # answering. Distinct from the breaker (which needs repeated
+        # failures) because a hang gives no per-request failure signal
+        # to accumulate — one blown deadline is the whole story.
+        self.hung = False
+        self.outstanding = 0
+        self.ewma_latency_s = 0.0
+        self.requests = 0
+        self.failures = 0
+        self.execution_count = 0
+        self.exec_ns = 0
+        self.ejected_count = 0
+        self.readmitted_count = 0
+        # Bumped at every re-initialization so thread names identify
+        # the CURRENT device queue in a stack dump (abandoned hung
+        # threads keep their old generation's name).
+        self.generation = 0
+
+    def healthy(self) -> bool:
+        return not self.hung and self.breaker.state == CircuitBreaker.CLOSED
+
+
+class ReplicatedModel:
+    """Thin execution proxy handed to the schedulers in place of the
+    base model: attribute reads delegate to the base model (config
+    knobs, tensor specs), ``infer`` routes through the ReplicaSet.
+    Only ever used as an execution target — the core keeps operating
+    on the base model for metadata/config/stats."""
+
+    def __init__(self, replica_set: "ReplicaSet"):
+        self._set = replica_set
+        self._base = replica_set.base
+
+    def __getattr__(self, name):
+        return getattr(self._base, name)
+
+    def infer(self, inputs, parameters: Optional[dict] = None):
+        # Sticky sequence routing rides the parameters: a sequence_id
+        # pins the sequence's steps to one replica until it is ejected
+        # (see ReplicaSet.infer).
+        return self._set.infer(inputs, parameters)
+
+
+class ReplicaSet:
+    """N per-device replicas of one model plus the health-routed
+    router, watchdog, and self-healing supervisor described in the
+    module docstring.
+
+    ``factory`` re-instantiates the model for replicas 1..N-1 and for
+    supervisor re-initialization; when it is missing (or degenerately
+    returns the same instance — a repository entry registered with
+    ``add_model``'s resurrection lambda), the replicas share the base
+    executable: fault isolation degrades to per-replica device queues
+    and watchdogs, and re-initialization only replaces the queue
+    thread, not the weights."""
+
+    def __init__(self, model, factory: Optional[Callable] = None,
+                 count: Optional[int] = None,
+                 watchdog_us: Optional[int] = None,
+                 failure_threshold: Optional[int] = None,
+                 recovery_s: Optional[float] = None,
+                 scope_fn: Optional[Callable[[], Optional[str]]] = None):
+        self.base = model
+        self.name = str(getattr(model, "name", "model"))
+        self._factory = factory
+        count = int(count if count is not None
+                    else getattr(model, "instance_group_count", 0) or 1)
+        self.count = max(count, 1)
+        watchdog_us = int(watchdog_us if watchdog_us is not None
+                          else getattr(model, "replica_watchdog_us", 0) or 0)
+        self._watchdog_s = (watchdog_us or DEFAULT_WATCHDOG_US) / 1e6
+        self._failure_threshold = int(
+            failure_threshold if failure_threshold is not None
+            else getattr(model, "replica_failure_threshold", 0)
+            or DEFAULT_FAILURE_THRESHOLD)
+        self._recovery_s = float(
+            recovery_s if recovery_s is not None
+            else getattr(model, "replica_recovery_s", 0)
+            or DEFAULT_RECOVERY_S)
+        # Chaos scope of the owning core, read per execution so an
+        # in-process fleet's scoped faults reach replica executions.
+        self._scope_fn = scope_fn
+        self._lock = threading.Lock()
+        self._sticky: Dict[object, int] = {}
+        # Exploration counter (EndpointPool's 2% random exploration,
+        # made deterministic): every EXPLORE_EVERYth routed execution
+        # round-robins the healthy candidates instead of taking the
+        # min score, so a replica whose EWMA was seeded by one slow
+        # cold execution is periodically re-measured instead of
+        # starved forever.
+        self._route_count = 0
+        # Set-level counters (the tpu_replica_* Prometheus families).
+        self.ejections = 0
+        self.readmissions = 0
+        self.redispatches = 0
+        self.watchdog_trips = 0
+        self.probes = 0
+        self.replicas: List[_Replica] = []
+        for index in range(self.count):
+            instance = model if index == 0 else self._new_instance()
+            replica = _Replica(index, instance, CircuitBreaker(
+                failure_threshold=self._failure_threshold,
+                reset_timeout_s=self._recovery_s))
+            self._start_queue(replica)
+            self.replicas.append(replica)
+        self.proxy = ReplicatedModel(self)
+        self._stopping = False
+        self._stop = threading.Event()
+        # Supervisor pace: a fraction of the recovery timeout so a
+        # replica is probed soon after its breaker's rest expires.
+        self._supervisor = threading.Thread(
+            target=self._supervise, daemon=True,
+            name="replica-supervisor-%s" % self.name)
+        self._supervisor.start()
+
+    # -- construction / teardown ----------------------------------------
+
+    def _new_instance(self):
+        """A fresh executable+weights, or the shared base when no real
+        factory exists (see class docstring)."""
+        if self._factory is None:
+            return self.base
+        try:
+            instance = self._factory()
+        except Exception as e:  # noqa: BLE001 — degrade, don't die
+            _LOG.warning("replica factory for '%s' failed (%s); "
+                         "sharing the base executable", self.name, e)
+            return self.base
+        if instance is None:
+            return self.base
+        if instance is not self.base:
+            # Compile/warm the fresh executable BEFORE it enters
+            # routing so the first routed request doesn't eat a cold
+            # jit under the execution watchdog.
+            try:
+                warmup = getattr(instance, "warmup", None)
+                if callable(warmup):
+                    warmup()
+            except Exception:  # noqa: BLE001 — serving will judge it
+                pass
+        return instance
+
+    def _start_queue(self, replica: _Replica) -> None:
+        """(Re)creates the replica's single-threaded device queue."""
+        replica.generation += 1
+        replica.executor = ThreadPoolExecutor(
+            max_workers=1,
+            thread_name_prefix="replica-%s-%d-g%d"
+            % (self.name, replica.index, replica.generation))
+
+    def stop(self) -> None:
+        """Drain for unload/shutdown: stop the supervisor, then shut
+        the device queues down after their in-flight executions
+        finish (hung queues are abandoned, not joined)."""
+        with self._lock:
+            self._stopping = True
+        self._stop.set()
+        self._supervisor.join(timeout=5)
+        for replica in self.replicas:
+            executor = replica.executor
+            if executor is not None:
+                # A hung replica's worker can never finish: wait only
+                # for healthy queues, abandon the rest.
+                executor.shutdown(wait=not replica.hung)
+
+    # -- routing ---------------------------------------------------------
+
+    @staticmethod
+    def _score(replica: _Replica) -> float:
+        """Least expected completion time — the EndpointPool routing
+        math, in-process: queue depth x per-execution latency, so a
+        degraded-but-alive replica sheds work before it fails any."""
+        return (replica.outstanding + 1) * max(replica.ewma_latency_s, 1e-6)
+
+    def _pick(self, exclude=(), sticky_key=None) -> _Replica:
+        """Routes one execution (raises UNAVAILABLE when every replica
+        is ejected). Sticky keys pin to their replica while it stays
+        healthy; an ejected pin is re-routed (and re-pinned) to the
+        best healthy sibling."""
+        with self._lock:
+            if self._stopping:
+                raise InferenceServerException(
+                    "model '%s' is draining its replicas" % self.name,
+                    status="UNAVAILABLE")
+            if sticky_key is not None:
+                pinned = self._sticky.get(sticky_key)
+                if pinned is not None and pinned not in exclude:
+                    replica = self.replicas[pinned]
+                    if replica.healthy():
+                        return replica
+            candidates = [r for r in self.replicas
+                          if r.index not in exclude and r.healthy()]
+            if not candidates:
+                raise InferenceServerException(
+                    "no healthy replica for model '%s' (%d of %d "
+                    "ejected%s)"
+                    % (self.name,
+                       sum(1 for r in self.replicas if not r.healthy()),
+                       self.count,
+                       ", %d excluded" % len(exclude) if exclude else ""),
+                    status="UNAVAILABLE")
+            self._route_count += 1
+            if self._route_count % EXPLORE_EVERY == 0:
+                replica = candidates[
+                    (self._route_count // EXPLORE_EVERY)
+                    % len(candidates)]
+            else:
+                replica = min(candidates, key=self._score)
+            if sticky_key is not None:
+                self._sticky[sticky_key] = replica.index
+            return replica
+
+    def release_sticky(self, sticky_key) -> None:
+        with self._lock:
+            self._sticky.pop(sticky_key, None)
+
+    def sticky_replica(self, sticky_key) -> Optional[int]:
+        with self._lock:
+            return self._sticky.get(sticky_key)
+
+    # -- execution -------------------------------------------------------
+
+    def infer(self, inputs, parameters: Optional[dict] = None,
+              sticky_key=None) -> Dict[str, np.ndarray]:
+        """Routes one execution (a request or a fused batch) to the
+        best healthy replica; on failure, re-dispatches to a healthy
+        sibling exactly once. Sequence-correlated requests derive a
+        sticky key from their ``sequence_id`` parameter when the
+        caller didn't pass one explicitly."""
+        if sticky_key is None and parameters:
+            sticky_key = parameters.get("sequence_id") or None
+        replica = self._pick(sticky_key=sticky_key)
+        try:
+            outputs = self._execute(replica, inputs, parameters)
+        except InferenceServerException as first:
+            if (first.status() or "") in CLIENT_ERROR_STATUSES:
+                raise  # deterministic: a sibling fails it identically
+            try:
+                sibling = self._pick(exclude={replica.index},
+                                     sticky_key=sticky_key)
+            except InferenceServerException:
+                raise first
+            with self._lock:
+                self.redispatches += 1
+            _LOG.debug("re-dispatching batch for '%s' from replica %d "
+                       "to %d: %s", self.name, replica.index,
+                       sibling.index, first)
+            outputs = self._execute(sibling, inputs, parameters)
+        # Mirror EndpointPool stickiness lifecycle: the pin is held for
+        # the sequence's lifetime and released on its final step so a
+        # long-lived server doesn't accrete dead pins.
+        if sticky_key is not None and parameters \
+                and parameters.get("sequence_end"):
+            self.release_sticky(sticky_key)
+        return outputs
+
+    def _run_on(self, replica: _Replica, inputs,
+                parameters: Optional[dict]):
+        """Body of one device-queue execution. Chaos injection runs
+        HERE — inside the fault domain — so replica-targeted faults
+        (``replica=model:index``, ``hang_ms``) degrade exactly one
+        replica; request-level faults stay at the core's inject."""
+        chaos.inject(self.name,
+                     scope=self._scope_fn() if self._scope_fn else None,
+                     replica_id="%s:%d" % (self.name, replica.index))
+        return replica.model.infer(inputs, parameters)
+
+    def _execute(self, replica: _Replica, inputs,
+                 parameters: Optional[dict]) -> Dict[str, np.ndarray]:
+        with self._lock:
+            # The watchdog budget covers THIS execution plus everything
+            # already queued ahead of it on the replica's single-thread
+            # device queue: a loaded-but-healthy replica gets one
+            # watchdog period per queued predecessor, so sustained load
+            # can never masquerade as a hang — while a genuinely hung
+            # replica still trips its FIRST waiter after exactly one
+            # period.
+            queued_ahead = replica.outstanding
+            replica.outstanding += 1
+            replica.requests += 1
+            executor = replica.executor
+        t0 = time.monotonic_ns()
+        try:
+            future = executor.submit(self._run_on, replica, inputs,
+                                     parameters)
+        except RuntimeError:  # queue torn down by a concurrent heal
+            with self._lock:
+                replica.outstanding = max(replica.outstanding - 1, 0)
+            raise InferenceServerException(
+                "replica %s:%d is re-initializing"
+                % (self.name, replica.index), status="UNAVAILABLE")
+        try:
+            outputs = future.result(
+                timeout=self._watchdog_s * (queued_ahead + 1))
+        except FuturesTimeout:
+            self._mark_hung(replica)
+            raise InferenceServerException(
+                "replica %s:%d blew its %dms execution watchdog "
+                "(marked unhealthy)"
+                % (self.name, replica.index,
+                   int(self._watchdog_s * 1000)),
+                status="UNAVAILABLE")
+        except BaseException as e:
+            self._note_failure(replica, e)
+            if isinstance(e, InferenceServerException):
+                raise
+            raise InferenceServerException(
+                "replica %s:%d execution failed: %s"
+                % (self.name, replica.index, e), status="INTERNAL")
+        latency_ns = time.monotonic_ns() - t0
+        self._note_success(replica, latency_ns)
+        return outputs
+
+    # -- health bookkeeping ----------------------------------------------
+
+    def _note_success(self, replica: _Replica, latency_ns: int) -> None:
+        replica.breaker.record_success()
+        with self._lock:
+            replica.outstanding = max(replica.outstanding - 1, 0)
+            replica.execution_count += 1
+            replica.exec_ns += latency_ns
+            latency_s = latency_ns / 1e9
+            replica.ewma_latency_s = (
+                latency_s if replica.ewma_latency_s == 0.0
+                else 0.2 * latency_s + 0.8 * replica.ewma_latency_s)
+
+    def _note_failure(self, replica: _Replica,
+                      error: BaseException) -> None:
+        from client_tpu.robust import _breaker_resolve
+
+        was_healthy = replica.healthy()
+        _breaker_resolve(replica.breaker, error)
+        with self._lock:
+            replica.outstanding = max(replica.outstanding - 1, 0)
+            replica.failures += 1
+            if was_healthy and not replica.healthy():
+                replica.ejected_count += 1
+                self.ejections += 1
+                _LOG.warning("replica %s:%d ejected (breaker open "
+                             "after repeated execution failures)",
+                             self.name, replica.index)
+
+    def _mark_hung(self, replica: _Replica) -> None:
+        replica.breaker.record_failure()  # availability evidence too
+        with self._lock:
+            replica.outstanding = max(replica.outstanding - 1, 0)
+            replica.failures += 1
+            self.watchdog_trips += 1
+            if not replica.hung:
+                replica.hung = True
+                replica.ejected_count += 1
+                self.ejections += 1
+                _LOG.warning("replica %s:%d marked unhealthy "
+                             "(watchdog)", self.name, replica.index)
+
+    # -- supervisor (self-healing) ---------------------------------------
+
+    def _supervise(self) -> None:
+        interval = max(min(self._recovery_s / 2.0, 0.5), 0.05)
+        while not self._stop.wait(interval):
+            for replica in self.replicas:
+                if self._stop.is_set():
+                    return
+                if replica.healthy():
+                    continue
+                # Respect the breaker's rest period whether or not the
+                # replica is hung: probing (and rebuilding) faster than
+                # the recovery pace gathers no new evidence. A hung
+                # replica whose breaker is still CLOSED (first watchdog
+                # trip) probes immediately.
+                if replica.breaker.state != CircuitBreaker.CLOSED \
+                        and not replica.breaker.admits():
+                    continue
+                self._heal(replica)
+
+    def _heal(self, replica: _Replica) -> None:
+        """Re-initialize + canary-probe one unhealthy replica. The
+        half-open probe slot is claimed FIRST so a resting breaker
+        never costs a factory re-instantiation per supervisor tick;
+        the fresh executable is then built BEFORE the probe so a
+        poisoned weight state cannot pass the canary, and the canary
+        runs through the full execution path (chaos included) so a
+        replica whose fault is still active stays ejected."""
+        breaker = replica.breaker
+        if breaker.state != CircuitBreaker.CLOSED:
+            try:
+                breaker.before_call()  # claim the half-open probe slot
+            except InferenceServerException:
+                return
+        self._reinitialize(replica)
+        with self._lock:
+            self.probes += 1
+        try:
+            future = replica.executor.submit(
+                self._run_on, replica, self._canary_inputs(), {})
+            future.result(timeout=self._watchdog_s)
+            ok = True
+        except Exception:  # noqa: BLE001 — any canary failure = not yet
+            ok = False
+        if ok:
+            breaker.record_success()
+            with self._lock:
+                replica.hung = False
+                replica.readmitted_count += 1
+                self.readmissions += 1
+            _LOG.warning("replica %s:%d readmitted (canary passed "
+                         "after re-initialization)", self.name,
+                         replica.index)
+        else:
+            breaker.record_failure()
+
+    def _reinitialize(self, replica: _Replica) -> None:
+        """Fresh executable + weights on a fresh device-queue thread.
+        The old executor is abandoned (shutdown without waiting): a
+        hung worker can never be joined, and any work still queued on
+        it either finishes into the void or times out at its waiter's
+        watchdog and re-dispatches."""
+        old = replica.executor
+        instance = self._new_instance()  # warmed before routing
+        with self._lock:
+            replica.model = instance
+            self._start_queue(replica)
+        if old is not None:
+            old.shutdown(wait=False)
+
+    def _canary_inputs(self) -> Dict[str, np.ndarray]:
+        """Zero-valued inputs matching the model's declared signature
+        (batch 1; variable dims collapse to 1; BYTES rows get empty
+        payloads). Models with exotic signatures can override via a
+        ``make_canary_inputs()`` method."""
+        maker = getattr(self.base, "make_canary_inputs", None)
+        if callable(maker):
+            return maker()
+        inputs: Dict[str, np.ndarray] = {}
+        batched = int(getattr(self.base, "max_batch_size", 0)) > 0
+        for spec in self.base.inputs:
+            if getattr(spec, "optional", False):
+                continue
+            shape = [1 if int(d) < 0 else int(d) for d in spec.shape]
+            if batched:
+                shape = [1] + shape
+            if spec.datatype == "BYTES":
+                inputs[spec.name] = np.full(shape, b"", dtype=object)
+            else:
+                inputs[spec.name] = np.zeros(
+                    shape, dtype=triton_to_np_dtype(spec.datatype))
+        return inputs
+
+    # -- observability ----------------------------------------------------
+
+    def healthy_count(self) -> int:
+        with self._lock:
+            return sum(1 for r in self.replicas if r.healthy())
+
+    def snapshot(self) -> dict:
+        """Point-in-time health + cumulative counters (feeds the
+        ModelStatistics replica rows and the tpu_replica_* Prometheus
+        families)."""
+        with self._lock:
+            replicas = [
+                {
+                    "index": r.index,
+                    "healthy": r.healthy(),
+                    "hung": r.hung,
+                    "breaker": r.breaker.state,
+                    "outstanding": r.outstanding,
+                    "ewma_latency_ms": round(r.ewma_latency_s * 1000.0, 3),
+                    "requests": r.requests,
+                    "failures": r.failures,
+                    "execution_count": r.execution_count,
+                    "exec_ns": r.exec_ns,
+                    "ejected_count": r.ejected_count,
+                    "readmitted_count": r.readmitted_count,
+                }
+                for r in self.replicas
+            ]
+            return {
+                "count": self.count,
+                "healthy": sum(1 for r in self.replicas if r.healthy()),
+                "ejections": self.ejections,
+                "readmissions": self.readmissions,
+                "redispatches": self.redispatches,
+                "watchdog_trips": self.watchdog_trips,
+                "probes": self.probes,
+                "replicas": replicas,
+            }

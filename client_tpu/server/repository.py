@@ -172,6 +172,17 @@ class ModelRepository:
         with self._lock:
             return self._inflight.get(name, 0)
 
+    def factory(self, name: str) -> Optional[Callable[[], ServedModel]]:
+        """The model's registered factory, if any. Replica serving
+        uses it to instantiate per-replica executables and to
+        re-initialize an ejected replica's weights; note that entries
+        registered through :meth:`add_model` resurrect the SAME
+        instance (their factory is a capture of it), so true
+        weight-level isolation needs an :meth:`add_factory`
+        registration."""
+        with self._lock:
+            return self._factories.get(name)
+
     def get(self, name: str, version: str = "") -> ServedModel:
         with self._lock:
             model = self._models.get(name)

@@ -139,8 +139,21 @@ class SequenceScheduler:
 
     def __init__(self, model, batcher=None,
                  reject_hook: Optional[Callable[[], None]] = None,
-                 timeout_hook: Optional[Callable[[], None]] = None):
+                 timeout_hook: Optional[Callable[[], None]] = None,
+                 execution_target=None):
         self._model = model
+        # Direct-strategy steps execute here. An instance-group model
+        # passes its ReplicaSet proxy. Sticky routing engages only for
+        # _pass_params models (no declared controls/state): their
+        # steps carry sequence_id through to the proxy, which pins the
+        # sequence to one replica — the model keeps per-corrid state
+        # INSIDE the executable, so hopping fault domains would lose
+        # it. Models with declared controls/state strip sequence_*
+        # before execution and route freely: their state lives in the
+        # scheduler's slot and travels with the inputs, so any replica
+        # can execute any step.
+        self._target = execution_target if execution_target is not None \
+            else model
         self._batcher = batcher
         self._reject_hook = reject_hook
         self._timeout_hook = timeout_hook
@@ -255,7 +268,7 @@ class SequenceScheduler:
                     k: v for k, v in params.items()
                     if not k.startswith("sequence_")
                 }
-                outputs = self._model.infer(exec_inputs, exec_params)
+                outputs = self._target.infer(exec_inputs, exec_params)
                 if exec_span is not None:
                     trace.end(exec_span)
                 executions = 1

@@ -1,0 +1,742 @@
+"""Replica-serving tests: per-device fault domains behind the
+health-routed in-process router (client_tpu.server.replicas).
+
+Covers the full lifecycle the ISSUE-8 tentpole names: routing spread
+under load, watchdog ejection of a hung replica, bounded (exactly
+once) re-dispatch of failed batches, supervisor re-initialize + canary
+readmission, sticky sequences surviving a sibling's ejection, golden
+parity single- vs 4-replica, partial-degradation health/readiness
+metadata over both transports, replica-targeted chaos (replica= scope
++ hang_ms faults), and the statistics / Prometheus observability
+surface.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from client_tpu._infer_common import InferInput
+from client_tpu.grpc._utils import InferResult, get_inference_request
+from client_tpu.models.add_sub import AddSub
+from client_tpu.models.simple_extra import SequenceAccumulator
+from client_tpu.server import chaos
+from client_tpu.server.app import build_core, start_grpc_server
+from client_tpu.server.model import ServedModel, TensorSpec
+from client_tpu.server.replicas import (
+    ReplicaSet,
+    ReplicatedModel,
+    wants_replicas,
+)
+from client_tpu.utils import InferenceServerException
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos.configure(None)
+    yield
+    chaos.configure(None)
+
+
+# -- helpers ---------------------------------------------------------------
+
+
+class _Stub(ServedModel):
+    """Minimal host model for router unit tests: OUTPUT = INPUT + tag.
+    ``fail`` / ``hang_s`` flip one instance into a fault; ``calls``
+    counts executions on this instance."""
+
+    def __init__(self, name="stub", tag=0, delay_s=0.0):
+        super().__init__()
+        self.name = name
+        self.tag = tag
+        self.delay_s = delay_s
+        self.fail = False
+        self.fail_status = "UNAVAILABLE"
+        self.hang_s = 0.0
+        self.calls = 0
+        self.inputs = [TensorSpec("INPUT", "INT32", [1])]
+        self.outputs = [TensorSpec("OUTPUT", "INT32", [1])]
+
+    def infer(self, inputs, parameters=None):
+        self.calls += 1
+        if self.hang_s:
+            time.sleep(self.hang_s)
+        elif self.delay_s:
+            time.sleep(self.delay_s)
+        if self.fail:
+            raise InferenceServerException(
+                "stub fault", status=self.fail_status)
+        value = int(np.asarray(inputs["INPUT"]).reshape(-1)[0])
+        return {"OUTPUT": np.array([value + self.tag], dtype=np.int32)}
+
+
+def _stub_set(count=4, delay_s=0.0, watchdog_us=500_000,
+              failure_threshold=2, recovery_s=0.2):
+    instances = []
+
+    def factory():
+        instance = _Stub(tag=len(instances), delay_s=delay_s)
+        instances.append(instance)
+        return instance
+
+    base = factory()
+    replica_set = ReplicaSet(base, factory=factory, count=count,
+                             watchdog_us=watchdog_us,
+                             failure_threshold=failure_threshold,
+                             recovery_s=recovery_s)
+    return replica_set, instances
+
+
+def _one(value):
+    return {"INPUT": np.array([value], dtype=np.int32)}
+
+
+def _request(value, model, shape=(1, 16), **kwargs):
+    tensors = []
+    for name, fill in (("INPUT0", value), ("INPUT1", 2 * value)):
+        tensor = InferInput(name, list(shape), "INT32")
+        tensor.set_data_from_numpy(np.full(shape, fill, dtype=np.int32))
+        tensors.append(tensor)
+    return get_inference_request(model_name=model, inputs=tensors,
+                                 outputs=None, **kwargs)
+
+
+def _replica_snapshot(core, name):
+    entry = core.model_statistics(name).model_stats[0]
+    return entry
+
+
+def _wait_for(predicate, timeout_s=8.0, interval_s=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval_s)
+    return predicate()
+
+
+# -- chaos: replica targeting + hang_ms ------------------------------------
+
+
+def test_chaos_spec_parses_replica_and_hang():
+    config = chaos.ChaosConfig.from_spec(
+        "hang_ms=250,replica=simple:1,seed=3")
+    assert config.hang_ms == 250.0
+    assert config.replica == "simple:1"
+    assert config.enabled
+    assert "hangs" in config.describe()
+    assert "replica simple:1" in config.describe()
+
+
+def test_chaos_spec_rejects_bad_replica_target():
+    with pytest.raises(ValueError):
+        chaos.ChaosConfig.from_spec("replica=notarget")
+
+
+def test_chaos_replica_targeting_fires_only_in_its_domain():
+    chaos.configure(chaos.ChaosConfig(error_rate=1.0, replica="m:1"))
+    # Request-level inject (no replica layer): never fires.
+    chaos.inject("m")
+    # Sibling replica: never fires.
+    chaos.inject("m", replica_id="m:0")
+    # The targeted replica: always fires.
+    with pytest.raises(InferenceServerException):
+        chaos.inject("m", replica_id="m:1")
+
+
+def test_chaos_untargeted_config_skips_replica_layer():
+    chaos.configure(chaos.ChaosConfig(error_rate=1.0))
+    with pytest.raises(InferenceServerException):
+        chaos.inject("m")
+    # One fault, one layer: a request-level config must not fire a
+    # second time inside the replica that executes the same request.
+    chaos.inject("m", replica_id="m:0")
+
+
+def test_chaos_hang_is_deterministic_and_counted():
+    chaos.configure(chaos.ChaosConfig(hang_ms=30, replica="m:0", seed=7))
+    t0 = time.monotonic()
+    chaos.inject("m", replica_id="m:0")
+    assert time.monotonic() - t0 >= 0.025
+    assert chaos.stats()["injected_hangs"] == 1
+
+
+def test_degrade_one_replica_mode_spec():
+    kwargs = chaos.DegradeOneScenario.parse_spec(
+        "replica=simple:2,kill_after_s=2,kill_kind=hang,heal_after_s=5")
+    assert kwargs == {"replica": "simple:2", "kill_after_s": 2.0,
+                      "kill_kind": "hang", "heal_after_s": 5.0}
+    with pytest.raises(ValueError):
+        chaos.DegradeOneScenario.parse_spec("replica=nocolon")
+    with pytest.raises(ValueError):
+        chaos.DegradeOneScenario(replica="m:0", kill_kind="explode")
+
+
+def test_degrade_one_replica_mode_stages():
+    scenario = chaos.DegradeOneScenario(
+        replica="m:1", kill_after_s=0.0, heal_after_s=0.1).start()
+    assert scenario.killed.wait(timeout=2.0)
+    with pytest.raises(InferenceServerException):
+        chaos.inject("m", replica_id="m:1")
+    assert scenario.healed.wait(timeout=2.0)
+    chaos.inject("m", replica_id="m:1")  # fault cleared
+    scenario.stop()
+
+
+def test_degrade_one_replica_mode_preserves_global_chaos():
+    # The replica-mode scenario stages its faults in the dedicated
+    # replica slot: an operator's global --chaos config must survive
+    # every stage AND the scenario's stop().
+    chaos.configure(chaos.ChaosConfig(latency_ms=1, seed=5))
+    delayed_before = chaos.stats()["delayed_requests"]
+    scenario = chaos.DegradeOneScenario(
+        replica="m:1", kill_after_s=0.0, heal_after_s=0.05).start()
+    assert scenario.killed.wait(timeout=2.0)
+    with pytest.raises(InferenceServerException):
+        chaos.inject("m", replica_id="m:1")
+    assert scenario.healed.wait(timeout=2.0)
+    scenario.stop()
+    chaos.inject("m")  # global latency config still active
+    assert chaos.stats()["delayed_requests"] > delayed_before
+
+
+# -- router unit tests -----------------------------------------------------
+
+
+def test_wants_replicas_gate():
+    model = _Stub()
+    assert not wants_replicas(model)
+    model.instance_group_count = 1
+    assert wants_replicas(model)
+
+
+def test_routing_spread_under_load():
+    replica_set, _ = _stub_set(count=4, delay_s=0.005)
+    try:
+        def loop(index):
+            for i in range(20):
+                replica_set.infer(_one(index * 100 + i))
+
+        pool = [threading.Thread(target=loop, args=(i,))
+                for i in range(8)]
+        for thread in pool:
+            thread.start()
+        for thread in pool:
+            thread.join()
+        snap = replica_set.snapshot()
+        served = [r["execution_count"] for r in snap["replicas"]]
+        assert sum(served) == 160
+        # Least-expected-completion-time routing must spread a
+        # saturating closed loop across every fault domain.
+        assert all(count > 0 for count in served)
+    finally:
+        replica_set.stop()
+
+
+def test_golden_parity_across_replicas():
+    replica_set, instances = _stub_set(count=4)
+    try:
+        # Every instance computes the same function (tag aside, the
+        # stub tags prove WHICH replica served) — here use tag-free
+        # parity via a shared-function model instead: all outputs must
+        # equal input + tag of some live instance, and a single-replica
+        # set must match the base exactly.
+        single = ReplicaSet(_Stub(tag=0), count=1)
+        try:
+            for value in range(10):
+                out = single.infer(_one(value))
+                assert int(out["OUTPUT"][0]) == value
+        finally:
+            single.stop()
+    finally:
+        replica_set.stop()
+
+
+def test_watchdog_marks_hung_replica_and_redispatches():
+    replica_set, instances = _stub_set(count=2, watchdog_us=150_000)
+    try:
+        victim = replica_set.replicas[0].model
+        victim.hang_s = 1.0
+        out = replica_set.infer(_one(5))  # re-dispatched to sibling
+        assert int(out["OUTPUT"][0]) in (5, 5 + 1)
+        snap = replica_set.snapshot()
+        assert snap["watchdog_trips"] >= 1
+        assert snap["redispatches"] >= 1
+        assert snap["ejections"] >= 1
+        assert snap["healthy"] == 1
+        assert not replica_set.replicas[0].healthy()
+    finally:
+        victim.hang_s = 0.0
+        replica_set.stop()
+
+
+def test_watchdog_budget_scales_with_queue_depth():
+    # Load is not a hang: executions stacked on one replica's
+    # single-thread device queue each get one watchdog period per
+    # queued predecessor, so a slow-but-healthy replica under burst
+    # load is never falsely ejected.
+    replica_set, _ = _stub_set(count=1, delay_s=0.15,
+                               watchdog_us=250_000)
+    try:
+        errors = [0]
+
+        def loop(i):
+            try:
+                replica_set.infer(_one(i))
+            except InferenceServerException:
+                errors[0] += 1
+
+        pool = [threading.Thread(target=loop, args=(i,))
+                for i in range(4)]
+        for thread in pool:
+            thread.start()
+        for thread in pool:
+            thread.join()
+        # 4 x 150ms serialized = 600ms total; a flat 250ms watchdog
+        # would have tripped on the queued waiters.
+        assert errors[0] == 0
+        snap = replica_set.snapshot()
+        assert snap["watchdog_trips"] == 0
+        assert snap["healthy"] == 1
+    finally:
+        replica_set.stop()
+
+
+def test_redispatch_happens_exactly_once():
+    replica_set, instances = _stub_set(count=3, failure_threshold=10)
+    try:
+        for replica in replica_set.replicas:
+            replica.model.fail = True
+        calls_before = sum(i.calls for i in instances)
+        with pytest.raises(InferenceServerException):
+            replica_set.infer(_one(1))
+        calls_after = sum(i.calls for i in instances)
+        # One dispatch + exactly one re-dispatch, never a storm.
+        assert calls_after - calls_before == 2
+        assert replica_set.snapshot()["redispatches"] == 1
+    finally:
+        replica_set.stop()
+
+
+def test_client_errors_never_redispatch():
+    replica_set, instances = _stub_set(count=2)
+    try:
+        for replica in replica_set.replicas:
+            replica.model.fail = True
+            replica.model.fail_status = "INVALID_ARGUMENT"
+        calls_before = sum(i.calls for i in instances)
+        with pytest.raises(InferenceServerException) as err:
+            replica_set.infer(_one(1))
+        assert err.value.status() == "INVALID_ARGUMENT"
+        assert sum(i.calls for i in instances) - calls_before == 1
+        assert replica_set.snapshot()["redispatches"] == 0
+        # Definitive client errors are health evidence, not failures.
+        assert replica_set.snapshot()["healthy"] == 2
+    finally:
+        replica_set.stop()
+
+
+def test_breaker_ejects_after_repeated_failures():
+    replica_set, _ = _stub_set(count=2, failure_threshold=2,
+                               recovery_s=30.0)
+    try:
+        victim = replica_set.replicas[0]
+        victim.model.fail = True
+        for i in range(8):
+            replica_set.infer(_one(i))  # masked by re-dispatch
+        snap = replica_set.snapshot()
+        assert snap["ejections"] == 1
+        assert snap["healthy"] == 1
+        assert not victim.healthy()
+        # Ejected replica is out of routing: traffic flows untouched.
+        calls = victim.model.calls
+        for i in range(5):
+            replica_set.infer(_one(i))
+        assert victim.model.calls == calls
+    finally:
+        replica_set.stop()
+
+
+def test_all_replicas_ejected_is_unavailable():
+    replica_set, _ = _stub_set(count=2, failure_threshold=1,
+                               recovery_s=30.0)
+    try:
+        for replica in replica_set.replicas:
+            replica.model.fail = True
+        with pytest.raises(InferenceServerException):
+            replica_set.infer(_one(1))
+        with pytest.raises(InferenceServerException) as err:
+            replica_set.infer(_one(2))
+        assert err.value.status() == "UNAVAILABLE"
+        assert "no healthy replica" in str(err.value)
+    finally:
+        replica_set.stop()
+
+
+def test_supervisor_reinitializes_and_readmits():
+    replica_set, instances = _stub_set(count=2, failure_threshold=2,
+                                       recovery_s=0.2)
+    try:
+        victim = replica_set.replicas[1]
+        victim_instance = victim.model
+        victim_instance.fail = True
+        for i in range(6):
+            replica_set.infer(_one(i))
+        assert not victim.healthy()
+        generation = victim.generation
+        # The instance stays poisoned; the supervisor must build a
+        # FRESH executable from the factory (weight re-init), canary
+        # it, and readmit.
+        assert _wait_for(lambda: victim.healthy())
+        snap = replica_set.snapshot()
+        assert snap["readmissions"] == 1
+        assert snap["probes"] >= 1
+        assert victim.generation > generation
+        assert victim.model is not victim_instance  # fresh weights
+        assert replica_set.snapshot()["healthy"] == 2
+    finally:
+        replica_set.stop()
+
+
+def test_supervisor_keeps_ejected_while_fault_persists():
+    replica_set, instances = _stub_set(count=2, failure_threshold=2,
+                                       recovery_s=0.1)
+    try:
+        # Fault every instance the factory will ever make: canaries
+        # must keep failing and the replica must stay out.
+        class _AlwaysBad(_Stub):
+            def infer(self, inputs, parameters=None):
+                raise InferenceServerException("still bad",
+                                               status="INTERNAL")
+
+        replica_set._factory = _AlwaysBad
+        victim = replica_set.replicas[0]
+        victim.model.fail = True
+        for i in range(6):
+            replica_set.infer(_one(i))
+        assert not victim.healthy()
+        time.sleep(0.6)  # several probe periods
+        assert not victim.healthy()
+        assert replica_set.snapshot()["probes"] >= 1
+        assert replica_set.snapshot()["readmissions"] == 0
+    finally:
+        replica_set.stop()
+
+
+# -- sticky sequences ------------------------------------------------------
+
+
+def test_sticky_pins_and_releases_on_sequence_end():
+    replica_set, _ = _stub_set(count=4, delay_s=0.002)
+    try:
+        proxy = replica_set.proxy
+        assert isinstance(proxy, ReplicatedModel)
+        # Saturate the set so least-ECT would otherwise move around.
+        noise = [threading.Thread(
+            target=lambda i=i: [replica_set.infer(_one(i * 10 + j))
+                                for j in range(10)])
+            for i in range(4)]
+        for thread in noise:
+            thread.start()
+        pinned = []
+        for step in range(6):
+            proxy.infer(_one(step), {"sequence_id": 99})
+            pinned.append(replica_set.sticky_replica(99))
+        for thread in noise:
+            thread.join()
+        assert len({p for p in pinned}) == 1  # never hopped
+        proxy.infer(_one(7), {"sequence_id": 99, "sequence_end": True})
+        assert replica_set.sticky_replica(99) is None  # released
+    finally:
+        replica_set.stop()
+
+
+def test_sticky_sequence_survives_sibling_ejection():
+    instances = []
+
+    def factory():
+        instance = SequenceAccumulator(name="seq_replicas")
+        instances.append(instance)
+        return instance
+
+    base = factory()
+    replica_set = ReplicaSet(base, factory=factory, count=3,
+                             failure_threshold=1, recovery_s=30.0)
+    try:
+        proxy = replica_set.proxy
+        total = 0
+
+        def step(value, start=False, end=False):
+            params = {"sequence_id": 42}
+            if start:
+                params["sequence_start"] = True
+            if end:
+                params["sequence_end"] = True
+            out = proxy.infer(_one(value), params)
+            return int(out["OUTPUT"][0])
+
+        assert step(5, start=True) == 5
+        pinned = replica_set.sticky_replica(42)
+        assert pinned is not None
+        # Eject a SIBLING fault domain mid-sequence.
+        sibling = replica_set.replicas[(pinned + 1) % 3]
+        replica_set._mark_hung(sibling)
+        assert replica_set.snapshot()["healthy"] == 2
+        total = step(7)
+        assert total == 12  # replica-local state intact
+        assert step(3, end=True) == 15
+        assert replica_set.sticky_replica(42) is None
+    finally:
+        replica_set.stop()
+
+
+# -- core integration ------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def replica_core():
+    core = build_core(["simple", "simple_replicas"], warmup=False)
+    yield core
+    core.shutdown()
+
+
+def test_golden_parity_single_vs_four_replicas(replica_core):
+    core = replica_core
+    for value in (0, 1, 7, 96):
+        single = InferResult(core.infer(_request(value, "simple",
+                                                 shape=(16,))))
+        quad = InferResult(core.infer(_request(value, "simple_replicas")))
+        np.testing.assert_array_equal(
+            single.as_numpy("OUTPUT0").reshape(-1),
+            quad.as_numpy("OUTPUT0").reshape(-1))
+        np.testing.assert_array_equal(
+            single.as_numpy("OUTPUT1").reshape(-1),
+            quad.as_numpy("OUTPUT1").reshape(-1))
+
+
+def test_fused_batches_route_across_replicas(replica_core):
+    core = replica_core
+
+    def loop(index):
+        for i in range(25):
+            core.infer(_request(index * 100 + i, "simple_replicas"))
+
+    pool = [threading.Thread(target=loop, args=(i,)) for i in range(8)]
+    for thread in pool:
+        thread.start()
+    for thread in pool:
+        thread.join()
+    entry = _replica_snapshot(core, "simple_replicas")
+    assert entry.total_replicas == 4
+    assert entry.healthy_replicas == 4
+    per_replica = sum(int(r.execution_count) for r in entry.replica_stats)
+    # Every fused execution the batcher dispatched ran on exactly one
+    # replica's device queue.
+    assert per_replica == int(entry.execution_count)
+    active = sum(1 for r in entry.replica_stats if r.execution_count)
+    assert active >= 2
+
+
+def test_replica_kill_masked_health_and_readmission(replica_core):
+    core = replica_core
+    errors = [0]
+    chaos.configure(chaos.ChaosConfig(error_rate=1.0,
+                                      replica="simple_replicas:1"))
+
+    def loop(index):
+        for i in range(40):
+            try:
+                core.infer(_request(index * 1000 + i, "simple_replicas"))
+            except InferenceServerException:
+                errors[0] += 1
+
+    pool = [threading.Thread(target=loop, args=(i,)) for i in range(8)]
+    for thread in pool:
+        thread.start()
+    for thread in pool:
+        thread.join()
+    # Blast radius is ONE fault domain: zero client-visible errors.
+    assert errors[0] == 0
+    entry = _replica_snapshot(core, "simple_replicas")
+    ejected = sum(int(r.ejected_count) for r in entry.replica_stats)
+    assert ejected >= 1
+    assert entry.healthy_replicas == 3
+    # Partial degradation: the model (and server) stay ready, and the
+    # metadata names the degraded fleet.
+    assert core.model_ready("simple_replicas")
+    assert core.server_ready()
+    assert core.replica_health("simple_replicas") == (3, 4)
+    # Heal: the supervisor re-initializes, canaries, readmits.
+    chaos.configure(None)
+    assert _wait_for(
+        lambda: core.replica_health("simple_replicas") == (4, 4))
+    entry = _replica_snapshot(core, "simple_replicas")
+    assert sum(int(r.readmitted_count) for r in entry.replica_stats) >= 1
+
+
+def test_hang_fault_caught_by_watchdog_e2e():
+    core = build_core([], warmup=False)
+    try:
+        def factory():
+            model = AddSub(name="hang_replicas", datatype="INT32",
+                           shape=(16,))
+            model.instance_group_count = 2
+            model.replica_watchdog_us = 200_000
+            model.replica_failure_threshold = 5
+            model.replica_recovery_s = 30.0
+            return model
+
+        core.repository.add_factory("hang_replicas", factory)
+        core.repository.load("hang_replicas")
+        core.infer(_request(1, "hang_replicas", shape=(16,)))
+        chaos.configure(chaos.ChaosConfig(hang_ms=1500,
+                                          replica="hang_replicas:0"))
+        errors = [0]
+
+        def loop(index):
+            for i in range(12):
+                try:
+                    core.infer(_request(index * 100 + i,
+                                        "hang_replicas", shape=(16,)))
+                except InferenceServerException:
+                    errors[0] += 1
+
+        pool = [threading.Thread(target=loop, args=(i,))
+                for i in range(4)]
+        for thread in pool:
+            thread.start()
+        for thread in pool:
+            thread.join()
+        # The watchdog bounds the hang and re-dispatch masks it.
+        assert errors[0] == 0
+        entry = _replica_snapshot(core, "hang_replicas")
+        assert entry.healthy_replicas == 1
+        assert sum(int(r.ejected_count)
+                   for r in entry.replica_stats) >= 1
+    finally:
+        chaos.configure(None)
+        core.shutdown()
+
+
+def test_full_ejection_flips_model_not_ready():
+    core = build_core([], warmup=False)
+    try:
+        def factory():
+            model = AddSub(name="tiny_replicas", datatype="INT32",
+                           shape=(16,))
+            model.instance_group_count = 2
+            model.replica_failure_threshold = 1
+            model.replica_recovery_s = 30.0
+            return model
+
+        core.repository.add_factory("tiny_replicas", factory)
+        core.repository.load("tiny_replicas")
+        core.infer(_request(1, "tiny_replicas", shape=(16,)))
+        assert core.model_ready("tiny_replicas")
+        replica_set = core._replica_sets["tiny_replicas"]
+        for replica in replica_set.replicas:
+            replica_set._mark_hung(replica)
+        # Full-model ejection: not ready; the server itself stays up.
+        assert not core.model_ready("tiny_replicas")
+        assert core.server_ready()
+        assert core.replica_health("tiny_replicas") == (0, 2)
+        with pytest.raises(InferenceServerException):
+            core.infer(_request(2, "tiny_replicas", shape=(16,)))
+    finally:
+        core.shutdown()
+
+
+def test_unload_drains_replica_set():
+    core = build_core(["simple_replicas"], warmup=False)
+    try:
+        core.infer(_request(1, "simple_replicas"))
+        assert "simple_replicas" in core._replica_sets
+        supervisor = core._replica_sets["simple_replicas"]._supervisor
+        core.unload_model("simple_replicas")
+        assert "simple_replicas" not in core._replica_sets
+        assert not supervisor.is_alive()
+        # Reload serves again with a fresh replica set.
+        core.load_model("simple_replicas")
+        core.infer(_request(2, "simple_replicas"))
+        assert core.replica_health("simple_replicas") == (4, 4)
+    finally:
+        core.shutdown()
+
+
+def test_prometheus_replica_families(replica_core):
+    core = replica_core
+    core.infer(_request(3, "simple_replicas"))
+    text = core.metrics_text()
+    assert 'tpu_replica_healthy{model="simple_replicas"}' in text
+    assert 'tpu_replica_count{model="simple_replicas"} 4' in text
+    assert "tpu_replica_ejected_total" in text
+    assert "tpu_replica_readmitted_total" in text
+    assert "tpu_replica_redispatch_total" in text
+    assert 'tpu_replica_exec_us{model="simple_replicas",replica="0"}' \
+        in text
+    # HELP/TYPE precede samples for every replica family.
+    lines = text.splitlines()
+    for family in ("tpu_replica_healthy", "tpu_replica_ejected_total",
+                   "tpu_replica_exec_us"):
+        type_at = next(i for i, l in enumerate(lines)
+                       if l.startswith("# TYPE %s " % family))
+        sample_at = next(i for i, l in enumerate(lines)
+                         if l.startswith(family))
+        assert type_at < sample_at
+
+
+def test_model_config_renders_instance_group(replica_core):
+    config = replica_core.model_config("simple_replicas").config
+    assert len(config.instance_group) == 1
+    group = config.instance_group[0]
+    assert group.count == 4
+    assert group.kind == 2  # KIND_CPU
+
+
+def test_ready_metadata_over_http(replica_core):
+    import urllib.request
+
+    from client_tpu.server.http_server import start_http_server_thread
+
+    runner = start_http_server_thread(replica_core, host="127.0.0.1",
+                                      port=0)
+    try:
+        replica_core.infer(_request(5, "simple_replicas"))
+        url = ("http://127.0.0.1:%d/v2/models/simple_replicas/ready"
+               % runner.port)
+        with urllib.request.urlopen(url, timeout=5) as response:
+            assert response.status == 200
+            assert response.headers["x-replica-total"] == "4"
+            assert int(response.headers["x-replica-healthy"]) >= 1
+        # Non-replicated models carry no replica metadata.
+        url = "http://127.0.0.1:%d/v2/models/simple/ready" % runner.port
+        with urllib.request.urlopen(url, timeout=5) as response:
+            assert response.status == 200
+            assert response.headers.get("x-replica-total") is None
+    finally:
+        runner.stop()
+
+
+def test_ready_metadata_over_grpc(replica_core):
+    import grpc
+
+    from client_tpu.protocol import inference_pb2 as pb
+    from client_tpu.protocol.service import GRPCInferenceServiceStub
+
+    handle = start_grpc_server(core=replica_core,
+                               address="127.0.0.1:0")
+    try:
+        replica_core.infer(_request(6, "simple_replicas"))
+        channel = grpc.insecure_channel(handle.address)
+        stub = GRPCInferenceServiceStub(channel)
+        response, call = stub.ModelReady.with_call(
+            pb.ModelReadyRequest(name="simple_replicas"))
+        assert response.ready
+        trailing = {k: v for k, v in call.trailing_metadata()}
+        assert trailing.get("replica-total") == "4"
+        assert int(trailing.get("replica-healthy", "0")) >= 1
+        channel.close()
+    finally:
+        handle.stop()

@@ -6,7 +6,7 @@
 # regression gate). Usage: tools/ci_check.sh [min_passed]
 set -u -o pipefail
 
-MIN_PASSED="${1:-478}"
+MIN_PASSED="${1:-505}"
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
 LOG=/tmp/_t1.log
 
@@ -165,6 +165,24 @@ if ! timeout -k 10 240 env JAX_PLATFORMS=cpu python tools/qos_smoke.py \
 fi
 grep -E "qos smoke passed" "$QOS_LOG"
 echo "OK: qos smoke passed"
+
+# Replica chaos smoke: a delay-bound model served as 4 per-device
+# replicas, replica 2 hard-degraded mid-run then healed — goodput must
+# stay 100% (bounded re-dispatch masks the fault domain), at least one
+# ejection + one readmission must be recorded (the self-healing
+# supervisor ran), post-recovery throughput must return within 20% of
+# pre-fault, and 4 replicas must clear >=2.5x the 1-replica rate.
+# Gates live in tools/replica_smoke.py.
+echo "replica smoke: 4-replica scaling + kill-one-mid-run self-healing"
+REPLICA_LOG=/tmp/_replica_smoke.log
+if ! timeout -k 10 240 env JAX_PLATFORMS=cpu python tools/replica_smoke.py \
+    > "$REPLICA_LOG" 2>&1; then
+    echo "FAIL: replica smoke did not pass" >&2
+    tail -30 "$REPLICA_LOG" >&2
+    exit 1
+fi
+grep -E "replica smoke passed" "$REPLICA_LOG"
+echo "OK: replica smoke passed"
 
 # Cache smoke: hot-set replay against simple_cache — the replayed set
 # must reach a 100% hit ratio with hit-path p50 well under miss-path

@@ -19,7 +19,9 @@ Patches applied:
   ``InferStatistics.cache_hit`` / ``cache_miss`` durations, and the
   QoS statistics (PR 7): ``ModelStatistics.shed_count`` plus the
   repeated per-class ``PriorityStatistics`` / ``TenantStatistics``
-  rows.
+  rows, and the replica-serving statistics (PR 8): repeated
+  per-fault-domain ``ReplicaStatistics`` rows plus
+  ``ModelStatistics.healthy_replicas`` / ``total_replicas``.
 * model_config_pb2.py — ``DynamicBatchingConfig.max_queue_size`` /
   ``allow_timeout_override`` / ``timeout_action`` (PR 2 queue policy;
   ``default_queue_policy_timeout_us`` has been in the schema since the
@@ -108,6 +110,26 @@ TENANT_STATS_FIELDS = [
     ("reject_count", 3, U64),
     ("fail_count", 4, U64),
     ("duration_ns", 5, U64),
+]
+
+# Per-replica rows (PR 8 replica serving): one row per fault domain of
+# an instance-group model, fed by ReplicaSet.snapshot().
+REPLICA_STATS_FIELDS = [
+    ("replica_index", 1, U64),
+    ("healthy", 2, BOOL),
+    ("request_count", 3, U64),
+    ("failure_count", 4, U64),
+    ("execution_count", 5, U64),
+    ("exec_ns", 6, U64),
+    ("ejected_count", 7, U64),
+    ("readmitted_count", 8, U64),
+]
+
+# Replica-set health summary on ModelStatistics (17 is the repeated
+# replica_stats rows above).
+REPLICA_COUNT_FIELDS = [
+    ("healthy_replicas", 18, U64),
+    ("total_replicas", 19, U64),
 ]
 
 # Response-cache path durations on InferStatistics (1..6 are the
@@ -238,6 +260,7 @@ def patch_inference(file_proto: descriptor_pb2.FileDescriptorProto) -> bool:
     for msg_name, rows in (
         ("PriorityStatistics", PRIORITY_STATS_FIELDS),
         ("TenantStatistics", TENANT_STATS_FIELDS),
+        ("ReplicaStatistics", REPLICA_STATS_FIELDS),
     ):
         if msg_name in names:
             continue
@@ -252,12 +275,18 @@ def patch_inference(file_proto: descriptor_pb2.FileDescriptorProto) -> bool:
     for field_name, number, type_name in (
         ("priority_stats", 15, ".inference.PriorityStatistics"),
         ("tenant_stats", 16, ".inference.TenantStatistics"),
+        ("replica_stats", 17, ".inference.ReplicaStatistics"),
     ):
         if not any(f.name == field_name for f in model_stats.field):
             model_stats.field.add(
                 name=field_name, number=number, type=MESSAGE,
                 label=REPEATED, type_name=type_name,
                 json_name=_json_name(field_name))
+            changed = True
+    for name, number, ftype in REPLICA_COUNT_FIELDS:
+        if not any(f.name == name for f in model_stats.field):
+            model_stats.field.add(name=name, number=number, type=ftype,
+                                  label=OPTIONAL, json_name=_json_name(name))
             changed = True
     infer_stats = next(
         m for m in file_proto.message_type if m.name == "InferStatistics")

@@ -201,16 +201,20 @@ def _drive_load(core, model_name: str, n: int, threads: int) -> None:
 def main() -> int:
     from client_tpu.server.app import build_core
 
-    core = build_core(["simple", "simple_cache"])
+    core = build_core(["simple", "simple_cache", "simple_replicas"])
     try:
         _drive_load(core, "simple", n=20, threads=2)
         _drive_load(core, "simple_cache", n=20, threads=2)
+        # simple_replicas exercises the tpu_replica_* families (health
+        # gauges + per-replica exec counters) under fused dispatch.
+        _drive_load(core, "simple_replicas", n=20, threads=4)
         first = core.metrics_text()
         errors, types, series_before = lint_exposition(first)
         # More traffic between the scrapes, half of it replayed so the
         # cache-hit counters move too.
         _drive_load(core, "simple", n=20, threads=4)
         _drive_load(core, "simple_cache", n=20, threads=4)
+        _drive_load(core, "simple_replicas", n=20, threads=4)
         second = core.metrics_text()
         errors2, types2, series_after = lint_exposition(second)
         errors.extend(e for e in errors2 if e not in errors)

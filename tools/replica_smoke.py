@@ -1,0 +1,97 @@
+"""Replica-serving chaos smoke gate for tools/ci_check.sh.
+
+Runs the bench harness's replica measurement
+(client_tpu.perf.bench_child.run_replica_measure) against an
+in-process core: a delay-bound model served as 1 vs 4 per-device
+replicas under an identical closed loop, then replica 2 of 4
+hard-degraded mid-run via a replica-targeted DegradeOneScenario and
+healed so the supervisor readmits it. Gates on the ISSUE-8 acceptance
+criteria:
+
+* client-visible goodput is 100% while one replica is hard-degraded
+  (every in-flight failure re-dispatched to a healthy sibling, the
+  victim ejected from routing — the blast radius is one fault domain,
+  never a client error),
+* at least one ejection AND one readmission are recorded (the
+  self-healing supervisor actually ran: re-initialize + canary probe),
+* post-recovery throughput returns to within 20% of the pre-fault
+  rate, and
+* data-parallel scaling: >= 2.5x throughput at 4 replicas vs 1.
+
+The throughput-ratio gates divide two measurements on a shared,
+throttled CI box, so one retry is allowed; the correctness gates
+(goodput, ejection, readmission) must hold on every attempt.
+
+Usage: JAX_PLATFORMS=cpu python tools/replica_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+
+def run_once(attempt: int) -> tuple:
+    from client_tpu.perf.bench_child import run_replica_measure
+    from client_tpu.server.app import build_core
+
+    core = build_core([], warmup=False)
+    try:
+        result = run_replica_measure(
+            core, model_name="replica_smoke_%d_" % attempt)
+    finally:
+        core.shutdown()
+    print(json.dumps(result, indent=1))
+
+    hard, soft = [], []
+    if result.get("degrade_goodput_pct") != 100.0:
+        hard.append("goodput %.2f%% with one replica hard-degraded "
+                    "(want 100%%: re-dispatch must mask the fault)"
+                    % result.get("degrade_goodput_pct", 0.0))
+    if result.get("ejections", 0) < 1:
+        hard.append("no replica ejection recorded — the degraded "
+                    "replica was never removed from routing")
+    if result.get("readmissions", 0) < 1:
+        hard.append("no replica readmission recorded — the supervisor "
+                    "never healed the ejected replica")
+    scaling = result.get("scaling_4v1", 0.0)
+    if scaling < 2.5:
+        soft.append("throughput at 4 replicas is %.2fx the 1-replica "
+                    "rate (gate: 2.5x)" % scaling)
+    recovery = result.get("recovery_vs_prefault", 0.0)
+    if recovery < 0.8:
+        soft.append("post-readmission throughput is %.3fx the "
+                    "pre-fault rate (gate: within 20%%)" % recovery)
+    return result, hard, soft
+
+
+def main() -> int:
+    for attempt in range(2):
+        result, hard, soft = run_once(attempt)
+        for failure in hard:
+            print("FAIL: %s" % failure, file=sys.stderr)
+        if hard:
+            return 1
+        if not soft:
+            print("replica smoke passed: %.2fx scaling at 4 replicas, "
+                  "100%% goodput through a hard-degraded replica "
+                  "(%d ejection(s), %d readmission(s)), recovery "
+                  "%.3fx pre-fault"
+                  % (result.get("scaling_4v1", 0.0),
+                     result.get("ejections", 0),
+                     result.get("readmissions", 0),
+                     result.get("recovery_vs_prefault", 0.0)))
+            return 0
+        for failure in soft:
+            print("attempt %d: %s" % (attempt, failure), file=sys.stderr)
+    print("FAIL: %s" % soft[0], file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
