@@ -21,8 +21,11 @@ def console_report(stats: Statistics, title: str = "LLM Metrics") -> str:
     for name, entry in stats.as_dict().items():
         if "value" in entry:
             continue
+        # Server-side rows (bucket-quantile estimates from /metrics)
+        # only carry mean/p50/p99 — blank cells beat printing NaN.
         lines.append("%-28s" % name + "".join(
-            "%12.2f" % entry.get(c, float("nan")) for c in _COLUMNS))
+            ("%12.2f" % entry[c]) if c in entry else "%12s" % "-"
+            for c in _COLUMNS))
     for name, entry in stats.as_dict().items():
         if "value" in entry:
             lines.append("%-28s%12.2f" % (name, entry["value"]))

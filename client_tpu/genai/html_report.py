@@ -328,14 +328,27 @@ def _tiles(stats_list) -> str:
         (_fmt(itl.get("p50", 0.0)), "ITL p50 (ms)"),
         (_fmt(itl.get("p99", 0.0)), "ITL p99 (ms)"),
     ]
+    # Server-observed twins (scraped /metrics histograms) when a
+    # metrics URL was supplied: client-vs-server TTFT side by side IS
+    # the network/queueing decomposition.
+    server_ttft = s0.stats.get("server_time_to_first_token_ms")
+    server_itl = s0.stats.get("server_inter_token_latency_ms")
+    if server_ttft:
+        tiles.append((_fmt(server_ttft.get("p99", 0.0)),
+                      "server TTFT p99 (ms)"))
+    if server_itl:
+        tiles.append((_fmt(server_itl.get("p99", 0.0)),
+                      "server ITL p99 (ms)"))
     return '<div class="tiles">%s</div>' % "".join(
         '<div class="tile"><div class="v">%s</div><div class="l">%s</div>'
         '</div>' % (v, l) for v, l in tiles)
 
 
 def _table(stats_list) -> str:
-    metrics = ["time_to_first_token_ms", "inter_token_latency_ms",
-               "request_latency_ms", "output_token_count"]
+    metrics = ["time_to_first_token_ms", "server_time_to_first_token_ms",
+               "inter_token_latency_ms", "server_inter_token_latency_ms",
+               "request_latency_ms", "server_request_latency_ms",
+               "output_token_count"]
     cols = ["mean", "p50", "p90", "p99"]
     rows = []
     for i, stats in enumerate(stats_list):
@@ -345,7 +358,8 @@ def _table(stats_list) -> str:
                 continue
             rows.append("<tr><td>exp %d · %s</td>%s</tr>" % (
                 i, metric,
-                "".join("<td>%s</td>" % _fmt(entry.get(c, 0.0))
+                "".join("<td>%s</td>"
+                        % (_fmt(entry[c]) if c in entry else "–")
                         for c in cols)))
     return ('<details open><summary>Summary table (all experiments)'
             '</summary><table class="stats"><tr><th>metric</th>%s</tr>%s'

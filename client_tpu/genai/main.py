@@ -75,6 +75,12 @@ def build_parser() -> argparse.ArgumentParser:
                              "contended backends)")
     parser.add_argument("--measurement-request-count", type=int,
                         default=50)
+    parser.add_argument("--server-metrics-url", default=None,
+                        help="Prometheus /metrics URL of the serving "
+                             "endpoint; when given, the report joins "
+                             "the server-observed TTFT/ITL histograms "
+                             "(scraped before/after the run) beside "
+                             "the client-observed numbers")
     return parser
 
 
@@ -142,6 +148,18 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
         extra_args=(["--endpoint", args.endpoint]
                     if args.service_kind == "openai" else None),
     )
+    metrics_before = None
+    if args.server_metrics_url:
+        from client_tpu.genai.metrics import fetch_metrics_text
+
+        try:
+            # Bracketing scrapes: cumulative-histogram deltas between
+            # them isolate THIS run's server-observed distributions.
+            metrics_before = fetch_metrics_text(args.server_metrics_url)
+        except Exception as e:  # noqa: BLE001 — metrics are optional
+            print("genai: server metrics unreachable at %s (%s); "
+                  "continuing without server-side columns"
+                  % (args.server_metrics_url, e), file=sys.stderr)
     rc = Profiler.run(perf_args, core=core)
     if rc != 0:
         return rc
@@ -149,6 +167,36 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
     parser_obj = LLMProfileDataParser(export_path, tokenizer)
     stats_list = [parser_obj.get_statistics(i)
                   for i in range(len(parser_obj.experiments))]
+    if metrics_before is not None:
+        from client_tpu.genai.metrics import (
+            fetch_metrics_text,
+            parse_server_histograms,
+        )
+
+        try:
+            metrics_after = fetch_metrics_text(args.server_metrics_url)
+            server_rows = parse_server_histograms(
+                metrics_before, metrics_after, args.model)
+        except Exception as e:  # noqa: BLE001 — metrics are optional
+            print("genai: post-run server metrics scrape failed (%s)"
+                  % e, file=sys.stderr)
+            server_rows = {}
+        if server_rows and len(stats_list) == 1:
+            stats_list[0].stats.update(server_rows)
+        elif server_rows:
+            # The bracketing scrapes cover the WHOLE run; stamping the
+            # same aggregate into every experiment would misrepresent
+            # it as per-experiment. Report it once, clearly run-wide.
+            print("\nServer-side histograms (whole run, all "
+                  "experiments):")
+            for name, entry in sorted(server_rows.items()):
+                print("    %-32s mean %8.2f  p50 %8.2f  p99 %8.2f"
+                      % (name, entry["mean"], entry["p50"],
+                         entry["p99"]))
+        else:
+            print("genai: no server-side stream histograms for model "
+                  "'%s' in the scrape window" % args.model,
+                  file=sys.stderr)
     for stats in stats_list:
         print(console_report(stats))
     if args.export_json:

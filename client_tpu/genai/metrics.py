@@ -155,3 +155,61 @@ class LLMProfileDataParser:
             return len(self._tokenizer.encode("".join(texts)))
         # decoupled generate: one token per streamed response
         return len(responses)
+
+
+# -- server-side telemetry join -------------------------------------------
+#
+# The server exposes always-on TTFT/ITL histograms on /metrics
+# (client_tpu.server.telemetry). Scraping the endpoint before and after
+# the run and differencing the cumulative buckets yields the RUN's
+# server-observed distributions — printed beside the client-observed
+# numbers above, the queueing-vs-network decomposition a client-only
+# genai-perf cannot do (client TTFT - server TTFT ~= network + client
+# stack time).
+
+# (histogram attr on the scrape, stats row name) — values land in ms
+# to match the client-side rows.
+_SERVER_METRIC_ROWS = (
+    ("stream_first_response_us", "server_time_to_first_token_ms"),
+    ("stream_inter_response_us", "server_inter_token_latency_ms"),
+    ("request_duration_us", "server_request_latency_ms"),
+)
+
+
+def fetch_metrics_text(url: str, timeout_s: float = 5.0) -> str:
+    """One raw scrape of a Prometheus /metrics endpoint (the URL may
+    omit the scheme and /metrics path — MetricsManager owns the
+    normalization rules, one copy for both harnesses)."""
+    from client_tpu.perf.metrics_manager import MetricsManager
+
+    return MetricsManager(url, timeout_s=timeout_s).scrape_text()
+
+
+def parse_server_histograms(before_text: str, after_text: str,
+                            model_name: str
+                            ) -> Dict[str, Dict[str, float]]:
+    """Server-observed TTFT / ITL / request-latency stats for
+    ``model_name`` from two scrapes bracketing the run: bucket deltas
+    give the run's distribution, quantiles are estimated by linear
+    interpolation inside the containing bucket. Returns stats rows
+    (``{"mean"/"p50"/"p99": ms}``) to merge into Statistics.stats;
+    empty when the model streamed nothing between the scrapes."""
+    from client_tpu.perf.metrics_manager import (
+        histogram_quantiles,
+        parse_prometheus,
+        summarize_metrics,
+    )
+
+    snapshots = [parse_prometheus(before_text),
+                 parse_prometheus(after_text)]
+    quantiles = histogram_quantiles(summarize_metrics(snapshots))
+    out: Dict[str, Dict[str, float]] = {}
+    for attr, row_name in _SERVER_METRIC_ROWS:
+        entry = quantiles.get("%s|%s" % (attr, model_name))
+        if entry:
+            out[row_name] = {
+                "mean": entry["mean_us"] / 1000.0,
+                "p50": entry["p50_us"] / 1000.0,
+                "p99": entry["p99_us"] / 1000.0,
+            }
+    return out

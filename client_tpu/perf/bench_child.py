@@ -1028,6 +1028,109 @@ def run_tracing_measure(core, model_name: str = "add_sub_large",
     }
 
 
+def run_telemetry_measure(core, model_name: str = "add_sub_large",
+                          threads: int = 4, requests: int = 120,
+                          rounds: int = 4) -> dict:
+    """Latency-histogram recording overhead: the identical closed loop
+    with the telemetry registry disabled vs enabled (the always-on
+    default). Each served request pays ~5 histogram observations
+    (request + decode/queue/execute/encode) of a bisect + three
+    counter updates under a per-histogram lock; the acceptance gate is
+    <2% throughput cost — histograms must be cheap enough to NEVER
+    turn off, because an SLO signal that gets disabled under load is
+    not an SLO signal.
+
+    Interleaved A/B rounds with medians, same discipline as
+    run_tracing_measure: the absolute cost is microseconds per
+    request, far below this host's minute-to-minute drift."""
+    import threading as _threading
+
+    import numpy as np
+
+    from client_tpu._infer_common import InferInput
+    from client_tpu.grpc._utils import get_inference_request
+
+    def request(seed: int):
+        a = np.full((1048576,), float(seed % 1000), dtype=np.float32)
+        b = np.arange(1048576, dtype=np.float32)
+        t0 = InferInput("INPUT0", [1048576], "FP32")
+        t0.set_data_from_numpy(a)
+        t1 = InferInput("INPUT1", [1048576], "FP32")
+        t1.set_data_from_numpy(b)
+        return get_inference_request(model_name=model_name,
+                                     inputs=[t0, t1], outputs=None)
+
+    pool_requests = [request(i) for i in range(8)]
+
+    def closed_loop() -> tuple:
+        latencies: list = []
+        merge = _threading.Lock()
+        per_thread = requests // threads
+
+        def worker(offset: int):
+            local = []
+            for i in range(per_thread):
+                req = pool_requests[(offset + i) % len(pool_requests)]
+                t_start = time.monotonic_ns()
+                core.infer(req)
+                local.append(time.monotonic_ns() - t_start)
+            with merge:
+                latencies.extend(local)
+
+        t0 = time.monotonic()
+        pool = [_threading.Thread(target=worker, args=(i * 31,))
+                for i in range(threads)]
+        for thread in pool:
+            thread.start()
+        for thread in pool:
+            thread.join()
+        elapsed = time.monotonic() - t0
+        if not latencies or elapsed <= 0:
+            return 0.0, 0.0
+        latencies.sort()
+        return (len(latencies) / elapsed,
+                latencies[len(latencies) // 2] / 1000.0)
+
+    for req in pool_requests[:4]:
+        core.infer(req)  # warm the model outside both windows
+    was_enabled = core.telemetry.enabled
+    off_rounds, on_rounds, pair_overheads = [], [], []
+    try:
+        for _ in range(rounds):
+            core.telemetry.enabled = False
+            off_tput_i, off_p50_i = closed_loop()
+            core.telemetry.enabled = True
+            on_tput_i, on_p50_i = closed_loop()
+            off_rounds.append((off_tput_i, off_p50_i))
+            on_rounds.append((on_tput_i, on_p50_i))
+            if off_tput_i > 0:
+                # PAIRED per-round overhead: adjacent windows share
+                # the host's drift state, so their ratio isolates the
+                # recording cost; the median of pair ratios is far
+                # tighter than a ratio of medians at a 2% gate.
+                pair_overheads.append(
+                    100.0 * (off_tput_i - on_tput_i) / off_tput_i)
+    finally:
+        core.telemetry.enabled = was_enabled
+    off_rounds.sort()
+    on_rounds.sort()
+    off_tput, off_p50 = off_rounds[len(off_rounds) // 2]
+    on_tput, on_p50 = on_rounds[len(on_rounds) // 2]
+    pair_overheads.sort()
+    overhead_pct = (pair_overheads[len(pair_overheads) // 2]
+                    if pair_overheads else 0.0)
+    return {
+        "telemetry_off_tput": round(off_tput, 2),
+        "telemetry_off_p50_us": round(off_p50, 1),
+        "telemetry_on_tput": round(on_tput, 2),
+        "telemetry_on_p50_us": round(on_p50, 1),
+        "pair_overheads_pct": [round(v, 2) for v in pair_overheads],
+        "overhead_pct": round(overhead_pct, 2),
+        "overhead_gate_pct": 2.0,
+        "overhead_ok": overhead_pct < 2.0,
+    }
+
+
 def sequence_stats(core, model_name: str):
     """Sequence-scheduler snapshot for bench evidence (slot occupancy
     + lifetime counters from ModelStatistics.sequence_stats)."""
@@ -1909,6 +2012,27 @@ def main() -> None:
                     % extra.get("overhead_pct", 0.0))
         except Exception as exc:  # noqa: BLE001
             log("tracing_overhead failed: %s" % exc)
+
+    # Config 3g: latency-histogram (telemetry) overhead — the same
+    # closed loop on add_sub_large with the always-on histogram
+    # registry disabled vs enabled. Gate: <2% throughput cost at
+    # trace_rate=0, so the SLO histograms can stay on in production
+    # unconditionally (the whole point of "always-on").
+    if remaining() > 45 and stage_wanted("telemetry_overhead"):
+        try:
+            run_with_watchdog(
+                "add_sub_large load",
+                lambda: core.repository.load("add_sub_large"),
+                min(120.0, max(30.0, remaining() - 60)))
+            extra = run_telemetry_measure(core)
+            record_stage("telemetry_overhead",
+                         extra.get("telemetry_on_tput", 0.0),
+                         extra.get("telemetry_on_p50_us", 0.0), extra)
+            if not extra.get("overhead_ok", True):
+                log("telemetry overhead %.2f%% exceeds the 2%% gate"
+                    % extra.get("overhead_pct", 0.0))
+        except Exception as exc:  # noqa: BLE001
+            log("telemetry_overhead failed: %s" % exc)
 
     # Config 3c: failover + hedging across a 2-server fleet (the
     # EndpointPool client). Three measurements: one endpoint latency-

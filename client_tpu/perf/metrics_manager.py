@@ -59,6 +59,18 @@ class TpuMetrics:
     replica_redispatch_total: Dict[str, float] = field(
         default_factory=dict)
     replica_exec_us: Dict[str, float] = field(default_factory=dict)
+    # Latency-histogram families (telemetry layer): attr -> series key
+    # -> {le_bound: cumulative_count}. Keys are the model (stage
+    # histograms append "|s<stage>", tenant histograms use the tenant
+    # label); bounds are floats with +Inf as float("inf"). The paired
+    # _sum/_count series land in hist_sum/hist_count under the same
+    # (attr, key).
+    histograms: Dict[str, Dict[str, Dict[float, float]]] = field(
+        default_factory=dict)
+    hist_sum: Dict[str, Dict[str, float]] = field(default_factory=dict)
+    hist_count: Dict[str, Dict[str, float]] = field(default_factory=dict)
+    stream_responses_total: Dict[str, float] = field(
+        default_factory=dict)
 
 
 _FAMILIES = {
@@ -86,6 +98,20 @@ _FAMILIES = {
     "tpu_replica_readmitted_total": "replica_readmitted_total",
     "tpu_replica_redispatch_total": "replica_redispatch_total",
     "tpu_replica_exec_us": "replica_exec_us",
+    "tpu_stream_responses_total": "stream_responses_total",
+}
+
+# Histogram families (telemetry layer): the scraper folds their
+# ``_bucket`` / ``_sum`` / ``_count`` child series into
+# TpuMetrics.histograms / hist_sum / hist_count so the window summary
+# can difference cumulative bucket counts and estimate p50/p99 via
+# client_tpu.server.telemetry.estimate_quantile.
+_HIST_FAMILIES = {
+    "tpu_request_duration_us": "request_duration_us",
+    "tpu_stage_duration_us": "stage_duration_us",
+    "tpu_stream_first_response_us": "stream_first_response_us",
+    "tpu_stream_inter_response_us": "stream_inter_response_us",
+    "tpu_tenant_request_duration_us": "tenant_request_duration_us",
 }
 
 # Monotonic counters among the scraped families: summarize_metrics
@@ -97,7 +123,30 @@ _COUNTER_FAMILIES = frozenset((
     "shed_total", "tenant_success_total", "tenant_rejected_total",
     "replica_ejected_total", "replica_readmitted_total",
     "replica_redispatch_total", "replica_exec_us",
+    "stream_responses_total",
 ))
+
+
+def _histogram_parts(family: str):
+    """(attr, kind) for a histogram child sample name, else None —
+    kind is "bucket", "sum" or "count"."""
+    for suffix in ("_bucket", "_sum", "_count"):
+        if family.endswith(suffix):
+            base = family[: -len(suffix)]
+            attr = _HIST_FAMILIES.get(base)
+            if attr is not None:
+                return attr, suffix[1:]
+    return None
+
+
+def _hist_key(attr: str, labels: Dict[str, str]) -> str:
+    """Series key for one histogram label set: model or tenant, with
+    the stage folded in as a compound "model|s<stage>" key so deltas
+    and quantiles stay per stage."""
+    key = (labels.get("model") or labels.get("tenant") or "0")
+    if "stage" in labels:
+        key = "%s|s%s" % (key, labels["stage"])
+    return key
 
 
 def parse_prometheus(text: str) -> TpuMetrics:
@@ -107,7 +156,31 @@ def parse_prometheus(text: str) -> TpuMetrics:
         if not line or line.startswith("#"):
             continue
         m = _SAMPLE.match(line)
-        if not m or m.group("name") not in _FAMILIES:
+        if not m:
+            continue
+        hist = _histogram_parts(m.group("name"))
+        if hist is not None:
+            attr, kind = hist
+            labels = dict(_LABEL.findall(m.group("labels") or ""))
+            try:
+                value = float(m.group("value"))
+            except ValueError:
+                continue
+            key = _hist_key(attr, labels)
+            if kind == "bucket":
+                le = labels.get("le", "")
+                try:
+                    bound = float("inf") if le == "+Inf" else float(le)
+                except ValueError:
+                    continue
+                metrics.histograms.setdefault(attr, {}).setdefault(
+                    key, {})[bound] = value
+            elif kind == "sum":
+                metrics.hist_sum.setdefault(attr, {})[key] = value
+            else:
+                metrics.hist_count.setdefault(attr, {})[key] = value
+            continue
+        if m.group("name") not in _FAMILIES:
             continue
         labels = dict(_LABEL.findall(m.group("labels") or ""))
         # Batcher gauges are per-model; HBM gauges are per-device;
@@ -149,10 +222,15 @@ class MetricsManager:
         self._snapshots: List[TpuMetrics] = []
         self.scrape_failures = 0
 
-    def scrape_once(self) -> TpuMetrics:
+    def scrape_text(self) -> str:
+        """One raw exposition scrape (the genai front-end brackets its
+        run with two of these; parse is the caller's business)."""
         with urllib.request.urlopen(self._url,
                                     timeout=self._timeout_s) as resp:
-            return parse_prometheus(resp.read().decode("utf-8", "replace"))
+            return resp.read().decode("utf-8", "replace")
+
+    def scrape_once(self) -> TpuMetrics:
+        return parse_prometheus(self.scrape_text())
 
     def check_reachable(self) -> None:
         """Raise if the endpoint cannot be scraped (parity:
@@ -228,4 +306,106 @@ def summarize_metrics(snapshots: List[TpuMetrics]) -> Dict[str, Dict[str, float]
                              for k in last),
                 "last": sum(last.values()),
             }
+    out.update(_summarize_histograms(snapshots))
+    return out
+
+
+def _summarize_histograms(snapshots: List[TpuMetrics]
+                          ) -> Dict[str, Dict[str, float]]:
+    """Window deltas of the cumulative histogram series, flattened to
+    ``hist!<attr>|<key>|le=<bound>`` / ``...|sum`` / ``...|count``
+    entries. Differencing cumulative-in-le bucket counts yields the
+    WINDOW's cumulative distribution, so the entries stay additive —
+    the profiler's merge can sum them across stable windows and
+    :func:`histogram_quantiles` re-estimates p50/p99 from the sums."""
+    from client_tpu.server.telemetry import format_le
+
+    out: Dict[str, Dict[str, float]] = {}
+    first_b: Dict[tuple, float] = {}
+    last_b: Dict[tuple, float] = {}
+    first_sc: Dict[tuple, float] = {}
+    last_sc: Dict[tuple, float] = {}
+    for index, snap in enumerate(snapshots):
+        for attr, by_key in snap.histograms.items():
+            for key, buckets in by_key.items():
+                for bound, value in buckets.items():
+                    entry = (attr, key, bound)
+                    # Baseline comes from the FIRST snapshot only: a
+                    # series born mid-window (model's first traffic
+                    # after the window opened) starts from 0, not from
+                    # its first observed cumulative value — otherwise
+                    # its whole delta would vanish.
+                    if index == 0:
+                        first_b.setdefault(entry, value)
+                    last_b[entry] = value
+        for attr, by_key in snap.hist_sum.items():
+            for key, value in by_key.items():
+                entry = (attr, key, "sum")
+                if index == 0:
+                    first_sc.setdefault(entry, value)
+                last_sc[entry] = value
+        for attr, by_key in snap.hist_count.items():
+            for key, value in by_key.items():
+                entry = (attr, key, "count")
+                if index == 0:
+                    first_sc.setdefault(entry, value)
+                last_sc[entry] = value
+    # Only series whose count moved this window are emitted: idle
+    # models' zero-delta ladders would bloat every summary.
+    active = {
+        (attr, key)
+        for (attr, key, which), value in last_sc.items()
+        if which == "count"
+        and value - first_sc.get((attr, key, which), 0.0) > 0
+    }
+    for (attr, key, bound), value in last_b.items():
+        if (attr, key) not in active:
+            continue
+        delta = max(value - first_b.get((attr, key, bound), 0.0), 0.0)
+        out["hist!%s|%s|le=%s" % (attr, key, format_le(bound))] = {
+            "delta": delta}
+    for (attr, key, which), value in last_sc.items():
+        if (attr, key) not in active:
+            continue
+        delta = max(value - first_sc.get((attr, key, which), 0.0), 0.0)
+        out["hist!%s|%s|%s" % (attr, key, which)] = {"delta": delta}
+    return out
+
+
+def histogram_quantiles(tpu_metrics: Dict[str, Dict[str, float]]
+                        ) -> Dict[str, Dict[str, float]]:
+    """Bucket-quantile estimates from a window summary (or a merge of
+    summaries): ``{"<attr>|<key>": {"p50_us", "p99_us", "mean_us",
+    "count"}}``. Input entries are the ``hist!`` rows
+    :func:`_summarize_histograms` emits."""
+    from client_tpu.server.telemetry import estimate_quantile
+
+    grouped: Dict[str, Dict[str, float]] = {}
+    for name, entry in tpu_metrics.items():
+        if not name.startswith("hist!"):
+            continue
+        body = name[len("hist!"):]
+        attr_key, part = body.rsplit("|", 1)
+        grouped.setdefault(attr_key, {})[part] = entry.get("delta", 0.0)
+    out: Dict[str, Dict[str, float]] = {}
+    for attr_key, parts in grouped.items():
+        count = parts.get("count", 0.0)
+        if count <= 0:
+            continue
+        buckets = []
+        for part, value in parts.items():
+            if not part.startswith("le="):
+                continue
+            le = part[3:]
+            bound = float("inf") if le == "+Inf" else float(le)
+            buckets.append((bound, value))
+        if not buckets:
+            continue
+        total = parts.get("sum", 0.0)
+        out[attr_key] = {
+            "p50_us": estimate_quantile(buckets, 0.50),
+            "p99_us": estimate_quantile(buckets, 0.99),
+            "mean_us": total / count if count else 0.0,
+            "count": count,
+        }
     return out

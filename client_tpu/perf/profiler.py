@@ -118,6 +118,16 @@ def _normalize_stats_entry(entry: Dict) -> Dict:
             name: int(value)
             for name, value in out["sequence_stats"].items()
         }
+    if "stream_stats" in out:
+        # Counters + nested StatisticDuration pairs (count/ns), all
+        # additive — window deltas and merges treat them generically.
+        out["stream_stats"] = {
+            name: (
+                {k: int(v) for k, v in value.items()}
+                if isinstance(value, dict) else int(value)
+            )
+            for name, value in dict(out["stream_stats"]).items()
+        }
     return out
 
 

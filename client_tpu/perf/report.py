@@ -70,6 +70,27 @@ def print_report(results: List[PerfStatus], percentile: int = 0,
                                  % path_us("cache_miss", misses))
                 print("    cache %s (this window): %s"
                       % (entry.get("name", "?"), ", ".join(parts)))
+            stream = entry.get("stream_stats") or {}
+            if stream.get("response_count"):
+                # Server-observed streaming-token telemetry (means
+                # from ModelStatistics; the /metrics histograms below
+                # add the distributions when a metrics URL is
+                # scraped).
+                first = stream.get("first_response") or {}
+                inter = stream.get("inter_response") or {}
+                parts = ["%d responses over %d streams"
+                         % (int(stream.get("response_count", 0)),
+                            int(stream.get("stream_count", 0)))]
+                if first.get("count"):
+                    parts.append("TTFT mean %.0f us"
+                                 % (first.get("ns", 0)
+                                    / first["count"] / 1000.0))
+                if inter.get("count"):
+                    parts.append("ITL mean %.0f us"
+                                 % (inter.get("ns", 0)
+                                    / inter["count"] / 1000.0))
+                print("    stream %s (this window): %s"
+                      % (entry.get("name", "?"), ", ".join(parts)))
             seq = entry.get("sequence_stats") or {}
             if seq.get("step_count") or seq.get("active_sequences"):
                 slot_total = seq.get("slot_total", 0)
@@ -90,6 +111,7 @@ def print_report(results: List[PerfStatus], percentile: int = 0,
                        seq.get("backlog_depth", 0),
                        seq.get("idle_reclaimed_total", 0)))
         if status.tpu_metrics:
+            _print_histogram_lines(status)
             hbm = status.tpu_metrics.get("hbm_used_bytes")
             util = status.tpu_metrics.get("hbm_utilization")
             parts = []
@@ -117,6 +139,44 @@ def print_report(results: List[PerfStatus], percentile: int = 0,
                 print("    server replicas: %s" % ", ".join(parts))
         if not status.on_target:
             print("    WARNING: measurement did not stabilize")
+
+
+def _print_histogram_lines(status: PerfStatus) -> None:
+    """Server-side latency quantiles estimated from the scraped
+    /metrics histogram window deltas, printed beside the
+    client-observed percentiles — the queueing-vs-network
+    decomposition a client-only harness cannot do. TTFT/ITL lines
+    appear when the model streamed this window."""
+    from client_tpu.perf.metrics_manager import histogram_quantiles
+
+    quantiles = histogram_quantiles(status.tpu_metrics)
+    for key in sorted(k for k in quantiles
+                      if k.startswith("request_duration_us|")):
+        model_name = key.split("|", 1)[1]
+        q = quantiles[key]
+        line = ("    server %s /metrics histogram (this window): "
+                "request p50 %.0f us / p99 %.0f us over %d requests"
+                % (model_name, q["p50_us"], q["p99_us"], q["count"]))
+        client_p50 = status.latency_percentiles.get(50)
+        client_p99 = status.latency_percentiles.get(99)
+        if client_p50 is not None and client_p99 is not None:
+            line += (" (client p50 %.0f / p99 %.0f)"
+                     % (client_p50, client_p99))
+        print(line)
+    for key in sorted(k for k in quantiles
+                      if k.startswith("stream_first_response_us|")):
+        model_name = key.split("|", 1)[1]
+        first = quantiles[key]
+        line = ("    server %s stream histograms (this window): TTFT "
+                "p50 %.0f us / p99 %.0f us" % (model_name,
+                                               first["p50_us"],
+                                               first["p99_us"]))
+        inter = quantiles.get("stream_inter_response_us|%s" % model_name)
+        if inter:
+            line += (", ITL p50 %.0f us / p99 %.0f us (%d gaps)"
+                     % (inter["p50_us"], inter["p99_us"],
+                        inter["count"]))
+        print(line)
 
 
 # Span name -> report stage for the --trace stage-attribution table.
@@ -279,6 +339,21 @@ def print_qos_report(results: List[PerfStatus],
                          int(row.get("fail_count", 0)),
                          duration_ns / success / 1000.0 if success
                          else 0.0))
+    # Per-tenant latency DISTRIBUTIONS from the scraped
+    # tpu_tenant_request_duration_us histogram (the family that used
+    # to be a sum-only counter — now p50/p99 are estimable).
+    from client_tpu.perf.metrics_manager import histogram_quantiles
+
+    for status in results:
+        quantiles = histogram_quantiles(status.tpu_metrics)
+        for key in sorted(k for k in quantiles
+                          if k.startswith("tenant_request_duration_us|")):
+            tenant = key.split("|", 1)[1]
+            q = quantiles[key]
+            print("    tenant %s histogram (this window): p50 %.0f us, "
+                  "p99 %.0f us, mean %.0f us over %d requests"
+                  % (tenant, q["p50_us"], q["p99_us"], q["mean_us"],
+                     q["count"]))
 
 
 def print_chaos_report(results: List[PerfStatus], retry_count: int,

@@ -312,8 +312,17 @@ class DynamicBatcher:
                  priority_policies: Optional[Dict[int, dict]] = None,
                  shed_watermark: float = 0.0,
                  shed_hook: Optional[Callable[..., None]] = None,
-                 execution_target=None):
+                 execution_target=None,
+                 telemetry=None):
         self._model = model
+        # Always-on latency histograms (client_tpu.server.telemetry's
+        # ServerTelemetry, or None): each fused execution records a
+        # batch_execute observation and each host materialization a
+        # relay_fetch observation — per execution, never per member
+        # request, so the histogram counts work units. When a sampled
+        # request rode the batch, its trace id lands on the bucket as
+        # an exemplar (the hot-bucket -> span-tree join).
+        self._telemetry = telemetry
         # The hand-off point to execution. By default fused batches run
         # on the model itself; an instance-group model passes its
         # ReplicaSet proxy here so every fused batch is health-routed
@@ -1003,6 +1012,22 @@ class DynamicBatcher:
                 self._stats_hook(executed, compute_ns, fetch_ns)
             except Exception:  # noqa: BLE001 — stats never fail serving
                 pass
+        if ok and self._telemetry is not None \
+                and self._telemetry.enabled and compute_ns:
+            try:
+                trace_id = next(
+                    (p.trace.trace_id for p in bucket
+                     if p.trace is not None), None)
+                name = getattr(self._model, "name", "?")
+                self._telemetry.observe_stage(
+                    name, "batch_execute", compute_ns / 1000.0,
+                    trace_id)
+                if fetch_ns:
+                    self._telemetry.observe_stage(
+                        name, "relay_fetch", fetch_ns / 1000.0,
+                        trace_id)
+            except Exception:  # noqa: BLE001 — telemetry never fails
+                pass  # serving
         with self._cv:
             self._inflight -= 1
             self._cv.notify_all()

@@ -20,6 +20,7 @@ import numpy as np
 
 from client_tpu.protocol import inference_pb2 as pb
 from client_tpu.server import chaos
+from client_tpu.server import telemetry as slo
 from client_tpu.server import tracing as spantrace
 from client_tpu.server.cache import (
     DEFAULT_CACHE_BYTES,
@@ -106,6 +107,17 @@ class _ModelStats:
         self.cache_hit_ns = 0
         self.cache_miss_count = 0
         self.cache_miss_ns = 0
+        # Streaming-token telemetry (ModelStatistics.stream_stats):
+        # server-observed TTFT / inter-response gaps plus response and
+        # completed-stream counts. The telemetry histograms carry the
+        # distributions; these counters carry the means over the
+        # statistics protocol both transports already speak.
+        self.stream_count = 0
+        self.stream_response_count = 0
+        self.stream_first_count = 0
+        self.stream_first_ns = 0
+        self.stream_inter_count = 0
+        self.stream_inter_ns = 0
 
     def _priority_row(self, level: int) -> list:
         """[success, reject, timeout, shed, queue_ns] for one class
@@ -208,6 +220,27 @@ class _ModelStats:
             self.cache_miss_count += 1
             self.cache_miss_ns += ns
 
+    def record_stream_first(self, ns: int):
+        """Server-observed time from stream admission to the first
+        response the model produced (TTFT for token streams)."""
+        with self.lock:
+            self.stream_first_count += 1
+            self.stream_first_ns += max(int(ns), 0)
+            self.stream_response_count += 1
+
+    def record_stream_gap(self, ns: int):
+        """Server-observed gap between consecutive streamed responses
+        (inter-token latency for one-token-per-response streams)."""
+        with self.lock:
+            self.stream_inter_count += 1
+            self.stream_inter_ns += max(int(ns), 0)
+            self.stream_response_count += 1
+
+    def record_stream_done(self):
+        """One stream (decoupled or unary-through-stream) completed."""
+        with self.lock:
+            self.stream_count += 1
+
     def record_batch(self, size: int, compute_ns: int, fetch_ns: int):
         """Dynamic-batcher stats hook: one fused execution at `size`."""
         if size <= 0:
@@ -299,6 +332,12 @@ class _TenantAdmission:
             if self.model_name is not None:
                 self._core._stats_for(self.model_name).record_tenant(
                     self.tenant, self.ok, duration_ns)
+            if self.ok:
+                # The per-tenant duration HISTOGRAM (the sum-only
+                # counter this family used to be had no paired count,
+                # so rate() yielded nothing interpretable).
+                self._core.telemetry.observe_tenant(
+                    self.tenant, duration_ns / 1000.0)
         return False
 
 
@@ -336,6 +375,12 @@ class InferenceServerCore:
         self.response_cache = ResponseCache(
             DEFAULT_CACHE_BYTES if cache_size is None else cache_size)
         repository.add_unload_listener(self.response_cache.invalidate_model)
+        # Always-on latency histograms + streaming-token telemetry
+        # (client_tpu.server.telemetry): scrape-cheap SLO distributions
+        # for every request at every serving stage, exposed on /metrics
+        # as Prometheus histogram families. CLIENT_TPU_TELEMETRY=off
+        # disables recording (the bench's A/B arm).
+        self.telemetry = slo.ServerTelemetry()
         self._stats: Dict[str, _ModelStats] = {}
         self._stats_lock = threading.Lock()
         self._batchers: Dict[str, object] = {}
@@ -452,6 +497,14 @@ class InferenceServerCore:
                         tenant=tenant, success_count=row[0],
                         reject_count=row[1], fail_count=row[2],
                         duration_ns=row[3])
+                if s.stream_response_count or s.stream_count:
+                    stream = stat.stream_stats
+                    stream.stream_count = s.stream_count
+                    stream.response_count = s.stream_response_count
+                    stream.first_response.count = s.stream_first_count
+                    stream.first_response.ns = s.stream_first_ns
+                    stream.inter_response.count = s.stream_inter_count
+                    stream.inter_response.ns = s.stream_inter_ns
                 stat.inference_stats.cache_hit.count = s.cache_hit_count
                 stat.inference_stats.cache_hit.ns = s.cache_hit_ns
                 stat.inference_stats.cache_miss.count = s.cache_miss_count
@@ -518,10 +571,17 @@ class InferenceServerCore:
                 seq.fused_steps = snap["fused_steps"]
         return response
 
-    def metrics_text(self) -> str:
+    def metrics_text(self, openmetrics: bool = False) -> str:
         """Prometheus exposition text (parity: the Triton /metrics
         endpoint that perf MetricsManager scrapes, metrics_manager.h:56;
-        the DCGM GPU gauges map to TPU HBM gauges here)."""
+        the DCGM GPU gauges map to TPU HBM gauges here).
+
+        ``openmetrics=True`` renders the OpenMetrics flavor a scraper
+        negotiates via ``Accept: application/openmetrics-text``:
+        trace-id exemplars on histogram buckets plus the ``# EOF``
+        terminator. The default text-format-0.0.4 flavor NEVER carries
+        exemplars — stock Prometheus rejects them outside OpenMetrics,
+        and a rejected line drops the whole scrape."""
         lines = []
 
         def family(name, kind, help_text, rows):
@@ -601,7 +661,6 @@ class InferenceServerCore:
                "watermark sheds)", shed_rows)
 
         tenant_success, tenant_rejected, tenant_failure = [], [], []
-        tenant_duration = []
         # Quota rejects come from the quota manager when configured —
         # it counts every reject, including ones for model names that
         # never minted a stats entry; per-model rows are the fallback.
@@ -621,8 +680,6 @@ class InferenceServerCore:
                                   % (label, row[0]))
             tenant_failure.append("tpu_tenant_failure_total%s %d"
                                   % (label, row[2]))
-            tenant_duration.append("tpu_tenant_request_duration_us%s %d"
-                                   % (label, row[3] // 1000))
         for tenant in sorted(rejected_by_tenant):
             tenant_rejected.append(
                 'tpu_tenant_rejected_total{tenant="%s"} %d'
@@ -637,9 +694,9 @@ class InferenceServerCore:
         family("tpu_tenant_failure_total", "counter",
                "Failed requests per tenant (post-admission errors)",
                tenant_failure)
-        family("tpu_tenant_request_duration_us", "counter",
-               "Cumulative successful-request duration per tenant",
-               tenant_duration)
+        # tpu_tenant_request_duration_us is emitted as a HISTOGRAM by
+        # the telemetry registry below (the sum-only counter this used
+        # to be gave rate() nothing to divide by).
 
         tenant_inflight, tenant_tokens = [], []
         if quota_snapshot is not None:
@@ -827,6 +884,15 @@ class InferenceServerCore:
                "Accelerator HBM capacity in bytes", total_rows)
         family("tpu_hbm_utilization", "gauge",
                "Fraction of accelerator HBM in use", util_rows)
+        # Latency-histogram + streaming-token families (request/stage
+        # durations, stream TTFT/ITL, per-tenant duration histogram) —
+        # HELP/TYPE lines come with the rendered block. Exemplar
+        # suffixes are OpenMetrics syntax, gated on the scraper's
+        # negotiated flavor, never on server state.
+        lines.extend(self.telemetry.render(
+            escape=_escape_label_value, exemplars=openmetrics))
+        if openmetrics:
+            lines.append("# EOF")
         return "\n".join(lines) + "\n"
 
     # -- trace / log settings -------------------------------------------
@@ -1126,6 +1192,7 @@ class InferenceServerCore:
                     shed_watermark=float(
                         getattr(model, "shed_watermark", 0.0)),
                     shed_hook=stats.record_shed,
+                    telemetry=self.telemetry,
                 )
                 self._batchers[model.name] = batcher
             return batcher
@@ -1384,6 +1451,10 @@ class InferenceServerCore:
         stats.record(self._batch_size(model, request), 0, 0, 0, 0,
                      ok=True, executions=0, total_ns=ns,
                      priority=priority)
+        # Hits land in the request-duration histogram too (they are
+        # served requests an SLO covers) but skip the stage families —
+        # a hit never queues, executes, or fetches.
+        self.telemetry.observe_request(model.name, ns / 1000.0)
         return response
 
     def _await_flight(self, model: ServedModel,
@@ -1437,6 +1508,7 @@ class InferenceServerCore:
         stats.record(self._batch_size(model, request), 0, 0, 0, 0,
                      ok=True, executions=0, total_ns=ns,
                      priority=priority)
+        self.telemetry.observe_request(model.name, ns / 1000.0)
         return response
 
     def _infer_executed(self, model: ServedModel,
@@ -1540,6 +1612,27 @@ class InferenceServerCore:
         stats.record(batch, queue_ns, t1 - t0, (t2 - t1) - queue_ns,
                      t3 - t2, ok=True, executions=executions,
                      priority=priority)
+        telemetry = self.telemetry
+        if telemetry.enabled:
+            # Always-on SLO histograms: the end-to-end duration plus
+            # the per-request stages that tile it (decode/queue/
+            # execute/encode — the span-tree timeline, observed for
+            # EVERY request, not just trace samples). Sampled requests
+            # stamp their trace id as an OpenMetrics exemplar so a
+            # hot-bucket outlier joins its span tree.
+            trace_id = trace.trace_id if trace is not None else None
+            telemetry.observe_request(model.name, (t3 - t0) / 1000.0,
+                                      trace_id)
+            telemetry.observe_stage(model.name, "decode",
+                                    (t1 - t0) / 1000.0, trace_id)
+            if queue_ns:
+                telemetry.observe_stage(model.name, "queue",
+                                        queue_ns / 1000.0, trace_id)
+            telemetry.observe_stage(model.name, "execute",
+                                    ((t2 - t1) - queue_ns) / 1000.0,
+                                    trace_id)
+            telemetry.observe_stage(model.name, "encode",
+                                    (t3 - t2) / 1000.0, trace_id)
         if trace is not None:
             trace.timeline = (t0, t1, t1 + queue_ns, t2, t3)
         return response
@@ -1591,6 +1684,15 @@ class InferenceServerCore:
         if not model.decoupled:
             response = self.infer(request, trace_context)
             # admission handled there (tenant quotas included)
+            # Unary-through-stream still counts as a one-response
+            # stream: its "first response" latency is the whole
+            # request — so streaming load against non-decoupled
+            # models populates the TTFT family too.
+            now_ns = time.monotonic_ns()
+            stats.record_stream_first(now_ns - t0)
+            stats.record_stream_done()
+            self.telemetry.observe_stream_first(
+                model.name, (now_ns - t0) / 1000.0)
             stream_response = pb.ModelStreamInferResponse()
             stream_response.infer_response.CopyFrom(response)
             stream_response.infer_response.parameters[
@@ -1643,15 +1745,35 @@ class InferenceServerCore:
             count = 0
             pending = None  # buffer one ahead so the last data response
             # can carry the final flag when empty finals are off
+            telemetry = self.telemetry
+            trace_id = trace.trace_id if trace is not None else None
+            # TTFT measures from stream admission (t0, before decode)
+            # — the server-side bound of what the client experiences;
+            # later gaps measure production-to-production (the
+            # server-observed inter-token latency, incl. encode and
+            # any consumer backpressure of the previous response).
+            prev_ns = t0
             mark_ns = time.monotonic_ns()
             for out in model.infer_stream(inputs, params):
+                now_ns = time.monotonic_ns()
                 if trace is not None:
                     # One span per decoupled response: model produce
                     # time since the previous response left this loop
                     # (the server-side view of inter-token latency).
                     trace.add_timed(
                         spantrace.SPAN_STREAM_RESPONSE, mark_ns,
-                        time.monotonic_ns(), {"index": count})
+                        now_ns, {"index": count})
+                if count == 0:
+                    stats.record_stream_first(now_ns - prev_ns)
+                    telemetry.observe_stream_first(
+                        model.name, (now_ns - prev_ns) / 1000.0,
+                        trace_id)
+                else:
+                    stats.record_stream_gap(now_ns - prev_ns)
+                    telemetry.observe_stream_gap(
+                        model.name, (now_ns - prev_ns) / 1000.0,
+                        trace_id)
+                prev_ns = now_ns
                 response = self._encode_response(model, request, out)
                 stream_response = pb.ModelStreamInferResponse()
                 stream_response.infer_response.CopyFrom(response)
@@ -1679,6 +1801,7 @@ class InferenceServerCore:
                     "triton_final_response"
                 ].bool_param = True
                 yield pending
+            stats.record_stream_done()
             stats.record(max(count, 1), 0, 0, time.monotonic_ns() - t0, 0, ok=True)
         except InferenceServerException as e:
             stats.record(1, 0, 0, time.monotonic_ns() - t0, 0, ok=False)

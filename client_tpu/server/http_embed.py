@@ -146,7 +146,15 @@ def _model_ready(core, m, headers, body):
 
 @_route("GET", r"/metrics")
 def _metrics(core, m, headers, body):
-    text = core.metrics_text()
+    # Same content negotiation as the aiohttp front-end: exemplars +
+    # '# EOF' only for scrapers that negotiate OpenMetrics.
+    openmetrics = "application/openmetrics-text" in \
+        headers.get("accept", "")
+    text = core.metrics_text(openmetrics)
+    if openmetrics:
+        return 200, {"Content-Type": "application/openmetrics-text; "
+                                     "version=1.0.0; charset=utf-8"}, \
+            text.encode()
     return 200, {"Content-Type": "text/plain; version=0.0.4"}, text.encode()
 
 
@@ -284,6 +292,15 @@ def _post_logging(core, m, headers, body):
     return _json_reply(core.log_settings(json.loads(body)))
 
 
+def _apply_tenant_header(headers, infer_request) -> None:
+    """x-tenant-id -> `tenant` parameter (aiohttp front-end parity);
+    an in-body parameter wins. Header names are lower-cased by the
+    caller (http_call contract)."""
+    tenant_header = headers.get("x-tenant-id")
+    if tenant_header and "tenant" not in infer_request.parameters:
+        infer_request.parameters["tenant"].string_param = tenant_header
+
+
 @_route("POST", _MODEL + r"/generate")
 def _generate(core, m, headers, body):
     """Non-streaming generate extension (JSON in, JSON out); the SSE
@@ -297,7 +314,13 @@ def _generate(core, m, headers, body):
     model = core.repository.get(m.group("model"))
     infer_request = build_generate_request(
         model.inputs, m.group("model"), m.group("version") or "", body)
-    return _json_reply(generate_response_json(core.infer(infer_request)))
+    # Same correlation/propagation hygiene as the /infer route below.
+    from client_tpu.server.core import mint_request_id
+
+    mint_request_id(infer_request)
+    _apply_tenant_header(headers, infer_request)
+    return _json_reply(generate_response_json(core.infer(
+        infer_request, trace_context=headers.get("traceparent"))))
 
 
 @_route("POST", _MODEL + r"/infer")
@@ -310,11 +333,7 @@ def _infer(core, m, headers, body):
     from client_tpu.server.core import mint_request_id
 
     mint_request_id(infer_request)
-    # Tenant identity: x-tenant-id maps onto the `tenant` parameter
-    # (aiohttp front-end parity); an in-body parameter wins.
-    tenant_header = headers.get("x-tenant-id")
-    if tenant_header and "tenant" not in infer_request.parameters:
-        infer_request.parameters["tenant"].string_param = tenant_header
+    _apply_tenant_header(headers, infer_request)
     # header names are lower-cased by the caller (http_call contract)
     response = core.infer(infer_request,
                           trace_context=headers.get("traceparent"))

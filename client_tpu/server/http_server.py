@@ -107,7 +107,18 @@ def build_http_app(core: InferenceServerCore) -> web.Application:
 
     @routes.get("/metrics")
     async def metrics(request):
-        text = await _run(core.metrics_text)
+        # Content negotiation: exemplars (and the # EOF terminator)
+        # are OpenMetrics syntax, served only to scrapers that ask for
+        # that flavor — stock text-format parsers never see them.
+        openmetrics = "application/openmetrics-text" in \
+            request.headers.get("Accept", "")
+        text = await _run(core.metrics_text, openmetrics)
+        if openmetrics:
+            return web.Response(
+                body=text.encode("utf-8"),
+                headers={"Content-Type": "application/openmetrics-text"
+                                         "; version=1.0.0"
+                                         "; charset=utf-8"})
         return web.Response(text=text,
                             content_type="text/plain", charset="utf-8")
 
@@ -323,12 +334,16 @@ def build_http_app(core: InferenceServerCore) -> web.Application:
         """JSON body fields -> ModelInferRequest tensors by input name
         (shared codec: http_wire.build_generate_request)."""
         from client_tpu.protocol.http_wire import build_generate_request
+        from client_tpu.server.core import mint_request_id
 
         model_name = request.match_info["model"]
         model = core.repository.get(model_name)
         infer_request = build_generate_request(
             model.inputs, model_name,
             request.match_info.get("version", ""), body)
+        # Same correlation hygiene as the /infer route: an id for
+        # trace/statistics joins, tenant identity for quotas.
+        mint_request_id(infer_request)
         _apply_tenant_header(request, infer_request)
         return infer_request
 
@@ -343,7 +358,8 @@ def build_http_app(core: InferenceServerCore) -> web.Application:
         body = await request.read()
         try:
             infer_request = _generate_request(request, body)
-            response = await _run(core.infer, infer_request)
+            response = await _run(core.infer, infer_request,
+                                  request.headers.get("traceparent"))
             return web.json_response(_generate_json(response))
         except InferenceServerException as e:
             return _error_response(e)
@@ -369,9 +385,13 @@ def build_http_app(core: InferenceServerCore) -> web.Application:
         import threading
 
         cancelled = threading.Event()
+        # W3C propagation parity with /infer: a caller-supplied
+        # traceparent joins the stream's span tree (and thereby the
+        # TTFT/ITL exemplars) to the client's trace.
+        trace_context = request.headers.get("traceparent")
 
         def _produce():
-            generator = core.stream_infer(infer_request)
+            generator = core.stream_infer(infer_request, trace_context)
             try:
                 for stream_response in generator:
                     if cancelled.is_set():
@@ -440,6 +460,9 @@ def build_http_app(core: InferenceServerCore) -> web.Application:
             tensor.shape.extend([1])
             infer_request.raw_input_contents.append(
                 _json_data_to_raw([int(max_tokens)], "INT32", "max_tokens"))
+        from client_tpu.server.core import mint_request_id
+
+        mint_request_id(infer_request)
         return infer_request
 
     def _openai_text(response: pb.ModelInferResponse) -> str:
@@ -538,9 +561,10 @@ def build_http_app(core: InferenceServerCore) -> web.Application:
         queue_: asyncio.Queue = asyncio.Queue()
         DONE = object()
         cancelled = threading.Event()
+        trace_context = request.headers.get("traceparent")
 
         def _produce():
-            generator = core.stream_infer(infer_request)
+            generator = core.stream_infer(infer_request, trace_context)
             try:
                 for stream_response in generator:
                     if cancelled.is_set():

@@ -348,6 +348,16 @@ class ReplicaSet:
         except InferenceServerException as first:
             if (first.status() or "") in CLIENT_ERROR_STATUSES:
                 raise  # deterministic: a sibling fails it identically
+            if sticky_key is not None and replica.healthy():
+                # A TRANSIENT fault on a still-healthy pinned replica
+                # must not fail over: the sequence's replica-local
+                # implicit state lives on this replica, and a sibling
+                # would silently run stateless (wrong results, not an
+                # error). Surface the fault instead — the client's
+                # retry re-routes to the same healthy pin. Ejected
+                # pins still re-dispatch + re-pin below (state loss is
+                # inherent to losing the fault domain).
+                raise
             try:
                 sibling = self._pick(exclude={replica.index},
                                      sticky_key=sticky_key)

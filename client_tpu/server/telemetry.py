@@ -1,0 +1,435 @@
+"""Always-on latency histograms + streaming-token telemetry.
+
+The server-side SLO layer the span tracer (client_tpu.server.tracing)
+cannot be: tracing samples 1-in-N requests and renders a span tree per
+sample — perfect for attributing ONE slow request, useless as a
+continuously scraped p99. This module keeps fixed-bucket, log-spaced
+latency histograms for EVERY request at every serving stage the span
+tree delineates, cheap enough to stay on at trace_rate=0, and exposes
+them as proper Prometheus histogram families
+(``_bucket{le=...}`` / ``_sum`` / ``_count``):
+
+* ``tpu_request_duration_us{model=...}`` — end-to-end served requests
+  (success paths only: cache hits, scheduler paths, direct executes).
+* ``tpu_stage_duration_us{model=...,stage=...}`` — per-stage time.
+  Per-request stages (``decode`` / ``queue`` / ``execute`` /
+  ``encode``) tile the request like the span tree's timeline; the
+  dynamic batcher adds per-fused-execution stages (``batch_execute``
+  / ``relay_fetch``) — one observation per fused batch, not per
+  member request.
+* ``tpu_stream_first_response_us{model=...}`` — server-observed time
+  to first streamed response (TTFT for token streams), measured from
+  stream admission to the model producing its first response.
+* ``tpu_stream_inter_response_us{model=...}`` — server-observed gap
+  between consecutive streamed responses (inter-token latency for
+  one-token-per-response LLM streams).
+* ``tpu_stream_responses_total{model=...}`` — responses streamed.
+* ``tpu_tenant_request_duration_us{tenant=...}`` — per-tenant
+  end-to-end histogram (replaces the PR-7 sum-only counter, whose
+  rate() had no paired count to divide by).
+
+Design constraints:
+
+* **Lock-cheap.** One observation is a bisect on a shared immutable
+  bounds tuple plus three integer updates under a per-histogram lock
+  (never the server's stats lock); the bench's telemetry_overhead
+  stage gates the cost at <2% throughput with histograms always on.
+* **Fixed buckets.** A 1-2-5 ladder from 1 us to 10 s. Log-spaced
+  buckets keep relative quantile-estimation error bounded at every
+  scale (a 100 us CPU model and a 10 s LLM decode share one ladder),
+  and fixed bounds mean scrapes are mergeable across models, windows,
+  and servers.
+* **Trace-joinable.** When the observed request was trace-sampled,
+  the bucket it lands in keeps an OpenMetrics-style exemplar
+  (``# {trace_id="..."} value timestamp``) — a dashboard's p99
+  outlier bucket links straight to the span tree that explains it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from bisect import bisect_left
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# Bucket upper bounds in MICROSECONDS: a 1-2-5 ladder from 1 us to
+# 10 s, +Inf implied as the final bucket. Shared by every histogram so
+# scrapes merge and the perf harness can estimate quantiles without
+# reading bounds out of band.
+DEFAULT_BOUNDS_US: Tuple[float, ...] = (
+    1, 2, 5, 10, 20, 50, 100, 200, 500,
+    1_000, 2_000, 5_000, 10_000, 20_000, 50_000,
+    100_000, 200_000, 500_000,
+    1_000_000, 2_000_000, 5_000_000, 10_000_000,
+)
+
+INF = float("inf")
+
+
+def bucket_width_us(value_us: float,
+                    bounds: Tuple[float, ...] = DEFAULT_BOUNDS_US
+                    ) -> float:
+    """Width of the bucket containing ``value_us`` — the resolution
+    bound tests hold quantile estimates to."""
+    idx = bisect_left(bounds, value_us)
+    if idx >= len(bounds):
+        return INF
+    lower = bounds[idx - 1] if idx > 0 else 0.0
+    return bounds[idx] - lower
+
+
+def format_le(bound: float) -> str:
+    """Prometheus ``le`` label value: integers render bare, +Inf as
+    the literal ``+Inf``."""
+    if bound == INF:
+        return "+Inf"
+    if bound == int(bound):
+        return "%d" % int(bound)
+    return repr(bound)
+
+
+class LatencyHistogram:
+    """One fixed-bucket latency accumulator (values in microseconds).
+
+    ``observe`` is the hot path: bisect against the shared bounds
+    (outside the lock — bounds are immutable), then three updates
+    under the histogram's own lock. Exemplars are kept per bucket,
+    last-writer-wins: the freshest trace-sampled request to land in a
+    bucket is the one a dashboard wants to open anyway."""
+
+    __slots__ = ("bounds", "_counts", "_sum", "_count", "_lock",
+                 "_exemplars")
+
+    def __init__(self, bounds: Tuple[float, ...] = DEFAULT_BOUNDS_US):
+        self.bounds = bounds
+        self._counts = [0] * (len(bounds) + 1)  # last = +Inf bucket
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+        # bucket index -> (trace_id, observed value, unix seconds)
+        self._exemplars: Dict[int, Tuple[str, float, float]] = {}
+
+    def observe(self, value_us: float,
+                trace_id: Optional[str] = None) -> None:
+        if value_us < 0:
+            value_us = 0.0
+        idx = bisect_left(self.bounds, value_us)
+        if trace_id is None:
+            with self._lock:
+                self._counts[idx] += 1
+                self._sum += value_us
+                self._count += 1
+        else:
+            # time.time() outside the lock: exemplar timestamps are
+            # wall-clock for dashboard display, not ordering.
+            stamp = (trace_id, value_us, time.time())
+            with self._lock:
+                self._counts[idx] += 1
+                self._sum += value_us
+                self._count += 1
+                self._exemplars[idx] = stamp
+
+    def snapshot(self) -> dict:
+        """``{"buckets": [(le, cumulative_count), ...], "sum": float,
+        "count": int, "exemplars": {le: (trace_id, value, ts)}}`` —
+        buckets are CUMULATIVE (Prometheus semantics) and always end
+        at +Inf."""
+        with self._lock:
+            counts = list(self._counts)
+            total_sum = self._sum
+            total = self._count
+            exemplars = dict(self._exemplars)
+        buckets: List[Tuple[float, int]] = []
+        running = 0
+        for bound, count in zip(self.bounds, counts):
+            running += count
+            buckets.append((bound, running))
+        buckets.append((INF, running + counts[-1]))
+        return {
+            "buckets": buckets,
+            "sum": total_sum,
+            "count": total,
+            "exemplars": {
+                (self.bounds[idx] if idx < len(self.bounds) else INF):
+                    exemplar
+                for idx, exemplar in exemplars.items()
+            },
+        }
+
+
+def estimate_quantile(buckets: Iterable[Tuple[float, float]],
+                      q: float) -> float:
+    """Quantile estimate (same value space as the bounds, us here)
+    from CUMULATIVE ``(le, count)`` pairs — the classic
+    histogram_quantile(): find the bucket holding rank ``q * total``
+    and interpolate linearly inside it. The +Inf bucket clamps to the
+    highest finite bound (an estimate beyond the ladder is a lie; the
+    clamp at least says "at or past the top"). Returns 0.0 for an
+    empty histogram."""
+    pairs = sorted(buckets, key=lambda pair: pair[0])
+    if not pairs:
+        return 0.0
+    total = pairs[-1][1]
+    if total <= 0:
+        return 0.0
+    rank = q * total
+    prev_bound, prev_cum = 0.0, 0.0
+    for bound, cum in pairs:
+        if cum >= rank:
+            if bound == INF:
+                return prev_bound
+            in_bucket = cum - prev_cum
+            if in_bucket <= 0:
+                return bound
+            fraction = (rank - prev_cum) / in_bucket
+            return prev_bound + (bound - prev_bound) * fraction
+        prev_bound, prev_cum = bound, cum
+    return prev_bound
+
+
+class _Counter:
+    """A monotonically increasing counter with its own small lock."""
+
+    __slots__ = ("_value", "_lock")
+
+    def __init__(self):
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def add(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+
+class ModelTelemetry:
+    """Per-model histogram set (request + stages + stream)."""
+
+    __slots__ = ("request", "stages", "stream_first", "stream_inter",
+                 "stream_responses", "_stage_lock")
+
+    def __init__(self):
+        self.request = LatencyHistogram()
+        self.stages: Dict[str, LatencyHistogram] = {}
+        self.stream_first = LatencyHistogram()
+        self.stream_inter = LatencyHistogram()
+        self.stream_responses = _Counter()
+        self._stage_lock = threading.Lock()
+
+    def stage(self, name: str) -> LatencyHistogram:
+        hist = self.stages.get(name)
+        if hist is None:
+            with self._stage_lock:
+                hist = self.stages.get(name)
+                if hist is None:
+                    hist = LatencyHistogram()
+                    self.stages[name] = hist
+        return hist
+
+    def stages_snapshot(self) -> Dict[str, LatencyHistogram]:
+        """Copy of the stage map for iteration: a concurrent first
+        observation of a new stage mutates ``stages`` mid-scrape, and
+        iterating the live dict would raise."""
+        with self._stage_lock:
+            return dict(self.stages)
+
+
+class ServerTelemetry:
+    """The server-wide registry: one ModelTelemetry per model plus the
+    per-tenant duration histograms. ``enabled=False`` turns every
+    observe into a cheap early return — the A/B arm the
+    telemetry_overhead bench stage measures against; the
+    ``CLIENT_TPU_TELEMETRY`` env var (``off``/``0``/``false``)
+    disables it for embedded launches with no ctor surface."""
+
+    # Tenant identity is client-supplied: past this cap new names fold
+    # into the shared overflow row (same bound as qos.py's tracked
+    # tenants) so a rotating header cannot grow /metrics unboundedly.
+    MAX_TENANTS = 1024
+    OVERFLOW_TENANT = "overflow"
+
+    def __init__(self, enabled: Optional[bool] = None):
+        if enabled is None:
+            import os
+
+            enabled = os.environ.get(
+                "CLIENT_TPU_TELEMETRY", "").strip().lower() not in (
+                    "off", "0", "false", "disabled")
+        self.enabled = bool(enabled)
+        self._models: Dict[str, ModelTelemetry] = {}
+        self._tenants: Dict[str, LatencyHistogram] = {}
+        self._lock = threading.Lock()
+
+    # -- recording --------------------------------------------------------
+
+    def for_model(self, model_name: str) -> ModelTelemetry:
+        telemetry = self._models.get(model_name)
+        if telemetry is None:
+            with self._lock:
+                telemetry = self._models.get(model_name)
+                if telemetry is None:
+                    telemetry = ModelTelemetry()
+                    self._models[model_name] = telemetry
+        return telemetry
+
+    def observe_request(self, model_name: str, us: float,
+                        trace_id: Optional[str] = None) -> None:
+        if not self.enabled:
+            return
+        self.for_model(model_name).request.observe(us, trace_id)
+
+    def observe_stage(self, model_name: str, stage: str, us: float,
+                      trace_id: Optional[str] = None) -> None:
+        if not self.enabled:
+            return
+        self.for_model(model_name).stage(stage).observe(us, trace_id)
+
+    def observe_stream_first(self, model_name: str, us: float,
+                             trace_id: Optional[str] = None) -> None:
+        if not self.enabled:
+            return
+        telemetry = self.for_model(model_name)
+        telemetry.stream_first.observe(us, trace_id)
+        telemetry.stream_responses.add(1)
+
+    def observe_stream_gap(self, model_name: str, us: float,
+                           trace_id: Optional[str] = None) -> None:
+        if not self.enabled:
+            return
+        telemetry = self.for_model(model_name)
+        telemetry.stream_inter.observe(us, trace_id)
+        telemetry.stream_responses.add(1)
+
+    def observe_tenant(self, tenant: str, us: float) -> None:
+        if not self.enabled:
+            return
+        hist = self._tenants.get(tenant)
+        if hist is None:
+            with self._lock:
+                hist = self._tenants.get(tenant)
+                if hist is None:
+                    if len(self._tenants) >= self.MAX_TENANTS:
+                        tenant = self.OVERFLOW_TENANT
+                    hist = self._tenants.setdefault(tenant,
+                                                    LatencyHistogram())
+        hist.observe(us)
+
+    # -- exposition -------------------------------------------------------
+
+    @staticmethod
+    def _exemplar_suffix(exemplars: dict, le: float) -> str:
+        entry = exemplars.get(le)
+        if entry is None:
+            return ""
+        trace_id, value, stamp = entry
+        return ' # {trace_id="%s"} %s %.3f' % (trace_id, repr(float(value)),
+                                               stamp)
+
+    @classmethod
+    def _histogram_rows(cls, family: str, label: str, snapshot: dict,
+                        with_exemplars: bool = True) -> List[str]:
+        rows = []
+        exemplars = snapshot["exemplars"] if with_exemplars else {}
+        for le, cumulative in snapshot["buckets"]:
+            rows.append('%s_bucket{%s,le="%s"} %d%s'
+                        % (family, label, format_le(le), cumulative,
+                           cls._exemplar_suffix(exemplars, le)))
+        rows.append("%s_sum{%s} %s" % (family, label,
+                                       repr(float(snapshot["sum"]))))
+        rows.append("%s_count{%s} %d" % (family, label,
+                                         snapshot["count"]))
+        return rows
+
+    def render(self, escape=None, exemplars: bool = True) -> List[str]:
+        """Exposition lines for every non-empty histogram family
+        (HELP/TYPE included; empty families are omitted entirely so
+        an idle server's scrape stays small). ``escape`` sanitizes
+        client-supplied tenant label values. ``exemplars=False``
+        suppresses the OpenMetrics exemplar suffixes — the core passes
+        the current tracing state here, so the exposition returns to
+        strict text-format 0.0.4 the moment tracing is disabled
+        (stored exemplars are retained, not re-emitted)."""
+        if escape is None:
+            escape = lambda value: str(value)  # noqa: E731
+        with self._lock:
+            models = dict(self._models)
+            tenants = dict(self._tenants)
+        lines: List[str] = []
+
+        def family(name, help_text, rows, kind="histogram"):
+            if not rows:
+                return
+            lines.append("# HELP %s %s" % (name, help_text))
+            lines.append("# TYPE %s %s" % (name, kind))
+            lines.extend(rows)
+
+        request_rows: List[str] = []
+        stage_rows: List[str] = []
+        first_rows: List[str] = []
+        inter_rows: List[str] = []
+        response_rows: List[str] = []
+        for name in sorted(models):
+            telemetry = models[name]
+            label = 'model="%s"' % name
+            snap = telemetry.request.snapshot()
+            if snap["count"]:
+                request_rows.extend(self._histogram_rows(
+                    "tpu_request_duration_us", label, snap,
+                    exemplars))
+            stages = telemetry.stages_snapshot()
+            for stage in sorted(stages):
+                snap = stages[stage].snapshot()
+                if snap["count"]:
+                    stage_rows.extend(self._histogram_rows(
+                        "tpu_stage_duration_us",
+                        '%s,stage="%s"' % (label, stage), snap,
+                        exemplars))
+            snap = telemetry.stream_first.snapshot()
+            if snap["count"]:
+                first_rows.extend(self._histogram_rows(
+                    "tpu_stream_first_response_us", label, snap,
+                    exemplars))
+            snap = telemetry.stream_inter.snapshot()
+            if snap["count"]:
+                inter_rows.extend(self._histogram_rows(
+                    "tpu_stream_inter_response_us", label, snap,
+                    exemplars))
+            responses = telemetry.stream_responses.value()
+            if responses:
+                response_rows.append(
+                    "tpu_stream_responses_total{%s} %d"
+                    % (label, responses))
+        family("tpu_request_duration_us",
+               "End-to-end served request duration (histogram; "
+               "success paths incl. cache hits)", request_rows)
+        family("tpu_stage_duration_us",
+               "Per-stage serving time (histogram; per-request stages "
+               "decode/queue/execute/encode tile the request, "
+               "batch_execute/relay_fetch are per fused execution)",
+               stage_rows)
+        family("tpu_stream_first_response_us",
+               "Server-observed time to first streamed response "
+               "(TTFT for token streams)", first_rows)
+        family("tpu_stream_inter_response_us",
+               "Server-observed gap between consecutive streamed "
+               "responses (inter-token latency for token streams)",
+               inter_rows)
+        family("tpu_stream_responses_total",
+               "Responses streamed by decoupled/stream inference",
+               response_rows, kind="counter")
+
+        tenant_rows: List[str] = []
+        for tenant in sorted(tenants):
+            snap = tenants[tenant].snapshot()
+            if snap["count"]:
+                tenant_rows.extend(self._histogram_rows(
+                    "tpu_tenant_request_duration_us",
+                    'tenant="%s"' % escape(tenant), snap, exemplars))
+        family("tpu_tenant_request_duration_us",
+               "End-to-end successful request duration per tenant "
+               "(histogram; replaces the sum-only counter)",
+               tenant_rows)
+        return lines

@@ -492,6 +492,42 @@ def test_sticky_sequence_survives_sibling_ejection():
         replica_set.stop()
 
 
+def test_sticky_transient_fault_on_healthy_pin_does_not_migrate():
+    """A transient (non-ejecting) failure on a still-healthy pinned
+    replica must surface the error, NOT re-dispatch the step to a
+    sibling: the sequence's replica-local state lives on the pin, and
+    a stateless sibling would silently return wrong results."""
+    replica_set, _ = _stub_set(count=3, failure_threshold=3)
+    try:
+        proxy = replica_set.proxy
+        proxy.infer(_one(1), {"sequence_id": 7})
+        pinned = replica_set.sticky_replica(7)
+        assert pinned is not None
+        pinned_model = replica_set.replicas[pinned].model
+        sibling_models = [r.model for r in replica_set.replicas
+                          if r.index != pinned]
+        sibling_calls_before = sum(m.calls for m in sibling_models)
+        # One transient fault on the pinned replica (threshold 3: the
+        # breaker stays closed, the replica stays healthy).
+        pinned_model.fail = True
+        pinned_model.fail_status = "INTERNAL"
+        with pytest.raises(InferenceServerException):
+            proxy.infer(_one(2), {"sequence_id": 7})
+        pinned_model.fail = False
+        # The pin did not migrate, the replica is still healthy, and
+        # no sibling executed the faulted step.
+        assert replica_set.replicas[pinned].healthy()
+        assert replica_set.sticky_replica(7) == pinned
+        assert sum(m.calls for m in sibling_models) \
+            == sibling_calls_before
+        # The retry (client-side semantics) lands back on the pin.
+        out = proxy.infer(_one(2), {"sequence_id": 7})
+        assert replica_set.sticky_replica(7) == pinned
+        assert int(out["OUTPUT"][0]) == 2 + pinned_model.tag
+    finally:
+        replica_set.stop()
+
+
 # -- core integration ------------------------------------------------------
 
 

@@ -1,0 +1,729 @@
+"""Always-on latency histograms + streaming-token telemetry (PR 10):
+unit coverage for the histogram accumulators and quantile estimation,
+exposition lint for the new families, e2e TTFT/ITL population over
+both transports, bucket-quantile fidelity against trace-derived
+latencies on a seeded-latency chaos model, and the exemplar ->
+trace-id join."""
+
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import client_tpu.grpc as grpcclient
+from client_tpu._infer_common import InferInput
+from client_tpu.grpc._utils import get_inference_request
+from client_tpu.server.app import build_core, start_grpc_server
+from client_tpu.server.http_server import start_http_server_thread
+from client_tpu.server.telemetry import (
+    DEFAULT_BOUNDS_US,
+    INF,
+    LatencyHistogram,
+    ServerTelemetry,
+    bucket_width_us,
+    estimate_quantile,
+    format_le,
+)
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+from metrics_lint import check_monotonic, lint_exposition  # noqa: E402
+
+
+# -- histogram unit -------------------------------------------------------
+
+
+def test_histogram_observe_and_cumulative_snapshot():
+    hist = LatencyHistogram()
+    hist.observe(3.0)
+    hist.observe(30.0)
+    hist.observe(1e9)  # beyond the ladder -> +Inf bucket
+    snap = hist.snapshot()
+    assert snap["count"] == 3
+    assert snap["sum"] == pytest.approx(3.0 + 30.0 + 1e9)
+    cumulative = dict(snap["buckets"])
+    assert cumulative[5] == 1
+    assert cumulative[50] == 2
+    assert cumulative[10_000_000] == 2
+    assert cumulative[INF] == 3
+    # the ladder ends at +Inf and is cumulative-non-decreasing
+    bounds = [b for b, _ in snap["buckets"]]
+    assert bounds[-1] == INF
+    counts = [c for _, c in snap["buckets"]]
+    assert counts == sorted(counts)
+
+
+def test_histogram_exemplar_only_for_traced_observations():
+    hist = LatencyHistogram()
+    hist.observe(10.0)
+    assert hist.snapshot()["exemplars"] == {}
+    hist.observe(10.0, trace_id="abc123")
+    exemplars = hist.snapshot()["exemplars"]
+    assert len(exemplars) == 1
+    (bound, (trace_id, value, stamp)), = exemplars.items()
+    assert trace_id == "abc123"
+    assert value == 10.0
+    assert bound in DEFAULT_BOUNDS_US
+
+
+def test_negative_observation_clamps_to_zero():
+    hist = LatencyHistogram()
+    hist.observe(-5.0)
+    snap = hist.snapshot()
+    assert snap["count"] == 1
+    assert snap["sum"] == 0.0
+    assert snap["buckets"][0][1] == 1  # lands in the first bucket
+
+
+def test_estimate_quantile_linear_interpolation():
+    buckets = [(100.0, 50.0), (200.0, 100.0), (INF, 100.0)]
+    assert estimate_quantile(buckets, 0.50) == pytest.approx(100.0)
+    assert estimate_quantile(buckets, 0.25) == pytest.approx(50.0)
+    assert estimate_quantile(buckets, 0.75) == pytest.approx(150.0)
+    assert estimate_quantile(buckets, 0.99) == pytest.approx(198.0)
+
+
+def test_estimate_quantile_edge_cases():
+    assert estimate_quantile([], 0.5) == 0.0
+    assert estimate_quantile([(100.0, 0.0), (INF, 0.0)], 0.5) == 0.0
+    # All mass past the ladder: clamp to the highest finite bound.
+    assert estimate_quantile([(100.0, 0.0), (INF, 10.0)], 0.99) == 100.0
+
+
+def test_bucket_width_and_le_formatting():
+    assert bucket_width_us(30.0) == 30.0   # (20, 50]
+    assert bucket_width_us(1.0) == 1.0     # (0, 1]
+    assert bucket_width_us(1e12) == INF    # beyond the ladder
+    assert format_le(100.0) == "100"
+    assert format_le(INF) == "+Inf"
+
+
+# -- registry + exposition ------------------------------------------------
+
+
+def _lint(text):
+    return lint_exposition(text)
+
+
+def test_registry_render_is_lint_clean_and_typed():
+    registry = ServerTelemetry(enabled=True)
+    registry.observe_request("m", 120.0, "tid123")
+    registry.observe_stage("m", "decode", 5.0)
+    registry.observe_stage("m", "batch_execute", 80.0, "tid456")
+    registry.observe_stream_first("m", 50.0)
+    registry.observe_stream_gap("m", 10.0)
+    registry.observe_tenant("t1", 99.0)
+    text = "\n".join(registry.render()) + "\n"
+    errors, types, series = _lint(text)
+    assert errors == []
+    for family in ("tpu_request_duration_us", "tpu_stage_duration_us",
+                   "tpu_stream_first_response_us",
+                   "tpu_stream_inter_response_us",
+                   "tpu_tenant_request_duration_us"):
+        assert types.get(family) == "histogram", family
+    assert types.get("tpu_stream_responses_total") == "counter"
+    # The traced observations carry exemplars; untraced ones do not.
+    assert '# {trace_id="tid123"}' in text
+    assert '# {trace_id="tid456"}' in text
+
+
+def test_disabled_registry_records_nothing():
+    registry = ServerTelemetry(enabled=False)
+    registry.observe_request("m", 120.0)
+    registry.observe_stream_first("m", 50.0)
+    registry.observe_tenant("t", 10.0)
+    assert registry.render() == []
+
+
+def test_tenant_cardinality_folds_into_overflow(monkeypatch):
+    monkeypatch.setattr(ServerTelemetry, "MAX_TENANTS", 2)
+    registry = ServerTelemetry(enabled=True)
+    for i in range(5):
+        registry.observe_tenant("tenant-%d" % i, 10.0)
+    text = "\n".join(registry.render())
+    counts = [line for line in text.splitlines()
+              if line.startswith("tpu_tenant_request_duration_us_count")]
+    assert len(counts) == 3  # two real tenants + the overflow row
+    assert 'tenant="overflow"' in text
+
+
+# -- lint histogram validation --------------------------------------------
+
+
+_GOOD_HIST = """\
+# HELP tpu_request_duration_us x
+# TYPE tpu_request_duration_us histogram
+tpu_request_duration_us_bucket{model="m",le="100"} 5 # {trace_id="ab"} 42.0 1690000000.000
+tpu_request_duration_us_bucket{model="m",le="+Inf"} 7
+tpu_request_duration_us_sum{model="m"} 900.0
+tpu_request_duration_us_count{model="m"} 7
+"""
+
+
+def test_lint_accepts_histogram_with_exemplar():
+    errors, types, series = _lint(_GOOD_HIST)
+    assert errors == []
+    # histogram children are typed counter for cross-scrape checks
+    assert types["tpu_request_duration_us_bucket"] == "counter"
+
+
+def test_lint_catches_count_mismatch():
+    bad = _GOOD_HIST.replace(
+        'tpu_request_duration_us_count{model="m"} 7',
+        'tpu_request_duration_us_count{model="m"} 9')
+    errors, _, _ = _lint(bad)
+    assert any("_count" in e and "+Inf" in e for e in errors)
+
+
+def test_lint_catches_missing_inf_bucket():
+    bad = "\n".join(line for line in _GOOD_HIST.splitlines()
+                    if 'le="+Inf"' not in line) + "\n"
+    errors, _, _ = _lint(bad)
+    assert any("does not end" in e for e in errors)
+
+
+def test_lint_catches_decreasing_bucket_ladder():
+    bad = _GOOD_HIST.replace(
+        'tpu_request_duration_us_bucket{model="m",le="+Inf"} 7',
+        'tpu_request_duration_us_bucket{model="m",le="+Inf"} 3')
+    errors, _, _ = _lint(bad)
+    assert any("decreases" in e or "_count" in e for e in errors)
+
+
+def test_lint_catches_missing_sum():
+    bad = "\n".join(line for line in _GOOD_HIST.splitlines()
+                    if "_sum" not in line) + "\n"
+    errors, _, _ = _lint(bad)
+    assert any("missing _sum" in e for e in errors)
+
+
+def test_lint_hostile_label_value_is_not_an_exemplar():
+    """An escaped label VALUE may legally contain '# {...}' (tenant
+    identity is client-supplied); the exemplar splitter must not
+    mangle such a sample."""
+    hostile = (
+        "# HELP tpu_tenant_success_total x\n"
+        "# TYPE tpu_tenant_success_total counter\n"
+        'tpu_tenant_success_total{tenant="a # {b} c"} 5\n')
+    errors, _, series = _lint(hostile)
+    assert errors == []
+    assert ("tpu_tenant_success_total",
+            'tenant="a # {b} c"') in series
+
+
+def test_lint_rejects_malformed_exemplar():
+    bad = _GOOD_HIST.replace('# {trace_id="ab"} 42.0 1690000000.000',
+                             '# {trace_id=ab} 42.0')
+    errors, _, _ = _lint(bad)
+    assert any("exemplar" in e for e in errors)
+
+
+def test_histogram_buckets_monotonic_across_scrapes():
+    after = _GOOD_HIST.replace(
+        'tpu_request_duration_us_bucket{model="m",le="100"} 5 ',
+        'tpu_request_duration_us_bucket{model="m",le="100"} 3 ')
+    errors_a, types, before_series = _lint(_GOOD_HIST)
+    errors_b, types_b, after_series = _lint(after)
+    violations = check_monotonic(types_b, before_series, after_series)
+    assert any("tpu_request_duration_us_bucket" in v
+               for v in violations)
+
+
+# -- metrics_manager scrape + quantiles -----------------------------------
+
+
+_SCRAPE_BEFORE = """\
+# TYPE tpu_request_duration_us histogram
+tpu_request_duration_us_bucket{model="simple",le="100"} 10
+tpu_request_duration_us_bucket{model="simple",le="200"} 10
+tpu_request_duration_us_bucket{model="simple",le="+Inf"} 10
+tpu_request_duration_us_sum{model="simple"} 500.0
+tpu_request_duration_us_count{model="simple"} 10
+"""
+
+_SCRAPE_AFTER = """\
+# TYPE tpu_request_duration_us histogram
+tpu_request_duration_us_bucket{model="simple",le="100"} 60
+tpu_request_duration_us_bucket{model="simple",le="200"} 110
+tpu_request_duration_us_bucket{model="simple",le="+Inf"} 110
+tpu_request_duration_us_sum{model="simple"} 13000.0
+tpu_request_duration_us_count{model="simple"} 110
+# TYPE tpu_stream_first_response_us histogram
+tpu_stream_first_response_us_bucket{model="llm",le="1000"} 4
+tpu_stream_first_response_us_bucket{model="llm",le="+Inf"} 4
+tpu_stream_first_response_us_sum{model="llm"} 2000.0
+tpu_stream_first_response_us_count{model="llm"} 4
+# TYPE tpu_stage_duration_us histogram
+tpu_stage_duration_us_bucket{model="simple",stage="queue",le="50"} 8
+tpu_stage_duration_us_bucket{model="simple",stage="queue",le="+Inf"} 8
+tpu_stage_duration_us_sum{model="simple",stage="queue"} 100.0
+tpu_stage_duration_us_count{model="simple",stage="queue"} 8
+"""
+
+
+def test_scrape_parses_histogram_children():
+    from client_tpu.perf.metrics_manager import parse_prometheus
+
+    snap = parse_prometheus(_SCRAPE_AFTER)
+    buckets = snap.histograms["request_duration_us"]["simple"]
+    assert buckets[100.0] == 60
+    assert buckets[float("inf")] == 110
+    assert snap.hist_count["request_duration_us"]["simple"] == 110
+    # stage series key folds the stage label in
+    assert "simple|squeue" in snap.histograms["stage_duration_us"]
+
+
+def test_window_quantiles_from_bucket_deltas():
+    from client_tpu.perf.metrics_manager import (
+        histogram_quantiles,
+        parse_prometheus,
+        summarize_metrics,
+    )
+
+    snaps = [parse_prometheus(_SCRAPE_BEFORE),
+             parse_prometheus(_SCRAPE_AFTER)]
+    quantiles = histogram_quantiles(summarize_metrics(snaps))
+    entry = quantiles["request_duration_us|simple"]
+    # window: 50 obs <= 100us, 50 in (100, 200]
+    assert entry["count"] == 100
+    assert entry["p50_us"] == pytest.approx(100.0)
+    assert entry["p99_us"] == pytest.approx(198.0)
+    assert entry["mean_us"] == pytest.approx(125.0)
+    # A series born mid-window (absent from the first scrape) baselines
+    # at 0, not at its first observed value.
+    ttft = quantiles["stream_first_response_us|llm"]
+    assert ttft["count"] == 4
+    assert ttft["mean_us"] == pytest.approx(500.0)
+
+
+def test_summary_entries_are_merge_additive():
+    """hist! summary entries carry only a 'delta' leaf, the shape the
+    profiler's stable-window merge sums generically."""
+    from client_tpu.perf.metrics_manager import (
+        parse_prometheus,
+        summarize_metrics,
+    )
+
+    summary = summarize_metrics([parse_prometheus(_SCRAPE_BEFORE),
+                                 parse_prometheus(_SCRAPE_AFTER)])
+    hist_entries = {k: v for k, v in summary.items()
+                    if k.startswith("hist!")}
+    assert hist_entries
+    for value in hist_entries.values():
+        assert set(value) == {"delta"}
+
+
+# -- profiler stream_stats plumbing ---------------------------------------
+
+
+def test_normalize_and_delta_stream_stats():
+    from client_tpu.perf.profiler import (
+        _normalize_stats_entry,
+        _numeric_delta,
+    )
+
+    entry = _normalize_stats_entry({
+        "name": "llm", "version": "1", "inference_count": "5",
+        "stream_stats": {
+            "stream_count": "2", "response_count": "8",
+            "first_response": {"count": "2", "ns": "1000"},
+            "inter_response": {"count": "6", "ns": "3000"},
+        },
+    })
+    assert entry["stream_stats"]["stream_count"] == 2
+    assert entry["stream_stats"]["first_response"]["ns"] == 1000
+    before = {"stream_stats": {"stream_count": 1, "response_count": 4,
+                               "first_response": {"count": 1,
+                                                  "ns": 400}}}
+    delta = _numeric_delta(before, entry)
+    assert delta["stream_stats"]["stream_count"] == 1
+    assert delta["stream_stats"]["first_response"]["ns"] == 600
+
+
+def test_print_report_histogram_lines(capsys):
+    from client_tpu.perf.profiler import PerfStatus
+    from client_tpu.perf.report import print_report
+
+    status = PerfStatus()
+    status.concurrency = 1
+    status.completed_count = 10
+    status.throughput = 100.0
+    status.latency_percentiles = {50: 120.0, 99: 260.0}
+    status.tpu_metrics = {
+        "hist!request_duration_us|simple|le=100": {"delta": 5.0},
+        "hist!request_duration_us|simple|le=+Inf": {"delta": 10.0},
+        "hist!request_duration_us|simple|sum": {"delta": 1500.0},
+        "hist!request_duration_us|simple|count": {"delta": 10.0},
+        "hist!stream_first_response_us|simple|le=1000": {"delta": 4.0},
+        "hist!stream_first_response_us|simple|le=+Inf": {"delta": 4.0},
+        "hist!stream_first_response_us|simple|sum": {"delta": 2000.0},
+        "hist!stream_first_response_us|simple|count": {"delta": 4.0},
+    }
+    print_report([status])
+    out = capsys.readouterr().out
+    assert "server simple /metrics histogram" in out
+    assert "client p50 120 / p99 260" in out
+    assert "TTFT p50" in out
+
+
+# -- e2e: one core, both transports ---------------------------------------
+
+
+@pytest.fixture(scope="module")
+def stack():
+    core = build_core(["simple", "repeat_int32"])
+    grpc_handle = start_grpc_server(core=core, address="127.0.0.1:0")
+    http_runner = start_http_server_thread(core, host="127.0.0.1",
+                                           port=0)
+    yield {"core": core, "grpc": grpc_handle.address,
+           "http_port": http_runner.port}
+    http_runner.stop()
+    grpc_handle.stop()
+
+
+def _simple_request(seed=0):
+    in0 = InferInput("INPUT0", [16], "INT32")
+    in0.set_data_from_numpy(np.arange(16, dtype=np.int32) + seed)
+    in1 = InferInput("INPUT1", [16], "INT32")
+    in1.set_data_from_numpy(np.ones(16, dtype=np.int32))
+    return get_inference_request(
+        model_name="simple", inputs=[in0, in1], model_version="",
+        outputs=None, request_id="", sequence_id=0,
+        sequence_start=False, sequence_end=False, priority=0,
+        timeout=None)
+
+
+def _hist_count(text, family, **labels):
+    """The _count value of one histogram series in an exposition."""
+    needle = "%s_count{%s}" % (
+        family, ",".join('%s="%s"' % kv for kv in sorted(labels.items())))
+    for line in text.splitlines():
+        if line.startswith(needle):
+            return float(line.rsplit(" ", 1)[1])
+    return 0.0
+
+
+def test_unary_requests_populate_request_and_stage_histograms(stack):
+    core = stack["core"]
+    before = _hist_count(core.metrics_text(), "tpu_request_duration_us",
+                         model="simple")
+    for i in range(5):
+        core.infer(_simple_request(i))
+    text = core.metrics_text()
+    assert _hist_count(text, "tpu_request_duration_us",
+                       model="simple") >= before + 5
+    for stage in ("decode", "execute", "encode"):
+        assert _hist_count(text, "tpu_stage_duration_us",
+                           model="simple", stage=stage) >= 5
+    errors, types, _ = lint_exposition(text)
+    assert errors == []
+    assert types.get("tpu_request_duration_us") == "histogram"
+
+
+def test_stream_ttft_itl_over_grpc(stack):
+    import queue as _queue
+
+    core = stack["core"]
+    before_text = core.metrics_text()
+    before_first = _hist_count(before_text,
+                               "tpu_stream_first_response_us",
+                               model="repeat_int32")
+    before_inter = _hist_count(before_text,
+                               "tpu_stream_inter_response_us",
+                               model="repeat_int32")
+    with grpcclient.InferenceServerClient(stack["grpc"]) as client:
+        results = _queue.Queue()
+        client.start_stream(
+            lambda result, error: results.put((result, error)))
+        try:
+            tensor = grpcclient.InferInput("IN", [4], "INT32")
+            tensor.set_data_from_numpy(
+                np.array([1, 2, 3, 4], dtype=np.int32))
+            client.async_stream_infer("repeat_int32", [tensor])
+            got = 0
+            while got < 4:
+                result, error = results.get(timeout=10)
+                assert error is None
+                got += 1
+        finally:
+            client.stop_stream()
+        stats = client.get_inference_statistics("repeat_int32")
+    text = stack["core"].metrics_text()
+    # 1 first response + 3 inter-response gaps for a 4-element stream
+    assert _hist_count(text, "tpu_stream_first_response_us",
+                       model="repeat_int32") >= before_first + 1
+    assert _hist_count(text, "tpu_stream_inter_response_us",
+                       model="repeat_int32") >= before_inter + 3
+    # ...and the means travel in ModelStatistics.stream_stats
+    stream = stats.model_stats[0].stream_stats
+    assert stream.response_count >= 4
+    assert stream.first_response.count >= 1
+    assert stream.inter_response.count >= 3
+    assert stream.inter_response.ns > 0
+
+
+def test_stream_ttft_itl_over_http_generate_stream(stack):
+    import http.client as hc
+
+    core = stack["core"]
+    before = _hist_count(core.metrics_text(),
+                         "tpu_stream_inter_response_us",
+                         model="repeat_int32")
+    conn = hc.HTTPConnection("127.0.0.1", stack["http_port"],
+                             timeout=60)
+    conn.request("POST", "/v2/models/repeat_int32/generate_stream",
+                 body=json.dumps({"IN": [7, 8, 9]}),
+                 headers={"Content-Type": "application/json"})
+    response = conn.getresponse()
+    payload = response.read().decode()
+    conn.close()
+    assert response.status == 200
+    assert payload.count("data:") == 3
+    text = core.metrics_text()
+    assert _hist_count(text, "tpu_stream_inter_response_us",
+                       model="repeat_int32") >= before + 2
+    # stream_stats render over the HTTP statistics route too
+    import urllib.request
+
+    with urllib.request.urlopen(
+            "http://127.0.0.1:%d/v2/models/repeat_int32/stats"
+            % stack["http_port"], timeout=10) as resp:
+        doc = json.loads(resp.read())
+    stream = doc["model_stats"][0]["stream_stats"]
+    assert int(stream["response_count"]) >= 3
+
+
+def test_unary_through_stream_records_ttft(stack):
+    core = stack["core"]
+    before = _hist_count(core.metrics_text(),
+                         "tpu_stream_first_response_us", model="simple")
+    responses = list(core.stream_infer(_simple_request(3)))
+    assert len(responses) == 1
+    assert _hist_count(core.metrics_text(),
+                       "tpu_stream_first_response_us",
+                       model="simple") >= before + 1
+
+
+def test_tenant_duration_is_a_histogram_not_a_bare_counter(stack):
+    core = stack["core"]
+    request = _simple_request(11)
+    request.parameters["tenant"].string_param = "acme-corp"
+    core.infer(request)
+    text = core.metrics_text()
+    assert 'tpu_tenant_request_duration_us_bucket{tenant="acme-corp"' \
+        in text
+    assert 'tpu_tenant_request_duration_us_count{tenant="acme-corp"' \
+        in text
+    # the PR-7 sum-only counter sample must be gone
+    for line in text.splitlines():
+        assert not line.startswith("tpu_tenant_request_duration_us{")
+    errors, types, _ = lint_exposition(text)
+    assert errors == []
+    assert types["tpu_tenant_request_duration_us"] == "histogram"
+
+
+def test_metrics_content_negotiation_over_http(stack):
+    """Exemplars + '# EOF' are OpenMetrics syntax: served only when
+    the scraper negotiates that flavor via Accept; the default
+    text-format response never carries either."""
+    import urllib.request
+
+    url = "http://127.0.0.1:%d/metrics" % stack["http_port"]
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        plain = resp.read().decode()
+        plain_type = resp.headers.get("Content-Type", "")
+    assert "# EOF" not in plain
+    assert "# {" not in plain
+    assert "text/plain" in plain_type
+    request = urllib.request.Request(
+        url, headers={"Accept": "application/openmetrics-text"})
+    with urllib.request.urlopen(request, timeout=10) as resp:
+        openmetrics = resp.read().decode()
+        om_type = resp.headers.get("Content-Type", "")
+    assert openmetrics.rstrip().endswith("# EOF")
+    assert "application/openmetrics-text" in om_type
+    errors, _, _ = lint_exposition(openmetrics)
+    assert errors == []
+
+
+def test_telemetry_survives_concurrent_load_lint_clean(stack):
+    core = stack["core"]
+
+    def worker(offset):
+        for i in range(10):
+            core.infer(_simple_request(offset + i))
+
+    threads = [threading.Thread(target=worker, args=(i * 100,))
+               for i in range(4)]
+    for thread in threads:
+        thread.start()
+    for thread in threads:
+        thread.join()
+    errors, _, _ = lint_exposition(core.metrics_text())
+    assert errors == []
+
+
+# -- quantile fidelity + exemplar join on a seeded-latency model ----------
+
+
+def test_bucket_p99_matches_trace_p99_and_exemplar_joins(tmp_path):
+    from client_tpu.perf.metrics_manager import (
+        histogram_quantiles,
+        parse_prometheus,
+        summarize_metrics,
+    )
+    from client_tpu.server import chaos
+
+    core = build_core(["simple"])
+    trace_file = tmp_path / "trace.jsonl"
+    try:
+        chaos.configure(chaos.ChaosConfig(latency_ms=20,
+                                          models={"simple"}))
+        core.trace_setting("", {
+            "trace_level": ["TIMESTAMPS"], "trace_rate": ["1"],
+            "trace_count": ["-1"], "log_frequency": ["1"],
+            "trace_file": [str(trace_file)],
+            "trace_mode": ["compact"]})
+        before = core.metrics_text()
+        for i in range(30):
+            core.infer(_simple_request(i))
+        # The OpenMetrics flavor (negotiated via Accept on the HTTP
+        # front-ends) carries the exemplars; the plain flavor must
+        # stay exemplar-free even while tracing is on.
+        after = core.metrics_text(openmetrics=True)
+        assert after.rstrip().endswith("# EOF")
+        assert "# {" not in core.metrics_text()
+        core.trace_setting("", {"trace_level": ["OFF"]})
+        records = [json.loads(line)
+                   for line in trace_file.read_text().splitlines()
+                   if line.strip()]
+        assert len(records) == 30
+        roots_us = []
+        trace_ids = set()
+        for record in records:
+            trace_ids.add(record["trace_id"])
+            root = next(s for s in record["spans"]
+                        if s["name"] == "request")
+            roots_us.append((root["end_ns"] - root["start_ns"])
+                            / 1000.0)
+        roots_us.sort()
+        trace_p99 = roots_us[int(len(roots_us) * 0.99) - 1]
+        quantiles = histogram_quantiles(summarize_metrics(
+            [parse_prometheus(before), parse_prometheus(after)]))
+        entry = quantiles["request_duration_us|simple"]
+        assert entry["count"] == 30
+        # The estimate must land within one bucket width of the
+        # trace-derived p99 (the ladder's resolution bound).
+        assert abs(entry["p99_us"] - trace_p99) \
+            <= bucket_width_us(trace_p99)
+        # Exemplar -> trace join: the hot bucket's exemplar names a
+        # trace id that exists in the trace file.
+        exemplar_ids = set()
+        for line in after.splitlines():
+            if line.startswith("tpu_request_duration_us_bucket") \
+                    and "# {" in line:
+                exemplar_ids.add(
+                    line.split('trace_id="', 1)[1].split('"', 1)[0])
+        assert exemplar_ids, "no exemplars on a trace_rate=1 run"
+        assert exemplar_ids & trace_ids
+        # The plain text-format flavor stays exemplar-free after
+        # tracing is off too (stored exemplars serve only negotiated
+        # OpenMetrics scrapes).
+        assert "# {" not in core.metrics_text()
+    finally:
+        chaos.configure(None)
+        core.shutdown()
+
+
+# -- genai server-side join -----------------------------------------------
+
+
+_GENAI_BEFORE = """\
+# TYPE tpu_stream_first_response_us histogram
+tpu_stream_first_response_us_bucket{model="llm",le="10000"} 0
+tpu_stream_first_response_us_bucket{model="llm",le="20000"} 0
+tpu_stream_first_response_us_bucket{model="llm",le="+Inf"} 0
+tpu_stream_first_response_us_sum{model="llm"} 0
+tpu_stream_first_response_us_count{model="llm"} 0
+"""
+
+_GENAI_AFTER = """\
+# TYPE tpu_stream_first_response_us histogram
+tpu_stream_first_response_us_bucket{model="llm",le="10000"} 8
+tpu_stream_first_response_us_bucket{model="llm",le="20000"} 16
+tpu_stream_first_response_us_bucket{model="llm",le="+Inf"} 16
+tpu_stream_first_response_us_sum{model="llm"} 200000.0
+tpu_stream_first_response_us_count{model="llm"} 16
+# TYPE tpu_stream_inter_response_us histogram
+tpu_stream_inter_response_us_bucket{model="llm",le="1000"} 50
+tpu_stream_inter_response_us_bucket{model="llm",le="2000"} 100
+tpu_stream_inter_response_us_bucket{model="llm",le="+Inf"} 100
+tpu_stream_inter_response_us_sum{model="llm"} 120000.0
+tpu_stream_inter_response_us_count{model="llm"} 100
+"""
+
+
+def test_genai_parse_server_histograms_canned_scrape():
+    from client_tpu.genai.metrics import parse_server_histograms
+
+    rows = parse_server_histograms(_GENAI_BEFORE, _GENAI_AFTER, "llm")
+    ttft = rows["server_time_to_first_token_ms"]
+    assert ttft["p50"] == pytest.approx(10.0)     # 10000 us
+    assert ttft["mean"] == pytest.approx(12.5)    # 200000/16 us
+    itl = rows["server_inter_token_latency_ms"]
+    assert itl["p50"] == pytest.approx(1.0)
+    assert itl["p99"] == pytest.approx(1.98)
+    # unknown model: no rows, caller prints a notice instead
+    assert parse_server_histograms(_GENAI_BEFORE, _GENAI_AFTER,
+                                   "other") == {}
+
+
+def test_genai_console_report_includes_server_rows():
+    from client_tpu.genai.exporters import console_report
+    from client_tpu.genai.metrics import (
+        LLMMetrics,
+        Statistics,
+        parse_server_histograms,
+    )
+
+    metrics = LLMMetrics(
+        time_to_first_token_ns=[15_000_000, 16_000_000],
+        inter_token_latency_ns=[1_200_000] * 4,
+        request_latency_ns=[30_000_000, 32_000_000],
+        output_token_counts=[4, 4],
+        benchmark_duration_s=1.0)
+    stats = Statistics(metrics)
+    stats.stats.update(parse_server_histograms(
+        _GENAI_BEFORE, _GENAI_AFTER, "llm"))
+    report = console_report(stats)
+    assert "server_time_to_first_token_ms" in report
+    assert "server_inter_token_latency_ms" in report
+    # rows with partial columns render "-" cells, never NaN
+    assert "nan" not in report
+
+
+def test_genai_html_report_includes_server_rows(tmp_path):
+    from client_tpu.genai.html_report import generate_html_report
+    from client_tpu.genai.metrics import (
+        LLMMetrics,
+        Statistics,
+        parse_server_histograms,
+    )
+
+    metrics = LLMMetrics(
+        time_to_first_token_ns=[15_000_000],
+        inter_token_latency_ns=[1_200_000] * 3,
+        request_latency_ns=[30_000_000],
+        output_token_counts=[4],
+        benchmark_duration_s=1.0,
+        itl_sequences_ns=[[1_200_000] * 3])
+    stats = Statistics(metrics)
+    stats.stats.update(parse_server_histograms(
+        _GENAI_BEFORE, _GENAI_AFTER, "llm"))
+    path = generate_html_report([stats], str(tmp_path), title="t")
+    html_text = open(path).read()
+    assert "server TTFT p99 (ms)" in html_text
+    assert "server_time_to_first_token_ms" in html_text

@@ -6,7 +6,7 @@
 # regression gate). Usage: tools/ci_check.sh [min_passed]
 set -u -o pipefail
 
-MIN_PASSED="${1:-505}"
+MIN_PASSED="${1:-540}"
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
 LOG=/tmp/_t1.log
 
@@ -102,9 +102,12 @@ grep -E "Failover summary|client-visible|failovers|ejections" "$FO_LOG"
 echo "OK: failover smoke passed (100% goodput through an endpoint kill)"
 
 # Metrics lint: the Prometheus exposition must stay well-formed
-# (HELP/TYPE before samples, escaped labels, no duplicate series) and
-# counters must stay monotonic across two scrapes under load.
-echo "metrics lint: exposition format + counter monotonicity"
+# (HELP/TYPE before samples, escaped labels, no duplicate series,
+# histogram ladders strictly increasing and ending +Inf with
+# _count == +Inf bucket, exemplar syntax valid) and counters —
+# histogram buckets included — must stay monotonic across two scrapes
+# under unary AND streaming load.
+echo "metrics lint: exposition format + histograms + monotonicity"
 LINT_LOG=/tmp/_metrics_lint.log
 if ! timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/metrics_lint.py \
     > "$LINT_LOG" 2>&1; then
@@ -114,6 +117,23 @@ if ! timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/metrics_lint.py \
 fi
 grep "metrics lint passed" "$LINT_LOG"
 echo "OK: metrics lint passed"
+
+# Telemetry smoke: the always-on latency-histogram layer must (a)
+# expose lint-clean histogram families after unary + streaming load,
+# (b) estimate a server p99 from bucket deltas within 2x of the
+# client-observed p99 of the same window, and (c) cost <2% throughput
+# vs recording disabled (paired A/B medians on add_sub_large). Gates
+# live in tools/telemetry_smoke.py.
+echo "telemetry smoke: histogram presence + quantile fidelity + overhead"
+TELEMETRY_LOG=/tmp/_telemetry_smoke.log
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/telemetry_smoke.py \
+    > "$TELEMETRY_LOG" 2>&1; then
+    echo "FAIL: telemetry smoke did not pass" >&2
+    tail -30 "$TELEMETRY_LOG" >&2
+    exit 1
+fi
+grep -E "telemetry smoke passed" "$TELEMETRY_LOG"
+echo "OK: telemetry smoke passed"
 
 # Trace smoke: perf run with span tracing at trace_rate=1 — the
 # stage-attribution table must be emitted and the instrumented stages

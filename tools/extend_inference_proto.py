@@ -132,6 +132,17 @@ REPLICA_COUNT_FIELDS = [
     ("total_replicas", 19, U64),
 ]
 
+# Streaming-token telemetry (PR 10): completed streams, responses
+# streamed, and the server-observed TTFT / inter-response sums
+# (StatisticDuration count+ns pairs). ModelStatistics.stream_stats is
+# field 20.
+STREAM_STATS_FIELDS = [
+    ("stream_count", 1, U64, None),
+    ("response_count", 2, U64, None),
+    ("first_response", 3, MESSAGE, ".inference.StatisticDuration"),
+    ("inter_response", 4, MESSAGE, ".inference.StatisticDuration"),
+]
+
 # Response-cache path durations on InferStatistics (1..6 are the
 # Triton-parity sections present since the seed).
 CACHE_DURATION_FIELDS = [
@@ -288,6 +299,24 @@ def patch_inference(file_proto: descriptor_pb2.FileDescriptorProto) -> bool:
             model_stats.field.add(name=name, number=number, type=ftype,
                                   label=OPTIONAL, json_name=_json_name(name))
             changed = True
+    names = [m.name for m in file_proto.message_type]
+    if "StreamStatistics" not in names:
+        anchor = names.index("SequenceBatchingStatistics") + 1
+        message = descriptor_pb2.DescriptorProto(name="StreamStatistics")
+        for name, number, ftype, type_name in STREAM_STATS_FIELDS:
+            field = message.field.add(name=name, number=number,
+                                      type=ftype, label=OPTIONAL,
+                                      json_name=_json_name(name))
+            if type_name:
+                field.type_name = type_name
+        file_proto.message_type.insert(anchor, message)
+        changed = True
+    if not any(f.name == "stream_stats" for f in model_stats.field):
+        model_stats.field.add(
+            name="stream_stats", number=20, type=MESSAGE, label=OPTIONAL,
+            type_name=".inference.StreamStatistics",
+            json_name="streamStats")
+        changed = True
     infer_stats = next(
         m for m in file_proto.message_type if m.name == "InferStatistics")
     for name, number in CACHE_DURATION_FIELDS:

@@ -3,7 +3,8 @@
 
 Validates the /metrics surface the perf MetricsManager and external
 scrapers consume, then proves counter monotonicity across two scrapes
-taken under concurrent load:
+taken under concurrent load (unary AND streaming, so the latency
+histogram and stream-telemetry families are exercised):
 
 * every sample's family has a ``# HELP`` and ``# TYPE`` line, and both
   appear BEFORE the family's first sample (Prometheus exposition
@@ -12,7 +13,14 @@ taken under concurrent load:
   (no raw ``"``, ``\\`` or newline inside a quoted value);
 * no duplicate series (family + label set appears once per scrape);
 * ``_total``-suffixed families are typed ``counter``;
-* every family typed ``counter`` is monotonically non-decreasing
+* histogram families are structurally sound: per label set, ``le``
+  bucket bounds are unique/parseable and end in ``+Inf``, cumulative
+  bucket counts are non-decreasing in ``le``, ``_count`` equals the
+  ``+Inf`` bucket, and a ``_sum`` series is present;
+* OpenMetrics-style exemplars (``# {trace_id="..."} value [ts]``) are
+  accepted on ``_bucket``/counter samples and their syntax validated;
+* every family typed ``counter`` — histogram ``_bucket`` / ``_sum`` /
+  ``_count`` children included — is monotonically non-decreasing
   between two scrapes with inference traffic in between.
 
 Run directly (``python tools/metrics_lint.py``) or from
@@ -25,7 +33,7 @@ import os
 import re
 import sys
 import threading
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -34,16 +42,49 @@ _NAME = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_NAME = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
 # One label pair: name="value" with only escaped specials inside.
 _LABEL_PAIR = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\\n]|\\.)*)"')
+# OpenMetrics exemplar suffix on a sample line:
+#   ``... 42 # {trace_id="abc"} 95.0 1690000000.000``
+_EXEMPLAR = re.compile(
+    r"\s#\s*\{(?P<labels>[^}]*)\}\s+(?P<value>\S+)(?:\s+(?P<ts>\S+))?\s*$")
+
+# Suffixes a histogram-typed family's child series may use.
+_HISTOGRAM_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def histogram_base(family: str, types: Dict[str, str]) -> Optional[str]:
+    """The histogram family ``family`` is a child series of (e.g.
+    ``tpu_request_duration_us_bucket`` -> ``tpu_request_duration_us``)
+    or None when it is not a histogram child."""
+    for suffix in _HISTOGRAM_SUFFIXES:
+        if family.endswith(suffix):
+            base = family[: -len(suffix)]
+            if types.get(base) == "histogram":
+                return base
+    return None
+
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?\s+(?P<value>\S+)\s*$")
 
 
 def _parse_sample(line: str):
-    """(family, labels_str, value_str) or None when not a sample."""
-    m = re.match(
-        r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
-        r"(?:\{(?P<labels>.*)\})?\s+(?P<value>\S+)\s*$", line)
+    """(family, labels_str, value_str, exemplar_str_or_None) or None
+    when not a sample. An exemplar suffix is split off first so the
+    value regex never sees it — but only when the remainder still
+    parses as a sample: an ESCAPED label value may legally contain
+    ``# {...}`` (tenant identity is client-supplied), and such a line
+    is one long sample, not a sample plus exemplar."""
+    exemplar = _EXEMPLAR.search(line)
+    if exemplar is not None:
+        m = _SAMPLE_RE.match(line[: exemplar.start()])
+        if m is not None:
+            return (m.group("name"), m.group("labels") or "",
+                    m.group("value"), exemplar)
+    m = _SAMPLE_RE.match(line)
     if m is None:
         return None
-    return m.group("name"), m.group("labels") or "", m.group("value")
+    return m.group("name"), m.group("labels") or "", m.group("value"), None
 
 
 def lint_exposition(text: str) -> Tuple[List[str], Dict[str, str],
@@ -94,7 +135,29 @@ def lint_exposition(text: str) -> Tuple[List[str], Dict[str, str],
             errors.append("line %d: unparseable sample: %r"
                           % (lineno, line))
             continue
-        family, labels_str, value_str = sample
+        family, labels_str, value_str, exemplar = sample
+        if exemplar is not None:
+            # Exemplars are only meaningful on bucket/counter samples;
+            # syntax: labels parse like sample labels, value is a
+            # float, optional timestamp is a float.
+            if not (family.endswith("_bucket")
+                    or family.endswith("_total")):
+                errors.append(
+                    "line %d: exemplar on non-bucket/counter sample %s"
+                    % (lineno, family))
+            ex_labels = exemplar.group("labels")
+            consumed = _LABEL_PAIR.sub("", ex_labels)
+            if consumed.replace(",", "").strip():
+                errors.append(
+                    "line %d: malformed exemplar labels {%s}"
+                    % (lineno, ex_labels))
+            try:
+                float(exemplar.group("value"))
+                if exemplar.group("ts") is not None:
+                    float(exemplar.group("ts"))
+            except ValueError:
+                errors.append("line %d: non-numeric exemplar value in "
+                              "%r" % (lineno, line))
         first_sample.setdefault(family, lineno)
         if not _NAME.match(family):
             errors.append("line %d: illegal family name %r"
@@ -121,17 +184,21 @@ def lint_exposition(text: str) -> Tuple[List[str], Dict[str, str],
                           % (lineno, family, labels_str))
         series[key] = value
     for family, lineno in first_sample.items():
-        if family not in help_seen:
-            errors.append("family %s has samples but no HELP" % family)
-        elif help_seen[family] > lineno:
+        # Histogram child series (_bucket/_sum/_count) are covered by
+        # their base family's HELP/TYPE lines.
+        base = histogram_base(family, type_seen) or family
+        if base not in help_seen:
+            errors.append("family %s has samples but no HELP" % base)
+        elif help_seen[base] > lineno:
             errors.append("family %s: HELP appears after its first "
-                          "sample" % family)
-        if family not in type_seen:
-            errors.append("family %s has samples but no TYPE" % family)
+                          "sample" % base)
+        if base not in type_seen:
+            errors.append("family %s has samples but no TYPE" % base)
         if family.endswith("_total") and \
                 type_seen.get(family, "counter") != "counter":
             errors.append("family %s ends in _total but is typed %s"
                           % (family, type_seen.get(family)))
+    errors.extend(check_histograms(type_seen, series))
     # TYPE-before-sample ordering (re-scan cheaply).
     type_line: Dict[str, int] = {}
     for lineno, raw in enumerate(text.splitlines(), 1):
@@ -140,10 +207,102 @@ def lint_exposition(text: str) -> Tuple[List[str], Dict[str, str],
             if len(parts) >= 3:
                 type_line.setdefault(parts[2], lineno)
     for family, lineno in first_sample.items():
-        if family in type_line and type_line[family] > lineno:
+        base = histogram_base(family, type_seen) or family
+        if base in type_line and type_line[base] > lineno:
             errors.append("family %s: TYPE appears after its first "
-                          "sample" % family)
-    return errors, type_seen, series
+                          "sample" % base)
+    # Histogram children are cumulative like counters: expose them as
+    # such so check_monotonic covers _bucket/_sum/_count across
+    # scrapes (a bucket count that DROPS means lost observations).
+    effective_types = dict(type_seen)
+    for family in first_sample:
+        if histogram_base(family, type_seen) is not None:
+            effective_types[family] = "counter"
+    return errors, effective_types, series
+
+
+def _le_of(labels_str: str) -> Optional[str]:
+    for name, value in _LABEL_PAIR.findall(labels_str):
+        if name == "le":
+            return value
+    return None
+
+
+def _strip_le(labels_str: str) -> str:
+    pairs = [(name, value)
+             for name, value in _LABEL_PAIR.findall(labels_str)
+             if name != "le"]
+    return ",".join('%s="%s"' % pair for pair in pairs)
+
+
+def check_histograms(types: Dict[str, str],
+                     series: Dict[Tuple[str, str], float]) -> List[str]:
+    """Structural validation of every histogram family in one scrape:
+    per label set, ``le`` bounds parse (``+Inf`` included) and are
+    unique, cumulative counts are non-decreasing in ``le``, the ladder
+    ends in ``+Inf``, ``_count`` equals the ``+Inf`` bucket, and
+    ``_sum`` exists."""
+    errors: List[str] = []
+    histograms = [f for f, kind in types.items() if kind == "histogram"]
+    for base in histograms:
+        groups: Dict[str, List[Tuple[float, float]]] = {}
+        sums: Dict[str, float] = {}
+        counts: Dict[str, float] = {}
+        for (family, labels_str), value in series.items():
+            if family == base + "_sum":
+                sums[labels_str] = value
+                continue
+            if family == base + "_count":
+                counts[labels_str] = value
+                continue
+            if family != base + "_bucket":
+                continue
+            le = _le_of(labels_str)
+            if le is None:
+                errors.append("histogram %s: bucket sample without an "
+                              "le label {%s}" % (base, labels_str))
+                continue
+            try:
+                bound = float("inf") if le == "+Inf" else float(le)
+            except ValueError:
+                errors.append("histogram %s: unparseable le=%r"
+                              % (base, le))
+                continue
+            groups.setdefault(_strip_le(labels_str), []).append(
+                (bound, value))
+        if not groups and (sums or counts):
+            errors.append("histogram %s has _sum/_count but no "
+                          "_bucket series" % base)
+        for group, buckets in groups.items():
+            bounds = [b for b, _ in buckets]
+            if len(set(bounds)) != len(bounds):
+                errors.append("histogram %s{%s}: duplicate le bounds"
+                              % (base, group))
+            buckets.sort(key=lambda pair: pair[0])
+            if not buckets or buckets[-1][0] != float("inf"):
+                errors.append("histogram %s{%s}: bucket ladder does "
+                              "not end in le=\"+Inf\"" % (base, group))
+            last = -1.0
+            for bound, value in buckets:
+                if value < last:
+                    errors.append(
+                        "histogram %s{%s}: cumulative bucket count "
+                        "decreases at le=%s (%s -> %s)"
+                        % (base, group, "+Inf" if bound == float("inf")
+                           else bound, last, value))
+                last = value
+            if group not in sums:
+                errors.append("histogram %s{%s}: missing _sum series"
+                              % (base, group))
+            if group not in counts:
+                errors.append("histogram %s{%s}: missing _count series"
+                              % (base, group))
+            elif buckets and buckets[-1][0] == float("inf") \
+                    and counts[group] != buckets[-1][1]:
+                errors.append(
+                    "histogram %s{%s}: _count %s != +Inf bucket %s"
+                    % (base, group, counts[group], buckets[-1][1]))
+    return errors
 
 
 def check_monotonic(types: Dict[str, str],
@@ -198,16 +357,64 @@ def _drive_load(core, model_name: str, n: int, threads: int) -> None:
         thread.join()
 
 
+def _drive_stream_load(core, n: int = 8) -> None:
+    """Streaming traffic so the tpu_stream_* telemetry families
+    populate: decoupled streams against repeat_int32 (real TTFT + ITL
+    gaps) plus unary-through-stream against simple (one-response
+    streams, TTFT only)."""
+    import numpy as np
+
+    from client_tpu._infer_common import InferInput
+    from client_tpu.grpc._utils import get_inference_request
+
+    for i in range(n):
+        request = get_inference_request(
+            model_name="repeat_int32", inputs=[], outputs=None)
+        tensor = request.inputs.add()
+        tensor.name = "IN"
+        tensor.datatype = "INT32"
+        tensor.shape.extend([4])
+        request.raw_input_contents.append(
+            np.arange(i, i + 4, dtype=np.int32).tobytes())
+        for _ in core.stream_infer(request):
+            pass
+    shape = [16]
+    a = np.full(shape, 7, dtype=np.int32)
+    b = np.arange(16, dtype=np.int32)
+    t0 = InferInput("INPUT0", shape, "INT32")
+    t0.set_data_from_numpy(a)
+    t1 = InferInput("INPUT1", shape, "INT32")
+    t1.set_data_from_numpy(b)
+    request = get_inference_request(model_name="simple",
+                                    inputs=[t0, t1], outputs=None)
+    for _ in range(n):
+        for _ in core.stream_infer(request):
+            pass
+
+
+# Histogram families the telemetry layer must expose once unary AND
+# streaming load has run (the ci_check gate that the SLO surface is
+# actually present, not just lint-clean when absent).
+EXPECTED_HISTOGRAMS = (
+    "tpu_request_duration_us",
+    "tpu_stage_duration_us",
+    "tpu_stream_first_response_us",
+    "tpu_stream_inter_response_us",
+)
+
+
 def main() -> int:
     from client_tpu.server.app import build_core
 
-    core = build_core(["simple", "simple_cache", "simple_replicas"])
+    core = build_core(["simple", "simple_cache", "simple_replicas",
+                       "repeat_int32"])
     try:
         _drive_load(core, "simple", n=20, threads=2)
         _drive_load(core, "simple_cache", n=20, threads=2)
         # simple_replicas exercises the tpu_replica_* families (health
         # gauges + per-replica exec counters) under fused dispatch.
         _drive_load(core, "simple_replicas", n=20, threads=4)
+        _drive_stream_load(core)
         first = core.metrics_text()
         errors, types, series_before = lint_exposition(first)
         # More traffic between the scrapes, half of it replayed so the
@@ -215,10 +422,29 @@ def main() -> int:
         _drive_load(core, "simple", n=20, threads=4)
         _drive_load(core, "simple_cache", n=20, threads=4)
         _drive_load(core, "simple_replicas", n=20, threads=4)
+        _drive_stream_load(core)
         second = core.metrics_text()
         errors2, types2, series_after = lint_exposition(second)
         errors.extend(e for e in errors2 if e not in errors)
         errors.extend(check_monotonic(types2, series_before, series_after))
+        for family in EXPECTED_HISTOGRAMS:
+            if types2.get(family) != "histogram":
+                errors.append(
+                    "expected histogram family %s missing from the "
+                    "exposition under streaming load" % family)
+        # The negotiated OpenMetrics flavor (exemplars + '# EOF') must
+        # lint clean too, and the PLAIN flavor must never leak
+        # exemplar syntax — stock text-format parsers reject it.
+        openmetrics = core.metrics_text(openmetrics=True)
+        errors3, _, _ = lint_exposition(openmetrics)
+        errors.extend("openmetrics: %s" % e for e in errors3
+                      if "openmetrics: %s" % e not in errors)
+        if not openmetrics.rstrip().endswith("# EOF"):
+            errors.append("openmetrics flavor missing the # EOF "
+                          "terminator")
+        if "# {" in second:
+            errors.append("plain text-format flavor leaked exemplar "
+                          "syntax")
         moved = sum(
             1 for key, value in series_after.items()
             if types2.get(key[0]) == "counter"

@@ -1,0 +1,200 @@
+#!/usr/bin/env python
+"""CI smoke for the latency-histogram / streaming-telemetry layer.
+
+Three gates (tools/ci_check.sh step "telemetry smoke"):
+
+1. **Presence + lint.** After a loaded unary run and a streaming run,
+   /metrics must expose the histogram families
+   (tpu_request_duration_us, tpu_stage_duration_us,
+   tpu_stream_first_response_us, tpu_stream_inter_response_us) and
+   the whole exposition must pass tools/metrics_lint.py — bucket
+   ladders strictly increasing and ending +Inf, _count == +Inf
+   bucket, exemplar syntax valid.
+2. **Quantile fidelity.** The server p99 estimated from the
+   request-duration bucket deltas of the loaded window must land
+   within 2x of the client-observed p99 of the same requests — the
+   bucket ladder is coarse by design (1-2-5), but a histogram whose
+   p99 is off by more than the ladder step is not an SLO signal.
+3. **Overhead.** The always-on recording must cost <2% throughput vs
+   telemetry disabled (interleaved A/B medians on add_sub_large via
+   client_tpu.perf.bench_child.run_telemetry_measure) — an SLO signal
+   that must be turned off under load is not always-on.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _simple_request(seed: int):
+    import numpy as np
+
+    from client_tpu._infer_common import InferInput
+    from client_tpu.grpc._utils import get_inference_request
+
+    a = np.full((16,), seed % 97, dtype=np.int32)
+    b = np.arange(16, dtype=np.int32)
+    t0 = InferInput("INPUT0", [16], "INT32")
+    t0.set_data_from_numpy(a)
+    t1 = InferInput("INPUT1", [16], "INT32")
+    t1.set_data_from_numpy(b)
+    return get_inference_request(model_name="simple",
+                                 inputs=[t0, t1], outputs=None)
+
+
+def _loaded_run(core, n: int = 60, threads: int = 4):
+    """Concurrent closed loop on `simple`; returns sorted client
+    latencies (us)."""
+    latencies: list = []
+    merge = threading.Lock()
+
+    def worker(offset: int):
+        local = []
+        for i in range(n):
+            request = _simple_request(offset * 1000 + i)
+            start = time.monotonic_ns()
+            core.infer(request)
+            local.append((time.monotonic_ns() - start) / 1000.0)
+        with merge:
+            latencies.extend(local)
+
+    pool = [threading.Thread(target=worker, args=(i,))
+            for i in range(threads)]
+    for thread in pool:
+        thread.start()
+    for thread in pool:
+        thread.join()
+    latencies.sort()
+    return latencies
+
+
+def _stream_run(core, n: int = 10):
+    import numpy as np
+
+    from client_tpu.grpc._utils import get_inference_request
+
+    for i in range(n):
+        request = get_inference_request(
+            model_name="repeat_int32", inputs=[], outputs=None)
+        tensor = request.inputs.add()
+        tensor.name = "IN"
+        tensor.datatype = "INT32"
+        tensor.shape.extend([4])
+        request.raw_input_contents.append(
+            np.arange(i, i + 4, dtype=np.int32).tobytes())
+        for _ in core.stream_infer(request):
+            pass
+
+
+def main() -> int:
+    from metrics_lint import lint_exposition
+
+    from client_tpu.perf.bench_child import run_telemetry_measure
+    from client_tpu.perf.metrics_manager import (
+        histogram_quantiles,
+        parse_prometheus,
+        summarize_metrics,
+    )
+    from client_tpu.server.app import build_core
+
+    failures = []
+    core = build_core(["simple", "repeat_int32"])
+    try:
+        # Warm (compile + first-request effects outside the window).
+        _loaded_run(core, n=5, threads=2)
+        before_text = core.metrics_text()
+        client_latencies = _loaded_run(core)
+        _stream_run(core)
+        after_text = core.metrics_text()
+
+        # Gate 1: presence + lint-clean exposition.
+        errors, types, _series = lint_exposition(after_text)
+        for family in ("tpu_request_duration_us",
+                       "tpu_stage_duration_us",
+                       "tpu_stream_first_response_us",
+                       "tpu_stream_inter_response_us"):
+            if types.get(family) != "histogram":
+                failures.append("histogram family %s missing" % family)
+        if errors:
+            failures.extend("lint: %s" % e for e in errors[:10])
+        print("exposition: %d families, lint %s"
+              % (len(types), "clean" if not errors
+                 else "%d violations" % len(errors)))
+
+        # Gate 2: bucket-estimated p99 within 2x of client p99 over
+        # the same window.
+        snapshots = [parse_prometheus(before_text),
+                     parse_prometheus(after_text)]
+        quantiles = histogram_quantiles(summarize_metrics(snapshots))
+        entry = quantiles.get("request_duration_us|simple")
+        if not entry:
+            failures.append("no request-duration window delta for "
+                            "'simple'")
+        else:
+            client_p99 = client_latencies[
+                int(len(client_latencies) * 0.99) - 1]
+            server_p99 = entry["p99_us"]
+            ratio = (server_p99 / client_p99 if client_p99 > 0
+                     else float("inf"))
+            print("p99: server (bucket estimate) %.0f us vs client "
+                  "%.0f us (%.2fx) over %d server obs"
+                  % (server_p99, client_p99, ratio, entry["count"]))
+            if not (0.5 <= ratio <= 2.0):
+                failures.append(
+                    "server bucket p99 %.0f us is not within 2x of "
+                    "client p99 %.0f us" % (server_p99, client_p99))
+        ttft = quantiles.get("stream_first_response_us|repeat_int32")
+        itl = quantiles.get("stream_inter_response_us|repeat_int32")
+        if not ttft or not itl:
+            failures.append("stream TTFT/ITL window deltas missing "
+                            "for repeat_int32")
+        else:
+            print("stream: TTFT p50 %.0f us, ITL p50 %.0f us over "
+                  "%d gaps" % (ttft["p50_us"], itl["p50_us"],
+                               itl["count"]))
+
+        # Gate 3: <2% recording overhead, A/B on add_sub_large. The
+        # true cost is ~microseconds against a ~15 ms request, far
+        # below host noise — one retry with more interleaved pairs
+        # filters transient contention (another process's burst can
+        # skew a 4-pair median past 2% when the real cost is ~0).
+        core.repository.load("add_sub_large")
+        overhead = run_telemetry_measure(core, requests=96, rounds=4)
+        if not overhead["overhead_ok"]:
+            print("overhead first pass %.2f%% over the gate; "
+                  "re-measuring with more pairs"
+                  % overhead["overhead_pct"])
+            overhead = run_telemetry_measure(core, requests=96,
+                                             rounds=6)
+        print("overhead: %.2f%% (off %.1f/s vs on %.1f/s; pairs %s; "
+              "gate <%.0f%%)"
+              % (overhead["overhead_pct"],
+                 overhead["telemetry_off_tput"],
+                 overhead["telemetry_on_tput"],
+                 overhead["pair_overheads_pct"],
+                 overhead["overhead_gate_pct"]))
+        if not overhead["overhead_ok"]:
+            failures.append("telemetry overhead %.2f%% exceeds the "
+                            "2%% gate" % overhead["overhead_pct"])
+    finally:
+        core.shutdown()
+    if failures:
+        for failure in failures:
+            print("telemetry smoke: %s" % failure, file=sys.stderr)
+        print("telemetry smoke FAILED (%d gate violation%s)"
+              % (len(failures), "s" if len(failures) != 1 else ""),
+              file=sys.stderr)
+        return 1
+    print("telemetry smoke passed: histograms present + lint-clean, "
+          "bucket p99 within 2x of client, overhead under 2%")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
