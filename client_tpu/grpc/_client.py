@@ -14,6 +14,7 @@ from typing import Callable, Optional, Sequence
 import grpc
 from google.protobuf import json_format
 
+from client_tpu import status_map
 from client_tpu._infer_common import InferInput, InferRequestedOutput
 from client_tpu._plugin import InferenceServerClientBase
 from client_tpu.grpc._utils import (
@@ -138,7 +139,8 @@ class _InferStream:
                 else:
                     self._callback(InferResult(response.infer_response), None)
         except grpc.RpcError as rpc_error:
-            if rpc_error.code() != grpc.StatusCode.CANCELLED:
+            if status_map.status_of_grpc_code(
+                    rpc_error.code()) != "CANCELLED":
                 self._callback(None, get_error_grpc(rpc_error))
         except Exception as e:  # defensive: surface reader crashes
             self._callback(None, InferenceServerException(str(e)))

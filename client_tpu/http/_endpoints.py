@@ -7,6 +7,7 @@ import base64
 import json
 from urllib.parse import quote
 
+from client_tpu import status_map
 from client_tpu.utils import InferenceServerException
 
 
@@ -105,7 +106,7 @@ def unload_model_body(unload_dependents: bool = False) -> bytes:
 
 def raise_if_error(status: int, body: bytes,
                    retry_after_s=None) -> None:
-    if status < 400:
+    if status < status_map.HTTP_ERROR_FLOOR:
         return
     try:
         message = json.loads(body).get("error", "")

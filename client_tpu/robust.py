@@ -45,6 +45,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence
 
+from client_tpu import status_map as _status_map
 from client_tpu.utils import InferenceServerException
 
 # Statuses worth retrying by default: server-side admission rejections
@@ -56,8 +57,9 @@ from client_tpu.utils import InferenceServerException
 # Deadline expiries are NOT default-retryable — a request that timed
 # out once will usually time out again and retrying it doubles load at
 # exactly the moment the server is slowest.
-DEFAULT_RETRYABLE_STATUSES = ("UNAVAILABLE", "503",
-                              "RESOURCE_EXHAUSTED", "429")
+# (The string<->code vocabulary itself lives in client_tpu/status_map —
+# one canonical table for servers and clients alike.)
+DEFAULT_RETRYABLE_STATUSES = _status_map.DEFAULT_RETRYABLE_WIRE
 
 # Statuses that justify FAILOVER to a different endpoint even though
 # they are not retryable against the same one: a server cancelling
@@ -71,11 +73,7 @@ POOL_FAILOVER_STATUSES = frozenset({"CANCELLED"})
 # the endpoint is healthy. These feed the circuit breaker as
 # successes; everything else (availability errors, timeouts, server
 # errors, status-less transport failures) counts toward opening it.
-CLIENT_ERROR_STATUSES = frozenset({
-    "INVALID_ARGUMENT", "400", "NOT_FOUND", "404", "ALREADY_EXISTS",
-    "409", "UNIMPLEMENTED", "501", "PERMISSION_DENIED", "403",
-    "UNAUTHENTICATED", "401",
-})
+CLIENT_ERROR_STATUSES = _status_map.CLIENT_ERROR_WIRE
 
 # Per-tenant quota rejects: retryable (paced by Retry-After) but
 # POLICY signals, not availability evidence — the server answered
@@ -83,7 +81,7 @@ CLIENT_ERROR_STATUSES = frozenset({
 # yet. Counting them as breaker failures would let one over-quota
 # tenant open the circuit / eject a healthy endpoint for all traffic
 # sharing the client.
-QUOTA_REJECT_STATUSES = frozenset({"RESOURCE_EXHAUSTED", "429"})
+QUOTA_REJECT_STATUSES = _status_map.QUOTA_REJECT_WIRE
 
 
 def _breaker_resolve(breaker: "CircuitBreaker", error: BaseException) -> None:
@@ -1194,6 +1192,9 @@ async def _hedged_call_async(pool: EndpointPool, fn,
                         pass
                 if task is not primary_task:
                     pool.note_hedge_won()
+                # tpulint: disable=aio-blocking -- task came from
+                # asyncio.wait's done set; result() on a settled
+                # future returns immediately
                 return task.result()
             errors.append((tasks[task], error))
     for state, error in errors:

@@ -32,6 +32,7 @@ from typing import Iterator, Optional
 
 import numpy as np
 
+from client_tpu import status_map
 from client_tpu.protocol import arena_pb2
 from client_tpu.server.tpu_arena import TpuArena
 from client_tpu.utils import (
@@ -234,10 +235,9 @@ def pull_region(owner, raw_handle: bytes, local_arena: TpuArena,
         # permanent (a retry loop keyed on UNAVAILABLE must not spin on
         # a dead handle); everything else is a transport failure.
         code = err.code() if hasattr(err, "code") else None
-        status = {
-            grpc.StatusCode.NOT_FOUND: "NOT_FOUND",
-            grpc.StatusCode.INVALID_ARGUMENT: "INVALID_ARGUMENT",
-        }.get(code, "UNAVAILABLE")
+        status = status_map.status_of_grpc_code(code)
+        if status not in ("NOT_FOUND", "INVALID_ARGUMENT"):
+            status = "UNAVAILABLE"
         raise InferenceServerException(
             "DCN pull from region owner failed: %s"
             % getattr(err, "details", lambda: err)(),

@@ -5,6 +5,7 @@ from __future__ import annotations
 
 import grpc
 
+from client_tpu import status_map
 from client_tpu.protocol import arena_pb2
 from client_tpu.server.tpu_arena import TpuArena
 from client_tpu.utils import InferenceServerException
@@ -29,13 +30,6 @@ _STREAM_METHODS = [
     ("PullRegion", arena_pb2.PullRegionRequest,
      arena_pb2.PullRegionChunk),
 ]
-
-_STATUS_MAP = {
-    "NOT_FOUND": grpc.StatusCode.NOT_FOUND,
-    "INVALID_ARGUMENT": grpc.StatusCode.INVALID_ARGUMENT,
-    "UNAVAILABLE": grpc.StatusCode.UNAVAILABLE,
-}
-
 
 class TpuArenaStub:
     def __init__(self, channel):
@@ -65,7 +59,7 @@ class TpuArenaServicer:
 
     def _abort(self, context, error: InferenceServerException):
         context.abort(
-            _STATUS_MAP.get(error.status() or "", grpc.StatusCode.INTERNAL),
+            status_map.grpc_code(error.status()),
             error.message(),
         )
 

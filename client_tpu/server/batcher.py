@@ -68,6 +68,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from client_tpu.server import tracing as spantrace
+from client_tpu import status_map
 from client_tpu.server.qos import coerce_int, coerce_priority
 from client_tpu.utils import InferenceServerException
 
@@ -474,8 +475,11 @@ class DynamicBatcher:
         pending.queue_from_ns = queue_from_ns
         with self._cv:
             if self._stopping:
-                raise InferenceServerException(
-                    "server is shutting down", status="UNAVAILABLE")
+                # Retry-After here is for the fleet case: a draining
+                # replica's clients should re-resolve/failover, not
+                # hammer the dying process.
+                raise status_map.retryable_error(
+                    "server is shutting down", retry_after_s=1.0)
             self._admit_locked(pending)
             if pending.deadline_ns:
                 self._any_deadlines = True

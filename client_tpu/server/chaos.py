@@ -40,6 +40,7 @@ import time
 from typing import Optional
 
 from client_tpu.utils import InferenceServerException
+from client_tpu import status_map
 
 ENV_VAR = "CLIENT_TPU_CHAOS"
 
@@ -288,9 +289,12 @@ def inject(model_name: str = "", scope: Optional[str] = None,
     if drop:
         raise ChaosDropError()
     if error is not None:
-        raise InferenceServerException(
+        # A tiny Retry-After: honest for a transient injected fault,
+        # and small enough that retrying clients in the chaos smokes
+        # keep their pressure up instead of pacing on a 1s floor.
+        raise status_map.retryable_error(
             "injected fault (chaos error_rate=%g)" % error,
-            status="UNAVAILABLE")
+            retry_after_s=0.01)
 
 
 class OverloadScenario:

@@ -1065,27 +1065,35 @@ class InferenceServerCore:
         # holds in-flight counts, (3) wait for in-flight to hit zero
         # (bounded) and only then tear the model down.
         self.repository.begin_unload(name)
-        with self._sequencers_lock:
-            sequencer = self._sequencers.pop(name, None)
-        if sequencer is not None:
-            sequencer.stop()
-        with self._batchers_lock:
-            batcher = self._batchers.pop(name, None)
-        if batcher is not None:
-            batcher.stop()
-        # Replica sets drain AFTER the schedulers: the batcher's stop()
-        # executes its queued tail through the replica router, so the
-        # per-device queues must still be routing while it drains.
-        with self._replica_lock:
-            replica_set = self._replica_sets.pop(name, None)
-        if replica_set is not None:
-            replica_set.stop()
-        with self._trace_lock:
-            state = self._trace_state.get(name)
-            if state is not None and state["buffer"]:
-                self._flush_trace(
-                    name, self._effective_trace_settings(name), state)
-        self.repository.finish_unload(name)
+        try:
+            with self._sequencers_lock:
+                sequencer = self._sequencers.pop(name, None)
+            if sequencer is not None:
+                sequencer.stop()
+            with self._batchers_lock:
+                batcher = self._batchers.pop(name, None)
+            if batcher is not None:
+                batcher.stop()
+            # Replica sets drain AFTER the schedulers: the batcher's
+            # stop() executes its queued tail through the replica
+            # router, so the per-device queues must still be routing
+            # while it drains.
+            with self._replica_lock:
+                replica_set = self._replica_sets.pop(name, None)
+            if replica_set is not None:
+                replica_set.stop()
+            with self._trace_lock:
+                state = self._trace_state.get(name)
+                if state is not None and state["buffer"]:
+                    self._flush_trace(
+                        name, self._effective_trace_settings(name), state)
+        finally:
+            # begin_unload flipped the model UNAVAILABLE; finish MUST
+            # run even when a scheduler's stop() raises, or the model
+            # is stuck draining forever — shedding every request with
+            # 503 while its instance and device memory stay resident
+            # (tpulint: resource-pairing found the unprotected span).
+            self.repository.finish_unload(name)
 
     def shutdown(self) -> None:
         """Teardown: flip /v2/health/ready to not-ready FIRST (load

@@ -21,6 +21,7 @@ from __future__ import annotations
 import json
 from typing import Optional
 
+from client_tpu import status_map
 from client_tpu.protocol import inference_pb2 as pb
 
 _core = None
@@ -159,11 +160,10 @@ def tpu_arena_allocate(byte_size: int, device_id: int = 0) -> bytes:
     handle bytes (what the gRPC arena service would return)."""
     arena = _require_core().memory.arena
     if arena is None:
-        from client_tpu.utils import InferenceServerException
-
-        raise InferenceServerException(
+        # Clears only on an operator restart with an arena configured.
+        raise status_map.retryable_error(
             "server has no TPU arena; TPU shared memory unavailable",
-            status="UNAVAILABLE")
+            retry_after_s=30.0)
     return arena.create_region(byte_size, device_id)
 
 

@@ -8,6 +8,7 @@ from typing import Optional
 
 import grpc
 
+from client_tpu import status_map
 from client_tpu.protocol import inference_pb2 as pb
 from client_tpu.protocol.service import (
     GRPCInferenceServiceServicer,
@@ -19,18 +20,6 @@ from client_tpu.server.core import (
     stream_error_response,
 )
 from client_tpu.utils import InferenceServerException
-
-_STATUS_MAP = {
-    "NOT_FOUND": grpc.StatusCode.NOT_FOUND,
-    "INVALID_ARGUMENT": grpc.StatusCode.INVALID_ARGUMENT,
-    "ALREADY_EXISTS": grpc.StatusCode.ALREADY_EXISTS,
-    "UNAVAILABLE": grpc.StatusCode.UNAVAILABLE,
-    "DEADLINE_EXCEEDED": grpc.StatusCode.DEADLINE_EXCEEDED,
-    "RESOURCE_EXHAUSTED": grpc.StatusCode.RESOURCE_EXHAUSTED,
-    "CANCELLED": grpc.StatusCode.CANCELLED,
-    "INTERNAL": grpc.StatusCode.INTERNAL,
-    "UNIMPLEMENTED": grpc.StatusCode.UNIMPLEMENTED,
-}
 
 
 def _trace_context(context) -> Optional[str]:
@@ -47,9 +36,8 @@ def _trace_context(context) -> Optional[str]:
 
 
 def _abort(context, error: InferenceServerException):
-    code = _STATUS_MAP.get(error.status() or "", grpc.StatusCode.INTERNAL)
-    if code in (grpc.StatusCode.UNAVAILABLE,
-                grpc.StatusCode.RESOURCE_EXHAUSTED):
+    code = status_map.grpc_code(error.status())
+    if status_map.is_retryable_status(error.status()):
         # The gRPC twin of the HTTP Retry-After header: a trailing
         # metadata hint that well-behaved clients (RetryPolicy) use as
         # their minimum backoff before retrying a shed request.

@@ -18,6 +18,7 @@ from typing import Dict, Optional, Tuple
 
 from google.protobuf import json_format
 
+from client_tpu import status_map
 from client_tpu.protocol.http_wire import (
     compress_body,
     decode_infer_request,
@@ -27,17 +28,6 @@ from client_tpu.protocol.http_wire import (
 from client_tpu.utils import InferenceServerException
 
 HEADER_LEN = "Inference-Header-Content-Length"
-
-_STATUS_HTTP = {
-    "NOT_FOUND": 404,
-    "INVALID_ARGUMENT": 400,
-    "ALREADY_EXISTS": 409,
-    "UNAVAILABLE": 503,
-    "DEADLINE_EXCEEDED": 504,
-    "RESOURCE_EXHAUSTED": 429,
-    "UNIMPLEMENTED": 501,
-    "INTERNAL": 500,
-}
 
 Reply = Tuple[int, Dict[str, str], bytes]
 
@@ -73,19 +63,11 @@ def _pb_reply(message) -> Reply:
 
 
 def _error_reply(error: InferenceServerException) -> Reply:
-    status = _STATUS_HTTP.get(error.status() or "", 500)
-    # Retry-After on 503 (queue saturation) and 429 (tenant quota):
-    # parity with the aiohttp front-end — the value is the server's
-    # refill/window estimate, rounded UP to whole seconds (RFC 9110
-    # delta-seconds is integer; third-party consumers fail a float).
-    headers = None
-    if status in (503, 429):
-        import math
-
-        retry_after = getattr(error, "retry_after_s", None)
-        headers = {"Retry-After": ("%d" % max(math.ceil(retry_after), 1))
-                   if retry_after else "1"}
-    return _json_reply({"error": error.message()}, status, headers)
+    # Retry-After on shed (503) and quota (429) replies: parity with
+    # the aiohttp front-end — mapping + rounding policy in status_map.
+    status = status_map.http_status(error.status())
+    return _json_reply({"error": error.message()}, status,
+                       status_map.retry_after_headers(status, error))
 
 
 def _pick_encoding(accept_encoding: str) -> Optional[str]:

@@ -15,6 +15,7 @@ from typing import Optional
 from aiohttp import web
 from google.protobuf import json_format
 
+from client_tpu import status_map
 from client_tpu.protocol import inference_pb2 as pb
 from client_tpu.protocol.http_wire import (
     HEADER_LEN,
@@ -25,37 +26,15 @@ from client_tpu.protocol.http_wire import (
 from client_tpu.server.core import InferenceServerCore
 from client_tpu.utils import InferenceServerException
 
-_STATUS_HTTP = {
-    "NOT_FOUND": 404,
-    "INVALID_ARGUMENT": 400,
-    "ALREADY_EXISTS": 409,
-    "UNAVAILABLE": 503,
-    "DEADLINE_EXCEEDED": 504,
-    "RESOURCE_EXHAUSTED": 429,
-    "INTERNAL": 500,
-    "UNIMPLEMENTED": 501,
-}
-
 
 def _error_response(error: InferenceServerException) -> web.Response:
-    status = _STATUS_HTTP.get(error.status() or "", 500)
-    # 503s (queue saturation) and 429s (tenant quota) carry
-    # Retry-After so well-behaved clients (and LBs) back off instead
-    # of hammering a saturated queue. The value comes from the
-    # error's server-computed backoff when present (token-bucket
-    # refill time, gather-window estimate), else the legacy 1s —
-    # rounded UP to whole seconds: RFC 9110 delta-seconds is integer,
-    # and third-party consumers (urllib3, proxies) fail a float parse.
-    # The gRPC trailing metadata keeps sub-second precision.
-    headers = None
-    if status in (503, 429):
-        import math
-
-        retry_after = getattr(error, "retry_after_s", None)
-        headers = {"Retry-After": ("%d" % max(math.ceil(retry_after), 1))
-                   if retry_after else "1"}
+    # Shed (503) and quota (429) responses carry Retry-After so
+    # well-behaved clients (and LBs) back off instead of hammering a
+    # saturated queue; value + rounding policy live in status_map.
+    status = status_map.http_status(error.status())
     return web.json_response(
-        {"error": error.message()}, status=status, headers=headers,
+        {"error": error.message()}, status=status,
+        headers=status_map.retry_after_headers(status, error),
     )
 
 
@@ -228,7 +207,8 @@ def build_http_app(core: InferenceServerCore) -> web.Application:
             return web.Response(status=200)
         except KeyError as e:
             return web.json_response(
-                {"error": "missing field %s" % e}, status=400
+                {"error": "missing field %s" % e},
+                status=status_map.HTTP_BAD_REQUEST,
             )
         except InferenceServerException as e:
             return _error_response(e)
@@ -273,7 +253,8 @@ def build_http_app(core: InferenceServerCore) -> web.Application:
             return web.Response(status=200)
         except (KeyError, TypeError, ValueError) as e:
             return web.json_response(
-                {"error": "malformed register request: %s" % e}, status=400
+                {"error": "malformed register request: %s" % e},
+                status=status_map.HTTP_BAD_REQUEST,
             )
         except InferenceServerException as e:
             return _error_response(e)
@@ -492,7 +473,8 @@ def build_http_app(core: InferenceServerCore) -> web.Application:
             return _error_response(e)
         except Exception as e:
             return web.json_response(
-                {"error": {"message": str(e)}}, status=400)
+                {"error": {"message": str(e)}},
+                status=status_map.HTTP_BAD_REQUEST)
         if doc.get("stream"):
             return await _openai_stream(
                 request, infer_request, chat=True)
@@ -526,7 +508,8 @@ def build_http_app(core: InferenceServerCore) -> web.Application:
             return _error_response(e)
         except Exception as e:
             return web.json_response(
-                {"error": {"message": str(e)}}, status=400)
+                {"error": {"message": str(e)}},
+                status=status_map.HTTP_BAD_REQUEST)
         if doc.get("stream"):
             return await _openai_stream(
                 request, infer_request, chat=False)

@@ -19,6 +19,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from client_tpu import status_map
 from client_tpu.protocol import inference_pb2 as pb
 from client_tpu.utils import InferenceServerException
 from client_tpu.utils import shared_memory as system_shm
@@ -110,9 +111,12 @@ class SharedMemoryManager:
     def register_tpu(self, name: str, raw_handle: bytes, device_id: int,
                      byte_size: int) -> None:
         if self._arena is None:
-            raise InferenceServerException(
+            # UNAVAILABLE for wire parity with the reference; the
+            # condition only clears on an operator restart with an
+            # arena configured, so advertise a long re-probe interval.
+            raise status_map.retryable_error(
                 "server has no TPU arena; TPU shared memory unavailable",
-                status="UNAVAILABLE",
+                retry_after_s=30.0,
             )
         with self._lock:
             if name in self._system or name in self._tpu:

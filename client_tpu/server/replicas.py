@@ -59,6 +59,7 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from client_tpu import status_map
 from client_tpu.robust import CLIENT_ERROR_STATUSES, CircuitBreaker
 from client_tpu.server import chaos
 from client_tpu.utils import InferenceServerException, triton_to_np_dtype
@@ -292,9 +293,9 @@ class ReplicaSet:
         best healthy sibling."""
         with self._lock:
             if self._stopping:
-                raise InferenceServerException(
+                raise status_map.retryable_error(
                     "model '%s' is draining its replicas" % self.name,
-                    status="UNAVAILABLE")
+                    retry_after_s=1.0)
             if sticky_key is not None:
                 pinned = self._sticky.get(sticky_key)
                 if pinned is not None and pinned not in exclude:
@@ -304,14 +305,17 @@ class ReplicaSet:
             candidates = [r for r in self.replicas
                           if r.index not in exclude and r.healthy()]
             if not candidates:
-                raise InferenceServerException(
+                # Retry-After: the supervisor re-inits + canaries an
+                # ejected replica each breaker rest period, so that IS
+                # the honest earliest-recovery estimate.
+                raise status_map.retryable_error(
                     "no healthy replica for model '%s' (%d of %d "
                     "ejected%s)"
                     % (self.name,
                        sum(1 for r in self.replicas if not r.healthy()),
                        self.count,
                        ", %d excluded" % len(exclude) if exclude else ""),
-                    status="UNAVAILABLE")
+                    retry_after_s=max(self._recovery_s, 0.05))
             self._route_count += 1
             if self._route_count % EXPLORE_EVERY == 0:
                 replica = candidates[
@@ -409,20 +413,21 @@ class ReplicaSet:
         except RuntimeError:  # queue torn down by a concurrent heal
             with self._lock:
                 replica.outstanding = max(replica.outstanding - 1, 0)
-            raise InferenceServerException(
+            raise status_map.retryable_error(
                 "replica %s:%d is re-initializing"
-                % (self.name, replica.index), status="UNAVAILABLE")
+                % (self.name, replica.index),
+                retry_after_s=max(self._recovery_s / 2.0, 0.05))
         try:
             outputs = future.result(
                 timeout=self._watchdog_s * (queued_ahead + 1))
         except FuturesTimeout:
             self._mark_hung(replica)
-            raise InferenceServerException(
+            raise status_map.retryable_error(
                 "replica %s:%d blew its %dms execution watchdog "
                 "(marked unhealthy)"
                 % (self.name, replica.index,
                    int(self._watchdog_s * 1000)),
-                status="UNAVAILABLE")
+                retry_after_s=max(self._watchdog_s, 0.05))
         except BaseException as e:
             self._note_failure(replica, e)
             if isinstance(e, InferenceServerException):

@@ -8,6 +8,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from client_tpu import status_map
 from client_tpu.protocol import inference_pb2 as pb
 from client_tpu.server.model import ServedModel
 from client_tpu.utils import InferenceServerException
@@ -117,16 +118,26 @@ class ModelRepository:
             self._reason[name] = "unloaded" if not leaked else (
                 "unloaded with %d request(s) still in flight after "
                 "%.1fs drain" % (leaked, timeout))
-        if model is not None:
-            model.unload()
-        for listener in list(self._unload_listeners):
-            try:
-                listener(name)
-            except Exception:  # noqa: BLE001 — teardown must not raise
-                pass
+        try:
+            if model is not None:
+                model.unload()
+        finally:
+            # Listeners ALWAYS fire, even when the model's own
+            # teardown raises: the response cache invalidates here,
+            # and skipping it would let a reloaded instance serve the
+            # crashed instance's cached bytes (tpulint:
+            # resource-pairing found the unprotected ordering).
+            for listener in list(self._unload_listeners):
+                try:
+                    listener(name)
+                except Exception:  # noqa: BLE001 — teardown must not raise
+                    pass
 
     def unload(self, name: str,
                drain_timeout_s: Optional[float] = None) -> None:
+        # tpulint: disable=resource-pairing -- begin and finish are
+        # adjacent: no statement between them can raise and strand the
+        # drain state
         self.begin_unload(name)
         self.finish_unload(name, drain_timeout_s)
 
@@ -145,10 +156,14 @@ class ModelRepository:
                     status="NOT_FOUND",
                 )
             if self._state.get(name) != "READY":
-                raise InferenceServerException(
+                # Retry-After: an unloading model's drain is bounded by
+                # DRAIN_TIMEOUT_S but typically finishes in well under
+                # a fifth of it; a reload needs about the same. tpulint
+                # (retry-after) keeps every shed path honest like this.
+                raise status_map.retryable_error(
                     "model '%s' is unavailable: %s"
                     % (name, self._reason.get(name, "not ready")),
-                    status="UNAVAILABLE",
+                    retry_after_s=self.DRAIN_TIMEOUT_S / 5.0,
                 )
             if version and model.version != version:
                 raise InferenceServerException(

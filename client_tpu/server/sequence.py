@@ -63,6 +63,7 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 from client_tpu.server import tracing as spantrace
+from client_tpu import status_map
 from client_tpu.utils import InferenceServerException, triton_to_np_dtype
 
 NANOS_PER_US = 1_000
@@ -300,8 +301,8 @@ class SequenceScheduler:
         with self._cv:
             while True:
                 if self._stopping:
-                    raise InferenceServerException(
-                        "server is shutting down", status="UNAVAILABLE")
+                    raise status_map.retryable_error(
+                        "server is shutting down", retry_after_s=1.0)
                 self._reclaim_locked(time.monotonic_ns())
                 slot = self._sequences.get(corrid)
                 if slot is not None:
@@ -340,19 +341,23 @@ class SequenceScheduler:
                     self._reject_hook()
                 except Exception:  # noqa: BLE001 — stats only
                     pass
-            raise InferenceServerException(
+            # Retry-After estimate: a slot frees when a live sequence
+            # ends or idles out — half the idle-reclaim horizon is the
+            # best signal this scheduler has (1s when reclaim is off).
+            raise status_map.retryable_error(
                 "sequence start for model '%s' rejected: all %d sequence "
                 "slots busy and the backlog exceeds max_queue_size %d"
                 % (model_name, self._slot_total, self._backlog_max),
-                status="UNAVAILABLE")
+                retry_after_s=(self._idle_ns / 2e9 if self._idle_ns
+                               else 1.0))
         timeout_ns = self._timeout_ns_for(params)
         deadline_ns = entry_ns + timeout_ns if timeout_ns else 0
         self._backlog += 1
         try:
             while not self._free_slots:
                 if self._stopping:
-                    raise InferenceServerException(
-                        "server is shutting down", status="UNAVAILABLE")
+                    raise status_map.retryable_error(
+                        "server is shutting down", retry_after_s=1.0)
                 now = time.monotonic_ns()
                 self._reclaim_locked(now)
                 if self._free_slots:
@@ -386,8 +391,8 @@ class SequenceScheduler:
         with self._cv:
             while slot.serving != ticket:
                 if self._stopping:
-                    raise InferenceServerException(
-                        "server is shutting down", status="UNAVAILABLE")
+                    raise status_map.retryable_error(
+                        "server is shutting down", retry_after_s=1.0)
                 self._cv.wait(timeout=1.0)
             if slot.reclaimed:
                 raise _not_started(

@@ -391,9 +391,14 @@ class TpuArena:
             # covered bytes on host — touching only those segments —
             # and upload the window once.
             data = self._read_locked(region, offset, count)
-            host = np.frombuffer(
-                data, dtype=triton_to_np_dtype(datatype)).reshape(shape)
-            return jax.device_put(host, region.device)
+        # Upload OUTSIDE the region lock: a host->device transfer can
+        # stall behind the device queue, and holding the lock across
+        # it would block every concurrent reader/writer of this region
+        # for the duration (tpulint: lock-discipline). The bytes are
+        # already copied out, so a concurrent write can't tear them.
+        host = np.frombuffer(
+            data, dtype=triton_to_np_dtype(datatype)).reshape(shape)
+        return jax.device_put(host, region.device)
 
     def store(self, region_id: str, offset: int, byte_size: int, value) -> int:
         """Place an inference output into the region by reference (the

@@ -1,0 +1,128 @@
+"""The one canonical status mapping table.
+
+Every translation between canonical status strings (the
+``InferenceServerException.status()`` vocabulary, which matches
+``grpc.StatusCode`` member names) and wire codes (HTTP ints, gRPC
+codes) lives here. Before this module the same tables were hand-copied
+into three front-ends and two clients and drifted; tpulint's
+``status-literal`` checker now fails any new shadow table or bare
+status literal outside this file.
+
+Retry-After policy also lives here: every ``UNAVAILABLE`` /
+``RESOURCE_EXHAUSTED`` error a server component raises must carry a
+``retry_after_s`` estimate (construct it via :func:`retryable_error`);
+the front-ends serialize it as the HTTP ``Retry-After`` header
+(integer delta-seconds, RFC 9110) and the gRPC ``retry-after``
+trailing metadata (sub-second precision). tpulint's ``retry-after``
+checker enforces the construction side.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional
+
+from client_tpu.utils import InferenceServerException
+
+# Canonical status string -> HTTP response code. Statuses absent from
+# the table (CANCELLED, UNKNOWN, transport noise) fall back to
+# HTTP_INTERNAL — the pre-refactor behavior of every front-end copy.
+HTTP_STATUS: Dict[str, int] = {
+    "NOT_FOUND": 404,
+    "INVALID_ARGUMENT": 400,
+    "ALREADY_EXISTS": 409,
+    "UNAVAILABLE": 503,
+    "DEADLINE_EXCEEDED": 504,
+    "RESOURCE_EXHAUSTED": 429,
+    "UNIMPLEMENTED": 501,
+    "INTERNAL": 500,
+    "PERMISSION_DENIED": 403,
+    "UNAUTHENTICATED": 401,
+}
+
+HTTP_OK = 200
+HTTP_BAD_REQUEST = 400
+HTTP_NOT_FOUND = 404
+HTTP_INTERNAL = 500
+#: First HTTP status code that is an error (RFC 9110 client errors).
+HTTP_ERROR_FLOOR = 400
+
+#: Statuses a well-behaved client may retry (the server sheds with an
+#: honest Retry-After; see retryable_error). Canonical + HTTP string
+#: forms, because client-side errors carry whichever the transport saw.
+RETRYABLE_STATUSES = frozenset({"UNAVAILABLE", "RESOURCE_EXHAUSTED"})
+RETRYABLE_HTTP = frozenset({503, 429})
+DEFAULT_RETRYABLE_WIRE = ("UNAVAILABLE", "503", "RESOURCE_EXHAUSTED", "429")
+
+#: Per-tenant quota rejects: retryable but POLICY signals, not
+#: availability evidence (client breakers must not count them).
+QUOTA_REJECT_WIRE = frozenset({"RESOURCE_EXHAUSTED", "429"})
+
+#: Definitive client errors — the server answered decisively, which is
+#: proof of health, not failure (client breakers count them as
+#: successes). Canonical + HTTP string forms.
+CLIENT_ERROR_WIRE = frozenset({
+    "INVALID_ARGUMENT", "400", "NOT_FOUND", "404", "ALREADY_EXISTS",
+    "409", "UNIMPLEMENTED", "501", "PERMISSION_DENIED", "403",
+    "UNAUTHENTICATED", "401",
+})
+
+
+def http_status(status: Optional[str]) -> int:
+    """Canonical status string (or None) -> HTTP response code."""
+    return HTTP_STATUS.get(status or "", HTTP_INTERNAL)
+
+
+def grpc_code(status: Optional[str]):
+    """Canonical status string (or None) -> ``grpc.StatusCode``.
+
+    grpc is imported lazily: HTTP-only deployments never pay for it."""
+    import grpc
+
+    try:
+        return grpc.StatusCode[status or "INTERNAL"]
+    except KeyError:
+        return grpc.StatusCode.INTERNAL
+
+
+def status_of_grpc_code(code) -> Optional[str]:
+    """``grpc.StatusCode`` (or None) -> canonical status string."""
+    return getattr(code, "name", None)
+
+
+def is_retryable_status(status: Optional[str]) -> bool:
+    return (status or "") in RETRYABLE_STATUSES
+
+
+def retryable_error(msg: str, status: str = "UNAVAILABLE",
+                    retry_after_s: float = 1.0,
+                    debug_details=None) -> InferenceServerException:
+    """An UNAVAILABLE/RESOURCE_EXHAUSTED error with its Retry-After
+    estimate attached — the only sanctioned way to construct one.
+    ``retry_after_s`` is the server's honest guess at when capacity
+    returns (queue-drain estimate, token-bucket refill, supervisor
+    recovery interval); it is floored at 1 ms so a zero can never
+    serialize as "don't wait"."""
+    assert status in RETRYABLE_STATUSES, status
+    error = InferenceServerException(msg, status=status,
+                                     debug_details=debug_details)
+    error.retry_after_s = max(float(retry_after_s), 0.001)
+    return error
+
+
+def retry_after_headers(code: int, error: BaseException,
+                        headers: Optional[dict] = None) -> Optional[dict]:
+    """Adds the ``Retry-After`` header for shed/quota responses.
+
+    The value is the error's server-computed estimate when present,
+    else the legacy 1 — rounded UP to whole seconds: RFC 9110
+    delta-seconds is integer, and third-party consumers (urllib3,
+    proxies) fail a float parse. The gRPC trailing metadata keeps
+    sub-second precision."""
+    if code not in RETRYABLE_HTTP:
+        return headers
+    retry_after = getattr(error, "retry_after_s", None)
+    value = "%d" % max(math.ceil(retry_after), 1) if retry_after else "1"
+    headers = dict(headers) if headers else {}
+    headers["Retry-After"] = value
+    return headers

@@ -2,7 +2,15 @@
 runs the most threading-heavy test binaries under it (SURVEY.md §5 —
 the reference configures no sanitizer jobs; the load managers,
 async clients, and channel cache here are all lock-based concurrent
-code, exactly what TSAN exists for)."""
+code, exactly what TSAN exists for).
+
+Split per docs/static_analysis.md: the cheap "the TSAN build tree
+CONFIGURES" check runs in tier-1 (a CMakeLists/toolchain regression
+fails fast, every run), while the full instrumented build + binary
+runs stay ``slow`` (~3 min build). The Python-side concurrency gets
+its static coverage from ``python -m tools.tpulint`` (lock-discipline
+/ lock-order / resource-pairing) — TSAN covers the native side
+dynamically."""
 
 import os
 import pathlib
@@ -11,11 +19,41 @@ import subprocess
 
 import pytest
 
-pytestmark = pytest.mark.slow  # TSAN cmake build tree (~3 min)
-
 REPO = pathlib.Path(__file__).resolve().parent.parent
 NATIVE = REPO / "native"
 TSAN_BUILD = NATIVE / "build-tsan"
+
+_CMAKE_ARGS = [
+    "-G", "Ninja", "-DTPUCLIENT_SANITIZE=thread",
+    # The CPython-embedding backend is out of scope for TSAN
+    # (the interpreter itself is not TSAN-instrumented).
+    "-DCMAKE_DISABLE_FIND_PACKAGE_Python3=ON",
+]
+
+
+def _configure(build_dir: pathlib.Path) -> "subprocess.CompletedProcess":
+    return subprocess.run(
+        ["cmake", "-S", str(NATIVE), "-B", str(build_dir)] + _CMAKE_ARGS,
+        capture_output=True, text=True, timeout=300,
+    )
+
+
+def test_tsan_tree_configures(tmp_path):
+    """Tier-1 (not slow): the TSAN configuration itself must stay
+    valid — a -DTPUCLIENT_SANITIZE=thread configure that errors means
+    the slow job can never run, and that regression should fail in
+    every CI run, not only when someone remembers -m slow.
+
+    Reuses the persistent build tree when it exists (incremental
+    re-configure is ~1s); otherwise configures into tmp_path so
+    tier-1 leaves no build tree behind."""
+    if shutil.which("cmake") is None or shutil.which("ninja") is None:
+        pytest.skip("cmake/ninja not available")
+    build_dir = TSAN_BUILD if (TSAN_BUILD / "build.ninja").exists() \
+        else tmp_path / "build-tsan"
+    proc = _configure(build_dir)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert (build_dir / "build.ninja").exists()
 
 
 @pytest.fixture(scope="module")
@@ -23,14 +61,7 @@ def tsan_build():
     if shutil.which("cmake") is None or shutil.which("ninja") is None:
         pytest.skip("cmake/ninja not available")
     if not (TSAN_BUILD / "build.ninja").exists():
-        proc = subprocess.run(
-            ["cmake", "-S", str(NATIVE), "-B", str(TSAN_BUILD),
-             "-G", "Ninja", "-DTPUCLIENT_SANITIZE=thread",
-             # The CPython-embedding backend is out of scope for TSAN
-             # (the interpreter itself is not TSAN-instrumented).
-             "-DCMAKE_DISABLE_FIND_PACKAGE_Python3=ON"],
-            capture_output=True, text=True, timeout=300,
-        )
+        proc = _configure(TSAN_BUILD)
         assert proc.returncode == 0, proc.stderr[-2000:]
     proc = subprocess.run(
         ["ninja", "-C", str(TSAN_BUILD), "test_core", "test_perf_harness",
@@ -41,6 +72,7 @@ def tsan_build():
     return TSAN_BUILD
 
 
+@pytest.mark.slow  # full TSAN cmake build tree (~3 min) + binary runs
 @pytest.mark.parametrize(
     "binary", ["test_core", "test_perf_harness", "test_grpc_client",
                "test_h2_server"])

@@ -1,0 +1,164 @@
+"""Regression tests for the real defects tpulint's checkers surfaced
+in this PR (see docs/static_analysis.md for the checker catalog and
+CHANGES.md for the fix list). Each test names its checker id."""
+
+import json
+
+import numpy as np
+import pytest
+
+from client_tpu.utils import InferenceServerException
+
+
+class _StubModel:
+    name = "stub"
+    version = "1"
+
+    def __init__(self, boom_on_unload=False):
+        self.boom_on_unload = boom_on_unload
+        self.unloaded = 0
+
+    def warmup(self):
+        pass
+
+    def unload(self):
+        self.unloaded += 1
+        if self.boom_on_unload:
+            raise RuntimeError("teardown bug")
+
+
+# -- resource-pairing: repository.finish_unload listener ordering -----------
+
+def test_unload_listeners_fire_even_when_model_teardown_raises():
+    """[resource-pairing] finish_unload ran its unload listeners AFTER
+    model.unload() with no finally: a teardown exception skipped cache
+    invalidation, so a reloaded instance could serve the crashed
+    instance's cached bytes."""
+    from client_tpu.server.repository import ModelRepository
+
+    repo = ModelRepository()
+    fired = []
+    repo.add_unload_listener(fired.append)
+    model = _StubModel(boom_on_unload=True)
+    repo.add_model(model)
+    repo.begin_unload("stub")
+    with pytest.raises(RuntimeError):
+        repo.finish_unload("stub")
+    assert model.unloaded == 1
+    assert fired == ["stub"]  # the listener fired despite the raise
+
+
+# -- resource-pairing: core.unload_model drain state ------------------------
+
+def test_unload_model_completes_drain_when_scheduler_stop_raises():
+    """[resource-pairing] core.unload_model called begin_unload, then
+    stopped schedulers, then finish_unload — with no finally. A
+    scheduler stop() exception left the model UNAVAILABLE 'draining'
+    forever, shedding every request with 503 while the instance and
+    its device memory stayed resident."""
+    from client_tpu.server.app import build_core
+
+    core = build_core(["simple"])
+    try:
+        class _BoomSequencer:
+            def stop(self):
+                raise RuntimeError("scheduler stop bug")
+
+        core._sequencers["simple"] = _BoomSequencer()
+        with pytest.raises(RuntimeError):
+            core.unload_model("simple")
+        # finish_unload still ran: the instance is gone (drain state
+        # resolved), not stuck draining...
+        index = {m.name: m for m in core.repository_index().models}
+        assert "unloading" not in index["simple"].reason
+        # ...and the model is reloadable + serves again.
+        core.load_model("simple")
+        assert core.model_ready("simple", "")
+    finally:
+        core.shutdown()
+
+
+# -- lock-discipline: arena upload under the region lock --------------------
+
+def test_arena_multi_segment_view_uploads_outside_region_lock():
+    """[lock-discipline] as_typed_array's multi-segment path ran
+    jax.device_put while holding region.lock — a host->device
+    transfer stalling behind the device queue blocked every
+    concurrent reader/writer of the region for its duration."""
+    from client_tpu.server.tpu_arena import TpuArena
+
+    arena = TpuArena()
+    handle = arena.create_region(64, 0)
+    region_id = json.loads(handle)["region_id"]
+    # Two adjacent RAW segments: the INT32 view over both must take
+    # the multi-segment assemble-then-upload path.
+    arena.write(region_id, 0, np.arange(4, dtype=np.int32).tobytes())
+    arena.write(region_id, 16, np.arange(4, 8, dtype=np.int32).tobytes())
+    region = arena._get(region_id)
+    real_jax = arena._jax
+    observed = {}
+
+    class _JaxProxy:
+        def __getattr__(self, name):
+            return getattr(real_jax, name)
+
+        @staticmethod
+        def device_put(*args, **kwargs):
+            observed["lock_held"] = region.lock.locked()
+            return real_jax.device_put(*args, **kwargs)
+
+    arena._jax = _JaxProxy()
+    try:
+        view = np.asarray(
+            arena.as_typed_array(region_id, 0, 32, "INT32", [8]))
+    finally:
+        arena._jax = real_jax
+    np.testing.assert_array_equal(view, np.arange(8, dtype=np.int32))
+    assert observed == {"lock_held": False}
+
+
+# -- retry-after: honest estimates on shed paths ----------------------------
+
+def test_draining_model_rejects_with_honest_retry_after():
+    """[retry-after] repository.acquire shed draining-model requests
+    with a bare UNAVAILABLE; the front-ends then sent the meaningless
+    legacy Retry-After '1'. The error now carries the drain-derived
+    estimate, end to end through the REST error path."""
+    from client_tpu.server.http_embed import _error_reply
+    from client_tpu.server.repository import ModelRepository
+
+    repo = ModelRepository()
+    repo.add_model(_StubModel())
+    repo.begin_unload("stub")
+    with pytest.raises(InferenceServerException) as exc_info:
+        repo.acquire("stub")
+    error = exc_info.value
+    assert error.status() == "UNAVAILABLE"
+    expected = ModelRepository.DRAIN_TIMEOUT_S / 5.0
+    assert error.retry_after_s == pytest.approx(expected)
+    status, headers, _body = _error_reply(error)
+    assert status == 503
+    assert headers["Retry-After"] == "2"  # ceil(expected) seconds
+
+
+def test_replica_errors_carry_recovery_derived_retry_after():
+    """[retry-after] a fully-ejected ReplicaSet rejected with a bare
+    UNAVAILABLE; it now advertises the supervisor's recovery interval
+    (the honest earliest point a canary can readmit a replica)."""
+    from client_tpu.server import replicas as replicas_mod
+
+    model = type("_M", (), {
+        "name": "m", "version": "1",
+        "instance_group_count": 2,
+        "replica_recovery_s": 3.0,
+    })()
+    replica_set = replicas_mod.ReplicaSet(model)
+    try:
+        for replica in replica_set.replicas:
+            replica.hung = True  # watchdog verdict: domain ejected
+        with pytest.raises(InferenceServerException) as exc_info:
+            replica_set._pick()
+        assert exc_info.value.status() == "UNAVAILABLE"
+        assert exc_info.value.retry_after_s == pytest.approx(3.0)
+    finally:
+        replica_set.stop()

@@ -6,7 +6,7 @@
 # regression gate). Usage: tools/ci_check.sh [min_passed]
 set -u -o pipefail
 
-MIN_PASSED="${1:-540}"
+MIN_PASSED="${1:-578}"
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
 LOG=/tmp/_t1.log
 
@@ -101,22 +101,25 @@ fi
 grep -E "Failover summary|client-visible|failovers|ejections" "$FO_LOG"
 echo "OK: failover smoke passed (100% goodput through an endpoint kill)"
 
-# Metrics lint: the Prometheus exposition must stay well-formed
-# (HELP/TYPE before samples, escaped labels, no duplicate series,
-# histogram ladders strictly increasing and ending +Inf with
-# _count == +Inf bucket, exemplar syntax valid) and counters —
-# histogram buckets included — must stay monotonic across two scrapes
-# under unary AND streaming load.
-echo "metrics lint: exposition format + histograms + monotonicity"
-LINT_LOG=/tmp/_metrics_lint.log
-if ! timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/metrics_lint.py \
+# Static analysis: one entry point for everything static —
+# tpulint's repo-specific checkers (lock-discipline, lock-order,
+# resource-pairing, status-literal, retry-after, aio-blocking,
+# proto-drift, metrics-doc-drift; docs/static_analysis.md) gated
+# against tools/tpulint/baseline.json (zero NEW findings, zero STALE
+# baseline entries — an entry whose anchored line changed must be
+# pruned), plus the live Prometheus exposition lint
+# (tools/metrics_lint.py) via --all.
+echo "tpulint: static analysis (zero new findings) + metrics lint"
+LINT_LOG=/tmp/_tpulint.log
+if ! timeout -k 10 240 env JAX_PLATFORMS=cpu python -m tools.tpulint --all \
     > "$LINT_LOG" 2>&1; then
-    echo "FAIL: metrics lint failed" >&2
-    tail -20 "$LINT_LOG" >&2
+    echo "FAIL: tpulint/metrics lint failed" >&2
+    tail -30 "$LINT_LOG" >&2
     exit 1
 fi
-grep "metrics lint passed" "$LINT_LOG"
-echo "OK: metrics lint passed"
+grep -E "tpulint passed" "$LINT_LOG"
+grep -E "metrics lint passed" "$LINT_LOG"
+echo "OK: static analysis passed"
 
 # Telemetry smoke: the always-on latency-histogram layer must (a)
 # expose lint-clean histogram families after unary + streaming load,

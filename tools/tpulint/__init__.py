@@ -1,0 +1,128 @@
+"""tpulint — repo-specific concurrency & protocol static analysis.
+
+Entry point: ``python -m tools.tpulint`` (see __main__.py). The
+checkers, their defect classes, and the historical bugs that motivate
+them are cataloged in docs/static_analysis.md.
+"""
+
+from __future__ import annotations
+
+import pathlib
+from typing import Dict, List, Tuple
+
+from tools.tpulint.check_aio import check_aio_blocking
+from tools.tpulint.check_drift import (
+    check_metrics_doc_drift,
+    check_proto_drift,
+)
+from tools.tpulint.check_locks import check_lock_discipline, check_lock_order
+from tools.tpulint.check_pairing import check_resource_pairing
+from tools.tpulint.check_status import check_retry_after, check_status_literals
+from tools.tpulint.framework import (
+    BASELINE_PATH,
+    CHECKER_IDS,
+    REPO_ROOT,
+    Finding,
+    SourceFile,
+    apply_baseline,
+    iter_python_files,
+    load_baseline,
+    save_baseline,
+)
+
+__all__ = [
+    "CHECKER_IDS",
+    "Finding",
+    "SourceFile",
+    "run",
+    "run_gated",
+]
+
+# Where each checker looks. The concurrency checkers cover the serving
+# core and the perf harness (the two lock-heavy trees); the status
+# checkers cover every module that translates between canonical status
+# strings and wire codes; aio covers everything (it only fires inside
+# ``async def``).
+SCOPES: Dict[str, List[str]] = {
+    "lock-discipline": ["client_tpu/server", "client_tpu/perf",
+                        "client_tpu/robust.py"],
+    "lock-order": ["client_tpu/server"],
+    "resource-pairing": ["client_tpu/server", "client_tpu/perf"],
+    "status": ["client_tpu/server", "client_tpu/http", "client_tpu/grpc",
+               "client_tpu/robust.py", "client_tpu/protocol/http_wire.py",
+               "client_tpu/status_map.py"],
+    "retry-after": ["client_tpu/server", "client_tpu/status_map.py"],
+    "aio-blocking": ["client_tpu"],
+}
+
+
+def run(root: pathlib.Path = REPO_ROOT) -> List[Finding]:
+    """All checkers over ``root``; suppressions applied, baseline NOT
+    applied (callers gate via :func:`run_gated`)."""
+    findings: List[Finding] = []
+    sources: Dict[str, SourceFile] = {}
+
+    def load(scope_key: str) -> List[SourceFile]:
+        # Scopes overlap heavily (server/ appears in five of them):
+        # parse each file once and share the SourceFile.
+        loaded = []
+        for path in iter_python_files(root, SCOPES[scope_key]):
+            rel = path.relative_to(root).as_posix()
+            src = sources.get(rel)
+            if src is None:
+                src = SourceFile(path, root)
+                sources[rel] = src
+            loaded.append(src)
+        return loaded
+
+    for src in load("lock-discipline"):
+        findings.extend(check_lock_discipline(src))
+    findings.extend(check_lock_order(load("lock-order")))
+    for src in load("resource-pairing"):
+        findings.extend(check_resource_pairing(src))
+    for src in load("status"):
+        findings.extend(check_status_literals(src))
+    for src in load("retry-after"):
+        findings.extend(check_retry_after(src))
+    for src in load("aio-blocking"):
+        findings.extend(check_aio_blocking(src))
+    findings.extend(check_proto_drift(root))
+    findings.extend(check_metrics_doc_drift(root))
+
+    # Uniform suppression pass + bad-suppression reporting.
+    kept: List[Finding] = []
+    seen = set()
+    for finding in findings:
+        src = sources.get(finding.path)
+        if src is not None and src.suppressed(finding.checker, finding.line):
+            continue
+        if (finding.checker, finding.path, finding.line,
+                finding.message) in seen:
+            continue
+        seen.add((finding.checker, finding.path, finding.line,
+                  finding.message))
+        kept.append(finding)
+    for src in sources.values():
+        kept.extend(src.bad_suppressions)
+    kept.sort(key=lambda f: (f.path, f.line, f.checker, f.message))
+    return kept
+
+
+def run_gated(root: pathlib.Path = REPO_ROOT,
+              baseline_path: pathlib.Path = None
+              ) -> Tuple[List[Finding], List[Finding], List[str]]:
+    """(new_findings, baseline_accepted, stale_baseline_entries)."""
+    findings = run(root)
+    baseline = load_baseline(baseline_path or BASELINE_PATH)
+    return apply_baseline(findings, baseline, root)
+
+
+def update_baseline(root: pathlib.Path = REPO_ROOT,
+                    baseline_path: pathlib.Path = None) -> int:
+    # bad-suppression is never baselinable: accepting one would
+    # permanently legitimize a reason-less disable comment, voiding
+    # the "a bare disable is itself a finding" invariant. Write the
+    # reason instead.
+    findings = [f for f in run(root) if f.checker != "bad-suppression"]
+    save_baseline(findings, root, baseline_path or BASELINE_PATH)
+    return len(findings)

@@ -1,0 +1,82 @@
+"""CLI: ``python -m tools.tpulint [--all] [--update-baseline] [--list]``.
+
+Default run: every static checker over the tree, gated against
+``tools/tpulint/baseline.json`` — exit non-zero on any NEW finding,
+any STALE baseline entry, or any disable comment without a reason.
+
+``--all`` additionally runs the live Prometheus-exposition lint
+(tools/metrics_lint.py: spins an in-process core, drives load, lints
+two scrapes) so CI has exactly one static-analysis entry point.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))))
+
+from tools import tpulint  # noqa: E402
+from tools.tpulint.framework import CHECKER_IDS  # noqa: E402
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.tpulint",
+        description="repo-specific concurrency & protocol static analysis")
+    parser.add_argument(
+        "--all", action="store_true",
+        help="also run the live /metrics exposition lint "
+             "(tools/metrics_lint.py) — the single CI entry point")
+    parser.add_argument(
+        "--update-baseline", action="store_true",
+        help="rewrite tools/tpulint/baseline.json with the current "
+             "finding set (review the diff — the baseline should only "
+             "ever shrink)")
+    parser.add_argument(
+        "--list", action="store_true",
+        help="print the checker catalog and exit")
+    args = parser.parse_args(argv)
+
+    if args.list:
+        for checker_id, summary in sorted(CHECKER_IDS.items()):
+            print("%-18s %s" % (checker_id, summary))
+        return 0
+
+    if args.update_baseline:
+        count = tpulint.update_baseline()
+        print("tpulint: baseline rewritten with %d accepted finding%s"
+              % (count, "" if count == 1 else "s"))
+        return 0
+
+    new, accepted, stale = tpulint.run_gated()
+    for finding in new:
+        print("tpulint: %s" % finding.format(), file=sys.stderr)
+    for entry in stale:
+        print("tpulint: %s" % entry, file=sys.stderr)
+    rc = 0
+    if new or stale:
+        print("tpulint FAILED: %d new finding%s, %d stale baseline "
+              "entr%s (baseline: %d accepted)"
+              % (len(new), "" if len(new) == 1 else "s",
+                 len(stale), "y" if len(stale) == 1 else "ies",
+                 len(accepted)), file=sys.stderr)
+        rc = 1
+    else:
+        print("tpulint passed: 0 new findings (%d baselined)"
+              % len(accepted))
+
+    if args.all and rc == 0:
+        # The exposition lint drives a live core; keep it after the
+        # static pass so a broken tree fails fast and cheap first.
+        import tools.metrics_lint as metrics_lint
+
+        rc = metrics_lint.main()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

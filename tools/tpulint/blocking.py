@@ -1,0 +1,115 @@
+"""Classification of blocking calls, shared by the lock-discipline and
+aio-blocking checkers.
+
+The list is grounded in what has actually burned this repo: PR 6 had
+to move record rendering outside ``_trace_lock``; the batcher/replica
+web mixes device work with bucket locks; and the aio clients must not
+run sync sleeps/sockets on the event loop."""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from tools.tpulint.framework import expr_text, terminal_name
+
+# Module-level callables that block the calling thread.
+_BLOCKING_MODULE_CALLS = {
+    ("time", "sleep"): "time.sleep blocks the thread",
+    ("socket", "create_connection"): "socket connect is unbounded I/O",
+    ("subprocess", "run"): "subprocess.run blocks on the child",
+    ("subprocess", "check_output"): "subprocess.check_output blocks",
+    ("subprocess", "check_call"): "subprocess.check_call blocks",
+    ("subprocess", "call"): "subprocess.call blocks",
+    ("jax", "device_get"): "jax device->host transfer stalls on the device",
+    ("jax", "device_put"): "jax host->device transfer stalls on the device",
+}
+
+# Bare-name calls (``from time import sleep``-style imports).
+_BLOCKING_NAME_CALLS = {
+    "sleep": "sleep blocks the thread",
+    "urlopen": "urlopen is unbounded network I/O",
+}
+
+# Method names that are blocking regardless of the receiver.
+_BLOCKING_METHODS = {
+    "recv": "socket recv blocks on the peer",
+    "recv_into": "socket recv blocks on the peer",
+    "sendall": "socket sendall blocks on the peer",
+    "accept": "socket accept blocks on the peer",
+    "getresponse": "HTTP response read blocks on the peer",
+    "urlopen": "urlopen is unbounded network I/O",
+    "communicate": "subprocess communicate blocks on the child",
+    "block_until_ready": "device sync stalls until the TPU drains",
+}
+
+
+def _bounded(call: ast.Call, timeout_position: int = 0) -> bool:
+    """Does this call carry a REAL timeout? The positional slot
+    matters: ``result``/``join``/``wait`` take timeout first, but
+    ``Queue.get(block, timeout)`` takes it SECOND — ``get(True)`` is
+    the block flag and still waits forever. Constant ``None``/bools
+    never bound anything."""
+    arg = None
+    if len(call.args) > timeout_position:
+        arg = call.args[timeout_position]
+    else:
+        for kw in call.keywords:
+            if kw.arg == "timeout":
+                arg = kw.value
+    if arg is None:
+        return False
+    if isinstance(arg, ast.Constant) and (
+            arg.value is None or isinstance(arg.value, bool)):
+        return False
+    return True
+
+
+def classify_blocking(call: ast.Call) -> Optional[str]:
+    """A one-line reason when this call blocks the calling thread,
+    else None. ``.wait()`` is handled separately by lock-discipline
+    (waiting on the innermost held condition is the cv idiom)."""
+    func = call.func
+    if isinstance(func, ast.Name):
+        return _BLOCKING_NAME_CALLS.get(func.id)
+    if not isinstance(func, ast.Attribute):
+        return None
+    receiver = func.value
+    receiver_name = terminal_name(receiver)
+    if receiver_name is not None:
+        reason = _BLOCKING_MODULE_CALLS.get((receiver_name, func.attr))
+        if reason is not None:
+            return reason
+    if func.attr in _BLOCKING_METHODS:
+        return _BLOCKING_METHODS[func.attr]
+    if func.attr == "result" and not _bounded(call):
+        return "Future.result() without a timeout blocks indefinitely"
+    if func.attr == "join" and not _bounded(call) and \
+            receiver_name not in (None, "os", "posixpath", "ntpath",
+                                  "path", "shlex"):
+        # str.join / os.path.join take args, so an arg-less join on a
+        # non-path receiver is a thread/process join.
+        return "join() without a timeout blocks indefinitely"
+    if func.attr == "get" and not _bounded(call, timeout_position=1) and \
+            not _nonblocking_get(call) and \
+            receiver_name is not None and "queue" in receiver_name.lower():
+        return "Queue.get() without a timeout blocks indefinitely"
+    return None
+
+
+def _nonblocking_get(call: ast.Call) -> bool:
+    """``Queue.get(False)`` / ``get(block=False)`` raises Empty
+    immediately — the explicitly non-blocking form."""
+    block = call.args[0] if call.args else next(
+        (kw.value for kw in call.keywords if kw.arg == "block"), None)
+    return isinstance(block, ast.Constant) and not block.value
+
+
+def untimed_wait(call: ast.Call) -> Optional[str]:
+    """Receiver text when this is ``<x>.wait(...)`` with no timeout
+    (Condition.wait / Event.wait / Thread-like), else None."""
+    func = call.func
+    if isinstance(func, ast.Attribute) and func.attr == "wait" and \
+            not _bounded(call):
+        return expr_text(func.value)
+    return None

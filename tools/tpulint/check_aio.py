@@ -1,0 +1,61 @@
+"""aio-blocking checker.
+
+Synchronous sleeps, sync sockets/subprocess I/O, unbounded
+``Future.result()``/``Queue.get()``/``join()`` inside ``async def``
+stall the whole event loop — in the aio clients that freezes every
+in-flight request sharing the loop, and in the aiohttp front-end it
+freezes the server. (The aiohttp front-end's own idiom is to push
+sync core calls through ``run_in_executor``; this checker keeps it
+that way.)"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.tpulint.blocking import classify_blocking, untimed_wait
+from tools.tpulint.framework import (
+    Finding,
+    SourceFile,
+    iter_functions,
+    own_nodes,
+)
+
+
+def _own_calls(func: ast.AST):
+    """Call nodes belonging to ``func`` itself — nested defs (sync
+    helpers handed to executors, callbacks) run on their own thread
+    and are excluded."""
+    for node in own_nodes(func):
+        if isinstance(node, ast.Call):
+            yield node
+
+
+def check_aio_blocking(src: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    for _qual, _cls, func in iter_functions(src.tree):
+        if not isinstance(func, ast.AsyncFunctionDef):
+            continue
+        # ``await x.wait()`` / ``await loop.run_in_executor(...)`` are
+        # the non-blocking aio idiom — an awaited call never stalls
+        # the loop, whatever its name.
+        awaited = {id(node.value) for node in ast.walk(func)
+                   if isinstance(node, ast.Await)}
+        for call in _own_calls(func):
+            if id(call) in awaited:
+                continue
+            reason = classify_blocking(call)
+            if reason is not None:
+                findings.append(src.finding(
+                    "aio-blocking", call,
+                    "%s inside async def %s — it stalls the event loop; "
+                    "await the aio equivalent or push it through "
+                    "run_in_executor" % (reason, func.name)))
+                continue
+            waited_on = untimed_wait(call)
+            if waited_on is not None:
+                findings.append(src.finding(
+                    "aio-blocking", call,
+                    "%s.wait() without a timeout inside async def %s "
+                    "stalls the event loop" % (waited_on, func.name)))
+    return findings

@@ -1,0 +1,311 @@
+"""proto-drift and metrics-doc-drift checkers.
+
+proto-drift: the schema exists in three places that are edited by
+hand — the ``.proto`` sources, the serialized descriptors embedded in
+``*_pb2.py`` (patched by ``tools/extend_inference_proto.py``, protoc
+is not in the image), and the patch lists inside that tool. All three
+must agree on every patched (message, field, number) triple, and the
+``.proto`` text must be syntactically sane (PR 8 shipped a ``/``
+comment that is invalid protobuf and broke downstream protoc users).
+
+metrics-doc-drift: every ``tpu_*`` Prometheus family registered by
+the server (``family("tpu_…", …)`` calls in ``client_tpu/server/``)
+must be documented in the docs/metrics.md catalog, and every
+``tpu_*`` family the catalog lists must still be emitted."""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+from typing import Dict, List, Set, Tuple
+
+from tools.tpulint.framework import Finding
+
+_PROTO_DIR = "client_tpu/protocol"
+
+
+def _normalize_rows(rows) -> List[Tuple[str, int]]:
+    return [(row[0], row[1]) for row in rows]
+
+
+def _expected_schema():
+    """(message -> [(field, number)]) per proto file, sourced from the
+    patch lists in tools/extend_inference_proto.py so the tool itself
+    is one of the three compared artifacts."""
+    import tools.extend_inference_proto as tool
+
+    inference = {
+        "BatchPipelineStatistics": _normalize_rows(tool.PIPELINE_FIELDS),
+        "ModelStatistics": (
+            _normalize_rows(tool.STATISTICS_FIELDS)
+            + _normalize_rows(tool.CACHE_COUNT_FIELDS)
+            + _normalize_rows(tool.QOS_COUNT_FIELDS)
+            + _normalize_rows(tool.REPLICA_COUNT_FIELDS)
+            + [("pipeline_stats", 8), ("sequence_stats", 11),
+               ("priority_stats", 15), ("tenant_stats", 16),
+               ("replica_stats", 17), ("stream_stats", 20)]),
+        "SequenceBatchingStatistics":
+            _normalize_rows(tool.SEQUENCE_STATS_FIELDS),
+        "PriorityStatistics": _normalize_rows(tool.PRIORITY_STATS_FIELDS),
+        "TenantStatistics": _normalize_rows(tool.TENANT_STATS_FIELDS),
+        "ReplicaStatistics": _normalize_rows(tool.REPLICA_STATS_FIELDS),
+        "StreamStatistics": _normalize_rows(tool.STREAM_STATS_FIELDS),
+        "InferStatistics": _normalize_rows(tool.CACHE_DURATION_FIELDS),
+    }
+    model_config = {
+        "DynamicBatchingConfig": (
+            _normalize_rows(tool.QUEUE_POLICY_FIELDS)
+            + _normalize_rows(tool.PRIORITY_FIELDS)
+            + [("priority_queue_policy", 9)]),
+        "PriorityQueuePolicy": _normalize_rows(tool.PRIORITY_POLICY_FIELDS),
+        "SequenceControlInput": _normalize_rows(tool.CONTROL_INPUT_FIELDS),
+        "SequenceStateConfig": _normalize_rows(tool.STATE_CONFIG_FIELDS),
+        "SequenceBatchingConfig":
+            _normalize_rows(tool.SEQUENCE_BATCHING_FIELDS),
+        "ResponseCacheConfig": [("enable", 1)],
+        "ModelConfig": [("response_cache", 15)],
+    }
+    return {
+        ("inference.proto", "inference_pb2.py"): inference,
+        ("model_config.proto", "model_config_pb2.py"): model_config,
+    }
+
+
+def _pb2_fields(pb2_path: pathlib.Path) -> Dict[str, Dict[str, int]]:
+    """message -> {field: number} parsed from the serialized
+    FileDescriptorProto embedded in a *_pb2.py."""
+    from google.protobuf import descriptor_pb2
+
+    import tools.extend_inference_proto as tool
+
+    source = pb2_path.read_text()
+    file_proto = descriptor_pb2.FileDescriptorProto()
+    file_proto.ParseFromString(tool.extract_serialized(source, pb2_path))
+    result: Dict[str, Dict[str, int]] = {}
+    for message in file_proto.message_type:
+        result[message.name] = {f.name: f.number for f in message.field}
+    return result
+
+
+def _proto_message_blocks(text: str) -> Dict[str, str]:
+    """message name -> body text (outermost messages, brace-matched)."""
+    blocks: Dict[str, str] = {}
+    for match in re.finditer(r"\bmessage\s+(\w+)\s*\{", text):
+        depth = 1
+        i = match.end()
+        while i < len(text) and depth:
+            if text[i] == "{":
+                depth += 1
+            elif text[i] == "}":
+                depth -= 1
+            i += 1
+        blocks[match.group(1)] = text[match.end():i]
+    return blocks
+
+
+def _strip_proto_comments(text: str) -> str:
+    text = re.sub(r"/\*.*?\*/", lambda m: re.sub(r"[^\n]", " ", m.group()),
+                  text, flags=re.S)
+    return re.sub(r"//[^\n]*", "", text)
+
+
+def check_proto_drift(root: pathlib.Path) -> List[Finding]:
+    findings: List[Finding] = []
+    schema = _expected_schema()
+    for (proto_name, pb2_name), messages in schema.items():
+        proto_path = root / _PROTO_DIR / proto_name
+        pb2_path = root / _PROTO_DIR / pb2_name
+        rel_proto = "%s/%s" % (_PROTO_DIR, proto_name)
+        rel_pb2 = "%s/%s" % (_PROTO_DIR, pb2_name)
+        if not proto_path.exists() or not pb2_path.exists():
+            findings.append(Finding(
+                "proto-drift", rel_proto, 1,
+                "expected proto/pb2 pair missing on disk"))
+            continue
+        proto_text = proto_path.read_text()
+        findings.extend(_proto_syntax(proto_text, rel_proto))
+        stripped = _strip_proto_comments(proto_text)
+        blocks = _proto_message_blocks(stripped)
+        try:
+            pb2_messages = _pb2_fields(pb2_path)
+        except Exception as e:  # noqa: BLE001 — a broken pb2 IS the finding
+            findings.append(Finding(
+                "proto-drift", rel_pb2, 1,
+                "embedded descriptor failed to parse: %s" % e))
+            continue
+        for message, fields in messages.items():
+            descriptor = pb2_messages.get(message)
+            block = blocks.get(message)
+            if descriptor is None:
+                findings.append(Finding(
+                    "proto-drift", rel_pb2, 1,
+                    "message %s from the extend_inference_proto patch "
+                    "list is absent from the pb2 descriptor — rerun "
+                    "tools/extend_inference_proto.py" % message))
+            if block is None:
+                findings.append(Finding(
+                    "proto-drift", rel_proto, 1,
+                    "message %s from the extend_inference_proto patch "
+                    "list is absent from the .proto source" % message))
+            for field, number in fields:
+                if descriptor is not None and \
+                        descriptor.get(field) != number:
+                    findings.append(Finding(
+                        "proto-drift", rel_pb2, 1,
+                        "%s.%s should be field %d per the patch list "
+                        "but the pb2 descriptor has %s"
+                        % (message, field, number,
+                           descriptor.get(field, "no such field"))))
+                if block is not None and not re.search(
+                        r"\b%s\s*=\s*%d\s*[;\[]" % (re.escape(field),
+                                                    number), block):
+                    findings.append(Finding(
+                        "proto-drift", rel_proto,
+                        _line_of(proto_text, "message %s" % message),
+                        "%s.%s = %d is in the patch list + pb2 but not "
+                        "in the .proto source — the three are out of "
+                        "sync" % (message, field, number)))
+        # Duplicate field numbers inside one .proto message (nested
+        # message/enum declarations have their own number space and
+        # are stripped first; oneof members share the parent's).
+        for message, block in blocks.items():
+            numbers = re.findall(r"=\s*(\d+)\s*[;\[]",
+                                 _strip_nested_blocks(block))
+            dupes = {n for n in numbers if numbers.count(n) > 1}
+            if dupes:
+                findings.append(Finding(
+                    "proto-drift", rel_proto,
+                    _line_of(proto_text, "message %s" % message),
+                    "duplicate field number(s) %s in message %s"
+                    % (sorted(dupes), message)))
+    return findings
+
+
+def _strip_nested_blocks(body: str) -> str:
+    """Remove nested ``message``/``enum`` declarations (their fields
+    number independently of the parent's)."""
+    out = []
+    i = 0
+    while i < len(body):
+        match = re.compile(r"\b(message|enum)\s+\w+\s*\{").search(body, i)
+        if match is None:
+            out.append(body[i:])
+            break
+        out.append(body[i:match.start()])
+        depth = 1
+        j = match.end()
+        while j < len(body) and depth:
+            if body[j] == "{":
+                depth += 1
+            elif body[j] == "}":
+                depth -= 1
+            j += 1
+        i = j
+    return "".join(out)
+
+
+def _line_of(text: str, needle: str) -> int:
+    index = text.find(needle)
+    if index < 0:
+        return 1
+    return text.count("\n", 0, index) + 1
+
+
+def _proto_syntax(text: str, rel_path: str) -> List[Finding]:
+    """The exact PR-8 defect class: a comment opened with a single
+    ``/`` is invalid protobuf (protoc: 'Expected top-level statement').
+    Also checks brace balance."""
+    findings: List[Finding] = []
+    in_block_comment = False
+    for lineno, line in enumerate(text.splitlines(), 1):
+        i = 0
+        while i < len(line):
+            if in_block_comment:
+                end = line.find("*/", i)
+                if end < 0:
+                    break
+                in_block_comment = False
+                i = end + 2
+                continue
+            ch = line[i]
+            if ch == '"':
+                closing = line.find('"', i + 1)
+                i = len(line) if closing < 0 else closing + 1
+                continue
+            if ch == "/":
+                nxt = line[i + 1] if i + 1 < len(line) else ""
+                if nxt == "/":
+                    i = len(line)
+                    continue
+                if nxt == "*":
+                    in_block_comment = True
+                    i += 2
+                    continue
+                findings.append(Finding(
+                    "proto-drift", rel_path, lineno,
+                    "stray '/' — protobuf comments are '//' or '/* */' "
+                    "(a '/' comment broke inference.proto in PR 8)"))
+                i = len(line)
+                continue
+            i += 1
+    stripped = _strip_proto_comments(text)
+    if stripped.count("{") != stripped.count("}"):
+        findings.append(Finding(
+            "proto-drift", rel_path, 1,
+            "unbalanced braces ({=%d, }=%d)"
+            % (stripped.count("{"), stripped.count("}"))))
+    return findings
+
+
+# -- metrics <-> docs -------------------------------------------------------
+
+_DOC_FAMILY = re.compile(r"^\|\s*`(tpu_[a-z0-9_]+)`")
+
+
+def _emitted_families(root: pathlib.Path):
+    """{family: (path, line)} for every family("tpu_…", …) call under
+    client_tpu/server/."""
+    emitted: Dict[str, Tuple[str, int]] = {}
+    for path in sorted((root / "client_tpu" / "server").glob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        rel = path.relative_to(root).as_posix()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id == "family" and node.args:
+                first = node.args[0]
+                if isinstance(first, ast.Constant) and \
+                        isinstance(first.value, str) and \
+                        first.value.startswith("tpu_"):
+                    emitted.setdefault(first.value, (rel, node.lineno))
+    return emitted
+
+
+def check_metrics_doc_drift(root: pathlib.Path) -> List[Finding]:
+    findings: List[Finding] = []
+    doc_path = root / "docs" / "metrics.md"
+    rel_doc = "docs/metrics.md"
+    if not doc_path.exists():
+        return [Finding("metrics-doc-drift", rel_doc, 1,
+                        "docs/metrics.md is missing")]
+    documented: Dict[str, int] = {}
+    for lineno, line in enumerate(doc_path.read_text().splitlines(), 1):
+        match = _DOC_FAMILY.match(line.strip())
+        if match:
+            documented.setdefault(match.group(1), lineno)
+    emitted = _emitted_families(root)
+    for family, (path, line) in sorted(emitted.items()):
+        if family not in documented:
+            findings.append(Finding(
+                "metrics-doc-drift", path, line,
+                "registered family %s is not documented in "
+                "docs/metrics.md" % family))
+    for family, lineno in sorted(documented.items()):
+        if family not in emitted:
+            findings.append(Finding(
+                "metrics-doc-drift", rel_doc, lineno,
+                "docs/metrics.md documents %s but no "
+                "client_tpu/server/ family() call registers it"
+                % family))
+    return findings

@@ -1,0 +1,414 @@
+"""lock-discipline and lock-order checkers.
+
+lock-discipline: no blocking call (sleep, unbounded Future.result /
+Queue.get / wait / join, socket & subprocess I/O, jax device
+transfers) lexically inside a ``with <lock>:`` body or between
+explicit ``acquire()``/``release()`` calls. Waiting without a timeout
+on the innermost held condition variable is the cv idiom and allowed;
+waiting on anything else while a lock is held is not. (Historical bug:
+PR 6 rendered trace records under ``_trace_lock``.)
+
+lock-order: builds the inter-procedural lock-acquisition graph (which
+locks are taken while which are held, resolved through ``self._x``
+attributes and module-local calls) and fails on cycles — a static
+deadlock detector for the batcher/replica/cache/repository lock web.
+Also flags re-acquisition of a known non-reentrant lock through a
+self-call chain."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.tpulint.blocking import classify_blocking, untimed_wait
+from tools.tpulint.framework import (
+    Finding,
+    SourceFile,
+    expr_text,
+    is_lockish,
+    iter_functions,
+    own_nodes,
+    terminal_name,
+)
+
+# -- lock-discipline --------------------------------------------------------
+
+
+def _calls_in(node: ast.AST):
+    """Call nodes inside ``node``, not descending into nested function
+    definitions (they run later, outside the lexical lock region)."""
+    for child in own_nodes(node):
+        if isinstance(child, ast.Call):
+            yield child
+
+
+def _releases_in(stmts: List[ast.stmt]) -> List[str]:
+    """Lock texts released anywhere in these statements (pruned)."""
+    released = []
+    for stmt in stmts:
+        for call in _calls_in(stmt):
+            if isinstance(call.func, ast.Attribute) and \
+                    call.func.attr == "release" and \
+                    is_lockish(call.func.value):
+                released.append(expr_text(call.func.value))
+    return released
+
+
+def _lock_call(stmt: ast.stmt, attr: str) -> Optional[str]:
+    """Lock text when ``stmt`` is ``<lock>.acquire()``/``.release()``
+    (bare expression or assignment of the acquire result)."""
+    value = None
+    if isinstance(stmt, ast.Expr):
+        value = stmt.value
+    elif isinstance(stmt, ast.Assign):
+        value = stmt.value
+    if isinstance(value, ast.Call) and \
+            isinstance(value.func, ast.Attribute) and \
+            value.func.attr == attr and is_lockish(value.func.value):
+        return expr_text(value.func.value)
+    return None
+
+
+def check_lock_discipline(src: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def flag(call: ast.Call, held: List[str], reason: str) -> None:
+        findings.append(src.finding(
+            "lock-discipline", call,
+            "%s while holding %s" % (reason, held[-1])))
+
+    def scan_expr(node: ast.AST, held: List[str]) -> None:
+        if not held:
+            return
+        for call in _calls_in(node):
+            waited_on = untimed_wait(call)
+            if waited_on is not None:
+                # cv.wait() releases cv's own lock — fine when cv IS
+                # the only lock held; a deadlock when an outer lock
+                # stays held across the wait.
+                if waited_on == held[-1] and len(held) == 1:
+                    continue
+                outer = [h for h in held if h != waited_on]
+                flag(call, outer or held,
+                     "%s.wait() without a timeout" % waited_on)
+                continue
+            reason = classify_blocking(call)
+            if reason is not None:
+                flag(call, held, reason)
+
+    def visit_block(stmts: List[ast.stmt], held: List[str]) -> None:
+        held = list(held)
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                new = []
+                for item in stmt.items:
+                    scan_expr(item.context_expr, held)
+                    if is_lockish(item.context_expr):
+                        new.append(expr_text(item.context_expr))
+                visit_block(stmt.body, held + new)
+                continue
+            acquired = _lock_call(stmt, "acquire")
+            if acquired is not None:
+                held.append(acquired)
+                continue
+            released = _lock_call(stmt, "release")
+            if released is not None and released in held:
+                held.remove(released)
+                continue
+            if isinstance(stmt, (ast.If, ast.While)):
+                scan_expr(stmt.test, held)
+                visit_block(stmt.body, held)
+                visit_block(stmt.orelse, held)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                scan_expr(stmt.iter, held)
+                visit_block(stmt.body, held)
+                visit_block(stmt.orelse, held)
+            elif isinstance(stmt, ast.Try):
+                visit_block(stmt.body, held)
+                for handler in stmt.handlers:
+                    visit_block(handler.body, held)
+                visit_block(stmt.orelse, held)
+                visit_block(stmt.finalbody, held)
+                # A release in the finalbody ALWAYS runs: the lock is
+                # no longer held after the Try (the canonical
+                # acquire/try/finally/release idiom must not taint the
+                # rest of the block).
+                for released in _releases_in(stmt.finalbody):
+                    if released in held:
+                        held.remove(released)
+            else:
+                scan_expr(stmt, held)
+
+    for _qual, _cls, func in iter_functions(src.tree):
+        visit_block(func.body, [])
+
+    return findings
+
+
+# -- lock-order -------------------------------------------------------------
+
+
+class _FuncLockInfo:
+    def __init__(self, qual: str):
+        self.qual = qual
+        self.direct: Set[str] = set()        # locks acquired anywhere
+        # (held_locks_tuple, "lock"|"call", lock_name_or_callee, path, line)
+        self.events: List[Tuple[Tuple[str, ...], str, str, str, int]] = []
+
+
+def _collect_lock_kinds(src: SourceFile, module: str):
+    """{class: {attr: kind}} and {class: {attr: aliased_attr}} from
+    ``self.X = threading.Lock()/RLock()/Condition(self.Y)`` inits."""
+    kinds: Dict[str, Dict[str, str]] = {}
+    aliases: Dict[str, Dict[str, str]] = {}
+    for _qual, cls, func in iter_functions(src.tree):
+        if cls is None:
+            continue
+        for stmt in ast.walk(func):
+            if not isinstance(stmt, ast.Assign) or \
+                    not isinstance(stmt.value, ast.Call):
+                continue
+            ctor = terminal_name(stmt.value.func)
+            if ctor not in ("Lock", "RLock", "Condition", "Semaphore",
+                            "BoundedSemaphore"):
+                continue
+            for target in stmt.targets:
+                if isinstance(target, ast.Attribute) and \
+                        isinstance(target.value, ast.Name) and \
+                        target.value.id == "self":
+                    kinds.setdefault(cls, {})[target.attr] = ctor
+                    if ctor == "Condition" and stmt.value.args:
+                        wrapped = stmt.value.args[0]
+                        if isinstance(wrapped, ast.Attribute) and \
+                                isinstance(wrapped.value, ast.Name) and \
+                                wrapped.value.id == "self":
+                            aliases.setdefault(cls, {})[target.attr] = \
+                                wrapped.attr
+    return kinds, aliases
+
+
+def _canonical(node: ast.AST, module: str, cls: Optional[str],
+               aliases: Dict[str, Dict[str, str]]) -> str:
+    """Stable identity for a lock expression. ``self._x`` resolves to
+    ``module.Class._x`` (a Condition wrapping another lock resolves to
+    the wrapped lock — same underlying mutex, not an ordering edge)."""
+    text = expr_text(node)
+    if cls is not None and text.startswith("self."):
+        attr = text[len("self."):]
+        resolved = aliases.get(cls, {}).get(attr, attr)
+        return "%s.%s.%s" % (module, cls, resolved)
+    return "%s:%s" % (module, text)
+
+
+def check_lock_order(sources: List[SourceFile]) -> List[Finding]:
+    infos: Dict[str, _FuncLockInfo] = {}
+    per_module_funcs: Dict[str, Set[str]] = {}
+    per_class_methods: Dict[Tuple[str, str], Set[str]] = {}
+    kinds_by_class: Dict[Tuple[str, str], Dict[str, str]] = {}
+
+    prepared = []
+    for src in sources:
+        module = src.rel_path[:-3].replace("/", ".")
+        kinds, aliases = _collect_lock_kinds(src, module)
+        for cls, attrs in kinds.items():
+            kinds_by_class[(module, cls)] = attrs
+        names = {qual for qual, _cls, _f in iter_functions(src.tree)}
+        per_module_funcs[module] = {n for n in names if "." not in n}
+        for qual in names:
+            if "." in qual:
+                cls, _, meth = qual.rpartition(".")
+                if "." not in cls:
+                    per_class_methods.setdefault((module, cls),
+                                                 set()).add(meth)
+        prepared.append((src, module, aliases))
+
+    def resolve_call(call: ast.Call, module: str,
+                     cls: Optional[str]) -> Optional[str]:
+        func = call.func
+        if isinstance(func, ast.Attribute) and \
+                isinstance(func.value, ast.Name) and \
+                func.value.id == "self" and cls is not None and \
+                func.attr in per_class_methods.get((module, cls), ()):
+            return "%s.%s.%s" % (module, cls, func.attr)
+        if isinstance(func, ast.Name) and \
+                func.id in per_module_funcs.get(module, ()):
+            return "%s.%s" % (module, func.id)
+        return None
+
+    for src, module, aliases in prepared:
+        for qual, cls, func in iter_functions(src.tree):
+            info = _FuncLockInfo("%s.%s" % (module, qual))
+            infos[info.qual] = info
+
+            def visit(stmts: List[ast.stmt], held: Tuple[str, ...],
+                      info=info, cls=cls, src=src, module=module) -> None:
+                for stmt in stmts:
+                    if isinstance(stmt, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef,
+                                         ast.ClassDef)):
+                        continue
+                    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                        new = list(held)
+                        for item in stmt.items:
+                            if is_lockish(item.context_expr):
+                                lock = _canonical(item.context_expr,
+                                                  module, cls,
+                                                  {cls: aliases.get(cls, {})}
+                                                  if cls else {})
+                                info.direct.add(lock)
+                                info.events.append(
+                                    (tuple(new), "lock", lock,
+                                     src.rel_path, item.context_expr.lineno))
+                                new.append(lock)
+                        visit(stmt.body, tuple(new))
+                        continue
+                    for call in _calls_in(stmt):
+                        func_node = call.func
+                        if isinstance(func_node, ast.Attribute) and \
+                                func_node.attr == "acquire" and \
+                                is_lockish(func_node.value):
+                            lock = _canonical(func_node.value, module, cls,
+                                              {cls: aliases.get(cls, {})}
+                                              if cls else {})
+                            info.direct.add(lock)
+                            info.events.append(
+                                (held, "lock", lock, src.rel_path,
+                                 call.lineno))
+                            continue
+                        callee = resolve_call(call, module, cls)
+                        if callee is not None:
+                            info.events.append(
+                                (held, "call", callee, src.rel_path,
+                                 call.lineno))
+                    if isinstance(stmt, (ast.If, ast.While)):
+                        visit(stmt.body, held)
+                        visit(stmt.orelse, held)
+                    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                        visit(stmt.body, held)
+                        visit(stmt.orelse, held)
+                    elif isinstance(stmt, ast.Try):
+                        visit(stmt.body, held)
+                        for handler in stmt.handlers:
+                            visit(handler.body, held)
+                        visit(stmt.orelse, held)
+                        visit(stmt.finalbody, held)
+
+            visit(func.body, ())
+
+    # Fixpoint: the transitive lock set each function may acquire.
+    acquires: Dict[str, Set[str]] = {
+        qual: set(info.direct) for qual, info in infos.items()}
+    changed = True
+    while changed:
+        changed = False
+        for qual, info in infos.items():
+            for _held, kind, target, _path, _line in info.events:
+                if kind == "call" and target in acquires:
+                    before = len(acquires[qual])
+                    acquires[qual] |= acquires[target]
+                    changed = changed or len(acquires[qual]) != before
+
+    # Edge set: held -> acquired (with a representative location).
+    edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+    reentrant: List[Finding] = []
+    for qual, info in infos.items():
+        for held, kind, target, path, line in info.events:
+            acquired = {target} if kind == "lock" else \
+                acquires.get(target, set())
+            for h in held:
+                for lock in acquired:
+                    if lock == h:
+                        if kind == "call" and _non_reentrant(
+                                h, kinds_by_class):
+                            reentrant.append(Finding(
+                                "lock-order", path, line,
+                                "call into %s re-acquires non-reentrant "
+                                "%s already held here" % (target, h)))
+                        continue
+                    edges.setdefault((h, lock),
+                                     (path, line, qual))
+
+    findings = list(reentrant)
+    for cycle in _find_cycles({pair for pair in edges}):
+        members = set(cycle)
+        in_cycle = sorted(
+            (pair, loc) for pair, loc in edges.items()
+            if pair[0] in members and pair[1] in members)
+        (held, acquired), (path, line, qual) = in_cycle[0]
+        findings.append(Finding(
+            "lock-order", path, line,
+            "lock-order cycle (potential deadlock) among {%s}: e.g. %s "
+            "is taken while %s is held, in %s"
+            % (", ".join(cycle), acquired, held, qual)))
+    return findings
+
+
+def _non_reentrant(lock: str, kinds_by_class) -> bool:
+    parts = lock.rsplit(".", 1)
+    if len(parts) != 2:
+        return False
+    prefix, attr = parts
+    module, _, cls = prefix.rpartition(".")
+    kind = kinds_by_class.get((module, cls), {}).get(attr)
+    return kind in ("Lock", "Condition")
+
+
+def _find_cycles(edges: Set[Tuple[str, str]]) -> List[List[str]]:
+    """Elementary cycles via SCC decomposition: each non-trivial SCC is
+    reported once as a sorted node list (stable across runs so the
+    baseline can anchor it)."""
+    graph: Dict[str, Set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    counter = [0]
+    sccs: List[List[str]] = []
+
+    def strongconnect(node: str) -> None:
+        work = [(node, iter(sorted(graph[node])))]
+        index[node] = low[node] = counter[0]
+        counter[0] += 1
+        stack.append(node)
+        on_stack.add(node)
+        while work:
+            current, it = work[-1]
+            advanced = False
+            for succ in it:
+                if succ not in index:
+                    index[succ] = low[succ] = counter[0]
+                    counter[0] += 1
+                    stack.append(succ)
+                    on_stack.add(succ)
+                    work.append((succ, iter(sorted(graph[succ]))))
+                    advanced = True
+                    break
+                if succ in on_stack:
+                    low[current] = min(low[current], index[succ])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[current])
+            if low[current] == index[current]:
+                component = []
+                while True:
+                    member = stack.pop()
+                    on_stack.discard(member)
+                    component.append(member)
+                    if member == current:
+                        break
+                if len(component) > 1:
+                    sccs.append(sorted(component))
+
+    for node in sorted(graph):
+        if node not in index:
+            strongconnect(node)
+    return sccs

@@ -1,0 +1,201 @@
+"""resource-pairing checker.
+
+Any ``acquire()`` / ``begin_*()`` call whose matching release
+(``release()`` / ``finish_*()``) is not guaranteed by a ``finally``
+block, an ``__exit__`` method, or a context manager is an error. This
+is the exact shape of the PR-7 stream-path tenant-token leak: a
+``repository.acquire`` that raised between a tenant-token spend and
+its release permanently starved a concurrency-capped tenant.
+
+Rules, per function:
+
+* an acquire whose receiver also has a matching release call in the
+  same function: at least one release site must be lexically inside a
+  ``finally`` block (or an ``__exit__`` body). Success-path +
+  except-handler releases do NOT count — that is precisely the shape
+  that leaked.
+* an acquire with NO matching release in the same function is an
+  error too, unless the function is ``__enter__``/``__init__`` and the
+  class's ``__exit__``/teardown methods release it, the result is
+  stored on ``self`` (ownership handed to the object), or the
+  function IS a generator (the caller's ``finally`` runs on close).
+
+Plain lock mutexes are the lock-discipline/lock-order checkers'
+domain and skipped here."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.tpulint.framework import (
+    Finding,
+    SourceFile,
+    expr_text,
+    is_lockish,
+    iter_functions,
+    own_nodes,
+)
+
+_RELEASE_OF = {
+    "acquire": ("release",),
+    "begin_unload": ("finish_unload", "unload"),
+}
+
+
+def _release_names(acquire_attr: str) -> Tuple[str, ...]:
+    if acquire_attr in _RELEASE_OF:
+        return _RELEASE_OF[acquire_attr]
+    if acquire_attr.startswith("begin_"):
+        return ("finish_" + acquire_attr[len("begin_"):],)
+    return ()
+
+
+def _acquire_attr(call: ast.Call) -> Optional[str]:
+    func = call.func
+    if not isinstance(func, ast.Attribute):
+        return None
+    if func.attr == "acquire" or func.attr.startswith("begin_"):
+        if is_lockish(func.value):
+            return None  # mutexes are lock-discipline's domain
+        return func.attr
+    return None
+
+
+def _is_generator(func: ast.AST) -> bool:
+    return any(isinstance(node, (ast.Yield, ast.YieldFrom))
+               for node in own_nodes(func))
+
+
+def _assigned_to_self(stmt: Optional[ast.stmt]) -> bool:
+    if not isinstance(stmt, ast.Assign):
+        return False
+    for target in stmt.targets:
+        if isinstance(target, ast.Attribute) and \
+                isinstance(target.value, ast.Name) and \
+                target.value.id == "self":
+            return True
+    return False
+
+
+def check_resource_pairing(src: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+
+    # class -> set of (receiver_text, release_attr) released in
+    # __exit__/close/stop/shutdown-style teardown methods.
+    teardown_releases: Dict[str, Set[Tuple[str, str]]] = {}
+    for _qual, cls, func in iter_functions(src.tree):
+        if cls is None or func.name not in ("__exit__", "__aexit__",
+                                            "close", "stop", "shutdown",
+                                            "unload", "__del__"):
+            continue
+        for node in ast.walk(func):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute):
+                teardown_releases.setdefault(cls, set()).add(
+                    (expr_text(node.func.value), node.func.attr))
+
+    for qual, cls, func in iter_functions(src.tree):
+        if func.name in ("__exit__", "__aexit__"):
+            continue
+        acquires = []  # (call, attr, receiver_text, enclosing_stmt)
+        releases = []  # (receiver_text, attr, stmt)
+
+        # Pair statements with their calls so we can ask "is this
+        # release inside a finally suite". Both walks prune nested
+        # function bodies — a nested def's acquires/releases belong to
+        # that def's own visit, not the enclosing function's.
+        for stmt in own_nodes(func):
+            if not isinstance(stmt, ast.stmt):
+                continue
+            for node in own_nodes(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                attr = _acquire_attr(node)
+                if attr is not None and not _inside_with(func, node):
+                    acquires.append((node, attr,
+                                     expr_text(node.func.value), stmt))
+                if isinstance(node.func, ast.Attribute):
+                    releases.append((expr_text(node.func.value),
+                                     node.func.attr, stmt))
+
+        # Deduplicate: ast.walk reaches each call through every
+        # enclosing statement; keep the innermost statement per call.
+        seen = {}
+        for call, attr, receiver, stmt in acquires:
+            seen[id(call)] = (call, attr, receiver, stmt)
+        acquires = list(seen.values())
+
+        for call, attr, receiver, stmt in acquires:
+            wanted = _release_names(attr)
+            matching = [(r_receiver, r_attr, r_stmt)
+                        for r_receiver, r_attr, r_stmt in releases
+                        if r_attr in wanted and _receivers_match(
+                            receiver, r_receiver)]
+            if matching:
+                if any(_stmt_in_finally_chain(func, r_stmt)
+                       for _r, _a, r_stmt in matching):
+                    continue
+                findings.append(src.finding(
+                    "resource-pairing", call,
+                    "%s.%s() is released in this function but never "
+                    "inside a finally: an exception between the two "
+                    "leaks the %s" % (receiver, attr,
+                                      _resource_noun(attr))))
+                continue
+            # No release here: excused hand-off patterns.
+            if _assigned_to_self(stmt):
+                continue
+            if _is_generator(func):
+                continue
+            if func.name in ("__enter__", "__init__", "start"):
+                excused = cls is not None and any(
+                    r_attr in wanted and _receivers_match(receiver, r_recv)
+                    for r_recv, r_attr in teardown_releases.get(cls, ()))
+                if excused:
+                    continue
+            findings.append(src.finding(
+                "resource-pairing", call,
+                "%s.%s() has no matching %s in this function (nor a "
+                "teardown hand-off): the %s leaks on every path"
+                % (receiver, attr, "/".join(wanted) or "release",
+                   _resource_noun(attr))))
+    return findings
+
+
+def _resource_noun(attr: str) -> str:
+    return "model/token slot" if attr == "acquire" else "drain state"
+
+
+def _receivers_match(a: str, b: str) -> bool:
+    """``self.repository`` vs ``repository`` vs ``self._core.repository``
+    should pair: compare on the final attribute component. A suffix
+    match also pairs (``quotas`` acquired, ``tenant_quotas``
+    released) — local aliases commonly shorten the attribute name."""
+    last_a, last_b = a.split(".")[-1], b.split(".")[-1]
+    return last_a == last_b or last_a.endswith(last_b) or \
+        last_b.endswith(last_a)
+
+
+def _inside_with(func: ast.AST, call: ast.Call) -> bool:
+    """True when the acquire call IS a with-item context expression
+    (``with pool.acquire() as x:`` releases via __exit__)."""
+    for node in ast.walk(func):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                for sub in ast.walk(item.context_expr):
+                    if sub is call:
+                        return True
+    return False
+
+
+def _stmt_in_finally_chain(func: ast.AST, stmt: ast.stmt) -> bool:
+    """True when ``stmt`` lives (at any depth) inside some Try's
+    finalbody within ``func``."""
+    for node in ast.walk(func):
+        if isinstance(node, ast.Try):
+            for final_stmt in node.finalbody:
+                for sub in ast.walk(final_stmt):
+                    if sub is stmt:
+                        return True
+    return False

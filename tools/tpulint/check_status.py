@@ -1,0 +1,192 @@
+"""status-literal and retry-after checkers.
+
+status-literal: gRPC-status and HTTP-code literals must route through
+the one canonical mapping table in ``client_tpu/status_map.py``. Before
+this checker existed the same status->code tables were hand-copied
+into three front-ends and drifted across ~29 call sites. Flagged
+shapes (inside the scoped transport/server modules):
+
+* a dict literal mapping two or more canonical status strings to
+  HTTP ints or ``grpc.StatusCode`` members — a shadow mapping table;
+* an HTTP error-code literal (400/404/409/429/500/501/503/504) used
+  as a ``status=``/``code=`` keyword, as a dict value keyed by a
+  canonical status string, or in an ``in (…)``/``== …`` comparison;
+* any ``grpc.StatusCode.<X>`` attribute access outside status_map.
+
+retry-after: every ``UNAVAILABLE``/``RESOURCE_EXHAUSTED`` error
+construction must attach a Retry-After estimate (the
+``retry_after_s`` attribute the front-ends serialize). Historical
+bug: PR 7's quota rejects advertised Retry-After while queue sheds
+and drain rejects sent the meaningless legacy "1". The canonical
+constructor is ``status_map.retryable_error(...)``; a direct
+``InferenceServerException(status="UNAVAILABLE")`` with no
+``<name>.retry_after_s = …`` in the same function is an error."""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from tools.tpulint.framework import (
+    Finding,
+    SourceFile,
+    iter_functions,
+    own_nodes,
+)
+
+#: The module that owns the mapping — everything here is allowed in it.
+STATUS_MAP_MODULE = "client_tpu/status_map.py"
+
+# The vocabulary is DERIVED from the canonical table, not copied: a
+# status/code added to status_map is immediately gated here too (a
+# hand-copied set already drifted once — 401/403 were mapped but
+# unflagged on day one).
+from client_tpu import status_map as _status_map  # noqa: E402
+
+CANONICAL_STATUSES = frozenset(_status_map.HTTP_STATUS) | {
+    "CANCELLED", "OK"}
+
+HTTP_ERROR_CODES = frozenset(_status_map.HTTP_STATUS.values())
+
+RETRYABLE_STATUSES = _status_map.RETRYABLE_STATUSES
+
+
+def _const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _const_code(node: ast.AST) -> Optional[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool) \
+            and node.value in HTTP_ERROR_CODES:
+        return node.value
+    return None
+
+
+def _is_status_code_attr(node: ast.AST) -> bool:
+    """``grpc.StatusCode.X`` / ``StatusCode.X`` attribute chains."""
+    if not isinstance(node, ast.Attribute):
+        return False
+    value = node.value
+    if isinstance(value, ast.Attribute) and value.attr == "StatusCode":
+        return True
+    if isinstance(value, ast.Name) and value.id == "StatusCode":
+        return True
+    return False
+
+
+def check_status_literals(src: SourceFile) -> List[Finding]:
+    if src.rel_path == STATUS_MAP_MODULE:
+        return []
+    findings: List[Finding] = []
+
+    for node in ast.walk(src.tree):
+        # Shadow mapping tables: {"NOT_FOUND": 404, ...} or
+        # {"NOT_FOUND": grpc.StatusCode.NOT_FOUND, ...}.
+        if isinstance(node, ast.Dict):
+            canonical_keys = [k for k in node.keys
+                              if k is not None and
+                              _const_str(k) in CANONICAL_STATUSES]
+            if len(canonical_keys) >= 2:
+                findings.append(src.finding(
+                    "status-literal", node,
+                    "shadow status mapping table — use "
+                    "client_tpu/status_map.py, the one canonical table"))
+                continue
+        # grpc.StatusCode.* anywhere outside the canonical map.
+        if _is_status_code_attr(node):
+            findings.append(src.finding(
+                "status-literal", node,
+                "grpc.StatusCode.%s referenced directly — route through "
+                "status_map.grpc_code()" % node.attr))
+        # status=<error literal> keywords.
+        if isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if kw.arg in ("status", "code", "status_code"):
+                    code = _const_code(kw.value)
+                    if code is not None:
+                        findings.append(src.finding(
+                            "status-literal", kw.value,
+                            "bare HTTP %d literal as %s= — use "
+                            "status_map.http_status()" % (code, kw.arg)))
+        # Comparisons against error-code literals: status in (503, 429)
+        # or status == 503.
+        if isinstance(node, ast.Compare):
+            for comparator in node.comparators:
+                elements = comparator.elts if isinstance(
+                    comparator, (ast.Tuple, ast.List, ast.Set)) else \
+                    [comparator]
+                codes = [c for c in (
+                    _const_code(e) for e in elements) if c is not None]
+                if codes:
+                    findings.append(src.finding(
+                        "status-literal", node,
+                        "comparison against bare HTTP code(s) %s — use "
+                        "status_map constants (e.g. RETRYABLE_HTTP)"
+                        % sorted(codes)))
+    return findings
+
+
+def _status_kwarg(call: ast.Call) -> Optional[str]:
+    """The canonical status a constructor call carries, if literal."""
+    for kw in call.keywords:
+        if kw.arg == "status":
+            return _const_str(kw.value)
+    # InferenceServerException(msg, "UNAVAILABLE") positional form.
+    if len(call.args) >= 2:
+        return _const_str(call.args[1])
+    return None
+
+
+def check_retry_after(src: SourceFile) -> List[Finding]:
+    if src.rel_path == STATUS_MAP_MODULE:
+        return []
+    findings: List[Finding] = []
+    for _qual, _cls, func in iter_functions(src.tree):
+        # Names that get a ``retry_after_s`` attribute somewhere in
+        # this function (the legacy attach pattern). Pruned walk: a
+        # nested helper attaching to ITS local must not excuse the
+        # enclosing function's bare construction.
+        attached = set()
+        for node in own_nodes(func):
+            if isinstance(node, ast.Assign):
+                for target in node.targets:
+                    if isinstance(target, ast.Attribute) and \
+                            target.attr == "retry_after_s" and \
+                            isinstance(target.value, ast.Name):
+                        attached.add(target.value.id)
+        for node in own_nodes(func):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = node.func
+            name = callee.attr if isinstance(callee, ast.Attribute) \
+                else (callee.id if isinstance(callee, ast.Name) else "")
+            if name != "InferenceServerException":
+                continue
+            status = _status_kwarg(node)
+            if status not in RETRYABLE_STATUSES:
+                continue
+            if any(kw.arg == "retry_after_s" for kw in node.keywords):
+                continue
+            # Excused when the construction is assigned to a name that
+            # later gets .retry_after_s set in this function.
+            assigned_name = _assignment_target_name(func, node)
+            if assigned_name is not None and assigned_name in attached:
+                continue
+            findings.append(src.finding(
+                "retry-after", node,
+                "%s error raised without a Retry-After estimate — use "
+                "status_map.retryable_error(msg, status, retry_after_s)"
+                % status))
+    return findings
+
+
+def _assignment_target_name(func: ast.AST, call: ast.Call) -> Optional[str]:
+    for node in own_nodes(func):
+        if isinstance(node, ast.Assign) and node.value is call:
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    return target.id
+    return None

@@ -1,0 +1,277 @@
+"""tpulint core: findings, suppressions, baseline, file discovery.
+
+The repo-specific static-analysis framework (stdlib ``ast`` only — the
+container carries no third-party linters). Each checker is grounded in
+a defect class this repo has actually shipped and fixed; see
+docs/static_analysis.md for the catalog and the historical bug behind
+every checker id.
+
+Suppression syntax (a reason is REQUIRED — a bare disable is itself a
+finding)::
+
+    something_flagged()  # tpulint: disable=lock-discipline -- probe is bounded
+
+The comment may also stand alone on the line directly above the
+flagged statement. Accepted pre-existing findings live in
+``tools/tpulint/baseline.json``; the CI gate is zero NEW findings and
+zero STALE baseline entries (an entry whose anchored line changed or
+vanished must be pruned, so the baseline can only shrink).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import pathlib
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
+
+#: checker id -> one-line defect class (the catalog; docs/static_analysis.md
+#: carries the long form with the motivating historical bug).
+CHECKER_IDS = {
+    "lock-discipline": "blocking call while a lock is held",
+    "lock-order": "cyclic lock-acquisition order (static deadlock)",
+    "resource-pairing": "acquire/begin_* without a release in finally/__exit__",
+    "status-literal": "HTTP/gRPC status literal outside client_tpu/status_map.py",
+    "retry-after": "UNAVAILABLE/RESOURCE_EXHAUSTED error without Retry-After",
+    "aio-blocking": "synchronous blocking call inside async def",
+    "proto-drift": ".proto / *_pb2.py / extend_inference_proto.py disagree",
+    "metrics-doc-drift": "tpu_* family and docs/metrics.md disagree",
+    "bad-suppression": "tpulint disable comment without a reason",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    checker: str
+    path: str  # repo-relative, posix separators
+    line: int
+    message: str
+
+    def format(self) -> str:
+        return "%s:%d: [%s] %s" % (self.path, self.line, self.checker,
+                                   self.message)
+
+    def key(self) -> Tuple[str, str, int]:
+        return (self.checker, self.path, self.line)
+
+
+class SourceFile:
+    """One parsed python file plus its suppression table."""
+
+    def __init__(self, path: pathlib.Path, root: pathlib.Path = REPO_ROOT):
+        self.abs_path = path
+        self.rel_path = path.relative_to(root).as_posix()
+        self.source = path.read_text()
+        self.lines = self.source.splitlines()
+        self.tree = ast.parse(self.source, filename=str(path))
+        self._suppressed, self.bad_suppressions = _parse_suppressions(
+            self.lines, self.rel_path)
+
+    def suppressed(self, checker: str, line: int) -> bool:
+        return checker in self._suppressed.get(line, ())
+
+    def finding(self, checker: str, node_or_line, message: str) -> Finding:
+        line = getattr(node_or_line, "lineno", node_or_line)
+        return Finding(checker, self.rel_path, int(line), message)
+
+
+_DISABLE = re.compile(
+    r"#\s*tpulint:\s*disable=(?P<ids>[a-z-]+(?:\s*,\s*[a-z-]+)*)"
+    r"(?P<reason>\s+--\s+\S.*)?")
+
+
+def _parse_suppressions(lines: Sequence[str], rel_path: str):
+    """line number -> set of disabled checker ids. A stand-alone
+    comment line applies to the next non-blank line; an inline comment
+    applies to its own line. A disable without a ``-- reason`` is
+    reported as a ``bad-suppression`` finding instead of honored."""
+    suppressed: Dict[int, set] = {}
+    bad: List[Finding] = []
+    for lineno, text in enumerate(lines, 1):
+        match = _DISABLE.search(text)
+        if match is None:
+            continue
+        ids = {part.strip() for part in match.group("ids").split(",")}
+        unknown = ids - set(CHECKER_IDS)
+        if match.group("reason") is None:
+            bad.append(Finding(
+                "bad-suppression", rel_path, lineno,
+                "disable=%s has no ' -- reason'; a suppression must "
+                "say why the finding is accepted" % ",".join(sorted(ids))))
+            continue
+        if unknown:
+            bad.append(Finding(
+                "bad-suppression", rel_path, lineno,
+                "unknown checker id(s) %s in disable comment"
+                % ",".join(sorted(unknown))))
+            ids -= unknown
+        target = lineno
+        if text.lstrip().startswith("#"):
+            # Stand-alone comment: applies to the next non-blank,
+            # non-comment line.
+            for follow in range(lineno + 1, len(lines) + 1):
+                stripped = lines[follow - 1].strip()
+                if stripped and not stripped.startswith("#"):
+                    target = follow
+                    break
+        suppressed.setdefault(target, set()).update(ids)
+        # An inline disable also covers a multi-line statement that
+        # STARTS on this line; checkers anchor findings at the
+        # statement's first line, so same-line coverage suffices.
+    return suppressed, bad
+
+
+def iter_python_files(root: pathlib.Path,
+                      rel_dirs: Iterable[str]) -> List[pathlib.Path]:
+    files: List[pathlib.Path] = []
+    for rel in rel_dirs:
+        base = root / rel
+        if base.is_file():
+            files.append(base)
+            continue
+        if base.is_dir():
+            files.extend(sorted(base.rglob("*.py")))
+    # Generated protobuf modules are machine-written; linting them
+    # produces nothing actionable.
+    return [f for f in files if not f.name.endswith("_pb2.py")]
+
+
+def load_sources(root: pathlib.Path,
+                 rel_dirs: Iterable[str]) -> List[SourceFile]:
+    return [SourceFile(path, root)
+            for path in iter_python_files(root, rel_dirs)]
+
+
+# -- baseline ---------------------------------------------------------------
+
+BASELINE_PATH = REPO_ROOT / "tools" / "tpulint" / "baseline.json"
+
+
+def load_baseline(path: pathlib.Path = BASELINE_PATH) -> List[dict]:
+    if not path.exists():
+        return []
+    return json.loads(path.read_text())
+
+
+def save_baseline(findings: Sequence[Finding], root: pathlib.Path,
+                  path: pathlib.Path = BASELINE_PATH) -> None:
+    entries = []
+    for finding in sorted(findings, key=lambda f: (f.path, f.line,
+                                                   f.checker)):
+        entries.append({
+            "checker": finding.checker,
+            "path": finding.path,
+            "line": finding.line,
+            # Content anchor: the stripped source text of the flagged
+            # line. If the line moves or changes, the entry goes STALE
+            # and the gate fails until the baseline is pruned — stale
+            # suppressions can never pile up silently.
+            "text": _line_text(root, finding.path, finding.line),
+            "message": finding.message,
+        })
+    path.write_text(json.dumps(entries, indent=1) + "\n")
+
+
+def _line_text(root: pathlib.Path, rel_path: str, line: int) -> str:
+    try:
+        lines = (root / rel_path).read_text().splitlines()
+        return lines[line - 1].strip()
+    except (OSError, IndexError):
+        return ""
+
+
+def apply_baseline(findings: Sequence[Finding], baseline: Sequence[dict],
+                   root: pathlib.Path):
+    """Split findings into (new, accepted) and report stale baseline
+    entries. A baseline entry matches a finding only when checker,
+    path, line AND the anchored line text all still agree."""
+    index = {}
+    for entry in baseline:
+        index[(entry["checker"], entry["path"], entry["line"])] = entry
+    new: List[Finding] = []
+    accepted: List[Finding] = []
+    matched = set()
+    for finding in findings:
+        entry = index.get(finding.key())
+        if entry is not None and \
+                _line_text(root, finding.path, finding.line) == entry["text"]:
+            accepted.append(finding)
+            matched.add(finding.key())
+        else:
+            new.append(finding)
+    stale = []
+    for key, entry in index.items():
+        if key in matched:
+            continue
+        stale.append("%s:%d: [%s] baseline entry is stale (line changed, "
+                     "moved, or the finding is fixed) — prune it: %r"
+                     % (entry["path"], entry["line"], entry["checker"],
+                        entry["text"]))
+    return new, accepted, stale
+
+
+# -- shared AST helpers -----------------------------------------------------
+
+LOCK_NAME = re.compile(
+    r"(^|_)(lock|mutex|cv|cond|condition)$|(^|_)locks?$")
+
+
+def expr_text(node: ast.AST) -> str:
+    """Stable source-ish text for an expression (receiver matching)."""
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse covers all exprs we see
+        return ast.dump(node)
+
+
+def terminal_name(node: ast.AST) -> Optional[str]:
+    """The last identifier of a Name/Attribute chain, else None."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def is_lockish(node: ast.AST) -> bool:
+    """Does this with-item / receiver look like a mutex or condition
+    variable? Name-based: the repo's idiom is ``self._lock`` /
+    ``self._cv`` / ``tail_lock`` etc."""
+    name = terminal_name(node)
+    return name is not None and LOCK_NAME.search(name) is not None
+
+
+def own_nodes(node: ast.AST):
+    """Descendants of ``node`` excluding nested function/lambda/class
+    bodies — their statements run in a different frame (and possibly
+    at a different time), so they must never color the enclosing
+    scope. The one pruned-walk helper every checker shares (plain
+    ``ast.walk`` + ``continue`` does NOT prune subtrees)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+            continue
+        yield child
+        stack.extend(ast.iter_child_nodes(child))
+
+
+def iter_functions(tree: ast.AST):
+    """Yield (qualname, class_name_or_None, func_node) for every
+    function/method, including nested ones."""
+    stack: List[Tuple[str, Optional[str], ast.AST]] = [("", None, tree)]
+    while stack:
+        prefix, cls, node = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                stack.append(("%s%s." % (prefix, child.name), child.name,
+                              child))
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = "%s%s" % (prefix, child.name)
+                yield qual, cls, child
+                stack.append(("%s." % qual, cls, child))
