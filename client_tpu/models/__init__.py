@@ -11,7 +11,7 @@ from client_tpu.server.model import ServedModel
 
 def builtin_model_factories(repository=None
                             ) -> Dict[str, Callable[[], ServedModel]]:
-    from client_tpu.models.add_sub import AddSub
+    from client_tpu.models.add_sub import AddSub, MultiOutLarge
     from client_tpu.models.simple_extra import (
         DynaSequence,
         RepeatInt32,
@@ -94,6 +94,14 @@ def builtin_model_factories(repository=None
         ),
         "add_sub_tpu": lambda: AddSub(
             name="add_sub_tpu", datatype="FP32", shape=(16,), device="tpu"
+        ),
+        # Overlapped-vs-legacy relay-fetch A/B pair: identical
+        # 4-output x 4 MiB models, one with the fetch subsystem on
+        # (the default), one opted out via overlapped_fetch=False
+        # (tools/fetch_smoke.py + the bench relay_fetch stage).
+        "fetch_bench": lambda: MultiOutLarge(name="fetch_bench"),
+        "fetch_bench_legacy": lambda: MultiOutLarge(
+            name="fetch_bench_legacy", overlapped=False
         ),
         "simple_string": StringAddSub,
         "simple_sequence": SequenceAccumulator,

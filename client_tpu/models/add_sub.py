@@ -71,3 +71,55 @@ class AddSub(ServedModel):
         else:
             zero = jnp.zeros(self._shape, dtype=np_dtype)
             jax.block_until_ready(self._fn(zero, zero))
+
+
+class MultiOutLarge(ServedModel):
+    """Relay-fetch testbed: a tiny input fans out to ``out_count``
+    multi-MiB outputs (default 4 x 4 MiB fp32), so the device->host
+    output relay — not compute — dominates the request. The
+    ``fetch_bench`` / ``fetch_bench_legacy`` pair A/Bs the overlapped
+    fetch subsystem (client_tpu.server.fetch) against the serial
+    blocking np.asarray baseline on otherwise identical models
+    (tools/fetch_smoke.py and the bench relay_fetch stage).
+
+    Dynamic batching with preferred size 4 keeps single requests off
+    the batcher's passthrough shortcut (batch 1 pads to 4), so every
+    execution exercises the fused-output fetch path the A/B measures.
+    Placement follows the default device — the accelerator when one is
+    present, which is where the relay tax is real."""
+
+    platform = "jax"
+
+    def __init__(self, name: str = "fetch_bench", out_count: int = 4,
+                 elements: int = 1 << 20, overlapped: bool = True):
+        super().__init__()
+        self.name = name
+        self.max_batch_size = 4
+        self.dynamic_batching = True
+        self.preferred_batch_sizes = [4]
+        self.max_queue_delay_us = 2000
+        self.overlapped_fetch = overlapped
+        self._out_count = out_count
+        self._elements = elements
+        self.inputs = [TensorSpec("INPUT0", "FP32", [16])]
+        self.outputs = [
+            TensorSpec("OUTPUT%d" % i, "FP32", [elements])
+            for i in range(out_count)
+        ]
+
+        def produce(a):
+            base = jnp.sum(a, axis=-1, keepdims=True)  # (batch, 1)
+            ramp = jnp.arange(elements, dtype=jnp.float32)
+            return tuple(base + ramp * float(i + 1)
+                         for i in range(out_count))
+
+        self._fn = jax.jit(produce)
+
+    def infer(self, inputs: Dict[str, np.ndarray],
+              parameters: Optional[dict] = None) -> Dict[str, np.ndarray]:
+        outs = self._fn(jnp.asarray(inputs["INPUT0"], dtype=jnp.float32))
+        return {"OUTPUT%d" % i: out for i, out in enumerate(outs)}
+
+    def warmup(self) -> None:
+        zero = jnp.zeros((self.max_batch_size, 16), dtype=jnp.float32)
+        jax.block_until_ready(self._fn(zero))

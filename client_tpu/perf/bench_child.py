@@ -1131,6 +1131,101 @@ def run_telemetry_measure(core, model_name: str = "add_sub_large",
     }
 
 
+def run_fetch_measure(core, threads: int = 4, rounds: int = 3,
+                      per_round: int = 3) -> dict:
+    """Relay-fetch A/B (ROADMAP item 1's measured form): interleaved
+    closed loops on the ``fetch_bench`` / ``fetch_bench_legacy`` pair
+    — identical 4-output x 4 MiB models, one with the overlapped
+    output-fetch subsystem (client_tpu.server.fetch), one opted out to
+    the legacy serial blocking np.asarray. Reports client
+    throughput/p50 per arm plus the server-side
+    ``tpu_stage_duration_us{stage=relay_fetch}`` p50 window deltas and
+    their ratio — on an accelerator this is the device->host relay
+    win itself; on the cpu backend both arms materialize committed
+    host buffers and the ratio sits near 1 (tools/fetch_smoke.py
+    gates the overlap mechanism with simulated transfers)."""
+    import threading as _threading
+
+    import numpy as np
+
+    from client_tpu._infer_common import InferInput
+    from client_tpu.grpc._utils import get_inference_request
+    from client_tpu.perf.metrics_manager import (
+        histogram_quantiles,
+        parse_prometheus,
+        summarize_metrics,
+    )
+
+    def request(model_name: str, seed: int):
+        tensor = InferInput("INPUT0", [1, 16], "FP32")
+        tensor.set_data_from_numpy(
+            np.full((1, 16), float(seed % 31), dtype=np.float32))
+        return get_inference_request(model_name=model_name,
+                                     inputs=[tensor], outputs=None)
+
+    def closed_loop(model_name: str) -> tuple:
+        latencies: list = []
+        merge = _threading.Lock()
+
+        def worker(offset: int):
+            local = []
+            for i in range(per_round):
+                req = request(model_name, offset * 31 + i)
+                t_start = time.monotonic_ns()
+                core.infer(req)
+                local.append(time.monotonic_ns() - t_start)
+            with merge:
+                latencies.extend(local)
+
+        t0 = time.monotonic()
+        pool = [_threading.Thread(target=worker, args=(i,))
+                for i in range(threads)]
+        for thread in pool:
+            thread.start()
+        for thread in pool:
+            thread.join()
+        elapsed = time.monotonic() - t0
+        if not latencies or elapsed <= 0:
+            return 0.0, 0.0
+        latencies.sort()
+        return (len(latencies) / elapsed,
+                latencies[len(latencies) // 2] / 1000.0)
+
+    for model_name in ("fetch_bench", "fetch_bench_legacy"):
+        closed_loop(model_name)  # warm (compile + first fused batch)
+    before = core.metrics_text()
+    over_rounds, legacy_rounds = [], []
+    for _ in range(rounds):
+        # Interleaved windows: adjacent A/B rounds share the host's
+        # drift state (same discipline as run_telemetry_measure).
+        over_rounds.append(closed_loop("fetch_bench"))
+        legacy_rounds.append(closed_loop("fetch_bench_legacy"))
+    after = core.metrics_text()
+    over_rounds.sort()
+    legacy_rounds.sort()
+    over_tput, over_p50 = over_rounds[len(over_rounds) // 2]
+    legacy_tput, legacy_p50 = legacy_rounds[len(legacy_rounds) // 2]
+    quantiles = histogram_quantiles(summarize_metrics(
+        [parse_prometheus(before), parse_prometheus(after)]))
+    over_entry = quantiles.get("stage_duration_us|fetch_bench|srelay_fetch")
+    legacy_entry = quantiles.get(
+        "stage_duration_us|fetch_bench_legacy|srelay_fetch")
+    over_relay = over_entry["p50_us"] if over_entry else 0.0
+    legacy_relay = legacy_entry["p50_us"] if legacy_entry else 0.0
+    return {
+        "overlapped_tput": round(over_tput, 2),
+        "overlapped_p50_us": round(over_p50, 1),
+        "legacy_tput": round(legacy_tput, 2),
+        "legacy_p50_us": round(legacy_p50, 1),
+        "relay_fetch_p50_overlapped_us": round(over_relay, 1),
+        "relay_fetch_p50_legacy_us": round(legacy_relay, 1),
+        "relay_fetch_p50_speedup": round(
+            legacy_relay / over_relay, 2) if over_relay > 0 else 0.0,
+        "relay_fetch_executions": int(
+            over_entry["count"] if over_entry else 0),
+    }
+
+
 def sequence_stats(core, model_name: str):
     """Sequence-scheduler snapshot for bench evidence (slot occupancy
     + lifetime counters from ModelStatistics.sequence_stats)."""
@@ -2033,6 +2128,33 @@ def main() -> None:
                     % extra.get("overhead_pct", 0.0))
         except Exception as exc:  # noqa: BLE001
             log("telemetry_overhead failed: %s" % exc)
+
+    # Config 3h: relay-fetch A/B — the overlapped output-fetch
+    # subsystem (client_tpu.server.fetch) vs the legacy serial
+    # np.asarray on the identical multi-output 4 MiB fetch_bench
+    # pair: client throughput/p50 per arm plus the server-side
+    # relay_fetch p50 window deltas and their ratio. On the
+    # accelerator this stage is ROADMAP item 1's success metric (the
+    # ~67 ms relay tax measured with and without the subsystem).
+    if remaining() > 45 and stage_wanted("relay_fetch_ab"):
+        try:
+            run_with_watchdog(
+                "fetch_bench load",
+                lambda: (core.repository.load("fetch_bench"),
+                         core.repository.load("fetch_bench_legacy")),
+                min(120.0, max(30.0, remaining() - 60)))
+            extra = run_fetch_measure(core)
+            record_stage("relay_fetch_ab",
+                         extra.get("overlapped_tput", 0.0),
+                         extra.get("overlapped_p50_us", 0.0), extra)
+            log("relay_fetch p50: overlapped %.0f us vs legacy %.0f "
+                "us (%.2fx) over %d executions"
+                % (extra.get("relay_fetch_p50_overlapped_us", 0.0),
+                   extra.get("relay_fetch_p50_legacy_us", 0.0),
+                   extra.get("relay_fetch_p50_speedup", 0.0),
+                   extra.get("relay_fetch_executions", 0)))
+        except Exception as exc:  # noqa: BLE001
+            log("relay_fetch_ab failed: %s" % exc)
 
     # Config 3c: failover + hedging across a 2-server fleet (the
     # EndpointPool client). Three measurements: one endpoint latency-

@@ -74,10 +74,13 @@ def iter_region_chunks(arena: TpuArena, raw_handle: bytes,
         yield stamp(arena_pb2.PullRegionChunk(segment_nbytes=0))
         return
     for index, segment in enumerate(segments):
-        raw = TpuArena._segment_bytes(segment)
+        # One host materialization per segment, chunked by slicing the
+        # byte view: each proto chunk copies once (into the message),
+        # never via an intermediate whole-segment bytes object.
+        raw = TpuArena._segment_view(segment)
         position = 0
         while True:
-            data = raw[position:position + chunk_bytes]
+            data = bytes(raw[position:position + chunk_bytes])
             yield stamp(arena_pb2.PullRegionChunk(
                 segment_index=index,
                 segment_offset=segment.offset,

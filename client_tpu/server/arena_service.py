@@ -92,7 +92,9 @@ class TpuArenaServicer:
             data = self._arena.read(
                 request.region_id, request.offset, request.byte_size
             )
-            return arena_pb2.ReadRegionResponse(data=data)
+            # read() may serve a zero-copy memoryview (single-segment
+            # window); the proto boundary is where it becomes bytes.
+            return arena_pb2.ReadRegionResponse(data=bytes(data))
         except InferenceServerException as e:
             self._abort(context, e)
 

@@ -69,6 +69,7 @@ import numpy as np
 
 from client_tpu.server import tracing as spantrace
 from client_tpu import status_map
+from client_tpu.server.fetch import OutputFetcher
 from client_tpu.server.qos import coerce_int, coerce_priority
 from client_tpu.utils import InferenceServerException
 
@@ -79,10 +80,11 @@ class _Pending:
     __slots__ = ("inputs", "params", "batch", "shape_key", "event",
                  "outputs", "error", "enqueue_ns", "queue_ns", "leader",
                  "deadline_ns", "trace", "done_ns", "queue_from_ns",
-                 "priority")
+                 "priority", "wanted")
 
     def __init__(self, inputs, params, batch, shape_key,
-                 timeout_ns: int = 0, trace=None, priority: int = 0):
+                 timeout_ns: int = 0, trace=None, priority: int = 0,
+                 wanted=None):
         self.inputs = inputs
         self.params = params
         self.batch = batch
@@ -113,6 +115,11 @@ class _Pending:
         # priority levels). Dispatch order, never fusion identity —
         # mixed-priority requests still fuse into one execution.
         self.priority = priority
+        # The output names THIS member's request asked for (None =
+        # everything the model produces). The overlapped fetch path
+        # wakes a member as soon as its wanted outputs land — it never
+        # waits out transfers of outputs it will not encode.
+        self.wanted = wanted
 
 
 class _Bucket:
@@ -314,7 +321,9 @@ class DynamicBatcher:
                  shed_watermark: float = 0.0,
                  shed_hook: Optional[Callable[..., None]] = None,
                  execution_target=None,
-                 telemetry=None):
+                 telemetry=None,
+                 overlapped_fetch: bool = True,
+                 fetch_chunk_bytes: int = 0):
         self._model = model
         # Always-on latency histograms (client_tpu.server.telemetry's
         # ServerTelemetry, or None): each fused execution records a
@@ -419,6 +428,17 @@ class DynamicBatcher:
         self._fetch_pool = ThreadPoolExecutor(
             max_workers=self._fetch_workers,
             thread_name_prefix="batch-fetch")
+        # Overlapped output-fetch subsystem (client_tpu.server.fetch):
+        # its OWN pool lands per-output/per-chunk transfers while
+        # _fetch_pool keeps orchestrating whole-bucket completions.
+        # Separate pools by design: an orchestration job WAITS on
+        # landing jobs, so sharing one bounded pool could deadlock
+        # with every worker parked in an orchestrator. None = the
+        # model opted out (overlapped_fetch=False) — the legacy serial
+        # np.asarray fetch, kept as the bench A/B baseline arm.
+        self._fetcher = (OutputFetcher(workers=self._fetch_workers,
+                                       chunk_bytes=fetch_chunk_bytes)
+                         if overlapped_fetch else None)
         # Bucket executions run here, NOT on the gather thread: a
         # model whose infer() blocks (an ensemble fetching its final
         # outputs, any host-side model) would otherwise serialize the
@@ -443,13 +463,18 @@ class DynamicBatcher:
         self._thread.join(timeout=10)
         self._exec_pool.shutdown(wait=True)
         self._fetch_pool.shutdown(wait=True)
+        if self._fetcher is not None:
+            # After the orchestration pool: its draining completions
+            # still wait on landing jobs running here.
+            self._fetcher.shutdown()
 
     # -- request side ----------------------------------------------------
 
     def infer(self, inputs: Dict[str, np.ndarray], params: dict,
               batch: int, trace=None,
               queue_from_ns: int = 0,
-              priority: Optional[int] = None) -> Dict[str, np.ndarray]:
+              priority: Optional[int] = None,
+              wanted_outputs=None) -> Dict[str, np.ndarray]:
         """Blocks until this request's slice of a fused execution is
         ready. `batch` is the request's own batch-dim size; `trace` is
         the request's RequestTrace when sampled (never part of the
@@ -458,7 +483,10 @@ class DynamicBatcher:
         span boundary. `priority` is the caller's already-coerced
         class when it validated the parameter itself (the core does,
         for stats labeling — one coercion, one source of truth);
-        None = coerce from params here."""
+        None = coerce from params here. `wanted_outputs` is the set of
+        output names the request asked for (None = all): the
+        overlapped fetch wakes this call as soon as those land, even
+        while the fused batch's other outputs are still in flight."""
         shape_key = (
             tuple(
                 (name, array.shape[1:], array.dtype.str)
@@ -471,7 +499,9 @@ class DynamicBatcher:
         pending = _Pending(inputs, params, batch, shape_key,
                            timeout_ns=self._timeout_ns_for(params,
                                                            priority),
-                           trace=trace, priority=priority)
+                           trace=trace, priority=priority,
+                           wanted=(frozenset(wanted_outputs)
+                                   if wanted_outputs else None))
         pending.queue_from_ns = queue_from_ns
         with self._cv:
             if self._stopping:
@@ -938,17 +968,23 @@ class DynamicBatcher:
                 # bucket, not n slice transfers) — and do it on the
                 # fetch pool so this exec worker (and the gather
                 # thread) can dispatch the NEXT bucket while this
-                # transfer is in flight.
-                for array in outputs.values():
-                    if hasattr(array, "copy_to_host_async"):
-                        array.copy_to_host_async()
+                # transfer is in flight. The legacy arm kicks its
+                # async copies HERE, before even the pool handoff; the
+                # overlapped fetcher issues its own in start() AFTER
+                # deciding which outputs land chunked (a full-buffer
+                # kick would double a chunked tensor's DMA traffic).
+                if self._fetcher is None:
+                    for array in outputs.values():
+                        if hasattr(array, "copy_to_host_async"):
+                            array.copy_to_host_async()
+                finish = (self._finish_overlapped
+                          if self._fetcher is not None
+                          else self._finish_host_bucket)
                 try:
                     self._fetch_pool.submit(
-                        self._finish_host_bucket, bucket, outputs,
-                        target, compute_ns)
+                        finish, bucket, outputs, target, compute_ns)
                 except RuntimeError:  # pool shut down mid-stop
-                    self._finish_host_bucket(bucket, outputs, target,
-                                             compute_ns)
+                    finish(bucket, outputs, target, compute_ns)
             else:
                 # Device-resident bucket (TPU-shm path): slices are
                 # lazy device views; outputs stay in HBM end-to-end.
@@ -1000,6 +1036,111 @@ class DynamicBatcher:
                      time.monotonic_ns() - fetch_start,
                      done_from=mark_ns)
 
+    def _finish_overlapped(self, bucket: List[_Pending], outputs,
+                           target: int, compute_ns: int) -> None:
+        """Overlapped replacement for _finish_host_bucket
+        (client_tpu.server.fetch): every output's device->host
+        transfer is issued at once, outputs are processed in LANDING
+        order, and each member wakes the moment ITS wanted outputs
+        have landed — the first response encodes while the batch's
+        remaining tensors are still in flight. One output's failed
+        fetch fails only the members that asked for it."""
+        fetch_start = time.monotonic_ns()
+        self._tracker.enter_fetch()
+        traced = [p.trace for p in bucket if p.trace is not None]
+        offsets: List[int] = []
+        offset = 0
+        for pending in bucket:
+            offsets.append(offset)
+            offset += pending.batch
+        ordered = tuple(outputs)  # model output order, for responses
+        landed: Dict[str, np.ndarray] = {}
+        failed: Dict[str, Exception] = {}
+        mark_ns = fetch_start
+        try:
+            inflight = self._fetcher.start(outputs)
+            for handle in inflight.as_completed():
+                end_ns = time.monotonic_ns()
+                if handle.error is not None:
+                    failed[handle.name] = handle.error
+                else:
+                    landed[handle.name] = handle.value
+                    if traced:
+                        # Same shared relay_fetch span the legacy path
+                        # records, with the wait bounded by landing
+                        # order instead of transfer order; `mode` and
+                        # `chunks` make the overlap visible to a span
+                        # reader.
+                        attrs = {"output": handle.name,
+                                 "nbytes": int(handle.value.nbytes),
+                                 "mode": "overlap"}
+                        if handle.chunks:
+                            attrs["chunks"] = handle.chunks
+                        fetch_span = spantrace.shared_span(
+                            spantrace.SPAN_RELAY_FETCH, mark_ns,
+                            end_ns, attrs)
+                        for trace in traced:
+                            trace.add(fetch_span)
+                mark_ns = end_ns
+                self._wake_ready(bucket, offsets, ordered, landed,
+                                 failed, end_ns)
+        except Exception as e:  # noqa: BLE001 — waiters must wake
+            self._assign_error(
+                [p for p in bucket if not p.event.is_set()], e)
+            self._tracker.exit_fetch()
+            self._finish(bucket, 0, 0, 0, ok=False)
+            return
+        self._tracker.exit_fetch()
+        # Final sweep: members wanting ALL outputs when some failed,
+        # and members whose wanted set resolved empty.
+        self._wake_ready(bucket, offsets, ordered, landed, failed,
+                         mark_ns, final=True)
+        # ok=True even on a partial fetch failure: the execution
+        # happened and members that didn't want the failed output were
+        # served — stats/telemetry must record the batch (only the
+        # failed members' errors are per-member, via _wake_ready).
+        self._finish(bucket, target, compute_ns,
+                     time.monotonic_ns() - fetch_start,
+                     done_from=mark_ns)
+
+    @staticmethod
+    def _wake_ready(bucket: List[_Pending], offsets: List[int],
+                    ordered: tuple, landed: Dict[str, np.ndarray],
+                    failed: Dict[str, Exception], done_ns: int,
+                    final: bool = False) -> None:
+        """Per-member early completion: wake every not-yet-woken
+        member whose wanted outputs have all landed (its outputs dict
+        holds just those slices, in model output order), or whose
+        wanted outputs include a failed fetch (only those members see
+        the error). A member wanting everything (wanted=None)
+        completes on the last landing — or errors on the final sweep
+        if anything failed."""
+        names = frozenset(ordered)
+        for pending, offset in zip(bucket, offsets):
+            if pending.event.is_set():
+                continue
+            wanted = (names if pending.wanted is None
+                      else pending.wanted & names)
+            hit = (failed.keys() & wanted if pending.wanted is not None
+                   else (failed.keys() if final else frozenset()))
+            if hit:
+                error = failed[sorted(hit)[0]]
+                if not isinstance(error, InferenceServerException):
+                    error = InferenceServerException(
+                        "output fetch failed for '%s': %s"
+                        % (sorted(hit)[0], error), status="INTERNAL")
+                pending.error = error
+                pending.done_ns = done_ns
+                pending.event.set()
+                continue
+            if wanted <= landed.keys():
+                pending.outputs = {
+                    name: landed[name][offset:offset + pending.batch]
+                    for name in ordered if name in wanted
+                }
+                pending.done_ns = done_ns
+                pending.event.set()
+
     def _finish(self, bucket: List[_Pending], executed: int,
                 compute_ns: int, fetch_ns: int, ok: bool = True,
                 done_from: int = 0) -> None:
@@ -1009,6 +1150,8 @@ class DynamicBatcher:
         scatter/notify slice is attributed too."""
         done_ns = done_from or time.monotonic_ns()
         for pending in bucket:
+            if pending.event.is_set():
+                continue  # woken early (per-member completion)
             pending.done_ns = done_ns
             pending.event.set()
         if ok and self._stats_hook is not None:

@@ -1,0 +1,398 @@
+"""Overlapped device->host output fetch: the serving core's answer to
+the ~67 ms output-relay tax (ROADMAP item 1).
+
+Every serving path used to materialize outputs with a blocking
+``np.asarray`` per tensor, serially: the first output's device->host
+transfer had to retire before the second was even issued, and encode
+could not start until the whole output dict was host-resident. On a
+dense model the transfer — not the TPU — bounded the stage (BENCH r05:
+``relay_fetch_ms_est`` ~67 ms against 0.8-3.4 ms device exec).
+
+Three composable mechanisms replace that:
+
+* **Overlapped non-blocking copies.** :meth:`OutputFetcher.start`
+  issues ``copy_to_host_async`` on every device output up front, then
+  lands each output on its own pool job — the transfers ride the
+  device's DMA engines concurrently and the first landed output can
+  encode (or wake its batch member) while later ones are still in
+  flight. :meth:`InflightFetch.as_completed` yields outputs in LANDING
+  order, which is what lets the batcher unblock each member as soon as
+  *its* requested outputs land.
+
+* **Chunked-parallel transfers.** An output at least twice
+  ``chunk_bytes`` is split along its leading axis into device slices
+  landed by concurrent jobs into one preallocated host buffer — a
+  single huge tensor stops serializing on one transfer stream.
+  Host-committed arrays (numpy, and jax arrays already on the cpu
+  platform, whose ``np.asarray`` is a cached zero-copy view) are never
+  chunked or pooled: slicing them would add copies and job overhead
+  where the direct materialization is free.
+
+* **Fetch-into-registered-region.** :func:`fetch_into` lands a
+  tensor's bytes directly in a caller-provided writable buffer (a
+  registered system-shm region), retiring the ``device -> host ndarray
+  -> bytes object -> region`` double hop; :func:`host_view` serves a
+  read-only byte view over the single host materialization (the
+  TPU-arena serialization path's ``np.asarray(x).tobytes()`` fix).
+
+Jobs never wait on other jobs, so the pool bounds concurrency but can
+never deadlock; nothing here holds a lock across a transfer (the
+per-fetch condition variable guards only completion bookkeeping —
+tpulint lock-discipline).
+
+Consumers: the dynamic batcher's fetch stage
+(``client_tpu.server.batcher``), the direct/sequence paths in the core
+(``client_tpu.server.core``), shared-memory output placement
+(``client_tpu.server.memory``), and the TPU arena's serialization
+paths (``client_tpu.server.tpu_arena``). Knobs:
+``ModelConfig.overlapped_fetch`` (opt-out) and
+``ModelConfig.fetch_chunk_bytes`` — see docs/zero_copy_fetch.md.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+# Split threshold for chunked-parallel transfers: tensors at or above
+# 2x this are landed as concurrent per-slice copies. 4 MiB keeps a
+# logits-sized tensor whole (one job beats job overhead) while a
+# 32 MiB activation rides 8 parallel lanes.
+DEFAULT_CHUNK_BYTES = 4 << 20
+# Pool width when the owner does not size it (the batcher passes its
+# fetch_pool_workers; the core's shared fetcher uses this default).
+DEFAULT_WORKERS = 4
+
+
+def is_device_value(value) -> bool:
+    """True for array-likes that need a host materialization step
+    (anything ``__array__``-able that is not already numpy)."""
+    return not isinstance(value, np.ndarray) and hasattr(value, "__array__")
+
+
+def host_committed(value) -> bool:
+    """True when host materialization is already free: numpy arrays,
+    and jax arrays committed to the cpu platform (``np.asarray`` on
+    those returns a cached zero-copy view — chunking or pooling them
+    would add copies and job overhead to a no-op)."""
+    if isinstance(value, np.ndarray):
+        return True
+    devices = getattr(value, "devices", None)
+    if not callable(devices):
+        return False
+    try:
+        return all(d.platform == "cpu" for d in devices())
+    except Exception:  # noqa: BLE001 — unknown array-like: assume off-host
+        return False
+
+
+def start_async_copy(value) -> None:
+    """Kick the device->host DMA without waiting on it (jax.Array's
+    ``copy_to_host_async``): a later ``np.asarray`` finds the bytes
+    already in flight or landed. No-op for array-likes without it."""
+    hook = getattr(value, "copy_to_host_async", None)
+    if hook is None:
+        return
+    try:
+        hook()
+    except Exception:  # noqa: BLE001 — an unlaunchable async copy just
+        pass  # falls back to the blocking materialization
+
+
+def host_array(value) -> np.ndarray:
+    """ONE blocking host materialization, C-contiguous."""
+    host = np.asarray(value)
+    if not host.flags["C_CONTIGUOUS"]:
+        host = np.ascontiguousarray(host)
+    return host
+
+
+def host_view(value) -> memoryview:
+    """Read-only byte view over one host materialization of ``value``
+    — the single-copy replacement for ``np.asarray(x).tobytes()``
+    (which materializes and then copies the whole buffer AGAIN into a
+    bytes object)."""
+    host = host_array(value)
+    if host.dtype.hasobject:
+        raise TypeError("object arrays have no flat byte view")
+    return host.reshape(-1).view(np.uint8).data
+
+
+def fetch_into(value, dest) -> int:
+    """Copy ``value``'s bytes into ``dest`` (a writable
+    buffer/memoryview over a registered region) with no intermediate
+    bytes object: one host materialization (a zero-copy view for
+    host-committed arrays), then one copy straight into the region —
+    the old path's whole-buffer ``tobytes()`` hop is gone. Returns the
+    byte count written; the caller bounds-checks and sizes ``dest`` to
+    at least that count."""
+    start_async_copy(value)
+    view = host_view(value)
+    out = np.frombuffer(dest, dtype=np.uint8)
+    n = len(view)
+    if n > out.size:
+        raise ValueError(
+            "tensor of %d bytes exceeds the %d-byte landing buffer"
+            % (n, out.size))
+    out[:n] = np.frombuffer(view, dtype=np.uint8)
+    return n
+
+
+class _OutputHandle:
+    """Completion state of one output's fetch. Immutable once it
+    appears in the inflight completion order."""
+
+    __slots__ = ("name", "value", "error", "chunks", "_dest",
+                 "_remaining")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value: Optional[np.ndarray] = None
+        self.error: Optional[Exception] = None
+        # Number of parallel slice jobs landing this output (0 = one
+        # whole-tensor job or an inline completion) — span attribute.
+        self.chunks = 0
+        self._dest = None
+        self._remaining = 0
+
+    @property
+    def done(self) -> bool:
+        return self.value is not None or self.error is not None
+
+
+class InflightFetch:
+    """All of one output dict's transfers, landing concurrently.
+
+    Iterate :meth:`as_completed` to process outputs in LANDING order
+    (how the batcher wakes each member the moment its outputs land);
+    :meth:`result` waits for one output. Completion bookkeeping runs
+    under the fetch's own condition variable; no transfer ever
+    executes under it."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._handles: Dict[str, _OutputHandle] = {}
+        self._order: List[str] = []
+
+    @property
+    def names(self) -> frozenset:
+        return frozenset(self._handles)
+
+    def _add(self, name: str) -> _OutputHandle:
+        handle = _OutputHandle(name)
+        self._handles[name] = handle
+        return handle
+
+    def _complete(self, name: str, value, error) -> None:
+        with self._cv:
+            handle = self._handles[name]
+            if handle.done:
+                return  # first completion wins (chunk-error races)
+            handle.value = value
+            handle.error = error
+            handle._dest = None
+            self._order.append(name)
+            self._cv.notify_all()
+
+    def _chunk_done(self, name: str, error: Optional[Exception] = None
+                    ) -> None:
+        with self._cv:
+            handle = self._handles[name]
+            if handle.done:
+                return
+            if error is not None:
+                handle.error = error
+                handle._dest = None
+                self._order.append(name)
+                self._cv.notify_all()
+                return
+            handle._remaining -= 1
+            if handle._remaining == 0:
+                handle.value = handle._dest
+                handle._dest = None
+                self._order.append(name)
+                self._cv.notify_all()
+
+    def as_completed(self) -> Iterator[_OutputHandle]:
+        """Yields each output's handle in the order it landed."""
+        served = 0
+        total = len(self._handles)
+        while served < total:
+            with self._cv:
+                while len(self._order) <= served:
+                    self._cv.wait()
+                name = self._order[served]
+            served += 1
+            yield self._handles[name]
+
+    def wait(self, names=None) -> None:
+        """Blocks until the named outputs (default: all) have landed
+        or failed."""
+        targets = (list(self._handles) if names is None
+                   else [n for n in names if n in self._handles])
+        for name in targets:
+            handle = self._handles[name]
+            with self._cv:
+                while not handle.done:
+                    self._cv.wait()
+
+    def result(self, name: str) -> np.ndarray:
+        """The landed host array for one output (raises its fetch
+        error)."""
+        self.wait((name,))
+        handle = self._handles[name]
+        if handle.error is not None:
+            raise handle.error
+        return handle.value
+
+
+class OutputFetcher:
+    """Owns the transfer pool and chunking policy: one per dynamic
+    batcher (sized from its ``fetch_pool_workers``) plus one shared by
+    the core's direct/sequence paths. Landing jobs never wait on other
+    jobs, so the bounded pool can never deadlock — which is also why
+    this pool is distinct from the batcher's orchestration pool (an
+    orchestrating completion DOES wait on landing jobs)."""
+
+    def __init__(self, workers: int = 0, chunk_bytes: int = 0):
+        self._workers = workers if workers > 0 else DEFAULT_WORKERS
+        self._chunk_bytes = (chunk_bytes if chunk_bytes > 0
+                             else DEFAULT_CHUNK_BYTES)
+        self._pool = None
+        self._pool_lock = threading.Lock()
+        self._stopped = False
+
+    def _pool_or_none(self):
+        """The lazily-created landing pool (None once shut down: the
+        caller then lands inline, which is the drain path)."""
+        with self._pool_lock:
+            if self._stopped:
+                return None
+            if self._pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._pool = ThreadPoolExecutor(
+                    max_workers=self._workers,
+                    thread_name_prefix="relay-fetch")
+            return self._pool
+
+    def shutdown(self) -> None:
+        with self._pool_lock:
+            pool, self._pool = self._pool, None
+            self._stopped = True
+        if pool is not None:
+            pool.shutdown(wait=True)
+
+    def start(self, outputs: Dict[str, object], chunk_bytes: int = 0
+              ) -> InflightFetch:
+        """Issues every output's device->host transfer at once and
+        returns the in-flight handle set. Host-committed outputs
+        complete inline (their materialization is the zero-copy view
+        the caller needed anyway); off-host outputs land on pool jobs,
+        chunked-parallel past the split threshold."""
+        chunk_bytes = chunk_bytes if chunk_bytes > 0 else self._chunk_bytes
+        inflight = InflightFetch()
+        for name in outputs:
+            inflight._add(name)
+        # Classify first, THEN issue async copies: whole-tensor
+        # landings get their DMA kicked before the first blocking
+        # materialization (the across-outputs overlap), but chunked
+        # outputs must NOT get the full-buffer kick — their slices
+        # carry their own transfers, and a redundant whole-tensor DMA
+        # would contend with (and double) the chunked traffic.
+        inline, whole, chunked = [], [], []
+        for name, value in outputs.items():
+            if not is_device_value(value) or host_committed(value):
+                inline.append((name, value))
+                continue
+            plan = self._chunk_plan(value, chunk_bytes)
+            if plan is None:
+                whole.append((name, value))
+            else:
+                chunked.append((name, value, plan))
+        for _name, value in whole:
+            start_async_copy(value)
+        jobs = []
+        for name, value in inline:
+            try:
+                host = (value if isinstance(value, np.ndarray)
+                        else host_array(value))
+                inflight._complete(name, host, None)
+            except Exception as e:  # noqa: BLE001 — per-output
+                inflight._complete(name, None, e)
+        for name, value in whole:
+            jobs.append((self._land_whole, name, value, inflight))
+        for name, value, plan in chunked:
+            handle = inflight._handles[name]
+            try:
+                dest = np.empty(tuple(value.shape),
+                                dtype=np.dtype(value.dtype))
+            except Exception:  # noqa: BLE001 — undescribable dtype:
+                jobs.append((self._land_whole, name, value, inflight))
+                continue  # land whole instead of chunking
+            handle._dest = dest
+            handle._remaining = len(plan)
+            handle.chunks = len(plan)
+            for lo, hi in plan:
+                jobs.append((self._land_chunk, name, value, dest, lo, hi,
+                             inflight))
+        pool = self._pool_or_none() if jobs else None
+        for fn, *args in jobs:
+            if pool is not None:
+                try:
+                    pool.submit(fn, *args)
+                    continue
+                except RuntimeError:  # pool shut down mid-drain
+                    pool = None
+            fn(*args)
+        return inflight
+
+    @staticmethod
+    def _chunk_plan(value, chunk_bytes: int
+                    ) -> Optional[List[Tuple[int, int]]]:
+        """Leading-axis split for chunked-parallel landing, or None to
+        land whole: needs a sliceable tensor of >=2 rows at >=2x the
+        chunk size."""
+        try:
+            shape = tuple(getattr(value, "shape", ()) or ())
+            if not shape or int(shape[0]) < 2:
+                return None
+            if getattr(value, "__getitem__", None) is None:
+                return None
+            nbytes = getattr(value, "nbytes", None)
+            if nbytes is None:
+                nbytes = int(np.prod(shape)) * np.dtype(value.dtype).itemsize
+            nbytes = int(nbytes)
+            if nbytes < 2 * chunk_bytes:
+                return None
+            rows = int(shape[0])
+            rows_per = max(int(chunk_bytes // max(nbytes // rows, 1)), 1)
+            plan = []
+            lo = 0
+            while lo < rows:
+                hi = min(lo + rows_per, rows)
+                plan.append((lo, hi))
+                lo = hi
+            return plan if len(plan) > 1 else None
+        except Exception:  # noqa: BLE001 — unplannable: land whole
+            return None
+
+    @staticmethod
+    def _land_whole(name, value, inflight: InflightFetch) -> None:
+        try:
+            inflight._complete(name, host_array(value), None)
+        except Exception as e:  # noqa: BLE001 — error rides the handle
+            inflight._complete(name, None, e)
+
+    @staticmethod
+    def _land_chunk(name, value, dest, lo, hi,
+                    inflight: InflightFetch) -> None:
+        if inflight._handles[name].done:
+            return  # a sibling chunk already failed this output
+        try:
+            dest[lo:hi] = np.asarray(value[lo:hi])
+            inflight._chunk_done(name)
+        except Exception as e:  # noqa: BLE001 — error rides the handle
+            inflight._chunk_done(name, e)

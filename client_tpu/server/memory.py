@@ -238,26 +238,45 @@ class SharedMemoryManager:
 
     def write_output(self, name: str, byte_size: int, offset: int, value) -> int:
         """Place an output tensor into a region. Returns bytes written.
-        TPU regions store the device array by reference (zero copy)."""
+        TPU regions store the device array by reference (zero copy);
+        system regions take the fetch-into-region path
+        (client_tpu.server.fetch.fetch_into): the old chain was host
+        ndarray -> whole-buffer bytes object -> region copy; the bytes
+        hop is retired, so numeric tensors cost one host
+        materialization (a zero-copy view for cpu-committed jax
+        arrays) plus the copy into the region. BYTES tensors keep the
+        serialize path (variable-length framing has no flat byte
+        view)."""
         region = self._get(name)
         if region.kind == "system":
-            data = _array_to_bytes(value)
-            if len(data) > byte_size:
+            nbytes = _tensor_nbytes(value)
+            if nbytes is None:
+                # BYTES / unknown layout: legacy serialize-then-copy.
+                data = _array_to_bytes(value)
+                nbytes = len(data)
+            else:
+                data = None
+            if nbytes > byte_size:
                 raise InferenceServerException(
                     "output of %d bytes exceeds the requested %d-byte slice "
-                    "of region '%s'" % (len(data), byte_size, name),
+                    "of region '%s'" % (nbytes, byte_size, name),
                     status="INVALID_ARGUMENT",
                 )
-            if offset + len(data) > region.byte_size:
+            if offset + nbytes > region.byte_size:
                 raise InferenceServerException(
                     "output exceeds region '%s' bounds (%d > %d)"
-                    % (name, offset + len(data), region.byte_size),
+                    % (name, offset + nbytes, region.byte_size),
                     status="INVALID_ARGUMENT",
                 )
             buf = region.handle.buf()
             base = region.offset + offset
-            buf[base : base + len(data)] = data
-            return len(data)
+            if data is not None:
+                buf[base : base + nbytes] = data
+            else:
+                from client_tpu.server.fetch import fetch_into
+
+                fetch_into(value, memoryview(buf)[base : base + nbytes])
+            return nbytes
         return self._arena.store(region.region_id, offset, byte_size, value)
 
 
@@ -273,6 +292,20 @@ def _bytes_to_array(view, datatype: str, shape):
     if datatype == "BF16":
         return deserialize_bf16_tensor(bytes(view)).reshape(shape)
     return np.frombuffer(view, dtype=triton_to_np_dtype(datatype)).reshape(shape)
+
+
+def _tensor_nbytes(value):
+    """Byte size of a numeric tensor from its METADATA (device arrays
+    carry dtype/shape without a host trip), or None when the tensor
+    needs serialization (BYTES/string) or has no dtype at all."""
+    dtype = getattr(value, "dtype", None)
+    shape = getattr(value, "shape", None)
+    if dtype is None or shape is None:
+        return None
+    dtype = np.dtype(dtype)
+    if dtype.kind in ("O", "S", "U"):
+        return None
+    return int(np.prod(shape)) * dtype.itemsize
 
 
 def _array_to_bytes(value) -> bytes:

@@ -67,6 +67,15 @@ class ServedModel:
     # (0 = sized from pipeline depth).
     pipeline_depth: int = 0
     fetch_pool_workers: int = 0
+    # Output-fetch subsystem (client_tpu.server.fetch,
+    # docs/zero_copy_fetch.md). overlapped_fetch=False opts this model
+    # out of overlapped/chunked device->host output copies — back to
+    # the serial blocking np.asarray per output (the bench A/B
+    # baseline arm). fetch_chunk_bytes tunes the chunked-parallel
+    # split threshold (0 = fetch.DEFAULT_CHUNK_BYTES); outputs at or
+    # above 2x it land as concurrent per-slice copies.
+    overlapped_fetch: bool = True
+    fetch_chunk_bytes: int = 0
     # Queue policy (Triton ModelQueuePolicy semantics). max_queue_size
     # bounds pending requests in the dynamic batcher (0 = unbounded;
     # overflow rejected UNAVAILABLE at admission).

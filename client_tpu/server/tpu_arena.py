@@ -290,8 +290,9 @@ class TpuArena:
                 # stale framing bytes past a smaller replacement.
                 continue
             # Partial overlap: keep the non-overlapped remainder(s) as
-            # raw byte runs (host hop for this segment only).
-            raw = self._segment_bytes(existing)
+            # raw byte runs (host hop for this segment only; the view
+            # is sliced without a second whole-buffer copy).
+            raw = self._segment_view(existing)
             if existing.offset < segment.offset:
                 head = raw[: segment.offset - existing.offset]
                 kept.append(_Segment(
@@ -309,15 +310,29 @@ class TpuArena:
         region.segments = kept
 
     @staticmethod
-    def _segment_bytes(segment: _Segment) -> bytes:
-        """Serialize one segment to host bytes (inspection / carve
-        path — the only place a device segment crosses to host)."""
+    def _segment_view(segment: _Segment) -> memoryview:
+        """ONE host materialization of a segment, served as a
+        read-only byte view (client_tpu.server.fetch.host_view). The
+        old ``np.asarray(...).tobytes()`` materialized the array and
+        then copied the whole buffer AGAIN into a bytes object; every
+        internal consumer (read windows, carve remainders, pull-stream
+        chunking) slices this view instead."""
+        from client_tpu.server.fetch import host_view, start_async_copy
+
         if segment.datatype == "BYTES":
             from client_tpu.utils import serialize_byte_tensor
 
-            return serialize_byte_tensor(
-                np.asarray(segment.array)).tobytes()
-        return np.asarray(segment.array).tobytes()
+            return host_view(serialize_byte_tensor(
+                np.asarray(segment.array)))
+        start_async_copy(segment.array)
+        return host_view(segment.array)
+
+    @classmethod
+    def _segment_bytes(cls, segment: _Segment) -> bytes:
+        """Owned-bytes form of :meth:`_segment_view` for consumers
+        that must outlive the backing array (kept for compatibility;
+        prefer the view)."""
+        return bytes(cls._segment_view(segment))
 
     def as_typed_array(self, region_id: str, offset: int, byte_size: int,
                        datatype: str, shape):
@@ -433,9 +448,17 @@ class TpuArena:
                 offset, nbytes, datatype, list(stored.shape), stored))
         return nbytes
 
-    def read(self, region_id: str, offset: int, byte_size: int) -> bytes:
+    def read(self, region_id: str, offset: int, byte_size: int):
         """Device region -> host bytes (inspection path). Serializes
-        only the segments overlapping the window."""
+        only the segments overlapping the window. When ONE segment
+        covers the whole window — the head-segment and whole-region
+        common cases — the returned value is a memoryview over the
+        single host materialization (no assembly copy, no tobytes
+        copy); multi-segment windows assemble into bytes as before.
+        Serialization runs OUTSIDE the region lock: segment arrays are
+        immutable (writes replace the list), so a snapshot of the list
+        is a coherent point-in-time view and the device->host transfer
+        never blocks concurrent readers/writers."""
         region = self._get(region_id)
         with region.lock:
             if not region.segments:
@@ -445,17 +468,30 @@ class TpuArena:
                 byte_size = max(end - offset, 0)
                 if byte_size == 0:
                     return b""
-            return self._read_locked(region, offset, byte_size)
+            segments = list(region.segments)
+        for segment in segments:
+            if segment.offset <= offset and \
+                    segment.end >= offset + byte_size:
+                view = self._segment_view(segment)
+                lo = offset - segment.offset
+                return view[lo:lo + byte_size]
+        return self._assemble(segments, offset, byte_size)
 
     def _read_locked(self, region: _Region, offset: int,
                      byte_size: int) -> bytes:
         """Assemble [offset, offset+byte_size) from overlapping
         segments, zero-filling gaps. Caller holds region.lock."""
+        return self._assemble(region.segments, offset, byte_size)
+
+    def _assemble(self, segments, offset: int, byte_size: int) -> bytes:
+        """Multi-segment window assembly over an immutable segment
+        snapshot; each segment contributes a slice of its single host
+        view (no per-segment tobytes copy)."""
         window = bytearray(byte_size)
-        for segment in region.segments:
+        for segment in segments:
             if segment.end <= offset or segment.offset >= offset + byte_size:
                 continue
-            raw = self._segment_bytes(segment)
+            raw = self._segment_view(segment)
             src_lo = max(0, offset - segment.offset)
             src_hi = min(len(raw), offset + byte_size - segment.offset)
             dst_lo = segment.offset + src_lo - offset

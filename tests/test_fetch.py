@@ -1,0 +1,641 @@
+"""Overlapped device->host output fetch (client_tpu.server.fetch):
+golden parity against the legacy blocking-np.asarray path across
+dtypes (incl. the bf16 bitcast), shapes, chunk boundaries, and fused
+batch slices; fetch-into-region for shm-bound outputs; per-member
+early completion; and error isolation (one output's failed fetch fails
+only the members that requested it)."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from client_tpu.server.batcher import DynamicBatcher
+from client_tpu.server.fetch import (
+    DEFAULT_CHUNK_BYTES,
+    OutputFetcher,
+    fetch_into,
+    host_committed,
+    host_view,
+    is_device_value,
+)
+from client_tpu.server.model import ServedModel, TensorSpec
+from client_tpu.utils import InferenceServerException
+
+
+class FakeDeviceArray:
+    """Array-like standing in for an off-host device tensor: host
+    materialization (np.asarray) costs ``delay_s``, slicing yields a
+    lazy sub-tensor (chunked transfers), and an optional error fires
+    on materialization. Unlike a committed cpu jax.Array this never
+    claims to be host-resident, so the fetcher routes it through the
+    pool — which is exactly what the overlap tests need to observe."""
+
+    def __init__(self, data: np.ndarray, delay_s: float = 0.0,
+                 error: Exception = None):
+        self._data = data
+        self._delay_s = delay_s
+        self._error = error
+        self.shape = data.shape
+        self.dtype = data.dtype
+        self.nbytes = data.nbytes
+
+    def __getitem__(self, item):
+        return FakeDeviceArray(self._data[item], self._delay_s,
+                               self._error)
+
+    def __array__(self, dtype=None, copy=None):
+        if self._delay_s:
+            time.sleep(self._delay_s)
+        if self._error is not None:
+            raise self._error
+        return self._data
+
+
+# -- primitives ------------------------------------------------------------
+
+
+def test_is_device_value_and_host_committed():
+    import jax.numpy as jnp
+
+    host = np.arange(4, dtype=np.float32)
+    dev = jnp.arange(4, dtype=jnp.float32)
+    fake = FakeDeviceArray(host)
+    assert not is_device_value(host)
+    assert is_device_value(dev)
+    assert is_device_value(fake)
+    assert host_committed(host)
+    # On the cpu backend jax arrays are committed host buffers.
+    assert host_committed(dev)
+    assert not host_committed(fake)
+
+
+def test_host_view_is_single_copy():
+    data = np.arange(64, dtype=np.float32)
+    view = host_view(data)
+    assert bytes(view) == data.tobytes()
+    # The view aliases the materialized buffer — no tobytes copy.
+    data[0] = -1.0
+    assert np.frombuffer(view, np.float32)[0] == -1.0
+
+
+@pytest.mark.parametrize("dtype", ["float32", "int32", "float16",
+                                   "uint8", "bool"])
+def test_fetch_into_parity_numeric(dtype):
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(7)
+    host = (rng.random((33, 5)) * 100).astype(dtype)
+    dev = jnp.asarray(host)
+    golden = np.asarray(dev).tobytes()
+    dest = bytearray(len(golden))
+    written = fetch_into(dev, memoryview(dest))
+    assert written == len(golden)
+    assert bytes(dest) == golden
+
+
+def test_fetch_into_parity_bf16_bitcast():
+    import jax.numpy as jnp
+
+    dev = jnp.arange(257, dtype=jnp.bfloat16) / 3
+    golden = np.asarray(dev).tobytes()
+    dest = bytearray(len(golden))
+    fetch_into(dev, memoryview(dest))
+    assert bytes(dest) == golden
+    # Bitcast round trip: the landed bytes reinterpret to the same
+    # bf16 values.
+    import ml_dtypes
+
+    landed = np.frombuffer(dest, dtype=ml_dtypes.bfloat16)
+    np.testing.assert_array_equal(landed, np.asarray(dev))
+
+
+def test_fetch_into_noncontiguous_source():
+    base = np.arange(60, dtype=np.float32).reshape(6, 10)
+    sliced = base[:, ::2]  # non-contiguous view
+    golden = np.ascontiguousarray(sliced).tobytes()
+    dest = bytearray(len(golden))
+    fetch_into(sliced.copy(order="F"), memoryview(dest))
+    assert bytes(dest) == golden
+
+
+# -- OutputFetcher parity --------------------------------------------------
+
+
+def test_fetcher_parity_across_dtypes_and_shapes():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(11)
+    outputs = {
+        "fp32": jnp.asarray(rng.random((8, 16)).astype(np.float32)),
+        "int32": jnp.asarray((rng.random(77) * 50).astype(np.int32)),
+        "bf16": jnp.arange(1030, dtype=jnp.bfloat16) / 7,
+        "bool": jnp.asarray(rng.random((3, 4, 5)) > 0.5),
+        "host": rng.random(12).astype(np.float64),
+    }
+    fetcher = OutputFetcher(workers=2)
+    try:
+        inflight = fetcher.start(outputs)
+        seen = {}
+        for handle in inflight.as_completed():
+            assert handle.error is None
+            seen[handle.name] = handle.value
+        assert set(seen) == set(outputs)
+        for name, value in outputs.items():
+            golden = value if isinstance(value, np.ndarray) \
+                else np.asarray(value)
+            np.testing.assert_array_equal(seen[name], golden)
+            assert seen[name].dtype == golden.dtype
+    finally:
+        fetcher.shutdown()
+
+
+def test_chunked_parity_and_odd_boundaries():
+    """Chunked-parallel landing reassembles exactly, including when
+    the row count does not divide by the chunk rows."""
+    rng = np.random.default_rng(13)
+    data = rng.random((37, 129)).astype(np.float32)  # odd everything
+    fake = FakeDeviceArray(data)
+    fetcher = OutputFetcher(workers=4, chunk_bytes=4096)
+    try:
+        inflight = fetcher.start({"OUT": fake})
+        handle = next(inflight.as_completed())
+        assert handle.error is None
+        assert handle.chunks > 1  # it really chunked
+        np.testing.assert_array_equal(handle.value, data)
+    finally:
+        fetcher.shutdown()
+
+
+def test_chunking_skips_host_committed_arrays():
+    """A committed cpu jax array's np.asarray is a zero-copy view;
+    chunking it would add copies — the plan must land it whole,
+    inline."""
+    import jax.numpy as jnp
+
+    big = jnp.zeros((64, 1024), dtype=jnp.float32)
+    fetcher = OutputFetcher(workers=2, chunk_bytes=1024)
+    try:
+        inflight = fetcher.start({"OUT": big})
+        handle = next(inflight.as_completed())
+        assert handle.chunks == 0
+        assert handle.value.shape == (64, 1024)
+    finally:
+        fetcher.shutdown()
+
+
+def test_outputs_land_concurrently():
+    """Two 150 ms transfers through the pool land in well under the
+    serial 300 ms — the overlapped-copies property itself."""
+    data = np.arange(32, dtype=np.float32)
+    outputs = {
+        "A": FakeDeviceArray(data, delay_s=0.15),
+        "B": FakeDeviceArray(data * 2, delay_s=0.15),
+    }
+    fetcher = OutputFetcher(workers=4)
+    try:
+        start = time.monotonic()
+        inflight = fetcher.start(outputs)
+        inflight.wait()
+        elapsed = time.monotonic() - start
+        assert elapsed < 0.27, "transfers serialized (%.3fs)" % elapsed
+        np.testing.assert_array_equal(inflight.result("A"), data)
+        np.testing.assert_array_equal(inflight.result("B"), data * 2)
+    finally:
+        fetcher.shutdown()
+
+
+def test_as_completed_yields_landing_order():
+    data = np.arange(8, dtype=np.float32)
+    outputs = {
+        "slow": FakeDeviceArray(data, delay_s=0.3),
+        "fast": FakeDeviceArray(data, delay_s=0.01),
+    }
+    fetcher = OutputFetcher(workers=2)
+    try:
+        order = [h.name for h in fetcher.start(outputs).as_completed()]
+        assert order == ["fast", "slow"]
+    finally:
+        fetcher.shutdown()
+
+
+def test_fetcher_error_rides_only_its_output():
+    data = np.arange(8, dtype=np.float32)
+    outputs = {
+        "good": FakeDeviceArray(data),
+        "bad": FakeDeviceArray(data, error=RuntimeError("dma fault")),
+    }
+    fetcher = OutputFetcher(workers=2)
+    try:
+        inflight = fetcher.start(outputs)
+        np.testing.assert_array_equal(inflight.result("good"), data)
+        with pytest.raises(RuntimeError, match="dma fault"):
+            inflight.result("bad")
+    finally:
+        fetcher.shutdown()
+
+
+# -- batcher integration ---------------------------------------------------
+
+
+class _TwoOutModel(ServedModel):
+    """Fusable model producing one fast and one slow fake-device
+    output (rows = fused batch), for early-completion tests."""
+
+    name = "two_out"
+    max_batch_size = 8
+    dynamic_batching = True
+
+    def __init__(self, slow_s: float = 0.0, fail_slow: bool = False):
+        super().__init__()
+        self._slow_s = slow_s
+        self._fail = fail_slow
+        self.inputs = [TensorSpec("IN", "FP32", [4])]
+        self.outputs = [TensorSpec("FAST", "FP32", [4]),
+                        TensorSpec("SLOW", "FP32", [4])]
+
+    def infer(self, inputs, parameters=None):
+        array = np.asarray(inputs["IN"], dtype=np.float32)
+        return {
+            "FAST": FakeDeviceArray(array + 1.0, delay_s=0.01),
+            "SLOW": FakeDeviceArray(
+                array - 1.0, delay_s=self._slow_s,
+                error=RuntimeError("slow output fetch died")
+                if self._fail else None),
+        }
+
+
+def _member(batcher, value, wanted, results, key, timings=None):
+    data = np.full((1, 4), value, dtype=np.float32)
+    start = time.monotonic()
+    try:
+        outputs, _, _ = batcher.infer({"IN": data}, {}, 1,
+                                      wanted_outputs=wanted)
+        results[key] = outputs
+    except Exception as e:  # noqa: BLE001 — asserted by the test
+        results[key] = e
+    if timings is not None:
+        timings[key] = time.monotonic() - start
+
+
+def test_member_early_completion_on_wanted_outputs():
+    """A member that asked only for the fast output wakes as soon as
+    it lands — while the fused batch's slow output is still in
+    flight; a member wanting everything waits for both. Slices stay
+    golden for both."""
+    model = _TwoOutModel(slow_s=0.5)
+    batcher = DynamicBatcher(model, max_queue_delay_us=100_000)
+    results, timings = {}, {}
+    threads = [
+        threading.Thread(target=_member, args=(
+            batcher, 5.0, frozenset(("FAST",)), results, "fast_only",
+            timings)),
+        threading.Thread(target=_member, args=(
+            batcher, 9.0, None, results, "wants_all", timings)),
+    ]
+    for thread in threads:
+        thread.start()
+    for thread in threads:
+        thread.join(timeout=30)
+    batcher.stop()
+    fast_only = results["fast_only"]
+    wants_all = results["wants_all"]
+    assert not isinstance(fast_only, Exception), fast_only
+    assert not isinstance(wants_all, Exception), wants_all
+    assert set(fast_only) == {"FAST"}
+    assert set(wants_all) == {"FAST", "SLOW"}
+    # Fused batch order is [fast_only, wants_all] or the reverse —
+    # check values, not offsets.
+    np.testing.assert_array_equal(fast_only["FAST"],
+                                  np.full((1, 4), 6.0, np.float32))
+    np.testing.assert_array_equal(wants_all["SLOW"],
+                                  np.full((1, 4), 8.0, np.float32))
+    assert timings["fast_only"] < timings["wants_all"], timings
+    # 0.5 s of slow-output transfer never taxed the fast-only member.
+    assert timings["wants_all"] - timings["fast_only"] > 0.2, timings
+
+
+def test_failed_output_fetch_fails_only_requesters():
+    """SLOW's fetch dies: the member that wanted only FAST still
+    succeeds; the member wanting everything gets the INTERNAL error;
+    the next batch is unaffected."""
+    model = _TwoOutModel(slow_s=0.05, fail_slow=True)
+    executions = []
+    batcher = DynamicBatcher(
+        model, max_queue_delay_us=100_000,
+        stats_hook=lambda size, compute_ns, fetch_ns:
+        executions.append(size))
+    results = {}
+    threads = [
+        threading.Thread(target=_member, args=(
+            batcher, 1.0, frozenset(("FAST",)), results, "fast_only")),
+        threading.Thread(target=_member, args=(
+            batcher, 2.0, None, results, "wants_all")),
+        threading.Thread(target=_member, args=(
+            batcher, 3.0, frozenset(("SLOW",)), results, "slow_only")),
+    ]
+    for thread in threads:
+        thread.start()
+    for thread in threads:
+        thread.join(timeout=30)
+    fast_only = results["fast_only"]
+    assert not isinstance(fast_only, Exception), fast_only
+    np.testing.assert_array_equal(fast_only["FAST"],
+                                  np.full((1, 4), 2.0, np.float32))
+    for key in ("wants_all", "slow_only"):
+        error = results[key]
+        assert isinstance(error, InferenceServerException), error
+        assert "slow output fetch died" in str(error)
+    # Error isolation across batches: the batcher still serves.
+    model._fail = False
+    late = {}
+    _member(batcher, 7.0, None, late, "late")
+    batcher.stop()
+    assert not isinstance(late["late"], Exception), late["late"]
+    np.testing.assert_array_equal(late["late"]["FAST"],
+                                  np.full((1, 4), 8.0, np.float32))
+    # The execution HAPPENED and served members — a partial fetch
+    # failure must still record it (stats_hook per successful batch).
+    assert len(executions) == 2, executions
+
+
+def test_fused_slices_parity_mixed_batch_sizes():
+    """Members of batch 1/2/1 get exactly their rows of the fused
+    output — the scatter-offset contract under per-member wake."""
+    class EchoModel(ServedModel):
+        name = "echo"
+        max_batch_size = 8
+        dynamic_batching = True
+
+        def infer(self, inputs, parameters=None):
+            array = np.asarray(inputs["IN"], dtype=np.float32)
+            return {"OUT": FakeDeviceArray(array * 10.0, delay_s=0.01)}
+
+    batcher = DynamicBatcher(EchoModel(), max_queue_delay_us=150_000)
+    results = {}
+
+    def one(key, rows, value):
+        data = np.full((rows, 4), value, dtype=np.float32)
+        try:
+            outputs, _, _ = batcher.infer({"IN": data}, {}, rows)
+            results[key] = outputs["OUT"]
+        except Exception as e:  # noqa: BLE001
+            results[key] = e
+
+    threads = [threading.Thread(target=one, args=(k, r, v))
+               for k, r, v in (("a", 1, 1.0), ("b", 2, 2.0),
+                               ("c", 1, 3.0))]
+    for thread in threads:
+        thread.start()
+    for thread in threads:
+        thread.join(timeout=30)
+    batcher.stop()
+    for key, rows, value in (("a", 1, 1.0), ("b", 2, 2.0),
+                             ("c", 1, 3.0)):
+        out = results[key]
+        assert not isinstance(out, Exception), out
+        np.testing.assert_array_equal(
+            out, np.full((rows, 4), value * 10.0, np.float32))
+
+
+def test_opt_out_keeps_legacy_serial_path():
+    model = _TwoOutModel()
+    model.overlapped_fetch = False
+    batcher = DynamicBatcher(model, max_queue_delay_us=100_000,
+                             overlapped_fetch=False)
+    assert batcher._fetcher is None
+    results = {}
+    threads = [threading.Thread(target=_member, args=(
+        batcher, float(i), None, results, i)) for i in range(2)]
+    for thread in threads:
+        thread.start()
+    for thread in threads:
+        thread.join(timeout=30)
+    batcher.stop()
+    for i in range(2):
+        out = results[i]
+        assert not isinstance(out, Exception), out
+        np.testing.assert_array_equal(
+            out["FAST"], np.full((1, 4), i + 1.0, np.float32))
+
+
+# -- shm / arena landing ---------------------------------------------------
+
+
+def test_write_output_lands_device_tensor_in_region():
+    """System-shm output placement routes device tensors through
+    fetch_into — the region is the landing buffer, bytes match the
+    legacy serialize path, bf16 included."""
+    import jax.numpy as jnp
+
+    from client_tpu.server.memory import SharedMemoryManager
+    from client_tpu.utils import shared_memory as system_shm
+
+    region = system_shm.create_shared_memory_region(
+        "fetch_test", "/fetch_test_region", 1 << 16)
+    manager = SharedMemoryManager()
+    manager.register_system("fetch_test", "/fetch_test_region", 0,
+                            1 << 16)
+    try:
+        for value in (jnp.arange(100, dtype=jnp.float32) * 0.5,
+                      jnp.arange(100, dtype=jnp.bfloat16) / 3,
+                      np.arange(100, dtype=np.int64)):
+            golden = np.ascontiguousarray(np.asarray(value)).tobytes()
+            written = manager.write_output("fetch_test", 1 << 16, 0,
+                                           value)
+            assert written == len(golden)
+            landed = bytes(region.buf()[:written])
+            assert landed == golden
+    finally:
+        manager.unregister_system("fetch_test")
+        system_shm.destroy_shared_memory_region(region)
+
+
+def test_write_output_bytes_tensor_keeps_serialize_path():
+    from client_tpu.server.memory import SharedMemoryManager
+    from client_tpu.utils import serialize_byte_tensor
+    from client_tpu.utils import shared_memory as system_shm
+
+    region = system_shm.create_shared_memory_region(
+        "fetch_bytes", "/fetch_bytes_region", 4096)
+    manager = SharedMemoryManager()
+    manager.register_system("fetch_bytes", "/fetch_bytes_region", 0,
+                            4096)
+    try:
+        value = np.array([b"alpha", b"bb", b"c" * 40], dtype=np.object_)
+        golden = serialize_byte_tensor(value).tobytes()
+        written = manager.write_output("fetch_bytes", 4096, 0, value)
+        assert written == len(golden)
+        assert bytes(region.buf()[:written]) == golden
+    finally:
+        manager.unregister_system("fetch_bytes")
+        system_shm.destroy_shared_memory_region(region)
+
+
+def test_write_output_bounds_still_enforced():
+    from client_tpu.server.memory import SharedMemoryManager
+    from client_tpu.utils import shared_memory as system_shm
+
+    region = system_shm.create_shared_memory_region(
+        "fetch_small", "/fetch_small_region", 64)
+    manager = SharedMemoryManager()
+    manager.register_system("fetch_small", "/fetch_small_region", 0, 64)
+    try:
+        too_big = np.arange(1024, dtype=np.float32)
+        with pytest.raises(InferenceServerException):
+            manager.write_output("fetch_small", 64, 0, too_big)
+    finally:
+        manager.unregister_system("fetch_small")
+        system_shm.destroy_shared_memory_region(region)
+
+
+def test_arena_read_serves_memoryview_single_cover():
+    import json
+
+    from client_tpu.server.tpu_arena import TpuArena
+
+    arena = TpuArena()
+    handle = arena.create_region(1 << 16)
+    region_id = json.loads(handle)["region_id"]
+    data = np.arange(2048, dtype=np.float32)
+    arena.write(region_id, 0, data.tobytes(), "FP32", [2048])
+    # Whole-segment and interior windows: zero-assembly memoryview.
+    whole = arena.read(region_id, 0, data.nbytes)
+    assert isinstance(whole, memoryview)
+    assert bytes(whole) == data.tobytes()
+    interior = arena.read(region_id, 16, 256)
+    assert isinstance(interior, memoryview)
+    assert bytes(interior) == data.tobytes()[16:272]
+    # Multi-segment window still assembles to bytes (zero-filled gap).
+    arena.write(region_id, data.nbytes + 64, b"\x07\x08")
+    spanning = arena.read(region_id, 0, data.nbytes + 66)
+    assert isinstance(spanning, bytes)
+    assert spanning[:data.nbytes] == data.tobytes()
+    assert spanning[-2:] == b"\x07\x08"
+    assert spanning[data.nbytes:data.nbytes + 64] == b"\x00" * 64
+
+
+def test_arena_store_then_read_single_copy_bf16():
+    import json
+
+    import jax.numpy as jnp
+
+    from client_tpu.server.tpu_arena import TpuArena
+
+    arena = TpuArena()
+    handle = arena.create_region(4096)
+    region_id = json.loads(handle)["region_id"]
+    value = jnp.arange(64, dtype=jnp.bfloat16) / 7
+    arena.store(region_id, 0, 4096, value)
+    golden = np.asarray(value).tobytes()
+    got = arena.read(region_id, 0, len(golden))
+    assert bytes(got) == golden
+
+
+# -- core direct path ------------------------------------------------------
+
+
+def test_core_direct_path_overlapped_parity():
+    """A non-batched model returning fake-device outputs: the core's
+    shared fetcher materializes them (overlapped) and the encoded
+    response is golden; opting out restores the serial path with the
+    same bytes."""
+    from client_tpu.protocol import inference_pb2 as pb
+    from client_tpu.server.core import InferenceServerCore
+    from client_tpu.server.repository import ModelRepository
+
+    class DirectModel(ServedModel):
+        max_batch_size = 0
+
+        def __init__(self, name, overlapped):
+            super().__init__()
+            self.name = name
+            self.overlapped_fetch = overlapped
+            self.inputs = [TensorSpec("IN", "FP32", [4])]
+            self.outputs = [TensorSpec("OUT0", "FP32", [4]),
+                            TensorSpec("OUT1", "FP32", [4])]
+
+        def infer(self, inputs, parameters=None):
+            array = np.asarray(inputs["IN"], dtype=np.float32)
+            return {"OUT0": FakeDeviceArray(array * 2.0, delay_s=0.01),
+                    "OUT1": FakeDeviceArray(array * 3.0, delay_s=0.01)}
+
+    repository = ModelRepository()
+    repository.add_factory("direct_on",
+                           lambda: DirectModel("direct_on", True))
+    repository.add_factory("direct_off",
+                           lambda: DirectModel("direct_off", False))
+    repository.load("direct_on")
+    repository.load("direct_off")
+    core = InferenceServerCore(repository)
+    try:
+        responses = {}
+        for name in ("direct_on", "direct_off"):
+            request = pb.ModelInferRequest(model_name=name, id="r1")
+            tensor = request.inputs.add()
+            tensor.name = "IN"
+            tensor.datatype = "FP32"
+            tensor.shape.extend([4])
+            request.raw_input_contents.append(
+                np.arange(4, dtype=np.float32).tobytes())
+            responses[name] = core.infer(request)
+        on, off = responses["direct_on"], responses["direct_off"]
+        assert [t.name for t in on.outputs] == \
+            [t.name for t in off.outputs]
+        assert list(on.raw_output_contents) == \
+            list(off.raw_output_contents)
+        golden = (np.arange(4, dtype=np.float32) * 2.0).tobytes()
+        assert on.raw_output_contents[0] == golden
+    finally:
+        core.shutdown()
+
+
+def test_core_direct_path_fetches_only_requested_outputs():
+    """A subset request must not pay device->host traffic for outputs
+    it never asked for: the unrequested output's materialization is
+    rigged to raise — fetching it would fail the request."""
+    from client_tpu.protocol import inference_pb2 as pb
+    from client_tpu.server.core import InferenceServerCore
+    from client_tpu.server.repository import ModelRepository
+
+    class SubsetModel(ServedModel):
+        name = "subset"
+        max_batch_size = 0
+
+        def __init__(self):
+            super().__init__()
+            self.inputs = [TensorSpec("IN", "FP32", [4])]
+            self.outputs = [TensorSpec("WANTED", "FP32", [4]),
+                            TensorSpec("UNTOUCHED", "FP32", [4])]
+
+        def infer(self, inputs, parameters=None):
+            array = np.asarray(inputs["IN"], dtype=np.float32)
+            return {
+                "WANTED": FakeDeviceArray(array + 1.0),
+                "UNTOUCHED": FakeDeviceArray(
+                    array, error=RuntimeError(
+                        "unrequested output was fetched")),
+            }
+
+    repository = ModelRepository()
+    repository.add_factory("subset", SubsetModel)
+    repository.load("subset")
+    core = InferenceServerCore(repository)
+    try:
+        request = pb.ModelInferRequest(model_name="subset", id="r1")
+        tensor = request.inputs.add()
+        tensor.name = "IN"
+        tensor.datatype = "FP32"
+        tensor.shape.extend([4])
+        request.raw_input_contents.append(
+            np.arange(4, dtype=np.float32).tobytes())
+        request.outputs.add(name="WANTED")
+        response = core.infer(request)
+        assert [t.name for t in response.outputs] == ["WANTED"]
+        assert response.raw_output_contents[0] == \
+            (np.arange(4, dtype=np.float32) + 1.0).tobytes()
+    finally:
+        core.shutdown()

@@ -224,13 +224,13 @@ class TestSegmentedArena:
         arena.write(region_id, 0, a.tobytes(), "FP32", [1024])
 
         calls = []
-        original = TpuArena._segment_bytes
+        original = TpuArena._segment_view
 
         def spy(segment):
             calls.append(segment.offset)
             return original(segment)
 
-        monkeypatch.setattr(TpuArena, "_segment_bytes",
+        monkeypatch.setattr(TpuArena, "_segment_view",
                             staticmethod(spy))
         # Disjoint write: no segment serialization at all.
         b = np.zeros(512, dtype=np.int32)

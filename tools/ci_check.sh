@@ -6,7 +6,7 @@
 # regression gate). Usage: tools/ci_check.sh [min_passed]
 set -u -o pipefail
 
-MIN_PASSED="${1:-578}"
+MIN_PASSED="${1:-596}"
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
 LOG=/tmp/_t1.log
 
@@ -221,4 +221,23 @@ if ! timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/cache_smoke.py \
 fi
 grep -E "cache smoke passed" "$CACHE_LOG"
 echo "OK: cache smoke passed"
+
+# Fetch smoke: the overlapped output-fetch subsystem must hold golden
+# parity against the legacy serial np.asarray path (wire + shm-landed
+# outputs on the fetch_bench A/B pair), must not regress the
+# server-side relay_fetch p50 on real arrays, and must show >=2x
+# relay_fetch p50 reduction on a simulated-DMA pair (the overlap
+# mechanism itself, platform-independent). Gates live in
+# tools/fetch_smoke.py.
+echo "fetch smoke: overlapped-vs-legacy relay fetch A/B + parity"
+FETCH_LOG=/tmp/_fetch_smoke.log
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/fetch_smoke.py \
+    > "$FETCH_LOG" 2>&1; then
+    echo "FAIL: fetch smoke did not pass" >&2
+    tail -20 "$FETCH_LOG" >&2
+    exit 1
+fi
+grep -E "fetch smoke passed" "$FETCH_LOG"
+grep -E "real arrays|simulated DMA" "$FETCH_LOG"
+echo "OK: fetch smoke passed"
 exit 0
