@@ -15,11 +15,13 @@ TPU-first structure:
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import queue
 import threading
-from collections import deque
+import time
+from collections import OrderedDict, deque
 from functools import partial
-from typing import Dict, Iterator, Optional
+from typing import Dict, Iterator, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -27,6 +29,7 @@ import numpy as np
 
 from client_tpu.parallel import LLM_RULES, ShardingRules, create_mesh
 from client_tpu.server.model import ServedModel, TensorSpec
+from client_tpu.status_map import retryable_error
 from client_tpu.utils import InferenceServerException
 
 
@@ -226,13 +229,17 @@ def forward(params, tokens, cfg: LlmConfig, attention_fn=None):
     return (x @ params["unembed"]).astype(jnp.float32)
 
 
-def init_cache(cfg: LlmConfig, batch: int, dtype=None):
+def init_cache(cfg: LlmConfig, batch: int, dtype=None, length=None):
+    """Dense per-lane KV cache. ``length`` (default ``max_seq``) sizes
+    the sequence axis — the paged path prefills into a bucket-sized
+    scratch cache instead of a full ``max_seq`` reservation."""
     dtype = dtype or jnp.dtype(cfg.dtype)
+    length = length or cfg.max_seq
     return [
         (
-            jnp.zeros((batch, cfg.max_seq, cfg.n_kv_heads, cfg.head_dim),
+            jnp.zeros((batch, length, cfg.n_kv_heads, cfg.head_dim),
                       dtype=dtype),
-            jnp.zeros((batch, cfg.max_seq, cfg.n_kv_heads, cfg.head_dim),
+            jnp.zeros((batch, length, cfg.n_kv_heads, cfg.head_dim),
                       dtype=dtype),
         )
         for _ in range(cfg.n_layers)
@@ -250,9 +257,11 @@ def prefill(params, tokens, cache, cfg: LlmConfig, true_len=None):
     b, s = tokens.shape
     x = params["embed"][tokens]
     positions = jnp.broadcast_to(jnp.arange(s), (b, s))
-    # rows attend to cache slots <= their position
+    # rows attend to cache slots <= their position; mask width follows
+    # the cache's sequence axis (max_seq for the dense arm, the padded
+    # prompt bucket for the paged arm's scratch prefill).
     mask = jnp.tril(
-        jnp.ones((s, cfg.max_seq), dtype=bool), k=0
+        jnp.ones((s, cache[0][0].shape[1]), dtype=bool), k=0
     )[None]
     new_cache = []
     for layer, layer_cache in zip(params["layers"], cache):
@@ -347,6 +356,314 @@ def decode_step(params, token, pos, cache, cfg: LlmConfig):
     return logits, new_cache
 
 
+# -- paged KV cache --------------------------------------------------------
+#
+# vLLM-style layout: one device-resident page pool per layer
+# (``[num_pages, page_size, n_kv_heads, head_dim]`` for K and V) plus a
+# per-lane block table of page ids. A lane touches only the pages its
+# sequence actually occupies, so HBM (and attention width — the tables
+# are bucketed to the longest live sequence) scales with live tokens,
+# not ``lanes x max_seq``. Kernels address the pool through a flattened
+# ``[num_pages * page_size, ...]`` view; ``num_pages * page_size`` is
+# the out-of-bounds sentinel slot — scatters to it are dropped
+# (``mode="drop"``), which is how padded rows, finished lanes, and
+# shared (copy-on-write) pages are write-protected.
+
+
+def init_page_pool(cfg: LlmConfig, num_pages: int, page_size: int,
+                   dtype=None):
+    dtype = dtype or jnp.dtype(cfg.dtype)
+    return [
+        (
+            jnp.zeros((num_pages, page_size, cfg.n_kv_heads,
+                       cfg.head_dim), dtype=dtype),
+            jnp.zeros((num_pages, page_size, cfg.n_kv_heads,
+                       cfg.head_dim), dtype=dtype),
+        )
+        for _ in range(cfg.n_layers)
+    ]
+
+
+def prefix_page_hashes(prompt, page_size: int) -> List[bytes]:
+    """Chained BLAKE2b digest per FULL page of prompt tokens: digest
+    ``p`` covers tokens ``[0, (p+1) * page_size)`` — a page's K/V
+    depend on the whole prefix through attention, so the hash must
+    too (the PR-5 content-hash approach at page granularity)."""
+    arr = np.asarray(prompt, dtype=np.int32)
+    running = hashlib.blake2b(digest_size=16)
+    out: List[bytes] = []
+    for p in range(len(arr) // page_size):
+        running.update(arr[p * page_size:(p + 1) * page_size].tobytes())
+        out.append(running.digest())
+    return out
+
+
+def _paged_block(layer, x, positions, mask, cfg: LlmConfig, kv, dest,
+                 tables, page_size: int):
+    """One transformer block over the paged pool: write this call's
+    K/V rows at flat slots ``dest`` (sentinel rows dropped), then
+    attend over the lane's block-table gather. x ``[B,S,D]``, dest
+    ``[B*S]``, tables ``[B,P]``, kv = (K pool, V pool)."""
+    ck, cv = kv
+    h = _rms_norm(x, layer["attn_norm"])
+    q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"])
+    k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"])
+    v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"])
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    b, s = x.shape[0], x.shape[1]
+    flat_k = ck.reshape((-1,) + ck.shape[2:])
+    flat_v = cv.reshape((-1,) + cv.shape[2:])
+    flat_k = flat_k.at[dest].set(
+        k.reshape((b * s,) + k.shape[2:]), mode="drop")
+    flat_v = flat_v.at[dest].set(
+        v.reshape((b * s,) + v.shape[2:]), mode="drop")
+    ck = flat_k.reshape(ck.shape)
+    cv = flat_v.reshape(cv.shape)
+    t = tables.shape[1] * page_size
+    gk = ck[tables].reshape((b, t) + ck.shape[2:])
+    gv = cv[tables].reshape((b, t) + cv.shape[2:])
+    ctx = _attention(q, gk, gv, mask)
+    x = x + jnp.einsum("bshk,hkd->bsd", ctx, layer["wo"])
+    h = _rms_norm(x, layer["mlp_norm"])
+    gated = jax.nn.silu(h @ layer["w_gate"]) * (h @ layer["w_up"])
+    return x + gated @ layer["w_down"], (ck, cv)
+
+
+def paged_decode_chunk(params, tokens, pos, limit, eos_stop, done,
+                       tables, pool, *, cfg: LlmConfig, length: int,
+                       page_size: int):
+    """Greedy-decodes up to ``length`` tokens for B lanes against the
+    paged pool. tokens/pos/limit ``[B]``; eos_stop/done ``[B]`` bool;
+    tables ``[B, P]`` page ids. Per-lane masking fixes the run-ahead
+    waste the dense arm pays: a lane decodes only while
+    ``step < limit`` (host-known budget) and ``not done`` (device-known
+    EOS, carried BETWEEN dispatches) — an in-flight chunk dispatched
+    before the host learned of a lane's EOS writes nothing for that
+    lane and burns no pages. Returns
+    ``(emitted [length, B], tokens [B], done [B], pool)``; inactive
+    steps emit PAD."""
+    num_slots = pool[0][0].shape[0] * page_size
+    t_width = tables.shape[1] * page_size
+
+    def step(carry, i):
+        tok, p, dn, pl = carry
+        active = jnp.logical_and(jnp.logical_not(dn), i < limit)
+        x = params["embed"][tok[:, None]]  # [B,1,D]
+        positions = p[:, None]
+        page = jnp.take_along_axis(
+            tables, (p // page_size)[:, None], axis=1)[:, 0]
+        dest = jnp.where(active, page * page_size + p % page_size,
+                         num_slots)
+        mask = jnp.arange(t_width)[None, None, :] <= p[:, None, None]
+        new_pool = []
+        for layer, kv in zip(params["layers"], pl):
+            x, kv = _paged_block(layer, x, positions, mask, cfg, kv,
+                                 dest, tables, page_size)
+            new_pool.append(kv)
+        x = _rms_norm(x, params["final_norm"])
+        logits = (x[:, -1] @ params["unembed"]).astype(jnp.float32)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        newly_done = jnp.logical_and(
+            active, jnp.logical_and(nxt == EOS, eos_stop))
+        emit = jnp.where(active, nxt, PAD)
+        tok = jnp.where(active, nxt, tok)
+        p = jnp.where(active, p + 1, p)
+        dn = jnp.logical_or(dn, newly_done)
+        return (tok, p, dn, tuple(new_pool)), emit
+
+    (tok, _, done, pool), emitted = jax.lax.scan(
+        step,
+        (tokens.astype(jnp.int32), pos.astype(jnp.int32), done,
+         tuple(pool)),
+        jnp.arange(length))
+    return emitted, tok, done, list(pool)
+
+
+def paged_prefill_chunk(params, tokens, positions, dest, last_row,
+                        tables, pool, *, cfg: LlmConfig,
+                        page_size: int):
+    """One bounded prefill chunk for a single joining sequence:
+    tokens ``[1, C]``, positions ``[C]`` (absolute, ``start+i``), dest
+    ``[C]`` flat pool slots (sentinel for padded rows AND rows covered
+    by shared prefix pages — copy-on-write: shared pages are never
+    written), tables ``[1, P]`` covering the lane's pages so far.
+    Attention gathers the whole live context (earlier chunks + shared
+    prefix pages) from the pool. Returns the greedy next token after
+    row ``last_row`` (``[1]``, meaningful on the final chunk) and the
+    updated pool."""
+    t_width = tables.shape[1] * page_size
+    x = params["embed"][tokens]  # [1,C,D]
+    posb = positions[None, :]
+    mask = (jnp.arange(t_width)[None, None, :]
+            <= positions[None, :, None])  # [1,C,T]
+    new_pool = []
+    for layer, kv in zip(params["layers"], pool):
+        x, kv = _paged_block(layer, x, posb, mask, cfg, kv, dest,
+                             tables, page_size)
+        new_pool.append(kv)
+    x = _rms_norm(x, params["final_norm"])
+    last = jax.lax.dynamic_slice_in_dim(x[0], last_row, 1, axis=0)[0]
+    logits = (last @ params["unembed"]).astype(jnp.float32)
+    return jnp.argmax(logits).astype(jnp.int32).reshape(1), new_pool
+
+
+def pack_pages(pool, scratch, dest):
+    """Scatters a batched scratch prefill cache (``[b, bucket, ...]``
+    per layer) into pool pages at flat slots ``dest [b * bucket]``
+    (sentinel rows — padding — are dropped)."""
+    out = []
+    for (pk, pv), (sk, sv) in zip(pool, scratch):
+        fk = pk.reshape((-1,) + pk.shape[2:])
+        fv = pv.reshape((-1,) + pv.shape[2:])
+        fk = fk.at[dest].set(
+            sk.reshape((-1,) + sk.shape[2:]), mode="drop")
+        fv = fv.at[dest].set(
+            sv.reshape((-1,) + sv.shape[2:]), mode="drop")
+        out.append((fk.reshape(pk.shape), fv.reshape(pv.shape)))
+    return out
+
+
+class _PagePool:
+    """Host-side page accounting (guarded by the model's scheduler
+    lock — no internal lock). Three invariant-bearing counts:
+
+    * ``reserved`` — pages promised to admitted-but-not-yet-drawn
+      work. Admission reserves a sequence's worst case
+      (private prompt pages + decode pages for ``max_tokens``), so a
+      mid-stream allocation can NEVER fail — the deadlock a
+      free-for-all paged pool invites is ruled out by construction.
+    * ``lane_held`` — private pages referenced by a live lane.
+    * ``shared_live`` — prefix-cache pages pinned by >=1 live lane
+      (copy-on-write refcounts; never written after registration).
+
+    Pages whose only reference is the prefix index are EVICTABLE
+    (LRU): they keep serving prefix hits while free, and are reclaimed
+    on demand, so the admission invariant is
+    ``reserved + lane_held + shared_live <= num_pages``."""
+
+    def __init__(self, num_pages: int, page_size: int):
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self._free = list(range(self.num_pages - 1, -1, -1))
+        self._lane_refs = [0] * self.num_pages
+        self._hash_of: Dict[int, bytes] = {}
+        self._index: "OrderedDict[bytes, int]" = OrderedDict()
+        self.reserved = 0
+        self.lane_held = 0
+        self.shared_live = 0
+
+    # -- admission ------------------------------------------------------
+
+    def peek_chain(self, hashes: List[bytes], cap: int):
+        """(hits, newly_pinned) for the longest cached prefix-page
+        chain (<= cap pages) without attaching."""
+        hits = pinned = 0
+        for digest in hashes[:cap]:
+            page = self._index.get(digest)
+            if page is None:
+                break
+            hits += 1
+            if self._lane_refs[page] == 0:
+                pinned += 1
+        return hits, pinned
+
+    def can_admit(self, reserve_need: int, newly_pinned: int) -> bool:
+        return (self.reserved + self.lane_held + self.shared_live
+                + reserve_need + newly_pinned) <= self.num_pages
+
+    def reserve(self, n: int) -> None:
+        self.reserved += n
+
+    def release_reservation(self, n: int) -> None:
+        self.reserved -= n
+
+    def attach(self, hashes: List[bytes]) -> List[int]:
+        """Increfs the cached pages for ``hashes`` (all must be
+        present — call peek_chain first) and returns their page ids
+        in chain order."""
+        pages = []
+        for digest in hashes:
+            page = self._index[digest]
+            self._index.move_to_end(digest)
+            if self._lane_refs[page] == 0:
+                self.shared_live += 1
+            self._lane_refs[page] += 1
+            pages.append(page)
+        return pages
+
+    def alloc(self, n: int) -> List[int]:
+        """Draws ``n`` private pages against the reservation, evicting
+        LRU cache-only pages as needed. The admission invariant
+        guarantees success; a failure is a refcount bug and raises."""
+        if n > self.reserved:
+            raise RuntimeError(
+                "kv page alloc of %d exceeds reservation %d"
+                % (n, self.reserved))
+        out = []
+        for _ in range(n):
+            if not self._free:
+                self._evict_one()
+            page = self._free.pop()
+            self._lane_refs[page] = 1
+            self.lane_held += 1
+            self.reserved -= 1
+            out.append(page)
+        return out
+
+    def _evict_one(self) -> None:
+        for digest, page in self._index.items():
+            if self._lane_refs[page] == 0:
+                del self._index[digest]
+                del self._hash_of[page]
+                self._free.append(page)
+                return
+        raise RuntimeError(
+            "kv page pool invariant violated: no free or evictable "
+            "page (reserved=%d lane_held=%d shared_live=%d)"
+            % (self.reserved, self.lane_held, self.shared_live))
+
+    def register(self, digest: bytes, page: int) -> None:
+        """Publishes a lane-held page into the prefix index (becomes
+        shared + copy-on-write; the write barrier is that nothing ever
+        scatters to an indexed page again)."""
+        if digest in self._index or page in self._hash_of:
+            return
+        self._index[digest] = page
+        self._hash_of[page] = digest
+        if self._lane_refs[page] > 0:
+            self.lane_held -= 1
+            self.shared_live += 1
+
+    def free(self, pages: List[int]) -> None:
+        for page in pages:
+            self._lane_refs[page] -= 1
+            if self._lane_refs[page] == 0:
+                if page in self._hash_of:
+                    self.shared_live -= 1  # stays cached, evictable
+                else:
+                    self.lane_held -= 1
+                    self._free.append(page)
+
+    def drop_cache(self) -> None:
+        """Evicts every cache-only page (tests / leak accounting)."""
+        for digest in [d for d, p in self._index.items()
+                       if self._lane_refs[p] == 0]:
+            page = self._index.pop(digest)
+            del self._hash_of[page]
+            self._free.append(page)
+
+    def snapshot(self) -> dict:
+        cached = len(self._index) - self.shared_live
+        return {
+            "pages_total": self.num_pages,
+            "pages_used": self.lane_held + self.shared_live,
+            "pages_cached": cached,
+            "pages_free": len(self._free),
+            "pages_reserved": self.reserved,
+        }
+
+
 def loss_fn(params, tokens, targets, cfg: LlmConfig, attention_fn=None):
     logits = forward(params, tokens, cfg, attention_fn=attention_fn)
     logp = jax.nn.log_softmax(logits, axis=-1)
@@ -384,17 +701,43 @@ class _GenRequest:
         self.delivered = 0
         self.queue: queue.Queue = queue.Queue()
         self.error: Optional[str] = None
+        self.error_status = "INTERNAL"
         # Set when the consumer abandons the stream (client
         # disconnect): the scheduler frees the lane at the next chunk
         # boundary instead of decoding the full budget into nowhere.
         self.cancelled = False
+        # Paged-path bookkeeping: wall-clock admission deadline for the
+        # join-queue page wait (PR-2 queue-deadline semantics), the
+        # enqueue stamp feeding the page-free-time EWMA, and the
+        # prompt's chained page hashes (computed ONCE at enqueue — a
+        # blocked queue head is re-planned every scheduler pass).
+        self.deadline_ns: Optional[int] = None
+        self.enqueue_ns: Optional[int] = None
+        self.page_hashes: List[bytes] = []
 
     def finish(self):
         self.queue.put(None)
 
-    def fail(self, message: str):
+    def fail(self, message: str, status: str = "INTERNAL"):
         self.error = message
+        self.error_status = status
         self.queue.put(None)
+
+
+class _PrefillJob:
+    """A joining sequence whose prompt prefills in bounded chunks
+    interleaved with decode steps (long prompts, and any prompt with a
+    shared-prefix hit — the chunk kernel gathers the shared pages)."""
+
+    __slots__ = ("lane", "req", "prompt", "done_tokens", "hashes")
+
+    def __init__(self, lane: int, req: _GenRequest, prompt,
+                 done_tokens: int, hashes: List[bytes]):
+        self.lane = lane
+        self.req = req
+        self.prompt = prompt
+        self.done_tokens = done_tokens  # shared-prefix tokens skipped
+        self.hashes = hashes
 
 
 class LlmModel(ServedModel):
@@ -403,13 +746,26 @@ class LlmModel(ServedModel):
     Inputs: text_input BYTES [1]; max_tokens INT32 [1] (optional);
     outputs: text_output BYTES [1] per streamed response. Greedy
     decoding with multi-lane batched decode: a scheduler thread steps
-    ``decode_lanes`` independent sequences through one jitted
-    decode_chunk_multi dispatch, so concurrent requests share device
-    work instead of serializing (continuous batching at chunk
-    granularity — requests join/leave at chunk boundaries). Joins
-    prefill in one batched dispatch per padded bucket and their caches
-    are row-inserted into the batched KV cache, which never leaves the
-    device.
+    ``decode_lanes`` independent sequences through one jitted decode
+    dispatch, so concurrent requests share device work instead of
+    serializing (continuous batching at chunk granularity — requests
+    join/leave at chunk boundaries).
+
+    Two KV-cache arms (``paged_kv``, default True; docs/llm_serving.md):
+
+    * **paged** — a device page pool (``[kv_pages, page_size, Hkv, D]``
+      per layer) + per-lane block tables. HBM and attention width
+      scale with live tokens (tables bucket to the longest live
+      sequence), so ``decode_lanes`` can grow to 32-64; prompts
+      prefill in bounded chunks interleaved with decode (chunked
+      prefill), full prompt pages are content-hashed and shared
+      copy-on-write across lanes (prefix cache), joins that cannot
+      reserve pages wait bounded by their queue deadline, and past
+      ``join_watermark`` arrivals shed with an honest Retry-After.
+    * **dense** (``paged_kv=False``, the A/B baseline arm) — the
+      legacy per-lane ``[lanes, max_seq, Hkv, D]`` cache: every lane
+      reserves (and attends over) max_seq regardless of actual length.
+      Paged decode is token-exact against this arm.
 
     The decode pipeline is split into a dispatch side (scheduler
     thread: prefills + decode chunks launched back-to-back, last
@@ -430,13 +786,21 @@ class LlmModel(ServedModel):
     # Decode chunks allowed in flight (dispatched, fetch pending).
     # Pipelining bound: the relay's ~65 ms fetch overlaps roughly
     # fetch_latency / chunk_compute (~4) chunks; beyond that it is
-    # run-ahead waste on finished requests and queue-drain latency
-    # ahead of every join's first token.
+    # queue-drain latency ahead of every join's first token. (The
+    # dense arm also pays run-ahead waste on finished requests here;
+    # the paged arm does not — per-lane limit/done masking means an
+    # in-flight chunk never decodes a dead lane, see
+    # paged_decode_chunk.)
     MAX_INFLIGHT = 5
 
     def __init__(self, name: str = "llm", cfg: Optional[LlmConfig] = None,
                  mesh=None, rules: ShardingRules = LLM_RULES,
-                 seed: int = 0, decode_lanes: int = 4):
+                 seed: int = 0, decode_lanes: int = 4,
+                 paged_kv: Optional[bool] = None, page_size: int = 16,
+                 kv_pages: Optional[int] = None,
+                 prefill_chunk: int = 64,
+                 join_watermark: Optional[int] = None,
+                 queue_timeout_s: float = 30.0):
         super().__init__()
         self.name = name
         self.cfg = cfg or LlmConfig()
@@ -520,6 +884,64 @@ class LlmModel(ServedModel):
         self._delivery_queue: deque = deque()
         self._inflight = 0  # dispatched-not-yet-delivered decode chunks
 
+        # -- paged KV cache (the default serving arm; paged_kv=False
+        # keeps the dense per-lane cache as the A/B baseline). Mesh-
+        # sharded deployments default to the dense arm: the pool is an
+        # unsharded device-resident carry.
+        self._paged = bool(mesh is None if paged_kv is None else paged_kv)
+        self._page_size = max(1, int(page_size))
+        self._pages_per_seq = -(-self.cfg.max_seq // self._page_size)
+        self._num_pages = (int(kv_pages) if kv_pages
+                           else self._lanes * self._pages_per_seq)
+        self._prefill_chunk = max(self._page_size,
+                                  min(int(prefill_chunk),
+                                      self.cfg.max_seq))
+        self._join_watermark = (int(join_watermark) if join_watermark
+                                else max(2 * self._lanes, 8))
+        self._queue_timeout_s = float(queue_timeout_s)
+        self._pool: Optional[_PagePool] = None  # host accounting
+        self._pool_dev = None  # per-layer (K, V) page arrays
+        self._done_dev = None  # [lanes] bool device carry (EOS latch)
+        self._lane_pages: List[List[int]] = [
+            [] for _ in range(self._lanes)]
+        self._lane_reserved = [0] * self._lanes
+        self._lane_steps_left = [0] * self._lanes
+        self._prefill_jobs: List[_PrefillJob] = []
+        self._joining: List[_GenRequest] = []  # admitted, not yet active
+        self._ewma_request_s: Optional[float] = None
+        self._kv_counters = {
+            "prefix_hits_total": 0,
+            "prefill_chunks_total": 0,
+            "shed_total": 0,
+            "expired_total": 0,
+            "pages_used_peak": 0,
+        }
+        if self._paged:
+            self._paged_decode = jax.jit(
+                partial(paged_decode_chunk, cfg=cfg_static,
+                        length=self.STREAM_CHUNK,
+                        page_size=self._page_size),
+                donate_argnums=(7,))
+            self._paged_prefill = jax.jit(
+                partial(paged_prefill_chunk, cfg=cfg_static,
+                        page_size=self._page_size),
+                donate_argnums=(6,))
+            self._pack_pages = jax.jit(pack_pages, donate_argnums=(0,))
+            self._gather_lanes = jax.jit(
+                lambda toks, done, idx: (toks[idx], done[idx]))
+            # Pad rows scatter to index `lanes` (out of bounds) and drop.
+            self._scatter_lanes = jax.jit(
+                lambda toks, done, idx, tv, dv: (
+                    toks.at[idx].set(tv, mode="drop"),
+                    done.at[idx].set(dv, mode="drop")),
+                donate_argnums=(0, 1))
+            # Join commit: seat first tokens + clear the EOS latch.
+            self._join_lanes = jax.jit(
+                lambda toks, done, idx, vals: (
+                    toks.at[idx].set(vals),
+                    done.at[idx].set(False)),
+                donate_argnums=(0, 1))
+
     # -- scheduler -------------------------------------------------------
 
     def _ensure_scheduler(self):
@@ -537,8 +959,10 @@ class LlmModel(ServedModel):
                     max_workers=self.MAX_INFLIGHT + 2,
                     thread_name_prefix="llm-fetch-%s" % self.name)
             if self._sched_thread is None:
+                loop = (self._scheduler_loop_paged if self._paged
+                        else self._scheduler_loop)
                 self._sched_thread = threading.Thread(
-                    target=self._scheduler_loop, args=(self._gen,),
+                    target=loop, args=(self._gen,),
                     daemon=True, name="llm-decode-%s" % self.name)
                 self._sched_thread.start()
             if self._delivery_thread is None:
@@ -565,10 +989,33 @@ class LlmModel(ServedModel):
         return True
 
     def _release_lane(self, lane: int):
-        """Caller holds _sched_cv."""
-        self._active.pop(lane, None)
+        """Caller holds _sched_cv. On the paged arm this is also where
+        the lane's pages and leftover reservation return to the pool
+        (shared prefix pages decref; private pages free immediately —
+        stale in-flight writes to a recycled page are harmless because
+        every dispatch is device-stream-ordered and a page's next
+        owner writes, or masks, each row before attending to it)."""
+        req = self._active.pop(lane, None)
         self._lane_pos[lane] = 0
+        if self._paged:
+            self._free_lane_pages(lane)
+            if req is not None and req.enqueue_ns is not None:
+                dur_s = (time.monotonic_ns() - req.enqueue_ns) / 1e9
+                if self._ewma_request_s is None:
+                    self._ewma_request_s = dur_s
+                else:
+                    self._ewma_request_s = (0.7 * self._ewma_request_s
+                                            + 0.3 * dur_s)
         self._free_lanes.append(lane)
+
+    def _free_lane_pages(self, lane: int):
+        """Caller holds _sched_cv."""
+        if self._pool is not None:
+            self._pool.free(self._lane_pages[lane])
+            self._pool.release_reservation(self._lane_reserved[lane])
+        self._lane_pages[lane] = []
+        self._lane_reserved[lane] = 0
+        self._lane_steps_left[lane] = 0
 
     def _compile_prefill(self, b: int, bucket: int):
         """AOT-compiles the (b, bucket) prefill and publishes it in
@@ -577,9 +1024,12 @@ class LlmModel(ServedModel):
         for batched shapes."""
         toks = jax.ShapeDtypeStruct((b, bucket), jnp.int32)
         lens = jax.ShapeDtypeStruct((b,), jnp.int32)
+        # Paged arm prefills into a bucket-sized scratch cache (packed
+        # into pages afterwards) instead of a max_seq reservation.
         cache = jax.tree.map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
-            init_cache(self.cfg, b))
+            init_cache(self.cfg, b,
+                       length=bucket if self._paged else None))
         compiled = self._prefill.lower(
             self._params, toks, cache, lens).compile()
         with self._prefill_exec_lock:
@@ -757,7 +1207,8 @@ class LlmModel(ServedModel):
                         return
                     self._batched_cache = new_cache
                     self._tokens_dev = toks[-1]  # [lanes] device carry
-                    snapshot = dict(self._active)
+                    snapshot = {lane: (req, self.STREAM_CHUNK, lane)
+                                for lane, req in self._active.items()}
                     for lane in snapshot:
                         self._lane_pos[lane] += self.STREAM_CHUNK
                     self._inflight += 1
@@ -795,11 +1246,11 @@ class LlmModel(ServedModel):
                 with self._sched_cv:
                     if self._gen != gen:
                         return
-                    for lane, req in payload.items():
+                    for lane, (req, steps, row) in payload.items():
                         if self._active.get(lane) is not req:
                             continue  # lane re-assigned since dispatch
                         alive = True
-                        for token in ids[:, lane]:
+                        for token in ids[:steps, row]:
                             alive = self._deliver(lane, req, int(token))
                             if not alive:
                                 break
@@ -814,14 +1265,441 @@ class LlmModel(ServedModel):
         except Exception as e:  # noqa: BLE001
             self._crash("llm delivery failed: %s" % e, gen)
 
+    # -- paged scheduler -------------------------------------------------
+
+    def _page_wait_estimate_locked(self) -> float:
+        """Honest page-free-time estimate for the shed Retry-After:
+        the request-duration EWMA scaled by the queue's depth relative
+        to the lane count. Caller holds _sched_cv."""
+        base = self._ewma_request_s if self._ewma_request_s else 1.0
+        waiting = len(self._join_queue) + 1
+        return max(0.05, base * waiting / max(self._lanes, 1))
+
+    def _plan_admission(self, req: _GenRequest):
+        """Pages this join needs (worst case) and what the prefix
+        cache already holds. Returns None when the pool cannot cover
+        the reservation yet. Caller holds _sched_cv."""
+        ps = self._page_size
+        n = len(req.prompt)
+        hashes = req.page_hashes
+        # Never share the FINAL full page of an exactly page-aligned
+        # prompt: its last-row logits seed the first token, so at
+        # least one prompt row must be recomputed.
+        shareable = len(hashes) - (1 if n % ps == 0 else 0)
+        hits, newly_pinned = self._pool.peek_chain(hashes,
+                                                   max(shareable, 0))
+        total_slots = min(n + max(req.max_tokens - 1, 0),
+                          self.cfg.max_seq)
+        need = -(-total_slots // ps) - hits
+        if not self._pool.can_admit(need, newly_pinned):
+            return None
+        return {"hashes": hashes, "hits": hits, "need": need}
+
+    def _commit_admission(self, lane: int, req: _GenRequest,
+                          plan: dict):
+        """Caller holds _sched_cv."""
+        shared = self._pool.attach(plan["hashes"][:plan["hits"]])
+        self._pool.reserve(plan["need"])
+        self._lane_pages[lane] = list(shared)
+        self._lane_reserved[lane] = plan["need"]
+        self._lane_steps_left[lane] = max(req.max_tokens - 1, 0)
+        self._kv_counters["prefix_hits_total"] += plan["hits"]
+        self._note_pages_peak()
+        self._joining.append(req)
+
+    def _note_pages_peak(self):
+        used = self._pool.lane_held + self._pool.shared_live
+        if used > self._kv_counters["pages_used_peak"]:
+            self._kv_counters["pages_used_peak"] = used
+
+    def _expire_queued_joins(self):
+        """Fails queued joins whose PR-2-style queue deadline passed
+        while waiting for pages. Caller holds _sched_cv."""
+        now = time.monotonic_ns()
+        keep = []
+        for req in self._join_queue:
+            if req.cancelled:
+                req.finish()
+            elif req.deadline_ns is not None and now > req.deadline_ns:
+                self._kv_counters["expired_total"] += 1
+                req.fail("model '%s': deadline exceeded waiting for KV "
+                         "pages" % self.name,
+                         status="DEADLINE_EXCEEDED")
+            else:
+                keep.append(req)
+        self._join_queue[:] = keep
+
+    def _next_deadline_delta_s(self) -> Optional[float]:
+        """Seconds until the earliest queued-join deadline (the paged
+        scheduler's idle-wait bound). Caller holds _sched_cv."""
+        deadlines = [req.deadline_ns for req in self._join_queue
+                     if req.deadline_ns is not None]
+        if not deadlines:
+            return None
+        return max((min(deadlines) - time.monotonic_ns()) / 1e9, 0.01)
+
+    def _admit_joins(self):
+        """Pops admissible joins FIFO (strict order: a big join at the
+        head is not overtaken — it would starve under a stream of
+        small ones). Caller holds _sched_cv."""
+        joins = []
+        while self._join_queue and self._free_lanes:
+            req = self._join_queue[0]
+            if req.cancelled:
+                self._join_queue.pop(0)
+                req.finish()
+                continue
+            plan = self._plan_admission(req)
+            if plan is None:
+                break  # pages unavailable: wait (bounded by deadline)
+            self._join_queue.pop(0)
+            lane = self._free_lanes.pop(0)
+            self._commit_admission(lane, req, plan)
+            joins.append((lane, req, plan))
+        return joins
+
+    def _scheduler_loop_paged(self, gen: int):
+        """Dispatch side of the paged decode pipeline. Each pass:
+        admit joins (page-pool admission control), dispatch one decode
+        chunk across every decodable lane, then at most ONE bounded
+        prefill chunk — chunked prefill interleaves 1:1 with decode so
+        a long-prompt join never spikes active streams' ITL the way
+        the dense arm's all-at-once prefill dispatch does."""
+        try:
+            while True:
+                with self._sched_cv:
+                    if self._sched_stop or self._gen != gen:
+                        return
+                    self._expire_queued_joins()
+                    joins = self._admit_joins()
+                progressed = False
+                if joins:
+                    self._dispatch_joins_paged(joins, gen)
+                    progressed = True
+                with self._sched_cv:
+                    if self._sched_stop or self._gen != gen:
+                        return
+                progressed |= self._dispatch_decode_paged(gen)
+                with self._sched_cv:
+                    if self._sched_stop or self._gen != gen:
+                        return
+                progressed |= self._dispatch_prefill_chunk(gen)
+                with self._sched_cv:
+                    if self._sched_stop or self._gen != gen:
+                        return
+                    if not progressed:
+                        self._sched_cv.wait(
+                            timeout=self._next_deadline_delta_s())
+        except Exception as e:  # noqa: BLE001 — fail all riders loudly
+            self._crash("llm scheduler failed: %s" % e, gen)
+
+    def _dispatch_joins_paged(self, joins, gen: int):
+        """Routes admitted joins: short prompts with no prefix hit go
+        through ONE batched scratch prefill + page pack (bounded by
+        prefill_chunk, so it cannot spike ITL); long prompts and
+        prefix-hit prompts become chunked prefill jobs (the chunk
+        kernel gathers shared pages from the pool)."""
+        batched = []
+        with self._sched_cv:
+            if self._sched_stop or self._gen != gen:
+                return
+            for lane, req, plan in joins:
+                if (plan["hits"] == 0
+                        and len(req.prompt) <= self._prefill_chunk):
+                    batched.append((lane, req, plan))
+                else:
+                    self._prefill_jobs.append(_PrefillJob(
+                        lane, req, req.prompt,
+                        plan["hits"] * self._page_size,
+                        plan["hashes"]))
+        if batched:
+            self._dispatch_batched_prefill(batched, gen)
+
+    def _activate_lane_locked(self, lane: int, req: _GenRequest):
+        """Transition admitted -> active. Caller holds _sched_cv."""
+        self._lane_pos[lane] = len(req.prompt)
+        self._active[lane] = req
+        if req in self._joining:
+            self._joining.remove(req)
+
+    def _register_prompt_pages_locked(self, lane: int,
+                                      hashes: List[bytes]):
+        """Publishes the lane's full prompt pages into the prefix
+        index (they become shared/copy-on-write and outlive the lane
+        as evictable cache entries)."""
+        for i, digest in enumerate(hashes):
+            if i < len(self._lane_pages[lane]):
+                self._pool.register(digest, self._lane_pages[lane][i])
+
+    def _dispatch_batched_prefill(self, group, gen: int):
+        """Batched scratch prefill for short no-prefix-hit joins:
+        prompts sharing a padded bucket run through ONE prefill
+        dispatch into a bucket-sized scratch cache, which is then
+        packed into each lane's freshly allocated pages."""
+        ps = self._page_size
+        groups: Dict[int, list] = {}
+        for lane, req, plan in group:
+            bucket = 16
+            while bucket < len(req.prompt):
+                bucket *= 2
+            groups.setdefault(bucket, []).append((lane, req, plan))
+        batches = []
+        for bucket, entries in groups.items():
+            b = 1
+            while b < len(entries):
+                b *= 2
+            compiled = self._get_prefill_exec(b, bucket)
+            if compiled is None:
+                one = self._get_prefill_exec(1, bucket)
+                batches.extend((bucket, 1, one, [entry])
+                               for entry in entries)
+            else:
+                batches.append((bucket, b, compiled, entries))
+        for bucket, b, compiled, entries in batches:
+            padded = np.full((b, bucket), PAD, dtype=np.int32)
+            lens = np.ones((b,), dtype=np.int32)
+            sentinel = self._num_pages * ps
+            dest = np.full((b * bucket,), sentinel, dtype=np.int32)
+            with self._sched_cv:
+                if self._sched_stop or self._gen != gen:
+                    return
+                for row, (lane, req, plan) in enumerate(entries):
+                    n = len(req.prompt)
+                    padded[row, :n] = req.prompt
+                    lens[row] = n
+                    pages = self._pool.alloc(-(-n // ps))
+                    self._lane_reserved[lane] -= len(pages)
+                    self._lane_pages[lane].extend(pages)
+                    for i in range(n):
+                        dest[row * bucket + i] = pages[i // ps] * ps \
+                            + i % ps
+                self._note_pages_peak()
+                pool = self._pool_dev
+                tokens_dev = self._tokens_dev
+                done_dev = self._done_dev
+            firsts, scratch = compiled(
+                self._params, jnp.asarray(padded),
+                init_cache(self.cfg, b, length=bucket),
+                jnp.asarray(lens))
+            pool = self._pack_pages(pool, scratch, jnp.asarray(dest))
+            lanes_idx = jnp.asarray(
+                np.array([lane for lane, _, _ in entries],
+                         dtype=np.int32))
+            tokens_dev, done_dev = self._join_lanes(
+                tokens_dev, done_dev, lanes_idx, firsts[:len(entries)])
+            fut = self._fetch_pool.submit(np.asarray,
+                                          firsts[:len(entries)])
+            with self._sched_cv:
+                if self._sched_stop or self._gen != gen:
+                    return  # riders already failed by crash/unload
+                self._pool_dev = pool
+                self._tokens_dev = tokens_dev
+                self._done_dev = done_dev
+                for lane, req, plan in entries:
+                    self._activate_lane_locked(lane, req)
+                    self._register_prompt_pages_locked(
+                        lane, plan["hashes"])
+                self._kv_counters["prefill_chunks_total"] += 1
+                self._delivery_queue.append(
+                    ("join", fut,
+                     [(lane, req) for lane, req, _ in entries]))
+                self._sched_cv.notify_all()
+
+    def _dispatch_prefill_chunk(self, gen: int) -> bool:
+        """Runs ONE bounded chunk of the oldest prefill job. Returns
+        True when a dispatch happened."""
+        ps = self._page_size
+        chunk = self._prefill_chunk
+        with self._sched_cv:
+            if not self._prefill_jobs:
+                return False
+            job = self._prefill_jobs[0]
+            if job.req.cancelled:
+                self._prefill_jobs.pop(0)
+                job.req.finish()
+                if job.req in self._joining:
+                    self._joining.remove(job.req)
+                self._free_lane_pages(job.lane)
+                self._free_lanes.append(job.lane)
+                self._sched_cv.notify_all()
+                return True
+            n = len(job.prompt)
+            tc = min(chunk, n - job.done_tokens)
+            start = job.done_tokens
+            need = -(-(start + tc) // ps) - len(self._lane_pages[job.lane])
+            if need > 0:
+                pages = self._pool.alloc(need)
+                self._lane_reserved[job.lane] -= need
+                self._lane_pages[job.lane].extend(pages)
+                self._note_pages_peak()
+            lane_pages = list(self._lane_pages[job.lane])
+            pool = self._pool_dev
+        sentinel = self._num_pages * ps
+        tokens_chunk = np.full((1, chunk), PAD, dtype=np.int32)
+        tokens_chunk[0, :tc] = job.prompt[start:start + tc]
+        positions = (start + np.arange(chunk)).astype(np.int32)
+        dest = np.full((chunk,), sentinel, dtype=np.int32)
+        for i in range(tc):
+            pos = start + i
+            dest[i] = lane_pages[pos // ps] * ps + pos % ps
+        p_bucket = 1
+        while p_bucket < len(lane_pages):
+            p_bucket *= 2
+        tables = np.zeros((1, p_bucket), dtype=np.int32)
+        tables[0, :len(lane_pages)] = lane_pages
+        first_dev, pool = self._paged_prefill(
+            self._params, jnp.asarray(tokens_chunk),
+            jnp.asarray(positions), jnp.asarray(dest),
+            np.int32(tc - 1), jnp.asarray(tables), pool)
+        with self._sched_cv:
+            if self._sched_stop or self._gen != gen:
+                return True
+            self._pool_dev = pool
+            job.done_tokens += tc
+            self._kv_counters["prefill_chunks_total"] += 1
+            if job.done_tokens < n:
+                return True
+            self._prefill_jobs.pop(0)
+            tokens_dev = self._tokens_dev
+            done_dev = self._done_dev
+        tokens_dev, done_dev = self._join_lanes(
+            tokens_dev, done_dev,
+            jnp.asarray(np.array([job.lane], dtype=np.int32)),
+            first_dev)
+        fut = self._fetch_pool.submit(np.asarray, first_dev)
+        with self._sched_cv:
+            if self._sched_stop or self._gen != gen:
+                return True
+            self._tokens_dev = tokens_dev
+            self._done_dev = done_dev
+            self._activate_lane_locked(job.lane, job.req)
+            self._register_prompt_pages_locked(job.lane, job.hashes)
+            self._delivery_queue.append(
+                ("join", fut, [(job.lane, job.req)]))
+            self._sched_cv.notify_all()
+        return True
+
+    def _dispatch_decode_paged(self, gen: int) -> bool:
+        """One decode chunk across every decodable lane, compacted to
+        a power-of-two batch and a power-of-two block-table width (so
+        attention cost follows the LONGEST LIVE sequence, not
+        max_seq). Returns True when a dispatch happened."""
+        ps = self._page_size
+        reaped = False
+        with self._sched_cv:
+            if (not self._active or self._pool_dev is None
+                    or self._inflight >= self.MAX_INFLIGHT):
+                return False
+            rows = []
+            for lane in sorted(self._active):
+                req = self._active[lane]
+                if req.cancelled:
+                    # Cancel lands here, not at the next chunk
+                    # boundary: the lane and its pages free NOW. This
+                    # counts as progress — the freed pages may admit a
+                    # queued join, so the loop must re-run admission
+                    # instead of sleeping to that join's deadline.
+                    req.finish()
+                    self._release_lane(lane)
+                    reaped = True
+                    continue
+                steps = min(self.STREAM_CHUNK,
+                            self._lane_steps_left[lane],
+                            self.cfg.max_seq - self._lane_pos[lane])
+                if steps <= 0:
+                    continue  # budget spent; awaiting delivery/finish
+                rows.append((lane, req, steps))
+            if not rows:
+                return reaped
+            for lane, req, steps in rows:
+                need = (-(-(self._lane_pos[lane] + steps) // ps)
+                        - len(self._lane_pages[lane]))
+                if need > 0:
+                    pages = self._pool.alloc(need)
+                    self._lane_reserved[lane] -= need
+                    self._lane_pages[lane].extend(pages)
+            self._note_pages_peak()
+            b_prime = 1
+            while b_prime < len(rows):
+                b_prime *= 2
+            p_bucket = 1
+            p_need = max(len(self._lane_pages[lane])
+                         for lane, _, _ in rows)
+            while p_bucket < p_need:
+                p_bucket *= 2
+            sel = np.zeros((b_prime,), dtype=np.int32)
+            scatter_idx = np.full((b_prime,), self._lanes,
+                                  dtype=np.int32)
+            pos = np.zeros((b_prime,), dtype=np.int32)
+            limit = np.zeros((b_prime,), dtype=np.int32)
+            eos_stop = np.zeros((b_prime,), dtype=bool)
+            tables = np.zeros((b_prime, p_bucket), dtype=np.int32)
+            payload = {}
+            for row, (lane, req, steps) in enumerate(rows):
+                sel[row] = lane
+                scatter_idx[row] = lane
+                pos[row] = self._lane_pos[lane]
+                limit[row] = steps
+                eos_stop[row] = not req.ignore_eos
+                tables[row, :len(self._lane_pages[lane])] = \
+                    self._lane_pages[lane]
+                payload[lane] = (req, steps, row)
+            params = self._params
+            tokens_dev = self._tokens_dev
+            done_dev = self._done_dev
+            pool = self._pool_dev
+        tok_c, done_c = self._gather_lanes(tokens_dev, done_dev,
+                                           jnp.asarray(sel))
+        emitted, tok_o, done_o, pool = self._paged_decode(
+            params, tok_c, jnp.asarray(pos), jnp.asarray(limit),
+            jnp.asarray(eos_stop), done_c, jnp.asarray(tables), pool)
+        tokens_dev, done_dev = self._scatter_lanes(
+            tokens_dev, done_dev, jnp.asarray(scatter_idx), tok_o,
+            done_o)
+        fut = self._fetch_pool.submit(np.asarray, emitted)
+        with self._sched_cv:
+            if self._sched_stop or self._gen != gen:
+                # A concurrent _crash/unload reset the pipeline while
+                # this dispatch ran unlocked (see the dense loop's
+                # comment) — drop the stale record.
+                return True
+            self._pool_dev = pool
+            self._tokens_dev = tokens_dev
+            self._done_dev = done_dev
+            for lane, (req, steps, row) in payload.items():
+                self._lane_pos[lane] += steps
+                self._lane_steps_left[lane] -= steps
+            self._inflight += 1
+            self._delivery_queue.append(("chunk", fut, payload))
+            self._sched_cv.notify_all()
+        return True
+
+    def kv_stats(self) -> Optional[dict]:
+        """Paged-cache accounting for /metrics (``tpu_kv_*`` /
+        ``tpu_prefill_*`` families) and the bench/smoke leak gates.
+        None on the dense arm."""
+        if not self._paged:
+            return None
+        with self._sched_cv:
+            if self._pool is None:
+                snap = {"pages_total": self._num_pages, "pages_used": 0,
+                        "pages_cached": 0, "pages_free": self._num_pages,
+                        "pages_reserved": 0}
+            else:
+                snap = self._pool.snapshot()
+            snap.update(self._kv_counters)
+            return snap
+
     def _collect_riders(self):
         """Every request the pipeline still owes tokens to: active
-        lanes, queued joins, and requests riding undelivered records.
-        Caller holds _sched_cv."""
-        riders = list(self._active.values()) + self._join_queue
+        lanes, queued joins, admitted-but-not-yet-active joins (paged
+        batched prefills in dispatch + chunked prefill jobs), and
+        requests riding undelivered records. Caller holds _sched_cv."""
+        riders = (list(self._active.values()) + self._join_queue
+                  + list(self._joining))
         for _, _, payload in self._delivery_queue:
             if isinstance(payload, dict):
-                riders.extend(payload.values())
+                riders.extend(entry[0] for entry in payload.values())
             else:
                 riders.extend(req for _, req in payload)
         return riders
@@ -844,9 +1722,24 @@ class LlmModel(ServedModel):
             self._lane_pos = [0] * self._lanes
             self._tokens_dev = None
             self._batched_cache = None
+            self._reset_paged_state()
             self._sched_thread = None
             self._delivery_thread = None
             self._sched_cv.notify_all()
+
+    def _reset_paged_state(self):
+        """Caller holds _sched_cv. A crash rebuilds the page pool from
+        scratch — the generation bump must not leak pages (the old
+        pool's host accounting and device arrays are dropped wholesale,
+        so accounting restarts at zero by construction)."""
+        self._prefill_jobs.clear()
+        self._joining.clear()
+        self._pool = None
+        self._pool_dev = None
+        self._done_dev = None
+        self._lane_pages = [[] for _ in range(self._lanes)]
+        self._lane_reserved = [0] * self._lanes
+        self._lane_steps_left = [0] * self._lanes
 
     def unload(self) -> None:
         with self._sched_cv:
@@ -856,6 +1749,8 @@ class LlmModel(ServedModel):
             self._active.clear()
             self._join_queue.clear()
             self._delivery_queue.clear()
+            self._prefill_jobs.clear()
+            self._joining.clear()
             self._inflight = 0
             self._sched_cv.notify_all()
         if self._sched_thread is not None:
@@ -881,12 +1776,63 @@ class LlmModel(ServedModel):
         prompt = self._tokenizer.encode(text)
         prompt = prompt[-(self.cfg.max_seq - max_tokens - 1):]
         request = _GenRequest(prompt, max_tokens, ignore_eos)
+        if self._paged:
+            request.page_hashes = prefix_page_hashes(prompt,
+                                                     self._page_size)
+        timeout_us = self._queue_timeout_s * 1e6
+        raw_timeout = (parameters or {}).get("timeout")
+        if raw_timeout is not None:
+            # PR-2 queue-policy semantics: 0 (or non-numeric) means
+            # "no per-request override", keeping the model default —
+            # matching the dynamic batcher's `timeout` coercion.
+            try:
+                value = float(raw_timeout)
+            except (TypeError, ValueError):
+                value = 0.0
+            if value > 0:
+                timeout_us = value
         with self._sched_cv:
             if self._sched_stop:
                 raise InferenceServerException(
                     "model '%s' is unloaded" % self.name,
                     status="UNAVAILABLE")
-            if self._batched_cache is None:
+            if self._paged:
+                worst_pages = -(-min(len(prompt) + max_tokens - 1,
+                                     self.cfg.max_seq)
+                                // self._page_size)
+                if worst_pages > self._num_pages:
+                    # Larger than the whole pool: no amount of waiting
+                    # admits it — reject immediately, not retryably.
+                    raise InferenceServerException(
+                        "model '%s': prompt + max_tokens needs %d KV "
+                        "pages but the pool holds %d"
+                        % (self.name, worst_pages, self._num_pages),
+                        status="INVALID_ARGUMENT")
+                # Page-exhaustion admission control: past the join
+                # watermark, shed at the door with an honest
+                # Retry-After estimating page-free time instead of
+                # queueing the request to die on its deadline.
+                if len(self._join_queue) >= self._join_watermark:
+                    self._kv_counters["shed_total"] += 1
+                    raise retryable_error(
+                        "model '%s': KV page pool saturated "
+                        "(%d joins already waiting for pages)"
+                        % (self.name, len(self._join_queue)),
+                        status="RESOURCE_EXHAUSTED",
+                        retry_after_s=self._page_wait_estimate_locked())
+                request.enqueue_ns = time.monotonic_ns()
+                request.deadline_ns = (request.enqueue_ns
+                                       + int(timeout_us * 1000))
+                if self._pool is None:
+                    self._pool = _PagePool(self._num_pages,
+                                           self._page_size)
+                if self._pool_dev is None:
+                    self._pool_dev = init_page_pool(
+                        self.cfg, self._num_pages, self._page_size)
+                if self._done_dev is None:
+                    self._done_dev = jnp.zeros((self._lanes,),
+                                               dtype=bool)
+            elif self._batched_cache is None:
                 self._batched_cache = init_cache(self.cfg, self._lanes)
             if self._tokens_dev is None:
                 self._tokens_dev = jnp.full(
@@ -909,7 +1855,7 @@ class LlmModel(ServedModel):
             request.cancelled = True
         if request.error is not None:
             raise InferenceServerException(request.error,
-                                           status="INTERNAL")
+                                           status=request.error_status)
 
     def infer_stream(self, inputs, parameters=None
                      ) -> Iterator[Dict[str, np.ndarray]]:
@@ -954,21 +1900,85 @@ class LlmModel(ServedModel):
         # insert per prefill batch, token scatter per join-group size)
         # also compile per shape — prime them too, or the first
         # concurrent join round stalls every stream for the compile.
-        try:
-            for b in pow2s:
-                scratch = self._lane_insert_row(
-                    init_cache(self.cfg, self._lanes),
-                    init_cache(self.cfg, b), np.int32(0), np.int32(0))
-                del scratch
-            toks = jnp.full((self._lanes,), PAD, dtype=jnp.int32)
-            for g in range(1, self._lanes + 1):
-                toks = self._set_lane_tokens(
-                    toks, jnp.arange(g, dtype=jnp.int32),
-                    jnp.full((g,), PAD, dtype=jnp.int32))
-            del toks
-        except Exception:  # noqa: BLE001 — warmup best-effort
-            pass
+        if self._paged:
+            self._warmup_paged(pow2s)
+        else:
+            try:
+                for b in pow2s:
+                    scratch = self._lane_insert_row(
+                        init_cache(self.cfg, self._lanes),
+                        init_cache(self.cfg, b), np.int32(0),
+                        np.int32(0))
+                    del scratch
+                toks = jnp.full((self._lanes,), PAD, dtype=jnp.int32)
+                for g in range(1, self._lanes + 1):
+                    toks = self._set_lane_tokens(
+                        toks, jnp.arange(g, dtype=jnp.int32),
+                        jnp.full((g,), PAD, dtype=jnp.int32))
+                del toks
+            except Exception:  # noqa: BLE001 — warmup best-effort
+                pass
         list(self.infer_stream({
             "text_input": np.array([b"hi"], dtype=np.object_),
             "max_tokens": np.array([2], dtype=np.int32),
         }))
+
+    def _warmup_paged(self, pow2s):
+        """Primes the paged kernels' common shape buckets on a
+        throwaway pool: decode chunks per (compact batch, table
+        width), the prefill chunk kernel, the pack kernel, and the
+        lane gather/scatter helpers — an inline XLA compile
+        mid-serving would stall every active token stream."""
+        try:
+            ps = self._page_size
+            p_buckets = []
+            p = 1
+            while p <= self._pages_per_seq:
+                p_buckets.append(p)
+                p *= 2
+            p_buckets = p_buckets[:4]  # short-context buckets dominate
+            pool = init_page_pool(self.cfg, self._num_pages, ps)
+            for b_prime in {1, self._lanes}:
+                for p_bucket in p_buckets:
+                    zeros = np.zeros((b_prime,), dtype=np.int32)
+                    _, _, _, pool = self._paged_decode(
+                        self._params, jnp.asarray(zeros),
+                        jnp.asarray(zeros), jnp.asarray(zeros),
+                        jnp.zeros((b_prime,), dtype=bool),
+                        jnp.zeros((b_prime,), dtype=bool),
+                        jnp.zeros((b_prime, p_bucket), dtype=jnp.int32),
+                        pool)
+            for p_bucket in p_buckets:
+                sentinel = np.full((self._prefill_chunk,),
+                                   self._num_pages * ps,
+                                   dtype=np.int32)
+                _, pool = self._paged_prefill(
+                    self._params,
+                    jnp.full((1, self._prefill_chunk), PAD,
+                             dtype=jnp.int32),
+                    jnp.arange(self._prefill_chunk, dtype=jnp.int32),
+                    jnp.asarray(sentinel), np.int32(0),
+                    jnp.zeros((1, p_bucket), dtype=jnp.int32), pool)
+            for b in pow2s:
+                for bucket in sorted({min(16, self.cfg.max_seq),
+                                      min(64, self.cfg.max_seq)}):
+                    pool = self._pack_pages(
+                        pool, init_cache(self.cfg, b, length=bucket),
+                        jnp.full((b * bucket,), self._num_pages * ps,
+                                 dtype=jnp.int32))
+            toks = jnp.full((self._lanes,), PAD, dtype=jnp.int32)
+            done = jnp.zeros((self._lanes,), dtype=bool)
+            for b_prime in {1, self._lanes}:
+                idx = jnp.zeros((b_prime,), dtype=jnp.int32)
+                tok_c, done_c = self._gather_lanes(toks, done, idx)
+                toks, done = self._scatter_lanes(
+                    toks, done,
+                    jnp.full((b_prime,), self._lanes, dtype=jnp.int32),
+                    tok_c, done_c)
+            for g in {1, min(2, self._lanes), self._lanes}:
+                toks, done = self._join_lanes(
+                    toks, done, jnp.zeros((g,), dtype=jnp.int32),
+                    jnp.full((g,), PAD, dtype=jnp.int32))
+            del pool, toks, done
+        except Exception:  # noqa: BLE001 — warmup best-effort
+            pass

@@ -20,11 +20,17 @@ def extra_model_factories(repository=None) -> Dict[str, Callable[[], ServedModel
     factories: Dict[str, Callable[[], ServedModel]] = {
         "resnet50": ResNetModel,
         "bert_base": BertModel,
-        "llm_tiny": lambda: LlmModel(name="llm_tiny"),
+        # Paged KV cache (docs/llm_serving.md): 32 decode lanes over a
+        # page pool sized at ~25% of the dense worst case
+        # (lanes x max_seq) — HBM follows live tokens, and admission
+        # control sheds honestly past the pool instead of OOMing.
+        "llm_tiny": lambda: LlmModel(name="llm_tiny", decode_lanes=32,
+                                     kv_pages=512),
         "llm_small": lambda: LlmModel(
             name="llm_small",
             cfg=LlmConfig(d_model=512, n_layers=8, n_heads=8, n_kv_heads=4,
                           d_ff=1408, max_seq=2048),
+            decode_lanes=32, kv_pages=1024,
         ),
         "preprocess": PreprocessModel,
         "postprocess": PostprocessModel,

@@ -1288,6 +1288,221 @@ class PipelineSampler:
                     self.max_inflight.get(name, 0), snap["inflight_count"])
 
 
+# Continuous-batching A/B config (tools/llm_smoke.py shares it): an
+# attention-dominated model with a LONG configured context, because
+# that is the dense arm's honest cost — a dense lane reserves (and
+# attends over) max_seq every step regardless of actual sequence
+# length, which is exactly why decode_lanes was capped at 4. The paged
+# arm's block tables bucket attention to the longest LIVE sequence.
+LLM_CONTINUOUS_CFG = dict(d_model=64, n_layers=2, n_heads=4,
+                          n_kv_heads=2, d_ff=128, max_seq=8192)
+LLM_CONTINUOUS_SYS = ("System: you are a terse benchmark assistant. "
+                      "Answer briefly. ")
+LLM_CONTINUOUS_MAX_TOKENS = 48
+
+
+def _llm_closed_loop(model, concurrency: int, n_requests: int,
+                     max_tokens: int = LLM_CONTINUOUS_MAX_TOKENS) -> dict:
+    """Closed-loop generate driver against the model's scheduler
+    (client-observed TTFT/ITL; every request carries the shared system
+    prompt so the paged arm's prefix cache is exercised)."""
+    import numpy as np
+
+    lock = threading.Lock()
+    ttfts: list = []
+    gaps: list = []
+    tokens = [0]
+    work = list(range(n_requests))
+
+    def worker():
+        while True:
+            with lock:
+                if not work:
+                    return
+                i = work.pop()
+            prompt = (LLM_CONTINUOUS_SYS
+                      + "Question %d about topic %d?" % (i, i * 7))
+            t0 = time.monotonic()
+            last = t0
+            got = 0
+            for _ in model._generate(
+                    {"text_input": np.array([prompt.encode()],
+                                            dtype=np.object_),
+                     "max_tokens": np.array([max_tokens],
+                                            dtype=np.int32),
+                     "ignore_eos": np.array([True])}, {}):
+                now = time.monotonic()
+                with lock:
+                    if got == 0:
+                        ttfts.append(now - t0)
+                    else:
+                        gaps.append(now - last)
+                last = now
+                got += 1
+            with lock:
+                tokens[0] += got
+
+    t_start = time.monotonic()
+    threads = [threading.Thread(target=worker)
+               for _ in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t_start
+
+    def pct(values, q):
+        ordered = sorted(values)
+        if not ordered:
+            return 0.0
+        return ordered[min(int(len(ordered) * q), len(ordered) - 1)]
+
+    return {
+        "tokens_per_sec": round(tokens[0] / wall, 1) if wall else 0.0,
+        "ttft_p50_ms": round(pct(ttfts, 0.50) * 1e3, 2),
+        "ttft_p99_ms": round(pct(ttfts, 0.99) * 1e3, 2),
+        "itl_p50_ms": round(pct(gaps, 0.50) * 1e3, 3),
+        "itl_p99_ms": round(pct(gaps, 0.99) * 1e3, 2),
+        "wall_s": round(wall, 2),
+    }
+
+
+def _llm_token_parity(dense, paged, max_tokens: int = 12) -> bool:
+    """Greedy paged decode must be token-exact vs the dense arm —
+    across the batched short-prompt prefill, the chunked long-prompt
+    prefill, and a prefix-cache-hit prompt."""
+    import numpy as np
+
+    prompts = [
+        b"short parity prompt",
+        (LLM_CONTINUOUS_SYS + "chunked prefill parity check " * 4
+         ).encode(),
+        (LLM_CONTINUOUS_SYS + "prefix hit parity tail").encode(),
+    ]
+
+    def run(model, prompt):
+        return [t for t in model._generate(
+            {"text_input": np.array([prompt], dtype=np.object_),
+             "max_tokens": np.array([max_tokens], dtype=np.int32),
+             "ignore_eos": np.array([True])}, {})]
+
+    return all(run(dense, p) == run(paged, p) for p in prompts)
+
+
+def _llm_chaos_pass(paged) -> bool:
+    """Cancel mid-stream + one forced crash-recovery: the page pool
+    must come back leak-free (the acceptance gate's cancel/crash
+    arm). Returns True when a post-crash request completes."""
+    import numpy as np
+
+    from client_tpu.utils import InferenceServerException
+
+    def start(prompt, max_tokens):
+        return paged._generate(
+            {"text_input": np.array([prompt], dtype=np.object_),
+             "max_tokens": np.array([max_tokens], dtype=np.int32),
+             "ignore_eos": np.array([True])}, {})
+
+    gen = start(b"cancelled mid-stream request", 40)
+    next(gen)
+    gen.close()
+
+    real = paged._paged_decode
+    state = {"armed": True}
+
+    def exploding(*args, **kwargs):
+        if state["armed"]:
+            state["armed"] = False
+            raise RuntimeError("injected device failure")
+        return real(*args, **kwargs)
+
+    paged._paged_decode = exploding
+    try:
+        list(start(b"crash victim", 16))
+    except InferenceServerException:
+        pass
+    finally:
+        paged._paged_decode = real
+    try:
+        return len(list(start(b"post crash recovery", 4))) == 4
+    except InferenceServerException:
+        return False
+
+
+def _llm_pool_drained(paged, timeout_s: float = 30.0) -> dict:
+    """Waits for in-flight chunks to deliver, then snapshots the pool
+    (leak gate: pages_used and pages_reserved must be 0)."""
+    deadline = time.monotonic() + timeout_s
+    snap = paged.kv_stats()
+    while time.monotonic() < deadline and (
+            snap["pages_used"] or snap["pages_reserved"]):
+        time.sleep(0.05)
+        snap = paged.kv_stats()
+    return snap
+
+
+def run_llm_continuous_measure(concurrencies=(4, 16),
+                               paged_lanes: int = 0,
+                               requests_per_worker: int = 4,
+                               chaos: bool = True) -> dict:
+    """Paged-KV continuous-batching A/B (ROADMAP item 2's measured
+    form): a dense-arm c4 baseline (`paged_kv=False`, 4 lanes — the
+    pre-paged ceiling) against the paged arm at each concurrency in
+    ``concurrencies``. Both arms run the same closed-loop workload
+    with a shared system prompt. Reports tokens/s + client TTFT/ITL
+    per arm, paged pool peak/prefix-hit accounting, token parity, and
+    the post-chaos leak check."""
+    from client_tpu.models.llm import LlmConfig, LlmModel
+
+    cfg = LlmConfig(**LLM_CONTINUOUS_CFG)
+    lanes = paged_lanes or max(concurrencies)
+    pages_per_seq_live = 8  # ~ (prompt + max_tokens) / page_size
+    dense = LlmModel(name="llm_dense_ab", cfg=cfg, paged_kv=False,
+                     decode_lanes=4)
+    dense.warmup()
+    paged = LlmModel(name="llm_paged_ab", cfg=cfg, paged_kv=True,
+                     decode_lanes=lanes, page_size=16,
+                     kv_pages=max(lanes * pages_per_seq_live, 64))
+    paged.warmup()
+
+    out: dict = {
+        "max_tokens": LLM_CONTINUOUS_MAX_TOKENS,
+        "paged_lanes": lanes,
+        "kv_pages": paged._num_pages,
+        "dense_equivalent_pages": 4 * paged._pages_per_seq,
+        "token_parity": _llm_token_parity(dense, paged),
+    }
+    # Warm pass per arm: every (compact batch, table width) XLA bucket
+    # the measured pass will touch compiles here, not mid-measurement.
+    _llm_closed_loop(dense, 4, 8)
+    _llm_closed_loop(paged, max(concurrencies), 2 * max(concurrencies))
+
+    base = _llm_closed_loop(dense, 4, 4 * requests_per_worker)
+    out["dense_c4"] = base
+    for conc in concurrencies:
+        run = _llm_closed_loop(paged, conc,
+                               conc * requests_per_worker)
+        snap = paged.kv_stats()
+        run["pages_used_peak"] = snap["pages_used_peak"]
+        run["prefix_hits_total"] = snap["prefix_hits_total"]
+        out["paged_c%d" % conc] = run
+        if base["tokens_per_sec"]:
+            run["speedup_vs_dense_c4"] = round(
+                run["tokens_per_sec"] / base["tokens_per_sec"], 2)
+        if base["itl_p99_ms"]:
+            run["itl_p99_vs_dense_c4"] = round(
+                run["itl_p99_ms"] / base["itl_p99_ms"], 2)
+    if chaos:
+        out["chaos_recovered"] = _llm_chaos_pass(paged)
+    final = _llm_pool_drained(paged)
+    out["pages_used_final"] = final["pages_used"]
+    out["pages_reserved_final"] = final["pages_reserved"]
+    out["prefill_chunks_total"] = final["prefill_chunks_total"]
+    dense.unload()
+    paged.unload()
+    return out
+
+
 def run_python_harness(model: str, batch: int, concurrency: int,
                        shared_memory: str, output_shm: int,
                        core=None, address: str = "",
@@ -2286,6 +2501,38 @@ def main() -> None:
                 % (llm_stage.get("ttft_ms"), llm_stage.get("itl_ms")))
         except Exception as exc:  # noqa: BLE001
             log("genai stage failed: %s" % exc)
+
+    # Config 5b: paged-KV continuous batching A/B (ROADMAP item 2).
+    # Dense c4 baseline vs the paged arm at c4/c16 (c64 when budget
+    # allows): tokens/s, TTFT/ITL, pages-used peak, prefix hit ratio,
+    # token parity, and the cancel+crash leak check.
+    if remaining() > 150 and stage_wanted("llm_continuous"):
+        try:
+            concs = (4, 16, 64) if remaining() > 300 else (4, 16)
+            extra = run_with_watchdog(
+                "llm_continuous measure",
+                lambda: run_llm_continuous_measure(concurrencies=concs),
+                min(420.0, max(120.0, remaining() - 30)))
+            top = extra.get("paged_c%d" % max(concs), {})
+            record_stage("llm_continuous",
+                         top.get("tokens_per_sec", 0.0),
+                         top.get("itl_p50_ms", 0.0) * 1000.0, extra)
+            log("llm_continuous: dense c4 %.0f tok/s; paged %s; "
+                "parity=%s leak=%d"
+                % (extra.get("dense_c4", {}).get("tokens_per_sec", 0),
+                   ", ".join(
+                       "c%d %.0f tok/s (%.1fx, itl p99 %.2fx)"
+                       % (c,
+                          extra["paged_c%d" % c]["tokens_per_sec"],
+                          extra["paged_c%d" % c].get(
+                              "speedup_vs_dense_c4", 0.0),
+                          extra["paged_c%d" % c].get(
+                              "itl_p99_vs_dense_c4", 0.0))
+                       for c in concs if ("paged_c%d" % c) in extra),
+                   extra.get("token_parity"),
+                   extra.get("pages_used_final", -1)))
+        except Exception as exc:  # noqa: BLE001
+            log("llm_continuous failed: %s" % exc)
 
     # Reconcile the probe label with the final relay state: a stall
     # that later recovered (stages ran) must not read as "model stages

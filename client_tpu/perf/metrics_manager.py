@@ -71,6 +71,12 @@ class TpuMetrics:
     hist_count: Dict[str, Dict[str, float]] = field(default_factory=dict)
     stream_responses_total: Dict[str, float] = field(
         default_factory=dict)
+    # Paged-KV-cache families (docs/llm_serving.md): pool occupancy
+    # gauges per model, prefix-hit / prefill-chunk counters.
+    kv_pages_used: Dict[str, float] = field(default_factory=dict)
+    kv_pages_total: Dict[str, float] = field(default_factory=dict)
+    kv_prefix_hits_total: Dict[str, float] = field(default_factory=dict)
+    prefill_chunks_total: Dict[str, float] = field(default_factory=dict)
 
 
 _FAMILIES = {
@@ -99,6 +105,10 @@ _FAMILIES = {
     "tpu_replica_redispatch_total": "replica_redispatch_total",
     "tpu_replica_exec_us": "replica_exec_us",
     "tpu_stream_responses_total": "stream_responses_total",
+    "tpu_kv_pages_used": "kv_pages_used",
+    "tpu_kv_pages_total": "kv_pages_total",
+    "tpu_kv_prefix_hits_total": "kv_prefix_hits_total",
+    "tpu_prefill_chunks_total": "prefill_chunks_total",
 }
 
 # Histogram families (telemetry layer): the scraper folds their
@@ -124,6 +134,7 @@ _COUNTER_FAMILIES = frozenset((
     "replica_ejected_total", "replica_readmitted_total",
     "replica_redispatch_total", "replica_exec_us",
     "stream_responses_total",
+    "kv_prefix_hits_total", "prefill_chunks_total",
 ))
 
 
@@ -282,7 +293,7 @@ def summarize_metrics(snapshots: List[TpuMetrics]) -> Dict[str, Dict[str, float]
                  "sequence_active", "sequence_backlog",
                  "cache_size_bytes", "cache_entries",
                  "priority_queue_size", "replica_healthy",
-                 "replica_count"):
+                 "replica_count", "kv_pages_used", "kv_pages_total"):
         values = []
         for snap in snapshots:
             per_device = getattr(snap, attr)

@@ -866,6 +866,43 @@ class InferenceServerCore:
                "Cumulative successful execution time per replica",
                exec_rows)
 
+        kv_used_rows, kv_total_rows = [], []
+        kv_hit_rows, prefill_rows = [], []
+        for model in self.repository.ready_models():
+            stats_fn = getattr(model, "kv_stats", None)
+            if stats_fn is None:
+                continue
+            try:
+                snap = stats_fn()
+            except Exception:  # noqa: BLE001 — metrics never take
+                continue  # the server down
+            if not snap:
+                continue  # dense A/B arm: no paged pool to report
+            label = '{model="%s"}' % model.name
+            kv_used_rows.append("tpu_kv_pages_used%s %d"
+                                % (label, snap["pages_used"]))
+            kv_total_rows.append("tpu_kv_pages_total%s %d"
+                                 % (label, snap["pages_total"]))
+            kv_hit_rows.append("tpu_kv_prefix_hits_total%s %d"
+                               % (label, snap["prefix_hits_total"]))
+            prefill_rows.append("tpu_prefill_chunks_total%s %d"
+                                % (label, snap["prefill_chunks_total"]))
+        family("tpu_kv_pages_used", "gauge",
+               "Paged-KV-cache pages held by live decode lanes "
+               "(private pages + shared prefix pages pinned by a "
+               "lane; prefix-cache-only pages are evictable and not "
+               "counted)", kv_used_rows)
+        family("tpu_kv_pages_total", "gauge",
+               "Configured paged-KV-cache page-pool capacity",
+               kv_total_rows)
+        family("tpu_kv_prefix_hits_total", "counter",
+               "Prompt pages served from the shared prefix cache "
+               "(content-hashed full pages, copy-on-write) instead of "
+               "being prefilled", kv_hit_rows)
+        family("tpu_prefill_chunks_total", "counter",
+               "LLM prefill dispatches (bounded chunked-prefill "
+               "chunks + batched short-prompt prefills)", prefill_rows)
+
         used_rows, total_rows, util_rows = [], [], []
         try:
             import jax

@@ -1,0 +1,411 @@
+"""Paged KV cache + continuous batching (docs/llm_serving.md).
+
+Golden parity paged-vs-dense (batched, chunked, prefix-hit prefill;
+join/leave mid-stream), copy-on-write prefix sharing, page-exhaustion
+admission control (bounded wait -> completion, deadline expiry,
+watermark shed with Retry-After), and pool accounting returning to
+zero after cancel and forced crash-recovery."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from client_tpu.models.llm import (
+    LlmConfig,
+    LlmModel,
+    _PagePool,
+    prefix_page_hashes,
+)
+from client_tpu.utils import InferenceServerException
+
+TINY = LlmConfig(d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+                 d_ff=128, max_seq=128)
+
+
+def _gen(model, prompt, n=6, timeout_us=None, ignore_eos=True):
+    params = {} if timeout_us is None else {"timeout": timeout_us}
+    return [t for t in model._generate(
+        {"text_input": np.array([prompt], dtype=np.object_),
+         "max_tokens": np.array([n], dtype=np.int32),
+         "ignore_eos": np.array([ignore_eos])}, params)]
+
+
+def _drain(model, timeout_s=30.0):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        snap = model.kv_stats()
+        if snap is None:
+            if not model._active:
+                return None
+        elif not (snap["pages_used"] or snap["pages_reserved"]
+                  or model._active):
+            return snap
+        time.sleep(0.05)
+    return model.kv_stats()
+
+
+@pytest.fixture(scope="module")
+def arms():
+    dense = LlmModel(name="llm_pd", cfg=TINY, paged_kv=False,
+                     decode_lanes=2)
+    paged = LlmModel(name="llm_pp", cfg=TINY, paged_kv=True,
+                     decode_lanes=3, page_size=4)
+    yield dense, paged
+    dense.unload()
+    paged.unload()
+
+
+# -- parity ----------------------------------------------------------------
+
+
+def test_paged_parity_batched_and_chunked_prefill(arms):
+    """Token-exact vs dense across both prefill routes: short prompts
+    (batched scratch prefill + page pack) and prompts longer than
+    prefill_chunk (bounded chunked prefill)."""
+    dense, paged = arms
+    for prompt in (b"abc", b"a much longer prompt for the chunked "
+                          b"prefill route to split " * 2):
+        assert _gen(dense, prompt, 8) == _gen(paged, prompt, 8), prompt
+
+
+def test_paged_parity_join_leave_mid_stream(arms):
+    """More concurrent generations than lanes, staggered joins and
+    leaves: every request must produce exactly its solo-run tokens
+    (greedy decode is lane-independent under block-table gather)."""
+    dense, paged = arms
+    prompts = [("join leave %d" % i).encode() for i in range(7)]
+    solo = {p: _gen(paged, p) for p in prompts}
+    results, errors = {}, []
+
+    def worker(p, delay):
+        try:
+            time.sleep(delay)
+            results[p] = _gen(paged, p)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(p, 0.03 * i))
+               for i, p in enumerate(prompts)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    for p in prompts:
+        assert results[p] == solo[p] == _gen(dense, p), p
+
+
+def test_prefix_sharing_cow_divergence(arms):
+    """Two prompts sharing a long system prefix: the second join must
+    hit the prefix cache (pages reused, not recomputed) and still
+    produce exactly its dense-arm tokens — divergence after the
+    shared prefix lands in private (copy-on-write) pages."""
+    dense, paged = arms
+    sys_prompt = b"shared system prompt padding: " * 2
+    first = _gen(paged, sys_prompt + b"tail one")
+    hits0 = paged.kv_stats()["prefix_hits_total"]
+    second = _gen(paged, sys_prompt + b"completely different tail two")
+    hits1 = paged.kv_stats()["prefix_hits_total"]
+    assert hits1 > hits0, "second join did not reuse prefix pages"
+    assert first == _gen(dense, sys_prompt + b"tail one")
+    assert second == _gen(
+        dense, sys_prompt + b"completely different tail two")
+
+
+def test_eos_parity_without_ignore(arms):
+    """EOS handling (device-side done latch on the paged arm) must
+    terminate streams at the same token as the dense arm."""
+    dense, paged = arms
+    for prompt in (b"eos parity", b"x"):
+        assert _gen(dense, prompt, 20, ignore_eos=False) \
+            == _gen(paged, prompt, 20, ignore_eos=False)
+
+
+# -- admission control -----------------------------------------------------
+
+
+def test_exhaustion_bounded_wait_then_completion():
+    """A join that cannot reserve pages waits in the join queue and
+    completes once the holder's pages free — no failure, no leak."""
+    model = LlmModel(name="llm_wait", cfg=TINY, paged_kv=True,
+                     decode_lanes=2, page_size=4, kv_pages=12,
+                     queue_timeout_s=60.0)
+    results = {}
+
+    def run(tag, prompt):
+        results[tag] = _gen(model, prompt, 16)
+
+    # Each request needs ~ceil((prompt + 15)/4) pages; two of these
+    # cannot reserve 12 pages simultaneously.
+    t1 = threading.Thread(target=run,
+                          args=("a", b"first big request padd xx"))
+    t2 = threading.Thread(target=run,
+                          args=("b", b"second big request padd yy"))
+    t1.start()
+    t2.start()
+    t1.join(120)
+    t2.join(120)
+    assert len(results["a"]) == 16 and len(results["b"]) == 16
+    snap = _drain(model)
+    assert snap["pages_used"] == 0 and snap["pages_reserved"] == 0
+    model.unload()
+
+
+def test_exhaustion_deadline_and_watermark_shed():
+    """Behind a pool-holding stream: a queued join dies on its PR-2
+    queue deadline (DEADLINE_EXCEEDED), and past the watermark new
+    arrivals shed immediately with RESOURCE_EXHAUSTED + an honest
+    Retry-After estimate."""
+    model = LlmModel(name="llm_shed", cfg=TINY, paged_kv=True,
+                     decode_lanes=2, page_size=4, kv_pages=24,
+                     join_watermark=1, queue_timeout_s=30.0)
+    hold = model._generate(
+        {"text_input": np.array([b"hold most of the pool here"],
+                                dtype=np.object_),
+         "max_tokens": np.array([60], dtype=np.int32),
+         "ignore_eos": np.array([True])}, {})
+    next(hold)
+    with pytest.raises(InferenceServerException) as excinfo:
+        _gen(model, b"needs pages that never free", 60,
+             timeout_us=300000)
+    assert excinfo.value.status() == "DEADLINE_EXCEEDED"
+
+    queued = threading.Thread(
+        target=lambda: _try(model, b"queued forever request", 60))
+    queued.start()
+    time.sleep(0.3)  # let it reach the join queue (watermark = 1)
+    with pytest.raises(InferenceServerException) as excinfo:
+        _gen(model, b"shed at the door", 60)
+    assert excinfo.value.status() == "RESOURCE_EXHAUSTED"
+    assert getattr(excinfo.value, "retry_after_s", 0) > 0
+    assert model.kv_stats()["shed_total"] >= 1
+    hold.close()
+    queued.join(120)
+    snap = _drain(model)
+    assert snap["pages_used"] == 0 and snap["pages_reserved"] == 0
+    model.unload()
+
+
+def _try(model, prompt, n):
+    try:
+        _gen(model, prompt, n)
+    except InferenceServerException:
+        pass
+
+
+def test_cancelled_holder_admits_queued_join():
+    """Cancelling a pool-holding stream must count as scheduler
+    progress: the freed pages admit the queued join promptly instead
+    of letting it sleep to its deadline (review regression)."""
+    model = LlmModel(name="llm_reap", cfg=TINY, paged_kv=True,
+                     decode_lanes=2, page_size=4, kv_pages=24,
+                     queue_timeout_s=60.0)
+    hold = model._generate(
+        {"text_input": np.array([b"hold most of the pool here"],
+                                dtype=np.object_),
+         "max_tokens": np.array([60], dtype=np.int32),
+         "ignore_eos": np.array([True])}, {})
+    next(hold)
+    done = threading.Event()
+    results = {}
+
+    def queued():
+        results["tokens"] = _gen(model, b"queued join waits for pages",
+                                 60)
+        done.set()
+
+    thread = threading.Thread(target=queued)
+    thread.start()
+    time.sleep(0.5)  # reaches the join queue, cannot reserve
+    hold.close()
+    assert done.wait(25.0), "queued join did not admit after cancel"
+    assert len(results["tokens"]) == 60
+    thread.join(30)
+    _drain(model)
+    model.unload()
+
+
+def test_timeout_zero_keeps_default_deadline():
+    """`timeout=0` means 'no per-request override' (PR-2 batcher
+    semantics), not a zero-microsecond deadline: a queued join with
+    timeout=0 must survive the wait, not die instantly."""
+    model = LlmModel(name="llm_t0", cfg=TINY, paged_kv=True,
+                     decode_lanes=2, page_size=4, kv_pages=24,
+                     queue_timeout_s=60.0)
+    hold = model._generate(
+        {"text_input": np.array([b"hold most of the pool here"],
+                                dtype=np.object_),
+         "max_tokens": np.array([60], dtype=np.int32),
+         "ignore_eos": np.array([True])}, {})
+    next(hold)
+    outcome = {}
+
+    def queued():
+        try:
+            outcome["tokens"] = _gen(
+                model, b"zero timeout join padd", 60, timeout_us=0)
+        except InferenceServerException as e:
+            outcome["error"] = e
+
+    thread = threading.Thread(target=queued)
+    thread.start()
+    time.sleep(1.0)
+    assert "error" not in outcome, outcome.get("error")
+    hold.close()
+    thread.join(60)
+    assert outcome.get("tokens"), outcome
+    _drain(model)
+    model.unload()
+
+
+def test_oversized_request_rejected_immediately():
+    model = LlmModel(name="llm_big", cfg=TINY, paged_kv=True,
+                     decode_lanes=2, page_size=4, kv_pages=8)
+    with pytest.raises(InferenceServerException) as excinfo:
+        _gen(model, b"x" * 200, 120)
+    assert excinfo.value.status() == "INVALID_ARGUMENT"
+    model.unload()
+
+
+# -- pool accounting -------------------------------------------------------
+
+
+def test_cancel_mid_stream_frees_pages():
+    model = LlmModel(name="llm_cancel", cfg=TINY, paged_kv=True,
+                     decode_lanes=2, page_size=4)
+    gen = model._generate(
+        {"text_input": np.array([b"abandon this stream"],
+                                dtype=np.object_),
+         "max_tokens": np.array([100], dtype=np.int32),
+         "ignore_eos": np.array([True])}, {})
+    next(gen)
+    assert model.kv_stats()["pages_used"] > 0
+    gen.close()
+    snap = _drain(model)
+    assert snap["pages_used"] == 0 and snap["pages_reserved"] == 0
+    # lane is reusable afterwards
+    assert len(_gen(model, b"next", 4)) == 4
+    model.unload()
+
+
+def test_crash_recovery_does_not_leak_pages():
+    """A device failure mid-decode fails every rider loudly; the
+    generation bump rebuilds the pool with zero pages held and the
+    next request completes."""
+    model = LlmModel(name="llm_crash2", cfg=TINY, paged_kv=True,
+                     decode_lanes=2, page_size=4)
+    assert len(_gen(model, b"prime", 4)) == 4
+    _drain(model)
+    real = model._paged_decode
+    state = {"armed": True}
+
+    def exploding(*args, **kwargs):
+        if state["armed"]:
+            state["armed"] = False
+            raise RuntimeError("injected device failure")
+        return real(*args, **kwargs)
+
+    model._paged_decode = exploding
+    with pytest.raises(InferenceServerException, match="failed"):
+        _gen(model, b"boom", 8)
+    model._paged_decode = real
+    snap = model.kv_stats()
+    assert snap["pages_used"] == 0 and snap["pages_reserved"] == 0
+    assert len(_gen(model, b"after", 4)) == 4
+    snap = _drain(model)
+    assert snap["pages_used"] == 0 and snap["pages_reserved"] == 0
+    model.unload()
+
+
+def test_budget_limits_page_allocation():
+    """Run-ahead never allocates pages past the request's token
+    budget: a 3-token request on a fresh pool touches only the pages
+    its prompt + 2 decode slots need, not STREAM_CHUNK's worth."""
+    model = LlmModel(name="llm_budget", cfg=TINY, paged_kv=True,
+                     decode_lanes=1, page_size=4)
+    prompt = b"abcdefg"  # 8 tokens with BOS
+    _gen(model, prompt, 3)
+    snap = _drain(model)
+    # 8 prompt tokens + 2 decode slots = 10 slots -> 3 pages of 4.
+    assert snap["pages_used_peak"] <= 3
+    model.unload()
+
+
+# -- page pool unit --------------------------------------------------------
+
+
+def test_page_pool_reservation_invariant():
+    pool = _PagePool(num_pages=8, page_size=4)
+    assert pool.can_admit(8, 0)
+    assert not pool.can_admit(9, 0)
+    pool.reserve(6)
+    pages = pool.alloc(6)
+    assert len(pages) == 6 and pool.reserved == 0
+    assert not pool.can_admit(3, 0)
+    with pytest.raises(RuntimeError):
+        pool.alloc(1)  # nothing reserved
+    pool.free(pages)
+    assert pool.snapshot()["pages_used"] == 0
+    assert pool.snapshot()["pages_free"] == 8
+
+
+def test_page_pool_prefix_lifecycle_and_eviction():
+    pool = _PagePool(num_pages=4, page_size=4)
+    hashes = prefix_page_hashes(np.arange(8, dtype=np.int32), 4)
+    assert len(hashes) == 2
+    pool.reserve(2)
+    pages = pool.alloc(2)
+    for digest, page in zip(hashes, pages):
+        pool.register(digest, page)
+    assert pool.shared_live == 2
+    # a second lane attaches: still the same physical pages
+    hits, pinned = pool.peek_chain(hashes, 2)
+    assert (hits, pinned) == (2, 0)
+    attached = pool.attach(hashes)
+    assert attached == pages
+    pool.free(attached)
+    pool.free(pages)
+    snap = pool.snapshot()
+    assert snap["pages_used"] == 0 and snap["pages_cached"] == 2
+    # cache-only pages are evictable: a fresh reservation can claim
+    # the whole pool
+    pool.reserve(4)
+    fresh = pool.alloc(4)
+    assert len(fresh) == 4
+    assert pool.snapshot()["pages_cached"] == 0
+
+
+def test_prefix_hash_is_chained():
+    """Page 1's hash must depend on page 0's tokens (K/V depend on
+    the whole prefix through attention)."""
+    a = prefix_page_hashes(np.array([1, 2, 3, 4, 5, 6, 7, 8]), 4)
+    b = prefix_page_hashes(np.array([9, 2, 3, 4, 5, 6, 7, 8]), 4)
+    assert a[0] != b[0]
+    assert a[1] != b[1]  # same page-1 tokens, different prefix
+
+
+# -- metrics ---------------------------------------------------------------
+
+
+def test_kv_metric_families_on_metrics_endpoint():
+    from client_tpu.server.app import build_core
+
+    core = build_core([])
+    model = LlmModel(name="llm_kv_metrics", cfg=TINY, paged_kv=True,
+                     decode_lanes=2, page_size=4)
+    core.repository.add_model(model)
+    _gen(model, b"metrics please", 4)
+    text = core.metrics_text()
+    for family in ("tpu_kv_pages_used", "tpu_kv_pages_total",
+                   "tpu_kv_prefix_hits_total",
+                   "tpu_prefill_chunks_total"):
+        assert '%s{model="llm_kv_metrics"}' % family in text, family
+    core.shutdown()
+
+
+def test_dense_arm_reports_no_kv_stats(arms):
+    dense, paged = arms
+    assert dense.kv_stats() is None
+    assert paged.kv_stats()["pages_total"] > 0

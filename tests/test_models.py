@@ -208,7 +208,10 @@ def test_llm_pipeline_crash_recovery():
         time.sleep(0.05)
     assert sorted(model._free_lanes) == [0, 1]
 
-    real_decode = model._decode_chunk_multi
+    # Patch whichever decode kernel the configured arm dispatches
+    # (paged by default; _decode_chunk_multi on the dense A/B arm).
+    attr = "_paged_decode" if model._paged else "_decode_chunk_multi"
+    real_decode = getattr(model, attr)
     state = {"armed": True}
 
     def exploding(*args, **kwargs):
@@ -217,7 +220,7 @@ def test_llm_pipeline_crash_recovery():
             raise RuntimeError("injected device failure")
         return real_decode(*args, **kwargs)
 
-    model._decode_chunk_multi = exploding
+    setattr(model, attr, exploding)
     from client_tpu.utils import InferenceServerException
 
     with pytest.raises(InferenceServerException, match="failed"):

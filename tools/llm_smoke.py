@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Continuous-batching / paged-KV-cache smoke (ISSUE 13 acceptance).
+
+Runs the shared A/B driver (client_tpu.perf.bench_child.
+run_llm_continuous_measure): a dense-arm c4 baseline (``paged_kv=
+False``, 4 decode lanes — the pre-paged ceiling) against the paged
+arm at c16 on an attention-dominated long-context config, with every
+request carrying a shared system prompt.
+
+Gates:
+  1. paged decode is token-exact vs the dense arm (batched prefill,
+     chunked prefill, and prefix-hit prompts);
+  2. paged c16 tokens/s >= 5x the dense c4 baseline;
+  3. paged c16 ITL p99 <= 1.5x the dense c4 ITL p99 (joins and
+     chunked prefill must not spike active streams);
+  4. prefix hit ratio > 0 on the shared-system-prompt workload;
+  5. pool leak-free at exit after cancels and a forced
+     crash-recovery (pages_used == pages_reserved == 0).
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+SPEEDUP_FLOOR = 5.0
+ITL_P99_CEIL = 1.5
+
+
+def main() -> int:
+    from client_tpu.perf.bench_child import run_llm_continuous_measure
+
+    result = run_llm_continuous_measure(concurrencies=(16,),
+                                        paged_lanes=16, chaos=True)
+    dense = result["dense_c4"]
+    paged = result["paged_c16"]
+    speedup = paged.get("speedup_vs_dense_c4", 0.0)
+    itl_ratio = paged.get("itl_p99_vs_dense_c4", 0.0)
+    print("dense c4: %.1f tok/s, ITL p99 %.2f ms"
+          % (dense["tokens_per_sec"], dense["itl_p99_ms"]))
+    print("paged c16: %.1f tok/s (%.2fx), ITL p99 %.2f ms (%.2fx), "
+          "pages peak %d of %d (dense-equivalent %d)"
+          % (paged["tokens_per_sec"], speedup, paged["itl_p99_ms"],
+             itl_ratio, paged["pages_used_peak"], result["kv_pages"],
+             result["dense_equivalent_pages"]))
+    print("prefix hits: %d pages; prefill chunks: %d"
+          % (paged["prefix_hits_total"],
+             result["prefill_chunks_total"]))
+
+    failures = []
+    if not result["token_parity"]:
+        failures.append("paged decode is NOT token-exact vs dense")
+    if speedup < SPEEDUP_FLOOR:
+        failures.append("c16 speedup %.2fx below the %.1fx floor"
+                        % (speedup, SPEEDUP_FLOOR))
+    if not itl_ratio or itl_ratio > ITL_P99_CEIL:
+        failures.append("c16 ITL p99 ratio %.2fx above the %.1fx "
+                        "ceiling" % (itl_ratio, ITL_P99_CEIL))
+    if paged["prefix_hits_total"] <= 0:
+        failures.append("no prefix-cache hits on a shared-system-"
+                        "prompt workload")
+    if not result.get("chaos_recovered"):
+        failures.append("post-crash recovery request failed")
+    if result["pages_used_final"] or result["pages_reserved_final"]:
+        failures.append(
+            "page pool leaked: used=%d reserved=%d after cancels + "
+            "crash" % (result["pages_used_final"],
+                       result["pages_reserved_final"]))
+    for failure in failures:
+        print("FAIL: %s" % failure)
+    if failures:
+        return 1
+    print("llm smoke passed: %.2fx tokens/s at c16 (floor %.1fx), "
+          "ITL p99 %.2fx (ceil %.1fx), %d prefix-hit pages, pool "
+          "leak-free through cancel + crash"
+          % (speedup, SPEEDUP_FLOOR, itl_ratio, ITL_P99_CEIL,
+             paged["prefix_hits_total"]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
