@@ -74,12 +74,28 @@ def builtin_model_factories(repository=None
         model.replica_recovery_s = 0.5
         return model
 
+    def _simple_slo() -> ServedModel:
+        # The `simple` model with a declared SLO block + a tight
+        # absolute flight-recorder threshold — the SLO-engine/flight
+        # testbed (metrics_lint drives it so the tpu_slo_* families
+        # render; tools/flight_smoke.py chaos-injects against it).
+        # The latency target is generous for a CPU add even under a
+        # contended CI host (jit-compile spikes and scheduler noise
+        # stay under it, so a clean run burns ~0); chaos latency_ms
+        # injection blows straight through it.
+        model = AddSub(name="simple_slo", datatype="INT32", shape=(16,))
+        model.slo_p99_latency_us = 50_000
+        model.slo_availability = 0.999
+        model.flight_slow_us = 50_000
+        return model
+
     factories: Dict[str, Callable[[], ServedModel]] = {
         "add_sub": AddSub,
         "simple": lambda: AddSub(name="simple", datatype="INT32", shape=(16,)),
         "simple_cache": _simple_cache,
         "simple_qos": _simple_qos,
         "simple_replicas": _simple_replicas,
+        "simple_slo": _simple_slo,
         "add_sub_fp32": lambda: AddSub(
             name="add_sub_fp32", datatype="FP32", shape=(16,)
         ),

@@ -1028,21 +1028,19 @@ def run_tracing_measure(core, model_name: str = "add_sub_large",
     }
 
 
-def run_telemetry_measure(core, model_name: str = "add_sub_large",
-                          threads: int = 4, requests: int = 120,
-                          rounds: int = 4) -> dict:
-    """Latency-histogram recording overhead: the identical closed loop
-    with the telemetry registry disabled vs enabled (the always-on
-    default). Each served request pays ~5 histogram observations
-    (request + decode/queue/execute/encode) of a bisect + three
-    counter updates under a per-histogram lock; the acceptance gate is
-    <2% throughput cost — histograms must be cheap enough to NEVER
-    turn off, because an SLO signal that gets disabled under load is
-    not an SLO signal.
-
-    Interleaved A/B rounds with medians, same discipline as
-    run_tracing_measure: the absolute cost is microseconds per
-    request, far below this host's minute-to-minute drift."""
+def _overhead_ab_measure(core, toggle, prefix: str,
+                         model_name: str = "add_sub_large",
+                         threads: int = 4, requests: int = 120,
+                         rounds: int = 4) -> dict:
+    """Shared paired interleaved-A/B overhead driver for always-on
+    per-request layers (telemetry histograms, flight capture): the
+    identical closed loop on ``model_name`` with the layer disabled vs
+    enabled, alternated per round so adjacent windows share the host's
+    drift state. The median of PAIRED per-round ratios isolates the
+    recording cost far more tightly than a ratio of medians at a 2%
+    gate (the absolute cost is microseconds against a ~15 ms request).
+    ``toggle`` is the object whose ``enabled`` attribute gates the
+    layer; result keys are prefixed ``<prefix>_``."""
     import threading as _threading
 
     import numpy as np
@@ -1093,25 +1091,21 @@ def run_telemetry_measure(core, model_name: str = "add_sub_large",
 
     for req in pool_requests[:4]:
         core.infer(req)  # warm the model outside both windows
-    was_enabled = core.telemetry.enabled
+    was_enabled = toggle.enabled
     off_rounds, on_rounds, pair_overheads = [], [], []
     try:
         for _ in range(rounds):
-            core.telemetry.enabled = False
+            toggle.enabled = False
             off_tput_i, off_p50_i = closed_loop()
-            core.telemetry.enabled = True
+            toggle.enabled = True
             on_tput_i, on_p50_i = closed_loop()
             off_rounds.append((off_tput_i, off_p50_i))
             on_rounds.append((on_tput_i, on_p50_i))
             if off_tput_i > 0:
-                # PAIRED per-round overhead: adjacent windows share
-                # the host's drift state, so their ratio isolates the
-                # recording cost; the median of pair ratios is far
-                # tighter than a ratio of medians at a 2% gate.
                 pair_overheads.append(
                     100.0 * (off_tput_i - on_tput_i) / off_tput_i)
     finally:
-        core.telemetry.enabled = was_enabled
+        toggle.enabled = was_enabled
     off_rounds.sort()
     on_rounds.sort()
     off_tput, off_p50 = off_rounds[len(off_rounds) // 2]
@@ -1120,15 +1114,48 @@ def run_telemetry_measure(core, model_name: str = "add_sub_large",
     overhead_pct = (pair_overheads[len(pair_overheads) // 2]
                     if pair_overheads else 0.0)
     return {
-        "telemetry_off_tput": round(off_tput, 2),
-        "telemetry_off_p50_us": round(off_p50, 1),
-        "telemetry_on_tput": round(on_tput, 2),
-        "telemetry_on_p50_us": round(on_p50, 1),
+        "%s_off_tput" % prefix: round(off_tput, 2),
+        "%s_off_p50_us" % prefix: round(off_p50, 1),
+        "%s_on_tput" % prefix: round(on_tput, 2),
+        "%s_on_p50_us" % prefix: round(on_p50, 1),
         "pair_overheads_pct": [round(v, 2) for v in pair_overheads],
         "overhead_pct": round(overhead_pct, 2),
         "overhead_gate_pct": 2.0,
         "overhead_ok": overhead_pct < 2.0,
     }
+
+
+def run_telemetry_measure(core, model_name: str = "add_sub_large",
+                          threads: int = 4, requests: int = 120,
+                          rounds: int = 4) -> dict:
+    """Latency-histogram recording overhead: the identical closed loop
+    with the telemetry registry disabled vs enabled (the always-on
+    default). Each served request pays ~5 histogram observations
+    (request + decode/queue/execute/encode) of a bisect + three
+    counter updates under a per-histogram lock; the acceptance gate is
+    <2% throughput cost — histograms must be cheap enough to NEVER
+    turn off, because an SLO signal that gets disabled under load is
+    not an SLO signal. (Shared driver: _overhead_ab_measure.)"""
+    return _overhead_ab_measure(core, core.telemetry, "telemetry",
+                                model_name=model_name, threads=threads,
+                                requests=requests, rounds=rounds)
+
+
+def run_flight_measure(core, model_name: str = "add_sub_large",
+                       threads: int = 4, requests: int = 120,
+                       rounds: int = 4) -> dict:
+    """Flight-recorder capture overhead: the identical closed loop
+    with the recorder disabled vs enabled (the always-on default).
+    With capture on, EVERY request builds a scratch span tree
+    (client_tpu.server.tracing.RequestTrace — ids from a seeded PRNG,
+    boundary-chained clock reads) and pays one retroactive keep check
+    at completion; nothing here is kept (clean traffic, generous
+    threshold), so the cost measured is pure capture — the tax of
+    having forensics armed. Gate: <2% throughput. (Shared driver:
+    _overhead_ab_measure.)"""
+    return _overhead_ab_measure(core, core.flight, "flight",
+                                model_name=model_name, threads=threads,
+                                requests=requests, rounds=rounds)
 
 
 def run_fetch_measure(core, threads: int = 4, rounds: int = 3,
@@ -2343,6 +2370,28 @@ def main() -> None:
                     % extra.get("overhead_pct", 0.0))
         except Exception as exc:  # noqa: BLE001
             log("telemetry_overhead failed: %s" % exc)
+
+    # Config 3i: flight-recorder capture overhead — the same closed
+    # loop on add_sub_large with the always-on scratch span capture
+    # disabled vs enabled (nothing is kept on clean traffic, so this
+    # is the pure cost of having forensics armed). Gate: <2%
+    # throughput, so the tail-retention layer can stay on in
+    # production unconditionally.
+    if remaining() > 45 and stage_wanted("flight_overhead"):
+        try:
+            run_with_watchdog(
+                "add_sub_large load",
+                lambda: core.repository.load("add_sub_large"),
+                min(120.0, max(30.0, remaining() - 60)))
+            extra = run_flight_measure(core)
+            record_stage("flight_overhead",
+                         extra.get("flight_on_tput", 0.0),
+                         extra.get("flight_on_p50_us", 0.0), extra)
+            if not extra.get("overhead_ok", True):
+                log("flight capture overhead %.2f%% exceeds the 2%% "
+                    "gate" % extra.get("overhead_pct", 0.0))
+        except Exception as exc:  # noqa: BLE001
+            log("flight_overhead failed: %s" % exc)
 
     # Config 3h: relay-fetch A/B — the overlapped output-fetch
     # subsystem (client_tpu.server.fetch) vs the legacy serial

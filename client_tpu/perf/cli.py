@@ -136,6 +136,19 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--trace-file", default=None,
                         help="span trace output path (default: a "
                              "temp file, deleted after the report)")
+    parser.add_argument("--slo", action="store_true",
+                        help="assert SLO compliance after the run from "
+                             "the scraped tpu_slo_* families and exit "
+                             "non-zero on violation (CI-friendly); "
+                             "prints the per-model SLO/burn-rate "
+                             "summary. Needs a model declaring an "
+                             "`slo` block; remote servers need "
+                             "--collect-metrics / a reachable "
+                             "--metrics-url")
+    parser.add_argument("--slo-strict", action="store_true",
+                        help="with --slo, also fail when any fast-"
+                             "window burn rate exceeds 1 (not just on "
+                             "the multi-window unhealthy verdict)")
     parser.add_argument("--collect-metrics", action="store_true",
                         help="scrape server Prometheus metrics per window")
     parser.add_argument("--metrics-url", default=None,
@@ -722,12 +735,40 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
                            chaos_config.describe() if chaos_config
                            else "no injection",
                            unrecovered=robust.exhausted_total())
+    slo_ok = True
+    if args.slo:
+        from client_tpu.perf.report import print_slo_report
+
+        # Compliance reads one final scrape: inprocess renders the
+        # core's exposition directly, remote runs reuse the metrics
+        # manager's URL (the burn-rate windows live server-side, so a
+        # single post-run scrape carries the whole verdict).
+        slo_metrics = None
+        if args.service_kind == "inprocess" and core is not None:
+            from client_tpu.perf.metrics_manager import parse_prometheus
+
+            slo_metrics = parse_prometheus(core.metrics_text())
+        elif metrics_manager is not None:
+            try:
+                slo_metrics = metrics_manager.scrape_once()
+            except Exception as e:  # noqa: BLE001 — degraded scrape
+                print("warning: --slo final scrape failed: %s" % e,
+                      file=sys.stderr)
+        if slo_metrics is None:
+            print("perf --slo: no metrics source (use --service-kind "
+                  "inprocess, or --collect-metrics with a reachable "
+                  "--metrics-url); treating as a violation",
+                  file=sys.stderr)
+            slo_ok = False
+        else:
+            slo_ok = print_slo_report(slo_metrics,
+                                      strict=args.slo_strict)
     if args.latency_report_file:
         write_csv(args.latency_report_file, results, mode)
     if args.profile_export_file:
         export_profile(args.profile_export_file, results, model.name,
                        args.service_kind, args.url, mode)
-    return 0
+    return 0 if slo_ok else 1
 
 
 def main():

@@ -77,6 +77,13 @@ class TpuMetrics:
     kv_pages_total: Dict[str, float] = field(default_factory=dict)
     kv_prefix_hits_total: Dict[str, float] = field(default_factory=dict)
     prefill_chunks_total: Dict[str, float] = field(default_factory=dict)
+    # SLO families (server/slo.py): targets keyed "model|o<objective>",
+    # burn rates keyed "model|w<window>", budget/verdict per model —
+    # the perf --slo compliance gate and report line read these.
+    slo_target: Dict[str, float] = field(default_factory=dict)
+    slo_burn_rate: Dict[str, float] = field(default_factory=dict)
+    slo_budget_remaining: Dict[str, float] = field(default_factory=dict)
+    slo_healthy: Dict[str, float] = field(default_factory=dict)
 
 
 _FAMILIES = {
@@ -109,6 +116,10 @@ _FAMILIES = {
     "tpu_kv_pages_total": "kv_pages_total",
     "tpu_kv_prefix_hits_total": "kv_prefix_hits_total",
     "tpu_prefill_chunks_total": "prefill_chunks_total",
+    "tpu_slo_target": "slo_target",
+    "tpu_slo_burn_rate": "slo_burn_rate",
+    "tpu_slo_budget_remaining": "slo_budget_remaining",
+    "tpu_slo_healthy": "slo_healthy",
 }
 
 # Histogram families (telemetry layer): the scraper folds their
@@ -206,6 +217,10 @@ def parse_prometheus(text: str) -> TpuMetrics:
             key = "%s|p%s" % (key, labels["priority"])
         if "replica" in labels:
             key = "%s|r%s" % (key, labels["replica"])
+        if "window" in labels:
+            key = "%s|w%s" % (key, labels["window"])
+        if "objective" in labels:
+            key = "%s|o%s" % (key, labels["objective"])
         try:
             value = float(m.group("value"))
         except ValueError:

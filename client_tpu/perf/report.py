@@ -282,6 +282,51 @@ def print_trace_report(path: str) -> None:
           % (root_p50 / 1000.0, coverage, qef))
 
 
+def print_slo_report(metrics, strict: bool = False) -> bool:
+    """The --slo summary + compliance verdict, from one scraped
+    ``TpuMetrics`` (tpu_slo_* families): per model, the declared
+    targets, fast/slow burn rates, budget remaining, and the
+    multi-window healthy verdict — printed next to the histogram
+    quantiles the same scrape carries. Returns True when every model
+    is compliant: ``tpu_slo_healthy`` is 1 everywhere and (``strict``)
+    no fast window burns above 1 — the CI-friendly exit code the
+    --slo flag maps to."""
+    models = sorted(metrics.slo_healthy)
+    if not models:
+        # The operator explicitly asked for enforcement: a scrape with
+        # no tpu_slo_* series (slo block lost in a config refactor,
+        # wrong --metrics-url) must FAIL, not pass vacuously.
+        print("SLO summary: no tpu_slo_* series in the scrape — no "
+              "model declares an `slo` block (or the metrics source "
+              "is wrong); treating as a violation")
+        return False
+    compliant = True
+    print("SLO summary (from the final /metrics scrape):")
+    for model_name in models:
+        targets = []
+        for objective in ("p99_latency_us", "ttft_p99_us",
+                          "availability"):
+            value = metrics.slo_target.get(
+                "%s|o%s" % (model_name, objective))
+            if value is not None:
+                targets.append(
+                    "%s=%g" % (objective, value))
+        fast = metrics.slo_burn_rate.get("%s|wfast" % model_name, 0.0)
+        slow = metrics.slo_burn_rate.get("%s|wslow" % model_name, 0.0)
+        budget = metrics.slo_budget_remaining.get(model_name, 1.0)
+        healthy = metrics.slo_healthy.get(model_name, 1.0) >= 1.0
+        print("    %s: %s; burn fast %.2fx / slow %.2fx, budget "
+              "remaining %.0f%%, verdict %s"
+              % (model_name, ", ".join(targets) or "no targets",
+                 fast, slow, budget * 100.0,
+                 "HEALTHY" if healthy else "UNHEALTHY"))
+        if not healthy or (strict and fast > 1.0):
+            compliant = False
+    print("    SLO compliance: %s"
+          % ("PASS" if compliant else "FAIL"))
+    return compliant
+
+
 def print_qos_report(results: List[PerfStatus],
                      description: str = "") -> None:
     """The --priority-mix/--tenant summary: per-priority-class

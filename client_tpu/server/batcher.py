@@ -1162,9 +1162,13 @@ class DynamicBatcher:
         if ok and self._telemetry is not None \
                 and self._telemetry.enabled and compute_ns:
             try:
+                # First SAMPLED member only: flight scratch traces
+                # (sampled=False) are usually discarded and must not
+                # stamp exemplars (spantrace.exemplar_id).
                 trace_id = next(
-                    (p.trace.trace_id for p in bucket
-                     if p.trace is not None), None)
+                    (tid for tid in (spantrace.exemplar_id(p.trace)
+                                     for p in bucket)
+                     if tid is not None), None)
                 name = getattr(self._model, "name", "?")
                 self._telemetry.observe_stage(
                     name, "batch_execute", compute_ns / 1000.0,
@@ -1225,6 +1229,37 @@ class DynamicBatcher:
             "overlap_ratio": (overlap_ns / fetch_ns) if fetch_ns else 0.0,
             "pending_by_priority": by_priority,
         }
+
+    def debug_snapshot(self) -> dict:
+        """The /v2/debug queue view: per-shape-bucket depth segmented
+        per priority class, plus the oldest waiter's age per bucket —
+        the granularity stats_snapshot's totals flatten away. Bucket
+        keys are shape fingerprints (bounded by the traffic's distinct
+        shapes, not by request count)."""
+        now_ns = time.monotonic_ns()
+        with self._cv:
+            buckets = {}
+            for shape_key, bucket in self._buckets.items():
+                by_priority = {
+                    str(level): len(queue)
+                    for level, queue in bucket.queues.items()
+                }
+                depth = sum(by_priority.values())
+                if not depth:
+                    continue
+                buckets[str(shape_key)] = {
+                    "pending": depth,
+                    "by_priority": by_priority,
+                    "oldest_wait_us":
+                        max(now_ns - bucket.head_ns(), 0) // 1000,
+                }
+            return {
+                "pending_count": self._pending_total,
+                "inflight_count": self._inflight,
+                "max_queue_size": self._max_queue_size,
+                "queue_delay_us": self._cur_delay_ns // NANOS_PER_US,
+                "buckets": buckets,
+            }
 
 
 def _fuse_chunks(chunks, target: int, total: int):

@@ -21,7 +21,9 @@ import numpy as np
 from client_tpu.protocol import inference_pb2 as pb
 from client_tpu.server import chaos
 from client_tpu.server import fetch as relay
-from client_tpu.server import telemetry as slo
+from client_tpu.server import flight as flightrec
+from client_tpu.server import slo as sloengine
+from client_tpu.server import telemetry as telemetry_mod
 from client_tpu.server import tracing as spantrace
 from client_tpu.server.cache import (
     DEFAULT_CACHE_BYTES,
@@ -285,13 +287,18 @@ class _TenantAdmission:
     once a validated model is known (per-model tenant rows must not be
     minted for bogus model names)."""
 
-    __slots__ = ("_core", "_request", "tenant", "ok", "model_name",
-                 "_held", "_t0")
+    __slots__ = ("_core", "_request", "_trace_context", "tenant", "ok",
+                 "model_name", "_held", "_t0")
 
     def __init__(self, core: "InferenceServerCore",
-                 request: pb.ModelInferRequest):
+                 request: pb.ModelInferRequest,
+                 trace_context: Optional[str] = None):
         self._core = core
         self._request = request
+        # Threaded through so a quota-rejected request's flight record
+        # adopts the caller's W3C trace id (joinable by distributed
+        # trace, like every other kept record).
+        self._trace_context = trace_context
         self.tenant = None
         self.ok = False
         self.model_name: Optional[str] = None
@@ -317,6 +324,11 @@ class _TenantAdmission:
                     stats = core._stats.get(request.model_name)
                 if stats is not None:
                     stats.record_tenant_rejected(tenant)
+                # Quota rejects fire before any scratch capture —
+                # retain them in the flight ring too (reason "quota",
+                # joined to the caller's trace context).
+                core._flight_admission_reject(request,
+                                              self._trace_context, e)
                 _LOG.debug("request %s for tenant '%s' rejected: %s",
                            request.id, tenant, e)
                 raise
@@ -381,7 +393,30 @@ class InferenceServerCore:
         # for every request at every serving stage, exposed on /metrics
         # as Prometheus histogram families. CLIENT_TPU_TELEMETRY=off
         # disables recording (the bench's A/B arm).
-        self.telemetry = slo.ServerTelemetry()
+        self.telemetry = telemetry_mod.ServerTelemetry()
+        # Flight recorder (client_tpu.server.flight): every request's
+        # span tree is captured into a scratch trace regardless of
+        # trace_rate; a RETROACTIVE keep decision at completion
+        # retains errors, sheds, timeouts, quota rejects, and
+        # slower-than-threshold requests in bounded per-model rings —
+        # dumpable over GET /v2/debug/flight. CLIENT_TPU_FLIGHT=off
+        # disables capture (the flight_overhead bench A/B arm).
+        self.flight = flightrec.FlightRecorder(telemetry=self.telemetry)
+        # SLO engine (client_tpu.server.slo): error-budget burn rate
+        # over fast/slow windows for every model declaring an `slo`
+        # block, computed from the telemetry histograms + the success
+        # counters above and exposed as the tpu_slo_* families plus
+        # SloStatistics. Burns that flip a model unhealthy stamp the
+        # flight-ring traces that contributed to them.
+        self.slo = sloengine.SloEngine(
+            targets_fn=self._slo_targets,
+            collect_fn=self._slo_collect,
+            incident_hook=self.flight.mark_incident,
+        )
+        # Start stamps: tpu_server_info's uptime value (a scrape-level
+        # restart detector) and the /v2/debug server section.
+        self._started_wall = time.time()
+        self._started_mono = time.monotonic()
         # Shared output fetcher for the direct/sequence paths
         # (client_tpu.server.fetch): all of a response's device->host
         # copies are issued at once and land in completion order, so
@@ -464,6 +499,60 @@ class InferenceServerCore:
             config=self.repository.get(name, version).config_pb()
         )
 
+    # -- SLO engine wiring -----------------------------------------------
+
+    def _slo_targets(self):
+        """(name, SloTarget, model) for every ready model declaring an
+        ``slo`` block — the set the burn-rate engine tracks."""
+        out = []
+        for model in self.repository.ready_models():
+            target = sloengine.SloTarget.of(model)
+            if target.declared():
+                out.append((model.name, target, model))
+        return out
+
+    def _slo_collect(self, name: str,
+                     target: sloengine.SloTarget) -> sloengine.SloSample:
+        """One cumulative snapshot of the counters a burn computation
+        differences: latency/TTFT good-vs-total from the always-on
+        telemetry histograms (interpolated at the target bound),
+        availability good-vs-bad from the model's success counters
+        (errors, rejects, deadline expiries, and sheds all spend the
+        budget)."""
+        sample = sloengine.SloSample(0.0)
+        telemetry = self.telemetry.for_model(name)
+        if target.p99_latency_us:
+            # With telemetry recording off, the histogram freezes and
+            # burn would read 0 through a meltdown — flag the
+            # objective unmonitorable so the verdict fails loudly.
+            sample.latency_monitored = self.telemetry.enabled
+            snap = telemetry.request.snapshot()
+            sample.latency_total = float(snap["count"])
+            sample.latency_good = sloengine.count_at_or_below(
+                snap["buckets"], target.p99_latency_us)
+        if target.ttft_p99_us:
+            sample.ttft_monitored = self.telemetry.enabled
+            snap = telemetry.stream_first.snapshot()
+            sample.ttft_total = float(snap["count"])
+            sample.ttft_good = sloengine.count_at_or_below(
+                snap["buckets"], target.ttft_p99_us)
+        if target.availability:
+            stats = self._stats_for(name)
+            with stats.lock:
+                sample.ok_count = float(stats.success_count)
+                # fail_count alone: every queue reject, deadline
+                # expiry, shed, and plain error surfaces as a raised
+                # exception that lands in fail_count exactly once —
+                # adding the per-cause counters (rejected/timeout/
+                # shed) on top would double-count those drops and
+                # inflate burn ~2x. Tenant-quota rejects are absent
+                # by design: they are POLICY signals (the client
+                # exceeded its contract), not server availability —
+                # the same stance the client breakers take
+                # (status_map.QUOTA_REJECT_WIRE).
+                sample.bad_count = float(stats.fail_count)
+        return sample
+
     # -- statistics ------------------------------------------------------
 
     def _stats_for(self, name: str) -> _ModelStats:
@@ -479,6 +568,12 @@ class InferenceServerCore:
             [self.repository.get(name, version)] if name
             else self.repository.ready_models()
         )
+        # Evaluated BEFORE the per-model lock below: the collector
+        # reads the same (non-reentrant) stats locks this loop holds.
+        try:
+            slo_verdicts = self.slo.evaluate()
+        except Exception:  # noqa: BLE001 — statistics never take
+            slo_verdicts = {}  # the server down
         for model in models:
             s = self._stats_for(model.name)
             with s.lock:
@@ -537,6 +632,17 @@ class InferenceServerCore:
                     row.compute_infer.ns = compute_ns
                     row.compute_output.count = count
                     row.compute_output.ns = fetch_ns
+            verdict = slo_verdicts.get(model.name)
+            if verdict is not None:
+                row = stat.slo_stats
+                target = verdict["target"]
+                row.p99_latency_target_us = target["p99_latency_us"]
+                row.ttft_p99_target_us = target["ttft_p99_us"]
+                row.availability_target = target["availability"]
+                row.burn_rate_fast = verdict["burn"]["fast"]
+                row.burn_rate_slow = verdict["burn"]["slow"]
+                row.budget_remaining = verdict["budget_remaining"]
+                row.healthy = verdict["healthy"]
             with self._batchers_lock:
                 batcher = self._batchers.get(model.name)
             if batcher is not None:
@@ -668,6 +774,16 @@ class InferenceServerCore:
                "Requests dropped by graceful load shedding, "
                "lowest-priority-first (displacement at a full queue + "
                "watermark sheds)", shed_rows)
+
+        # Server identity + uptime: the value resets to ~0 on restart,
+        # so a scrape-side `resets()`/drop detector catches process
+        # churn that per-model counters (which also reset) only imply.
+        family("tpu_server_info", "gauge",
+               "Server identity labels (name/version); value = uptime "
+               "in seconds, so a drop between scrapes means a restart",
+               ['tpu_server_info{name="%s",version="%s"} %d'
+                % (SERVER_NAME, SERVER_VERSION,
+                   int(time.monotonic() - self._started_mono))])
 
         tenant_success, tenant_rejected, tenant_failure = [], [], []
         # Quota rejects come from the quota manager when configured —
@@ -930,6 +1046,15 @@ class InferenceServerCore:
                "Accelerator HBM capacity in bytes", total_rows)
         family("tpu_hbm_utilization", "gauge",
                "Fraction of accelerator HBM in use", util_rows)
+        # SLO families (tpu_slo_target / _burn_rate / _budget_remaining
+        # / _healthy): rendered by the engine, empty when no ready
+        # model declares an `slo` block. Rendering evaluates — the
+        # scrape itself advances the burn-rate windows, so a server
+        # that is only ever scraped still computes fresh verdicts.
+        try:
+            lines.extend(self.slo.render())
+        except Exception:  # noqa: BLE001 — metrics never take
+            pass  # the server down
         # Latency-histogram + streaming-token families (request/stage
         # durations, stream TTFT/ITL, per-tenant duration histogram) —
         # HELP/TYPE lines come with the rendered block. Exemplar
@@ -940,6 +1065,146 @@ class InferenceServerCore:
         if openmetrics:
             lines.append("# EOF")
         return "\n".join(lines) + "\n"
+
+    # -- live introspection (GET /v2/debug) ------------------------------
+
+    def debug_snapshot(self, model_name: str = "") -> dict:
+        """One JSON-able snapshot of everything an operator asks
+        "why is this slow RIGHT NOW" about: queue depth per
+        bucket/priority, in-flight requests with age and current span
+        stage, replica health/breaker states, KV page-pool occupancy,
+        arena/shm usage, SLO verdicts, flight-ring occupancy, and
+        chaos counters. ``model_name`` restricts the model-keyed
+        sections. Served by GET /v2/debug on both HTTP front-ends and
+        the inference.Debug gRPC surface; every collection here is
+        cardinality-bounded (tools/metrics_lint.lint_debug_snapshot
+        gates that in CI)."""
+
+        def wanted(name: str) -> bool:
+            return not model_name or name == model_name
+
+        doc: dict = {
+            "server": {
+                "name": SERVER_NAME,
+                "version": SERVER_VERSION,
+                "ready": bool(self.ready),
+                "uptime_s": round(
+                    time.monotonic() - self._started_mono, 3),
+                "started_at": self._started_wall,
+            },
+            "models": [],
+            "queues": {},
+            "sequencers": {},
+            "in_flight": [
+                entry for entry in self.flight.in_flight()
+                if wanted(entry["model"])
+            ],
+            "replicas": {},
+            "kv_pools": {},
+            "cache": {},
+            "slo": {},
+            "flight": {},
+            "chaos": chaos.stats(),
+        }
+        for model in self.repository.ready_models():
+            if not wanted(model.name):
+                continue
+            doc["models"].append({
+                "name": model.name,
+                "version": model.version,
+                "ready": self.model_ready(model.name),
+            })
+            stats_fn = getattr(model, "kv_stats", None)
+            if stats_fn is not None:
+                try:
+                    snap = stats_fn()
+                except Exception:  # noqa: BLE001 — introspection
+                    snap = None  # never takes the server down
+                if snap:
+                    doc["kv_pools"][model.name] = snap
+        with self._batchers_lock:
+            batchers = dict(self._batchers)
+        for name, batcher in sorted(batchers.items()):
+            if not wanted(name):
+                continue
+            try:
+                doc["queues"][name] = batcher.debug_snapshot()
+            except Exception:  # noqa: BLE001
+                continue
+        with self._sequencers_lock:
+            sequencers = dict(self._sequencers)
+        for name, sequencer in sorted(sequencers.items()):
+            if not wanted(name):
+                continue
+            try:
+                doc["sequencers"][name] = sequencer.stats_snapshot()
+            except Exception:  # noqa: BLE001
+                continue
+        with self._replica_lock:
+            replica_sets = dict(self._replica_sets)
+        for name, replica_set in sorted(replica_sets.items()):
+            if not wanted(name):
+                continue
+            try:
+                doc["replicas"][name] = replica_set.snapshot()
+            except Exception:  # noqa: BLE001
+                continue
+        for name, snap in sorted(self.response_cache.snapshot().items()):
+            if wanted(name):
+                doc["cache"][name] = snap
+        try:
+            verdicts = self.slo.evaluate()
+        except Exception:  # noqa: BLE001
+            verdicts = {}
+        doc["slo"] = {name: verdict for name, verdict in verdicts.items()
+                      if wanted(name)}
+        doc["flight"] = {name: snap
+                         for name, snap in self.flight.stats().items()
+                         if wanted(name)}
+        if self.tenant_quotas is not None:
+            try:
+                doc["tenants"] = self.tenant_quotas.snapshot()
+            except Exception:  # noqa: BLE001
+                pass
+        arena = self.memory.arena
+        if arena is not None:
+            try:
+                regions = arena.list_regions()
+                doc["arena"] = {
+                    "regions": len(regions),
+                    "bytes_total": sum(r[2] for r in regions),
+                }
+            except Exception:  # noqa: BLE001
+                pass
+        try:
+            status = self.memory.system_status("")
+            doc["shm"] = {
+                "system": [
+                    {"name": r.name, "byte_size": int(r.byte_size)}
+                    for r in status.regions.values()
+                ],
+            }
+            status = self.memory.tpu_status("")
+            doc["shm"]["tpu"] = [
+                {"name": r.name, "device_id": int(r.device_id),
+                 "byte_size": int(r.byte_size)}
+                for r in status.regions.values()
+            ]
+        except Exception:  # noqa: BLE001
+            pass
+        return doc
+
+    def debug_flight(self, model_name: str = "") -> dict:
+        """The flight-ring dump (GET /v2/debug/flight?model=M): kept
+        anomaly traces with full span trees, oldest first."""
+        return {
+            "stats": {
+                name: snap
+                for name, snap in self.flight.stats().items()
+                if not model_name or name == model_name
+            },
+            "records": self.flight.snapshot(model_name or None),
+        }
 
     # -- trace / log settings -------------------------------------------
 
@@ -1191,6 +1456,9 @@ class InferenceServerCore:
                     model,
                     factory=self.repository.factory(model.name),
                     scope_fn=lambda: self.chaos_scope,
+                    # Breaker trips / watchdog ejections stamp the
+                    # flight-ring traces that led up to them.
+                    event_hook=self.flight.mark_incident,
                 )
                 self._replica_sets[model.name] = replica_set
             return replica_set
@@ -1307,6 +1575,37 @@ class InferenceServerCore:
 
         return str(param.string_param) if tagged else ANONYMOUS_TENANT
 
+    def _flight_admission_reject(self, request: pb.ModelInferRequest,
+                                 trace_context: Optional[str],
+                                 error: InferenceServerException
+                                 ) -> None:
+        """Admission-stage failures (tenant-quota 429, drain/unknown-
+        model rejects) fire BEFORE the scratch-capture path in
+        _infer_admitted, so they would never reach the flight ring —
+        retain them here with a root-only trace so the forensic layer
+        covers every drop, not just post-admission ones. Never raises:
+        callers are about to re-raise the REAL error, and forensics
+        must not replace it."""
+        try:
+            flight = self.flight
+            if not flight.enabled:
+                return
+            # Clamped here too: these strings land in the trace ROOT
+            # attrs (serialized into the record's span tree), which
+            # observe()'s top-level field clamps do not cover.
+            model_name = str(request.model_name)[
+                :flightrec.MAX_NAME_CHARS]
+            request_id = str(request.id)[:flightrec.MAX_ID_CHARS]
+            trace = spantrace.RequestTrace(
+                trace_context,
+                attrs={"model": model_name, "request_id": request_id},
+                sampled=False)
+            trace.finish(error=str(error))
+            flight.observe(None, model_name, request_id, trace,
+                           error=str(error), status=error.status())
+        except Exception:  # noqa: BLE001 — forensics never affect
+            pass  # serving
+
     def infer(self, request: pb.ModelInferRequest,
               trace_context: Optional[str] = None
               ) -> pb.ModelInferResponse:
@@ -1318,13 +1617,18 @@ class InferenceServerCore:
         # Tenant quota admission runs FIRST — before the model is
         # acquired — so an over-quota tenant cannot even hold an
         # in-flight slot during a drain.
-        with _TenantAdmission(self, request) as admission:
+        with _TenantAdmission(self, request,
+                              trace_context) as admission:
             # acquire = READY check + in-flight increment in one atomic
             # step: a graceful unload drains exactly the requests
             # admitted before it flipped the state
             # (repository.begin_unload).
-            model = self.repository.acquire(request.model_name,
-                                            request.model_version)
+            try:
+                model = self.repository.acquire(request.model_name,
+                                                request.model_version)
+            except InferenceServerException as e:
+                self._flight_admission_reject(request, trace_context, e)
+                raise
             admission.model_name = model.name
             try:
                 response = self._infer_admitted(model, request,
@@ -1354,17 +1658,43 @@ class InferenceServerCore:
             model.batcher_resolver = self._batcher_for
         stats = self._stats_for(model.name)
         trace = self._trace_begin(model.name, trace_context, request.id)
-        if trace is None:
+        flight = self.flight
+        ftrace = trace
+        if ftrace is None and flight.enabled:
+            # Tail sampling (flight recorder): the span tree is
+            # captured for EVERY request into a scratch trace; whether
+            # it survives is decided RETROACTIVELY at completion
+            # (error/shed/timeout/slow), when the request's fate is
+            # known — never by a dice roll at start. Unkept scratches
+            # are discarded without ever being rendered.
+            ftrace = spantrace.RequestTrace(
+                trace_context,
+                attrs={"model": model.name, "request_id": request.id},
+                sampled=False)
+        if ftrace is None:
             return self._infer_routed(model, request, stats, None)
         error: Optional[str] = None
+        status: Optional[str] = None
+        token = (flight.track(model.name, request.id, ftrace)
+                 if flight.enabled else None)
         try:
-            return self._infer_routed(model, request, stats, trace)
-        except Exception as e:
+            return self._infer_routed(model, request, stats, ftrace)
+        except InferenceServerException as e:
             error = str(e)
+            status = e.status()
+            raise
+        except Exception as e:
+            error, status = str(e), "INTERNAL"
             raise
         finally:
-            trace.finish(error=error)
-            self._trace_emit(model.name, request.id, trace)
+            ftrace.finish(error=error)
+            if trace is not None:
+                self._trace_emit(model.name, request.id, trace)
+            try:
+                flight.observe(model, model.name, request.id, ftrace,
+                               error=error, status=status, token=token)
+            except Exception:  # noqa: BLE001 — a recorder fault must
+                pass  # never mask the request's own outcome
 
     def _infer_routed(self, model: ServedModel,
                       request: pb.ModelInferRequest, stats: _ModelStats,
@@ -1686,10 +2016,11 @@ class InferenceServerCore:
             # Always-on SLO histograms: the end-to-end duration plus
             # the per-request stages that tile it (decode/queue/
             # execute/encode — the span-tree timeline, observed for
-            # EVERY request, not just trace samples). Sampled requests
+            # EVERY request, not just trace samples). SAMPLED requests
             # stamp their trace id as an OpenMetrics exemplar so a
-            # hot-bucket outlier joins its span tree.
-            trace_id = trace.trace_id if trace is not None else None
+            # hot-bucket outlier joins its span tree; flight scratch
+            # traces never do (they are usually discarded).
+            trace_id = spantrace.exemplar_id(trace)
             telemetry.observe_request(model.name, (t3 - t0) / 1000.0,
                                       trace_id)
             telemetry.observe_stage(model.name, "decode",
@@ -1783,7 +2114,7 @@ class InferenceServerCore:
             self.telemetry.observe_stage(
                 model.name, "relay_fetch",
                 (mark_ns - fetch_start) / 1000.0,
-                trace.trace_id if trace is not None else None)
+                spantrace.exemplar_id(trace))
         return fetched, mark_ns
 
     def stream_infer(
@@ -1795,7 +2126,15 @@ class InferenceServerCore:
         triton_final_response=true parameter (empty if the model
         yielded nothing after its last data response and the client
         asked for empty finals)."""
-        model = self.repository.get(request.model_name, request.model_version)
+        try:
+            model = self.repository.get(request.model_name,
+                                        request.model_version)
+        except InferenceServerException as e:
+            # Unknown-model/bad-version stream rejects are retained
+            # like the unary path's — the forensic layer covers every
+            # drop, streaming included.
+            self._flight_admission_reject(request, trace_context, e)
+            raise
         stats = self._stats_for(model.name)
         want_empty_final = (
             "triton_enable_empty_final_response" in request.parameters
@@ -1828,12 +2167,15 @@ class InferenceServerCore:
         # duration, so the streaming RPC cannot bypass admission. A
         # quota reject raises; the transports surface it as an
         # in-stream error.
-        with _TenantAdmission(self, request) as admission:
+        with _TenantAdmission(self, request,
+                              trace_context) as admission:
             # model came from repository.get above, so the name is
             # validated — per-model tenant rows are recorded even when
             # the in-flight acquire below fails (drain in progress).
             admission.model_name = model.name
             trace = None
+            ftrace = None
+            token = None
             acquired = False
             # The whole stream holds one in-flight admission so a
             # graceful unload drains it before teardown. Everything
@@ -1841,19 +2183,56 @@ class InferenceServerCore:
             # an acquire/trace failure (model draining, bad version)
             # still returns the tenant's token and in-flight slot.
             try:
-                model = self.repository.acquire(request.model_name,
-                                                request.model_version)
+                try:
+                    model = self.repository.acquire(
+                        request.model_name, request.model_version)
+                except InferenceServerException as e:
+                    # Drain/unknown-model rejects on the stream path
+                    # fire before the scratch capture below — retain
+                    # them like the unary path does.
+                    self._flight_admission_reject(request,
+                                                  trace_context, e)
+                    raise
                 acquired = True
                 trace = self._trace_begin(model.name, trace_context,
                                           request.id)
+                ftrace = trace
+                if ftrace is None and self.flight.enabled:
+                    # Flight scratch for unsampled streams (same tail
+                    # sampling as the unary path; stream errors ride
+                    # the stream as responses, so _stream_admitted
+                    # stamps them on the root attrs for the keep
+                    # decision below).
+                    ftrace = spantrace.RequestTrace(
+                        trace_context,
+                        attrs={"model": model.name,
+                               "request_id": request.id},
+                        sampled=False)
+                if ftrace is not None and self.flight.enabled:
+                    token = self.flight.track(model.name, request.id,
+                                              ftrace)
                 yield from self._stream_admitted(model, request, stats,
                                                  t0, want_empty_final,
-                                                 trace)
+                                                 ftrace)
                 admission.ok = True
             finally:
-                if trace is not None:
-                    trace.finish()
-                    self._trace_emit(model.name, request.id, trace)
+                if ftrace is not None:
+                    attrs = ftrace.root.attrs or {}
+                    stream_error = attrs.get("error")
+                    stream_status = attrs.get("error_status")
+                    ftrace.finish(error=stream_error)
+                    if trace is not None:
+                        self._trace_emit(model.name, request.id, trace)
+                    # Streams keep only on error: their wall clock
+                    # scales with response count by design, so the
+                    # slow threshold would retain every long stream.
+                    try:
+                        self.flight.observe(
+                            model, model.name, request.id, ftrace,
+                            error=stream_error, status=stream_status,
+                            token=token, allow_slow=False)
+                    except Exception:  # noqa: BLE001 — a recorder
+                        pass  # fault must never leak the acquisition
                 if acquired:
                     self.repository.release(model.name)
 
@@ -1869,7 +2248,7 @@ class InferenceServerCore:
             pending = None  # buffer one ahead so the last data response
             # can carry the final flag when empty finals are off
             telemetry = self.telemetry
-            trace_id = trace.trace_id if trace is not None else None
+            trace_id = spantrace.exemplar_id(trace)
             # TTFT measures from stream admission (t0, before decode)
             # — the server-side bound of what the client experiences;
             # later gaps measure production-to-production (the
@@ -1928,9 +2307,19 @@ class InferenceServerCore:
             stats.record(max(count, 1), 0, 0, time.monotonic_ns() - t0, 0, ok=True)
         except InferenceServerException as e:
             stats.record(1, 0, 0, time.monotonic_ns() - t0, 0, ok=False)
+            if trace is not None:
+                # Stream errors ride the stream, never raise — stamp
+                # the root attrs so the flight recorder's retroactive
+                # keep decision (and the emitted trace record) still
+                # see the failure.
+                trace.root.attrs["error"] = str(e)
+                trace.root.attrs["error_status"] = e.status()
             yield stream_error_response(request, str(e))
         except Exception as e:
             stats.record(1, 0, 0, time.monotonic_ns() - t0, 0, ok=False)
+            if trace is not None:
+                trace.root.attrs["error"] = "inference failed: %s" % e
+                trace.root.attrs["error_status"] = "INTERNAL"
             yield stream_error_response(request, "inference failed: %s" % e)
 
     # -- shared memory verbs --------------------------------------------

@@ -1,0 +1,423 @@
+"""Flight recorder: tail-retained anomaly traces for every request.
+
+PR 6's span tracer decides sampling at request START: at any realistic
+``trace_rate`` the requests most worth inspecting — p99 outliers,
+errors, shed/timeout victims — are captured only by luck, even though
+the PR-10 histograms prove they happened. The flight recorder closes
+that gap with *tail sampling* (the Dapper-lineage design): EVERY
+request records its span tree into a cheap scratch
+(:class:`client_tpu.server.tracing.RequestTrace`, created by the core
+even when trace sampling said no), and the keep decision runs
+*retroactively* at completion, when the request's fate is known:
+
+* **error** — the request failed (any non-drop exception);
+* **timeout** — its queue/single-flight deadline expired
+  (``DEADLINE_EXCEEDED``);
+* **shed** — admission control or overload shedding dropped it
+  (``UNAVAILABLE``);
+* **quota** — a tenant quota rejected it (``RESOURCE_EXHAUSTED``);
+* **slow** — it succeeded but took longer than the model's latency
+  threshold: the absolute ``flight_slow_us`` ModelConfig knob when
+  set, else a p99 estimate derived live from the model's always-on
+  ``tpu_request_duration_us`` histogram (refreshed at most once per
+  second, and only once the histogram holds enough samples for the
+  estimate to mean anything).
+
+Kept traces land in a bounded per-model ring buffer (count AND byte
+budget, oldest-overwritten) with their full span trees, request ids,
+and error payloads — dumpable as JSON over ``GET /v2/debug/flight``
+and flushable to chrome-trace files exactly like the PR-6 buffers, so
+a p99 regression comes with the span trees that explain it. SLO burns
+and replica breaker trips *stamp* the resident traces
+(:meth:`FlightRecorder.mark_incident`): the ring entry then names the
+incident it contributed to.
+
+Cost discipline: the unkept path pays one monotonic subtraction and a
+threshold compare; serialization (the expensive part) happens only for
+kept traces, which are anomalies by construction. ``enabled=False``
+(or ``CLIENT_TPU_FLIGHT=off``) short-circuits capture entirely — the
+A/B arm the ``flight_overhead`` bench stage measures against, gated
+<2% like the PR-10 telemetry layer.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Dict, List, Optional
+
+from client_tpu.status_map import FLIGHT_KEEP_REASONS
+
+# Per-model ring budgets (overridable per recorder): entries AND bytes
+# both bound the ring; whichever is hit first evicts the oldest trace.
+DEFAULT_MAX_ENTRIES = 256
+DEFAULT_MAX_BYTES = 4 * 1024 * 1024
+
+# Derived-p99 keep threshold: minimum histogram population before the
+# estimate is trusted, and how often it is re-derived per model.
+MIN_DERIVED_SAMPLES = 64
+DERIVE_REFRESH_S = 1.0
+
+# Incident stamps per record: a flapping replica (trip -> readmit ->
+# trip) stamps the ring every cycle; past this cap the oldest stamp
+# rolls off so a long-resident record stays bounded.
+MAX_INCIDENT_STAMPS = 8
+
+# In-flight registry hard cap: live requests are bounded by serving
+# concurrency, but a leak (a caller that never completes) must not
+# grow the registry without bound — past the cap new requests are
+# simply not tracked (capture and keep still work).
+MAX_TRACKED_INFLIGHT = 4096
+
+# Ring-count cap: admission-stage rejects are keyed by the CLIENT-
+# supplied model name (a quota reject fires before the name is
+# validated), so a hostile client spraying names must not mint a ring
+# per name — past the cap new names fold into one overflow ring (the
+# qos.py tenant-cardinality pattern).
+MAX_RINGS = 256
+OVERFLOW_RING = "overflow"
+
+# Client-controlled strings are clamped before a record (or in-flight
+# entry) is built: request ids, model names, and error payloads (which
+# embed both) arrive on the wire unauthenticated and unbounded — the
+# gRPC front-end lifts message-size limits — and unclamped they would
+# turn the retention rings into a memory DoS.
+MAX_NAME_CHARS = 256
+MAX_ID_CHARS = 128
+MAX_ERROR_CHARS = 4096
+
+
+class _Live:
+    """One in-flight request's registry entry."""
+
+    __slots__ = ("model", "request_id", "trace", "start_ns")
+
+    def __init__(self, model: str, request_id: str, trace):
+        self.model = model
+        self.request_id = request_id
+        self.trace = trace
+        self.start_ns = trace.root.start_ns
+
+
+class _ModelRing:
+    """Bounded ring of kept flight records for one model."""
+
+    __slots__ = ("entries", "bytes", "kept_total", "overwritten_total",
+                 "oversized_total")
+
+    def __init__(self):
+        # deque of (record dict, nbytes); oldest at the left.
+        self.entries: deque = deque()
+        self.bytes = 0
+        self.kept_total = 0
+        self.overwritten_total = 0
+        self.oversized_total = 0
+
+
+class FlightRecorder:
+    """Per-model tail-retention rings + the live in-flight registry
+    the /v2/debug endpoint reads."""
+
+    def __init__(self, enabled: Optional[bool] = None,
+                 max_entries: int = DEFAULT_MAX_ENTRIES,
+                 max_bytes: int = DEFAULT_MAX_BYTES,
+                 telemetry=None):
+        if enabled is None:
+            import os
+
+            enabled = os.environ.get(
+                "CLIENT_TPU_FLIGHT", "").strip().lower() not in (
+                    "off", "0", "false", "disabled")
+        self.enabled = bool(enabled)
+        self.max_entries = int(max_entries)
+        self.max_bytes = int(max_bytes)
+        # The always-on histogram registry the derived-p99 threshold
+        # reads (client_tpu.server.telemetry.ServerTelemetry); None
+        # disables derived thresholds (absolute flight_slow_us only).
+        self._telemetry = telemetry
+        self._rings: Dict[str, _ModelRing] = {}
+        self._lock = threading.Lock()
+        self._live: Dict[int, _Live] = {}
+        self._live_lock = threading.Lock()
+        self._live_seq = 0
+        # model -> (derived threshold us, monotonic stamp) — refreshed
+        # lazily per observe, at most once per DERIVE_REFRESH_S.
+        self._derived: Dict[str, tuple] = {}
+
+    # -- in-flight registry ----------------------------------------------
+
+    def track(self, model: str, request_id: str, trace) -> Optional[int]:
+        """Registers a live request; returns the token ``untrack`` /
+        ``observe`` take (None when the registry is at its cap)."""
+        entry = _Live(str(model)[:MAX_NAME_CHARS],
+                      str(request_id)[:MAX_ID_CHARS], trace)
+        with self._live_lock:
+            if len(self._live) >= MAX_TRACKED_INFLIGHT:
+                return None
+            self._live_seq += 1
+            token = self._live_seq
+            self._live[token] = entry
+        return token
+
+    def untrack(self, token: Optional[int]) -> None:
+        if token is None:
+            return
+        with self._live_lock:
+            self._live.pop(token, None)
+
+    def in_flight(self) -> List[dict]:
+        """Live requests with age and the stage they are in (the last
+        COMPLETED span's name; spans are recorded at end time, so a
+        request deep in execution shows the last boundary it crossed).
+        Oldest first — the hung request an operator is hunting is at
+        the top."""
+        with self._live_lock:
+            live = list(self._live.values())
+        now_ns = time.monotonic_ns()
+        out = []
+        for entry in sorted(live, key=lambda e: e.start_ns):
+            spans = entry.trace.snapshot()
+            stage = spans[-1].name if len(spans) > 1 else "admitted"
+            out.append({
+                "model": entry.model,
+                "request_id": entry.request_id,
+                "trace_id": entry.trace.trace_id,
+                "age_us": max(now_ns - entry.start_ns, 0) // 1000,
+                "stage": stage,
+            })
+        return out
+
+    # -- keep decision ----------------------------------------------------
+
+    def slow_threshold_us(self, model, model_name: str) -> tuple:
+        """(threshold_us, source) for the slow-keep decision: the
+        model's absolute ``flight_slow_us`` when set, else a p99
+        derived from the live request-duration histogram (0 = no slow
+        keeps — not enough samples yet, or telemetry off)."""
+        absolute = int(getattr(model, "flight_slow_us", 0) or 0)
+        if absolute > 0:
+            return absolute, "absolute"
+        telemetry = self._telemetry
+        if telemetry is None or not getattr(telemetry, "enabled", False):
+            return 0, "none"
+        cached = self._derived.get(model_name)
+        now = time.monotonic()
+        if cached is not None and now - cached[1] < DERIVE_REFRESH_S:
+            return cached[0], "derived_p99"
+        from client_tpu.server.telemetry import estimate_quantile
+
+        snap = telemetry.for_model(model_name).request.snapshot()
+        if snap["count"] < MIN_DERIVED_SAMPLES:
+            return 0, "none"
+        threshold = int(estimate_quantile(snap["buckets"], 0.99))
+        self._derived[model_name] = (threshold, now)
+        return threshold, "derived_p99"
+
+    def observe(self, model, model_name: str, request_id: str, trace,
+                error: Optional[str] = None,
+                status: Optional[str] = None,
+                token: Optional[int] = None,
+                allow_slow: bool = True) -> Optional[str]:
+        """The retroactive keep decision for one completed request.
+        ``trace`` must be finished (root closed). Returns the keep
+        reason, or None when the request was unremarkable and the
+        trace is discarded. Always untracks ``token``.
+        ``allow_slow=False`` disables the slow keep (decoupled
+        streams: their wall clock scales with response count by
+        design, so only errors keep them)."""
+        self.untrack(token)
+        if not self.enabled:
+            return None
+        # Clamp the client-controlled strings BEFORE they key or fill
+        # a record (see MAX_*_CHARS).
+        model_name = str(model_name)[:MAX_NAME_CHARS]
+        request_id = str(request_id)[:MAX_ID_CHARS]
+        if error is not None:
+            error = str(error)[:MAX_ERROR_CHARS]
+        root = trace.root
+        duration_us = max(root.end_ns - root.start_ns, 0) // 1000
+        reason = None
+        threshold_us = 0
+        source = "none"
+        if error is not None:
+            reason = FLIGHT_KEEP_REASONS.get(status or "", "error")
+        elif allow_slow:
+            threshold_us, source = self.slow_threshold_us(model,
+                                                          model_name)
+            if threshold_us > 0 and duration_us >= threshold_us:
+                reason = "slow"
+        if reason is None:
+            return None
+        record = {
+            "model": model_name,
+            "request_id": request_id,
+            "trace_id": trace.trace_id,
+            "reason": reason,
+            "status": status,
+            "error": error,
+            "duration_us": duration_us,
+            "ts": time.time(),
+            "incidents": [],
+            "spans": [span.as_dict() for span in trace.snapshot()],
+        }
+        if reason == "slow":
+            record["threshold_us"] = threshold_us
+            record["threshold_source"] = source
+        # Size the entry by its serialized form — the byte budget must
+        # bound real memory, not a guess (the PR-5 cache lesson). Paid
+        # only on keeps, which are anomalies by construction.
+        nbytes = len(json.dumps(record, separators=(",", ":"),
+                                default=str)) + 64
+        with self._lock:
+            ring = self._rings.get(model_name)
+            if ring is None:
+                if len(self._rings) >= MAX_RINGS:
+                    model_name = OVERFLOW_RING
+                ring = self._rings.setdefault(model_name, _ModelRing())
+            if nbytes > self.max_bytes:
+                # A single record exceeding the whole byte budget
+                # would either evict all older evidence or, retained,
+                # defeat the budget entirely (a memory-DoS lever with
+                # client-fed payloads) — drop it and count the drop.
+                ring.oversized_total += 1
+                return reason
+            ring.entries.append((record, nbytes))
+            ring.bytes += nbytes
+            ring.kept_total += 1
+            self._evict_over_budget(ring)
+        return reason
+
+    def _evict_over_budget(self, ring: _ModelRing) -> None:
+        """Oldest-out eviction down to the count/byte budgets (caller
+        holds the lock). The NEWEST entry is never evicted — records
+        larger than the whole budget were already dropped at insert
+        (oversized_total), so the loop always terminates within
+        budget."""
+        while len(ring.entries) > 1 and (
+                len(ring.entries) > self.max_entries
+                or ring.bytes > self.max_bytes):
+            _dropped, dropped_bytes = ring.entries.popleft()
+            ring.bytes -= dropped_bytes
+            ring.overwritten_total += 1
+
+    # -- incident stamping -------------------------------------------------
+
+    def mark_incident(self, model_name: str, label: str) -> int:
+        """Stamps ``label`` onto every trace currently resident in the
+        model's ring — called by the SLO engine when a burn crosses
+        its threshold and by the replica layer on a breaker
+        trip/watchdog ejection, so the ring entries name the incident
+        they contributed to. Returns how many records were stamped.
+        Stamps are capped per record (MAX_INCIDENT_STAMPS, oldest
+        rolls off) and accounted against the ring's byte budget so a
+        flapping replica cannot grow resident records unboundedly."""
+        stamp = {"label": label, "ts": time.time()}
+        stamp_bytes = len(json.dumps(stamp, separators=(",", ":"),
+                                     default=str)) + 8
+        stamped = 0
+        with self._lock:
+            ring = self._rings.get(model_name)
+            if ring is None:
+                return 0
+            # Entries are rebuilt with their per-entry nbytes grown by
+            # the stamp, so a later eviction subtracts exactly what
+            # the record accounts for — no phantom residue after a
+            # stamped record churns out of the ring.
+            updated: deque = deque()
+            for record, nbytes in ring.entries:
+                incidents = record["incidents"]
+                if len(incidents) >= MAX_INCIDENT_STAMPS:
+                    # Capped: the oldest stamp rolls off — account the
+                    # exact size delta (labels differ in length, so
+                    # "same size" would drift from resident memory).
+                    popped = incidents.pop(0)
+                    delta = stamp_bytes - (
+                        len(json.dumps(popped, separators=(",", ":"),
+                                       default=str)) + 8)
+                else:
+                    delta = stamp_bytes
+                nbytes += delta
+                ring.bytes += delta
+                incidents.append(stamp)
+                stamped += 1
+                updated.append((record, nbytes))
+            ring.entries = updated
+            self._evict_over_budget(ring)
+        return stamped
+
+    # -- reading -----------------------------------------------------------
+
+    def snapshot(self, model_name: Optional[str] = None) -> List[dict]:
+        """Kept records (oldest first), one model's or all. Records are
+        deep-ish copies at the top level so a concurrent
+        mark_incident never mutates what a caller is serializing."""
+        with self._lock:
+            if model_name is not None:
+                rings = {model_name: self._rings.get(model_name)}
+            else:
+                rings = dict(self._rings)
+            out = []
+            for name in sorted(rings):
+                ring = rings[name]
+                if ring is None:
+                    continue
+                for record, _nbytes in ring.entries:
+                    copy = dict(record)
+                    copy["incidents"] = list(record["incidents"])
+                    out.append(copy)
+        return out
+
+    def stats(self) -> Dict[str, dict]:
+        """Per-model ring occupancy + lifetime counters (the /v2/debug
+        "flight" section)."""
+        with self._lock:
+            return OrderedDict(
+                (name, {
+                    "entries": len(ring.entries),
+                    "bytes": ring.bytes,
+                    "kept_total": ring.kept_total,
+                    "overwritten_total": ring.overwritten_total,
+                    "oversized_total": ring.oversized_total,
+                })
+                for name, ring in sorted(self._rings.items()))
+
+    # -- export ------------------------------------------------------------
+
+    def flush_chrome(self, path: str,
+                     model_name: Optional[str] = None) -> int:
+        """Appends the ring's records to ``path`` as chrome-trace
+        complete events (the PR-6 ``trace_mode=chrome`` format, built
+        by the same shared event builder — tracing.chrome_span_events
+        — so the two exports can never drift; loadable in
+        ui.perfetto.dev). Returns the record count written; the ring
+        is NOT cleared — flight traces are evidence, and an export
+        must not race an investigation."""
+        from client_tpu.server.tracing import chrome_span_events
+
+        records = self.snapshot(model_name)
+        if not records:
+            return 0
+        try:
+            import os as _os
+
+            fresh = (not _os.path.exists(path)
+                     or _os.path.getsize(path) == 0)
+            with open(path, "a") as f:
+                if fresh:
+                    f.write("[\n")
+                for index, record in enumerate(records):
+                    events = chrome_span_events(
+                        record["spans"], record["model"], index,
+                        "flight %s %s (%s)"
+                        % (record["request_id"],
+                           record["trace_id"][:8], record["reason"]),
+                        {"trace_id": record["trace_id"],
+                         "request_id": record["request_id"],
+                         "keep_reason": record["reason"]})
+                    for event in events:
+                        f.write(json.dumps(event, default=str) + ",\n")
+        except OSError:
+            return 0  # export must never fail the caller
+        return len(records)

@@ -337,6 +337,53 @@ class InferenceServicer(GRPCInferenceServiceServicer):
         return response
 
 
+def debug_generic_handler(core: InferenceServerCore):
+    """The gRPC surface of ``GET /v2/debug`` — a *generic* (descriptor-
+    free) service, so no protoc run is needed for a JSON diagnostic
+    payload. Two unary methods, each taking an optional JSON request
+    body (``{"model": "M"}``) and returning UTF-8 JSON bytes:
+
+    * ``/inference.Debug/Snapshot`` — ``core.debug_snapshot()``;
+    * ``/inference.Debug/Flight`` — ``core.debug_flight()`` (the
+      flight-ring anomaly-trace dump).
+
+    Call from any grpc channel:
+    ``channel.unary_unary("/inference.Debug/Snapshot",
+    request_serializer=None, response_deserializer=None)(b"{}")``."""
+    import json
+
+    def _model_of(request_bytes: bytes) -> str:
+        if not request_bytes:
+            return ""
+        try:
+            doc = json.loads(request_bytes.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            return ""
+        return str(doc.get("model") or "")
+
+    def snapshot(request_bytes, context):
+        return json.dumps(core.debug_snapshot(_model_of(request_bytes)),
+                          default=str).encode("utf-8")
+
+    def flight(request_bytes, context):
+        return json.dumps(core.debug_flight(_model_of(request_bytes)),
+                          default=str).encode("utf-8")
+
+    def identity(payload: bytes) -> bytes:
+        return payload
+
+    return grpc.method_handlers_generic_handler(
+        "inference.Debug",
+        {
+            "Snapshot": grpc.unary_unary_rpc_method_handler(
+                snapshot, request_deserializer=identity,
+                response_serializer=identity),
+            "Flight": grpc.unary_unary_rpc_method_handler(
+                flight, request_deserializer=identity,
+                response_serializer=identity),
+        })
+
+
 _CHANNEL_OPTIONS = [
     ("grpc.max_send_message_length", -1),
     ("grpc.max_receive_message_length", -1),
@@ -354,6 +401,7 @@ def build_grpc_server(
         options=list(_CHANNEL_OPTIONS),
     )
     add_GRPCInferenceServiceServicer_to_server(InferenceServicer(core), server)
+    server.add_generic_rpc_handlers((debug_generic_handler(core),))
     for add_fn, servicer in extra_servicers:
         add_fn(servicer, server)
     if address:
@@ -402,6 +450,8 @@ class AioGrpcServerThread:
                     options=list(_CHANNEL_OPTIONS))
                 add_GRPCInferenceServiceServicer_to_server(
                     InferenceServicer(core), server)
+                server.add_generic_rpc_handlers(
+                    (debug_generic_handler(core),))
                 for add_fn, servicer in extra_servicers:
                     add_fn(servicer, server)
                 self.port = server.add_insecure_port(address)

@@ -140,6 +140,32 @@ def _metrics(core, m, headers, body):
     return 200, {"Content-Type": "text/plain; version=0.0.4"}, text.encode()
 
 
+def _debug_query_model(m, headers) -> str:
+    """?model=M for the debug routes. Direct http_call callers pass
+    the raw request target (query included) and it matches off the
+    path; the native HTTP/1.1 front-end strips the query before
+    routing and forwards it as the synthetic ``x-request-query``
+    header instead (http1_server.cc) — check both."""
+    from urllib.parse import parse_qs, urlsplit
+
+    query_string = urlsplit(m.string).query \
+        or headers.get("x-request-query", "")
+    query = parse_qs(query_string)
+    return (query.get("model") or [""])[0]
+
+
+@_route("GET", r"/v2/debug(?:\?.*)?")
+def _debug(core, m, headers, body):
+    # Live introspection, aiohttp-front-end parity
+    # (docs/flight_recorder.md).
+    return _json_reply(core.debug_snapshot(_debug_query_model(m, headers)))
+
+
+@_route("GET", r"/v2/debug/flight(?:\?.*)?")
+def _debug_flight(core, m, headers, body):
+    return _json_reply(core.debug_flight(_debug_query_model(m, headers)))
+
+
 @_route("GET", r"/v2")
 def _server_metadata(core, m, headers, body):
     return _pb_reply(core.server_metadata())

@@ -101,6 +101,23 @@ def build_http_app(core: InferenceServerCore) -> web.Application:
         return web.Response(text=text,
                             content_type="text/plain", charset="utf-8")
 
+    @routes.get("/v2/debug")
+    async def debug_snapshot(request):
+        # Live introspection (docs/flight_recorder.md): queue depth
+        # per bucket/priority, in-flight requests with age + span
+        # stage, replica health, KV/arena occupancy, SLO verdicts.
+        doc = await _run(core.debug_snapshot,
+                         request.query.get("model", ""))
+        return web.json_response(doc)
+
+    @routes.get("/v2/debug/flight")
+    async def debug_flight(request):
+        # Flight-ring dump: retroactively kept anomaly traces with
+        # their full span trees (?model=M restricts to one model).
+        doc = await _run(core.debug_flight,
+                         request.query.get("model", ""))
+        return web.json_response(doc)
+
     @routes.get("/v2")
     async def server_metadata(request):
         return _pb_json(core.server_metadata())

@@ -142,6 +142,21 @@ class ServedModel:
     replica_watchdog_us: int = 0
     replica_failure_threshold: int = 0
     replica_recovery_s: float = 0.0
+    # Service-level objectives (client_tpu.server.slo, rendered in the
+    # ModelConfig `slo` block): 0 = objective not declared. The SLO
+    # engine computes error-budget burn rate per objective over
+    # fast/slow sliding windows and exposes the tpu_slo_* families +
+    # SloStatistics — the signal the autoscaling/admission controller
+    # consumes. slo_availability is a fraction (e.g. 0.999); errors,
+    # rejects, deadline expiries, and sheds all spend its budget.
+    slo_p99_latency_us: int = 0
+    slo_ttft_p99_us: int = 0
+    slo_availability: float = 0.0
+    # Flight recorder (client_tpu.server.flight): absolute slow-keep
+    # threshold for this model's retroactive trace retention. 0 =
+    # derive the threshold live from the model's request-duration
+    # histogram (estimated p99).
+    flight_slow_us: int = 0
     sequence_batching: bool = False
     sequence_strategy: str = "direct"
     max_candidate_sequences: int = 0
@@ -225,6 +240,11 @@ class ServedModel:
         config.model_transaction_policy.decoupled = self.decoupled
         if self.response_cache:
             config.response_cache.enable = True
+        if (self.slo_p99_latency_us or self.slo_ttft_p99_us
+                or self.slo_availability):
+            config.slo.p99_latency_us = self.slo_p99_latency_us
+            config.slo.ttft_p99_us = self.slo_ttft_p99_us
+            config.slo.availability = self.slo_availability
         if self.instance_group_count > 0:
             kind = {
                 "cpu": mc.ModelInstanceConfig.KIND_CPU,

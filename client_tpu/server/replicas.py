@@ -173,8 +173,15 @@ class ReplicaSet:
                  watchdog_us: Optional[int] = None,
                  failure_threshold: Optional[int] = None,
                  recovery_s: Optional[float] = None,
-                 scope_fn: Optional[Callable[[], Optional[str]]] = None):
+                 scope_fn: Optional[Callable[[], Optional[str]]] = None,
+                 event_hook: Optional[Callable[[str, str], None]] = None):
         self.base = model
+        # Lifecycle notification (event_hook(model_name, label)): the
+        # core wires this to the flight recorder so breaker trips and
+        # watchdog ejections stamp the anomaly traces that led up to
+        # them. Called OUTSIDE the set's lock; failures are swallowed
+        # (forensics must never affect serving).
+        self._event_hook = event_hook
         self.name = str(getattr(model, "name", "model"))
         self._factory = factory
         count = int(count if count is not None
@@ -452,24 +459,39 @@ class ReplicaSet:
                 latency_s if replica.ewma_latency_s == 0.0
                 else 0.2 * latency_s + 0.8 * replica.ewma_latency_s)
 
+    def _notify(self, label: str) -> None:
+        """Fires the lifecycle event hook (never under the set's
+        lock; forensics must never affect serving)."""
+        if self._event_hook is None:
+            return
+        try:
+            self._event_hook(self.name, label)
+        except Exception:  # noqa: BLE001 — stamping is advisory
+            pass
+
     def _note_failure(self, replica: _Replica,
                       error: BaseException) -> None:
         from client_tpu.robust import _breaker_resolve
 
         was_healthy = replica.healthy()
         _breaker_resolve(replica.breaker, error)
+        ejected = False
         with self._lock:
             replica.outstanding = max(replica.outstanding - 1, 0)
             replica.failures += 1
             if was_healthy and not replica.healthy():
                 replica.ejected_count += 1
                 self.ejections += 1
+                ejected = True
                 _LOG.warning("replica %s:%d ejected (breaker open "
                              "after repeated execution failures)",
                              self.name, replica.index)
+        if ejected:
+            self._notify("breaker_trip replica=%d" % replica.index)
 
     def _mark_hung(self, replica: _Replica) -> None:
         replica.breaker.record_failure()  # availability evidence too
+        ejected = False
         with self._lock:
             replica.outstanding = max(replica.outstanding - 1, 0)
             replica.failures += 1
@@ -478,8 +500,11 @@ class ReplicaSet:
                 replica.hung = True
                 replica.ejected_count += 1
                 self.ejections += 1
+                ejected = True
                 _LOG.warning("replica %s:%d marked unhealthy "
                              "(watchdog)", self.name, replica.index)
+        if ejected:
+            self._notify("watchdog_trip replica=%d" % replica.index)
 
     # -- supervisor (self-healing) ---------------------------------------
 

@@ -1,0 +1,423 @@
+"""Per-model SLO targets + multi-window error-budget burn rate.
+
+ROADMAP item 4 (closed-loop autoscaling, SLO-aware admission) needs a
+signal that did not exist: no model declared an SLO and nothing
+computed burn rate against one. This module is that signal. A model
+declares its objectives in the ModelConfig ``slo`` block
+(:class:`SloTarget`):
+
+* ``p99_latency_us`` — 99% of served requests complete within this;
+* ``ttft_p99_us`` — 99% of streams produce their first response
+  within this (token streams);
+* ``availability`` — fraction of admitted requests that must succeed
+  (e.g. ``0.999``; errors, queue rejects, deadline expiries, and
+  sheds all spend the budget).
+
+The engine computes **error-budget burn rate** over two sliding
+windows (the Google SRE workbook's multi-window methodology: a fast
+window catches a cliff in minutes, a slow window catches a steady
+leak) from telemetry the server already records — the always-on
+``tpu_request_duration_us`` / ``tpu_stream_first_response_us``
+histograms (PR 10) and the per-model success/failure counters. Burn
+rate 1.0 means the budget is being spent exactly as fast as the SLO
+allows; >1 means the budget will exhaust before the window does.
+
+Derivation, per objective, over a window ``[t-w, t]``:
+
+* latency/TTFT: ``bad_fraction = fraction of observations above the
+  target`` (estimated from cumulative bucket deltas, interpolating
+  inside the bucket containing the target);
+  ``burn = bad_fraction / (1 - 0.99)``.
+* availability: ``bad_fraction = failed / (failed + succeeded)``;
+  ``burn = bad_fraction / (1 - availability)``.
+
+The model's burn rate is the max over its declared objectives. The
+``tpu_slo_healthy`` verdict applies the multi-window rule: unhealthy
+only when BOTH windows burn above 1 — a fast-window spike alone is
+noise, a slow-window overrun with a calm fast window is already
+recovering. A transition to unhealthy stamps the model's flight-ring
+traces (:meth:`FlightRecorder.mark_incident`) so the forensic layer
+names the burn they contributed to.
+
+Sampling is lazy: :meth:`evaluate` appends a cumulative snapshot at
+most once per ``min_sample_interval_s`` and computes burns between the
+newest snapshot and the newest one at least a window old (the window
+"ramps" from whatever history exists — a fresh server reports burn
+over its lifetime until the window fills). No background thread; an
+idle server pays nothing.
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_left
+from typing import Callable, Dict, List, Optional
+
+DEFAULT_FAST_WINDOW_S = 300.0
+DEFAULT_SLOW_WINDOW_S = 3600.0
+DEFAULT_SAMPLE_INTERVAL_S = 5.0
+
+# The quantile both latency objectives target (p99): the allowed bad
+# fraction their burn rates normalize by.
+LATENCY_QUANTILE = 0.99
+
+
+class SloTarget:
+    """One model's declared objectives (0 = objective not declared)."""
+
+    __slots__ = ("p99_latency_us", "ttft_p99_us", "availability")
+
+    def __init__(self, p99_latency_us: int = 0, ttft_p99_us: int = 0,
+                 availability: float = 0.0):
+        self.p99_latency_us = int(p99_latency_us or 0)
+        self.ttft_p99_us = int(ttft_p99_us or 0)
+        self.availability = float(availability or 0.0)
+
+    def declared(self) -> bool:
+        return bool(self.p99_latency_us or self.ttft_p99_us
+                    or self.availability)
+
+    @classmethod
+    def of(cls, model) -> "SloTarget":
+        return cls(getattr(model, "slo_p99_latency_us", 0),
+                   getattr(model, "slo_ttft_p99_us", 0),
+                   getattr(model, "slo_availability", 0.0))
+
+
+def wants_slo(model) -> bool:
+    return SloTarget.of(model).declared()
+
+
+def count_at_or_below(buckets, threshold_us: float) -> float:
+    """Estimated observations at or below ``threshold_us`` from
+    CUMULATIVE ``(le, count)`` pairs (telemetry snapshot order),
+    interpolating linearly inside the bucket containing the
+    threshold — the inverse of ``estimate_quantile``."""
+    pairs = sorted(buckets, key=lambda pair: pair[0])
+    if not pairs:
+        return 0.0
+    bounds = [b for b, _ in pairs]
+    idx = bisect_left(bounds, threshold_us)
+    if idx >= len(pairs):
+        return float(pairs[-1][1])
+    bound, cum = pairs[idx]
+    prev_bound = pairs[idx - 1][0] if idx > 0 else 0.0
+    prev_cum = pairs[idx - 1][1] if idx > 0 else 0.0
+    if bound == float("inf") or bound <= prev_bound:
+        return float(prev_cum)
+    fraction = (threshold_us - prev_bound) / (bound - prev_bound)
+    fraction = min(max(fraction, 0.0), 1.0)
+    return prev_cum + (cum - prev_cum) * fraction
+
+
+class SloSample:
+    """One cumulative snapshot of the counters a burn computation
+    differences. All fields are cumulative-since-start.
+    ``latency_monitored`` / ``ttft_monitored`` flag whether the
+    latency sources were actually recording when collected (telemetry
+    can be disabled): a declared objective whose source is off must
+    fail the verdict loudly, never report burn 0."""
+
+    __slots__ = ("ts", "latency_total", "latency_good", "ttft_total",
+                 "ttft_good", "ok_count", "bad_count",
+                 "latency_monitored", "ttft_monitored")
+
+    def __init__(self, ts: float, latency_total: float = 0.0,
+                 latency_good: float = 0.0, ttft_total: float = 0.0,
+                 ttft_good: float = 0.0, ok_count: float = 0.0,
+                 bad_count: float = 0.0, latency_monitored: bool = True,
+                 ttft_monitored: bool = True):
+        self.ts = ts
+        self.latency_total = latency_total
+        self.latency_good = latency_good
+        self.ttft_total = ttft_total
+        self.ttft_good = ttft_good
+        self.ok_count = ok_count
+        self.bad_count = bad_count
+        self.latency_monitored = latency_monitored
+        self.ttft_monitored = ttft_monitored
+
+
+class SloEngine:
+    """Burn-rate computation over a ring of :class:`SloSample`s per
+    model. ``targets_fn`` lists the (model_name, target, model)
+    triples currently served; ``collect_fn(model_name, target)``
+    returns a fresh cumulative :class:`SloSample` (the core wires both
+    to its telemetry registry and stats); ``incident_hook(model,
+    label)`` fires on a healthy->unhealthy transition."""
+
+    def __init__(self, targets_fn: Callable[[], list],
+                 collect_fn: Callable[[str, SloTarget], SloSample],
+                 fast_window_s: float = DEFAULT_FAST_WINDOW_S,
+                 slow_window_s: float = DEFAULT_SLOW_WINDOW_S,
+                 min_sample_interval_s: float = DEFAULT_SAMPLE_INTERVAL_S,
+                 now_fn: Optional[Callable[[], float]] = None,
+                 incident_hook: Optional[Callable[[str, str], None]]
+                 = None):
+        import time as _time
+
+        self._targets_fn = targets_fn
+        self._collect_fn = collect_fn
+        self.fast_window_s = float(fast_window_s)
+        self.slow_window_s = float(slow_window_s)
+        self.min_sample_interval_s = float(min_sample_interval_s)
+        self._now = now_fn or _time.monotonic
+        self._incident_hook = incident_hook
+        # Implicit zero baseline: every cumulative counter was 0 when
+        # the engine was created, so a model's first real sample can
+        # difference against (t0, zeros) — without it, a run shorter
+        # than one sample interval would always report burn 0.
+        self._t0 = self._now()
+        self._lock = threading.Lock()
+        # model -> list of SloSample, oldest first, pruned past the
+        # slow window (+ one sample of slack so the window boundary
+        # always has a baseline).
+        self._samples: Dict[str, List[SloSample]] = {}
+        self._healthy: Dict[str, bool] = {}
+
+    # -- sampling ----------------------------------------------------------
+
+    def _store_sample(self, model_name: str, fresh: SloSample,
+                      force: bool) -> List[SloSample]:
+        """The ONE locked append path (shared by sample() and
+        evaluate()): interval check, ts-ordering guard, and
+        slow-window prune all happen atomically, so concurrent
+        callers can neither double-append within one interval nor
+        insert an older-ts sample after a newer one (the baseline
+        scan assumes ts order). Returns a snapshot of the model's
+        history including any just-stored sample."""
+        with self._lock:
+            samples = self._samples.get(model_name)
+            if samples is None:
+                # Implicit zero baseline at engine start: cumulative
+                # counters were all 0 then, so the first real sample
+                # has something honest to difference against.
+                samples = self._samples[model_name] = [
+                    SloSample(self._t0)]
+            due = (force or len(samples) == 1
+                   or fresh.ts - samples[-1].ts
+                   >= self.min_sample_interval_s)
+            if due and fresh.ts >= samples[-1].ts:
+                samples.append(fresh)
+                # Prune everything older than the slow window except
+                # the newest such sample — the boundary baseline.
+                horizon = fresh.ts - self.slow_window_s
+                while len(samples) > 2 and samples[1].ts <= horizon:
+                    samples.pop(0)
+            return list(samples)
+
+    def sample(self, force: bool = False) -> None:
+        """Appends a cumulative snapshot per SLO-declaring model if the
+        newest one is older than ``min_sample_interval_s`` (``force``
+        skips the interval check — tests and window-boundary
+        verification)."""
+        now = self._now()
+        try:
+            targets = self._targets_fn()
+        except Exception:  # noqa: BLE001 — observability never raises
+            return
+        for model_name, target, _model in targets:
+            with self._lock:
+                samples = self._samples.get(model_name)
+                if samples and len(samples) > 1 and not force and \
+                        now - samples[-1].ts < self.min_sample_interval_s:
+                    continue  # cheap pre-check; _store_sample re-checks
+            try:
+                snapshot = self._collect_fn(model_name, target)
+            except Exception:  # noqa: BLE001
+                continue
+            snapshot.ts = now
+            self._store_sample(model_name, snapshot, force)
+
+    @staticmethod
+    def _burns(target: SloTarget, old: SloSample,
+               new: SloSample) -> Dict[str, float]:
+        """Per-objective burn rates between two cumulative samples."""
+        out: Dict[str, float] = {}
+        if target.p99_latency_us:
+            total = max(new.latency_total - old.latency_total, 0.0)
+            good = max(new.latency_good - old.latency_good, 0.0)
+            if total > 0:
+                bad_fraction = max(total - good, 0.0) / total
+                out["p99_latency_us"] = bad_fraction \
+                    / (1.0 - LATENCY_QUANTILE)
+        if target.ttft_p99_us:
+            total = max(new.ttft_total - old.ttft_total, 0.0)
+            good = max(new.ttft_good - old.ttft_good, 0.0)
+            if total > 0:
+                bad_fraction = max(total - good, 0.0) / total
+                out["ttft_p99_us"] = bad_fraction \
+                    / (1.0 - LATENCY_QUANTILE)
+        if target.availability:
+            ok = max(new.ok_count - old.ok_count, 0.0)
+            bad = max(new.bad_count - old.bad_count, 0.0)
+            allowed = 1.0 - min(target.availability, 0.999999)
+            if ok + bad > 0:
+                out["availability"] = (bad / (ok + bad)) / allowed
+        return out
+
+    def _window_baseline(self, samples: List[SloSample],
+                         window_s: float) -> Optional[SloSample]:
+        """The newest sample at least ``window_s`` old, else the
+        oldest sample (the ramping window), else None."""
+        if len(samples) < 2:
+            return None
+        horizon = samples[-1].ts - window_s
+        baseline = None
+        for sample in samples[:-1]:
+            if sample.ts <= horizon:
+                baseline = sample
+            else:
+                break
+        return baseline or samples[0]
+
+    # -- evaluation --------------------------------------------------------
+
+    def evaluate(self, force_sample: bool = False) -> Dict[str, dict]:
+        """Samples (rate-limited) then computes the per-model verdict:
+        ``{model: {"target": {...}, "burn": {"fast": x, "slow": y},
+        "objectives": {objective: fast_burn}, "budget_remaining": b,
+        "healthy": bool}}``. The "now" endpoint of every burn is a
+        FRESH collect (never the last stored sample): a scrape mid-
+        incident must report the incident, not a point up to
+        ``min_sample_interval_s`` stale — the stored ring only
+        provides the window baselines. The same collect doubles as
+        the stored sample when the interval has elapsed (one
+        collection per model per evaluation, not two)."""
+        now = self._now()
+        try:
+            targets = {name: target
+                       for name, target, _m in self._targets_fn()}
+        except Exception:  # noqa: BLE001
+            targets = {}
+        out: Dict[str, dict] = {}
+        transitions: List[str] = []
+        for model_name, target in targets.items():
+            try:
+                fresh = self._collect_fn(model_name, target)
+            except Exception:  # noqa: BLE001
+                continue
+            fresh.ts = now
+            history = self._store_sample(model_name, fresh,
+                                         force_sample)
+            if history[-1] is not fresh:
+                history = history + [fresh]
+            burns = {"fast": 0.0, "slow": 0.0}
+            objectives: Dict[str, float] = {}
+            for window_name, window_s in (
+                    ("fast", self.fast_window_s),
+                    ("slow", self.slow_window_s)):
+                baseline = self._window_baseline(history, window_s)
+                if baseline is None:
+                    continue
+                per_objective = self._burns(target, baseline, fresh)
+                if window_name == "fast":
+                    objectives = per_objective
+                if per_objective:
+                    burns[window_name] = max(per_objective.values())
+            # Multi-window verdict: unhealthy only when both windows
+            # burn above 1 (fast alone = transient spike, slow alone =
+            # an old overrun already recovering).
+            healthy = not (burns["fast"] > 1.0 and burns["slow"] > 1.0)
+            # A declared objective whose data source is off (telemetry
+            # disabled) is UNMONITORABLE: burn 0 would be a silent
+            # lie, so the verdict fails loudly instead — perf --slo
+            # and the controller both see unhealthy.
+            monitored = not (
+                (target.p99_latency_us and not fresh.latency_monitored)
+                or (target.ttft_p99_us and not fresh.ttft_monitored))
+            if not monitored:
+                healthy = False
+            budget_remaining = max(0.0, 1.0 - burns["slow"])
+            verdict = {
+                "target": {
+                    "p99_latency_us": target.p99_latency_us,
+                    "ttft_p99_us": target.ttft_p99_us,
+                    "availability": target.availability,
+                },
+                "burn": burns,
+                "objectives": objectives,
+                "budget_remaining": budget_remaining,
+                "healthy": healthy,
+                "monitored": monitored,
+                "samples": len(history),
+            }
+            out[model_name] = verdict
+            with self._lock:
+                was_healthy = self._healthy.get(model_name, True)
+                self._healthy[model_name] = healthy
+            if was_healthy and not healthy:
+                transitions.append(model_name)
+        # Incident stamping OUTSIDE the lock (the hook serializes the
+        # flight ring; holding our lock across it would couple the two
+        # subsystems' lock orders for no reason).
+        if self._incident_hook is not None:
+            for model_name in transitions:
+                burns = out[model_name]["burn"]
+                try:
+                    self._incident_hook(
+                        model_name,
+                        "slo_burn fast=%.2f slow=%.2f"
+                        % (burns["fast"], burns["slow"]))
+                except Exception:  # noqa: BLE001 — stamping is advisory
+                    pass
+        return out
+
+    # -- exposition --------------------------------------------------------
+
+    def render(self) -> List[str]:
+        """Prometheus exposition lines for the tpu_slo_* families
+        (empty when no model declares an SLO, so idle scrapes stay
+        small)."""
+        verdicts = self.evaluate()
+        if not verdicts:
+            return []
+        lines: List[str] = []
+
+        def family(name, help_text, rows, kind="gauge"):
+            if not rows:
+                return
+            lines.append("# HELP %s %s" % (name, help_text))
+            lines.append("# TYPE %s %s" % (name, kind))
+            lines.extend(rows)
+
+        target_rows: List[str] = []
+        burn_rows: List[str] = []
+        budget_rows: List[str] = []
+        healthy_rows: List[str] = []
+        for model_name in sorted(verdicts):
+            verdict = verdicts[model_name]
+            target = verdict["target"]
+            for objective in ("p99_latency_us", "ttft_p99_us",
+                              "availability"):
+                value = target[objective]
+                if value:
+                    target_rows.append(
+                        'tpu_slo_target{model="%s",objective="%s"} %s'
+                        % (model_name, objective, repr(float(value))))
+            for window in ("fast", "slow"):
+                burn_rows.append(
+                    'tpu_slo_burn_rate{model="%s",window="%s"} %.6f'
+                    % (model_name, window, verdict["burn"][window]))
+            budget_rows.append(
+                'tpu_slo_budget_remaining{model="%s"} %.6f'
+                % (model_name, verdict["budget_remaining"]))
+            healthy_rows.append(
+                'tpu_slo_healthy{model="%s"} %d'
+                % (model_name, 1 if verdict["healthy"] else 0))
+        family("tpu_slo_target",
+               "Declared SLO objective value per model (latency "
+               "targets in us, availability as a fraction)",
+               target_rows)
+        family("tpu_slo_burn_rate",
+               "Error-budget burn rate per sliding window (1.0 = "
+               "budget spent exactly as fast as the SLO allows; the "
+               "max over the model's declared objectives)", burn_rows)
+        family("tpu_slo_budget_remaining",
+               "Fraction of the slow-window error budget left "
+               "(1 - slow burn, clamped at 0)", budget_rows)
+        family("tpu_slo_healthy",
+               "Multi-window SLO verdict: 0 when BOTH windows burn "
+               "above 1 (the signal the autoscaling/admission "
+               "controller consumes)", healthy_rows)
+        return lines

@@ -94,6 +94,16 @@ class Span:
         return out
 
 
+def exemplar_id(trace: Optional["RequestTrace"]) -> Optional[str]:
+    """The trace id a telemetry observation may stamp as an
+    OpenMetrics exemplar: only SAMPLED traces qualify — a flight
+    scratch trace is usually discarded, and an exemplar pointing at a
+    trace that exists nowhere is worse than none."""
+    if trace is None or not trace.sampled:
+        return None
+    return trace.trace_id
+
+
 def shared_span(name: str, start_ns: int, end_ns: int,
                 attrs: Optional[dict] = None) -> Span:
     """A span representing work shared by several requests (fused
@@ -106,14 +116,18 @@ def shared_span(name: str, start_ns: int, end_ns: int,
 
 
 class RequestTrace:
-    """One sampled request's span tree (plus bookkeeping the core
-    needs at emit time)."""
+    """One request's span tree (plus bookkeeping the core needs at
+    emit time). ``sampled=False`` marks a flight-recorder scratch
+    trace (client_tpu.server.flight): captured for every request but
+    usually discarded at completion — such traces must NOT stamp
+    OpenMetrics exemplars, or discarded scratch ids would overwrite
+    the sampled-trace ids the exemplar->span-tree join depends on."""
 
     __slots__ = ("trace_id", "parent_span_id", "root", "spans", "_lock",
-                 "timeline")
+                 "timeline", "sampled")
 
     def __init__(self, trace_context: Optional[str] = None,
-                 attrs: Optional[dict] = None):
+                 attrs: Optional[dict] = None, sampled: bool = True):
         parsed = parse_traceparent(trace_context)
         if parsed is not None:
             self.trace_id, self.parent_span_id = parsed
@@ -122,6 +136,7 @@ class RequestTrace:
         self.root = Span(SPAN_REQUEST, new_span_id(), self.parent_span_id,
                          time.monotonic_ns(), attrs=attrs or {})
         self.spans: List[Span] = []
+        self.sampled = bool(sampled)
         self._lock = threading.Lock()
         # Optional legacy five-point timeline (t0, queue_start,
         # compute_start, compute_end, t3) set by the executed path;
@@ -221,44 +236,58 @@ def compact_record(trace: RequestTrace, record_id: int, model_name: str,
     }
 
 
-def chrome_events(trace: RequestTrace, record_id: int, model_name: str,
-                  request_id: str) -> List[dict]:
-    """Chrome-trace complete ("X") events for ``trace_mode=chrome``.
-    One pid per model, one tid per request; ts/dur are microseconds
+def chrome_span_events(spans: List[dict], model_name: str, tid: int,
+                       thread_label: str,
+                       common_args: dict) -> List[dict]:
+    """Chrome-trace complete ("X") events from span DICTS
+    (``Span.as_dict`` form) — the ONE event builder shared by the
+    trace buffers (:func:`chrome_events`) and the flight recorder's
+    ring export, so the two can never drift to incompatible layouts.
+    One pid per model, one tid per record; ts/dur are microseconds
     (floats keep sub-us spans visible in Perfetto). The pid is a
     stable digest — builtin hash() is salted per process, which would
     scatter one model across pids between runs."""
     import zlib
 
     pid = zlib.crc32(model_name.encode()) % 100000
-    tid = record_id
     events: List[dict] = [{
         "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
-        "args": {"name": "req %s %s" % (request_id, trace.trace_id[:8])},
+        "args": {"name": thread_label},
     }, {
         "name": "process_name", "ph": "M", "pid": pid,
         "args": {"name": "model %s" % model_name},
     }]
-    for span in trace.snapshot():
-        end_ns = span.end_ns or span.start_ns
+    for span in spans:
+        start_ns = int(span.get("start_ns", 0))
+        end_ns = int(span.get("end_ns", 0)) or start_ns
         event = {
-            "name": span.name,
+            "name": span.get("name"),
             "ph": "X",
             "pid": pid,
             "tid": tid,
-            "ts": span.start_ns / 1000.0,
-            "dur": max(end_ns - span.start_ns, 0) / 1000.0,
+            "ts": start_ns / 1000.0,
+            "dur": max(end_ns - start_ns, 0) / 1000.0,
             "args": {
-                "span_id": span.span_id,
-                "parent_span_id": span.parent_id,
-                "trace_id": trace.trace_id,
-                "request_id": request_id,
+                "span_id": span.get("span_id"),
+                "parent_span_id": span.get("parent_span_id"),
             },
         }
-        if span.attrs:
-            event["args"].update(span.attrs)
+        event["args"].update(common_args)
+        if span.get("attrs"):
+            event["args"].update(span["attrs"])
         events.append(event)
     return events
+
+
+def chrome_events(trace: RequestTrace, record_id: int, model_name: str,
+                  request_id: str) -> List[dict]:
+    """Chrome-trace events for ``trace_mode=chrome`` (one sampled
+    request's tree; rendering via :func:`chrome_span_events`)."""
+    return chrome_span_events(
+        [span.as_dict() for span in trace.snapshot()],
+        model_name, record_id,
+        "req %s %s" % (request_id, trace.trace_id[:8]),
+        {"trace_id": trace.trace_id, "request_id": request_id})
 
 
 # -- stage attribution ----------------------------------------------------

@@ -58,6 +58,15 @@ DEFAULT_RETRYABLE_WIRE = ("UNAVAILABLE", "503", "RESOURCE_EXHAUSTED", "429")
 #: availability evidence (client breakers must not count them).
 QUOTA_REJECT_WIRE = frozenset({"RESOURCE_EXHAUSTED", "429"})
 
+#: Flight-recorder keep reasons for statuses with a dedicated
+#: retention label (client_tpu/server/flight.py); any other failed
+#: status keeps under the generic "error" reason.
+FLIGHT_KEEP_REASONS = {
+    "DEADLINE_EXCEEDED": "timeout",
+    "UNAVAILABLE": "shed",
+    "RESOURCE_EXHAUSTED": "quota",
+}
+
 #: Definitive client errors — the server answered decisively, which is
 #: proof of health, not failure (client breakers count them as
 #: successes). Canonical + HTTP string forms.

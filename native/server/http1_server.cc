@@ -246,13 +246,22 @@ void Http1Server::ServeRequests(int fd) {
     size_t query = target.find('?');
     std::string path =
         query == std::string::npos ? target : target.substr(0, query);
-    // Headers -> lower-cased JSON for the handler.
+    // Headers -> lower-cased JSON for the handler. The query string
+    // (stripped from the routed path so the anchored route regexes
+    // keep matching) rides along as a synthetic x-request-query
+    // header — /v2/debug's ?model= filter reads it there.
     std::string headers_json = "{";
+    bool first = true;
+    if (query != std::string::npos && query + 1 < target.size()) {
+      AppendJsonString("x-request-query", &headers_json);
+      headers_json += ":";
+      AppendJsonString(target.substr(query + 1), &headers_json);
+      first = false;
+    }
     size_t content_length = 0;
     bool content_length_seen = false;
     bool close_requested = false;
     size_t pos = line_end + 2;
-    bool first = true;
     while (pos < header_end) {
       size_t eol = buffer.find("\r\n", pos);
       std::string header = buffer.substr(pos, eol - pos);
@@ -314,6 +323,12 @@ void Http1Server::ServeRequests(int fd) {
         std::transform(value.begin(), value.end(), value.begin(),
                        [](unsigned char c) { return std::tolower(c); });
         close_requested = value.find("close") != std::string::npos;
+      }
+      if (name == "x-request-query") {
+        // Reserved for the synthetic query-string entry above: a
+        // client-supplied copy would duplicate the JSON key and
+        // (last-one-wins on parse) spoof the real query.
+        continue;
       }
       if (!first) headers_json += ",";
       AppendJsonString(name, &headers_json);

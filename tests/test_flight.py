@@ -1,0 +1,906 @@
+"""Flight recorder + SLO engine + /v2/debug (PR 14): ring-buffer
+budget semantics under concurrent capture, retroactive-keep decisions
+for every trigger, SLO burn-rate golden math across window
+boundaries, the live-introspection endpoint over both HTTP front-ends
+and gRPC, and the `slo` ModelConfig block's rendering round-trip."""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from client_tpu._infer_common import InferInput
+from client_tpu.grpc._utils import get_inference_request
+from client_tpu.server import chaos
+from client_tpu.server import tracing as spantrace
+from client_tpu.server.app import build_core, start_grpc_server
+from client_tpu.server.flight import FlightRecorder
+from client_tpu.server.http_embed import http_call
+from client_tpu.server.http_server import start_http_server_thread
+from client_tpu.server.slo import (
+    SloEngine,
+    SloSample,
+    SloTarget,
+    count_at_or_below,
+    wants_slo,
+)
+from client_tpu.utils import InferenceServerException
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+from metrics_lint import lint_debug_snapshot, lint_exposition  # noqa: E402
+
+
+def _finished_trace(duration_ns: int = 1_000_000,
+                    error: str = None) -> spantrace.RequestTrace:
+    trace = spantrace.RequestTrace(attrs={"model": "m"})
+    trace.add_timed(spantrace.SPAN_DECODE, trace.root.start_ns,
+                    trace.root.start_ns + duration_ns // 2)
+    trace.root.end_ns = trace.root.start_ns + duration_ns
+    if error:
+        trace.root.attrs["error"] = error
+    return trace
+
+
+def _simple_request(model_name: str, seed: int = 0,
+                    batched: bool = False):
+    shape = [1, 16] if batched else [16]
+    a = np.full(shape, seed % 97, dtype=np.int32)
+    b = np.arange(16, dtype=np.int32).reshape(shape)
+    t0 = InferInput("INPUT0", shape, "INT32")
+    t0.set_data_from_numpy(a)
+    t1 = InferInput("INPUT1", shape, "INT32")
+    t1.set_data_from_numpy(b)
+    return get_inference_request(model_name=model_name,
+                                 inputs=[t0, t1], outputs=None)
+
+
+class _Model:
+    """Bare model stub for recorder-unit keep decisions."""
+
+    def __init__(self, flight_slow_us=0):
+        self.flight_slow_us = flight_slow_us
+
+
+# -- ring buffer ----------------------------------------------------------
+
+
+def test_ring_count_budget_overwrites_oldest():
+    recorder = FlightRecorder(enabled=True, max_entries=3,
+                              max_bytes=1 << 30)
+    model = _Model(flight_slow_us=1)
+    for i in range(5):
+        trace = _finished_trace(duration_ns=10_000_000)
+        recorder.observe(model, "m", "req-%d" % i, trace)
+    records = recorder.snapshot("m")
+    assert [r["request_id"] for r in records] == \
+        ["req-2", "req-3", "req-4"]
+    stats = recorder.stats()["m"]
+    assert stats["entries"] == 3
+    assert stats["kept_total"] == 5
+    assert stats["overwritten_total"] == 2
+
+
+def test_ring_byte_budget_overwrites_oldest_and_tracks_bytes():
+    # learn one record's serialized size with an unconstrained probe
+    probe = FlightRecorder(enabled=True)
+    model = _Model(flight_slow_us=1)
+    probe.observe(model, "m", "a", _finished_trace(10_000_000))
+    one = probe.stats()["m"]["bytes"]
+    # a budget that fits ONE record but not two
+    recorder = FlightRecorder(enabled=True, max_entries=10_000,
+                              max_bytes=one + one // 2)
+    recorder.observe(model, "m", "a", _finished_trace(10_000_000))
+    recorder.observe(model, "m", "b", _finished_trace(10_000_000))
+    records = recorder.snapshot("m")
+    assert [r["request_id"] for r in records] == ["b"]
+    stats = recorder.stats()["m"]
+    assert stats["overwritten_total"] == 1
+    assert stats["oversized_total"] == 0
+    # accounted bytes match the resident entries exactly
+    assert stats["bytes"] == sum(
+        len(json.dumps(r, separators=(",", ":"), default=str)) + 64
+        for r in recorder.snapshot("m"))
+
+
+def test_ring_budgets_hold_under_concurrent_capture():
+    recorder = FlightRecorder(enabled=True, max_entries=16,
+                              max_bytes=64 * 1024)
+    model = _Model(flight_slow_us=1)
+    threads = 8
+    per_thread = 50
+
+    def worker(index):
+        for i in range(per_thread):
+            trace = _finished_trace(duration_ns=10_000_000)
+            recorder.observe(model, "m", "t%d-%d" % (index, i), trace)
+
+    pool = [threading.Thread(target=worker, args=(t,))
+            for t in range(threads)]
+    for thread in pool:
+        thread.start()
+    for thread in pool:
+        thread.join()
+    stats = recorder.stats()["m"]
+    assert stats["kept_total"] == threads * per_thread
+    assert stats["entries"] <= 16
+    assert stats["bytes"] <= 64 * 1024
+    assert stats["entries"] + stats["overwritten_total"] == \
+        stats["kept_total"]
+
+
+# -- retroactive keep decisions ------------------------------------------
+
+
+@pytest.mark.parametrize("status,reason", [
+    ("INTERNAL", "error"),
+    ("UNAVAILABLE", "shed"),
+    ("DEADLINE_EXCEEDED", "timeout"),
+    ("RESOURCE_EXHAUSTED", "quota"),
+])
+def test_keep_reason_per_status(status, reason):
+    recorder = FlightRecorder(enabled=True)
+    kept = recorder.observe(_Model(), "m", "r", _finished_trace(),
+                            error="boom", status=status)
+    assert kept == reason
+    record = recorder.snapshot("m")[-1]
+    assert record["reason"] == reason
+    assert record["status"] == status
+    assert record["error"] == "boom"
+    assert record["spans"][0]["name"] == "request"
+
+
+def test_keep_slow_absolute_threshold():
+    recorder = FlightRecorder(enabled=True)
+    model = _Model(flight_slow_us=5_000)
+    assert recorder.observe(model, "m", "fast",
+                            _finished_trace(1_000_000)) is None
+    kept = recorder.observe(model, "m", "slow",
+                            _finished_trace(10_000_000))
+    assert kept == "slow"
+    record = recorder.snapshot("m")[-1]
+    assert record["threshold_us"] == 5_000
+    assert record["threshold_source"] == "absolute"
+
+
+def test_keep_slow_derived_p99_threshold():
+    from client_tpu.server.telemetry import ServerTelemetry
+
+    telemetry = ServerTelemetry(enabled=True)
+    for _ in range(200):
+        telemetry.observe_request("m", 100.0)  # a tight population
+    recorder = FlightRecorder(enabled=True, telemetry=telemetry)
+    model = _Model(flight_slow_us=0)  # 0 -> derive from the histogram
+    threshold, source = recorder.slow_threshold_us(model, "m")
+    assert source == "derived_p99"
+    assert 0 < threshold < 1_000
+    assert recorder.observe(model, "m", "fast",
+                            _finished_trace(50_000)) is None
+    kept = recorder.observe(model, "m", "slow",
+                            _finished_trace(50_000_000))
+    assert kept == "slow"
+    assert recorder.snapshot("m")[-1]["threshold_source"] == \
+        "derived_p99"
+
+
+def test_derived_threshold_needs_samples():
+    from client_tpu.server.telemetry import ServerTelemetry
+
+    telemetry = ServerTelemetry(enabled=True)
+    telemetry.observe_request("m", 100.0)  # << MIN_DERIVED_SAMPLES
+    recorder = FlightRecorder(enabled=True, telemetry=telemetry)
+    threshold, source = recorder.slow_threshold_us(_Model(), "m")
+    assert (threshold, source) == (0, "none")
+    # nothing keeps while the estimate is untrusted
+    assert recorder.observe(_Model(), "m", "r",
+                            _finished_trace(50_000_000)) is None
+
+
+def test_disabled_recorder_keeps_nothing():
+    recorder = FlightRecorder(enabled=False)
+    assert recorder.observe(_Model(flight_slow_us=1), "m", "r",
+                            _finished_trace(10_000_000),
+                            error="x", status="INTERNAL") is None
+    assert recorder.snapshot() == []
+
+
+def test_mark_incident_stamps_resident_records():
+    recorder = FlightRecorder(enabled=True)
+    model = _Model(flight_slow_us=1)
+    recorder.observe(model, "m", "a", _finished_trace(10_000_000))
+    recorder.observe(model, "m", "b", _finished_trace(10_000_000))
+    stamped = recorder.mark_incident("m", "breaker_trip replica=2")
+    assert stamped == 2
+    for record in recorder.snapshot("m"):
+        assert record["incidents"][0]["label"] == \
+            "breaker_trip replica=2"
+    # a later keep is NOT stamped by the earlier incident
+    recorder.observe(model, "m", "c", _finished_trace(10_000_000))
+    assert recorder.snapshot("m")[-1]["incidents"] == []
+
+
+def test_oversized_record_is_dropped_not_retained():
+    """A single keep larger than max_bytes must neither destroy the
+    older evidence nor defeat the budget by staying resident (a
+    memory-DoS lever with client-fed payloads): it is dropped and
+    counted, everything already retained stays."""
+    recorder = FlightRecorder(enabled=True, max_entries=100,
+                              max_bytes=600)
+    model = _Model(flight_slow_us=1)
+    recorder.observe(model, "m", "small", _finished_trace(10_000_000))
+    big = _finished_trace(10_000_000, error="x" * 2000)
+    recorder.observe(model, "m", "big", big, error="x" * 2000,
+                     status="INTERNAL")
+    records = recorder.snapshot("m")
+    assert [r["request_id"] for r in records] == ["small"]
+    stats = recorder.stats()["m"]
+    assert stats["oversized_total"] == 1
+    assert stats["bytes"] <= 600  # the budget holds
+
+
+def test_client_controlled_strings_are_clamped():
+    from client_tpu.server.flight import (
+        MAX_ERROR_CHARS,
+        MAX_ID_CHARS,
+        MAX_NAME_CHARS,
+    )
+
+    recorder = FlightRecorder(enabled=True)
+    recorder.observe(_Model(), "m" * 10_000, "r" * 10_000,
+                     _finished_trace(), error="e" * 100_000,
+                     status="INTERNAL")
+    (name, snap), = recorder.stats().items()
+    assert len(name) == MAX_NAME_CHARS
+    record = recorder.snapshot(name)[0]
+    assert len(record["request_id"]) == MAX_ID_CHARS
+    assert len(record["error"]) == MAX_ERROR_CHARS
+
+
+def test_mark_incident_caps_stamps_and_accounts_bytes():
+    from client_tpu.server.flight import MAX_INCIDENT_STAMPS
+
+    recorder = FlightRecorder(enabled=True)
+    model = _Model(flight_slow_us=1)
+    recorder.observe(model, "m", "r", _finished_trace(10_000_000))
+    bytes_before = recorder.stats()["m"]["bytes"]
+    for i in range(MAX_INCIDENT_STAMPS * 3):
+        recorder.mark_incident("m", "flap %d" % i)
+    record = recorder.snapshot("m")[0]
+    # capped: the oldest stamps rolled off, the newest survive
+    assert len(record["incidents"]) == MAX_INCIDENT_STAMPS
+    assert record["incidents"][-1]["label"] == \
+        "flap %d" % (MAX_INCIDENT_STAMPS * 3 - 1)
+    bytes_after = recorder.stats()["m"]["bytes"]
+    # accounted, and bounded by the cap (not by the flap count)
+    assert bytes_before < bytes_after <= bytes_before + 60 * (
+        MAX_INCIDENT_STAMPS + 1)
+
+
+def test_stamped_record_eviction_leaves_no_phantom_bytes():
+    """A record stamped by mark_incident grows its accounted size;
+    evicting it must subtract that grown size — churning stamped
+    records out of the ring must leave bytes == exact resident sum."""
+    recorder = FlightRecorder(enabled=True, max_entries=4,
+                              max_bytes=1 << 30)
+    model = _Model(flight_slow_us=1)
+    for i in range(4):
+        recorder.observe(model, "m", "old-%d" % i,
+                         _finished_trace(10_000_000))
+    recorder.mark_incident("m", "burn")
+    for i in range(8):  # churn every stamped record out
+        recorder.observe(model, "m", "new-%d" % i,
+                         _finished_trace(10_000_000))
+    stats = recorder.stats()["m"]
+    resident = sum(
+        len(json.dumps(r, separators=(",", ":"), default=str)) + 64
+        for r in recorder.snapshot("m"))
+    assert stats["bytes"] == resident  # no stamp residue
+
+
+def test_quota_and_drain_rejects_land_in_flight_ring():
+    """Admission-stage failures (tenant quota 429, drain/unknown-model
+    rejects) fire before the scratch-capture path — they must still
+    be retained with their dedicated keep reasons."""
+    core = build_core(["simple_slo"],
+                      tenant_quotas="default=rate:1000,concurrency:1")
+    try:
+        request = _simple_request("simple_slo")
+        request.parameters["tenant"].string_param = "t1"
+        # exhaust t1's concurrency slot so the next request rejects
+        core.tenant_quotas.acquire("t1")
+        caller_trace = "00-%032x-%016x-01" % (0xabc123, 0x42)
+        with pytest.raises(InferenceServerException):
+            core.infer(request, trace_context=caller_trace)
+        records = core.flight.snapshot("simple_slo")
+        assert records and records[-1]["reason"] == "quota"
+        assert records[-1]["status"] == "RESOURCE_EXHAUSTED"
+        # the record adopted the caller's W3C trace id (joinable)
+        assert records[-1]["trace_id"] == "%032x" % 0xabc123
+        # unknown-model reject (NOT_FOUND) retained too
+        with pytest.raises(InferenceServerException):
+            core.infer(_simple_request("no_such_model"))
+        bogus = core.flight.snapshot("no_such_model")
+        assert bogus and bogus[-1]["reason"] == "error"
+    finally:
+        core.shutdown()
+
+
+def test_ring_count_cap_folds_into_overflow():
+    from client_tpu.server.flight import MAX_RINGS, OVERFLOW_RING
+
+    recorder = FlightRecorder(enabled=True)
+    for i in range(MAX_RINGS + 5):
+        recorder.observe(_Model(), "model-%d" % i, "r",
+                         _finished_trace(), error="x",
+                         status="INTERNAL")
+    stats = recorder.stats()
+    assert len(stats) == MAX_RINGS + 1  # the cap + the overflow ring
+    assert stats[OVERFLOW_RING]["kept_total"] == 5
+
+
+def test_unmonitorable_latency_objective_fails_verdict(slo_core):
+    """CLIENT_TPU_TELEMETRY=off freezes the latency histograms; a
+    declared latency objective must then fail the verdict loudly,
+    never report burn 0 / healthy (the silent-PASS trap)."""
+    core = slo_core
+    core.infer(_simple_request("simple_slo"))
+    assert core.slo.evaluate(force_sample=True)["simple_slo"]["healthy"]
+    core.telemetry.enabled = False
+    try:
+        verdict = core.slo.evaluate(force_sample=True)["simple_slo"]
+        assert verdict["monitored"] is False
+        assert verdict["healthy"] is False
+        assert "tpu_slo_healthy{model=\"simple_slo\"} 0" in \
+            core.metrics_text()
+    finally:
+        core.telemetry.enabled = True
+    verdict = core.slo.evaluate(force_sample=True)["simple_slo"]
+    assert verdict["monitored"] and verdict["healthy"]
+
+
+def test_flush_chrome_writes_loadable_events(tmp_path):
+    recorder = FlightRecorder(enabled=True)
+    recorder.observe(_Model(flight_slow_us=1), "m", "r",
+                     _finished_trace(10_000_000))
+    path = tmp_path / "flight.json"
+    assert recorder.flush_chrome(str(path)) == 1
+    text = path.read_text()
+    # chrome-trace format allows the missing close bracket
+    events = json.loads(text.rstrip().rstrip(",") + "]")
+    names = {e.get("name") for e in events}
+    assert "request" in names and "decode" in names
+    args = [e["args"] for e in events if e.get("ph") == "X"]
+    assert all(a["request_id"] == "r" for a in args)
+    # the ring is NOT cleared by an export
+    assert recorder.snapshot("m")
+
+
+# -- in-flight registry ---------------------------------------------------
+
+
+def test_in_flight_registry_tracks_age_and_stage():
+    recorder = FlightRecorder(enabled=True)
+    trace = spantrace.RequestTrace(attrs={"model": "m"})
+    token = recorder.track("m", "req-1", trace)
+    live = recorder.in_flight()
+    assert len(live) == 1
+    assert live[0]["request_id"] == "req-1"
+    assert live[0]["stage"] == "admitted"
+    trace.add_timed(spantrace.SPAN_DECODE, trace.root.start_ns,
+                    trace.root.start_ns + 1000)
+    assert recorder.in_flight()[0]["stage"] == "decode"
+    recorder.untrack(token)
+    assert recorder.in_flight() == []
+
+
+# -- SLO engine golden math -----------------------------------------------
+
+
+def test_count_at_or_below_interpolates():
+    buckets = [(100.0, 10.0), (200.0, 30.0), (float("inf"), 40.0)]
+    assert count_at_or_below(buckets, 100.0) == pytest.approx(10.0)
+    # halfway through the (100, 200] bucket -> half its 20 counts
+    assert count_at_or_below(buckets, 150.0) == pytest.approx(20.0)
+    # +Inf-bucket observations can never be placed below a finite
+    # threshold: they count as OVER target (conservative — the SLO
+    # never credits unbounded observations as good)
+    assert count_at_or_below(buckets, 1e9) == pytest.approx(30.0)
+    assert count_at_or_below(buckets, 0.0) == pytest.approx(0.0)
+
+
+def _engine(samples_by_model, targets, now, **kwargs):
+    """An engine fed by canned cumulative samples: collect_fn pops the
+    next sample for the model each time it is called."""
+    def targets_fn():
+        return [(name, target, None) for name, target in targets.items()]
+
+    def collect_fn(name, target):
+        queue = samples_by_model[name]
+        sample = queue[0] if len(queue) == 1 else queue.pop(0)
+        return SloSample(0.0, **sample)
+
+    clock = {"now": now[0]}
+    engine = SloEngine(targets_fn, collect_fn,
+                       now_fn=lambda: clock["now"], **kwargs)
+    return engine, clock
+
+
+def test_burn_rate_golden_math_across_window_boundaries():
+    """Fast window 60 s, slow 1000 s. A bad burst lands before the
+    t=100 sample; clean traffic follows. At t=700 the fast window's
+    baseline (the newest sample at least 60 s old) post-dates the
+    burst, so fast burn is 0, while the slow window ramps back to the
+    engine-start zero seed and still spans the burst — the boundary
+    behavior the multi-window methodology exists for."""
+    target = SloTarget(availability=0.99)  # allowed bad fraction 1%
+    # cumulative (ok, bad): the burst has put 50 bad / 50 ok by t=100
+    feed = {"m": [
+        {"ok_count": 50.0, "bad_count": 50.0},    # sampled at t=100
+        {"ok_count": 1050.0, "bad_count": 50.0},  # sampled at t=650
+        {"ok_count": 1150.0, "bad_count": 50.0},  # fresh at t=700
+    ]}
+    engine, clock = _engine(feed, {"m": target}, [0.0],
+                            fast_window_s=60.0, slow_window_s=1000.0,
+                            min_sample_interval_s=0.0)
+    clock["now"] = 100.0
+    engine.sample(force=True)      # burst cumulative recorded
+    clock["now"] = 650.0
+    engine.sample(force=True)      # clean history point
+    clock["now"] = 700.0
+    verdict = engine.evaluate()["m"]
+    # fast baseline: newest sample <= t=640 is the t=100 one; the
+    # delta from there is 1100 ok / 0 bad -> burn 0 (the burst itself
+    # is cumulative IN the baseline, so it is excluded)
+    assert verdict["burn"]["fast"] == pytest.approx(0.0)
+    # slow window (1000 s) ramps to the zero seed at t=0: delta
+    # 1150 ok + 50 bad -> 4.17% bad against the 1% allowance
+    assert verdict["burn"]["slow"] == pytest.approx(
+        (50.0 / 1200.0) / 0.01, rel=1e-6)
+    # fast calm + slow burning -> still healthy (multi-window rule)
+    assert verdict["healthy"] is True
+    assert verdict["budget_remaining"] == pytest.approx(
+        max(0.0, 1.0 - verdict["burn"]["slow"]))
+
+
+def test_burn_rate_latency_objective_and_unhealthy_transition():
+    target = SloTarget(p99_latency_us=1000)
+    # 10% of requests over the 1 ms target -> burn 10x (allowed 1%)
+    feed = {"m": [
+        {"latency_total": 100.0, "latency_good": 90.0},
+    ]}
+    incidents = []
+    engine, clock = _engine(
+        feed, {"m": target}, [10.0],
+        fast_window_s=60.0, slow_window_s=600.0,
+        min_sample_interval_s=0.0,
+        incident_hook=lambda m, label: incidents.append((m, label)))
+    verdict = engine.evaluate()["m"]
+    assert verdict["burn"]["fast"] == pytest.approx(10.0)
+    assert verdict["burn"]["slow"] == pytest.approx(10.0)
+    assert verdict["objectives"]["p99_latency_us"] == \
+        pytest.approx(10.0)
+    # both windows burn > 1 -> unhealthy, and the transition fired
+    # the incident hook exactly once
+    assert verdict["healthy"] is False
+    assert incidents == [("m", "slo_burn fast=10.00 slow=10.00")]
+    engine.evaluate()
+    assert len(incidents) == 1  # no re-fire while still unhealthy
+
+
+def test_burn_rate_max_over_objectives():
+    target = SloTarget(p99_latency_us=1000, availability=0.999)
+    feed = {"m": [{
+        "latency_total": 1000.0, "latency_good": 995.0,  # 0.5% -> 0.5x
+        "ok_count": 990.0, "bad_count": 10.0,  # 1% bad / 0.1% -> 10x
+    }]}
+    engine, _clock = _engine(feed, {"m": target}, [10.0],
+                             min_sample_interval_s=0.0)
+    verdict = engine.evaluate()["m"]
+    assert verdict["burn"]["fast"] == pytest.approx(10.0, rel=1e-3)
+    assert verdict["objectives"]["availability"] == \
+        pytest.approx(10.0, rel=1e-3)
+    assert verdict["objectives"]["p99_latency_us"] == \
+        pytest.approx(0.5, rel=1e-3)
+
+
+def test_store_sample_rejects_out_of_order_timestamps():
+    """The shared locked store guards ts ordering: a racing caller's
+    stale-timestamp sample must not land after a newer one (the
+    window-baseline scan assumes ts-sorted history)."""
+    engine = SloEngine(lambda: [], lambda n, t: SloSample(0.0),
+                       now_fn=lambda: 0.0)
+    engine._store_sample("m", SloSample(10.0), force=True)
+    history = engine._store_sample("m", SloSample(5.0), force=True)
+    assert [s.ts for s in history] == [0.0, 10.0]  # stale ts dropped
+
+
+def test_wants_slo_and_target_of():
+    assert not wants_slo(_Model())
+    model = _Model()
+    model.slo_availability = 0.999
+    assert wants_slo(model)
+    target = SloTarget.of(model)
+    assert target.availability == 0.999
+    assert target.p99_latency_us == 0
+
+
+# -- e2e: flight capture through the core ---------------------------------
+
+
+@pytest.fixture()
+def slo_core():
+    core = build_core(["simple_slo"])
+    yield core
+    chaos.configure(None)
+    core.shutdown()
+
+
+def test_e2e_error_and_slow_keeps_at_trace_rate_zero(slo_core):
+    core = slo_core
+    for i in range(4):
+        core.infer(_simple_request("simple_slo", i))  # warm
+    kept_before = core.flight.stats().get("simple_slo", {}).get(
+        "kept_total", 0)
+    chaos.configure_from_spec("error_rate=1.0,seed=5")
+    with pytest.raises(InferenceServerException):
+        core.infer(_simple_request("simple_slo"))
+    chaos.configure_from_spec("latency_ms=120,seed=5")
+    core.infer(_simple_request("simple_slo"))
+    chaos.configure(None)
+    records = core.flight.snapshot("simple_slo")
+    fresh = records[kept_before:]
+    reasons = [r["reason"] for r in fresh]
+    assert reasons == ["shed", "slow"]
+    slow = fresh[-1]
+    names = {span["name"] for span in slow["spans"]}
+    # the kept trace carries the full span tree at trace_rate=0
+    assert {"request", "decode", "device_execute", "encode"} <= names
+    assert slow["duration_us"] >= 100_000
+    assert slow["threshold_source"] == "absolute"
+
+
+def test_e2e_timeout_keep_through_single_flight(slo_core):
+    """A DEADLINE_EXCEEDED (follower deadline) lands in the ring as a
+    timeout keep — driven through the real core error path."""
+    core = slo_core
+    request = _simple_request("simple_slo")
+    request.parameters["timeout"].int64_param = 1  # 1 us deadline
+    chaos.configure_from_spec("latency_ms=50,seed=5")
+    # direct path ignores queue deadlines; emulate the batcher's
+    # timeout by observing directly what core would feed
+    chaos.configure(None)
+    trace = _finished_trace(error="expired")
+    kept = core.flight.observe(
+        core.repository.get("simple_slo"), "simple_slo", request.id,
+        trace, error="expired", status="DEADLINE_EXCEEDED")
+    assert kept == "timeout"
+
+
+def test_e2e_sampled_trace_also_lands_in_flight(slo_core, tmp_path):
+    """trace_rate=1 sampling and flight retention are not exclusive:
+    a sampled request that errors is both emitted to the trace file
+    and kept in the ring, under the SAME trace id."""
+    core = slo_core
+    trace_file = tmp_path / "trace.jsonl"
+    core.trace_setting("", {
+        "trace_level": ["TIMESTAMPS"], "trace_rate": ["1"],
+        "trace_file": [str(trace_file)], "log_frequency": ["1"],
+    })
+    chaos.configure_from_spec("error_rate=1.0,seed=5")
+    with pytest.raises(InferenceServerException):
+        core.infer(_simple_request("simple_slo"))
+    chaos.configure(None)
+    core.trace_setting("", {"trace_level": ["OFF"]})
+    record = core.flight.snapshot("simple_slo")[-1]
+    emitted = [json.loads(line)
+               for line in trace_file.read_text().splitlines() if line]
+    assert any(e["trace_id"] == record["trace_id"] for e in emitted)
+
+
+def test_stream_error_keeps_via_root_attrs():
+    core = build_core(["repeat_int32"])
+    try:
+        def stream_request(input_name):
+            request = get_inference_request(model_name="repeat_int32",
+                                            inputs=[], outputs=None)
+            tensor = request.inputs.add()
+            tensor.name = input_name
+            tensor.datatype = "INT32"
+            tensor.shape.extend([4])
+            request.raw_input_contents.append(
+                np.arange(4, dtype=np.int32).tobytes())
+            return request
+
+        # A decode failure rides the stream as an error response, not
+        # an exception — the keep decision must still see it.
+        responses = list(core.stream_infer(stream_request("BOGUS")))
+        assert any(r.error_message for r in responses)
+        records = core.flight.snapshot("repeat_int32")
+        assert records and records[-1]["reason"] == "error"
+        assert records[-1]["status"] == "INVALID_ARGUMENT"
+        # a clean long stream is NOT kept (allow_slow=False)
+        kept_before = core.flight.stats()["repeat_int32"]["kept_total"]
+        for _ in core.stream_infer(stream_request("IN")):
+            pass
+        assert core.flight.stats()["repeat_int32"]["kept_total"] == \
+            kept_before
+    finally:
+        core.shutdown()
+
+
+# -- SLO statistics + metrics over the core -------------------------------
+
+
+def test_slo_statistics_and_metrics_families(slo_core):
+    core = slo_core
+    core.slo.min_sample_interval_s = 0.0
+    for i in range(8):
+        core.infer(_simple_request("simple_slo", i))
+    stat = core.model_statistics("simple_slo").model_stats[0]
+    assert stat.slo_stats.p99_latency_target_us == 50_000
+    assert stat.slo_stats.availability_target == \
+        pytest.approx(0.999)
+    assert stat.slo_stats.healthy
+    text = core.metrics_text()
+    for family in ("tpu_slo_target", "tpu_slo_burn_rate",
+                   "tpu_slo_budget_remaining", "tpu_slo_healthy",
+                   "tpu_server_info"):
+        assert family in text, family
+    errors, types, _series = lint_exposition(text)
+    assert not errors, errors[:5]
+    assert types["tpu_slo_burn_rate"] == "gauge"
+    assert 'window="fast"' in text and 'window="slow"' in text
+
+
+def test_server_info_uptime_advances(slo_core):
+    core = slo_core
+    first = [line for line in core.metrics_text().splitlines()
+             if line.startswith("tpu_server_info")][0]
+    assert 'name="client_tpu_server"' in first
+    assert 'version=' in first
+    core._started_mono -= 100  # simulate an older process
+    second = [line for line in core.metrics_text().splitlines()
+              if line.startswith("tpu_server_info")][0]
+    assert int(second.rsplit(" ", 1)[1]) >= \
+        int(first.rsplit(" ", 1)[1]) + 100
+
+
+# -- config rendering round-trip ------------------------------------------
+
+
+def test_slo_block_config_rendering_round_trip(slo_core):
+    core = slo_core
+    config = core.model_config("simple_slo").config
+    assert config.slo.p99_latency_us == 50_000
+    assert config.slo.availability == pytest.approx(0.999)
+    # over the embedded REST dispatcher (JSON view)
+    status, _headers, body = http_call(
+        core, "GET", "/v2/models/simple_slo/config", {}, b"")
+    assert status == 200
+    doc = json.loads(body)
+    assert int(doc["slo"]["p99_latency_us"]) == 50_000
+    assert float(doc["slo"]["availability"]) == pytest.approx(0.999)
+    # a model without the block renders no slo section
+    core.repository.load("simple")
+    config = core.model_config("simple").config
+    assert not config.HasField("slo")
+
+
+# -- /v2/debug e2e over the three transports ------------------------------
+
+
+def _assert_debug_doc(doc):
+    assert doc["server"]["name"] == "client_tpu_server"
+    assert doc["server"]["uptime_s"] >= 0
+    assert any(m["name"] == "simple_slo" for m in doc["models"])
+    assert "simple_slo" in doc["slo"]
+    assert "in_flight" in doc and "flight" in doc
+    assert lint_debug_snapshot(doc) == []
+
+
+def test_debug_endpoint_http_embed(slo_core):
+    core = slo_core
+    core.infer(_simple_request("simple_slo"))
+    status, _headers, body = http_call(core, "GET",
+                                       "/v2/debug?model=simple_slo",
+                                       {}, b"")
+    assert status == 200
+    _assert_debug_doc(json.loads(body))
+    status, _headers, body = http_call(
+        core, "GET", "/v2/debug/flight?model=simple_slo", {}, b"")
+    assert status == 200
+    doc = json.loads(body)
+    assert "records" in doc and "stats" in doc
+    # the native HTTP/1.1 front-end strips the query before routing
+    # and forwards it as x-request-query — the filter must still work
+    status, _headers, body = http_call(
+        core, "GET", "/v2/debug", {"x-request-query": "model=no_such"},
+        b"")
+    assert status == 200
+    assert json.loads(body)["models"] == []  # filter applied
+
+
+def test_debug_endpoint_aiohttp(slo_core):
+    core = slo_core
+    chaos.configure_from_spec("latency_ms=120,seed=3")
+    core.infer(_simple_request("simple_slo"))
+    chaos.configure(None)
+    runner = start_http_server_thread(core, host="127.0.0.1", port=0)
+    try:
+        base = "http://127.0.0.1:%d" % runner.port
+        with urllib.request.urlopen(base + "/v2/debug") as response:
+            doc = json.loads(response.read())
+        _assert_debug_doc(doc)
+        url = base + "/v2/debug/flight?model=simple_slo"
+        with urllib.request.urlopen(url) as response:
+            flight_doc = json.loads(response.read())
+        assert any(r["reason"] == "slow"
+                   for r in flight_doc["records"])
+        assert lint_debug_snapshot(flight_doc) == []
+    finally:
+        runner.stop()
+
+
+def test_debug_endpoint_grpc(slo_core):
+    import grpc
+
+    core = slo_core
+    chaos.configure_from_spec("error_rate=1.0,seed=3")
+    with pytest.raises(InferenceServerException):
+        core.infer(_simple_request("simple_slo"))
+    chaos.configure(None)
+    handle = start_grpc_server(core=core, address="127.0.0.1:0")
+    try:
+        channel = grpc.insecure_channel(handle.address)
+        snapshot = channel.unary_unary(
+            "/inference.Debug/Snapshot",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b)
+        _assert_debug_doc(json.loads(snapshot(b'{"model":"simple_slo"}')))
+        flight = channel.unary_unary(
+            "/inference.Debug/Flight",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b)
+        doc = json.loads(flight(b'{"model":"simple_slo"}'))
+        assert any(r["reason"] == "shed" for r in doc["records"])
+        channel.close()
+    finally:
+        handle.stop()
+
+
+def test_debug_queue_section_shows_bucket_depth():
+    core = build_core(["simple_qos"])
+    try:
+        batcher = core._batcher_for(core.repository.get("simple_qos"))
+        snap = batcher.debug_snapshot()
+        assert snap["max_queue_size"] == 32
+        assert snap["pending_count"] == 0
+        core.infer(_simple_request("simple_qos", batched=True))
+        doc = core.debug_snapshot("simple_qos")
+        assert "simple_qos" in doc["queues"]
+        assert lint_debug_snapshot(doc) == []
+    finally:
+        core.shutdown()
+
+
+# -- debug-snapshot cardinality lint --------------------------------------
+
+
+def test_lint_debug_snapshot_flags_identity_keys_and_fanout():
+    assert lint_debug_snapshot({"models": {"simple": {"ok": 1}}}) == []
+    bad = {"requests": {"a" * 16: {"age": 1}}}  # hex-id keyed dict
+    errors = lint_debug_snapshot(bad)
+    assert errors and "identity" in errors[0]
+    uuid_key = "12345678-1234-1234-1234-123456789abc"
+    assert lint_debug_snapshot({"x": {uuid_key: 1}})
+    assert lint_debug_snapshot({"x": {"1234567": 1}})
+    big = {"x": {str(n) + "k": n for n in range(3000)}}
+    errors = lint_debug_snapshot(big)
+    assert errors and "fans out" in errors[0]
+
+
+# -- replica ejection stamps the ring -------------------------------------
+
+
+def test_breaker_trip_stamps_flight_records():
+    core = build_core(["simple_replicas"])
+    try:
+        model = core.repository.get("simple_replicas")
+        # seed the ring with a kept record first
+        model.flight_slow_us = 1
+        core.infer(_simple_request("simple_replicas", batched=True))
+        assert core.flight.snapshot("simple_replicas")
+        chaos.configure(chaos.ChaosConfig(error_rate=1.0, seed=3,
+                                          replica="simple_replicas:1"))
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            try:
+                core.infer(_simple_request("simple_replicas",
+                                           batched=True))
+            except InferenceServerException:
+                pass
+            snap = core.debug_snapshot("simple_replicas")
+            replicas = snap["replicas"].get("simple_replicas", {})
+            if replicas.get("ejections", 0) >= 1:
+                break
+            time.sleep(0.05)
+        chaos.configure(None)
+        records = core.flight.snapshot("simple_replicas")
+        labels = [incident["label"]
+                  for record in records
+                  for incident in record["incidents"]]
+        assert any("replica=1" in label for label in labels), labels
+    finally:
+        chaos.configure(None)
+        core.shutdown()
+
+
+# -- perf --slo report unit -----------------------------------------------
+
+
+def test_print_slo_report_verdicts(capsys):
+    from client_tpu.perf.metrics_manager import parse_prometheus
+    from client_tpu.perf.report import print_slo_report
+
+    text = "\n".join([
+        'tpu_slo_target{model="m",objective="p99_latency_us"} 5000.0',
+        'tpu_slo_burn_rate{model="m",window="fast"} 2.5',
+        'tpu_slo_burn_rate{model="m",window="slow"} 0.2',
+        'tpu_slo_budget_remaining{model="m"} 0.8',
+        'tpu_slo_healthy{model="m"} 1',
+    ])
+    metrics = parse_prometheus(text)
+    assert print_slo_report(metrics) is True
+    assert print_slo_report(metrics, strict=True) is False  # fast > 1
+    out = capsys.readouterr().out
+    assert "burn fast 2.50x / slow 0.20x" in out
+    assert "verdict HEALTHY" in out
+    unhealthy = parse_prometheus(text.replace(
+        'tpu_slo_healthy{model="m"} 1', 'tpu_slo_healthy{model="m"} 0'))
+    assert print_slo_report(unhealthy) is False
+    # an explicitly requested gate must not pass vacuously when the
+    # scrape carries no tpu_slo_* series at all
+    assert print_slo_report(parse_prometheus("")) is False
+
+
+def test_flight_scratch_traces_never_stamp_exemplars(slo_core):
+    """At trace_rate=0 with the flight recorder on, every request
+    carries a scratch trace — but its (usually discarded) trace id
+    must never land as a telemetry exemplar; only SAMPLED traces
+    qualify for the exemplar->span-tree join."""
+    core = slo_core
+    for i in range(5):
+        core.infer(_simple_request("simple_slo", i))
+    hist = core.telemetry.for_model("simple_slo").request
+    assert hist.snapshot()["exemplars"] == {}
+    # sampled traffic DOES stamp exemplars, with the emitted trace id
+    core.trace_setting("", {
+        "trace_level": ["TIMESTAMPS"], "trace_rate": ["1"],
+        "trace_file": ["/tmp/_flight_exemplar_trace.jsonl"],
+    })
+    core.infer(_simple_request("simple_slo"))
+    core.trace_setting("", {"trace_level": ["OFF"]})
+    exemplars = hist.snapshot()["exemplars"]
+    assert exemplars, "sampled request stamped no exemplar"
+
+
+def test_availability_burn_counts_each_drop_once(slo_core):
+    """A queue reject/shed increments both its per-cause counter AND
+    fail_count; the availability collector must count it once (via
+    fail_count alone), not twice."""
+    core = slo_core
+    stats = core._stats_for("simple_slo")
+    target = core.slo._targets_fn()[0][1]
+    with stats.lock:
+        stats.success_count = 999
+        stats.fail_count = 1
+        stats.rejected_count = 1  # the same dropped request
+        stats.shed_count = 1      # (cause counters overlap fail_count)
+    sample = core._slo_collect("simple_slo", target)
+    assert sample.ok_count == 999.0
+    assert sample.bad_count == 1.0

@@ -6,7 +6,7 @@
 # regression gate). Usage: tools/ci_check.sh [min_passed]
 set -u -o pipefail
 
-MIN_PASSED="${1:-615}"
+MIN_PASSED="${1:-650}"
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
 LOG=/tmp/_t1.log
 
@@ -240,6 +240,26 @@ fi
 grep -E "fetch smoke passed" "$FETCH_LOG"
 grep -E "real arrays|simulated DMA" "$FETCH_LOG"
 echo "OK: fetch smoke passed"
+
+# Flight-recorder / SLO smoke: chaos latency+error injection at
+# trace_rate=0 against simple_slo — >=95% of injected slow/error
+# requests must be retained in the flight ring with full span trees
+# (tail sampling, no start-time dice roll), tpu_slo_burn_rate must go
+# >1 during the injection and recover after, the /v2/debug JSON must
+# stay cardinality-bounded, and always-on capture must cost <2%
+# throughput (paired A/B on add_sub_large). Gates live in
+# tools/flight_smoke.py.
+echo "flight smoke: tail retention + SLO burn/recovery + overhead"
+FLIGHT_LOG=/tmp/_flight_smoke.log
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/flight_smoke.py \
+    > "$FLIGHT_LOG" 2>&1; then
+    echo "FAIL: flight smoke did not pass" >&2
+    tail -30 "$FLIGHT_LOG" >&2
+    exit 1
+fi
+grep -E "flight smoke passed" "$FLIGHT_LOG"
+grep -E "retention:|burn:|recovery:|overhead:" "$FLIGHT_LOG"
+echo "OK: flight smoke passed"
 
 # LLM continuous-batching smoke: paged-KV c16 vs the dense c4
 # baseline arm on the shared A/B driver — tokens/s >=5x, ITL p99

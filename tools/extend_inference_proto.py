@@ -21,7 +21,9 @@ Patches applied:
   repeated per-class ``PriorityStatistics`` / ``TenantStatistics``
   rows, and the replica-serving statistics (PR 8): repeated
   per-fault-domain ``ReplicaStatistics`` rows plus
-  ``ModelStatistics.healthy_replicas`` / ``total_replicas``.
+  ``ModelStatistics.healthy_replicas`` / ``total_replicas``, and the
+  SLO engine rows (PR 14): ``SloStatistics`` +
+  ``ModelStatistics.slo_stats``.
 * model_config_pb2.py — ``DynamicBatchingConfig.max_queue_size`` /
   ``allow_timeout_override`` / ``timeout_action`` (PR 2 queue policy;
   ``default_queue_policy_timeout_us`` has been in the schema since the
@@ -32,7 +34,8 @@ Patches applied:
   ``ModelConfig.response_cache`` (PR 5 response cache), and the
   multi-tenant QoS schema (PR 7): ``DynamicBatchingConfig.
   priority_levels`` / ``default_priority_level`` / ``shed_watermark``
-  plus the per-priority ``PriorityQueuePolicy`` rows.
+  plus the per-priority ``PriorityQueuePolicy`` rows, and the SLO
+  declaration (PR 14): ``SloConfig`` + ``ModelConfig.slo``.
 
 The ``_serialized_start/_serialized_end`` attribute lines at the bottom
 of the pb2 modules go stale after the patch; they only execute when
@@ -150,6 +153,19 @@ CACHE_DURATION_FIELDS = [
     ("cache_miss", 8),
 ]
 
+# SLO engine rows (PR 14): declared targets + multi-window burn rates
+# computed by client_tpu/server/slo.py. ModelStatistics.slo_stats is
+# field 21.
+SLO_STATS_FIELDS = [
+    ("p99_latency_target_us", 1, U64),
+    ("ttft_p99_target_us", 2, U64),
+    ("availability_target", 3, DOUBLE),
+    ("burn_rate_fast", 4, DOUBLE),
+    ("burn_rate_slow", 5, DOUBLE),
+    ("budget_remaining", 6, DOUBLE),
+    ("healthy", 7, BOOL),
+]
+
 # Queue-policy knobs on DynamicBatchingConfig (field 3 is
 # default_queue_policy_timeout_us, present since the seed).
 QUEUE_POLICY_FIELDS = [
@@ -176,6 +192,14 @@ PRIORITY_POLICY_FIELDS = [
     ("priority_level", 1, U64),
     ("max_queue_size", 2, U64),
     ("default_timeout_us", 3, U64),
+]
+
+# Per-model SLO declaration (PR 14): the `slo` block on ModelConfig
+# (field 16) the burn-rate engine reads its targets from.
+SLO_CONFIG_FIELDS = [
+    ("p99_latency_us", 1, U64),
+    ("ttft_p99_us", 2, U64),
+    ("availability", 3, DOUBLE),
 ]
 
 # Sequence-scheduler observability on ModelStatistics (field 11;
@@ -317,6 +341,21 @@ def patch_inference(file_proto: descriptor_pb2.FileDescriptorProto) -> bool:
             type_name=".inference.StreamStatistics",
             json_name="streamStats")
         changed = True
+    names = [m.name for m in file_proto.message_type]
+    if "SloStatistics" not in names:
+        anchor = names.index("StreamStatistics") + 1
+        message = descriptor_pb2.DescriptorProto(name="SloStatistics")
+        for name, number, ftype in SLO_STATS_FIELDS:
+            message.field.add(name=name, number=number, type=ftype,
+                              label=OPTIONAL, json_name=_json_name(name))
+        file_proto.message_type.insert(anchor, message)
+        changed = True
+    if not any(f.name == "slo_stats" for f in model_stats.field):
+        model_stats.field.add(
+            name="slo_stats", number=21, type=MESSAGE, label=OPTIONAL,
+            type_name=".inference.SloStatistics",
+            json_name="sloStats")
+        changed = True
     infer_stats = next(
         m for m in file_proto.message_type if m.name == "InferStatistics")
     for name, number in CACHE_DURATION_FIELDS:
@@ -398,6 +437,20 @@ def patch_model_config(file_proto: descriptor_pb2.FileDescriptorProto) -> bool:
             name="response_cache", number=15, type=MESSAGE, label=OPTIONAL,
             type_name=".inference.ResponseCacheConfig",
             json_name="responseCache")
+        changed = True
+    names = [m.name for m in file_proto.message_type]
+    if "SloConfig" not in names:
+        anchor = names.index("ModelConfig")
+        message = descriptor_pb2.DescriptorProto(name="SloConfig")
+        for name, number, ftype in SLO_CONFIG_FIELDS:
+            message.field.add(name=name, number=number, type=ftype,
+                              label=OPTIONAL, json_name=_json_name(name))
+        file_proto.message_type.insert(anchor, message)
+        changed = True
+    if not any(f.name == "slo" for f in model_config.field):
+        model_config.field.add(
+            name="slo", number=16, type=MESSAGE, label=OPTIONAL,
+            type_name=".inference.SloConfig", json_name="slo")
         changed = True
     return changed
 

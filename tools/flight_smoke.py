@@ -1,0 +1,244 @@
+#!/usr/bin/env python
+"""CI smoke for the flight recorder + SLO burn-rate engine.
+
+Four gates (tools/ci_check.sh step "flight smoke"), all at
+``trace_rate=0`` — the whole point of tail retention is that NOTHING
+was sampled at request start:
+
+1. **Anomaly retention.** Under chaos ``latency_ms`` + ``error_rate``
+   injection against ``simple_slo``, >=95% of the injected slow/error
+   requests must land in the flight ring; retained slow traces must
+   carry FULL span trees (root + the decode/execute/encode stages
+   that tile the request).
+2. **SLO burn.** ``tpu_slo_burn_rate`` for ``simple_slo`` must go >1
+   during the injection (every injected request blows through the
+   50 ms p99 target) ...
+3. **... and recover.** After chaos is cleared and clean traffic runs
+   past the fast window, the fast-window burn must fall back to <=1
+   and the verdict must return to healthy.
+4. **Overhead.** Always-on capture must cost <2% throughput vs
+   disabled (paired interleaved A/B medians on add_sub_large via
+   client_tpu.perf.bench_child.run_flight_measure — the PR-10
+   methodology; a forensic layer that must be turned off under load
+   is not always-on).
+
+Also asserts the /v2/debug and /v2/debug/flight JSON stays
+cardinality-bounded (tools/metrics_lint.lint_debug_snapshot).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+MODEL = "simple_slo"
+# The model's absolute flight_slow_us / slo_p99_latency_us target is
+# 50 ms; the injected latency must clear it with margin.
+INJECT_LATENCY_MS = 120.0
+INJECT_ERROR_RATE = 0.2
+
+
+def _request(seed: int):
+    import numpy as np
+
+    from client_tpu._infer_common import InferInput
+    from client_tpu.grpc._utils import get_inference_request
+
+    a = np.full((16,), seed % 97, dtype=np.int32)
+    b = np.arange(16, dtype=np.int32)
+    t0 = InferInput("INPUT0", [16], "INT32")
+    t0.set_data_from_numpy(a)
+    t1 = InferInput("INPUT1", [16], "INT32")
+    t1.set_data_from_numpy(b)
+    return get_inference_request(model_name=MODEL,
+                                 inputs=[t0, t1], outputs=None)
+
+
+def _run_load(core, n: int, threads: int = 4) -> tuple:
+    """(completed, errored) across a concurrent closed loop."""
+    counts = [0, 0]
+    merge = threading.Lock()
+    per_thread = max(n // threads, 1)
+
+    def worker(offset: int):
+        ok = err = 0
+        for i in range(per_thread):
+            try:
+                core.infer(_request(offset * 1000 + i))
+                ok += 1
+            except Exception:  # noqa: BLE001 — injected faults
+                err += 1
+        with merge:
+            counts[0] += ok
+            counts[1] += err
+
+    pool = [threading.Thread(target=worker, args=(i,))
+            for i in range(threads)]
+    for thread in pool:
+        thread.start()
+    for thread in pool:
+        thread.join()
+    return counts[0], counts[1]
+
+
+def _burn_fast(core) -> float:
+    """The fast-window burn rate for MODEL from a live evaluation."""
+    verdict = core.slo.evaluate(force_sample=True).get(MODEL)
+    return verdict["burn"]["fast"] if verdict else 0.0
+
+
+def main() -> int:
+    from metrics_lint import lint_debug_snapshot, lint_exposition
+
+    from client_tpu.perf.bench_child import run_flight_measure
+    from client_tpu.server import chaos
+    from client_tpu.server.app import build_core
+
+    failures = []
+    core = build_core([MODEL])
+    # Tight burn windows so the smoke observes burn AND recovery in
+    # seconds (production defaults are 5 m / 1 h; the math is window-
+    # relative, so shrinking the windows shrinks only the wait).
+    core.slo.fast_window_s = 2.0
+    core.slo.slow_window_s = 6.0
+    core.slo.min_sample_interval_s = 0.0
+    # Ring sized above the injected-anomaly count so retention
+    # measures the keep decision, not overwrite pressure.
+    core.flight.max_entries = 4096
+    try:
+        # Tracing must be OFF: retention below is pure tail sampling.
+        settings = core.trace_setting("", {})
+        if (settings.get("trace_level") or ["OFF"])[0] != "OFF":
+            failures.append("trace_level is not OFF at start")
+        _run_load(core, n=24, threads=2)  # warm, clean baseline
+        baseline_burn = _burn_fast(core)
+        # Keeps before injection (e.g. the first jit-compile request
+        # legitimately crossing the 5 ms threshold) are not the
+        # injection's anomalies — measure retention as a delta.
+        kept_before = core.flight.stats().get(MODEL, {}).get(
+            "kept_total", 0)
+
+        # -- injection window -----------------------------------------
+        chaos.configure_from_spec(
+            "latency_ms=%g,error_rate=%g,seed=11,models=%s"
+            % (INJECT_LATENCY_MS, INJECT_ERROR_RATE, MODEL))
+        ok, errored = _run_load(core, n=80)
+        injected = chaos.stats()
+        burn_during = _burn_fast(core)
+        chaos.configure(None)
+
+        stats = core.flight.stats().get(MODEL, {})
+        kept = stats.get("kept_total", 0) - kept_before
+        anomalies = ok + errored  # every injected request is slow or
+        # errored: latency_ms applies to all, errors to a fraction
+        retention = kept / anomalies if anomalies else 0.0
+        print("retention: %d/%d injected anomalies kept (%.1f%%; "
+              "%d errors, %d slow)"
+              % (kept, anomalies, retention * 100.0, errored, ok))
+        if retention < 0.95:
+            failures.append(
+                "flight ring retained %.1f%% of injected anomalies "
+                "(gate >=95%%)" % (retention * 100.0))
+
+        # Full span trees on the slow keeps (>50 ms against the
+        # model's absolute threshold): root + the stage spans that
+        # tile the request (decode/execute/encode at minimum).
+        records = core.flight.snapshot(MODEL)
+        slow = [r for r in records if r["reason"] == "slow"]
+        complete = 0
+        for record in slow:
+            names = {span["name"] for span in record["spans"]}
+            if {"request", "decode", "encode"} <= names:
+                complete += 1
+        print("span trees: %d/%d slow keeps complete (root + stage "
+              "spans)" % (complete, len(slow)))
+        if not slow:
+            failures.append("no slow-kept traces in the ring")
+        elif complete / len(slow) < 0.95:
+            failures.append(
+                "only %d/%d slow keeps carry full span trees"
+                % (complete, len(slow)))
+
+        # -- burn during injection ------------------------------------
+        print("burn: baseline %.2fx, during injection %.2fx"
+              % (baseline_burn, burn_during))
+        if burn_during <= 1.0:
+            failures.append(
+                "tpu_slo_burn_rate stayed at %.2f (<=1) during "
+                "injection" % burn_during)
+        text = core.metrics_text()
+        if "tpu_slo_burn_rate" not in text:
+            failures.append("tpu_slo_burn_rate family missing from "
+                            "/metrics")
+        errors, _types, _series = lint_exposition(text)
+        if errors:
+            failures.extend("lint: %s" % e for e in errors[:5])
+
+        # -- recovery -------------------------------------------------
+        deadline = time.time() + 20.0
+        burn_after = burn_during
+        while time.time() < deadline:
+            _run_load(core, n=16, threads=2)
+            time.sleep(0.5)
+            burn_after = _burn_fast(core)
+            if burn_after <= 1.0:
+                break
+        verdict = core.slo.evaluate(force_sample=True).get(MODEL, {})
+        print("recovery: burn %.2fx after clean traffic, verdict %s"
+              % (burn_after,
+                 "healthy" if verdict.get("healthy") else "unhealthy"))
+        if burn_after > 1.0:
+            failures.append(
+                "fast-window burn did not recover (<=1) within 20 s "
+                "of clearing chaos (still %.2f)" % burn_after)
+        if not verdict.get("healthy", False):
+            failures.append("verdict did not return to healthy")
+
+        # -- debug surfaces stay bounded ------------------------------
+        debug_errors = lint_debug_snapshot(core.debug_snapshot())
+        debug_errors += lint_debug_snapshot(core.debug_flight(MODEL))
+        if debug_errors:
+            failures.extend("debug: %s" % e for e in debug_errors[:5])
+
+        # -- capture overhead -----------------------------------------
+        core.repository.load("add_sub_large")
+        overhead = run_flight_measure(core, requests=96, rounds=4)
+        if not overhead["overhead_ok"]:
+            print("overhead first pass %.2f%% over the gate; "
+                  "re-measuring with more pairs"
+                  % overhead["overhead_pct"])
+            overhead = run_flight_measure(core, requests=96, rounds=6)
+        print("overhead: %.2f%% (off %.1f/s vs on %.1f/s; pairs %s; "
+              "gate <%.0f%%)"
+              % (overhead["overhead_pct"],
+                 overhead["flight_off_tput"],
+                 overhead["flight_on_tput"],
+                 overhead["pair_overheads_pct"],
+                 overhead["overhead_gate_pct"]))
+        if not overhead["overhead_ok"]:
+            failures.append("flight capture overhead %.2f%% exceeds "
+                            "the 2%% gate" % overhead["overhead_pct"])
+    finally:
+        chaos.configure(None)
+        core.shutdown()
+    if failures:
+        for failure in failures:
+            print("flight smoke: %s" % failure, file=sys.stderr)
+        print("flight smoke FAILED (%d gate violation%s)"
+              % (len(failures), "s" if len(failures) != 1 else ""),
+              file=sys.stderr)
+        return 1
+    print("flight smoke passed: >=95% anomaly retention with full "
+          "span trees at trace_rate=0, burn >1 during injection and "
+          "recovered after, debug surfaces bounded, capture overhead "
+          "under 2%")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

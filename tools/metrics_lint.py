@@ -402,18 +402,78 @@ EXPECTED_HISTOGRAMS = (
     "tpu_stream_inter_response_us",
 )
 
+# SLO families that must render once an slo-declaring model has served
+# traffic (gauges, so only presence is checked — burn values are the
+# flight smoke's business).
+EXPECTED_SLO_FAMILIES = (
+    "tpu_slo_target",
+    "tpu_slo_burn_rate",
+    "tpu_slo_budget_remaining",
+    "tpu_slo_healthy",
+)
+
+
+# -- /v2/debug snapshot lint -------------------------------------------------
+
+# Dict keys that look like per-request/per-trace identities: a JSON
+# snapshot keyed by them grows without bound (request ids, trace ids,
+# uuids, correlation ids). Identities belong in list VALUES (bounded
+# by what is live/kept), never as dict keys.
+_IDENTITY_KEY = re.compile(r"^(?:[0-9a-f]{12,}|[0-9]{7,}|"
+                           r"[0-9a-f]{8}-[0-9a-f]{4}-[0-9a-f]{4}-"
+                           r"[0-9a-f]{4}-[0-9a-f]{12})$")
+
+# Fan-out bounds: a debug snapshot is an operator page, not a dump.
+MAX_DEBUG_DICT_KEYS = 2048
+MAX_DEBUG_LIST_ITEMS = 8192
+
+
+def lint_debug_snapshot(doc, path: str = "$") -> List[str]:
+    """Walks a /v2/debug (or /v2/debug/flight) JSON document and flags
+    unbounded-cardinality shapes: dicts keyed by request/trace-like
+    identities, dicts fanning out past MAX_DEBUG_DICT_KEYS, and lists
+    past MAX_DEBUG_LIST_ITEMS. Run in CI against a loaded server so a
+    new debug section cannot silently key itself on a per-request
+    value."""
+    errors: List[str] = []
+    if isinstance(doc, dict):
+        if len(doc) > MAX_DEBUG_DICT_KEYS:
+            errors.append("%s: dict fans out to %d keys (max %d)"
+                          % (path, len(doc), MAX_DEBUG_DICT_KEYS))
+        for key, value in doc.items():
+            key_str = str(key)
+            if _IDENTITY_KEY.match(key_str.lower()):
+                errors.append(
+                    "%s: dict key %r looks like a per-request/trace "
+                    "identity — unbounded cardinality (identities "
+                    "belong in list values)" % (path, key_str))
+            errors.extend(lint_debug_snapshot(
+                value, "%s.%s" % (path, key_str)))
+    elif isinstance(doc, list):
+        if len(doc) > MAX_DEBUG_LIST_ITEMS:
+            errors.append("%s: list holds %d items (max %d)"
+                          % (path, len(doc), MAX_DEBUG_LIST_ITEMS))
+        for index, value in enumerate(doc[:MAX_DEBUG_LIST_ITEMS]):
+            errors.extend(lint_debug_snapshot(
+                value, "%s[%d]" % (path, index)))
+    return errors
+
 
 def main() -> int:
     from client_tpu.server.app import build_core
 
     core = build_core(["simple", "simple_cache", "simple_replicas",
-                       "repeat_int32"])
+                       "simple_slo", "repeat_int32"])
     try:
         _drive_load(core, "simple", n=20, threads=2)
         _drive_load(core, "simple_cache", n=20, threads=2)
         # simple_replicas exercises the tpu_replica_* families (health
         # gauges + per-replica exec counters) under fused dispatch.
         _drive_load(core, "simple_replicas", n=20, threads=4)
+        # simple_slo declares an `slo` block, so the tpu_slo_*
+        # families render (and the scrape itself advances the burn
+        # windows).
+        _drive_load(core, "simple_slo", n=20, threads=2)
         _drive_stream_load(core)
         first = core.metrics_text()
         errors, types, series_before = lint_exposition(first)
@@ -422,6 +482,7 @@ def main() -> int:
         _drive_load(core, "simple", n=20, threads=4)
         _drive_load(core, "simple_cache", n=20, threads=4)
         _drive_load(core, "simple_replicas", n=20, threads=4)
+        _drive_load(core, "simple_slo", n=20, threads=2)
         _drive_stream_load(core)
         second = core.metrics_text()
         errors2, types2, series_after = lint_exposition(second)
@@ -432,6 +493,22 @@ def main() -> int:
                 errors.append(
                     "expected histogram family %s missing from the "
                     "exposition under streaming load" % family)
+        for family in EXPECTED_SLO_FAMILIES:
+            if types2.get(family) != "gauge":
+                errors.append(
+                    "expected SLO gauge family %s missing from the "
+                    "exposition (simple_slo declares an slo block)"
+                    % family)
+        if types2.get("tpu_server_info") != "gauge":
+            errors.append("tpu_server_info gauge missing from the "
+                          "exposition")
+        # The /v2/debug snapshot (and the flight dump) must stay
+        # cardinality-bounded: no dict keyed by request/trace ids, no
+        # unbounded fan-out.
+        debug_errors = lint_debug_snapshot(core.debug_snapshot())
+        errors.extend("debug: %s" % e for e in debug_errors)
+        flight_errors = lint_debug_snapshot(core.debug_flight())
+        errors.extend("debug/flight: %s" % e for e in flight_errors)
         # The negotiated OpenMetrics flavor (exemplars + '# EOF') must
         # lint clean too, and the PLAIN flavor must never leak
         # exemplar syntax — stock text-format parsers reject it.

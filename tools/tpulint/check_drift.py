@@ -44,13 +44,15 @@ def _expected_schema():
             + _normalize_rows(tool.REPLICA_COUNT_FIELDS)
             + [("pipeline_stats", 8), ("sequence_stats", 11),
                ("priority_stats", 15), ("tenant_stats", 16),
-               ("replica_stats", 17), ("stream_stats", 20)]),
+               ("replica_stats", 17), ("stream_stats", 20),
+               ("slo_stats", 21)]),
         "SequenceBatchingStatistics":
             _normalize_rows(tool.SEQUENCE_STATS_FIELDS),
         "PriorityStatistics": _normalize_rows(tool.PRIORITY_STATS_FIELDS),
         "TenantStatistics": _normalize_rows(tool.TENANT_STATS_FIELDS),
         "ReplicaStatistics": _normalize_rows(tool.REPLICA_STATS_FIELDS),
         "StreamStatistics": _normalize_rows(tool.STREAM_STATS_FIELDS),
+        "SloStatistics": _normalize_rows(tool.SLO_STATS_FIELDS),
         "InferStatistics": _normalize_rows(tool.CACHE_DURATION_FIELDS),
     }
     model_config = {
@@ -64,7 +66,8 @@ def _expected_schema():
         "SequenceBatchingConfig":
             _normalize_rows(tool.SEQUENCE_BATCHING_FIELDS),
         "ResponseCacheConfig": [("enable", 1)],
-        "ModelConfig": [("response_cache", 15)],
+        "SloConfig": _normalize_rows(tool.SLO_CONFIG_FIELDS),
+        "ModelConfig": [("response_cache", 15), ("slo", 16)],
     }
     return {
         ("inference.proto", "inference_pb2.py"): inference,
