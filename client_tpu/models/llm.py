@@ -901,6 +901,10 @@ class LlmModel(ServedModel):
         self._queue_timeout_s = float(queue_timeout_s)
         self._pool: Optional[_PagePool] = None  # host accounting
         self._pool_dev = None  # per-layer (K, V) page arrays
+        # Device-ledger row for the page pool's HBM (kv_pages): held
+        # while _pool_dev is live, released on crash rebuild / unload
+        # so cross-model HBM accounting never shows a dead pool.
+        self._kv_ledger_row = None
         self._done_dev = None  # [lanes] bool device carry (EOS latch)
         self._lane_pages: List[List[int]] = [
             [] for _ in range(self._lanes)]
@@ -1058,6 +1062,7 @@ class LlmModel(ServedModel):
         return None
 
     def _compile_prefill_safely(self, b: int, bucket: int):
+        self._attribute_thread()
         try:
             self._compile_prefill(b, bucket)
         except Exception:  # noqa: BLE001 — joins keep falling back
@@ -1150,6 +1155,7 @@ class LlmModel(ServedModel):
         relay's ~65 ms fetch latency then overlaps the next chunks'
         compute instead of gating the token cadence (inter-chunk gap =
         chunk compute time, not fetch latency)."""
+        self._attribute_thread()
         try:
             while True:
                 joins = []
@@ -1365,6 +1371,7 @@ class LlmModel(ServedModel):
         prefill chunk — chunked prefill interleaves 1:1 with decode so
         a long-prompt join never spikes active streams' ITL the way
         the dense arm's all-at-once prefill dispatch does."""
+        self._attribute_thread()
         try:
             while True:
                 with self._sched_cv:
@@ -1477,6 +1484,7 @@ class LlmModel(ServedModel):
                 pool = self._pool_dev
                 tokens_dev = self._tokens_dev
                 done_dev = self._done_dev
+            busy_t0 = time.monotonic_ns()
             firsts, scratch = compiled(
                 self._params, jnp.asarray(padded),
                 init_cache(self.cfg, b, length=bucket),
@@ -1487,6 +1495,7 @@ class LlmModel(ServedModel):
                          dtype=np.int32))
             tokens_dev, done_dev = self._join_lanes(
                 tokens_dev, done_dev, lanes_idx, firsts[:len(entries)])
+            self._record_busy(busy_t0)
             fut = self._fetch_pool.submit(np.asarray,
                                           firsts[:len(entries)])
             with self._sched_cv:
@@ -1547,10 +1556,12 @@ class LlmModel(ServedModel):
             p_bucket *= 2
         tables = np.zeros((1, p_bucket), dtype=np.int32)
         tables[0, :len(lane_pages)] = lane_pages
+        busy_t0 = time.monotonic_ns()
         first_dev, pool = self._paged_prefill(
             self._params, jnp.asarray(tokens_chunk),
             jnp.asarray(positions), jnp.asarray(dest),
             np.int32(tc - 1), jnp.asarray(tables), pool)
+        self._record_busy(busy_t0)
         with self._sched_cv:
             if self._sched_stop or self._gen != gen:
                 return True
@@ -1648,6 +1659,7 @@ class LlmModel(ServedModel):
             tokens_dev = self._tokens_dev
             done_dev = self._done_dev
             pool = self._pool_dev
+        busy_t0 = time.monotonic_ns()
         tok_c, done_c = self._gather_lanes(tokens_dev, done_dev,
                                            jnp.asarray(sel))
         emitted, tok_o, done_o, pool = self._paged_decode(
@@ -1656,6 +1668,7 @@ class LlmModel(ServedModel):
         tokens_dev, done_dev = self._scatter_lanes(
             tokens_dev, done_dev, jnp.asarray(scatter_idx), tok_o,
             done_o)
+        self._record_busy(busy_t0)
         fut = self._fetch_pool.submit(np.asarray, emitted)
         with self._sched_cv:
             if self._sched_stop or self._gen != gen:
@@ -1727,14 +1740,56 @@ class LlmModel(ServedModel):
             self._delivery_thread = None
             self._sched_cv.notify_all()
 
+    def _attribute_thread(self):
+        """Sticky compile attribution for a model-owned worker thread:
+        XLA compiles on the decode scheduler / background prefill-
+        compile threads land on this model, not `unattributed`."""
+        try:
+            from client_tpu.server import devstats
+
+            devstats.get().set_thread_model(self.name)
+        except Exception:  # noqa: BLE001 — attribution is advisory
+            pass
+
+    def _device_ledger(self):
+        """The process-wide HBM ledger (None when the devstats layer
+        is unavailable — accounting must never block serving)."""
+        try:
+            from client_tpu.server import devstats
+
+            return devstats.get().ledger
+        except Exception:  # noqa: BLE001
+            return None
+
+    def _record_busy(self, t0_ns: int) -> None:
+        """Feeds the device busy-time counter with one dispatch's wall
+        time. The scheduler serializes dispatches, so on the blocking
+        CPU sim wall ~= device occupancy; on async accelerator
+        backends the jit call returns at enqueue and this bounds
+        device time from below — duty cycle under pure LLM load is
+        then an underestimate, never a zero."""
+        try:
+            from client_tpu.server import devstats
+
+            devstats.get().record_busy(
+                None, time.monotonic_ns() - t0_ns)
+        except Exception:  # noqa: BLE001 — accounting is advisory
+            pass
+
     def _reset_paged_state(self):
         """Caller holds _sched_cv. A crash rebuilds the page pool from
         scratch — the generation bump must not leak pages (the old
         pool's host accounting and device arrays are dropped wholesale,
-        so accounting restarts at zero by construction)."""
+        so accounting restarts at zero by construction). The ledger
+        row goes with the device arrays: a crashed pool must not keep
+        claiming HBM in the cross-model accounting."""
         self._prefill_jobs.clear()
         self._joining.clear()
         self._pool = None
+        ledger = self._device_ledger()
+        if ledger is not None:
+            ledger.release(self._kv_ledger_row)
+        self._kv_ledger_row = None
         self._pool_dev = None
         self._done_dev = None
         self._lane_pages = [[] for _ in range(self._lanes)]
@@ -1742,6 +1797,10 @@ class LlmModel(ServedModel):
         self._lane_steps_left = [0] * self._lanes
 
     def unload(self) -> None:
+        ledger = self._device_ledger()
+        if ledger is not None:
+            ledger.release(self._kv_ledger_row)
+        self._kv_ledger_row = None
         with self._sched_cv:
             self._sched_stop = True
             for req in self._collect_riders():
@@ -1829,6 +1888,12 @@ class LlmModel(ServedModel):
                 if self._pool_dev is None:
                     self._pool_dev = init_page_pool(
                         self.cfg, self._num_pages, self._page_size)
+                    ledger = self._device_ledger()
+                    if ledger is not None:
+                        self._kv_ledger_row = ledger.register(
+                            self.name, "kv_pages",
+                            sum(int(k.nbytes) + int(v.nbytes)
+                                for k, v in self._pool_dev))
                 if self._done_dev is None:
                     self._done_dev = jnp.zeros((self._lanes,),
                                                dtype=bool)

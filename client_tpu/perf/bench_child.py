@@ -83,13 +83,38 @@ def flush_result() -> None:
     tmp.replace(_OUT_PATH)
 
 
+# Per-stage device sampling (client_tpu.server.devstats): armed once
+# the in-child core exists, every record_stage then carries the HBM
+# peak observed during the stage and the XLA compiles it triggered —
+# BENCH rounds finally carry a memory trajectory.
+DEVICE_STATS = {"stats": None}
+
+
+def set_device_stats(devstats) -> None:
+    try:
+        devstats.stage_sample()  # reset the baseline
+        DEVICE_STATS["stats"] = devstats
+    except Exception:  # noqa: BLE001 — sampling is best-effort
+        DEVICE_STATS["stats"] = None
+
+
 def record_stage(name: str, throughput: float, p50_us: float,
                  extra: dict | None = None) -> None:
-    RESULT["stages"][name] = {
+    entry = {
         "throughput": round(throughput, 2),
         "p50_latency_us": round(p50_us, 1),
         **(extra or {}),
     }
+    stats = DEVICE_STATS["stats"]
+    if stats is not None:
+        try:
+            sample = stats.stage_sample()
+            entry.setdefault("hbm_peak_bytes",
+                             sample["hbm_peak_bytes"])
+            entry.setdefault("compile_count", sample["compile_count"])
+        except Exception:  # noqa: BLE001
+            pass
+    RESULT["stages"][name] = entry
     flush_result()
     log("stage %s: %.2f infer/sec, p50 %.0f us" % (name, throughput, p50_us))
 
@@ -1728,6 +1753,9 @@ def main() -> None:
 
     log("building core + warming 'simple'...")
     core = build_core(["simple"])
+    # Device sampling is process-global (devstats singleton), so one
+    # arm covers every core the stages build later (fleets included).
+    set_device_stats(core.devstats)
     handle = start_grpc_server(core=core)
     log("gRPC server on %s" % handle.address)
     pathlib.Path(args.init_marker).write_text(

@@ -32,6 +32,16 @@ class TpuMetrics:
     hbm_used_bytes: Dict[str, float] = field(default_factory=dict)
     hbm_total_bytes: Dict[str, float] = field(default_factory=dict)
     hbm_utilization: Dict[str, float] = field(default_factory=dict)
+    # Device-axis families (server/devstats.py): the per-model HBM
+    # ledger keyed "model|c<component>", busy-time counters and the
+    # duty-cycle gauge keyed by device uuid, compile counters keyed
+    # "model|b<shape-fingerprint>".
+    hbm_model_bytes: Dict[str, float] = field(default_factory=dict)
+    device_busy_us_total: Dict[str, float] = field(default_factory=dict)
+    device_duty_cycle: Dict[str, float] = field(default_factory=dict)
+    compile_total: Dict[str, float] = field(default_factory=dict)
+    device_stats_errors_total: Dict[str, float] = field(
+        default_factory=dict)
     batch_pending_depth: Dict[str, float] = field(default_factory=dict)
     batch_inflight: Dict[str, float] = field(default_factory=dict)
     batch_queue_delay_us: Dict[str, float] = field(default_factory=dict)
@@ -90,6 +100,11 @@ _FAMILIES = {
     "tpu_hbm_used_bytes": "hbm_used_bytes",
     "tpu_hbm_total_bytes": "hbm_total_bytes",
     "tpu_hbm_utilization": "hbm_utilization",
+    "tpu_hbm_model_bytes": "hbm_model_bytes",
+    "tpu_device_busy_us_total": "device_busy_us_total",
+    "tpu_device_duty_cycle": "device_duty_cycle",
+    "tpu_compile_total": "compile_total",
+    "tpu_device_stats_errors_total": "device_stats_errors_total",
     "tpu_batch_pending_depth": "batch_pending_depth",
     "tpu_batch_inflight": "batch_inflight",
     "tpu_batch_queue_delay_us": "batch_queue_delay_us",
@@ -133,6 +148,7 @@ _HIST_FAMILIES = {
     "tpu_stream_first_response_us": "stream_first_response_us",
     "tpu_stream_inter_response_us": "stream_inter_response_us",
     "tpu_tenant_request_duration_us": "tenant_request_duration_us",
+    "tpu_compile_duration_us": "compile_duration_us",
 }
 
 # Monotonic counters among the scraped families: summarize_metrics
@@ -146,6 +162,8 @@ _COUNTER_FAMILIES = frozenset((
     "replica_redispatch_total", "replica_exec_us",
     "stream_responses_total",
     "kv_prefix_hits_total", "prefill_chunks_total",
+    "device_busy_us_total", "compile_total",
+    "device_stats_errors_total",
 ))
 
 
@@ -212,11 +230,15 @@ def parse_prometheus(text: str) -> TpuMetrics:
         # fault domain.
         key = (labels.get("model") or labels.get("tenant")
                or labels.get("tpu_uuid") or labels.get("gpu_uuid")
-               or "0")
+               or labels.get("device") or "0")
         if "priority" in labels:
             key = "%s|p%s" % (key, labels["priority"])
         if "replica" in labels:
             key = "%s|r%s" % (key, labels["replica"])
+        if "component" in labels:
+            key = "%s|c%s" % (key, labels["component"])
+        if "shape" in labels:
+            key = "%s|b%s" % (key, labels["shape"])
         if "window" in labels:
             key = "%s|w%s" % (key, labels["window"])
         if "objective" in labels:
@@ -308,7 +330,8 @@ def summarize_metrics(snapshots: List[TpuMetrics]) -> Dict[str, Dict[str, float]
                  "sequence_active", "sequence_backlog",
                  "cache_size_bytes", "cache_entries",
                  "priority_queue_size", "replica_healthy",
-                 "replica_count", "kv_pages_used", "kv_pages_total"):
+                 "replica_count", "kv_pages_used", "kv_pages_total",
+                 "device_duty_cycle"):
         values = []
         for snap in snapshots:
             per_device = getattr(snap, attr)
@@ -319,6 +342,25 @@ def summarize_metrics(snapshots: List[TpuMetrics]) -> Dict[str, Dict[str, float]
                 "avg": sum(values) / len(values),
                 "max": max(values),
             }
+    # The per-model HBM ledger sums over its (model, component) rows
+    # per snapshot — the total attributed bytes is the meaningful
+    # aggregate (a mean over rows is not), and its max is the window's
+    # attributed-HBM peak. The unattributed/residual row is EXCLUDED:
+    # it closes the gap to tpu_hbm_used_bytes by construction, so
+    # including it would make this line a duplicate of whole-chip
+    # used bytes instead of what the ledger attributed.
+    values = []
+    for snap in snapshots:
+        attributed = sum(
+            value for key, value in snap.hbm_model_bytes.items()
+            if not key.startswith("unattributed|"))
+        if attributed:
+            values.append(attributed)
+    if values:
+        out["hbm_model_bytes"] = {
+            "avg": sum(values) / len(values),
+            "max": max(values),
+        }
     for attr in sorted(_COUNTER_FAMILIES):
         first: Dict[str, float] = {}
         last: Dict[str, float] = {}

@@ -122,6 +122,24 @@ def print_report(results: List[PerfStatus], percentile: int = 0,
                 parts.append("HBM util avg %.1f%%" % (util["avg"] * 100))
             if parts:
                 print("    server TPU: %s" % ", ".join(parts))
+            # Device-axis line (server/devstats.py families): duty
+            # cycle over the window, per-model-attributed HBM peak
+            # (the ledger total's max), and XLA compiles in window.
+            duty = status.tpu_metrics.get("device_duty_cycle")
+            ledger = status.tpu_metrics.get("hbm_model_bytes")
+            compiles = status.tpu_metrics.get("compile_total")
+            parts = []
+            if duty:
+                parts.append("duty cycle avg %.1f%% / max %.1f%%"
+                             % (duty["avg"] * 100, duty["max"] * 100))
+            if ledger:
+                parts.append("model HBM peak %.1f MiB"
+                             % (ledger["max"] / 2**20))
+            if compiles and compiles.get("delta"):
+                parts.append("%d XLA compiles in window"
+                             % int(compiles["delta"]))
+            if parts:
+                print("    server device: %s" % ", ".join(parts))
             healthy = status.tpu_metrics.get("replica_healthy")
             total = status.tpu_metrics.get("replica_count")
             if healthy and total and total.get("max"):

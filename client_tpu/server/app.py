@@ -53,9 +53,9 @@ def build_core(
                                cache_size=cache_size,
                                tenant_quotas=quota_manager)
     for name in load_models or ():
-        model = repository.load(name)
-        if warmup:
-            model.warmup()
+        # Through the core so every startup load lands in the device
+        # ledger (weights row) with warmup compiles attributed.
+        core.load_model(name, warmup=warmup)
     return core
 
 

@@ -59,6 +59,7 @@ like any other concurrent requests."""
 
 from __future__ import annotations
 
+import contextlib
 import inspect
 import threading
 import time
@@ -323,8 +324,15 @@ class DynamicBatcher:
                  execution_target=None,
                  telemetry=None,
                  overlapped_fetch: bool = True,
-                 fetch_chunk_bytes: int = 0):
+                 fetch_chunk_bytes: int = 0,
+                 compile_scope: Optional[Callable] = None):
         self._model = model
+        # Compile-attribution scope (client_tpu.server.devstats):
+        # wraps each fused execution so XLA compiles triggered by a
+        # fresh pow2 shape bucket attribute to this model + bucket.
+        # The core passes None for replicated models — the replica's
+        # own device queue owns attribution there.
+        self._compile_scope = compile_scope
         # Always-on latency histograms (client_tpu.server.telemetry's
         # ServerTelemetry, or None): each fused execution records a
         # batch_execute observation and each host materialization a
@@ -924,16 +932,24 @@ class DynamicBatcher:
             passthrough = len(bucket) == 1 and bucket[0].batch == target
             self._tracker.enter_compute()
             try:
-                if passthrough:
-                    outputs = self._target.infer(
-                        bucket[0].inputs, bucket[0].params)
-                else:
-                    fused = {
-                        name: _fuse_chunks(
-                            [p.inputs[name] for p in bucket], target, total)
-                        for name in bucket[0].inputs
-                    }
-                    outputs = self._target.infer(fused, bucket[0].params)
+                scope = (self._compile_scope(
+                             getattr(self._model, "name", "?"),
+                             "b%d" % target)
+                         if self._compile_scope is not None
+                         else contextlib.nullcontext())
+                with scope:
+                    if passthrough:
+                        outputs = self._target.infer(
+                            bucket[0].inputs, bucket[0].params)
+                    else:
+                        fused = {
+                            name: _fuse_chunks(
+                                [p.inputs[name] for p in bucket],
+                                target, total)
+                            for name in bucket[0].inputs
+                        }
+                        outputs = self._target.infer(
+                            fused, bucket[0].params)
             finally:
                 self._tracker.exit_compute()
             compute_end_ns = time.monotonic_ns()

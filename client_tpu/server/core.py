@@ -20,6 +20,7 @@ import numpy as np
 
 from client_tpu.protocol import inference_pb2 as pb
 from client_tpu.server import chaos
+from client_tpu.server import devstats as devstats_mod
 from client_tpu.server import fetch as relay
 from client_tpu.server import flight as flightrec
 from client_tpu.server import slo as sloengine
@@ -413,6 +414,13 @@ class InferenceServerCore:
             collect_fn=self._slo_collect,
             incident_hook=self.flight.mark_incident,
         )
+        # Device-axis observability (client_tpu.server.devstats):
+        # process-wide — every in-process core shares the same chips,
+        # so they share one HBM ledger, busy-time counters, compile
+        # tracker, and profiler. Recompile storms stamp THIS core's
+        # flight ring like SLO burns and breaker trips do.
+        self.devstats = devstats_mod.get()
+        self.devstats.add_incident_hook(self.flight.mark_incident)
         # Start stamps: tpu_server_info's uptime value (a scrape-level
         # restart detector) and the /v2/debug server section.
         self._started_wall = time.time()
@@ -671,6 +679,15 @@ class InferenceServerCore:
                         exec_ns=row["exec_ns"],
                         ejected_count=row["ejected_count"],
                         readmitted_count=row["readmitted_count"])
+            device = self.devstats.model_device_snapshot(model.name)
+            if device is not None:
+                row = stat.device_stats
+                row.hbm_bytes = device["hbm_bytes"]
+                for component, nbytes in device["components"]:
+                    row.components.add(component=component,
+                                       hbm_bytes=nbytes)
+                row.compile_count = device["compile_count"]
+                row.compile_ns = device["compile_ns"]
             with self._sequencers_lock:
                 sequencer = self._sequencers.get(model.name)
             if sequencer is not None:
@@ -1019,33 +1036,15 @@ class InferenceServerCore:
                "LLM prefill dispatches (bounded chunked-prefill "
                "chunks + batched short-prompt prefills)", prefill_rows)
 
-        used_rows, total_rows, util_rows = [], [], []
+        # Device-axis families (client_tpu.server.devstats): the
+        # tpu_hbm_* gauges plus the per-model HBM ledger, busy-time/
+        # duty-cycle counters, and compile telemetry. Scrape failures
+        # are counted (tpu_device_stats_errors_total) and logged once
+        # per process — the old inline block swallowed them silently.
         try:
-            import jax
-
-            for device in jax.local_devices():
-                uuid = "%s-%d" % (device.platform.upper(), device.id)
-                label = '{tpu_uuid="%s"}' % uuid
-                mem = device.memory_stats() or {}
-                used = mem.get("bytes_in_use")
-                limit = mem.get("bytes_limit")
-                if used is not None:
-                    used_rows.append("tpu_hbm_used_bytes%s %d"
-                                     % (label, used))
-                if limit:
-                    total_rows.append("tpu_hbm_total_bytes%s %d"
-                                      % (label, limit))
-                    if used is not None:
-                        util_rows.append("tpu_hbm_utilization%s %.6f"
-                                         % (label, used / limit))
-        except Exception:
-            pass  # metrics must never take the server down
-        family("tpu_hbm_used_bytes", "gauge",
-               "Accelerator HBM bytes in use", used_rows)
-        family("tpu_hbm_total_bytes", "gauge",
-               "Accelerator HBM capacity in bytes", total_rows)
-        family("tpu_hbm_utilization", "gauge",
-               "Fraction of accelerator HBM in use", util_rows)
+            lines.extend(self.devstats.render_metrics())
+        except Exception:  # noqa: BLE001 — metrics never take
+            pass  # the server down
         # SLO families (tpu_slo_target / _burn_rate / _budget_remaining
         # / _healthy): rendered by the engine, empty when no ready
         # model declares an `slo` block. Rendering evaluates — the
@@ -1106,6 +1105,14 @@ class InferenceServerCore:
             "flight": {},
             "chaos": chaos.stats(),
         }
+        try:
+            # Device axis: HBM ledger rows, busy/duty per device,
+            # compile counts, profiler state (docs/
+            # device_observability.md). Process-global, so the section
+            # is identical across in-process cores.
+            doc["devices"] = self.devstats.debug_snapshot()
+        except Exception:  # noqa: BLE001 — introspection never takes
+            pass  # the server down
         for model in self.repository.ready_models():
             if not wanted(model.name):
                 continue
@@ -1193,6 +1200,16 @@ class InferenceServerCore:
         except Exception:  # noqa: BLE001
             pass
         return doc
+
+    def debug_profile(self, duration_ms: int = 500,
+                      model_name: str = "") -> dict:
+        """On-demand bounded profiler capture (GET /v2/debug/profile
+        on both HTTP front-ends + /inference.Debug/Profile): starts a
+        jax.profiler trace when the platform supports one and always
+        writes a span-derived chrome trace of the same window under a
+        server-owned directory; concurrent captures coalesce
+        single-flight. Returns paths + a summary."""
+        return self.devstats.profiler.capture(duration_ms, model_name)
 
     def debug_flight(self, model_name: str = "") -> dict:
         """The flight-ring dump (GET /v2/debug/flight?model=M): kept
@@ -1365,9 +1382,17 @@ class InferenceServerCore:
                          ) -> pb.RepositoryIndexResponse:
         return self.repository.index(ready_only)
 
-    def load_model(self, name: str) -> None:
-        model = self.repository.load(name)
-        model.warmup()
+    def load_model(self, name: str, warmup: bool = True) -> None:
+        # The load (and its warmup compiles) runs inside a device-
+        # ledger measurement: the per-device memory_stats() delta —
+        # cross-checked against the instance's exact jax.Array nbytes
+        # — becomes the model's `weights` HBM row, and warmup compiles
+        # attribute to the model instead of `unattributed`.
+        with self.devstats.measure_model_load(name) as measure:
+            model = self.repository.load(name)
+            measure.model = model
+            if warmup:
+                model.warmup()
 
     def unload_model(self, name: str) -> None:
         # Graceful drain ordering: (1) shed NEW requests (503/
@@ -1405,6 +1430,12 @@ class InferenceServerCore:
             # 503 while its instance and device memory stay resident
             # (tpulint: resource-pairing found the unprotected span).
             self.repository.finish_unload(name)
+            # Ledger rows die with the instance: the model's own
+            # unload released its components (KV pool, replica rows);
+            # this sweeps the load-time `weights` row and anything a
+            # crashed teardown left behind — an unloaded model must
+            # leave no HBM attribution residue.
+            self.devstats.ledger.release_model(name)
 
     def shutdown(self) -> None:
         """Teardown: flip /v2/health/ready to not-ready FIRST (load
@@ -1479,13 +1510,33 @@ class InferenceServerCore:
 
         if not wants_dynamic_batching(model):
             return None
+        from client_tpu.server.replicas import wants_replicas
+
         with self._batchers_lock:
             batcher = self._batchers.get(model.name)
             if batcher is None:
                 stats = self._stats_for(model.name)
+                devstats = self.devstats
+                if wants_replicas(model):
+                    # Replicated models record busy time and compile
+                    # attribution inside each replica's own device
+                    # queue (ReplicaSet._run_on) — routed per device,
+                    # never double-counted through the batcher span.
+                    stats_hook = stats.record_batch
+                    compile_scope = None
+                else:
+                    def stats_hook(size, compute_ns, fetch_ns,
+                                   _record=stats.record_batch,
+                                   _dev=devstats):
+                        _record(size, compute_ns, fetch_ns)
+                        # The fused execution's compute span IS the
+                        # device-side duration for the busy counter.
+                        _dev.record_busy(None, compute_ns)
+                    compile_scope = devstats.compile_scope
                 batcher = DynamicBatcher(
                     model,
                     execution_target=self._execution_target(model),
+                    compile_scope=compile_scope,
                     max_queue_delay_us=int(
                         getattr(model, "max_queue_delay_us", 500)),
                     preferred_batch_sizes=list(
@@ -1496,7 +1547,7 @@ class InferenceServerCore:
                         getattr(model, "pipeline_depth", 0)),
                     fetch_workers=int(
                         getattr(model, "fetch_pool_workers", 0)),
-                    stats_hook=stats.record_batch,
+                    stats_hook=stats_hook,
                     max_queue_size=int(
                         getattr(model, "max_queue_size", 0)),
                     default_timeout_us=int(getattr(
@@ -1660,13 +1711,17 @@ class InferenceServerCore:
         trace = self._trace_begin(model.name, trace_context, request.id)
         flight = self.flight
         ftrace = trace
-        if ftrace is None and flight.enabled:
+        if ftrace is None and (flight.enabled
+                               or self.devstats.profiler.armed):
             # Tail sampling (flight recorder): the span tree is
             # captured for EVERY request into a scratch trace; whether
             # it survives is decided RETROACTIVELY at completion
             # (error/shed/timeout/slow), when the request's fate is
             # known — never by a dice roll at start. Unkept scratches
-            # are discarded without ever being rendered.
+            # are discarded without ever being rendered. An armed
+            # profiler window forces capture too (even with the flight
+            # recorder off) so the span-derived chrome trace always
+            # has material.
             ftrace = spantrace.RequestTrace(
                 trace_context,
                 attrs={"model": model.name, "request_id": request.id},
@@ -1695,6 +1750,9 @@ class InferenceServerCore:
                                error=error, status=status, token=token)
             except Exception:  # noqa: BLE001 — a recorder fault must
                 pass  # never mask the request's own outcome
+            profiler = self.devstats.profiler
+            if profiler.armed:
+                profiler.tap(model.name, request.id, ftrace)
 
     def _infer_routed(self, model: ServedModel,
                       request: pb.ModelInferRequest, stats: _ModelStats,
@@ -1916,6 +1974,7 @@ class InferenceServerCore:
         queue_ns = 0
         executions = 1
         priority = 0
+        direct_busy = False
         try:
             chaos.inject(model.name, scope=self.chaos_scope)
             # fault injection (no-op unless configured); drops/errors
@@ -1969,10 +2028,25 @@ class InferenceServerCore:
             else:
                 # Direct path: instance-group models route through the
                 # ReplicaSet proxy (health-routed dispatch + bounded
-                # re-dispatch); everything else executes in place.
-                outputs = self._execution_target(model).infer(
-                    inputs, params)
+                # re-dispatch; busy time and compile attribution land
+                # inside the replica's own device queue); everything
+                # else executes in place under a compile-attribution
+                # scope, and its device_execute duration feeds the
+                # busy-time counter below.
+                replica_set = self._replicas_for(model)
+                if replica_set is not None:
+                    outputs = replica_set.proxy.infer(inputs, params)
+                elif self.devstats.enabled:
+                    with self.devstats.compile_scope(
+                            model.name,
+                            devstats_mod.shape_fingerprint(inputs)):
+                        outputs = model.infer(inputs, params)
+                    direct_busy = True
+                else:  # A/B off arm: zero devstats cost on the path
+                    outputs = model.infer(inputs, params)
             t2 = time.monotonic_ns()
+            if direct_busy:
+                self.devstats.record_busy(None, t2 - t1)
             # Span boundaries are CHAINED off single clock reads
             # (decode ends exactly where execute starts, etc.): two
             # separate reads around a boundary would let a GIL
@@ -2233,6 +2307,9 @@ class InferenceServerCore:
                             token=token, allow_slow=False)
                     except Exception:  # noqa: BLE001 — a recorder
                         pass  # fault must never leak the acquisition
+                    profiler = self.devstats.profiler
+                    if profiler.armed:
+                        profiler.tap(model.name, request.id, ftrace)
                 if acquired:
                     self.repository.release(model.name)
 

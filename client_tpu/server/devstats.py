@@ -1,0 +1,1102 @@
+"""Device-axis observability: per-model HBM ledger, busy-time/duty-
+cycle counters, XLA compile telemetry, and on-demand profiler capture.
+
+The request axis is covered end to end (spans, histograms, the flight
+recorder); the *device* axis used to stop at three whole-chip
+``tpu_hbm_*`` gauges rendered inline by ``core.metrics_text`` with a
+bare ``except: pass``. This module owns that axis:
+
+* :class:`DeviceLedger` — every HBM allocation site registers a
+  ``(model, component)`` row (model weights at load, KV page pools,
+  TPU arena regions, per-replica instances) and releases it on
+  teardown, so ``tpu_hbm_model_bytes{model,component}`` attributes
+  device memory to its owner. A residual ``unattributed`` row closes
+  the gap to ``tpu_hbm_used_bytes`` whenever the runtime reports it,
+  so the rows always sum to the whole-chip gauge within tolerance.
+  ``register``/``release`` is a paired protocol the tpulint
+  resource-pairing checker enforces (the PR-7 tenant-admission
+  guarantee class) — a new allocation site cannot silently leak rows.
+* **Busy time** — ``tpu_device_busy_us_total{device}`` accumulates the
+  device-side durations the execution layers already measure (fused
+  ``batch_execute`` compute, direct ``device_execute``, per-replica
+  executions routed to their device), so Prometheus ``rate()`` yields
+  duty cycle; ``tpu_device_duty_cycle{device}`` derives the same over
+  a sliding window for scrape-free consumers (the ROADMAP-4
+  autoscaler's scale-up signal).
+* **Compile telemetry** — a ``jax.monitoring`` listener attributes
+  every XLA backend compile to the model whose execution (or load
+  warmup, or background prefill compile) triggered it, via a
+  thread-local scope the execution layers push. Families:
+  ``tpu_compile_total{model,shape}`` (shape-bucket fingerprint,
+  cardinality-bounded) and the ``tpu_compile_duration_us{model}``
+  histogram — the batcher's pow2-padding policy's compile cost,
+  finally measurable. A recompile storm (N compiles for one model
+  inside a short window) stamps the model's flight ring
+  (``mark_incident``) and logs.
+* :class:`ProfilerCapture` — ``GET /v2/debug/profile?duration_ms=``:
+  a bounded ``jax.profiler`` trace written under a server-owned
+  directory, plus a span-derived chrome trace of the same window
+  (always produced; the graceful arm when the platform profiler is
+  unsupported). Concurrent captures coalesce single-flight.
+
+One :class:`DeviceStats` instance per process (``devstats.get()``):
+the device axis is process-global — several in-process cores share
+the same chips, so they share the same ledger and counters.
+``enabled=False`` turns every hot-path recording into a cheap early
+return (the paired-A/B overhead arm, gated <2% like telemetry and
+flight capture).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+_LOG = logging.getLogger("client_tpu.server.devstats")
+
+# Ledger cardinality bounds: models are operator-configured (bounded),
+# but a hostile/looping caller must not mint rows without bound —
+# past the caps new names fold into one overflow row (the qos.py
+# tenant pattern).
+MAX_LEDGER_MODELS = 256
+MAX_LEDGER_COMPONENTS = 64
+OVERFLOW_ROW = "overflow"
+
+# Compile-telemetry bounds: shape-bucket fingerprints are derived from
+# execution shapes (pow2-padded, so naturally few), but unbounded
+# dynamic shapes must not grow /metrics — past the cap new
+# fingerprints fold into "other".
+MAX_COMPILE_SHAPES = 32
+OVERFLOW_SHAPE = "other"
+
+# Recompile-storm detector: >= STORM_COMPILES compiles for ONE model
+# inside STORM_WINDOW_S stamps the model's flight ring and logs; the
+# detector re-arms after the window so a sustained storm stamps once
+# per window, not once per compile.
+STORM_COMPILES = 5
+STORM_WINDOW_S = 30.0
+
+# Duty-cycle derivation window (seconds) and its bucket resolution.
+DUTY_WINDOW_S = 10.0
+_DUTY_SLOT_S = 0.1
+
+# Profiler capture bounds: the duration is clamped so a typo'd
+# duration_ms cannot hold the single-flight slot (and a jax trace
+# buffer) for minutes.
+PROFILE_MIN_MS = 10
+PROFILE_MAX_MS = 10_000
+PROFILE_DEFAULT_MS = 500
+# Span-tap bound: requests captured into the fallback chrome trace.
+PROFILE_MAX_TAPPED = 512
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_UNATTRIBUTED = "unattributed"
+
+
+def _array_leaf_bytes(value) -> int:
+    """Sum of ``jax.Array`` leaf nbytes in an arbitrary pytree-ish
+    value (0 when jax is unavailable or the value holds none)."""
+    try:
+        import jax
+
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(value):
+            if isinstance(leaf, jax.Array):
+                total += int(leaf.nbytes)
+        return total
+    except Exception:  # noqa: BLE001 — measurement is best-effort
+        return 0
+
+
+def model_array_bytes(model) -> int:
+    """Exact ``jax.Array`` nbytes resident in a model instance (the
+    cross-check against the memory_stats() delta at load): walks the
+    instance's attribute values and sums device-array leaves."""
+    attrs = getattr(model, "__dict__", None)
+    if not attrs:
+        return 0
+    total = 0
+    for value in attrs.values():
+        total += _array_leaf_bytes(value)
+    return total
+
+
+def shape_fingerprint(inputs) -> str:
+    """Bounded shape-bucket fingerprint of an execution's input dict:
+    the compile-relevant signature (sorted names are dropped — shapes
+    alone identify the XLA specialization for a fixed model)."""
+    try:
+        parts = []
+        for name in sorted(inputs):
+            value = inputs[name]
+            shape = getattr(value, "shape", None)
+            if shape is None:
+                continue
+            parts.append("x".join(str(int(d)) for d in shape))
+        return "b" + "_".join(parts)[:64] if parts else "b?"
+    except Exception:  # noqa: BLE001 — a label, never a failure
+        return "b?"
+
+
+class LedgerRow:
+    """Handle for one registered allocation: releasing it subtracts
+    exactly what the register added (idempotent — a double release is
+    a no-op, never negative accounting)."""
+
+    __slots__ = ("model", "component", "nbytes", "_released")
+
+    def __init__(self, model: str, component: str, nbytes: int):
+        self.model = model
+        self.component = component
+        self.nbytes = int(nbytes)
+        self._released = False
+
+
+class DeviceLedger:
+    """Per-model HBM attribution: (model, component) -> bytes.
+
+    Rows aggregate — registering the same (model, component) twice
+    holds the sum, and each :class:`LedgerRow` handle releases its own
+    contribution, so many arena regions (say) share one bounded row.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # model -> component -> [bytes, exact_bytes]
+        self._rows: Dict[str, Dict[str, List[int]]] = {}
+        # High-water mark of the attributed total, advanced at every
+        # register — so a pool allocated and freed between two
+        # observations still shows in take_peak().
+        self._peak = 0
+
+    def _total_locked(self) -> int:
+        return sum(entry[0]
+                   for components in self._rows.values()
+                   for entry in components.values())
+
+    def _fold(self, model: str, component: str):
+        """Cardinality bounds (caller holds the lock)."""
+        if model not in self._rows and len(self._rows) >= MAX_LEDGER_MODELS:
+            model = OVERFLOW_ROW
+        components = self._rows.setdefault(model, {})
+        if component not in components and \
+                len(components) >= MAX_LEDGER_COMPONENTS:
+            component = OVERFLOW_ROW
+        return model, component, components
+
+    def register(self, model: str, component: str, nbytes: int,
+                 exact_nbytes: Optional[int] = None
+                 ) -> Optional[LedgerRow]:
+        """Adds ``nbytes`` to the (model, component) row; returns the
+        handle ``release`` takes (None for empty allocations — nothing
+        to account, nothing to leak)."""
+        nbytes = int(nbytes)
+        if nbytes <= 0:
+            return None
+        model = str(model)
+        component = str(component)
+        with self._lock:
+            model, component, components = self._fold(model, component)
+            entry = components.setdefault(component, [0, 0])
+            entry[0] += nbytes
+            entry[1] += int(exact_nbytes if exact_nbytes is not None
+                            else nbytes)
+            current = self._total_locked()
+            if current > self._peak:
+                self._peak = current
+        return LedgerRow(model, component, nbytes)
+
+    def release(self, row: Optional[LedgerRow]) -> None:
+        if row is None or row._released:
+            return
+        row._released = True
+        with self._lock:
+            components = self._rows.get(row.model)
+            if components is None:
+                return
+            entry = components.get(row.component)
+            if entry is None:
+                return
+            entry[0] = max(entry[0] - row.nbytes, 0)
+            if entry[0] <= 0:
+                components.pop(row.component, None)
+                if not components:
+                    self._rows.pop(row.model, None)
+
+    def release_component(self, model: str, component: str) -> int:
+        """Drops one whole (model, component) row (weights replacement
+        at re-load); returns the bytes dropped."""
+        with self._lock:
+            components = self._rows.get(model)
+            if components is None:
+                return 0
+            entry = components.pop(component, None)
+            if not components:
+                self._rows.pop(model, None)
+            if entry is None:
+                return 0
+            return entry[0]
+
+    def release_model(self, model: str) -> int:
+        """Drops every row of ``model`` (unload teardown); returns the
+        bytes dropped."""
+        with self._lock:
+            components = self._rows.pop(str(model), None)
+            if not components:
+                return 0
+            return sum(entry[0] for entry in components.values())
+
+    def take_peak(self) -> int:
+        """High-water mark of the attributed total since the last
+        call (re-armed at the current total) — the per-bench-stage
+        `hbm_peak_bytes` sample, catching pools that alloc and free
+        entirely inside one stage."""
+        with self._lock:
+            current = self._total_locked()
+            peak = max(self._peak, current)
+            self._peak = current
+            return peak
+
+    def snapshot(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            return {
+                model: {component: entry[0]
+                        for component, entry in components.items()}
+                for model, components in self._rows.items()
+            }
+
+    def model_bytes(self, model: str) -> Dict[str, int]:
+        with self._lock:
+            components = self._rows.get(str(model))
+            if not components:
+                return {}
+            return {component: entry[0]
+                    for component, entry in components.items()}
+
+    def total(self) -> int:
+        with self._lock:
+            return self._total_locked()
+
+
+class _LoadMeasure:
+    """Context manager around one model load: measures the per-device
+    ``memory_stats()`` delta (exact on accelerators), cross-checked
+    against the instance's summed ``jax.Array`` nbytes (the only
+    signal on backends whose ``memory_stats()`` is None — the CPU
+    sim), and registers the ``weights`` ledger row on success. Also
+    pushes the compile-attribution scope so load-time warmup compiles
+    land on the model, not on ``unattributed``."""
+
+    def __init__(self, stats: "DeviceStats", name: str):
+        self._stats = stats
+        self._name = name
+        self.model = None  # caller sets once the instance exists
+        self._before = 0
+        self._scope = None
+        self.row: Optional[LedgerRow] = None
+
+    def __enter__(self) -> "_LoadMeasure":
+        # Loads serialize on the measurement lock: two concurrent
+        # loads would each see the other's allocations inside their
+        # memory_stats() delta and both weights rows would over-count
+        # (reentrant: an ensemble load may load composing models).
+        self._stats._load_lock.acquire()
+        self._before = self._stats.hbm_used_total()
+        self._scope = self._stats.compile_scope(self._name, "load")
+        self._scope.__enter__()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        try:
+            if self._scope is not None:
+                self._scope.__exit__(exc_type, exc, tb)
+            if exc_type is not None:
+                return False
+            return self._register()
+        finally:
+            self._stats._load_lock.release()
+
+    def _register(self) -> bool:
+        exact = model_array_bytes(self.model) if self.model is not None \
+            else 0
+        after = self._stats.hbm_used_total()
+        delta = max(after - self._before, 0) if after else 0
+        nbytes = delta or exact
+        ledger = self._stats.ledger
+        # A re-load replaces the previous instance's weights row
+        # instead of stacking on top of it.
+        ledger.release_component(self._name, "weights")
+        self.row = ledger.register(self._name, "weights", nbytes,
+                                   exact_nbytes=exact)
+        return False
+
+
+class ProfilerCapture:
+    """Bounded on-demand capture with single-flight coalescing.
+
+    Always produces a span-derived chrome trace of the window (every
+    request completing while armed is tapped, bounded); additionally
+    runs ``jax.profiler`` when the platform supports it and reports
+    its output directory. Writes under a server-owned directory."""
+
+    def __init__(self, stats: "DeviceStats",
+                 directory: Optional[str] = None):
+        self._stats = stats
+        self._dir = directory
+        self._dir_lock = threading.Lock()
+        self._lock = threading.Lock()
+        self._inflight: Optional[tuple] = None
+        self._seq = 0
+        # Span tap: armed during a capture window; the core forwards
+        # every finished request trace here (cheap flag check when
+        # disarmed).
+        self.armed = False
+        self._tap_lock = threading.Lock()
+        self._tapped: List[dict] = []
+        self._tap_dropped = 0
+        self._tap_model = ""
+        self.capture_count = 0
+        self.coalesced_count = 0
+        # Bound on arming the jax profiler: the FIRST start in a
+        # process imports heavy profiler deps (tensorflow, ~10s cold
+        # and far worse under GIL-saturating load) — a capture must
+        # not block on it. Past the bound the capture proceeds with
+        # the span arm; the import keeps warming in the background, so
+        # a later capture gets the jax arm cheaply.
+        self.jax_start_timeout_s = 5.0
+
+    def directory(self) -> str:
+        with self._dir_lock:
+            if self._dir is None:
+                import tempfile
+
+                self._dir = tempfile.mkdtemp(prefix="client_tpu_profile_")
+            return self._dir
+
+    # -- span tap ---------------------------------------------------------
+
+    def tap(self, model_name: str, request_id: str, trace) -> None:
+        """Called by the core for every request finishing while a
+        capture is armed (bounded; serialization happens here, off
+        the capture thread but only during the window)."""
+        if not self.armed:
+            return
+        if self._tap_model and model_name != self._tap_model:
+            return
+        try:
+            record = {
+                "model": str(model_name),
+                "request_id": str(request_id),
+                "spans": [span.as_dict() for span in trace.snapshot()],
+            }
+        except Exception:  # noqa: BLE001 — profiling never fails serving
+            return
+        with self._tap_lock:
+            if not self.armed:
+                return
+            if len(self._tapped) >= PROFILE_MAX_TAPPED:
+                self._tap_dropped += 1
+                return
+            self._tapped.append(record)
+
+    # -- capture ----------------------------------------------------------
+
+    def capture(self, duration_ms: int = PROFILE_DEFAULT_MS,
+                model_name: str = "") -> dict:
+        """One bounded capture; concurrent calls coalesce onto the
+        in-flight window and share its result."""
+        try:
+            duration_ms = int(duration_ms)
+        except (TypeError, ValueError):
+            duration_ms = PROFILE_DEFAULT_MS
+        duration_ms = max(PROFILE_MIN_MS, min(duration_ms,
+                                              PROFILE_MAX_MS))
+        with self._lock:
+            inflight = self._inflight
+            if inflight is not None:
+                event, box, leader_ms = inflight
+            else:
+                event, box = threading.Event(), {}
+                self._inflight = (event, box, duration_ms)
+        if inflight is not None:
+            # Follower: wait the leader out (bounded by its window
+            # plus profiler teardown slack), then share its result.
+            event.wait(leader_ms / 1000.0 + 30.0)
+            with self._lock:
+                self.coalesced_count += 1
+            result = dict(box) if box else {"error": "capture failed"}
+            result["coalesced"] = True
+            return result
+        try:
+            box.update(self._capture(duration_ms, model_name))
+        except Exception as e:  # noqa: BLE001 — the endpoint reports,
+            box["error"] = str(e)  # never raises a 500 for a trace
+        finally:
+            with self._lock:
+                self._inflight = None
+                self.capture_count += 1
+            event.set()
+        return dict(box, coalesced=False)
+
+    def _start_jax_trace(self, jax_dir: str) -> tuple:
+        """Starts ``jax.profiler.start_trace`` on a worker thread,
+        bounded by ``jax_start_timeout_s``. Returns ``(started,
+        error)``; a start that completes only after the bound stops
+        itself immediately (profile sessions are exclusive — an
+        abandoned open session would fail every later capture)."""
+        box: dict = {}
+        done = threading.Event()
+        lock = threading.Lock()
+
+        def run():
+            ok = False
+            try:
+                import jax
+
+                jax.profiler.start_trace(jax_dir)
+                ok = True
+            except Exception as e:  # noqa: BLE001 — the graceful
+                box["error"] = "unsupported on this platform: %s" % e
+            with lock:
+                box["ok"] = ok
+                done.set()
+                abandoned = box.get("abandoned", False)
+            if ok and abandoned:
+                try:
+                    import jax
+
+                    jax.profiler.stop_trace()
+                except Exception:  # noqa: BLE001
+                    pass
+
+        threading.Thread(target=run, daemon=True,
+                         name="devstats-profile-start").start()
+        if done.wait(self.jax_start_timeout_s):
+            if box.get("ok"):
+                return True, None
+            return False, box.get("error", "start failed")
+        with lock:
+            if done.is_set():  # landed while we were timing out
+                if box.get("ok"):
+                    return True, None
+                return False, box.get("error", "start failed")
+            box["abandoned"] = True
+        return False, ("profiler start exceeded %.0fs (deps still "
+                       "importing) — span-derived trace only; retry "
+                       "for the jax arm" % self.jax_start_timeout_s)
+
+    def _capture(self, duration_ms: int, model_name: str) -> dict:
+        out_dir = self.directory()
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        with self._tap_lock:
+            self._tapped = []
+            self._tap_dropped = 0
+            self._tap_model = str(model_name or "")
+        jax_dir = os.path.join(out_dir, "jax_%d" % seq)
+        started, jax_error = self._start_jax_trace(jax_dir)
+        if not started:
+            jax_dir = None
+        self.armed = True
+        try:
+            time.sleep(duration_ms / 1000.0)
+        finally:
+            self.armed = False
+            if started:
+                try:
+                    import jax
+
+                    jax.profiler.stop_trace()
+                except Exception as e:  # noqa: BLE001
+                    jax_error = str(e)
+                    jax_dir = None
+        with self._tap_lock:
+            tapped, self._tapped = self._tapped, []
+            dropped = self._tap_dropped
+        chrome_path = os.path.join(out_dir,
+                                   "profile_%d.trace.json" % seq)
+        models: Dict[str, int] = {}
+        events: List[dict] = []
+        from client_tpu.server.tracing import chrome_span_events
+
+        for index, record in enumerate(tapped):
+            models[record["model"]] = models.get(record["model"], 0) + 1
+            events.extend(chrome_span_events(
+                record["spans"], record["model"], index,
+                "req %s" % record["request_id"],
+                {"request_id": record["request_id"]}))
+        try:
+            with open(chrome_path, "w") as f:
+                json.dump(events, f)
+        except OSError as e:
+            chrome_path = None
+            jax_error = jax_error or str(e)
+        return {
+            "duration_ms": duration_ms,
+            "model": str(model_name or ""),
+            "chrome_trace": chrome_path,
+            "jax_trace_dir": jax_dir,
+            "jax_supported": started and jax_dir is not None,
+            "jax_error": jax_error,
+            "mode": "jax+spans" if jax_dir else "spans",
+            "requests_captured": len(tapped),
+            "requests_dropped": dropped,
+            "models": models,
+        }
+
+
+class DeviceStats:
+    """The process-wide device-observability registry (see module
+    docstring). Prefer :func:`get` over constructing one — the device
+    axis is shared by every core in the process; tests build private
+    instances."""
+
+    def __init__(self, enabled: Optional[bool] = None):
+        if enabled is None:
+            enabled = os.environ.get(
+                "CLIENT_TPU_DEVSTATS", "").strip().lower() not in (
+                    "off", "0", "false", "disabled")
+        self.enabled = bool(enabled)
+        self.ledger = DeviceLedger()
+        self.profiler = ProfilerCapture(self)
+        self._lock = threading.Lock()
+        # Serializes load measurements (see _LoadMeasure.__enter__);
+        # reentrant because an ensemble load loads composing models.
+        self._load_lock = threading.RLock()
+        # device key -> cumulative busy ns.
+        self._busy_ns: Dict[str, int] = {}
+        # device key -> deque of [slot, ns] duty-window buckets.
+        self._busy_window: Dict[str, deque] = {}
+        # model -> {"count", "ns", "shapes": {fp: count},
+        #           "hist": LatencyHistogram, "storm": deque,
+        #           "storm_fired": mono}
+        self._compiles: Dict[str, dict] = {}
+        self._incident_hooks: List[Callable[[str, str], None]] = []
+        self._tls = threading.local()
+        self._device_keys: Optional[List[str]] = None
+        # Scrape-error accounting: a broken memory_stats() backend is
+        # a counter + one warning log, never an invisible empty family.
+        self.scrape_errors = 0
+        self._scrape_warned = False
+        # Bench stage sampling (hbm peak + compile delta per stage).
+        self._stage_peak = 0
+        self._stage_compiles_base = 0
+        register_compile_listener()
+
+    # -- devices ----------------------------------------------------------
+
+    def device_keys(self) -> List[str]:
+        """Stable per-device labels (``CPU-0`` / ``TPU-3`` — the same
+        uuid scheme the tpu_hbm_* families have always used)."""
+        keys = self._device_keys
+        if keys is None:
+            try:
+                import jax
+
+                keys = ["%s-%d" % (d.platform.upper(), d.id)
+                        for d in jax.local_devices()]
+            except Exception:  # noqa: BLE001 — no runtime: one slot
+                keys = ["DEVICE-0"]
+            if not keys:
+                keys = ["DEVICE-0"]
+            self._device_keys = keys
+        return keys
+
+    def device_key_for_index(self, index: int) -> str:
+        """Replica index -> device label (replicas map onto local
+        devices round-robin — on a one-device host every replica's
+        busy time lands on that device, which is the truth)."""
+        keys = self.device_keys()
+        return keys[int(index) % len(keys)]
+
+    def hbm_used_total(self) -> int:
+        """Sum of ``bytes_in_use`` over local devices (0 when the
+        backend reports none — the CPU sim)."""
+        total = 0
+        try:
+            import jax
+
+            for device in jax.local_devices():
+                stats = device.memory_stats() or {}
+                total += int(stats.get("bytes_in_use") or 0)
+        except Exception:  # noqa: BLE001
+            self._note_scrape_error()
+            return 0
+        return total
+
+    def _note_scrape_error(self) -> None:
+        with self._lock:
+            self.scrape_errors += 1
+            warned, self._scrape_warned = self._scrape_warned, True
+        if not warned:
+            _LOG.warning(
+                "device memory_stats() scrape failed — tpu_hbm_* "
+                "families will be empty; tpu_device_stats_errors_total "
+                "counts further failures (logged once per process)")
+
+    # -- model load measurement ------------------------------------------
+
+    def measure_model_load(self, name: str) -> _LoadMeasure:
+        return _LoadMeasure(self, str(name))
+
+    # -- busy time / duty cycle ------------------------------------------
+
+    def record_busy(self, device_key: Optional[str], ns: int) -> None:
+        """Accumulates one execution's device-side duration.
+        ``device_key=None`` lands on the first local device (the
+        non-replicated single-device arm)."""
+        if not self.enabled or ns <= 0:
+            return
+        if device_key is None:
+            device_key = self.device_keys()[0]
+        now = time.monotonic()
+        slot = int(now / _DUTY_SLOT_S)
+        horizon = slot - int(DUTY_WINDOW_S / _DUTY_SLOT_S)
+        with self._lock:
+            self._busy_ns[device_key] = \
+                self._busy_ns.get(device_key, 0) + int(ns)
+            window = self._busy_window.get(device_key)
+            if window is None:
+                window = deque()
+                self._busy_window[device_key] = window
+            if window and window[-1][0] == slot:
+                window[-1][1] += int(ns)
+            else:
+                window.append([slot, int(ns)])
+            while window and window[0][0] < horizon:
+                window.popleft()
+
+    def replica_busy(self, index: int, ns: int) -> None:
+        """ReplicaSet busy hook: one successful execution on replica
+        ``index``, routed to its device."""
+        if not self.enabled:
+            return
+        self.record_busy(self.device_key_for_index(index), ns)
+
+    def busy_snapshot(self) -> Dict[str, int]:
+        """device key -> cumulative busy microseconds (monotonic)."""
+        with self._lock:
+            return {key: ns // 1000 for key, ns in self._busy_ns.items()}
+
+    def duty_cycle(self) -> Dict[str, float]:
+        """device key -> busy fraction over the sliding window. On the
+        CPU sim several 'device' executions can overlap in wall time,
+        so the value may exceed 1.0 — that reads as oversubscription,
+        not an error."""
+        now = time.monotonic()
+        slot = int(now / _DUTY_SLOT_S)
+        horizon = slot - int(DUTY_WINDOW_S / _DUTY_SLOT_S)
+        out: Dict[str, float] = {}
+        with self._lock:
+            for key, window in self._busy_window.items():
+                while window and window[0][0] < horizon:
+                    window.popleft()
+                busy_ns = sum(entry[1] for entry in window)
+                out[key] = busy_ns / (DUTY_WINDOW_S * 1e9)
+        return out
+
+    # -- compile telemetry ------------------------------------------------
+
+    def _compile_entry(self, model: str) -> dict:
+        entry = self._compiles.get(model)
+        if entry is None:
+            from client_tpu.server.telemetry import LatencyHistogram
+
+            entry = self._compiles.setdefault(model, {
+                "count": 0, "ns": 0, "shapes": {},
+                "hist": LatencyHistogram(),
+                "storm": deque(maxlen=64), "storm_fired": 0.0,
+            })
+        return entry
+
+    def add_incident_hook(self, hook: Callable[[str, str], None]) -> None:
+        """Registers a recompile-storm sink (the core wires the flight
+        recorder's ``mark_incident`` here)."""
+        with self._lock:
+            if hook not in self._incident_hooks:
+                self._incident_hooks.append(hook)
+
+    def set_thread_model(self, model: str) -> None:
+        """Sticky attribution for a model-owned worker thread (LLM
+        decode scheduler, background prefill compiles): XLA compiles
+        on this thread attribute to ``model`` unless a narrower scope
+        is active."""
+        self._tls.default = (str(model), "worker")
+
+    @contextlib.contextmanager
+    def _scope_cm(self, model: str, fingerprint: Optional[str]):
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        entry = (str(model), str(fingerprint) if fingerprint else "b?")
+        stack.append(entry)
+        wall0 = time.monotonic_ns()
+        try:
+            yield
+        finally:
+            stack.pop()
+            if _LISTENER_MODE != "monitoring":
+                # First-call fallback when jax.monitoring is absent:
+                # the first execution of a new shape bucket carries
+                # the compile, so its wall time is the honest upper
+                # bound.
+                self._record_first_call(entry,
+                                        time.monotonic_ns() - wall0)
+
+    def compile_scope(self, model: str, fingerprint: Optional[str] = None):
+        """Context manager the execution layers wrap device dispatch
+        in; compiles observed inside attribute to (model,
+        fingerprint)."""
+        if not self.enabled:
+            return contextlib.nullcontext()
+        return self._scope_cm(model, fingerprint)
+
+    def _record_first_call(self, entry, wall_ns: int) -> None:
+        model, fingerprint = entry
+        with self._lock:
+            compile_entry = self._compile_entry(model)
+            if fingerprint in compile_entry["shapes"]:
+                return
+        self.record_compile(model, fingerprint, wall_ns,
+                            source="first_call")
+
+    def current_scope(self):
+        """(model, fingerprint) for the calling thread: innermost
+        explicit scope, else the thread's sticky model, else
+        unattributed."""
+        stack = getattr(self._tls, "stack", None)
+        if stack:
+            return stack[-1]
+        default = getattr(self._tls, "default", None)
+        if default is not None:
+            return default
+        return (_UNATTRIBUTED, "b?")
+
+    def record_compile(self, model: str, fingerprint: str, ns: int,
+                       source: str = "monitoring") -> None:
+        """One XLA backend compile attributed to ``model``/shape."""
+        if not self.enabled:
+            return
+        ns = max(int(ns), 0)
+        fire_storm = False
+        with self._lock:
+            entry = self._compile_entry(str(model))
+            entry["count"] += 1
+            entry["ns"] += ns
+            shapes = entry["shapes"]
+            fingerprint = str(fingerprint or "b?")
+            if fingerprint not in shapes and \
+                    len(shapes) >= MAX_COMPILE_SHAPES:
+                fingerprint = OVERFLOW_SHAPE
+            shapes[fingerprint] = shapes.get(fingerprint, 0) + 1
+            now = time.monotonic()
+            storm = entry["storm"]
+            storm.append(now)
+            while storm and now - storm[0] > STORM_WINDOW_S:
+                storm.popleft()
+            # The unattributed pseudo-model aggregates compiles from
+            # unscoped threads across ALL models — a storm there names
+            # no culprit and stamps no ring, so it never fires.
+            if model != _UNATTRIBUTED \
+                    and len(storm) >= STORM_COMPILES and \
+                    now - entry["storm_fired"] > STORM_WINDOW_S:
+                entry["storm_fired"] = now
+                fire_storm = True
+                storm_count = len(storm)
+            hooks = list(self._incident_hooks)
+        entry["hist"].observe(ns / 1000.0)
+        if fire_storm:
+            label = ("recompile_storm compiles=%d window_s=%d"
+                     % (storm_count, int(STORM_WINDOW_S)))
+            _LOG.warning(
+                "model '%s': %d XLA compiles inside %ds — recompile "
+                "storm (shape-bucket churn? check the batcher's "
+                "padding policy and the model's dynamic shapes)",
+                model, storm_count, int(STORM_WINDOW_S))
+            for hook in hooks:
+                try:
+                    hook(str(model), label)
+                except Exception:  # noqa: BLE001 — stamping is
+                    pass  # advisory
+
+    def compile_snapshot(self) -> Dict[str, dict]:
+        with self._lock:
+            return {
+                model: {
+                    "count": entry["count"],
+                    "ns": entry["ns"],
+                    "shapes": dict(entry["shapes"]),
+                }
+                for model, entry in self._compiles.items()
+            }
+
+    def compile_total(self) -> int:
+        with self._lock:
+            return sum(entry["count"]
+                       for entry in self._compiles.values())
+
+    # -- statistics-proto / debug views -----------------------------------
+
+    def model_device_snapshot(self, model: str) -> Optional[dict]:
+        """The DeviceStatistics block for one model (None when the
+        ledger and compile tracker both know nothing about it)."""
+        components = self.ledger.model_bytes(model)
+        with self._lock:
+            entry = self._compiles.get(str(model))
+            compile_count = entry["count"] if entry else 0
+            compile_ns = entry["ns"] if entry else 0
+        if not components and not compile_count:
+            return None
+        return {
+            "hbm_bytes": sum(components.values()),
+            "components": sorted(components.items()),
+            "compile_count": compile_count,
+            "compile_ns": compile_ns,
+        }
+
+    def debug_snapshot(self) -> dict:
+        """The ``devices`` section of GET /v2/debug (cardinality-
+        bounded: devices, ledger rows, per-model compile counts)."""
+        used_rows = {}
+        limit_rows = {}
+        try:
+            import jax
+
+            for device in jax.local_devices():
+                key = "%s-%d" % (device.platform.upper(), device.id)
+                stats = device.memory_stats() or {}
+                used = stats.get("bytes_in_use")
+                limit = stats.get("bytes_limit")
+                if used is not None:
+                    used_rows[key] = int(used)
+                if limit:
+                    limit_rows[key] = int(limit)
+        except Exception:  # noqa: BLE001
+            self._note_scrape_error()
+        ledger = self.ledger.snapshot()
+        ledger_total = sum(sum(components.values())
+                           for components in ledger.values())
+        compiles = self.compile_snapshot()
+        return {
+            "hbm_used_bytes": used_rows,
+            "hbm_total_bytes": limit_rows,
+            "ledger": ledger,
+            "ledger_total_bytes": ledger_total,
+            "unattributed_bytes": max(
+                sum(used_rows.values()) - ledger_total, 0)
+            if used_rows else None,
+            "busy_us": self.busy_snapshot(),
+            "duty_cycle": {key: round(value, 6)
+                           for key, value in self.duty_cycle().items()},
+            "compiles": {
+                model: {"count": entry["count"],
+                        "shapes": entry["shapes"]}
+                for model, entry in sorted(compiles.items())
+            },
+            "scrape_errors": self.scrape_errors,
+            "profiler": {
+                "armed": bool(self.profiler.armed),
+                "captures": self.profiler.capture_count,
+                "coalesced": self.profiler.coalesced_count,
+            },
+        }
+
+    # -- bench stage sampling ---------------------------------------------
+
+    def stage_sample(self) -> dict:
+        """Per-bench-stage device sample: the HBM high-water mark
+        since the last call — the ledger's register-time peak (catches
+        a pool allocated AND freed inside the stage) combined with the
+        runtime used-bytes endpoint samples — plus the compile-count
+        delta."""
+        used = self.hbm_used_total()
+        ledger_peak = self.ledger.take_peak()
+        current = max(used, self.ledger.total())
+        compiles = self.compile_total()
+        with self._lock:
+            peak = max(self._stage_peak, used, ledger_peak)
+            delta = compiles - self._stage_compiles_base
+            self._stage_peak = current
+            self._stage_compiles_base = compiles
+        return {"hbm_peak_bytes": int(peak),
+                "compile_count": max(int(delta), 0)}
+
+    # -- exposition --------------------------------------------------------
+
+    def render_metrics(self) -> List[str]:
+        """Prometheus exposition lines for every device family (the
+        block that used to live inline in ``core.metrics_text`` behind
+        a bare ``except: pass`` — failures now count and log)."""
+        lines: List[str] = []
+
+        def family(name, kind, help_text, rows):
+            if not rows:
+                return
+            lines.append("# HELP %s %s" % (name, help_text))
+            lines.append("# TYPE %s %s" % (name, kind))
+            lines.extend(rows)
+
+        used_rows, total_rows, util_rows = [], [], []
+        used_total = 0
+        used_seen = False
+        try:
+            import jax
+
+            for device in jax.local_devices():
+                uuid = "%s-%d" % (device.platform.upper(), device.id)
+                label = '{tpu_uuid="%s"}' % uuid
+                mem = device.memory_stats() or {}
+                used = mem.get("bytes_in_use")
+                limit = mem.get("bytes_limit")
+                if used is not None:
+                    used_seen = True
+                    used_total += int(used)
+                    used_rows.append("tpu_hbm_used_bytes%s %d"
+                                     % (label, used))
+                if limit:
+                    total_rows.append("tpu_hbm_total_bytes%s %d"
+                                      % (label, limit))
+                    if used is not None:
+                        util_rows.append("tpu_hbm_utilization%s %.6f"
+                                         % (label, used / limit))
+        except Exception:  # noqa: BLE001 — metrics never take the
+            self._note_scrape_error()  # server down — but they COUNT
+        family("tpu_hbm_used_bytes", "gauge",
+               "Accelerator HBM bytes in use", used_rows)
+        family("tpu_hbm_total_bytes", "gauge",
+               "Accelerator HBM capacity in bytes", total_rows)
+        family("tpu_hbm_utilization", "gauge",
+               "Fraction of accelerator HBM in use", util_rows)
+
+        model_rows = []
+        ledger_total = 0
+        ledger_rows = self.ledger.snapshot()  # ONE consistent view
+        for model in sorted(ledger_rows):
+            components = ledger_rows[model]
+            for component in sorted(components):
+                nbytes = components[component]
+                ledger_total += nbytes
+                model_rows.append(
+                    'tpu_hbm_model_bytes{model="%s",component="%s"} %d'
+                    % (model, component, nbytes))
+        if used_seen:
+            residual = max(used_total - ledger_total, 0)
+            model_rows.append(
+                'tpu_hbm_model_bytes{model="%s",component="residual"} '
+                '%d' % (_UNATTRIBUTED, residual))
+        family("tpu_hbm_model_bytes", "gauge",
+               "HBM bytes attributed per model and component by the "
+               "device ledger (weights, kv_pages, arena, replicas); "
+               "the unattributed/residual row closes the gap to "
+               "tpu_hbm_used_bytes", model_rows)
+
+        busy_rows = [
+            'tpu_device_busy_us_total{device="%s"} %d' % (key, us)
+            for key, us in sorted(self.busy_snapshot().items())
+        ]
+        family("tpu_device_busy_us_total", "counter",
+               "Cumulative device-side execution time (fused batch "
+               "compute + direct executes + per-replica executions); "
+               "rate() yields duty cycle", busy_rows)
+        duty_rows = [
+            'tpu_device_duty_cycle{device="%s"} %.6f' % (key, value)
+            for key, value in sorted(self.duty_cycle().items())
+        ]
+        family("tpu_device_duty_cycle", "gauge",
+               "Busy fraction over a %ds sliding window (may exceed 1 "
+               "when simulated devices overlap executions)"
+               % int(DUTY_WINDOW_S), duty_rows)
+
+        compiles = self.compile_snapshot()
+        compile_rows = []
+        for model in sorted(compiles):
+            for shape in sorted(compiles[model]["shapes"]):
+                compile_rows.append(
+                    'tpu_compile_total{model="%s",shape="%s"} %d'
+                    % (model, shape, compiles[model]["shapes"][shape]))
+        family("tpu_compile_total", "counter",
+               "XLA compiles attributed per model and shape-bucket "
+               "fingerprint (bounded cardinality; recompile storms "
+               "stamp the flight ring)", compile_rows)
+        hist_rows = []
+        with self._lock:
+            entries = [(model, entry["hist"])
+                       for model, entry in sorted(self._compiles.items())]
+        from client_tpu.server.telemetry import ServerTelemetry
+
+        for model, hist in entries:
+            snap = hist.snapshot()
+            if snap["count"]:
+                hist_rows.extend(ServerTelemetry._histogram_rows(
+                    "tpu_compile_duration_us", 'model="%s"' % model,
+                    snap, with_exemplars=False))
+        family("tpu_compile_duration_us", "histogram",
+               "XLA compile wall time per model (histogram)",
+               hist_rows)
+
+        family("tpu_device_stats_errors_total", "counter",
+               "Device-stats scrape failures (memory_stats() backend "
+               "errors; logged once per process)",
+               ["tpu_device_stats_errors_total %d" % self.scrape_errors])
+        return lines
+
+
+# -- process-wide singleton + jax.monitoring listener ----------------------
+
+_SINGLETON: Optional[DeviceStats] = None
+_SINGLETON_LOCK = threading.Lock()
+_LISTENER_LOCK = threading.Lock()
+_LISTENER_MODE = "unregistered"
+
+
+def get() -> DeviceStats:
+    """The process-wide DeviceStats (devices are process-global; all
+    in-process cores share one ledger and one set of counters)."""
+    global _SINGLETON
+    if _SINGLETON is None:
+        with _SINGLETON_LOCK:
+            if _SINGLETON is None:
+                _SINGLETON = DeviceStats()
+    return _SINGLETON
+
+
+def _on_jax_event(event: str, duration_secs: float, **_kwargs) -> None:
+    if event != _COMPILE_EVENT:
+        return
+    stats = _SINGLETON
+    if stats is None or not stats.enabled:
+        return
+    model, fingerprint = stats.current_scope()
+    stats.record_compile(model, fingerprint,
+                         int(duration_secs * 1e9))
+
+
+def register_compile_listener() -> str:
+    """Registers the process-wide jax.monitoring compile listener once
+    (idempotent); returns the resulting mode ("monitoring" or
+    "first_call" when jax.monitoring is unavailable)."""
+    global _LISTENER_MODE
+    with _LISTENER_LOCK:
+        if _LISTENER_MODE != "unregistered":
+            return _LISTENER_MODE
+        try:
+            import jax.monitoring
+
+            jax.monitoring.register_event_duration_secs_listener(
+                _on_jax_event)
+            _LISTENER_MODE = "monitoring"
+        except Exception:  # noqa: BLE001 — fall back to first-call
+            _LISTENER_MODE = "first_call"  # timing inside the scopes
+        return _LISTENER_MODE
+
+
+def listener_mode() -> str:
+    return _LISTENER_MODE

@@ -345,7 +345,10 @@ def debug_generic_handler(core: InferenceServerCore):
 
     * ``/inference.Debug/Snapshot`` — ``core.debug_snapshot()``;
     * ``/inference.Debug/Flight`` — ``core.debug_flight()`` (the
-      flight-ring anomaly-trace dump).
+      flight-ring anomaly-trace dump);
+    * ``/inference.Debug/Profile`` — ``core.debug_profile()``
+      (on-demand bounded profiler capture; request body
+      ``{"duration_ms": N, "model": "M"}``, both optional).
 
     Call from any grpc channel:
     ``channel.unary_unary("/inference.Debug/Snapshot",
@@ -369,6 +372,25 @@ def debug_generic_handler(core: InferenceServerCore):
         return json.dumps(core.debug_flight(_model_of(request_bytes)),
                           default=str).encode("utf-8")
 
+    def profile(request_bytes, context):
+        doc = {}
+        if request_bytes:
+            try:
+                doc = json.loads(request_bytes.decode("utf-8"))
+            except (ValueError, UnicodeDecodeError):
+                doc = {}
+        if not isinstance(doc, dict):
+            doc = {}
+        try:
+            duration_ms = int(doc.get("duration_ms") or 500)
+        except (TypeError, ValueError):
+            duration_ms = 500
+        # Blocks this handler thread for the (clamped) capture window;
+        # concurrent callers coalesce single-flight inside the core.
+        return json.dumps(
+            core.debug_profile(duration_ms, str(doc.get("model") or "")),
+            default=str).encode("utf-8")
+
     def identity(payload: bytes) -> bytes:
         return payload
 
@@ -380,6 +402,9 @@ def debug_generic_handler(core: InferenceServerCore):
                 response_serializer=identity),
             "Flight": grpc.unary_unary_rpc_method_handler(
                 flight, request_deserializer=identity,
+                response_serializer=identity),
+            "Profile": grpc.unary_unary_rpc_method_handler(
+                profile, request_deserializer=identity,
                 response_serializer=identity),
         })
 

@@ -140,18 +140,21 @@ def _metrics(core, m, headers, body):
     return 200, {"Content-Type": "text/plain; version=0.0.4"}, text.encode()
 
 
-def _debug_query_model(m, headers) -> str:
-    """?model=M for the debug routes. Direct http_call callers pass
-    the raw request target (query included) and it matches off the
-    path; the native HTTP/1.1 front-end strips the query before
+def _debug_query(m, headers) -> dict:
+    """Parsed query for the debug routes. Direct http_call callers
+    pass the raw request target (query included) and it matches off
+    the path; the native HTTP/1.1 front-end strips the query before
     routing and forwards it as the synthetic ``x-request-query``
     header instead (http1_server.cc) — check both."""
     from urllib.parse import parse_qs, urlsplit
 
     query_string = urlsplit(m.string).query \
         or headers.get("x-request-query", "")
-    query = parse_qs(query_string)
-    return (query.get("model") or [""])[0]
+    return parse_qs(query_string)
+
+
+def _debug_query_model(m, headers) -> str:
+    return (_debug_query(m, headers).get("model") or [""])[0]
 
 
 @_route("GET", r"/v2/debug(?:\?.*)?")
@@ -164,6 +167,21 @@ def _debug(core, m, headers, body):
 @_route("GET", r"/v2/debug/flight(?:\?.*)?")
 def _debug_flight(core, m, headers, body):
     return _json_reply(core.debug_flight(_debug_query_model(m, headers)))
+
+
+@_route("GET", r"/v2/debug/profile(?:\?.*)?")
+def _debug_profile(core, m, headers, body):
+    # On-demand bounded profiler capture, aiohttp-front-end parity
+    # (docs/device_observability.md). The embedded dispatcher is
+    # synchronous by design — the caller's worker thread blocks for
+    # the (clamped) capture window.
+    query = _debug_query(m, headers)
+    try:
+        duration_ms = int((query.get("duration_ms") or ["500"])[0])
+    except ValueError:
+        duration_ms = 500
+    return _json_reply(core.debug_profile(
+        duration_ms, (query.get("model") or [""])[0]))
 
 
 @_route("GET", r"/v2")

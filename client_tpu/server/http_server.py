@@ -118,6 +118,20 @@ def build_http_app(core: InferenceServerCore) -> web.Application:
                          request.query.get("model", ""))
         return web.json_response(doc)
 
+    @routes.get("/v2/debug/profile")
+    async def debug_profile(request):
+        # On-demand bounded profiler capture (docs/
+        # device_observability.md): blocks for the (clamped) window on
+        # the executor, so the event loop keeps serving; concurrent
+        # requests coalesce single-flight inside the core.
+        try:
+            duration_ms = int(request.query.get("duration_ms", "500"))
+        except ValueError:
+            duration_ms = 500
+        doc = await _run(core.debug_profile, duration_ms,
+                         request.query.get("model", ""))
+        return web.json_response(doc)
+
     @routes.get("/v2")
     async def server_metadata(request):
         return _pb_json(core.server_metadata())

@@ -62,6 +62,7 @@ import numpy as np
 from client_tpu import status_map
 from client_tpu.robust import CLIENT_ERROR_STATUSES, CircuitBreaker
 from client_tpu.server import chaos
+from client_tpu.server import devstats as devstats_mod
 from client_tpu.utils import InferenceServerException, triton_to_np_dtype
 
 _LOG = logging.getLogger("client_tpu.server.replicas")
@@ -105,12 +106,16 @@ class _Replica:
     __slots__ = ("index", "model", "executor", "breaker", "hung",
                  "outstanding", "ewma_latency_s", "requests", "failures",
                  "execution_count", "exec_ns", "ejected_count",
-                 "readmitted_count", "generation")
+                 "readmitted_count", "generation", "ledger_row")
 
     def __init__(self, index: int, model, breaker: CircuitBreaker):
         self.index = index
         self.model = model
         self.breaker = breaker
+        # Device-ledger row for this replica's own executable (None
+        # when the replica shares the base instance — the load-time
+        # weights row already covers that memory).
+        self.ledger_row = None
         self.executor: Optional[ThreadPoolExecutor] = None
         # Watchdog verdict: the replica's device queue stopped
         # answering. Distinct from the breaker (which needs repeated
@@ -223,6 +228,7 @@ class ReplicaSet:
                 failure_threshold=self._failure_threshold,
                 reset_timeout_s=self._recovery_s))
             self._start_queue(replica)
+            self._register_ledger(replica, instance)
             self.replicas.append(replica)
         self.proxy = ReplicatedModel(self)
         self._stopping = False
@@ -256,7 +262,9 @@ class ReplicaSet:
             try:
                 warmup = getattr(instance, "warmup", None)
                 if callable(warmup):
-                    warmup()
+                    with devstats_mod.get().compile_scope(
+                            self.name, "replica_warmup"):
+                        warmup()
             except Exception:  # noqa: BLE001 — serving will judge it
                 pass
         return instance
@@ -269,6 +277,21 @@ class ReplicaSet:
             thread_name_prefix="replica-%s-%d-g%d"
             % (self.name, replica.index, replica.generation))
 
+    def _register_ledger(self, replica: _Replica, instance) -> None:
+        """Attributes a fresh per-replica executable's device arrays
+        to this model in the HBM ledger (``replica:<index>`` row).
+        Replicas sharing the base executable register nothing — the
+        load-time ``weights`` row already covers that memory."""
+        if instance is self.base:
+            return
+        try:
+            ledger = devstats_mod.get().ledger
+            replica.ledger_row = ledger.register(
+                self.name, "replica:%d" % replica.index,
+                devstats_mod.model_array_bytes(instance))
+        except Exception:  # noqa: BLE001 — accounting must never
+            pass  # block serving
+
     def stop(self) -> None:
         """Drain for unload/shutdown: stop the supervisor, then shut
         the device queues down after their in-flight executions
@@ -277,7 +300,10 @@ class ReplicaSet:
             self._stopping = True
         self._stop.set()
         self._supervisor.join(timeout=5)
+        ledger = devstats_mod.get().ledger
         for replica in self.replicas:
+            ledger.release(replica.ledger_row)
+            replica.ledger_row = None
             executor = replica.executor
             if executor is not None:
                 # A hung replica's worker can never finish: wait only
@@ -397,7 +423,15 @@ class ReplicaSet:
         chaos.inject(self.name,
                      scope=self._scope_fn() if self._scope_fn else None,
                      replica_id="%s:%d" % (self.name, replica.index))
-        return replica.model.infer(inputs, parameters)
+        # Compile attribution runs HERE — on the replica's own device-
+        # queue thread — because thread-local scopes pushed by the
+        # batcher or the core do not cross the executor hand-off.
+        devstats = devstats_mod.get()
+        if not devstats.enabled:  # A/B off arm: zero devstats cost
+            return replica.model.infer(inputs, parameters)
+        with devstats.compile_scope(
+                self.name, devstats_mod.shape_fingerprint(inputs)):
+            return replica.model.infer(inputs, parameters)
 
     def _execute(self, replica: _Replica, inputs,
                  parameters: Optional[dict]) -> Dict[str, np.ndarray]:
@@ -458,6 +492,9 @@ class ReplicaSet:
             replica.ewma_latency_s = (
                 latency_s if replica.ewma_latency_s == 0.0
                 else 0.2 * latency_s + 0.8 * replica.ewma_latency_s)
+        # Busy time routed per replica device (outside the set's lock;
+        # the devstats layer does its own cheap synchronization).
+        devstats_mod.get().replica_busy(replica.index, latency_ns)
 
     def _notify(self, label: str) -> None:
         """Fires the lifecycle event hook (never under the set's
@@ -570,6 +607,12 @@ class ReplicaSet:
         watchdog and re-dispatches."""
         old = replica.executor
         instance = self._new_instance()  # warmed before routing
+        # The old executable's ledger row dies with it; the fresh
+        # instance registers its own (re-init is an allocation site —
+        # skipping it here would leak a row per heal cycle).
+        devstats_mod.get().ledger.release(replica.ledger_row)
+        replica.ledger_row = None
+        self._register_ledger(replica, instance)
         with self._lock:
             replica.model = instance
             self._start_queue(replica)

@@ -67,6 +67,9 @@ class _Region:
         self.lock = threading.Lock()
         # Disjoint segments sorted by offset.
         self.segments: list = []
+        # Device-ledger row for this slot's logical reservation
+        # (registered by create_region, released by destroy_region).
+        self.ledger_row = None
 
 
 class TpuArena:
@@ -120,6 +123,18 @@ class TpuArena:
         region_id = uuid.uuid4().hex
         nonce = secrets.token_hex(8)
         region = _Region(region_id, device, device_id, byte_size, nonce)
+        # HBM attribution: arena slots are client-reserved device
+        # memory nothing model-keyed would otherwise explain — one
+        # aggregated `arena/regions` ledger row covers them all
+        # (per-region handles release their own contribution).
+        try:
+            from client_tpu.server import devstats
+
+            ledger = devstats.get().ledger
+            region.ledger_row = ledger.register("arena", "regions",
+                                                byte_size)
+        except Exception:  # noqa: BLE001 — accounting must never
+            pass  # block the data plane
         with self._lock:
             self._regions[region_id] = region
         return self._serialize_handle(region)
@@ -183,6 +198,13 @@ class TpuArena:
             region = self._regions.pop(region_id, None)
         if region is not None:
             region.segments = []  # drop the HBM buffer references
+            try:
+                from client_tpu.server import devstats
+
+                devstats.get().ledger.release(region.ledger_row)
+            except Exception:  # noqa: BLE001
+                pass
+            region.ledger_row = None
 
     def list_regions(self):
         with self._lock:

@@ -1,0 +1,565 @@
+"""Device-axis observability (PR 15): HBM ledger register/release
+pairing across model load/unload, replica re-init and KV
+crash-rebuild; busy-time monotonicity under concurrent fused
+executions; compile-counter increments on a forced shape-bucket miss;
+the recompile-storm incident stamp; the /v2/debug/profile endpoint
+over all three transports (single-flight, bounded duration, fallback
+arm); and the /v2/debug ``devices`` section's cardinality lint."""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from client_tpu._infer_common import InferInput
+from client_tpu.grpc._utils import get_inference_request
+from client_tpu.server import devstats as devstats_mod
+from client_tpu.server.app import build_core, start_grpc_server
+from client_tpu.server.devstats import (
+    DeviceLedger,
+    DeviceStats,
+    MAX_LEDGER_COMPONENTS,
+    OVERFLOW_ROW,
+    model_array_bytes,
+)
+from client_tpu.server.http_embed import http_call
+from client_tpu.server.http_server import start_http_server_thread
+from client_tpu.server.model import ServedModel, TensorSpec
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+from metrics_lint import lint_debug_snapshot  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _stub_jax_profiler(monkeypatch):
+    """The first jax-profiler start in a process imports heavy deps
+    (tensorflow, ~10s) on a background thread, and an import left
+    mid-flight at interpreter exit can segfault the teardown. Tests
+    stub the start so the capture always takes its span-derived arm —
+    which is the logic under test here; the real jax arm is exercised
+    end-to-end by tools/devstats_smoke.py (which hard-exits past the
+    teardown hazard)."""
+
+    def unsupported(*_args, **_kwargs):
+        raise RuntimeError("stubbed in tests")
+
+    monkeypatch.setattr(jax.profiler, "start_trace", unsupported)
+    profiler = devstats_mod.get().profiler
+    before = profiler.jax_start_timeout_s
+    profiler.jax_start_timeout_s = 2.0
+    yield
+    profiler.jax_start_timeout_s = before
+
+
+def _simple_request(model_name: str, shape=(16,), batch: int = 0,
+                    seed: int = 0):
+    full = ([batch] + list(shape)) if batch else list(shape)
+    a = np.full(full, seed % 97, dtype=np.int32)
+    b = np.arange(int(np.prod(full)), dtype=np.int32).reshape(full)
+    t0 = InferInput("INPUT0", full, "INT32")
+    t0.set_data_from_numpy(a)
+    t1 = InferInput("INPUT1", full, "INT32")
+    t1.set_data_from_numpy(b)
+    return get_inference_request(model_name=model_name,
+                                 inputs=[t0, t1], outputs=None)
+
+
+class _ArrayModel(ServedModel):
+    """Add/sub with a device-resident weight array, so the ledger's
+    exact-nbytes measurement has something real to count."""
+
+    def __init__(self, name: str = "array_model", weights_n: int = 1024):
+        super().__init__()
+        self.name = name
+        self.inputs = [TensorSpec("INPUT0", "INT32", [16]),
+                       TensorSpec("INPUT1", "INT32", [16])]
+        self.outputs = [TensorSpec("OUTPUT0", "INT32", [16]),
+                        TensorSpec("OUTPUT1", "INT32", [16])]
+        self._weights = jnp.zeros((weights_n,), dtype=jnp.float32)
+
+    def infer(self, inputs, parameters=None):
+        a, b = inputs["INPUT0"], inputs["INPUT1"]
+        return {"OUTPUT0": np.asarray(a) + np.asarray(b),
+                "OUTPUT1": np.asarray(a) - np.asarray(b)}
+
+
+# -- ledger unit semantics -------------------------------------------------
+
+
+def test_ledger_rows_aggregate_and_release_exactly():
+    ledger = DeviceLedger()
+    row_a = ledger.register("m", "weights", 100)
+    row_b = ledger.register("m", "weights", 50)
+    row_c = ledger.register("m", "kv_pages", 10)
+    assert ledger.model_bytes("m") == {"weights": 150, "kv_pages": 10}
+    assert ledger.total() == 160
+    ledger.release(row_a)
+    assert ledger.model_bytes("m") == {"weights": 50, "kv_pages": 10}
+    ledger.release(row_a)  # double release: a no-op, never negative
+    assert ledger.model_bytes("m")["weights"] == 50
+    ledger.release(row_b)
+    ledger.release(row_c)
+    assert ledger.model_bytes("m") == {}
+    assert ledger.total() == 0
+
+
+def test_ledger_zero_byte_register_is_a_noop():
+    ledger = DeviceLedger()
+    assert ledger.register("m", "weights", 0) is None
+    assert ledger.total() == 0
+
+
+def test_ledger_release_model_sweeps_all_components():
+    ledger = DeviceLedger()
+    ledger.register("m", "weights", 5)
+    ledger.register("m", "kv_pages", 7)
+    ledger.register("other", "weights", 3)
+    assert ledger.release_model("m") == 12
+    assert ledger.model_bytes("m") == {}
+    assert ledger.total() == 3
+
+
+def test_ledger_component_cardinality_folds_into_overflow():
+    ledger = DeviceLedger()
+    for index in range(MAX_LEDGER_COMPONENTS + 8):
+        ledger.register("m", "component%d" % index, 1)
+    components = ledger.model_bytes("m")
+    assert len(components) <= MAX_LEDGER_COMPONENTS + 1
+    assert components[OVERFLOW_ROW] == 8
+
+
+def test_model_array_bytes_counts_device_arrays():
+    model = _ArrayModel(weights_n=2048)
+    assert model_array_bytes(model) == 2048 * 4
+
+
+# -- ledger pairing across the real lifecycle ------------------------------
+
+
+def test_load_unload_leaves_no_ledger_residue():
+    stats = devstats_mod.get()
+    core = build_core([])
+    name = "devstats_load_model"
+    core.repository.add_factory(name, lambda: _ArrayModel(name))
+    before = stats.ledger.model_bytes(name)
+    assert before == {}
+    try:
+        core.load_model(name, warmup=False)
+        rows = stats.ledger.model_bytes(name)
+        assert rows.get("weights") == 1024 * 4
+        # Re-load replaces the weights row instead of stacking on it.
+        core.load_model(name, warmup=False)
+        assert stats.ledger.model_bytes(name).get("weights") == 1024 * 4
+        core.unload_model(name)
+        assert stats.ledger.model_bytes(name) == {}
+    finally:
+        core.shutdown()
+
+
+def test_replica_reinit_replaces_row_without_residue():
+    from client_tpu.server.replicas import ReplicaSet
+
+    stats = devstats_mod.get()
+    name = "devstats_replica_model"
+    base = _ArrayModel(name)
+    base.instance_group_count = 2
+    replica_set = ReplicaSet(base, factory=lambda: _ArrayModel(name),
+                             count=2)
+    try:
+        rows = stats.ledger.model_bytes(name)
+        # replica 0 shares the base (covered by the weights row);
+        # replica 1 holds its own executable.
+        assert rows.get("replica:1") == 1024 * 4
+        replica_set._reinitialize(replica_set.replicas[1])
+        rows = stats.ledger.model_bytes(name)
+        assert rows.get("replica:1") == 1024 * 4  # replaced, not added
+    finally:
+        replica_set.stop()
+    assert stats.ledger.model_bytes(name) == {}
+
+
+def test_kv_pool_row_registered_and_crash_rebuild_releases():
+    stats = devstats_mod.get()
+    core = build_core([])
+    try:
+        from client_tpu.models.llm import LlmModel
+
+        model = LlmModel(name="devstats_llm", decode_lanes=2,
+                         kv_pages=8)
+        core.repository.add_model(model)
+        assert stats.ledger.model_bytes("devstats_llm") == {}
+        out = list(model.infer_stream({
+            "text_input": np.array([b"hello there"], dtype=np.object_),
+            "max_tokens": np.array([2], dtype=np.int32),
+        }))
+        assert out
+        rows = stats.ledger.model_bytes("devstats_llm")
+        assert rows.get("kv_pages", 0) > 0
+        pool_bytes = rows["kv_pages"]
+        # Crash: the pool's device arrays are dropped wholesale — the
+        # ledger row must go with them, and a rebuild re-registers
+        # exactly one row.
+        model._crash("injected crash", model._gen)
+        assert "kv_pages" not in stats.ledger.model_bytes(
+            "devstats_llm")
+        out = list(model.infer_stream({
+            "text_input": np.array([b"again"], dtype=np.object_),
+            "max_tokens": np.array([2], dtype=np.int32),
+        }))
+        assert out
+        assert stats.ledger.model_bytes(
+            "devstats_llm")["kv_pages"] == pool_bytes
+        core.unload_model("devstats_llm")
+        assert stats.ledger.model_bytes("devstats_llm") == {}
+    finally:
+        core.shutdown()
+
+
+def test_arena_region_rows_pair_create_destroy():
+    pytest.importorskip("jax")
+    from client_tpu.server.tpu_arena import TpuArena
+
+    stats = devstats_mod.get()
+    before = stats.ledger.model_bytes("arena").get("regions", 0)
+    arena = TpuArena()
+    handle = arena.create_region(4096, 0)
+    region_id = json.loads(handle)["region_id"]
+    assert stats.ledger.model_bytes("arena")["regions"] == before + 4096
+    arena.destroy_region(region_id)
+    assert stats.ledger.model_bytes("arena").get("regions", 0) == before
+
+
+# -- busy time -------------------------------------------------------------
+
+
+def test_busy_counter_monotonic_under_concurrent_fused_executions():
+    stats = devstats_mod.get()
+    core = build_core(["simple_cache"])
+    try:
+        base = dict(stats.busy_snapshot())
+
+        def worker(offset):
+            for index in range(6):
+                core.infer(_simple_request(
+                    "simple_cache", batch=1,
+                    seed=offset * 100 + index))
+
+        pool = [threading.Thread(target=worker, args=(i,))
+                for i in range(4)]
+        for thread in pool:
+            thread.start()
+        for thread in pool:
+            thread.join()
+        mid = dict(stats.busy_snapshot())
+        assert sum(mid.values()) > sum(base.values())
+        for _ in range(4):
+            core.infer(_simple_request("simple_cache", batch=1,
+                                       seed=999))
+        after = dict(stats.busy_snapshot())
+        # Monotonic per device between scrapes.
+        for key, value in mid.items():
+            assert after.get(key, 0) >= value
+        duty = stats.duty_cycle()
+        assert duty and all(v >= 0 for v in duty.values())
+    finally:
+        core.shutdown()
+
+
+def test_busy_disabled_arm_records_nothing():
+    stats = DeviceStats(enabled=False)
+    stats.record_busy("CPU-0", 1_000_000)
+    assert stats.busy_snapshot() == {}
+
+
+# -- compile telemetry -----------------------------------------------------
+
+
+def test_compile_counter_increments_on_forced_shape_bucket_miss():
+    if devstats_mod.listener_mode() != "monitoring":
+        pytest.skip("jax.monitoring unavailable")
+    from client_tpu.models.add_sub import AddSub
+
+    stats = devstats_mod.get()
+    name = "devstats_bucket_model"
+    # device != "cpu" keeps AddSub off its host-numpy shortcut, so
+    # every fused execution goes through the jitted kernel and a
+    # fresh shape bucket really compiles.
+    model = AddSub(name=name, datatype="INT32", shape=(16,),
+                   device="default")
+    model.max_batch_size = 4
+    model.dynamic_batching = True
+    model.preferred_batch_sizes = [1, 2]
+    model.max_queue_delay_us = 100
+    core = build_core([])
+    core.repository.add_model(model)
+    try:
+        core.infer(_simple_request(name, batch=1))
+        first = stats.compile_snapshot().get(name, {"count": 0})
+        assert first["count"] >= 1  # bucket b1 compiled
+        # Force a shape-bucket miss: a batch-2 request pads to the
+        # next preferred size and hits a bucket XLA never traced.
+        core.infer(_simple_request(name, batch=2))
+        second = stats.compile_snapshot()[name]
+        assert second["count"] > first["count"]
+        assert any(shape.startswith("b") for shape in second["shapes"])
+        # The same bucket again: steady state, no recompile.
+        core.infer(_simple_request(name, batch=2))
+        assert stats.compile_snapshot()[name]["count"] == \
+            second["count"]
+    finally:
+        core.shutdown()
+
+
+def test_recompile_storm_stamps_incident_hook():
+    stats = DeviceStats(enabled=True)
+    stamped = []
+    stats.add_incident_hook(lambda model, label: stamped.append(
+        (model, label)))
+    for _ in range(devstats_mod.STORM_COMPILES):
+        stats.record_compile("stormy", "b1", 1_000_000)
+    assert stamped
+    model, label = stamped[0]
+    assert model == "stormy"
+    assert label.startswith("recompile_storm")
+    # Re-fire is suppressed inside the window (one stamp per storm,
+    # not one per compile).
+    stats.record_compile("stormy", "b1", 1_000_000)
+    assert len(stamped) == 1
+
+
+def test_compile_shape_cardinality_bounded():
+    stats = DeviceStats(enabled=True)
+    for index in range(devstats_mod.MAX_COMPILE_SHAPES + 10):
+        stats.record_compile("m", "b%d" % index, 1000)
+    shapes = stats.compile_snapshot()["m"]["shapes"]
+    assert len(shapes) <= devstats_mod.MAX_COMPILE_SHAPES + 1
+    assert shapes[devstats_mod.OVERFLOW_SHAPE] == 10
+
+
+def test_compile_families_render_on_metrics():
+    core = build_core(["simple"])
+    try:
+        core.infer(_simple_request("simple"))
+        text = core.metrics_text()
+        assert "tpu_device_busy_us_total" in text
+        assert "tpu_device_stats_errors_total" in text
+        if devstats_mod.listener_mode() == "monitoring":
+            assert "tpu_compile_total" in text
+            assert "tpu_compile_duration_us_bucket" in text
+    finally:
+        core.shutdown()
+
+
+# -- statistics proto ------------------------------------------------------
+
+
+def test_device_stats_block_in_statistics_proto():
+    core = build_core([])
+    name = "devstats_proto_model"
+    core.repository.add_factory(name, lambda: _ArrayModel(name))
+    try:
+        core.load_model(name, warmup=False)
+        response = core.model_statistics(name)
+        stat = response.model_stats[0]
+        assert stat.device_stats.hbm_bytes == 1024 * 4
+        components = {row.component: row.hbm_bytes
+                      for row in stat.device_stats.components}
+        assert components.get("weights") == 1024 * 4
+    finally:
+        core.shutdown()
+
+
+# -- profiler capture ------------------------------------------------------
+
+
+def test_profile_capture_bounded_and_chrome_loadable():
+    core = build_core(["simple"])
+    try:
+        stop = threading.Event()
+
+        def traffic():
+            while not stop.is_set():
+                core.infer(_simple_request("simple"))
+
+        thread = threading.Thread(target=traffic, daemon=True)
+        thread.start()
+        try:
+            # duration is clamped to the [10ms, 10s] bound — a bogus
+            # negative duration cannot wedge the single-flight slot.
+            doc = core.debug_profile(duration_ms=-50)
+        finally:
+            stop.set()
+            thread.join(timeout=5)
+        assert doc["duration_ms"] == devstats_mod.PROFILE_MIN_MS
+        assert doc["coalesced"] is False
+        assert doc["requests_captured"] >= 0
+        with open(doc["chrome_trace"]) as f:
+            events = json.load(f)  # strict JSON: loadable as written
+        assert isinstance(events, list)
+    finally:
+        core.shutdown()
+
+
+def test_profile_capture_taps_requests_even_with_flight_off():
+    core = build_core(["simple"])
+    try:
+        core.flight.enabled = False
+        box = {}
+
+        def capture():
+            box["doc"] = core.debug_profile(duration_ms=400)
+
+        thread = threading.Thread(target=capture)
+        thread.start()
+        deadline = time.monotonic() + 10.0
+        while not core.devstats.profiler.armed \
+                and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert core.devstats.profiler.armed
+        # Serve WHILE the window is armed — these are the requests the
+        # span tap must capture even with the flight recorder off.
+        seed = 0
+        while core.devstats.profiler.armed and seed < 10_000:
+            seed += 1
+            core.infer(_simple_request("simple", seed=seed))
+        thread.join(timeout=30)
+        doc = box["doc"]
+        assert doc["requests_captured"] >= 1
+        with open(doc["chrome_trace"]) as f:
+            events = json.load(f)
+        assert any(e.get("name") == "device_execute" for e in events)
+    finally:
+        core.flight.enabled = True
+        core.shutdown()
+
+
+def test_profile_concurrent_captures_coalesce_single_flight():
+    core = build_core(["simple"])
+    try:
+        captures_before = core.devstats.profiler.capture_count
+        results = []
+        lock = threading.Lock()
+
+        def capture():
+            doc = core.debug_profile(duration_ms=300)
+            with lock:
+                results.append(doc)
+
+        threads = [threading.Thread(target=capture) for _ in range(3)]
+        for thread in threads:
+            thread.start()
+        for thread in threads:
+            thread.join(timeout=30)
+        assert len(results) == 3
+        leaders = [doc for doc in results if not doc["coalesced"]]
+        followers = [doc for doc in results if doc["coalesced"]]
+        assert len(leaders) >= 1
+        assert len(followers) >= 1
+        # The coalesced callers share the leader's artifact.
+        assert followers[0]["chrome_trace"] == \
+            leaders[0]["chrome_trace"]
+        assert core.devstats.profiler.capture_count \
+            == captures_before + len(leaders)
+    finally:
+        core.shutdown()
+
+
+def test_profile_fallback_arm_when_jax_profiler_unsupported(
+        monkeypatch):
+    core = build_core(["simple"])
+    try:
+        def boom(*_args, **_kwargs):
+            raise RuntimeError("no profiler on this platform")
+
+        monkeypatch.setattr(jax.profiler, "start_trace", boom)
+        doc = core.debug_profile(duration_ms=30)
+        assert doc["jax_supported"] is False
+        assert doc["mode"] == "spans"
+        assert "unsupported on this platform" in doc["jax_error"]
+        assert doc["chrome_trace"]  # the span arm still delivers
+    finally:
+        core.shutdown()
+
+
+# -- the three transports --------------------------------------------------
+
+
+def test_profile_endpoint_http_embed():
+    core = build_core(["simple"])
+    try:
+        status, _headers, body = http_call(
+            core, "GET", "/v2/debug/profile?duration_ms=20", {}, b"")
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["duration_ms"] == 20
+        assert "chrome_trace" in doc
+    finally:
+        core.shutdown()
+
+
+def test_profile_endpoint_aiohttp():
+    core = build_core(["simple"])
+    runner = start_http_server_thread(core, host="127.0.0.1", port=0)
+    try:
+        url = ("http://127.0.0.1:%d/v2/debug/profile?duration_ms=20"
+               % runner.port)
+        with urllib.request.urlopen(url, timeout=30) as response:
+            doc = json.loads(response.read())
+        assert doc["duration_ms"] == 20
+        assert "chrome_trace" in doc
+    finally:
+        runner.stop()
+        core.shutdown()
+
+
+def test_profile_endpoint_grpc():
+    import grpc
+
+    core = build_core(["simple"])
+    handle = start_grpc_server(core=core, address="127.0.0.1:0")
+    try:
+        channel = grpc.insecure_channel(handle.address)
+        profile = channel.unary_unary(
+            "/inference.Debug/Profile",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b)
+        doc = json.loads(profile(b'{"duration_ms": 20}', timeout=30))
+        assert doc["duration_ms"] == 20
+        assert "chrome_trace" in doc
+        channel.close()
+    finally:
+        handle.stop()
+
+
+# -- /v2/debug devices section ---------------------------------------------
+
+
+def test_debug_devices_section_present_and_lint_clean():
+    core = build_core(["simple"])
+    try:
+        core.infer(_simple_request("simple"))
+        doc = core.debug_snapshot()
+        devices = doc["devices"]
+        for key in ("ledger", "busy_us", "duty_cycle", "compiles",
+                    "profiler", "scrape_errors"):
+            assert key in devices
+        assert lint_debug_snapshot(devices) == []
+        assert lint_debug_snapshot(doc) == []
+    finally:
+        core.shutdown()
+
+
+def test_devstats_errors_counter_renders_and_counts():
+    stats = DeviceStats(enabled=True)
+    stats._note_scrape_error()
+    stats._note_scrape_error()
+    lines = stats.render_metrics()
+    assert "tpu_device_stats_errors_total 2" in lines

@@ -332,6 +332,77 @@ def test_resource_pairing_clean(tmp_path):
     assert check_resource_pairing(src) == []
 
 
+def test_resource_pairing_ledger_register_leak_flagged(tmp_path):
+    # PR-15: ledger.register/release is the same guarantee class as
+    # tenant admission — an unreleased register leaks an HBM
+    # attribution row for the process lifetime.
+    src = _source(tmp_path, """
+        def leaky(ledger, build):
+            row = ledger.register("m", "weights", 128)
+            build()          # raises -> the row leaks
+            ledger.release(row)
+    """)
+    findings = check_resource_pairing(src)
+    assert _ids(findings) == ["resource-pairing"]
+    assert "finally" in findings[0].message
+
+
+def test_resource_pairing_ledger_no_release_at_all_flagged(tmp_path):
+    src = _source(tmp_path, """
+        def leaky(ledger):
+            row = ledger.register("m", "weights", 128)
+            return row.nbytes
+    """)
+    assert _ids(check_resource_pairing(src)) == ["resource-pairing"]
+
+
+def test_resource_pairing_ledger_clean_forms(tmp_path):
+    src = _source(tmp_path, """
+        def finally_paired(ledger, build):
+            row = ledger.register("m", "weights", 128)
+            try:
+                build()
+            finally:
+                ledger.release(row)
+
+        def attribute_handoff(ledger, region):
+            # Ownership parked on the owning object, whose teardown
+            # releases it (the arena/replica pattern).
+            region.ledger_row = ledger.register("arena", "regions", 64)
+
+        def model_sweep_paired(ledger, teardown):
+            row = ledger.register("m", "kv", 32)
+            try:
+                teardown()
+            finally:
+                ledger.release_model("m")
+    """)
+    assert check_resource_pairing(src) == []
+
+
+def test_resource_pairing_ledger_replace_pattern_clean(tmp_path):
+    # Dropping the PREVIOUS holder's row before registering the fresh
+    # one is the replace pattern, not a pairing — the release above
+    # the register must not be mistaken for its finally-less pairing
+    # when the fresh handle is parked on an owner.
+    src = _source(tmp_path, """
+        def reload(ledger, measure):
+            ledger.release_component("m", "weights")
+            measure.row = ledger.register("m", "weights", 64)
+    """)
+    assert check_resource_pairing(src) == []
+
+
+def test_resource_pairing_non_ledger_register_not_flagged(tmp_path):
+    # `register` is a common verb (shm regions, prefix pages) — only
+    # ledger-named receivers engage the pairing rule.
+    src = _source(tmp_path, """
+        def fine(memory):
+            memory.register("region", "key", 0, 64)
+    """)
+    assert check_resource_pairing(src) == []
+
+
 def test_resource_pairing_suppressed(tmp_path):
     src = _source(tmp_path, """
         def adjacent(repo):

@@ -277,4 +277,24 @@ fi
 grep -E "llm smoke passed" "$LLM_LOG"
 grep -E "dense c4|paged c16" "$LLM_LOG"
 echo "OK: llm smoke passed"
+
+# Device-stats smoke: mixed dense + llm + arena load, then the
+# device-axis gates — ledger rows sum to tpu_hbm_used_bytes within
+# 10% (CPU dryrun: attributed rows present + internally consistent),
+# busy-time counter monotonic across two scrapes, >=1 XLA compile
+# recorded per fresh model, the /v2/debug/profile endpoint returns a
+# loadable chrome trace of a live window, and always-on recording
+# costs <2% throughput (paired A/B). Gates live in
+# tools/devstats_smoke.py.
+echo "devstats smoke: HBM ledger + busy/duty + compiles + profiler"
+DEVSTATS_LOG=/tmp/_devstats_smoke.log
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/devstats_smoke.py \
+    > "$DEVSTATS_LOG" 2>&1; then
+    echo "FAIL: devstats smoke did not pass" >&2
+    tail -30 "$DEVSTATS_LOG" >&2
+    exit 1
+fi
+grep -E "devstats smoke passed" "$DEVSTATS_LOG"
+grep -E "ledger|busy|compile recorded|overhead" "$DEVSTATS_LOG" | head -10
+echo "OK: devstats smoke passed"
 exit 0

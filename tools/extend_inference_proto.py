@@ -153,6 +153,19 @@ CACHE_DURATION_FIELDS = [
     ("cache_miss", 8),
 ]
 
+# Device-axis rows (PR 15): per-model HBM attribution from the device
+# ledger (client_tpu/server/devstats.py) plus compile telemetry.
+# ModelStatistics.device_stats is field 22.
+DEVICE_HBM_COMPONENT_FIELDS = [
+    ("component", 1, STRING),
+    ("hbm_bytes", 2, U64),
+]
+DEVICE_STATS_FIELDS = [
+    ("hbm_bytes", 1, U64),
+    ("compile_count", 3, U64),
+    ("compile_ns", 4, U64),
+]
+
 # SLO engine rows (PR 14): declared targets + multi-window burn rates
 # computed by client_tpu/server/slo.py. ModelStatistics.slo_stats is
 # field 21.
@@ -355,6 +368,34 @@ def patch_inference(file_proto: descriptor_pb2.FileDescriptorProto) -> bool:
             name="slo_stats", number=21, type=MESSAGE, label=OPTIONAL,
             type_name=".inference.SloStatistics",
             json_name="sloStats")
+        changed = True
+    names = [m.name for m in file_proto.message_type]
+    if "DeviceHbmComponent" not in names:
+        anchor = names.index("SloStatistics") + 1
+        message = descriptor_pb2.DescriptorProto(name="DeviceHbmComponent")
+        for name, number, ftype in DEVICE_HBM_COMPONENT_FIELDS:
+            message.field.add(name=name, number=number, type=ftype,
+                              label=OPTIONAL, json_name=_json_name(name))
+        file_proto.message_type.insert(anchor, message)
+        names.insert(anchor, "DeviceHbmComponent")
+        changed = True
+    if "DeviceStatistics" not in names:
+        anchor = names.index("DeviceHbmComponent") + 1
+        message = descriptor_pb2.DescriptorProto(name="DeviceStatistics")
+        for name, number, ftype in DEVICE_STATS_FIELDS:
+            message.field.add(name=name, number=number, type=ftype,
+                              label=OPTIONAL, json_name=_json_name(name))
+        message.field.add(
+            name="components", number=2, type=MESSAGE, label=REPEATED,
+            type_name=".inference.DeviceHbmComponent",
+            json_name="components")
+        file_proto.message_type.insert(anchor, message)
+        changed = True
+    if not any(f.name == "device_stats" for f in model_stats.field):
+        model_stats.field.add(
+            name="device_stats", number=22, type=MESSAGE, label=OPTIONAL,
+            type_name=".inference.DeviceStatistics",
+            json_name="deviceStats")
         changed = True
     infer_stats = next(
         m for m in file_proto.message_type if m.name == "InferStatistics")

@@ -502,6 +502,15 @@ def main() -> int:
         if types2.get("tpu_server_info") != "gauge":
             errors.append("tpu_server_info gauge missing from the "
                           "exposition")
+        # Device-axis families (server/devstats.py): busy time must
+        # accumulate from the load driven above, and the scrape-error
+        # counter renders unconditionally.
+        if types2.get("tpu_device_busy_us_total") != "counter":
+            errors.append("tpu_device_busy_us_total counter missing "
+                          "from the exposition under load")
+        if types2.get("tpu_device_stats_errors_total") != "counter":
+            errors.append("tpu_device_stats_errors_total counter "
+                          "missing from the exposition")
         # The /v2/debug snapshot (and the flight dump) must stay
         # cardinality-bounded: no dict keyed by request/trace ids, no
         # unbounded fan-out.

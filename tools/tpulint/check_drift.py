@@ -45,7 +45,7 @@ def _expected_schema():
             + [("pipeline_stats", 8), ("sequence_stats", 11),
                ("priority_stats", 15), ("tenant_stats", 16),
                ("replica_stats", 17), ("stream_stats", 20),
-               ("slo_stats", 21)]),
+               ("slo_stats", 21), ("device_stats", 22)]),
         "SequenceBatchingStatistics":
             _normalize_rows(tool.SEQUENCE_STATS_FIELDS),
         "PriorityStatistics": _normalize_rows(tool.PRIORITY_STATS_FIELDS),
@@ -53,6 +53,11 @@ def _expected_schema():
         "ReplicaStatistics": _normalize_rows(tool.REPLICA_STATS_FIELDS),
         "StreamStatistics": _normalize_rows(tool.STREAM_STATS_FIELDS),
         "SloStatistics": _normalize_rows(tool.SLO_STATS_FIELDS),
+        "DeviceHbmComponent":
+            _normalize_rows(tool.DEVICE_HBM_COMPONENT_FIELDS),
+        "DeviceStatistics": (
+            _normalize_rows(tool.DEVICE_STATS_FIELDS)
+            + [("components", 2)]),
         "InferStatistics": _normalize_rows(tool.CACHE_DURATION_FIELDS),
     }
     model_config = {

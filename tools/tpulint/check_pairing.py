@@ -40,6 +40,13 @@ from tools.tpulint.framework import (
 _RELEASE_OF = {
     "acquire": ("release",),
     "begin_unload": ("finish_unload", "unload"),
+    # Device-ledger rows (client_tpu/server/devstats.py): a
+    # ledger.register() whose row is never released leaks a
+    # tpu_hbm_model_bytes row for the process lifetime — the same
+    # guarantee class as the PR-7 tenant-admission slot. Scoped to
+    # ledger-named receivers (see _acquire_attr) so unrelated
+    # register() verbs (shm regions, prefix-cache pages) stay out.
+    "register": ("release", "release_component", "release_model"),
 }
 
 
@@ -55,6 +62,9 @@ def _acquire_attr(call: ast.Call) -> Optional[str]:
     func = call.func
     if not isinstance(func, ast.Attribute):
         return None
+    if func.attr == "register":
+        receiver = expr_text(func.value).split(".")[-1]
+        return func.attr if "ledger" in receiver.lower() else None
     if func.attr == "acquire" or func.attr.startswith("begin_"):
         if is_lockish(func.value):
             return None  # mutexes are lock-discipline's domain
@@ -76,6 +86,18 @@ def _assigned_to_self(stmt: Optional[ast.stmt]) -> bool:
                 target.value.id == "self":
             return True
     return False
+
+
+def _assigned_to_attribute(stmt: Optional[ast.stmt]) -> bool:
+    """Ownership hand-off for ledger rows: ``region.ledger_row =
+    ledger.register(...)`` parks the handle on the owning object,
+    whose teardown path releases it — broader than the self-only rule
+    because rows commonly ride resource objects (regions, replicas),
+    not the registering class itself."""
+    if not isinstance(stmt, ast.Assign):
+        return False
+    return any(isinstance(target, ast.Attribute)
+               for target in stmt.targets)
 
 
 def check_resource_pairing(src: SourceFile) -> List[Finding]:
@@ -128,10 +150,15 @@ def check_resource_pairing(src: SourceFile) -> List[Finding]:
 
         for call, attr, receiver, stmt in acquires:
             wanted = _release_names(attr)
+            # A release lexically BEFORE the acquire cannot be its
+            # pairing — that is the replace pattern (drop the previous
+            # holder's row, then register the fresh one), and treating
+            # it as a pairing would demand a nonsensical finally.
             matching = [(r_receiver, r_attr, r_stmt)
                         for r_receiver, r_attr, r_stmt in releases
                         if r_attr in wanted and _receivers_match(
-                            receiver, r_receiver)]
+                            receiver, r_receiver)
+                        and r_stmt.lineno >= call.lineno]
             if matching:
                 if any(_stmt_in_finally_chain(func, r_stmt)
                        for _r, _a, r_stmt in matching):
@@ -144,6 +171,8 @@ def check_resource_pairing(src: SourceFile) -> List[Finding]:
                                       _resource_noun(attr))))
                 continue
             # No release here: excused hand-off patterns.
+            if attr == "register" and _assigned_to_attribute(stmt):
+                continue
             if _assigned_to_self(stmt):
                 continue
             if _is_generator(func):
