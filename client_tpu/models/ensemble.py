@@ -6,11 +6,13 @@ ensembles."""
 
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from client_tpu.protocol import model_config_pb2 as mc
+from client_tpu.server import tracing as spantrace
 from client_tpu.server.model import ServedModel, TensorSpec
 from client_tpu.utils import InferenceServerException
 
@@ -73,12 +75,42 @@ class PostprocessModel(ServedModel):
         idx = logits.argmax(axis=-1)
         exp = np.exp(logits - logits.max(axis=-1, keepdims=True))
         probs = exp / exp.sum(axis=-1, keepdims=True)
-        labels = np.array(
-            [("%f:%d" % (probs[i, idx[i]], idx[i])).encode()
-             for i in range(len(idx))],
-            dtype=np.object_,
-        )[:, None]
+        # Vectorized "%f:%d" formatting (np.char runs the same %
+        # operator element-wise, so bytes stay identical to the old
+        # per-row Python loop).
+        top = probs[np.arange(len(idx)), idx]
+        text = np.char.add(
+            np.char.add(np.char.mod("%f", top), ":"),
+            np.char.mod("%d", idx))
+        labels = np.char.encode(text).astype(np.object_)[:, None]
         return {"LABEL": labels if batched else labels[0]}
+
+
+class DataflowContext:
+    """Everything the core lends :meth:`EnsembleModel.infer_dataflow`
+    for one request: span trace, telemetry, per-composing-model stats
+    recording, batcher/replica resolution, and the stage-output cache
+    closures (already keyed to this request's edge digest). All
+    optional — a ``None`` field skips that integration."""
+
+    __slots__ = ("trace", "telemetry", "stats_recorder", "batcher_for",
+                 "target_for", "cache_lookup", "cache_insert",
+                 "queue_from_ns")
+
+    def __init__(self, trace=None, telemetry=None, stats_recorder=None,
+                 batcher_for=None, target_for=None, cache_lookup=None,
+                 cache_insert=None, queue_from_ns: int = 0):
+        self.trace = trace
+        self.telemetry = telemetry
+        self.stats_recorder = stats_recorder
+        self.batcher_for = batcher_for
+        self.target_for = target_for
+        # cache_lookup(step_index, model) -> step outputs dict or None;
+        # cache_insert(step_index, model, outputs). The core binds the
+        # request's content digest so the executor never hashes.
+        self.cache_lookup = cache_lookup
+        self.cache_insert = cache_insert
+        self.queue_from_ns = queue_from_ns
 
 
 class EnsembleModel(ServedModel):
@@ -86,6 +118,12 @@ class EnsembleModel(ServedModel):
     input/output maps (ensemble tensor name -> step tensor name)."""
 
     platform = "ensemble"
+    # Device-resident dataflow (the default serving path): the core
+    # executes the step graph itself, handing each stage's output —
+    # still a device array — straight to the next stage's batcher.
+    # False = the legacy host-mediated loop (the A/B opt-out arm,
+    # PR-12 pattern), byte-identical outputs.
+    device_dataflow = True
 
     def __init__(
         self,
@@ -106,7 +144,7 @@ class EnsembleModel(ServedModel):
         # Set by the server core so composing-step executions show up
         # in per-model statistics (Triton records composing models'
         # queue/compute like top-level requests): callable
-        # (model_name, count, compute_ns).
+        # (model_name, count, compute_ns, executions, queue_ns).
         self.stats_recorder = None
         # Set by the server core: resolves a composing model to its
         # dynamic batcher (or None). Steps entering a batching model's
@@ -124,32 +162,43 @@ class EnsembleModel(ServedModel):
             for ens_name, step_name in output_map.items():
                 step.output_map[ens_name] = step_name
 
+    def _wire_step(self, tensors: Dict[str, np.ndarray],
+                   model_name: str, input_map: Dict[str, str],
+                   max_batch_size: int):
+        """(step_inputs, count) for one step; raises when the graph
+        references a tensor no earlier step produced."""
+        step_inputs = {}
+        for ens_name, step_name in input_map.items():
+            if ens_name not in tensors:
+                raise InferenceServerException(
+                    "ensemble '%s': tensor '%s' unavailable for step "
+                    "'%s'" % (self.name, ens_name, model_name),
+                    status="INVALID_ARGUMENT",
+                )
+            step_inputs[step_name] = tensors[ens_name]
+        first = next(iter(step_inputs.values()), None)
+        count = (
+            int(first.shape[0])
+            if getattr(first, "ndim", 0) and max_batch_size > 0
+            else 1
+        )
+        return step_inputs, count
+
     def infer(self, inputs, parameters=None):
+        """Legacy host-mediated step loop (the ``device_dataflow=
+        False`` A/B arm, and the path for an ensemble invoked outside
+        a core): each stage's outputs round-trip through this caller
+        before the next stage sees them."""
         tensors: Dict[str, np.ndarray] = dict(inputs)
         for model_name, input_map, output_map in self._steps:
             # load (not get): resolve composing models on demand even
             # if they were never explicitly loaded or got unloaded
             model = self._repository.load(model_name)
-            step_inputs = {}
-            for ens_name, step_name in input_map.items():
-                if ens_name not in tensors:
-                    raise InferenceServerException(
-                        "ensemble '%s': tensor '%s' unavailable for step "
-                        "'%s'" % (self.name, ens_name, model_name),
-                        status="INVALID_ARGUMENT",
-                    )
-                step_inputs[step_name] = tensors[ens_name]
-            first = next(iter(step_inputs.values()), None)
-            count = (
-                int(first.shape[0])
-                if getattr(first, "ndim", 0) and model.max_batch_size > 0
-                else 1
-            )
+            step_inputs, count = self._wire_step(
+                tensors, model_name, input_map, model.max_batch_size)
             batcher = self.batcher_resolver(model) \
                 if self.batcher_resolver is not None else None
             if self.stats_recorder is not None:
-                import time
-
                 start_ns = time.monotonic_ns()
                 if batcher is not None:
                     step_outputs, queue_ns, leader = batcher.infer(
@@ -162,11 +211,13 @@ class EnsembleModel(ServedModel):
                         time.monotonic_ns() - start_ns - queue_ns, 0
                     ) if leader else 0
                 else:
+                    queue_ns = 0
                     step_outputs = model.infer(step_inputs, parameters)
                     executions = 1
                     compute_ns = time.monotonic_ns() - start_ns
                 self.stats_recorder(
-                    model_name, count, compute_ns, executions)
+                    model_name, count, compute_ns, executions,
+                    queue_ns=queue_ns)
             elif batcher is not None:
                 step_outputs, _, _ = batcher.infer(
                     step_inputs, parameters or {}, count)
@@ -175,6 +226,114 @@ class EnsembleModel(ServedModel):
             for ens_name, step_name in output_map.items():
                 tensors[ens_name] = step_outputs[step_name]
         return {spec.name: tensors[spec.name] for spec in self.outputs}
+
+    def infer_dataflow(self, inputs, parameters, ctx: DataflowContext):
+        """Device-resident dataflow execution (the core's serving
+        path): stage outputs are handed to the next stage's batcher
+        as-is — device arrays stay device arrays, host encode happens
+        only at the graph edge (the core's output fetch). Returns
+        ``(outputs, queue_ns_total)`` where ``queue_ns_total`` is the
+        summed interior batcher queue time (the ensemble's own stats
+        book it as queue, mirroring the batcher path).
+
+        Per stage: fuse through the composing model's dynamic batcher
+        when it has one (``device_outputs=True`` — the member wakes
+        with device slices at compute end, and fuses with concurrent
+        ensembles AND standalone wire traffic for the same model);
+        otherwise execute directly on the core's execution target
+        (the PR-8 ReplicaSet proxy when replicated, so replica fault
+        masking covers ensemble steps). A composing-model response-
+        cache hit short-circuits the whole prefix subgraph: the lookup
+        scans deepest-first and resumes execution past the hit."""
+        tensors: Dict[str, np.ndarray] = dict(inputs)
+        params = parameters or {}
+        steps = self._steps
+        start_index = 0
+        mark = ctx.queue_from_ns or time.monotonic_ns()
+        if ctx.cache_lookup is not None:
+            for k in range(len(steps) - 1, -1, -1):
+                model_name, _, output_map = steps[k]
+                model = self._repository.load(model_name)
+                cached = ctx.cache_lookup(k, model)
+                if cached is None:
+                    continue
+                mapped = {ens_name: step_name
+                          for ens_name, step_name in output_map.items()
+                          if step_name in cached}
+                if not self._resumable_after(k, set(tensors)
+                                             | set(mapped)):
+                    # A later stage (or the ensemble's own outputs)
+                    # needs a tensor this hit would strand — keep
+                    # scanning for a shallower one.
+                    continue
+                for ens_name, step_name in mapped.items():
+                    tensors[ens_name] = cached[step_name]
+                start_index = k + 1
+                now = time.monotonic_ns()
+                if ctx.trace is not None:
+                    ctx.trace.add_timed(
+                        spantrace.SPAN_ENSEMBLE_STEP, mark, now,
+                        {"step": "%d:%s" % (k, model_name),
+                         "cache_hit": True})
+                mark = now
+                break
+        queue_ns_total = 0
+        for k in range(start_index, len(steps)):
+            model_name, input_map, output_map = steps[k]
+            model = self._repository.load(model_name)
+            step_inputs, count = self._wire_step(
+                tensors, model_name, input_map, model.max_batch_size)
+            batcher = ctx.batcher_for(model) \
+                if ctx.batcher_for is not None else None
+            queue_ns = 0
+            executions = 1
+            if batcher is not None and "sequence_id" not in params:
+                step_outputs, queue_ns, leader = batcher.infer(
+                    step_inputs, params, count, trace=ctx.trace,
+                    queue_from_ns=mark, device_outputs=True)
+                executions = 1 if leader else 0
+                if not leader and ctx.telemetry is not None:
+                    ctx.telemetry.record_ensemble_fused(self.name)
+            else:
+                target = (ctx.target_for(model)
+                          if ctx.target_for is not None else model)
+                step_outputs = target.infer(step_inputs, params)
+            end = time.monotonic_ns()
+            queue_ns_total += queue_ns
+            if ctx.stats_recorder is not None:
+                compute_ns = (max(end - mark - queue_ns, 0)
+                              if executions else 0)
+                ctx.stats_recorder(model_name, count, compute_ns,
+                                   executions, queue_ns=queue_ns)
+            step_label = "%d:%s" % (k, model_name)
+            if ctx.trace is not None:
+                ctx.trace.add_timed(
+                    spantrace.SPAN_ENSEMBLE_STEP, mark, end,
+                    {"step": step_label, "batch": count,
+                     "fused": executions == 0})
+            if ctx.telemetry is not None:
+                ctx.telemetry.observe_ensemble_step(
+                    self.name, step_label, (end - mark) / 1000.0,
+                    spantrace.exemplar_id(ctx.trace))
+            if ctx.cache_insert is not None:
+                ctx.cache_insert(k, model, step_outputs)
+            for ens_name, step_name in output_map.items():
+                tensors[ens_name] = step_outputs[step_name]
+            mark = end
+        return ({spec.name: tensors[spec.name] for spec in self.outputs},
+                queue_ns_total)
+
+    def _resumable_after(self, k: int, available: set) -> bool:
+        """True when execution can resume at step ``k + 1`` with only
+        ``available`` ensemble tensors in hand: every later stage's
+        inputs and every ensemble output stays reachable."""
+        avail = set(available)
+        for j in range(k + 1, len(self._steps)):
+            _, input_map, output_map = self._steps[j]
+            if any(ens_name not in avail for ens_name in input_map):
+                return False
+            avail.update(output_map)
+        return all(spec.name in avail for spec in self.outputs)
 
     def warmup(self) -> None:
         for model_name, _, _ in self._steps:
@@ -208,4 +367,110 @@ def make_image_ensemble(repository, name: str = "ensemble_image",
     ensemble.dynamic_batching = True
     ensemble.preferred_batch_sizes = [8, 16, 32]
     ensemble.max_queue_delay_us = 20000
+    return ensemble
+
+
+# -- dataflow A/B bench pair --------------------------------------------
+#
+# A three-step ensemble whose middle stage has a cost PROPORTIONAL to
+# batch rows (a sleep per row plus a deterministic matmul): fusion
+# cannot amortize it, so the measured gap between the arms isolates
+# what the dataflow actually changes — per-stage batching and the
+# composing-cache short-circuit (the legacy loop pays backbone compute
+# on every request; the PR-5 caveat meant it could never legally use
+# the composing cache).
+
+AB_BACKBONE_ROW_COST_S = 0.0025
+
+
+class AbPreprocessModel(ServedModel):
+    """Host-side scale stage for the dataflow A/B pair (direct step,
+    no scheduler)."""
+
+    max_batch_size = 32
+
+    def __init__(self, name: str = "ab_pre"):
+        super().__init__()
+        self.name = name
+        self.inputs = [TensorSpec("RAW", "FP32", [8])]
+        self.outputs = [TensorSpec("SCALED", "FP32", [8])]
+
+    def infer(self, inputs, parameters=None):
+        raw = np.asarray(inputs["RAW"], dtype=np.float32)
+        return {"SCALED": raw * np.float32(1.0 / 255.0)}
+
+
+class AbBackboneModel(ServedModel):
+    """Batched backbone whose wall cost scales with batch rows, so the
+    A/B gap measures dataflow mechanics, not batching amortization.
+    ``response_cache=True`` makes it the cache-short-circuit stage."""
+
+    max_batch_size = 32
+    dynamic_batching = True
+    preferred_batch_sizes = [16, 32]
+    max_queue_delay_us = 3000
+    response_cache = True
+
+    def __init__(self, name: str = "ab_backbone",
+                 row_cost_s: float = AB_BACKBONE_ROW_COST_S):
+        super().__init__()
+        self.name = name
+        self._row_cost_s = row_cost_s
+        rng = np.random.default_rng(1234)
+        self._weights = rng.standard_normal((8, 8)).astype(np.float32)
+        self.inputs = [TensorSpec("SCALED", "FP32", [8])]
+        self.outputs = [TensorSpec("FEATS", "FP32", [8])]
+
+    def infer(self, inputs, parameters=None):
+        x = np.asarray(inputs["SCALED"], dtype=np.float32)
+        rows = int(x.shape[0]) if x.ndim == 2 else 1
+        time.sleep(self._row_cost_s * rows)
+        return {"FEATS": x @ self._weights}
+
+
+class AbPostprocessModel(ServedModel):
+    """Trivial host reduction at the graph edge."""
+
+    max_batch_size = 32
+
+    def __init__(self, name: str = "ab_post"):
+        super().__init__()
+        self.name = name
+        self.inputs = [TensorSpec("FEATS", "FP32", [8])]
+        self.outputs = [TensorSpec("SCORE", "FP32", [1])]
+
+    def infer(self, inputs, parameters=None):
+        feats = np.asarray(inputs["FEATS"], dtype=np.float32)
+        return {"SCORE": feats.sum(axis=-1, keepdims=True)}
+
+
+def make_ab_ensemble(repository, name: str = "ensemble_ab",
+                     legacy: bool = False) -> EnsembleModel:
+    """The ``ensemble_dataflow_ab`` bench pair: identical three-step
+    graphs over per-arm composing models (suffixed so each arm's
+    fusion/execution statistics stay separable), differing ONLY in
+    ``device_dataflow``. Outputs are byte-identical across arms —
+    the bench's golden-parity gate."""
+    suffix = "_legacy" if legacy else ""
+    ensemble = EnsembleModel(
+        name=name,
+        repository=repository,
+        steps=[
+            ("ab_pre" + suffix, {"RAW": "RAW"}, {"scaled": "SCALED"}),
+            ("ab_backbone" + suffix, {"scaled": "SCALED"},
+             {"feats": "FEATS"}),
+            ("ab_post" + suffix, {"feats": "FEATS"},
+             {"SCORE": "SCORE"}),
+        ],
+        inputs=[TensorSpec("RAW", "FP32", [8])],
+        outputs=[TensorSpec("SCORE", "FP32", [1])],
+        max_batch_size=32,
+    )
+    ensemble.device_dataflow = not legacy
+    if legacy:
+        # Prod-style ensemble-level gather (make_image_ensemble's
+        # shape): the strongest legacy arm, not a strawman.
+        ensemble.dynamic_batching = True
+        ensemble.preferred_batch_sizes = [8, 16, 32]
+        ensemble.max_queue_delay_us = 20000
     return ensemble

@@ -10,8 +10,12 @@ from client_tpu.server.model import ServedModel
 def extra_model_factories(repository=None) -> Dict[str, Callable[[], ServedModel]]:
     from client_tpu.models.bert import BertModel
     from client_tpu.models.ensemble import (
+        AbBackboneModel,
+        AbPostprocessModel,
+        AbPreprocessModel,
         PostprocessModel,
         PreprocessModel,
+        make_ab_ensemble,
         make_image_ensemble,
     )
     from client_tpu.models.llm import LlmConfig, LlmModel
@@ -39,4 +43,19 @@ def extra_model_factories(repository=None) -> Dict[str, Callable[[], ServedModel
         factories["ensemble_image"] = (
             lambda: make_image_ensemble(repository)
         )
+        # ensemble_dataflow_ab bench pair: identical step graphs over
+        # per-arm composing models, differing only in device_dataflow.
+        for suffix in ("", "_legacy"):
+            factories["ab_pre" + suffix] = (
+                lambda s=suffix: AbPreprocessModel("ab_pre" + s))
+            factories["ab_backbone" + suffix] = (
+                lambda s=suffix: AbBackboneModel("ab_backbone" + s))
+            factories["ab_post" + suffix] = (
+                lambda s=suffix: AbPostprocessModel("ab_post" + s))
+        factories["ensemble_ab"] = (
+            lambda: make_ab_ensemble(repository))
+        factories["ensemble_ab_legacy"] = (
+            lambda: make_ab_ensemble(repository,
+                                     name="ensemble_ab_legacy",
+                                     legacy=True))
     return factories

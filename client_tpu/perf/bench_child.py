@@ -1555,6 +1555,215 @@ def run_llm_continuous_measure(concurrencies=(4, 16),
     return out
 
 
+def run_ensemble_dataflow_measure(core=None, concurrency: int = 16,
+                                  rounds: int = 3, per_round: int = 4,
+                                  hot_set: int = 4) -> dict:
+    """Device-resident ensemble dataflow A/B (ROADMAP item 1's
+    ensemble form): interleaved closed loops on the ``ensemble_ab`` /
+    ``ensemble_ab_legacy`` pair — identical three-step graphs whose
+    backbone wall cost scales with batch ROWS (so ensemble-level
+    gather cannot amortize it away), one executed as a device-resident
+    dataflow graph (per-stage batching + composing-cache
+    short-circuit), one through the legacy host-mediated step loop
+    with prod-style ensemble-level dynamic batching. Two phases:
+    distinct inputs at ``concurrency`` measure the backbone fusion
+    ratio (execution_count / inference_count deltas — per-stage
+    batching across concurrent dataflow requests); a pinned hot set
+    measures steady-state throughput where the dataflow arm's stage
+    cache short-circuits the subgraph (the retired PR-5 caveat,
+    measured). Also asserts byte-level golden parity across arms and
+    sends one traced request through the dataflow arm for the span
+    gate: ensemble_step spans present, ZERO relay_fetch spans — the
+    no-host-round-trip evidence."""
+    import json as _json
+    import os as _os
+    import tempfile as _tempfile
+    import threading as _threading
+
+    import numpy as np
+
+    from client_tpu._infer_common import InferInput
+    from client_tpu.grpc._utils import get_inference_request
+    from client_tpu.perf.metrics_manager import parse_prometheus
+
+    own_core = core is None
+    if own_core:
+        from client_tpu.server.app import build_core
+
+        core = build_core(["ensemble_ab", "ensemble_ab_legacy"])
+
+    def request(model_name: str, seed: int):
+        tensor = InferInput("RAW", [1, 8], "FP32")
+        tensor.set_data_from_numpy(
+            ((np.arange(8, dtype=np.float32) + 1.0)
+             * np.float32(seed % 99991 + 1)).reshape(1, 8))
+        return get_inference_request(model_name=model_name,
+                                     inputs=[tensor], outputs=None)
+
+    seq = [0]
+    seq_lock = _threading.Lock()
+
+    def next_seed() -> int:
+        # Fresh seeds are cache misses by construction; the hot phase
+        # pins its working set instead.
+        with seq_lock:
+            seq[0] += 1
+            return seq[0]
+
+    def closed_loop(model_name: str, seeds=None) -> tuple:
+        latencies: list = []
+        merge = _threading.Lock()
+
+        def worker(offset: int):
+            local = []
+            for i in range(per_round):
+                if seeds is None:
+                    seed = next_seed()
+                else:
+                    seed = seeds[(offset * per_round + i) % len(seeds)]
+                req = request(model_name, seed)
+                t_start = time.monotonic_ns()
+                core.infer(req)
+                local.append(time.monotonic_ns() - t_start)
+            with merge:
+                latencies.extend(local)
+
+        t0 = time.monotonic()
+        pool = [_threading.Thread(target=worker, args=(i,))
+                for i in range(concurrency)]
+        for thread in pool:
+            thread.start()
+        for thread in pool:
+            thread.join()
+        elapsed = time.monotonic() - t0
+        latencies.sort()
+        return (len(latencies) / elapsed if elapsed > 0 else 0.0,
+                latencies[len(latencies) // 2] / 1000.0
+                if latencies else 0.0)
+
+    def counts(model_name: str) -> tuple:
+        stats = core.model_statistics(model_name)
+        s = stats.model_stats[0]
+        return int(s.inference_count), int(s.execution_count)
+
+    try:
+        # Warm both arms: batcher gather threads spin up, composing
+        # models load, every shape bucket the measurement touches runs
+        # once outside the window.
+        closed_loop("ensemble_ab")
+        closed_loop("ensemble_ab_legacy")
+
+        # Golden parity, cold inputs: the same RAW tensor through both
+        # arms must produce byte-identical SCORE bytes.
+        parity = True
+        for _ in range(3):
+            seed = next_seed()
+            blobs = [
+                bytes(core.infer(request(name, seed))
+                      .raw_output_contents[0])
+                for name in ("ensemble_ab", "ensemble_ab_legacy")]
+            parity = parity and blobs[0] == blobs[1]
+
+        # Phase 1 — distinct inputs at full concurrency: the backbone
+        # fusion ratio is the per-stage batching evidence (1.0 would
+        # mean every dataflow request executed its backbone alone).
+        inf0, exec0 = counts("ab_backbone")
+        distinct_before = core.metrics_text()
+        fusion_rounds = [closed_loop("ensemble_ab")
+                         for _ in range(rounds)]
+        distinct_after = core.metrics_text()
+        inf1, exec1 = counts("ab_backbone")
+        d_inf, d_exec = inf1 - inf0, exec1 - exec0
+        fusion_ratio = round(d_exec / d_inf, 4) if d_inf else 1.0
+        fusion_rounds.sort()
+        distinct_tput, distinct_p50 = \
+            fusion_rounds[len(fusion_rounds) // 2]
+
+        # Phase 2 — pinned hot set, interleaved A/B windows: the
+        # dataflow arm's stage cache short-circuits the subgraph; the
+        # legacy arm re-pays the row-proportional backbone each cycle.
+        hot = [next_seed() for _ in range(hot_set)]
+        for seed in hot:  # populate the stage cache (async inserts)
+            core.infer(request("ensemble_ab", seed))
+        time.sleep(0.3)
+        before = core.metrics_text()
+        dataflow_rounds, legacy_rounds = [], []
+        for _ in range(rounds):
+            dataflow_rounds.append(closed_loop("ensemble_ab", seeds=hot))
+            legacy_rounds.append(
+                closed_loop("ensemble_ab_legacy", seeds=hot))
+        after = core.metrics_text()
+        dataflow_rounds.sort()
+        legacy_rounds.sort()
+        dataflow_tput, dataflow_p50 = \
+            dataflow_rounds[len(dataflow_rounds) // 2]
+        legacy_tput, legacy_p50 = legacy_rounds[len(legacy_rounds) // 2]
+        def delta(before_text: str, after_text: str, attr: str) -> int:
+            m0 = parse_prometheus(before_text)
+            m1 = parse_prometheus(after_text)
+            return int(getattr(m1, attr).get("ensemble_ab", 0.0)
+                       - getattr(m0, attr).get("ensemble_ab", 0.0))
+
+        # Span gate: one traced request through the dataflow arm. The
+        # record must hold the per-stage ensemble_step chain and ZERO
+        # relay_fetch spans — interior tensors never detoured through
+        # a host fetch.
+        fd, trace_file = _tempfile.mkstemp(prefix="bench_ens_trace_",
+                                           suffix=".jsonl")
+        _os.close(fd)
+        step_spans = relay_spans = 0
+        try:
+            core.trace_setting("ensemble_ab", {
+                "trace_level": ["TIMESTAMPS"], "trace_rate": ["1"],
+                "trace_count": ["-1"], "log_frequency": ["1"],
+                "trace_file": [trace_file], "trace_mode": ["compact"]})
+            core.infer(request("ensemble_ab", next_seed()))
+            core.trace_setting("ensemble_ab", {
+                key: [] for key in ("trace_level", "trace_rate",
+                                    "trace_count", "log_frequency",
+                                    "trace_file", "trace_mode")})
+            with open(trace_file) as f:
+                for line in f:
+                    if not line.strip():
+                        continue
+                    names = [s["name"]
+                             for s in _json.loads(line)["spans"]]
+                    step_spans += names.count("ensemble_step")
+                    relay_spans += names.count("relay_fetch")
+        finally:
+            try:
+                _os.unlink(trace_file)
+            except OSError:
+                pass
+    finally:
+        if own_core:
+            core.shutdown()
+
+    return {
+        "concurrency": concurrency,
+        "golden_parity": parity,
+        "backbone_inferences": d_inf,
+        "backbone_executions": d_exec,
+        "fusion_ratio": fusion_ratio,
+        "distinct_tput": round(distinct_tput, 2),
+        "distinct_p50_us": round(distinct_p50, 1),
+        "dataflow_tput": round(dataflow_tput, 2),
+        "dataflow_p50_us": round(dataflow_p50, 1),
+        "legacy_tput": round(legacy_tput, 2),
+        "legacy_p50_us": round(legacy_p50, 1),
+        "speedup": round(dataflow_tput / legacy_tput, 2)
+        if legacy_tput else 0.0,
+        # Fusion counts accrue where batcher dispatches happen (the
+        # distinct phase); cache hits where the hot set repeats.
+        "ensemble_fused": delta(distinct_before, distinct_after,
+                                "ensemble_fused_total"),
+        "ensemble_cache_hits": delta(before, after,
+                                     "ensemble_cache_hits_total"),
+        "ensemble_step_spans": step_spans,
+        "interior_relay_fetch_spans": relay_spans,
+    }
+
+
 def run_python_harness(model: str, batch: int, concurrency: int,
                        shared_memory: str, output_shm: int,
                        core=None, address: str = "",
@@ -2610,6 +2819,34 @@ def main() -> None:
                    extra.get("pages_used_final", -1)))
         except Exception as exc:  # noqa: BLE001
             log("llm_continuous failed: %s" % exc)
+
+    # Config 4b: device-resident ensemble dataflow A/B (ROADMAP
+    # item 1's ensemble form). Distinct-input phase at c16 for the
+    # backbone fusion ratio, pinned hot set for the stage-cache
+    # short-circuit throughput gap, golden parity, and the span gate
+    # (ensemble_step present, zero relay_fetch).
+    if remaining() > 45 and stage_wanted("ensemble_dataflow_ab"):
+        try:
+            extra = run_with_watchdog(
+                "ensemble_dataflow measure",
+                run_ensemble_dataflow_measure,
+                min(180.0, max(60.0, remaining() - 30)))
+            record_stage("ensemble_dataflow_ab",
+                         extra.get("dataflow_tput", 0.0),
+                         extra.get("dataflow_p50_us", 0.0), extra)
+            log("ensemble_dataflow: hot %.0f/s vs legacy %.0f/s "
+                "(%.2fx); fusion %.3f over %d backbone rows; "
+                "parity=%s; spans step=%d relay_fetch=%d"
+                % (extra.get("dataflow_tput", 0.0),
+                   extra.get("legacy_tput", 0.0),
+                   extra.get("speedup", 0.0),
+                   extra.get("fusion_ratio", 1.0),
+                   extra.get("backbone_inferences", 0),
+                   extra.get("golden_parity"),
+                   extra.get("ensemble_step_spans", 0),
+                   extra.get("interior_relay_fetch_spans", -1)))
+        except Exception as exc:  # noqa: BLE001
+            log("ensemble_dataflow_ab failed: %s" % exc)
 
     # Reconcile the probe label with the final relay state: a stall
     # that later recovered (stages ran) must not read as "model stages

@@ -407,17 +407,20 @@ def run(argv: Optional[List[str]] = None, core=None) -> int:
         tpu_arena_url=tpu_arena_url, batch_size=args.batch_size,
     )
 
-    if model.response_cache_enabled or model.composing_cache_enabled:
+    if model.response_cache_enabled:
         # Cache hits bypass queue/compute, so per-window server-stat
         # breakdowns under-report work (reference perf_analyzer prints
-        # the same caveat when response_cache.enable is set). An
-        # ensemble whose COMPOSING model caches is just as affected:
-        # the composing step's paired stats exclude its hits.
-        scope = ("model" if model.response_cache_enabled
-                 else "a composing model")
-        print("note: %s has response caching enabled; server-side "
-              "queue/compute breakdowns exclude cache hits" % scope,
+        # the same caveat when response_cache.enable is set).
+        print("note: model has response caching enabled; server-side "
+              "queue/compute breakdowns exclude cache hits",
               file=sys.stderr)
+    elif model.composing_cache_enabled:
+        # Composing-model cache hits short-circuit the ensemble
+        # subgraph device-side (the dataflow path) and ARE visible in
+        # tpu_ensemble_cache_hits_total — no breakdown caveat needed.
+        print("note: a composing model has response caching enabled; "
+              "cache hits short-circuit the ensemble subgraph (see "
+              "tpu_ensemble_cache_hits_total)", file=sys.stderr)
 
     # -- server-side span tracing (--trace RATE) ----------------------
     trace_path = None

@@ -94,6 +94,13 @@ class TpuMetrics:
     slo_burn_rate: Dict[str, float] = field(default_factory=dict)
     slo_budget_remaining: Dict[str, float] = field(default_factory=dict)
     slo_healthy: Dict[str, float] = field(default_factory=dict)
+    # Ensemble-dataflow families (docs/ensembles.md): fused-dispatch
+    # and subgraph cache-hit counters per ensemble; the per-stage
+    # duration histogram lands in ``histograms`` keyed
+    # "model|s<step>".
+    ensemble_fused_total: Dict[str, float] = field(default_factory=dict)
+    ensemble_cache_hits_total: Dict[str, float] = field(
+        default_factory=dict)
 
 
 _FAMILIES = {
@@ -135,6 +142,8 @@ _FAMILIES = {
     "tpu_slo_burn_rate": "slo_burn_rate",
     "tpu_slo_budget_remaining": "slo_budget_remaining",
     "tpu_slo_healthy": "slo_healthy",
+    "tpu_ensemble_fused_total": "ensemble_fused_total",
+    "tpu_ensemble_cache_hits_total": "ensemble_cache_hits_total",
 }
 
 # Histogram families (telemetry layer): the scraper folds their
@@ -149,6 +158,7 @@ _HIST_FAMILIES = {
     "tpu_stream_inter_response_us": "stream_inter_response_us",
     "tpu_tenant_request_duration_us": "tenant_request_duration_us",
     "tpu_compile_duration_us": "compile_duration_us",
+    "tpu_ensemble_step_duration_us": "ensemble_step_duration_us",
 }
 
 # Monotonic counters among the scraped families: summarize_metrics
@@ -164,6 +174,7 @@ _COUNTER_FAMILIES = frozenset((
     "kv_prefix_hits_total", "prefill_chunks_total",
     "device_busy_us_total", "compile_total",
     "device_stats_errors_total",
+    "ensemble_fused_total", "ensemble_cache_hits_total",
 ))
 
 
@@ -186,6 +197,10 @@ def _hist_key(attr: str, labels: Dict[str, str]) -> str:
     key = (labels.get("model") or labels.get("tenant") or "0")
     if "stage" in labels:
         key = "%s|s%s" % (key, labels["stage"])
+    # Ensemble-step histograms carry a step label instead of a stage;
+    # fold it the same way so quantiles stay per composing step.
+    if "step" in labels:
+        key = "%s|s%s" % (key, labels["step"])
     return key
 
 

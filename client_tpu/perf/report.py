@@ -210,6 +210,10 @@ STAGE_SPANS = {
     "batch_execute": "execute",
     "device_execute": "execute",
     "stream_response": "execute",
+    # Per-stage ensemble spans overlap the member queue/batch_execute
+    # spans they parent — attribution view, not a work count (same
+    # rule as shared batch spans).
+    "ensemble_step": "execute",
     "relay_fetch": "fetch",
     "encode": "encode",
 }

@@ -81,11 +81,11 @@ class _Pending:
     __slots__ = ("inputs", "params", "batch", "shape_key", "event",
                  "outputs", "error", "enqueue_ns", "queue_ns", "leader",
                  "deadline_ns", "trace", "done_ns", "queue_from_ns",
-                 "priority", "wanted")
+                 "priority", "wanted", "device_outputs")
 
     def __init__(self, inputs, params, batch, shape_key,
                  timeout_ns: int = 0, trace=None, priority: int = 0,
-                 wanted=None):
+                 wanted=None, device_outputs=None):
         self.inputs = inputs
         self.params = params
         self.batch = batch
@@ -121,6 +121,14 @@ class _Pending:
         # wakes a member as soon as its wanted outputs land — it never
         # waits out transfers of outputs it will not encode.
         self.wanted = wanted
+        # True = the caller consumes device arrays directly (ensemble
+        # dataflow interior stage): wake it with device SLICES at
+        # compute end, never route it through the host fetch path.
+        # None = infer from the member's input types (a wire request
+        # decoded to numpy wants host outputs; the TPU-shm path's
+        # device inputs keep outputs resident) — the pre-dataflow
+        # behavior.
+        self.device_outputs = device_outputs
 
 
 class _Bucket:
@@ -482,7 +490,8 @@ class DynamicBatcher:
               batch: int, trace=None,
               queue_from_ns: int = 0,
               priority: Optional[int] = None,
-              wanted_outputs=None) -> Dict[str, np.ndarray]:
+              wanted_outputs=None,
+              device_outputs=None) -> Dict[str, np.ndarray]:
         """Blocks until this request's slice of a fused execution is
         ready. `batch` is the request's own batch-dim size; `trace` is
         the request's RequestTrace when sampled (never part of the
@@ -494,7 +503,11 @@ class DynamicBatcher:
         None = coerce from params here. `wanted_outputs` is the set of
         output names the request asked for (None = all): the
         overlapped fetch wakes this call as soon as those land, even
-        while the fused batch's other outputs are still in flight."""
+        while the fused batch's other outputs are still in flight.
+        `device_outputs=True` marks a device-resident consumer
+        (ensemble dataflow interior stage): it wakes with device
+        slices at compute end and never rides the host fetch — while
+        still fusing into the same shape bucket as wire traffic."""
         shape_key = (
             tuple(
                 (name, array.shape[1:], array.dtype.str)
@@ -509,7 +522,8 @@ class DynamicBatcher:
                                                            priority),
                            trace=trace, priority=priority,
                            wanted=(frozenset(wanted_outputs)
-                                   if wanted_outputs else None))
+                                   if wanted_outputs else None),
+                           device_outputs=device_outputs)
         pending.queue_from_ns = queue_from_ns
         with self._cv:
             if self._stopping:
@@ -974,12 +988,37 @@ class DynamicBatcher:
                 self._finish(bucket, target, compute_ns, 0,
                              done_from=compute_end_ns)
                 return
-            if all(
-                isinstance(p.inputs[name], np.ndarray)
-                for p in bucket for name in p.inputs
-            ):
-                # Every request arrived over the wire and will be
-                # serialized to host bytes anyway: fetch the fused
+            # Partition the bucket by where each member wants its
+            # slice to live. Explicit device_outputs wins; None falls
+            # back to the input-type heuristic (wire requests decode
+            # to numpy, the TPU-shm path resolves device arrays) —
+            # the pre-dataflow behavior, member by member.
+            device_members = [
+                p for p in bucket
+                if p.device_outputs or (
+                    p.device_outputs is None
+                    and any(not isinstance(p.inputs[name], np.ndarray)
+                            for name in p.inputs))
+            ]
+            if device_members and len(device_members) < len(bucket):
+                # Mixed ensemble-interior + wire bucket (the fusion the
+                # dataflow exists to create): device consumers wake NOW
+                # with device slices — zero host round-trip — while the
+                # host riders share one batched fetch below. _scatter /
+                # _wake_ready / _finish all skip already-set members.
+                offset = 0
+                for pending in bucket:
+                    if pending in device_members:
+                        pending.outputs = {
+                            name: array[offset:offset + pending.batch]
+                            for name, array in outputs.items()
+                        }
+                        pending.done_ns = compute_end_ns
+                        pending.event.set()
+                    offset += pending.batch
+            if len(device_members) < len(bucket):
+                # The remaining members arrived over the wire and will
+                # be serialized to host bytes anyway: fetch the fused
                 # output ONCE (one relay round-trip for the whole
                 # bucket, not n slice transfers) — and do it on the
                 # fetch pool so this exec worker (and the gather
@@ -1008,7 +1047,10 @@ class DynamicBatcher:
                 self._finish(bucket, target, compute_ns, 0,
                              done_from=compute_end_ns)
         except Exception as e:
-            self._assign_error(bucket, e)
+            # Members already served device slices (mixed bucket) are
+            # past the point of failure — error only the unwoken.
+            self._assign_error(
+                [p for p in bucket if not p.event.is_set()], e)
             self._finish(bucket, 0, 0, 0, ok=False)
 
     # -- fetch stage (fetch pool) -----------------------------------------
@@ -1017,7 +1059,12 @@ class DynamicBatcher:
                             target: int, compute_ns: int) -> None:
         fetch_start = time.monotonic_ns()
         self._tracker.enter_fetch()
-        traced = [p.trace for p in bucket if p.trace is not None]
+        # Device consumers in a mixed bucket completed at compute end
+        # (event already set): the relay fetch below is not their work,
+        # so their traces must not carry relay_fetch spans — that
+        # absence IS the dataflow's zero-host-round-trip evidence.
+        traced = [p.trace for p in bucket
+                  if p.trace is not None and not p.event.is_set()]
         mark_ns = 0
         try:
             if traced:
@@ -1043,7 +1090,8 @@ class DynamicBatcher:
                 host = {name: np.asarray(a) for name, a in outputs.items()}
             self._scatter(bucket, host)
         except Exception as e:  # noqa: BLE001 — waiters must wake
-            self._assign_error(bucket, e)
+            self._assign_error(
+                [p for p in bucket if not p.event.is_set()], e)
             self._tracker.exit_fetch()
             self._finish(bucket, 0, 0, 0, ok=False)
             return
@@ -1063,7 +1111,10 @@ class DynamicBatcher:
         fetch fails only the members that asked for it."""
         fetch_start = time.monotonic_ns()
         self._tracker.enter_fetch()
-        traced = [p.trace for p in bucket if p.trace is not None]
+        # Same exclusion as _finish_host_bucket: members already woken
+        # with device slices never see relay_fetch spans.
+        traced = [p.trace for p in bucket
+                  if p.trace is not None and not p.event.is_set()]
         offsets: List[int] = []
         offset = 0
         for pending in bucket:
@@ -1203,10 +1254,14 @@ class DynamicBatcher:
     def _scatter(bucket: List[_Pending], outputs) -> None:
         offset = 0
         for pending in bucket:
-            pending.outputs = {
-                name: array[offset:offset + pending.batch]
-                for name, array in outputs.items()
-            }
+            if not pending.event.is_set():
+                # Already-woken members (mixed bucket's device
+                # consumers) hold device slices; overwriting them here
+                # would race their reader.
+                pending.outputs = {
+                    name: array[offset:offset + pending.batch]
+                    for name, array in outputs.items()
+                }
             offset += pending.batch
 
     @staticmethod

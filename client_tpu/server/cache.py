@@ -232,7 +232,13 @@ class ResponseCache:
         stored = pb.ModelInferResponse()
         stored.CopyFrom(response)
         stored.id = ""
-        data = stored.SerializeToString()
+        return self.insert_bytes(model, key, stored.SerializeToString())
+
+    def insert_bytes(self, model: str, key: bytes, data: bytes) -> bool:
+        """Stores an already-serialized payload under ``key`` —
+        response protos from :meth:`insert`, or the tensor-codec bytes
+        the ensemble dataflow caches per composing stage. Same budget,
+        LRU order, and ``invalidate_model`` scope either way."""
         nbytes = len(data) + ENTRY_OVERHEAD_BYTES
         with self._lock:
             stats = self._model_stats(model)
@@ -345,3 +351,85 @@ def wants_response_cache(model) -> bool:
         bool(getattr(model, "response_cache", False))
         and not getattr(model, "decoupled", False)
     )
+
+
+# -- stage-output tensor codec ------------------------------------------
+#
+# The ensemble dataflow caches *composing-stage* outputs (name ->
+# ndarray dicts), not wire protos, so stage entries get their own
+# compact framing: per tensor a length-prefixed name, numpy dtype
+# string, shape, and the raw row-major bytes. Object-dtype tensors
+# (BYTES outputs holding Python objects) are not byte-stable and make
+# the whole dict uncacheable.
+
+_CODEC_MAGIC = b"TCD1"
+
+
+def encode_tensors(outputs: Dict[str, "object"]) -> Optional[bytes]:
+    """Serializes a ``{name: ndarray}`` dict to host bytes, or ``None``
+    when any tensor cannot be cached (object dtype). Device arrays are
+    materialized here — call off the request path."""
+    import numpy as np
+
+    parts = [_CODEC_MAGIC, len(outputs).to_bytes(4, "little")]
+    for name in sorted(outputs):
+        array = np.asarray(outputs[name])
+        if array.dtype.hasobject:
+            return None
+        if not array.flags.c_contiguous:
+            array = np.ascontiguousarray(array)
+        name_b = name.encode()
+        dtype_b = array.dtype.str.encode()
+        parts.append(len(name_b).to_bytes(2, "little"))
+        parts.append(name_b)
+        parts.append(len(dtype_b).to_bytes(2, "little"))
+        parts.append(dtype_b)
+        parts.append(len(array.shape).to_bytes(2, "little"))
+        for dim in array.shape:
+            parts.append(int(dim).to_bytes(8, "little"))
+        raw = array.tobytes()
+        parts.append(len(raw).to_bytes(8, "little"))
+        parts.append(raw)
+    return b"".join(parts)
+
+
+def decode_tensors(data: bytes) -> Optional[Dict[str, "object"]]:
+    """Inverse of :func:`encode_tensors`; returns ``None`` on framing
+    mismatch (a corrupt or foreign entry is a cache miss, never an
+    error)."""
+    import numpy as np
+
+    try:
+        if data[:4] != _CODEC_MAGIC:
+            return None
+        view = memoryview(data)
+        offset = 4
+        count = int.from_bytes(view[offset:offset + 4], "little")
+        offset += 4
+        outputs: Dict[str, object] = {}
+        for _ in range(count):
+            name_len = int.from_bytes(view[offset:offset + 2], "little")
+            offset += 2
+            name = bytes(view[offset:offset + name_len]).decode()
+            offset += name_len
+            dtype_len = int.from_bytes(view[offset:offset + 2], "little")
+            offset += 2
+            dtype = np.dtype(bytes(view[offset:offset + dtype_len]).decode())
+            offset += dtype_len
+            ndim = int.from_bytes(view[offset:offset + 2], "little")
+            offset += 2
+            shape = []
+            for _ in range(ndim):
+                shape.append(int.from_bytes(view[offset:offset + 8],
+                                            "little"))
+                offset += 8
+            nbytes = int.from_bytes(view[offset:offset + 8], "little")
+            offset += 8
+            raw = view[offset:offset + nbytes]
+            offset += nbytes
+            outputs[name] = np.frombuffer(raw, dtype=dtype).reshape(shape)
+        if offset != len(data):
+            return None
+        return outputs
+    except Exception:
+        return None

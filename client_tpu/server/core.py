@@ -10,6 +10,7 @@ triton_c_api/) calls it directly with no serialization at all.
 
 from __future__ import annotations
 
+import hashlib
 import logging
 import threading
 import time
@@ -19,6 +20,7 @@ from typing import Dict, Iterator, Optional
 import numpy as np
 
 from client_tpu.protocol import inference_pb2 as pb
+from client_tpu.server import cache as cache_mod
 from client_tpu.server import chaos
 from client_tpu.server import devstats as devstats_mod
 from client_tpu.server import fetch as relay
@@ -433,6 +435,13 @@ class InferenceServerCore:
         # fetch_pool_workers); this one covers everything that never
         # enters a batcher.
         self.fetcher = relay.OutputFetcher()
+        # Ensemble stage-cache inserts serialize device outputs OFF the
+        # request path on a single lazy worker (created on first
+        # cacheable stage, torn down in shutdown): the dataflow hands
+        # the next stage its device array immediately and the cache
+        # copy materializes behind it.
+        self._stage_insert_pool = None
+        self._stage_insert_lock = threading.Lock()
         self._stats: Dict[str, _ModelStats] = {}
         self._stats_lock = threading.Lock()
         self._batchers: Dict[str, object] = {}
@@ -1465,6 +1474,10 @@ class InferenceServerCore:
         # After the schedulers: a draining batcher's tail may still be
         # encoding direct-path responses through the shared fetcher.
         self.fetcher.shutdown()
+        with self._stage_insert_lock:
+            pool, self._stage_insert_pool = self._stage_insert_pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
 
     # -- inference -------------------------------------------------------
 
@@ -1605,13 +1618,150 @@ class InferenceServerCore:
             return sequencer
 
     def _record_composing(self, name: str, count: int,
-                          compute_ns: int, executions: int = 1) -> None:
+                          compute_ns: int, executions: int = 1,
+                          queue_ns: int = 0) -> None:
         """Stats hook ensembles call per composing-step execution, so
         composing models' per-window deltas are real (Triton records
         composing executions through their own schedulers). Batched
-        steps pass executions=0 for non-leader riders."""
-        self._stats_for(name).record(count, 0, 0, compute_ns, 0, ok=True,
-                                     executions=executions)
+        steps pass executions=0 for non-leader riders and their
+        scheduler queue time as ``queue_ns`` — composing rows keep the
+        same queue/compute split as top-level requests."""
+        self._stats_for(name).record(count, queue_ns, 0, compute_ns, 0,
+                                     ok=True, executions=executions)
+
+    # -- ensemble dataflow ------------------------------------------------
+
+    def _ensemble_dataflow(self, model, inputs, params, trace,
+                           queue_from_ns: int):
+        """Device-resident execution of an ensemble's step graph (the
+        ``device_dataflow=True`` serving path): builds the per-request
+        DataflowContext — per-stage batchers, replica-routed targets,
+        composing stats, telemetry, and the stage-output cache
+        closures — and runs :meth:`EnsembleModel.infer_dataflow`.
+        Returns ``(outputs, queue_ns_total)``; outputs may still be
+        device arrays (``_fetch_outputs`` lands them at the edge)."""
+        from client_tpu.models.ensemble import DataflowContext
+
+        cache_lookup = cache_insert = None
+        if self.response_cache.enabled:
+            digest = self._ensemble_edge_digest(model, inputs, params)
+            if digest is not None:
+                cache_lookup, cache_insert = \
+                    self._stage_cache_closures(model, digest)
+        ctx = DataflowContext(
+            trace=trace,
+            telemetry=(self.telemetry if self.telemetry.enabled
+                       else None),
+            stats_recorder=self._record_composing,
+            batcher_for=self._batcher_for,
+            target_for=self._execution_target,
+            cache_lookup=cache_lookup,
+            cache_insert=cache_insert,
+            queue_from_ns=queue_from_ns,
+        )
+        return model.infer_dataflow(inputs, params, ctx)
+
+    @staticmethod
+    def _ensemble_edge_digest(model, inputs, params) -> Optional[bytes]:
+        """Content hash of an ensemble request at the graph edge
+        (decoded host inputs + cache-relevant params) — the base every
+        stage-cache key derives from. ``None`` = uncacheable (object-
+        dtype input, or anything that will not hash stably)."""
+        h = hashlib.blake2b(digest_size=16)
+        h.update(model.name.encode())
+        try:
+            for name in sorted(inputs):
+                array = np.asarray(inputs[name])
+                if array.dtype.hasobject:
+                    return None
+                h.update(b"\x01")
+                h.update(name.encode())
+                h.update(array.dtype.str.encode())
+                h.update(repr(array.shape).encode())
+                h.update(array.tobytes())
+            for key in sorted(params):
+                if key in cache_mod._UNCACHED_PARAMS:
+                    continue
+                h.update(b"\x02")
+                h.update(key.encode())
+                h.update(repr(params[key]).encode())
+        except Exception:  # noqa: BLE001 — uncacheable, never fatal
+            return None
+        return h.digest()
+
+    def _stage_cache_closures(self, ensemble, digest: bytes):
+        """(cache_lookup, cache_insert) bound to one request's edge
+        digest. Stage keys chain the prefix model names, so two
+        ensembles sharing a backbone but differing upstream never
+        collide; entries are attributed to the STEP's model name, so
+        the existing unload listener invalidates them with the model
+        that produced them."""
+        steps = ensemble._steps
+
+        def stage_key(k: int) -> bytes:
+            h = hashlib.blake2b(digest_size=16)
+            h.update(b"ens-stage")
+            h.update(digest)
+            h.update(k.to_bytes(4, "little"))
+            for name, _, _ in steps[:k + 1]:
+                h.update(b"\x00")
+                h.update(name.encode())
+            return h.digest()
+
+        def cache_lookup(k: int, step_model):
+            if not cache_mod.wants_response_cache(step_model):
+                return None
+            data = self.response_cache.lookup(stage_key(k))
+            if data is None:
+                return None
+            decoded = cache_mod.decode_tensors(data)
+            if decoded is None:
+                return None
+            # The composing model's own hit counter (PR-1 fields) plus
+            # the ensemble-level short-circuit counter: the hit made
+            # the whole prefix subgraph free.
+            self._stats_for(step_model.name).record_cache_hit(0)
+            if self.telemetry.enabled:
+                self.telemetry.record_ensemble_cache_hit(ensemble.name)
+            return decoded
+
+        def cache_insert(k: int, step_model, outputs):
+            if not cache_mod.wants_response_cache(step_model):
+                return
+            key = stage_key(k)
+            if self.response_cache.lookup(key) is not None:
+                return  # hot-set steady state: already cached
+            self._stage_insert_async(step_model.name, key, outputs)
+
+        return cache_lookup, cache_insert
+
+    def _stage_insert_async(self, model_name: str, key: bytes,
+                            outputs) -> None:
+        pool = self._stage_insert_pool
+        if pool is None:
+            with self._stage_insert_lock:
+                pool = self._stage_insert_pool
+                if pool is None:
+                    from concurrent.futures import ThreadPoolExecutor
+
+                    pool = ThreadPoolExecutor(
+                        max_workers=1,
+                        thread_name_prefix="stage-cache")
+                    self._stage_insert_pool = pool
+
+        def work():
+            try:
+                data = cache_mod.encode_tensors(outputs)
+                if data is not None:
+                    self.response_cache.insert_bytes(model_name, key,
+                                                     data)
+            except Exception:  # noqa: BLE001 — caching is best-effort
+                pass
+
+        try:
+            pool.submit(work)
+        except RuntimeError:
+            pass  # shutting down
 
     def _tenant_of(self, request: pb.ModelInferRequest) -> Optional[str]:
         """Tenant identity for quota/accounting purposes, or None when
@@ -1975,6 +2125,7 @@ class InferenceServerCore:
         executions = 1
         priority = 0
         direct_busy = False
+        dataflow = False
         try:
             chaos.inject(model.name, scope=self.chaos_scope)
             # fault injection (no-op unless configured); drops/errors
@@ -2011,6 +2162,22 @@ class InferenceServerCore:
                 batch = self._batch_size(model, request)
                 outputs, queue_ns, executions = sequencer.infer(
                     inputs, params, batch, trace=trace)
+            elif getattr(model, "device_dataflow", False) \
+                    and hasattr(model, "infer_dataflow") \
+                    and "sequence_id" not in params:
+                # Device-resident ensemble dataflow: the core executes
+                # the step graph itself — per-stage batching (fusing
+                # with concurrent ensembles AND standalone traffic),
+                # per-stage replica routing, composing-cache short-
+                # circuits. Takes precedence over the ensemble's OWN
+                # batcher: gathering whole ensembles would serialize
+                # the stage pipeline behind one leader thread, while
+                # per-stage fusion reaches the same padded XLA calls
+                # without it.
+                dataflow = True
+                outputs, queue_ns = self._ensemble_dataflow(
+                    model, inputs, params, trace,
+                    t1 if trace is not None else 0)
             elif batcher is not None and "sequence_id" not in params:
                 batch = self._batch_size(model, request)
                 outputs, queue_ns, leader = batcher.infer(
@@ -2053,7 +2220,8 @@ class InferenceServerCore:
             # deschedule land between them as untracked time, and at
             # concurrency those slices dominate microsecond models.
             span_mark = t2
-            if trace is not None and sequencer is None and batcher is None:
+            if trace is not None and sequencer is None \
+                    and batcher is None and not dataflow:
                 # device_execute = end of decode to model return
                 # (async-dispatch models return lazy arrays; the
                 # forced materialization lands in relay_fetch below).

@@ -209,7 +209,8 @@ class ModelTelemetry:
     """Per-model histogram set (request + stages + stream)."""
 
     __slots__ = ("request", "stages", "stream_first", "stream_inter",
-                 "stream_responses", "_stage_lock")
+                 "stream_responses", "ensemble_steps",
+                 "ensemble_fused", "ensemble_cache_hits", "_stage_lock")
 
     def __init__(self):
         self.request = LatencyHistogram()
@@ -217,6 +218,13 @@ class ModelTelemetry:
         self.stream_first = LatencyHistogram()
         self.stream_inter = LatencyHistogram()
         self.stream_responses = _Counter()
+        # Device-resident ensemble dataflow: per-step duration
+        # histograms keyed "<index>:<composing model>", plus fused
+        # (non-leader) step executions and composing-cache
+        # short-circuits. Only ensembles populate these.
+        self.ensemble_steps: Dict[str, LatencyHistogram] = {}
+        self.ensemble_fused = _Counter()
+        self.ensemble_cache_hits = _Counter()
         self._stage_lock = threading.Lock()
 
     def stage(self, name: str) -> LatencyHistogram:
@@ -229,12 +237,26 @@ class ModelTelemetry:
                     self.stages[name] = hist
         return hist
 
+    def ensemble_step(self, step: str) -> LatencyHistogram:
+        hist = self.ensemble_steps.get(step)
+        if hist is None:
+            with self._stage_lock:
+                hist = self.ensemble_steps.get(step)
+                if hist is None:
+                    hist = LatencyHistogram()
+                    self.ensemble_steps[step] = hist
+        return hist
+
     def stages_snapshot(self) -> Dict[str, LatencyHistogram]:
         """Copy of the stage map for iteration: a concurrent first
         observation of a new stage mutates ``stages`` mid-scrape, and
         iterating the live dict would raise."""
         with self._stage_lock:
             return dict(self.stages)
+
+    def ensemble_steps_snapshot(self) -> Dict[str, LatencyHistogram]:
+        with self._stage_lock:
+            return dict(self.ensemble_steps)
 
 
 class ServerTelemetry:
@@ -302,6 +324,26 @@ class ServerTelemetry:
         telemetry = self.for_model(model_name)
         telemetry.stream_inter.observe(us, trace_id)
         telemetry.stream_responses.add(1)
+
+    def observe_ensemble_step(self, model_name: str, step: str,
+                              us: float,
+                              trace_id: Optional[str] = None) -> None:
+        if not self.enabled:
+            return
+        self.for_model(model_name).ensemble_step(step).observe(
+            us, trace_id)
+
+    def record_ensemble_fused(self, model_name: str,
+                              n: int = 1) -> None:
+        if not self.enabled:
+            return
+        self.for_model(model_name).ensemble_fused.add(n)
+
+    def record_ensemble_cache_hit(self, model_name: str,
+                                  n: int = 1) -> None:
+        if not self.enabled:
+            return
+        self.for_model(model_name).ensemble_cache_hits.add(n)
 
     def observe_tenant(self, tenant: str, us: float) -> None:
         if not self.enabled:
@@ -371,6 +413,9 @@ class ServerTelemetry:
         first_rows: List[str] = []
         inter_rows: List[str] = []
         response_rows: List[str] = []
+        step_rows: List[str] = []
+        fused_rows: List[str] = []
+        cache_hit_rows: List[str] = []
         for name in sorted(models):
             telemetry = models[name]
             label = 'model="%s"' % name
@@ -387,6 +432,23 @@ class ServerTelemetry:
                         "tpu_stage_duration_us",
                         '%s,stage="%s"' % (label, stage), snap,
                         exemplars))
+            steps = telemetry.ensemble_steps_snapshot()
+            for step in sorted(steps):
+                snap = steps[step].snapshot()
+                if snap["count"]:
+                    step_rows.extend(self._histogram_rows(
+                        "tpu_ensemble_step_duration_us",
+                        '%s,step="%s"' % (label, step), snap,
+                        exemplars))
+            fused = telemetry.ensemble_fused.value()
+            if fused:
+                fused_rows.append(
+                    "tpu_ensemble_fused_total{%s} %d" % (label, fused))
+            hits = telemetry.ensemble_cache_hits.value()
+            if hits:
+                cache_hit_rows.append(
+                    "tpu_ensemble_cache_hits_total{%s} %d"
+                    % (label, hits))
             snap = telemetry.stream_first.snapshot()
             if snap["count"]:
                 first_rows.extend(self._histogram_rows(
@@ -420,6 +482,18 @@ class ServerTelemetry:
         family("tpu_stream_responses_total",
                "Responses streamed by decoupled/stream inference",
                response_rows, kind="counter")
+        family("tpu_ensemble_step_duration_us",
+               "Per-stage device-resident ensemble dataflow time "
+               "(histogram; step label is <index>:<composing model>, "
+               "measured queue+execute per stage)", step_rows)
+        family("tpu_ensemble_fused_total",
+               "Composing-model step executions that fused into "
+               "another request's batch (non-leader batcher rides)",
+               fused_rows, kind="counter")
+        family("tpu_ensemble_cache_hits_total",
+               "Ensemble subgraphs short-circuited by a composing-"
+               "model response-cache hit", cache_hit_rows,
+               kind="counter")
 
         tenant_rows: List[str] = []
         for tenant in sorted(tenants):

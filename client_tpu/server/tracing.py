@@ -65,6 +65,7 @@ SPAN_DEVICE_EXECUTE = "device_execute"
 SPAN_RELAY_FETCH = "relay_fetch"
 SPAN_ENCODE = "encode"
 SPAN_STREAM_RESPONSE = "stream_response"
+SPAN_ENSEMBLE_STEP = "ensemble_step"
 
 
 class Span:

@@ -182,11 +182,17 @@ int RunRank(PerfAnalyzerParameters& params) {
       &model, &loader, shm_type, params.output_shm_size, arena_url,
       params.batch_size);
 
-  if (model.response_cache_enabled || model.composing_cache_enabled) {
+  if (model.response_cache_enabled) {
     fprintf(stderr,
-            "note: %s has response caching enabled; server-side "
-            "queue/compute breakdowns exclude cache hits\n",
-            model.response_cache_enabled ? "model" : "a composing model");
+            "note: model has response caching enabled; server-side "
+            "queue/compute breakdowns exclude cache hits\n");
+  } else if (model.composing_cache_enabled) {
+    // Composing-model cache hits short-circuit the ensemble subgraph
+    // device-side and are counted in tpu_ensemble_cache_hits_total.
+    fprintf(stderr,
+            "note: a composing model has response caching enabled; "
+            "cache hits short-circuit the ensemble subgraph (see "
+            "tpu_ensemble_cache_hits_total)\n");
   }
 
   std::unique_ptr<SequenceManager> sequence_manager;

@@ -1,0 +1,333 @@
+"""Device-resident ensemble dataflow (ISSUE 16): golden parity vs the
+legacy host-mediated arm, span shape (per-stage ensemble_step chain,
+zero interior relay_fetch), composing-cache subgraph short-circuit,
+replica fault masking mid-ensemble, mixed ensemble+standalone fusion
+into one batch, and Triton-parity per-stage statistics.
+
+Uses tiny custom composing models (2 ms backbone) so the file stays
+tier-1 fast; the row-proportional A/B pair lives in the bench/smoke
+driver (client_tpu.perf.bench_child.run_ensemble_dataflow_measure).
+"""
+
+import json
+import re
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from client_tpu._infer_common import InferInput
+from client_tpu.grpc._utils import get_inference_request
+from client_tpu.models.ensemble import EnsembleModel
+from client_tpu.server import chaos
+from client_tpu.server.app import build_core
+from client_tpu.server.model import ServedModel, TensorSpec
+from client_tpu.utils import InferenceServerException
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos.configure(None)
+    yield
+    chaos.configure(None)
+
+
+# -- tiny composing graph --------------------------------------------------
+
+
+class _Edge(ServedModel):
+    """Direct (scheduler-less) first stage: H = XIN * 2."""
+
+    max_batch_size = 8
+
+    def __init__(self, name="dfl_edge"):
+        super().__init__()
+        self.name = name
+        self.inputs = [TensorSpec("XIN", "FP32", [4])]
+        self.outputs = [TensorSpec("H", "FP32", [4])]
+
+    def infer(self, inputs, parameters=None):
+        x = np.asarray(inputs["XIN"], dtype=np.float32)
+        return {"H": x * np.float32(2.0)}
+
+
+class _Mid(ServedModel):
+    """Batched, cached backbone: F = H + 1. ``calls`` counts
+    executions on this instance — the cache-short-circuit probe."""
+
+    max_batch_size = 8
+    dynamic_batching = True
+    preferred_batch_sizes = [2, 4, 8]
+    max_queue_delay_us = 50_000
+    response_cache = True
+
+    def __init__(self, name="dfl_mid"):
+        super().__init__()
+        self.name = name
+        self.calls = 0
+        self.inputs = [TensorSpec("H", "FP32", [4])]
+        self.outputs = [TensorSpec("F", "FP32", [4])]
+
+    def infer(self, inputs, parameters=None):
+        self.calls += 1
+        time.sleep(0.002)  # real compute time for the stats gate
+        x = np.asarray(inputs["H"], dtype=np.float32)
+        return {"F": x + np.float32(1.0)}
+
+
+class _MidReplicated(_Mid):
+    """Two fault domains, cache off so every request executes (chaos
+    must hit the model, not a cache hit)."""
+
+    instance_group_count = 2
+    response_cache = False
+    max_queue_delay_us = 5_000
+
+    def __init__(self, name="dfl_mid_r"):
+        super().__init__(name=name)
+
+
+class _Tail(ServedModel):
+    """Direct reduction at the graph edge: OUT = sum(F)."""
+
+    max_batch_size = 8
+
+    def __init__(self, name="dfl_tail"):
+        super().__init__()
+        self.name = name
+        self.inputs = [TensorSpec("F", "FP32", [4])]
+        self.outputs = [TensorSpec("OUT", "FP32", [1])]
+
+    def infer(self, inputs, parameters=None):
+        x = np.asarray(inputs["F"], dtype=np.float32)
+        return {"OUT": x.sum(axis=-1, keepdims=True)}
+
+
+def _make_ensemble(repository, name, mid="dfl_mid", legacy=False):
+    ensemble = EnsembleModel(
+        name=name,
+        repository=repository,
+        steps=[
+            ("dfl_edge", {"XIN": "XIN"}, {"h": "H"}),
+            (mid, {"h": "H"}, {"f": "F"}),
+            ("dfl_tail", {"f": "F"}, {"OUT": "OUT"}),
+        ],
+        inputs=[TensorSpec("XIN", "FP32", [4])],
+        outputs=[TensorSpec("OUT", "FP32", [1])],
+        max_batch_size=8,
+    )
+    ensemble.device_dataflow = not legacy
+    return ensemble
+
+
+@pytest.fixture(scope="module")
+def core():
+    core = build_core([], warmup=False)
+    repo = core.repository
+    repo.add_factory("dfl_edge", _Edge)
+    repo.add_factory("dfl_mid", _Mid)
+    repo.add_factory("dfl_mid_r", _MidReplicated)
+    repo.add_factory("dfl_tail", _Tail)
+    repo.add_factory("dfl_ens", lambda: _make_ensemble(repo, "dfl_ens"))
+    repo.add_factory(
+        "dfl_ens_legacy",
+        lambda: _make_ensemble(repo, "dfl_ens_legacy", legacy=True))
+    repo.add_factory(
+        "dfl_ens_r",
+        lambda: _make_ensemble(repo, "dfl_ens_r", mid="dfl_mid_r"))
+    for name in ("dfl_ens", "dfl_ens_legacy", "dfl_ens_r"):
+        core.load_model(name, warmup=False)
+    yield core
+    core.shutdown()
+
+
+def _request(model, seed, tensor="XIN"):
+    data = ((np.arange(4, dtype=np.float32) + 1.0)
+            * np.float32(seed)).reshape(1, 4)
+    inp = InferInput(tensor, [1, 4], "FP32")
+    inp.set_data_from_numpy(data)
+    return get_inference_request(model_name=model, inputs=[inp],
+                                 outputs=None)
+
+
+def _stats(core, name):
+    return core.model_statistics(name).model_stats[0]
+
+
+def _family_value(core, family, model):
+    pattern = r'%s\{model="%s"\} (\d+)' % (family, model)
+    match = re.search(pattern, core.metrics_text())
+    return int(match.group(1)) if match else 0
+
+
+# -- parity ----------------------------------------------------------------
+
+
+def test_golden_parity_dataflow_vs_legacy(core):
+    for seed in (3, 5, 11, 42):
+        dataflow = core.infer(_request("dfl_ens", seed))
+        legacy = core.infer(_request("dfl_ens_legacy", seed))
+        assert dataflow.raw_output_contents[0] \
+            == legacy.raw_output_contents[0]
+        value = np.frombuffer(dataflow.raw_output_contents[0],
+                              np.float32)
+        expected = (np.arange(4, dtype=np.float32) + 1.0) * seed
+        np.testing.assert_allclose(
+            value, [(expected * 2.0 + 1.0).sum()], rtol=1e-6)
+
+
+# -- span shape ------------------------------------------------------------
+
+
+def test_span_tree_has_step_chain_and_no_interior_relay_fetch(
+        core, tmp_path):
+    path = tmp_path / "trace.jsonl"
+    keys = ("trace_level", "trace_rate", "trace_count",
+            "log_frequency", "trace_file", "trace_mode")
+    core.trace_setting("dfl_ens", {
+        "trace_level": ["TIMESTAMPS"], "trace_rate": ["1"],
+        "trace_count": ["-1"], "log_frequency": ["1"],
+        "trace_file": [str(path)], "trace_mode": ["compact"]})
+    try:
+        core.infer(_request("dfl_ens", 21))
+    finally:
+        core.trace_setting("dfl_ens", {key: [] for key in keys})
+    records = [json.loads(line) for line in open(path)
+               if line.strip()]
+    assert records
+    names = [s["name"] for s in records[0]["spans"]]
+    steps = [s for s in records[0]["spans"]
+             if s["name"] == "ensemble_step"]
+    # One span per composing stage, labeled <index>:<model> ...
+    assert [s["attrs"]["step"] for s in steps] \
+        == ["0:dfl_edge", "1:dfl_mid", "2:dfl_tail"]
+    # ... and ZERO host round-trips between stages: no relay_fetch
+    # span anywhere in the request's tree.
+    assert "relay_fetch" not in names
+
+
+# -- composing-cache short-circuit ----------------------------------------
+
+
+def test_composing_cache_short_circuits_subgraph(core):
+    mid = core.repository.load("dfl_mid")
+    seed = 77
+    first = core.infer(_request("dfl_ens", seed)).raw_output_contents[0]
+    hits_before = _family_value(core, "tpu_ensemble_cache_hits_total",
+                                "dfl_ens")
+    # The stage insert is async (single-worker pool); poll until a
+    # repeat stops executing the backbone.
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        calls_before = mid.calls
+        repeat = core.infer(
+            _request("dfl_ens", seed)).raw_output_contents[0]
+        assert repeat == first
+        if mid.calls == calls_before:
+            break
+        time.sleep(0.05)
+    else:
+        pytest.fail("repeat requests kept executing the cached "
+                    "backbone stage")
+    assert _family_value(core, "tpu_ensemble_cache_hits_total",
+                         "dfl_ens") > hits_before
+    # The composing model's own Triton-parity cache counters see the
+    # short-circuit too.
+    assert _stats(core, "dfl_mid").inference_stats.cache_hit.count > 0
+
+
+# -- replica fault masking mid-ensemble ------------------------------------
+
+
+def test_replica_kill_masked_mid_ensemble(core):
+    errors = [0]
+    chaos.configure(chaos.ChaosConfig(error_rate=1.0,
+                                      replica="dfl_mid_r:1"))
+    try:
+        def loop(index):
+            for i in range(10):
+                try:
+                    core.infer(_request("dfl_ens_r",
+                                        1000 + index * 100 + i))
+                except InferenceServerException:
+                    errors[0] += 1
+
+        pool = [threading.Thread(target=loop, args=(i,))
+                for i in range(4)]
+        for thread in pool:
+            thread.start()
+        for thread in pool:
+            thread.join()
+    finally:
+        chaos.configure(None)
+    # Blast radius is ONE fault domain of the composing model: zero
+    # client-visible ensemble errors, faults masked by redispatch.
+    assert errors[0] == 0
+    entry = _stats(core, "dfl_mid_r")
+    ejected = sum(int(r.ejected_count) for r in entry.replica_stats)
+    redispatched = _family_value(core, "tpu_replica_redispatch_total",
+                                 "dfl_mid_r")
+    assert ejected + redispatched >= 1
+    assert core.model_ready("dfl_ens_r")
+
+
+# -- mixed ensemble + standalone fusion ------------------------------------
+
+
+def test_ensemble_and_standalone_fuse_into_one_batch(core):
+    before = _stats(core, "dfl_mid")
+    inf0, exec0 = int(before.inference_count), int(before.execution_count)
+    barrier = threading.Barrier(2)
+    failures = []
+
+    def ensemble_request():
+        barrier.wait()
+        try:
+            core.infer(_request("dfl_ens", 901))
+        except Exception as exc:  # noqa: BLE001
+            failures.append(exc)
+
+    def standalone_request():
+        barrier.wait()
+        try:
+            core.infer(_request("dfl_mid", 902, tensor="H"))
+        except Exception as exc:  # noqa: BLE001
+            failures.append(exc)
+
+    pool = [threading.Thread(target=ensemble_request),
+            threading.Thread(target=standalone_request)]
+    for thread in pool:
+        thread.start()
+    for thread in pool:
+        thread.join()
+    assert not failures
+    after = _stats(core, "dfl_mid")
+    # Two inference rows (one interior dataflow step + one standalone
+    # wire request), ONE fused execution: the shared backbone gathered
+    # both into a single batch (preferred size 2 dispatches the moment
+    # the second member arrives, inside the 50 ms window).
+    assert int(after.inference_count) - inf0 == 2
+    assert int(after.execution_count) - exec0 == 1
+
+
+# -- per-stage statistics parity -------------------------------------------
+
+
+def test_composing_stats_keep_queue_and_compute_accounting(core):
+    before = _stats(core, "dfl_mid")
+    core.infer(_request("dfl_ens", 511))
+    after = _stats(core, "dfl_mid")
+    # PR-1 histogram fields stay meaningful for composing traffic:
+    # the row count, the fused-execution count, a real queue wait
+    # (the batcher's gather window) and a real compute time (the
+    # 2 ms backbone) all advance.
+    assert int(after.inference_count) - int(before.inference_count) == 1
+    assert int(after.execution_count) - int(before.execution_count) == 1
+    stats_b, stats_a = before.inference_stats, after.inference_stats
+    assert int(stats_a.success.count) > int(stats_b.success.count)
+    assert int(stats_a.queue.ns) > int(stats_b.queue.ns)
+    assert int(stats_a.compute_infer.ns) - int(stats_b.compute_infer.ns) \
+        >= 1_000_000  # >= half the 2 ms sleep, well clear of zero
+    # The ensemble itself keeps end-to-end accounting as well.
+    assert _stats(core, "dfl_ens").inference_stats.success.count > 0

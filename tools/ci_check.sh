@@ -297,4 +297,22 @@ fi
 grep -E "devstats smoke passed" "$DEVSTATS_LOG"
 grep -E "ledger|busy|compile recorded|overhead" "$DEVSTATS_LOG" | head -10
 echo "OK: devstats smoke passed"
+
+# Ensemble-dataflow smoke: the ensemble_ab / ensemble_ab_legacy A/B
+# pair on the shared driver — golden parity across arms, backbone
+# fusion ratio <= 0.15 at c16 (per-stage batching), hot-set
+# throughput >= 4x legacy (stage-cache subgraph short-circuit), and
+# a traced request with ensemble_step spans and zero relay_fetch.
+# Gates live in tools/ensemble_smoke.py.
+echo "ensemble smoke: device-resident dataflow vs legacy step loop"
+ENSEMBLE_LOG=/tmp/_ensemble_smoke.log
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/ensemble_smoke.py \
+    > "$ENSEMBLE_LOG" 2>&1; then
+    echo "FAIL: ensemble smoke did not pass" >&2
+    tail -30 "$ENSEMBLE_LOG" >&2
+    exit 1
+fi
+grep -E "ensemble smoke passed" "$ENSEMBLE_LOG"
+grep -E "distinct c|hot set|trace:" "$ENSEMBLE_LOG"
+echo "OK: ensemble smoke passed"
 exit 0

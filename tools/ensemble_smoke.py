@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Device-resident ensemble dataflow smoke (ISSUE 16 acceptance).
+
+Runs the shared A/B driver (client_tpu.perf.bench_child.
+run_ensemble_dataflow_measure): the ``ensemble_ab`` /
+``ensemble_ab_legacy`` pair — identical three-step graphs whose
+backbone wall cost scales with batch ROWS (ensemble-level gather
+cannot amortize it), one arm executed as a device-resident dataflow
+graph (per-stage batching + composing-cache short-circuit), the other
+through the legacy host-mediated step loop with prod-style
+ensemble-level dynamic batching.
+
+Gates:
+  1. golden parity — identical RAW inputs produce byte-identical
+     SCORE bytes across arms;
+  2. backbone fusion ratio (execution_count / inference_count over
+     the distinct-input phase at c16) <= 0.15 — concurrent dataflow
+     requests fuse in the composing model's own batcher;
+  3. hot-set throughput >= 4x the legacy arm — the dataflow arm's
+     stage cache short-circuits the subgraph (the retired PR-5
+     composing-cache caveat, measured), the legacy arm re-pays the
+     row-proportional backbone every cycle;
+  4. span shape — a traced dataflow request carries per-stage
+     ``ensemble_step`` spans and ZERO ``relay_fetch`` spans: interior
+     tensors never detour through a host fetch.
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+SPEEDUP_FLOOR = 4.0
+FUSION_CEIL = 0.15
+
+
+def main() -> int:
+    from client_tpu.perf.bench_child import run_ensemble_dataflow_measure
+
+    result = run_ensemble_dataflow_measure()
+    print("distinct c%d: %.1f/s p50 %.0f us; fusion %.4f "
+          "(%d executions over %d backbone rows, %d fused dispatches)"
+          % (result["concurrency"], result["distinct_tput"],
+             result["distinct_p50_us"], result["fusion_ratio"],
+             result["backbone_executions"],
+             result["backbone_inferences"], result["ensemble_fused"]))
+    print("hot set: dataflow %.1f/s p50 %.0f us vs legacy %.1f/s "
+          "p50 %.0f us (%.2fx); %d subgraph cache hits"
+          % (result["dataflow_tput"], result["dataflow_p50_us"],
+             result["legacy_tput"], result["legacy_p50_us"],
+             result["speedup"], result["ensemble_cache_hits"]))
+    print("trace: %d ensemble_step spans, %d relay_fetch spans"
+          % (result["ensemble_step_spans"],
+             result["interior_relay_fetch_spans"]))
+
+    failures = []
+    if not result["golden_parity"]:
+        failures.append("dataflow arm is NOT byte-identical to the "
+                        "legacy host-mediated arm")
+    if result["fusion_ratio"] > FUSION_CEIL:
+        failures.append(
+            "backbone fusion ratio %.4f above the %.2f ceiling at "
+            "c%d — per-stage batching is not fusing concurrent "
+            "dataflow requests" % (result["fusion_ratio"], FUSION_CEIL,
+                                   result["concurrency"]))
+    if result["speedup"] < SPEEDUP_FLOOR:
+        failures.append(
+            "hot-set throughput %.2fx below the %.1fx floor "
+            "(dataflow %.1f/s vs legacy %.1f/s)"
+            % (result["speedup"], SPEEDUP_FLOOR,
+               result["dataflow_tput"], result["legacy_tput"]))
+    if result["ensemble_cache_hits"] <= 0:
+        failures.append("no subgraph cache hits on the pinned hot set")
+    if result["ensemble_step_spans"] <= 0:
+        failures.append("traced dataflow request carried no "
+                        "ensemble_step spans")
+    if result["interior_relay_fetch_spans"] != 0:
+        failures.append(
+            "%d relay_fetch span(s) inside the dataflow request — "
+            "interior tensors detoured through a host fetch"
+            % result["interior_relay_fetch_spans"])
+    for failure in failures:
+        print("FAIL: %s" % failure)
+    if failures:
+        return 1
+    print("ensemble smoke passed: %.2fx hot-set throughput "
+          "(floor %.1fx), fusion %.4f (ceil %.2f) at c%d, golden "
+          "parity, %d ensemble_step spans with zero relay_fetch"
+          % (result["speedup"], SPEEDUP_FLOOR, result["fusion_ratio"],
+             FUSION_CEIL, result["concurrency"],
+             result["ensemble_step_spans"]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
