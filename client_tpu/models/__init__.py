@@ -89,6 +89,40 @@ def builtin_model_factories(repository=None
         model.flight_slow_us = 50_000
         return model
 
+    def _simple_autoscale() -> ServedModel:
+        # The autoscale testbed: one replica at rest, growable to 4 by
+        # the feedback controller (client_tpu.server.autoscale), with
+        # two priority classes so the controller's shed directive has
+        # a lowest class to shed and a generous-for-CPU latency SLO
+        # whose burn the controller reads. Cooldowns are tuned tight
+        # (0.3s up / 1s down) so tests and the autoscale smoke observe
+        # grow -> shrink inside seconds; queue_high 2 means "more than
+        # two gathered batches of backlog per healthy replica".
+        model = AddSub(name="simple_autoscale", datatype="INT32",
+                       shape=(16,))
+        model.max_batch_size = 8
+        model.dynamic_batching = True
+        model.preferred_batch_sizes = [8]
+        model.max_queue_delay_us = 500
+        model.max_queue_size = 64
+        model.priority_levels = 2
+        model.default_priority_level = 2
+        model.shed_watermark = 0.95
+        model.instance_group_count = 1
+        model.instance_group_kind = "cpu"
+        model.replica_watchdog_us = 2_000_000
+        model.replica_failure_threshold = 3
+        model.replica_recovery_s = 0.5
+        model.slo_p99_latency_us = 80_000
+        model.slo_availability = 0.999
+        model.autoscale_min_replicas = 1
+        model.autoscale_max_replicas = 4
+        model.autoscale_interval_s = 0.2
+        model.autoscale_queue_high = 2.0
+        model.autoscale_up_cooldown_s = 0.3
+        model.autoscale_down_cooldown_s = 1.0
+        return model
+
     factories: Dict[str, Callable[[], ServedModel]] = {
         "add_sub": AddSub,
         "simple": lambda: AddSub(name="simple", datatype="INT32", shape=(16,)),
@@ -96,6 +130,7 @@ def builtin_model_factories(repository=None
         "simple_qos": _simple_qos,
         "simple_replicas": _simple_replicas,
         "simple_slo": _simple_slo,
+        "simple_autoscale": _simple_autoscale,
         "add_sub_fp32": lambda: AddSub(
             name="add_sub_fp32", datatype="FP32", shape=(16,)
         ),

@@ -940,6 +940,208 @@ def run_replica_measure(core, model_name: str = "replica_bench",
     return result
 
 
+def run_autoscale_measure(core, model_name: str = "autoscale_bench",
+                          exec_delay_s: float = 0.02,
+                          low_rate: float = 20.0,
+                          high_rate: float = 200.0,
+                          low_s: float = 1.5, high_s: float = 3.0,
+                          drain_s: float = 6.0) -> dict:
+    """Autoscale-controller measurement: a 10x diurnal load swing
+    replayed through the chaos OverloadScenario trace mode against a
+    controller-governed model, with a mid-swing replica kill.
+
+    The model is AddSub + a fixed per-execution delay (so capacity is
+    replica-bound on CPU: one replica serves preferred/exec_delay
+    rows/s), governed min 1 / max 4 with tight cooldowns. The trace
+    is low -> 10x high -> low; the controller must grow the fleet
+    through the canaried path during the high stage and drain it back
+    after, while a priority-1 foreground closed loop measures the
+    latency the SLO gate reads. During the high stage one serving
+    replica is chaos-killed: the PR-8 masking (redispatch + ejection)
+    must keep foreground goodput at 100% while the controller's
+    canary keeps chaos-free replacements coming.
+
+    Returns the smoke's evidence: foreground p50/p99/errors, the
+    configured SLO target, replica-seconds consumed vs a
+    max-scale-always baseline over the same window, scale events by
+    direction, and the flight-recorded decision labels."""
+    import threading as _threading
+
+    import numpy as np
+
+    from client_tpu._infer_common import InferInput
+    from client_tpu.grpc._utils import get_inference_request
+    from client_tpu.models.add_sub import AddSub
+    from client_tpu.server import chaos as chaos_mod
+    from client_tpu.server.chaos import OverloadScenario
+    from client_tpu.utils import InferenceServerException
+
+    slo_p99_us = 250_000
+
+    class _AutoscaleBench(AddSub):
+        # One replica's service rate is preferred_batch / exec_delay
+        # = 100 rows/s, so the 20/s low stage idles one replica and
+        # the 200/s high stage needs the fleet — the controller has
+        # to actually scale for the p99 gate to hold.
+        def __init__(self):
+            super().__init__(name=model_name, datatype="INT32",
+                             shape=(16,))
+            self.max_batch_size = 2
+            self.dynamic_batching = True
+            self.preferred_batch_sizes = [2]
+            self.max_queue_delay_us = 1000
+            self.max_queue_size = 64
+            self.priority_levels = 2
+            self.default_priority_level = 2
+            self.shed_watermark = 0.95
+            self.instance_group_count = 1
+            self.instance_group_kind = "cpu"
+            self.replica_failure_threshold = 3
+            self.replica_recovery_s = 0.5
+            self.slo_p99_latency_us = slo_p99_us
+            self.slo_availability = 0.999
+            self.autoscale_min_replicas = 1
+            self.autoscale_max_replicas = 4
+            self.autoscale_interval_s = 0.1
+            self.autoscale_queue_high = 1.0
+            self.autoscale_up_cooldown_s = 0.2
+            self.autoscale_down_cooldown_s = 0.6
+
+        def infer(self, inputs, parameters=None):
+            time.sleep(exec_delay_s)
+            return super().infer(inputs, parameters)
+
+    core.repository.add_factory(model_name, _AutoscaleBench)
+    core.load_model(model_name, warmup=False)  # starts the controller
+
+    def request(priority: int, seed: int):
+        a = np.full((1, 16), seed % 997, dtype=np.int32)
+        b = np.arange(16, dtype=np.int32).reshape(1, 16)
+        t0 = InferInput("INPUT0", [1, 16], "INT32")
+        t0.set_data_from_numpy(a)
+        t1 = InferInput("INPUT1", [1, 16], "INT32")
+        t1.set_data_from_numpy(b)
+        return get_inference_request(
+            model_name=model_name, inputs=[t0, t1], outputs=None,
+            priority=priority, parameters={"tenant": "bulk"})
+
+    core.infer(request(1, 0))  # wake batcher + replica set
+    replica_set = core._replica_sets[model_name]
+
+    bulk_seed = [0]
+    bulk_lock = _threading.Lock()
+
+    def submit_bulk():
+        with bulk_lock:
+            bulk_seed[0] += 1
+            seed = bulk_seed[0]
+        core.infer(request(2, seed))
+
+    controller_t0 = core.autoscaler.snapshot().get(model_name, {})
+    seconds_t0 = controller_t0.get("replica_seconds", 0.0)
+    window_t0 = time.monotonic()
+    peak = [1]
+
+    latencies: list = []
+    fg_errors = [0]
+    fg_stop = _threading.Event()
+
+    def foreground():
+        seed = 10_000_000
+        while not fg_stop.is_set():
+            seed += 1
+            t_start = time.monotonic_ns()
+            try:
+                core.infer(request(1, seed))
+                latencies.append(time.monotonic_ns() - t_start)
+            except InferenceServerException:
+                fg_errors[0] += 1
+            peak[0] = max(peak[0], replica_set.count)
+
+    fg_thread = _threading.Thread(target=foreground, daemon=True)
+    fg_thread.start()
+
+    scenario = OverloadScenario(
+        submit_bulk, workers=8, seed=11,
+        trace=[(low_rate, low_s), (high_rate, high_s),
+               (low_rate, low_s)])
+    scenario.start()
+
+    # Mid-swing replica kill: wait for the high stage to be underway
+    # and the fleet grown, then hard-fail one SERVING replica for a
+    # bounded slice — the foreground must not see a single error.
+    kill = {"fired": False, "errors_before": None}
+    kill_deadline = time.monotonic() + low_s + high_s
+    while time.monotonic() < kill_deadline:
+        if replica_set.count >= 2:
+            victim = replica_set.replicas[0].index
+            kill["errors_before"] = fg_errors[0]
+            kill["fired"] = True
+            chaos_mod.configure(chaos_mod.ChaosConfig(
+                error_rate=1.0,
+                replica="%s:%d" % (model_name, victim)))
+            time.sleep(0.8)
+            chaos_mod.configure(None)
+            break
+        time.sleep(0.05)
+
+    scenario.finished.wait(low_s + high_s + low_s + 30.0)
+    scenario.stop()
+    fg_stop.set()
+    fg_thread.join(timeout=10)
+
+    # Quiet tail: the controller must drain the fleet back down.
+    drain_deadline = time.monotonic() + drain_s
+    while time.monotonic() < drain_deadline:
+        if replica_set.count <= 1:
+            break
+        time.sleep(0.1)
+    window_s = time.monotonic() - window_t0
+
+    controller = core.autoscaler.snapshot().get(model_name, {})
+    events = controller.get("events", {})
+    ups = sum(n for key, n in events.items()
+              if key.startswith("up|"))
+    downs = sum(n for key, n in events.items()
+                if key.startswith("down|"))
+    decisions = [r["decision"] for r
+                 in core.flight.snapshot(model_name)
+                 if r.get("reason") == "decision"]
+    replica_seconds = (controller.get("replica_seconds", 0.0)
+                       - seconds_t0)
+    max_always = 4 * window_s
+
+    arr = (np.array(latencies, dtype=float) / 1000.0
+           if latencies else np.array([0.0]))
+    result = {
+        "fg_completed": len(latencies),
+        "fg_errors": fg_errors[0],
+        "fg_p50_us": round(float(np.percentile(arr, 50)), 1),
+        "fg_p99_us": round(float(np.percentile(arr, 99)), 1),
+        "slo_p99_us": slo_p99_us,
+        "bulk": scenario.stats(),
+        "peak_replicas": peak[0],
+        "final_replicas": replica_set.count,
+        "scale_ups": ups,
+        "scale_downs": downs,
+        "canary_rejects": replica_set.canary_rejects,
+        "replica_seconds": round(replica_seconds, 2),
+        "max_scale_always_seconds": round(max_always, 2),
+        "replica_seconds_ratio": round(
+            replica_seconds / max_always, 3) if max_always else 0.0,
+        "kill_fired": kill["fired"],
+        "kill_fg_errors": (fg_errors[0] - kill["errors_before"]
+                           if kill["fired"] else None),
+        "shed_state": controller.get("shed"),
+        "flight_up_decisions": sum(
+            1 for d in decisions if d.startswith("autoscale_up")),
+        "flight_down_decisions": sum(
+            1 for d in decisions if d.startswith("autoscale_down")),
+        "window_s": round(window_s, 2),
+    }
+    return result
+
+
 def run_tracing_measure(core, model_name: str = "add_sub_large",
                         threads: int = 4, requests: int = 120) -> dict:
     """Span-tracing overhead: the same closed loop run with tracing

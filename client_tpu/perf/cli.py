@@ -210,7 +210,13 @@ def build_parser() -> argparse.ArgumentParser:
                              "seed=11,priority=2,tenant=bulk' — the "
                              "burst saturates the queue while the "
                              "foreground load's QoS is measured "
-                             "(service-kind inprocess and triton)")
+                             "(service-kind inprocess and triton). "
+                             "A 'trace=rate:dur+rate:dur+...' spec "
+                             "(optional 'repeat=N') replays a "
+                             "multi-stage diurnal schedule instead "
+                             "of one burst — the autoscale "
+                             "controller's test surface (rate 0 "
+                             "stages are idle gaps)")
     return parser
 
 

@@ -69,6 +69,14 @@ class TpuMetrics:
     replica_redispatch_total: Dict[str, float] = field(
         default_factory=dict)
     replica_exec_us: Dict[str, float] = field(default_factory=dict)
+    # Autoscale-controller families: desired-fleet gauge per model,
+    # decision counters keyed "model|d<direction>|g<reason>", and the
+    # replica-seconds cost counter per model (the number the autoscale
+    # smoke gates against a max-scale-always baseline).
+    replica_desired: Dict[str, float] = field(default_factory=dict)
+    scale_events_total: Dict[str, float] = field(default_factory=dict)
+    replica_seconds_total: Dict[str, float] = field(
+        default_factory=dict)
     # Latency-histogram families (telemetry layer): attr -> series key
     # -> {le_bound: cumulative_count}. Keys are the model (stage
     # histograms append "|s<stage>", tenant histograms use the tenant
@@ -133,6 +141,9 @@ _FAMILIES = {
     "tpu_replica_readmitted_total": "replica_readmitted_total",
     "tpu_replica_redispatch_total": "replica_redispatch_total",
     "tpu_replica_exec_us": "replica_exec_us",
+    "tpu_replica_desired": "replica_desired",
+    "tpu_scale_events_total": "scale_events_total",
+    "tpu_replica_seconds_total": "replica_seconds_total",
     "tpu_stream_responses_total": "stream_responses_total",
     "tpu_kv_pages_used": "kv_pages_used",
     "tpu_kv_pages_total": "kv_pages_total",
@@ -170,6 +181,7 @@ _COUNTER_FAMILIES = frozenset((
     "shed_total", "tenant_success_total", "tenant_rejected_total",
     "replica_ejected_total", "replica_readmitted_total",
     "replica_redispatch_total", "replica_exec_us",
+    "scale_events_total", "replica_seconds_total",
     "stream_responses_total",
     "kv_prefix_hits_total", "prefill_chunks_total",
     "device_busy_us_total", "compile_total",
@@ -258,6 +270,10 @@ def parse_prometheus(text: str) -> TpuMetrics:
             key = "%s|w%s" % (key, labels["window"])
         if "objective" in labels:
             key = "%s|o%s" % (key, labels["objective"])
+        if "direction" in labels:
+            key = "%s|d%s" % (key, labels["direction"])
+        if "reason" in labels:
+            key = "%s|g%s" % (key, labels["reason"])
         try:
             value = float(m.group("value"))
         except ValueError:
@@ -345,7 +361,8 @@ def summarize_metrics(snapshots: List[TpuMetrics]) -> Dict[str, Dict[str, float]
                  "sequence_active", "sequence_backlog",
                  "cache_size_bytes", "cache_entries",
                  "priority_queue_size", "replica_healthy",
-                 "replica_count", "kv_pages_used", "kv_pages_total",
+                 "replica_count", "replica_desired",
+                 "kv_pages_used", "kv_pages_total",
                  "device_duty_cycle"):
         values = []
         for snap in snapshots:
@@ -357,6 +374,22 @@ def summarize_metrics(snapshots: List[TpuMetrics]) -> Dict[str, Dict[str, float]
                 "avg": sum(values) / len(values),
                 "max": max(values),
             }
+    # Gauge-aware window deltas for the fleet-size gauges: how the
+    # value MOVED across the window (signed first-to-last, summed over
+    # models) — avg/max alone cannot show that an autoscaled fleet
+    # grew then shrank back. min tracks the window trough.
+    for attr in ("replica_count", "replica_desired", "replica_healthy"):
+        first: Dict[str, float] = {}
+        last: Dict[str, float] = {}
+        low: Dict[str, float] = {}
+        for snap in snapshots:
+            for key, value in getattr(snap, attr).items():
+                first.setdefault(key, value)
+                last[key] = value
+                low[key] = min(low.get(key, value), value)
+        if last and attr in out:
+            out[attr]["delta"] = sum(last[k] - first[k] for k in last)
+            out[attr]["min"] = sum(low.values())
     # The per-model HBM ledger sums over its (model, component) rows
     # per snapshot — the total attributed bytes is the meaningful
     # aggregate (a mean over rows is not), and its max is the window's

@@ -155,8 +155,35 @@ def print_report(results: List[PerfStatus], percentile: int = 0,
                         parts.append("%s %d" % (label,
                                                 int(window["delta"])))
                 print("    server replicas: %s" % ", ".join(parts))
+            _print_scaling_line(status)
         if not status.on_target:
             print("    WARNING: measurement did not stabilize")
+
+
+def _print_scaling_line(status: PerfStatus) -> None:
+    """The autoscale timeline: replica-seconds consumed, fleet-size
+    movement across the window (gauge-aware delta/min), scale events
+    by direction, and shed decisions with their reasons — rendered
+    only when the controller's families were scraped."""
+    seconds = status.tpu_metrics.get("replica_seconds_total")
+    events = status.tpu_metrics.get("scale_events_total")
+    if not seconds and not events:
+        return
+    parts = []
+    if seconds and seconds.get("delta"):
+        parts.append("replica-seconds %.1f" % seconds["delta"])
+    desired = status.tpu_metrics.get("replica_desired")
+    if desired and desired.get("max"):
+        parts.append("desired peak %.0f / trough %.0f"
+                     % (desired["max"],
+                        desired.get("min", desired["max"])))
+    if events and events.get("delta"):
+        parts.append("%d scale events in window" % int(events["delta"]))
+    sheds = status.tpu_metrics.get("shed_total")
+    if sheds and sheds.get("delta"):
+        parts.append("sheds %d" % int(sheds["delta"]))
+    if parts:
+        print("    server scaling: %s" % ", ".join(parts))
 
 
 def _print_histogram_lines(status: PerfStatus) -> None:

@@ -1,0 +1,477 @@
+"""Per-model autoscale controller — the loop that closes the loop.
+
+Every control signal this module consumes already existed: live queue
+depth (`DynamicBatcher.stats_snapshot` / tpu_queue_size), per-device
+duty cycle (devstats), and the SLO engine's multi-window burn-rate
+verdicts. What was missing was the actuator: a feedback controller
+that reads those signals on a background tick and drives the
+`ReplicaSet` between the `instance_group` autoscale bounds.
+
+Decision ladder, evaluated per model per tick:
+
+* **Scale up** when queue depth per healthy replica exceeds
+  ``queue_high``, device duty cycle exceeds ``duty_high``, or the SLO
+  verdict is unhealthy — bounded by ``max_replicas`` and the
+  ``up_cooldown_s`` hysteresis. The new replica is warmed and
+  canaried through the chaos-injected execution path (the PR-8
+  supervisor readmission flow) BEFORE it enters routing: a sick birth
+  never sees traffic.
+* **Shed directive** when the SLO burns even AT max scale: growing is
+  no longer an option, so a `qos.ShedDirective` is installed on the
+  batcher and lowest-priority arrivals shed at the door (the PR-7
+  watermark path) with a Retry-After derived from the controller's
+  predicted recovery time (queued work / healthy service rate).
+  Cleared the first tick the verdict recovers.
+* **Scale down** when the model is quiet — empty queue, duty below
+  ``duty_low``, fast burn under 1 — sustained past ``down_cooldown_s``;
+  the victim replica drains through the existing routing tail.
+* **Scale to zero** when ``min_replicas == 0`` and the model has been
+  completely idle for ``idle_s``: the model unloads entirely (the HBM
+  ledger shows exactly whose memory frees) and the controller
+  remembers it. The next arrival triggers a transparent cold start —
+  a background reload plus an honest 503 + Retry-After while warming.
+
+Every decision is stamped into the flight recorder twice: as a
+standalone ring record (`record_decision`, the auditable evidence) and
+as an incident stamp on resident traces (`mark_incident`, joining the
+decision to the requests that provoked it), and counted in the
+`tpu_scale_events_total{model,direction,reason}` family next to the
+`tpu_replica_desired{model}` gauge and the
+`tpu_replica_seconds_total{model}` cost counter.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, Optional
+
+from client_tpu.server import devstats as devstats_mod
+from client_tpu.server import qos
+
+_LOG = logging.getLogger("client_tpu.server.autoscale")
+
+# Control-loop pace when the model declares none (interval_s == 0).
+DEFAULT_INTERVAL_S = 1.0
+# Fallback warm-time estimate for the first cold start (no measured
+# load yet); replaced by the measured reload time afterwards.
+DEFAULT_WARM_ESTIMATE_S = 1.0
+# Clamp band for the shed directive's predicted recovery time.
+MIN_RETRY_AFTER_S = 0.1
+MAX_RETRY_AFTER_S = 10.0
+
+
+class _ModelState:
+    """Mutable per-model controller memory (owned by the tick thread;
+    read-only snapshots cross threads under the controller lock)."""
+
+    __slots__ = ("desired", "last_up", "last_down", "idle_since",
+                 "last_inference_count", "last_decision", "last_reason",
+                 "last_decision_ts", "replica_seconds", "events",
+                 "shed", "last_seen")
+
+    def __init__(self) -> None:
+        self.desired = 0
+        self.last_up = 0.0
+        self.last_down = 0.0
+        self.idle_since: Optional[float] = None
+        self.last_inference_count = 0
+        self.last_decision = "none"
+        self.last_reason = ""
+        self.last_decision_ts = 0.0
+        self.replica_seconds = 0.0
+        # (direction, reason) -> cumulative count, feeds
+        # tpu_scale_events_total{model,direction,reason}.
+        self.events: Dict[tuple, int] = {}
+        self.shed = qos.ShedDirective()
+        self.last_seen = 0.0
+
+
+class _ColdModel:
+    """A model the controller scaled to zero: enough memory to answer
+    its next arrival honestly (kick one reload, estimate warm time)."""
+
+    __slots__ = ("warm_estimate_s", "loading", "load_started")
+
+    def __init__(self, warm_estimate_s: float) -> None:
+        self.warm_estimate_s = warm_estimate_s
+        self.loading = False
+        self.load_started = 0.0
+
+
+class AutoscaleController:
+    """Background feedback loop over every autoscale-enabled model.
+
+    Created unconditionally by the core; the thread starts lazily on
+    the first `ensure_started()` (a model with an autoscale block was
+    loaded or touched), so servers without autoscaling pay nothing."""
+
+    def __init__(self, core) -> None:
+        self._core = core
+        self._lock = threading.Lock()
+        self._states: Dict[str, _ModelState] = {}
+        self._cold: Dict[str, _ColdModel] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_tick = 0.0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def ensure_started(self) -> None:
+        with self._lock:
+            if self._thread is not None or self._stop.is_set():
+                return
+            self._thread = threading.Thread(
+                target=self._run, name="autoscale-controller",
+                daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._lock:
+            thread = self._thread
+        if thread is not None:
+            thread.join(timeout=5)
+
+    # -- configuration -----------------------------------------------------
+
+    @staticmethod
+    def config_of(model) -> Optional[dict]:
+        """The model's autoscale knobs, or None when the controller is
+        off for it (max_replicas unset)."""
+        max_replicas = int(getattr(model, "autoscale_max_replicas", 0))
+        if max_replicas <= 0:
+            return None
+        return {
+            "min_replicas": max(
+                int(getattr(model, "autoscale_min_replicas", 0)), 0),
+            "max_replicas": max_replicas,
+            "interval_s": float(
+                getattr(model, "autoscale_interval_s", 0.0))
+            or DEFAULT_INTERVAL_S,
+            "queue_high": float(
+                getattr(model, "autoscale_queue_high", 0.0)),
+            "duty_high": float(
+                getattr(model, "autoscale_duty_high", 0.0)),
+            "duty_low": float(
+                getattr(model, "autoscale_duty_low", 0.0)),
+            "up_cooldown_s": float(
+                getattr(model, "autoscale_up_cooldown_s", 0.0)),
+            "down_cooldown_s": float(
+                getattr(model, "autoscale_down_cooldown_s", 0.0)),
+            "idle_s": float(getattr(model, "autoscale_idle_s", 0.0)),
+        }
+
+    # -- control loop ------------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            interval = DEFAULT_INTERVAL_S
+            try:
+                interval = self.tick_once()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                _LOG.exception("autoscale tick failed")
+            self._stop.wait(max(interval, 0.05))
+
+    def tick_once(self) -> float:
+        """One full evaluation pass over every autoscale-enabled ready
+        model. Returns the next sleep interval (the smallest declared
+        interval among governed models). Public so tests can drive
+        the controller deterministically without the thread."""
+        core = self._core
+        now = time.monotonic()
+        dt = (now - self._last_tick) if self._last_tick else 0.0
+        self._last_tick = now
+        try:
+            duty_by_device = devstats_mod.get().duty_cycle()
+            duty = max(duty_by_device.values()) if duty_by_device else 0.0
+        except Exception:  # noqa: BLE001
+            duty = 0.0
+        verdicts: Dict[str, dict] = {}
+        interval = DEFAULT_INTERVAL_S
+        governed = []
+        for model in core.repository.ready_models():
+            config = self.config_of(model)
+            if config is None:
+                continue
+            governed.append((model, config))
+            interval = min(interval, config["interval_s"])
+        if governed:
+            try:
+                verdicts = core.slo.cached_verdicts(
+                    max_age_s=interval)
+            except Exception:  # noqa: BLE001
+                verdicts = {}
+        for model, config in governed:
+            try:
+                self._tick_model(model.name, config,
+                                 verdicts.get(model.name), duty,
+                                 now, dt)
+            except Exception:  # noqa: BLE001 — one sick model must
+                _LOG.exception(  # not stall the others' control loop
+                    "autoscale tick for '%s' failed", model.name)
+        return interval
+
+    def _tick_model(self, name: str, config: dict,
+                    verdict: Optional[dict], duty: float,
+                    now: float, dt: float) -> None:
+        core = self._core
+        with self._lock:
+            state = self._states.setdefault(name, _ModelState())
+            state.last_seen = now
+        with core._replica_lock:
+            replica_set = core._replica_sets.get(name)
+        with core._batchers_lock:
+            batcher = core._batchers.get(name)
+        pending = 0
+        if batcher is not None:
+            try:
+                pending = int(
+                    batcher.stats_snapshot()["pending_count"])
+            except Exception:  # noqa: BLE001
+                pending = 0
+        snap = replica_set.snapshot() if replica_set else None
+        actual = snap["count"] if snap else 0
+        healthy = snap["healthy"] if snap else 0
+        if dt > 0:
+            # Cost accounting: what the fleet actually consumed this
+            # interval (tpu_replica_seconds_total — the number the
+            # smoke gates against max-scale-always).
+            state.replica_seconds += actual * dt
+        inference_count = core._stats_for(name).inference_count
+        # An unmonitored verdict is unhealthy-by-design for alerting,
+        # but the controller must not chase capacity it cannot
+        # observe: only a MONITORED unhealthy verdict is SLO pressure.
+        slo_pressure = bool(verdict
+                            and verdict.get("monitored", True)
+                            and not verdict["healthy"])
+        fast_burn = (verdict["burn"]["fast"] if verdict else 0.0)
+        state.desired = max(actual, config["min_replicas"]) \
+            if actual else state.desired
+
+        # -- idle tracking (scale-to-zero arm) ---------------------------
+        busy = pending > 0 \
+            or inference_count != state.last_inference_count
+        state.last_inference_count = inference_count
+        if busy:
+            state.idle_since = None
+        elif state.idle_since is None:
+            state.idle_since = now
+
+        # -- scale up ----------------------------------------------------
+        reason = None
+        if replica_set is not None and actual < config["max_replicas"]:
+            if config["queue_high"] > 0 \
+                    and pending > config["queue_high"] * max(healthy, 1):
+                reason = "queue_depth"
+            elif config["duty_high"] > 0 and duty > config["duty_high"]:
+                reason = "duty_cycle"
+            elif slo_pressure:
+                reason = "slo_burn"
+            if reason is not None \
+                    and now - state.last_up >= config["up_cooldown_s"]:
+                state.desired = actual + 1
+                state.last_up = now
+                if replica_set.scale_up():
+                    self._decide(state, name, "up", reason,
+                                 {"from": actual, "to": actual + 1,
+                                  "pending": pending,
+                                  "duty": round(duty, 3),
+                                  "fast_burn": round(fast_burn, 3)})
+                else:
+                    # Canary rejected the prospect (or the set was
+                    # stopping): the fleet is unchanged and the audit
+                    # trail must say a grow was attempted and why it
+                    # did not land.
+                    state.desired = actual
+                    self._decide(state, name, "up", "canary_rejected",
+                                 {"from": actual, "to": actual,
+                                  "wanted": reason})
+                return
+
+        # -- shed directive (SLO unmeetable at max scale) ----------------
+        if replica_set is not None and slo_pressure \
+                and actual >= config["max_replicas"]:
+            retry_after = self._predicted_recovery_s(snap, pending)
+            directive = qos.ShedDirective(
+                active=True, retry_after_s=retry_after,
+                reason="slo unmeetable at max scale %d"
+                % config["max_replicas"],
+                since=state.shed.since or time.time())
+            first = not state.shed.active
+            state.shed = directive
+            if batcher is not None:
+                batcher.set_shed_directive(directive)
+            if first:
+                self._decide(state, name, "shed", "slo_unmeetable",
+                             {"retry_after_s": round(retry_after, 3),
+                              "at_scale": actual})
+            return
+        if state.shed.active and not slo_pressure:
+            state.shed = qos.ShedDirective()
+            if batcher is not None:
+                batcher.set_shed_directive(None)
+            self._decide(state, name, "shed_clear", "slo_recovered", {})
+
+        # -- scale down / scale to zero ----------------------------------
+        quiet = (pending == 0 and fast_burn < 1.0
+                 and (config["duty_low"] <= 0
+                      or duty < config["duty_low"]))
+        if not quiet:
+            return
+        floor = max(config["min_replicas"], 1)
+        cooldown_ok = (
+            now - state.last_down >= config["down_cooldown_s"]
+            and now - state.last_up >= config["down_cooldown_s"])
+        if replica_set is not None and actual > floor and cooldown_ok:
+            state.desired = actual - 1
+            state.last_down = now
+            if replica_set.scale_down():
+                self._decide(state, name, "down", "quiet",
+                             {"from": actual, "to": actual - 1})
+            return
+        if (config["min_replicas"] == 0 and config["idle_s"] > 0
+                and state.idle_since is not None
+                and now - state.idle_since >= config["idle_s"]
+                and cooldown_ok):
+            self._scale_to_zero(name, state, config)
+
+    def _predicted_recovery_s(self, snap: Optional[dict],
+                              pending: int) -> float:
+        """Queued work over the healthy fleet's service rate: the
+        honest Retry-After a shed response carries."""
+        if not snap:
+            return MIN_RETRY_AFTER_S
+        replicas = snap.get("replicas") or []
+        latencies = [r["ewma_latency_ms"] / 1000.0
+                     for r in replicas if r["ewma_latency_ms"] > 0]
+        mean_latency = (sum(latencies) / len(latencies)) \
+            if latencies else 0.05
+        healthy = max(snap.get("healthy", 1), 1)
+        predicted = (pending + 1) * mean_latency / healthy
+        return min(max(predicted, MIN_RETRY_AFTER_S),
+                   MAX_RETRY_AFTER_S)
+
+    # -- scale to zero / cold start ----------------------------------------
+
+    def _scale_to_zero(self, name: str, state: _ModelState,
+                       config: dict) -> None:
+        core = self._core
+        state.desired = 0
+        state.last_down = time.monotonic()
+        started = time.monotonic()
+        try:
+            core.unload_model(name)
+        except Exception:  # noqa: BLE001
+            _LOG.exception("scale-to-zero unload of '%s' failed", name)
+            return
+        # The drain time is a decent first warm-time estimate (load
+        # and unload both walk the executable); measured reload time
+        # replaces it after the first cold start.
+        estimate = max(time.monotonic() - started,
+                       DEFAULT_WARM_ESTIMATE_S)
+        with self._lock:
+            self._cold[name] = _ColdModel(estimate)
+        self._decide(state, name, "down", "scale_to_zero",
+                     {"idle_s": round(config["idle_s"], 3),
+                      "warm_estimate_s": round(estimate, 3)})
+
+    def on_admission_miss(self, name: str) -> Optional[float]:
+        """Cold-start hook: ``core.infer`` calls this when acquire
+        fails for a model. For a model THIS controller scaled to zero
+        it kicks exactly one background reload and returns the honest
+        Retry-After (remaining warm time) the 503 should carry; for
+        anything else it returns None and the original error stands."""
+        with self._lock:
+            cold = self._cold.get(name)
+            if cold is None:
+                return None
+            now = time.monotonic()
+            if not cold.loading:
+                cold.loading = True
+                cold.load_started = now
+                thread = threading.Thread(
+                    target=self._cold_start, args=(name,),
+                    name="autoscale-coldstart-%s" % name, daemon=True)
+                thread.start()
+            remaining = cold.warm_estimate_s - (now - cold.load_started)
+        return max(remaining, MIN_RETRY_AFTER_S)
+
+    def _cold_start(self, name: str) -> None:
+        core = self._core
+        started = time.monotonic()
+        try:
+            core.load_model(name)
+        except Exception:  # noqa: BLE001
+            _LOG.exception("cold start of '%s' failed", name)
+            with self._lock:
+                cold = self._cold.get(name)
+                if cold is not None:
+                    # Re-arm: the next arrival may retry the load
+                    # (a transient factory failure must not strand
+                    # the model cold forever).
+                    cold.loading = False
+            return
+        warm_s = time.monotonic() - started
+        with self._lock:
+            self._cold.pop(name, None)
+            state = self._states.get(name)
+        if state is not None:
+            state.desired = 1
+            self._decide(state, name, "up", "cold_start",
+                         {"warm_s": round(warm_s, 3)})
+
+    # -- audit + exposition ------------------------------------------------
+
+    def _decide(self, state: _ModelState, name: str, direction: str,
+                reason: str, attrs: dict) -> None:
+        """One decision = one flight ring record + one incident stamp
+        + one event counter bump + the /v2/debug last-decision row."""
+        state.last_decision = direction
+        state.last_reason = reason
+        state.last_decision_ts = time.time()
+        key = (direction, reason)
+        with self._lock:
+            state.events[key] = state.events.get(key, 0) + 1
+        label = "autoscale_%s reason=%s" % (direction, reason)
+        core = self._core
+        try:
+            core.flight.record_decision(name, label, attrs)
+            core.flight.mark_incident(name, label)
+        except Exception:  # noqa: BLE001 — audit is advisory
+            pass
+        _LOG.info("autoscale decision model=%s direction=%s reason=%s "
+                  "%s", name, direction, reason, attrs)
+
+    def snapshot(self) -> Dict[str, dict]:
+        """Per-model controller state for /v2/debug's ``controller``
+        section and the tpu_replica_desired / tpu_scale_events_total /
+        tpu_replica_seconds_total families."""
+        core = self._core
+        out: Dict[str, dict] = {}
+        with self._lock:
+            states = dict(self._states)
+            cold = {name: c.warm_estimate_s
+                    for name, c in self._cold.items()}
+        for name, state in states.items():
+            with core._replica_lock:
+                replica_set = core._replica_sets.get(name)
+            actual = replica_set.count if replica_set else 0
+            out[name] = {
+                "desired": state.desired,
+                "actual": actual,
+                "last_decision": state.last_decision,
+                "last_reason": state.last_reason,
+                "last_decision_ts": state.last_decision_ts,
+                "replica_seconds": round(state.replica_seconds, 3),
+                "events": {"%s|%s" % k: v
+                           for k, v in state.events.items()},
+                "shed": {
+                    "active": state.shed.active,
+                    "retry_after_s": state.shed.retry_after_s,
+                    "reason": state.shed.reason,
+                },
+                "cold": name in cold,
+            }
+        return out

@@ -374,6 +374,12 @@ class DynamicBatcher:
         self._priority_policies = dict(priority_policies or {})
         self._shed_watermark = min(max(float(shed_watermark), 0.0), 1.0)
         self._shed_hook = shed_hook
+        # Controller-ordered shed (qos.ShedDirective, set by the
+        # autoscale loop when the SLO is unmeetable at max scale):
+        # while active, lowest-class arrivals shed at the door with
+        # the directive's predicted-recovery Retry-After — depth-
+        # independent, unlike the watermark gate below it.
+        self._shed_directive = None
         self._pending_by_priority: Dict[int, int] = {}
         # Queue policy (Triton ModelQueuePolicy semantics):
         # max_queue_size bounds total pending requests (0 = unbounded;
@@ -607,8 +613,12 @@ class DynamicBatcher:
 
     def _admit_locked(self, pending: _Pending) -> None:
         """Queue-policy admission for one request (caller holds the
-        lock). Three gates, cheapest first:
+        lock). Four gates, cheapest first:
 
+        0. Autoscale shed directive — while the controller says the
+           SLO is unmeetable at max scale, lowest-class arrivals shed
+           at the door regardless of queue depth, carrying the
+           controller's predicted-recovery Retry-After.
         1. Per-priority max_queue_size (ModelQueuePolicy override) —
            a class over its own bound is rejected even when the global
            queue has room, so one class cannot monopolize the queue.
@@ -623,6 +633,21 @@ class DynamicBatcher:
            is rejected. This is what keeps priority-1 goodput at 100%
            while bulk saturates the queue."""
         priority = pending.priority
+        directive = self._shed_directive
+        if (directive is not None and directive.active
+                and self._priority_levels
+                and priority == self._priority_levels):
+            # Gate 0 — controller-ordered shed: the autoscale loop
+            # determined the SLO is unmeetable even at max scale, so
+            # lowest-class arrivals shed immediately (not at the
+            # watermark) with the controller's predicted recovery as
+            # the Retry-After.
+            self._hook(self._shed_hook, priority)
+            error = self._over_capacity_error(
+                "shed by autoscale directive (%s)"
+                % (directive.reason or "slo unmeetable at max scale"))
+            error.retry_after_s = max(directive.retry_after_s, 0.05)
+            raise error
         policy = self._priority_policies.get(priority)
         if policy and policy.get("max_queue_size"):
             if self._pending_by_priority.get(priority, 0) \
@@ -1300,6 +1325,19 @@ class DynamicBatcher:
             "overlap_ratio": (overlap_ns / fetch_ns) if fetch_ns else 0.0,
             "pending_by_priority": by_priority,
         }
+
+    def set_shed_directive(self, directive) -> None:
+        """Installs/clears the controller's shed order (a
+        qos.ShedDirective or None). Reference assignment only — the
+        admission path reads it without extra locking, so a directive
+        object is never mutated after install (the controller swaps
+        in a fresh instance per decision)."""
+        with self._cv:
+            self._shed_directive = directive
+
+    def shed_directive(self):
+        """The active qos.ShedDirective, or None (for /v2/debug)."""
+        return self._shed_directive
 
     def debug_snapshot(self) -> dict:
         """The /v2/debug queue view: per-shape-bucket depth segmented

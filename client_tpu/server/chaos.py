@@ -310,18 +310,30 @@ class OverloadScenario:
     ``rate=500,after_s=1,duration_s=3,workers=8,seed=11`` — rate is
     target submissions/sec across all workers (0 = as fast as the
     closed loops can go). Timings are relative to :meth:`start`.
+
+    **Diurnal/trace mode**: ``trace=50:2+500:3+50:2,repeat=2`` replays
+    a repeating multi-stage Poisson schedule — each ``rate:duration_s``
+    stage paces arrivals at that rate for that long (rate 0 = idle
+    stage), the whole schedule ``repeat`` times. This is the 10x load
+    swing the autoscale bench replays; ``rate``/``duration_s`` are
+    ignored while a trace is set (``after_s`` still delays the start).
     """
 
     def __init__(self, submit_fn, rate: float = 0.0,
                  burst_after_s: float = 0.0,
                  burst_duration_s: float = 3.0,
-                 workers: int = 8, seed: int = 11):
+                 workers: int = 8, seed: int = 11,
+                 trace=None, repeat: int = 1):
         self.submit_fn = submit_fn
         self.rate = max(float(rate), 0.0)
         self.burst_after_s = max(float(burst_after_s), 0.0)
         self.burst_duration_s = max(float(burst_duration_s), 0.0)
         self.workers = max(int(workers), 1)
         self.seed = seed
+        # [(rate, duration_s), ...] or None — see class docstring.
+        self.trace = [(max(float(r), 0.0), max(float(d), 0.0))
+                      for r, d in (trace or [])] or None
+        self.repeat = max(int(repeat), 1)
         self.submitted = 0
         self.rejected = 0
         self._lock = threading.Lock()
@@ -354,6 +366,18 @@ class OverloadScenario:
                 kwargs["workers"] = int(value)
             elif key == "seed":
                 kwargs["seed"] = int(value)
+            elif key == "trace":
+                stages = []
+                for stage in value.split("+"):
+                    rate_s, sep2, dur_s = stage.partition(":")
+                    if not sep2:
+                        raise ValueError(
+                            "overload trace stage '%s' is not "
+                            "rate:duration_s" % stage)
+                    stages.append((float(rate_s), float(dur_s)))
+                kwargs["trace"] = stages
+            elif key == "repeat":
+                kwargs["repeat"] = int(value)
             else:
                 raise ValueError("unknown overload spec key '%s'" % key)
         return kwargs
@@ -376,8 +400,28 @@ class OverloadScenario:
         if self._stop.wait(self.burst_after_s):
             return
         self.started.set()
-        deadline = time.monotonic() + self.burst_duration_s
-        per_worker_rate = self.rate / self.workers if self.rate else 0.0
+        if self.trace is not None:
+            # Diurnal replay: each (rate, duration) stage in order,
+            # the whole schedule `repeat` times.
+            for _cycle in range(self.repeat):
+                for rate, duration_s in self.trace:
+                    self._stage(rng, rate, duration_s)
+                    if self._stop.is_set():
+                        return
+        else:
+            self._stage(rng, self.rate, self.burst_duration_s)
+            if self._stop.is_set():
+                return
+        self.finished.set()
+
+    def _stage(self, rng, rate: float, duration_s: float) -> None:
+        """One constant-rate Poisson stage (rate 0 in trace mode =
+        idle: wait the stage out without submitting)."""
+        deadline = time.monotonic() + duration_s
+        per_worker_rate = rate / self.workers if rate else 0.0
+        if rate == 0.0 and self.trace is not None:
+            self._stop.wait(duration_s)
+            return
         while not self._stop.is_set() and time.monotonic() < deadline:
             try:
                 self.submit_fn()
@@ -394,7 +438,7 @@ class OverloadScenario:
                 pause = rng.expovariate(per_worker_rate)
                 if self._stop.wait(min(pause, 1.0)):
                     return
-        self.finished.set()
+        return
 
     def stop(self) -> None:
         """Cancel the burst (or wait out stragglers) and join."""

@@ -19,7 +19,9 @@ from typing import Dict, Iterator, Optional
 
 import numpy as np
 
+from client_tpu import status_map
 from client_tpu.protocol import inference_pb2 as pb
+from client_tpu.server import autoscale
 from client_tpu.server import cache as cache_mod
 from client_tpu.server import chaos
 from client_tpu.server import devstats as devstats_mod
@@ -423,6 +425,13 @@ class InferenceServerCore:
         # flight ring like SLO burns and breaker trips do.
         self.devstats = devstats_mod.get()
         self.devstats.add_incident_hook(self.flight.mark_incident)
+        # Autoscale controller (client_tpu.server.autoscale): the
+        # feedback loop that resizes ReplicaSets between the
+        # instance_group autoscale bounds, scales idle models to zero,
+        # and feeds shed directives back into admission. Its thread
+        # starts lazily the first time an autoscale-enabled model is
+        # loaded — servers without the config block pay nothing.
+        self.autoscaler = autoscale.AutoscaleController(self)
         # Start stamps: tpu_server_info's uptime value (a scrape-level
         # restart detector) and the /v2/debug server section.
         self._started_wall = time.time()
@@ -1008,6 +1017,32 @@ class InferenceServerCore:
                "Cumulative successful execution time per replica",
                exec_rows)
 
+        desired_rows, scale_event_rows, replica_second_rows = [], [], []
+        for name, entry in sorted(self.autoscaler.snapshot().items()):
+            label = '{model="%s"}' % name
+            desired_rows.append("tpu_replica_desired%s %d"
+                                % (label, entry["desired"]))
+            replica_second_rows.append(
+                "tpu_replica_seconds_total%s %.3f"
+                % (label, entry["replica_seconds"]))
+            for key, count in sorted(entry["events"].items()):
+                direction, reason = key.split("|", 1)
+                scale_event_rows.append(
+                    'tpu_scale_events_total{model="%s",direction="%s"'
+                    ',reason="%s"} %d'
+                    % (name, direction, reason, count))
+        family("tpu_replica_desired", "gauge",
+               "Replicas the autoscale controller currently wants per "
+               "model (actual converges via canaried scale-up / "
+               "drained scale-down)", desired_rows)
+        family("tpu_scale_events_total", "counter",
+               "Autoscale decisions per model by direction (up/down/"
+               "shed/shed_clear) and reason", scale_event_rows)
+        family("tpu_replica_seconds_total", "counter",
+               "Replica-seconds consumed per model (fleet size "
+               "integrated over time — the autoscaler's cost metric)",
+               replica_second_rows)
+
         kv_used_rows, kv_total_rows = [], []
         kv_hit_rows, prefill_rows = [], []
         for model in self.repository.ready_models():
@@ -1113,6 +1148,11 @@ class InferenceServerCore:
             "slo": {},
             "flight": {},
             "chaos": chaos.stats(),
+            "controller": {
+                name: entry
+                for name, entry in self.autoscaler.snapshot().items()
+                if wanted(name)
+            },
         }
         try:
             # Device axis: HBM ledger rows, busy/duty per device,
@@ -1402,6 +1442,8 @@ class InferenceServerCore:
             measure.model = model
             if warmup:
                 model.warmup()
+        if autoscale.AutoscaleController.config_of(model) is not None:
+            self.autoscaler.ensure_started()
 
     def unload_model(self, name: str) -> None:
         # Graceful drain ordering: (1) shed NEW requests (503/
@@ -1454,6 +1496,9 @@ class InferenceServerCore:
         the tail of every trace file (Triton flushes on trace-file
         close)."""
         self.ready = False
+        # The controller first: a resize racing the teardown below
+        # would re-create queues the drain already stopped.
+        self.autoscaler.stop()
         with self._sequencers_lock:
             sequencers, self._sequencers = dict(self._sequencers), {}
         for sequencer in sequencers.values():
@@ -1828,8 +1873,19 @@ class InferenceServerCore:
                 model = self.repository.acquire(request.model_name,
                                                 request.model_version)
             except InferenceServerException as e:
+                # Transparent cold start: a model the autoscale
+                # controller scaled to zero is not "unknown" — the
+                # first arrival kicks exactly one background reload
+                # and is told honestly how long warming will take.
+                retry = self.autoscaler.on_admission_miss(
+                    request.model_name)
+                if retry is not None:
+                    e = status_map.retryable_error(
+                        "model '%s' is cold-starting (was scaled to "
+                        "zero while idle); warming now"
+                        % request.model_name, retry_after_s=retry)
                 self._flight_admission_reject(request, trace_context, e)
-                raise
+                raise e
             admission.model_name = model.name
             try:
                 response = self._infer_admitted(model, request,

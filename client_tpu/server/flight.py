@@ -302,6 +302,45 @@ class FlightRecorder:
             ring.bytes -= dropped_bytes
             ring.overwritten_total += 1
 
+    # -- control-plane decisions -------------------------------------------
+
+    def record_decision(self, model_name: str, label: str,
+                        attrs: Optional[dict] = None) -> bool:
+        """Appends a standalone control-plane record (autoscale
+        resize, shed directive, scale-to-zero) to the model's ring.
+        Unlike ``mark_incident`` — which stamps records already
+        resident and is a no-op on an empty ring — a decision is its
+        own evidence: the post-incident audit must show every scaling
+        move even when no request trace happened to be kept around
+        it. Returns False when disabled or the record was oversized."""
+        if not self.enabled:
+            return False
+        model_name = str(model_name)[:MAX_NAME_CHARS]
+        record = {
+            "model": model_name,
+            "reason": "decision",
+            "decision": str(label)[:MAX_ERROR_CHARS],
+            "attrs": attrs or {},
+            "ts": time.time(),
+            "incidents": [],
+        }
+        nbytes = len(json.dumps(record, separators=(",", ":"),
+                                default=str)) + 64
+        with self._lock:
+            ring = self._rings.get(model_name)
+            if ring is None:
+                if len(self._rings) >= MAX_RINGS:
+                    model_name = OVERFLOW_RING
+                ring = self._rings.setdefault(model_name, _ModelRing())
+            if nbytes > self.max_bytes:
+                ring.oversized_total += 1
+                return False
+            ring.entries.append((record, nbytes))
+            ring.bytes += nbytes
+            ring.kept_total += 1
+            self._evict_over_budget(ring)
+        return True
+
     # -- incident stamping -------------------------------------------------
 
     def mark_incident(self, model_name: str, label: str) -> int:

@@ -142,6 +142,26 @@ class ServedModel:
     replica_watchdog_us: int = 0
     replica_failure_threshold: int = 0
     replica_recovery_s: float = 0.0
+    # Autoscaling (client_tpu.server.autoscale, rendered in the
+    # instance_group `autoscale` block): the per-model feedback
+    # controller resizes the ReplicaSet between min/max replicas.
+    # autoscale_max_replicas 0 (default) disables the controller;
+    # min_replicas 0 with a nonzero idle window allows scale-to-zero
+    # (the model unloads entirely when idle and cold-starts on the
+    # next arrival with an honest Retry-After). queue_high is the
+    # pending-per-healthy-replica depth that triggers growth;
+    # duty_high/duty_low are device duty-cycle bands; the cooldowns
+    # are the hysteresis floor between consecutive resizes in each
+    # direction. interval_s paces the control loop (0 = 1s default).
+    autoscale_min_replicas: int = 0
+    autoscale_max_replicas: int = 0
+    autoscale_interval_s: float = 0.0
+    autoscale_queue_high: float = 0.0
+    autoscale_duty_high: float = 0.0
+    autoscale_duty_low: float = 0.0
+    autoscale_up_cooldown_s: float = 0.0
+    autoscale_down_cooldown_s: float = 0.0
+    autoscale_idle_s: float = 0.0
     # Service-level objectives (client_tpu.server.slo, rendered in the
     # ModelConfig `slo` block): 0 = objective not declared. The SLO
     # engine computes error-budget burn rate per objective over
@@ -251,9 +271,20 @@ class ServedModel:
                 "tpu": mc.ModelInstanceConfig.KIND_TPU,
             }.get(str(self.instance_group_kind).lower(),
                   mc.ModelInstanceConfig.KIND_AUTO)
-            config.instance_group.add(
+            group = config.instance_group.add(
                 name="%s_0" % self.name, kind=kind,
                 count=self.instance_group_count)
+            if self.autoscale_max_replicas > 0:
+                auto = group.autoscale
+                auto.min_replicas = self.autoscale_min_replicas
+                auto.max_replicas = self.autoscale_max_replicas
+                auto.interval_s = self.autoscale_interval_s
+                auto.queue_high = self.autoscale_queue_high
+                auto.duty_high = self.autoscale_duty_high
+                auto.duty_low = self.autoscale_duty_low
+                auto.up_cooldown_s = self.autoscale_up_cooldown_s
+                auto.down_cooldown_s = self.autoscale_down_cooldown_s
+                auto.idle_s = self.autoscale_idle_s
         if self.dynamic_batching:
             config.dynamic_batching.preferred_batch_size.extend(
                 self.preferred_batch_sizes)

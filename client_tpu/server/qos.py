@@ -41,6 +41,7 @@ in-flight cap; 0 disables that knob for the tenant.
 
 from __future__ import annotations
 
+import dataclasses
 import threading
 import time
 from typing import Dict, Optional
@@ -60,6 +61,25 @@ ANONYMOUS_TENANT = "anonymous"
 # further new identities share one overflow bucket.
 MAX_TRACKED_TENANTS = 1024
 OVERFLOW_TENANT = "overflow"
+
+
+@dataclasses.dataclass
+class ShedDirective:
+    """An admission-coupled shed order from the autoscale controller.
+
+    Raised when a model's SLO is burning even at max replica scale:
+    growing capacity is no longer an option, so the lowest priority
+    class sheds AT THE DOOR (the PR-7 watermark path) instead of
+    queueing work the fleet cannot absorb. ``retry_after_s`` is the
+    controller's predicted recovery time — an honest Retry-After the
+    shed response carries so well-behaved clients pace their return
+    instead of hammering a saturated fleet. Cleared (``active=False``)
+    the first tick the verdict recovers."""
+
+    active: bool = False
+    retry_after_s: float = 0.0
+    reason: str = ""
+    since: float = 0.0
 
 
 def coerce_int(value) -> int:

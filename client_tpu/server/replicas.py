@@ -221,6 +221,11 @@ class ReplicaSet:
         self.redispatches = 0
         self.watchdog_trips = 0
         self.probes = 0
+        # Dynamic-resize lifecycle (driven by the autoscale
+        # controller; see scale_up/scale_down below).
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.canary_rejects = 0
         self.replicas: List[_Replica] = []
         for index in range(self.count):
             instance = model if index == 0 else self._new_instance()
@@ -230,6 +235,10 @@ class ReplicaSet:
             self._start_queue(replica)
             self._register_ledger(replica, instance)
             self.replicas.append(replica)
+        # Indexes are never reused across resizes: a drained replica's
+        # index (and its metric series, sticky pins, chaos target ids)
+        # dies with it, so list POSITION is not index — lookups scan.
+        self._next_index = self.count
         self.proxy = ReplicatedModel(self)
         self._stopping = False
         self._stop = threading.Event()
@@ -298,10 +307,11 @@ class ReplicaSet:
         finish (hung queues are abandoned, not joined)."""
         with self._lock:
             self._stopping = True
+            replicas = list(self.replicas)
         self._stop.set()
         self._supervisor.join(timeout=5)
         ledger = devstats_mod.get().ledger
-        for replica in self.replicas:
+        for replica in replicas:
             ledger.release(replica.ledger_row)
             replica.ledger_row = None
             executor = replica.executor
@@ -309,6 +319,102 @@ class ReplicaSet:
                 # A hung replica's worker can never finish: wait only
                 # for healthy queues, abandon the rest.
                 executor.shutdown(wait=not replica.hung)
+
+    # -- dynamic resize (autoscale controller) ---------------------------
+
+    def scale_up(self) -> bool:
+        """Admits ONE new replica — but only after it proves itself.
+        The fresh executable is built and warmed off the routing path,
+        then canaried through the full chaos-injected execution path
+        (the same probe the supervisor's readmission flow runs), and
+        only a passing canary enters routing. A sick birth (chaos
+        targeting the new index, a poisoned factory) costs nothing but
+        the probe: serving traffic never sees the replica."""
+        with self._lock:
+            if self._stopping:
+                return False
+            index = self._next_index
+            self._next_index += 1
+        instance = self._new_instance()  # warmed before routing
+        replica = _Replica(index, instance, CircuitBreaker(
+            failure_threshold=self._failure_threshold,
+            reset_timeout_s=self._recovery_s))
+        self._start_queue(replica)
+        self._register_ledger(replica, instance)
+        with self._lock:
+            self.probes += 1
+        try:
+            future = replica.executor.submit(
+                self._run_on, replica, self._canary_inputs(), {})
+            future.result(timeout=self._watchdog_s)
+            ok = True
+        except Exception:  # noqa: BLE001 — any canary failure = reject
+            ok = False
+        admitted = False
+        if ok:
+            with self._lock:
+                if not self._stopping:
+                    self.replicas.append(replica)
+                    self.count = len(self.replicas)
+                    self.scale_ups += 1
+                    admitted = True
+        if admitted:
+            self._notify("scale_up replica=%d" % index)
+            _LOG.info("replica %s:%d admitted by scale-up (canary "
+                      "passed)", self.name, index)
+            return True
+        # Rejected (or lost the race with stop()): tear the prospect
+        # down completely — queue, ledger row, and all.
+        devstats_mod.get().ledger.release(replica.ledger_row)
+        replica.ledger_row = None
+        replica.executor.shutdown(wait=False)
+        if not ok:
+            with self._lock:
+                self.canary_rejects += 1
+            self._notify("scale_up_canary_rejected replica=%d" % index)
+            _LOG.warning("replica %s:%d rejected by scale-up canary — "
+                         "kept out of rotation", self.name, index)
+        return False
+
+    def scale_down(self, drain_timeout_s: float = 5.0) -> bool:
+        """Drains ONE replica out through the routing tail: the victim
+        (an already-unhealthy replica if any — shedding a sick domain
+        is free — else the newest) leaves routing immediately, its
+        sticky pins release so sequences re-pin, in-flight executions
+        finish normally, and only then do its device queue and ledger
+        row die. Refuses to drain the last replica (that is the
+        model-level scale-to-zero path, owned by the controller)."""
+        with self._lock:
+            if self._stopping or len(self.replicas) <= 1:
+                return False
+            victim = next((r for r in reversed(self.replicas)
+                           if not r.healthy()), None)
+            if victim is None:
+                victim = max(self.replicas, key=lambda r: r.index)
+            self.replicas.remove(victim)
+            self.count = len(self.replicas)
+            self.scale_downs += 1
+            for key in [k for k, idx in self._sticky.items()
+                        if idx == victim.index]:
+                del self._sticky[key]
+        # Bounded drain OUTSIDE the lock: waiters already executing on
+        # the victim get their results; nothing new routes to it.
+        deadline = time.monotonic() + max(drain_timeout_s, 0.0)
+        while time.monotonic() < deadline:
+            with self._lock:
+                busy = victim.outstanding
+            if busy <= 0:
+                break
+            time.sleep(0.01)
+        devstats_mod.get().ledger.release(victim.ledger_row)
+        victim.ledger_row = None
+        executor = victim.executor
+        if executor is not None:
+            executor.shutdown(wait=not victim.hung)
+        self._notify("scale_down replica=%d" % victim.index)
+        _LOG.info("replica %s:%d drained out by scale-down",
+                  self.name, victim.index)
+        return True
 
     # -- routing ---------------------------------------------------------
 
@@ -332,8 +438,9 @@ class ReplicaSet:
             if sticky_key is not None:
                 pinned = self._sticky.get(sticky_key)
                 if pinned is not None and pinned not in exclude:
-                    replica = self.replicas[pinned]
-                    if replica.healthy():
+                    replica = next((r for r in self.replicas
+                                    if r.index == pinned), None)
+                    if replica is not None and replica.healthy():
                         return replica
             candidates = [r for r in self.replicas
                           if r.index not in exclude and r.healthy()]
@@ -548,7 +655,9 @@ class ReplicaSet:
     def _supervise(self) -> None:
         interval = max(min(self._recovery_s / 2.0, 0.5), 0.05)
         while not self._stop.wait(interval):
-            for replica in self.replicas:
+            with self._lock:
+                fleet = list(self.replicas)
+            for replica in fleet:
                 if self._stop.is_set():
                     return
                 if replica.healthy():
@@ -678,5 +787,8 @@ class ReplicaSet:
                 "redispatches": self.redispatches,
                 "watchdog_trips": self.watchdog_trips,
                 "probes": self.probes,
+                "scale_ups": self.scale_ups,
+                "scale_downs": self.scale_downs,
+                "canary_rejects": self.canary_rejects,
                 "replicas": replicas,
             }

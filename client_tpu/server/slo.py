@@ -174,6 +174,8 @@ class SloEngine:
         # always has a baseline).
         self._samples: Dict[str, List[SloSample]] = {}
         self._healthy: Dict[str, bool] = {}
+        # (ts, verdicts) of the last evaluate() — see cached_verdicts.
+        self._last_verdicts: tuple = (0.0, None)
 
     # -- sampling ----------------------------------------------------------
 
@@ -361,7 +363,23 @@ class SloEngine:
                         % (burns["fast"], burns["slow"]))
                 except Exception:  # noqa: BLE001 — stamping is advisory
                     pass
+        with self._lock:
+            self._last_verdicts = (now, out)
         return out
+
+    def cached_verdicts(self, max_age_s: float = 1.0) -> Dict[str, dict]:
+        """The last ``evaluate()`` result when it is at most
+        ``max_age_s`` old, else a fresh evaluation. The autoscale
+        controller and the metrics scrape both want verdicts every
+        tick; sharing one collect between near-simultaneous callers
+        halves the per-model statistics walks without letting either
+        consumer act on stale burn rates."""
+        now = self._now()
+        with self._lock:
+            ts, cached = self._last_verdicts
+            if cached is not None and (now - ts) <= max_age_s:
+                return cached
+        return self.evaluate()
 
     # -- exposition --------------------------------------------------------
 

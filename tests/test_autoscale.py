@@ -1,0 +1,395 @@
+"""Autoscale controller tests (client_tpu.server.autoscale).
+
+Covers the PR-17 tentpole end to end with a hand-driven control loop
+(the background thread is stopped so every test tick is
+deterministic): queue-pressure scale-up through the canaried
+admission path, quiet scale-down through the routing-tail drain, the
+scale-to-zero round trip (HBM ledger rows release, cold start answers
+503 + honest Retry-After, then serves), canary rejection of a
+chaos-poisoned prospect without disturbing serving, the
+admission-coupled shed directive, the chaos OverloadScenario
+diurnal-trace mode, and the /v2/debug ``controller`` section +
+flight-ring decision records the acceptance criteria audit."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from client_tpu._infer_common import InferInput
+from client_tpu.grpc._utils import get_inference_request
+from client_tpu.models.add_sub import AddSub
+from client_tpu.server import chaos
+from client_tpu.server import devstats as devstats_mod
+from client_tpu.server import flight as flightrec
+from client_tpu.server import qos
+from client_tpu.server.app import build_core
+from client_tpu.utils import InferenceServerException
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos.configure(None)
+    yield
+    chaos.configure(None)
+
+
+def _request(value, model, shape=(1, 16), **kwargs):
+    tensors = []
+    for name, fill in (("INPUT0", value), ("INPUT1", 2 * value)):
+        tensor = InferInput(name, list(shape), "INT32")
+        tensor.set_data_from_numpy(np.full(shape, fill, dtype=np.int32))
+        tensors.append(tensor)
+    return get_inference_request(model_name=model, inputs=tensors,
+                                 outputs=None, **kwargs)
+
+
+def _wait_for(predicate, timeout_s=8.0, interval_s=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval_s)
+    return predicate()
+
+
+def _slow_autoscale_factory(name, delay_s=0.02, max_replicas=3):
+    def factory():
+        model = AddSub(name=name, datatype="INT32", shape=(16,))
+        model.max_batch_size = 4
+        model.dynamic_batching = True
+        model.preferred_batch_sizes = [4]
+        model.max_queue_delay_us = 500
+        model.max_queue_size = 64
+        model.instance_group_count = 1
+        model.instance_group_kind = "cpu"
+        model.replica_failure_threshold = 3
+        model.replica_recovery_s = 0.5
+        model.autoscale_min_replicas = 1
+        model.autoscale_max_replicas = max_replicas
+        model.autoscale_interval_s = 0.05
+        model.autoscale_queue_high = 1.0
+        model.autoscale_up_cooldown_s = 0.0
+        model.autoscale_down_cooldown_s = 0.0
+
+        original_infer = model.infer
+
+        def slow_infer(inputs, parameters=None):
+            time.sleep(delay_s)
+            return original_infer(inputs, parameters)
+
+        model.infer = slow_infer
+        return model
+    return factory
+
+
+# -- config plumbing -------------------------------------------------------
+
+
+def test_autoscale_block_renders_in_config_pb():
+    core = build_core(["simple_autoscale"], warmup=False)
+    try:
+        config = core.repository.get("simple_autoscale").config_pb()
+        auto = config.instance_group[0].autoscale
+        assert auto.max_replicas == 4
+        assert auto.min_replicas == 1
+        assert auto.queue_high == 2.0
+        # The controller thread started lazily because an autoscale-
+        # enabled model was loaded.
+        assert core.autoscaler._thread is not None
+    finally:
+        core.shutdown()
+
+
+# -- the feedback loop -----------------------------------------------------
+
+
+def test_scale_up_under_pressure_then_down_when_quiet():
+    core = build_core([], warmup=False)
+    try:
+        core.repository.add_factory(
+            "slow_autoscale", _slow_autoscale_factory("slow_autoscale"))
+        core.load_model("slow_autoscale", warmup=False)
+        core.autoscaler.stop()  # hand-driven ticks from here on
+        core.infer(_request(0, "slow_autoscale"))
+        replica_set = core._replica_sets["slow_autoscale"]
+        assert replica_set.count == 1
+
+        stop = threading.Event()
+
+        def flood(index):
+            i = 0
+            while not stop.is_set():
+                try:
+                    core.infer(_request(index * 10_000 + i,
+                                        "slow_autoscale"))
+                except InferenceServerException:
+                    pass
+                i += 1
+
+        pool = [threading.Thread(target=flood, args=(i,), daemon=True)
+                for i in range(8)]
+        for thread in pool:
+            thread.start()
+        try:
+            # Pressure ticks: queue depth per healthy replica exceeds
+            # queue_high, so each tick (cooldown 0) admits one
+            # canaried replica until the backlog drains or max is hit.
+            grown = _wait_for(
+                lambda: core.autoscaler.tick_once() is not None
+                and replica_set.count >= 2)
+            assert grown, "controller never scaled up under backlog"
+        finally:
+            stop.set()
+            for thread in pool:
+                thread.join(timeout=5)
+
+        snapshot = core.autoscaler.snapshot()["slow_autoscale"]
+        assert any(key.startswith("up|")
+                   for key in snapshot["events"])
+
+        # Quiet: empty queue, burn 0 -> drain back to min_replicas.
+        shrunk = _wait_for(
+            lambda: core.autoscaler.tick_once() is not None
+            and replica_set.count == 1)
+        assert shrunk, "controller never drained back to the floor"
+        snapshot = core.autoscaler.snapshot()["slow_autoscale"]
+        assert any(key.startswith("down|")
+                   for key in snapshot["events"])
+        # Serving is undisturbed after the full up/down cycle.
+        core.infer(_request(7, "slow_autoscale"))
+        # Every decision left an auditable flight-ring record.
+        decisions = [r for r in core.flight.snapshot("slow_autoscale")
+                     if r.get("reason") == "decision"]
+        assert any("autoscale_up" in r["decision"] for r in decisions)
+        assert any("autoscale_down" in r["decision"] for r in decisions)
+    finally:
+        core.shutdown()
+
+
+def test_scale_to_zero_round_trip():
+    core = build_core([], warmup=False)
+    try:
+        factory = _slow_autoscale_factory("zero_autoscale", delay_s=0.0)
+
+        def zero_factory():
+            model = factory()
+            model.autoscale_min_replicas = 0
+            model.autoscale_idle_s = 0.2
+            return model
+
+        core.repository.add_factory("zero_autoscale", zero_factory)
+        core.load_model("zero_autoscale", warmup=False)
+        core.autoscaler.stop()
+        core.infer(_request(0, "zero_autoscale"))
+        ledger = devstats_mod.get().ledger
+
+        # Idle past idle_s -> the controller unloads the model whole.
+        drained = _wait_for(
+            lambda: core.autoscaler.tick_once() is not None
+            and not core.repository.is_ready("zero_autoscale"))
+        assert drained, "idle model never scaled to zero"
+        # The HBM ledger shows exactly whose memory freed: no rows
+        # remain for the model (tpu_hbm_model_bytes drops to 0).
+        assert ledger.model_bytes("zero_autoscale") == {}
+        assert core.autoscaler.snapshot()["zero_autoscale"]["cold"]
+
+        # First arrival: an honest 503 + Retry-After while warming.
+        with pytest.raises(InferenceServerException) as raised:
+            core.infer(_request(1, "zero_autoscale"))
+        assert raised.value.status() == "UNAVAILABLE"
+        assert getattr(raised.value, "retry_after_s", 0) > 0
+        assert "cold-starting" in str(raised.value)
+
+        # ... then the background reload finishes and serving resumes.
+        assert _wait_for(
+            lambda: core.repository.is_ready("zero_autoscale"))
+        core.infer(_request(2, "zero_autoscale"))
+        events = core.autoscaler.snapshot()["zero_autoscale"]["events"]
+        assert events.get("down|scale_to_zero") == 1
+        assert events.get("up|cold_start") == 1
+        decisions = [r["decision"] for r
+                     in core.flight.snapshot("zero_autoscale")
+                     if r.get("reason") == "decision"]
+        assert "autoscale_down reason=scale_to_zero" in decisions
+        assert "autoscale_up reason=cold_start" in decisions
+    finally:
+        core.shutdown()
+
+
+def test_canary_rejects_sick_replica_without_disturbing_serving():
+    core = build_core([], warmup=False)
+    try:
+        core.repository.add_factory(
+            "canary_autoscale",
+            _slow_autoscale_factory("canary_autoscale", delay_s=0.0))
+        core.load_model("canary_autoscale", warmup=False)
+        core.autoscaler.stop()
+        core.infer(_request(0, "canary_autoscale"))
+        replica_set = core._replica_sets["canary_autoscale"]
+
+        # Poison the index the NEXT replica will get: the chaos fault
+        # fires inside the canary probe (the chaos-injected execution
+        # path), so the prospect never enters routing.
+        sick_index = replica_set._next_index
+        chaos.configure(chaos.ChaosConfig(
+            error_rate=1.0,
+            replica="canary_autoscale:%d" % sick_index))
+        assert replica_set.scale_up() is False
+        assert replica_set.count == 1
+        assert replica_set.canary_rejects == 1
+        assert all(r.index != sick_index
+                   for r in replica_set.replicas)
+        # Serving through the existing fleet is untouched (the chaos
+        # scope targets only the rejected index).
+        core.infer(_request(1, "canary_autoscale"))
+        chaos.configure(None)
+        # The same grow succeeds once the fault clears — indexes are
+        # never reused, so the retry canaries a FRESH index.
+        assert replica_set.scale_up() is True
+        assert replica_set.count == 2
+    finally:
+        core.shutdown()
+
+
+# -- admission-coupled shedding --------------------------------------------
+
+
+def test_shed_directive_sheds_lowest_class_with_controller_retry_after():
+    core = build_core(["simple_autoscale"], warmup=False)
+    try:
+        core.autoscaler.stop()
+        core.infer(_request(0, "simple_autoscale"))
+        batcher = core._batchers["simple_autoscale"]
+        directive = qos.ShedDirective(active=True, retry_after_s=2.5,
+                                      reason="test directive",
+                                      since=time.time())
+        batcher.set_shed_directive(directive)
+        # Lowest class (the default, 2) sheds at the door with the
+        # controller's predicted recovery as Retry-After ...
+        with pytest.raises(InferenceServerException) as raised:
+            core.infer(_request(1, "simple_autoscale"))
+        assert raised.value.status() == "UNAVAILABLE"
+        assert raised.value.retry_after_s == 2.5
+        assert "autoscale directive" in str(raised.value)
+        # ... while priority 1 is admitted normally.
+        core.infer(_request(2, "simple_autoscale", priority=1))
+        batcher.set_shed_directive(None)
+        core.infer(_request(3, "simple_autoscale"))
+    finally:
+        core.shutdown()
+
+
+def test_controller_installs_and_clears_directive_on_verdict():
+    core = build_core([], warmup=False)
+    try:
+        core.repository.add_factory(
+            "shed_autoscale",
+            _slow_autoscale_factory("shed_autoscale", delay_s=0.0,
+                                    max_replicas=1))
+        core.load_model("shed_autoscale", warmup=False)
+        core.autoscaler.stop()
+        core.infer(_request(0, "shed_autoscale"))
+        batcher = core._batchers["shed_autoscale"]
+        verdicts = {"shed_autoscale": {
+            "healthy": False, "monitored": True,
+            "burn": {"fast": 4.0, "slow": 2.0},
+        }}
+        core.slo.cached_verdicts = lambda max_age_s=1.0: verdicts
+        # Unhealthy at max scale (1 of 1): growing is impossible, so
+        # the controller feeds the shed directive into admission.
+        core.autoscaler.tick_once()
+        installed = batcher.shed_directive()
+        assert installed is not None and installed.active
+        assert installed.retry_after_s > 0
+        state = core.autoscaler.snapshot()["shed_autoscale"]
+        assert state["shed"]["active"]
+        assert state["events"].get("shed|slo_unmeetable") == 1
+        # Recovery clears it the next tick.
+        verdicts["shed_autoscale"]["healthy"] = True
+        core.autoscaler.tick_once()
+        assert batcher.shed_directive() is None
+        state = core.autoscaler.snapshot()["shed_autoscale"]
+        assert not state["shed"]["active"]
+        assert state["events"].get("shed_clear|slo_recovered") == 1
+    finally:
+        core.shutdown()
+
+
+# -- chaos diurnal trace ---------------------------------------------------
+
+
+def test_overload_trace_spec_parses():
+    kwargs = chaos.OverloadScenario.parse_spec(
+        "trace=50:2+500:3+0:1,repeat=2,workers=4,seed=3")
+    assert kwargs["trace"] == [(50.0, 2.0), (500.0, 3.0), (0.0, 1.0)]
+    assert kwargs["repeat"] == 2
+    assert kwargs["workers"] == 4
+    with pytest.raises(ValueError):
+        chaos.OverloadScenario.parse_spec("trace=50:2+bogus")
+    with pytest.raises(ValueError):
+        chaos.OverloadScenario.parse_spec("cadence=5")
+
+
+def test_overload_trace_replays_schedule():
+    stamps = []
+    lock = threading.Lock()
+
+    def submit():
+        with lock:
+            stamps.append(time.monotonic())
+
+    scenario = chaos.OverloadScenario(
+        submit, workers=2, seed=7,
+        trace=[(200.0, 0.25), (0.0, 0.35), (200.0, 0.25)], repeat=1)
+    start = time.monotonic()
+    scenario.start()
+    assert scenario.finished.wait(5.0)
+    scenario.stop()
+    assert scenario.stats()["submitted"] == len(stamps)
+    assert len(stamps) > 0
+    # The idle stage really is idle: no arrivals land in its middle
+    # (stage 1 ends by 0.25 + generous scheduler slack; stage 3 does
+    # not begin before 0.60 on any worker).
+    gap = [t - start for t in stamps if 0.35 < t - start < 0.55]
+    assert gap == []
+
+
+# -- observability ---------------------------------------------------------
+
+
+def test_debug_controller_section_and_desired_metric():
+    core = build_core(["simple_autoscale"], warmup=False)
+    try:
+        core.autoscaler.stop()
+        core.infer(_request(0, "simple_autoscale"))
+        core.autoscaler.tick_once()
+        section = core.debug_snapshot()["controller"]
+        entry = section["simple_autoscale"]
+        assert entry["actual"] == 1
+        assert entry["desired"] >= 1
+        assert {"last_decision", "last_reason", "replica_seconds",
+                "events", "shed", "cold"} <= set(entry)
+        text = core.metrics_text()
+        assert 'tpu_replica_desired{model="simple_autoscale"}' in text
+        assert 'tpu_replica_seconds_total{model="simple_autoscale"}' \
+            in text
+    finally:
+        core.shutdown()
+
+
+def test_flight_record_decision_populates_empty_ring():
+    recorder = flightrec.FlightRecorder()
+    # mark_incident on an empty ring stamps nothing — the reason
+    # record_decision exists: a scaling decision must be auditable
+    # even when no request trace happened to be resident around it.
+    assert recorder.mark_incident("fresh_model", "autoscale_up") == 0
+    assert recorder.record_decision(
+        "fresh_model", "autoscale_up reason=queue_depth",
+        {"from": 1, "to": 2})
+    records = recorder.snapshot("fresh_model")
+    assert len(records) == 1
+    assert records[0]["reason"] == "decision"
+    assert records[0]["decision"] == "autoscale_up reason=queue_depth"
+    assert records[0]["attrs"] == {"from": 1, "to": 2}

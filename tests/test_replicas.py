@@ -595,9 +595,30 @@ def test_replica_kill_masked_health_and_readmission(replica_core):
         thread.join()
     # Blast radius is ONE fault domain: zero client-visible errors.
     assert errors[0] == 0
+
+    def ejected_total():
+        entry = _replica_snapshot(core, "simple_replicas")
+        return sum(int(r.ejected_count) for r in entry.replica_stats)
+
+    # The batcher fuses those 320 requests into a NONDETERMINISTIC
+    # number of executions (preferred_batch_sizes=[4] under 8 racing
+    # threads), so a quiet run can finish with fewer than
+    # failure_threshold fused batches ever landing on the poisoned
+    # replica — its breaker never trips and ejected stays 0 (the
+    # pre-PR-17 flake, observed on the seed tree too). Chaos is still
+    # active, so keep feeding masked singles until the breaker has
+    # provably tripped: replica 1's EWMA stays 0 (failures never
+    # update it), which makes it the router's first choice, and each
+    # injected fault is masked by the bounded redispatch against a
+    # healthy sibling — these extra requests cannot fail client-
+    # visibly.
+    fill = iter(range(100_000, 200_000))
+    deadline = time.monotonic() + 8.0
+    while ejected_total() < 1:
+        assert time.monotonic() < deadline, \
+            "poisoned replica's breaker never tripped"
+        core.infer(_request(next(fill), "simple_replicas"))
     entry = _replica_snapshot(core, "simple_replicas")
-    ejected = sum(int(r.ejected_count) for r in entry.replica_stats)
-    assert ejected >= 1
     assert entry.healthy_replicas == 3
     # Partial degradation: the model (and server) stay ready, and the
     # metadata names the degraded fleet.

@@ -1,0 +1,117 @@
+"""Autoscale-controller smoke gate for tools/ci_check.sh.
+
+Runs the bench harness's autoscale measurement
+(client_tpu.perf.bench_child.run_autoscale_measure) against an
+in-process core: a 10x diurnal load swing (chaos OverloadScenario
+trace mode, low -> 10x -> low) against a controller-governed model
+(min 1 / max 4 replicas), with one serving replica chaos-killed
+mid-swing. Gates on the ISSUE-17 acceptance criteria:
+
+* priority-1 foreground p99 stays within the model's configured SLO
+  through the whole swing (the controller grew capacity in time),
+* replica-seconds consumed <= 0.6x of a max-scale-always fleet over
+  the same window (the controller shrank capacity in time),
+* >= 1 scale-up AND >= 1 scale-down decision fired, each with a
+  flight-recorded decision record (the post-incident audit trail),
+* the mid-swing replica kill is fully masked: 0 foreground errors
+  while one fault domain was hard-failed.
+
+The p99 and replica-seconds gates measure wall-clock behavior on a
+shared, throttled CI box, so one retry is allowed; the correctness
+gates (scale events, flight records, kill masking) must hold on every
+attempt.
+
+Usage: JAX_PLATFORMS=cpu python tools/autoscale_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+REPLICA_SECONDS_GATE = 0.6
+
+
+def run_once(attempt: int) -> tuple:
+    from client_tpu.perf.bench_child import run_autoscale_measure
+    from client_tpu.server.app import build_core
+
+    core = build_core([], warmup=False)
+    try:
+        result = run_autoscale_measure(
+            core, model_name="autoscale_smoke_%d_" % attempt)
+    finally:
+        core.shutdown()
+    print(json.dumps(result, indent=1))
+
+    hard, soft = [], []
+    if result.get("scale_ups", 0) < 1:
+        hard.append("no scale-up decision fired under a 10x swing")
+    if result.get("scale_downs", 0) < 1:
+        hard.append("no scale-down decision fired after the swing")
+    if result.get("flight_up_decisions", 0) < 1:
+        hard.append("no flight-recorded scale-up decision — the "
+                    "audit trail is missing a direction")
+    if result.get("flight_down_decisions", 0) < 1:
+        hard.append("no flight-recorded scale-down decision — the "
+                    "audit trail is missing a direction")
+    if not result.get("kill_fired"):
+        hard.append("the mid-swing replica kill never fired (fleet "
+                    "never reached 2 replicas during the high stage)")
+    elif result.get("kill_fg_errors", 1) != 0:
+        hard.append("%d foreground error(s) while one replica was "
+                    "hard-killed mid-swing (want 0: redispatch + "
+                    "ejection must mask the fault)"
+                    % result.get("kill_fg_errors"))
+    if result.get("fg_errors", 1) != 0:
+        hard.append("%d foreground error(s) across the whole swing "
+                    "(priority 1 must always be admitted)"
+                    % result.get("fg_errors"))
+    p99 = result.get("fg_p99_us", 0.0)
+    slo = result.get("slo_p99_us", 0)
+    if p99 > slo:
+        soft.append("foreground p99 %.0f us exceeds the configured "
+                    "SLO %d us (the controller did not grow in time)"
+                    % (p99, slo))
+    ratio = result.get("replica_seconds_ratio", 1.0)
+    if ratio > REPLICA_SECONDS_GATE:
+        soft.append("replica-seconds ratio %.3f exceeds %.1fx of "
+                    "max-scale-always (the controller did not shrink "
+                    "in time)" % (ratio, REPLICA_SECONDS_GATE))
+    return result, hard, soft
+
+
+def main() -> int:
+    for attempt in range(2):
+        result, hard, soft = run_once(attempt)
+        for failure in hard:
+            print("FAIL: %s" % failure, file=sys.stderr)
+        if hard:
+            return 1
+        if not soft:
+            print("autoscale smoke passed: peak %d replicas under a "
+                  "10x swing, p99 %.0f us within SLO %d us, "
+                  "replica-seconds %.3fx of max-scale-always "
+                  "(gate %.1fx), %d up / %d down decision(s) all "
+                  "flight-recorded, mid-swing kill masked"
+                  % (result.get("peak_replicas", 0),
+                     result.get("fg_p99_us", 0.0),
+                     result.get("slo_p99_us", 0),
+                     result.get("replica_seconds_ratio", 0.0),
+                     REPLICA_SECONDS_GATE,
+                     result.get("scale_ups", 0),
+                     result.get("scale_downs", 0)))
+            return 0
+        for failure in soft:
+            print("attempt %d: %s" % (attempt, failure), file=sys.stderr)
+    print("FAIL: %s" % soft[0], file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

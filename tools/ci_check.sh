@@ -6,7 +6,7 @@
 # regression gate). Usage: tools/ci_check.sh [min_passed]
 set -u -o pipefail
 
-MIN_PASSED="${1:-650}"
+MIN_PASSED="${1:-728}"
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
 LOG=/tmp/_t1.log
 
@@ -297,6 +297,25 @@ fi
 grep -E "devstats smoke passed" "$DEVSTATS_LOG"
 grep -E "ledger|busy|compile recorded|overhead" "$DEVSTATS_LOG" | head -10
 echo "OK: devstats smoke passed"
+
+# Autoscale smoke: a controller-governed model (min 1 / max 4
+# replicas) under a 10x diurnal swing (chaos trace mode) with one
+# replica chaos-killed mid-swing — priority-1 p99 must stay within
+# the configured SLO, replica-seconds consumed must be <= 0.6x of a
+# max-scale-always fleet, >= 1 scale-up and >= 1 scale-down must fire
+# with flight-recorded decisions in both directions, and the kill must
+# be fully masked (0 foreground errors). Gates live in
+# tools/autoscale_smoke.py.
+echo "autoscale smoke: 10x diurnal swing + mid-swing kill vs controller"
+AUTOSCALE_LOG=/tmp/_autoscale_smoke.log
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/autoscale_smoke.py \
+    > "$AUTOSCALE_LOG" 2>&1; then
+    echo "FAIL: autoscale smoke did not pass" >&2
+    tail -30 "$AUTOSCALE_LOG" >&2
+    exit 1
+fi
+grep -E "autoscale smoke passed" "$AUTOSCALE_LOG"
+echo "OK: autoscale smoke passed"
 
 # Ensemble-dataflow smoke: the ensemble_ab / ensemble_ab_legacy A/B
 # pair on the shared driver — golden parity across arms, backbone

@@ -34,8 +34,10 @@ Patches applied:
   ``ModelConfig.response_cache`` (PR 5 response cache), and the
   multi-tenant QoS schema (PR 7): ``DynamicBatchingConfig.
   priority_levels`` / ``default_priority_level`` / ``shed_watermark``
-  plus the per-priority ``PriorityQueuePolicy`` rows, and the SLO
-  declaration (PR 14): ``SloConfig`` + ``ModelConfig.slo``.
+  plus the per-priority ``PriorityQueuePolicy`` rows, the SLO
+  declaration (PR 14): ``SloConfig`` + ``ModelConfig.slo``, and the
+  autoscale declaration (PR 17): ``AutoscaleConfig`` +
+  ``ModelInstanceConfig.autoscale``.
 
 The ``_serialized_start/_serialized_end`` attribute lines at the bottom
 of the pb2 modules go stale after the patch; they only execute when
@@ -213,6 +215,21 @@ SLO_CONFIG_FIELDS = [
     ("p99_latency_us", 1, U64),
     ("ttft_p99_us", 2, U64),
     ("availability", 3, DOUBLE),
+]
+
+# Autoscale controller declaration (PR 17): per-instance-group
+# feedback-loop bounds and hysteresis knobs, rendered as
+# ModelInstanceConfig.autoscale (client_tpu.server.autoscale).
+AUTOSCALE_CONFIG_FIELDS = [
+    ("min_replicas", 1, U64),
+    ("max_replicas", 2, U64),
+    ("interval_s", 3, DOUBLE),
+    ("queue_high", 4, DOUBLE),
+    ("duty_high", 5, DOUBLE),
+    ("duty_low", 6, DOUBLE),
+    ("up_cooldown_s", 7, DOUBLE),
+    ("down_cooldown_s", 8, DOUBLE),
+    ("idle_s", 9, DOUBLE),
 ]
 
 # Sequence-scheduler observability on ModelStatistics (field 11;
@@ -492,6 +509,23 @@ def patch_model_config(file_proto: descriptor_pb2.FileDescriptorProto) -> bool:
         model_config.field.add(
             name="slo", number=16, type=MESSAGE, label=OPTIONAL,
             type_name=".inference.SloConfig", json_name="slo")
+        changed = True
+    names = [m.name for m in file_proto.message_type]
+    if "AutoscaleConfig" not in names:
+        anchor = names.index("ModelInstanceConfig")
+        message = descriptor_pb2.DescriptorProto(name="AutoscaleConfig")
+        for name, number, ftype in AUTOSCALE_CONFIG_FIELDS:
+            message.field.add(name=name, number=number, type=ftype,
+                              label=OPTIONAL, json_name=_json_name(name))
+        file_proto.message_type.insert(anchor, message)
+        changed = True
+    instance_group = next(
+        m for m in file_proto.message_type
+        if m.name == "ModelInstanceConfig")
+    if not any(f.name == "autoscale" for f in instance_group.field):
+        instance_group.field.add(
+            name="autoscale", number=5, type=MESSAGE, label=OPTIONAL,
+            type_name=".inference.AutoscaleConfig", json_name="autoscale")
         changed = True
     return changed
 

@@ -72,6 +72,8 @@ def _expected_schema():
             _normalize_rows(tool.SEQUENCE_BATCHING_FIELDS),
         "ResponseCacheConfig": [("enable", 1)],
         "SloConfig": _normalize_rows(tool.SLO_CONFIG_FIELDS),
+        "AutoscaleConfig": _normalize_rows(tool.AUTOSCALE_CONFIG_FIELDS),
+        "ModelInstanceConfig": [("autoscale", 5)],
         "ModelConfig": [("response_cache", 15), ("slo", 16)],
     }
     return {
