@@ -278,50 +278,94 @@ class EnsembleModel(ServedModel):
                 mark = now
                 break
         queue_ns_total = 0
-        for k in range(start_index, len(steps)):
-            model_name, input_map, output_map = steps[k]
-            model = self._repository.load(model_name)
-            step_inputs, count = self._wire_step(
-                tensors, model_name, input_map, model.max_batch_size)
-            batcher = ctx.batcher_for(model) \
-                if ctx.batcher_for is not None else None
-            queue_ns = 0
-            executions = 1
-            if batcher is not None and "sequence_id" not in params:
-                step_outputs, queue_ns, leader = batcher.infer(
-                    step_inputs, params, count, trace=ctx.trace,
-                    queue_from_ns=mark, device_outputs=True)
-                executions = 1 if leader else 0
-                if not leader and ctx.telemetry is not None:
-                    ctx.telemetry.record_ensemble_fused(self.name)
-            else:
-                target = (ctx.target_for(model)
-                          if ctx.target_for is not None else model)
-                step_outputs = target.infer(step_inputs, params)
-            end = time.monotonic_ns()
-            queue_ns_total += queue_ns
-            if ctx.stats_recorder is not None:
-                compute_ns = (max(end - mark - queue_ns, 0)
-                              if executions else 0)
-                ctx.stats_recorder(model_name, count, compute_ns,
-                                   executions, queue_ns=queue_ns)
-            step_label = "%d:%s" % (k, model_name)
-            if ctx.trace is not None:
-                ctx.trace.add_timed(
-                    spantrace.SPAN_ENSEMBLE_STEP, mark, end,
-                    {"step": step_label, "batch": count,
-                     "fused": executions == 0})
-            if ctx.telemetry is not None:
-                ctx.telemetry.observe_ensemble_step(
-                    self.name, step_label, (end - mark) / 1000.0,
-                    spantrace.exemplar_id(ctx.trace))
-            if ctx.cache_insert is not None:
-                ctx.cache_insert(k, model, step_outputs)
-            for ens_name, step_name in output_map.items():
-                tensors[ens_name] = step_outputs[step_name]
-            mark = end
-        return ({spec.name: tensors[spec.name] for spec in self.outputs},
-                queue_ns_total)
+        # Interior hand-offs live on device between stages; the HBM
+        # allocator tracks their bytes under an `ensemble_interior`
+        # ledger row for the request's duration (best-effort: the
+        # accounting never sheds or blocks a stage).
+        allocator = self._interior_allocator()
+        interior_leases = []
+        try:
+            for k in range(start_index, len(steps)):
+                model_name, input_map, output_map = steps[k]
+                model = self._repository.load(model_name)
+                step_inputs, count = self._wire_step(
+                    tensors, model_name, input_map, model.max_batch_size)
+                batcher = ctx.batcher_for(model) \
+                    if ctx.batcher_for is not None else None
+                queue_ns = 0
+                executions = 1
+                if batcher is not None and "sequence_id" not in params:
+                    step_outputs, queue_ns, leader = batcher.infer(
+                        step_inputs, params, count, trace=ctx.trace,
+                        queue_from_ns=mark, device_outputs=True)
+                    executions = 1 if leader else 0
+                    if not leader and ctx.telemetry is not None:
+                        ctx.telemetry.record_ensemble_fused(self.name)
+                else:
+                    target = (ctx.target_for(model)
+                              if ctx.target_for is not None else model)
+                    step_outputs = target.infer(step_inputs, params)
+                end = time.monotonic_ns()
+                queue_ns_total += queue_ns
+                if ctx.stats_recorder is not None:
+                    compute_ns = (max(end - mark - queue_ns, 0)
+                                  if executions else 0)
+                    ctx.stats_recorder(model_name, count, compute_ns,
+                                       executions, queue_ns=queue_ns)
+                step_label = "%d:%s" % (k, model_name)
+                if ctx.trace is not None:
+                    ctx.trace.add_timed(
+                        spantrace.SPAN_ENSEMBLE_STEP, mark, end,
+                        {"step": step_label, "batch": count,
+                         "fused": executions == 0})
+                if ctx.telemetry is not None:
+                    ctx.telemetry.observe_ensemble_step(
+                        self.name, step_label, (end - mark) / 1000.0,
+                        spantrace.exemplar_id(ctx.trace))
+                if ctx.cache_insert is not None:
+                    ctx.cache_insert(k, model, step_outputs)
+                for ens_name, step_name in output_map.items():
+                    tensors[ens_name] = step_outputs[step_name]
+                if allocator is not None and k < len(steps) - 1:
+                    nbytes = self._device_hand_off_bytes(step_outputs)
+                    if nbytes > 0:
+                        interior_leases.append(allocator.lease(
+                            self.name, "ensemble_interior", nbytes,
+                            best_effort=True))
+                mark = end
+            return ({spec.name: tensors[spec.name]
+                     for spec in self.outputs}, queue_ns_total)
+        finally:
+            if allocator is not None:
+                for interior in interior_leases:
+                    allocator.release(interior)
+
+    @staticmethod
+    def _interior_allocator():
+        """The process-wide HBM allocator (None when the server layer
+        is unavailable) — interior hand-off tracking is best-effort
+        accounting, never a serving dependency."""
+        try:
+            from client_tpu.server import hbm
+
+            return hbm.get()
+        except Exception:  # noqa: BLE001
+            return None
+
+    @staticmethod
+    def _device_hand_off_bytes(step_outputs) -> int:
+        """Bytes of a stage's outputs that stay device-resident into
+        the next stage (host-committed arrays cost no HBM)."""
+        try:
+            from client_tpu.server import fetch
+
+            return sum(
+                int(getattr(value, "nbytes", 0))
+                for value in step_outputs.values()
+                if fetch.is_device_value(value)
+                and not fetch.host_committed(value))
+        except Exception:  # noqa: BLE001
+            return 0
 
     def _resumable_after(self, k: int, available: set) -> bool:
         """True when execution can resume at step ``k + 1`` with only

@@ -384,6 +384,17 @@ def init_page_pool(cfg: LlmConfig, num_pages: int, page_size: int,
     ]
 
 
+def page_pool_nbytes(cfg: LlmConfig, num_pages: int, page_size: int,
+                     dtype=None) -> int:
+    """Analytic size of the init_page_pool slab (K and V per layer):
+    what the HBM allocator admits BEFORE the device arrays exist, so
+    an over-budget slab sheds honestly instead of OOMing mid-zeros."""
+    dtype = jnp.dtype(dtype or cfg.dtype)
+    per_pool = (int(num_pages) * int(page_size) * cfg.n_kv_heads
+                * cfg.head_dim * dtype.itemsize)
+    return 2 * cfg.n_layers * per_pool
+
+
 def prefix_page_hashes(prompt, page_size: int) -> List[bytes]:
     """Chained BLAKE2b digest per FULL page of prompt tokens: digest
     ``p`` covers tokens ``[0, (p+1) * page_size)`` — a page's K/V
@@ -905,6 +916,17 @@ class LlmModel(ServedModel):
         # while _pool_dev is live, released on crash rebuild / unload
         # so cross-model HBM accounting never shows a dead pool.
         self._kv_ledger_row = None
+        # HBM-allocator lease for the slab (docs/hbm.md): carved
+        # through budgeted admission in _ensure_page_pool — the lease
+        # registers the kv_pages ledger row itself, so only one of
+        # lease/_kv_ledger_row is ever live.
+        self._kv_lease = None
+        # Serializes slab admission OUTSIDE _sched_cv: allocator
+        # admission may evict cold weights (device<->host transfers
+        # that must never run under the scheduler's condition
+        # variable). Deliberately not lockish-named — transfers under
+        # it are the point.
+        self._pool_admission = threading.Lock()
         self._done_dev = None  # [lanes] bool device carry (EOS latch)
         self._lane_pages: List[List[int]] = [
             [] for _ in range(self._lanes)]
@@ -1761,6 +1783,65 @@ class LlmModel(ServedModel):
         except Exception:  # noqa: BLE001
             return None
 
+    def _hbm_allocator(self):
+        """The process-wide HBM allocator (None when the server layer
+        is unavailable — accounting must never block serving)."""
+        try:
+            from client_tpu.server import hbm
+
+            return hbm.get()
+        except Exception:  # noqa: BLE001
+            return None
+
+    def _release_kv_lease(self) -> None:
+        """Returns the slab's bytes to the allocator (and any legacy
+        direct ledger row). Lock-only — safe under _sched_cv."""
+        allocator = self._hbm_allocator()
+        if allocator is not None:
+            allocator.release(self._kv_lease)
+        self._kv_lease = None
+        ledger = self._device_ledger()
+        if ledger is not None:
+            ledger.release(self._kv_ledger_row)
+        self._kv_ledger_row = None
+
+    def _ensure_page_pool(self) -> None:
+        """Carves the KV slab from the HBM allocator BEFORE entering
+        the scheduler's condition variable (the deferred PR-13
+        follow-up): budgeted admission may evict cold paged weights —
+        device<->host transfers that must never run under _sched_cv —
+        and a slab that loses even after eviction sheds with the
+        allocator's honest RESOURCE_EXHAUSTED deferral instead of an
+        opaque OOM. The reservation invariant is untouched: _PagePool
+        still carves its pages out of this one slab."""
+        if self._pool_dev is not None:
+            return
+        self._pool_admission.acquire()
+        try:
+            if self._pool_dev is not None or self._sched_stop:
+                return
+            allocator = self._hbm_allocator()
+            lease = None
+            if allocator is not None:
+                lease = allocator.lease(
+                    self.name, "kv_pages",
+                    page_pool_nbytes(self.cfg, self._num_pages,
+                                     self._page_size),
+                    reason="kv_pool")
+            committed = False
+            try:
+                pool_dev = init_page_pool(self.cfg, self._num_pages,
+                                          self._page_size)
+                with self._sched_cv:
+                    self._pool_dev = pool_dev
+                    self._kv_lease = lease
+                committed = True
+            finally:
+                if not committed and allocator is not None:
+                    allocator.release(lease)
+        finally:
+            self._pool_admission.release()
+
     def _record_busy(self, t0_ns: int) -> None:
         """Feeds the device busy-time counter with one dispatch's wall
         time. The scheduler serializes dispatches, so on the blocking
@@ -1786,10 +1867,7 @@ class LlmModel(ServedModel):
         self._prefill_jobs.clear()
         self._joining.clear()
         self._pool = None
-        ledger = self._device_ledger()
-        if ledger is not None:
-            ledger.release(self._kv_ledger_row)
-        self._kv_ledger_row = None
+        self._release_kv_lease()
         self._pool_dev = None
         self._done_dev = None
         self._lane_pages = [[] for _ in range(self._lanes)]
@@ -1797,10 +1875,7 @@ class LlmModel(ServedModel):
         self._lane_steps_left = [0] * self._lanes
 
     def unload(self) -> None:
-        ledger = self._device_ledger()
-        if ledger is not None:
-            ledger.release(self._kv_ledger_row)
-        self._kv_ledger_row = None
+        self._release_kv_lease()
         with self._sched_cv:
             self._sched_stop = True
             for req in self._collect_riders():
@@ -1850,6 +1925,11 @@ class LlmModel(ServedModel):
                 value = 0.0
             if value > 0:
                 timeout_us = value
+        if self._paged and self._pool_dev is None:
+            # Budgeted slab admission runs before the scheduler cv
+            # (it can evict, i.e. run device transfers) — see
+            # _ensure_page_pool.
+            self._ensure_page_pool()
         with self._sched_cv:
             if self._sched_stop:
                 raise InferenceServerException(
@@ -1886,14 +1966,19 @@ class LlmModel(ServedModel):
                     self._pool = _PagePool(self._num_pages,
                                            self._page_size)
                 if self._pool_dev is None:
+                    # Crash-rebuild fallback: a scheduler reset
+                    # cleared the slab after _ensure_page_pool ran.
+                    # Best-effort lease only — no eviction (and no
+                    # device<->host transfers) under the cv.
                     self._pool_dev = init_page_pool(
                         self.cfg, self._num_pages, self._page_size)
-                    ledger = self._device_ledger()
-                    if ledger is not None:
-                        self._kv_ledger_row = ledger.register(
+                    allocator = self._hbm_allocator()
+                    if allocator is not None:
+                        self._kv_lease = allocator.lease(
                             self.name, "kv_pages",
                             sum(int(k.nbytes) + int(v.nbytes)
-                                for k, v in self._pool_dev))
+                                for k, v in self._pool_dev),
+                            best_effort=True)
                 if self._done_dev is None:
                     self._done_dev = jnp.zeros((self._lanes,),
                                                dtype=bool)

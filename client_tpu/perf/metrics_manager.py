@@ -37,6 +37,13 @@ class TpuMetrics:
     # duty-cycle gauge keyed by device uuid, compile counters keyed
     # "model|b<shape-fingerprint>".
     hbm_model_bytes: Dict[str, float] = field(default_factory=dict)
+    # HBM-allocator families (server/hbm.py): free-budget gauge per
+    # device uuid, eviction counters keyed
+    # "model|c<component>|g<reason>", page-out counters per model; the
+    # restore-latency histogram lands in ``histograms``.
+    hbm_free_bytes: Dict[str, float] = field(default_factory=dict)
+    hbm_evictions_total: Dict[str, float] = field(default_factory=dict)
+    weight_pageout_total: Dict[str, float] = field(default_factory=dict)
     device_busy_us_total: Dict[str, float] = field(default_factory=dict)
     device_duty_cycle: Dict[str, float] = field(default_factory=dict)
     compile_total: Dict[str, float] = field(default_factory=dict)
@@ -116,6 +123,9 @@ _FAMILIES = {
     "tpu_hbm_total_bytes": "hbm_total_bytes",
     "tpu_hbm_utilization": "hbm_utilization",
     "tpu_hbm_model_bytes": "hbm_model_bytes",
+    "tpu_hbm_free_bytes": "hbm_free_bytes",
+    "tpu_hbm_evictions_total": "hbm_evictions_total",
+    "tpu_weight_pageout_total": "weight_pageout_total",
     "tpu_device_busy_us_total": "device_busy_us_total",
     "tpu_device_duty_cycle": "device_duty_cycle",
     "tpu_compile_total": "compile_total",
@@ -170,6 +180,7 @@ _HIST_FAMILIES = {
     "tpu_tenant_request_duration_us": "tenant_request_duration_us",
     "tpu_compile_duration_us": "compile_duration_us",
     "tpu_ensemble_step_duration_us": "ensemble_step_duration_us",
+    "tpu_weight_restore_us": "weight_restore_us",
 }
 
 # Monotonic counters among the scraped families: summarize_metrics
@@ -187,6 +198,7 @@ _COUNTER_FAMILIES = frozenset((
     "device_busy_us_total", "compile_total",
     "device_stats_errors_total",
     "ensemble_fused_total", "ensemble_cache_hits_total",
+    "hbm_evictions_total", "weight_pageout_total",
 ))
 
 
@@ -356,6 +368,7 @@ def summarize_metrics(snapshots: List[TpuMetrics]) -> Dict[str, Dict[str, float]
     cannot go negative."""
     out: Dict[str, Dict[str, float]] = {}
     for attr in ("hbm_used_bytes", "hbm_total_bytes", "hbm_utilization",
+                 "hbm_free_bytes",
                  "batch_pending_depth", "batch_inflight",
                  "batch_queue_delay_us", "batch_overlap_ratio",
                  "sequence_active", "sequence_backlog",

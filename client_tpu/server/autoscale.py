@@ -90,14 +90,19 @@ class _ModelState:
 
 class _ColdModel:
     """A model the controller scaled to zero: enough memory to answer
-    its next arrival honestly (kick one reload, estimate warm time)."""
+    its next arrival honestly (kick one reload/restore, estimate warm
+    time). ``mode`` records HOW it went cold — "paged" (weights live
+    on host via the hbm allocator; warming is a restore) or
+    "unloaded" (full teardown; warming is a factory reload)."""
 
-    __slots__ = ("warm_estimate_s", "loading", "load_started")
+    __slots__ = ("warm_estimate_s", "loading", "load_started", "mode")
 
-    def __init__(self, warm_estimate_s: float) -> None:
+    def __init__(self, warm_estimate_s: float,
+                 mode: str = "unloaded") -> None:
         self.warm_estimate_s = warm_estimate_s
         self.loading = False
         self.load_started = 0.0
+        self.mode = mode
 
 
 class AutoscaleController:
@@ -361,21 +366,40 @@ class AutoscaleController:
         state.desired = 0
         state.last_down = time.monotonic()
         started = time.monotonic()
+        # Pageable models go cold the cheap way: weights move to host
+        # through the hbm allocator (ledger rows park in the
+        # paged_out side table, the instance stays registered) and
+        # the warm estimate is bytes over measured restore bandwidth.
+        # Everything else keeps the PR-17 full unload/reload cycle.
+        mode = "paged"
         try:
-            core.unload_model(name)
+            info = core.page_out_model(name)
         except Exception:  # noqa: BLE001
-            _LOG.exception("scale-to-zero unload of '%s' failed", name)
-            return
-        # The drain time is a decent first warm-time estimate (load
-        # and unload both walk the executable); measured reload time
-        # replaces it after the first cold start.
-        estimate = max(time.monotonic() - started,
-                       DEFAULT_WARM_ESTIMATE_S)
+            _LOG.exception("scale-to-zero page-out of '%s' failed",
+                           name)
+            info = None
+        if info is not None:
+            estimate = max(info["restore_estimate_s"],
+                           MIN_RETRY_AFTER_S)
+        else:
+            mode = "unloaded"
+            try:
+                core.unload_model(name)
+            except Exception:  # noqa: BLE001
+                _LOG.exception("scale-to-zero unload of '%s' failed",
+                               name)
+                return
+            # The drain time is a decent first warm-time estimate
+            # (load and unload both walk the executable); measured
+            # reload time replaces it after the first cold start.
+            estimate = max(time.monotonic() - started,
+                           DEFAULT_WARM_ESTIMATE_S)
         with self._lock:
-            self._cold[name] = _ColdModel(estimate)
+            self._cold[name] = _ColdModel(estimate, mode=mode)
         self._decide(state, name, "down", "scale_to_zero",
                      {"idle_s": round(config["idle_s"], 3),
-                      "warm_estimate_s": round(estimate, 3)})
+                      "warm_estimate_s": round(estimate, 3),
+                      "mode": mode})
 
     def on_admission_miss(self, name: str) -> Optional[float]:
         """Cold-start hook: ``core.infer`` calls this when acquire
@@ -400,10 +424,23 @@ class AutoscaleController:
 
     def _cold_start(self, name: str) -> None:
         core = self._core
+        with self._lock:
+            cold = self._cold.get(name)
+            mode = cold.mode if cold is not None else "unloaded"
         started = time.monotonic()
         try:
-            core.load_model(name)
-        except Exception:  # noqa: BLE001
+            # A paged model warms by restoring its weights
+            # (chunked-parallel host->device through the hbm
+            # allocator); restore_model returns False when the lease
+            # is gone (e.g. an unload raced us), and the factory
+            # reload covers that. core.load_model itself restores
+            # when paged, so the fallthrough is safe either way.
+            if mode != "paged" or not core.restore_model(name):
+                core.load_model(name)
+        except Exception:  # noqa: BLE001 — includes the allocator's
+            # honest deferral when the restore loses the per-device
+            # arbitration: the 503 already told the client when to
+            # retry, and re-arming lets the next arrival try again.
             _LOG.exception("cold start of '%s' failed", name)
             with self._lock:
                 cold = self._cold.get(name)
@@ -420,7 +457,7 @@ class AutoscaleController:
         if state is not None:
             state.desired = 1
             self._decide(state, name, "up", "cold_start",
-                         {"warm_s": round(warm_s, 3)})
+                         {"warm_s": round(warm_s, 3), "mode": mode})
 
     # -- audit + exposition ------------------------------------------------
 
@@ -452,8 +489,7 @@ class AutoscaleController:
         out: Dict[str, dict] = {}
         with self._lock:
             states = dict(self._states)
-            cold = {name: c.warm_estimate_s
-                    for name, c in self._cold.items()}
+            cold = {name: c.mode for name, c in self._cold.items()}
         for name, state in states.items():
             with core._replica_lock:
                 replica_set = core._replica_sets.get(name)
@@ -473,5 +509,6 @@ class AutoscaleController:
                     "reason": state.shed.reason,
                 },
                 "cold": name in cold,
+                "cold_mode": cold.get(name),
             }
         return out

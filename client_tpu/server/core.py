@@ -27,6 +27,7 @@ from client_tpu.server import chaos
 from client_tpu.server import devstats as devstats_mod
 from client_tpu.server import fetch as relay
 from client_tpu.server import flight as flightrec
+from client_tpu.server import hbm as hbm_mod
 from client_tpu.server import slo as sloengine
 from client_tpu.server import telemetry as telemetry_mod
 from client_tpu.server import tracing as spantrace
@@ -425,6 +426,13 @@ class InferenceServerCore:
         # flight ring like SLO burns and breaker trips do.
         self.devstats = devstats_mod.get()
         self.devstats.add_incident_hook(self.flight.mark_incident)
+        # HBM allocator (client_tpu.server.hbm): process-wide like
+        # devstats — the single owner of device memory for weights,
+        # KV slabs, arena regions, and ensemble-interior hand-offs.
+        # Cold pageable models' weights move to host under pressure
+        # or at scale-to-zero and restore chunked-parallel on the
+        # next arrival; admissions arbitrate per-device.
+        self.hbm = hbm_mod.get()
         # Autoscale controller (client_tpu.server.autoscale): the
         # feedback loop that resizes ReplicaSets between the
         # instance_group autoscale bounds, scales idle models to zero,
@@ -1089,6 +1097,14 @@ class InferenceServerCore:
             lines.extend(self.devstats.render_metrics())
         except Exception:  # noqa: BLE001 — metrics never take
             pass  # the server down
+        # Allocator families (client_tpu.server.hbm): per-device free
+        # bytes against the managed budget, eviction counters by
+        # victim/reason, weight page-out counts, restore-latency
+        # histogram.
+        try:
+            lines.extend(self.hbm.render_metrics())
+        except Exception:  # noqa: BLE001 — metrics never take
+            pass  # the server down
         # SLO families (tpu_slo_target / _burn_rate / _budget_remaining
         # / _healthy): rendered by the engine, empty when no ready
         # model declares an `slo` block. Rendering evaluates — the
@@ -1160,6 +1176,15 @@ class InferenceServerCore:
             # device_observability.md). Process-global, so the section
             # is identical across in-process cores.
             doc["devices"] = self.devstats.debug_snapshot()
+        except Exception:  # noqa: BLE001 — introspection never takes
+            pass  # the server down
+        try:
+            # HBM allocator: per-device capacity/free, leases by
+            # model/component with idle age, the paged-out set,
+            # eviction history, and arbitration queue depth
+            # (docs/hbm.md) — eviction incidents are introspectable
+            # like everything else.
+            doc["hbm"] = self.hbm.debug_snapshot()
         except Exception:  # noqa: BLE001 — introspection never takes
             pass  # the server down
         for model in self.repository.ready_models():
@@ -1432,6 +1457,11 @@ class InferenceServerCore:
         return self.repository.index(ready_only)
 
     def load_model(self, name: str, warmup: bool = True) -> None:
+        # A paged-out model "loads" by restoring its weights — the
+        # instance never left the repository, so the factory/warmup
+        # round-trip (and a second ledger measurement) would be waste.
+        if self.restore_model(name):
+            return
         # The load (and its warmup compiles) runs inside a device-
         # ledger measurement: the per-device memory_stats() delta —
         # cross-checked against the instance's exact jax.Array nbytes
@@ -1442,8 +1472,47 @@ class InferenceServerCore:
             measure.model = model
             if warmup:
                 model.warmup()
+        # The allocator adopts the measured weights row: the lease
+        # charges the device budget post-hoc and rebalance pages out
+        # colder models if this admission overflowed it.
+        try:
+            self.hbm.adopt_weights(
+                model, measure.row,
+                on_page_out=lambda: self._quiesce_model(name),
+                on_restore=lambda: self._unquiesce_model(name))
+        except Exception:  # noqa: BLE001 — accounting must never
+            _LOG.warning("hbm: weights adoption failed for %s",  # block
+                        name, exc_info=True)
         if autoscale.AutoscaleController.config_of(model) is not None:
             self.autoscaler.ensure_started()
+
+    def _stop_schedulers(self, name: str) -> None:
+        """Stops a model's sequencer, batcher, and replica set (in
+        that order — the batcher's stop() drains its queued tail
+        through the replica router) and flushes buffered traces.
+        Shared by the unload teardown and the weight page-out
+        quiesce."""
+        with self._sequencers_lock:
+            sequencer = self._sequencers.pop(name, None)
+        if sequencer is not None:
+            sequencer.stop()
+        with self._batchers_lock:
+            batcher = self._batchers.pop(name, None)
+        if batcher is not None:
+            batcher.stop()
+        # Replica sets drain AFTER the schedulers: the batcher's
+        # stop() executes its queued tail through the replica
+        # router, so the per-device queues must still be routing
+        # while it drains.
+        with self._replica_lock:
+            replica_set = self._replica_sets.pop(name, None)
+        if replica_set is not None:
+            replica_set.stop()
+        with self._trace_lock:
+            state = self._trace_state.get(name)
+            if state is not None and state["buffer"]:
+                self._flush_trace(
+                    name, self._effective_trace_settings(name), state)
 
     def unload_model(self, name: str) -> None:
         # Graceful drain ordering: (1) shed NEW requests (503/
@@ -1453,27 +1522,7 @@ class InferenceServerCore:
         # (bounded) and only then tear the model down.
         self.repository.begin_unload(name)
         try:
-            with self._sequencers_lock:
-                sequencer = self._sequencers.pop(name, None)
-            if sequencer is not None:
-                sequencer.stop()
-            with self._batchers_lock:
-                batcher = self._batchers.pop(name, None)
-            if batcher is not None:
-                batcher.stop()
-            # Replica sets drain AFTER the schedulers: the batcher's
-            # stop() executes its queued tail through the replica
-            # router, so the per-device queues must still be routing
-            # while it drains.
-            with self._replica_lock:
-                replica_set = self._replica_sets.pop(name, None)
-            if replica_set is not None:
-                replica_set.stop()
-            with self._trace_lock:
-                state = self._trace_state.get(name)
-                if state is not None and state["buffer"]:
-                    self._flush_trace(
-                        name, self._effective_trace_settings(name), state)
+            self._stop_schedulers(name)
         finally:
             # begin_unload flipped the model UNAVAILABLE; finish MUST
             # run even when a scheduler's stop() raises, or the model
@@ -1481,12 +1530,107 @@ class InferenceServerCore:
             # 503 while its instance and device memory stay resident
             # (tpulint: resource-pairing found the unprotected span).
             self.repository.finish_unload(name)
-            # Ledger rows die with the instance: the model's own
-            # unload released its components (KV pool, replica rows);
-            # this sweeps the load-time `weights` row and anything a
-            # crashed teardown left behind — an unloaded model must
-            # leave no HBM attribution residue.
+            # Every lease dies with the instance — device bytes,
+            # paged-out host copies, and the underlying ledger rows
+            # (the allocator sweeps its own rows; release_model below
+            # still sweeps anything a crashed teardown left behind —
+            # an unloaded model must leave no HBM attribution
+            # residue).
+            try:
+                self.hbm.release_model(name)
+            except Exception:  # noqa: BLE001 — teardown must not raise
+                _LOG.warning("hbm: lease sweep failed for %s", name,
+                            exc_info=True)
             self.devstats.ledger.release_model(name)
+
+    # -- weight paging (client_tpu.server.hbm) ---------------------------
+
+    def _quiesce_model(self, name: str) -> None:
+        """Pre-page-out callback run by the allocator (eviction or
+        scale-to-zero): stop admitting, stop the schedulers, drain
+        in-flight — the weights must not move mid-request. Never
+        raises (it runs inside the allocator's arbitration)."""
+        try:
+            # tpulint: disable=resource-pairing -- the drain state IS
+            # the paged-out model's admission gate: it is deliberately
+            # held until _unquiesce_model's mark_ready at restore (or
+            # unload_model's finish_unload if the model is torn down
+            # cold), so no release belongs in this function
+            self.repository.begin_unload(name)
+            self._stop_schedulers(name)
+            if not self.repository.drain(
+                    name, drain_timeout_s=hbm_mod.EVICT_DRAIN_TIMEOUT_S,
+                    reason="weights paged out to host; restoring on "
+                           "next arrival"):
+                _LOG.warning("hbm: %s still had requests in flight at "
+                            "page-out drain deadline; paging out "
+                            "anyway (host copies keep it correct, "
+                            "just slow)", name)
+        except Exception:  # noqa: BLE001
+            _LOG.warning("hbm: quiesce failed for %s", name,
+                        exc_info=True)
+
+    def _unquiesce_model(self, name: str) -> None:
+        """Post-restore callback: weights are device-resident again,
+        re-admit traffic."""
+        try:
+            self.repository.mark_ready(name)
+        except Exception:  # noqa: BLE001
+            _LOG.warning("hbm: mark_ready failed for %s", name,
+                        exc_info=True)
+
+    def page_out_model(self, name: str) -> Optional[dict]:
+        """Scale-to-zero page-out: moves a pageable model's weights
+        to host (ledger rows move to the paged_out side table) and
+        leaves the instance registered-but-unavailable. None when the
+        model has no pageable resident weights — the caller falls
+        back to a full unload."""
+        lease = self.hbm.weight_lease(name)
+        if lease is None or not lease.pageable \
+                or lease.state != hbm_mod.RESIDENT:
+            return None
+        freed = self.hbm.page_out(lease, reason="scale_to_zero")
+        if not freed:
+            return None
+        return {"nbytes": lease.nbytes,
+                "restore_estimate_s":
+                    self.hbm.restore_estimate_s(lease.nbytes)}
+
+    def restore_model(self, name: str) -> bool:
+        """Restore a paged-out model's weights (chunked-parallel
+        host->device) and re-admit traffic. May evict colder models;
+        raises the allocator's honest retryable deferral when the
+        budget loses the arbitration. False when the model is not
+        paged out."""
+        lease = self.hbm.weight_lease(name)
+        if lease is None or lease.state != hbm_mod.PAGED_OUT:
+            return False
+        return self.hbm.restore(lease, reason="restore")
+
+    def _kick_restore(self, name: str) -> Optional[float]:
+        """Admission-miss hook for models paged out by *eviction*
+        (the autoscaler only tracks its own scale-to-zero decisions):
+        single-flight background restore + honest Retry-After from
+        measured bandwidth. None when the model is not paged out."""
+        lease = self.hbm.weight_lease(name)
+        if lease is None or lease.state != hbm_mod.PAGED_OUT:
+            return None
+        estimate = self.hbm.restore_estimate_s(lease.nbytes)
+        if self.hbm.claim_restore(lease):
+            thread = threading.Thread(
+                target=self._restore_in_background, args=(name,),
+                name="hbm-restore-%s" % name, daemon=True)
+            thread.start()
+        return estimate
+
+    def _restore_in_background(self, name: str) -> None:
+        try:
+            self.restore_model(name)
+        except Exception:  # noqa: BLE001 — the deferral already told
+            # the client when to retry; the claim was cleared by
+            # restore()'s failure path, so the next arrival re-kicks.
+            _LOG.warning("hbm: background restore of %s failed", name,
+                        exc_info=True)
 
     def shutdown(self) -> None:
         """Teardown: flip /v2/health/ready to not-ready FIRST (load
@@ -1879,14 +2023,24 @@ class InferenceServerCore:
                 # and is told honestly how long warming will take.
                 retry = self.autoscaler.on_admission_miss(
                     request.model_name)
+                if retry is None:
+                    # Paged out by HBM eviction rather than by the
+                    # autoscaler: same transparency, restore instead
+                    # of reload, Retry-After from measured restore
+                    # bandwidth.
+                    retry = self._kick_restore(request.model_name)
                 if retry is not None:
                     e = status_map.retryable_error(
-                        "model '%s' is cold-starting (was scaled to "
-                        "zero while idle); warming now"
+                        "model '%s' is cold-starting (weights are "
+                        "paged out or it was scaled to zero); "
+                        "warming now"
                         % request.model_name, retry_after_s=retry)
                 self._flight_admission_reject(request, trace_context, e)
                 raise e
             admission.model_name = model.name
+            # Admission is the eviction policy's heat signal: stamp
+            # every lease of this model hot (lock-only, never raises).
+            self.hbm.touch_model(model.name)
             try:
                 response = self._infer_admitted(model, request,
                                                 trace_context)
@@ -2492,6 +2646,7 @@ class InferenceServerCore:
                                                   trace_context, e)
                     raise
                 acquired = True
+                self.hbm.touch_model(model.name)
                 trace = self._trace_begin(model.name, trace_context,
                                           request.id)
                 ftrace = trace

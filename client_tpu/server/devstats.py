@@ -171,6 +171,10 @@ class DeviceLedger:
         self._lock = threading.Lock()
         # model -> component -> [bytes, exact_bytes]
         self._rows: Dict[str, Dict[str, List[int]]] = {}
+        # model -> component -> bytes currently paged out to host: the
+        # component still *exists* (its row did not vanish at
+        # page-out), it just occupies zero device bytes until restore.
+        self._paged: Dict[str, Dict[str, int]] = {}
         # High-water mark of the attributed total, advanced at every
         # register — so a pool allocated and freed between two
         # observations still shows in take_peak().
@@ -248,10 +252,51 @@ class DeviceLedger:
         """Drops every row of ``model`` (unload teardown); returns the
         bytes dropped."""
         with self._lock:
+            self._paged.pop(str(model), None)
             components = self._rows.pop(str(model), None)
             if not components:
                 return 0
             return sum(entry[0] for entry in components.values())
+
+    def mark_paged(self, row: Optional[LedgerRow]) -> int:
+        """Moves a row's bytes to the paged-out side table: the device
+        total drops (the bytes now live in host memory) but the
+        (model, component) pair stays visible — ``/v2/debug`` and the
+        hbm allocator keep naming it until restore or release. Returns
+        the bytes moved (0 for an empty or already-released row)."""
+        if row is None or row._released:
+            return 0
+        self.release(row)
+        with self._lock:
+            components = self._paged.setdefault(row.model, {})
+            components[row.component] = \
+                components.get(row.component, 0) + row.nbytes
+        return row.nbytes
+
+    def unmark_paged(self, model: str, component: str,
+                     nbytes: Optional[int] = None) -> int:
+        """Removes up to ``nbytes`` (all when None) from the paged-out
+        side table — restore re-registers a live row, release drops
+        the bytes entirely. Returns the bytes removed."""
+        with self._lock:
+            components = self._paged.get(str(model))
+            if not components:
+                return 0
+            held = components.get(str(component), 0)
+            taken = held if nbytes is None else min(held, int(nbytes))
+            remaining = held - taken
+            if remaining > 0:
+                components[str(component)] = remaining
+            else:
+                components.pop(str(component), None)
+                if not components:
+                    self._paged.pop(str(model), None)
+            return taken
+
+    def paged_snapshot(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            return {model: dict(components)
+                    for model, components in self._paged.items()}
 
     def take_peak(self) -> int:
         """High-water mark of the attributed total since the last
@@ -889,6 +934,7 @@ class DeviceStats:
             "hbm_used_bytes": used_rows,
             "hbm_total_bytes": limit_rows,
             "ledger": ledger,
+            "ledger_paged_out": self.ledger.paged_snapshot(),
             "ledger_total_bytes": ledger_total,
             "unattributed_bytes": max(
                 sum(used_rows.values()) - ledger_total, 0)

@@ -140,6 +140,82 @@ def fetch_into(value, dest) -> int:
     return n
 
 
+def offload_tree(tree):
+    """Device pytree -> host (pinned-stand-in numpy) pytree with the
+    overlapped-copy discipline: every device leaf's DMA is kicked
+    first (``copy_to_host_async``), then the blocking materializations
+    run against transfers already in flight — the weight page-out half
+    of the hbm subsystem (docs/hbm.md). Host-committed leaves pass
+    through as numpy views; non-array leaves pass through untouched."""
+    try:
+        import jax
+    except Exception:  # noqa: BLE001 — no runtime: nothing to offload
+        return tree
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    for leaf in leaves:
+        if is_device_value(leaf) and not host_committed(leaf):
+            start_async_copy(leaf)
+    out = []
+    for leaf in leaves:
+        if is_device_value(leaf):
+            out.append(host_array(leaf))
+        elif isinstance(leaf, np.ndarray):
+            out.append(leaf)
+        else:
+            out.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _upload_leaf(leaf, device, chunk_bytes: int, pool):
+    """One host array -> device, chunked-parallel past the split
+    threshold: row slices ride concurrent ``device_put`` calls (the
+    :meth:`OutputFetcher.start` plan run in reverse) and reassemble
+    with one device-side concatenate."""
+    import jax
+    import jax.numpy as jnp
+
+    if not isinstance(leaf, np.ndarray):
+        return leaf
+    plan = OutputFetcher._chunk_plan(leaf, chunk_bytes)
+    if plan is None or pool is None:
+        return jax.device_put(leaf, device)
+    futures = [pool.submit(jax.device_put, leaf[lo:hi], device)
+               for lo, hi in plan]
+    return jnp.concatenate([f.result() for f in futures], axis=0)
+
+
+def upload_tree(tree, device=None, chunk_bytes: int = 0,
+                workers: int = 0):
+    """Host pytree -> device pytree: the restore half of weight paging
+    (docs/hbm.md) — :func:`offload_tree` run in reverse. All leaves
+    upload concurrently on a transient pool, and each leaf at or above
+    2x ``chunk_bytes`` additionally splits along its leading axis into
+    parallel ``device_put`` slices, so a single huge weight tensor
+    does not serialize the whole restore on one transfer stream."""
+    try:
+        import jax
+    except Exception:  # noqa: BLE001 — no runtime: hand back as-is
+        return tree
+    chunk_bytes = chunk_bytes if chunk_bytes > 0 else DEFAULT_CHUNK_BYTES
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    array_count = sum(1 for leaf in leaves if isinstance(leaf, np.ndarray))
+    if array_count == 0:
+        return tree
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(
+            max_workers=(workers if workers > 0 else DEFAULT_WORKERS),
+            thread_name_prefix="hbm-restore") as pool:
+        futures = [
+            pool.submit(_upload_leaf, leaf, device, chunk_bytes, pool)
+            if isinstance(leaf, np.ndarray) else None
+            for leaf in leaves
+        ]
+        out = [future.result() if future is not None else leaf
+               for future, leaf in zip(futures, leaves)]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 class _OutputHandle:
     """Completion state of one output's fetch. Immutable once it
     appears in the inflight completion order."""

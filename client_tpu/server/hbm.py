@@ -1,0 +1,809 @@
+"""HBM as a managed resource: one per-device allocator over the
+ledger's (model, component) rows.
+
+PR 15 made device memory *observable* (the DeviceLedger attributes
+every byte); this module makes it *schedulable*. Every component the
+ledger describes — model weights, the paged-KV slab, arena regions,
+ensemble-interior hand-offs — now acquires its bytes as an
+:class:`HbmLease` from the process-wide :class:`HbmAllocator`, and
+three global behaviors fall out of having one owner:
+
+* **Ledger-driven eviction.** Admission that does not fit the device
+  budget pages out the *coldest* pageable leases (idle age from the
+  admission-path ``touch_model`` timestamps) until it does. A request
+  that loses even after eviction gets an honest retryable deferral
+  (503 + Retry-After from measured restore bandwidth), never an OOM.
+* **Weight paging.** Pageable models' weights move to host through
+  the PR-12 overlapped-copy machinery (``fetch.offload_tree``) and
+  come back chunked-parallel in reverse (``fetch.upload_tree``). The
+  ledger row does not vanish at page-out — it moves to the
+  ``paged_out`` side table, so ``/v2/debug`` keeps naming it.
+* **Arbitration.** Each device has one admission mutex (``arb``):
+  concurrent scale-ups serialize against one budget instead of racing
+  each other into fragmentation; the waiter count is the arbitration
+  queue depth in ``/v2/debug``.
+
+Budget discovery: ``CLIENT_TPU_HBM_BUDGET`` (bytes, ``k``/``m``/``g``
+suffixes — the simulated budget for CPU-sim CI), else the device's
+``memory_stats()['bytes_limit']``, else None — accounting-only mode
+where every lease is granted and nothing evicts, which is exactly the
+pre-subsystem behavior. See docs/hbm.md.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from client_tpu import status_map
+from client_tpu.server import devstats as devstats_mod
+from client_tpu.server import fetch
+
+LOG = logging.getLogger("client_tpu.hbm")
+
+BUDGET_ENV = "CLIENT_TPU_HBM_BUDGET"
+
+# Restore-bandwidth prior before the first measured restore (1 GiB/s:
+# conservative for PCIe hosts, pessimistic for TPU hosts). One real
+# restore replaces it through the EWMA.
+DEFAULT_RESTORE_BANDWIDTH = float(1 << 30)
+_BANDWIDTH_EWMA_ALPHA = 0.3
+MIN_RESTORE_ESTIMATE_S = 0.05
+MAX_RESTORE_ESTIMATE_S = 30.0
+
+# Bounded wait for an eviction victim's in-flight requests before its
+# weights move. The policy targets the *coldest* lease — idle in any
+# non-adversarial schedule — so this is a safety bound, not a budget;
+# page-out proceeds at the deadline because the host copies keep a
+# racing request correct (just slow), never wrong.
+EVICT_DRAIN_TIMEOUT_S = 5.0
+
+RESIDENT = "resident"
+PAGED_OUT = "paged_out"
+RELEASED = "released"
+
+# Eviction heat model. Pure last-used LRU has a microsecond-
+# granularity failure mode: a cold model that just served its one
+# request looks "hotter" than a model serving thousands of requests
+# per second whose latest touch is a hair older, so a churning cold
+# tail evicts the hot set. Victims are therefore ordered by
+# (recency bucket, touch-rate): leases idle in different
+# LRU_BUCKET_S-sized buckets compare by idle age alone
+# (coldest-first), and within the same bucket the lease with the
+# lower exponentially-decayed touch rate (time constant HEAT_TAU_S)
+# is the colder one.
+LRU_BUCKET_S = 1.0
+HEAT_TAU_S = 10.0
+
+
+def _parse_budget(text: Optional[str]) -> Optional[int]:
+    """``CLIENT_TPU_HBM_BUDGET`` value -> bytes (k/m/g suffixes), None
+    when unset or unparseable (unparseable also warns: a typo'd budget
+    silently meaning "unlimited" would be a nasty prod surprise)."""
+    if not text:
+        return None
+    cleaned = text.strip().lower()
+    multiplier = 1
+    if cleaned and cleaned[-1] in ("k", "m", "g"):
+        multiplier = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30}[cleaned[-1]]
+        cleaned = cleaned[:-1]
+    try:
+        value = float(cleaned)
+    except ValueError:
+        LOG.warning("hbm: unparseable %s=%r ignored (accounting-only "
+                    "mode)", BUDGET_ENV, text)
+        return None
+    nbytes = int(value * multiplier)
+    return nbytes if nbytes > 0 else None
+
+
+class WeightPager:
+    """Moves one model's weights device<->host through the fetch
+    machinery. ``page_out`` leaves the model holding the *host*
+    copies (numpy — the CPU-sim stand-in for pinned buffers), so a
+    request that races past the quiesce is slow (jit re-uploads per
+    call), never wrong. ``restore`` uploads chunked-parallel and
+    hands the device tree back to the model."""
+
+    __slots__ = ("_model",)
+
+    def __init__(self, model):
+        self._model = model
+
+    def page_out(self):
+        state = self._model.weight_state()
+        host_state = fetch.offload_tree(state)
+        self._model.set_weight_state(host_state)
+        return host_state
+
+    def restore(self, host_state) -> None:
+        device_state = fetch.upload_tree(host_state)
+        self._model.set_weight_state(device_state)
+
+
+class HbmLease:
+    """One component's claim on one device's budget. States:
+    ``resident`` (bytes count against the device), ``paged_out``
+    (bytes live in ``host_state``; ledger row parked in the paged
+    side table), ``released`` (terminal, idempotent)."""
+
+    __slots__ = ("model", "component", "nbytes", "device_key",
+                 "pageable", "pager", "best_effort", "state",
+                 "last_used", "heat", "ledger_row", "host_state",
+                 "on_page_out", "on_restore", "restoring")
+
+    def __init__(self, model: str, component: str, nbytes: int,
+                 device_key: str, pageable: bool = False,
+                 pager: Optional[WeightPager] = None,
+                 best_effort: bool = False):
+        self.model = str(model)
+        self.component = str(component)
+        self.nbytes = int(nbytes)
+        self.device_key = device_key
+        self.pageable = bool(pageable)
+        self.pager = pager
+        self.best_effort = bool(best_effort)
+        self.state = RESIDENT
+        self.last_used = time.monotonic()
+        self.heat = 0.0  # decayed touch rate (see LRU_BUCKET_S)
+        self.ledger_row = None
+        self.host_state = None
+        # Quiesce/ready callbacks wired by the owning core: eviction
+        # must stop admission + drain in-flight before weights move,
+        # and flip the model READY again after restore.
+        self.on_page_out: Optional[Callable[[], None]] = None
+        self.on_restore: Optional[Callable[[], None]] = None
+        self.restoring = False  # single-flight background restore
+
+
+class _DeviceState:
+    __slots__ = ("key", "capacity", "leased", "arb", "waiters")
+
+    def __init__(self, key: str, capacity: Optional[int]):
+        self.key = key
+        self.capacity = capacity
+        self.leased = 0
+        # The per-device arbitration queue. Deliberately NOT a
+        # lockish-named attribute: admission legitimately runs device
+        # transfers (eviction page-outs) while serialized on it, and
+        # holds the allocator's data lock only in between.
+        self.arb = threading.Lock()
+        self.waiters = 0
+
+
+class HbmAllocator:
+    """Process-wide arena-style owner of device memory (one instance
+    via :func:`get`, like ``devstats.get()`` — devices are
+    process-global, so all in-process cores share one budget).
+
+    Locking: ``self._lock`` guards pure bookkeeping and is never held
+    across a device transfer; ``dev.arb`` serializes admission and IS
+    held across eviction/restore transfers — that serialization is
+    the arbitration queue the subsystem exists to provide."""
+
+    def __init__(self, budget_bytes: Optional[int] = None,
+                 stats: Optional["devstats_mod.DeviceStats"] = None):
+        self._stats = stats or devstats_mod.get()
+        self._budget_override = budget_bytes
+        self._lock = threading.Lock()
+        self._devices: Dict[str, _DeviceState] = {}
+        self._by_model: Dict[str, List[HbmLease]] = {}
+        # (model, component, reason) -> count
+        self._evictions: Dict[Tuple[str, str, str], int] = {}
+        self._pageouts: Dict[str, int] = {}
+        self._restore_hists: Dict[str, object] = {}
+        self._restore_bw: Optional[float] = None
+        self._deferrals = 0
+
+    # -- devices -----------------------------------------------------------
+
+    def _discover_capacity(self, device_key: str) -> Optional[int]:
+        if self._budget_override is not None:
+            return int(self._budget_override)
+        budget = _parse_budget(os.environ.get(BUDGET_ENV))
+        if budget is not None:
+            return budget
+        try:
+            import jax
+
+            for device in jax.local_devices():
+                key = "%s-%d" % (device.platform.upper(), device.id)
+                if key == device_key:
+                    limit = (device.memory_stats() or {}).get(
+                        "bytes_limit")
+                    return int(limit) if limit else None
+        except Exception:  # noqa: BLE001 — no runtime: unlimited
+            pass
+        return None
+
+    def _device(self, device_key: Optional[str] = None) -> _DeviceState:
+        if device_key is None:
+            device_key = self._stats.device_keys()[0]
+        with self._lock:
+            dev = self._devices.get(device_key)
+        if dev is not None:
+            return dev
+        capacity = self._discover_capacity(device_key)
+        with self._lock:
+            dev = self._devices.get(device_key)
+            if dev is None:
+                dev = _DeviceState(device_key, capacity)
+                self._devices[device_key] = dev
+            return dev
+
+    # -- lease lifecycle ---------------------------------------------------
+
+    def lease(self, model: str, component: str, nbytes: int,
+              device_key: Optional[str] = None, pageable: bool = False,
+              pager: Optional[WeightPager] = None,
+              best_effort: bool = False,
+              reason: str = "admission") -> Optional[HbmLease]:
+        """Claims ``nbytes`` on a device, evicting coldest pageable
+        leases if the budget demands it; raises an honest retryable
+        deferral when even eviction cannot fit it. ``best_effort``
+        leases (ensemble-interior regions, adopted weights) never
+        evict and never raise — they charge the budget and let
+        rebalance settle accounts later. Returns None for empty
+        sizes: nothing to account, nothing to leak."""
+        nbytes = int(nbytes)
+        if nbytes <= 0:
+            return None
+        dev = self._device(device_key)
+        new_lease = HbmLease(model, component, nbytes, dev.key,
+                             pageable=pageable, pager=pager,
+                             best_effort=best_effort)
+        if best_effort or dev.capacity is None:
+            with self._lock:
+                dev.leased += nbytes
+        else:
+            self._admit(dev, nbytes, exclude_model=new_lease.model,
+                        reason=reason)
+        try:  # accounting must never block the data plane
+            new_lease.ledger_row = self._stats.ledger.register(
+                new_lease.model, new_lease.component, nbytes)
+        except Exception:  # noqa: BLE001
+            LOG.warning("hbm: ledger register failed for %s/%s",
+                        model, component, exc_info=True)
+        with self._lock:
+            self._by_model.setdefault(new_lease.model, []).append(
+                new_lease)
+        return new_lease
+
+    def release(self, lease: Optional[HbmLease]) -> None:
+        """Idempotent: frees device bytes (resident) or drops the host
+        copy (paged_out); the ledger row goes with it either way."""
+        if lease is None:
+            return
+        with self._lock:
+            state, lease.state = lease.state, RELEASED
+            if state == RELEASED:
+                return
+            lease.restoring = False
+            dev = self._devices.get(lease.device_key)
+            if state == RESIDENT and dev is not None:
+                dev.leased = max(dev.leased - lease.nbytes, 0)
+            leases = self._by_model.get(lease.model)
+            if leases is not None:
+                try:
+                    leases.remove(lease)
+                except ValueError:
+                    pass
+                if not leases:
+                    self._by_model.pop(lease.model, None)
+        row, lease.ledger_row = lease.ledger_row, None
+        lease.host_state = None
+        try:  # accounting must never block the data plane
+            if state == RESIDENT:
+                self._stats.ledger.release(row)
+            elif state == PAGED_OUT:
+                self._stats.ledger.unmark_paged(
+                    lease.model, lease.component, lease.nbytes)
+        except Exception:  # noqa: BLE001
+            LOG.warning("hbm: ledger release failed for %s/%s",
+                        lease.model, lease.component, exc_info=True)
+
+    def release_model(self, model: str) -> int:
+        """Unload teardown: every lease of ``model`` goes, paged-out
+        host copies included. Returns the count released."""
+        with self._lock:
+            doomed = list(self._by_model.get(str(model), ()))
+        for lease in doomed:
+            self.release(lease)
+        return len(doomed)
+
+    def touch_model(self, model: str) -> None:
+        """Admission hot path: stamps every lease of ``model`` so the
+        eviction policy sees it as hot. Lock-only, never raises."""
+        now = time.monotonic()
+        with self._lock:
+            for lease in self._by_model.get(str(model), ()):
+                elapsed = max(now - lease.last_used, 0.0)
+                lease.heat = (lease.heat
+                              * math.exp(-elapsed / HEAT_TAU_S) + 1.0)
+                lease.last_used = now
+
+    def weight_lease(self, model: str) -> Optional[HbmLease]:
+        with self._lock:
+            for lease in self._by_model.get(str(model), ()):
+                if lease.component == "weights" \
+                        and lease.state != RELEASED:
+                    return lease
+        return None
+
+    # -- admission + eviction ----------------------------------------------
+
+    def _admit(self, dev: _DeviceState, nbytes: int,
+               exclude_model: str, reason: str) -> None:
+        with self._lock:
+            dev.waiters += 1
+        dev.arb.acquire()
+        try:
+            with self._lock:
+                dev.waiters -= 1
+            self._reserve(dev, nbytes, exclude_model, reason)
+        finally:
+            dev.arb.release()
+
+    def _reserve(self, dev: _DeviceState, nbytes: int,
+                 exclude_model: str, reason: str) -> None:
+        """Caller holds ``dev.arb``. Reserves ``nbytes`` against the
+        budget, paging out coldest pageable leases until it fits, or
+        raises the honest deferral."""
+        if dev.capacity is None:
+            with self._lock:
+                dev.leased += nbytes
+            return
+        if nbytes > dev.capacity:
+            raise status_map.retryable_error(
+                "component needs %d bytes but device %s has %d total: "
+                "it can never fit this budget"
+                % (nbytes, dev.key, dev.capacity),
+                status="RESOURCE_EXHAUSTED",
+                retry_after_s=MAX_RESTORE_ESTIMATE_S)
+        skip: set = set()
+        while True:
+            with self._lock:
+                if dev.capacity - dev.leased >= nbytes:
+                    dev.leased += nbytes
+                    return
+                victim = self._coldest_locked(dev, exclude_model, skip)
+                if victim is None:
+                    self._deferrals += 1
+                    free = max(dev.capacity - dev.leased, 0)
+            if victim is None:
+                raise status_map.retryable_error(
+                    "HBM budget exhausted on %s: need %d bytes, %d "
+                    "free, nothing evictable (every resident lease is "
+                    "hot or non-pageable)" % (dev.key, nbytes, free),
+                    status="RESOURCE_EXHAUSTED",
+                    retry_after_s=self.restore_estimate_s(nbytes))
+            try:
+                self._count_eviction(victim, reason)
+                self._do_page_out(victim)
+            except Exception:  # noqa: BLE001 — a victim whose page-
+                # out fails stays resident; skip it or the loop spins.
+                LOG.warning("hbm: eviction page-out of %s/%s failed",
+                            victim.model, victim.component,
+                            exc_info=True)
+                skip.add(id(victim))
+
+    @staticmethod
+    def _cold_key(lease: HbmLease) -> Tuple[int, float]:
+        """Victim ordering: recency bucket first (coldest-first by
+        idle age), decayed touch rate within a bucket — so a cold
+        model's single just-served request cannot outrank a model
+        serving thousands per second whose latest touch is a
+        microsecond older."""
+        return (int(lease.last_used / LRU_BUCKET_S), lease.heat)
+
+    def _coldest_locked(self, dev: _DeviceState, exclude_model: str,
+                        skip: set) -> Optional[HbmLease]:
+        coldest = None
+        for leases in self._by_model.values():
+            for candidate in leases:
+                if (candidate.device_key != dev.key
+                        or candidate.state != RESIDENT
+                        or not candidate.pageable
+                        or candidate.pager is None
+                        or candidate.model == exclude_model
+                        or id(candidate) in skip):
+                    continue
+                if coldest is None \
+                        or self._cold_key(candidate) \
+                        < self._cold_key(coldest):
+                    coldest = candidate
+        return coldest
+
+    def _count_eviction(self, victim: HbmLease, reason: str) -> None:
+        with self._lock:
+            key = (victim.model, victim.component, str(reason))
+            self._evictions[key] = self._evictions.get(key, 0) + 1
+
+    # -- paging ------------------------------------------------------------
+
+    def _do_page_out(self, lease: HbmLease) -> None:
+        """Device->host for one lease. Caller holds ``dev.arb`` (all
+        page-outs serialize with admission); never holds
+        ``self._lock`` — the quiesce waits on in-flight requests and
+        the copy is a device transfer."""
+        quiesce = lease.on_page_out
+        if quiesce is not None:
+            quiesce()
+        try:
+            lease.host_state = lease.pager.page_out()
+        except Exception:
+            # Weights are still resident: undo the quiesce so the
+            # model does not strand UNAVAILABLE behind a failed copy.
+            ready = lease.on_restore
+            if ready is not None:
+                ready()
+            raise
+        with self._lock:
+            lease.state = PAGED_OUT
+            dev = self._devices.get(lease.device_key)
+            if dev is not None:
+                dev.leased = max(dev.leased - lease.nbytes, 0)
+            self._pageouts[lease.model] = \
+                self._pageouts.get(lease.model, 0) + 1
+        row, lease.ledger_row = lease.ledger_row, None
+        try:  # accounting must never block the data plane
+            moved = self._stats.ledger.mark_paged(row)
+            if not moved:
+                # Row was never registered (load-measure failure):
+                # park the bytes directly so the paged set still
+                # names this component.
+                ledger = self._stats.ledger
+                with ledger._lock:
+                    components = ledger._paged.setdefault(
+                        lease.model, {})
+                    components[lease.component] = \
+                        components.get(lease.component, 0) \
+                        + lease.nbytes
+        except Exception:  # noqa: BLE001
+            LOG.warning("hbm: ledger page-out failed for %s/%s",
+                        lease.model, lease.component, exc_info=True)
+
+    def page_out(self, lease: Optional[HbmLease],
+                 reason: str = "scale_to_zero") -> int:
+        """Voluntary page-out (the autoscaler's scale-to-zero): moves
+        one resident pageable lease to host and returns the device
+        bytes freed (0 when there was nothing to do)."""
+        if lease is None or lease.pager is None:
+            return 0
+        dev = self._device(lease.device_key)
+        dev.arb.acquire()
+        try:
+            with self._lock:
+                if lease.state != RESIDENT:
+                    return 0
+            self._do_page_out(lease)
+        finally:
+            dev.arb.release()
+        return lease.nbytes
+
+    def claim_restore(self, lease: HbmLease) -> bool:
+        """Single-flight guard for background restore kicks: True for
+        exactly one caller until the restore settles."""
+        with self._lock:
+            if lease.state != PAGED_OUT or lease.restoring:
+                return False
+            lease.restoring = True
+            return True
+
+    def restore(self, lease: Optional[HbmLease],
+                reason: str = "restore") -> bool:
+        """Host->device: re-admits the lease against the budget (may
+        evict colder leases; may raise the honest deferral — the
+        "losing scale-up" of the arbitration design), uploads through
+        ``fetch.upload_tree``, updates the measured restore-bandwidth
+        EWMA, and flips the model READY via ``on_restore``. True when
+        the lease is resident on return."""
+        if lease is None:
+            return False
+        dev = self._device(lease.device_key)
+        with self._lock:
+            dev.waiters += 1
+        dev.arb.acquire()
+        try:
+            with self._lock:
+                dev.waiters -= 1
+                if lease.state != PAGED_OUT:
+                    lease.restoring = False
+                    return lease.state == RESIDENT
+            try:
+                self._reserve(dev, lease.nbytes, lease.model, reason)
+            except Exception:
+                with self._lock:
+                    lease.restoring = False
+                raise
+            started_ns = time.monotonic_ns()
+            try:
+                lease.pager.restore(lease.host_state)
+            except Exception:
+                with self._lock:
+                    dev.leased = max(dev.leased - lease.nbytes, 0)
+                    lease.restoring = False
+                raise
+            elapsed_s = max((time.monotonic_ns() - started_ns) / 1e9,
+                            1e-9)
+            with self._lock:
+                lease.state = RESIDENT
+                lease.host_state = None
+                lease.restoring = False
+                lease.last_used = time.monotonic()
+                bandwidth = lease.nbytes / elapsed_s
+                if self._restore_bw is None:
+                    self._restore_bw = bandwidth
+                else:
+                    self._restore_bw = (
+                        _BANDWIDTH_EWMA_ALPHA * bandwidth
+                        + (1.0 - _BANDWIDTH_EWMA_ALPHA)
+                        * self._restore_bw)
+            self._observe_restore(lease.model, elapsed_s * 1e6)
+            try:  # accounting must never block the data plane
+                self._stats.ledger.unmark_paged(
+                    lease.model, lease.component, lease.nbytes)
+                lease.ledger_row = self._stats.ledger.register(
+                    lease.model, lease.component, lease.nbytes)
+            except Exception:  # noqa: BLE001
+                LOG.warning("hbm: ledger restore failed for %s/%s",
+                            lease.model, lease.component,
+                            exc_info=True)
+            ready = lease.on_restore
+            if ready is not None:
+                ready()
+            return True
+        finally:
+            dev.arb.release()
+
+    # -- weights adoption --------------------------------------------------
+
+    def adopt_weights(self, model_obj, row=None,
+                      on_page_out: Optional[Callable[[], None]] = None,
+                      on_restore: Optional[Callable[[], None]] = None
+                      ) -> Optional[HbmLease]:
+        """Post-load adoption of a model's weights: the load
+        measurement already registered the ``weights`` ledger row, so
+        the lease adopts it (no double accounting), charges the
+        budget post-hoc, and rebalances — paging out *other* models'
+        coldest leases if this adoption overflowed the device. Never
+        raises: the load already happened; the honest pre-admission
+        path is :meth:`restore`."""
+        name = str(getattr(model_obj, "name", model_obj))
+        nbytes = int(getattr(row, "nbytes", 0) or 0)
+        if nbytes <= 0:
+            try:
+                nbytes = devstats_mod.model_array_bytes(model_obj)
+            except Exception:  # noqa: BLE001
+                nbytes = 0
+        if nbytes <= 0:
+            return None
+        previous = self.weight_lease(name)
+        if previous is not None:
+            if row is not None:
+                # The re-load measurement already replaced the
+                # ledger's weights component wholesale
+                # (release_component), so the old lease's row handle
+                # is stale — releasing it would subtract from the
+                # fresh row.
+                previous.ledger_row = None
+            self.release(previous)  # re-load replaces, never doubles
+        pageable = bool(getattr(model_obj, "pageable_weights", False))
+        pager = None
+        if pageable:
+            try:
+                pager = WeightPager(model_obj) \
+                    if model_obj.weight_state() is not None else None
+            except Exception:  # noqa: BLE001
+                pager = None
+            pageable = pager is not None
+        dev = self._device(None)
+        new_lease = HbmLease(name, "weights", nbytes, dev.key,
+                             pageable=pageable, pager=pager,
+                             best_effort=True)
+        new_lease.on_page_out = on_page_out
+        new_lease.on_restore = on_restore
+        new_lease.ledger_row = row
+        if row is None:
+            try:  # accounting must never block the data plane
+                new_lease.ledger_row = self._stats.ledger.register(
+                    name, "weights", nbytes)
+            except Exception:  # noqa: BLE001
+                LOG.warning("hbm: weights ledger register failed for "
+                            "%s", name, exc_info=True)
+        with self._lock:
+            dev.leased += nbytes
+            self._by_model.setdefault(name, []).append(new_lease)
+        self._rebalance(dev, protect=name, reason="admission")
+        return new_lease
+
+    def _rebalance(self, dev: _DeviceState, protect: str,
+                   reason: str) -> None:
+        """Post-hoc pressure relief after an adoption: pages out
+        coldest pageable leases until the device fits its budget.
+        Never raises — when nothing is evictable the device runs
+        honestly overcommitted (the pre-subsystem behavior)."""
+        if dev.capacity is None:
+            return
+        dev.arb.acquire()
+        try:
+            skip: set = set()
+            while True:
+                with self._lock:
+                    if dev.leased <= dev.capacity:
+                        return
+                    victim = self._coldest_locked(dev, protect, skip)
+                if victim is None:
+                    return
+                try:
+                    self._count_eviction(victim, reason)
+                    self._do_page_out(victim)
+                except Exception:  # noqa: BLE001
+                    LOG.warning("hbm: rebalance page-out of %s/%s "
+                                "failed", victim.model,
+                                victim.component, exc_info=True)
+                    skip.add(id(victim))
+        finally:
+            dev.arb.release()
+
+    # -- estimates + introspection -----------------------------------------
+
+    def restore_bandwidth(self) -> float:
+        with self._lock:
+            return self._restore_bw or DEFAULT_RESTORE_BANDWIDTH
+
+    def restore_estimate_s(self, nbytes: int) -> float:
+        """Honest Retry-After for a cold start: bytes over the
+        measured restore-bandwidth EWMA, clamped to sane bounds."""
+        bandwidth = max(self.restore_bandwidth(), 1.0)
+        estimate = float(max(int(nbytes), 0)) / bandwidth
+        return min(max(estimate, MIN_RESTORE_ESTIMATE_S),
+                   MAX_RESTORE_ESTIMATE_S)
+
+    def _observe_restore(self, model: str, micros: float) -> None:
+        try:  # accounting must never block the data plane
+            from client_tpu.server.telemetry import LatencyHistogram
+
+            with self._lock:
+                hist = self._restore_hists.get(model)
+                if hist is None:
+                    hist = self._restore_hists.setdefault(
+                        model, LatencyHistogram())
+            hist.observe(micros)
+        except Exception:  # noqa: BLE001
+            LOG.warning("hbm: restore histogram failed", exc_info=True)
+
+    def paged_out_models(self) -> List[str]:
+        with self._lock:
+            return sorted({
+                lease.model
+                for leases in self._by_model.values()
+                for lease in leases if lease.state == PAGED_OUT})
+
+    def debug_snapshot(self) -> dict:
+        """The ``hbm`` section of GET /v2/debug (cardinality-bounded
+        by the ledger's own model/component caps)."""
+        now = time.monotonic()
+        with self._lock:
+            devices = {}
+            for key in sorted(self._devices):
+                dev = self._devices[key]
+                free = None
+                if dev.capacity is not None:
+                    free = max(dev.capacity - dev.leased, 0)
+                devices[key] = {
+                    "capacity_bytes": dev.capacity,
+                    "leased_bytes": dev.leased,
+                    "free_bytes": free,
+                    "arbitration_queue_depth": dev.waiters,
+                }
+            leases = []
+            paged_out = set()
+            for model in sorted(self._by_model):
+                for lease in self._by_model[model]:
+                    leases.append({
+                        "model": lease.model,
+                        "component": lease.component,
+                        "nbytes": lease.nbytes,
+                        "device": lease.device_key,
+                        "state": lease.state,
+                        "pageable": lease.pageable,
+                        "idle_s": round(now - lease.last_used, 3),
+                    })
+                    if lease.state == PAGED_OUT:
+                        paged_out.add(lease.model)
+            evictions = [
+                {"model": model, "component": component,
+                 "reason": reason, "count": count}
+                for (model, component, reason), count
+                in sorted(self._evictions.items())]
+            deferrals = self._deferrals
+        return {
+            "devices": devices,
+            "leases": leases,
+            "paged_out": sorted(paged_out),
+            "evictions": evictions,
+            "deferrals": deferrals,
+            "restore_bandwidth_bytes_per_s":
+                int(self.restore_bandwidth()),
+        }
+
+    # -- exposition --------------------------------------------------------
+
+    def render_metrics(self) -> List[str]:
+        """Prometheus exposition for the allocator families (joins
+        the devstats block in ``core.metrics_text``)."""
+        lines: List[str] = []
+
+        def family(name, kind, help_text, rows):
+            if not rows:
+                return
+            lines.append("# HELP %s %s" % (name, help_text))
+            lines.append("# TYPE %s %s" % (name, kind))
+            lines.extend(rows)
+
+        free_rows = []
+        with self._lock:
+            for key in sorted(self._devices):
+                dev = self._devices[key]
+                if dev.capacity is None:
+                    continue
+                free_rows.append(
+                    'tpu_hbm_free_bytes{tpu_uuid="%s"} %d'
+                    % (key, max(dev.capacity - dev.leased, 0)))
+            eviction_items = sorted(self._evictions.items())
+            pageout_items = sorted(self._pageouts.items())
+            hist_items = sorted(self._restore_hists.items())
+        family("tpu_hbm_free_bytes", "gauge",
+               "Allocator-visible free HBM per device (budget minus "
+               "resident leases)", free_rows)
+        family("tpu_hbm_evictions_total", "counter",
+               "Ledger-driven evictions of pageable components, by "
+               "victim and trigger",
+               ['tpu_hbm_evictions_total{model="%s",component="%s",'
+                'reason="%s"} %d' % (model, component, reason, count)
+                for (model, component, reason), count
+                in eviction_items])
+        family("tpu_weight_pageout_total", "counter",
+               "Weight page-outs to host (evictions plus "
+               "scale-to-zero)",
+               ['tpu_weight_pageout_total{model="%s"} %d'
+                % (model, count) for model, count in pageout_items])
+        hist_rows: List[str] = []
+        try:
+            from client_tpu.server.telemetry import ServerTelemetry
+
+            for model, hist in hist_items:
+                snap = hist.snapshot()
+                if snap["count"]:
+                    hist_rows.extend(ServerTelemetry._histogram_rows(
+                        "tpu_weight_restore_us", 'model="%s"' % model,
+                        snap, with_exemplars=False))
+        except Exception:  # noqa: BLE001
+            LOG.warning("hbm: restore histogram render failed",
+                        exc_info=True)
+        family("tpu_weight_restore_us", "histogram",
+               "Host->device weight restore wall time (histogram)",
+               hist_rows)
+        return lines
+
+
+# -- process-wide singleton -------------------------------------------------
+
+_SINGLETON: Optional[HbmAllocator] = None
+_SINGLETON_LOCK = threading.Lock()
+
+
+def get() -> HbmAllocator:
+    """The process-wide allocator (devices are process-global; all
+    in-process cores share one budget, exactly like devstats.get())."""
+    global _SINGLETON
+    if _SINGLETON is None:
+        with _SINGLETON_LOCK:
+            if _SINGLETON is None:
+                _SINGLETON = HbmAllocator()
+    return _SINGLETON

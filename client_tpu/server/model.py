@@ -177,6 +177,16 @@ class ServedModel:
     # derive the threshold live from the model's request-duration
     # histogram (estimated p99).
     flight_slow_us: int = 0
+    # Weight paging (client_tpu.server.hbm): pageable_weights opts
+    # this model's weights into the allocator's page-out path — cold
+    # models move their weights to host (scale-to-zero, eviction
+    # under HBM pressure) and restore them chunked-parallel on the
+    # next arrival. A pageable model must implement weight_state()
+    # (return the live weights pytree) and set_weight_state() (accept
+    # a replacement pytree, device or host); models that keep the
+    # default (None state) are treated as non-pageable regardless of
+    # the flag.
+    pageable_weights: bool = False
     sequence_batching: bool = False
     sequence_strategy: str = "direct"
     max_candidate_sequences: int = 0
@@ -210,6 +220,17 @@ class ServedModel:
 
     def unload(self) -> None:
         """Release device resources (optional)."""
+
+    def weight_state(self):
+        """The live weights pytree for paging (docs/hbm.md). None
+        (the default) marks the model non-pageable even when
+        ``pageable_weights`` is set."""
+        return None
+
+    def set_weight_state(self, state) -> None:
+        """Replace the weights pytree (host copies at page-out,
+        device copies at restore). Only called when weight_state()
+        returned a pytree."""
 
     def flops_estimate(self, batch: int, seq: int = 0):
         """Analytic FLOPs for ONE forward execution at this batch size

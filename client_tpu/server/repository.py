@@ -141,6 +141,44 @@ class ModelRepository:
         self.begin_unload(name)
         self.finish_unload(name, drain_timeout_s)
 
+    # -- weight paging (client_tpu.server.hbm) ----------------------------
+    #
+    # Page-out is phases 1+2 of the unload drain WITHOUT phase 3: the
+    # instance stays registered (its ledger rows move to the
+    # paged-out side table, they don't vanish), admission sheds with
+    # the same honest 503 + Retry-After, and mark_ready reverses it
+    # after restore — no factory round-trip, no re-warmup.
+
+    def drain(self, name: str, drain_timeout_s: Optional[float] = None,
+              reason: str = "weights paged out to host") -> bool:
+        """Bounded wait for ``name``'s in-flight counter to reach
+        zero while keeping the instance (begin_unload must already
+        have flipped admission off). False when requests were still
+        in flight at the deadline — the caller must not move the
+        weights out from under them."""
+        timeout = self.DRAIN_TIMEOUT_S if drain_timeout_s is None \
+            else drain_timeout_s
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while self._inflight.get(name, 0) > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cv.wait(timeout=remaining)
+            self._reason[name] = reason
+        return True
+
+    def mark_ready(self, name: str) -> None:
+        """Re-admit a paged-out (or otherwise quiesced-but-loaded)
+        model after its weights are device-resident again."""
+        with self._lock:
+            if name not in self._models:
+                raise InferenceServerException(
+                    "unknown model '%s'" % name, status="NOT_FOUND"
+                )
+            self._state[name] = "READY"
+            self._reason.pop(name, None)
+
     # -- in-flight accounting ---------------------------------------------
 
     def acquire(self, name: str, version: str = "") -> ServedModel:

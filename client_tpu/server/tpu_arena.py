@@ -70,6 +70,11 @@ class _Region:
         # Device-ledger row for this slot's logical reservation
         # (registered by create_region, released by destroy_region).
         self.ledger_row = None
+        # HBM-allocator lease (docs/hbm.md): when the allocator layer
+        # is importable the lease supersedes the direct ledger row —
+        # it registers the same arena/regions row itself and the
+        # bytes count against the managed device budget.
+        self.hbm_lease = None
 
 
 class TpuArena:
@@ -125,16 +130,28 @@ class TpuArena:
         region = _Region(region_id, device, device_id, byte_size, nonce)
         # HBM attribution: arena slots are client-reserved device
         # memory nothing model-keyed would otherwise explain — one
-        # aggregated `arena/regions` ledger row covers them all
-        # (per-region handles release their own contribution).
+        # aggregated `arena/regions` row covers them all (per-region
+        # handles release their own contribution). The bytes flow
+        # through the HBM allocator (best-effort: client reservations
+        # charge the budget but never evict models), which registers
+        # the ledger row itself; the direct ledger write is the
+        # fallback when only devstats is importable.
         try:
-            from client_tpu.server import devstats
+            from client_tpu.server import hbm
 
-            ledger = devstats.get().ledger
-            region.ledger_row = ledger.register("arena", "regions",
-                                                byte_size)
+            region.hbm_lease = hbm.get().lease(
+                "arena", "regions", byte_size, best_effort=True)
         except Exception:  # noqa: BLE001 — accounting must never
             pass  # block the data plane
+        if region.hbm_lease is None:
+            try:
+                from client_tpu.server import devstats
+
+                ledger = devstats.get().ledger
+                region.ledger_row = ledger.register("arena", "regions",
+                                                    byte_size)
+            except Exception:  # noqa: BLE001 — accounting must never
+                pass  # block the data plane
         with self._lock:
             self._regions[region_id] = region
         return self._serialize_handle(region)
@@ -198,6 +215,13 @@ class TpuArena:
             region = self._regions.pop(region_id, None)
         if region is not None:
             region.segments = []  # drop the HBM buffer references
+            try:
+                from client_tpu.server import hbm
+
+                hbm.get().release(region.hbm_lease)
+            except Exception:  # noqa: BLE001
+                pass
+            region.hbm_lease = None
             try:
                 from client_tpu.server import devstats
 

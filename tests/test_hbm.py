@@ -1,0 +1,433 @@
+"""HBM-allocator tests (client_tpu.server.hbm).
+
+Covers the PR-18 tentpole: budget parsing and admission against a
+simulated budget, ledger-driven eviction (coldest-first by idle age,
+never the requesting model), the arbitration queue under two
+concurrent scale-ups racing one budget (exactly one honest retryable
+deferral, never an OOM), weight paging round trips (bit-identical
+host copies, golden inference parity through a live core, the
+admission-miss background restore), ledger residual ~0 after
+page-out/restore churn, and the autoscaler's scale-to-zero riding
+the page-out path for pageable models (snapshot ``cold_mode``)."""
+
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from client_tpu._infer_common import InferInput
+from client_tpu.grpc._utils import get_inference_request
+from client_tpu.models.add_sub import AddSub
+from client_tpu.server import devstats as devstats_mod
+from client_tpu.server import hbm as hbm_mod
+from client_tpu.server.app import build_core
+from client_tpu.utils import InferenceServerException
+
+
+def _request(value, model, shape=(16,), **kwargs):
+    tensors = []
+    for name, fill in (("INPUT0", value), ("INPUT1", 2 * value)):
+        tensor = InferInput(name, list(shape), "INT32")
+        tensor.set_data_from_numpy(np.full(shape, fill, dtype=np.int32))
+        tensors.append(tensor)
+    return get_inference_request(model_name=model, inputs=tensors,
+                                 outputs=None, **kwargs)
+
+
+def _wait_for(predicate, timeout_s=8.0, interval_s=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval_s)
+    return predicate()
+
+
+def _allocator(budget):
+    return hbm_mod.HbmAllocator(
+        budget_bytes=budget, stats=devstats_mod.DeviceStats(enabled=True))
+
+
+class _FakePager:
+    """Order-recording stand-in for WeightPager in pure-allocator
+    tests (no device arrays involved)."""
+
+    def __init__(self, name, order=None, fail=False):
+        self.name = name
+        self.order = order if order is not None else []
+        self.fail = fail
+        self.paged = 0
+        self.restored = 0
+
+    def page_out(self):
+        if self.fail:
+            raise RuntimeError("injected page-out failure")
+        self.paged += 1
+        self.order.append(self.name)
+        return {"host": self.name}
+
+    def restore(self, host_state):
+        self.restored += 1
+
+
+class _BiasAddSub(AddSub):
+    """AddSub plus a learned bias — the smallest model with real
+    pageable weights: OUTPUT0 = a + b + bias, OUTPUT1 = a - b + bias."""
+
+    def __init__(self, name, bias=3):
+        super().__init__(name=name, datatype="INT32", shape=(16,))
+        self.pageable_weights = True
+        self._bias = jnp.full((16,), bias, dtype=jnp.int32)
+
+    def infer(self, inputs, parameters=None):
+        a = np.asarray(inputs["INPUT0"])
+        b = np.asarray(inputs["INPUT1"])
+        bias = np.asarray(self._bias)
+        return {"OUTPUT0": a + b + bias, "OUTPUT1": a - b + bias}
+
+    def weight_state(self):
+        return {"bias": self._bias}
+
+    def set_weight_state(self, state):
+        self._bias = state["bias"]
+
+
+def _bias_factory(name, **autoscale):
+    def factory():
+        model = _BiasAddSub(name)
+        model.max_batch_size = 0
+        for attr, value in autoscale.items():
+            setattr(model, attr, value)
+        return model
+    return factory
+
+
+# -- budget parsing ---------------------------------------------------------
+
+
+def test_parse_budget_suffixes_and_garbage():
+    assert hbm_mod._parse_budget("512m") == 512 << 20
+    assert hbm_mod._parse_budget("2g") == 2 << 30
+    assert hbm_mod._parse_budget("64K") == 64 << 10
+    assert hbm_mod._parse_budget("1000") == 1000
+    assert hbm_mod._parse_budget("1.5k") == 1536
+    assert hbm_mod._parse_budget("") is None
+    assert hbm_mod._parse_budget(None) is None
+    assert hbm_mod._parse_budget("garbage") is None
+    assert hbm_mod._parse_budget("0") is None
+
+
+# -- admission --------------------------------------------------------------
+
+
+def test_admission_deferral_and_release():
+    alloc = _allocator(1000)
+    first = alloc.lease("a", "weights", 400)
+    second = alloc.lease("b", "weights", 400)
+    # Nothing pageable is resident: the third scale-up loses honestly.
+    with pytest.raises(InferenceServerException) as raised:
+        alloc.lease("c", "weights", 400)
+    assert raised.value.status() == "RESOURCE_EXHAUSTED"
+    assert raised.value.retry_after_s >= hbm_mod.MIN_RESTORE_ESTIMATE_S
+    snap = alloc.debug_snapshot()
+    assert snap["deferrals"] == 1
+    (dev,) = snap["devices"].values()
+    assert dev["free_bytes"] == 200
+    alloc.release(first)
+    alloc.release(second)
+    alloc.release(second)  # idempotent
+    (dev,) = alloc.debug_snapshot()["devices"].values()
+    assert dev["free_bytes"] == 1000
+    # No attribution residue either.
+    assert alloc._stats.ledger.model_bytes("a") == {}
+    assert alloc._stats.ledger.model_bytes("b") == {}
+
+
+def test_oversize_request_raises_immediately():
+    alloc = _allocator(1000)
+    with pytest.raises(InferenceServerException) as raised:
+        alloc.lease("huge", "weights", 2000)
+    assert raised.value.retry_after_s == hbm_mod.MAX_RESTORE_ESTIMATE_S
+
+
+def test_zero_and_best_effort_leases():
+    alloc = _allocator(100)
+    assert alloc.lease("m", "weights", 0) is None
+    # Best-effort overcommit never raises; free clamps at zero.
+    lease = alloc.lease("m", "ensemble_interior", 500, best_effort=True)
+    assert lease is not None
+    (dev,) = alloc.debug_snapshot()["devices"].values()
+    assert dev["free_bytes"] == 0
+    assert dev["leased_bytes"] == 500
+    alloc.release(lease)
+
+
+# -- eviction ---------------------------------------------------------------
+
+
+def test_eviction_coldest_first_by_idle_age():
+    alloc = _allocator(1000)
+    order = []
+    leases = {}
+    for name in ("a", "b", "c"):
+        leases[name] = alloc.lease(
+            name, "weights", 300, pageable=True,
+            pager=_FakePager(name, order))
+    now = time.monotonic()
+    leases["b"].last_used = now - 100.0  # coldest
+    leases["a"].last_used = now - 50.0
+    leases["c"].last_used = now          # hot
+    # 650 needs two evictions: b first (coldest), then a; c is hot
+    # enough to survive.
+    alloc.lease("d", "weights", 650)
+    assert order == ["b", "a"]
+    assert leases["b"].state == hbm_mod.PAGED_OUT
+    assert leases["a"].state == hbm_mod.PAGED_OUT
+    assert leases["c"].state == hbm_mod.RESIDENT
+    snap = alloc.debug_snapshot()
+    assert {"model": "b", "component": "weights",
+            "reason": "admission", "count": 1} in snap["evictions"]
+    assert snap["paged_out"] == ["a", "b"]
+    # The paged rows stay attributable in the ledger's side table.
+    assert alloc._stats.ledger.paged_snapshot() == {
+        "a": {"weights": 300}, "b": {"weights": 300}}
+
+
+def test_eviction_never_touches_requesting_model():
+    alloc = _allocator(1000)
+    own = alloc.lease("solo", "weights", 600, pageable=True,
+                      pager=_FakePager("solo"))
+    with pytest.raises(InferenceServerException):
+        alloc.lease("solo", "kv_pages", 600)
+    assert own.state == hbm_mod.RESIDENT
+    assert own.pager.paged == 0
+
+
+def test_failed_pageout_victim_is_skipped_and_unquiesced():
+    alloc = _allocator(1000)
+    victim = alloc.lease("sick", "weights", 600, pageable=True,
+                         pager=_FakePager("sick", fail=True))
+    calls = {"quiesce": 0, "ready": 0}
+    victim.on_page_out = lambda: calls.__setitem__(
+        "quiesce", calls["quiesce"] + 1)
+    victim.on_restore = lambda: calls.__setitem__(
+        "ready", calls["ready"] + 1)
+    with pytest.raises(InferenceServerException):
+        alloc.lease("next", "weights", 600)
+    # The victim stayed resident and its quiesce was undone — a
+    # failed copy must not strand a model UNAVAILABLE.
+    assert victim.state == hbm_mod.RESIDENT
+    assert calls == {"quiesce": 1, "ready": 1}
+
+
+# -- arbitration ------------------------------------------------------------
+
+
+def test_two_concurrent_scaleups_one_budget():
+    alloc = _allocator(1000)
+    barrier = threading.Barrier(2)
+    results = {}
+
+    def scale_up(name):
+        barrier.wait()
+        try:
+            results[name] = alloc.lease(name, "weights", 600)
+        except InferenceServerException as e:
+            results[name] = e
+
+    threads = [threading.Thread(target=scale_up, args=(name,))
+               for name in ("x", "y")]
+    for thread in threads:
+        thread.start()
+    for thread in threads:
+        thread.join()
+    winners = [r for r in results.values()
+               if isinstance(r, hbm_mod.HbmLease)]
+    losers = [r for r in results.values()
+              if isinstance(r, InferenceServerException)]
+    # Serialized admission: exactly one wins, the loser gets the
+    # honest retryable deferral — never both admitted, never an OOM.
+    assert len(winners) == 1 and len(losers) == 1
+    assert losers[0].status() == "RESOURCE_EXHAUSTED"
+    assert losers[0].retry_after_s > 0
+    assert alloc.debug_snapshot()["deferrals"] == 1
+
+
+# -- paging round trips -----------------------------------------------------
+
+
+def test_weight_pager_round_trip_bit_identical():
+    model = _BiasAddSub("pager_parity", bias=7)
+    golden = np.asarray(model._bias).copy()
+    pager = hbm_mod.WeightPager(model)
+    host_state = pager.page_out()
+    assert isinstance(model._bias, np.ndarray)  # host copies installed
+    assert np.array_equal(np.asarray(model._bias), golden)
+    pager.restore(host_state)
+    assert not isinstance(model._bias, np.ndarray)  # device again
+    assert np.array_equal(np.asarray(model._bias), golden)
+
+
+def test_allocator_restore_measures_bandwidth_and_ledger():
+    alloc = _allocator(None)  # accounting-only: page/restore still work
+    lease = alloc.lease("m", "weights", 4096, pageable=True,
+                        pager=_FakePager("m"))
+    assert alloc.page_out(lease) == 4096
+    assert lease.state == hbm_mod.PAGED_OUT
+    assert alloc.paged_out_models() == ["m"]
+    assert alloc._stats.ledger.model_bytes("m") == {}
+    assert alloc._stats.ledger.paged_snapshot() == {
+        "m": {"weights": 4096}}
+    assert alloc.restore(lease)
+    assert lease.state == hbm_mod.RESIDENT
+    assert lease.pager.restored == 1
+    assert alloc.paged_out_models() == []
+    assert alloc._stats.ledger.paged_snapshot() == {}
+    assert alloc._stats.ledger.model_bytes("m") == {"weights": 4096}
+    # One measured restore replaced the bandwidth prior and landed in
+    # the exposition families.
+    assert alloc.restore_bandwidth() != hbm_mod.DEFAULT_RESTORE_BANDWIDTH
+    text = "\n".join(alloc.render_metrics())
+    assert 'tpu_weight_pageout_total{model="m"} 1' in text
+    assert "tpu_weight_restore_us" in text
+    alloc.release(lease)
+    assert alloc._stats.ledger.model_bytes("m") == {}
+
+
+def test_ledger_residual_zero_after_churn():
+    alloc = _allocator(8192)
+    lease = alloc.lease("churn", "weights", 2048, pageable=True,
+                        pager=_FakePager("churn"))
+    for _ in range(5):
+        assert alloc.page_out(lease) == 2048
+        assert alloc.restore(lease)
+    (dev,) = alloc.debug_snapshot()["devices"].values()
+    assert dev["leased_bytes"] == 2048
+    assert alloc._stats.ledger.model_bytes("churn") == {"weights": 2048}
+    assert alloc._stats.ledger.paged_snapshot() == {}
+    alloc.release_model("churn")
+    (dev,) = alloc.debug_snapshot()["devices"].values()
+    assert dev["leased_bytes"] == 0
+    assert alloc._stats.ledger.model_bytes("churn") == {}
+
+
+# -- through a live core ----------------------------------------------------
+
+
+def test_core_page_out_restore_golden_parity():
+    core = build_core([], warmup=False)
+    name = "hbm_parity"
+    try:
+        core.repository.add_factory(name, _bias_factory(name))
+        core.load_model(name, warmup=False)
+        golden = core.infer(_request(5, name))
+        info = core.page_out_model(name)
+        assert info is not None and info["nbytes"] > 0
+        assert info["restore_estimate_s"] >= hbm_mod.MIN_RESTORE_ESTIMATE_S
+        assert not core.repository.is_ready(name)
+        # The debug document names the paged-out set.
+        assert name in core.debug_snapshot()["hbm"]["paged_out"]
+        # First arrival: honest 503 + Retry-After, and it kicks the
+        # single-flight background restore.
+        with pytest.raises(InferenceServerException) as raised:
+            core.infer(_request(5, name))
+        assert raised.value.status() == "UNAVAILABLE"
+        assert raised.value.retry_after_s > 0
+        assert "cold-starting" in str(raised.value)
+        assert _wait_for(lambda: core.repository.is_ready(name))
+        after = core.infer(_request(5, name))
+        assert list(after.raw_output_contents) == \
+            list(golden.raw_output_contents)
+        assert "tpu_weight_pageout_total" in core.metrics_text()
+    finally:
+        try:
+            core.unload_model(name)
+        finally:
+            core.shutdown()
+
+
+def test_core_unload_sweeps_hbm_leases():
+    core = build_core([], warmup=False)
+    name = "hbm_sweep"
+    try:
+        core.repository.add_factory(name, _bias_factory(name))
+        core.load_model(name, warmup=False)
+        assert core.hbm.weight_lease(name) is not None
+        assert core.page_out_model(name) is not None  # paged residue too
+        core.unload_model(name)
+        assert core.hbm.weight_lease(name) is None
+        assert core.devstats.ledger.model_bytes(name) == {}
+        assert core.devstats.ledger.paged_snapshot().get(name) is None
+    finally:
+        core.shutdown()
+
+
+def test_explicit_load_of_paged_model_restores():
+    core = build_core([], warmup=False)
+    name = "hbm_reload"
+    try:
+        core.repository.add_factory(name, _bias_factory(name))
+        core.load_model(name, warmup=False)
+        assert core.page_out_model(name) is not None
+        # An explicit load of a paged model restores in place instead
+        # of double-loading (no second weights lease).
+        core.load_model(name, warmup=False)
+        lease = core.hbm.weight_lease(name)
+        assert lease is not None and lease.state == hbm_mod.RESIDENT
+        assert len(core.hbm._by_model.get(name, ())) == 1
+        core.infer(_request(1, name))
+    finally:
+        try:
+            core.unload_model(name)
+        finally:
+            core.shutdown()
+
+
+# -- scale-to-zero rides page-out -------------------------------------------
+
+
+def test_scale_to_zero_pages_out_pageable_model():
+    core = build_core([], warmup=False)
+    name = "hbm_zero"
+    try:
+        core.repository.add_factory(name, _bias_factory(
+            name,
+            autoscale_min_replicas=0,
+            autoscale_max_replicas=2,
+            autoscale_idle_s=0.2,
+            autoscale_interval_s=0.05,
+            autoscale_up_cooldown_s=0.0,
+            autoscale_down_cooldown_s=0.0))
+        core.load_model(name, warmup=False)
+        core.autoscaler.stop()  # hand-driven ticks
+        golden = core.infer(_request(4, name))
+
+        drained = _wait_for(
+            lambda: core.autoscaler.tick_once() is not None
+            and not core.repository.is_ready(name))
+        assert drained, "idle model never scaled to zero"
+        # Cheap cold: weights on host, ledger rows parked (not gone),
+        # the controller remembers WHICH path it took.
+        snapshot = core.autoscaler.snapshot()[name]
+        assert snapshot["cold"]
+        assert snapshot["cold_mode"] == "paged"
+        assert core.devstats.ledger.model_bytes(name) == {}
+        assert name in core.devstats.ledger.paged_snapshot()
+
+        with pytest.raises(InferenceServerException) as raised:
+            core.infer(_request(4, name))
+        assert raised.value.status() == "UNAVAILABLE"
+        assert raised.value.retry_after_s > 0
+        assert _wait_for(lambda: core.repository.is_ready(name))
+        after = core.infer(_request(4, name))
+        assert list(after.raw_output_contents) == \
+            list(golden.raw_output_contents)
+        events = core.autoscaler.snapshot()[name]["events"]
+        assert events.get("down|scale_to_zero") == 1
+    finally:
+        try:
+            core.unload_model(name)
+        finally:
+            core.shutdown()

@@ -403,6 +403,87 @@ def test_resource_pairing_non_ledger_register_not_flagged(tmp_path):
     assert check_resource_pairing(src) == []
 
 
+def test_resource_pairing_hbm_lease_leak_flagged(tmp_path):
+    # PR-18: an unpaired HbmAllocator.lease() holds device-budget
+    # bytes for the process lifetime — phantom pressure that evicts
+    # innocent models.
+    src = _source(tmp_path, """
+        def leaky(allocator, build):
+            lease = allocator.lease("m", "kv_pages", 1 << 20)
+            build()          # raises -> the lease leaks
+            allocator.release(lease)
+
+        def never_released(hbm):
+            lease = hbm.lease("m", "weights", 64)
+            return lease.nbytes
+    """)
+    findings = check_resource_pairing(src)
+    assert _ids(findings) == ["resource-pairing"] * 2
+    assert "HBM lease" in findings[1].message
+
+
+def test_resource_pairing_hbm_lease_clean_forms(tmp_path):
+    src = _source(tmp_path, """
+        def finally_paired(allocator, build):
+            lease = allocator.lease("m", "kv_pages", 1 << 20)
+            try:
+                build(lease)
+            finally:
+                allocator.release(lease)
+
+        def attribute_handoff(hbm, region):
+            # Ownership parked on the owning object (the arena /
+            # ensemble pattern): teardown releases it.
+            region.hbm_lease = hbm.lease("arena", "regions", 64)
+
+        def model_sweep(allocator, teardown):
+            lease = allocator.lease("m", "weights", 64)
+            try:
+                teardown()
+            finally:
+                allocator.release_model("m")
+    """)
+    assert check_resource_pairing(src) == []
+
+
+def test_resource_pairing_non_hbm_lease_not_flagged(tmp_path):
+    # `lease` is a common verb — only hbm/alloc-named receivers
+    # engage the pairing rule.
+    src = _source(tmp_path, """
+        def fine(contract):
+            return contract.lease("office", months=12)
+    """)
+    assert check_resource_pairing(src) == []
+
+
+def test_resource_pairing_pager_page_out(tmp_path):
+    # A pager.page_out() whose host state is neither restored nor
+    # handed off strands weights on the host with the device bytes
+    # already freed.
+    src = _source(tmp_path, """
+        def leaky(pager):
+            state = pager.page_out()
+            return len(state)
+
+        def restored_in_finally(pager, wait):
+            state = pager.page_out()
+            try:
+                wait()
+            finally:
+                pager.restore(state)
+
+        def attribute_handoff(lease):
+            lease.host_state = lease.pager.page_out()
+
+        def non_pager_receiver(editor):
+            editor.page_out()
+    """)
+    findings = check_resource_pairing(src)
+    assert _ids(findings) == ["resource-pairing"]
+    assert "paged-out weight state" in findings[0].message
+    assert findings[0].line == 3
+
+
 def test_resource_pairing_suppressed(tmp_path):
     src = _source(tmp_path, """
         def adjacent(repo):

@@ -1,0 +1,326 @@
+#!/usr/bin/env python
+"""CI smoke for the HBM allocator (client_tpu/server/hbm.py,
+docs/hbm.md).
+
+Serves 3x more pageable models than fit a simulated HBM budget
+(``CLIENT_TPU_HBM_BUDGET``, set before jax imports) and drives a
+hot-set workload: two models take continuous traffic while the cold
+tail is cycled through admission-miss restores, each restore evicting
+the coldest resident weights. Gates:
+
+1. **Hot set untouched** — zero evictions of hot-model components
+   across the whole churn (the admission-path ``touch_model`` heat
+   signal must protect them), and no hot request fails.
+2. **Hot p99 unaffected** — hot-model p99 during cold churn within
+   5x the quiet-phase p99 (floor 50 ms for CI noise): restores
+   serialize on the arbitration mutex, not on the serving path.
+3. **Cold-start bound** — every cold model's first-request-to-served
+   wall time within 10x the allocator's own restore estimate (floor
+   3 s): the advertised Retry-After must be honest.
+4. **Residual ~0** — after unloading everything, allocator leased
+   bytes and ledger attribution are both zero: page-out/restore churn
+   leaks nothing.
+5. **Parity** — every response equals the model's golden (weights
+   that moved host->device->host stay bit-identical).
+
+Exits non-zero with one line per violation.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DIM = 128
+WEIGHT_BYTES = DIM * DIM * 4  # fp32
+N_MODELS = 9
+# 3 of 9 fit — 3x oversubscription by model count. The fit count must
+# exceed the hot set by one: the two hot models pin their slots while
+# the cold tail rotates through the remaining slot; a budget that
+# cannot hold hot+1 would make hot evictions load-bearing instead of
+# a bug.
+BUDGET = int(WEIGHT_BYTES * 3.5)
+HOT = ("hbm_hot_0", "hbm_hot_1")
+COLD = tuple("hbm_cold_%d" % i for i in range(N_MODELS - len(HOT)))
+
+# Must precede any jax/client_tpu import: the allocator discovers its
+# budget from the environment at first device touch.
+os.environ["CLIENT_TPU_HBM_BUDGET"] = str(BUDGET)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+FAILURES: list = []
+
+
+def gate(ok: bool, label: str, detail: str = "") -> None:
+    line = "%s%s" % (label, (": " + detail) if detail else "")
+    if ok:
+        print("  ok   %s" % line)
+    else:
+        print("  FAIL %s" % line)
+        FAILURES.append(line)
+
+
+def _build_model(name: str, seed: int):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from client_tpu.server.model import ServedModel, TensorSpec
+
+    class PagedMatmul(ServedModel):
+        """OUTPUT0 = INPUT0 @ W with a per-model deterministic W —
+        the smallest model whose weights are worth paging."""
+
+        platform = "jax"
+
+        def __init__(self):
+            super().__init__()
+            self.name = name
+            self.pageable_weights = True
+            self.max_batch_size = 0
+            self.inputs = [TensorSpec("INPUT0", "FP32", [DIM])]
+            self.outputs = [TensorSpec("OUTPUT0", "FP32", [DIM])]
+            rows = np.arange(DIM, dtype=np.float32)
+            self._w = jnp.asarray(
+                np.outer(rows, rows) * 1e-4 + np.eye(DIM) * (seed + 1),
+                dtype=jnp.float32)
+
+        def infer(self, inputs, parameters=None):
+            x = np.asarray(inputs["INPUT0"], dtype=np.float32)
+            w = np.asarray(self._w, dtype=np.float32)
+            return {"OUTPUT0": x @ w}
+
+        def weight_state(self):
+            return {"w": self._w}
+
+        def set_weight_state(self, state):
+            self._w = state["w"]
+
+    return PagedMatmul()
+
+
+def _request(name: str, seed: int = 0):
+    import numpy as np
+
+    from client_tpu._infer_common import InferInput
+    from client_tpu.grpc._utils import get_inference_request
+
+    x = np.linspace(0.0, 1.0, DIM).astype(np.float32) + (seed % 17)
+    tensor = InferInput("INPUT0", [DIM], "FP32")
+    tensor.set_data_from_numpy(x)
+    return get_inference_request(model_name=name, inputs=[tensor],
+                                 outputs=None)
+
+
+def _infer_until_served(core, name: str, deadline_s: float = 30.0):
+    """Drives one request through the cold-start contract: 503 +
+    Retry-After -> sleep the advised value -> retry. Returns
+    (response, wall_s, saw_cold)."""
+    from client_tpu.utils import InferenceServerException
+
+    started = time.monotonic()
+    saw_cold = False
+    while True:
+        try:
+            response = core.infer(_request(name))
+            return response, time.monotonic() - started, saw_cold
+        except InferenceServerException as e:
+            if time.monotonic() - started > deadline_s:
+                raise
+            saw_cold = True
+            time.sleep(min(getattr(e, "retry_after_s", 0.1) or 0.1,
+                           0.25))
+
+
+def _percentile(values, q):
+    ordered = sorted(values)
+    return ordered[min(int(len(ordered) * q), len(ordered) - 1)]
+
+
+def main() -> int:
+    from client_tpu.server import hbm as hbm_mod
+    from client_tpu.server.app import build_core
+
+    core = build_core([], warmup=False)
+    names = list(HOT) + list(COLD)
+    goldens = {}
+    try:
+        print("hbm smoke: budget=%d bytes, %d models x %d bytes "
+              "weights (%.1fx oversubscribed)"
+              % (BUDGET, N_MODELS, WEIGHT_BYTES,
+                 N_MODELS * WEIGHT_BYTES / float(BUDGET)))
+        for seed, name in enumerate(names):
+            core.repository.add_factory(
+                name, lambda name=name, seed=seed: _build_model(
+                    name, seed))
+            core.load_model(name, warmup=False)
+        snap = core.hbm.debug_snapshot()
+        (dev,) = snap["devices"].values()
+        gate(dev["capacity_bytes"] == BUDGET, "budget discovered",
+             "capacity=%s" % dev["capacity_bytes"])
+        gate(dev["leased_bytes"] <= BUDGET,
+             "oversubscribed load rebalanced under budget",
+             "leased=%d paged_out=%s" % (dev["leased_bytes"],
+                                         snap["paged_out"]))
+
+        # Take goldens everywhere — cold tail first, hot set LAST, so
+        # the hot weights are resident (and hottest) when the quiet
+        # phase starts; each arrival here may itself be a cold-start
+        # restore, since the load sweep paged out the early models.
+        for name in list(COLD) + list(HOT):
+            response, _, _ = _infer_until_served(core, name)
+            goldens[name] = list(response.raw_output_contents)
+
+        # Prime the hot set's heat: right after the warm sweep every
+        # lease sits in the same recency bucket with one touch each,
+        # so the sweep's last restores may have paged a hot model —
+        # serve through any cold start, then build the touch-rate
+        # signal the eviction policy protects.
+        for name in HOT:
+            _infer_until_served(core, name)
+        for index in range(50):
+            for name in HOT:
+                core.infer(_request(name, index))
+
+        # Quiet phase: hot-set p99 with no churn.
+        quiet_lat = []
+        for index in range(150):
+            for name in HOT:
+                t0 = time.monotonic()
+                core.infer(_request(name, index))
+                quiet_lat.append(time.monotonic() - t0)
+        quiet_p99 = _percentile(quiet_lat, 0.99)
+
+        # Churn phase: the cold tail cycles through admission-miss
+        # restores (each evicting the coldest resident weights) while
+        # the hot set keeps serving. The eviction gate below is
+        # windowed from here: the load sweep legitimately paged out
+        # the then-idle hot models, the workload must not.
+        evictions_before = {
+            (row["model"], row["component"], row["reason"]):
+                row["count"]
+            for row in core.hbm.debug_snapshot()["evictions"]}
+        stop = threading.Event()
+        cold_walls = []
+        churn_errors = []
+
+        def churn():
+            try:
+                for cycle in range(3):
+                    for name in COLD:
+                        response, wall, saw_cold = _infer_until_served(
+                            core, name)
+                        cold_walls.append((name, wall, saw_cold))
+                        if list(response.raw_output_contents) != \
+                                goldens[name]:
+                            churn_errors.append(
+                                "%s parity lost after restore" % name)
+            except Exception as e:  # noqa: BLE001
+                churn_errors.append("churn failed: %r" % e)
+            finally:
+                stop.set()
+
+        churn_thread = threading.Thread(target=churn, daemon=True)
+        churn_thread.start()
+        churn_lat = []
+        hot_errors = 0
+        index = 0
+        while not stop.is_set():
+            for name in HOT:
+                t0 = time.monotonic()
+                try:
+                    # Seed 0 matches the golden request: every churn-
+                    # phase response is parity-checked against it.
+                    response = core.infer(_request(name, 0))
+                    churn_lat.append(time.monotonic() - t0)
+                    if list(response.raw_output_contents) != \
+                            goldens[name]:
+                        hot_errors += 1
+                except Exception:  # noqa: BLE001
+                    hot_errors += 1
+            index += 1
+        churn_thread.join(timeout=60)
+        churn_p99 = _percentile(churn_lat, 0.99)
+
+        gate(not churn_errors, "cold tail served through churn",
+             "; ".join(churn_errors[:3]))
+        gate(hot_errors == 0,
+             "hot set never failed or lost parity during churn",
+             "%d bad responses" % hot_errors)
+        restores = sum(1 for _, _, cold in cold_walls if cold)
+        gate(restores > 0, "churn actually exercised cold restores",
+             "%d of %d cold arrivals were misses" % (restores,
+                                                     len(cold_walls)))
+
+        # Gate 1: the heat signal protected the hot set.
+        snap = core.hbm.debug_snapshot()
+        deltas = {}
+        for row in snap["evictions"]:
+            key = (row["model"], row["component"], row["reason"])
+            delta = row["count"] - evictions_before.get(key, 0)
+            if delta:
+                deltas[key] = delta
+        hot_evictions = {key: count for key, count in deltas.items()
+                         if key[0] in HOT}
+        total_evictions = sum(deltas.values())
+        gate(total_evictions > 0 and not hot_evictions,
+             "zero evictions of hot components during churn",
+             "total=%d hot=%s" % (total_evictions, hot_evictions))
+
+        # Gate 2: hot p99 unaffected by the cold churn.
+        bound = max(0.050, 5.0 * quiet_p99)
+        gate(churn_p99 <= bound,
+             "hot p99 unaffected by churn",
+             "quiet=%.1fms churn=%.1fms bound=%.1fms"
+             % (quiet_p99 * 1e3, churn_p99 * 1e3, bound * 1e3))
+
+        # Gate 3: cold-start wall time within the advertised
+        # restore-bandwidth bound.
+        estimate = core.hbm.restore_estimate_s(WEIGHT_BYTES)
+        cold_bound = max(3.0, 10.0 * estimate)
+        worst = max(wall for _, wall, _ in cold_walls)
+        gate(worst <= cold_bound,
+             "cold first-request latency within restore bound",
+             "worst=%.3fs bound=%.3fs (estimate=%.3fs)"
+             % (worst, cold_bound, estimate))
+
+        # The exposition families saw the traffic.
+        metrics = core.metrics_text()
+        gate("tpu_weight_pageout_total" in metrics
+             and "tpu_hbm_evictions_total" in metrics
+             and "tpu_hbm_free_bytes" in metrics
+             and "tpu_weight_restore_us" in metrics,
+             "allocator metric families rendered")
+
+        # Gate 4: churn leaks nothing.
+        for name in names:
+            core.unload_model(name)
+        snap = core.hbm.debug_snapshot()
+        (dev,) = snap["devices"].values()
+        residual = sum(
+            sum(components.values())
+            for model, components
+            in core.devstats.ledger.paged_snapshot().items())
+        gate(dev["leased_bytes"] == 0 and not snap["leases"]
+             and residual == 0,
+             "allocator + ledger residual zero after unload",
+             "leased=%d leases=%d paged=%d"
+             % (dev["leased_bytes"], len(snap["leases"]), residual))
+    finally:
+        core.shutdown()
+
+    if FAILURES:
+        print("hbm smoke FAILED:")
+        for line in FAILURES:
+            print("  - %s" % line)
+        return 1
+    print("hbm smoke passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -47,7 +47,23 @@ _RELEASE_OF = {
     # ledger-named receivers (see _acquire_attr) so unrelated
     # register() verbs (shm regions, prefix-cache pages) stay out.
     "register": ("release", "release_component", "release_model"),
+    # HBM-allocator leases (client_tpu/server/hbm.py): an unpaired
+    # HbmAllocator.lease() holds device-budget bytes for the process
+    # lifetime — phantom pressure that evicts innocent models. Scoped
+    # to hbm/alloc-named receivers (see _acquire_attr).
+    "lease": ("release", "release_model"),
+    # Weight paging: a pager.page_out() whose host state is neither
+    # restored nor handed off strands a model's weights on the host
+    # with the device bytes already freed. Scoped to pager-named
+    # receivers.
+    "page_out": ("restore", "release", "release_model"),
 }
+
+# Acquire verbs whose result assigned onto ANY attribute counts as an
+# ownership hand-off (ledger rows / leases / host weight states ride
+# resource objects — regions, leases, replicas — whose teardown path
+# releases them).
+_ATTRIBUTE_HANDOFF_VERBS = ("register", "lease", "page_out")
 
 
 def _release_names(acquire_attr: str) -> Tuple[str, ...]:
@@ -65,6 +81,13 @@ def _acquire_attr(call: ast.Call) -> Optional[str]:
     if func.attr == "register":
         receiver = expr_text(func.value).split(".")[-1]
         return func.attr if "ledger" in receiver.lower() else None
+    if func.attr == "lease":
+        receiver = expr_text(func.value).split(".")[-1].lower()
+        return func.attr if ("hbm" in receiver or "alloc" in receiver) \
+            else None
+    if func.attr == "page_out":
+        receiver = expr_text(func.value).split(".")[-1].lower()
+        return func.attr if "pager" in receiver else None
     if func.attr == "acquire" or func.attr.startswith("begin_"):
         if is_lockish(func.value):
             return None  # mutexes are lock-discipline's domain
@@ -171,7 +194,8 @@ def check_resource_pairing(src: SourceFile) -> List[Finding]:
                                       _resource_noun(attr))))
                 continue
             # No release here: excused hand-off patterns.
-            if attr == "register" and _assigned_to_attribute(stmt):
+            if attr in _ATTRIBUTE_HANDOFF_VERBS and \
+                    _assigned_to_attribute(stmt):
                 continue
             if _assigned_to_self(stmt):
                 continue
@@ -193,7 +217,13 @@ def check_resource_pairing(src: SourceFile) -> List[Finding]:
 
 
 def _resource_noun(attr: str) -> str:
-    return "model/token slot" if attr == "acquire" else "drain state"
+    if attr == "acquire":
+        return "model/token slot"
+    if attr == "lease":
+        return "HBM lease"
+    if attr == "page_out":
+        return "paged-out weight state"
+    return "drain state"
 
 
 def _receivers_match(a: str, b: str) -> bool:
