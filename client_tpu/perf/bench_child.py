@@ -1258,13 +1258,18 @@ def run_tracing_measure(core, model_name: str = "add_sub_large",
 def _overhead_ab_measure(core, toggle, prefix: str,
                          model_name: str = "add_sub_large",
                          threads: int = 4, requests: int = 120,
-                         rounds: int = 4) -> dict:
+                         rounds: int = 8) -> dict:
     """Shared paired interleaved-A/B overhead driver for always-on
     per-request layers (telemetry histograms, flight capture): the
     identical closed loop on ``model_name`` with the layer disabled vs
     enabled, alternated per round so adjacent windows share the host's
-    drift state. The median of PAIRED per-round ratios isolates the
-    recording cost far more tightly than a ratio of medians at a 2%
+    drift state. The first pair is a throwaway warm-up (its off-window
+    absorbs allocator/cache ramp and reads biased), and the gate takes
+    the true median over the remaining pairs — the upper-median of a
+    handful of pairs is a 75th-percentile estimator that flips the
+    gate on per-window scheduler noise. The median of PAIRED per-round
+    ratios isolates the recording cost far more tightly than a ratio
+    of medians at a 2%
     gate (the absolute cost is microseconds against a ~15 ms request).
     ``toggle`` is the object whose ``enabled`` attribute gates the
     layer; result keys are prefixed ``<prefix>_``."""
@@ -1321,11 +1326,13 @@ def _overhead_ab_measure(core, toggle, prefix: str,
     was_enabled = toggle.enabled
     off_rounds, on_rounds, pair_overheads = [], [], []
     try:
-        for _ in range(rounds):
+        for index in range(rounds + 1):
             toggle.enabled = False
             off_tput_i, off_p50_i = closed_loop()
             toggle.enabled = True
             on_tput_i, on_p50_i = closed_loop()
+            if index == 0:
+                continue  # warm-up pair: ramp bias, not recording cost
             off_rounds.append((off_tput_i, off_p50_i))
             on_rounds.append((on_tput_i, on_p50_i))
             if off_tput_i > 0:
@@ -1338,8 +1345,13 @@ def _overhead_ab_measure(core, toggle, prefix: str,
     off_tput, off_p50 = off_rounds[len(off_rounds) // 2]
     on_tput, on_p50 = on_rounds[len(on_rounds) // 2]
     pair_overheads.sort()
-    overhead_pct = (pair_overheads[len(pair_overheads) // 2]
-                    if pair_overheads else 0.0)
+    if not pair_overheads:
+        overhead_pct = 0.0
+    elif len(pair_overheads) % 2:
+        overhead_pct = pair_overheads[len(pair_overheads) // 2]
+    else:
+        mid = len(pair_overheads) // 2
+        overhead_pct = (pair_overheads[mid - 1] + pair_overheads[mid]) / 2.0
     return {
         "%s_off_tput" % prefix: round(off_tput, 2),
         "%s_off_p50_us" % prefix: round(off_p50, 1),
@@ -1354,7 +1366,7 @@ def _overhead_ab_measure(core, toggle, prefix: str,
 
 def run_telemetry_measure(core, model_name: str = "add_sub_large",
                           threads: int = 4, requests: int = 120,
-                          rounds: int = 4) -> dict:
+                          rounds: int = 8) -> dict:
     """Latency-histogram recording overhead: the identical closed loop
     with the telemetry registry disabled vs enabled (the always-on
     default). Each served request pays ~5 histogram observations
@@ -1370,7 +1382,7 @@ def run_telemetry_measure(core, model_name: str = "add_sub_large",
 
 def run_flight_measure(core, model_name: str = "add_sub_large",
                        threads: int = 4, requests: int = 120,
-                       rounds: int = 4) -> dict:
+                       rounds: int = 8) -> dict:
     """Flight-recorder capture overhead: the identical closed loop
     with the recorder disabled vs enabled (the always-on default).
     With capture on, EVERY request builds a scratch span tree

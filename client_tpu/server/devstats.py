@@ -273,6 +273,22 @@ class DeviceLedger:
                 components.get(row.component, 0) + row.nbytes
         return row.nbytes
 
+    def mark_paged_bytes(self, model: str, component: str,
+                         nbytes: int) -> int:
+        """Parks ``nbytes`` straight into the paged-out side table —
+        the row-less variant of :meth:`mark_paged`, for a component
+        whose register was never observed (load-measure failure) but
+        whose bytes did move to host: the paged set still names it.
+        Returns the bytes parked (0 for empty sizes)."""
+        nbytes = int(nbytes)
+        if nbytes <= 0:
+            return 0
+        with self._lock:
+            components = self._paged.setdefault(str(model), {})
+            components[str(component)] = \
+                components.get(str(component), 0) + nbytes
+        return nbytes
+
     def unmark_paged(self, model: str, component: str,
                      nbytes: Optional[int] = None) -> int:
         """Removes up to ``nbytes`` (all when None) from the paged-out

@@ -166,24 +166,6 @@ def offload_tree(tree):
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
-def _upload_leaf(leaf, device, chunk_bytes: int, pool):
-    """One host array -> device, chunked-parallel past the split
-    threshold: row slices ride concurrent ``device_put`` calls (the
-    :meth:`OutputFetcher.start` plan run in reverse) and reassemble
-    with one device-side concatenate."""
-    import jax
-    import jax.numpy as jnp
-
-    if not isinstance(leaf, np.ndarray):
-        return leaf
-    plan = OutputFetcher._chunk_plan(leaf, chunk_bytes)
-    if plan is None or pool is None:
-        return jax.device_put(leaf, device)
-    futures = [pool.submit(jax.device_put, leaf[lo:hi], device)
-               for lo, hi in plan]
-    return jnp.concatenate([f.result() for f in futures], axis=0)
-
-
 def upload_tree(tree, device=None, chunk_bytes: int = 0,
                 workers: int = 0):
     """Host pytree -> device pytree: the restore half of weight paging
@@ -191,28 +173,50 @@ def upload_tree(tree, device=None, chunk_bytes: int = 0,
     upload concurrently on a transient pool, and each leaf at or above
     2x ``chunk_bytes`` additionally splits along its leading axis into
     parallel ``device_put`` slices, so a single huge weight tensor
-    does not serialize the whole restore on one transfer stream."""
+    does not serialize the whole restore on one transfer stream.
+
+    The job list is FLAT: this thread plans every chunk up front and
+    submits one pool job per whole leaf or per slice, and is also the
+    only thread that waits on futures. A job must never submit to and
+    then wait on this same bounded pool — with every worker blocked
+    inside a leaf waiting for slice jobs queued behind it, the pool
+    deadlocks (the same jobs-never-wait-on-jobs rule as the landing
+    pool)."""
     try:
         import jax
+        import jax.numpy as jnp
     except Exception:  # noqa: BLE001 — no runtime: hand back as-is
         return tree
     chunk_bytes = chunk_bytes if chunk_bytes > 0 else DEFAULT_CHUNK_BYTES
     leaves, treedef = jax.tree_util.tree_flatten(tree)
-    array_count = sum(1 for leaf in leaves if isinstance(leaf, np.ndarray))
-    if array_count == 0:
+    if not any(isinstance(leaf, np.ndarray) for leaf in leaves):
         return tree
     from concurrent.futures import ThreadPoolExecutor
 
     with ThreadPoolExecutor(
             max_workers=(workers if workers > 0 else DEFAULT_WORKERS),
             thread_name_prefix="hbm-restore") as pool:
-        futures = [
-            pool.submit(_upload_leaf, leaf, device, chunk_bytes, pool)
-            if isinstance(leaf, np.ndarray) else None
-            for leaf in leaves
-        ]
-        out = [future.result() if future is not None else leaf
-               for future, leaf in zip(futures, leaves)]
+        uploads = []
+        for leaf in leaves:
+            if not isinstance(leaf, np.ndarray):
+                uploads.append(None)
+                continue
+            plan = OutputFetcher._chunk_plan(leaf, chunk_bytes)
+            if plan is None:
+                uploads.append(pool.submit(jax.device_put, leaf, device))
+            else:
+                uploads.append([
+                    pool.submit(jax.device_put, leaf[lo:hi], device)
+                    for lo, hi in plan])
+        out = []
+        for leaf, upload in zip(leaves, uploads):
+            if upload is None:
+                out.append(leaf)
+            elif isinstance(upload, list):
+                out.append(jnp.concatenate(
+                    [f.result() for f in upload], axis=0))
+            else:
+                out.append(upload.result())
     return jax.tree_util.tree_unflatten(treedef, out)
 
 

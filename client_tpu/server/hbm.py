@@ -42,6 +42,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from client_tpu import status_map
 from client_tpu.server import devstats as devstats_mod
 from client_tpu.server import fetch
+from client_tpu.utils import InferenceServerException
 
 LOG = logging.getLogger("client_tpu.hbm")
 
@@ -358,12 +359,15 @@ class HbmAllocator:
                 dev.leased += nbytes
             return
         if nbytes > dev.capacity:
-            raise status_map.retryable_error(
+            # Permanent, not a pressure condition: no amount of
+            # eviction or waiting makes the component fit, so the
+            # error is non-retryable (a Retry-After here would have
+            # well-behaved clients retrying forever).
+            raise InferenceServerException(
                 "component needs %d bytes but device %s has %d total: "
                 "it can never fit this budget"
                 % (nbytes, dev.key, dev.capacity),
-                status="RESOURCE_EXHAUSTED",
-                retry_after_s=MAX_RESTORE_ESTIMATE_S)
+                status="INVALID_ARGUMENT")
         skip: set = set()
         while True:
             with self._lock:
@@ -382,8 +386,10 @@ class HbmAllocator:
                     status="RESOURCE_EXHAUSTED",
                     retry_after_s=self.restore_estimate_s(nbytes))
             try:
-                self._count_eviction(victim, reason)
-                self._do_page_out(victim)
+                if self._do_page_out(victim):
+                    self._count_eviction(victim, reason)
+                else:  # concurrently released/paged: pick another
+                    skip.add(id(victim))
             except Exception:  # noqa: BLE001 — a victim whose page-
                 # out fails stays resident; skip it or the loop spins.
                 LOG.warning("hbm: eviction page-out of %s/%s failed",
@@ -425,11 +431,20 @@ class HbmAllocator:
 
     # -- paging ------------------------------------------------------------
 
-    def _do_page_out(self, lease: HbmLease) -> None:
+    def _do_page_out(self, lease: HbmLease) -> bool:
         """Device->host for one lease. Caller holds ``dev.arb`` (all
         page-outs serialize with admission); never holds
         ``self._lock`` — the quiesce waits on in-flight requests and
-        the copy is a device transfer."""
+        the copy is a device transfer. Returns True when the lease
+        committed to ``paged_out``, False when a concurrent
+        release/page-out made it a no-op. The RELEASED re-checks are
+        load-bearing: release()/release_model() take only
+        ``self._lock``, so an unload can land at any point during the
+        copy — a RELEASED lease is terminal and must never be
+        resurrected or have its bytes settled twice."""
+        with self._lock:
+            if lease.state != RESIDENT:
+                return False
         quiesce = lease.on_page_out
         if quiesce is not None:
             quiesce()
@@ -437,35 +452,49 @@ class HbmAllocator:
             lease.host_state = lease.pager.page_out()
         except Exception:
             # Weights are still resident: undo the quiesce so the
-            # model does not strand UNAVAILABLE behind a failed copy.
+            # model does not strand UNAVAILABLE behind a failed copy
+            # (unless a racing release already tore the model down —
+            # then there is nothing left to mark ready).
             ready = lease.on_restore
-            if ready is not None:
+            with self._lock:
+                released = lease.state == RELEASED
+            if ready is not None and not released:
                 ready()
             raise
         with self._lock:
+            if lease.state != RESIDENT:
+                # Released mid-copy: the teardown already settled the
+                # device bytes and the ledger; the host copy just
+                # dies here.
+                lease.host_state = None
+                return False
             lease.state = PAGED_OUT
+            row, lease.ledger_row = lease.ledger_row, None
             dev = self._devices.get(lease.device_key)
             if dev is not None:
                 dev.leased = max(dev.leased - lease.nbytes, 0)
             self._pageouts[lease.model] = \
                 self._pageouts.get(lease.model, 0) + 1
-        row, lease.ledger_row = lease.ledger_row, None
         try:  # accounting must never block the data plane
             moved = self._stats.ledger.mark_paged(row)
             if not moved:
                 # Row was never registered (load-measure failure):
                 # park the bytes directly so the paged set still
                 # names this component.
-                ledger = self._stats.ledger
-                with ledger._lock:
-                    components = ledger._paged.setdefault(
-                        lease.model, {})
-                    components[lease.component] = \
-                        components.get(lease.component, 0) \
-                        + lease.nbytes
+                self._stats.ledger.mark_paged_bytes(
+                    lease.model, lease.component, lease.nbytes)
+            with self._lock:
+                released = lease.state == RELEASED
+            if released:
+                # release() raced the ledger move: its unmark ran
+                # before the bytes were parked, so undo the parking
+                # (idempotent — unmark clamps at what is held).
+                self._stats.ledger.unmark_paged(
+                    lease.model, lease.component, lease.nbytes)
         except Exception:  # noqa: BLE001
             LOG.warning("hbm: ledger page-out failed for %s/%s",
                         lease.model, lease.component, exc_info=True)
+        return True
 
     def page_out(self, lease: Optional[HbmLease],
                  reason: str = "scale_to_zero") -> int:
@@ -477,10 +506,8 @@ class HbmAllocator:
         dev = self._device(lease.device_key)
         dev.arb.acquire()
         try:
-            with self._lock:
-                if lease.state != RESIDENT:
-                    return 0
-            self._do_page_out(lease)
+            if not self._do_page_out(lease):
+                return 0
         finally:
             dev.arb.release()
         return lease.nbytes
@@ -514,6 +541,11 @@ class HbmAllocator:
                 if lease.state != PAGED_OUT:
                     lease.restoring = False
                     return lease.state == RESIDENT
+                # Pin the host copy now: a release() racing this
+                # restore nulls lease.host_state without holding
+                # dev.arb, and the upload must not read a torn-down
+                # None (the local reference keeps the tree alive).
+                host_state = lease.host_state
             try:
                 self._reserve(dev, lease.nbytes, lease.model, reason)
             except Exception:
@@ -522,7 +554,7 @@ class HbmAllocator:
                 raise
             started_ns = time.monotonic_ns()
             try:
-                lease.pager.restore(lease.host_state)
+                lease.pager.restore(host_state)
             except Exception:
                 with self._lock:
                     dev.leased = max(dev.leased - lease.nbytes, 0)
@@ -531,10 +563,8 @@ class HbmAllocator:
             elapsed_s = max((time.monotonic_ns() - started_ns) / 1e9,
                             1e-9)
             with self._lock:
-                lease.state = RESIDENT
-                lease.host_state = None
-                lease.restoring = False
-                lease.last_used = time.monotonic()
+                # The transfer was real either way: let it price
+                # future Retry-After estimates.
                 bandwidth = lease.nbytes / elapsed_s
                 if self._restore_bw is None:
                     self._restore_bw = bandwidth
@@ -543,20 +573,51 @@ class HbmAllocator:
                         _BANDWIDTH_EWMA_ALPHA * bandwidth
                         + (1.0 - _BANDWIDTH_EWMA_ALPHA)
                         * self._restore_bw)
+                if lease.state == RELEASED:
+                    # unload_model raced the upload: release() saw
+                    # PAGED_OUT and settled the ledger but left the
+                    # device bytes alone, so the admission reserve is
+                    # ours to give back; the fresh device tree dies
+                    # with the lease. RELEASED is terminal — do not
+                    # resurrect it.
+                    dev_state = self._devices.get(lease.device_key)
+                    if dev_state is not None:
+                        dev_state.leased = max(
+                            dev_state.leased - lease.nbytes, 0)
+                    lease.restoring = False
+                    lease.host_state = None
+                    return False
+                lease.state = RESIDENT
+                lease.host_state = None
+                lease.restoring = False
+                lease.last_used = time.monotonic()
             self._observe_restore(lease.model, elapsed_s * 1e6)
             try:  # accounting must never block the data plane
                 self._stats.ledger.unmark_paged(
                     lease.model, lease.component, lease.nbytes)
-                lease.ledger_row = self._stats.ledger.register(
+                row = self._stats.ledger.register(
                     lease.model, lease.component, lease.nbytes)
+                try:
+                    with self._lock:
+                        if lease.state != RELEASED:
+                            lease.ledger_row, row = row, None
+                finally:
+                    if row is not None:
+                        # Released between the RESIDENT commit and
+                        # the re-register (release saw no row to
+                        # drop): the fresh row must not outlive the
+                        # lease.
+                        self._stats.ledger.release(row)
             except Exception:  # noqa: BLE001
                 LOG.warning("hbm: ledger restore failed for %s/%s",
                             lease.model, lease.component,
                             exc_info=True)
             ready = lease.on_restore
-            if ready is not None:
+            with self._lock:
+                still_resident = lease.state == RESIDENT
+            if ready is not None and still_resident:
                 ready()
-            return True
+            return still_resident
         finally:
             dev.arb.release()
 
@@ -640,8 +701,10 @@ class HbmAllocator:
                 if victim is None:
                     return
                 try:
-                    self._count_eviction(victim, reason)
-                    self._do_page_out(victim)
+                    if self._do_page_out(victim):
+                        self._count_eviction(victim, reason)
+                    else:  # concurrently released/paged
+                        skip.add(id(victim))
                 except Exception:  # noqa: BLE001
                     LOG.warning("hbm: rebalance page-out of %s/%s "
                                 "failed", victim.model,

@@ -371,13 +371,25 @@ def test_grpc_server_sends_retry_after_on_unavailable():
         with grpcclient.InferenceServerClient(handle.address) as client:
             inputs = [grpcclient.InferInput("IN", [1, 4], "FP32")]
             inputs[0].set_data_from_numpy(np.ones((1, 4), np.float32))
-            threads = [
-                threading.Thread(
-                    target=lambda: _swallow(
-                        lambda: client.infer("gated_ra", inputs)),
-                    daemon=True)
-                for _ in range(6)
-            ]
+            def saturate():
+                # Keep the 1-deep queue occupied no matter how the
+                # batcher interleaves gather and enqueue: depending on
+                # scheduling, the gather can drain every admitted
+                # request into the executing batch while the rest shed
+                # at enqueue — leaving the queue EMPTY for the whole
+                # gate, so every probe below is admitted and expires
+                # DEADLINE_EXCEEDED instead of shedding. A shed
+                # saturator re-submits until it is admitted (or the
+                # gate opens), so probes always race a full queue.
+                while not model.gate.is_set():
+                    try:
+                        client.infer("gated_ra", inputs)
+                        return
+                    except Exception:  # noqa: BLE001 — shed: retry
+                        time.sleep(0.005)
+
+            threads = [threading.Thread(target=saturate, daemon=True)
+                       for _ in range(6)]
             for thread in threads:
                 thread.start()
             time.sleep(0.3)  # saturate the 1-deep queue

@@ -55,6 +55,36 @@ class FakeDeviceArray:
         return self._data
 
 
+def test_upload_tree_chunked_many_leaves_no_deadlock():
+    """Regression: chunk-slice jobs used to be submitted to the SAME
+    bounded pool their leaf job was blocking in — with every worker
+    holding a chunkable leaf, the slices queued behind them could
+    never run and the restore hung forever. The flat job plan uploads
+    the same tree bit-identically with no job ever waiting on the
+    pool it runs in."""
+    from client_tpu.server.fetch import upload_tree
+
+    leaves = {
+        "w%d" % i: np.arange(i, i + 2048,
+                             dtype=np.float32).reshape(8, 256)
+        for i in range(6)
+    }
+    done = {}
+
+    def run():
+        # chunk_bytes=1024 makes every 8 KiB leaf split into 8 slice
+        # jobs; workers=2 < chunkable-leaf count is the old hang.
+        done["tree"] = upload_tree(dict(leaves), chunk_bytes=1024,
+                                   workers=2)
+
+    worker = threading.Thread(target=run, daemon=True)
+    worker.start()
+    worker.join(30.0)
+    assert "tree" in done, "upload_tree deadlocked on nested submits"
+    for name, host in leaves.items():
+        assert np.array_equal(np.asarray(done["tree"][name]), host)
+
+
 # -- primitives ------------------------------------------------------------
 
 

@@ -145,11 +145,15 @@ def test_admission_deferral_and_release():
     assert alloc._stats.ledger.model_bytes("b") == {}
 
 
-def test_oversize_request_raises_immediately():
+def test_oversize_request_raises_immediately_nonretryable():
     alloc = _allocator(1000)
+    # Bigger than the whole device is a PERMANENT condition, not
+    # pressure: the error must not carry a Retry-After, or clients
+    # would retry it forever.
     with pytest.raises(InferenceServerException) as raised:
         alloc.lease("huge", "weights", 2000)
-    assert raised.value.retry_after_s == hbm_mod.MAX_RESTORE_ESTIMATE_S
+    assert raised.value.status() == "INVALID_ARGUMENT"
+    assert getattr(raised.value, "retry_after_s", None) is None
 
 
 def test_zero_and_best_effort_leases():
@@ -220,6 +224,90 @@ def test_failed_pageout_victim_is_skipped_and_unquiesced():
     # failed copy must not strand a model UNAVAILABLE.
     assert victim.state == hbm_mod.RESIDENT
     assert calls == {"quiesce": 1, "ready": 1}
+
+
+# -- release racing an in-flight transfer -----------------------------------
+
+
+class _GatedPager:
+    """Pager whose transfers park on an event — lets a test land a
+    release() in the middle of a page-out or restore copy."""
+
+    def __init__(self, block_page_out=False, block_restore=False):
+        self.started = threading.Event()
+        self.proceed = threading.Event()
+        self._block_page_out = block_page_out
+        self._block_restore = block_restore
+
+    def _gate(self, blocked):
+        if blocked:
+            self.started.set()
+            assert self.proceed.wait(8.0), "test gate never opened"
+
+    def page_out(self):
+        self._gate(self._block_page_out)
+        return {"host": 1}
+
+    def restore(self, host_state):
+        self._gate(self._block_restore)
+
+
+def test_release_during_page_out_stays_terminal():
+    """An unload landing mid-page-out must not resurrect the lease or
+    settle its device bytes twice (the keeper lease would be the one
+    silently over-admitted against)."""
+    alloc = _allocator(1000)
+    keeper = alloc.lease("keep", "weights", 300)
+    pager = _GatedPager(block_page_out=True)
+    doomed = alloc.lease("m", "weights", 400, pageable=True, pager=pager)
+    worker = threading.Thread(target=alloc.page_out, args=(doomed,))
+    worker.start()
+    assert pager.started.wait(8.0)
+    alloc.release(doomed)  # unload racing the device->host copy
+    pager.proceed.set()
+    worker.join(8.0)
+    assert not worker.is_alive()
+    assert doomed.state == hbm_mod.RELEASED
+    assert doomed.host_state is None
+    (dev,) = alloc.debug_snapshot()["devices"].values()
+    assert dev["leased_bytes"] == 300  # keeper intact, no double-free
+    assert alloc._stats.ledger.model_bytes("m") == {}
+    assert alloc._stats.ledger.paged_snapshot() == {}
+    alloc.release(keeper)
+
+
+def test_release_during_restore_stays_terminal():
+    """An unload landing mid-restore must not flip the lease back to
+    RESIDENT (mark_ready on a mid-teardown model) and must hand the
+    admission reserve back."""
+    alloc = _allocator(1000)
+    keeper = alloc.lease("keep", "weights", 300)
+    pager = _GatedPager(block_restore=True)
+    doomed = alloc.lease("m", "weights", 400, pageable=True, pager=pager)
+    readies = {"count": 0}
+    doomed.on_restore = lambda: readies.__setitem__(
+        "count", readies["count"] + 1)
+    assert alloc.page_out(doomed) == 400
+    results = {}
+
+    def run():
+        results["restored"] = alloc.restore(doomed)
+
+    worker = threading.Thread(target=run)
+    worker.start()
+    assert pager.started.wait(8.0)
+    alloc.release(doomed)  # unload racing the host->device upload
+    pager.proceed.set()
+    worker.join(8.0)
+    assert not worker.is_alive()
+    assert results["restored"] is False
+    assert doomed.state == hbm_mod.RELEASED
+    assert readies["count"] == 0  # never marked ready mid-teardown
+    (dev,) = alloc.debug_snapshot()["devices"].values()
+    assert dev["leased_bytes"] == 300  # reserve given back
+    assert alloc._stats.ledger.model_bytes("m") == {}
+    assert alloc._stats.ledger.paged_snapshot() == {}
+    alloc.release(keeper)
 
 
 # -- arbitration ------------------------------------------------------------

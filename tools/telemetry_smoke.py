@@ -165,13 +165,13 @@ def main() -> int:
         # filters transient contention (another process's burst can
         # skew a 4-pair median past 2% when the real cost is ~0).
         core.repository.load("add_sub_large")
-        overhead = run_telemetry_measure(core, requests=96, rounds=4)
+        overhead = run_telemetry_measure(core, requests=96)
         if not overhead["overhead_ok"]:
             print("overhead first pass %.2f%% over the gate; "
                   "re-measuring with more pairs"
                   % overhead["overhead_pct"])
             overhead = run_telemetry_measure(core, requests=96,
-                                             rounds=6)
+                                             rounds=12)
         print("overhead: %.2f%% (off %.1f/s vs on %.1f/s; pairs %s; "
               "gate <%.0f%%)"
               % (overhead["overhead_pct"],
