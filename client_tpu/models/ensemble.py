@@ -95,11 +95,12 @@ class DataflowContext:
 
     __slots__ = ("trace", "telemetry", "stats_recorder", "batcher_for",
                  "target_for", "cache_lookup", "cache_insert",
-                 "queue_from_ns")
+                 "queue_from_ns", "cancel")
 
     def __init__(self, trace=None, telemetry=None, stats_recorder=None,
                  batcher_for=None, target_for=None, cache_lookup=None,
-                 cache_insert=None, queue_from_ns: int = 0):
+                 cache_insert=None, queue_from_ns: int = 0,
+                 cancel=None):
         self.trace = trace
         self.telemetry = telemetry
         self.stats_recorder = stats_recorder
@@ -111,6 +112,11 @@ class DataflowContext:
         self.cache_lookup = cache_lookup
         self.cache_insert = cache_insert
         self.queue_from_ns = queue_from_ns
+        # The request's CancelToken (or None): checked between
+        # composing stages so a cancelled request aborts the remaining
+        # subgraph, and its remaining deadline budget replaces the
+        # original `timeout` in each stage's queue policy.
+        self.cancel = cancel
 
 
 class EnsembleModel(ServedModel):
@@ -286,6 +292,21 @@ class EnsembleModel(ServedModel):
         interior_leases = []
         try:
             for k in range(start_index, len(steps)):
+                step_params = params
+                if ctx.cancel is not None:
+                    # Stage boundary: abort the remaining subgraph the
+                    # moment the caller is gone (work already done for
+                    # earlier stages may still populate the composing
+                    # cache — it was paid for and is reusable).
+                    ctx.cancel.raise_if_cancelled("ensemble")
+                    remaining = ctx.cancel.remaining_us()
+                    if remaining is not None:
+                        # Each stage gets the REMAINING deadline budget
+                        # (deadline minus elapsed), not the original
+                        # timeout — a deep graph must not overshoot its
+                        # caller's deadline by N x stages.
+                        step_params = dict(params)
+                        step_params["timeout"] = remaining
                 model_name, input_map, output_map = steps[k]
                 model = self._repository.load(model_name)
                 step_inputs, count = self._wire_step(
@@ -296,15 +317,16 @@ class EnsembleModel(ServedModel):
                 executions = 1
                 if batcher is not None and "sequence_id" not in params:
                     step_outputs, queue_ns, leader = batcher.infer(
-                        step_inputs, params, count, trace=ctx.trace,
-                        queue_from_ns=mark, device_outputs=True)
+                        step_inputs, step_params, count, trace=ctx.trace,
+                        queue_from_ns=mark, device_outputs=True,
+                        cancel=ctx.cancel)
                     executions = 1 if leader else 0
                     if not leader and ctx.telemetry is not None:
                         ctx.telemetry.record_ensemble_fused(self.name)
                 else:
                     target = (ctx.target_for(model)
                               if ctx.target_for is not None else model)
-                    step_outputs = target.infer(step_inputs, params)
+                    step_outputs = target.infer(step_inputs, step_params)
                 end = time.monotonic_ns()
                 queue_ns_total += queue_ns
                 if ctx.stats_recorder is not None:

@@ -1993,6 +1993,20 @@ class LlmModel(ServedModel):
         # liveness check and the append would otherwise leave the
         # request stranded — this restart sees it in the queue.
         self._ensure_scheduler()
+        cancel = (parameters or {}).get("cancel_token")
+        handle = None
+        if cancel is not None:
+            # Explicit cancellation (wire cancel, hedge loser, chaos
+            # abandon) between decode chunks: mark the lane for reap,
+            # wake the consumer with the end sentinel, and poke the
+            # scheduler so pages/reservations free at the NEXT chunk
+            # boundary instead of after the full decode budget.
+            def _reap_lane():
+                request.cancelled = True
+                request.queue.put(None)
+                with self._sched_cv:
+                    self._sched_cv.notify_all()
+            handle = cancel.on_cancel(_reap_lane)
         try:
             while True:
                 token = request.queue.get()
@@ -2000,6 +2014,8 @@ class LlmModel(ServedModel):
                     break
                 yield token
         finally:
+            if handle is not None:
+                cancel.remove_callback(handle)
             # Consumer gone (client disconnect closes the generator):
             # let the scheduler reclaim the lane at the next chunk.
             request.cancelled = True

@@ -385,6 +385,7 @@ def call_with_retry(
     deadline_s: Optional[float] = None,
     sleep: Callable[[float], None] = time.sleep,
     clock: Callable[[], float] = time.monotonic,
+    cancel_fn: Optional[Callable[[], None]] = None,
 ):
     """Run ``fn(remaining_timeout_s)`` under the retry policy.
 
@@ -393,6 +394,11 @@ def call_with_retry(
     as its transport timeout, so later attempts get strictly less time.
     Only :class:`InferenceServerException` is ever retried; breaker
     open-state failures raise without consuming retry attempts.
+    ``cancel_fn`` (best-effort, e.g. ``POST /v2/cancel/<id>``) fires
+    before a retry that follows a client-side DEADLINE_EXCEEDED: the
+    timed-out attempt was *abandoned*, not answered — without the
+    cancel the server keeps computing a response nobody will read
+    while the retry doubles the load.
     """
     start = clock()
     attempt = 0
@@ -428,6 +434,14 @@ def call_with_retry(
                 # only delay the failure and skew the chaos report.
                 _note_if_exhausted(policy, e)
                 raise
+            if cancel_fn is not None \
+                    and (e.status() or "") == "DEADLINE_EXCEEDED":
+                # Client-timeout failover: the abandoned attempt may
+                # still be computing server-side.
+                try:
+                    cancel_fn()
+                except Exception:  # noqa: BLE001 — best-effort signal
+                    pass
             note_retries()
             sleep(delay)
             attempt += 1
@@ -947,12 +961,15 @@ def _remaining_of(deadline_s, start, clock):
 
 def _hedged_call(pool: EndpointPool, fn, primary: EndpointState,
                  deadline_s: Optional[float], start: float, clock,
-                 hedge: bool):
+                 hedge: bool, cancel_fn=None):
     """Run one logical attempt, optionally hedged: the primary runs on
     a worker thread; if it hasn't answered within the pool's hedge
     delay and the budget admits, the same request fires at a second
-    endpoint and the first SUCCESS wins (the loser's response is
-    discarded and counted). Falls back to a plain inline attempt when
+    endpoint and the first SUCCESS wins. The loser is not silently
+    discarded: ``cancel_fn(endpoint_state)`` (when provided) sends a
+    real wire cancel for the still-pending attempt, so budgeted
+    hedging stops double-charging the fleet — Dean & Barroso's
+    tied-request rule. Falls back to a plain inline attempt when
     hedging can't apply."""
     workers = None
     if hedge and pool.hedge_max_ratio > 0 and len(pool) >= 2:
@@ -1023,6 +1040,18 @@ def _hedged_call(pool: EndpointPool, fn, primary: EndpointState,
             settled.set()
             if len(launched) > 1 and state is launched[1]:
                 pool.note_hedge_won()
+            if cancel_fn is not None and pending > 0:
+                # A winner settled while attempts are still in flight:
+                # wire-cancel each pending loser instead of letting
+                # its server compute a response nobody reads.
+                finished = {id(state)}
+                finished.update(id(s) for s, _ in errors)
+                for loser in launched:
+                    if id(loser) not in finished:
+                        try:
+                            cancel_fn(loser)
+                        except Exception:  # noqa: BLE001 — best-effort
+                            pass
             return result
         errors.append((state, error))
         if pending <= 0:
@@ -1045,6 +1074,7 @@ def call_with_retry_pool(
     hedge: bool = True,
     sleep: Callable[[float], None] = time.sleep,
     clock: Callable[[], float] = time.monotonic,
+    cancel_fn=None,
 ):
     """Pool-aware twin of :func:`call_with_retry`.
 
@@ -1056,6 +1086,8 @@ def call_with_retry_pool(
     Without a policy the budget is one attempt per endpoint (pure
     failover). Sequence-correlated requests (``sequence_id``) are
     sticky-routed and never hedged; ``sequence_end`` releases the pin.
+    ``cancel_fn(endpoint_state)`` wire-cancels a hedge loser's
+    still-pending attempt at that endpoint (best-effort).
     """
     start = clock()
     attempt = 0
@@ -1088,7 +1120,8 @@ def call_with_retry_pool(
                 raise
         try:
             result = _hedged_call(pool, fn, state, deadline_s, start,
-                                  clock, hedge and not sequence_id)
+                                  clock, hedge and not sequence_id,
+                                  cancel_fn=cancel_fn)
         except InferenceServerException as e:
             status = e.status() or ""
             retryable = (policy.is_retryable(e) if policy is not None

@@ -70,6 +70,7 @@ import numpy as np
 
 from client_tpu.server import tracing as spantrace
 from client_tpu import status_map
+from client_tpu.server import cancel as cancel_mod
 from client_tpu.server.fetch import OutputFetcher
 from client_tpu.server.qos import coerce_int, coerce_priority
 from client_tpu.utils import InferenceServerException
@@ -329,6 +330,7 @@ class DynamicBatcher:
                  priority_policies: Optional[Dict[int, dict]] = None,
                  shed_watermark: float = 0.0,
                  shed_hook: Optional[Callable[..., None]] = None,
+                 wasted_hook: Optional[Callable[[int], None]] = None,
                  execution_target=None,
                  telemetry=None,
                  overlapped_fetch: bool = True,
@@ -374,6 +376,11 @@ class DynamicBatcher:
         self._priority_policies = dict(priority_policies or {})
         self._shed_watermark = min(max(float(shed_watermark), 0.0), 1.0)
         self._shed_hook = shed_hook
+        # Wasted-compute accounting (tpu_wasted_compute_us): called
+        # with the device-ns share attributable to fused members that
+        # were already cancelled when their batch completed — work
+        # nobody read, priced by _finish.
+        self._wasted_hook = wasted_hook
         # Controller-ordered shed (qos.ShedDirective, set by the
         # autoscale loop when the SLO is unmeetable at max scale):
         # while active, lowest-class arrivals shed at the door with
@@ -497,7 +504,8 @@ class DynamicBatcher:
               queue_from_ns: int = 0,
               priority: Optional[int] = None,
               wanted_outputs=None,
-              device_outputs=None) -> Dict[str, np.ndarray]:
+              device_outputs=None,
+              cancel=None) -> Dict[str, np.ndarray]:
         """Blocks until this request's slice of a fused execution is
         ready. `batch` is the request's own batch-dim size; `trace` is
         the request's RequestTrace when sampled (never part of the
@@ -567,7 +575,21 @@ class DynamicBatcher:
                 self._pending_by_priority[priority] = \
                     self._pending_by_priority.get(priority, 0) + 1
             self._cv.notify_all()
-        pending.event.wait()
+        if cancel is not None:
+            # Event-driven wakeup, not a poll: the token fires
+            # _cancel_pending which drops a still-queued member (or
+            # marks a dispatched one stage="execute" — its fused XLA
+            # call is never unpadded, its slice simply isn't fetched)
+            # and sets the event. Removal is paired in a finally so a
+            # recycled token can never poke a completed pending.
+            handle = cancel.on_cancel(
+                lambda: self._cancel_pending(pending))
+            try:
+                pending.event.wait()
+            finally:
+                cancel.remove_callback(handle)
+        else:
+            pending.event.wait()
         if trace is not None and pending.done_ns:
             # Wake latency: the batch finished (done_ns stamped by
             # _finish) but this thread had to be rescheduled — real
@@ -577,6 +599,37 @@ class DynamicBatcher:
         if pending.error is not None:
             raise pending.error
         return pending.outputs, pending.queue_ns, pending.leader
+
+    def _cancel_pending(self, pending: _Pending) -> None:
+        """CancelToken wakeup for one waiter. Still queued: the member
+        is removed from its bucket (never reaches the device) —
+        stage "queue". Already dispatched: the in-flight fused XLA
+        call is NOT re-padded or interrupted; the member is marked
+        done with a CANCELLED error and PR-12's per-member early
+        completion (_wake_ready/_scatter/_finish all skip event-set
+        members) guarantees its slice is never fetched or encoded —
+        stage "execute", and _finish bills its share of the batch's
+        compute as wasted."""
+        with self._cv:
+            if pending.event.is_set():
+                return  # completed (or expired/shed) before the signal
+            bucket = self._buckets.get(pending.shape_key)
+            removed = bucket is not None and bucket.remove(pending)
+            if removed:
+                if not bucket.queues:
+                    del self._buckets[pending.shape_key]
+                self._drop_accounting_locked(pending)
+                stage = "queue"
+            else:
+                stage = "execute"
+            pending.queue_ns = time.monotonic_ns() - pending.enqueue_ns
+            pending.error = cancel_mod.cancelled_error(
+                "request for model '%s' cancelled %s"
+                % (getattr(self._model, "name", "?"),
+                   "in queue" if removed else "while executing"),
+                stage)
+            pending.event.set()
+            self._cv.notify_all()
 
     # -- queue policy -----------------------------------------------------
 
@@ -1251,6 +1304,20 @@ class DynamicBatcher:
                 self._stats_hook(executed, compute_ns, fetch_ns)
             except Exception:  # noqa: BLE001 — stats never fail serving
                 pass
+        if ok and self._wasted_hook is not None and compute_ns \
+                and executed:
+            # Members cancelled AFTER dispatch (stage "execute") rode
+            # the fused call to completion but nobody reads their
+            # slice: bill their row-proportional share of the batch's
+            # device time as wasted compute.
+            wasted_ns = sum(
+                compute_ns * p.batch // executed for p in bucket
+                if getattr(p.error, "cancel_stage", None) == "execute")
+            if wasted_ns:
+                try:
+                    self._wasted_hook(wasted_ns)
+                except Exception:  # noqa: BLE001 — stats never fail
+                    pass  # serving
         if ok and self._telemetry is not None \
                 and self._telemetry.enabled and compute_ns:
             try:
@@ -1415,8 +1482,11 @@ def _fuse_chunks(chunks, target: int, total: int):
 # Parameters enforced per request by the scheduler itself, never by
 # the model: they must not fragment fusion. `timeout` (PR 2) is a
 # per-request deadline; `priority` orders dispatch but the fused batch
-# executes identically; `tenant` is admission-control identity.
-_QOS_PARAMS = frozenset(("timeout", "priority", "tenant"))
+# executes identically; `tenant` is admission-control identity;
+# `cancel_token` is the request's CancelToken riding params into the
+# decoupled stream path — per-request lifecycle, never batch identity.
+_QOS_PARAMS = frozenset(("timeout", "priority", "tenant",
+                         "cancel_token"))
 
 
 def _params_fingerprint(params: dict):

@@ -60,6 +60,8 @@ _UNCACHED_PARAMS = frozenset((
     "tenant",
     "triton_enable_empty_final_response",
     "binary_data_output",
+    # Per-request cancellation lifecycle — never response identity.
+    "cancel_token",
 ))
 
 # Any of these marks a correlated (stateful) request: bypass entirely.

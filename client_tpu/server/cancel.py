@@ -1,0 +1,273 @@
+"""Request-lifecycle cancellation: one token, every stage, all resources.
+
+PR-2 gave requests a deadline but only honored it *before* dispatch:
+once a request left the front of the batcher queue the server computed
+to completion whether or not anybody was still listening. Under hedged
+(PR-4) and retried traffic, and abandoned LLM streams (PR-13), that is
+the "wasted work amplification" failure mode from Dean & Barroso's
+*The Tail at Scale* — device time spent producing responses nobody
+reads.
+
+This module is the one signal that threads through every layer:
+
+``CancelToken``
+    Minted at admission (``core.infer`` / ``core.stream_infer``),
+    carries the request's absolute deadline and a cancel flag.
+    *Sources* (HTTP transport close, embed socket EOF, gRPC context
+    callbacks, the ``/v2/cancel/<id>`` route, hedging losers, chaos
+    ``abandon_rate``) call :meth:`CancelToken.cancel`. *Sinks* (the
+    batcher, the LLM scheduler, ensembles, cache followers, sequence
+    slots) either poll :meth:`raise_if_cancelled` at stage boundaries
+    or register a wakeup via :meth:`on_cancel` — every ``on_cancel``
+    must be paired with :meth:`remove_callback` in a ``finally``
+    (tpulint's resource-pairing checker enforces this, same as
+    acquire/release).
+
+``CancelRegistry``
+    Bounded request-id -> token map powering explicit wire
+    cancellation (``core.cancel_request``), plus the subsystem
+    kill-switch: ``registry.enabled`` (env ``CLIENT_TPU_CANCEL=off``)
+    disables token minting entirely so the paired-A/B overhead driver
+    can price the hot-path cost of the always-on checks.
+
+Cancellation raised by a token is an ``InferenceServerException`` with
+status ``CANCELLED`` (or ``DEADLINE_EXCEEDED`` when the deadline — not
+an explicit signal — fired after dispatch) carrying a ``cancel_stage``
+attribute naming the stage boundary where the signal landed; the core
+turns that into ``tpu_request_cancelled_total{model,stage}`` and the
+``cancelled`` terminal span attr.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict
+from typing import Callable, Dict, Optional
+
+from client_tpu.utils import InferenceServerException
+
+#: Canonical cancellation reasons. Free-form strings are accepted too;
+#: these exist so sources agree on spelling (the reason lands in the
+#: error message, the flight recorder, and the ``cancelled`` span attr).
+REASON_CLIENT_DISCONNECT = "client_disconnect"
+REASON_WIRE_CANCEL = "wire_cancel"
+REASON_DEADLINE = "deadline"
+REASON_HEDGE_LOSER = "hedge_loser"
+REASON_RETRY_ABANDONED = "retry_abandoned"
+REASON_ABANDONED = "abandoned"
+
+_ENV_FLAG = "CLIENT_TPU_CANCEL"
+_OFF_VALUES = ("off", "0", "false", "no")
+
+
+def cancelled_error(message: str, stage: str,
+                    status: str = "CANCELLED") -> InferenceServerException:
+    """A CANCELLED (or post-dispatch DEADLINE_EXCEEDED) error stamped
+    with the stage boundary where the signal landed."""
+    error = InferenceServerException(message, status=status)
+    error.cancel_stage = stage
+    return error
+
+
+def deadline_from_timeout_us(timeout_us,
+                             now_ns: Optional[int] = None) -> Optional[int]:
+    """Absolute monotonic deadline from the PR-2 ``timeout`` request
+    parameter (microseconds), or None when absent/invalid. The same
+    parameter the batcher's queue policy reads — the token simply
+    carries it past dispatch."""
+    try:
+        timeout_us = int(timeout_us)
+    except (TypeError, ValueError):
+        return None
+    if timeout_us <= 0:
+        return None
+    if now_ns is None:
+        now_ns = time.monotonic_ns()
+    return now_ns + timeout_us * 1000
+
+
+class CancelToken:
+    """Per-request cancel flag + absolute deadline, observed at every
+    stage boundary.
+
+    Thread-safe. ``cancel()`` is idempotent; callbacks registered via
+    ``on_cancel`` fire exactly once (immediately, if registration
+    happens after cancellation) and are invoked outside the token lock
+    so they may take subsystem locks (batcher CV, scheduler CV).
+    """
+
+    __slots__ = ("request_id", "deadline_ns", "reason", "stage",
+                 "_cancelled", "_lock", "_callbacks", "_next_handle")
+
+    def __init__(self, deadline_ns: Optional[int] = None,
+                 request_id: Optional[str] = None):
+        self.request_id = request_id
+        self.deadline_ns = deadline_ns
+        self.reason: Optional[str] = None
+        #: Stage boundary where the signal landed (first raise wins);
+        #: the core copies it into the terminal span attr.
+        self.stage: Optional[str] = None
+        self._cancelled = False
+        self._lock = threading.Lock()
+        self._callbacks: Dict[int, Callable[[], None]] = {}
+        self._next_handle = 0
+
+    # -- source side ---------------------------------------------------
+
+    def cancel(self, reason: str = REASON_WIRE_CANCEL) -> bool:
+        """Flip the flag and fire registered wakeups. Returns True if
+        this call performed the transition (False when already
+        cancelled — late losers and double disconnects are no-ops)."""
+        with self._lock:
+            if self._cancelled:
+                return False
+            self._cancelled = True
+            self.reason = reason
+            callbacks = list(self._callbacks.values())
+            self._callbacks.clear()
+        for fn in callbacks:
+            try:
+                fn()
+            except Exception:
+                pass  # a sink's wakeup must never mask the signal
+        return True
+
+    # -- sink side -----------------------------------------------------
+
+    def cancelled(self) -> bool:
+        return self._cancelled
+
+    def expired(self, now_ns: Optional[int] = None) -> bool:
+        if self.deadline_ns is None:
+            return False
+        return (now_ns or time.monotonic_ns()) >= self.deadline_ns
+
+    def cancelled_or_expired(self, now_ns: Optional[int] = None) -> bool:
+        return self._cancelled or self.expired(now_ns)
+
+    def remaining_us(self, now_ns: Optional[int] = None) -> Optional[int]:
+        """Microseconds of deadline budget left (floored at 0), or
+        None when the request carries no deadline. Ensembles use this
+        to hand each composing stage the *remaining* budget instead of
+        the full original timeout."""
+        if self.deadline_ns is None:
+            return None
+        remaining = self.deadline_ns - (now_ns or time.monotonic_ns())
+        return max(0, remaining // 1000)
+
+    def raise_if_cancelled(self, stage: str,
+                           now_ns: Optional[int] = None) -> None:
+        """Stage-boundary check: raise CANCELLED when a source fired,
+        DEADLINE_EXCEEDED when only the deadline lapsed (deadline
+        expiry *after* dispatch — PR-2 checked it only before)."""
+        if self._cancelled:
+            if self.stage is None:
+                self.stage = stage
+            raise cancelled_error(
+                "request cancelled (%s) at stage %r"
+                % (self.reason or "cancelled", stage), stage)
+        if self.expired(now_ns):
+            if self.stage is None:
+                self.stage = stage
+            raise cancelled_error(
+                "deadline exceeded after dispatch at stage %r" % stage,
+                stage, status="DEADLINE_EXCEEDED")
+
+    def on_cancel(self, fn: Callable[[], None]) -> int:
+        """Register a wakeup fired on cancellation; returns a handle
+        for :meth:`remove_callback`. Pair every registration with a
+        ``remove_callback`` in a ``finally`` — tokens outlive the
+        stage that registered, and a stale wakeup poking a recycled
+        pending is a use-after-free in spirit. If the token is already
+        cancelled the wakeup fires immediately (the handle is still
+        returned and still valid to remove)."""
+        fire = False
+        with self._lock:
+            self._next_handle += 1
+            handle = self._next_handle
+            if self._cancelled:
+                fire = True
+            else:
+                self._callbacks[handle] = fn
+        if fire:
+            try:
+                fn()
+            except Exception:
+                pass
+        return handle
+
+    def remove_callback(self, handle: int) -> None:
+        with self._lock:
+            self._callbacks.pop(handle, None)
+
+
+class CancelRegistry:
+    """Mints tokens and tracks in-flight ones by request id so
+    explicit wire cancellation (`POST /v2/cancel/<id>`, hedge-loser
+    cancels) can find them. Bounded like the flight recorder's
+    in-flight table: beyond MAX_TRACKED the oldest entry is evicted —
+    an evicted request simply can't be wire-cancelled any more, it
+    still honors disconnect/deadline signals via its token."""
+
+    MAX_TRACKED = 4096
+
+    def __init__(self, enabled: Optional[bool] = None):
+        if enabled is None:
+            enabled = os.environ.get(
+                _ENV_FLAG, "on").strip().lower() not in _OFF_VALUES
+        #: Kill switch: when False the core mints no tokens and every
+        #: stage check short-circuits on ``cancel is None``. The
+        #: paired-A/B overhead driver flips this per round.
+        self.enabled = bool(enabled)
+        self._lock = threading.Lock()
+        self._tokens: "OrderedDict[str, CancelToken]" = OrderedDict()
+        self.cancelled_by_id = 0
+        self.unknown_id_cancels = 0
+
+    def mint(self, request_id: Optional[str] = None,
+             timeout_us=None) -> CancelToken:
+        token = CancelToken(
+            deadline_ns=deadline_from_timeout_us(timeout_us),
+            request_id=request_id or None)
+        return token
+
+    def track(self, token: CancelToken) -> None:
+        """Index the token by request id (no-op for id-less requests —
+        in-process callers hold the token object directly)."""
+        if not token.request_id:
+            return
+        with self._lock:
+            self._tokens[token.request_id] = token
+            self._tokens.move_to_end(token.request_id)
+            while len(self._tokens) > self.MAX_TRACKED:
+                self._tokens.popitem(last=False)
+
+    def untrack(self, token: CancelToken) -> None:
+        if not token.request_id:
+            return
+        with self._lock:
+            existing = self._tokens.get(token.request_id)
+            if existing is token:
+                del self._tokens[token.request_id]
+
+    def cancel(self, request_id: str,
+               reason: str = REASON_WIRE_CANCEL) -> bool:
+        """Explicit wire cancellation by request id. True if a tracked
+        in-flight request was found (whether or not this call won the
+        cancel race); False for unknown/already-finished ids."""
+        with self._lock:
+            token = self._tokens.get(request_id or "")
+        if token is None:
+            with self._lock:
+                self.unknown_id_cancels += 1
+            return False
+        token.cancel(reason)
+        with self._lock:
+            self.cancelled_by_id += 1
+        return True
+
+    def inflight(self) -> int:
+        with self._lock:
+            return len(self._tokens)

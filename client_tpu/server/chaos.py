@@ -16,6 +16,13 @@ core calls once per inference request. Three fault kinds:
   (deterministic, no roll), the shape a wedged device queue produces.
   Sized above a replica's watchdog deadline it is what the watchdog
   ejection path exists to catch.
+* ``abandon_rate`` — fraction of requests whose *caller walks away*
+  mid-flight: the request's CancelToken is cancelled
+  ``abandon_after_ms`` after injection (a client disconnect, seen
+  from the server). Unlike drop_rate the request was healthy — this
+  is the fault the cancellation subsystem converts from wasted device
+  time into freed capacity, and what the cancel smoke's abandoned
+  storm replays.
 
 Spec strings (``--chaos`` / CLIENT_TPU_CHAOS) are comma-separated
 ``key=value`` pairs, e.g. ``"latency_ms=50,error_rate=0.1,seed=7"``.
@@ -57,6 +64,8 @@ class ChaosDropError(InferenceServerException):
 class ChaosConfig:
     def __init__(self, latency_ms: float = 0.0, error_rate: float = 0.0,
                  drop_rate: float = 0.0, hang_ms: float = 0.0,
+                 abandon_rate: float = 0.0,
+                 abandon_after_ms: float = 0.0,
                  seed: Optional[int] = None,
                  models: Optional[set] = None,
                  replica: Optional[str] = None):
@@ -64,6 +73,8 @@ class ChaosConfig:
         self.error_rate = min(max(float(error_rate), 0.0), 1.0)
         self.drop_rate = min(max(float(drop_rate), 0.0), 1.0)
         self.hang_ms = max(float(hang_ms), 0.0)
+        self.abandon_rate = min(max(float(abandon_rate), 0.0), 1.0)
+        self.abandon_after_ms = max(float(abandon_after_ms), 0.0)
         self.seed = seed
         self.models = set(models) if models else None
         # "model:index" retargets this config at one replica's
@@ -73,7 +84,7 @@ class ChaosConfig:
     @property
     def enabled(self) -> bool:
         return bool(self.latency_ms or self.error_rate or self.drop_rate
-                    or self.hang_ms)
+                    or self.hang_ms or self.abandon_rate)
 
     @classmethod
     def from_spec(cls, spec: str) -> "ChaosConfig":
@@ -92,7 +103,7 @@ class ChaosConfig:
             key = key.strip()
             value = value.strip()
             if key in ("latency_ms", "error_rate", "drop_rate",
-                       "hang_ms"):
+                       "hang_ms", "abandon_rate", "abandon_after_ms"):
                 kwargs[key] = float(value)
             elif key == "seed":
                 kwargs["seed"] = int(value)
@@ -118,6 +129,8 @@ class ChaosConfig:
             parts.append("%.0f%% drops" % (self.drop_rate * 100))
         if self.hang_ms:
             parts.append("%gms hangs" % self.hang_ms)
+        if self.abandon_rate:
+            parts.append("%.0f%% abandons" % (self.abandon_rate * 100))
         described = ", ".join(parts) if parts else "disabled"
         if self.replica and parts:
             described += " @ replica %s" % self.replica
@@ -143,6 +156,7 @@ class _ChaosState:
         self.injected_drops = 0
         self.delayed_requests = 0
         self.injected_hangs = 0
+        self.abandoned_requests = 0
         self._env_checked = False
 
 
@@ -163,6 +177,7 @@ def configure(config: Optional[ChaosConfig]) -> None:
         _state.injected_drops = 0
         _state.delayed_requests = 0
         _state.injected_hangs = 0
+        _state.abandoned_requests = 0
         _state._env_checked = True  # explicit config beats the env
 
 
@@ -221,11 +236,12 @@ def stats() -> dict:
             "injected_drops": _state.injected_drops,
             "delayed_requests": _state.delayed_requests,
             "injected_hangs": _state.injected_hangs,
+            "abandoned_requests": _state.abandoned_requests,
         }
 
 
 def inject(model_name: str = "", scope: Optional[str] = None,
-           replica_id: Optional[str] = None) -> None:
+           replica_id: Optional[str] = None, cancel=None) -> None:
     """Request-path hook: sleep/raise per the active config(s). No-op
     (one lock-free attribute read) when chaos is off. ``scope`` names
     the calling core; a matching scoped config applies on top of the
@@ -234,7 +250,10 @@ def inject(model_name: str = "", scope: Optional[str] = None,
     device queue is executing: replica-targeted configs fire only
     here, and only for their replica; untargeted configs fire only at
     the request-level inject (``replica_id=None``) — one fault, one
-    layer, never both."""
+    layer, never both. ``cancel`` is the request's CancelToken when
+    the caller has one: abandon_rate faults fire by cancelling it
+    after abandon_after_ms (a timer thread — the walked-away client),
+    and are inert when cancellation is off (no token, no fault)."""
     if not _state._env_checked:
         _load_env_config()
     configs = []
@@ -252,6 +271,7 @@ def inject(model_name: str = "", scope: Optional[str] = None,
     hang_ms = 0.0
     drop = False
     error = None
+    abandon_after_ms = None
     with _state.lock:
         for config in configs:
             if config.models is not None \
@@ -273,6 +293,11 @@ def inject(model_name: str = "", scope: Optional[str] = None,
                 drop = True
             elif roll < config.drop_rate + config.error_rate:
                 error = config.error_rate
+            # Independent roll, drawn ONLY when the fault is configured
+            # so legacy specs keep their exact rng sequence.
+            if config.abandon_rate and cancel is not None \
+                    and _state.rng.random() < config.abandon_rate:
+                abandon_after_ms = config.abandon_after_ms
         if delay_ms:
             _state.delayed_requests += 1
         if hang_ms:
@@ -281,6 +306,16 @@ def inject(model_name: str = "", scope: Optional[str] = None,
             _state.injected_drops += 1
         elif error is not None:
             _state.injected_errors += 1
+        if abandon_after_ms is not None:
+            _state.abandoned_requests += 1
+    if abandon_after_ms is not None:
+        if abandon_after_ms <= 0:
+            cancel.cancel("abandoned")
+        else:
+            timer = threading.Timer(abandon_after_ms / 1000.0,
+                                    cancel.cancel, args=("abandoned",))
+            timer.daemon = True
+            timer.start()
     if delay_ms:
         time.sleep(delay_ms / 1000.0)
     if hang_ms:

@@ -23,6 +23,7 @@ from client_tpu import status_map
 from client_tpu.protocol import inference_pb2 as pb
 from client_tpu.server import autoscale
 from client_tpu.server import cache as cache_mod
+from client_tpu.server import cancel as cancel_mod
 from client_tpu.server import chaos
 from client_tpu.server import devstats as devstats_mod
 from client_tpu.server import fetch as relay
@@ -127,6 +128,14 @@ class _ModelStats:
         self.stream_first_ns = 0
         self.stream_inter_count = 0
         self.stream_inter_ns = 0
+        # Cancellation accounting: stage boundary the signal landed at
+        # -> count (tpu_request_cancelled_total{model,stage}), plus
+        # device compute spent on requests that were already cancelled
+        # when their execution completed (tpu_wasted_compute_us — the
+        # Tail-at-Scale wasted-work amplification number cancellation
+        # exists to shrink).
+        self.cancelled_hist: Dict[str, int] = {}
+        self.wasted_compute_ns = 0
 
     def _priority_row(self, level: int) -> list:
         """[success, reject, timeout, shed, queue_ns] for one class
@@ -180,6 +189,20 @@ class _ModelStats:
             self.shed_count += 1
             if priority:
                 self._priority_row(priority)[3] += 1
+
+    def record_cancelled(self, stage: str):
+        """One request abandoned at `stage` (client disconnect, wire
+        cancel, hedge loser, or post-dispatch deadline expiry)."""
+        with self.lock:
+            self.cancelled_hist[stage] = \
+                self.cancelled_hist.get(stage, 0) + 1
+
+    def record_wasted_ns(self, ns: int):
+        """Device compute that completed for a caller already gone."""
+        if ns <= 0:
+            return
+        with self.lock:
+            self.wasted_compute_ns += int(ns)
 
     def _tenant_row(self, tenant: str) -> list:
         """[success, reject, fail, duration_ns] for one tenant (caller
@@ -440,6 +463,14 @@ class InferenceServerCore:
         # starts lazily the first time an autoscale-enabled model is
         # loaded — servers without the config block pay nothing.
         self.autoscaler = autoscale.AutoscaleController(self)
+        # Request-lifecycle cancellation (client_tpu.server.cancel):
+        # every admitted request gets a CancelToken carrying its
+        # deadline; transports cancel it on disconnect, the registry
+        # routes explicit wire cancels (POST /v2/cancel/<id>) to it,
+        # and every scheduler observes it at stage boundaries.
+        # CLIENT_TPU_CANCEL=off disables minting (the cancel_overhead
+        # bench A/B arm).
+        self.cancel = cancel_mod.CancelRegistry()
         # Start stamps: tpu_server_info's uptime value (a scrape-level
         # restart detector) and the /v2/debug server section.
         self._started_wall = time.time()
@@ -753,6 +784,7 @@ class InferenceServerCore:
         fused_hist, rejected, timed_out = [], [], []
         cache_hits, cache_misses = [], []
         shed_rows = []
+        cancelled_rows, wasted_rows = [], []
         tenant_totals: Dict[str, list] = {}
         with self._stats_lock:
             stats_snapshot = dict(self._stats)
@@ -781,6 +813,14 @@ class InferenceServerCore:
                     fused_hist.append(
                         'tpu_batch_fused_total{model="%s",size="%d"} %d'
                         % (name, size, s.batch_hist[size][0]))
+                for stage in sorted(s.cancelled_hist):
+                    cancelled_rows.append(
+                        'tpu_request_cancelled_total{model="%s",'
+                        'stage="%s"} %d'
+                        % (name, stage, s.cancelled_hist[stage]))
+                wasted_rows.append(
+                    'tpu_wasted_compute_us{model="%s"} %d'
+                    % (name, s.wasted_compute_ns // 1000))
                 for level in sorted(s.priority_hist):
                     shed_rows.append(
                         'tpu_shed_total{model="%s",priority="%d"} %d'
@@ -817,6 +857,13 @@ class InferenceServerCore:
                "Requests dropped by graceful load shedding, "
                "lowest-priority-first (displacement at a full queue + "
                "watermark sheds)", shed_rows)
+        family("tpu_request_cancelled_total", "counter",
+               "Requests abandoned per stage boundary (client "
+               "disconnect, wire cancel, hedge loser, post-dispatch "
+               "deadline expiry)", cancelled_rows)
+        family("tpu_wasted_compute_us", "counter",
+               "Device compute spent on requests already cancelled at "
+               "completion (work nobody read)", wasted_rows)
 
         # Server identity + uptime: the value resets to ~0 on restart,
         # so a scrape-side `resets()`/drop detector catches process
@@ -1770,6 +1817,7 @@ class InferenceServerCore:
                     shed_watermark=float(
                         getattr(model, "shed_watermark", 0.0)),
                     shed_hook=stats.record_shed,
+                    wasted_hook=stats.record_wasted_ns,
                     telemetry=self.telemetry,
                     overlapped_fetch=bool(
                         getattr(model, "overlapped_fetch", True)),
@@ -1821,7 +1869,7 @@ class InferenceServerCore:
     # -- ensemble dataflow ------------------------------------------------
 
     def _ensemble_dataflow(self, model, inputs, params, trace,
-                           queue_from_ns: int):
+                           queue_from_ns: int, cancel=None):
         """Device-resident execution of an ensemble's step graph (the
         ``device_dataflow=True`` serving path): builds the per-request
         DataflowContext — per-stage batchers, replica-routed targets,
@@ -1847,6 +1895,7 @@ class InferenceServerCore:
             cache_lookup=cache_lookup,
             cache_insert=cache_insert,
             queue_from_ns=queue_from_ns,
+            cancel=cancel,
         )
         return model.infer_dataflow(inputs, params, ctx)
 
@@ -1997,67 +2046,141 @@ class InferenceServerCore:
             pass  # serving
 
     def infer(self, request: pb.ModelInferRequest,
-              trace_context: Optional[str] = None
+              trace_context: Optional[str] = None,
+              cancel: Optional[cancel_mod.CancelToken] = None
               ) -> pb.ModelInferResponse:
         # Request-id correlation happens at the transport front-ends
         # (mint_request_id): they own their per-call protos, whereas a
         # direct core caller may legitimately share one request object
         # across threads (the bench's closed loops do) and an in-place
         # mint would race.
-        # Tenant quota admission runs FIRST — before the model is
-        # acquired — so an over-quota tenant cannot even hold an
-        # in-flight slot during a drain.
-        with _TenantAdmission(self, request,
-                              trace_context) as admission:
-            # acquire = READY check + in-flight increment in one atomic
-            # step: a graceful unload drains exactly the requests
-            # admitted before it flipped the state
-            # (repository.begin_unload).
+        # Cancellation: transports pass the token they wired to their
+        # disconnect signal; direct callers get one minted here so
+        # wire cancellation by request id works everywhere.
+        cancel = self._cancel_begin(request, cancel)
+        try:
+            # Tenant quota admission runs FIRST — before the model is
+            # acquired — so an over-quota tenant cannot even hold an
+            # in-flight slot during a drain.
+            with _TenantAdmission(self, request,
+                                  trace_context) as admission:
+                # acquire = READY check + in-flight increment in one
+                # atomic step: a graceful unload drains exactly the
+                # requests admitted before it flipped the state
+                # (repository.begin_unload).
+                try:
+                    model = self.repository.acquire(request.model_name,
+                                                    request.model_version)
+                except InferenceServerException as e:
+                    # Transparent cold start: a model the autoscale
+                    # controller scaled to zero is not "unknown" — the
+                    # first arrival kicks exactly one background reload
+                    # and is told honestly how long warming will take.
+                    retry = self.autoscaler.on_admission_miss(
+                        request.model_name)
+                    if retry is None:
+                        # Paged out by HBM eviction rather than by the
+                        # autoscaler: same transparency, restore instead
+                        # of reload, Retry-After from measured restore
+                        # bandwidth.
+                        retry = self._kick_restore(request.model_name)
+                    if retry is not None:
+                        e = status_map.retryable_error(
+                            "model '%s' is cold-starting (weights are "
+                            "paged out or it was scaled to zero); "
+                            "warming now"
+                            % request.model_name, retry_after_s=retry)
+                    self._flight_admission_reject(request, trace_context,
+                                                  e)
+                    raise e
+                admission.model_name = model.name
+                if cancel is not None and cancel.deadline_ns is None:
+                    # The token carries the SAME deadline the PR-2
+                    # queue policy enforces pre-dispatch — past
+                    # dispatch, stage-boundary checks keep enforcing it
+                    # (DELAY models have an advisory deadline: none).
+                    cancel.deadline_ns = self._queue_deadline_ns(
+                        model, request)
+                # Admission is the eviction policy's heat signal: stamp
+                # every lease of this model hot (lock-only, never
+                # raises).
+                self.hbm.touch_model(model.name)
+                try:
+                    response = self._infer_admitted(model, request,
+                                                    trace_context,
+                                                    cancel=cancel)
+                    admission.ok = True
+                    return response
+                except InferenceServerException as e:
+                    # Stamped error log: the line joins a client-side
+                    # failure to its trace/statistics by request id.
+                    _LOG.debug("request %s for model '%s' failed: %s",
+                               request.id, model.name, e)
+                    stage = getattr(e, "cancel_stage", None)
+                    if stage is not None:
+                        self._stats_for(model.name).record_cancelled(
+                            stage)
+                    raise
+                finally:
+                    self.repository.release(model.name)
+        finally:
+            if cancel is not None:
+                self.cancel.untrack(cancel)
+
+    def _cancel_begin(self, request: pb.ModelInferRequest,
+                      cancel: Optional[cancel_mod.CancelToken]
+                      ) -> Optional[cancel_mod.CancelToken]:
+        """Mint-or-adopt the request's CancelToken at admission and
+        index it by request id so explicit wire cancels can find it.
+        Returns None when the subsystem is off AND no transport token
+        was supplied — every stage check downstream short-circuits on
+        `cancel is None`, which is the whole cost of the off arm."""
+        registry = self.cancel
+        if cancel is None:
+            if not registry.enabled:
+                return None
+            cancel = registry.mint(request.id)
+        elif not cancel.request_id and request.id:
+            cancel.request_id = request.id
+        registry.track(cancel)
+        return cancel
+
+    @staticmethod
+    def _queue_deadline_ns(model: ServedModel,
+                           request: pb.ModelInferRequest
+                           ) -> Optional[int]:
+        """Absolute deadline under PR-2 queue-policy semantics: the
+        per-request `timeout` parameter when the model allows the
+        override, else the model's default_queue_policy_timeout_us;
+        None for DELAY models (advisory) and deadline-less requests."""
+        if str(getattr(model, "timeout_action", "REJECT")).upper() \
+                != "REJECT":
+            return None
+        timeout_us = 0
+        if getattr(model, "allow_timeout_override", True) \
+                and "timeout" in request.parameters:
             try:
-                model = self.repository.acquire(request.model_name,
-                                                request.model_version)
-            except InferenceServerException as e:
-                # Transparent cold start: a model the autoscale
-                # controller scaled to zero is not "unknown" — the
-                # first arrival kicks exactly one background reload
-                # and is told honestly how long warming will take.
-                retry = self.autoscaler.on_admission_miss(
-                    request.model_name)
-                if retry is None:
-                    # Paged out by HBM eviction rather than by the
-                    # autoscaler: same transparency, restore instead
-                    # of reload, Retry-After from measured restore
-                    # bandwidth.
-                    retry = self._kick_restore(request.model_name)
-                if retry is not None:
-                    e = status_map.retryable_error(
-                        "model '%s' is cold-starting (weights are "
-                        "paged out or it was scaled to zero); "
-                        "warming now"
-                        % request.model_name, retry_after_s=retry)
-                self._flight_admission_reject(request, trace_context, e)
-                raise e
-            admission.model_name = model.name
-            # Admission is the eviction policy's heat signal: stamp
-            # every lease of this model hot (lock-only, never raises).
-            self.hbm.touch_model(model.name)
-            try:
-                response = self._infer_admitted(model, request,
-                                                trace_context)
-                admission.ok = True
-                return response
-            except InferenceServerException as e:
-                # Stamped error log: the line joins a client-side
-                # failure to its trace/statistics by request id.
-                _LOG.debug("request %s for model '%s' failed: %s",
-                           request.id, model.name, e)
-                raise
-            finally:
-                self.repository.release(model.name)
+                timeout_us = int(
+                    _param_value(request.parameters["timeout"]) or 0)
+            except (TypeError, ValueError):
+                timeout_us = 0
+        if timeout_us <= 0:
+            timeout_us = int(getattr(
+                model, "default_queue_policy_timeout_us", 0))
+        return cancel_mod.deadline_from_timeout_us(timeout_us)
+
+    def cancel_request(self, request_id: str,
+                       reason: str = cancel_mod.REASON_WIRE_CANCEL
+                       ) -> bool:
+        """Explicit wire cancellation by request id (the HTTP
+        `POST /v2/cancel/<id>` route and hedge-loser cancels). True if
+        an in-flight request was found and signalled."""
+        return self.cancel.cancel(request_id, reason)
 
     def _infer_admitted(self, model: ServedModel,
                         request: pb.ModelInferRequest,
-                        trace_context: Optional[str] = None
+                        trace_context: Optional[str] = None,
+                        cancel: Optional[cancel_mod.CancelToken] = None
                         ) -> pb.ModelInferResponse:
         if getattr(model, "stats_recorder", False) is None:
             model.stats_recorder = self._record_composing
@@ -2087,13 +2210,15 @@ class InferenceServerCore:
                 attrs={"model": model.name, "request_id": request.id},
                 sampled=False)
         if ftrace is None:
-            return self._infer_routed(model, request, stats, None)
+            return self._infer_routed(model, request, stats, None,
+                                      cancel=cancel)
         error: Optional[str] = None
         status: Optional[str] = None
         token = (flight.track(model.name, request.id, ftrace)
                  if flight.enabled else None)
         try:
-            return self._infer_routed(model, request, stats, ftrace)
+            return self._infer_routed(model, request, stats, ftrace,
+                                      cancel=cancel)
         except InferenceServerException as e:
             error = str(e)
             status = e.status()
@@ -2102,6 +2227,10 @@ class InferenceServerCore:
             error, status = str(e), "INTERNAL"
             raise
         finally:
+            if cancel is not None and cancel.stage is not None:
+                # Terminal span attr: where the cancel signal landed
+                # (traces + flight ring show the abandoned stage).
+                ftrace.root.attrs["cancelled"] = cancel.stage
             ftrace.finish(error=error)
             if trace is not None:
                 self._trace_emit(model.name, request.id, trace)
@@ -2116,7 +2245,8 @@ class InferenceServerCore:
 
     def _infer_routed(self, model: ServedModel,
                       request: pb.ModelInferRequest, stats: _ModelStats,
-                      trace: Optional[spantrace.RequestTrace]
+                      trace: Optional[spantrace.RequestTrace],
+                      cancel: Optional[cancel_mod.CancelToken] = None
                       ) -> pb.ModelInferResponse:
         """Cache-aware routing for one admitted request: lookup /
         single-flight when the model opted into the response cache,
@@ -2125,7 +2255,8 @@ class InferenceServerCore:
         if not (cache.enabled and wants_response_cache(model)):
             return self._infer_executed(
                 model, request, stats, trace,
-                t0_ns=trace.root.start_ns if trace is not None else None)
+                t0_ns=trace.root.start_ns if trace is not None else None,
+                cancel=cancel)
         # Cache lookup runs on the WIRE request, before any input
         # decoding: a hit skips deserialization, queue/batcher, model
         # execution, and output encoding — it pays only the content
@@ -2139,8 +2270,9 @@ class InferenceServerCore:
                                 trace.root.start_ns, mark,
                                 {"outcome": "bypass"})
                 return self._infer_executed(model, request, stats, trace,
-                                            t0_ns=mark)
-            return self._infer_executed(model, request, stats, trace)
+                                            t0_ns=mark, cancel=cancel)
+            return self._infer_executed(model, request, stats, trace,
+                                        cancel=cancel)
         # Priority is coerced BEFORE the cache probe on QoS models so
         # (a) an out-of-range value fails INVALID_ARGUMENT even when
         # the answer is cached — caching must not change validation
@@ -2195,12 +2327,14 @@ class InferenceServerCore:
         if overtake:
             return self._infer_executed(
                 model, request, stats, trace,
-                t0_ns=mark if trace is not None else None)
+                t0_ns=mark if trace is not None else None,
+                cancel=cancel)
         if not leader:
             try:
                 response = self._await_flight(model, request, stats, cache,
                                               flight, t_cache,
-                                              priority=req_priority)
+                                              priority=req_priority,
+                                              cancel=cancel)
             except Exception:
                 if trace is not None:
                     trace.add_timed(spantrace.SPAN_CACHE_WAIT, mark,
@@ -2221,8 +2355,13 @@ class InferenceServerCore:
         try:
             response = self._infer_executed(
                 model, request, stats, trace,
-                t0_ns=mark if trace is not None else None)
+                t0_ns=mark if trace is not None else None,
+                cancel=cancel)
         except Exception:
+            # A cancelled leader aborts and fails its flight — exactly
+            # right for an all-cancelled burst; a follower that was NOT
+            # cancelled falls back to an independent execution below,
+            # so one abandoned leader never takes live followers down.
             if flight is not None:
                 cache.fail_flight(key, flight)
             raise
@@ -2269,7 +2408,8 @@ class InferenceServerCore:
     def _await_flight(self, model: ServedModel,
                       request: pb.ModelInferRequest, stats: _ModelStats,
                       cache: ResponseCache, flight, t_cache: int,
-                      priority: int = 0
+                      priority: int = 0,
+                      cancel: Optional[cancel_mod.CancelToken] = None
                       ) -> Optional[pb.ModelInferResponse]:
         """Follower side of single-flight: wait for the leader's
         response, bounded by this request's own queue deadline (PR-2
@@ -2278,8 +2418,11 @@ class InferenceServerCore:
         the leader — whose own execution is bounded). A model whose
         timeout_action is DELAY keeps its deadline advisory here too:
         the follower waits the leader out instead of hard-failing.
-        Returns None when the leader failed (caller executes
-        independently)."""
+        A cancelled follower DETACHES without touching the leader's
+        flight (chunked wait below): the leader and remaining
+        followers are unaffected, and an all-cancelled burst dies when
+        the cancelled leader aborts on its own stage checks. Returns
+        None when the leader failed (caller executes independently)."""
         timeout_us = 0
         if getattr(model, "allow_timeout_override", True) \
                 and "timeout" in request.parameters:
@@ -2296,8 +2439,28 @@ class InferenceServerCore:
         if str(getattr(model, "timeout_action", "REJECT")).upper() \
                 != "REJECT":
             timeout_us = 0  # DELAY: deadline is advisory, never fatal
-        if not flight.event.wait(
-                timeout_us / 1e6 if timeout_us > 0 else None):
+        if cancel is None:
+            served = flight.event.wait(
+                timeout_us / 1e6 if timeout_us > 0 else None)
+        else:
+            # The flight event cannot be set on cancel (it would wake
+            # every follower), so a cancellable follower polls it in
+            # short chunks — detach latency is bounded by the chunk.
+            wait_deadline = (time.monotonic_ns() + timeout_us * 1000
+                             if timeout_us > 0 else None)
+            served = flight.event.is_set()
+            while not served:
+                if cancel.cancelled():
+                    stats.record(1, 0, 0, 0,
+                                 time.monotonic_ns() - t_cache, ok=False)
+                    cancel.raise_if_cancelled("queue")
+                remaining = (None if wait_deadline is None else
+                             (wait_deadline - time.monotonic_ns()) / 1e9)
+                if remaining is not None and remaining <= 0:
+                    break
+                served = flight.event.wait(
+                    0.05 if remaining is None else min(0.05, remaining))
+        if not served:
             stats.record_timeout(priority)
             stats.record(1, 0, 0, 0,
                          time.monotonic_ns() - t_cache, ok=False)
@@ -2324,7 +2487,8 @@ class InferenceServerCore:
                         request: pb.ModelInferRequest,
                         stats: _ModelStats,
                         trace: Optional[spantrace.RequestTrace] = None,
-                        t0_ns: Optional[int] = None
+                        t0_ns: Optional[int] = None,
+                        cancel: Optional[cancel_mod.CancelToken] = None
                         ) -> pb.ModelInferResponse:
         # Traced requests chain t0 off the caller's last span boundary
         # (root start / cache-lookup end) so the admission slice lands
@@ -2337,10 +2501,15 @@ class InferenceServerCore:
         direct_busy = False
         dataflow = False
         try:
-            chaos.inject(model.name, scope=self.chaos_scope)
+            chaos.inject(model.name, scope=self.chaos_scope,
+                         cancel=cancel)
             # fault injection (no-op unless configured); drops/errors
             # ride the normal failure path
             inputs, params = self._decode_inputs(model, request)
+            if cancel is not None and cancel.cancelled():
+                # Signal landed during decode/admission: nothing is
+                # queued yet, drop before touching any scheduler.
+                cancel.raise_if_cancelled("queue")
             if getattr(model, "priority_levels", 0) > 0:
                 # Same coercion/validation the batcher applies — done
                 # here too so the success stats can be labeled per
@@ -2371,7 +2540,7 @@ class InferenceServerCore:
                 # dynamic batcher for cross-sequence step fusion.
                 batch = self._batch_size(model, request)
                 outputs, queue_ns, executions = sequencer.infer(
-                    inputs, params, batch, trace=trace)
+                    inputs, params, batch, trace=trace, cancel=cancel)
             elif getattr(model, "device_dataflow", False) \
                     and hasattr(model, "infer_dataflow") \
                     and "sequence_id" not in params:
@@ -2387,7 +2556,7 @@ class InferenceServerCore:
                 dataflow = True
                 outputs, queue_ns = self._ensemble_dataflow(
                     model, inputs, params, trace,
-                    t1 if trace is not None else 0)
+                    t1 if trace is not None else 0, cancel=cancel)
             elif batcher is not None and "sequence_id" not in params:
                 batch = self._batch_size(model, request)
                 outputs, queue_ns, leader = batcher.infer(
@@ -2398,7 +2567,8 @@ class InferenceServerCore:
                     # this call as soon as the outputs THIS request
                     # asked for have landed ([] = wants everything).
                     wanted_outputs=[t.name for t in request.outputs]
-                    or None)
+                    or None,
+                    cancel=cancel)
                 # Fused requests share one model execution; only its
                 # leader bumps execution_count (Triton semantics).
                 executions = 1 if leader else 0
@@ -2424,6 +2594,12 @@ class InferenceServerCore:
             t2 = time.monotonic_ns()
             if direct_busy:
                 self.devstats.record_busy(None, t2 - t1)
+            if cancel is not None and cancel.cancelled_or_expired(t2):
+                # Deadline/cancel landed during (or right after)
+                # execution: the compute already happened — account it
+                # as wasted — but fetch and encode are still saved.
+                stats.record_wasted_ns((t2 - t1) - queue_ns)
+                cancel.raise_if_cancelled("execute", t2)
             # Span boundaries are CHAINED off single clock reads
             # (decode ends exactly where execute starts, etc.): two
             # separate reads around a boundary would let a GIL
@@ -2571,7 +2747,8 @@ class InferenceServerCore:
 
     def stream_infer(
         self, request: pb.ModelInferRequest,
-        trace_context: Optional[str] = None
+        trace_context: Optional[str] = None,
+        cancel: Optional[cancel_mod.CancelToken] = None,
     ) -> Iterator[pb.ModelStreamInferResponse]:
         """Decoupled execution: yields one ModelStreamInferResponse per
         model response; the final response carries the
@@ -2596,7 +2773,7 @@ class InferenceServerCore:
         )
         t0 = time.monotonic_ns()
         if not model.decoupled:
-            response = self.infer(request, trace_context)
+            response = self.infer(request, trace_context, cancel=cancel)
             # admission handled there (tenant quotas included)
             # Unary-through-stream still counts as a one-response
             # stream: its "first response" latency is the whole
@@ -2619,6 +2796,11 @@ class InferenceServerCore:
         # duration, so the streaming RPC cannot bypass admission. A
         # quota reject raises; the transports surface it as an
         # in-stream error.
+        # The stream's CancelToken (mid-stream disconnect is THE
+        # abandoned-LLM case): the model reads it from
+        # params["cancel_token"] and reaps the lane between decode
+        # chunks; the registry indexes it for wire cancellation.
+        cancel = self._cancel_begin(request, cancel)
         with _TenantAdmission(self, request,
                               trace_context) as admission:
             # model came from repository.get above, so the name is
@@ -2666,9 +2848,19 @@ class InferenceServerCore:
                                               ftrace)
                 yield from self._stream_admitted(model, request, stats,
                                                  t0, want_empty_final,
-                                                 ftrace)
+                                                 ftrace, cancel=cancel)
                 admission.ok = True
             finally:
+                if cancel is not None:
+                    self.cancel.untrack(cancel)
+                    if cancel.cancelled():
+                        # One count per abandoned stream — whether the
+                        # signal surfaced as an in-stream error or as
+                        # a transport teardown closing this generator.
+                        stats.record_cancelled(cancel.stage or "stream")
+                        if ftrace is not None:
+                            ftrace.root.attrs["cancelled"] = \
+                                cancel.stage or "stream"
                 if ftrace is not None:
                     attrs = ftrace.root.attrs or {}
                     stream_error = attrs.get("error")
@@ -2693,13 +2885,21 @@ class InferenceServerCore:
                     self.repository.release(model.name)
 
     def _stream_admitted(self, model, request, stats, t0,
-                         want_empty_final, trace=None):
+                         want_empty_final, trace=None, cancel=None):
         try:
             decode_span = (trace.begin(spantrace.SPAN_DECODE)
                            if trace is not None else None)
             inputs, params = self._decode_inputs(model, request)
             if decode_span is not None:
                 trace.end(decode_span)
+            if cancel is not None:
+                # Models that own a scheduler (the LLM's continuous-
+                # batching loop) react to the token directly: the lane
+                # is reaped between decode chunks, pages/reservations
+                # freed, instead of waiting for this consumer loop to
+                # notice. cancel_token never enters cache keys or
+                # fusion fingerprints (_UNCACHED_PARAMS / _QOS_PARAMS).
+                params["cancel_token"] = cancel
             count = 0
             pending = None  # buffer one ahead so the last data response
             # can carry the final flag when empty finals are off
@@ -2713,6 +2913,13 @@ class InferenceServerCore:
             prev_ns = t0
             mark_ns = time.monotonic_ns()
             for out in model.infer_stream(inputs, params):
+                if cancel is not None and cancel.cancelled():
+                    # Explicit-cancel streams end with an in-stream
+                    # CANCELLED error (deadlines stay advisory mid-
+                    # stream: a healthy long generation is not a
+                    # timeout). Disconnects tear the generator down
+                    # via GeneratorExit instead and never reach here.
+                    cancel.raise_if_cancelled("stream")
                 now_ns = time.monotonic_ns()
                 if trace is not None:
                     # One span per decoupled response: model produce

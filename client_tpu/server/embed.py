@@ -276,6 +276,17 @@ def http_call(method: str, path: str, headers_json: str,
     return status, _json.dumps(headers), payload
 
 
+def http_cancel(request_id: str) -> bool:
+    """Client-disconnect hook for the native HTTP/1.1 front-end: when
+    the transport sees the client socket hit EOF while a unary request
+    is still in flight, it cancels by the request id it parsed from
+    the wire. True when an in-flight request was found and flipped."""
+    from client_tpu.server import cancel as cancel_mod
+
+    return _require_core().cancel_request(
+        request_id, reason=cancel_mod.REASON_CLIENT_DISCONNECT)
+
+
 def grpc_stream_call(path: str, request_bytes: bytes) -> list:
     """Dispatches one message of a bidi-streaming RPC; returns the
     list of serialized responses it produced. Stream RPCs here map

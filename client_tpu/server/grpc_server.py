@@ -9,6 +9,7 @@ from typing import Optional
 import grpc
 
 from client_tpu import status_map
+from client_tpu.server import cancel as cancel_mod
 from client_tpu.protocol import inference_pb2 as pb
 from client_tpu.protocol.service import (
     GRPCInferenceServiceServicer,
@@ -68,6 +69,166 @@ def _apply_tenant_metadata(request, context) -> None:
         pass
 
 
+class _StreamDispatcher:
+    """Transport-neutral guts of ``ModelStreamInfer``: a bounded output
+    queue fed by a worker pool dispatching pipelined requests
+    (same-sequence requests chained in arrival order), plus an explicit
+    teardown signal both front-ends raise when the client goes away —
+    the sync handler from its generator ``finally``, the aio handler
+    from its ``CancelledError``. Workers observe teardown via the
+    bounded put loop, cancel their request tokens, and close their
+    per-request generators, so abandonment handling is identical on
+    both transports."""
+
+    # Bounded: the old sequential `yield from` backpressured through
+    # HTTP/2 flow control; with threaded dispatch a non-reading client
+    # must hit this cap (workers block in put) instead of growing
+    # server memory without bound.
+    QUEUE_DEPTH = 64
+
+    def __init__(self, core: InferenceServerCore, context,
+                 workers: int = 8):
+        import queue as _queue
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._core = core
+        self._queue_mod = _queue
+        self._out: _queue.Queue = _queue.Queue(maxsize=self.QUEUE_DEPTH)
+        self.sentinel = object()
+        self._cancelled = threading.Event()
+        self._pool = ThreadPoolExecutor(max_workers=workers,
+                                        thread_name_prefix="stream-infer")
+        # key -> tail future of that correlation id's chain. An entry
+        # is dropped as soon as its tail future completes while still
+        # being the tail (sequence ended, errored, or simply idle) —
+        # before this a long-lived stream kept one future alive per
+        # correlation id it ever saw.
+        self._sequence_tail: dict = {}
+        self._tail_lock = threading.Lock()
+        # One traceparent per stream (gRPC metadata is per-call):
+        # every request pipelined on this stream joins that trace.
+        self._trace_context = _trace_context(context)
+        # Likewise one tenant identity per stream: without this the
+        # streaming RPC would bypass tenant quotas entirely.
+        self._tenant = None
+        try:
+            for key, value in context.invocation_metadata() or ():
+                if key == "tenant" and value:
+                    self._tenant = value
+                    break
+        except Exception:  # noqa: BLE001 — identity is best-effort
+            pass
+
+    def put_out(self, item) -> bool:
+        while not self._cancelled.is_set():
+            try:
+                self._out.put(item, timeout=0.5)
+                return True
+            except self._queue_mod.Full:
+                continue
+        return False
+
+    def get_out(self):
+        """Blocking take for the sync front-end: the reader thread's
+        sentinel always arrives."""
+        return self._out.get()
+
+    def poll_out(self):
+        """Bounded take for the aio front-end's executor reads: once
+        teardown is signalled and the queue has drained this returns
+        the sentinel, so an abandoned read always lets its pool thread
+        go."""
+        while True:
+            try:
+                return self._out.get(timeout=0.25)
+            except self._queue_mod.Empty:
+                if self._cancelled.is_set():
+                    return self.sentinel
+
+    def put_sentinel(self) -> None:
+        self.put_out(self.sentinel)
+
+    def wait_all(self) -> None:
+        """End-of-requests barrier: waits for every in-flight
+        request."""
+        self._pool.shutdown(wait=True)
+
+    def shutdown(self) -> None:
+        self._cancelled.set()
+        self._pool.shutdown(wait=False)
+
+    def dispatch(self, request) -> None:
+        if self._cancelled.is_set():
+            return
+        key = None
+        param = request.parameters.get("sequence_id")
+        if param is not None:
+            key = param.int64_param or param.string_param or None
+        try:
+            if key:
+                with self._tail_lock:
+                    prev = self._sequence_tail.get(key)
+                    future = self._pool.submit(self._run_after, prev,
+                                               request)
+                    self._sequence_tail[key] = future
+                self._drop_when_tail(key, future)
+            else:
+                self._pool.submit(self._run_one, request)
+        except RuntimeError:
+            # pool shut down: teardown raced an in-flight dispatch
+            if not self._cancelled.is_set():
+                raise
+
+    def _drop_when_tail(self, key, future) -> None:
+        def _done(f):
+            with self._tail_lock:
+                if self._sequence_tail.get(key) is f:
+                    del self._sequence_tail[key]
+
+        future.add_done_callback(_done)
+
+    def _run_after(self, prev, request) -> None:
+        # Same-sequence requests must reach the sequence scheduler in
+        # arrival order (it serializes execution, but ordering of
+        # ticket issue is the transport's to preserve) — so each
+        # chains on its predecessor; distinct sequences still run
+        # concurrently.
+        if prev is not None:
+            try:
+                prev.result()
+            except Exception:  # noqa: BLE001 — order, not success
+                pass
+        self._run_one(request)
+
+    def _run_one(self, request) -> None:
+        mint_request_id(request)
+        if self._tenant and "tenant" not in request.parameters:
+            request.parameters["tenant"].string_param = self._tenant
+        token = (self._core.cancel.mint(request.id)
+                 if self._core.cancel.enabled else None)
+        generator = self._core.stream_infer(
+            request, trace_context=self._trace_context, cancel=token)
+        try:
+            for response in generator:
+                if (self._cancelled.is_set()
+                        or not self.put_out(response)):
+                    break
+        except InferenceServerException as e:
+            # decoupled errors ride the stream, not abort it
+            self.put_out(stream_error_response(request, str(e)))
+        except Exception as e:  # noqa: BLE001 — never kill the stream
+            self.put_out(stream_error_response(
+                request, "internal error: %s" % e))
+        finally:
+            # Stream teardown (client went away) cancels the request
+            # BEFORE closing the generator so the core's stream
+            # finally sees a flipped token and books the disconnect; a
+            # completed request's close is a no-op.
+            if token is not None and self._cancelled.is_set():
+                token.cancel(cancel_mod.REASON_CLIENT_DISCONNECT)
+            generator.close()
+
+
 class InferenceServicer(GRPCInferenceServiceServicer):
     def __init__(self, core: InferenceServerCore):
         self._core = core
@@ -112,9 +273,22 @@ class InferenceServicer(GRPCInferenceServiceServicer):
     def ModelInfer(self, request, context):
         mint_request_id(request)
         _apply_tenant_metadata(request, context)
+        token = None
+        if self._core.cancel.enabled:
+            token = self._core.cancel.mint(request.id)
+            try:
+                # Fires on RPC termination: a client-side cancel or
+                # dropped channel flips the token mid-flight; after a
+                # normal completion the flip is a harmless no-op (the
+                # token is already untracked and nobody reads it).
+                context.add_callback(lambda: token.cancel(
+                    cancel_mod.REASON_CLIENT_DISCONNECT))
+            except Exception:  # noqa: BLE001 — detection is best-effort
+                pass
         try:
             return self._core.infer(
-                request, trace_context=_trace_context(context))
+                request, trace_context=_trace_context(context),
+                cancel=token)
         except InferenceServerException as e:
             _abort(context, e)
 
@@ -126,128 +300,34 @@ class InferenceServicer(GRPCInferenceServiceServicer):
     STREAM_WORKERS = 8
 
     def ModelStreamInfer(self, request_iterator, context):
-        import queue as _queue
-        from concurrent.futures import ThreadPoolExecutor
-
-        # One traceparent per stream (gRPC metadata is per-call):
-        # every request pipelined on this stream joins that trace.
-        stream_trace_context = _trace_context(context)
-        # Likewise one tenant identity per stream: without this the
-        # streaming RPC would bypass tenant quotas entirely.
-        stream_tenant = None
-        try:
-            for key, value in context.invocation_metadata() or ():
-                if key == "tenant" and value:
-                    stream_tenant = value
-                    break
-        except Exception:  # noqa: BLE001 — identity is best-effort
-            pass
-
-        # Bounded: the old sequential `yield from` backpressured
-        # through HTTP/2 flow control; with threaded dispatch a
-        # non-reading client must hit this cap (workers block in put)
-        # instead of growing server memory without bound.
-        out: _queue.Queue = _queue.Queue(maxsize=64)
-        sentinel = object()
-        # Set when the client goes away (gRPC closes this generator):
-        # workers close their per-request generators so model-side
-        # abandonment handling (GeneratorExit -> request.cancelled,
-        # e.g. the LLM's lane reclaim) still fires with threaded
-        # dispatch.
-        cancelled = threading.Event()
-
-        def put_out(item) -> bool:
-            while not cancelled.is_set():
-                try:
-                    out.put(item, timeout=0.5)
-                    return True
-                except _queue.Full:
-                    continue
-            return False
-
-        def run_one(request):
-            mint_request_id(request)
-            if stream_tenant and "tenant" not in request.parameters:
-                request.parameters["tenant"].string_param = stream_tenant
-            generator = self._core.stream_infer(
-                request, trace_context=stream_trace_context)
-            try:
-                for response in generator:
-                    if cancelled.is_set() or not put_out(response):
-                        break
-            except InferenceServerException as e:
-                # decoupled errors ride the stream, not abort it
-                put_out(stream_error_response(request, str(e)))
-            except Exception as e:  # noqa: BLE001 — never kill the stream
-                put_out(stream_error_response(
-                    request, "internal error: %s" % e))
-            finally:
-                generator.close()
-
-        def run_after(prev, request):
-            # Same-sequence requests must reach the sequence scheduler
-            # in arrival order (it serializes execution, but ordering
-            # of ticket issue is the transport's to preserve) — so
-            # each chains on its predecessor; distinct sequences still
-            # run concurrently.
-            if prev is not None:
-                try:
-                    prev.result()
-                except Exception:  # noqa: BLE001 — order, not success
-                    pass
-            run_one(request)
+        dispatcher = _StreamDispatcher(self._core, context,
+                                       workers=self.STREAM_WORKERS)
 
         def reader():
-            # key -> tail future of that correlation id's chain. An
-            # entry is dropped as soon as its tail future completes
-            # while still being the tail (sequence ended, errored, or
-            # simply idle) — before this a long-lived stream kept one
-            # future alive per correlation id it ever saw.
-            sequence_tail = {}
-            tail_lock = threading.Lock()
-
-            def drop_when_tail(key, future):
-                def _done(f):
-                    with tail_lock:
-                        if sequence_tail.get(key) is f:
-                            del sequence_tail[key]
-
-                future.add_done_callback(_done)
-
             try:
-                with ThreadPoolExecutor(
-                        max_workers=self.STREAM_WORKERS,
-                        thread_name_prefix="stream-infer") as pool:
-                    for request in request_iterator:
-                        key = None
-                        param = request.parameters.get("sequence_id")
-                        if param is not None:
-                            key = (param.int64_param or
-                                   param.string_param or None)
-                        if key:
-                            with tail_lock:
-                                prev = sequence_tail.get(key)
-                                future = pool.submit(
-                                    run_after, prev, request)
-                                sequence_tail[key] = future
-                            drop_when_tail(key, future)
-                        else:
-                            pool.submit(run_one, request)
-                    # with-block: waits for every in-flight request
+                for request in request_iterator:
+                    dispatcher.dispatch(request)
+                dispatcher.wait_all()
             finally:
-                put_out(sentinel)  # no-op when the client is gone
+                dispatcher.put_sentinel()  # no-op when the client is gone
 
         reader_thread = threading.Thread(target=reader, daemon=True,
                                          name="stream-infer-reader")
         reader_thread.start()
         try:
             while True:
-                item = out.get()
-                if item is sentinel:
+                item = dispatcher.get_out()
+                if item is dispatcher.sentinel:
                     return
                 yield item
         finally:
-            cancelled.set()
+            # Stream teardown (client went away: gRPC closes this
+            # generator): workers observe the signal, cancel their
+            # request tokens, and close their per-request generators
+            # so model-side abandonment handling (GeneratorExit ->
+            # request.cancelled, e.g. the LLM's lane reclaim) still
+            # fires with threaded dispatch.
+            dispatcher.shutdown()
 
     def ModelStatistics(self, request, context):
         try:
@@ -335,6 +415,107 @@ class InferenceServicer(GRPCInferenceServiceServicer):
             else:
                 response.settings[key].string_param = str(value)
         return response
+
+
+async def _abort_aio(context, error: InferenceServerException):
+    """`_abort` twin for grpc.aio handler coroutines, where
+    ``context.abort`` is a coroutine (trailing metadata stays sync)."""
+    code = status_map.grpc_code(error.status())
+    if status_map.is_retryable_status(error.status()):
+        retry_after = getattr(error, "retry_after_s", None)
+        try:
+            context.set_trailing_metadata((
+                ("retry-after",
+                 "%.3f" % retry_after if retry_after else "1"),))
+        except Exception:  # noqa: BLE001 — the abort must still fire
+            pass
+    await context.abort(code, error.message())
+
+
+class AioInferenceServicer(InferenceServicer):
+    """InferenceServicer with the unary infer path rewritten as a
+    coroutine for the grpc.aio front-end.
+
+    The asyncio server's sync-migration path hands non-coroutine
+    handlers a ``_SyncServicerContext`` whose ``add_callback`` accepts
+    the callback and then never invokes it — not on client cancel, not
+    even at normal RPC completion — so a sync ``ModelInfer`` under the
+    aio server is blind to the caller going away. A coroutine handler
+    gets the real signal: grpc.aio cancels the handler task when the
+    RPC terminates early, and the ``CancelledError`` arm flips the
+    request's token. The blocking work still runs on the migration
+    pool (via ``run_in_executor``) so serving semantics and pool
+    sizing are unchanged; the abandoned executor job unwinds at its
+    next stage boundary once it observes the flipped token.
+    """
+
+    def __init__(self, core: InferenceServerCore, executor):
+        super().__init__(core)
+        self._executor = executor
+
+    async def ModelInfer(self, request, context):
+        import asyncio
+
+        mint_request_id(request)
+        _apply_tenant_metadata(request, context)
+        token = (self._core.cancel.mint(request.id)
+                 if self._core.cancel.enabled else None)
+        trace_context = _trace_context(context)
+
+        def _work():
+            return self._core.infer(
+                request, trace_context=trace_context, cancel=token)
+
+        try:
+            return await asyncio.get_running_loop().run_in_executor(
+                self._executor, _work)
+        except asyncio.CancelledError:
+            if token is not None:
+                token.cancel(cancel_mod.REASON_CLIENT_DISCONNECT)
+            raise
+        except InferenceServerException as e:
+            await _abort_aio(context, e)
+
+    async def ModelStreamInfer(self, request_iterator, context):
+        """Async-generator twin of the sync handler, for the same
+        reason as ``ModelInfer``: a sync streaming generator under the
+        aio server is never closed when the client goes away (its
+        ``finally`` — the teardown signal — simply does not run, so
+        workers wedge in the bounded put loop and tokens never flip).
+        grpc.aio DOES close an async generator on RPC termination, so
+        teardown rides this coroutine's ``finally`` instead. The
+        blocking dispatch machinery is the shared
+        ``_StreamDispatcher``; queue reads hop through the migration
+        pool to keep the event loop unblocked."""
+        import asyncio
+
+        dispatcher = _StreamDispatcher(self._core, context,
+                                       workers=self.STREAM_WORKERS)
+        loop = asyncio.get_running_loop()
+
+        async def reader():
+            try:
+                async for request in request_iterator:
+                    dispatcher.dispatch(request)
+                await loop.run_in_executor(self._executor,
+                                           dispatcher.wait_all)
+            finally:
+                # Off-loop: the sentinel put can block behind a slow
+                # reader (bounded queue); no-op when the client is
+                # gone.
+                self._executor.submit(dispatcher.put_sentinel)
+
+        reader_task = asyncio.ensure_future(reader())
+        try:
+            while True:
+                item = await loop.run_in_executor(self._executor,
+                                                  dispatcher.poll_out)
+                if item is dispatcher.sentinel:
+                    return
+                yield item
+        finally:
+            dispatcher.shutdown()
+            reader_task.cancel()
 
 
 def debug_generic_handler(core: InferenceServerCore):
@@ -469,12 +650,16 @@ class AioGrpcServerThread:
 
         async def _serve():
             try:
+                pool = futures.ThreadPoolExecutor(
+                    max_workers=max_workers)
                 server = grpc.aio.server(
-                    migration_thread_pool=futures.ThreadPoolExecutor(
-                        max_workers=max_workers),
+                    migration_thread_pool=pool,
                     options=list(_CHANNEL_OPTIONS))
+                # Coroutine ModelInfer + sync everything-else; the
+                # same pool backs both the migration path and the
+                # coroutine's run_in_executor dispatch.
                 add_GRPCInferenceServiceServicer_to_server(
-                    InferenceServicer(core), server)
+                    AioInferenceServicer(core, pool), server)
                 server.add_generic_rpc_handlers(
                     (debug_generic_handler(core),))
                 for add_fn, servicer in extra_servicers:

@@ -327,6 +327,17 @@ def _apply_tenant_header(headers, infer_request) -> None:
         infer_request.parameters["tenant"].string_param = tenant_header
 
 
+@_route("POST", r"/v2/cancel/(?P<id>[^/]+)")
+def _cancel_by_id(core, m, headers, body):
+    """Explicit wire cancellation by request id (parity with the
+    aiohttp front-end's route). The native transport also calls
+    ``embed.http_cancel`` with this id directly when it sees the
+    client socket hit EOF mid-request."""
+    found = core.cancel_request(m.group("id"))
+    return _json_reply({"cancelled": bool(found)},
+                       200 if found else 404)
+
+
 @_route("POST", _MODEL + r"/generate")
 def _generate(core, m, headers, body):
     """Non-streaming generate extension (JSON in, JSON out); the SSE
@@ -345,8 +356,11 @@ def _generate(core, m, headers, body):
 
     mint_request_id(infer_request)
     _apply_tenant_header(headers, infer_request)
+    token = (core.cancel.mint(infer_request.id)
+             if core.cancel.enabled else None)
     return _json_reply(generate_response_json(core.infer(
-        infer_request, trace_context=headers.get("traceparent"))))
+        infer_request, trace_context=headers.get("traceparent"),
+        cancel=token)))
 
 
 @_route("POST", _MODEL + r"/infer")
@@ -360,9 +374,16 @@ def _infer(core, m, headers, body):
 
     mint_request_id(infer_request)
     _apply_tenant_header(headers, infer_request)
+    # Tracked token: the native transport watches the client socket
+    # while this (synchronous) handler runs and calls
+    # ``embed.http_cancel(request_id)`` on EOF — the id lookup below
+    # is what makes a mid-flight embed disconnect land.
+    token = (core.cancel.mint(infer_request.id)
+             if core.cancel.enabled else None)
     # header names are lower-cased by the caller (http_call contract)
     response = core.infer(infer_request,
-                          trace_context=headers.get("traceparent"))
+                          trace_context=headers.get("traceparent"),
+                          cancel=token)
     binary_prefs = {}
     default_binary = False
     for tensor in infer_request.outputs:

@@ -23,6 +23,7 @@ from client_tpu.protocol.http_wire import (
     decode_infer_request,
     encode_infer_response,
 )
+from client_tpu.server import cancel as cancel_mod
 from client_tpu.server.core import InferenceServerCore
 from client_tpu.utils import InferenceServerException
 
@@ -370,8 +371,16 @@ def build_http_app(core: InferenceServerCore) -> web.Application:
         body = await request.read()
         try:
             infer_request = _generate_request(request, body)
-            response = await _run(core.infer, infer_request,
-                                  request.headers.get("traceparent"))
+            token = (core.cancel.mint(infer_request.id)
+                     if core.cancel.enabled else None)
+            try:
+                response = await _run(core.infer, infer_request,
+                                      request.headers.get("traceparent"),
+                                      token)
+            except asyncio.CancelledError:
+                if token is not None:
+                    token.cancel(cancel_mod.REASON_CLIENT_DISCONNECT)
+                raise
             return web.json_response(_generate_json(response))
         except InferenceServerException as e:
             return _error_response(e)
@@ -401,9 +410,12 @@ def build_http_app(core: InferenceServerCore) -> web.Application:
         # traceparent joins the stream's span tree (and thereby the
         # TTFT/ITL exemplars) to the client's trace.
         trace_context = request.headers.get("traceparent")
+        token = (core.cancel.mint(infer_request.id)
+                 if core.cancel.enabled else None)
 
         def _produce():
-            generator = core.stream_infer(infer_request, trace_context)
+            generator = core.stream_infer(infer_request, trace_context,
+                                          token)
             try:
                 for stream_response in generator:
                     if cancelled.is_set():
@@ -437,6 +449,11 @@ def build_http_app(core: InferenceServerCore) -> web.Application:
                     ("data: %s\n\n" % _json.dumps(payload)).encode()
                 )
         except (ConnectionResetError, ConnectionError, asyncio.CancelledError):
+            # SSE transport gone mid-stream: the token reaps the LLM
+            # lane at the next chunk boundary (pages + reservation
+            # freed) instead of decoding the full budget into nowhere.
+            if token is not None:
+                token.cancel(cancel_mod.REASON_CLIENT_DISCONNECT)
             cancelled.set()
             raise
         finally:
@@ -634,6 +651,17 @@ def build_http_app(core: InferenceServerCore) -> web.Application:
 
     # -- inference -------------------------------------------------------
 
+    @routes.post("/v2/cancel/{id}")
+    async def cancel_by_id(request):
+        """Explicit wire cancellation: flips the CancelToken of the
+        in-flight request with this id (the HTTP twin of a gRPC RPC
+        cancel). 404 for unknown/already-finished ids — cancellation
+        of completed work is not an error a client can act on, but the
+        distinction is observable."""
+        found = await _run(core.cancel_request, request.match_info["id"])
+        return web.json_response({"cancelled": bool(found)},
+                                 status=200 if found else 404)
+
     @routes.post("/v2/models/{model}/infer")
     @routes.post("/v2/models/{model}/versions/{version}/infer")
     async def infer(request):
@@ -652,10 +680,23 @@ def build_http_app(core: InferenceServerCore) -> web.Application:
 
             mint_request_id(infer_request)
             _apply_tenant_header(request, infer_request)
-            # W3C trace-context propagation: a caller-supplied
-            # traceparent joins the server span tree to the client's.
-            response = await _run(core.infer, infer_request,
-                                  request.headers.get("traceparent"))
+            token = (core.cancel.mint(infer_request.id)
+                     if core.cancel.enabled else None)
+            try:
+                # W3C trace-context propagation: a caller-supplied
+                # traceparent joins the server span tree to the
+                # client's.
+                response = await _run(core.infer, infer_request,
+                                      request.headers.get("traceparent"),
+                                      token)
+            except asyncio.CancelledError:
+                # aiohttp cancels the handler task when the client's
+                # transport closes mid-request: flip the token so the
+                # worker thread's in-flight core call unwinds at its
+                # next stage boundary and frees everything it holds.
+                if token is not None:
+                    token.cancel(cancel_mod.REASON_CLIENT_DISCONNECT)
+                raise
             binary_prefs = {}
             default_binary = False  # pure-JSON clients get JSON back
             for tensor in infer_request.outputs:
@@ -737,7 +778,12 @@ class HttpServerThread:
 
         async def _up():
             app = build_http_app(self._core)
-            self._runner = web.AppRunner(app)
+            # handler_cancellation: aiohttp >= 3.9 no longer cancels
+            # handler tasks on client disconnect by default — without
+            # it the client-disconnect cancellation source (the
+            # CancelledError handlers in build_http_app) never fires
+            # and an abandoned request computes to completion.
+            self._runner = web.AppRunner(app, handler_cancellation=True)
             await self._runner.setup()
             # shutdown_timeout mirrors the gRPC server's stop grace:
             # aiohttp's 60s default would park stop() on every live

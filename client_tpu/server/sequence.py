@@ -107,7 +107,7 @@ class _Slot:
     ticket turnstile that serializes its steps."""
 
     __slots__ = ("index", "corrid", "state", "last_step_ns", "next_ticket",
-                 "serving", "ended", "reclaimed")
+                 "serving", "ended", "reclaimed", "abandoned")
 
     def __init__(self, index: int, corrid):
         self.index = index
@@ -118,6 +118,12 @@ class _Slot:
         self.serving = 0       # ticket currently allowed to execute
         self.ended = False     # sequence_end step has been admitted
         self.reclaimed = False
+        # Tickets whose waiter was cancelled mid-wait: the turnstile
+        # auto-advances past them in _release_turn. A cancelled step
+        # must NOT bump `serving` itself — mid-wait its ticket is not
+        # the one being served, and stealing the increment would strand
+        # the live waiter behind it.
+        self.abandoned: set = set()
 
 
 def _not_started(model_name: str, corrid) -> InferenceServerException:
@@ -217,24 +223,38 @@ class SequenceScheduler:
     # -- request path -----------------------------------------------------
 
     def infer(self, inputs: Dict[str, np.ndarray], params: dict,
-              batch: int, trace=None):
+              batch: int, trace=None, cancel=None):
         """Executes one sequence step; returns
         ``(outputs, queue_ns, executions)`` where executions follows
         the dynamic batcher's leader accounting (0 for fused riders).
         ``trace`` is the request's RequestTrace when sampled: the slot
         wait and (direct-strategy) device execution record spans, and
         fused steps carry the trace into the dynamic batcher.
+        ``cancel`` is the request's CancelToken (or None): a cancelled
+        step abandons its backlog wait or turnstile ticket without
+        wedging the sequence's later steps.
         """
         corrid = params.get("sequence_id")
         start = bool(params.get("sequence_start"))
         end = bool(params.get("sequence_end"))
         entry_ns = time.monotonic_ns()
-        slot, ticket = self._admit(corrid, start, entry_ns, params)
+        handle = (cancel.on_cancel(self._wake_waiters)
+                  if cancel is not None else None)
         try:
-            self._await_turn(slot, ticket, start)
-        except Exception:
-            self._release_turn(slot, end=False)
-            raise
+            slot, ticket = self._admit(corrid, start, entry_ns, params,
+                                       cancel=cancel)
+            try:
+                self._await_turn(slot, ticket, start, cancel=cancel)
+            except Exception as e:
+                # A cancelled mid-wait step already abandoned its
+                # ticket in _await_turn; bumping `serving` here would
+                # steal the live turn owner's increment.
+                if getattr(e, "cancel_stage", None) is None:
+                    self._release_turn(slot, end=False)
+                raise
+        finally:
+            if handle is not None:
+                cancel.remove_callback(handle)
         turn_ns = time.monotonic_ns()
         queue_ns = turn_ns - entry_ns
         if trace is not None:
@@ -255,7 +275,8 @@ class SequenceScheduler:
                 }
                 outputs, fuse_queue_ns, leader = self._batcher.infer(
                     exec_inputs, exec_params, batch, trace=trace,
-                    queue_from_ns=turn_ns if trace is not None else 0)
+                    queue_from_ns=turn_ns if trace is not None else 0,
+                    cancel=cancel)
                 queue_ns += fuse_queue_ns
                 executions = 1 if leader else 0
                 with self._cv:
@@ -269,6 +290,8 @@ class SequenceScheduler:
                     k: v for k, v in params.items()
                     if not k.startswith("sequence_")
                 }
+                if cancel is not None and cancel.cancelled():
+                    cancel.raise_if_cancelled("queue")
                 outputs = self._target.infer(exec_inputs, exec_params)
                 if exec_span is not None:
                     trace.end(exec_span)
@@ -294,7 +317,14 @@ class SequenceScheduler:
                     pass
         return timeout_ns
 
-    def _admit(self, corrid, start: bool, entry_ns: int, params: dict):
+    def _wake_waiters(self) -> None:
+        """CancelToken wakeup: backlog and turnstile waits sleep on the
+        scheduler CV, so a cancel must poke it to be seen promptly."""
+        with self._cv:
+            self._cv.notify_all()
+
+    def _admit(self, corrid, start: bool, entry_ns: int, params: dict,
+               cancel=None):
         """Returns (slot, ticket) for this step, allocating a slot on
         sequence_start (waiting in the backlog when none is free)."""
         model_name = getattr(self._model, "name", "?")
@@ -329,10 +359,11 @@ class SequenceScheduler:
                     return slot, ticket
                 # Backlog wait releases the lock; loop to re-check the
                 # world (slot freed, duplicate start won, stopping).
-                self._wait_for_slot_locked(model_name, entry_ns, params)
+                self._wait_for_slot_locked(model_name, entry_ns, params,
+                                           cancel=cancel)
 
     def _wait_for_slot_locked(self, model_name: str, entry_ns: int,
-                              params: dict) -> None:
+                              params: dict, cancel=None) -> None:
         """Backlog admission under the PR-2 queue policy (caller holds
         the lock; returns with a slot free or raises)."""
         if self._backlog_max > 0 and self._backlog >= self._backlog_max:
@@ -355,6 +386,10 @@ class SequenceScheduler:
         self._backlog += 1
         try:
             while not self._free_slots:
+                if cancel is not None and cancel.cancelled():
+                    # No slot held yet — backing out of the backlog
+                    # (the finally below) is the whole release.
+                    cancel.raise_if_cancelled("queue")
                 if self._stopping:
                     raise status_map.retryable_error(
                         "server is shutting down", retry_after_s=1.0)
@@ -387,9 +422,17 @@ class SequenceScheduler:
 
     # -- per-sequence ordering --------------------------------------------
 
-    def _await_turn(self, slot: _Slot, ticket: int, start: bool) -> None:
+    def _await_turn(self, slot: _Slot, ticket: int, start: bool,
+                    cancel=None) -> None:
         with self._cv:
             while slot.serving != ticket:
+                if cancel is not None and cancel.cancelled():
+                    # Mid-wait this ticket is by definition not the one
+                    # being served: abandon it in place and let
+                    # _release_turn's turnstile advance skip over it.
+                    slot.abandoned.add(ticket)
+                    self._cv.notify_all()
+                    cancel.raise_if_cancelled("queue")
                 if self._stopping:
                     raise status_map.retryable_error(
                         "server is shutting down", retry_after_s=1.0)
@@ -409,6 +452,12 @@ class SequenceScheduler:
     def _release_turn(self, slot: _Slot, end: bool) -> None:
         with self._cv:
             slot.serving += 1
+            # Skip tickets whose waiter was cancelled mid-wait: nobody
+            # will ever claim them, and the next live waiter must not
+            # block behind a ghost.
+            while slot.serving in slot.abandoned:
+                slot.abandoned.discard(slot.serving)
+                slot.serving += 1
             slot.last_step_ns = time.monotonic_ns()
             if end:
                 slot.ended = True

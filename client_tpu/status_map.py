@@ -25,8 +25,10 @@ from typing import Dict, Optional
 from client_tpu.utils import InferenceServerException
 
 # Canonical status string -> HTTP response code. Statuses absent from
-# the table (CANCELLED, UNKNOWN, transport noise) fall back to
-# HTTP_INTERNAL — the pre-refactor behavior of every front-end copy.
+# the table (UNKNOWN, transport noise) fall back to HTTP_INTERNAL —
+# the pre-refactor behavior of every front-end copy. CANCELLED maps to
+# 499 (nginx's "client closed request"): the caller is gone, so the
+# code is for proxies and access logs, not the client.
 HTTP_STATUS: Dict[str, int] = {
     "NOT_FOUND": 404,
     "INVALID_ARGUMENT": 400,
@@ -38,6 +40,7 @@ HTTP_STATUS: Dict[str, int] = {
     "INTERNAL": 500,
     "PERMISSION_DENIED": 403,
     "UNAUTHENTICATED": 401,
+    "CANCELLED": 499,
 }
 
 HTTP_OK = 200
@@ -65,6 +68,7 @@ FLIGHT_KEEP_REASONS = {
     "DEADLINE_EXCEEDED": "timeout",
     "UNAVAILABLE": "shed",
     "RESOURCE_EXHAUSTED": "quota",
+    "CANCELLED": "cancelled",
 }
 
 #: Definitive client errors — the server answered decisively, which is

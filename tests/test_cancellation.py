@@ -1,0 +1,735 @@
+"""End-to-end request cancellation (docs/cancellation.md): token and
+registry semantics, the golden resource-release matrix (batcher queue
+drop + in-flight early completion with wasted-compute billing, tenant
+in-flight slot release, LLM lane reap freeing KV pages, sequence
+turnstile abandonment, single-flight follower detach / leader abort),
+ensemble between-stage aborts with remaining-deadline budgets, the
+wire cancellation surfaces (HTTP /v2/cancel route, gRPC client-side
+cancel, aio disconnect), and the chaos ``abandon_rate`` fault with
+surviving-client goodput unaffected."""
+
+import asyncio
+import json
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from client_tpu.models.simple_extra import SequenceAccumulator
+from client_tpu.protocol import inference_pb2 as pb
+from client_tpu.server import chaos
+from client_tpu.server.app import build_core, start_grpc_server
+from client_tpu.server.batcher import DynamicBatcher
+from client_tpu.server.cancel import (
+    REASON_CLIENT_DISCONNECT,
+    CancelRegistry,
+    CancelToken,
+)
+from client_tpu.server.http_server import start_http_server_thread
+from client_tpu.server.model import ServedModel, TensorSpec
+from client_tpu.server.qos import TenantQuotaManager
+from client_tpu.server.sequence import SequenceScheduler
+from client_tpu.utils import InferenceServerException
+
+
+def _wait_for(predicate, timeout_s=10.0):
+    deadline = time.monotonic() + timeout_s
+    while not predicate() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert predicate()
+
+
+def _metric(core, family, labels):
+    pattern = r"%s\{%s\} (\d+)" % (re.escape(family), re.escape(labels))
+    match = re.search(pattern, core.metrics_text())
+    return int(match.group(1)) if match else 0
+
+
+# -- token + registry semantics -------------------------------------------
+
+
+def test_token_cancel_idempotent_fires_callbacks_once():
+    token = CancelToken()
+    fired = []
+    handle = token.on_cancel(lambda: fired.append("a"))
+    assert token.cancel("wire_cancel") is True
+    assert token.cancel("wire_cancel") is False  # idempotent
+    assert fired == ["a"]
+    token.remove_callback(handle)  # late remove is a no-op
+    # registration after cancellation fires immediately
+    token.on_cancel(lambda: fired.append("late"))
+    assert fired == ["a", "late"]
+    assert token.cancelled()
+    assert token.reason == "wire_cancel"
+
+
+def test_removed_callback_never_fires():
+    token = CancelToken()
+    fired = []
+    handle = token.on_cancel(lambda: fired.append(1))
+    token.remove_callback(handle)
+    token.cancel()
+    assert fired == []
+
+
+def test_raise_if_cancelled_stamps_stage_and_status():
+    token = CancelToken()
+    token.cancel("client_disconnect")
+    with pytest.raises(InferenceServerException) as exc:
+        token.raise_if_cancelled("queue")
+    assert exc.value.status() == "CANCELLED"
+    assert exc.value.cancel_stage == "queue"
+    assert token.stage == "queue"  # first raise wins the stage stamp
+    with pytest.raises(InferenceServerException):
+        token.raise_if_cancelled("execute")
+    assert token.stage == "queue"
+
+
+def test_deadline_expiry_raises_deadline_exceeded():
+    now = time.monotonic_ns()
+    token = CancelToken(deadline_ns=now + 50_000_000)  # 50 ms
+    assert not token.expired(now)
+    assert token.remaining_us(now) == 50_000
+    late = now + 60_000_000
+    assert token.expired(late)
+    assert token.remaining_us(late) == 0  # floored, never negative
+    with pytest.raises(InferenceServerException) as exc:
+        token.raise_if_cancelled("ensemble", now_ns=late)
+    assert exc.value.status() == "DEADLINE_EXCEEDED"
+    assert exc.value.cancel_stage == "ensemble"
+
+
+def test_registry_tracks_and_wire_cancels_by_id():
+    registry = CancelRegistry(enabled=True)
+    token = registry.mint("req-9", timeout_us=None)
+    registry.track(token)
+    assert registry.inflight() == 1
+    assert registry.cancel("req-9") is True
+    assert token.cancelled()
+    assert registry.cancel("no-such-id") is False
+    assert registry.unknown_id_cancels == 1
+    registry.untrack(token)
+    assert registry.inflight() == 0
+
+
+def test_kill_switch_env(monkeypatch):
+    monkeypatch.setenv("CLIENT_TPU_CANCEL", "off")
+    assert not CancelRegistry().enabled
+    monkeypatch.setenv("CLIENT_TPU_CANCEL", "on")
+    assert CancelRegistry().enabled
+
+
+# -- batcher sink ----------------------------------------------------------
+
+
+class GatedModel(ServedModel):
+    """Execution blocks on a per-test gate so cancels can land at a
+    chosen stage; ``entered`` flips when a fused batch dispatches."""
+
+    max_batch_size = 8
+    dynamic_batching = True
+
+    def __init__(self, name="cancel_gated"):
+        super().__init__()
+        self.name = name
+        self.inputs = [TensorSpec("IN", "FP32", [4])]
+        self.outputs = [TensorSpec("OUT", "FP32", [4])]
+        self.gate = threading.Event()
+        self.entered = threading.Event()
+        self.executions = []
+
+    def infer(self, inputs, parameters=None):
+        self.entered.set()
+        assert self.gate.wait(30), "test gate never released"
+        array = np.asarray(inputs["IN"])
+        self.executions.append([float(v) for v in array[:, 0]])
+        return {"OUT": array * 2.0}
+
+
+def _submit(batcher, i, cancel=None, results=None):
+    def run():
+        try:
+            out, _, _ = batcher.infer(
+                {"IN": np.full((1, 4), float(i), np.float32)}, {}, 1,
+                cancel=cancel)
+            results[i] = ("ok", float(out["OUT"][0, 0]))
+        except InferenceServerException as e:
+            results[i] = (e.status(), getattr(e, "cancel_stage", None))
+
+    thread = threading.Thread(target=run, daemon=True)
+    thread.start()
+    return thread
+
+
+def test_batcher_drops_queued_member_on_cancel():
+    model = GatedModel()
+    batcher = DynamicBatcher(model, max_queue_delay_us=1000,
+                             preferred_batch_sizes=[1], pipeline_depth=1)
+    results = {}
+    t0 = _submit(batcher, 0, results=results)
+    _wait_for(model.entered.is_set)  # request 0 dispatched, holds gate
+    token = CancelToken()
+    t1 = _submit(batcher, 1, cancel=token, results=results)
+    _wait_for(lambda: batcher.stats_snapshot()["pending_count"] == 1)
+    token.cancel(REASON_CLIENT_DISCONNECT)
+    t1.join(timeout=5)  # returns while the gate is still held
+    assert not t1.is_alive()
+    assert results[1] == ("CANCELLED", "queue")
+    assert batcher.stats_snapshot()["pending_count"] == 0  # backed out
+    model.gate.set()
+    t0.join(timeout=10)
+    batcher.stop()
+    assert results[0] == ("ok", 0.0)
+    # the dropped member never executed
+    assert all(1.0 not in ex for ex in model.executions)
+
+
+def test_batcher_inflight_cancel_completes_early_and_bills_waste():
+    model = GatedModel()
+    wasted = []
+    batcher = DynamicBatcher(model, max_queue_delay_us=300_000,
+                             preferred_batch_sizes=[2],
+                             wasted_hook=wasted.append)
+    results = {}
+    token = CancelToken()
+    t0 = _submit(batcher, 0, results=results)
+    t1 = _submit(batcher, 1, cancel=token, results=results)
+    _wait_for(model.entered.is_set)  # both fused, batch in flight
+    token.cancel(REASON_CLIENT_DISCONNECT)
+    t1.join(timeout=5)  # early completion: never re-pads in-flight XLA
+    assert not t1.is_alive()
+    assert results[1] == ("CANCELLED", "execute")
+    model.gate.set()
+    t0.join(timeout=10)
+    batcher.stop()
+    assert results[0] == ("ok", 0.0)  # survivor's slice intact
+    assert model.executions == [[0.0, 1.0]]  # one fused execution ran
+    # the cancelled member's row-proportional compute share is billed
+    assert len(wasted) == 1 and wasted[0] > 0
+
+
+# -- golden resource-release matrix over the wire --------------------------
+
+
+def _pb_request(model, array, name="IN", request_id="", tenant=None,
+                timeout_us=None):
+    request = pb.ModelInferRequest(model_name=model, id=request_id)
+    tensor = request.inputs.add()
+    tensor.name = name
+    tensor.datatype = {"float32": "FP32", "int32": "INT32"}[
+        str(array.dtype)]
+    tensor.shape.extend(array.shape)
+    request.raw_input_contents.append(array.tobytes())
+    if tenant:
+        request.parameters["tenant"].string_param = tenant
+    if timeout_us:
+        request.parameters["timeout"].int64_param = timeout_us
+    return request
+
+
+@pytest.fixture(scope="module")
+def wire():
+    core = build_core([], warmup=False)
+    model = GatedModel()
+    core.repository.add_model(model)
+    core.tenant_quotas = TenantQuotaManager.from_spec(
+        "default=rate:10000,burst:100,concurrency:8")
+    grpc_handle = start_grpc_server(core=core)
+    http_runner = start_http_server_thread(core, host="127.0.0.1",
+                                           port=0)
+    yield core, model, grpc_handle, http_runner
+    model.gate.set()
+    http_runner.stop()
+    grpc_handle.stop()
+    core.shutdown()
+
+
+@pytest.fixture()
+def fresh_gate(wire):
+    _core, model, _grpc, _http = wire
+    model.gate = threading.Event()
+    model.entered = threading.Event()
+    yield
+    model.gate.set()
+
+
+def test_wire_cancel_releases_tenant_slot_and_registry(wire, fresh_gate):
+    core, model, _grpc, _http = wire
+    before = _metric(core, "tpu_request_cancelled_total",
+                     'model="cancel_gated",stage="execute"')
+    outcome = {}
+
+    def run():
+        try:
+            core.infer(_pb_request("cancel_gated",
+                                   np.ones((1, 4), np.float32),
+                                   request_id="wc-1", tenant="acme"))
+            outcome["status"] = "ok"
+        except InferenceServerException as e:
+            outcome["status"] = e.status()
+            outcome["stage"] = getattr(e, "cancel_stage", None)
+
+    thread = threading.Thread(target=run, daemon=True)
+    thread.start()
+    _wait_for(model.entered.is_set)
+    assert core.tenant_quotas.snapshot()["acme"]["inflight"] == 1
+    assert core.cancel.inflight() == 1
+    assert core.cancel_request("wc-1") is True
+    thread.join(timeout=10)
+    assert not thread.is_alive()
+    assert outcome == {"status": "CANCELLED", "stage": "execute"}
+    # golden matrix rows: tenant slot back, registry drained
+    assert core.tenant_quotas.snapshot()["acme"]["inflight"] == 0
+    assert core.cancel.inflight() == 0
+    assert core.cancel_request("wc-1") is False  # already finished
+    after = _metric(core, "tpu_request_cancelled_total",
+                    'model="cancel_gated",stage="execute"')
+    assert after == before + 1
+    # releasing the gate lets the in-flight batch finish and bill the
+    # abandoned member's compute share
+    model.gate.set()
+    _wait_for(lambda: _metric(core, "tpu_wasted_compute_us",
+                              'model="cancel_gated"') > 0)
+
+
+def test_http_cancel_route_returns_499(wire, fresh_gate):
+    _core, model, _grpc, http_runner = wire
+    base = "http://127.0.0.1:%d" % http_runner.port
+    body = json.dumps({
+        "id": "http-c1",
+        "inputs": [{"name": "IN", "shape": [1, 4], "datatype": "FP32",
+                    "data": [1.0, 2.0, 3.0, 4.0]}],
+    }).encode()
+    outcome = {}
+
+    def run():
+        request = urllib.request.Request(
+            base + "/v2/models/cancel_gated/infer", data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(request) as response:
+                outcome["code"] = response.status
+        except urllib.error.HTTPError as e:
+            outcome["code"] = e.code
+
+    thread = threading.Thread(target=run, daemon=True)
+    thread.start()
+    _wait_for(model.entered.is_set)
+    cancel = urllib.request.Request(base + "/v2/cancel/http-c1",
+                                    data=b"", method="POST")
+    with urllib.request.urlopen(cancel) as response:
+        assert response.status == 200
+        assert json.load(response) == {"cancelled": True}
+    thread.join(timeout=10)
+    assert not thread.is_alive()
+    assert outcome["code"] == 499  # nginx's "client closed request"
+    # unknown / already-finished id: 404
+    late = urllib.request.Request(base + "/v2/cancel/http-c1",
+                                  data=b"", method="POST")
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        urllib.request.urlopen(late)
+    assert exc.value.code == 404
+    model.gate.set()
+
+
+def test_grpc_client_cancel_reaches_server_token(wire, fresh_gate):
+    import grpc as grpc_mod
+
+    from client_tpu.protocol.service import GRPCInferenceServiceStub
+
+    core, model, grpc_handle, _http = wire
+    before = _metric(core, "tpu_request_cancelled_total",
+                     'model="cancel_gated",stage="execute"')
+    channel = grpc_mod.insecure_channel(grpc_handle.address)
+    stub = GRPCInferenceServiceStub(channel)
+    future = stub.ModelInfer.future(
+        _pb_request("cancel_gated", np.ones((1, 4), np.float32),
+                    request_id="grpc-c1"))
+    _wait_for(model.entered.is_set)
+    future.cancel()  # client walks away: context callback fires
+    _wait_for(lambda: _metric(
+        core, "tpu_request_cancelled_total",
+        'model="cancel_gated",stage="execute"') == before + 1)
+    channel.close()
+    model.gate.set()
+
+
+def test_aio_http_disconnect_cancels_inflight_request(wire, fresh_gate):
+    aiohttp = pytest.importorskip("aiohttp")
+    core, model, _grpc, http_runner = wire
+    before = _metric(core, "tpu_request_cancelled_total",
+                     'model="cancel_gated",stage="execute"')
+    url = ("http://127.0.0.1:%d/v2/models/cancel_gated/infer"
+           % http_runner.port)
+    payload = {
+        "id": "aio-c1",
+        "inputs": [{"name": "IN", "shape": [1, 4], "datatype": "FP32",
+                    "data": [1.0, 1.0, 1.0, 1.0]}],
+    }
+
+    async def go():
+        async with aiohttp.ClientSession() as session:
+            task = asyncio.ensure_future(session.post(url, json=payload))
+            loop = asyncio.get_event_loop()
+            await loop.run_in_executor(None, model.entered.wait)
+            task.cancel()  # closes the connection mid-request
+            with pytest.raises(asyncio.CancelledError):
+                await task
+
+    asyncio.run(go())
+    _wait_for(lambda: _metric(
+        core, "tpu_request_cancelled_total",
+        'model="cancel_gated",stage="execute"') == before + 1)
+    model.gate.set()
+
+
+def test_stream_cancel_ends_with_cancelled_error(wire):
+    core, _model, _grpc, _http = wire
+    core.repository.load("repeat_int32")
+    token = CancelToken()
+    request = _pb_request("repeat_int32",
+                          np.array([1, 2, 3, 4], np.int32),
+                          request_id="st-c1")
+    before = _metric(core, "tpu_request_cancelled_total",
+                     'model="repeat_int32",stage="stream"')
+    stream = core.stream_infer(request, cancel=token)
+    first = next(stream)
+    assert not first.error_message
+    token.cancel(REASON_CLIENT_DISCONNECT)
+    responses = list(stream)
+    assert responses, "the cancel must surface as an in-stream error"
+    assert "cancelled" in responses[-1].error_message
+    after = _metric(core, "tpu_request_cancelled_total",
+                    'model="repeat_int32",stage="stream"')
+    assert after == before + 1
+
+
+# -- LLM lane reap ---------------------------------------------------------
+
+
+def test_llm_cancel_token_reaps_lane_and_frees_pages():
+    from client_tpu.models.llm import LlmConfig, LlmModel
+
+    model = LlmModel(
+        name="llm_cancel_token",
+        cfg=LlmConfig(d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+                      d_ff=128, max_seq=128),
+        paged_kv=True, decode_lanes=2, page_size=4)
+    try:
+        token = CancelToken()
+        gen = model._generate(
+            {"text_input": np.array([b"abandoned stream"],
+                                    dtype=np.object_),
+             "max_tokens": np.array([200], dtype=np.int32),
+             "ignore_eos": np.array([True])},
+            {"cancel_token": token})
+        next(gen)
+        assert model.kv_stats()["pages_used"] > 0
+        token.cancel(REASON_CLIENT_DISCONNECT)
+        list(gen)  # the reap posts the end sentinel; no 200-token wait
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            snap = model.kv_stats()
+            if not (snap["pages_used"] or snap["pages_reserved"]):
+                break
+            time.sleep(0.05)
+        snap = model.kv_stats()
+        assert snap["pages_used"] == 0 and snap["pages_reserved"] == 0
+        # the lane is immediately reusable by a surviving client
+        survivor = list(model._generate(
+            {"text_input": np.array([b"next"], dtype=np.object_),
+             "max_tokens": np.array([4], dtype=np.int32),
+             "ignore_eos": np.array([True])}, {}))
+        assert len(survivor) == 4
+    finally:
+        model.unload()
+
+
+# -- sequence turnstile ----------------------------------------------------
+
+
+def test_sequence_cancelled_waiter_abandons_ticket_without_wedging():
+    class SlowSeq(SequenceAccumulator):
+        def infer(self, inputs, parameters=None):
+            time.sleep(0.2)
+            return super().infer(inputs, parameters)
+
+    model = SlowSeq(name="cancel_seq")
+    scheduler = SequenceScheduler(model)
+    results = {}
+
+    def step(key, value, start=False, end=False, cancel=None):
+        try:
+            out, _, _ = scheduler.infer(
+                {"INPUT": np.array([value], dtype=np.int32)},
+                {"sequence_id": 77, "sequence_start": start,
+                 "sequence_end": end}, 1, cancel=cancel)
+            results[key] = ("ok",
+                            int(np.asarray(out["OUTPUT"]).reshape(-1)[0]))
+        except InferenceServerException as e:
+            results[key] = (e.status(), getattr(e, "cancel_stage", None))
+
+    token = CancelToken()
+    threads = [threading.Thread(target=step, args=("s1", 1, True))]
+    threads[0].start()
+    time.sleep(0.05)  # s1 admitted, executing: holds the turn
+    threads.append(threading.Thread(
+        target=step, args=("s2", 2), kwargs={"cancel": token}))
+    threads[1].start()
+    time.sleep(0.05)  # s2 ticketed behind s1
+    threads.append(threading.Thread(
+        target=step, args=("s3", 3), kwargs={"end": True}))
+    threads[2].start()
+    time.sleep(0.05)
+    token.cancel(REASON_CLIENT_DISCONNECT)
+    for thread in threads:
+        thread.join(timeout=10)
+        assert not thread.is_alive()
+    assert results["s1"] == ("ok", 1)
+    assert results["s2"] == ("CANCELLED", "queue")
+    # the turnstile skipped the abandoned ticket: s3 still served
+    assert results["s3"] == ("ok", 4)  # 1 + 3; the cancelled 2 never ran
+    snap = scheduler.stats_snapshot()
+    assert snap["active_sequences"] == 0  # slot reclaimed at end
+    scheduler.stop()
+
+
+# -- single-flight (response cache) ----------------------------------------
+
+
+class SlowCached(ServedModel):
+    response_cache = True
+    max_batch_size = 0
+
+    def __init__(self, name="cancel_sf", delay_s=0.5):
+        super().__init__()
+        self.name = name
+        self.delay_s = delay_s
+        self.inputs = [TensorSpec("IN", "FP32", [4])]
+        self.outputs = [TensorSpec("OUT", "FP32", [4])]
+        self.entered = threading.Event()
+        self.calls = 0
+
+    def infer(self, inputs, parameters=None):
+        self.calls += 1
+        self.entered.set()
+        time.sleep(self.delay_s)
+        return {"OUT": np.asarray(inputs["IN"]) * 3.0}
+
+
+def _sf_infer(core, model_name, value, outcome, key, cancel=None):
+    def run():
+        try:
+            response = core.infer(
+                _pb_request(model_name,
+                            np.full((4,), float(value), np.float32)),
+                cancel=cancel)
+            out = np.frombuffer(response.raw_output_contents[0],
+                                np.float32)
+            outcome[key] = ("ok", float(out[0]))
+        except InferenceServerException as e:
+            outcome[key] = (e.status(), getattr(e, "cancel_stage", None))
+
+    thread = threading.Thread(target=run, daemon=True)
+    thread.start()
+    return thread
+
+
+def test_cancelled_follower_detaches_without_killing_leader():
+    core = build_core([], warmup=False)
+    model = SlowCached("cancel_sf", delay_s=0.6)
+    core.repository.add_model(model)
+    outcome = {}
+    leader = _sf_infer(core, "cancel_sf", 5, outcome, "leader")
+    _wait_for(model.entered.is_set)
+    token = CancelToken()
+    follower = _sf_infer(core, "cancel_sf", 5, outcome, "follower",
+                         cancel=token)
+    time.sleep(0.15)  # follower parked on the leader's flight
+    token.cancel(REASON_CLIENT_DISCONNECT)
+    follower.join(timeout=5)
+    assert not follower.is_alive()
+    assert outcome["follower"] == ("CANCELLED", "queue")
+    leader.join(timeout=10)
+    assert outcome["leader"] == ("ok", 15.0)  # leader unharmed
+    assert model.calls == 1
+    # burst resolved: an identical request now hits the cache
+    third = _sf_infer(core, "cancel_sf", 5, outcome, "third")
+    third.join(timeout=5)
+    assert outcome["third"] == ("ok", 15.0)
+    assert model.calls == 1  # cache hit, no re-execution
+    core.shutdown()
+
+
+def test_cancelled_leader_aborts_surviving_follower_reexecutes():
+    core = build_core([], warmup=False)
+    model = SlowCached("cancel_sf2", delay_s=0.4)
+    core.repository.add_model(model)
+    outcome = {}
+    token = CancelToken()
+    leader = _sf_infer(core, "cancel_sf2", 7, outcome, "leader",
+                       cancel=token)
+    _wait_for(model.entered.is_set)
+    follower = _sf_infer(core, "cancel_sf2", 7, outcome, "follower")
+    time.sleep(0.1)
+    token.cancel(REASON_CLIENT_DISCONNECT)
+    leader.join(timeout=10)
+    assert outcome["leader"][0] == "CANCELLED"
+    # the non-cancelled follower falls back to its own execution
+    follower.join(timeout=10)
+    assert not follower.is_alive()
+    assert outcome["follower"] == ("ok", 21.0)
+    core.shutdown()
+
+
+# -- ensembles -------------------------------------------------------------
+
+
+class _RecStage(ServedModel):
+    """Direct composing stage recording the timeout budget it was
+    handed; optionally cancels a token mid-stage (the disconnect that
+    lands while stage k runs)."""
+
+    max_batch_size = 8
+
+    def __init__(self, name, in_name, out_name, scale, sleep_s=0.0):
+        super().__init__()
+        self.name = name
+        self.inputs = [TensorSpec(in_name, "FP32", [4])]
+        self.outputs = [TensorSpec(out_name, "FP32", [4])]
+        self._in, self._out, self._scale = in_name, out_name, scale
+        self._sleep_s = sleep_s
+        self.seen_timeouts = []
+        self.cancel_during = None
+        self.calls = 0
+
+    def infer(self, inputs, parameters=None):
+        self.calls += 1
+        self.seen_timeouts.append((parameters or {}).get("timeout"))
+        if self._sleep_s:
+            time.sleep(self._sleep_s)
+        if self.cancel_during is not None:
+            self.cancel_during.cancel(REASON_CLIENT_DISCONNECT)
+        x = np.asarray(inputs[self._in], dtype=np.float32)
+        return {self._out: x * np.float32(self._scale)}
+
+
+@pytest.fixture()
+def ensemble_core():
+    from client_tpu.models.ensemble import EnsembleModel
+
+    core = build_core([], warmup=False)
+    repo = core.repository
+    edge = _RecStage("c_edge", "XIN", "H", 2.0, sleep_s=0.05)
+    tail = _RecStage("c_tail", "H", "OUT", 3.0)
+    repo.add_model(edge)
+    repo.add_model(tail)
+    repo.add_factory("c_ens", lambda: EnsembleModel(
+        name="c_ens", repository=repo,
+        steps=[("c_edge", {"XIN": "XIN"}, {"h": "H"}),
+               ("c_tail", {"h": "H"}, {"OUT": "OUT"})],
+        inputs=[TensorSpec("XIN", "FP32", [4])],
+        outputs=[TensorSpec("OUT", "FP32", [4])],
+        max_batch_size=8))
+    core.load_model("c_ens", warmup=False)
+    yield core, edge, tail
+    core.shutdown()
+
+
+def test_ensemble_cancel_between_stages_aborts_subgraph(ensemble_core):
+    core, edge, tail = ensemble_core
+    token = CancelToken()
+    edge.cancel_during = token  # disconnect lands while stage 1 runs
+    with pytest.raises(InferenceServerException) as exc:
+        core.infer(_pb_request("c_ens", np.ones((1, 4), np.float32),
+                               name="XIN"), cancel=token)
+    assert exc.value.status() == "CANCELLED"
+    assert exc.value.cancel_stage == "ensemble"
+    assert edge.calls == 1
+    assert tail.calls == 0  # the remaining subgraph never ran
+    assert _metric(core, "tpu_request_cancelled_total",
+                   'model="c_ens",stage="ensemble"') == 1
+
+
+def test_ensemble_stages_get_remaining_deadline_budget(ensemble_core):
+    core, edge, tail = ensemble_core
+    response = core.infer(
+        _pb_request("c_ens", np.ones((1, 4), np.float32), name="XIN",
+                    timeout_us=2_000_000))
+    out = np.frombuffer(response.raw_output_contents[0], np.float32)
+    np.testing.assert_allclose(out, np.full(4, 6.0), rtol=1e-6)
+    edge_budget = edge.seen_timeouts[-1]
+    tail_budget = tail.seen_timeouts[-1]
+    assert edge_budget is not None and tail_budget is not None
+    assert int(edge_budget) <= 2_000_000
+    # stage 1 slept 50 ms: stage 2's budget shrank by the elapsed time
+    assert int(tail_budget) <= int(edge_budget) - 30_000
+
+
+# -- chaos abandon_rate ----------------------------------------------------
+
+
+class QuickModel(ServedModel):
+    max_batch_size = 0
+
+    def __init__(self, name="abandon_quick"):
+        super().__init__()
+        self.name = name
+        self.inputs = [TensorSpec("IN", "FP32", [4])]
+        self.outputs = [TensorSpec("OUT", "FP32", [4])]
+
+    def infer(self, inputs, parameters=None):
+        time.sleep(0.01)
+        return {"OUT": np.asarray(inputs["IN"]) + 1.0}
+
+
+def test_chaos_abandon_cancels_sampled_requests_survivors_unaffected():
+    core = build_core([], warmup=False)
+    core.repository.add_model(QuickModel())
+    chaos.configure(chaos.ChaosConfig(abandon_rate=0.5, seed=11))
+    cancelled, ok = 0, 0
+    try:
+        before = chaos.stats()["abandoned_requests"]
+        for i in range(20):
+            token = core.cancel.mint("ab-%d" % i)
+            try:
+                response = core.infer(
+                    _pb_request("abandon_quick",
+                                np.full((4,), float(i), np.float32),
+                                request_id="ab-%d" % i),
+                    cancel=token)
+                out = np.frombuffer(response.raw_output_contents[0],
+                                    np.float32)
+                # surviving-client goodput: correct answers, not junk
+                np.testing.assert_allclose(out, np.full(4, i + 1.0))
+                ok += 1
+            except InferenceServerException as e:
+                assert e.status() == "CANCELLED"
+                cancelled += 1
+        abandoned = chaos.stats()["abandoned_requests"] - before
+    finally:
+        chaos.configure(None)
+        core.shutdown()
+    assert cancelled > 0 and ok > 0  # the coin actually flipped
+    assert cancelled == abandoned
+    assert cancelled + ok == 20
+
+
+def test_chaos_abandon_inert_without_token():
+    core = build_core([], warmup=False)
+    core.repository.add_model(QuickModel(name="abandon_inert"))
+    core.cancel.enabled = False  # kill switch: no token minted
+    chaos.configure(chaos.ChaosConfig(abandon_rate=1.0, seed=5))
+    try:
+        before = chaos.stats()["abandoned_requests"]
+        response = core.infer(_pb_request(
+            "abandon_inert", np.ones((4,), np.float32)))
+        assert response.raw_output_contents  # served normally
+        assert chaos.stats()["abandoned_requests"] == before
+    finally:
+        chaos.configure(None)
+        core.shutdown()

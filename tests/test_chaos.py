@@ -91,7 +91,8 @@ def test_disabled_is_noop():
     chaos.configure(None)
     chaos.inject("anything")  # must not raise or sleep
     assert chaos.stats() == {"injected_errors": 0, "injected_drops": 0,
-                             "delayed_requests": 0, "injected_hangs": 0}
+                             "delayed_requests": 0, "injected_hangs": 0,
+                             "abandoned_requests": 0}
 
 
 def test_core_counts_injected_errors_as_failures():

@@ -1,0 +1,339 @@
+#!/usr/bin/env python
+"""CI smoke for the request-cancellation lifecycle
+(client_tpu/server/cancel.py, docs/cancellation.md).
+
+Drives an abandoned-request storm A/B against an in-process core: 16
+closed-loop clients, half of which walk away a few milliseconds after
+submitting each request (the token flips mid-queue, exactly what a
+dropped connection does). Three arms on identical workloads:
+
+* **baseline** — survivors only, no abandoners: the p99 yardstick.
+* **ignore**   — storm with the cancel kill switch off: every
+  abandoned request computes to completion; its distinct payload
+  values reaching the model are the wasted-work denominator.
+* **cancel**   — storm with cancellation on (the default).
+
+Gates:
+
+1. **Waste ≤ 0.4x** — abandoned work reaching the model in the
+   cancel arm is at most 0.4x the ignore arm (queued members must be
+   dropped before dispatch; only the already-in-flight sliver may
+   execute).
+2. **Survivors unharmed** — survivor p99 in the cancel arm within
+   1.2x the no-abandon baseline (floor 50 ms for CI noise): reclaimed
+   capacity goes back to live callers.
+3. **Nothing leaks** — after the storm drains: tenant in-flight
+   slots 0, cancel registry empty, and (post-unload) HBM allocator
+   leases + device-ledger residual zero. A separate paged-LLM burst
+   cancels 4 live decode streams and requires pages_used ==
+   pages_reserved == 0 afterwards, with the lane immediately
+   reusable.
+4. **Hot path free** — the shared paired-A/B overhead driver
+   (`bench_child._overhead_ab_measure(core, core.cancel, "cancel")`)
+   holds the always-on token mint + stage checks under 2% throughput
+   cost on `add_sub_large`.
+
+Exits non-zero with one line per violation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import sys
+import threading
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+SURVIVORS = 8
+ABANDONERS = 8
+REQUESTS_EACH = 8
+ABANDON_AFTER_S = 0.005
+EXEC_SLEEP_S = 0.04
+
+FAILURES: list = []
+
+
+def gate(ok: bool, label: str, detail: str = "") -> None:
+    line = "%s%s" % (label, (": " + detail) if detail else "")
+    if ok:
+        print("  ok   %s" % line)
+    else:
+        print("  FAIL %s" % line)
+        FAILURES.append(line)
+
+
+def _p99(samples):
+    if not samples:
+        return 0.0
+    ordered = sorted(samples)
+    return ordered[min(len(ordered) - 1, int(len(ordered) * 0.99))]
+
+
+def _storm_arm(abandon: bool, cancel_enabled: bool) -> dict:
+    """One arm on a fresh core; returns survivor latencies, the set of
+    abandoned payload values that reached the model, and the drain
+    state of every storm-held resource."""
+    import numpy as np
+
+    from client_tpu.protocol import inference_pb2 as pb
+    from client_tpu.server import cancel as cancel_mod
+    from client_tpu.server.app import build_core
+    from client_tpu.server.model import ServedModel, TensorSpec
+    from client_tpu.server.qos import TenantQuotaManager
+    from client_tpu.utils import InferenceServerException
+
+    class StormModel(ServedModel):
+        """Fused execution burns EXEC_SLEEP_S and records each row's
+        payload value — the ground truth of what actually computed."""
+
+        max_batch_size = 8
+        dynamic_batching = True
+
+        def __init__(self):
+            super().__init__()
+            self.name = "cancel_storm"
+            self.inputs = [TensorSpec("IN", "FP32", [4])]
+            self.outputs = [TensorSpec("OUT", "FP32", [4])]
+            self.seen: set = set()
+            self._lock = threading.Lock()
+
+        def infer(self, inputs, parameters=None):
+            array = np.asarray(inputs["IN"])
+            time.sleep(EXEC_SLEEP_S)
+            with self._lock:
+                self.seen.update(int(v) for v in array[:, 0])
+            return {"OUT": array * 2.0}
+
+    core = build_core([], warmup=False)
+    model = StormModel()
+    core.repository.add_model(model)
+    core.tenant_quotas = TenantQuotaManager.from_spec(
+        "default=rate:100000,burst:1000,concurrency:64")
+    core.cancel.enabled = cancel_enabled
+
+    def request(value: int, request_id: str):
+        req = pb.ModelInferRequest(model_name="cancel_storm",
+                                   id=request_id)
+        tensor = req.inputs.add()
+        tensor.name = "IN"
+        tensor.datatype = "FP32"
+        tensor.shape.extend([1, 4])
+        req.raw_input_contents.append(
+            np.full((1, 4), float(value), np.float32).tobytes())
+        req.parameters["tenant"].string_param = "storm"
+        return req
+
+    survivor_latencies: list = []
+    abandoned_values: set = set()
+    merge = threading.Lock()
+
+    def survivor(index: int):
+        local = []
+        for i in range(REQUESTS_EACH):
+            value = 1000 + index * REQUESTS_EACH + i
+            t0 = time.monotonic()
+            core.infer(request(value, "sv-%d" % value))
+            local.append(time.monotonic() - t0)
+        with merge:
+            survivor_latencies.extend(local)
+
+    def abandoner(index: int):
+        for i in range(REQUESTS_EACH):
+            value = 50000 + index * REQUESTS_EACH + i
+            request_id = "ab-%d" % value
+            with merge:
+                abandoned_values.add(value)
+            # The ignore arm mimics a lifecycle-less server: no token
+            # is wired in, so the walk-away has nothing to flip and
+            # the request computes to completion.
+            token = (core.cancel.mint(request_id)
+                     if cancel_enabled else None)
+            if token is not None:
+                # the caller walks away shortly after submitting —
+                # same flip a dropped transport produces
+                threading.Timer(
+                    ABANDON_AFTER_S, token.cancel,
+                    args=(cancel_mod.REASON_CLIENT_DISCONNECT,)).start()
+            try:
+                core.infer(request(value, request_id), cancel=token)
+            except InferenceServerException:
+                pass  # CANCELLED is this client's expected ending
+
+    threads = [threading.Thread(target=survivor, args=(i,))
+               for i in range(SURVIVORS)]
+    if abandon:
+        threads += [threading.Thread(target=abandoner, args=(i,))
+                    for i in range(ABANDONERS)]
+    t0 = time.monotonic()
+    for thread in threads:
+        thread.start()
+    for thread in threads:
+        thread.join()
+    wall_s = time.monotonic() - t0
+
+    time.sleep(0.3)  # let in-flight fused tails and timers drain
+    tenant_inflight = core.tenant_quotas.snapshot().get(
+        "storm", {}).get("inflight", 0)
+    registry_inflight = core.cancel.inflight()
+    core.unload_model("cancel_storm")
+    hbm = core.hbm.debug_snapshot()
+    leased = sum(dev["leased_bytes"] for dev in hbm["devices"].values())
+    ledger_residual = sum(
+        sum(components.values())
+        for _model, components
+        in core.devstats.ledger.paged_snapshot().items())
+    core.shutdown()
+    return {
+        "wall_s": round(wall_s, 3),
+        "survivor_p99_s": round(_p99(survivor_latencies), 4),
+        "wasted_executed": len(abandoned_values & model.seen),
+        "abandoned_total": len(abandoned_values),
+        "tenant_inflight": tenant_inflight,
+        "registry_inflight": registry_inflight,
+        "leased_bytes": leased,
+        "ledger_residual": ledger_residual,
+    }
+
+
+def _llm_burst() -> dict:
+    """Cancel 4 live paged-KV decode streams mid-flight; the pool must
+    drain to zero and a survivor must get a lane immediately."""
+    import numpy as np
+
+    from client_tpu.models.llm import LlmConfig, LlmModel
+    from client_tpu.server import cancel as cancel_mod
+    from client_tpu.server.cancel import CancelToken
+
+    model = LlmModel(
+        name="cancel_smoke_llm",
+        cfg=LlmConfig(d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+                      d_ff=128, max_seq=128),
+        paged_kv=True, decode_lanes=4, page_size=4)
+    try:
+        tokens, generators = [], []
+        for i in range(4):
+            token = CancelToken()
+            gen = model._generate(
+                {"text_input": np.array([b"abandoned stream %d" % i],
+                                        dtype=np.object_),
+                 "max_tokens": np.array([200], dtype=np.int32),
+                 "ignore_eos": np.array([True])},
+                {"cancel_token": token})
+            next(gen)  # stream live: pages held
+            tokens.append(token)
+            generators.append(gen)
+        peak = model.kv_stats()
+        for token in tokens:
+            token.cancel(cancel_mod.REASON_CLIENT_DISCONNECT)
+        for gen in generators:
+            list(gen)  # reap posts the end sentinel, not 200 tokens
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            snap = model.kv_stats()
+            if not (snap["pages_used"] or snap["pages_reserved"]):
+                break
+            time.sleep(0.05)
+        snap = model.kv_stats()
+        survivor = list(model._generate(
+            {"text_input": np.array([b"survivor"], dtype=np.object_),
+             "max_tokens": np.array([4], dtype=np.int32),
+             "ignore_eos": np.array([True])}, {}))
+        return {
+            "peak_pages_used": peak["pages_used"],
+            "pages_used": snap["pages_used"],
+            "pages_reserved": snap["pages_reserved"],
+            "survivor_tokens": len(survivor),
+        }
+    finally:
+        model.unload()
+
+
+def main() -> int:
+    from client_tpu.perf.bench_child import _overhead_ab_measure
+    from client_tpu.server.app import build_core
+
+    print("cancel smoke: abandoned storm A/B "
+          "(%d survivors + %d abandoners x %d requests)"
+          % (SURVIVORS, ABANDONERS, REQUESTS_EACH))
+    baseline = _storm_arm(abandon=False, cancel_enabled=True)
+    ignore = _storm_arm(abandon=True, cancel_enabled=False)
+    storm = _storm_arm(abandon=True, cancel_enabled=True)
+    print(json.dumps({"baseline": baseline, "ignore": ignore,
+                      "cancel": storm}, indent=1))
+
+    # Gate 1: wasted work vs the ignore-cancels arm.
+    wasted_ratio = (storm["wasted_executed"] /
+                    max(1, ignore["wasted_executed"]))
+    gate(ignore["wasted_executed"] >= ignore["abandoned_total"] // 2,
+         "ignore arm actually executed the abandoned work",
+         "%d of %d" % (ignore["wasted_executed"],
+                       ignore["abandoned_total"]))
+    gate(wasted_ratio <= 0.4,
+         "cancel arm wasted work <= 0.4x ignore arm",
+         "%d vs %d executed (%.2fx)"
+         % (storm["wasted_executed"], ignore["wasted_executed"],
+            wasted_ratio))
+
+    # Gate 2: survivors unharmed by the storm.
+    p99_bound = max(1.2 * baseline["survivor_p99_s"],
+                    baseline["survivor_p99_s"] + 0.050)
+    gate(storm["survivor_p99_s"] <= p99_bound,
+         "survivor p99 within 1.2x no-abandon baseline",
+         "%.1f ms vs baseline %.1f ms (bound %.1f ms)"
+         % (storm["survivor_p99_s"] * 1e3,
+            baseline["survivor_p99_s"] * 1e3, p99_bound * 1e3))
+
+    # Gate 3: the storm drained every held resource.
+    gate(storm["tenant_inflight"] == 0 and
+         storm["registry_inflight"] == 0,
+         "tenant slots + cancel registry drained",
+         "inflight tenant=%d registry=%d"
+         % (storm["tenant_inflight"], storm["registry_inflight"]))
+    gate(storm["leased_bytes"] == 0 and storm["ledger_residual"] == 0,
+         "allocator + ledger residual zero after unload",
+         "leased=%d paged=%d"
+         % (storm["leased_bytes"], storm["ledger_residual"]))
+
+    llm = _llm_burst()
+    print(json.dumps({"llm_burst": llm}, indent=1))
+    gate(llm["peak_pages_used"] > 0,
+         "llm burst held pages while live",
+         "peak=%d" % llm["peak_pages_used"])
+    gate(llm["pages_used"] == 0 and llm["pages_reserved"] == 0,
+         "kv pages + reservations freed after cancel burst",
+         "used=%d reserved=%d"
+         % (llm["pages_used"], llm["pages_reserved"]))
+    gate(llm["survivor_tokens"] == 4,
+         "lane immediately reusable by a survivor",
+         "tokens=%d" % llm["survivor_tokens"])
+
+    # Gate 4: the always-on mint + stage checks cost < 2%.
+    core = build_core(["add_sub_large"], warmup=False)
+    try:
+        overhead = _overhead_ab_measure(core, core.cancel, "cancel")
+    finally:
+        core.shutdown()
+    print(json.dumps(overhead, indent=1))
+    gate(overhead["overhead_ok"],
+         "cancel lifecycle overhead < 2%%",
+         "%.2f%%" % overhead["overhead_pct"])
+
+    for failure in FAILURES:
+        print("FAIL: %s" % failure, file=sys.stderr)
+    if FAILURES:
+        return 1
+    print("cancel smoke passed: wasted %.2fx ignore arm, survivor p99 "
+          "%.1f ms vs %.1f ms baseline, kv/tenant/ledger residual 0, "
+          "overhead %.2f%%"
+          % (wasted_ratio, storm["survivor_p99_s"] * 1e3,
+             baseline["survivor_p99_s"] * 1e3,
+             overhead["overhead_pct"]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -354,4 +354,21 @@ fi
 grep -E "hbm smoke passed" "$HBM_LOG"
 grep -E "hot p99|cold first-request|residual" "$HBM_LOG"
 echo "OK: hbm smoke passed"
+
+# Cancellation smoke: abandoned-request storm A/B — the cancel arm
+# must waste <= 0.4x the ignore-cancels arm on work whose caller
+# already left, survivor p99 within 1.2x the no-abandon baseline,
+# zero leaked tenant slots / KV pages / allocator+ledger bytes after
+# the storm drains, and the always-on token mint + stage checks under
+# 2% hot-path overhead. Gates live in tools/cancel_smoke.py.
+echo "cancel smoke: abandoned-request storm A/B + leak + overhead"
+CANCEL_LOG=/tmp/_cancel_smoke.log
+if ! timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/cancel_smoke.py \
+    > "$CANCEL_LOG" 2>&1; then
+    echo "FAIL: cancel smoke did not pass" >&2
+    tail -30 "$CANCEL_LOG" >&2
+    exit 1
+fi
+grep -E "cancel smoke passed" "$CANCEL_LOG"
+echo "OK: cancel smoke passed"
 exit 0

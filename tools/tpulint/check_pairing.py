@@ -57,6 +57,12 @@ _RELEASE_OF = {
     # with the device bytes already freed. Scoped to pager-named
     # receivers.
     "page_out": ("restore", "release", "release_model"),
+    # Cancel-callback registrations (client_tpu/server/cancel.py): an
+    # on_cancel() handle that is never removed keeps the dead
+    # request's closure — and whatever it captures: batcher pending
+    # entries, scheduler lanes — alive on the token, and a late cancel
+    # fires into state the request already tore down.
+    "on_cancel": ("remove_callback",),
 }
 
 # Acquire verbs whose result assigned onto ANY attribute counts as an
@@ -88,6 +94,8 @@ def _acquire_attr(call: ast.Call) -> Optional[str]:
     if func.attr == "page_out":
         receiver = expr_text(func.value).split(".")[-1].lower()
         return func.attr if "pager" in receiver else None
+    if func.attr == "on_cancel":
+        return func.attr
     if func.attr == "acquire" or func.attr.startswith("begin_"):
         if is_lockish(func.value):
             return None  # mutexes are lock-discipline's domain
@@ -223,6 +231,8 @@ def _resource_noun(attr: str) -> str:
         return "HBM lease"
     if attr == "page_out":
         return "paged-out weight state"
+    if attr == "on_cancel":
+        return "cancel-callback handle"
     return "drain state"
 
 
