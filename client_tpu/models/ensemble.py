@@ -6,6 +6,7 @@ ensembles."""
 
 from __future__ import annotations
 
+import json
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -95,12 +96,12 @@ class DataflowContext:
 
     __slots__ = ("trace", "telemetry", "stats_recorder", "batcher_for",
                  "target_for", "cache_lookup", "cache_insert",
-                 "queue_from_ns", "cancel")
+                 "queue_from_ns", "cancel", "arena")
 
     def __init__(self, trace=None, telemetry=None, stats_recorder=None,
                  batcher_for=None, target_for=None, cache_lookup=None,
                  cache_insert=None, queue_from_ns: int = 0,
-                 cancel=None):
+                 cancel=None, arena=None):
         self.trace = trace
         self.telemetry = telemetry
         self.stats_recorder = stats_recorder
@@ -117,6 +118,11 @@ class DataflowContext:
         # subgraph, and its remaining deadline budget replaces the
         # original `timeout` in each stage's queue policy.
         self.cancel = cancel
+        # The core's TpuArena (or None): interior hand-off tensors
+        # land in arena regions for the request's duration, making
+        # every stage boundary a pull-addressable edge (the region
+        # books its own HBM row, replacing the interior lease).
+        self.arena = arena
 
 
 class EnsembleModel(ServedModel):
@@ -147,6 +153,9 @@ class EnsembleModel(ServedModel):
         self.inputs = inputs
         self.outputs = outputs
         self.max_batch_size = max_batch_size
+        # How many interior hand-offs landed in arena regions (vs the
+        # lease fallback) — observability for the zero-copy edge.
+        self.interior_arena_regions = 0
         # Set by the server core so composing-step executions show up
         # in per-model statistics (Triton records composing models'
         # queue/compute like top-level requests): callable
@@ -284,12 +293,18 @@ class EnsembleModel(ServedModel):
                 mark = now
                 break
         queue_ns_total = 0
-        # Interior hand-offs live on device between stages; the HBM
-        # allocator tracks their bytes under an `ensemble_interior`
-        # ledger row for the request's duration (best-effort: the
-        # accounting never sheds or blocks a stage).
+        # Interior hand-offs live on device between stages. Preferred
+        # landing: a TPU arena region per stage boundary (the region's
+        # own `arena/regions` HBM row covers the bytes, and the stage
+        # edge becomes pull-addressable — a downstream consumer on
+        # another host could redeem the segments over the DCN pull
+        # path with no host round-trip on this side). Fallback when
+        # the arena is absent or landing fails: the PR-16 best-effort
+        # `ensemble_interior` lease. Both are accounting/addressing —
+        # never a serving dependency.
         allocator = self._interior_allocator()
         interior_leases = []
+        interior_regions = []
         try:
             for k in range(start_index, len(steps)):
                 step_params = params
@@ -348,19 +363,77 @@ class EnsembleModel(ServedModel):
                     ctx.cache_insert(k, model, step_outputs)
                 for ens_name, step_name in output_map.items():
                     tensors[ens_name] = step_outputs[step_name]
-                if allocator is not None and k < len(steps) - 1:
+                if k < len(steps) - 1 and (ctx.arena is not None
+                                           or allocator is not None):
                     nbytes = self._device_hand_off_bytes(step_outputs)
                     if nbytes > 0:
-                        interior_leases.append(allocator.lease(
-                            self.name, "ensemble_interior", nbytes,
-                            best_effort=True))
+                        region_id = (
+                            self._land_interior(ctx.arena, step_outputs,
+                                                nbytes)
+                            if ctx.arena is not None else None)
+                        if region_id is not None:
+                            interior_regions.append(region_id)
+                            self.interior_arena_regions += 1
+                        elif allocator is not None:
+                            interior_leases.append(allocator.lease(
+                                self.name, "ensemble_interior", nbytes,
+                                best_effort=True))
                 mark = end
             return ({spec.name: tensors[spec.name]
                      for spec in self.outputs}, queue_ns_total)
         finally:
+            if ctx.arena is not None:
+                for region_id in interior_regions:
+                    try:
+                        ctx.arena.destroy_region(region_id)
+                    except Exception:  # noqa: BLE001 — teardown must
+                        pass  # never mask the stage result
             if allocator is not None:
                 for interior in interior_leases:
                     allocator.release(interior)
+
+    @staticmethod
+    def _land_interior(arena, step_outputs, nbytes: int):
+        """Land a stage's device-resident outputs in one arena region:
+        segments are adopted at packed offsets with their wire dtype,
+        so the whole hand-off is addressable through the arena's pull
+        path. Returns the region_id, or None on any failure (the
+        caller falls back to the plain interior lease) — the landed
+        arrays are the SAME device buffers the next stage consumes,
+        adoption adds addressing, not a copy."""
+        try:
+            handle = arena.create_region(nbytes)
+            region_id = json.loads(handle)["region_id"]
+        except Exception:  # noqa: BLE001 — arena full / no devices
+            return None
+        try:
+            from client_tpu.server import fetch
+            from client_tpu.utils import np_to_wire_dtype
+
+            offset = 0
+            for name in sorted(step_outputs):
+                value = step_outputs[name]
+                if not (fetch.is_device_value(value)
+                        and not fetch.host_committed(value)):
+                    continue
+                seg_bytes = int(getattr(value, "nbytes", 0))
+                if seg_bytes <= 0:
+                    continue
+                try:
+                    datatype = np_to_wire_dtype(np.dtype(value.dtype))
+                except Exception:  # noqa: BLE001 — exotic dtype
+                    datatype = None
+                arena.adopt_segment(
+                    region_id, offset, seg_bytes, datatype,
+                    list(getattr(value, "shape", ()) or ()), value)
+                offset += seg_bytes
+            return region_id
+        except Exception:  # noqa: BLE001 — partial landing: drop the
+            try:  # region so its HBM row never outlives the request
+                arena.destroy_region(region_id)
+            except Exception:  # noqa: BLE001
+                pass
+            return None
 
     @staticmethod
     def _interior_allocator():

@@ -370,10 +370,31 @@ def decode_step(params, token, pos, cache, cfg: LlmConfig):
 # shared (copy-on-write) pages are write-protected.
 
 
+def page_pool_axis(mesh):
+    """The mesh axis the PAGE dimension shards over: ``tp`` when
+    present (the slice's tensor axis — pages then live alongside the
+    head shards that read them), else the largest nontrivial axis;
+    None for a trivial/absent mesh (unsharded pool)."""
+    if mesh is None:
+        return None
+    sizes = dict(mesh.shape)
+    if sizes.get("tp", 1) > 1:
+        return "tp"
+    axis = max(sizes, key=lambda a: sizes[a]) if sizes else None
+    return axis if axis is not None and sizes[axis] > 1 else None
+
+
+def page_axis_shards(mesh) -> int:
+    """How many ways the page axis splits over ``mesh`` (1 = dense
+    single-device pool). num_pages must be a multiple of this."""
+    axis = page_pool_axis(mesh)
+    return int(mesh.shape[axis]) if axis is not None else 1
+
+
 def init_page_pool(cfg: LlmConfig, num_pages: int, page_size: int,
-                   dtype=None):
+                   dtype=None, mesh=None):
     dtype = dtype or jnp.dtype(cfg.dtype)
-    return [
+    pools = [
         (
             jnp.zeros((num_pages, page_size, cfg.n_kv_heads,
                        cfg.head_dim), dtype=dtype),
@@ -382,6 +403,19 @@ def init_page_pool(cfg: LlmConfig, num_pages: int, page_size: int,
         )
         for _ in range(cfg.n_layers)
     ]
+    axis = page_pool_axis(mesh)
+    if axis is not None:
+        # Page-axis sharding (PR 20): each slice member holds a
+        # num_pages/shards sub-pool — per-device sub-pools under the
+        # ONE host-side reservation invariant (_PagePool still
+        # accounts the full pool; GSPMD routes each page's reads and
+        # writes to the member that owns it).
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        sharding = NamedSharding(mesh, PartitionSpec(axis))
+        pools = [(jax.device_put(k, sharding), jax.device_put(v, sharding))
+                 for k, v in pools]
+    return pools
 
 
 def page_pool_nbytes(cfg: LlmConfig, num_pages: int, page_size: int,
@@ -896,14 +930,21 @@ class LlmModel(ServedModel):
         self._inflight = 0  # dispatched-not-yet-delivered decode chunks
 
         # -- paged KV cache (the default serving arm; paged_kv=False
-        # keeps the dense per-lane cache as the A/B baseline). Mesh-
-        # sharded deployments default to the dense arm: the pool is an
-        # unsharded device-resident carry.
-        self._paged = bool(mesh is None if paged_kv is None else paged_kv)
+        # keeps the dense per-lane cache as the A/B baseline). PR 20
+        # retired the mesh-sharded dense fallback: sharded deployments
+        # serve paged too, with the pool's page axis sharded across
+        # the slice (see init_page_pool).
+        self._paged = bool(True if paged_kv is None else paged_kv)
         self._page_size = max(1, int(page_size))
         self._pages_per_seq = -(-self.cfg.max_seq // self._page_size)
         self._num_pages = (int(kv_pages) if kv_pages
                            else self._lanes * self._pages_per_seq)
+        # Page-axis sharding wants an even split: round the pool UP to
+        # a multiple of the shard count (extra pages are capacity, not
+        # waste — the reservation invariant covers them too).
+        kv_shards = page_axis_shards(mesh)
+        if kv_shards > 1:
+            self._num_pages = -(-self._num_pages // kv_shards) * kv_shards
         self._prefill_chunk = max(self._page_size,
                                   min(int(prefill_chunk),
                                       self.cfg.max_seq))
@@ -916,11 +957,13 @@ class LlmModel(ServedModel):
         # while _pool_dev is live, released on crash rebuild / unload
         # so cross-model HBM accounting never shows a dead pool.
         self._kv_ledger_row = None
-        # HBM-allocator lease for the slab (docs/hbm.md): carved
-        # through budgeted admission in _ensure_page_pool — the lease
-        # registers the kv_pages ledger row itself, so only one of
-        # lease/_kv_ledger_row is ever live.
-        self._kv_lease = None
+        # HBM-allocator leases for the slab (docs/hbm.md): carved
+        # through budgeted admission in _ensure_page_pool — each lease
+        # registers its own ledger row, so only leases/_kv_ledger_row
+        # are ever live, never both. Unsharded = one lease
+        # ("kv_pages"); mesh-sharded = one per member device
+        # ("kv_pages:<device>"), each booked on ITS device's budget.
+        self._kv_leases: list = []
         # Serializes slab admission OUTSIDE _sched_cv: allocator
         # admission may evict cold weights (device<->host transfers
         # that must never run under the scheduler's condition
@@ -1793,13 +1836,27 @@ class LlmModel(ServedModel):
         except Exception:  # noqa: BLE001
             return None
 
+    def _kv_device_keys(self) -> list:
+        """The allocator device keys the KV slab books against: [None]
+        (= first device) unsharded; one key per slice member when the
+        model is mesh-sharded, so each device's budget carries exactly
+        its sub-pool."""
+        if self._mesh is None:
+            return [None]
+        try:
+            return ["%s-%d" % (d.platform.upper(), d.id)
+                    for d in self._mesh.devices.flat]
+        except Exception:  # noqa: BLE001 — exotic mesh stand-ins
+            return [None]
+
     def _release_kv_lease(self) -> None:
         """Returns the slab's bytes to the allocator (and any legacy
         direct ledger row). Lock-only — safe under _sched_cv."""
         allocator = self._hbm_allocator()
+        leases, self._kv_leases = self._kv_leases, []
         if allocator is not None:
-            allocator.release(self._kv_lease)
-        self._kv_lease = None
+            for lease in leases:
+                allocator.release(lease)
         ledger = self._device_ledger()
         if ledger is not None:
             ledger.release(self._kv_ledger_row)
@@ -1821,24 +1878,36 @@ class LlmModel(ServedModel):
             if self._pool_dev is not None or self._sched_stop:
                 return
             allocator = self._hbm_allocator()
-            lease = None
-            if allocator is not None:
-                lease = allocator.lease(
-                    self.name, "kv_pages",
-                    page_pool_nbytes(self.cfg, self._num_pages,
-                                     self._page_size),
-                    reason="kv_pool")
+            leases: list = []
             committed = False
             try:
+                if allocator is not None:
+                    total = page_pool_nbytes(self.cfg, self._num_pages,
+                                             self._page_size)
+                    keys = self._kv_device_keys()
+                    # Mesh-sharded: one lease per slice member for its
+                    # sub-pool share, admitted under THAT device's
+                    # arbitration mutex — no device carries another's
+                    # pages in the budget.
+                    share = -(-total // len(keys))
+                    for device_key in keys:
+                        leases.append(allocator.lease(
+                            self.name,
+                            "kv_pages" if device_key is None
+                            else "kv_pages:%s" % device_key,
+                            share, device_key=device_key,
+                            reason="kv_pool"))
                 pool_dev = init_page_pool(self.cfg, self._num_pages,
-                                          self._page_size)
+                                          self._page_size,
+                                          mesh=self._mesh)
                 with self._sched_cv:
                     self._pool_dev = pool_dev
-                    self._kv_lease = lease
+                    self._kv_leases = leases
                 committed = True
             finally:
                 if not committed and allocator is not None:
-                    allocator.release(lease)
+                    for lease in leases:
+                        allocator.release(lease)
         finally:
             self._pool_admission.release()
 
@@ -1968,17 +2037,25 @@ class LlmModel(ServedModel):
                 if self._pool_dev is None:
                     # Crash-rebuild fallback: a scheduler reset
                     # cleared the slab after _ensure_page_pool ran.
-                    # Best-effort lease only — no eviction (and no
+                    # Best-effort leases only — no eviction (and no
                     # device<->host transfers) under the cv.
                     self._pool_dev = init_page_pool(
-                        self.cfg, self._num_pages, self._page_size)
+                        self.cfg, self._num_pages, self._page_size,
+                        mesh=self._mesh)
                     allocator = self._hbm_allocator()
                     if allocator is not None:
-                        self._kv_lease = allocator.lease(
-                            self.name, "kv_pages",
-                            sum(int(k.nbytes) + int(v.nbytes)
-                                for k, v in self._pool_dev),
-                            best_effort=True)
+                        total = sum(int(k.nbytes) + int(v.nbytes)
+                                    for k, v in self._pool_dev)
+                        keys = self._kv_device_keys()
+                        share = -(-total // len(keys))
+                        self._kv_leases = [
+                            allocator.lease(
+                                self.name,
+                                "kv_pages" if key is None
+                                else "kv_pages:%s" % key,
+                                share, device_key=key,
+                                best_effort=True)
+                            for key in keys]
                 if self._done_dev is None:
                     self._done_dev = jnp.zeros((self._lanes,),
                                                dtype=bool)

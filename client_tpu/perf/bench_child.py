@@ -940,6 +940,169 @@ def run_replica_measure(core, model_name: str = "replica_bench",
     return result
 
 
+def run_mesh_measure(core, model_name: str = "mesh_bench",
+                     exec_delay_s: float = 0.004,
+                     threads: int = 8,
+                     measure_s: float = 1.5) -> dict:
+    """Mesh-slice serving measurement (docs/sharded_serving.md):
+    slice-replica scaling plus the kill-one-chip blast-radius
+    timeline.
+
+    Phase 1 — scaling: a delay-bound model declaring a ``shard_mesh``
+    served as 1 slice vs 2 slices (each slice ``tp=width`` devices)
+    under an identical closed loop. Each slice runs its own device
+    queue, so 2 slices sustain ~2x the fused-call rate of 1.
+
+    Phase 2 — kill one chip: chaos ``device=<member of slice 0>``
+    fails every execution that touches the chip. The router masks the
+    failures (bounded re-dispatch to the sibling slice — goodput stays
+    100%), the breaker ejects the WHOLE slice, the chip heals, and the
+    supervisor re-initializes + canaries the slice back in.
+    """
+    import threading as _threading
+
+    import jax
+    import numpy as np
+
+    from client_tpu._infer_common import InferInput
+    from client_tpu.grpc._utils import get_inference_request
+    from client_tpu.models.add_sub import AddSub
+    from client_tpu.server import chaos as chaos_mod
+    from client_tpu.utils import InferenceServerException
+
+    ndev = len(jax.devices())
+    width = 4 if ndev >= 8 else 2
+    if ndev < 2 * width:
+        raise RuntimeError(
+            "mesh measure needs %d devices (2 slices x tp=%d), have %d"
+            % (2 * width, width, ndev))
+
+    def slice_factory(name: str, count: int):
+        class _SlowSlice(AddSub):
+            # Direct path, sharded instance group: every request is
+            # one fused sharded call on a slice's device queue. The
+            # fixed delay stands in for the sharded XLA program, so
+            # the scaling ratio reads slice parallelism.
+            instance_group_count = count
+            shard_mesh = {"tp": width}
+
+            def __init__(self, mesh=None):
+                super().__init__(name=name, datatype="INT32",
+                                 shape=(16,))
+                self.mesh = mesh
+                self.replica_watchdog_us = 2_000_000
+                self.replica_failure_threshold = 3
+                self.replica_recovery_s = 0.3
+
+            def infer(self, inputs, parameters=None):
+                time.sleep(exec_delay_s)
+                return super().infer(inputs, parameters)
+
+        return _SlowSlice
+
+    def request(name: str, seed: int):
+        a = np.full((16,), seed % 997, dtype=np.int32)
+        b = np.arange(16, dtype=np.int32)
+        t0 = InferInput("INPUT0", [16], "INT32")
+        t0.set_data_from_numpy(a)
+        t1 = InferInput("INPUT1", [16], "INT32")
+        t1.set_data_from_numpy(b)
+        return get_inference_request(model_name=name, inputs=[t0, t1],
+                                     outputs=None)
+
+    def closed_loop(name: str, duration_s: float) -> dict:
+        latencies: list = []
+        errors = [0]
+        merge = _threading.Lock()
+
+        def worker(index: int):
+            local, failed = [], 0
+            deadline = time.monotonic() + duration_s
+            seed = index * 100_000
+            while time.monotonic() < deadline:
+                req = request(name, seed)
+                seed += 1
+                t_start = time.monotonic_ns()
+                try:
+                    core.infer(req)
+                    local.append(time.monotonic_ns() - t_start)
+                except InferenceServerException:
+                    failed += 1
+            with merge:
+                latencies.extend(local)
+                errors[0] += failed
+
+        pool = [_threading.Thread(target=worker, args=(i,))
+                for i in range(threads)]
+        for thread in pool:
+            thread.start()
+        for thread in pool:
+            thread.join()
+        completed = len(latencies)
+        total = completed + errors[0]
+        return {
+            "tput": completed / duration_s if duration_s else 0.0,
+            "p50_us": round(float(np.percentile(
+                np.array(latencies, dtype=float) / 1000.0, 50)), 1)
+            if latencies else 0.0,
+            "completed": completed,
+            "errors": errors[0],
+            "goodput_pct": round(completed / total * 100.0, 2)
+            if total else 0.0,
+        }
+
+    # -- phase 1: slice scaling, 1 vs 2 slices ----------------------------
+    name1, name2 = model_name + "1", model_name + "2"
+    core.repository.add_factory(name1, slice_factory(name1, 1))
+    core.repository.add_factory(name2, slice_factory(name2, 2))
+    core.repository.load(name1)
+    core.repository.load(name2)
+    closed_loop(name1, 0.3)  # warmup, discarded
+    single = closed_loop(name1, measure_s)
+    closed_loop(name2, 0.3)  # warmup: instantiates the slice set
+    double = closed_loop(name2, measure_s)
+
+    # -- phase 2: kill one chip of slice 0 mid-load, then heal ------------
+    before = replica_stats(core, name2) or {}
+    # Slice 0 owns devices [0, width): failing chip 0 must eject the
+    # whole slice while the sibling slice masks every request.
+    chaos_mod.configure(chaos_mod.ChaosConfig(error_rate=1.0, device=0))
+    try:
+        degraded = closed_loop(name2, measure_s)
+        mid = replica_stats(core, name2) or {}
+    finally:
+        chaos_mod.configure(None)  # chip healed
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        snap = replica_stats(core, name2)
+        if snap and snap["readmitted"] > before.get("readmitted", 0):
+            break
+        time.sleep(0.1)
+    after = replica_stats(core, name2) or {}
+
+    result = {
+        "exec_delay_ms": exec_delay_s * 1000.0,
+        "concurrency": threads,
+        "slice_width": width,
+        "tput_1slice": round(single["tput"], 2),
+        "p50_1slice_us": single["p50_us"],
+        "tput_2slice": round(double["tput"], 2),
+        "p50_2slice_us": double["p50_us"],
+        "degraded_tput": round(degraded["tput"], 2),
+        "degrade_goodput_pct": degraded["goodput_pct"],
+        "degrade_errors": degraded["errors"],
+        "healthy_during_degrade": mid.get("healthy"),
+        "ejections": (after.get("ejected", 0)
+                      - before.get("ejected", 0)),
+        "readmissions": (after.get("readmitted", 0)
+                         - before.get("readmitted", 0)),
+    }
+    if single["tput"]:
+        result["scaling_2v1"] = round(
+            double["tput"] / single["tput"], 2)
+    return result
+
+
 def run_autoscale_measure(core, model_name: str = "autoscale_bench",
                           exec_delay_s: float = 0.02,
                           low_rate: float = 20.0,
@@ -2778,6 +2941,31 @@ def main() -> None:
                     % extra.get("recovery_vs_prefault", 0.0))
         except Exception as exc:  # noqa: BLE001
             log("replica_scaling failed: %s" % exc)
+
+    # Mesh-slice serving (docs/sharded_serving.md): 1 vs 2 tp-sharded
+    # slices of a delay-bound model under one closed loop, plus the
+    # kill-one-chip timeline (chaos device=0 fails every execution
+    # touching the chip: goodput holds 100% via re-dispatch to the
+    # sibling slice, the WHOLE slice ejects, and the supervisor
+    # readmits it after the chip heals). Acceptance: scaling_2v1 >=
+    # 1.8x, degrade goodput 100%, >=1 ejection and readmission.
+    if remaining() > 60 and stage_wanted("mesh_sharded"):
+        try:
+            extra = run_mesh_measure(core)
+            record_stage("mesh_sharded", extra.get("tput_2slice", 0.0),
+                         extra.get("p50_2slice_us", 0.0), extra)
+            if extra.get("scaling_2v1", 0.0) < 1.8:
+                log("mesh_sharded: %.2fx at 2 slices is under the "
+                    "1.8x gate" % extra.get("scaling_2v1", 0.0))
+            if extra.get("degrade_goodput_pct", 0.0) < 100.0:
+                log("mesh_sharded: kill-one-chip goodput %.2f%% "
+                    "below 100%%"
+                    % extra.get("degrade_goodput_pct", 0.0))
+            if extra.get("readmissions", 0) < 1:
+                log("mesh_sharded: the killed slice was never "
+                    "readmitted")
+        except Exception as exc:  # noqa: BLE001
+            log("mesh_sharded failed: %s" % exc)
 
     # Config 3d: span-tracing overhead — the identical closed loop on
     # add_sub_large (4 MiB tensors, the ms-scale request shape tracing

@@ -69,6 +69,12 @@ class ParsedModel:
         # across the model's instance groups (0 = single fault
         # domain), so reports can annotate per-replica expectations.
         self.instance_group_count = 0
+        # Mesh-slice serving (instance_group.shard_mesh): the shard
+        # axes each replica is sharded over ([] = one-device replicas)
+        # and the devices per slice (axis-size product, 1 = unsharded)
+        # — so reports can annotate per-slice device budgets.
+        self.shard_mesh_axes: List = []
+        self.slice_width = 1
 
 
 class ModelParser:
@@ -139,6 +145,18 @@ class ModelParser:
         model.instance_group_count = sum(
             int(group.get("count", 0) or 0)
             for group in config.get("instance_group", []) or [])
+        for group in config.get("instance_group", []) or []:
+            shard_mesh = group.get("shard_mesh", {}) or {}
+            names = shard_mesh.get("axis_names", []) or []
+            sizes = shard_mesh.get("axis_sizes", []) or []
+            axes = [(str(axis), int(size))
+                    for axis, size in zip(names, sizes) if int(size) > 1]
+            if axes:
+                model.shard_mesh_axes = axes
+                model.slice_width = 1
+                for _axis, size in axes:
+                    model.slice_width *= size
+                break  # one shard spec per model, first group wins
 
         # Composing models: ensemble steps (recursively — an ensemble
         # step may itself be an ensemble) plus any BLS children named

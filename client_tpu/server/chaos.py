@@ -31,7 +31,12 @@ An optional ``replica=model:index`` entry retargets the config at
 exactly ONE replica of an instance-group model: the faults then fire
 only at the replica layer's inject (which passes ``replica_id``) and
 never at the request-level inject — degrading one fault domain while
-its siblings and the front-of-house path stay clean.
+its siblings and the front-of-house path stay clean. An optional
+``device=<id>`` entry targets one DEVICE instead: the faults fire at
+any replica execution whose device set contains that chip — for a
+mesh-sharded model this is exactly one chip of one slice, the
+kill-one-chip experiment that must eject the whole slice while its
+sibling slices keep serving.
 
 Everything is deterministic under ``seed`` so a chaos run is
 reproducible — the property that turns "it degrades gracefully" into a
@@ -68,7 +73,8 @@ class ChaosConfig:
                  abandon_after_ms: float = 0.0,
                  seed: Optional[int] = None,
                  models: Optional[set] = None,
-                 replica: Optional[str] = None):
+                 replica: Optional[str] = None,
+                 device: Optional[int] = None):
         self.latency_ms = max(float(latency_ms), 0.0)
         self.error_rate = min(max(float(error_rate), 0.0), 1.0)
         self.drop_rate = min(max(float(drop_rate), 0.0), 1.0)
@@ -80,6 +86,9 @@ class ChaosConfig:
         # "model:index" retargets this config at one replica's
         # execution path (see module docstring); None = request level.
         self.replica = str(replica) if replica else None
+        # Device id retargets at any execution whose device set holds
+        # this chip — one chip of a mesh slice; None = no device gate.
+        self.device = int(device) if device is not None else None
 
     @property
     def enabled(self) -> bool:
@@ -115,6 +124,8 @@ class ChaosConfig:
                         "chaos replica target '%s' is not model:index"
                         % value)
                 kwargs["replica"] = value
+            elif key == "device":
+                kwargs["device"] = int(value)
             else:
                 raise ValueError("unknown chaos spec key '%s'" % key)
         return cls(**kwargs)
@@ -134,6 +145,8 @@ class ChaosConfig:
         described = ", ".join(parts) if parts else "disabled"
         if self.replica and parts:
             described += " @ replica %s" % self.replica
+        if self.device is not None and parts:
+            described += " @ device %d" % self.device
         return described
 
 
@@ -197,15 +210,16 @@ def configure_scope(scope: str, config: Optional[ChaosConfig]) -> None:
 
 def configure_replica(config: Optional[ChaosConfig]) -> None:
     """Install (or, with None, clear) the replica-targeted chaos slot
-    (``config.replica`` must name a ``model:index``). Independent of
-    the global config and the scoped configs — a replica-mode
-    DegradeOneScenario stages faults here so it compounds with an
-    operator's baseline ``--chaos`` instead of replacing it. Counters
-    are shared and NOT reset (scenarios flip stages mid-run)."""
+    (``config.replica`` must name a ``model:index``, or
+    ``config.device`` a chip id). Independent of the global config and
+    the scoped configs — a replica-mode DegradeOneScenario stages
+    faults here so it compounds with an operator's baseline ``--chaos``
+    instead of replacing it. Counters are shared and NOT reset
+    (scenarios flip stages mid-run)."""
     with _state.lock:
         _state.replica_config = (
             config if config is not None and config.enabled
-            and config.replica else None)
+            and (config.replica or config.device is not None) else None)
         _state._env_checked = True
 
 
@@ -241,19 +255,24 @@ def stats() -> dict:
 
 
 def inject(model_name: str = "", scope: Optional[str] = None,
-           replica_id: Optional[str] = None, cancel=None) -> None:
+           replica_id: Optional[str] = None, cancel=None,
+           device_ids=None) -> None:
     """Request-path hook: sleep/raise per the active config(s). No-op
     (one lock-free attribute read) when chaos is off. ``scope`` names
     the calling core; a matching scoped config applies on top of the
     global one (fault kinds compound: delays add, the first raising
     kind wins). ``replica_id`` ("model:index") names the replica whose
-    device queue is executing: replica-targeted configs fire only
-    here, and only for their replica; untargeted configs fire only at
-    the request-level inject (``replica_id=None``) — one fault, one
-    layer, never both. ``cancel`` is the request's CancelToken when
-    the caller has one: abandon_rate faults fire by cancelling it
-    after abandon_after_ms (a timer thread — the walked-away client),
-    and are inert when cancellation is off (no token, no fault)."""
+    device queue is executing and ``device_ids`` the chip set that
+    execution occupies (one id per-device, every slice member when the
+    replica is a mesh slice): replica- and device-targeted configs
+    fire only here — a device config for any chip in ``device_ids``,
+    so one sick chip fails its whole slice; untargeted configs fire
+    only at the request-level inject (``replica_id=None``) — one
+    fault, one layer, never both. ``cancel`` is the request's
+    CancelToken when the caller has one: abandon_rate faults fire by
+    cancelling it after abandon_after_ms (a timer thread — the
+    walked-away client), and are inert when cancellation is off (no
+    token, no fault)."""
     if not _state._env_checked:
         _load_env_config()
     configs = []
@@ -277,11 +296,17 @@ def inject(model_name: str = "", scope: Optional[str] = None,
             if config.models is not None \
                     and model_name not in config.models:
                 continue
-            if (config.replica is None) != (replica_id is None):
+            targeted = config.replica is not None \
+                or config.device is not None
+            if targeted != (replica_id is not None):
                 continue  # wrong layer for this config
             if config.replica is not None \
                     and config.replica != replica_id:
                 continue  # targeted at a sibling replica
+            if config.device is not None and (
+                    device_ids is None
+                    or config.device not in device_ids):
+                continue  # targeted at a chip this execution skips
             if config is not _state.config \
                     and config is not _state.replica_config \
                     and config is not _state.scoped.get(scope):

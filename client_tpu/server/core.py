@@ -1029,7 +1029,7 @@ class InferenceServerCore:
 
         healthy_rows, replica_total_rows = [], []
         ejected_rows, readmitted_rows, redispatch_rows = [], [], []
-        exec_rows = []
+        exec_rows, slice_rows = [], []
         with self._replica_lock:
             replica_snapshot = dict(self._replica_sets)
         for name, replica_set in sorted(replica_snapshot.items()):
@@ -1052,6 +1052,11 @@ class InferenceServerCore:
                 exec_rows.append(
                     'tpu_replica_exec_us{model="%s",replica="%d"} %d'
                     % (name, row["index"], row["exec_ns"] // 1000))
+                if snap.get("sharded"):
+                    slice_rows.append(
+                        'tpu_slice_healthy{model="%s",slice="%d"} %d'
+                        % (name, row["index"],
+                           1 if row["healthy"] else 0))
         family("tpu_replica_healthy", "gauge",
                "Healthy replicas (fault domains) currently in routing "
                "per instance-group model", healthy_rows)
@@ -1071,6 +1076,11 @@ class InferenceServerCore:
         family("tpu_replica_exec_us", "counter",
                "Cumulative successful execution time per replica",
                exec_rows)
+        family("tpu_slice_healthy", "gauge",
+               "Per-slice health for mesh-sharded instance groups "
+               "(1 = the slice's whole device set is in routing; one "
+               "sick chip zeroes its slice, siblings stay 1)",
+               slice_rows)
 
         desired_rows, scale_event_rows, replica_second_rows = [], [], []
         for name, entry in sorted(self.autoscaler.snapshot().items()):
@@ -1896,6 +1906,7 @@ class InferenceServerCore:
             cache_insert=cache_insert,
             queue_from_ns=queue_from_ns,
             cancel=cancel,
+            arena=getattr(self.memory, "arena", None),
         )
         return model.infer_dataflow(inputs, params, ctx)
 

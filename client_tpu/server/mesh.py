@@ -1,0 +1,302 @@
+"""Sharded serving across the mesh: tensor-parallel slices as a
+first-class replica axis (ROADMAP item 3, docs/sharded_serving.md).
+
+A model that only exists sharded (weights partitioned over a
+``shard_mesh``, e.g. ``{"tp": 4}``) is served by the PR-8 ReplicaSet
+exactly like a per-device model — except each replica is a **mesh
+slice**: a disjoint set of ``slice_width`` devices carrying one
+sharded executable plus that slice's shard of the weights. The
+``instance_group`` count stays the replica axis (2 replicas x tp=4 =
+8 devices); this module owns everything slice-shaped so the router
+keeps its device-agnostic health/routing math:
+
+* **Planning.** :func:`plan_slice` deterministically partitions the
+  local device list into contiguous ``slice_width`` blocks (replica
+  index -> device block, wrapping when indexes outlive the device
+  count — index reuse after scale churn must not strand hardware).
+* **Construction.** :func:`build_instance` calls the model factory
+  with the slice's ``jax.Mesh`` when the factory accepts a ``mesh``
+  keyword — the contract a sharded model opts into; factories without
+  the keyword degrade to unsharded instances (served, but warned).
+* **Admission.** :func:`admit_slice` books the slice's weights with
+  the PR-18 HBM allocator as **per-participating-device rows**
+  (``slice:<index>:<device>`` components, real per-device shard bytes
+  from ``addressable_shards`` when available): admission runs under
+  each member device's arbitration mutex, so a slice-unit scale-up
+  contends with every other allocation on every member chip — and
+  ``tpu_hbm_model_bytes`` / ``/v2/debug`` stay truthful under tp>1
+  instead of attributing the whole slab to device 0.
+
+Fault domains widen with the slice: the ReplicaSet attributes
+watchdog/breaker evidence to every member device, chaos ``device=<id>``
+targeting fails a slice through any one chip, and autoscale
+scale_up/scale_down operates in slice units (one resize = one whole
+slice's devices + leases + ledger rows).
+"""
+
+from __future__ import annotations
+
+import inspect
+import logging
+import threading
+from typing import Callable, List, Optional, Sequence, Tuple
+
+_LOG = logging.getLogger("client_tpu.server.mesh")
+
+# Axis-name order for rendering/parsing sanity; anything the parallel
+# helpers accept is allowed — these are just the conventional names.
+KNOWN_AXES = ("dp", "fsdp", "tp", "sp", "ep", "pp")
+
+
+def parse_shard_mesh(spec) -> List[Tuple[str, int]]:
+    """Normalizes a shard-mesh spec to an ordered axis list.
+
+    Accepts a dict (``{"tp": 4}``), an iterable of ``(axis, size)``
+    pairs, or a spec string (``"tp=4"`` / ``"sp=2,tp=2"``). Axes with
+    size <= 1 are dropped (they shard nothing). Returns ``[]`` for an
+    empty/None spec."""
+    if not spec:
+        return []
+    if isinstance(spec, str):
+        pairs = []
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            axis, sep, size = part.partition("=")
+            if not sep:
+                raise ValueError(
+                    "shard_mesh entry '%s' is not axis=size" % part)
+            pairs.append((axis.strip(), int(size)))
+    elif isinstance(spec, dict):
+        pairs = [(str(axis), int(size)) for axis, size in spec.items()]
+    else:
+        pairs = [(str(axis), int(size)) for axis, size in spec]
+    return [(axis, size) for axis, size in pairs if size > 1]
+
+
+def shard_axes(model) -> List[Tuple[str, int]]:
+    """The model's declared shard-mesh axes (``[]`` = unsharded)."""
+    return parse_shard_mesh(getattr(model, "shard_mesh", None))
+
+
+def wants_mesh(model) -> bool:
+    """A model opts into slice serving by declaring a ``shard_mesh``
+    whose axis product exceeds one device."""
+    return bool(shard_axes(model))
+
+
+def slice_width(model) -> int:
+    """Devices per slice: the product of the shard-mesh axis sizes."""
+    width = 1
+    for _axis, size in shard_axes(model):
+        width *= size
+    return width
+
+
+def _local_devices():
+    import jax
+
+    return jax.devices()
+
+
+class MeshSlice:
+    """One replica-sized fault domain: ``slice_width`` devices plus
+    the ``jax.Mesh`` the slice's executable is pjit-ed over."""
+
+    __slots__ = ("slice_id", "axes", "devices", "device_ids",
+                 "device_keys", "mesh")
+
+    def __init__(self, slice_id: int, axes: Sequence[Tuple[str, int]],
+                 devices):
+        from client_tpu.parallel import create_mesh
+
+        self.slice_id = int(slice_id)
+        self.axes = list(axes)
+        self.devices = list(devices)
+        self.device_ids = tuple(int(d.id) for d in self.devices)
+        self.device_keys = tuple("%s-%d" % (d.platform.upper(), d.id)
+                                 for d in self.devices)
+        self.mesh = create_mesh(self.axes, devices=self.devices)
+
+    def describe(self) -> str:
+        return "slice %d [%s] over devices %s" % (
+            self.slice_id,
+            ",".join("%s=%d" % (a, s) for a, s in self.axes),
+            list(self.device_ids))
+
+
+def plan_slice(axes: Sequence[Tuple[str, int]], slice_id: int,
+               devices=None) -> MeshSlice:
+    """Deterministic replica-index -> device-block assignment:
+    contiguous ``width`` blocks of the local device list, wrapping
+    modulo the device count. Replica indexes are never reused across
+    resizes (ReplicaSet semantics), so a long-lived fleet's index 37
+    must still land on real hardware — the wrap keeps the mapping
+    total while preserving "disjoint blocks" whenever
+    ``count * width <= len(devices)``."""
+    devices = list(devices) if devices is not None else _local_devices()
+    width = 1
+    for _axis, size in axes:
+        width *= size
+    if width > len(devices):
+        raise ValueError(
+            "shard_mesh wants %d devices per slice but only %d are "
+            "visible" % (width, len(devices)))
+    start = (int(slice_id) * width) % len(devices)
+    block = [devices[(start + i) % len(devices)] for i in range(width)]
+    return MeshSlice(slice_id, axes, block)
+
+
+def build_instance(factory: Optional[Callable], mesh_slice: MeshSlice):
+    """Instantiates one slice's sharded executable: calls ``factory``
+    with ``mesh=<slice mesh>`` when its signature accepts it (the
+    sharded-model factory contract), else calls it bare and serves the
+    unsharded instance with a warning — a misdeclared model degrades
+    to PR-8 behavior instead of failing the fleet."""
+    if factory is None:
+        return None
+    if _accepts_mesh(factory):
+        return factory(mesh=mesh_slice.mesh)
+    _LOG.warning(
+        "model factory for %s does not accept a mesh= keyword; the "
+        "slice serves an UNSHARDED instance", mesh_slice.describe())
+    return factory()
+
+
+def _accepts_mesh(factory: Callable) -> bool:
+    try:
+        signature = inspect.signature(factory)
+    except (TypeError, ValueError):
+        return False
+    for param in signature.parameters.values():
+        if param.kind == inspect.Parameter.VAR_KEYWORD:
+            return True
+        if param.name == "mesh" and param.kind in (
+                inspect.Parameter.POSITIONAL_OR_KEYWORD,
+                inspect.Parameter.KEYWORD_ONLY):
+            return True
+    return False
+
+
+class SliceResources:
+    """The per-device HBM leases backing one slice's weights. Released
+    exactly once (idempotent, like the leases themselves) by the
+    ReplicaSet when the slice leaves routing — scale-down drain,
+    supervisor re-initialization, or set teardown."""
+
+    __slots__ = ("leases", "_lock")
+
+    def __init__(self):
+        self.leases: List = []
+        self._lock = threading.Lock()
+
+    def release(self) -> None:
+        with self._lock:
+            leases, self.leases = self.leases, []
+        if not leases:
+            return
+        try:
+            from client_tpu.server import hbm
+
+            allocator = hbm.get()
+        except Exception:  # noqa: BLE001 — accounting must never
+            return  # block teardown
+        for lease in leases:
+            allocator.release(lease)
+
+
+def per_device_bytes(instance, mesh_slice: MeshSlice) -> dict:
+    """device_key -> resident weight bytes for this slice's instance.
+
+    Sums real per-shard bytes from each ``jax.Array``'s addressable
+    shards when the arrays are sharded (the honest number under tp>1);
+    arrays without shard introspection fall back to an even split of
+    their total across the slice — per-device rows stay populated
+    either way."""
+    from client_tpu.server import devstats as devstats_mod
+
+    width = max(len(mesh_slice.device_keys), 1)
+    totals = {key: 0 for key in mesh_slice.device_keys}
+    attrs = getattr(instance, "__dict__", None) or {}
+    for value in attrs.values():
+        for leaf in _array_leaves(value):
+            if not _shard_into(leaf, totals):
+                share = -(-int(getattr(leaf, "nbytes", 0)) // width)
+                for key in totals:
+                    totals[key] += share
+    if not any(totals.values()):
+        # No introspectable arrays (a pure-python stub model): fall
+        # back to the aggregate estimate split evenly, so admission
+        # still exercises every member device's budget.
+        share = -(-devstats_mod.model_array_bytes(instance) // width)
+        totals = {key: share for key in mesh_slice.device_keys}
+    return totals
+
+
+def _array_leaves(value):
+    try:
+        import jax
+
+        leaves = jax.tree.leaves(value)
+    except Exception:  # noqa: BLE001 — not a pytree of arrays
+        return []
+    return [leaf for leaf in leaves
+            if hasattr(leaf, "nbytes") and hasattr(leaf, "dtype")]
+
+
+def _shard_into(leaf, totals: dict) -> bool:
+    """Adds ``leaf``'s per-device shard bytes into ``totals``; False
+    when the array exposes no shard placement (caller even-splits)."""
+    shards = getattr(leaf, "addressable_shards", None)
+    if not shards:
+        return False
+    landed = False
+    try:
+        for shard in shards:
+            device = shard.device
+            key = "%s-%d" % (device.platform.upper(), device.id)
+            if key in totals:
+                data = shard.data
+                totals[key] += int(getattr(data, "nbytes", 0))
+                landed = True
+    except Exception:  # noqa: BLE001 — introspection is best-effort
+        return landed
+    return landed
+
+
+def admit_slice(model_name: str, mesh_slice: MeshSlice,
+                instance, reason: str = "slice_admission"
+                ) -> SliceResources:
+    """Books the slice's weights with the HBM allocator as one lease
+    per participating device (``slice:<id>:<device>`` components —
+    each lease registers its own ledger row, so the device axis of
+    ``tpu_hbm_model_bytes`` stays truthful under tp>1). Budgeted
+    admission runs per device under that device's arbitration mutex —
+    the slice-unit scale-up contention point; a device that cannot fit
+    its share even after eviction raises the allocator's honest
+    retryable deferral, and every already-granted sibling lease rolls
+    back."""
+    from client_tpu.server import hbm
+
+    allocator = hbm.get()
+    plan = per_device_bytes(instance, mesh_slice)
+    resources = SliceResources()
+    granted: List = []
+    try:
+        for device_key, nbytes in sorted(plan.items()):
+            granted.append(allocator.lease(
+                str(model_name),
+                "slice:%d:%s" % (mesh_slice.slice_id, device_key),
+                nbytes, device_key=device_key, reason=reason))
+        resources.leases = [lease for lease in granted
+                            if lease is not None]
+    finally:
+        if not resources.leases:
+            # A member device refused its share mid-loop: roll the
+            # sibling grants back so a failed slice admission leaves
+            # zero phantom pressure on any device.
+            for lease in granted:
+                if lease is not None:
+                    allocator.release(lease)
+    return resources

@@ -142,6 +142,16 @@ class ServedModel:
     replica_watchdog_us: int = 0
     replica_failure_threshold: int = 0
     replica_recovery_s: float = 0.0
+    # Mesh-slice serving (client_tpu.server.mesh, rendered in the
+    # instance_group `shard_mesh` block): a shard-mesh spec — ordered
+    # axis sizes, e.g. {"tp": 4} or "sp=2,tp=2" — turns each replica
+    # into a tensor-parallel SLICE of slice_width (= axis product)
+    # devices: the factory is invoked with mesh=<slice mesh> to build
+    # one sharded executable per slice, weights are leased per member
+    # device, and the fault domain is the whole device set. Empty
+    # (default) keeps classic one-device replicas. Requires
+    # instance_group_count >= 1 (the replica axis composes on top).
+    shard_mesh: dict = {}
     # Autoscaling (client_tpu.server.autoscale, rendered in the
     # instance_group `autoscale` block): the per-model feedback
     # controller resizes the ReplicaSet between min/max replicas.
@@ -306,6 +316,14 @@ class ServedModel:
                 auto.up_cooldown_s = self.autoscale_up_cooldown_s
                 auto.down_cooldown_s = self.autoscale_down_cooldown_s
                 auto.idle_s = self.autoscale_idle_s
+            if self.shard_mesh:
+                from client_tpu.server import mesh as mesh_mod
+
+                sm = group.shard_mesh
+                for axis, size in mesh_mod.parse_shard_mesh(
+                        self.shard_mesh):
+                    sm.axis_names.append(axis)
+                    sm.axis_sizes.append(size)
         if self.dynamic_batching:
             config.dynamic_batching.preferred_batch_size.extend(
                 self.preferred_batch_sizes)

@@ -46,6 +46,18 @@ Replica-targeted chaos (``replica=model:index`` + the ``hang_ms``
 fault kind in :mod:`client_tpu.server.chaos`) injects faults into
 exactly one replica's execution path — the blast-radius scenario the
 CI replica smoke gates on.
+
+**Mesh slices** (PR 20, :mod:`client_tpu.server.mesh`): a model that
+declares a ``shard_mesh`` (e.g. tp=4) is served by replicas that are
+*slices* — each one a disjoint ``slice_width``-device block carrying a
+sharded executable built by the factory's ``mesh=`` contract, with
+per-device HBM leases/ledger rows booked at admission. Everything
+above stays word-for-word true with "device" read as "device set": the
+watchdog bounds the slice's fused sharded call, one sick chip (chaos
+``device=<id>``) fails executions that touch it and so ejects the
+whole slice, busy time and watchdog/breaker evidence are attributed to
+every member device, and scale_up/scale_down admit/drain whole slices
+against the HBM arbitration mutex on every member.
 """
 
 from __future__ import annotations
@@ -63,6 +75,7 @@ from client_tpu import status_map
 from client_tpu.robust import CLIENT_ERROR_STATUSES, CircuitBreaker
 from client_tpu.server import chaos
 from client_tpu.server import devstats as devstats_mod
+from client_tpu.server import mesh as mesh_mod
 from client_tpu.utils import InferenceServerException, triton_to_np_dtype
 
 _LOG = logging.getLogger("client_tpu.server.replicas")
@@ -106,9 +119,11 @@ class _Replica:
     __slots__ = ("index", "model", "executor", "breaker", "hung",
                  "outstanding", "ewma_latency_s", "requests", "failures",
                  "execution_count", "exec_ns", "ejected_count",
-                 "readmitted_count", "generation", "ledger_row")
+                 "readmitted_count", "generation", "ledger_row",
+                 "mesh_slice", "device_ids", "device_keys", "slice_res")
 
-    def __init__(self, index: int, model, breaker: CircuitBreaker):
+    def __init__(self, index: int, model, breaker: CircuitBreaker,
+                 mesh_slice=None):
         self.index = index
         self.model = model
         self.breaker = breaker
@@ -116,6 +131,16 @@ class _Replica:
         # when the replica shares the base instance — the load-time
         # weights row already covers that memory).
         self.ledger_row = None
+        # Mesh-slice serving (PR 20): the device block this replica IS
+        # (None = classic per-device replica). device_ids feed chaos
+        # device targeting; device_keys feed per-member busy/evidence
+        # attribution; slice_res holds the per-device HBM leases.
+        self.mesh_slice = mesh_slice
+        self.device_ids = tuple(mesh_slice.device_ids) \
+            if mesh_slice is not None else ()
+        self.device_keys = tuple(mesh_slice.device_keys) \
+            if mesh_slice is not None else ()
+        self.slice_res = None
         self.executor: Optional[ThreadPoolExecutor] = None
         # Watchdog verdict: the replica's device queue stopped
         # answering. Distinct from the breaker (which needs repeated
@@ -206,6 +231,31 @@ class ReplicaSet:
         # Chaos scope of the owning core, read per execution so an
         # in-process fleet's scoped faults reach replica executions.
         self._scope_fn = scope_fn
+        # Mesh-slice serving (PR 20): a shard_mesh declaration turns
+        # each replica into a slice_width-device slice. Slices need a
+        # real factory (the mesh= contract); without one the set
+        # degrades to classic shared-base replicas with a warning.
+        self._shard_axes = mesh_mod.shard_axes(model)
+        self.slice_width = mesh_mod.slice_width(model)
+        self.sharded = bool(self._shard_axes)
+        if self.sharded and factory is None:
+            _LOG.warning(
+                "model '%s' declares shard_mesh %s but has no factory; "
+                "serving UNSHARDED shared-base replicas", self.name,
+                self._shard_axes)
+            self._shard_axes = []
+            self.slice_width = 1
+            self.sharded = False
+        try:
+            import jax
+
+            self._ndev = max(len(jax.devices()), 1)
+        except Exception:  # noqa: BLE001 — device-less unit tests
+            self._ndev = 1
+        # Per-device fault evidence (watchdog/breaker failures keyed by
+        # device_key): under tp>1 one sick chip's trail must name the
+        # chip, not just the slice. Guarded by the set's lock.
+        self._device_evidence: Dict[str, int] = {}
         self._lock = threading.Lock()
         self._sticky: Dict[object, int] = {}
         # Exploration counter (EndpointPool's 2% random exploration,
@@ -228,10 +278,19 @@ class ReplicaSet:
         self.canary_rejects = 0
         self.replicas: List[_Replica] = []
         for index in range(self.count):
-            instance = model if index == 0 else self._new_instance()
+            mesh_slice = self._plan_slice(index)
+            if mesh_slice is not None:
+                # Sharded: EVERY replica (index 0 included) is a fresh
+                # slice-sharded executable from the factory; the base
+                # model stays the metadata/config surface only.
+                instance = self._new_instance(mesh_slice)
+            else:
+                instance = model if index == 0 else self._new_instance()
             replica = _Replica(index, instance, CircuitBreaker(
                 failure_threshold=self._failure_threshold,
-                reset_timeout_s=self._recovery_s))
+                reset_timeout_s=self._recovery_s),
+                mesh_slice=mesh_slice)
+            self._seed_devices(replica)
             self._start_queue(replica)
             self._register_ledger(replica, instance)
             self.replicas.append(replica)
@@ -251,13 +310,38 @@ class ReplicaSet:
 
     # -- construction / teardown ----------------------------------------
 
-    def _new_instance(self):
+    def _plan_slice(self, index: int):
+        """The deterministic device block for replica ``index`` (None
+        when the set is unsharded)."""
+        if not self.sharded:
+            return None
+        return mesh_mod.plan_slice(self._shard_axes, index)
+
+    def _seed_devices(self, replica: _Replica) -> None:
+        """Fills the replica's device identity: slice members when
+        sharded, else the single device its index maps to (the same
+        index-modulo placement devstats uses for busy attribution) —
+        so chaos ``device=<id>`` targeting and per-device evidence
+        work uniformly across both serving shapes."""
+        if replica.mesh_slice is not None:
+            return  # _Replica.__init__ copied the slice's devices
+        replica.device_ids = (replica.index % self._ndev,)
+        replica.device_keys = (
+            devstats_mod.get().device_key_for_index(replica.index),)
+
+    def _new_instance(self, mesh_slice=None):
         """A fresh executable+weights, or the shared base when no real
-        factory exists (see class docstring)."""
+        factory exists (see class docstring). With ``mesh_slice`` the
+        factory is invoked through the mesh= contract so the instance
+        comes up sharded over exactly that slice's devices."""
         if self._factory is None:
             return self.base
         try:
-            instance = self._factory()
+            if mesh_slice is not None:
+                instance = mesh_mod.build_instance(self._factory,
+                                                   mesh_slice)
+            else:
+                instance = self._factory()
         except Exception as e:  # noqa: BLE001 — degrade, don't die
             _LOG.warning("replica factory for '%s' failed (%s); "
                          "sharing the base executable", self.name, e)
@@ -290,8 +374,20 @@ class ReplicaSet:
         """Attributes a fresh per-replica executable's device arrays
         to this model in the HBM ledger (``replica:<index>`` row).
         Replicas sharing the base executable register nothing — the
-        load-time ``weights`` row already covers that memory."""
+        load-time ``weights`` row already covers that memory.
+
+        A mesh slice books per-participating-device rows instead
+        (``slice:<index>:<device>``), leased from the HBM allocator
+        under every member device's arbitration mutex — slice-unit
+        admission AND truthful ``tpu_hbm_model_bytes`` under tp>1. An
+        allocator refusal (RESOURCE_EXHAUSTED after eviction)
+        propagates: the slice does not fit, and pretending otherwise
+        would un-do PR-18's honest admission."""
         if instance is self.base:
+            return
+        if replica.mesh_slice is not None:
+            replica.slice_res = mesh_mod.admit_slice(
+                self.name, replica.mesh_slice, instance)
             return
         try:
             ledger = devstats_mod.get().ledger
@@ -300,6 +396,19 @@ class ReplicaSet:
                 devstats_mod.model_array_bytes(instance))
         except Exception:  # noqa: BLE001 — accounting must never
             pass  # block serving
+
+    def _release_resources(self, replica: _Replica) -> None:
+        """Returns everything a replica's executable holds: its ledger
+        row and — for a mesh slice — the per-device HBM leases. Both
+        releases are idempotent; callers run this whenever a replica's
+        instance leaves routing (stop, drain, re-initialization,
+        rejected scale-up prospect)."""
+        devstats_mod.get().ledger.release(replica.ledger_row)
+        replica.ledger_row = None
+        slice_res = replica.slice_res
+        replica.slice_res = None
+        if slice_res is not None:
+            slice_res.release()
 
     def stop(self) -> None:
         """Drain for unload/shutdown: stop the supervisor, then shut
@@ -310,10 +419,8 @@ class ReplicaSet:
             replicas = list(self.replicas)
         self._stop.set()
         self._supervisor.join(timeout=5)
-        ledger = devstats_mod.get().ledger
         for replica in replicas:
-            ledger.release(replica.ledger_row)
-            replica.ledger_row = None
+            self._release_resources(replica)
             executor = replica.executor
             if executor is not None:
                 # A hung replica's worker can never finish: wait only
@@ -335,12 +442,27 @@ class ReplicaSet:
                 return False
             index = self._next_index
             self._next_index += 1
-        instance = self._new_instance()  # warmed before routing
+        mesh_slice = self._plan_slice(index)
+        instance = self._new_instance(mesh_slice)  # warmed pre-routing
         replica = _Replica(index, instance, CircuitBreaker(
             failure_threshold=self._failure_threshold,
-            reset_timeout_s=self._recovery_s))
+            reset_timeout_s=self._recovery_s), mesh_slice=mesh_slice)
+        self._seed_devices(replica)
         self._start_queue(replica)
-        self._register_ledger(replica, instance)
+        try:
+            self._register_ledger(replica, instance)
+        except InferenceServerException as e:
+            # Slice-unit admission refused by a member device's HBM
+            # arbitration: the resize loses honestly, like a failed
+            # canary — nothing entered routing, nothing leaked.
+            replica.executor.shutdown(wait=False)
+            with self._lock:
+                self.canary_rejects += 1
+            self._notify("scale_up_admission_rejected replica=%d"
+                         % index)
+            _LOG.warning("replica %s:%d rejected by scale-up slice "
+                         "admission: %s", self.name, index, e)
+            return False
         with self._lock:
             self.probes += 1
         try:
@@ -364,9 +486,8 @@ class ReplicaSet:
                       "passed)", self.name, index)
             return True
         # Rejected (or lost the race with stop()): tear the prospect
-        # down completely — queue, ledger row, and all.
-        devstats_mod.get().ledger.release(replica.ledger_row)
-        replica.ledger_row = None
+        # down completely — queue, ledger rows, slice leases, and all.
+        self._release_resources(replica)
         replica.executor.shutdown(wait=False)
         if not ok:
             with self._lock:
@@ -406,8 +527,7 @@ class ReplicaSet:
             if busy <= 0:
                 break
             time.sleep(0.01)
-        devstats_mod.get().ledger.release(victim.ledger_row)
-        victim.ledger_row = None
+        self._release_resources(victim)
         executor = victim.executor
         if executor is not None:
             executor.shutdown(wait=not victim.hung)
@@ -529,7 +649,8 @@ class ReplicaSet:
         replica; request-level faults stay at the core's inject."""
         chaos.inject(self.name,
                      scope=self._scope_fn() if self._scope_fn else None,
-                     replica_id="%s:%d" % (self.name, replica.index))
+                     replica_id="%s:%d" % (self.name, replica.index),
+                     device_ids=replica.device_ids or None)
         # Compile attribution runs HERE — on the replica's own device-
         # queue thread — because thread-local scopes pushed by the
         # batcher or the core do not cross the executor hand-off.
@@ -600,8 +721,15 @@ class ReplicaSet:
                 latency_s if replica.ewma_latency_s == 0.0
                 else 0.2 * latency_s + 0.8 * replica.ewma_latency_s)
         # Busy time routed per replica device (outside the set's lock;
-        # the devstats layer does its own cheap synchronization).
-        devstats_mod.get().replica_busy(replica.index, latency_ns)
+        # the devstats layer does its own cheap synchronization). A
+        # sharded call occupies EVERY slice member for the wall time —
+        # each device gets the full duration, not a 1/width share.
+        devstats = devstats_mod.get()
+        if replica.mesh_slice is not None:
+            for device_key in replica.device_keys:
+                devstats.record_busy(device_key, latency_ns)
+        else:
+            devstats.replica_busy(replica.index, latency_ns)
 
     def _notify(self, label: str) -> None:
         """Fires the lifecycle event hook (never under the set's
@@ -623,6 +751,9 @@ class ReplicaSet:
         with self._lock:
             replica.outstanding = max(replica.outstanding - 1, 0)
             replica.failures += 1
+            for device_key in replica.device_keys:
+                self._device_evidence[device_key] = \
+                    self._device_evidence.get(device_key, 0) + 1
             if was_healthy and not replica.healthy():
                 replica.ejected_count += 1
                 self.ejections += 1
@@ -631,7 +762,17 @@ class ReplicaSet:
                              "after repeated execution failures)",
                              self.name, replica.index)
         if ejected:
-            self._notify("breaker_trip replica=%d" % replica.index)
+            self._notify(self._eject_label("breaker_trip", replica))
+
+    def _eject_label(self, kind: str, replica: _Replica) -> str:
+        """Incident label for an ejection: a slice's label names every
+        member chip — the fault domain IS the device set, and the
+        flight-recorder trail must say which chips left serving."""
+        label = "%s replica=%d" % (kind, replica.index)
+        if replica.mesh_slice is not None:
+            label += " devices=%s" % (",".join(
+                str(d) for d in replica.device_ids))
+        return label
 
     def _mark_hung(self, replica: _Replica) -> None:
         replica.breaker.record_failure()  # availability evidence too
@@ -640,6 +781,9 @@ class ReplicaSet:
             replica.outstanding = max(replica.outstanding - 1, 0)
             replica.failures += 1
             self.watchdog_trips += 1
+            for device_key in replica.device_keys:
+                self._device_evidence[device_key] = \
+                    self._device_evidence.get(device_key, 0) + 1
             if not replica.hung:
                 replica.hung = True
                 replica.ejected_count += 1
@@ -648,7 +792,7 @@ class ReplicaSet:
                 _LOG.warning("replica %s:%d marked unhealthy "
                              "(watchdog)", self.name, replica.index)
         if ejected:
-            self._notify("watchdog_trip replica=%d" % replica.index)
+            self._notify(self._eject_label("watchdog_trip", replica))
 
     # -- supervisor (self-healing) ---------------------------------------
 
@@ -715,13 +859,24 @@ class ReplicaSet:
         it either finishes into the void or times out at its waiter's
         watchdog and re-dispatches."""
         old = replica.executor
-        instance = self._new_instance()  # warmed before routing
-        # The old executable's ledger row dies with it; the fresh
-        # instance registers its own (re-init is an allocation site —
-        # skipping it here would leak a row per heal cycle).
-        devstats_mod.get().ledger.release(replica.ledger_row)
-        replica.ledger_row = None
-        self._register_ledger(replica, instance)
+        # Same slice, fresh executable: the device block is the
+        # replica's identity, so re-initialization rebuilds the
+        # sharded program over the SAME member devices.
+        instance = self._new_instance(replica.mesh_slice)
+        # The old executable's ledger rows/leases die with it; the
+        # fresh instance registers its own (re-init is an allocation
+        # site — skipping it here would leak a row per heal cycle).
+        self._release_resources(replica)
+        try:
+            self._register_ledger(replica, instance)
+        except InferenceServerException as e:
+            # Slice re-admission refused (another model grew into the
+            # freed budget): serve anyway — the weights are already
+            # resident — but log the accounting gap; the next heal
+            # cycle retries the booking.
+            _LOG.warning("replica %s:%d re-admission lease refused "
+                         "(%s); slice accounting degraded until the "
+                         "next heal", self.name, replica.index, e)
         with self._lock:
             replica.model = instance
             self._start_queue(replica)
@@ -776,12 +931,16 @@ class ReplicaSet:
                     "exec_ns": r.exec_ns,
                     "ejected_count": r.ejected_count,
                     "readmitted_count": r.readmitted_count,
+                    "devices": list(r.device_ids),
                 }
                 for r in self.replicas
             ]
             return {
                 "count": self.count,
                 "healthy": sum(1 for r in self.replicas if r.healthy()),
+                "sharded": self.sharded,
+                "slice_width": self.slice_width,
+                "device_evidence": dict(self._device_evidence),
                 "ejections": self.ejections,
                 "readmissions": self.readmissions,
                 "redispatches": self.redispatches,

@@ -206,9 +206,14 @@ def test_scale_to_zero_round_trip():
         assert _wait_for(
             lambda: core.repository.is_ready("zero_autoscale"))
         core.infer(_request(2, "zero_autoscale"))
+        # The model turns ready inside the cold-start thread a beat
+        # before that thread stamps its decision — wait for the event
+        # instead of racing the stamp.
+        assert _wait_for(
+            lambda: core.autoscaler.snapshot()["zero_autoscale"]
+            ["events"].get("up|cold_start") == 1)
         events = core.autoscaler.snapshot()["zero_autoscale"]["events"]
         assert events.get("down|scale_to_zero") == 1
-        assert events.get("up|cold_start") == 1
         decisions = [r["decision"] for r
                      in core.flight.snapshot("zero_autoscale")
                      if r.get("reason") == "decision"]

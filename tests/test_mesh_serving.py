@@ -1,0 +1,558 @@
+"""Mesh-slice serving (docs/sharded_serving.md): shard-mesh spec
+parsing and slice planning, the sharded ReplicaSet (disjoint device
+blocks, per-slice fault domains, chaos ``device=<id>`` kill ->
+whole-slice ejection + readmission), slice-unit HBM admission
+rollback, golden parity single-device vs tp-sharded LLMs across
+dtypes (bf16 included), sharded paged-KV accounting (page-axis
+rounding, per-member leases, zero leaks after cancel AND crash), mixed
+sharded+unsharded traffic through one core, and the ensemble interior
+arena landing (PR-16 follow-up: stage hand-offs become
+pull-addressable regions instead of plain leases)."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from client_tpu._infer_common import InferInput
+from client_tpu.grpc._utils import get_inference_request
+from client_tpu.models.ensemble import DataflowContext, EnsembleModel
+from client_tpu.models.llm import LlmConfig, LlmModel
+from client_tpu.server import chaos
+from client_tpu.server import devstats as devstats_mod
+from client_tpu.server import hbm as hbm_mod
+from client_tpu.server import mesh as mesh_mod
+from client_tpu.server.app import build_core
+from client_tpu.server.model import ServedModel, TensorSpec
+from client_tpu.server.replicas import ReplicaSet
+from client_tpu.utils import InferenceServerException
+
+TINY = LlmConfig(vocab=264, d_model=64, n_layers=2, n_heads=4,
+                 n_kv_heads=2, d_ff=128, max_seq=64)
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos.configure(None)
+    yield
+    chaos.configure(None)
+
+
+def _wait_for(predicate, timeout_s=10.0, interval_s=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval_s)
+    return predicate()
+
+
+# -- spec parsing / slice planning -----------------------------------------
+
+
+def test_parse_shard_mesh_variants():
+    assert mesh_mod.parse_shard_mesh({"tp": 4}) == [("tp", 4)]
+    assert mesh_mod.parse_shard_mesh("sp=2,tp=2") \
+        == [("sp", 2), ("tp", 2)]
+    assert mesh_mod.parse_shard_mesh([("tp", 2), ("dp", 1)]) \
+        == [("tp", 2)]  # size<=1 axes shard nothing and drop out
+    assert mesh_mod.parse_shard_mesh(None) == []
+    assert mesh_mod.parse_shard_mesh("") == []
+    with pytest.raises(ValueError):
+        mesh_mod.parse_shard_mesh("tp4")
+
+
+def test_slice_width_and_wants_mesh():
+    class _M:
+        shard_mesh = {"sp": 2, "tp": 2}
+
+    assert mesh_mod.wants_mesh(_M())
+    assert mesh_mod.slice_width(_M()) == 4
+    assert not mesh_mod.wants_mesh(object())
+    assert mesh_mod.slice_width(object()) == 1
+
+
+def test_plan_slice_contiguous_blocks_and_wrap():
+    import jax
+
+    devices = jax.devices()
+    assert len(devices) >= 8, "conftest should provide 8 CPU devices"
+    s0 = mesh_mod.plan_slice([("tp", 4)], 0)
+    s1 = mesh_mod.plan_slice([("tp", 4)], 1)
+    assert s0.device_ids == (0, 1, 2, 3)
+    assert s1.device_ids == (4, 5, 6, 7)
+    assert not set(s0.device_ids) & set(s1.device_ids)
+    # Replica indexes are never reused; index 2 wraps onto block 0.
+    assert mesh_mod.plan_slice([("tp", 4)], 2).device_ids \
+        == s0.device_ids
+    assert dict(s0.mesh.shape) == {"tp": 4}
+    with pytest.raises(ValueError):
+        mesh_mod.plan_slice([("tp", len(devices) * 2)], 0)
+
+
+# -- sharded ReplicaSet ----------------------------------------------------
+
+
+class _MeshStub(ServedModel):
+    """Sharded-factory stub: records the mesh it was built over and
+    computes OUTPUT = INPUT * 2 + 1 (slice-independent, so golden
+    parity across slices is exact)."""
+
+    instance_group_count = 2
+    shard_mesh = {"tp": 2}
+
+    def __init__(self, name="mesh_stub", mesh=None):
+        super().__init__()
+        self.name = name
+        self.mesh = mesh
+        self.inputs = [TensorSpec("INPUT", "INT32", [1])]
+        self.outputs = [TensorSpec("OUTPUT", "INT32", [1])]
+
+    def infer(self, inputs, parameters=None):
+        value = np.asarray(inputs["INPUT"], dtype=np.int64)
+        return {"OUTPUT": (value * 2 + 1).astype(np.int32)}
+
+
+def _sharded_set(count=2, **kwargs):
+    instances = []
+
+    def factory(mesh=None):
+        instance = _MeshStub(mesh=mesh)
+        instances.append(instance)
+        return instance
+
+    base = _MeshStub()
+    replica_set = ReplicaSet(base, factory=factory, count=count,
+                             watchdog_us=2_000_000,
+                             failure_threshold=2, recovery_s=0.2,
+                             **kwargs)
+    return replica_set, instances
+
+
+def _one(value):
+    return {"INPUT": np.array([value], dtype=np.int32)}
+
+
+def test_sharded_set_builds_disjoint_slices():
+    replica_set, instances = _sharded_set()
+    try:
+        snap = replica_set.snapshot()
+        assert snap["sharded"] and snap["slice_width"] == 2
+        blocks = [tuple(row["devices"]) for row in snap["replicas"]]
+        assert blocks == [(0, 1), (2, 3)]
+        # Every replica (index 0 included) is a fresh sharded
+        # instance built over exactly its slice's mesh.
+        assert len(instances) == 2
+        for instance, block in zip(instances, blocks):
+            assert instance.mesh is not None
+            assert tuple(d.id for d in instance.mesh.devices.flat) \
+                == block
+        out = replica_set.infer(_one(5))
+        assert int(np.asarray(out["OUTPUT"]).reshape(-1)[0]) == 11
+    finally:
+        replica_set.stop()
+
+
+def test_sharded_set_degrades_without_factory(caplog):
+    base = _MeshStub()
+    replica_set = ReplicaSet(base, factory=None, count=2,
+                             recovery_s=0.2)
+    try:
+        snap = replica_set.snapshot()
+        assert not snap["sharded"] and snap["slice_width"] == 1
+    finally:
+        replica_set.stop()
+
+
+def test_chaos_device_kill_ejects_whole_slice_and_readmits():
+    """A single sick chip (chaos ``device=<id>``) must: (a) stay
+    masked — the sibling slice serves every request; (b) eject exactly
+    the slice containing the chip, with per-member device evidence;
+    (c) readmit the slice once the chip heals."""
+    replica_set, _ = _sharded_set()
+    try:
+        chaos.configure(chaos.ChaosConfig(error_rate=1.0, device=1))
+        for value in range(6):
+            out = replica_set.infer(_one(value))
+            assert int(np.asarray(out["OUTPUT"]).reshape(-1)[0]) \
+                == value * 2 + 1
+        assert _wait_for(
+            lambda: replica_set.snapshot()["healthy"] == 1)
+        snap = replica_set.snapshot()
+        sick = [row for row in snap["replicas"] if not row["healthy"]]
+        assert len(sick) == 1 and sick[0]["devices"] == [0, 1]
+        # Evidence names every member chip of the failed executions.
+        assert snap["device_evidence"].get("CPU-0", 0) >= 1
+        assert snap["device_evidence"].get("CPU-1", 0) >= 1
+        chaos.configure(None)  # chip healed
+        assert _wait_for(
+            lambda: replica_set.snapshot()["healthy"] == 2)
+        assert replica_set.snapshot()["readmissions"] >= 1
+    finally:
+        replica_set.stop()
+
+
+def test_chaos_device_targeting_skips_untouched_slices():
+    chaos.configure(chaos.ChaosConfig(error_rate=1.0, device=7))
+    # Request layer (no devices): never fires.
+    chaos.inject("m")
+    # A slice not containing device 7: never fires.
+    chaos.inject("m", replica_id="m:0", device_ids=(0, 1))
+    with pytest.raises(InferenceServerException):
+        chaos.inject("m", replica_id="m:1", device_ids=(6, 7))
+
+
+# -- slice-unit HBM admission ----------------------------------------------
+
+
+def test_admit_slice_rolls_back_partial_grants(monkeypatch):
+    """A member device refusing its share must unwind every sibling
+    grant — a failed slice admission leaves zero phantom pressure."""
+
+    class _Weights:
+        def __init__(self):
+            self.weights = np.zeros(1024, dtype=np.float32)  # 4 KiB
+
+    allocator = hbm_mod.HbmAllocator(
+        budget_bytes=3000,
+        stats=devstats_mod.DeviceStats(enabled=True))
+    monkeypatch.setattr(hbm_mod, "_SINGLETON", allocator)
+    # CPU-1 is nearly full: its 2 KiB share cannot fit, CPU-0's can.
+    blocker = allocator.lease("blocker", "weights", 2800,
+                              device_key="CPU-1")
+    assert blocker is not None
+    mesh_slice = mesh_mod.plan_slice([("tp", 2)], 0)
+    with pytest.raises(InferenceServerException):
+        mesh_mod.admit_slice("victim", mesh_slice, _Weights())
+    assert not allocator._by_model.get("victim")
+
+
+def test_admit_slice_books_per_device_rows(monkeypatch):
+    class _Weights:
+        def __init__(self):
+            self.weights = np.zeros(1024, dtype=np.float32)
+
+    allocator = hbm_mod.HbmAllocator(
+        budget_bytes=1 << 20,
+        stats=devstats_mod.DeviceStats(enabled=True))
+    monkeypatch.setattr(hbm_mod, "_SINGLETON", allocator)
+    mesh_slice = mesh_mod.plan_slice([("tp", 2)], 0)
+    resources = mesh_mod.admit_slice("m", mesh_slice, _Weights())
+    leases = list(resources.leases)
+    assert sorted(lease.device_key for lease in leases) \
+        == ["CPU-0", "CPU-1"]
+    assert all(lease.nbytes == 2048 for lease in leases)
+    resources.release()
+    resources.release()  # idempotent
+    assert not allocator._by_model.get("m")
+
+
+# -- sharded LLM: golden parity + sharded paged KV -------------------------
+
+
+def _gen(model, prompt, n=6, ignore_eos=True):
+    return [t for t in model._generate(
+        {"text_input": np.array([prompt], dtype=np.object_),
+         "max_tokens": np.array([n], dtype=np.int32),
+         "ignore_eos": np.array([ignore_eos])}, {})]
+
+
+def _drain(model, timeout_s=30.0):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        snap = model.kv_stats()
+        if not (snap["pages_used"] or snap["pages_reserved"]
+                or model._active):
+            return snap
+        time.sleep(0.05)
+    return model.kv_stats()
+
+
+def _tp2_mesh():
+    import jax
+
+    from client_tpu.parallel import create_mesh
+
+    return create_mesh((("tp", 2),), devices=jax.devices()[:2])
+
+
+@pytest.mark.parametrize("dtype", ["bfloat16", "float32"])
+def test_llm_sharded_golden_parity_across_dtypes(dtype):
+    """tp=2 sharded serving is byte-identical to the single-device
+    model — greedy decode over the page-axis-sharded KV pool must not
+    perturb a single logit, in bf16 or fp32."""
+    cfg = LlmConfig(vocab=264, d_model=64, n_layers=2, n_heads=4,
+                    n_kv_heads=2, d_ff=128, max_seq=64, dtype=dtype)
+    single = LlmModel(name="llm_one_%s" % dtype, cfg=cfg,
+                      decode_lanes=2, page_size=4)
+    sharded = LlmModel(name="llm_tp2_%s" % dtype, cfg=cfg,
+                       mesh=_tp2_mesh(), decode_lanes=2, page_size=4)
+    try:
+        assert sharded._paged, "sharded LLM must serve the paged arm"
+        for prompt in (b"abc", b"sharded parity probe " * 2):
+            assert _gen(single, prompt, 8) == _gen(sharded, prompt, 8)
+    finally:
+        single.unload()
+        sharded.unload()
+
+
+def test_llm_sharded_kv_pool_rounds_and_leases_per_member():
+    model = LlmModel(name="llm_kv_shard", cfg=TINY, mesh=_tp2_mesh(),
+                     decode_lanes=2, page_size=4, kv_pages=9)
+    try:
+        assert len(_gen(model, b"warm", 4)) == 4
+        # Page axis shards over tp=2: the count rounds UP to a
+        # shard-count multiple and each member holds a sub-pool.
+        assert model._num_pages == 10
+        leases = list(model._kv_leases)
+        assert sorted(lease.device_key for lease in leases) \
+            == ["CPU-0", "CPU-1"]
+        assert {lease.component for lease in leases} \
+            == {"kv_pages:CPU-0", "kv_pages:CPU-1"}
+        snap = _drain(model)
+        assert snap["pages_used"] == 0 and snap["pages_reserved"] == 0
+    finally:
+        model.unload()
+
+
+def test_llm_sharded_kv_leak_free_after_cancel_and_crash():
+    """The PR-19 cancel/crash matrix against the sharded pool: an
+    abandoned stream and an injected device failure must both return
+    the sharded pool to zero pages (no per-member sub-pool may strand
+    a page)."""
+    model = LlmModel(name="llm_kv_churn", cfg=TINY, mesh=_tp2_mesh(),
+                     decode_lanes=2, page_size=4)
+    try:
+        # Cancel mid-stream.
+        gen = model._generate(
+            {"text_input": np.array([b"abandon sharded stream"],
+                                    dtype=np.object_),
+             "max_tokens": np.array([50], dtype=np.int32),
+             "ignore_eos": np.array([True])}, {})
+        next(gen)
+        assert model.kv_stats()["pages_used"] > 0
+        gen.close()
+        snap = _drain(model)
+        assert snap["pages_used"] == 0 and snap["pages_reserved"] == 0
+        # Crash mid-decode: generation bump rebuilds the SHARDED pool.
+        real = model._paged_decode
+        state = {"armed": True}
+
+        def exploding(*args, **kwargs):
+            if state["armed"]:
+                state["armed"] = False
+                raise RuntimeError("injected device failure")
+            return real(*args, **kwargs)
+
+        model._paged_decode = exploding
+        with pytest.raises(InferenceServerException, match="failed"):
+            _gen(model, b"boom", 8)
+        model._paged_decode = real
+        assert len(_gen(model, b"after", 4)) == 4
+        snap = _drain(model)
+        assert snap["pages_used"] == 0 and snap["pages_reserved"] == 0
+    finally:
+        model.unload()
+
+
+# -- mixed sharded + unsharded traffic through one core --------------------
+
+
+def test_mixed_sharded_and_unsharded_traffic_one_core():
+    """A mesh-sharded instance group and a plain host model serve
+    concurrently from one core: the sharded set's slices and the
+    unsharded model's direct path must not disturb each other."""
+    core = build_core([], warmup=False)
+    name = "mesh_mixed"
+    try:
+        core.repository.add_factory(
+            name, lambda mesh=None: _MeshStub(name=name, mesh=mesh))
+        core.load_model(name, warmup=False)
+        core.load_model("simple", warmup=False)
+
+        def _mesh_request(value):
+            tensor = InferInput("INPUT", [1], "INT32")
+            tensor.set_data_from_numpy(
+                np.array([value], dtype=np.int32))
+            return get_inference_request(model_name=name,
+                                         inputs=[tensor], outputs=None)
+
+        def _simple_request(value):
+            tensors = []
+            for tname, fill in (("INPUT0", value), ("INPUT1", 2 * value)):
+                tensor = InferInput(tname, [16], "INT32")
+                tensor.set_data_from_numpy(
+                    np.full((16,), fill, dtype=np.int32))
+                tensors.append(tensor)
+            return get_inference_request(model_name="simple",
+                                         inputs=tensors, outputs=None)
+
+        # First sharded request builds the ReplicaSet lazily; its
+        # debug snapshot must then report slice serving.
+        response = core.infer(_mesh_request(3))
+        out = np.frombuffer(response.raw_output_contents[0],
+                            dtype=np.int32)
+        assert int(out[0]) == 7
+        snap = core.debug_snapshot()["replicas"][name]
+        assert snap["sharded"] and snap["slice_width"] == 2
+
+        errors = []
+
+        def worker(kind, value):
+            try:
+                if kind == "sharded":
+                    response = core.infer(_mesh_request(value))
+                    out = np.frombuffer(
+                        response.raw_output_contents[0], dtype=np.int32)
+                    assert int(out[0]) == value * 2 + 1, out
+                else:
+                    core.infer(_simple_request(value))
+            except Exception as e:  # noqa: BLE001
+                errors.append((kind, value, e))
+
+        threads = [
+            threading.Thread(target=worker,
+                             args=("sharded" if i % 2 else "plain", i))
+            for i in range(12)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+        # The sharded model renders its per-slice health gauge.
+        assert 'tpu_slice_healthy{model="%s",slice="0"} 1' % name \
+            in core.metrics_text()
+    finally:
+        core.shutdown()
+
+
+# -- ensemble interior tensors land in arena regions -----------------------
+
+
+class _FakeDeviceArray:
+    """Mimics an OFF-HOST jax array. CPU-sim jax arrays are host-
+    committed (zero-copy to numpy), so the interior hand-off
+    accounting correctly skips them — exercising the landing path
+    needs an array whose devices() reports a non-cpu platform."""
+
+    def __init__(self, data):
+        self._data = np.asarray(data, dtype=np.float32)
+        self.dtype = self._data.dtype
+        self.shape = self._data.shape
+        self.nbytes = self._data.nbytes
+
+    def __array__(self, dtype=None):
+        return self._data if dtype is None \
+            else self._data.astype(dtype)
+
+    def devices(self):
+        class _Device:
+            platform = "tpu"
+
+        return {_Device()}
+
+
+class _DeviceMid(ServedModel):
+    """Stage whose output stays 'device-resident' into the next
+    stage."""
+
+    max_batch_size = 0
+
+    def __init__(self, name="arena_mid"):
+        super().__init__()
+        self.name = name
+        self.inputs = [TensorSpec("XIN", "FP32", [4])]
+        self.outputs = [TensorSpec("H", "FP32", [4])]
+
+    def infer(self, inputs, parameters=None):
+        x = np.asarray(inputs["XIN"], dtype=np.float32)
+        return {"H": _FakeDeviceArray(x * 2.0)}
+
+
+class _HostTail(ServedModel):
+    max_batch_size = 0
+
+    def __init__(self, name="arena_tail"):
+        super().__init__()
+        self.name = name
+        self.inputs = [TensorSpec("H", "FP32", [4])]
+        self.outputs = [TensorSpec("OUT", "FP32", [1])]
+
+    def infer(self, inputs, parameters=None):
+        x = np.asarray(inputs["H"], dtype=np.float32)
+        return {"OUT": x.sum(axis=-1, keepdims=True)}
+
+
+class _MiniRepo:
+    def __init__(self, models):
+        self._models = {m.name: m for m in models}
+
+    def load(self, name):
+        return self._models[name]
+
+
+def _interior_ensemble():
+    repo = _MiniRepo([_DeviceMid(), _HostTail()])
+    return EnsembleModel(
+        name="arena_ens",
+        repository=repo,
+        steps=[
+            ("arena_mid", {"XIN": "XIN"}, {"h": "H"}),
+            ("arena_tail", {"h": "H"}, {"OUT": "OUT"}),
+        ],
+        inputs=[TensorSpec("XIN", "FP32", [4])],
+        outputs=[TensorSpec("OUT", "FP32", [1])],
+    )
+
+
+def test_land_interior_adopts_typed_segments():
+    core = build_core([], warmup=False)
+    try:
+        arena = core.memory.arena
+        if arena is None:
+            pytest.skip("no arena on this platform")
+        outputs = {"H": _FakeDeviceArray(np.arange(4.0)),
+                   "Z": _FakeDeviceArray(np.arange(8.0))}
+        nbytes = sum(v.nbytes for v in outputs.values())
+        region_id = EnsembleModel._land_interior(arena, outputs, nbytes)
+        assert region_id is not None
+        segments = arena.snapshot_segments(region_id)
+        assert len(segments) == 2
+        assert [seg.offset for seg in segments] == [0, 16]
+        assert all(seg.datatype == "FP32" for seg in segments)
+        arena.destroy_region(region_id)
+    finally:
+        core.shutdown()
+
+
+def test_ensemble_interior_lands_in_arena_and_cleans_up():
+    """Each interior stage boundary lands one arena region (the
+    pull-addressable zero-copy edge) and every region dies with the
+    request — the arena holds no interior residue afterwards."""
+    core = build_core([], warmup=False)
+    try:
+        arena = core.memory.arena
+        if arena is None:
+            pytest.skip("no arena on this platform")
+        ensemble = _interior_ensemble()
+        baseline = len(arena.list_regions())
+        ctx = DataflowContext(arena=arena)
+        outputs, _queue_ns = ensemble.infer_dataflow(
+            {"XIN": np.arange(4, dtype=np.float32)}, {}, ctx)
+        assert float(np.asarray(outputs["OUT"]).reshape(-1)[0]) \
+            == pytest.approx(12.0)  # sum(2 * [0..3])
+        assert ensemble.interior_arena_regions == 1
+        assert len(arena.list_regions()) == baseline
+        # Without an arena the site falls back to the interior lease
+        # path (best-effort) and still serves identically.
+        outputs, _ = ensemble.infer_dataflow(
+            {"XIN": np.arange(4, dtype=np.float32)}, {},
+            DataflowContext())
+        assert float(np.asarray(outputs["OUT"]).reshape(-1)[0]) \
+            == pytest.approx(12.0)
+        assert ensemble.interior_arena_regions == 1  # unchanged
+    finally:
+        core.shutdown()

@@ -371,4 +371,23 @@ if ! timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/cancel_smoke.py \
 fi
 grep -E "cancel smoke passed" "$CANCEL_LOG"
 echo "OK: cancel smoke passed"
+
+# Mesh smoke: sharded serving on the 8-device simulated platform —
+# a model too big for any one device's budget admits as per-device
+# slice leases, a tp=4-sharded LLM holds golden parity with the
+# single-device model and its sharded paged-KV pool is leak-free
+# after cancel churn, 2 tp slices clear >=1.8x the 1-slice rate, and
+# a chaos-killed chip ejects its whole slice (100% goodput via the
+# sibling) then readmits. Gates live in tools/mesh_smoke.py.
+echo "mesh smoke: sharded slices — scaling + kill-one-chip + parity"
+MESH_LOG=/tmp/_mesh_smoke.log
+if ! timeout -k 10 420 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python tools/mesh_smoke.py > "$MESH_LOG" 2>&1; then
+    echo "FAIL: mesh smoke did not pass" >&2
+    tail -30 "$MESH_LOG" >&2
+    exit 1
+fi
+grep -E "mesh smoke passed" "$MESH_LOG"
+echo "OK: mesh smoke passed"
 exit 0

@@ -35,9 +35,11 @@ Patches applied:
   multi-tenant QoS schema (PR 7): ``DynamicBatchingConfig.
   priority_levels`` / ``default_priority_level`` / ``shed_watermark``
   plus the per-priority ``PriorityQueuePolicy`` rows, the SLO
-  declaration (PR 14): ``SloConfig`` + ``ModelConfig.slo``, and the
+  declaration (PR 14): ``SloConfig`` + ``ModelConfig.slo``, the
   autoscale declaration (PR 17): ``AutoscaleConfig`` +
-  ``ModelInstanceConfig.autoscale``.
+  ``ModelInstanceConfig.autoscale``, and the mesh-slice declaration
+  (PR 20): ``ModelInstanceConfig.shard_mesh`` (reusing the base
+  schema's ``MeshConfig``).
 
 The ``_serialized_start/_serialized_end`` attribute lines at the bottom
 of the pb2 modules go stale after the patch; they only execute when
@@ -526,6 +528,15 @@ def patch_model_config(file_proto: descriptor_pb2.FileDescriptorProto) -> bool:
         instance_group.field.add(
             name="autoscale", number=5, type=MESSAGE, label=OPTIONAL,
             type_name=".inference.AutoscaleConfig", json_name="autoscale")
+        changed = True
+    # Mesh-slice serving (PR 20): the replica axis composes with a
+    # shard mesh — each instance_group replica is a slice of
+    # product(axis_sizes) devices. Reuses the existing MeshConfig
+    # message (already in the base descriptor for model-level mesh).
+    if not any(f.name == "shard_mesh" for f in instance_group.field):
+        instance_group.field.add(
+            name="shard_mesh", number=6, type=MESSAGE, label=OPTIONAL,
+            type_name=".inference.MeshConfig", json_name="shardMesh")
         changed = True
     return changed
 

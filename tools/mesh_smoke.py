@@ -1,0 +1,228 @@
+"""Mesh-slice serving smoke gate for tools/ci_check.sh
+(docs/sharded_serving.md).
+
+Runs on the 8-device simulated CPU platform
+(``XLA_FLAGS=--xla_force_host_platform_device_count=8``) and gates the
+ISSUE-20 acceptance criteria:
+
+* **Slice scaling + kill-one-chip** (bench_child.run_mesh_measure): a
+  delay-bound model served as 1 vs 2 tp-sharded slices must scale >=
+  1.8x; chaos ``device=0`` mid-load must be fully masked (100%
+  goodput — every failure re-dispatched to the sibling slice) with the
+  whole slice ejected AND readmitted after the chip heals.
+* **Too-big-for-one-device admission**: against a per-device HBM
+  budget smaller than the model, whole-model admission on one device
+  is refused while slice admission (per-device shard shares) succeeds
+  — the model serves BECAUSE it is sharded.
+* **Golden parity**: a tp=4-sharded LLM's greedy token stream is
+  byte-identical to the single-device model's.
+* **Sharded paged KV**: the page pool serves sharded (page axis over
+  tp) and returns to zero pages after completion + cancel churn.
+
+The throughput-ratio gate divides two measurements on a shared CI
+box, so one retry is allowed; every correctness gate must hold on
+each attempt.
+
+Usage: JAX_PLATFORMS=cpu python tools/mesh_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+
+def check_budget_proof() -> list:
+    """The model only fits sharded: one device refuses the whole
+    model, slice admission lands every per-device share."""
+    import numpy as np
+
+    from client_tpu.server import devstats as devstats_mod
+    from client_tpu.server import hbm as hbm_mod
+    from client_tpu.server import mesh as mesh_mod
+    from client_tpu.utils import InferenceServerException
+
+    class _Big:
+        def __init__(self):
+            self.weights = np.zeros(1 << 18, dtype=np.float32)  # 1 MiB
+
+    failures = []
+    allocator = hbm_mod.HbmAllocator(
+        budget_bytes=512 << 10,  # half the model per device
+        stats=devstats_mod.DeviceStats(enabled=True))
+    saved = hbm_mod._SINGLETON
+    hbm_mod._SINGLETON = allocator
+    try:
+        try:
+            allocator.lease("big", "weights", 1 << 20,
+                            device_key="CPU-0")
+            failures.append("whole-model lease fit a 512K device "
+                            "budget — the too-big premise is broken")
+        except InferenceServerException:
+            pass
+        mesh_slice = mesh_mod.plan_slice([("tp", 4)], 0)
+        resources = mesh_mod.admit_slice("big", mesh_slice, _Big())
+        if len(resources.leases) != 4:
+            failures.append("slice admission booked %d leases "
+                            "(want 4 — one per member device)"
+                            % len(resources.leases))
+        devices = sorted({lease.device_key
+                          for lease in resources.leases})
+        if len(devices) != 4:
+            failures.append("slice leases landed on %s (want 4 "
+                            "distinct member devices)" % devices)
+        resources.release()
+        if allocator._by_model.get("big"):
+            failures.append("slice release left residual leases")
+    finally:
+        hbm_mod._SINGLETON = saved
+    return failures
+
+
+def check_llm_parity_and_sharded_kv() -> list:
+    """tp=4 parity vs single device + sharded paged pool returning to
+    zero pages after completion and cancel churn."""
+    import jax
+    import numpy as np
+
+    from client_tpu.models.llm import LlmConfig, LlmModel
+    from client_tpu.parallel import create_mesh
+
+    def gen(model, prompt, n=8):
+        return [t for t in model._generate(
+            {"text_input": np.array([prompt], dtype=np.object_),
+             "max_tokens": np.array([n], dtype=np.int32),
+             "ignore_eos": np.array([True])}, {})]
+
+    def drain(model, timeout_s=30.0):
+        import time
+
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            snap = model.kv_stats()
+            if not (snap["pages_used"] or snap["pages_reserved"]
+                    or model._active):
+                return snap
+            time.sleep(0.05)
+        return model.kv_stats()
+
+    failures = []
+    cfg = LlmConfig(vocab=264, d_model=64, n_layers=2, n_heads=4,
+                    n_kv_heads=4, d_ff=128, max_seq=64)
+    mesh = create_mesh((("tp", 4),), devices=jax.devices()[:4])
+    single = LlmModel(name="mesh_smoke_one", cfg=cfg,
+                      decode_lanes=2, page_size=4, kv_pages=16)
+    sharded = LlmModel(name="mesh_smoke_tp4", cfg=cfg, mesh=mesh,
+                       decode_lanes=2, page_size=4, kv_pages=16)
+    try:
+        if not sharded._paged:
+            failures.append("sharded LLM fell back to the dense arm "
+                            "(paged pool must shard its page axis)")
+        for prompt in (b"mesh smoke", b"sharded parity probe " * 2):
+            if gen(single, prompt) != gen(sharded, prompt):
+                failures.append("sharded output diverged from the "
+                                "single-device model on %r" % prompt)
+        # Cancel churn: abandon a stream mid-decode, then drain.
+        stream = sharded._generate(
+            {"text_input": np.array([b"abandoned stream"],
+                                    dtype=np.object_),
+             "max_tokens": np.array([40], dtype=np.int32),
+             "ignore_eos": np.array([True])}, {})
+        next(stream)
+        stream.close()
+        snap = drain(sharded)
+        if snap["pages_used"] or snap["pages_reserved"]:
+            failures.append(
+                "sharded pool leaked pages after churn: %d used, "
+                "%d reserved"
+                % (snap["pages_used"], snap["pages_reserved"]))
+        members = sorted(lease.device_key
+                         for lease in sharded._kv_leases)
+        if len(members) != 4:
+            failures.append("sharded pool holds %d member leases "
+                            "(want one per slice device)"
+                            % len(members))
+    finally:
+        single.unload()
+        sharded.unload()
+    return failures
+
+
+def run_once(attempt: int) -> tuple:
+    from client_tpu.perf.bench_child import run_mesh_measure
+    from client_tpu.server.app import build_core
+
+    core = build_core([], warmup=False)
+    try:
+        result = run_mesh_measure(
+            core, model_name="mesh_smoke_%d_" % attempt)
+    finally:
+        core.shutdown()
+    print(json.dumps(result, indent=1))
+
+    hard, soft = [], []
+    if result.get("degrade_goodput_pct") != 100.0:
+        hard.append("goodput %.2f%% with one chip killed (want "
+                    "100%%: the sibling slice must mask every "
+                    "failure)" % result.get("degrade_goodput_pct", 0.0))
+    if result.get("ejections", 0) < 1:
+        hard.append("no slice ejection recorded — the sick chip "
+                    "never took its slice out of routing")
+    if result.get("readmissions", 0) < 1:
+        hard.append("no slice readmission recorded — the supervisor "
+                    "never healed the ejected slice")
+    if result.get("healthy_during_degrade") not in (None, 1):
+        hard.append("%s slices healthy during the kill (want exactly "
+                    "the sibling slice)"
+                    % result.get("healthy_during_degrade"))
+    scaling = result.get("scaling_2v1", 0.0)
+    if scaling < 1.8:
+        soft.append("throughput at 2 slices is %.2fx the 1-slice "
+                    "rate (gate: 1.8x)" % scaling)
+    return result, hard, soft
+
+
+def main() -> int:
+    failures = check_budget_proof()
+    failures += check_llm_parity_and_sharded_kv()
+    for failure in failures:
+        print("FAIL: %s" % failure, file=sys.stderr)
+    if failures:
+        return 1
+    print("mesh smoke: budget proof + golden parity + sharded paged "
+          "KV passed")
+
+    for attempt in range(2):
+        result, hard, soft = run_once(attempt)
+        for failure in hard:
+            print("FAIL: %s" % failure, file=sys.stderr)
+        if hard:
+            return 1
+        if not soft:
+            print("mesh smoke passed: %.2fx scaling at 2 slices "
+                  "(tp=%d), 100%% goodput through a killed chip "
+                  "(%d ejection(s), %d readmission(s))"
+                  % (result.get("scaling_2v1", 0.0),
+                     result.get("slice_width", 0),
+                     result.get("ejections", 0),
+                     result.get("readmissions", 0)))
+            return 0
+        for failure in soft:
+            print("attempt %d: %s" % (attempt, failure),
+                  file=sys.stderr)
+    print("FAIL: %s" % soft[0], file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -73,7 +73,7 @@ def _expected_schema():
         "ResponseCacheConfig": [("enable", 1)],
         "SloConfig": _normalize_rows(tool.SLO_CONFIG_FIELDS),
         "AutoscaleConfig": _normalize_rows(tool.AUTOSCALE_CONFIG_FIELDS),
-        "ModelInstanceConfig": [("autoscale", 5)],
+        "ModelInstanceConfig": [("autoscale", 5), ("shard_mesh", 6)],
         "ModelConfig": [("response_cache", 15), ("slo", 16)],
     }
     return {
